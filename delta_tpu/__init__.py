@@ -1,0 +1,70 @@
+"""delta-tpu: a TPU-native lakehouse framework.
+
+A ground-up reimplementation of the Delta Lake transaction-log protocol
+(reference: vkorukanti/delta — PROTOCOL.md) designed for TPU execution:
+
+- The transaction log (`_delta_log/`) is the unit of truth: numbered JSON
+  commits, Parquet checkpoints, `_last_checkpoint`, `.crc` checksums.
+- Snapshot state reconstruction — the replay of AddFile/RemoveFile actions
+  into the live file set — runs as a jitted sort + segmented last-wins
+  reduce over `(path_hash, dv_hash, version)` keys on TPU, instead of the
+  reference's per-row JVM hash maps (spark `InMemoryLogReplay.scala:38`,
+  kernel `ActiveAddFilesIterator.java:54`).
+- Data skipping, checkpoint stats, Z-order curve keys, and deletion-vector
+  bitmaps reuse the same device columnar kernels.
+- All I/O and compute the core needs is behind an Engine SPI mirroring the
+  Delta Kernel `Engine` boundary (kernel-api `engine/Engine.java:30`):
+  JsonHandler / ParquetHandler / ExpressionHandler / FileSystemClient /
+  MetricsReporter.
+
+Public API (mirrors kernel-api `Table.java` / `Snapshot` / `Scan` /
+`Transaction` plus the spark-side `DeltaTable` conveniences):
+
+    from delta_tpu import Table
+    table = Table.for_path("/data/events")
+    snap = table.latest_snapshot()
+    files = snap.scan().add_files()
+"""
+
+from delta_tpu.version import __version__
+from delta_tpu.table import Table
+from delta_tpu.snapshot import Snapshot
+from delta_tpu.scan import Scan, ScanBuilder
+from delta_tpu.txn.transaction import Transaction, TransactionBuilder, Operation
+from delta_tpu.errors import (
+    DeltaError,
+    TableNotFoundError,
+    ConcurrentModificationError,
+    ProtocolChangedError,
+    MetadataChangedError,
+    ConcurrentAppendError,
+    ConcurrentDeleteReadError,
+    ConcurrentDeleteDeleteError,
+    ConcurrentTransactionError,
+    VersionNotFoundError,
+    CommitFailedError,
+    InvariantViolationError,
+)
+
+__all__ = [
+    "__version__",
+    "Table",
+    "Snapshot",
+    "Scan",
+    "ScanBuilder",
+    "Transaction",
+    "TransactionBuilder",
+    "Operation",
+    "DeltaError",
+    "TableNotFoundError",
+    "ConcurrentModificationError",
+    "ProtocolChangedError",
+    "MetadataChangedError",
+    "ConcurrentAppendError",
+    "ConcurrentDeleteReadError",
+    "ConcurrentDeleteDeleteError",
+    "ConcurrentTransactionError",
+    "VersionNotFoundError",
+    "CommitFailedError",
+    "InvariantViolationError",
+]

@@ -1,0 +1,98 @@
+"""Convenience API: the delta-spark `DeltaTable` / DataFrame-writer
+equivalents for Arrow tables.
+
+    import delta_tpu.api as dta
+    dta.write_table("/data/events", arrow_table, partition_by=["date"])
+    t = dta.read_table("/data/events", filter=col("date") == lit("2024-01-01"))
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import RemoveFile
+from delta_tpu.models.schema import from_arrow_schema
+from delta_tpu.table import Table
+from delta_tpu.txn.transaction import Operation
+from delta_tpu.write.writer import write_data_files
+
+
+def write_table(
+    path: str,
+    data: pa.Table,
+    mode: str = "append",
+    partition_by: Optional[Sequence[str]] = None,
+    engine=None,
+    properties: Optional[Dict[str, str]] = None,
+    target_rows_per_file: Optional[int] = None,
+) -> int:
+    """Write an Arrow table as a Delta commit. Returns the commit version.
+
+    mode: 'append' | 'overwrite' | 'error' (fail if exists) | 'ignore'.
+    """
+    table = Table.for_path(path, engine)
+    exists = table.exists()
+    if exists and mode == "error":
+        raise DeltaError(f"table {path} already exists")
+    if exists and mode == "ignore":
+        snap = table.latest_snapshot()
+        return snap.version
+
+    builder = table.create_transaction_builder(
+        Operation.WRITE if exists else Operation.CREATE_TABLE
+    )
+    if not exists:
+        builder = builder.with_schema(from_arrow_schema(data.schema))
+        if partition_by:
+            builder = builder.with_partition_columns(partition_by)
+        if properties:
+            builder = builder.with_table_properties(properties)
+    txn = builder.build()
+
+    meta = txn.metadata()
+    schema = meta.schema
+    partition_columns = meta.partitionColumns
+
+    if exists and mode == "overwrite":
+        for f in txn.scan_files():
+            txn.remove_file(f.remove(deletion_timestamp=_now_ms()))
+
+    adds = write_data_files(
+        engine=table.engine,
+        table_path=table.path,
+        data=data,
+        schema=schema,
+        partition_columns=partition_columns,
+        configuration=meta.configuration,
+        target_rows_per_file=target_rows_per_file,
+    )
+    txn.add_files(adds)
+    result = txn.commit()
+    return result.version
+
+
+def read_table(
+    path: str,
+    filter=None,
+    columns: Optional[List[str]] = None,
+    version: Optional[int] = None,
+    timestamp_ms: Optional[int] = None,
+    engine=None,
+) -> pa.Table:
+    table = Table.for_path(path, engine)
+    if version is not None:
+        snap = table.snapshot_at(version)
+    elif timestamp_ms is not None:
+        snap = table.snapshot_as_of_timestamp(timestamp_ms)
+    else:
+        snap = table.latest_snapshot()
+    return snap.scan(filter=filter, columns=columns).to_arrow()
+
+
+def _now_ms() -> int:
+    import time
+
+    return int(time.time() * 1000)

@@ -1,0 +1,129 @@
+"""Table properties (`delta.*` keys in `Metadata.configuration`).
+
+The rebuild's `DeltaConfig.scala` analogue: typed accessors with defaults
+and validation. Session-level tuning knobs live in `delta_tpu.settings`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() == "true"
+
+
+def _parse_interval_ms(s: str) -> int:
+    """Parse 'interval <n> <unit>' (Spark CalendarInterval subset) or a
+    plain millisecond count."""
+    s = s.strip().lower()
+    if s.startswith("interval"):
+        parts = s.split()
+        n = float(parts[1])
+        unit = parts[2].rstrip("s") if len(parts) > 2 else "millisecond"
+        scale = {
+            "millisecond": 1,
+            "second": 1000,
+            "minute": 60_000,
+            "hour": 3_600_000,
+            "day": 86_400_000,
+            "week": 7 * 86_400_000,
+        }[unit]
+        return int(n * scale)
+    return int(s)
+
+
+@dataclass(frozen=True)
+class TableConfig:
+    key: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str = ""
+
+
+def _cfg(key: str, default, parse, doc="") -> TableConfig:
+    c = TableConfig(key, default, parse, doc)
+    TABLE_CONFIGS[key] = c
+    return c
+
+
+TABLE_CONFIGS: Dict[str, TableConfig] = {}
+
+CHECKPOINT_INTERVAL = _cfg(
+    "delta.checkpointInterval", 10, int,
+    "Write a checkpoint every N commits (reference default 10, "
+    "`DeltaConfig.scala:402`).",
+)
+LOG_RETENTION = _cfg(
+    "delta.logRetentionDuration", 30 * 86_400_000, _parse_interval_ms,
+    "How long commit files are kept before metadata cleanup (30 days).",
+)
+TOMBSTONE_RETENTION = _cfg(
+    "delta.deletedFileRetentionDuration", 7 * 86_400_000, _parse_interval_ms,
+    "How long remove tombstones are kept in checkpoints / how soon VACUUM "
+    "may delete data files (7 days).",
+)
+ENABLE_EXPIRED_LOG_CLEANUP = _cfg(
+    "delta.enableExpiredLogCleanup", True, _parse_bool,
+    "Clean expired commits after checkpointing.",
+)
+APPEND_ONLY = _cfg(
+    "delta.appendOnly", False, _parse_bool,
+    "Reject deletes/updates when true.",
+)
+ENABLE_CDF = _cfg(
+    "delta.enableChangeDataFeed", False, _parse_bool,
+    "Write change-data files for DML.",
+)
+IN_COMMIT_TIMESTAMPS = _cfg(
+    "delta.enableInCommitTimestamps", False, _parse_bool,
+    "Commit timestamps from commitInfo.inCommitTimestamp (monotonic) "
+    "instead of file modification times.",
+)
+COLUMN_MAPPING_MODE = _cfg(
+    "delta.columnMapping.mode", "none", str,
+    "none | name | id logical->physical column indirection.",
+)
+COLUMN_MAPPING_MAX_ID = _cfg("delta.columnMapping.maxColumnId", 0, int)
+DATA_SKIPPING_NUM_INDEXED_COLS = _cfg(
+    "delta.dataSkippingNumIndexedCols", 32, int,
+    "Collect min/max/nullCount stats for the first N leaf columns "
+    "(`DataSkippingReader.scala:176`).",
+)
+DATA_SKIPPING_STATS_COLUMNS = _cfg(
+    "delta.dataSkippingStatsColumns", None, lambda s: [c.strip() for c in s.split(",")],
+    "Explicit stats column list (overrides the first-N rule).",
+)
+ROW_TRACKING_ENABLED = _cfg("delta.enableRowTracking", False, _parse_bool)
+DELETION_VECTORS_ENABLED = _cfg("delta.enableDeletionVectors", False, _parse_bool)
+CHECKPOINT_POLICY = _cfg(
+    "delta.checkpointPolicy", "classic", str, "classic | v2",
+)
+TARGET_FILE_SIZE = _cfg("delta.targetFileSize", 256 * 1024 * 1024, int)
+AUTO_OPTIMIZE_AUTO_COMPACT = _cfg("delta.autoOptimize.autoCompact", False, _parse_bool)
+OPTIMIZE_WRITE = _cfg("delta.autoOptimize.optimizeWrite", False, _parse_bool)
+
+
+def get_table_config(configuration: Dict[str, str], cfg: TableConfig):
+    raw = configuration.get(cfg.key)
+    if raw is None:
+        return cfg.default
+    return cfg.parse(raw)
+
+
+@dataclass
+class Settings:
+    """Session-level knobs (the `DeltaSQLConf` analogue, pared to what the
+    engine actually consults)."""
+
+    max_commit_retries: int = 200            # spark DELTA_MAX_RETRY default
+    checkpoint_part_size: Optional[int] = None  # actions per checkpoint part
+    replay_min_device_rows: int = 4096       # below this, host replay wins
+    stats_collection_enabled: bool = True
+    write_checksum_enabled: bool = True
+    vacuum_parallelism: int = 16
+    verify_checkpoint_row_count: bool = True
+
+
+settings = Settings()

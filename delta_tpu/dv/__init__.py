@@ -1,0 +1,25 @@
+"""Deletion vectors: roaring-bitmap soft deletes.
+
+`roaring.py` is a clean-room, numpy-vectorized implementation of the
+standard RoaringFormatSpec 32-bit serialization plus the 64-bit portable
+extension (the reference uses the RoaringBitmap JVM library behind
+`RoaringBitmapArray.scala:46`). `descriptor.py` handles the Delta wire
+formats: the magic-prefixed blob, the versioned DV file layout, inline
+base85 descriptors, and 'u'-type path derivation.
+"""
+
+from delta_tpu.dv.roaring import RoaringBitmapArray
+from delta_tpu.dv.descriptor import (
+    load_deletion_vector,
+    write_deletion_vector_file,
+    inline_descriptor,
+    absolute_dv_path,
+)
+
+__all__ = [
+    "RoaringBitmapArray",
+    "load_deletion_vector",
+    "write_deletion_vector_file",
+    "inline_descriptor",
+    "absolute_dv_path",
+]

@@ -1,0 +1,115 @@
+"""Deletion-vector storage: paths, inline codecs, file layout.
+
+Formats per PROTOCOL.md:1819-1831 and reference
+`actions/DeletionVectorDescriptor.scala` / `storage/dv/`:
+
+- storageType 'u': pathOrInlineDv = `<random prefix><base85 uuid(20 chars)>`;
+  the DV lives in `<table>/<prefix>/deletion_vector_<uuid>.bin` at `offset`.
+- storageType 'p': absolute path.
+- storageType 'i': pathOrInlineDv = base85 of the magic-prefixed blob.
+
+DV file layout (big-endian): [version u8 = 1] then per DV:
+[dataSize i32][blob: magic+portable bitmap][crc32 of blob].
+Base85 uses the RFC 1924 alphabet (= Python's `base64.b85*`).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import struct
+import uuid as _uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from delta_tpu.dv.roaring import RoaringBitmapArray, checksum
+from delta_tpu.models.actions import DeletionVectorDescriptor
+
+DV_FILE_VERSION = 1
+
+
+def encode_uuid_base85(u: _uuid.UUID) -> str:
+    return base64.b85encode(u.bytes).decode("ascii")
+
+
+def decode_uuid_base85(s: str) -> _uuid.UUID:
+    return _uuid.UUID(bytes=base64.b85decode(s.encode("ascii")))
+
+
+def absolute_dv_path(table_path: str, descriptor_row: Dict) -> str:
+    """Resolve the DV file location from a descriptor (dict or dataclass)."""
+    storage = descriptor_row["storageType"]
+    p = descriptor_row["pathOrInlineDv"]
+    if storage == "p":
+        return p
+    if storage == "u":
+        prefix, enc = p[:-20], p[-20:]
+        u = decode_uuid_base85(enc)
+        name = f"deletion_vector_{u}.bin"
+        if prefix:
+            return f"{table_path}/{prefix}/{name}"
+        return f"{table_path}/{name}"
+    raise ValueError(f"cannot resolve a path for storageType {storage!r}")
+
+
+def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.ndarray:
+    """Descriptor → sorted uint64 array of deleted row indexes."""
+    storage = descriptor_row["storageType"]
+    if storage == "i":
+        blob = base64.b85decode(descriptor_row["pathOrInlineDv"].encode("ascii"))
+        return RoaringBitmapArray.deserialize_delta(blob).values
+    path = absolute_dv_path(table_path, descriptor_row)
+    data = engine.fs.read_file(path)
+    offset = descriptor_row.get("offset") or 0
+    (size,) = struct.unpack_from(">i", data, offset)
+    blob = data[offset + 4:offset + 4 + size]
+    (crc,) = struct.unpack_from(">I", data, offset + 4 + size)
+    if checksum(blob) != crc:
+        raise ValueError(f"deletion vector checksum mismatch in {path}")
+    return RoaringBitmapArray.deserialize_delta(blob).values
+
+
+def write_deletion_vector_file(
+    engine,
+    table_path: str,
+    bitmaps: list[RoaringBitmapArray],
+    random_prefix: str = "",
+) -> list[DeletionVectorDescriptor]:
+    """Write one `.bin` holding the given bitmaps; returns 'u'-type
+    descriptors (one per bitmap, sharing the file)."""
+    u = _uuid.uuid4()
+    name = f"deletion_vector_{u}.bin"
+    rel_dir = f"{random_prefix}/" if random_prefix else ""
+    path = f"{table_path}/{rel_dir}{name}"
+    body = bytearray([DV_FILE_VERSION])
+    descriptors = []
+    for bm in bitmaps:
+        blob = bm.serialize_delta()
+        offset = len(body)
+        body += struct.pack(">i", len(blob))
+        body += blob
+        body += struct.pack(">I", checksum(blob))
+        descriptors.append(
+            DeletionVectorDescriptor(
+                storageType="u",
+                pathOrInlineDv=f"{random_prefix}{encode_uuid_base85(u)}",
+                offset=offset,
+                sizeInBytes=len(blob),
+                cardinality=bm.cardinality,
+            )
+        )
+    from delta_tpu.storage.logstore import logstore_for_path
+
+    logstore_for_path(path).write(path, bytes(body), overwrite=True)
+    return descriptors
+
+
+def inline_descriptor(bitmap: RoaringBitmapArray) -> DeletionVectorDescriptor:
+    blob = bitmap.serialize_delta()
+    return DeletionVectorDescriptor(
+        storageType="i",
+        pathOrInlineDv=base64.b85encode(blob).decode("ascii"),
+        sizeInBytes=len(blob),
+        cardinality=bitmap.cardinality,
+    )

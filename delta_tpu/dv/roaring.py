@@ -1,0 +1,243 @@
+"""Clean-room numpy RoaringBitmap (32-bit) + 64-bit portable extension.
+
+Serialization follows the public RoaringFormatSpec
+(github.com/RoaringBitmap/RoaringFormatSpec), which PROTOCOL.md:1780-1831
+mandates for deletion vectors:
+
+32-bit container types (per 16-bit high key):
+- array:  sorted uint16 values (cardinality <= 4096)
+- bitmap: 8192-byte fixed bitset
+- run:    uint16 numRuns + (start, length-1) uint16 pairs
+
+Top-level layouts:
+- no runs:   [cookie 12346 i32][numContainers i32]
+             [(key u16, card-1 u16) * n][offsets i32 * n][container data]
+- with runs: [cookie (n-1)<<16 | 12347][run bitset ceil(n/8) bytes]
+             [(key u16, card-1 u16) * n]
+             [offsets i32 * n  -- only when n >= 4][container data]
+
+64-bit portable: [numBuckets i64 LE] then per bucket (ascending):
+[key u32 LE][32-bit roaring bytes].
+
+The in-memory representation here is simply a sorted numpy uint64 array of
+set bits — all set operations are vectorized; serialization groups by
+high bits with `np.unique`. This trades pointer-chasing container maps
+for columnar passes, matching how the rest of the engine works.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Optional
+
+import numpy as np
+
+SERIAL_COOKIE_NO_RUNCONTAINER = 12346
+SERIAL_COOKIE = 12347
+NO_OFFSET_THRESHOLD = 4
+ARRAY_MAX_CARD = 4096
+BITMAP_BYTES = 8192
+
+DELTA_MAGIC = 1681511377
+
+
+class RoaringBitmapArray:
+    """A set of uint64 row indexes (sorted, deduplicated numpy array)."""
+
+    def __init__(self, values: Optional[np.ndarray] = None):
+        if values is None or len(values) == 0:
+            self.values = np.empty(0, dtype=np.uint64)
+        else:
+            self.values = np.unique(np.asarray(values, dtype=np.uint64))
+
+    # -- set ops (vectorized) ----------------------------------------------
+
+    @property
+    def cardinality(self) -> int:
+        return int(self.values.shape[0])
+
+    def contains(self, x) -> np.ndarray:
+        x = np.asarray(x, dtype=np.uint64)
+        idx = np.searchsorted(self.values, x)
+        idx = np.minimum(idx, max(len(self.values) - 1, 0))
+        if len(self.values) == 0:
+            return np.zeros(x.shape, dtype=bool)
+        return self.values[idx] == x
+
+    def union(self, other: "RoaringBitmapArray") -> "RoaringBitmapArray":
+        return RoaringBitmapArray(np.union1d(self.values, other.values))
+
+    def intersect(self, other: "RoaringBitmapArray") -> "RoaringBitmapArray":
+        return RoaringBitmapArray(np.intersect1d(self.values, other.values))
+
+    def difference(self, other: "RoaringBitmapArray") -> "RoaringBitmapArray":
+        return RoaringBitmapArray(np.setdiff1d(self.values, other.values))
+
+    def add_all(self, values) -> "RoaringBitmapArray":
+        return self.union(RoaringBitmapArray(np.asarray(values, dtype=np.uint64)))
+
+    def to_mask(self, n: int) -> np.ndarray:
+        """Boolean deleted-mask of length n."""
+        mask = np.zeros(n, dtype=bool)
+        sel = self.values[self.values < n]
+        mask[sel.astype(np.int64)] = True
+        return mask
+
+    def __eq__(self, other):
+        return isinstance(other, RoaringBitmapArray) and np.array_equal(
+            self.values, other.values
+        )
+
+    def __len__(self):
+        return self.cardinality
+
+    # -- 32-bit roaring serialization --------------------------------------
+
+    @staticmethod
+    def _serialize32(values32: np.ndarray) -> bytes:
+        """values32: sorted unique uint32 -> standard portable bytes
+        (writer emits array/bitmap containers, never runs)."""
+        high = (values32 >> np.uint32(16)).astype(np.uint16)
+        low = (values32 & np.uint32(0xFFFF)).astype(np.uint16)
+        keys, starts = np.unique(high, return_index=True)
+        n = len(keys)
+        bounds = np.append(starts, len(values32))
+        header = struct.pack("<ii", SERIAL_COOKIE_NO_RUNCONTAINER, n)
+        descr = bytearray()
+        containers = []
+        for i in range(n):
+            lo = low[bounds[i]:bounds[i + 1]]
+            card = len(lo)
+            descr += struct.pack("<HH", int(keys[i]), card - 1)
+            if card <= ARRAY_MAX_CARD:
+                containers.append(lo.astype("<u2").tobytes())
+            else:
+                bits = np.zeros(BITMAP_BYTES, dtype=np.uint8)
+                np.bitwise_or.at(
+                    bits, (lo >> np.uint16(3)).astype(np.int64),
+                    (np.uint8(1) << (lo & np.uint16(7)).astype(np.uint8)),
+                )
+                containers.append(bits.tobytes())
+        # offsets: absolute byte position of each container within the blob
+        offset_block_pos = len(header) + len(descr)
+        data_start = offset_block_pos + 4 * n
+        offsets = []
+        pos = data_start
+        for c in containers:
+            offsets.append(pos)
+            pos += len(c)
+        return (
+            bytes(header)
+            + bytes(descr)
+            + struct.pack(f"<{n}i", *offsets)
+            + b"".join(containers)
+        )
+
+    @staticmethod
+    def _deserialize32(buf: memoryview) -> tuple[np.ndarray, int]:
+        """Returns (sorted uint32 values, bytes consumed)."""
+        (cookie16,) = struct.unpack_from("<H", buf, 0)
+        pos = 0
+        if cookie16 == SERIAL_COOKIE:
+            (cookie,) = struct.unpack_from("<I", buf, 0)
+            n = (cookie >> 16) + 1
+            pos = 4
+            run_bytes = (n + 7) // 8
+            run_flags = np.unpackbits(
+                np.frombuffer(buf[pos:pos + run_bytes], dtype=np.uint8), bitorder="little"
+            )[:n].astype(bool)
+            pos += run_bytes
+            has_offsets = n >= NO_OFFSET_THRESHOLD
+        else:
+            cookie32, n = struct.unpack_from("<ii", buf, 0)
+            if cookie32 != SERIAL_COOKIE_NO_RUNCONTAINER:
+                raise ValueError(f"bad roaring cookie {cookie32}")
+            pos = 8
+            run_flags = np.zeros(n, dtype=bool)
+            has_offsets = True
+
+        keys = np.empty(n, dtype=np.uint16)
+        cards = np.empty(n, dtype=np.int64)
+        for i in range(n):
+            k, c = struct.unpack_from("<HH", buf, pos + 4 * i)
+            keys[i] = k
+            cards[i] = c + 1
+        pos += 4 * n
+        if has_offsets:
+            pos += 4 * n  # offsets are redundant for sequential reads
+
+        parts = []
+        for i in range(n):
+            key = np.uint32(keys[i]) << np.uint32(16)
+            if run_flags[i]:
+                (n_runs,) = struct.unpack_from("<H", buf, pos)
+                pos += 2
+                runs = np.frombuffer(buf[pos:pos + 4 * n_runs], dtype="<u2").reshape(-1, 2)
+                pos += 4 * n_runs
+                lows = np.concatenate(
+                    [
+                        np.arange(int(s), int(s) + int(l) + 1, dtype=np.uint32)
+                        for s, l in runs
+                    ]
+                ) if n_runs else np.empty(0, np.uint32)
+            elif cards[i] > ARRAY_MAX_CARD:
+                bits = np.frombuffer(buf[pos:pos + BITMAP_BYTES], dtype=np.uint8)
+                pos += BITMAP_BYTES
+                unpacked = np.unpackbits(bits, bitorder="little")
+                lows = np.nonzero(unpacked)[0].astype(np.uint32)
+            else:
+                c = int(cards[i])
+                lows = np.frombuffer(buf[pos:pos + 2 * c], dtype="<u2").astype(np.uint32)
+                pos += 2 * c
+            parts.append(key | lows)
+        values = np.concatenate(parts) if parts else np.empty(0, np.uint32)
+        return values, pos
+
+    # -- 64-bit portable ----------------------------------------------------
+
+    def serialize_portable(self) -> bytes:
+        """64-bit portable format (no Delta magic)."""
+        v = self.values
+        high = (v >> np.uint64(32)).astype(np.uint32)
+        low = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        keys, starts = np.unique(high, return_index=True)
+        bounds = np.append(starts, len(v))
+        out = [struct.pack("<q", len(keys))]
+        for i, key in enumerate(keys):
+            out.append(struct.pack("<I", int(key)))
+            out.append(self._serialize32(low[bounds[i]:bounds[i + 1]]))
+        return b"".join(out)
+
+    @staticmethod
+    def deserialize_portable(data: bytes) -> "RoaringBitmapArray":
+        buf = memoryview(data)
+        (n_buckets,) = struct.unpack_from("<q", buf, 0)
+        pos = 8
+        parts = []
+        for _ in range(n_buckets):
+            (key,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            lows, used = RoaringBitmapArray._deserialize32(buf[pos:])
+            pos += used
+            parts.append((np.uint64(key) << np.uint64(32)) | lows.astype(np.uint64))
+        values = np.concatenate(parts) if parts else np.empty(0, np.uint64)
+        out = RoaringBitmapArray.__new__(RoaringBitmapArray)
+        out.values = values  # already sorted by construction
+        return out
+
+    # -- Delta blob (magic + portable) -------------------------------------
+
+    def serialize_delta(self) -> bytes:
+        return struct.pack("<i", DELTA_MAGIC) + self.serialize_portable()
+
+    @staticmethod
+    def deserialize_delta(data: bytes) -> "RoaringBitmapArray":
+        (magic,) = struct.unpack_from("<i", data, 0)
+        if magic != DELTA_MAGIC:
+            raise ValueError(f"bad deletion-vector magic {magic}")
+        return RoaringBitmapArray.deserialize_portable(data[4:])
+
+
+def checksum(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF

@@ -1,0 +1,22 @@
+from delta_tpu.engine.spi import (
+    Engine,
+    JsonHandler,
+    ParquetHandler,
+    FileSystemClient,
+    ExpressionHandler,
+    MetricsReporter,
+)
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.engine.tpu import TpuEngine, default_engine
+
+__all__ = [
+    "Engine",
+    "JsonHandler",
+    "ParquetHandler",
+    "FileSystemClient",
+    "ExpressionHandler",
+    "MetricsReporter",
+    "HostEngine",
+    "TpuEngine",
+    "default_engine",
+]

@@ -1,0 +1,135 @@
+"""HostEngine: CPU/pyarrow implementation of the Engine SPI.
+
+This is the rebuild's analogue of `kernel-defaults`' `DefaultEngine`
+(`DefaultEngine.java:24`): Parquet via pyarrow (the parquet-mr role), JSON
+via the stdlib, an interpreted expression evaluator over Arrow batches.
+It is both the portability fallback and the measured baseline that the
+TpuEngine must beat.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.json as pa_json
+import pyarrow.parquet as pq
+
+from delta_tpu.engine.spi import (
+    Engine,
+    ExpressionHandler,
+    FileSystemClient,
+    JsonHandler,
+    MetricsReporter,
+    ParquetHandler,
+)
+from delta_tpu.storage.logstore import FileStatus, LogStore, logstore_for_path
+
+
+class HostJsonHandler(JsonHandler):
+    def __init__(self, store_resolver=logstore_for_path):
+        self._store_for = store_resolver
+
+    def parse_json(self, json_strings: Sequence[str], schema: pa.Schema) -> pa.Table:
+        rows = [json.loads(s) if s is not None else {} for s in json_strings]
+        return pa.Table.from_pylist(rows, schema=schema)
+
+    def read_json_files(self, paths: Sequence[str]) -> Iterator[tuple[str, bytes]]:
+        for p in paths:
+            yield p, self._store_for(p).read(p)
+
+    def write_json_file_atomically(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self._store_for(path).write(path, data, overwrite=overwrite)
+
+
+class HostParquetHandler(ParquetHandler):
+    def __init__(self, store_resolver=logstore_for_path):
+        self._store_for = store_resolver
+
+    def read_parquet_files(
+        self, paths: Sequence[str], columns: Optional[List[str]] = None
+    ) -> Iterator[pa.Table]:
+        for p in paths:
+            data = self._store_for(p).read(p)
+            yield pq.read_table(pa.BufferReader(data), columns=columns)
+
+    def write_parquet_file(self, path: str, table: pa.Table) -> FileStatus:
+        sink = pa.BufferOutputStream()
+        pq.write_table(table, sink, compression="snappy")
+        buf = sink.getvalue().to_pybytes()
+        store = self._store_for(path)
+        store.write(path, buf, overwrite=True)
+        return store.file_status(path)
+
+    def write_parquet_file_atomically(self, path: str, table: pa.Table) -> None:
+        sink = pa.BufferOutputStream()
+        pq.write_table(table, sink, compression="snappy")
+        self._store_for(path).write(path, sink.getvalue().to_pybytes(), overwrite=False)
+
+
+class HostFileSystemClient(FileSystemClient):
+    def __init__(self, store_resolver=logstore_for_path):
+        self._store_for = store_resolver
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        return self._store_for(path).list_from(path)
+
+    def read_file(self, path: str) -> bytes:
+        return self._store_for(path).read(path)
+
+    def resolve_path(self, path: str) -> str:
+        return path
+
+    def mkdirs(self, path: str) -> None:
+        self._store_for(path).mkdirs(path)
+
+    def delete(self, path: str) -> None:
+        self._store_for(path).delete(path)
+
+    def exists(self, path: str) -> bool:
+        return self._store_for(path).exists(path)
+
+    def file_status(self, path: str):
+        return self._store_for(path).file_status(path)
+
+
+class HostExpressionHandler(ExpressionHandler):
+    """Interpreted evaluator over Arrow batches (via numpy); expression
+    trees come from delta_tpu.expressions."""
+
+    def evaluate(self, expr, batch: pa.Table):
+        from delta_tpu.expressions.eval import evaluate_host
+
+        return evaluate_host(expr, batch)
+
+    def evaluate_predicate(self, expr, batch: pa.Table) -> np.ndarray:
+        from delta_tpu.expressions.eval import evaluate_host
+
+        result = evaluate_host(expr, batch)
+        arr = np.asarray(result)
+        if arr.dtype != np.bool_:
+            # three-valued logic: NULL -> cannot prune -> treated True by
+            # skipping callers; plain predicate callers get False
+            arr = np.nan_to_num(arr.astype(np.float64), nan=0.0) != 0
+        return arr
+
+
+class LoggingMetricsReporter(MetricsReporter):
+    def __init__(self):
+        self.reports: List[dict] = []
+
+    def report(self, report: dict) -> None:
+        self.reports.append(report)
+
+
+class HostEngine(Engine):
+    def __init__(self, store_resolver=logstore_for_path, metrics_reporters=None):
+        super().__init__(
+            json_handler=HostJsonHandler(store_resolver),
+            parquet_handler=HostParquetHandler(store_resolver),
+            fs_client=HostFileSystemClient(store_resolver),
+            expression_handler=HostExpressionHandler(),
+            metrics_reporters=metrics_reporters,
+        )

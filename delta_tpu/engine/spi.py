@@ -1,0 +1,113 @@
+"""Engine SPI: all I/O and compute the table core needs, supplied as five
+pluggable handlers (mirrors kernel-api `engine/Engine.java:30-63`).
+
+Two implementations ship in-tree:
+- `HostEngine` — CPU/pyarrow execution (the rebuild's `DefaultEngine`
+  analogue, and the honest baseline for the ≥8× target).
+- `TpuEngine` — the same handlers with replay dedup, stats reduction, and
+  predicate evaluation lowered onto TPU via jit'd columnar kernels.
+
+Batches crossing this boundary are Arrow record batches / tables — the
+engine-neutral columnar format (the kernel's `ColumnarBatch` analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from delta_tpu.storage.logstore import FileStatus
+
+
+class JsonHandler:
+    """Parse/read/write newline-delimited JSON (commit files, _last_checkpoint)."""
+
+    def parse_json(self, json_strings: Sequence[str], schema: pa.Schema) -> pa.Table:
+        raise NotImplementedError
+
+    def read_json_files(self, paths: Sequence[str]) -> Iterator[tuple[str, bytes]]:
+        """Yield (path, raw bytes) per file; decoding to actions is the
+        caller's columnarizer's job."""
+        raise NotImplementedError
+
+    def write_json_file_atomically(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        raise NotImplementedError
+
+
+class ParquetHandler:
+    """Read/write Parquet (checkpoints, data files)."""
+
+    def read_parquet_files(
+        self, paths: Sequence[str], columns: Optional[List[str]] = None
+    ) -> Iterator[pa.Table]:
+        raise NotImplementedError
+
+    def write_parquet_file(self, path: str, table: pa.Table) -> FileStatus:
+        raise NotImplementedError
+
+    def write_parquet_file_atomically(self, path: str, table: pa.Table) -> None:
+        raise NotImplementedError
+
+
+class FileSystemClient:
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        raise NotImplementedError
+
+    def read_file(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def resolve_path(self, path: str) -> str:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def file_status(self, path: str) -> FileStatus:
+        raise NotImplementedError
+
+
+class ExpressionHandler:
+    """Evaluate expressions over columnar batches (partition pruning,
+    data-skipping predicates, stats aggregation)."""
+
+    def evaluate(self, expr, batch: pa.Table):
+        """Return an Arrow array (projection) for `expr` over `batch`."""
+        raise NotImplementedError
+
+    def evaluate_predicate(self, expr, batch: pa.Table):
+        """Return a boolean selection mask (numpy bool array) for `expr`."""
+        raise NotImplementedError
+
+
+class MetricsReporter:
+    def report(self, report: dict) -> None:
+        raise NotImplementedError
+
+
+class Engine:
+    """Bundle of the five handlers."""
+
+    def __init__(
+        self,
+        json_handler: JsonHandler,
+        parquet_handler: ParquetHandler,
+        fs_client: FileSystemClient,
+        expression_handler: ExpressionHandler,
+        metrics_reporters: Optional[List[MetricsReporter]] = None,
+    ):
+        self.json = json_handler
+        self.parquet = parquet_handler
+        self.fs = fs_client
+        self.expressions = expression_handler
+        self.metrics_reporters = list(metrics_reporters or [])
+
+    def report_metrics(self, report: dict) -> None:
+        for r in self.metrics_reporters:
+            r.report(report)

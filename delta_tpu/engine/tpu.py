@@ -1,0 +1,47 @@
+"""TpuEngine: the TPU-backed Engine implementation.
+
+I/O handlers (JSON/Parquet/filesystem) stay host-side — object-store bytes
+never touch the accelerator — but everything columnar runs on device:
+
+- snapshot state reconstruction: jit'd sort + segmented last-wins reduce
+  (`delta_tpu.ops.replay`), optionally sharded over a `jax.sharding.Mesh`
+  (`delta_tpu.parallel`);
+- data-skipping predicate evaluation over the stats index
+  (`delta_tpu.stats.skipping`);
+- stats aggregation (min/max/nullCount) for written files and checkpoint
+  summaries;
+- Z-order / Hilbert curve keys for OPTIMIZE.
+
+This class is the rebuild's counterpart of registering a new `Engine` with
+the kernel (`kernel-defaults` `DefaultEngine.java:24` being the sibling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.storage.logstore import logstore_for_path
+
+
+class TpuEngine(HostEngine):
+    use_device_replay = True
+
+    def __init__(
+        self,
+        store_resolver=logstore_for_path,
+        metrics_reporters=None,
+        mesh=None,
+        replay_shards: Optional[int] = None,
+    ):
+        super().__init__(store_resolver, metrics_reporters)
+        from delta_tpu.expressions.device_eval import DeviceExpressionHandler
+
+        self.expressions = DeviceExpressionHandler()
+        self.mesh = mesh
+        self.replay_shards = replay_shards
+
+
+def default_engine(**kwargs) -> TpuEngine:
+    """The engine used when callers don't pass one."""
+    return TpuEngine(**kwargs)

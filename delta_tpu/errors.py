@@ -1,0 +1,154 @@
+"""Error hierarchy for delta-tpu.
+
+Mirrors the reference's error taxonomy: the concurrent-modification family
+raised by conflict checking (spark `DeltaErrors.scala` /
+`ConflictChecker.scala:175`), commit failures discriminated as
+retryable-vs-conflict (`CommitFailedException`, OptimisticTransaction
+retry loop), and the kernel's Table/Snapshot resolution errors.
+
+Each error carries a stable ``error_class`` string (the reference keeps a
+JSON catalog of these in ``delta-error-classes.json``) so callers can match
+on class rather than message text.
+"""
+
+from __future__ import annotations
+
+
+class DeltaError(Exception):
+    """Base class for all delta-tpu errors."""
+
+    error_class: str = "DELTA_ERROR"
+
+    def __init__(self, message: str = "", **context):
+        super().__init__(message)
+        self.context = context
+
+
+class TableNotFoundError(DeltaError):
+    error_class = "DELTA_TABLE_NOT_FOUND"
+
+
+class VersionNotFoundError(DeltaError):
+    """Requested version is outside the reconstructable range."""
+
+    error_class = "DELTA_VERSION_NOT_FOUND"
+
+    def __init__(self, version=None, earliest=None, latest=None):
+        super().__init__(
+            f"Cannot time travel Delta table to version {version}. "
+            f"Available versions: [{earliest}, {latest}].",
+            version=version,
+            earliest=earliest,
+            latest=latest,
+        )
+
+
+class TimestampEarlierThanCommitRetentionError(DeltaError):
+    error_class = "DELTA_TIMESTAMP_EARLIER_THAN_COMMIT_RETENTION"
+
+
+class TimestampLaterThanLatestCommitError(DeltaError):
+    error_class = "DELTA_TIMESTAMP_LATER_THAN_LATEST_COMMIT"
+
+
+class CommitFailedError(DeltaError):
+    """A commit attempt failed.
+
+    ``retryable`` discriminates transient failures (retry at same version)
+    from losses of the put-if-absent race (rebase + retry at version+1);
+    ``conflict`` marks the latter. Mirrors the semantics of
+    storage `CommitFailedException` consumed by
+    `OptimisticTransaction.scala:2229-2254`.
+    """
+
+    error_class = "DELTA_COMMIT_FAILED"
+
+    def __init__(self, message: str, retryable: bool = False, conflict: bool = False):
+        super().__init__(message)
+        self.retryable = retryable
+        self.conflict = conflict
+
+
+class ConcurrentModificationError(DeltaError):
+    """Base for logical conflicts detected against winning commits."""
+
+    error_class = "DELTA_CONCURRENT_MODIFICATION"
+
+
+class ProtocolChangedError(ConcurrentModificationError):
+    error_class = "DELTA_PROTOCOL_CHANGED"
+
+
+class MetadataChangedError(ConcurrentModificationError):
+    error_class = "DELTA_METADATA_CHANGED"
+
+
+class ConcurrentAppendError(ConcurrentModificationError):
+    """A winning commit added files that this transaction's read predicate
+    might have matched."""
+
+    error_class = "DELTA_CONCURRENT_APPEND"
+
+
+class ConcurrentDeleteReadError(ConcurrentModificationError):
+    """A winning commit removed a file this transaction read."""
+
+    error_class = "DELTA_CONCURRENT_DELETE_READ"
+
+
+class ConcurrentDeleteDeleteError(ConcurrentModificationError):
+    """A winning commit removed a file this transaction also removes."""
+
+    error_class = "DELTA_CONCURRENT_DELETE_DELETE"
+
+
+class ConcurrentTransactionError(ConcurrentModificationError):
+    """A winning commit advanced an idempotent-txn appId this transaction read."""
+
+    error_class = "DELTA_CONCURRENT_TRANSACTION"
+
+
+class ConcurrentWriteError(ConcurrentModificationError):
+    error_class = "DELTA_CONCURRENT_WRITE"
+
+
+class MaxCommitRetriesExceededError(DeltaError):
+    error_class = "DELTA_MAX_COMMIT_RETRIES_EXCEEDED"
+
+
+class InvariantViolationError(DeltaError):
+    """NOT NULL / CHECK constraint violated by written data."""
+
+    error_class = "DELTA_VIOLATE_CONSTRAINT"
+
+
+class UnsupportedTableFeatureError(DeltaError):
+    """Protocol requires a reader/writer feature this client does not implement."""
+
+    error_class = "DELTA_UNSUPPORTED_FEATURES_FOR_READ"
+
+    def __init__(self, features, read: bool = True):
+        kind = "read" if read else "write"
+        super().__init__(
+            f"Unsupported Delta table features for {kind}: {sorted(features)}",
+            features=sorted(features),
+        )
+        self.features = frozenset(features)
+
+
+class InvalidProtocolVersionError(DeltaError):
+    error_class = "DELTA_INVALID_PROTOCOL_VERSION"
+
+
+class ChecksumMismatchError(DeltaError):
+    """Post-replay state disagrees with the `.crc` version checksum."""
+
+    error_class = "DELTA_CHECKSUM_MISMATCH"
+
+
+class SchemaMismatchError(DeltaError):
+    error_class = "DELTA_SCHEMA_MISMATCH"
+
+
+class PartitionColumnMismatchError(DeltaError):
+    error_class = "DELTA_PARTITION_COLUMN_MISMATCH"

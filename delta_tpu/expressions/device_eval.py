@@ -1,0 +1,142 @@
+"""Device (JAX) expression evaluation over columnar batches.
+
+Numeric comparisons/boolean algebra run as jitted elementwise kernels —
+XLA fuses an entire predicate tree into one pass over the columns (this is
+what the TpuEngine uses for data-skipping over the stats index and for
+partition pruning on dictionary-encoded partition columns). Anything
+non-numeric (strings, decimals, maps) falls back to the host evaluator —
+strings reach the device only as dictionary codes, never as bytes.
+
+Null handling: each column is carried as (values, valid) pair; Kleene
+logic propagates validity exactly like the host evaluator.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.engine.spi import ExpressionHandler
+from delta_tpu.expressions.tree import (
+    And,
+    Column,
+    Comparison,
+    Expression,
+    In,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Not,
+    Or,
+)
+
+_NUMERIC_KINDS = ("i", "u", "f", "b")
+
+
+def _batch_to_device_columns(batch: pa.Table) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    cols = {}
+    for name in batch.column_names:
+        arr = batch.column(name).combine_chunks()
+        if pa.types.is_integer(arr.type) or pa.types.is_floating(arr.type) or pa.types.is_boolean(arr.type):
+            valid = np.asarray(pc.is_valid(arr), dtype=bool)
+            values = np.asarray(pc.fill_null(arr, 0))
+            if values.dtype == np.int64:
+                # avoid x64 traps on TPU: split not needed for comparisons
+                # that fit int32; keep float64->float32 would lose precision,
+                # so keep i64/f64 on host numpy and only ship when safe
+                if np.all(np.abs(values) < 2**31):
+                    values = values.astype(np.int32)
+            if values.dtype == np.float64:
+                values = values.astype(np.float32)
+            cols[name] = (values, valid)
+        elif pa.types.is_date32(arr.type):
+            valid = np.asarray(pc.is_valid(arr), dtype=bool)
+            values = np.asarray(arr.cast(pa.int32()).fill_null(0))
+            cols[name] = (values, valid)
+    return cols
+
+
+class _HostFallback(Exception):
+    pass
+
+
+def _eval_device(expr: Expression, cols) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (bool values, valid) arrays."""
+    if isinstance(expr, Column):
+        name = ".".join(expr.name_path)
+        if name not in cols:
+            raise _HostFallback(name)
+        return cols[name]
+    if isinstance(expr, Literal):
+        if not isinstance(expr.value, (int, float, bool, np.number)) or expr.value is None:
+            raise _HostFallback(repr(expr))
+        return (jnp.asarray(expr.value), jnp.asarray(True))
+    if isinstance(expr, Comparison):
+        lv, lval = _eval_device(expr.left, cols)
+        rv, rval = _eval_device(expr.right, cols)
+        op = {
+            "=": jnp.equal,
+            "!=": jnp.not_equal,
+            "<": jnp.less,
+            "<=": jnp.less_equal,
+            ">": jnp.greater,
+            ">=": jnp.greater_equal,
+        }[expr.op]
+        return op(lv, rv), jnp.logical_and(lval, rval)
+    if isinstance(expr, And):
+        lv, lval = _eval_device(expr.left, cols)
+        rv, rval = _eval_device(expr.right, cols)
+        # Kleene: false wins over null
+        value = jnp.logical_and(lv, rv)
+        valid = (lval & rval) | (lval & ~lv) | (rval & ~rv)
+        return value, valid
+    if isinstance(expr, Or):
+        lv, lval = _eval_device(expr.left, cols)
+        rv, rval = _eval_device(expr.right, cols)
+        value = jnp.logical_or(lv, rv)
+        valid = (lval & rval) | (lval & lv) | (rval & rv)
+        return value, valid
+    if isinstance(expr, Not):
+        v, val = _eval_device(expr.child, cols)
+        return jnp.logical_not(v), val
+    if isinstance(expr, IsNull):
+        _, val = _eval_device(expr.child, cols)
+        return jnp.logical_not(val), jnp.ones_like(val, dtype=bool)
+    if isinstance(expr, IsNotNull):
+        _, val = _eval_device(expr.child, cols)
+        return val, jnp.ones_like(val, dtype=bool)
+    if isinstance(expr, In):
+        cv, cval = _eval_device(expr.child, cols)
+        acc = jnp.zeros_like(cv, dtype=bool)
+        for v in expr.values:
+            if not isinstance(v, (int, float, bool, np.number)):
+                raise _HostFallback(repr(expr))
+            acc = acc | (cv == v)
+        return acc, cval
+    raise _HostFallback(repr(expr))
+
+
+class DeviceExpressionHandler(ExpressionHandler):
+    def evaluate(self, expr, batch: pa.Table):
+        from delta_tpu.expressions.eval import evaluate_host
+
+        return evaluate_host(expr, batch)
+
+    def evaluate_predicate(self, expr, batch: pa.Table) -> np.ndarray:
+        cols = _batch_to_device_columns(batch)
+        try:
+            value, valid = jax.jit(
+                functools.partial(_eval_device, expr)
+            )(cols)
+            # WHERE semantics: NULL -> False
+            return np.asarray(value & valid)
+        except _HostFallback:
+            from delta_tpu.expressions.eval import evaluate_predicate_host
+
+            return evaluate_predicate_host(expr, batch)

@@ -1,0 +1,91 @@
+"""Host (numpy-over-Arrow) expression evaluation with SQL 3-valued logic.
+
+Null semantics: comparisons involving NULL yield NULL; AND/OR use Kleene
+logic; IsNull/IsNotNull produce definite booleans. Boolean results are
+returned as a pair encoded in a masked float — we use numpy object-free
+representation: (value: np.ndarray, valid: np.ndarray[bool]).
+
+Public entry `evaluate_host` returns, for predicates, a numpy bool array
+where NULL results are False (SQL WHERE semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.expressions.tree import (
+    And,
+    Column,
+    Comparison,
+    Expression,
+    In,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Not,
+    Or,
+    StartsWith,
+)
+
+
+def _resolve_column(batch: pa.Table, name_path: Tuple[str, ...]) -> pa.ChunkedArray:
+    if name_path[0] not in batch.column_names:
+        raise KeyError(f"column {'.'.join(name_path)} not in batch")
+    arr = batch.column(name_path[0])
+    for part in name_path[1:]:
+        arr = pc.struct_field(arr, part)
+    return arr
+
+
+def _eval(expr: Expression, batch: pa.Table):
+    """Returns a pyarrow Array/ChunkedArray (nullable) for any expression."""
+    n = batch.num_rows
+    if isinstance(expr, Column):
+        return _resolve_column(batch, expr.name_path)
+    if isinstance(expr, Literal):
+        return pa.chunked_array([pa.array([expr.value] * n)])
+    if isinstance(expr, Comparison):
+        left = _eval(expr.left, batch)
+        right = _eval(expr.right, batch)
+        op = {
+            "=": pc.equal,
+            "!=": pc.not_equal,
+            "<": pc.less,
+            "<=": pc.less_equal,
+            ">": pc.greater,
+            ">=": pc.greater_equal,
+        }[expr.op]
+        return op(left, right)
+    if isinstance(expr, And):
+        return pc.and_kleene(_eval(expr.left, batch), _eval(expr.right, batch))
+    if isinstance(expr, Or):
+        return pc.or_kleene(_eval(expr.left, batch), _eval(expr.right, batch))
+    if isinstance(expr, Not):
+        return pc.invert(_eval(expr.child, batch))
+    if isinstance(expr, IsNull):
+        return pc.is_null(_eval(expr.child, batch))
+    if isinstance(expr, IsNotNull):
+        return pc.is_valid(_eval(expr.child, batch))
+    if isinstance(expr, In):
+        child = _eval(expr.child, batch)
+        return pc.is_in(child, value_set=pa.array(list(expr.values)))
+    if isinstance(expr, StartsWith):
+        return pc.starts_with(_eval(expr.child, batch), pattern=expr.prefix)
+    raise ValueError(f"cannot evaluate {expr!r}")
+
+
+def evaluate_host(expr: Expression, batch: pa.Table):
+    return _eval(expr, batch)
+
+
+def evaluate_predicate_host(expr: Expression, batch: pa.Table) -> np.ndarray:
+    """Boolean selection with NULL -> False (WHERE semantics)."""
+    result = _eval(expr, batch)
+    if isinstance(result, pa.ChunkedArray):
+        result = result.combine_chunks()
+    filled = pc.fill_null(result, False)
+    return np.asarray(filled, dtype=np.bool_)

@@ -1,0 +1,169 @@
+"""Table-features registry and protocol negotiation.
+
+PROTOCOL.md:844-876 / reference `TableFeature.scala` +
+`TableFeatureSupport.scala`: capability flags with reader/writer version
+gating. `readerFeatures` may only exist at (3,7); `writerFeatures` at
+writer 7. A *supported* feature is listed in the protocol; it is *active*
+only when its metadata requirement is also met (e.g. deletionVectors
+supported vs `delta.enableDeletionVectors=true`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from delta_tpu.errors import InvalidProtocolVersionError, UnsupportedTableFeatureError
+from delta_tpu.models.actions import Metadata, Protocol
+
+
+@dataclass(frozen=True)
+class TableFeature:
+    name: str
+    min_reader_version: int   # 1 if writer-only
+    min_writer_version: int
+    is_reader_writer: bool
+    # metadata predicate that makes a supported feature *active*
+    activated_by: Optional[Callable[[Metadata], bool]] = None
+    # legacy features are implicitly supported by older proto versions
+    legacy: bool = False
+
+
+FEATURES: Dict[str, TableFeature] = {}
+
+
+def _feature(name, min_reader, min_writer, reader_writer, activated_by=None, legacy=False):
+    f = TableFeature(name, min_reader, min_writer, reader_writer, activated_by, legacy)
+    FEATURES[name] = f
+    return f
+
+
+def _conf_true(key):
+    return lambda m: m.configuration.get(key, "").lower() == "true"
+
+
+APPEND_ONLY = _feature("appendOnly", 1, 2, False, _conf_true("delta.appendOnly"), legacy=True)
+INVARIANTS = _feature("invariants", 1, 2, False, legacy=True)
+CHECK_CONSTRAINTS = _feature("checkConstraints", 1, 3, False, legacy=True)
+CHANGE_DATA_FEED = _feature(
+    "changeDataFeed", 1, 4, False, _conf_true("delta.enableChangeDataFeed"), legacy=True
+)
+GENERATED_COLUMNS = _feature("generatedColumns", 1, 4, False, legacy=True)
+COLUMN_MAPPING = _feature(
+    "columnMapping", 2, 5, True,
+    lambda m: m.configuration.get("delta.columnMapping.mode", "none") != "none",
+    legacy=True,
+)
+IDENTITY_COLUMNS = _feature("identityColumns", 1, 6, False, legacy=True)
+DELETION_VECTORS = _feature(
+    "deletionVectors", 3, 7, True, _conf_true("delta.enableDeletionVectors")
+)
+ROW_TRACKING = _feature("rowTracking", 1, 7, False, _conf_true("delta.enableRowTracking"))
+TIMESTAMP_NTZ = _feature("timestampNtz", 3, 7, True)
+TYPE_WIDENING = _feature("typeWidening", 3, 7, True, _conf_true("delta.enableTypeWidening"))
+DOMAIN_METADATA = _feature("domainMetadata", 1, 7, False)
+V2_CHECKPOINT = _feature(
+    "v2Checkpoint", 3, 7, True,
+    lambda m: m.configuration.get("delta.checkpointPolicy", "classic") == "v2",
+)
+ICEBERG_COMPAT_V1 = _feature("icebergCompatV1", 1, 7, False)
+ICEBERG_COMPAT_V2 = _feature("icebergCompatV2", 1, 7, False)
+IN_COMMIT_TIMESTAMP = _feature(
+    "inCommitTimestamp", 1, 7, False, _conf_true("delta.enableInCommitTimestamps")
+)
+VACUUM_PROTOCOL_CHECK = _feature("vacuumProtocolCheck", 3, 7, True)
+CLUSTERING = _feature("clustering", 1, 7, False)
+VARIANT_TYPE = _feature("variantType", 3, 7, True)
+ALLOW_COLUMN_DEFAULTS = _feature("allowColumnDefaults", 1, 7, False)
+
+
+SUPPORTED_WRITER_FEATURES = frozenset(FEATURES)
+MAX_WRITER_VERSION = 7
+
+
+def protocol_for_new_table(configuration: Dict[str, str]) -> Protocol:
+    """Minimal protocol satisfying the features activated by the given
+    table properties (reference `Protocol.forNewTable` semantics)."""
+    meta = Metadata(id="", configuration=dict(configuration))
+    needed = [f for f in FEATURES.values() if f.activated_by and f.activated_by(meta)]
+    min_reader, min_writer = 1, 2
+    for f in needed:
+        min_reader = max(min_reader, f.min_reader_version)
+        min_writer = max(min_writer, f.min_writer_version)
+    non_legacy = [f for f in needed if not f.legacy]
+    if non_legacy:
+        # feature vectors required
+        reader_features = sorted(
+            f.name for f in needed if f.is_reader_writer
+        ) if any(f.min_reader_version >= 3 for f in needed) else None
+        if reader_features:
+            min_reader = 3
+        min_writer = 7
+        writer_features = sorted(f.name for f in needed)
+        return Protocol(min_reader if not reader_features else 3, 7,
+                        readerFeatures=reader_features, writerFeatures=writer_features)
+    return Protocol(min_reader, min_writer)
+
+
+def upgraded_protocol(current: Protocol, feature: TableFeature) -> Protocol:
+    """Protocol after enabling `feature` (moves to (3,7)/writer-7 feature
+    vectors when the feature is non-legacy)."""
+    reader = set(current.readerFeatures or [])
+    writer = set(current.writerFeatures or [])
+    min_reader = current.minReaderVersion
+    min_writer = current.minWriterVersion
+    if feature.legacy and feature.min_writer_version <= min_writer and (
+        not feature.is_reader_writer or feature.min_reader_version <= min_reader
+    ):
+        return current
+    min_writer = 7
+    writer.add(feature.name)
+    if feature.is_reader_writer and feature.min_reader_version >= 3:
+        min_reader = 3
+        reader.add(feature.name)
+    if min_reader >= 3:
+        # at (3,7) every legacy-supported feature must be listed too
+        reader = reader or set()
+    return Protocol(
+        min_reader,
+        min_writer,
+        readerFeatures=sorted(reader) if min_reader >= 3 else None,
+        writerFeatures=sorted(writer),
+    )
+
+
+def validate_writable(protocol: Optional[Protocol], metadata: Metadata) -> None:
+    """Refuse to write tables whose protocol demands writer features we
+    don't implement (`TableFeatureSupport` write-gate)."""
+    if protocol is None:
+        raise InvalidProtocolVersionError("missing protocol")
+    if protocol.minWriterVersion > MAX_WRITER_VERSION:
+        raise UnsupportedTableFeatureError(
+            {f"writerVersion={protocol.minWriterVersion}"}, read=False
+        )
+    unsupported = protocol.writer_feature_set() - SUPPORTED_WRITER_FEATURES
+    if unsupported:
+        raise UnsupportedTableFeatureError(unsupported, read=False)
+
+
+def is_feature_supported(protocol: Protocol, feature: TableFeature) -> bool:
+    if feature.name in protocol.writer_feature_set() or (
+        feature.is_reader_writer and feature.name in protocol.reader_feature_set()
+    ):
+        return True
+    if feature.legacy:
+        ok_writer = protocol.minWriterVersion >= feature.min_writer_version
+        ok_reader = (
+            not feature.is_reader_writer
+            or protocol.minReaderVersion >= feature.min_reader_version
+        )
+        return ok_writer and ok_reader and protocol.minWriterVersion < 7
+    return False
+
+
+def is_feature_active(protocol: Protocol, metadata: Metadata, feature: TableFeature) -> bool:
+    if not is_feature_supported(protocol, feature):
+        return False
+    if feature.activated_by is None:
+        return True
+    return feature.activated_by(metadata)

@@ -1,0 +1,46 @@
+"""Post-commit hooks (reference `hook/PostCommitHook.java`, spark hooks
+registered at `OptimisticTransaction.scala:378-385`).
+
+Built-ins: CheckpointHook (every `delta.checkpointInterval` commits),
+ChecksumHook (`.crc` per version). Custom hooks register process-wide via
+`register_post_commit_hook`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from delta_tpu.config import CHECKPOINT_INTERVAL, get_table_config, settings
+
+Hook = Callable[..., None]  # (table, txn, version, metadata)
+
+_EXTRA_HOOKS: List[Hook] = []
+
+
+def register_post_commit_hook(hook: Hook) -> None:
+    _EXTRA_HOOKS.append(hook)
+
+
+def checkpoint_hook(table, txn, version: int, metadata) -> None:
+    interval = get_table_config(metadata.configuration, CHECKPOINT_INTERVAL)
+    if interval > 0 and version > 0 and version % interval == 0:
+        from delta_tpu.log.checkpointer import write_checkpoint
+
+        snap = table.snapshot_at(version)
+        write_checkpoint(table.engine, snap)
+
+
+def checksum_hook(table, txn, version: int, metadata) -> None:
+    if not settings.write_checksum_enabled:
+        return
+    from delta_tpu.log.checksum import write_checksum_for_commit
+
+    write_checksum_for_commit(table, txn, version)
+
+
+def run_post_commit_hooks(table, txn, version: int, metadata) -> None:
+    for hook in (checksum_hook, checkpoint_hook, *_EXTRA_HOOKS):
+        try:
+            hook(table, txn, version, metadata)
+        except Exception:
+            pass

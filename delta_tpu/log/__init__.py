@@ -1,0 +1,4 @@
+from delta_tpu.log.segment import LogSegment, build_log_segment
+from delta_tpu.log.last_checkpoint import LastCheckpointInfo
+
+__all__ = ["LogSegment", "build_log_segment", "LastCheckpointInfo"]

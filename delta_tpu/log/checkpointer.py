@@ -1,0 +1,464 @@
+"""Checkpoint writing (classic single-file, multi-part, V2+sidecars).
+
+Reference: spark `Checkpoints.scala:616` writeCheckpoint, kernel
+`CreateCheckpointIterator` → `ParquetHandler.writeParquetFileAtomically`.
+
+A checkpoint materializes the reconciled state at a version as Parquet in
+the SingleAction layout: struct columns `protocol`, `metaData`, `txn`,
+`domainMetadata`, `add`, `remove` — one non-null per row. Contents:
+- 1 protocol + 1 metaData row,
+- one `txn` row per appId, one `domainMetadata` row per domain
+  (including removal tombstones),
+- every live `add` (dataChange=false),
+- every `remove` tombstone younger than the retention window
+  (`delta.deletedFileRetentionDuration`), dataChange=false.
+
+The add/remove struct columns are assembled directly from the snapshot's
+canonical columnar state — no per-row object hop. Finishes by pointing
+`_last_checkpoint` at the new checkpoint.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.config import (
+    CHECKPOINT_POLICY,
+    TOMBSTONE_RETENTION,
+    get_table_config,
+    settings,
+)
+from delta_tpu.errors import ChecksumMismatchError
+from delta_tpu.log.last_checkpoint import LastCheckpointInfo, write_last_checkpoint
+from delta_tpu.models.actions import CheckpointMetadata, Sidecar
+from delta_tpu.replay.columnar import DV_STRUCT_TYPE
+from delta_tpu.utils import filenames
+
+PV_MAP = pa.map_(pa.string(), pa.string())
+
+ADD_STRUCT = pa.struct(
+    [
+        pa.field("path", pa.string()),
+        pa.field("partitionValues", PV_MAP),
+        pa.field("size", pa.int64()),
+        pa.field("modificationTime", pa.int64()),
+        pa.field("dataChange", pa.bool_()),
+        pa.field("stats", pa.string()),
+        pa.field("deletionVector", DV_STRUCT_TYPE),
+        pa.field("baseRowId", pa.int64()),
+        pa.field("defaultRowCommitVersion", pa.int64()),
+        pa.field("clusteringProvider", pa.string()),
+    ]
+)
+
+REMOVE_STRUCT = pa.struct(
+    [
+        pa.field("path", pa.string()),
+        pa.field("deletionTimestamp", pa.int64()),
+        pa.field("dataChange", pa.bool_()),
+        pa.field("extendedFileMetadata", pa.bool_()),
+        pa.field("partitionValues", PV_MAP),
+        pa.field("size", pa.int64()),
+        pa.field("deletionVector", DV_STRUCT_TYPE),
+        pa.field("baseRowId", pa.int64()),
+        pa.field("defaultRowCommitVersion", pa.int64()),
+    ]
+)
+
+PROTOCOL_STRUCT = pa.struct(
+    [
+        pa.field("minReaderVersion", pa.int32()),
+        pa.field("minWriterVersion", pa.int32()),
+        pa.field("readerFeatures", pa.list_(pa.string())),
+        pa.field("writerFeatures", pa.list_(pa.string())),
+    ]
+)
+
+METADATA_STRUCT = pa.struct(
+    [
+        pa.field("id", pa.string()),
+        pa.field("name", pa.string()),
+        pa.field("description", pa.string()),
+        pa.field(
+            "format",
+            pa.struct(
+                [pa.field("provider", pa.string()), pa.field("options", PV_MAP)]
+            ),
+        ),
+        pa.field("schemaString", pa.string()),
+        pa.field("partitionColumns", pa.list_(pa.string())),
+        pa.field("configuration", PV_MAP),
+        pa.field("createdTime", pa.int64()),
+    ]
+)
+
+TXN_STRUCT = pa.struct(
+    [
+        pa.field("appId", pa.string()),
+        pa.field("version", pa.int64()),
+        pa.field("lastUpdated", pa.int64()),
+    ]
+)
+
+DOMAIN_STRUCT = pa.struct(
+    [
+        pa.field("domain", pa.string()),
+        pa.field("configuration", pa.string()),
+        pa.field("removed", pa.bool_()),
+    ]
+)
+
+
+def _file_struct_from_canonical(tbl: pa.Table, is_add: bool) -> pa.Array:
+    """Canonical columnar rows → add/remove StructArray."""
+    n = tbl.num_rows
+    false_col = pa.array(np.zeros(n, dtype=bool))
+
+    def col(name):
+        return tbl.column(name).combine_chunks()
+
+    if is_add:
+        children = [
+            col("path"),
+            col("partition_values"),
+            col("size"),
+            col("modification_time"),
+            false_col,  # dataChange normalized to false in checkpoints
+            col("stats"),
+            col("deletion_vector"),
+            col("base_row_id"),
+            col("default_row_commit_version"),
+            col("clustering_provider"),
+        ]
+        return pa.StructArray.from_arrays(children, fields=list(ADD_STRUCT))
+    children = [
+        col("path"),
+        col("deletion_timestamp"),
+        false_col,
+        col("extended_file_metadata"),
+        col("partition_values"),
+        col("size"),
+        col("deletion_vector"),
+        col("base_row_id"),
+        col("default_row_commit_version"),
+    ]
+    return pa.StructArray.from_arrays(children, fields=list(REMOVE_STRUCT))
+
+
+def _single_action_table(
+    n: int,
+    protocol_rows: Optional[pa.Array] = None,
+    metadata_rows: Optional[pa.Array] = None,
+    txn_rows: Optional[pa.Array] = None,
+    domain_rows: Optional[pa.Array] = None,
+    add_rows: Optional[pa.Array] = None,
+    remove_rows: Optional[pa.Array] = None,
+) -> pa.Table:
+    """Assemble a SingleAction table: each input occupies its own row
+    range; all other columns null there."""
+    blocks = [
+        ("protocol", PROTOCOL_STRUCT, protocol_rows),
+        ("metaData", METADATA_STRUCT, metadata_rows),
+        ("txn", TXN_STRUCT, txn_rows),
+        ("domainMetadata", DOMAIN_STRUCT, domain_rows),
+        ("add", ADD_STRUCT, add_rows),
+        ("remove", REMOVE_STRUCT, remove_rows),
+    ]
+    sizes = [len(b[2]) if b[2] is not None else 0 for b in blocks]
+    total = sum(sizes)
+    assert total == n, (total, n)
+    cols = {}
+    offset = 0
+    offsets = []
+    for (name, typ, arr), sz in zip(blocks, sizes):
+        offsets.append(offset)
+        offset += sz
+    for i, (name, typ, arr) in enumerate(blocks):
+        sz = sizes[i]
+        before, after = offsets[i], n - offsets[i] - sz
+        parts = []
+        if before:
+            parts.append(pa.nulls(before, typ))
+        if arr is not None and sz:
+            parts.append(arr)
+        if after:
+            parts.append(pa.nulls(after, typ))
+        cols[name] = pa.concat_arrays([p.cast(typ) if p.type != typ else p for p in parts]) if parts else pa.nulls(0, typ)
+    return pa.table(cols)
+
+
+def _small_action_arrays(state) -> tuple:
+    proto = state.protocol
+    protocol_rows = pa.array(
+        [
+            {
+                "minReaderVersion": proto.minReaderVersion,
+                "minWriterVersion": proto.minWriterVersion,
+                "readerFeatures": (
+                    sorted(proto.readerFeatures) if proto.readerFeatures is not None else None
+                ),
+                "writerFeatures": (
+                    sorted(proto.writerFeatures) if proto.writerFeatures is not None else None
+                ),
+            }
+        ],
+        PROTOCOL_STRUCT,
+    )
+    meta = state.metadata
+    metadata_rows = pa.array(
+        [
+            {
+                "id": meta.id,
+                "name": meta.name,
+                "description": meta.description,
+                "format": {"provider": meta.format.provider, "options": list(meta.format.options.items())},
+                "schemaString": meta.schemaString,
+                "partitionColumns": list(meta.partitionColumns),
+                "configuration": list(meta.configuration.items()),
+                "createdTime": meta.createdTime,
+            }
+        ],
+        METADATA_STRUCT,
+    )
+    txn_rows = (
+        pa.array(
+            [
+                {"appId": t.appId, "version": t.version, "lastUpdated": t.lastUpdated}
+                for t in state.set_transactions.values()
+            ],
+            TXN_STRUCT,
+        )
+        if state.set_transactions
+        else None
+    )
+    domain_rows = (
+        pa.array(
+            [
+                {"domain": d.domain, "configuration": d.configuration, "removed": d.removed}
+                for d in state.domain_metadata.values()
+            ],
+            DOMAIN_STRUCT,
+        )
+        if state.domain_metadata
+        else None
+    )
+    return protocol_rows, metadata_rows, txn_rows, domain_rows
+
+
+def _retained_tombstones(state, now_ms: int, retention_ms: int) -> pa.Table:
+    tombs = state.tombstones_table
+    if tombs.num_rows == 0:
+        return tombs
+    min_retain = now_ms - retention_ms
+    del_ts = pc.fill_null(tombs.column("deletion_timestamp"), 0)
+    keep = pc.greater_equal(del_ts, pa.scalar(min_retain, pa.int64()))
+    return tombs.filter(keep)
+
+
+def write_checkpoint(engine, snapshot, policy: Optional[str] = None) -> LastCheckpointInfo:
+    """Write a checkpoint for `snapshot` and update `_last_checkpoint`."""
+    state = snapshot.state
+    meta_conf = state.metadata.configuration
+    if policy is None:
+        policy = get_table_config(meta_conf, CHECKPOINT_POLICY)
+    now_ms = int(time.time() * 1000)
+    retention = get_table_config(meta_conf, TOMBSTONE_RETENTION)
+
+    adds = state.add_files_table
+    tombs = _retained_tombstones(state, now_ms, retention)
+    add_struct = _file_struct_from_canonical(adds, is_add=True)
+    remove_struct = _file_struct_from_canonical(tombs, is_add=False)
+    protocol_rows, metadata_rows, txn_rows, domain_rows = _small_action_arrays(state)
+
+    if settings.verify_checkpoint_row_count and len(add_struct) != state.num_files:
+        raise ChecksumMismatchError(
+            f"checkpoint add rows {len(add_struct)} != snapshot numFiles "
+            f"{state.num_files}"
+        )
+
+    log_path = snapshot._table.log_path
+    version = snapshot.version
+
+    if policy == "v2":
+        info = _write_v2_checkpoint(
+            engine, log_path, version, add_struct, remove_struct,
+            protocol_rows, metadata_rows, txn_rows, domain_rows,
+        )
+    else:
+        part_size = settings.checkpoint_part_size
+        n_files = len(add_struct) + len(remove_struct)
+        if part_size is not None and n_files > part_size:
+            info = _write_multipart_checkpoint(
+                engine, log_path, version, part_size, add_struct, remove_struct,
+                protocol_rows, metadata_rows, txn_rows, domain_rows,
+            )
+        else:
+            n = (
+                len(protocol_rows) + len(metadata_rows)
+                + (len(txn_rows) if txn_rows is not None else 0)
+                + (len(domain_rows) if domain_rows is not None else 0)
+                + len(add_struct) + len(remove_struct)
+            )
+            table = _single_action_table(
+                n, protocol_rows, metadata_rows, txn_rows, domain_rows,
+                add_struct, remove_struct,
+            )
+            path = filenames.checkpoint_file_singular(log_path, version)
+            try:
+                engine.parquet.write_parquet_file_atomically(path, table)
+            except FileExistsError:
+                pass  # another writer already checkpointed this version
+            info = LastCheckpointInfo(
+                version=version,
+                size=n,
+                sizeInBytes=_file_size(engine, path),
+                numOfAddFiles=len(add_struct),
+            )
+    write_last_checkpoint(engine.json, log_path, info)
+    return info
+
+
+def _file_size(engine, path: str) -> Optional[int]:
+    try:
+        return engine.fs.file_status(path).size
+    except Exception:
+        return None
+
+
+def _write_multipart_checkpoint(
+    engine, log_path, version, part_size, add_struct, remove_struct,
+    protocol_rows, metadata_rows, txn_rows, domain_rows,
+):
+    """Legacy multi-part: file actions split across parts; small actions in
+    part 1. Part layout mirrors `Checkpoints.scala:669-699` (hash split by
+    row — here contiguous ranges, equally valid: parts are unordered)."""
+    file_rows: List[tuple] = [(True, add_struct), (False, remove_struct)]
+    total_files = len(add_struct) + len(remove_struct)
+    num_parts = max(1, -(-total_files // part_size))
+    paths = filenames.checkpoint_file_with_parts(log_path, version, num_parts)
+    total_actions = 0
+
+    add_splits = _split_ranges(len(add_struct), num_parts)
+    rem_splits = _split_ranges(len(remove_struct), num_parts)
+    for i, path in enumerate(paths):
+        a0, a1 = add_splits[i]
+        r0, r1 = rem_splits[i]
+        adds_i = add_struct.slice(a0, a1 - a0)
+        rems_i = remove_struct.slice(r0, r1 - r0)
+        p_rows = protocol_rows if i == 0 else None
+        m_rows = metadata_rows if i == 0 else None
+        t_rows = txn_rows if i == 0 else None
+        d_rows = domain_rows if i == 0 else None
+        n = (
+            (len(p_rows) if p_rows is not None else 0)
+            + (len(m_rows) if m_rows is not None else 0)
+            + (len(t_rows) if t_rows is not None else 0)
+            + (len(d_rows) if d_rows is not None else 0)
+            + len(adds_i) + len(rems_i)
+        )
+        total_actions += n
+        table = _single_action_table(n, p_rows, m_rows, t_rows, d_rows, adds_i, rems_i)
+        try:
+            engine.parquet.write_parquet_file_atomically(path, table)
+        except FileExistsError:
+            pass
+    return LastCheckpointInfo(
+        version=version, size=total_actions, parts=num_parts,
+        numOfAddFiles=len(add_struct),
+    )
+
+
+def _split_ranges(n: int, parts: int) -> List[tuple]:
+    bounds = [round(i * n / parts) for i in range(parts + 1)]
+    return [(bounds[i], bounds[i + 1]) for i in range(parts)]
+
+
+def _write_v2_checkpoint(
+    engine, log_path, version, add_struct, remove_struct,
+    protocol_rows, metadata_rows, txn_rows, domain_rows,
+):
+    """V2 (PROTOCOL.md:196-269): file actions go to `_sidecars/<uuid>.parquet`;
+    the top-level UUID checkpoint holds checkpointMetadata + sidecar
+    pointers + the small actions."""
+    sidecar_uuid = str(uuid.uuid4())
+    sidecar_path = filenames.sidecar_file(log_path, sidecar_uuid)
+    n_files = len(add_struct) + len(remove_struct)
+    sidecar_table = _single_action_table(
+        n_files, None, None, None, None, add_struct, remove_struct
+    )
+    status = engine.parquet.write_parquet_file(sidecar_path, sidecar_table)
+
+    cp_meta = CheckpointMetadata(version=version)
+    sidecar = Sidecar(
+        path=f"{sidecar_uuid}.parquet",
+        sizeInBytes=status.size,
+        modificationTime=status.modification_time,
+    )
+    top_schema_cols = {}
+    n_top = (
+        1 + 1  # checkpointMetadata + sidecar
+        + len(protocol_rows) + len(metadata_rows)
+        + (len(txn_rows) if txn_rows is not None else 0)
+        + (len(domain_rows) if domain_rows is not None else 0)
+    )
+    CP_META_STRUCT = pa.struct([pa.field("version", pa.int64())])
+    SIDECAR_STRUCT = pa.struct(
+        [
+            pa.field("path", pa.string()),
+            pa.field("sizeInBytes", pa.int64()),
+            pa.field("modificationTime", pa.int64()),
+        ]
+    )
+
+    def block(arr, typ, start, sz):
+        parts = []
+        if start:
+            parts.append(pa.nulls(start, typ))
+        if arr is not None and sz:
+            parts.append(arr)
+        rest = n_top - start - sz
+        if rest:
+            parts.append(pa.nulls(rest, typ))
+        return pa.concat_arrays(parts)
+
+    offset = 0
+    cp_arr = pa.array([{"version": version}], CP_META_STRUCT)
+    top_schema_cols["checkpointMetadata"] = block(cp_arr, CP_META_STRUCT, offset, 1)
+    offset += 1
+    sc_arr = pa.array(
+        [{
+            "path": sidecar.path,
+            "sizeInBytes": sidecar.sizeInBytes,
+            "modificationTime": sidecar.modificationTime,
+        }],
+        SIDECAR_STRUCT,
+    )
+    top_schema_cols["sidecar"] = block(sc_arr, SIDECAR_STRUCT, offset, 1)
+    offset += 1
+    top_schema_cols["protocol"] = block(protocol_rows, PROTOCOL_STRUCT, offset, len(protocol_rows))
+    offset += len(protocol_rows)
+    top_schema_cols["metaData"] = block(metadata_rows, METADATA_STRUCT, offset, len(metadata_rows))
+    offset += len(metadata_rows)
+    if txn_rows is not None:
+        top_schema_cols["txn"] = block(txn_rows, TXN_STRUCT, offset, len(txn_rows))
+        offset += len(txn_rows)
+    if domain_rows is not None:
+        top_schema_cols["domainMetadata"] = block(domain_rows, DOMAIN_STRUCT, offset, len(domain_rows))
+        offset += len(domain_rows)
+
+    top_table = pa.table(top_schema_cols)
+    top_path = filenames.top_level_v2_checkpoint_file(log_path, version, "parquet")
+    engine.parquet.write_parquet_file_atomically(top_path, top_table)
+    return LastCheckpointInfo(
+        version=version,
+        size=n_top + n_files,
+        sizeInBytes=status.size,
+        numOfAddFiles=len(add_struct),
+        tag=filenames.file_name(top_path),
+    )

@@ -1,0 +1,159 @@
+"""Per-version `.crc` checksum files.
+
+Reference `Checksum.scala`: after each commit, a `%020d.crc` JSON document
+records the post-commit table state summary (tableSizeBytes, numFiles,
+protocol, metadata, ...). Readers use it to (a) get P&M + counts without
+replay, (b) validate a reconstructed snapshot (`ValidateChecksum`).
+
+Derivation here is incremental (`incrementallyDeriveChecksum:155`): new
+checksum = previous checksum + this commit's actions — no replay. When
+the previous `.crc` is missing or the commit lacks the information to
+derive sizes exactly (e.g. removes without size), we fall back to writing
+nothing; the next checkpointed snapshot can seed a fresh chain via
+`write_checksum_from_state`.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from delta_tpu.errors import ChecksumMismatchError
+from delta_tpu.models.actions import Metadata, Protocol
+from delta_tpu.utils import filenames
+
+
+@dataclass
+class VersionChecksum:
+    tableSizeBytes: int
+    numFiles: int
+    numMetadata: int
+    numProtocol: int
+    metadata: Metadata
+    protocol: Protocol
+    txnId: Optional[str] = None
+    inCommitTimestamp: Optional[int] = None
+    numDeletedRecordsOpt: Optional[int] = None
+    numDeletionVectorsOpt: Optional[int] = None
+
+    def to_json(self) -> str:
+        d = {
+            "tableSizeBytes": self.tableSizeBytes,
+            "numFiles": self.numFiles,
+            "numMetadata": self.numMetadata,
+            "numProtocol": self.numProtocol,
+            "metadata": self.metadata.to_dict(),
+            "protocol": self.protocol.to_dict(),
+        }
+        if self.txnId is not None:
+            d["txnId"] = self.txnId
+        if self.inCommitTimestamp is not None:
+            d["inCommitTimestampOpt"] = self.inCommitTimestamp
+        if self.numDeletedRecordsOpt is not None:
+            d["numDeletedRecordsOpt"] = self.numDeletedRecordsOpt
+        if self.numDeletionVectorsOpt is not None:
+            d["numDeletionVectorsOpt"] = self.numDeletionVectorsOpt
+        return json.dumps(d, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(data) -> "VersionChecksum":
+        d = json.loads(data)
+        return VersionChecksum(
+            tableSizeBytes=int(d["tableSizeBytes"]),
+            numFiles=int(d["numFiles"]),
+            numMetadata=int(d.get("numMetadata", 1)),
+            numProtocol=int(d.get("numProtocol", 1)),
+            metadata=Metadata.from_dict(d["metadata"]),
+            protocol=Protocol.from_dict(d["protocol"]),
+            txnId=d.get("txnId"),
+            inCommitTimestamp=d.get("inCommitTimestampOpt"),
+            numDeletedRecordsOpt=d.get("numDeletedRecordsOpt"),
+            numDeletionVectorsOpt=d.get("numDeletionVectorsOpt"),
+        )
+
+
+def read_checksum(fs, log_path: str, version: int) -> Optional[VersionChecksum]:
+    try:
+        return VersionChecksum.from_json(
+            fs.read_file(filenames.checksum_file(log_path, version))
+        )
+    except (FileNotFoundError, ValueError, KeyError):
+        return None
+
+
+def write_checksum_from_state(engine, log_path: str, state) -> None:
+    crc = VersionChecksum(
+        tableSizeBytes=state.size_in_bytes,
+        numFiles=state.num_files,
+        numMetadata=1,
+        numProtocol=1,
+        metadata=state.metadata,
+        protocol=state.protocol,
+    )
+    engine.json.write_json_file_atomically(
+        filenames.checksum_file(log_path, state.version),
+        crc.to_json().encode(),
+        overwrite=True,
+    )
+
+
+def write_checksum_for_commit(table, txn, version: int) -> None:
+    """Incremental derivation from the previous version's checksum and the
+    transaction's staged actions. No-op when the chain is broken."""
+    engine = table.engine
+    log_path = table.log_path
+    if version == 0:
+        prev_size, prev_files = 0, 0
+    else:
+        prev = read_checksum(engine.fs, log_path, version - 1)
+        if prev is None:
+            return
+        prev_size, prev_files = prev.tableSizeBytes, prev.numFiles
+
+    adds = txn._adds
+    removes = txn._removes
+    if any(r.size is None for r in removes):
+        return  # can't derive exactly
+    # NOTE: exact derivation also requires that adds don't replace existing
+    # live files with the same (path, dv) key. DML commands re-add with the
+    # same path only after removing it in the same commit, which cancels
+    # out below; blind double-adds break the chain, which validation will
+    # catch and drop.
+    new_size = prev_size + sum(a.size for a in adds) - sum(r.size for r in removes)
+    new_files = prev_files + len(adds) - len(removes)
+    if new_files < 0 or new_size < 0:
+        return
+
+    meta = txn.metadata()
+    proto = txn.protocol()
+    crc = VersionChecksum(
+        tableSizeBytes=new_size,
+        numFiles=new_files,
+        numMetadata=1,
+        numProtocol=1,
+        metadata=meta,
+        protocol=proto,
+        txnId=txn.txn_id,
+    )
+    engine.json.write_json_file_atomically(
+        filenames.checksum_file(log_path, version), crc.to_json().encode(), overwrite=True
+    )
+
+
+def validate_state_against_checksum(state, crc: VersionChecksum) -> None:
+    """`ValidateChecksum` semantics: replayed state must match the stored
+    summary exactly."""
+    problems = []
+    if state.num_files != crc.numFiles:
+        problems.append(f"numFiles {state.num_files} != crc {crc.numFiles}")
+    if state.size_in_bytes != crc.tableSizeBytes:
+        problems.append(
+            f"tableSizeBytes {state.size_in_bytes} != crc {crc.tableSizeBytes}"
+        )
+    if state.protocol.to_dict() != crc.protocol.to_dict():
+        problems.append("protocol mismatch")
+    if state.metadata.id != crc.metadata.id:
+        problems.append("metadata id mismatch")
+    if problems:
+        raise ChecksumMismatchError("; ".join(problems))

@@ -1,0 +1,67 @@
+"""`_last_checkpoint` pointer file.
+
+A small JSON document naming the most recent checkpoint so readers can
+start their LIST there instead of at version 0 (PROTOCOL.md:318; reference
+`spark/.../delta/Checkpoints.scala:601` LastCheckpointInfo schema, kernel
+`internal/checkpoints/CheckpointMetaData.java`). Always written with
+overwrite=True — it is a hint, and a stale or corrupt pointer must degrade
+to a full listing, never to an error.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from delta_tpu.utils import filenames
+
+
+@dataclass
+class LastCheckpointInfo:
+    version: int
+    size: int                       # number of actions in the checkpoint
+    parts: Optional[int] = None     # multi-part only
+    sizeInBytes: Optional[int] = None
+    numOfAddFiles: Optional[int] = None
+    checkpointSchema: Optional[Dict[str, Any]] = None
+    checksum: Optional[str] = None
+    tag: Optional[str] = None       # V2: the UUID-named top-level file name
+
+    def to_json(self) -> str:
+        d = {"version": self.version, "size": self.size}
+        for k in ("parts", "sizeInBytes", "numOfAddFiles", "checkpointSchema", "checksum", "tag"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return json.dumps(d, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(data: bytes | str) -> "LastCheckpointInfo":
+        d = json.loads(data)
+        return LastCheckpointInfo(
+            version=int(d["version"]),
+            size=int(d.get("size", -1)),
+            parts=(int(d["parts"]) if d.get("parts") is not None else None),
+            sizeInBytes=d.get("sizeInBytes"),
+            numOfAddFiles=d.get("numOfAddFiles"),
+            checkpointSchema=d.get("checkpointSchema"),
+            checksum=d.get("checksum"),
+            tag=d.get("tag"),
+        )
+
+
+def read_last_checkpoint(fs, log_path: str) -> Optional[LastCheckpointInfo]:
+    """Best-effort read; any failure returns None (degrade to listing)."""
+    path = filenames.last_checkpoint_file(log_path)
+    try:
+        return LastCheckpointInfo.from_json(fs.read_file(path))
+    except (FileNotFoundError, ValueError, KeyError):
+        return None
+
+
+def write_last_checkpoint(json_handler, log_path: str, info: LastCheckpointInfo) -> None:
+    path = filenames.last_checkpoint_file(log_path)
+    json_handler.write_json_file_atomically(
+        path, info.to_json().encode("utf-8"), overwrite=True
+    )

@@ -1,0 +1,208 @@
+"""LogSegment: the minimal set of log files that reproduces a version.
+
+Construction semantics follow the reference (spark
+`SnapshotManagement.scala:329,461`; kernel
+`internal/snapshot/SnapshotManager.java:311`):
+
+1. LIST `_delta_log` from the last-known checkpoint version (hint) —
+   lexicographic listing == version order thanks to zero padding.
+2. Partition the listing into commit files, checkpoint files, compacted
+   deltas; drop everything after the target version.
+3. Pick the newest *complete* checkpoint at or below the target version.
+4. Keep commit files with `checkpoint_version < v <= target`; verify they
+   are contiguous and reach the target (a gap means a corrupt/raced
+   listing).
+5. Prefer compacted delta files covering whole sub-ranges when allowed
+   (fewer files to parse; PROTOCOL.md:270).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from delta_tpu.errors import DeltaError, TableNotFoundError, VersionNotFoundError
+from delta_tpu.storage.logstore import FileStatus
+from delta_tpu.utils import filenames
+from delta_tpu.utils.filenames import CheckpointInstance, group_complete_checkpoints
+
+
+@dataclass
+class LogSegment:
+    log_path: str
+    version: int
+    deltas: List[FileStatus] = field(default_factory=list)       # ascending version
+    checkpoints: List[FileStatus] = field(default_factory=list)  # parts of ONE checkpoint
+    compacted_deltas: List[FileStatus] = field(default_factory=list)  # chosen replacements
+    checkpoint_version: Optional[int] = None
+    last_commit_timestamp: int = 0
+
+    @property
+    def delta_versions(self) -> List[int]:
+        return [filenames.delta_version(f.path) for f in self.deltas]
+
+    def commit_files_descending(self) -> List[FileStatus]:
+        return list(reversed(self.deltas))
+
+
+class CorruptLogError(DeltaError):
+    error_class = "DELTA_CORRUPT_LOG"
+
+
+def _verify_deltas_contiguous(versions: List[int], expected_start: int, target: int) -> None:
+    if versions != list(range(expected_start, target + 1)):
+        raise CorruptLogError(
+            f"Log is missing commit files: have versions {versions[:5]}..., "
+            f"expected contiguous [{expected_start}, {target}]"
+        )
+
+
+def _apply_compaction(
+    deltas: List[FileStatus], compacted: List[FileStatus], start: int, target: int
+) -> tuple[List[FileStatus], List[FileStatus]]:
+    """Greedily substitute compacted-delta files for runs of single-commit
+    files inside [start, target]. Returns (kept singles, chosen compacted).
+    Mirrors the listing-time substitution in `SnapshotManagement.scala:329`.
+    """
+    if not compacted:
+        return deltas, []
+    by_version = {filenames.delta_version(f.path): f for f in deltas}
+    chosen: List[FileStatus] = []
+    covered: set[int] = set()
+    # Prefer widest ranges first.
+    ranges = sorted(
+        ((filenames.compacted_delta_versions(f.path), f) for f in compacted),
+        key=lambda t: (t[0][0], -(t[0][1] - t[0][0])),
+    )
+    for (lo, hi), f in ranges:
+        if lo < start or hi > target:
+            continue
+        rng = set(range(lo, hi + 1))
+        if rng & covered:
+            continue
+        if not rng <= set(by_version):
+            # compaction may cover commits we no longer list; only usable
+            # when every covered single exists in-window or is irrelevant
+            if not rng <= (set(by_version) | covered):
+                continue
+        chosen.append(f)
+        covered |= rng
+    singles = [f for v, f in sorted(by_version.items()) if v not in covered]
+    return singles, chosen
+
+
+def build_log_segment(
+    fs,
+    log_path: str,
+    target_version: Optional[int] = None,
+    checkpoint_hint: Optional[int] = None,
+    use_compacted_deltas: bool = True,
+) -> LogSegment:
+    """LIST the log and assemble the segment for `target_version` (or the
+    latest version when None)."""
+    start = checkpoint_hint if checkpoint_hint is not None else 0
+    prefix = filenames.listing_prefix(log_path, start)
+    try:
+        listing = list(fs.list_from(prefix))
+    except FileNotFoundError:
+        raise TableNotFoundError(f"no _delta_log at {log_path}")
+
+    deltas: List[FileStatus] = []
+    checkpoint_files: List[CheckpointInstance] = []
+    compacted: List[FileStatus] = []
+    for fstat in listing:
+        name = filenames.file_name(fstat.path)
+        if filenames.DELTA_FILE_RE.match(name):
+            v = filenames.delta_version(fstat.path)
+            if target_version is None or v <= target_version:
+                deltas.append(fstat)
+        elif filenames.CHECKPOINT_FILE_RE.match(name) and fstat.size > 0:
+            ci = CheckpointInstance.parse(fstat.path)
+            if ci is not None and (target_version is None or ci.version <= target_version):
+                checkpoint_files.append(ci)
+        elif filenames.COMPACTED_DELTA_FILE_RE.match(name):
+            lo, hi = filenames.compacted_delta_versions(fstat.path)
+            if target_version is None or hi <= target_version:
+                compacted.append(fstat)
+
+    if not deltas and not checkpoint_files:
+        if checkpoint_hint is not None and checkpoint_hint > 0:
+            # stale hint (log may have been cleaned differently) — retry full
+            return build_log_segment(
+                fs, log_path, target_version, checkpoint_hint=None,
+                use_compacted_deltas=use_compacted_deltas,
+            )
+        raise TableNotFoundError(f"no commits found in {log_path}")
+
+    complete = group_complete_checkpoints(checkpoint_files)
+    chosen_checkpoint: List[CheckpointInstance] = complete[-1] if complete else []
+    cp_version = chosen_checkpoint[0].version if chosen_checkpoint else None
+
+    window_start = (cp_version + 1) if cp_version is not None else 0
+    deltas_in_window = [
+        f for f in deltas if filenames.delta_version(f.path) >= window_start
+    ]
+    versions = [filenames.delta_version(f.path) for f in deltas_in_window]
+
+    if target_version is None:
+        if versions:
+            version = versions[-1]
+        elif cp_version is not None:
+            version = cp_version
+        else:
+            raise TableNotFoundError(f"no commits found in {log_path}")
+    else:
+        version = target_version
+        have_max = versions[-1] if versions else cp_version
+        if have_max is None or have_max < target_version:
+            raise VersionNotFoundError(
+                version=target_version,
+                earliest=versions[0] if versions else cp_version,
+                latest=have_max,
+            )
+
+    deltas_needed = [
+        f for f in deltas_in_window if filenames.delta_version(f.path) <= version
+    ]
+    needed_versions = [filenames.delta_version(f.path) for f in deltas_needed]
+    if needed_versions:
+        _verify_deltas_contiguous(needed_versions, window_start, version)
+    elif cp_version is None:
+        raise VersionNotFoundError(version=version, earliest=None, latest=None)
+    elif cp_version != version:
+        raise CorruptLogError(
+            f"checkpoint at {cp_version} but no commits up to requested {version}"
+        )
+
+    chosen_compacted: List[FileStatus] = []
+    if use_compacted_deltas and compacted:
+        deltas_needed, chosen_compacted = _apply_compaction(
+            deltas_needed, compacted, window_start, version
+        )
+
+    checkpoint_statuses = []
+    for ci in chosen_checkpoint:
+        try:
+            checkpoint_statuses.append(
+                next(
+                    fstat
+                    for fstat in listing
+                    if fstat.path == ci.path
+                )
+            )
+        except StopIteration:  # pragma: no cover - listing produced it
+            pass
+
+    last_ts = 0
+    for f in deltas_needed or checkpoint_statuses:
+        last_ts = max(last_ts, f.modification_time)
+
+    return LogSegment(
+        log_path=log_path,
+        version=version,
+        deltas=deltas_needed,
+        checkpoints=checkpoint_statuses,
+        compacted_deltas=chosen_compacted,
+        checkpoint_version=cp_version,
+        last_commit_timestamp=last_ts,
+    )

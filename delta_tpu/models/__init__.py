@@ -1,0 +1,61 @@
+"""Data model: the typed action schemas of the Delta transaction log.
+
+(The reference calls this the "actions" model — spark
+`actions/actions.scala`, kernel `internal/actions/`.)
+"""
+
+from delta_tpu.models.actions import (
+    Action,
+    AddFile,
+    RemoveFile,
+    AddCDCFile,
+    Metadata,
+    Protocol,
+    SetTransaction,
+    DomainMetadata,
+    CommitInfo,
+    CheckpointMetadata,
+    Sidecar,
+    DeletionVectorDescriptor,
+    Format,
+    action_from_json_dict,
+    actions_from_commit_bytes,
+    actions_to_commit_bytes,
+)
+from delta_tpu.models.schema import (
+    DataType,
+    PrimitiveType,
+    ArrayType,
+    MapType,
+    StructField,
+    StructType,
+    schema_from_json,
+    schema_to_json,
+)
+
+__all__ = [
+    "Action",
+    "AddFile",
+    "RemoveFile",
+    "AddCDCFile",
+    "Metadata",
+    "Protocol",
+    "SetTransaction",
+    "DomainMetadata",
+    "CommitInfo",
+    "CheckpointMetadata",
+    "Sidecar",
+    "DeletionVectorDescriptor",
+    "Format",
+    "action_from_json_dict",
+    "actions_from_commit_bytes",
+    "actions_to_commit_bytes",
+    "DataType",
+    "PrimitiveType",
+    "ArrayType",
+    "MapType",
+    "StructField",
+    "StructType",
+    "schema_from_json",
+    "schema_to_json",
+]

@@ -1,0 +1,331 @@
+"""Table schema model.
+
+Delta serializes table schemas as Spark-SQL-style JSON in
+`metaData.schemaString` (PROTOCOL.md Schema Serialization Format): a
+`struct` of fields, each `{name, type, nullable, metadata}`, where type is a
+primitive name string, or a nested `struct` / `array` / `map` object, or a
+`decimal(p,s)` string. This module models that format and converts to/from
+pyarrow schemas for the host Parquet/Arrow I/O layer.
+
+Column-mapping metadata keys (`delta.columnMapping.id` / `.physicalName`)
+live in field metadata; the columnmapping module consumes them.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+_DECIMAL_RE = re.compile(r"^decimal\(\s*(\d+)\s*,\s*(-?\d+)\s*\)$")
+
+PRIMITIVES = {
+    "string",
+    "long",
+    "integer",
+    "short",
+    "byte",
+    "float",
+    "double",
+    "boolean",
+    "binary",
+    "date",
+    "timestamp",
+    "timestamp_ntz",
+    "variant",
+}
+
+COLUMN_MAPPING_ID_KEY = "delta.columnMapping.id"
+COLUMN_MAPPING_PHYSICAL_NAME_KEY = "delta.columnMapping.physicalName"
+
+
+class DataType:
+    def to_json_value(self) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_json_value() == other.to_json_value()
+
+    def __hash__(self):
+        return hash(json.dumps(self.to_json_value(), sort_keys=True))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.to_json_value()!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class PrimitiveType(DataType):
+    name: str  # one of PRIMITIVES or "decimal(p,s)"
+
+    def __post_init__(self):
+        if self.name not in PRIMITIVES and not _DECIMAL_RE.match(self.name):
+            raise ValueError(f"unknown primitive type: {self.name}")
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.name.startswith("decimal")
+
+    def decimal_precision_scale(self) -> tuple[int, int]:
+        m = _DECIMAL_RE.match(self.name)
+        assert m, self.name
+        return int(m.group(1)), int(m.group(2))
+
+    def to_json_value(self) -> Any:
+        return self.name
+
+
+STRING = PrimitiveType("string")
+LONG = PrimitiveType("long")
+INTEGER = PrimitiveType("integer")
+SHORT = PrimitiveType("short")
+BYTE = PrimitiveType("byte")
+FLOAT = PrimitiveType("float")
+DOUBLE = PrimitiveType("double")
+BOOLEAN = PrimitiveType("boolean")
+BINARY = PrimitiveType("binary")
+DATE = PrimitiveType("date")
+TIMESTAMP = PrimitiveType("timestamp")
+TIMESTAMP_NTZ = PrimitiveType("timestamp_ntz")
+
+
+def decimal(precision: int, scale: int) -> PrimitiveType:
+    return PrimitiveType(f"decimal({precision},{scale})")
+
+
+@dataclass(eq=False)
+class ArrayType(DataType):
+    elementType: DataType
+    containsNull: bool = True
+
+    def to_json_value(self) -> Any:
+        return {
+            "type": "array",
+            "elementType": self.elementType.to_json_value(),
+            "containsNull": self.containsNull,
+        }
+
+
+@dataclass(eq=False)
+class MapType(DataType):
+    keyType: DataType
+    valueType: DataType
+    valueContainsNull: bool = True
+
+    def to_json_value(self) -> Any:
+        return {
+            "type": "map",
+            "keyType": self.keyType.to_json_value(),
+            "valueType": self.valueType.to_json_value(),
+            "valueContainsNull": self.valueContainsNull,
+        }
+
+
+@dataclass(eq=False)
+class StructField:
+    name: str
+    dataType: DataType = STRING
+    nullable: bool = True
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json_value(self) -> Any:
+        return {
+            "name": self.name,
+            "type": self.dataType.to_json_value(),
+            "nullable": self.nullable,
+            "metadata": self.metadata,
+        }
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, StructField)
+            and self.to_json_value() == other.to_json_value()
+        )
+
+    @property
+    def column_mapping_id(self) -> Optional[int]:
+        v = self.metadata.get(COLUMN_MAPPING_ID_KEY)
+        return int(v) if v is not None else None
+
+    @property
+    def physical_name(self) -> str:
+        return self.metadata.get(COLUMN_MAPPING_PHYSICAL_NAME_KEY, self.name)
+
+
+@dataclass(eq=False)
+class StructType(DataType):
+    fields: List[StructField] = field(default_factory=list)
+
+    def to_json_value(self) -> Any:
+        return {"type": "struct", "fields": [f.to_json_value() for f in self.fields]}
+
+    def field_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def __getitem__(self, name: str) -> StructField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def add(self, name: str, dt: DataType, nullable: bool = True, metadata=None) -> "StructType":
+        return StructType(
+            self.fields + [StructField(name, dt, nullable, dict(metadata or {}))]
+        )
+
+    def leaves(self, prefix: tuple = ()) -> List[tuple[tuple, StructField]]:
+        """Depth-first leaf columns as (name-path, field) pairs — the unit
+        for stats collection / data skipping (first 32 leaves by default)."""
+        out = []
+        for f in self.fields:
+            if isinstance(f.dataType, StructType):
+                out.extend(f.dataType.leaves(prefix + (f.name,)))
+            else:
+                out.append((prefix + (f.name,), f))
+        return out
+
+
+def _type_from_json_value(v: Any) -> DataType:
+    if isinstance(v, str):
+        return PrimitiveType(v)
+    if isinstance(v, dict):
+        t = v.get("type")
+        if t == "struct":
+            return StructType(
+                [
+                    StructField(
+                        name=f["name"],
+                        dataType=_type_from_json_value(f["type"]),
+                        nullable=bool(f.get("nullable", True)),
+                        metadata=dict(f.get("metadata") or {}),
+                    )
+                    for f in v.get("fields", [])
+                ]
+            )
+        if t == "array":
+            return ArrayType(
+                elementType=_type_from_json_value(v["elementType"]),
+                containsNull=bool(v.get("containsNull", True)),
+            )
+        if t == "map":
+            return MapType(
+                keyType=_type_from_json_value(v["keyType"]),
+                valueType=_type_from_json_value(v["valueType"]),
+                valueContainsNull=bool(v.get("valueContainsNull", True)),
+            )
+    raise ValueError(f"cannot parse schema type: {v!r}")
+
+
+def schema_from_json(s: str) -> StructType:
+    dt = _type_from_json_value(json.loads(s))
+    if not isinstance(dt, StructType):
+        raise ValueError("top-level schema must be a struct")
+    return dt
+
+
+def schema_to_json(st: StructType) -> str:
+    return json.dumps(st.to_json_value(), separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# pyarrow conversion (host I/O layer)
+# ---------------------------------------------------------------------------
+
+_PRIM_TO_ARROW = {
+    "string": pa.string(),
+    "long": pa.int64(),
+    "integer": pa.int32(),
+    "short": pa.int16(),
+    "byte": pa.int8(),
+    "float": pa.float32(),
+    "double": pa.float64(),
+    "boolean": pa.bool_(),
+    "binary": pa.binary(),
+    "date": pa.date32(),
+    "timestamp": pa.timestamp("us", tz="UTC"),
+    "timestamp_ntz": pa.timestamp("us"),
+}
+
+
+def to_arrow_type(dt: DataType) -> pa.DataType:
+    if isinstance(dt, PrimitiveType):
+        if dt.is_decimal:
+            p, s = dt.decimal_precision_scale()
+            return pa.decimal128(p, s)
+        try:
+            return _PRIM_TO_ARROW[dt.name]
+        except KeyError:
+            raise ValueError(f"no arrow mapping for {dt.name}")
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow_type(dt.elementType))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow_type(dt.keyType), to_arrow_type(dt.valueType))
+    if isinstance(dt, StructType):
+        return pa.struct(
+            [pa.field(f.name, to_arrow_type(f.dataType), f.nullable) for f in dt.fields]
+        )
+    raise ValueError(f"cannot convert {dt!r}")
+
+
+def to_arrow_schema(st: StructType, use_physical_names: bool = False) -> pa.Schema:
+    return pa.schema(
+        [
+            pa.field(
+                f.physical_name if use_physical_names else f.name,
+                to_arrow_type(f.dataType),
+                f.nullable,
+            )
+            for f in st.fields
+        ]
+    )
+
+
+_ARROW_TO_PRIM = {
+    pa.string(): "string",
+    pa.large_string(): "string",
+    pa.int64(): "long",
+    pa.int32(): "integer",
+    pa.int16(): "short",
+    pa.int8(): "byte",
+    pa.float32(): "float",
+    pa.float64(): "double",
+    pa.bool_(): "boolean",
+    pa.binary(): "binary",
+    pa.large_binary(): "binary",
+    pa.date32(): "date",
+}
+
+
+def from_arrow_type(t: pa.DataType) -> DataType:
+    if t in _ARROW_TO_PRIM:
+        return PrimitiveType(_ARROW_TO_PRIM[t])
+    if pa.types.is_timestamp(t):
+        return TIMESTAMP if t.tz is not None else TIMESTAMP_NTZ
+    if pa.types.is_decimal(t):
+        return decimal(t.precision, t.scale)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return ArrayType(from_arrow_type(t.value_type))
+    if pa.types.is_map(t):
+        return MapType(from_arrow_type(t.key_type), from_arrow_type(t.item_type))
+    if pa.types.is_struct(t):
+        return StructType(
+            [
+                StructField(t.field(i).name, from_arrow_type(t.field(i).type), t.field(i).nullable)
+                for i in range(t.num_fields)
+            ]
+        )
+    raise ValueError(f"cannot convert arrow type {t}")
+
+
+def from_arrow_schema(schema: pa.Schema) -> StructType:
+    return StructType(
+        [StructField(f.name, from_arrow_type(f.type), f.nullable) for f in schema]
+    )

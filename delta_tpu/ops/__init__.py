@@ -1,0 +1,13 @@
+"""Device (JAX/XLA/Pallas) columnar kernels.
+
+- `replay`: snapshot state reconstruction as sort + segmented last-wins
+  reduce — the TPU-native formulation of the reference's per-row hash-map
+  replay (spark `InMemoryLogReplay.scala:52-100`, kernel
+  `ActiveAddFilesIterator.java:146-219`).
+- `hashing`: vectorized multi-lane 32-bit polynomial string hashing over
+  padded byte matrices (key derivation that needs no host dictionary —
+  the shard-routable path for multi-host replay).
+- `zorder`: bit-interleave / Hilbert curve keys for OPTIMIZE clustering.
+- `stats`: masked min/max/nullCount segment reductions for stats
+  collection and checkpoint summaries.
+"""

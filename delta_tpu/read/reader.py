@@ -1,0 +1,103 @@
+"""Scan execution: materialize the scanned rows as one Arrow table.
+
+The `DeltaParquetFileFormat` role (`DeltaParquetFileFormat.scala:189`):
+per surviving file — read the Parquet data, drop rows deleted by the
+file's deletion vector, splice in partition-column values from
+`partitionValues`, apply residual filters, project requested columns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.models.schema import PrimitiveType, to_arrow_type
+from delta_tpu.stats.partition import deserialize_partition_value
+
+
+def _absolute_path(table_path: str, file_path: str) -> str:
+    if "://" in file_path or file_path.startswith("/"):
+        return file_path
+    return f"{table_path}/{file_path}"
+
+
+def _dv_row_mask(engine, table_path: str, dv_row: dict, num_rows: int) -> Optional[np.ndarray]:
+    """Boolean keep-mask from a deletion vector descriptor row (None = keep
+    all)."""
+    if dv_row is None or dv_row.get("storageType") is None:
+        return None
+    from delta_tpu.dv.descriptor import load_deletion_vector
+
+    deleted = load_deletion_vector(engine, table_path, dv_row)
+    mask = np.ones(num_rows, dtype=bool)
+    idx = deleted[deleted < num_rows]
+    mask[idx] = False
+    return mask
+
+
+def read_scan(scan) -> pa.Table:
+    snapshot = scan.snapshot
+    engine = snapshot._engine
+    table_path = snapshot.table_path
+    schema = snapshot.schema
+    partition_columns = snapshot.partition_columns
+    files = scan.add_files_table()
+
+    requested = scan.columns
+    data_columns = None
+    if requested is not None:
+        data_columns = [c for c in requested if c not in partition_columns]
+
+    ptypes = {}
+    for c in partition_columns:
+        dtype = PrimitiveType("string")
+        if schema is not None and c in schema:
+            f = schema[c]
+            if isinstance(f.dataType, PrimitiveType):
+                dtype = f.dataType
+        ptypes[c] = dtype
+
+    batches: List[pa.Table] = []
+    paths = files.column("path").to_pylist()
+    pvs = files.column("partition_values").to_pylist()
+    dvs = files.column("deletion_vector").to_pylist()
+    for path, pv, dv in zip(paths, pvs, dvs):
+        abs_path = _absolute_path(table_path, path)
+        tbl = next(iter(engine.parquet.read_parquet_files([abs_path], columns=data_columns)))
+        mask = _dv_row_mask(engine, table_path, dv, tbl.num_rows)
+        if mask is not None:
+            tbl = tbl.filter(pa.array(mask))
+        pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
+        for c in partition_columns:
+            if requested is not None and c not in requested:
+                continue
+            value = deserialize_partition_value(pv_dict.get(c), ptypes[c])
+            arr = pa.array([value] * tbl.num_rows, to_arrow_type(ptypes[c]))
+            tbl = tbl.append_column(c, arr)
+        batches.append(tbl)
+
+    if not batches:
+        cols = requested or (
+            [f.name for f in schema.fields] if schema is not None else []
+        )
+        empty = {}
+        for c in cols:
+            t = to_arrow_type(schema[c].dataType) if schema and c in schema else pa.string()
+            empty[c] = pa.array([], t)
+        return pa.table(empty)
+
+    result = pa.concat_tables(batches, promote_options="permissive")
+    if scan.filter is not None:
+        from delta_tpu.expressions.eval import evaluate_predicate_host
+
+        try:
+            keep = evaluate_predicate_host(scan.filter, result)
+            result = result.filter(pa.array(keep))
+        except KeyError:
+            pass  # filter references columns not projected
+    if requested is not None:
+        result = result.select([c for c in requested if c in result.column_names])
+    return result

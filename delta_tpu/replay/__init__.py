@@ -1,0 +1,14 @@
+from delta_tpu.replay.columnar import (
+    CANONICAL_FILE_ACTION_SCHEMA,
+    ColumnarActions,
+    columnarize_log_segment,
+)
+from delta_tpu.replay.state import SnapshotState, reconstruct_state
+
+__all__ = [
+    "CANONICAL_FILE_ACTION_SCHEMA",
+    "ColumnarActions",
+    "columnarize_log_segment",
+    "SnapshotState",
+    "reconstruct_state",
+]

@@ -1,0 +1,289 @@
+"""State reconstruction driver: columnar actions → SnapshotState.
+
+Pipeline (TPU path):
+1. Columnarize the log segment (columnar.py) → canonical Arrow table.
+2. Dictionary-encode the replay key `(path, dv_id)` into int32 codes
+   (exact, vectorized factorization — the host-side equivalent of the
+   reference's path canonicalization + hashing at `Snapshot.scala:477-483`).
+3. Run the device sort + segmented last-wins reduce (ops.replay) to get
+   the live/tombstone masks.
+4. Filter the Arrow table by the masks; aggregate numFiles/sizeInBytes.
+
+HostEngine path replaces step 3 with the sequential dict replay — the
+faithful re-implementation of `InMemoryLogReplay` used as parity oracle
+and baseline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from delta_tpu.errors import UnsupportedTableFeatureError
+from delta_tpu.models.actions import (
+    AddFile,
+    CommitInfo,
+    DeletionVectorDescriptor,
+    DomainMetadata,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+)
+from delta_tpu.replay.columnar import ColumnarActions, columnarize_log_segment
+
+
+@dataclass
+class SnapshotState:
+    version: int
+    protocol: Protocol
+    metadata: Metadata
+    set_transactions: Dict[str, SetTransaction]
+    domain_metadata: Dict[str, DomainMetadata]
+    file_actions: pa.Table            # canonical schema, all actions
+    live_mask: np.ndarray             # bool over file_actions rows
+    tombstone_mask: np.ndarray
+    latest_commit_info: Optional[CommitInfo] = None
+    commit_infos: Dict[int, CommitInfo] = field(default_factory=dict)
+    timestamp_ms: int = 0
+
+    _add_table_cache: Optional[pa.Table] = None
+    _tombstone_table_cache: Optional[pa.Table] = None
+
+    @property
+    def add_files_table(self) -> pa.Table:
+        """Live files as an Arrow table (canonical schema)."""
+        if self._add_table_cache is None:
+            self._add_table_cache = self.file_actions.filter(
+                pa.array(self.live_mask)
+            )
+        return self._add_table_cache
+
+    @property
+    def tombstones_table(self) -> pa.Table:
+        if self._tombstone_table_cache is None:
+            self._tombstone_table_cache = self.file_actions.filter(
+                pa.array(self.tombstone_mask)
+            )
+        return self._tombstone_table_cache
+
+    @property
+    def num_files(self) -> int:
+        return int(self.live_mask.sum())
+
+    @property
+    def size_in_bytes(self) -> int:
+        sizes = np.asarray(
+            self.file_actions.column("size").fill_null(0), dtype=np.int64
+        )
+        return int(sizes[self.live_mask].sum())
+
+    def visible_domain_metadata(self) -> Dict[str, DomainMetadata]:
+        return {k: v for k, v in self.domain_metadata.items() if not v.removed}
+
+    def add_files(self) -> list[AddFile]:
+        """Materialize live files as AddFile objects (small results only —
+        columnar consumers should use add_files_table)."""
+        return [_row_to_add(r) for r in self.add_files_table.to_pylist()]
+
+    def tombstones(self) -> list[RemoveFile]:
+        return [_row_to_remove(r) for r in self.tombstones_table.to_pylist()]
+
+
+def _row_dv(r) -> Optional[DeletionVectorDescriptor]:
+    dv = r.get("deletion_vector")
+    if dv is None or dv.get("storageType") is None:
+        return None
+    return DeletionVectorDescriptor(
+        storageType=dv["storageType"],
+        pathOrInlineDv=dv["pathOrInlineDv"],
+        sizeInBytes=dv.get("sizeInBytes") or 0,
+        cardinality=dv.get("cardinality") or 0,
+        offset=dv.get("offset"),
+        maxRowIndex=dv.get("maxRowIndex"),
+    )
+
+
+def _pv_dict(r) -> dict:
+    pv = r.get("partition_values")
+    if pv is None:
+        return {}
+    if isinstance(pv, list):  # arrow map -> list of (k, v)
+        return {k: v for k, v in pv}
+    return dict(pv)
+
+
+def _row_to_add(r: dict) -> AddFile:
+    import json as _json
+
+    return AddFile(
+        path=r["path"],
+        partitionValues=_pv_dict(r),
+        size=r.get("size") or 0,
+        modificationTime=r.get("modification_time") or 0,
+        dataChange=bool(r.get("data_change", True)),
+        stats=r.get("stats"),
+        tags=_json.loads(r["tags"]) if r.get("tags") else None,
+        deletionVector=_row_dv(r),
+        baseRowId=r.get("base_row_id"),
+        defaultRowCommitVersion=r.get("default_row_commit_version"),
+        clusteringProvider=r.get("clustering_provider"),
+    )
+
+
+def _row_to_remove(r: dict) -> RemoveFile:
+    import json as _json
+
+    return RemoveFile(
+        path=r["path"],
+        deletionTimestamp=r.get("deletion_timestamp"),
+        dataChange=bool(r.get("data_change", True)),
+        extendedFileMetadata=r.get("extended_file_metadata"),
+        partitionValues=_pv_dict(r) or None,
+        size=r.get("size"),
+        stats=r.get("stats"),
+        tags=_json.loads(r["tags"]) if r.get("tags") else None,
+        deletionVector=_row_dv(r),
+        baseRowId=r.get("base_row_id"),
+        defaultRowCommitVersion=r.get("default_row_commit_version"),
+    )
+
+
+def build_replay_keys(file_actions: pa.Table) -> tuple[np.ndarray, np.ndarray]:
+    """Dictionary-encode (path, dv_id) into two int32 code arrays.
+
+    pd.factorize is exact (no collisions) and C-vectorized; null dv_id
+    maps to code 0, real ids to 1+code."""
+    paths = file_actions.column("path").combine_chunks()
+    path_codes, _ = pd.factorize(paths.to_pandas(), sort=False)
+    dv = file_actions.column("dv_id").combine_chunks()
+    if dv.null_count == len(dv):
+        dv_codes = np.zeros(len(dv), dtype=np.int64)
+    else:
+        codes, _ = pd.factorize(dv.to_pandas(), sort=False, use_na_sentinel=True)
+        dv_codes = codes + 1  # NaN sentinel -1 -> 0
+    return path_codes.astype(np.uint32), dv_codes.astype(np.uint32)
+
+
+def compute_masks_device(columnar: ColumnarActions) -> tuple[np.ndarray, np.ndarray]:
+    from delta_tpu.ops.replay import replay_select
+
+    fa = columnar.file_actions
+    n = fa.num_rows
+    if n == 0:
+        z = np.zeros(0, bool)
+        return z, z
+    path_codes, dv_codes = build_replay_keys(fa)
+    version = np.asarray(fa.column("version"), dtype=np.int64)
+    # versions fit int32 in practice (2^31 commits); assert to be safe
+    assert version.max(initial=0) < 2**31, "version overflow"
+    order = np.asarray(fa.column("order"), dtype=np.int32)
+    is_add = np.asarray(fa.column("is_add"), dtype=bool)
+    return replay_select(
+        [path_codes, dv_codes], version.astype(np.int32), order, is_add
+    )
+
+
+def compute_masks_host(columnar: ColumnarActions) -> tuple[np.ndarray, np.ndarray]:
+    """Sequential reference replay (`InMemoryLogReplay` semantics)."""
+    fa = columnar.file_actions
+    n = fa.num_rows
+    live = np.zeros(n, dtype=bool)
+    tomb = np.zeros(n, dtype=bool)
+    if n == 0:
+        return live, tomb
+    paths = fa.column("path").to_pylist()
+    dvs = fa.column("dv_id").to_pylist()
+    version = np.asarray(fa.column("version"), dtype=np.int64)
+    order = np.asarray(fa.column("order"), dtype=np.int32)
+    is_add = np.asarray(fa.column("is_add"), dtype=bool)
+    rows = sorted(range(n), key=lambda i: (version[i], order[i]))
+    winner: dict = {}
+    for i in rows:
+        winner[(paths[i], dvs[i])] = i
+    for i in winner.values():
+        if is_add[i]:
+            live[i] = True
+        else:
+            tomb[i] = True
+    return live, tomb
+
+
+SUPPORTED_READER_FEATURES = frozenset(
+    {
+        "deletionVectors",
+        "columnMapping",
+        "timestampNtz",
+        "typeWidening",
+        "typeWidening-preview",
+        "v2Checkpoint",
+        "vacuumProtocolCheck",
+        "variantType",
+        "variantType-preview",
+        "inCommitTimestamp",
+        "domainMetadata",
+        "rowTracking",
+        "clustering",
+        "appendOnly",
+        "invariants",
+        "checkConstraints",
+        "changeDataFeed",
+        "generatedColumns",
+        "identityColumns",
+        "allowColumnDefaults",
+        "icebergCompatV1",
+        "icebergCompatV2",
+        "liquid",
+    }
+)
+MAX_READER_VERSION = 3
+
+
+def check_read_supported(protocol: Protocol) -> None:
+    """Protocol gate (PROTOCOL.md:844-876): reader version <= 3 and, at
+    (3,7), every readerFeature must be implemented here."""
+    if protocol.minReaderVersion > MAX_READER_VERSION:
+        raise UnsupportedTableFeatureError(
+            {f"readerVersion={protocol.minReaderVersion}"}, read=True
+        )
+    unsupported = protocol.reader_feature_set() - SUPPORTED_READER_FEATURES
+    if unsupported:
+        raise UnsupportedTableFeatureError(unsupported, read=True)
+
+
+def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotState:
+    """Full state reconstruction for a log segment."""
+    columnar = columnarize_log_segment(engine, segment)
+    if columnar.protocol is None or columnar.metadata is None:
+        from delta_tpu.errors import DeltaError
+
+        raise DeltaError(
+            f"log segment for version {segment.version} has no "
+            f"{'protocol' if columnar.protocol is None else 'metadata'} action"
+        )
+    if check_protocol:
+        check_read_supported(columnar.protocol)
+
+    use_device = getattr(engine, "use_device_replay", False)
+    if use_device:
+        live, tomb = compute_masks_device(columnar)
+    else:
+        live, tomb = compute_masks_host(columnar)
+
+    return SnapshotState(
+        version=segment.version,
+        protocol=columnar.protocol,
+        metadata=columnar.metadata,
+        set_transactions=columnar.set_transactions,
+        domain_metadata=columnar.domain_metadata,
+        file_actions=columnar.file_actions,
+        live_mask=live,
+        tombstone_mask=tomb,
+        latest_commit_info=columnar.latest_commit_info,
+        commit_infos=columnar.commit_infos,
+        timestamp_ms=segment.last_commit_timestamp,
+    )

@@ -1,0 +1,141 @@
+"""Scan: pruned file listing (and data read) over a snapshot.
+
+Mirrors kernel `ScanBuilder`/`Scan`/`ScanImpl.java:438`: a scan applies,
+in order,
+1. partition pruning — the filter conjuncts that touch only partition
+   columns, evaluated against each file's `partitionValues`;
+2. data skipping — remaining conjuncts translated into min/max-stats
+   predicates over the stats index (delta_tpu.stats.skipping), evaluated
+   on device for the TpuEngine;
+3. (on read) deletion-vector row filtering and column mapping.
+
+`add_files_table()` returns the surviving files columnar; `to_arrow()`
+reads the actual data rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.expressions.tree import Expression, split_conjuncts
+from delta_tpu.models.actions import AddFile
+
+
+class ScanBuilder:
+    def __init__(self, snapshot):
+        self._snapshot = snapshot
+        self._filter: Optional[Expression] = None
+        self._columns: Optional[List[str]] = None
+
+    def with_filter(self, expr: Expression) -> "ScanBuilder":
+        self._filter = expr if self._filter is None else (self._filter & expr)
+        return self
+
+    def with_columns(self, columns: Sequence[str]) -> "ScanBuilder":
+        self._columns = list(columns)
+        return self
+
+    def build(self) -> "Scan":
+        return Scan(self._snapshot, self._filter, self._columns)
+
+
+class Scan:
+    def __init__(self, snapshot, filter: Optional[Expression], columns: Optional[List[str]]):
+        self._snapshot = snapshot
+        self.filter = filter
+        self.columns = columns
+        self._result_cache: Optional[pa.Table] = None
+        self.partition_pruned = 0
+        self.skipped_by_stats = 0
+
+    @property
+    def snapshot(self):
+        return self._snapshot
+
+    def _partition_batch(self, files: pa.Table) -> pa.Table:
+        """Reconstruct typed partition-column values from the
+        partitionValues string map (protocol Partition Value Serialization)."""
+        from delta_tpu.stats.partition import partition_values_to_columns
+
+        return partition_values_to_columns(
+            files.column("partition_values"),
+            self._snapshot.metadata,
+        )
+
+    def add_files_table(self) -> pa.Table:
+        """Surviving AddFiles (canonical columnar schema) after pruning."""
+        if self._result_cache is not None:
+            return self._result_cache
+        files = self._snapshot.state.add_files_table
+        if self.filter is None or files.num_rows == 0:
+            self._result_cache = files
+            return files
+
+        partition_cols = set(self._snapshot.partition_columns)
+        conjuncts = split_conjuncts(self.filter)
+        part_conjuncts = [
+            c for c in conjuncts
+            if c.references() and all(r[0] in partition_cols for r in c.references())
+        ]
+        data_conjuncts = [c for c in conjuncts if c not in part_conjuncts]
+
+        keep = np.ones(files.num_rows, dtype=bool)
+        if part_conjuncts:
+            batch = self._partition_batch(files)
+            from delta_tpu.expressions.eval import evaluate_predicate_host
+
+            for c in part_conjuncts:
+                keep &= evaluate_predicate_host(c, batch)
+            self.partition_pruned = int((~keep).sum())
+
+        if data_conjuncts:
+            from delta_tpu.stats.skipping import skipping_mask
+
+            stats_keep = skipping_mask(
+                files,
+                data_conjuncts,
+                self._snapshot.metadata,
+                engine=self._snapshot._engine,
+            )
+            self.skipped_by_stats = int((keep & ~stats_keep).sum())
+            keep &= stats_keep
+
+        result = files.filter(pa.array(keep))
+        self._result_cache = result
+        self._report_metrics(files.num_rows, result.num_rows)
+        return result
+
+    def _report_metrics(self, total: int, surviving: int) -> None:
+        eng = self._snapshot._engine
+        if getattr(eng, "metrics_reporters", None):
+            eng.report_metrics(
+                {
+                    "type": "ScanReport",
+                    "tablePath": self._snapshot.table_path,
+                    "tableVersion": self._snapshot.version,
+                    "totalFiles": total,
+                    "survivingFiles": surviving,
+                    "partitionPruned": self.partition_pruned,
+                    "skippedByStats": self.skipped_by_stats,
+                    "filter": repr(self.filter) if self.filter else None,
+                }
+            )
+
+    def files(self) -> List[AddFile]:
+        from delta_tpu.replay.state import _row_to_add
+
+        return [_row_to_add(r) for r in self.add_files_table().to_pylist()]
+
+    def file_paths(self) -> List[str]:
+        return self.add_files_table().column("path").to_pylist()
+
+    def to_arrow(self) -> pa.Table:
+        """Read the scanned data into one Arrow table (applies DV row
+        filtering, partition-column injection, and residual filters)."""
+        from delta_tpu.read.reader import read_scan
+
+        return read_scan(self)

@@ -1,0 +1,117 @@
+"""Snapshot: an immutable view of the table at one version.
+
+Counterpart of kernel `SnapshotImpl.java` / spark `Snapshot.scala:81`.
+State is reconstructed lazily on first access and cached on the object;
+`Table` caches the newest snapshot and reuses it across `update()` calls
+when the version is unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from delta_tpu.log.segment import LogSegment
+from delta_tpu.models.actions import DomainMetadata, Metadata, Protocol, SetTransaction
+from delta_tpu.replay.state import SnapshotState, reconstruct_state
+
+
+class Snapshot:
+    def __init__(self, table, segment: LogSegment, engine=None):
+        self._table = table
+        self._segment = segment
+        self._engine = engine if engine is not None else table.engine
+        self._state: Optional[SnapshotState] = None
+
+    @property
+    def version(self) -> int:
+        return self._segment.version
+
+    @property
+    def log_segment(self) -> LogSegment:
+        return self._segment
+
+    @property
+    def table_path(self) -> str:
+        return self._table.path
+
+    @property
+    def state(self) -> SnapshotState:
+        if self._state is None:
+            self._state = reconstruct_state(self._engine, self._segment)
+        return self._state
+
+    @property
+    def protocol(self) -> Protocol:
+        return self.state.protocol
+
+    @property
+    def metadata(self) -> Metadata:
+        return self.state.metadata
+
+    @property
+    def schema(self):
+        return self.state.metadata.schema
+
+    @property
+    def partition_columns(self) -> list:
+        return list(self.state.metadata.partitionColumns)
+
+    @property
+    def timestamp_ms(self) -> int:
+        """Commit timestamp of this version: in-commit timestamp when the
+        feature is enabled, else file modification time."""
+        ci = self.state.commit_infos.get(self.version)
+        if ci is not None and ci.inCommitTimestamp is not None:
+            return ci.inCommitTimestamp
+        return self.state.timestamp_ms
+
+    @property
+    def num_files(self) -> int:
+        return self.state.num_files
+
+    @property
+    def size_in_bytes(self) -> int:
+        return self.state.size_in_bytes
+
+    def set_transaction_version(self, app_id: str) -> Optional[int]:
+        txn = self.state.set_transactions.get(app_id)
+        return txn.version if txn else None
+
+    def set_transactions(self) -> Dict[str, SetTransaction]:
+        return dict(self.state.set_transactions)
+
+    def domain_metadata(self, domain: str) -> Optional[DomainMetadata]:
+        dm = self.state.domain_metadata.get(domain)
+        if dm is None or dm.removed:
+            return None
+        return dm
+
+    def scan_builder(self):
+        from delta_tpu.scan import ScanBuilder
+
+        return ScanBuilder(self)
+
+    def scan(self, filter=None, columns=None):
+        b = self.scan_builder()
+        if filter is not None:
+            b = b.with_filter(filter)
+        if columns is not None:
+            b = b.with_columns(columns)
+        return b.build()
+
+    def table_configuration(self) -> Dict[str, str]:
+        return dict(self.state.metadata.configuration)
+
+    def get_config(self, key: str, default=None):
+        from delta_tpu.config import TABLE_CONFIGS
+
+        cfg = TABLE_CONFIGS.get(key)
+        raw = self.state.metadata.configuration.get(key)
+        if cfg is not None:
+            return cfg.parse(raw) if raw is not None else (
+                cfg.default if default is None else default
+            )
+        return raw if raw is not None else default
+
+    def __repr__(self):
+        return f"Snapshot(path={self._table.path!r}, version={self.version})"

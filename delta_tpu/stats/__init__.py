@@ -1,0 +1,1 @@
+"""Stats: collection on write, columnar stats index, data skipping."""

@@ -1,0 +1,226 @@
+"""Data skipping: prune files whose min/max/nullCount stats prove a
+predicate can't match (reference `stats/DataSkippingReader.scala:287`
+constructDataFilters).
+
+The stats index is columnar: the `stats` JSON strings of all surviving
+AddFiles are parsed in ONE `pyarrow.json.read_json` call into struct
+columns (`numRecords`, `minValues.*`, `maxValues.*`, `nullCount.*`), then
+per-conjunct keep-masks are evaluated vectorized — numpy on the host
+engine, jit'd on device for the TpuEngine (delta_tpu.ops.stats).
+
+Semantics: a file is SKIPPED only when stats *prove* no row can match.
+Missing stats (null stats string, missing column, or unparseable value)
+always keep the file. NULL handling: `col op lit` can only match non-null
+rows, so files where nullCount == numRecords are skippable for such
+conjuncts — but only when both counts are present.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.json as pa_json
+
+from delta_tpu.expressions.tree import (
+    Column,
+    Comparison,
+    Expression,
+    In,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Not,
+    Or,
+)
+
+
+class StatsIndex:
+    """Parsed stats for a batch of files."""
+
+    def __init__(self, table: Optional[pa.Table], n: int):
+        self._table = table
+        self.n = n
+
+    @staticmethod
+    def from_stats_column(stats_col: pa.ChunkedArray) -> "StatsIndex":
+        n = len(stats_col)
+        arr = stats_col.combine_chunks() if isinstance(stats_col, pa.ChunkedArray) else stats_col
+        if n == 0 or arr.null_count == n:
+            return StatsIndex(None, n)
+        # one-shot parse: substitute "{}" for null rows to keep row alignment
+        filled = pc.fill_null(arr, "{}")
+        joined = ("\n".join(filled.to_pylist()) + "\n").encode()
+        try:
+            parsed = pa_json.read_json(pa.BufferReader(joined))
+        except pa.ArrowInvalid:
+            return StatsIndex(None, n)
+        if parsed.num_rows != n:
+            return StatsIndex(None, n)
+        return StatsIndex(parsed, n)
+
+    def _leaf(self, group: str, name_path: tuple) -> Optional[np.ndarray]:
+        """Return (values, valid) for e.g. group='minValues', col path.
+        None when the column isn't in the index."""
+        if self._table is None or group not in self._table.column_names:
+            return None
+        arr = self._table.column(group).combine_chunks()
+        if not pa.types.is_struct(arr.type):
+            return None
+        for part in name_path:
+            if not pa.types.is_struct(arr.type) or arr.type.get_field_index(part) < 0:
+                return None
+            arr = pc.struct_field(arr, part)
+        return arr
+
+    def num_records(self):
+        if self._table is None or "numRecords" not in self._table.column_names:
+            return None
+        return self._table.column("numRecords").combine_chunks()
+
+    def min_values(self, name_path):
+        return self._leaf("minValues", name_path)
+
+    def max_values(self, name_path):
+        return self._leaf("maxValues", name_path)
+
+    def null_count(self, name_path):
+        return self._leaf("nullCount", name_path)
+
+
+def _cmp_keep(op: str, minv, maxv, lit_arr) -> Optional[pa.Array]:
+    """Keep-condition (nullable bool Arrow array) for `col op lit` given
+    min/max arrays; None = cannot decide (keep)."""
+    try:
+        if op == "=":
+            if minv is None or maxv is None:
+                return None
+            return pc.and_kleene(pc.less_equal(minv, lit_arr), pc.greater_equal(maxv, lit_arr))
+        if op == "<":
+            return None if minv is None else pc.less(minv, lit_arr)
+        if op == "<=":
+            return None if minv is None else pc.less_equal(minv, lit_arr)
+        if op == ">":
+            return None if maxv is None else pc.greater(maxv, lit_arr)
+        if op == ">=":
+            return None if maxv is None else pc.greater_equal(maxv, lit_arr)
+        if op == "!=":
+            if minv is None or maxv is None:
+                return None
+            # skip only when min == max == lit (every row equals lit)
+            return pc.invert(
+                pc.and_kleene(pc.equal(minv, lit_arr), pc.equal(maxv, lit_arr))
+            )
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
+        return None
+    return None
+
+
+def _conjunct_keep(conj: Expression, index: StatsIndex) -> Optional[pa.Array]:
+    """Nullable keep-mask for one conjunct; None/null = keep."""
+    if isinstance(conj, Or):
+        left = _conjunct_keep(conj.left, index)
+        right = _conjunct_keep(conj.right, index)
+        if left is None or right is None:
+            return None
+        return pc.or_kleene(left, right)
+    if isinstance(conj, Comparison):
+        sides = (conj.left, conj.right)
+        if isinstance(sides[0], Column) and isinstance(sides[1], Literal):
+            colref, lit, op = sides[0], sides[1], conj.op
+        elif isinstance(sides[1], Column) and isinstance(sides[0], Literal):
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+            colref, lit, op = sides[1], sides[0], flip[conj.op]
+        else:
+            return None
+        if lit.value is None:
+            return None
+        minv = index.min_values(colref.name_path)
+        maxv = index.max_values(colref.name_path)
+        try:
+            lit_arr = pa.scalar(lit.value)
+        except pa.ArrowInvalid:
+            return None
+        keep = _cmp_keep(op, minv, maxv, lit_arr)
+        # additionally: an all-null column can't match col op lit
+        nc = index.null_count(colref.name_path)
+        nr = index.num_records()
+        if nc is not None and nr is not None:
+            try:
+                not_all_null = pc.less(nc, nr)
+                keep = not_all_null if keep is None else pc.and_kleene(keep, not_all_null)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
+                pass
+        return keep
+    if isinstance(conj, IsNull):
+        child = conj.child
+        if isinstance(child, Column):
+            nc = index.null_count(child.name_path)
+            if nc is None:
+                return None
+            try:
+                return pc.greater(nc, pa.scalar(0))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                return None
+        return None
+    if isinstance(conj, IsNotNull):
+        child = conj.child
+        if isinstance(child, Column):
+            nc = index.null_count(child.name_path)
+            nr = index.num_records()
+            if nc is None or nr is None:
+                return None
+            try:
+                return pc.less(nc, nr)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                return None
+        return None
+    if isinstance(conj, In):
+        if isinstance(conj.child, Column) and conj.values:
+            keeps = None
+            for v in conj.values:
+                k = _conjunct_keep(Comparison("=", conj.child, Literal(v)), index)
+                if k is None:
+                    return None
+                keeps = k if keeps is None else pc.or_kleene(keeps, k)
+            return keeps
+        return None
+    if isinstance(conj, Not):
+        inner = conj.child
+        if isinstance(inner, Comparison):
+            neg = {"=": "!=", "!=": "=", "<": ">=", "<=": ">", ">": "<=", ">=": "<"}
+            return _conjunct_keep(
+                Comparison(neg[inner.op], inner.left, inner.right), index
+            )
+        if isinstance(inner, IsNull):
+            return _conjunct_keep(IsNotNull(inner.child), index)
+        if isinstance(inner, IsNotNull):
+            return _conjunct_keep(IsNull(inner.child), index)
+        return None
+    return None
+
+
+def skipping_mask(
+    files: pa.Table,
+    conjuncts: List[Expression],
+    metadata,
+    engine=None,
+) -> np.ndarray:
+    """Boolean keep-mask over `files` rows. True = must read the file."""
+    n = files.num_rows
+    keep = np.ones(n, dtype=bool)
+    if n == 0 or not conjuncts:
+        return keep
+    index = StatsIndex.from_stats_column(files.column("stats"))
+    if index._table is None:
+        return keep
+    for conj in conjuncts:
+        mask = _conjunct_keep(conj, index)
+        if mask is None:
+            continue
+        # null (missing stats for that file) -> keep
+        filled = pc.fill_null(mask, True)
+        keep &= np.asarray(filled, dtype=bool)
+    return keep

@@ -1,0 +1,19 @@
+from delta_tpu.storage.logstore import (
+    FileStatus,
+    LogStore,
+    LocalLogStore,
+    InMemoryLogStore,
+    FaultInjectingLogStore,
+    logstore_for_path,
+    register_logstore_scheme,
+)
+
+__all__ = [
+    "FileStatus",
+    "LogStore",
+    "LocalLogStore",
+    "InMemoryLogStore",
+    "FaultInjectingLogStore",
+    "logstore_for_path",
+    "register_logstore_scheme",
+]

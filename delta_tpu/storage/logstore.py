@@ -1,0 +1,291 @@
+"""Log-storage abstraction: the atomic put-if-absent commit primitive.
+
+The entire ACID story of the log protocol reduces to two storage
+guarantees (reference `storage/.../LogStore.java:57-140`):
+
+1. `write(path, data, overwrite=False)` must fail with
+   `FileAlreadyExistsError` if the path exists — mutual exclusion for
+   commit files.
+2. `list_from(path)` must return files in lexicographic order and reflect
+   all completed writes (listing consistency).
+
+Implementations here:
+- `LocalLogStore` — POSIX: `O_CREAT|O_EXCL` open gives atomic
+  put-if-absent; write-to-temp + `os.rename` gives atomic overwrite. On a
+  GCS/S3 deployment the equivalent is `x-goog-if-generation-match: 0`
+  preconditions / DynamoDB conditional put; the scheme registry below is
+  the plug-in point (reference `DelegatingLogStore.scala:37`).
+- `InMemoryLogStore` — lock-protected dict; used by tests and by the
+  in-memory commit coordinator to simulate multi-writer races
+  deterministically.
+- `FaultInjectingLogStore` — wrapper that fails/blocks according to a
+  schedule; the rebuild's analogue of `BlockWritesLocalFileSystem.scala`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional
+
+
+@dataclass(frozen=True)
+class FileStatus:
+    """A listed file: path + size + modification time (ms since epoch)."""
+
+    path: str
+    size: int
+    modification_time: int
+
+
+class LogStore:
+    """SPI. Paths are plain strings; `/`-separated. All methods raise
+    FileNotFoundError / FileAlreadyExistsError with standard semantics."""
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        """Atomically create `path` with `data`. Without `overwrite`, raise
+        FileAlreadyExistsError if it exists; the failure must be atomic
+        (no partial file visible)."""
+        raise NotImplementedError
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        """List files in the parent of `path` whose name is
+        lexicographically >= `path`'s name, in sorted order."""
+        raise NotImplementedError
+
+    def list_dir(self, path: str) -> List[FileStatus]:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def file_status(self, path: str) -> FileStatus:
+        raise NotImplementedError
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        """Whether a reader may observe a half-written file (true for
+        rename-less stores). Drives whether commit files must be written
+        via temp+rename."""
+        return False
+
+
+class LocalLogStore(LogStore):
+    """POSIX-filesystem store with O_EXCL atomicity."""
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        if overwrite:
+            tmp = os.path.join(parent, f".{os.path.basename(path)}.{uuid.uuid4().hex}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return
+        # Atomic put-if-absent. Write to a temp file first so a crash
+        # mid-write never leaves a partial commit visible under the final
+        # name; link() is atomic and fails if the target exists.
+        tmp = os.path.join(parent, f".{os.path.basename(path)}.{uuid.uuid4().hex}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            raise FileAlreadyExistsError(path)
+        finally:
+            os.unlink(tmp)
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        parent = os.path.dirname(path)
+        name = os.path.basename(path)
+        if not os.path.isdir(parent):
+            raise FileNotFoundError(parent)
+        entries = sorted(e for e in os.listdir(parent) if e >= name)
+        for e in entries:
+            full = os.path.join(parent, e)
+            try:
+                st = os.stat(full)
+            except FileNotFoundError:
+                continue
+            yield FileStatus(full, st.st_size, int(st.st_mtime * 1000))
+
+    def list_dir(self, path: str) -> List[FileStatus]:
+        out = []
+        for e in sorted(os.listdir(path)):
+            full = os.path.join(path, e)
+            st = os.stat(full)
+            out.append(FileStatus(full, st.st_size, int(st.st_mtime * 1000)))
+        return out
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete(self, path: str) -> None:
+        os.unlink(path)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def file_status(self, path: str) -> FileStatus:
+        st = os.stat(path)
+        return FileStatus(path, st.st_size, int(st.st_mtime * 1000))
+
+
+class FileAlreadyExistsError(FileExistsError):
+    pass
+
+
+class InMemoryLogStore(LogStore):
+    """Deterministic in-memory store for unit tests and race simulation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._files: Dict[str, tuple[bytes, int]] = {}
+        self._clock = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def read(self, path: str) -> bytes:
+        with self._lock:
+            if path not in self._files:
+                raise FileNotFoundError(path)
+            return self._files[path][0]
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        with self._lock:
+            if not overwrite and path in self._files:
+                raise FileAlreadyExistsError(path)
+            self._files[path] = (data, self._tick())
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        parent, _, name = path.rpartition("/")
+        with self._lock:
+            found_parent = False
+            matches = []
+            for p, (data, mtime) in self._files.items():
+                pp, _, pn = p.rpartition("/")
+                if pp == parent:
+                    found_parent = True
+                    if pn >= name:
+                        matches.append(FileStatus(p, len(data), mtime))
+            if not found_parent:
+                raise FileNotFoundError(parent)
+        return iter(sorted(matches, key=lambda fs: fs.path))
+
+    def list_dir(self, path: str) -> List[FileStatus]:
+        path = path.rstrip("/")
+        with self._lock:
+            out = [
+                FileStatus(p, len(d), m)
+                for p, (d, m) in self._files.items()
+                if p.rpartition("/")[0] == path
+            ]
+        return sorted(out, key=lambda fs: fs.path)
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._files
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            if path not in self._files:
+                raise FileNotFoundError(path)
+            del self._files[path]
+
+    def mkdirs(self, path: str) -> None:
+        pass
+
+    def file_status(self, path: str) -> FileStatus:
+        with self._lock:
+            if path not in self._files:
+                raise FileNotFoundError(path)
+            data, mtime = self._files[path]
+            return FileStatus(path, len(data), mtime)
+
+
+class FaultInjectingLogStore(LogStore):
+    """Wraps a store; `fail_on(path_predicate)` arms one-shot or persistent
+    failures, `block_on` installs a barrier the test releases. Used by
+    concurrency tests to force specific interleavings."""
+
+    def __init__(self, inner: LogStore):
+        self.inner = inner
+        self._write_faults: List[tuple[Callable[[str], bool], Exception, bool]] = []
+        self._write_barriers: List[tuple[Callable[[str], bool], threading.Event]] = []
+        self.write_log: List[str] = []
+
+    def fail_writes(self, pred: Callable[[str], bool], exc: Optional[Exception] = None,
+                    once: bool = True) -> None:
+        self._write_faults.append((pred, exc or IOError("injected fault"), once))
+
+    def block_writes(self, pred: Callable[[str], bool]) -> threading.Event:
+        ev = threading.Event()
+        self._write_barriers.append((pred, ev))
+        return ev
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self.write_log.append(path)
+        for pred, ev in list(self._write_barriers):
+            if pred(path):
+                ev.wait()
+        for i, (pred, exc, once) in enumerate(list(self._write_faults)):
+            if pred(path):
+                if once:
+                    self._write_faults.pop(i)
+                raise exc
+        self.inner.write(path, data, overwrite)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+_SCHEME_REGISTRY: Dict[str, Callable[[], LogStore]] = {}
+
+
+def register_logstore_scheme(scheme: str, factory: Callable[[], LogStore]) -> None:
+    """Register a LogStore factory for a URI scheme (e.g. 'gs', 's3a') —
+    the rebuild's `DelegatingLogStore` extension point."""
+    _SCHEME_REGISTRY[scheme] = factory
+
+
+_local = LocalLogStore()
+_memory_stores: Dict[str, InMemoryLogStore] = {}
+
+
+def logstore_for_path(path: str) -> LogStore:
+    """Resolve the store owning `path` by scheme; plain paths and file://
+    map to the local POSIX store, memory:// to a process-wide namespace."""
+    if "://" not in path:
+        return _local
+    scheme = path.split("://", 1)[0]
+    if scheme == "file":
+        return _local
+    if scheme == "memory":
+        ns = path.split("://", 1)[1].split("/", 1)[0]
+        if ns not in _memory_stores:
+            _memory_stores[ns] = InMemoryLogStore()
+        return _memory_stores[ns]
+    if scheme in _SCHEME_REGISTRY:
+        return _SCHEME_REGISTRY[scheme]()
+    raise ValueError(f"no LogStore registered for scheme {scheme!r}")

@@ -1,0 +1,125 @@
+"""Table: entry point handle for a Delta table at a path.
+
+Combines the roles of kernel `Table.java:32` (forPath / getLatestSnapshot
+/ getSnapshotAsOfVersion / getSnapshotAsOfTimestamp / checkpoint /
+createTransactionBuilder) and the spark `DeltaLog` singleton (snapshot
+caching + update()).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from delta_tpu.engine.tpu import default_engine
+from delta_tpu.log.last_checkpoint import read_last_checkpoint
+from delta_tpu.log.segment import build_log_segment
+from delta_tpu.snapshot import Snapshot
+from delta_tpu.utils import filenames
+
+
+class Table:
+    def __init__(self, path: str, engine=None):
+        self.path = path.rstrip("/")
+        self.engine = engine if engine is not None else default_engine()
+        self.log_path = f"{self.path}/{filenames.LOG_DIR_NAME}"
+        self._lock = threading.Lock()
+        self._cached_snapshot: Optional[Snapshot] = None
+
+    @staticmethod
+    def for_path(path: str, engine=None) -> "Table":
+        return Table(path, engine)
+
+    def exists(self) -> bool:
+        try:
+            build_log_segment(self.engine.fs, self.log_path)
+            return True
+        except Exception:
+            return False
+
+    # -- snapshots ----------------------------------------------------------
+
+    def latest_snapshot(self) -> Snapshot:
+        """LIST the log (from the `_last_checkpoint` hint) and return the
+        newest snapshot; reuses the cached state when the version is
+        unchanged."""
+        hint = read_last_checkpoint(self.engine.fs, self.log_path)
+        segment = build_log_segment(
+            self.engine.fs,
+            self.log_path,
+            target_version=None,
+            checkpoint_hint=hint.version if hint else None,
+        )
+        with self._lock:
+            cached = self._cached_snapshot
+            if cached is not None and cached.version == segment.version:
+                return cached
+            snap = Snapshot(self, segment)
+            self._cached_snapshot = snap
+            return snap
+
+    update = latest_snapshot
+
+    def snapshot_at(self, version: int) -> Snapshot:
+        hint = read_last_checkpoint(self.engine.fs, self.log_path)
+        cp_hint = hint.version if hint and hint.version <= version else None
+        try:
+            segment = build_log_segment(
+                self.engine.fs,
+                self.log_path,
+                target_version=version,
+                checkpoint_hint=cp_hint,
+            )
+        except Exception:
+            # hint past target or cleaned log — retry with full listing
+            segment = build_log_segment(
+                self.engine.fs, self.log_path, target_version=version, checkpoint_hint=None
+            )
+        return Snapshot(self, segment)
+
+    snapshot_as_of_version = snapshot_at
+
+    def snapshot_as_of_timestamp(self, timestamp_ms: int) -> Snapshot:
+        """Latest version committed at or before `timestamp_ms`
+        (`DeltaHistoryManager.getActiveCommitAtTime` semantics)."""
+        from delta_tpu.history import version_at_timestamp
+
+        version = version_at_timestamp(self, timestamp_ms)
+        return self.snapshot_at(version)
+
+    # -- transactions -------------------------------------------------------
+
+    def create_transaction_builder(self, operation: str = "WRITE", engine_info: str = None):
+        from delta_tpu.txn.transaction import TransactionBuilder
+
+        return TransactionBuilder(self, operation=operation, engine_info=engine_info)
+
+    def start_transaction(self, operation: str = "WRITE"):
+        return self.create_transaction_builder(operation).build()
+
+    # -- maintenance --------------------------------------------------------
+
+    def checkpoint(self, version: Optional[int] = None) -> None:
+        """Write a checkpoint for `version` (default: latest)."""
+        from delta_tpu.log.checkpointer import write_checkpoint
+
+        snap = self.latest_snapshot() if version is None else self.snapshot_at(version)
+        write_checkpoint(self.engine, snap)
+
+    def history(self, limit: Optional[int] = None):
+        from delta_tpu.history import get_history
+
+        return get_history(self, limit)
+
+    def vacuum(self, retention_hours: Optional[float] = None, dry_run: bool = False):
+        from delta_tpu.commands.vacuum import vacuum
+
+        return vacuum(self, retention_hours=retention_hours, dry_run=dry_run)
+
+    def optimize(self):
+        from delta_tpu.commands.optimize import OptimizeBuilder
+
+        return OptimizeBuilder(self)
+
+    def __repr__(self):
+        return f"Table({self.path!r})"

@@ -1,0 +1,4 @@
+from delta_tpu.txn.transaction import Transaction, TransactionBuilder, Operation
+from delta_tpu.txn.isolation import IsolationLevel
+
+__all__ = ["Transaction", "TransactionBuilder", "Operation", "IsolationLevel"]

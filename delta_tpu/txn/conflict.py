@@ -1,0 +1,180 @@
+"""Conflict detection against winning commits (optimistic-concurrency
+rebase).
+
+Semantics follow `ConflictChecker.scala:175` / kernel
+`internal/replay/ConflictChecker.java:98`: after losing the put-if-absent
+race at version v, read the winning commit files [v, latest] and check, in
+order:
+
+1. protocol change by winner        → ProtocolChangedError
+2. metadata change by winner        → MetadataChangedError
+3. winner's added files visible to our read predicates
+   (per isolation level)            → ConcurrentAppendError
+4. winner removed a file we read    → ConcurrentDeleteReadError
+5. winner removed a file we remove  → ConcurrentDeleteDeleteError
+6. winner advanced an idempotent-txn appId we read
+                                    → ConcurrentTransactionError
+7. winner touched a metadata domain we also write
+                                    → ConcurrentWriteError (domain)
+
+If nothing conflicts, the transaction is *rebased*: it may retry at
+latest+1 (and must fold the winners' SetTransactions into its own
+read-state for the next round).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Set
+
+from delta_tpu.errors import (
+    ConcurrentAppendError,
+    ConcurrentDeleteDeleteError,
+    ConcurrentDeleteReadError,
+    ConcurrentTransactionError,
+    ConcurrentWriteError,
+    MetadataChangedError,
+    ProtocolChangedError,
+)
+from delta_tpu.expressions.tree import Expression, split_conjuncts
+from delta_tpu.models.actions import (
+    Action,
+    AddFile,
+    DomainMetadata,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    actions_from_commit_bytes,
+)
+from delta_tpu.txn.isolation import IsolationLevel
+from delta_tpu.utils import filenames
+
+
+@dataclass
+class WinningCommit:
+    version: int
+    actions: List[Action]
+
+    @property
+    def is_blind_append(self) -> bool:
+        from delta_tpu.models.actions import CommitInfo
+
+        for a in self.actions:
+            if isinstance(a, CommitInfo) and a.isBlindAppend is not None:
+                return bool(a.isBlindAppend)
+        # conservatively not blind if it contains removes or reads
+        return not any(isinstance(a, RemoveFile) for a in self.actions)
+
+
+@dataclass
+class TransactionReadState:
+    """What the losing transaction read + intends to write."""
+
+    read_predicates: List[Expression] = field(default_factory=list)
+    read_whole_table: bool = False
+    read_files: Set[tuple] = field(default_factory=set)       # (path, dv_id)
+    read_app_ids: Set[str] = field(default_factory=set)
+    removed_keys: Set[tuple] = field(default_factory=set)     # (path, dv_id)
+    written_domains: Set[str] = field(default_factory=set)
+    metadata_changed: bool = False
+    protocol_changed: bool = False
+    partition_columns: List[str] = field(default_factory=list)
+    isolation: IsolationLevel = IsolationLevel.WRITE_SERIALIZABLE
+
+
+def read_winning_commits(fs, log_path: str, from_version: int, to_version: int) -> List[WinningCommit]:
+    out = []
+    for v in range(from_version, to_version + 1):
+        data = fs.read_file(filenames.delta_file(log_path, v))
+        out.append(WinningCommit(v, actions_from_commit_bytes(data)))
+    return out
+
+
+def _add_matches_predicates(add: AddFile, state: TransactionReadState) -> bool:
+    """Could this added file have matched any of our read predicates?
+    Partition-only conjuncts are evaluated exactly against the file's
+    partitionValues; anything else conservatively matches (the reference
+    evaluates against stats when available, else conservatively)."""
+    if state.read_whole_table:
+        return True
+    if not state.read_predicates:
+        return False
+    import pyarrow as pa
+
+    from delta_tpu.expressions.eval import evaluate_predicate_host
+    from delta_tpu.stats.partition import partition_values_to_batch
+
+    pcols = set(state.partition_columns)
+    for pred in state.read_predicates:
+        for conj in split_conjuncts(pred):
+            refs = conj.references()
+            if refs and all(r[0] in pcols for r in refs):
+                batch = partition_values_to_batch(
+                    [add.partitionValues], state.partition_columns
+                )
+                try:
+                    if bool(evaluate_predicate_host(conj, batch)[0]):
+                        return True
+                except Exception:
+                    return True  # can't evaluate exactly -> conservative
+            else:
+                return True  # non-partition predicate: can't disprove overlap
+    return False
+
+
+def check_conflicts(
+    state: TransactionReadState,
+    winners: Sequence[WinningCommit],
+) -> dict:
+    """Raises a ConcurrentModificationError subclass on logical conflict;
+    otherwise returns the rebase info {'txn_versions': {appId: version}}.
+    """
+    rebase_txns = {}
+    for w in winners:
+        blind = w.is_blind_append
+        for a in w.actions:
+            if isinstance(a, Protocol):
+                raise ProtocolChangedError(
+                    f"protocol changed by concurrent commit {w.version}"
+                )
+            if isinstance(a, Metadata):
+                raise MetadataChangedError(
+                    f"metadata changed by concurrent commit {w.version}"
+                )
+            if isinstance(a, AddFile):
+                check_appends = (
+                    state.isolation == IsolationLevel.SERIALIZABLE
+                    or (state.isolation == IsolationLevel.WRITE_SERIALIZABLE and not blind)
+                )
+                if check_appends and _add_matches_predicates(a, state):
+                    raise ConcurrentAppendError(
+                        f"files added by concurrent commit {w.version} may "
+                        f"match this transaction's read predicate: {a.path}"
+                    )
+            if isinstance(a, RemoveFile):
+                key = (a.path, a.dv_unique_id)
+                if key in state.read_files:
+                    raise ConcurrentDeleteReadError(
+                        f"file read by this transaction was removed by "
+                        f"concurrent commit {w.version}: {a.path}"
+                    )
+                if key in state.removed_keys:
+                    raise ConcurrentDeleteDeleteError(
+                        f"file removed by both this transaction and "
+                        f"concurrent commit {w.version}: {a.path}"
+                    )
+            if isinstance(a, SetTransaction):
+                if a.appId in state.read_app_ids:
+                    raise ConcurrentTransactionError(
+                        f"idempotent-transaction appId {a.appId} advanced by "
+                        f"concurrent commit {w.version}"
+                    )
+                rebase_txns[a.appId] = a.version
+            if isinstance(a, DomainMetadata):
+                if a.domain in state.written_domains:
+                    raise ConcurrentWriteError(
+                        f"metadata domain {a.domain!r} modified by concurrent "
+                        f"commit {w.version}"
+                    )
+    return {"txn_versions": rebase_txns}

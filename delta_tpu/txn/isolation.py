@@ -1,0 +1,31 @@
+"""Isolation levels (reference `isolationLevels.scala`).
+
+- SERIALIZABLE: full serializability — concurrent appends that our read
+  predicate might have seen conflict.
+- WRITE_SERIALIZABLE: writes serialize, reads may see a snapshot that a
+  concurrent blind append later "time-travels" behind; blind appends by
+  winners don't conflict with our reads.
+- SNAPSHOT_ISOLATION: only write-write conflicts (deletes of the same
+  files, metadata/protocol changes) matter.
+
+Data-changing commits default to WRITE_SERIALIZABLE; file-rearranging
+commits (OPTIMIZE: dataChange=false) can run at SNAPSHOT_ISOLATION
+(`OptimisticTransaction.getIsolationLevelToUse`:2076).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class IsolationLevel(Enum):
+    SERIALIZABLE = "Serializable"
+    WRITE_SERIALIZABLE = "WriteSerializable"
+    SNAPSHOT_ISOLATION = "SnapshotIsolation"
+
+
+def default_isolation_level(data_changed: bool) -> IsolationLevel:
+    return (
+        IsolationLevel.WRITE_SERIALIZABLE if data_changed
+        else IsolationLevel.SNAPSHOT_ISOLATION
+    )

@@ -1,0 +1,472 @@
+"""Optimistic transactions: read-tracked, conflict-checked commits.
+
+The rebuild of `OptimisticTransaction.scala` (commit:1236 →
+doCommitRetryIteratively:2198) and kernel `TransactionImpl.java:144`:
+
+    txn = table.start_transaction("WRITE")
+    files = txn.scan_files(filter=...)      # reads are tracked
+    txn.add_file(add)
+    txn.remove_file(remove)
+    result = txn.commit()
+
+Commit loop: serialize actions → LogStore.write(N.json, overwrite=False)
+(atomic put-if-absent) → on FileAlreadyExistsError, run the conflict
+checker against the winning commits and retry at the next version, up to
+`settings.max_commit_retries`. Post-commit hooks (checkpointing every
+`delta.checkpointInterval` commits, checksum) run best-effort.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from delta_tpu.config import (
+    CHECKPOINT_INTERVAL,
+    IN_COMMIT_TIMESTAMPS,
+    get_table_config,
+    settings,
+)
+from delta_tpu.errors import (
+    ConcurrentTransactionError,
+    DeltaError,
+    MaxCommitRetriesExceededError,
+    MetadataChangedError,
+    ProtocolChangedError,
+    TableNotFoundError,
+)
+from delta_tpu.expressions.tree import Expression
+from delta_tpu.models.actions import (
+    Action,
+    AddCDCFile,
+    AddFile,
+    CommitInfo,
+    DomainMetadata,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    actions_to_commit_bytes,
+)
+from delta_tpu.txn.conflict import (
+    TransactionReadState,
+    check_conflicts,
+    read_winning_commits,
+)
+from delta_tpu.txn.isolation import IsolationLevel, default_isolation_level
+from delta_tpu.utils import filenames
+
+
+class Operation:
+    WRITE = "WRITE"
+    STREAMING_UPDATE = "STREAMING UPDATE"
+    DELETE = "DELETE"
+    UPDATE = "UPDATE"
+    MERGE = "MERGE"
+    OPTIMIZE = "OPTIMIZE"
+    CREATE_TABLE = "CREATE TABLE"
+    REPLACE_TABLE = "REPLACE TABLE"
+    SET_TBLPROPERTIES = "SET TBLPROPERTIES"
+    ADD_COLUMNS = "ADD COLUMNS"
+    CHANGE_COLUMN = "CHANGE COLUMN"
+    RESTORE = "RESTORE"
+    CLONE = "CLONE"
+    VACUUM_START = "VACUUM START"
+    VACUUM_END = "VACUUM END"
+    TRUNCATE = "TRUNCATE"
+    CONVERT = "CONVERT"
+    MANUAL_UPDATE = "Manual Update"
+
+
+@dataclass
+class CommitResult:
+    version: int
+    committed: bool
+    snapshot_fn: Optional[object] = None
+    attempts: int = 1
+
+    @property
+    def post_commit_snapshot(self):
+        return self.snapshot_fn() if self.snapshot_fn else None
+
+
+class TransactionBuilder:
+    """Builds a Transaction against the current table state (or a new
+    table). Mirrors kernel `TransactionBuilderImpl`."""
+
+    def __init__(self, table, operation: str = Operation.WRITE, engine_info: Optional[str] = None):
+        self._table = table
+        self._operation = operation
+        self._engine_info = engine_info or f"delta-tpu/{_version()}"
+        self._schema = None
+        self._partition_columns: Optional[List[str]] = None
+        self._txn_app_id: Optional[str] = None
+        self._txn_version: Optional[int] = None
+        self._table_properties: Optional[Dict[str, str]] = None
+        self._isolation: Optional[IsolationLevel] = None
+        self._max_retries: Optional[int] = None
+
+    def with_schema(self, schema) -> "TransactionBuilder":
+        self._schema = schema
+        return self
+
+    def with_partition_columns(self, cols: Sequence[str]) -> "TransactionBuilder":
+        self._partition_columns = list(cols)
+        return self
+
+    def with_transaction_id(self, app_id: str, version: int) -> "TransactionBuilder":
+        self._txn_app_id, self._txn_version = app_id, version
+        return self
+
+    def with_table_properties(self, props: Dict[str, str]) -> "TransactionBuilder":
+        self._table_properties = dict(props)
+        return self
+
+    def with_isolation_level(self, level: IsolationLevel) -> "TransactionBuilder":
+        self._isolation = level
+        return self
+
+    def with_max_retries(self, n: int) -> "TransactionBuilder":
+        self._max_retries = n
+        return self
+
+    def build(self) -> "Transaction":
+        try:
+            snapshot = self._table.latest_snapshot()
+        except TableNotFoundError:
+            snapshot = None
+
+        if snapshot is None and self._schema is None:
+            raise DeltaError(
+                f"table {self._table.path} does not exist; provide a schema "
+                "to create it"
+            )
+
+        txn = Transaction(
+            table=self._table,
+            snapshot=snapshot,
+            operation=self._operation,
+            engine_info=self._engine_info,
+            isolation=self._isolation,
+            max_retries=self._max_retries,
+        )
+        if snapshot is None:
+            from delta_tpu.models.schema import StructType, schema_to_json
+            from delta_tpu.features import protocol_for_new_table
+
+            schema_json = (
+                schema_to_json(self._schema)
+                if isinstance(self._schema, StructType)
+                else self._schema
+            )
+            props = dict(self._table_properties or {})
+            metadata = Metadata(
+                id=str(uuid.uuid4()),
+                schemaString=schema_json,
+                partitionColumns=list(self._partition_columns or []),
+                configuration=props,
+                createdTime=int(time.time() * 1000),
+            )
+            txn.update_metadata(metadata)
+            txn.update_protocol(protocol_for_new_table(props))
+        elif self._table_properties:
+            meta = snapshot.metadata
+            new_conf = dict(meta.configuration)
+            new_conf.update(self._table_properties)
+            if new_conf != meta.configuration:
+                import dataclasses
+
+                txn.update_metadata(dataclasses.replace(meta, configuration=new_conf))
+
+        if self._txn_app_id is not None:
+            txn.set_transaction_id(self._txn_app_id, self._txn_version)
+        return txn
+
+
+def _version() -> str:
+    from delta_tpu.version import __version__
+
+    return __version__
+
+
+class Transaction:
+    def __init__(
+        self,
+        table,
+        snapshot,
+        operation: str,
+        engine_info: str,
+        isolation: Optional[IsolationLevel] = None,
+        max_retries: Optional[int] = None,
+    ):
+        self._table = table
+        self.read_snapshot = snapshot
+        self.operation = operation
+        self.engine_info = engine_info
+        self.txn_id = str(uuid.uuid4())
+        self._isolation = isolation
+        self._max_retries = (
+            max_retries if max_retries is not None else settings.max_commit_retries
+        )
+
+        self._adds: List[AddFile] = []
+        self._removes: List[RemoveFile] = []
+        self._cdcs: List[AddCDCFile] = []
+        self._set_txns: Dict[str, SetTransaction] = {}
+        self._domain_metadata: Dict[str, DomainMetadata] = {}
+        self._new_metadata: Optional[Metadata] = None
+        self._new_protocol: Optional[Protocol] = None
+        self._op_parameters: Dict[str, object] = {}
+        self._op_metrics: Dict[str, object] = {}
+
+        self._read_predicates: List[Expression] = []
+        self._read_whole_table = False
+        self._read_files: set = set()
+        self._read_app_ids: set = set()
+        self._committed = False
+        # observer hook for deterministic concurrency tests (the
+        # TransactionExecutionObserver analogue)
+        self.observer = None
+
+    # -- read tracking ------------------------------------------------------
+
+    @property
+    def read_version(self) -> int:
+        return self.read_snapshot.version if self.read_snapshot else -1
+
+    def metadata(self) -> Optional[Metadata]:
+        if self._new_metadata is not None:
+            return self._new_metadata
+        return self.read_snapshot.metadata if self.read_snapshot else None
+
+    def protocol(self) -> Optional[Protocol]:
+        if self._new_protocol is not None:
+            return self._new_protocol
+        return self.read_snapshot.protocol if self.read_snapshot else None
+
+    def scan_files(self, filter: Optional[Expression] = None):
+        """Scan the read snapshot, recording the predicate (or whole-table
+        read) and the returned file keys for conflict checking."""
+        if self.read_snapshot is None:
+            return []
+        scan = self.read_snapshot.scan(filter=filter)
+        files = scan.files()
+        if filter is None:
+            self._read_whole_table = True
+        else:
+            self._read_predicates.append(filter)
+        for f in files:
+            self._read_files.add((f.path, f.dv_unique_id))
+        return files
+
+    def mark_read_whole_table(self) -> None:
+        self._read_whole_table = True
+
+    def txn_version(self, app_id: str) -> Optional[int]:
+        """Read an idempotent-txn watermark; the read is tracked."""
+        self._read_app_ids.add(app_id)
+        if self.read_snapshot is None:
+            return None
+        return self.read_snapshot.set_transaction_version(app_id)
+
+    # -- staging ------------------------------------------------------------
+
+    def add_file(self, add: AddFile) -> None:
+        self._adds.append(add)
+
+    def add_files(self, adds: Sequence[AddFile]) -> None:
+        self._adds.extend(adds)
+
+    def remove_file(self, remove: RemoveFile) -> None:
+        self._removes.append(remove)
+
+    def remove_files(self, removes: Sequence[RemoveFile]) -> None:
+        self._removes.extend(removes)
+
+    def add_cdc_file(self, cdc: AddCDCFile) -> None:
+        self._cdcs.append(cdc)
+
+    def set_transaction_id(self, app_id: str, version: int, last_updated: Optional[int] = None):
+        existing = self.txn_version(app_id)
+        if existing is not None and version <= existing:
+            raise ConcurrentTransactionError(
+                f"transaction {app_id} already advanced to {existing} >= {version}"
+            )
+        self._set_txns[app_id] = SetTransaction(app_id, version, last_updated)
+
+    def update_metadata(self, metadata: Metadata) -> None:
+        self._new_metadata = metadata
+
+    def update_protocol(self, protocol: Protocol) -> None:
+        self._new_protocol = protocol
+
+    def set_domain_metadata(self, domain: str, configuration: str) -> None:
+        self._domain_metadata[domain] = DomainMetadata(domain, configuration, removed=False)
+
+    def remove_domain_metadata(self, domain: str) -> None:
+        self._domain_metadata[domain] = DomainMetadata(domain, "", removed=True)
+
+    def set_operation_parameters(self, params: Dict[str, object]) -> None:
+        self._op_parameters.update(params)
+
+    def set_operation_metrics(self, metrics: Dict[str, object]) -> None:
+        self._op_metrics.update(metrics)
+
+    # -- commit -------------------------------------------------------------
+
+    @property
+    def data_changed(self) -> bool:
+        return any(a.dataChange for a in self._adds) or any(
+            r.dataChange for r in self._removes
+        )
+
+    def _prepare_actions(self, attempt_version: int, winners_ict: Optional[int]) -> List[Action]:
+        """prepareCommit (`OptimisticTransaction.scala:1910`): validate and
+        order actions; first line is commitInfo (required when ICT on)."""
+        meta = self.metadata()
+        if meta is None:
+            raise DeltaError("cannot commit a transaction with no metadata")
+        if self.read_snapshot is None and self._new_protocol is None:
+            raise DeltaError("new table commit must include a protocol")
+        from delta_tpu.features import validate_writable
+
+        validate_writable(self.protocol(), meta)
+
+        now = int(time.time() * 1000)
+        ict = None
+        if get_table_config(meta.configuration, IN_COMMIT_TIMESTAMPS):
+            prev = 0
+            if self.read_snapshot is not None:
+                prev = self.read_snapshot.timestamp_ms
+            if winners_ict is not None:
+                prev = max(prev, winners_ict)
+            ict = max(now, prev + 1)
+
+        commit_info = CommitInfo(
+            timestamp=now,
+            inCommitTimestamp=ict,
+            operation=self.operation,
+            operationParameters=self._op_parameters or {},
+            operationMetrics=self._compute_metrics(),
+            readVersion=self.read_version if self.read_version >= 0 else None,
+            isolationLevel=self._isolation_level().value,
+            isBlindAppend=(not self._removes and not self._read_files
+                           and not self._read_predicates and not self._read_whole_table),
+            engineInfo=self.engine_info,
+            txnId=self.txn_id,
+        )
+        actions: List[Action] = [commit_info]
+        if self._new_protocol is not None:
+            actions.append(self._new_protocol)
+        if self._new_metadata is not None:
+            actions.append(self._new_metadata)
+        actions.extend(self._set_txns.values())
+        actions.extend(self._domain_metadata.values())
+        actions.extend(self._removes)
+        actions.extend(self._adds)
+        actions.extend(self._cdcs)
+        return actions
+
+    def _compute_metrics(self) -> Dict[str, object]:
+        m = {
+            "numOutputFiles": str(len(self._adds)),
+            "numOutputBytes": str(sum(a.size for a in self._adds)),
+        }
+        if self._removes:
+            m["numRemovedFiles"] = str(len(self._removes))
+        m.update({k: str(v) for k, v in self._op_metrics.items()})
+        return m
+
+    def _isolation_level(self) -> IsolationLevel:
+        if self._isolation is not None:
+            return self._isolation
+        return default_isolation_level(self.data_changed)
+
+    def _read_state(self) -> TransactionReadState:
+        meta = self.metadata()
+        return TransactionReadState(
+            read_predicates=list(self._read_predicates),
+            read_whole_table=self._read_whole_table,
+            read_files=set(self._read_files),
+            read_app_ids=set(self._read_app_ids) | set(self._set_txns),
+            removed_keys={(r.path, r.dv_unique_id) for r in self._removes},
+            written_domains=set(self._domain_metadata),
+            metadata_changed=self._new_metadata is not None,
+            protocol_changed=self._new_protocol is not None,
+            partition_columns=list(meta.partitionColumns) if meta else [],
+            isolation=self._isolation_level(),
+        )
+
+    def commit(self) -> CommitResult:
+        """doCommitRetryIteratively (`OptimisticTransaction.scala:2198`)."""
+        if self._committed:
+            raise DeltaError("transaction already committed")
+        engine = self._table.engine
+        log_path = self._table.log_path
+        attempt_version = self.read_version + 1
+        winners_ict: Optional[int] = None
+        attempts = 0
+
+        while attempts <= self._max_retries:
+            attempts += 1
+            if self.observer:
+                self.observer.before_commit_attempt(self, attempt_version)
+            actions = self._prepare_actions(attempt_version, winners_ict)
+            data = actions_to_commit_bytes(actions)
+            path = filenames.delta_file(log_path, attempt_version)
+            try:
+                engine.json.write_json_file_atomically(path, data, overwrite=False)
+            except FileExistsError:
+                if self.observer:
+                    self.observer.on_commit_conflict(self, attempt_version)
+                # We lost the race: find the current latest, check logical
+                # conflicts against every winner, rebase, retry.
+                latest = self._latest_version(engine, log_path, attempt_version)
+                winners = read_winning_commits(
+                    engine.fs, log_path, attempt_version, latest
+                )
+                rebase = check_conflicts(self._read_state(), winners)
+                for w in winners:
+                    ci = next(
+                        (a for a in w.actions if isinstance(a, CommitInfo)), None
+                    )
+                    if ci is not None and ci.inCommitTimestamp is not None:
+                        winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
+                attempt_version = latest + 1
+                continue
+            self._committed = True
+            if self.observer:
+                self.observer.after_commit(self, attempt_version)
+            self._run_post_commit_hooks(attempt_version)
+            table = self._table
+            return CommitResult(
+                version=attempt_version,
+                committed=True,
+                snapshot_fn=lambda: table.latest_snapshot(),
+                attempts=attempts,
+            )
+        raise MaxCommitRetriesExceededError(
+            f"commit failed after {attempts} attempts (last tried version "
+            f"{attempt_version})"
+        )
+
+    def _latest_version(self, engine, log_path: str, at_least: int) -> int:
+        latest = at_least
+        prefix = filenames.listing_prefix(log_path, at_least)
+        for fstat in engine.fs.list_from(prefix):
+            if filenames.is_delta_file(fstat.path):
+                latest = max(latest, filenames.delta_version(fstat.path))
+        return latest
+
+    def _run_post_commit_hooks(self, version: int) -> None:
+        meta = self.metadata()
+        try:
+            from delta_tpu.hooks import run_post_commit_hooks
+
+            run_post_commit_hooks(self._table, self, version, meta)
+        except Exception:
+            # Post-commit hooks are best-effort (reference: hook failures
+            # do not fail the commit).
+            pass

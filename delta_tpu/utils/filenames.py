@@ -1,0 +1,237 @@
+"""`_delta_log` file-naming scheme.
+
+The log directory contains, side by side (semantics per reference
+`spark/.../delta/util/FileNames.scala` and `PROTOCOL.md:1495-1519`):
+
+- commit ("delta") files              ``%020d.json``
+- unbackfilled commits                ``_commits/%020d.<uuid>.json``
+- per-version checksums               ``%020d.crc``
+- compacted commit ranges             ``%020d.%020d.compacted.json``
+- classic single-file checkpoints     ``%020d.checkpoint.parquet``
+- legacy multi-part checkpoints       ``%020d.checkpoint.%010d.%010d.parquet``
+- V2 / UUID checkpoints               ``%020d.checkpoint.<uuid>.{json,parquet}``
+- V2 sidecars                         ``_sidecars/<uuid>.parquet``
+- the last-checkpoint pointer         ``_last_checkpoint``
+
+Zero padding exists so a lexicographic LIST from a prefix returns files in
+version order — the listing contract everything above depends on.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid as _uuid
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional, Sequence
+
+LOG_DIR_NAME = "_delta_log"
+COMMIT_SUBDIR = "_commits"
+SIDECAR_SUBDIR = "_sidecars"
+LAST_CHECKPOINT = "_last_checkpoint"
+CHANGE_DATA_DIR = "_change_data"
+
+DELTA_FILE_RE = re.compile(r"^(\d+)\.json$")
+UUID_DELTA_FILE_RE = re.compile(r"^(\d+)\.([^.]+)\.json$")
+COMPACTED_DELTA_FILE_RE = re.compile(r"^(\d+)\.(\d+)\.compacted\.json$")
+CHECKSUM_FILE_RE = re.compile(r"^(\d+)\.crc$")
+CHECKPOINT_FILE_RE = re.compile(
+    r"^(\d+)\.checkpoint((\.\d+\.\d+)?\.parquet|\.[^.]+\.(json|parquet))$"
+)
+
+
+def delta_file(log_path: str, version: int) -> str:
+    """Backfilled commit file path for `version`."""
+    return f"{log_path}/{version:020d}.json"
+
+
+def unbackfilled_delta_file(log_path: str, version: int, uuid: Optional[str] = None) -> str:
+    u = uuid if uuid is not None else str(_uuid.uuid4())
+    return f"{log_path}/{COMMIT_SUBDIR}/{version:020d}.{u}.json"
+
+
+def commit_dir(log_path: str) -> str:
+    return f"{log_path}/{COMMIT_SUBDIR}"
+
+
+def sidecar_dir(log_path: str) -> str:
+    return f"{log_path}/{SIDECAR_SUBDIR}"
+
+
+def sidecar_file(log_path: str, uuid: Optional[str] = None) -> str:
+    u = uuid if uuid is not None else str(_uuid.uuid4())
+    return f"{log_path}/{SIDECAR_SUBDIR}/{u}.parquet"
+
+
+def checksum_file(log_path: str, version: int) -> str:
+    return f"{log_path}/{version:020d}.crc"
+
+
+def compacted_delta_file(log_path: str, from_version: int, to_version: int) -> str:
+    return f"{log_path}/{from_version:020d}.{to_version:020d}.compacted.json"
+
+
+def checkpoint_file_singular(log_path: str, version: int) -> str:
+    return f"{log_path}/{version:020d}.checkpoint.parquet"
+
+
+def checkpoint_file_with_parts(log_path: str, version: int, num_parts: int) -> list[str]:
+    """Part paths are 1-based: part `i` of `n` is `...checkpoint.%010i.%010n.parquet`."""
+    return [
+        f"{log_path}/{version:020d}.checkpoint.{i:010d}.{num_parts:010d}.parquet"
+        for i in range(1, num_parts + 1)
+    ]
+
+
+def top_level_v2_checkpoint_file(
+    log_path: str, version: int, fmt: str = "parquet", uuid: Optional[str] = None
+) -> str:
+    assert fmt in ("json", "parquet"), fmt
+    u = uuid if uuid is not None else str(_uuid.uuid4())
+    return f"{log_path}/{version:020d}.checkpoint.{u}.{fmt}"
+
+
+def last_checkpoint_file(log_path: str) -> str:
+    return f"{log_path}/{LAST_CHECKPOINT}"
+
+
+def listing_prefix(log_path: str, version: int) -> str:
+    """Prefix such that a lexicographic listFrom returns all log files with
+    version >= `version` (plus `_`-prefixed dirs, which sort after digits
+    — callers filter)."""
+    return f"{log_path}/{version:020d}."
+
+
+def file_name(path: str) -> str:
+    return path.rstrip("/").rsplit("/", 1)[-1]
+
+
+def is_delta_file(path: str) -> bool:
+    return DELTA_FILE_RE.match(file_name(path)) is not None
+
+
+def is_unbackfilled_delta_file(path: str) -> bool:
+    p = path.rstrip("/")
+    return (
+        UUID_DELTA_FILE_RE.match(file_name(p)) is not None
+        and f"/{COMMIT_SUBDIR}/" in p
+    )
+
+
+def is_checksum_file(path: str) -> bool:
+    return CHECKSUM_FILE_RE.match(file_name(path)) is not None
+
+
+def is_checkpoint_file(path: str) -> bool:
+    return CHECKPOINT_FILE_RE.match(file_name(path)) is not None
+
+
+def is_compacted_delta_file(path: str) -> bool:
+    return COMPACTED_DELTA_FILE_RE.match(file_name(path)) is not None
+
+
+def delta_version(path: str) -> int:
+    """Version encoded in a commit/unbackfilled-commit file name."""
+    return int(file_name(path).split(".")[0])
+
+
+def checksum_version(path: str) -> int:
+    return int(file_name(path).removesuffix(".crc"))
+
+
+def checkpoint_version(path: str) -> int:
+    return int(file_name(path).split(".")[0])
+
+
+def compacted_delta_versions(path: str) -> tuple[int, int]:
+    parts = file_name(path).split(".")
+    return int(parts[0]), int(parts[1])
+
+
+class CheckpointFormat(Enum):
+    CLASSIC = "classic"            # %020d.checkpoint.parquet
+    MULTIPART = "multipart"        # %020d.checkpoint.%010d.%010d.parquet
+    V2_JSON = "v2-json"            # %020d.checkpoint.<uuid>.json
+    V2_PARQUET = "v2-parquet"      # %020d.checkpoint.<uuid>.parquet
+
+
+@dataclass(frozen=True, order=False)
+class CheckpointInstance:
+    """Parsed identity of a checkpoint file (reference
+    `kernel/.../internal/checkpoints/CheckpointInstance.java`,
+    spark `Checkpoints.scala` CheckpointInstance).
+
+    Ordering: by version, then format preference (V2 > multipart > classic —
+    newer formats carry more information), used to pick the best complete
+    checkpoint at or below a version.
+    """
+
+    version: int
+    fmt: CheckpointFormat
+    num_parts: int = 1
+    part: int = 1          # 1-based part index for MULTIPART
+    uuid: Optional[str] = None
+    path: Optional[str] = None
+
+    _FORMAT_RANK = {
+        CheckpointFormat.CLASSIC: 0,
+        CheckpointFormat.MULTIPART: 1,
+        CheckpointFormat.V2_JSON: 2,
+        CheckpointFormat.V2_PARQUET: 2,
+    }
+
+    @property
+    def sort_key(self):
+        return (self.version, self._FORMAT_RANK[self.fmt], self.num_parts)
+
+    @staticmethod
+    def parse(path: str) -> Optional["CheckpointInstance"]:
+        name = file_name(path)
+        m = CHECKPOINT_FILE_RE.match(name)
+        if m is None:
+            return None
+        version = int(m.group(1))
+        parts = name.split(".")
+        # name.checkpoint.parquet -> 3 segments
+        if len(parts) == 3:
+            return CheckpointInstance(version, CheckpointFormat.CLASSIC, path=path)
+        # name.checkpoint.<part>.<num>.parquet -> 5 segments, digits
+        if len(parts) == 5 and parts[2].isdigit() and parts[3].isdigit():
+            return CheckpointInstance(
+                version,
+                CheckpointFormat.MULTIPART,
+                num_parts=int(parts[3]),
+                part=int(parts[2]),
+                path=path,
+            )
+        # name.checkpoint.<uuid>.{json,parquet} -> 4 segments
+        if len(parts) == 4:
+            fmt = (
+                CheckpointFormat.V2_JSON if parts[3] == "json" else CheckpointFormat.V2_PARQUET
+            )
+            return CheckpointInstance(version, fmt, uuid=parts[2], path=path)
+        return None
+
+
+def group_complete_checkpoints(
+    instances: Sequence[CheckpointInstance],
+) -> list[list[CheckpointInstance]]:
+    """Group parsed checkpoint files into *complete* checkpoints.
+
+    A classic or V2 file is complete by itself; a multipart checkpoint is
+    complete only when all `num_parts` parts for the same (version,
+    num_parts) are present (reference `Checkpoints.scala` getLatestComplete
+    semantics). Returns groups sorted ascending by (version, format rank).
+    """
+    singles: list[list[CheckpointInstance]] = []
+    multi: dict[tuple[int, int], dict[int, CheckpointInstance]] = {}
+    for ci in instances:
+        if ci.fmt == CheckpointFormat.MULTIPART:
+            multi.setdefault((ci.version, ci.num_parts), {})[ci.part] = ci
+        else:
+            singles.append([ci])
+    for (version, num_parts), parts in multi.items():
+        if len(parts) == num_parts and set(parts) == set(range(1, num_parts + 1)):
+            singles.append([parts[i] for i in range(1, num_parts + 1)])
+    singles.sort(key=lambda group: group[0].sort_key)
+    return singles

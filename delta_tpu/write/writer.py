@@ -1,0 +1,170 @@
+"""Transactional data-file writing.
+
+The `TransactionalWrite.writeFiles` analogue (`files/
+TransactionalWrite.scala:230`): an Arrow table goes in; Parquet data files
+plus fully-populated `AddFile` actions (partition values, size, mtime,
+stats JSON) come out, ready to stage on a transaction. Partitioned tables
+are split by partition values into Hive-style directories; large inputs
+split into multiple files per `delta.targetFileSize` (approximated by row
+count from the input's in-memory footprint).
+
+Invariant / constraint enforcement (NOT NULL, CHECK) runs before any file
+is written (`constraints/Invariants.scala` role).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.errors import InvariantViolationError, SchemaMismatchError
+from delta_tpu.models.actions import AddFile
+from delta_tpu.models.schema import StructType, from_arrow_schema, to_arrow_schema
+from delta_tpu.stats.collection import collect_stats
+from delta_tpu.stats.partition import partition_path, serialize_partition_value
+
+
+def _check_invariants(table: pa.Table, schema: StructType, constraints=None) -> None:
+    for f in schema.fields:
+        if not f.nullable and f.name in table.column_names:
+            nulls = table.column(f.name).null_count
+            if nulls:
+                raise InvariantViolationError(
+                    f"NOT NULL constraint violated for column {f.name}: "
+                    f"{nulls} null row(s)"
+                )
+    if constraints:
+        from delta_tpu.expressions.eval import evaluate_predicate_host
+
+        for name, expr in constraints.items():
+            ok = evaluate_predicate_host(expr, table)
+            bad = int((~ok).sum())
+            if bad:
+                raise InvariantViolationError(
+                    f"CHECK constraint {name} violated by {bad} row(s)"
+                )
+
+
+def _validate_schema(table: pa.Table, schema: StructType) -> None:
+    table_fields = set(table.column_names)
+    schema_fields = set(schema.field_names())
+    missing = schema_fields - table_fields
+    extra = table_fields - schema_fields
+    if extra:
+        raise SchemaMismatchError(
+            f"columns {sorted(extra)} not in table schema {sorted(schema_fields)}"
+        )
+    if missing:
+        nonnull_missing = [
+            m for m in missing if m in schema and not schema[m].nullable
+        ]
+        if nonnull_missing:
+            raise SchemaMismatchError(
+                f"missing non-nullable columns: {sorted(nonnull_missing)}"
+            )
+
+
+def write_data_files(
+    engine,
+    table_path: str,
+    data: pa.Table,
+    schema: StructType,
+    partition_columns: Sequence[str],
+    configuration: Dict[str, str],
+    data_change: bool = True,
+    constraints=None,
+    target_rows_per_file: Optional[int] = None,
+    base_row_id_start: Optional[int] = None,
+) -> List[AddFile]:
+    """Write `data` under `table_path`, returning AddFile actions."""
+    _validate_schema(data, schema)
+    _check_invariants(data, schema, constraints)
+    now_ms = int(time.time() * 1000)
+    adds: List[AddFile] = []
+    partition_columns = list(partition_columns)
+
+    if partition_columns:
+        groups = _partition_groups(data, partition_columns)
+    else:
+        groups = [({}, data)]
+
+    next_base_row_id = base_row_id_start
+    for pv, part_data in groups:
+        file_data = part_data.drop_columns(
+            [c for c in partition_columns if c in part_data.column_names]
+        )
+        for chunk in _split_rows(file_data, target_rows_per_file):
+            if chunk.num_rows == 0:
+                continue
+            rel_dir = partition_path(pv, partition_columns)
+            fname = f"part-{uuid.uuid4()}.parquet"
+            rel_path = f"{rel_dir}{fname}"
+            abs_path = f"{table_path}/{rel_path}"
+            status = engine.parquet.write_parquet_file(abs_path, chunk)
+            stats = collect_stats(chunk, schema, configuration, partition_columns)
+            add = AddFile(
+                path=rel_path,
+                partitionValues={k: v for k, v in pv.items()},
+                size=status.size,
+                modificationTime=status.modification_time or now_ms,
+                dataChange=data_change,
+                stats=stats,
+            )
+            if next_base_row_id is not None:
+                add.baseRowId = next_base_row_id
+                next_base_row_id += chunk.num_rows
+            adds.append(add)
+    return adds
+
+
+def _partition_groups(data: pa.Table, partition_columns: List[str]):
+    """Split rows by partition-column values (vectorized grouping)."""
+    import pandas as pd
+
+    key_cols = []
+    for c in partition_columns:
+        if c not in data.column_names:
+            raise SchemaMismatchError(f"partition column {c} missing from data")
+        key_cols.append(data.column(c).to_pandas())
+    if len(key_cols) == 1:
+        codes, uniques = pd.factorize(key_cols[0], use_na_sentinel=False)
+        unique_tuples = [(u,) for u in uniques]
+    else:
+        mi = pd.MultiIndex.from_arrays(key_cols)
+        codes, uniques = pd.factorize(mi, use_na_sentinel=False)
+        unique_tuples = list(uniques)
+    out = []
+    codes = np.asarray(codes)
+    for gid, key in enumerate(unique_tuples):
+        idx = np.nonzero(codes == gid)[0]
+        pv = {
+            c: serialize_partition_value(_null_to_none(v))
+            for c, v in zip(partition_columns, key)
+        }
+        out.append((pv, data.take(pa.array(idx, pa.int64()))))
+    return out
+
+
+def _null_to_none(v):
+    import pandas as pd
+
+    try:
+        if v is None or (isinstance(v, float) and np.isnan(v)) or v is pd.NaT:
+            return None
+    except (TypeError, ValueError):
+        pass
+    return v
+
+
+def _split_rows(data: pa.Table, target_rows: Optional[int]):
+    if target_rows is None or data.num_rows <= target_rows:
+        return [data]
+    out = []
+    for start in range(0, data.num_rows, target_rows):
+        out.append(data.slice(start, target_rows))
+    return out

@@ -1,0 +1,52 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax loads.
+
+Tests exercise the multi-chip sharding paths on virtual devices (the
+driver validates the real thing via __graft_entry__.dryrun_multichip);
+bench.py runs unforced on the real TPU chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_table_path(tmp_path):
+    return str(tmp_path / "table")
+
+
+@pytest.fixture
+def host_engine():
+    from delta_tpu.engine.host import HostEngine
+
+    return HostEngine()
+
+
+@pytest.fixture
+def tpu_engine():
+    from delta_tpu.engine.tpu import TpuEngine
+
+    return TpuEngine()
+
+
+@pytest.fixture
+def sample_data():
+    rng = np.random.default_rng(7)
+    n = 1000
+    return pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "value": pa.array(rng.normal(size=n)),
+            "category": pa.array([f"cat{i % 5}" for i in range(n)]),
+            "date": pa.array([f"2024-01-{(i % 28) + 1:02d}" for i in range(n)]),
+        }
+    )

@@ -1,0 +1,96 @@
+import json
+
+from delta_tpu.models.actions import (
+    AddFile,
+    CommitInfo,
+    DeletionVectorDescriptor,
+    DomainMetadata,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    action_from_json_dict,
+    actions_from_commit_bytes,
+    actions_to_commit_bytes,
+)
+
+
+def test_add_file_roundtrip():
+    add = AddFile(
+        path="p=1/part-00000.parquet",
+        partitionValues={"p": "1"},
+        size=1234,
+        modificationTime=999,
+        dataChange=True,
+        stats='{"numRecords":10}',
+        baseRowId=4071,
+        defaultRowCommitVersion=41,
+    )
+    wrapped = json.loads(add.to_json())
+    assert set(wrapped) == {"add"}
+    back = action_from_json_dict(wrapped)
+    assert isinstance(back, AddFile)
+    assert back == add
+    assert back.num_records() == 10
+
+
+def test_remove_and_logical_key_with_dv():
+    dv = DeletionVectorDescriptor("u", "ab^-aqEH.-t@S}K{vb[*k^", sizeInBytes=4, cardinality=6, offset=1)
+    add = AddFile(path="a.parquet", deletionVector=dv)
+    assert add.dv_unique_id == "uab^-aqEH.-t@S}K{vb[*k^@1"
+    rm = add.remove(deletion_timestamp=123)
+    assert rm.logical_file_key() == add.logical_file_key()
+    assert rm.extendedFileMetadata is True
+    back = action_from_json_dict(json.loads(rm.to_json()))
+    assert isinstance(back, RemoveFile)
+    assert back.deletionVector.unique_id == dv.unique_id
+
+
+def test_dv_unique_id_without_offset():
+    dv = DeletionVectorDescriptor("i", "inlinebits", sizeInBytes=4, cardinality=1)
+    assert dv.unique_id == "iinlinebits"
+
+
+def test_metadata_protocol_roundtrip():
+    meta = Metadata(
+        id="uuid-1",
+        schemaString='{"type":"struct","fields":[]}',
+        partitionColumns=["p"],
+        configuration={"delta.appendOnly": "true"},
+        createdTime=5,
+    )
+    back = action_from_json_dict(json.loads(meta.to_json()))
+    assert back == meta
+    proto = Protocol(3, 7, readerFeatures=["deletionVectors"], writerFeatures=["deletionVectors"])
+    back = action_from_json_dict(json.loads(proto.to_json()))
+    assert back == proto
+
+
+def test_unknown_fields_roundtrip():
+    raw = {"add": {"path": "x", "partitionValues": {}, "size": 1,
+                   "modificationTime": 2, "dataChange": True,
+                   "futureField": {"a": 1}}}
+    act = action_from_json_dict(raw)
+    assert act.extra == {"futureField": {"a": 1}}
+    assert json.loads(act.to_json())["add"]["futureField"] == {"a": 1}
+
+
+def test_unknown_action_ignored():
+    assert action_from_json_dict({"mystery": {"x": 1}}) is None
+
+
+def test_commit_bytes_roundtrip():
+    actions = [
+        CommitInfo(timestamp=1, operation="WRITE"),
+        Protocol(1, 2),
+        Metadata(id="m", schemaString="{}"),
+        SetTransaction("app", 7),
+        DomainMetadata("d1", '{"k":1}', False),
+        AddFile(path="f1"),
+        RemoveFile(path="f0", deletionTimestamp=3),
+    ]
+    data = actions_to_commit_bytes(actions)
+    lines = [ln for ln in data.decode().splitlines() if ln]
+    assert len(lines) == 7
+    back = actions_from_commit_bytes(data)
+    assert [type(a).__name__ for a in back] == [type(a).__name__ for a in actions]

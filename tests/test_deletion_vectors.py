@@ -1,0 +1,102 @@
+import numpy as np
+import pytest
+
+from delta_tpu.dv.roaring import RoaringBitmapArray
+from delta_tpu.dv.descriptor import (
+    decode_uuid_base85,
+    encode_uuid_base85,
+    inline_descriptor,
+    load_deletion_vector,
+    write_deletion_vector_file,
+)
+
+
+@pytest.mark.parametrize(
+    "values",
+    [
+        [],
+        [0],
+        [0, 1, 2, 3],
+        [2, 5, 7, 8, 1000, 65535, 65536, 65537],
+        list(range(5000)),                      # bitmap container
+        [2**32 - 1, 2**32, 2**32 + 5, 2**40],   # multiple buckets
+        list(range(100000, 200000, 3)),
+    ],
+)
+def test_roaring_roundtrip(values):
+    bm = RoaringBitmapArray(np.array(values, dtype=np.uint64))
+    data = bm.serialize_delta()
+    back = RoaringBitmapArray.deserialize_delta(data)
+    assert back == bm
+    assert back.cardinality == len(set(values))
+
+
+def test_roaring_fuzz():
+    rng = np.random.default_rng(42)
+    for _ in range(10):
+        n = rng.integers(1, 20000)
+        vals = rng.integers(0, 2**40, n).astype(np.uint64)
+        bm = RoaringBitmapArray(vals)
+        back = RoaringBitmapArray.deserialize_delta(bm.serialize_delta())
+        assert back == bm
+
+
+def test_roaring_run_container_decode():
+    """Hand-build a WITH_RUN serialization and decode it."""
+    import struct
+
+    # one run container: key 0, values 10..19
+    n = 1
+    cookie = ((n - 1) << 16) | 12347
+    buf = struct.pack("<I", cookie)
+    buf += bytes([0b1])            # run flag bitset
+    buf += struct.pack("<HH", 0, 9)  # key 0, card-1 = 9
+    # n < 4 -> no offsets
+    buf += struct.pack("<H", 1)      # numRuns
+    buf += struct.pack("<HH", 10, 9)  # start 10, length-1 9
+    bitmap32 = struct.pack("<q", 1) + struct.pack("<I", 0) + buf
+    full = struct.pack("<i", 1681511377) + bitmap32
+    bm = RoaringBitmapArray.deserialize_delta(full)
+    assert bm.values.tolist() == list(range(10, 20))
+
+
+def test_to_mask_and_contains():
+    bm = RoaringBitmapArray(np.array([1, 5, 9], dtype=np.uint64))
+    mask = bm.to_mask(8)
+    assert mask.tolist() == [False, True, False, False, False, True, False, False]
+    assert bm.contains(np.array([1, 2, 9])).tolist() == [True, False, True]
+
+
+def test_uuid_base85_roundtrip():
+    import uuid
+
+    u = uuid.uuid4()
+    enc = encode_uuid_base85(u)
+    assert len(enc) == 20
+    assert decode_uuid_base85(enc) == u
+
+
+def test_dv_file_roundtrip(tmp_path):
+    from delta_tpu.engine.host import HostEngine
+
+    engine = HostEngine()
+    table_path = str(tmp_path)
+    bm1 = RoaringBitmapArray(np.array([1, 2, 3], dtype=np.uint64))
+    bm2 = RoaringBitmapArray(np.array([10, 2**33], dtype=np.uint64))
+    descs = write_deletion_vector_file(engine, table_path, [bm1, bm2])
+    assert len(descs) == 2
+    assert descs[0].cardinality == 3
+    v1 = load_deletion_vector(engine, table_path, descs[0].to_dict())
+    v2 = load_deletion_vector(engine, table_path, descs[1].to_dict())
+    assert v1.tolist() == [1, 2, 3]
+    assert v2.tolist() == [10, 2**33]
+
+
+def test_inline_dv_roundtrip():
+    from delta_tpu.engine.host import HostEngine
+
+    bm = RoaringBitmapArray(np.array([7, 8, 1000], dtype=np.uint64))
+    desc = inline_descriptor(bm)
+    assert desc.storageType == "i"
+    vals = load_deletion_vector(HostEngine(), "/nope", desc.to_dict())
+    assert vals.tolist() == [7, 8, 1000]

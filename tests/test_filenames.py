@@ -1,0 +1,67 @@
+from delta_tpu.utils import filenames as fn
+from delta_tpu.utils.filenames import CheckpointFormat, CheckpointInstance, group_complete_checkpoints
+
+
+LOG = "/t/_delta_log"
+
+
+def test_delta_file_naming():
+    assert fn.delta_file(LOG, 0) == f"{LOG}/00000000000000000000.json"
+    assert fn.delta_file(LOG, 123) == f"{LOG}/00000000000000000123.json"
+    assert fn.is_delta_file(fn.delta_file(LOG, 5))
+    assert fn.delta_version(fn.delta_file(LOG, 987654)) == 987654
+
+
+def test_checkpoint_naming():
+    single = fn.checkpoint_file_singular(LOG, 10)
+    assert single.endswith("00000000000000000010.checkpoint.parquet")
+    assert fn.is_checkpoint_file(single)
+    parts = fn.checkpoint_file_with_parts(LOG, 4915, 3)
+    assert len(parts) == 3
+    assert parts[0].endswith("00000000000000004915.checkpoint.0000000001.0000000003.parquet")
+    assert all(fn.is_checkpoint_file(p) for p in parts)
+    v2 = fn.top_level_v2_checkpoint_file(LOG, 7, "json", uuid="abc-def")
+    assert v2.endswith("00000000000000000007.checkpoint.abc-def.json")
+    assert fn.is_checkpoint_file(v2)
+
+
+def test_checksum_and_compacted():
+    crc = fn.checksum_file(LOG, 42)
+    assert crc.endswith("00000000000000000042.crc")
+    assert fn.is_checksum_file(crc)
+    assert fn.checksum_version(crc) == 42
+    cd = fn.compacted_delta_file(LOG, 5, 9)
+    assert fn.is_compacted_delta_file(cd)
+    assert fn.compacted_delta_versions(cd) == (5, 9)
+
+
+def test_listing_prefix_orders_before_log_files():
+    # everything for version >= v must sort >= the prefix
+    p = fn.listing_prefix(LOG, 10).rsplit("/", 1)[-1]
+    for f in [
+        fn.delta_file(LOG, 10),
+        fn.checkpoint_file_singular(LOG, 10),
+        fn.checksum_file(LOG, 10),
+        fn.delta_file(LOG, 11),
+    ]:
+        assert f.rsplit("/", 1)[-1] >= p
+    assert fn.delta_file(LOG, 9).rsplit("/", 1)[-1] < p
+
+
+def test_checkpoint_instance_parse():
+    ci = CheckpointInstance.parse(fn.checkpoint_file_singular(LOG, 3))
+    assert ci.version == 3 and ci.fmt == CheckpointFormat.CLASSIC
+    ci = CheckpointInstance.parse(fn.checkpoint_file_with_parts(LOG, 3, 4)[1])
+    assert ci.fmt == CheckpointFormat.MULTIPART and ci.part == 2 and ci.num_parts == 4
+    ci = CheckpointInstance.parse(fn.top_level_v2_checkpoint_file(LOG, 3, "parquet", uuid="u1"))
+    assert ci.fmt == CheckpointFormat.V2_PARQUET and ci.uuid == "u1"
+    assert CheckpointInstance.parse(f"{LOG}/foo.json") is None
+
+
+def test_group_complete_checkpoints():
+    c3 = CheckpointInstance.parse(fn.checkpoint_file_singular(LOG, 3))
+    mp = [CheckpointInstance.parse(p) for p in fn.checkpoint_file_with_parts(LOG, 5, 2)]
+    incomplete = CheckpointInstance.parse(fn.checkpoint_file_with_parts(LOG, 7, 3)[0])
+    groups = group_complete_checkpoints([c3, *mp, incomplete])
+    assert [g[0].version for g in groups] == [3, 5]
+    assert len(groups[1]) == 2

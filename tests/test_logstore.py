@@ -1,0 +1,88 @@
+import threading
+
+import pytest
+
+from delta_tpu.storage.logstore import (
+    FaultInjectingLogStore,
+    InMemoryLogStore,
+    LocalLogStore,
+    logstore_for_path,
+)
+
+
+@pytest.fixture(params=["local", "memory"])
+def store_and_root(request, tmp_path):
+    if request.param == "local":
+        return LocalLogStore(), str(tmp_path)
+    return InMemoryLogStore(), "memory://ns/root"
+
+
+def test_put_if_absent(store_and_root):
+    store, root = store_and_root
+    p = f"{root}/d/file.json"
+    store.write(p, b"one")
+    assert store.read(p) == b"one"
+    with pytest.raises(FileExistsError):
+        store.write(p, b"two")
+    assert store.read(p) == b"one"
+    store.write(p, b"three", overwrite=True)
+    assert store.read(p) == b"three"
+
+
+def test_put_if_absent_race(store_and_root):
+    """Exactly one of N concurrent writers must win."""
+    store, root = store_and_root
+    p = f"{root}/race/commit.json"
+    wins, errs = [], []
+    barrier = threading.Barrier(8)
+
+    def attempt(i):
+        barrier.wait()
+        try:
+            store.write(p, f"writer-{i}".encode())
+            wins.append(i)
+        except FileExistsError:
+            errs.append(i)
+
+    threads = [threading.Thread(target=attempt, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert len(errs) == 7
+    assert store.read(p) == f"writer-{wins[0]}".encode()
+
+
+def test_list_from_ordering(store_and_root):
+    store, root = store_and_root
+    names = ["00000000000000000002.json", "00000000000000000010.json",
+             "00000000000000000001.json"]
+    for n in names:
+        store.write(f"{root}/log/{n}", b"x")
+    listed = [f.path.rsplit("/", 1)[-1] for f in store.list_from(f"{root}/log/00000000000000000002.json")]
+    assert listed == ["00000000000000000002.json", "00000000000000000010.json"]
+
+
+def test_list_from_missing_parent(store_and_root):
+    store, root = store_and_root
+    with pytest.raises(FileNotFoundError):
+        list(store.list_from(f"{root}/nope/x"))
+
+
+def test_fault_injection():
+    inner = InMemoryLogStore()
+    store = FaultInjectingLogStore(inner)
+    store.fail_writes(lambda p: p.endswith("1.json"), once=True)
+    with pytest.raises(IOError):
+        store.write("memory://x/1.json", b"a")
+    store.write("memory://x/1.json", b"a")  # once=True: second attempt fine
+    assert store.write_log.count("memory://x/1.json") == 2
+
+
+def test_scheme_resolution(tmp_path):
+    assert isinstance(logstore_for_path(str(tmp_path / "f")), LocalLogStore)
+    m1 = logstore_for_path("memory://a/x")
+    m2 = logstore_for_path("memory://a/y")
+    m3 = logstore_for_path("memory://b/x")
+    assert m1 is m2 and m1 is not m3
