@@ -1,0 +1,117 @@
+"""Benchmark: snapshot state reconstruction throughput (files/sec).
+
+North star (BASELINE.md): replay of AddFile/RemoveFile actions into the
+live-file set. Baseline = the reference algorithm (sequential hash-map
+last-wins replay, `InMemoryLogReplay.scala:52` semantics) run on the host
+CPU; measured = the TPU sort + segmented-reduce kernel on the real chip
+(including host↔device transfer of the key columns).
+
+Prints ONE JSON line:
+  {"metric": "replay_files_per_sec", "value": ..., "unit": "actions/s",
+   "vs_baseline": ...}
+
+Env knobs: BENCH_ACTIONS (default 2_000_000), BENCH_REPEATS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def synth_history(n_actions: int, seed: int = 0):
+    """Synthetic log history: ~85% adds over a large key space, 15%
+    removes of earlier keys, spread over n_actions/100 commits."""
+    rng = np.random.default_rng(seed)
+    n_keys = max(2, int(n_actions * 0.7))
+    pk = rng.integers(0, n_keys, n_actions).astype(np.uint32)
+    dk = np.zeros(n_actions, dtype=np.uint32)
+    dv_rows = rng.random(n_actions) < 0.02
+    dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
+    n_commits = max(2, n_actions // 100)
+    ver = np.sort(rng.integers(0, n_commits, n_actions)).astype(np.int32)
+    order = np.zeros(n_actions, np.int32)
+    # order within version: positions of each row inside its commit
+    change = np.nonzero(np.diff(ver))[0] + 1
+    starts = np.concatenate([[0], change])
+    lens = np.diff(np.concatenate([starts, [n_actions]]))
+    order = (np.arange(n_actions) - np.repeat(starts, lens)).astype(np.int32)
+    is_add = rng.random(n_actions) < 0.85
+    size = rng.integers(1 << 20, 1 << 28, n_actions).astype(np.int64)
+    return pk, dk, ver, order, is_add, size
+
+
+def bench_host(pk, dk, ver, order, is_add) -> float:
+    """Sequential reference replay; returns seconds."""
+    t0 = time.perf_counter()
+    winner = {}
+    # rows are already version-sorted (synth_history) and order-increasing
+    # within version, so a single pass IS the chronological replay
+    pk_l = pk.tolist()
+    dk_l = dk.tolist()
+    add_l = is_add.tolist()
+    for i in range(len(pk_l)):
+        winner[(pk_l[i], dk_l[i])] = i
+    live = 0
+    for i in winner.values():
+        if add_l[i]:
+            live += 1
+    dt = time.perf_counter() - t0
+    print(f"host replay: {dt:.3f}s, live={live}", file=sys.stderr)
+    return dt
+
+
+def bench_device(pk, dk, ver, order, is_add, repeats: int) -> float:
+    from delta_tpu.ops.replay import replay_select
+
+    # warmup/compile
+    replay_select([pk[:1024], dk[:1024]], ver[:1024], order[:1024], is_add[:1024])
+    times = []
+    live = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        live_mask, _ = replay_select([pk, dk], ver, order, is_add)
+        times.append(time.perf_counter() - t0)
+        live = int(live_mask.sum())
+    dt = float(np.median(times))
+    print(f"device replay: {dt:.3f}s (runs {['%.3f' % t for t in times]}), live={live}",
+          file=sys.stderr)
+    return dt
+
+
+def main():
+    n = int(os.environ.get("BENCH_ACTIONS", 2_000_000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    pk, dk, ver, order, is_add, size = synth_history(n)
+
+    host_s = bench_host(pk, dk, ver, order, is_add)
+    dev_s = bench_device(pk, dk, ver, order, is_add, repeats)
+
+    host_rate = n / host_s
+    dev_rate = n / dev_s
+    print(
+        f"host: {host_rate:,.0f} actions/s   device: {dev_rate:,.0f} actions/s   "
+        f"speedup: {dev_rate / host_rate:.2f}x",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "replay_files_per_sec",
+                "value": round(dev_rate, 1),
+                "unit": "actions/s",
+                "vs_baseline": round(dev_rate / host_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+from delta_tpu.parallel.mesh import make_mesh, replay_mesh_axis
+from delta_tpu.parallel.sharded_replay import sharded_replay_select, sharded_replay_step
+
+__all__ = [
+    "make_mesh",
+    "replay_mesh_axis",
+    "sharded_replay_select",
+    "sharded_replay_step",
+]

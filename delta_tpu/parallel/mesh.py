@@ -1,0 +1,34 @@
+"""Device meshes for sharded state reconstruction.
+
+The reference distributes replay by `repartition(N, hash(path))` across
+Spark executors (`Snapshot.scala:481`). Here the same idea is a
+`jax.sharding.Mesh`: rows are routed to shards by path-key, each device
+sorts/reduces its shard locally (no cross-device dedup is ever needed —
+the key fully determines the shard), and only scalar aggregates cross the
+ICI via psum. Multi-host: the same mesh spans processes; shard routing is
+identical because the key hash is global.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+REPLAY_AXIS = "shard"
+
+
+def replay_mesh_axis() -> str:
+    return REPLAY_AXIS
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the fastest interconnect ordering of the available
+    devices. `n_devices` trims (useful for tests)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (REPLAY_AXIS,))

@@ -1,22 +1,34 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax loads.
+"""Test bootstrap: force an 8-device virtual CPU mesh.
 
-Tests exercise the multi-chip sharding paths on virtual devices (the
-driver validates the real thing via __graft_entry__.dryrun_multichip);
+Tests exercise the multi-chip sharding paths on virtual CPU devices (the
+driver separately validates multi-chip via __graft_entry__.dryrun_multichip);
 bench.py runs unforced on the real TPU chip.
+
+Note: some environments (axon) import and configure jax at interpreter
+startup via sitecustomize — env vars alone are too late, so we override
+`jax_platforms` through jax.config and set XLA_FLAGS before the first
+backend initialization (backends init lazily at first use).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pyarrow as pa  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.devices()}"
 
 
 @pytest.fixture

@@ -1,0 +1,85 @@
+"""Multi-device sharded replay over the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from delta_tpu.ops.replay import python_replay_reference
+from delta_tpu.parallel import make_mesh, sharded_replay_select
+from delta_tpu.parallel.sharded_replay import build_sharded_replay_fn, route_to_shards
+
+
+def _history(rng, n, n_keys, n_versions):
+    pk = rng.integers(0, n_keys, n).astype(np.uint32)
+    dk = rng.integers(0, 2, n).astype(np.uint32)
+    ver = np.sort(rng.integers(0, n_versions, n)).astype(np.int32)
+    order = np.zeros(n, np.int32)
+    for v in np.unique(ver):
+        s = ver == v
+        order[s] = np.arange(s.sum())
+    add = rng.random(n) < 0.6
+    size = rng.integers(100, 10_000, n).astype(np.int64)
+    return pk, dk, ver, order, add, size
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+
+
+@pytest.mark.parametrize("n", [10, 1000, 30_000])
+def test_sharded_matches_reference(n):
+    rng = np.random.default_rng(n)
+    pk, dk, ver, order, add, size = _history(rng, n, max(2, n // 4), max(2, n // 8))
+    mesh = make_mesh()
+    live, tomb, num_live, _ = sharded_replay_select(pk, dk, ver, order, add, size, mesh)
+    live_h, tomb_h = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, add
+    )
+    np.testing.assert_array_equal(live, live_h)
+    np.testing.assert_array_equal(tomb, tomb_h)
+    assert num_live == int(live_h.sum())
+
+
+def test_sharded_on_subset_mesh():
+    rng = np.random.default_rng(3)
+    pk, dk, ver, order, add, size = _history(rng, 5000, 700, 50)
+    for nd in (1, 2, 4):
+        mesh = make_mesh(n_devices=nd)
+        live, tomb, num_live, _ = sharded_replay_select(pk, dk, ver, order, add, size, mesh)
+        live_h, _ = python_replay_reference(
+            list(zip(pk.tolist(), dk.tolist())), ver, order, add
+        )
+        np.testing.assert_array_equal(live, live_h)
+
+
+def test_routing_is_key_complete():
+    """Every row lands in exactly one shard; all rows of a key share it."""
+    rng = np.random.default_rng(5)
+    pk, dk, ver, order, add, size = _history(rng, 2000, 97, 20)
+    ops, scatter = route_to_shards(pk, dk, ver, order, add, size, 8)
+    flat = scatter.ravel()
+    placed = np.sort(flat[flat >= 0])
+    np.testing.assert_array_equal(placed, np.arange(len(pk)))
+    k0 = ops[0]
+    for s in range(8):
+        keys_here = k0[s][k0[s] != 0xFFFFFFFF]
+        assert np.all(keys_here % 8 == s)
+
+
+def test_step_fn_compiles_with_shardings():
+    """The jitted sharded step lowers and runs with explicit NamedSharding
+    inputs (what dryrun_multichip exercises)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    fn = build_sharded_replay_fn(mesh)
+    rng = np.random.default_rng(11)
+    pk, dk, ver, order, add, size = _history(rng, 4000, 300, 16)
+    ops, _ = route_to_shards(pk, dk, ver, order, add, size, 8)
+    spec = NamedSharding(mesh, P("shard", None))
+    device_ops = tuple(jax.device_put(o, spec) for o in ops)
+    live, tomb, num_live, live_bytes = fn(*device_ops)
+    assert live.shape == ops[0].shape
+    assert int(num_live) > 0
