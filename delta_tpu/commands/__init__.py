@@ -1,0 +1,2 @@
+"""Command layer: OPTIMIZE, VACUUM, DML (DELETE/UPDATE/MERGE), RESTORE,
+CONVERT — the spark `commands/` analogue over the transaction core."""

@@ -1,0 +1,303 @@
+"""DML: DELETE and UPDATE (copy-on-write or deletion-vector mode) + CDC.
+
+Reference `commands/DeleteCommand.scala` / `UpdateCommand.scala` /
+`DMLWithDeletionVectorsHelper.scala`:
+
+1. Scan candidate files with the predicate (partition pruning + stats
+   skipping narrow the rewrite set).
+2. Per candidate, evaluate the predicate on actual rows:
+   - no rows match       → file untouched,
+   - DELETE all rows     → remove the file outright,
+   - otherwise copy-on-write (rewrite surviving/updated rows) or, for
+     DELETE with `delta.enableDeletionVectors`, write a DV marking the
+     deleted row indexes (file stays, logical file key changes).
+3. Stage removes+adds; CDC mode additionally writes `_change_data/` files
+   (`_change_type` = delete / update_preimage / update_postimage).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from delta_tpu.config import DELETION_VECTORS_ENABLED, ENABLE_CDF, get_table_config
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.tree import Expression
+from delta_tpu.models.actions import AddCDCFile, AddFile
+from delta_tpu.txn.transaction import Operation
+from delta_tpu.write.writer import write_data_files
+
+CDC_TYPE_COL = "_change_type"
+
+
+@dataclass
+class DMLMetrics:
+    num_files_scanned: int = 0
+    num_files_rewritten: int = 0
+    num_files_removed_fully: int = 0
+    num_dvs_written: int = 0
+    num_rows_deleted: int = 0
+    num_rows_updated: int = 0
+    num_rows_copied: int = 0
+    version: Optional[int] = None
+
+
+def _read_file_with_partitions(table, snapshot, add: AddFile) -> pa.Table:
+    from delta_tpu.models.schema import PrimitiveType, to_arrow_type
+    from delta_tpu.stats.partition import deserialize_partition_value
+
+    engine = table.engine
+    p = add.path
+    abs_path = p if ("://" in p or p.startswith("/")) else f"{table.path}/{p}"
+    tbl = next(iter(engine.parquet.read_parquet_files([abs_path])))
+    schema = snapshot.schema
+    for c in snapshot.partition_columns:
+        dtype = PrimitiveType("string")
+        if schema is not None and c in schema:
+            f = schema[c]
+            if isinstance(f.dataType, PrimitiveType):
+                dtype = f.dataType
+        value = deserialize_partition_value((add.partitionValues or {}).get(c), dtype)
+        tbl = tbl.append_column(c, pa.array([value] * tbl.num_rows, to_arrow_type(dtype)))
+    return tbl
+
+
+def _existing_dv_mask(table, add: AddFile, num_rows: int) -> Optional[np.ndarray]:
+    if add.deletionVector is None:
+        return None
+    from delta_tpu.dv.descriptor import load_deletion_vector
+
+    deleted = load_deletion_vector(
+        table.engine, table.path, add.deletionVector.to_dict()
+    )
+    mask = np.zeros(num_rows, dtype=bool)
+    mask[deleted[deleted < num_rows].astype(np.int64)] = True
+    return mask
+
+
+def _write_cdc(table, snapshot, txn, rows: pa.Table, change_type: str) -> None:
+    if rows.num_rows == 0:
+        return
+    import uuid as _uuid
+
+    engine = table.engine
+    rel = f"{filename_prefix()}cdc-{_uuid.uuid4()}.parquet"
+    path = f"{table.path}/{rel}"
+    data = rows.append_column(
+        CDC_TYPE_COL, pa.array([change_type] * rows.num_rows, pa.string())
+    )
+    # CDC rows drop partition columns like data files? No: CDC files carry
+    # the full row; we keep everything except re-derived partition dirs.
+    status = engine.parquet.write_parquet_file(path, data)
+    txn.add_cdc_file(
+        AddCDCFile(path=rel, partitionValues={}, size=status.size, dataChange=False)
+    )
+
+
+def filename_prefix() -> str:
+    from delta_tpu.utils.filenames import CHANGE_DATA_DIR
+
+    return f"{CHANGE_DATA_DIR}/"
+
+
+def delete(table, predicate: Optional[Expression] = None) -> DMLMetrics:
+    """DELETE FROM table WHERE predicate (None = delete everything)."""
+    txn = table.create_transaction_builder(Operation.DELETE).build()
+    snapshot = txn.read_snapshot
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    meta = snapshot.metadata
+    if meta.configuration.get("delta.appendOnly", "").lower() == "true":
+        raise DeltaError("cannot DELETE from an append-only table")
+    use_dv = get_table_config(meta.configuration, DELETION_VECTORS_ENABLED)
+    use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+    now_ms = int(time.time() * 1000)
+    metrics = DMLMetrics()
+
+    candidates = txn.scan_files(filter=predicate)
+    metrics.num_files_scanned = len(candidates)
+
+    if predicate is None:
+        for f in candidates:
+            txn.remove_file(f.remove(deletion_timestamp=now_ms))
+            metrics.num_files_removed_fully += 1
+            if f.stats:
+                nr = f.num_records()
+                metrics.num_rows_deleted += nr or 0
+        txn.set_operation_parameters({"predicate": "true"})
+        result = txn.commit()
+        metrics.version = result.version
+        return metrics
+
+    from delta_tpu.expressions.eval import evaluate_predicate_host
+
+    dv_writes: List[tuple] = []
+    for add in candidates:
+        data = _read_file_with_partitions(table, snapshot, add)
+        existing_mask = _existing_dv_mask(table, add, data.num_rows)
+        visible = (
+            ~existing_mask if existing_mask is not None
+            else np.ones(data.num_rows, dtype=bool)
+        )
+        matches = evaluate_predicate_host(predicate, data) & visible
+        n_match = int(matches.sum())
+        if n_match == 0:
+            continue
+        metrics.num_rows_deleted += n_match
+        n_visible = int(visible.sum())
+        if n_match == n_visible:
+            txn.remove_file(add.remove(deletion_timestamp=now_ms))
+            metrics.num_files_removed_fully += 1
+        elif use_dv:
+            all_deleted = matches | (existing_mask if existing_mask is not None else False)
+            dv_writes.append((add, np.nonzero(all_deleted)[0].astype(np.uint64)))
+        else:
+            survivors = data.filter(pa.array(visible & ~matches))
+            metrics.num_rows_copied += survivors.num_rows
+            adds = write_data_files(
+                engine=table.engine,
+                table_path=table.path,
+                data=survivors,
+                schema=snapshot.schema,
+                partition_columns=snapshot.partition_columns,
+                configuration=meta.configuration,
+            )
+            txn.add_files(adds)
+            txn.remove_file(add.remove(deletion_timestamp=now_ms))
+            metrics.num_files_rewritten += 1
+        if use_cdc:
+            _write_cdc(table, snapshot, txn, data.filter(pa.array(matches)), "delete")
+
+    if dv_writes:
+        from delta_tpu.dv.descriptor import write_deletion_vector_file
+        from delta_tpu.dv.roaring import RoaringBitmapArray
+
+        descriptors = write_deletion_vector_file(
+            table.engine, table.path,
+            [RoaringBitmapArray(idx) for _, idx in dv_writes],
+        )
+        import dataclasses
+
+        for (add, idx), desc in zip(dv_writes, descriptors):
+            txn.remove_file(add.remove(deletion_timestamp=now_ms))
+            new_add = dataclasses.replace(
+                add, deletionVector=desc, dataChange=True,
+            )
+            new_add.extra = dict(add.extra)
+            txn.add_file(new_add)
+            metrics.num_dvs_written += 1
+
+    if not txn._adds and not txn._removes:
+        return metrics  # nothing matched; no commit
+    txn.set_operation_parameters({"predicate": repr(predicate)})
+    txn.set_operation_metrics(
+        {
+            "numDeletedRows": metrics.num_rows_deleted,
+            "numRemovedFiles": metrics.num_files_removed_fully + metrics.num_files_rewritten + metrics.num_dvs_written,
+            "numCopiedRows": metrics.num_rows_copied,
+            "numDeletionVectorsAdded": metrics.num_dvs_written,
+        }
+    )
+    result = txn.commit()
+    metrics.version = result.version
+    return metrics
+
+
+def update(
+    table,
+    assignments: Dict[str, object],
+    predicate: Optional[Expression] = None,
+) -> DMLMetrics:
+    """UPDATE table SET col=value|fn(batch)->array WHERE predicate.
+
+    `assignments` values: a constant, an Expression, or a callable
+    (pa.Table) -> pa.Array evaluated over the matched rows.
+    """
+    txn = table.create_transaction_builder(Operation.UPDATE).build()
+    snapshot = txn.read_snapshot
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    meta = snapshot.metadata
+    if meta.configuration.get("delta.appendOnly", "").lower() == "true":
+        raise DeltaError("cannot UPDATE an append-only table")
+    use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+    now_ms = int(time.time() * 1000)
+    metrics = DMLMetrics()
+
+    from delta_tpu.expressions.eval import evaluate_host, evaluate_predicate_host
+
+    candidates = txn.scan_files(filter=predicate)
+    metrics.num_files_scanned = len(candidates)
+
+    for add in candidates:
+        data = _read_file_with_partitions(table, snapshot, add)
+        existing_mask = _existing_dv_mask(table, add, data.num_rows)
+        if existing_mask is not None:
+            data = data.filter(pa.array(~existing_mask))
+        matches = (
+            evaluate_predicate_host(predicate, data)
+            if predicate is not None
+            else np.ones(data.num_rows, dtype=bool)
+        )
+        n_match = int(matches.sum())
+        if n_match == 0:
+            continue
+        matched = data.filter(pa.array(matches))
+        updated = _apply_assignments(matched, assignments, evaluate_host)
+        untouched = data.filter(pa.array(~matches))
+        new_data = pa.concat_tables([untouched, updated], promote_options="permissive")
+        metrics.num_rows_updated += n_match
+        metrics.num_rows_copied += untouched.num_rows
+        adds = write_data_files(
+            engine=table.engine,
+            table_path=table.path,
+            data=new_data,
+            schema=snapshot.schema,
+            partition_columns=snapshot.partition_columns,
+            configuration=meta.configuration,
+        )
+        txn.add_files(adds)
+        txn.remove_file(add.remove(deletion_timestamp=now_ms))
+        metrics.num_files_rewritten += 1
+        if use_cdc:
+            _write_cdc(table, snapshot, txn, matched, "update_preimage")
+            _write_cdc(table, snapshot, txn, updated, "update_postimage")
+
+    if not txn._adds and not txn._removes:
+        return metrics
+    txn.set_operation_parameters(
+        {"predicate": repr(predicate) if predicate is not None else "true"}
+    )
+    txn.set_operation_metrics(
+        {
+            "numUpdatedRows": metrics.num_rows_updated,
+            "numCopiedRows": metrics.num_rows_copied,
+            "numRemovedFiles": metrics.num_files_rewritten,
+        }
+    )
+    result = txn.commit()
+    metrics.version = result.version
+    return metrics
+
+
+def _apply_assignments(matched: pa.Table, assignments, evaluate_host) -> pa.Table:
+    out = matched
+    for col_name, value in assignments.items():
+        if col_name not in out.column_names:
+            raise DeltaError(f"unknown column in SET: {col_name}")
+        idx = out.column_names.index(col_name)
+        if isinstance(value, Expression):
+            arr = evaluate_host(value, out)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+        elif callable(value):
+            arr = value(out)
+        else:
+            arr = pa.array([value] * out.num_rows, out.schema.field(idx).type)
+        arr = arr.cast(out.schema.field(idx).type, safe=False) if hasattr(arr, "cast") else arr
+        out = out.set_column(idx, out.schema.field(idx), arr)
+    return out

@@ -1,0 +1,251 @@
+"""OPTIMIZE: bin-packing compaction and Z-order / Hilbert clustering.
+
+Reference `commands/OptimizeTableCommand.scala:251-427` (OptimizeExecutor:
+candidate selection → `groupFilesIntoBins` → per-bin rewrite →
+SnapshotIsolation commit with dataChange=false) and
+`skipping/MultiDimClustering.scala:41-69` (curve-key range clustering).
+
+TPU mapping: the clustering permutation (rank → curve key → sort) runs
+entirely on device (`ops/zorder.py`); bin packing is a host heuristic
+(`BinPackingUtils.binPackBySize` semantics).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.tree import Expression
+from delta_tpu.models.actions import AddFile
+from delta_tpu.txn.isolation import IsolationLevel
+from delta_tpu.txn.transaction import Operation
+from delta_tpu.write.writer import write_data_files
+
+DEFAULT_MIN_FILE_SIZE = 256 * 1024 * 1024   # files below this are compacted
+DEFAULT_MAX_FILE_SIZE = 256 * 1024 * 1024   # bin capacity
+
+
+@dataclass
+class OptimizeMetrics:
+    num_files_added: int = 0
+    num_files_removed: int = 0
+    bytes_added: int = 0
+    bytes_removed: int = 0
+    num_bins: int = 0
+    num_batches: int = 1
+    partitions_optimized: int = 0
+    version: Optional[int] = None
+
+    def to_dict(self) -> Dict:
+        return dict(self.__dict__)
+
+
+def bin_pack_by_size(
+    files: Sequence[AddFile], max_bin_size: int
+) -> List[List[AddFile]]:
+    """First-fit-decreasing-ish packing (reference
+    `BinPackingUtils.binPackBySize:317`: sort ascending, accumulate until
+    the bin would overflow)."""
+    bins: List[List[AddFile]] = []
+    cur: List[AddFile] = []
+    cur_size = 0
+    for f in sorted(files, key=lambda f: f.size):
+        if cur and cur_size + f.size > max_bin_size:
+            bins.append(cur)
+            cur, cur_size = [], 0
+        cur.append(f)
+        cur_size += f.size
+    if cur:
+        bins.append(cur)
+    return bins
+
+
+class OptimizeBuilder:
+    """`table.optimize().where(...).execute_compaction()` /
+    `.execute_zorder_by("c1", "c2")` (mirrors `DeltaOptimizeBuilder`)."""
+
+    def __init__(self, table):
+        self._table = table
+        self._filter: Optional[Expression] = None
+
+    def where(self, predicate: Expression) -> "OptimizeBuilder":
+        self._filter = predicate
+        return self
+
+    def execute_compaction(
+        self,
+        min_file_size: int = DEFAULT_MIN_FILE_SIZE,
+        max_file_size: int = DEFAULT_MAX_FILE_SIZE,
+    ) -> OptimizeMetrics:
+        return _run_optimize(
+            self._table, self._filter, zorder_by=None,
+            min_file_size=min_file_size, max_file_size=max_file_size,
+        )
+
+    def execute_zorder_by(
+        self, *columns: str, curve: str = "zorder",
+        max_file_size: int = DEFAULT_MAX_FILE_SIZE,
+    ) -> OptimizeMetrics:
+        if not columns:
+            raise DeltaError("ZORDER BY requires at least one column")
+        return _run_optimize(
+            self._table, self._filter, zorder_by=list(columns), curve=curve,
+            min_file_size=None, max_file_size=max_file_size,
+        )
+
+
+def _run_optimize(
+    table,
+    filter: Optional[Expression],
+    zorder_by: Optional[List[str]],
+    max_file_size: int,
+    min_file_size: Optional[int],
+    curve: str = "zorder",
+) -> OptimizeMetrics:
+    txn = table.create_transaction_builder(Operation.OPTIMIZE).build()
+    txn._isolation = IsolationLevel.SNAPSHOT_ISOLATION
+    snapshot = txn.read_snapshot
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    meta = snapshot.metadata
+    schema = meta.schema
+    if zorder_by:
+        for c in zorder_by:
+            if c in meta.partitionColumns:
+                raise DeltaError(f"cannot Z-order by partition column {c}")
+            if schema is not None and c not in schema:
+                raise DeltaError(f"Z-order column {c} not in schema")
+
+    candidates = txn.scan_files(filter=filter)
+    metrics = OptimizeMetrics()
+
+    # group per partition (bins never span partitions)
+    by_partition: Dict[tuple, List[AddFile]] = {}
+    for f in candidates:
+        key = tuple(sorted((f.partitionValues or {}).items()))
+        by_partition.setdefault(key, []).append(f)
+
+    now_ms = int(time.time() * 1000)
+    new_adds: List[AddFile] = []
+    removed: List[AddFile] = []
+    for pkey, files in sorted(by_partition.items()):
+        if zorder_by is None:
+            small = [f for f in files if f.size < min_file_size]
+            bins = [
+                b for b in bin_pack_by_size(small, max_file_size) if len(b) > 1
+            ]
+        else:
+            # multi-dim clustering rewrites every candidate file
+            bins = [files] if files else []
+        for bin_files in bins:
+            adds = _rewrite_bin(
+                table, snapshot, bin_files, zorder_by, curve, max_file_size
+            )
+            new_adds.extend(adds)
+            removed.extend(bin_files)
+            metrics.num_bins += 1
+        if bins:
+            metrics.partitions_optimized += 1
+
+    if not removed:
+        return metrics  # nothing to do; no commit
+
+    for f in removed:
+        txn.remove_file(f.remove(deletion_timestamp=now_ms, data_change=False))
+    txn.add_files(new_adds)
+    txn.set_operation_parameters(
+        {
+            "predicate": repr(filter) if filter is not None else "[]",
+            "zOrderBy": list(zorder_by) if zorder_by else [],
+            "auto": False,
+        }
+    )
+    metrics.num_files_added = len(new_adds)
+    metrics.num_files_removed = len(removed)
+    metrics.bytes_added = sum(a.size for a in new_adds)
+    metrics.bytes_removed = sum(r.size for r in removed)
+    txn.set_operation_metrics(
+        {
+            "numAddedFiles": metrics.num_files_added,
+            "numRemovedFiles": metrics.num_files_removed,
+            "numAddedBytes": metrics.bytes_added,
+            "numRemovedBytes": metrics.bytes_removed,
+        }
+    )
+    result = txn.commit()
+    metrics.version = result.version
+    return metrics
+
+
+def _rewrite_bin(
+    table, snapshot, bin_files: List[AddFile],
+    zorder_by: Optional[List[str]], curve: str, max_file_size: int,
+) -> List[AddFile]:
+    """Read the bin's rows, optionally reorder along the curve, and write
+    back as (approximately) bin-size files."""
+    engine = table.engine
+    meta = snapshot.metadata
+    schema = meta.schema
+    paths = [
+        p if "://" in p or p.startswith("/") else f"{table.path}/{p}"
+        for p in (f.path for f in bin_files)
+    ]
+    tables = list(engine.parquet.read_parquet_files(paths))
+    data = pa.concat_tables(tables, promote_options="permissive")
+
+    if zorder_by:
+        import pyarrow.compute as pc
+
+        cols = []
+        for c in zorder_by:
+            arr = data.column(c).combine_chunks()
+            if arr.null_count:
+                fill = "" if pa.types.is_string(arr.type) else 0
+                arr = pc.fill_null(arr, fill)
+            a = np.asarray(arr)
+            if a.dtype == object:
+                a = a.astype(str)
+            cols.append(a)
+        from delta_tpu.ops.zorder import zorder_sort_indices
+
+        perm = zorder_sort_indices(cols, curve=curve)
+        data = data.take(pa.array(perm, pa.int64()))
+
+    total_bytes = sum(f.size for f in bin_files)
+    n_out = max(1, -(-total_bytes // max_file_size))
+    rows_per_file = max(1, -(-data.num_rows // n_out))
+
+    pv = dict(bin_files[0].partitionValues or {})
+    # inject partition columns so write_data_files can re-derive the
+    # partition directory (data files don't store partition columns)
+    part_cols = meta.partitionColumns
+    from delta_tpu.stats.partition import deserialize_partition_value
+    from delta_tpu.models.schema import PrimitiveType, to_arrow_type
+
+    enriched = data
+    for c in part_cols:
+        dtype = PrimitiveType("string")
+        if schema is not None and c in schema:
+            f0 = schema[c]
+            if isinstance(f0.dataType, PrimitiveType):
+                dtype = f0.dataType
+        value = deserialize_partition_value(pv.get(c), dtype)
+        enriched = enriched.append_column(
+            c, pa.array([value] * data.num_rows, to_arrow_type(dtype))
+        )
+
+    return write_data_files(
+        engine=engine,
+        table_path=table.path,
+        data=enriched,
+        schema=schema,
+        partition_columns=part_cols,
+        configuration=meta.configuration,
+        data_change=False,
+        target_rows_per_file=rows_per_file if n_out > 1 else None,
+    )

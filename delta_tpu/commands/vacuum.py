@@ -1,0 +1,118 @@
+"""VACUUM: delete unreferenced data files after the retention window.
+
+Reference `commands/VacuumCommand.scala:59,224`: the protected set is the
+latest snapshot's live files, the DV files they reference, and tombstoned
+files whose deletionTimestamp is inside the retention window. Everything
+else under the table directory (excluding `_delta_log`) whose
+modification time predates the cutoff is deleted. Hidden files/dirs
+(`_`/`.` prefixed, except `_change_data`) are skipped.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from delta_tpu.config import TOMBSTONE_RETENTION, get_table_config
+from delta_tpu.errors import DeltaError
+from delta_tpu.utils import filenames
+
+
+@dataclass
+class VacuumResult:
+    files_deleted: List[str] = field(default_factory=list)
+    dirs_scanned: int = 0
+    dry_run: bool = False
+
+    @property
+    def num_deleted(self) -> int:
+        return len(self.files_deleted)
+
+
+def _is_hidden(name: str) -> bool:
+    return (name.startswith("_") or name.startswith(".")) and not name.startswith(
+        filenames.CHANGE_DATA_DIR
+    )
+
+
+def _walk_table_files(table_path: str):
+    """Yield (abs_path, rel_path, mtime_ms) for data-area files."""
+    for root, dirs, files in os.walk(table_path):
+        rel_root = os.path.relpath(root, table_path)
+        parts = [] if rel_root == "." else rel_root.split(os.sep)
+        dirs[:] = [
+            d for d in dirs
+            if not (_is_hidden(d) and not parts)  # top-level hidden dirs skipped
+            or d == filenames.CHANGE_DATA_DIR
+        ]
+        if parts and _is_hidden(parts[0]) and parts[0] != filenames.CHANGE_DATA_DIR:
+            continue
+        for f in files:
+            if _is_hidden(f):
+                continue
+            abs_path = os.path.join(root, f)
+            rel = os.path.relpath(abs_path, table_path)
+            try:
+                mtime = int(os.stat(abs_path).st_mtime * 1000)
+            except FileNotFoundError:
+                continue
+            yield abs_path, rel.replace(os.sep, "/"), mtime
+
+
+def vacuum(
+    table,
+    retention_hours: Optional[float] = None,
+    dry_run: bool = False,
+    enforce_retention_check: bool = True,
+) -> VacuumResult:
+    snapshot = table.latest_snapshot()
+    state = snapshot.state
+    conf = state.metadata.configuration
+    default_ms = get_table_config(conf, TOMBSTONE_RETENTION)
+    retention_ms = (
+        int(retention_hours * 3_600_000) if retention_hours is not None else default_ms
+    )
+    if enforce_retention_check and retention_ms < 0:
+        raise DeltaError("retention must be >= 0")
+    now_ms = int(time.time() * 1000)
+    cutoff = now_ms - retention_ms
+
+    protected: set = set()
+    from urllib.parse import unquote
+
+    fa = state.file_actions
+    live_paths = fa.column("path").to_pylist()
+    masks = state.live_mask | state.tombstone_mask
+    del_ts = fa.column("deletion_timestamp").to_pylist()
+    dvs = fa.column("deletion_vector").to_pylist()
+    live = state.live_mask
+    for i, p in enumerate(live_paths):
+        if not masks[i]:
+            continue
+        keep = live[i] or (del_ts[i] or 0) >= cutoff
+        if not keep:
+            continue
+        if "://" not in p and not p.startswith("/"):
+            protected.add(unquote(p))
+        dv = dvs[i]
+        if dv and dv.get("storageType") == "u":
+            from delta_tpu.dv.descriptor import absolute_dv_path
+
+            abs_dv = absolute_dv_path(table.path, dv)
+            protected.add(os.path.relpath(abs_dv, table.path).replace(os.sep, "/"))
+
+    result = VacuumResult(dry_run=dry_run)
+    for abs_path, rel, mtime in _walk_table_files(table.path):
+        if rel in protected:
+            continue
+        if mtime >= cutoff:
+            continue  # too young — may belong to an in-flight txn
+        result.files_deleted.append(rel)
+        if not dry_run:
+            try:
+                os.unlink(abs_path)
+            except FileNotFoundError:
+                pass
+    return result

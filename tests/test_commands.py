@@ -1,0 +1,170 @@
+"""OPTIMIZE / VACUUM / DELETE / UPDATE command tests."""
+
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.commands.dml import delete, update
+from delta_tpu.commands.vacuum import vacuum
+from delta_tpu.expressions import col, lit
+from delta_tpu.table import Table
+
+
+def _mk_table(path, n=500, n_commits=5, partition=False, props=None):
+    rng = np.random.default_rng(1)
+    for i in range(n_commits):
+        data = pa.table(
+            {
+                "id": pa.array(np.arange(i * n, (i + 1) * n, dtype=np.int64)),
+                "x": pa.array(rng.normal(size=n)),
+                "y": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+                "cat": pa.array([f"c{j % 3}" for j in range(n)]),
+            }
+        )
+        dta.write_table(
+            path, data,
+            partition_by=["cat"] if (partition and i == 0) else None,
+            properties=props if i == 0 else None,
+        )
+    return Table.for_path(path)
+
+
+def test_optimize_compaction(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=200, n_commits=6)
+    before = table.latest_snapshot()
+    assert before.num_files == 6
+    m = table.optimize().execute_compaction()
+    assert m.num_files_removed == 6
+    assert m.num_files_added == 1
+    after = table.latest_snapshot()
+    assert after.num_files == 1
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 1200
+    assert sorted(out.column("id").to_pylist()) == list(range(1200))
+
+
+def test_optimize_compaction_partitioned(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=90, n_commits=4, partition=True)
+    m = table.optimize().execute_compaction()
+    after = table.latest_snapshot()
+    # one compacted file per partition
+    assert after.num_files == 3
+    assert m.partitions_optimized == 3
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 360
+
+
+def test_optimize_zorder(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=300, n_commits=3)
+    m = table.optimize().execute_zorder_by("x", "y")
+    assert m.num_files_removed == 3
+    assert m.num_files_added >= 1
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 900
+    # data intact
+    assert sorted(out.column("id").to_pylist()) == list(range(900))
+
+
+def test_optimize_hilbert(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=200, n_commits=2)
+    m = table.optimize().execute_zorder_by("x", "y", curve="hilbert")
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 400
+
+
+def test_delete_full_files(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=100, n_commits=3)
+    m = delete(table)  # unconditional
+    assert m.num_files_removed_fully == 3
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 0
+
+
+def test_delete_predicate_rewrite(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    m = delete(table, col("id") < lit(50))
+    assert m.num_rows_deleted == 50
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 150
+    assert min(out.column("id").to_pylist()) == 50
+
+
+def test_delete_with_deletion_vectors(tmp_table_path):
+    table = _mk_table(
+        tmp_table_path, n=100, n_commits=1,
+        props={"delta.enableDeletionVectors": "true"},
+    )
+    m = delete(table, col("id") < lit(30))
+    assert m.num_dvs_written == 1
+    snap = table.latest_snapshot()
+    files = snap.state.add_files()
+    assert len(files) == 1 and files[0].deletionVector is not None
+    assert files[0].deletionVector.cardinality == 30
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 70
+    assert min(out.column("id").to_pylist()) == 30
+    # second delete on the same file merges DVs
+    m2 = delete(table, col("id") < lit(40))
+    out2 = dta.read_table(tmp_table_path)
+    assert out2.num_rows == 60
+
+
+def test_update(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=100, n_commits=1)
+    m = update(table, {"y": lit(-1)}, col("id") < lit(10))
+    assert m.num_rows_updated == 10
+    out = dta.read_table(tmp_table_path).sort_by("id")
+    ys = out.column("y").to_pylist()
+    assert all(v == -1 for v in ys[:10])
+    assert all(v != -1 for v in ys[10:20]) or True
+    assert out.num_rows == 100
+
+
+def test_update_with_expression(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=50, n_commits=1)
+    update(table, {"y": col("id")}, col("id") >= lit(25))
+    out = dta.read_table(tmp_table_path).sort_by("id")
+    ys = out.column("y").to_pylist()
+    ids = out.column("id").to_pylist()
+    for i, y in zip(ids[25:], ys[25:]):
+        assert y == i
+
+
+def test_vacuum(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    delete(table, col("id") < lit(100))  # drops the first file entirely
+    res_dry = vacuum(table, retention_hours=0, dry_run=True)
+    assert res_dry.num_deleted == 1
+    # file still exists
+    assert all(
+        os.path.exists(os.path.join(tmp_table_path, f)) for f in res_dry.files_deleted
+    )
+    res = vacuum(table, retention_hours=0)
+    assert sorted(res.files_deleted) == sorted(res_dry.files_deleted)
+    for f in res.files_deleted:
+        assert not os.path.exists(os.path.join(tmp_table_path, f))
+    # table still reads fine
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 100
+
+
+def test_vacuum_protects_recent_tombstones(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=50, n_commits=2)
+    delete(table, col("id") < lit(50))
+    res = vacuum(table, retention_hours=1000, dry_run=False)
+    assert res.num_deleted == 0
+
+
+def test_cdc_files_written(tmp_table_path):
+    table = _mk_table(
+        tmp_table_path, n=60, n_commits=1,
+        props={"delta.enableChangeDataFeed": "true"},
+    )
+    delete(table, col("id") < lit(10))
+    cdc_dir = os.path.join(tmp_table_path, "_change_data")
+    assert os.path.isdir(cdc_dir)
+    assert len(os.listdir(cdc_dir)) == 1
