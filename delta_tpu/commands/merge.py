@@ -1,0 +1,498 @@
+"""MERGE INTO: upserts with matched / not-matched / not-matched-by-source
+clauses.
+
+Reference `commands/MergeIntoCommand.scala` + `commands/merge/
+ClassicMergeExecutor.scala`: find touched files via a join of the source
+against the target on the merge condition, rewrite those files applying
+clause actions row-wise (first matching clause wins), append inserts,
+enforce the at-most-one-source-match cardinality rule, emit CDC rows.
+
+API (mirrors `DeltaMergeBuilder`):
+
+    (MergeBuilder(table, source, on=(col("target.id") == col("source.id")))
+        .when_matched_update(set={"v": col("source.v")})
+        .when_matched_delete(condition=col("source.op") == lit("del"))
+        .when_not_matched_insert(values={"id": col("source.id"), ...})
+        .when_not_matched_by_source_delete()
+        .execute())
+
+Conditions and values are expressions over a namespaced batch: columns of
+the target are `target.<name>`, of the source `source.<name>`.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from delta_tpu.config import ENABLE_CDF, get_table_config
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.tree import (
+    And,
+    Column,
+    Comparison,
+    Expression,
+    split_conjuncts,
+)
+from delta_tpu.txn.transaction import Operation
+from delta_tpu.write.writer import write_data_files
+
+
+class MergeCardinalityError(DeltaError):
+    error_class = "DELTA_MULTIPLE_SOURCE_ROW_MATCHING_TARGET_ROW"
+
+
+@dataclass
+class MergeClause:
+    kind: str  # update | delete | insert
+    condition: Optional[Expression] = None
+    assignments: Optional[Dict[str, object]] = None  # update/insert values
+
+
+@dataclass
+class MergeMetrics:
+    num_target_rows_updated: int = 0
+    num_target_rows_deleted: int = 0
+    num_target_rows_inserted: int = 0
+    num_target_rows_copied: int = 0
+    num_target_files_rewritten: int = 0
+    num_source_rows: int = 0
+    version: Optional[int] = None
+
+
+class MergeBuilder:
+    def __init__(self, table, source: pa.Table, on: Expression):
+        self._table = table
+        self._source = source
+        self._on = on
+        self._matched: List[MergeClause] = []
+        self._not_matched: List[MergeClause] = []
+        self._not_matched_by_source: List[MergeClause] = []
+
+    def when_matched_update(self, set: Dict[str, object], condition=None):
+        self._matched.append(MergeClause("update", condition, dict(set)))
+        return self
+
+    def when_matched_update_all(self, condition=None):
+        self._matched.append(MergeClause("update", condition, None))
+        return self
+
+    def when_matched_delete(self, condition=None):
+        self._matched.append(MergeClause("delete", condition))
+        return self
+
+    def when_not_matched_insert(self, values: Dict[str, object], condition=None):
+        self._not_matched.append(MergeClause("insert", condition, dict(values)))
+        return self
+
+    def when_not_matched_insert_all(self, condition=None):
+        self._not_matched.append(MergeClause("insert", condition, None))
+        return self
+
+    def when_not_matched_by_source_update(self, set: Dict[str, object], condition=None):
+        self._not_matched_by_source.append(MergeClause("update", condition, dict(set)))
+        return self
+
+    def when_not_matched_by_source_delete(self, condition=None):
+        self._not_matched_by_source.append(MergeClause("delete", condition))
+        return self
+
+    def execute(self) -> MergeMetrics:
+        return _execute_merge(
+            self._table, self._source, self._on,
+            self._matched, self._not_matched, self._not_matched_by_source,
+        )
+
+
+def merge(table, source: pa.Table, on: Expression) -> MergeBuilder:
+    return MergeBuilder(table, source, on)
+
+
+def _equi_keys(on: Expression) -> tuple[List[str], List[str], List[Expression]]:
+    """Split the ON condition into target/source equi-key pairs + residual
+    conjuncts (the join fast path; residual evaluated per candidate pair)."""
+    t_keys, s_keys, residual = [], [], []
+    for conj in split_conjuncts(on):
+        if isinstance(conj, Comparison) and conj.op == "=":
+            sides = [conj.left, conj.right]
+            if all(isinstance(s, Column) for s in sides):
+                roots = {s.name_path[0] for s in sides}
+                if roots == {"target", "source"}:
+                    t = next(s for s in sides if s.name_path[0] == "target")
+                    s = next(s for s in sides if s.name_path[0] == "source")
+                    t_keys.append(".".join(t.name_path[1:]))
+                    s_keys.append(".".join(s.name_path[1:]))
+                    continue
+        residual.append(conj)
+    return t_keys, s_keys, residual
+
+
+def _namespaced_batch(target: pa.Table, source: pa.Table) -> pa.Table:
+    """Rows side by side as struct columns `target` / `source`."""
+    cols = {}
+    for name, tbl in (("target", target), ("source", source)):
+        arrays = [tbl.column(c).combine_chunks() for c in tbl.column_names]
+        cols[name] = pa.StructArray.from_arrays(arrays, names=tbl.column_names)
+    return pa.table(cols)
+
+
+def _eval_values(
+    assignments: Optional[Dict[str, object]],
+    batch: pa.Table,
+    target_schema: pa.Schema,
+    source_prefix_ok: bool,
+) -> pa.Table:
+    """Materialize clause output rows (full target schema)."""
+    from delta_tpu.expressions.eval import evaluate_host
+    import pyarrow.compute as pc
+
+    n = batch.num_rows
+    out = {}
+    for f in target_schema:
+        if assignments is not None and f.name in assignments:
+            v = assignments[f.name]
+            if isinstance(v, Expression):
+                arr = evaluate_host(v, batch)
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                arr = arr.cast(f.type, safe=False)
+            else:
+                arr = pa.array([v] * n, f.type)
+        elif assignments is None:
+            # UPDATE * / INSERT *: take the source column of the same name
+            src = pc.struct_field(batch.column("source").combine_chunks(), f.name) \
+                if f.name in batch.column("source").combine_chunks().type.names \
+                else None
+            if src is None:
+                arr = pa.nulls(n, f.type)
+            else:
+                arr = src.cast(f.type, safe=False)
+        else:
+            # unassigned target column keeps its current value (update) or
+            # null (insert — no target side present)
+            tcol = batch.column("target").combine_chunks()
+            if f.name in tcol.type.names:
+                arr = pc.struct_field(tcol, f.name).cast(f.type, safe=False)
+            else:
+                arr = pa.nulls(n, f.type)
+        out[f.name] = arr
+    return pa.table(out)
+
+
+def _execute_merge(
+    table, source, on, matched, not_matched, not_matched_by_source
+) -> MergeMetrics:
+    import pyarrow.compute as pc
+
+    from delta_tpu.commands.dml import _read_file_with_partitions, _write_cdc
+    from delta_tpu.expressions.eval import evaluate_predicate_host
+    from delta_tpu.models.schema import to_arrow_schema
+
+    txn = table.create_transaction_builder(Operation.MERGE).build()
+    snapshot = txn.read_snapshot
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    meta = snapshot.metadata
+    use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+    schema = snapshot.schema
+    target_arrow_schema = to_arrow_schema(schema)
+    now_ms = int(time.time() * 1000)
+    metrics = MergeMetrics(num_source_rows=source.num_rows)
+
+    candidates = txn.scan_files()  # whole-table read (predicate refinement: future)
+    t_keys, s_keys, residual = _equi_keys(on)
+
+    # ---- load target rows with provenance ----
+    file_tables = []
+    for fi, add in enumerate(candidates):
+        t = _read_file_with_partitions(table, snapshot, add)
+        t = t.append_column("__file", pa.array(np.full(t.num_rows, fi, np.int64)))
+        t = t.append_column("__row", pa.array(np.arange(t.num_rows, dtype=np.int64)))
+        file_tables.append(t)
+    target_all = (
+        pa.concat_tables(file_tables, promote_options="permissive")
+        if file_tables
+        else None
+    )
+
+    # ---- join ----
+    if target_all is not None and target_all.num_rows and source.num_rows:
+        if t_keys:
+            tdf = pd.DataFrame({k: target_all.column(k).to_pandas() for k in t_keys})
+            sdf = pd.DataFrame({k: source.column(k).to_pandas() for k in s_keys})
+            tdf["__tpos"] = np.arange(len(tdf))
+            sdf["__spos"] = np.arange(len(sdf))
+            joined = tdf.merge(
+                sdf, left_on=t_keys, right_on=s_keys, how="inner", suffixes=("", "_s")
+            )
+            tpos = joined["__tpos"].to_numpy()
+            spos = joined["__spos"].to_numpy()
+        else:
+            tpos, spos = np.meshgrid(
+                np.arange(target_all.num_rows), np.arange(source.num_rows),
+                indexing="ij",
+            )
+            tpos, spos = tpos.ravel(), spos.ravel()
+        if residual and len(tpos):
+            pair_batch = _namespaced_batch(
+                target_all.take(pa.array(tpos, pa.int64())),
+                source.take(pa.array(spos, pa.int64())),
+            )
+            keep = np.ones(len(tpos), dtype=bool)
+            for conj in residual:
+                keep &= evaluate_predicate_host(conj, pair_batch)
+            tpos, spos = tpos[keep], spos[keep]
+    else:
+        tpos = np.empty(0, np.int64)
+        spos = np.empty(0, np.int64)
+
+    # ---- cardinality rule ----
+    if (matched) and len(tpos):
+        uniq, counts = np.unique(tpos, return_counts=True)
+        if (counts > 1).any():
+            raise MergeCardinalityError(
+                f"{int((counts > 1).sum())} target row(s) matched by multiple "
+                "source rows; MERGE with update/delete requires at most one match"
+            )
+
+    matched_t = np.unique(tpos)
+    matched_s = np.unique(spos)
+
+    # ---- matched clause resolution (per pair; first clause wins) ----
+    pair_action = np.full(len(tpos), -1, dtype=np.int64)  # index into `matched`
+    if matched and len(tpos):
+        pair_batch = _namespaced_batch(
+            target_all.take(pa.array(tpos, pa.int64())),
+            source.take(pa.array(spos, pa.int64())),
+        )
+        undecided = np.ones(len(tpos), dtype=bool)
+        for ci, clause in enumerate(matched):
+            if not undecided.any():
+                break
+            ok = (
+                evaluate_predicate_host(clause.condition, pair_batch)
+                if clause.condition is not None
+                else np.ones(len(tpos), dtype=bool)
+            )
+            sel = undecided & ok
+            pair_action[sel] = ci
+            undecided &= ~sel
+
+    # ---- build per-target-row plan ----
+    # delete set / update outputs
+    delete_rows: set = set()
+    update_rows: Dict[int, int] = {}  # tpos -> pair index
+    for pi, act in enumerate(pair_action):
+        if act < 0:
+            continue
+        clause = matched[act]
+        t = int(tpos[pi])
+        if clause.kind == "delete":
+            delete_rows.add(t)
+        else:
+            update_rows[t] = pi
+
+    # ---- not-matched (insert) ----
+    insert_tables = []
+    if not_matched and source.num_rows:
+        unmatched_mask = np.ones(source.num_rows, dtype=bool)
+        unmatched_mask[matched_s] = False
+        un_idx = np.nonzero(unmatched_mask)[0]
+        if len(un_idx):
+            sub = source.take(pa.array(un_idx, pa.int64()))
+            empty_target = target_arrow_schema.empty_table()
+            batch = _namespaced_batch(
+                _null_target_rows(target_arrow_schema, sub.num_rows), sub
+            )
+            undecided = np.ones(sub.num_rows, dtype=bool)
+            for clause in not_matched:
+                if not undecided.any():
+                    break
+                ok = (
+                    evaluate_predicate_host(clause.condition, batch)
+                    if clause.condition is not None
+                    else np.ones(sub.num_rows, dtype=bool)
+                )
+                sel = undecided & ok
+                if sel.any():
+                    rows = _eval_values(
+                        clause.assignments,
+                        batch.filter(pa.array(sel)),
+                        target_arrow_schema,
+                        True,
+                    )
+                    insert_tables.append(rows)
+                undecided &= ~sel
+
+    # ---- not-matched-by-source ----
+    nmbs_delete: set = set()
+    nmbs_update: Dict[int, pa.Table] = {}
+    if not_matched_by_source and target_all is not None and target_all.num_rows:
+        by_source_mask = np.zeros(target_all.num_rows, dtype=bool)
+        by_source_mask[matched_t] = True
+        un_idx = np.nonzero(~by_source_mask)[0]
+        if len(un_idx):
+            sub = target_all.take(pa.array(un_idx, pa.int64()))
+            batch = _namespaced_batch(sub, _null_source_rows(source.schema, sub.num_rows))
+            undecided = np.ones(sub.num_rows, dtype=bool)
+            for clause in not_matched_by_source:
+                if not undecided.any():
+                    break
+                ok = (
+                    evaluate_predicate_host(clause.condition, batch)
+                    if clause.condition is not None
+                    else np.ones(sub.num_rows, dtype=bool)
+                )
+                sel = undecided & ok
+                for j in np.nonzero(sel)[0]:
+                    t = int(un_idx[j])
+                    if clause.kind == "delete":
+                        nmbs_delete.add(t)
+                    else:
+                        nmbs_update[t] = _eval_values(
+                            clause.assignments,
+                            batch.slice(int(j), 1),
+                            target_arrow_schema,
+                            False,
+                        )
+                undecided &= ~sel
+
+    # ---- rewrite touched files ----
+    touched_files = set()
+    for t in (*delete_rows, *update_rows, *nmbs_delete, *nmbs_update):
+        touched_files.add(int(target_all.column("__file")[int(t)].as_py()))
+
+    part_cols = snapshot.partition_columns
+    cdc_del, cdc_pre, cdc_post = [], [], []
+    file_of = (
+        np.asarray(target_all.column("__file"), dtype=np.int64)
+        if target_all is not None and target_all.num_rows
+        else np.empty(0, np.int64)
+    )
+    n_target = len(file_of)
+    del_mask = np.zeros(n_target, dtype=bool)
+    for t in delete_rows:
+        del_mask[t] = True
+    for t in nmbs_delete:
+        del_mask[t] = True
+    upd_mask = np.zeros(n_target, dtype=bool)
+    for t in update_rows:
+        upd_mask[t] = True
+    nmbs_mask = np.zeros(n_target, dtype=bool)
+    for t in nmbs_update:
+        nmbs_mask[t] = True
+
+    for fi in sorted(touched_files):
+        add = candidates[fi]
+        here = file_of == fi
+        kept = here & ~del_mask & ~upd_mask & ~nmbs_mask
+        out_parts = []
+        n_kept = int(kept.sum())
+        if n_kept:
+            out_parts.append(
+                _strip_provenance(target_all.filter(pa.array(kept))).cast(
+                    target_arrow_schema
+                )
+            )
+            metrics.num_target_rows_copied += n_kept
+        # matched updates in this file, all pairs at once
+        upd_pis = [pi for t, pi in update_rows.items() if file_of[t] == fi]
+        by_clause: Dict[int, list] = {}
+        for pi in upd_pis:
+            by_clause.setdefault(int(pair_action[pi]), []).append(pi)
+        for ci, pis in sorted(by_clause.items()):
+            pair_batch_f = _namespaced_batch(
+                target_all.take(pa.array(tpos[pis], pa.int64())),
+                source.take(pa.array(spos[pis], pa.int64())),
+            )
+            new_rows = _eval_values(
+                matched[ci].assignments, pair_batch_f, target_arrow_schema, True
+            )
+            out_parts.append(new_rows)
+            metrics.num_target_rows_updated += new_rows.num_rows
+            if use_cdc:
+                cdc_pre.append(
+                    _strip_provenance(
+                        target_all.take(pa.array(tpos[pis], pa.int64()))
+                    )
+                )
+                cdc_post.append(new_rows)
+        nmbs_here = [t for t in nmbs_update if file_of[t] == fi]
+        if nmbs_here:
+            rows = pa.concat_tables(
+                [nmbs_update[t] for t in nmbs_here], promote_options="permissive"
+            )
+            out_parts.append(rows)
+            metrics.num_target_rows_updated += len(nmbs_here)
+        n_del_here = int((here & del_mask).sum())
+        metrics.num_target_rows_deleted += n_del_here
+        if use_cdc and n_del_here:
+            cdc_del.append(
+                _strip_provenance(target_all.filter(pa.array(here & del_mask)))
+            )
+        txn.remove_file(add.remove(deletion_timestamp=now_ms))
+        metrics.num_target_files_rewritten += 1
+        if out_parts:
+            new_data = pa.concat_tables(out_parts, promote_options="permissive")
+            adds = write_data_files(
+                engine=table.engine, table_path=table.path, data=new_data,
+                schema=schema, partition_columns=part_cols,
+                configuration=meta.configuration,
+            )
+            txn.add_files(adds)
+
+    # ---- inserts ----
+    if insert_tables:
+        ins = pa.concat_tables(insert_tables, promote_options="permissive")
+        metrics.num_target_rows_inserted = ins.num_rows
+        adds = write_data_files(
+            engine=table.engine, table_path=table.path, data=ins,
+            schema=schema, partition_columns=part_cols,
+            configuration=meta.configuration,
+        )
+        txn.add_files(adds)
+        if use_cdc:
+            _write_cdc(table, snapshot, txn, ins, "insert")
+
+    if use_cdc:
+        for rows, kind in (
+            (cdc_del, "delete"), (cdc_pre, "update_preimage"), (cdc_post, "update_postimage"),
+        ):
+            if rows:
+                _write_cdc(
+                    table, snapshot, txn,
+                    pa.concat_tables(rows, promote_options="permissive"), kind,
+                )
+
+    if not txn._adds and not txn._removes:
+        return metrics
+    txn.set_operation_parameters({"predicate": repr(on)})
+    txn.set_operation_metrics(
+        {
+            "numTargetRowsUpdated": metrics.num_target_rows_updated,
+            "numTargetRowsDeleted": metrics.num_target_rows_deleted,
+            "numTargetRowsInserted": metrics.num_target_rows_inserted,
+            "numTargetRowsCopied": metrics.num_target_rows_copied,
+            "numSourceRows": metrics.num_source_rows,
+        }
+    )
+    result = txn.commit()
+    metrics.version = result.version
+    return metrics
+
+
+def _strip_provenance(t: pa.Table) -> pa.Table:
+    return t.drop_columns([c for c in ("__file", "__row") if c in t.column_names])
+
+
+def _null_target_rows(schema: pa.Schema, n: int) -> pa.Table:
+    return pa.table({f.name: pa.nulls(n, f.type) for f in schema})
+
+
+def _null_source_rows(schema: pa.Schema, n: int) -> pa.Table:
+    return pa.table({f.name: pa.nulls(n, f.type) for f in schema})

@@ -1,0 +1,199 @@
+"""RESTORE TABLE ... TO VERSION AS OF / CLONE / CONVERT TO DELTA.
+
+- restore: diff the target snapshot against the current one; re-add files
+  the restore brings back, remove files added since, restore metadata
+  (`commands/RestoreTableCommand.scala` semantics; fails if data files of
+  the target version were already vacuumed unless force).
+- clone (shallow): new table whose AddFiles point at the source table's
+  files via absolute paths (`commands/CloneTableCommand.scala`).
+- convert: import a plain Parquet directory as version 0
+  (`commands/ConvertToDeltaCommand.scala`).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import AddFile, Metadata
+from delta_tpu.table import Table
+from delta_tpu.txn.transaction import Operation
+
+
+@dataclass
+class RestoreMetrics:
+    num_restored_files: int = 0
+    num_removed_files: int = 0
+    version: Optional[int] = None
+
+
+def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = None,
+            force: bool = False) -> RestoreMetrics:
+    if (version is None) == (timestamp_ms is None):
+        raise DeltaError("restore requires exactly one of version / timestamp")
+    target = (
+        table.snapshot_at(version)
+        if version is not None
+        else table.snapshot_as_of_timestamp(timestamp_ms)
+    )
+    current = table.latest_snapshot()
+    now_ms = int(time.time() * 1000)
+
+    cur_files = {
+        (f["path"], f["dv_id"]): f
+        for f in current.state.add_files_table.select(["path", "dv_id"]).to_pylist()
+    }
+    target_adds = target.state.add_files()
+    target_keys = {(a.path, a.dv_unique_id) for a in target_adds}
+
+    to_add = [a for a in target_adds if (a.path, a.dv_unique_id) not in cur_files]
+    cur_adds = current.state.add_files()
+    to_remove = [a for a in cur_adds if (a.path, a.dv_unique_id) not in target_keys]
+
+    if not force:
+        # fail when restored files no longer exist (vacuumed)
+        for a in to_add:
+            p = a.path
+            abs_path = p if ("://" in p or p.startswith("/")) else f"{table.path}/{p}"
+            if not table.engine.fs.exists(abs_path):
+                raise DeltaError(
+                    f"cannot restore: data file {a.path} was removed "
+                    "(probably by VACUUM); use force=True to restore anyway"
+                )
+
+    txn = table.create_transaction_builder(Operation.RESTORE).build()
+    import dataclasses
+
+    for a in to_add:
+        txn.add_file(dataclasses.replace(a, dataChange=True))
+    for a in to_remove:
+        txn.remove_file(a.remove(deletion_timestamp=now_ms))
+    if target.metadata.to_dict() != current.metadata.to_dict():
+        txn.update_metadata(target.metadata)
+    txn.set_operation_parameters(
+        {"version": version, "timestamp": timestamp_ms}
+    )
+    txn.set_operation_metrics(
+        {
+            "numRestoredFiles": len(to_add),
+            "numRemovedFiles": len(to_remove),
+        }
+    )
+    result = txn.commit()
+    return RestoreMetrics(len(to_add), len(to_remove), result.version)
+
+
+def clone(source_table, dest_path: str, shallow: bool = True,
+          properties: Optional[Dict[str, str]] = None) -> int:
+    """Shallow clone: dest commits AddFiles with absolute paths into the
+    source table's data. Returns the dest commit version."""
+    if not shallow:
+        raise DeltaError("deep clone not implemented; copy files + convert")
+    snap = source_table.latest_snapshot()
+    dest = Table.for_path(dest_path, source_table.engine)
+    if dest.exists():
+        raise DeltaError(f"clone destination {dest_path} already exists")
+    meta = snap.metadata
+    import uuid as _uuid
+
+    new_conf = dict(meta.configuration)
+    new_conf.update(properties or {})
+    builder = (
+        dest.create_transaction_builder(Operation.CLONE)
+        .with_schema(meta.schemaString)
+        .with_partition_columns(meta.partitionColumns)
+        .with_table_properties(new_conf)
+    )
+    txn = builder.build()
+    import dataclasses
+
+    src_root = source_table.path
+    for a in snap.state.add_files():
+        p = a.path
+        abs_path = p if ("://" in p or p.startswith("/")) else f"{src_root}/{p}"
+        txn.add_file(dataclasses.replace(a, path=abs_path, dataChange=True))
+    txn.set_operation_parameters(
+        {"source": src_root, "sourceVersion": snap.version, "isShallow": True}
+    )
+    return txn.commit().version
+
+
+def convert_to_delta(
+    path: str,
+    partition_schema: Optional[Dict[str, str]] = None,
+    engine=None,
+) -> int:
+    """Convert a directory of Parquet files (optionally Hive-partitioned)
+    into a Delta table in place."""
+    import pyarrow.parquet as pq
+
+    from delta_tpu.models.schema import PrimitiveType, from_arrow_schema
+
+    table = Table.for_path(path, engine)
+    if table.exists():
+        raise DeltaError(f"{path} is already a Delta table")
+    part_schema = partition_schema or {}
+    part_cols = list(part_schema)
+
+    adds: List[AddFile] = []
+    arrow_schema = None
+    root = table.path
+    for dirpath, dirs, files in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        if rel_dir.startswith("_delta_log"):
+            continue
+        dirs[:] = [d for d in dirs if not d.startswith((".", "_"))]
+        pv: Dict[str, Optional[str]] = {}
+        if rel_dir != ".":
+            for part in rel_dir.split(os.sep):
+                if "=" in part:
+                    k, _, v = part.partition("=")
+                    from urllib.parse import unquote
+
+                    pv[k] = None if v == "__HIVE_DEFAULT_PARTITION__" else unquote(v)
+        for fname in files:
+            if not fname.endswith(".parquet") or fname.startswith((".", "_")):
+                continue
+            full = os.path.join(dirpath, fname)
+            st = os.stat(full)
+            if arrow_schema is None:
+                arrow_schema = pq.read_schema(full)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            missing = [k for k in part_cols if k not in pv]
+            if missing:
+                raise DeltaError(
+                    f"file {rel} lacks partition values for {missing}"
+                )
+            adds.append(
+                AddFile(
+                    path=rel,
+                    partitionValues={k: pv.get(k) for k in part_cols},
+                    size=st.st_size,
+                    modificationTime=int(st.st_mtime * 1000),
+                    dataChange=True,
+                )
+            )
+    if arrow_schema is None:
+        raise DeltaError(f"no parquet files found under {path}")
+
+    schema = from_arrow_schema(arrow_schema)
+    for col_name, type_name in part_schema.items():
+        if col_name not in schema:
+            schema = schema.add(col_name, PrimitiveType(type_name))
+
+    from delta_tpu.models.schema import schema_to_json
+
+    txn = (
+        table.create_transaction_builder(Operation.CONVERT)
+        .with_schema(schema)
+        .with_partition_columns(part_cols)
+        .build()
+    )
+    txn.add_files(adds)
+    txn.set_operation_parameters({"numFiles": len(adds), "partitionedBy": part_cols})
+    return txn.commit().version

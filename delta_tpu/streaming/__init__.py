@@ -1,0 +1,4 @@
+from delta_tpu.streaming.source import DeltaSource, DeltaSourceOffset, ReadLimits
+from delta_tpu.streaming.sink import DeltaSink
+
+__all__ = ["DeltaSource", "DeltaSourceOffset", "ReadLimits", "DeltaSink"]

@@ -1,0 +1,256 @@
+"""Structured-streaming source: incremental micro-batch reads of a table.
+
+Reference `sources/DeltaSource.scala:721` + `DeltaSourceOffset.scala:55`:
+an offset is `(reservoir_version, index, is_initial_snapshot)` — the
+initial snapshot is served as an indexed enumeration of the start
+snapshot's files, after which the source tails commit files version by
+version, admitting files up to the rate limits (`AdmissionLimits:1309`,
+maxFilesPerTrigger / maxBytesPerTrigger).
+
+Data-changing removes in tailed commits are an error unless
+`ignore_changes` (re-emit rewritten files) or `ignore_deletes` is set —
+same contract as the reference.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import (
+    AddFile,
+    CommitInfo,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    actions_from_commit_bytes,
+)
+from delta_tpu.utils import filenames
+
+BASE_INDEX = -1  # offset index meaning "before any file of this version"
+END_INDEX = -2   # (reference END_INDEX analog: version fully consumed)
+
+
+@dataclass(frozen=True, order=True)
+class DeltaSourceOffset:
+    reservoir_version: int
+    index: int
+    is_initial_snapshot: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "reservoirVersion": self.reservoir_version,
+                "index": self.index,
+                "isStartingVersion": self.is_initial_snapshot,
+            }
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "DeltaSourceOffset":
+        d = json.loads(s)
+        return DeltaSourceOffset(
+            int(d["reservoirVersion"]), int(d["index"]),
+            bool(d.get("isStartingVersion", False)),
+        )
+
+
+@dataclass
+class ReadLimits:
+    max_files: Optional[int] = 1000
+    max_bytes: Optional[int] = None
+
+
+@dataclass
+class IndexedFile:
+    version: int
+    index: int
+    add: AddFile
+    is_initial: bool
+
+
+class DeltaSource:
+    def __init__(
+        self,
+        table,
+        starting_version: Optional[int] = None,
+        ignore_deletes: bool = False,
+        ignore_changes: bool = False,
+    ):
+        self.table = table
+        self.ignore_deletes = ignore_deletes
+        self.ignore_changes = ignore_changes
+        self._starting_version = starting_version
+        self._initial_files: Optional[List[AddFile]] = None
+        self._initial_version: Optional[int] = None
+
+    # -- initial snapshot ---------------------------------------------------
+
+    def _ensure_initial(self) -> None:
+        if self._initial_version is not None:
+            return
+        if self._starting_version is not None:
+            # start tailing from a version: no initial snapshot
+            self._initial_version = self._starting_version - 1
+            self._initial_files = []
+            return
+        snap = self.table.latest_snapshot()
+        files = snap.state.add_files()
+        files.sort(key=lambda f: (f.modificationTime, f.path))
+        self._initial_files = files
+        self._initial_version = snap.version
+
+    # -- change enumeration -------------------------------------------------
+
+    def _files_from_version(self, version: int) -> Optional[List[AddFile]]:
+        """File adds of one commit; None when the commit doesn't exist yet."""
+        path = filenames.delta_file(self.table.log_path, version)
+        try:
+            data = self.table.engine.fs.read_file(path)
+        except FileNotFoundError:
+            return None
+        adds = []
+        for a in actions_from_commit_bytes(data):
+            if isinstance(a, AddFile) and a.dataChange:
+                adds.append(a)
+            elif isinstance(a, RemoveFile) and a.dataChange:
+                if not (self.ignore_deletes or self.ignore_changes):
+                    raise DeltaError(
+                        f"streaming source found a data-changing remove in "
+                        f"version {version}; set ignore_deletes/ignore_changes "
+                        "or use the CDC reader"
+                    )
+            elif isinstance(a, Metadata):
+                pass  # schema evolution checks: future (schema tracking log)
+        return adds
+
+    def _indexed_after(
+        self, start: Optional[DeltaSourceOffset], limits: ReadLimits
+    ) -> List[IndexedFile]:
+        """Files strictly after `start`, up to the limits."""
+        self._ensure_initial()
+        out: List[IndexedFile] = []
+        budget_files = limits.max_files if limits.max_files is not None else float("inf")
+        budget_bytes = limits.max_bytes if limits.max_bytes is not None else float("inf")
+
+        def admit(f: IndexedFile) -> bool:
+            nonlocal budget_files, budget_bytes
+            if budget_files < 1:
+                return False
+            if out and budget_bytes < f.add.size:
+                return False
+            budget_files -= 1
+            budget_bytes -= f.add.size
+            out.append(f)
+            return True
+
+        if start is None or start.is_initial_snapshot:
+            begin_idx = -1 if start is None else start.index
+            if self._starting_version is None:
+                for i, add in enumerate(self._initial_files):
+                    if i <= begin_idx:
+                        continue
+                    if not admit(
+                        IndexedFile(self._initial_version, i, add, True)
+                    ):
+                        return out
+            v = self._initial_version + 1
+        else:
+            v = start.reservoir_version
+        # tail commits
+        start_idx = (
+            start.index
+            if start is not None and not start.is_initial_snapshot
+            else -1
+        )
+        while True:
+            adds = self._files_from_version(v)
+            if adds is None:
+                break
+            for i, add in enumerate(adds):
+                if v == (start.reservoir_version if start and not start.is_initial_snapshot else -1) and i <= start_idx:
+                    continue
+                if not admit(IndexedFile(v, i, add, False)):
+                    return out
+            v += 1
+        return out
+
+    # -- public micro-batch API --------------------------------------------
+
+    def latest_offset(
+        self, start: Optional[DeltaSourceOffset] = None,
+        limits: Optional[ReadLimits] = None,
+    ) -> Optional[DeltaSourceOffset]:
+        files = self._indexed_after(start, limits or ReadLimits())
+        if not files:
+            return start
+        last = files[-1]
+        return DeltaSourceOffset(last.version, last.index, last.is_initial)
+
+    def get_batch(
+        self,
+        start: Optional[DeltaSourceOffset],
+        end: DeltaSourceOffset,
+    ) -> pa.Table:
+        """All rows in files after `start` up to and including `end`."""
+        files = self._indexed_after(start, ReadLimits(max_files=None, max_bytes=None))
+        # Initial-snapshot files share the start snapshot's version and the
+        # tail begins at version+1, so (version, index) totally orders the
+        # stream.
+        end_key = (end.reservoir_version, end.index)
+        selected = [f.add for f in files if (f.version, f.index) <= end_key]
+        return self._read_adds(selected)
+
+    def _read_adds(self, adds: List[AddFile]) -> pa.Table:
+        from delta_tpu.read.reader import _absolute_path
+        from delta_tpu.models.schema import PrimitiveType, to_arrow_type
+        from delta_tpu.stats.partition import deserialize_partition_value
+
+        snap = self.table.latest_snapshot()
+        schema = snap.schema
+        part_cols = snap.partition_columns
+        batches = []
+        for add in adds:
+            tbl = next(
+                iter(
+                    self.table.engine.parquet.read_parquet_files(
+                        [_absolute_path(self.table.path, add.path)]
+                    )
+                )
+            )
+            for c in part_cols:
+                dtype = PrimitiveType("string")
+                if schema is not None and c in schema:
+                    fld = schema[c]
+                    if isinstance(fld.dataType, PrimitiveType):
+                        dtype = fld.dataType
+                value = deserialize_partition_value(
+                    (add.partitionValues or {}).get(c), dtype
+                )
+                tbl = tbl.append_column(
+                    c, pa.array([value] * tbl.num_rows, to_arrow_type(dtype))
+                )
+            batches.append(tbl)
+        if not batches:
+            names = [f.name for f in schema.fields] if schema else []
+            from delta_tpu.models.schema import to_arrow_schema
+
+            return to_arrow_schema(schema).empty_table() if schema else pa.table({})
+        return pa.concat_tables(batches, promote_options="permissive")
+
+    def micro_batches(
+        self, limits: Optional[ReadLimits] = None,
+        start: Optional[DeltaSourceOffset] = None,
+    ) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
+        """Drain available data as (offset, batch) pairs until caught up."""
+        cur = start
+        while True:
+            nxt = self.latest_offset(cur, limits)
+            if nxt == cur or nxt is None:
+                return
+            yield nxt, self.get_batch(cur, nxt)
+            cur = nxt

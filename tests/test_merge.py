@@ -1,0 +1,145 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.commands.merge import MergeCardinalityError, merge
+from delta_tpu.expressions import col, lit
+from delta_tpu.table import Table
+
+
+@pytest.fixture
+def target_path(tmp_table_path):
+    data = pa.table(
+        {
+            "id": pa.array([1, 2, 3, 4, 5], pa.int64()),
+            "value": pa.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+            "status": pa.array(["a", "a", "a", "a", "a"]),
+        }
+    )
+    dta.write_table(tmp_table_path, data)
+    return tmp_table_path
+
+
+def _source(ids, values, ops=None):
+    cols = {
+        "id": pa.array(ids, pa.int64()),
+        "value": pa.array(values, pa.float64()),
+    }
+    if ops is not None:
+        cols["op"] = pa.array(ops, pa.string())
+    return pa.table(cols)
+
+
+def test_merge_upsert(target_path):
+    table = Table.for_path(target_path)
+    src = _source([3, 4, 6, 7], [300.0, 400.0, 600.0, 700.0])
+    m = (
+        merge(table, src, on=col("target.id") == col("source.id"))
+        .when_matched_update(set={"value": col("source.value")})
+        .when_not_matched_insert(
+            values={"id": col("source.id"), "value": col("source.value"),
+                    "status": lit("new")}
+        )
+        .execute()
+    )
+    assert m.num_target_rows_updated == 2
+    assert m.num_target_rows_inserted == 2
+    assert m.num_target_rows_copied == 3
+    out = dta.read_table(target_path).sort_by("id")
+    assert out.column("id").to_pylist() == [1, 2, 3, 4, 5, 6, 7]
+    assert out.column("value").to_pylist() == [10.0, 20.0, 300.0, 400.0, 50.0, 600.0, 700.0]
+    st = out.column("status").to_pylist()
+    assert st[5] == "new" and st[6] == "new"
+
+
+def test_merge_matched_delete_with_condition(target_path):
+    table = Table.for_path(target_path)
+    src = _source([1, 2, 3], [0.0, 0.0, 0.0], ops=["del", "keep", "del"])
+    m = (
+        merge(table, src, on=col("target.id") == col("source.id"))
+        .when_matched_delete(condition=col("source.op") == lit("del"))
+        .when_matched_update(set={"value": col("source.value")})
+        .execute()
+    )
+    assert m.num_target_rows_deleted == 2
+    assert m.num_target_rows_updated == 1
+    out = dta.read_table(target_path).sort_by("id")
+    assert out.column("id").to_pylist() == [2, 4, 5]
+    assert out.column("value").to_pylist()[0] == 0.0
+
+
+def test_merge_clause_order_first_wins(target_path):
+    table = Table.for_path(target_path)
+    src = _source([1], [99.0])
+    (
+        merge(table, src, on=col("target.id") == col("source.id"))
+        .when_matched_update(set={"value": lit(111.0)},
+                             condition=col("target.value") < lit(15.0))
+        .when_matched_update(set={"value": lit(222.0)})
+        .execute()
+    )
+    out = dta.read_table(target_path).sort_by("id")
+    assert out.column("value").to_pylist()[0] == 111.0
+
+
+def test_merge_not_matched_by_source_delete(target_path):
+    table = Table.for_path(target_path)
+    src = _source([1, 2], [0.0, 0.0])
+    m = (
+        merge(table, src, on=col("target.id") == col("source.id"))
+        .when_matched_update(set={"value": col("source.value")})
+        .when_not_matched_by_source_delete()
+        .execute()
+    )
+    assert m.num_target_rows_deleted == 3
+    out = dta.read_table(target_path).sort_by("id")
+    assert out.column("id").to_pylist() == [1, 2]
+
+
+def test_merge_cardinality_violation(target_path):
+    table = Table.for_path(target_path)
+    src = _source([3, 3], [1.0, 2.0])
+    with pytest.raises(MergeCardinalityError):
+        (
+            merge(table, src, on=col("target.id") == col("source.id"))
+            .when_matched_update(set={"value": col("source.value")})
+            .execute()
+        )
+
+
+def test_merge_insert_all(target_path):
+    table = Table.for_path(target_path)
+    src = pa.table(
+        {
+            "id": pa.array([8], pa.int64()),
+            "value": pa.array([80.0]),
+            "status": pa.array(["s"]),
+        }
+    )
+    (
+        merge(table, src, on=col("target.id") == col("source.id"))
+        .when_not_matched_insert_all()
+        .execute()
+    )
+    out = dta.read_table(target_path).sort_by("id")
+    assert out.column("id").to_pylist() == [1, 2, 3, 4, 5, 8]
+    assert out.column("status").to_pylist()[-1] == "s"
+
+
+def test_merge_residual_condition(target_path):
+    table = Table.for_path(target_path)
+    src = _source([1, 2], [100.0, 200.0])
+    (
+        merge(
+            table, src,
+            on=(col("target.id") == col("source.id"))
+            & (col("source.value") > lit(150.0)),
+        )
+        .when_matched_update(set={"value": col("source.value")})
+        .execute()
+    )
+    out = dta.read_table(target_path).sort_by("id")
+    vals = out.column("value").to_pylist()
+    assert vals[0] == 10.0      # id=1 pair filtered out by residual
+    assert vals[1] == 200.0     # id=2 updated

@@ -1,0 +1,115 @@
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.commands.dml import delete, update
+from delta_tpu.commands.restore import clone, convert_to_delta, restore
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions import col, lit
+from delta_tpu.read.cdc import table_changes
+from delta_tpu.table import Table
+
+
+def _batch(start, n):
+    return pa.table(
+        {
+            "id": pa.array(np.arange(start, start + n, dtype=np.int64)),
+            "v": pa.array(np.full(n, float(start))),
+        }
+    )
+
+
+def test_restore_to_version(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 10))      # v0
+    dta.write_table(tmp_table_path, _batch(10, 10))     # v1
+    table = Table.for_path(tmp_table_path)
+    delete(table, col("id") < lit(5))                   # v2
+    assert dta.read_table(tmp_table_path).num_rows == 15
+    m = restore(table, version=1)
+    assert m.version == 3
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 20
+    # restore back down to v0
+    restore(table, version=0)
+    assert dta.read_table(tmp_table_path).num_rows == 10
+
+
+def test_restore_history_preserved(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    dta.write_table(tmp_table_path, _batch(5, 5))
+    restore(table, version=0)
+    hist = table.history()
+    assert hist[0].commit_info.operation == "RESTORE"
+
+
+def test_shallow_clone(tmp_table_path, tmp_path):
+    dta.write_table(tmp_table_path, _batch(0, 30))
+    src = Table.for_path(tmp_table_path)
+    dest_path = str(tmp_path / "cloned")
+    v = clone(src, dest_path)
+    assert v == 0
+    out = dta.read_table(dest_path)
+    assert out.num_rows == 30
+    # writes to the clone don't affect the source
+    dta.write_table(dest_path, _batch(100, 5))
+    assert dta.read_table(dest_path).num_rows == 35
+    assert dta.read_table(tmp_table_path).num_rows == 30
+    with pytest.raises(DeltaError):
+        clone(src, dest_path)
+
+
+def test_convert_to_delta(tmp_path):
+    root = str(tmp_path / "plain")
+    os.makedirs(f"{root}/p=a", exist_ok=True)
+    os.makedirs(f"{root}/p=b", exist_ok=True)
+    pq.write_table(_batch(0, 10), f"{root}/p=a/f1.parquet")
+    pq.write_table(_batch(10, 10), f"{root}/p=b/f2.parquet")
+    v = convert_to_delta(root, partition_schema={"p": "string"})
+    assert v == 0
+    out = dta.read_table(root)
+    assert out.num_rows == 20
+    assert set(out.column("p").to_pylist()) == {"a", "b"}
+    filtered = dta.read_table(root, filter=col("p") == lit("a"))
+    assert filtered.num_rows == 10
+    with pytest.raises(DeltaError):
+        convert_to_delta(root)
+
+
+def test_cdc_reader_dml(tmp_table_path):
+    dta.write_table(
+        tmp_table_path, _batch(0, 10),
+        properties={"delta.enableChangeDataFeed": "true"},
+    )
+    table = Table.for_path(tmp_table_path)
+    update(table, {"v": lit(-1.0)}, col("id") == lit(3))   # v1
+    delete(table, col("id") == lit(7))                      # v2
+    changes = table_changes(table, 1)
+    types = changes.column("_change_type").to_pylist()
+    versions = changes.column("_commit_version").to_pylist()
+    rows = list(zip(types, versions, changes.column("id").to_pylist()))
+    assert ("update_preimage", 1, 3) in rows
+    assert ("update_postimage", 1, 3) in rows
+    assert ("delete", 2, 7) in rows
+
+
+def test_cdc_reader_synthesized_inserts(tmp_table_path):
+    dta.write_table(
+        tmp_table_path, _batch(0, 4),
+        properties={"delta.enableChangeDataFeed": "true"},
+    )
+    dta.write_table(tmp_table_path, _batch(4, 3))  # plain append: no cdc files
+    table = Table.for_path(tmp_table_path)
+    changes = table_changes(table, 1, 1)
+    assert changes.column("_change_type").to_pylist() == ["insert"] * 3
+    assert sorted(changes.column("id").to_pylist()) == [4, 5, 6]
+
+
+def test_cdc_requires_flag(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 4))
+    with pytest.raises(DeltaError):
+        table_changes(Table.for_path(tmp_table_path), 0)

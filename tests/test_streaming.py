@@ -1,0 +1,99 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.errors import DeltaError
+from delta_tpu.streaming import DeltaSink, DeltaSource, DeltaSourceOffset, ReadLimits
+from delta_tpu.table import Table
+
+
+def _batch(start, n):
+    return pa.table(
+        {
+            "id": pa.array(np.arange(start, start + n, dtype=np.int64)),
+            "v": pa.array(np.full(n, float(start))),
+        }
+    )
+
+
+def test_sink_exactly_once(tmp_table_path):
+    sink = DeltaSink(tmp_table_path, query_id="q1")
+    v0 = sink.add_batch(0, _batch(0, 10))
+    assert v0 == 0
+    v1 = sink.add_batch(1, _batch(10, 10))
+    assert v1 == 1
+    # replay of batch 1 must be a no-op
+    assert sink.add_batch(1, _batch(10, 10)) is None
+    assert sink.add_batch(0, _batch(0, 10)) is None
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 20
+    # a different query id is independent
+    sink2 = DeltaSink(tmp_table_path, query_id="q2")
+    assert sink2.add_batch(0, _batch(100, 5)) is not None
+
+
+def test_source_initial_snapshot_then_tail(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 10))
+    dta.write_table(tmp_table_path, _batch(10, 10))
+    table = Table.for_path(tmp_table_path)
+    src = DeltaSource(table)
+    off1 = src.latest_offset(None)
+    assert off1 is not None and off1.is_initial_snapshot
+    batch1 = src.get_batch(None, off1)
+    assert batch1.num_rows == 20  # initial snapshot covers both commits
+    # nothing new
+    assert src.latest_offset(off1) == off1
+    # append arrives
+    dta.write_table(tmp_table_path, _batch(20, 5))
+    off2 = src.latest_offset(off1)
+    assert off2 != off1 and not off2.is_initial_snapshot
+    batch2 = src.get_batch(off1, off2)
+    assert batch2.num_rows == 5
+    assert sorted(batch2.column("id").to_pylist()) == list(range(20, 25))
+
+
+def test_source_rate_limit(tmp_table_path):
+    for i in range(4):
+        dta.write_table(tmp_table_path, _batch(i * 10, 10))
+    table = Table.for_path(tmp_table_path)
+    src = DeltaSource(table, starting_version=0)
+    limits = ReadLimits(max_files=2)
+    offsets = []
+    rows = 0
+    cur = None
+    for off, batch in src.micro_batches(limits=limits):
+        offsets.append(off)
+        rows += batch.num_rows
+    assert rows == 40
+    assert len(offsets) == 2  # 4 files admitted 2 per batch
+
+
+def test_source_starting_version(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 10))
+    dta.write_table(tmp_table_path, _batch(10, 10))
+    dta.write_table(tmp_table_path, _batch(20, 10))
+    table = Table.for_path(tmp_table_path)
+    src = DeltaSource(table, starting_version=1)
+    off = src.latest_offset(None)
+    batch = src.get_batch(None, off)
+    assert sorted(batch.column("id").to_pylist()) == list(range(10, 30))
+
+
+def test_source_rejects_deletes(tmp_table_path):
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+
+    dta.write_table(tmp_table_path, _batch(0, 10))
+    table = Table.for_path(tmp_table_path)
+    delete(table, col("id") < lit(5))
+    src = DeltaSource(table, starting_version=0)
+    with pytest.raises(DeltaError):
+        src.latest_offset(None)
+    src2 = DeltaSource(table, starting_version=0, ignore_changes=True)
+    assert src2.latest_offset(None) is not None
+
+
+def test_offset_json_roundtrip():
+    off = DeltaSourceOffset(7, 3, True)
+    assert DeltaSourceOffset.from_json(off.to_json()) == off
