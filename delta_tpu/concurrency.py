@@ -1,0 +1,97 @@
+"""Deterministic transaction interleaving for concurrency tests.
+
+The rebuild of the reference's phase-locking fuzzer
+(`TransactionExecutionObserver.scala:43`, `fuzzer/AtomicBarrier.scala`):
+a `PhaseLockingObserver` attached to `Transaction.observer` blocks the
+transaction at named phases until the test unblocks it, so two-writer
+races are driven to exact interleavings instead of sleeps.
+
+Phases: `before_commit` (before each attempt's write), `conflict`
+(entered the lost-race path), `after_commit`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class AtomicBarrier:
+    """unblocked -> (block) -> blocked -> (pass/unblock) -> passed."""
+
+    def __init__(self, blocked: bool = True):
+        self._event = threading.Event()
+        if not blocked:
+            self._event.set()
+        self.arrivals = 0
+        self._arrived = threading.Event()
+
+    def wait(self, timeout: Optional[float] = 30.0) -> None:
+        self.arrivals += 1
+        self._arrived.set()
+        if not self._event.wait(timeout):
+            raise TimeoutError("barrier never unblocked")
+
+    def unblock(self) -> None:
+        self._event.set()
+
+    def wait_for_arrival(self, timeout: float = 30.0) -> None:
+        if not self._arrived.wait(timeout):
+            raise TimeoutError("no transaction arrived at barrier")
+
+
+class PhaseLockingObserver:
+    def __init__(
+        self,
+        block_before_commit: bool = False,
+        block_on_conflict: bool = False,
+    ):
+        self.before_commit_barrier = AtomicBarrier(blocked=block_before_commit)
+        self.conflict_barrier = AtomicBarrier(blocked=block_on_conflict)
+        self.events: List[tuple] = []
+        self._lock = threading.Lock()
+
+    def _record(self, kind: str, version: int) -> None:
+        with self._lock:
+            self.events.append((kind, version))
+
+    # -- Transaction hook points -------------------------------------------
+
+    def before_commit_attempt(self, txn, version: int) -> None:
+        self._record("attempt", version)
+        self.before_commit_barrier.wait()
+
+    def on_commit_conflict(self, txn, version: int) -> None:
+        self._record("conflict", version)
+        self.conflict_barrier.wait()
+
+    def after_commit(self, txn, version: int) -> None:
+        self._record("committed", version)
+
+
+def run_txn_async(fn) -> "TxnThread":
+    t = TxnThread(fn)
+    t.start()
+    return t
+
+
+class TxnThread(threading.Thread):
+    def __init__(self, fn):
+        super().__init__(daemon=True)
+        self._fn = fn
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        try:
+            self.result = self._fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced to the test
+            self.error = e
+
+    def join_result(self, timeout: float = 60.0):
+        self.join(timeout)
+        if self.is_alive():
+            raise TimeoutError("transaction thread did not finish")
+        if self.error is not None:
+            raise self.error
+        return self.result

@@ -1,0 +1,21 @@
+from delta_tpu.coordinatedcommits.client import (
+    Commit,
+    CommitCoordinatorClient,
+    CommitFailedException,
+    GetCommitsResponse,
+    InMemoryCommitCoordinator,
+    coordinator_for_table,
+    register_coordinator,
+    COORDINATOR_NAME_KEY,
+)
+
+__all__ = [
+    "Commit",
+    "CommitCoordinatorClient",
+    "CommitFailedException",
+    "GetCommitsResponse",
+    "InMemoryCommitCoordinator",
+    "coordinator_for_table",
+    "register_coordinator",
+    "COORDINATOR_NAME_KEY",
+]

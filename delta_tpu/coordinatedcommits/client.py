@@ -1,0 +1,186 @@
+"""Coordinated commits: a pluggable commit owner replacing put-if-absent.
+
+Reference SPI `storage/.../commit/CommitCoordinatorClient.java` + spark
+`coordinatedcommits/` + `InMemoryCommitCoordinator.scala`:
+
+- A table opts in via the `delta.coordinatedCommits.commitCoordinator-preview`
+  table property naming a registered coordinator.
+- Writers send commits to the coordinator (which enforces linearizable
+  version assignment — the DynamoDB conditional-put role); the commit
+  lands as an *unbackfilled* file `_delta_log/_commits/<v>.<uuid>.json`.
+- The coordinator (or any client) *backfills* commits to their canonical
+  `%020d.json` names asynchronously; readers merge
+  `get_commits()` with the backfilled listing (`Snapshot.scala:166-220`).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from delta_tpu.storage.logstore import FileStatus, logstore_for_path
+from delta_tpu.utils import filenames
+
+COORDINATOR_NAME_KEY = "delta.coordinatedCommits.commitCoordinator-preview"
+COORDINATOR_CONF_KEY = "delta.coordinatedCommits.commitCoordinatorConf-preview"
+TABLE_CONF_KEY = "delta.coordinatedCommits.tableConf-preview"
+
+
+class CommitFailedException(Exception):
+    def __init__(self, message: str, retryable: bool, conflict: bool):
+        super().__init__(message)
+        self.retryable = retryable
+        self.conflict = conflict
+
+
+@dataclass(frozen=True)
+class Commit:
+    version: int
+    file_status: FileStatus
+    commit_timestamp: int
+
+
+@dataclass
+class GetCommitsResponse:
+    commits: List[Commit]
+    latest_table_version: int
+
+
+class CommitCoordinatorClient:
+    """SPI (mirrors CommitCoordinatorClient.java)."""
+
+    def register_table(self, log_path: str, current_version: int) -> Dict[str, str]:
+        """Called once when a table adopts this coordinator; returns table
+        conf to store in metadata."""
+        raise NotImplementedError
+
+    def commit(
+        self,
+        log_path: str,
+        version: int,
+        data: bytes,
+        commit_timestamp: int,
+    ) -> Commit:
+        """Atomically register commit `version`. Raises
+        CommitFailedException(conflict=True) if the version was taken."""
+        raise NotImplementedError
+
+    def get_commits(
+        self, log_path: str, start_version: Optional[int] = None,
+        end_version: Optional[int] = None,
+    ) -> GetCommitsResponse:
+        """Unbackfilled commits in ascending order + latest known version."""
+        raise NotImplementedError
+
+    def backfill_to_version(self, log_path: str, version: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class _TableState:
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    commits: Dict[int, Commit] = field(default_factory=dict)  # unbackfilled
+    latest: int = -1
+    backfilled_until: int = -1
+
+
+class InMemoryCommitCoordinator(CommitCoordinatorClient):
+    """Single-process coordinator with per-table mutual exclusion — the
+    deterministic test double for DynamoDB-style arbitration (reference
+    `InMemoryCommitCoordinator.scala`), and a correct single-node
+    coordinator in its own right.
+
+    `batch_size` controls backfill cadence: every N commits the
+    coordinator copies unbackfilled files to their `%020d.json` names
+    (AbstractBatchBackfillingCommitCoordinatorClient semantics).
+    """
+
+    def __init__(self, batch_size: int = 5):
+        self.batch_size = batch_size
+        self._tables: Dict[str, _TableState] = {}
+        self._global = threading.Lock()
+
+    def _state(self, log_path: str) -> _TableState:
+        with self._global:
+            if log_path not in self._tables:
+                self._tables[log_path] = _TableState()
+            return self._tables[log_path]
+
+    def register_table(self, log_path: str, current_version: int) -> Dict[str, str]:
+        st = self._state(log_path)
+        with st.lock:
+            st.latest = max(st.latest, current_version)
+            st.backfilled_until = max(st.backfilled_until, current_version)
+        return {"coordinator": "in-memory"}
+
+    def commit(self, log_path, version, data, commit_timestamp) -> Commit:
+        st = self._state(log_path)
+        with st.lock:
+            expected = st.latest + 1
+            if version != expected:
+                raise CommitFailedException(
+                    f"commit version {version} rejected; expected {expected}",
+                    retryable=True,
+                    conflict=version > expected or version <= st.latest,
+                )
+            path = filenames.unbackfilled_delta_file(log_path, version)
+            store = logstore_for_path(path)
+            store.write(path, data, overwrite=False)
+            fstat = store.file_status(path)
+            commit = Commit(version, fstat, commit_timestamp)
+            st.commits[version] = commit
+            st.latest = version
+        if version % self.batch_size == 0:
+            self.backfill_to_version(log_path, version)
+        return commit
+
+    def get_commits(self, log_path, start_version=None, end_version=None) -> GetCommitsResponse:
+        st = self._state(log_path)
+        with st.lock:
+            commits = [
+                c for v, c in sorted(st.commits.items())
+                if (start_version is None or v >= start_version)
+                and (end_version is None or v <= end_version)
+            ]
+            return GetCommitsResponse(commits, st.latest)
+
+    def backfill_to_version(self, log_path: str, version: Optional[int] = None) -> None:
+        st = self._state(log_path)
+        with st.lock:
+            target = version if version is not None else st.latest
+            to_backfill = [
+                (v, c) for v, c in sorted(st.commits.items())
+                if st.backfilled_until < v <= target
+            ]
+            for v, c in to_backfill:
+                src_store = logstore_for_path(c.file_status.path)
+                data = src_store.read(c.file_status.path)
+                dest = filenames.delta_file(log_path, v)
+                try:
+                    logstore_for_path(dest).write(dest, data, overwrite=False)
+                except FileExistsError:
+                    pass  # someone else backfilled
+                st.backfilled_until = v
+            # drop backfilled entries (readers find them via listing now)
+            for v, _ in to_backfill:
+                st.commits.pop(v, None)
+
+
+_REGISTRY: Dict[str, CommitCoordinatorClient] = {}
+
+
+def register_coordinator(name: str, client: CommitCoordinatorClient) -> None:
+    _REGISTRY[name] = client
+
+
+def coordinator_for_table(metadata_configuration: Dict[str, str]) -> Optional[CommitCoordinatorClient]:
+    name = metadata_configuration.get(COORDINATOR_NAME_KEY)
+    if name is None:
+        return None
+    client = _REGISTRY.get(name)
+    if client is None:
+        raise KeyError(
+            f"commit coordinator {name!r} is not registered in this process"
+        )
+    return client

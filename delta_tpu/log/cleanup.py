@@ -1,0 +1,129 @@
+"""Metadata cleanup (expire old commits) and log compaction.
+
+- `cleanup_expired_logs`: delete commit/checkpoint files older than
+  `delta.logRetentionDuration` that are shadowed by a newer checkpoint
+  (reference `MetadataCleanup.scala:64,155`; never deletes past the most
+  recent complete checkpoint — reconstructability invariant).
+- `write_compacted_delta`: write `<lo>.<hi>.compacted.json` containing
+  the reconciled actions of the commit range (PROTOCOL.md:270); listing
+  substitutes it for the singles (delta_tpu.log.segment._apply_compaction).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import (
+    Action,
+    AddFile,
+    CommitInfo,
+    DomainMetadata,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    actions_from_commit_bytes,
+    actions_to_commit_bytes,
+)
+from delta_tpu.utils import filenames
+from delta_tpu.utils.filenames import CheckpointInstance, group_complete_checkpoints
+
+
+def cleanup_expired_logs(
+    table,
+    retention_ms: Optional[int] = None,
+    now_ms: Optional[int] = None,
+) -> List[str]:
+    """Delete expired, checkpoint-shadowed log files. Returns deleted paths."""
+    from delta_tpu.config import LOG_RETENTION, get_table_config
+
+    engine = table.engine
+    snap = table.latest_snapshot()
+    if retention_ms is None:
+        retention_ms = get_table_config(snap.metadata.configuration, LOG_RETENTION)
+    now = now_ms if now_ms is not None else int(time.time() * 1000)
+    cutoff = now - retention_ms
+
+    listing = list(engine.fs.list_from(filenames.listing_prefix(table.log_path, 0)))
+    checkpoints = [
+        ci for f in listing
+        if (ci := CheckpointInstance.parse(f.path)) is not None
+    ]
+    complete = group_complete_checkpoints(checkpoints)
+    if not complete:
+        return []  # nothing shadowed; keep everything
+    newest_cp_version = complete[-1][0].version
+
+    deleted = []
+    for f in listing:
+        name = filenames.file_name(f.path)
+        version = None
+        if filenames.DELTA_FILE_RE.match(name):
+            version = filenames.delta_version(f.path)
+        elif filenames.CHECKSUM_FILE_RE.match(name):
+            version = filenames.checksum_version(f.path)
+        elif filenames.COMPACTED_DELTA_FILE_RE.match(name):
+            _, version = filenames.compacted_delta_versions(f.path)
+        elif filenames.CHECKPOINT_FILE_RE.match(name):
+            version = filenames.checkpoint_version(f.path)
+            if version >= newest_cp_version:
+                continue  # never delete the active checkpoint
+        if version is None:
+            continue
+        if version < newest_cp_version and f.modification_time < cutoff:
+            try:
+                engine.fs.delete(f.path)
+                deleted.append(f.path)
+            except FileNotFoundError:
+                pass
+    return deleted
+
+
+def write_compacted_delta(table, from_version: int, to_version: int) -> str:
+    """Reconcile commits [from, to] into one compacted file."""
+    if to_version <= from_version:
+        raise DeltaError("compaction range must span at least two commits")
+    engine = table.engine
+    # Sequential reconciliation of the range (small: it's a commit range,
+    # not a full table state).
+    protocol = None
+    metadata = None
+    txns = {}
+    domains = {}
+    adds = {}
+    removes = {}
+    for v in range(from_version, to_version + 1):
+        data = engine.fs.read_file(filenames.delta_file(table.log_path, v))
+        for a in actions_from_commit_bytes(data):
+            if isinstance(a, Protocol):
+                protocol = a
+            elif isinstance(a, Metadata):
+                metadata = a
+            elif isinstance(a, SetTransaction):
+                txns[a.appId] = a
+            elif isinstance(a, DomainMetadata):
+                domains[a.domain] = a
+            elif isinstance(a, AddFile):
+                key = (a.path, a.dv_unique_id)
+                removes.pop(key, None)
+                adds[key] = a
+            elif isinstance(a, RemoveFile):
+                key = (a.path, a.dv_unique_id)
+                adds.pop(key, None)
+                removes[key] = a
+    out: List[Action] = []
+    if protocol is not None:
+        out.append(protocol)
+    if metadata is not None:
+        out.append(metadata)
+    out.extend(txns.values())
+    out.extend(domains.values())
+    out.extend(removes.values())
+    out.extend(adds.values())
+    path = filenames.compacted_delta_file(table.log_path, from_version, to_version)
+    engine.json.write_json_file_atomically(
+        path, actions_to_commit_bytes(out), overwrite=False
+    )
+    return path

@@ -25,6 +25,7 @@ class Table:
         self.log_path = f"{self.path}/{filenames.LOG_DIR_NAME}"
         self._lock = threading.Lock()
         self._cached_snapshot: Optional[Snapshot] = None
+        self._coordinated = False  # learned from the last metadata read
 
     @staticmethod
     def for_path(path: str, engine=None) -> "Table":
@@ -42,7 +43,8 @@ class Table:
     def latest_snapshot(self) -> Snapshot:
         """LIST the log (from the `_last_checkpoint` hint) and return the
         newest snapshot; reuses the cached state when the version is
-        unchanged."""
+        unchanged. Coordinated-commit tables additionally merge the
+        coordinator's unbackfilled commits (`Snapshot.scala:166-220`)."""
         hint = read_last_checkpoint(self.engine.fs, self.log_path)
         segment = build_log_segment(
             self.engine.fs,
@@ -52,11 +54,59 @@ class Table:
         )
         with self._lock:
             cached = self._cached_snapshot
-            if cached is not None and cached.version == segment.version:
+        if (
+            cached is not None
+            and cached.version == segment.version
+            and not self._coordinated
+        ):
+            return cached
+        snap = Snapshot(self, segment)
+        merged = self._merge_unbackfilled(snap, segment)
+        if merged is not segment:
+            snap = Snapshot(self, merged)
+        with self._lock:
+            cached = self._cached_snapshot
+            if cached is not None and cached.version == snap.version:
                 return cached
-            snap = Snapshot(self, segment)
             self._cached_snapshot = snap
             return snap
+
+    def _merge_unbackfilled(self, probe: Snapshot, segment):
+        """Extend the listed segment with the commit coordinator's
+        unbackfilled `_commits/` files, when the table uses one."""
+        try:
+            meta_conf = probe.metadata.configuration
+        except Exception:
+            return segment
+        from delta_tpu.coordinatedcommits import coordinator_for_table
+
+        try:
+            coordinator = coordinator_for_table(meta_conf)
+        except KeyError:
+            return segment
+        self._coordinated = coordinator is not None
+        if coordinator is None:
+            return segment
+        resp = coordinator.get_commits(self.log_path, segment.version + 1)
+        extra = []
+        next_v = segment.version + 1
+        for c in sorted(resp.commits, key=lambda c: c.version):
+            if c.version == next_v:
+                extra.append(c.file_status)
+                next_v += 1
+        if not extra:
+            return segment
+        import dataclasses
+
+        return dataclasses.replace(
+            segment,
+            version=next_v - 1,
+            deltas=list(segment.deltas) + extra,
+            last_commit_timestamp=max(
+                segment.last_commit_timestamp,
+                max(f.modification_time for f in extra),
+            ),
+        )
 
     update = latest_snapshot
 

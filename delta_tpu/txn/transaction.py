@@ -399,6 +399,55 @@ class Transaction:
             isolation=self._isolation_level(),
         )
 
+    def _coordinator(self):
+        meta = self.metadata()
+        if meta is None:
+            return None
+        from delta_tpu.coordinatedcommits import coordinator_for_table
+
+        return coordinator_for_table(meta.configuration)
+
+    def _write_commit(self, engine, log_path: str, version: int, data: bytes) -> None:
+        """One commit attempt: put-if-absent file write, or coordinator RPC
+        for coordinated-commit tables. Raises FileExistsError on loss."""
+        coordinator = self._coordinator()
+        if coordinator is None:
+            path = filenames.delta_file(log_path, version)
+            engine.json.write_json_file_atomically(path, data, overwrite=False)
+            return
+        import time as _time
+
+        from delta_tpu.coordinatedcommits import CommitFailedException
+
+        try:
+            coordinator.commit(log_path, version, data, int(_time.time() * 1000))
+        except CommitFailedException as e:
+            if e.conflict:
+                raise FileExistsError(str(e)) from e
+            raise CommitFailedError(str(e), retryable=e.retryable) from e
+
+    def _read_commit_range(self, engine, log_path: str, lo: int, hi: int):
+        """Winning commits [lo, hi] — backfilled files or coordinator
+        unbackfilled entries."""
+        coordinator = self._coordinator()
+        unbackfilled = {}
+        if coordinator is not None:
+            resp = coordinator.get_commits(log_path, lo, hi)
+            for c in resp.commits:
+                unbackfilled[c.version] = c.file_status.path
+        from delta_tpu.models.actions import actions_from_commit_bytes
+        from delta_tpu.txn.conflict import WinningCommit
+
+        out = []
+        for v in range(lo, hi + 1):
+            path = unbackfilled.get(v, filenames.delta_file(log_path, v))
+            try:
+                data = engine.fs.read_file(path)
+            except FileNotFoundError:
+                data = engine.fs.read_file(filenames.delta_file(log_path, v))
+            out.append(WinningCommit(v, actions_from_commit_bytes(data)))
+        return out
+
     def commit(self) -> CommitResult:
         """doCommitRetryIteratively (`OptimisticTransaction.scala:2198`)."""
         if self._committed:
@@ -415,17 +464,16 @@ class Transaction:
                 self.observer.before_commit_attempt(self, attempt_version)
             actions = self._prepare_actions(attempt_version, winners_ict)
             data = actions_to_commit_bytes(actions)
-            path = filenames.delta_file(log_path, attempt_version)
             try:
-                engine.json.write_json_file_atomically(path, data, overwrite=False)
+                self._write_commit(engine, log_path, attempt_version, data)
             except FileExistsError:
                 if self.observer:
                     self.observer.on_commit_conflict(self, attempt_version)
                 # We lost the race: find the current latest, check logical
                 # conflicts against every winner, rebase, retry.
                 latest = self._latest_version(engine, log_path, attempt_version)
-                winners = read_winning_commits(
-                    engine.fs, log_path, attempt_version, latest
+                winners = self._read_commit_range(
+                    engine, log_path, attempt_version, latest
                 )
                 rebase = check_conflicts(self._read_state(), winners)
                 for w in winners:
@@ -458,6 +506,11 @@ class Transaction:
         for fstat in engine.fs.list_from(prefix):
             if filenames.is_delta_file(fstat.path):
                 latest = max(latest, filenames.delta_version(fstat.path))
+        coordinator = self._coordinator()
+        if coordinator is not None:
+            latest = max(
+                latest, coordinator.get_commits(log_path).latest_table_version
+            )
         return latest
 
     def _run_post_commit_hooks(self, version: int) -> None:

@@ -1,0 +1,210 @@
+"""Deterministic two-writer races via the phase-locking observer, plus
+coordinated-commit behavior."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.concurrency import PhaseLockingObserver, run_txn_async
+from delta_tpu.coordinatedcommits import (
+    COORDINATOR_NAME_KEY,
+    InMemoryCommitCoordinator,
+    register_coordinator,
+)
+from delta_tpu.errors import (
+    ConcurrentAppendError,
+    ConcurrentDeleteDeleteError,
+    ConcurrentTransactionError,
+    MetadataChangedError,
+)
+from delta_tpu.models.actions import AddFile
+from delta_tpu.table import Table
+from delta_tpu.txn.isolation import IsolationLevel
+
+
+def _batch(start, n):
+    return pa.table({"id": pa.array(np.arange(start, start + n, dtype=np.int64))})
+
+
+def _add(path, size=10):
+    return AddFile(path=path, size=size, modificationTime=1, dataChange=True)
+
+
+def test_blind_append_race_rebases(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+
+    obs = PhaseLockingObserver(block_before_commit=True)
+    txn_a = table.start_transaction()
+    txn_a.add_file(_add("a.parquet"))
+    txn_a.observer = obs
+    thread = run_txn_async(txn_a.commit)
+    obs.before_commit_barrier.wait_for_arrival()
+
+    # B wins the race while A is parked before its write
+    txn_b = table.start_transaction()
+    txn_b.add_file(_add("b.parquet"))
+    res_b = txn_b.commit()
+    assert res_b.version == 1
+
+    obs.before_commit_barrier.unblock()
+    res_a = thread.join_result()
+    assert res_a.version == 2          # rebased past B
+    assert res_a.attempts == 2
+    kinds = [k for k, _ in obs.events]
+    assert kinds == ["attempt", "conflict", "attempt", "committed"]
+
+    snap = table.latest_snapshot()
+    paths = set(snap.state.add_files_table.column("path").to_pylist())
+    assert {"a.parquet", "b.parquet"} <= paths
+
+
+def test_delete_delete_conflict(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    victim = table.latest_snapshot().state.add_files()[0]
+
+    obs = PhaseLockingObserver(block_before_commit=True)
+    txn_a = table.start_transaction("DELETE")
+    txn_a.remove_file(victim.remove(deletion_timestamp=1))
+    txn_a.observer = obs
+    thread = run_txn_async(txn_a.commit)
+    obs.before_commit_barrier.wait_for_arrival()
+
+    txn_b = table.start_transaction("DELETE")
+    txn_b.remove_file(victim.remove(deletion_timestamp=2))
+    txn_b.commit()
+
+    obs.before_commit_barrier.unblock()
+    with pytest.raises(ConcurrentDeleteDeleteError):
+        thread.join_result()
+
+
+def test_read_append_conflict_serializable(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+
+    txn_a = table.start_transaction()
+    txn_a._isolation = IsolationLevel.SERIALIZABLE
+    txn_a.scan_files()  # reads whole table
+    txn_a.add_file(_add("a2.parquet"))
+
+    txn_b = table.start_transaction()
+    txn_b.add_file(_add("b2.parquet"))
+    txn_b.commit()
+
+    with pytest.raises(ConcurrentAppendError):
+        txn_a.commit()
+
+
+def test_blind_append_no_conflict_write_serializable(tmp_table_path):
+    """Under WriteSerializable a blind append doesn't conflict with a
+    reader's snapshot."""
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+
+    txn_a = table.start_transaction()
+    txn_a.scan_files()
+    txn_a.add_file(_add("a3.parquet"))
+
+    txn_b = table.start_transaction()  # blind append
+    txn_b.add_file(_add("b3.parquet"))
+    txn_b.commit()
+
+    res = txn_a.commit()  # WriteSerializable default: rebase succeeds
+    assert res.version == 2
+
+
+def test_metadata_change_conflict(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    import dataclasses
+
+    txn_a = table.start_transaction()
+    txn_a.add_file(_add("x.parquet"))
+
+    txn_b = table.start_transaction("SET TBLPROPERTIES")
+    meta = txn_b.metadata()
+    txn_b.update_metadata(
+        dataclasses.replace(
+            meta, configuration={**meta.configuration, "foo": "bar"}
+        )
+    )
+    txn_b.commit()
+
+    with pytest.raises(MetadataChangedError):
+        txn_a.commit()
+
+
+def test_set_transaction_conflict(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+
+    txn_a = table.start_transaction()
+    txn_a.set_transaction_id("app1", 5)
+    txn_a.add_file(_add("y.parquet"))
+
+    txn_b = table.start_transaction()
+    txn_b.set_transaction_id("app1", 4)
+    txn_b.add_file(_add("z.parquet"))
+    txn_b.commit()
+
+    with pytest.raises(ConcurrentTransactionError):
+        txn_a.commit()
+
+
+# ---------------------------------------------------------------------------
+# coordinated commits
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def coordinated_path(tmp_table_path):
+    register_coordinator("test-coord", InMemoryCommitCoordinator(batch_size=3))
+    dta.write_table(
+        tmp_table_path, _batch(0, 5),
+        properties={COORDINATOR_NAME_KEY: "test-coord"},
+    )
+    return tmp_table_path
+
+
+def test_coordinated_commit_unbackfilled_reads(coordinated_path):
+    import os
+
+    table = Table.for_path(coordinated_path)
+    dta.write_table(coordinated_path, _batch(5, 5))   # v1 -> unbackfilled
+    dta.write_table(coordinated_path, _batch(10, 5))  # v2 -> unbackfilled
+    log_dir = os.path.join(coordinated_path, "_delta_log")
+    backfilled = [f for f in os.listdir(log_dir) if f.endswith(".json") and "." not in f[:-5]]
+    # v1, v2 not yet backfilled (batch_size=3), but reads see them
+    assert not os.path.exists(os.path.join(log_dir, "00000000000000000002.json"))
+    out = dta.read_table(coordinated_path)
+    assert out.num_rows == 15
+    snap = Table.for_path(coordinated_path).latest_snapshot()
+    assert snap.version == 2
+    # v3 triggers batch backfill
+    dta.write_table(coordinated_path, _batch(15, 5))
+    assert os.path.exists(os.path.join(log_dir, "00000000000000000003.json"))
+    assert dta.read_table(coordinated_path).num_rows == 20
+
+
+def test_coordinated_commit_race(coordinated_path):
+    table = Table.for_path(coordinated_path)
+    obs = PhaseLockingObserver(block_before_commit=True)
+    txn_a = table.start_transaction()
+    txn_a.add_file(_add("ca.parquet"))
+    txn_a.observer = obs
+    thread = run_txn_async(txn_a.commit)
+    obs.before_commit_barrier.wait_for_arrival()
+
+    txn_b = Table.for_path(coordinated_path).start_transaction()
+    txn_b.add_file(_add("cb.parquet"))
+    vb = txn_b.commit().version
+
+    obs.before_commit_barrier.unblock()
+    res_a = thread.join_result()
+    assert res_a.version == vb + 1
+    snap = Table.for_path(coordinated_path).latest_snapshot()
+    paths = set(snap.state.add_files_table.column("path").to_pylist())
+    assert {"ca.parquet", "cb.parquet"} <= paths
