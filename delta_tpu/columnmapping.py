@@ -1,0 +1,156 @@
+"""Column mapping: logical→physical column indirection.
+
+Reference `DeltaColumnMapping.scala:93-120`: modes `none` | `name` | `id`.
+Under `name`/`id` every field carries `delta.columnMapping.id` (stable
+int) and `delta.columnMapping.physicalName` (`col-<uuid>`) in its
+metadata; Parquet files use physical names, so renaming/dropping a
+logical column is a metadata-only operation.
+
+This module assigns mapping metadata, rewrites schemas between logical
+and physical forms, and provides the rename/drop transformations ALTER
+TABLE uses.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, Optional
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import Metadata
+from delta_tpu.models.schema import (
+    COLUMN_MAPPING_ID_KEY,
+    COLUMN_MAPPING_PHYSICAL_NAME_KEY,
+    ArrayType,
+    DataType,
+    MapType,
+    StructField,
+    StructType,
+)
+
+MODE_KEY = "delta.columnMapping.mode"
+MAX_ID_KEY = "delta.columnMapping.maxColumnId"
+
+
+def mapping_mode(configuration: Dict[str, str]) -> str:
+    return configuration.get(MODE_KEY, "none")
+
+
+def _assign_in_type(dt: DataType, next_id) -> DataType:
+    if isinstance(dt, StructType):
+        return StructType([_assign_field(f, next_id) for f in dt.fields])
+    if isinstance(dt, ArrayType):
+        return ArrayType(_assign_in_type(dt.elementType, next_id), dt.containsNull)
+    if isinstance(dt, MapType):
+        return MapType(
+            _assign_in_type(dt.keyType, next_id),
+            _assign_in_type(dt.valueType, next_id),
+            dt.valueContainsNull,
+        )
+    return dt
+
+
+def _assign_field(f: StructField, next_id) -> StructField:
+    md = dict(f.metadata)
+    if COLUMN_MAPPING_ID_KEY not in md:
+        md[COLUMN_MAPPING_ID_KEY] = next_id()
+    if COLUMN_MAPPING_PHYSICAL_NAME_KEY not in md:
+        md[COLUMN_MAPPING_PHYSICAL_NAME_KEY] = f"col-{uuid.uuid4()}"
+    return StructField(f.name, _assign_in_type(f.dataType, next_id), f.nullable, md)
+
+
+def assign_column_mapping(schema: StructType, configuration: Dict[str, str]) -> tuple:
+    """Assign ids/physical names to all fields lacking them. Returns
+    (new schema, new configuration with bumped maxColumnId)."""
+    max_id = int(configuration.get(MAX_ID_KEY, "0"))
+
+    def next_id():
+        nonlocal max_id
+        max_id += 1
+        return max_id
+
+    new_schema = StructType([_assign_field(f, next_id) for f in schema.fields])
+    new_conf = dict(configuration)
+    new_conf[MAX_ID_KEY] = str(max_id)
+    return new_schema, new_conf
+
+
+def physical_schema(schema: StructType) -> StructType:
+    """Logical schema → physical (names replaced, metadata kept)."""
+
+    def conv_type(dt: DataType) -> DataType:
+        if isinstance(dt, StructType):
+            return StructType(
+                [
+                    StructField(
+                        f.physical_name, conv_type(f.dataType), f.nullable, dict(f.metadata)
+                    )
+                    for f in dt.fields
+                ]
+            )
+        if isinstance(dt, ArrayType):
+            return ArrayType(conv_type(dt.elementType), dt.containsNull)
+        if isinstance(dt, MapType):
+            return MapType(conv_type(dt.keyType), conv_type(dt.valueType), dt.valueContainsNull)
+        return dt
+
+    return conv_type(schema)  # type: ignore[return-value]
+
+
+def logical_to_physical_names(schema: StructType) -> Dict[str, str]:
+    return {f.name: f.physical_name for f in schema.fields}
+
+
+def physical_to_logical_names(schema: StructType) -> Dict[str, str]:
+    return {f.physical_name: f.name for f in schema.fields}
+
+
+def physical_name_path(schema: StructType, name_path: tuple) -> Optional[tuple]:
+    """Translate a logical column path to its physical path (None if any
+    segment is missing)."""
+    out = []
+    cur: Optional[DataType] = schema
+    for part in name_path:
+        if not isinstance(cur, StructType) or part not in cur:
+            return None
+        f = cur[part]
+        out.append(f.physical_name)
+        cur = f.dataType
+    return tuple(out)
+
+
+def validate_mode_change(old_mode: str, new_mode: str) -> None:
+    """Legal transitions: none->name, none->id (on new tables), same->same.
+    name/id cannot be dropped (`DeltaColumnMapping` restrictions)."""
+    if old_mode == new_mode:
+        return
+    if old_mode == "none" and new_mode in ("name", "id"):
+        return
+    raise DeltaError(
+        f"unsupported column mapping mode change {old_mode} -> {new_mode}"
+    )
+
+
+def rename_column(schema: StructType, old: str, new: str) -> StructType:
+    """Metadata-only rename (requires mapping mode != none)."""
+    if new in schema:
+        raise DeltaError(f"column {new} already exists")
+    fields = []
+    found = False
+    for f in schema.fields:
+        if f.name == old:
+            fields.append(StructField(new, f.dataType, f.nullable, dict(f.metadata)))
+            found = True
+        else:
+            fields.append(f)
+    if not found:
+        raise DeltaError(f"column {old} not found")
+    return StructType(fields)
+
+
+def drop_column(schema: StructType, name: str) -> StructType:
+    if name not in schema:
+        raise DeltaError(f"column {name} not found")
+    if len(schema.fields) == 1:
+        raise DeltaError("cannot drop the last column")
+    return StructType([f for f in schema.fields if f.name != name])

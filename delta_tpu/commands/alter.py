@@ -1,0 +1,206 @@
+"""ALTER TABLE operations: columns, properties, protocol, column mapping.
+
+Mirrors the reference's `AlterDeltaTableCommand` family
+(`commands/alterDeltaTableCommands.scala`): each operation is a
+metadata/protocol-only transaction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from delta_tpu.columnmapping import (
+    MODE_KEY,
+    assign_column_mapping,
+    drop_column as _drop_from_schema,
+    mapping_mode,
+    rename_column as _rename_in_schema,
+    validate_mode_change,
+)
+from delta_tpu.errors import DeltaError, SchemaMismatchError
+from delta_tpu.features import FEATURES, upgraded_protocol
+from delta_tpu.models.schema import (
+    DataType,
+    StructField,
+    StructType,
+    schema_from_json,
+    schema_to_json,
+)
+from delta_tpu.schema_evolution import can_widen
+from delta_tpu.txn.transaction import Operation
+
+
+def _metadata_txn(table, operation: str):
+    txn = table.create_transaction_builder(operation).build()
+    if txn.read_snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    return txn
+
+
+def _commit_schema(txn, new_schema: StructType, operation_params: Dict,
+                   new_conf: Optional[Dict[str, str]] = None) -> int:
+    meta = txn.metadata()
+    replacement = dataclasses.replace(
+        meta,
+        schemaString=schema_to_json(new_schema),
+        configuration=dict(new_conf if new_conf is not None else meta.configuration),
+    )
+    txn.update_metadata(replacement)
+    txn.set_operation_parameters(operation_params)
+    return txn.commit().version
+
+
+def add_columns(table, columns: Sequence[StructField]) -> int:
+    """ADD COLUMNS (always nullable; appended at the end)."""
+    txn = _metadata_txn(table, Operation.ADD_COLUMNS)
+    meta = txn.metadata()
+    schema = schema_from_json(meta.schemaString)
+    conf = dict(meta.configuration)
+    new_fields = []
+    for f in columns:
+        if f.name in schema:
+            raise SchemaMismatchError(f"column {f.name} already exists")
+        if not f.nullable:
+            raise DeltaError("added columns must be nullable")
+        new_fields.append(f)
+    new_schema = StructType(schema.fields + list(new_fields))
+    if mapping_mode(conf) != "none":
+        new_schema, conf = assign_column_mapping(new_schema, conf)
+    return _commit_schema(
+        txn, new_schema, {"columns": [f.name for f in columns]}, conf
+    )
+
+
+def rename_column(table, old: str, new: str) -> int:
+    """RENAME COLUMN — metadata-only; requires column mapping."""
+    txn = _metadata_txn(table, Operation.RENAME_COLUMN)
+    meta = txn.metadata()
+    if mapping_mode(meta.configuration) == "none":
+        raise DeltaError(
+            "RENAME COLUMN requires column mapping "
+            "(set delta.columnMapping.mode = 'name')"
+        )
+    schema = schema_from_json(meta.schemaString)
+    new_schema = _rename_in_schema(schema, old, new)
+    partition_cols = [
+        new if c == old else c for c in meta.partitionColumns
+    ]
+    replacement = dataclasses.replace(
+        meta,
+        schemaString=schema_to_json(new_schema),
+        partitionColumns=partition_cols,
+    )
+    txn.update_metadata(replacement)
+    txn.set_operation_parameters({"oldName": old, "newName": new})
+    return txn.commit().version
+
+
+def drop_column(table, name: str) -> int:
+    """DROP COLUMN — metadata-only; requires column mapping."""
+    txn = _metadata_txn(table, Operation.DROP_COLUMNS)
+    meta = txn.metadata()
+    if mapping_mode(meta.configuration) == "none":
+        raise DeltaError(
+            "DROP COLUMN requires column mapping "
+            "(set delta.columnMapping.mode = 'name')"
+        )
+    if name in meta.partitionColumns:
+        raise DeltaError(f"cannot drop partition column {name}")
+    schema = schema_from_json(meta.schemaString)
+    new_schema = _drop_from_schema(schema, name)
+    return _commit_schema(txn, new_schema, {"column": name})
+
+
+def change_column_type(table, name: str, new_type: DataType) -> int:
+    """CHANGE COLUMN TYPE — only widening changes, gated on the
+    typeWidening feature."""
+    txn = _metadata_txn(table, Operation.CHANGE_COLUMN)
+    meta = txn.metadata()
+    schema = schema_from_json(meta.schemaString)
+    if name not in schema:
+        raise SchemaMismatchError(f"column {name} not found")
+    f = schema[name]
+    if not can_widen(f.dataType, new_type):
+        raise DeltaError(
+            f"unsupported type change {f.dataType.to_json_value()} -> "
+            f"{new_type.to_json_value()} (only widening changes allowed)"
+        )
+    if meta.configuration.get("delta.enableTypeWidening", "").lower() != "true":
+        raise DeltaError("set delta.enableTypeWidening = true first")
+    new_fields = [
+        StructField(x.name, new_type, x.nullable, dict(x.metadata))
+        if x.name == name
+        else x
+        for x in schema.fields
+    ]
+    # upgrade protocol for the typeWidening feature
+    proto = upgraded_protocol(txn.protocol(), FEATURES["typeWidening"])
+    if proto != txn.protocol():
+        txn.update_protocol(proto)
+    return _commit_schema(
+        txn, StructType(new_fields),
+        {"column": name, "newType": new_type.to_json_value()},
+    )
+
+
+def set_properties(table, properties: Dict[str, str]) -> int:
+    txn = _metadata_txn(table, Operation.SET_TBLPROPERTIES)
+    meta = txn.metadata()
+    conf = dict(meta.configuration)
+    old_mode = mapping_mode(conf)
+    conf.update(properties)
+    new_mode = mapping_mode(conf)
+    schema = schema_from_json(meta.schemaString)
+    if old_mode != new_mode:
+        validate_mode_change(old_mode, new_mode)
+        schema, conf = assign_column_mapping(schema, conf)
+        proto = upgraded_protocol(txn.protocol(), FEATURES["columnMapping"])
+        if proto != txn.protocol():
+            txn.update_protocol(proto)
+    # feature-activating properties may demand protocol upgrades
+    for feat in FEATURES.values():
+        if feat.activated_by is not None:
+            probe = dataclasses.replace(meta, configuration=conf)
+            if feat.activated_by(probe):
+                proto = upgraded_protocol(txn.protocol(), feat)
+                if proto != txn.protocol():
+                    txn.update_protocol(proto)
+    return _commit_schema(txn, schema, {"properties": dict(properties)}, conf)
+
+
+def unset_properties(table, keys: Sequence[str]) -> int:
+    txn = _metadata_txn(table, Operation.SET_TBLPROPERTIES)
+    meta = txn.metadata()
+    conf = {k: v for k, v in meta.configuration.items() if k not in set(keys)}
+    replacement = dataclasses.replace(meta, configuration=conf)
+    txn.update_metadata(replacement)
+    txn.set_operation_parameters({"unset": list(keys)})
+    return txn.commit().version
+
+
+def upgrade_protocol(table, min_reader: Optional[int] = None,
+                     min_writer: Optional[int] = None,
+                     feature: Optional[str] = None) -> int:
+    txn = _metadata_txn(table, Operation.UPGRADE_PROTOCOL)
+    proto = txn.protocol()
+    if feature is not None:
+        if feature not in FEATURES:
+            raise DeltaError(f"unknown table feature {feature}")
+        new_proto = upgraded_protocol(proto, FEATURES[feature])
+    else:
+        new_proto = dataclasses.replace(
+            proto,
+            minReaderVersion=max(proto.minReaderVersion, min_reader or 0),
+            minWriterVersion=max(proto.minWriterVersion, min_writer or 0),
+        )
+    if new_proto == proto:
+        return txn.read_version
+    if (new_proto.minReaderVersion < proto.minReaderVersion
+            or new_proto.minWriterVersion < proto.minWriterVersion):
+        raise DeltaError("protocol downgrade is not allowed")
+    txn.update_protocol(new_proto)
+    txn.set_operation_parameters(
+        {"newProtocol": new_proto.to_dict()}
+    )
+    return txn.commit().version

@@ -1,0 +1,90 @@
+"""CHECK constraints + invariants.
+
+Reference `constraints/Constraints.scala` / `Invariants.scala`: CHECK
+constraints persist as `delta.constraints.<name> = <sql>` table
+properties and are enforced on every write; NOT NULL comes from schema
+nullability (enforced in the writer). Adding a constraint validates the
+existing data first (`AlterTableAddConstraint`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from delta_tpu.errors import DeltaError, InvariantViolationError
+from delta_tpu.expressions.parser import parse_expression, to_sql
+from delta_tpu.expressions.tree import Expression
+
+CONSTRAINT_PREFIX = "delta.constraints."
+
+
+def constraint_key(name: str) -> str:
+    return CONSTRAINT_PREFIX + name.lower()
+
+
+def table_constraints(configuration: Dict[str, str]) -> Dict[str, Expression]:
+    """name -> parsed predicate, from table properties."""
+    out = {}
+    for k, v in configuration.items():
+        if k.startswith(CONSTRAINT_PREFIX):
+            out[k[len(CONSTRAINT_PREFIX):]] = parse_expression(v)
+    return out
+
+
+def add_constraint(table, name: str, expr) -> int:
+    """ALTER TABLE ADD CONSTRAINT name CHECK (expr). Validates existing
+    rows before committing. Returns the commit version."""
+    import dataclasses
+
+    import numpy as np
+    import pyarrow as pa
+
+    from delta_tpu.expressions.eval import evaluate_predicate_host
+    from delta_tpu.txn.transaction import Operation
+
+    if isinstance(expr, str):
+        expr = parse_expression(expr)
+    txn = table.create_transaction_builder(Operation.ADD_CONSTRAINT).build()
+    snapshot = txn.read_snapshot
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    meta = snapshot.metadata
+    key = constraint_key(name)
+    if key in meta.configuration:
+        raise DeltaError(f"constraint {name} already exists")
+
+    # validate current data
+    data = snapshot.scan().to_arrow()
+    if data.num_rows:
+        ok = evaluate_predicate_host(expr, data)
+        bad = int((~np.asarray(ok)).sum())
+        if bad:
+            raise InvariantViolationError(
+                f"{bad} existing row(s) violate new constraint {name}: "
+                f"{to_sql(expr)}"
+            )
+    txn.mark_read_whole_table()
+
+    new_conf = dict(meta.configuration)
+    new_conf[key] = to_sql(expr)
+    txn.update_metadata(dataclasses.replace(meta, configuration=new_conf))
+    txn.set_operation_parameters({"name": name, "expr": to_sql(expr)})
+    return txn.commit().version
+
+
+def drop_constraint(table, name: str, if_exists: bool = False) -> int:
+    import dataclasses
+
+    from delta_tpu.txn.transaction import Operation
+
+    txn = table.create_transaction_builder(Operation.DROP_CONSTRAINT).build()
+    meta = txn.metadata()
+    key = constraint_key(name)
+    if key not in meta.configuration:
+        if if_exists:
+            return txn.read_version
+        raise DeltaError(f"constraint {name} does not exist")
+    new_conf = {k: v for k, v in meta.configuration.items() if k != key}
+    txn.update_metadata(dataclasses.replace(meta, configuration=new_conf))
+    txn.set_operation_parameters({"name": name})
+    return txn.commit().version

@@ -39,26 +39,42 @@ def _dv_row_mask(engine, table_path: str, dv_row: dict, num_rows: int) -> Option
 
 
 def read_scan(scan) -> pa.Table:
+    from delta_tpu.columnmapping import (
+        logical_to_physical_names,
+        mapping_mode,
+        physical_to_logical_names,
+    )
+
     snapshot = scan.snapshot
     engine = snapshot._engine
     table_path = snapshot.table_path
     schema = snapshot.schema
+    meta = snapshot.metadata
     partition_columns = snapshot.partition_columns
     files = scan.add_files_table()
+
+    mapped = mapping_mode(meta.configuration) != "none" and schema is not None
+    l2p = logical_to_physical_names(schema) if mapped else {}
+    p2l = physical_to_logical_names(schema) if mapped else {}
 
     requested = scan.columns
     data_columns = None
     if requested is not None:
-        data_columns = [c for c in requested if c not in partition_columns]
+        data_columns = [
+            l2p.get(c, c) for c in requested if c not in partition_columns
+        ]
 
     ptypes = {}
     for c in partition_columns:
         dtype = PrimitiveType("string")
+        pv_key = c
         if schema is not None and c in schema:
             f = schema[c]
             if isinstance(f.dataType, PrimitiveType):
                 dtype = f.dataType
-        ptypes[c] = dtype
+            if mapped:
+                pv_key = f.physical_name
+        ptypes[c] = (pv_key, dtype)
 
     batches: List[pa.Table] = []
     paths = files.column("path").to_pylist()
@@ -66,7 +82,28 @@ def read_scan(scan) -> pa.Table:
     dvs = files.column("deletion_vector").to_pylist()
     for path, pv, dv in zip(paths, pvs, dvs):
         abs_path = _absolute_path(table_path, path)
-        tbl = next(iter(engine.parquet.read_parquet_files([abs_path], columns=data_columns)))
+        try:
+            tbl = next(
+                iter(engine.parquet.read_parquet_files([abs_path], columns=data_columns))
+            )
+        except (pa.ArrowInvalid, KeyError):
+            # file predates newly added columns — read everything it has
+            tbl = next(iter(engine.parquet.read_parquet_files([abs_path])))
+        if mapped:
+            tbl = tbl.rename_columns([p2l.get(c, c) for c in tbl.column_names])
+        if schema is not None:
+            # align to the logical schema: dropped columns disappear,
+            # columns added after this file was written read as null
+            known = [f.name for f in schema.fields if f.name not in partition_columns]
+            tbl = tbl.select([c for c in tbl.column_names if c in set(known)])
+            for f in schema.fields:
+                if f.name in partition_columns or f.name in tbl.column_names:
+                    continue
+                if requested is not None and f.name not in requested:
+                    continue
+                tbl = tbl.append_column(
+                    f.name, pa.nulls(tbl.num_rows, to_arrow_type(f.dataType))
+                )
         mask = _dv_row_mask(engine, table_path, dv, tbl.num_rows)
         if mask is not None:
             tbl = tbl.filter(pa.array(mask))
@@ -74,8 +111,9 @@ def read_scan(scan) -> pa.Table:
         for c in partition_columns:
             if requested is not None and c not in requested:
                 continue
-            value = deserialize_partition_value(pv_dict.get(c), ptypes[c])
-            arr = pa.array([value] * tbl.num_rows, to_arrow_type(ptypes[c]))
+            pv_key, dtype = ptypes[c]
+            value = deserialize_partition_value(pv_dict.get(pv_key), dtype)
+            arr = pa.array([value] * tbl.num_rows, to_arrow_type(dtype))
             tbl = tbl.append_column(c, arr)
         batches.append(tbl)
 

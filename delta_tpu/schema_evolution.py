@@ -1,0 +1,109 @@
+"""Schema evolution: merge-on-write, type widening.
+
+Reference `schema/SchemaMergingUtils.scala` + `TypeWidening.scala`:
+- `merge_schemas(current, incoming)`: incoming may ADD nullable columns
+  (appended in order) and, when widening is allowed, widen primitive
+  types along safe chains; anything else is a SchemaMismatch.
+- widening chains (`TypeWideningMode`): byte→short→int→long,
+  float→double, int→long→double(+decimal), date→timestamp_ntz.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from delta_tpu.errors import SchemaMismatchError
+from delta_tpu.models.schema import (
+    ArrayType,
+    DataType,
+    MapType,
+    PrimitiveType,
+    StructField,
+    StructType,
+)
+
+_WIDEN = {
+    ("byte", "short"), ("byte", "integer"), ("byte", "long"),
+    ("short", "integer"), ("short", "long"),
+    ("integer", "long"),
+    ("float", "double"),
+    ("byte", "double"), ("short", "double"), ("integer", "double"),
+    ("date", "timestamp_ntz"),
+}
+
+
+def can_widen(from_t: DataType, to_t: DataType) -> bool:
+    if not isinstance(from_t, PrimitiveType) or not isinstance(to_t, PrimitiveType):
+        return False
+    if from_t.is_decimal or to_t.is_decimal:
+        if from_t.is_decimal and to_t.is_decimal:
+            p1, s1 = from_t.decimal_precision_scale()
+            p2, s2 = to_t.decimal_precision_scale()
+            return s2 >= s1 and (p2 - s2) >= (p1 - s1) and (p1, s1) != (p2, s2)
+        return False
+    return (from_t.name, to_t.name) in _WIDEN
+
+
+def merge_types(
+    current: DataType, incoming: DataType, allow_widening: bool, path: str
+) -> DataType:
+    if current == incoming:
+        return current
+    if isinstance(current, StructType) and isinstance(incoming, StructType):
+        return merge_schemas(current, incoming, allow_widening, prefix=path + ".")
+    if isinstance(current, ArrayType) and isinstance(incoming, ArrayType):
+        return ArrayType(
+            merge_types(current.elementType, incoming.elementType, allow_widening,
+                        path + ".element"),
+            current.containsNull or incoming.containsNull,
+        )
+    if isinstance(current, MapType) and isinstance(incoming, MapType):
+        return MapType(
+            merge_types(current.keyType, incoming.keyType, allow_widening, path + ".key"),
+            merge_types(current.valueType, incoming.valueType, allow_widening,
+                        path + ".value"),
+            current.valueContainsNull or incoming.valueContainsNull,
+        )
+    if allow_widening and can_widen(current, incoming):
+        return incoming
+    if can_widen(incoming, current):
+        return current  # incoming is narrower: fits without change
+    raise SchemaMismatchError(
+        f"cannot merge types at {path or '<root>'}: "
+        f"{current.to_json_value()} vs {incoming.to_json_value()}"
+    )
+
+
+def merge_schemas(
+    current: StructType,
+    incoming: StructType,
+    allow_widening: bool = False,
+    prefix: str = "",
+) -> StructType:
+    """Evolved schema accepting `incoming` data. New incoming fields are
+    appended as nullable."""
+    by_name = {f.name.lower(): f for f in incoming.fields}
+    out = []
+    for f in current.fields:
+        inc = by_name.pop(f.name.lower(), None)
+        if inc is None:
+            out.append(f)
+            continue
+        merged_type = merge_types(
+            f.dataType, inc.dataType, allow_widening, prefix + f.name
+        )
+        out.append(StructField(f.name, merged_type, f.nullable, dict(f.metadata)))
+    for f in incoming.fields:
+        if f.name.lower() in by_name:  # genuinely new
+            out.append(StructField(f.name, f.dataType, True, dict(f.metadata)))
+    return StructType(out)
+
+
+def is_read_compatible(table_schema: StructType, read_schema: StructType) -> bool:
+    """Can data written with table_schema be read as read_schema (missing
+    columns become nulls)?"""
+    try:
+        merge_schemas(read_schema, table_schema)
+        return True
+    except SchemaMismatchError:
+        return False

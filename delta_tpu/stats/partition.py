@@ -63,16 +63,25 @@ def deserialize_partition_value(s: Optional[str], dtype: PrimitiveType):
     return s
 
 
-def _partition_field_types(metadata) -> Dict[str, PrimitiveType]:
-    out: Dict[str, PrimitiveType] = {}
+def _partition_field_types(metadata) -> Dict[str, tuple]:
+    """logical name -> (map key in partitionValues, type). Under column
+    mapping the map is keyed by physical names."""
+    out: Dict[str, tuple] = {}
     schema = metadata.schema if metadata is not None else None
+    mapped = (
+        metadata is not None
+        and metadata.configuration.get("delta.columnMapping.mode", "none") != "none"
+    )
     for c in (metadata.partitionColumns if metadata else []):
         dtype = PrimitiveType("string")
+        key = c
         if schema is not None and c in schema:
             f = schema[c]
             if isinstance(f.dataType, PrimitiveType):
                 dtype = f.dataType
-        out[c] = dtype
+            if mapped:
+                key = f.physical_name
+        out[c] = (key, dtype)
     return out
 
 
@@ -96,9 +105,9 @@ def partition_values_to_columns(pv_column: pa.ChunkedArray, metadata) -> pa.Tabl
     row_of_entry = np.repeat(np.arange(n), np.diff(offsets))
 
     cols = {}
-    for name, dtype in types.items():
+    for name, (map_key, dtype) in types.items():
         values = np.full(n, None, dtype=object)
-        sel = keys == name
+        sel = keys == map_key
         values[row_of_entry[sel]] = items[sel]
         py = [deserialize_partition_value(v, dtype) for v in values]
         try:

@@ -202,6 +202,33 @@ def _conjunct_keep(conj: Expression, index: StatsIndex) -> Optional[pa.Array]:
     return None
 
 
+def _to_physical(expr: Expression, schema) -> Optional[Expression]:
+    """Rewrite logical column paths to physical names (stats JSON keys use
+    physical names under column mapping). None = untranslatable -> keep."""
+    from delta_tpu.columnmapping import physical_name_path
+
+    if isinstance(expr, Column):
+        phys = physical_name_path(schema, expr.name_path)
+        return Column(phys) if phys is not None else None
+    children = expr.children()
+    if not children:
+        return expr
+    import dataclasses
+
+    new_children = []
+    for c in children:
+        nc = _to_physical(c, schema)
+        if nc is None:
+            return None
+        new_children.append(nc)
+    field_names = [
+        f.name for f in dataclasses.fields(expr)
+        if isinstance(getattr(expr, f.name), Expression)
+    ]
+    replacements = dict(zip(field_names, new_children))
+    return dataclasses.replace(expr, **replacements)
+
+
 def skipping_mask(
     files: pa.Table,
     conjuncts: List[Expression],
@@ -216,6 +243,17 @@ def skipping_mask(
     index = StatsIndex.from_stats_column(files.column("stats"))
     if index._table is None:
         return keep
+    if (
+        metadata is not None
+        and metadata.configuration.get("delta.columnMapping.mode", "none") != "none"
+    ):
+        schema = metadata.schema
+        translated = []
+        for conj in conjuncts:
+            t = _to_physical(conj, schema)
+            if t is not None:
+                translated.append(t)
+        conjuncts = translated
     for conj in conjuncts:
         mask = _conjunct_keep(conj, index)
         if mask is None:

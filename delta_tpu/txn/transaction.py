@@ -71,6 +71,11 @@ class Operation:
     SET_TBLPROPERTIES = "SET TBLPROPERTIES"
     ADD_COLUMNS = "ADD COLUMNS"
     CHANGE_COLUMN = "CHANGE COLUMN"
+    RENAME_COLUMN = "RENAME COLUMN"
+    DROP_COLUMNS = "DROP COLUMNS"
+    ADD_CONSTRAINT = "ADD CONSTRAINT"
+    DROP_CONSTRAINT = "DROP CONSTRAINT"
+    UPGRADE_PROTOCOL = "UPGRADE PROTOCOL"
     RESTORE = "RESTORE"
     CLONE = "CLONE"
     VACUUM_START = "VACUUM START"
@@ -153,18 +158,22 @@ class TransactionBuilder:
             max_retries=self._max_retries,
         )
         if snapshot is None:
-            from delta_tpu.models.schema import StructType, schema_to_json
+            from delta_tpu.models.schema import StructType, schema_from_json, schema_to_json
             from delta_tpu.features import protocol_for_new_table
 
-            schema_json = (
-                schema_to_json(self._schema)
-                if isinstance(self._schema, StructType)
-                else self._schema
-            )
             props = dict(self._table_properties or {})
+            schema_obj = (
+                self._schema
+                if isinstance(self._schema, StructType)
+                else schema_from_json(self._schema)
+            )
+            if props.get("delta.columnMapping.mode", "none") != "none":
+                from delta_tpu.columnmapping import assign_column_mapping
+
+                schema_obj, props = assign_column_mapping(schema_obj, props)
             metadata = Metadata(
                 id=str(uuid.uuid4()),
-                schemaString=schema_json,
+                schemaString=schema_to_json(schema_obj),
                 partitionColumns=list(self._partition_columns or []),
                 configuration=props,
                 createdTime=int(time.time() * 1000),

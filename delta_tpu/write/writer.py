@@ -81,35 +81,65 @@ def write_data_files(
     target_rows_per_file: Optional[int] = None,
     base_row_id_start: Optional[int] = None,
 ) -> List[AddFile]:
-    """Write `data` under `table_path`, returning AddFile actions."""
+    """Write `data` under `table_path`, returning AddFile actions.
+
+    Inputs use LOGICAL column names; under column mapping the Parquet
+    files, stats JSON, and partitionValues keys all use physical names
+    (protocol requirement)."""
+    from delta_tpu.columnmapping import logical_to_physical_names, mapping_mode
+
     _validate_schema(data, schema)
+    if constraints is None:
+        from delta_tpu.constraints import table_constraints
+
+        constraints = table_constraints(configuration)
     _check_invariants(data, schema, constraints)
     now_ms = int(time.time() * 1000)
     adds: List[AddFile] = []
     partition_columns = list(partition_columns)
+
+    mapped = mapping_mode(configuration) != "none"
+    l2p = logical_to_physical_names(schema) if mapped else {}
+
+    def phys(name: str) -> str:
+        return l2p.get(name, name)
 
     if partition_columns:
         groups = _partition_groups(data, partition_columns)
     else:
         groups = [({}, data)]
 
+    phys_schema = schema
+    if mapped:
+        from delta_tpu.columnmapping import physical_schema
+
+        phys_schema = physical_schema(schema)
+
     next_base_row_id = base_row_id_start
     for pv, part_data in groups:
         file_data = part_data.drop_columns(
             [c for c in partition_columns if c in part_data.column_names]
         )
+        if mapped:
+            file_data = file_data.rename_columns(
+                [phys(c) for c in file_data.column_names]
+            )
+        phys_pv = {phys(k): v for k, v in pv.items()}
+        phys_part_cols = [phys(c) for c in partition_columns]
         for chunk in _split_rows(file_data, target_rows_per_file):
             if chunk.num_rows == 0:
                 continue
-            rel_dir = partition_path(pv, partition_columns)
+            rel_dir = partition_path(phys_pv, phys_part_cols)
             fname = f"part-{uuid.uuid4()}.parquet"
             rel_path = f"{rel_dir}{fname}"
             abs_path = f"{table_path}/{rel_path}"
             status = engine.parquet.write_parquet_file(abs_path, chunk)
-            stats = collect_stats(chunk, schema, configuration, partition_columns)
+            stats = collect_stats(
+                chunk, phys_schema, configuration, phys_part_cols
+            )
             add = AddFile(
                 path=rel_path,
-                partitionValues={k: v for k, v in pv.items()},
+                partitionValues=dict(phys_pv),
                 size=status.size,
                 modificationTime=status.modification_time or now_ms,
                 dataChange=data_change,
