@@ -28,6 +28,8 @@ def write_table(
     engine=None,
     properties: Optional[Dict[str, str]] = None,
     target_rows_per_file: Optional[int] = None,
+    schema=None,
+    merge_schema: bool = False,
 ) -> int:
     """Write an Arrow table as a Delta commit. Returns the commit version.
 
@@ -45,16 +47,63 @@ def write_table(
         Operation.WRITE if exists else Operation.CREATE_TABLE
     )
     if not exists:
-        builder = builder.with_schema(from_arrow_schema(data.schema))
+        builder = builder.with_schema(
+            schema if schema is not None else from_arrow_schema(data.schema)
+        )
         if partition_by:
             builder = builder.with_partition_columns(partition_by)
         if properties:
             builder = builder.with_table_properties(properties)
     txn = builder.build()
 
+    if exists and merge_schema:
+        import dataclasses
+
+        from delta_tpu.models.schema import schema_to_json
+        from delta_tpu.schema_evolution import merge_schemas
+
+        cur_meta = txn.metadata()
+        widen = (
+            cur_meta.configuration.get("delta.enableTypeWidening", "").lower()
+            == "true"
+        )
+        merged = merge_schemas(
+            cur_meta.schema, from_arrow_schema(data.schema), allow_widening=widen
+        )
+        if merged.to_json_value() != cur_meta.schema.to_json_value():
+            txn.update_metadata(
+                dataclasses.replace(cur_meta, schemaString=schema_to_json(merged))
+            )
+
     meta = txn.metadata()
     schema = meta.schema
     partition_columns = meta.partitionColumns
+
+    from delta_tpu.colgen import (
+        GENERATION_EXPRESSION_KEY,
+        IDENTITY_START_KEY,
+        IDENTITY_STEP_KEY,
+        apply_column_generation,
+    )
+
+    if any(
+        GENERATION_EXPRESSION_KEY in f.metadata
+        or IDENTITY_START_KEY in f.metadata
+        or IDENTITY_STEP_KEY in f.metadata
+        for f in schema.fields
+    ):
+        data, evolved = apply_column_generation(data, schema)
+        if evolved is not None:
+            import dataclasses
+
+            from delta_tpu.models.schema import schema_to_json
+
+            schema = evolved
+            txn.update_metadata(
+                dataclasses.replace(
+                    txn.metadata(), schemaString=schema_to_json(evolved)
+                )
+            )
 
     if exists and mode == "overwrite":
         for f in txn.scan_files():

@@ -1,0 +1,124 @@
+"""Generated and identity column value generation on write.
+
+Reference `GeneratedColumn.scala` / `IdentityColumn.scala` /
+`GenerateIdentityValues.scala`:
+
+- generated columns: field metadata `delta.generationExpression`
+  (parseable predicate/expression text). Missing on write → computed;
+  present → validated against the expression.
+- identity columns: field metadata `delta.identity.start` / `.step` /
+  `.highWaterMark` / `.allowExplicitInsert`. Missing on write → values
+  allocated from the high watermark (which advances in the SAME commit
+  via a schema-metadata update); present → rejected unless
+  allowExplicitInsert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError, InvariantViolationError
+from delta_tpu.models.schema import StructField, StructType, to_arrow_type
+
+GENERATION_EXPRESSION_KEY = "delta.generationExpression"
+IDENTITY_START_KEY = "delta.identity.start"
+IDENTITY_STEP_KEY = "delta.identity.step"
+IDENTITY_HIGH_WATERMARK_KEY = "delta.identity.highWaterMark"
+IDENTITY_ALLOW_EXPLICIT_KEY = "delta.identity.allowExplicitInsert"
+
+
+def identity_field(
+    name: str, start: int = 1, step: int = 1, allow_explicit_insert: bool = False
+) -> StructField:
+    """Helper to declare an identity column in a new table's schema."""
+    from delta_tpu.models.schema import LONG
+
+    if step == 0:
+        raise DeltaError("identity step must not be 0")
+    return StructField(
+        name,
+        LONG,
+        nullable=True,
+        metadata={
+            IDENTITY_START_KEY: start,
+            IDENTITY_STEP_KEY: step,
+            IDENTITY_ALLOW_EXPLICIT_KEY: allow_explicit_insert,
+        },
+    )
+
+
+def generated_field(name: str, dtype, expression: str) -> StructField:
+    from delta_tpu.expressions.parser import parse_expression
+
+    parse_expression(expression)  # validate early
+    return StructField(name, dtype, metadata={GENERATION_EXPRESSION_KEY: expression})
+
+
+def apply_column_generation(
+    data: pa.Table, schema: StructType
+) -> Tuple[pa.Table, Optional[StructType]]:
+    """Fill generated/identity columns. Returns (new data, updated schema
+    or None when no watermark moved)."""
+    from delta_tpu.expressions.eval import evaluate_host
+    from delta_tpu.expressions.parser import parse_expression
+
+    new_schema_fields = list(schema.fields)
+    schema_changed = False
+    n = data.num_rows
+
+    for i, f in enumerate(schema.fields):
+        gen_expr = f.metadata.get(GENERATION_EXPRESSION_KEY)
+        is_identity = IDENTITY_START_KEY in f.metadata or IDENTITY_STEP_KEY in f.metadata
+
+        if gen_expr is not None:
+            expr = parse_expression(gen_expr)
+            computed = evaluate_host(expr, data)
+            if isinstance(computed, pa.ChunkedArray):
+                computed = computed.combine_chunks()
+            computed = computed.cast(to_arrow_type(f.dataType), safe=False)
+            if f.name in data.column_names:
+                actual = data.column(f.name).combine_chunks()
+                import pyarrow.compute as pc
+
+                mismatch = pc.sum(
+                    pc.cast(
+                        pc.fill_null(pc.not_equal(actual, computed), True),
+                        pa.int64(),
+                    )
+                ).as_py()
+                if mismatch:
+                    raise InvariantViolationError(
+                        f"{mismatch} row(s) violate generation expression of "
+                        f"column {f.name}: {gen_expr}"
+                    )
+            else:
+                data = data.append_column(f.name, computed)
+            continue
+
+        if is_identity:
+            step = int(f.metadata.get(IDENTITY_STEP_KEY, 1))
+            start = int(f.metadata.get(IDENTITY_START_KEY, 1))
+            allow_explicit = bool(f.metadata.get(IDENTITY_ALLOW_EXPLICIT_KEY, False))
+            if f.name in data.column_names:
+                if not allow_explicit:
+                    raise DeltaError(
+                        f"explicit values for identity column {f.name} are "
+                        "not allowed (allowExplicitInsert=false)"
+                    )
+                continue
+            if n == 0:
+                continue
+            watermark = f.metadata.get(IDENTITY_HIGH_WATERMARK_KEY)
+            first = start if watermark is None else int(watermark) + step
+            values = first + step * np.arange(n, dtype=np.int64)
+            data = data.append_column(f.name, pa.array(values, pa.int64()))
+            md = dict(f.metadata)
+            md[IDENTITY_HIGH_WATERMARK_KEY] = int(values[-1]) if step > 0 else int(values.min())
+            new_schema_fields[i] = StructField(f.name, f.dataType, f.nullable, md)
+            schema_changed = True
+
+    return data, (StructType(new_schema_fields) if schema_changed else None)

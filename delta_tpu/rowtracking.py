@@ -1,0 +1,86 @@
+"""Row tracking: stable row ids + row commit versions.
+
+Reference `RowId.scala` / `RowTracking.scala`: when the `rowTracking`
+writer feature is supported, every committed AddFile gets a fresh
+`baseRowId` range (row i of the file has row id baseRowId + i) and a
+`defaultRowCommitVersion`. The allocator state is the
+`delta.rowTracking` metadata domain: `{"rowIdHighWaterMark": N}`.
+
+Concurrent writers both bump the watermark; that domain write is
+auto-resolved at conflict time (winner's watermark is folded in and ids
+reassigned on rebase) instead of failing the transaction — mirroring
+`RowTracking.resolveRowIdConflicts` semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Tuple
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import AddFile, DomainMetadata, Protocol
+
+ROW_TRACKING_DOMAIN = "delta.rowTracking"
+ROW_TRACKING_FEATURE = "rowTracking"
+
+
+def is_row_tracking_supported(protocol: Optional[Protocol]) -> bool:
+    return protocol is not None and ROW_TRACKING_FEATURE in protocol.writer_feature_set()
+
+
+def watermark_from_domain(dm: Optional[DomainMetadata]) -> int:
+    if dm is None or not dm.configuration:
+        return -1
+    try:
+        return int(json.loads(dm.configuration).get("rowIdHighWaterMark", -1))
+    except (ValueError, TypeError):
+        return -1
+
+
+def current_high_watermark(snapshot) -> int:
+    if snapshot is None:
+        return -1
+    dm = snapshot.state.domain_metadata.get(ROW_TRACKING_DOMAIN)
+    return watermark_from_domain(dm)
+
+
+def assign_fresh_row_ids(
+    adds: List[AddFile],
+    high_watermark: int,
+    commit_version: int,
+) -> Tuple[List[AddFile], Optional[DomainMetadata]]:
+    """Assign baseRowId/defaultRowCommitVersion to adds lacking them.
+    Returns (new adds, watermark domain action or None if nothing moved)."""
+    next_id = high_watermark + 1
+    out = []
+    assigned = False
+    for a in adds:
+        num = a.num_records()
+        base = a.baseRowId
+        if base is None:
+            if num is None:
+                raise DeltaError(
+                    f"row tracking requires numRecords stats on {a.path}"
+                )
+            base = next_id
+            next_id += num
+            assigned = True
+            a = dataclasses.replace(
+                a, baseRowId=base, defaultRowCommitVersion=commit_version
+            )
+        elif a.defaultRowCommitVersion is None:
+            a = dataclasses.replace(a, defaultRowCommitVersion=commit_version)
+            next_id = max(next_id, base + (num or 0))
+            assigned = True
+        else:
+            next_id = max(next_id, base + (num or 0))
+        out.append(a)
+    if not assigned and next_id == high_watermark + 1:
+        return out, None
+    dm = DomainMetadata(
+        ROW_TRACKING_DOMAIN,
+        json.dumps({"rowIdHighWaterMark": next_id - 1}),
+        removed=False,
+    )
+    return out, dm

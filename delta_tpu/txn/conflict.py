@@ -131,6 +131,7 @@ def check_conflicts(
     otherwise returns the rebase info {'txn_versions': {appId: version}}.
     """
     rebase_txns = {}
+    rebase_row_watermark: List[int] = []
     for w in winners:
         blind = w.is_blind_append
         for a in w.actions:
@@ -172,9 +173,24 @@ def check_conflicts(
                     )
                 rebase_txns[a.appId] = a.version
             if isinstance(a, DomainMetadata):
+                from delta_tpu.rowtracking import (
+                    ROW_TRACKING_DOMAIN,
+                    watermark_from_domain,
+                )
+
+                if a.domain == ROW_TRACKING_DOMAIN:
+                    # system domain: auto-resolved by folding the winner's
+                    # watermark and reassigning ids on rebase
+                    rebase_row_watermark.append(watermark_from_domain(a))
+                    continue
                 if a.domain in state.written_domains:
                     raise ConcurrentWriteError(
                         f"metadata domain {a.domain!r} modified by concurrent "
                         f"commit {w.version}"
                     )
-    return {"txn_versions": rebase_txns}
+    return {
+        "txn_versions": rebase_txns,
+        "row_id_high_watermark": (
+            max(rebase_row_watermark) if rebase_row_watermark else None
+        ),
+    }

@@ -231,6 +231,7 @@ class Transaction:
         self._op_metrics: Dict[str, object] = {}
 
         self._read_predicates: List[Expression] = []
+        self._winners_row_watermark: Optional[int] = None
         self._read_whole_table = False
         self._read_files: set = set()
         self._read_app_ids: set = set()
@@ -352,6 +353,46 @@ class Transaction:
             if winners_ict is not None:
                 prev = max(prev, winners_ict)
             ict = max(now, prev + 1)
+            # enablement provenance (PROTOCOL.md in-commit timestamps):
+            # when ICT turns on mid-table, record the enabling version +
+            # timestamp so timestamp search knows where the ICT range starts
+            prov_key = "delta.inCommitTimestampEnablementVersion"
+            was_enabled = self.read_snapshot is not None and get_table_config(
+                self.read_snapshot.metadata.configuration, IN_COMMIT_TIMESTAMPS
+            )
+            if (
+                not was_enabled
+                and self.read_snapshot is not None
+                and prov_key not in meta.configuration
+            ):
+                import dataclasses as _dc
+
+                conf = dict(meta.configuration)
+                conf[prov_key] = str(attempt_version)
+                conf["delta.inCommitTimestampEnablementTimestamp"] = str(ict)
+                meta = _dc.replace(meta, configuration=conf)
+                self._new_metadata = meta
+
+        # row tracking: assign fresh baseRowIds + the watermark domain
+        adds = self._adds
+        row_tracking_dm = None
+        from delta_tpu.rowtracking import (
+            ROW_TRACKING_DOMAIN,
+            assign_fresh_row_ids,
+            current_high_watermark,
+            is_row_tracking_supported,
+        )
+
+        if is_row_tracking_supported(self.protocol()) and self._adds:
+            hw = max(
+                current_high_watermark(self.read_snapshot),
+                self._winners_row_watermark
+                if self._winners_row_watermark is not None
+                else -1,
+            )
+            adds, row_tracking_dm = assign_fresh_row_ids(
+                self._adds, hw, attempt_version
+            )
 
         commit_info = CommitInfo(
             timestamp=now,
@@ -372,9 +413,12 @@ class Transaction:
         if self._new_metadata is not None:
             actions.append(self._new_metadata)
         actions.extend(self._set_txns.values())
-        actions.extend(self._domain_metadata.values())
+        domains = dict(self._domain_metadata)
+        if row_tracking_dm is not None and ROW_TRACKING_DOMAIN not in domains:
+            domains[ROW_TRACKING_DOMAIN] = row_tracking_dm
+        actions.extend(domains.values())
         actions.extend(self._removes)
-        actions.extend(self._adds)
+        actions.extend(adds)
         actions.extend(self._cdcs)
         return actions
 
@@ -485,6 +529,11 @@ class Transaction:
                     engine, log_path, attempt_version, latest
                 )
                 rebase = check_conflicts(self._read_state(), winners)
+                if rebase.get("row_id_high_watermark") is not None:
+                    self._winners_row_watermark = max(
+                        self._winners_row_watermark or -1,
+                        rebase["row_id_high_watermark"],
+                    )
                 for w in winners:
                     ci = next(
                         (a for a in w.actions if isinstance(a, CommitInfo)), None
