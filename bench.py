@@ -83,16 +83,60 @@ def bench_device(pk, dk, ver, order, is_add, repeats: int) -> float:
     return dt
 
 
+def bench_device_subprocess(n: int, repeats: int, timeout_s: int) -> float:
+    """Run the device benchmark in a child process so a wedged accelerator
+    runtime can't hang the driver; returns seconds or raises."""
+    import subprocess
+
+    code = (
+        "import bench, sys, json\n"
+        "import jax\n"
+        "print('devices:', jax.devices(), file=sys.stderr)\n"
+        f"pk, dk, ver, order, is_add, size = bench.synth_history({n})\n"
+        f"dt = bench.bench_device(pk, dk, ver, order, is_add, {repeats})\n"
+        "print('DEVICE_SECONDS=' + repr(dt))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    for line in proc.stderr.splitlines():
+        print(line, file=sys.stderr)
+    for line in proc.stdout.splitlines():
+        if line.startswith("DEVICE_SECONDS="):
+            return float(line.split("=", 1)[1])
+    raise RuntimeError(
+        f"device benchmark failed (rc={proc.returncode}): {proc.stderr[-500:]}"
+    )
+
+
 def main():
     n = int(os.environ.get("BENCH_ACTIONS", 2_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
-    import jax
-
-    print(f"devices: {jax.devices()}", file=sys.stderr)
+    # NOTE: jax is only imported in the child process (bench_device_subprocess)
+    # so a wedged accelerator runtime can never hang the bench driver itself.
     pk, dk, ver, order, is_add, size = synth_history(n)
 
     host_s = bench_host(pk, dk, ver, order, is_add)
-    dev_s = bench_device(pk, dk, ver, order, is_add, repeats)
+    timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 900))
+    try:
+        dev_s = bench_device_subprocess(n, repeats, timeout_s)
+    except Exception as e:  # wedged/unavailable accelerator: fail loud
+        print(f"device benchmark unavailable: {e}", file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "replay_files_per_sec",
+                    "value": 0.0,
+                    "unit": "actions/s",
+                    "vs_baseline": 0.0,
+                }
+            )
+        )
+        return
 
     host_rate = n / host_s
     dev_rate = n / dev_s

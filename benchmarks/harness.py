@@ -1,0 +1,76 @@
+"""Benchmark harness (the reference `benchmarks/` module role:
+`Benchmark.scala:96` — named benchmarks, timed queries, JSON report).
+
+Run: `python -m benchmarks.run --benchmark replay --scale small`
+Each benchmark yields {name, metric, value, unit, extra} dicts; the
+driver prints a JSON report and a human summary.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List
+
+
+@dataclass
+class QueryResult:
+    name: str
+    iteration: int
+    duration_ms: float
+    extra: Dict = field(default_factory=dict)
+
+
+@dataclass
+class BenchmarkReport:
+    benchmark: str
+    scale: str
+    results: List[QueryResult] = field(default_factory=list)
+    metrics: List[Dict] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "benchmark": self.benchmark,
+                "scale": self.scale,
+                "queries": [
+                    {
+                        "name": r.name,
+                        "iteration": r.iteration,
+                        "durationMs": round(r.duration_ms, 2),
+                        **r.extra,
+                    }
+                    for r in self.results
+                ],
+                "metrics": self.metrics,
+            },
+            indent=2,
+        )
+
+
+class Benchmark:
+    name = "base"
+
+    def __init__(self, scale: str = "small", workdir: str = "/tmp/delta_tpu_bench"):
+        self.scale = scale
+        self.workdir = workdir
+        self.report = BenchmarkReport(self.name, scale)
+
+    @contextmanager
+    def timed(self, name: str, iteration: int = 0, **extra) -> Iterator[None]:
+        t0 = time.perf_counter()
+        yield
+        dt = (time.perf_counter() - t0) * 1000
+        self.report.results.append(QueryResult(name, iteration, dt, extra))
+        print(f"  {name}[{iteration}]: {dt:,.1f} ms", file=sys.stderr)
+
+    def metric(self, metric: str, value: float, unit: str, **extra) -> None:
+        m = {"metric": metric, "value": value, "unit": unit, **extra}
+        self.report.metrics.append(m)
+        print(f"  {metric}: {value:,.1f} {unit}", file=sys.stderr)
+
+    def run(self) -> BenchmarkReport:  # pragma: no cover - abstract
+        raise NotImplementedError

@@ -1,0 +1,40 @@
+"""Benchmark driver: `python -m benchmarks.run --benchmark replay --scale small`.
+
+`--benchmark all` runs every workload; the JSON report lands in
+`--report-dir` (default the workdir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--benchmark", default="replay")
+    ap.add_argument("--scale", default="small",
+                    choices=["smoke", "small", "medium", "full"])
+    ap.add_argument("--workdir", default="/tmp/delta_tpu_bench")
+    ap.add_argument("--report-dir", default=None)
+    args = ap.parse_args()
+
+    from benchmarks.workloads import BENCHMARKS
+
+    names = list(BENCHMARKS) if args.benchmark == "all" else [args.benchmark]
+    os.makedirs(args.workdir, exist_ok=True)
+    report_dir = args.report_dir or args.workdir
+    os.makedirs(report_dir, exist_ok=True)
+    for name in names:
+        print(f"== {name} ({args.scale})", file=sys.stderr)
+        bench = BENCHMARKS[name](scale=args.scale, workdir=args.workdir)
+        report = bench.run()
+        out = os.path.join(report_dir, f"report_{name}_{args.scale}.json")
+        with open(out, "w") as f:
+            f.write(report.to_json())
+        print(f"report -> {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,275 @@
+"""Benchmark workloads.
+
+- `replay`: the north-star — snapshot state reconstruction over a
+  synthetic `_delta_log` (BASELINE.md config 2: 100k commits / 10M adds
+  at `--scale full`; smaller presets for CI). Compares the sequential
+  reference replay, the single-device kernel, and (where >1 device) the
+  sharded path, plus end-to-end table load including JSON parse.
+- `checkpoint`: checkpoint write throughput from a reconstructed state
+  (config 2's GB/s half).
+- `optimize`: bin-packing compaction + ZORDER rewrite (configs 3/4).
+- `merge`: upsert MERGE throughput (reference MergeBenchmark role).
+- `streaming`: micro-batch ingest + per-batch stats (config 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from benchmarks.harness import Benchmark
+
+SCALES = {
+    "smoke": dict(commits=50, files_per_commit=20, rows=5_000),
+    "small": dict(commits=1_000, files_per_commit=100, rows=50_000),
+    "medium": dict(commits=10_000, files_per_commit=100, rows=200_000),
+    "full": dict(commits=100_000, files_per_commit=100, rows=1_000_000),
+}
+
+
+def synth_delta_log(path: str, commits: int, files_per_commit: int,
+                    remove_fraction: float = 0.2) -> None:
+    """Write a synthetic `_delta_log` directly (no data files — replay
+    only touches the log)."""
+    rng = np.random.default_rng(0)
+    log = os.path.join(path, "_delta_log")
+    os.makedirs(log, exist_ok=True)
+    protocol = '{"protocol":{"minReaderVersion":1,"minWriterVersion":2}}'
+    metadata = json.dumps({
+        "metaData": {
+            "id": "bench", "format": {"provider": "parquet", "options": {}},
+            "schemaString": '{"type":"struct","fields":[{"name":"x","type":"long","nullable":true,"metadata":{}}]}',
+            "partitionColumns": [], "configuration": {},
+        }
+    })
+    alive: list = []
+    fid = 0
+    for v in range(commits):
+        lines = []
+        if v == 0:
+            lines += [protocol, metadata]
+        n_rm = int(files_per_commit * remove_fraction)
+        if alive and n_rm:
+            for _ in range(min(n_rm, len(alive))):
+                p = alive.pop(rng.integers(0, len(alive)))
+                lines.append(json.dumps({
+                    "remove": {"path": p, "deletionTimestamp": v, "dataChange": True}
+                }))
+        for _ in range(files_per_commit - n_rm):
+            p = f"part-{fid:010d}.parquet"
+            fid += 1
+            alive.append(p)
+            stats = json.dumps({"numRecords": 1000,
+                                "minValues": {"x": int(fid) * 1000},
+                                "maxValues": {"x": int(fid + 1) * 1000},
+                                "nullCount": {"x": 0}})
+            lines.append(json.dumps({
+                "add": {"path": p, "partitionValues": {}, "size": 1 << 20,
+                        "modificationTime": v, "dataChange": True,
+                        "stats": stats}
+            }))
+        with open(os.path.join(log, f"{v:020d}.json"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+class ReplayBenchmark(Benchmark):
+    name = "replay"
+
+    def run(self):
+        from delta_tpu.engine.host import HostEngine
+        from delta_tpu.engine.tpu import TpuEngine
+        from delta_tpu.replay.columnar import columnarize_log_segment
+        from delta_tpu.replay.state import compute_masks_device, compute_masks_host
+        from delta_tpu.log.segment import build_log_segment
+        from delta_tpu.table import Table
+
+        cfg = SCALES[self.scale]
+        path = os.path.join(self.workdir, f"replay_{self.scale}")
+        if not os.path.exists(os.path.join(path, "_delta_log")):
+            print(f"  generating {cfg['commits']} commits...", end=" ", flush=True)
+            t0 = time.perf_counter()
+            synth_delta_log(path, cfg["commits"], cfg["files_per_commit"])
+            print(f"{time.perf_counter() - t0:.1f}s")
+
+        engine = TpuEngine()
+        with self.timed("list+segment"):
+            segment = build_log_segment(engine.fs, os.path.join(path, "_delta_log"))
+        with self.timed("columnarize(parse json)"):
+            columnar = columnarize_log_segment(engine, segment)
+        n = columnar.num_actions
+
+        with self.timed("replay-host-dict", extra={"actions": n}):
+            live_h, _ = compute_masks_host(columnar)
+        # device (includes key factorization + transfers)
+        with self.timed("replay-device-e2e", 0):
+            live_d, _ = compute_masks_device(columnar)
+        with self.timed("replay-device-e2e", 1):
+            live_d, _ = compute_masks_device(columnar)
+        assert live_h.sum() == live_d.sum()
+
+        host_ms = next(r.duration_ms for r in self.report.results
+                       if r.name == "replay-host-dict")
+        dev_ms = min(r.duration_ms for r in self.report.results
+                     if r.name == "replay-device-e2e")
+        self.metric("replay_actions_per_sec_host", n / host_ms * 1000, "actions/s")
+        self.metric("replay_actions_per_sec_device", n / dev_ms * 1000, "actions/s",
+                    vs_host=round(host_ms / dev_ms, 2))
+
+        # full table load end-to-end on both engines
+        for label, eng in (("host", HostEngine()), ("tpu", TpuEngine())):
+            with self.timed(f"full-load-{label}"):
+                snap = Table.for_path(path, eng).latest_snapshot()
+                _ = snap.num_files
+        return self.report
+
+
+class CheckpointBenchmark(Benchmark):
+    name = "checkpoint"
+
+    def run(self):
+        from delta_tpu.engine.tpu import TpuEngine
+        from delta_tpu.log.checkpointer import write_checkpoint
+        from delta_tpu.table import Table
+
+        cfg = SCALES[self.scale]
+        path = os.path.join(self.workdir, f"replay_{self.scale}")
+        if not os.path.exists(os.path.join(path, "_delta_log")):
+            synth_delta_log(path, cfg["commits"], cfg["files_per_commit"])
+        table = Table.for_path(path, TpuEngine())
+        snap = table.latest_snapshot()
+        _ = snap.num_files
+        with self.timed("checkpoint-write", extra={"numFiles": snap.num_files}):
+            info = write_checkpoint(table.engine, snap)
+        size = info.sizeInBytes or 0
+        dur_s = self.report.results[-1].duration_ms / 1000
+        if size:
+            self.metric("checkpoint_write_mb_per_sec", size / 1e6 / dur_s, "MB/s")
+        self.metric("checkpoint_files_per_sec", snap.num_files / dur_s, "files/s")
+        # re-load from checkpoint
+        with self.timed("reload-from-checkpoint"):
+            snap2 = Table.for_path(path, TpuEngine()).latest_snapshot()
+            _ = snap2.num_files
+        return self.report
+
+
+class OptimizeBenchmark(Benchmark):
+    name = "optimize"
+
+    def run(self):
+        import delta_tpu.api as dta
+        from delta_tpu.table import Table
+
+        cfg = SCALES[self.scale]
+        rows = cfg["rows"]
+        path = os.path.join(self.workdir, f"optimize_{self.scale}")
+        shutil.rmtree(path, ignore_errors=True)
+        rng = np.random.default_rng(1)
+        n_commits = 20
+        per = rows // n_commits
+        for i in range(n_commits):
+            data = pa.table({
+                "k1": pa.array(rng.integers(0, 1 << 30, per).astype(np.int64)),
+                "k2": pa.array(rng.integers(0, 1 << 30, per).astype(np.int64)),
+                "k3": pa.array(rng.integers(0, 1 << 30, per).astype(np.int64)),
+                "payload": pa.array(rng.normal(size=per)),
+            })
+            dta.write_table(path, data)
+        table = Table.for_path(path)
+        with self.timed("compaction", extra={"rows": rows}):
+            m = table.optimize().execute_compaction()
+        self.metric("compaction_files_per_sec",
+                    m.num_files_removed / (self.report.results[-1].duration_ms / 1000),
+                    "files/s")
+        with self.timed("zorder-3col", extra={"rows": rows}):
+            mz = Table.for_path(path).optimize().execute_zorder_by("k1", "k2", "k3")
+        dur_s = self.report.results[-1].duration_ms / 1000
+        self.metric("zorder_rows_per_sec", rows / dur_s, "rows/s")
+        # curve-key kernel alone
+        from delta_tpu.ops.zorder import zorder_sort_indices
+
+        cols = [rng.integers(0, 1 << 30, rows).astype(np.int64) for _ in range(3)]
+        zorder_sort_indices([c[:1000] for c in cols])  # compile
+        with self.timed("curve-key-kernel", extra={"rows": rows}):
+            zorder_sort_indices(cols)
+        dur_s = self.report.results[-1].duration_ms / 1000
+        self.metric("curve_key_rows_per_sec", rows / dur_s, "rows/s")
+        return self.report
+
+
+class MergeBenchmark(Benchmark):
+    name = "merge"
+
+    def run(self):
+        import delta_tpu.api as dta
+        from delta_tpu.commands.merge import merge
+        from delta_tpu.expressions import col
+        from delta_tpu.table import Table
+
+        cfg = SCALES[self.scale]
+        rows = cfg["rows"]
+        path = os.path.join(self.workdir, f"merge_{self.scale}")
+        shutil.rmtree(path, ignore_errors=True)
+        rng = np.random.default_rng(2)
+        base = pa.table({
+            "id": pa.array(np.arange(rows, dtype=np.int64)),
+            "v": pa.array(rng.normal(size=rows)),
+        })
+        dta.write_table(path, base, target_rows_per_file=max(1, rows // 20))
+        n_src = rows // 10
+        src = pa.table({
+            "id": pa.array(np.concatenate([
+                rng.choice(rows, n_src // 2, replace=False),
+                np.arange(rows, rows + n_src // 2),
+            ]).astype(np.int64)),
+            "v": pa.array(rng.normal(size=2 * (n_src // 2))),
+        })
+        with self.timed("merge-upsert", extra={"source_rows": src.num_rows}):
+            m = (merge(Table.for_path(path), src,
+                       on=col("target.id") == col("source.id"))
+                 .when_matched_update(set={"v": col("source.v")})
+                 .when_not_matched_insert_all()
+                 .execute())
+        dur_s = self.report.results[-1].duration_ms / 1000
+        self.metric("merge_source_rows_per_sec", src.num_rows / dur_s, "rows/s",
+                    updated=m.num_target_rows_updated,
+                    inserted=m.num_target_rows_inserted)
+        return self.report
+
+
+class StreamingBenchmark(Benchmark):
+    name = "streaming"
+
+    def run(self):
+        from delta_tpu.streaming import DeltaSink
+
+        cfg = SCALES[self.scale]
+        rows = cfg["rows"]
+        path = os.path.join(self.workdir, f"streaming_{self.scale}")
+        shutil.rmtree(path, ignore_errors=True)
+        rng = np.random.default_rng(3)
+        sink = DeltaSink(path, query_id="bench")
+        n_batches = 20
+        per = max(1, rows // n_batches)
+        with self.timed("ingest", extra={"batches": n_batches, "rows": rows}):
+            for b in range(n_batches):
+                data = pa.table({
+                    "id": pa.array(np.arange(b * per, (b + 1) * per, dtype=np.int64)),
+                    "v": pa.array(rng.normal(size=per)),
+                })
+                sink.add_batch(b, data)
+        dur_s = self.report.results[-1].duration_ms / 1000
+        self.metric("ingest_batches_per_sec", n_batches / dur_s, "batches/s")
+        self.metric("ingest_rows_per_sec", n_batches * per / dur_s, "rows/s")
+        return self.report
+
+
+BENCHMARKS = {
+    b.name: b
+    for b in (ReplayBenchmark, CheckpointBenchmark, OptimizeBenchmark,
+              MergeBenchmark, StreamingBenchmark)
+}

@@ -1,0 +1,103 @@
+"""Typed metric reports + timers.
+
+Reference kernel `internal/metrics/` (Timer/Counter,
+SnapshotMetrics/ScanMetrics/TransactionMetrics) pushed as
+SnapshotReport / ScanReport / TransactionReport to engine-registered
+MetricsReporters (`engine/Engine.java:61`), and spark's
+`recordDeltaOperation` timing scopes (`DeltaLogging.scala:118`).
+
+Reports are plain dicts with a `type` tag so reporters stay trivial;
+`delta_tpu.engine.host.LoggingMetricsReporter` collects them in-memory.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class Timer:
+    def __init__(self):
+        self.count = 0
+        self.total_ns = 0
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.record(time.perf_counter_ns() - t0)
+
+    def record(self, duration_ns: int) -> None:
+        self.count += 1
+        self.total_ns += duration_ns
+
+    @property
+    def total_ms(self) -> float:
+        return self.total_ns / 1e6
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def increment(self, n: int = 1) -> None:
+        self.value += n
+
+
+class SnapshotMetrics:
+    def __init__(self):
+        self.load_init_state_timer = Timer()      # listing + segment build
+        self.columnarize_timer = Timer()          # log parse → arrow
+        self.replay_timer = Timer()               # dedup kernel
+        self.num_commit_files = Counter()
+        self.num_checkpoint_parts = Counter()
+        self.num_actions = Counter()
+        self.bytes_parsed = Counter()
+
+    def report(self, table_path: str, version: int, extra: Optional[Dict] = None) -> Dict:
+        r = {
+            "type": "SnapshotReport",
+            "reportUUID": str(uuid.uuid4()),
+            "tablePath": table_path,
+            "version": version,
+            "loadInitStateMs": self.load_init_state_timer.total_ms,
+            "columnarizeMs": self.columnarize_timer.total_ms,
+            "replayMs": self.replay_timer.total_ms,
+            "numCommitFiles": self.num_commit_files.value,
+            "numCheckpointParts": self.num_checkpoint_parts.value,
+            "numActions": self.num_actions.value,
+            "bytesParsed": self.bytes_parsed.value,
+        }
+        if extra:
+            r.update(extra)
+        return r
+
+
+def transaction_report(
+    table_path: str,
+    operation: str,
+    read_version: int,
+    committed_version: Optional[int],
+    attempts: int,
+    total_ms: float,
+    num_adds: int,
+    num_removes: int,
+    success: bool,
+) -> Dict:
+    return {
+        "type": "TransactionReport",
+        "reportUUID": str(uuid.uuid4()),
+        "tablePath": table_path,
+        "operation": operation,
+        "readVersion": read_version,
+        "committedVersion": committed_version,
+        "numCommitAttempts": attempts,
+        "totalCommitMs": total_ms,
+        "numAddFiles": num_adds,
+        "numRemoveFiles": num_removes,
+        "success": success,
+    }

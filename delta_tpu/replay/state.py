@@ -169,7 +169,9 @@ def build_replay_keys(file_actions: pa.Table) -> tuple[np.ndarray, np.ndarray]:
     return path_codes.astype(np.uint32), dv_codes.astype(np.uint32)
 
 
-def compute_masks_device(columnar: ColumnarActions) -> tuple[np.ndarray, np.ndarray]:
+def compute_masks_device(
+    columnar: ColumnarActions, engine=None
+) -> tuple[np.ndarray, np.ndarray]:
     from delta_tpu.ops.replay import replay_select
 
     fa = columnar.file_actions
@@ -183,6 +185,16 @@ def compute_masks_device(columnar: ColumnarActions) -> tuple[np.ndarray, np.ndar
     assert version.max(initial=0) < 2**31, "version overflow"
     order = np.asarray(fa.column("order"), dtype=np.int32)
     is_add = np.asarray(fa.column("is_add"), dtype=bool)
+
+    mesh = getattr(engine, "mesh", None) if engine is not None else None
+    if mesh is not None and mesh.devices.size > 1:
+        from delta_tpu.parallel.sharded_replay import sharded_replay_select
+
+        live, tomb, _, _ = sharded_replay_select(
+            path_codes, dv_codes, version.astype(np.int32), order, is_add,
+            mesh=mesh,
+        )
+        return live, tomb
     return replay_select(
         [path_codes, dv_codes], version.astype(np.int32), order, is_add
     )
@@ -257,7 +269,11 @@ def check_read_supported(protocol: Protocol) -> None:
 
 def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotState:
     """Full state reconstruction for a log segment."""
-    columnar = columnarize_log_segment(engine, segment)
+    from delta_tpu.metrics import SnapshotMetrics
+
+    metrics = SnapshotMetrics()
+    with metrics.columnarize_timer.time():
+        columnar = columnarize_log_segment(engine, segment)
     if columnar.protocol is None or columnar.metadata is None:
         from delta_tpu.errors import DeltaError
 
@@ -269,10 +285,24 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
         check_read_supported(columnar.protocol)
 
     use_device = getattr(engine, "use_device_replay", False)
-    if use_device:
-        live, tomb = compute_masks_device(columnar)
-    else:
-        live, tomb = compute_masks_host(columnar)
+    with metrics.replay_timer.time():
+        if use_device:
+            live, tomb = compute_masks_device(columnar, engine)
+        else:
+            live, tomb = compute_masks_host(columnar)
+
+    metrics.num_commit_files.increment(columnar.num_commit_files)
+    metrics.num_checkpoint_parts.increment(len(segment.checkpoints))
+    metrics.num_actions.increment(columnar.num_actions)
+    metrics.bytes_parsed.increment(columnar.bytes_parsed)
+    if getattr(engine, "metrics_reporters", None):
+        engine.report_metrics(
+            metrics.report(
+                segment.log_path,
+                segment.version,
+                extra={"replayMode": "device" if use_device else "host"},
+            )
+        )
 
     return SnapshotState(
         version=segment.version,

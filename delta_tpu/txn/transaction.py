@@ -510,6 +510,25 @@ class Transaction:
         attempt_version = self.read_version + 1
         winners_ict: Optional[int] = None
         attempts = 0
+        t_start = time.perf_counter()
+
+        def _report(committed_version, success):
+            if getattr(engine, "metrics_reporters", None):
+                from delta_tpu.metrics import transaction_report
+
+                engine.report_metrics(
+                    transaction_report(
+                        self._table.path,
+                        self.operation,
+                        self.read_version,
+                        committed_version,
+                        attempts,
+                        (time.perf_counter() - t_start) * 1000,
+                        len(self._adds),
+                        len(self._removes),
+                        success,
+                    )
+                )
 
         while attempts <= self._max_retries:
             attempts += 1
@@ -528,7 +547,11 @@ class Transaction:
                 winners = self._read_commit_range(
                     engine, log_path, attempt_version, latest
                 )
-                rebase = check_conflicts(self._read_state(), winners)
+                try:
+                    rebase = check_conflicts(self._read_state(), winners)
+                except Exception:
+                    _report(None, False)
+                    raise
                 if rebase.get("row_id_high_watermark") is not None:
                     self._winners_row_watermark = max(
                         self._winners_row_watermark or -1,
@@ -545,6 +568,7 @@ class Transaction:
             self._committed = True
             if self.observer:
                 self.observer.after_commit(self, attempt_version)
+            _report(attempt_version, True)
             self._run_post_commit_hooks(attempt_version)
             table = self._table
             return CommitResult(

@@ -1,0 +1,32 @@
+"""TpuEngine with a multi-device mesh: full snapshot load through the
+sharded replay path."""
+
+import numpy as np
+import pyarrow as pa
+
+import delta_tpu.api as dta
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.engine.tpu import TpuEngine
+from delta_tpu.parallel import make_mesh
+from delta_tpu.table import Table
+
+
+def test_snapshot_with_mesh_engine(tmp_table_path):
+    for i in range(5):
+        data = pa.table({"id": pa.array(np.arange(i * 50, (i + 1) * 50, dtype=np.int64))})
+        dta.write_table(tmp_table_path, data)
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+
+    delete(Table.for_path(tmp_table_path), col("id") < lit(25))
+
+    mesh_engine = TpuEngine(mesh=make_mesh())
+    snap = Table.for_path(tmp_table_path, mesh_engine).latest_snapshot()
+    host_snap = Table.for_path(tmp_table_path, HostEngine()).latest_snapshot()
+    assert snap.num_files == host_snap.num_files
+    assert snap.size_in_bytes == host_snap.size_in_bytes
+    assert sorted(snap.state.add_files_table.column("path").to_pylist()) == sorted(
+        host_snap.state.add_files_table.column("path").to_pylist()
+    )
+    out = snap.scan().to_arrow()
+    assert out.num_rows == 225

@@ -1,0 +1,56 @@
+import numpy as np
+import pyarrow as pa
+
+import delta_tpu.api as dta
+from delta_tpu.engine.host import HostEngine, LoggingMetricsReporter
+from delta_tpu.engine.tpu import TpuEngine
+from delta_tpu.expressions import col, lit
+from delta_tpu.table import Table
+
+
+def _data(n=50):
+    return pa.table({"id": pa.array(np.arange(n, dtype=np.int64))})
+
+
+def test_snapshot_scan_transaction_reports(tmp_table_path):
+    reporter = LoggingMetricsReporter()
+    engine = TpuEngine(metrics_reporters=[reporter])
+    dta.write_table(tmp_table_path, _data(), engine=engine)
+    table = Table.for_path(tmp_table_path, engine)
+    snap = table.latest_snapshot()
+    snap.scan(filter=col("id") < lit(10)).add_files_table()
+
+    types = [r["type"] for r in reporter.reports]
+    assert "TransactionReport" in types
+    assert "SnapshotReport" in types
+    assert "ScanReport" in types
+
+    txn_r = next(r for r in reporter.reports if r["type"] == "TransactionReport")
+    assert txn_r["success"] and txn_r["committedVersion"] == 0
+    assert txn_r["numAddFiles"] == 1
+    snap_r = next(r for r in reporter.reports if r["type"] == "SnapshotReport")
+    assert snap_r["replayMode"] == "device"
+    assert snap_r["numActions"] >= 1
+    assert snap_r["replayMs"] >= 0
+
+
+def test_engine_call_efficiency(tmp_table_path):
+    """I/O-efficiency regression guard (LogReplayEngineMetricsSuite role):
+    loading a snapshot must parse each commit file exactly once."""
+    engine = HostEngine()
+    for i in range(5):
+        dta.write_table(tmp_table_path, _data(5), engine=engine)
+
+    reads = []
+    orig = engine.fs.read_file
+
+    def counting_read(path):
+        reads.append(path)
+        return orig(path)
+
+    engine.fs.read_file = counting_read
+    snap = Table.for_path(tmp_table_path, engine).latest_snapshot()
+    _ = snap.state
+    commit_reads = [p for p in reads if p.endswith(".json") and "_delta_log" in p]
+    # 5 commits, each parsed once
+    assert len([p for p in commit_reads if not p.endswith("_last_checkpoint")]) == 5
