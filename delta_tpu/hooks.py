@@ -38,8 +38,51 @@ def checksum_hook(table, txn, version: int, metadata) -> None:
     write_checksum_for_commit(table, txn, version)
 
 
+AUTO_COMPACT_MIN_FILES = 50
+AUTO_COMPACT_MAX_FILE_SIZE = 128 * 1024 * 1024
+
+
+def auto_compact_hook(table, txn, version: int, metadata) -> None:
+    """AutoCompact (`hooks/AutoCompact.scala`): after a data-changing
+    commit on a table with delta.autoOptimize.autoCompact, compact
+    partitions that accumulated enough small files."""
+    if metadata.configuration.get("delta.autoOptimize.autoCompact", "").lower() != "true":
+        return
+    if txn.operation == "OPTIMIZE" or not txn._adds:
+        return
+    snap = table.snapshot_at(version)
+    small = sum(
+        1 for s in snap.state.add_files_table.column("size").to_pylist()
+        if (s or 0) < AUTO_COMPACT_MAX_FILE_SIZE
+    )
+    if small < AUTO_COMPACT_MIN_FILES:
+        return
+    from delta_tpu.commands.optimize import _run_optimize
+
+    _run_optimize(
+        table, None, zorder_by=None,
+        min_file_size=AUTO_COMPACT_MAX_FILE_SIZE,
+        max_file_size=AUTO_COMPACT_MAX_FILE_SIZE,
+    )
+
+
+def uniform_hooks(table, txn, version: int, metadata) -> None:
+    formats = metadata.configuration.get("delta.universalFormat.enabledFormats", "")
+    if "iceberg" in formats:
+        from delta_tpu.interop.iceberg import iceberg_converter_hook
+
+        iceberg_converter_hook(table, txn, version, metadata)
+    if "hudi" in formats:
+        from delta_tpu.interop.hudi import hudi_converter_hook
+
+        hudi_converter_hook(table, txn, version, metadata)
+
+
 def run_post_commit_hooks(table, txn, version: int, metadata) -> None:
-    for hook in (checksum_hook, checkpoint_hook, *_EXTRA_HOOKS):
+    for hook in (
+        checksum_hook, checkpoint_hook, auto_compact_hook, uniform_hooks,
+        *_EXTRA_HOOKS,
+    ):
         try:
             hook(table, txn, version, metadata)
         except Exception:

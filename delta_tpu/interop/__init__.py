@@ -1,0 +1,4 @@
+"""Interop: UniForm metadata converters (Iceberg, Hudi) and the sharing
+client. The reference ships these as `iceberg/`, `hudi/`, `sharing/`
+modules driven by post-commit hooks (`IcebergConverterHook.scala`,
+`HudiConverterHook.scala`)."""

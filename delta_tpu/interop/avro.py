@@ -1,0 +1,250 @@
+"""Minimal clean-room Avro Object Container File writer/reader.
+
+Implements exactly the subset the Iceberg manifest format needs (the
+Avro 1.11 spec's binary encoding): null/boolean/int/long/float/double/
+bytes/string primitives, records, unions, arrays, maps, and the OCF
+framing (magic, metadata map, sync-marked blocks, null codec).
+
+Schemas are plain dicts in Avro JSON form; extra keys (like Iceberg's
+`field-id`) pass through into the embedded schema JSON, which is how
+Iceberg attaches its ids.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from typing import Any, Dict, Iterable, List
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# binary encoding
+# ---------------------------------------------------------------------------
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: io.BytesIO, n: int) -> None:
+    z = _zigzag(n) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            break
+
+
+def read_long(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        byte = buf.read(1)
+        if not byte:
+            raise EOFError
+        b = byte[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return _unzigzag(acc)
+
+
+def write_bytes(buf: io.BytesIO, data: bytes) -> None:
+    write_long(buf, len(data))
+    buf.write(data)
+
+
+def read_bytes(buf: io.BytesIO) -> bytes:
+    n = read_long(buf)
+    return buf.read(n)
+
+
+def _resolve(schema):
+    if isinstance(schema, str):
+        return {"type": schema}
+    return schema
+
+
+def encode(buf: io.BytesIO, schema, value) -> None:
+    if isinstance(schema, list):  # union
+        for i, branch in enumerate(schema):
+            bt = _resolve(branch)["type"] if not isinstance(branch, list) else None
+            if value is None and bt == "null":
+                write_long(buf, i)
+                return
+            if value is not None and bt != "null":
+                write_long(buf, i)
+                encode(buf, branch, value)
+                return
+        raise ValueError(f"value {value!r} matches no union branch {schema}")
+    s = _resolve(schema)
+    t = s["type"]
+    if t == "null":
+        return
+    if t == "boolean":
+        buf.write(b"\x01" if value else b"\x00")
+    elif t in ("int", "long"):
+        write_long(buf, int(value))
+    elif t == "float":
+        buf.write(struct.pack("<f", float(value)))
+    elif t == "double":
+        buf.write(struct.pack("<d", float(value)))
+    elif t == "bytes":
+        write_bytes(buf, bytes(value))
+    elif t == "string":
+        write_bytes(buf, value.encode("utf-8"))
+    elif t == "record":
+        for f in s["fields"]:
+            fv = value.get(f["name"]) if isinstance(value, dict) else getattr(value, f["name"])
+            encode(buf, f["type"], fv)
+    elif t == "array":
+        items = list(value or [])
+        if items:
+            write_long(buf, len(items))
+            for it in items:
+                encode(buf, s["items"], it)
+        write_long(buf, 0)
+    elif t == "map":
+        entries = dict(value or {})
+        if entries:
+            write_long(buf, len(entries))
+            for k, v in entries.items():
+                write_bytes(buf, k.encode("utf-8"))
+                encode(buf, s["values"], v)
+        write_long(buf, 0)
+    elif t == "fixed":
+        assert len(value) == s["size"]
+        buf.write(bytes(value))
+    else:
+        raise ValueError(f"unsupported avro type {t}")
+
+
+def decode(buf: io.BytesIO, schema):
+    if isinstance(schema, list):
+        idx = read_long(buf)
+        return decode(buf, schema[idx])
+    s = _resolve(schema)
+    t = s["type"]
+    if t == "null":
+        return None
+    if t == "boolean":
+        return buf.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return read_bytes(buf)
+    if t == "string":
+        return read_bytes(buf).decode("utf-8")
+    if t == "record":
+        return {f["name"]: decode(buf, f["type"]) for f in s["fields"]}
+    if t == "array":
+        out = []
+        while True:
+            n = read_long(buf)
+            if n == 0:
+                break
+            if n < 0:
+                read_long(buf)  # block byte size
+                n = -n
+            for _ in range(n):
+                out.append(decode(buf, s["items"]))
+        return out
+    if t == "map":
+        out = {}
+        while True:
+            n = read_long(buf)
+            if n == 0:
+                break
+            if n < 0:
+                read_long(buf)
+                n = -n
+            for _ in range(n):
+                k = read_bytes(buf).decode("utf-8")
+                out[k] = decode(buf, s["values"])
+        return out
+    if t == "fixed":
+        return buf.read(s["size"])
+    raise ValueError(f"unsupported avro type {t}")
+
+
+# ---------------------------------------------------------------------------
+# object container files
+# ---------------------------------------------------------------------------
+
+
+def write_ocf(
+    schema: Dict,
+    records: Iterable[Dict],
+    metadata: Dict[str, str] | None = None,
+) -> bytes:
+    buf = io.BytesIO()
+    buf.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema), "avro.codec": "null"}
+    meta.update(metadata or {})
+    write_long(buf, len(meta))
+    for k, v in meta.items():
+        write_bytes(buf, k.encode())
+        write_bytes(buf, v.encode() if isinstance(v, str) else v)
+    write_long(buf, 0)
+    sync = os.urandom(16)
+    buf.write(sync)
+
+    records = list(records)
+    if records:
+        block = io.BytesIO()
+        for r in records:
+            encode(block, schema, r)
+        data = block.getvalue()
+        write_long(buf, len(records))
+        write_long(buf, len(data))
+        buf.write(data)
+        buf.write(sync)
+    return buf.getvalue()
+
+
+def read_ocf(data: bytes) -> tuple[Dict, List[Dict], Dict[str, bytes]]:
+    buf = io.BytesIO(data)
+    assert buf.read(4) == MAGIC, "not an avro object container file"
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = read_long(buf)
+        if n == 0:
+            break
+        if n < 0:
+            read_long(buf)
+            n = -n
+        for _ in range(n):
+            k = read_bytes(buf).decode()
+            meta[k] = read_bytes(buf)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null")
+    assert codec in (b"null", "null"), f"unsupported codec {codec}"
+    sync = buf.read(16)
+    records = []
+    while True:
+        try:
+            count = read_long(buf)
+        except EOFError:
+            break
+        size = read_long(buf)
+        block = io.BytesIO(buf.read(size))
+        for _ in range(count):
+            records.append(decode(block, schema))
+        assert buf.read(16) == sync, "sync marker mismatch"
+    return schema, records, meta

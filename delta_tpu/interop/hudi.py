@@ -1,0 +1,69 @@
+"""UniForm Hudi export (reference `hudi/` module + HudiConverterHook).
+
+Writes the Hudi copy-on-write table skeleton: `.hoodie/hoodie.properties`
+and a commit timeline where each converted Delta snapshot becomes a
+`<ts>.commit` JSON document listing the live files (Hudi's
+HoodieCommitMetadata shape: partitionToWriteStats)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+UNIFORM_FORMATS_KEY = "delta.universalFormat.enabledFormats"
+
+
+def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
+    table_path = table_path or snapshot.table_path
+    hoodie = os.path.join(table_path, ".hoodie")
+    os.makedirs(hoodie, exist_ok=True)
+    props_path = os.path.join(hoodie, "hoodie.properties")
+    meta = snapshot.metadata
+    if not os.path.exists(props_path):
+        props = {
+            "hoodie.table.name": meta.name or os.path.basename(table_path),
+            "hoodie.table.type": "COPY_ON_WRITE",
+            "hoodie.table.version": "6",
+            "hoodie.timeline.layout.version": "1",
+            "hoodie.table.base.file.format": "PARQUET",
+            "hoodie.table.partition.fields": ",".join(meta.partitionColumns),
+            "hoodie.table.checksum": "0",
+        }
+        with open(props_path, "w") as f:
+            f.write("#Updated at " + time.strftime("%c") + "\n")
+            for k, v in props.items():
+                f.write(f"{k}={v}\n")
+
+    instant = time.strftime("%Y%m%d%H%M%S") + f"{snapshot.version:03d}"
+    files = snapshot.state.add_files_table
+    partition_stats: dict = {}
+    for p, size, pv in zip(
+        files.column("path").to_pylist(),
+        files.column("size").to_pylist(),
+        files.column("partition_values").to_pylist(),
+    ):
+        pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
+        partition = "/".join(
+            f"{k}={v}" for k, v in sorted(pv_dict.items())
+        ) or ""
+        partition_stats.setdefault(partition, []).append(
+            {"path": p, "fileSizeInBytes": int(size or 0)}
+        )
+    commit_doc = {
+        "partitionToWriteStats": partition_stats,
+        "compacted": False,
+        "extraMetadata": {"delta.version": str(snapshot.version)},
+        "operationType": "UPSERT",
+    }
+    commit_path = os.path.join(hoodie, f"{instant}.commit")
+    with open(commit_path, "w") as f:
+        json.dump(commit_doc, f, indent=2)
+    return commit_path
+
+
+def hudi_converter_hook(table, txn, version: int, metadata) -> None:
+    if "hudi" not in metadata.configuration.get(UNIFORM_FORMATS_KEY, ""):
+        return
+    convert_snapshot(table.snapshot_at(version))

@@ -1,0 +1,374 @@
+"""UniForm: Iceberg metadata generated alongside the Delta log.
+
+Reference `iceberg/` module + `UniversalFormat.scala` +
+`IcebergConverterHook.scala:31`: when
+`delta.universalFormat.enabledFormats` contains `iceberg`, every commit
+triggers (asynchronously in the reference; synchronously here) a
+conversion that writes Iceberg v2 metadata — manifest files (Avro),
+a manifest list (Avro), vN.metadata.json, and version-hint.text — under
+`<table>/metadata/`, all pointing at the same Parquet data files.
+
+The converter snapshots from the Delta state table; each conversion is a
+full rewrite of one manifest (correct, if not incremental — the
+reference's IcebergConversionTransaction also rewrites on snapshot
+boundaries)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from delta_tpu.interop import avro as avro_io
+from delta_tpu.models.schema import (
+    ArrayType,
+    DataType,
+    MapType,
+    PrimitiveType,
+    StructType,
+)
+
+UNIFORM_FORMATS_KEY = "delta.universalFormat.enabledFormats"
+
+_DELTA_TO_ICEBERG = {
+    "boolean": "boolean",
+    "integer": "int",
+    "short": "int",
+    "byte": "int",
+    "long": "long",
+    "float": "float",
+    "double": "double",
+    "string": "string",
+    "binary": "binary",
+    "date": "date",
+    "timestamp": "timestamptz",
+    "timestamp_ntz": "timestamp",
+}
+
+
+class _IdGen:
+    def __init__(self):
+        self.next_id = 0
+
+    def __call__(self):
+        self.next_id += 1
+        return self.next_id
+
+
+def _iceberg_type(dt: DataType, ids: _IdGen):
+    if isinstance(dt, PrimitiveType):
+        if dt.is_decimal:
+            p, s = dt.decimal_precision_scale()
+            return f"decimal({p}, {s})"
+        t = _DELTA_TO_ICEBERG.get(dt.name)
+        if t is None:
+            raise ValueError(f"no iceberg mapping for {dt.name}")
+        return t
+    if isinstance(dt, StructType):
+        return {
+            "type": "struct",
+            "fields": [
+                {
+                    "id": ids(),
+                    "name": f.name,
+                    "required": not f.nullable,
+                    "type": _iceberg_type(f.dataType, ids),
+                }
+                for f in dt.fields
+            ],
+        }
+    if isinstance(dt, ArrayType):
+        return {
+            "type": "list",
+            "element-id": ids(),
+            "element": _iceberg_type(dt.elementType, ids),
+            "element-required": not dt.containsNull,
+        }
+    if isinstance(dt, MapType):
+        return {
+            "type": "map",
+            "key-id": ids(),
+            "key": _iceberg_type(dt.keyType, ids),
+            "value-id": ids(),
+            "value": _iceberg_type(dt.valueType, ids),
+            "value-required": not dt.valueContainsNull,
+        }
+    raise ValueError(f"cannot convert {dt!r}")
+
+
+def iceberg_schema(schema: StructType) -> Dict:
+    ids = _IdGen()
+    top = _iceberg_type(schema, ids)
+    return {"schema-id": 0, **top}, ids.next_id
+
+
+def _field_id_of(ice_schema: Dict, name: str) -> int:
+    for f in ice_schema["fields"]:
+        if f["name"] == name:
+            return f["id"]
+    raise KeyError(name)
+
+
+# Avro schemas for manifests (field-ids per the Iceberg spec appendix).
+
+
+def _manifest_entry_schema(partition_fields: List[Dict]) -> Dict:
+    partition_record = {
+        "type": "record",
+        "name": "r102",
+        "fields": partition_fields,
+    }
+    data_file = {
+        "type": "record",
+        "name": "r2",
+        "fields": [
+            {"name": "content", "type": "int", "field-id": 134},
+            {"name": "file_path", "type": "string", "field-id": 100},
+            {"name": "file_format", "type": "string", "field-id": 101},
+            {"name": "partition", "type": partition_record, "field-id": 102},
+            {"name": "record_count", "type": "long", "field-id": 103},
+            {"name": "file_size_in_bytes", "type": "long", "field-id": 104},
+        ],
+    }
+    return {
+        "type": "record",
+        "name": "manifest_entry",
+        "fields": [
+            {"name": "status", "type": "int", "field-id": 0},
+            {"name": "snapshot_id", "type": ["null", "long"], "field-id": 1},
+            {"name": "sequence_number", "type": ["null", "long"], "field-id": 3},
+            {"name": "file_sequence_number", "type": ["null", "long"], "field-id": 4},
+            {"name": "data_file", "type": data_file, "field-id": 2},
+        ],
+    }
+
+
+_MANIFEST_FILE_SCHEMA = {
+    "type": "record",
+    "name": "manifest_file",
+    "fields": [
+        {"name": "manifest_path", "type": "string", "field-id": 500},
+        {"name": "manifest_length", "type": "long", "field-id": 501},
+        {"name": "partition_spec_id", "type": "int", "field-id": 502},
+        {"name": "content", "type": "int", "field-id": 517},
+        {"name": "sequence_number", "type": "long", "field-id": 515},
+        {"name": "min_sequence_number", "type": "long", "field-id": 516},
+        {"name": "added_snapshot_id", "type": "long", "field-id": 503},
+        {"name": "added_files_count", "type": "int", "field-id": 504},
+        {"name": "existing_files_count", "type": "int", "field-id": 505},
+        {"name": "deleted_files_count", "type": "int", "field-id": 506},
+        {"name": "added_rows_count", "type": "long", "field-id": 512},
+        {"name": "existing_rows_count", "type": "long", "field-id": 513},
+        {"name": "deleted_rows_count", "type": "long", "field-id": 514},
+    ],
+}
+
+_ICEBERG_PRIM_TO_AVRO = {
+    "boolean": "boolean",
+    "int": "int",
+    "long": "long",
+    "float": "float",
+    "double": "double",
+    "string": "string",
+    "binary": "bytes",
+    "date": {"type": "int", "logicalType": "date"},
+    "timestamp": {"type": "long", "logicalType": "timestamp-micros"},
+    "timestamptz": {"type": "long", "logicalType": "timestamp-micros"},
+}
+
+
+def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
+    """Write Iceberg metadata for `snapshot`; returns the metadata.json
+    path."""
+    table_path = table_path or snapshot.table_path
+    meta_dir = os.path.join(table_path, "metadata")
+    os.makedirs(meta_dir, exist_ok=True)
+
+    delta_meta = snapshot.metadata
+    schema = delta_meta.schema
+    ice_schema, last_column_id = iceberg_schema(schema)
+    partition_cols = list(delta_meta.partitionColumns)
+    snapshot_id = snapshot.version + 1  # stable, monotonic
+    sequence_number = snapshot.version + 1
+    now_ms = int(time.time() * 1000)
+
+    # partition spec
+    spec_fields = []
+    partition_avro_fields = []
+    for i, c in enumerate(partition_cols):
+        source_id = _field_id_of(ice_schema, c)
+        field_id = 1000 + i
+        spec_fields.append(
+            {"name": c, "transform": "identity", "source-id": source_id,
+             "field-id": field_id}
+        )
+        f = schema[c]
+        ice_t = (
+            _DELTA_TO_ICEBERG.get(f.dataType.name, "string")
+            if isinstance(f.dataType, PrimitiveType)
+            else "string"
+        )
+        avro_t = _ICEBERG_PRIM_TO_AVRO.get(ice_t, "string")
+        partition_avro_fields.append(
+            {"name": c, "type": ["null", avro_t], "field-id": field_id}
+        )
+
+    # --- manifest ---
+    from delta_tpu.stats.partition import deserialize_partition_value
+
+    entries = []
+    files = snapshot.state.add_files_table
+    paths = files.column("path").to_pylist()
+    sizes = files.column("size").to_pylist()
+    pvs = files.column("partition_values").to_pylist()
+    stats_col = files.column("stats").to_pylist()
+    total_rows = 0
+    for p, size, pv, st in zip(paths, sizes, pvs, stats_col):
+        abs_path = p if ("://" in p or p.startswith("/")) else f"{table_path}/{p}"
+        nrec = 0
+        if st:
+            try:
+                nrec = int(json.loads(st).get("numRecords") or 0)
+            except ValueError:
+                pass
+        total_rows += nrec
+        pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
+        partition = {}
+        for c in partition_cols:
+            f = schema[c]
+            dtype = f.dataType if isinstance(f.dataType, PrimitiveType) else PrimitiveType("string")
+            v = deserialize_partition_value(pv_dict.get(c), dtype)
+            import datetime as dt
+
+            if isinstance(v, dt.date) and not isinstance(v, dt.datetime):
+                v = (v - dt.date(1970, 1, 1)).days
+            elif isinstance(v, dt.datetime):
+                v = int(v.timestamp() * 1_000_000)
+            partition[c] = v
+        entries.append(
+            {
+                "status": 1,  # ADDED (full rewrite each conversion)
+                "snapshot_id": snapshot_id,
+                "sequence_number": None,     # inherited
+                "file_sequence_number": None,
+                "data_file": {
+                    "content": 0,
+                    "file_path": abs_path,
+                    "file_format": "PARQUET",
+                    "partition": partition,
+                    "record_count": nrec,
+                    "file_size_in_bytes": int(size or 0),
+                },
+            }
+        )
+
+    entry_schema = _manifest_entry_schema(partition_avro_fields)
+    manifest_name = f"manifest-{uuid.uuid4()}.avro"
+    manifest_path = os.path.join(meta_dir, manifest_name)
+    manifest_bytes = avro_io.write_ocf(
+        entry_schema, entries,
+        metadata={
+            "schema": json.dumps(ice_schema),
+            "partition-spec": json.dumps(spec_fields),
+            "partition-spec-id": "0",
+            "format-version": "2",
+            "content": "data",
+        },
+    )
+    with open(manifest_path, "wb") as f:
+        f.write(manifest_bytes)
+
+    # --- manifest list ---
+    mlist_name = f"snap-{snapshot_id}-{uuid.uuid4()}.avro"
+    mlist_path = os.path.join(meta_dir, mlist_name)
+    mlist_bytes = avro_io.write_ocf(
+        _MANIFEST_FILE_SCHEMA,
+        [
+            {
+                "manifest_path": manifest_path,
+                "manifest_length": len(manifest_bytes),
+                "partition_spec_id": 0,
+                "content": 0,
+                "sequence_number": sequence_number,
+                "min_sequence_number": sequence_number,
+                "added_snapshot_id": snapshot_id,
+                "added_files_count": len(entries),
+                "existing_files_count": 0,
+                "deleted_files_count": 0,
+                "added_rows_count": total_rows,
+                "existing_rows_count": 0,
+                "deleted_rows_count": 0,
+            }
+        ],
+        metadata={"format-version": "2"},
+    )
+    with open(mlist_path, "wb") as f:
+        f.write(mlist_bytes)
+
+    # --- table metadata ---
+    prev_meta = _read_version_hint(meta_dir)
+    metadata_version = (prev_meta or 0) + 1
+    metadata_doc = {
+        "format-version": 2,
+        "table-uuid": delta_meta.id,
+        "location": table_path,
+        "last-sequence-number": sequence_number,
+        "last-updated-ms": now_ms,
+        "last-column-id": last_column_id,
+        "current-schema-id": 0,
+        "schemas": [ice_schema],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": spec_fields}],
+        "last-partition-id": 1000 + max(0, len(spec_fields)) - 1 if spec_fields else 999,
+        "default-sort-order-id": 0,
+        "sort-orders": [{"order-id": 0, "fields": []}],
+        "properties": {
+            "delta.universalFormat": "iceberg",
+            "delta.version": str(snapshot.version),
+        },
+        "current-snapshot-id": snapshot_id,
+        "snapshots": [
+            {
+                "snapshot-id": snapshot_id,
+                "sequence-number": sequence_number,
+                "timestamp-ms": now_ms,
+                "manifest-list": mlist_path,
+                "summary": {
+                    "operation": "overwrite",
+                    "added-data-files": str(len(entries)),
+                    "total-records": str(total_rows),
+                },
+                "schema-id": 0,
+            }
+        ],
+        "snapshot-log": [
+            {"snapshot-id": snapshot_id, "timestamp-ms": now_ms}
+        ],
+        "metadata-log": [],
+    }
+    md_path = os.path.join(meta_dir, f"v{metadata_version}.metadata.json")
+    with open(md_path, "w") as f:
+        json.dump(metadata_doc, f, indent=2)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(metadata_version))
+    return md_path
+
+
+def _read_version_hint(meta_dir: str) -> Optional[int]:
+    try:
+        with open(os.path.join(meta_dir, "version-hint.text")) as f:
+            return int(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def iceberg_converter_hook(table, txn, version: int, metadata) -> None:
+    """Post-commit UniForm hook (register via
+    delta_tpu.hooks.register_post_commit_hook)."""
+    formats = metadata.configuration.get(UNIFORM_FORMATS_KEY, "")
+    if "iceberg" not in formats:
+        return
+    snap = table.snapshot_at(version)
+    convert_snapshot(snap)

@@ -345,8 +345,12 @@ def parse_commit_batch(
         total += len(blob)
         if not blob.endswith(b"\n"):
             blob = blob + b"\n"
-        # count non-empty lines
-        nlines = sum(1 for ln in blob.split(b"\n") if ln.strip())
+        # vectorized line count; writers never emit blank lines, but fall
+        # back to an exact scan if one shows up
+        if b"\n\n" in blob or blob.startswith(b"\n"):
+            nlines = sum(1 for ln in blob.split(b"\n") if ln.strip())
+        else:
+            nlines = int((np.frombuffer(blob, np.uint8) == 10).sum())
         bufs.append(blob)
         versions_parts.append(np.full(nlines, version, np.int64))
         orders_parts.append(np.arange(nlines, dtype=np.int32))

@@ -1,0 +1,225 @@
+"""Delta SQL statement surface.
+
+The reference extends Spark SQL with delta-specific statements
+(`DeltaSqlBase.g4:74-95`). This module provides the same statement set
+over table *paths* (there is no external catalog in-process):
+
+    VACUUM '/path' [RETAIN n HOURS] [DRY RUN]
+    OPTIMIZE '/path' [WHERE <pred>] [ZORDER BY (c1, c2)]
+    DESCRIBE HISTORY '/path' [LIMIT n]
+    DESCRIBE DETAIL '/path'
+    RESTORE TABLE '/path' TO VERSION AS OF n
+    RESTORE TABLE '/path' TO TIMESTAMP AS OF <ms|'iso'>
+    CONVERT TO DELTA parquet.'/path' [PARTITIONED BY (c type, ...)]
+    ALTER TABLE '/path' ADD CONSTRAINT name CHECK (<pred>)
+    ALTER TABLE '/path' DROP CONSTRAINT [IF EXISTS] name
+
+Plus (not in the reference grammar, for symmetry with our API):
+    DELETE FROM '/path' [WHERE <pred>]
+    UPDATE '/path' SET col = <literal>[, ...] [WHERE <pred>]
+
+Returns command-specific results (VacuumResult, OptimizeMetrics, history
+records as dicts, an Arrow table for DESCRIBE DETAIL, commit versions...).
+WHERE/CHECK predicates use the persisted-expression subset
+(`expressions/parser.py`).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.parser import parse_expression
+from delta_tpu.table import Table
+
+_PATH = r"(?:'(?P<path>[^']+)'|delta\.`(?P<path2>[^`]+)`|\"(?P<path3>[^\"]+)\")"
+
+
+def _path_of(m) -> str:
+    return m.group("path") or m.group("path2") or m.group("path3")
+
+
+def _table(m, engine) -> Table:
+    return Table.for_path(_path_of(m), engine)
+
+
+def sql(statement: str, engine=None):
+    """Execute one Delta SQL statement against a table path."""
+    s = statement.strip().rstrip(";").strip()
+
+    m = re.fullmatch(
+        rf"VACUUM\s+{_PATH}(?:\s+RETAIN\s+(?P<hours>[\d.]+)\s+HOURS)?"
+        r"(?P<dry>\s+DRY\s+RUN)?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.vacuum import vacuum
+
+        return vacuum(
+            _table(m, engine),
+            retention_hours=float(m.group("hours")) if m.group("hours") else None,
+            dry_run=m.group("dry") is not None,
+        )
+
+    m = re.fullmatch(
+        rf"OPTIMIZE\s+{_PATH}(?:\s+WHERE\s+(?P<where>.+?))?"
+        r"(?:\s+ZORDER\s+BY\s+\((?P<zcols>[^)]+)\))?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        builder = _table(m, engine).optimize()
+        if m.group("where"):
+            builder = builder.where(parse_expression(m.group("where")))
+        if m.group("zcols"):
+            cols = [c.strip().strip("`") for c in m.group("zcols").split(",")]
+            return builder.execute_zorder_by(*cols)
+        return builder.execute_compaction()
+
+    m = re.fullmatch(
+        rf"(?:DESC|DESCRIBE)\s+HISTORY\s+{_PATH}(?:\s+LIMIT\s+(?P<limit>\d+))?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        limit = int(m.group("limit")) if m.group("limit") else None
+        return [r.to_dict() for r in _table(m, engine).history(limit)]
+
+    m = re.fullmatch(rf"(?:DESC|DESCRIBE)\s+DETAIL\s+{_PATH}", s, re.IGNORECASE)
+    if m:
+        return describe_detail(_table(m, engine))
+
+    m = re.fullmatch(
+        rf"RESTORE\s+(?:TABLE\s+)?{_PATH}\s+TO\s+VERSION\s+AS\s+OF\s+(?P<v>\d+)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.restore import restore
+
+        return restore(_table(m, engine), version=int(m.group("v")))
+
+    m = re.fullmatch(
+        rf"RESTORE\s+(?:TABLE\s+)?{_PATH}\s+TO\s+TIMESTAMP\s+AS\s+OF\s+"
+        r"(?:(?P<ms>\d+)|'(?P<iso>[^']+)')",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.restore import restore
+
+        if m.group("ms"):
+            ts = int(m.group("ms"))
+        else:
+            import datetime as dt
+
+            ts = int(dt.datetime.fromisoformat(m.group("iso")).timestamp() * 1000)
+        return restore(_table(m, engine), timestamp_ms=ts)
+
+    m = re.fullmatch(
+        rf"CONVERT\s+TO\s+DELTA\s+parquet\.{_PATH}"
+        r"(?:\s+PARTITIONED\s+BY\s+\((?P<parts>[^)]+)\))?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.restore import convert_to_delta
+
+        part_schema = None
+        if m.group("parts"):
+            part_schema = {}
+            for item in m.group("parts").split(","):
+                name, _, typ = item.strip().partition(" ")
+                part_schema[name.strip("`")] = typ.strip() or "string"
+        return convert_to_delta(_path_of(m), partition_schema=part_schema,
+                                engine=engine)
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+ADD\s+CONSTRAINT\s+(?P<name>\w+)\s+"
+        r"CHECK\s*\((?P<expr>.+)\)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.constraints import add_constraint
+
+        return add_constraint(_table(m, engine), m.group("name"), m.group("expr"))
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+DROP\s+CONSTRAINT\s+"
+        r"(?P<ife>IF\s+EXISTS\s+)?(?P<name>\w+)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.constraints import drop_constraint
+
+        return drop_constraint(
+            _table(m, engine), m.group("name"), if_exists=m.group("ife") is not None
+        )
+
+    m = re.fullmatch(
+        rf"DELETE\s+FROM\s+{_PATH}(?:\s+WHERE\s+(?P<where>.+))?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.dml import delete
+
+        pred = parse_expression(m.group("where")) if m.group("where") else None
+        return delete(_table(m, engine), pred)
+
+    m = re.fullmatch(
+        rf"UPDATE\s+{_PATH}\s+SET\s+(?P<sets>.+?)(?:\s+WHERE\s+(?P<where>.+))?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.dml import update
+
+        assignments = {}
+        for part in _split_top_level_commas(m.group("sets")):
+            col_name, _, value = part.partition("=")
+            assignments[col_name.strip().strip("`")] = parse_expression(value.strip())
+        pred = parse_expression(m.group("where")) if m.group("where") else None
+        return update(_table(m, engine), assignments, pred)
+
+    raise DeltaError(f"cannot parse Delta SQL statement: {statement!r}")
+
+
+def _split_top_level_commas(s: str):
+    out, depth, cur = [], 0, []
+    in_str = False
+    for ch in s:
+        if ch == "'":
+            in_str = not in_str
+        elif not in_str:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                out.append("".join(cur))
+                cur = []
+                continue
+        cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def describe_detail(table: Table) -> dict:
+    """DESCRIBE DETAIL row (reference `DeltaTableV2` detail schema)."""
+    snap = table.latest_snapshot()
+    meta = snap.metadata
+    return {
+        "format": meta.format.provider,
+        "id": meta.id,
+        "name": meta.name,
+        "description": meta.description,
+        "location": table.path,
+        "createdAt": meta.createdTime,
+        "lastModified": snap.timestamp_ms,
+        "partitionColumns": list(meta.partitionColumns),
+        "numFiles": snap.num_files,
+        "sizeInBytes": snap.size_in_bytes,
+        "properties": dict(meta.configuration),
+        "minReaderVersion": snap.protocol.minReaderVersion,
+        "minWriterVersion": snap.protocol.minWriterVersion,
+        "tableFeatures": sorted(
+            snap.protocol.reader_feature_set() | snap.protocol.writer_feature_set()
+        ),
+        "version": snap.version,
+    }

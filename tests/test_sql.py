@@ -1,0 +1,91 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.errors import DeltaError
+from delta_tpu.sql import sql
+
+
+@pytest.fixture
+def path(tmp_table_path):
+    for i in range(3):
+        data = pa.table(
+            {
+                "id": pa.array(np.arange(i * 100, (i + 1) * 100, dtype=np.int64)),
+                "v": pa.array(np.full(100, float(i))),
+            }
+        )
+        dta.write_table(tmp_table_path, data)
+    return tmp_table_path
+
+
+def test_describe_history_and_detail(path):
+    hist = sql(f"DESCRIBE HISTORY '{path}' LIMIT 2")
+    assert len(hist) == 2
+    assert hist[0]["version"] == 2
+    detail = sql(f"DESCRIBE DETAIL '{path}'")
+    assert detail["numFiles"] == 3
+    assert detail["version"] == 2
+    assert detail["format"] == "parquet"
+
+
+def test_optimize_and_vacuum(path):
+    m = sql(f"OPTIMIZE '{path}'")
+    assert m.num_files_removed == 3
+    res = sql(f"VACUUM '{path}' RETAIN 0 HOURS DRY RUN")
+    assert res.dry_run and res.num_deleted == 3
+    res2 = sql(f"VACUUM '{path}' RETAIN 0 HOURS")
+    assert res2.num_deleted == 3
+    assert dta.read_table(path).num_rows == 300
+
+
+def test_optimize_zorder_sql(path):
+    m = sql(f"OPTIMIZE '{path}' ZORDER BY (id, v)")
+    assert m.num_files_added >= 1
+    assert dta.read_table(path).num_rows == 300
+
+
+def test_delete_update_sql(path):
+    sql(f"DELETE FROM '{path}' WHERE id < 100")
+    assert dta.read_table(path).num_rows == 200
+    sql(f"UPDATE '{path}' SET v = 99.0 WHERE id >= 250")
+    out = dta.read_table(path)
+    import pyarrow.compute as pc
+
+    assert pc.sum(pc.equal(out.column("v"), 99.0)).as_py() == 50
+
+
+def test_restore_sql(path):
+    sql(f"RESTORE TABLE '{path}' TO VERSION AS OF 0")
+    assert dta.read_table(path).num_rows == 100
+
+
+def test_constraints_sql(path):
+    sql(f"ALTER TABLE '{path}' ADD CONSTRAINT idpos CHECK (id >= 0)")
+    from delta_tpu.errors import InvariantViolationError
+
+    bad = pa.table({"id": pa.array([-1], pa.int64()), "v": pa.array([0.0])})
+    with pytest.raises(InvariantViolationError):
+        dta.write_table(path, bad)
+    sql(f"ALTER TABLE '{path}' DROP CONSTRAINT idpos")
+    dta.write_table(path, bad)
+
+
+def test_convert_sql(tmp_path):
+    import pyarrow.parquet as pq
+
+    root = str(tmp_path / "plain")
+    import os
+
+    os.makedirs(root)
+    pq.write_table(pa.table({"x": pa.array([1, 2, 3], pa.int64())}),
+                   f"{root}/f.parquet")
+    v = sql(f"CONVERT TO DELTA parquet.'{root}'")
+    assert v == 0
+    assert dta.read_table(root).num_rows == 3
+
+
+def test_bad_statement():
+    with pytest.raises(DeltaError):
+        sql("FROBNICATE '/x'")
