@@ -1,0 +1,151 @@
+"""Pallas TPU kernels for the hottest per-row ops.
+
+Two kernels with identical jnp fallbacks (used automatically off-TPU or
+via `interpret=True` on CPU):
+
+- `interleave_bits_tiled`: the OPTIMIZE ZORDER curve-key op. One VMEM
+  pass per [8, 128] tile computes all output words — the 32·k-step bit
+  loop stays in registers instead of materializing 32·k intermediate
+  arrays for XLA to fuse.
+- `segmented_minmax`: per-file min/max/count over a [files, rows] batch
+  with a validity mask — the stats-collection reduction when many data
+  files are written in one call (stats for the skipping index,
+  `StatisticsCollection.scala:257` role).
+
+Layout notes: rows are padded to 128 lanes; tiles are (8, 128) float32 /
+int32 per the TPU tiling table.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+_LANES = 128
+_SUBLANES = 8
+_TILE = _SUBLANES * _LANES
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# interleave bits
+# ---------------------------------------------------------------------------
+
+
+def _interleave_kernel(n_cols: int, n_bits: int, n_words: int, in_ref, out_ref):
+    """in_ref: [k, 8, 128] uint32; out_ref: [w, 8, 128] uint32."""
+    cols = [in_ref[c] for c in range(n_cols)]
+    words = [jnp.zeros((_SUBLANES, _LANES), jnp.uint32) for _ in range(n_words)]
+    for g in range(n_cols * n_bits):
+        c = g % n_cols
+        s = n_bits - 1 - g // n_cols
+        w, wb = divmod(g, 32)
+        bit = (cols[c] >> jnp.uint32(s)) & jnp.uint32(1)
+        words[w] = words[w] | (bit << jnp.uint32(31 - wb))
+    for w in range(n_words):
+        out_ref[w] = words[w]
+
+
+@functools.partial(jax.jit, static_argnames=("n_bits",))
+def interleave_bits_tiled(cols: jnp.ndarray, n_bits: int = 32) -> jnp.ndarray:
+    """cols: [k, n] uint32 (n a multiple of 1024) -> [w, n] uint32."""
+    k, n = cols.shape
+    n_words = max(1, -(-(k * n_bits) // 32))
+    assert n % _TILE == 0, n
+    tiles = n // _TILE
+    shaped = cols.reshape(k, tiles * _SUBLANES, _LANES)
+    kernel = functools.partial(_interleave_kernel, k, n_bits, n_words)
+    out = pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec((k, _SUBLANES, _LANES), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((n_words, _SUBLANES, _LANES), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_words, tiles * _SUBLANES, _LANES), jnp.uint32),
+        interpret=_use_interpret(),
+    )(shaped)
+    return out.reshape(n_words, n)
+
+
+def interleave_bits_auto(cols, n_bits: int = 32):
+    """Pallas when available/beneficial, jnp fallback otherwise."""
+    from delta_tpu.ops.zorder import interleave_bits
+
+    stacked = jnp.stack(list(cols))
+    k, n = stacked.shape
+    if not HAVE_PALLAS or n % _TILE != 0:
+        return interleave_bits(list(cols), n_bits=n_bits)
+    return interleave_bits_tiled(stacked, n_bits=n_bits)
+
+
+# ---------------------------------------------------------------------------
+# segmented min/max/count (stats collection)
+# ---------------------------------------------------------------------------
+
+
+def _minmax_kernel(in_ref, mask_ref, min_ref, max_ref, cnt_ref):
+    """in/mask: [8, R]; outputs: [8, 128] (stats broadcast into lane 0)."""
+    x = in_ref[:]
+    valid = mask_ref[:]
+    big = jnp.float32(jnp.inf)
+    mn = jnp.min(jnp.where(valid, x, big), axis=1, keepdims=True)
+    mx = jnp.max(jnp.where(valid, x, -big), axis=1, keepdims=True)
+    cnt = jnp.sum(valid.astype(jnp.float32), axis=1, keepdims=True)
+    min_ref[:] = jnp.broadcast_to(mn, (_SUBLANES, _LANES))
+    max_ref[:] = jnp.broadcast_to(mx, (_SUBLANES, _LANES))
+    cnt_ref[:] = jnp.broadcast_to(cnt, (_SUBLANES, _LANES))
+
+
+@jax.jit
+def segmented_minmax(values: jnp.ndarray, valid: jnp.ndarray):
+    """values/valid: [F, R] float32/bool, F a multiple of 8, R of 128.
+    Returns (min[F], max[F], valid_count[F]) — min/max over valid entries
+    (±inf when a file has no valid rows)."""
+    f, r = values.shape
+    assert f % _SUBLANES == 0 and r % _LANES == 0, (f, r)
+    grid = (f // _SUBLANES,)
+    spec_in = pl.BlockSpec((_SUBLANES, r), lambda i: (i, 0))
+    spec_out = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))
+    mn, mx, cnt = pl.pallas_call(
+        _minmax_kernel,
+        grid=grid,
+        in_specs=[spec_in, spec_in],
+        out_specs=(spec_out, spec_out, spec_out),
+        out_shape=(
+            jax.ShapeDtypeStruct((f, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((f, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((f, _LANES), jnp.float32),
+        ),
+        interpret=_use_interpret(),
+    )(values.astype(jnp.float32), valid)
+    return mn[:, 0], mx[:, 0], cnt[:, 0].astype(jnp.int32)
+
+
+def batched_file_stats(values: np.ndarray, valid: np.ndarray):
+    """Host wrapper: pad [F, R] to tile multiples, run the kernel, return
+    numpy (min, max, null_count, num_records) per file."""
+    f, r = values.shape
+    fpad = (-f) % _SUBLANES
+    rpad = (-r) % _LANES
+    v = np.pad(values.astype(np.float32), ((0, fpad), (0, rpad)))
+    m = np.pad(valid.astype(bool), ((0, fpad), (0, rpad)))
+    mn, mx, cnt = segmented_minmax(jnp.asarray(v), jnp.asarray(m))
+    mn = np.asarray(mn)[:f]
+    mx = np.asarray(mx)[:f]
+    cnt = np.asarray(cnt)[:f]
+    num_records = np.full(f, r, dtype=np.int64)
+    null_count = num_records - cnt
+    return mn, mx, null_count, num_records

@@ -144,7 +144,9 @@ def zorder_sort_indices(cols: Sequence[np.ndarray], curve: str = "zorder") -> np
         keys = hilbert_key(scaled, n_bits=n_bits)
     else:
         scaled = [_scale_ranks(r, n, 32) for r in ranks]
-        keys = interleave_bits(scaled, n_bits=32)
+        from delta_tpu.ops.pallas_kernels import interleave_bits_auto
+
+        keys = interleave_bits_auto(scaled, n_bits=32)
     return np.asarray(curve_order(keys))
 
 

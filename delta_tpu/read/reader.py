@@ -93,9 +93,21 @@ def read_scan(scan) -> pa.Table:
             tbl = tbl.rename_columns([p2l.get(c, c) for c in tbl.column_names])
         if schema is not None:
             # align to the logical schema: dropped columns disappear,
-            # columns added after this file was written read as null
-            known = [f.name for f in schema.fields if f.name not in partition_columns]
-            tbl = tbl.select([c for c in tbl.column_names if c in set(known)])
+            # columns added after this file was written read as null, and
+            # files written before a type-widening change cast up
+            known = {f.name: f for f in schema.fields if f.name not in partition_columns}
+            tbl = tbl.select([c for c in tbl.column_names if c in known])
+            for idx, c in enumerate(tbl.column_names):
+                target_t = to_arrow_type(known[c].dataType)
+                if tbl.schema.field(idx).type != target_t:
+                    try:
+                        tbl = tbl.set_column(
+                            idx,
+                            pa.field(c, target_t),
+                            tbl.column(c).cast(target_t),
+                        )
+                    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                        pass  # non-widening mismatch: surface as-is
             for f in schema.fields:
                 if f.name in partition_columns or f.name in tbl.column_names:
                     continue

@@ -1,0 +1,51 @@
+"""Pallas kernels vs their jnp/numpy references (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from delta_tpu.ops.pallas_kernels import (
+    HAVE_PALLAS,
+    batched_file_stats,
+    interleave_bits_auto,
+    interleave_bits_tiled,
+)
+from delta_tpu.ops.zorder import interleave_bits
+
+pytestmark = pytest.mark.skipif(not HAVE_PALLAS, reason="pallas unavailable")
+
+
+def test_interleave_tiled_matches_jnp():
+    rng = np.random.default_rng(0)
+    n = 2048
+    cols = [rng.integers(0, 2**32, n, dtype=np.uint32) for _ in range(3)]
+    ref = np.asarray(interleave_bits([jnp.asarray(c) for c in cols]))
+    got = np.asarray(interleave_bits_tiled(jnp.stack([jnp.asarray(c) for c in cols])))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_interleave_auto_fallback_on_ragged():
+    rng = np.random.default_rng(1)
+    n = 1000  # not a tile multiple -> fallback path
+    cols = [rng.integers(0, 2**32, n, dtype=np.uint32) for _ in range(2)]
+    ref = np.asarray(interleave_bits([jnp.asarray(c) for c in cols]))
+    got = np.asarray(interleave_bits_auto([jnp.asarray(c) for c in cols]))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_segmented_minmax():
+    rng = np.random.default_rng(2)
+    f, r = 10, 300
+    values = rng.normal(size=(f, r)).astype(np.float32)
+    valid = rng.random((f, r)) < 0.9
+    valid[3] = False  # one all-null file
+    mn, mx, null_count, num_records = batched_file_stats(values, valid)
+    for i in range(f):
+        sel = values[i][valid[i]]
+        if sel.size:
+            assert mn[i] == pytest.approx(sel.min())
+            assert mx[i] == pytest.approx(sel.max())
+        else:
+            assert np.isinf(mn[i])
+        assert null_count[i] == r - valid[i].sum()
+        assert num_records[i] == r
