@@ -1,0 +1,91 @@
+"""MERGE upserts, Z-order clustering, deletion vectors, UniForm export.
+
+Run: python examples/merge_clustering_uniform.py
+(Reference analogues: examples UniForm.scala / Clustering.scala, MERGE suites.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("DELTA_TPU_PLATFORM"):  # e.g. cpu, for accelerator-free runs
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["DELTA_TPU_PLATFORM"])
+
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+
+import delta_tpu.api as dta
+from delta_tpu import Table
+from delta_tpu.commands.dml import delete
+from delta_tpu.commands.merge import merge
+from delta_tpu.expressions import col, lit
+
+
+def main():
+    base = tempfile.mkdtemp()
+    path = f"{base}/orders"
+    rng = np.random.default_rng(0)
+    n = 10_000
+
+    dta.write_table(
+        path,
+        pa.table(
+            {
+                "order_id": pa.array(np.arange(n, dtype=np.int64)),
+                "user_id": pa.array(rng.integers(0, 500, n).astype(np.int64)),
+                "amount": pa.array(rng.gamma(2.0, 30.0, n)),
+            }
+        ),
+        properties={
+            "delta.enableDeletionVectors": "true",
+            "delta.universalFormat.enabledFormats": "iceberg,hudi",
+        },
+        target_rows_per_file=1000,
+    )
+    table = Table.for_path(path)
+
+    # MERGE: update half, insert new
+    src = pa.table(
+        {
+            "order_id": pa.array(
+                np.concatenate([rng.choice(n, 100, replace=False),
+                                np.arange(n, n + 50)]).astype(np.int64)
+            ),
+            "user_id": pa.array(rng.integers(0, 500, 150).astype(np.int64)),
+            "amount": pa.array(rng.gamma(2.0, 30.0, 150)),
+        }
+    )
+    m = (
+        merge(table, src, on=col("target.order_id") == col("source.order_id"))
+        .when_matched_update(set={"amount": col("source.amount")})
+        .when_not_matched_insert_all()
+        .execute()
+    )
+    print(f"merge: updated={m.num_target_rows_updated} inserted={m.num_target_rows_inserted}")
+
+    # deletion vectors: soft-delete without rewriting files
+    d = delete(Table.for_path(path), col("amount") < lit(5.0))
+    print(f"delete: {d.num_rows_deleted} rows via {d.num_dvs_written} deletion vectors")
+
+    # Z-order by (user_id, amount)
+    mz = Table.for_path(path).optimize().execute_zorder_by("user_id", "amount")
+    print(f"zorder: rewrote {mz.num_files_removed} -> {mz.num_files_added} files")
+    scan = Table.for_path(path).latest_snapshot().scan(
+        filter=(col("user_id") == lit(7))
+    )
+    scan.add_files_table()
+    print(f"scan for one user skips {scan.skipped_by_stats} files via stats")
+
+    # UniForm metadata landed alongside
+    print("iceberg metadata:", sorted(os.listdir(f"{path}/metadata"))[:3], "...")
+    print("hudi timeline:", sorted(os.listdir(f"{path}/.hoodie"))[:3])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Quickstart: create, append, time travel, overwrite, optimize, vacuum.
+
+Run: python examples/quickstart.py [workdir]
+(Reference analogue: examples/scala Quickstart / python quickstart.py.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("DELTA_TPU_PLATFORM"):  # e.g. cpu, for accelerator-free runs
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["DELTA_TPU_PLATFORM"])
+
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+
+import delta_tpu.api as dta
+from delta_tpu import Table
+from delta_tpu.expressions import col, lit
+from delta_tpu.sql import sql
+
+
+def main(workdir: str) -> None:
+    path = f"{workdir}/people"
+
+    data = pa.table(
+        {
+            "id": pa.array(np.arange(5, dtype=np.int64)),
+            "name": pa.array(["ada", "bob", "cyd", "dee", "eli"]),
+            "age": pa.array([35, 41, 29, 53, 61], pa.int64()),
+        }
+    )
+    v = dta.write_table(path, data)
+    print("created table at version", v)
+
+    more = pa.table(
+        {
+            "id": pa.array([5, 6], pa.int64()),
+            "name": pa.array(["fay", "gus"]),
+            "age": pa.array([22, 44], pa.int64()),
+        }
+    )
+    dta.write_table(path, more)
+
+    print("\nfull read:")
+    print(dta.read_table(path).sort_by("id").to_pandas())
+
+    print("\nfiltered (age > 40):")
+    print(dta.read_table(path, filter=col("age") > lit(40)).to_pandas())
+
+    print("\ntime travel to version 0:")
+    print(dta.read_table(path, version=0).sort_by("id").to_pandas())
+
+    table = Table.for_path(path)
+    print("\nhistory:")
+    for rec in table.history():
+        print(" ", rec.version, rec.commit_info.operation)
+
+    print("\nDESCRIBE DETAIL:")
+    for k, v in sql(f"DESCRIBE DETAIL '{path}'").items():
+        print(f"  {k}: {v}")
+
+    m = table.optimize().execute_compaction()
+    print(f"\noptimize: {m.num_files_removed} files -> {m.num_files_added}")
+    res = table.vacuum(retention_hours=0)
+    print("vacuum deleted", res.num_deleted, "files")
+    print("\nfinal count:", dta.read_table(path).num_rows)
+
+
+if __name__ == "__main__":
+    workdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp()
+    main(workdir)

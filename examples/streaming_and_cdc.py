@@ -1,0 +1,69 @@
+"""Streaming ingest + incremental reads + change data feed.
+
+Run: python examples/streaming_and_cdc.py
+(Reference analogue: examples Streaming.scala, CDC suites.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("DELTA_TPU_PLATFORM"):  # e.g. cpu, for accelerator-free runs
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["DELTA_TPU_PLATFORM"])
+
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+
+import delta_tpu.api as dta
+from delta_tpu import Table
+from delta_tpu.commands.dml import delete, update
+from delta_tpu.expressions import col, lit
+from delta_tpu.read.cdc import table_changes
+from delta_tpu.streaming import DeltaSink, DeltaSource, ReadLimits
+
+
+def main():
+    path = tempfile.mkdtemp() + "/events"
+
+    # exactly-once sink: re-delivered batches are no-ops
+    sink = DeltaSink(path, query_id="ingest-job",)
+    for batch_id in range(5):
+        data = pa.table(
+            {"seq": pa.array(np.arange(batch_id * 10, (batch_id + 1) * 10, dtype=np.int64))}
+        )
+        v = sink.add_batch(batch_id, data)
+        print(f"batch {batch_id} -> version {v}")
+    print("replay of batch 3:", sink.add_batch(3, pa.table({"seq": pa.array([0], pa.int64())})))
+
+    # incremental source with rate limits
+    table = Table.for_path(path)
+    source = DeltaSource(table, starting_version=0)
+    total = 0
+    for offset, batch in source.micro_batches(limits=ReadLimits(max_files=2)):
+        total += batch.num_rows
+        print(f"micro-batch up to {offset.reservoir_version}:{offset.index} "
+              f"(+{batch.num_rows} rows)")
+    print("streamed rows:", total)
+
+    # change data feed
+    dta.write_table(path, pa.table({"seq": pa.array([999], pa.int64())}),
+                    properties=None)
+    from delta_tpu.commands.alter import set_properties
+
+    set_properties(table, {"delta.enableChangeDataFeed": "true"})
+    t2 = Table.for_path(path)
+    start = t2.latest_snapshot().version + 1
+    update(t2, {"seq": lit(-1)}, col("seq") == lit(999))
+    delete(Table.for_path(path), col("seq") == lit(0))
+    changes = table_changes(Table.for_path(path), start)
+    print("\nchange feed:")
+    print(changes.select(["seq", "_change_type", "_commit_version"]).to_pandas())
+
+
+if __name__ == "__main__":
+    main()
