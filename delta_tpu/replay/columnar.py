@@ -328,6 +328,82 @@ class _SmallActionTracker:
         self.commit_infos[v] = CommitInfo.from_dict(row)
 
 
+def parse_commit_files(
+    engine,
+    commit_infos: Sequence[Tuple[int, str, int]],
+    max_workers: int = 16,
+) -> tuple[Optional[pa.Table], np.ndarray, np.ndarray, int]:
+    """Parallel-read commit files into ONE preallocated buffer and parse
+    with a single Arrow read_json call.
+
+    commit_infos: (version, path, size-from-listing). Each file gets a
+    region of `size + 1` bytes, the last byte forced to "\\n" (blank
+    lines between files are ignored by the parser). Row→version mapping
+    comes from one vectorized pass: a row ends at every newline not
+    preceded by a newline; per-file counts by searchsorted over region
+    boundaries. Falls back to the sequential path when a listed size
+    disagrees with the bytes read.
+    """
+    if not commit_infos:
+        return None, np.empty(0, np.int64), np.empty(0, np.int32), 0
+    n = len(commit_infos)
+    sizes = np.array([max(0, int(s)) for _, _, s in commit_infos], dtype=np.int64)
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(sizes + 1, out=starts[1:])
+    total = int(starts[-1])
+    buf = bytearray(total)
+    mv = memoryview(buf)
+    mismatch: List[int] = []
+
+    def fill(i: int):
+        _, path, _ = commit_infos[i]
+        data = engine.fs.read_file(path)
+        if len(data) != sizes[i]:
+            mismatch.append(i)
+            return
+        off = starts[i]
+        mv[off:off + sizes[i]] = data
+        mv[off + sizes[i]] = 0x0A
+
+    import os as _os
+
+    workers = min(max_workers, (_os.cpu_count() or 1) * 4)
+    if n > 4 and (_os.cpu_count() or 1) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            list(ex.map(fill, range(n)))
+    else:
+        for i in range(n):
+            fill(i)
+    if mismatch:
+        blobs = [(v, engine.fs.read_file(p)) for v, p, _ in commit_infos]
+        return parse_commit_batch(blobs)
+
+    arr = np.frombuffer(buf, np.uint8)
+    nl = arr == 0x0A
+    prev = np.empty_like(nl)
+    prev[0] = True
+    prev[1:] = nl[:-1]
+    row_ends = np.nonzero(nl & ~prev)[0]
+    counts = np.diff(np.searchsorted(row_ends, starts))
+    version_arr = np.array([v for v, _, _ in commit_infos], dtype=np.int64)
+    versions = np.repeat(version_arr, counts)
+    orders = (
+        np.arange(versions.shape[0], dtype=np.int64)
+        - np.repeat(np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+    ).astype(np.int32)
+
+    table = pa_json.read_json(
+        pa.BufferReader(pa.py_buffer(buf)),
+        read_options=pa_json.ReadOptions(block_size=1 << 24),
+    )
+    if table.num_rows != versions.shape[0]:
+        blobs = [(v, engine.fs.read_file(p)) for v, p, _ in commit_infos]
+        return parse_commit_batch(blobs)
+    return table, versions, orders, total
+
+
 def parse_commit_batch(
     commit_blobs: Sequence[Tuple[int, bytes]],
 ) -> tuple[Optional[pa.Table], np.ndarray, np.ndarray, int]:
@@ -421,20 +497,17 @@ def columnarize_log_segment(
                 _consume_checkpoint_table(tbl)
         bytes_parsed += fstat.size
 
-    # --- compacted deltas + commits: one batched JSON parse ---
-    commit_blobs: List[Tuple[int, bytes]] = []
+    # --- compacted deltas + commits: parallel read, one JSON parse ---
+    from delta_tpu.utils import filenames as fn
+
+    commit_infos: List[Tuple[int, str, int]] = []
     for fstat in segment.compacted_deltas:
-        from delta_tpu.utils import filenames as fn
-
         _, hi = fn.compacted_delta_versions(fstat.path)
-        commit_blobs.append((hi, engine.fs.read_file(fstat.path)))
+        commit_infos.append((hi, fstat.path, fstat.size))
     for fstat in segment.deltas:
-        from delta_tpu.utils import filenames as fn
+        commit_infos.append((fn.delta_version(fstat.path), fstat.path, fstat.size))
 
-        v = fn.delta_version(fstat.path)
-        commit_blobs.append((v, engine.fs.read_file(fstat.path)))
-
-    tbl, versions, orders, nbytes = parse_commit_batch(commit_blobs)
+    tbl, versions, orders, nbytes = parse_commit_files(engine, commit_infos)
     bytes_parsed += nbytes
     if tbl is not None:
         tracker.scan_chunk(tbl, versions, orders)
@@ -460,6 +533,6 @@ def columnarize_log_segment(
         domain_metadata={k: t[2] for k, t in tracker.domains.items()},
         latest_commit_info=latest_ci,
         commit_infos=tracker.commit_infos,
-        num_commit_files=len(commit_blobs),
+        num_commit_files=len(commit_infos),
         bytes_parsed=bytes_parsed,
     )
