@@ -4,17 +4,21 @@ This is the TPU-native counterpart of the reference's distributed replay
 (`Snapshot.scala:481-511`): shuffle by path hash, per-partition
 reconcile. Here:
 
-1. HOST ROUTE — rows are binned by `path_key % n_shards` (the "shuffle";
-   a numpy argsort by shard id). Because the replay key determines its
-   shard, per-shard reconciliation is globally correct with zero
-   cross-device key exchange.
+1. HOST ROUTE — rows are binned by `key % n_shards` (the "shuffle"; a
+   stable numpy argsort by shard id, so each shard's rows stay in
+   chronological order and the in-shard row index is the chronological
+   rank). Because the replay key determines its shard, per-shard
+   reconciliation is globally correct with zero cross-device key
+   exchange.
 2. DEVICE — a [n_shards, bucket] batch is laid out with
    `NamedSharding(mesh, P('shard', None))`; under `shard_map` each device
-   runs the same sort + segmented last-wins reduce as the single-chip
-   kernel on its local rows, then contributes to global aggregates
-   (live-file count, total bytes) with `psum` over the ICI.
+   runs the same (key, chrono) sort + run-boundary last-wins reduce as
+   the single-chip kernel on its local rows, then contributes to global
+   aggregates (live-file count, total bytes) with `psum` over the ICI.
 3. HOST GATHER — per-shard masks come back and are scattered to the
-   original row order.
+   original row order. Padding rows never reach the output (their
+   scatter index is -1) and contribute zero to the aggregates (is_add
+   False, size 0), so no validity lane ships at all.
 
 Multi-host scale-out: the mesh spans hosts; each host routes only the
 rows it parsed (`jax.make_array_from_process_local_data`), the psum
@@ -25,7 +29,7 @@ needed, XLA owns the collectives.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Sequence
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +42,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from delta_tpu.ops.replay import _PAD_KEY, pad_bucket
+from delta_tpu.ops.replay import _PAD_KEY, chrono_ok, combine_key_lanes, pad_bucket
 from delta_tpu.parallel.mesh import REPLAY_AXIS, make_mesh
 
 
@@ -49,26 +53,24 @@ class ShardedReplayOut(NamedTuple):
     live_bytes: jax.Array  # [] float32, global
 
 
-def _shard_kernel(k0, k1, version, order, is_add, valid, size):
-    """Per-device replay over its local [1, M] shard block."""
-    k0, k1 = k0[0], k1[0]
-    version, order = version[0], order[0]
-    is_add, valid, size = is_add[0], valid[0], size[0]
-    m = k0.shape[0]
+def _shard_kernel(key, is_add, size):
+    """Per-device replay over its local [1, M] shard block. Rows arrive
+    in chronological order (stable routing), so the local iota is the
+    chronological tiebreaker."""
+    key, is_add, size = key[0], is_add[0], size[0]
+    m = key.shape[0]
     idx = jnp.arange(m, dtype=jnp.int32)
-    s_k0, s_k1, s_ver, s_ord, s_add, s_valid, s_idx = lax.sort(
-        (k0, k1, version, order, is_add, valid, idx), num_keys=4
+    s_key, s_idx, s_add, s_size = lax.sort(
+        (key, idx, is_add, size), num_keys=2, is_stable=False
     )
-    same_next = (s_k0[:-1] == s_k0[1:]) & (s_k1[:-1] == s_k1[1:])
-    is_last = jnp.concatenate([~same_next, jnp.ones((1,), bool)])
-    winner = is_last & s_valid
-    live_s = winner & s_add
-    tomb_s = winner & ~s_add
+    is_last = jnp.concatenate([s_key[:-1] != s_key[1:], jnp.ones((1,), bool)])
+    live_s = is_last & s_add
+    tomb_s = is_last & ~s_add
     live = jnp.zeros((m,), bool).at[s_idx].set(live_s)
     tomb = jnp.zeros((m,), bool).at[s_idx].set(tomb_s)
-    # global aggregates over the ICI
+    # global aggregates over the ICI (padding rows: add=False, size=0)
     local_live = jnp.sum(live_s.astype(jnp.int32))
-    local_bytes = jnp.sum(jnp.where(live, size, 0.0))
+    local_bytes = jnp.sum(jnp.where(live_s, s_size, 0.0))
     num_live = lax.psum(local_live, REPLAY_AXIS)
     live_bytes = lax.psum(local_bytes, REPLAY_AXIS)
     return live[None], tomb[None], num_live, live_bytes
@@ -80,7 +82,7 @@ def build_sharded_replay_fn(mesh: Mesh):
     fn = shard_map(
         _shard_kernel,
         mesh=mesh,
-        in_specs=(spec,) * 7,
+        in_specs=(spec, spec, spec),
         out_specs=(spec, spec, P(), P()),
     )
     return jax.jit(fn)
@@ -95,41 +97,52 @@ def route_to_shards(
     size: Optional[np.ndarray],
     n_shards: int,
 ):
-    """Host-side shuffle: returns ([S, M] operand arrays, scatter indexes)
-    where scatter_index[s, j] = original row (or -1 for padding)."""
+    """Host-side shuffle: returns ([S, M] operand arrays (key, is_add,
+    size), scatter indexes) where scatter_index[s, j] = original row (or
+    -1 for padding)."""
     n = len(path_key)
-    shard_of = (path_key % np.uint32(n_shards)).astype(np.int64)
+    # perm=None in the common chronological case avoids three O(n) copies
+    perm = None
+    if not chrono_ok(np.asarray(version), np.asarray(order)):
+        perm = np.lexsort((order, version)).astype(np.int64)
+    key = combine_key_lanes([path_key, dv_key])
+    if key is None:
+        # lanes too wide to combine: re-encode to dense uint32 codes via a
+        # 64-bit fold + np.unique (exact; a single routing batch never
+        # holds 2^32 distinct logical files). Dense codes also keep every
+        # real key below the 0xFFFFFFFF pad sentinel — the kernel relies
+        # on pads owning that key exclusively for aggregate correctness.
+        wide = path_key.astype(np.uint64) << np.uint64(32) | dv_key.astype(np.uint64)
+        _, key = np.unique(wide, return_inverse=True)
+        key = key.astype(np.uint32)
+    is_add = np.asarray(is_add, bool)
+    size_p = None if size is None else np.asarray(size)
+    if perm is not None:
+        key = key[perm]
+        is_add = is_add[perm]
+        size_p = None if size_p is None else size_p[perm]
+
+    shard_of = (key % np.uint32(n_shards)).astype(np.int64)
     sort_idx = np.argsort(shard_of, kind="stable")
     counts = np.bincount(shard_of, minlength=n_shards)
     m = pad_bucket(int(counts.max(initial=1)))
 
-    def mk(dtype, fill):
-        return np.full((n_shards, m), fill, dtype=dtype)
-
-    k0 = mk(np.uint32, _PAD_KEY)
-    k1 = mk(np.uint32, _PAD_KEY)
-    ver = mk(np.int32, -1)
-    ordr = mk(np.int32, -1)
-    add = mk(np.bool_, False)
-    valid = mk(np.bool_, False)
-    sz = mk(np.float32, 0.0)
-    scatter = mk(np.int32, -1)
+    k = np.full((n_shards, m), _PAD_KEY, dtype=np.uint32)
+    add = np.zeros((n_shards, m), dtype=np.bool_)
+    sz = np.zeros((n_shards, m), dtype=np.float32)
+    scatter = np.full((n_shards, m), -1, dtype=np.int32)
 
     starts = np.zeros(n_shards + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
-    pos_in_shard = np.arange(n) - starts[shard_of[sort_idx]]
     rows = shard_of[sort_idx]
-    cols = pos_in_shard
-    k0[rows, cols] = path_key[sort_idx]
-    k1[rows, cols] = dv_key[sort_idx]
-    ver[rows, cols] = version[sort_idx]
-    ordr[rows, cols] = order[sort_idx]
+    cols = np.arange(n) - starts[rows]
+    k[rows, cols] = key[sort_idx]
     add[rows, cols] = is_add[sort_idx]
-    valid[rows, cols] = True
-    if size is not None:
-        sz[rows, cols] = size[sort_idx].astype(np.float32)
-    scatter[rows, cols] = sort_idx.astype(np.int32)
-    return (k0, k1, ver, ordr, add, valid, sz), scatter
+    if size_p is not None:
+        sz[rows, cols] = size_p[sort_idx].astype(np.float32)
+    orig = sort_idx if perm is None else perm[sort_idx]
+    scatter[rows, cols] = orig.astype(np.int32)
+    return (k, add, sz), scatter
 
 
 def sharded_replay_select(
@@ -156,7 +169,7 @@ def sharded_replay_select(
     spec = NamedSharding(mesh, P(REPLAY_AXIS, None))
     device_ops = tuple(jax.device_put(o, spec) for o in operands)
     fn = _cached_fn(mesh)
-    live_sh, tomb_sh, num_live, live_bytes = fn(device_ops)
+    live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
     live_sh = np.asarray(live_sh)
     tomb_sh = np.asarray(tomb_sh)
     live = np.zeros(n, dtype=bool)
@@ -170,13 +183,7 @@ def sharded_replay_select(
 
 @functools.lru_cache(maxsize=8)
 def _sharded_fn_for(mesh_key):
-    mesh = mesh_key[0]
-    base = build_sharded_replay_fn(mesh)
-
-    def call(ops):
-        return base(*ops)
-
-    return call
+    return build_sharded_replay_fn(mesh_key[0])
 
 
 def _cached_fn(mesh: Mesh):

@@ -73,6 +73,45 @@ def test_empty():
     assert live.shape == (0,) and tomb.shape == (0,)
 
 
+def test_key_equal_to_pad_sentinel_survives():
+    # a real row whose key lane equals the 0xFFFFFFFF padding sentinel
+    # must not be swallowed by the padding run
+    pk = np.array([0xFFFFFFFF, 3], dtype=np.uint32)
+    ver = np.array([0, 1], dtype=np.int32)
+    order = np.zeros(2, dtype=np.int32)
+    is_add = np.array([True, True])
+    live, tomb = replay_select([pk], ver, order, is_add)
+    assert live.tolist() == [True, True]
+    assert not tomb.any()
+
+
+def test_unsigned_descending_versions():
+    # uint32 version columns must not wrap the chronology check
+    pk = np.array([5, 5], dtype=np.uint32)
+    ver = np.array([2, 1], dtype=np.uint32)
+    order = np.zeros(2, dtype=np.uint32)
+    is_add = np.array([True, False])  # remove is OLDER -> add wins
+    live, tomb = replay_select([pk], ver, order, is_add)
+    assert live.tolist() == [True, False]
+    assert not tomb.any()
+
+
+def test_out_of_order_rows_rank_path():
+    rng = np.random.default_rng(17)
+    n = 2000
+    pk = rng.integers(0, 300, n).astype(np.uint32)
+    dk = rng.integers(0, 3, n).astype(np.uint32)
+    ver = rng.integers(0, 80, n).astype(np.int32)  # NOT sorted
+    order = rng.integers(0, 50, n).astype(np.int32)
+    is_add = rng.random(n) < 0.6
+    live_d, tomb_d = replay_select([pk, dk], ver, order, is_add)
+    live_h, tomb_h = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, is_add
+    )
+    np.testing.assert_array_equal(live_d, live_h)
+    np.testing.assert_array_equal(tomb_d, tomb_h)
+
+
 def test_pad_bucket():
     assert pad_bucket(1) == 1024
     assert pad_bucket(1024) == 1024
