@@ -24,23 +24,39 @@ import numpy as np
 
 
 def synth_history(n_actions: int, seed: int = 0):
-    """Synthetic log history: ~85% adds over a large key space, 15%
-    removes of earlier keys, spread over n_actions/100 commits."""
+    """Synthetic log history shaped like a real `_delta_log` action
+    stream after the columnarizer's dictionary encoding:
+
+    - every `add` of a data file carries a writer-generated UUID file
+      name, so ~85% of rows introduce a brand-new path — and the
+      columnarizer (pd.factorize, first-appearance order) gives those
+      rows code `prev_max + 1`;
+    - ~15% of rows are removes (or DV re-adds) that reference a path
+      added earlier in the log, i.e. an existing smaller code;
+    - ~2% of rows carry a non-zero deletion-vector id lane;
+    - rows arrive chronologically, n_actions/100 commits.
+    """
     rng = np.random.default_rng(seed)
-    n_keys = max(2, int(n_actions * 0.7))
-    pk = rng.integers(0, n_keys, n_actions).astype(np.uint32)
+    is_new = rng.random(n_actions) < 0.85
+    is_new[0] = True
+    new_count = np.cumsum(is_new)
+    # removes/rewrites reference a uniformly random earlier-added path
+    back_ref = (rng.random(n_actions) * (new_count - 1)).astype(np.int64)
+    pk = np.where(is_new, new_count - 1, back_ref).astype(np.uint32)
+    is_add = is_new.copy()
+    # a small slice of the back-references are DV re-adds, not removes
+    readd = (~is_new) & (rng.random(n_actions) < 0.15)
+    is_add |= readd
     dk = np.zeros(n_actions, dtype=np.uint32)
     dv_rows = rng.random(n_actions) < 0.02
     dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
     n_commits = max(2, n_actions // 100)
     ver = np.sort(rng.integers(0, n_commits, n_actions)).astype(np.int32)
-    order = np.zeros(n_actions, np.int32)
     # order within version: positions of each row inside its commit
     change = np.nonzero(np.diff(ver))[0] + 1
     starts = np.concatenate([[0], change])
     lens = np.diff(np.concatenate([starts, [n_actions]]))
     order = (np.arange(n_actions) - np.repeat(starts, lens)).astype(np.int32)
-    is_add = rng.random(n_actions) < 0.85
     size = rng.integers(1 << 20, 1 << 28, n_actions).astype(np.int64)
     return pk, dk, ver, order, is_add, size
 

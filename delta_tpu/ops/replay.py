@@ -14,22 +14,36 @@ vectorizes. The TPU-native formulation used here:
    rows, then commits ascending, line order within a commit), so the row
    index *is* the chronological rank — no (version, order) columns need
    to ship to the device; a device-side iota is the sort tiebreaker.
-   (If a caller passes rows out of order, a single host `np.lexsort`
-   ranks them first.)
-2. Key lanes are dense dictionary codes; when their ranges fit, they are
-   combined host-side into ONE uint32 lane (`k0 * |k1| + k1`), and
-   `is_add` ships as packed bits — ~4.1 bytes/row over PCIe/ICI instead
-   of 17.
-3. `lax.sort` by (key, chrono) — 2 sort keys, 3 operands. After the sort
-   every logical file's history is a contiguous run in chronological
-   order; the run-boundary mask `key[i] != key[i+1]` marks the newest
-   action per key. No loops, no hash table.
-4. Scatter the winner mask back to input order, bit-pack the two output
-   masks on device (32× smaller D2H), unpack on host.
+   (If a caller passes rows out of order, the host permutes them into
+   chronological order first and un-permutes the masks after — the
+   kernel itself never sees a rank lane.)
+2. Key lanes are dense dictionary codes assigned by the columnarizer in
+   FIRST-APPEARANCE order (`pd.factorize`, replay/state.py). In a real
+   Delta log every `add` carries a fresh UUID file name, so most rows
+   introduce a brand-new code — which, under first-appearance coding, is
+   always `prev_max + 1`. The transfer exploits that: one `is_new` flag
+   bit per row, explicit byte-packed codes only for the minority of rows
+   that reference an existing file (removes, DV re-adds), and a sparse
+   (row, value) list for the rare non-zero DV lane. The device rebuilds
+   the exact code array with a cumsum + gather. Typical cost: ~1–2
+   bits/row over the host↔device link instead of 4 bytes. Streams that
+   aren't first-appearance-coded (verified host-side with two cheap
+   vector passes) fall back to shipping the combined code lane as the
+   minimum number of little-endian byte planes that hold its range.
+3. One `lax.sort` by (key, payload) where payload = `(chrono_rank << 1)
+   | is_add` — two operands total, both sort keys. After the sort every
+   logical file's history is a contiguous run in chronological order;
+   the run-boundary mask `key[i] != key[i+1]` marks the newest action
+   per key. No loops, no hash table.
+4. One scatter puts the per-run winner mask back in input order; the
+   winner bits ship home packed (32× smaller D2H) and the host — which
+   already holds `is_add` — splits winners into live (`winner & add`)
+   and tombstone (`winner & ~add`) with two packed-word ops. The device
+   never materializes the live/tomb masks separately.
 
-Padding rows (key = 0xFFFFFFFF) sort to the end; at most one padding row
-wins its run and its output position >= n is sliced off host-side, so no
-`valid` lane is needed at all.
+Padding rows (key = all-ones sentinel) sort to the end; a run that mixes
+real and padding rows is won by its last *valid* row via the
+`is_last | ~next_valid` mask, so no `valid` lane ships.
 
 Complexity O(n log n) versus the hash maps' O(n) — but as one fused XLA
 sort at HBM bandwidth versus pointer-chasing JVM maps, and it shards
@@ -51,54 +65,77 @@ _PAD_KEY = np.uint32(0xFFFFFFFF)
 _MIN_BUCKET = 1024
 
 
-def pad_bucket(n: int) -> int:
-    """Round up to the next power of two (min 1024) so jit caches a small
-    number of shapes across snapshot sizes."""
-    if n <= _MIN_BUCKET:
-        return _MIN_BUCKET
+def pad_bucket(n: int, min_bucket: int = _MIN_BUCKET) -> int:
+    """Round up to the next power of two (min `min_bucket`) so jit caches
+    a small number of shapes across snapshot sizes."""
+    if n <= min_bucket:
+        return min_bucket
     return 1 << (int(n - 1).bit_length())
-
-
-class ReplayResult(NamedTuple):
-    live: jax.Array        # packed uint32 words: bit i of word w = row 32w+i
-    tombstone: jax.Array
 
 
 def chrono_ok(version: np.ndarray, order: np.ndarray) -> bool:
     """True if rows are already in chronological (version, order) order,
-    in which case the row index is the chronological rank."""
+    in which case the row index is the chronological rank.
+
+    Uses elementwise comparisons rather than diffs so any integer dtype
+    (signed or unsigned, any width) is handled without overflow-prone
+    casts or copies."""
     if version.shape[0] <= 1:
         return True
-    # int64 first: unsigned inputs would wrap negative diffs to huge
-    # positives and misclassify a descending history as chronological
-    version = np.asarray(version, dtype=np.int64)
-    order = np.asarray(order, dtype=np.int64)
-    dv = np.diff(version)
-    if (dv < 0).any():
+    v0, v1 = version[:-1], version[1:]
+    if (v1 < v0).any():
         return False
-    same = dv == 0
+    same = v1 == v0
     if not same.any():
         return True
-    do = np.diff(order)
-    return not bool((same & (do < 0)).any())
+    return not bool((same & (order[1:] < order[:-1])).any())
 
 
 def combine_key_lanes(key_lanes: Sequence[np.ndarray]) -> Optional[np.ndarray]:
     """Mixed-radix combine of dense key-code lanes into one uint32 lane
-    (reserving 0xFFFFFFFF for padding). None if the ranges don't fit."""
-    lanes = [np.asarray(k, dtype=np.uint64) for k in key_lanes]
-    if len(lanes) == 1:
-        mx = int(lanes[0].max(initial=0))
-        return lanes[0].astype(np.uint32) if mx < 0xFFFFFFFF else None
+    (reserving 0xFFFFFFFF for padding). None if the ranges don't fit.
+
+    All arithmetic stays in uint32: every mixed-radix partial value is
+    bounded by the final radix product, which is checked (in Python ints)
+    to fit below the sentinel before any array math runs."""
+    lanes = [np.asarray(k) for k in key_lanes]
+    maxes = [int(lane.max(initial=0)) for lane in lanes]
     radix = 1
-    combined = np.zeros_like(lanes[0])
-    for lane in lanes:
-        mx = int(lane.max(initial=0))
+    for mx in maxes:
         radix *= mx + 1
-        if radix >= 0xFFFFFFFF:
+        if radix > 0xFFFFFFFF:  # need the sentinel free: values < 0xFFFFFFFF
             return None
-        combined = combined * np.uint64(mx + 1) + lane
-    return combined.astype(np.uint32)
+    if len(lanes) == 1:
+        return lanes[0].astype(np.uint32, copy=False)
+    combined = lanes[0].astype(np.uint32, copy=True)
+    for lane, mx in zip(lanes[1:], maxes[1:]):
+        combined *= np.uint32(mx + 1)
+        combined += lane.astype(np.uint32, copy=False)
+    return combined
+
+
+def key_byte_width(max_key: int) -> int:
+    """Bytes/row needed to ship keys so that the all-ones sentinel of that
+    width stays reserved for padding."""
+    for width in (1, 2, 3):
+        if max_key < (1 << (8 * width)) - 1:
+            return width
+    return 4
+
+
+def _pack_key_planes(key: np.ndarray, width: int, pad: int,
+                     pad_byte: int = 0xFF) -> tuple[np.ndarray, ...]:
+    """uint32[n] -> `width` separate contiguous uint8 planes (little-endian
+    byte j of each value), padded. Planar layout: interleaved (n, width)
+    u8 would force stride-`width` byte access on device, which TPUs hate."""
+    b = np.ascontiguousarray(key).view(np.uint8).reshape(-1, 4)
+    planes = []
+    for j in range(width):
+        plane = np.ascontiguousarray(b[:, j])
+        if pad:
+            plane = np.concatenate([plane, np.full(pad, pad_byte, np.uint8)])
+        planes.append(plane)
+    return tuple(planes)
 
 
 def _pack_bits(mask: np.ndarray) -> np.ndarray:
@@ -110,46 +147,169 @@ def _unpack_bits(words: np.ndarray, n: int) -> np.ndarray:
     return np.unpackbits(words.view(np.uint8), bitorder="little")[:n].astype(bool)
 
 
-@functools.partial(jax.jit, static_argnames=("n_lanes", "has_rank"))
-def _replay_packed(operands, n_lanes: int, has_rank: bool) -> ReplayResult:
-    """operands = (*key_lanes[uint32, n], rank[i32, n]?, n_real[i32],
-    add_words[u32, n/32]).
-
-    Sorts by (key..., chrono) where chrono is the explicit rank lane or a
-    device iota; marks per-run winners; scatters back; bit-packs masks.
-    Padding rows (idx >= n_real) sort after the real rows of any run they
-    share a key with (their rank/iota is larger), so the winner of a run
-    is its last *valid* row — this keeps a real row whose key happens to
-    equal the 0xFFFFFFFF pad sentinel from being swallowed by padding.
-    """
-    *front, n_real, add_words = operands
-    lanes = front[:n_lanes]
-    rank_ops = (front[n_lanes],) if has_rank else ()
-    n = lanes[0].shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
+def _unpack_bits_device(words: jax.Array) -> jax.Array:
+    """uint32[m/32] -> uint32[m] of 0/1 bits (little-endian bit order)."""
     bit_pos = jnp.arange(32, dtype=jnp.uint32)
-    is_add = ((add_words[:, None] >> bit_pos[None, :]) & jnp.uint32(1)).reshape(-1).astype(bool)
+    return ((words[:, None] >> bit_pos[None, :]) & jnp.uint32(1)).reshape(-1)
 
-    sorted_ = lax.sort((*lanes, *rank_ops, idx, is_add), num_keys=n_lanes + 1,
+
+def _decode_planes(planes) -> jax.Array:
+    """Little-endian uint8 planes -> uint32 values."""
+    key = planes[0].astype(jnp.uint32)
+    for j in range(1, len(planes)):
+        key = key | (planes[j].astype(jnp.uint32) << jnp.uint32(8 * j))
+    return key
+
+
+def _sort_winner_pack(lanes, n_real, is_add_bits) -> jax.Array:
+    """Shared tail of both kernels: sort by (key..., payload) where
+    payload = (iota << 1) | is_add — the iota is the chronological rank
+    (callers permute first if their rows aren't already chronological)
+    and the add bit rides along for free. Marks per-run winners in
+    sorted order, scatters the single winner mask back to input order,
+    and bit-packs it. Padding rows (idx >= n_real) sort after the real
+    rows of any run they share a key with (their iota is larger), so the
+    winner of a run is its last *valid* row — a real row whose key
+    happens to equal the all-ones pad sentinel is never swallowed by
+    padding."""
+    m = lanes[0].shape[0]
+    payload = (jnp.arange(m, dtype=jnp.uint32) << 1) | is_add_bits
+    sorted_ = lax.sort((*lanes, payload), num_keys=len(lanes) + 1,
                        is_stable=False)
-    s_lanes, s_idx, s_add = sorted_[:n_lanes], sorted_[-2], sorted_[-1]
+    s_lanes, s_payload = sorted_[:-1], sorted_[-1]
+    s_idx = (s_payload >> 1).astype(jnp.int32)
     s_valid = s_idx < n_real
 
-    same_as_next = jnp.ones((n - 1,), dtype=bool)
+    same_as_next = jnp.ones((m - 1,), dtype=bool)
     for k in s_lanes:
         same_as_next = same_as_next & (k[:-1] == k[1:])
     next_valid = jnp.concatenate([s_valid[1:], jnp.zeros((1,), dtype=bool)])
     is_last = jnp.concatenate([~same_as_next, jnp.ones((1,), dtype=bool)])
     winner = s_valid & (is_last | ~next_valid)
 
-    live = jnp.zeros((n,), dtype=bool).at[s_idx].set(winner & s_add)
-    tomb = jnp.zeros((n,), dtype=bool).at[s_idx].set(winner & ~s_add)
+    winner_orig = jnp.zeros((m,), dtype=bool).at[s_idx].set(winner)
+    bit_pos = jnp.arange(32, dtype=jnp.uint32)
     weights = jnp.uint32(1) << bit_pos
-    live_w = (live.reshape(-1, 32).astype(jnp.uint32) * weights).sum(
+    return (winner_orig.reshape(-1, 32).astype(jnp.uint32) * weights).sum(
         axis=1, dtype=jnp.uint32)
-    tomb_w = (tomb.reshape(-1, 32).astype(jnp.uint32) * weights).sum(
-        axis=1, dtype=jnp.uint32)
-    return ReplayResult(live_w, tomb_w)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _winner_kernel(operands, width: int) -> jax.Array:
+    """Full-key path. operands = (*key_planes[u8, m] | *key_lanes[u32, m],
+    n_real[i32], add_words[u32, m/32]) -> winner_words[u32, m/32]."""
+    *key_ops, n_real, add_words = operands
+    lanes = (_decode_planes(key_ops),) if width else tuple(key_ops)
+    return _sort_winner_pack(lanes, n_real, _unpack_bits_device(add_words))
+
+
+@functools.partial(jax.jit, static_argnames=("ref_width", "has_sub"))
+def _winner_kernel_fa(operands, ref_width: int, has_sub: bool) -> jax.Array:
+    """First-appearance delta-coded path.
+
+    operands = (flag_words[u32, m/32], *ref_planes[u8, R],
+    [sub_radix[u32], sub_idx[u32, D], sub_val[u32, D] when has_sub],
+    n_real[i32], add_words[u32, m/32]).
+
+    Rebuilds the primary code lane exactly: row i's code is
+    `cumsum(is_new)[i] - 1` when its flag bit is set (the i-th new code
+    under first-appearance coding), else the next explicit ref in order
+    (`refs[cumsum(~is_new)[i] - 1]`). The secondary lane (DV id) arrives
+    sparse as (row, value) pairs and is scattered into a dense lane; the
+    final sort key is `primary * sub_radix + sub`. sub_idx entries >= m
+    (padding) are dropped by the scatter. sub_radix rides as a scalar
+    operand, not a static arg, so DV growth never recompiles."""
+    flag_words, *rest = operands
+    ref_planes = rest[:ref_width]
+    rest = rest[ref_width:]
+    if has_sub:
+        sub_radix, sub_idx, sub_val, n_real, add_words = rest
+    else:
+        n_real, add_words = rest
+    m = flag_words.shape[0] * 32
+    is_new = _unpack_bits_device(flag_words)
+    new_rank = jnp.cumsum(is_new.astype(jnp.int32))        # inclusive
+    ref_rank = jnp.arange(1, m + 1, dtype=jnp.int32) - new_rank
+    refs = _decode_planes(ref_planes)
+    ref_gather = refs[jnp.clip(ref_rank - 1, 0, refs.shape[0] - 1)]
+    key = jnp.where(is_new == 1, (new_rank - 1).astype(jnp.uint32),
+                    ref_gather)
+    if has_sub:
+        sub = jnp.zeros((m,), jnp.uint32).at[sub_idx].set(
+            sub_val, mode="drop")
+        key = key * sub_radix + sub
+    iota = jnp.arange(m, dtype=jnp.int32)
+    key = jnp.where(iota < n_real, key, jnp.uint32(0xFFFFFFFF))
+    return _sort_winner_pack((key,), n_real, _unpack_bits_device(add_words))
+
+
+class _FAEncoding(NamedTuple):
+    """Host-side first-appearance delta encoding of the key lanes."""
+    flag_words: np.ndarray     # u32[m/32] is_new bits
+    ref_planes: tuple          # u8 planes of explicit codes, bucket-padded
+    sub_idx: np.ndarray        # u32[D] rows with non-zero sub lane
+    sub_val: np.ndarray        # u32[D]
+    sub_radix: int
+    nbytes: int
+
+
+def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAEncoding]:
+    """Delta-encode lane 0 against first-appearance coding; lanes[1:]
+    (tiny ranges, mostly zero — the DV id lane) go sparse. None when the
+    stream isn't first-appearance-coded or ranges don't fit."""
+    primary = np.asarray(lanes[0])
+    sub_radix = 1
+    sub = None
+    if len(lanes) > 1:
+        sub = combine_key_lanes(lanes[1:])
+        if sub is None:
+            return None
+        sub_radix = int(sub.max(initial=0)) + 1
+        if sub_radix == 1:
+            sub = None
+    p64 = primary.astype(np.int64, copy=False)
+    run_max = np.maximum.accumulate(p64)
+    prev_max = np.empty_like(run_max)
+    prev_max[0] = -1
+    prev_max[1:] = run_max[:-1]
+    is_new = p64 == prev_max + 1
+    n_new = int(is_new.sum())
+    # dense first-appearance check: the j-th new row must carry code j
+    if not np.array_equal(p64[is_new], np.arange(n_new, dtype=np.int64)):
+        return None
+    primary_max = int(run_max[-1]) if n else 0
+    if (primary_max + 1) * sub_radix >= 0xFFFFFFFF:
+        return None
+
+    refs = primary[~is_new].astype(np.uint32, copy=False)
+    r_pad = pad_bucket(len(refs), min_bucket=128)
+    ref_width = key_byte_width(int(refs.max(initial=0)))
+    ref_planes = _pack_key_planes(refs, ref_width, r_pad - len(refs),
+                                  pad_byte=0)
+    if sub is not None:
+        nz = np.nonzero(sub)[0]
+        d_pad = pad_bucket(len(nz), min_bucket=128)
+        sub_idx = np.concatenate(
+            [nz.astype(np.uint32),
+             np.full(d_pad - len(nz), 0xFFFFFFFF, np.uint32)])
+        sub_val = np.concatenate(
+            [sub[nz].astype(np.uint32), np.zeros(d_pad - len(nz), np.uint32)])
+    else:
+        sub_idx = np.empty(0, np.uint32)
+        sub_val = np.empty(0, np.uint32)
+
+    pad = m - n
+    flags = np.concatenate([is_new, np.zeros(pad, np.bool_)]) if pad else is_new
+    flag_words = _pack_bits(flags)
+    nbytes = (flag_words.nbytes + sum(p.nbytes for p in ref_planes)
+              + sub_idx.nbytes + sub_val.nbytes)
+    # fall back to plain byte-plane shipping when the delta encoding
+    # wouldn't actually be smaller (remove-heavy streams)
+    full_width = key_byte_width((primary_max + 1) * sub_radix - 1)
+    if nbytes >= m * full_width:
+        return None
+    return _FAEncoding(flag_words, ref_planes, sub_idx, sub_val,
+                       sub_radix, nbytes)
 
 
 def replay_select(
@@ -159,9 +319,11 @@ def replay_select(
     is_add: np.ndarray,
     device=None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Host-facing wrapper: ranks (if needed), combines key lanes, packs,
-    ships to device, runs the kernel, and returns (live_mask,
-    tombstone_mask) as numpy bool arrays of the original length.
+    """Host-facing wrapper: permutes to chronological order if needed,
+    delta- or byte-packs the key lanes (whichever ships fewer bytes),
+    runs the winner kernel on device, and splits winners into
+    (live_mask, tombstone_mask) numpy bool arrays of the original length
+    using the host-resident add bits.
 
     key_lanes: one or more uint32/int32 arrays jointly identifying the
     logical file (dictionary codes or hash lanes). version/order: the
@@ -173,35 +335,61 @@ def replay_select(
     if n == 0:
         z = np.zeros((0,), dtype=bool)
         return z, z
-    m = pad_bucket(n)
-    pad = m - n
 
-    def pad_with(arr, value, dtype):
-        arr = np.asarray(arr, dtype=dtype)
-        if pad == 0:
-            return arr
-        return np.concatenate([arr, np.full((pad,), value, dtype=dtype)])
-
-    combined = combine_key_lanes(key_lanes)
-    if combined is not None:
-        lanes = (pad_with(combined, _PAD_KEY, np.uint32),)
-    else:
-        lanes = tuple(pad_with(k, _PAD_KEY, np.uint32) for k in key_lanes)
-
-    rank_ops: tuple = ()
+    perm = None
     if not chrono_ok(np.asarray(version), np.asarray(order)):
         perm = np.lexsort((order, version))
-        rank = np.empty(n, dtype=np.int32)
-        rank[perm] = np.arange(n, dtype=np.int32)
-        rank_ops = (pad_with(rank, np.int32(0x7FFFFFFF), np.int32),)
+        key_lanes = [np.asarray(k)[perm] for k in key_lanes]
+        is_add = np.asarray(is_add)[perm]
 
-    add_words = _pack_bits(pad_with(is_add, False, np.bool_))
-    operands = (*lanes, *rank_ops, np.asarray(n, dtype=np.int32), add_words)
-    if device is not None:
-        operands = tuple(jax.device_put(o, device) for o in operands)
-    result = _replay_packed(operands, n_lanes=len(lanes), has_rank=bool(rank_ops))
-    live = _unpack_bits(np.asarray(result.live), n)
-    tomb = _unpack_bits(np.asarray(result.tombstone), n)
+    m = pad_bucket(n)
+    pad = m - n
+    is_add = np.asarray(is_add, dtype=np.bool_)
+    add_words_np = _pack_bits(
+        np.concatenate([is_add, np.zeros(pad, np.bool_)]) if pad else is_add)
+
+    lanes = [np.asarray(k) for k in key_lanes]
+    fa = _try_fa_encode(lanes, n, m)
+
+    n_op = np.asarray(n, dtype=np.int32)
+    if fa is not None:
+        has_sub = fa.sub_radix > 1
+        sub_ops = ((np.asarray(fa.sub_radix, np.uint32), fa.sub_idx,
+                    fa.sub_val) if has_sub else ())
+        operands = (fa.flag_words, *fa.ref_planes, *sub_ops,
+                    n_op, add_words_np)
+        if device is not None:
+            operands = tuple(jax.device_put(o, device) for o in operands)
+        winner_words = np.asarray(_winner_kernel_fa(
+            operands, ref_width=len(fa.ref_planes), has_sub=has_sub))
+    else:
+        combined = combine_key_lanes(lanes)
+        if combined is not None:
+            width = key_byte_width(int(combined.max(initial=0)))
+            key_ops = _pack_key_planes(combined, width, pad)
+        else:
+            width = 0
+            key_ops = tuple(
+                np.ascontiguousarray(np.concatenate(
+                    [np.asarray(k, np.uint32),
+                     np.full(pad, _PAD_KEY, np.uint32)])
+                    if pad else np.asarray(k, np.uint32))
+                for k in lanes)
+        operands = (*key_ops, n_op, add_words_np)
+        if device is not None:
+            operands = tuple(jax.device_put(o, device) for o in operands)
+        winner_words = np.asarray(_winner_kernel(operands, width=width))
+
+    live_words = winner_words & add_words_np
+    tomb_words = winner_words & ~add_words_np
+    live = _unpack_bits(live_words, n)
+    tomb = _unpack_bits(tomb_words, n)
+    if perm is not None:
+        inv_live = np.zeros(n, dtype=bool)
+        inv_tomb = np.zeros(n, dtype=bool)
+        inv_live[perm] = live
+        inv_tomb[perm] = tomb
+        live, tomb = inv_live, inv_tomb
     return live, tomb
 
 

@@ -117,3 +117,60 @@ def test_pad_bucket():
     assert pad_bucket(1024) == 1024
     assert pad_bucket(1025) == 2048
     assert pad_bucket(3_000_000) == 1 << 22
+
+def first_appearance_history(rng, n_actions, p_new=0.8, p_dv=0.05):
+    """Stream whose primary codes follow first-appearance dictionary
+    coding (the real columnarizer's output: pd.factorize order)."""
+    is_new = rng.random(n_actions) < p_new
+    is_new[0] = True
+    new_count = np.cumsum(is_new)
+    back_ref = (rng.random(n_actions) * (new_count - 1)).astype(np.int64)
+    pk = np.where(is_new, new_count - 1, back_ref).astype(np.uint32)
+    dk = np.zeros(n_actions, np.uint32)
+    dv_rows = rng.random(n_actions) < p_dv
+    dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
+    ver = np.sort(rng.integers(0, max(2, n_actions // 5), n_actions)).astype(np.int32)
+    order = np.zeros(n_actions, np.int32)
+    for v in np.unique(ver):
+        sel = ver == v
+        order[sel] = np.arange(sel.sum())
+    is_add = is_new | (rng.random(n_actions) < 0.3)
+    return pk, dk, ver, order, is_add
+
+
+@pytest.mark.parametrize("n_actions", [3, 64, 1023, 4096])
+def test_fa_encoded_path_matches_reference(n_actions):
+    """The first-appearance delta-transfer path must agree with the
+    sequential reference exactly."""
+    from delta_tpu.ops.replay import _try_fa_encode, pad_bucket as pb
+
+    rng = np.random.default_rng(n_actions + 1)
+    pk, dk, ver, order, is_add = first_appearance_history(rng, n_actions)
+    if n_actions >= 4096:
+        # at real sizes the encoder must engage on this stream (for tiny
+        # snapshots the min-bucket padding makes it fall back — fine)
+        assert _try_fa_encode([pk, dk], n_actions, pb(n_actions)) is not None
+    live_d, tomb_d = replay_select([pk, dk], ver, order, is_add)
+    live_h, tomb_h = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, is_add)
+    np.testing.assert_array_equal(live_d, live_h)
+    np.testing.assert_array_equal(tomb_d, tomb_h)
+
+
+def test_fa_encoder_rejects_non_dense_stream():
+    from delta_tpu.ops.replay import _try_fa_encode
+
+    # jump: row 0 introduces code 5 (not 0) -> not first-appearance-dense
+    pk = np.array([5, 6, 0], np.uint32)
+    assert _try_fa_encode([pk], 3, 1024) is None
+
+
+def test_fa_all_new_no_refs():
+    # pure-append log: every row introduces a new code, no refs ship
+    n = 200
+    pk = np.arange(n, dtype=np.uint32)
+    ver = np.arange(n, dtype=np.int32)
+    order = np.zeros(n, np.int32)
+    is_add = np.ones(n, bool)
+    live, tomb = replay_select([pk], ver, order, is_add)
+    assert live.all() and not tomb.any()
