@@ -1,0 +1,772 @@
+// action_scan: specialized multithreaded NDJSON scanner for Delta log
+// commit files.
+//
+// The reference leans on Jackson for this (DefaultJsonHandler,
+// kernel-defaults/.../DefaultJsonHandler.java; spark pays it as a JSON
+// scan at Snapshot.scala:524). A generic JSON reader must infer a
+// unified schema and materialize every field; this scanner knows the
+// action schema (PROTOCOL.md:418-822) and emits exactly the columnar
+// buffers the canonical file-actions table needs: add/remove rows fully
+// decoded into arenas + offsets + validity, everything else (protocol,
+// metaData, txn, domainMetadata, commitInfo — O(commits), not O(files))
+// returned as byte spans for the host to json.loads.
+//
+// Contract with the Python side (delta_tpu/native/__init__.py):
+// - das_scan(buf, len, n_threads) -> opaque handle (never NULL)
+// - das_error(h): 0 ok; 1 = structural parse failure, caller must fall
+//   back to the generic parser (no partial results are exposed)
+// - das_n(h, i) / das_ptr(h, i): counts and column pointers by the
+//   DasField enum below — indices are mirrored in the Python binding.
+// - all string columns are (int32 end-offsets per row, one byte arena,
+//   uint8 validity); map columns add per-entry offsets. Offsets are
+//   Arrow-style: offsets[0] == 0 stored implicitly; the exposed array
+//   holds n+1 entries including the leading 0.
+//
+// Unescaping: full JSON string unescape including \uXXXX surrogate
+// pairs -> UTF-8. Raw-capture fields (tags) keep the original JSON
+// text, which is itself valid JSON.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- builders
+
+struct StrCol {
+  std::string arena;
+  std::vector<int32_t> ends;   // running end offset per row (local)
+  std::vector<uint8_t> valid;
+  void add_null() { ends.push_back((int32_t)arena.size()); valid.push_back(0); }
+  void add(const char* s, size_t n) {
+    arena.append(s, n);
+    ends.push_back((int32_t)arena.size());
+    valid.push_back(1);
+  }
+  void add(const std::string& s) { add(s.data(), s.size()); }
+};
+
+template <typename T>
+struct NumCol {
+  std::vector<T> vals;
+  std::vector<uint8_t> valid;
+  void add_null() { vals.push_back(0); valid.push_back(0); }
+  void add(T v) { vals.push_back(v); valid.push_back(1); }
+};
+
+struct Builder {
+  std::vector<int64_t> line_no;      // global row number of each file action
+  std::vector<uint8_t> is_add;
+  StrCol path;
+  // partitionValues: per-row entry count; per-entry key/value
+  std::vector<int32_t> pv_nentries;
+  std::vector<uint8_t> pv_valid;     // row-level presence of the object
+  StrCol pv_key;                     // validity unused (keys non-null)
+  StrCol pv_val;
+  NumCol<int64_t> size;
+  NumCol<int64_t> mod_time;
+  NumCol<uint8_t> data_change;
+  StrCol stats;
+  StrCol tags;                       // raw JSON text of the tags object
+  std::vector<uint8_t> dv_valid;
+  StrCol dv_storage;
+  StrCol dv_pathinline;
+  NumCol<int32_t> dv_offset;
+  NumCol<int32_t> dv_size;
+  NumCol<int64_t> dv_card;
+  NumCol<int64_t> dv_maxrow;
+  NumCol<int64_t> base_row_id;
+  NumCol<int64_t> drcv;
+  StrCol clustering;
+  NumCol<int64_t> del_ts;
+  NumCol<uint8_t> ext_meta;
+  // non-file-action lines: (global row number, byte start, byte end)
+  std::vector<int64_t> other_line_no;
+  std::vector<int64_t> other_start;
+  std::vector<int64_t> other_end;
+  // byte start of every non-blank line, in order (global row numbering)
+  std::vector<int64_t> line_starts;
+  bool failed = false;
+};
+
+// ---------------------------------------------------------------- lexing
+
+struct Cursor {
+  const char* p;
+  const char* end;
+  bool ok = true;
+  void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p; }
+  bool lit(char c) { ws(); if (p < end && *p == c) { ++p; return true; } return false; }
+  char peek() { ws(); return p < end ? *p : '\0'; }
+};
+
+void append_utf8(std::string& out, uint32_t cp) {
+  if (cp < 0x80) {
+    out.push_back((char)cp);
+  } else if (cp < 0x800) {
+    out.push_back((char)(0xC0 | (cp >> 6)));
+    out.push_back((char)(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back((char)(0xE0 | (cp >> 12)));
+    out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back((char)(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back((char)(0xF0 | (cp >> 18)));
+    out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back((char)(0x80 | (cp & 0x3F)));
+  }
+}
+
+int hex4(const char* p) {
+  int v = 0;
+  for (int i = 0; i < 4; i++) {
+    char c = p[i];
+    v <<= 4;
+    if (c >= '0' && c <= '9') v |= c - '0';
+    else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+    else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+    else return -1;
+  }
+  return v;
+}
+
+// Parse a JSON string (cursor at opening quote). out receives the
+// unescaped bytes. Returns false on malformed input.
+bool parse_string(Cursor& c, std::string& out) {
+  out.clear();
+  if (!c.lit('"')) return false;
+  const char* p = c.p;
+  const char* end = c.end;
+  // fast path: no escapes
+  const char* q = p;
+  while (q < end && *q != '"' && *q != '\\') ++q;
+  if (q < end && *q == '"') {
+    out.assign(p, q - p);
+    c.p = q + 1;
+    return true;
+  }
+  out.assign(p, q - p);
+  p = q;
+  while (p < end) {
+    char ch = *p;
+    if (ch == '"') { c.p = p + 1; return true; }
+    if (ch != '\\') { out.push_back(ch); ++p; continue; }
+    if (p + 1 >= end) return false;
+    char e = p[1];
+    p += 2;
+    switch (e) {
+      case '"': out.push_back('"'); break;
+      case '\\': out.push_back('\\'); break;
+      case '/': out.push_back('/'); break;
+      case 'b': out.push_back('\b'); break;
+      case 'f': out.push_back('\f'); break;
+      case 'n': out.push_back('\n'); break;
+      case 'r': out.push_back('\r'); break;
+      case 't': out.push_back('\t'); break;
+      case 'u': {
+        if (p + 4 > end) return false;
+        int v = hex4(p);
+        if (v < 0) return false;
+        p += 4;
+        uint32_t cp = (uint32_t)v;
+        if (cp >= 0xD800 && cp <= 0xDBFF && p + 6 <= end && p[0] == '\\' &&
+            p[1] == 'u') {
+          int lo = hex4(p + 2);
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + ((uint32_t)lo - 0xDC00);
+            p += 6;
+          }
+        }
+        append_utf8(out, cp);
+        break;
+      }
+      default: return false;
+    }
+  }
+  return false;
+}
+
+bool skip_string(Cursor& c) {
+  if (!c.lit('"')) return false;
+  const char* p = c.p;
+  while (p < c.end) {
+    if (*p == '\\') { p += 2; continue; }
+    if (*p == '"') { c.p = p + 1; return true; }
+    ++p;
+  }
+  return false;
+}
+
+// Skip any JSON value (cursor at its first char). String-aware.
+bool skip_value(Cursor& c) {
+  char ch = c.peek();
+  if (ch == '"') return skip_string(c);
+  if (ch == '{' || ch == '[') {
+    char open = ch, close = (ch == '{') ? '}' : ']';
+    c.lit(open);
+    int depth = 1;
+    const char* p = c.p;
+    while (p < c.end && depth) {
+      char d = *p;
+      if (d == '"') {
+        ++p;
+        while (p < c.end) {
+          if (*p == '\\') { p += 2; continue; }
+          if (*p == '"') { ++p; break; }
+          ++p;
+        }
+        continue;
+      }
+      if (d == open) ++depth;
+      else if (d == close) --depth;
+      ++p;
+    }
+    c.p = p;
+    return depth == 0;
+  }
+  // literal / number: consume until a delimiter
+  const char* p = c.p;
+  while (p < c.end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+         *p != '\t' && *p != '\r' && *p != '\n')
+    ++p;
+  bool any = p != c.p;
+  c.p = p;
+  return any;
+}
+
+// Capture the raw text of the next value (objects only in practice).
+bool capture_raw(Cursor& c, const char** start, const char** stop) {
+  c.ws();
+  *start = c.p;
+  if (!skip_value(c)) return false;
+  *stop = c.p;
+  return true;
+}
+
+enum NumKind { NUM_NULL, NUM_INT, NUM_BOOL_TRUE, NUM_BOOL_FALSE, NUM_BAD };
+
+// Integers (JSON numbers without fraction/exponent are the norm for the
+// action schema; fractional/exponent forms are truncated via strtod).
+NumKind parse_num_or_lit(Cursor& c, int64_t* out) {
+  char ch = c.peek();
+  if (ch == 'n') { c.p += 4 <= c.end - c.p ? 4 : 0; return NUM_NULL; }
+  if (ch == 't') { c.p += 4 <= c.end - c.p ? 4 : 0; return NUM_BOOL_TRUE; }
+  if (ch == 'f') { c.p += 5 <= c.end - c.p ? 5 : 0; return NUM_BOOL_FALSE; }
+  const char* p = c.p;
+  bool neg = false;
+  if (p < c.end && (*p == '-' || *p == '+')) { neg = *p == '-'; ++p; }
+  int64_t v = 0;
+  const char* digits = p;
+  while (p < c.end && *p >= '0' && *p <= '9') { v = v * 10 + (*p - '0'); ++p; }
+  if (p == digits) return NUM_BAD;
+  if (p < c.end && (*p == '.' || *p == 'e' || *p == 'E')) {
+    char* endp = nullptr;
+    double d = strtod(c.p, &endp);
+    if (endp == c.p) return NUM_BAD;
+    c.p = endp;
+    *out = (int64_t)d;
+    return NUM_INT;
+  }
+  c.p = p;
+  *out = neg ? -v : v;
+  return NUM_INT;
+}
+
+bool key_is(const std::string& k, const char* name) { return k == name; }
+
+// ------------------------------------------------------------- action parse
+
+// deletionVector object
+bool parse_dv(Cursor& c, Builder& b) {
+  if (!c.lit('{')) return false;
+  b.dv_valid.push_back(1);
+  bool s_storage = false, s_path = false, s_off = false, s_size = false,
+       s_card = false, s_max = false;
+  std::string key, sval;
+  if (c.peek() == '}') { c.lit('}'); }
+  else {
+    while (true) {
+      if (!parse_string(c, key)) return false;
+      if (!c.lit(':')) return false;
+      int64_t num;
+      // duplicate keys (legal JSON) would misalign the column builders:
+      // fail the scan so the caller uses the generic parser
+      if (key_is(key, "storageType")) {
+        if (s_storage) return false;
+        if (c.peek() == '"') { if (!parse_string(c, sval)) return false; b.dv_storage.add(sval); s_storage = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "pathOrInlineDv")) {
+        if (s_path) return false;
+        if (c.peek() == '"') { if (!parse_string(c, sval)) return false; b.dv_pathinline.add(sval); s_path = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "offset")) {
+        if (s_off) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.dv_offset.add((int32_t)num); s_off = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "sizeInBytes")) {
+        if (s_size) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.dv_size.add((int32_t)num); s_size = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "cardinality")) {
+        if (s_card) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.dv_card.add(num); s_card = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "maxRowIndex")) {
+        if (s_max) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.dv_maxrow.add(num); s_max = true; }
+        else if (k != NUM_NULL) return false;
+      } else {
+        if (!skip_value(c)) return false;
+      }
+      if (c.lit(',')) continue;
+      if (c.lit('}')) break;
+      return false;
+    }
+  }
+  if (!s_storage) b.dv_storage.add_null();
+  if (!s_path) b.dv_pathinline.add_null();
+  if (!s_off) b.dv_offset.add_null();
+  if (!s_size) b.dv_size.add_null();
+  if (!s_card) b.dv_card.add_null();
+  if (!s_max) b.dv_maxrow.add_null();
+  return true;
+}
+
+// partitionValues object -> per-entry key/value
+bool parse_pv(Cursor& c, Builder& b) {
+  if (!c.lit('{')) return false;
+  b.pv_valid.push_back(1);
+  int32_t n = 0;
+  std::string key, sval;
+  if (c.peek() == '}') { c.lit('}'); b.pv_nentries.push_back(0); return true; }
+  while (true) {
+    if (!parse_string(c, key)) return false;
+    if (!c.lit(':')) return false;
+    b.pv_key.add(key);
+    char ch = c.peek();
+    if (ch == '"') {
+      if (!parse_string(c, sval)) return false;
+      b.pv_val.add(sval);
+    } else if (ch == 'n') {
+      c.p += 4;
+      b.pv_val.add_null();
+    } else {
+      // non-conforming scalar (number/bool): keep raw text as the value
+      const char* s; const char* e;
+      if (!capture_raw(c, &s, &e)) return false;
+      b.pv_val.add(s, e - s);
+    }
+    ++n;
+    if (c.lit(',')) continue;
+    if (c.lit('}')) break;
+    return false;
+  }
+  b.pv_nentries.push_back(n);
+  return true;
+}
+
+// The add/remove object body (cursor after '{' of the action value).
+bool parse_file_action(Cursor& c, Builder& b, bool is_add, int64_t row_no) {
+  if (!c.lit('{')) return false;
+  bool s_path = false, s_pv = false, s_size = false, s_mt = false,
+       s_dc = false, s_stats = false, s_tags = false, s_dv = false,
+       s_brid = false, s_drcv = false, s_clust = false, s_dts = false,
+       s_ext = false;
+  std::string key, sval;
+  if (c.peek() == '}') c.lit('}');
+  else {
+    while (true) {
+      if (!parse_string(c, key)) return false;
+      if (!c.lit(':')) return false;
+      int64_t num;
+      if (key_is(key, "path")) {
+        if (s_path) return false;
+        if (c.peek() == '"') { if (!parse_string(c, sval)) return false; b.path.add(sval); s_path = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "partitionValues")) {
+        if (s_pv) return false;
+        if (c.peek() == '{') { if (!parse_pv(c, b)) return false; s_pv = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "size")) {
+        if (s_size) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.size.add(num); s_size = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "modificationTime")) {
+        if (s_mt) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.mod_time.add(num); s_mt = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "dataChange")) {
+        if (s_dc) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_BOOL_TRUE) { b.data_change.add(1); s_dc = true; }
+        else if (k == NUM_BOOL_FALSE) { b.data_change.add(0); s_dc = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "stats")) {
+        if (s_stats) return false;
+        if (c.peek() == '"') { if (!parse_string(c, sval)) return false; b.stats.add(sval); s_stats = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "tags")) {
+        if (s_tags) return false;
+        if (c.peek() == '{') {
+          const char* s; const char* e;
+          if (!capture_raw(c, &s, &e)) return false;
+          b.tags.add(s, e - s);
+          s_tags = true;
+        } else if (!skip_value(c)) return false;
+      } else if (key_is(key, "deletionVector")) {
+        if (s_dv) return false;
+        if (c.peek() == '{') { if (!parse_dv(c, b)) return false; s_dv = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "baseRowId")) {
+        if (s_brid) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.base_row_id.add(num); s_brid = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "defaultRowCommitVersion")) {
+        if (s_drcv) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.drcv.add(num); s_drcv = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "clusteringProvider")) {
+        if (s_clust) return false;
+        if (c.peek() == '"') { if (!parse_string(c, sval)) return false; b.clustering.add(sval); s_clust = true; }
+        else if (!skip_value(c)) return false;
+      } else if (key_is(key, "deletionTimestamp")) {
+        if (s_dts) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_INT) { b.del_ts.add(num); s_dts = true; }
+        else if (k != NUM_NULL) return false;
+      } else if (key_is(key, "extendedFileMetadata")) {
+        if (s_ext) return false;
+        NumKind k = parse_num_or_lit(c, &num);
+        if (k == NUM_BOOL_TRUE) { b.ext_meta.add(1); s_ext = true; }
+        else if (k == NUM_BOOL_FALSE) { b.ext_meta.add(0); s_ext = true; }
+        else if (k != NUM_NULL) return false;
+      } else {
+        if (!skip_value(c)) return false;
+      }
+      if (c.lit(',')) continue;
+      if (c.lit('}')) break;
+      return false;
+    }
+  }
+  b.line_no.push_back(row_no);
+  b.is_add.push_back(is_add ? 1 : 0);
+  if (!s_path) b.path.add_null();
+  if (!s_pv) { b.pv_valid.push_back(0); b.pv_nentries.push_back(0); }
+  if (!s_size) b.size.add_null();
+  if (!s_mt) b.mod_time.add_null();
+  if (!s_dc) b.data_change.add_null();
+  if (!s_stats) b.stats.add_null();
+  if (!s_tags) b.tags.add_null();
+  if (!s_dv) {
+    b.dv_valid.push_back(0);
+    b.dv_storage.add_null(); b.dv_pathinline.add_null();
+    b.dv_offset.add_null(); b.dv_size.add_null();
+    b.dv_card.add_null(); b.dv_maxrow.add_null();
+  }
+  if (!s_brid) b.base_row_id.add_null();
+  if (!s_drcv) b.drcv.add_null();
+  if (!s_clust) b.clustering.add_null();
+  if (!s_dts) b.del_ts.add_null();
+  if (!s_ext) b.ext_meta.add_null();
+  return true;
+}
+
+// One line (one action object). row_no is the line's global row number.
+bool parse_line(const char* start, const char* stop, int64_t row_no,
+                int64_t base_off, Builder& b) {
+  Cursor c{start, stop};
+  if (!c.lit('{')) return false;
+  std::string key;
+  if (!parse_string(c, key)) return false;
+  if (!c.lit(':')) return false;
+  bool is_add = key_is(key, "add");
+  bool is_rm = key_is(key, "remove");
+  if ((is_add || is_rm) && c.peek() == '{') {
+    if (!parse_file_action(c, b, is_add, row_no)) return false;
+    // single-key objects are the norm; tolerate (skip) extra keys
+    while (c.lit(',')) {
+      if (!parse_string(c, key)) return false;
+      if (!c.lit(':')) return false;
+      if (!skip_value(c)) return false;
+    }
+    return c.lit('}');
+  }
+  // everything else: hand the whole line to the host
+  b.other_line_no.push_back(row_no);
+  b.other_start.push_back(base_off + (start - start));
+  b.other_end.push_back(base_off + (stop - start));
+  return true;
+}
+
+// ------------------------------------------------------------- result/ABI
+
+struct FinalStr {
+  std::string arena;
+  std::vector<int32_t> offsets;  // n+1, leading 0
+  std::vector<uint8_t> valid;
+};
+
+template <typename T>
+struct FinalNum {
+  std::vector<T> vals;
+  std::vector<uint8_t> valid;
+};
+
+struct Result {
+  int32_t error = 0;
+  int64_t n_rows = 0, n_lines = 0, n_others = 0, n_pv_entries = 0;
+  std::vector<int64_t> line_no;
+  std::vector<uint8_t> is_add;
+  FinalStr path, pv_key, pv_val, stats, tags, dv_storage, dv_pathinline,
+      clustering;
+  std::vector<int32_t> pv_offsets;  // n+1 entry offsets per row
+  std::vector<uint8_t> pv_valid;
+  FinalNum<int64_t> size, mod_time, dv_card, dv_maxrow, base_row_id, drcv,
+      del_ts;
+  FinalNum<int32_t> dv_offset, dv_size;
+  FinalNum<uint8_t> data_change, ext_meta;
+  std::vector<uint8_t> dv_valid;
+  std::vector<int64_t> other_line_no, other_start, other_end;
+  std::vector<int64_t> line_starts;
+};
+
+// false when the merged arena would overflow int32 offsets (the caller
+// flags the scan as failed and the host falls back to the generic parser)
+bool merge_str(FinalStr& out, std::vector<Builder>& bs, StrCol Builder::* m) {
+  size_t rows = 0, bytes = 0;
+  for (auto& b : bs) { rows += (b.*m).ends.size(); bytes += (b.*m).arena.size(); }
+  if (bytes > (size_t)INT32_MAX) return false;
+  out.arena.reserve(bytes);
+  out.offsets.reserve(rows + 1);
+  out.valid.reserve(rows);
+  out.offsets.push_back(0);
+  for (auto& b : bs) {
+    StrCol& c = b.*m;
+    int32_t base = (int32_t)out.arena.size();
+    out.arena += c.arena;
+    for (int32_t e : c.ends) out.offsets.push_back(base + e);
+    out.valid.insert(out.valid.end(), c.valid.begin(), c.valid.end());
+  }
+  return true;
+}
+
+template <typename T, typename M>
+void merge_num(FinalNum<T>& out, std::vector<Builder>& bs, M m) {
+  for (auto& b : bs) {
+    auto& c = b.*m;
+    out.vals.insert(out.vals.end(), c.vals.begin(), c.vals.end());
+    out.valid.insert(out.valid.end(), c.valid.begin(), c.valid.end());
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* das_scan(const char* buf, int64_t len, int32_t n_threads) {
+  Result* r = new Result();
+  if (len <= 0) return r;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 32) n_threads = 32;
+  // split at line boundaries
+  std::vector<int64_t> cut(n_threads + 1, 0);
+  cut[n_threads] = len;
+  for (int t = 1; t < n_threads; t++) {
+    int64_t target = len * t / n_threads;
+    if (target < cut[t - 1]) target = cut[t - 1];
+    const char* nl = (const char*)memchr(buf + target, '\n', len - target);
+    cut[t] = nl ? (nl - buf) + 1 : len;
+  }
+  std::vector<Builder> builders(n_threads);
+  auto work = [&](int t) {
+    Builder& b = builders[t];
+    const char* p = buf + cut[t];
+    const char* end = buf + cut[t + 1];
+    while (p < end) {
+      const char* nl = (const char*)memchr(p, '\n', end - p);
+      const char* stop = nl ? nl : end;
+      // skip blank lines (the inter-file padding byte and trailing \n)
+      const char* q = p;
+      while (q < stop && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+      if (q != stop) {
+        b.line_starts.push_back(p - buf);
+        // row number assigned after join; stash local index via size
+        if (!parse_line(p, stop, (int64_t)b.line_starts.size() - 1,
+                        p - buf, b)) {
+          b.failed = true;
+          break;
+        }
+      }
+      if (!nl) break;
+      p = nl + 1;
+    }
+  };
+  if (n_threads == 1) {
+    work(0);  // single-core hosts: no thread spawn at all
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; t++) threads.emplace_back(work, t);
+    for (auto& th : threads) th.join();
+  }
+  for (auto& b : builders)
+    if (b.failed) { r->error = 1; return r; }
+
+  // rebase per-thread local row numbers to global ones
+  int64_t row_base = 0;
+  for (auto& b : builders) {
+    for (auto& v : b.line_no) v += row_base;
+    for (auto& v : b.other_line_no) v += row_base;
+    row_base += (int64_t)b.line_starts.size();
+  }
+  r->n_lines = row_base;
+
+  for (auto& b : builders) {
+    r->line_no.insert(r->line_no.end(), b.line_no.begin(), b.line_no.end());
+    r->is_add.insert(r->is_add.end(), b.is_add.begin(), b.is_add.end());
+    r->pv_valid.insert(r->pv_valid.end(), b.pv_valid.begin(), b.pv_valid.end());
+    r->dv_valid.insert(r->dv_valid.end(), b.dv_valid.begin(), b.dv_valid.end());
+    r->other_line_no.insert(r->other_line_no.end(), b.other_line_no.begin(),
+                            b.other_line_no.end());
+    r->other_start.insert(r->other_start.end(), b.other_start.begin(),
+                          b.other_start.end());
+    r->other_end.insert(r->other_end.end(), b.other_end.begin(),
+                        b.other_end.end());
+    r->line_starts.insert(r->line_starts.end(), b.line_starts.begin(),
+                          b.line_starts.end());
+  }
+  // line_starts were thread-local offsets from buf already (absolute)
+  r->n_rows = (int64_t)r->line_no.size();
+  r->n_others = (int64_t)r->other_line_no.size();
+
+  r->pv_offsets.reserve(r->n_rows + 1);
+  r->pv_offsets.push_back(0);
+  int32_t acc = 0;
+  for (auto& b : builders)
+    for (int32_t nent : b.pv_nentries) {
+      acc += nent;
+      r->pv_offsets.push_back(acc);
+    }
+  r->n_pv_entries = acc;
+
+  bool str_ok = merge_str(r->path, builders, &Builder::path) &&
+                merge_str(r->pv_key, builders, &Builder::pv_key) &&
+                merge_str(r->pv_val, builders, &Builder::pv_val) &&
+                merge_str(r->stats, builders, &Builder::stats) &&
+                merge_str(r->tags, builders, &Builder::tags) &&
+                merge_str(r->dv_storage, builders, &Builder::dv_storage) &&
+                merge_str(r->dv_pathinline, builders, &Builder::dv_pathinline) &&
+                merge_str(r->clustering, builders, &Builder::clustering);
+  if (!str_ok) { r->error = 1; return r; }
+  merge_num(r->size, builders, &Builder::size);
+  merge_num(r->mod_time, builders, &Builder::mod_time);
+  merge_num(r->data_change, builders, &Builder::data_change);
+  merge_num(r->dv_offset, builders, &Builder::dv_offset);
+  merge_num(r->dv_size, builders, &Builder::dv_size);
+  merge_num(r->dv_card, builders, &Builder::dv_card);
+  merge_num(r->dv_maxrow, builders, &Builder::dv_maxrow);
+  merge_num(r->base_row_id, builders, &Builder::base_row_id);
+  merge_num(r->drcv, builders, &Builder::drcv);
+  merge_num(r->del_ts, builders, &Builder::del_ts);
+  merge_num(r->ext_meta, builders, &Builder::ext_meta);
+  return r;
+}
+
+void das_free(void* h) { delete (Result*)h; }
+int32_t das_error(void* h) { return ((Result*)h)->error; }
+
+// counts: 0 rows, 1 lines, 2 others, 3 pv entries, and arena byte sizes
+int64_t das_n(void* h, int32_t what) {
+  Result* r = (Result*)h;
+  switch (what) {
+    case 0: return r->n_rows;
+    case 1: return r->n_lines;
+    case 2: return r->n_others;
+    case 3: return r->n_pv_entries;
+    case 4: return (int64_t)r->path.arena.size();
+    case 5: return (int64_t)r->pv_key.arena.size();
+    case 6: return (int64_t)r->pv_val.arena.size();
+    case 7: return (int64_t)r->stats.arena.size();
+    case 8: return (int64_t)r->tags.arena.size();
+    case 9: return (int64_t)r->dv_storage.arena.size();
+    case 10: return (int64_t)r->dv_pathinline.arena.size();
+    case 11: return (int64_t)r->clustering.arena.size();
+    default: return -1;
+  }
+}
+
+const void* das_ptr(void* h, int32_t which) {
+  Result* r = (Result*)h;
+  switch (which) {
+    case 0: return r->line_no.data();
+    case 1: return r->is_add.data();
+    case 2: return r->path.offsets.data();
+    case 3: return r->path.arena.data();
+    case 4: return r->path.valid.data();
+    case 5: return r->pv_offsets.data();
+    case 6: return r->pv_valid.data();
+    case 7: return r->pv_key.offsets.data();
+    case 8: return r->pv_key.arena.data();
+    case 9: return r->pv_val.offsets.data();
+    case 10: return r->pv_val.arena.data();
+    case 11: return r->pv_val.valid.data();
+    case 12: return r->size.vals.data();
+    case 13: return r->size.valid.data();
+    case 14: return r->mod_time.vals.data();
+    case 15: return r->mod_time.valid.data();
+    case 16: return r->data_change.vals.data();
+    case 17: return r->data_change.valid.data();
+    case 18: return r->stats.offsets.data();
+    case 19: return r->stats.arena.data();
+    case 20: return r->stats.valid.data();
+    case 21: return r->tags.offsets.data();
+    case 22: return r->tags.arena.data();
+    case 23: return r->tags.valid.data();
+    case 24: return r->dv_valid.data();
+    case 25: return r->dv_storage.offsets.data();
+    case 26: return r->dv_storage.arena.data();
+    case 27: return r->dv_storage.valid.data();
+    case 28: return r->dv_pathinline.offsets.data();
+    case 29: return r->dv_pathinline.arena.data();
+    case 30: return r->dv_pathinline.valid.data();
+    case 31: return r->dv_offset.vals.data();
+    case 32: return r->dv_offset.valid.data();
+    case 33: return r->dv_size.vals.data();
+    case 34: return r->dv_size.valid.data();
+    case 35: return r->dv_card.vals.data();
+    case 36: return r->dv_card.valid.data();
+    case 37: return r->dv_maxrow.vals.data();
+    case 38: return r->dv_maxrow.valid.data();
+    case 39: return r->base_row_id.vals.data();
+    case 40: return r->base_row_id.valid.data();
+    case 41: return r->drcv.vals.data();
+    case 42: return r->drcv.valid.data();
+    case 43: return r->clustering.offsets.data();
+    case 44: return r->clustering.arena.data();
+    case 45: return r->clustering.valid.data();
+    case 46: return r->del_ts.vals.data();
+    case 47: return r->del_ts.valid.data();
+    case 48: return r->ext_meta.vals.data();
+    case 49: return r->ext_meta.valid.data();
+    case 50: return r->other_line_no.data();
+    case 51: return r->other_start.data();
+    case 52: return r->other_end.data();
+    case 53: return r->line_starts.data();
+    default: return nullptr;
+  }
+}
+
+}  // extern "C"

@@ -97,7 +97,11 @@ def _field_or_null(struct_arr: pa.StructArray, name: str, typ: pa.DataType) -> p
     t = struct_arr.type
     if t.get_field_index(name) >= 0:
         arr = pc.struct_field(struct_arr, name)
-        if arr.type != typ and not (pa.types.is_map(typ) or pa.types.is_struct(typ)):
+        # struct-typed actual values (e.g. JSON-inferred tags maps) are
+        # normalized downstream, never cast here
+        if (arr.type != typ
+                and not (pa.types.is_map(typ) or pa.types.is_struct(typ))
+                and not pa.types.is_struct(arr.type)):
             arr = arr.cast(typ, safe=False)
         return arr
     return pa.nulls(n, typ)
@@ -158,6 +162,19 @@ def _map_or_json_to_string(arr: pa.Array, n: int) -> pa.Array:
     return pa.array(out, pa.string())
 
 
+def _dv_unique_id(storage, path_or_inline, offset, valid_mask, n) -> pa.Array:
+    """unique id = storageType + pathOrInlineDv [+ "@" + offset]
+    (DeletionVectorDescriptor.uniqueId semantics)."""
+    base = pc.binary_join_element_wise(
+        pc.fill_null(storage, ""), pc.fill_null(path_or_inline, ""), ""
+    )
+    with_offset = pc.binary_join_element_wise(
+        base, pc.cast(offset, pa.string()), "@"
+    )
+    dv_id = pc.if_else(pc.is_valid(offset), with_offset, base)
+    return pc.if_else(valid_mask, dv_id, pa.nulls(n, pa.string()))
+
+
 def _normalize_dv(arr: pa.Array, n: int) -> tuple[pa.Array, pa.Array]:
     """Returns (dv struct column, dv_id string column)."""
     if pa.types.is_null(arr.type) or not pa.types.is_struct(arr.type):
@@ -174,16 +191,7 @@ def _normalize_dv(arr: pa.Array, n: int) -> tuple[pa.Array, pa.Array]:
         fields=list(DV_STRUCT_TYPE),
         mask=pc.invert(valid_mask),
     )
-    # unique id = storageType + pathOrInlineDv [+ "@" + offset]
-    base = pc.binary_join_element_wise(
-        pc.fill_null(storage, ""), pc.fill_null(path_or_inline, ""), ""
-    )
-    with_offset = pc.binary_join_element_wise(
-        base, pc.cast(offset, pa.string()), "@"
-    )
-    dv_id = pc.if_else(pc.is_valid(offset), with_offset, base)
-    dv_id = pc.if_else(valid_mask, dv_id, pa.nulls(n, pa.string()))
-    return dv_struct, dv_id
+    return dv_struct, _dv_unique_id(storage, path_or_inline, offset, valid_mask, n)
 
 
 _URI_ESCAPE = pc.match_substring  # detection helper (see _decode_paths)
@@ -327,25 +335,35 @@ class _SmallActionTracker:
     def _on_commit_info(self, v, o, row):
         self.commit_infos[v] = CommitInfo.from_dict(row)
 
+    def scan_pylist(self, rows: Sequence[Tuple[int, int, dict]]):
+        """Consume (version, order, {action-key: body}) rows — the
+        native scanner's non-file-action lines."""
+        handlers = {
+            "protocol": self._on_protocol,
+            "metaData": self._on_metadata,
+            "txn": self._on_txn,
+            "domainMetadata": self._on_domain,
+            "commitInfo": self._on_commit_info,
+        }
+        for v, o, row in rows:
+            for key, body in row.items():
+                h = handlers.get(key)
+                if h is not None and body is not None:
+                    h(v, o, _prune_nones(body))
 
-def parse_commit_files(
+
+def _read_commits_buffer(
     engine,
     commit_infos: Sequence[Tuple[int, str, int]],
     max_workers: int = 16,
-) -> tuple[Optional[pa.Table], np.ndarray, np.ndarray, int]:
-    """Parallel-read commit files into ONE preallocated buffer and parse
-    with a single Arrow read_json call.
+) -> Optional[tuple[bytearray, np.ndarray, np.ndarray]]:
+    """Parallel-read commit files into ONE preallocated buffer.
 
     commit_infos: (version, path, size-from-listing). Each file gets a
     region of `size + 1` bytes, the last byte forced to "\\n" (blank
-    lines between files are ignored by the parser). Row→version mapping
-    comes from one vectorized pass: a row ends at every newline not
-    preceded by a newline; per-file counts by searchsorted over region
-    boundaries. Falls back to the sequential path when a listed size
-    disagrees with the bytes read.
-    """
-    if not commit_infos:
-        return None, np.empty(0, np.int64), np.empty(0, np.int32), 0
+    lines between files are ignored by the parsers). Returns
+    (buffer, per-file byte starts[n+1], per-file versions), or None when
+    a listed size disagrees with the bytes read (caller re-reads)."""
     n = len(commit_infos)
     sizes = np.array([max(0, int(s)) for _, _, s in commit_infos], dtype=np.int64)
     starts = np.zeros(n + 1, dtype=np.int64)
@@ -377,9 +395,20 @@ def parse_commit_files(
         for i in range(n):
             fill(i)
     if mismatch:
-        blobs = [(v, engine.fs.read_file(p)) for v, p, _ in commit_infos]
-        return parse_commit_batch(blobs)
+        return None
+    version_arr = np.array([v for v, _, _ in commit_infos], dtype=np.int64)
+    return buf, starts, version_arr
 
+
+def _parse_buffer_generic(
+    buf, starts: np.ndarray, version_arr: np.ndarray
+) -> Optional[tuple[pa.Table, np.ndarray, np.ndarray, int]]:
+    """Generic path over one concatenated buffer: one Arrow read_json
+    call. Row→version mapping comes from one vectorized pass: a row ends
+    at every newline not preceded by a newline; per-file counts by
+    searchsorted over region boundaries. None when the parsed row count
+    disagrees with the line accounting (caller re-reads per file)."""
+    total = int(starts[-1])
     arr = np.frombuffer(buf, np.uint8)
     nl = arr == 0x0A
     prev = np.empty_like(nl)
@@ -387,7 +416,6 @@ def parse_commit_files(
     prev[1:] = nl[:-1]
     row_ends = np.nonzero(nl & ~prev)[0]
     counts = np.diff(np.searchsorted(row_ends, starts))
-    version_arr = np.array([v for v, _, _ in commit_infos], dtype=np.int64)
     versions = np.repeat(version_arr, counts)
     orders = (
         np.arange(versions.shape[0], dtype=np.int64)
@@ -399,9 +427,25 @@ def parse_commit_files(
         read_options=pa_json.ReadOptions(block_size=1 << 24),
     )
     if table.num_rows != versions.shape[0]:
+        return None
+    return table, versions, orders, total
+
+
+def parse_commit_files(
+    engine,
+    commit_infos: Sequence[Tuple[int, str, int]],
+    max_workers: int = 16,
+) -> tuple[Optional[pa.Table], np.ndarray, np.ndarray, int]:
+    """One buffer, one Arrow read_json call; per-file re-read fallback
+    when listed sizes or line accounting disagree."""
+    if not commit_infos:
+        return None, np.empty(0, np.int64), np.empty(0, np.int32), 0
+    read = _read_commits_buffer(engine, commit_infos, max_workers)
+    out = _parse_buffer_generic(*read) if read is not None else None
+    if out is None:
         blobs = [(v, engine.fs.read_file(p)) for v, p, _ in commit_infos]
         return parse_commit_batch(blobs)
-    return table, versions, orders, total
+    return out
 
 
 def parse_commit_batch(
@@ -507,14 +551,41 @@ def columnarize_log_segment(
     for fstat in segment.deltas:
         commit_infos.append((fn.delta_version(fstat.path), fstat.path, fstat.size))
 
-    tbl, versions, orders, nbytes = parse_commit_files(engine, commit_infos)
-    bytes_parsed += nbytes
-    if tbl is not None:
-        tracker.scan_chunk(tbl, versions, orders)
-        for col in ("add", "remove"):
-            block = _extract_file_actions(tbl, col, versions, orders)
-            if block is not None:
+    if commit_infos:
+        # one parallel read into one buffer; the native C++ scanner and
+        # the generic Arrow parser are alternative consumers of the SAME
+        # bytes — a native-side rejection never re-fetches from storage
+        read = _read_commits_buffer(engine, commit_infos)
+        parsed_native = generic = None
+        if read is not None:
+            buf, starts, version_arr = read
+            from delta_tpu import native as _native
+
+            if _native.available():
+                from delta_tpu.replay.native_parse import parse_commits_native
+
+                parsed_native = parse_commits_native(buf, starts, version_arr)
+            if parsed_native is None:
+                generic = _parse_buffer_generic(buf, starts, version_arr)
+        if parsed_native is not None:
+            block, others = parsed_native
+            if block.num_rows:
                 blocks.append(block)
+            tracker.scan_pylist(others)
+            bytes_parsed += int(read[1][-1])
+        else:
+            if generic is None:  # size mismatch or accounting failure
+                blobs = [(v, engine.fs.read_file(p))
+                         for v, p, _ in commit_infos]
+                generic = parse_commit_batch(blobs)
+            tbl, versions, orders, nbytes = generic
+            bytes_parsed += nbytes
+            if tbl is not None:
+                tracker.scan_chunk(tbl, versions, orders)
+                for col in ("add", "remove"):
+                    block = _extract_file_actions(tbl, col, versions, orders)
+                    if block is not None:
+                        blocks.append(block)
 
     if blocks:
         file_actions = pa.concat_tables(blocks)

@@ -1,0 +1,155 @@
+"""Assemble the canonical file-actions table from the native scanner.
+
+`delta_tpu.native.scan_actions` returns flat numpy buffers (offsets +
+arenas + validity) for the add/remove rows of a commit-JSON buffer; this
+module zero-copies them into Arrow arrays in the canonical schema
+(`CANONICAL_FILE_ACTION_SCHEMA`) and resolves per-row (version, order)
+tags from line positions. Non-file actions come back as byte spans and
+are json.loads'ed host-side — they are O(commits), not O(files).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+def _bitmap(valid: np.ndarray) -> Optional[pa.Buffer]:
+    if valid.all():
+        return None
+    return pa.py_buffer(np.packbits(valid, bitorder="little"))
+
+
+def _str_array(col: tuple) -> pa.Array:
+    offsets, arena, valid = col
+    return pa.StringArray.from_buffers(
+        len(valid), pa.py_buffer(offsets), pa.py_buffer(arena),
+        _bitmap(valid))
+
+
+def _num_array(col: tuple, typ: pa.DataType) -> pa.Array:
+    vals, valid = col
+    return pa.Array.from_buffers(
+        typ, len(valid), [_bitmap(valid), pa.py_buffer(vals)])
+
+
+def _bool_array(col: tuple) -> pa.Array:
+    vals, valid = col
+    return pa.Array.from_buffers(
+        pa.bool_(), len(valid),
+        [_bitmap(valid), pa.py_buffer(np.packbits(vals, bitorder="little"))])
+
+
+def line_tags(
+    line_starts: np.ndarray,
+    file_starts: np.ndarray,
+    file_versions: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(version, order) per line: which file a line's byte offset falls
+    in gives its version; order is the line's rank within that file."""
+    file_of_line = np.searchsorted(file_starts, line_starts, side="right") - 1
+    first_line = np.searchsorted(line_starts, file_starts[:-1], side="left")
+    versions = file_versions[file_of_line]
+    orders = (np.arange(len(line_starts), dtype=np.int64)
+              - first_line[file_of_line]).astype(np.int32)
+    return versions, orders
+
+
+def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
+    """ScanResult + per-row tags -> canonical Arrow table (+ dv struct
+    pieces needed for dv_id derivation, done by the caller with the same
+    expressions as the generic path)."""
+    from delta_tpu.replay.columnar import (
+        CANONICAL_FILE_ACTION_SCHEMA,
+        DV_STRUCT_TYPE,
+        _decode_paths,
+        _dv_unique_id,
+    )
+
+    n = scan.n_rows
+    path = _decode_paths(_str_array(scan.path))
+    keys = _str_array(scan.pv_key)
+    items = _str_array(scan.pv_val)
+    map_type = pa.map_(pa.string(), pa.string())
+    entries_type = map_type.field(0).type
+    entries = pa.StructArray.from_arrays(
+        [keys, items],
+        fields=[entries_type.field(0), entries_type.field(1)])
+    pv = pa.Array.from_buffers(
+        map_type, n,
+        [_bitmap(scan.pv_valid), pa.py_buffer(scan.pv_offsets)],
+        children=[entries])
+
+    storage = _str_array(scan.dv_storage)
+    pathinline = _str_array(scan.dv_pathinline)
+    dv_offset = _num_array(scan.dv_offset, pa.int32())
+    dv_struct = pa.StructArray.from_arrays(
+        [storage, pathinline, dv_offset,
+         _num_array(scan.dv_size, pa.int32()),
+         _num_array(scan.dv_card, pa.int64()),
+         _num_array(scan.dv_maxrow, pa.int64())],
+        fields=list(DV_STRUCT_TYPE),
+        mask=pa.array(~scan.dv_valid),
+    )
+    dv_id = _dv_unique_id(storage, pathinline, dv_offset, scan.dv_valid, n)
+
+    return pa.table(
+        {
+            "path": path,
+            "dv_id": dv_id,
+            "partition_values": pv,
+            "size": _num_array(scan.size, pa.int64()),
+            "modification_time": _num_array(scan.mod_time, pa.int64()),
+            "data_change": _bool_array(scan.data_change),
+            "stats": _str_array(scan.stats),
+            "tags": _str_array(scan.tags),
+            "deletion_vector": dv_struct,
+            "base_row_id": _num_array(scan.base_row_id, pa.int64()),
+            "default_row_commit_version": _num_array(scan.drcv, pa.int64()),
+            "clustering_provider": _str_array(scan.clustering),
+            "deletion_timestamp": _num_array(scan.del_ts, pa.int64()),
+            "extended_file_metadata": _bool_array(scan.ext_meta),
+            "is_add": pa.array(scan.is_add),
+            "version": pa.array(versions, pa.int64()),
+            "order": pa.array(orders, pa.int32()),
+        },
+        schema=CANONICAL_FILE_ACTION_SCHEMA,
+    )
+
+
+def parse_commits_native(
+    buf,
+    file_starts: np.ndarray,
+    file_versions: np.ndarray,
+) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]]]]:
+    """Native fast path over one concatenated commit buffer.
+
+    Returns (canonical file-actions table, [(version, order, action-dict)
+    for non-file actions]) or None when the native scanner is
+    unavailable/fails (caller uses the generic Arrow parser)."""
+    from delta_tpu import native
+
+    scan = native.scan_actions(buf)
+    if scan is None:
+        return None
+    line_versions, line_orders = line_tags(
+        scan.line_starts, file_starts, file_versions)
+    table = build_canonical_table(
+        scan,
+        line_versions[scan.line_no] if scan.n_rows else np.empty(0, np.int64),
+        line_orders[scan.line_no] if scan.n_rows else np.empty(0, np.int32),
+    )
+    others: List[Tuple[int, int, dict]] = []
+    mv = memoryview(buf)
+    for ln, s, e in zip(scan.other_line_no.tolist(),
+                        scan.other_start.tolist(),
+                        scan.other_end.tolist()):
+        try:
+            row = json.loads(bytes(mv[s:e]))
+        except ValueError:
+            return None  # malformed control line: let the generic path err
+        others.append((int(line_versions[ln]), int(line_orders[ln]), row))
+    return table, others

@@ -1,0 +1,170 @@
+"""Native C++ action scanner: unit + parity vs the generic Arrow path."""
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _scan(lines):
+    buf = ("\n".join(lines) + "\n").encode()
+    return buf, native.scan_actions(buf)
+
+
+def test_scan_basic_fields():
+    buf, scan = _scan([
+        '{"add":{"path":"a.parquet","partitionValues":{"d":"x"},"size":10,'
+        '"modificationTime":5,"dataChange":true,"stats":"{\\"numRecords\\":1}"}}',
+        '{"remove":{"path":"b.parquet","deletionTimestamp":7,"dataChange":false}}',
+        '{"commitInfo":{"operation":"WRITE"}}',
+    ])
+    assert scan.n_rows == 2 and scan.n_others == 1 and scan.n_lines == 3
+    assert scan.is_add.tolist() == [True, False]
+    assert scan.size[0][0] == 10 and scan.size[1].tolist() == [True, False]
+    assert scan.del_ts[0][1] == 7
+    assert scan.data_change[0].tolist() == [True, False]
+
+
+def test_scan_string_escapes_and_unicode():
+    buf, scan = _scan([
+        '{"add":{"path":"a\\u00e9\\n\\"b\\\\c\\ud83d\\ude00.parquet",'
+        '"partitionValues":{},"size":1,"modificationTime":1,"dataChange":true}}',
+    ])
+    off, arena, valid = scan.path
+    path = bytes(arena[off[0]:off[1]]).decode()
+    assert path == 'aé\n"b\\c😀.parquet'
+
+
+def test_scan_dv_and_null_pv_values():
+    buf, scan = _scan([
+        '{"add":{"path":"p","partitionValues":{"k":null},"size":1,'
+        '"modificationTime":1,"dataChange":true,"deletionVector":'
+        '{"storageType":"u","pathOrInlineDv":"xyz","offset":3,'
+        '"sizeInBytes":9,"cardinality":2,"maxRowIndex":77}}}',
+    ])
+    assert scan.dv_valid.tolist() == [True]
+    assert scan.dv_offset[0][0] == 3 and scan.dv_card[0][0] == 2
+    assert scan.dv_maxrow[0][0] == 77
+    _, _, vvalid = scan.pv_val
+    assert vvalid.tolist() == [False]
+
+
+def test_scan_unknown_fields_skipped():
+    buf, scan = _scan([
+        '{"add":{"path":"p","partitionValues":{},"size":1,'
+        '"modificationTime":1,"dataChange":true,'
+        '"futureField":{"nested":[1,{"x":"}"}],"s":"]"},"another":null}}',
+    ])
+    assert scan.n_rows == 1
+
+
+def test_scan_malformed_returns_none():
+    buf = b'{"add":{"path": broken\n'
+    assert native.scan_actions(buf) is None
+
+
+def test_parity_with_generic_parser(tmp_path):
+    """Columnarize the same log with and without the native scanner —
+    canonical tables must match."""
+    import pyarrow.parquet  # noqa: F401  (ensure pyarrow loaded)
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.log.segment import build_log_segment
+    from delta_tpu.replay.columnar import columnarize_log_segment
+
+    rng = np.random.default_rng(7)
+    log = tmp_path / "_delta_log"
+    log.mkdir()
+    meta = {"metaData": {"id": "m", "format": {"provider": "parquet",
+            "options": {}}, "schemaString": "{}", "partitionColumns": [],
+            "configuration": {}}}
+    proto = {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}
+    added = []
+    for v in range(12):
+        lines = []
+        if v == 0:
+            lines += [json.dumps(proto), json.dumps(meta)]
+        for i in range(6):
+            p = f"part-{v}-{i}%20x.parquet"
+            added.append(p)
+            act = {"add": {"path": p, "partitionValues": {"d": f"d{v}"},
+                   "size": int(rng.integers(1, 1000)),
+                   "modificationTime": 1000 + v, "dataChange": True,
+                   "stats": json.dumps({"numRecords": i})}}
+            if i == 3:
+                act["add"]["deletionVector"] = {
+                    "storageType": "u", "pathOrInlineDv": f"dv{v}",
+                    "offset": 1, "sizeInBytes": 40, "cardinality": 2}
+            if i == 4:
+                act["add"]["tags"] = {"t": "v"}
+            lines.append(json.dumps(act))
+        if v > 2:
+            lines.append(json.dumps({"remove": {
+                "path": added[int(rng.integers(0, len(added) - 10))],
+                "deletionTimestamp": 2000 + v, "dataChange": True,
+                "extendedFileMetadata": False}}))
+        lines.append(json.dumps({"commitInfo": {"operation": "WRITE",
+                                                "tpu": v}}))
+        (log / f"{v:020d}.json").write_text("\n".join(lines) + "\n")
+
+    eng = HostEngine()
+    seg = build_log_segment(eng.fs, str(log))
+    col_native = columnarize_log_segment(eng, seg)
+
+    import os
+    os.environ["DELTA_TPU_DISABLE_NATIVE"] = "1"
+    import delta_tpu.native as nat
+    old_lib, old_tried = nat._LIB, nat._TRIED
+    nat._LIB, nat._TRIED = None, True
+    try:
+        col_generic = columnarize_log_segment(eng, seg)
+    finally:
+        del os.environ["DELTA_TPU_DISABLE_NATIVE"]
+        nat._LIB, nat._TRIED = old_lib, old_tried
+
+    tn, tg = col_native.file_actions, col_generic.file_actions
+    assert tn.num_rows == tg.num_rows
+    # native emits commit order; generic emits adds-then-removes blocks.
+    # Compare as (version, order)-sorted rows.
+    def norm(t):
+        idx = pa.compute.sort_indices(
+            t, sort_keys=[("version", "ascending"), ("order", "ascending")])
+        return t.take(idx)
+    tn, tg = norm(tn), norm(tg)
+    for name in ("path", "dv_id", "size", "modification_time", "data_change",
+                 "stats", "is_add", "version", "order", "deletion_timestamp",
+                 "extended_file_metadata", "base_row_id",
+                 "clustering_provider"):
+        assert tn.column(name).to_pylist() == tg.column(name).to_pylist(), name
+    assert tn.column("partition_values").to_pylist() == \
+        tg.column("partition_values").to_pylist()
+    dv_n = [None if d is None else {k: d[k] for k in
+            ("storageType", "pathOrInlineDv", "offset", "sizeInBytes",
+             "cardinality")} for d in tn.column("deletion_vector").to_pylist()]
+    dv_g = [None if d is None else {k: d[k] for k in
+            ("storageType", "pathOrInlineDv", "offset", "sizeInBytes",
+             "cardinality")} for d in tg.column("deletion_vector").to_pylist()]
+    assert dv_n == dv_g
+    # tags: JSON text may differ in key order; compare parsed
+    tags_n = [None if t is None else json.loads(t)
+              for t in tn.column("tags").to_pylist()]
+    tags_g = [None if t is None else json.loads(t)
+              for t in tg.column("tags").to_pylist()]
+    assert tags_n == tags_g
+    assert col_native.protocol == col_generic.protocol
+    assert col_native.metadata == col_generic.metadata
+    assert col_native.commit_infos.keys() == col_generic.commit_infos.keys()
+
+
+def test_scan_duplicate_keys_rejected():
+    # duplicate keys would misalign the column builders; the scanner must
+    # reject the buffer so the caller falls back to the generic parser
+    buf = (b'{"add":{"path":"a","path":"b","partitionValues":{},"size":1,'
+           b'"modificationTime":1,"dataChange":true}}\n')
+    assert native.scan_actions(buf) is None
