@@ -79,19 +79,9 @@ def write_table(
     schema = meta.schema
     partition_columns = meta.partitionColumns
 
-    from delta_tpu.colgen import (
-        GENERATION_EXPRESSION_KEY,
-        IDENTITY_START_KEY,
-        IDENTITY_STEP_KEY,
-        apply_column_generation,
-    )
+    from delta_tpu.colgen import apply_column_generation, needs_column_generation
 
-    if any(
-        GENERATION_EXPRESSION_KEY in f.metadata
-        or IDENTITY_START_KEY in f.metadata
-        or IDENTITY_STEP_KEY in f.metadata
-        for f in schema.fields
-    ):
+    if needs_column_generation(schema):
         data, evolved = apply_column_generation(data, schema)
         if evolved is not None:
             import dataclasses

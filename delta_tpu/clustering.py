@@ -1,0 +1,109 @@
+"""Liquid clustering: clustered-table domain metadata + ZCube tracking.
+
+Reference `skipping/clustering/ClusteredTableUtils.scala` +
+`ClusteringColumnInfo` + `ZCube.scala`: a clustered table stores its
+clustering columns in the `delta.clusteringMetadata` domain
+(`{"clusteringColumns": [["col"], ["nested","col"]], ...}`) and requires
+the `clustering` + `domainMetadata` writer features. OPTIMIZE on a
+clustered table clusters by those columns (no explicit ZORDER BY) and
+tags every written file with a ZCUBE id so later OPTIMIZE runs can skip
+files that are already part of a large-enough cube
+(`ZCubeFileStatsCollector.scala` tags).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import List, Optional
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.actions import DomainMetadata
+
+CLUSTERING_DOMAIN = "delta.clusteringMetadata"
+ZCUBE_ID_TAG = "ZCUBE_ID"
+ZCUBE_ZORDER_BY_TAG = "ZCUBE_ZORDER_BY"
+ZCUBE_ZORDER_CURVE_TAG = "ZCUBE_ZORDER_CURVE"
+# files in a cube at least this big are "stable" and not re-clustered
+DEFAULT_MIN_CUBE_SIZE = 100 * 1024 * 1024 * 1024  # 100GB, reference default
+
+
+def clustering_domain(columns: List[str]) -> DomainMetadata:
+    return DomainMetadata(
+        CLUSTERING_DOMAIN,
+        json.dumps({"clusteringColumns": [[c] for c in columns]}),
+        removed=False,
+    )
+
+
+def clustering_columns(snapshot) -> Optional[List[str]]:
+    """The table's clustering columns, or None if not a clustered table."""
+    if snapshot is None:
+        return None
+    dm = snapshot.state.domain_metadata.get(CLUSTERING_DOMAIN)
+    if dm is None or dm.removed or not dm.configuration:
+        return None
+    try:
+        cols = json.loads(dm.configuration).get("clusteringColumns", [])
+    except ValueError:
+        return None
+    return [".".join(c) if isinstance(c, list) else str(c) for c in cols]
+
+
+def set_clustering_columns(table, columns: List[str]) -> int:
+    """ALTER TABLE ... CLUSTER BY (columns) — writes the clustering
+    domain (and upgrades the protocol with the clustering +
+    domainMetadata features). Empty list = CLUSTER BY NONE."""
+    from delta_tpu.features import CLUSTERING, DOMAIN_METADATA, upgraded_protocol
+    from delta_tpu.txn.transaction import Operation
+
+    snap = table.latest_snapshot()
+    meta = snap.metadata
+    schema = meta.schema
+    for c in columns:
+        if schema is not None and c not in schema:
+            raise DeltaError(f"clustering column {c} not in schema")
+        if c in meta.partitionColumns:
+            raise DeltaError(f"cannot cluster by partition column {c}")
+    if meta.partitionColumns and columns:
+        raise DeltaError("clustered tables cannot be partitioned")
+
+    txn = table.create_transaction_builder(Operation.CLUSTER_BY).build()
+    proto = snap.protocol
+    for feat in (DOMAIN_METADATA, CLUSTERING):
+        proto = upgraded_protocol(proto, feat)
+    if proto != snap.protocol:
+        txn.update_protocol(proto)
+    if columns:
+        dm = clustering_domain(columns)
+        txn.set_domain_metadata(dm.domain, dm.configuration)
+    else:
+        txn.remove_domain_metadata(CLUSTERING_DOMAIN)
+    txn.set_operation_parameters({"clusterBy": columns})
+    return txn.commit().version
+
+
+def new_zcube_tags(columns: List[str], curve: str) -> dict:
+    return {
+        ZCUBE_ID_TAG: uuid.uuid4().hex,
+        ZCUBE_ZORDER_BY_TAG: json.dumps(columns),
+        ZCUBE_ZORDER_CURVE_TAG: curve,
+    }
+
+
+def file_in_stable_zcube(add_file, columns: List[str],
+                         cube_sizes: dict) -> bool:
+    """True when the file already belongs to a cube clustered by the
+    same columns whose total size passes the stability threshold —
+    OPTIMIZE skips these (`ZCube.scala` filtering semantics)."""
+    tags = add_file.tags or {}
+    cube = tags.get(ZCUBE_ID_TAG)
+    if not cube:
+        return False
+    try:
+        cube_cols = json.loads(tags.get(ZCUBE_ZORDER_BY_TAG, "[]"))
+    except ValueError:
+        return False
+    if cube_cols != columns:
+        return False
+    return cube_sizes.get(cube, 0) >= DEFAULT_MIN_CUBE_SIZE

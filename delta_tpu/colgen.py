@@ -1,7 +1,7 @@
-"""Generated and identity column value generation on write.
+"""Generated / identity / default column value generation on write.
 
 Reference `GeneratedColumn.scala` / `IdentityColumn.scala` /
-`GenerateIdentityValues.scala`:
+`GenerateIdentityValues.scala` / `ColumnWithDefaultExprUtils.scala`:
 
 - generated columns: field metadata `delta.generationExpression`
   (parseable predicate/expression text). Missing on write → computed;
@@ -11,6 +11,9 @@ Reference `GeneratedColumn.scala` / `IdentityColumn.scala` /
   allocated from the high watermark (which advances in the SAME commit
   via a schema-metadata update); present → rejected unless
   allowExplicitInsert.
+- default columns (`allowColumnDefaults` writer feature): field metadata
+  `CURRENT_DEFAULT` holds an expression; a column missing from the
+  written data is filled with its evaluated default instead of null.
 """
 
 from __future__ import annotations
@@ -29,6 +32,16 @@ IDENTITY_START_KEY = "delta.identity.start"
 IDENTITY_STEP_KEY = "delta.identity.step"
 IDENTITY_HIGH_WATERMARK_KEY = "delta.identity.highWaterMark"
 IDENTITY_ALLOW_EXPLICIT_KEY = "delta.identity.allowExplicitInsert"
+CURRENT_DEFAULT_KEY = "CURRENT_DEFAULT"
+
+GENERATION_KEYS = (GENERATION_EXPRESSION_KEY, IDENTITY_START_KEY,
+                   IDENTITY_STEP_KEY, CURRENT_DEFAULT_KEY)
+
+
+def needs_column_generation(schema: StructType) -> bool:
+    return any(
+        any(k in f.metadata for k in GENERATION_KEYS) for f in schema.fields
+    )
 
 
 def identity_field(
@@ -58,6 +71,17 @@ def generated_field(name: str, dtype, expression: str) -> StructField:
     return StructField(name, dtype, metadata={GENERATION_EXPRESSION_KEY: expression})
 
 
+def default_field(name: str, dtype, default: str,
+                  nullable: bool = True) -> StructField:
+    """Declare a column with a DEFAULT expression (requires the
+    `allowColumnDefaults` writer feature; enforced at commit)."""
+    from delta_tpu.expressions.parser import parse_expression
+
+    parse_expression(default)  # validate early
+    return StructField(name, dtype, nullable=nullable,
+                       metadata={CURRENT_DEFAULT_KEY: default})
+
+
 def apply_column_generation(
     data: pa.Table, schema: StructType
 ) -> Tuple[pa.Table, Optional[StructType]]:
@@ -73,6 +97,22 @@ def apply_column_generation(
     for i, f in enumerate(schema.fields):
         gen_expr = f.metadata.get(GENERATION_EXPRESSION_KEY)
         is_identity = IDENTITY_START_KEY in f.metadata or IDENTITY_STEP_KEY in f.metadata
+        default_expr = f.metadata.get(CURRENT_DEFAULT_KEY)
+
+        if (default_expr is not None and gen_expr is None and not is_identity
+                and f.name not in data.column_names):
+            expr = parse_expression(default_expr)
+            computed = evaluate_host(expr, data)
+            if isinstance(computed, pa.ChunkedArray):
+                computed = computed.combine_chunks()
+            if isinstance(computed, pa.Scalar) or not isinstance(
+                    computed, (pa.Array, pa.ChunkedArray)):
+                computed = pa.array(
+                    [computed.as_py() if isinstance(computed, pa.Scalar)
+                     else computed] * n)
+            computed = computed.cast(to_arrow_type(f.dataType), safe=False)
+            data = data.append_column(f.name, computed)
+            continue
 
         if gen_expr is not None:
             expr = parse_expression(gen_expr)

@@ -107,6 +107,12 @@ def _run_optimize(
     min_file_size: Optional[int],
     curve: str = "zorder",
 ) -> OptimizeMetrics:
+    from delta_tpu.clustering import (
+        clustering_columns,
+        file_in_stable_zcube,
+        new_zcube_tags,
+    )
+
     txn = table.create_transaction_builder(Operation.OPTIMIZE).build()
     txn._isolation = IsolationLevel.SNAPSHOT_ISOLATION
     snapshot = txn.read_snapshot
@@ -114,6 +120,20 @@ def _run_optimize(
         raise DeltaError(f"no table at {table.path}")
     meta = snapshot.metadata
     schema = meta.schema
+
+    # clustered table: compaction becomes clustering by the domain's
+    # columns (`OptimizeExecutor` isClusteredTable semantics)
+    cluster_cols = clustering_columns(snapshot)
+    zcube_tags = None
+    if zorder_by is None and cluster_cols:
+        zorder_by = cluster_cols
+        min_file_size = None
+        zcube_tags = new_zcube_tags(cluster_cols, curve)
+    elif zorder_by and cluster_cols:
+        raise DeltaError(
+            "clustered tables use OPTIMIZE (no ZORDER BY); clustering "
+            f"columns are {cluster_cols}")
+
     if zorder_by:
         for c in zorder_by:
             if c in meta.partitionColumns:
@@ -122,6 +142,19 @@ def _run_optimize(
                 raise DeltaError(f"Z-order column {c} not in schema")
 
     candidates = txn.scan_files(filter=filter)
+    if zcube_tags is not None:
+        # skip files already in a stable cube over the same columns
+        cube_sizes: Dict[str, int] = {}
+        from delta_tpu.clustering import ZCUBE_ID_TAG
+
+        for f in candidates:
+            cid = (f.tags or {}).get(ZCUBE_ID_TAG)
+            if cid:
+                cube_sizes[cid] = cube_sizes.get(cid, 0) + f.size
+        candidates = [
+            f for f in candidates
+            if not file_in_stable_zcube(f, zorder_by, cube_sizes)
+        ]
     metrics = OptimizeMetrics()
 
     # group per partition (bins never span partitions)
@@ -146,6 +179,16 @@ def _run_optimize(
             adds = _rewrite_bin(
                 table, snapshot, bin_files, zorder_by, curve, max_file_size
             )
+            if zcube_tags is not None:
+                import dataclasses
+
+                adds = [
+                    dataclasses.replace(
+                        a, tags={**(a.tags or {}), **zcube_tags},
+                        clusteringProvider="liquid",
+                    )
+                    for a in adds
+                ]
             new_adds.extend(adds)
             removed.extend(bin_files)
             metrics.num_bins += 1
@@ -161,7 +204,8 @@ def _run_optimize(
     txn.set_operation_parameters(
         {
             "predicate": repr(filter) if filter is not None else "[]",
-            "zOrderBy": list(zorder_by) if zorder_by else [],
+            "zOrderBy": list(zorder_by) if zorder_by and zcube_tags is None else [],
+            "clusterBy": list(zorder_by) if zcube_tags is not None else [],
             "auto": False,
         }
     )

@@ -90,16 +90,15 @@ def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = 
 
 def clone(source_table, dest_path: str, shallow: bool = True,
           properties: Optional[Dict[str, str]] = None) -> int:
-    """Shallow clone: dest commits AddFiles with absolute paths into the
-    source table's data. Returns the dest commit version."""
-    if not shallow:
-        raise DeltaError("deep clone not implemented; copy files + convert")
+    """CLONE. Shallow: dest commits AddFiles with absolute paths into the
+    source table's data. Deep: data files are copied into the destination
+    and re-added under their relative paths (`CloneTableBase.scala`
+    shallow/deep modes). Returns the dest commit version."""
     snap = source_table.latest_snapshot()
     dest = Table.for_path(dest_path, source_table.engine)
     if dest.exists():
         raise DeltaError(f"clone destination {dest_path} already exists")
     meta = snap.metadata
-    import uuid as _uuid
 
     new_conf = dict(meta.configuration)
     new_conf.update(properties or {})
@@ -113,12 +112,47 @@ def clone(source_table, dest_path: str, shallow: bool = True,
     import dataclasses
 
     src_root = source_table.path
-    for a in snap.state.add_files():
+    fs = source_table.engine.fs
+    used_rel: set = set()
+    copied_dvs: set = set()
+    for i, a in enumerate(snap.state.add_files()):
         p = a.path
         abs_path = p if ("://" in p or p.startswith("/")) else f"{src_root}/{p}"
-        txn.add_file(dataclasses.replace(a, path=abs_path, dataChange=True))
+        if shallow:
+            txn.add_file(dataclasses.replace(a, path=abs_path, dataChange=True))
+            continue
+        # deep: materialize the bytes under the destination root,
+        # preserving the relative layout (partition dirs). Absolute
+        # source paths get fresh unique names — basenames from different
+        # directories may collide.
+        if "://" not in p and not p.startswith("/") and p not in used_rel:
+            rel = p
+        else:
+            base = p.rsplit("/", 1)[-1]
+            rel = f"part-{i:05d}-{base}"
+        used_rel.add(rel)
+        target = f"{dest.path}/{rel}"
+        parent = target.rsplit("/", 1)[0]
+        fs.mkdirs(parent)
+        fs.write_file(target, fs.read_file(abs_path))
+        dv = a.deletionVector
+        if dv is not None and dv.storageType == "u":
+            # the DV bitmap file is table-root-relative: copy it so the
+            # clone stays self-contained (CloneTableBase deep semantics)
+            from delta_tpu.dv.descriptor import absolute_dv_path
+
+            row = {"storageType": dv.storageType,
+                   "pathOrInlineDv": dv.pathOrInlineDv}
+            src_dv = absolute_dv_path(src_root, row)
+            dst_dv = absolute_dv_path(dest.path, row)
+            if src_dv not in copied_dvs:
+                copied_dvs.add(src_dv)
+                fs.mkdirs(dst_dv.rsplit("/", 1)[0])
+                fs.write_file(dst_dv, fs.read_file(src_dv))
+        txn.add_file(dataclasses.replace(a, path=rel, dataChange=True))
     txn.set_operation_parameters(
-        {"source": src_root, "sourceVersion": snap.version, "isShallow": True}
+        {"source": src_root, "sourceVersion": snap.version,
+         "isShallow": shallow}
     )
     return txn.commit().version
 

@@ -79,6 +79,9 @@ class HostFileSystemClient(FileSystemClient):
     def read_file(self, path: str) -> bytes:
         return self._store_for(path).read(path)
 
+    def write_file(self, path: str, data: bytes) -> None:
+        self._store_for(path).write(path, data, overwrite=True)
+
     def resolve_path(self, path: str) -> str:
         return path
 

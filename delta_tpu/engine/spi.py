@@ -57,6 +57,12 @@ class FileSystemClient:
     def read_file(self, path: str) -> bytes:
         raise NotImplementedError
 
+    def write_file(self, path: str, data: bytes) -> None:
+        """Non-atomic data-file write (data files are immutable once
+        committed; atomicity is only required for the log, via
+        JsonHandler.write_json_file_atomically)."""
+        raise NotImplementedError
+
     def resolve_path(self, path: str) -> str:
         raise NotImplementedError
 

@@ -48,13 +48,48 @@ CHECK_CONSTRAINTS = _feature("checkConstraints", 1, 3, False, legacy=True)
 CHANGE_DATA_FEED = _feature(
     "changeDataFeed", 1, 4, False, _conf_true("delta.enableChangeDataFeed"), legacy=True
 )
-GENERATED_COLUMNS = _feature("generatedColumns", 1, 4, False, legacy=True)
+def _schema_has_metadata_key(predicate):
+    """Activation by field-metadata key on any (nested) schema field —
+    exact, not a substring probe of the serialized JSON."""
+
+    def walk(fields):
+        for f in fields:
+            md = f.get("metadata") or {}
+            if any(predicate(k) for k in md):
+                return True
+            t = f.get("type")
+            if isinstance(t, dict) and t.get("type") == "struct":
+                if walk(t.get("fields", [])):
+                    return True
+        return False
+
+    def check(m):
+        import json as _json
+
+        if not m.schemaString:
+            return False
+        try:
+            schema = _json.loads(m.schemaString)
+        except ValueError:
+            return False
+        return walk(schema.get("fields", []))
+
+    return check
+
+
+GENERATED_COLUMNS = _feature(
+    "generatedColumns", 1, 4, False,
+    _schema_has_metadata_key(lambda k: k == "delta.generationExpression"),
+    legacy=True)
 COLUMN_MAPPING = _feature(
     "columnMapping", 2, 5, True,
     lambda m: m.configuration.get("delta.columnMapping.mode", "none") != "none",
     legacy=True,
 )
-IDENTITY_COLUMNS = _feature("identityColumns", 1, 6, False, legacy=True)
+IDENTITY_COLUMNS = _feature(
+    "identityColumns", 1, 6, False,
+    _schema_has_metadata_key(lambda k: k.startswith("delta.identity.")),
+    legacy=True)
 DELETION_VECTORS = _feature(
     "deletionVectors", 3, 7, True, _conf_true("delta.enableDeletionVectors")
 )
@@ -74,17 +109,22 @@ IN_COMMIT_TIMESTAMP = _feature(
 VACUUM_PROTOCOL_CHECK = _feature("vacuumProtocolCheck", 3, 7, True)
 CLUSTERING = _feature("clustering", 1, 7, False)
 VARIANT_TYPE = _feature("variantType", 3, 7, True)
-ALLOW_COLUMN_DEFAULTS = _feature("allowColumnDefaults", 1, 7, False)
+ALLOW_COLUMN_DEFAULTS = _feature(
+    "allowColumnDefaults", 1, 7, False,
+    _schema_has_metadata_key(lambda k: k == "CURRENT_DEFAULT"))
 
 
 SUPPORTED_WRITER_FEATURES = frozenset(FEATURES)
 MAX_WRITER_VERSION = 7
 
 
-def protocol_for_new_table(configuration: Dict[str, str]) -> Protocol:
+def protocol_for_new_table(
+    configuration: Dict[str, str], schema_string: Optional[str] = None
+) -> Protocol:
     """Minimal protocol satisfying the features activated by the given
-    table properties (reference `Protocol.forNewTable` semantics)."""
-    meta = Metadata(id="", configuration=dict(configuration))
+    table properties / schema (reference `Protocol.forNewTable`)."""
+    meta = Metadata(id="", schemaString=schema_string or "",
+                    configuration=dict(configuration))
     needed = [f for f in FEATURES.values() if f.activated_by and f.activated_by(meta)]
     min_reader, min_writer = 1, 2
     for f in needed:

@@ -39,7 +39,7 @@ def _cache_dir() -> str:
     return os.path.join(xdg, "delta_tpu_native")
 
 
-def _build() -> Optional[str]:
+def _build(allow_compile: bool = True) -> Optional[str]:
     with open(_SRC, "rb") as f:
         src = f.read()
     tag = hashlib.sha256(src).hexdigest()[:16]
@@ -47,6 +47,8 @@ def _build() -> Optional[str]:
     lib_path = os.path.join(out_dir, f"libactionscan-{tag}.so")
     if os.path.exists(lib_path):
         return lib_path
+    if not allow_compile:
+        return None
     os.makedirs(out_dir, exist_ok=True)
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
     os.close(fd)
@@ -66,21 +68,27 @@ def _build() -> Optional[str]:
         return None
 
 
-def load() -> Optional[ctypes.CDLL]:
+def load(allow_compile: bool = True) -> Optional[ctypes.CDLL]:
     """Compile (once, cached) and load the native library; None if the
-    toolchain is unavailable. Safe to call from any thread."""
+    toolchain is unavailable. Safe to call from any thread. With
+    allow_compile=False only a pre-built cached library is loaded —
+    callers on a latency-sensitive path use this so a cold cache never
+    blocks on a g++ subprocess."""
     global _LIB, _TRIED
     if _LIB is not None or _TRIED:
         return _LIB
     with _LOCK:
         if _LIB is not None or _TRIED:
             return _LIB
-        _TRIED = True
         if os.environ.get("DELTA_TPU_DISABLE_NATIVE"):
+            _TRIED = True
             return None
-        path = _build()
+        path = _build(allow_compile)
         if path is None:
+            # only a definitive failure (compile attempted) is final
+            _TRIED = allow_compile
             return None
+        _TRIED = True
         try:
             lib = ctypes.CDLL(path)
         except OSError:
@@ -99,8 +107,13 @@ def load() -> Optional[ctypes.CDLL]:
         return _LIB
 
 
-def available() -> bool:
-    return load() is not None
+def available(allow_compile: bool = True) -> bool:
+    return load(allow_compile) is not None
+
+
+# buffers below this size parse in negligible time either way — not
+# worth triggering a first-time g++ compile on the read path
+MIN_BYTES_FOR_COLD_BUILD = 4 << 20
 
 
 def _np(lib, h, which: int, n: int, dtype) -> np.ndarray:

@@ -561,7 +561,10 @@ def columnarize_log_segment(
             buf, starts, version_arr = read
             from delta_tpu import native as _native
 
-            if _native.available():
+            # a cold g++ build is only worth blocking on for buffers
+            # where the native scanner meaningfully wins
+            allow_compile = int(starts[-1]) >= _native.MIN_BYTES_FOR_COLD_BUILD
+            if _native.available(allow_compile):
                 from delta_tpu.replay.native_parse import parse_commits_native
 
                 parsed_native = parse_commits_native(buf, starts, version_arr)

@@ -1,0 +1,117 @@
+"""Streaming schema-tracking log.
+
+Reference `DeltaSourceMetadataTrackingLog.scala` +
+`DeltaSourceMetadataEvolutionSupport.scala`: a stream that must survive
+schema evolution persists each observed table-metadata change into its
+own little log next to the streaming checkpoint
+(`<checkpoint>/_schema_log_<tableId>/%020d.json`, put-if-absent writes).
+When the source hits a commit whose metaData changes the read schema, it
+appends the new entry and stops the stream; the restarted stream reads
+the latest entry and uses it as the authoritative read schema for
+batches that follow.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from delta_tpu.errors import DeltaError
+
+
+class SchemaEvolutionRequiresRestart(DeltaError):
+    """The source persisted a new schema; restart the stream to adopt it."""
+
+
+@dataclass
+class PersistedMetadata:
+    """One schema-log entry: the table schema as of a commit version."""
+
+    delta_commit_version: int
+    schema_string: str
+    partition_columns: list
+    configuration: dict
+    seq_num: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "deltaCommitVersion": self.delta_commit_version,
+                "schemaString": self.schema_string,
+                "partitionColumns": self.partition_columns,
+                "configuration": self.configuration,
+            },
+            sort_keys=True,
+        )
+
+    @staticmethod
+    def from_json(s: str, seq_num: int) -> "PersistedMetadata":
+        d = json.loads(s)
+        return PersistedMetadata(
+            delta_commit_version=d["deltaCommitVersion"],
+            schema_string=d["schemaString"],
+            partition_columns=d.get("partitionColumns", []),
+            configuration=d.get("configuration", {}),
+            seq_num=seq_num,
+        )
+
+
+class SchemaTrackingLog:
+    """Sequential JSON entries under
+    `<location>/_schema_log_<table_id>/`, written with the LogStore
+    put-if-absent primitive (concurrent streams race safely)."""
+
+    def __init__(self, engine, location: str, table_id: str):
+        self._engine = engine
+        self._dir = f"{location.rstrip('/')}/_schema_log_{table_id}"
+
+    def _entry_path(self, seq: int) -> str:
+        return f"{self._dir}/{seq:020d}.json"
+
+    def entries(self) -> list:
+        fs = self._engine.fs
+        out = []
+        try:
+            # listFrom contract: list the parent dir from a child path
+            listing = sorted(fs.list_from(self._entry_path(0)),
+                             key=lambda f: f.path)
+        except FileNotFoundError:
+            return out
+        for st in listing:
+            name = st.path.rsplit("/", 1)[-1]
+            if not name.endswith(".json"):
+                continue
+            try:
+                seq = int(name[:-5])
+            except ValueError:
+                continue
+            out.append(
+                PersistedMetadata.from_json(
+                    fs.read_file(st.path).decode("utf-8"), seq))
+        return out
+
+    def latest(self) -> Optional[PersistedMetadata]:
+        entries = self.entries()
+        return entries[-1] if entries else None
+
+    def append(self, entry: PersistedMetadata) -> PersistedMetadata:
+        """Write the next sequential entry (put-if-absent; loser of a
+        race re-reads and returns the winner when identical)."""
+        from delta_tpu.storage.logstore import logstore_for_path
+
+        cur = self.latest()
+        seq = 0 if cur is None else cur.seq_num + 1
+        entry.seq_num = seq
+        path = self._entry_path(seq)
+        store = logstore_for_path(path)
+        store.mkdirs(self._dir)
+        try:
+            store.write(path, entry.to_json().encode("utf-8"), overwrite=False)
+        except FileExistsError:
+            winner = PersistedMetadata.from_json(
+                self._engine.fs.read_file(path).decode("utf-8"), seq)
+            if winner.schema_string != entry.schema_string:
+                raise
+            return winner
+        return entry

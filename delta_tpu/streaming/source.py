@@ -80,6 +80,7 @@ class DeltaSource:
         starting_version: Optional[int] = None,
         ignore_deletes: bool = False,
         ignore_changes: bool = False,
+        schema_tracking_log=None,
     ):
         self.table = table
         self.ignore_deletes = ignore_deletes
@@ -87,18 +88,40 @@ class DeltaSource:
         self._starting_version = starting_version
         self._initial_files: Optional[List[AddFile]] = None
         self._initial_version: Optional[int] = None
+        # schema evolution across the stream's lifetime
+        # (DeltaSourceMetadataTrackingLog semantics): None = fail on any
+        # read-incompatible metadata change mid-stream
+        self.schema_log = schema_tracking_log
+        self._tracked_schema: Optional[str] = None
+        if schema_tracking_log is not None:
+            latest = schema_tracking_log.latest()
+            if latest is not None:
+                self._tracked_schema = latest.schema_string
 
     # -- initial snapshot ---------------------------------------------------
 
     def _ensure_initial(self) -> None:
         if self._initial_version is not None:
             return
+        snap = self.table.latest_snapshot()
+        if self._tracked_schema is None:
+            # the schema this stream was started against — the baseline
+            # for mid-stream metadata-change detection. With a
+            # starting_version the baseline is the schema AS OF that
+            # version (replayed metaData actions before the change must
+            # not trip the detector).
+            baseline = snap
+            if self._starting_version is not None:
+                try:
+                    baseline = self.table.snapshot_at(self._starting_version)
+                except Exception:
+                    baseline = snap  # version expired: best effort
+            self._tracked_schema = baseline.metadata.schemaString
         if self._starting_version is not None:
             # start tailing from a version: no initial snapshot
             self._initial_version = self._starting_version - 1
             self._initial_files = []
             return
-        snap = self.table.latest_snapshot()
         files = snap.state.add_files()
         files.sort(key=lambda f: (f.modificationTime, f.path))
         self._initial_files = files
@@ -125,8 +148,49 @@ class DeltaSource:
                         "or use the CDC reader"
                     )
             elif isinstance(a, Metadata):
-                pass  # schema evolution checks: future (schema tracking log)
+                self._on_metadata_action(a, version)
         return adds
+
+    def _on_metadata_action(self, meta: Metadata, version: int) -> None:
+        """Mid-stream metaData action: adopt silently if it matches the
+        tracked schema; persist + stop otherwise (reference
+        `DeltaSourceMetadataEvolutionSupport`)."""
+        baseline = self._tracked_schema
+        if baseline is None or meta.schemaString == baseline:
+            return
+        if self.schema_log is None:
+            from delta_tpu.errors import DeltaError
+
+            raise DeltaError(
+                f"table schema changed at version {version}; restart the "
+                "stream (attach a SchemaTrackingLog to evolve automatically)"
+            )
+        from delta_tpu.streaming.schema_log import (
+            PersistedMetadata,
+            SchemaEvolutionRequiresRestart,
+        )
+
+        self.schema_log.append(
+            PersistedMetadata(
+                delta_commit_version=version,
+                schema_string=meta.schemaString,
+                partition_columns=list(meta.partitionColumns or []),
+                configuration=dict(meta.configuration or {}),
+            )
+        )
+        raise SchemaEvolutionRequiresRestart(
+            f"schema change at version {version} persisted to the schema "
+            "log; restart the stream to continue with the new schema"
+        )
+
+    def read_schema(self):
+        """The schema batches are read with: the tracked schema when a
+        schema log has entries, else the table's current schema."""
+        from delta_tpu.models.schema import schema_from_json
+
+        if self._tracked_schema is not None:
+            return schema_from_json(self._tracked_schema)
+        return self.table.latest_snapshot().metadata.schema
 
     def _indexed_after(
         self, start: Optional[DeltaSourceOffset], limits: ReadLimits

@@ -82,6 +82,7 @@ class Operation:
     VACUUM_END = "VACUUM END"
     TRUNCATE = "TRUNCATE"
     CONVERT = "CONVERT"
+    CLUSTER_BY = "CLUSTER BY"
     MANUAL_UPDATE = "Manual Update"
 
 
@@ -179,7 +180,8 @@ class TransactionBuilder:
                 createdTime=int(time.time() * 1000),
             )
             txn.update_metadata(metadata)
-            txn.update_protocol(protocol_for_new_table(props))
+            txn.update_protocol(
+                protocol_for_new_table(props, metadata.schemaString))
         elif self._table_properties:
             meta = snapshot.metadata
             new_conf = dict(meta.configuration)

@@ -1,0 +1,240 @@
+"""Column DEFAULTs, liquid clustering, row-tracking backfill, deep
+clone, and the streaming schema-tracking log."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.colgen import default_field
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.schema import LONG, STRING, StructField, StructType
+from delta_tpu.table import Table
+
+
+def _write(path, start, n, extra_cols=None):
+    cols = {"id": pa.array(np.arange(start, start + n, dtype=np.int64)),
+            "v": pa.array(np.full(n, float(start)))}
+    cols.update(extra_cols or {})
+    dta.write_table(path, pa.table(cols), mode="append")
+
+
+# ---------------------------------------------------------------- defaults
+
+def test_column_defaults(tmp_table_path):
+    schema = StructType([
+        StructField("id", LONG, nullable=False),
+        default_field("status", STRING, "'active'"),
+        default_field("score", LONG, "100"),
+    ])
+    t = Table.for_path(tmp_table_path)
+    t.create_transaction_builder().with_schema(schema).build().commit()
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2], pa.int64())}),
+                    mode="append")
+    rows = dta.read_table(tmp_table_path)
+    assert rows.column("status").to_pylist() == ["active", "active"]
+    assert rows.column("score").to_pylist() == [100, 100]
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert "allowColumnDefaults" in (snap.protocol.writerFeatures or [])
+    # explicit values win over the default
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([3], pa.int64()),
+                              "status": pa.array(["x"]),
+                              "score": pa.array([7], pa.int64())}),
+                    mode="append")
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("score").to_pylist()) == [7, 100, 100]
+
+
+# ---------------------------------------------------------------- clustering
+
+def test_liquid_clustering_optimize(tmp_table_path):
+    from delta_tpu.clustering import (
+        CLUSTERING_DOMAIN,
+        ZCUBE_ID_TAG,
+        clustering_columns,
+        set_clustering_columns,
+    )
+
+    for i in range(3):
+        _write(tmp_table_path, i * 10, 10)
+    table = Table.for_path(tmp_table_path)
+    set_clustering_columns(table, ["id"])
+    snap = table.latest_snapshot()
+    assert clustering_columns(snap) == ["id"]
+    assert "clustering" in (snap.protocol.writerFeatures or [])
+    assert CLUSTERING_DOMAIN in snap.state.visible_domain_metadata()
+
+    # plain OPTIMIZE clusters by the domain columns and tags outputs
+    m = table.optimize().execute_compaction()
+    assert m.num_files_removed == 3 and m.num_files_added >= 1
+    snap = table.latest_snapshot()
+    adds = snap.state.add_files()
+    assert all((a.tags or {}).get(ZCUBE_ID_TAG) for a in adds)
+    assert all(a.clusteringProvider == "liquid" for a in adds)
+    # data intact and clustered (sorted by id within the file)
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("id").to_pylist()) == list(range(30))
+
+    # explicit ZORDER BY on a clustered table is rejected
+    with pytest.raises(DeltaError):
+        table.optimize().execute_zorder_by("v")
+
+    # CLUSTER BY NONE removes the domain
+    set_clustering_columns(table, [])
+    assert clustering_columns(Table.for_path(tmp_table_path).latest_snapshot()) is None
+
+
+def test_stable_zcube_skip():
+    from delta_tpu.clustering import (
+        DEFAULT_MIN_CUBE_SIZE,
+        file_in_stable_zcube,
+        new_zcube_tags,
+    )
+    from delta_tpu.models.actions import AddFile
+
+    tags = new_zcube_tags(["id"], "zorder")
+    f = AddFile(path="p", partitionValues={}, size=10,
+                modificationTime=0, dataChange=False, tags=tags)
+    cube = tags["ZCUBE_ID"]
+    assert not file_in_stable_zcube(f, ["id"], {cube: 10})
+    assert file_in_stable_zcube(f, ["id"], {cube: DEFAULT_MIN_CUBE_SIZE})
+    assert not file_in_stable_zcube(f, ["other"], {cube: DEFAULT_MIN_CUBE_SIZE})
+
+
+# ---------------------------------------------------------------- backfill
+
+def test_row_tracking_backfill(tmp_table_path):
+    from delta_tpu.commands.backfill import backfill_row_tracking
+    from delta_tpu.rowtracking import ROW_TRACKING_DOMAIN, current_high_watermark
+
+    for i in range(3):
+        _write(tmp_table_path, i * 10, 10)
+    table = Table.for_path(tmp_table_path)
+    snap = table.latest_snapshot()
+    assert all(a.baseRowId is None for a in snap.state.add_files())
+
+    m = backfill_row_tracking(table, batch_size=2)
+    assert m.num_files_backfilled == 3
+    assert m.num_batches == 2  # 2 + 1
+
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    adds = snap.state.add_files()
+    ids = sorted(a.baseRowId for a in adds)
+    assert all(b is not None for b in ids)
+    # ranges must not overlap (each file spans numRecords ids)
+    assert len(set(ids)) == len(ids)
+    assert snap.metadata.configuration.get("delta.enableRowTracking") == "true"
+    assert "rowTracking" in (snap.protocol.writerFeatures or [])
+    assert ROW_TRACKING_DOMAIN in snap.state.domain_metadata
+    assert current_high_watermark(snap) >= 29
+    # idempotent
+    m2 = backfill_row_tracking(table)
+    assert m2.num_files_backfilled == 0
+
+
+# ---------------------------------------------------------------- deep clone
+
+def test_deep_clone(tmp_path):
+    from delta_tpu.commands.restore import clone
+
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    _write(src, 0, 10)
+    _write(src, 10, 10)
+    src_table = Table.for_path(src)
+    clone(src_table, dst, shallow=False)
+
+    rows = dta.read_table(dst)
+    assert sorted(rows.column("id").to_pylist()) == list(range(20))
+    # deep clone is self-contained: paths are relative, files materialized
+    snap = Table.for_path(dst).latest_snapshot()
+    for a in snap.state.add_files():
+        assert not a.path.startswith("/") and "://" not in a.path
+    # destroying the source must not break the clone
+    import shutil
+
+    shutil.rmtree(src)
+    assert sorted(dta.read_table(dst).column("id").to_pylist()) == list(range(20))
+
+
+def test_deep_clone_copies_deletion_vectors(tmp_path):
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.commands.restore import clone
+    from delta_tpu.expressions.tree import col, lit
+
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    _write(src, 0, 10)
+    table = Table.for_path(src)
+    from delta_tpu.commands.alter import set_properties
+
+    set_properties(table, {"delta.enableDeletionVectors": "true"})
+    delete(Table.for_path(src), predicate=col("id") < lit(3))
+    snap = Table.for_path(src).latest_snapshot()
+    assert any(a.deletionVector is not None for a in snap.state.add_files())
+
+    clone(Table.for_path(src), dst, shallow=False)
+    import shutil
+
+    shutil.rmtree(src)
+    rows = dta.read_table(dst)
+    assert sorted(rows.column("id").to_pylist()) == list(range(3, 10))
+
+
+# ------------------------------------------------------- schema tracking log
+
+def test_schema_tracking_log(tmp_path):
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.streaming import DeltaSource
+    from delta_tpu.streaming.schema_log import (
+        SchemaEvolutionRequiresRestart,
+        SchemaTrackingLog,
+    )
+    from delta_tpu.engine.host import HostEngine
+
+    path = str(tmp_path / "t")
+    ckpt = str(tmp_path / "ckpt")
+    _write(path, 0, 5)
+    table = Table.for_path(path)
+    engine = table.engine
+    log = SchemaTrackingLog(engine, ckpt, table.latest_snapshot().metadata.id)
+
+    src = DeltaSource(table, schema_tracking_log=log)
+    off0 = src.latest_offset(None)
+    assert src.get_batch(None, off0).num_rows == 5
+
+    # mid-stream schema change + new data
+    add_columns(table, [StructField("extra", STRING)])
+    _write(path, 10, 5, {"extra": pa.array(["e"] * 5)})
+
+    with pytest.raises(SchemaEvolutionRequiresRestart):
+        off1 = src.latest_offset(off0)
+        src.get_batch(off0, off1)
+    assert log.latest() is not None
+
+    # restarted stream adopts the evolved schema and continues
+    src2 = DeltaSource(table, schema_tracking_log=log)
+    off1 = src2.latest_offset(off0)
+    batch = src2.get_batch(off0, off1)
+    assert batch.num_rows == 5
+    assert "extra" in src2.read_schema()
+
+
+def test_schema_change_without_log_fails(tmp_path):
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.streaming import DeltaSource
+
+    path = str(tmp_path / "t")
+    _write(path, 0, 5)
+    table = Table.for_path(path)
+    src = DeltaSource(table)
+    off0 = src.latest_offset(None)
+    src.get_batch(None, off0)
+
+    add_columns(table, [StructField("extra", STRING)])
+    _write(path, 10, 5, {"extra": pa.array(["e"] * 5)})
+    with pytest.raises(DeltaError):
+        off1 = src.latest_offset(off0)
+        src.get_batch(off0, off1)
