@@ -1,0 +1,150 @@
+"""In-process table catalog: name → location metastore.
+
+The reference's `catalog/DeltaCatalog.scala` delegates table-name
+resolution to the Spark/Hive metastore; here the same role is a tiny
+file-backed registry. Each table is one JSON entry file
+`<root>/_catalog/<name>.json` written with the LogStore put-if-absent
+primitive, so CREATE TABLE is atomic under concurrent writers and DROP
+is a single delete — no read-modify-write races, same durability story
+as the `_delta_log` itself.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Dict, List, Optional
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.table import Table
+
+_NAME_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)?$")
+
+
+class TableAlreadyExistsError(DeltaError):
+    pass
+
+
+class TableNotInCatalogError(DeltaError):
+    pass
+
+
+class Catalog:
+    def __init__(self, root: str, engine=None):
+        if engine is None:
+            from delta_tpu.engine.tpu import default_engine
+
+            engine = default_engine()
+        self.engine = engine
+        self.root = root.rstrip("/")
+        self._dir = f"{self.root}/_catalog"
+
+    def _entry_path(self, name: str) -> str:
+        if not _NAME_RE.match(name):
+            raise DeltaError(f"invalid table name: {name!r}")
+        return f"{self._dir}/{name}.json"
+
+    def _default_location(self, name: str) -> str:
+        return f"{self.root}/{name.replace('.', '/')}"
+
+    # -- mutation ----------------------------------------------------------
+
+    def create_table(
+        self,
+        name: str,
+        schema=None,
+        location: Optional[str] = None,
+        partition_by: Optional[List[str]] = None,
+        cluster_by: Optional[List[str]] = None,
+        properties: Optional[Dict[str, str]] = None,
+        if_not_exists: bool = False,
+    ) -> Table:
+        """Create (or register, when `location` points at an existing
+        Delta table and no schema is given) a named table."""
+        from delta_tpu.storage.logstore import logstore_for_path
+
+        entry = self._entry_path(name)
+        loc = (location or self._default_location(name)).rstrip("/")
+        store = logstore_for_path(entry)
+        store.mkdirs(self._dir)
+        payload = json.dumps(
+            {"location": loc, "createdAt": int(time.time() * 1000)},
+            sort_keys=True,
+        ).encode()
+        try:
+            store.write(entry, payload, overwrite=False)
+        except FileExistsError:
+            if if_not_exists:
+                return self.table(name)
+            raise TableAlreadyExistsError(f"table {name} already exists")
+
+        table = Table.for_path(loc, self.engine)
+        if schema is not None and not table.exists():
+            builder = (
+                table.create_transaction_builder()
+                .with_schema(schema)
+                .with_partition_columns(partition_by or [])
+                .with_table_properties(properties or {})
+            )
+            builder.build().commit()
+            if cluster_by:
+                from delta_tpu.clustering import set_clustering_columns
+
+                set_clustering_columns(table, cluster_by)
+        elif schema is None and not table.exists():
+            self.engine.fs.delete(entry)
+            raise DeltaError(
+                f"no Delta table at {loc}; provide a schema to create one"
+            )
+        return table
+
+    def register(self, name: str, path: str) -> Table:
+        """Register an existing Delta table under a name."""
+        t = Table.for_path(path, self.engine)
+        if not t.exists():
+            raise DeltaError(f"no Delta table at {path}")
+        return self.create_table(name, location=path)
+
+    def drop(self, name: str, if_exists: bool = False,
+             delete_data: bool = False) -> bool:
+        entry = self._entry_path(name)
+        fs = self.engine.fs
+        if not fs.exists(entry):
+            if if_exists:
+                return False
+            raise TableNotInCatalogError(f"table {name} not found")
+        loc = self._location(name)
+        fs.delete(entry)
+        if delete_data and loc.startswith(self.root + "/"):
+            import shutil
+
+            shutil.rmtree(loc, ignore_errors=True)
+        return True
+
+    # -- resolution --------------------------------------------------------
+
+    def _location(self, name: str) -> str:
+        entry = self._entry_path(name)
+        try:
+            return json.loads(self.engine.fs.read_file(entry))["location"]
+        except FileNotFoundError:
+            raise TableNotInCatalogError(f"table {name} not found") from None
+
+    def table(self, name: str) -> Table:
+        return Table.for_path(self._location(name), self.engine)
+
+    def exists(self, name: str) -> bool:
+        return self.engine.fs.exists(self._entry_path(name))
+
+    def tables(self) -> List[str]:
+        try:
+            listing = self.engine.fs.list_from(f"{self._dir}/")
+        except FileNotFoundError:
+            return []
+        out = []
+        for st in listing:
+            base = st.path.rsplit("/", 1)[-1]
+            if base.endswith(".json"):
+                out.append(base[:-5])
+        return sorted(out)

@@ -1,25 +1,38 @@
 """Delta SQL statement surface.
 
 The reference extends Spark SQL with delta-specific statements
-(`DeltaSqlBase.g4:74-95`). This module provides the same statement set
-over table *paths* (there is no external catalog in-process):
+(`DeltaSqlBase.g4:74-95`) and resolves table names through its catalog
+(`catalog/DeltaCatalog.scala`). This module provides the same statement
+set over table *paths*, or over *names* when a `Catalog` is passed:
 
-    VACUUM '/path' [RETAIN n HOURS] [DRY RUN]
-    OPTIMIZE '/path' [WHERE <pred>] [ZORDER BY (c1, c2)]
-    DESCRIBE HISTORY '/path' [LIMIT n]
-    DESCRIBE DETAIL '/path'
-    RESTORE TABLE '/path' TO VERSION AS OF n
-    RESTORE TABLE '/path' TO TIMESTAMP AS OF <ms|'iso'>
+    VACUUM <t> [RETAIN n HOURS] [DRY RUN]
+    OPTIMIZE <t> [WHERE <pred>] [ZORDER BY (c1, c2)]
+    DESCRIBE HISTORY <t> [LIMIT n]
+    DESCRIBE DETAIL <t>
+    RESTORE TABLE <t> TO VERSION AS OF n
+    RESTORE TABLE <t> TO TIMESTAMP AS OF <ms|'iso'>
     CONVERT TO DELTA parquet.'/path' [PARTITIONED BY (c type, ...)]
-    ALTER TABLE '/path' ADD CONSTRAINT name CHECK (<pred>)
-    ALTER TABLE '/path' DROP CONSTRAINT [IF EXISTS] name
+    ALTER TABLE <t> ADD CONSTRAINT name CHECK (<pred>)
+    ALTER TABLE <t> DROP CONSTRAINT [IF EXISTS] name
+    ALTER TABLE <t> CLUSTER BY (c1, c2) | CLUSTER BY NONE
+    ALTER TABLE <t> SET TBLPROPERTIES (k = v, ...)
 
-Plus (not in the reference grammar, for symmetry with our API):
-    DELETE FROM '/path' [WHERE <pred>]
-    UPDATE '/path' SET col = <literal>[, ...] [WHERE <pred>]
+Catalog statements (require `catalog=`):
+    CREATE TABLE [IF NOT EXISTS] name (col type, ...) USING DELTA
+        [PARTITIONED BY (c1, ...)] [CLUSTER BY (c1, ...)]
+        [LOCATION '/path'] [TBLPROPERTIES (k = v, ...)]
+    DROP TABLE [IF EXISTS] name
+    SHOW TABLES
 
-Returns command-specific results (VacuumResult, OptimizeMetrics, history
-records as dicts, an Arrow table for DESCRIBE DETAIL, commit versions...).
+Query/DML (paths or names):
+    SELECT <cols|*> FROM <t> [WHERE <pred>] [LIMIT n]
+    INSERT INTO <t> VALUES (v1, v2, ...)[, (...)]
+    DELETE FROM <t> [WHERE <pred>]
+    UPDATE <t> SET col = <literal>[, ...] [WHERE <pred>]
+
+`<t>` = '/path', delta.`/path`, "/path", or a bare identifier resolved
+through the catalog. Returns command-specific results (VacuumResult,
+OptimizeMetrics, history dicts, Arrow tables for SELECT...).
 WHERE/CHECK predicates use the persisted-expression subset
 (`expressions/parser.py`).
 """
@@ -33,20 +46,47 @@ from delta_tpu.errors import DeltaError
 from delta_tpu.expressions.parser import parse_expression
 from delta_tpu.table import Table
 
-_PATH = r"(?:'(?P<path>[^']+)'|delta\.`(?P<path2>[^`]+)`|\"(?P<path3>[^\"]+)\")"
+_PATH = (r"(?:'(?P<path>[^']+)'|delta\.`(?P<path2>[^`]+)`|\"(?P<path3>[^\"]+)\""
+         r"|(?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)?))")
+
+_SQL_TYPES = {
+    "int": "integer", "integer": "integer", "bigint": "long", "long": "long",
+    "smallint": "short", "short": "short", "tinyint": "byte", "byte": "byte",
+    "string": "string", "varchar": "string", "text": "string",
+    "double": "double", "float": "float", "real": "float",
+    "boolean": "boolean", "bool": "boolean", "date": "date",
+    "timestamp": "timestamp", "binary": "binary",
+}
 
 
 def _path_of(m) -> str:
     return m.group("path") or m.group("path2") or m.group("path3")
 
 
-def _table(m, engine) -> Table:
+def _table(m, engine, catalog=None) -> Table:
+    ident = m.groupdict().get("ident")
+    if ident is not None:
+        if catalog is None:
+            raise DeltaError(
+                f"table name {ident!r} requires a catalog (pass catalog=)"
+            )
+        return catalog.table(ident)
     return Table.for_path(_path_of(m), engine)
 
 
-def sql(statement: str, engine=None):
-    """Execute one Delta SQL statement against a table path."""
+def sql(statement: str, engine=None, catalog=None):
+    """Execute one Delta SQL statement against a table path or (with a
+    catalog) a table name."""
     s = statement.strip().rstrip(";").strip()
+    if catalog is not None and engine is None:
+        engine = catalog.engine
+
+    result = _catalog_statement(s, engine, catalog)
+    if result is not NotImplemented:
+        return result
+    result = _query_statement(s, engine, catalog)
+    if result is not NotImplemented:
+        return result
 
     m = re.fullmatch(
         rf"VACUUM\s+{_PATH}(?:\s+RETAIN\s+(?P<hours>[\d.]+)\s+HOURS)?"
@@ -57,7 +97,7 @@ def sql(statement: str, engine=None):
         from delta_tpu.commands.vacuum import vacuum
 
         return vacuum(
-            _table(m, engine),
+            _table(m, engine, catalog),
             retention_hours=float(m.group("hours")) if m.group("hours") else None,
             dry_run=m.group("dry") is not None,
         )
@@ -68,7 +108,7 @@ def sql(statement: str, engine=None):
         s, re.IGNORECASE,
     )
     if m:
-        builder = _table(m, engine).optimize()
+        builder = _table(m, engine, catalog).optimize()
         if m.group("where"):
             builder = builder.where(parse_expression(m.group("where")))
         if m.group("zcols"):
@@ -82,11 +122,11 @@ def sql(statement: str, engine=None):
     )
     if m:
         limit = int(m.group("limit")) if m.group("limit") else None
-        return [r.to_dict() for r in _table(m, engine).history(limit)]
+        return [r.to_dict() for r in _table(m, engine, catalog).history(limit)]
 
     m = re.fullmatch(rf"(?:DESC|DESCRIBE)\s+DETAIL\s+{_PATH}", s, re.IGNORECASE)
     if m:
-        return describe_detail(_table(m, engine))
+        return describe_detail(_table(m, engine, catalog))
 
     m = re.fullmatch(
         rf"RESTORE\s+(?:TABLE\s+)?{_PATH}\s+TO\s+VERSION\s+AS\s+OF\s+(?P<v>\d+)",
@@ -95,7 +135,7 @@ def sql(statement: str, engine=None):
     if m:
         from delta_tpu.commands.restore import restore
 
-        return restore(_table(m, engine), version=int(m.group("v")))
+        return restore(_table(m, engine, catalog), version=int(m.group("v")))
 
     m = re.fullmatch(
         rf"RESTORE\s+(?:TABLE\s+)?{_PATH}\s+TO\s+TIMESTAMP\s+AS\s+OF\s+"
@@ -111,7 +151,7 @@ def sql(statement: str, engine=None):
             import datetime as dt
 
             ts = int(dt.datetime.fromisoformat(m.group("iso")).timestamp() * 1000)
-        return restore(_table(m, engine), timestamp_ms=ts)
+        return restore(_table(m, engine, catalog), timestamp_ms=ts)
 
     m = re.fullmatch(
         rf"CONVERT\s+TO\s+DELTA\s+parquet\.{_PATH}"
@@ -138,7 +178,7 @@ def sql(statement: str, engine=None):
     if m:
         from delta_tpu.constraints import add_constraint
 
-        return add_constraint(_table(m, engine), m.group("name"), m.group("expr"))
+        return add_constraint(_table(m, engine, catalog), m.group("name"), m.group("expr"))
 
     m = re.fullmatch(
         rf"ALTER\s+TABLE\s+{_PATH}\s+DROP\s+CONSTRAINT\s+"
@@ -149,7 +189,31 @@ def sql(statement: str, engine=None):
         from delta_tpu.constraints import drop_constraint
 
         return drop_constraint(
-            _table(m, engine), m.group("name"), if_exists=m.group("ife") is not None
+            _table(m, engine, catalog), m.group("name"), if_exists=m.group("ife") is not None
+        )
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+CLUSTER\s+BY\s+"
+        r"(?:\((?P<cols>[^)]+)\)|(?P<none>NONE))",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.clustering import set_clustering_columns
+
+        cols = ([] if m.group("none")
+                else [c.strip().strip("`") for c in m.group("cols").split(",")])
+        return set_clustering_columns(_table(m, engine, catalog), cols)
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+SET\s+TBLPROPERTIES\s*"
+        r"\((?P<props>.+)\)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.alter import set_properties
+
+        return set_properties(
+            _table(m, engine, catalog), _parse_properties(m.group("props"))
         )
 
     m = re.fullmatch(
@@ -160,7 +224,7 @@ def sql(statement: str, engine=None):
         from delta_tpu.commands.dml import delete
 
         pred = parse_expression(m.group("where")) if m.group("where") else None
-        return delete(_table(m, engine), pred)
+        return delete(_table(m, engine, catalog), pred)
 
     m = re.fullmatch(
         rf"UPDATE\s+{_PATH}\s+SET\s+(?P<sets>.+?)(?:\s+WHERE\s+(?P<where>.+))?",
@@ -174,9 +238,148 @@ def sql(statement: str, engine=None):
             col_name, _, value = part.partition("=")
             assignments[col_name.strip().strip("`")] = parse_expression(value.strip())
         pred = parse_expression(m.group("where")) if m.group("where") else None
-        return update(_table(m, engine), assignments, pred)
+        return update(_table(m, engine, catalog), assignments, pred)
 
     raise DeltaError(f"cannot parse Delta SQL statement: {statement!r}")
+
+
+def _parse_properties(text: str) -> dict:
+    """`'k' = 'v', k2 = v2` → dict (quotes optional)."""
+    props = {}
+    for part in _split_top_level_commas(text):
+        k, _, v = part.partition("=")
+        props[k.strip().strip("'\"` ")] = v.strip().strip("'\"` ")
+    return props
+
+
+def _parse_column_defs(text: str):
+    from delta_tpu.models.schema import PrimitiveType, StructField
+
+    fields = []
+    for part in _split_top_level_commas(text):
+        toks = part.strip().split(None, 2)
+        if len(toks) < 2:
+            raise DeltaError(f"cannot parse column definition: {part!r}")
+        name = toks[0].strip("`")
+        typ = _SQL_TYPES.get(toks[1].lower())
+        if typ is None:
+            typ = toks[1].lower()  # decimal(p,s) etc. pass through
+        nullable = True
+        if len(toks) == 3 and re.fullmatch(r"NOT\s+NULL", toks[2].strip(),
+                                           re.IGNORECASE):
+            nullable = False
+        fields.append(StructField(name, PrimitiveType(typ), nullable=nullable))
+    return fields
+
+
+def _catalog_statement(s: str, engine, catalog):
+    m = re.fullmatch(
+        r"CREATE\s+TABLE\s+(?P<ine>IF\s+NOT\s+EXISTS\s+)?"
+        r"(?P<name>[A-Za-z_][A-Za-z0-9_.]*)\s*"
+        r"\((?P<cols>.+?)\)\s*USING\s+DELTA"
+        r"(?:\s+PARTITIONED\s+BY\s+\((?P<parts>[^)]+)\))?"
+        r"(?:\s+CLUSTER\s+BY\s+\((?P<clust>[^)]+)\))?"
+        r"(?:\s+LOCATION\s+'(?P<loc>[^']+)')?"
+        r"(?:\s+TBLPROPERTIES\s*\((?P<props>.+)\))?",
+        s, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        if catalog is None:
+            raise DeltaError("CREATE TABLE <name> requires a catalog")
+        from delta_tpu.models.schema import StructType
+
+        schema = StructType(_parse_column_defs(m.group("cols")))
+        split = lambda g: ([c.strip().strip("`") for c in m.group(g).split(",")]
+                           if m.group(g) else None)
+        catalog.create_table(
+            m.group("name"),
+            schema=schema,
+            location=m.group("loc"),
+            partition_by=split("parts"),
+            cluster_by=split("clust"),
+            properties=_parse_properties(m.group("props")) if m.group("props") else None,
+            if_not_exists=m.group("ine") is not None,
+        )
+        return m.group("name")
+
+    m = re.fullmatch(
+        r"DROP\s+TABLE\s+(?P<ife>IF\s+EXISTS\s+)?"
+        r"(?P<name>[A-Za-z_][A-Za-z0-9_.]*)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        if catalog is None:
+            raise DeltaError("DROP TABLE <name> requires a catalog")
+        return catalog.drop(m.group("name"), if_exists=m.group("ife") is not None)
+
+    if re.fullmatch(r"SHOW\s+TABLES", s, re.IGNORECASE):
+        if catalog is None:
+            raise DeltaError("SHOW TABLES requires a catalog")
+        return catalog.tables()
+
+    return NotImplemented
+
+
+def _query_statement(s: str, engine, catalog):
+    m = re.fullmatch(
+        rf"SELECT\s+(?P<cols>.+?)\s+FROM\s+{_PATH}"
+        r"(?:\s+WHERE\s+(?P<where>.+?))?(?:\s+LIMIT\s+(?P<limit>\d+))?",
+        s, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        import delta_tpu.api as dta
+
+        table = _table(m, engine, catalog)
+        cols_text = m.group("cols").strip()
+        columns = (None if cols_text == "*"
+                   else [c.strip().strip("`")
+                         for c in _split_top_level_commas(cols_text)])
+        pred = parse_expression(m.group("where")) if m.group("where") else None
+        out = dta.read_table(table.path, filter=pred, columns=columns,
+                             engine=table.engine)
+        if m.group("limit"):
+            out = out.slice(0, int(m.group("limit")))
+        return out
+
+    m = re.fullmatch(
+        rf"INSERT\s+INTO\s+{_PATH}\s+VALUES\s+(?P<vals>.+)",
+        s, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        import pyarrow as pa
+
+        import delta_tpu.api as dta
+        from delta_tpu.expressions.tree import Literal
+
+        table = _table(m, engine, catalog)
+        meta = table.latest_snapshot().metadata
+        names = [f.name for f in meta.schema.fields]
+        rows = []
+        for tup in re.findall(r"\(([^)]*)\)", m.group("vals")):
+            vals = []
+            for item in _split_top_level_commas(tup):
+                expr = parse_expression(item.strip())
+                if not isinstance(expr, Literal):
+                    raise DeltaError(
+                        f"INSERT VALUES must be literals, got {item!r}")
+                vals.append(expr.value)
+            rows.append(vals)
+        if not rows:
+            raise DeltaError("INSERT requires at least one VALUES tuple")
+        width = len(rows[0])
+        if any(len(r) != width for r in rows) or width > len(names):
+            raise DeltaError("VALUES tuples must match the table schema")
+        from delta_tpu.models.schema import to_arrow_type
+
+        data = pa.table({
+            n: pa.array([r[i] for r in rows],
+                        to_arrow_type(meta.schema.fields[i].dataType))
+            for i, n in enumerate(names[:width])
+        })
+        return dta.write_table(table.path, data, mode="append",
+                               engine=table.engine)
+
+    return NotImplemented
 
 
 def _split_top_level_commas(s: str):

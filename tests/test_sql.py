@@ -89,3 +89,68 @@ def test_convert_sql(tmp_path):
 def test_bad_statement():
     with pytest.raises(DeltaError):
         sql("FROBNICATE '/x'")
+
+
+# ---------------------------------------------------------------- catalog
+
+def test_catalog_create_insert_select_drop(tmp_path):
+    from delta_tpu.catalog import Catalog, TableAlreadyExistsError
+    from delta_tpu.sql import sql
+    import pytest as _pytest
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE events (id BIGINT NOT NULL, name STRING, score DOUBLE) "
+        "USING DELTA TBLPROPERTIES ('delta.appendOnly' = 'false')", catalog=cat)
+    assert sql("SHOW TABLES", catalog=cat) == ["events"]
+
+    sql("INSERT INTO events VALUES (1, 'a', 1.5), (2, 'b', 2.5)", catalog=cat)
+    out = sql("SELECT * FROM events", catalog=cat)
+    assert out.num_rows == 2
+    out = sql("SELECT name FROM events WHERE id = 2", catalog=cat)
+    assert out.column_names == ["name"] and out.column("name").to_pylist() == ["b"]
+    out = sql("SELECT id, name FROM events LIMIT 1", catalog=cat)
+    assert out.num_rows == 1
+
+    with _pytest.raises(TableAlreadyExistsError):
+        sql("CREATE TABLE events (id BIGINT) USING DELTA", catalog=cat)
+    sql("CREATE TABLE IF NOT EXISTS events (id BIGINT) USING DELTA", catalog=cat)
+
+    assert sql("DESCRIBE DETAIL events", catalog=cat)["numFiles"] == 1
+    sql("DELETE FROM events WHERE id = 1", catalog=cat)
+    assert sql("SELECT * FROM events", catalog=cat).num_rows == 1
+
+    sql("DROP TABLE events", catalog=cat)
+    assert sql("SHOW TABLES", catalog=cat) == []
+    assert sql("DROP TABLE IF EXISTS events", catalog=cat) is False
+
+
+def test_catalog_clustered_create_and_alter(tmp_path):
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.clustering import clustering_columns
+    from delta_tpu.sql import sql
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE c (id BIGINT, v DOUBLE) USING DELTA CLUSTER BY (id)",
+        catalog=cat)
+    t = cat.table("c")
+    assert clustering_columns(t.latest_snapshot()) == ["id"]
+    sql("ALTER TABLE c CLUSTER BY NONE", catalog=cat)
+    assert clustering_columns(cat.table("c").latest_snapshot()) is None
+    sql("ALTER TABLE c SET TBLPROPERTIES ('delta.appendOnly' = 'true')",
+        catalog=cat)
+    conf = cat.table("c").latest_snapshot().metadata.configuration
+    assert conf.get("delta.appendOnly") == "true"
+
+
+def test_catalog_register_existing(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import delta_tpu.api as dta
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.sql import sql
+
+    path = str(tmp_path / "elsewhere")
+    dta.write_table(path, pa.table({"x": pa.array(np.arange(5))}))
+    cat = Catalog(str(tmp_path / "cat"))
+    cat.register("ext", path)
+    assert sql("SELECT * FROM ext", catalog=cat).num_rows == 5
