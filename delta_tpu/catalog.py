@@ -81,17 +81,40 @@ class Catalog:
 
         table = Table.for_path(loc, self.engine)
         if schema is not None and not table.exists():
-            builder = (
-                table.create_transaction_builder()
-                .with_schema(schema)
-                .with_partition_columns(partition_by or [])
-                .with_table_properties(properties or {})
-            )
-            builder.build().commit()
-            if cluster_by:
-                from delta_tpu.clustering import set_clustering_columns
+            import os
 
-                set_clustering_columns(table, cluster_by)
+            local = "://" not in loc
+            dir_existed = local and os.path.isdir(loc)
+            log_existed = local and os.path.isdir(os.path.join(loc, "_delta_log"))
+            try:
+                builder = (
+                    table.create_transaction_builder()
+                    .with_schema(schema)
+                    .with_partition_columns(partition_by or [])
+                    .with_table_properties(properties or {})
+                )
+                builder.build().commit()
+                if cluster_by:
+                    from delta_tpu.clustering import set_clustering_columns
+
+                    set_clustering_columns(table, cluster_by)
+            except BaseException:
+                # don't leave a dangling name → location entry or a
+                # half-created table behind a failed creation: either
+                # would make retries misbehave (name blocked, or retry
+                # skipping schema/clustering because the table exists).
+                # Only remove what THIS call created — never a
+                # pre-existing user directory at an explicit LOCATION.
+                self.engine.fs.delete(entry)
+                if local:
+                    import shutil
+
+                    if not dir_existed:
+                        shutil.rmtree(loc, ignore_errors=True)
+                    elif not log_existed:
+                        shutil.rmtree(os.path.join(loc, "_delta_log"),
+                                      ignore_errors=True)
+                raise
         elif schema is None and not table.exists():
             self.engine.fs.delete(entry)
             raise DeltaError(
@@ -115,11 +138,31 @@ class Catalog:
                 return False
             raise TableNotInCatalogError(f"table {name} not found")
         loc = self._location(name)
-        fs.delete(entry)
-        if delete_data and loc.startswith(self.root + "/"):
+        if delete_data and "://" in loc:
+            # recursive delete is local-FS only (like VACUUM's walker);
+            # failing loudly beats reporting success while retaining data
+            raise DeltaError(
+                f"DROP TABLE ... delete_data is not supported for "
+                f"non-local location {loc!r}; drop without delete_data "
+                f"and remove the data out of band"
+            )
+        if delete_data and not loc.startswith(self.root + "/"):
+            # externally registered table: refuse rather than silently
+            # keep the data after an explicit delete_data request
+            raise DeltaError(
+                f"table {name} is external (location {loc!r} outside the "
+                f"catalog root); drop without delete_data"
+            )
+        if delete_data:
+            # data first: if rmtree fails the entry survives, so the
+            # drop can be retried through the catalog
             import shutil
 
-            shutil.rmtree(loc, ignore_errors=True)
+            try:
+                shutil.rmtree(loc)
+            except FileNotFoundError:
+                pass
+        fs.delete(entry)
         return True
 
     # -- resolution --------------------------------------------------------
@@ -138,13 +181,14 @@ class Catalog:
         return self.engine.fs.exists(self._entry_path(name))
 
     def tables(self) -> List[str]:
+        out = []
         try:
-            listing = self.engine.fs.list_from(f"{self._dir}/")
+            # list_from may be a generator that raises lazily on a
+            # missing _catalog dir — keep the iteration inside the try
+            for st in self.engine.fs.list_from(f"{self._dir}/"):
+                base = st.path.rsplit("/", 1)[-1]
+                if base.endswith(".json"):
+                    out.append(base[:-5])
         except FileNotFoundError:
             return []
-        out = []
-        for st in listing:
-            base = st.path.rsplit("/", 1)[-1]
-            if base.endswith(".json"):
-                out.append(base[:-5])
         return sorted(out)

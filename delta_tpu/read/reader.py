@@ -58,10 +58,16 @@ def read_scan(scan) -> pa.Table:
     p2l = physical_to_logical_names(schema) if mapped else {}
 
     requested = scan.columns
+    # Columns the residual filter references must be read even when not
+    # projected (SELECT name ... WHERE id = 2); projection happens last.
+    needed = requested
+    if requested is not None and scan.filter is not None:
+        refs = [r[0] for r in scan.filter.references()]
+        needed = requested + [c for c in dict.fromkeys(refs) if c not in requested]
     data_columns = None
-    if requested is not None:
+    if needed is not None:
         data_columns = [
-            l2p.get(c, c) for c in requested if c not in partition_columns
+            l2p.get(c, c) for c in needed if c not in partition_columns
         ]
 
     ptypes = {}
@@ -111,7 +117,7 @@ def read_scan(scan) -> pa.Table:
             for f in schema.fields:
                 if f.name in partition_columns or f.name in tbl.column_names:
                     continue
-                if requested is not None and f.name not in requested:
+                if needed is not None and f.name not in needed:
                     continue
                 tbl = tbl.append_column(
                     f.name, pa.nulls(tbl.num_rows, to_arrow_type(f.dataType))
@@ -121,7 +127,7 @@ def read_scan(scan) -> pa.Table:
             tbl = tbl.filter(pa.array(mask))
         pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
         for c in partition_columns:
-            if requested is not None and c not in requested:
+            if needed is not None and c not in needed:
                 continue
             pv_key, dtype = ptypes[c]
             value = deserialize_partition_value(pv_dict.get(pv_key), dtype)

@@ -48,6 +48,9 @@ from delta_tpu.table import Table
 
 _PATH = (r"(?:'(?P<path>[^']+)'|delta\.`(?P<path2>[^`]+)`|\"(?P<path3>[^\"]+)\""
          r"|(?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)?))")
+# quoted-path-only variant (no catalog ident) — e.g. CONVERT TO DELTA parquet.`/p`
+_QUOTED_PATH = (r"(?:'(?P<path>[^']+)'|`(?P<path2>[^`]+)`"
+                r"|\"(?P<path3>[^\"]+)\")")
 
 _SQL_TYPES = {
     "int": "integer", "integer": "integer", "bigint": "long", "long": "long",
@@ -154,7 +157,7 @@ def sql(statement: str, engine=None, catalog=None):
         return restore(_table(m, engine, catalog), timestamp_ms=ts)
 
     m = re.fullmatch(
-        rf"CONVERT\s+TO\s+DELTA\s+parquet\.{_PATH}"
+        rf"CONVERT\s+TO\s+DELTA\s+parquet\.{_QUOTED_PATH}"
         r"(?:\s+PARTITIONED\s+BY\s+\((?P<parts>[^)]+)\))?",
         s, re.IGNORECASE,
     )
@@ -253,22 +256,62 @@ def _parse_properties(text: str) -> dict:
 
 
 def _parse_column_defs(text: str):
+    from delta_tpu.colgen import CURRENT_DEFAULT_KEY
     from delta_tpu.models.schema import PrimitiveType, StructField
 
     fields = []
     for part in _split_top_level_commas(text):
-        toks = part.strip().split(None, 2)
-        if len(toks) < 2:
+        part = part.strip()
+        m = re.match(
+            r"(?:`(?P<q>[^`]+)`|(?P<name>\w+))\s+"
+            r"(?P<type>\w+(?:\s*\([^)]*\))?)\s*(?P<rest>.*)",
+            part, re.IGNORECASE | re.DOTALL,
+        )
+        if not m:
             raise DeltaError(f"cannot parse column definition: {part!r}")
-        name = toks[0].strip("`")
-        typ = _SQL_TYPES.get(toks[1].lower())
-        if typ is None:
-            typ = toks[1].lower()  # decimal(p,s) etc. pass through
+        name = m.group("q") or m.group("name")
+        type_text = re.sub(r"\s+", "", m.group("type").lower())
+        base = type_text.split("(", 1)[0]
+        if base in ("varchar", "char", "text"):
+            typ = "string"  # length parameter is advisory
+        else:
+            typ = _SQL_TYPES.get(type_text, type_text)  # decimal(p,s) etc.
         nullable = True
-        if len(toks) == 3 and re.fullmatch(r"NOT\s+NULL", toks[2].strip(),
-                                           re.IGNORECASE):
-            nullable = False
-        fields.append(StructField(name, PrimitiveType(typ), nullable=nullable))
+        default = None
+        rest = m.group("rest").strip()
+        while rest:
+            c = re.match(r"NOT\s+NULL\b\s*", rest, re.IGNORECASE)
+            if c:
+                nullable = False
+                rest = rest[c.end():].strip()
+                continue
+            c = re.match(r"DEFAULT\s+(?P<d>'[^']*'|\S+)\s*", rest, re.IGNORECASE)
+            if c:
+                default = c.group("d")
+                try:
+                    d_expr = parse_expression(default)  # fail at CREATE, not on write
+                except Exception as e:
+                    raise DeltaError(
+                        f"cannot parse DEFAULT expression {default!r}: {e}"
+                    ) from None
+                if d_expr.references():
+                    # protocol: column defaults must be constant expressions
+                    raise DeltaError(
+                        f"DEFAULT must be a constant expression, got {default!r}"
+                    )
+                rest = rest[c.end():].strip()
+                continue
+            raise DeltaError(
+                f"cannot parse column constraint {rest!r} in {part!r}"
+            )
+        metadata = {CURRENT_DEFAULT_KEY: default} if default is not None else {}
+        try:
+            dtype = PrimitiveType(typ)
+        except ValueError as e:
+            raise DeltaError(f"unsupported column type in {part!r}: {e}") from None
+        fields.append(
+            StructField(name, dtype, nullable=nullable, metadata=metadata)
+        )
     return fields
 
 
@@ -327,22 +370,38 @@ def _query_statement(s: str, engine, catalog):
         s, re.IGNORECASE | re.DOTALL,
     )
     if m:
-        import delta_tpu.api as dta
-
         table = _table(m, engine, catalog)
+        snap = table.latest_snapshot()
+        known = ({f.name for f in snap.schema.fields}
+                 if snap.schema is not None else set())
         cols_text = m.group("cols").strip()
         columns = (None if cols_text == "*"
                    else [c.strip().strip("`")
                          for c in _split_top_level_commas(cols_text)])
+        if columns is not None:
+            unknown = [c for c in columns if c not in known]
+            if unknown:
+                raise DeltaError(
+                    f"column(s) {unknown} not found in table schema "
+                    f"{sorted(known)}"
+                )
         pred = parse_expression(m.group("where")) if m.group("where") else None
-        out = dta.read_table(table.path, filter=pred, columns=columns,
-                             engine=table.engine)
+        if pred is not None and known:
+            bad = sorted({r[0] for r in pred.references()} - known)
+            if bad:
+                raise DeltaError(
+                    f"WHERE references unknown column(s) {bad}; table "
+                    f"schema is {sorted(known)}"
+                )
+        scan = snap.scan(filter=pred, columns=columns)
+        out = scan.to_arrow()
         if m.group("limit"):
             out = out.slice(0, int(m.group("limit")))
         return out
 
     m = re.fullmatch(
-        rf"INSERT\s+INTO\s+{_PATH}\s+VALUES\s+(?P<vals>.+)",
+        rf"INSERT\s+INTO\s+{_PATH}\s*"
+        r"(?:\((?P<collist>[^)]+)\)\s*)?VALUES\s+(?P<vals>.+)",
         s, re.IGNORECASE | re.DOTALL,
     )
     if m:
@@ -353,9 +412,19 @@ def _query_statement(s: str, engine, catalog):
 
         table = _table(m, engine, catalog)
         meta = table.latest_snapshot().metadata
-        names = [f.name for f in meta.schema.fields]
+        fields = {f.name: f for f in meta.schema.fields}
+        if m.group("collist"):
+            targets = [c.strip().strip("`")
+                       for c in m.group("collist").split(",")]
+            unknown = [c for c in targets if c not in fields]
+            if unknown:
+                raise DeltaError(f"INSERT column(s) {unknown} not in schema")
+            if len(set(targets)) != len(targets):
+                raise DeltaError(f"duplicate INSERT column(s) in {targets}")
+        else:
+            targets = list(fields)
         rows = []
-        for tup in re.findall(r"\(([^)]*)\)", m.group("vals")):
+        for tup in _split_values_tuples(m.group("vals")):
             vals = []
             for item in _split_top_level_commas(tup):
                 expr = parse_expression(item.strip())
@@ -366,20 +435,59 @@ def _query_statement(s: str, engine, catalog):
             rows.append(vals)
         if not rows:
             raise DeltaError("INSERT requires at least one VALUES tuple")
-        width = len(rows[0])
-        if any(len(r) != width for r in rows) or width > len(names):
-            raise DeltaError("VALUES tuples must match the table schema")
+        if any(len(r) != len(targets) for r in rows):
+            raise DeltaError(
+                f"each VALUES tuple must have exactly {len(targets)} "
+                f"value(s) for columns {targets}"
+            )
         from delta_tpu.models.schema import to_arrow_type
 
         data = pa.table({
             n: pa.array([r[i] for r in rows],
-                        to_arrow_type(meta.schema.fields[i].dataType))
-            for i, n in enumerate(names[:width])
+                        to_arrow_type(fields[n].dataType))
+            for i, n in enumerate(targets)
         })
         return dta.write_table(table.path, data, mode="append",
                                engine=table.engine)
 
     return NotImplemented
+
+
+def _split_values_tuples(s: str):
+    """`(1, 'a(b)'), (2, 'c,d')` → ["1, 'a(b)'", "2, 'c,d'"] — tuple
+    bodies at paren depth 1, honoring string literals."""
+    out, cur, depth, in_str = [], [], 0, False
+    for ch in s:
+        if in_str:
+            cur.append(ch)
+            if ch == "'":
+                in_str = False
+            continue
+        if ch == "'":
+            in_str = True
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            if depth > 1:
+                cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                out.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+        elif depth >= 1:
+            cur.append(ch)
+        elif not ch.isspace() and ch != ",":
+            raise DeltaError(f"cannot parse VALUES tuples near {ch!r} in {s!r}")
+    if depth != 0 or in_str:
+        raise DeltaError(f"unbalanced VALUES tuples: {s!r}")
+    if cur:
+        raise DeltaError(
+            f"unexpected content outside VALUES tuples: {''.join(cur)!r}"
+        )
+    return out
 
 
 def _split_top_level_commas(s: str):

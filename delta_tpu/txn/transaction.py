@@ -309,6 +309,20 @@ class Transaction:
         self._set_txns[app_id] = SetTransaction(app_id, version, last_updated)
 
     def update_metadata(self, metadata: Metadata) -> None:
+        # partition columns must name schema fields and be unique
+        # (`DeltaErrors.partitionColumnNotFoundException` semantics)
+        pcols = list(metadata.partitionColumns or [])
+        if pcols:
+            schema = metadata.schema
+            known = {f.name for f in schema.fields} if schema else set()
+            missing = [c for c in pcols if c not in known]
+            if missing:
+                raise DeltaError(
+                    f"partition column(s) {missing} not found in schema "
+                    f"{sorted(known)}"
+                )
+            if len(set(pcols)) != len(pcols):
+                raise DeltaError(f"duplicate partition columns: {pcols}")
         self._new_metadata = metadata
 
     def update_protocol(self, protocol: Protocol) -> None:

@@ -154,3 +154,205 @@ def test_catalog_register_existing(tmp_path):
     cat = Catalog(str(tmp_path / "cat"))
     cat.register("ext", path)
     assert sql("SELECT * FROM ext", catalog=cat).num_rows == 5
+
+
+def test_show_tables_on_fresh_catalog(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path / "fresh"))
+    assert sql("SHOW TABLES", catalog=cat) == []
+
+
+def test_create_table_not_null_and_default(tmp_path):
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.colgen import CURRENT_DEFAULT_KEY
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE d (id BIGINT NOT NULL DEFAULT 5, v DOUBLE) USING DELTA",
+        catalog=cat)
+    schema = cat.table("d").latest_snapshot().schema
+    f = schema["id"]
+    assert f.nullable is False
+    assert f.metadata[CURRENT_DEFAULT_KEY] == "5"
+    # missing id column on insert fills from the default
+    import delta_tpu.api as dta2
+    dta2.write_table(cat.table("d").path,
+                     pa.table({"v": pa.array([1.0, 2.0])}), mode="append")
+    out = sql("SELECT id, v FROM d", catalog=cat)
+    assert out.column("id").to_pylist() == [5, 5]
+    # unknown constraint text is rejected, not silently dropped
+    with pytest.raises(DeltaError):
+        sql("CREATE TABLE bad (id BIGINT FROB) USING DELTA", catalog=cat)
+
+
+def test_insert_values_with_parens_in_strings(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE s (id BIGINT, name STRING) USING DELTA", catalog=cat)
+    sql("INSERT INTO s VALUES (1, 'a(b)'), (2, 'c,d')", catalog=cat)
+    out = sql("SELECT name FROM s WHERE id = 1", catalog=cat)
+    assert out.column("name").to_pylist() == ["a(b)"]
+    out = sql("SELECT name FROM s WHERE id = 2", catalog=cat)
+    assert out.column("name").to_pylist() == ["c,d"]
+    with pytest.raises(DeltaError):
+        sql("INSERT INTO s VALUES (1, 'unbalanced", catalog=cat)
+
+
+def test_convert_requires_quoted_path():
+    with pytest.raises(DeltaError):
+        sql("CONVERT TO DELTA parquet.mytbl")
+
+
+def test_drop_table_delete_data(tmp_path):
+    import os
+
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE g (id BIGINT) USING DELTA", catalog=cat)
+    sql("INSERT INTO g VALUES (1)", catalog=cat)
+    loc = cat.table("g").path
+    assert os.path.isdir(loc)
+    cat.drop("g", delete_data=True)
+    assert not os.path.exists(loc)
+
+
+def test_create_table_failure_leaves_no_entry(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    with pytest.raises(Exception):
+        sql("CREATE TABLE p (id BIGINT) USING DELTA PARTITIONED BY (nosuchcol)",
+            catalog=cat)
+    assert not cat.exists("p")
+    # name is reusable after the failed create
+    sql("CREATE TABLE p (id BIGINT) USING DELTA", catalog=cat)
+    assert sql("SHOW TABLES", catalog=cat) == ["p"]
+
+
+def test_drop_external_delete_data_refused(tmp_path):
+    import delta_tpu.api as dta2
+    from delta_tpu.catalog import Catalog
+
+    ext = str(tmp_path / "elsewhere")
+    dta2.write_table(ext, pa.table({"x": pa.array([1])}))
+    cat = Catalog(str(tmp_path / "cat"))
+    cat.register("ext", ext)
+    with pytest.raises(DeltaError):
+        cat.drop("ext", delete_data=True)
+    assert cat.exists("ext")
+    cat.drop("ext")  # without delete_data is fine; data stays
+    import os
+
+    assert os.path.isdir(ext)
+
+
+def test_select_unknown_column_raises(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE u (id BIGINT) USING DELTA", catalog=cat)
+    with pytest.raises(DeltaError):
+        sql("SELECT nosuch FROM u", catalog=cat)
+
+
+def test_insert_width_mismatch_and_column_list(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE w (id BIGINT, name STRING, score DOUBLE DEFAULT 0.5) "
+        "USING DELTA", catalog=cat)
+    with pytest.raises(DeltaError):
+        sql("INSERT INTO w VALUES (1)", catalog=cat)
+    sql("INSERT INTO w (id, name) VALUES (1, 'a')", catalog=cat)
+    out = sql("SELECT id, name, score FROM w", catalog=cat)
+    assert out.column("id").to_pylist() == [1]
+    assert out.column("score").to_pylist() == [0.5]  # filled from DEFAULT
+    with pytest.raises(DeltaError):
+        sql("INSERT INTO w (id, nosuch) VALUES (1, 'x')", catalog=cat)
+
+
+def test_varchar_maps_to_string(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE vc (name VARCHAR(255), note CHAR(4)) USING DELTA",
+        catalog=cat)
+    schema = cat.table("vc").latest_snapshot().schema
+    assert schema["name"].dataType.name == "string"
+    assert schema["note"].dataType.name == "string"
+    with pytest.raises(DeltaError):
+        sql("CREATE TABLE vb (x FROBTYPE) USING DELTA", catalog=cat)
+
+
+def test_where_unknown_column_raises(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE wh (id BIGINT) USING DELTA", catalog=cat)
+    sql("INSERT INTO wh VALUES (1), (2)", catalog=cat)
+    with pytest.raises(DeltaError):
+        sql("SELECT id FROM wh WHERE nosuchcol = 99", catalog=cat)
+
+
+def test_insert_duplicate_column_list_raises(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE dup (id BIGINT, name STRING) USING DELTA", catalog=cat)
+    with pytest.raises(DeltaError):
+        sql("INSERT INTO dup (id, id) VALUES (1, 2)", catalog=cat)
+
+
+def test_bad_default_rejected_at_create(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    with pytest.raises(DeltaError):
+        sql("CREATE TABLE bd (x BIGINT DEFAULT frob NOT NULL) USING DELTA",
+            catalog=cat)
+    assert not cat.exists("bd")
+
+
+def test_failed_clustering_create_is_fully_rolled_back(tmp_path):
+    import os
+
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.clustering import clustering_columns
+
+    cat = Catalog(str(tmp_path))
+    with pytest.raises(Exception):
+        sql("CREATE TABLE rc (id BIGINT) USING DELTA CLUSTER BY (nosuch)",
+            catalog=cat)
+    assert not cat.exists("rc")
+    # retry succeeds and the clustering from the retry is applied
+    sql("CREATE TABLE rc (id BIGINT) USING DELTA CLUSTER BY (id)", catalog=cat)
+    assert clustering_columns(cat.table("rc").latest_snapshot()) == ["id"]
+
+
+def test_failed_create_preserves_preexisting_location(tmp_path):
+    import os
+
+    from delta_tpu.catalog import Catalog
+
+    pre = tmp_path / "preexisting"
+    pre.mkdir()
+    (pre / "user_data.parquet").write_bytes(b"not actually parquet")
+    cat = Catalog(str(tmp_path / "cat"))
+    with pytest.raises(DeltaError):
+        sql("CREATE TABLE pe (id BIGINT) USING DELTA "
+            f"PARTITIONED BY (nosuch) LOCATION '{pre}'", catalog=cat)
+    assert (pre / "user_data.parquet").exists()   # user data untouched
+    assert not os.path.isdir(pre / "_delta_log")  # our half-write removed
+    assert not cat.exists("pe")
+
+
+def test_insert_trailing_garbage_raises(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    sql("CREATE TABLE tg (id BIGINT) USING DELTA", catalog=cat)
+    with pytest.raises(DeltaError):
+        sql("INSERT INTO tg VALUES (1), '2'", catalog=cat)
+    assert sql("SELECT * FROM tg", catalog=cat).num_rows == 0
