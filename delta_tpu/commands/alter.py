@@ -47,6 +47,16 @@ def _commit_schema(txn, new_schema: StructType, operation_params: Dict,
         configuration=dict(new_conf if new_conf is not None else meta.configuration),
     )
     txn.update_metadata(replacement)
+    # schema metadata can activate features (CURRENT_DEFAULT →
+    # allowColumnDefaults, generation expressions, identity columns);
+    # the protocol must list them before the commit lands
+    proto = txn.protocol()
+    for feat in FEATURES.values():
+        if feat.activated_by is not None and feat.activated_by(replacement):
+            upgraded = upgraded_protocol(proto, feat)
+            if upgraded != proto:
+                proto = upgraded
+                txn.update_protocol(proto)
     txn.set_operation_parameters(operation_params)
     return txn.commit().version
 

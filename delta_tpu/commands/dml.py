@@ -47,23 +47,13 @@ class DMLMetrics:
 
 
 def _read_file_with_partitions(table, snapshot, add: AddFile) -> pa.Table:
-    from delta_tpu.models.schema import PrimitiveType, to_arrow_type
-    from delta_tpu.stats.partition import deserialize_partition_value
+    """Full physical row set (DV NOT applied — DML computes row indices
+    positionally against the Parquet order), logical names, partition
+    columns appended."""
+    from delta_tpu.read.reader import read_add_file_logical
 
-    engine = table.engine
-    p = add.path
-    abs_path = p if ("://" in p or p.startswith("/")) else f"{table.path}/{p}"
-    tbl = next(iter(engine.parquet.read_parquet_files([abs_path])))
-    schema = snapshot.schema
-    for c in snapshot.partition_columns:
-        dtype = PrimitiveType("string")
-        if schema is not None and c in schema:
-            f = schema[c]
-            if isinstance(f.dataType, PrimitiveType):
-                dtype = f.dataType
-        value = deserialize_partition_value((add.partitionValues or {}).get(c), dtype)
-        tbl = tbl.append_column(c, pa.array([value] * tbl.num_rows, to_arrow_type(dtype)))
-    return tbl
+    return read_add_file_logical(
+        table.engine, table.path, snapshot, add, apply_dv=False)
 
 
 def _existing_dv_mask(table, add: AddFile, num_rows: int) -> Optional[np.ndarray]:

@@ -1,0 +1,282 @@
+"""ALTER TABLE ... DROP FEATURE: protocol feature removal with
+pre-downgrade cleanup.
+
+Reference `AlterTableDropFeatureDeltaCommand` +
+`PreDowngradeTableFeatureCommand.scala`: each removable feature defines a
+pre-downgrade step that erases the feature's traces from the *current*
+version (disable the table property, purge deletion vectors, strip
+schema metadata, drop domain metadata, ...). Reader-writer features
+additionally require the *history* to be clean, since old commits and
+checkpoints may still carry the feature — the reference gates this on a
+24h wait + `TRUNCATE HISTORY`; we implement TRUNCATE HISTORY as an
+immediate checkpoint + log cleanup so the downgrade is one call.
+
+After pre-downgrade, the protocol is rewritten without the feature and
+collapsed back to legacy (reader, writer) versions when no non-legacy
+feature remains (reference `Protocol.downgraded`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.features import FEATURES, TableFeature, is_feature_supported
+from delta_tpu.models.actions import Metadata, Protocol
+from delta_tpu.models.schema import (
+    StructField,
+    StructType,
+    schema_from_json,
+    schema_to_json,
+)
+
+DROP_FEATURE_OP = "DROP FEATURE"
+
+# features whose traces we know how to erase; everything else refuses
+# (reference `RemovableFeature`)
+_REMOVABLE = {
+    "deletionVectors",
+    "inCommitTimestamp",
+    "v2Checkpoint",
+    "typeWidening",
+    "rowTracking",
+    "clustering",
+    "vacuumProtocolCheck",
+    "checkConstraints",
+    "changeDataFeed",
+    "columnMapping",
+    "domainMetadata",
+    "allowColumnDefaults",
+}
+
+# configuration keys each feature's pre-downgrade must remove
+_CONF_KEYS: Dict[str, List[str]] = {
+    "deletionVectors": ["delta.enableDeletionVectors"],
+    "inCommitTimestamp": [
+        "delta.enableInCommitTimestamps",
+        "delta.inCommitTimestampEnablementVersion",
+        "delta.inCommitTimestampEnablementTimestamp",
+    ],
+    "v2Checkpoint": ["delta.checkpointPolicy"],
+    "typeWidening": ["delta.enableTypeWidening"],
+    "rowTracking": ["delta.enableRowTracking"],
+    "changeDataFeed": ["delta.enableChangeDataFeed"],
+    "columnMapping": ["delta.columnMapping.mode", "delta.columnMapping.maxColumnId"],
+}
+
+
+def drop_feature(table, feature_name: str, truncate_history: bool = False) -> int:
+    """Run the pre-downgrade step for `feature_name`, verify no traces
+    remain, and commit the downgraded protocol. Returns the version of
+    the protocol-downgrade commit."""
+    feature = FEATURES.get(feature_name)
+    if feature is None:
+        raise DeltaError(
+            f"unknown table feature {feature_name!r}; known features: "
+            f"{sorted(FEATURES)}")
+    if feature_name not in _REMOVABLE:
+        raise DeltaError(
+            f"feature {feature_name!r} cannot be dropped (not removable)")
+
+    snapshot = table.latest_snapshot()
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    proto = snapshot.protocol
+    if feature_name not in proto.writer_feature_set() and (
+        feature_name not in proto.reader_feature_set()
+    ):
+        if is_feature_supported(proto, feature):
+            raise DeltaError(
+                f"feature {feature_name!r} is implicitly supported by "
+                f"protocol ({proto.minReaderVersion}, {proto.minWriterVersion}) "
+                "legacy versions; dropping legacy features requires them to "
+                "be listed explicitly (writer version 7)")
+        raise DeltaError(f"feature {feature_name!r} is not present on this table")
+
+    _pre_downgrade(table, feature_name)
+
+    # reader-writer features leave traces in historical commits and
+    # checkpoints; those stay readable until history is truncated
+    if feature.is_reader_writer and feature_name != "vacuumProtocolCheck":
+        if not truncate_history:
+            raise DeltaError(
+                f"dropping reader+writer feature {feature_name!r} requires "
+                "history truncation: historical versions may still carry the "
+                "feature. Re-run with TRUNCATE HISTORY "
+                "(drop_feature(..., truncate_history=True))")
+        _truncate_history(table)
+
+    return _commit_downgrade(table, feature)
+
+
+def _pre_downgrade(table, name: str) -> None:
+    from delta_tpu.commands.alter import unset_properties
+
+    snapshot = table.latest_snapshot()
+    meta = snapshot.metadata
+    conf = meta.configuration
+
+    if name == "deletionVectors":
+        from delta_tpu.commands.reorg import reorg_purge
+
+        if conf.get("delta.enableDeletionVectors", "").lower() == "true":
+            unset_properties(table, _CONF_KEYS[name])
+        reorg_purge(table)
+        still = [f for f in table.latest_snapshot().scan().files()
+                 if f.deletionVector is not None]
+        if still:
+            raise DeltaError(
+                f"{len(still)} file(s) still carry deletion vectors after purge")
+        return
+
+    if name == "checkConstraints":
+        from delta_tpu.constraints import table_constraints
+
+        existing = table_constraints(conf)
+        if existing:
+            raise DeltaError(
+                f"cannot drop checkConstraints: constraint(s) "
+                f"{sorted(existing)} still exist — DROP CONSTRAINT them first")
+        return
+
+    if name == "rowTracking":
+        from delta_tpu.rowtracking import ROW_TRACKING_DOMAIN
+
+        _strip_metadata_and_domains(
+            table, conf_keys=_CONF_KEYS[name], domains=[ROW_TRACKING_DOMAIN])
+        return
+
+    if name == "clustering":
+        from delta_tpu.clustering import CLUSTERING_DOMAIN
+
+        _strip_metadata_and_domains(table, conf_keys=[], domains=[CLUSTERING_DOMAIN])
+        return
+
+    if name == "columnMapping":
+        schema = schema_from_json(meta.schemaString)
+        renamed = [f.name for f in schema.fields if f.physical_name != f.name]
+        if renamed:
+            raise DeltaError(
+                "cannot drop columnMapping: column(s) "
+                f"{renamed} have physical names differing from their logical "
+                "names (a rename or drop happened); rewrite the table first")
+
+        def strip(f: StructField) -> StructField:
+            md = {k: v for k, v in f.metadata.items()
+                  if not k.startswith("delta.columnMapping.")}
+            return dataclasses.replace(f, metadata=md)
+
+        new_schema = StructType([strip(f) for f in schema.fields])
+        _strip_metadata_and_domains(
+            table, conf_keys=_CONF_KEYS[name], domains=[], new_schema=new_schema)
+        return
+
+    if name == "typeWidening":
+        # files written before a widening already read correctly only via
+        # the feature; materialize the wide type everywhere first
+        from delta_tpu.commands.reorg import reorg_rewrite_all
+
+        if conf.get("delta.enableTypeWidening", "").lower() == "true":
+            unset_properties(table, _CONF_KEYS[name])
+        reorg_rewrite_all(table)
+        return
+
+    if name == "v2Checkpoint":
+        keys = [k for k in _CONF_KEYS[name] if k in conf]
+        if conf.get("delta.checkpointPolicy", "classic") != "classic":
+            _strip_metadata_and_domains(table, conf_keys=keys, domains=[])
+        # replace any V2 checkpoint with a classic one at the head version
+        table.checkpoint()
+        return
+
+    if name == "allowColumnDefaults":
+        schema = schema_from_json(meta.schemaString)
+
+        def strip(f: StructField) -> StructField:
+            md = {k: v for k, v in f.metadata.items()
+                  if k not in ("CURRENT_DEFAULT", "EXISTS_DEFAULT")}
+            return dataclasses.replace(f, metadata=md)
+
+        new_schema = StructType([strip(f) for f in schema.fields])
+        if new_schema != schema:
+            _strip_metadata_and_domains(
+                table, conf_keys=[], domains=[], new_schema=new_schema)
+        return
+
+    if name == "domainMetadata":
+        live = {d: dm for d, dm in
+                table.latest_snapshot().state.domain_metadata.items()
+                if not dm.removed}
+        if live:
+            raise DeltaError(
+                f"cannot drop domainMetadata: live domain(s) {sorted(live)} "
+                "still exist")
+        return
+
+    keys = [k for k in _CONF_KEYS.get(name, ()) if k in conf]
+    if keys:
+        unset_properties(table, keys)
+
+
+def _strip_metadata_and_domains(table, conf_keys: List[str],
+                                domains: List[str],
+                                new_schema: Optional[StructType] = None) -> None:
+    txn = table.create_transaction_builder(DROP_FEATURE_OP).build()
+    meta = txn.metadata()
+    conf = {k: v for k, v in meta.configuration.items() if k not in set(conf_keys)}
+    replacement = dataclasses.replace(
+        meta, configuration=conf,
+        schemaString=(schema_to_json(new_schema) if new_schema is not None
+                      else meta.schemaString))
+    if replacement != meta:
+        txn.update_metadata(replacement)
+    for d in domains:
+        if d in txn.read_snapshot.state.domain_metadata:
+            txn.remove_domain_metadata(d)
+    txn.set_operation_parameters({"preDowngrade": True})
+    txn.commit()
+
+
+def _truncate_history(table) -> None:
+    """Checkpoint the head version and delete every shadowed log file,
+    regardless of age (the TRUNCATE HISTORY arm of the reference command,
+    with the 24h wait collapsed to 'now')."""
+    import time
+
+    from delta_tpu.log.cleanup import cleanup_expired_logs
+
+    table.checkpoint()
+    cleanup_expired_logs(table, retention_ms=0,
+                         now_ms=int(time.time() * 1000) + 60_000)
+
+
+def _commit_downgrade(table, feature: TableFeature) -> int:
+    txn = table.create_transaction_builder(DROP_FEATURE_OP).build()
+    proto = txn.protocol()
+    meta = txn.metadata()
+    if feature.activated_by is not None and feature.activated_by(meta):
+        raise DeltaError(
+            f"feature {feature.name!r} is still active after pre-downgrade")
+    txn.update_protocol(_downgraded_protocol(proto, feature.name))
+    txn.set_operation_parameters({"featureName": feature.name})
+    return txn.commit().version
+
+
+def _downgraded_protocol(proto: Protocol, name: str) -> Protocol:
+    writer = proto.writer_feature_set() - {name}
+    reader = proto.reader_feature_set() - {name}
+    remaining = [FEATURES[n] for n in writer | reader if n in FEATURES]
+    unknown = (writer | reader) - set(FEATURES)
+    if not unknown and all(f.legacy for f in remaining):
+        # collapse to legacy versions (reference Protocol.downgraded)
+        min_writer = max([f.min_writer_version for f in remaining], default=2)
+        min_reader = max(
+            [f.min_reader_version for f in remaining if f.is_reader_writer],
+            default=1)
+        return Protocol(min_reader, min_writer)
+    min_reader = 3 if reader else 1
+    return Protocol(
+        min_reader, 7,
+        readerFeatures=sorted(reader) if min_reader >= 3 else None,
+        writerFeatures=sorted(writer))

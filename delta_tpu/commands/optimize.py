@@ -230,17 +230,21 @@ def _rewrite_bin(
     table, snapshot, bin_files: List[AddFile],
     zorder_by: Optional[List[str]], curve: str, max_file_size: int,
 ) -> List[AddFile]:
-    """Read the bin's rows, optionally reorder along the curve, and write
-    back as (approximately) bin-size files."""
+    """Read the bin's rows (deletion vectors applied, physical→logical
+    names mapped), optionally reorder along the curve, and write back as
+    (approximately) bin-size files. Rewritten files drop their DVs —
+    OPTIMIZE purges soft-deleted rows like the reference's
+    `OptimizeExecutor`."""
+    from delta_tpu.read.reader import read_add_file_logical
+
     engine = table.engine
     meta = snapshot.metadata
     schema = meta.schema
-    paths = [
-        p if "://" in p or p.startswith("/") else f"{table.path}/{p}"
-        for p in (f.path for f in bin_files)
-    ]
-    tables = list(engine.parquet.read_parquet_files(paths))
-    data = pa.concat_tables(tables, promote_options="permissive")
+    data = pa.concat_tables(
+        [read_add_file_logical(engine, table.path, snapshot, f)
+         for f in bin_files],
+        promote_options="permissive",
+    )
 
     if zorder_by:
         import pyarrow.compute as pc
@@ -264,29 +268,11 @@ def _rewrite_bin(
     n_out = max(1, -(-total_bytes // max_file_size))
     rows_per_file = max(1, -(-data.num_rows // n_out))
 
-    pv = dict(bin_files[0].partitionValues or {})
-    # inject partition columns so write_data_files can re-derive the
-    # partition directory (data files don't store partition columns)
     part_cols = meta.partitionColumns
-    from delta_tpu.stats.partition import deserialize_partition_value
-    from delta_tpu.models.schema import PrimitiveType, to_arrow_type
-
-    enriched = data
-    for c in part_cols:
-        dtype = PrimitiveType("string")
-        if schema is not None and c in schema:
-            f0 = schema[c]
-            if isinstance(f0.dataType, PrimitiveType):
-                dtype = f0.dataType
-        value = deserialize_partition_value(pv.get(c), dtype)
-        enriched = enriched.append_column(
-            c, pa.array([value] * data.num_rows, to_arrow_type(dtype))
-        )
-
     return write_data_files(
         engine=engine,
         table_path=table.path,
-        data=enriched,
+        data=data,
         schema=schema,
         partition_columns=part_cols,
         configuration=meta.configuration,

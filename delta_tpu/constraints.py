@@ -68,6 +68,13 @@ def add_constraint(table, name: str, expr) -> int:
     new_conf = dict(meta.configuration)
     new_conf[key] = to_sql(expr)
     txn.update_metadata(dataclasses.replace(meta, configuration=new_conf))
+
+    from delta_tpu.features import CHECK_CONSTRAINTS, upgraded_protocol
+
+    proto = txn.protocol()
+    new_proto = upgraded_protocol(proto, CHECK_CONSTRAINTS)
+    if new_proto != proto:
+        txn.update_protocol(new_proto)
     txn.set_operation_parameters({"name": name, "expr": to_sql(expr)})
     return txn.commit().version
 

@@ -152,18 +152,40 @@ def upgraded_protocol(current: Protocol, feature: TableFeature) -> Protocol:
     writer = set(current.writerFeatures or [])
     min_reader = current.minReaderVersion
     min_writer = current.minWriterVersion
-    if feature.legacy and feature.min_writer_version <= min_writer and (
-        not feature.is_reader_writer or feature.min_reader_version <= min_reader
-    ):
+    # on a legacy protocol (no feature vectors) version coverage implies
+    # support; at writer 7 a feature counts only when listed
+    if (feature.legacy and current.writerFeatures is None
+            and min_writer < 7
+            and feature.min_writer_version <= min_writer
+            and (not feature.is_reader_writer
+                 or feature.min_reader_version <= min_reader)):
         return current
+    if feature.legacy and min_writer < 7 and current.writerFeatures is None:
+        # legacy protocols bump versions instead of listing features
+        # (reference: CHECK constraint on a (1,2) table → (1,3))
+        return Protocol(
+            max(min_reader,
+                feature.min_reader_version if feature.is_reader_writer else 1),
+            max(min_writer, feature.min_writer_version),
+        )
+    if current.writerFeatures is None:
+        # converting a legacy protocol to feature vectors: every feature
+        # the old (reader, writer) versions implied must be listed or it
+        # silently loses support (reference Protocol.upgradeToFeatures /
+        # implicitlySupportedFeatures)
+        for f in FEATURES.values():
+            if (f.legacy and f.min_writer_version <= min_writer
+                    and (not f.is_reader_writer
+                         or f.min_reader_version <= min_reader)):
+                writer.add(f.name)
+                if f.is_reader_writer:
+                    reader.add(f.name)
     min_writer = 7
     writer.add(feature.name)
     if feature.is_reader_writer and feature.min_reader_version >= 3:
-        min_reader = 3
         reader.add(feature.name)
-    if min_reader >= 3:
-        # at (3,7) every legacy-supported feature must be listed too
-        reader = reader or set()
+    if reader:
+        min_reader = 3
     return Protocol(
         min_reader,
         min_writer,

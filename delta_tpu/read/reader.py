@@ -38,6 +38,88 @@ def _dv_row_mask(engine, table_path: str, dv_row: dict, num_rows: int) -> Option
     return mask
 
 
+def _align_to_logical(tbl: pa.Table, schema, partition_columns, p2l,
+                      needed=None) -> pa.Table:
+    """Physical→logical renames + schema alignment for one file's rows:
+    dropped columns disappear, columns added after the file was written
+    read as null (restricted to `needed` when projecting), and files
+    written before a type-widening change cast up."""
+    if p2l:
+        tbl = tbl.rename_columns([p2l.get(c, c) for c in tbl.column_names])
+    if schema is None:
+        return tbl
+    known = {f.name: f for f in schema.fields if f.name not in partition_columns}
+    tbl = tbl.select([c for c in tbl.column_names if c in known])
+    for idx, c in enumerate(tbl.column_names):
+        target_t = to_arrow_type(known[c].dataType)
+        if tbl.schema.field(idx).type != target_t:
+            try:
+                tbl = tbl.set_column(
+                    idx, pa.field(c, target_t), tbl.column(c).cast(target_t))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                pass  # non-widening mismatch: surface as-is
+    for f in schema.fields:
+        if f.name in partition_columns or f.name in tbl.column_names:
+            continue
+        if needed is not None and f.name not in needed:
+            continue
+        tbl = tbl.append_column(
+            f.name, pa.nulls(tbl.num_rows, to_arrow_type(f.dataType)))
+    return tbl
+
+
+def _append_partition_columns(tbl: pa.Table, pv_dict, partition_columns,
+                              schema, mapped: bool, needed=None) -> pa.Table:
+    """Splice partition-column values (serialized strings in
+    `partitionValues`, keyed by physical name under column mapping) back
+    into the row set as typed columns."""
+    for c in partition_columns:
+        if needed is not None and c not in needed:
+            continue
+        dtype = PrimitiveType("string")
+        pv_key = c
+        if schema is not None and c in schema:
+            f = schema[c]
+            if isinstance(f.dataType, PrimitiveType):
+                dtype = f.dataType
+            if mapped:
+                pv_key = f.physical_name
+        value = deserialize_partition_value(
+            pv_dict.get(pv_key, pv_dict.get(c)), dtype)
+        tbl = tbl.append_column(
+            c, pa.array([value] * tbl.num_rows, to_arrow_type(dtype)))
+    return tbl
+
+
+def read_add_file_logical(engine, table_path: str, snapshot, add,
+                          apply_dv: bool = True) -> pa.Table:
+    """Read one AddFile as a logical-schema Arrow table: physical→logical
+    column renames, schema alignment (missing columns as null, widened
+    types cast up), deletion-vector rows dropped, partition columns
+    appended. The shared read half of every file-rewrite command
+    (OPTIMIZE / REORG PURGE / copy-on-write DML) — the reference does the
+    same via `DeltaParquetFileFormat` (`DeltaParquetFileFormat.scala:189`).
+    """
+    from delta_tpu.columnmapping import mapping_mode, physical_to_logical_names
+
+    schema = snapshot.schema
+    meta = snapshot.metadata
+    partition_columns = snapshot.partition_columns
+    mapped = mapping_mode(meta.configuration) != "none" and schema is not None
+    p2l = physical_to_logical_names(schema) if mapped else {}
+
+    tbl = next(iter(engine.parquet.read_parquet_files(
+        [_absolute_path(table_path, add.path)])))
+    tbl = _align_to_logical(tbl, schema, partition_columns, p2l)
+    if apply_dv and add.deletionVector is not None:
+        mask = _dv_row_mask(engine, table_path, add.deletionVector.to_dict(),
+                            tbl.num_rows)
+        if mask is not None:
+            tbl = tbl.filter(pa.array(mask))
+    return _append_partition_columns(
+        tbl, add.partitionValues or {}, partition_columns, schema, mapped)
+
+
 def read_scan(scan) -> pa.Table:
     from delta_tpu.columnmapping import (
         logical_to_physical_names,
@@ -70,18 +152,6 @@ def read_scan(scan) -> pa.Table:
             l2p.get(c, c) for c in needed if c not in partition_columns
         ]
 
-    ptypes = {}
-    for c in partition_columns:
-        dtype = PrimitiveType("string")
-        pv_key = c
-        if schema is not None and c in schema:
-            f = schema[c]
-            if isinstance(f.dataType, PrimitiveType):
-                dtype = f.dataType
-            if mapped:
-                pv_key = f.physical_name
-        ptypes[c] = (pv_key, dtype)
-
     batches: List[pa.Table] = []
     paths = files.column("path").to_pylist()
     pvs = files.column("partition_values").to_pylist()
@@ -95,44 +165,13 @@ def read_scan(scan) -> pa.Table:
         except (pa.ArrowInvalid, KeyError):
             # file predates newly added columns — read everything it has
             tbl = next(iter(engine.parquet.read_parquet_files([abs_path])))
-        if mapped:
-            tbl = tbl.rename_columns([p2l.get(c, c) for c in tbl.column_names])
-        if schema is not None:
-            # align to the logical schema: dropped columns disappear,
-            # columns added after this file was written read as null, and
-            # files written before a type-widening change cast up
-            known = {f.name: f for f in schema.fields if f.name not in partition_columns}
-            tbl = tbl.select([c for c in tbl.column_names if c in known])
-            for idx, c in enumerate(tbl.column_names):
-                target_t = to_arrow_type(known[c].dataType)
-                if tbl.schema.field(idx).type != target_t:
-                    try:
-                        tbl = tbl.set_column(
-                            idx,
-                            pa.field(c, target_t),
-                            tbl.column(c).cast(target_t),
-                        )
-                    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-                        pass  # non-widening mismatch: surface as-is
-            for f in schema.fields:
-                if f.name in partition_columns or f.name in tbl.column_names:
-                    continue
-                if needed is not None and f.name not in needed:
-                    continue
-                tbl = tbl.append_column(
-                    f.name, pa.nulls(tbl.num_rows, to_arrow_type(f.dataType))
-                )
+        tbl = _align_to_logical(tbl, schema, partition_columns, p2l, needed)
         mask = _dv_row_mask(engine, table_path, dv, tbl.num_rows)
         if mask is not None:
             tbl = tbl.filter(pa.array(mask))
         pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
-        for c in partition_columns:
-            if needed is not None and c not in needed:
-                continue
-            pv_key, dtype = ptypes[c]
-            value = deserialize_partition_value(pv_dict.get(pv_key), dtype)
-            arr = pa.array([value] * tbl.num_rows, to_arrow_type(dtype))
-            tbl = tbl.append_column(c, arr)
+        tbl = _append_partition_columns(
+            tbl, pv_dict, partition_columns, schema, mapped, needed)
         batches.append(tbl)
 
     if not batches:

@@ -220,6 +220,86 @@ def sql(statement: str, engine=None, catalog=None):
         )
 
     m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+UNSET\s+TBLPROPERTIES\s*"
+        r"\((?P<props>.+)\)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.alter import unset_properties
+
+        keys = [k.strip().strip("'\"`") for k in
+                _split_top_level_commas(m.group("props"))]
+        return unset_properties(_table(m, engine, catalog), keys)
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+ADD\s+COLUMNS?\s*\((?P<cols>.+)\)",
+        s, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        from delta_tpu.commands.alter import add_columns
+
+        return add_columns(
+            _table(m, engine, catalog), _parse_column_defs(m.group("cols")))
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+RENAME\s+COLUMN\s+"
+        r"`?(?P<old>\w+)`?\s+TO\s+`?(?P<new>\w+)`?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.alter import rename_column
+
+        return rename_column(
+            _table(m, engine, catalog), m.group("old"), m.group("new"))
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+DROP\s+COLUMN\s+`?(?P<col>\w+)`?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.alter import drop_column
+
+        return drop_column(_table(m, engine, catalog), m.group("col"))
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+(?:ALTER|CHANGE)\s+COLUMN\s+"
+        r"`?(?P<col>\w+)`?\s+TYPE\s+(?P<typ>\w+)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.alter import change_column_type
+        from delta_tpu.models.schema import PrimitiveType
+
+        typ = m.group("typ").lower()
+        try:
+            new_type = PrimitiveType(_SQL_TYPES.get(typ, typ))
+        except ValueError as e:
+            raise DeltaError(str(e)) from e
+        return change_column_type(
+            _table(m, engine, catalog), m.group("col"), new_type)
+
+    m = re.fullmatch(
+        rf"ALTER\s+TABLE\s+{_PATH}\s+DROP\s+FEATURE\s+"
+        r"`?(?P<feat>\w+)`?(?P<trunc>\s+TRUNCATE\s+HISTORY)?",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.dropfeature import drop_feature
+
+        return drop_feature(
+            _table(m, engine, catalog), m.group("feat"),
+            truncate_history=m.group("trunc") is not None)
+
+    m = re.fullmatch(
+        rf"REORG\s+TABLE\s+{_PATH}\s+APPLY\s*\(\s*PURGE\s*\)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.reorg import reorg_purge
+
+        return reorg_purge(_table(m, engine, catalog))
+
+    m = re.fullmatch(
         rf"DELETE\s+FROM\s+{_PATH}(?:\s+WHERE\s+(?P<where>.+))?",
         s, re.IGNORECASE,
     )

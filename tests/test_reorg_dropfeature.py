@@ -1,0 +1,283 @@
+"""REORG PURGE, DROP FEATURE pre-downgrade flows, and the
+OPTIMIZE-with-DVs regression (rewrites must not resurrect soft-deleted
+rows)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.commands.dml import delete
+from delta_tpu.commands.dropfeature import drop_feature
+from delta_tpu.commands.reorg import reorg_purge
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.parser import parse_expression
+from delta_tpu.sql import sql
+from delta_tpu.table import Table
+
+
+def _dv_table(path, n=100):
+    data = pa.table({
+        "id": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.float64)),
+    })
+    dta.write_table(path, data, mode="append",
+                    properties={"delta.enableDeletionVectors": "true"})
+    delete(Table.for_path(path), parse_expression("id < 10"))
+    return Table.for_path(path)
+
+
+def test_reorg_purge_materializes_dv_deletes(tmp_table_path):
+    t = _dv_table(tmp_table_path)
+    files = t.latest_snapshot().scan().files()
+    assert any(f.deletionVector is not None for f in files)
+
+    metrics = reorg_purge(t)
+    assert metrics.num_files_removed >= 1
+
+    snap = t.latest_snapshot()
+    assert all(f.deletionVector is None for f in snap.scan().files())
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("id").to_pylist()) == list(range(10, 100))
+
+
+def test_reorg_purge_noop_without_dvs(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2], pa.int64())}),
+                    mode="append")
+    before = Table.for_path(tmp_table_path).latest_snapshot().version
+    metrics = reorg_purge(Table.for_path(tmp_table_path))
+    assert metrics.num_files_removed == 0
+    assert Table.for_path(tmp_table_path).latest_snapshot().version == before
+
+
+def test_optimize_does_not_resurrect_dv_deleted_rows(tmp_table_path):
+    """Regression: OPTIMIZE reads must apply deletion vectors before
+    rewriting a bin."""
+    t = _dv_table(tmp_table_path)
+    # add more small files so compaction has a bin to work on
+    for start in (100, 200):
+        dta.write_table(
+            tmp_table_path,
+            pa.table({"id": pa.array(np.arange(start, start + 50, dtype=np.int64)),
+                      "v": pa.array(np.zeros(50))}),
+            mode="append")
+    metrics = t.optimize().execute_compaction()
+    assert metrics.num_files_removed >= 2
+    rows = dta.read_table(tmp_table_path)
+    ids = sorted(rows.column("id").to_pylist())
+    assert ids == list(range(10, 100)) + list(range(100, 150)) + list(range(200, 250))
+    # DVs were purged by the rewrite
+    assert all(f.deletionVector is None
+               for f in Table.for_path(tmp_table_path).latest_snapshot().scan().files())
+
+
+def test_drop_feature_deletion_vectors(tmp_table_path):
+    t = _dv_table(tmp_table_path)
+    # reader-writer feature requires TRUNCATE HISTORY
+    with pytest.raises(DeltaError, match="TRUNCATE HISTORY"):
+        drop_feature(t, "deletionVectors")
+    v = drop_feature(t, "deletionVectors", truncate_history=True)
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert snap.version == v
+    assert "deletionVectors" not in (snap.protocol.writerFeatures or [])
+    assert "deletionVectors" not in (snap.protocol.readerFeatures or [])
+    assert "delta.enableDeletionVectors" not in snap.metadata.configuration
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("id").to_pylist()) == list(range(10, 100))
+    # history was truncated: old commits are gone but head still loads
+    assert Table.for_path(tmp_table_path).latest_snapshot().version == v
+
+
+def test_drop_feature_ict(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append",
+                    properties={"delta.enableInCommitTimestamps": "true"})
+    t = Table.for_path(tmp_table_path)
+    assert "inCommitTimestamp" in (t.latest_snapshot().protocol.writerFeatures or [])
+    v = drop_feature(t, "inCommitTimestamp")
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert "inCommitTimestamp" not in (snap.protocol.writerFeatures or [])
+    conf = snap.metadata.configuration
+    assert "delta.enableInCommitTimestamps" not in conf
+    assert "delta.inCommitTimestampEnablementVersion" not in conf
+
+
+def test_add_constraint_upgrades_legacy_protocol(tmp_table_path):
+    """CHECK constraints demand writer v3 (PROTOCOL.md legacy table)."""
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2], pa.int64())}),
+                    mode="append")
+    assert Table.for_path(tmp_table_path).latest_snapshot().protocol.minWriterVersion == 2
+    sql(f"ALTER TABLE '{tmp_table_path}' ADD CONSTRAINT pos CHECK (id > 0)")
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert proto.minWriterVersion == 3
+    assert proto.writerFeatures is None  # legacy bump, not feature vectors
+
+
+def test_drop_feature_check_constraints_blocked(tmp_table_path):
+    # ICT forces a writer-7 feature-vector protocol, so the later
+    # ADD CONSTRAINT lists checkConstraints explicitly
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2], pa.int64())}),
+                    mode="append",
+                    properties={"delta.enableInCommitTimestamps": "true"})
+    sql(f"ALTER TABLE '{tmp_table_path}' ADD CONSTRAINT pos CHECK (id > 0)")
+    t = Table.for_path(tmp_table_path)
+    assert "checkConstraints" in (t.latest_snapshot().protocol.writerFeatures or [])
+    with pytest.raises(DeltaError, match="DROP CONSTRAINT"):
+        drop_feature(t, "checkConstraints")
+    sql(f"ALTER TABLE '{tmp_table_path}' DROP CONSTRAINT pos")
+    drop_feature(Table.for_path(tmp_table_path), "checkConstraints")
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert "checkConstraints" not in (proto.writerFeatures or [])
+
+
+def test_drop_feature_legacy_protocol_refused(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2], pa.int64())}),
+                    mode="append")
+    sql(f"ALTER TABLE '{tmp_table_path}' ADD CONSTRAINT pos CHECK (id > 0)")
+    with pytest.raises(DeltaError, match="listed explicitly"):
+        drop_feature(Table.for_path(tmp_table_path), "checkConstraints")
+
+
+def test_drop_feature_errors(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append")
+    t = Table.for_path(tmp_table_path)
+    with pytest.raises(DeltaError, match="unknown table feature"):
+        drop_feature(t, "nosuchfeature")
+    with pytest.raises(DeltaError, match="not present"):
+        drop_feature(t, "deletionVectors")
+
+
+def test_drop_feature_collapses_to_legacy_protocol(tmp_table_path):
+    """After dropping the only non-legacy feature, the protocol shrinks
+    back to legacy (reader, writer) versions."""
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append",
+                    properties={"delta.enableInCommitTimestamps": "true"})
+    t = Table.for_path(tmp_table_path)
+    drop_feature(t, "inCommitTimestamp")
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert proto.writerFeatures is None
+    assert proto.minWriterVersion <= 2
+
+
+def test_sql_drop_feature_and_reorg(tmp_table_path):
+    t = _dv_table(tmp_table_path)
+    metrics = sql(f"REORG TABLE '{tmp_table_path}' APPLY (PURGE)")
+    assert metrics.num_files_removed >= 1
+    sql(f"ALTER TABLE '{tmp_table_path}' DROP FEATURE deletionVectors "
+        "TRUNCATE HISTORY")
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert "deletionVectors" not in (proto.writerFeatures or [])
+
+
+def test_sql_alter_add_rename_drop_columns(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2], pa.int64()),
+                              "v": pa.array([1.0, 2.0])}),
+                    mode="append")
+    sql(f"ALTER TABLE '{tmp_table_path}' ADD COLUMNS (note string)")
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert [f.name for f in snap.schema.fields] == ["id", "v", "note"]
+
+    sql(f"ALTER TABLE '{tmp_table_path}' SET TBLPROPERTIES "
+        "('delta.columnMapping.mode' = 'name')")
+    sql(f"ALTER TABLE '{tmp_table_path}' RENAME COLUMN note TO comment")
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert [f.name for f in snap.schema.fields] == ["id", "v", "comment"]
+
+    sql(f"ALTER TABLE '{tmp_table_path}' DROP COLUMN comment")
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert [f.name for f in snap.schema.fields] == ["id", "v"]
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("id").to_pylist()) == [1, 2]
+
+    sql(f"ALTER TABLE '{tmp_table_path}' ADD COLUMNS (cnt int)")
+    sql(f"ALTER TABLE '{tmp_table_path}' SET TBLPROPERTIES "
+        "('delta.enableTypeWidening' = 'true')")
+    sql(f"ALTER TABLE '{tmp_table_path}' ALTER COLUMN cnt TYPE long")
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert snap.schema["cnt"].dataType.name == "long"
+
+    sql(f"ALTER TABLE '{tmp_table_path}' UNSET TBLPROPERTIES ('nokey')")
+
+
+def test_upgrade_to_feature_vectors_keeps_implied_legacy_features(tmp_table_path):
+    """Enabling a non-legacy feature on a legacy protocol must fold the
+    implicitly supported legacy features into the new feature lists."""
+    from delta_tpu.features import COLUMN_MAPPING, is_feature_supported
+
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append",
+                    properties={"delta.columnMapping.mode": "name"})
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert is_feature_supported(proto, COLUMN_MAPPING)
+    # now activate a non-legacy feature → protocol moves to vectors
+    sql(f"ALTER TABLE '{tmp_table_path}' SET TBLPROPERTIES "
+        "('delta.enableDeletionVectors' = 'true')")
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert proto.minWriterVersion == 7
+    assert "columnMapping" in (proto.writerFeatures or [])
+    assert "columnMapping" in (proto.readerFeatures or [])
+    assert is_feature_supported(proto, COLUMN_MAPPING)
+
+
+def test_add_column_with_default_upgrades_protocol(tmp_table_path):
+    """ADD COLUMNS carrying CURRENT_DEFAULT metadata must list the
+    allowColumnDefaults writer feature."""
+    from delta_tpu.colgen import default_field
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.models.schema import STRING
+
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append")
+    add_columns(Table.for_path(tmp_table_path),
+                [default_field("status", STRING, "'new'")])
+    proto = Table.for_path(tmp_table_path).latest_snapshot().protocol
+    assert proto.minWriterVersion == 7
+    assert "allowColumnDefaults" in (proto.writerFeatures or [])
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([2], pa.int64())}),
+                    mode="append")
+    rows = dta.read_table(tmp_table_path)
+    assert set(rows.column("status").to_pylist()) <= {None, "new"}
+
+
+def test_sql_bad_type_raises_delta_error(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append")
+    with pytest.raises(DeltaError, match="unknown primitive type"):
+        sql(f"ALTER TABLE '{tmp_table_path}' ALTER COLUMN id TYPE frobtype")
+
+
+def test_dml_on_column_mapped_table(tmp_table_path):
+    """Copy-on-write DELETE and UPDATE work after a rename under column
+    mapping (physical names differ from logical)."""
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array(np.arange(10, dtype=np.int64)),
+                              "v": pa.array(np.arange(10, dtype=np.float64))}),
+                    mode="append")
+    sql(f"ALTER TABLE '{tmp_table_path}' SET TBLPROPERTIES "
+        "('delta.columnMapping.mode' = 'name')")
+    sql(f"ALTER TABLE '{tmp_table_path}' RENAME COLUMN v TO val")
+    sql(f"DELETE FROM '{tmp_table_path}' WHERE id < 3")
+    sql(f"UPDATE '{tmp_table_path}' SET val = 99.0 WHERE id = 5")
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("id").to_pylist()) == list(range(3, 10))
+    by_id = dict(zip(rows.column("id").to_pylist(),
+                     rows.column("val").to_pylist()))
+    assert by_id[5] == 99.0
+    # OPTIMIZE under mapping also works
+    Table.for_path(tmp_table_path).optimize().execute_compaction()
+    rows = dta.read_table(tmp_table_path)
+    assert sorted(rows.column("id").to_pylist()) == list(range(3, 10))
