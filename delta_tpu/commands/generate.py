@@ -1,0 +1,147 @@
+"""GENERATE symlink_format_manifest: Hive/Presto/Athena-readable
+manifests of the table's live data files.
+
+Reference `commands/DeltaGenerateCommand.scala` +
+`hooks/GenerateSymlinkManifest.scala`: writes one text file per
+partition under `_symlink_format_manifest/`, each line an absolute data
+file URI. With the `delta.compatibility.symlinkFormatManifest.enabled`
+table property, a post-commit hook regenerates only the partitions a
+commit touched and deletes manifests of emptied partitions.
+
+Deletion vectors cannot be expressed in a symlink manifest (external
+engines would read soft-deleted rows), so generation refuses when any
+live file carries a DV — same gate as the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.stats.partition import partition_path
+
+MANIFEST_DIR = "_symlink_format_manifest"
+MANIFEST_NAME = "manifest"
+
+
+def _manifest_location(table_path: str, pv: Dict[str, Optional[str]],
+                       partition_columns: List[str]) -> str:
+    rel = partition_path(pv, partition_columns).rstrip("/")
+    base = f"{table_path}/{MANIFEST_DIR}"
+    return f"{base}/{rel}/{MANIFEST_NAME}" if rel else f"{base}/{MANIFEST_NAME}"
+
+
+def _absolute(table_path: str, p: str) -> str:
+    if "://" in p or p.startswith("/"):
+        return p
+    return os.path.join(table_path, p)
+
+
+def generate_symlink_manifest(table) -> Dict[str, int]:
+    """Full regeneration: one manifest per live partition; stale
+    partition manifests are removed. Returns {manifest_path: num_files}."""
+    snapshot = table.latest_snapshot()
+    if snapshot is None:
+        raise DeltaError(f"no table at {table.path}")
+    _check_compatible(snapshot)
+    files = snapshot.scan().files()
+    _check_no_dvs(files)
+    part_cols = snapshot.partition_columns
+
+    groups: Dict[Tuple, List[str]] = {}
+    for f in files:
+        pv = f.partitionValues or {}
+        key = tuple(pv.get(c) for c in part_cols)
+        groups.setdefault(key, []).append(_absolute(table.path, f.path))
+
+    written = _write_manifests(table, part_cols, groups)
+    _delete_stale_manifests(table, keep=set(written))
+    return written
+
+
+def incremental_symlink_manifest_hook(table, txn, version: int, metadata) -> None:
+    """Post-commit: regenerate manifests only for the partitions the
+    commit added or removed files in (reference
+    `GenerateSymlinkManifest.incrementally`)."""
+    if metadata.configuration.get(
+            "delta.compatibility.symlinkFormatManifest.enabled", ""
+    ).lower() != "true":
+        return
+    touched_pvs = [a.partitionValues or {} for a in txn._adds]
+    touched_pvs += [r.partitionValues or {} for r in txn._removes]
+    if not touched_pvs:
+        return
+    snapshot = table.snapshot_at(version)
+    _check_compatible(snapshot)
+    part_cols = snapshot.partition_columns
+    touched: Set[Tuple] = {
+        tuple(pv.get(c) for c in part_cols) for pv in touched_pvs
+    }
+
+    files = snapshot.scan().files()
+    _check_no_dvs(files)
+    groups: Dict[Tuple, List[str]] = {k: [] for k in touched}
+    for f in files:
+        pv = f.partitionValues or {}
+        key = tuple(pv.get(c) for c in part_cols)
+        if key in touched:
+            groups[key].append(_absolute(table.path, f.path))
+
+    live = {k: v for k, v in groups.items() if v}
+    _write_manifests(table, part_cols, live)
+    # partitions that lost their last file lose their manifest
+    for key in touched - set(live):
+        pv = dict(zip(part_cols, key))
+        loc = _manifest_location(table.path, pv, part_cols)
+        try:
+            table.engine.fs.delete(loc)
+        except FileNotFoundError:
+            pass
+
+
+def _check_compatible(snapshot) -> None:
+    """Column mapping renames physical columns/partition dirs in ways a
+    symlink manifest cannot describe to external engines (same gate as
+    the reference's GenerateSymlinkManifest protocol check)."""
+    from delta_tpu.columnmapping import mapping_mode
+
+    if mapping_mode(snapshot.metadata.configuration) != "none":
+        raise DeltaError(
+            "symlink manifests are not supported on column-mapped tables")
+
+
+def _check_no_dvs(files: Iterable) -> None:
+    n = sum(1 for f in files if f.deletionVector is not None)
+    if n:
+        raise DeltaError(
+            f"cannot generate symlink manifests: {n} live file(s) carry "
+            "deletion vectors (external engines would see deleted rows); "
+            "run REORG TABLE ... APPLY (PURGE) first")
+
+
+def _write_manifests(table, part_cols: List[str],
+                     groups: Dict[Tuple, List[str]]) -> Dict[str, int]:
+    written: Dict[str, int] = {}
+    for key, paths in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        pv = dict(zip(part_cols, key))
+        loc = _manifest_location(table.path, pv, part_cols)
+        body = ("\n".join(sorted(paths)) + "\n").encode()
+        table.engine.fs.mkdirs(os.path.dirname(loc))
+        table.engine.fs.write_file(loc, body)
+        written[loc] = len(paths)
+    return written
+
+
+def _delete_stale_manifests(table, keep: Set[str]) -> None:
+    root = f"{table.path}/{MANIFEST_DIR}"
+    try:
+        listing = list(table.engine.fs.walk(root))
+    except FileNotFoundError:
+        return
+    for f in listing:
+        if os.path.basename(f.path) == MANIFEST_NAME and f.path not in keep:
+            try:
+                table.engine.fs.delete(f.path)
+            except FileNotFoundError:
+                pass

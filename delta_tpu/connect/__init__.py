@@ -1,0 +1,10 @@
+"""Remote table protocol (the Delta Connect role, reference
+`spark-connect/`): a thin length-prefixed JSON + Arrow-IPC protocol so
+clients in other processes/hosts can read, write, and administer Delta
+tables served by a delta-tpu engine without importing the engine
+themselves."""
+
+from delta_tpu.connect.client import DeltaConnectClient, connect
+from delta_tpu.connect.server import DeltaConnectServer
+
+__all__ = ["DeltaConnectServer", "DeltaConnectClient", "connect"]

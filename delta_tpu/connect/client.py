@@ -1,0 +1,119 @@
+"""Connect client: remote mirror of the table API (the reference's
+Scala/Python Delta Connect clients, `spark-connect/client/` and
+`python/delta/connect/tables.py`).
+
+    with connect("127.0.0.1", 9477) as session:
+        session.write_table("/data/t", arrow_table, mode="append")
+        rows = session.read_table("/data/t", filter="id > 5")
+        session.sql("OPTIMIZE '/data/t'")
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional, Sequence
+
+import pyarrow as pa
+
+from delta_tpu.connect.protocol import (
+    ipc_to_table,
+    recv_frame,
+    send_frame,
+    table_to_ipc,
+)
+from delta_tpu.errors import DeltaError
+
+
+class RemoteDeltaError(DeltaError):
+    """Server-side failure surfaced to the client."""
+
+    def __init__(self, message: str, error_class: str = "DeltaError"):
+        super().__init__(f"[{error_class}] {message}")
+        self.error_class = error_class
+
+
+class DeltaConnectClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9477,
+                 timeout: float = 120.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._lock = threading.Lock()
+
+    # -- plumbing ------------------------------------------------------
+    def _call(self, op: str, payload: bytes = b"", **params):
+        with self._lock:
+            send_frame(self._sock, {"op": op, **params}, payload)
+            envelope, out_payload = recv_frame(self._sock)
+        if not envelope.get("ok"):
+            raise RemoteDeltaError(envelope.get("error", "unknown error"),
+                                   envelope.get("error_class", "DeltaError"))
+        return envelope, out_payload
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- API -----------------------------------------------------------
+    def ping(self) -> bool:
+        env, _ = self._call("ping")
+        return bool(env.get("pong"))
+
+    def read_table(self, path: str, columns: Optional[Sequence[str]] = None,
+                   filter: Optional[str] = None,
+                   version: Optional[int] = None) -> pa.Table:
+        _, payload = self._call(
+            "read", path=path, columns=list(columns) if columns else None,
+            filter=filter, version=version)
+        return ipc_to_table(payload)
+
+    def write_table(self, path: str, data: pa.Table, mode: str = "append",
+                    partition_by: Optional[Sequence[str]] = None,
+                    properties: Optional[dict] = None) -> int:
+        env, _ = self._call(
+            "write", payload=table_to_ipc(data), path=path, mode=mode,
+            partition_by=list(partition_by) if partition_by else None,
+            properties=properties)
+        return env["version"]
+
+    def sql(self, statement: str):
+        env, payload = self._call("sql", statement=statement)
+        if env.get("kind") == "table":
+            return ipc_to_table(payload)
+        return env.get("result")
+
+    def history(self, path: str, limit: Optional[int] = None):
+        env, _ = self._call("history", path=path, limit=limit)
+        return env["history"]
+
+    def detail(self, path: str) -> dict:
+        env, _ = self._call("detail", path=path)
+        return env["detail"]
+
+    def table_version(self, path: str) -> int:
+        env, _ = self._call("version", path=path)
+        return env["version"]
+
+    def optimize(self, path: str,
+                 zorder_by: Optional[Sequence[str]] = None) -> dict:
+        env, _ = self._call("optimize", path=path,
+                            zorder_by=list(zorder_by) if zorder_by else None)
+        return env["metrics"]
+
+    def vacuum(self, path: str, retention_hours: Optional[float] = None,
+               dry_run: bool = False):
+        env, _ = self._call("vacuum", path=path,
+                            retention_hours=retention_hours, dry_run=dry_run)
+        return env["deleted"]
+
+
+def connect(host: str = "127.0.0.1", port: int = 9477,
+            timeout: float = 120.0) -> DeltaConnectClient:
+    return DeltaConnectClient(host, port, timeout)

@@ -1,0 +1,64 @@
+"""Wire framing for the connect protocol.
+
+One message = 8-byte little-endian header (4B JSON length, 4B payload
+length) + UTF-8 JSON envelope + optional Arrow IPC stream payload. The
+same frame shape is used for requests and responses — the reference uses
+protobuf relations/commands over Spark Connect
+(`spark-connect/common/src/main/protobuf/delta/connect/*.proto`); JSON +
+Arrow IPC is the engine-neutral equivalent here.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+_HEADER = struct.Struct("<II")
+MAX_FRAME = 1 << 31
+
+
+def send_frame(sock: socket.socket, envelope: dict,
+               payload: bytes = b"") -> None:
+    body = json.dumps(envelope).encode()
+    sock.sendall(_HEADER.pack(len(body), len(payload)) + body + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    header = recv_exact(sock, _HEADER.size)
+    json_len, payload_len = _HEADER.unpack(header)
+    if json_len > MAX_FRAME or payload_len > MAX_FRAME:
+        raise ConnectionError("oversized frame")
+    envelope = json.loads(recv_exact(sock, json_len)) if json_len else {}
+    payload = recv_exact(sock, payload_len) if payload_len else b""
+    return envelope, payload
+
+
+def table_to_ipc(table: Optional[pa.Table]) -> bytes:
+    if table is None:
+        return b""
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def ipc_to_table(data: bytes) -> Optional[pa.Table]:
+    if not data:
+        return None
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_all()

@@ -1,0 +1,183 @@
+"""Connect server: serves Delta tables over the framed JSON/Arrow
+protocol (the `DeltaRelationPlugin`/`DeltaCommandPlugin` role from the
+reference's `spark-connect/server/`).
+
+Operations: ping, read, write, sql, history, detail, version, optimize,
+vacuum. Each request envelope is `{"op": ..., **params}`; tabular
+results travel as an Arrow IPC payload, scalar results inside the JSON
+envelope. Errors return `{"ok": false, "error", "error_class"}`.
+
+Security note: the server executes operations on local table paths on
+behalf of remote clients; `allowed_root` confines requests to one
+directory tree.
+"""
+
+from __future__ import annotations
+
+import os
+import socketserver
+import threading
+from typing import Optional
+
+from delta_tpu.connect.protocol import (
+    ipc_to_table,
+    recv_frame,
+    send_frame,
+    table_to_ipc,
+)
+from delta_tpu.errors import DeltaError
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                envelope, payload = recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                result, out_payload = self.server._dispatch(envelope, payload)
+                send_frame(self.request, {"ok": True, **(result or {})},
+                           out_payload)
+            except Exception as e:  # error envelope, keep connection alive
+                send_frame(self.request, {
+                    "ok": False,
+                    "error": str(e),
+                    "error_class": type(e).__name__,
+                })
+
+
+class DeltaConnectServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 engine=None, allowed_root: Optional[str] = None):
+        super().__init__((host, port), _Handler)
+        self.engine = engine
+        self.allowed_root = (os.path.abspath(allowed_root)
+                             if allowed_root else None)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def address(self):
+        return self.server_address
+
+    def start_background(self) -> "DeltaConnectServer":
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- dispatch ------------------------------------------------------
+    def _check_root(self, path: str) -> None:
+        if self.allowed_root is not None:
+            resolved = os.path.abspath(path)
+            if not (resolved + "/").startswith(self.allowed_root + "/"):
+                raise DeltaError(f"path {path!r} is outside the served root")
+
+    def _table(self, path: str):
+        from delta_tpu.table import Table
+
+        self._check_root(path)
+        return Table.for_path(path, engine=self.engine)
+
+    def _dispatch(self, env: dict, payload: bytes):
+        op = env.get("op")
+        if op == "ping":
+            return {"pong": True}, b""
+
+        if op == "read":
+            t = self._table(env["path"])
+            snap = (t.snapshot_at(env["version"])
+                    if env.get("version") is not None
+                    else t.latest_snapshot())
+            pred = None
+            if env.get("filter"):
+                from delta_tpu.expressions.parser import parse_expression
+
+                pred = parse_expression(env["filter"])
+            data = snap.scan(filter=pred, columns=env.get("columns")).to_arrow()
+            return {"num_rows": data.num_rows,
+                    "version": snap.version}, table_to_ipc(data)
+
+        if op == "write":
+            data = ipc_to_table(payload)
+            if data is None:
+                raise DeltaError("write requires an Arrow payload")
+            import delta_tpu.api as dta
+
+            self._table(env["path"])  # root check
+            v = dta.write_table(
+                env["path"], data,
+                mode=env.get("mode", "append"),
+                partition_by=env.get("partition_by"),
+                properties=env.get("properties"),
+                engine=self.engine)
+            return {"version": v}, b""
+
+        if op == "sql":
+            import pyarrow as pa
+
+            from delta_tpu.sql import sql as run_sql
+
+            out = run_sql(env["statement"], engine=self.engine,
+                          path_guard=self._check_root)
+            if isinstance(out, pa.Table):
+                return {"kind": "table"}, table_to_ipc(out)
+            if hasattr(out, "to_dict"):
+                out = out.to_dict()
+            return {"kind": "json", "result": out}, b""
+
+        if op == "history":
+            t = self._table(env["path"])
+            return {"history": [r.to_dict()
+                                for r in t.history(env.get("limit"))]}, b""
+
+        if op == "detail":
+            from delta_tpu.sql import describe_detail
+
+            return {"detail": describe_detail(self._table(env["path"]))}, b""
+
+        if op == "version":
+            return {"version": self._table(env["path"]).latest_snapshot().version}, b""
+
+        if op == "optimize":
+            t = self._table(env["path"])
+            builder = t.optimize()
+            if env.get("zorder_by"):
+                m = builder.execute_zorder_by(*env["zorder_by"])
+            else:
+                m = builder.execute_compaction()
+            return {"metrics": m.to_dict()}, b""
+
+        if op == "vacuum":
+            from delta_tpu.commands.vacuum import vacuum
+
+            deleted = vacuum(self._table(env["path"]),
+                             retention_hours=env.get("retention_hours"),
+                             dry_run=env.get("dry_run", False))
+            return {"deleted": deleted if isinstance(deleted, int)
+                    else len(deleted)}, b""
+
+        raise DeltaError(f"unknown connect op {op!r}")
+
+
+def serve(path_root: str, host: str = "127.0.0.1", port: int = 9477):
+    """Blocking entry point: `python -m delta_tpu.connect.server /root`."""
+    srv = DeltaConnectServer(host, port, allowed_root=path_root)
+    print(f"delta-tpu connect server on {srv.address}, root={path_root}")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve(sys.argv[1] if len(sys.argv) > 1 else ".",
+          port=int(sys.argv[2]) if len(sys.argv) > 2 else 9477)

@@ -88,6 +88,9 @@ class HostFileSystemClient(FileSystemClient):
     def mkdirs(self, path: str) -> None:
         self._store_for(path).mkdirs(path)
 
+    def walk(self, path: str):
+        return self._store_for(path).walk(path)
+
     def delete(self, path: str) -> None:
         self._store_for(path).delete(path)
 

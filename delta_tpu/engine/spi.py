@@ -69,6 +69,10 @@ class FileSystemClient:
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
 
+    def walk(self, path: str) -> Iterator[FileStatus]:
+        """Recursively yield every file under `path`."""
+        raise NotImplementedError
+
     def delete(self, path: str) -> None:
         raise NotImplementedError
 

@@ -78,12 +78,39 @@ def uniform_hooks(table, txn, version: int, metadata) -> None:
         hudi_converter_hook(table, txn, version, metadata)
 
 
+def symlink_manifest_hook(table, txn, version: int, metadata) -> None:
+    from delta_tpu.commands.generate import incremental_symlink_manifest_hook
+
+    incremental_symlink_manifest_hook(table, txn, version, metadata)
+
+
+# A failed manifest update means external engines keep serving stale —
+# possibly soft-deleted — rows, so unlike best-effort hooks its error
+# must surface (the commit itself has already landed), matching the
+# reference's GenerateSymlinkManifest.handleError.
+symlink_manifest_hook.critical = True
+
+
+class PostCommitHookError(Exception):
+    """A critical post-commit hook failed. The commit itself succeeded."""
+
+    def __init__(self, hook_name: str, version: int, cause: Exception):
+        super().__init__(
+            f"post-commit hook {hook_name!r} failed after version "
+            f"{version} committed: {cause}")
+        self.hook_name = hook_name
+        self.version = version
+        self.__cause__ = cause
+
+
 def run_post_commit_hooks(table, txn, version: int, metadata) -> None:
     for hook in (
         checksum_hook, checkpoint_hook, auto_compact_hook, uniform_hooks,
+        symlink_manifest_hook,
         *_EXTRA_HOOKS,
     ):
         try:
             hook(table, txn, version, metadata)
-        except Exception:
-            pass
+        except Exception as e:
+            if getattr(hook, "critical", False):
+                raise PostCommitHookError(hook.__name__, version, e) from e

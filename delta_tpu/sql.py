@@ -62,8 +62,21 @@ _SQL_TYPES = {
 }
 
 
+import contextvars
+
+# optional callable(path) -> None that raises for disallowed paths; set
+# by embedders (e.g. the connect server's allowed_root confinement) for
+# the duration of a sql() call
+_PATH_GUARD: contextvars.ContextVar = contextvars.ContextVar(
+    "delta_sql_path_guard", default=None)
+
+
 def _path_of(m) -> str:
-    return m.group("path") or m.group("path2") or m.group("path3")
+    path = m.group("path") or m.group("path2") or m.group("path3")
+    guard = _PATH_GUARD.get()
+    if guard is not None:
+        guard(path)
+    return path
 
 
 def _table(m, engine, catalog=None) -> Table:
@@ -77,9 +90,17 @@ def _table(m, engine, catalog=None) -> Table:
     return Table.for_path(_path_of(m), engine)
 
 
-def sql(statement: str, engine=None, catalog=None):
+def sql(statement: str, engine=None, catalog=None, path_guard=None):
     """Execute one Delta SQL statement against a table path or (with a
-    catalog) a table name."""
+    catalog) a table name. `path_guard(path)` — when given — is invoked
+    for every table path the statement references and may raise to
+    reject it."""
+    if path_guard is not None:
+        token = _PATH_GUARD.set(path_guard)
+        try:
+            return sql(statement, engine=engine, catalog=catalog)
+        finally:
+            _PATH_GUARD.reset(token)
     s = statement.strip().rstrip(";").strip()
     if catalog is not None and engine is None:
         engine = catalog.engine
@@ -298,6 +319,15 @@ def sql(statement: str, engine=None, catalog=None):
         from delta_tpu.commands.reorg import reorg_purge
 
         return reorg_purge(_table(m, engine, catalog))
+
+    m = re.fullmatch(
+        rf"GENERATE\s+symlink_format_manifest\s+FOR\s+TABLE\s+{_PATH}",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.generate import generate_symlink_manifest
+
+        return generate_symlink_manifest(_table(m, engine, catalog))
 
     m = re.fullmatch(
         rf"DELETE\s+FROM\s+{_PATH}(?:\s+WHERE\s+(?P<where>.+))?",

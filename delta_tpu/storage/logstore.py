@@ -61,6 +61,10 @@ class LogStore:
     def list_dir(self, path: str) -> List[FileStatus]:
         raise NotImplementedError
 
+    def walk(self, path: str) -> Iterator[FileStatus]:
+        """Recursively yield every file under `path`."""
+        raise NotImplementedError
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -136,6 +140,16 @@ class LocalLogStore(LogStore):
             out.append(FileStatus(full, st.st_size, int(st.st_mtime * 1000)))
         return out
 
+    def walk(self, path: str) -> Iterator[FileStatus]:
+        for root, _dirs, files in os.walk(path):
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                try:
+                    st = os.stat(full)
+                except FileNotFoundError:
+                    continue
+                yield FileStatus(full, st.st_size, int(st.st_mtime * 1000))
+
     def exists(self, path: str) -> bool:
         return os.path.exists(path)
 
@@ -202,6 +216,16 @@ class InMemoryLogStore(LogStore):
                 if p.rpartition("/")[0] == path
             ]
         return sorted(out, key=lambda fs: fs.path)
+
+    def walk(self, path: str) -> Iterator[FileStatus]:
+        prefix = path.rstrip("/") + "/"
+        with self._lock:
+            out = [
+                FileStatus(p, len(d), m)
+                for p, (d, m) in self._files.items()
+                if p.startswith(prefix)
+            ]
+        return iter(sorted(out, key=lambda fs: fs.path))
 
     def exists(self, path: str) -> bool:
         with self._lock:

@@ -1,0 +1,3 @@
+"""Operational tools: bulk import (the `connectors/sql-delta-import`
+equivalent) and the remote-protocol server/client live under
+`delta_tpu.connect`."""

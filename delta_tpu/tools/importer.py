@@ -1,0 +1,231 @@
+"""Bulk import into Delta tables: the `connectors/sql-delta-import`
+role (reference `connectors/sql-delta-import/src/main/scala/.../
+ImportRunner.scala`) rebuilt for file sources.
+
+The reference splits a JDBC source into numeric-range chunks and writes
+each chunk through the Delta writer; here the source is CSV / Parquet /
+NDJSON files (plus any Arrow-readable iterable), chunked by row count,
+with each chunk appended in its own transaction so imports of arbitrary
+size never materialize fully in memory. A SQLite source covers the
+"database table → Delta" path without a JDBC driver.
+
+CLI:
+    python -m delta_tpu.tools.importer --source data.csv \
+        --destination /path/to/table [--format csv|parquet|ndjson|sqlite]
+        [--partition-by col,col] [--chunk-rows N] [--mode append|overwrite]
+        [--query 'SELECT ...'] (sqlite only)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+
+DEFAULT_CHUNK_ROWS = 1_000_000
+
+
+@dataclass
+class ImportResult:
+    num_rows: int = 0
+    num_chunks: int = 0
+    num_source_files: int = 0
+    first_version: Optional[int] = None
+    last_version: Optional[int] = None
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+def _detect_format(path: str) -> str:
+    ext = os.path.splitext(path)[1].lower().lstrip(".")
+    if ext in ("csv", "tsv"):
+        return "csv"
+    if ext in ("parquet", "pq"):
+        return "parquet"
+    if ext in ("json", "jsonl", "ndjson"):
+        return "ndjson"
+    if ext in ("db", "sqlite", "sqlite3"):
+        return "sqlite"
+    raise DeltaError(
+        f"cannot infer import format from {path!r}; pass --format")
+
+
+def _expand_sources(source: str) -> List[str]:
+    if os.path.isdir(source):
+        files = sorted(
+            p for p in glob.glob(os.path.join(source, "**", "*"), recursive=True)
+            if os.path.isfile(p) and not os.path.basename(p).startswith((".", "_"))
+        )
+    else:
+        files = sorted(glob.glob(source)) or [source]
+    missing = [f for f in files if not os.path.exists(f)]
+    if missing:
+        raise DeltaError(f"source file(s) not found: {missing}")
+    return files
+
+
+def _iter_batches(path: str, fmt: str, chunk_rows: int,
+                  query: Optional[str] = None) -> Iterator[pa.Table]:
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        delim = "\t" if path.endswith(".tsv") else ","
+        with pacsv.open_csv(
+            path,
+            read_options=pacsv.ReadOptions(block_size=16 << 20),
+            parse_options=pacsv.ParseOptions(delimiter=delim),
+        ) as reader:
+            for batch in reader:
+                yield pa.Table.from_batches([batch])
+    elif fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        f = pq.ParquetFile(path)
+        for batch in f.iter_batches(batch_size=chunk_rows):
+            yield pa.Table.from_batches([batch])
+    elif fmt == "ndjson":
+        import pyarrow.json as pajson
+
+        # pyarrow.json reads whole-file; chunk by slicing
+        tbl = pajson.read_json(path)
+        for start in range(0, max(tbl.num_rows, 1), chunk_rows):
+            sl = tbl.slice(start, chunk_rows)
+            if sl.num_rows or tbl.num_rows == 0:
+                yield sl
+    elif fmt == "sqlite":
+        yield from _iter_sqlite(path, query, chunk_rows)
+    else:
+        raise DeltaError(f"unsupported import format {fmt!r}")
+
+
+def _iter_sqlite(path: str, query: Optional[str],
+                 chunk_rows: int) -> Iterator[pa.Table]:
+    import sqlite3
+
+    conn = sqlite3.connect(path)
+    try:
+        if query is None:
+            tables = [r[0] for r in conn.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'")]
+            if len(tables) != 1:
+                raise DeltaError(
+                    f"sqlite source has tables {tables}; pass --query "
+                    "'SELECT ... FROM <table>'")
+            query = f"SELECT * FROM {tables[0]}"
+        cur = conn.execute(query)
+        names = [d[0] for d in cur.description]
+        schema: Optional[pa.Schema] = None
+        while True:
+            rows = cur.fetchmany(chunk_rows)
+            if not rows:
+                break
+            cols = list(zip(*rows))
+            tbl = pa.table({n: pa.array(list(c)) for n, c in zip(names, cols)})
+            # all-NULL columns infer arrow's null type and chunk-local
+            # inference can drift; pin the first chunk's schema (nulls →
+            # string) and cast every later chunk to it
+            if schema is None:
+                fields = [
+                    pa.field(f.name, pa.string() if pa.types.is_null(f.type)
+                             else f.type)
+                    for f in tbl.schema
+                ]
+                schema = pa.schema(fields)
+            yield tbl.cast(schema)
+    finally:
+        conn.close()
+
+
+def _accumulate(batches: Iterator[pa.Table], chunk_rows: int) -> Iterator[pa.Table]:
+    """Regroup arbitrary-size batches into ≤chunk_rows transactions
+    (oversized source batches are sliced, small ones coalesced)."""
+    pending: List[pa.Table] = []
+    n = 0
+    for b in batches:
+        for start in range(0, max(b.num_rows, 1), chunk_rows):
+            sl = b.slice(start, chunk_rows)
+            pending.append(sl)
+            n += sl.num_rows
+            if n >= chunk_rows:
+                yield pa.concat_tables(pending, promote_options="permissive")
+                pending, n = [], 0
+    if pending:
+        yield pa.concat_tables(pending, promote_options="permissive")
+
+
+def import_into_delta(
+    source: str,
+    destination: str,
+    fmt: Optional[str] = None,
+    partition_by: Optional[Sequence[str]] = None,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    mode: str = "append",
+    query: Optional[str] = None,
+    engine=None,
+) -> ImportResult:
+    """Stream `source` into the Delta table at `destination` in
+    chunk-sized transactions. `mode='overwrite'` replaces the table with
+    the first chunk, then appends."""
+    import delta_tpu.api as dta
+
+    files = _expand_sources(source)
+    result = ImportResult(num_source_files=len(files))
+    write_mode = mode
+    for path in files:
+        f_fmt = fmt or _detect_format(path)
+        for chunk in _accumulate(
+                _iter_batches(path, f_fmt, chunk_rows, query), chunk_rows):
+            if chunk.num_rows == 0 and result.num_chunks:
+                continue
+            v = dta.write_table(
+                destination, chunk, mode=write_mode,
+                partition_by=partition_by, engine=engine)
+            write_mode = "append"  # only the first chunk may overwrite
+            result.num_rows += chunk.num_rows
+            result.num_chunks += 1
+            if result.first_version is None:
+                result.first_version = v
+            result.last_version = v
+    if result.num_chunks == 0:
+        raise DeltaError(f"source {source!r} produced no rows")
+    return result
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="delta-tpu-import",
+        description="Bulk-import CSV/Parquet/NDJSON/SQLite into a Delta table")
+    ap.add_argument("--source", required=True,
+                    help="file, glob, or directory to import")
+    ap.add_argument("--destination", required=True, help="Delta table path")
+    ap.add_argument("--format", dest="fmt",
+                    choices=["csv", "parquet", "ndjson", "sqlite"])
+    ap.add_argument("--partition-by", default=None,
+                    help="comma-separated partition columns")
+    ap.add_argument("--chunk-rows", type=int, default=DEFAULT_CHUNK_ROWS)
+    ap.add_argument("--mode", choices=["append", "overwrite"], default="append")
+    ap.add_argument("--query", default=None,
+                    help="SELECT statement (sqlite sources)")
+    args = ap.parse_args(argv)
+    result = import_into_delta(
+        source=args.source,
+        destination=args.destination,
+        fmt=args.fmt,
+        partition_by=(args.partition_by.split(",") if args.partition_by else None),
+        chunk_rows=args.chunk_rows,
+        mode=args.mode,
+        query=args.query,
+    )
+    print(result.to_dict())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

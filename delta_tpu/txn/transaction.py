@@ -613,11 +613,15 @@ class Transaction:
 
     def _run_post_commit_hooks(self, version: int) -> None:
         meta = self.metadata()
-        try:
-            from delta_tpu.hooks import run_post_commit_hooks
+        from delta_tpu.hooks import PostCommitHookError, run_post_commit_hooks
 
+        try:
             run_post_commit_hooks(self._table, self, version, meta)
+        except PostCommitHookError:
+            # the commit has landed; a critical hook (e.g. symlink
+            # manifest) failing is a caller-visible error
+            raise
         except Exception:
-            # Post-commit hooks are best-effort (reference: hook failures
-            # do not fail the commit).
+            # Other post-commit hooks are best-effort (reference: hook
+            # failures do not fail the commit).
             pass

@@ -1,0 +1,148 @@
+"""Symlink-format manifest generation: full GENERATE, incremental hook,
+and the DV / column-mapping gates."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.commands.dml import delete
+from delta_tpu.commands.generate import (
+    MANIFEST_DIR,
+    MANIFEST_NAME,
+    generate_symlink_manifest,
+)
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.parser import parse_expression
+from delta_tpu.sql import sql
+from delta_tpu.table import Table
+
+
+def _read_manifest(path):
+    with open(path) as f:
+        return [l for l in f.read().splitlines() if l]
+
+
+def test_generate_unpartitioned(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1, 2, 3], pa.int64())}),
+                    mode="append")
+    written = generate_symlink_manifest(Table.for_path(tmp_table_path))
+    loc = f"{tmp_table_path}/{MANIFEST_DIR}/{MANIFEST_NAME}"
+    assert list(written) == [loc]
+    lines = _read_manifest(loc)
+    live = {os.path.join(tmp_table_path, f.path)
+            for f in Table.for_path(tmp_table_path).latest_snapshot().scan().files()}
+    assert set(lines) == live
+    assert all(os.path.isfile(l) for l in lines)
+
+
+def test_generate_partitioned_and_stale_cleanup(tmp_table_path):
+    data = pa.table({
+        "id": pa.array(np.arange(20, dtype=np.int64)),
+        "part": pa.array(["a"] * 10 + ["b"] * 10),
+    })
+    dta.write_table(tmp_table_path, data, mode="append", partition_by=["part"])
+    written = generate_symlink_manifest(Table.for_path(tmp_table_path))
+    assert len(written) == 2
+    assert any("part=a" in p for p in written)
+    assert any("part=b" in p for p in written)
+    # the files actually exist on disk at the reported locations and
+    # name real data files
+    for loc, n in written.items():
+        assert os.path.isfile(loc), loc
+        lines = _read_manifest(loc)
+        assert len(lines) == n
+        assert all(os.path.isfile(l) for l in lines)
+
+    # delete all of partition b, regenerate → its manifest disappears
+    delete(Table.for_path(tmp_table_path), parse_expression("part = 'b'"))
+    written = generate_symlink_manifest(Table.for_path(tmp_table_path))
+    assert len(written) == 1
+    assert not os.path.exists(
+        f"{tmp_table_path}/{MANIFEST_DIR}/part=b/{MANIFEST_NAME}")
+
+
+def test_incremental_hook_on_commit(tmp_table_path):
+    dta.write_table(
+        tmp_table_path,
+        pa.table({"id": pa.array([1], pa.int64()),
+                  "part": pa.array(["a"])}),
+        mode="append", partition_by=["part"],
+        properties={"delta.compatibility.symlinkFormatManifest.enabled": "true"})
+    loc_a = f"{tmp_table_path}/{MANIFEST_DIR}/part=a/{MANIFEST_NAME}"
+    assert os.path.isfile(loc_a), "hook should fire on the creating commit"
+
+    # append to a new partition: only that partition's manifest appears
+    dta.write_table(
+        tmp_table_path,
+        pa.table({"id": pa.array([2], pa.int64()), "part": pa.array(["b"])}),
+        mode="append")
+    loc_b = f"{tmp_table_path}/{MANIFEST_DIR}/part=b/{MANIFEST_NAME}"
+    assert os.path.isfile(loc_b)
+    assert len(_read_manifest(loc_b)) == 1
+
+    # delete partition a: manifest removed by the hook
+    delete(Table.for_path(tmp_table_path), parse_expression("part = 'a'"))
+    assert not os.path.exists(loc_a)
+    assert os.path.isfile(loc_b)
+
+
+def test_generate_refuses_dvs(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array(np.arange(100, dtype=np.int64))}),
+                    mode="append",
+                    properties={"delta.enableDeletionVectors": "true"})
+    delete(Table.for_path(tmp_table_path), parse_expression("id < 5"))
+    with pytest.raises(DeltaError, match="deletion vectors"):
+        generate_symlink_manifest(Table.for_path(tmp_table_path))
+
+
+def test_generate_refuses_column_mapping(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append",
+                    properties={"delta.columnMapping.mode": "name"})
+    with pytest.raises(DeltaError, match="column-mapped"):
+        generate_symlink_manifest(Table.for_path(tmp_table_path))
+
+
+def test_manifest_hook_failure_surfaces(tmp_table_path):
+    """A DV write on a manifest-enabled table must raise (commit lands,
+    but the stale manifest is a correctness hazard for external
+    engines)."""
+    from delta_tpu.hooks import PostCommitHookError
+
+    dta.write_table(
+        tmp_table_path,
+        pa.table({"id": pa.array(np.arange(10, dtype=np.int64))}),
+        mode="append",
+        properties={
+            "delta.compatibility.symlinkFormatManifest.enabled": "true",
+            "delta.enableDeletionVectors": "true",
+        })
+    with pytest.raises(PostCommitHookError, match="deletion vectors"):
+        delete(Table.for_path(tmp_table_path), parse_expression("id < 5"))
+    # the delete itself committed
+    assert Table.for_path(tmp_table_path).latest_snapshot().version == 1
+    assert dta.read_table(tmp_table_path).num_rows == 5
+
+
+def test_sql_path_guard():
+    from delta_tpu.errors import DeltaError as DE
+
+    def guard(path):
+        raise DE(f"blocked: {path}")
+
+    with pytest.raises(DE, match="blocked"):
+        sql("SELECT * FROM '/anywhere/at/all'", path_guard=guard)
+
+
+def test_sql_generate(tmp_table_path):
+    dta.write_table(tmp_table_path,
+                    pa.table({"id": pa.array([1], pa.int64())}),
+                    mode="append")
+    written = sql(f"GENERATE symlink_format_manifest FOR TABLE '{tmp_table_path}'")
+    assert len(written) == 1

@@ -1,0 +1,148 @@
+"""Bulk importer (sql-delta-import role) and the connect remote
+protocol (Delta Connect role)."""
+
+import os
+import sqlite3
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.connect import DeltaConnectServer, connect
+from delta_tpu.connect.client import RemoteDeltaError
+from delta_tpu.errors import DeltaError
+from delta_tpu.table import Table
+from delta_tpu.tools.importer import import_into_delta, main as import_main
+
+
+# ------------------------------------------------------------ importer
+
+def test_import_csv(tmp_path):
+    src = tmp_path / "data.csv"
+    src.write_text("id,name\n1,a\n2,b\n3,c\n")
+    dest = str(tmp_path / "t")
+    r = import_into_delta(str(src), dest)
+    assert r.num_rows == 3 and r.num_chunks == 1
+    rows = dta.read_table(dest)
+    assert sorted(rows.column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_import_parquet_chunked_partitioned(tmp_path):
+    src = tmp_path / "data.parquet"
+    n = 1000
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(n, dtype=np.int64)),
+                  "part": pa.array(["x" if i % 2 else "y" for i in range(n)])}),
+        src)
+    dest = str(tmp_path / "t")
+    r = import_into_delta(str(src), dest, chunk_rows=300,
+                          partition_by=["part"])
+    assert r.num_rows == n
+    assert r.num_chunks == 4  # 300+300+300+100
+    assert r.last_version == r.first_version + 3
+    snap = Table.for_path(dest).latest_snapshot()
+    assert snap.metadata.partitionColumns == ["part"]
+    assert dta.read_table(dest).num_rows == n
+
+
+def test_import_ndjson_and_glob(tmp_path):
+    (tmp_path / "a.ndjson").write_text('{"id": 1}\n{"id": 2}\n')
+    (tmp_path / "b.ndjson").write_text('{"id": 3}\n')
+    dest = str(tmp_path / "t")
+    r = import_into_delta(str(tmp_path / "*.ndjson"), dest)
+    assert r.num_source_files == 2 and r.num_rows == 3
+    assert sorted(dta.read_table(dest).column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_import_sqlite(tmp_path):
+    db = tmp_path / "src.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO items VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(10)])
+    conn.commit()
+    conn.close()
+    dest = str(tmp_path / "t")
+    r = import_into_delta(str(db), dest)
+    assert r.num_rows == 10
+    assert sorted(dta.read_table(dest).column("id").to_pylist()) == list(range(10))
+
+
+def test_import_overwrite_and_cli(tmp_path):
+    src = tmp_path / "data.csv"
+    src.write_text("id\n1\n2\n")
+    dest = str(tmp_path / "t")
+    import_into_delta(str(src), dest)
+    src.write_text("id\n9\n")
+    rc = import_main(["--source", str(src), "--destination", dest,
+                      "--mode", "overwrite"])
+    assert rc == 0
+    assert dta.read_table(dest).column("id").to_pylist() == [9]
+
+
+def test_import_missing_source(tmp_path):
+    with pytest.raises(DeltaError, match="not found"):
+        import_into_delta(str(tmp_path / "nope.csv"), str(tmp_path / "t"))
+
+
+# ------------------------------------------------------------- connect
+
+@pytest.fixture
+def server(tmp_path):
+    srv = DeltaConnectServer("127.0.0.1", 0,
+                             allowed_root=str(tmp_path)).start_background()
+    yield srv
+    srv.stop()
+
+
+def test_connect_roundtrip(server, tmp_path):
+    host, port = server.address
+    path = str(tmp_path / "t")
+    data = pa.table({"id": pa.array(np.arange(50, dtype=np.int64)),
+                     "v": pa.array(np.arange(50, dtype=np.float64))})
+    with connect(host, port) as c:
+        assert c.ping()
+        v0 = c.write_table(path, data, mode="error")
+        assert v0 == 0
+        out = c.read_table(path)
+        assert out.num_rows == 50
+        out = c.read_table(path, columns=["id"], filter="id >= 45")
+        assert sorted(out.column("id").to_pylist()) == list(range(45, 50))
+        assert out.column_names == ["id"]
+        assert c.table_version(path) == 0
+
+        c.write_table(path, data.slice(0, 5))
+        assert c.table_version(path) == 1
+        hist = c.history(path)
+        assert len(hist) == 2
+        det = c.detail(path)
+        assert det["numFiles"] >= 1
+
+
+def test_connect_sql_and_errors(server, tmp_path):
+    host, port = server.address
+    path = str(tmp_path / "t")
+    with connect(host, port) as c:
+        c.write_table(path, pa.table({"id": pa.array([1, 2, 3], pa.int64())}))
+        out = c.sql(f"SELECT id FROM '{path}' WHERE id > 1")
+        assert sorted(out.column("id").to_pylist()) == [2, 3]
+        with pytest.raises(RemoteDeltaError, match="cannot parse"):
+            c.sql("FLY TO THE MOON")
+        # connection survives the error
+        assert c.ping()
+        with pytest.raises(RemoteDeltaError, match="outside the served root"):
+            c.read_table("/etc/passwd-table")
+
+
+def test_connect_time_travel_and_optimize(server, tmp_path):
+    host, port = server.address
+    path = str(tmp_path / "t")
+    with connect(host, port) as c:
+        c.write_table(path, pa.table({"id": pa.array([1], pa.int64())}))
+        c.write_table(path, pa.table({"id": pa.array([2], pa.int64())}))
+        old = c.read_table(path, version=0)
+        assert old.column("id").to_pylist() == [1]
+        m = c.optimize(path)
+        assert "num_files_added" in m
