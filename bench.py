@@ -10,7 +10,9 @@ Prints ONE JSON line:
   {"metric": "replay_files_per_sec", "value": ..., "unit": "actions/s",
    "vs_baseline": ...}
 
-Env knobs: BENCH_ACTIONS (default 2_000_000), BENCH_REPEATS (default 3).
+Env knobs: BENCH_ACTIONS (default 10_000_000 — the BASELINE.md
+north-star scale: a 100k-commit / 10M-file `_delta_log`), BENCH_REPEATS
+(default 3).
 """
 
 from __future__ import annotations
@@ -131,8 +133,8 @@ def bench_device_subprocess(n: int, repeats: int, timeout_s: int) -> float:
 
 
 def main():
-    n = int(os.environ.get("BENCH_ACTIONS", 2_000_000))
-    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    n = int(os.environ.get("BENCH_ACTIONS", 10_000_000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
     # NOTE: jax is only imported in the child process (bench_device_subprocess)
     # so a wedged accelerator runtime can never hang the bench driver itself.
     pk, dk, ver, order, is_add, size = synth_history(n)

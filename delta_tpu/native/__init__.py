@@ -25,7 +25,11 @@ from typing import Optional
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(__file__), "src", "action_scan.cpp")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_SOURCES = (
+    os.path.join(_SRC_DIR, "action_scan.cpp"),
+    os.path.join(_SRC_DIR, "fa_encode.cpp"),
+)
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -40,11 +44,13 @@ def _cache_dir() -> str:
 
 
 def _build(allow_compile: bool = True) -> Optional[str]:
-    with open(_SRC, "rb") as f:
-        src = f.read()
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src_path in _SOURCES:
+        with open(src_path, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     out_dir = _cache_dir()
-    lib_path = os.path.join(out_dir, f"libactionscan-{tag}.so")
+    lib_path = os.path.join(out_dir, f"libdeltatpu-{tag}.so")
     if os.path.exists(lib_path):
         return lib_path
     if not allow_compile:
@@ -54,7 +60,7 @@ def _build(allow_compile: bool = True) -> Optional[str]:
     os.close(fd)
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", tmp,
+        *_SOURCES, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
@@ -103,6 +109,17 @@ def load(allow_compile: bool = True) -> Optional[ctypes.CDLL]:
         lib.das_n.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.das_ptr.restype = ctypes.c_void_p
         lib.das_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.fae_encode.restype = ctypes.c_void_p
+        lib.fae_encode.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int64, ctypes.c_int64,
+                                   ctypes.c_int32]
+        lib.fae_free.argtypes = [ctypes.c_void_p]
+        lib.fae_error.restype = ctypes.c_int32
+        lib.fae_error.argtypes = [ctypes.c_void_p]
+        lib.fae_n.restype = ctypes.c_int64
+        lib.fae_n.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.fae_ptr.restype = ctypes.c_void_p
+        lib.fae_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         _LIB = lib
         return _LIB
 
@@ -116,11 +133,13 @@ def available(allow_compile: bool = True) -> bool:
 MIN_BYTES_FOR_COLD_BUILD = 4 << 20
 
 
-def _np(lib, h, which: int, n: int, dtype) -> np.ndarray:
-    """Copy column `which` out of the scan result as a numpy array."""
+def _np(lib, h, which: int, n: int, dtype, ptr_fn=None) -> np.ndarray:
+    """Copy column `which` out of a native result handle as a numpy
+    array. `ptr_fn` selects the accessor (das_ptr for scans, fae_ptr for
+    encoder results)."""
     if n == 0:
         return np.empty(0, dtype)
-    ptr = lib.das_ptr(h, which)
+    ptr = (ptr_fn or lib.das_ptr)(h, which)
     itemsize = np.dtype(dtype).itemsize
     buf = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_char * (n * itemsize)))
     return np.frombuffer(buf.contents, dtype=dtype).copy()
@@ -205,3 +224,71 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
         return ScanResult(lib, h)
     finally:
         lib.das_free(h)
+
+
+class FaEncoded:
+    """Output of the native first-appearance delta encoder — same fields
+    the numpy `_try_fa_encode` produces (see ops/replay.py)."""
+
+    __slots__ = ("flag_words", "ref_planes", "sub_idx", "sub_val",
+                 "sub_radix", "nbytes", "primary_max")
+
+    def __init__(self, lib, h):
+        n_words = int(lib.fae_n(h, 0))
+        r_pad = int(lib.fae_n(h, 2))
+        ref_width = int(lib.fae_n(h, 3))
+        d_pad = int(lib.fae_n(h, 5))
+        self.sub_radix = int(lib.fae_n(h, 6))
+        self.primary_max = int(lib.fae_n(h, 7))
+        self.flag_words = _np(lib, h, 0, n_words, np.uint32,
+                              ptr_fn=lib.fae_ptr)
+        planes_flat = _np(lib, h, 1, ref_width * r_pad, np.uint8,
+                          ptr_fn=lib.fae_ptr)
+        self.ref_planes = tuple(
+            np.ascontiguousarray(planes_flat[j * r_pad:(j + 1) * r_pad])
+            for j in range(ref_width))
+        if self.sub_radix > 1:
+            self.sub_idx = _np(lib, h, 2, d_pad, np.uint32,
+                               ptr_fn=lib.fae_ptr)
+            self.sub_val = _np(lib, h, 3, d_pad, np.uint32,
+                               ptr_fn=lib.fae_ptr)
+        else:
+            self.sub_idx = np.empty(0, np.uint32)
+            self.sub_val = np.empty(0, np.uint32)
+        self.nbytes = (self.flag_words.nbytes
+                       + sum(p.nbytes for p in self.ref_planes)
+                       + self.sub_idx.nbytes + self.sub_val.nbytes)
+
+
+NOT_FA = object()  # definitive "stream is not first-appearance coded"
+
+
+def fa_encode(primary: np.ndarray, sub: Optional[np.ndarray], n: int,
+              m: int, n_threads: int = 0, allow_compile: bool = False):
+    """Native first-appearance delta encoding of a combined key stream.
+    `primary` is the uint32 primary code lane (length n), `sub` the
+    optional pre-combined uint32 sub lane. Returns a FaEncoded, None when
+    the library is unavailable (caller falls back to numpy), or the
+    NOT_FA sentinel when the stream is definitively not
+    first-appearance coded (caller skips straight to byte planes). Pass
+    allow_compile=True on large inputs where a one-off g++ build is
+    worth the wait."""
+    lib = load(allow_compile=allow_compile)
+    if lib is None:
+        return None
+    if n_threads <= 0:
+        n_threads = min(16, os.cpu_count() or 1)
+    primary = np.ascontiguousarray(primary, dtype=np.uint32)
+    pk_ptr = primary.ctypes.data_as(ctypes.c_void_p)
+    if sub is not None:
+        sub = np.ascontiguousarray(sub, dtype=np.uint32)
+        dk_ptr = sub.ctypes.data_as(ctypes.c_void_p)
+    else:
+        dk_ptr = None
+    h = lib.fae_encode(pk_ptr, dk_ptr, n, m, n_threads)
+    try:
+        if lib.fae_error(h):
+            return NOT_FA
+        return FaEncoded(lib, h)
+    finally:
+        lib.fae_free(h)

@@ -1,0 +1,272 @@
+// First-appearance delta encoder for the replay transfer path.
+//
+// Mirrors delta_tpu/ops/replay.py::_try_fa_encode exactly (the numpy
+// implementation remains as the toolchain-less fallback and the parity
+// oracle): given the primary dictionary-code lane `pk` (first-appearance
+// coded by the columnarizer) and the optional small-range sub lane `dk`
+// (deletion-vector id codes), produce
+//   - is_new flag bits, packed little-endian into u32 words, padded to
+//     `m` rows with zeros;
+//   - the explicit codes of non-new rows (`refs`), emitted directly as
+//     little-endian byte planes (planar, padded with 0);
+//   - the sparse (row, value) pairs of the non-zero sub-lane entries.
+//
+// The stream is "first-appearance coded" iff the j-th row that
+// introduces a previously-unseen code carries exactly code j.  Rows are
+// classified with a running max (a row is new iff pk[i] == prev_max+1),
+// then verified against the global new-row count.  Everything runs in
+// three parallel passes over the input (classify+count, prefix-combine,
+// emit+verify), so the encoder is memory-bound and scales with threads.
+//
+// Plain C ABI (no pybind11): an opaque handle exposes result buffers by
+// index, exactly like action_scan.cpp.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct FaResult {
+  int32_t error = 0;  // 0 ok; 1 = not first-appearance coded / fallback
+  std::vector<uint32_t> flag_words;  // m/32
+  std::vector<uint8_t> ref_planes;   // ref_width contiguous planes of r_pad
+  int64_t n_refs = 0;
+  int64_t r_pad = 0;
+  int32_t ref_width = 0;
+  std::vector<uint32_t> sub_idx;  // d_pad (pad = 0xFFFFFFFF)
+  std::vector<uint32_t> sub_val;  // d_pad (pad = 0)
+  int64_t n_sub = 0;
+  int64_t d_pad = 0;
+  int64_t sub_radix = 1;
+  int64_t primary_max = -1;  // max primary code seen (-1 when n == 0)
+};
+
+int64_t pad_bucket(int64_t n, int64_t min_bucket) {
+  // must match ops/replay.py::pad_bucket: pow2 up to 1M, then the next
+  // multiple of 512k
+  if (n <= min_bucket) return min_bucket;
+  if (n <= (1ll << 20)) {
+    int64_t b = min_bucket;
+    while (b < n) b <<= 1;
+    return b;
+  }
+  const int64_t step = 1ll << 19;
+  return ((n + step - 1) / step) * step;
+}
+
+int32_t byte_width(uint64_t max_value) {
+  // matches replay.py::key_byte_width — the all-ones sentinel of the
+  // chosen width must stay free
+  for (int32_t w = 1; w <= 3; ++w)
+    if (max_value < ((1ull << (8 * w)) - 1)) return w;
+  return 4;
+}
+
+struct ChunkStat {
+  int64_t n_new = 0;
+  int64_t n_ref = 0;
+  int64_t n_sub = 0;
+  uint64_t max_pk = 0;   // max over chunk (0 when empty)
+  bool has_pk = false;
+  uint64_t max_ref = 0;
+  uint64_t max_sub = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* fae_encode(const uint32_t* pk, const uint32_t* dk, int64_t n,
+                 int64_t m, int32_t n_threads) {
+  auto* res = new FaResult();
+  if (n == 0) {
+    res->flag_words.assign(m / 32, 0);
+    res->r_pad = pad_bucket(0, 128);
+    res->ref_width = 1;
+    res->ref_planes.assign(res->r_pad, 0);
+    return res;
+  }
+  if (n_threads <= 0) n_threads = 1;
+  int64_t t_count = std::min<int64_t>(n_threads, (n + 65535) / 65536);
+  if (t_count < 1) t_count = 1;
+  // chunk bounds on 64-row boundaries so flag-word packing never races
+  int64_t chunk = ((n + t_count - 1) / t_count + 63) & ~int64_t(63);
+  std::vector<ChunkStat> stats(t_count);
+
+  // ---- pass 1: classify per chunk with a local running max ------------
+  // A row is new iff pk[i] == prev_max + 1 where prev_max is the running
+  // max over ALL prior rows.  The cross-chunk prefix max isn't known in
+  // pass 1, so classify with the LOCAL running max seeded by a sentinel,
+  // and re-classify in pass 2 only the prefix of each chunk that the
+  // incoming prefix max can affect (rows before the chunk's local max
+  // first exceeds the incoming max are the only ones whose prev_max
+  // differs).  Simpler and still fast: pass 1 only computes chunk maxima,
+  // pass 2 does classify+count with exact prefix maxima, pass 3 emits.
+  {
+    std::vector<std::thread> ts;
+    for (int64_t t = 0; t < t_count; ++t) {
+      ts.emplace_back([&, t]() {
+        int64_t s = t * chunk, e = std::min(n, s + chunk);
+        uint64_t mx = 0;
+        bool has = false;
+        for (int64_t i = s; i < e; ++i) {
+          if (!has || pk[i] > mx) mx = pk[i];
+          has = true;
+        }
+        stats[t].max_pk = mx;
+        stats[t].has_pk = has;
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  std::vector<int64_t> prefix_max(t_count);  // exclusive; -1 = none
+  {
+    int64_t run = -1;
+    for (int64_t t = 0; t < t_count; ++t) {
+      prefix_max[t] = run;
+      if (stats[t].has_pk)
+        run = std::max(run, (int64_t)stats[t].max_pk);
+    }
+    res->primary_max = run;
+  }
+
+  // ---- pass 2: exact classify + count ---------------------------------
+  res->flag_words.assign(m / 32, 0);
+  {
+    std::vector<std::thread> ts;
+    for (int64_t t = 0; t < t_count; ++t) {
+      ts.emplace_back([&, t]() {
+        int64_t s = t * chunk, e = std::min(n, s + chunk);
+        int64_t prev_max = prefix_max[t];
+        ChunkStat& st = stats[t];
+        uint32_t* words = res->flag_words.data();
+        for (int64_t i = s; i < e; ++i) {
+          int64_t v = (int64_t)pk[i];
+          if (v == prev_max + 1) {
+            words[i >> 5] |= (1u << (i & 31));
+            st.n_new++;
+          } else {
+            st.n_ref++;
+            if ((uint64_t)v > st.max_ref) st.max_ref = (uint64_t)v;
+          }
+          if (v > prev_max) prev_max = v;
+          if (dk) {
+            uint32_t d = dk[i];
+            if (d) {
+              st.n_sub++;
+              if (d > st.max_sub) st.max_sub = d;
+            }
+          }
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+
+  std::vector<int64_t> new_base(t_count), ref_base(t_count), sub_base(t_count);
+  uint64_t max_ref = 0, max_sub = 0;
+  {
+    int64_t nn = 0, nr = 0, ns = 0;
+    for (int64_t t = 0; t < t_count; ++t) {
+      new_base[t] = nn;
+      ref_base[t] = nr;
+      sub_base[t] = ns;
+      nn += stats[t].n_new;
+      nr += stats[t].n_ref;
+      ns += stats[t].n_sub;
+      max_ref = std::max(max_ref, stats[t].max_ref);
+      max_sub = std::max(max_sub, stats[t].max_sub);
+    }
+    res->n_refs = nr;
+    res->n_sub = ns;
+    res->sub_radix = dk ? (int64_t)max_sub + 1 : 1;
+  }
+  // range check: combined key must stay below the u32 pad sentinel
+  if ((res->primary_max + 1) * res->sub_radix >= 0xFFFFFFFFll) {
+    res->error = 1;
+    return res;
+  }
+
+  // ---- pass 3: emit refs/sub + verify dense first-appearance ----------
+  res->r_pad = pad_bucket(res->n_refs, 128);
+  res->ref_width = byte_width(max_ref);
+  res->ref_planes.assign((int64_t)res->ref_width * res->r_pad, 0);
+  if (res->sub_radix > 1) {
+    res->d_pad = pad_bucket(res->n_sub, 128);
+    res->sub_idx.assign(res->d_pad, 0xFFFFFFFFu);
+    res->sub_val.assign(res->d_pad, 0);
+  }
+  std::atomic<bool> not_fa{false};
+  {
+    std::vector<std::thread> ts;
+    for (int64_t t = 0; t < t_count; ++t) {
+      ts.emplace_back([&, t]() {
+        int64_t s = t * chunk, e = std::min(n, s + chunk);
+        int64_t new_rank = new_base[t], ref_at = ref_base[t];
+        int64_t sub_at = sub_base[t];
+        const uint32_t* words = res->flag_words.data();
+        uint8_t* planes = res->ref_planes.data();
+        int32_t w = res->ref_width;
+        int64_t rp = res->r_pad;
+        for (int64_t i = s; i < e; ++i) {
+          if ((words[i >> 5] >> (i & 31)) & 1u) {
+            // dense check: the j-th new row must carry code j
+            if ((int64_t)pk[i] != new_rank) {
+              not_fa.store(true, std::memory_order_relaxed);
+              return;
+            }
+            new_rank++;
+          } else {
+            uint32_t v = pk[i];
+            for (int32_t j = 0; j < w; ++j)
+              planes[(int64_t)j * rp + ref_at] = (uint8_t)(v >> (8 * j));
+            ref_at++;
+          }
+          if (dk && res->sub_radix > 1 && dk[i]) {
+            res->sub_idx[sub_at] = (uint32_t)i;
+            res->sub_val[sub_at] = dk[i];
+            sub_at++;
+          }
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  if (not_fa.load()) res->error = 1;
+  return res;
+}
+
+void fae_free(void* h) { delete static_cast<FaResult*>(h); }
+
+int32_t fae_error(void* h) { return static_cast<FaResult*>(h)->error; }
+
+int64_t fae_n(void* h, int32_t which) {
+  auto* r = static_cast<FaResult*>(h);
+  switch (which) {
+    case 0: return (int64_t)r->flag_words.size();
+    case 1: return r->n_refs;
+    case 2: return r->r_pad;
+    case 3: return r->ref_width;
+    case 4: return r->n_sub;
+    case 5: return r->d_pad;
+    case 6: return r->sub_radix;
+    case 7: return r->primary_max;
+    default: return -1;
+  }
+}
+
+const void* fae_ptr(void* h, int32_t which) {
+  auto* r = static_cast<FaResult*>(h);
+  switch (which) {
+    case 0: return r->flag_words.data();
+    case 1: return r->ref_planes.data();  // ref_width planes of r_pad bytes
+    case 2: return r->sub_idx.data();
+    case 3: return r->sub_val.data();
+    default: return nullptr;
+  }
+}
+
+}  // extern "C"

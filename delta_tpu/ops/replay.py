@@ -65,12 +65,22 @@ _PAD_KEY = np.uint32(0xFFFFFFFF)
 _MIN_BUCKET = 1024
 
 
+_FINE_PAD_START = 1 << 20  # above this, pad linearly instead of to pow2
+_FINE_PAD_STEP = 1 << 19
+
+
 def pad_bucket(n: int, min_bucket: int = _MIN_BUCKET) -> int:
-    """Round up to the next power of two (min `min_bucket`) so jit caches
-    a small number of shapes across snapshot sizes."""
+    """Round up to a shape bucket so jit caches a bounded number of
+    shapes across snapshot sizes: next power of two up to 1M rows, then
+    the next multiple of 512k. Pure pow2 padding wastes up to ~2× in
+    transfer bytes and sort rows exactly at the multi-million-row scale
+    where each step costs hundreds of ms; the linear tail keeps waste
+    under 5% there while still bounding distinct compiled shapes."""
     if n <= min_bucket:
         return min_bucket
-    return 1 << (int(n - 1).bit_length())
+    if n <= _FINE_PAD_START:
+        return 1 << (int(n - 1).bit_length())
+    return -(-n // _FINE_PAD_STEP) * _FINE_PAD_STEP
 
 
 def chrono_ok(version: np.ndarray, order: np.ndarray) -> bool:
@@ -253,10 +263,18 @@ class _FAEncoding(NamedTuple):
     nbytes: int
 
 
+_NATIVE_FA_MIN_ROWS = 200_000    # below this numpy encodes in ~ms anyway
+_NATIVE_FA_COMPILE_ROWS = 1_000_000  # worth a one-off g++ build
+
+
 def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAEncoding]:
     """Delta-encode lane 0 against first-appearance coding; lanes[1:]
     (tiny ranges, mostly zero — the DV id lane) go sparse. None when the
-    stream isn't first-appearance-coded or ranges don't fit."""
+    stream isn't first-appearance-coded or ranges don't fit.
+
+    Large inputs go through the multithreaded C++ encoder
+    (native/src/fa_encode.cpp, same output layout); this numpy
+    implementation is the toolchain-less fallback and parity oracle."""
     primary = np.asarray(lanes[0])
     sub_radix = 1
     sub = None
@@ -267,6 +285,23 @@ def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAE
         sub_radix = int(sub.max(initial=0)) + 1
         if sub_radix == 1:
             sub = None
+
+    if n >= _NATIVE_FA_MIN_ROWS:
+        from delta_tpu import native
+
+        enc = native.fa_encode(
+            primary, sub, n, m,
+            allow_compile=n >= _NATIVE_FA_COMPILE_ROWS)
+        if enc is native.NOT_FA:
+            return None  # definitive: ship byte planes instead
+        if enc is not None:
+            full_width = key_byte_width(
+                (enc.primary_max + 1) * enc.sub_radix - 1)
+            if enc.nbytes >= m * full_width:
+                return None  # byte planes ship fewer bytes
+            return _FAEncoding(enc.flag_words, enc.ref_planes, enc.sub_idx,
+                               enc.sub_val, enc.sub_radix, enc.nbytes)
+        # fall through to numpy: toolchain/library unavailable
     p64 = primary.astype(np.int64, copy=False)
     run_max = np.maximum.accumulate(p64)
     prev_max = np.empty_like(run_max)
