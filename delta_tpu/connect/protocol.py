@@ -25,6 +25,12 @@ MAX_FRAME = 1 << 31
 def send_frame(sock: socket.socket, envelope: dict,
                payload: bytes = b"") -> None:
     body = json.dumps(envelope).encode()
+    # enforce the limit on the sending side: emitting a frame the
+    # receiver is guaranteed to reject would desynchronize the stream
+    if len(body) > MAX_FRAME or len(payload) > MAX_FRAME:
+        raise ValueError(
+            f"frame exceeds MAX_FRAME ({max(len(body), len(payload))} "
+            f"> {MAX_FRAME} bytes)")
     sock.sendall(_HEADER.pack(len(body), len(payload)) + body + payload)
 
 

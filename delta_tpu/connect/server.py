@@ -28,6 +28,26 @@ from delta_tpu.connect.protocol import (
 from delta_tpu.errors import DeltaError
 
 
+def _jsonable(out):
+    """Convert an arbitrary statement result (dataclass metrics objects,
+    lists of them, plain scalars) into something json.dumps accepts — a
+    VACUUM/OPTIMIZE result must not kill the response frame after the
+    operation already ran."""
+    import dataclasses
+
+    if hasattr(out, "to_dict"):
+        return out.to_dict()
+    if dataclasses.is_dataclass(out) and not isinstance(out, type):
+        return dataclasses.asdict(out)
+    if isinstance(out, (list, tuple)):
+        return [_jsonable(v) for v in out]
+    if isinstance(out, dict):
+        return {k: _jsonable(v) for k, v in out.items()}
+    if out is None or isinstance(out, (bool, int, float, str)):
+        return out
+    return str(out)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         while True:
@@ -55,7 +75,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
                  engine=None, allowed_root: Optional[str] = None):
         super().__init__((host, port), _Handler)
         self.engine = engine
-        self.allowed_root = (os.path.abspath(allowed_root)
+        self.allowed_root = (os.path.realpath(allowed_root)
                              if allowed_root else None)
         self._thread: Optional[threading.Thread] = None
 
@@ -78,7 +98,9 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
     # -- dispatch ------------------------------------------------------
     def _check_root(self, path: str) -> None:
         if self.allowed_root is not None:
-            resolved = os.path.abspath(path)
+            # realpath, not abspath: a symlink inside the served root must
+            # not escape the confinement the docstring promises
+            resolved = os.path.realpath(path)
             if not (resolved + "/").startswith(self.allowed_root + "/"):
                 raise DeltaError(f"path {path!r} is outside the served root")
 
@@ -131,9 +153,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
                           path_guard=self._check_root)
             if isinstance(out, pa.Table):
                 return {"kind": "table"}, table_to_ipc(out)
-            if hasattr(out, "to_dict"):
-                out = out.to_dict()
-            return {"kind": "json", "result": out}, b""
+            return {"kind": "json", "result": _jsonable(out)}, b""
 
         if op == "history":
             t = self._table(env["path"])

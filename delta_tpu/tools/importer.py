@@ -151,6 +151,13 @@ def _accumulate(batches: Iterator[pa.Table], chunk_rows: int) -> Iterator[pa.Tab
     for b in batches:
         for start in range(0, max(b.num_rows, 1), chunk_rows):
             sl = b.slice(start, chunk_rows)
+            # flush before appending whenever the slice would push the
+            # transaction past chunk_rows, so a yielded chunk never
+            # exceeds the bound (only the slice that exactly fills it
+            # rides in the same transaction)
+            if pending and n + sl.num_rows > chunk_rows:
+                yield pa.concat_tables(pending, promote_options="permissive")
+                pending, n = [], 0
             pending.append(sl)
             n += sl.num_rows
             if n >= chunk_rows:
