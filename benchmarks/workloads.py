@@ -268,8 +268,122 @@ class StreamingBenchmark(Benchmark):
         return self.report
 
 
+class TpcdsLiteBenchmark(Benchmark):
+    """Star-schema load + query shapes, the role of the reference's
+    TPC-DS harness (`benchmarks/src/main/scala/benchmark/
+    TPCDSDataLoad.scala:71`, `TPCDSBenchmark.scala:74`). A dsdgen-scale
+    run needs a Spark cluster; this generates a store_sales-shaped fact
+    table (partitioned by month) plus item/date dims, loads them as
+    Delta tables, and times representative query shapes through the
+    framework surface: partition-pruned scans, stats-skipped range
+    scans, dimension joins + aggregation (Arrow host compute — the
+    framework's query-integration layer), and full-scan aggregates."""
+
+    name = "tpcds_lite"
+
+    FACT_ROWS = {"smoke": 50_000, "small": 1_000_000,
+                 "medium": 10_000_000, "full": 50_000_000}
+
+    def run(self):
+        import delta_tpu.api as dta
+        from delta_tpu.expressions import col, lit
+
+        rows = self.FACT_ROWS[self.scale]
+        root = os.path.join(self.workdir, f"tpcds_{self.scale}")
+        shutil.rmtree(root, ignore_errors=True)
+        rng = np.random.default_rng(42)
+
+        n_items = max(100, rows // 1000)
+        item = pa.table({
+            "i_item_sk": pa.array(np.arange(n_items, dtype=np.int64)),
+            "i_brand_id": pa.array(rng.integers(0, 50, n_items)),
+            "i_category_id": pa.array(rng.integers(0, 10, n_items)),
+        })
+        date_dim = pa.table({
+            "d_date_sk": pa.array(np.arange(365 * 5, dtype=np.int64)),
+            "d_year": pa.array(2019 + np.arange(365 * 5) // 365),
+            "d_moy": pa.array((np.arange(365 * 5) % 365) // 31 + 1),
+        })
+        with self.timed("load_dims"):
+            dta.write_table(os.path.join(root, "item"), item)
+            dta.write_table(os.path.join(root, "date_dim"), date_dim)
+
+        fact_path = os.path.join(root, "store_sales")
+        # at least 12 chunks so every month partition exists at any scale
+        chunk = min(max(1, rows // 12), 1_000_000)
+        with self.timed("load_fact", extra={"rows": rows}):
+            for start in range(0, rows, chunk):
+                n = min(chunk, rows - start)
+                ci = start // chunk
+                month = ci % 12 + 1
+                # each chunk covers a narrow date window (like real
+                # time-ordered ingest) so per-file min/max stats are
+                # tight and range queries can actually skip files
+                date_base = (ci * 150) % (365 * 5 - 150)
+                data = pa.table({
+                    "ss_sold_date_sk": pa.array(
+                        (date_base
+                         + rng.integers(0, 150, n)).astype(np.int64)),
+                    "ss_item_sk": pa.array(
+                        rng.integers(0, n_items, n).astype(np.int64)),
+                    "ss_quantity": pa.array(rng.integers(1, 100, n)),
+                    "ss_sales_price": pa.array(rng.uniform(1, 500, n)),
+                    "ss_month": pa.array(np.full(n, f"{month:02d}")),
+                })
+                dta.write_table(fact_path, data, mode="append",
+                                partition_by=["ss_month"])
+        dur_s = self.report.results[-1].duration_ms / 1000
+        self.metric("load_rows_per_sec", rows / dur_s, "rows/s")
+
+        import pyarrow.compute as pc
+
+        from delta_tpu.table import Table
+
+        snap = Table.for_path(fact_path).latest_snapshot()
+        n_files = len(snap.state.add_files_table)
+
+        # Q1: partition-pruned aggregate (one month of sales)
+        with self.timed("q1_partition_prune"):
+            scan1 = snap.scan(filter=col("ss_month") == lit("03"))
+            t = scan1.to_arrow()
+            q1 = pc.sum(t.column("ss_sales_price")).as_py() or 0.0
+        self.metric("q1_files_scanned", len(scan1.files()), "files",
+                    total=n_files)
+
+        # Q2: stats-skipped range scan (narrow date window; chunks are
+        # date-correlated so per-file stats prune)
+        with self.timed("q2_range_skip"):
+            pred = (col("ss_sold_date_sk") >= lit(100)) & (
+                col("ss_sold_date_sk") < lit(130))
+            scan2 = snap.scan(filter=pred)
+            t = scan2.to_arrow()
+            q2 = t.num_rows
+        self.metric("q2_files_scanned", len(scan2.files()), "files",
+                    total=n_files)
+
+        # Q3: fact-dim join + group-by (TPC-DS Q3 shape: brand revenue
+        # for one year)
+        with self.timed("q3_join_groupby"):
+            years = date_dim.filter(pc.equal(date_dim.column("d_year"), 2020))
+            fact = snap.scan().to_arrow()
+            j = fact.join(years, keys="ss_sold_date_sk",
+                          right_keys="d_date_sk", join_type="inner")
+            j = j.join(item, keys="ss_item_sk", right_keys="i_item_sk")
+            q3 = j.group_by("i_brand_id").aggregate(
+                [("ss_sales_price", "sum")]).num_rows
+
+        # Q4: full-scan aggregate
+        with self.timed("q4_full_agg"):
+            t = snap.scan(columns=["ss_quantity"]).to_arrow()
+            q4 = pc.sum(t.column("ss_quantity")).as_py()
+
+        self.metric("fact_rows", rows, "rows", q1=round(q1, 2), q2=q2,
+                    q3=q3, q4=int(q4))
+        return self.report
+
+
 BENCHMARKS = {
     b.name: b
     for b in (ReplayBenchmark, CheckpointBenchmark, OptimizeBenchmark,
-              MergeBenchmark, StreamingBenchmark)
+              MergeBenchmark, StreamingBenchmark, TpcdsLiteBenchmark)
 }

@@ -161,12 +161,18 @@ def convert_to_delta(
     path: str,
     partition_schema: Optional[Dict[str, str]] = None,
     engine=None,
+    collect_stats: bool = True,
 ) -> int:
     """Convert a directory of Parquet files (optionally Hive-partitioned)
-    into a Delta table in place."""
+    into a Delta table in place. Footer reads (schema + per-file stats)
+    run on the shared I/O pool, the reference's parallel file-manifest
+    read (`commands/convert/ConvertUtils.scala`); `collect_stats` fills
+    each AddFile's stats from row-group footers so the converted table
+    data-skips immediately without scanning data."""
     import pyarrow.parquet as pq
 
     from delta_tpu.models.schema import PrimitiveType, from_arrow_schema
+    from delta_tpu.utils.threads import parallel_map
 
     table = Table.for_path(path, engine)
     if table.exists():
@@ -174,8 +180,7 @@ def convert_to_delta(
     part_schema = partition_schema or {}
     part_cols = list(part_schema)
 
-    adds: List[AddFile] = []
-    arrow_schema = None
+    manifest: List[tuple] = []  # (abs_path, rel_path, partition_values)
     root = table.path
     for dirpath, dirs, files in os.walk(root):
         rel_dir = os.path.relpath(dirpath, root)
@@ -194,31 +199,39 @@ def convert_to_delta(
             if not fname.endswith(".parquet") or fname.startswith((".", "_")):
                 continue
             full = os.path.join(dirpath, fname)
-            st = os.stat(full)
-            if arrow_schema is None:
-                arrow_schema = pq.read_schema(full)
             rel = os.path.relpath(full, root).replace(os.sep, "/")
             missing = [k for k in part_cols if k not in pv]
             if missing:
                 raise DeltaError(
                     f"file {rel} lacks partition values for {missing}"
                 )
-            adds.append(
-                AddFile(
-                    path=rel,
-                    partitionValues={k: pv.get(k) for k in part_cols},
-                    size=st.st_size,
-                    modificationTime=int(st.st_mtime * 1000),
-                    dataChange=True,
-                )
-            )
-    if arrow_schema is None:
+            manifest.append((full, rel, {k: pv.get(k) for k in part_cols}))
+    if not manifest:
         raise DeltaError(f"no parquet files found under {path}")
 
+    arrow_schema = pq.read_schema(manifest[0][0])
     schema = from_arrow_schema(arrow_schema)
     for col_name, type_name in part_schema.items():
         if col_name not in schema:
             schema = schema.add(col_name, PrimitiveType(type_name))
+
+    from delta_tpu.stats.footer import footer_stats
+
+    def _to_add(entry: tuple) -> AddFile:
+        full, rel, pvals = entry
+        st = os.stat(full)
+        stats = (footer_stats(full, schema, {}, part_cols)
+                 if collect_stats else None)
+        return AddFile(
+            path=rel,
+            partitionValues=pvals,
+            size=st.st_size,
+            modificationTime=int(st.st_mtime * 1000),
+            dataChange=True,
+            stats=stats,
+        )
+
+    adds: List[AddFile] = parallel_map(_to_add, manifest)
 
     from delta_tpu.models.schema import schema_to_json
 

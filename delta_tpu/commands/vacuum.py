@@ -104,15 +104,25 @@ def vacuum(
             protected.add(os.path.relpath(abs_dv, table.path).replace(os.sep, "/"))
 
     result = VacuumResult(dry_run=dry_run)
+    doomed: List[str] = []
     for abs_path, rel, mtime in _walk_table_files(table.path):
         if rel in protected:
             continue
         if mtime >= cutoff:
             continue  # too young — may belong to an in-flight txn
         result.files_deleted.append(rel)
-        if not dry_run:
+        doomed.append(abs_path)
+    if not dry_run and doomed:
+        # parallel delete, as the reference's distributed delete
+        # (`VacuumCommand.scala:224`) — object-store unlink latency
+        # dominates, not CPU
+        from delta_tpu.utils.threads import parallel_map
+
+        def _unlink(p: str) -> None:
             try:
-                os.unlink(abs_path)
+                os.unlink(p)
             except FileNotFoundError:
                 pass
+
+        parallel_map(_unlink, doomed)
     return result

@@ -183,8 +183,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
             deleted = vacuum(self._table(env["path"]),
                              retention_hours=env.get("retention_hours"),
                              dry_run=env.get("dry_run", False))
-            return {"deleted": deleted if isinstance(deleted, int)
-                    else len(deleted)}, b""
+            return {"deleted": deleted.num_deleted}, b""
 
         raise DeltaError(f"unknown connect op {op!r}")
 

@@ -1,0 +1,137 @@
+"""Per-file stats from Parquet footers (no data scan).
+
+CONVERT TO DELTA needs an AddFile stats document per existing file so
+the converted table data-skips immediately (reference:
+`commands/convert/ConvertUtils.scala` + ConvertToDeltaCommand's stats
+collection). Re-reading every file's data would make conversion O(table
+bytes); row-group footer statistics give min/max/nullCount in O(files).
+
+Conservative by construction — a column's min/max is emitted only when
+every row group carries trustworthy stats for it:
+- floating columns are skipped entirely (Parquet min/max ordering around
+  NaN is writer-dependent, and Delta's contract is NaN > everything);
+- string stats honor Parquet's `is_max_value_exact` flag (a truncated
+  footer max is NOT an upper bound of the column) and re-truncate to the
+  Delta 32-char prefix rule;
+- any conversion oddity (decimal/physical-type mismatch) drops that
+  column's min/max, never the whole document.
+Absent stats only cost skipping opportunities; they can never cause a
+wrong prune.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from delta_tpu.stats.collection import (
+    _json_value,
+    _set_nested,
+    _truncate_max,
+    _truncate_min,
+    stats_columns,
+)
+
+
+def _bump(s: str) -> Optional[str]:
+    """Smallest convenient string strictly greater than every string with
+    prefix `s`: increment the last bumpable character. None when all
+    characters are already U+10FFFF."""
+    for i in range(len(s) - 1, -1, -1):
+        if ord(s[i]) < 0x10FFFF:
+            return s[:i] + chr(ord(s[i]) + 1)
+    return None
+
+
+def footer_stats(
+    parquet_path: str,
+    schema,
+    configuration: Dict[str, str],
+    partition_columns: List[str],
+) -> Optional[str]:
+    """Stats JSON for one existing Parquet file, from its footer only.
+    Returns None when the footer is unreadable (caller converts the file
+    without stats)."""
+    import pyarrow.parquet as pq
+
+    try:
+        md = pq.ParquetFile(parquet_path).metadata
+    except Exception:
+        return None
+
+    stats: dict = {"numRecords": md.num_rows}
+    min_d: dict = {}
+    max_d: dict = {}
+    null_d: dict = {}
+
+    # map dotted parquet leaf path -> column-chunk index
+    col_index: Dict[str, int] = {}
+    if md.num_row_groups:
+        rg0 = md.row_group(0)
+        for j in range(rg0.num_columns):
+            col_index[rg0.column(j).path_in_schema] = j
+
+    for path in stats_columns(schema, configuration, partition_columns):
+        j = col_index.get(".".join(path))
+        if j is None:
+            continue
+        nulls = 0
+        mins: list = []
+        maxs: list = []
+        exact_max = True
+        usable = md.num_row_groups > 0
+        for g in range(md.num_row_groups):
+            col = md.row_group(g).column(j)
+            st = col.statistics
+            if st is None or st.null_count is None:
+                usable = False
+                break
+            nulls += st.null_count
+            if col.num_values - st.null_count == 0:
+                continue  # all-null group contributes no min/max
+            if not st.has_min_max:
+                mins = maxs = None  # type: ignore[assignment]
+                continue
+            if mins is None:
+                continue
+            mins.append(st.min)
+            maxs.append(st.max)
+            if getattr(st, "is_max_value_exact", True) is False:
+                exact_max = False
+        if not usable:
+            continue
+        _set_nested(null_d, path, int(nulls))
+        if not mins or mins is None:
+            continue
+        try:
+            mn = min(mins)
+            mx = max(maxs)
+        except TypeError:
+            continue  # incomparable physical values — skip min/max
+        if isinstance(mn, float) or isinstance(mx, float):
+            continue  # NaN ordering is writer-dependent; never trust
+        if isinstance(mn, bytes) or isinstance(mx, bytes):
+            try:
+                mn = mn.decode("utf-8") if isinstance(mn, bytes) else mn
+                mx = mx.decode("utf-8") if isinstance(mx, bytes) else mx
+            except UnicodeDecodeError:
+                continue
+        if isinstance(mn, str):
+            mn = _truncate_min(mn)
+            if not exact_max:
+                # the footer max is a truncated prefix of the real max —
+                # a LOWER bound of it, not an upper bound of the column;
+                # bump it above everything sharing the prefix first
+                mx = _bump(mx)
+            mx = _truncate_max(mx) if mx is not None else None
+            if mx is None:
+                _set_nested(min_d, path, _json_value(mn))
+                continue
+        _set_nested(min_d, path, _json_value(mn))
+        _set_nested(max_d, path, _json_value(mx))
+
+    if min_d:
+        stats["minValues"] = min_d
+        stats["maxValues"] = max_d
+    stats["nullCount"] = null_d
+    return json.dumps(stats, separators=(",", ":"))

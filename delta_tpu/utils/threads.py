@@ -1,0 +1,66 @@
+"""Host-side thread parallelism for I/O-bound table operations.
+
+The reference keeps a family of named daemon thread pools
+(`spark/src/main/scala/org/apache/spark/sql/delta/util/threads/` —
+`DeltaThreadPool.scala`, `SparkThreadLocalForwardingThreadPoolExecutor`)
+for parallel LIST/DELETE in VACUUM (`commands/VacuumCommand.scala:224`),
+parallel manifest reads in CONVERT, and async post-commit work. The JAX
+engine is single-process, so the equivalent here is a plain shared
+`ThreadPoolExecutor` wrapper: ordered `map`, fire-and-forget `submit`,
+and a bounded default size. Pools are daemonic — an exiting interpreter
+never blocks on stragglers.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Iterable, List, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+_DEFAULT_WORKERS = min(32, (os.cpu_count() or 4) * 4)
+
+
+class DeltaThreadPool:
+    """Named daemon pool with ordered map semantics."""
+
+    def __init__(self, name: str, max_workers: Optional[int] = None):
+        self.name = name
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers or _DEFAULT_WORKERS,
+            thread_name_prefix=f"delta-tpu-{name}")
+
+    def submit(self, fn: Callable[..., R], *args, **kwargs) -> "Future[R]":
+        return self._pool.submit(fn, *args, **kwargs)
+
+    def map(self, fn: Callable[[T], R], items: Iterable[T]) -> List[R]:
+        """Apply `fn` to every item concurrently; results in input order.
+        The first exception propagates (after all tasks were submitted)."""
+        futures = [self._pool.submit(fn, it) for it in items]
+        return [f.result() for f in futures]
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+_SHARED: Optional[DeltaThreadPool] = None
+
+
+def shared_pool() -> DeltaThreadPool:
+    """The process-wide pool used by VACUUM/CONVERT/listing."""
+    global _SHARED
+    if _SHARED is None:
+        _SHARED = DeltaThreadPool("io")
+    return _SHARED
+
+
+def parallel_map(fn: Callable[[T], R], items: Sequence[T],
+                 min_parallel: int = 8) -> List[R]:
+    """Ordered parallel map over an I/O-bound function; falls back to a
+    sequential loop for tiny inputs where pool dispatch costs more than
+    it saves."""
+    if len(items) < min_parallel:
+        return [fn(it) for it in items]
+    return shared_pool().map(fn, items)

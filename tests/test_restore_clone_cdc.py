@@ -80,6 +80,41 @@ def test_convert_to_delta(tmp_path):
         convert_to_delta(root)
 
 
+def test_convert_collects_footer_stats(tmp_path):
+    """Converted AddFiles carry footer-derived stats and the scan prunes
+    with them — no data re-scan needed."""
+    import json
+
+    root = str(tmp_path / "plain_stats")
+    os.makedirs(root, exist_ok=True)
+    pq.write_table(_batch(0, 10), f"{root}/lo.parquet")    # ids 0..9
+    pq.write_table(_batch(100, 10), f"{root}/hi.parquet")  # ids 100..109
+    convert_to_delta(root)
+    snap = Table.for_path(root).latest_snapshot()
+    stats = [json.loads(s) for s in
+             snap.state.add_files_table.column("stats").to_pylist() if s]
+    assert len(stats) == 2
+    by_min = sorted(stats, key=lambda s: s["minValues"]["id"])
+    assert by_min[0]["numRecords"] == 10
+    assert by_min[0]["minValues"]["id"] == 0
+    assert by_min[0]["maxValues"]["id"] == 9
+    assert by_min[1]["minValues"]["id"] == 100
+    assert by_min[1]["nullCount"]["id"] == 0
+    # skipping: id > 50 must scan only the hi file
+    files = snap.scan(filter=col("id") > lit(50)).files()
+    assert len(files) == 1 and files[0].path.endswith("hi.parquet")
+
+
+def test_convert_without_stats_flag(tmp_path):
+    root = str(tmp_path / "plain_nostats")
+    os.makedirs(root, exist_ok=True)
+    pq.write_table(_batch(0, 5), f"{root}/a.parquet")
+    convert_to_delta(root, collect_stats=False)
+    snap = Table.for_path(root).latest_snapshot()
+    assert all(s is None
+               for s in snap.state.add_files_table.column("stats").to_pylist())
+
+
 def test_cdc_reader_dml(tmp_table_path):
     dta.write_table(
         tmp_table_path, _batch(0, 10),
