@@ -167,3 +167,81 @@ def load_shared_table(
     dest = os.path.join(workdir, f"{share}.{schema}.{table}")
     materialize_shared_table(lines, dest)
     return Table.for_path(dest, engine)
+
+
+class SharingStreamSource:
+    """Streaming reads of a shared table (the reference's
+    `sharing/.../DeltaFormatSharingSource.scala` role): each poll
+    re-queries the server, re-materializes the synthetic log, and emits
+    only files not seen before (keyed by the server-side file id, falling
+    back to the url). The offset is the count of consumed file keys plus
+    the last materialized snapshot — a restartable position for a
+    protocol that exposes snapshots rather than a commit log."""
+
+    def __init__(self, client: SharingClient, share: str, schema: str,
+                 table: str, workdir: str, engine=None,
+                 ignore_changes: bool = False):
+        self.client = client
+        self.share = share
+        self.schema = schema
+        self.table = table
+        self.workdir = workdir
+        self.engine = engine
+        self.ignore_changes = ignore_changes
+        self._seen: set = set()
+        self._poll = 0
+
+    @staticmethod
+    def _file_key(f: dict) -> str:
+        return f.get("id") or f["url"]
+
+    def poll(self):
+        """One micro-batch: (new_rows_arrow_table | None, num_new_files).
+        None means no new data since the last poll."""
+        import shutil
+
+        from delta_tpu.table import Table
+
+        lines = self.client.query_table(self.share, self.schema, self.table)
+        files = [l["file"] for l in lines if "file" in l]
+        keys_now = {self._file_key(f) for f in files}
+        vanished = self._seen - keys_now
+        if vanished and not self.ignore_changes:
+            # a previously-emitted file left the share: the table was
+            # updated/deleted/compacted server-side, and re-emitting the
+            # rewritten files would duplicate rows downstream — same
+            # contract as DeltaSource's data-changing-remove error
+            raise DeltaError(
+                f"{len(vanished)} previously-streamed file(s) were "
+                "rewritten or removed on the sharing server; restart the "
+                "stream, or pass ignore_changes=True to re-emit "
+                "rewritten files (downstream must tolerate duplicates)")
+        fresh = [f for f in files if self._file_key(f) not in self._seen]
+        if not fresh:
+            return None, 0
+        dest = os.path.join(
+            self.workdir,
+            f"{self.share}.{self.schema}.{self.table}.poll{self._poll}")
+        self._poll += 1
+        fresh_lines = [l for l in lines if "file" not in l] + [
+            {"file": f} for f in fresh]
+        materialize_shared_table(fresh_lines, dest)
+        try:
+            rows = (Table.for_path(dest, self.engine)
+                    .latest_snapshot().scan().to_arrow())
+        finally:
+            # the materialized dir is only a synthetic log (data lives at
+            # the server urls); rows are in memory now, so a long-running
+            # stream must not accrete one dir per poll
+            shutil.rmtree(dest, ignore_errors=True)
+        for f in fresh:
+            self._seen.add(self._file_key(f))
+        return rows, len(fresh)
+
+    def micro_batches(self):
+        """Drain currently-available new data."""
+        while True:
+            rows, n = self.poll()
+            if rows is None:
+                return
+            yield rows, n

@@ -37,16 +37,21 @@ def _truncate_min(s: str) -> str:
     return s[:MAX_STRING_PREFIX_LENGTH]
 
 
+def bump_string(s: str) -> Optional[str]:
+    """Smallest convenient string > every string with prefix `s`:
+    increment the last bumpable character. None when all characters are
+    already U+10FFFF (unbumpable -> caller drops the max stat)."""
+    for i in range(len(s) - 1, -1, -1):
+        if ord(s[i]) < 0x10FFFF:
+            return s[:i] + chr(ord(s[i]) + 1)
+    return None
+
+
 def _truncate_max(s: str) -> Optional[str]:
     if len(s) <= MAX_STRING_PREFIX_LENGTH:
         return s
-    prefix = s[:MAX_STRING_PREFIX_LENGTH]
-    # bump the last bumpable character so prefix >= every string it covers
-    for i in range(len(prefix) - 1, -1, -1):
-        c = prefix[i]
-        if ord(c) < 0x10FFFF:
-            return prefix[:i] + chr(ord(c) + 1)
-    return None  # unbumpable (all U+10FFFF) -> no max stat
+    # bump the truncated prefix so it >= every string it covers
+    return bump_string(s[:MAX_STRING_PREFIX_LENGTH])
 
 
 def _json_value(v: Any) -> Any:

@@ -29,18 +29,9 @@ from delta_tpu.stats.collection import (
     _set_nested,
     _truncate_max,
     _truncate_min,
+    bump_string,
     stats_columns,
 )
-
-
-def _bump(s: str) -> Optional[str]:
-    """Smallest convenient string strictly greater than every string with
-    prefix `s`: increment the last bumpable character. None when all
-    characters are already U+10FFFF."""
-    for i in range(len(s) - 1, -1, -1):
-        if ord(s[i]) < 0x10FFFF:
-            return s[:i] + chr(ord(s[i]) + 1)
-    return None
 
 
 def footer_stats(
@@ -122,7 +113,7 @@ def footer_stats(
                 # the footer max is a truncated prefix of the real max —
                 # a LOWER bound of it, not an upper bound of the column;
                 # bump it above everything sharing the prefix first
-                mx = _bump(mx)
+                mx = bump_string(mx)
             mx = _truncate_max(mx) if mx is not None else None
             if mx is None:
                 _set_nested(min_d, path, _json_value(mn))

@@ -1,4 +1,10 @@
-from delta_tpu.streaming.source import DeltaSource, DeltaSourceOffset, ReadLimits
+from delta_tpu.streaming.source import (
+    DeltaCDCSource,
+    DeltaSource,
+    DeltaSourceOffset,
+    ReadLimits,
+)
 from delta_tpu.streaming.sink import DeltaSink
 
-__all__ = ["DeltaSource", "DeltaSourceOffset", "ReadLimits", "DeltaSink"]
+__all__ = ["DeltaCDCSource", "DeltaSource", "DeltaSourceOffset",
+           "ReadLimits", "DeltaSink"]

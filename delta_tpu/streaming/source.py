@@ -318,3 +318,169 @@ class DeltaSource:
                 return
             yield nxt, self.get_batch(cur, nxt)
             cur = nxt
+
+
+class DeltaCDCSource:
+    """Streaming read of the change data feed (reference
+    `sources/DeltaSourceCDCSupport.scala`): micro-batches carry
+    `_change_type` / `_commit_version` / `_commit_timestamp` columns.
+
+    Offsets reuse `DeltaSourceOffset`; a version is the admission unit
+    (a commit's changes are never split across batches — its file count
+    draws down the budget, and at least one version is always admitted
+    so progress never stalls). With no `starting_version`, the current
+    snapshot is served first as `insert` rows at the snapshot's version
+    — the reference's initial-snapshot-as-inserts contract."""
+
+    def __init__(self, table, starting_version: Optional[int] = None):
+        from delta_tpu.config import ENABLE_CDF, get_table_config
+
+        self.table = table
+        snap = table.latest_snapshot()
+        if not get_table_config(snap.metadata.configuration, ENABLE_CDF):
+            raise DeltaError(
+                "change data feed is not enabled on this table "
+                "(set delta.enableChangeDataFeed=true)"
+            )
+        self._starting_version = starting_version
+        self._initial_version: Optional[int] = None
+
+    def _ensure_initial(self) -> None:
+        if self._initial_version is not None:
+            return
+        if self._starting_version is not None:
+            self._initial_version = self._starting_version - 1
+        else:
+            self._initial_version = self.table.latest_snapshot().version
+
+    def _version_file_stats(self, version: int) -> Optional[tuple]:
+        """(file_count, byte_count) of a commit's change-bearing files;
+        None when the commit doesn't exist yet."""
+        path = filenames.delta_file(self.table.log_path, version)
+        try:
+            data = self.table.engine.fs.read_file(path)
+        except FileNotFoundError:
+            return None
+        from delta_tpu.models.actions import AddCDCFile
+
+        n = nbytes = 0
+        for a in actions_from_commit_bytes(data):
+            if isinstance(a, AddCDCFile):
+                n += 1
+                nbytes += a.size or 0
+            elif isinstance(a, (AddFile, RemoveFile)) and a.dataChange:
+                n += 1
+                nbytes += getattr(a, "size", 0) or 0
+        return n, nbytes
+
+    def latest_offset(
+        self, start: Optional[DeltaSourceOffset] = None,
+        limits: Optional[ReadLimits] = None,
+    ) -> Optional[DeltaSourceOffset]:
+        self._ensure_initial()
+        limits = limits or ReadLimits()
+        budget_files = (limits.max_files if limits.max_files is not None
+                        else float("inf"))
+        budget_bytes = (limits.max_bytes if limits.max_bytes is not None
+                        else float("inf"))
+        if start is None and self._starting_version is None:
+            # the initial snapshot is one indivisible batch
+            return DeltaSourceOffset(self._initial_version, END_INDEX,
+                                     is_initial_snapshot=True)
+        v = (self._initial_version if start is None
+             else start.reservoir_version) + 1
+        last = None
+        while True:
+            stats = self._version_file_stats(v)
+            if stats is None:
+                break
+            n, nbytes = stats
+            if last is not None and (n > budget_files
+                                     or nbytes > budget_bytes):
+                break
+            budget_files -= n
+            budget_bytes -= nbytes
+            last = DeltaSourceOffset(v, END_INDEX)
+            v += 1
+        return last or start
+
+    def get_batch(
+        self, start: Optional[DeltaSourceOffset], end: DeltaSourceOffset
+    ) -> pa.Table:
+        from delta_tpu.read.cdc import table_changes
+
+        self._ensure_initial()
+        parts = []
+        if start is None and self._starting_version is None:
+            parts.append(self._initial_snapshot_as_inserts())
+        begin = ((self._initial_version + 1) if start is None
+                 else start.reservoir_version + 1)
+        if not end.is_initial_snapshot and begin <= end.reservoir_version:
+            parts.append(table_changes(self.table, begin,
+                                       end.reservoir_version))
+        parts = [p for p in parts if p.num_rows]
+        if not parts:
+            return self._empty_batch()
+        return pa.concat_tables(parts, promote_options="permissive")
+
+    def _commit_timestamp(self, version: int) -> int:
+        try:
+            data = self.table.engine.fs.read_file(
+                filenames.delta_file(self.table.log_path, version))
+        except FileNotFoundError:
+            return 0
+        for a in actions_from_commit_bytes(data):
+            if isinstance(a, CommitInfo):
+                return a.inCommitTimestamp or a.timestamp or 0
+        return 0
+
+    def _cdc_arrow_schema(self, snap) -> pa.Schema:
+        from delta_tpu.models.schema import to_arrow_schema
+        from delta_tpu.read.cdc import (
+            CDC_TYPE_COL,
+            COMMIT_TIMESTAMP_COL,
+            COMMIT_VERSION_COL,
+        )
+
+        sch = to_arrow_schema(snap.metadata.schema)
+        return (sch.append(pa.field(CDC_TYPE_COL, pa.string()))
+                .append(pa.field(COMMIT_VERSION_COL, pa.int64()))
+                .append(pa.field(COMMIT_TIMESTAMP_COL, pa.int64())))
+
+    def _empty_batch(self) -> pa.Table:
+        """Zero rows with the full CDC schema — a metadata-only or
+        dataChange=false commit must not yield a schema-less batch."""
+        return self._cdc_arrow_schema(
+            self.table.latest_snapshot()).empty_table()
+
+    def _initial_snapshot_as_inserts(self) -> pa.Table:
+        from delta_tpu.read.cdc import (
+            CDC_TYPE_COL,
+            COMMIT_TIMESTAMP_COL,
+            COMMIT_VERSION_COL,
+        )
+
+        snap = self.table.snapshot_at(self._initial_version)
+        rows = snap.scan().to_arrow()
+        n = rows.num_rows
+        ts = self._commit_timestamp(self._initial_version)
+        rows = rows.append_column(CDC_TYPE_COL,
+                                  pa.array(["insert"] * n, pa.string()))
+        rows = rows.append_column(COMMIT_VERSION_COL,
+                                  pa.array([self._initial_version] * n,
+                                           pa.int64()))
+        rows = rows.append_column(COMMIT_TIMESTAMP_COL,
+                                  pa.array([ts] * n, pa.int64()))
+        return rows
+
+    def micro_batches(
+        self, limits: Optional[ReadLimits] = None,
+        start: Optional[DeltaSourceOffset] = None,
+    ) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
+        cur = start
+        while True:
+            nxt = self.latest_offset(cur, limits)
+            if nxt == cur or nxt is None:
+                return
+            yield nxt, self.get_batch(cur, nxt)
+            cur = nxt

@@ -6,9 +6,10 @@ The reference keeps a family of named daemon thread pools
 for parallel LIST/DELETE in VACUUM (`commands/VacuumCommand.scala:224`),
 parallel manifest reads in CONVERT, and async post-commit work. The JAX
 engine is single-process, so the equivalent here is a plain shared
-`ThreadPoolExecutor` wrapper: ordered `map`, fire-and-forget `submit`,
-and a bounded default size. Pools are daemonic — an exiting interpreter
-never blocks on stragglers.
+`ThreadPoolExecutor` wrapper: ordered `map`, `submit`, and a bounded
+default size. Note CPython joins executor workers at interpreter exit —
+in-flight I/O (e.g. an unlink against a dead mount) delays shutdown
+until it returns; `shutdown(wait=False)` only stops new work.
 """
 
 from __future__ import annotations

@@ -18,11 +18,13 @@ from delta_tpu.table import Table
 
 def _server_for(table_path):
     """Fake sharing server: serves one table from a local delta table,
-    speaking the sharing wire format (urls = local absolute paths)."""
-    snap = Table.for_path(table_path).latest_snapshot()
-    meta = snap.metadata
+    speaking the sharing wire format (urls = local absolute paths). The
+    snapshot is resolved per query, so appends to the backing table show
+    up on the next poll (as on a real server)."""
 
     def transport(path, body):
+        snap = Table.for_path(table_path).latest_snapshot()
+        meta = snap.metadata
         if path == "/shares":
             return {"items": [{"name": "s1"}]}
         if path == "/shares/s1/schemas":
@@ -89,3 +91,56 @@ def test_sharing_stats_skipping(tmp_table_path, tmp_path):
     scan = shared.latest_snapshot().scan(filter=col("id") < lit(20))
     assert scan.add_files_table().num_rows == 1  # stats carried through
     assert scan.to_arrow().num_rows == 20
+
+
+def test_sharing_stream_source(tmp_table_path, tmp_path):
+    from delta_tpu.interop.sharing import SharingStreamSource
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array(np.arange(10, dtype=np.int64))}))
+    client = SharingClient(
+        ShareProfile(endpoint="fake", bearer_token="t"),
+        _server_for(tmp_table_path))
+    src = SharingStreamSource(client, "s1", "default", "t1",
+                              workdir=str(tmp_path / "stream"))
+
+    rows, n = src.poll()
+    assert n == 1 and sorted(rows.column("id").to_pylist()) == list(range(10))
+    # caught up: next poll yields nothing
+    assert src.poll() == (None, 0)
+
+    # append server-side; only the new file arrives on the next poll
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array(np.arange(10, 20, dtype=np.int64))}), mode="append")
+    batches = list(src.micro_batches())
+    assert len(batches) == 1
+    rows2, n2 = batches[0]
+    assert n2 == 1
+    assert sorted(rows2.column("id").to_pylist()) == list(range(10, 20))
+
+
+def test_sharing_stream_rejects_rewrites(tmp_table_path, tmp_path):
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+    from delta_tpu.errors import DeltaError
+    from delta_tpu.interop.sharing import SharingStreamSource
+    import pytest as _pytest
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array(np.arange(10, dtype=np.int64))}))
+    client = SharingClient(
+        ShareProfile(endpoint="fake", bearer_token="t"),
+        _server_for(tmp_table_path))
+    src = SharingStreamSource(client, "s1", "default", "t1",
+                              workdir=str(tmp_path / "s"))
+    src.poll()
+    # server-side rewrite: delete removes rows -> file replaced
+    delete(Table.for_path(tmp_table_path), predicate=col("id") < lit(5))
+    with _pytest.raises(DeltaError):
+        src.poll()
+    # with ignore_changes the rewritten file is re-emitted
+    src2 = SharingStreamSource(client, "s1", "default", "t1",
+                               workdir=str(tmp_path / "s2"),
+                               ignore_changes=True)
+    rows, n = src2.poll()
+    assert rows.num_rows == 5

@@ -97,3 +97,101 @@ def test_source_rejects_deletes(tmp_table_path):
 def test_offset_json_roundtrip():
     off = DeltaSourceOffset(7, 3, True)
     assert DeltaSourceOffset.from_json(off.to_json()) == off
+
+
+# ---------------------------------------------------------------- CDC source
+
+def _cdf_table(path):
+    dta.write_table(path, _batch(0, 10),
+                    properties={"delta.enableChangeDataFeed": "true"})
+    return Table.for_path(path)
+
+
+def test_cdc_source_requires_cdf(tmp_table_path):
+    from delta_tpu.streaming import DeltaCDCSource
+
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    with pytest.raises(DeltaError):
+        DeltaCDCSource(Table.for_path(tmp_table_path))
+
+
+def test_cdc_source_initial_snapshot_then_changes(tmp_table_path):
+    from delta_tpu.commands.dml import delete, update
+    from delta_tpu.expressions import col, lit
+    from delta_tpu.streaming import DeltaCDCSource
+
+    table = _cdf_table(tmp_table_path)
+    src = DeltaCDCSource(table)
+
+    # batch 1: the initial snapshot as inserts
+    off1 = src.latest_offset(None)
+    assert off1.is_initial_snapshot
+    b1 = src.get_batch(None, off1)
+    assert b1.num_rows == 10
+    assert set(b1.column("_change_type").to_pylist()) == {"insert"}
+    assert set(b1.column("_commit_version").to_pylist()) == {0}
+
+    # no new commits: offset unchanged
+    assert src.latest_offset(off1) == off1
+
+    # commits: an update (CDC files) and a delete
+    update(table, {"v": lit(-1.0)}, col("id") == lit(3))  # v1
+    delete(table, predicate=col("id") >= lit(8))          # v2
+
+    off2 = src.latest_offset(off1)
+    assert off2.reservoir_version == 2
+    b2 = src.get_batch(off1, off2)
+    types = b2.column("_change_type").to_pylist()
+    vers = b2.column("_commit_version").to_pylist()
+    assert "delete" in types
+    # the update produced preimage/postimage rows via CDC files
+    assert "update_preimage" in types and "update_postimage" in types
+    assert set(vers) == {1, 2}
+
+
+def test_cdc_source_starting_version_and_rate_limit(tmp_table_path):
+    from delta_tpu.streaming import DeltaCDCSource, ReadLimits
+
+    table = _cdf_table(tmp_table_path)
+    dta.write_table(tmp_table_path, _batch(10, 10))  # v1
+    dta.write_table(tmp_table_path, _batch(20, 10))  # v2
+    dta.write_table(tmp_table_path, _batch(30, 10))  # v3
+
+    # starting_version=1: no initial snapshot, tail from v1
+    src = DeltaCDCSource(table, starting_version=1)
+    lim = ReadLimits(max_files=1)  # one file per version here
+    off = src.latest_offset(None, lim)
+    assert off.reservoir_version == 1 and not off.is_initial_snapshot
+    b = src.get_batch(None, off)
+    assert sorted(b.column("id").to_pylist()) == list(range(10, 20))
+    assert set(b.column("_change_type").to_pylist()) == {"insert"}
+
+    # drain the rest one version at a time
+    versions = []
+    for o, batch in src.micro_batches(lim, start=off):
+        versions.append(o.reservoir_version)
+    assert versions == [2, 3]
+
+
+def test_cdc_source_schema_consistency(tmp_table_path):
+    """Initial-snapshot batches and empty batches carry the same CDC
+    schema as change batches (_change_type/_commit_version/_commit_timestamp)."""
+    from delta_tpu.streaming import DeltaCDCSource
+
+    table = _cdf_table(tmp_table_path)
+    src = DeltaCDCSource(table)
+    off = src.latest_offset(None)
+    b = src.get_batch(None, off)
+    for c in ("_change_type", "_commit_version", "_commit_timestamp"):
+        assert c in b.column_names, c
+    # metadata-only commit: offset advances, batch is empty but schemad
+    t2 = Table.for_path(tmp_table_path)
+    txn = t2.create_transaction_builder().build()
+    txn.set_operation_parameters({"properties": {}})
+    txn.commit()
+    off2 = src.latest_offset(off)
+    assert off2.reservoir_version == 1
+    b2 = src.get_batch(off, off2)
+    assert b2.num_rows == 0
+    for c in ("id", "_change_type", "_commit_timestamp"):
+        assert c in b2.column_names, c
