@@ -18,10 +18,40 @@ the kernel (`kernel-defaults` `DefaultEngine.java:24` being the sibling).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from delta_tpu.engine.host import HostEngine
 from delta_tpu.storage.logstore import logstore_for_path
+
+_CACHE_CONFIGURED = False
+
+
+def _configure_compilation_cache() -> None:
+    """Point JAX at a persistent compilation cache so a fresh process
+    pays ~0.2s for a snapshot load instead of a multi-second XLA compile
+    of the replay kernel's shape bucket. Opt out with
+    DELTA_TPU_JAX_CACHE=0 (or point it at a different directory)."""
+    global _CACHE_CONFIGURED
+    if _CACHE_CONFIGURED:
+        return
+    _CACHE_CONFIGURED = True
+    setting = os.environ.get("DELTA_TPU_JAX_CACHE", "")
+    if setting == "0":
+        return
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:  # user already configured
+        return
+    cache_dir = setting or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "delta_tpu_jax")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimization; never fail engine construction
 
 
 class TpuEngine(HostEngine):
@@ -35,6 +65,7 @@ class TpuEngine(HostEngine):
         replay_shards: Optional[int] = None,
     ):
         super().__init__(store_resolver, metrics_reporters)
+        _configure_compilation_cache()
         from delta_tpu.expressions.device_eval import DeviceExpressionHandler
 
         self.expressions = DeviceExpressionHandler()

@@ -206,7 +206,9 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
     if lib is None:
         return None
     if n_threads <= 0:
-        n_threads = min(8, os.cpu_count() or 1)
+        from delta_tpu.utils.threads import default_io_threads
+
+        n_threads = default_io_threads()
     if isinstance(buf, (bytes, bytearray, memoryview)):
         n_bytes = len(buf)
         if isinstance(buf, bytes):
@@ -277,7 +279,9 @@ def fa_encode(primary: np.ndarray, sub: Optional[np.ndarray], n: int,
     if lib is None:
         return None
     if n_threads <= 0:
-        n_threads = min(16, os.cpu_count() or 1)
+        from delta_tpu.utils.threads import default_io_threads
+
+        n_threads = default_io_threads()
     primary = np.ascontiguousarray(primary, dtype=np.uint32)
     pk_ptr = primary.ctypes.data_as(ctypes.c_void_p)
     if sub is not None:

@@ -383,10 +383,10 @@ def _read_commits_buffer(
         mv[off:off + sizes[i]] = data
         mv[off + sizes[i]] = 0x0A
 
-    import os as _os
+    from delta_tpu.utils.threads import default_io_threads
 
-    workers = min(max_workers, (_os.cpu_count() or 1) * 4)
-    if n > 4 and (_os.cpu_count() or 1) > 1:
+    workers = min(max_workers, default_io_threads())
+    if n > 4:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as ex:

@@ -73,6 +73,20 @@ class IndexedFile:
     is_initial: bool
 
 
+def _drain_micro_batches(
+    source, limits: Optional[ReadLimits], start: Optional[DeltaSourceOffset]
+) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
+    """Shared drain loop: yield (offset, batch) until the source reports
+    no progress."""
+    cur = start
+    while True:
+        nxt = source.latest_offset(cur, limits)
+        if nxt == cur or nxt is None:
+            return
+        yield nxt, source.get_batch(cur, nxt)
+        cur = nxt
+
+
 class DeltaSource:
     def __init__(
         self,
@@ -311,13 +325,7 @@ class DeltaSource:
         start: Optional[DeltaSourceOffset] = None,
     ) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
         """Drain available data as (offset, batch) pairs until caught up."""
-        cur = start
-        while True:
-            nxt = self.latest_offset(cur, limits)
-            if nxt == cur or nxt is None:
-                return
-            yield nxt, self.get_batch(cur, nxt)
-            cur = nxt
+        return _drain_micro_batches(self, limits, start)
 
 
 class DeltaCDCSource:
@@ -344,6 +352,16 @@ class DeltaCDCSource:
             )
         self._starting_version = starting_version
         self._initial_version: Optional[int] = None
+        # the schema this stream serves; a mid-stream change is an error
+        # (same contract as DeltaSource._on_metadata_action)
+        if starting_version is not None:
+            try:
+                base = table.snapshot_at(starting_version)
+            except Exception:
+                base = snap  # expired version: best effort
+        else:
+            base = snap
+        self._baseline_schema = base.metadata.schemaString
 
     def _ensure_initial(self) -> None:
         if self._initial_version is not None:
@@ -354,8 +372,11 @@ class DeltaCDCSource:
             self._initial_version = self.table.latest_snapshot().version
 
     def _version_file_stats(self, version: int) -> Optional[tuple]:
-        """(file_count, byte_count) of a commit's change-bearing files;
-        None when the commit doesn't exist yet."""
+        """(file_count, byte_count) of the files a CDC read of this
+        commit will actually touch — the AddCDCFiles when present, else
+        the dataChange add/remove files (mirroring
+        `read/cdc.py::table_changes`). None when the commit doesn't
+        exist yet. Raises on a mid-stream schema change."""
         path = filenames.delta_file(self.table.log_path, version)
         try:
             data = self.table.engine.fs.read_file(path)
@@ -363,15 +384,22 @@ class DeltaCDCSource:
             return None
         from delta_tpu.models.actions import AddCDCFile
 
-        n = nbytes = 0
+        n_cdc = cdc_bytes = n_data = data_bytes = 0
         for a in actions_from_commit_bytes(data):
             if isinstance(a, AddCDCFile):
-                n += 1
-                nbytes += a.size or 0
+                n_cdc += 1
+                cdc_bytes += a.size or 0
             elif isinstance(a, (AddFile, RemoveFile)) and a.dataChange:
-                n += 1
-                nbytes += getattr(a, "size", 0) or 0
-        return n, nbytes
+                n_data += 1
+                data_bytes += getattr(a, "size", 0) or 0
+            elif (isinstance(a, Metadata)
+                  and a.schemaString != self._baseline_schema):
+                raise DeltaError(
+                    f"table schema changed at version {version}; restart "
+                    "the CDC stream to continue with the new schema")
+        if n_cdc:
+            return n_cdc, cdc_bytes
+        return n_data, data_bytes
 
     def latest_offset(
         self, start: Optional[DeltaSourceOffset] = None,
@@ -402,6 +430,16 @@ class DeltaCDCSource:
             budget_bytes -= nbytes
             last = DeltaSourceOffset(v, END_INDEX)
             v += 1
+        if last is None and v <= self.table.latest_snapshot().version:
+            # the next commit exists in the snapshot's history but its
+            # file is gone: log cleanup expired it. Stalling silently
+            # would report caught-up forever while newer versions hold
+            # undelivered changes — same error contract as the
+            # reference's unavailable-starting-version case.
+            raise DeltaError(
+                f"commit {v} required by this CDC stream no longer "
+                "exists (expired by log cleanup); restart the stream "
+                "from a fresh snapshot")
         return last or start
 
     def get_batch(
@@ -434,15 +472,17 @@ class DeltaCDCSource:
                 return a.inCommitTimestamp or a.timestamp or 0
         return 0
 
-    def _cdc_arrow_schema(self, snap) -> pa.Schema:
-        from delta_tpu.models.schema import to_arrow_schema
+    def _cdc_arrow_schema(self) -> pa.Schema:
+        from delta_tpu.models.schema import schema_from_json, to_arrow_schema
         from delta_tpu.read.cdc import (
             CDC_TYPE_COL,
             COMMIT_TIMESTAMP_COL,
             COMMIT_VERSION_COL,
         )
 
-        sch = to_arrow_schema(snap.metadata.schema)
+        # the stream's baseline schema, NOT latest_snapshot() — batches
+        # for offsets before a schema change must not adopt the new one
+        sch = to_arrow_schema(schema_from_json(self._baseline_schema))
         return (sch.append(pa.field(CDC_TYPE_COL, pa.string()))
                 .append(pa.field(COMMIT_VERSION_COL, pa.int64()))
                 .append(pa.field(COMMIT_TIMESTAMP_COL, pa.int64())))
@@ -450,8 +490,7 @@ class DeltaCDCSource:
     def _empty_batch(self) -> pa.Table:
         """Zero rows with the full CDC schema — a metadata-only or
         dataChange=false commit must not yield a schema-less batch."""
-        return self._cdc_arrow_schema(
-            self.table.latest_snapshot()).empty_table()
+        return self._cdc_arrow_schema().empty_table()
 
     def _initial_snapshot_as_inserts(self) -> pa.Table:
         from delta_tpu.read.cdc import (
@@ -477,10 +516,4 @@ class DeltaCDCSource:
         self, limits: Optional[ReadLimits] = None,
         start: Optional[DeltaSourceOffset] = None,
     ) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
-        cur = start
-        while True:
-            nxt = self.latest_offset(cur, limits)
-            if nxt == cur or nxt is None:
-                return
-            yield nxt, self.get_batch(cur, nxt)
-            cur = nxt
+        return _drain_micro_batches(self, limits, start)

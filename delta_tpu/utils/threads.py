@@ -21,7 +21,27 @@ from typing import Callable, Iterable, List, Optional, Sequence, TypeVar
 T = TypeVar("T")
 R = TypeVar("R")
 
-_DEFAULT_WORKERS = min(32, (os.cpu_count() or 4) * 4)
+
+def default_io_threads() -> int:
+    """Worker count for I/O-bound and GIL-releasing native work.
+
+    Deliberately floored at 16 rather than trusting `os.cpu_count()`:
+    containerized/cgroup environments (including this one) routinely
+    advertise 1 CPU while the host schedules many more, and measured
+    native-scan throughput here scales ~4x from 1 to 16 threads on a
+    "1-CPU" box. Oversubscription on a genuinely single-core machine
+    costs a few percent; undersubscription costs multiples. Override
+    with DELTA_TPU_THREADS."""
+    env = os.environ.get("DELTA_TPU_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return min(32, max(16, (os.cpu_count() or 1) * 4))
+
+
+_DEFAULT_WORKERS = default_io_threads()
 
 
 class DeltaThreadPool:

@@ -195,3 +195,35 @@ def test_cdc_source_schema_consistency(tmp_table_path):
     assert b2.num_rows == 0
     for c in ("id", "_change_type", "_commit_timestamp"):
         assert c in b2.column_names, c
+
+
+def test_cdc_source_schema_change_errors(tmp_table_path):
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.models.schema import LONG, StructField
+    from delta_tpu.streaming import DeltaCDCSource
+
+    table = _cdf_table(tmp_table_path)
+    src = DeltaCDCSource(table)
+    off = src.latest_offset(None)
+    add_columns(Table.for_path(tmp_table_path),
+                [StructField("extra", LONG)])  # v1
+    with pytest.raises(DeltaError, match="schema changed"):
+        src.latest_offset(off)
+
+
+def test_cdc_source_expired_commit_errors(tmp_table_path):
+    import os
+    from delta_tpu.streaming import DeltaCDCSource
+    from delta_tpu.utils import filenames
+
+    table = _cdf_table(tmp_table_path)
+    src = DeltaCDCSource(table)
+    off = src.latest_offset(None)
+    dta.write_table(tmp_table_path, _batch(10, 5), mode="append")  # v1
+    dta.write_table(tmp_table_path, _batch(20, 5), mode="append")  # v2
+    # checkpoint v2 so the log stays loadable, then expire v1 as log
+    # cleanup would
+    table.checkpoint()
+    os.unlink(filenames.delta_file(table.log_path, 1))
+    with pytest.raises(DeltaError, match="expired"):
+        src.latest_offset(off)
