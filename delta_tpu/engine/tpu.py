@@ -41,12 +41,12 @@ def _configure_compilation_cache() -> None:
         return
     import jax
 
-    if jax.config.jax_compilation_cache_dir:  # user already configured
-        return
     cache_dir = setting or os.path.join(
         os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
         "delta_tpu_jax")
     try:
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            return  # user already configured a cache
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -36,7 +36,7 @@ from delta_tpu.config import (
 )
 from delta_tpu.errors import ChecksumMismatchError
 from delta_tpu.log.last_checkpoint import LastCheckpointInfo, write_last_checkpoint
-from delta_tpu.models.actions import CheckpointMetadata, Sidecar
+from delta_tpu.models.actions import Sidecar
 from delta_tpu.replay.columnar import DV_STRUCT_TYPE
 from delta_tpu.utils import filenames
 
@@ -342,11 +342,15 @@ def _write_multipart_checkpoint(
     total_files = len(add_struct) + len(remove_struct)
     num_parts = max(1, -(-total_files // part_size))
     paths = filenames.checkpoint_file_with_parts(log_path, version, num_parts)
-    total_actions = 0
 
     add_splits = _split_ranges(len(add_struct), num_parts)
     rem_splits = _split_ranges(len(remove_struct), num_parts)
-    for i, path in enumerate(paths):
+
+    def _write_part(i: int) -> int:
+        """One part; returns its action count. Parts are independent
+        files, so they write concurrently — the reference's task-per-part
+        distributed write (`Checkpoints.scala:717-782`) mapped onto the
+        shared I/O pool."""
         a0, a1 = add_splits[i]
         r0, r1 = rem_splits[i]
         adds_i = add_struct.slice(a0, a1 - a0)
@@ -362,12 +366,17 @@ def _write_multipart_checkpoint(
             + (len(d_rows) if d_rows is not None else 0)
             + len(adds_i) + len(rems_i)
         )
-        total_actions += n
-        table = _single_action_table(n, p_rows, m_rows, t_rows, d_rows, adds_i, rems_i)
+        table = _single_action_table(n, p_rows, m_rows, t_rows, d_rows,
+                                     adds_i, rems_i)
         try:
-            engine.parquet.write_parquet_file_atomically(path, table)
+            engine.parquet.write_parquet_file_atomically(paths[i], table)
         except FileExistsError:
             pass
+        return n
+
+    from delta_tpu.utils.threads import parallel_map
+
+    total_actions = sum(parallel_map(_write_part, range(num_parts)))
     return LastCheckpointInfo(
         version=version, size=total_actions, parts=num_parts,
         numOfAddFiles=len(add_struct),
@@ -385,24 +394,39 @@ def _write_v2_checkpoint(
 ):
     """V2 (PROTOCOL.md:196-269): file actions go to `_sidecars/<uuid>.parquet`;
     the top-level UUID checkpoint holds checkpointMetadata + sidecar
-    pointers + the small actions."""
-    sidecar_uuid = str(uuid.uuid4())
-    sidecar_path = filenames.sidecar_file(log_path, sidecar_uuid)
+    pointers + the small actions. File actions split across
+    `checkpoint_part_size`-row sidecars written concurrently (the
+    reference writes one sidecar per state partition)."""
     n_files = len(add_struct) + len(remove_struct)
-    sidecar_table = _single_action_table(
-        n_files, None, None, None, None, add_struct, remove_struct
-    )
-    status = engine.parquet.write_parquet_file(sidecar_path, sidecar_table)
+    part_size = settings.checkpoint_part_size
+    num_parts = (max(1, -(-n_files // part_size)) if part_size else 1)
+    add_splits = _split_ranges(len(add_struct), num_parts)
+    rem_splits = _split_ranges(len(remove_struct), num_parts)
 
-    cp_meta = CheckpointMetadata(version=version)
-    sidecar = Sidecar(
-        path=f"{sidecar_uuid}.parquet",
-        sizeInBytes=status.size,
-        modificationTime=status.modification_time,
-    )
+    def _write_sidecar(i: int) -> Sidecar:
+        a0, a1 = add_splits[i]
+        r0, r1 = rem_splits[i]
+        adds_i = add_struct.slice(a0, a1 - a0)
+        rems_i = remove_struct.slice(r0, r1 - r0)
+        sidecar_uuid = str(uuid.uuid4())
+        sidecar_path = filenames.sidecar_file(log_path, sidecar_uuid)
+        sidecar_table = _single_action_table(
+            len(adds_i) + len(rems_i), None, None, None, None, adds_i, rems_i
+        )
+        status = engine.parquet.write_parquet_file(sidecar_path, sidecar_table)
+        return Sidecar(
+            path=f"{sidecar_uuid}.parquet",
+            sizeInBytes=status.size,
+            modificationTime=status.modification_time,
+        )
+
+    from delta_tpu.utils.threads import parallel_map
+
+    sidecars = parallel_map(_write_sidecar, range(num_parts))
+
     top_schema_cols = {}
     n_top = (
-        1 + 1  # checkpointMetadata + sidecar
+        1 + num_parts  # checkpointMetadata + sidecar pointers
         + len(protocol_rows) + len(metadata_rows)
         + (len(txn_rows) if txn_rows is not None else 0)
         + (len(domain_rows) if domain_rows is not None else 0)
@@ -433,14 +457,14 @@ def _write_v2_checkpoint(
     offset += 1
     sc_arr = pa.array(
         [{
-            "path": sidecar.path,
-            "sizeInBytes": sidecar.sizeInBytes,
-            "modificationTime": sidecar.modificationTime,
-        }],
+            "path": sc.path,
+            "sizeInBytes": sc.sizeInBytes,
+            "modificationTime": sc.modificationTime,
+        } for sc in sidecars],
         SIDECAR_STRUCT,
     )
-    top_schema_cols["sidecar"] = block(sc_arr, SIDECAR_STRUCT, offset, 1)
-    offset += 1
+    top_schema_cols["sidecar"] = block(sc_arr, SIDECAR_STRUCT, offset, num_parts)
+    offset += num_parts
     top_schema_cols["protocol"] = block(protocol_rows, PROTOCOL_STRUCT, offset, len(protocol_rows))
     offset += len(protocol_rows)
     top_schema_cols["metaData"] = block(metadata_rows, METADATA_STRUCT, offset, len(metadata_rows))
@@ -455,10 +479,12 @@ def _write_v2_checkpoint(
     top_table = pa.table(top_schema_cols)
     top_path = filenames.top_level_v2_checkpoint_file(log_path, version, "parquet")
     engine.parquet.write_parquet_file_atomically(top_path, top_table)
+    total_bytes = sum(sc.sizeInBytes or 0 for sc in sidecars)
+    total_bytes += _file_size(engine, top_path) or 0
     return LastCheckpointInfo(
         version=version,
         size=n_top + n_files,
-        sizeInBytes=status.size,
+        sizeInBytes=total_bytes or None,
         numOfAddFiles=len(add_struct),
         tag=filenames.file_name(top_path),
     )

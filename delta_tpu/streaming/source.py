@@ -73,6 +73,15 @@ class IndexedFile:
     is_initial: bool
 
 
+class _SchemaChanged(Exception):
+    """Internal: a Metadata action with a different schema was seen while
+    scanning commit `version` for admission."""
+
+    def __init__(self, version: int):
+        super().__init__(version)
+        self.version = version
+
+
 def _drain_micro_batches(
     source, limits: Optional[ReadLimits], start: Optional[DeltaSourceOffset]
 ) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
@@ -352,6 +361,10 @@ class DeltaCDCSource:
             )
         self._starting_version = starting_version
         self._initial_version: Optional[int] = None
+        # version verified as "missing because not committed yet" — lets
+        # idle polls skip the expiry LIST (commits are append-only, so
+        # the verdict stays true until the probe finds the file)
+        self._verified_pending: Optional[int] = None
         # the schema this stream serves; a mid-stream change is an error
         # (same contract as DeltaSource._on_metadata_action)
         if starting_version is not None:
@@ -394,9 +407,7 @@ class DeltaCDCSource:
                 data_bytes += getattr(a, "size", 0) or 0
             elif (isinstance(a, Metadata)
                   and a.schemaString != self._baseline_schema):
-                raise DeltaError(
-                    f"table schema changed at version {version}; restart "
-                    "the CDC stream to continue with the new schema")
+                raise _SchemaChanged(version)
         if n_cdc:
             return n_cdc, cdc_bytes
         return n_data, data_bytes
@@ -419,7 +430,17 @@ class DeltaCDCSource:
              else start.reservoir_version) + 1
         last = None
         while True:
-            stats = self._version_file_stats(v)
+            try:
+                stats = self._version_file_stats(v)
+            except _SchemaChanged as sc:
+                if last is not None:
+                    # deliver commits admitted before the schema change;
+                    # the next poll starts AT the change and raises
+                    return last
+                raise DeltaError(
+                    f"table schema changed at version {sc.version}; "
+                    "restart the CDC stream to continue with the new "
+                    "schema") from None
             if stats is None:
                 break
             n, nbytes = stats
@@ -430,17 +451,51 @@ class DeltaCDCSource:
             budget_bytes -= nbytes
             last = DeltaSourceOffset(v, END_INDEX)
             v += 1
-        if last is None and v <= self.table.latest_snapshot().version:
-            # the next commit exists in the snapshot's history but its
-            # file is gone: log cleanup expired it. Stalling silently
-            # would report caught-up forever while newer versions hold
-            # undelivered changes — same error contract as the
-            # reference's unavailable-starting-version case.
-            raise DeltaError(
-                f"commit {v} required by this CDC stream no longer "
-                "exists (expired by log cleanup); restart the stream "
-                "from a fresh snapshot")
+        if last is None:
+            self._check_not_expired(v)
         return last or start
+
+    def _check_not_expired(self, v: int) -> None:
+        """No progress because commit `v` is missing: distinguish
+        'not committed yet' (fine — caught up) from 'expired by log
+        cleanup' (fatal — stalling silently would report caught-up
+        forever while newer versions hold undelivered changes). The
+        expensive LIST verdict is cached per version, so steady-state
+        idle polls cost one failed read, and a commit that lands between
+        the probe and the LIST is re-probed rather than misreported."""
+        if self._verified_pending == v:
+            return  # already verified as not-yet-committed
+        segment = None
+        try:
+            segment = self.table.latest_snapshot().log_segment
+        except Exception:
+            return  # can't list — treat as caught up, retry next poll
+        if segment.version < v:
+            self._verified_pending = v
+            return
+        # the snapshot knows version v. Re-probe before declaring it
+        # expired: a writer may have committed v after our first read.
+        if self._version_file_stats(v) is not None:
+            return  # it exists now; the next poll admits it
+        # still unreadable: unbackfilled coordinated commits appear in
+        # the segment under _delta_log/_commits/ — wait for backfill
+        # rather than erroring. Only _commits/ paths count: a backfilled
+        # name in a stale cached listing proves nothing about the file
+        # still existing.
+        from delta_tpu.utils import filenames as fn
+
+        for fstat in segment.deltas:
+            if f"/{fn.COMMIT_SUBDIR}/" not in fstat.path:
+                continue
+            try:
+                if fn.delta_version(fstat.path) == v:
+                    return
+            except ValueError:
+                continue
+        raise DeltaError(
+            f"commit {v} required by this CDC stream no longer exists "
+            "(expired by log cleanup); restart the stream from a fresh "
+            "snapshot")
 
     def get_batch(
         self, start: Optional[DeltaSourceOffset], end: DeltaSourceOffset

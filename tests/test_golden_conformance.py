@@ -327,3 +327,33 @@ def test_engines_agree_on_random_history(tmp_path):
     assert live_paths(host) == live_paths(tpu)
     assert host.num_files == tpu.num_files
     assert host.size_in_bytes == tpu.size_in_bytes
+
+
+@pytest.mark.parametrize("engine_cls", ENGINES)
+def test_v2_checkpoint_multiple_sidecars(tmp_path, engine_cls):
+    """With checkpoint_part_size set, a V2 checkpoint splits file actions
+    across several concurrently-written sidecars, all resolved on read."""
+    from delta_tpu.config import settings
+    from delta_tpu.log.checkpointer import write_checkpoint
+
+    path = write_log(
+        str(tmp_path),
+        [
+            [PROTOCOL, METADATA] + [add(f"f{i}") for i in range(9)],
+            [remove("f0")],
+        ],
+    )
+    table = Table.for_path(path, engine_cls())
+    old = settings.checkpoint_part_size
+    settings.checkpoint_part_size = 4
+    try:
+        write_checkpoint(table.engine, table.latest_snapshot(), policy="v2")
+    finally:
+        settings.checkpoint_part_size = old
+    log = os.path.join(path, "_delta_log")
+    sidecars = os.listdir(os.path.join(log, "_sidecars"))
+    # 8 live adds (f0 removed; its tombstone ages out of retention), 4/part
+    assert len(sidecars) == 2
+    snap = Table.for_path(path, engine_cls()).latest_snapshot()
+    assert snap.log_segment.checkpoint_version == 1
+    assert live_paths(snap) == [f"f{i}" for i in range(1, 9)]

@@ -227,3 +227,23 @@ def test_cdc_source_expired_commit_errors(tmp_table_path):
     os.unlink(filenames.delta_file(table.log_path, 1))
     with pytest.raises(DeltaError, match="expired"):
         src.latest_offset(off)
+
+
+def test_cdc_source_schema_change_delivers_prior_commits_first(tmp_table_path):
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.models.schema import LONG, StructField
+    from delta_tpu.streaming import DeltaCDCSource
+
+    table = _cdf_table(tmp_table_path)
+    src = DeltaCDCSource(table)
+    off0 = src.latest_offset(None)
+    dta.write_table(tmp_table_path, _batch(10, 5), mode="append")  # v1: data
+    add_columns(Table.for_path(tmp_table_path),
+                [StructField("extra", LONG)])                      # v2: schema
+    # v1 must be delivered under the old schema before the error fires
+    off1 = src.latest_offset(off0)
+    assert off1.reservoir_version == 1
+    b = src.get_batch(off0, off1)
+    assert sorted(b.column("id").to_pylist()) == list(range(10, 15))
+    with pytest.raises(DeltaError, match="schema changed"):
+        src.latest_offset(off1)
