@@ -128,26 +128,57 @@ def curve_order(key_words: jnp.ndarray) -> jnp.ndarray:
     return out[-1]
 
 
-def zorder_sort_indices(cols: Sequence[np.ndarray], curve: str = "zorder") -> np.ndarray:
-    """Host entry: rank columns, build curve keys, return the row
-    permutation that clusters rows along the curve."""
-    n = len(cols[0])
-    if n == 0:
-        return np.empty(0, dtype=np.int32)
-    device_cols = [jnp.asarray(_to_sortable_u32(c)) for c in cols]
-    ranks = [range_rank(c) for c in device_cols]
+@functools.partial(jax.jit, static_argnames=("curve",))
+def _curve_perm(cols: tuple, curve: str) -> jnp.ndarray:
+    """One fused device program: rank -> scale -> curve key -> argsort.
+    Row count is the (bucket-padded) static shape; padding rows carry
+    the all-ones sentinel, rank at the top, and sort to the end of the
+    curve (the host drops them from the permutation)."""
+    m = cols[0].shape[0]
+    ranks = [range_rank(c) for c in cols]
     if curve == "hilbert":
         n_bits = 16
         scaled = [
-            _scale_ranks(r, n, 32) >> jnp.uint32(32 - n_bits) for r in ranks
+            _scale_ranks(r, m, 32) >> jnp.uint32(32 - n_bits) for r in ranks
         ]
         keys = hilbert_key(scaled, n_bits=n_bits)
     else:
-        scaled = [_scale_ranks(r, n, 32) for r in ranks]
         from delta_tpu.ops.pallas_kernels import interleave_bits_auto
 
+        scaled = [_scale_ranks(r, m, 32) for r in ranks]
+        # m is always a tile multiple (pad_bucket), so this is the
+        # Pallas VMEM-tile kernel on TPU (jnp fallback elsewhere)
         keys = interleave_bits_auto(scaled, n_bits=32)
-    return np.asarray(curve_order(keys))
+    return curve_order(keys)
+
+
+def zorder_sort_indices(cols: Sequence[np.ndarray], curve: str = "zorder") -> np.ndarray:
+    """Host entry: rank columns, build curve keys, return the row
+    permutation that clusters rows along the curve.
+
+    Rows are padded to a shape bucket (`ops.replay.pad_bucket`) so
+    OPTIMIZE over many different bin sizes compiles a handful of
+    programs instead of one per size, and the whole pipeline runs as a
+    single jit (one dispatch, fully fused) rather than eager per-op
+    round-trips."""
+    n = len(cols[0])
+    if n == 0:
+        return np.empty(0, dtype=np.int32)
+    from delta_tpu.ops.replay import pad_bucket
+
+    m = pad_bucket(n, min_bucket=1024)
+    host_cols = []
+    for c in cols:
+        h = _to_sortable_u32(c)
+        if m > n:
+            # all-ones padding ranks above (or tied with) every real
+            # value, so padding rows sort to the end of the curve
+            h = np.concatenate([h, np.full(m - n, 0xFFFFFFFF, np.uint32)])
+        host_cols.append(jnp.asarray(h))
+    perm = np.asarray(_curve_perm(tuple(host_cols), curve))
+    if m > n:
+        perm = perm[perm < n]
+    return perm
 
 
 def _to_sortable_u32(col: np.ndarray) -> np.ndarray:

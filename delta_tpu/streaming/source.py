@@ -461,10 +461,24 @@ class DeltaCDCSource:
         cleanup' (fatal — stalling silently would report caught-up
         forever while newer versions hold undelivered changes). The
         expensive LIST verdict is cached per version, so steady-state
-        idle polls cost one failed read, and a commit that lands between
-        the probe and the LIST is re-probed rather than misreported."""
+        idle polls cost one failed read plus one `_last_checkpoint`
+        probe, and a commit that lands between the probe and the LIST is
+        re-probed rather than misreported."""
+        from delta_tpu.log.last_checkpoint import read_last_checkpoint
+
         if self._verified_pending == v:
-            return  # already verified as not-yet-committed
+            # the cached "not committed yet" verdict goes stale only if
+            # v was committed AND cleaned up since — cleanup requires a
+            # checkpoint at >= v, so a _last_checkpoint behind v proves
+            # the verdict still holds
+            try:
+                hint = read_last_checkpoint(self.table.engine.fs,
+                                            self.table.log_path)
+            except Exception:
+                hint = None
+            if hint is None or hint.version < v:
+                return
+            self._verified_pending = None  # re-verify below
         segment = None
         try:
             segment = self.table.latest_snapshot().log_segment
@@ -475,8 +489,13 @@ class DeltaCDCSource:
             return
         # the snapshot knows version v. Re-probe before declaring it
         # expired: a writer may have committed v after our first read.
-        if self._version_file_stats(v) is not None:
-            return  # it exists now; the next poll admits it
+        try:
+            if self._version_file_stats(v) is not None:
+                return  # it exists now; the next poll admits it
+        except _SchemaChanged:
+            # it exists and changes the schema — let the admission loop
+            # surface that as the documented DeltaError on the next poll
+            return
         # still unreadable: unbackfilled coordinated commits appear in
         # the segment under _delta_log/_commits/ — wait for backfill
         # rather than erroring. Only _commits/ paths count: a backfilled

@@ -237,3 +237,79 @@ def test_can_widen():
     assert can_widen(PrimitiveType("float"), PrimitiveType("double"))
     assert not can_widen(LONG, PrimitiveType("integer"))
     assert not can_widen(STRING, LONG)
+
+
+# -- cross-feature interactions --------------------------------------------
+
+def test_column_mapping_dv_checkpoint_reload(tmp_table_path):
+    """Column mapping + deletion-vector DELETE + checkpoint, then a fresh
+    reload: physical names and DV masks must survive the checkpoint."""
+    from delta_tpu.commands.dml import delete
+
+    dta.write_table(
+        tmp_table_path, _data(100),
+        properties={"delta.columnMapping.mode": "name",
+                    "delta.enableDeletionVectors": "true"})
+    table = Table.for_path(tmp_table_path)
+    rename_column(table, "name", "label")
+    delete(Table.for_path(tmp_table_path), col("id") < lit(30))
+    Table.for_path(tmp_table_path).checkpoint()
+
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert snap.log_segment.checkpoint_version is not None
+    rows = dta.read_table(tmp_table_path)
+    assert rows.num_rows == 70
+    assert "label" in rows.column_names and "name" not in rows.column_names
+    assert min(rows.column("id").to_pylist()) == 30
+    # the delete used a DV (no file rewrite): the add still has one
+    dvs = [d for d in
+           snap.state.add_files_table.column("deletion_vector").to_pylist()
+           if d]
+    assert dvs, "expected a deletion vector on the surviving add"
+
+
+def test_cdf_after_rename(tmp_table_path):
+    """Change-data-feed reads must surface the LOGICAL (renamed) column
+    names, including for pre-rename commits read through mapping."""
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.read.cdc import table_changes
+
+    dta.write_table(
+        tmp_table_path, _data(10),
+        properties={"delta.enableChangeDataFeed": "true",
+                    "delta.columnMapping.mode": "name"})
+    table = Table.for_path(tmp_table_path)
+    rename_column(table, "name", "label")                 # v1
+    delete(Table.for_path(tmp_table_path), col("id") == lit(3))  # v2
+    changes = table_changes(Table.for_path(tmp_table_path), 2, 2)
+    assert changes.num_rows >= 1
+    assert "label" in changes.column_names
+    deleted = changes.filter(
+        pa.compute.equal(changes.column("_change_type"), "delete"))
+    assert deleted.column("id").to_pylist() == [3]
+
+
+def test_optimize_materializes_dvs_and_preserves_mapping(tmp_table_path):
+    """OPTIMIZE compaction on a column-mapped table with DV deletes:
+    rewritten files drop the deleted rows (DVs materialized) and reads
+    keep working through the mapping."""
+    from delta_tpu.commands.dml import delete
+
+    props = {"delta.columnMapping.mode": "name",
+             "delta.enableDeletionVectors": "true"}
+    dta.write_table(tmp_table_path, _data(50), properties=props)
+    dta.write_table(tmp_table_path, pa.table({
+        "id": pa.array(np.arange(100, 150, dtype=np.int64)),
+        "name": pa.array([f"n{i}" for i in range(50)]),
+    }), mode="append")
+    delete(Table.for_path(tmp_table_path), col("id") < lit(10))
+    m = Table.for_path(tmp_table_path).optimize().execute_compaction()
+    assert m.num_files_added >= 1
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    # compacted adds carry no DVs
+    assert not any(
+        d for d in
+        snap.state.add_files_table.column("deletion_vector").to_pylist())
+    rows = dta.read_table(tmp_table_path)
+    assert rows.num_rows == 90
+    assert min(rows.column("id").to_pylist()) == 10
