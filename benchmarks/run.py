@@ -15,7 +15,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--benchmark", default="replay")
     ap.add_argument("--scale", default="small",
-                    choices=["smoke", "small", "medium", "full"])
+                    choices=["smoke", "small", "medium", "large", "full"])
     ap.add_argument("--workdir", default="/tmp/delta_tpu_bench")
     ap.add_argument("--report-dir", default=None)
     args = ap.parse_args()

@@ -28,6 +28,7 @@ SCALES = {
     "smoke": dict(commits=50, files_per_commit=20, rows=5_000),
     "small": dict(commits=1_000, files_per_commit=100, rows=50_000),
     "medium": dict(commits=10_000, files_per_commit=100, rows=200_000),
+    "large": dict(commits=30_000, files_per_commit=100, rows=500_000),
     "full": dict(commits=100_000, files_per_commit=100, rows=1_000_000),
 }
 

@@ -130,8 +130,35 @@ class LoggingMetricsReporter(MetricsReporter):
         self.reports.append(report)
 
 
+_ARROW_POOL_SET = False
+
+
+def _configure_arrow_pool() -> None:
+    """Size Arrow's compute pool like our own I/O pool: containers here
+    advertise 1 CPU (so Arrow defaults to single-threaded parquet decode
+    / filter / JSON parse) while the host actually schedules several
+    workers. Never shrink a user-configured pool."""
+    global _ARROW_POOL_SET
+    if _ARROW_POOL_SET:
+        return
+    _ARROW_POOL_SET = True
+    try:
+        import pyarrow as _pa
+
+        from delta_tpu.utils.threads import default_io_threads
+
+        n = default_io_threads()
+        if _pa.cpu_count() < n:
+            _pa.set_cpu_count(n)
+        if _pa.io_thread_count() < n:
+            _pa.set_io_thread_count(n)
+    except Exception:
+        pass
+
+
 class HostEngine(Engine):
     def __init__(self, store_resolver=logstore_for_path, metrics_reporters=None):
+        _configure_arrow_pool()
         super().__init__(
             json_handler=HostJsonHandler(store_resolver),
             parquet_handler=HostParquetHandler(store_resolver),

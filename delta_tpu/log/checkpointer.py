@@ -173,6 +173,9 @@ def _single_action_table(
     sizes = [len(b[2]) if b[2] is not None else 0 for b in blocks]
     total = sum(sizes)
     assert total == n, (total, n)
+    # chunked columns, not concat_arrays: the null spans and the payload
+    # arrays become chunks as-is, so a million-file checkpoint table is
+    # assembled without copying a single struct row
     cols = {}
     offset = 0
     offsets = []
@@ -182,14 +185,15 @@ def _single_action_table(
     for i, (name, typ, arr) in enumerate(blocks):
         sz = sizes[i]
         before, after = offsets[i], n - offsets[i] - sz
-        parts = []
+        chunks = []
         if before:
-            parts.append(pa.nulls(before, typ))
+            chunks.append(pa.nulls(before, typ))
         if arr is not None and sz:
-            parts.append(arr)
+            chunks.append(arr.cast(typ) if arr.type != typ else arr)
         if after:
-            parts.append(pa.nulls(after, typ))
-        cols[name] = pa.concat_arrays([p.cast(typ) if p.type != typ else p for p in parts]) if parts else pa.nulls(0, typ)
+            chunks.append(pa.nulls(after, typ))
+        cols[name] = (pa.chunked_array(chunks, type=typ) if chunks
+                      else pa.chunked_array([], type=typ))
     return pa.table(cols)
 
 

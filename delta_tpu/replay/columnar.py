@@ -225,11 +225,14 @@ def _extract_file_actions(
     struct_arr = struct_chunks.combine_chunks()
     if pa.types.is_null(struct_arr.type):
         return None
-    mask = np.asarray(pc.is_valid(struct_arr), dtype=bool)
+    valid = pc.is_valid(struct_arr)
+    mask = np.asarray(valid, dtype=bool)
     sel = np.nonzero(mask)[0]
     if sel.size == 0:
         return None
-    sub = struct_arr.take(pa.array(sel, pa.int64()))
+    # filter, not take: selection-by-mask over a wide struct (stats
+    # strings, partitionValues maps) is ~2x faster than row gather
+    sub = struct_arr.filter(valid)
     n = len(sub)
     is_add = col == "add"
 

@@ -82,6 +82,82 @@ class _SchemaChanged(Exception):
         self.version = version
 
 
+class _ExpiryGuard:
+    """Shared by DeltaSource and DeltaCDCSource: when an admission walk
+    makes no progress because commit `v`'s file is missing, distinguish
+    'not committed yet' (caught up — fine) from 'expired by log cleanup'
+    (fatal — stalling silently would report caught-up forever while
+    newer versions hold undelivered data).
+
+    The expensive LIST verdict is cached per version, so steady-state
+    idle polls cost one failed read plus one `_last_checkpoint` probe
+    (cleanup requires a checkpoint at >= v, so a hint behind v proves a
+    cached 'pending' verdict still holds); a commit that lands between
+    the probe and the LIST is re-probed rather than misreported."""
+
+    def __init__(self, table, what: str):
+        self.table = table
+        self._what = what
+        self._verified_pending: Optional[int] = None
+
+    def _exists(self, v: int) -> bool:
+        """Side-effect-free existence probe — no action parsing, so a
+        schema change or ignorable-delete in the commit can't raise from
+        inside an expiry check (those surface through the admission walk
+        on the next poll)."""
+        from delta_tpu.utils import filenames as fn
+
+        try:
+            self.table.engine.fs.file_status(
+                fn.delta_file(self.table.log_path, v))
+            return True
+        except Exception:
+            return False
+
+    def check(self, v: int) -> None:
+        from delta_tpu.log.last_checkpoint import read_last_checkpoint
+
+        if self._verified_pending == v:
+            try:
+                hint = read_last_checkpoint(self.table.engine.fs,
+                                            self.table.log_path)
+            except Exception:
+                hint = None
+            if hint is None or hint.version < v:
+                return
+            self._verified_pending = None  # re-verify below
+        try:
+            segment = self.table.latest_snapshot().log_segment
+        except Exception:
+            return  # can't list — treat as caught up, retry next poll
+        if segment.version < v:
+            self._verified_pending = v
+            return
+        # the snapshot knows version v. Re-probe before declaring it
+        # expired: a writer may have committed v after our first read.
+        if self._exists(v):
+            return  # it exists now; the next poll admits it
+        # still unreadable: unbackfilled coordinated commits appear in
+        # the segment under _delta_log/_commits/ — wait for backfill
+        # rather than erroring. Only _commits/ paths count: a backfilled
+        # name in a stale cached listing proves nothing about the file
+        # still existing.
+        from delta_tpu.utils import filenames as fn
+
+        for fstat in segment.deltas:
+            if f"/{fn.COMMIT_SUBDIR}/" not in fstat.path:
+                continue
+            try:
+                if fn.delta_version(fstat.path) == v:
+                    return
+            except ValueError:
+                continue
+        raise DeltaError(
+            f"commit {v} required by this {self._what} no longer exists "
+            "(expired by log cleanup); restart the stream from a fresh "
+            "snapshot")
+
+
 def _drain_micro_batches(
     source, limits: Optional[ReadLimits], start: Optional[DeltaSourceOffset]
 ) -> Iterator[tuple[DeltaSourceOffset, pa.Table]]:
@@ -111,6 +187,7 @@ class DeltaSource:
         self._starting_version = starting_version
         self._initial_files: Optional[List[AddFile]] = None
         self._initial_version: Optional[int] = None
+        self._expiry_guard = _ExpiryGuard(table, "stream")
         # schema evolution across the stream's lifetime
         # (DeltaSourceMetadataTrackingLog semantics): None = fail on any
         # read-incompatible metadata change mid-stream
@@ -257,6 +334,14 @@ class DeltaSource:
         while True:
             adds = self._files_from_version(v)
             if adds is None:
+                # distinguish "not committed yet" from "expired by log
+                # cleanup" — a silent stall would report caught-up
+                # forever (the CDC source shares this guard). Only when
+                # the walk made NO progress: admitted files already
+                # prove the stream isn't stalled, and the check costs a
+                # LIST.
+                if not out:
+                    self._expiry_guard.check(v)
                 break
             for i, add in enumerate(adds):
                 if v == (start.reservoir_version if start and not start.is_initial_snapshot else -1) and i <= start_idx:
@@ -361,10 +446,7 @@ class DeltaCDCSource:
             )
         self._starting_version = starting_version
         self._initial_version: Optional[int] = None
-        # version verified as "missing because not committed yet" — lets
-        # idle polls skip the expiry LIST (commits are append-only, so
-        # the verdict stays true until the probe finds the file)
-        self._verified_pending: Optional[int] = None
+        self._expiry_guard = _ExpiryGuard(table, "CDC stream")
         # the schema this stream serves; a mid-stream change is an error
         # (same contract as DeltaSource._on_metadata_action)
         if starting_version is not None:
@@ -452,69 +534,8 @@ class DeltaCDCSource:
             last = DeltaSourceOffset(v, END_INDEX)
             v += 1
         if last is None:
-            self._check_not_expired(v)
+            self._expiry_guard.check(v)
         return last or start
-
-    def _check_not_expired(self, v: int) -> None:
-        """No progress because commit `v` is missing: distinguish
-        'not committed yet' (fine — caught up) from 'expired by log
-        cleanup' (fatal — stalling silently would report caught-up
-        forever while newer versions hold undelivered changes). The
-        expensive LIST verdict is cached per version, so steady-state
-        idle polls cost one failed read plus one `_last_checkpoint`
-        probe, and a commit that lands between the probe and the LIST is
-        re-probed rather than misreported."""
-        from delta_tpu.log.last_checkpoint import read_last_checkpoint
-
-        if self._verified_pending == v:
-            # the cached "not committed yet" verdict goes stale only if
-            # v was committed AND cleaned up since — cleanup requires a
-            # checkpoint at >= v, so a _last_checkpoint behind v proves
-            # the verdict still holds
-            try:
-                hint = read_last_checkpoint(self.table.engine.fs,
-                                            self.table.log_path)
-            except Exception:
-                hint = None
-            if hint is None or hint.version < v:
-                return
-            self._verified_pending = None  # re-verify below
-        segment = None
-        try:
-            segment = self.table.latest_snapshot().log_segment
-        except Exception:
-            return  # can't list — treat as caught up, retry next poll
-        if segment.version < v:
-            self._verified_pending = v
-            return
-        # the snapshot knows version v. Re-probe before declaring it
-        # expired: a writer may have committed v after our first read.
-        try:
-            if self._version_file_stats(v) is not None:
-                return  # it exists now; the next poll admits it
-        except _SchemaChanged:
-            # it exists and changes the schema — let the admission loop
-            # surface that as the documented DeltaError on the next poll
-            return
-        # still unreadable: unbackfilled coordinated commits appear in
-        # the segment under _delta_log/_commits/ — wait for backfill
-        # rather than erroring. Only _commits/ paths count: a backfilled
-        # name in a stale cached listing proves nothing about the file
-        # still existing.
-        from delta_tpu.utils import filenames as fn
-
-        for fstat in segment.deltas:
-            if f"/{fn.COMMIT_SUBDIR}/" not in fstat.path:
-                continue
-            try:
-                if fn.delta_version(fstat.path) == v:
-                    return
-            except ValueError:
-                continue
-        raise DeltaError(
-            f"commit {v} required by this CDC stream no longer exists "
-            "(expired by log cleanup); restart the stream from a fresh "
-            "snapshot")
 
     def get_batch(
         self, start: Optional[DeltaSourceOffset], end: DeltaSourceOffset

@@ -247,3 +247,21 @@ def test_cdc_source_schema_change_delivers_prior_commits_first(tmp_table_path):
     assert sorted(b.column("id").to_pylist()) == list(range(10, 15))
     with pytest.raises(DeltaError, match="schema changed"):
         src.latest_offset(off1)
+
+
+def test_source_expired_commit_errors(tmp_table_path):
+    """Non-CDC DeltaSource shares the expiry guard: a resume offset
+    pointing before cleaned-up commits must error, not stall."""
+    import os
+    from delta_tpu.utils import filenames
+
+    dta.write_table(tmp_table_path, _batch(0, 10))
+    table = Table.for_path(tmp_table_path)
+    src = DeltaSource(table)
+    off = src.latest_offset(None)
+    dta.write_table(tmp_table_path, _batch(10, 5), mode="append")  # v1
+    dta.write_table(tmp_table_path, _batch(20, 5), mode="append")  # v2
+    table.checkpoint()
+    os.unlink(filenames.delta_file(table.log_path, 1))
+    with pytest.raises(DeltaError, match="expired"):
+        src.latest_offset(off)
