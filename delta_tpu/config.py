@@ -103,6 +103,39 @@ CHECKPOINT_POLICY = _cfg(
 TARGET_FILE_SIZE = _cfg("delta.targetFileSize", 256 * 1024 * 1024, int)
 AUTO_OPTIMIZE_AUTO_COMPACT = _cfg("delta.autoOptimize.autoCompact", False, _parse_bool)
 OPTIMIZE_WRITE = _cfg("delta.autoOptimize.optimizeWrite", False, _parse_bool)
+CHECKPOINT_WRITE_STATS_AS_JSON = _cfg(
+    "delta.checkpoint.writeStatsAsJson", True, _parse_bool,
+    "Write the per-file stats JSON string into checkpoint add rows "
+    "(`Checkpoints.scala` buildCheckpoint stats shaping).",
+)
+CHECKPOINT_WRITE_STATS_AS_STRUCT = _cfg(
+    "delta.checkpoint.writeStatsAsStruct", False, _parse_bool,
+    "Additionally write parsed `stats_parsed` structs into checkpoint "
+    "add rows (faster skipping for engines that read the struct form).",
+)
+SET_TXN_RETENTION = _cfg(
+    "delta.setTransactionRetentionDuration", None,
+    _parse_interval_ms,
+    "Expire SetTransaction (streaming idempotence) entries older than "
+    "this when writing checkpoints (`InMemoryLogReplay.scala:84-91`). "
+    "Default: keep forever.",
+)
+CHECKPOINT_RETENTION = _cfg(
+    "delta.checkpointRetentionDuration", 2 * 86_400_000, _parse_interval_ms,
+    "How long shadowed checkpoint files are kept before metadata "
+    "cleanup deletes them (reference default 2 days).",
+)
+RANDOMIZE_FILE_PREFIXES = _cfg(
+    "delta.randomizeFilePrefixes", False, _parse_bool,
+    "Prefix data file paths with a random bucket instead of partition "
+    "directories first — spreads object-store key space under high "
+    "write concurrency.",
+)
+RANDOM_PREFIX_LENGTH = _cfg(
+    "delta.randomPrefixLength", 2, int,
+    "Length of the random file-prefix bucket when "
+    "delta.randomizeFilePrefixes is on.",
+)
 
 
 def get_table_config(configuration: Dict[str, str], cfg: TableConfig):

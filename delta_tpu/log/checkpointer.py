@@ -115,8 +115,129 @@ DOMAIN_STRUCT = pa.struct(
 )
 
 
-def _file_struct_from_canonical(tbl: pa.Table, is_add: bool) -> pa.Array:
-    """Canonical columnar rows → add/remove StructArray."""
+def _stats_parsed_schema(schema, configuration,
+                         partition_columns) -> Optional[pa.Schema]:
+    """Explicit arrow schema for stats_parsed, typed per the TABLE
+    schema (external struct-form readers expect e.g. timestamp mins as
+    timestamps, not inferred strings): numRecords int64, minValues /
+    maxValues as nested structs of the indexed leaves' arrow types,
+    nullCount as int64 per leaf."""
+    from delta_tpu.models.schema import PrimitiveType, StructType, to_arrow_type
+    from delta_tpu.stats.collection import stats_columns
+
+    if schema is None:
+        return None
+
+    def resolve(path):
+        node = schema
+        for name in path[:-1]:
+            if not isinstance(node, StructType) or name not in node:
+                return None
+            node = node[name].dataType
+        if not isinstance(node, StructType) or path[-1] not in node:
+            return None
+        return node[path[-1]].dataType
+
+    minmax_tree: dict = {}
+    null_tree: dict = {}
+
+    def insert(tree, path, typ):
+        for p in path[:-1]:
+            tree = tree.setdefault(p, {})
+        tree[path[-1]] = typ
+
+    for path in stats_columns(schema, configuration, partition_columns):
+        dt = resolve(path)
+        if not isinstance(dt, PrimitiveType):
+            continue
+        try:
+            arrow_t = to_arrow_type(dt)
+        except Exception:
+            continue
+        insert(null_tree, path, pa.int64())
+        if dt.name != "binary":
+            insert(minmax_tree, path, arrow_t)
+
+    def to_struct(tree) -> pa.DataType:
+        return pa.struct([
+            pa.field(k, to_struct(v) if isinstance(v, dict) else v)
+            for k, v in tree.items()
+        ])
+
+    fields = [pa.field("numRecords", pa.int64())]
+    if minmax_tree:
+        fields.append(pa.field("minValues", to_struct(minmax_tree)))
+        fields.append(pa.field("maxValues", to_struct(minmax_tree)))
+    if null_tree:
+        fields.append(pa.field("nullCount", to_struct(null_tree)))
+    return pa.schema(fields)
+
+
+def _stats_ndjson_buffer(stats_col: pa.Array) -> Optional[pa.Buffer]:
+    """The stats strings as one newline-delimited buffer, built with
+    Arrow kernels (no per-row Python objects — this runs at
+    checkpoint-write scale)."""
+    import pyarrow.compute as _pc
+
+    filled = _pc.fill_null(stats_col, "{}")
+    with_nl = _pc.binary_join_element_wise(filled, pa.scalar("\n"))
+    arr = (with_nl.combine_chunks()
+           if isinstance(with_nl, pa.ChunkedArray) else with_nl)
+    if arr.offset != 0:
+        arr = pa.concat_arrays([arr])  # re-materialize at offset 0
+    offsets_buf = arr.buffers()[1]
+    width = 8 if pa.types.is_large_string(arr.type) else 4
+    dtype = np.int64 if width == 8 else np.int32
+    offsets = np.frombuffer(offsets_buf, dtype=dtype, count=len(arr) + 1)
+    total = int(offsets[-1])
+    return arr.buffers()[2].slice(0, total)
+
+
+def _parse_stats_structs(
+    stats_col: pa.Array, explicit_schema: Optional[pa.Schema] = None
+) -> Optional[pa.Array]:
+    """Parse per-file stats JSON strings into a struct array, typed by
+    `explicit_schema` when given (falling back to inference if the
+    explicit parse fails — e.g. 'NaN' strings in double stats). Null
+    stats become empty objects (all-null fields). None when nothing
+    parses."""
+    import pyarrow.json as pa_json
+
+    if stats_col.null_count == len(stats_col):
+        return None
+    buf = _stats_ndjson_buffer(stats_col)
+    if buf is None:
+        return None
+    parsed = None
+    if explicit_schema is not None:
+        try:
+            parsed = pa_json.read_json(
+                pa.BufferReader(buf),
+                parse_options=pa_json.ParseOptions(
+                    explicit_schema=explicit_schema,
+                    unexpected_field_behavior="ignore"))
+        except Exception:
+            parsed = None
+    if parsed is None:
+        try:
+            parsed = pa_json.read_json(pa.BufferReader(buf))
+        except Exception:
+            return None  # malformed stats: skip the struct form entirely
+    if parsed.num_rows != len(stats_col):
+        return None
+    return parsed.to_struct_array().combine_chunks()
+
+
+def _file_struct_from_canonical(
+    tbl: pa.Table,
+    is_add: bool,
+    stats_as_json: bool = True,
+    stats_as_struct: bool = False,
+    stats_schema: Optional[pa.Schema] = None,
+) -> pa.Array:
+    """Canonical columnar rows → add/remove StructArray. Stats shaping
+    per `delta.checkpoint.writeStatsAsJson` / `writeStatsAsStruct`
+    (`Checkpoints.scala` buildCheckpoint)."""
     n = tbl.num_rows
     false_col = pa.array(np.zeros(n, dtype=bool))
 
@@ -124,19 +245,26 @@ def _file_struct_from_canonical(tbl: pa.Table, is_add: bool) -> pa.Array:
         return tbl.column(name).combine_chunks()
 
     if is_add:
+        stats = col("stats")
+        fields = list(ADD_STRUCT)
         children = [
             col("path"),
             col("partition_values"),
             col("size"),
             col("modification_time"),
             false_col,  # dataChange normalized to false in checkpoints
-            col("stats"),
+            stats if stats_as_json else pa.nulls(n, pa.string()),
             col("deletion_vector"),
             col("base_row_id"),
             col("default_row_commit_version"),
             col("clustering_provider"),
         ]
-        return pa.StructArray.from_arrays(children, fields=list(ADD_STRUCT))
+        if stats_as_struct:
+            parsed = _parse_stats_structs(stats, stats_schema)
+            if parsed is not None:
+                children.append(parsed)
+                fields = fields + [pa.field("stats_parsed", parsed.type)]
+        return pa.StructArray.from_arrays(children, fields=fields)
     children = [
         col("path"),
         col("deletion_timestamp"),
@@ -184,12 +312,16 @@ def _single_action_table(
         offset += sz
     for i, (name, typ, arr) in enumerate(blocks):
         sz = sizes[i]
+        # honor the payload's actual type when present — the add struct
+        # may carry an extra stats_parsed field beyond the static schema
+        if arr is not None and sz:
+            typ = arr.type
         before, after = offsets[i], n - offsets[i] - sz
         chunks = []
         if before:
             chunks.append(pa.nulls(before, typ))
         if arr is not None and sz:
-            chunks.append(arr.cast(typ) if arr.type != typ else arr)
+            chunks.append(arr)
         if after:
             chunks.append(pa.nulls(after, typ))
         cols[name] = (pa.chunked_array(chunks, type=typ) if chunks
@@ -197,7 +329,7 @@ def _single_action_table(
     return pa.table(cols)
 
 
-def _small_action_arrays(state) -> tuple:
+def _small_action_arrays(state, txn_min_last_updated: Optional[int] = None) -> tuple:
     proto = state.protocol
     protocol_rows = pa.array(
         [
@@ -230,15 +362,23 @@ def _small_action_arrays(state) -> tuple:
         ],
         METADATA_STRUCT,
     )
+    txns = list(state.set_transactions.values())
+    if txn_min_last_updated is not None:
+        # expire idle SetTransaction entries from the checkpoint
+        # (`InMemoryLogReplay.scala:84-91`: lastUpdated.exists(_ > min) —
+        # entries without a timestamp are dropped once retention is on)
+        txns = [t for t in txns
+                if t.lastUpdated is not None
+                and t.lastUpdated >= txn_min_last_updated]
     txn_rows = (
         pa.array(
             [
                 {"appId": t.appId, "version": t.version, "lastUpdated": t.lastUpdated}
-                for t in state.set_transactions.values()
+                for t in txns
             ],
             TXN_STRUCT,
         )
-        if state.set_transactions
+        if txns
         else None
     )
     domain_rows = (
@@ -273,12 +413,30 @@ def write_checkpoint(engine, snapshot, policy: Optional[str] = None) -> LastChec
         policy = get_table_config(meta_conf, CHECKPOINT_POLICY)
     now_ms = int(time.time() * 1000)
     retention = get_table_config(meta_conf, TOMBSTONE_RETENTION)
+    from delta_tpu.config import (
+        CHECKPOINT_WRITE_STATS_AS_JSON,
+        CHECKPOINT_WRITE_STATS_AS_STRUCT,
+        SET_TXN_RETENTION,
+    )
+
+    stats_as_json = get_table_config(meta_conf, CHECKPOINT_WRITE_STATS_AS_JSON)
+    stats_as_struct = get_table_config(meta_conf, CHECKPOINT_WRITE_STATS_AS_STRUCT)
+    txn_retention = get_table_config(meta_conf, SET_TXN_RETENTION)
+    txn_min = (now_ms - txn_retention) if txn_retention is not None else None
 
     adds = state.add_files_table
     tombs = _retained_tombstones(state, now_ms, retention)
-    add_struct = _file_struct_from_canonical(adds, is_add=True)
+    stats_schema = (_stats_parsed_schema(
+        state.metadata.schema, meta_conf,
+        list(state.metadata.partitionColumns or []))
+        if stats_as_struct else None)
+    add_struct = _file_struct_from_canonical(
+        adds, is_add=True,
+        stats_as_json=stats_as_json, stats_as_struct=stats_as_struct,
+        stats_schema=stats_schema)
     remove_struct = _file_struct_from_canonical(tombs, is_add=False)
-    protocol_rows, metadata_rows, txn_rows, domain_rows = _small_action_arrays(state)
+    protocol_rows, metadata_rows, txn_rows, domain_rows = _small_action_arrays(
+        state, txn_min_last_updated=txn_min)
 
     if settings.verify_checkpoint_row_count and len(add_struct) != state.num_files:
         raise ChecksumMismatchError(

@@ -37,14 +37,30 @@ def cleanup_expired_logs(
     now_ms: Optional[int] = None,
 ) -> List[str]:
     """Delete expired, checkpoint-shadowed log files. Returns deleted paths."""
-    from delta_tpu.config import LOG_RETENTION, get_table_config
+    from delta_tpu.config import (
+        CHECKPOINT_RETENTION,
+        LOG_RETENTION,
+        get_table_config,
+    )
 
     engine = table.engine
     snap = table.latest_snapshot()
+    explicit_retention = retention_ms is not None
     if retention_ms is None:
         retention_ms = get_table_config(snap.metadata.configuration, LOG_RETENTION)
     now = now_ms if now_ms is not None else int(time.time() * 1000)
     cutoff = now - retention_ms
+    # shadowed checkpoints expire on their own (usually shorter) clock:
+    # delta.checkpointRetentionDuration (2 days default) vs the 30-day
+    # commit retention. An explicitly passed retention overrides both
+    # directions — a caller guaranteeing a week of time travel must not
+    # lose 3-day-old checkpoints to the table default.
+    if explicit_retention:
+        cp_cutoff = cutoff
+    else:
+        cp_retention = get_table_config(
+            snap.metadata.configuration, CHECKPOINT_RETENTION)
+        cp_cutoff = max(cutoff, now - cp_retention)
 
     listing = list(engine.fs.list_from(filenames.listing_prefix(table.log_path, 0)))
     checkpoints = [
@@ -66,13 +82,15 @@ def cleanup_expired_logs(
             version = filenames.checksum_version(f.path)
         elif filenames.COMPACTED_DELTA_FILE_RE.match(name):
             _, version = filenames.compacted_delta_versions(f.path)
-        elif filenames.CHECKPOINT_FILE_RE.match(name):
+        file_cutoff = cutoff
+        if filenames.CHECKPOINT_FILE_RE.match(name):
             version = filenames.checkpoint_version(f.path)
             if version >= newest_cp_version:
                 continue  # never delete the active checkpoint
+            file_cutoff = cp_cutoff
         if version is None:
             continue
-        if version < newest_cp_version and f.modification_time < cutoff:
+        if version < newest_cp_version and f.modification_time < file_cutoff:
             try:
                 engine.fs.delete(f.path)
                 deleted.append(f.path)

@@ -242,6 +242,10 @@ def _extract_file_actions(
     mod_time = _field_or_null(sub, "modificationTime", pa.int64())
     data_change = _field_or_null(sub, "dataChange", pa.bool_())
     stats = _field_or_null(sub, "stats", pa.string())
+    if is_add and stats.null_count == n:
+        # writeStatsAsJson=false checkpoints carry stats only in the
+        # stats_parsed struct — re-serialize so skipping keeps working
+        stats = _stats_from_parsed(sub, n) or stats
     tags = _map_or_json_to_string(_field_or_null(sub, "tags", pa.string()), n)
     dv_struct, dv_id = _normalize_dv(
         _field_or_null(sub, "deletionVector", DV_STRUCT_TYPE), n
@@ -274,6 +278,30 @@ def _extract_file_actions(
         },
         schema=CANONICAL_FILE_ACTION_SCHEMA,
     )
+
+
+def _stats_from_parsed(sub: pa.StructArray, n: int) -> Optional[pa.Array]:
+    """Re-serialize `stats_parsed` structs to stats JSON strings (only
+    taken when the checkpoint was written with writeStatsAsJson=false,
+    so the struct is the sole stats form)."""
+    names = [f.name for f in sub.type]
+    if "stats_parsed" not in names:
+        return None
+    sp = sub.field("stats_parsed")
+    if pa.types.is_null(sp.type) or sp.null_count == len(sp):
+        return None
+    import json as _json
+
+    from delta_tpu.stats.collection import _json_value
+
+    out = []
+    for r in sp.to_pylist():
+        if not r:
+            out.append(None)
+        else:
+            out.append(_json.dumps(_prune_nones(r), separators=(",", ":"),
+                                   default=_json_value))
+    return pa.array(out, pa.string())
 
 
 def _prune_nones(d):

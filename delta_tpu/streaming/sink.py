@@ -8,6 +8,7 @@ coordination.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Sequence
 
 import pyarrow as pa
@@ -49,12 +50,11 @@ class DeltaSink:
         last = txn.txn_version(self.query_id)
         if last is not None and batch_id <= last:
             return None  # already applied — exactly-once replay protection
-        txn.set_transaction_id(self.query_id, batch_id)
+        txn.set_transaction_id(self.query_id, batch_id,
+                               last_updated=int(time.time() * 1000))
 
         meta = txn.metadata()
         if self.output_mode == "complete":
-            import time
-
             for f in txn.scan_files():
                 txn.remove_file(f.remove(deletion_timestamp=int(time.time() * 1000)))
         adds = write_data_files(

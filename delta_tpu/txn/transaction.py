@@ -306,6 +306,12 @@ class Transaction:
             raise ConcurrentTransactionError(
                 f"transaction {app_id} already advanced to {existing} >= {version}"
             )
+        if last_updated is None:
+            # always stamped (reference commit path does the same):
+            # delta.setTransactionRetentionDuration drops un-timestamped
+            # entries at the first checkpoint, which would break
+            # idempotent replay protection for fresh watermarks
+            last_updated = int(time.time() * 1000)
         self._set_txns[app_id] = SetTransaction(app_id, version, last_updated)
 
     def update_metadata(self, metadata: Metadata) -> None:

@@ -98,6 +98,15 @@ def write_data_files(
     adds: List[AddFile] = []
     partition_columns = list(partition_columns)
 
+    from delta_tpu.config import (
+        RANDOM_PREFIX_LENGTH,
+        RANDOMIZE_FILE_PREFIXES,
+        get_table_config,
+    )
+
+    randomize_prefixes = get_table_config(configuration, RANDOMIZE_FILE_PREFIXES)
+    prefix_len = max(1, get_table_config(configuration, RANDOM_PREFIX_LENGTH))
+
     mapped = mapping_mode(configuration) != "none"
     l2p = logical_to_physical_names(schema) if mapped else {}
 
@@ -129,9 +138,15 @@ def write_data_files(
         for chunk in _split_rows(file_data, target_rows_per_file):
             if chunk.num_rows == 0:
                 continue
-            rel_dir = partition_path(phys_pv, phys_part_cols)
             fname = f"part-{uuid.uuid4()}.parquet"
-            rel_path = f"{rel_dir}{fname}"
+            if randomize_prefixes:
+                # random bucket INSTEAD of partition directories
+                # (reference DelayedCommitProtocol): flattens the
+                # object-store key space; partition values live in the
+                # AddFile metadata, not the path
+                rel_path = f"{uuid.uuid4().hex[:prefix_len]}/{fname}"
+            else:
+                rel_path = f"{partition_path(phys_pv, phys_part_cols)}{fname}"
             abs_path = f"{table_path}/{rel_path}"
             status = engine.parquet.write_parquet_file(abs_path, chunk)
             stats = collect_stats(

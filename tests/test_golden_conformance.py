@@ -357,3 +357,105 @@ def test_v2_checkpoint_multiple_sidecars(tmp_path, engine_cls):
     snap = Table.for_path(path, engine_cls()).latest_snapshot()
     assert snap.log_segment.checkpoint_version == 1
     assert live_paths(snap) == [f"f{i}" for i in range(1, 9)]
+
+
+def test_checkpoint_stats_shaping(tmp_path):
+    """delta.checkpoint.writeStatsAsJson/writeStatsAsStruct control the
+    checkpoint add-row stats forms (`Checkpoints.scala` buildCheckpoint)."""
+    import pyarrow.parquet as pq
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+
+    def make(path, props):
+        dta.write_table(path, pa.table(
+            {"x": pa.array(np.arange(5, dtype=np.int64))}), properties=props)
+        t = Table.for_path(path)
+        t.checkpoint()
+        log = os.path.join(path, "_delta_log")
+        cp = [f for f in os.listdir(log) if f.endswith(".checkpoint.parquet")]
+        return pq.read_table(os.path.join(log, cp[0]))
+
+    # struct form on: stats_parsed present with parsed minValues
+    tbl = make(str(tmp_path / "t1"),
+               {"delta.checkpoint.writeStatsAsStruct": "true"})
+    add_t = tbl.column("add").combine_chunks()
+    assert "stats_parsed" in [f.name for f in add_t.type]
+    import pyarrow.compute as pc
+    sp = pc.struct_field(add_t, "stats_parsed")
+    rows = [r for r in sp.to_pylist() if r and r.get("numRecords")]
+    assert rows and rows[0]["numRecords"] == 5
+    assert rows[0]["minValues"]["x"] == 0
+
+    # json off: stats column all-null in the checkpoint
+    tbl2 = make(str(tmp_path / "t2"),
+                {"delta.checkpoint.writeStatsAsJson": "false"})
+    add2 = tbl2.column("add").combine_chunks()
+    stats2 = pc.struct_field(add2, "stats")
+    assert all(s is None for s in stats2.to_pylist())
+
+
+def test_set_transaction_checkpoint_retention(tmp_path):
+    """delta.setTransactionRetentionDuration expires idle SetTransaction
+    entries from checkpoints (`InMemoryLogReplay.scala:84-91`)."""
+    import time as _time
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+    from delta_tpu.streaming import DeltaSink
+
+    path = str(tmp_path / "t")
+    dta.write_table(
+        path, pa.table({"x": pa.array(np.arange(3, dtype=np.int64))}),
+        properties={"delta.setTransactionRetentionDuration": "interval 1 millisecond"})
+    DeltaSink(path, query_id="old-stream").add_batch(0, pa.table(
+        {"x": pa.array([10], pa.int64())}))
+    _time.sleep(0.05)  # let the entry age past the 1ms retention
+    t = Table.for_path(path)
+    t.checkpoint()
+    snap = Table.for_path(path).latest_snapshot()
+    assert "old-stream" not in snap.state.set_transactions
+
+
+def test_randomized_file_prefixes(tmp_path):
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+
+    path = str(tmp_path / "t")
+    dta.write_table(
+        path, pa.table({"x": pa.array(np.arange(10, dtype=np.int64))}),
+        properties={"delta.randomizeFilePrefixes": "true",
+                    "delta.randomPrefixLength": "3"})
+    snap = Table.for_path(path).latest_snapshot()
+    paths = snap.state.add_files_table.column("path").to_pylist()
+    for p in paths:
+        bucket, _, rest = p.partition("/")
+        assert len(bucket) == 3 and rest.startswith("part-"), p
+    assert dta.read_table(path).num_rows == 10
+
+
+def test_stats_struct_only_checkpoint_keeps_skipping(tmp_path):
+    """The reference-recommended combo writeStatsAsJson=false +
+    writeStatsAsStruct=true: after checkpointing, stats survive via the
+    struct form and data skipping still prunes."""
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+    from delta_tpu.expressions import col, lit
+
+    path = str(tmp_path / "t")
+    props = {"delta.checkpoint.writeStatsAsJson": "false",
+             "delta.checkpoint.writeStatsAsStruct": "true"}
+    dta.write_table(path, pa.table(
+        {"x": pa.array(np.arange(10, dtype=np.int64))}), properties=props)
+    dta.write_table(path, pa.table(
+        {"x": pa.array(np.arange(100, 110, dtype=np.int64))}), mode="append")
+    Table.for_path(path).checkpoint()
+    snap = Table.for_path(path).latest_snapshot()
+    stats = [s for s in
+             snap.state.add_files_table.column("stats").to_pylist() if s]
+    assert len(stats) == 2  # reconstructed from stats_parsed
+    assert json.loads(sorted(stats)[0])["minValues"]["x"] == 0
+    files = snap.scan(filter=col("x") >= lit(100)).files()
+    assert len(files) == 1
