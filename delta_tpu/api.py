@@ -12,7 +12,7 @@ from typing import Dict, List, Optional, Sequence
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, InvariantViolationError
 from delta_tpu.models.actions import RemoveFile
 from delta_tpu.models.schema import from_arrow_schema
 from delta_tpu.table import Table
@@ -30,10 +30,19 @@ def write_table(
     target_rows_per_file: Optional[int] = None,
     schema=None,
     merge_schema: bool = False,
+    overwrite_schema: bool = False,
+    replace_where=None,
 ) -> int:
     """Write an Arrow table as a Delta commit. Returns the commit version.
 
     mode: 'append' | 'overwrite' | 'error' (fail if exists) | 'ignore'.
+    overwrite_schema: with mode='overwrite', replace the table schema
+    with the incoming data's schema (the reference's overwriteSchema
+    option).
+    replace_where: with mode='overwrite', an Expression — only rows
+    matching it are replaced (matching rows are deleted exactly as
+    DELETE would, then the new data is appended; every incoming row must
+    satisfy the predicate — reference `replaceWhere` semantics).
     """
     table = Table.for_path(path, engine)
     exists = table.exists()
@@ -42,6 +51,13 @@ def write_table(
     if exists and mode == "ignore":
         snap = table.latest_snapshot()
         return snap.version
+
+    if (overwrite_schema or replace_where is not None) and mode != "overwrite":
+        raise DeltaError(
+            "overwrite_schema/replace_where require mode='overwrite'")
+    if overwrite_schema and replace_where is not None:
+        raise DeltaError(
+            "overwrite_schema cannot be combined with replace_where")
 
     builder = table.create_transaction_builder(
         Operation.WRITE if exists else Operation.CREATE_TABLE
@@ -55,6 +71,23 @@ def write_table(
         if properties:
             builder = builder.with_table_properties(properties)
     txn = builder.build()
+
+    if exists and mode == "overwrite" and overwrite_schema:
+        import dataclasses
+
+        from delta_tpu.models.schema import schema_to_json
+
+        cur_meta = txn.metadata()
+        new_schema = (schema if schema is not None
+                      else from_arrow_schema(data.schema))
+        new_parts = list(partition_by or [])
+        if (new_schema.to_json_value() != cur_meta.schema.to_json_value()
+                or new_parts != list(cur_meta.partitionColumns or [])):
+            # the new schema replaces partitioning too (reference
+            # overwriteSchema allows repartitioning the table)
+            txn.update_metadata(dataclasses.replace(
+                cur_meta, schemaString=schema_to_json(new_schema),
+                partitionColumns=new_parts))
 
     if exists and merge_schema:
         import dataclasses
@@ -95,9 +128,29 @@ def write_table(
                 )
             )
 
+    rw_metrics = None
+    if replace_where is not None:
+        # every incoming row must satisfy the predicate (reference
+        # replaceWhere constraint check) — enforced even on a first
+        # write: a brand-new table must not be seeded with violating rows
+        from delta_tpu.expressions.eval import evaluate_predicate_host
+
+        matches = evaluate_predicate_host(replace_where, data)
+        if not bool(matches.all()):
+            raise InvariantViolationError(
+                "replace_where: written data contains rows that do "
+                "not match the predicate")
+
     if exists and mode == "overwrite":
-        for f in txn.scan_files():
-            txn.remove_file(f.remove(deletion_timestamp=_now_ms()))
+        if replace_where is not None:
+            from delta_tpu.commands.dml import DMLMetrics, delete_matching_rows
+
+            rw_metrics = DMLMetrics()
+            delete_matching_rows(txn, table, txn.read_snapshot,
+                                 replace_where, rw_metrics)
+        else:
+            for f in txn.scan_files():
+                txn.remove_file(f.remove(deletion_timestamp=_now_ms()))
 
     adds = write_data_files(
         engine=table.engine,
@@ -109,6 +162,28 @@ def write_table(
         target_rows_per_file=target_rows_per_file,
     )
     txn.add_files(adds)
+    if replace_where is not None:
+        from delta_tpu.config import ENABLE_CDF, get_table_config
+
+        if exists and get_table_config(meta.configuration, ENABLE_CDF):
+            # the commit carries delete CDC images from the replaced
+            # rows; once a commit has ANY cdc file the change feed is
+            # served exclusively from them, so the inserted rows need
+            # their insert images too
+            from delta_tpu.commands.dml import _write_cdc
+
+            _write_cdc(table, txn.read_snapshot, txn, data, "insert")
+        params = {"predicate": repr(replace_where)}
+        txn.set_operation_parameters(params)
+        if rw_metrics is not None:
+            txn.set_operation_metrics({
+                "numDeletedRows": rw_metrics.num_rows_deleted,
+                "numRemovedFiles": (rw_metrics.num_files_removed_fully
+                                    + rw_metrics.num_files_rewritten
+                                    + rw_metrics.num_dvs_written),
+                "numCopiedRows": rw_metrics.num_rows_copied,
+                "numOutputRows": data.num_rows,
+            })
     result = txn.commit()
     return result.version
 

@@ -123,6 +123,51 @@ def delete(table, predicate: Optional[Expression] = None) -> DMLMetrics:
         metrics.version = result.version
         return metrics
 
+    delete_matching_rows(txn, table, snapshot, predicate, metrics,
+                         now_ms=now_ms, use_dv=use_dv, use_cdc=use_cdc,
+                         candidates=candidates)
+
+    if not txn._adds and not txn._removes:
+        return metrics  # nothing matched; no commit
+    txn.set_operation_parameters({"predicate": repr(predicate)})
+    txn.set_operation_metrics(
+        {
+            "numDeletedRows": metrics.num_rows_deleted,
+            "numRemovedFiles": metrics.num_files_removed_fully + metrics.num_files_rewritten + metrics.num_dvs_written,
+            "numCopiedRows": metrics.num_rows_copied,
+            "numDeletionVectorsAdded": metrics.num_dvs_written,
+        }
+    )
+    result = txn.commit()
+    metrics.version = result.version
+    return metrics
+
+
+def delete_matching_rows(
+    txn,
+    table,
+    snapshot,
+    predicate: Expression,
+    metrics: DMLMetrics,
+    now_ms: Optional[int] = None,
+    use_dv: Optional[bool] = None,
+    use_cdc: Optional[bool] = None,
+    candidates=None,
+) -> None:
+    """Stage the removal of all rows matching `predicate` into an open
+    transaction: full-file removes, deletion-vector writes, or
+    copy-on-write rewrites (+ CDC files), exactly as DELETE — shared by
+    DELETE and by overwrite-with-replaceWhere."""
+    meta = snapshot.metadata
+    if now_ms is None:
+        now_ms = int(time.time() * 1000)
+    if use_dv is None:
+        use_dv = get_table_config(meta.configuration, DELETION_VECTORS_ENABLED)
+    if use_cdc is None:
+        use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+    if candidates is None:
+        candidates = txn.scan_files(filter=predicate)
+
     from delta_tpu.expressions.eval import evaluate_predicate_host
 
     dv_writes: List[tuple] = []
@@ -180,21 +225,6 @@ def delete(table, predicate: Optional[Expression] = None) -> DMLMetrics:
             new_add.extra = dict(add.extra)
             txn.add_file(new_add)
             metrics.num_dvs_written += 1
-
-    if not txn._adds and not txn._removes:
-        return metrics  # nothing matched; no commit
-    txn.set_operation_parameters({"predicate": repr(predicate)})
-    txn.set_operation_metrics(
-        {
-            "numDeletedRows": metrics.num_rows_deleted,
-            "numRemovedFiles": metrics.num_files_removed_fully + metrics.num_files_rewritten + metrics.num_dvs_written,
-            "numCopiedRows": metrics.num_rows_copied,
-            "numDeletionVectorsAdded": metrics.num_dvs_written,
-        }
-    )
-    result = txn.commit()
-    metrics.version = result.version
-    return metrics
 
 
 def update(

@@ -180,7 +180,10 @@ def _stats_ndjson_buffer(stats_col: pa.Array) -> Optional[pa.Buffer]:
     import pyarrow.compute as _pc
 
     filled = _pc.fill_null(stats_col, "{}")
-    with_nl = _pc.binary_join_element_wise(filled, pa.scalar("\n"))
+    # append "\n" per row: the LAST argument is the separator, so join
+    # (value, "") with separator "\n" — value + "\n" + ""
+    with_nl = _pc.binary_join_element_wise(filled, pa.scalar(""),
+                                           pa.scalar("\n"))
     arr = (with_nl.combine_chunks()
            if isinstance(with_nl, pa.ChunkedArray) else with_nl)
     if arr.offset != 0:

@@ -283,7 +283,12 @@ def _extract_file_actions(
 def _stats_from_parsed(sub: pa.StructArray, n: int) -> Optional[pa.Array]:
     """Re-serialize `stats_parsed` structs to stats JSON strings (only
     taken when the checkpoint was written with writeStatsAsJson=false,
-    so the struct is the sole stats form)."""
+    so the struct is the sole stats form).
+
+    Deliberately a per-row Python pass: JSON string escaping rules out a
+    compositional Arrow-kernel rebuild, and this path only runs for the
+    opt-in struct-only checkpoint configuration, once per snapshot load
+    (the result is cached with the snapshot state)."""
     names = [f.name for f in sub.type]
     if "stats_parsed" not in names:
         return None

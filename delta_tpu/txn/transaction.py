@@ -366,6 +366,20 @@ class Transaction:
 
         validate_writable(self.protocol(), meta)
 
+        from delta_tpu.config import APPEND_ONLY
+
+        if get_table_config(meta.configuration, APPEND_ONLY) and any(
+            r.dataChange for r in self._removes
+        ):
+            # commit-level backstop (`DeltaLog.assertRemovable`): DML
+            # commands check earlier, but a raw transaction must not
+            # bypass the table contract. dataChange=false removes
+            # (OPTIMIZE rewrites) stay allowed.
+            raise DeltaError(
+                "This table is configured to only allow appends "
+                "(delta.appendOnly=true); data-changing removes are not "
+                "permitted")
+
         now = int(time.time() * 1000)
         ict = None
         if get_table_config(meta.configuration, IN_COMMIT_TIMESTAMPS):

@@ -208,3 +208,34 @@ def test_coordinated_commit_race(coordinated_path):
     snap = Table.for_path(coordinated_path).latest_snapshot()
     paths = set(snap.state.add_files_table.column("path").to_pylist())
     assert {"ca.parquet", "cb.parquet"} <= paths
+
+
+def test_append_only_commit_backstop(tmp_table_path):
+    """A raw transaction with a data-changing remove must be rejected on
+    an appendOnly table at commit (DeltaLog.assertRemovable), while
+    dataChange=false rewrites stay allowed."""
+    import numpy as np
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"x": pa.array(np.arange(5, dtype=np.int64))}),
+        properties={"delta.appendOnly": "true"})
+    t = Table.for_path(tmp_table_path)
+    snap = t.latest_snapshot()
+    add = snap.state.add_files()[0]
+
+    txn = t.start_transaction("DELETE")
+    txn.remove_file(add.remove(deletion_timestamp=1, data_change=True))
+    with pytest.raises(DeltaError, match="only allow appends"):
+        txn.commit()
+
+    # dataChange=false (compaction-style) remove is fine
+    txn2 = t.start_transaction("OPTIMIZE")
+    txn2.remove_file(add.remove(deletion_timestamp=1, data_change=False))
+    txn2.add_files([add])
+    txn2.commit()

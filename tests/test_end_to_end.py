@@ -157,3 +157,107 @@ def test_crc_written_and_validates(tmp_table_path, sample_data, engine):
     assert crc is not None
     snap = table.latest_snapshot()
     validate_state_against_checksum(snap.state, crc)
+
+
+def test_overwrite_schema(tmp_table_path):
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+    from delta_tpu.errors import DeltaError
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"a": pa.array(np.arange(5, dtype=np.int64))}))
+    new = pa.table({"b": pa.array(["x", "y"])})
+    # schema change without the flag is a schema mismatch
+    import pytest
+    with pytest.raises(Exception):
+        dta.write_table(tmp_table_path, new, mode="overwrite")
+    dta.write_table(tmp_table_path, new, mode="overwrite",
+                    overwrite_schema=True)
+    out = dta.read_table(tmp_table_path)
+    assert out.column_names == ["b"]
+    assert out.num_rows == 2
+    assert [f.name for f in
+            Table.for_path(tmp_table_path).latest_snapshot().schema.fields] == ["b"]
+    with pytest.raises(DeltaError):
+        dta.write_table(tmp_table_path, new, mode="append",
+                        overwrite_schema=True)
+
+
+def test_replace_where(tmp_table_path):
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+    from delta_tpu.errors import InvariantViolationError
+    from delta_tpu.expressions import col, lit
+    import pytest
+
+    def batch(part, vals):
+        return pa.table({
+            "p": pa.array([part] * len(vals)),
+            "v": pa.array(np.asarray(vals, dtype=np.int64)),
+        })
+
+    dta.write_table(tmp_table_path, batch("a", [1, 2]), partition_by=["p"])
+    dta.write_table(tmp_table_path, batch("b", [3, 4]), mode="append")
+
+    # replace partition a only
+    dta.write_table(tmp_table_path, batch("a", [9]), mode="overwrite",
+                    replace_where=col("p") == lit("a"))
+    out = dta.read_table(tmp_table_path)
+    rows = sorted(zip(out.column("p").to_pylist(), out.column("v").to_pylist()))
+    assert rows == [("a", 9), ("b", 3), ("b", 4)]
+
+    # data violating the predicate is rejected
+    with pytest.raises(InvariantViolationError):
+        dta.write_table(tmp_table_path, batch("b", [7]), mode="overwrite",
+                        replace_where=col("p") == lit("a"))
+
+    # non-partition predicate: row-level replacement within files (the
+    # b-file holds v=3,4; only v<=3 is replaced, 4 survives the rewrite)
+    dta.write_table(tmp_table_path, pa.table(
+        {"p": pa.array(["b"]), "v": pa.array([1], pa.int64())}),
+        mode="overwrite", replace_where=col("v") <= lit(3))
+    out2 = dta.read_table(tmp_table_path)
+    rows2 = sorted(zip(out2.column("p").to_pylist(), out2.column("v").to_pylist()))
+    assert rows2 == [("a", 9), ("b", 1), ("b", 4)]
+
+
+def test_replace_where_cdc_has_inserts(tmp_table_path):
+    """replaceWhere on a CDF table must emit insert images alongside the
+    delete images (the feed is served exclusively from cdc files)."""
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+    from delta_tpu.expressions import col, lit
+    from delta_tpu.read.cdc import table_changes
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"p": pa.array(["a", "b"]), "v": pa.array([1, 2], pa.int64())}),
+        partition_by=["p"],
+        properties={"delta.enableChangeDataFeed": "true"})
+    dta.write_table(tmp_table_path, pa.table(
+        {"p": pa.array(["a"]), "v": pa.array([9], pa.int64())}),
+        mode="overwrite", replace_where=col("p") == lit("a"))
+    ch = table_changes(Table.for_path(tmp_table_path), 1, 1)
+    types = sorted(zip(ch.column("_change_type").to_pylist(),
+                       ch.column("v").to_pylist()))
+    assert ("delete", 1) in types and ("insert", 9) in types
+    # history carries the predicate + metrics
+    hist = Table.for_path(tmp_table_path).history(1)[0].to_dict()
+    assert "predicate" in str(hist.get("operationParameters", {}))
+
+
+def test_replace_where_validates_on_new_table(tmp_table_path):
+    import delta_tpu.api as dta
+    import pyarrow as pa
+    import pytest
+    from delta_tpu.errors import InvariantViolationError
+    from delta_tpu.expressions import col, lit
+
+    with pytest.raises(InvariantViolationError):
+        dta.write_table(tmp_table_path, pa.table({"p": pa.array(["b"])}),
+                        mode="overwrite",
+                        replace_where=col("p") == lit("a"))
