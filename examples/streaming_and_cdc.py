@@ -64,6 +64,19 @@ def main():
     print("\nchange feed:")
     print(changes.select(["seq", "_change_type", "_commit_version"]).to_pandas())
 
+    # streaming CDC: the initial snapshot arrives as inserts, then each
+    # commit's change images tail in micro-batches
+    from delta_tpu.streaming import DeltaCDCSource
+
+    cdc = DeltaCDCSource(Table.for_path(path))
+    off = cdc.latest_offset(None)
+    snapshot_batch = cdc.get_batch(None, off)
+    print(f"\nCDC stream initial snapshot: {snapshot_batch.num_rows} insert rows")
+    update(Table.for_path(path), {"seq": lit(-2)}, col("seq") == lit(-1))
+    for o, b in cdc.micro_batches(start=off):
+        kinds = sorted(set(b.column("_change_type").to_pylist()))
+        print(f"CDC micro-batch @v{o.reservoir_version}: {b.num_rows} rows {kinds}")
+
 
 if __name__ == "__main__":
     main()
