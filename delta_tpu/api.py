@@ -134,8 +134,27 @@ def write_table(
         # replaceWhere constraint check) — enforced even on a first
         # write: a brand-new table must not be seeded with violating rows
         from delta_tpu.expressions.eval import evaluate_predicate_host
+        from delta_tpu.models.schema import to_arrow_type
 
-        matches = evaluate_predicate_host(replace_where, data)
+        schema_cols = {f.name: f for f in schema.fields}
+        # references() yields name-path tuples; top-level name decides
+        # schema membership (nested predicates resolve inside the field)
+        ref_names = sorted({p[0] for p in replace_where.references()})
+        unknown = [n for n in ref_names if n not in schema_cols]
+        if unknown:
+            raise DeltaError(
+                f"replace_where references column(s) {unknown} not in the "
+                "table schema")
+        # predicate columns absent from the written batch read as NULL
+        # (which never satisfies the predicate -> clean violation error,
+        # not a KeyError)
+        eval_data = data
+        for name in ref_names:
+            if name not in eval_data.column_names:
+                eval_data = eval_data.append_column(
+                    name, pa.nulls(eval_data.num_rows,
+                                   to_arrow_type(schema_cols[name].dataType)))
+        matches = evaluate_predicate_host(replace_where, eval_data)
         if not bool(matches.all()):
             raise InvariantViolationError(
                 "replace_where: written data contains rows that do "

@@ -26,7 +26,8 @@ Catalog statements (require `catalog=`):
 
 Query/DML (paths or names):
     SELECT <cols|*> FROM <t> [WHERE <pred>] [LIMIT n]
-    INSERT INTO <t> VALUES (v1, v2, ...)[, (...)]
+    INSERT INTO <t> [(cols)] VALUES (v1, v2, ...)[, (...)]
+    INSERT OVERWRITE <t> [(cols)] [REPLACE WHERE <pred>] VALUES (...)
     DELETE FROM <t> [WHERE <pred>]
     UPDATE <t> SET col = <literal>[, ...] [WHERE <pred>]
 
@@ -510,8 +511,8 @@ def _query_statement(s: str, engine, catalog):
         return out
 
     m = re.fullmatch(
-        rf"INSERT\s+INTO\s+{_PATH}\s*"
-        r"(?:\((?P<collist>[^)]+)\)\s*)?VALUES\s+(?P<vals>.+)",
+        rf"INSERT\s+(?:INTO|(?P<overwrite>OVERWRITE))\s+{_PATH}\s*"
+        r"(?:\((?P<collist>[^)]+)\)\s*)?(?P<rest>.+)",
         s, re.IGNORECASE | re.DOTALL,
     )
     if m:
@@ -519,6 +520,22 @@ def _query_statement(s: str, engine, catalog):
 
         import delta_tpu.api as dta
         from delta_tpu.expressions.tree import Literal
+
+        rest = m.group("rest").strip()
+        replace_where = None
+        rw = re.match(r"REPLACE\s+WHERE\s+", rest, re.IGNORECASE)
+        if rw:
+            if not m.group("overwrite"):
+                raise DeltaError("REPLACE WHERE requires INSERT OVERWRITE")
+            pred_str, rest = _split_before_keyword(rest[rw.end():], "VALUES")
+            if rest is None:
+                raise DeltaError("REPLACE WHERE must be followed by VALUES")
+            replace_where = parse_expression(pred_str.strip())
+        vm = re.match(r"VALUES\s+(?P<vals>.+)", rest,
+                      re.IGNORECASE | re.DOTALL)
+        if not vm:
+            raise DeltaError("INSERT requires a VALUES clause")
+        vals_str = vm.group("vals")
 
         table = _table(m, engine, catalog)
         meta = table.latest_snapshot().metadata
@@ -534,7 +551,7 @@ def _query_statement(s: str, engine, catalog):
         else:
             targets = list(fields)
         rows = []
-        for tup in _split_values_tuples(m.group("vals")):
+        for tup in _split_values_tuples(vals_str):
             vals = []
             for item in _split_top_level_commas(tup):
                 expr = parse_expression(item.strip())
@@ -557,10 +574,40 @@ def _query_statement(s: str, engine, catalog):
                         to_arrow_type(fields[n].dataType))
             for i, n in enumerate(targets)
         })
-        return dta.write_table(table.path, data, mode="append",
+        mode = "overwrite" if m.group("overwrite") else "append"
+        return dta.write_table(table.path, data, mode=mode,
+                               replace_where=replace_where,
                                engine=table.engine)
 
     return NotImplemented
+
+
+def _split_before_keyword(s: str, keyword: str):
+    """Split `s` at the first whitespace-delimited `keyword` OUTSIDE
+    single-quoted literals; returns (before, from_keyword) or (s, None)
+    when absent — so a predicate string containing the word is safe."""
+    kw = keyword.lower()
+    in_str = False
+    i, n = 0, len(s)
+    while i < n:
+        ch = s[i]
+        if in_str:
+            if ch == "'":
+                in_str = False
+            i += 1
+            continue
+        if ch == "'":
+            in_str = True
+            i += 1
+            continue
+        if s[i:i + len(kw)].lower() == kw:
+            before_ok = i == 0 or s[i - 1].isspace()
+            after = i + len(kw)
+            after_ok = after >= n or s[after].isspace()
+            if before_ok and after_ok:
+                return s[:i], s[i:]
+        i += 1
+    return s, None
 
 
 def _split_values_tuples(s: str):

@@ -356,3 +356,52 @@ def test_insert_trailing_garbage_raises(tmp_path):
     with pytest.raises(DeltaError):
         sql("INSERT INTO tg VALUES (1), '2'", catalog=cat)
     assert sql("SELECT * FROM tg", catalog=cat).num_rows == 0
+
+
+def test_insert_overwrite_and_replace_where(tmp_path):
+    import os
+
+    from delta_tpu.sql import sql
+
+    p = os.path.join(str(tmp_path), "t")
+    dta.write_table(p, pa.table({"k": pa.array(["a", "b"]),
+                                 "v": pa.array([1, 2], pa.int64())}))
+    sql(f"INSERT OVERWRITE '{p}' VALUES ('c', 3)")
+    out = dta.read_table(p)
+    assert sorted(zip(out.column("k").to_pylist(),
+                      out.column("v").to_pylist())) == [("c", 3)]
+
+    sql(f"INSERT INTO '{p}' VALUES ('a', 1), ('b', 2)")
+    sql(f"INSERT OVERWRITE '{p}' REPLACE WHERE k = 'a' VALUES ('a', 10)")
+    out = dta.read_table(p)
+    assert sorted(zip(out.column("k").to_pylist(),
+                      out.column("v").to_pylist())) == [
+        ("a", 10), ("b", 2), ("c", 3)]
+
+    with pytest.raises(DeltaError):
+        sql(f"INSERT INTO '{p}' REPLACE WHERE k = 'a' VALUES ('a', 1)")
+
+
+def test_insert_replace_where_edge_cases(tmp_path):
+    import os
+
+    from delta_tpu.sql import sql
+
+    p = os.path.join(str(tmp_path), "t")
+    dta.write_table(p, pa.table({"k": pa.array(["old values x", "b"]),
+                                 "v": pa.array([1, 2], pa.int64())}))
+    # the word 'values' inside a string literal must not split the parse
+    sql(f"INSERT OVERWRITE '{p}' REPLACE WHERE k = 'old values x' "
+        "VALUES ('old values x', 9)")
+    out = dta.read_table(p)
+    assert sorted(out.column("v").to_pylist()) == [2, 9]
+
+    # unknown predicate column -> clean DeltaError, not KeyError
+    with pytest.raises(DeltaError):
+        sql(f"INSERT OVERWRITE '{p}' REPLACE WHERE zz = 'a' VALUES ('a', 1)")
+
+    # predicate on a column outside the INSERT column list: the missing
+    # column reads as NULL, which never matches -> clean violation
+    from delta_tpu.errors import InvariantViolationError
+    with pytest.raises(InvariantViolationError):
+        sql(f"INSERT OVERWRITE '{p}' (k) REPLACE WHERE v = 1 VALUES ('a')")
