@@ -25,7 +25,8 @@ Catalog statements (require `catalog=`):
     SHOW TABLES
 
 Query/DML (paths or names):
-    SELECT <cols|*> FROM <t> [WHERE <pred>] [LIMIT n]
+    SELECT <cols|*> FROM <t> [VERSION AS OF n | TIMESTAMP AS OF <ms|'iso'>]
+        [WHERE <pred>] [LIMIT n]
     INSERT INTO <t> [(cols)] VALUES (v1, v2, ...)[, (...)]
     INSERT OVERWRITE <t> [(cols)] [REPLACE WHERE <pred>] VALUES (...)
     DELETE FROM <t> [WHERE <pred>]
@@ -170,13 +171,9 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
     if m:
         from delta_tpu.commands.restore import restore
 
-        if m.group("ms"):
-            ts = int(m.group("ms"))
-        else:
-            import datetime as dt
-
-            ts = int(dt.datetime.fromisoformat(m.group("iso")).timestamp() * 1000)
-        return restore(_table(m, engine, catalog), timestamp_ms=ts)
+        raw = m.group("ms") or f"'{m.group('iso')}'"
+        return restore(_table(m, engine, catalog),
+                       timestamp_ms=_timestamp_ms(raw))
 
     m = re.fullmatch(
         rf"CONVERT\s+TO\s+DELTA\s+parquet\.{_QUOTED_PATH}"
@@ -477,12 +474,20 @@ def _catalog_statement(s: str, engine, catalog):
 def _query_statement(s: str, engine, catalog):
     m = re.fullmatch(
         rf"SELECT\s+(?P<cols>.+?)\s+FROM\s+{_PATH}"
+        r"(?:\s+VERSION\s+AS\s+OF\s+(?P<tt_version>\d+)"
+        r"|\s+TIMESTAMP\s+AS\s+OF\s+(?P<tt_ts>\d+|'[^']+'))?"
         r"(?:\s+WHERE\s+(?P<where>.+?))?(?:\s+LIMIT\s+(?P<limit>\d+))?",
         s, re.IGNORECASE | re.DOTALL,
     )
     if m:
         table = _table(m, engine, catalog)
-        snap = table.latest_snapshot()
+        if m.group("tt_version") is not None:
+            snap = table.snapshot_at(int(m.group("tt_version")))
+        elif m.group("tt_ts") is not None:
+            snap = table.snapshot_as_of_timestamp(
+                _timestamp_ms(m.group("tt_ts")))
+        else:
+            snap = table.latest_snapshot()
         known = ({f.name for f in snap.schema.fields}
                  if snap.schema is not None else set())
         cols_text = m.group("cols").strip()
@@ -580,6 +585,20 @@ def _query_statement(s: str, engine, catalog):
                                engine=table.engine)
 
     return NotImplemented
+
+
+def _timestamp_ms(raw: str) -> int:
+    """`<ms>` or `'<iso>'` → epoch millis; malformed input raises
+    DeltaError like every other bad-SQL path."""
+    if raw.startswith("'"):
+        import datetime as dt
+
+        try:
+            return int(dt.datetime.fromisoformat(
+                raw.strip("'")).timestamp() * 1000)
+        except ValueError as e:
+            raise DeltaError(f"cannot parse timestamp {raw}: {e}") from None
+    return int(raw)
 
 
 def _split_before_keyword(s: str, keyword: str):

@@ -405,3 +405,40 @@ def test_insert_replace_where_edge_cases(tmp_path):
     from delta_tpu.errors import InvariantViolationError
     with pytest.raises(InvariantViolationError):
         sql(f"INSERT OVERWRITE '{p}' (k) REPLACE WHERE v = 1 VALUES ('a')")
+
+
+def test_select_time_travel(tmp_path):
+    import os
+    import time as _time
+
+    from delta_tpu.sql import sql
+
+    p = os.path.join(str(tmp_path), "t")
+    dta.write_table(p, pa.table({"v": pa.array([1], pa.int64())}))
+    _time.sleep(0.05)
+    mid_ms = int(_time.time() * 1000)
+    _time.sleep(0.05)
+    dta.write_table(p, pa.table({"v": pa.array([2], pa.int64())}),
+                    mode="append")
+    assert sql(f"SELECT * FROM '{p}'").num_rows == 2
+    assert sql(f"SELECT * FROM '{p}' VERSION AS OF 0").num_rows == 1
+    out = sql(f"SELECT v FROM '{p}' VERSION AS OF 0 WHERE v = 1")
+    assert out.column("v").to_pylist() == [1]
+    # a timestamp between the two commits resolves to version 0; a
+    # far-future timestamp errors (same contract as the reference)
+    assert sql(f"SELECT * FROM '{p}' TIMESTAMP AS OF {mid_ms}").num_rows == 1
+    from delta_tpu.errors import DeltaError
+    with pytest.raises(DeltaError):
+        sql(f"SELECT * FROM '{p}' TIMESTAMP AS OF "
+            f"{int(_time.time() * 1000) + 60_000}")
+
+
+def test_timestamp_parse_errors_cleanly(tmp_path):
+    import os
+
+    from delta_tpu.sql import sql
+
+    p = os.path.join(str(tmp_path), "t")
+    dta.write_table(p, pa.table({"v": pa.array([1], pa.int64())}))
+    with pytest.raises(DeltaError, match="cannot parse timestamp"):
+        sql(f"SELECT * FROM '{p}' TIMESTAMP AS OF '01/02/2024'")
