@@ -53,7 +53,16 @@ class HostParquetHandler(ParquetHandler):
     ) -> Iterator[pa.Table]:
         for p in paths:
             data = self._store_for(p).read(p)
-            yield pq.read_table(pa.BufferReader(data), columns=columns)
+            cols = columns
+            if cols is not None:
+                # project onto the columns the file actually has — a
+                # checkpoint from another engine may omit e.g. txn or
+                # domainMetadata, and erroring would force callers into
+                # read-twice fallbacks
+                present = set(
+                    pq.read_schema(pa.BufferReader(data)).names)
+                cols = [c for c in cols if c in present] or None
+            yield pq.read_table(pa.BufferReader(data), columns=cols)
 
     def write_parquet_file(self, path: str, table: pa.Table) -> FileStatus:
         sink = pa.BufferOutputStream()

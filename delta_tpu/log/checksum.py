@@ -83,6 +83,7 @@ def read_checksum(fs, log_path: str, version: int) -> Optional[VersionChecksum]:
 
 
 def write_checksum_from_state(engine, log_path: str, state) -> None:
+    ci = state.commit_infos.get(state.version)
     crc = VersionChecksum(
         tableSizeBytes=state.size_in_bytes,
         numFiles=state.num_files,
@@ -90,6 +91,7 @@ def write_checksum_from_state(engine, log_path: str, state) -> None:
         numProtocol=1,
         metadata=state.metadata,
         protocol=state.protocol,
+        inCommitTimestamp=(ci.inCommitTimestamp if ci is not None else None),
     )
     engine.json.write_json_file_atomically(
         filenames.checksum_file(log_path, state.version),
@@ -135,6 +137,7 @@ def write_checksum_for_commit(table, txn, version: int) -> None:
         metadata=meta,
         protocol=proto,
         txnId=txn.txn_id,
+        inCommitTimestamp=getattr(txn, "_committed_ict", None),
     )
     engine.json.write_json_file_atomically(
         filenames.checksum_file(log_path, version), crc.to_json().encode(), overwrite=True

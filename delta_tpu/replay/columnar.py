@@ -524,10 +524,14 @@ def parse_commit_batch(
     return table, versions, orders, total
 
 
+SMALL_ACTION_COLUMNS = ("protocol", "metaData", "txn", "domainMetadata")
+
+
 def columnarize_log_segment(
     engine,
     segment,
     table_root: Optional[str] = None,
+    small_only: bool = False,
 ) -> ColumnarActions:
     """Read every file in the segment and produce a ColumnarActions.
 
@@ -535,6 +539,14 @@ def columnarize_log_segment(
     version), then compacted deltas, then commits ascending — but order
     only matters through the (version, order) tags; the device sort makes
     global order irrelevant.
+
+    `small_only`: resolve only the small actions (protocol / metaData /
+    txn / domainMetadata / commitInfo) — checkpoint parquet is read with
+    column projection (the add/remove columns, i.e. ~all of a large
+    checkpoint's bytes, are never decoded), sidecars are skipped (file
+    actions only), and no file-action blocks are built. This is the
+    reference's P&M fast path (`Snapshot.scala:440`,
+    `LogReplay.loadTableProtocolAndMetadata`).
     """
     tracker = _SmallActionTracker()
     blocks: List[pa.Table] = []
@@ -548,6 +560,8 @@ def columnarize_log_segment(
         # order is irrelevant within a checkpoint (keys are unique)
         orders = np.arange(n, dtype=np.int32)
         tracker.scan_chunk(tbl, versions, orders)
+        if small_only:
+            return  # sidecars carry only file actions — nothing to do
         for col in ("add", "remove"):
             block = _extract_file_actions(tbl, col, versions, orders)
             if block is not None:
@@ -565,6 +579,18 @@ def columnarize_log_segment(
                 for sub in engine.parquet.read_parquet_files(sidecar_paths):
                     _consume_checkpoint_table(sub)
 
+    def _read_checkpoint_part(path: str):
+        if not small_only:
+            yield from engine.parquet.read_parquet_files([path])
+            return
+        try:
+            yield from engine.parquet.read_parquet_files(
+                [path], columns=list(SMALL_ACTION_COLUMNS))
+        except Exception:
+            # part lacks some small column (e.g. a multipart tail part
+            # written by another engine): fall back to a full read
+            yield from engine.parquet.read_parquet_files([path])
+
     # --- checkpoint parts (columnar already) ---
     cp_version = segment.checkpoint_version
     for fstat in segment.checkpoints:
@@ -573,7 +599,7 @@ def columnarize_log_segment(
             tbl = pa_json.read_json(pa.BufferReader(engine.fs.read_file(fstat.path)))
             _consume_checkpoint_table(tbl)
         else:
-            for tbl in engine.parquet.read_parquet_files([fstat.path]):
+            for tbl in _read_checkpoint_part(fstat.path):
                 _consume_checkpoint_table(tbl)
         bytes_parsed += fstat.size
 
@@ -603,12 +629,13 @@ def columnarize_log_segment(
             if _native.available(allow_compile):
                 from delta_tpu.replay.native_parse import parse_commits_native
 
-                parsed_native = parse_commits_native(buf, starts, version_arr)
+                parsed_native = parse_commits_native(
+                    buf, starts, version_arr, small_only=small_only)
             if parsed_native is None:
                 generic = _parse_buffer_generic(buf, starts, version_arr)
         if parsed_native is not None:
             block, others = parsed_native
-            if block.num_rows:
+            if block.num_rows and not small_only:
                 blocks.append(block)
             tracker.scan_pylist(others)
             bytes_parsed += int(read[1][-1])
@@ -621,10 +648,12 @@ def columnarize_log_segment(
             bytes_parsed += nbytes
             if tbl is not None:
                 tracker.scan_chunk(tbl, versions, orders)
-                for col in ("add", "remove"):
-                    block = _extract_file_actions(tbl, col, versions, orders)
-                    if block is not None:
-                        blocks.append(block)
+                if not small_only:
+                    for col in ("add", "remove"):
+                        block = _extract_file_actions(tbl, col, versions,
+                                                      orders)
+                        if block is not None:
+                            blocks.append(block)
 
     if blocks:
         file_actions = pa.concat_tables(blocks)

@@ -124,12 +124,15 @@ def parse_commits_native(
     buf,
     file_starts: np.ndarray,
     file_versions: np.ndarray,
+    small_only: bool = False,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]]]]:
     """Native fast path over one concatenated commit buffer.
 
     Returns (canonical file-actions table, [(version, order, action-dict)
     for non-file actions]) or None when the native scanner is
-    unavailable/fails (caller uses the generic Arrow parser)."""
+    unavailable/fails (caller uses the generic Arrow parser).
+    `small_only` skips materializing the file-action table (the P&M fast
+    path throws it away)."""
     from delta_tpu import native
 
     scan = native.scan_actions(buf)
@@ -137,11 +140,16 @@ def parse_commits_native(
         return None
     line_versions, line_orders = line_tags(
         scan.line_starts, file_starts, file_versions)
-    table = build_canonical_table(
-        scan,
-        line_versions[scan.line_no] if scan.n_rows else np.empty(0, np.int64),
-        line_orders[scan.line_no] if scan.n_rows else np.empty(0, np.int32),
-    )
+    if small_only:
+        from delta_tpu.replay.columnar import CANONICAL_FILE_ACTION_SCHEMA
+
+        table = CANONICAL_FILE_ACTION_SCHEMA.empty_table()
+    else:
+        table = build_canonical_table(
+            scan,
+            line_versions[scan.line_no] if scan.n_rows else np.empty(0, np.int64),
+            line_orders[scan.line_no] if scan.n_rows else np.empty(0, np.int32),
+        )
     others: List[Tuple[int, int, dict]] = []
     mv = memoryview(buf)
     for ln, s, e in zip(scan.other_line_no.tolist(),

@@ -267,6 +267,50 @@ def check_read_supported(protocol: Protocol) -> None:
         raise UnsupportedTableFeatureError(unsupported, read=True)
 
 
+@dataclass
+class SmallState:
+    """Protocol/metadata/txn/domain/commitInfo resolution WITHOUT the
+    file-level replay — checkpoint parquet is read with column
+    projection so none of the add/remove bytes are decoded. The
+    reference's P&M fast path (`Snapshot.scala:440`); serves
+    metadata-only operations (schema reads, config lookups, blind-append
+    transaction setup) on large tables in milliseconds."""
+
+    version: int
+    protocol: Protocol
+    metadata: Metadata
+    set_transactions: Dict[str, SetTransaction]
+    domain_metadata: Dict[str, DomainMetadata]
+    latest_commit_info: Optional[CommitInfo] = None
+    commit_infos: Dict[int, CommitInfo] = field(default_factory=dict)
+    timestamp_ms: int = 0
+
+
+def reconstruct_small_state(engine, segment,
+                            check_protocol: bool = True) -> SmallState:
+    """Small-action-only reconstruction (see SmallState)."""
+    columnar = columnarize_log_segment(engine, segment, small_only=True)
+    if columnar.protocol is None or columnar.metadata is None:
+        from delta_tpu.errors import DeltaError
+
+        raise DeltaError(
+            f"log segment for version {segment.version} has no "
+            f"{'protocol' if columnar.protocol is None else 'metadata'} action"
+        )
+    if check_protocol:
+        check_read_supported(columnar.protocol)
+    return SmallState(
+        version=segment.version,
+        protocol=columnar.protocol,
+        metadata=columnar.metadata,
+        set_transactions=columnar.set_transactions,
+        domain_metadata=columnar.domain_metadata,
+        latest_commit_info=columnar.latest_commit_info,
+        commit_infos=columnar.commit_infos,
+        timestamp_ms=segment.last_commit_timestamp,
+    )
+
+
 def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotState:
     """Full state reconstruction for a log segment."""
     from delta_tpu.metrics import SnapshotMetrics

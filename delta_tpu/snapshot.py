@@ -12,7 +12,12 @@ from typing import Dict, Optional
 
 from delta_tpu.log.segment import LogSegment
 from delta_tpu.models.actions import DomainMetadata, Metadata, Protocol, SetTransaction
-from delta_tpu.replay.state import SnapshotState, reconstruct_state
+from delta_tpu.replay.state import (
+    SmallState,
+    SnapshotState,
+    reconstruct_small_state,
+    reconstruct_state,
+)
 
 
 class Snapshot:
@@ -21,6 +26,8 @@ class Snapshot:
         self._segment = segment
         self._engine = engine if engine is not None else table.engine
         self._state: Optional[SnapshotState] = None
+        self._small: Optional[SmallState] = None
+        self._pm: Optional[SmallState] = None  # crc-derived P&M only
 
     @property
     def version(self) -> int:
@@ -41,29 +48,89 @@ class Snapshot:
         return self._state
 
     @property
+    def _small_state(self):
+        """Small actions WITHOUT the file replay (P&M fast path,
+        `Snapshot.scala:440`): metadata-only consumers on a large table
+        never pay for decoding the checkpoint's add/remove columns. The
+        full state, once materialized, serves as the small state too."""
+        if self._state is not None:
+            return self._state
+        if self._small is None:
+            self._small = reconstruct_small_state(self._engine, self._segment)
+        return self._small
+
+    @property
+    def _pm_state(self):
+        """Cheapest protocol/metadata source: full state if present,
+        else an already-parsed small state, else this version's `.crc`
+        checksum (one tiny read — the reference ChecksumReader path,
+        `LogReplay.java:384-426`), else the small-action parse. Only
+        protocol/metadata/timestamp come from a crc-derived view — txn
+        and domain accessors always use the real small state."""
+        if self._state is not None:
+            return self._state
+        if self._small is not None:
+            return self._small
+        if self._pm is None:
+            from delta_tpu.log.checksum import read_checksum
+
+            try:
+                crc = read_checksum(self._engine.fs, self._table.log_path,
+                                    self.version)
+            except Exception:
+                crc = None
+            if crc is not None:
+                from delta_tpu.config import IN_COMMIT_TIMESTAMPS, get_table_config
+                from delta_tpu.replay.state import check_read_supported
+
+                if (get_table_config(crc.metadata.configuration,
+                                     IN_COMMIT_TIMESTAMPS)
+                        and crc.inCommitTimestamp is None):
+                    # an older crc without the ICT can't serve
+                    # timestamp_ms on an ICT table (monotonicity feeds
+                    # the next commit's ICT): use the real small parse
+                    return self._small_state
+                check_read_supported(crc.protocol)
+                ts = self._segment.last_commit_timestamp
+                if crc.inCommitTimestamp is not None:
+                    ts = crc.inCommitTimestamp
+                self._pm = SmallState(
+                    version=self.version,
+                    protocol=crc.protocol,
+                    metadata=crc.metadata,
+                    set_transactions={},
+                    domain_metadata={},
+                    timestamp_ms=ts,
+                )
+            else:
+                return self._small_state
+        return self._pm
+
+    @property
     def protocol(self) -> Protocol:
-        return self.state.protocol
+        return self._pm_state.protocol
 
     @property
     def metadata(self) -> Metadata:
-        return self.state.metadata
+        return self._pm_state.metadata
 
     @property
     def schema(self):
-        return self.state.metadata.schema
+        return self._pm_state.metadata.schema
 
     @property
     def partition_columns(self) -> list:
-        return list(self.state.metadata.partitionColumns)
+        return list(self._pm_state.metadata.partitionColumns)
 
     @property
     def timestamp_ms(self) -> int:
         """Commit timestamp of this version: in-commit timestamp when the
         feature is enabled, else file modification time."""
-        ci = self.state.commit_infos.get(self.version)
+        pm = self._pm_state
+        ci = pm.commit_infos.get(self.version)
         if ci is not None and ci.inCommitTimestamp is not None:
             return ci.inCommitTimestamp
-        return self.state.timestamp_ms
+        return pm.timestamp_ms
 
     @property
     def num_files(self) -> int:
@@ -74,14 +141,14 @@ class Snapshot:
         return self.state.size_in_bytes
 
     def set_transaction_version(self, app_id: str) -> Optional[int]:
-        txn = self.state.set_transactions.get(app_id)
+        txn = self._small_state.set_transactions.get(app_id)
         return txn.version if txn else None
 
     def set_transactions(self) -> Dict[str, SetTransaction]:
-        return dict(self.state.set_transactions)
+        return dict(self._small_state.set_transactions)
 
     def domain_metadata(self, domain: str) -> Optional[DomainMetadata]:
-        dm = self.state.domain_metadata.get(domain)
+        dm = self._small_state.domain_metadata.get(domain)
         if dm is None or dm.removed:
             return None
         return dm
@@ -100,13 +167,13 @@ class Snapshot:
         return b.build()
 
     def table_configuration(self) -> Dict[str, str]:
-        return dict(self.state.metadata.configuration)
+        return dict(self._pm_state.metadata.configuration)
 
     def get_config(self, key: str, default=None):
         from delta_tpu.config import TABLE_CONFIGS
 
         cfg = TABLE_CONFIGS.get(key)
-        raw = self.state.metadata.configuration.get(key)
+        raw = self._pm_state.metadata.configuration.get(key)
         if cfg is not None:
             return cfg.parse(raw) if raw is not None else (
                 cfg.default if default is None else default

@@ -430,6 +430,7 @@ class Transaction:
                 self._adds, hw, attempt_version
             )
 
+        self._committed_ict = ict  # consumed by the incremental .crc writer
         commit_info = CommitInfo(
             timestamp=now,
             inCommitTimestamp=ict,

@@ -54,3 +54,35 @@ def test_engine_call_efficiency(tmp_table_path):
     commit_reads = [p for p in reads if p.endswith(".json") and "_delta_log" in p]
     # 5 commits, each parsed once
     assert len([p for p in commit_reads if not p.endswith("_last_checkpoint")]) == 5
+
+
+def test_metadata_access_skips_file_replay(tmp_table_path, monkeypatch):
+    """P&M / txn / domain accessors must never trigger the full
+    file-level state reconstruction (`Snapshot.scala:440` fast path)."""
+    import numpy as np
+    import pyarrow as pa
+
+    import delta_tpu.api as dta
+    import delta_tpu.snapshot as snapshot_mod
+    from delta_tpu.streaming import DeltaSink
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"x": pa.array(np.arange(10, dtype=np.int64))}))
+    DeltaSink(tmp_table_path, query_id="q").add_batch(
+        0, pa.table({"x": pa.array([1], pa.int64())}))
+    Table.for_path(tmp_table_path).checkpoint()
+
+    def boom(*a, **k):
+        raise AssertionError("full state reconstruction was triggered")
+
+    monkeypatch.setattr(snapshot_mod, "reconstruct_state", boom)
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert snap.metadata.schema is not None
+    assert snap.protocol.minReaderVersion >= 1
+    assert snap.partition_columns == []
+    assert snap.set_transaction_version("q") == 0
+    assert snap.table_configuration() is not None
+    monkeypatch.undo()
+    # and the full state still works afterwards
+    assert Table.for_path(tmp_table_path).latest_snapshot().num_files >= 1
