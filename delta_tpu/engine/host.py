@@ -53,16 +53,19 @@ class HostParquetHandler(ParquetHandler):
     ) -> Iterator[pa.Table]:
         for p in paths:
             data = self._store_for(p).read(p)
-            cols = columns
-            if cols is not None:
-                # project onto the columns the file actually has — a
-                # checkpoint from another engine may omit e.g. txn or
-                # domainMetadata, and erroring would force callers into
-                # read-twice fallbacks
-                present = set(
-                    pq.read_schema(pa.BufferReader(data)).names)
-                cols = [c for c in cols if c in present] or None
-            yield pq.read_table(pa.BufferReader(data), columns=cols)
+            if columns is None:
+                yield pq.read_table(pa.BufferReader(data))
+                continue
+            # one footer parse serves both the schema check and the
+            # read. Project onto the columns the file actually has — a
+            # checkpoint from another engine may omit e.g. txn or
+            # domainMetadata, and erroring would force callers into
+            # read-twice fallbacks. An empty intersection stays an empty
+            # projection (0 columns, correct row count) — never a
+            # decode-everything full read.
+            f = pq.ParquetFile(pa.BufferReader(data))
+            present = set(f.schema_arrow.names)
+            yield f.read(columns=[c for c in columns if c in present])
 
     def write_parquet_file(self, path: str, table: pa.Table) -> FileStatus:
         sink = pa.BufferOutputStream()

@@ -39,7 +39,9 @@ def _feature(name, min_reader, min_writer, reader_writer, activated_by=None, leg
 
 
 def _conf_true(key):
-    return lambda m: m.configuration.get(key, "").lower() == "true"
+    from delta_tpu.config import _parse_bool
+
+    return lambda m: _parse_bool(m.configuration.get(key, ""))
 
 
 APPEND_ONLY = _feature("appendOnly", 1, 2, False, _conf_true("delta.appendOnly"), legacy=True)
@@ -101,8 +103,10 @@ V2_CHECKPOINT = _feature(
     "v2Checkpoint", 3, 7, True,
     lambda m: m.configuration.get("delta.checkpointPolicy", "classic") == "v2",
 )
-ICEBERG_COMPAT_V1 = _feature("icebergCompatV1", 1, 7, False)
-ICEBERG_COMPAT_V2 = _feature("icebergCompatV2", 1, 7, False)
+ICEBERG_COMPAT_V1 = _feature("icebergCompatV1", 1, 7, False,
+                              _conf_true("delta.enableIcebergCompatV1"))
+ICEBERG_COMPAT_V2 = _feature("icebergCompatV2", 1, 7, False,
+                             _conf_true("delta.enableIcebergCompatV2"))
 IN_COMMIT_TIMESTAMP = _feature(
     "inCommitTimestamp", 1, 7, False, _conf_true("delta.enableInCommitTimestamps")
 )

@@ -1,0 +1,102 @@
+"""IcebergCompat v1/v2 commit-time validation.
+
+Reference `IcebergCompat.scala:42-70`: when
+`delta.enableIcebergCompatV1` / `V2` is set, every commit must satisfy
+the compat invariants so the UniForm Iceberg conversion can mirror the
+table: single compat version, column mapping on, stats on every added
+file, no deletion vectors, and (V2) field types restricted to Iceberg's
+allow-list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.models.schema import ArrayType, MapType, PrimitiveType, StructType
+
+ICEBERG_COMPAT_V1_KEY = "delta.enableIcebergCompatV1"
+ICEBERG_COMPAT_V2_KEY = "delta.enableIcebergCompatV2"
+
+# Iceberg's primitive type space (CheckTypeInV2AllowList)
+_V2_ALLOWED_PRIMITIVES = {
+    "byte", "short", "integer", "long", "float", "double", "boolean",
+    "string", "binary", "date", "timestamp", "timestamp_ntz",
+}
+
+
+def _is_true(configuration, key) -> bool:
+    from delta_tpu.config import _parse_bool
+
+    return _parse_bool((configuration or {}).get(key, ""))
+
+
+def enabled_version(configuration) -> Optional[int]:
+    v1 = _is_true(configuration, ICEBERG_COMPAT_V1_KEY)
+    v2 = _is_true(configuration, ICEBERG_COMPAT_V2_KEY)
+    if v1 and v2:
+        raise DeltaError(
+            "icebergCompatV1 and icebergCompatV2 are mutually exclusive "
+            "(CheckOnlySingleVersionEnabled)")
+    return 1 if v1 else 2 if v2 else None
+
+
+def _walk_types(dt, path, problems, version: int):
+    if isinstance(dt, StructType):
+        for f in dt.fields:
+            _walk_types(f.dataType, path + [f.name], problems, version)
+        return
+    if isinstance(dt, ArrayType):
+        _walk_types(dt.elementType, path + ["element"], problems, version)
+        return
+    if isinstance(dt, MapType):
+        _walk_types(dt.keyType, path + ["key"], problems, version)
+        _walk_types(dt.valueType, path + ["value"], problems, version)
+        return
+    if isinstance(dt, PrimitiveType):
+        name = dt.name
+        if name == "null":
+            problems.append(f"{'.'.join(path)}: null type")
+        elif version == 2 and not dt.is_decimal and \
+                name not in _V2_ALLOWED_PRIMITIVES:
+            problems.append(f"{'.'.join(path)}: type {name!r} outside the "
+                            "Iceberg V2 allow-list")
+
+
+def validate_iceberg_compat(metadata, protocol,
+                            adds: Sequence = ()) -> None:
+    """Raise when the staged commit violates the enabled compat version;
+    no-op when neither flag is set."""
+    conf = metadata.configuration or {}
+    version = enabled_version(conf)
+    if version is None:
+        return
+    feature = f"icebergCompatV{version}"
+    if feature not in (protocol.writerFeatures or []):
+        raise DeltaError(
+            f"delta.enableIcebergCompatV{version} requires the "
+            f"{feature} writer table feature")
+    mode = conf.get("delta.columnMapping.mode", "none")
+    if mode not in ("name", "id"):
+        raise DeltaError(
+            f"icebergCompatV{version} requires column mapping "
+            f"(delta.columnMapping.mode=name), found {mode!r} "
+            "(RequireColumnMapping)")
+    if _is_true(conf, "delta.enableDeletionVectors"):
+        raise DeltaError(
+            f"icebergCompatV{version} is incompatible with deletion "
+            "vectors (CheckDeletionVectorDisabled)")
+    problems: list = []
+    if metadata.schema is not None:
+        _walk_types(metadata.schema, [], problems, version)
+    if problems:
+        raise DeltaError(
+            f"icebergCompatV{version} schema violations: "
+            + "; ".join(problems))
+    missing_stats = [a.path for a in adds
+                     if getattr(a, "dataChange", True) and not a.stats]
+    if missing_stats:
+        raise DeltaError(
+            f"icebergCompatV{version} requires stats on every added "
+            f"file (CheckAddFileHasStats); missing on "
+            f"{missing_stats[:3]}")

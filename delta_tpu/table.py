@@ -152,9 +152,18 @@ class Table:
     def checkpoint(self, version: Optional[int] = None) -> None:
         """Write a checkpoint for `version` (default: latest)."""
         from delta_tpu.log.checkpointer import write_checkpoint
+        from delta_tpu.log.checksum import write_checksum_from_state
 
         snap = self.latest_snapshot() if version is None else self.snapshot_at(version)
         write_checkpoint(self.engine, snap)
+        # reseed the incremental .crc chain from the full state: a commit
+        # whose checksum couldn't be derived (e.g. removes without sizes)
+        # breaks the chain, and the checkpoint is the natural recovery
+        # point (reference recomputes the checksum from the snapshot too)
+        try:
+            write_checksum_from_state(self.engine, self.log_path, snap.state)
+        except Exception:
+            pass  # the checksum is an accelerator, never a failure cause
 
     def history(self, limit: Optional[int] = None):
         from delta_tpu.history import get_history

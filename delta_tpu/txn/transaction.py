@@ -366,6 +366,10 @@ class Transaction:
 
         validate_writable(self.protocol(), meta)
 
+        from delta_tpu.interop.icebergcompat import validate_iceberg_compat
+
+        validate_iceberg_compat(meta, self.protocol(), adds=self._adds)
+
         from delta_tpu.config import APPEND_ONLY
 
         if get_table_config(meta.configuration, APPEND_ONLY) and any(

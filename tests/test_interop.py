@@ -124,3 +124,50 @@ def test_hudi_conversion(tmp_table_path):
         doc = json.load(f)
     parts = doc["partitionToWriteStats"]
     assert set(parts) == {"p=a", "p=b"}
+
+
+# ------------------------------------------------------- iceberg compat
+
+def test_iceberg_compat_v2_validation(tmp_table_path):
+    import numpy as np
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError
+
+    data = pa.table({"x": pa.array(np.arange(3, dtype=np.int64))})
+    # compat requires column mapping
+    with pytest.raises(DeltaError, match="column mapping"):
+        dta.write_table(tmp_table_path + "_a", data,
+                        properties={"delta.enableIcebergCompatV2": "true"})
+    # with mapping on, the commit passes and the feature is activated
+    dta.write_table(tmp_table_path, data, properties={
+        "delta.enableIcebergCompatV2": "true",
+        "delta.columnMapping.mode": "name"})
+    from delta_tpu.table import Table
+
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert "icebergCompatV2" in (snap.protocol.writerFeatures or [])
+    # DVs cannot be enabled together with compat
+    with pytest.raises(DeltaError, match="deletion"):
+        dta.write_table(tmp_table_path + "_b", data, properties={
+            "delta.enableIcebergCompatV2": "true",
+            "delta.columnMapping.mode": "name",
+            "delta.enableDeletionVectors": "true"})
+
+
+def test_iceberg_compat_versions_mutually_exclusive(tmp_table_path):
+    import numpy as np
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError
+
+    with pytest.raises(DeltaError, match="mutually exclusive"):
+        dta.write_table(
+            tmp_table_path, pa.table({"x": pa.array([1], pa.int64())}),
+            properties={"delta.enableIcebergCompatV1": "true",
+                        "delta.enableIcebergCompatV2": "true",
+                        "delta.columnMapping.mode": "name"})
