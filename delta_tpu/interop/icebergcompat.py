@@ -54,13 +54,10 @@ def _walk_types(dt, path, problems, version: int):
         _walk_types(dt.valueType, path + ["value"], problems, version)
         return
     if isinstance(dt, PrimitiveType):
-        name = dt.name
-        if name == "null":
-            problems.append(f"{'.'.join(path)}: null type")
-        elif version == 2 and not dt.is_decimal and \
-                name not in _V2_ALLOWED_PRIMITIVES:
-            problems.append(f"{'.'.join(path)}: type {name!r} outside the "
-                            "Iceberg V2 allow-list")
+        if version == 2 and not dt.is_decimal and \
+                dt.name not in _V2_ALLOWED_PRIMITIVES:
+            problems.append(f"{'.'.join(path)}: type {dt.name!r} outside "
+                            "the Iceberg V2 allow-list")
 
 
 def validate_iceberg_compat(metadata, protocol,
@@ -82,10 +79,22 @@ def validate_iceberg_compat(metadata, protocol,
             f"icebergCompatV{version} requires column mapping "
             f"(delta.columnMapping.mode=name), found {mode!r} "
             "(RequireColumnMapping)")
-    if _is_true(conf, "delta.enableDeletionVectors"):
+    if (_is_true(conf, "delta.enableDeletionVectors")
+            or "deletionVectors" in (protocol.writerFeatures or [])):
+        # feature presence, not just the config flag: a table that ever
+        # wrote DVs may still carry them in live files — the established
+        # escape path is ALTER TABLE DROP FEATURE deletionVectors (which
+        # purges them) before enabling compat
         raise DeltaError(
             f"icebergCompatV{version} is incompatible with deletion "
-            "vectors (CheckDeletionVectorDisabled)")
+            "vectors (CheckDeletionVectorDisabled); drop the "
+            "deletionVectors feature first")
+    dv_adds = [a.path for a in adds
+               if getattr(a, "deletionVector", None) is not None]
+    if dv_adds:
+        raise DeltaError(
+            f"icebergCompatV{version}: staged add(s) carry deletion "
+            f"vectors ({dv_adds[:3]})")
     problems: list = []
     if metadata.schema is not None:
         _walk_types(metadata.schema, [], problems, version)
@@ -93,8 +102,9 @@ def validate_iceberg_compat(metadata, protocol,
         raise DeltaError(
             f"icebergCompatV{version} schema violations: "
             + "; ".join(problems))
-    missing_stats = [a.path for a in adds
-                     if getattr(a, "dataChange", True) and not a.stats]
+    # every AddFile, including dataChange=false rewrites: the Iceberg
+    # mirror needs numRecords for each data file (CheckAddFileHasStats)
+    missing_stats = [a.path for a in adds if not a.stats]
     if missing_stats:
         raise DeltaError(
             f"icebergCompatV{version} requires stats on every added "
