@@ -593,9 +593,11 @@ def _timestamp_ms(raw: str) -> int:
     if raw.startswith("'"):
         import datetime as dt
 
+        text = raw.strip("'")
+        if text.endswith(("Z", "z")):
+            text = text[:-1] + "+00:00"  # py3.10 fromisoformat lacks Z
         try:
-            return int(dt.datetime.fromisoformat(
-                raw.strip("'")).timestamp() * 1000)
+            return int(dt.datetime.fromisoformat(text).timestamp() * 1000)
         except ValueError as e:
             raise DeltaError(f"cannot parse timestamp {raw}: {e}") from None
     return int(raw)

@@ -1,0 +1,247 @@
+"""`delta.tables`-compatible Python surface.
+
+The reference ships `python/delta/tables.py:37` (`DeltaTable`) as the
+user-facing API: camelCase methods, string SQL predicates, a fluent
+merge builder. This module mirrors that surface 1:1 over the native
+engine so a `delta-spark` user can switch with their code shape intact:
+
+    from delta_tpu.tables import DeltaTable
+    dt = DeltaTable.forPath("/data/events")
+    dt.update(condition="id = 3", set={"v": "'fixed'"})
+    (dt.merge(source_arrow, "target.id = source.id")
+       .whenMatchedUpdateAll()
+       .whenNotMatchedInsertAll()
+       .execute())
+
+DataFrames are Arrow tables here (`toDF()` returns `pyarrow.Table`);
+conditions and set-expressions accept either SQL strings (parsed by the
+expression parser) or `delta_tpu.expressions` trees.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.expressions.parser import parse_expression
+from delta_tpu.expressions.tree import Expression
+from delta_tpu.table import Table
+
+ExprOrStr = Union[str, Expression, None]
+
+
+def _expr(e: ExprOrStr):
+    if e is None or isinstance(e, Expression):
+        return e
+    return parse_expression(e)
+
+
+def _exprs(d: Optional[Dict[str, object]]):
+    if d is None:
+        return None
+    return {k: (_expr(v) if isinstance(v, str) else v)
+            for k, v in d.items()}
+
+
+class DeltaTable:
+    """Mirror of the reference `DeltaTable` (python/delta/tables.py:37)."""
+
+    def __init__(self, table: Table):
+        self._table = table
+
+    # -- constructors --------------------------------------------------
+    @classmethod
+    def forPath(cls, path: str, engine=None) -> "DeltaTable":
+        t = Table.for_path(path, engine)
+        if not t.exists():
+            raise DeltaError(f"{path} is not a Delta table")
+        return cls(t)
+
+    @classmethod
+    def forName(cls, name: str, catalog=None) -> "DeltaTable":
+        if catalog is None:
+            raise DeltaError("forName requires a catalog")
+        return cls(catalog.table(name))
+
+    @classmethod
+    def isDeltaTable(cls, path: str) -> bool:
+        return Table.for_path(path).exists()
+
+    @classmethod
+    def convertToDelta(cls, path: str, partitionSchema=None,
+                       engine=None) -> "DeltaTable":
+        from delta_tpu.commands.restore import convert_to_delta
+
+        convert_to_delta(path, partition_schema=partitionSchema,
+                         engine=engine)
+        return cls.forPath(path, engine)
+
+    # -- reads ---------------------------------------------------------
+    def toDF(self) -> pa.Table:
+        return self._table.latest_snapshot().scan().to_arrow()
+
+    def history(self, limit: Optional[int] = None):
+        return [h.to_dict() for h in self._table.history(limit)]
+
+    def detail(self) -> dict:
+        from delta_tpu.sql import describe_detail
+
+        return describe_detail(self._table)
+
+    # -- DML -----------------------------------------------------------
+    def delete(self, condition: ExprOrStr = None):
+        from delta_tpu.commands.dml import delete
+
+        return delete(self._table, predicate=_expr(condition))
+
+    def update(self, condition: ExprOrStr = None,
+               set: Optional[Dict[str, object]] = None):
+        if not set:
+            raise DeltaError("update requires a set mapping")
+        from delta_tpu.commands.dml import update
+
+        return update(self._table, _exprs(set), predicate=_expr(condition))
+
+    def merge(self, source: pa.Table, condition: ExprOrStr
+              ) -> "DeltaMergeBuilder":
+        from delta_tpu.commands.merge import merge
+
+        return DeltaMergeBuilder(merge(self._table, source,
+                                       on=_expr(condition)))
+
+    # -- maintenance ---------------------------------------------------
+    def vacuum(self, retentionHours: Optional[float] = None,
+               dryRun: bool = False):
+        return self._table.vacuum(retention_hours=retentionHours,
+                                  dry_run=dryRun)
+
+    def optimize(self) -> "DeltaOptimizeBuilder":
+        return DeltaOptimizeBuilder(self._table.optimize())
+
+    def generate(self, mode: str) -> None:
+        if mode != "symlink_format_manifest":
+            raise DeltaError(f"unsupported generate mode {mode!r}")
+        from delta_tpu.commands.generate import generate_symlink_manifest
+
+        generate_symlink_manifest(self._table)
+
+    # -- history management --------------------------------------------
+    def restoreToVersion(self, version: int):
+        from delta_tpu.commands.restore import restore
+
+        return restore(self._table, version=version)
+
+    def restoreToTimestamp(self, timestamp) -> None:
+        from delta_tpu.commands.restore import restore
+        from delta_tpu.sql import _timestamp_ms
+
+        ts = (_timestamp_ms(f"'{timestamp}'") if isinstance(timestamp, str)
+              else int(timestamp))
+        return restore(self._table, timestamp_ms=ts)
+
+    # -- protocol ------------------------------------------------------
+    def upgradeTableProtocol(self, readerVersion: int,
+                             writerVersion: int) -> None:
+        from delta_tpu.commands.alter import upgrade_protocol
+
+        upgrade_protocol(self._table, min_reader=readerVersion,
+                         min_writer=writerVersion)
+
+    def addFeatureSupport(self, featureName: str) -> None:
+        from delta_tpu.commands.alter import upgrade_protocol
+
+        upgrade_protocol(self._table, feature=featureName)
+
+    def dropFeatureSupport(self, featureName: str,
+                           truncateHistory: Optional[bool] = None) -> None:
+        from delta_tpu.commands.dropfeature import drop_feature
+
+        drop_feature(self._table, featureName,
+                     truncate_history=bool(truncateHistory))
+
+    # escape hatch to the native surface
+    @property
+    def table(self) -> Table:
+        return self._table
+
+
+class DeltaOptimizeBuilder:
+    """camelCase facade over the native OPTIMIZE builder (reference
+    python/delta/tables.py:1459)."""
+
+    def __init__(self, builder):
+        self._b = builder
+
+    def where(self, partitionFilter: ExprOrStr) -> "DeltaOptimizeBuilder":
+        self._b = self._b.where(_expr(partitionFilter))
+        return self
+
+    def executeCompaction(self):
+        return self._b.execute_compaction()
+
+    def executeZOrderBy(self, *cols: str):
+        return self._b.execute_zorder_by(*cols)
+
+
+class DeltaMergeBuilder:
+    """camelCase facade over the native merge builder, mirroring the
+    reference's clause set (python/delta/tables.py:757)."""
+
+    def __init__(self, builder):
+        self._b = builder
+
+    def whenMatchedUpdate(self, condition: ExprOrStr = None,
+                          set: Optional[Dict[str, object]] = None
+                          ) -> "DeltaMergeBuilder":
+        if not set:
+            raise DeltaError("whenMatchedUpdate requires a set mapping")
+        self._b = self._b.when_matched_update(set=_exprs(set),
+                                              condition=_expr(condition))
+        return self
+
+    def whenMatchedUpdateAll(self, condition: ExprOrStr = None
+                             ) -> "DeltaMergeBuilder":
+        self._b = self._b.when_matched_update_all(condition=_expr(condition))
+        return self
+
+    def whenMatchedDelete(self, condition: ExprOrStr = None
+                          ) -> "DeltaMergeBuilder":
+        self._b = self._b.when_matched_delete(condition=_expr(condition))
+        return self
+
+    def whenNotMatchedInsert(self, condition: ExprOrStr = None,
+                             values: Optional[Dict[str, object]] = None
+                             ) -> "DeltaMergeBuilder":
+        if not values:
+            raise DeltaError("whenNotMatchedInsert requires values")
+        self._b = self._b.when_not_matched_insert(
+            values=_exprs(values), condition=_expr(condition))
+        return self
+
+    def whenNotMatchedInsertAll(self, condition: ExprOrStr = None
+                                ) -> "DeltaMergeBuilder":
+        self._b = self._b.when_not_matched_insert_all(
+            condition=_expr(condition))
+        return self
+
+    def whenNotMatchedBySourceUpdate(
+        self, condition: ExprOrStr = None,
+        set: Optional[Dict[str, object]] = None,
+    ) -> "DeltaMergeBuilder":
+        if not set:
+            raise DeltaError(
+                "whenNotMatchedBySourceUpdate requires a set mapping")
+        self._b = self._b.when_not_matched_by_source_update(
+            set=_exprs(set), condition=_expr(condition))
+        return self
+
+    def whenNotMatchedBySourceDelete(self, condition: ExprOrStr = None
+                                     ) -> "DeltaMergeBuilder":
+        self._b = self._b.when_not_matched_by_source_delete(
+            condition=_expr(condition))
+        return self
+
+    def execute(self):
+        return self._b.execute()

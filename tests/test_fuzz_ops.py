@@ -1,8 +1,9 @@
 """Differential operation fuzz: a random sequence of table operations
-(append / delete / update / optimize / checkpoint / restore / vacuum)
-executed once, then the resulting `_delta_log` replayed independently by
-BOTH engines — states must agree bit-for-bit, and reads must match a
-Python-dict model of the table contents.
+(append / delete / update / optimize / checkpoint) executed once, then
+the resulting `_delta_log` replayed independently by BOTH engines —
+states must agree bit-for-bit, and reads must match a Python-dict model
+of the table contents. A deterministic time-travel check and a restore
+run once at the end of each sequence.
 
 This is the end-to-end analogue of the replay-kernel fuzz: it exercises
 commit writing, checkpoints mid-history, DV deletes, CDC writes, and

@@ -1,0 +1,90 @@
+"""The delta.tables-compatible surface (reference python/delta/tables.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.errors import DeltaError
+from delta_tpu.tables import DeltaTable
+
+
+def _data(start, n):
+    return pa.table({
+        "id": pa.array(np.arange(start, start + n, dtype=np.int64)),
+        "v": pa.array([f"v{i}" for i in range(start, start + n)]),
+    })
+
+
+def test_for_path_to_df_history_detail(tmp_table_path):
+    with pytest.raises(DeltaError):
+        DeltaTable.forPath(tmp_table_path)
+    dta.write_table(tmp_table_path, _data(0, 10))
+    dt = DeltaTable.forPath(tmp_table_path)
+    assert DeltaTable.isDeltaTable(tmp_table_path)
+    assert dt.toDF().num_rows == 10
+    assert dt.history()[0]["version"] == 0
+    assert dt.detail()["numFiles"] == 1
+
+
+def test_string_condition_dml(tmp_table_path):
+    dta.write_table(tmp_table_path, _data(0, 10))
+    dt = DeltaTable.forPath(tmp_table_path)
+    dt.update(condition="id = 3", set={"v": "'patched'"})
+    assert sorted(dt.toDF().filter(
+        pa.compute.equal(pa.compute.field("id"), 3)
+    ).column("v").to_pylist()) == ["patched"]
+    dt.delete("id >= 8")
+    assert dt.toDF().num_rows == 8
+    dt.delete()  # no condition: everything
+    assert dt.toDF().num_rows == 0
+
+
+def test_merge_builder_camel_case(tmp_table_path):
+    dta.write_table(tmp_table_path, _data(0, 5))
+    dt = DeltaTable.forPath(tmp_table_path)
+    source = pa.table({
+        "id": pa.array([3, 4, 10, 11], pa.int64()),
+        "v": pa.array(["s3", "s4", "s10", "s11"]),
+    })
+    (dt.merge(source, "target.id = source.id")
+       .whenMatchedUpdate(set={"v": "source.v"})
+       .whenNotMatchedInsertAll()
+       .execute())
+    out = dict(zip(dt.toDF().column("id").to_pylist(),
+                   dt.toDF().column("v").to_pylist()))
+    assert out[3] == "s3" and out[10] == "s10" and out[0] == "v0"
+    assert len(out) == 7
+
+
+def test_restore_vacuum_optimize_protocol(tmp_table_path):
+    dta.write_table(tmp_table_path, _data(0, 5))
+    dta.write_table(tmp_table_path, _data(5, 5), mode="append")
+    dt = DeltaTable.forPath(tmp_table_path)
+    dt.optimize().executeCompaction()
+    dt.restoreToVersion(1)
+    assert dt.toDF().num_rows == 10
+    res = dt.vacuum(retentionHours=0, dryRun=True)
+    assert res.dry_run
+    dt.upgradeTableProtocol(1, 4)
+    assert dt.table.latest_snapshot().protocol.minWriterVersion >= 4
+    dt.addFeatureSupport("deletionVectors")
+    assert "deletionVectors" in (
+        dt.table.latest_snapshot().protocol.writerFeatures or [])
+
+
+def test_generate_and_convert(tmp_path):
+    import os
+
+    import pyarrow.parquet as pq
+
+    root = str(tmp_path / "plain")
+    os.makedirs(root)
+    pq.write_table(pa.table({"x": pa.array([1, 2], pa.int64())}),
+                   f"{root}/f.parquet")
+    dt = DeltaTable.convertToDelta(root)
+    assert dt.toDF().num_rows == 2
+    dt.generate("symlink_format_manifest")
+    assert os.path.isdir(os.path.join(root, "_symlink_format_manifest"))
+    with pytest.raises(DeltaError):
+        dt.generate("bogus_mode")
