@@ -48,6 +48,13 @@ class Catalog:
     def _default_location(self, name: str) -> str:
         return f"{self.root}/{name.replace('.', '/')}"
 
+    def default_location(self, name: str) -> str:
+        """Where a table of this name lives (existing registration wins,
+        else the catalog-root convention) — used by DDL builders."""
+        if self.exists(name):
+            return self._location(name)
+        return self._default_location(name)
+
     # -- mutation ----------------------------------------------------------
 
     def create_table(

@@ -64,6 +64,16 @@ _SQL_TYPES = {
 }
 
 
+def normalize_sql_type(t: str) -> str:
+    """SQL type text → delta primitive name: BIGINT→long,
+    VARCHAR(20)→string, DECIMAL(10,2) passes through intact."""
+    type_text = re.sub(r"\s+", "", t.lower())
+    base = type_text.split("(", 1)[0]
+    if base in ("varchar", "char", "text"):
+        return "string"  # length parameter is advisory
+    return _SQL_TYPES.get(type_text, type_text)  # decimal(p,s) etc.
+
+
 import contextvars
 
 # optional callable(path) -> None that raises for disallowed paths; set
@@ -378,12 +388,7 @@ def _parse_column_defs(text: str):
         if not m:
             raise DeltaError(f"cannot parse column definition: {part!r}")
         name = m.group("q") or m.group("name")
-        type_text = re.sub(r"\s+", "", m.group("type").lower())
-        base = type_text.split("(", 1)[0]
-        if base in ("varchar", "char", "text"):
-            typ = "string"  # length parameter is advisory
-        else:
-            typ = _SQL_TYPES.get(type_text, type_text)  # decimal(p,s) etc.
+        typ = normalize_sql_type(m.group("type"))
         nullable = True
         default = None
         rest = m.group("rest").strip()

@@ -161,10 +161,150 @@ class DeltaTable:
         drop_feature(self._table, featureName,
                      truncate_history=bool(truncateHistory))
 
+    # -- DDL builders ---------------------------------------------------
+    @classmethod
+    def create(cls, catalog=None) -> "DeltaTableBuilder":
+        return DeltaTableBuilder("create", catalog)
+
+    @classmethod
+    def createIfNotExists(cls, catalog=None) -> "DeltaTableBuilder":
+        return DeltaTableBuilder("createIfNotExists", catalog)
+
+    @classmethod
+    def replace(cls, catalog=None) -> "DeltaTableBuilder":
+        return DeltaTableBuilder("replace", catalog)
+
+    @classmethod
+    def createOrReplace(cls, catalog=None) -> "DeltaTableBuilder":
+        return DeltaTableBuilder("createOrReplace", catalog)
+
     # escape hatch to the native surface
     @property
     def table(self) -> Table:
         return self._table
+
+
+class DeltaTableBuilder:
+    """DDL builder mirror (reference python/delta/tables.py:1124):
+    `DeltaTable.create().location(path).addColumn("id", "BIGINT")
+    .partitionedBy("p").property("delta.appendOnly", "true").execute()`.
+    `tableName` requires a catalog; `location` works standalone."""
+
+    def __init__(self, mode: str, catalog=None):
+        self._mode = mode
+        self._catalog = catalog
+        self._name: Optional[str] = None
+        self._location: Optional[str] = None
+        self._comment: Optional[str] = None
+        self._columns: list = []
+        self._partitioning: list = []
+        self._properties: Dict[str, str] = {}
+
+    def tableName(self, identifier: str) -> "DeltaTableBuilder":
+        self._name = identifier
+        return self
+
+    def location(self, location: str) -> "DeltaTableBuilder":
+        self._location = location
+        return self
+
+    def comment(self, comment: str) -> "DeltaTableBuilder":
+        self._comment = comment
+        return self
+
+    def addColumn(self, colName: str, dataType: str,
+                  nullable: bool = True,
+                  comment: Optional[str] = None) -> "DeltaTableBuilder":
+        from delta_tpu.models.schema import PrimitiveType, StructField
+        from delta_tpu.sql import normalize_sql_type
+
+        md = {"comment": comment} if comment else {}
+        self._columns.append(StructField(
+            colName, PrimitiveType(normalize_sql_type(dataType)),
+            nullable=nullable, metadata=md))
+        return self
+
+    def addColumns(self, cols) -> "DeltaTableBuilder":
+        self._columns.extend(cols)
+        return self
+
+    def partitionedBy(self, *cols: str) -> "DeltaTableBuilder":
+        self._partitioning = list(cols)
+        return self
+
+    def property(self, key: str, value: str) -> "DeltaTableBuilder":
+        self._properties[key] = value
+        return self
+
+    def execute(self) -> "DeltaTable":
+        from delta_tpu.models.schema import StructType
+
+        if not self._columns:
+            raise DeltaError("table builder requires at least one column")
+        if self._location is None:
+            if self._name is None or self._catalog is None:
+                raise DeltaError(
+                    "table builder needs a location (or a tableName plus "
+                    "a catalog)")
+            self._location = self._catalog.default_location(self._name)
+        table = Table.for_path(self._location)
+        exists = table.exists()
+        if exists:
+            if self._mode == "create":
+                raise DeltaError(f"table {self._location} already exists")
+            if self._mode == "createIfNotExists":
+                return DeltaTable(table)
+        elif self._mode == "replace":
+            # matches the reference: replace() demands an existing table
+            raise DeltaError(
+                f"table {self._location} cannot be replaced as it does "
+                "not exist; use createOrReplace()")
+        import dataclasses
+
+        from delta_tpu.txn.transaction import Operation
+
+        props = dict(self._properties)
+        schema = StructType(self._columns)
+        if not exists:
+            txn = (table.create_transaction_builder(Operation.CREATE_TABLE)
+                   .with_schema(schema)
+                   .with_partition_columns(self._partitioning)
+                   .with_table_properties(props)
+                   .build())
+            if self._comment:
+                txn.update_metadata(dataclasses.replace(
+                    txn.metadata(), description=self._comment))
+            txn.commit()
+        else:  # replace/createOrReplace: new metadata, drop old files
+            import time as _t
+
+            from delta_tpu.models.schema import schema_to_json
+
+            txn = table.create_transaction_builder(
+                Operation.REPLACE_TABLE).build()
+            txn.update_metadata(dataclasses.replace(
+                txn.metadata(),
+                schemaString=schema_to_json(schema),
+                partitionColumns=list(self._partitioning),
+                configuration=props,
+                description=self._comment,
+            ))
+            for f in txn.scan_files():
+                txn.remove_file(f.remove(
+                    deletion_timestamp=int(_t.time() * 1000)))
+            txn.commit()
+        if self._name is not None and self._catalog is not None:
+            from delta_tpu.catalog import TableAlreadyExistsError
+
+            try:
+                self._catalog.register(self._name, self._location)
+            except TableAlreadyExistsError:
+                registered = self._catalog.table(self._name).path
+                if registered != table.path:
+                    raise DeltaError(
+                        f"catalog already maps {self._name!r} to "
+                        f"{registered}, not {table.path}") from None
+        return DeltaTable(table)
 
 
 class DeltaOptimizeBuilder:

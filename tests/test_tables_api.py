@@ -88,3 +88,64 @@ def test_generate_and_convert(tmp_path):
     assert os.path.isdir(os.path.join(root, "_symlink_format_manifest"))
     with pytest.raises(DeltaError):
         dt.generate("bogus_mode")
+
+
+def test_table_builder_create_and_replace(tmp_path):
+    loc = str(tmp_path / "built")
+    dt = (DeltaTable.create()
+          .location(loc)
+          .addColumn("id", "BIGINT", nullable=False)
+          .addColumn("name", "STRING", comment="display name")
+          .partitionedBy("name")
+          .property("delta.appendOnly", "false")
+          .execute())
+    snap = dt.table.latest_snapshot()
+    assert [f.name for f in snap.schema.fields] == ["id", "name"]
+    assert snap.schema["id"].nullable is False
+    assert snap.partition_columns == ["name"]
+
+    with pytest.raises(DeltaError):
+        DeltaTable.create().location(loc).addColumn("x", "INT").execute()
+    # createIfNotExists on existing: no-op handle
+    dt2 = (DeltaTable.createIfNotExists().location(loc)
+           .addColumn("x", "INT").execute())
+    assert [f.name for f in
+            dt2.table.latest_snapshot().schema.fields] == ["id", "name"]
+
+    # write some rows, then replace: new schema, empty table
+    dta.write_table(loc, pa.table({
+        "id": pa.array([1], pa.int64()), "name": pa.array(["a"])}),
+        mode="append")
+    dt3 = (DeltaTable.replace().location(loc)
+           .addColumn("x", "DOUBLE").execute())
+    snap3 = dt3.table.latest_snapshot()
+    assert [f.name for f in snap3.schema.fields] == ["x"]
+    assert dt3.toDF().num_rows == 0
+
+
+def test_table_builder_with_catalog(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path / "cat"))
+    dt = (DeltaTable.create(catalog=cat)
+          .tableName("users")
+          .addColumn("id", "BIGINT")
+          .execute())
+    assert dt.toDF().num_rows == 0
+    assert "users" in cat.tables()
+    assert DeltaTable.forName("users", catalog=cat).detail()["numFiles"] == 0
+
+
+def test_table_builder_semantics(tmp_path):
+    loc = str(tmp_path / "sem")
+    # replace() on a missing table errors (reference contract)
+    with pytest.raises(DeltaError, match="does not exist"):
+        DeltaTable.replace().location(loc).addColumn("x", "INT").execute()
+    dt = (DeltaTable.createOrReplace().location(loc)
+          .addColumn("price", "DECIMAL(10,2)")
+          .comment("money table")
+          .execute())
+    snap = dt.table.latest_snapshot()
+    assert snap.schema["price"].dataType.name == "decimal(10,2)"
+    assert dt.detail().get("description") == "money table"
+    assert dt.history()[0]["operation"] in ("CREATE TABLE", "CREATE OR REPLACE TABLE")
