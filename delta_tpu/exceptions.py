@@ -1,0 +1,45 @@
+"""`delta.exceptions`-compatible names (reference
+`python/delta/exceptions.py:33-106`): the delta-spark concurrency
+exception taxonomy, aliased onto this engine's error classes so
+migrated `except` clauses keep working:
+
+    from delta_tpu.exceptions import ConcurrentAppendException
+    try:
+        txn.commit()
+    except ConcurrentAppendException:
+        retry()
+
+Each name IS the corresponding native class (no wrapping), so catching
+either spelling works.
+"""
+
+from delta_tpu.errors import (
+    ConcurrentAppendError,
+    ConcurrentDeleteDeleteError,
+    ConcurrentDeleteReadError,
+    ConcurrentModificationError,
+    ConcurrentTransactionError,
+    ConcurrentWriteError,
+    MetadataChangedError,
+    ProtocolChangedError,
+)
+
+DeltaConcurrentModificationException = ConcurrentModificationError
+ConcurrentWriteException = ConcurrentWriteError
+MetadataChangedException = MetadataChangedError
+ProtocolChangedException = ProtocolChangedError
+ConcurrentAppendException = ConcurrentAppendError
+ConcurrentDeleteReadException = ConcurrentDeleteReadError
+ConcurrentDeleteDeleteException = ConcurrentDeleteDeleteError
+ConcurrentTransactionException = ConcurrentTransactionError
+
+__all__ = [
+    "DeltaConcurrentModificationException",
+    "ConcurrentWriteException",
+    "MetadataChangedException",
+    "ProtocolChangedException",
+    "ConcurrentAppendException",
+    "ConcurrentDeleteReadException",
+    "ConcurrentDeleteDeleteException",
+    "ConcurrentTransactionException",
+]

@@ -225,6 +225,16 @@ class DeltaTableBuilder:
         return self
 
     def addColumns(self, cols) -> "DeltaTableBuilder":
+        from delta_tpu.models.schema import StructField, StructType
+
+        if isinstance(cols, StructType):
+            cols = cols.fields
+        cols = list(cols)
+        bad = [c for c in cols if not isinstance(c, StructField)]
+        if bad:
+            raise DeltaError(
+                f"addColumns takes StructFields or a StructType, got "
+                f"{type(bad[0]).__name__}")
         self._columns.extend(cols)
         return self
 
@@ -248,17 +258,24 @@ class DeltaTableBuilder:
                     "a catalog)")
             self._location = self._catalog.default_location(self._name)
         table = Table.for_path(self._location)
+        # a catalog-name conflict must surface BEFORE any commit, so a
+        # typo never leaves an orphaned unregistered table on disk
+        if self._name is not None and self._catalog is not None and \
+                self._catalog.exists(self._name):
+            registered = self._catalog.table(self._name).path
+            if registered != table.path:
+                raise DeltaError(
+                    f"catalog already maps {self._name!r} to "
+                    f"{registered}, not {table.path}")
         exists = table.exists()
-        if exists:
-            if self._mode == "create":
-                raise DeltaError(f"table {self._location} already exists")
-            if self._mode == "createIfNotExists":
-                return DeltaTable(table)
-        elif self._mode == "replace":
+        if not exists and self._mode == "replace":
             # matches the reference: replace() demands an existing table
             raise DeltaError(
                 f"table {self._location} cannot be replaced as it does "
                 "not exist; use createOrReplace()")
+        if exists and self._mode == "create":
+            raise DeltaError(f"table {self._location} already exists")
+
         import dataclasses
 
         from delta_tpu.txn.transaction import Operation
@@ -275,20 +292,35 @@ class DeltaTableBuilder:
                 txn.update_metadata(dataclasses.replace(
                     txn.metadata(), description=self._comment))
             txn.commit()
-        else:  # replace/createOrReplace: new metadata, drop old files
+        elif self._mode != "createIfNotExists":
+            # replace/createOrReplace: new definition, drop old files.
+            # Feature-activating properties (column mapping, CDF, DVs,
+            # ...) must upgrade the protocol and assign field ids, as
+            # the create path and ALTER ... SET TBLPROPERTIES do.
             import time as _t
 
+            from delta_tpu.columnmapping import assign_column_mapping, mapping_mode
+            from delta_tpu.features import FEATURES, upgraded_protocol
             from delta_tpu.models.schema import schema_to_json
 
             txn = table.create_transaction_builder(
                 Operation.REPLACE_TABLE).build()
-            txn.update_metadata(dataclasses.replace(
+            if mapping_mode(props) != "none":
+                schema, props = assign_column_mapping(schema, props)
+            new_meta = dataclasses.replace(
                 txn.metadata(),
                 schemaString=schema_to_json(schema),
                 partitionColumns=list(self._partitioning),
                 configuration=props,
                 description=self._comment,
-            ))
+            )
+            proto = txn.protocol()
+            for feat in FEATURES.values():
+                if feat.activated_by is not None and feat.activated_by(new_meta):
+                    proto = upgraded_protocol(proto, feat)
+            if proto != txn.protocol():
+                txn.update_protocol(proto)
+            txn.update_metadata(new_meta)
             for f in txn.scan_files():
                 txn.remove_file(f.remove(
                     deletion_timestamp=int(_t.time() * 1000)))
@@ -299,11 +331,7 @@ class DeltaTableBuilder:
             try:
                 self._catalog.register(self._name, self._location)
             except TableAlreadyExistsError:
-                registered = self._catalog.table(self._name).path
-                if registered != table.path:
-                    raise DeltaError(
-                        f"catalog already maps {self._name!r} to "
-                        f"{registered}, not {table.path}") from None
+                pass  # pre-checked above: same location
         return DeltaTable(table)
 
 

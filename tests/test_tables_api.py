@@ -149,3 +149,58 @@ def test_table_builder_semantics(tmp_path):
     assert snap.schema["price"].dataType.name == "decimal(10,2)"
     assert dt.detail().get("description") == "money table"
     assert dt.history()[0]["operation"] in ("CREATE TABLE", "CREATE OR REPLACE TABLE")
+
+
+def test_exceptions_compat_aliases(tmp_table_path):
+    """delta.exceptions names catch the native concurrency errors."""
+    from delta_tpu.exceptions import (
+        ConcurrentTransactionException,
+        DeltaConcurrentModificationException,
+    )
+    from delta_tpu.errors import ConcurrentTransactionError
+
+    assert ConcurrentTransactionException is ConcurrentTransactionError
+    dta.write_table(tmp_table_path, _data(0, 3))
+    from delta_tpu.table import Table
+
+    t = Table.for_path(tmp_table_path)
+    txn = t.start_transaction("WRITE")
+    txn.set_transaction_id("app", 5)
+    txn.commit()
+    txn2 = t.start_transaction("WRITE")
+    with pytest.raises(DeltaConcurrentModificationException):
+        txn2.set_transaction_id("app", 5)  # not past the watermark
+
+
+def test_builder_replace_activates_features(tmp_path):
+    from delta_tpu.models.schema import LONG, StructField, StructType
+
+    loc = str(tmp_path / "feat")
+    DeltaTable.create().location(loc).addColumn("x", "INT").execute()
+    dt = (DeltaTable.createOrReplace().location(loc)
+          .addColumns(StructType([StructField("y", LONG)]))
+          .property("delta.columnMapping.mode", "name")
+          .property("delta.enableChangeDataFeed", "true")
+          .execute())
+    snap = dt.table.latest_snapshot()
+    proto = snap.protocol
+    # legacy features may be carried by version bumps instead of names
+    feats = set(proto.writerFeatures or [])
+    assert "columnMapping" in feats or proto.minWriterVersion >= 5
+    assert "changeDataFeed" in feats or proto.minWriterVersion >= 4
+    assert proto.minReaderVersion >= 2  # column mapping needs reader v2
+    # field ids assigned
+    assert snap.schema["y"].metadata.get("delta.columnMapping.id") is not None
+
+
+def test_builder_catalog_conflict_before_commit(tmp_path):
+    from delta_tpu.catalog import Catalog
+
+    cat = Catalog(str(tmp_path / "cat"))
+    DeltaTable.create(catalog=cat).tableName("t").addColumn("a", "INT").execute()
+    other = str(tmp_path / "elsewhere")
+    with pytest.raises(DeltaError, match="already maps"):
+        (DeltaTable.create(catalog=cat).tableName("t").location(other)
+         .addColumn("b", "INT").execute())
+    import os
+    assert not os.path.exists(other)  # nothing was committed
