@@ -73,6 +73,14 @@ class MergeBuilder:
         self._matched: List[MergeClause] = []
         self._not_matched: List[MergeClause] = []
         self._not_matched_by_source: List[MergeClause] = []
+        self._schema_evolution = False
+
+    def with_schema_evolution(self):
+        """Evolve the target schema with source-only columns (the
+        reference's `withSchemaEvolution()`); without it, extra source
+        columns in *All clauses are an error."""
+        self._schema_evolution = True
+        return self
 
     def when_matched_update(self, set: Dict[str, object], condition=None):
         self._matched.append(MergeClause("update", condition, dict(set)))
@@ -106,6 +114,7 @@ class MergeBuilder:
         return _execute_merge(
             self._table, self._source, self._on,
             self._matched, self._not_matched, self._not_matched_by_source,
+            schema_evolution=self._schema_evolution,
         )
 
 
@@ -164,14 +173,16 @@ def _eval_values(
             else:
                 arr = pa.array([v] * n, f.type)
         elif assignments is None:
-            # UPDATE * / INSERT *: take the source column of the same name
-            src = pc.struct_field(batch.column("source").combine_chunks(), f.name) \
-                if f.name in batch.column("source").combine_chunks().type.names \
-                else None
-            if src is None:
+            # UPDATE * / INSERT *: take the source column of the same
+            # name — resolved case-insensitively, like the reference
+            # analyzer (a source 'ID' feeds a target 'id')
+            s_struct = batch.column("source").combine_chunks()
+            by_lower = {sn.lower(): sn for sn in s_struct.type.names}
+            actual = by_lower.get(f.name.lower())
+            if actual is None:
                 arr = pa.nulls(n, f.type)
             else:
-                arr = src.cast(f.type, safe=False)
+                arr = pc.struct_field(s_struct, actual).cast(f.type, safe=False)
         else:
             # unassigned target column keeps its current value (update) or
             # null (insert — no target side present)
@@ -185,7 +196,8 @@ def _eval_values(
 
 
 def _execute_merge(
-    table, source, on, matched, not_matched, not_matched_by_source
+    table, source, on, matched, not_matched, not_matched_by_source,
+    schema_evolution: bool = False,
 ) -> MergeMetrics:
     import pyarrow.compute as pc
 
@@ -200,6 +212,39 @@ def _execute_merge(
     meta = snapshot.metadata
     use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
     schema = snapshot.schema
+
+    # source-only columns (case-insensitive, like the reference
+    # analyzer): error for *All clauses unless schema evolution was
+    # requested (reference withSchemaEvolution / schema.autoMerge)
+    target_by_lower = {f.name.lower() for f in schema.fields}
+    extra_cols = [c for c in source.column_names
+                  if c.lower() not in target_by_lower]
+    has_star = any(c.assignments is None and c.kind != "delete"
+                   for c in (matched + not_matched))
+    schema_evolved = False
+    if extra_cols and has_star:
+        if not schema_evolution:
+            raise DeltaError(
+                f"source column(s) {extra_cols} not in the target schema; "
+                "call with_schema_evolution() to evolve the table")
+        import dataclasses
+
+        from delta_tpu.columnmapping import assign_column_mapping, mapping_mode
+        from delta_tpu.models.schema import from_arrow_schema, schema_to_json
+        from delta_tpu.schema_evolution import merge_schemas
+
+        evolved = merge_schemas(schema, from_arrow_schema(source.schema))
+        conf = dict(meta.configuration)
+        if mapping_mode(conf) != "none":
+            # new fields need column-mapping ids/physical names (exactly
+            # as ALTER TABLE ADD COLUMNS assigns them)
+            evolved, conf = assign_column_mapping(evolved, conf)
+        txn.update_metadata(dataclasses.replace(
+            meta, schemaString=schema_to_json(evolved),
+            configuration=conf))
+        meta = txn.metadata()
+        schema = evolved
+        schema_evolved = True
     target_arrow_schema = to_arrow_schema(schema)
     now_ms = int(time.time() * 1000)
     metrics = MergeMetrics(num_source_rows=source.num_rows)
@@ -394,11 +439,9 @@ def _execute_merge(
         out_parts = []
         n_kept = int(kept.sum())
         if n_kept:
-            out_parts.append(
-                _strip_provenance(target_all.filter(pa.array(kept))).cast(
-                    target_arrow_schema
-                )
-            )
+            out_parts.append(_align_to_schema(
+                _strip_provenance(target_all.filter(pa.array(kept))),
+                target_arrow_schema))
             metrics.num_target_rows_copied += n_kept
         # matched updates in this file, all pairs at once
         upd_pis = [pi for t, pi in update_rows.items() if file_of[t] == fi]
@@ -469,8 +512,8 @@ def _execute_merge(
                     pa.concat_tables(rows, promote_options="permissive"), kind,
                 )
 
-    if not txn._adds and not txn._removes:
-        return metrics
+    if not txn._adds and not txn._removes and not schema_evolved:
+        return metrics  # nothing touched (an evolved schema still commits)
     txn.set_operation_parameters({"predicate": repr(on)})
     txn.set_operation_metrics(
         {
@@ -484,6 +527,18 @@ def _execute_merge(
     result = txn.commit()
     metrics.version = result.version
     return metrics
+
+
+def _align_to_schema(t: pa.Table, schema: pa.Schema) -> pa.Table:
+    """Null-fill columns `t` lacks (pre-evolution rows), order + cast to
+    `schema`."""
+    cols = []
+    for f in schema:
+        if f.name in t.column_names:
+            cols.append(t.column(f.name))
+        else:
+            cols.append(pa.nulls(t.num_rows, f.type))
+    return pa.table(dict(zip(schema.names, cols))).cast(schema)
 
 
 def _strip_provenance(t: pa.Table) -> pa.Table:

@@ -331,7 +331,13 @@ class DeltaTableBuilder:
             try:
                 self._catalog.register(self._name, self._location)
             except TableAlreadyExistsError:
-                pass  # pre-checked above: same location
+                # the pre-check passed, so either it's our own location
+                # (fine) or another writer raced us to the name
+                registered = self._catalog.table(self._name).path
+                if registered != table.path:
+                    raise DeltaError(
+                        f"catalog already maps {self._name!r} to "
+                        f"{registered}, not {table.path}") from None
         return DeltaTable(table)
 
 
@@ -359,6 +365,10 @@ class DeltaMergeBuilder:
 
     def __init__(self, builder):
         self._b = builder
+
+    def withSchemaEvolution(self) -> "DeltaMergeBuilder":
+        self._b = self._b.with_schema_evolution()
+        return self
 
     def whenMatchedUpdate(self, condition: ExprOrStr = None,
                           set: Optional[Dict[str, object]] = None

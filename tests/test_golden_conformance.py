@@ -459,3 +459,51 @@ def test_stats_struct_only_checkpoint_keeps_skipping(tmp_path):
     assert json.loads(sorted(stats)[0])["minValues"]["x"] == 0
     files = snap.scan(filter=col("x") >= lit(100)).files()
     assert len(files) == 1
+
+
+def test_ict_monotonic_through_fast_path(tmp_path):
+    """In-commit timestamps stay strictly increasing across commits even
+    when the previous snapshot's timestamp came from the .crc/P&M fast
+    path (the monotonicity floor feeds the next commit's ICT)."""
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+    from delta_tpu.read.cdc import COMMIT_VERSION_COL  # noqa: F401
+
+    path = str(tmp_path / "ict")
+    dta.write_table(path, pa.table(
+        {"x": pa.array(np.arange(3, dtype=np.int64))}),
+        properties={"delta.enableInCommitTimestamps": "true"})
+    for i in range(4):
+        # fresh handle each time: the read snapshot resolves via crc
+        dta.write_table(path, pa.table(
+            {"x": pa.array([i], pa.int64())}), mode="append")
+    snap = Table.for_path(path).latest_snapshot()
+    icts = [ci.inCommitTimestamp
+            for v, ci in sorted(snap.state.commit_infos.items())
+            if ci.inCommitTimestamp is not None]
+    assert len(icts) >= 2
+    assert all(b > a for a, b in zip(icts, icts[1:])), icts
+
+
+def test_column_mapping_id_mode_roundtrip(tmp_path):
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+
+    path = str(tmp_path / "idmode")
+    dta.write_table(path, pa.table(
+        {"a": pa.array(np.arange(5, dtype=np.int64)),
+         "b": pa.array(["x"] * 5)}),
+        properties={"delta.columnMapping.mode": "id"})
+    out = dta.read_table(path)
+    assert sorted(out.column_names) == ["a", "b"]
+    assert out.num_rows == 5
+    snap = Table.for_path(path).latest_snapshot()
+    ids = {f.name: f.metadata.get("delta.columnMapping.id")
+           for f in snap.schema.fields}
+    assert all(v is not None for v in ids.values())
+    # physical names differ from logical under id mode too
+    phys = {f.name: f.metadata.get("delta.columnMapping.physicalName")
+            for f in snap.schema.fields}
+    assert all(v for v in phys.values())

@@ -4,6 +4,7 @@ import pytest
 
 import delta_tpu.api as dta
 from delta_tpu.commands.merge import MergeCardinalityError, merge
+from delta_tpu.errors import DeltaError
 from delta_tpu.expressions import col, lit
 from delta_tpu.table import Table
 
@@ -143,3 +144,76 @@ def test_merge_residual_condition(target_path):
     vals = out.column("value").to_pylist()
     assert vals[0] == 10.0      # id=1 pair filtered out by residual
     assert vals[1] == 200.0     # id=2 updated
+
+
+def test_merge_schema_evolution(tmp_table_path):
+    """Extra source columns: error without with_schema_evolution(),
+    evolve the target schema with it (reference withSchemaEvolution)."""
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1, 2], pa.int64())}))
+    src = pa.table({"id": pa.array([2, 3], pa.int64()),
+                    "extra": pa.array(["e2", "e3"])})
+    t = Table.for_path(tmp_table_path)
+    with pytest.raises(DeltaError, match="with_schema_evolution"):
+        (merge(t, src, on=col("target.id") == col("source.id"))
+         .when_not_matched_insert_all().execute())
+
+    m = (merge(Table.for_path(tmp_table_path), src,
+               on=col("target.id") == col("source.id"))
+         .with_schema_evolution()
+         .when_matched_update_all()
+         .when_not_matched_insert_all()
+         .execute())
+    assert m.num_target_rows_inserted == 1
+    out = dta.read_table(tmp_table_path)
+    rows = {i: e for i, e in zip(out.column("id").to_pylist(),
+                                 out.column("extra").to_pylist())}
+    assert rows == {1: None, 2: "e2", 3: "e3"}
+
+
+def test_merge_evolution_with_column_mapping(tmp_table_path):
+    """Evolved columns on a mapped table get field ids/physical names."""
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1], pa.int64())}),
+        properties={"delta.columnMapping.mode": "name"})
+    src = pa.table({"id": pa.array([2], pa.int64()),
+                    "extra": pa.array(["x"])})
+    (merge(Table.for_path(tmp_table_path), src,
+           on=col("target.id") == col("source.id"))
+     .with_schema_evolution()
+     .when_not_matched_insert_all()
+     .execute())
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    f = snap.schema["extra"]
+    assert f.metadata.get("delta.columnMapping.id") is not None
+    assert f.metadata.get("delta.columnMapping.physicalName")
+    out = dta.read_table(tmp_table_path)
+    assert dict(zip(out.column("id").to_pylist(),
+                    out.column("extra").to_pylist())) == {1: None, 2: "x"}
+
+
+def test_merge_case_insensitive_source_columns(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1], pa.int64())}))
+    src = pa.table({"ID": pa.array([2], pa.int64())})
+    (merge(Table.for_path(tmp_table_path), src,
+           on=col("target.id") == col("source.ID"))
+     .when_not_matched_insert_all()
+     .execute())
+    out = dta.read_table(tmp_table_path)
+    assert sorted(out.column("id").to_pylist()) == [1, 2]  # no NULL insert
+
+
+def test_merge_evolution_commits_schema_even_without_row_changes(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1], pa.int64())}))
+    src = pa.table({"id": pa.array([99], pa.int64()),
+                    "extra": pa.array(["x"])})
+    (merge(Table.for_path(tmp_table_path), src,
+           on=col("target.id") == col("source.id"))
+     .with_schema_evolution()
+     .when_matched_update_all()   # nothing matches
+     .execute())
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    assert "extra" in {f.name for f in snap.schema.fields}
+    assert snap.version == 1  # metadata-only commit landed
