@@ -160,6 +160,10 @@ def set_properties(table, properties: Dict[str, str]) -> int:
     conf = dict(meta.configuration)
     old_mode = mapping_mode(conf)
     conf.update(properties)
+
+    from delta_tpu.interop.icebergcompat import validate_enablement
+
+    validate_enablement(txn.read_snapshot, conf)
     new_mode = mapping_mode(conf)
     schema = schema_from_json(meta.schemaString)
     if old_mode != new_mode:

@@ -162,9 +162,15 @@ def _eval_values(
 
     n = batch.num_rows
     out = {}
+    if assignments is None:
+        # hoisted: one combine + name map for the whole clause batch
+        s_struct_all = batch.column("source").combine_chunks()
+        by_lower_all = {sn.lower(): sn for sn in s_struct_all.type.names}
+    else:
+        amap = {k.lower(): v for k, v in assignments.items()}
     for f in target_schema:
-        if assignments is not None and f.name in assignments:
-            v = assignments[f.name]
+        if assignments is not None and f.name.lower() in amap:
+            v = amap[f.name.lower()]
             if isinstance(v, Expression):
                 arr = evaluate_host(v, batch)
                 if isinstance(arr, pa.ChunkedArray):
@@ -176,13 +182,12 @@ def _eval_values(
             # UPDATE * / INSERT *: take the source column of the same
             # name — resolved case-insensitively, like the reference
             # analyzer (a source 'ID' feeds a target 'id')
-            s_struct = batch.column("source").combine_chunks()
-            by_lower = {sn.lower(): sn for sn in s_struct.type.names}
-            actual = by_lower.get(f.name.lower())
+            actual = by_lower_all.get(f.name.lower())
             if actual is None:
                 arr = pa.nulls(n, f.type)
             else:
-                arr = pc.struct_field(s_struct, actual).cast(f.type, safe=False)
+                arr = pc.struct_field(s_struct_all, actual).cast(
+                    f.type, safe=False)
         else:
             # unassigned target column keeps its current value (update) or
             # null (insert — no target side present)
@@ -213,27 +218,52 @@ def _execute_merge(
     use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
     schema = snapshot.schema
 
-    # source-only columns (case-insensitive, like the reference
-    # analyzer): error for *All clauses unless schema evolution was
-    # requested (reference withSchemaEvolution / schema.autoMerge)
+    # new-column detection (case-insensitive, like the reference
+    # analyzer): source-only columns consumed by *All clauses, plus
+    # explicit assignments targeting unknown columns. Without
+    # with_schema_evolution() both are errors (never silent drops).
     target_by_lower = {f.name.lower() for f in schema.fields}
     extra_cols = [c for c in source.column_names
                   if c.lower() not in target_by_lower]
     has_star = any(c.assignments is None and c.kind != "delete"
                    for c in (matched + not_matched))
+    unknown_assigned = sorted({
+        k for c in (matched + not_matched + not_matched_by_source)
+        if c.assignments
+        for k in c.assignments if k.lower() not in target_by_lower})
     schema_evolved = False
-    if extra_cols and has_star:
+    if unknown_assigned:
+        source_by_lower = {c.lower() for c in source.column_names}
+        missing = [k for k in unknown_assigned
+                   if k.lower() not in source_by_lower]
+        if missing:
+            raise DeltaError(
+                f"assignment target column(s) {missing} exist in neither "
+                "the target schema nor the source")
         if not schema_evolution:
             raise DeltaError(
-                f"source column(s) {extra_cols} not in the target schema; "
-                "call with_schema_evolution() to evolve the table")
+                f"assignment target column(s) {unknown_assigned} not in "
+                "the target schema; call with_schema_evolution() to "
+                "evolve the table")
+    if (extra_cols and has_star and not schema_evolution):
+        raise DeltaError(
+            f"source column(s) {extra_cols} not in the target schema; "
+            "call with_schema_evolution() to evolve the table")
+    if (extra_cols and has_star) or unknown_assigned:
         import dataclasses
 
         from delta_tpu.columnmapping import assign_column_mapping, mapping_mode
         from delta_tpu.models.schema import from_arrow_schema, schema_to_json
         from delta_tpu.schema_evolution import merge_schemas
 
-        evolved = merge_schemas(schema, from_arrow_schema(source.schema))
+        # evolve only the consumed source columns: all of them under a
+        # *All clause, else just the explicitly assigned ones
+        cols_to_add = set(extra_cols) if (extra_cols and has_star) else set()
+        for k in unknown_assigned:
+            cols_to_add.add(next(c for c in source.column_names
+                                 if c.lower() == k.lower()))
+        evolved = merge_schemas(
+            schema, from_arrow_schema(source.select(sorted(cols_to_add)).schema))
         conf = dict(meta.configuration)
         if mapping_mode(conf) != "none":
             # new fields need column-mapping ids/physical names (exactly

@@ -36,6 +36,38 @@ def reorg_rewrite_all(table, max_file_size: int = DEFAULT_MAX_FILE_SIZE) -> Opti
     return _reorg(table, lambda f: True, "REORG (REWRITE)", max_file_size)
 
 
+def reorg_upgrade_uniform(table, iceberg_compat_version: int = 2,
+                          max_file_size: int = DEFAULT_MAX_FILE_SIZE) -> OptimizeMetrics:
+    """REORG TABLE ... APPLY (UPGRADE UNIFORM (ICEBERG_COMPAT_VERSION=N)):
+    make an existing table IcebergCompat-ready — materialize any
+    deletion vectors, drop the DV feature, then enable column mapping +
+    the compat flag + UniForm iceberg in one property commit (reference
+    `DeltaReorgTableCommand.scala` upgrade-uniform mode)."""
+    from delta_tpu.commands.alter import set_properties
+    from delta_tpu.table import Table as _Table
+
+    if iceberg_compat_version not in (1, 2):
+        raise DeltaError(
+            f"unsupported ICEBERG_COMPAT_VERSION {iceberg_compat_version}")
+    metrics = reorg_purge(table, max_file_size)
+
+    fresh = _Table.for_path(table.path, table.engine)
+    other = 1 if iceberg_compat_version == 2 else 2
+    props = {
+        f"delta.enableIcebergCompatV{iceberg_compat_version}": "true",
+        # upgrading between versions must not trip the mutual-exclusion
+        # check after the purge already ran
+        f"delta.enableIcebergCompatV{other}": "false",
+        "delta.enableDeletionVectors": "false",
+        "delta.universalFormat.enabledFormats": "iceberg",
+    }
+    conf = fresh.latest_snapshot().metadata.configuration
+    if conf.get("delta.columnMapping.mode", "none") == "none":
+        props["delta.columnMapping.mode"] = "name"
+    set_properties(fresh, props)
+    return metrics
+
+
 def _reorg(table, selector: Callable[[AddFile], bool], op_name: str,
            max_file_size: int) -> OptimizeMetrics:
     from delta_tpu.read.reader import read_add_file_logical

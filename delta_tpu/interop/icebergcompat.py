@@ -60,6 +60,25 @@ def _walk_types(dt, path, problems, version: int):
                             "the Iceberg V2 allow-list")
 
 
+def validate_enablement(snapshot, new_configuration) -> None:
+    """Called when a property change newly enables a compat version:
+    beyond the metadata checks, no LIVE file may still carry a deletion
+    vector — stale DVs would resurrect deleted rows in the Iceberg
+    mirror. (The reference routes enablement through REORG UPGRADE
+    UNIFORM, which purges first.)"""
+    old_v = enabled_version(snapshot.metadata.configuration)
+    new_v = enabled_version(new_configuration)
+    if new_v is None or new_v == old_v:
+        return
+    dvs = [d for d in snapshot.state.add_files_table
+           .column("deletion_vector").to_pylist() if d]
+    if dvs:
+        raise DeltaError(
+            f"cannot enable icebergCompatV{new_v}: {len(dvs)} live "
+            "file(s) still carry deletion vectors; run REORG TABLE ... "
+            "APPLY (UPGRADE UNIFORM (...)) or PURGE first")
+
+
 def validate_iceberg_compat(metadata, protocol,
                             adds: Sequence = ()) -> None:
     """Raise when the staged commit violates the enabled compat version;
@@ -79,16 +98,15 @@ def validate_iceberg_compat(metadata, protocol,
             f"icebergCompatV{version} requires column mapping "
             f"(delta.columnMapping.mode=name), found {mode!r} "
             "(RequireColumnMapping)")
-    if (_is_true(conf, "delta.enableDeletionVectors")
-            or "deletionVectors" in (protocol.writerFeatures or [])):
-        # feature presence, not just the config flag: a table that ever
-        # wrote DVs may still carry them in live files — the established
-        # escape path is ALTER TABLE DROP FEATURE deletionVectors (which
-        # purges them) before enabling compat
+    if _is_true(conf, "delta.enableDeletionVectors"):
+        # config-level check, as the reference's
+        # CheckDeletionVectorDisabled; live files are additionally
+        # checked at ENABLEMENT time (validate_enablement) and staged
+        # adds on every commit below — REORG ... APPLY (UPGRADE UNIFORM)
+        # is the purge path for tables that already wrote DVs
         raise DeltaError(
             f"icebergCompatV{version} is incompatible with deletion "
-            "vectors (CheckDeletionVectorDisabled); drop the "
-            "deletionVectors feature first")
+            "vectors (CheckDeletionVectorDisabled)")
     dv_adds = [a.path for a in adds
                if getattr(a, "deletionVector", None) is not None]
     if dv_adds:

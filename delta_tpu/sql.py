@@ -329,6 +329,17 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         return reorg_purge(_table(m, engine, catalog))
 
     m = re.fullmatch(
+        rf"REORG\s+TABLE\s+{_PATH}\s+APPLY\s*\(\s*UPGRADE\s+UNIFORM\s*"
+        r"\(\s*ICEBERG_COMPAT_VERSION\s*=\s*(?P<v>\d+)\s*\)\s*\)",
+        s, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.reorg import reorg_upgrade_uniform
+
+        return reorg_upgrade_uniform(_table(m, engine, catalog),
+                                     iceberg_compat_version=int(m.group("v")))
+
+    m = re.fullmatch(
         rf"GENERATE\s+symlink_format_manifest\s+FOR\s+TABLE\s+{_PATH}",
         s, re.IGNORECASE,
     )

@@ -217,3 +217,34 @@ def test_merge_evolution_commits_schema_even_without_row_changes(tmp_table_path)
     snap = Table.for_path(tmp_table_path).latest_snapshot()
     assert "extra" in {f.name for f in snap.schema.fields}
     assert snap.version == 1  # metadata-only commit landed
+
+
+def test_merge_evolution_explicit_assignment_to_new_column(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1], pa.int64())}))
+    src = pa.table({"id": pa.array([2], pa.int64()),
+                    "extra": pa.array(["x"])})
+    # without evolution: error, never a silent drop
+    with pytest.raises(DeltaError, match="with_schema_evolution"):
+        (merge(Table.for_path(tmp_table_path), src,
+               on=col("target.id") == col("source.id"))
+         .when_not_matched_insert(values={"id": col("source.id"),
+                                          "extra": col("source.extra")})
+         .execute())
+    # assignment to a column in neither schema: clean error
+    with pytest.raises(DeltaError, match="neither"):
+        (merge(Table.for_path(tmp_table_path), src,
+               on=col("target.id") == col("source.id"))
+         .with_schema_evolution()
+         .when_not_matched_insert(values={"id": col("source.id"),
+                                          "ghost": lit(1)})
+         .execute())
+    (merge(Table.for_path(tmp_table_path), src,
+           on=col("target.id") == col("source.id"))
+     .with_schema_evolution()
+     .when_not_matched_insert(values={"id": col("source.id"),
+                                      "extra": col("source.extra")})
+     .execute())
+    out = dta.read_table(tmp_table_path)
+    assert dict(zip(out.column("id").to_pylist(),
+                    out.column("extra").to_pylist())) == {1: None, 2: "x"}

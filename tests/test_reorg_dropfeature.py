@@ -281,3 +281,40 @@ def test_dml_on_column_mapped_table(tmp_table_path):
     Table.for_path(tmp_table_path).optimize().execute_compaction()
     rows = dta.read_table(tmp_table_path)
     assert sorted(rows.column("id").to_pylist()) == list(range(3, 10))
+
+
+def test_reorg_upgrade_uniform(tmp_table_path):
+    """REORG ... APPLY (UPGRADE UNIFORM): DV purge + feature drop +
+    compat/UniForm enablement in one command."""
+    import numpy as np
+    import pyarrow as pa
+
+    import delta_tpu.api as dta
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+    from delta_tpu.sql import sql
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array(np.arange(10, dtype=np.int64))}),
+        properties={"delta.enableDeletionVectors": "true"})
+    delete(Table.for_path(tmp_table_path), col("id") < lit(3))
+    sql(f"REORG TABLE '{tmp_table_path}' APPLY "
+        "(UPGRADE UNIFORM (ICEBERG_COMPAT_VERSION = 2))")
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    conf = snap.metadata.configuration
+    assert conf.get("delta.enableIcebergCompatV2") == "true"
+    assert conf.get("delta.columnMapping.mode") == "name"
+    assert "iceberg" in conf.get("delta.universalFormat.enabledFormats", "")
+    # the DV FEATURE may remain in the protocol (reference semantics);
+    # what matters is the config is off and no live file carries a DV
+    assert conf.get("delta.enableDeletionVectors") == "false"
+    # no DVs survive, reads still correct through the new mapping
+    assert not any(
+        d for d in
+        snap.state.add_files_table.column("deletion_vector").to_pylist())
+    assert sorted(dta.read_table(tmp_table_path).column("id").to_pylist()) \
+        == list(range(3, 10))
+    # and subsequent compat-validated commits pass
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([100], pa.int64())}), mode="append")
