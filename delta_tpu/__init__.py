@@ -31,6 +31,7 @@ from delta_tpu.table import Table
 from delta_tpu.snapshot import Snapshot
 from delta_tpu.scan import Scan, ScanBuilder
 from delta_tpu.txn.transaction import Transaction, TransactionBuilder, Operation
+from delta_tpu.tables import DeltaTable
 from delta_tpu.errors import (
     DeltaError,
     TableNotFoundError,
@@ -49,6 +50,7 @@ from delta_tpu.errors import (
 __all__ = [
     "__version__",
     "Table",
+    "DeltaTable",
     "Snapshot",
     "Scan",
     "ScanBuilder",
