@@ -33,13 +33,22 @@ def _state_fingerprint(snap):
     return snap.version, snap.num_files, rows
 
 
-@pytest.mark.parametrize("seed", [11, 23, 47])
-def test_random_op_sequence_engines_agree(tmp_table_path, seed):
+@pytest.mark.parametrize("seed,variant", [
+    (11, 0),   # baseline (CDF only)
+    (23, 1),   # deletion vectors
+    (47, 2),   # column mapping + deletion vectors
+    (61, 3),   # v2 checkpoints
+])
+def test_random_op_sequence_engines_agree(tmp_table_path, seed, variant):
     rng = np.random.default_rng(seed)
-    use_dv = bool(seed % 2)
     props = {"delta.enableChangeDataFeed": "true"}
-    if use_dv:
+    if variant == 1:
         props["delta.enableDeletionVectors"] = "true"
+    elif variant == 2:
+        props["delta.columnMapping.mode"] = "name"
+        props["delta.enableDeletionVectors"] = "true"
+    elif variant == 3:
+        props["delta.checkpointPolicy"] = "v2"
 
     # model: id -> value
     model = {}
