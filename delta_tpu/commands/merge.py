@@ -61,6 +61,7 @@ class MergeMetrics:
     num_target_rows_inserted: int = 0
     num_target_rows_copied: int = 0
     num_target_files_rewritten: int = 0
+    num_target_files_scanned: int = 0
     num_source_rows: int = 0
     version: Optional[int] = None
 
@@ -120,6 +121,44 @@ class MergeBuilder:
 
 def merge(table, source: pa.Table, on: Expression) -> MergeBuilder:
     return MergeBuilder(table, source, on)
+
+
+def _source_key_bounds(t_keys: List[str], s_keys: List[str],
+                       source: pa.Table) -> Optional[Expression]:
+    """AND of per-key [min, max] range predicates over the target equi-key
+    columns, computed from the source — a safe superset of the matchable
+    rows (NULL keys never equi-match, so dropping them keeps the bound
+    valid). None when no key yields a usable bound."""
+    import pyarrow.compute as pc
+
+    from delta_tpu.expressions.tree import Literal
+    from delta_tpu.stats.collection import _supports_minmax
+
+    conjuncts: List[Expression] = []
+    for t_key, s_key in zip(t_keys, s_keys):
+        if "." in t_key or s_key not in source.column_names:
+            continue  # nested targets: skip (no bound, still correct)
+        col_arr = source.column(s_key)
+        if not _supports_minmax(col_arr.type):
+            continue
+        if col_arr.null_count == len(col_arr):
+            continue
+        mm = pc.min_max(col_arr)
+        mn, mx = mm["min"].as_py(), mm["max"].as_py()
+        if mn is None or mx is None:
+            continue
+        if (isinstance(mn, float) and mn != mn) or \
+                (isinstance(mx, float) and mx != mx):
+            continue  # NaN bounds prune incorrectly; skip this key
+        target_col = Column((t_key,))
+        conjuncts.append(Comparison(">=", target_col, Literal(mn)))
+        conjuncts.append(Comparison("<=", target_col, Literal(mx)))
+    if not conjuncts:
+        return None
+    pred = conjuncts[0]
+    for c in conjuncts[1:]:
+        pred = pred & c
+    return pred
 
 
 def _equi_keys(on: Expression) -> tuple[List[str], List[str], List[Expression]]:
@@ -279,8 +318,17 @@ def _execute_merge(
     now_ms = int(time.time() * 1000)
     metrics = MergeMetrics(num_source_rows=source.num_rows)
 
-    candidates = txn.scan_files()  # whole-table read (predicate refinement: future)
     t_keys, s_keys, residual = _equi_keys(on)
+    # source-derived file pruning (the reference's dynamic pruning via
+    # MergeIntoMaterializeSource): equi-join keys bound the target rows
+    # that can match, so files outside [min(source key), max(source key)]
+    # are skipped entirely. Only safe when no clause touches UNmatched
+    # target rows.
+    scan_pred = None
+    if not not_matched_by_source:
+        scan_pred = _source_key_bounds(t_keys, s_keys, source)
+    candidates = txn.scan_files(filter=scan_pred)
+    metrics.num_target_files_scanned = len(candidates)
 
     # ---- load target rows with provenance ----
     file_tables = []
@@ -302,6 +350,12 @@ def _execute_merge(
             sdf = pd.DataFrame({k: source.column(k).to_pandas() for k in s_keys})
             tdf["__tpos"] = np.arange(len(tdf))
             sdf["__spos"] = np.arange(len(sdf))
+            # SQL equi-join semantics: NULL keys never match. pandas
+            # would happily join NaN==NaN, which both diverges from the
+            # reference and breaks the NULL assumption the source-bounds
+            # pruning relies on — drop NULL-key rows from both sides.
+            tdf = tdf.dropna(subset=t_keys)
+            sdf = sdf.dropna(subset=s_keys)
             joined = tdf.merge(
                 sdf, left_on=t_keys, right_on=s_keys, how="inner", suffixes=("", "_s")
             )

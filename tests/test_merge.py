@@ -248,3 +248,60 @@ def test_merge_evolution_explicit_assignment_to_new_column(tmp_table_path):
     out = dta.read_table(tmp_table_path)
     assert dict(zip(out.column("id").to_pylist(),
                     out.column("extra").to_pylist())) == {1: None, 2: "x"}
+
+
+def test_merge_prunes_target_files_by_source_bounds(tmp_table_path):
+    """Equi-key source bounds prune target files (dynamic pruning); with
+    a not-matched-by-source clause the whole table must be scanned."""
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array(np.arange(0, 100, dtype=np.int64)),
+         "v": pa.array(np.zeros(100))}), target_rows_per_file=10)
+    src = pa.table({"id": pa.array([5], pa.int64()),
+                    "v": pa.array([9.0])})
+    m = (merge(Table.for_path(tmp_table_path), src,
+               on=col("target.id") == col("source.id"))
+         .when_matched_update_all()
+         .execute())
+    assert m.num_target_files_scanned == 1  # 10 files, bounds hit one
+    assert m.num_target_rows_updated == 1
+
+    m2 = (merge(Table.for_path(tmp_table_path), src,
+                on=col("target.id") == col("source.id"))
+          .when_matched_update_all()
+          .when_not_matched_by_source_update(set={"v": lit(-1.0)},
+                                             condition=col("target.id") >= lit(95))
+          .execute())
+    assert m2.num_target_files_scanned >= 10  # no pruning allowed
+    out = dta.read_table(tmp_table_path)
+    vals = dict(zip(out.column("id").to_pylist(), out.column("v").to_pylist()))
+    assert vals[5] == 9.0 and vals[99] == -1.0 and vals[0] == 0.0
+
+
+def test_merge_null_keys_never_match(tmp_table_path):
+    """SQL equi-join semantics: NULL join keys match nothing, with or
+    without source-bounds pruning."""
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([None, None], pa.int64()),
+         "v": pa.array([1.0, 2.0])}))
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([5], pa.int64()), "v": pa.array([3.0])}),
+        mode="append")
+    src = pa.table({"id": pa.array([None, 5], pa.int64()),
+                    "v": pa.array([9.0, 9.0])})
+
+    def run(extra_nmbs):
+        b = (merge(Table.for_path(tmp_table_path), src,
+                   on=col("target.id") == col("source.id"))
+             .when_matched_update_all())
+        if extra_nmbs:  # disables pruning without changing any row
+            b = b.when_not_matched_by_source_update(
+                set={"v": lit(99.0)}, condition=col("target.v") > lit(1e9))
+        return b.execute()
+
+    m1 = run(False)
+    assert m1.num_target_rows_updated == 1  # only id=5; NULLs untouched
+    m2 = run(True)
+    assert m2.num_target_rows_updated == 1  # identical without pruning
+    out = dta.read_table(tmp_table_path)
+    vals = sorted(out.column("v").to_pylist())
+    assert vals == [1.0, 2.0, 9.0]
