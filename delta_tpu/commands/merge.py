@@ -143,13 +143,18 @@ def _source_key_bounds(t_keys: List[str], s_keys: List[str],
             continue
         if col_arr.null_count == len(col_arr):
             continue
+        if pa.types.is_floating(col_arr.type):
+            flat = (col_arr.combine_chunks()
+                    if isinstance(col_arr, pa.ChunkedArray) else col_arr)
+            if pc.any(pc.is_nan(pc.drop_null(flat))).as_py():
+                # NaN source keys CAN match NaN target rows (Spark
+                # NaN = NaN is true), but min_max skips NaNs — a range
+                # bound would wrongly prune all-NaN target files
+                continue
         mm = pc.min_max(col_arr)
         mn, mx = mm["min"].as_py(), mm["max"].as_py()
         if mn is None or mx is None:
             continue
-        if (isinstance(mn, float) and mn != mn) or \
-                (isinstance(mx, float) and mx != mx):
-            continue  # NaN bounds prune incorrectly; skip this key
         target_col = Column((t_key,))
         conjuncts.append(Comparison(">=", target_col, Literal(mn)))
         conjuncts.append(Comparison("<=", target_col, Literal(mx)))
@@ -331,9 +336,17 @@ def _execute_merge(
     metrics.num_target_files_scanned = len(candidates)
 
     # ---- load target rows with provenance ----
+    from delta_tpu.commands.dml import _existing_dv_mask
+
     file_tables = []
     for fi, add in enumerate(candidates):
         t = _read_file_with_partitions(table, snapshot, add)
+        dv_mask = _existing_dv_mask(table, add, t.num_rows)
+        if dv_mask is not None:
+            # rows already soft-deleted by a deletion vector are not part
+            # of the table: they must neither match nor be copied into
+            # rewritten files (resurrection)
+            t = t.filter(pa.array(~dv_mask))
         t = t.append_column("__file", pa.array(np.full(t.num_rows, fi, np.int64)))
         t = t.append_column("__row", pa.array(np.arange(t.num_rows, dtype=np.int64)))
         file_tables.append(t)
@@ -346,16 +359,26 @@ def _execute_merge(
     # ---- join ----
     if target_all is not None and target_all.num_rows and source.num_rows:
         if t_keys:
+            import pyarrow.compute as _pc
+
             tdf = pd.DataFrame({k: target_all.column(k).to_pandas() for k in t_keys})
             sdf = pd.DataFrame({k: source.column(k).to_pandas() for k in s_keys})
             tdf["__tpos"] = np.arange(len(tdf))
             sdf["__spos"] = np.arange(len(sdf))
-            # SQL equi-join semantics: NULL keys never match. pandas
-            # would happily join NaN==NaN, which both diverges from the
-            # reference and breaks the NULL assumption the source-bounds
-            # pruning relies on — drop NULL-key rows from both sides.
-            tdf = tdf.dropna(subset=t_keys)
-            sdf = sdf.dropna(subset=s_keys)
+            # SQL equi-join semantics: NULL keys never match — but real
+            # float NaN keys DO (Spark treats NaN = NaN as true). Drop
+            # only genuinely-NULL rows, using Arrow validity (after
+            # to_pandas, NULL and NaN are indistinguishable).
+            t_null = np.zeros(len(tdf), dtype=bool)
+            for k in t_keys:
+                t_null |= np.asarray(_pc.is_null(
+                    target_all.column(k).combine_chunks()))
+            s_null = np.zeros(len(sdf), dtype=bool)
+            for k in s_keys:
+                s_null |= np.asarray(_pc.is_null(
+                    source.column(k).combine_chunks()))
+            tdf = tdf[~t_null]
+            sdf = sdf[~s_null]
             joined = tdf.merge(
                 sdf, left_on=t_keys, right_on=s_keys, how="inner", suffixes=("", "_s")
             )

@@ -91,8 +91,28 @@ def test_random_op_sequence_engines_agree(tmp_table_path, seed, variant):
     def do_checkpoint():
         Table.for_path(tmp_table_path).checkpoint()
 
+    def do_merge():
+        nonlocal next_id
+        from delta_tpu.commands.merge import merge as _merge
+
+        n_upd = int(rng.integers(1, 6))
+        upd_ids = [int(rng.integers(0, next_id)) for _ in range(n_upd)]
+        new_ids = [next_id, next_id + 1]
+        next_id += 2
+        ids = sorted(set(upd_ids)) + new_ids
+        vals = [int(rng.integers(0, 1000)) for _ in ids]
+        (_merge(Table.for_path(tmp_table_path), batch(ids, vals),
+                on=col("target.id") == col("source.id"))
+         .when_matched_update_all()
+         .when_not_matched_insert_all()
+         .execute())
+        # every source row lands: matched -> updated, unmatched
+        # (including previously-deleted ids) -> inserted
+        for i, v in zip(ids, vals):
+            model[i] = v
+
     ops = [do_append, do_append, do_delete, do_update, do_optimize,
-           do_checkpoint]
+           do_checkpoint, do_merge]
     dta.write_table(tmp_table_path, batch([0], [0]), properties=props)
     model[0] = 0
     next_id = 1

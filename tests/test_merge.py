@@ -305,3 +305,22 @@ def test_merge_null_keys_never_match(tmp_table_path):
     out = dta.read_table(tmp_table_path)
     vals = sorted(out.column("v").to_pylist())
     assert vals == [1.0, 2.0, 9.0]
+
+
+def test_merge_nan_keys_match_null_keys_dont(tmp_table_path):
+    """Spark semantics: NaN = NaN is TRUE in joins, NULL matches nothing."""
+    dta.write_table(tmp_table_path, pa.table(
+        {"k": pa.array([float("nan"), None, 1.0], pa.float64()),
+         "v": pa.array([10.0, 20.0, 30.0])}))
+    src = pa.table({"k": pa.array([float("nan"), None], pa.float64()),
+                    "v": pa.array([99.0, 88.0])})
+    m = (merge(Table.for_path(tmp_table_path), src,
+               on=col("target.k") == col("source.k"))
+         .when_matched_update_all()
+         .when_not_matched_insert_all()
+         .execute())
+    assert m.num_target_rows_updated == 1   # the NaN row
+    assert m.num_target_rows_inserted == 1  # the NULL source row
+    out = dta.read_table(tmp_table_path)
+    vals = sorted(out.column("v").to_pylist())
+    assert vals == [20.0, 30.0, 88.0, 99.0]
