@@ -31,6 +31,10 @@ Query/DML (paths or names):
     INSERT OVERWRITE <t> [(cols)] [REPLACE WHERE <pred>] VALUES (...)
     DELETE FROM <t> [WHERE <pred>]
     UPDATE <t> SET col = <literal>[, ...] [WHERE <pred>]
+    MERGE INTO <t> [AS a] USING <t2> [AS b] ON <cond>
+        WHEN MATCHED [AND c] THEN UPDATE SET ... | UPDATE SET * | DELETE
+        WHEN NOT MATCHED [AND c] THEN INSERT * | INSERT (cols) VALUES (...)
+        WHEN NOT MATCHED BY SOURCE [AND c] THEN DELETE | UPDATE SET ...
 
 `<t>` = '/path', delta.`/path`, "/path", or a bare identifier resolved
 through the catalog. Returns command-specific results (VacuumResult,
@@ -348,6 +352,9 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
 
         return generate_symlink_manifest(_table(m, engine, catalog))
 
+    if re.match(r"MERGE\s+INTO\s+", s, re.IGNORECASE):
+        return _handle_merge_into(s, engine, catalog)
+
     m = re.fullmatch(
         rf"DELETE\s+FROM\s+{_PATH}(?:\s+WHERE\s+(?P<where>.+))?",
         s, re.IGNORECASE,
@@ -601,6 +608,161 @@ def _query_statement(s: str, engine, catalog):
                                engine=table.engine)
 
     return NotImplemented
+
+
+def _handle_merge_into(s: str, engine, catalog):
+    """MERGE INTO <t> [AS a] USING <t2> [AS b] ON <cond> WHEN ... —
+    the reference's SQL MERGE surface, parsed stepwise so table tokens,
+    aliases, and quote-embedded keywords all resolve safely."""
+    head = re.match(r"MERGE\s+INTO\s+", s, re.IGNORECASE)
+    if not head:
+        return NotImplemented
+
+    def take_table(text):
+        m = re.match(_PATH, text)
+        if not m:
+            raise DeltaError(f"cannot parse table reference near {text[:40]!r}")
+        return m, text[m.end():].lstrip()
+
+    def take_alias(text):
+        m = re.match(r"(?:AS\s+)?([A-Za-z_][A-Za-z0-9_]*)\s+", text,
+                     re.IGNORECASE)
+        if m and m.group(1).upper() not in ("USING", "ON", "WHEN"):
+            return m.group(1), text[m.end():]
+        return None, text
+
+    rest = s[head.end():]
+    t_m, rest = take_table(rest)
+    alias_t, rest = take_alias(rest)
+    um = re.match(r"USING\s+", rest, re.IGNORECASE)
+    if not um:
+        raise DeltaError("MERGE INTO requires a USING clause")
+    s_m, rest = take_table(rest[um.end():])
+    alias_s, rest = take_alias(rest)
+    onm = re.match(r"ON\s+", rest, re.IGNORECASE)
+    if not onm:
+        raise DeltaError("MERGE INTO requires an ON condition")
+    on_text, rest = _split_before_keyword(rest[onm.end():], "WHEN")
+    if rest is None:
+        raise DeltaError("MERGE INTO requires at least one WHEN clause")
+
+    # split the WHEN clauses at top level
+    clause_texts = []
+    while rest is not None:
+        rest = rest[len("WHEN"):].strip() if rest[:4].upper() == "WHEN" \
+            else rest
+        body, rest = _split_before_keyword(rest, "WHEN")
+        clause_texts.append(body.strip())
+
+    from delta_tpu.commands.merge import merge as _merge
+    from delta_tpu.expressions.tree import Column as _Col
+
+    def requalify(expr):
+        """Rewrite alias roots onto the merge namespace at the TREE
+        level (string literals are untouched by construction): every
+        Expression node's children live in Expression-typed dataclass
+        fields, so a generic dataclasses.replace rebuild is exact."""
+        import dataclasses as _dc
+
+        from delta_tpu.expressions.tree import Expression as _Expr
+
+        if isinstance(expr, _Col):
+            root = expr.name_path[0]
+            if alias_t is not None and root == alias_t:
+                return _Col(("target",) + tuple(expr.name_path[1:]))
+            if alias_s is not None and root == alias_s:
+                return _Col(("source",) + tuple(expr.name_path[1:]))
+            return expr
+        if not expr.children():
+            return expr
+        updates = {}
+        for f in _dc.fields(expr):
+            v = getattr(expr, f.name)
+            if isinstance(v, _Expr):
+                nv = requalify(v)
+                if nv is not v:
+                    updates[f.name] = nv
+        return _dc.replace(expr, **updates) if updates else expr
+
+    target_table = _table(t_m, engine, catalog)
+    source_table = _table(s_m, engine, catalog)
+    source_data = source_table.latest_snapshot().scan().to_arrow()
+    on_expr = requalify(parse_expression(on_text.strip()))
+    builder = _merge(target_table, source_data, on=on_expr)
+
+    def parse_sets(text):
+        out = {}
+        for part in _split_top_level_commas(text):
+            lhs, _, rhs = part.partition("=")
+            name = lhs.strip().strip("`")
+            for pre in (f"{alias_t}." if alias_t else None, "target."):
+                if pre and name.startswith(pre):
+                    name = name[len(pre):]
+            out[name] = requalify(parse_expression(rhs.strip()))
+        return out
+
+    for text in clause_texts:
+        # split the condition from the action at a quote-safe THEN, so a
+        # literal like 'a THEN b' inside the AND condition parses
+        before_then, from_then = _split_before_keyword(text, "THEN")
+        if from_then is None:
+            raise DeltaError(f"cannot parse MERGE clause: {text[:60]!r}")
+        km = re.match(
+            r"(?P<kind>MATCHED|NOT\s+MATCHED\s+BY\s+SOURCE|NOT\s+MATCHED)"
+            r"(?:\s+AND\s+(?P<cond>.+))?\s*$",
+            before_then.strip(), re.IGNORECASE | re.DOTALL)
+        if not km:
+            raise DeltaError(f"cannot parse MERGE clause: {text[:60]!r}")
+        kind = re.sub(r"\s+", " ", km.group("kind").upper())
+        cond = (requalify(parse_expression(km.group("cond").strip()))
+                if km.group("cond") else None)
+        action = from_then[len("THEN"):].strip()
+        # keyword comparisons are whitespace-normalized (formatted SQL
+        # uses newlines/extra spaces); the SET payload keeps its text
+        a_up = re.sub(r"\s+", " ", action.upper())
+        if kind == "MATCHED":
+            if a_up == "DELETE":
+                builder = builder.when_matched_delete(condition=cond)
+            elif a_up in ("UPDATE SET *", "UPDATE *"):
+                builder = builder.when_matched_update_all(condition=cond)
+            elif a_up.startswith("UPDATE SET"):
+                builder = builder.when_matched_update(
+                    set=parse_sets(re.sub(r"^UPDATE\s+SET\s*", "",
+                                        action, flags=re.IGNORECASE)),
+                    condition=cond)
+            else:
+                raise DeltaError(f"unsupported MATCHED action {action!r}")
+        elif kind == "NOT MATCHED":
+            if a_up in ("INSERT *",):
+                builder = builder.when_not_matched_insert_all(condition=cond)
+            else:
+                im = re.match(r"INSERT\s*\((?P<cols>[^)]+)\)\s*VALUES\s*"
+                              r"\((?P<vals>.+)\)\s*$", action,
+                              re.IGNORECASE | re.DOTALL)
+                if not im:
+                    raise DeltaError(
+                        f"unsupported NOT MATCHED action {action!r}")
+                cols = [c.strip().strip("`")
+                        for c in im.group("cols").split(",")]
+                vals = [requalify(parse_expression(v.strip()))
+                        for v in _split_top_level_commas(im.group("vals"))]
+                if len(cols) != len(vals):
+                    raise DeltaError("INSERT column/value count mismatch")
+                builder = builder.when_not_matched_insert(
+                    values=dict(zip(cols, vals)), condition=cond)
+        else:  # NOT MATCHED BY SOURCE
+            if a_up == "DELETE":
+                builder = builder.when_not_matched_by_source_delete(
+                    condition=cond)
+            elif a_up.startswith("UPDATE SET"):
+                builder = builder.when_not_matched_by_source_update(
+                    set=parse_sets(re.sub(r"^UPDATE\s+SET\s*", "",
+                                        action, flags=re.IGNORECASE)),
+                    condition=cond)
+            else:
+                raise DeltaError(
+                    f"unsupported NOT MATCHED BY SOURCE action {action!r}")
+    return builder.execute()
 
 
 def _timestamp_ms(raw: str) -> int:

@@ -442,3 +442,70 @@ def test_timestamp_parse_errors_cleanly(tmp_path):
     dta.write_table(p, pa.table({"v": pa.array([1], pa.int64())}))
     with pytest.raises(DeltaError, match="cannot parse timestamp"):
         sql(f"SELECT * FROM '{p}' TIMESTAMP AS OF '01/02/2024'")
+
+
+def test_merge_into_sql(tmp_path):
+    import os
+
+    from delta_tpu.sql import sql
+
+    tgt = os.path.join(str(tmp_path), "tgt")
+    src = os.path.join(str(tmp_path), "src")
+    dta.write_table(tgt, pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                                   "v": pa.array([10, 20, 30], pa.int64())}))
+    dta.write_table(src, pa.table({"id": pa.array([2, 3, 4], pa.int64()),
+                                   "v": pa.array([99, 99, 99], pa.int64())}))
+    m = sql(f"MERGE INTO '{tgt}' AS t USING '{src}' AS s ON t.id = s.id "
+            "WHEN MATCHED AND s.v > 0 THEN UPDATE SET v = s.v "
+            "WHEN NOT MATCHED THEN INSERT * "
+            "WHEN NOT MATCHED BY SOURCE THEN DELETE")
+    assert m.num_target_rows_updated == 2
+    assert m.num_target_rows_inserted == 1
+    assert m.num_target_rows_deleted == 1
+    out = dta.read_table(tgt)
+    rows = sorted(zip(out.column("id").to_pylist(), out.column("v").to_pylist()))
+    assert rows == [(2, 99), (3, 99), (4, 99)]
+
+
+def test_merge_into_sql_explicit_insert_and_delete(tmp_path):
+    import os
+
+    from delta_tpu.sql import sql
+
+    tgt = os.path.join(str(tmp_path), "tgt2")
+    src = os.path.join(str(tmp_path), "src2")
+    dta.write_table(tgt, pa.table({"id": pa.array([1, 2], pa.int64()),
+                                   "name": pa.array(["a", "b"])}))
+    dta.write_table(src, pa.table({"id": pa.array([2, 9], pa.int64()),
+                                   "name": pa.array(["B when matched", "n9"])}))
+    m = sql(f"MERGE INTO '{tgt}' USING '{src}' AS s ON target.id = s.id "
+            "WHEN MATCHED THEN DELETE "
+            "WHEN NOT MATCHED THEN INSERT (id, name) VALUES (s.id, s.name)")
+    assert m.num_target_rows_deleted == 1 and m.num_target_rows_inserted == 1
+    out = dta.read_table(tgt)
+    rows = sorted(zip(out.column("id").to_pylist(),
+                      out.column("name").to_pylist()))
+    assert rows == [(1, "a"), (9, "n9")]
+
+
+def test_merge_into_sql_formatting_and_literals(tmp_path):
+    import os
+
+    from delta_tpu.sql import sql
+
+    tgt = os.path.join(str(tmp_path), "t3")
+    src = os.path.join(str(tmp_path), "s3")
+    dta.write_table(tgt, pa.table({"id": pa.array([1], pa.int64()),
+                                   "note": pa.array(["x"])}))
+    dta.write_table(src, pa.table({"id": pa.array([1, 2], pa.int64()),
+                                   "note": pa.array(["a THEN b", "n2"])}))
+    # literal containing THEN + newlines/extra whitespace in keywords
+    m = sql(f"""MERGE INTO '{tgt}' AS t USING '{src}' AS s ON t.id = s.id
+                WHEN MATCHED AND s.note = 'a THEN b' THEN UPDATE
+                  SET note = s.note
+                WHEN NOT MATCHED THEN INSERT  *""")
+    assert m.num_target_rows_updated == 1 and m.num_target_rows_inserted == 1
+    out = dta.read_table(tgt)
+    rows = sorted(zip(out.column("id").to_pylist(),
+                      out.column("note").to_pylist()))
+    assert rows == [(1, "a THEN b"), (2, "n2")]
