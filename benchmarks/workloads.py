@@ -283,7 +283,8 @@ class TpcdsLiteBenchmark(Benchmark):
     name = "tpcds_lite"
 
     FACT_ROWS = {"smoke": 50_000, "small": 1_000_000,
-                 "medium": 10_000_000, "full": 50_000_000}
+                 "medium": 10_000_000, "large": 25_000_000,
+                 "full": 50_000_000}
 
     def run(self):
         import delta_tpu.api as dta

@@ -211,6 +211,8 @@ def _eval_values(
         s_struct_all = batch.column("source").combine_chunks()
         by_lower_all = {sn.lower(): sn for sn in s_struct_all.type.names}
     else:
+        # case-collision duplicates are rejected at analysis time in
+        # _execute_merge, so lower-casing here cannot silently collapse
         amap = {k.lower(): v for k, v in assignments.items()}
     for f in target_schema:
         if assignments is not None and f.name.lower() in amap:
@@ -267,6 +269,18 @@ def _execute_merge(
     # explicit assignments targeting unknown columns. Without
     # with_schema_evolution() both are errors (never silent drops).
     target_by_lower = {f.name.lower() for f in schema.fields}
+    # duplicate assignments (incl. case-only collisions) are an analysis
+    # error regardless of whether any row reaches the clause
+    for c in (matched + not_matched + not_matched_by_source):
+        if not c.assignments:
+            continue
+        seen: set = set()
+        for k in c.assignments:
+            if k.lower() in seen:
+                raise DeltaError(
+                    f"duplicate assignment for column '{k}' in MERGE clause"
+                )
+            seen.add(k.lower())
     extra_cols = [c for c in source.column_names
                   if c.lower() not in target_by_lower]
     has_star = any(c.assignments is None and c.kind != "delete"

@@ -170,6 +170,10 @@ def _stats_parsed_schema(schema, configuration,
         fields.append(pa.field("maxValues", to_struct(minmax_tree)))
     if null_tree:
         fields.append(pa.field("nullCount", to_struct(null_tree)))
+    # DV-capable writers mark whether min/max reflect the post-delete
+    # rows; without this field in the explicit schema a struct-only
+    # checkpoint round-trip would silently drop it
+    fields.append(pa.field("tightBounds", pa.bool_()))
     return pa.schema(fields)
 
 

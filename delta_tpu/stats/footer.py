@@ -69,7 +69,7 @@ def footer_stats(
         nulls = 0
         mins: list = []
         maxs: list = []
-        exact_max = True
+        max_dropped = False
         usable = md.num_row_groups > 0
         for g in range(md.num_row_groups):
             col = md.row_group(g).column(j)
@@ -85,10 +85,31 @@ def footer_stats(
                 continue
             if mins is None:
                 continue
-            mins.append(st.min)
-            maxs.append(st.max)
+            gmin, gmax = st.min, st.max
+            if isinstance(gmin, bytes) or isinstance(gmax, bytes):
+                # UTF-8 byte order == code-point order, so decoding before
+                # aggregation preserves min/max
+                try:
+                    gmin = gmin.decode("utf-8") if isinstance(gmin, bytes) else gmin
+                    gmax = gmax.decode("utf-8") if isinstance(gmax, bytes) else gmax
+                except UnicodeDecodeError:
+                    mins = maxs = None  # type: ignore[assignment]
+                    continue
+            mins.append(gmin)
             if getattr(st, "is_max_value_exact", True) is False:
-                exact_max = False
+                # this group's footer max is a truncated prefix of its real
+                # max — a LOWER bound, not an upper bound. Bump it above
+                # everything sharing the prefix BEFORE aggregating, so every
+                # element of maxs is a true upper bound of its group (an
+                # aggregated-then-bumped max can undershoot another group's
+                # exact max that extends the same prefix).
+                bumped = bump_string(gmax) if isinstance(gmax, str) else None
+                if bumped is None:
+                    max_dropped = True
+                else:
+                    maxs.append(bumped)
+            else:
+                maxs.append(gmax)
         if not usable:
             continue
         _set_nested(null_d, path, int(nulls))
@@ -96,30 +117,17 @@ def footer_stats(
             continue
         try:
             mn = min(mins)
-            mx = max(maxs)
+            mx = max(maxs) if maxs and not max_dropped else None
         except TypeError:
             continue  # incomparable physical values — skip min/max
         if isinstance(mn, float) or isinstance(mx, float):
             continue  # NaN ordering is writer-dependent; never trust
-        if isinstance(mn, bytes) or isinstance(mx, bytes):
-            try:
-                mn = mn.decode("utf-8") if isinstance(mn, bytes) else mn
-                mx = mx.decode("utf-8") if isinstance(mx, bytes) else mx
-            except UnicodeDecodeError:
-                continue
         if isinstance(mn, str):
             mn = _truncate_min(mn)
-            if not exact_max:
-                # the footer max is a truncated prefix of the real max —
-                # a LOWER bound of it, not an upper bound of the column;
-                # bump it above everything sharing the prefix first
-                mx = bump_string(mx)
             mx = _truncate_max(mx) if mx is not None else None
-            if mx is None:
-                _set_nested(min_d, path, _json_value(mn))
-                continue
         _set_nested(min_d, path, _json_value(mn))
-        _set_nested(max_d, path, _json_value(mx))
+        if mx is not None:
+            _set_nested(max_d, path, _json_value(mx))
 
     if min_d:
         stats["minValues"] = min_d

@@ -507,3 +507,34 @@ def test_column_mapping_id_mode_roundtrip(tmp_path):
     phys = {f.name: f.metadata.get("delta.columnMapping.physicalName")
             for f in snap.schema.fields}
     assert all(v for v in phys.values())
+
+
+def test_stats_struct_checkpoint_preserves_tight_bounds(tmp_path):
+    """tightBounds written by a DV-capable foreign engine must survive a
+    writeStatsAsJson=false checkpoint round-trip through stats_parsed."""
+    import delta_tpu.api as dta
+    import numpy as np
+    import pyarrow as pa
+
+    path = str(tmp_path / "t")
+    props = {"delta.checkpoint.writeStatsAsJson": "false",
+             "delta.checkpoint.writeStatsAsStruct": "true"}
+    dta.write_table(path, pa.table(
+        {"x": pa.array(np.arange(10, dtype=np.int64))}), properties=props)
+    commit = os.path.join(path, "_delta_log", "%020d.json" % 0)
+    out_lines = []
+    with open(commit) as f:
+        for ln in f.read().splitlines():
+            d = json.loads(ln)
+            if "add" in d and d["add"].get("stats"):
+                st = json.loads(d["add"]["stats"])
+                st["tightBounds"] = True
+                d["add"]["stats"] = json.dumps(st, separators=(",", ":"))
+            out_lines.append(json.dumps(d, separators=(",", ":")))
+    with open(commit, "w") as f:
+        f.write("\n".join(out_lines) + "\n")
+    Table.for_path(path).checkpoint()
+    snap = Table.for_path(path).latest_snapshot()
+    stats = [json.loads(s) for s in
+             snap.state.add_files_table.column("stats").to_pylist() if s]
+    assert stats and all(s.get("tightBounds") is True for s in stats)

@@ -324,3 +324,26 @@ def test_merge_nan_keys_match_null_keys_dont(tmp_table_path):
     out = dta.read_table(tmp_table_path)
     vals = sorted(out.column("v").to_pylist())
     assert vals == [20.0, 30.0, 88.0, 99.0]
+
+
+def test_merge_duplicate_assignment_differing_case_raises(target_path):
+    """Two explicit SET assignments differing only in case are one
+    duplicate assignment (the reference analyzer rejects them), not a
+    silent last-wins collapse."""
+    table = Table.for_path(target_path)
+    src = _source([3], [300.0])
+    m = (
+        merge(table, src, on=col("target.id") == col("source.id"))
+        .when_matched_update(set={"value": lit(1.0), "VALUE": lit(2.0)})
+    )
+    with pytest.raises(DeltaError, match="duplicate assignment"):
+        m.execute()
+    # analysis-time error: raised even when no row reaches the clause
+    src_nomatch = _source([999], [1.0])
+    m = (
+        merge(Table.for_path(target_path), src_nomatch,
+              on=col("target.id") == col("source.id"))
+        .when_matched_update(set={"value": lit(1.0), "VALUE": lit(2.0)})
+    )
+    with pytest.raises(DeltaError, match="duplicate assignment"):
+        m.execute()

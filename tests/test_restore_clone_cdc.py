@@ -148,3 +148,107 @@ def test_cdc_requires_flag(tmp_table_path):
     dta.write_table(tmp_table_path, _batch(0, 4))
     with pytest.raises(DeltaError):
         table_changes(Table.for_path(tmp_table_path), 0)
+
+
+def test_footer_stats_truncated_max_bumped_per_group(monkeypatch):
+    """An inexact (truncated) row-group max is a lower bound of that
+    group's real max, so it must be bumped per group BEFORE aggregation:
+    trunc 'ab' (real max 'abz') alongside an exact 'abc' must still
+    yield a column max >= 'abz' (bump-after-aggregate gives 'abd')."""
+    import json
+
+    import pyarrow.parquet as _pq
+
+    from delta_tpu.models.schema import PrimitiveType, StructField, StructType
+    from delta_tpu.stats.footer import footer_stats
+
+    class _Stats:
+        def __init__(self, mn, mx, exact):
+            self.min, self.max = mn, mx
+            self.null_count = 0
+            self.has_min_max = True
+            self.is_max_value_exact = exact
+
+    class _Col:
+        path_in_schema = "s"
+        num_values = 5
+
+        def __init__(self, st):
+            self.statistics = st
+
+    class _RG:
+        num_columns = 1
+
+        def __init__(self, st):
+            self._c = _Col(st)
+
+        def column(self, j):
+            return self._c
+
+    class _MD:
+        num_rows = 10
+        num_row_groups = 2
+        _groups = [_RG(_Stats(b"aa", b"ab", False)),   # real max 'abz'
+                   _RG(_Stats(b"aa", b"abc", True))]
+
+        def row_group(self, g):
+            return self._groups[g]
+
+    class _FakePF:
+        def __init__(self, path):
+            self.metadata = _MD()
+
+    monkeypatch.setattr(_pq, "ParquetFile", _FakePF)
+    schema = StructType([StructField("s", PrimitiveType("string"), True)])
+    doc = json.loads(footer_stats("ignored", schema, {}, []))
+    assert doc["maxValues"]["s"] >= "abz"
+    assert doc["minValues"]["s"] == "aa"
+
+
+def test_footer_stats_unbumpable_truncated_max_drops_max(monkeypatch):
+    """If a truncated group max cannot be bumped (all U+10FFFF), the
+    column max is dropped entirely while min and nullCount survive."""
+    import json
+
+    import pyarrow.parquet as _pq
+
+    from delta_tpu.models.schema import PrimitiveType, StructField, StructType
+    from delta_tpu.stats.footer import footer_stats
+
+    top = chr(0x10FFFF) * 3
+
+    class _Stats:
+        min = "aa"
+        max = top
+        null_count = 0
+        has_min_max = True
+        is_max_value_exact = False
+
+    class _Col:
+        path_in_schema = "s"
+        num_values = 5
+        statistics = _Stats()
+
+    class _RG:
+        num_columns = 1
+
+        def column(self, j):
+            return _Col()
+
+    class _MD:
+        num_rows = 5
+        num_row_groups = 1
+
+        def row_group(self, g):
+            return _RG()
+
+    class _FakePF:
+        def __init__(self, path):
+            self.metadata = _MD()
+
+    monkeypatch.setattr(_pq, "ParquetFile", _FakePF)
+    schema = StructType([StructField("s", PrimitiveType("string"), True)])
+    doc = json.loads(footer_stats("ignored", schema, {}, []))
+    assert "s" not in doc.get("maxValues", {})
+    assert doc["minValues"]["s"] == "aa"
+    assert doc["nullCount"]["s"] == 0
