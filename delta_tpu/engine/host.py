@@ -97,6 +97,13 @@ class HostFileSystemClient(FileSystemClient):
     def resolve_path(self, path: str) -> str:
         return path
 
+    def os_path(self, path: str):
+        from delta_tpu.storage.logstore import LocalLogStore
+
+        if not isinstance(self._store_for(path), LocalLogStore):
+            return None
+        return path[len("file://"):] if path.startswith("file://") else path
+
     def mkdirs(self, path: str) -> None:
         self._store_for(path).mkdirs(path)
 

@@ -66,6 +66,12 @@ class FileSystemClient:
     def resolve_path(self, path: str) -> str:
         raise NotImplementedError
 
+    def os_path(self, path: str) -> "str | None":
+        """An operating-system path for `path` when it is directly
+        readable from the local filesystem (lets native components
+        bypass per-file interpreter I/O), else None."""
+        return None
+
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
 

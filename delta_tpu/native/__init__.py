@@ -120,6 +120,18 @@ def load(allow_compile: bool = True) -> Optional[ctypes.CDLL]:
         lib.fae_n.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.fae_ptr.restype = ctypes.c_void_p
         lib.fae_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dar_read.restype = ctypes.c_void_p
+        lib.dar_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                 ctypes.c_int32]
+        lib.dar_free.argtypes = [ctypes.c_void_p]
+        lib.dar_error.restype = ctypes.c_int32
+        lib.dar_error.argtypes = [ctypes.c_void_p]
+        lib.dar_len.restype = ctypes.c_int64
+        lib.dar_len.argtypes = [ctypes.c_void_p]
+        lib.dar_buf.restype = ctypes.c_void_p
+        lib.dar_buf.argtypes = [ctypes.c_void_p]
+        lib.dar_starts.restype = ctypes.c_void_p
+        lib.dar_starts.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return _LIB
 
@@ -166,36 +178,53 @@ class ScanResult:
                 valid = col(valid_which, count, np.uint8).astype(bool)
             return offsets, arena, valid
 
+        n_uniq = self.n_uniq = int(lib.das_n(h, 4))
+        n_refs = self.n_refs = int(lib.das_n(h, 5))
         self.line_no = col(0, n, np.int64)
         self.is_add = col(1, n, np.uint8).astype(bool)
-        self.path = strcol(2, 4, 4, n)
-        self.pv_offsets = col(5, n + 1, np.int32)
-        self.pv_valid = col(6, n, np.uint8).astype(bool)
-        self.pv_key = strcol(7, 5, None, n_pv)
-        self.pv_val = strcol(9, 6, 11, n_pv)
-        self.size = (col(12, n, np.int64), col(13, n, np.uint8).astype(bool))
-        self.mod_time = (col(14, n, np.int64), col(15, n, np.uint8).astype(bool))
-        self.data_change = (col(16, n, np.uint8).astype(bool),
-                            col(17, n, np.uint8).astype(bool))
-        self.stats = strcol(18, 7, 20, n)
-        self.tags = strcol(21, 8, 23, n)
-        self.dv_valid = col(24, n, np.uint8).astype(bool)
-        self.dv_storage = strcol(25, 9, 27, n)
-        self.dv_pathinline = strcol(28, 10, 30, n)
-        self.dv_offset = (col(31, n, np.int32), col(32, n, np.uint8).astype(bool))
-        self.dv_size = (col(33, n, np.int32), col(34, n, np.uint8).astype(bool))
-        self.dv_card = (col(35, n, np.int64), col(36, n, np.uint8).astype(bool))
-        self.dv_maxrow = (col(37, n, np.int64), col(38, n, np.uint8).astype(bool))
-        self.base_row_id = (col(39, n, np.int64), col(40, n, np.uint8).astype(bool))
-        self.drcv = (col(41, n, np.int64), col(42, n, np.uint8).astype(bool))
-        self.clustering = strcol(43, 11, 45, n)
-        self.del_ts = (col(46, n, np.int64), col(47, n, np.uint8).astype(bool))
-        self.ext_meta = (col(48, n, np.uint8).astype(bool),
-                         col(49, n, np.uint8).astype(bool))
-        self.other_line_no = col(50, n_oth, np.int64)
-        self.other_start = col(51, n_oth, np.int64)
-        self.other_end = col(52, n_oth, np.int64)
-        self.line_starts = col(53, self.n_lines, np.int64)
+        # dictionary-encoded paths: per-row first-appearance codes plus
+        # the unique-path arena in code order; `path_new`/`refs` are the
+        # ready-made first-appearance delta encoding (ops/replay.py)
+        self.path_code = col(2, n, np.uint32)
+        self.path_new = col(3, n, np.uint8).astype(bool)
+        self.refs = col(4, n_refs, np.uint32)
+        self.uniq_offs = col(5, n_uniq + 1, np.uint32)
+        self.uniq_arena = col(6, int(lib.das_n(h, 6)), np.uint8)
+        self.pv_offsets = col(7, n + 1, np.int32)
+        self.pv_valid = col(8, n, np.uint8).astype(bool)
+        self.pv_key = strcol(9, 7, None, n_pv)
+        self.pv_val = strcol(11, 8, 13, n_pv)
+        self.size = (col(14, n, np.int64), col(15, n, np.uint8).astype(bool))
+        self.mod_time = (col(16, n, np.int64), col(17, n, np.uint8).astype(bool))
+        self.data_change = (col(18, n, np.uint8).astype(bool),
+                            col(19, n, np.uint8).astype(bool))
+        self.stats = strcol(20, 9, 22, n)
+        self.tags = strcol(23, 10, 25, n)
+        self.dv_valid = col(26, n, np.uint8).astype(bool)
+        self.dv_storage = strcol(27, 11, 29, n)
+        self.dv_pathinline = strcol(30, 12, 32, n)
+        self.dv_offset = (col(33, n, np.int32), col(34, n, np.uint8).astype(bool))
+        self.dv_size = (col(35, n, np.int32), col(36, n, np.uint8).astype(bool))
+        self.dv_card = (col(37, n, np.int64), col(38, n, np.uint8).astype(bool))
+        self.dv_maxrow = (col(39, n, np.int64), col(40, n, np.uint8).astype(bool))
+        self.base_row_id = (col(41, n, np.int64), col(42, n, np.uint8).astype(bool))
+        self.drcv = (col(43, n, np.int64), col(44, n, np.uint8).astype(bool))
+        self.clustering = strcol(45, 13, 47, n)
+        self.del_ts = (col(48, n, np.int64), col(49, n, np.uint8).astype(bool))
+        self.ext_meta = (col(50, n, np.uint8).astype(bool),
+                         col(51, n, np.uint8).astype(bool))
+        self.other_line_no = col(52, n_oth, np.int64)
+        self.other_start = col(53, n_oth, np.int64)
+        self.other_end = col(54, n_oth, np.int64)
+        self.line_starts = col(55, self.n_lines, np.int64)
+
+    def path_list(self) -> list:
+        """Per-row path strings (tests/small results; the hot path keeps
+        codes + the unique arena)."""
+        offs = self.uniq_offs
+        arena = self.uniq_arena.tobytes()
+        return [arena[offs[c]:offs[c + 1]].decode("utf-8")
+                for c in self.path_code]
 
 
 def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
@@ -226,6 +255,46 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
         return ScanResult(lib, h)
     finally:
         lib.das_free(h)
+
+
+def scan_commit_files(paths) -> Optional[tuple]:
+    """Read a list of LOCAL commit files and scan them in one native
+    round-trip (no per-file Python overhead, no buffer copy into the
+    interpreter). Returns (ScanResult, others_bytes, file_starts,
+    total_bytes) where others_bytes is the raw line bytes of each
+    non-file action (index-aligned with ScanResult.other_line_no), or
+    None when the library is unavailable or either step fails."""
+    lib = load()
+    if lib is None or not paths:
+        return None
+    blob = "".join(paths).encode("utf-8")
+    offs = np.zeros(len(paths) + 1, dtype=np.int64)
+    np.cumsum([len(p.encode("utf-8")) for p in paths], out=offs[1:])
+    rh = lib.dar_read(blob, offs.ctypes.data_as(ctypes.c_void_p), len(paths))
+    try:
+        if lib.dar_error(rh):
+            return None
+        total = int(lib.dar_len(rh))
+        buf_ptr = lib.dar_buf(rh)
+        starts = _np(lib, rh, 0, len(paths) + 1, np.int64,
+                     ptr_fn=lambda h, w: lib.dar_starts(h))
+        from delta_tpu.utils.threads import default_io_threads
+
+        sh = lib.das_scan(ctypes.cast(buf_ptr, ctypes.c_char_p), total,
+                          default_io_threads())
+        try:
+            if lib.das_error(sh):
+                return None
+            scan = ScanResult(lib, sh)
+        finally:
+            lib.das_free(sh)
+        # slice the non-file-action lines out while the buffer is alive
+        raw = (ctypes.c_char * total).from_address(buf_ptr) if total else b""
+        others = [bytes(raw[int(s):int(e)])
+                  for s, e in zip(scan.other_start, scan.other_end)]
+        return scan, others, starts, total
+    finally:
+        lib.dar_free(rh)
 
 
 class FaEncoded:

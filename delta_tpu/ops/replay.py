@@ -276,15 +276,10 @@ def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAE
     (native/src/fa_encode.cpp, same output layout); this numpy
     implementation is the toolchain-less fallback and parity oracle."""
     primary = np.asarray(lanes[0])
-    sub_radix = 1
-    sub = None
-    if len(lanes) > 1:
-        sub = combine_key_lanes(lanes[1:])
-        if sub is None:
-            return None
-        sub_radix = int(sub.max(initial=0)) + 1
-        if sub_radix == 1:
-            sub = None
+    sl = _sub_lane(lanes)
+    if sl is None:
+        return None
+    sub, sub_radix = sl
 
     if n >= _NATIVE_FA_MIN_ROWS:
         from delta_tpu import native
@@ -313,10 +308,39 @@ def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAE
     if not np.array_equal(p64[is_new], np.arange(n_new, dtype=np.int64)):
         return None
     primary_max = int(run_max[-1]) if n else 0
+    refs = primary[~is_new].astype(np.uint32, copy=False)
+    return _fa_pack(is_new, refs, primary_max, sub, sub_radix, n, m)
+
+
+def _sub_lane(lanes: Sequence[np.ndarray]):
+    """Combine lanes[1:] into one sub lane. Returns (sub-or-None,
+    sub_radix) or None when the ranges don't fit uint32."""
+    if len(lanes) <= 1:
+        return None, 1
+    sub = combine_key_lanes(lanes[1:])
+    if sub is None:
+        return None
+    sub_radix = int(sub.max(initial=0)) + 1
+    return (sub if sub_radix > 1 else None), sub_radix
+
+
+def _fa_pack(
+    flags: np.ndarray,
+    refs: np.ndarray,
+    primary_max: int,
+    sub: Optional[np.ndarray],
+    sub_radix: int,
+    n: int,
+    m: int,
+) -> Optional[_FAEncoding]:
+    """Shared wire-format tail of every first-appearance encoding path:
+    pack the is_new flags into bit words, the explicit refs into byte
+    planes, the sub lane into sparse (row, value) pairs, and apply the
+    economics check (None when plain byte planes would ship fewer
+    bytes — remove-heavy streams)."""
     if (primary_max + 1) * sub_radix >= 0xFFFFFFFF:
         return None
-
-    refs = primary[~is_new].astype(np.uint32, copy=False)
+    refs = np.ascontiguousarray(refs, dtype=np.uint32)
     r_pad = pad_bucket(len(refs), min_bucket=128)
     ref_width = key_byte_width(int(refs.max(initial=0)))
     ref_planes = _pack_key_planes(refs, ref_width, r_pad - len(refs),
@@ -334,17 +358,36 @@ def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAE
         sub_val = np.empty(0, np.uint32)
 
     pad = m - n
-    flags = np.concatenate([is_new, np.zeros(pad, np.bool_)]) if pad else is_new
-    flag_words = _pack_bits(flags)
+    flags = np.asarray(flags, dtype=np.bool_)
+    flag_words = _pack_bits(
+        np.concatenate([flags, np.zeros(pad, np.bool_)]) if pad else flags)
     nbytes = (flag_words.nbytes + sum(p.nbytes for p in ref_planes)
               + sub_idx.nbytes + sub_val.nbytes)
-    # fall back to plain byte-plane shipping when the delta encoding
-    # wouldn't actually be smaller (remove-heavy streams)
     full_width = key_byte_width((primary_max + 1) * sub_radix - 1)
     if nbytes >= m * full_width:
         return None
     return _FAEncoding(flag_words, ref_planes, sub_idx, sub_val,
                        sub_radix, nbytes)
+
+
+def _fa_from_hint(
+    flags: np.ndarray,
+    refs: np.ndarray,
+    n_uniq: int,
+    lanes: Sequence[np.ndarray],
+    n: int,
+    m: int,
+) -> Optional[_FAEncoding]:
+    """Build the device encoding from a scanner-provided first-appearance
+    coding (flags = is_new per row, refs = explicit codes of non-new rows
+    in row order) — the host never re-derives what the dictionary pass
+    already knew."""
+    sl = _sub_lane(lanes)
+    if sl is None:
+        return None
+    sub, sub_radix = sl
+    return _fa_pack(flags, refs, n_uniq - 1 if n_uniq else 0,
+                    sub, sub_radix, n, m)
 
 
 def replay_select(
@@ -353,6 +396,7 @@ def replay_select(
     order: np.ndarray,
     is_add: np.ndarray,
     device=None,
+    fa_hint: Optional[tuple] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Host-facing wrapper: permutes to chronological order if needed,
     delta- or byte-packs the key lanes (whichever ships fewer bytes),
@@ -376,6 +420,7 @@ def replay_select(
         perm = np.lexsort((order, version))
         key_lanes = [np.asarray(k)[perm] for k in key_lanes]
         is_add = np.asarray(is_add)[perm]
+        fa_hint = None  # hint flags are in original row order
 
     m = pad_bucket(n)
     pad = m - n
@@ -384,7 +429,12 @@ def replay_select(
         np.concatenate([is_add, np.zeros(pad, np.bool_)]) if pad else is_add)
 
     lanes = [np.asarray(k) for k in key_lanes]
-    fa = _try_fa_encode(lanes, n, m)
+    fa = None
+    if fa_hint is not None:
+        flags, refs, n_uniq = fa_hint
+        fa = _fa_from_hint(flags, refs, int(n_uniq), lanes, n, m)
+    if fa is None:
+        fa = _try_fa_encode(lanes, n, m)
 
     n_op = np.asarray(n, dtype=np.int32)
     if fa is not None:

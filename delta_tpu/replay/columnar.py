@@ -86,6 +86,11 @@ class ColumnarActions:
     commit_infos: Dict[int, CommitInfo] = field(default_factory=dict)
     num_commit_files: int = 0
     bytes_parsed: int = 0
+    # Replay-key sidecar from the native scanner (first-appearance path
+    # codes + delta encoding), row-aligned with file_actions. Only set
+    # when file_actions came from one native scan (no checkpoint blocks)
+    # so the alignment is exact; replay falls back to factorize otherwise.
+    replay_keys: Optional[object] = None
 
     @property
     def num_actions(self) -> int:
@@ -613,32 +618,60 @@ def columnarize_log_segment(
     for fstat in segment.deltas:
         commit_infos.append((fn.delta_version(fstat.path), fstat.path, fstat.size))
 
+    native_keys = None
     if commit_infos:
-        # one parallel read into one buffer; the native C++ scanner and
-        # the generic Arrow parser are alternative consumers of the SAME
-        # bytes — a native-side rejection never re-fetches from storage
-        read = _read_commits_buffer(engine, commit_infos)
-        parsed_native = generic = None
-        if read is not None:
-            buf, starts, version_arr = read
-            from delta_tpu import native as _native
+        version_arr = np.array([v for v, _, _ in commit_infos],
+                               dtype=np.int64)
+        from delta_tpu import native as _native
 
-            # a cold g++ build is only worth blocking on for buffers
-            # where the native scanner meaningfully wins
-            allow_compile = int(starts[-1]) >= _native.MIN_BYTES_FOR_COLD_BUILD
-            if _native.available(allow_compile):
-                from delta_tpu.replay.native_parse import parse_commits_native
+        total_listed = sum(max(0, int(s)) for _, _, s in commit_infos)
+        allow_compile = total_listed >= _native.MIN_BYTES_FOR_COLD_BUILD
+        parsed_native = generic = read = None
+        native_rejected = False
+        if _native.available(allow_compile):
+            # local files: one native read+scan round-trip (no per-file
+            # interpreter I/O, no buffer copy into Python)
+            local = [engine.fs.os_path(p) for _, p, _ in commit_infos]
+            if all(p is not None for p in local):
+                from delta_tpu.replay.native_parse import (
+                    parse_commit_paths_native,
+                )
 
-                parsed_native = parse_commits_native(
-                    buf, starts, version_arr, small_only=small_only)
-            if parsed_native is None:
-                generic = _parse_buffer_generic(buf, starts, version_arr)
+                out = parse_commit_paths_native(
+                    local, version_arr, small_only=small_only)
+                if out is not None:
+                    block, others, keys, total = out
+                    parsed_native = (block, others, keys)
+                    bytes_parsed += total
+                else:
+                    # the scanner saw (and rejected) this exact content —
+                    # don't scan the same bytes natively a second time
+                    native_rejected = True
+        if parsed_native is None:
+            # one parallel read into one buffer; the native C++ scanner
+            # and the generic Arrow parser are alternative consumers of
+            # the SAME bytes — a native-side rejection never re-fetches
+            read = _read_commits_buffer(engine, commit_infos)
+            if read is not None:
+                buf, starts, version_arr = read
+                if not native_rejected and _native.available(allow_compile):
+                    from delta_tpu.replay.native_parse import (
+                        parse_commits_native,
+                    )
+
+                    parsed_native = parse_commits_native(
+                        buf, starts, version_arr, small_only=small_only)
+                    if parsed_native is not None:
+                        bytes_parsed += int(starts[-1])
+                if parsed_native is None:
+                    generic = _parse_buffer_generic(buf, starts, version_arr)
         if parsed_native is not None:
-            block, others = parsed_native
+            block, others, keys = parsed_native
             if block.num_rows and not small_only:
+                if not blocks:
+                    native_keys = keys  # row-aligned only when sole block
                 blocks.append(block)
             tracker.scan_pylist(others)
-            bytes_parsed += int(read[1][-1])
         else:
             if generic is None:  # size mismatch or accounting failure
                 blobs = [(v, engine.fs.read_file(p))
@@ -674,4 +707,5 @@ def columnarize_log_segment(
         commit_infos=tracker.commit_infos,
         num_commit_files=len(commit_infos),
         bytes_parsed=bytes_parsed,
+        replay_keys=native_keys,
     )

@@ -58,6 +58,29 @@ def line_tags(
     return versions, orders
 
 
+def _path_column(scan) -> tuple:
+    """Per-row path strings from the scanner's dictionary encoding: the
+    unique arena becomes the dictionary values (decoded/percent-unescaped
+    once per UNIQUE path, not per row), then one take() materializes the
+    canonical string column.
+
+    Returns (column, codes_match_decoded): when percent-decoding changed
+    any unique path, two raw spellings may decode to the SAME logical
+    path, so the scanner's codes no longer key the decoded column and
+    the replay-key sidecar must be dropped (caller re-factorizes)."""
+    from delta_tpu.replay.columnar import _decode_paths
+
+    uniq = pa.StringArray.from_buffers(
+        scan.n_uniq,
+        pa.py_buffer(scan.uniq_offs.view(np.int32)),
+        pa.py_buffer(scan.uniq_arena))
+    decoded = _decode_paths(uniq)
+    idx = pa.Array.from_buffers(
+        pa.int32(), scan.n_rows, [None, pa.py_buffer(scan.path_code.view(np.int32))])
+    col = pa.DictionaryArray.from_arrays(idx, decoded).cast(pa.string())
+    return col, decoded is uniq
+
+
 def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
     """ScanResult + per-row tags -> canonical Arrow table (+ dv struct
     pieces needed for dv_id derivation, done by the caller with the same
@@ -65,12 +88,11 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
     from delta_tpu.replay.columnar import (
         CANONICAL_FILE_ACTION_SCHEMA,
         DV_STRUCT_TYPE,
-        _decode_paths,
         _dv_unique_id,
     )
 
     n = scan.n_rows
-    path = _decode_paths(_str_array(scan.path))
+    path, codes_ok = _path_column(scan)
     keys = _str_array(scan.pv_key)
     items = _str_array(scan.pv_val)
     map_type = pa.map_(pa.string(), pa.string())
@@ -96,7 +118,7 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
     )
     dv_id = _dv_unique_id(storage, pathinline, dv_offset, scan.dv_valid, n)
 
-    return pa.table(
+    tbl = pa.table(
         {
             "path": path,
             "dv_id": dv_id,
@@ -118,6 +140,55 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
         },
         schema=CANONICAL_FILE_ACTION_SCHEMA,
     )
+    return tbl, codes_ok
+
+
+class NativeReplayKeys:
+    """Replay-key sidecar from the native scan: first-appearance path
+    dictionary codes plus the ready-made delta encoding the device
+    kernel ships (ops/replay.py `_winner_kernel_fa`). Row-aligned with
+    the canonical table built from the same scan."""
+
+    __slots__ = ("path_code", "path_new", "refs", "n_uniq")
+
+    def __init__(self, scan):
+        self.path_code = scan.path_code
+        self.path_new = scan.path_new
+        self.refs = scan.refs
+        self.n_uniq = scan.n_uniq
+
+
+def _finish_scan(
+    scan,
+    others_raw: List[bytes],
+    file_starts: np.ndarray,
+    file_versions: np.ndarray,
+    small_only: bool,
+) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
+                    Optional[NativeReplayKeys]]]:
+    line_versions, line_orders = line_tags(
+        scan.line_starts, file_starts, file_versions)
+    keys: Optional[NativeReplayKeys] = None
+    if small_only:
+        from delta_tpu.replay.columnar import CANONICAL_FILE_ACTION_SCHEMA
+
+        table = CANONICAL_FILE_ACTION_SCHEMA.empty_table()
+    else:
+        table, codes_ok = build_canonical_table(
+            scan,
+            line_versions[scan.line_no] if scan.n_rows else np.empty(0, np.int64),
+            line_orders[scan.line_no] if scan.n_rows else np.empty(0, np.int32),
+        )
+        if codes_ok:
+            keys = NativeReplayKeys(scan)
+    others: List[Tuple[int, int, dict]] = []
+    for ln, raw in zip(scan.other_line_no.tolist(), others_raw):
+        try:
+            row = json.loads(raw)
+        except ValueError:
+            return None  # malformed control line: let the generic path err
+        others.append((int(line_versions[ln]), int(line_orders[ln]), row))
+    return table, others, keys
 
 
 def parse_commits_native(
@@ -125,12 +196,13 @@ def parse_commits_native(
     file_starts: np.ndarray,
     file_versions: np.ndarray,
     small_only: bool = False,
-) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]]]]:
+) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
+                    Optional[NativeReplayKeys]]]:
     """Native fast path over one concatenated commit buffer.
 
     Returns (canonical file-actions table, [(version, order, action-dict)
-    for non-file actions]) or None when the native scanner is
-    unavailable/fails (caller uses the generic Arrow parser).
+    for non-file actions], replay-key sidecar) or None when the native
+    scanner is unavailable/fails (caller uses the generic Arrow parser).
     `small_only` skips materializing the file-action table (the P&M fast
     path throws it away)."""
     from delta_tpu import native
@@ -138,26 +210,29 @@ def parse_commits_native(
     scan = native.scan_actions(buf)
     if scan is None:
         return None
-    line_versions, line_orders = line_tags(
-        scan.line_starts, file_starts, file_versions)
-    if small_only:
-        from delta_tpu.replay.columnar import CANONICAL_FILE_ACTION_SCHEMA
-
-        table = CANONICAL_FILE_ACTION_SCHEMA.empty_table()
-    else:
-        table = build_canonical_table(
-            scan,
-            line_versions[scan.line_no] if scan.n_rows else np.empty(0, np.int64),
-            line_orders[scan.line_no] if scan.n_rows else np.empty(0, np.int32),
-        )
-    others: List[Tuple[int, int, dict]] = []
     mv = memoryview(buf)
-    for ln, s, e in zip(scan.other_line_no.tolist(),
-                        scan.other_start.tolist(),
-                        scan.other_end.tolist()):
-        try:
-            row = json.loads(bytes(mv[s:e]))
-        except ValueError:
-            return None  # malformed control line: let the generic path err
-        others.append((int(line_versions[ln]), int(line_orders[ln]), row))
-    return table, others
+    others_raw = [bytes(mv[s:e])
+                  for s, e in zip(scan.other_start.tolist(),
+                                  scan.other_end.tolist())]
+    return _finish_scan(scan, others_raw, file_starts, file_versions,
+                        small_only)
+
+
+def parse_commit_paths_native(
+    local_paths: List[str],
+    file_versions: np.ndarray,
+    small_only: bool = False,
+) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
+                    Optional[NativeReplayKeys], int]]:
+    """Native read+scan of local commit files in one round-trip (no
+    per-file Python I/O). Returns (..., total_bytes) or None."""
+    from delta_tpu import native
+
+    out = native.scan_commit_files(local_paths)
+    if out is None:
+        return None
+    scan, others_raw, starts, total = out
+    fin = _finish_scan(scan, others_raw, starts, file_versions, small_only)
+    if fin is None:
+        return None
+    return (*fin, total)

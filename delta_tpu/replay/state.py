@@ -169,6 +169,15 @@ def build_replay_keys(file_actions: pa.Table) -> tuple[np.ndarray, np.ndarray]:
     return path_codes.astype(np.uint32), dv_codes.astype(np.uint32)
 
 
+def _dv_codes_only(file_actions: pa.Table) -> np.ndarray:
+    """dv_id lane codes (0 = no DV) without touching the path column."""
+    dv = file_actions.column("dv_id").combine_chunks()
+    if dv.null_count == len(dv):
+        return np.zeros(len(dv), dtype=np.uint32)
+    codes, _ = pd.factorize(dv.to_pandas(), sort=False, use_na_sentinel=True)
+    return (codes + 1).astype(np.uint32)
+
+
 def compute_masks_device(
     columnar: ColumnarActions, engine=None
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -179,7 +188,17 @@ def compute_masks_device(
     if n == 0:
         z = np.zeros(0, bool)
         return z, z
-    path_codes, dv_codes = build_replay_keys(fa)
+    keys = columnar.replay_keys
+    fa_hint = None
+    if keys is not None and len(keys.path_code) == n:
+        # the native scanner already dictionary-coded the paths in
+        # first-appearance order and emitted the delta encoding — skip
+        # the factorize pass entirely
+        path_codes = keys.path_code
+        dv_codes = _dv_codes_only(fa)
+        fa_hint = (keys.path_new, keys.refs, keys.n_uniq)
+    else:
+        path_codes, dv_codes = build_replay_keys(fa)
     version = np.asarray(fa.column("version"), dtype=np.int64)
     # versions fit int32 in practice (2^31 commits); assert to be safe
     assert version.max(initial=0) < 2**31, "version overflow"
@@ -196,7 +215,8 @@ def compute_masks_device(
         )
         return live, tomb
     return replay_select(
-        [path_codes, dv_codes], version.astype(np.int32), order, is_add
+        [path_codes, dv_codes], version.astype(np.int32), order, is_add,
+        fa_hint=fa_hint,
     )
 
 

@@ -37,9 +37,7 @@ def test_scan_string_escapes_and_unicode():
         '{"add":{"path":"a\\u00e9\\n\\"b\\\\c\\ud83d\\ude00.parquet",'
         '"partitionValues":{},"size":1,"modificationTime":1,"dataChange":true}}',
     ])
-    off, arena, valid = scan.path
-    path = bytes(arena[off[0]:off[1]]).decode()
-    assert path == 'aé\n"b\\c😀.parquet'
+    assert scan.path_list() == ['aé\n"b\\c😀.parquet']
 
 
 def test_scan_dv_and_null_pv_values():
@@ -168,3 +166,38 @@ def test_scan_duplicate_keys_rejected():
     buf = (b'{"add":{"path":"a","path":"b","partitionValues":{},"size":1,'
            b'"modificationTime":1,"dataChange":true}}\n')
     assert native.scan_actions(buf) is None
+
+
+def test_percent_encoded_paths_replay_on_decoded_form(tmp_path):
+    """Two raw spellings that percent-decode to the same logical path
+    ('a%41.parquet' vs 'aA.parquet') must reconcile as ONE file: the
+    scanner's raw-byte dictionary codes cannot key the replay, so the
+    sidecar is dropped and replay re-keys on the decoded column."""
+    import os
+
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.log.segment import build_log_segment
+    from delta_tpu.replay.columnar import columnarize_log_segment
+    from delta_tpu.replay.state import compute_masks_device, compute_masks_host
+
+    log = tmp_path / "t" / "_delta_log"
+    os.makedirs(log)
+    protocol = '{"protocol":{"minReaderVersion":1,"minWriterVersion":2}}'
+    metadata = ('{"metaData":{"id":"x","format":{"provider":"parquet",'
+                '"options":{}},"schemaString":"{\\"type\\":\\"struct\\",'
+                '\\"fields\\":[]}","partitionColumns":[],"configuration":{}}}')
+    add = ('{"add":{"path":"a%41.parquet","partitionValues":{},"size":1,'
+           '"modificationTime":1,"dataChange":true}}')
+    rm = '{"remove":{"path":"aA.parquet","dataChange":true}}'
+    (log / ("%020d.json" % 0)).write_text(f"{protocol}\n{metadata}\n{add}\n")
+    (log / ("%020d.json" % 1)).write_text(rm + "\n")
+
+    eng = HostEngine()
+    segment = build_log_segment(eng.fs, str(log))
+    columnar = columnarize_log_segment(eng, segment)
+    assert columnar.replay_keys is None  # decoding changed a unique path
+    live_d, tomb_d = compute_masks_device(columnar)
+    live_h, tomb_h = compute_masks_host(columnar)
+    assert live_d.tolist() == live_h.tolist()
+    assert int(live_d.sum()) == 0  # the remove cancels the decoded add
+    assert int(tomb_d.sum()) == 1
