@@ -157,10 +157,37 @@ def _np(lib, h, which: int, n: int, dtype, ptr_fn=None) -> np.ndarray:
     return np.frombuffer(buf.contents, dtype=dtype).copy()
 
 
-class ScanResult:
-    """Columnar output of one native scan (numpy-owned copies)."""
+class _NativeScanHandle:
+    """Owns one das_scan result; freed when the last referencing Arrow
+    buffer (or the ScanResult) is collected. Foreign buffers reference
+    THIS object, not the ScanResult, so no reference cycle forms."""
+
+    __slots__ = ("_lib", "_h")
 
     def __init__(self, lib, h):
+        self._lib = lib
+        self._h = h
+
+    def __del__(self):
+        try:
+            self._lib.das_free(self._h)
+        except Exception:
+            pass
+
+
+class ScanResult:
+    """Columnar output of one native scan.
+
+    Replay-side arrays (codes, flags, refs, line maps) are numpy copies;
+    the heavyweight arenas and numeric value buffers that Arrow consumes
+    stay in native memory as zero-copy `pa.foreign_buffer`s whose `base`
+    keeps the scan handle alive — at the 10M-row scale this avoids
+    copying ~2GB through a slow memory system."""
+
+    def __init__(self, lib, h):
+        import pyarrow as pa
+
+        owner = self._owner = _NativeScanHandle(lib, h)
         n = self.n_rows = int(lib.das_n(h, 0))
         self.n_lines = int(lib.das_n(h, 1))
         n_oth = self.n_others = int(lib.das_n(h, 2))
@@ -169,14 +196,24 @@ class ScanResult:
         def col(which, count, dtype):
             return _np(lib, h, which, count, dtype)
 
+        def fbuf(which, nbytes):
+            if nbytes == 0:
+                return pa.py_buffer(b"")
+            return pa.foreign_buffer(lib.das_ptr(h, which), nbytes,
+                                     base=owner)
+
         def strcol(off_which, arena_n_idx, valid_which, count):
-            offsets = col(off_which, count + 1, np.int32)
-            arena = col(off_which + 1, int(lib.das_n(h, arena_n_idx)), np.uint8)
+            offsets = fbuf(off_which, (count + 1) * 4)
+            arena = fbuf(off_which + 1, int(lib.das_n(h, arena_n_idx)))
             if valid_which is None:  # keys are never null
                 valid = np.ones(count, dtype=bool)
             else:
                 valid = col(valid_which, count, np.uint8).astype(bool)
             return offsets, arena, valid
+
+        def numcol(val_which, valid_which, count, width):
+            return (fbuf(val_which, count * width),
+                    col(valid_which, count, np.uint8).astype(bool))
 
         n_uniq = self.n_uniq = int(lib.das_n(h, 4))
         n_refs = self.n_refs = int(lib.das_n(h, 5))
@@ -189,13 +226,13 @@ class ScanResult:
         self.path_new = col(3, n, np.uint8).astype(bool)
         self.refs = col(4, n_refs, np.uint32)
         self.uniq_offs = col(5, n_uniq + 1, np.uint32)
-        self.uniq_arena = col(6, int(lib.das_n(h, 6)), np.uint8)
-        self.pv_offsets = col(7, n + 1, np.int32)
+        self.uniq_arena = fbuf(6, int(lib.das_n(h, 6)))
+        self.pv_offsets = fbuf(7, (n + 1) * 4)
         self.pv_valid = col(8, n, np.uint8).astype(bool)
         self.pv_key = strcol(9, 7, None, n_pv)
         self.pv_val = strcol(11, 8, 13, n_pv)
-        self.size = (col(14, n, np.int64), col(15, n, np.uint8).astype(bool))
-        self.mod_time = (col(16, n, np.int64), col(17, n, np.uint8).astype(bool))
+        self.size = numcol(14, 15, n, 8)
+        self.mod_time = numcol(16, 17, n, 8)
         self.data_change = (col(18, n, np.uint8).astype(bool),
                             col(19, n, np.uint8).astype(bool))
         self.stats = strcol(20, 9, 22, n)
@@ -203,14 +240,14 @@ class ScanResult:
         self.dv_valid = col(26, n, np.uint8).astype(bool)
         self.dv_storage = strcol(27, 11, 29, n)
         self.dv_pathinline = strcol(30, 12, 32, n)
-        self.dv_offset = (col(33, n, np.int32), col(34, n, np.uint8).astype(bool))
-        self.dv_size = (col(35, n, np.int32), col(36, n, np.uint8).astype(bool))
-        self.dv_card = (col(37, n, np.int64), col(38, n, np.uint8).astype(bool))
-        self.dv_maxrow = (col(39, n, np.int64), col(40, n, np.uint8).astype(bool))
-        self.base_row_id = (col(41, n, np.int64), col(42, n, np.uint8).astype(bool))
-        self.drcv = (col(43, n, np.int64), col(44, n, np.uint8).astype(bool))
+        self.dv_offset = numcol(33, 34, n, 4)
+        self.dv_size = numcol(35, 36, n, 4)
+        self.dv_card = numcol(37, 38, n, 8)
+        self.dv_maxrow = numcol(39, 40, n, 8)
+        self.base_row_id = numcol(41, 42, n, 8)
+        self.drcv = numcol(43, 44, n, 8)
         self.clustering = strcol(45, 13, 47, n)
-        self.del_ts = (col(48, n, np.int64), col(49, n, np.uint8).astype(bool))
+        self.del_ts = numcol(48, 49, n, 8)
         self.ext_meta = (col(50, n, np.uint8).astype(bool),
                          col(51, n, np.uint8).astype(bool))
         self.other_line_no = col(52, n_oth, np.int64)
@@ -218,13 +255,19 @@ class ScanResult:
         self.other_end = col(54, n_oth, np.int64)
         self.line_starts = col(55, self.n_lines, np.int64)
 
+    def uniq_strings(self):
+        """Unique paths (code order) as an Arrow string array."""
+        import pyarrow as pa
+
+        return pa.StringArray.from_buffers(
+            self.n_uniq, pa.py_buffer(self.uniq_offs.view(np.int32)),
+            self.uniq_arena)
+
     def path_list(self) -> list:
         """Per-row path strings (tests/small results; the hot path keeps
         codes + the unique arena)."""
-        offs = self.uniq_offs
-        arena = self.uniq_arena.tobytes()
-        return [arena[offs[c]:offs[c + 1]].decode("utf-8")
-                for c in self.path_code]
+        uniq = self.uniq_strings().to_pylist()
+        return [uniq[c] for c in self.path_code]
 
 
 def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
@@ -235,9 +278,9 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
     if lib is None:
         return None
     if n_threads <= 0:
-        from delta_tpu.utils.threads import default_io_threads
+        from delta_tpu.utils.threads import default_scan_threads
 
-        n_threads = default_io_threads()
+        n_threads = default_scan_threads()
     if isinstance(buf, (bytes, bytearray, memoryview)):
         n_bytes = len(buf)
         if isinstance(buf, bytes):
@@ -249,12 +292,10 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
         data = bytes(buf)
         n_bytes = len(data)
     h = lib.das_scan(data, n_bytes, n_threads)
-    try:
-        if lib.das_error(h):
-            return None
-        return ScanResult(lib, h)
-    finally:
+    if lib.das_error(h):
         lib.das_free(h)
+        return None
+    return ScanResult(lib, h)  # handle ownership moves to the result
 
 
 def scan_commit_files(paths) -> Optional[tuple]:
@@ -278,16 +319,14 @@ def scan_commit_files(paths) -> Optional[tuple]:
         buf_ptr = lib.dar_buf(rh)
         starts = _np(lib, rh, 0, len(paths) + 1, np.int64,
                      ptr_fn=lambda h, w: lib.dar_starts(h))
-        from delta_tpu.utils.threads import default_io_threads
+        from delta_tpu.utils.threads import default_scan_threads
 
         sh = lib.das_scan(ctypes.cast(buf_ptr, ctypes.c_char_p), total,
-                          default_io_threads())
-        try:
-            if lib.das_error(sh):
-                return None
-            scan = ScanResult(lib, sh)
-        finally:
+                          default_scan_threads())
+        if lib.das_error(sh):
             lib.das_free(sh)
+            return None
+        scan = ScanResult(lib, sh)  # handle ownership moves to the result
         # slice the non-file-action lines out while the buffer is alive
         raw = (ctypes.c_char * total).from_address(buf_ptr) if total else b""
         others = [bytes(raw[int(s):int(e)])
@@ -348,9 +387,9 @@ def fa_encode(primary: np.ndarray, sub: Optional[np.ndarray], n: int,
     if lib is None:
         return None
     if n_threads <= 0:
-        from delta_tpu.utils.threads import default_io_threads
+        from delta_tpu.utils.threads import default_scan_threads
 
-        n_threads = default_io_threads()
+        n_threads = default_scan_threads()
     primary = np.ascontiguousarray(primary, dtype=np.uint32)
     pk_ptr = primary.ctypes.data_as(ctypes.c_void_p)
     if sub is not None:

@@ -932,14 +932,43 @@ void* das_scan(const char* buf, int64_t len, int32_t n_threads) {
     Builder& b = builders[t];
     size_t span = (size_t)(cut[t + 1] - cut[t]);
     // ~230B/line typical: presize the per-row vectors to dodge most
-    // geometric regrowth copies (cheap over-reserve, freed on merge)
+    // geometric regrowth copies — at the GB scale each missed reserve
+    // is a multi-hundred-MB realloc memcpy plus a fresh round of page
+    // faults (reserve only maps, first touch pays the fault once)
     size_t est_rows = span / 128 + 16;
     b.line_no.reserve(est_rows);
     b.is_add.reserve(est_rows);
     b.path_code.reserve(est_rows);
+    b.path_new.reserve(est_rows);
     b.dict.reserve_slots(est_rows);
     b.dict.arena.reserve(span / 6);
     b.dict.offs.reserve(est_rows);
+    b.line_starts.reserve(est_rows);
+    // stats dominate commit bytes (~60%); the rest are small per-row
+    b.stats.arena.reserve(span * 2 / 3);
+    b.stats.offsets.reserve(est_rows);
+    b.stats.valid.reserve(est_rows);
+    b.pv_offsets.reserve(est_rows);
+    b.pv_valid.reserve(est_rows);
+    b.dv_valid.reserve(est_rows);
+    for (auto* c : {&b.size, &b.mod_time, &b.dv_card, &b.dv_maxrow,
+                    &b.base_row_id, &b.drcv, &b.del_ts}) {
+      c->vals.reserve(est_rows);
+      c->valid.reserve(est_rows);
+    }
+    for (auto* c8 : {&b.data_change, &b.ext_meta}) {
+      c8->vals.reserve(est_rows);
+      c8->valid.reserve(est_rows);
+    }
+    for (auto* s : {&b.tags, &b.clustering, &b.dv_storage,
+                    &b.dv_pathinline}) {
+      s->offsets.reserve(est_rows);
+      s->valid.reserve(est_rows);
+    }
+    b.dv_offset.vals.reserve(est_rows);
+    b.dv_offset.valid.reserve(est_rows);
+    b.dv_size.vals.reserve(est_rows);
+    b.dv_size.valid.reserve(est_rows);
     const char* p = buf + cut[t];
     const char* end = buf + cut[t + 1];
     while (p < end) {

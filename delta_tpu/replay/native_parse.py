@@ -23,17 +23,21 @@ def _bitmap(valid: np.ndarray) -> Optional[pa.Buffer]:
     return pa.py_buffer(np.packbits(valid, bitorder="little"))
 
 
+def _as_buf(x) -> pa.Buffer:
+    """numpy array or (zero-copy foreign) pa.Buffer -> pa.Buffer."""
+    return x if isinstance(x, pa.Buffer) else pa.py_buffer(x)
+
+
 def _str_array(col: tuple) -> pa.Array:
     offsets, arena, valid = col
     return pa.StringArray.from_buffers(
-        len(valid), pa.py_buffer(offsets), pa.py_buffer(arena),
-        _bitmap(valid))
+        len(valid), _as_buf(offsets), _as_buf(arena), _bitmap(valid))
 
 
 def _num_array(col: tuple, typ: pa.DataType) -> pa.Array:
     vals, valid = col
     return pa.Array.from_buffers(
-        typ, len(valid), [_bitmap(valid), pa.py_buffer(vals)])
+        typ, len(valid), [_bitmap(valid), _as_buf(vals)])
 
 
 def _bool_array(col: tuple) -> pa.Array:
@@ -70,10 +74,7 @@ def _path_column(scan) -> tuple:
     the replay-key sidecar must be dropped (caller re-factorizes)."""
     from delta_tpu.replay.columnar import _decode_paths
 
-    uniq = pa.StringArray.from_buffers(
-        scan.n_uniq,
-        pa.py_buffer(scan.uniq_offs.view(np.int32)),
-        pa.py_buffer(scan.uniq_arena))
+    uniq = scan.uniq_strings()
     decoded = _decode_paths(uniq)
     idx = pa.Array.from_buffers(
         pa.int32(), scan.n_rows, [None, pa.py_buffer(scan.path_code.view(np.int32))])
@@ -102,7 +103,7 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
         fields=[entries_type.field(0), entries_type.field(1)])
     pv = pa.Array.from_buffers(
         map_type, n,
-        [_bitmap(scan.pv_valid), pa.py_buffer(scan.pv_offsets)],
+        [_bitmap(scan.pv_valid), _as_buf(scan.pv_offsets)],
         children=[entries])
 
     storage = _str_array(scan.dv_storage)

@@ -56,6 +56,13 @@ class Snapshot:
         if self._state is not None:
             return self._state
         if self._small is None:
+            if not self._segment.checkpoints:
+                # JSON-only segment: the small projection saves no I/O
+                # (there are no parquet columns to skip), but a later
+                # full-state access would re-read and re-parse the whole
+                # log — reconstruct once and serve both
+                self._state = reconstruct_state(self._engine, self._segment)
+                return self._state
             self._small = reconstruct_small_state(self._engine, self._segment)
         return self._small
 

@@ -1,0 +1,522 @@
+"""Cloud object-store LogStores: conditional-put (GCS), single-driver
+(S3), and external-arbiter (S3+DynamoDB pattern) commit semantics.
+
+The local O_EXCL trick (`logstore.py`) doesn't exist on object stores;
+each cloud needs its own mutual-exclusion story (reference
+`storage/src/main/java/io/delta/storage/`):
+
+- **GCS** (`GCSLogStore.java:100-106`): generation preconditions — a PUT
+  with `ifGenerationMatch=0` succeeds only if the object does not exist;
+  HTTP 412 maps to FileAlreadyExistsError. Atomic put-if-absent comes
+  from the server, so no temp+rename dance is needed.
+- **S3 single-driver** (`S3SingleDriverLogStore.java`): plain S3 PUT
+  cannot be conditional (pre-2024 semantics the reference targets), so
+  mutual exclusion holds only WITHIN one process: a per-path lock plus
+  an existence check. Multi-writer safety requires the arbiter below.
+- **S3 + external arbiter** (`BaseExternalLogStore.java:154-270`): a
+  strongly-consistent side store (DynamoDB) arbitrates commits via
+  conditional put. Write N.json = prepare (temp file T(N) + entry
+  E(N, T(N), complete=false)) -> copy T(N) to N.json -> acknowledge
+  (E.complete=true). A crash between prepare and acknowledge leaves a
+  half commit that ANY subsequent reader or writer repairs
+  (`fixDeltaLog`, `BaseExternalLogStore.java:369-373`): copy T(N) into
+  place if missing, then mark complete. The arbiter entry, not the
+  object store, is the source of truth for who won version N.
+
+Transports are injectable: `GCSObjectClient` takes any callable with
+the (method, url, headers, body) -> (status, headers, body) shape.
+`HttpTransport` is the real urllib implementation — tests exercise it
+against a local in-process HTTP server that faithfully implements the
+generation-precondition subset of the GCS JSON API.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+import uuid
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from delta_tpu.storage.logstore import (
+    DelegatingLogStore,
+    FileAlreadyExistsError,
+    FileStatus,
+    LogStore,
+)
+
+Transport = Callable[[str, str, Dict[str, str], Optional[bytes]],
+                     Tuple[int, Dict[str, str], bytes]]
+
+
+class PreconditionFailedError(Exception):
+    """HTTP 412: the generation precondition did not hold."""
+
+
+class HttpTransport:
+    """urllib-backed transport. `base_url` lets tests point the real
+    HTTP code path at a local mock server."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def __call__(self, method: str, url: str, headers: Dict[str, str],
+                 body: Optional[bytes]):
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers or {}), e.read()
+
+
+class GCSObjectClient:
+    """Minimal GCS JSON-API client: conditional upload, media download,
+    prefix listing, delete. Only what a LogStore needs."""
+
+    def __init__(self, bucket: str, transport: Optional[Transport] = None,
+                 base_url: str = "https://storage.googleapis.com",
+                 token_provider: Optional[Callable[[], str]] = None):
+        self.bucket = bucket
+        self.transport = transport or HttpTransport()
+        self.base = base_url.rstrip("/")
+        self.token_provider = token_provider
+
+    def _headers(self) -> Dict[str, str]:
+        h = {}
+        if self.token_provider is not None:
+            h["Authorization"] = f"Bearer {self.token_provider()}"
+        return h
+
+    def put(self, name: str, data: bytes,
+            if_generation_match: Optional[int] = None) -> None:
+        q = {"uploadType": "media", "name": name}
+        if if_generation_match is not None:
+            q["ifGenerationMatch"] = str(if_generation_match)
+        url = (f"{self.base}/upload/storage/v1/b/{self.bucket}/o?"
+               + urllib.parse.urlencode(q))
+        headers = self._headers()
+        headers["Content-Type"] = "application/octet-stream"
+        status, _, body = self.transport("POST", url, headers, data)
+        if status == 412:
+            raise PreconditionFailedError(name)
+        if status >= 300:
+            raise IOError(f"GCS put {name}: HTTP {status} {body[:200]!r}")
+
+    def get(self, name: str) -> bytes:
+        url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(name, safe='')}?alt=media")
+        status, _, body = self.transport("GET", url, self._headers(), None)
+        if status == 404:
+            raise FileNotFoundError(name)
+        if status >= 300:
+            raise IOError(f"GCS get {name}: HTTP {status}")
+        return body
+
+    def list_prefix(self, prefix: str) -> List[dict]:
+        items: List[dict] = []
+        page: Optional[str] = None
+        while True:
+            q = {"prefix": prefix}
+            if page:
+                q["pageToken"] = page
+            url = (f"{self.base}/storage/v1/b/{self.bucket}/o?"
+                   + urllib.parse.urlencode(q))
+            status, _, body = self.transport("GET", url, self._headers(),
+                                             None)
+            if status >= 300:
+                raise IOError(f"GCS list {prefix}: HTTP {status}")
+            doc = json.loads(body)
+            items.extend(doc.get("items", []))
+            page = doc.get("nextPageToken")
+            if not page:
+                return items
+
+    def stat(self, name: str) -> dict:
+        """Object metadata (size/updated/generation) without the body —
+        one tiny response instead of a full media download."""
+        url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(name, safe='')}")
+        status, _, body = self.transport("GET", url, self._headers(), None)
+        if status == 404:
+            raise FileNotFoundError(name)
+        if status >= 300:
+            raise IOError(f"GCS stat {name}: HTTP {status}")
+        return json.loads(body)
+
+    def delete(self, name: str) -> None:
+        url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(name, safe='')}")
+        status, _, _ = self.transport("DELETE", url, self._headers(), None)
+        if status == 404:
+            raise FileNotFoundError(name)
+        if status >= 300:
+            raise IOError(f"GCS delete {name}: HTTP {status}")
+
+
+def _split_object_path(path: str) -> str:
+    """'gs://bucket/a/b' or 'a/b' -> object name 'a/b'."""
+    if "://" in path:
+        return path.split("://", 1)[1].split("/", 1)[1]
+    return path.lstrip("/")
+
+
+def _mtime_ms(item: dict) -> int:
+    upd = item.get("updated")
+    if not upd:
+        return 0
+    # RFC3339 'YYYY-MM-DDTHH:MM:SS(.fff)Z'
+    from datetime import datetime, timezone
+
+    try:
+        dt = datetime.fromisoformat(upd.replace("Z", "+00:00"))
+        return int(dt.astimezone(timezone.utc).timestamp() * 1000)
+    except ValueError:
+        return 0
+
+
+class GCSLogStore(LogStore):
+    """Put-if-absent via GCS generation preconditions — the server is
+    the arbiter, so this is multi-writer safe with zero extra
+    infrastructure (reference `GCSLogStore.java`)."""
+
+    def __init__(self, client: GCSObjectClient, scheme_prefix: str = "gs"):
+        self.client = client
+        self._prefix = f"{scheme_prefix}://{client.bucket}/"
+
+    def _name(self, path: str) -> str:
+        return _split_object_path(path)
+
+    def read(self, path: str) -> bytes:
+        return self.client.get(self._name(path))
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        name = self._name(path)
+        if overwrite:
+            self.client.put(name, data)
+            return
+        try:
+            self.client.put(name, data, if_generation_match=0)
+        except PreconditionFailedError:
+            raise FileAlreadyExistsError(path)
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        name = self._name(path)
+        parent, _, base = name.rpartition("/")
+        prefix = parent + "/" if parent else ""
+        out = []
+        for item in self.client.list_prefix(prefix):
+            obj = item["name"]
+            rest = obj[len(prefix):]
+            if "/" in rest:  # only direct children
+                continue
+            if rest >= base:
+                out.append(FileStatus(self._prefix + obj,
+                                      int(item.get("size", 0)),
+                                      _mtime_ms(item)))
+        return iter(sorted(out, key=lambda fs: fs.path))
+
+    def list_dir(self, path: str) -> List[FileStatus]:
+        name = self._name(path).rstrip("/")
+        prefix = name + "/" if name else ""
+        out = []
+        for item in self.client.list_prefix(prefix):
+            rest = item["name"][len(prefix):]
+            if "/" in rest:
+                continue
+            out.append(FileStatus(self._prefix + item["name"],
+                                  int(item.get("size", 0)), _mtime_ms(item)))
+        return sorted(out, key=lambda fs: fs.path)
+
+    def walk(self, path: str) -> Iterator[FileStatus]:
+        name = self._name(path).rstrip("/")
+        prefix = name + "/" if name else ""
+        out = [FileStatus(self._prefix + item["name"],
+                          int(item.get("size", 0)), _mtime_ms(item))
+               for item in self.client.list_prefix(prefix)]
+        return iter(sorted(out, key=lambda fs: fs.path))
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.client.stat(self._name(path))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def delete(self, path: str) -> None:
+        self.client.delete(self._name(path))
+
+    def mkdirs(self, path: str) -> None:
+        pass  # object stores have no directories
+
+    def file_status(self, path: str) -> FileStatus:
+        meta = self.client.stat(self._name(path))
+        return FileStatus(path, int(meta.get("size", 0)), _mtime_ms(meta))
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        return False  # uploads are atomic per object
+
+
+class _PathLocks:
+    """Per-path in-process locks (reference `PathLock.java` role)."""
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks: Dict[str, threading.Lock] = {}
+
+    def acquire(self, path: str) -> threading.Lock:
+        with self._guard:
+            lk = self._locks.setdefault(path, threading.Lock())
+        lk.acquire()
+        return lk
+
+
+class S3SingleDriverLogStore(DelegatingLogStore):
+    """Single-process mutual exclusion over a store whose put is NOT
+    conditional: per-path lock + existence check. Faithful to the
+    reference's caveat (`S3SingleDriverLogStore.java`): concurrent
+    writers from DIFFERENT processes are unsafe — use the external
+    arbiter for that."""
+
+    _locks = _PathLocks()
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        if overwrite:
+            self.inner.write(path, data, overwrite=True)
+            return
+        lk = self._locks.acquire(path)
+        try:
+            if self.inner.exists(path):
+                raise FileAlreadyExistsError(path)
+            self.inner.write(path, data, overwrite=True)
+        finally:
+            lk.release()
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        return False
+
+
+# ------------------------------------------------------ external arbiter
+
+
+@dataclass(frozen=True)
+class ExternalCommitEntry:
+    """One row of the arbiter table (reference
+    `ExternalCommitEntry.java`)."""
+
+    table_path: str
+    file_name: str       # e.g. 00000000000000000010.json
+    temp_path: str       # relative: _delta_log/.tmp/<file>.<uuid>
+    complete: bool
+    expire_time: Optional[int] = None  # epoch seconds, set when complete
+
+    def absolute_file_path(self) -> str:
+        return f"{self.table_path}/_delta_log/{self.file_name}"
+
+    def absolute_temp_path(self) -> str:
+        return f"{self.table_path}/{self.temp_path}"
+
+    def as_complete(self, expiration_delay_s: int) -> "ExternalCommitEntry":
+        return replace(self, complete=True,
+                       expire_time=int(time.time()) + expiration_delay_s)
+
+
+class CommitArbiter:
+    """Strongly-consistent conditional-put table (the DynamoDB role).
+    Keys are (table_path, file_name)."""
+
+    def put_entry(self, entry: ExternalCommitEntry,
+                  overwrite: bool) -> None:
+        """Conditional put: raise FileAlreadyExistsError when an entry
+        for (table_path, file_name) exists and overwrite is False."""
+        raise NotImplementedError
+
+    def get_entry(self, table_path: str,
+                  file_name: str) -> Optional[ExternalCommitEntry]:
+        raise NotImplementedError
+
+    def get_latest_entry(self,
+                         table_path: str) -> Optional[ExternalCommitEntry]:
+        raise NotImplementedError
+
+
+class InMemoryCommitArbiter(CommitArbiter):
+    """Process-wide arbiter with DynamoDB conditional-put semantics —
+    deterministic stand-in for tests and single-host deployments."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: Dict[Tuple[str, str], ExternalCommitEntry] = {}
+
+    def put_entry(self, entry: ExternalCommitEntry,
+                  overwrite: bool) -> None:
+        key = (entry.table_path, entry.file_name)
+        with self._lock:
+            if not overwrite and key in self._rows:
+                raise FileAlreadyExistsError(entry.file_name)
+            self._rows[key] = entry
+
+    def get_entry(self, table_path, file_name):
+        with self._lock:
+            return self._rows.get((table_path, file_name))
+
+    def get_latest_entry(self, table_path):
+        with self._lock:
+            rows = [e for (tp, _), e in self._rows.items()
+                    if tp == table_path]
+        if not rows:
+            return None
+        return max(rows, key=lambda e: e.file_name)
+
+
+def _is_delta_file(name: str) -> bool:
+    return name.endswith(".json") and name.split(".")[0].isdigit()
+
+
+class ExternalArbiterLogStore(DelegatingLogStore):
+    """The S3+DynamoDB commit protocol over any (non-mutually-exclusive)
+    inner store. See the module docstring and
+    `BaseExternalLogStore.java:154-270` for the algorithm.
+
+    The `_write_copy_temp_file` / `_write_put_complete_entry` /
+    `_fix_copy_temp_file` / `_fix_put_complete_entry` seams mirror the
+    reference's @VisibleForTesting wrappers: fault-injection tests
+    override them to crash a writer at each phase boundary and assert
+    recovery."""
+
+    EXPIRATION_DELAY_S = 24 * 3600  # BaseExternalLogStore.java:105
+
+    _path_locks = _PathLocks()
+
+    def __init__(self, inner: LogStore, arbiter: CommitArbiter):
+        super().__init__(inner)
+        self.arbiter = arbiter
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _table_path(path: str) -> str:
+        # <table>/_delta_log/<name> -> <table>
+        parent = path.rpartition("/")[0]
+        return parent.rpartition("/")[0]
+
+    @staticmethod
+    def _is_delta_log_path(path: str) -> bool:
+        return path.rpartition("/")[0].endswith("_delta_log")
+
+    def _copy(self, src: str, dst: str) -> None:
+        """Copy with best-effort no-overwrite (the inner store cannot do
+        better — that is the entire reason the arbiter exists)."""
+        data = self.inner.read(src)
+        try:
+            self.inner.write(dst, data, overwrite=False)
+        except FileAlreadyExistsError:
+            raise
+        except NotImplementedError:
+            self.inner.write(dst, data, overwrite=True)
+
+    # test seams (reference @VisibleForTesting wrappers)
+    def _write_copy_temp_file(self, src: str, dst: str) -> None:
+        self._copy(src, dst)
+
+    def _write_put_complete_entry(self, entry: ExternalCommitEntry) -> None:
+        self.arbiter.put_entry(entry.as_complete(self.EXPIRATION_DELAY_S),
+                               overwrite=True)
+
+    def _fix_copy_temp_file(self, src: str, dst: str) -> None:
+        self._copy(src, dst)
+
+    def _fix_put_complete_entry(self, entry: ExternalCommitEntry) -> None:
+        self.arbiter.put_entry(entry.as_complete(self.EXPIRATION_DELAY_S),
+                               overwrite=True)
+
+    def fix_delta_log(self, entry: ExternalCommitEntry) -> None:
+        """Complete a half commit: copy T(N) into N.json if missing,
+        then mark the entry complete (`BaseExternalLogStore.java:369`).
+        Never raises FileAlreadyExists — that just means another
+        writer/reader already did the copy."""
+        if entry.complete:
+            return
+        target = entry.absolute_file_path()
+        lk = self._path_locks.acquire(target)
+        try:
+            copied = False
+            retry = 0
+            while True:
+                try:
+                    if not copied and not self.inner.exists(target):
+                        self._fix_copy_temp_file(entry.absolute_temp_path(),
+                                                 target)
+                        copied = True
+                    self._fix_put_complete_entry(entry)
+                    return
+                except FileAlreadyExistsError:
+                    copied = True  # another fixer copied; still ack
+                except Exception:
+                    retry += 1
+                    if retry >= 3:
+                        raise
+        finally:
+            lk.release()
+
+    # -- LogStore surface ------------------------------------------------
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        if self._is_delta_log_path(path):
+            entry = self.arbiter.get_latest_entry(self._table_path(path))
+            if entry is not None and not entry.complete:
+                self.fix_delta_log(entry)
+        return self.inner.list_from(path)
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        if overwrite:
+            self.inner.write(path, data, overwrite=True)
+            return
+        name = path.rpartition("/")[2]
+        if not self._is_delta_log_path(path) or not _is_delta_file(name):
+            # non-commit files keep best-effort semantics
+            self.inner.write(path, data, overwrite=False)
+            return
+        lk = self._path_locks.acquire(path)
+        try:
+            # Step 0: fail fast if N.json is already visible
+            if self.inner.exists(path):
+                raise FileAlreadyExistsError(path)
+            table_path = self._table_path(path)
+            version = int(name.split(".")[0])
+            # Step 1: ensure N-1.json exists (recover if half-committed)
+            if version > 0:
+                prev_name = f"{version - 1:020d}.json"
+                prev_entry = self.arbiter.get_entry(table_path, prev_name)
+                prev_path = f"{table_path}/_delta_log/{prev_name}"
+                if prev_entry is not None and not prev_entry.complete:
+                    self.fix_delta_log(prev_entry)
+                elif not self.inner.exists(prev_path):
+                    raise FileNotFoundError(
+                        f"previous commit {prev_path} does not exist")
+            # Step 2: PREPARE — write T(N), then claim the version with a
+            # conditional put of E(N, T(N), complete=false)
+            temp_rel = f"_delta_log/.tmp/{name}.{uuid.uuid4().hex}"
+            entry = ExternalCommitEntry(table_path, name, temp_rel,
+                                        complete=False)
+            self.inner.write(entry.absolute_temp_path(), data,
+                             overwrite=True)
+            self.arbiter.put_entry(entry, overwrite=False)  # the real race
+            try:
+                # Step 3: COMMIT — copy T(N) into N.json
+                self._write_copy_temp_file(entry.absolute_temp_path(), path)
+                # Step 4: ACKNOWLEDGE
+                self._write_put_complete_entry(entry)
+            except Exception:
+                # recoverable: we own E(N); any reader/writer will finish
+                # the copy+ack via fix_delta_log
+                pass
+        finally:
+            lk.release()
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        return False

@@ -248,13 +248,53 @@ class InMemoryLogStore(LogStore):
             return FileStatus(path, len(data), mtime)
 
 
-class FaultInjectingLogStore(LogStore):
+class DelegatingLogStore(LogStore):
+    """Explicit method-by-method delegation base for wrapper stores.
+    (A `__getattr__` fallback alone is NOT enough: `LogStore` defines
+    every method as raising NotImplementedError, so normal attribute
+    lookup finds those and never falls through to the wrapped store.)"""
+
+    def __init__(self, inner: LogStore):
+        self.inner = inner
+
+    def read(self, path: str) -> bytes:
+        return self.inner.read(path)
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self.inner.write(path, data, overwrite)
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        return self.inner.list_from(path)
+
+    def list_dir(self, path: str) -> List[FileStatus]:
+        return self.inner.list_dir(path)
+
+    def walk(self, path: str) -> Iterator[FileStatus]:
+        return self.inner.walk(path)
+
+    def exists(self, path: str) -> bool:
+        return self.inner.exists(path)
+
+    def delete(self, path: str) -> None:
+        self.inner.delete(path)
+
+    def mkdirs(self, path: str) -> None:
+        self.inner.mkdirs(path)
+
+    def file_status(self, path: str) -> FileStatus:
+        return self.inner.file_status(path)
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        return self.inner.is_partial_write_visible(path)
+
+
+class FaultInjectingLogStore(DelegatingLogStore):
     """Wraps a store; `fail_on(path_predicate)` arms one-shot or persistent
     failures, `block_on` installs a barrier the test releases. Used by
     concurrency tests to force specific interleavings."""
 
     def __init__(self, inner: LogStore):
-        self.inner = inner
+        super().__init__(inner)
         self._write_faults: List[tuple[Callable[[str], bool], Exception, bool]] = []
         self._write_barriers: List[tuple[Callable[[str], bool], threading.Event]] = []
         self.write_log: List[str] = []
@@ -279,9 +319,6 @@ class FaultInjectingLogStore(LogStore):
                     self._write_faults.pop(i)
                 raise exc
         self.inner.write(path, data, overwrite)
-
-    def __getattr__(self, name):
-        return getattr(self.inner, name)
 
 
 _SCHEME_REGISTRY: Dict[str, Callable[[], LogStore]] = {}

@@ -1,0 +1,133 @@
+"""Fault-injected commit/checkpoint recovery (BlockWritesLocalFileSystem
+role, reference `spark/src/test/.../BlockWritesLocalFileSystem.scala`,
+zombie-task tolerance `Checkpoints.scala:752-767`): partial failures at
+storage level must leave the table readable and the next attempt
+successful."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.errors import DeltaError
+from delta_tpu.storage.logstore import (
+    FaultInjectingLogStore,
+    InMemoryLogStore,
+)
+from delta_tpu.table import Table
+
+
+def _engine_with_faults():
+    store = FaultInjectingLogStore(InMemoryLogStore())
+
+    def resolver(path):
+        return store
+
+    return HostEngine(store_resolver=resolver), store
+
+
+def _data(n=5, start=0):
+    return pa.table({"x": pa.array(np.arange(start, start + n,
+                                             dtype=np.int64))})
+
+
+TBL = "memory://fault/tbl"
+
+
+def test_commit_write_failure_then_retry():
+    """A transient storage failure on the commit file write surfaces to
+    the caller; the table is unchanged and the retried write lands."""
+    eng, store = _engine_with_faults()
+    dta.write_table(TBL + "0", _data(), engine=eng)
+
+    store.fail_writes(lambda p: p.endswith("1.json"), once=True)
+    with pytest.raises(Exception):
+        dta.write_table(TBL + "0", _data(), mode="append", engine=eng)
+    snap = Table.for_path(TBL + "0", eng).latest_snapshot()
+    assert snap.version == 0 and snap.num_files == 1  # unchanged
+
+    dta.write_table(TBL + "0", _data(), mode="append", engine=eng)
+    snap = Table.for_path(TBL + "0", eng).latest_snapshot()
+    assert snap.version == 1 and snap.num_files == 2
+
+
+def test_checkpoint_write_failure_leaves_table_readable():
+    """A checkpoint part-write failure must not corrupt the table: the
+    snapshot still loads from JSON commits and a retried checkpoint
+    succeeds and is then used."""
+    eng, store = _engine_with_faults()
+    path = TBL + "1"
+    for i in range(4):
+        dta.write_table(path, _data(start=i * 5), engine=eng,
+                        mode="error" if i == 0 else "append")
+
+    store.fail_writes(lambda p: ".checkpoint." in p or
+                      p.endswith(".checkpoint.parquet"), once=False)
+    with pytest.raises(Exception):
+        Table.for_path(path, eng).checkpoint()
+    # _last_checkpoint must not point at a checkpoint that failed to write
+    snap = Table.for_path(path, eng).latest_snapshot()
+    assert snap.version == 3 and snap.num_files == 4
+
+    store._write_faults.clear()
+    Table.for_path(path, eng).checkpoint()
+    seg = Table.for_path(path, eng).latest_snapshot().log_segment
+    assert seg.checkpoints  # the retried checkpoint is discovered
+    assert Table.for_path(path, eng).latest_snapshot().num_files == 4
+
+
+def test_blocked_commit_loses_race_and_rebases():
+    """Writer A stalls inside its commit-file write (stalled rename /
+    slow storage); writer B commits the same version meanwhile. A's
+    write must fail with the conflict, rebase, and land at the next
+    version — both appends survive."""
+    eng, store = _engine_with_faults()
+    path = TBL + "2"
+    dta.write_table(path, _data(), engine=eng)
+
+    release = store.block_writes(
+        lambda p: p.endswith("1.json") and threading.current_thread().name
+        == "writer-a")
+    done = threading.Event()
+    errors = []
+
+    def slow_writer():
+        try:
+            dta.write_table(path, _data(start=100), mode="append",
+                            engine=eng)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=slow_writer, name="writer-a")
+    t.start()
+    # B wins version 1 while A is stalled
+    dta.write_table(path, _data(start=200), mode="append", engine=eng)
+    release.set()
+    assert done.wait(30)
+    t.join()
+    assert not errors
+    snap = Table.for_path(path, eng).latest_snapshot()
+    assert snap.version == 2 and snap.num_files == 3
+    out = dta.read_table(path, engine=eng)
+    assert out.num_rows == 15
+
+
+def test_duplicate_checkpoint_writers_tolerated():
+    """Two 'tasks' checkpointing the same version (zombie-task shape,
+    `Checkpoints.scala:752-767`): the second write of the same
+    checkpoint content must not corrupt anything."""
+    eng, store = _engine_with_faults()
+    path = TBL + "3"
+    for i in range(3):
+        dta.write_table(path, _data(start=i * 5), engine=eng,
+                        mode="error" if i == 0 else "append")
+    Table.for_path(path, eng).checkpoint()
+    Table.for_path(path, eng).checkpoint()  # duplicate/zombie retry
+    snap = Table.for_path(path, eng).latest_snapshot()
+    assert snap.num_files == 3
+    assert dta.read_table(path, engine=eng).num_rows == 15

@@ -18,6 +18,15 @@ def _scan(lines):
     return buf, native.scan_actions(buf)
 
 
+def _vals(col, dtype):
+    """Numeric column -> (numpy values, validity); values may be a
+    zero-copy arrow buffer."""
+    vals, valid = col
+    if not isinstance(vals, np.ndarray):
+        vals = np.frombuffer(bytes(vals), dtype=dtype)
+    return vals, valid
+
+
 def test_scan_basic_fields():
     buf, scan = _scan([
         '{"add":{"path":"a.parquet","partitionValues":{"d":"x"},"size":10,'
@@ -27,8 +36,9 @@ def test_scan_basic_fields():
     ])
     assert scan.n_rows == 2 and scan.n_others == 1 and scan.n_lines == 3
     assert scan.is_add.tolist() == [True, False]
-    assert scan.size[0][0] == 10 and scan.size[1].tolist() == [True, False]
-    assert scan.del_ts[0][1] == 7
+    size_v, size_ok = _vals(scan.size, np.int64)
+    assert size_v[0] == 10 and size_ok.tolist() == [True, False]
+    assert _vals(scan.del_ts, np.int64)[0][1] == 7
     assert scan.data_change[0].tolist() == [True, False]
 
 
@@ -48,8 +58,9 @@ def test_scan_dv_and_null_pv_values():
         '"sizeInBytes":9,"cardinality":2,"maxRowIndex":77}}}',
     ])
     assert scan.dv_valid.tolist() == [True]
-    assert scan.dv_offset[0][0] == 3 and scan.dv_card[0][0] == 2
-    assert scan.dv_maxrow[0][0] == 77
+    assert _vals(scan.dv_offset, np.int32)[0][0] == 3
+    assert _vals(scan.dv_card, np.int64)[0][0] == 2
+    assert _vals(scan.dv_maxrow, np.int64)[0][0] == 77
     _, _, vvalid = scan.pv_val
     assert vvalid.tolist() == [False]
 
