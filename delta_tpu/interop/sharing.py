@@ -19,6 +19,10 @@ from __future__ import annotations
 
 import json
 import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -49,22 +53,126 @@ class ShareProfile:
         )
 
 
-class SharingClient:
-    def __init__(self, profile: ShareProfile, transport: Transport):
+class HttpTransport:
+    """Real REST transport (urllib, stdlib-only) for the Delta Sharing
+    protocol — the piece the reference implements in
+    `sharing/.../DeltaSharingRestClient` (via the delta-sharing client
+    lib). GET for list/version endpoints, POST for `/query` and
+    `/changes` (newline-delimited JSON responses). Bearer auth from the
+    profile; 429/5xx retried with exponential backoff honouring
+    `Retry-After`."""
+
+    def __init__(self, profile: ShareProfile, timeout: float = 60.0,
+                 max_retries: int = 4):
         self.profile = profile
-        self.transport = transport
+        self.timeout = timeout
+        self.max_retries = max_retries
+
+    def _request(self, url: str, body: Optional[dict]):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data, method="GET" if body is None else "POST")
+        if self.profile.bearer_token:
+            req.add_header(
+                "Authorization", f"Bearer {self.profile.bearer_token}")
+        if data is not None:
+            req.add_header("Content-Type", "application/json; charset=utf-8")
+        delay = 0.5
+        for attempt in range(self.max_retries + 1):
+            try:
+                return urllib.request.urlopen(req, timeout=self.timeout)
+            except urllib.error.HTTPError as e:
+                retryable = e.code == 429 or e.code >= 500
+                if not retryable or attempt == self.max_retries:
+                    detail = ""
+                    try:
+                        detail = e.read().decode(errors="replace")[:500]
+                    except Exception:
+                        pass
+                    raise DeltaError(
+                        f"sharing server returned HTTP {e.code} for "
+                        f"{url}: {detail}") from e
+                retry_after = e.headers.get("Retry-After")
+                try:
+                    # HTTP-date form (RFC 7231) isn't numeric; fall back
+                    wait = float(retry_after) if retry_after else delay
+                except ValueError:
+                    wait = delay
+                time.sleep(min(wait, 8.0))
+                delay = min(delay * 2, 8.0)
+            except urllib.error.URLError as e:
+                if attempt == self.max_retries:
+                    raise DeltaError(
+                        f"sharing server unreachable at {url}: {e.reason}"
+                    ) from e
+                time.sleep(delay)
+                delay = min(delay * 2, 8.0)
+
+    def __call__(self, path: str, body: Optional[dict]) -> dict:
+        resp = self._request(self.profile.endpoint + path, body)
+        with resp:
+            raw = resp.read()
+            headers = resp.headers
+        version = headers.get("Delta-Table-Version")
+        base = path.split("?", 1)[0]
+        if base.endswith("/version"):
+            # version is carried by the response header, not the body
+            return {"deltaTableVersion":
+                    int(version) if version is not None else None}
+        ctype = headers.get("Content-Type", "")
+        if base.endswith(("/query", "/changes")) or "ndjson" in ctype:
+            out: dict = {"lines": [ln for ln in raw.decode().splitlines()
+                                   if ln.strip()]}
+        else:
+            out = json.loads(raw) if raw.strip() else {}
+        if version is not None:
+            out.setdefault("deltaTableVersion", int(version))
+        return out
+
+
+class SharingClient:
+    def __init__(self, profile: ShareProfile,
+                 transport: Optional[Transport] = None):
+        self.profile = profile
+        self.transport = (transport if transport is not None
+                          else HttpTransport(profile))
+
+    def _paged_items(self, path: str) -> List[dict]:
+        """Drain a paginated list endpoint (nextPageToken protocol)."""
+        items: List[dict] = []
+        token: Optional[str] = None
+        while True:
+            page_path = path
+            if token is not None:
+                sep = "&" if "?" in path else "?"
+                page_path = (f"{path}{sep}pageToken="
+                             f"{urllib.parse.quote(token, safe='')}")
+            resp = self.transport(page_path, None)
+            items.extend(resp.get("items", []))
+            token = resp.get("nextPageToken")
+            if not token:
+                return items
 
     def list_shares(self) -> List[str]:
-        resp = self.transport("/shares", None)
-        return [s["name"] for s in resp.get("items", [])]
+        return [s["name"] for s in self._paged_items("/shares")]
 
     def list_schemas(self, share: str) -> List[str]:
-        resp = self.transport(f"/shares/{share}/schemas", None)
-        return [s["name"] for s in resp.get("items", [])]
+        return [s["name"] for s in self._paged_items(f"/shares/{share}/schemas")]
 
     def list_tables(self, share: str, schema: str) -> List[str]:
-        resp = self.transport(f"/shares/{share}/schemas/{schema}/tables", None)
-        return [t["name"] for t in resp.get("items", [])]
+        return [t["name"] for t in
+                self._paged_items(f"/shares/{share}/schemas/{schema}/tables")]
+
+    def table_version(self, share: str, schema: str, table: str,
+                      starting_timestamp: Optional[str] = None) -> Optional[int]:
+        """GET .../version — the server reports the current table version
+        in the `Delta-Table-Version` response header."""
+        path = f"/shares/{share}/schemas/{schema}/tables/{table}/version"
+        if starting_timestamp is not None:
+            path += ("?startingTimestamp="
+                     + urllib.parse.quote(starting_timestamp, safe=""))
+        resp = self.transport(path, None)
+        return resp.get("deltaTableVersion")
 
     def query_table(
         self,

@@ -295,7 +295,11 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
     if lib.das_error(h):
         lib.das_free(h)
         return None
-    return ScanResult(lib, h)  # handle ownership moves to the result
+    try:
+        return ScanResult(lib, h)  # handle ownership moves to the result
+    except BaseException:
+        lib.das_free(h)
+        raise
 
 
 def scan_commit_files(paths) -> Optional[tuple]:
@@ -326,7 +330,11 @@ def scan_commit_files(paths) -> Optional[tuple]:
         if lib.das_error(sh):
             lib.das_free(sh)
             return None
-        scan = ScanResult(lib, sh)  # handle ownership moves to the result
+        try:
+            scan = ScanResult(lib, sh)  # ownership moves to the result
+        except BaseException:
+            lib.das_free(sh)
+            raise
         # slice the non-file-action lines out while the buffer is alive
         raw = (ctypes.c_char * total).from_address(buf_ptr) if total else b""
         others = [bytes(raw[int(s):int(e)])

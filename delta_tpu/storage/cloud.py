@@ -261,18 +261,41 @@ class GCSLogStore(LogStore):
         return False  # uploads are atomic per object
 
 
+class _HeldPathLock:
+    __slots__ = ("_locks", "_path")
+
+    def __init__(self, locks: "_PathLocks", path: str):
+        self._locks = locks
+        self._path = path
+
+    def release(self) -> None:
+        self._locks._release(self._path)
+
+
 class _PathLocks:
-    """Per-path in-process locks (reference `PathLock.java` role)."""
+    """Per-path in-process locks (reference `PathLock.java` role).
+    Entries are refcounted and dropped when the last holder/waiter
+    releases — commit paths are unique per version, so an unbounded map
+    would leak one Lock per commit for the life of the process."""
 
     def __init__(self):
         self._guard = threading.Lock()
-        self._locks: Dict[str, threading.Lock] = {}
+        self._locks: Dict[str, list] = {}  # path -> [Lock, refcount]
 
-    def acquire(self, path: str) -> threading.Lock:
+    def acquire(self, path: str) -> _HeldPathLock:
         with self._guard:
-            lk = self._locks.setdefault(path, threading.Lock())
-        lk.acquire()
-        return lk
+            entry = self._locks.setdefault(path, [threading.Lock(), 0])
+            entry[1] += 1
+        entry[0].acquire()
+        return _HeldPathLock(self, path)
+
+    def _release(self, path: str) -> None:
+        with self._guard:
+            entry = self._locks[path]
+            entry[0].release()
+            entry[1] -= 1
+            if entry[1] == 0:
+                del self._locks[path]
 
 
 class S3SingleDriverLogStore(DelegatingLogStore):
