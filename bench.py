@@ -189,7 +189,7 @@ jax.devices()  # device / tunnel init outside the timed region
 from delta_tpu.engine.tpu import TpuEngine
 from delta_tpu.table import Table
 out = []
-for run in range(2):
+for run in range(3):
     t0 = time.perf_counter()
     snap = Table.for_path({path!r}, TpuEngine()).latest_snapshot()
     nf = snap.num_files

@@ -107,15 +107,18 @@ def build_log_segment(
     except FileNotFoundError:
         raise TableNotFoundError(f"no _delta_log at {log_path}")
 
-    deltas: List[FileStatus] = []
+    # (version, fstat) pairs: each name is parsed exactly once — at 100k
+    # commits the repeated delta_version() calls below were measurable
+    deltas: List[tuple] = []
     checkpoint_files: List[CheckpointInstance] = []
     compacted: List[FileStatus] = []
+    delta_match = filenames.DELTA_FILE_RE.match
     for fstat in listing:
         name = filenames.file_name(fstat.path)
-        if filenames.DELTA_FILE_RE.match(name):
-            v = filenames.delta_version(fstat.path)
+        if delta_match(name):
+            v = int(name.split(".", 1)[0])
             if target_version is None or v <= target_version:
-                deltas.append(fstat)
+                deltas.append((v, fstat))
         elif filenames.CHECKPOINT_FILE_RE.match(name) and fstat.size > 0:
             ci = CheckpointInstance.parse(fstat.path)
             if ci is not None and (target_version is None or ci.version <= target_version):
@@ -139,10 +142,8 @@ def build_log_segment(
     cp_version = chosen_checkpoint[0].version if chosen_checkpoint else None
 
     window_start = (cp_version + 1) if cp_version is not None else 0
-    deltas_in_window = [
-        f for f in deltas if filenames.delta_version(f.path) >= window_start
-    ]
-    versions = [filenames.delta_version(f.path) for f in deltas_in_window]
+    deltas_in_window = [(v, f) for v, f in deltas if v >= window_start]
+    versions = [v for v, _ in deltas_in_window]
 
     if target_version is None:
         if versions:
@@ -161,10 +162,8 @@ def build_log_segment(
                 latest=have_max,
             )
 
-    deltas_needed = [
-        f for f in deltas_in_window if filenames.delta_version(f.path) <= version
-    ]
-    needed_versions = [filenames.delta_version(f.path) for f in deltas_needed]
+    deltas_needed = [f for v, f in deltas_in_window if v <= version]
+    needed_versions = [v for v, _ in deltas_in_window if v <= version]
     if needed_versions:
         _verify_deltas_contiguous(needed_versions, window_start, version)
     elif cp_version is None:

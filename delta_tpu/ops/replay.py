@@ -390,6 +390,38 @@ def _fa_from_hint(
                     sub, sub_radix, n, m)
 
 
+class ReplayPending:
+    """A launched (asynchronously dispatched) replay: the device owns the
+    sort while the host keeps working — call `finish()` to block on the
+    winner words and split them into (live, tombstone) masks."""
+
+    __slots__ = ("_winner", "_add_words", "_n", "_perm")
+
+    def __init__(self, winner, add_words: np.ndarray, n: int, perm):
+        self._winner = winner
+        self._add_words = add_words
+        self._n = n
+        self._perm = perm
+
+    def finish(self) -> tuple[np.ndarray, np.ndarray]:
+        n = self._n
+        if n == 0:
+            z = np.zeros((0,), dtype=bool)
+            return z, z
+        winner_words = np.asarray(self._winner)
+        live_words = winner_words & self._add_words
+        tomb_words = winner_words & ~self._add_words
+        live = _unpack_bits(live_words, n)
+        tomb = _unpack_bits(tomb_words, n)
+        if self._perm is not None:
+            inv_live = np.zeros(n, dtype=bool)
+            inv_tomb = np.zeros(n, dtype=bool)
+            inv_live[self._perm] = live
+            inv_tomb[self._perm] = tomb
+            live, tomb = inv_live, inv_tomb
+        return live, tomb
+
+
 def replay_select(
     key_lanes: Sequence[np.ndarray],
     version: np.ndarray,
@@ -410,10 +442,27 @@ def replay_select(
     chronological order (the columnarizer's contract) they never leave
     the host.
     """
+    return replay_select_launch(
+        key_lanes, version, order, is_add, device=device,
+        fa_hint=fa_hint).finish()
+
+
+def replay_select_launch(
+    key_lanes: Sequence[np.ndarray],
+    version: np.ndarray,
+    order: np.ndarray,
+    is_add: np.ndarray,
+    device=None,
+    fa_hint: Optional[tuple] = None,
+) -> ReplayPending:
+    """Asynchronous half of `replay_select`: packs + ships the operands
+    and dispatches the device kernel, returning immediately (jax calls
+    are async). The caller overlaps host work (e.g. Arrow table
+    assembly) with the device sort and calls `.finish()` when it needs
+    the masks."""
     n = int(version.shape[0])
     if n == 0:
-        z = np.zeros((0,), dtype=bool)
-        return z, z
+        return ReplayPending(None, np.empty(0, np.uint32), 0, None)
 
     perm = None
     if not chrono_ok(np.asarray(version), np.asarray(order)):
@@ -445,8 +494,8 @@ def replay_select(
                     n_op, add_words_np)
         if device is not None:
             operands = tuple(jax.device_put(o, device) for o in operands)
-        winner_words = np.asarray(_winner_kernel_fa(
-            operands, ref_width=len(fa.ref_planes), has_sub=has_sub))
+        winner_words = _winner_kernel_fa(
+            operands, ref_width=len(fa.ref_planes), has_sub=has_sub)
     else:
         combined = combine_key_lanes(lanes)
         if combined is not None:
@@ -463,19 +512,9 @@ def replay_select(
         operands = (*key_ops, n_op, add_words_np)
         if device is not None:
             operands = tuple(jax.device_put(o, device) for o in operands)
-        winner_words = np.asarray(_winner_kernel(operands, width=width))
+        winner_words = _winner_kernel(operands, width=width)
 
-    live_words = winner_words & add_words_np
-    tomb_words = winner_words & ~add_words_np
-    live = _unpack_bits(live_words, n)
-    tomb = _unpack_bits(tomb_words, n)
-    if perm is not None:
-        inv_live = np.zeros(n, dtype=bool)
-        inv_tomb = np.zeros(n, dtype=bool)
-        inv_live[perm] = live
-        inv_tomb[perm] = tomb
-        live, tomb = inv_live, inv_tomb
-    return live, tomb
+    return ReplayPending(winner_words, add_words_np, n, perm)
 
 
 def python_replay_reference(

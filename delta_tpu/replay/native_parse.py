@@ -82,7 +82,8 @@ def _path_column(scan) -> tuple:
     return col, decoded is uniq
 
 
-def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
+def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray,
+                          path_and_ok=None):
     """ScanResult + per-row tags -> canonical Arrow table (+ dv struct
     pieces needed for dv_id derivation, done by the caller with the same
     expressions as the generic path)."""
@@ -93,7 +94,8 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray):
     )
 
     n = scan.n_rows
-    path, codes_ok = _path_column(scan)
+    path, codes_ok = (path_and_ok if path_and_ok is not None
+                      else _path_column(scan))
     keys = _str_array(scan.pv_key)
     items = _str_array(scan.pv_val)
     map_type = pa.map_(pa.string(), pa.string())
@@ -165,23 +167,38 @@ def _finish_scan(
     file_starts: np.ndarray,
     file_versions: np.ndarray,
     small_only: bool,
+    launch=None,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
-                    Optional[NativeReplayKeys]]]:
+                    Optional[NativeReplayKeys], Optional[object]]]:
+    """`launch`: optional callable (scan, row_versions, row_orders) ->
+    pending-replay handle, invoked BEFORE the Arrow assembly so the
+    device sorts while the host builds the canonical table. Only called
+    when the scanner's codes key the final column exactly (no percent
+    decoding collapse, no DV lane)."""
     line_versions, line_orders = line_tags(
         scan.line_starts, file_starts, file_versions)
     keys: Optional[NativeReplayKeys] = None
+    pending = None
     if small_only:
         from delta_tpu.replay.columnar import CANONICAL_FILE_ACTION_SCHEMA
 
         table = CANONICAL_FILE_ACTION_SCHEMA.empty_table()
     else:
+        path_and_ok = _path_column(scan)
+        row_versions = (line_versions[scan.line_no] if scan.n_rows
+                        else np.empty(0, np.int64))
+        row_orders = (line_orders[scan.line_no] if scan.n_rows
+                      else np.empty(0, np.int32))
+        if (launch is not None and path_and_ok[1] and scan.n_rows
+                and not bool(scan.dv_valid.any())):
+            pending = launch(scan, row_versions, row_orders)
         table, codes_ok = build_canonical_table(
-            scan,
-            line_versions[scan.line_no] if scan.n_rows else np.empty(0, np.int64),
-            line_orders[scan.line_no] if scan.n_rows else np.empty(0, np.int32),
-        )
+            scan, row_versions, row_orders, path_and_ok=path_and_ok)
         if codes_ok:
             keys = NativeReplayKeys(scan)
+    # NOTE: a malformed control line below aborts AFTER a launch may have
+    # been issued; the pending handle is simply dropped (harmless async
+    # work) and the generic path re-parses.
     others: List[Tuple[int, int, dict]] = []
     for ln, raw in zip(scan.other_line_no.tolist(), others_raw):
         try:
@@ -189,7 +206,7 @@ def _finish_scan(
         except ValueError:
             return None  # malformed control line: let the generic path err
         others.append((int(line_versions[ln]), int(line_orders[ln]), row))
-    return table, others, keys
+    return table, others, keys, pending
 
 
 def parse_commits_native(
@@ -197,15 +214,16 @@ def parse_commits_native(
     file_starts: np.ndarray,
     file_versions: np.ndarray,
     small_only: bool = False,
+    launch=None,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
-                    Optional[NativeReplayKeys]]]:
+                    Optional[NativeReplayKeys], Optional[object]]]:
     """Native fast path over one concatenated commit buffer.
 
     Returns (canonical file-actions table, [(version, order, action-dict)
-    for non-file actions], replay-key sidecar) or None when the native
-    scanner is unavailable/fails (caller uses the generic Arrow parser).
-    `small_only` skips materializing the file-action table (the P&M fast
-    path throws it away)."""
+    for non-file actions], replay-key sidecar, pending-replay handle) or
+    None when the native scanner is unavailable/fails (caller uses the
+    generic Arrow parser). `small_only` skips materializing the
+    file-action table (the P&M fast path throws it away)."""
     from delta_tpu import native
 
     scan = native.scan_actions(buf)
@@ -216,15 +234,16 @@ def parse_commits_native(
                   for s, e in zip(scan.other_start.tolist(),
                                   scan.other_end.tolist())]
     return _finish_scan(scan, others_raw, file_starts, file_versions,
-                        small_only)
+                        small_only, launch=launch)
 
 
 def parse_commit_paths_native(
     local_paths: List[str],
     file_versions: np.ndarray,
     small_only: bool = False,
+    launch=None,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
-                    Optional[NativeReplayKeys], int]]:
+                    Optional[NativeReplayKeys], Optional[object], int]]:
     """Native read+scan of local commit files in one round-trip (no
     per-file Python I/O). Returns (..., total_bytes) or None."""
     from delta_tpu import native
@@ -233,7 +252,8 @@ def parse_commit_paths_native(
     if out is None:
         return None
     scan, others_raw, starts, total = out
-    fin = _finish_scan(scan, others_raw, starts, file_versions, small_only)
+    fin = _finish_scan(scan, others_raw, starts, file_versions, small_only,
+                       launch=launch)
     if fin is None:
         return None
     return (*fin, total)

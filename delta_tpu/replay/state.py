@@ -188,6 +188,11 @@ def compute_masks_device(
     if n == 0:
         z = np.zeros(0, bool)
         return z, z
+    pending = columnar.pending_masks
+    if pending is not None:
+        # device replay was dispatched during columnarization (overlapped
+        # with the Arrow assembly) — just collect the masks
+        return pending.finish()
     keys = columnar.replay_keys
     fa_hint = None
     if keys is not None and len(keys.path_code) == n:
@@ -211,7 +216,7 @@ def compute_masks_device(
 
         live, tomb, _, _ = sharded_replay_select(
             path_codes, dv_codes, version.astype(np.int32), order, is_add,
-            mesh=mesh,
+            mesh=mesh, fa_hint=fa_hint,
         )
         return live, tomb
     return replay_select(
