@@ -4,26 +4,39 @@ This is the TPU-native counterpart of the reference's distributed replay
 (`Snapshot.scala:481-511`): shuffle by path hash, per-partition
 reconcile. Here:
 
-1. HOST ROUTE — rows are binned by `key % n_shards` (the "shuffle"; a
-   stable numpy argsort by shard id, so each shard's rows stay in
-   chronological order and the in-shard row index is the chronological
-   rank). Because the replay key determines its shard, per-shard
-   reconciliation is globally correct with zero cross-device key
-   exchange.
-2. DEVICE — a [n_shards, bucket] batch is laid out with
-   `NamedSharding(mesh, P('shard', None))`; under `shard_map` each device
-   runs the same (key, chrono) sort + run-boundary last-wins reduce as
-   the single-chip kernel on its local rows, then contributes to global
-   aggregates (live-file count, total bytes) with `psum` over the ICI.
-3. HOST GATHER — per-shard masks come back and are scattered to the
-   original row order. Padding rows never reach the output (their
-   scatter index is -1) and contribute zero to the aggregates (is_add
-   False, size 0), so no validity lane ships at all.
+1. HOST ROUTE — rows are binned by `path_key % n_shards` (the
+   "shuffle"; a stable numpy argsort by shard id, so each shard's rows
+   stay in chronological order and the in-shard row index is the
+   chronological rank). The key fully determines its shard, so
+   per-shard reconciliation is globally correct with zero cross-device
+   key exchange. Rows sharing a path (any DV id) land together.
+2. TRANSFER — the same first-appearance delta coding as the
+   single-chip kernel (`ops/replay.py`), per shard. The trick that
+   makes it free: global path codes are dense first-appearance codes,
+   so shard s's local code for path c ≡ s (mod S) is exactly c // S —
+   itself a dense first-appearance coding of the shard's stream. The
+   global `is_new` flags route through unchanged; explicit refs ship as
+   byte planes; the DV lane ships sparse; is_add ships bit-packed.
+   ~1-2 bits/row crosses the link instead of 9 bytes/row.
+3. DEVICE — under `shard_map` each device rebuilds its local code
+   lane with a cumsum + gather, runs the same (key, chrono) sort +
+   run-boundary last-wins reduce as the single-chip kernel, and
+   contributes to global aggregates (live-file count, live bytes) with
+   `psum` over the ICI. Winner masks come home bit-packed (32x smaller
+   D2H).
+4. HOST GATHER — per-shard winner words are unpacked, split into
+   live/tombstone with the host-resident add bits, and scattered back
+   to the original row order.
+
+Streams that aren't first-appearance-coded (host-hashed keys, permuted
+histories) fall back to shipping raw u32 key lanes — same kernel tail,
+fatter transfer.
 
 Multi-host scale-out: the mesh spans hosts; each host routes only the
 rows it parsed (`jax.make_array_from_process_local_data`), the psum
 rides ICI within a pod and DCN across pods — no NCCL/MPI analogue
-needed, XLA owns the collectives.
+needed, XLA owns the collectives. See tests/test_multiprocess.py for
+the 2-process jax.distributed harness.
 """
 
 from __future__ import annotations
@@ -42,15 +55,20 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from delta_tpu.ops.replay import _PAD_KEY, chrono_ok, combine_key_lanes, pad_bucket
+from delta_tpu.ops.replay import (
+    _PAD_KEY,
+    _decode_planes,
+    _sort_winner_pack,
+    _unpack_bits,
+    _unpack_bits_device,
+    chrono_ok,
+    key_byte_width,
+    pad_bucket,
+)
 from delta_tpu.parallel.mesh import REPLAY_AXIS, make_mesh
 
 
-class ShardedReplayOut(NamedTuple):
-    live: jax.Array        # [S, M] bool
-    tombstone: jax.Array   # [S, M] bool
-    num_live: jax.Array    # [] int32, global (psum over shards)
-    live_bytes: jax.Array  # [] float32, global
+# --------------------------------------------------------------- raw path
 
 
 def _shard_kernel(key, is_add, size):
@@ -77,7 +95,8 @@ def _shard_kernel(key, is_add, size):
 
 
 def build_sharded_replay_fn(mesh: Mesh):
-    """jit'd [S, M]-batch replay over `mesh` (S = mesh size)."""
+    """jit'd [S, M]-batch replay over `mesh` (S = mesh size) — raw-key
+    operands (uint32 key, bool add, f32 size)."""
     spec = P(REPLAY_AXIS, None)
     fn = shard_map(
         _shard_kernel,
@@ -97,24 +116,15 @@ def route_to_shards(
     size: Optional[np.ndarray],
     n_shards: int,
 ):
-    """Host-side shuffle: returns ([S, M] operand arrays (key, is_add,
-    size), scatter indexes) where scatter_index[s, j] = original row (or
-    -1 for padding)."""
+    """Host-side shuffle for the raw path: returns ([S, M] operand
+    arrays (key, is_add, size), scatter indexes) where
+    scatter_index[s, j] = original row (or -1 for padding)."""
     n = len(path_key)
     # perm=None in the common chronological case avoids three O(n) copies
     perm = None
     if not chrono_ok(np.asarray(version), np.asarray(order)):
         perm = np.lexsort((order, version)).astype(np.int64)
-    key = combine_key_lanes([path_key, dv_key])
-    if key is None:
-        # lanes too wide to combine: re-encode to dense uint32 codes via a
-        # 64-bit fold + np.unique (exact; a single routing batch never
-        # holds 2^32 distinct logical files). Dense codes also keep every
-        # real key below the 0xFFFFFFFF pad sentinel — the kernel relies
-        # on pads owning that key exclusively for aggregate correctness.
-        wide = path_key.astype(np.uint64) << np.uint64(32) | dv_key.astype(np.uint64)
-        _, key = np.unique(wide, return_inverse=True)
-        key = key.astype(np.uint32)
+    key = _combined_u32(path_key, dv_key)
     is_add = np.asarray(is_add, bool)
     size_p = None if size is None else np.asarray(size)
     if perm is not None:
@@ -123,19 +133,13 @@ def route_to_shards(
         size_p = None if size_p is None else size_p[perm]
 
     shard_of = (key % np.uint32(n_shards)).astype(np.int64)
-    sort_idx = np.argsort(shard_of, kind="stable")
-    counts = np.bincount(shard_of, minlength=n_shards)
-    m = pad_bucket(int(counts.max(initial=1)))
+    sort_idx, rows, cols, counts, m = _shard_coords(shard_of, n_shards)
 
     k = np.full((n_shards, m), _PAD_KEY, dtype=np.uint32)
     add = np.zeros((n_shards, m), dtype=np.bool_)
     sz = np.zeros((n_shards, m), dtype=np.float32)
     scatter = np.full((n_shards, m), -1, dtype=np.int32)
 
-    starts = np.zeros(n_shards + 1, dtype=np.int64)
-    np.cumsum(counts, out=starts[1:])
-    rows = shard_of[sort_idx]
-    cols = np.arange(n) - starts[rows]
     k[rows, cols] = key[sort_idx]
     add[rows, cols] = is_add[sort_idx]
     if size_p is not None:
@@ -143,6 +147,217 @@ def route_to_shards(
     orig = sort_idx if perm is None else perm[sort_idx]
     scatter[rows, cols] = orig.astype(np.int32)
     return (k, add, sz), scatter
+
+
+def _combined_u32(path_key: np.ndarray, dv_key: np.ndarray) -> np.ndarray:
+    """Combined (path, dv) -> one dense uint32 lane below the pad
+    sentinel (re-encoding through np.unique when the radix product
+    overflows)."""
+    from delta_tpu.ops.replay import combine_key_lanes
+
+    key = combine_key_lanes([path_key, dv_key])
+    if key is None:
+        wide = path_key.astype(np.uint64) << np.uint64(32) | dv_key.astype(
+            np.uint64)
+        _, key = np.unique(wide, return_inverse=True)
+        key = key.astype(np.uint32)
+    return key
+
+
+def _shard_coords(shard_of: np.ndarray, n_shards: int):
+    """(sort_idx, rows, cols, counts, padded bucket M) of the stable
+    shard sort."""
+    n = len(shard_of)
+    sort_idx = np.argsort(shard_of, kind="stable")
+    counts = np.bincount(shard_of, minlength=n_shards)
+    m = pad_bucket(int(counts.max(initial=1)))
+    starts = np.zeros(n_shards + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    rows = shard_of[sort_idx]
+    cols = np.arange(n) - starts[rows]
+    return sort_idx, rows, cols, counts, m
+
+
+# ---------------------------------------------------------------- FA path
+
+
+class ShardedFAOperands(NamedTuple):
+    """Routed, delta-coded device operands + host bookkeeping."""
+    flag_words: np.ndarray        # [S, M/32] u32 is_new bits
+    ref_planes: tuple             # each [S, R] u8 (little-endian planes)
+    sub_radix: int                # DV lane radix (1 = no DV anywhere)
+    sub_idx: np.ndarray           # [S, D] u32 in-shard rows (pad 0xFFFFFFFF)
+    sub_val: np.ndarray           # [S, D] u32
+    n_real: np.ndarray            # [S, 1] i32 rows per shard
+    add_words: np.ndarray         # [S, M/32] u32 is_add bits
+    scatter: np.ndarray           # [S, M] i32 original row (-1 = pad)
+    m: int
+    nbytes: int                   # H2D payload bytes (transfer accounting)
+
+
+def derive_fa_flags(primary: np.ndarray) -> Optional[np.ndarray]:
+    """is_new flags if `primary` is a dense first-appearance coding
+    (every new value == prev_max + 1, new values are 0,1,2,...)."""
+    p64 = primary.astype(np.int64, copy=False)
+    if len(p64) == 0:
+        return np.zeros(0, dtype=bool)
+    run_max = np.maximum.accumulate(p64)
+    prev_max = np.empty_like(run_max)
+    prev_max[0] = -1
+    prev_max[1:] = run_max[:-1]
+    is_new = p64 == prev_max + 1
+    n_new = int(is_new.sum())
+    if not np.array_equal(p64[is_new], np.arange(n_new, dtype=np.int64)):
+        return None
+    return is_new
+
+
+def route_to_shards_fa(
+    path_key: np.ndarray,
+    dv_key: np.ndarray,
+    is_new: np.ndarray,
+    is_add: np.ndarray,
+    n_shards: int,
+) -> Optional[ShardedFAOperands]:
+    """FA-coded routing (chronological input required — caller permutes
+    first). Returns None when ranges don't fit (caller falls back to the
+    raw route)."""
+    n = len(path_key)
+    path_key = np.asarray(path_key, np.uint32)
+    dv_key = np.asarray(dv_key, np.uint32)
+    n_uniq = (int(path_key.max()) + 1) if n else 0
+    local_max = (n_uniq - 1) // n_shards if n_uniq else 0
+    sub_radix = int(dv_key.max(initial=0)) + 1
+    # the device key is local_code * sub_radix + dv; keep the pad
+    # sentinel exclusive
+    if (local_max + 1) * sub_radix >= 0xFFFFFFFF:
+        return None
+
+    shard_of = (path_key % np.uint32(n_shards)).astype(np.int64)
+    sort_idx, rows, cols, counts, m = _shard_coords(shard_of, n_shards)
+
+    # is_new flags route through unchanged (a globally-new path is new
+    # in its shard; refs always target a path first seen in the SAME
+    # shard because routing is by path)
+    flags = np.zeros((n_shards, m), dtype=np.bool_)
+    flags[rows, cols] = np.asarray(is_new, bool)[sort_idx]
+    flag_words = np.packbits(flags, axis=1, bitorder="little").view(np.uint32)
+
+    add = np.zeros((n_shards, m), dtype=np.bool_)
+    add[rows, cols] = np.asarray(is_add, bool)[sort_idx]
+    add_words = np.packbits(add, axis=1, bitorder="little").view(np.uint32)
+
+    # explicit refs: non-new rows, local code = global code // S, in
+    # shard-stream order (the stable sort preserves it)
+    sorted_new = np.asarray(is_new, bool)[sort_idx]
+    ref_rows = rows[~sorted_new]
+    ref_vals = (path_key[sort_idx][~sorted_new] //
+                np.uint32(n_shards)).astype(np.uint32)
+    ref_counts = np.bincount(ref_rows, minlength=n_shards)
+    r_pad = pad_bucket(int(ref_counts.max(initial=1)), min_bucket=128)
+    ref_width = key_byte_width(local_max)
+    refs2d = np.zeros((n_shards, r_pad), dtype=np.uint32)
+    ref_starts = np.zeros(n_shards + 1, dtype=np.int64)
+    np.cumsum(ref_counts, out=ref_starts[1:])
+    ref_cols = np.arange(len(ref_vals)) - ref_starts[ref_rows]
+    refs2d[ref_rows, ref_cols] = ref_vals
+    rbytes = refs2d.view(np.uint8).reshape(n_shards, r_pad, 4)
+    ref_planes = tuple(
+        np.ascontiguousarray(rbytes[:, :, j]) for j in range(ref_width))
+
+    # DV lane: sparse (in-shard row, value); pad rows scatter-drop
+    if sub_radix > 1:
+        dv_sorted = dv_key[sort_idx]
+        nz = dv_sorted != 0
+        nz_rows = rows[nz]
+        nz_counts = np.bincount(nz_rows, minlength=n_shards)
+        d_pad = pad_bucket(int(nz_counts.max(initial=1)), min_bucket=128)
+        sub_idx = np.full((n_shards, d_pad), 0xFFFFFFFF, dtype=np.uint32)
+        sub_val = np.zeros((n_shards, d_pad), dtype=np.uint32)
+        nz_starts = np.zeros(n_shards + 1, dtype=np.int64)
+        np.cumsum(nz_counts, out=nz_starts[1:])
+        nz_cols = np.arange(int(nz.sum())) - nz_starts[nz_rows]
+        sub_idx[nz_rows, nz_cols] = cols[nz].astype(np.uint32)
+        sub_val[nz_rows, nz_cols] = dv_sorted[nz]
+    else:
+        sub_idx = np.empty((n_shards, 0), dtype=np.uint32)
+        sub_val = np.empty((n_shards, 0), dtype=np.uint32)
+
+    scatter = np.full((n_shards, m), -1, dtype=np.int32)
+    scatter[rows, cols] = sort_idx.astype(np.int32)
+
+    n_real = counts.astype(np.int32).reshape(n_shards, 1)
+    nbytes = (flag_words.nbytes + sum(p.nbytes for p in ref_planes)
+              + sub_idx.nbytes + sub_val.nbytes + n_real.nbytes
+              + add_words.nbytes)
+    return ShardedFAOperands(flag_words, ref_planes, sub_radix, sub_idx,
+                             sub_val, n_real, add_words, scatter,
+                             m, nbytes)
+
+
+def _shard_kernel_fa(ref_width: int, has_sub: bool):
+    """Kernel body factory for the FA-coded sharded replay."""
+
+    def kernel(*ops):
+        flag_words = ops[0][0]
+        ref_planes = tuple(o[0] for o in ops[1:1 + ref_width])
+        rest = ops[1 + ref_width:]
+        if has_sub:
+            sub_radix, sub_idx, sub_val = (rest[0], rest[1][0], rest[2][0])
+            rest = rest[3:]
+        n_real = rest[0][0][0]
+        add_words = rest[1][0]
+
+        m = flag_words.shape[0] * 32
+        is_new = _unpack_bits_device(flag_words)
+        new_rank = jnp.cumsum(is_new.astype(jnp.int32))
+        ref_rank = jnp.arange(1, m + 1, dtype=jnp.int32) - new_rank
+        refs = _decode_planes(ref_planes)
+        ref_gather = refs[jnp.clip(ref_rank - 1, 0, refs.shape[0] - 1)]
+        key = jnp.where(is_new == 1, (new_rank - 1).astype(jnp.uint32),
+                        ref_gather)
+        if has_sub:
+            sub = jnp.zeros((m,), jnp.uint32).at[sub_idx].set(
+                sub_val, mode="drop")
+            key = key * sub_radix + sub
+        iota = jnp.arange(m, dtype=jnp.int32)
+        key = jnp.where(iota < n_real, key, jnp.uint32(0xFFFFFFFF))
+
+        add_bits = _unpack_bits_device(add_words)
+        winner_words = _sort_winner_pack((key,), n_real, add_bits)
+        live_words = winner_words & add_words
+        live_bits = _unpack_bits_device(live_words)
+        local_live = jnp.sum(live_bits.astype(jnp.int32))
+        # the only cross-device exchange in the whole replay: one scalar
+        # psum over the ICI (int32 — exact)
+        num_live = lax.psum(local_live, REPLAY_AXIS)
+        return winner_words[None], num_live
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _fa_fn_cached(mesh: Mesh, ref_width: int, has_sub: bool):
+    spec = P(REPLAY_AXIS, None)
+    in_specs = [spec]                       # flag_words
+    in_specs += [spec] * ref_width          # ref planes
+    if has_sub:
+        in_specs += [P(), spec, spec]       # sub_radix (replicated), idx, val
+    in_specs += [spec, spec]                # n_real, add_words
+    fn = shard_map(
+        _shard_kernel_fa(ref_width, has_sub),
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def build_sharded_replay_fa_fn(mesh: Mesh, ref_width: int, has_sub: bool):
+    return _fa_fn_cached(mesh, ref_width, has_sub)
+
+
+# ------------------------------------------------------------ public API
 
 
 def sharded_replay_select(
@@ -153,9 +368,12 @@ def sharded_replay_select(
     is_add: np.ndarray,
     size: Optional[np.ndarray] = None,
     mesh: Optional[Mesh] = None,
+    fa_hint: Optional[tuple] = None,
 ) -> tuple[np.ndarray, np.ndarray, int, int]:
     """Full pipeline; returns (live_mask, tomb_mask, num_live, live_bytes)
-    in original row order."""
+    in original row order. `fa_hint` = (is_new flags, refs, n_uniq) from
+    the native scanner's in-scan dictionary (refs unused here — the
+    sharded route re-derives per-shard refs from the codes)."""
     if mesh is None:
         mesh = make_mesh()
     n = len(path_key)
@@ -163,22 +381,82 @@ def sharded_replay_select(
         z = np.zeros(0, bool)
         return z, z, 0, 0
     n_shards = mesh.devices.size
-    operands, scatter = route_to_shards(
-        path_key, dv_key, version, order, is_add, size, n_shards
-    )
+
+    size_orig = size  # original row order, for the exact host aggregate
+    perm = None
+    if not chrono_ok(np.asarray(version), np.asarray(order)):
+        perm = np.lexsort((order, version)).astype(np.int64)
+        path_key = np.asarray(path_key)[perm]
+        dv_key = np.asarray(dv_key)[perm]
+        is_add = np.asarray(is_add)[perm]
+        size = None if size is None else np.asarray(size)[perm]
+        fa_hint = None  # hint flags were in original row order
+
+    is_new = fa_hint[0] if fa_hint is not None else None
+    if is_new is None or len(is_new) != n:
+        is_new = derive_fa_flags(np.asarray(path_key))
+
+    fa = None
+    if is_new is not None:
+        fa = route_to_shards_fa(path_key, dv_key, is_new, is_add, n_shards)
     spec = NamedSharding(mesh, P(REPLAY_AXIS, None))
-    device_ops = tuple(jax.device_put(o, spec) for o in operands)
-    fn = _cached_fn(mesh)
-    live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
-    live_sh = np.asarray(live_sh)
-    tomb_sh = np.asarray(tomb_sh)
+    live_bytes = None
+    if fa is not None:
+        has_sub = fa.sub_radix > 1
+        ops = [fa.flag_words, *fa.ref_planes]
+        if has_sub:
+            ops += [np.uint32(fa.sub_radix), fa.sub_idx, fa.sub_val]
+        ops += [fa.n_real, fa.add_words]
+        device_ops = tuple(
+            o if np.isscalar(o) or o.ndim == 0 else jax.device_put(o, spec)
+            for o in ops)
+        # scalar sub_radix is replicated, not sharded
+        fn = build_sharded_replay_fa_fn(mesh, len(fa.ref_planes), has_sub)
+        winner_sh, num_live = fn(*device_ops)
+        winner_words = np.asarray(winner_sh)
+        add_words = fa.add_words
+        live_words = winner_words & add_words
+        tomb_words = winner_words & ~add_words
+        flat_live = _unpack_bits(live_words.ravel(), n_shards * fa.m)
+        flat_tomb = _unpack_bits(tomb_words.ravel(), n_shards * fa.m)
+        scatter = fa.scatter
+        m = fa.m
+    else:
+        operands, scatter = route_to_shards(
+            path_key, dv_key,
+            np.arange(n, dtype=np.int64), np.zeros(n, np.int64),
+            is_add, size, n_shards)
+        device_ops = tuple(jax.device_put(o, spec) for o in operands)
+        fn = _cached_fn(mesh)
+        live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
+        flat_live = np.asarray(live_sh).ravel()
+        flat_tomb = np.asarray(tomb_sh).ravel()
+        m = operands[0].shape[1]
+
     live = np.zeros(n, dtype=bool)
     tomb = np.zeros(n, dtype=bool)
     flat_scatter = scatter.ravel()
     sel = flat_scatter >= 0
-    live[flat_scatter[sel]] = live_sh.ravel()[sel]
-    tomb[flat_scatter[sel]] = tomb_sh.ravel()[sel]
-    return live, tomb, int(num_live), int(live_bytes)
+    live[flat_scatter[sel]] = flat_live[sel]
+    tomb[flat_scatter[sel]] = flat_tomb[sel]
+    if perm is not None:
+        inv_live = np.zeros(n, dtype=bool)
+        inv_tomb = np.zeros(n, dtype=bool)
+        inv_live[perm] = live
+        inv_tomb[perm] = tomb
+        live, tomb = inv_live, inv_tomb
+
+    n_live = int(num_live)
+    if size_orig is not None:
+        if live_bytes is None:
+            # FA route ships no size lane: exact int64 host aggregate
+            # (`live` is already back in original row order here)
+            bytes_out = int(np.asarray(size_orig)[live].sum())
+        else:
+            bytes_out = int(live_bytes)  # raw route's f32 device psum
+    else:
+        bytes_out = 0
+    return live, tomb, n_live, bytes_out
 
 
 @functools.lru_cache(maxsize=8)

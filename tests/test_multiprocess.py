@@ -1,0 +1,137 @@
+"""Two-process distributed replay over a global mesh (jax.distributed).
+
+The multi-host story from the module docstring of
+`parallel/sharded_replay.py`, actually executed: two OS processes, each
+with 4 virtual CPU devices, form one 8-device global mesh via
+`jax.distributed.initialize`. Each process routes ONLY the rows it
+"parsed" (keys are pre-partitioned by `key % 2 == process_id`, the way a
+multi-host columnarizer would split commit files), provides its local
+[4, M] shard blocks with `jax.make_array_from_process_local_data`, and
+runs the same shard_map replay kernel. The `psum` aggregate crosses the
+process boundary (Gloo collectives on CPU; ICI/DCN on real TPU pods) and
+must equal the global sequential reference on BOTH processes; each
+process additionally verifies the winner masks of its own rows.
+
+The subprocesses strip the axon sitecustomize (PYTHONPATH) so the CPU
+platform initializes fresh — mirroring how a real multi-host job
+launches one process per host before any jax import.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+import numpy as np
+sys.path.insert(0, {repo!r})
+from jax.sharding import NamedSharding, PartitionSpec as P
+from delta_tpu.ops.replay import python_replay_reference
+from delta_tpu.parallel.mesh import REPLAY_AXIS, make_mesh
+from delta_tpu.parallel.sharded_replay import build_sharded_replay_fn
+
+assert len(jax.devices()) == 8, len(jax.devices())
+assert len(jax.local_devices()) == 4
+
+# deterministic GLOBAL history, identical in both processes
+rng = np.random.default_rng(0)
+n = 20_000
+key = rng.integers(0, 3000, n).astype(np.uint32)
+ver = np.sort(rng.integers(0, 64, n)).astype(np.int32)
+add = rng.random(n) < 0.6
+size = rng.integers(100, 1000, n).astype(np.int64)
+
+# this process's rows (the files its host "parsed"); shard assignment is
+# process = key % 2, local shard = (key // 2) % 4 — injective per key, so
+# per-shard dedup is globally correct with no cross-device key exchange
+mine = key % 2 == pid
+lk, la, ls = key[mine], add[mine], size[mine]
+n_local = int(mine.sum())
+local_shard = ((lk // 2) % 4).astype(np.int64)
+sort_idx = np.argsort(local_shard, kind="stable")
+counts = np.bincount(local_shard, minlength=4)
+M = 4096
+assert counts.max() <= M
+k = np.full((4, M), 0xFFFFFFFF, np.uint32)
+a = np.zeros((4, M), np.bool_)
+s2 = np.zeros((4, M), np.float32)
+scatter = np.full((4, M), -1, np.int64)
+starts = np.zeros(5, np.int64)
+np.cumsum(counts, out=starts[1:])
+rows = local_shard[sort_idx]
+cols = np.arange(n_local) - starts[rows]
+k[rows, cols] = lk[sort_idx]
+a[rows, cols] = la[sort_idx]
+s2[rows, cols] = ls[sort_idx]
+scatter[rows, cols] = sort_idx
+
+mesh = make_mesh()  # global: 8 devices across both processes
+spec = NamedSharding(mesh, P(REPLAY_AXIS, None))
+gk = jax.make_array_from_process_local_data(spec, k)
+ga = jax.make_array_from_process_local_data(spec, a)
+gs = jax.make_array_from_process_local_data(spec, s2)
+fn = build_sharded_replay_fn(mesh)
+live, tomb, num_live, live_bytes = fn(gk, ga, gs)
+
+# global reference (identical in both processes)
+live_h, tomb_h = python_replay_reference(
+    [(int(x), 0) for x in key], ver, np.zeros(n, np.int32), add)
+# the psum crossed the process boundary: both processes see the GLOBAL count
+assert int(num_live) == int(live_h.sum()), (int(num_live), int(live_h.sum()))
+
+# my rows' masks from my addressable shards
+shards = sorted(live.addressable_shards, key=lambda s: s.index[0].start)
+live_local = np.concatenate([np.asarray(s.data) for s in shards])
+my_live = np.zeros(n_local, bool)
+sel = scatter.ravel() >= 0
+my_live[scatter.ravel()[sel]] = live_local.ravel()[sel]
+expected = live_h[mine]
+assert np.array_equal(my_live, expected), "local winner masks disagree"
+print(f"MP_OK pid={pid} num_live={int(num_live)} rows={n_local}", flush=True)
+"""
+
+
+def test_two_process_distributed_replay(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    # strip the single-chip tunnel sitecustomize; the workers set their
+    # own platform env before importing jax
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    pp = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in pp.split(os.pathsep) if "axon" not in p)
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.replace("{repo!r}", repr(REPO)))
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"MP_OK pid={pid}" in out, out[-3000:]

@@ -103,3 +103,109 @@ def test_step_fn_compiles_with_shardings():
     live, tomb, num_live, live_bytes = fn(*device_ops)
     assert live.shape == ops[0].shape
     assert int(num_live) > 0
+
+
+def _fa_history(rng, n, n_versions, dv_frac=0.0):
+    """First-appearance-coded history (the native scanner's output
+    shape): ~85% of rows introduce a fresh path code, the rest
+    re-reference earlier codes."""
+    is_new = rng.random(n) < 0.85
+    is_new[0] = True
+    new_count = np.cumsum(is_new)
+    back = (rng.random(n) * (new_count - 1)).astype(np.int64)
+    pk = np.where(is_new, new_count - 1, back).astype(np.uint32)
+    dk = np.zeros(n, np.uint32)
+    if dv_frac:
+        dv_rows = rng.random(n) < dv_frac
+        dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
+    ver = np.sort(rng.integers(0, n_versions, n)).astype(np.int32)
+    order = np.zeros(n, np.int32)
+    for v in np.unique(ver):
+        s = ver == v
+        order[s] = np.arange(s.sum())
+    add = is_new | (rng.random(n) < 0.3)
+    size = rng.integers(100, 10_000, n).astype(np.int64)
+    return pk, dk, ver, order, add, size
+
+
+@pytest.mark.parametrize("dv_frac", [0.0, 0.05])
+def test_sharded_fa_path_matches_reference(dv_frac):
+    """The delta-coded sharded route (flags + refs + sparse DV lane)
+    must agree with the sequential reference, including aggregates."""
+    from delta_tpu.parallel.sharded_replay import (
+        derive_fa_flags,
+        route_to_shards_fa,
+    )
+
+    rng = np.random.default_rng(42)
+    pk, dk, ver, order, add, size = _fa_history(rng, 20_000, 64, dv_frac)
+    is_new = derive_fa_flags(pk)
+    assert is_new is not None  # the stream IS first-appearance coded
+    fa = route_to_shards_fa(pk, dk, is_new, add, 8)
+    assert fa is not None
+    # transfer economics: delta coding ships less than raw u32+bool+f32
+    raw_bytes = fa.m * 8 * (4 + 1 + 4)
+    assert fa.nbytes < raw_bytes
+
+    mesh = make_mesh()
+    live, tomb, num_live, live_bytes = sharded_replay_select(
+        pk, dk, ver, order, add, size, mesh)
+    live_h, tomb_h = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, add)
+    np.testing.assert_array_equal(live, live_h)
+    np.testing.assert_array_equal(tomb, tomb_h)
+    assert num_live == int(live_h.sum())
+    assert live_bytes == int(size[live_h].sum())
+
+
+def test_sharded_fa_without_sizes_aggregates_on_host():
+    rng = np.random.default_rng(7)
+    pk, dk, ver, order, add, size = _fa_history(rng, 5_000, 32)
+    mesh = make_mesh()
+    live, tomb, num_live, live_bytes = sharded_replay_select(
+        pk, dk, ver, order, add, None, mesh)
+    live_h, _ = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, add)
+    np.testing.assert_array_equal(live, live_h)
+    assert num_live == int(live_h.sum())
+    assert live_bytes == 0  # no size lane shipped
+
+
+def test_sharded_fa_hint_is_used():
+    """A scanner-style fa_hint (flags precomputed) routes through the
+    delta-coded path without re-deriving flags."""
+    rng = np.random.default_rng(9)
+    pk, dk, ver, order, add, size = _fa_history(rng, 8_000, 32)
+    from delta_tpu.parallel.sharded_replay import derive_fa_flags
+
+    flags = derive_fa_flags(pk)
+    mesh = make_mesh()
+    live, tomb, num_live, _ = sharded_replay_select(
+        pk, dk, ver, order, add, size, mesh,
+        fa_hint=(flags, None, int(pk.max()) + 1))
+    live_h, _ = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, add)
+    np.testing.assert_array_equal(live, live_h)
+
+
+def test_sharded_transfer_bytes_close_to_single_chip():
+    """VERDICT round-1 item 5 'done' criterion: the sharded route's
+    H2D bytes/row stay within 2x of the single-chip FA encoding."""
+    from delta_tpu.ops.replay import _try_fa_encode, pad_bucket
+    from delta_tpu.parallel.sharded_replay import (
+        derive_fa_flags,
+        route_to_shards_fa,
+    )
+
+    rng = np.random.default_rng(1)
+    n = 1_000_000
+    pk, dk, ver, order, add, size = _fa_history(rng, n, 10_000, 0.01)
+    single = _try_fa_encode([pk, dk], n, pad_bucket(n))
+    assert single is not None
+    flags = derive_fa_flags(pk)
+    sharded = route_to_shards_fa(pk, dk, flags, add, 8)
+    assert sharded is not None
+    # add_words ship in both cases; compare total H2D payloads
+    single_total = single.nbytes + pad_bucket(n) // 8
+    assert sharded.nbytes <= 2 * single_total, (
+        sharded.nbytes, single_total)
