@@ -263,6 +263,26 @@ class _FAEncoding(NamedTuple):
     nbytes: int
 
 
+def derive_fa_flags(primary: np.ndarray):
+    """is_new flags if `primary` is a dense first-appearance coding
+    (every new value == prev_max + 1, new values are 0,1,2,...), else
+    None. The single source of truth for FA validity — the single-chip
+    encoder and the sharded route both use it."""
+    p64 = np.asarray(primary).astype(np.int64, copy=False)
+    if len(p64) == 0:
+        return np.zeros(0, dtype=bool)
+    run_max = np.maximum.accumulate(p64)
+    prev_max = np.empty_like(run_max)
+    prev_max[0] = -1
+    prev_max[1:] = run_max[:-1]
+    is_new = p64 == prev_max + 1
+    n_new = int(is_new.sum())
+    # dense first-appearance check: the j-th new row must carry code j
+    if not np.array_equal(p64[is_new], np.arange(n_new, dtype=np.int64)):
+        return None
+    return is_new
+
+
 _NATIVE_FA_MIN_ROWS = 200_000    # below this numpy encodes in ~ms anyway
 _NATIVE_FA_COMPILE_ROWS = 1_000_000  # worth a one-off g++ build
 
@@ -297,17 +317,10 @@ def _try_fa_encode(lanes: Sequence[np.ndarray], n: int, m: int) -> Optional[_FAE
             return _FAEncoding(enc.flag_words, enc.ref_planes, enc.sub_idx,
                                enc.sub_val, enc.sub_radix, enc.nbytes)
         # fall through to numpy: toolchain/library unavailable
-    p64 = primary.astype(np.int64, copy=False)
-    run_max = np.maximum.accumulate(p64)
-    prev_max = np.empty_like(run_max)
-    prev_max[0] = -1
-    prev_max[1:] = run_max[:-1]
-    is_new = p64 == prev_max + 1
-    n_new = int(is_new.sum())
-    # dense first-appearance check: the j-th new row must carry code j
-    if not np.array_equal(p64[is_new], np.arange(n_new, dtype=np.int64)):
+    is_new = derive_fa_flags(primary)
+    if is_new is None:
         return None
-    primary_max = int(run_max[-1]) if n else 0
+    primary_max = int(primary.max()) if n else 0
     refs = primary[~is_new].astype(np.uint32, copy=False)
     return _fa_pack(is_new, refs, primary_max, sub, sub_radix, n, m)
 

@@ -62,6 +62,7 @@ from delta_tpu.ops.replay import (
     _unpack_bits,
     _unpack_bits_device,
     chrono_ok,
+    derive_fa_flags,
     key_byte_width,
     pad_bucket,
 )
@@ -195,23 +196,6 @@ class ShardedFAOperands(NamedTuple):
     nbytes: int                   # H2D payload bytes (transfer accounting)
 
 
-def derive_fa_flags(primary: np.ndarray) -> Optional[np.ndarray]:
-    """is_new flags if `primary` is a dense first-appearance coding
-    (every new value == prev_max + 1, new values are 0,1,2,...)."""
-    p64 = primary.astype(np.int64, copy=False)
-    if len(p64) == 0:
-        return np.zeros(0, dtype=bool)
-    run_max = np.maximum.accumulate(p64)
-    prev_max = np.empty_like(run_max)
-    prev_max[0] = -1
-    prev_max[1:] = run_max[:-1]
-    is_new = p64 == prev_max + 1
-    n_new = int(is_new.sum())
-    if not np.array_equal(p64[is_new], np.arange(n_new, dtype=np.int64)):
-        return None
-    return is_new
-
-
 def route_to_shards_fa(
     path_key: np.ndarray,
     dv_key: np.ndarray,
@@ -239,8 +223,9 @@ def route_to_shards_fa(
     # is_new flags route through unchanged (a globally-new path is new
     # in its shard; refs always target a path first seen in the SAME
     # shard because routing is by path)
+    sorted_new = np.asarray(is_new, bool)[sort_idx]
     flags = np.zeros((n_shards, m), dtype=np.bool_)
-    flags[rows, cols] = np.asarray(is_new, bool)[sort_idx]
+    flags[rows, cols] = sorted_new
     flag_words = np.packbits(flags, axis=1, bitorder="little").view(np.uint32)
 
     add = np.zeros((n_shards, m), dtype=np.bool_)
@@ -249,7 +234,6 @@ def route_to_shards_fa(
 
     # explicit refs: non-new rows, local code = global code // S, in
     # shard-stream order (the stable sort preserves it)
-    sorted_new = np.asarray(is_new, bool)[sort_idx]
     ref_rows = rows[~sorted_new]
     ref_vals = (path_key[sort_idx][~sorted_new] //
                 np.uint32(n_shards)).astype(np.uint32)

@@ -1,0 +1,39 @@
+"""Synthetic replay histories shared by tests, benchmarks, and the
+driver dry-run — one generator so every harness exercises the same
+scanner-shaped stream.
+
+`fa_history` mimics what the native scanner emits for a real Delta log:
+dense first-appearance path codes (~`new_rate` of rows introduce a fresh
+file), a mostly-zero DV lane, sorted versions with within-commit order,
+and re-adds."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fa_history(n: int, seed: int = 0, new_rate: float = 0.85,
+               dv_frac: float = 0.0, n_versions: int | None = None,
+               readd_rate: float = 0.3):
+    """Returns (path_codes u32, dv_codes u32, version i32, order i32,
+    is_add bool, size i64)."""
+    rng = np.random.default_rng(seed)
+    is_new = rng.random(n) < new_rate
+    if n:
+        is_new[0] = True
+    new_count = np.cumsum(is_new)
+    back = (rng.random(n) * (new_count - 1)).astype(np.int64)
+    pk = np.where(is_new, new_count - 1, back).astype(np.uint32)
+    dk = np.zeros(n, np.uint32)
+    if dv_frac:
+        dv_rows = rng.random(n) < dv_frac
+        dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
+    if n_versions is None:
+        n_versions = max(2, n // 100)
+    ver = np.sort(rng.integers(0, n_versions, n)).astype(np.int32)
+    # rank within each version run (ver is sorted, so the run start of
+    # row i is searchsorted(ver, ver[i]))
+    order = (np.arange(n) - np.searchsorted(ver, ver)).astype(np.int32)
+    add = is_new | (rng.random(n) < readd_rate)
+    size = rng.integers(100, 10_000, n).astype(np.int64)
+    return pk, dk, ver, order, add, size

@@ -1,0 +1,361 @@
+"""Generate the checked-in golden `_delta_log` fixtures.
+
+This writer is INDEPENDENT of delta_tpu — stdlib json + pyarrow.parquet
+only — so the fixtures exercise the product's readers against bytes it
+did not produce (VERDICT round-1 item 4; reference mechanism
+`GoldenTables.scala:50`). Each fixture dir carries an `expected.json`
+whose state digest was written BY HAND from the commit contents — not
+computed by any reader — so a shared bug between readers cannot
+self-certify.
+
+Run `python tests/golden_fixtures/generate.py` to regenerate in place.
+"""
+
+import json
+import os
+import shutil
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+SCHEMA_STRING = json.dumps({
+    "type": "struct",
+    "fields": [
+        {"name": "x", "type": "long", "nullable": True, "metadata": {}}
+    ],
+})
+
+PROTOCOL = {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}
+
+
+def metadata(meta_id="golden", configuration=None, schema=SCHEMA_STRING,
+             partition_columns=None):
+    return {"metaData": {
+        "id": meta_id,
+        "format": {"provider": "parquet", "options": {}},
+        "schemaString": schema,
+        "partitionColumns": partition_columns or [],
+        "configuration": configuration or {},
+    }}
+
+
+def add(path, size, dv=None, stats=None, pv=None):
+    a = {"path": path, "partitionValues": pv or {}, "size": size,
+         "modificationTime": 1, "dataChange": True}
+    if stats:
+        a["stats"] = json.dumps(stats)
+    if dv:
+        a["deletionVector"] = dv
+    return {"add": a}
+
+
+def remove(path, dv=None):
+    r = {"path": path, "deletionTimestamp": 2, "dataChange": True}
+    if dv:
+        r["deletionVector"] = dv
+    return {"remove": r}
+
+
+def write_commits(log, commits, start=0):
+    for i, actions in enumerate(commits):
+        name = os.path.join(log, f"{start + i:020d}.json")
+        with open(name, "w") as f:
+            f.write("\n".join(json.dumps(a) for a in actions) + "\n")
+
+
+# ------------------------------------------------ checkpoint construction
+
+ADD_TYPE = pa.struct([
+    ("path", pa.string()),
+    ("partitionValues", pa.map_(pa.string(), pa.string())),
+    ("size", pa.int64()),
+    ("modificationTime", pa.int64()),
+    ("dataChange", pa.bool_()),
+    ("stats", pa.string()),
+    ("deletionVector", pa.struct([
+        ("storageType", pa.string()),
+        ("pathOrInlineDv", pa.string()),
+        ("offset", pa.int32()),
+        ("sizeInBytes", pa.int32()),
+        ("cardinality", pa.int64()),
+    ])),
+])
+REMOVE_TYPE = pa.struct([
+    ("path", pa.string()),
+    ("deletionTimestamp", pa.int64()),
+    ("dataChange", pa.bool_()),
+])
+META_TYPE = pa.struct([
+    ("id", pa.string()),
+    ("format", pa.struct([("provider", pa.string()),
+                          ("options", pa.map_(pa.string(), pa.string()))])),
+    ("schemaString", pa.string()),
+    ("partitionColumns", pa.list_(pa.string())),
+    ("configuration", pa.map_(pa.string(), pa.string())),
+])
+PROTO_TYPE = pa.struct([
+    ("minReaderVersion", pa.int32()),
+    ("minWriterVersion", pa.int32()),
+])
+TXN_TYPE = pa.struct([
+    ("appId", pa.string()),
+    ("version", pa.int64()),
+])
+SIDECAR_TYPE = pa.struct([
+    ("path", pa.string()),
+    ("sizeInBytes", pa.int64()),
+    ("modificationTime", pa.int64()),
+])
+CPMETA_TYPE = pa.struct([
+    ("version", pa.int64()),
+])
+
+
+def _conv_map(v):
+    return list(v.items()) if isinstance(v, dict) else v
+
+
+def checkpoint_rows(actions, with_v2_cols=False):
+    """action dicts -> one SingleAction-style Arrow table."""
+    cols = {"add": (ADD_TYPE, []), "remove": (REMOVE_TYPE, []),
+            "metaData": (META_TYPE, []), "protocol": (PROTO_TYPE, []),
+            "txn": (TXN_TYPE, [])}
+    if with_v2_cols:
+        cols["checkpointMetadata"] = (CPMETA_TYPE, [])
+        cols["sidecar"] = (SIDECAR_TYPE, [])
+    for act in actions:
+        for name, (typ, vals) in cols.items():
+            v = act.get(name)
+            if v is not None:
+                v = dict(v)
+                for k in ("partitionValues", "configuration", "options"):
+                    if k in v:
+                        v[k] = _conv_map(v[k])
+                if "format" in v and isinstance(v["format"], dict):
+                    fmt = dict(v["format"])
+                    fmt["options"] = _conv_map(fmt.get("options", {}))
+                    v["format"] = fmt
+            vals.append(v)
+    arrays = {name: pa.array(vals, type=typ)
+              for name, (typ, vals) in cols.items()}
+    return pa.table(arrays)
+
+
+def write_last_checkpoint(log, version, size, parts=None):
+    d = {"version": version, "size": size}
+    if parts is not None:
+        d["parts"] = parts
+    with open(os.path.join(log, "_last_checkpoint"), "w") as f:
+        f.write(json.dumps(d))
+
+
+def fresh(name):
+    root = os.path.join(HERE, name)
+    shutil.rmtree(root, ignore_errors=True)
+    log = os.path.join(root, "_delta_log")
+    os.makedirs(log)
+    return root, log
+
+
+def expected(root, **kw):
+    with open(os.path.join(root, "expected.json"), "w") as f:
+        json.dump(kw, f, indent=1, sort_keys=True)
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def gen_basic_checkpoint():
+    """Classic single-file checkpoint at v1 (covering commits 0-1) + two
+    later commits. Hand-derived state: a.parquet's v2 re-add (size 11)
+    wins over the checkpoint copy (size 10); b removed at v3; c, d
+    live."""
+    root, log = fresh("basic_checkpoint")
+    write_commits(log, [
+        [PROTOCOL, metadata(), add("a.parquet", 10), add("b.parquet", 20)],
+        [add("c.parquet", 30),
+         {"txn": {"appId": "app1", "version": 7}}],
+    ])
+    cp = checkpoint_rows([
+        PROTOCOL, metadata(),
+        add("a.parquet", 10), add("b.parquet", 20), add("c.parquet", 30),
+        {"txn": {"appId": "app1", "version": 7}},
+    ])
+    pq.write_table(cp, os.path.join(log, f"{1:020d}.checkpoint.parquet"))
+    write_commits(log, [
+        [add("a.parquet", 11)],        # v2 re-add wins (new size)
+        [remove("b.parquet"), add("d.parquet", 40)],
+    ], start=2)
+    write_last_checkpoint(log, 1, 6)
+    expected(root,
+             live_keys=["a.parquet|", "c.parquet|", "d.parquet|"],
+             tombstone_keys=["b.parquet|"],
+             num_live=3, live_bytes=11 + 30 + 40,
+             protocol={"minReaderVersion": 1, "minWriterVersion": 2},
+             metadata_id="golden",
+             txns={"app1": 7},
+             version=3)
+
+
+def gen_multipart_checkpoint():
+    root, log = fresh("multipart_checkpoint")
+    write_commits(log, [
+        [PROTOCOL, metadata("multi"),
+         add("p0.parquet", 1), add("p1.parquet", 2)],
+        [add("p2.parquet", 3), remove("p0.parquet")],
+    ])
+    part1 = checkpoint_rows([PROTOCOL, metadata("multi"),
+                             add("p1.parquet", 2)])
+    part2 = checkpoint_rows([add("p2.parquet", 3), remove("p0.parquet")])
+    pq.write_table(
+        part1, os.path.join(log, f"{1:020d}.checkpoint.{1:010d}.{2:010d}.parquet"))
+    pq.write_table(
+        part2, os.path.join(log, f"{1:020d}.checkpoint.{2:010d}.{2:010d}.parquet"))
+    write_last_checkpoint(log, 1, 5, parts=2)
+    write_commits(log, [[add("p3.parquet", 4)]], start=2)
+    expected(root,
+             live_keys=["p1.parquet|", "p2.parquet|", "p3.parquet|"],
+             tombstone_keys=["p0.parquet|"],
+             num_live=3, live_bytes=2 + 3 + 4,
+             protocol={"minReaderVersion": 1, "minWriterVersion": 2},
+             metadata_id="multi",
+             version=2)
+
+
+def gen_v2_sidecars():
+    root, log = fresh("v2_sidecars")
+    os.makedirs(os.path.join(log, "_sidecars"))
+    write_commits(log, [
+        [PROTOCOL, metadata("v2t"), add("s0.parquet", 5)],
+        [add("s1.parquet", 6), add("s2.parquet", 7)],
+    ])
+    side1 = checkpoint_rows([add("s0.parquet", 5), add("s1.parquet", 6)])
+    side2 = checkpoint_rows([add("s2.parquet", 7)])
+    pq.write_table(side1, os.path.join(log, "_sidecars", "sc-1.parquet"))
+    pq.write_table(side2, os.path.join(log, "_sidecars", "sc-2.parquet"))
+    top = [
+        {"checkpointMetadata": {"version": 1}},
+        PROTOCOL, metadata("v2t"),
+        {"sidecar": {"path": "sc-1.parquet", "sizeInBytes": 1,
+                     "modificationTime": 1}},
+        {"sidecar": {"path": "sc-2.parquet", "sizeInBytes": 1,
+                     "modificationTime": 1}},
+    ]
+    with open(os.path.join(log, f"{1:020d}.checkpoint.abc-123.json"), "w") as f:
+        f.write("\n".join(json.dumps(a) for a in top) + "\n")
+    write_last_checkpoint(log, 1, 5)
+    write_commits(log, [[remove("s0.parquet"), add("s3.parquet", 8)]],
+                  start=2)
+    expected(root,
+             live_keys=["s1.parquet|", "s2.parquet|", "s3.parquet|"],
+             tombstone_keys=["s0.parquet|"],
+             num_live=3, live_bytes=6 + 7 + 8,
+             protocol={"minReaderVersion": 1, "minWriterVersion": 2},
+             metadata_id="v2t",
+             version=2)
+
+
+def gen_dv_ict():
+    """Deletion vectors (same path, DV vs no-DV are distinct keys) + ICT.
+    Hand-derived: d.parquet@dv wins over plain d.parquet remove? NO —
+    they are separate keys: plain d removed; d with DV added at v2 and
+    survives. e.parquet's DV is replaced (same uniqueId removed then
+    re-added with a different DV id)."""
+    root, log = fresh("dv_ict")
+    dv1 = {"storageType": "u", "pathOrInlineDv": "ab^-aqEH.-t@#s9",
+           "offset": 1, "sizeInBytes": 36, "cardinality": 2}
+    dv2 = {"storageType": "u", "pathOrInlineDv": "ab^-aqEH.-t@#s9",
+           "offset": 9, "sizeInBytes": 36, "cardinality": 3}
+    ict_meta = metadata("dvt", configuration={
+        "delta.enableInCommitTimestamps": "true"})
+    proto37 = {"protocol": {"minReaderVersion": 3, "minWriterVersion": 7,
+                            "readerFeatures": ["deletionVectors",
+                                               "inCommitTimestamp"],
+                            "writerFeatures": ["deletionVectors",
+                                               "inCommitTimestamp"]}}
+    write_commits(log, [
+        [{"commitInfo": {"inCommitTimestamp": 1000, "operation": "WRITE"}},
+         proto37, ict_meta,
+         add("d.parquet", 10), add("e.parquet", 20)],
+        [{"commitInfo": {"inCommitTimestamp": 2000, "operation": "DELETE"}},
+         remove("d.parquet"), add("d.parquet", 10, dv=dv1)],
+        [{"commitInfo": {"inCommitTimestamp": 3000, "operation": "DELETE"}},
+         remove("e.parquet"), add("e.parquet", 20, dv=dv2)],
+    ])
+    expected(root,
+             live_keys=[f"d.parquet|u{'ab^-aqEH.-t@#s9'}@1",
+                        f"e.parquet|u{'ab^-aqEH.-t@#s9'}@9"],
+             tombstone_keys=["d.parquet|", "e.parquet|"],
+             num_live=2, live_bytes=30,
+             protocol=proto37["protocol"],
+             metadata_id="dvt",
+             latest_ict=3000,
+             version=2)
+
+
+def gen_column_mapping():
+    """Column-mapping (id mode) metadata + percent-encoded path: the
+    physical schema carries mapping metadata; the %20 path decodes."""
+    schema = json.dumps({
+        "type": "struct",
+        "fields": [{
+            "name": "x", "type": "long", "nullable": True,
+            "metadata": {
+                "delta.columnMapping.id": 1,
+                "delta.columnMapping.physicalName": "col-abc",
+            },
+        }],
+    })
+    root, log = fresh("column_mapping")
+    cm_meta = metadata("cmt", schema=schema, configuration={
+        "delta.columnMapping.mode": "id",
+        "delta.columnMapping.maxColumnId": "1",
+    })
+    proto = {"protocol": {"minReaderVersion": 2, "minWriterVersion": 5}}
+    write_commits(log, [
+        [proto, cm_meta, add("part%20one.parquet", 10)],
+        [add("plain.parquet", 5)],
+    ])
+    expected(root,
+             live_keys=["part one.parquet|", "plain.parquet|"],
+             tombstone_keys=[],
+             num_live=2, live_bytes=15,
+             protocol=proto["protocol"],
+             metadata_id="cmt",
+             configuration={"delta.columnMapping.mode": "id",
+                            "delta.columnMapping.maxColumnId": "1"},
+             version=1)
+
+
+def gen_compacted():
+    root, log = fresh("compacted")
+    write_commits(log, [
+        [PROTOCOL, metadata("cpt"), add("k0.parquet", 1)],
+        [add("k1.parquet", 2)],
+        [remove("k0.parquet"), add("k2.parquet", 3)],
+        [add("k3.parquet", 4)],
+    ])
+    compacted = [add("k1.parquet", 2), remove("k0.parquet"),
+                 add("k2.parquet", 3)]
+    with open(os.path.join(
+            log, f"{1:020d}.{2:020d}.compacted.json"), "w") as f:
+        f.write("\n".join(json.dumps(a) for a in compacted) + "\n")
+    expected(root,
+             live_keys=["k1.parquet|", "k2.parquet|", "k3.parquet|"],
+             tombstone_keys=["k0.parquet|"],
+             num_live=3, live_bytes=2 + 3 + 4,
+             protocol={"minReaderVersion": 1, "minWriterVersion": 2},
+             metadata_id="cpt",
+             version=3)
+
+
+if __name__ == "__main__":
+    gen_basic_checkpoint()
+    gen_multipart_checkpoint()
+    gen_v2_sidecars()
+    gen_dv_ict()
+    gen_column_mapping()
+    gen_compacted()
+    print("fixtures regenerated under", HERE)

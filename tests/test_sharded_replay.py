@@ -106,26 +106,12 @@ def test_step_fn_compiles_with_shardings():
 
 
 def _fa_history(rng, n, n_versions, dv_frac=0.0):
-    """First-appearance-coded history (the native scanner's output
-    shape): ~85% of rows introduce a fresh path code, the rest
-    re-reference earlier codes."""
-    is_new = rng.random(n) < 0.85
-    is_new[0] = True
-    new_count = np.cumsum(is_new)
-    back = (rng.random(n) * (new_count - 1)).astype(np.int64)
-    pk = np.where(is_new, new_count - 1, back).astype(np.uint32)
-    dk = np.zeros(n, np.uint32)
-    if dv_frac:
-        dv_rows = rng.random(n) < dv_frac
-        dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
-    ver = np.sort(rng.integers(0, n_versions, n)).astype(np.int32)
-    order = np.zeros(n, np.int32)
-    for v in np.unique(ver):
-        s = ver == v
-        order[s] = np.arange(s.sum())
-    add = is_new | (rng.random(n) < 0.3)
-    size = rng.integers(100, 10_000, n).astype(np.int64)
-    return pk, dk, ver, order, add, size
+    """First-appearance-coded history — the shared scanner-shaped
+    generator (delta_tpu.utils.synth), seeded from `rng`."""
+    from delta_tpu.utils.synth import fa_history
+
+    return fa_history(n, seed=int(rng.integers(0, 2**31)),
+                      dv_frac=dv_frac, n_versions=n_versions)
 
 
 @pytest.mark.parametrize("dv_frac", [0.0, 0.05])
