@@ -54,6 +54,11 @@ class MergeClause:
     assignments: Optional[Dict[str, object]] = None  # update/insert values
 
 
+# pure equi-joins at/above this many combined rows route through
+# the device sort/segment join (ops/join.py); tests lower it to 0
+DEVICE_JOIN_MIN_ROWS = 65_536
+
+
 @dataclass
 class MergeMetrics:
     num_target_rows_updated: int = 0
@@ -371,33 +376,71 @@ def _execute_merge(
     )
 
     # ---- join ----
+    # Pure equi-joins (no residual conjuncts) go through the device
+    # sort/segment join (ops/join.py — the TPU-native shuffle-join
+    # analogue of ClassicMergeExecutor); residual-predicate joins keep
+    # the host pair join so the residual can disambiguate multi-matches
+    # before the cardinality rule fires.
+    device_matched_s = None
     if target_all is not None and target_all.num_rows and source.num_rows:
         if t_keys:
             import pyarrow.compute as _pc
 
-            tdf = pd.DataFrame({k: target_all.column(k).to_pandas() for k in t_keys})
-            sdf = pd.DataFrame({k: source.column(k).to_pandas() for k in s_keys})
-            tdf["__tpos"] = np.arange(len(tdf))
-            sdf["__spos"] = np.arange(len(sdf))
             # SQL equi-join semantics: NULL keys never match — but real
             # float NaN keys DO (Spark treats NaN = NaN as true). Drop
             # only genuinely-NULL rows, using Arrow validity (after
             # to_pandas, NULL and NaN are indistinguishable).
-            t_null = np.zeros(len(tdf), dtype=bool)
+            t_key_arrs = {k: target_all.column(k).combine_chunks()
+                          for k in t_keys}
+            s_key_arrs = {k: source.column(k).combine_chunks()
+                          for k in s_keys}
+            t_null = np.zeros(target_all.num_rows, dtype=bool)
             for k in t_keys:
-                t_null |= np.asarray(_pc.is_null(
-                    target_all.column(k).combine_chunks()))
-            s_null = np.zeros(len(sdf), dtype=bool)
+                t_null |= np.asarray(_pc.is_null(t_key_arrs[k]))
+            s_null = np.zeros(source.num_rows, dtype=bool)
             for k in s_keys:
-                s_null |= np.asarray(_pc.is_null(
-                    source.column(k).combine_chunks()))
-            tdf = tdf[~t_null]
-            sdf = sdf[~s_null]
-            joined = tdf.merge(
-                sdf, left_on=t_keys, right_on=s_keys, how="inner", suffixes=("", "_s")
-            )
-            tpos = joined["__tpos"].to_numpy()
-            spos = joined["__spos"].to_numpy()
+                s_null |= np.asarray(_pc.is_null(s_key_arrs[k]))
+            t_valid = np.nonzero(~t_null)[0]
+            s_valid = np.nonzero(~s_null)[0]
+            use_device = (not residual
+                          and len(t_valid) + len(s_valid)
+                          >= DEVICE_JOIN_MIN_ROWS)
+            if use_device:
+                from delta_tpu.ops.join import equi_join_device
+
+                t_cols = [t_key_arrs[k].take(pa.array(t_valid))
+                          .to_pandas().to_numpy() for k in t_keys]
+                s_cols = [s_key_arrs[k].take(pa.array(s_valid))
+                          .to_pandas().to_numpy() for k in s_keys]
+                match_src, n_multi, _src_matched = equi_join_device(
+                    t_cols, s_cols)
+                if matched and n_multi:
+                    raise MergeCardinalityError(
+                        f"{n_multi} target row(s) matched "
+                        "by multiple source rows; MERGE with update/delete "
+                        "requires at most one match")
+                hit = match_src >= 0
+                tpos = t_valid[np.nonzero(hit)[0]]
+                spos = s_valid[match_src[hit]]
+                # the kernel's per-source matched flags cover duplicate-
+                # key sources that never appear in a (target, source)
+                # pair (legal in insert-only merges) — used below for
+                # insert detection instead of unique(spos)
+                device_matched_s = s_valid[np.nonzero(_src_matched)[0]]
+            else:
+                tdf = pd.DataFrame(
+                    {k: target_all.column(k).to_pandas() for k in t_keys})
+                sdf = pd.DataFrame(
+                    {k: source.column(k).to_pandas() for k in s_keys})
+                tdf["__tpos"] = np.arange(len(tdf))
+                sdf["__spos"] = np.arange(len(sdf))
+                tdf = tdf[~t_null]
+                sdf = sdf[~s_null]
+                joined = tdf.merge(
+                    sdf, left_on=t_keys, right_on=s_keys, how="inner",
+                    suffixes=("", "_s"))
+                tpos = joined["__tpos"].to_numpy()
+                spos = joined["__spos"].to_numpy()
         else:
             tpos, spos = np.meshgrid(
                 np.arange(target_all.num_rows), np.arange(source.num_rows),
@@ -427,7 +470,8 @@ def _execute_merge(
             )
 
     matched_t = np.unique(tpos)
-    matched_s = np.unique(spos)
+    matched_s = (np.unique(spos) if device_matched_s is None
+                 else device_matched_s)
 
     # ---- matched clause resolution (per pair; first clause wins) ----
     pair_action = np.full(len(tpos), -1, dtype=np.int64)  # index into `matched`
@@ -449,19 +493,21 @@ def _execute_merge(
             pair_action[sel] = ci
             undecided &= ~sel
 
-    # ---- build per-target-row plan ----
-    # delete set / update outputs
-    delete_rows: set = set()
-    update_rows: Dict[int, int] = {}  # tpos -> pair index
-    for pi, act in enumerate(pair_action):
-        if act < 0:
-            continue
-        clause = matched[act]
-        t = int(tpos[pi])
-        if clause.kind == "delete":
-            delete_rows.add(t)
-        else:
-            update_rows[t] = pi
+    # ---- build per-target-row plan (vectorized — no per-pair loop) ----
+    if matched and len(tpos):
+        is_del_clause = np.array([c.kind == "delete" for c in matched],
+                                 dtype=bool)
+        acted = pair_action >= 0
+        act_clamped = np.clip(pair_action, 0, None)
+        del_pair = acted & is_del_clause[act_clamped]
+        upd_pair = acted & ~is_del_clause[act_clamped]
+        delete_t = tpos[del_pair].astype(np.int64)
+        update_t = tpos[upd_pair].astype(np.int64)   # target rows updated
+        update_pi = np.nonzero(upd_pair)[0]          # their pair indices
+    else:
+        delete_t = np.empty(0, np.int64)
+        update_t = np.empty(0, np.int64)
+        update_pi = np.empty(0, np.int64)
 
     # ---- not-matched (insert) ----
     insert_tables = []
@@ -495,9 +541,10 @@ def _execute_merge(
                     insert_tables.append(rows)
                 undecided &= ~sel
 
-    # ---- not-matched-by-source ----
-    nmbs_delete: set = set()
-    nmbs_update: Dict[int, pa.Table] = {}
+    # ---- not-matched-by-source (per-clause batch eval, no row loop) ----
+    nmbs_delete_t = np.empty(0, np.int64)
+    nmbs_upd_t = np.empty(0, np.int64)       # target rows, aligned with
+    nmbs_upd_rows: Optional[pa.Table] = None  # ...rows of this table
     if not_matched_by_source and target_all is not None and target_all.num_rows:
         by_source_mask = np.zeros(target_all.num_rows, dtype=bool)
         by_source_mask[matched_t] = True
@@ -506,6 +553,7 @@ def _execute_merge(
             sub = target_all.take(pa.array(un_idx, pa.int64()))
             batch = _namespaced_batch(sub, _null_source_rows(source.schema, sub.num_rows))
             undecided = np.ones(sub.num_rows, dtype=bool)
+            del_parts, upd_idx_parts, upd_row_parts = [], [], []
             for clause in not_matched_by_source:
                 if not undecided.any():
                     break
@@ -515,24 +563,26 @@ def _execute_merge(
                     else np.ones(sub.num_rows, dtype=bool)
                 )
                 sel = undecided & ok
-                for j in np.nonzero(sel)[0]:
-                    t = int(un_idx[j])
+                if sel.any():
                     if clause.kind == "delete":
-                        nmbs_delete.add(t)
+                        del_parts.append(un_idx[sel])
                     else:
-                        nmbs_update[t] = _eval_values(
+                        upd_idx_parts.append(un_idx[sel])
+                        upd_row_parts.append(_eval_values(
                             clause.assignments,
-                            batch.slice(int(j), 1),
+                            batch.filter(pa.array(sel)),
                             target_arrow_schema,
                             False,
-                        )
+                        ))
                 undecided &= ~sel
+            if del_parts:
+                nmbs_delete_t = np.concatenate(del_parts)
+            if upd_idx_parts:
+                nmbs_upd_t = np.concatenate(upd_idx_parts)
+                nmbs_upd_rows = pa.concat_tables(
+                    upd_row_parts, promote_options="permissive")
 
-    # ---- rewrite touched files ----
-    touched_files = set()
-    for t in (*delete_rows, *update_rows, *nmbs_delete, *nmbs_update):
-        touched_files.add(int(target_all.column("__file")[int(t)].as_py()))
-
+    # ---- rewrite touched files (vectorized grouping) ----
     part_cols = snapshot.partition_columns
     cdc_del, cdc_pre, cdc_post = [], [], []
     file_of = (
@@ -542,18 +592,24 @@ def _execute_merge(
     )
     n_target = len(file_of)
     del_mask = np.zeros(n_target, dtype=bool)
-    for t in delete_rows:
-        del_mask[t] = True
-    for t in nmbs_delete:
-        del_mask[t] = True
+    del_mask[delete_t] = True
+    del_mask[nmbs_delete_t] = True
     upd_mask = np.zeros(n_target, dtype=bool)
-    for t in update_rows:
-        upd_mask[t] = True
+    upd_mask[update_t] = True
     nmbs_mask = np.zeros(n_target, dtype=bool)
-    for t in nmbs_update:
-        nmbs_mask[t] = True
+    nmbs_mask[nmbs_upd_t] = True
 
-    for fi in sorted(touched_files):
+    touched = del_mask | upd_mask | nmbs_mask
+    touched_files = np.unique(file_of[touched]) if n_target else []
+
+    upd_file = file_of[update_t] if len(update_t) else np.empty(0, np.int64)
+    upd_clause = (pair_action[update_pi] if len(update_pi)
+                  else np.empty(0, np.int64))
+    nmbs_file = (file_of[nmbs_upd_t] if len(nmbs_upd_t)
+                 else np.empty(0, np.int64))
+
+    for fi in touched_files:
+        fi = int(fi)
         add = candidates[fi]
         here = file_of == fi
         kept = here & ~del_mask & ~upd_mask & ~nmbs_mask
@@ -564,18 +620,18 @@ def _execute_merge(
                 _strip_provenance(target_all.filter(pa.array(kept))),
                 target_arrow_schema))
             metrics.num_target_rows_copied += n_kept
-        # matched updates in this file, all pairs at once
-        upd_pis = [pi for t, pi in update_rows.items() if file_of[t] == fi]
-        by_clause: Dict[int, list] = {}
-        for pi in upd_pis:
-            by_clause.setdefault(int(pair_action[pi]), []).append(pi)
-        for ci, pis in sorted(by_clause.items()):
+        # matched updates in this file, grouped by clause, batch eval
+        in_file = upd_file == fi
+        for ci in np.unique(upd_clause[in_file]) if in_file.any() else []:
+            sel = in_file & (upd_clause == ci)
+            pis = update_pi[sel]
             pair_batch_f = _namespaced_batch(
                 target_all.take(pa.array(tpos[pis], pa.int64())),
                 source.take(pa.array(spos[pis], pa.int64())),
             )
             new_rows = _eval_values(
-                matched[ci].assignments, pair_batch_f, target_arrow_schema, True
+                matched[int(ci)].assignments, pair_batch_f,
+                target_arrow_schema, True
             )
             out_parts.append(new_rows)
             metrics.num_target_rows_updated += new_rows.num_rows
@@ -586,13 +642,12 @@ def _execute_merge(
                     )
                 )
                 cdc_post.append(new_rows)
-        nmbs_here = [t for t in nmbs_update if file_of[t] == fi]
-        if nmbs_here:
-            rows = pa.concat_tables(
-                [nmbs_update[t] for t in nmbs_here], promote_options="permissive"
-            )
+        nmbs_sel = nmbs_file == fi
+        if nmbs_sel.any():
+            rows = nmbs_upd_rows.take(
+                pa.array(np.nonzero(nmbs_sel)[0], pa.int64()))
             out_parts.append(rows)
-            metrics.num_target_rows_updated += len(nmbs_here)
+            metrics.num_target_rows_updated += rows.num_rows
         n_del_here = int((here & del_mask).sum())
         metrics.num_target_rows_deleted += n_del_here
         if use_cdc and n_del_here:

@@ -1,0 +1,152 @@
+"""Device equi-join kernel (ops/join.py) + its MERGE integration."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.ops.join import equi_join_codes, equi_join_device
+from delta_tpu.table import Table
+
+
+def _reference_join(t_codes, s_codes):
+    """Sequential dict reference: first source per code, counts, flags."""
+    first = {}
+    count = {}
+    for i, c in enumerate(s_codes):
+        first.setdefault(int(c), i)
+        count[int(c)] = count.get(int(c), 0) + 1
+    match = np.array([first.get(int(c), -1) for c in t_codes], np.int64)
+    n_src = np.array([count.get(int(c), 0) for c in t_codes], np.int32)
+    t_set = set(int(c) for c in t_codes)
+    s_matched = np.array([int(c) in t_set for c in s_codes], bool)
+    return match, n_src, s_matched
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_join_codes_matches_reference(seed):
+    rng = np.random.default_rng(seed)
+    nt, ns = 5000, 1200
+    t = rng.integers(0, 3000, nt).astype(np.uint32)
+    s = rng.integers(0, 3000, ns).astype(np.uint32)
+    match, n_multi, s_matched = equi_join_codes(t, s)
+    m_ref, n_ref, f_ref = _reference_join(t, s)
+    assert n_multi == int(((n_ref > 1) & (m_ref >= 0)).sum())
+    np.testing.assert_array_equal(s_matched, f_ref)
+    # the kernel promises the FIRST source of each matched key
+    np.testing.assert_array_equal(match, m_ref)
+
+
+def test_join_no_overlap_and_empty():
+    t = np.array([1, 2, 3], np.uint32)
+    s = np.array([7, 8], np.uint32)
+    match, n_multi, s_matched = equi_join_codes(t, s)
+    assert (match == -1).all() and n_multi == 0
+    assert not s_matched.any()
+    match, n_multi, s_matched = equi_join_codes(t, np.empty(0, np.uint32))
+    assert (match == -1).all() and len(s_matched) == 0
+
+
+def test_join_multi_key_strings_and_ints():
+    t_k1 = np.array(["a", "b", "a", "c"], object)
+    t_k2 = np.array([1, 2, 2, 3], np.int64)
+    s_k1 = np.array(["a", "a", "x"], object)
+    s_k2 = np.array([2, 1, 9], np.int64)
+    match, n_multi, s_matched = equi_join_device([t_k1, t_k2], [s_k1, s_k2])
+    # target rows: (a,1)->s1, (b,2)->none, (a,2)->s0, (c,3)->none
+    np.testing.assert_array_equal(match, [1, -1, 0, -1])
+    np.testing.assert_array_equal(s_matched, [True, True, False])
+    assert n_multi == 0
+
+
+def test_merge_device_join_path_equals_host(tmp_path, monkeypatch):
+    """Force the device join (threshold -> 0) and check the MERGE result
+    equals the host-join run on an identical table."""
+    import delta_tpu.commands.merge as merge_mod
+    from delta_tpu.expressions import col
+
+    src = pa.table({
+        "id": pa.array(np.arange(50, 150, dtype=np.int64)),
+        "v": pa.array(np.full(100, 999.0)),
+    })
+
+    def run(path):
+        dta.write_table(path, pa.table({
+            "id": pa.array(np.arange(100, dtype=np.int64)),
+            "v": pa.array(np.arange(100, dtype=np.float64)),
+        }), target_rows_per_file=25)
+        t = Table.for_path(path)
+        m = (merge_mod.merge(t, src, col("target.id") == col("source.id"))
+             .when_matched_update_all()
+             .when_not_matched_insert_all()
+             .execute())
+        return m, dta.read_table(path)
+
+    m_host, rows_host = run(str(tmp_path / "host"))
+    monkeypatch.setattr(merge_mod, "DEVICE_JOIN_MIN_ROWS", 0)
+    m_dev, rows_dev = run(str(tmp_path / "dev"))
+
+    assert m_host.num_target_rows_updated == m_dev.num_target_rows_updated == 50
+    assert m_host.num_target_rows_inserted == m_dev.num_target_rows_inserted == 50
+    a = sorted(zip(rows_host.column("id").to_pylist(),
+                   rows_host.column("v").to_pylist()))
+    b = sorted(zip(rows_dev.column("id").to_pylist(),
+                   rows_dev.column("v").to_pylist()))
+    assert a == b
+
+
+def test_merge_device_join_cardinality_error(tmp_path, monkeypatch):
+    import delta_tpu.commands.merge as merge_mod
+    from delta_tpu.commands.merge import MergeCardinalityError
+    from delta_tpu.expressions import col
+
+    monkeypatch.setattr(merge_mod, "DEVICE_JOIN_MIN_ROWS", 0)
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({
+        "id": pa.array(np.arange(10, dtype=np.int64)),
+        "v": pa.array(np.arange(10, dtype=np.float64))}))
+    dup_src = pa.table({
+        "id": pa.array([3, 3], type=pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+    })
+    t = Table.for_path(p)
+    with pytest.raises(MergeCardinalityError):
+        (merge_mod.merge(t, dup_src, col("target.id") == col("source.id"))
+         .when_matched_update_all().execute())
+
+
+def test_merge_device_join_insert_only_dup_sources(tmp_path, monkeypatch):
+    """Duplicate-key sources are legal in insert-only merges: matched
+    dups are all suppressed, unmatched dups all insert."""
+    import delta_tpu.commands.merge as merge_mod
+    from delta_tpu.expressions import col
+
+    monkeypatch.setattr(merge_mod, "DEVICE_JOIN_MIN_ROWS", 0)
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({
+        "id": pa.array(np.arange(5, dtype=np.int64)),
+        "v": pa.array(np.arange(5, dtype=np.float64))}))
+    src = pa.table({
+        "id": pa.array([3, 3, 9, 9], type=pa.int64()),
+        "v": pa.array([1.0, 2.0, 3.0, 4.0]),
+    })
+    t = Table.for_path(p)
+    m = (merge_mod.merge(t, src, col("target.id") == col("source.id"))
+         .when_not_matched_insert_all().execute())
+    assert m.num_target_rows_inserted == 2  # both id=9 rows insert
+    rows = dta.read_table(p)
+    ids = sorted(rows.column("id").to_pylist())
+    assert ids == [0, 1, 2, 3, 4, 9, 9]
+
+
+def test_join_nan_keys_match_each_other():
+    """Spark equi-join semantics: NaN = NaN is TRUE (only NULL never
+    matches). The factorize encoding must give all NaNs one real code."""
+    t_k1 = np.array([1.0, np.nan, 3.0])
+    t_k2 = np.array([np.nan, 2.0, 3.0])
+    s_k1 = np.array([np.nan, 1.0])
+    s_k2 = np.array([2.0, np.nan])
+    match, n_multi, s_matched = equi_join_device([t_k1, t_k2], [s_k1, s_k2])
+    # (1,NaN)->s1, (NaN,2)->s0, (3,3)->none
+    np.testing.assert_array_equal(match, [1, 0, -1])
+    np.testing.assert_array_equal(s_matched, [True, True])
