@@ -6,8 +6,19 @@ a `PhaseLockingObserver` attached to `Transaction.observer` blocks the
 transaction at named phases until the test unblocks it, so two-writer
 races are driven to exact interleavings instead of sleeps.
 
-Phases: `before_commit` (before each attempt's write), `conflict`
-(entered the lost-race path), `after_commit`.
+Phases (mirroring the reference's `OptimisticTransactionPhases`:
+initialPhase -> preparePhase -> commitPhase -> backfillPhase):
+
+- `before_commit` — before each attempt's prepare+write (initial phase
+  exit; fires once per retry attempt);
+- `after_prepare` — actions validated and serialized, commit file not
+  yet written (the prepare/commit phase boundary: a writer parked here
+  holds a fully-prepared commit while others race past it);
+- `conflict` — entered the lost-race path;
+- `after_backfill` — coordinated-commit only: the coordinator accepted
+  the commit (and ran any batch backfill) but the transaction hasn't
+  finished;
+- `after_commit`.
 """
 
 from __future__ import annotations
@@ -45,9 +56,14 @@ class PhaseLockingObserver:
         self,
         block_before_commit: bool = False,
         block_on_conflict: bool = False,
+        block_after_prepare: bool = False,
+        block_after_backfill: bool = False,
     ):
         self.before_commit_barrier = AtomicBarrier(blocked=block_before_commit)
         self.conflict_barrier = AtomicBarrier(blocked=block_on_conflict)
+        self.after_prepare_barrier = AtomicBarrier(blocked=block_after_prepare)
+        self.after_backfill_barrier = AtomicBarrier(
+            blocked=block_after_backfill)
         self.events: List[tuple] = []
         self._lock = threading.Lock()
 
@@ -61,9 +77,17 @@ class PhaseLockingObserver:
         self._record("attempt", version)
         self.before_commit_barrier.wait()
 
+    def after_prepare(self, txn, version: int) -> None:
+        self._record("prepared", version)
+        self.after_prepare_barrier.wait()
+
     def on_commit_conflict(self, txn, version: int) -> None:
         self._record("conflict", version)
         self.conflict_barrier.wait()
+
+    def after_backfill(self, txn, version: int) -> None:
+        self._record("backfilled", version)
+        self.after_backfill_barrier.wait()
 
     def after_commit(self, txn, version: int) -> None:
         self._record("committed", version)

@@ -519,6 +519,12 @@ class Transaction:
             if e.conflict:
                 raise FileExistsError(str(e)) from e
             raise CommitFailedError(str(e), retryable=e.retryable) from e
+        if self.observer:
+            # the coordinator accepted the commit (and ran any batch
+            # backfill) — the reference's backfillPhase boundary
+            hook = getattr(self.observer, "after_backfill", None)
+            if hook is not None:
+                hook(self, version)
 
     def _read_commit_range(self, engine, log_path: str, lo: int, hi: int):
         """Winning commits [lo, hi] — backfilled files or coordinator
@@ -577,6 +583,12 @@ class Transaction:
                 self.observer.before_commit_attempt(self, attempt_version)
             actions = self._prepare_actions(attempt_version, winners_ict)
             data = actions_to_commit_bytes(actions)
+            if self.observer:
+                # prepare/commit phase boundary: actions are validated +
+                # serialized; the commit file is not yet visible
+                hook = getattr(self.observer, "after_prepare", None)
+                if hook is not None:
+                    hook(self, attempt_version)
             try:
                 self._write_commit(engine, log_path, attempt_version, data)
             except FileExistsError:

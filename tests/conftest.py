@@ -62,3 +62,25 @@ def sample_data():
             "date": pa.array([f"2024-01-{(i % 28) + 1:02d}" for i in range(n)]),
         }
     )
+
+
+@pytest.fixture
+def coordinated_path(tmp_table_path):
+    """A coordinated-commits table backed by the in-memory coordinator."""
+    import numpy as np
+    import pyarrow as pa
+
+    import delta_tpu.api as dta
+    from delta_tpu.coordinatedcommits import (
+        COORDINATOR_NAME_KEY,
+        InMemoryCommitCoordinator,
+        register_coordinator,
+    )
+
+    register_coordinator("test-coord", InMemoryCommitCoordinator(batch_size=3))
+    dta.write_table(
+        tmp_table_path,
+        pa.table({"id": pa.array(np.arange(5, dtype=np.int64))}),
+        properties={COORDINATOR_NAME_KEY: "test-coord"},
+    )
+    return tmp_table_path

@@ -7,11 +7,6 @@ import pytest
 
 import delta_tpu.api as dta
 from delta_tpu.concurrency import PhaseLockingObserver, run_txn_async
-from delta_tpu.coordinatedcommits import (
-    COORDINATOR_NAME_KEY,
-    InMemoryCommitCoordinator,
-    register_coordinator,
-)
 from delta_tpu.errors import (
     ConcurrentAppendError,
     ConcurrentDeleteDeleteError,
@@ -53,7 +48,8 @@ def test_blind_append_race_rebases(tmp_table_path):
     assert res_a.version == 2          # rebased past B
     assert res_a.attempts == 2
     kinds = [k for k, _ in obs.events]
-    assert kinds == ["attempt", "conflict", "attempt", "committed"]
+    assert kinds == ["attempt", "prepared", "conflict",
+                     "attempt", "prepared", "committed"]
 
     snap = table.latest_snapshot()
     paths = set(snap.state.add_files_table.column("path").to_pylist())
@@ -157,16 +153,6 @@ def test_set_transaction_conflict(tmp_table_path):
 # ---------------------------------------------------------------------------
 # coordinated commits
 # ---------------------------------------------------------------------------
-
-
-@pytest.fixture
-def coordinated_path(tmp_table_path):
-    register_coordinator("test-coord", InMemoryCommitCoordinator(batch_size=3))
-    dta.write_table(
-        tmp_table_path, _batch(0, 5),
-        properties={COORDINATOR_NAME_KEY: "test-coord"},
-    )
-    return tmp_table_path
 
 
 def test_coordinated_commit_unbackfilled_reads(coordinated_path):
