@@ -116,11 +116,22 @@ def ensure_log(workdir: str, commits: int) -> str:
 
 def baseline_load(path: str) -> tuple[float, int, int]:
     """Fair host DefaultEngine-semantics load. Returns (seconds,
-    num_files, num_actions)."""
+    num_files, num_actions). Both sides get the same allocator tuning
+    (utils/alloc.py) and both are measured warm (best of two runs) —
+    on lazily-faulted VM memory a cold run is dominated by hypervisor
+    page-fault costs that a long-running engine never pays."""
+    from delta_tpu.engine.host import HostEngine
+
+    eng = HostEngine()  # constructor applies the shared allocator tuning
+    r1 = _baseline_once(eng, path)
+    r2 = _baseline_once(eng, path)
+    return min(r1, r2, key=lambda r: r[0])
+
+
+def _baseline_once(eng, path: str) -> tuple[float, int, int]:
     import pandas as pd
     import pyarrow as pa
 
-    from delta_tpu.engine.host import HostEngine
     from delta_tpu.log.segment import build_log_segment
     from delta_tpu.replay.columnar import (
         _extract_file_actions,
@@ -129,7 +140,6 @@ def baseline_load(path: str) -> tuple[float, int, int]:
     )
     from delta_tpu.utils import filenames as fn
 
-    eng = HostEngine()
     t0 = time.perf_counter()
     segment = build_log_segment(eng.fs, os.path.join(path, "_delta_log"))
     infos = [(fn.delta_version(f.path), f.path, f.size)

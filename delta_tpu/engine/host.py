@@ -178,6 +178,9 @@ def _configure_arrow_pool() -> None:
 class HostEngine(Engine):
     def __init__(self, store_resolver=logstore_for_path, metrics_reporters=None):
         _configure_arrow_pool()
+        from delta_tpu.utils.alloc import tune_allocator
+
+        tune_allocator()
         super().__init__(
             json_handler=HostJsonHandler(store_resolver),
             parquet_handler=HostParquetHandler(store_resolver),

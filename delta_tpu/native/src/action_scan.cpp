@@ -236,8 +236,11 @@ struct Tmpl {
 struct SlotVal {
   const char* vs;  // decoded value span (string content, unescaped)
   const char* ve;
-  int64_t num;     // SL_INT / SL_BOOL value; F_PATH: the precomputed hash
-  bool esc;        // SL_STR: decoded into scratch (span is not in input)
+  int64_t num;       // SL_INT / SL_BOOL value; F_PATH: precomputed hash
+  int64_t a_start;   // in_arena: column-arena span of the decoded bytes
+  int64_t a_end;
+  bool esc;          // SL_STR: decoded into scratch (span not in input)
+  bool in_arena;     // SL_STR: decoded straight into the column arena
 };
 
 // Inlined equality for the short runtime-length literals (10-40 bytes):
@@ -460,6 +463,57 @@ const char* scan_jstring(const char* p, const char* end, std::string& tmp,
           }
         }
         append_utf8(tmp, cp);
+        break;
+      }
+      default: return nullptr;
+    }
+  }
+  return nullptr;
+}
+
+// Unescape a JSON string (opening quote at *p) by APPENDING the decoded
+// bytes to `out` (no clear — used for direct-into-column-arena decoding).
+// Returns the position after the closing quote, or nullptr.
+const char* scan_jstring_append(const char* p, const char* end,
+                                std::string& out) {
+  ++p;
+  while (p < end) {
+    char ch = *p;
+    if (ch == '"') return p + 1;
+    if (ch != '\\') {
+      const char* stop = scan_to_special(p, end);
+      if (stop >= end) return nullptr;
+      out.append(p, stop - p);
+      p = stop;
+      continue;
+    }
+    if (p + 1 >= end) return nullptr;
+    char esc = p[1];
+    p += 2;
+    switch (esc) {
+      case '"': out.push_back('"'); break;
+      case '\\': out.push_back('\\'); break;
+      case '/': out.push_back('/'); break;
+      case 'b': out.push_back('\b'); break;
+      case 'f': out.push_back('\f'); break;
+      case 'n': out.push_back('\n'); break;
+      case 'r': out.push_back('\r'); break;
+      case 't': out.push_back('\t'); break;
+      case 'u': {
+        if (p + 4 > end) return nullptr;
+        int v = hex4(p);
+        if (v < 0) return nullptr;
+        p += 4;
+        uint32_t cp = (uint32_t)v;
+        if (cp >= 0xD800 && cp <= 0xDBFF && p + 6 <= end && p[0] == '\\' &&
+            p[1] == 'u') {
+          int lo = hex4(p + 2);
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + ((uint32_t)lo - 0xDC00);
+            p += 6;
+          }
+        }
+        append_utf8(out, cp);
         break;
       }
       default: return nullptr;
@@ -1046,10 +1100,12 @@ bool learn_template(const char* start, const char* stop, Tmpl& t) {
   return !t.segs.empty();
 }
 
-// Phase 1: match a line against a template, recording value spans. No
-// builder writes — a mismatch anywhere is a clean fallback.
-inline bool match_template(Builder& b, const Tmpl& t, const char* p,
-                           const char* stop, SlotVal* out) {
+// Phase 1: match a line against a template, recording value spans. The
+// only builder writes are speculative arena appends for escaped
+// stats/clustering values — match_template (below) rolls those back on
+// a mismatch, so failure is still a clean fallback.
+inline bool match_template_impl(Builder& b, const Tmpl& t, const char* p,
+                                const char* stop, SlotVal* out) {
   const char* base = t.line.data();
   const size_t nseg = t.segs.size();
   for (size_t i = 0; i < nseg; i++) {
@@ -1063,21 +1119,40 @@ inline bool match_template(Builder& b, const Tmpl& t, const char* p,
         const char* q = scan_to_special(p, stop);
         if (q >= stop) return false;
         v.esc = false;
+        v.in_arena = false;
         if (*q == '"') {  // no escapes: zero-copy span into the input
           v.vs = p;
           v.ve = q;
           p = q;  // closing quote starts the next literal
         } else {
           v.esc = true;
-          // escapes: unescape ONCE here (into this slot's scratch) so
-          // the commit phase never rescans — stats are escape-dense
-          const char *s2, *e2;
-          const char* after =
-              scan_jstring(p - 1, stop, b.slot_tmp[i], &s2, &e2);
-          if (!after) return false;
-          v.vs = s2;
-          v.ve = e2;
-          p = after - 1;  // scan_jstring consumed the closing quote
+          // escapes: unescape ONCE here. Plain output columns (stats,
+          // clustering) decode STRAIGHT into their arena — stats are
+          // ~60% of commit bytes and the scratch-then-copy pattern was
+          // a second full pass over them. A later mismatch rolls the
+          // arena back (match_template wrapper).
+          StrCol* direct = nullptr;
+          if (sg.slot.field == (uint8_t)F_STATS) direct = &b.stats;
+          else if (sg.slot.field == (uint8_t)F_CLUSTERING)
+            direct = &b.clustering;
+          if (direct != nullptr) {
+            v.in_arena = true;
+            v.a_start = (int64_t)direct->arena.size();
+            const char* after = scan_jstring_append(p - 1, stop,
+                                                    direct->arena);
+            if (!after ||
+                direct->arena.size() > (size_t)INT32_MAX) return false;
+            v.a_end = (int64_t)direct->arena.size();
+            p = after - 1;
+          } else {
+            const char *s2, *e2;
+            const char* after =
+                scan_jstring(p - 1, stop, b.slot_tmp[i], &s2, &e2);
+            if (!after) return false;
+            v.vs = s2;
+            v.ve = e2;
+            p = after - 1;  // scan_jstring consumed the closing quote
+          }
         }
         if (sg.slot.field == (uint8_t)F_PATH) {
           // hash + prefetch NOW: the dictionary probe is DRAM-bound and
@@ -1135,6 +1210,17 @@ inline bool match_template(Builder& b, const Tmpl& t, const char* p,
          bytes_eq(p, base + t.tail_off, t.tail_len);
 }
 
+inline bool match_template(Builder& b, const Tmpl& t, const char* p,
+                           const char* stop, SlotVal* out) {
+  const size_t stats0 = b.stats.arena.size();
+  const size_t clust0 = b.clustering.arena.size();
+  if (match_template_impl(b, t, p, stop, out)) return true;
+  // roll back speculative decodes from the failed attempt
+  if (b.stats.arena.size() != stats0) b.stats.arena.resize(stats0);
+  if (b.clustering.arena.size() != clust0) b.clustering.arena.resize(clust0);
+  return false;
+}
+
 
 
 // Phase 2: commit the matched values through the same column adds and
@@ -1166,14 +1252,33 @@ bool commit_template(Builder& b, const Tmpl& t, const SlotVal* vals,
         rs.s_dc = true;
         break;
       case F_STATS:
-        b.stats.add_at(b.cur_row, v.vs, v.ve - v.vs);
+        if (v.in_arena) {
+          if (b.stats.valid.size() < b.cur_row) {
+            // null gap BEFORE this row: pad with the pre-append offset
+            b.stats.offsets.resize(b.cur_row + 1, (int32_t)v.a_start);
+            b.stats.valid.resize(b.cur_row, 0);
+          }
+          b.stats.offsets.push_back((int32_t)v.a_end);
+          b.stats.valid.push_back(1);
+        } else {
+          b.stats.add_at(b.cur_row, v.vs, v.ve - v.vs);
+        }
         rs.s_stats = true;
         break;
       case F_TAGS: b.tags.add_at(b.cur_row, v.vs, v.ve - v.vs); rs.s_tags = true; break;
       case F_BASE_ROW_ID: b.base_row_id.add_at(b.cur_row, v.num); rs.s_brid = true; break;
       case F_DRCV: b.drcv.add_at(b.cur_row, v.num); rs.s_drcv = true; break;
       case F_CLUSTERING:
-        b.clustering.add_at(b.cur_row, v.vs, v.ve - v.vs);
+        if (v.in_arena) {
+          if (b.clustering.valid.size() < b.cur_row) {
+            b.clustering.offsets.resize(b.cur_row + 1, (int32_t)v.a_start);
+            b.clustering.valid.resize(b.cur_row, 0);
+          }
+          b.clustering.offsets.push_back((int32_t)v.a_end);
+          b.clustering.valid.push_back(1);
+        } else {
+          b.clustering.add_at(b.cur_row, v.vs, v.ve - v.vs);
+        }
         rs.s_clust = true;
         break;
       case F_DELETION_TIMESTAMP: b.del_ts.add_at(b.cur_row, v.num); rs.s_dts = true; break;

@@ -213,32 +213,41 @@ def _winner_kernel(operands, width: int) -> jax.Array:
     return _sort_winner_pack(lanes, n_real, _unpack_bits_device(add_words))
 
 
-@functools.partial(jax.jit, static_argnames=("ref_width", "has_sub"))
-def _winner_kernel_fa(operands, ref_width: int, has_sub: bool) -> jax.Array:
-    """First-appearance delta-coded path.
+def _bitcast_u32(b: jax.Array) -> jax.Array:
+    """u8[4k] -> u32[k] (little-endian)."""
+    return jax.lax.bitcast_convert_type(b.reshape(-1, 4), jnp.uint32)
 
-    operands = (flag_words[u32, m/32], *ref_planes[u8, R],
-    [sub_radix[u32], sub_idx[u32, D], sub_val[u32, D] when has_sub],
-    n_real[i32], add_words[u32, m/32]).
 
-    Rebuilds the primary code lane exactly: row i's code is
-    `cumsum(is_new)[i] - 1` when its flag bit is set (the i-th new code
-    under first-appearance coding), else the next explicit ref in order
-    (`refs[cumsum(~is_new)[i] - 1]`). The secondary lane (DV id) arrives
-    sparse as (row, value) pairs and is scattered into a dense lane; the
-    final sort key is `primary * sub_radix + sub`. sub_idx entries >= m
-    (padding) are dropped by the scatter. sub_radix rides as a scalar
-    operand, not a static arg, so DV growth never recompiles."""
-    flag_words, *rest = operands
-    ref_planes = rest[:ref_width]
-    rest = rest[ref_width:]
+@functools.partial(jax.jit, static_argnames=("layout",))
+def _winner_kernel_fa_packed(buf, layout) -> jax.Array:
+    """Single-transfer variant of `_winner_kernel_fa`: every operand —
+    n_real, sub_radix, flag words, ref planes, the sparse DV lane, add
+    words — rides in ONE uint8 buffer and is sliced out on device. Over
+    a high-latency host<->device link (the tunnel pays ~120ms per
+    transfer), one H2D beats seven.
+
+    layout = (m, ref_width, r_pad, d_pad) — all bucket-padded statics."""
+    m, ref_width, r_pad, d_pad = layout
+    off = 0
+
+    def take(nbytes):
+        nonlocal off
+        s = jax.lax.slice(buf, (off,), (off + nbytes,))
+        off += nbytes
+        return s
+
+    n_real = _bitcast_u32(take(4))[0].astype(jnp.int32)
+    sub_radix = _bitcast_u32(take(4))[0]
+    flag_words = _bitcast_u32(take(m // 32 * 4))
+    ref_planes = tuple(take(r_pad) for _ in range(ref_width))
+    has_sub = d_pad > 0
     if has_sub:
-        sub_radix, sub_idx, sub_val, n_real, add_words = rest
-    else:
-        n_real, add_words = rest
-    m = flag_words.shape[0] * 32
+        sub_idx = _bitcast_u32(take(d_pad * 4))
+        sub_val = _bitcast_u32(take(d_pad * 4))
+    add_words = _bitcast_u32(take(m // 32 * 4))
+
     is_new = _unpack_bits_device(flag_words)
-    new_rank = jnp.cumsum(is_new.astype(jnp.int32))        # inclusive
+    new_rank = jnp.cumsum(is_new.astype(jnp.int32))
     ref_rank = jnp.arange(1, m + 1, dtype=jnp.int32) - new_rank
     refs = _decode_planes(ref_planes)
     ref_gather = refs[jnp.clip(ref_rank - 1, 0, refs.shape[0] - 1)]
@@ -251,6 +260,23 @@ def _winner_kernel_fa(operands, ref_width: int, has_sub: bool) -> jax.Array:
     iota = jnp.arange(m, dtype=jnp.int32)
     key = jnp.where(iota < n_real, key, jnp.uint32(0xFFFFFFFF))
     return _sort_winner_pack((key,), n_real, _unpack_bits_device(add_words))
+
+
+def _pack_fa_operands(fa: "_FAEncoding", n: int) -> tuple[np.ndarray, tuple]:
+    """Concatenate the FA operands into one uint8 buffer + its static
+    layout key."""
+    m = fa.flag_words.shape[0] * 32
+    r_pad = fa.ref_planes[0].shape[0] if fa.ref_planes else 0
+    d_pad = fa.sub_idx.shape[0]
+    parts = [
+        np.asarray([n], np.uint32).view(np.uint8),
+        np.asarray([fa.sub_radix], np.uint32).view(np.uint8),
+        fa.flag_words.view(np.uint8),
+        *fa.ref_planes,
+    ]
+    if d_pad:
+        parts += [fa.sub_idx.view(np.uint8), fa.sub_val.view(np.uint8)]
+    return parts, (m, len(fa.ref_planes), r_pad, d_pad)
 
 
 class _FAEncoding(NamedTuple):
@@ -500,15 +526,11 @@ def replay_select_launch(
 
     n_op = np.asarray(n, dtype=np.int32)
     if fa is not None:
-        has_sub = fa.sub_radix > 1
-        sub_ops = ((np.asarray(fa.sub_radix, np.uint32), fa.sub_idx,
-                    fa.sub_val) if has_sub else ())
-        operands = (fa.flag_words, *fa.ref_planes, *sub_ops,
-                    n_op, add_words_np)
+        parts, layout = _pack_fa_operands(fa, n)
+        buf = np.concatenate(parts + [add_words_np.view(np.uint8)])
         if device is not None:
-            operands = tuple(jax.device_put(o, device) for o in operands)
-        winner_words = _winner_kernel_fa(
-            operands, ref_width=len(fa.ref_planes), has_sub=has_sub)
+            buf = jax.device_put(buf, device)
+        winner_words = _winner_kernel_fa_packed(buf, layout)
     else:
         combined = combine_key_lanes(lanes)
         if combined is not None:

@@ -1,0 +1,45 @@
+"""Allocator tuning for fault-expensive hosts.
+
+On virtualized hosts whose memory is lazily faulted through a hypervisor
+(common for TPU-attached VMs and microVM sandboxes), a minor page fault
+costs tens of microseconds instead of ~1us. glibc's default malloc
+returns large (>128KB) allocations to the OS on free, so every snapshot
+load re-faults gigabytes of arena/buffer memory at that price — measured
+2.4x end-to-end on 2.3GB log scans. Raising the mmap/trim thresholds
+keeps freed memory in the process heap for reuse.
+
+Called once from the engines; set DELTA_TPU_NO_MALLOC_TUNING=1 to skip.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_done = False
+
+_M_TRIM_THRESHOLD = -1
+_M_MMAP_THRESHOLD = -3
+
+
+def tune_allocator() -> bool:
+    """Idempotently raise glibc malloc's mmap/trim thresholds so freed
+    GB-scale buffers are reused instead of re-faulted. Returns True when
+    tuning was applied (glibc present, not opted out)."""
+    global _done
+    if _done:
+        return True
+    if os.environ.get("DELTA_TPU_NO_MALLOC_TUNING"):
+        return False
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        mallopt = libc.mallopt
+    except (OSError, AttributeError):
+        return False
+    mallopt.argtypes = [ctypes.c_int, ctypes.c_int]
+    mallopt.restype = ctypes.c_int
+    gb = 1 << 30
+    ok = bool(mallopt(_M_MMAP_THRESHOLD, gb))
+    ok = bool(mallopt(_M_TRIM_THRESHOLD, gb)) and ok
+    _done = ok
+    return ok
