@@ -1,0 +1,47 @@
+"""Shared Delta commit-range walker for the UniForm converters.
+
+Both the Iceberg and Hudi incremental conversions consume the same
+input: the net added files and removed paths across a contiguous range
+of Delta commits (reference `IcebergConverter`'s commit-range walk).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+
+def delta_range_actions(
+    table_path: str, lo: int, hi: int,
+) -> Optional[Tuple[Dict[str, dict], set, bool, Dict[str, dict]]]:
+    """Walk commits [lo, hi] of `table_path`'s log. Returns (net added
+    AddFile dicts by path, net removed path set, metadata_changed,
+    removed RemoveFile dicts by path) — or None when any commit file in
+    the range is gone (cleaned/checkpointed), signalling the caller to
+    fall back to a full conversion."""
+    log = os.path.join(table_path, "_delta_log")
+    adds: Dict[str, dict] = {}
+    removes: Dict[str, dict] = {}
+    meta_changed = False
+    for v in range(lo, hi + 1):
+        try:
+            fh = open(os.path.join(log, f"{v:020d}.json"))
+        except FileNotFoundError:
+            return None
+        with fh:
+            for ln in fh:
+                if not ln.strip():
+                    continue
+                act = json.loads(ln)
+                if "add" in act:
+                    a = act["add"]
+                    adds[a["path"]] = a
+                    removes.pop(a["path"], None)
+                elif "remove" in act:
+                    r = act["remove"]
+                    removes[r["path"]] = r
+                    adds.pop(r["path"], None)
+                elif "metaData" in act:
+                    meta_changed = True
+    return adds, set(removes), meta_changed, removes

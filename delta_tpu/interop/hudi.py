@@ -1,65 +1,204 @@
-"""UniForm Hudi export (reference `hudi/` module + HudiConverterHook).
+"""UniForm Hudi export.
 
-Writes the Hudi copy-on-write table skeleton: `.hoodie/hoodie.properties`
-and a commit timeline where each converted Delta snapshot becomes a
-`<ts>.commit` JSON document listing the live files (Hudi's
-HoodieCommitMetadata shape: partitionToWriteStats)."""
+Reference `hudi/HudiConversionTransaction.scala` (1.6k LoC): each Delta
+commit converts into a timeline-correct Hudi COPY_ON_WRITE commit — the
+instant moves through its real lifecycle (`<ts>.commit.requested` ->
+`<ts>.inflight` -> `<ts>.commit`), the commit document carries
+HoodieCommitMetadata (partitionToWriteStats incl. written/updated
+records, previous commit linkage) and WRITE-level stats, and old
+instants are archived into `.hoodie/archived/` past the active-timeline
+cap — a real Hudi reader walks the same three-state timeline it would
+find under a Hudi writer.
+
+Incremental: each conversion covers the Delta commits since the last
+converted version (tracked in extraMetadata), emitting per-partition
+write stats for the files those commits added and marking replaced file
+groups. A full snapshot conversion seeds the timeline.
+"""
 
 from __future__ import annotations
 
 import json
 import os
 import time
-from typing import Optional
+from typing import Dict, List, Optional
 
 UNIFORM_FORMATS_KEY = "delta.universalFormat.enabledFormats"
 
+ACTIVE_TIMELINE_CAP = 10   # archive completed instants beyond this many
+_STATE_SUFFIXES = (".commit", ".commit.requested", ".inflight")
+
+
+def _timeline_instants(hoodie: str) -> List[str]:
+    """Completed commit instants, ascending."""
+    try:
+        names = os.listdir(hoodie)
+    except FileNotFoundError:
+        return []
+    return sorted(n[:-len(".commit")] for n in names
+                  if n.endswith(".commit") and not n.endswith(".inflight"))
+
+
+def _last_converted_delta_version(hoodie: str) -> Optional[int]:
+    instants = _timeline_instants(hoodie)
+    for instant in reversed(instants):
+        try:
+            with open(os.path.join(hoodie, f"{instant}.commit")) as f:
+                doc = json.load(f)
+            v = doc.get("extraMetadata", {}).get("delta.version")
+            if v is not None:
+                return int(v)
+        except (ValueError, OSError):
+            continue
+    return None
+
+
+def _write_properties(hoodie: str, meta, table_path: str) -> None:
+    props_path = os.path.join(hoodie, "hoodie.properties")
+    if os.path.exists(props_path):
+        return
+    props = {
+        "hoodie.table.name": meta.name or os.path.basename(table_path),
+        "hoodie.table.type": "COPY_ON_WRITE",
+        "hoodie.table.version": "6",
+        "hoodie.timeline.layout.version": "1",
+        "hoodie.table.base.file.format": "PARQUET",
+        "hoodie.table.partition.fields": ",".join(meta.partitionColumns),
+        "hoodie.datasource.write.hive_style_partitioning": "true",
+        "hoodie.table.checksum": "0",
+        "hoodie.populate.meta.fields": "false",
+    }
+    with open(props_path, "w") as f:
+        f.write("#Updated at " + time.strftime("%c") + "\n")
+        for k, v in props.items():
+            f.write(f"{k}={v}\n")
+
+
+def _partition_of(pv) -> str:
+    pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
+    return "/".join(f"{k}={v}" for k, v in sorted(pv_dict.items())) or ""
+
+
+def _write_stat(path: str, size, stats, prev_commit: str) -> Dict:
+    nrec = 0
+    if stats:
+        try:
+            nrec = int(json.loads(stats).get("numRecords") or 0)
+        except ValueError:
+            pass
+    return {
+        "fileId": os.path.basename(path).rsplit(".", 1)[0],
+        "path": path,
+        "prevCommit": prev_commit,
+        "numWrites": nrec,
+        "numInserts": nrec,
+        "numUpdateWrites": 0,
+        "numDeletes": 0,
+        "totalWriteBytes": int(size or 0),
+        "fileSizeInBytes": int(size or 0),
+    }
+
+
+def _archive_old_instants(hoodie: str) -> None:
+    """Move completed instants beyond the active cap into
+    `.hoodie/archived/` (the reference's timeline archival)."""
+    instants = _timeline_instants(hoodie)
+    if len(instants) <= ACTIVE_TIMELINE_CAP:
+        return
+    archived_dir = os.path.join(hoodie, "archived")
+    os.makedirs(archived_dir, exist_ok=True)
+    for instant in instants[:-ACTIVE_TIMELINE_CAP]:
+        for suffix in _STATE_SUFFIXES:
+            src = os.path.join(hoodie, f"{instant}{suffix}")
+            if os.path.exists(src):
+                os.replace(src, os.path.join(archived_dir,
+                                             f"{instant}{suffix}"))
+
 
 def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
+    """Convert `snapshot` into the next Hudi timeline instant; returns
+    the completed `.commit` path."""
     table_path = table_path or snapshot.table_path
     hoodie = os.path.join(table_path, ".hoodie")
     os.makedirs(hoodie, exist_ok=True)
-    props_path = os.path.join(hoodie, "hoodie.properties")
     meta = snapshot.metadata
-    if not os.path.exists(props_path):
-        props = {
-            "hoodie.table.name": meta.name or os.path.basename(table_path),
-            "hoodie.table.type": "COPY_ON_WRITE",
-            "hoodie.table.version": "6",
-            "hoodie.timeline.layout.version": "1",
-            "hoodie.table.base.file.format": "PARQUET",
-            "hoodie.table.partition.fields": ",".join(meta.partitionColumns),
-            "hoodie.table.checksum": "0",
-        }
-        with open(props_path, "w") as f:
-            f.write("#Updated at " + time.strftime("%c") + "\n")
-            for k, v in props.items():
-                f.write(f"{k}={v}\n")
+    _write_properties(hoodie, meta, table_path)
 
-    instant = time.strftime("%Y%m%d%H%M%S") + f"{snapshot.version:03d}"
-    files = snapshot.state.add_files_table
-    partition_stats: dict = {}
-    for p, size, pv in zip(
-        files.column("path").to_pylist(),
-        files.column("size").to_pylist(),
-        files.column("partition_values").to_pylist(),
-    ):
-        pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
-        partition = "/".join(
-            f"{k}={v}" for k, v in sorted(pv_dict.items())
-        ) or ""
-        partition_stats.setdefault(partition, []).append(
-            {"path": p, "fileSizeInBytes": int(size or 0)}
-        )
+    prev_instants = _timeline_instants(hoodie)
+    prev_commit = prev_instants[-1] if prev_instants else "null"
+    prev_delta_v = _last_converted_delta_version(hoodie)
+    if prev_delta_v is not None and prev_delta_v >= snapshot.version:
+        return os.path.join(hoodie, f"{prev_instants[-1]}.commit")
+
+    # instants must be strictly increasing even within one wall-second
+    instant = time.strftime("%Y%m%d%H%M%S") + f"{snapshot.version % 1000:03d}"
+    if prev_instants and instant <= prev_instants[-1]:
+        instant = f"{int(prev_instants[-1]) + 1:017d}"
+
+    # --- state 1: REQUESTED ---
+    requested_path = os.path.join(hoodie, f"{instant}.commit.requested")
+    with open(requested_path, "w") as f:
+        f.write("")
+
+    # --- state 2: INFLIGHT (carries the planned operation) ---
+    inflight_path = os.path.join(hoodie, f"{instant}.inflight")
+    with open(inflight_path, "w") as f:
+        json.dump({"operationType": "UPSERT"}, f)
+
+    # --- gather write stats (incremental when the range is available) ---
+    incremental = None
+    if prev_delta_v is not None and prev_delta_v < snapshot.version:
+        from delta_tpu.interop.commitrange import delta_range_actions
+
+        rng = delta_range_actions(
+            table_path, prev_delta_v + 1, snapshot.version)
+        if rng is not None:
+            incremental = (rng[0], rng[3])
+
+    partition_stats: Dict[str, List[Dict]] = {}
+    replaced: Dict[str, List[str]] = {}
+    if incremental is not None:
+        adds, removed = incremental
+        for p, a in adds.items():
+            partition = _partition_of(a.get("partitionValues"))
+            partition_stats.setdefault(partition, []).append(
+                _write_stat(p, a.get("size"), a.get("stats"), prev_commit))
+        for p in sorted(removed):
+            # replaced file groups are looked up PER PARTITION by Hudi
+            # readers — key by the remove action's partition values
+            partition = _partition_of(removed[p].get("partitionValues"))
+            replaced.setdefault(partition, []).append(
+                os.path.basename(p).rsplit(".", 1)[0])
+        op = "UPSERT" if removed else "INSERT"
+    else:
+        files = snapshot.state.add_files_table
+        for p, size, pv, st in zip(
+                files.column("path").to_pylist(),
+                files.column("size").to_pylist(),
+                files.column("partition_values").to_pylist(),
+                files.column("stats").to_pylist()):
+            partition = _partition_of(pv)
+            partition_stats.setdefault(partition, []).append(
+                _write_stat(p, size, st, prev_commit))
+        op = "BULK_INSERT"
+
     commit_doc = {
         "partitionToWriteStats": partition_stats,
+        "partitionToReplaceFileIds": replaced,
         "compacted": False,
-        "extraMetadata": {"delta.version": str(snapshot.version)},
-        "operationType": "UPSERT",
+        "extraMetadata": {
+            "delta.version": str(snapshot.version),
+            "schema": meta.schemaString,
+        },
+        "operationType": op,
     }
+
+    # --- state 3: COMPLETED ---
     commit_path = os.path.join(hoodie, f"{instant}.commit")
     with open(commit_path, "w") as f:
         json.dump(commit_doc, f, indent=2)
+
+    _archive_old_instants(hoodie)
     return commit_path
 
 

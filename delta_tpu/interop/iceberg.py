@@ -179,22 +179,51 @@ _ICEBERG_PRIM_TO_AVRO = {
 }
 
 
-def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
-    """Write Iceberg metadata for `snapshot`; returns the metadata.json
-    path."""
-    table_path = table_path or snapshot.table_path
-    meta_dir = os.path.join(table_path, "metadata")
-    os.makedirs(meta_dir, exist_ok=True)
+SNAPSHOT_RETENTION = 20  # expire-snapshots: keep at most this many
 
-    delta_meta = snapshot.metadata
-    schema = delta_meta.schema
-    ice_schema, last_column_id = iceberg_schema(schema)
-    partition_cols = list(delta_meta.partitionColumns)
-    snapshot_id = snapshot.version + 1  # stable, monotonic
-    sequence_number = snapshot.version + 1
-    now_ms = int(time.time() * 1000)
 
-    # partition spec
+def iceberg_schema_stable(schema: StructType, configuration) -> tuple:
+    """Iceberg schema with STABLE field ids: when Delta column mapping is
+    active (IcebergCompat requires it), field ids come from
+    `delta.columnMapping.id` so renames keep their identity across
+    conversions (reference `IcebergConversionTransaction`'s schema
+    mapping). Collection element ids are allocated past maxColumnId.
+    Without mapping, falls back to first-fit sequential ids."""
+    mode = (configuration or {}).get("delta.columnMapping.mode", "none")
+    if mode == "none":
+        return iceberg_schema(schema)
+    max_id = int((configuration or {}).get(
+        "delta.columnMapping.maxColumnId", "0"))
+    gen = _IdGen()
+    gen.next_id = max_id  # element/key/value ids go beyond mapped ids
+
+    def conv(dt: DataType):
+        if isinstance(dt, StructType):
+            out = []
+            for f in dt.fields:
+                fid = f.metadata.get("delta.columnMapping.id")
+                out.append({
+                    "id": int(fid) if fid is not None else gen(),
+                    "name": f.name,
+                    "required": not f.nullable,
+                    "type": conv(f.dataType),
+                })
+            return {"type": "struct", "fields": out}
+        if isinstance(dt, ArrayType):
+            return {"type": "list", "element-id": gen(),
+                    "element": conv(dt.elementType),
+                    "element-required": not dt.containsNull}
+        if isinstance(dt, MapType):
+            return {"type": "map", "key-id": gen(), "key": conv(dt.keyType),
+                    "value-id": gen(), "value": conv(dt.valueType),
+                    "value-required": not dt.valueContainsNull}
+        return _iceberg_type(dt, gen)
+
+    top = conv(schema)
+    return {"schema-id": 0, **top}, gen.next_id
+
+
+def _partition_spec(ice_schema, schema, partition_cols):
     spec_fields = []
     partition_avro_fields = []
     for i, c in enumerate(partition_cols):
@@ -202,72 +231,65 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
         field_id = 1000 + i
         spec_fields.append(
             {"name": c, "transform": "identity", "source-id": source_id,
-             "field-id": field_id}
-        )
+             "field-id": field_id})
         f = schema[c]
-        ice_t = (
-            _DELTA_TO_ICEBERG.get(f.dataType.name, "string")
-            if isinstance(f.dataType, PrimitiveType)
-            else "string"
-        )
+        ice_t = (_DELTA_TO_ICEBERG.get(f.dataType.name, "string")
+                 if isinstance(f.dataType, PrimitiveType) else "string")
         avro_t = _ICEBERG_PRIM_TO_AVRO.get(ice_t, "string")
         partition_avro_fields.append(
-            {"name": c, "type": ["null", avro_t], "field-id": field_id}
-        )
+            {"name": c, "type": ["null", avro_t], "field-id": field_id})
+    return spec_fields, partition_avro_fields
 
-    # --- manifest ---
+
+def _partition_value(schema, partition_cols, pv, c):
     from delta_tpu.stats.partition import deserialize_partition_value
+    import datetime as dt
 
-    entries = []
-    files = snapshot.state.add_files_table
-    paths = files.column("path").to_pylist()
-    sizes = files.column("size").to_pylist()
-    pvs = files.column("partition_values").to_pylist()
-    stats_col = files.column("stats").to_pylist()
-    total_rows = 0
-    for p, size, pv, st in zip(paths, sizes, pvs, stats_col):
-        abs_path = p if ("://" in p or p.startswith("/")) else f"{table_path}/{p}"
-        nrec = 0
-        if st:
-            try:
-                nrec = int(json.loads(st).get("numRecords") or 0)
-            except ValueError:
-                pass
-        total_rows += nrec
-        pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
-        partition = {}
-        for c in partition_cols:
-            f = schema[c]
-            dtype = f.dataType if isinstance(f.dataType, PrimitiveType) else PrimitiveType("string")
-            v = deserialize_partition_value(pv_dict.get(c), dtype)
-            import datetime as dt
+    f = schema[c]
+    dtype = (f.dataType if isinstance(f.dataType, PrimitiveType)
+             else PrimitiveType("string"))
+    v = deserialize_partition_value(pv.get(c), dtype)
+    if isinstance(v, dt.date) and not isinstance(v, dt.datetime):
+        v = (v - dt.date(1970, 1, 1)).days
+    elif isinstance(v, dt.datetime):
+        v = int(v.timestamp() * 1_000_000)
+    return v
 
-            if isinstance(v, dt.date) and not isinstance(v, dt.datetime):
-                v = (v - dt.date(1970, 1, 1)).days
-            elif isinstance(v, dt.datetime):
-                v = int(v.timestamp() * 1_000_000)
-            partition[c] = v
-        entries.append(
-            {
-                "status": 1,  # ADDED (full rewrite each conversion)
-                "snapshot_id": snapshot_id,
-                "sequence_number": None,     # inherited
-                "file_sequence_number": None,
-                "data_file": {
-                    "content": 0,
-                    "file_path": abs_path,
-                    "file_format": "PARQUET",
-                    "partition": partition,
-                    "record_count": nrec,
-                    "file_size_in_bytes": int(size or 0),
-                },
-            }
-        )
 
-    entry_schema = _manifest_entry_schema(partition_avro_fields)
-    manifest_name = f"manifest-{uuid.uuid4()}.avro"
-    manifest_path = os.path.join(meta_dir, manifest_name)
-    manifest_bytes = avro_io.write_ocf(
+def _data_file_entry(table_path, schema, partition_cols, path, size, pv,
+                     stats, status, snapshot_id):
+    abs_path = (path if ("://" in path or path.startswith("/"))
+                else f"{table_path}/{path}")
+    nrec = 0
+    if stats:
+        try:
+            nrec = int(json.loads(stats).get("numRecords") or 0)
+        except ValueError:
+            pass
+    pv_dict = {k: v for k, v in pv} if isinstance(pv, list) else (pv or {})
+    partition = {c: _partition_value(schema, partition_cols, pv_dict, c)
+                 for c in partition_cols}
+    return {
+        "status": status,  # 1 ADDED / 0 EXISTING / 2 DELETED
+        "snapshot_id": snapshot_id,
+        "sequence_number": None,       # inherited
+        "file_sequence_number": None,
+        "data_file": {
+            "content": 0,
+            "file_path": abs_path,
+            "file_format": "PARQUET",
+            "partition": partition,
+            "record_count": nrec,
+            "file_size_in_bytes": int(size or 0),
+        },
+    }, nrec
+
+
+def _write_manifest(meta_dir, entries, entry_schema, ice_schema,
+                    spec_fields):
+    name = f"manifest-{uuid.uuid4()}.avro"
+    path = os.path.join(meta_dir, name)
+    data = avro_io.write_ocf(
         entry_schema, entries,
         metadata={
             "schema": json.dumps(ice_schema),
@@ -275,41 +297,297 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
             "partition-spec-id": "0",
             "format-version": "2",
             "content": "data",
-        },
-    )
-    with open(manifest_path, "wb") as f:
-        f.write(manifest_bytes)
+        })
+    with open(path, "wb") as f:
+        f.write(data)
+    return path, len(data)
+
+
+def _manifest_list_entry(path, length, seq, snapshot_id, added, existing,
+                         deleted, added_rows, existing_rows, deleted_rows):
+    return {
+        "manifest_path": path,
+        "manifest_length": length,
+        "partition_spec_id": 0,
+        "content": 0,
+        "sequence_number": seq,
+        "min_sequence_number": seq,
+        "added_snapshot_id": snapshot_id,
+        "added_files_count": added,
+        "existing_files_count": existing,
+        "deleted_files_count": deleted,
+        "added_rows_count": added_rows,
+        "existing_rows_count": existing_rows,
+        "deleted_rows_count": deleted_rows,
+    }
+
+
+def _load_prev_metadata(meta_dir):
+    v = _read_version_hint(meta_dir)
+    if v is None:
+        return None, None
+    path = os.path.join(meta_dir, f"v{v}.metadata.json")
+    try:
+        with open(path) as f:
+            return json.load(f), v
+    except (FileNotFoundError, ValueError):
+        return None, None
+
+
+def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
+    """Write Iceberg metadata for `snapshot`; returns the metadata.json
+    path.
+
+    Incremental per-commit-type conversion (reference
+    `IcebergConverter.scala:74` + `IcebergConversionTransaction`):
+    appends become a new ADDED manifest while previous manifests are
+    REUSED untouched; deletes/rewrites rewrite only the manifests that
+    contain removed files (entries marked DELETED); the snapshot list
+    grows with parent ids + snapshot-log/metadata-log entries; snapshots
+    beyond SNAPSHOT_RETENTION are expired (their manifest lists removed,
+    manifests kept while any retained snapshot references them). Falls
+    back to a full rewrite when there is no previous conversion, the
+    schema changed, or the needed commit range was vacuumed."""
+    table_path = table_path or snapshot.table_path
+    meta_dir = os.path.join(table_path, "metadata")
+    os.makedirs(meta_dir, exist_ok=True)
+
+    delta_meta = snapshot.metadata
+    schema = delta_meta.schema
+    configuration = delta_meta.configuration
+    ice_schema, last_column_id = iceberg_schema_stable(schema, configuration)
+    partition_cols = list(delta_meta.partitionColumns)
+    spec_fields, partition_avro_fields = _partition_spec(
+        ice_schema, schema, partition_cols)
+    entry_schema = _manifest_entry_schema(partition_avro_fields)
+    snapshot_id = snapshot.version + 1  # stable, monotonic
+    now_ms = int(time.time() * 1000)
+
+    prev_doc, prev_md_version = _load_prev_metadata(meta_dir)
+    incremental = None
+    if prev_doc is not None:
+        try:
+            prev_delta_v = int(prev_doc["properties"]["delta.version"])
+        except (KeyError, ValueError):
+            prev_delta_v = None
+        prev_schema = next(
+            (s for s in prev_doc.get("schemas", [])
+             if s.get("schema-id") == prev_doc.get("current-schema-id")),
+            None)
+        schema_changed = (prev_schema is not None and
+                          prev_schema.get("fields") != ice_schema["fields"])
+        if (prev_delta_v is not None and prev_delta_v < snapshot.version
+                and not schema_changed):
+            from delta_tpu.interop.commitrange import delta_range_actions
+
+            rng = delta_range_actions(
+                table_path, prev_delta_v + 1, snapshot.version)
+            # a metadata change may alter the partition spec that reused
+            # manifests were written under: force the full rewrite
+            if rng is not None and not rng[2]:
+                incremental = (rng[0], rng[1])
+        if prev_delta_v is not None and prev_delta_v >= snapshot.version:
+            return os.path.join(
+                meta_dir, f"v{prev_md_version}.metadata.json")
+
+    sequence_number = (prev_doc["last-sequence-number"] + 1
+                       if prev_doc is not None else 1)
+
+    mlist_entries: List[dict] = []
+    summary_op = "overwrite"
+    added_count = deleted_count = 0
+    added_rows = 0
+    deleted_rows_total = 0
+
+    if incremental is not None and prev_doc is not None:
+        adds, removed_paths = incremental
+        removed_abs = {
+            p if ("://" in p or p.startswith("/")) else f"{table_path}/{p}"
+            for p in removed_paths}
+        # previous snapshot's manifest list
+        prev_snap = next(
+            s for s in prev_doc["snapshots"]
+            if s["snapshot-id"] == prev_doc["current-snapshot-id"])
+        with open(prev_snap["manifest-list"], "rb") as f:
+            _, prev_manifests, _ = avro_io.read_ocf(f.read())
+        for m in prev_manifests:
+            with open(m["manifest_path"], "rb") as f:
+                _, entries, _ = avro_io.read_ocf(f.read())
+            live = [e for e in entries if e["status"] != 2]
+            hit = [e for e in live
+                   if e["data_file"]["file_path"] in removed_abs]
+            if not hit:
+                mlist_entries.append(m)  # reuse untouched
+                continue
+            # rewrite: removed entries marked DELETED, the rest EXISTING
+            new_entries = []
+            kept_rows = del_rows = 0
+            for e in live:
+                dead = e["data_file"]["file_path"] in removed_abs
+                new_entries.append({
+                    **e,
+                    "status": 2 if dead else 0,
+                    "snapshot_id": snapshot_id if dead
+                    else e["snapshot_id"],
+                })
+                if dead:
+                    del_rows += e["data_file"]["record_count"]
+                    deleted_rows_total += e["data_file"]["record_count"]
+                    deleted_count += 1
+                else:
+                    kept_rows += e["data_file"]["record_count"]
+            path, length = _write_manifest(
+                meta_dir, new_entries, entry_schema, ice_schema, spec_fields)
+            mlist_entries.append(_manifest_list_entry(
+                path, length, m["sequence_number"], snapshot_id,
+                0, len(new_entries) - len(hit), len(hit),
+                0, kept_rows, del_rows))
+        new_adds = []
+        for p, a in adds.items():
+            entry, nrec = _data_file_entry(
+                table_path, schema, partition_cols, p, a.get("size"),
+                a.get("partitionValues"), a.get("stats"), 1, snapshot_id)
+            new_adds.append(entry)
+            added_rows += nrec
+        added_count = len(new_adds)
+        if new_adds:
+            path, length = _write_manifest(
+                meta_dir, new_adds, entry_schema, ice_schema, spec_fields)
+            mlist_entries.append(_manifest_list_entry(
+                path, length, sequence_number, snapshot_id,
+                len(new_adds), 0, 0, added_rows, 0, 0))
+        summary_op = ("append" if not removed_paths
+                      else ("delete" if not adds else "overwrite"))
+    else:
+        # full conversion from the snapshot's live set
+        files = snapshot.state.add_files_table
+        entries = []
+        for p, size, pv, st in zip(
+                files.column("path").to_pylist(),
+                files.column("size").to_pylist(),
+                files.column("partition_values").to_pylist(),
+                files.column("stats").to_pylist()):
+            entry, nrec = _data_file_entry(
+                table_path, schema, partition_cols, p, size, pv, st, 1,
+                snapshot_id)
+            entries.append(entry)
+            added_rows += nrec
+        added_count = len(entries)
+        path, length = _write_manifest(
+            meta_dir, entries, entry_schema, ice_schema, spec_fields)
+        mlist_entries.append(_manifest_list_entry(
+            path, length, sequence_number, snapshot_id,
+            len(entries), 0, 0, added_rows, 0, 0))
 
     # --- manifest list ---
     mlist_name = f"snap-{snapshot_id}-{uuid.uuid4()}.avro"
     mlist_path = os.path.join(meta_dir, mlist_name)
     mlist_bytes = avro_io.write_ocf(
-        _MANIFEST_FILE_SCHEMA,
-        [
-            {
-                "manifest_path": manifest_path,
-                "manifest_length": len(manifest_bytes),
-                "partition_spec_id": 0,
-                "content": 0,
-                "sequence_number": sequence_number,
-                "min_sequence_number": sequence_number,
-                "added_snapshot_id": snapshot_id,
-                "added_files_count": len(entries),
-                "existing_files_count": 0,
-                "deleted_files_count": 0,
-                "added_rows_count": total_rows,
-                "existing_rows_count": 0,
-                "deleted_rows_count": 0,
-            }
-        ],
-        metadata={"format-version": "2"},
-    )
+        _MANIFEST_FILE_SCHEMA, mlist_entries,
+        metadata={"format-version": "2"})
     with open(mlist_path, "wb") as f:
         f.write(mlist_bytes)
 
-    # --- table metadata ---
-    prev_meta = _read_version_hint(meta_dir)
-    metadata_version = (prev_meta or 0) + 1
+    # --- table metadata: lineage, schema evolution, expiry ---
+    # running table total: previous snapshot's total +/- this commit's
+    # net rows (full conversions re-derive it from the live set)
+    if prev_doc is not None and incremental is not None:
+        prev_snap_for_total = next(
+            (s for s in prev_doc.get("snapshots", [])
+             if s["snapshot-id"] == prev_doc.get("current-snapshot-id")),
+            None)
+        try:
+            prev_total = int(
+                prev_snap_for_total["summary"]["total-records"])
+        except (TypeError, KeyError, ValueError):
+            prev_total = 0
+        total_records = prev_total + added_rows - deleted_rows_total
+    else:
+        total_records = added_rows
+    new_snap = {
+        "snapshot-id": snapshot_id,
+        "sequence-number": sequence_number,
+        "timestamp-ms": now_ms,
+        "manifest-list": mlist_path,
+        "summary": {
+            "operation": summary_op,
+            "added-data-files": str(added_count),
+            "deleted-data-files": str(deleted_count),
+            "added-records": str(added_rows),
+            "total-records": str(total_records),
+        },
+        "schema-id": 0,
+    }
+    snapshots: List[dict] = []
+    snapshot_log: List[dict] = []
+    metadata_log: List[dict] = []
+    schemas = [ice_schema]
+    current_schema_id = 0
+    if prev_doc is not None:
+        snapshots = list(prev_doc.get("snapshots", []))
+        snapshot_log = list(prev_doc.get("snapshot-log", []))
+        metadata_log = list(prev_doc.get("metadata-log", []))
+        new_snap["parent-snapshot-id"] = prev_doc.get("current-snapshot-id")
+        # schema evolution: keep history, bump schema-id on change
+        schemas = list(prev_doc.get("schemas", []))
+        prev_schema = next(
+            (s for s in schemas
+             if s.get("schema-id") == prev_doc.get("current-schema-id")),
+            None)
+        if prev_schema is not None and \
+                prev_schema.get("fields") != ice_schema["fields"]:
+            current_schema_id = max(
+                s["schema-id"] for s in schemas) + 1
+            schemas.append({**ice_schema, "schema-id": current_schema_id})
+        else:
+            current_schema_id = prev_doc.get("current-schema-id", 0)
+            schemas = schemas or [ice_schema]
+        new_snap["schema-id"] = current_schema_id
+        metadata_log.append({
+            "metadata-file": os.path.join(
+                meta_dir, f"v{prev_md_version}.metadata.json"),
+            "timestamp-ms": prev_doc.get("last-updated-ms", now_ms),
+        })
+    snapshots.append(new_snap)
+    snapshot_log.append({"snapshot-id": snapshot_id, "timestamp-ms": now_ms})
+
+    # expire-snapshots: retain the newest SNAPSHOT_RETENTION
+    if len(snapshots) > SNAPSHOT_RETENTION:
+        expired = snapshots[:-SNAPSHOT_RETENTION]
+        snapshots = snapshots[-SNAPSHOT_RETENTION:]
+        keep_ids = {s["snapshot-id"] for s in snapshots}
+        snapshot_log = [e for e in snapshot_log
+                        if e["snapshot-id"] in keep_ids]
+        # referenced manifests survive; orphaned manifest lists go
+        referenced = set()
+        for s in snapshots:
+            try:
+                with open(s["manifest-list"], "rb") as f:
+                    _, ms, _ = avro_io.read_ocf(f.read())
+                referenced |= {m["manifest_path"] for m in ms}
+            except (FileNotFoundError, ValueError):
+                pass
+        for s in expired:
+            try:
+                with open(s["manifest-list"], "rb") as f:
+                    _, ms, _ = avro_io.read_ocf(f.read())
+                for m in ms:
+                    mp = m["manifest_path"]
+                    if mp not in referenced and os.path.exists(mp):
+                        os.unlink(mp)
+                os.unlink(s["manifest-list"])
+            except (FileNotFoundError, ValueError):
+                pass
+
+    schemas_out = []
+    for s in schemas:
+        sid = s.get("schema-id", 0)
+        if sid == current_schema_id:
+            schemas_out.append({**ice_schema, "schema-id": sid})
+        else:
+            schemas_out.append(s)
+
+    metadata_version = (prev_md_version or 0) + 1
     metadata_doc = {
         "format-version": 2,
         "table-uuid": delta_meta.id,
@@ -317,8 +595,8 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
         "last-sequence-number": sequence_number,
         "last-updated-ms": now_ms,
         "last-column-id": last_column_id,
-        "current-schema-id": 0,
-        "schemas": [ice_schema],
+        "current-schema-id": current_schema_id,
+        "schemas": schemas_out,
         "default-spec-id": 0,
         "partition-specs": [{"spec-id": 0, "fields": spec_fields}],
         "last-partition-id": 1000 + max(0, len(spec_fields)) - 1 if spec_fields else 999,
@@ -329,24 +607,9 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
             "delta.version": str(snapshot.version),
         },
         "current-snapshot-id": snapshot_id,
-        "snapshots": [
-            {
-                "snapshot-id": snapshot_id,
-                "sequence-number": sequence_number,
-                "timestamp-ms": now_ms,
-                "manifest-list": mlist_path,
-                "summary": {
-                    "operation": "overwrite",
-                    "added-data-files": str(len(entries)),
-                    "total-records": str(total_rows),
-                },
-                "schema-id": 0,
-            }
-        ],
-        "snapshot-log": [
-            {"snapshot-id": snapshot_id, "timestamp-ms": now_ms}
-        ],
-        "metadata-log": [],
+        "snapshots": snapshots,
+        "snapshot-log": snapshot_log,
+        "metadata-log": metadata_log,
     }
     md_path = os.path.join(meta_dir, f"v{metadata_version}.metadata.json")
     with open(md_path, "w") as f:

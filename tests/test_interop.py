@@ -171,3 +171,152 @@ def test_iceberg_compat_versions_mutually_exclusive(tmp_table_path):
             properties={"delta.enableIcebergCompatV1": "true",
                         "delta.enableIcebergCompatV2": "true",
                         "delta.columnMapping.mode": "name"})
+
+
+def test_iceberg_incremental_append_reuses_manifests(tmp_table_path):
+    """An append converts into a NEW manifest while the previous
+    manifest is reused untouched (IcebergConversionTransaction's append
+    path), with snapshot lineage + logs."""
+    _mk(tmp_table_path,
+        props={"delta.universalFormat.enabledFormats": "iceberg"})
+    meta_dir = os.path.join(tmp_table_path, "metadata")
+    with open(os.path.join(meta_dir, "v1.metadata.json")) as f:
+        md1 = json.load(f)
+    snap1 = md1["snapshots"][-1]
+    _, manifests1, _ = avro_io.read_ocf(
+        open(snap1["manifest-list"], "rb").read())
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([100], pa.int64()), "p": pa.array(["z"])}),
+        mode="append")
+    with open(os.path.join(meta_dir, "v2.metadata.json")) as f:
+        md2 = json.load(f)
+    assert len(md2["snapshots"]) == 2
+    snap2 = md2["snapshots"][-1]
+    assert snap2["parent-snapshot-id"] == snap1["snapshot-id"]
+    assert snap2["summary"]["operation"] == "append"
+    assert [e["snapshot-id"] for e in md2["snapshot-log"]] == [
+        s["snapshot-id"] for s in md2["snapshots"]]
+    assert md2["metadata-log"][-1]["metadata-file"].endswith(
+        "v1.metadata.json")
+
+    _, manifests2, _ = avro_io.read_ocf(
+        open(snap2["manifest-list"], "rb").read())
+    # previous manifest path appears unchanged + one new ADDED manifest
+    prev_paths = {m["manifest_path"] for m in manifests1}
+    assert prev_paths <= {m["manifest_path"] for m in manifests2}
+    new = [m for m in manifests2 if m["manifest_path"] not in prev_paths]
+    assert len(new) == 1 and new[0]["added_files_count"] == 1
+
+
+def test_iceberg_incremental_delete_rewrites_touched_manifest(tmp_table_path):
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+
+    _mk(tmp_table_path,
+        props={"delta.universalFormat.enabledFormats": "iceberg"})
+    delete(Table.for_path(tmp_table_path), predicate=col("p") == lit("a"))
+    meta_dir = os.path.join(tmp_table_path, "metadata")
+    with open(os.path.join(meta_dir, "v2.metadata.json")) as f:
+        md = json.load(f)
+    snap = md["snapshots"][-1]
+    assert snap["summary"]["operation"] in ("delete", "overwrite")
+    _, manifests, _ = avro_io.read_ocf(
+        open(snap["manifest-list"], "rb").read())
+    # the rewritten manifest marks the removed file DELETED
+    statuses = []
+    for m in manifests:
+        _, entries, _ = avro_io.read_ocf(
+            open(m["manifest_path"], "rb").read())
+        statuses += [e["status"] for e in entries]
+    assert 2 in statuses  # DELETED entry present
+
+
+def test_iceberg_schema_evolution_bumps_schema_id(tmp_table_path):
+    _mk(tmp_table_path,
+        props={"delta.universalFormat.enabledFormats": "iceberg"})
+    dta.write_table(tmp_table_path, pa.table({
+        "id": pa.array([5], pa.int64()),
+        "p": pa.array(["a"]),
+        "extra": pa.array([1.5]),
+    }), mode="append", merge_schema=True)
+    meta_dir = os.path.join(tmp_table_path, "metadata")
+    with open(os.path.join(meta_dir, "v2.metadata.json")) as f:
+        md = json.load(f)
+    assert len(md["schemas"]) == 2
+    assert md["current-schema-id"] == 1
+    assert md["snapshots"][-1]["schema-id"] == 1  # snapshot binds new schema
+    cur = next(s for s in md["schemas"] if s["schema-id"] == 1)
+    assert [f["name"] for f in cur["fields"]] == ["id", "p", "extra"]
+
+
+def test_iceberg_snapshot_expiry(tmp_table_path):
+    import delta_tpu.interop.iceberg as ice
+
+    _mk(tmp_table_path,
+        props={"delta.universalFormat.enabledFormats": "iceberg"})
+    old_retention = ice.SNAPSHOT_RETENTION
+    ice.SNAPSHOT_RETENTION = 3
+    try:
+        for i in range(5):
+            dta.write_table(tmp_table_path, pa.table(
+                {"id": pa.array([i], pa.int64()),
+                 "p": pa.array(["x"])}), mode="append")
+    finally:
+        ice.SNAPSHOT_RETENTION = old_retention
+    meta_dir = os.path.join(tmp_table_path, "metadata")
+    with open(os.path.join(meta_dir, "version-hint.text")) as f:
+        v = int(f.read())
+    with open(os.path.join(meta_dir, f"v{v}.metadata.json")) as f:
+        md = json.load(f)
+    assert len(md["snapshots"]) == 3
+    keep = {s["snapshot-id"] for s in md["snapshots"]}
+    assert {e["snapshot-id"] for e in md["snapshot-log"]} == keep
+    # retained manifest lists resolve; every manifest they reference exists
+    for s in md["snapshots"]:
+        _, ms, _ = avro_io.read_ocf(open(s["manifest-list"], "rb").read())
+        for m in ms:
+            assert os.path.exists(m["manifest_path"])
+
+
+def test_hudi_timeline_states_and_archival(tmp_table_path):
+    import delta_tpu.interop.hudi as hudi
+
+    _mk(tmp_table_path, partition=True,
+        props={"delta.universalFormat.enabledFormats": "hudi"})
+    hoodie = os.path.join(tmp_table_path, ".hoodie")
+    commits = sorted(f for f in os.listdir(hoodie) if f.endswith(".commit"))
+    assert len(commits) == 1
+    instant = commits[0][:-len(".commit")]
+    # full three-state lifecycle on disk
+    assert os.path.exists(os.path.join(hoodie, f"{instant}.commit.requested"))
+    assert os.path.exists(os.path.join(hoodie, f"{instant}.inflight"))
+
+    # incremental append: write stats cover ONLY the new file, linked to
+    # the previous instant
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([7], pa.int64()), "p": pa.array(["a"])}),
+        mode="append")
+    commits = sorted(f for f in os.listdir(hoodie) if f.endswith(".commit"))
+    assert len(commits) == 2
+    with open(os.path.join(hoodie, commits[-1])) as f:
+        doc = json.load(f)
+    stats = [s for part in doc["partitionToWriteStats"].values() for s in part]
+    assert len(stats) == 1
+    assert stats[0]["prevCommit"] == commits[0][:-len(".commit")]
+    assert doc["extraMetadata"]["delta.version"] == "1"
+
+    # archival: drive past the cap and check instants moved to archived/
+    old_cap = hudi.ACTIVE_TIMELINE_CAP
+    hudi.ACTIVE_TIMELINE_CAP = 2
+    try:
+        for i in range(3):
+            dta.write_table(tmp_table_path, pa.table(
+                {"id": pa.array([i], pa.int64()), "p": pa.array(["b"])}),
+                mode="append")
+    finally:
+        hudi.ACTIVE_TIMELINE_CAP = old_cap
+    active = sorted(f for f in os.listdir(hoodie) if f.endswith(".commit"))
+    assert len(active) == 2
+    archived = os.listdir(os.path.join(hoodie, "archived"))
+    assert any(a.endswith(".commit") for a in archived)
