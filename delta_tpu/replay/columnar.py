@@ -653,7 +653,10 @@ def columnarize_log_segment(
                 and (mesh is None or mesh.devices.size <= 1)):
             def launch(scan, row_versions, row_orders):
                 from delta_tpu.ops.replay import replay_select_launch
+                from delta_tpu.replay.state import BLOCKWISE_MIN_ROWS
 
+                if scan.n_rows >= BLOCKWISE_MIN_ROWS:
+                    return None  # >HBM: compute_masks_device streams blocks
                 if row_versions.max(initial=0) >= 2**31:
                     return None
                 return replay_select_launch(

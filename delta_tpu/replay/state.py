@@ -178,6 +178,11 @@ def _dv_codes_only(file_actions: pa.Table) -> np.ndarray:
     return (codes + 1).astype(np.uint32)
 
 
+# beyond this many file actions, one-shot device replay would need
+# multi-GB HBM headroom for the sort; stream blocks instead
+BLOCKWISE_MIN_ROWS = 32_000_000
+
+
 def compute_masks_device(
     columnar: ColumnarActions, engine=None
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -219,6 +224,13 @@ def compute_masks_device(
             mesh=mesh, fa_hint=fa_hint,
         )
         return live, tomb
+    if n >= BLOCKWISE_MIN_ROWS:
+        # >HBM scale path (SURVEY §5.7): stream fixed-size blocks through
+        # the device with a persistent key bitset instead of one giant sort
+        from delta_tpu.ops.replay_blockwise import replay_select_blockwise
+
+        return replay_select_blockwise(
+            [path_codes, dv_codes], version.astype(np.int32), order, is_add)
     return replay_select(
         [path_codes, dv_codes], version.astype(np.int32), order, is_add,
         fa_hint=fa_hint,
