@@ -363,16 +363,22 @@ class TpcdsLiteBenchmark(Benchmark):
         self.metric("q2_files_scanned", len(scan2.files()), "files",
                     total=n_files)
 
-        # Q3: fact-dim join + group-by (TPC-DS Q3 shape: brand revenue
-        # for one year)
-        with self.timed("q3_join_groupby"):
-            years = date_dim.filter(pc.equal(date_dim.column("d_year"), 2020))
-            fact = snap.scan().to_arrow()
-            j = fact.join(years, keys="ss_sold_date_sk",
-                          right_keys="d_date_sk", join_type="inner")
-            j = j.join(item, keys="ss_item_sk", right_keys="i_item_sk")
-            q3 = j.group_by("i_brand_id").aggregate(
-                [("ss_sales_price", "sum")]).num_rows
+        # Q3: fact-dim join + group-by through the SQL frontend
+        # (TPC-DS Q3 shape: brand revenue for one year)
+        from delta_tpu.sql import sql as run_sql
+
+        with self.timed("q3_join_groupby_sql"):
+            out = run_sql(
+                f"SELECT i.i_brand_id AS brand, "
+                f"SUM(f.ss_sales_price) AS rev "
+                f"FROM '{fact_path}' f "
+                f"JOIN '{os.path.join(root, 'date_dim')}' d "
+                f"ON f.ss_sold_date_sk = d.d_date_sk "
+                f"JOIN '{os.path.join(root, 'item')}' i "
+                f"ON f.ss_item_sk = i.i_item_sk "
+                f"WHERE d.d_year = 2020 "
+                f"GROUP BY i.i_brand_id ORDER BY rev DESC LIMIT 10")
+            q3 = out.num_rows
 
         # Q4: full-scan aggregate
         with self.timed("q4_full_agg"):

@@ -509,3 +509,76 @@ def test_merge_into_sql_formatting_and_literals(tmp_path):
     rows = sorted(zip(out.column("id").to_pylist(),
                       out.column("note").to_pylist()))
     assert rows == [(1, "a THEN b"), (2, "n2")]
+
+
+# ----------------------------------------------- joins + aggregates
+
+
+@pytest.fixture
+def star_tables(tmp_path):
+    """A small star schema: fact sales + dimension stores."""
+    fact = str(tmp_path / "sales")
+    dim = str(tmp_path / "stores")
+    dta.write_table(fact, pa.table({
+        "store_id": pa.array([1, 1, 2, 2, 3], pa.int64()),
+        "amount": pa.array([10.0, 20.0, 5.0, 15.0, 40.0]),
+    }))
+    dta.write_table(dim, pa.table({
+        "store_id": pa.array([1, 2, 3], pa.int64()),
+        "region": pa.array(["east", "east", "west"]),
+    }))
+    return fact, dim
+
+
+def test_select_aggregates_without_group(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"x": pa.array([1, 2, 3, 4], pa.int64())}))
+    out = sql(f"SELECT COUNT(*), SUM(x) AS total, AVG(x) AS mean "
+              f"FROM '{tmp_table_path}'")
+    assert out.column("count(*)").to_pylist() == [4]
+    assert out.column("total").to_pylist() == [10]
+    assert out.column("mean").to_pylist() == [2.5]
+
+
+def test_select_group_by_order_limit(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "k": pa.array(["a", "b", "a", "c", "b", "a"]),
+        "v": pa.array([1, 2, 3, 4, 5, 6], pa.int64()),
+    }))
+    out = sql(f"SELECT k, SUM(v) AS total FROM '{tmp_table_path}' "
+              f"GROUP BY k ORDER BY total DESC LIMIT 2")
+    assert out.column("k").to_pylist() == ["a", "b"]
+    assert out.column("total").to_pylist() == [10, 7]
+
+
+def test_select_join_with_aliases(star_tables):
+    fact, dim = star_tables
+    out = sql(f"SELECT s.region, SUM(f.amount) AS rev "
+              f"FROM '{fact}' f JOIN '{dim}' s ON f.store_id = s.store_id "
+              f"GROUP BY s.region ORDER BY rev DESC")
+    assert out.column("region").to_pylist() == ["east", "west"]
+    assert out.column("rev").to_pylist() == [50.0, 40.0]
+
+
+def test_select_join_where_residual(star_tables):
+    fact, dim = star_tables
+    out = sql(f"SELECT f.amount FROM '{fact}' f "
+              f"JOIN '{dim}' s ON f.store_id = s.store_id "
+              f"WHERE s.region = 'west' ORDER BY amount")
+    assert out.column("amount").to_pylist() == [40.0]
+
+
+def test_select_ambiguous_column_requires_alias(star_tables):
+    fact, dim = star_tables
+    with pytest.raises(DeltaError, match="not in scope|not found"):
+        sql(f"SELECT store_id FROM '{fact}' f "
+            f"JOIN '{dim}' s ON f.store_id = s.store_id")
+
+
+def test_select_non_grouped_column_rejected(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "k": pa.array(["a", "b"]),
+        "v": pa.array([1, 2], pa.int64()),
+    }))
+    with pytest.raises(DeltaError, match="GROUP BY"):
+        sql(f"SELECT v, COUNT(*) FROM '{tmp_table_path}' GROUP BY k")
