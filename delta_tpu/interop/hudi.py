@@ -26,24 +26,33 @@ from typing import Dict, List, Optional
 UNIFORM_FORMATS_KEY = "delta.universalFormat.enabledFormats"
 
 ACTIVE_TIMELINE_CAP = 10   # archive completed instants beyond this many
-_STATE_SUFFIXES = (".commit", ".commit.requested", ".inflight")
+# both commit actions, all three lifecycle states
+_STATE_SUFFIXES = (".commit", ".commit.requested", ".commit.inflight",
+                   ".replacecommit", ".replacecommit.requested",
+                   ".replacecommit.inflight", ".inflight")
 
 
-def _timeline_instants(hoodie: str) -> List[str]:
-    """Completed commit instants, ascending."""
+def _timeline_instants(hoodie: str) -> List[tuple]:
+    """Completed instants as (instant_ts, action), ascending. Removals
+    complete as `replacecommit` (the only action whose replaced file
+    groups Hudi readers honor); pure appends as `commit`."""
     try:
         names = os.listdir(hoodie)
     except FileNotFoundError:
         return []
-    return sorted(n[:-len(".commit")] for n in names
-                  if n.endswith(".commit") and not n.endswith(".inflight"))
+    out = []
+    for n in names:
+        for action in ("commit", "replacecommit"):
+            if n.endswith(f".{action}"):
+                out.append((n[:-(len(action) + 1)], action))
+                break
+    return sorted(out)
 
 
 def _last_converted_delta_version(hoodie: str) -> Optional[int]:
-    instants = _timeline_instants(hoodie)
-    for instant in reversed(instants):
+    for instant, action in reversed(_timeline_instants(hoodie)):
         try:
-            with open(os.path.join(hoodie, f"{instant}.commit")) as f:
+            with open(os.path.join(hoodie, f"{instant}.{action}")) as f:
                 doc = json.load(f)
             v = doc.get("extraMetadata", {}).get("delta.version")
             if v is not None:
@@ -107,7 +116,7 @@ def _archive_old_instants(hoodie: str) -> None:
         return
     archived_dir = os.path.join(hoodie, "archived")
     os.makedirs(archived_dir, exist_ok=True)
-    for instant in instants[:-ACTIVE_TIMELINE_CAP]:
+    for instant, _action in instants[:-ACTIVE_TIMELINE_CAP]:
         for suffix in _STATE_SUFFIXES:
             src = os.path.join(hoodie, f"{instant}{suffix}")
             if os.path.exists(src):
@@ -125,25 +134,16 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
     _write_properties(hoodie, meta, table_path)
 
     prev_instants = _timeline_instants(hoodie)
-    prev_commit = prev_instants[-1] if prev_instants else "null"
+    prev_commit = prev_instants[-1][0] if prev_instants else "null"
     prev_delta_v = _last_converted_delta_version(hoodie)
     if prev_delta_v is not None and prev_delta_v >= snapshot.version:
-        return os.path.join(hoodie, f"{prev_instants[-1]}.commit")
+        last_ts, last_action = prev_instants[-1]
+        return os.path.join(hoodie, f"{last_ts}.{last_action}")
 
     # instants must be strictly increasing even within one wall-second
     instant = time.strftime("%Y%m%d%H%M%S") + f"{snapshot.version % 1000:03d}"
-    if prev_instants and instant <= prev_instants[-1]:
-        instant = f"{int(prev_instants[-1]) + 1:017d}"
-
-    # --- state 1: REQUESTED ---
-    requested_path = os.path.join(hoodie, f"{instant}.commit.requested")
-    with open(requested_path, "w") as f:
-        f.write("")
-
-    # --- state 2: INFLIGHT (carries the planned operation) ---
-    inflight_path = os.path.join(hoodie, f"{instant}.inflight")
-    with open(inflight_path, "w") as f:
-        json.dump({"operationType": "UPSERT"}, f)
+    if prev_instants and instant <= prev_instants[-1][0]:
+        instant = f"{int(prev_instants[-1][0]) + 1:017d}"
 
     # --- gather write stats (incremental when the range is available) ---
     incremental = None
@@ -170,6 +170,7 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
             replaced.setdefault(partition, []).append(
                 os.path.basename(p).rsplit(".", 1)[0])
         op = "UPSERT" if removed else "INSERT"
+        action = "replacecommit" if removed else "commit"
     else:
         files = snapshot.state.add_files_table
         for p, size, pv, st in zip(
@@ -181,6 +182,17 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
             partition_stats.setdefault(partition, []).append(
                 _write_stat(p, size, st, prev_commit))
         op = "BULK_INSERT"
+        action = "commit"
+
+    # --- lifecycle: REQUESTED -> INFLIGHT (with the real planned op)
+    # -> COMPLETED. Removals use the `replacecommit` action: Hudi readers
+    # only honor replaced file groups declared by replacecommits.
+    with open(os.path.join(hoodie, f"{instant}.{action}.requested"),
+              "w") as f:
+        f.write("")
+    with open(os.path.join(hoodie, f"{instant}.{action}.inflight"),
+              "w") as f:
+        json.dump({"operationType": op}, f)
 
     commit_doc = {
         "partitionToWriteStats": partition_stats,
@@ -193,8 +205,7 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
         "operationType": op,
     }
 
-    # --- state 3: COMPLETED ---
-    commit_path = os.path.join(hoodie, f"{instant}.commit")
+    commit_path = os.path.join(hoodie, f"{instant}.{action}")
     with open(commit_path, "w") as f:
         json.dump(commit_doc, f, indent=2)
 

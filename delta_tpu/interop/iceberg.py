@@ -365,6 +365,7 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
 
     prev_doc, prev_md_version = _load_prev_metadata(meta_dir)
     incremental = None
+    schema_changed = False
     if prev_doc is not None:
         try:
             prev_delta_v = int(prev_doc["properties"]["delta.version"])
@@ -429,6 +430,13 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
                     "status": 2 if dead else 0,
                     "snapshot_id": snapshot_id if dead
                     else e["snapshot_id"],
+                    # EXISTING/DELETED entries may not inherit a null
+                    # sequence number from a manifest they didn't enter
+                    # with (Iceberg v2 inheritance rule): make the data
+                    # sequence explicit
+                    "sequence_number": (e["sequence_number"]
+                                        if e["sequence_number"] is not None
+                                        else m["sequence_number"]),
                 })
                 if dead:
                     del_rows += e["data_file"]["record_count"]
@@ -529,19 +537,13 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
         metadata_log = list(prev_doc.get("metadata-log", []))
         new_snap["parent-snapshot-id"] = prev_doc.get("current-snapshot-id")
         # schema evolution: keep history, bump schema-id on change
-        schemas = list(prev_doc.get("schemas", []))
-        prev_schema = next(
-            (s for s in schemas
-             if s.get("schema-id") == prev_doc.get("current-schema-id")),
-            None)
-        if prev_schema is not None and \
-                prev_schema.get("fields") != ice_schema["fields"]:
+        schemas = list(prev_doc.get("schemas", [])) or [ice_schema]
+        if schema_changed:
             current_schema_id = max(
-                s["schema-id"] for s in schemas) + 1
+                s.get("schema-id", 0) for s in schemas) + 1
             schemas.append({**ice_schema, "schema-id": current_schema_id})
         else:
             current_schema_id = prev_doc.get("current-schema-id", 0)
-            schemas = schemas or [ice_schema]
         new_snap["schema-id"] = current_schema_id
         metadata_log.append({
             "metadata-file": os.path.join(

@@ -290,7 +290,7 @@ def test_hudi_timeline_states_and_archival(tmp_table_path):
     instant = commits[0][:-len(".commit")]
     # full three-state lifecycle on disk
     assert os.path.exists(os.path.join(hoodie, f"{instant}.commit.requested"))
-    assert os.path.exists(os.path.join(hoodie, f"{instant}.inflight"))
+    assert os.path.exists(os.path.join(hoodie, f"{instant}.commit.inflight"))
 
     # incremental append: write stats cover ONLY the new file, linked to
     # the previous instant
@@ -320,3 +320,30 @@ def test_hudi_timeline_states_and_archival(tmp_table_path):
     assert len(active) == 2
     archived = os.listdir(os.path.join(hoodie, "archived"))
     assert any(a.endswith(".commit") for a in archived)
+
+
+def test_hudi_delete_completes_as_replacecommit(tmp_table_path):
+    """Removals must complete as a `replacecommit` instant — the only
+    action whose replaced file groups Hudi readers honor."""
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+
+    _mk(tmp_table_path, partition=True,
+        props={"delta.universalFormat.enabledFormats": "hudi"})
+    delete(Table.for_path(tmp_table_path), predicate=col("p") == lit("a"))
+    hoodie = os.path.join(tmp_table_path, ".hoodie")
+    rc = sorted(f for f in os.listdir(hoodie)
+                if f.endswith(".replacecommit"))
+    assert len(rc) == 1
+    instant = rc[0][:-len(".replacecommit")]
+    assert os.path.exists(
+        os.path.join(hoodie, f"{instant}.replacecommit.requested"))
+    with open(os.path.join(hoodie,
+                           f"{instant}.replacecommit.inflight")) as f:
+        assert json.load(f)["operationType"] == "UPSERT"
+    with open(os.path.join(hoodie, rc[0])) as f:
+        doc = json.load(f)
+    replaced = [fid for fids in doc["partitionToReplaceFileIds"].values()
+                for fid in fids]
+    assert replaced, "replaced file groups must be declared"
+    assert "p=a" in doc["partitionToReplaceFileIds"]
