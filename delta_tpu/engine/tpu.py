@@ -1,11 +1,16 @@
 """TpuEngine: the TPU-backed Engine implementation.
 
-I/O handlers (JSON/Parquet/filesystem) stay host-side — object-store bytes
-never touch the accelerator — but everything columnar runs on device:
+I/O and byte decode (JSON/Parquet/filesystem) stay host-side — a
+deliberate, measured boundary (docs/architecture.md "Device-compute
+boundary"): raw-byte wrangling on device would ship MORE over the
+host<->device link than the 1-2 bits/row the host encoder produces. The
+device owns the regular columnar work:
 
 - snapshot state reconstruction: jit'd sort + segmented last-wins reduce
-  (`delta_tpu.ops.replay`), optionally sharded over a `jax.sharding.Mesh`
-  (`delta_tpu.parallel`);
+  (`delta_tpu.ops.replay`; blockwise >HBM variant in
+  `ops.replay_blockwise`), optionally sharded over a
+  `jax.sharding.Mesh` (`delta_tpu.parallel`);
+- MERGE match-finding: sort/segment equi-join (`delta_tpu.ops.join`);
 - data-skipping predicate evaluation over the stats index
   (`delta_tpu.stats.skipping`);
 - stats aggregation (min/max/nullCount) for written files and checkpoint

@@ -152,3 +152,38 @@ class SchemaMismatchError(DeltaError):
 
 class PartitionColumnMismatchError(DeltaError):
     error_class = "DELTA_PARTITION_COLUMN_MISMATCH"
+
+
+# ------------------------------------------------------------- catalog
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def error_catalog() -> dict:
+    """The stable error-class catalog (reference:
+    `spark/src/main/resources/error/delta-error-classes.json` +
+    `DeltaThrowableHelper.scala`): maps every ``error_class`` to its
+    message template and SQLSTATE."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "resources",
+                        "error_classes.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def error_info(err: "DeltaError") -> dict:
+    """Structured view of an error: class, SQLSTATE, template, message,
+    and the bound context parameters — what the reference surfaces
+    through `DeltaThrowableHelper`."""
+    catalog = error_catalog()
+    entry = catalog.get(err.error_class) or catalog["DELTA_ERROR"]
+    return {
+        "errorClass": err.error_class,
+        "sqlState": entry["sqlState"],
+        "messageTemplate": " ".join(entry["message"]),
+        "message": str(err),
+        "parameters": dict(getattr(err, "context", {}) or {}),
+    }

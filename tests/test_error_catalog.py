@@ -1,0 +1,53 @@
+"""Error-class catalog: every concrete error type resolves to a stable
+class with an SQLSTATE (the reference's delta-error-classes.json role)."""
+
+import inspect
+
+import delta_tpu.errors as E
+from delta_tpu.errors import DeltaError, error_catalog, error_info
+
+
+def _concrete_error_classes():
+    out = []
+    for _, obj in inspect.getmembers(E, inspect.isclass):
+        if issubclass(obj, DeltaError):
+            out.append(obj)
+    # classes defined elsewhere that carry their own error_class
+    from delta_tpu.commands.merge import MergeCardinalityError
+    from delta_tpu.log.segment import CorruptLogError
+
+    out += [MergeCardinalityError, CorruptLogError]
+    return out
+
+
+def test_every_error_class_is_in_the_catalog():
+    catalog = error_catalog()
+    for cls in _concrete_error_classes():
+        assert cls.error_class in catalog, cls.__name__
+        entry = catalog[cls.error_class]
+        assert entry["sqlState"]
+        assert entry["message"]
+
+
+def test_error_classes_are_unique_where_distinct():
+    seen = {}
+    for cls in _concrete_error_classes():
+        if cls.error_class in seen and seen[cls.error_class] is not cls:
+            # subclass sharing a parent's class is allowed only for
+            # aliases; distinct top-level types must not collide
+            assert issubclass(cls, seen[cls.error_class]) or issubclass(
+                seen[cls.error_class], cls), (
+                f"{cls.__name__} and {seen[cls.error_class].__name__} share "
+                f"{cls.error_class}")
+        seen.setdefault(cls.error_class, cls)
+
+
+def test_error_info_structure():
+    try:
+        raise E.VersionNotFoundError(version=7, earliest=0, latest=3)
+    except DeltaError as e:
+        info = error_info(e)
+    assert info["errorClass"] == "DELTA_VERSION_NOT_FOUND"
+    assert info["sqlState"] == "42815"
+    assert info["parameters"]["version"] == 7
+    assert "version" in info["messageTemplate"]
