@@ -567,6 +567,10 @@ def _exec_select_extended(s: str, engine, catalog):
     rest, ob = _split_before_keyword(rest, "ORDER")
     if ob is not None:
         order_text = re.sub(r"^ORDER\s+BY\s+", "", ob, flags=re.IGNORECASE)
+    having_text = None
+    rest, hv = _split_before_keyword(rest, "HAVING")
+    if hv is not None:
+        having_text = re.sub(r"^HAVING\s+", "", hv, flags=re.IGNORECASE)
     group_text = None
     rest, gb = _split_before_keyword(rest, "GROUP")
     if gb is not None:
@@ -576,9 +580,27 @@ def _exec_select_extended(s: str, engine, catalog):
     if wh is not None:
         where_text = re.sub(r"^WHERE\s+", "", wh, flags=re.IGNORECASE)
 
-    # FROM + JOINs
+    # FROM + JOINs (INNER and LEFT [OUTER]); the LEFT keyword precedes
+    # JOIN so the splitter keys on JOIN and inspects the tail of the
+    # preceding segment
     joins = []
     first, j = _split_before_keyword(rest, "JOIN")
+
+    def _strip_join_kind(before: str):
+        m2 = re.search(r"\s+(LEFT(?:\s+OUTER)?|INNER|RIGHT(?:\s+OUTER)?"
+                       r"|FULL(?:\s+OUTER)?|CROSS)\s*$", before,
+                       re.IGNORECASE)
+        if m2:
+            kw = m2.group(1).upper()
+            if kw.startswith(("RIGHT", "FULL", "CROSS")):
+                raise DeltaError(
+                    f"{kw} JOIN is not supported; use INNER or LEFT "
+                    "[OUTER] JOIN")
+            kind = "left outer" if kw.startswith("LEFT") else "inner"
+            return before[:m2.start()], kind
+        return before, "inner"
+
+    first, next_kind = _strip_join_kind(first)
     while j is not None:
         j = re.sub(r"^JOIN\s+", "", j, flags=re.IGNORECASE)
         ref_text, on_rest = _split_before_keyword(j, "ON")
@@ -586,11 +608,13 @@ def _exec_select_extended(s: str, engine, catalog):
             raise DeltaError("JOIN requires ON")
         on_rest = re.sub(r"^ON\s+", "", on_rest, flags=re.IGNORECASE)
         on_text, j2 = _split_before_keyword(on_rest, "JOIN")
-        joins.append((ref_text.strip(), on_text.strip()))
+        this_kind = next_kind
+        on_text, next_kind = _strip_join_kind(on_text)
+        joins.append((ref_text.strip(), on_text.strip(), this_kind))
         j = j2
 
     tables = [_parse_table_ref(first, engine, catalog)]
-    for ref_text, _on in joins:
+    for ref_text, _on, _kind in joins:
         tables.append(_parse_table_ref(ref_text, engine, catalog))
 
     # resolve schemas + build the scope mapping BEFORE scanning so
@@ -638,7 +662,7 @@ def _exec_select_extended(s: str, engine, catalog):
         loaded.append((alias, arrow))
 
     current = loaded[0][1]
-    for (_, on_text), (alias, right) in zip(joins, loaded[1:]):
+    for (_, on_text, join_kind), (alias, right) in zip(joins, loaded[1:]):
         on_expr = parse_expression(on_text)
         left_keys, right_keys = [], []
         from delta_tpu.expressions.tree import Column as _Col
@@ -663,7 +687,7 @@ def _exec_select_extended(s: str, engine, catalog):
                     f"JOIN keys {a!r}/{b!r} do not span the two sides")
         current = current.join(right, keys=left_keys,
                                right_keys=right_keys,
-                               join_type="inner", coalesce_keys=False)
+                               join_type=join_kind, coalesce_keys=False)
 
     if where_conjuncts:
         pred = where_conjuncts[0]
@@ -676,7 +700,8 @@ def _exec_select_extended(s: str, engine, catalog):
     # select list
     items = [t.strip() for t in _split_top_level_commas(select_text)]
     agg_re = re.compile(
-        r"^(?P<fn>count|sum|min|max|avg)\s*\(\s*(?P<arg>\*|[A-Za-z_][\w.]*)\s*\)"
+        r"^(?P<fn>count|sum|min|max|avg)\s*\(\s*(?P<distinct>DISTINCT\s+)?"
+        r"(?P<arg>\*|[A-Za-z_][\w.]*)\s*\)"
         r"(?:\s+AS\s+(?P<alias>[A-Za-z_][\w]*))?$", re.IGNORECASE)
     col_re = re.compile(
         r"^(?P<col>[A-Za-z_][\w.]*)(?:\s+AS\s+(?P<alias>[A-Za-z_][\w]*))?$",
@@ -707,15 +732,21 @@ def _exec_select_extended(s: str, engine, catalog):
             has_agg = True
             fn = am.group("fn").lower()
             arg = am.group("arg")
+            distinct = bool(am.group("distinct"))
             if arg == "*":
-                if fn != "count":
+                if fn != "count" or distinct:
                     raise DeltaError(f"{fn}(*) is not a thing; use a column")
                 aggs.append(([], "count_all", "count_all",
                              am.group("alias") or "count(*)"))
             else:
+                if distinct and fn != "count":
+                    raise DeltaError("DISTINCT is supported only in COUNT")
                 phys = phys_of(arg)
-                aggs.append((phys, _AGG_FNS[fn], f"{phys}_{_AGG_FNS[fn]}",
-                             am.group("alias") or f"{fn}({arg})"))
+                pfn = "count_distinct" if distinct else _AGG_FNS[fn]
+                label = (f"count(distinct {arg})" if distinct
+                         else f"{fn}({arg})")
+                aggs.append((phys, pfn, f"{phys}_{pfn}",
+                             am.group("alias") or label))
             continue
         cm = col_re.match(it)
         if not cm:
@@ -743,7 +774,15 @@ def _exec_select_extended(s: str, engine, catalog):
                          (c.split(".", 1)[1] if "." in c else c))
         names += [a[3] for a in aggs]
         out = out.rename_columns(names)
+        if having_text is not None:
+            having_map = {(c,): c for c in out.column_names}
+            pred = _rewrite_columns(parse_expression(having_text),
+                                    having_map)
+            keep = evaluate_predicate_host(pred, out)
+            out = out.filter(pa.array(keep))
     else:
+        if having_text is not None:
+            raise DeltaError("HAVING requires GROUP BY or aggregates")
         out = current.select([p for p, _ in plain]).rename_columns(
             [o for _, o in plain])
 

@@ -61,3 +61,31 @@ def test_blockwise_device_footprint_is_bounded():
     live_b, _ = replay_select_blockwise(
         [pk, dk], ver, order, add, block_rows=block)
     assert live_b.sum() > 0
+
+
+def test_product_load_routes_blockwise_above_threshold(
+        tmp_table_path, monkeypatch):
+    """A snapshot load whose action count crosses BLOCKWISE_MIN_ROWS
+    reconstructs through the streaming path, with identical results."""
+    import pyarrow as pa
+
+    import delta_tpu.api as dta
+    import delta_tpu.replay.state as state_mod
+    from delta_tpu.engine.tpu import TpuEngine
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array(np.arange(1000, dtype=np.int64))}),
+        target_rows_per_file=100)
+    for i in range(3):
+        dta.write_table(tmp_table_path, pa.table(
+            {"id": pa.array([i], pa.int64())}), mode="append")
+
+    normal = Table.for_path(tmp_table_path, TpuEngine()).latest_snapshot()
+    monkeypatch.setattr(state_mod, "BLOCKWISE_MIN_ROWS", 1)
+    blockwise = Table.for_path(
+        tmp_table_path, TpuEngine()).latest_snapshot()
+    a = sorted(normal.state.add_files_table.column("path").to_pylist())
+    b = sorted(blockwise.state.add_files_table.column("path").to_pylist())
+    assert a == b
+    assert normal.state.size_in_bytes == blockwise.state.size_in_bytes

@@ -582,3 +582,55 @@ def test_select_non_grouped_column_rejected(tmp_table_path):
     }))
     with pytest.raises(DeltaError, match="GROUP BY"):
         sql(f"SELECT v, COUNT(*) FROM '{tmp_table_path}' GROUP BY k")
+
+
+def test_select_left_join_keeps_unmatched(star_tables, tmp_path):
+    fact, dim = star_tables
+    # a store with no sales
+    extra = str(tmp_path / "stores2")
+    dta.write_table(extra, pa.table({
+        "store_id": pa.array([1, 2, 3, 99], pa.int64()),
+        "region": pa.array(["east", "east", "west", "moon"]),
+    }))
+    out = sql(f"SELECT s.store_id, SUM(f.amount) AS rev "
+              f"FROM '{extra}' s LEFT JOIN '{fact}' f "
+              f"ON s.store_id = f.store_id "
+              f"GROUP BY s.store_id ORDER BY store_id")
+    assert out.column("store_id").to_pylist() == [1, 2, 3, 99]
+    assert out.column("rev").to_pylist()[-1] is None  # unmatched store
+
+
+def test_select_having(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "k": pa.array(["a", "b", "a", "c", "b", "a"]),
+        "v": pa.array([1, 2, 3, 4, 5, 6], pa.int64()),
+    }))
+    out = sql(f"SELECT k, SUM(v) AS total FROM '{tmp_table_path}' "
+              f"GROUP BY k HAVING total > 5 ORDER BY total DESC")
+    assert out.column("k").to_pylist() == ["a", "b"]
+    assert out.column("total").to_pylist() == [10, 7]
+
+
+def test_select_count_distinct(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "k": pa.array(["a", "a", "b", "b", "b"]),
+        "v": pa.array([1, 1, 2, 3, 3], pa.int64()),
+    }))
+    out = sql(f"SELECT COUNT(DISTINCT v) AS dv, COUNT(*) AS n "
+              f"FROM '{tmp_table_path}'")
+    assert out.column("dv").to_pylist() == [3]
+    assert out.column("n").to_pylist() == [5]
+
+
+def test_select_having_without_group_rejected(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"v": pa.array([1], pa.int64())}))
+    with pytest.raises(DeltaError, match="HAVING"):
+        sql(f"SELECT v FROM '{tmp_table_path}' HAVING v > 1")
+
+
+def test_select_right_join_rejected(star_tables):
+    fact, dim = star_tables
+    with pytest.raises(DeltaError, match="RIGHT JOIN is not supported"):
+        sql(f"SELECT f.amount FROM '{fact}' f RIGHT JOIN '{dim}' s "
+            f"ON f.store_id = s.store_id")
