@@ -100,6 +100,13 @@ def load(allow_compile: bool = True) -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         lib.das_scan.restype = ctypes.c_void_p
+        lib.das_scan2.restype = ctypes.c_void_p
+        lib.das_scan2.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_int32, ctypes.c_int32]
+        lib.das_stats_materialize.restype = ctypes.c_int32
+        lib.das_stats_materialize.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_int64]
         lib.das_scan.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                  ctypes.c_int32]
         lib.das_free.argtypes = [ctypes.c_void_p]
@@ -175,6 +182,22 @@ class _NativeScanHandle:
             pass
 
 
+class _NativeReadHandle:
+    """Owns one dar_read buffer (lazy-stats spans point into it)."""
+
+    __slots__ = ("_lib", "_h")
+
+    def __init__(self, lib, h):
+        self._lib = lib
+        self._h = h
+
+    def __del__(self):
+        try:
+            self._lib.dar_free(self._h)
+        except Exception:
+            pass
+
+
 class ScanResult:
     """Columnar output of one native scan.
 
@@ -235,7 +258,14 @@ class ScanResult:
         self.mod_time = numcol(16, 17, n, 8)
         self.data_change = (col(18, n, np.uint8).astype(bool),
                             col(19, n, np.uint8).astype(bool))
-        self.stats = strcol(20, 9, 22, n)
+        # lazy-stats mode: the stats column is still raw escaped spans in
+        # the input buffer; materialize_stats() decodes it on demand
+        self.stats_lazy = bool(lib.das_n(h, 14))
+        if self.stats_lazy:
+            self.stats = None
+            self._stats_valid = col(57, n, np.uint8).astype(bool)
+        else:
+            self.stats = strcol(20, 9, 22, n)
         self.tags = strcol(23, 10, 25, n)
         self.dv_valid = col(26, n, np.uint8).astype(bool)
         self.dv_storage = strcol(27, 11, 29, n)
@@ -254,6 +284,55 @@ class ScanResult:
         self.other_start = col(53, n_oth, np.int64)
         self.other_end = col(54, n_oth, np.int64)
         self.line_starts = col(55, self.n_lines, np.int64)
+
+    def attach_read_buffer(self, rh, buf_ptr, total: int) -> None:
+        """Adopt the dar_read handle whose buffer the lazy stats spans
+        reference (freed with this result)."""
+        self._rh = _NativeReadHandle(self._owner._lib, rh)
+        self._rh_buf = buf_ptr
+        self._rh_len = total
+
+    def materialize_stats(self) -> None:
+        """Decode the deferred stats spans into the standard column
+        buffers (idempotent, thread-safe — ctypes drops the GIL during
+        the native call, so an unguarded double call would race on the
+        native result)."""
+        if not self.stats_lazy:
+            return
+        import threading
+
+        lock = self.__dict__.setdefault("_stats_lock", threading.Lock())
+        with lock:
+            if not self.stats_lazy:
+                return
+            self._materialize_stats_locked()
+
+    def _materialize_stats_locked(self) -> None:
+        import pyarrow as pa
+
+        lib = self._owner._lib
+        h = self._owner._h
+        rc = lib.das_stats_materialize(
+            h, ctypes.cast(self._rh_buf, ctypes.c_char_p), self._rh_len)
+        if rc != 0:
+            raise ValueError("malformed stats content surfaced during "
+                             "deferred decode")
+        n = self.n_rows
+
+        def fbuf(which, nbytes):
+            if nbytes == 0:
+                return pa.py_buffer(b"")
+            return pa.foreign_buffer(lib.das_ptr(h, which), nbytes,
+                                     base=self._owner)
+
+        offsets = fbuf(20, (n + 1) * 4)
+        arena = fbuf(21, int(lib.das_n(h, 9)))
+        self.stats = (offsets, arena, self._stats_valid)
+        self.stats_lazy = False
+        # spans no longer needed; the read buffer may now be released
+        rh = getattr(self, "_rh", None)
+        if rh is not None:
+            self._rh = None
 
     def uniq_strings(self):
         """Unique paths (code order) as an Arrow string array."""
@@ -302,7 +381,7 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
         raise
 
 
-def scan_commit_files(paths) -> Optional[tuple]:
+def scan_commit_files(paths, lazy_stats: bool = False) -> Optional[tuple]:
     """Read a list of LOCAL commit files and scan them in one native
     round-trip (no per-file Python overhead, no buffer copy into the
     interpreter). Returns (ScanResult, others_bytes, file_starts,
@@ -325,8 +404,9 @@ def scan_commit_files(paths) -> Optional[tuple]:
                      ptr_fn=lambda h, w: lib.dar_starts(h))
         from delta_tpu.utils.threads import default_scan_threads
 
-        sh = lib.das_scan(ctypes.cast(buf_ptr, ctypes.c_char_p), total,
-                          default_scan_threads())
+        sh = lib.das_scan2(ctypes.cast(buf_ptr, ctypes.c_char_p), total,
+                           default_scan_threads(),
+                           1 if lazy_stats else 0)
         if lib.das_error(sh):
             lib.das_free(sh)
             return None
@@ -339,9 +419,14 @@ def scan_commit_files(paths) -> Optional[tuple]:
         raw = (ctypes.c_char * total).from_address(buf_ptr) if total else b""
         others = [bytes(raw[int(s):int(e)])
                   for s, e in zip(scan.other_start, scan.other_end)]
+        if scan.stats_lazy:
+            # the spans reference the read buffer: the result adopts it
+            scan.attach_read_buffer(rh, buf_ptr, total)
+            rh = None
         return scan, others, starts, total
     finally:
-        lib.dar_free(rh)
+        if rh is not None:
+            lib.dar_free(rh)
 
 
 class FaEncoded:

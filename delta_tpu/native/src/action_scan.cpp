@@ -241,6 +241,8 @@ struct SlotVal {
   int64_t a_end;
   bool esc;          // SL_STR: decoded into scratch (span not in input)
   bool in_arena;     // SL_STR: decoded straight into the column arena
+  bool lazy_span;    // SL_STR stats in lazy mode: a_start/a_end are raw
+                     // escaped offsets into the input buffer
 };
 
 // Inlined equality for the short runtime-length literals (10-40 bytes):
@@ -310,6 +312,15 @@ struct Builder {
   std::string tmp;       // reusable unescape scratch
   std::string path_tmp;  // separate scratch: path bytes stay live while
                          // later fields reuse `tmp`
+  // lazy-stats mode: stats VALUES are recorded as raw escaped byte
+  // spans into the input buffer (opening quote .. after closing quote)
+  // instead of being unescaped into the arena during the scan; a later
+  // das_stats_materialize() call decodes them in one pass. Stats are
+  // ~60% of commit bytes and many loads never read them.
+  bool lazy_stats = false;
+  const char* buf_base = nullptr;
+  NumCol<int64_t> stats_s;
+  NumCol<int64_t> stats_e;
   std::vector<Tmpl> tmpls;  // learned line templates, MRU first
   std::string slot_tmp[kMaxTmplSlots];  // per-slot unescape scratch
   uint32_t tmpl_hits = 0, tmpl_learns = 0;
@@ -328,7 +339,13 @@ struct Builder {
 
   // densify every lazily-padded positional column to `rows`
   void pad_all_to(size_t rows) {
-    for (auto* s : {&stats, &tags, &clustering, &dv_storage, &dv_pathinline})
+    if (lazy_stats) {
+      stats_s.pad_to(rows);
+      stats_e.pad_to(rows);
+    } else {
+      stats.pad_to(rows);
+    }
+    for (auto* s : {&tags, &clustering, &dv_storage, &dv_pathinline})
       s->pad_to(rows);
     size.pad_to(rows);
     mod_time.pad_to(rows);
@@ -916,10 +933,18 @@ const char* parse_file_action(const char* p, const char* end, Builder& b,
         case F_STATS:
           if (s_stats) return nullptr;
           if (p < end && *p == '"') {
-            const char *vs, *ve;
-            p = scan_jstring(p, end, b.tmp, &vs, &ve);
-            if (!p) return nullptr;
-            b.stats.add_at(b.cur_row, vs, ve - vs);
+            if (b.lazy_stats) {
+              const char* lq = skip_jstring(p, end);
+              if (!lq) return nullptr;
+              b.stats_s.add_at(b.cur_row, p - b.buf_base);
+              b.stats_e.add_at(b.cur_row, lq - b.buf_base);
+              p = lq;
+            } else {
+              const char *vs, *ve;
+              p = scan_jstring(p, end, b.tmp, &vs, &ve);
+              if (!p) return nullptr;
+              b.stats.add_at(b.cur_row, vs, ve - vs);
+            }
             s_stats = true;
           } else if (!(p = skip_value(p, end))) return nullptr;
           break;
@@ -1116,10 +1141,23 @@ inline bool match_template_impl(Builder& b, const Tmpl& t, const char* p,
     SlotVal& v = out[i];
     switch (sg.slot.type) {
       case SL_STR: {
+        if (b.lazy_stats && sg.slot.field == (uint8_t)F_STATS) {
+          // raw span only: find the closing quote, decode never
+          const char* lq = skip_jstring(p - 1, stop);
+          if (!lq) return false;
+          v.lazy_span = true;
+          v.in_arena = false;
+          v.esc = false;
+          v.a_start = (p - 1) - b.buf_base;
+          v.a_end = lq - b.buf_base;
+          p = lq - 1;  // the closing quote starts the next literal
+          break;
+        }
         const char* q = scan_to_special(p, stop);
         if (q >= stop) return false;
         v.esc = false;
         v.in_arena = false;
+        v.lazy_span = false;
         if (*q == '"') {  // no escapes: zero-copy span into the input
           v.vs = p;
           v.ve = q;
@@ -1252,7 +1290,10 @@ bool commit_template(Builder& b, const Tmpl& t, const SlotVal* vals,
         rs.s_dc = true;
         break;
       case F_STATS:
-        if (v.in_arena) {
+        if (v.lazy_span) {
+          b.stats_s.add_at(b.cur_row, v.a_start);
+          b.stats_e.add_at(b.cur_row, v.a_end);
+        } else if (v.in_arena) {
           if (b.stats.valid.size() < b.cur_row) {
             // null gap BEFORE this row: pad with the pre-append offset
             b.stats.offsets.resize(b.cur_row + 1, (int32_t)v.a_start);
@@ -1399,6 +1440,8 @@ struct Result {
   FinalNum<int32_t> dv_offset, dv_size;
   FinalNum<uint8_t> data_change, ext_meta;
   std::vector<uint8_t> dv_valid;
+  int32_t lazy_stats = 0;          // 1: stats live as raw spans below
+  FinalNum<int64_t> stats_s, stats_e;
   std::vector<int64_t> other_line_no, other_start, other_end;
   std::vector<int64_t> line_starts;
 };
@@ -1465,8 +1508,11 @@ void merge_vec(std::vector<T>& out, std::vector<Builder>& bs,
 
 extern "C" {
 
-void* das_scan(const char* buf, int64_t len, int32_t n_threads) {
+void* das_scan2(const char* buf, int64_t len, int32_t n_threads,
+                int32_t flags) {
+  const bool lazy_stats = (flags & 1) != 0;
   Result* r = new Result();
+  r->lazy_stats = lazy_stats ? 1 : 0;
   if (len <= 0) return r;
   if (n_threads < 1) n_threads = 1;
   if (n_threads > 32) n_threads = 32;
@@ -1482,6 +1528,8 @@ void* das_scan(const char* buf, int64_t len, int32_t n_threads) {
   std::vector<Builder> builders(n_threads);
   auto work = [&](int t) {
     Builder& b = builders[t];
+    b.lazy_stats = lazy_stats;
+    b.buf_base = buf;
     size_t span = (size_t)(cut[t + 1] - cut[t]);
     // ~230B/line typical: presize the per-row vectors to dodge most
     // geometric regrowth copies — at the GB scale each missed reserve
@@ -1663,7 +1711,61 @@ void* das_scan(const char* buf, int64_t len, int32_t n_threads) {
   merge_num(r->drcv, builders, &Builder::drcv);
   merge_num(r->del_ts, builders, &Builder::del_ts);
   merge_num(r->ext_meta, builders, &Builder::ext_meta);
+  if (lazy_stats) {
+    merge_num(r->stats_s, builders, &Builder::stats_s);
+    merge_num(r->stats_e, builders, &Builder::stats_e);
+  }
   return r;
+}
+
+void* das_scan(const char* buf, int64_t len, int32_t n_threads) {
+  return das_scan2(buf, len, n_threads, 0);
+}
+
+// Decode the deferred stats spans into the standard stats column. One
+// bulk pass; idempotent. Returns 0 ok, 1 on malformed escape content
+// (the scan only validated escape-pair STRUCTURE in lazy mode).
+int32_t das_stats_materialize(void* h, const char* buf, int64_t len) {
+  Result* r = (Result*)h;
+  if (!r->lazy_stats) return 0;
+  const char* end = buf + len;
+  size_t total = 0;
+  for (size_t i = 0; i < r->stats_s.vals.size(); i++)
+    if (r->stats_s.valid[i])
+      total += (size_t)(r->stats_e.vals[i] - r->stats_s.vals[i]);
+  FinalStr out;
+  out.arena.reserve(total);
+  out.offsets.reserve(r->stats_s.vals.size() + 1);
+  out.valid.reserve(r->stats_s.vals.size());
+  out.offsets.push_back(0);
+  for (size_t i = 0; i < r->stats_s.vals.size(); i++) {
+    if (!r->stats_s.valid[i]) {
+      out.offsets.push_back((int32_t)out.arena.size());
+      out.valid.push_back(0);
+      continue;
+    }
+    const char* p = buf + r->stats_s.vals[i];
+    const char* stop = buf + r->stats_e.vals[i];
+    if (stop > end || p >= stop) return 1;
+    const char* after = scan_jstring_append(p, stop, out.arena);
+    if (after != stop) return 1;
+    if (out.arena.size() > (size_t)INT32_MAX) return 1;
+    out.offsets.push_back((int32_t)out.arena.size());
+    out.valid.push_back(1);
+  }
+  r->stats = std::move(out);
+  // release the span vectors (~18 bytes/row) — the Result outlives the
+  // snapshot via Arrow foreign buffers, so dead lanes must not linger
+  r->stats_s.vals.clear();
+  r->stats_s.vals.shrink_to_fit();
+  r->stats_s.valid.clear();
+  r->stats_s.valid.shrink_to_fit();
+  r->stats_e.vals.clear();
+  r->stats_e.vals.shrink_to_fit();
+  r->stats_e.valid.clear();
+  r->stats_e.valid.shrink_to_fit();
+  r->lazy_stats = 0;
+  return 0;
 }
 
 void das_free(void* h) { delete (Result*)h; }
@@ -1691,6 +1793,7 @@ int64_t das_n(void* h, int32_t what) {
     case 11: return (int64_t)r->dv_storage.arena.size();
     case 12: return (int64_t)r->dv_pathinline.arena.size();
     case 13: return (int64_t)r->clustering.arena.size();
+    case 14: return (int64_t)r->lazy_stats;
     default: return -1;
   }
 }
@@ -1754,6 +1857,9 @@ const void* das_ptr(void* h, int32_t which) {
     case 53: return r->other_start.data();
     case 54: return r->other_end.data();
     case 55: return r->line_starts.data();
+    case 56: return r->stats_s.vals.data();
+    case 57: return r->stats_s.valid.data();
+    case 58: return r->stats_e.vals.data();
     default: return nullptr;
   }
 }

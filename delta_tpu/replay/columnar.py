@@ -20,6 +20,7 @@ counts. The reference pays this cost as a Spark JSON scan
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -96,6 +97,25 @@ class ColumnarActions:
     # assembles the Arrow table. Row-aligned with file_actions under the
     # same sole-native-block condition as replay_keys.
     pending_masks: Optional[object] = None
+    # Deferred stats decode (lazy-stats native scan): () -> Arrow string
+    # array replacing the placeholder stats column. Set under the same
+    # sole-native-block condition. NOTE: while this is set,
+    # `file_actions` carries an all-null stats PLACEHOLDER — internal
+    # replay consumers read only replay-safe columns, and SnapshotState
+    # splices the real column before any user-facing surface; any other
+    # caller must use `file_actions_complete()`.
+    stats_thunk: Optional[object] = None
+
+    def file_actions_complete(self) -> pa.Table:
+        """The canonical table with the stats column materialized (the
+        safe accessor for code outside the snapshot pipeline)."""
+        if self.stats_thunk is not None:
+            idx = self.file_actions.schema.get_field_index("stats")
+            self.file_actions = self.file_actions.set_column(
+                idx, self.file_actions.schema.field(idx),
+                self.stats_thunk())
+            self.stats_thunk = None
+        return self.file_actions
 
     @property
     def num_actions(self) -> int:
@@ -633,6 +653,7 @@ def columnarize_log_segment(
 
     native_keys = None
     native_pending = None
+    native_stats_thunk = None
     if commit_infos:
         version_arr = np.array([v for v, _, _ in commit_infos],
                                dtype=np.int64)
@@ -677,10 +698,16 @@ def columnarize_log_segment(
 
                 out = parse_commit_paths_native(
                     local, version_arr, small_only=small_only,
-                    launch=launch)
+                    launch=launch,
+                    # stats decode defers only when this scan's rows are
+                    # the whole table (sole block) — otherwise the concat
+                    # below would bake the placeholder in
+                    lazy_stats=(not blocks and not small_only
+                                and not os.environ.get(
+                                    "DELTA_TPU_EAGER_STATS")))
                 if out is not None:
-                    block, others, keys, pending, total = out
-                    parsed_native = (block, others, keys, pending)
+                    block, others, keys, pending, sthunk, total = out
+                    parsed_native = (block, others, keys, pending, sthunk)
                     bytes_parsed += total
                 else:
                     # the scanner saw (and rejected) this exact content —
@@ -706,11 +733,12 @@ def columnarize_log_segment(
                 if parsed_native is None:
                     generic = _parse_buffer_generic(buf, starts, version_arr)
         if parsed_native is not None:
-            block, others, keys, pending = parsed_native
+            block, others, keys, pending, sthunk = parsed_native
             if block.num_rows and not small_only:
                 if not blocks:
                     native_keys = keys  # row-aligned only when sole block
                     native_pending = pending
+                    native_stats_thunk = sthunk
                 blocks.append(block)
             tracker.scan_pylist(others)
         else:
@@ -748,6 +776,7 @@ def columnarize_log_segment(
         commit_infos=tracker.commit_infos,
         num_commit_files=len(commit_infos),
         pending_masks=native_pending,
+        stats_thunk=native_stats_thunk,
         bytes_parsed=bytes_parsed,
         replay_keys=native_keys,
     )

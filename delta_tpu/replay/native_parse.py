@@ -96,6 +96,12 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray,
     n = scan.n_rows
     path, codes_ok = (path_and_ok if path_and_ok is not None
                       else _path_column(scan))
+    if scan.stats is None:
+        # lazy-stats scan: a placeholder rides in the table; the caller
+        # splices the real column in before any consumer can see it
+        stats_col = pa.nulls(n, pa.string())
+    else:
+        stats_col = _str_array(scan.stats)
     keys = _str_array(scan.pv_key)
     items = _str_array(scan.pv_val)
     map_type = pa.map_(pa.string(), pa.string())
@@ -129,7 +135,7 @@ def build_canonical_table(scan, versions: np.ndarray, orders: np.ndarray,
             "size": _num_array(scan.size, pa.int64()),
             "modification_time": _num_array(scan.mod_time, pa.int64()),
             "data_change": _bool_array(scan.data_change),
-            "stats": _str_array(scan.stats),
+            "stats": stats_col,
             "tags": _str_array(scan.tags),
             "deletion_vector": dv_struct,
             "base_row_id": _num_array(scan.base_row_id, pa.int64()),
@@ -169,7 +175,8 @@ def _finish_scan(
     small_only: bool,
     launch=None,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
-                    Optional[NativeReplayKeys], Optional[object]]]:
+                    Optional[NativeReplayKeys], Optional[object],
+                    Optional[object]]]:
     """`launch`: optional callable (scan, row_versions, row_orders) ->
     pending-replay handle, invoked BEFORE the Arrow assembly so the
     device sorts while the host builds the canonical table. Only called
@@ -179,6 +186,11 @@ def _finish_scan(
         scan.line_starts, file_starts, file_versions)
     keys: Optional[NativeReplayKeys] = None
     pending = None
+    stats_thunk = None
+    if getattr(scan, "stats_lazy", False):
+        def stats_thunk(scan=scan):
+            scan.materialize_stats()
+            return _str_array(scan.stats)
     if small_only:
         from delta_tpu.replay.columnar import CANONICAL_FILE_ACTION_SCHEMA
 
@@ -206,7 +218,7 @@ def _finish_scan(
         except ValueError:
             return None  # malformed control line: let the generic path err
         others.append((int(line_versions[ln]), int(line_orders[ln]), row))
-    return table, others, keys, pending
+    return table, others, keys, pending, stats_thunk
 
 
 def parse_commits_native(
@@ -216,7 +228,8 @@ def parse_commits_native(
     small_only: bool = False,
     launch=None,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
-                    Optional[NativeReplayKeys], Optional[object]]]:
+                    Optional[NativeReplayKeys], Optional[object],
+                    Optional[object]]]:
     """Native fast path over one concatenated commit buffer.
 
     Returns (canonical file-actions table, [(version, order, action-dict)
@@ -242,13 +255,15 @@ def parse_commit_paths_native(
     file_versions: np.ndarray,
     small_only: bool = False,
     launch=None,
+    lazy_stats: bool = False,
 ) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
-                    Optional[NativeReplayKeys], Optional[object], int]]:
+                    Optional[NativeReplayKeys], Optional[object],
+                    Optional[object], int]]:
     """Native read+scan of local commit files in one round-trip (no
     per-file Python I/O). Returns (..., total_bytes) or None."""
     from delta_tpu import native
 
-    out = native.scan_commit_files(local_paths)
+    out = native.scan_commit_files(local_paths, lazy_stats=lazy_stats)
     if out is None:
         return None
     scan, others_raw, starts, total = out

@@ -44,15 +44,35 @@ class SnapshotState:
     metadata: Metadata
     set_transactions: Dict[str, SetTransaction]
     domain_metadata: Dict[str, DomainMetadata]
-    file_actions: pa.Table            # canonical schema, all actions
+    file_actions_raw: pa.Table        # canonical schema, all actions; the
+                                      # stats column may be a deferred
+                                      # placeholder until first
+                                      # `file_actions` access
     live_mask: np.ndarray             # bool over file_actions rows
     tombstone_mask: np.ndarray
     latest_commit_info: Optional[CommitInfo] = None
     commit_infos: Dict[int, CommitInfo] = field(default_factory=dict)
     timestamp_ms: int = 0
+    # deferred stats decode from the lazy-stats native scan (columnar
+    # stats_thunk); spliced exactly once below
+    stats_thunk: Optional[object] = None
 
     _add_table_cache: Optional[pa.Table] = None
     _tombstone_table_cache: Optional[pa.Table] = None
+
+    @property
+    def file_actions(self) -> pa.Table:
+        """The complete canonical table. Splices the deferred stats
+        column in on first access — stats are ~60% of commit bytes and
+        pure metadata loads (num_files/size_in_bytes/replay) never pay
+        for decoding them."""
+        if self.stats_thunk is not None:
+            idx = self.file_actions_raw.schema.get_field_index("stats")
+            col = self.stats_thunk()
+            self.file_actions_raw = self.file_actions_raw.set_column(
+                idx, self.file_actions_raw.schema.field(idx), col)
+            self.stats_thunk = None
+        return self.file_actions_raw
 
     @property
     def add_files_table(self) -> pa.Table:
@@ -77,8 +97,11 @@ class SnapshotState:
 
     @property
     def size_in_bytes(self) -> int:
+        # raw access on purpose: aggregates never touch stats, so they
+        # must not trigger the deferred decode
         sizes = np.asarray(
-            self.file_actions.column("size").fill_null(0), dtype=np.int64
+            self.file_actions_raw.column("size").fill_null(0),
+            dtype=np.int64
         )
         return int(sizes[self.live_mask].sum())
 
@@ -391,10 +414,11 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
         metadata=columnar.metadata,
         set_transactions=columnar.set_transactions,
         domain_metadata=columnar.domain_metadata,
-        file_actions=columnar.file_actions,
+        file_actions_raw=columnar.file_actions,
         live_mask=live,
         tombstone_mask=tomb,
         latest_commit_info=columnar.latest_commit_info,
         commit_infos=columnar.commit_infos,
         timestamp_ms=segment.last_commit_timestamp,
+        stats_thunk=columnar.stats_thunk,
     )

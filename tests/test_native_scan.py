@@ -137,7 +137,8 @@ def test_parity_with_generic_parser(tmp_path):
         del os.environ["DELTA_TPU_DISABLE_NATIVE"]
         nat._LIB, nat._TRIED = old_lib, old_tried
 
-    tn, tg = col_native.file_actions, col_generic.file_actions
+    tn = col_native.file_actions_complete()
+    tg = col_generic.file_actions_complete()
     assert tn.num_rows == tg.num_rows
     # native emits commit order; generic emits adds-then-removes blocks.
     # Compare as (version, order)-sorted rows.
