@@ -146,6 +146,12 @@ class ChecksumMismatchError(DeltaError):
     error_class = "DELTA_CHECKSUM_MISMATCH"
 
 
+class CorruptStatsError(DeltaError):
+    """Stats content failed to decode (invalid JSON escapes)."""
+
+    error_class = "DELTA_CORRUPT_STATS"
+
+
 class SchemaMismatchError(DeltaError):
     error_class = "DELTA_SCHEMA_MISMATCH"
 

@@ -287,7 +287,11 @@ class ScanResult:
 
     def attach_read_buffer(self, rh, buf_ptr, total: int) -> None:
         """Adopt the dar_read handle whose buffer the lazy stats spans
-        reference (freed with this result)."""
+        reference. Trade-off made explicit: until stats materialize (or
+        never, for pure metadata snapshots) the WHOLE raw commit buffer
+        stays resident — ~1.6x the bytes the eager path's decoded stats
+        arena would hold — in exchange for skipping the decode entirely.
+        The buffer is released as soon as materialization runs."""
         self._rh = _NativeReadHandle(self._owner._lib, rh)
         self._rh_buf = buf_ptr
         self._rh_len = total
@@ -315,8 +319,12 @@ class ScanResult:
         rc = lib.das_stats_materialize(
             h, ctypes.cast(self._rh_buf, ctypes.c_char_p), self._rh_len)
         if rc != 0:
-            raise ValueError("malformed stats content surfaced during "
-                             "deferred decode")
+            from delta_tpu.errors import CorruptStatsError
+
+            raise CorruptStatsError(
+                "stats string contains invalid JSON escapes (surfaced at "
+                "deferred decode; the eager path reports this at load "
+                "time via the generic-parser fallback)")
         n = self.n_rows
 
         def fbuf(which, nbytes):

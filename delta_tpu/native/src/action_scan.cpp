@@ -1064,6 +1064,27 @@ bool learn_template(const char* start, const char* stop, Tmpl& t) {
     Tmpl::Seg sg;
     sg.slot.field = (uint8_t)f;
     char c = *p;
+    // commit_template dispatches on FIELD: a slot whose value kind
+    // doesn't match what the field's commit case reads (string span vs
+    // number) must demote to F_UNKNOWN — the generic parser likewise
+    // skips wrong-typed known fields without storing them
+    if (c == '-' || (c >= '0' && c <= '9') || c == 't' || c == 'f') {
+      switch (f) {
+        case F_SIZE: case F_MODIFICATION_TIME: case F_DATA_CHANGE:
+        case F_BASE_ROW_ID: case F_DRCV: case F_DELETION_TIMESTAMP:
+        case F_EXT_META:
+          break;
+        default:
+          sg.slot.field = (uint8_t)F_UNKNOWN;
+      }
+    } else if (c == '"') {
+      switch (f) {
+        case F_PATH: case F_STATS: case F_CLUSTERING: case F_UNKNOWN:
+          break;
+        default:
+          sg.slot.field = (uint8_t)F_UNKNOWN;
+      }
+    }
     if (c == '"') {
       sg.slot.type = SL_STR;
       // literal includes the opening quote; value ends AT the closing
@@ -1139,6 +1160,11 @@ inline bool match_template_impl(Builder& b, const Tmpl& t, const char* p,
       return false;
     p += sg.len;
     SlotVal& v = out[i];
+    // slots are stack scratch reused across template attempts: flags
+    // must never leak from a previous (failed) match
+    v.esc = false;
+    v.in_arena = false;
+    v.lazy_span = false;
     switch (sg.slot.type) {
       case SL_STR: {
         if (b.lazy_stats && sg.slot.field == (uint8_t)F_STATS) {

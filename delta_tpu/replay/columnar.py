@@ -109,17 +109,24 @@ class ColumnarActions:
     def file_actions_complete(self) -> pa.Table:
         """The canonical table with the stats column materialized (the
         safe accessor for code outside the snapshot pipeline)."""
-        if self.stats_thunk is not None:
-            idx = self.file_actions.schema.get_field_index("stats")
-            self.file_actions = self.file_actions.set_column(
-                idx, self.file_actions.schema.field(idx),
-                self.stats_thunk())
-            self.stats_thunk = None
+        self.file_actions, self.stats_thunk = splice_stats(
+            self.file_actions, self.stats_thunk)
         return self.file_actions
 
     @property
     def num_actions(self) -> int:
         return self.file_actions.num_rows
+
+
+def splice_stats(table: pa.Table, stats_thunk):
+    """Replace the deferred-stats placeholder column with the decoded
+    one (shared by ColumnarActions and SnapshotState). Returns
+    (table, None); no-op when no decode is pending."""
+    if stats_thunk is None:
+        return table, None
+    idx = table.schema.get_field_index("stats")
+    return (table.set_column(idx, table.schema.field(idx), stats_thunk()),
+            None)
 
 
 def _field_or_null(struct_arr: pa.StructArray, name: str, typ: pa.DataType) -> pa.Array:

@@ -66,12 +66,10 @@ class SnapshotState:
         column in on first access — stats are ~60% of commit bytes and
         pure metadata loads (num_files/size_in_bytes/replay) never pay
         for decoding them."""
-        if self.stats_thunk is not None:
-            idx = self.file_actions_raw.schema.get_field_index("stats")
-            col = self.stats_thunk()
-            self.file_actions_raw = self.file_actions_raw.set_column(
-                idx, self.file_actions_raw.schema.field(idx), col)
-            self.stats_thunk = None
+        from delta_tpu.replay.columnar import splice_stats
+
+        self.file_actions_raw, self.stats_thunk = splice_stats(
+            self.file_actions_raw, self.stats_thunk)
         return self.file_actions_raw
 
     @property
@@ -408,7 +406,7 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
             )
         )
 
-    return SnapshotState(
+    state = SnapshotState(
         version=segment.version,
         protocol=columnar.protocol,
         metadata=columnar.metadata,
@@ -422,3 +420,6 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
         timestamp_ms=segment.last_commit_timestamp,
         stats_thunk=columnar.stats_thunk,
     )
+    # ownership of the deferred decode moves to the snapshot state
+    columnar.stats_thunk = None
+    return state

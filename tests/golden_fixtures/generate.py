@@ -98,6 +98,8 @@ META_TYPE = pa.struct([
 PROTO_TYPE = pa.struct([
     ("minReaderVersion", pa.int32()),
     ("minWriterVersion", pa.int32()),
+    ("readerFeatures", pa.list_(pa.string())),
+    ("writerFeatures", pa.list_(pa.string())),
 ])
 TXN_TYPE = pa.struct([
     ("appId", pa.string()),
@@ -351,6 +353,74 @@ def gen_compacted():
              version=3)
 
 
+def gen_kitchen_sink():
+    """Every feature at once: column-mapping metadata + ICT + DV adds +
+    a multipart checkpoint + later commits with percent-encoded paths.
+    The hand-derived state exercises interactions the single-feature
+    fixtures can't."""
+    schema = json.dumps({
+        "type": "struct",
+        "fields": [{
+            "name": "x", "type": "long", "nullable": True,
+            "metadata": {
+                "delta.columnMapping.id": 1,
+                "delta.columnMapping.physicalName": "col-x",
+            },
+        }],
+    })
+    root, log = fresh("kitchen_sink")
+    meta = metadata("sink", schema=schema, configuration={
+        "delta.columnMapping.mode": "name",
+        "delta.columnMapping.maxColumnId": "1",
+        "delta.enableInCommitTimestamps": "true",
+    })
+    proto = {"protocol": {"minReaderVersion": 3, "minWriterVersion": 7,
+                          "readerFeatures": ["deletionVectors",
+                                             "columnMapping",
+                                             "inCommitTimestamp"],
+                          "writerFeatures": ["deletionVectors",
+                                             "columnMapping",
+                                             "inCommitTimestamp"]}}
+    dv = {"storageType": "u", "pathOrInlineDv": "zz!xyz", "offset": 4,
+          "sizeInBytes": 40, "cardinality": 7}
+    write_commits(log, [
+        [{"commitInfo": {"inCommitTimestamp": 10, "operation": "WRITE"}},
+         proto, meta,
+         add("k%200.parquet", 11), add("k1.parquet", 12)],
+        [{"commitInfo": {"inCommitTimestamp": 20, "operation": "WRITE"}},
+         add("k2.parquet", 13),
+         {"txn": {"appId": "sinkapp", "version": 3}}],
+    ])
+    part1 = checkpoint_rows([proto, meta, add("k%200.parquet", 11),
+                             {"txn": {"appId": "sinkapp", "version": 3}}])
+    part2 = checkpoint_rows([add("k1.parquet", 12), add("k2.parquet", 13)])
+    pq.write_table(part1, os.path.join(
+        log, f"{1:020d}.checkpoint.{1:010d}.{2:010d}.parquet"))
+    pq.write_table(part2, os.path.join(
+        log, f"{1:020d}.checkpoint.{2:010d}.{2:010d}.parquet"))
+    write_last_checkpoint(log, 1, 6, parts=2)
+    write_commits(log, [
+        [{"commitInfo": {"inCommitTimestamp": 30, "operation": "DELETE"}},
+         remove("k1.parquet"), add("k1.parquet", 12, dv=dv)],
+        [{"commitInfo": {"inCommitTimestamp": 40, "operation": "WRITE"}},
+         add("k3.parquet", 14)],
+    ], start=2)
+    expected(root,
+             live_keys=["k 0.parquet|", "k1.parquet|uzz!xyz@4",
+                        "k2.parquet|", "k3.parquet|"],
+             tombstone_keys=["k1.parquet|"],
+             num_live=4, live_bytes=11 + 12 + 13 + 14,
+             protocol=proto["protocol"],
+             metadata_id="sink",
+             configuration={
+                 "delta.columnMapping.mode": "name",
+                 "delta.columnMapping.maxColumnId": "1",
+                 "delta.enableInCommitTimestamps": "true"},
+             txns={"sinkapp": 3},
+             latest_ict=40,
+             version=3)
+
+
 if __name__ == "__main__":
     gen_basic_checkpoint()
     gen_multipart_checkpoint()
@@ -358,4 +428,5 @@ if __name__ == "__main__":
     gen_dv_ict()
     gen_column_mapping()
     gen_compacted()
+    gen_kitchen_sink()
     print("fixtures regenerated under", HERE)

@@ -122,6 +122,9 @@ def _row_to_action(name: str, row: dict) -> dict | None:
         if isinstance(v, dict):
             return {k: clean(x) for k, x in v.items() if x is not None}
         if isinstance(v, list):
+            # Arrow map columns surface as [(k, v), ...] pair lists
+            if v and all(isinstance(x, tuple) and len(x) == 2 for x in v):
+                return {k: clean(x) for k, x in v}
             return [clean(x) for x in v]
         return v
 
