@@ -88,6 +88,15 @@ class HostFileSystemClient(FileSystemClient):
     def list_from(self, path: str) -> Iterator[FileStatus]:
         return self._store_for(path).list_from(path)
 
+    def list_from_fast(self, path: str, skip_stat):
+        """Stat-skipping listing when the store supports it (local
+        stores); falls back to the full listing."""
+        store = self._store_for(path)
+        fast = getattr(store, "list_from_fast", None)
+        if fast is not None:
+            return fast(path, skip_stat)
+        return store.list_from(path)
+
     def read_file(self, path: str) -> bytes:
         return self._store_for(path).read(path)
 

@@ -102,8 +102,17 @@ def build_log_segment(
     latest version when None)."""
     start = checkpoint_hint if checkpoint_hint is not None else 0
     prefix = filenames.listing_prefix(log_path, start)
+    # commit files skip the per-entry stat (their sizes come from the
+    # reader; only the segment's LAST commit needs an mtime, stat'd
+    # below) — checkpoint/compacted files still stat (size>0 checks)
+    fast = getattr(fs, "list_from_fast", None)
     try:
-        listing = list(fs.list_from(prefix))
+        if fast is not None:
+            listing = list(fast(
+                prefix, lambda n: filenames.DELTA_FILE_RE.match(n)
+                is not None))
+        else:
+            listing = list(fs.list_from(prefix))
     except FileNotFoundError:
         raise TableNotFoundError(f"no _delta_log at {log_path}")
 
@@ -193,8 +202,37 @@ def build_log_segment(
             pass
 
     last_ts = 0
-    for f in deltas_needed or checkpoint_statuses:
-        last_ts = max(last_ts, f.modification_time)
+    if deltas_needed:
+        for f in deltas_needed:
+            last_ts = max(last_ts, f.modification_time)
+        if deltas_needed[-1].modification_time == 0:
+            # fast listing deferred the stat; the last commit's mtime is
+            # the snapshot timestamp, so fetch just that one (through the
+            # fs abstraction — a non-local store may defer too)
+            try:
+                last_ts = max(
+                    last_ts,
+                    fs.file_status(deltas_needed[-1].path)
+                    .modification_time)
+            except FileNotFoundError:
+                pass
+    else:
+        # checkpoint-at-head: the snapshot's timestamp is the LAST
+        # COMMIT's (the checkpoint parquet is written after it and its
+        # mtime would overshoot — history/time-travel use commit mtimes)
+        cp_commit = next(
+            (f for v, f in deltas if v == version), None)
+        if cp_commit is not None:
+            ts = cp_commit.modification_time
+            if ts == 0:
+                try:
+                    ts = fs.file_status(cp_commit.path).modification_time
+                except FileNotFoundError:
+                    ts = 0
+            last_ts = ts
+        if last_ts == 0:
+            for f in checkpoint_statuses:
+                last_ts = max(last_ts, f.modification_time)
 
     return LogSegment(
         log_path=log_path,

@@ -446,6 +446,22 @@ def _read_commits_buffer(
     (buffer, per-file byte starts[n+1], per-file versions), or None when
     a listed size disagrees with the bytes read (caller re-reads)."""
     n = len(commit_infos)
+    if any(int(s) < 0 for _, _, s in commit_infos):
+        # fast listing deferred the stats: resolve sizes now (this path
+        # runs only when the native one-round-trip reader is unavailable)
+        try:
+            commit_infos = [
+                (v, p, s if int(s) >= 0
+                 else engine.fs.file_status(p).size)
+                for v, p, s in commit_infos]
+        except FileNotFoundError as e:
+            from delta_tpu.log.segment import CorruptLogError
+
+            # a listed commit vanished before reading: concurrent log
+            # cleanup — the same contract as a listing gap
+            raise CorruptLogError(
+                f"commit file vanished after listing (concurrent log "
+                f"cleanup?): {e}") from e
     sizes = np.array([max(0, int(s)) for _, _, s in commit_infos], dtype=np.int64)
     starts = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(sizes + 1, out=starts[1:])
@@ -667,6 +683,9 @@ def columnarize_log_segment(
         from delta_tpu import native as _native
 
         total_listed = sum(max(0, int(s)) for _, _, s in commit_infos)
+        if any(int(s) < 0 for _, _, s in commit_infos):
+            # stat-deferred listing: estimate with a typical commit size
+            total_listed = max(total_listed, 8192 * len(commit_infos))
         allow_compile = total_listed >= _native.MIN_BYTES_FOR_COLD_BUILD
         parsed_native = generic = read = None
         native_rejected = False

@@ -816,6 +816,26 @@ def _needs_extended_select(s: str) -> bool:
 
 
 def _query_statement(s: str, engine, catalog):
+    # table_changes('<path>' | name, start [, end]) — the reference's CDC
+    # SQL table function (DeltaTableValueFunctions): returns change rows
+    # with _change_type/_commit_version/_commit_timestamp columns
+    m = re.fullmatch(
+        rf"SELECT\s+\*\s+FROM\s+table_changes\s*\(\s*{_PATH}\s*,\s*"
+        r"(?P<start>\d+)\s*(?:,\s*(?P<end>\d+)\s*)?\)"
+        r"(?:\s+LIMIT\s+(?P<limit>\d+))?",
+        s, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        from delta_tpu.read.cdc import table_changes
+
+        table = _table(m, engine, catalog)
+        out = table_changes(
+            table, int(m.group("start")),
+            int(m.group("end")) if m.group("end") else None)
+        if m.group("limit"):
+            out = out.slice(0, int(m.group("limit")))
+        return out
+
     if re.match(r"SELECT\b", s, re.IGNORECASE) and _needs_extended_select(s):
         return _exec_select_extended(s, engine, catalog)
     m = re.fullmatch(

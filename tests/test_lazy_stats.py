@@ -94,3 +94,49 @@ def test_concurrent_table_access_is_safe(tmp_table_path):
     assert not errors, errors[:1]
     assert all(r == results[0] for r in results)
     assert len(results[0]) == 20
+
+
+def test_corrupt_stats_surfaces_typed_error(tmp_path):
+    """A stats string whose escapes pass the structural scan but fail
+    decode raises the catalogued CorruptStatsError at materialization."""
+    import os
+
+    import pytest
+
+    from delta_tpu.errors import CorruptStatsError
+
+    log = tmp_path / "tbl" / "_delta_log"
+    os.makedirs(log)
+    lines = [
+        '{"protocol":{"minReaderVersion":1,"minWriterVersion":2}}',
+        '{"metaData":{"id":"x","format":{"provider":"parquet","options":{}},'
+        '"schemaString":"{\\"type\\":\\"struct\\",\\"fields\\":[]}",'
+        '"partitionColumns":[],"configuration":{}}}',
+        # \\q is structurally a pair but not a legal JSON escape
+        '{"add":{"path":"a.parquet","partitionValues":{},"size":1,'
+        '"modificationTime":1,"dataChange":true,"stats":"bad\\qescape"}}',
+    ]
+    with open(log / "00000000000000000000.json", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    snap = Table.for_path(str(tmp_path / "tbl"), TpuEngine()).latest_snapshot()
+    if snap.state.stats_thunk is None:
+        pytest.skip("lazy native scan unavailable")
+    assert snap.num_files == 1  # metadata unaffected
+    with pytest.raises(CorruptStatsError):
+        snap.state.add_files_table
+
+
+def test_deferred_sizes_resolve_without_native(tmp_table_path):
+    """The generic read path (native scanner disabled) resolves the fast
+    listing's deferred sizes through fs.file_status."""
+    import delta_tpu.native as nat
+
+    _mk(tmp_table_path)
+    old_lib, old_tried = nat._LIB, nat._TRIED
+    nat._LIB, nat._TRIED = None, True
+    try:
+        snap = Table.for_path(tmp_table_path, TpuEngine()).latest_snapshot()
+        assert snap.num_files == 5
+        assert snap.state.size_in_bytes > 0
+    finally:
+        nat._LIB, nat._TRIED = old_lib, old_tried

@@ -634,3 +634,23 @@ def test_select_right_join_rejected(star_tables):
     with pytest.raises(DeltaError, match="RIGHT JOIN is not supported"):
         sql(f"SELECT f.amount FROM '{fact}' f RIGHT JOIN '{dim}' s "
             f"ON f.store_id = s.store_id")
+
+
+def test_table_changes_function(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1, 2], pa.int64())}),
+        properties={"delta.enableChangeDataFeed": "true"})
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([3], pa.int64())}), mode="append")
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+    from delta_tpu.table import Table
+
+    delete(Table.for_path(tmp_table_path), predicate=col("id") == lit(1))
+
+    out = sql(f"SELECT * FROM table_changes('{tmp_table_path}', 1)")
+    kinds = out.column("_change_type").to_pylist()
+    assert "insert" in kinds and "delete" in kinds
+    assert set(out.column("_commit_version").to_pylist()) == {1, 2}
+    out2 = sql(f"SELECT * FROM table_changes('{tmp_table_path}', 1, 1)")
+    assert set(out2.column("_commit_version").to_pylist()) == {1}
