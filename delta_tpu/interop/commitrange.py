@@ -14,15 +14,27 @@ from typing import Dict, Optional, Tuple
 
 def delta_range_actions(
     table_path: str, lo: int, hi: int,
-) -> Optional[Tuple[Dict[str, dict], set, bool, Dict[str, dict]]]:
+) -> Optional[Tuple[Dict[str, dict], set, bool, Dict[str, dict], set]]:
     """Walk commits [lo, hi] of `table_path`'s log. Returns (net added
     AddFile dicts by path, net removed path set, metadata_changed,
-    removed RemoveFile dicts by path) — or None when any commit file in
-    the range is gone (cleaned/checkpointed), signalling the caller to
-    fall back to a full conversion."""
+    removed RemoveFile dicts by path, rewritten path set) — or None when
+    any commit file in the range is gone (cleaned/checkpointed),
+    signalling the caller to fall back to a full conversion.
+
+    `rewritten` is the set of paths removed at some point in the range
+    but net-ADDED by its end (remove-then-re-add, e.g. RESTORE).  The
+    netting alone would hide these from converters that REUSE prior
+    metadata: the path lands in `adds`, so an incremental Iceberg
+    conversion would emit it ADDED in a new manifest while the reused
+    old manifest still carries it live — a duplicate entry.  Manifest-
+    reusing converters must treat `rewritten` paths as removed from
+    prior state (then re-added by the new commit).  Hudi ignores it by
+    design: same path = same fileId, and Hudi readers take the latest
+    write stat per file group, so a re-emitted stat supersedes cleanly."""
     log = os.path.join(table_path, "_delta_log")
     adds: Dict[str, dict] = {}
     removes: Dict[str, dict] = {}
+    ever_removed: set = set()
     meta_changed = False
     for v in range(lo, hi + 1):
         try:
@@ -41,7 +53,9 @@ def delta_range_actions(
                 elif "remove" in act:
                     r = act["remove"]
                     removes[r["path"]] = r
+                    ever_removed.add(r["path"])
                     adds.pop(r["path"], None)
                 elif "metaData" in act:
                     meta_changed = True
-    return adds, set(removes), meta_changed, removes
+    rewritten = ever_removed & set(adds)
+    return adds, set(removes), meta_changed, removes, rewritten

@@ -386,7 +386,11 @@ def convert_snapshot(snapshot, table_path: Optional[str] = None) -> str:
             # a metadata change may alter the partition spec that reused
             # manifests were written under: force the full rewrite
             if rng is not None and not rng[2]:
-                incremental = (rng[0], rng[1])
+                # remove-then-re-add (rng[4]) must drop the old entry
+                # from reused manifests — the re-add lands in the new
+                # ADDED manifest, so the stale live entry would be a
+                # duplicate
+                incremental = (rng[0], rng[1] | rng[4])
         if prev_delta_v is not None and prev_delta_v >= snapshot.version:
             return os.path.join(
                 meta_dir, f"v{prev_md_version}.metadata.json")

@@ -20,6 +20,7 @@ counts. The reference pays this cost as a Spark JSON scan
 from __future__ import annotations
 
 import json
+import threading
 import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -105,13 +106,17 @@ class ColumnarActions:
     # splices the real column before any user-facing surface; any other
     # caller must use `file_actions_complete()`.
     stats_thunk: Optional[object] = None
+    _splice_lock: object = field(default_factory=threading.Lock,
+                                 repr=False, compare=False)
 
     def file_actions_complete(self) -> pa.Table:
         """The canonical table with the stats column materialized (the
-        safe accessor for code outside the snapshot pipeline)."""
-        self.file_actions, self.stats_thunk = splice_stats(
-            self.file_actions, self.stats_thunk)
-        return self.file_actions
+        safe accessor for code outside the snapshot pipeline). Locked so
+        concurrent first calls run the decode thunk exactly once."""
+        with self._splice_lock:
+            self.file_actions, self.stats_thunk = splice_stats(
+                self.file_actions, self.stats_thunk)
+            return self.file_actions
 
     @property
     def num_actions(self) -> int:

@@ -16,6 +16,7 @@ and baseline.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -59,18 +60,22 @@ class SnapshotState:
 
     _add_table_cache: Optional[pa.Table] = None
     _tombstone_table_cache: Optional[pa.Table] = None
+    _splice_lock: object = field(default_factory=threading.Lock,
+                                 repr=False, compare=False)
 
     @property
     def file_actions(self) -> pa.Table:
         """The complete canonical table. Splices the deferred stats
         column in on first access — stats are ~60% of commit bytes and
         pure metadata loads (num_files/size_in_bytes/replay) never pay
-        for decoding them."""
+        for decoding them. Locked: two threads' first accesses must not
+        both run the thunk."""
         from delta_tpu.replay.columnar import splice_stats
 
-        self.file_actions_raw, self.stats_thunk = splice_stats(
-            self.file_actions_raw, self.stats_thunk)
-        return self.file_actions_raw
+        with self._splice_lock:
+            self.file_actions_raw, self.stats_thunk = splice_stats(
+                self.file_actions_raw, self.stats_thunk)
+            return self.file_actions_raw
 
     @property
     def add_files_table(self) -> pa.Table:

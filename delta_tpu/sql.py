@@ -640,11 +640,20 @@ def _exec_select_extended(s: str, engine, catalog):
     where_conjuncts = (split_conjuncts(parse_expression(where_text))
                        if where_text else [])
 
+    # WHERE pushdown is unsound into a null-supplying join side: rows
+    # there may be null-extended by the join, so filtering the scan
+    # changes which left rows survive residual predicates such as the
+    # anti-join idiom `WHERE b.x IS NULL`.  The residual host eval below
+    # still applies the full WHERE, so skipping only costs pruning.
+    null_supplying = {snaps[i + 1][0]
+                      for i, (_, _, kind) in enumerate(joins)
+                      if kind == "left outer"}
+
     loaded = []
     for alias, snap, cols in snaps:
         in_scope = {f"{alias}.{c}" for c in cols}
         push = None
-        for conj in where_conjuncts:
+        for conj in where_conjuncts if alias not in null_supplying else []:
             try:
                 rewritten = _rewrite_columns(conj, mapping)
             except DeltaError:

@@ -232,6 +232,42 @@ def test_iceberg_incremental_delete_rewrites_touched_manifest(tmp_table_path):
     assert 2 in statuses  # DELETED entry present
 
 
+def test_iceberg_incremental_remove_then_readd_no_duplicate(tmp_table_path):
+    """A remove-then-re-add of the same path inside one conversion window
+    (e.g. DELETE then RESTORE) must not leave the file live in both a
+    reused manifest and the new ADDED manifest (advisor round-2 medium)."""
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.commands.restore import restore
+    from delta_tpu.expressions import col, lit
+    import delta_tpu.interop.iceberg as ice
+
+    table = _mk(tmp_table_path, partition=True)  # no auto-convert
+    ice.convert_snapshot(table.latest_snapshot())  # window anchor at v0
+
+    delete(Table.for_path(tmp_table_path), predicate=col("p") == lit("a"))
+    restore(Table.for_path(tmp_table_path), version=0)
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    md_path = ice.convert_snapshot(snap)  # window = v1..v2 (remove + re-add)
+
+    with open(md_path) as f:
+        md = json.load(f)
+    cur = next(s for s in md["snapshots"]
+               if s["snapshot-id"] == md["current-snapshot-id"])
+    _, manifests, _ = avro_io.read_ocf(open(cur["manifest-list"], "rb").read())
+    live = []
+    for m in manifests:
+        _, entries, _ = avro_io.read_ocf(
+            open(m["manifest_path"], "rb").read())
+        live += [e["data_file"]["file_path"] for e in entries
+                 if e["status"] != 2]
+    assert len(live) == len(set(live)), f"duplicate live entries: {live}"
+    delta_live = {
+        p if ("://" in p or p.startswith("/"))
+        else f"{tmp_table_path}/{p}"
+        for p in snap.state.add_files_table.column("path").to_pylist()}
+    assert set(live) == delta_live
+
+
 def test_iceberg_schema_evolution_bumps_schema_id(tmp_table_path):
     _mk(tmp_table_path,
         props={"delta.universalFormat.enabledFormats": "iceberg"})

@@ -600,6 +600,25 @@ def test_select_left_join_keeps_unmatched(star_tables, tmp_path):
     assert out.column("rev").to_pylist()[-1] is None  # unmatched store
 
 
+def test_select_left_join_anti_join_idiom(tmp_path):
+    # WHERE on the null-supplying side must NOT be pushed into its scan:
+    # `b.x IS NULL` selects left rows with no match (advisor round-2 high)
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    dta.write_table(a, pa.table({"id": pa.array([1, 2, 3], pa.int64())}))
+    dta.write_table(b, pa.table({
+        "id": pa.array([1, 2], pa.int64()),
+        "x": pa.array([5, 7], pa.int64()),
+    }))
+    out = sql(f"SELECT a.id FROM '{a}' a LEFT JOIN '{b}' b "
+              f"ON a.id = b.id WHERE b.x IS NULL")
+    assert out.column("id").to_pylist() == [3]
+    # and a plain null-sensitive equality on the right side
+    out = sql(f"SELECT a.id FROM '{a}' a LEFT JOIN '{b}' b "
+              f"ON a.id = b.id WHERE b.x = 5")
+    assert out.column("id").to_pylist() == [1]
+
+
 def test_select_having(tmp_table_path):
     dta.write_table(tmp_table_path, pa.table({
         "k": pa.array(["a", "b", "a", "c", "b", "a"]),
