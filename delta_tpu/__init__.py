@@ -27,46 +27,60 @@ Public API (mirrors kernel-api `Table.java` / `Snapshot` / `Scan` /
 """
 
 from delta_tpu.version import __version__
-from delta_tpu.table import Table
-from delta_tpu.snapshot import Snapshot
-from delta_tpu.scan import Scan, ScanBuilder
-from delta_tpu.txn.transaction import Transaction, TransactionBuilder, Operation
-from delta_tpu.tables import DeltaTable
-from delta_tpu.errors import (
-    DeltaError,
-    TableNotFoundError,
-    ConcurrentModificationError,
-    ProtocolChangedError,
-    MetadataChangedError,
-    ConcurrentAppendError,
-    ConcurrentDeleteReadError,
-    ConcurrentDeleteDeleteError,
-    ConcurrentTransactionError,
-    VersionNotFoundError,
-    CommitFailedError,
-    InvariantViolationError,
-)
 
-__all__ = [
-    "__version__",
-    "Table",
-    "DeltaTable",
-    "Snapshot",
-    "Scan",
-    "ScanBuilder",
-    "Transaction",
-    "TransactionBuilder",
-    "Operation",
-    "DeltaError",
-    "TableNotFoundError",
-    "ConcurrentModificationError",
-    "ProtocolChangedError",
-    "MetadataChangedError",
-    "ConcurrentAppendError",
-    "ConcurrentDeleteReadError",
-    "ConcurrentDeleteDeleteError",
-    "ConcurrentTransactionError",
-    "VersionNotFoundError",
-    "CommitFailedError",
-    "InvariantViolationError",
-]
+# Lazy exports (PEP 562): importing a storage/tools submodule must not
+# drag in the full table stack (pyarrow/pandas/jax) — multi-process
+# workers and cold-start paths pay ~3s otherwise. `from delta_tpu
+# import Table` still works; it just resolves on first access.
+_LAZY = {
+    "Table": ("delta_tpu.table", "Table"),
+    "Snapshot": ("delta_tpu.snapshot", "Snapshot"),
+    "Scan": ("delta_tpu.scan", "Scan"),
+    "ScanBuilder": ("delta_tpu.scan", "ScanBuilder"),
+    "Transaction": ("delta_tpu.txn.transaction", "Transaction"),
+    "TransactionBuilder": ("delta_tpu.txn.transaction", "TransactionBuilder"),
+    "Operation": ("delta_tpu.txn.transaction", "Operation"),
+    "DeltaTable": ("delta_tpu.tables", "DeltaTable"),
+    "DeltaError": ("delta_tpu.errors", "DeltaError"),
+    "TableNotFoundError": ("delta_tpu.errors", "TableNotFoundError"),
+    "ConcurrentModificationError": (
+        "delta_tpu.errors", "ConcurrentModificationError"),
+    "ProtocolChangedError": ("delta_tpu.errors", "ProtocolChangedError"),
+    "MetadataChangedError": ("delta_tpu.errors", "MetadataChangedError"),
+    "ConcurrentAppendError": ("delta_tpu.errors", "ConcurrentAppendError"),
+    "ConcurrentDeleteReadError": (
+        "delta_tpu.errors", "ConcurrentDeleteReadError"),
+    "ConcurrentDeleteDeleteError": (
+        "delta_tpu.errors", "ConcurrentDeleteDeleteError"),
+    "ConcurrentTransactionError": (
+        "delta_tpu.errors", "ConcurrentTransactionError"),
+    "VersionNotFoundError": ("delta_tpu.errors", "VersionNotFoundError"),
+    "CommitFailedError": ("delta_tpu.errors", "CommitFailedError"),
+    "InvariantViolationError": (
+        "delta_tpu.errors", "InvariantViolationError"),
+}
+
+__all__ = ["__version__"] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    import importlib
+
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        # the eager imports used to bind submodules (delta_tpu.errors,
+        # delta_tpu.table, ...) as package attributes; keep that working
+        try:
+            value = importlib.import_module(f"delta_tpu.{name}")
+        except ModuleNotFoundError:
+            raise AttributeError(
+                f"module 'delta_tpu' has no attribute {name!r}")
+    else:
+        value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: resolve once
+    return value
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

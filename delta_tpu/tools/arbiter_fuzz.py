@@ -1,0 +1,267 @@
+"""Multi-process kill-fuzz for the external-arbiter commit protocol.
+
+N independent writer *processes* race commits against one table through
+`ExternalArbiterLogStore(RacyLocalStore, SqliteCommitArbiter)` — the
+S3+DynamoDB deployment shape — while being SIGKILLed (`os._exit`) at
+randomized protocol phase boundaries:
+
+- `before_claim` — temp file written, arbiter entry NOT yet put: the
+  version stays unclaimed; another writer takes it. Only a garbage temp
+  file remains.
+- `after_claim`  — entry E(N, complete=false) put, N.json NOT copied:
+  the classic half commit. Any later reader/writer must complete it via
+  `fix_delta_log` (reference `BaseExternalLogStore.java:369-373`).
+- `after_copy`   — N.json visible but E(N) still incomplete: recovery
+  must acknowledge without double-copying.
+
+Invariant checked after every round (the reference's multi-writer
+correctness contract): the log is gapless, every commit file is intact
+JSON attributable to exactly one writer attempt, every commit a writer
+observed as successful is present verbatim, and recovery leaves the
+arbiter's latest entry complete.
+
+Run standalone for the long proof:
+
+    python -m delta_tpu.tools.arbiter_fuzz --rounds 100
+
+The pytest suite (`tests/test_multiprocess_arbiter.py`) runs a few
+seeded rounds of the same driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import uuid
+from typing import List, Optional
+
+CRASH_PHASES = ["before_claim", "after_claim", "after_copy"]
+KILL_EXIT = 137
+
+
+def _build_store(db_path: str, crash_plan):
+    """ExternalArbiterLogStore wired for crash injection. `crash_plan`
+    is a callable returning the phase to crash at for the NEXT commit
+    attempt (or None)."""
+    from delta_tpu.storage.arbiter import RacyLocalStore, SqliteCommitArbiter
+    from delta_tpu.storage.cloud import ExternalArbiterLogStore
+
+    state = {"phase": None}
+
+    class _CrashArbiter(SqliteCommitArbiter):
+        def put_entry(self, entry, overwrite):
+            if not overwrite and not entry.complete:
+                if state["phase"] == "before_claim":
+                    os._exit(KILL_EXIT)
+            super().put_entry(entry, overwrite)
+            if (not overwrite and not entry.complete
+                    and state["phase"] == "after_claim"):
+                os._exit(KILL_EXIT)
+
+    class _CrashStore(ExternalArbiterLogStore):
+        def _write_copy_temp_file(self, src, dst):
+            super()._write_copy_temp_file(src, dst)
+            if state["phase"] == "after_copy":
+                os._exit(KILL_EXIT)
+
+        def write(self, path, data, overwrite=False):
+            state["phase"] = crash_plan()
+            super().write(path, data, overwrite)
+
+    return _CrashStore(RacyLocalStore(), _CrashArbiter(db_path))
+
+
+def _latest_version(store, table: str) -> int:
+    log = os.path.join(table, "_delta_log")
+    try:
+        entries = list(store.list_from(os.path.join(log, f"{0:020d}.json")))
+    except FileNotFoundError:
+        return -1
+    versions = [int(os.path.basename(fs.path).split(".")[0])
+                for fs in entries
+                if fs.path.endswith(".json")
+                and os.path.basename(fs.path).split(".")[0].isdigit()]
+    return max(versions, default=-1)
+
+
+def worker_main(table: str, db_path: str, writer_id: int, seed: int,
+                target_version: int, crash_prob: float) -> None:
+    """Commit loop: race to advance the table to `target_version`,
+    crashing at a random phase with probability `crash_prob` per
+    attempt. Successful commits are recorded (fsync'd) BEFORE the next
+    attempt so the checker can assert acknowledged-commit durability."""
+    rng = random.Random(seed)
+
+    def crash_plan() -> Optional[str]:
+        if rng.random() < crash_prob:
+            return rng.choice(CRASH_PHASES)
+        return None
+
+    store = _build_store(db_path, crash_plan)
+    success_log = os.path.join(table, f"_writer_{writer_id}.log")
+    fh = open(success_log, "a")
+    while True:
+        latest = _latest_version(store, table)
+        if latest >= target_version:
+            break
+        v = latest + 1
+        nonce = uuid.uuid4().hex
+        payload = json.dumps({"commitInfo": {
+            "writer": writer_id, "version": v, "nonce": nonce}}) + "\n"
+        path = os.path.join(table, "_delta_log", f"{v:020d}.json")
+        try:
+            store.write(path, payload.encode())
+        except (FileExistsError, FileNotFoundError):
+            continue  # lost the race (or prev not yet visible): refresh
+        fh.write(f"{v} {nonce}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    fh.close()
+
+
+def _spawn_worker(table, db_path, writer_id, seed, target, crash_prob):
+    return subprocess.Popen(
+        [sys.executable, "-m", "delta_tpu.tools.arbiter_fuzz", "--worker",
+         "--table", table, "--db", db_path, "--writer-id", str(writer_id),
+         "--seed", str(seed), "--target", str(target),
+         "--crash-prob", str(crash_prob)],
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    )
+
+
+def run_round(workdir: str, seed: int, n_writers: int = 3,
+              target_version: int = 11, crash_prob: float = 0.25,
+              timeout_s: float = 120.0) -> dict:
+    """One fuzz round. Returns stats; raises AssertionError on any
+    protocol violation."""
+    rng = random.Random(seed)
+    table = os.path.join(workdir, f"table_{seed}")
+    os.makedirs(os.path.join(table, "_delta_log"), exist_ok=True)
+    db_path = os.path.join(workdir, f"arbiter_{seed}.db")
+
+    procs = {}
+    crashes = 0
+    spawned = 0
+    for w in range(n_writers):
+        procs[w] = _spawn_worker(table, db_path, w, rng.randrange(2**31),
+                                 target_version, crash_prob)
+        spawned += 1
+    deadline = time.time() + timeout_s
+    while procs and time.time() < deadline:
+        for w, p in list(procs.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            del procs[w]
+            if rc == KILL_EXIT:
+                crashes += 1
+                # respawn: a new process inherits only durable state —
+                # exactly the recovery the protocol must survive
+                procs[w] = _spawn_worker(
+                    table, db_path, w, rng.randrange(2**31),
+                    target_version, crash_prob)
+                spawned += 1
+            elif rc != 0:
+                raise AssertionError(f"writer {w} died rc={rc}")
+        time.sleep(0.02)
+    for p in procs.values():
+        p.kill()
+    if procs:
+        raise AssertionError(
+            f"round timed out with {len(procs)} writers still running")
+
+    # --- recovery + invariant checks from a FRESH process-independent
+    # store (a reader that never wrote) -------------------------------
+    from delta_tpu.storage.arbiter import external_arbiter_store
+
+    reader = external_arbiter_store(db_path)
+    log = os.path.join(table, "_delta_log")
+    listed = list(reader.list_from(os.path.join(log, f"{0:020d}.json")))
+    versions = sorted(int(os.path.basename(fs.path).split(".")[0])
+                      for fs in listed
+                      if fs.path.endswith(".json")
+                      and os.path.basename(fs.path).split(".")[0].isdigit())
+    assert versions, "no commits at all"
+    assert versions == list(range(versions[-1] + 1)), \
+        f"log has gaps: {versions}"
+    assert versions[-1] >= target_version, \
+        f"never reached target: {versions[-1]} < {target_version}"
+
+    # every commit intact + attributable, exactly one file per version
+    by_version = {}
+    for v in versions:
+        raw = reader.read(os.path.join(log, f"{v:020d}.json"))
+        doc = json.loads(raw)  # intact JSON or this throws
+        ci = doc["commitInfo"]
+        assert ci["version"] == v, f"v{v} holds payload for v{ci['version']}"
+        by_version[v] = (ci["writer"], ci["nonce"])
+
+    # acknowledged-commit durability: every success a writer recorded
+    # must be present with that writer's exact nonce
+    acked = 0
+    for name in os.listdir(table):
+        if not name.startswith("_writer_"):
+            continue
+        wid = int(name.split("_")[2].split(".")[0])
+        for line in open(os.path.join(table, name)):
+            v, nonce = line.split()
+            assert by_version[int(v)] == (wid, nonce), \
+                f"acked commit v{v} by writer {wid} lost or replaced"
+            acked += 1
+
+    # recovery leaves the arbiter consistent: latest entry complete
+    latest_entry = reader.arbiter.get_latest_entry(table)
+    assert latest_entry is not None and latest_entry.complete, \
+        f"latest arbiter entry not complete after recovery: {latest_entry}"
+
+    return {"seed": seed, "commits": len(versions), "crashes": crashes,
+            "spawned": spawned, "acked": acked}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--table")
+    ap.add_argument("--db")
+    ap.add_argument("--writer-id", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--target", type=int, default=11)
+    ap.add_argument("--crash-prob", type=float, default=0.25)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--writers", type=int, default=3)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        worker_main(args.table, args.db, args.writer_id, args.seed,
+                    args.target, args.crash_prob)
+        return 0
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="arbiter_fuzz_")
+    total_crashes = total_commits = 0
+    t0 = time.time()
+    for r in range(args.rounds):
+        stats = run_round(workdir, seed=args.seed + r,
+                          n_writers=args.writers,
+                          target_version=args.target,
+                          crash_prob=args.crash_prob)
+        total_crashes += stats["crashes"]
+        total_commits += stats["commits"]
+        print(f"round {r}: {stats}", flush=True)
+    print(json.dumps({
+        "rounds": args.rounds, "writers": args.writers,
+        "total_commits": total_commits, "total_crashes": total_crashes,
+        "elapsed_s": round(time.time() - t0, 1), "ok": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""Durable cross-process commit arbitration (reference
+`S3DynamoDBLogStore.java` + `BaseExternalLogStore.java:321,369-373`).
+
+The long proof runs standalone (`python -m delta_tpu.tools.arbiter_fuzz
+--rounds 100`); here we run seeded rounds of the same driver plus unit
+tests of the sqlite conditional put."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from delta_tpu.storage.arbiter import (
+    RacyLocalStore,
+    SqliteCommitArbiter,
+    external_arbiter_store,
+)
+from delta_tpu.storage.cloud import ExternalCommitEntry
+from delta_tpu.storage.logstore import FileAlreadyExistsError
+from delta_tpu.tools.arbiter_fuzz import run_round
+
+
+def test_sqlite_arbiter_conditional_put(tmp_path):
+    db = str(tmp_path / "arb.db")
+    a = SqliteCommitArbiter(db)
+    e = ExternalCommitEntry("/t", "00000000000000000000.json",
+                            "_delta_log/.tmp/x", complete=False)
+    a.put_entry(e, overwrite=False)
+    with pytest.raises(FileAlreadyExistsError):
+        a.put_entry(e, overwrite=False)
+    # a SECOND arbiter instance over the same file (what another process
+    # constructs) sees the row and loses the same race
+    b = SqliteCommitArbiter(db)
+    with pytest.raises(FileAlreadyExistsError):
+        b.put_entry(e, overwrite=False)
+    assert b.get_entry("/t", e.file_name) == e
+    # overwrite=True is the acknowledge path
+    b.put_entry(e.as_complete(60), overwrite=True)
+    got = a.get_entry("/t", e.file_name)
+    assert got.complete and got.expire_time is not None
+    assert a.get_latest_entry("/t").file_name == e.file_name
+
+
+def test_sqlite_arbiter_durable_across_reopen(tmp_path):
+    db = str(tmp_path / "arb.db")
+    a = SqliteCommitArbiter(db)
+    for v in range(3):
+        a.put_entry(ExternalCommitEntry(
+            "/t", f"{v:020d}.json", f"_delta_log/.tmp/{v}",
+            complete=True, expire_time=1), overwrite=False)
+    del a
+    reopened = SqliteCommitArbiter(db)
+    assert reopened.get_latest_entry("/t").file_name == \
+        "00000000000000000002.json"
+
+
+def test_racy_local_store_is_racy(tmp_path):
+    """The inner store must NOT provide mutual exclusion (that is the
+    point of the arbiter): blind put overwrites."""
+    s = RacyLocalStore()
+    p = str(tmp_path / "f")
+    s.write(p, b"one")
+    with pytest.raises(FileAlreadyExistsError):
+        s.write(p, b"two")
+    # but the check is advisory only — overwrite path is a blind PUT
+    s.write(p, b"three", overwrite=True)
+    assert s.read(p) == b"three"
+
+
+def test_cross_process_race_no_crashes(tmp_path):
+    """Two independent PROCESSES race 8 commits with no fault
+    injection: the sqlite conditional put must arbitrate every
+    version."""
+    stats = run_round(str(tmp_path), seed=1234, n_writers=2,
+                      target_version=7, crash_prob=0.0)
+    assert stats["commits"] == 8
+    assert stats["crashes"] == 0
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_kill_fuzz_round(tmp_path, seed):
+    """Writers SIGKILLed at random phase boundaries; survivors and a
+    fresh reader recover a gapless, attributable log."""
+    stats = run_round(str(tmp_path), seed=seed, n_writers=3,
+                      target_version=9, crash_prob=0.3)
+    assert stats["commits"] >= 10
+
+
+def test_crashed_half_commit_completed_by_other_process(tmp_path):
+    """Deterministic version of the fuzz's after_claim case: process A
+    claims version 0 and dies before the copy; process B (fresh) must
+    read a complete log."""
+    table = str(tmp_path / "t")
+    os.makedirs(os.path.join(table, "_delta_log"))
+    db = str(tmp_path / "arb.db")
+    code = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from delta_tpu.tools.arbiter_fuzz import _build_store
+store = _build_store({db!r}, lambda: "after_claim")
+store.write(os.path.join({table!r}, "_delta_log",
+            "00000000000000000000.json"), b'{{"commitInfo": {{}}}}\\n')
+"""
+    proc = subprocess.run([sys.executable, "-c", code])
+    assert proc.returncode == 137  # died mid-commit
+    commit = os.path.join(table, "_delta_log", "00000000000000000000.json")
+    assert not os.path.exists(commit)  # the half commit: claimed, no file
+
+    reader = external_arbiter_store(db)
+    listed = list(reader.list_from(commit))
+    assert [os.path.basename(fs.path) for fs in listed] == \
+        ["00000000000000000000.json"]
+    assert json.loads(reader.read(commit)) == {"commitInfo": {}}
+    assert reader.arbiter.get_latest_entry(table).complete
