@@ -539,289 +539,41 @@ def _parse_table_ref(text: str, engine, catalog):
 
 
 def _exec_select_extended(s: str, engine, catalog):
-    """SELECT with JOIN / GROUP BY / aggregates / ORDER BY — the query
-    subset the reference delegates to Spark SQL. Executed over Arrow:
-    pyarrow hash joins + grouped aggregations; WHERE residuals through
-    the host expression evaluator. Columns are materialized under
-    alias-qualified names internally so multi-table scoping is exact."""
-    import pyarrow as pa
+    """SELECT beyond simple projection — joins (implicit comma +
+    INNER/LEFT/RIGHT/FULL OUTER), aggregates, GROUP BY / HAVING,
+    subqueries, CASE, BETWEEN, date arithmetic: the query subset the
+    reference delegates to Spark SQL, executed by the sqlengine
+    parser/planner (`delta_tpu/sqlengine/`) with scan pushdown into
+    Delta snapshots. Runs verbatim TPC-DS query shapes."""
+    from delta_tpu.sqlengine import execute_select
 
-    from delta_tpu.expressions.eval import evaluate_predicate_host
-    from delta_tpu.expressions.tree import Comparison, split_conjuncts
-
-    _ = parse_expression  # module-level import, used below
-
-    body = re.sub(r"^SELECT\s+", "", s.strip(), flags=re.IGNORECASE)
-    select_text, rest = _split_before_keyword(body, "FROM")
-    if rest is None:
-        raise DeltaError("SELECT requires FROM")
-    rest = re.sub(r"^FROM\s+", "", rest, flags=re.IGNORECASE)
-
-    # trailing clauses, innermost first
-    limit = None
-    m = re.search(r"\s+LIMIT\s+(\d+)\s*$", rest, re.IGNORECASE)
-    if m:
-        limit = int(m.group(1))
-        rest = rest[:m.start()]
-    order_text = None
-    rest, ob = _split_before_keyword(rest, "ORDER")
-    if ob is not None:
-        order_text = re.sub(r"^ORDER\s+BY\s+", "", ob, flags=re.IGNORECASE)
-    having_text = None
-    rest, hv = _split_before_keyword(rest, "HAVING")
-    if hv is not None:
-        having_text = re.sub(r"^HAVING\s+", "", hv, flags=re.IGNORECASE)
-    group_text = None
-    rest, gb = _split_before_keyword(rest, "GROUP")
-    if gb is not None:
-        group_text = re.sub(r"^GROUP\s+BY\s+", "", gb, flags=re.IGNORECASE)
-    where_text = None
-    rest, wh = _split_before_keyword(rest, "WHERE")
-    if wh is not None:
-        where_text = re.sub(r"^WHERE\s+", "", wh, flags=re.IGNORECASE)
-
-    # FROM + JOINs (INNER and LEFT [OUTER]); the LEFT keyword precedes
-    # JOIN so the splitter keys on JOIN and inspects the tail of the
-    # preceding segment
-    joins = []
-    first, j = _split_before_keyword(rest, "JOIN")
-
-    def _strip_join_kind(before: str):
-        m2 = re.search(r"\s+(LEFT(?:\s+OUTER)?|INNER|RIGHT(?:\s+OUTER)?"
-                       r"|FULL(?:\s+OUTER)?|CROSS)\s*$", before,
-                       re.IGNORECASE)
-        if m2:
-            kw = m2.group(1).upper()
-            if kw.startswith(("RIGHT", "FULL", "CROSS")):
-                raise DeltaError(
-                    f"{kw} JOIN is not supported; use INNER or LEFT "
-                    "[OUTER] JOIN")
-            kind = "left outer" if kw.startswith("LEFT") else "inner"
-            return before[:m2.start()], kind
-        return before, "inner"
-
-    first, next_kind = _strip_join_kind(first)
-    while j is not None:
-        j = re.sub(r"^JOIN\s+", "", j, flags=re.IGNORECASE)
-        ref_text, on_rest = _split_before_keyword(j, "ON")
-        if on_rest is None:
-            raise DeltaError("JOIN requires ON")
-        on_rest = re.sub(r"^ON\s+", "", on_rest, flags=re.IGNORECASE)
-        on_text, j2 = _split_before_keyword(on_rest, "JOIN")
-        this_kind = next_kind
-        on_text, next_kind = _strip_join_kind(on_text)
-        joins.append((ref_text.strip(), on_text.strip(), this_kind))
-        j = j2
-
-    tables = [_parse_table_ref(first, engine, catalog)]
-    for ref_text, _on, _kind in joins:
-        tables.append(_parse_table_ref(ref_text, engine, catalog))
-
-    # resolve schemas + build the scope mapping BEFORE scanning so
-    # single-table WHERE conjuncts push down into each scan (partition
-    # pruning + stats skipping, same as the simple SELECT path)
-    snaps = []
-    mapping = {}      # ('alias','col') and unambiguous ('col',) -> physical
-    ambiguous = set()
-    for i, (table, alias) in enumerate(tables):
-        alias = alias or f"_t{i}"
-        snap = table.latest_snapshot()
-        cols = [f.name for f in snap.schema.fields]
-        for c in cols:
-            mapping[(alias, c)] = f"{alias}.{c}"
-            if (c,) in mapping:
-                ambiguous.add((c,))
-            else:
-                mapping[(c,)] = f"{alias}.{c}"
-        snaps.append((alias, snap, cols))
-    for key in ambiguous:
-        mapping.pop(key, None)
-
-    where_conjuncts = (split_conjuncts(parse_expression(where_text))
-                       if where_text else [])
-
-    # WHERE pushdown is unsound into a null-supplying join side: rows
-    # there may be null-extended by the join, so filtering the scan
-    # changes which left rows survive residual predicates such as the
-    # anti-join idiom `WHERE b.x IS NULL`.  The residual host eval below
-    # still applies the full WHERE, so skipping only costs pruning.
-    null_supplying = {snaps[i + 1][0]
-                      for i, (_, _, kind) in enumerate(joins)
-                      if kind == "left outer"}
-
-    loaded = []
-    for alias, snap, cols in snaps:
-        in_scope = {f"{alias}.{c}" for c in cols}
-        push = None
-        for conj in where_conjuncts if alias not in null_supplying else []:
-            try:
-                rewritten = _rewrite_columns(conj, mapping)
-            except DeltaError:
-                continue  # unresolvable here; caught by the residual eval
-            refs = {r[0] for r in rewritten.references()}
-            if refs <= in_scope:
-                # strip the alias back to the table's own column names
-                local = _rewrite_columns(
-                    conj, {k: v.split(".", 1)[1] for k, v in mapping.items()
-                           if v in in_scope})
-                push = local if push is None else (push & local)
-        arrow = snap.scan(filter=push).to_arrow()
-        renames = {c: f"{alias}.{c}" for c in arrow.column_names}
-        arrow = arrow.rename_columns([renames[c] for c in arrow.column_names])
-        loaded.append((alias, arrow))
-
-    current = loaded[0][1]
-    for (_, on_text, join_kind), (alias, right) in zip(joins, loaded[1:]):
-        on_expr = parse_expression(on_text)
-        left_keys, right_keys = [], []
-        from delta_tpu.expressions.tree import Column as _Col
-
-        for conj in split_conjuncts(on_expr):
-            if not (isinstance(conj, Comparison) and conj.op == "="
-                    and isinstance(conj.left, _Col)
-                    and isinstance(conj.right, _Col)):
-                raise DeltaError(
-                    f"JOIN ON supports conjunctions of column = column "
-                    f"equalities; got {on_text!r}")
-            a = _rewrite_columns(conj.left, mapping).name_path[0]
-            b = _rewrite_columns(conj.right, mapping).name_path[0]
-            if a in current.column_names and b in right.column_names:
-                left_keys.append(a)
-                right_keys.append(b)
-            elif b in current.column_names and a in right.column_names:
-                left_keys.append(b)
-                right_keys.append(a)
-            else:
-                raise DeltaError(
-                    f"JOIN keys {a!r}/{b!r} do not span the two sides")
-        current = current.join(right, keys=left_keys,
-                               right_keys=right_keys,
-                               join_type=join_kind, coalesce_keys=False)
-
-    if where_conjuncts:
-        pred = where_conjuncts[0]
-        for c in where_conjuncts[1:]:
-            pred = pred & c
-        pred = _rewrite_columns(pred, mapping)
-        keep = evaluate_predicate_host(pred, current)
-        current = current.filter(pa.array(keep))
-
-    # select list
-    items = [t.strip() for t in _split_top_level_commas(select_text)]
-    agg_re = re.compile(
-        r"^(?P<fn>count|sum|min|max|avg)\s*\(\s*(?P<distinct>DISTINCT\s+)?"
-        r"(?P<arg>\*|[A-Za-z_][\w.]*)\s*\)"
-        r"(?:\s+AS\s+(?P<alias>[A-Za-z_][\w]*))?$", re.IGNORECASE)
-    col_re = re.compile(
-        r"^(?P<col>[A-Za-z_][\w.]*)(?:\s+AS\s+(?P<alias>[A-Za-z_][\w]*))?$",
-        re.IGNORECASE)
-
-    def phys_of(name: str) -> str:
-        key = tuple(name.split("."))
-        if key in mapping:
-            return mapping[key]
-        raise DeltaError(f"column {name!r} not found; available: "
-                         f"{sorted('.'.join(k) for k in mapping)}")
-
-    group_cols = []
-    if group_text is not None:
-        group_cols = [phys_of(c.strip().strip("`"))
-                      for c in _split_top_level_commas(group_text)]
-
-    aggs = []        # (phys_or_[], fn, out_default, alias)
-    plain = []       # (phys, out_name)
-    has_agg = False
-    for it in items:
-        if it == "*" and len(items) == 1 and group_text is None:
-            plain = [(c, c.split(".", 1)[1] if "." in c else c)
-                     for c in current.column_names]
-            break
-        am = agg_re.match(it)
-        if am:
-            has_agg = True
-            fn = am.group("fn").lower()
-            arg = am.group("arg")
-            distinct = bool(am.group("distinct"))
-            if arg == "*":
-                if fn != "count" or distinct:
-                    raise DeltaError(f"{fn}(*) is not a thing; use a column")
-                aggs.append(([], "count_all", "count_all",
-                             am.group("alias") or "count(*)"))
-            else:
-                if distinct and fn != "count":
-                    raise DeltaError("DISTINCT is supported only in COUNT")
-                phys = phys_of(arg)
-                pfn = "count_distinct" if distinct else _AGG_FNS[fn]
-                label = (f"count(distinct {arg})" if distinct
-                         else f"{fn}({arg})")
-                aggs.append((phys, pfn, f"{phys}_{pfn}",
-                             am.group("alias") or label))
-            continue
-        cm = col_re.match(it)
-        if not cm:
-            raise DeltaError(f"unsupported select item {it!r}")
-        phys = phys_of(cm.group("col"))
-        # default output name: the unqualified column (SQL convention)
-        default = cm.group("col").rsplit(".", 1)[-1]
-        plain.append((phys, cm.group("alias") or default))
-
-    if has_agg or group_text is not None:
-        for phys, _out in plain:
-            if phys not in group_cols:
-                raise DeltaError(
-                    f"column {phys!r} in SELECT must appear in GROUP BY "
-                    "when aggregates are present")
-        out = current.group_by(group_cols).aggregate(
-            [(a[0], a[1]) for a in aggs])
-        # output layout: group keys first, then aggregates in spec order
-        # (positional — duplicate agg specs keep distinct aliases)
-        assert len(out.column_names) == len(group_cols) + len(aggs)
-        names = []
-        for c in out.column_names[:len(group_cols)]:
-            p = next((pl for pl in plain if pl[0] == c), None)
-            names.append(p[1] if p else
-                         (c.split(".", 1)[1] if "." in c else c))
-        names += [a[3] for a in aggs]
-        out = out.rename_columns(names)
-        if having_text is not None:
-            having_map = {(c,): c for c in out.column_names}
-            pred = _rewrite_columns(parse_expression(having_text),
-                                    having_map)
-            keep = evaluate_predicate_host(pred, out)
-            out = out.filter(pa.array(keep))
-    else:
-        if having_text is not None:
-            raise DeltaError("HAVING requires GROUP BY or aggregates")
-        out = current.select([p for p, _ in plain]).rename_columns(
-            [o for _, o in plain])
-
-    if order_text is not None:
-        keys = []
-        for part in _split_top_level_commas(order_text):
-            mm = re.match(r"^(?P<col>[A-Za-z_()*.\w]+)"
-                          r"(?:\s+(?P<dir>ASC|DESC))?$",
-                          part.strip(), re.IGNORECASE)
-            if not mm:
-                raise DeltaError(f"cannot parse ORDER BY item {part!r}")
-            name = mm.group("col")
-            target = name if name in out.column_names else None
-            if target is None:
-                raise DeltaError(
-                    f"ORDER BY column {name!r} must appear in the SELECT "
-                    f"list; have {out.column_names}")
-            keys.append((target, "descending"
-                         if (mm.group("dir") or "").upper() == "DESC"
-                         else "ascending"))
-        out = out.sort_by(keys)
-    if limit is not None:
-        out = out.slice(0, limit)
-    return out
+    return execute_select(s, engine=engine, catalog=catalog)
 
 
 def _needs_extended_select(s: str) -> bool:
     up = re.sub(r"'[^']*'", "''", s).upper()
-    return bool(re.search(r"\bJOIN\b|\bGROUP\s+BY\b|\bORDER\s+BY\b"
-                          r"|\b(?:COUNT|SUM|MIN|MAX|AVG)\s*\(", up))
+    if re.search(r"\bJOIN\b|\bGROUP\s+BY\b|\bORDER\s+BY\b|\bHAVING\b"
+                 r"|\b(?:COUNT|SUM|MIN|MAX|AVG|STDDEV_SAMP|VAR_SAMP)\s*\("
+                 r"|\bCASE\b|\bEXISTS\b|\bBETWEEN\b|\bDISTINCT\b"
+                 r"|\bUNION\b|\(\s*SELECT\b|\bCAST\s*\(", up):
+        return True
+    # implicit comma join: a comma at FROM-list depth before any WHERE
+    m = re.search(r"\bFROM\b(?P<rest>.*)$", up, re.DOTALL)
+    if m:
+        rest = m.group("rest")
+        for stop in ("WHERE", "LIMIT"):
+            cut = re.search(rf"\b{stop}\b", rest)
+            if cut:
+                rest = rest[:cut.start()]
+        depth = 0
+        for ch in rest:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return True
+    return False
 
 
 def _query_statement(s: str, engine, catalog):

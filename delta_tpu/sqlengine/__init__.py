@@ -1,0 +1,15 @@
+"""Relational SELECT engine for the Delta SQL surface.
+
+The reference delegates queries to Spark SQL (its grammar only *extends*
+Spark's: `spark/src/main/antlr4/io/delta/sql/parser/DeltaSqlBase.g4`).
+This package is the standalone equivalent: a recursive-descent SQL
+parser (`parser.py`) and a columnar pandas/Arrow executor with scan
+pushdown into Delta snapshots (`executor.py`) — enough of the language
+to run verbatim TPC-DS queries (implicit comma joins, outer joins,
+subqueries, CASE, BETWEEN, date arithmetic, expression aggregates).
+"""
+
+from delta_tpu.sqlengine.parser import parse_select
+from delta_tpu.sqlengine.executor import execute_select
+
+__all__ = ["parse_select", "execute_select"]

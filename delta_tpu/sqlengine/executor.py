@@ -1,0 +1,995 @@
+"""SELECT executor: name resolution, scan pushdown, join planning,
+vectorized evaluation over pandas, aggregation, ordering.
+
+Pipeline (the single-node mirror of the reference's Spark plan):
+
+1. Resolve every table ref to a Delta snapshot (or sub-select frame),
+   collect the referenced column set per table, and scan with column
+   projection + pushed-down single-table predicates (partition pruning
+   and stats skipping ride `Snapshot.scan(filter=...)`, the same path
+   the reference drives through `PrepareDeltaScan`).
+2. Join: explicit JOIN ... ON clauses in order, then the implicit
+   comma-list via equi-join edges mined from WHERE conjuncts (the
+   TPC-DS style `from a, b where a.k = b.k`); unconnected tables fall
+   back to cross joins.
+3. Residual WHERE on the joined frame, aggregate (GROUP BY / HAVING)
+   with Spark null semantics (null group keys kept, sum of all-null ->
+   null), ORDER BY (nulls first when ascending, last when descending),
+   LIMIT, projection.
+
+WHERE pushdown never applies to the null-supplying side of an outer
+join (rows there may be null-extended, so pre-filtering the scan would
+change which outer rows survive residual predicates — the anti-join
+idiom `WHERE b.x IS NULL`).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from delta_tpu.errors import DeltaError
+from delta_tpu.sqlengine.parser import (
+    And, Between, BinOp, CaseWhen, Cast, Cmp, Col, Exists, Func, InList,
+    InSelect, Interval, IsNull, JoinClause, Like, Lit, Neg, Not, Or,
+    ScalarSelect, Select, SelectItem, Star, TableRef, parse_select,
+)
+
+_AGGS = {"count", "sum", "min", "max", "avg", "stddev_samp", "var_samp"}
+_NULL_SUPPLYING = {"left outer": ("right",), "right outer": ("left",),
+                   "full outer": ("left", "right")}
+
+
+# ---------------------------------------------------------------- API --
+
+def execute_select(statement_or_ast, engine=None, catalog=None) -> pa.Table:
+    sel = (statement_or_ast if isinstance(statement_or_ast, Select)
+           else parse_select(statement_or_ast))
+    df, names = _Exec(engine, catalog).run(sel)
+    out = pa.Table.from_pandas(df, preserve_index=False)
+    return out.rename_columns(names)
+
+
+# ------------------------------------------------------------ helpers --
+
+def _canon(e, resolve) -> str:
+    """Canonical key for an expression with columns resolved to their
+    physical names — `dt.d_year` and a bare `d_year` that resolves to
+    the same physical column share a key."""
+    if isinstance(e, Col):
+        return f"col:{resolve(e)}"
+    if isinstance(e, Lit):
+        return f"lit:{e.value!r}"
+    if isinstance(e, BinOp):
+        return f"({_canon(e.left, resolve)}{e.op}{_canon(e.right, resolve)})"
+    if isinstance(e, Cmp):
+        return f"({_canon(e.left, resolve)}{e.op}{_canon(e.right, resolve)})"
+    if isinstance(e, And):
+        return "and(" + ",".join(_canon(x, resolve) for x in e.items) + ")"
+    if isinstance(e, Or):
+        return "or(" + ",".join(_canon(x, resolve) for x in e.items) + ")"
+    if isinstance(e, Not):
+        return f"not({_canon(e.item, resolve)})"
+    if isinstance(e, Neg):
+        return f"neg({_canon(e.item, resolve)})"
+    if isinstance(e, Func):
+        inner = "*" if e.star else ",".join(
+            _canon(a, resolve) for a in e.args)
+        d = "distinct " if e.distinct else ""
+        return f"{e.name}({d}{inner})"
+    if isinstance(e, CaseWhen):
+        parts = [f"when {_canon(c, resolve)} then {_canon(v, resolve)}"
+                 for c, v in e.whens]
+        if e.else_ is not None:
+            parts.append(f"else {_canon(e.else_, resolve)}")
+        return "case(" + ";".join(parts) + ")"
+    if isinstance(e, Between):
+        neg = "not " if e.negated else ""
+        return (f"{neg}between({_canon(e.item, resolve)},"
+                f"{_canon(e.lo, resolve)},{_canon(e.hi, resolve)})")
+    if isinstance(e, InList):
+        neg = "not " if e.negated else ""
+        return (f"{neg}in({_canon(e.item, resolve)};"
+                + ",".join(_canon(v, resolve) for v in e.values) + ")")
+    if isinstance(e, IsNull):
+        return f"isnull({_canon(e.item, resolve)},{e.negated})"
+    if isinstance(e, Like):
+        return f"like({_canon(e.item, resolve)},{e.pattern!r},{e.negated})"
+    if isinstance(e, Cast):
+        return f"cast({_canon(e.item, resolve)} as {e.type_name})"
+    if isinstance(e, Interval):
+        return f"interval:{e.n}:{e.unit}"
+    if isinstance(e, (InSelect, Exists, ScalarSelect)):
+        return f"subquery:{id(e)}"
+    raise DeltaError(f"cannot canonicalize {type(e).__name__}")
+
+
+def _split_and(e) -> list:
+    if isinstance(e, And):
+        out = []
+        for x in e.items:
+            out.extend(_split_and(x))
+        return out
+    return [e] if e is not None else []
+
+
+def _walk_exprs(e, fn):
+    """Visit e and sub-expressions (does not descend into subqueries)."""
+    if e is None:
+        return
+    fn(e)
+    if isinstance(e, (BinOp, Cmp)):
+        _walk_exprs(e.left, fn)
+        _walk_exprs(e.right, fn)
+    elif isinstance(e, (And, Or)):
+        for x in e.items:
+            _walk_exprs(x, fn)
+    elif isinstance(e, (Not, Neg, IsNull, Like, Cast)):
+        _walk_exprs(e.item, fn)
+    elif isinstance(e, Func):
+        for a in e.args:
+            _walk_exprs(a, fn)
+    elif isinstance(e, CaseWhen):
+        for c, v in e.whens:
+            _walk_exprs(c, fn)
+            _walk_exprs(v, fn)
+        _walk_exprs(e.else_, fn)
+    elif isinstance(e, Between):
+        _walk_exprs(e.item, fn)
+        _walk_exprs(e.lo, fn)
+        _walk_exprs(e.hi, fn)
+    elif isinstance(e, (InList,)):
+        _walk_exprs(e.item, fn)
+        for v in e.values:
+            _walk_exprs(v, fn)
+    elif isinstance(e, InSelect):
+        _walk_exprs(e.item, fn)
+
+
+def _render(e) -> str:
+    """Spark-style output name for an unaliased expression."""
+    if isinstance(e, Col):
+        return e.parts[-1]
+    if isinstance(e, Func):
+        if e.star:
+            return f"{e.name}(*)"
+        d = "distinct " if e.distinct else ""
+        return f"{e.name}({d}{', '.join(_render(a) for a in e.args)})"
+    if isinstance(e, Lit):
+        return repr(e.value)
+    if isinstance(e, BinOp):
+        return f"({_render(e.left)} {e.op} {_render(e.right)})"
+    return type(e).__name__.lower()
+
+
+def _normalize_frame(df: pd.DataFrame) -> pd.DataFrame:
+    """Post-to_pandas cleanup: date32 -> datetime64, Decimal -> float."""
+    for c in df.columns:
+        s = df[c]
+        if s.dtype == object and len(s):
+            first = s.dropna().head(1)
+            if len(first):
+                v = first.iloc[0]
+                if isinstance(v, datetime.date) and not isinstance(
+                        v, datetime.datetime):
+                    df[c] = pd.to_datetime(s)
+                else:
+                    import decimal
+
+                    if isinstance(v, decimal.Decimal):
+                        df[c] = s.astype(float)
+    return df
+
+
+# -------------------------------------------------------- the executor --
+
+class _Exec:
+    def __init__(self, engine, catalog):
+        self.engine = engine
+        self.catalog = catalog
+
+    # -- table materialization ------------------------------------------
+    def _snapshot(self, ref: TableRef):
+        from delta_tpu.table import Table
+
+        if ref.kind == "path":
+            from delta_tpu.sql import _PATH_GUARD
+
+            guard = _PATH_GUARD.get()
+            if guard is not None:
+                guard(ref.value)
+            table = Table.for_path(ref.value, self.engine)
+        else:
+            if self.catalog is None:
+                raise DeltaError(
+                    f"table name {ref.value!r} requires a catalog "
+                    "(pass catalog=)")
+            table = self.catalog.table(ref.value)
+        if ref.tt_version is not None:
+            return table.snapshot_at(ref.tt_version)
+        if ref.tt_timestamp is not None:
+            from delta_tpu.sql import _timestamp_ms
+
+            return table.snapshot_as_of_timestamp(
+                _timestamp_ms(ref.tt_timestamp))
+        return table.latest_snapshot()
+
+    def run(self, sel: Select) -> Tuple[pd.DataFrame, List[str]]:
+        # ---- source inventory -----------------------------------------
+        sources: List[dict] = []  # {alias, ref, snap|frame, cols}
+        seen_aliases = set()
+        for i, ref in enumerate(list(sel.froms)
+                                + [j.ref for j in sel.joins]):
+            if ref.kind == "subquery":
+                sub_df, sub_names = _Exec(self.engine, self.catalog).run(
+                    ref.value)
+                sub_df.columns = sub_names
+                alias = ref.alias or f"_s{i}"
+                src = {"alias": alias, "frame": sub_df,
+                       "cols": list(sub_df.columns), "snap": None}
+            else:
+                snap = self._snapshot(ref)
+                alias = ref.alias or (
+                    ref.value.split(".")[-1] if ref.kind == "name"
+                    else f"_t{i}")
+                src = {"alias": alias, "snap": snap, "frame": None,
+                       "cols": [f.name for f in snap.schema.fields]}
+            if alias in seen_aliases:
+                raise DeltaError(f"duplicate table alias {alias!r}")
+            seen_aliases.add(alias)
+            sources.append(src)
+        # sources[len(froms) + k] belongs to sel.joins[k]
+        join_aliases = [sources[len(sel.froms) + k]["alias"]
+                        for k in range(len(sel.joins))]
+
+        by_alias = {s["alias"]: s for s in sources}
+        col_owners: Dict[str, List[str]] = {}
+        for s in sources:
+            for c in s["cols"]:
+                col_owners.setdefault(c, []).append(s["alias"])
+
+        def resolve(col: Col) -> str:
+            if len(col.parts) >= 2:
+                alias, name = col.parts[-2], col.parts[-1]
+                if alias not in by_alias:
+                    raise DeltaError(f"table alias {alias!r} not found "
+                                     f"for column {col.text!r}")
+                if name not in by_alias[alias]["cols"]:
+                    raise DeltaError(
+                        f"column {col.text!r} not found in {alias!r}")
+                return f"{alias}.{name}"
+            name = col.parts[0]
+            owners = col_owners.get(name, [])
+            if len(owners) == 1:
+                return f"{owners[0]}.{name}"
+            if not owners:
+                raise DeltaError(
+                    f"column {name!r} not found; not in scope of any "
+                    f"table ({sorted(by_alias)})")
+            raise DeltaError(
+                f"column {name!r} is ambiguous (in {owners}); qualify "
+                "with a table alias — not in scope unqualified")
+
+        self._resolve = resolve
+
+        # ---- referenced columns per alias (projection) ----------------
+        needed: Dict[str, set] = {s["alias"]: set() for s in sources}
+        select_star = any(isinstance(it.expr, Star) for it in sel.items)
+
+        def note(e):
+            if isinstance(e, Col):
+                try:
+                    phys = resolve(e)
+                except DeltaError:
+                    return  # surfaces with a proper error during eval
+                alias, name = phys.split(".", 1)
+                needed[alias].add(name)
+
+        for it in sel.items:
+            _walk_exprs(it.expr, note)
+        for e in ([sel.where, sel.having] + sel.group_by
+                  + [o for o, _ in sel.order_by]
+                  + [j.on for j in sel.joins]):
+            _walk_exprs(e, note)
+        if select_star:
+            for s in sources:
+                needed[s["alias"]] = set(s["cols"])
+
+        # ---- pushdown classification ----------------------------------
+        conjuncts = _split_and(sel.where)
+        null_supplying = set()
+        for k, j in enumerate(sel.joins):
+            sides = _NULL_SUPPLYING.get(j.kind, ())
+            if "right" in sides:
+                null_supplying.add(join_aliases[k])
+            if "left" in sides:
+                # everything joined before this clause can be
+                # null-extended by it
+                null_supplying.update(
+                    s["alias"] for s in sources[:len(sel.froms) + k])
+        pushed: Dict[str, list] = {s["alias"]: [] for s in sources}
+        for conj in conjuncts:
+            target = self._sole_alias(conj, resolve)
+            if target and target not in null_supplying:
+                tree = self._to_tree(conj, resolve, target)
+                if tree is not None:
+                    pushed[target].append(tree)
+
+        # ---- materialize frames ---------------------------------------
+        for s in sources:
+            if s["frame"] is not None:
+                df = s["frame"]
+                df.columns = [f"{s['alias']}.{c}" for c in df.columns]
+                s["frame"] = df
+                continue
+            filt = None
+            for t in pushed[s["alias"]]:
+                filt = t if filt is None else (filt & t)
+            cols = sorted(needed[s["alias"]]) or s["cols"][:1]
+            arrow = s["snap"].scan(filter=filt, columns=cols).to_arrow()
+            df = arrow.to_pandas()
+            df = _normalize_frame(df)
+            df.columns = [f"{s['alias']}.{c}" for c in df.columns]
+            s["frame"] = df
+
+        # ---- joins ----------------------------------------------------
+        implicit = [s["alias"] for s in sources
+                    if s["alias"] not in set(join_aliases)]
+        # equi-edges from WHERE (implicit joins only)
+        edges = []   # (alias_a, col_a, alias_b, col_b, conj)
+        consumed = set()
+        for conj in conjuncts:
+            if (isinstance(conj, Cmp) and conj.op == "="
+                    and isinstance(conj.left, Col)
+                    and isinstance(conj.right, Col)):
+                try:
+                    pa_, pb_ = resolve(conj.left), resolve(conj.right)
+                except DeltaError:
+                    continue
+                aa, ab = pa_.split(".", 1)[0], pb_.split(".", 1)[0]
+                if aa != ab:
+                    edges.append((aa, pa_, ab, pb_, conj))
+
+        first_alias = sources[0]["alias"]
+        current = by_alias[first_alias]["frame"]
+        joined = {first_alias}
+        remaining = [a for a in implicit if a != first_alias]
+        while remaining:
+            pick = None
+            for a in remaining:
+                keys = [(pl, pr) if al in joined else (pr, pl)
+                        for (al, pl, ar, pr, c) in edges
+                        if (al in joined and ar == a)
+                        or (ar in joined and al == a)]
+                if keys:
+                    pick = (a, keys)
+                    break
+            if pick is None:  # no connecting predicate: cross join
+                a = remaining[0]
+                current = current.merge(by_alias[a]["frame"], how="cross")
+                joined.add(a)
+                remaining.remove(a)
+                continue
+            a, keys = pick
+            lk = [k for k, _ in keys]
+            rk = [k for _, k in keys]
+            current = current.merge(by_alias[a]["frame"], how="inner",
+                                    left_on=lk, right_on=rk)
+            for (al, pl, ar, pr, c) in edges:
+                if {al, ar} <= joined | {a}:
+                    consumed.add(id(c))
+            joined.add(a)
+            remaining.remove(a)
+
+        for k, j in enumerate(sel.joins):
+            a = join_aliases[k]
+            right = by_alias[a]["frame"]
+            how = {"inner": "inner", "left outer": "left",
+                   "right outer": "right", "full outer": "outer",
+                   "cross": "cross"}[j.kind]
+            if j.kind == "cross":
+                current = current.merge(right, how="cross")
+                joined.add(a)
+                continue
+            lk, rk = [], []
+            for conj in _split_and(j.on):
+                if not (isinstance(conj, Cmp) and conj.op == "="
+                        and isinstance(conj.left, Col)
+                        and isinstance(conj.right, Col)):
+                    raise DeltaError(
+                        "JOIN ON supports conjunctions of column = "
+                        f"column equalities; got {_render(conj)!r}")
+                pl, pr = resolve(conj.left), resolve(conj.right)
+                if pl.split(".", 1)[0] == a and pr.split(".", 1)[0] != a:
+                    pl, pr = pr, pl
+                if pr.split(".", 1)[0] != a:
+                    raise DeltaError(
+                        f"JOIN keys {pl!r}/{pr!r} do not span the "
+                        "two sides")
+                lk.append(pl)
+                rk.append(pr)
+            current = current.merge(right, how=how, left_on=lk,
+                                    right_on=rk)
+            joined.add(a)
+
+        # ---- residual WHERE -------------------------------------------
+        residual = [c for c in conjuncts if id(c) not in consumed]
+        if residual:
+            mask = None
+            for conj in residual:
+                m = self._truth(self._eval(conj, current))
+                mask = m if mask is None else (mask & m)
+            if isinstance(mask, bool):  # e.g. a lone EXISTS(...)
+                current = current if mask else current.iloc[0:0]
+            else:
+                current = current[mask]
+
+        return self._project(sel, current, resolve)
+
+    # -- projection / aggregation / order -------------------------------
+    def _project(self, sel: Select, df: pd.DataFrame, resolve):
+        has_agg = False
+
+        def check_agg(e):
+            nonlocal has_agg
+            if isinstance(e, Func) and e.name in _AGGS:
+                has_agg = True
+
+        for it in sel.items:
+            _walk_exprs(it.expr, check_agg)
+        _walk_exprs(sel.having, check_agg)
+        for o, _ in sel.order_by:
+            _walk_exprs(o, check_agg)
+
+        if sel.having is not None and not sel.group_by:
+            raise DeltaError("HAVING requires GROUP BY")
+
+        alias_map = {it.alias: it.expr for it in sel.items if it.alias}
+
+        if has_agg or sel.group_by:
+            df = self._aggregate(sel, df, resolve)
+            env = self._agg_env
+        else:
+            env = {}
+
+        # output column evaluation
+        out_cols: List[pd.Series] = []
+        out_names: List[str] = []
+        for it in sel.items:
+            if isinstance(it.expr, Star):
+                if has_agg or sel.group_by:
+                    raise DeltaError("SELECT * cannot combine with "
+                                     "GROUP BY/aggregates")
+                for c in df.columns:
+                    out_cols.append(df[c])
+                    out_names.append(c.split(".", 1)[1] if "." in c else c)
+                continue
+            s = self._eval_out(it.expr, df, env, resolve)
+            if not isinstance(s, pd.Series):  # scalar -> broadcast
+                s = pd.Series([s] * len(df), index=df.index)
+            out_cols.append(s)
+            if it.alias:
+                out_names.append(it.alias)
+            elif isinstance(it.expr, Col):
+                out_names.append(it.expr.parts[-1])
+            elif isinstance(it.expr, Func):
+                out_names.append(_render(it.expr))
+            else:
+                out_names.append(it.text or _render(it.expr))
+
+        # HAVING
+        if sel.having is not None:
+            mask = self._truth(self._eval_out(
+                self._sub_aliases(sel.having, alias_map), df, env, resolve))
+            df = df[mask]
+            out_cols = [c[mask] for c in out_cols]
+
+        result = pd.DataFrame(
+            {f"__c{i}": c.reset_index(drop=True)
+             for i, c in enumerate(out_cols)})
+        if sel.distinct:
+            result = result.drop_duplicates()
+
+        # ORDER BY
+        if sel.order_by:
+            sort_series = []
+            for e, asc in sel.order_by:
+                e = self._sub_aliases(e, alias_map)
+                # select-list alias / output column reference
+                s = None
+                if isinstance(e, Col) and len(e.parts) == 1:
+                    if e.parts[0] in out_names:
+                        s = result[f"__c{out_names.index(e.parts[0])}"]
+                if s is None:
+                    ref = self._eval_out(e, df, env, resolve)
+                    s = ref.reset_index(drop=True)
+                sort_series.append((s, asc))
+            tmp = result.copy()
+            for i, (s, asc) in enumerate(sort_series):
+                tmp[f"__s{i}"] = s.values
+            for i in range(len(sort_series) - 1, -1, -1):
+                asc = sort_series[i][1]
+                tmp = tmp.sort_values(
+                    f"__s{i}", ascending=asc, kind="mergesort",
+                    na_position="first" if asc else "last")
+            result = tmp.drop(columns=[f"__s{i}"
+                                       for i in range(len(sort_series))])
+
+        if sel.limit is not None:
+            result = result.head(sel.limit)
+        result = result.reset_index(drop=True)
+        return result, out_names
+
+    def _aggregate(self, sel: Select, df: pd.DataFrame, resolve):
+        canon = lambda e: _canon(e, resolve)  # noqa: E731
+        key_exprs = list(sel.group_by)
+        key_cols = {}
+        for e in key_exprs:
+            key_cols[canon(e)] = self._eval(e, df)
+
+        agg_specs: Dict[str, Func] = {}
+
+        def collect(e):
+            if isinstance(e, Func) and e.name in _AGGS:
+                agg_specs.setdefault(canon(e), e)
+
+        for it in sel.items:
+            _walk_exprs(it.expr, collect)
+        _walk_exprs(sel.having, collect)
+        for o, _ in sel.order_by:
+            _walk_exprs(o, collect)
+
+        work = pd.DataFrame(index=df.index)
+        for k, s in key_cols.items():
+            work[k] = s
+        arg_cols = {}
+        for k, f in agg_specs.items():
+            if not f.star:
+                if len(f.args) != 1:
+                    raise DeltaError(
+                        f"{f.name} takes exactly one argument")
+                arg_cols[k] = self._eval(f.args[0], df)
+                work[f"__arg_{k}"] = arg_cols[k]
+
+        if key_exprs:
+            gb = work.groupby(list(key_cols), dropna=False, sort=False)
+            out = gb.size().rename("__size").reset_index()
+            for k, f in agg_specs.items():
+                if f.star:
+                    out[k] = gb.size().values
+                    continue
+                col = f"__arg_{k}"
+                if f.name == "count" and f.distinct:
+                    vals = gb[col].nunique()
+                elif f.name == "count":
+                    vals = gb[col].count()
+                elif f.name == "sum":
+                    vals = gb[col].sum(min_count=1)
+                elif f.name == "avg":
+                    vals = gb[col].mean()
+                elif f.name == "min":
+                    vals = gb[col].min()
+                elif f.name == "max":
+                    vals = gb[col].max()
+                elif f.name == "stddev_samp":
+                    vals = gb[col].std()
+                elif f.name == "var_samp":
+                    vals = gb[col].var()
+                out[k] = vals.values
+            out = out.drop(columns="__size")
+        else:
+            row = {}
+            for k, f in agg_specs.items():
+                if f.star:
+                    row[k] = len(work)
+                    continue
+                s = work[f"__arg_{k}"]
+                if f.name == "count" and f.distinct:
+                    row[k] = s.nunique()
+                elif f.name == "count":
+                    row[k] = s.count()
+                elif f.name == "sum":
+                    row[k] = s.sum(min_count=1)
+                elif f.name == "avg":
+                    row[k] = s.mean()
+                elif f.name == "min":
+                    row[k] = s.min() if len(s) else None
+                elif f.name == "max":
+                    row[k] = s.max() if len(s) else None
+                elif f.name == "stddev_samp":
+                    row[k] = s.std()
+                elif f.name == "var_samp":
+                    row[k] = s.var()
+            out = pd.DataFrame([row])
+        self._agg_env = {k: k for k in out.columns}
+        return out
+
+    def _sub_aliases(self, e, alias_map):
+        """Recursively replace select-list alias references (HAVING
+        total > 5 where total aliases SUM(v))."""
+        import dataclasses
+
+        if isinstance(e, Col) and len(e.parts) == 1 \
+                and e.parts[0] in alias_map:
+            return alias_map[e.parts[0]]
+        if isinstance(e, (BinOp, Cmp)):
+            return dataclasses.replace(
+                e, left=self._sub_aliases(e.left, alias_map),
+                right=self._sub_aliases(e.right, alias_map))
+        if isinstance(e, (And, Or)):
+            return dataclasses.replace(e, items=tuple(
+                self._sub_aliases(x, alias_map) for x in e.items))
+        if isinstance(e, (Not, Neg, IsNull, Cast, Like)):
+            return dataclasses.replace(
+                e, item=self._sub_aliases(e.item, alias_map))
+        if isinstance(e, Between):
+            return dataclasses.replace(
+                e, item=self._sub_aliases(e.item, alias_map),
+                lo=self._sub_aliases(e.lo, alias_map),
+                hi=self._sub_aliases(e.hi, alias_map))
+        if isinstance(e, InList):
+            return dataclasses.replace(
+                e, item=self._sub_aliases(e.item, alias_map),
+                values=tuple(self._sub_aliases(v, alias_map)
+                             for v in e.values))
+        return e
+
+    def _eval_out(self, e, df, env, resolve):
+        """Evaluate in the post-aggregation environment when env is
+        non-empty; else plain row environment."""
+        if env:
+            canon = _canon(e, resolve)
+            if canon in env:
+                return df[env[canon]]
+            if isinstance(e, Col):
+                raise DeltaError(
+                    f"column {e.text!r} in SELECT/HAVING/ORDER BY must "
+                    "appear in GROUP BY or inside an aggregate")
+            if isinstance(e, Lit):
+                return pd.Series([e.value] * len(df), index=df.index)
+            if isinstance(e, BinOp):
+                l = self._eval_out(e.left, df, env, resolve)
+                r = self._eval_out(e.right, df, env, resolve)
+                return _binop(e.op, l, r)
+            if isinstance(e, Cmp):
+                l = self._eval_out(e.left, df, env, resolve)
+                r = self._eval_out(e.right, df, env, resolve)
+                return _cmp(e.op, l, r)
+            if isinstance(e, And):
+                out = None
+                for x in e.items:
+                    m = self._truth(self._eval_out(x, df, env, resolve))
+                    out = m if out is None else (out & m)
+                return out
+            if isinstance(e, Or):
+                out = None
+                for x in e.items:
+                    m = self._truth(self._eval_out(x, df, env, resolve))
+                    out = m if out is None else (out | m)
+                return out
+            if isinstance(e, Not):
+                return ~self._truth(self._eval_out(e.item, df, env,
+                                                   resolve))
+            if isinstance(e, Func) and e.name in _AGGS:
+                # canon miss should not happen (collected above)
+                raise DeltaError(f"aggregate {e.name} not computed")
+            raise DeltaError(
+                f"unsupported expression over aggregated result: "
+                f"{_render(e)}")
+        return self._eval(e, df)
+
+    # -- row-environment evaluation -------------------------------------
+    def _eval(self, e, df: pd.DataFrame):
+        if isinstance(e, Lit):
+            return e.value
+        if isinstance(e, Col):
+            return df[self._resolve(e)]
+        if isinstance(e, Neg):
+            return -self._eval(e.item, df)
+        if isinstance(e, BinOp):
+            return _binop(e.op, self._eval(e.left, df),
+                          self._eval(e.right, df))
+        if isinstance(e, Cmp):
+            return _cmp(e.op, self._eval(e.left, df),
+                        self._eval(e.right, df))
+        if isinstance(e, And):
+            out = None
+            for x in e.items:
+                m = self._truth(self._eval(x, df))
+                out = m if out is None else (out & m)
+            return out
+        if isinstance(e, Or):
+            out = None
+            for x in e.items:
+                m = self._truth(self._eval(x, df))
+                out = m if out is None else (out | m)
+            return out
+        if isinstance(e, Not):
+            return ~self._truth(self._eval(e.item, df))
+        if isinstance(e, IsNull):
+            s = self._eval(e.item, df)
+            isna = s.isna() if isinstance(s, pd.Series) else pd.isna(s)
+            return ~isna if e.negated else isna
+        if isinstance(e, Between):
+            v = self._eval(e.item, df)
+            lo = self._eval(e.lo, df)
+            hi = self._eval(e.hi, df)
+            m = _cmp(">=", v, lo) & _cmp("<=", v, hi)
+            return ~self._truth(m) if e.negated else m
+        if isinstance(e, InList):
+            v = self._eval(e.item, df)
+            vals = [self._eval(x, df) for x in e.values]
+            if isinstance(v, pd.Series):
+                m = v.isin(vals)
+            else:
+                m = v in vals
+            return ~self._truth(m) if e.negated else m
+        if isinstance(e, Like):
+            import re as _re
+
+            s = self._eval(e.item, df)
+            pat = "^" + "".join(
+                ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+                for ch in e.pattern) + "$"
+            m = s.str.match(pat, na=False)
+            return ~m if e.negated else m
+        if isinstance(e, CaseWhen):
+            conds = [np.asarray(self._truth(self._eval(c, df)))
+                     for c, _ in e.whens]
+            vals = [self._eval(v, df) for _, v in e.whens]
+            default = self._eval(e.else_, df) if e.else_ is not None \
+                else None
+            n = len(df)
+            vals = [v.values if isinstance(v, pd.Series)
+                    else np.full(n, v, dtype=object if isinstance(v, str)
+                                 else None) for v in vals]
+            if isinstance(default, pd.Series):
+                default = default.values
+            elif default is None:
+                default = np.full(n, np.nan)
+            else:
+                default = np.full(
+                    n, default,
+                    dtype=object if isinstance(default, str) else None)
+            out = np.select(conds, vals, default)
+            return pd.Series(out, index=df.index)
+        if isinstance(e, Cast):
+            v = self._eval(e.item, df)
+            return _cast(v, e.type_name)
+        if isinstance(e, Interval):
+            return pd.Timedelta(days=e.n)
+        if isinstance(e, ScalarSelect):
+            out = execute_select(e.select, self.engine, self.catalog)
+            if out.num_columns != 1:
+                raise DeltaError("scalar subquery must return one column")
+            if out.num_rows == 0:
+                return None
+            if out.num_rows > 1:
+                raise DeltaError("scalar subquery returned >1 row")
+            return out.column(0)[0].as_py()
+        if isinstance(e, InSelect):
+            out = execute_select(e.select, self.engine, self.catalog)
+            if out.num_columns != 1:
+                raise DeltaError("IN subquery must return one column")
+            vals = set(out.column(0).to_pylist())
+            v = self._eval(e.item, df)
+            m = v.isin(vals) if isinstance(v, pd.Series) else (v in vals)
+            return ~self._truth(m) if e.negated else m
+        if isinstance(e, Exists):
+            out = execute_select(e.select, self.engine, self.catalog)
+            flag = out.num_rows > 0
+            if e.negated:
+                flag = not flag
+            return flag
+        if isinstance(e, Func):
+            if e.name in _AGGS:
+                raise DeltaError(
+                    f"aggregate {e.name}(...) is not allowed here")
+            return self._scalar_func(e, df)
+        if isinstance(e, Star):
+            raise DeltaError("* is only allowed as a lone select item")
+        raise DeltaError(f"unsupported expression {type(e).__name__}")
+
+    def _scalar_func(self, e: Func, df):
+        args = [self._eval(a, df) for a in e.args]
+        name = e.name
+        if name in ("substr", "substring"):
+            s, start, length = args[0], int(args[1]), int(args[2]) \
+                if len(args) > 2 else None
+            if not isinstance(s, pd.Series):
+                s = pd.Series([s] * len(df), index=df.index)
+            s = s.astype("string")
+            if length is None:
+                return s.str.slice(start - 1)
+            return s.str.slice(start - 1, start - 1 + length)
+        if name == "upper":
+            return args[0].str.upper()
+        if name == "lower":
+            return args[0].str.lower()
+        if name == "length":
+            return args[0].str.len()
+        if name == "abs":
+            return args[0].abs() if isinstance(args[0], pd.Series) \
+                else abs(args[0])
+        if name == "round":
+            nd = int(args[1]) if len(args) > 1 else 0
+            return args[0].round(nd) if isinstance(args[0], pd.Series) \
+                else round(args[0], nd)
+        if name == "coalesce":
+            out = args[0]
+            for nxt in args[1:]:
+                if isinstance(out, pd.Series):
+                    out = out.fillna(nxt) if not isinstance(nxt, pd.Series)\
+                        else out.combine_first(nxt)
+                elif out is None:
+                    out = nxt
+            return out
+        if name == "concat":
+            out = None
+            for a in args:
+                a = a.astype("string") if isinstance(a, pd.Series) \
+                    else str(a)
+                out = a if out is None else out + a
+            return out
+        if name == "year":
+            return args[0].dt.year
+        if name == "month":
+            return args[0].dt.month
+        raise DeltaError(f"unsupported function {name!r}")
+
+    @staticmethod
+    def _truth(m):
+        """Null comparison results are false (SQL three-valued logic
+        collapsed at filter boundaries)."""
+        if isinstance(m, pd.Series):
+            if m.dtype == object or str(m.dtype) == "boolean":
+                return m.fillna(False).astype(bool)
+            return m
+        return bool(m)
+
+    # -- pushdown helpers ------------------------------------------------
+    def _sole_alias(self, conj, resolve) -> Optional[str]:
+        aliases = set()
+        bad = False
+
+        def note(e):
+            nonlocal bad
+            if isinstance(e, Col):
+                try:
+                    aliases.add(resolve(e).split(".", 1)[0])
+                except DeltaError:
+                    bad = True
+            elif isinstance(e, (InSelect, Exists, ScalarSelect)):
+                bad = True
+
+        _walk_exprs(conj, note)
+        if bad or len(aliases) != 1:
+            return None
+        return next(iter(aliases))
+
+    def _to_tree(self, conj, resolve, alias):
+        """Best-effort conversion to the persisted-expression tree for
+        scan pushdown (file pruning). Unsupported shapes return None —
+        the residual evaluation still applies the full predicate."""
+        from delta_tpu.expressions import col as t_col, lit as t_lit
+        from delta_tpu.expressions.tree import Expression
+
+        def conv(e):
+            if isinstance(e, Cmp):
+                l, r = e.left, e.right
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                        "=": "=", "<>": "<>"}
+                if isinstance(r, Col) and isinstance(l, Lit):
+                    l, r = r, l
+                    op = flip[e.op]
+                else:
+                    op = e.op
+                if not (isinstance(l, Col) and isinstance(r, Lit)):
+                    return None
+                if not isinstance(r.value, (int, float, str, bool)):
+                    return None
+                c = t_col(l.parts[-1])
+                v = t_lit(r.value)
+                return {"=": c == v, "<>": c != v, "<": c < v,
+                        "<=": c <= v, ">": c > v, ">=": c >= v}[op]
+            if isinstance(e, Between) and not e.negated:
+                lo = conv(Cmp(">=", e.item, e.lo))
+                hi = conv(Cmp("<=", e.item, e.hi))
+                return lo & hi if lo is not None and hi is not None \
+                    else None
+            if isinstance(e, InList) and not e.negated:
+                out = None
+                for v in e.values:
+                    c = conv(Cmp("=", e.item, v))
+                    if c is None:
+                        return None
+                    out = c if out is None else (out | c)
+                return out
+            if isinstance(e, And):
+                out = None
+                for x in e.items:
+                    c = conv(x)
+                    if c is None:
+                        return None
+                    out = c if out is None else (out & c)
+                return out
+            if isinstance(e, Or):
+                out = None
+                for x in e.items:
+                    c = conv(x)
+                    if c is None:
+                        return None
+                    out = c if out is None else (out | c)
+                return out
+            return None
+
+        return conv(conj)
+
+
+def _binop(op, l, r):
+    if op == "+":
+        return l + r
+    if op == "-":
+        return l - r
+    if op == "*":
+        return l * r
+    if op == "/":
+        return l / r
+    if op == "||":
+        ls = l.astype("string") if isinstance(l, pd.Series) else str(l)
+        rs = r.astype("string") if isinstance(r, pd.Series) else str(r)
+        return ls + rs
+    raise DeltaError(f"unsupported operator {op!r}")
+
+
+def _coerce_datetime(l, r):
+    """Make string literals comparable to datetime64 columns."""
+    def is_dt(x):
+        return (isinstance(x, pd.Series)
+                and str(x.dtype).startswith("datetime64")) \
+            or isinstance(x, (pd.Timestamp, datetime.date))
+
+    if is_dt(l) and isinstance(r, str):
+        r = pd.Timestamp(r)
+    elif is_dt(r) and isinstance(l, str):
+        l = pd.Timestamp(l)
+    return l, r
+
+
+def _cmp(op, l, r):
+    l, r = _coerce_datetime(l, r)
+    if op == "=":
+        return l == r
+    if op == "<>":
+        return l != r
+    if op == "<":
+        return l < r
+    if op == "<=":
+        return l <= r
+    if op == ">":
+        return l > r
+    if op == ">=":
+        return l >= r
+    raise DeltaError(f"unsupported comparison {op!r}")
+
+
+def _cast(v, type_name):
+    if type_name == "date":
+        if isinstance(v, pd.Series):
+            return pd.to_datetime(v)
+        return pd.Timestamp(v)
+    if type_name in ("int", "integer", "bigint", "long", "smallint"):
+        if isinstance(v, pd.Series):
+            return v.astype("Int64")
+        return int(v)
+    if type_name in ("double", "float", "real"):
+        return v.astype(float) if isinstance(v, pd.Series) else float(v)
+    if type_name in ("string", "varchar", "char", "text"):
+        return v.astype("string") if isinstance(v, pd.Series) else str(v)
+    if type_name.startswith("decimal"):
+        return v.astype(float) if isinstance(v, pd.Series) else float(v)
+    raise DeltaError(f"unsupported CAST target {type_name!r}")

@@ -648,11 +648,27 @@ def test_select_having_without_group_rejected(tmp_table_path):
         sql(f"SELECT v FROM '{tmp_table_path}' HAVING v > 1")
 
 
-def test_select_right_join_rejected(star_tables):
+def test_select_right_and_full_join(star_tables, tmp_path):
     fact, dim = star_tables
-    with pytest.raises(DeltaError, match="RIGHT JOIN is not supported"):
-        sql(f"SELECT f.amount FROM '{fact}' f RIGHT JOIN '{dim}' s "
-            f"ON f.store_id = s.store_id")
+    # RIGHT JOIN keeps unmatched right rows null-extended
+    extra = str(tmp_path / "stores3")
+    dta.write_table(extra, pa.table({
+        "store_id": pa.array([1, 2, 99], pa.int64()),
+        "region": pa.array(["east", "east", "moon"]),
+    }))
+    out = sql(f"SELECT s.store_id, SUM(f.amount) AS rev "
+              f"FROM '{fact}' f RIGHT JOIN '{extra}' s "
+              f"ON f.store_id = s.store_id "
+              f"GROUP BY s.store_id ORDER BY store_id")
+    assert out.column("store_id").to_pylist() == [1, 2, 99]
+    assert out.column("rev").to_pylist()[-1] is None
+    # FULL OUTER keeps both sides
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    dta.write_table(a, pa.table({"id": pa.array([1, 2], pa.int64())}))
+    dta.write_table(b, pa.table({"id2": pa.array([2, 3], pa.int64())}))
+    out = sql(f"SELECT a.id, b.id2 FROM '{a}' a FULL OUTER JOIN '{b}' b "
+              f"ON a.id = b.id2 ORDER BY id")
+    assert out.num_rows == 3
 
 
 def test_table_changes_function(tmp_table_path):
