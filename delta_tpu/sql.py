@@ -555,7 +555,10 @@ def _needs_extended_select(s: str) -> bool:
     if re.search(r"\bJOIN\b|\bGROUP\s+BY\b|\bORDER\s+BY\b|\bHAVING\b"
                  r"|\b(?:COUNT|SUM|MIN|MAX|AVG|STDDEV_SAMP|VAR_SAMP)\s*\("
                  r"|\bCASE\b|\bEXISTS\b|\bBETWEEN\b|\bDISTINCT\b"
-                 r"|\bUNION\b|\(\s*SELECT\b|\bCAST\s*\(", up):
+                 r"|\bUNION\b|\(\s*SELECT\b|\bCAST\s*\("
+                 r"|\bNOT\s+(?:IN|LIKE|BETWEEN)\b|\bLIKE\b|\bIN\s*\("
+                 r"|\bINTERVAL\b|\bSUBSTR|\bCOALESCE\s*\(|\bCONCAT\s*\("
+                 r"|\|\|", up):
         return True
     # implicit comma join: a comma at FROM-list depth before any WHERE
     m = re.search(r"\bFROM\b(?P<rest>.*)$", up, re.DOTALL)

@@ -166,6 +166,27 @@ def _render(e) -> str:
     return type(e).__name__.lower()
 
 
+def _merge_null_safe(left: pd.DataFrame, right: pd.DataFrame, how: str,
+                     lk: List[str], rk: List[str]) -> pd.DataFrame:
+    """SQL join: NULL keys never match (pandas merge matches NaN/None
+    to each other). Rows with a null key are excluded from matching;
+    sides preserved by `how` get them re-appended null-extended."""
+    lnull = left[lk].isna().any(axis=1)
+    rnull = right[rk].isna().any(axis=1)
+    if not lnull.any() and not rnull.any():  # hot path: no copies
+        return left.merge(right, how=how, left_on=lk, right_on=rk)
+    merged = left[~lnull].merge(right[~rnull], how=how, left_on=lk,
+                                right_on=rk)
+    extra = []
+    if how in ("left", "outer") and lnull.any():
+        extra.append(left[lnull])
+    if how in ("right", "outer") and rnull.any():
+        extra.append(right[rnull])
+    if extra:
+        merged = pd.concat([merged] + extra, ignore_index=True)
+    return merged
+
+
 def _normalize_frame(df: pd.DataFrame) -> pd.DataFrame:
     """Post-to_pandas cleanup: date32 -> datetime64, Decimal -> float."""
     for c in df.columns:
@@ -377,8 +398,8 @@ class _Exec:
             a, keys = pick
             lk = [k for k, _ in keys]
             rk = [k for _, k in keys]
-            current = current.merge(by_alias[a]["frame"], how="inner",
-                                    left_on=lk, right_on=rk)
+            current = _merge_null_safe(current, by_alias[a]["frame"],
+                                       "inner", lk, rk)
             for (al, pl, ar, pr, c) in edges:
                 if {al, ar} <= joined | {a}:
                     consumed.add(id(c))
@@ -412,8 +433,7 @@ class _Exec:
                         "two sides")
                 lk.append(pl)
                 rk.append(pr)
-            current = current.merge(right, how=how, left_on=lk,
-                                    right_on=rk)
+            current = _merge_null_safe(current, right, how, lk, rk)
             joined.add(a)
 
         # ---- residual WHERE -------------------------------------------
@@ -445,8 +465,9 @@ class _Exec:
         for o, _ in sel.order_by:
             _walk_exprs(o, check_agg)
 
-        if sel.having is not None and not sel.group_by:
-            raise DeltaError("HAVING requires GROUP BY")
+        if sel.having is not None and not sel.group_by and not has_agg:
+            raise DeltaError(
+                "HAVING without GROUP BY requires an aggregate")
 
         alias_map = {it.alias: it.expr for it in sel.items if it.alias}
 
@@ -662,18 +683,20 @@ class _Exec:
             if isinstance(e, And):
                 out = None
                 for x in e.items:
-                    m = self._truth(self._eval_out(x, df, env, resolve))
+                    m = _as_kleene(
+                        self._eval_out(x, df, env, resolve), df.index)
                     out = m if out is None else (out & m)
                 return out
             if isinstance(e, Or):
                 out = None
                 for x in e.items:
-                    m = self._truth(self._eval_out(x, df, env, resolve))
+                    m = _as_kleene(
+                        self._eval_out(x, df, env, resolve), df.index)
                     out = m if out is None else (out | m)
                 return out
             if isinstance(e, Not):
-                return ~self._truth(self._eval_out(e.item, df, env,
-                                                   resolve))
+                return ~_as_kleene(
+                    self._eval_out(e.item, df, env, resolve), df.index)
             if isinstance(e, Func) and e.name in _AGGS:
                 # canon miss should not happen (collected above)
                 raise DeltaError(f"aggregate {e.name} not computed")
@@ -699,35 +722,40 @@ class _Exec:
         if isinstance(e, And):
             out = None
             for x in e.items:
-                m = self._truth(self._eval(x, df))
+                m = _as_kleene(self._eval(x, df), df.index)
                 out = m if out is None else (out & m)
             return out
         if isinstance(e, Or):
             out = None
             for x in e.items:
-                m = self._truth(self._eval(x, df))
+                m = _as_kleene(self._eval(x, df), df.index)
                 out = m if out is None else (out | m)
             return out
         if isinstance(e, Not):
-            return ~self._truth(self._eval(e.item, df))
+            return ~_as_kleene(self._eval(e.item, df), df.index)
         if isinstance(e, IsNull):
             s = self._eval(e.item, df)
-            isna = s.isna() if isinstance(s, pd.Series) else pd.isna(s)
-            return ~isna if e.negated else isna
+            if isinstance(s, pd.Series):
+                isna = s.isna()
+                return ~isna if e.negated else isna
+            isna = bool(pd.isna(s))
+            return (not isna) if e.negated else isna
         if isinstance(e, Between):
             v = self._eval(e.item, df)
             lo = self._eval(e.lo, df)
             hi = self._eval(e.hi, df)
-            m = _cmp(">=", v, lo) & _cmp("<=", v, hi)
-            return ~self._truth(m) if e.negated else m
+            m = _as_kleene(_cmp(">=", v, lo), df.index) \
+                & _as_kleene(_cmp("<=", v, hi), df.index)
+            return ~m if e.negated else m
         if isinstance(e, InList):
             v = self._eval(e.item, df)
             vals = [self._eval(x, df) for x in e.values]
-            if isinstance(v, pd.Series):
-                m = v.isin(vals)
-            else:
-                m = v in vals
-            return ~self._truth(m) if e.negated else m
+            has_null_val = any(not isinstance(x, pd.Series) and pd.isna(x)
+                               for x in vals)
+            vals = [x for x in vals
+                    if isinstance(x, pd.Series) or not pd.isna(x)]
+            m = _in_membership(v, vals, has_null_val, df.index)
+            return ~m if e.negated else m
         if isinstance(e, Like):
             import re as _re
 
@@ -735,7 +763,8 @@ class _Exec:
             pat = "^" + "".join(
                 ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
                 for ch in e.pattern) + "$"
-            m = s.str.match(pat, na=False)
+            m = _as_kleene(s.str.match(pat, na=False), df.index)
+            m = m.mask(s.isna(), pd.NA)
             return ~m if e.negated else m
         if isinstance(e, CaseWhen):
             conds = [np.asarray(self._truth(self._eval(c, df)))
@@ -775,10 +804,12 @@ class _Exec:
             out = execute_select(e.select, self.engine, self.catalog)
             if out.num_columns != 1:
                 raise DeltaError("IN subquery must return one column")
-            vals = set(out.column(0).to_pylist())
+            raw = out.column(0).to_pylist()
+            has_null = any(x is None for x in raw)
+            vals = set(x for x in raw if x is not None)
             v = self._eval(e.item, df)
-            m = v.isin(vals) if isinstance(v, pd.Series) else (v in vals)
-            return ~self._truth(m) if e.negated else m
+            m = _in_membership(v, vals, has_null, df.index)
+            return ~m if e.negated else m
         if isinstance(e, Exists):
             out = execute_select(e.select, self.engine, self.catalog)
             flag = out.num_rows > 0
@@ -843,12 +874,18 @@ class _Exec:
 
     @staticmethod
     def _truth(m):
-        """Null comparison results are false (SQL three-valued logic
-        collapsed at filter boundaries)."""
+        """Collapse SQL three-valued logic at a filter boundary:
+        NULL → False. Predicates propagate NULL through the tree
+        (Kleene, see _as_kleene); only WHERE/HAVING/CASE boundaries
+        collapse."""
         if isinstance(m, pd.Series):
-            if m.dtype == object or str(m.dtype) == "boolean":
+            if m.dtype == object or str(m.dtype) == "boolean" \
+                    or m.dtype.kind == "f":
                 return m.fillna(False).astype(bool)
             return m
+        if m is pd.NA or m is None or (isinstance(m, float)
+                                       and np.isnan(m)):
+            return False
         return bool(m)
 
     # -- pushdown helpers ------------------------------------------------
@@ -930,6 +967,61 @@ class _Exec:
         return conv(conj)
 
 
+def _as_kleene(x, index):
+    """Normalize a predicate value to pandas nullable-boolean so &, |
+    and ~ follow SQL three-valued (Kleene) logic; scalars broadcast.
+    Nulls stay NULL through the tree and collapse to False only at
+    filter boundaries (_truth)."""
+    if isinstance(x, pd.Series):
+        if str(x.dtype) == "boolean":
+            return x
+        return x.astype("boolean")
+    if x is None or x is pd.NA or (isinstance(x, float) and np.isnan(x)):
+        return pd.Series(pd.NA, index=index, dtype="boolean")
+    return pd.Series(bool(x), index=index, dtype="boolean")
+
+
+def _in_membership(v, vals, has_null, index):
+    """SQL IN membership with three-valued semantics: NULL item → NULL;
+    a NULL among the candidates means a non-match is NULL (nothing is
+    provably absent from a set containing NULL) — the NOT IN footgun."""
+    if isinstance(v, pd.Series):
+        m = v.isin(vals).astype("boolean")
+        m = m.mask(v.isna(), pd.NA)
+        if has_null:
+            m = m.mask(~m.fillna(False).astype(bool), pd.NA)
+    elif pd.isna(v):
+        m = pd.NA
+    else:
+        m = (v in vals) or (pd.NA if has_null else False)
+    return _as_kleene(m, index)
+
+
+def _with_nulls(res, *operands):
+    """Comparison result → nullable boolean with NULL wherever any
+    operand is NULL (numpy comparisons silently yield False for NaN ==
+    and True for NaN !=, both wrong under SQL semantics)."""
+    if isinstance(res, pd.Series):
+        out = res.astype("boolean")
+        mask = None
+        for o in operands:
+            if isinstance(o, pd.Series):
+                n = o.isna()
+                n.index = out.index
+            elif pd.isna(o):
+                n = pd.Series(True, index=out.index)
+            else:
+                continue
+            mask = n if mask is None else (mask | n)
+        if mask is not None and mask.any():
+            out = out.mask(mask.astype(bool), pd.NA)
+        return out
+    for o in operands:
+        if not isinstance(o, pd.Series) and pd.isna(o):
+            return pd.NA
+    return res
+
+
 def _binop(op, l, r):
     if op == "+":
         return l + r
@@ -963,18 +1055,20 @@ def _coerce_datetime(l, r):
 def _cmp(op, l, r):
     l, r = _coerce_datetime(l, r)
     if op == "=":
-        return l == r
-    if op == "<>":
-        return l != r
-    if op == "<":
-        return l < r
-    if op == "<=":
-        return l <= r
-    if op == ">":
-        return l > r
-    if op == ">=":
-        return l >= r
-    raise DeltaError(f"unsupported comparison {op!r}")
+        res = l == r
+    elif op == "<>":
+        res = l != r
+    elif op == "<":
+        res = l < r
+    elif op == "<=":
+        res = l <= r
+    elif op == ">":
+        res = l > r
+    elif op == ">=":
+        res = l >= r
+    else:
+        raise DeltaError(f"unsupported comparison {op!r}")
+    return _with_nulls(res, l, r)
 
 
 def _cast(v, type_name):

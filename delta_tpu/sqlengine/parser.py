@@ -428,7 +428,10 @@ class _P:
             tok = self.next()
             if tok.kind not in ("num", "str"):
                 raise DeltaError("TIMESTAMP AS OF expects a value")
-            tt_ts = tok.value
+            # preserve the literal kind: _timestamp_ms only treats a
+            # leading quote as "parse as ISO", so a bare ISO string
+            # would fall through to int() and crash
+            tt_ts = tok.value if tok.kind == "num" else f"'{tok.value}'"
         alias = self._opt_alias()
         return TableRef(kind, value, alias, tt_version, tt_ts)
 

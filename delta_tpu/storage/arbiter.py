@@ -77,9 +77,12 @@ class SqliteCommitArbiter(CommitArbiter):
     def _connect(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self.db_path, timeout=self.timeout_s)
         # WAL survives SIGKILL mid-transaction (auto-rollback on next
-        # open) and lets readers proceed under a writer
+        # open) and lets readers proceed under a writer. FULL (not
+        # NORMAL): an acknowledged conditional put is the commit
+        # arbiter's durability promise — it must survive power loss,
+        # not just process death, to match DynamoDB semantics
         conn.execute("PRAGMA journal_mode=WAL")
-        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute("PRAGMA synchronous=FULL")
         return conn
 
     def put_entry(self, entry: ExternalCommitEntry,

@@ -1,0 +1,202 @@
+"""SQL null-semantics regressions (round-3 advisor findings).
+
+The reference's engine (Spark SQL) implements full three-valued logic
+and null-rejecting join keys; these tests pin the same behavior in
+`delta_tpu.sqlengine` — null join keys never match, NULL propagates
+through NOT/IN/BETWEEN/LIKE/<> and collapses to False only at filter
+boundaries.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.errors import DeltaError
+from delta_tpu.sql import sql
+
+
+@pytest.fixture
+def nullkeys(tmp_path):
+    """Two tables whose join columns contain nulls; arrow nullable
+    int64 becomes float64+NaN in pandas, the exact shape that made
+    pandas merge match NULL==NULL."""
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    dta.write_table(a, pa.table({
+        "k": pa.array([1, 2, None, None], pa.int64()),
+        "av": pa.array([10, 20, 30, 40], pa.int64()),
+    }))
+    dta.write_table(b, pa.table({
+        "k2": pa.array([2, None, 3], pa.int64()),
+        "bv": pa.array([200, 300, 400], pa.int64()),
+    }))
+    return a, b
+
+
+def test_inner_join_null_keys_never_match(nullkeys):
+    a, b = nullkeys
+    out = sql(f"SELECT a.av, b.bv FROM '{a}' a JOIN '{b}' b "
+              f"ON a.k = b.k2")
+    assert out.column("av").to_pylist() == [20]
+    assert out.column("bv").to_pylist() == [200]
+
+
+def test_implicit_join_null_keys_never_match(nullkeys):
+    a, b = nullkeys
+    out = sql(f"SELECT a.av, b.bv FROM '{a}' a, '{b}' b "
+              f"WHERE a.k = b.k2")
+    assert out.column("av").to_pylist() == [20]
+
+
+def test_left_join_null_keys_null_extended(nullkeys):
+    a, b = nullkeys
+    out = sql(f"SELECT a.av, b.bv FROM '{a}' a LEFT JOIN '{b}' b "
+              f"ON a.k = b.k2 ORDER BY av")
+    assert out.column("av").to_pylist() == [10, 20, 30, 40]
+    # null-key left rows survive but never match the null-key right row
+    assert out.column("bv").to_pylist() == [None, 200, None, None]
+
+
+def test_full_outer_join_null_keys_both_sides(nullkeys):
+    a, b = nullkeys
+    out = sql(f"SELECT a.av, b.bv FROM '{a}' a FULL OUTER JOIN '{b}' b "
+              f"ON a.k = b.k2")
+    # 4 left rows (one matched) + 2 unmatched right rows (null-key b
+    # and k2=3) = 6
+    assert out.num_rows == 6
+    pairs = set(zip(out.column("av").to_pylist(),
+                    out.column("bv").to_pylist()))
+    assert (20, 200) in pairs
+    assert (None, 300) in pairs and (None, 400) in pairs
+
+
+def test_not_equals_excludes_nulls(tmp_table_path):
+    # <> on a float column: NaN != x is True in numpy, NULL in SQL
+    dta.write_table(tmp_table_path, pa.table({
+        "v": pa.array([1, 2, None], pa.int64()),
+    }))
+    out = sql(f"SELECT v FROM '{tmp_table_path}' WHERE v <> 1")
+    assert out.column("v").to_pylist() == [2]
+
+
+def test_not_in_excludes_nulls(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "v": pa.array([1, 2, None], pa.int64()),
+    }))
+    out = sql(f"SELECT v FROM '{tmp_table_path}' WHERE v NOT IN (1)")
+    assert out.column("v").to_pylist() == [2]
+
+
+def test_not_between_excludes_nulls(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "v": pa.array([1, 5, None], pa.int64()),
+    }))
+    out = sql(f"SELECT v FROM '{tmp_table_path}' "
+              f"WHERE v NOT BETWEEN 0 AND 2")
+    assert out.column("v").to_pylist() == [5]
+
+
+def test_not_like_excludes_nulls(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "s": pa.array(["apple", "banana", None]),
+    }))
+    out = sql(f"SELECT s FROM '{tmp_table_path}' "
+              f"WHERE s NOT LIKE 'a%'")
+    assert out.column("s").to_pylist() == ["banana"]
+
+
+def test_not_predicate_excludes_nulls(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "v": pa.array([1, 3, None], pa.int64()),
+    }))
+    out = sql(f"SELECT v FROM '{tmp_table_path}' WHERE NOT (v < 2)")
+    assert out.column("v").to_pylist() == [3]
+
+
+def test_kleene_or_null_recovers(tmp_table_path):
+    # NULL OR TRUE must be TRUE; NULL OR FALSE is NULL -> excluded
+    dta.write_table(tmp_table_path, pa.table({
+        "a": pa.array([None, None], pa.int64()),
+        "b": pa.array([7, 0], pa.int64()),
+    }))
+    out = sql(f"SELECT b FROM '{tmp_table_path}' "
+              f"WHERE a > 0 OR b = 7")
+    assert out.column("b").to_pylist() == [7]
+
+
+def test_not_and_with_null_kleene(tmp_table_path):
+    # NOT(a > 0 AND b = 7): row (NULL, 0) -> NOT(NULL AND FALSE) ->
+    # NOT(FALSE) -> TRUE; early collapse would also pass, but row
+    # (NULL, 7) -> NOT(NULL) -> NULL -> excluded
+    dta.write_table(tmp_table_path, pa.table({
+        "a": pa.array([None, None, 1], pa.int64()),
+        "b": pa.array([0, 7, 7], pa.int64()),
+    }))
+    out = sql(f"SELECT a, b FROM '{tmp_table_path}' "
+              f"WHERE NOT (a > 0 AND b = 7) ORDER BY b")
+    assert out.column("b").to_pylist() == [0]
+
+
+def test_not_in_subquery_with_null_matches_nothing(tmp_path):
+    # famous SQL footgun: NOT IN (subquery containing NULL) is never
+    # TRUE for any non-matching row
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    dta.write_table(a, pa.table({"v": pa.array([1, 9], pa.int64())}))
+    dta.write_table(b, pa.table({"w": pa.array([1, None], pa.int64())}))
+    out = sql(f"SELECT v FROM '{a}' WHERE v NOT IN "
+              f"(SELECT w FROM '{b}')")
+    assert out.num_rows == 0
+    # without the NULL the non-match comes back
+    c = str(tmp_path / "c")
+    dta.write_table(c, pa.table({"w": pa.array([1], pa.int64())}))
+    out = sql(f"SELECT v FROM '{a}' WHERE v NOT IN "
+              f"(SELECT w FROM '{c}')")
+    assert out.column("v").to_pylist() == [9]
+
+
+def test_timestamp_as_of_iso_string_select(tmp_table_path):
+    import datetime
+    import time
+
+    dta.write_table(tmp_table_path, pa.table(
+        {"v": pa.array([1], pa.int64())}))
+    time.sleep(0.05)
+    mid = datetime.datetime.now().isoformat()
+    time.sleep(0.05)
+    dta.write_table(tmp_table_path, pa.table(
+        {"v": pa.array([2], pa.int64())}), mode="append")
+    # ISO string between the two commits resolves to version 0; the
+    # bug was an uncaught ValueError from int('<iso>')
+    out = sql(f"SELECT v FROM '{tmp_table_path}' "
+              f"TIMESTAMP AS OF '{mid}' ORDER BY v")
+    assert out.column("v").to_pylist() == [1]
+
+
+def test_having_without_group_by_with_aggregate(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table(
+        {"v": pa.array([1, 2, 3], pa.int64())}))
+    out = sql(f"SELECT SUM(v) AS total FROM '{tmp_table_path}' "
+              f"HAVING SUM(v) > 5")
+    assert out.column("total").to_pylist() == [6]
+    out = sql(f"SELECT SUM(v) AS total FROM '{tmp_table_path}' "
+              f"HAVING SUM(v) > 100")
+    assert out.num_rows == 0
+    # still rejected with no aggregate anywhere
+    with pytest.raises(DeltaError, match="HAVING"):
+        sql(f"SELECT v FROM '{tmp_table_path}' HAVING v > 1")
+
+
+def test_arbiter_synchronous_full():
+    # acked conditional puts must be power-loss durable (advisor low)
+    import sqlite3
+    import tempfile
+
+    from delta_tpu.storage.arbiter import SqliteCommitArbiter
+
+    with tempfile.TemporaryDirectory() as d:
+        arb = SqliteCommitArbiter(d + "/arb.db")
+        conn = arb._connect()
+        assert conn.execute("PRAGMA synchronous").fetchone()[0] == 2
+        conn.close()
