@@ -1,0 +1,572 @@
+"""Seeded TPC-DS-schema data generator.
+
+Generates the 19 TPC-DS tables touched by the verbatim query corpus
+(`benchmarks/tpcds_queries.py`) as Arrow tables, with the column names
+and types of the TPC-DS v2.4 schema subset those queries reference.
+The reference loads dsdgen output (`benchmarks/src/main/scala/
+benchmark/TPCDSDataLoad.scala:71`); dsdgen is not redistributable, so
+this module plays its role with a seeded numpy generator whose value
+distributions are chosen so that **every filter constant in the query
+corpus matches rows** (e.g. `i_manufact_id = 816`, `d_moy = 11`,
+`cd_education_status = 'College'`, `s_store_name = 'ese'`,
+`d_month_seq between 1194 and 1205`).
+
+`scale` = number of store_sales rows; every other table is sized
+proportionally. Same seed + scale → identical data, so oracle results
+are reproducible.
+
+Facts contain NULLs (~2% of measure values, some nullable FKs) —
+TPC-DS data has them, and they exercise SQL null semantics in joins
+and aggregates.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+
+__all__ = ["generate", "load_delta", "TABLE_NAMES"]
+
+_CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Men",
+               "Women", "Music", "Shoes", "Sports", "Children"]
+# includes every i_class constant in the corpus (q53/q63/q89)
+_CLASSES = ["accent", "bedding", "classical", "dresses", "football",
+            "infants", "pants", "portable", "romance", "shirts",
+            "personal", "reference", "self-help", "accessories",
+            "fragrances", "pop", "home repair", "sports-apparel"]
+# q53/q63 brand IN-lists; generic Brand#N fills the rest
+_BRAND_POOL = ["scholaramalgamalg #14", "scholaramalgamalg #7",
+               "exportiunivamalg #9", "scholaramalgamalg #9",
+               "amalgimporto #1", "edu packscholar #1",
+               "exportiimporto #1", "importoamalg #1"]
+_EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+              "4 yr Degree", "Advanced Degree", "Unknown"]
+_MARITAL = ["M", "S", "D", "W", "U"]
+_BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
+                  "0-500", "Unknown"]
+_STORE_NAMES = ["ese", "ought", "able", "bar", "anti", "cally"]
+_SM_TYPES = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
+_STATES = ["CA", "WA", "GA", "TX", "NY", "FL", "OH", "MI", "IL", "VA"]
+_COUNTIES = ["Williamson County", "Ziebach County", "Walker County",
+             "Daviess County", "Fairfield County", "Barrow County",
+             "Franklin Parish", "Luce County", "Mobile County"]
+_CITIES = ["Midway", "Fairview", "Oakland", "Pleasant Hill", "Centerville",
+           "Five Points", "Liberty", "Bethel", "Summit"]
+_DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+              "Friday", "Saturday"]
+# pools guarantee the corpus' IN-list / equality constants exist
+_MANUFACT_POOL = [816, 928, 715, 942, 861, 941, 920, 105, 693]
+
+TABLE_NAMES = [
+    "date_dim", "time_dim", "item", "customer", "customer_address",
+    "customer_demographics", "household_demographics", "promotion",
+    "store", "warehouse", "ship_mode", "web_site", "web_page",
+    "call_center", "store_sales", "store_returns", "catalog_sales",
+    "web_sales", "inventory",
+]
+
+_BASE_DATE = datetime.date(1998, 1, 1)
+_N_DAYS = 5 * 366  # 1998-01-01 .. 2002-12-31 and a bit
+
+_DATE_SK0 = 2450000  # julian-ish offset like dsdgen's
+
+
+def _money(rng, n, lo=1.0, hi=300.0, null_frac=0.02):
+    v = np.round(rng.uniform(lo, hi, n), 2)
+    if null_frac:
+        v[rng.random(n) < null_frac] = np.nan
+    return pa.array(v)
+
+
+def _maybe_null_int(rng, vals, null_frac=0.02):
+    mask = rng.random(len(vals)) < null_frac
+    return pa.array(np.where(mask, None, vals), type=pa.int64(),
+                    from_pandas=True) if mask.any() else \
+        pa.array(vals.astype(np.int64))
+
+
+def _date_dim() -> pa.Table:
+    days = np.arange(_N_DAYS)
+    dates = [_BASE_DATE + datetime.timedelta(days=int(i)) for i in days]
+    years = np.array([d.year for d in dates], dtype=np.int64)
+    months = np.array([d.month for d in dates], dtype=np.int64)
+    return pa.table({
+        "d_date_sk": pa.array(_DATE_SK0 + days),
+        "d_date": pa.array(dates, type=pa.date32()),
+        "d_year": pa.array(years),
+        "d_moy": pa.array(months),
+        "d_dom": pa.array(np.array([d.day for d in dates], np.int64)),
+        "d_qoy": pa.array((months - 1) // 3 + 1),
+        # (year-1900)*12 + month-1: 1998-07=1182 .. 2002-09=1232 covers
+        # every d_month_seq window in the corpus (1186..1232)
+        "d_month_seq": pa.array((years - 1900) * 12 + months - 1),
+        "d_week_seq": pa.array((days // 7) + 5100),
+        "d_quarter_name": pa.array(
+            [f"{d.year}Q{(d.month - 1) // 3 + 1}" for d in dates]),
+        "d_day_name": pa.array(
+            [_DAY_NAMES[d.weekday() if d.weekday() != 6 else 6]
+             for d in dates]),
+        "d_dow": pa.array(np.array(
+            [(d.weekday() + 1) % 7 for d in dates], np.int64)),
+    })
+
+
+def _time_dim() -> pa.Table:
+    mins = np.arange(24 * 60)
+    return pa.table({
+        "t_time_sk": pa.array(mins * 60),  # sk = second of day
+        "t_hour": pa.array(mins // 60),
+        "t_minute": pa.array(mins % 60),
+    })
+
+
+def _item(rng, n_items) -> pa.Table:
+    sk = np.arange(1, n_items + 1)
+    manufact = np.where(
+        rng.random(n_items) < 0.3,
+        rng.choice(_MANUFACT_POOL, n_items),
+        rng.integers(1, 1000, n_items))
+    brand_id = rng.integers(1, 500, n_items)
+    cat_id = rng.integers(1, len(_CATEGORIES) + 1, n_items)
+    return pa.table({
+        "i_item_sk": pa.array(sk),
+        "i_item_id": pa.array([f"AAAAAAAA{j:08d}" for j in sk]),
+        "i_item_desc": pa.array([f"item description {j % 97}"
+                                 for j in sk]),
+        "i_brand_id": pa.array(brand_id),
+        "i_brand": pa.array(
+            [_BRAND_POOL[j % len(_BRAND_POOL)] if j % 4 == 0
+             else f"Brand#{b}"
+             for j, b in zip(sk, brand_id)]),
+        "i_class_id": pa.array(rng.integers(1, 11, n_items)),
+        "i_class": pa.array([_CLASSES[c] for c in
+                             rng.integers(0, len(_CLASSES), n_items)]),
+        "i_category_id": pa.array(cat_id),
+        "i_category": pa.array([_CATEGORIES[c - 1] for c in cat_id]),
+        "i_manufact_id": pa.array(manufact.astype(np.int64)),
+        "i_manufact": pa.array([f"manufact#{m}" for m in manufact]),
+        # deterministic cycle: every manager id 1..100 owns items, so
+        # the corpus' i_manager_id = 1/26/87 filters always match
+        "i_manager_id": pa.array((sk - 1) % 100 + 1),
+        "i_current_price": pa.array(
+            np.round(rng.uniform(1.0, 120.0, n_items), 2)),
+        "i_wholesale_cost": pa.array(
+            np.round(rng.uniform(1.0, 80.0, n_items), 2)),
+    })
+
+
+def _customer(rng, n_cust, n_addr) -> pa.Table:
+    sk = np.arange(1, n_cust + 1)
+    first = ["James", "Mary", "John", "Linda", "Robert", "Ann",
+             "Michael", "Susan"]
+    last = ["Smith", "Jones", "Brown", "Lee", "Garcia", "Miller",
+            "Davis", "Moore"]
+    return pa.table({
+        "c_customer_sk": pa.array(sk),
+        "c_customer_id": pa.array([f"CUST{j:012d}" for j in sk]),
+        "c_current_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, n_cust)),
+        "c_current_cdemo_sk": pa.array(rng.integers(1, 71, n_cust)),
+        "c_current_hdemo_sk": pa.array(rng.integers(1, 301, n_cust)),
+        "c_first_name": pa.array(
+            [first[i] for i in rng.integers(0, len(first), n_cust)]),
+        "c_last_name": pa.array(
+            [last[i] for i in rng.integers(0, len(last), n_cust)]),
+        "c_salutation": pa.array(
+            [["Mr.", "Ms.", "Dr."][i]
+             for i in rng.integers(0, 3, n_cust)]),
+        "c_preferred_cust_flag": pa.array(
+            [["Y", "N"][i] for i in rng.integers(0, 2, n_cust)]),
+        "c_birth_country": pa.array(
+            [["UNITED STATES", "CANADA", "MEXICO"][i]
+             for i in rng.integers(0, 3, n_cust)]),
+    })
+
+
+def _customer_address(rng, n_addr) -> pa.Table:
+    sk = np.arange(1, n_addr + 1)
+    zips = np.where(rng.random(n_addr) < 0.1,
+                    rng.choice([85669, 86197, 88274, 83405, 86475,
+                                85392, 85460, 80348, 81792], n_addr),
+                    rng.integers(10000, 99999, n_addr))
+    return pa.table({
+        "ca_address_sk": pa.array(sk),
+        "ca_zip": pa.array([f"{z:05d}" for z in zips]),
+        "ca_state": pa.array(
+            [_STATES[i] for i in rng.integers(0, len(_STATES), n_addr)]),
+        "ca_city": pa.array(
+            [_CITIES[i] for i in rng.integers(0, len(_CITIES), n_addr)]),
+        "ca_county": pa.array(
+            [_COUNTIES[i]
+             for i in rng.integers(0, len(_COUNTIES), n_addr)]),
+        "ca_country": pa.array(["United States"] * n_addr),
+    })
+
+
+def _customer_demographics() -> pa.Table:
+    rows = [(g, m, e)
+            for g in ("M", "F")
+            for m in _MARITAL
+            for e in _EDUCATION]
+    return pa.table({
+        "cd_demo_sk": pa.array(np.arange(1, len(rows) + 1)),
+        "cd_gender": pa.array([r[0] for r in rows]),
+        "cd_marital_status": pa.array([r[1] for r in rows]),
+        "cd_education_status": pa.array([r[2] for r in rows]),
+        "cd_dep_count": pa.array(
+            np.arange(len(rows), dtype=np.int64) % 7),
+    })
+
+
+def _household_demographics() -> pa.Table:
+    rows = [(d, v, b)
+            for d in range(10)
+            for v in range(5)
+            for b in _BUY_POTENTIAL]
+    return pa.table({
+        "hd_demo_sk": pa.array(np.arange(1, len(rows) + 1)),
+        "hd_dep_count": pa.array(np.array([r[0] for r in rows],
+                                          np.int64)),
+        "hd_vehicle_count": pa.array(np.array([r[1] for r in rows],
+                                              np.int64)),
+        "hd_buy_potential": pa.array([r[2] for r in rows]),
+    })
+
+
+def _promotion(rng) -> pa.Table:
+    n = 30
+    return pa.table({
+        "p_promo_sk": pa.array(np.arange(1, n + 1)),
+        "p_channel_email": pa.array(
+            [["N", "Y"][i] for i in rng.integers(0, 2, n)]),
+        "p_channel_event": pa.array(
+            [["N", "Y"][i] for i in rng.integers(0, 2, n)]),
+        "p_channel_dmail": pa.array(
+            [["N", "Y"][i] for i in rng.integers(0, 2, n)]),
+    })
+
+
+def _store(rng) -> pa.Table:
+    n = 12
+    sk = np.arange(1, n + 1)
+    return pa.table({
+        "s_store_sk": pa.array(sk),
+        "s_store_id": pa.array([f"STORE{j:010d}" for j in sk]),
+        "s_store_name": pa.array(
+            [_STORE_NAMES[j % len(_STORE_NAMES)] for j in sk]),
+        "s_gmt_offset": pa.array(
+            np.where(sk % 2 == 0, -6.0, -5.0)),
+        "s_zip": pa.array([f"{z:05d}" for z in
+                           rng.integers(10000, 99999, n)]),
+        "s_city": pa.array(
+            [_CITIES[i] for i in rng.integers(0, len(_CITIES), n)]),
+        "s_county": pa.array(
+            [_COUNTIES[i] for i in rng.integers(0, len(_COUNTIES), n)]),
+        "s_state": pa.array(
+            [_STATES[i] for i in rng.integers(0, len(_STATES), n)]),
+        "s_number_employees": pa.array(
+            rng.integers(200, 301, n).astype(np.int64)),
+        "s_company_id": pa.array(np.ones(n, np.int64)),
+        "s_company_name": pa.array(["Unknown"] * n),
+        "s_street_number": pa.array(
+            [str(z) for z in rng.integers(1, 1000, n)]),
+        "s_street_name": pa.array(
+            [["Main", "Oak", "Park", "First"][i]
+             for i in rng.integers(0, 4, n)]),
+        "s_street_type": pa.array(
+            [["St", "Ave", "Blvd", "Ln"][i]
+             for i in rng.integers(0, 4, n)]),
+        "s_suite_number": pa.array(
+            [f"Suite {z}" for z in rng.integers(0, 500, n)]),
+    })
+
+
+def _warehouse(rng) -> pa.Table:
+    n = 5
+    return pa.table({
+        "w_warehouse_sk": pa.array(np.arange(1, n + 1)),
+        "w_warehouse_name": pa.array(
+            [f"Warehouse number {j} of the chain" for j in range(n)]),
+    })
+
+
+def _ship_mode() -> pa.Table:
+    n = len(_SM_TYPES) * 4
+    return pa.table({
+        "sm_ship_mode_sk": pa.array(np.arange(1, n + 1)),
+        "sm_type": pa.array([_SM_TYPES[j % len(_SM_TYPES)]
+                             for j in range(n)]),
+    })
+
+
+def _web_site() -> pa.Table:
+    n = 6
+    return pa.table({
+        "web_site_sk": pa.array(np.arange(1, n + 1)),
+        "web_name": pa.array([f"site_{j}" for j in range(n)]),
+    })
+
+
+def _web_page(rng) -> pa.Table:
+    n = 60
+    return pa.table({
+        "wp_web_page_sk": pa.array(np.arange(1, n + 1)),
+        "wp_char_count": pa.array(
+            rng.integers(4000, 6000, n).astype(np.int64)),
+    })
+
+
+def _call_center() -> pa.Table:
+    n = 4
+    return pa.table({
+        "cc_call_center_sk": pa.array(np.arange(1, n + 1)),
+        "cc_name": pa.array([f"call center {j}" for j in range(n)]),
+        "cc_manager": pa.array([f"Manager {j}" for j in range(n)]),
+    })
+
+
+def generate(scale: int = 50_000, seed: int = 7):
+    """Return {table_name: pa.Table} for all 19 tables; `scale` =
+    store_sales row count."""
+    rng = np.random.default_rng(seed)
+    n_items = max(200, scale // 250)
+    n_cust = max(500, scale // 50)
+    n_addr = n_cust
+
+    tables = {
+        "date_dim": _date_dim(),
+        "time_dim": _time_dim(),
+        "item": _item(rng, n_items),
+        "customer": _customer(rng, n_cust, n_addr),
+        "customer_address": _customer_address(rng, n_addr),
+        "customer_demographics": _customer_demographics(),
+        "household_demographics": _household_demographics(),
+        "promotion": _promotion(rng),
+        "store": _store(rng),
+        "warehouse": _warehouse(rng),
+        "ship_mode": _ship_mode(),
+        "web_site": _web_site(),
+        "web_page": _web_page(rng),
+        "call_center": _call_center(),
+    }
+
+    n_cd = tables["customer_demographics"].num_rows
+    n_hd = tables["household_demographics"].num_rows
+    n_store = tables["store"].num_rows
+    n_wh = tables["warehouse"].num_rows
+    n_sm = tables["ship_mode"].num_rows
+    n_ws_site = tables["web_site"].num_rows
+    n_wp = tables["web_page"].num_rows
+    n_cc = tables["call_center"].num_rows
+    time_sks = tables["time_dim"].column("t_time_sk").to_numpy()
+
+    # ---- store_sales --------------------------------------------------
+    # ticket-structured: a ticket is one basket — same customer, store,
+    # date, time, demographics for all its line items (the reference's
+    # dsdgen does the same); ticket sizes 1..25 so the q34/q73
+    # `cnt between 15 and 20` shapes have matches
+    n = scale
+    t_sizes = rng.integers(1, 26, n)
+    ticket_of_row = np.repeat(np.arange(n), t_sizes)[:n]
+    n_tickets = int(ticket_of_row[-1]) + 1
+    t_day = rng.integers(0, _N_DAYS, n_tickets)
+    t_time = rng.choice(time_sks, n_tickets).astype(np.int64)
+    t_cust = rng.integers(1, n_cust + 1, n_tickets)
+    t_cdemo = rng.integers(1, n_cd + 1, n_tickets)
+    t_hdemo = rng.integers(1, n_hd + 1, n_tickets)
+    t_addr = rng.integers(1, n_addr + 1, n_tickets)
+    t_store = rng.integers(1, n_store + 1, n_tickets)
+    sold_day = t_day[ticket_of_row]
+    qty = rng.integers(1, 101, n).astype(np.int64)
+    sales_price = np.round(rng.uniform(1.0, 200.0, n), 2)
+    tables["store_sales"] = pa.table({
+        "ss_sold_date_sk": _maybe_null_int(rng, _DATE_SK0 + sold_day,
+                                           0.01),
+        "ss_sold_time_sk": pa.array(t_time[ticket_of_row]),
+        "ss_item_sk": pa.array(
+            rng.integers(1, n_items + 1, n).astype(np.int64)),
+        "ss_customer_sk": pa.array(
+            t_cust[ticket_of_row].astype(np.int64)),
+        "ss_cdemo_sk": pa.array(
+            t_cdemo[ticket_of_row].astype(np.int64)),
+        "ss_hdemo_sk": pa.array(
+            t_hdemo[ticket_of_row].astype(np.int64)),
+        "ss_addr_sk": pa.array(
+            t_addr[ticket_of_row].astype(np.int64)),
+        "ss_store_sk": pa.array(
+            t_store[ticket_of_row].astype(np.int64)),
+        "ss_promo_sk": _maybe_null_int(
+            rng, rng.integers(1, 31, n), 0.05),
+        "ss_ticket_number": pa.array(
+            (ticket_of_row + 1).astype(np.int64)),
+        "ss_quantity": pa.array(qty),
+        "ss_list_price": _money(rng, n, 1, 250),
+        "ss_sales_price": pa.array(sales_price),
+        "ss_ext_sales_price": _money(rng, n, 1, 2000),
+        "ss_ext_discount_amt": _money(rng, n, 0, 100),
+        "ss_ext_list_price": _money(rng, n, 1, 2500),
+        "ss_ext_wholesale_cost": _money(rng, n, 1, 1500),
+        "ss_ext_tax": _money(rng, n, 0, 150),
+        "ss_coupon_amt": _money(rng, n, 0, 50),
+        "ss_net_paid": _money(rng, n, 1, 2000),
+        "ss_net_profit": pa.array(
+            np.round(rng.uniform(-5000.0, 5000.0, n), 2)),
+        "ss_wholesale_cost": _money(rng, n, 1, 100),
+    })
+
+    # ---- store_returns (sampled from sales, so the
+    # customer+item+ticket join chain of q17/q25/q29/q50 has matches) --
+    nr = max(100, scale // 8)
+    ret_idx = rng.integers(0, n, nr)
+    ss_item = tables["store_sales"].column("ss_item_sk").to_numpy()
+    ret_day = np.minimum(sold_day[ret_idx] + rng.integers(1, 100, nr),
+                         _N_DAYS - 1)
+    tables["store_returns"] = pa.table({
+        "sr_returned_date_sk": pa.array(
+            (_DATE_SK0 + ret_day).astype(np.int64)),
+        "sr_item_sk": pa.array(ss_item[ret_idx]),
+        "sr_customer_sk": pa.array(
+            t_cust[ticket_of_row[ret_idx]].astype(np.int64)),
+        "sr_cdemo_sk": pa.array(
+            t_cdemo[ticket_of_row[ret_idx]].astype(np.int64)),
+        "sr_ticket_number": pa.array(
+            (ticket_of_row[ret_idx] + 1).astype(np.int64)),
+        "sr_return_quantity": pa.array(
+            rng.integers(1, 50, nr).astype(np.int64)),
+        "sr_return_amt": _money(rng, nr, 1, 500),
+        "sr_net_loss": _money(rng, nr, 1, 300),
+    })
+
+    # ---- catalog_sales ------------------------------------------------
+    nc = max(200, scale // 2)
+    c_sold = rng.integers(0, _N_DAYS, nc)
+    # ~40% of catalog orders come from customers re-buying a returned
+    # item: feeds the sr→cs leg of the q17/q25/q29 triple join
+    sr_cust = tables["store_returns"].column(
+        "sr_customer_sk").to_numpy()
+    sr_item = tables["store_returns"].column("sr_item_sk").to_numpy()
+    pick = rng.integers(0, nr, nc)
+    reuse = rng.random(nc) < 0.4
+    cs_cust = np.where(reuse, sr_cust[pick],
+                       rng.integers(1, n_cust + 1, nc))
+    cs_item = np.where(reuse, sr_item[pick],
+                       rng.integers(1, n_items + 1, nc))
+    tables["catalog_sales"] = pa.table({
+        "cs_sold_date_sk": _maybe_null_int(rng, _DATE_SK0 + c_sold,
+                                           0.01),
+        "cs_sold_time_sk": pa.array(
+            rng.choice(time_sks, nc).astype(np.int64)),
+        "cs_ship_date_sk": pa.array(
+            (_DATE_SK0 + np.minimum(c_sold + rng.integers(1, 140, nc),
+                                    _N_DAYS - 1)).astype(np.int64)),
+        "cs_item_sk": pa.array(cs_item.astype(np.int64)),
+        "cs_bill_customer_sk": pa.array(cs_cust.astype(np.int64)),
+        "cs_bill_cdemo_sk": pa.array(
+            rng.integers(1, n_cd + 1, nc).astype(np.int64)),
+        "cs_bill_hdemo_sk": pa.array(
+            rng.integers(1, n_hd + 1, nc).astype(np.int64)),
+        "cs_bill_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, nc).astype(np.int64)),
+        "cs_ship_customer_sk": pa.array(
+            rng.integers(1, n_cust + 1, nc).astype(np.int64)),
+        "cs_ship_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, nc).astype(np.int64)),
+        "cs_ship_mode_sk": pa.array(
+            rng.integers(1, n_sm + 1, nc).astype(np.int64)),
+        "cs_warehouse_sk": pa.array(
+            rng.integers(1, n_wh + 1, nc).astype(np.int64)),
+        "cs_call_center_sk": pa.array(
+            rng.integers(1, n_cc + 1, nc).astype(np.int64)),
+        "cs_promo_sk": _maybe_null_int(
+            rng, rng.integers(1, 31, nc), 0.05),
+        "cs_order_number": pa.array((np.arange(nc) // 2 + 1)),
+        "cs_quantity": pa.array(rng.integers(1, 101, nc).astype(
+            np.int64)),
+        "cs_list_price": _money(rng, nc, 1, 250),
+        "cs_sales_price": _money(rng, nc, 1, 600, null_frac=0.0),
+        "cs_ext_sales_price": _money(rng, nc, 1, 2000),
+        "cs_coupon_amt": _money(rng, nc, 0, 50),
+        "cs_net_profit": pa.array(
+            np.round(rng.uniform(-4000.0, 4000.0, nc), 2)),
+    })
+
+    # ---- web_sales ----------------------------------------------------
+    nw = max(200, scale // 2)
+    w_sold = rng.integers(0, _N_DAYS, nw)
+    tables["web_sales"] = pa.table({
+        "ws_sold_date_sk": _maybe_null_int(rng, _DATE_SK0 + w_sold,
+                                           0.01),
+        "ws_sold_time_sk": pa.array(
+            rng.choice(time_sks, nw).astype(np.int64)),
+        "ws_ship_date_sk": pa.array(
+            (_DATE_SK0 + np.minimum(w_sold + rng.integers(1, 140, nw),
+                                    _N_DAYS - 1)).astype(np.int64)),
+        "ws_item_sk": pa.array(
+            rng.integers(1, n_items + 1, nw).astype(np.int64)),
+        "ws_bill_customer_sk": pa.array(
+            rng.integers(1, n_cust + 1, nw).astype(np.int64)),
+        "ws_bill_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, nw).astype(np.int64)),
+        "ws_ship_customer_sk": pa.array(
+            rng.integers(1, n_cust + 1, nw).astype(np.int64)),
+        "ws_ship_hdemo_sk": pa.array(
+            rng.integers(1, n_hd + 1, nw).astype(np.int64)),
+        "ws_ship_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, nw).astype(np.int64)),
+        "ws_ship_mode_sk": pa.array(
+            rng.integers(1, n_sm + 1, nw).astype(np.int64)),
+        "ws_warehouse_sk": pa.array(
+            rng.integers(1, n_wh + 1, nw).astype(np.int64)),
+        "ws_web_site_sk": pa.array(
+            rng.integers(1, n_ws_site + 1, nw).astype(np.int64)),
+        "ws_web_page_sk": pa.array(
+            rng.integers(1, n_wp + 1, nw).astype(np.int64)),
+        "ws_promo_sk": _maybe_null_int(
+            rng, rng.integers(1, 31, nw), 0.05),
+        "ws_order_number": pa.array((np.arange(nw) // 2 + 1)),
+        "ws_quantity": pa.array(rng.integers(1, 101, nw).astype(
+            np.int64)),
+        "ws_list_price": _money(rng, nw, 1, 250),
+        "ws_sales_price": _money(rng, nw, 1, 600, null_frac=0.0),
+        "ws_ext_sales_price": _money(rng, nw, 1, 2000),
+        "ws_ext_ship_cost": _money(rng, nw, 0, 100),
+        "ws_net_profit": pa.array(
+            np.round(rng.uniform(-4000.0, 4000.0, nw), 2)),
+    })
+
+    # ---- inventory (weekly snapshots) ---------------------------------
+    weeks = np.arange(0, _N_DAYS, 7)
+    inv_items = np.arange(1, n_items + 1)
+    grid_d, grid_i = np.meshgrid(weeks, inv_items, indexing="ij")
+    ninv = grid_d.size
+    tables["inventory"] = pa.table({
+        "inv_date_sk": pa.array(_DATE_SK0 + grid_d.ravel()),
+        "inv_item_sk": pa.array(grid_i.ravel().astype(np.int64)),
+        "inv_warehouse_sk": pa.array(
+            rng.integers(1, n_wh + 1, ninv).astype(np.int64)),
+        "inv_quantity_on_hand": pa.array(
+            rng.integers(0, 1000, ninv).astype(np.int64)),
+    })
+
+    return tables
+
+
+def load_delta(root: str, scale: int = 50_000, seed: int = 7,
+               engine=None):
+    """Generate + write every table as a Delta table under `root`;
+    returns a `Catalog` with all names registered."""
+    import os
+
+    import delta_tpu.api as dta
+    from delta_tpu.catalog import Catalog
+
+    tables = generate(scale, seed)
+    cat = Catalog(root, engine=engine)
+    for name, tbl in tables.items():
+        path = os.path.join(root, name)
+        dta.write_table(path, tbl, engine=engine)
+        if not cat.exists(name):
+            cat.register(name, path)
+    return cat

@@ -558,7 +558,7 @@ def _needs_extended_select(s: str) -> bool:
                  r"|\bUNION\b|\(\s*SELECT\b|\bCAST\s*\("
                  r"|\bNOT\s+(?:IN|LIKE|BETWEEN)\b|\bLIKE\b|\bIN\s*\("
                  r"|\bINTERVAL\b|\bSUBSTR|\bCOALESCE\s*\(|\bCONCAT\s*\("
-                 r"|\|\|", up):
+                 r"|\|\||\bOVER\s*\(", up):
         return True
     # implicit comma join: a comma at FROM-list depth before any WHERE
     m = re.search(r"\bFROM\b(?P<rest>.*)$", up, re.DOTALL)

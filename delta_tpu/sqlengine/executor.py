@@ -36,7 +36,8 @@ from delta_tpu.errors import DeltaError
 from delta_tpu.sqlengine.parser import (
     And, Between, BinOp, CaseWhen, Cast, Cmp, Col, Exists, Func, InList,
     InSelect, Interval, IsNull, JoinClause, Like, Lit, Neg, Not, Or,
-    ScalarSelect, Select, SelectItem, Star, TableRef, parse_select,
+    ScalarSelect, Select, SelectItem, Star, TableRef, Window,
+    parse_select,
 )
 
 _AGGS = {"count", "sum", "min", "max", "avg", "stddev_samp", "var_samp"}
@@ -103,6 +104,11 @@ def _canon(e, resolve) -> str:
         return f"cast({_canon(e.item, resolve)} as {e.type_name})"
     if isinstance(e, Interval):
         return f"interval:{e.n}:{e.unit}"
+    if isinstance(e, Window):
+        parts = ",".join(_canon(p, resolve) for p in e.partition_by)
+        orders = ",".join(f"{_canon(o, resolve)}:{a}"
+                          for o, a in e.order_by)
+        return f"win({_canon(e.func, resolve)};part={parts};ord={orders})"
     if isinstance(e, (InSelect, Exists, ScalarSelect)):
         return f"subquery:{id(e)}"
     raise DeltaError(f"cannot canonicalize {type(e).__name__}")
@@ -148,6 +154,16 @@ def _walk_exprs(e, fn):
             _walk_exprs(v, fn)
     elif isinstance(e, InSelect):
         _walk_exprs(e.item, fn)
+    elif isinstance(e, Window):
+        # visit the window func's ARGS (not the func itself: an outer
+        # avg in `avg(sum(x)) over ...` is not a row aggregate, but
+        # its sum(x) argument is) plus partition/order expressions
+        for a in e.func.args:
+            _walk_exprs(a, fn)
+        for p in e.partition_by:
+            _walk_exprs(p, fn)
+        for o, _ in e.order_by:
+            _walk_exprs(o, fn)
 
 
 def _render(e) -> str:
@@ -363,17 +379,44 @@ class _Exec:
         # equi-edges from WHERE (implicit joins only)
         edges = []   # (alias_a, col_a, alias_b, col_b, conj)
         consumed = set()
-        for conj in conjuncts:
-            if (isinstance(conj, Cmp) and conj.op == "="
-                    and isinstance(conj.left, Col)
-                    and isinstance(conj.right, Col)):
+        def _col_eq(c):
+            if (isinstance(c, Cmp) and c.op == "="
+                    and isinstance(c.left, Col)
+                    and isinstance(c.right, Col)):
                 try:
-                    pa_, pb_ = resolve(conj.left), resolve(conj.right)
+                    return resolve(c.left), resolve(c.right)
                 except DeltaError:
-                    continue
+                    return None
+            return None
+
+        for conj in conjuncts:
+            eq = _col_eq(conj)
+            if eq:
+                pa_, pb_ = eq
                 aa, ab = pa_.split(".", 1)[0], pb_.split(".", 1)[0]
                 if aa != ab:
                     edges.append((aa, pa_, ab, pb_, conj))
+            elif isinstance(conj, Or):
+                # factor join equalities common to EVERY branch of an
+                # OR (TPC-DS q48 style: each branch repeats
+                # `cd_demo_sk = ss_cdemo_sk`); the OR itself stays in
+                # the residual filter, but the implied equality is a
+                # valid equi-join edge — without it the planner falls
+                # back to an exploding cross join
+                branch_sets = []
+                for br in conj.items:
+                    eqs = set()
+                    for c in _split_and(br):
+                        e2 = _col_eq(c)
+                        if e2:
+                            eqs.add(tuple(sorted(e2)))
+                    branch_sets.append(eqs)
+                for pa_, pb_ in set.intersection(*branch_sets) \
+                        if branch_sets else ():
+                    aa = pa_.split(".", 1)[0]
+                    ab = pb_.split(".", 1)[0]
+                    if aa != ab:
+                        edges.append((aa, pa_, ab, pb_, None))
 
         first_alias = sources[0]["alias"]
         current = by_alias[first_alias]["frame"]
@@ -401,7 +444,7 @@ class _Exec:
             current = _merge_null_safe(current, by_alias[a]["frame"],
                                        "inner", lk, rk)
             for (al, pl, ar, pr, c) in edges:
-                if {al, ar} <= joined | {a}:
+                if c is not None and {al, ar} <= joined | {a}:
                     consumed.add(id(c))
             joined.add(a)
             remaining.remove(a)
@@ -697,6 +740,26 @@ class _Exec:
             if isinstance(e, Not):
                 return ~_as_kleene(
                     self._eval_out(e.item, df, env, resolve), df.index)
+            if isinstance(e, CaseWhen):
+                conds = [np.asarray(self._truth(
+                    self._eval_out(c, df, env, resolve)))
+                    for c, _ in e.whens]
+                vals = [self._eval_out(v, df, env, resolve)
+                        for _, v in e.whens]
+                default = self._eval_out(e.else_, df, env, resolve) \
+                    if e.else_ is not None else None
+                return _case_from_values(conds, vals, default, len(df),
+                                         df.index)
+            if isinstance(e, Neg):
+                return -self._eval_out(e.item, df, env, resolve)
+            if isinstance(e, Window):
+                return self._window_eval(
+                    e, df, lambda x: self._eval_out(x, df, env, resolve))
+            if isinstance(e, Func) and e.name not in _AGGS:
+                # scalar function over aggregated values (abs, round…)
+                return self._apply_func(
+                    e, [self._eval_out(a, df, env, resolve)
+                        for a in e.args], df)
             if isinstance(e, Func) and e.name in _AGGS:
                 # canon miss should not happen (collected above)
                 raise DeltaError(f"aggregate {e.name} not computed")
@@ -772,20 +835,11 @@ class _Exec:
             vals = [self._eval(v, df) for _, v in e.whens]
             default = self._eval(e.else_, df) if e.else_ is not None \
                 else None
-            n = len(df)
-            vals = [v.values if isinstance(v, pd.Series)
-                    else np.full(n, v, dtype=object if isinstance(v, str)
-                                 else None) for v in vals]
-            if isinstance(default, pd.Series):
-                default = default.values
-            elif default is None:
-                default = np.full(n, np.nan)
-            else:
-                default = np.full(
-                    n, default,
-                    dtype=object if isinstance(default, str) else None)
-            out = np.select(conds, vals, default)
-            return pd.Series(out, index=df.index)
+            return _case_from_values(conds, vals, default, len(df),
+                                     df.index)
+        if isinstance(e, Window):
+            return self._window_eval(e, df,
+                                     lambda x: self._eval(x, df))
         if isinstance(e, Cast):
             v = self._eval(e.item, df)
             return _cast(v, e.type_name)
@@ -826,7 +880,127 @@ class _Exec:
         raise DeltaError(f"unsupported expression {type(e).__name__}")
 
     def _scalar_func(self, e: Func, df):
-        args = [self._eval(a, df) for a in e.args]
+        return self._apply_func(e, [self._eval(a, df) for a in e.args],
+                                df)
+
+    def _window_eval(self, e: Window, df, ev):
+        """Evaluate a window function over `df`; `ev` evaluates
+        sub-expressions in the caller's environment (row or post-agg).
+        sum/avg/min/max/count transform within partitions; rank and
+        row_number additionally use the ORDER BY clause."""
+        name = e.func.name
+        if e.func.distinct:
+            raise DeltaError(
+                f"DISTINCT inside window function {name} is not "
+                "supported")
+        parts = [ev(p) for p in e.partition_by]
+        parts = [p if isinstance(p, pd.Series)
+                 else pd.Series([p] * len(df), index=df.index)
+                 for p in parts]
+        if name in ("sum", "avg", "min", "max", "count"):
+            if e.func.star:
+                s = pd.Series(1, index=df.index)
+                fn = "sum"
+            else:
+                s = ev(e.func.args[0])
+                if not isinstance(s, pd.Series):
+                    s = pd.Series([s] * len(df), index=df.index)
+                fn = {"avg": "mean"}.get(name, name)
+            if e.order_by:
+                # SQL default frame with ORDER BY: RANGE UNBOUNDED
+                # PRECEDING..CURRENT ROW — a running aggregate where
+                # order-key peers share the value at their last row
+                return self._running_window(e, df, ev, s, fn, parts)
+            if not parts:
+                # whole-frame window
+                if fn == "count":
+                    val = s.count()
+                else:
+                    val = getattr(s, fn)()
+                return pd.Series([val] * len(df), index=df.index)
+            grouped = s.groupby([p.values for p in parts], dropna=False)
+            return pd.Series(grouped.transform(fn).values,
+                             index=df.index)
+        if name in ("rank", "row_number", "dense_rank"):
+            if not e.order_by:
+                raise DeltaError(f"{name}() requires ORDER BY")
+            work = pd.DataFrame(index=pd.RangeIndex(len(df)))
+            pcols, ocols, ascs = [], [], []
+            for i, p in enumerate(parts):
+                work[f"__p{i}"] = p.values
+                pcols.append(f"__p{i}")
+            for i, (o, asc) in enumerate(e.order_by):
+                s = ev(o)
+                work[f"__o{i}"] = s.values if isinstance(s, pd.Series) \
+                    else s
+                ocols.append(f"__o{i}")
+                ascs.append(asc)
+            order = work.sort_values(ocols, ascending=ascs,
+                                     kind="mergesort")
+            if pcols:
+                pos = order.groupby(pcols, dropna=False,
+                                    sort=False).cumcount() + 1
+            else:
+                pos = pd.Series(np.arange(1, len(order) + 1),
+                                index=order.index)
+            if name == "row_number":
+                ranks = pos
+            elif name == "rank":
+                # min position among equal order keys
+                order2 = order.assign(__pos=pos)
+                ranks = order2.groupby(pcols + ocols, dropna=False,
+                                       sort=False)["__pos"] \
+                    .transform("min")
+            else:  # dense_rank: count of distinct keys before + 1
+                order2 = order
+                key_first = order2.groupby(
+                    pcols + ocols, dropna=False,
+                    sort=False).cumcount() == 0
+                dr = key_first.groupby(
+                    [order2[c] for c in pcols] if pcols else
+                    np.zeros(len(order2), np.int8),
+                    dropna=False).cumsum()
+                ranks = dr.groupby(
+                    [order2[c] for c in (pcols + ocols)],
+                    dropna=False).transform("max")
+            out = ranks.sort_index()
+            return pd.Series(out.values, index=df.index)
+        raise DeltaError(f"unsupported window function {name!r}")
+
+    @staticmethod
+    def _running_window(e: Window, df, ev, s, fn, parts):
+        work = pd.DataFrame(index=pd.RangeIndex(len(df)))
+        pcols, ocols, ascs = [], [], []
+        for i, p in enumerate(parts):
+            work[f"__p{i}"] = p.values
+            pcols.append(f"__p{i}")
+        for i, (o, asc) in enumerate(e.order_by):
+            ov = ev(o)
+            work[f"__o{i}"] = ov.values if isinstance(ov, pd.Series) \
+                else ov
+            ocols.append(f"__o{i}")
+            ascs.append(asc)
+        work["__v"] = s.values
+        order = work.sort_values(ocols, ascending=ascs,
+                                 kind="mergesort")
+        expand = {"sum": lambda x: x.expanding().sum(),
+                  "mean": lambda x: x.expanding().mean(),
+                  "min": lambda x: x.expanding().min(),
+                  "max": lambda x: x.expanding().max(),
+                  "count": lambda x: x.expanding().count()}[fn]
+        if pcols:
+            cum = order.groupby(pcols, dropna=False, sort=False)[
+                "__v"].transform(expand)
+        else:
+            cum = expand(order["__v"])
+        # RANGE frame: peers (equal order keys) share the value at
+        # the last peer row
+        order = order.assign(__cum=cum.values)
+        peers = order.groupby(pcols + ocols, dropna=False,
+                              sort=False)["__cum"].transform("last")
+        return pd.Series(peers.sort_index().values, index=df.index)
+
+    def _apply_func(self, e: Func, args, df):
         name = e.name
         if name in ("substr", "substring"):
             s, start, length = args[0], int(args[1]), int(args[2]) \
@@ -965,6 +1139,23 @@ class _Exec:
             return None
 
         return conv(conj)
+
+
+def _case_from_values(conds, vals, default, n, index):
+    """np.select over pre-evaluated CASE WHEN branches."""
+    vals = [v.values if isinstance(v, pd.Series)
+            else np.full(n, v, dtype=object if isinstance(v, str)
+                         else None) for v in vals]
+    if isinstance(default, pd.Series):
+        default = default.values
+    elif default is None:
+        default = np.full(n, np.nan)
+    else:
+        default = np.full(
+            n, default,
+            dtype=object if isinstance(default, str) else None)
+    out = np.select(conds, vals, default)
+    return pd.Series(out, index=index)
 
 
 def _as_kleene(x, index):

@@ -80,6 +80,16 @@ class Func:
 
 
 @dataclass(frozen=True)
+class Window:
+    """`func(...) OVER (PARTITION BY ... [ORDER BY ...])` — the
+    windowed-aggregate surface of the TPC-DS corpus (q12/q20/q98
+    revenue ratios, q53/q63/q89 partition averages, rank/row_number)."""
+    func: "Func"
+    partition_by: Tuple[object, ...] = ()
+    order_by: Tuple[Tuple[object, bool], ...] = ()  # (expr, asc)
+
+
+@dataclass(frozen=True)
 class CaseWhen:
     whens: Tuple[Tuple[object, object], ...]  # (condition, value)
     else_: object = None
@@ -207,7 +217,7 @@ KEYWORDS = {
     "CROSS", "ON", "AS", "AND", "OR", "NOT", "IN", "EXISTS", "BETWEEN",
     "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END", "CAST",
     "INTERVAL", "ASC", "DESC", "VERSION", "TIMESTAMP", "OF", "UNION",
-    "TRUE", "FALSE",
+    "TRUE", "FALSE", "OVER", "PARTITION",
 }
 
 
@@ -632,14 +642,18 @@ class _P:
                 distinct = bool(self.accept_kw("DISTINCT"))
                 if self.accept_op("*"):
                     self.expect_op(")")
-                    return Func(name, (), distinct=distinct, star=True)
-                if self.accept_op(")"):
-                    return Func(name, ())
-                args = [self._expr()]
-                while self.accept_op(","):
-                    args.append(self._expr())
-                self.expect_op(")")
-                return Func(name, tuple(args), distinct=distinct)
+                    f = Func(name, (), distinct=distinct, star=True)
+                elif self.accept_op(")"):
+                    f = Func(name, ())
+                else:
+                    args = [self._expr()]
+                    while self.accept_op(","):
+                        args.append(self._expr())
+                    self.expect_op(")")
+                    f = Func(name, tuple(args), distinct=distinct)
+                if self.peek().is_kw("OVER"):
+                    return self._window(f)
+                return f
             parts = [self._ident_token().value]
             while (self.peek().kind == "op" and self.peek().value == "."
                    and self.peek(1).kind in ("ident", "bstr")):
@@ -710,3 +724,32 @@ def _parse_case(self: _P) -> object:
 
 
 _P._case = _parse_case
+
+
+def _parse_window(self: _P, f: Func) -> Window:
+    self.expect_kw("OVER")
+    self.expect_op("(")
+    part: list = []
+    order: list = []
+    if self.accept_kw("PARTITION"):
+        self.expect_kw("BY")
+        part.append(self._expr())
+        while self.accept_op(","):
+            part.append(self._expr())
+    if self.accept_kw("ORDER"):
+        self.expect_kw("BY")
+        while True:
+            e = self._expr()
+            asc = True
+            if self.accept_kw("DESC"):
+                asc = False
+            else:
+                self.accept_kw("ASC")
+            order.append((e, asc))
+            if not self.accept_op(","):
+                break
+    self.expect_op(")")
+    return Window(f, tuple(part), tuple(order))
+
+
+_P._window = _parse_window

@@ -1,0 +1,85 @@
+"""Window-function semantics (round-4 sqlengine surface).
+
+Partition-only aggregates, the SQL default running RANGE frame when
+ORDER BY is present, rank/row_number/dense_rank, and windows over
+aggregated results (the TPC-DS q12/q53/q98 shapes — those queries are
+oracle-validated end-to-end in test_tpcds.py; these pin the primitive
+semantics)."""
+
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.errors import DeltaError
+from delta_tpu.sql import sql
+
+
+@pytest.fixture
+def path(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "g": pa.array(["a", "a", "a", "b", "b"]),
+        "o": pa.array([1, 2, 2, 1, 2], pa.int64()),
+        "v": pa.array([10.0, 20.0, 30.0, 5.0, 7.0]),
+    }))
+    return tmp_table_path
+
+
+def test_partition_aggregate(path):
+    out = sql(f"SELECT g, v, sum(v) OVER (PARTITION BY g) t "
+              f"FROM '{path}' ORDER BY g, o, v")
+    assert out.column("t").to_pylist() == [60.0, 60.0, 60.0, 12.0, 12.0]
+
+
+def test_whole_frame_window(path):
+    out = sql(f"SELECT v, avg(v) OVER () a FROM '{path}' ORDER BY v")
+    assert out.column("a").to_pylist() == [14.4] * 5
+
+
+def test_running_sum_range_frame(path):
+    # ORDER BY without explicit frame = RANGE UNBOUNDED..CURRENT ROW:
+    # order-key peers share the value at their last peer row
+    out = sql(f"SELECT o, sum(v) OVER (PARTITION BY g ORDER BY o) c "
+              f"FROM '{path}' ORDER BY g, o, v")
+    assert out.column("c").to_pylist() == [10.0, 60.0, 60.0, 5.0, 12.0]
+
+
+def test_rank_and_row_number(path):
+    out = sql(f"SELECT g, v, "
+              f"rank() OVER (PARTITION BY g ORDER BY v DESC) r "
+              f"FROM '{path}' ORDER BY g, v")
+    assert out.column("r").to_pylist() == [3, 2, 1, 2, 1]
+    out = sql(f"SELECT o, row_number() OVER (ORDER BY o) rn "
+              f"FROM '{path}' WHERE g = 'a' ORDER BY o, rn")
+    assert out.column("rn").to_pylist() == [1, 2, 3]
+
+
+def test_rank_ties_share_min_position(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "v": pa.array([1, 2, 2, 3], pa.int64()),
+    }))
+    out = sql(f"SELECT v, rank() OVER (ORDER BY v) r, "
+              f"dense_rank() OVER (ORDER BY v) d "
+              f"FROM '{tmp_table_path}' ORDER BY v")
+    assert out.column("r").to_pylist() == [1, 2, 2, 4]
+    assert out.column("d").to_pylist() == [1, 2, 2, 3]
+
+
+def test_window_over_aggregate(path):
+    # q12/q98 shape: sum(sum(x)) over (partition by ...)
+    out = sql(f"SELECT g, o, sum(v) s, "
+              f"sum(v)*100/sum(sum(v)) OVER (PARTITION BY g) pct "
+              f"FROM '{path}' GROUP BY g, o ORDER BY g, o")
+    pct = out.column("pct").to_pylist()
+    assert pct[0] == pytest.approx(100 * 10 / 60)
+    assert pct[1] == pytest.approx(100 * 50 / 60)
+
+
+def test_distinct_in_window_rejected(path):
+    with pytest.raises(DeltaError, match="DISTINCT"):
+        sql(f"SELECT count(DISTINCT v) OVER (PARTITION BY g) "
+            f"FROM '{path}'")
+
+
+def test_window_rank_requires_order(path):
+    with pytest.raises(DeltaError, match="ORDER BY"):
+        sql(f"SELECT rank() OVER (PARTITION BY g) FROM '{path}'")

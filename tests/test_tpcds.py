@@ -1,0 +1,85 @@
+"""TPC-DS conformance: every verbatim query in the corpus executes
+through `delta_tpu.sqlengine` against Delta tables and matches an
+independent sqlite oracle (shared-nothing implementation) on seeded
+generated data.
+
+This is the proof artifact for the reference's query-integration role
+(`benchmarks/src/main/scala/benchmark/TPCDSBenchmark.scala:74`,
+`TPCDSBenchmarkQueries.scala:104`): the engine side always runs the
+UNMODIFIED query text. Oracle comparison strips the trailing
+`LIMIT n` from BOTH sides — ORDER BY ties at the cutoff are
+engine-dependent, and comparing the full result set is strictly
+stronger — while `test_verbatim_texts_execute` runs the texts exactly
+as shipped.
+"""
+
+import os
+import re
+
+import pytest
+
+from benchmarks.tpcds_data import generate, load_delta
+from benchmarks.tpcds_queries import QUERIES
+from delta_tpu.sqlengine import execute_select
+from tests.tpcds_sqlite_oracle import SqliteOracle, rows_equal
+
+SCALE = int(os.environ.get("TPCDS_TEST_SCALE", "12000"))
+
+
+def _strip_limit(q: str) -> str:
+    return re.sub(r"\blimit\s+\d+\s*$", "", q.strip(),
+                  flags=re.IGNORECASE)
+
+
+@pytest.fixture(scope="session")
+def tpcds(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpcds"))
+    tables = generate(SCALE)
+    catalog = load_delta(root, scale=SCALE)
+    oracle = SqliteOracle(tables)
+    return catalog, oracle
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_query_matches_oracle(tpcds, name):
+    catalog, oracle = tpcds
+    q = _strip_limit(QUERIES[name])
+    out = execute_select(q, catalog=catalog)
+    engine_rows = [tuple(r.values()) for r in out.to_pylist()]
+    oracle_rows = oracle.run(q)
+    ok, msg = rows_equal(engine_rows, oracle_rows)
+    assert ok, f"{name}: {msg}"
+
+
+def test_verbatim_texts_execute(tpcds):
+    """Every query runs EXACTLY as shipped (limit included) and
+    respects its LIMIT."""
+    catalog, _ = tpcds
+    for name, q in QUERIES.items():
+        out = execute_select(q, catalog=catalog)
+        m = re.search(r"\blimit\s+(\d+)\s*$", q.strip(),
+                      flags=re.IGNORECASE)
+        if m:
+            assert out.num_rows <= int(m.group(1)), name
+
+
+def test_corpus_filters_match_rows(tpcds):
+    """The generator is tuned so the corpus' filter constants hit
+    rows: the vast majority of queries must return a non-empty
+    result (an all-empty corpus would vacuously 'pass' the oracle)."""
+    catalog, _ = tpcds
+    nonempty = 0
+    empty = []
+    for name, q in QUERIES.items():
+        out = execute_select(_strip_limit(q), catalog=catalog)
+        if out.num_rows:
+            nonempty += 1
+        else:
+            empty.append(name)
+    assert nonempty >= len(QUERIES) - 4, f"empty results: {empty}"
+
+
+def test_corpus_size():
+    """Corpus growth guard: ≥33 verbatim queries (12 from round 3 +
+    window/subquery shapes added in round 4)."""
+    assert len(QUERIES) >= 33

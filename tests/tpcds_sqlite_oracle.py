@@ -1,0 +1,135 @@
+"""Independent TPC-DS oracle backed by sqlite3.
+
+Validates `delta_tpu.sqlengine` query results against sqlite — a
+fully independent SQL implementation (different parser, planner,
+executor; shares zero code with this repo). Plays the role of the
+reference's cross-engine conformance checks (golden tables read by
+kernel + spark + standalone).
+
+sqlite can't run the verbatim texts directly in two spots, so the
+oracle applies *mechanical* rewrites before execution (the sqlengine
+side always runs the verbatim text):
+
+- `cast('X' as date) + interval N days` → `date('X','+N days')` and
+  bare `cast('X' as date)` → `'X'`: dates are loaded into sqlite as
+  ISO strings, which compare correctly lexicographically.
+- `stddev_samp(x)` → a sum-of-squares expansion (sqlite has no
+  stddev aggregate).
+
+Result comparison is order-insensitive (sorted rows) with float
+tolerance; ORDER BY ... LIMIT cutoffs at tie boundaries are engine-
+dependent, so callers compare on limit-stripped texts.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+import sqlite3
+
+import pyarrow as pa
+
+__all__ = ["SqliteOracle", "rows_equal"]
+
+
+def _rewrite(q: str) -> str:
+    q = re.sub(
+        r"\(\s*cast\s*\(\s*'([0-9-]+)'\s+as\s+date\s*\)\s*\+\s*"
+        r"interval\s+(\d+)\s+days?\s*\)",
+        r"date('\1','+\2 days')", q, flags=re.IGNORECASE)
+    q = re.sub(r"cast\s*\(\s*'([0-9-]+)'\s+as\s+date\s*\)", r"'\1'",
+               q, flags=re.IGNORECASE)
+    # 1.0* factors force REAL arithmetic — sqlite would otherwise do
+    # integer division inside the sum-of-squares expansion
+    q = re.sub(
+        r"stddev_samp\s*\(\s*([a-z_][a-z0-9_.]*)\s*\)",
+        r"(case when count(\1) > 1 then sqrt(max(0.0,"
+        r"(1.0*sum(1.0*\1*\1) - 1.0*sum(\1)*sum(\1)/count(\1))"
+        r"/(count(\1)-1))) else null end)",
+        q, flags=re.IGNORECASE)
+    # CAST(x AS decimal(p,s)) keeps INTEGER affinity in sqlite, making
+    # int/int ratios truncate; REAL matches the engine's float64
+    q = re.sub(r"cast\s*\(\s*([^()]+?)\s+as\s+decimal\s*\([^)]*\)\s*\)",
+               r"CAST(\1 AS REAL)", q, flags=re.IGNORECASE)
+    return q
+
+
+class SqliteOracle:
+    def __init__(self, tables: dict):
+        """tables: {name: pyarrow.Table} — the same generated data the
+        Delta tables were written from."""
+        self.conn = sqlite3.connect(":memory:")
+        self.conn.create_function("sqrt", 1, math.sqrt)
+        for name, tbl in tables.items():
+            self._load(name, tbl)
+
+    def _load(self, name: str, tbl: pa.Table):
+        cols = tbl.column_names
+        self.conn.execute(
+            f"CREATE TABLE {name} ({', '.join(cols)})")
+        rows = [tuple(v.isoformat() if isinstance(v, datetime.date)
+                      else v for v in (r[c] for c in cols))
+                for r in tbl.to_pylist()]
+        self.conn.executemany(
+            f"INSERT INTO {name} VALUES ({','.join('?' * len(cols))})",
+            rows)
+
+    def run(self, query: str):
+        """Execute (rewritten) query; returns list of row tuples."""
+        cur = self.conn.execute(_rewrite(query))
+        return cur.fetchall()
+
+
+def _norm(v):
+    if isinstance(v, float):
+        if math.isnan(v):
+            return None
+        return round(v, 4)
+    if isinstance(v, datetime.datetime):
+        return v.date().isoformat()
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    return v
+
+
+def rows_equal(engine_rows, oracle_rows, float_tol=1e-6):
+    """Order-insensitive multiset comparison with float tolerance.
+    Returns (ok, message)."""
+    if len(engine_rows) != len(oracle_rows):
+        return False, (f"row count {len(engine_rows)} != oracle "
+                       f"{len(oracle_rows)}")
+
+    def key(row):
+        out = []
+        for v in row:
+            v = _norm(v)
+            if isinstance(v, bool):
+                out.append(f"bool:{v}")
+            elif isinstance(v, (int, float)):
+                out.append(f"num:{float(v):.4f}")
+            else:
+                out.append(f"{type(v).__name__}:{v}")
+        return tuple(out)
+
+    a = sorted(engine_rows, key=key)
+    b = sorted(oracle_rows, key=key)
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if len(ra) != len(rb):
+            return False, f"row {i}: width {len(ra)} != {len(rb)}"
+        for j, (va, vb) in enumerate(zip(ra, rb)):
+            va, vb = _norm(va), _norm(vb)
+            if va is None and vb is None:
+                continue
+            if isinstance(va, float) or isinstance(vb, float):
+                if va is None or vb is None:
+                    return False, (f"row {i} col {j}: {va!r} vs "
+                                   f"oracle {vb!r}")
+                if abs(va - vb) > float_tol * max(1.0, abs(va),
+                                                  abs(vb)):
+                    return False, (f"row {i} col {j}: {va!r} vs "
+                                   f"oracle {vb!r}")
+            elif va != vb:
+                return False, (f"row {i} col {j}: {va!r} vs oracle "
+                               f"{vb!r}")
+    return True, "ok"
