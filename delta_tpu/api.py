@@ -12,7 +12,7 @@ from typing import Dict, List, Optional, Sequence
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError, InvariantViolationError
+from delta_tpu.errors import DeltaError, InvalidArgumentError, InvariantViolationError, PathExistsError, UnresolvedColumnError
 from delta_tpu.models.actions import RemoveFile
 from delta_tpu.models.schema import from_arrow_schema
 from delta_tpu.table import Table
@@ -47,16 +47,16 @@ def write_table(
     table = Table.for_path(path, engine)
     exists = table.exists()
     if exists and mode == "error":
-        raise DeltaError(f"table {path} already exists")
+        raise PathExistsError(f"table {path} already exists")
     if exists and mode == "ignore":
         snap = table.latest_snapshot()
         return snap.version
 
     if (overwrite_schema or replace_where is not None) and mode != "overwrite":
-        raise DeltaError(
+        raise InvalidArgumentError(
             "overwrite_schema/replace_where require mode='overwrite'")
     if overwrite_schema and replace_where is not None:
-        raise DeltaError(
+        raise InvalidArgumentError(
             "overwrite_schema cannot be combined with replace_where")
 
     builder = table.create_transaction_builder(
@@ -142,7 +142,7 @@ def write_table(
         ref_names = sorted({p[0] for p in replace_where.references()})
         unknown = [n for n in ref_names if n not in schema_cols]
         if unknown:
-            raise DeltaError(
+            raise UnresolvedColumnError(
                 f"replace_where references column(s) {unknown} not in the "
                 "table schema")
         # predicate columns absent from the written batch read as NULL
@@ -182,9 +182,9 @@ def write_table(
     )
     txn.add_files(adds)
     if replace_where is not None:
-        from delta_tpu.config import ENABLE_CDF, get_table_config
+        from delta_tpu.config import ENABLE_CDF, cdf_enabled, get_table_config
 
-        if exists and get_table_config(meta.configuration, ENABLE_CDF):
+        if exists and cdf_enabled(meta.configuration):
             # the commit carries delete CDC images from the replaced
             # rows; once a commit has ANY cdc file the change feed is
             # served exclusively from them, so the inserted rows need

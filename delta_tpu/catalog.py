@@ -16,7 +16,7 @@ import re
 import time
 from typing import Dict, List, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import CatalogTableError, DeltaError, InvalidArgumentError, MissingTransactionLogError
 from delta_tpu.table import Table
 
 _NAME_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)?$")
@@ -42,7 +42,7 @@ class Catalog:
 
     def _entry_path(self, name: str) -> str:
         if not _NAME_RE.match(name):
-            raise DeltaError(f"invalid table name: {name!r}")
+            raise InvalidArgumentError(f"invalid table name: {name!r}")
         return f"{self._dir}/{name}.json"
 
     def _default_location(self, name: str) -> str:
@@ -124,7 +124,7 @@ class Catalog:
                 raise
         elif schema is None and not table.exists():
             self.engine.fs.delete(entry)
-            raise DeltaError(
+            raise MissingTransactionLogError(
                 f"no Delta table at {loc}; provide a schema to create one"
             )
         return table
@@ -133,7 +133,7 @@ class Catalog:
         """Register an existing Delta table under a name."""
         t = Table.for_path(path, self.engine)
         if not t.exists():
-            raise DeltaError(f"no Delta table at {path}")
+            raise MissingTransactionLogError(f"no Delta table at {path}")
         return self.create_table(name, location=path)
 
     def drop(self, name: str, if_exists: bool = False,
@@ -148,7 +148,7 @@ class Catalog:
         if delete_data and "://" in loc:
             # recursive delete is local-FS only (like VACUUM's walker);
             # failing loudly beats reporting success while retaining data
-            raise DeltaError(
+            raise CatalogTableError(
                 f"DROP TABLE ... delete_data is not supported for "
                 f"non-local location {loc!r}; drop without delete_data "
                 f"and remove the data out of band"
@@ -156,7 +156,7 @@ class Catalog:
         if delete_data and not loc.startswith(self.root + "/"):
             # externally registered table: refuse rather than silently
             # keep the data after an explicit delete_data request
-            raise DeltaError(
+            raise CatalogTableError(
                 f"table {name} is external (location {loc!r} outside the "
                 f"catalog root); drop without delete_data"
             )

@@ -17,7 +17,7 @@ import json
 import uuid
 from typing import List, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import ClusteringColumnError, DeltaError
 from delta_tpu.models.actions import DomainMetadata
 
 CLUSTERING_DOMAIN = "delta.clusteringMetadata"
@@ -62,11 +62,11 @@ def set_clustering_columns(table, columns: List[str]) -> int:
     schema = meta.schema
     for c in columns:
         if schema is not None and c not in schema:
-            raise DeltaError(f"clustering column {c} not in schema")
+            raise ClusteringColumnError(f"clustering column {c} not in schema")
         if c in meta.partitionColumns:
-            raise DeltaError(f"cannot cluster by partition column {c}")
+            raise ClusteringColumnError(f"cannot cluster by partition column {c}")
     if meta.partitionColumns and columns:
-        raise DeltaError("clustered tables cannot be partitioned")
+        raise ClusteringColumnError("clustered tables cannot be partitioned")
 
     txn = table.create_transaction_builder(Operation.CLUSTER_BY).build()
     proto = snap.protocol

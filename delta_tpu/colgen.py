@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError, InvariantViolationError
+from delta_tpu.errors import DeltaError, IdentityColumnError, InvariantViolationError
 from delta_tpu.models.schema import StructField, StructType, to_arrow_type
 
 GENERATION_EXPRESSION_KEY = "delta.generationExpression"
@@ -51,7 +51,7 @@ def identity_field(
     from delta_tpu.models.schema import LONG
 
     if step == 0:
-        raise DeltaError("identity step must not be 0")
+        raise IdentityColumnError("identity step must not be 0")
     return StructField(
         name,
         LONG,
@@ -145,7 +145,7 @@ def apply_column_generation(
             allow_explicit = bool(f.metadata.get(IDENTITY_ALLOW_EXPLICIT_KEY, False))
             if f.name in data.column_names:
                 if not allow_explicit:
-                    raise DeltaError(
+                    raise IdentityColumnError(
                         f"explicit values for identity column {f.name} are "
                         "not allowed (allowExplicitInsert=false)"
                     )

@@ -16,7 +16,7 @@ from __future__ import annotations
 import uuid
 from typing import Dict, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import ColumnMappingModeChangeError, DeltaError, DuplicateColumnError, NonExistentColumnError, SchemaEvolutionError
 from delta_tpu.models.actions import Metadata
 from delta_tpu.models.schema import (
     COLUMN_MAPPING_ID_KEY,
@@ -126,7 +126,7 @@ def validate_mode_change(old_mode: str, new_mode: str) -> None:
         return
     if old_mode == "none" and new_mode in ("name", "id"):
         return
-    raise DeltaError(
+    raise ColumnMappingModeChangeError(
         f"unsupported column mapping mode change {old_mode} -> {new_mode}"
     )
 
@@ -134,7 +134,7 @@ def validate_mode_change(old_mode: str, new_mode: str) -> None:
 def rename_column(schema: StructType, old: str, new: str) -> StructType:
     """Metadata-only rename (requires mapping mode != none)."""
     if new in schema:
-        raise DeltaError(f"column {new} already exists")
+        raise DuplicateColumnError(f"column {new} already exists")
     fields = []
     found = False
     for f in schema.fields:
@@ -144,13 +144,13 @@ def rename_column(schema: StructType, old: str, new: str) -> StructType:
         else:
             fields.append(f)
     if not found:
-        raise DeltaError(f"column {old} not found")
+        raise NonExistentColumnError(f"column {old} not found")
     return StructType(fields)
 
 
 def drop_column(schema: StructType, name: str) -> StructType:
     if name not in schema:
-        raise DeltaError(f"column {name} not found")
+        raise NonExistentColumnError(f"column {name} not found")
     if len(schema.fields) == 1:
-        raise DeltaError("cannot drop the last column")
+        raise SchemaEvolutionError("cannot drop the last column")
     return StructType([f for f in schema.fields if f.name != name])

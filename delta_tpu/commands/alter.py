@@ -18,7 +18,7 @@ from delta_tpu.columnmapping import (
     rename_column as _rename_in_schema,
     validate_mode_change,
 )
-from delta_tpu.errors import DeltaError, SchemaMismatchError
+from delta_tpu.errors import DeltaError, InvalidArgumentError, InvalidProtocolVersionError, MissingTransactionLogError, SchemaEvolutionError, SchemaMismatchError
 from delta_tpu.features import FEATURES, upgraded_protocol
 from delta_tpu.models.schema import (
     DataType,
@@ -34,7 +34,7 @@ from delta_tpu.txn.transaction import Operation
 def _metadata_txn(table, operation: str):
     txn = table.create_transaction_builder(operation).build()
     if txn.read_snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     return txn
 
 
@@ -72,7 +72,7 @@ def add_columns(table, columns: Sequence[StructField]) -> int:
         if f.name in schema:
             raise SchemaMismatchError(f"column {f.name} already exists")
         if not f.nullable:
-            raise DeltaError("added columns must be nullable")
+            raise SchemaEvolutionError("added columns must be nullable")
         new_fields.append(f)
     new_schema = StructType(schema.fields + list(new_fields))
     if mapping_mode(conf) != "none":
@@ -87,7 +87,7 @@ def rename_column(table, old: str, new: str) -> int:
     txn = _metadata_txn(table, Operation.RENAME_COLUMN)
     meta = txn.metadata()
     if mapping_mode(meta.configuration) == "none":
-        raise DeltaError(
+        raise SchemaEvolutionError(
             "RENAME COLUMN requires column mapping "
             "(set delta.columnMapping.mode = 'name')"
         )
@@ -111,12 +111,12 @@ def drop_column(table, name: str) -> int:
     txn = _metadata_txn(table, Operation.DROP_COLUMNS)
     meta = txn.metadata()
     if mapping_mode(meta.configuration) == "none":
-        raise DeltaError(
+        raise SchemaEvolutionError(
             "DROP COLUMN requires column mapping "
             "(set delta.columnMapping.mode = 'name')"
         )
     if name in meta.partitionColumns:
-        raise DeltaError(f"cannot drop partition column {name}")
+        raise SchemaEvolutionError(f"cannot drop partition column {name}")
     schema = schema_from_json(meta.schemaString)
     new_schema = _drop_from_schema(schema, name)
     return _commit_schema(txn, new_schema, {"column": name})
@@ -132,12 +132,12 @@ def change_column_type(table, name: str, new_type: DataType) -> int:
         raise SchemaMismatchError(f"column {name} not found")
     f = schema[name]
     if not can_widen(f.dataType, new_type):
-        raise DeltaError(
+        raise SchemaEvolutionError(
             f"unsupported type change {f.dataType.to_json_value()} -> "
             f"{new_type.to_json_value()} (only widening changes allowed)"
         )
     if meta.configuration.get("delta.enableTypeWidening", "").lower() != "true":
-        raise DeltaError("set delta.enableTypeWidening = true first")
+        raise SchemaEvolutionError("set delta.enableTypeWidening = true first")
     new_fields = [
         StructField(x.name, new_type, x.nullable, dict(x.metadata))
         if x.name == name
@@ -200,7 +200,7 @@ def upgrade_protocol(table, min_reader: Optional[int] = None,
     proto = txn.protocol()
     if feature is not None:
         if feature not in FEATURES:
-            raise DeltaError(f"unknown table feature {feature}")
+            raise InvalidArgumentError(f"unknown table feature {feature}")
         new_proto = upgraded_protocol(proto, FEATURES[feature])
     else:
         new_proto = dataclasses.replace(
@@ -212,7 +212,7 @@ def upgrade_protocol(table, min_reader: Optional[int] = None,
         return txn.read_version
     if (new_proto.minReaderVersion < proto.minReaderVersion
             or new_proto.minWriterVersion < proto.minWriterVersion):
-        raise DeltaError("protocol downgrade is not allowed")
+        raise InvalidProtocolVersionError("protocol downgrade is not allowed")
     txn.update_protocol(new_proto)
     txn.set_operation_parameters(
         {"newProtocol": new_proto.to_dict()}

@@ -14,7 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, InvalidArgumentError
 from delta_tpu.features import ROW_TRACKING, upgraded_protocol
 from delta_tpu.rowtracking import is_row_tracking_supported
 from delta_tpu.txn.transaction import Operation
@@ -34,7 +34,7 @@ def backfill_row_tracking(
 ) -> BackfillMetrics:
     """Enable row tracking on an existing table and backfill ids."""
     if batch_size <= 0:
-        raise DeltaError("batch_size must be positive")
+        raise InvalidArgumentError("batch_size must be positive")
     metrics = BackfillMetrics()
 
     snap = table.latest_snapshot()

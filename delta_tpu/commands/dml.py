@@ -24,8 +24,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 
-from delta_tpu.config import DELETION_VECTORS_ENABLED, ENABLE_CDF, get_table_config
-from delta_tpu.errors import DeltaError
+from delta_tpu.config import DELETION_VECTORS_ENABLED, ENABLE_CDF, cdf_enabled, get_table_config
+from delta_tpu.errors import AppendOnlyTableError, DeltaError, InvalidArgumentError, MissingTransactionLogError
 from delta_tpu.expressions.tree import Expression
 from delta_tpu.models.actions import AddCDCFile, AddFile
 from delta_tpu.txn.transaction import Operation
@@ -99,12 +99,12 @@ def delete(table, predicate: Optional[Expression] = None) -> DMLMetrics:
     txn = table.create_transaction_builder(Operation.DELETE).build()
     snapshot = txn.read_snapshot
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     meta = snapshot.metadata
     if meta.configuration.get("delta.appendOnly", "").lower() == "true":
-        raise DeltaError("cannot DELETE from an append-only table")
+        raise AppendOnlyTableError("cannot DELETE from an append-only table")
     use_dv = get_table_config(meta.configuration, DELETION_VECTORS_ENABLED)
-    use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+    use_cdc = cdf_enabled(meta.configuration)
     now_ms = int(time.time() * 1000)
     metrics = DMLMetrics()
 
@@ -164,7 +164,7 @@ def delete_matching_rows(
     if use_dv is None:
         use_dv = get_table_config(meta.configuration, DELETION_VECTORS_ENABLED)
     if use_cdc is None:
-        use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+        use_cdc = cdf_enabled(meta.configuration)
     if candidates is None:
         candidates = txn.scan_files(filter=predicate)
 
@@ -240,11 +240,11 @@ def update(
     txn = table.create_transaction_builder(Operation.UPDATE).build()
     snapshot = txn.read_snapshot
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     meta = snapshot.metadata
     if meta.configuration.get("delta.appendOnly", "").lower() == "true":
-        raise DeltaError("cannot UPDATE an append-only table")
-    use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+        raise AppendOnlyTableError("cannot UPDATE an append-only table")
+    use_cdc = cdf_enabled(meta.configuration)
     now_ms = int(time.time() * 1000)
     metrics = DMLMetrics()
 
@@ -308,7 +308,7 @@ def _apply_assignments(matched: pa.Table, assignments, evaluate_host) -> pa.Tabl
     out = matched
     for col_name, value in assignments.items():
         if col_name not in out.column_names:
-            raise DeltaError(f"unknown column in SET: {col_name}")
+            raise InvalidArgumentError(f"unknown column in SET: {col_name}")
         idx = out.column_names.index(col_name)
         if isinstance(value, Expression):
             arr = evaluate_host(value, out)

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, FeatureDropError, MissingTransactionLogError
 from delta_tpu.features import FEATURES, TableFeature, is_feature_supported
 from delta_tpu.models.actions import Metadata, Protocol
 from delta_tpu.models.schema import (
@@ -72,27 +72,27 @@ def drop_feature(table, feature_name: str, truncate_history: bool = False) -> in
     the protocol-downgrade commit."""
     feature = FEATURES.get(feature_name)
     if feature is None:
-        raise DeltaError(
+        raise FeatureDropError(
             f"unknown table feature {feature_name!r}; known features: "
             f"{sorted(FEATURES)}")
     if feature_name not in _REMOVABLE:
-        raise DeltaError(
+        raise FeatureDropError(
             f"feature {feature_name!r} cannot be dropped (not removable)")
 
     snapshot = table.latest_snapshot()
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     proto = snapshot.protocol
     if feature_name not in proto.writer_feature_set() and (
         feature_name not in proto.reader_feature_set()
     ):
         if is_feature_supported(proto, feature):
-            raise DeltaError(
+            raise FeatureDropError(
                 f"feature {feature_name!r} is implicitly supported by "
                 f"protocol ({proto.minReaderVersion}, {proto.minWriterVersion}) "
                 "legacy versions; dropping legacy features requires them to "
                 "be listed explicitly (writer version 7)")
-        raise DeltaError(f"feature {feature_name!r} is not present on this table")
+        raise FeatureDropError(f"feature {feature_name!r} is not present on this table")
 
     _pre_downgrade(table, feature_name)
 
@@ -100,7 +100,7 @@ def drop_feature(table, feature_name: str, truncate_history: bool = False) -> in
     # checkpoints; those stay readable until history is truncated
     if feature.is_reader_writer and feature_name != "vacuumProtocolCheck":
         if not truncate_history:
-            raise DeltaError(
+            raise FeatureDropError(
                 f"dropping reader+writer feature {feature_name!r} requires "
                 "history truncation: historical versions may still carry the "
                 "feature. Re-run with TRUNCATE HISTORY "
@@ -126,7 +126,7 @@ def _pre_downgrade(table, name: str) -> None:
         still = [f for f in table.latest_snapshot().scan().files()
                  if f.deletionVector is not None]
         if still:
-            raise DeltaError(
+            raise FeatureDropError(
                 f"{len(still)} file(s) still carry deletion vectors after purge")
         return
 
@@ -135,7 +135,7 @@ def _pre_downgrade(table, name: str) -> None:
 
         existing = table_constraints(conf)
         if existing:
-            raise DeltaError(
+            raise FeatureDropError(
                 f"cannot drop checkConstraints: constraint(s) "
                 f"{sorted(existing)} still exist — DROP CONSTRAINT them first")
         return
@@ -157,7 +157,7 @@ def _pre_downgrade(table, name: str) -> None:
         schema = schema_from_json(meta.schemaString)
         renamed = [f.name for f in schema.fields if f.physical_name != f.name]
         if renamed:
-            raise DeltaError(
+            raise FeatureDropError(
                 "cannot drop columnMapping: column(s) "
                 f"{renamed} have physical names differing from their logical "
                 "names (a rename or drop happened); rewrite the table first")
@@ -209,7 +209,7 @@ def _pre_downgrade(table, name: str) -> None:
                 table.latest_snapshot().state.domain_metadata.items()
                 if not dm.removed}
         if live:
-            raise DeltaError(
+            raise FeatureDropError(
                 f"cannot drop domainMetadata: live domain(s) {sorted(live)} "
                 "still exist")
         return
@@ -256,7 +256,7 @@ def _commit_downgrade(table, feature: TableFeature) -> int:
     proto = txn.protocol()
     meta = txn.metadata()
     if feature.activated_by is not None and feature.activated_by(meta):
-        raise DeltaError(
+        raise FeatureDropError(
             f"feature {feature.name!r} is still active after pre-downgrade")
     txn.update_protocol(_downgraded_protocol(proto, feature.name))
     txn.set_operation_parameters({"featureName": feature.name})

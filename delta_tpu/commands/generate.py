@@ -18,7 +18,7 @@ from __future__ import annotations
 import os
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, InvalidArgumentError, MissingTransactionLogError
 from delta_tpu.stats.partition import partition_path
 
 MANIFEST_DIR = "_symlink_format_manifest"
@@ -43,7 +43,7 @@ def generate_symlink_manifest(table) -> Dict[str, int]:
     partition manifests are removed. Returns {manifest_path: num_files}."""
     snapshot = table.latest_snapshot()
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     _check_compatible(snapshot)
     files = snapshot.scan().files()
     _check_no_dvs(files)
@@ -107,14 +107,14 @@ def _check_compatible(snapshot) -> None:
     from delta_tpu.columnmapping import mapping_mode
 
     if mapping_mode(snapshot.metadata.configuration) != "none":
-        raise DeltaError(
+        raise InvalidArgumentError(
             "symlink manifests are not supported on column-mapped tables")
 
 
 def _check_no_dvs(files: Iterable) -> None:
     n = sum(1 for f in files if f.deletionVector is not None)
     if n:
-        raise DeltaError(
+        raise InvalidArgumentError(
             f"cannot generate symlink manifests: {n} live file(s) carry "
             "deletion vectors (external engines would see deleted rows); "
             "run REORG TABLE ... APPLY (PURGE) first")

@@ -30,8 +30,8 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
-from delta_tpu.config import ENABLE_CDF, get_table_config
-from delta_tpu.errors import DeltaError
+from delta_tpu.config import ENABLE_CDF, cdf_enabled, get_table_config
+from delta_tpu.errors import DeltaError, InvalidArgumentError, MissingTransactionLogError
 from delta_tpu.expressions.tree import (
     And,
     Column,
@@ -264,9 +264,9 @@ def _execute_merge(
     txn = table.create_transaction_builder(Operation.MERGE).build()
     snapshot = txn.read_snapshot
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     meta = snapshot.metadata
-    use_cdc = get_table_config(meta.configuration, ENABLE_CDF)
+    use_cdc = cdf_enabled(meta.configuration)
     schema = snapshot.schema
 
     # new-column detection (case-insensitive, like the reference
@@ -282,7 +282,7 @@ def _execute_merge(
         seen: set = set()
         for k in c.assignments:
             if k.lower() in seen:
-                raise DeltaError(
+                raise InvalidArgumentError(
                     f"duplicate assignment for column '{k}' in MERGE clause"
                 )
             seen.add(k.lower())
@@ -300,16 +300,16 @@ def _execute_merge(
         missing = [k for k in unknown_assigned
                    if k.lower() not in source_by_lower]
         if missing:
-            raise DeltaError(
+            raise InvalidArgumentError(
                 f"assignment target column(s) {missing} exist in neither "
                 "the target schema nor the source")
         if not schema_evolution:
-            raise DeltaError(
+            raise InvalidArgumentError(
                 f"assignment target column(s) {unknown_assigned} not in "
                 "the target schema; call with_schema_evolution() to "
                 "evolve the table")
     if (extra_cols and has_star and not schema_evolution):
-        raise DeltaError(
+        raise InvalidArgumentError(
             f"source column(s) {extra_cols} not in the target schema; "
             "call with_schema_evolution() to evolve the table")
     if (extra_cols and has_star) or unknown_assigned:

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, MissingTransactionLogError, OptimizeArgumentError
 from delta_tpu.expressions.tree import Expression
 from delta_tpu.models.actions import AddFile
 from delta_tpu.txn.isolation import IsolationLevel
@@ -92,7 +92,7 @@ class OptimizeBuilder:
         max_file_size: int = DEFAULT_MAX_FILE_SIZE,
     ) -> OptimizeMetrics:
         if not columns:
-            raise DeltaError("ZORDER BY requires at least one column")
+            raise OptimizeArgumentError("ZORDER BY requires at least one column")
         return _run_optimize(
             self._table, self._filter, zorder_by=list(columns), curve=curve,
             min_file_size=None, max_file_size=max_file_size,
@@ -117,7 +117,7 @@ def _run_optimize(
     txn._isolation = IsolationLevel.SNAPSHOT_ISOLATION
     snapshot = txn.read_snapshot
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     meta = snapshot.metadata
     schema = meta.schema
 
@@ -130,16 +130,16 @@ def _run_optimize(
         min_file_size = None
         zcube_tags = new_zcube_tags(cluster_cols, curve)
     elif zorder_by and cluster_cols:
-        raise DeltaError(
+        raise OptimizeArgumentError(
             "clustered tables use OPTIMIZE (no ZORDER BY); clustering "
             f"columns are {cluster_cols}")
 
     if zorder_by:
         for c in zorder_by:
             if c in meta.partitionColumns:
-                raise DeltaError(f"cannot Z-order by partition column {c}")
+                raise OptimizeArgumentError(f"cannot Z-order by partition column {c}")
             if schema is not None and c not in schema:
-                raise DeltaError(f"Z-order column {c} not in schema")
+                raise OptimizeArgumentError(f"Z-order column {c} not in schema")
 
     candidates = txn.scan_files(filter=filter)
     if zcube_tags is not None:

@@ -14,7 +14,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, InvalidArgumentError, MissingTransactionLogError
 from delta_tpu.models.actions import AddFile
 from delta_tpu.txn.isolation import IsolationLevel
 from delta_tpu.txn.transaction import Operation
@@ -47,7 +47,7 @@ def reorg_upgrade_uniform(table, iceberg_compat_version: int = 2,
     from delta_tpu.table import Table as _Table
 
     if iceberg_compat_version not in (1, 2):
-        raise DeltaError(
+        raise InvalidArgumentError(
             f"unsupported ICEBERG_COMPAT_VERSION {iceberg_compat_version}")
     metrics = reorg_purge(table, max_file_size)
 
@@ -78,7 +78,7 @@ def _reorg(table, selector: Callable[[AddFile], bool], op_name: str,
     txn._isolation = IsolationLevel.SNAPSHOT_ISOLATION
     snapshot = txn.read_snapshot
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     meta = snapshot.metadata
 
     targets = [f for f in txn.scan_files() if selector(f)]

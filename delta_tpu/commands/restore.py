@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import CloneTargetExistsError, ConvertTargetError, DeltaError, RestoreTargetError
 from delta_tpu.models.actions import AddFile, Metadata
 from delta_tpu.table import Table
 from delta_tpu.txn.transaction import Operation
@@ -35,7 +35,7 @@ class RestoreMetrics:
 def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = None,
             force: bool = False) -> RestoreMetrics:
     if (version is None) == (timestamp_ms is None):
-        raise DeltaError("restore requires exactly one of version / timestamp")
+        raise RestoreTargetError("restore requires exactly one of version / timestamp")
     target = (
         table.snapshot_at(version)
         if version is not None
@@ -61,7 +61,7 @@ def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = 
             p = a.path
             abs_path = p if ("://" in p or p.startswith("/")) else f"{table.path}/{p}"
             if not table.engine.fs.exists(abs_path):
-                raise DeltaError(
+                raise RestoreTargetError(
                     f"cannot restore: data file {a.path} was removed "
                     "(probably by VACUUM); use force=True to restore anyway"
                 )
@@ -97,7 +97,7 @@ def clone(source_table, dest_path: str, shallow: bool = True,
     snap = source_table.latest_snapshot()
     dest = Table.for_path(dest_path, source_table.engine)
     if dest.exists():
-        raise DeltaError(f"clone destination {dest_path} already exists")
+        raise CloneTargetExistsError(f"clone destination {dest_path} already exists")
     meta = snap.metadata
 
     new_conf = dict(meta.configuration)
@@ -176,7 +176,7 @@ def convert_to_delta(
 
     table = Table.for_path(path, engine)
     if table.exists():
-        raise DeltaError(f"{path} is already a Delta table")
+        raise ConvertTargetError(f"{path} is already a Delta table")
     part_schema = partition_schema or {}
     part_cols = list(part_schema)
 
@@ -202,12 +202,12 @@ def convert_to_delta(
             rel = os.path.relpath(full, root).replace(os.sep, "/")
             missing = [k for k in part_cols if k not in pv]
             if missing:
-                raise DeltaError(
+                raise ConvertTargetError(
                     f"file {rel} lacks partition values for {missing}"
                 )
             manifest.append((full, rel, {k: pv.get(k) for k in part_cols}))
     if not manifest:
-        raise DeltaError(f"no parquet files found under {path}")
+        raise ConvertTargetError(f"no parquet files found under {path}")
 
     arrow_schema = pq.read_schema(manifest[0][0])
     schema = from_arrow_schema(arrow_schema)

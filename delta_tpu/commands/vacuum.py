@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from delta_tpu.config import TOMBSTONE_RETENTION, get_table_config
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, VacuumRetentionError
 from delta_tpu.utils import filenames
 
 
@@ -75,7 +75,7 @@ def vacuum(
         int(retention_hours * 3_600_000) if retention_hours is not None else default_ms
     )
     if enforce_retention_check and retention_ms < 0:
-        raise DeltaError("retention must be >= 0")
+        raise VacuumRetentionError("retention must be >= 0")
     now_ms = int(time.time() * 1000)
     cutoff = now_ms - retention_ms
 

@@ -138,11 +138,141 @@ RANDOM_PREFIX_LENGTH = _cfg(
 )
 
 
+def _parse_isolation(s: str) -> str:
+    lv = s.strip()
+    if lv not in ("Serializable", "WriteSerializable",
+                  "SnapshotIsolation"):
+        raise ValueError(f"invalid delta.isolationLevel {s!r}")
+    return lv
+
+
+def _parse_formats(s: str):
+    out = [f.strip().lower() for f in s.split(",") if f.strip()]
+    bad = [f for f in out if f not in ("iceberg", "hudi")]
+    if bad:
+        raise ValueError(
+            f"invalid delta.universalFormat.enabledFormats entries {bad}")
+    return out
+
+
+# -- remainder of the reference's DeltaConfig catalog
+# (`DeltaConfig.scala`, 46 buildConfig entries) -------------------------
+
+MIN_READER_VERSION = _cfg(
+    "delta.minReaderVersion", 1, int,
+    "Protocol floor at table creation (consumed, not persisted); "
+    "enforced in features.protocol_for_new_table.",
+)
+MIN_WRITER_VERSION = _cfg("delta.minWriterVersion", 2, int)
+IGNORE_PROTOCOL_DEFAULTS = _cfg(
+    "delta.ignoreProtocolDefaults", False, _parse_bool,
+    "Drop the (1,2) creation default to the protocol minimum (1,1).",
+)
+SAMPLE_RETENTION = _cfg(
+    "delta.sampleRetentionDuration", 7 * 86_400_000, _parse_interval_ms,
+    "Retention for sampled tables (reference default 7 days). Registered for parse/compat; no sampling subsystem consults it yet.",
+)
+ENABLE_FULL_RETENTION_ROLLBACK = _cfg(
+    "delta.enableFullRetentionRollback", True, _parse_bool,
+    "Allow RESTORE to any version within logRetentionDuration. Registered; RESTORE does not enforce a shorter window yet.",
+)
+DROP_FEATURE_TRUNCATE_RETENTION = _cfg(
+    "delta.dropFeatureTruncateHistory.retentionDuration",
+    24 * 3_600_000, _parse_interval_ms,
+    "History-truncation wait for DROP FEATURE (24 hours); consumed by "
+    "commands/dropfeature.py.",
+)
+ENABLE_CDC_ALIAS = _cfg(
+    "delta.enableChangeDataCapture", False, _parse_bool,
+    "Legacy alias of delta.enableChangeDataFeed (honored everywhere via config.cdf_enabled).",
+)
+ISOLATION_LEVEL = _cfg(
+    "delta.isolationLevel", "WriteSerializable", _parse_isolation,
+    "Serializable | WriteSerializable | SnapshotIsolation "
+    "(txn/isolation.py).",
+)
+ICT_ENABLEMENT_VERSION = _cfg(
+    "delta.inCommitTimestampEnablementVersion", None, int,
+    "Version at which inCommitTimestamps were enabled (written by the "
+    "txn when the feature turns on mid-history; history.py reads it).",
+)
+ICT_ENABLEMENT_TIMESTAMP = _cfg(
+    "delta.inCommitTimestampEnablementTimestamp", None, int,
+    "Timestamp pair of inCommitTimestampEnablementVersion.",
+)
+REQUIRE_CHECKPOINT_PROTECTION = _cfg(
+    "delta.requireCheckpointProtectionBeforeVersion", 0, int,
+    "Metadata cleanup must not rewrite checkpoints covering versions "
+    "below this (checkpoint-protection table feature). Registered; "
+    "log cleanup does not consult it yet.",
+)
+UNIFORM_ENABLED_FORMATS = _cfg(
+    "delta.universalFormat.enabledFormats", [], _parse_formats,
+    "UniForm targets: iceberg and/or hudi (interop/ converters run as "
+    "post-commit hooks).",
+)
+ICEBERG_COMPAT_V1 = _cfg(
+    "delta.enableIcebergCompatV1", False, _parse_bool,
+    "IcebergCompat v1 invariants (icebergcompat.py).",
+)
+ICEBERG_COMPAT_V2 = _cfg(
+    "delta.enableIcebergCompatV2", False, _parse_bool,
+    "IcebergCompat v2 invariants (icebergcompat.py).",
+)
+CAST_ICEBERG_TIME_TYPE = _cfg(
+    "delta.castIcebergTimeType", False, _parse_bool,
+    "Cast Iceberg TIME columns to long on conversion. Registered for parse/compat; the Iceberg converter has no TIME source type yet.",
+)
+AUTO_OPTIMIZE_LEGACY = _cfg(
+    "delta.autoOptimize", False, _parse_bool,
+    "Legacy umbrella switch implying autoCompact (honored by hooks.auto_compact_hook).",
+)
+COORDINATED_COMMITS_COORDINATOR = _cfg(
+    "delta.coordinatedCommits.commitCoordinator-preview", None, str,
+    "Commit-coordinator name; presence routes commits through "
+    "coordinatedcommits/ instead of LogStore put-if-absent.",
+)
+COORDINATED_COMMITS_COORDINATOR_CONF = _cfg(
+    "delta.coordinatedCommits.commitCoordinatorConf-preview", None, str,
+    "JSON configuration blob for the commit coordinator.",
+)
+COORDINATED_COMMITS_TABLE_CONF = _cfg(
+    "delta.coordinatedCommits.tableConf-preview", None, str,
+    "Coordinator-issued per-table configuration blob.",
+)
+REDIRECT_READER_WRITER = _cfg(
+    "delta.redirectReaderWriter-preview", None, str,
+    "Table-redirect spec (reads + writes routed to another table). Registered for parse/compat; redirects are not implemented.",
+)
+REDIRECT_WRITER_ONLY = _cfg(
+    "delta.redirectWriterOnly-preview", None, str,
+    "Table-redirect spec for writes only. Registered for parse/compat; redirects are not implemented.",
+)
+ENABLE_TYPE_WIDENING = _cfg(
+    "delta.enableTypeWidening", False, _parse_bool,
+    "Allow in-place widening type changes (schema_evolution.py).",
+)
+SYMLINK_MANIFEST_ENABLED = _cfg(
+    "delta.compatibility.symlinkFormatManifest.enabled", False,
+    _parse_bool,
+    "Regenerate the symlink manifest after every commit "
+    "(commands/generate.py + hooks).",
+)
+
+
 def get_table_config(configuration: Dict[str, str], cfg: TableConfig):
     raw = configuration.get(cfg.key)
     if raw is None:
         return cfg.default
     return cfg.parse(raw)
+
+
+def cdf_enabled(configuration: Dict[str, str]) -> bool:
+    """Change data feed on? Honors both delta.enableChangeDataFeed and
+    its legacy alias delta.enableChangeDataCapture (the reference keeps
+    both keys live)."""
+    return (get_table_config(configuration, ENABLE_CDF)
+            or get_table_config(configuration, ENABLE_CDC_ALIAS))
 
 
 @dataclass

@@ -25,7 +25,7 @@ from delta_tpu.connect.protocol import (
     send_frame,
     table_to_ipc,
 )
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import ConnectProtocolError, DeltaError
 
 
 def _jsonable(out):
@@ -102,7 +102,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
             # not escape the confinement the docstring promises
             resolved = os.path.realpath(path)
             if not (resolved + "/").startswith(self.allowed_root + "/"):
-                raise DeltaError(f"path {path!r} is outside the served root")
+                raise ConnectProtocolError(f"path {path!r} is outside the served root")
 
     def _table(self, path: str):
         from delta_tpu.table import Table
@@ -132,7 +132,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
         if op == "write":
             data = ipc_to_table(payload)
             if data is None:
-                raise DeltaError("write requires an Arrow payload")
+                raise ConnectProtocolError("write requires an Arrow payload")
             import delta_tpu.api as dta
 
             self._table(env["path"])  # root check
@@ -185,7 +185,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
                              dry_run=env.get("dry_run", False))
             return {"deleted": deleted.num_deleted}, b""
 
-        raise DeltaError(f"unknown connect op {op!r}")
+        raise ConnectProtocolError(f"unknown connect op {op!r}")
 
 
 def serve(path_root: str, host: str = "127.0.0.1", port: int = 9477):

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from delta_tpu.errors import DeltaError, InvariantViolationError
+from delta_tpu.errors import ConstraintAlreadyExistsError, ConstraintNotFoundError, DeltaError, InvariantViolationError, MissingTransactionLogError
 from delta_tpu.expressions.parser import parse_expression, to_sql
 from delta_tpu.expressions.tree import Expression
 
@@ -47,11 +47,11 @@ def add_constraint(table, name: str, expr) -> int:
     txn = table.create_transaction_builder(Operation.ADD_CONSTRAINT).build()
     snapshot = txn.read_snapshot
     if snapshot is None:
-        raise DeltaError(f"no table at {table.path}")
+        raise MissingTransactionLogError(f"no table at {table.path}")
     meta = snapshot.metadata
     key = constraint_key(name)
     if key in meta.configuration:
-        raise DeltaError(f"constraint {name} already exists")
+        raise ConstraintAlreadyExistsError(f"constraint {name} already exists")
 
     # validate current data
     data = snapshot.scan().to_arrow()
@@ -90,7 +90,7 @@ def drop_constraint(table, name: str, if_exists: bool = False) -> int:
     if key not in meta.configuration:
         if if_exists:
             return txn.read_version
-        raise DeltaError(f"constraint {name} does not exist")
+        raise ConstraintNotFoundError(f"constraint {name} does not exist")
     new_conf = {k: v for k, v in meta.configuration.items() if k != key}
     txn.update_metadata(dataclasses.replace(meta, configuration=new_conf))
     txn.set_operation_parameters({"name": name})

@@ -160,6 +160,223 @@ class PartitionColumnMismatchError(DeltaError):
     error_class = "DELTA_PARTITION_COLUMN_MISMATCH"
 
 
+class SqlParseError(DeltaError):
+    """SQL text failed to tokenize/parse (reference
+    `DELTA_PARSE_SYNTAX_ERROR` family, `DeltaSqlParser.scala`)."""
+
+    error_class = "DELTA_PARSE_SYNTAX_ERROR"
+
+
+class UnresolvedColumnError(DeltaError):
+    error_class = "DELTA_UNRESOLVED_COLUMN"
+
+
+class AmbiguousColumnError(DeltaError):
+    error_class = "DELTA_AMBIGUOUS_COLUMN"
+
+
+class UnsupportedSqlError(DeltaError):
+    """Valid-looking SQL using surface this engine does not implement."""
+
+    error_class = "DELTA_UNSUPPORTED_SQL"
+
+
+class SubqueryShapeError(DeltaError):
+    """Scalar/IN subquery returned the wrong shape."""
+
+    error_class = "DELTA_INVALID_SUBQUERY"
+
+
+class InvalidTablePropertyError(DeltaError):
+    error_class = "DELTA_INVALID_TABLE_PROPERTY"
+
+
+class UnknownConfigurationError(DeltaError):
+    error_class = "DELTA_UNKNOWN_CONFIGURATION"
+
+
+class InvalidArgumentError(DeltaError):
+    """Bad argument to a command/API builder (reference
+    `DeltaErrors.illegalDeltaOptionException` family)."""
+
+    error_class = "DELTA_ILLEGAL_ARGUMENT"
+
+
+class PathExistsError(DeltaError):
+    error_class = "DELTA_PATH_EXISTS"
+
+
+class MissingTransactionLogError(DeltaError):
+    error_class = "DELTA_MISSING_TRANSACTION_LOG"
+
+
+class FileNotFoundInLogError(DeltaError):
+    error_class = "DELTA_FILE_NOT_FOUND_DETAILED"
+
+
+class AppendOnlyTableError(DeltaError):
+    """DELETE/UPDATE/MERGE-delete on a delta.appendOnly table."""
+
+    error_class = "DELTA_CANNOT_MODIFY_APPEND_ONLY"
+
+
+class MultipleSourceRowMatchesError(DeltaError):
+    """MERGE: >1 source row matched the same target row with
+    conflicting actions."""
+
+    error_class = "DELTA_MULTIPLE_SOURCE_ROW_MATCHING_TARGET_ROW_IN_MERGE"
+
+
+class ColumnMappingError(DeltaError):
+    error_class = "DELTA_UNSUPPORTED_COLUMN_MAPPING_OPERATION"
+
+
+class ColumnMappingModeChangeError(ColumnMappingError):
+    error_class = "DELTA_UNSUPPORTED_COLUMN_MAPPING_MODE_CHANGE"
+
+
+class UnsupportedTypeChangeError(DeltaError):
+    """ALTER COLUMN TYPE outside the widening matrix."""
+
+    error_class = "DELTA_UNSUPPORTED_TYPE_CHANGE"
+
+
+class NonExistentColumnError(DeltaError):
+    error_class = "DELTA_COLUMN_NOT_FOUND"
+
+
+class DuplicateColumnError(DeltaError):
+    error_class = "DELTA_DUPLICATE_COLUMNS_FOUND"
+
+
+class GeneratedColumnError(DeltaError):
+    error_class = "DELTA_UNSUPPORTED_GENERATED_COLUMN"
+
+
+class IdentityColumnError(DeltaError):
+    error_class = "DELTA_IDENTITY_COLUMNS_ILLEGAL_OPERATION"
+
+
+class ConstraintAlreadyExistsError(DeltaError):
+    error_class = "DELTA_CONSTRAINT_ALREADY_EXISTS"
+
+
+class ConstraintNotFoundError(DeltaError):
+    error_class = "DELTA_CONSTRAINT_DOES_NOT_EXIST"
+
+
+class FeatureDropError(DeltaError):
+    """DROP FEATURE preconditions not met (reference
+    `DELTA_FEATURE_DROP_*` family)."""
+
+    error_class = "DELTA_FEATURE_DROP_UNSUPPORTED_CLIENT_FEATURE"
+
+
+class FeatureDropHistoricalVersionsExistError(FeatureDropError):
+    error_class = "DELTA_FEATURE_DROP_HISTORICAL_VERSIONS_EXIST"
+
+
+class FeatureDropWaitForRetentionError(FeatureDropError):
+    error_class = "DELTA_FEATURE_DROP_WAIT_FOR_RETENTION_PERIOD"
+
+
+class RestoreTargetError(DeltaError):
+    error_class = "DELTA_CANNOT_RESTORE_TABLE_VERSION"
+
+
+class CloneTargetExistsError(DeltaError):
+    error_class = "DELTA_CLONE_AMBIGUOUS_TARGET"
+
+
+class ConvertTargetError(DeltaError):
+    error_class = "DELTA_CONVERSION_UNSUPPORTED_SOURCE"
+
+
+class VacuumRetentionError(DeltaError):
+    """Retention below the safety floor without the override flag."""
+
+    error_class = "DELTA_UNSAFE_VACUUM_RETENTION"
+
+
+class OptimizeArgumentError(DeltaError):
+    error_class = "DELTA_OPTIMIZE_INVALID_ARGUMENT"
+
+
+class ClusteringColumnError(DeltaError):
+    error_class = "DELTA_CLUSTERING_COLUMNS_MISMATCH"
+
+
+class StreamingSourceError(DeltaError):
+    error_class = "DELTA_STREAMING_SOURCE_ERROR"
+
+
+class StreamingOffsetError(StreamingSourceError):
+    error_class = "DELTA_STREAMING_INVALID_OFFSET"
+
+
+class StreamingSchemaChangeError(StreamingSourceError):
+    """Non-additive schema change mid-stream (reference
+    `DELTA_STREAMING_METADATA_EVOLUTION` family)."""
+
+    error_class = "DELTA_STREAMING_INCOMPATIBLE_SCHEMA_CHANGE"
+
+
+class CdcNotEnabledError(DeltaError):
+    error_class = "DELTA_MISSING_CHANGE_DATA"
+
+
+class IcebergCompatViolationError(DeltaError):
+    error_class = "DELTA_ICEBERG_COMPAT_VIOLATION"
+
+
+class UniFormConversionError(DeltaError):
+    error_class = "DELTA_UNIVERSAL_FORMAT_VIOLATION"
+
+
+class SharingError(DeltaError):
+    error_class = "DELTA_SHARING_ERROR"
+
+
+class CheckpointError(DeltaError):
+    error_class = "DELTA_CHECKPOINT_NON_EXIST_TABLE"
+
+
+class LogCorruptedError(DeltaError):
+    error_class = "DELTA_LOG_FILE_MALFORMED"
+
+
+class DomainMetadataError(DeltaError):
+    error_class = "DELTA_DOMAIN_METADATA_NOT_SUPPORTED"
+
+
+class RowTrackingError(DeltaError):
+    error_class = "DELTA_ROW_TRACKING_ILLEGAL_OPERATION"
+
+
+class DeletionVectorError(DeltaError):
+    error_class = "DELTA_DELETION_VECTOR_INVALID"
+
+
+class TimeTravelArgumentError(DeltaError):
+    error_class = "DELTA_INVALID_TIME_TRAVEL_SPEC"
+
+
+class SchemaEvolutionError(DeltaError):
+    error_class = "DELTA_UNSUPPORTED_SCHEMA_EVOLUTION"
+
+
+class CatalogTableError(DeltaError):
+    error_class = "DELTA_CATALOG_TABLE_ERROR"
+
+
+class ImportError_(DeltaError):
+    error_class = "DELTA_IMPORT_FAILED"
+
+
+class ConnectProtocolError(DeltaError):
+    error_class = "DELTA_CONNECT_PROTOCOL_ERROR"
+
+
 # ------------------------------------------------------------- catalog
 
 import functools

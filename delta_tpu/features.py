@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
-from delta_tpu.errors import InvalidProtocolVersionError, UnsupportedTableFeatureError
+from delta_tpu.errors import InvalidProtocolVersionError, InvalidTablePropertyError, UnsupportedTableFeatureError
 from delta_tpu.models.actions import Metadata, Protocol
 
 
@@ -130,22 +130,65 @@ def protocol_for_new_table(
     meta = Metadata(id="", schemaString=schema_string or "",
                     configuration=dict(configuration))
     needed = [f for f in FEATURES.values() if f.activated_by and f.activated_by(meta)]
-    min_reader, min_writer = 1, 2
+    # delta.minReaderVersion/minWriterVersion raise the protocol floor
+    # at creation; delta.ignoreProtocolDefaults drops the (1,2) base to
+    # the protocol minimum (DeltaConfig.scala minReaderVersion/
+    # minWriterVersion/ignoreProtocolDefaults)
+    from delta_tpu import config as cfg
+
+    try:
+        if cfg.get_table_config(configuration,
+                                cfg.IGNORE_PROTOCOL_DEFAULTS):
+            min_reader, min_writer = 1, 1
+        else:
+            min_reader, min_writer = 1, 2
+        raw_r = configuration.get(cfg.MIN_READER_VERSION.key)
+        raw_w = configuration.get(cfg.MIN_WRITER_VERSION.key)
+        forced_r = int(raw_r) if raw_r is not None else None
+        forced_w = int(raw_w) if raw_w is not None else None
+    except ValueError as e:
+        raise InvalidTablePropertyError(
+            f"invalid protocol version property: {e}") from None
+    # range/consistency validation BEFORE committing: an out-of-range
+    # protocol would brick the table for every reader (incl. us)
+    if forced_r is not None and not 1 <= forced_r <= 3:
+        raise InvalidProtocolVersionError(
+            f"requested readerVersion {forced_r} is outside 1..3")
+    if forced_w is not None and not 1 <= forced_w <= MAX_WRITER_VERSION:
+        raise InvalidProtocolVersionError(
+            f"requested writerVersion {forced_w} is outside "
+            f"1..{MAX_WRITER_VERSION}")
+    if forced_r == 3 and (forced_w or 7) != 7:
+        raise InvalidProtocolVersionError(
+            "readerVersion 3 requires writerVersion 7 "
+            "(feature-vector protocols)")
+    if forced_r is not None:
+        min_reader = max(min_reader, forced_r)
+        if forced_r == 3:
+            min_writer = 7
+    if forced_w is not None:
+        min_writer = max(min_writer, forced_w)
     for f in needed:
         min_reader = max(min_reader, f.min_reader_version)
         min_writer = max(min_writer, f.min_writer_version)
     non_legacy = [f for f in needed if not f.legacy]
-    if non_legacy:
-        # feature vectors required
+    if non_legacy or min_writer == 7:
+        # feature vectors required (writer v7 always carries explicit
+        # writerFeatures, even if only legacy features are active; a
+        # forced reader 3 likewise requires readerFeatures, possibly
+        # empty)
+        need_reader_vec = (min_reader >= 3
+                           or any(f.min_reader_version >= 3
+                                  for f in needed))
         reader_features = sorted(
             f.name for f in needed if f.is_reader_writer
-        ) if any(f.min_reader_version >= 3 for f in needed) else None
-        if reader_features:
+        ) if need_reader_vec else None
+        if need_reader_vec:
             min_reader = 3
-        min_writer = 7
         writer_features = sorted(f.name for f in needed)
-        return Protocol(min_reader if not reader_features else 3, 7,
-                        readerFeatures=reader_features, writerFeatures=writer_features)
+        return Protocol(min_reader, 7,
+                        readerFeatures=reader_features,
+                        writerFeatures=writer_features)
     return Protocol(min_reader, min_writer)
 
 

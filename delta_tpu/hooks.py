@@ -46,7 +46,13 @@ def auto_compact_hook(table, txn, version: int, metadata) -> None:
     """AutoCompact (`hooks/AutoCompact.scala`): after a data-changing
     commit on a table with delta.autoOptimize.autoCompact, compact
     partitions that accumulated enough small files."""
-    if metadata.configuration.get("delta.autoOptimize.autoCompact", "").lower() != "true":
+    conf = metadata.configuration
+    # delta.autoOptimize is the legacy umbrella switch implying
+    # autoCompact (DeltaConfig.scala autoOptimize)
+    enabled = (conf.get("delta.autoOptimize.autoCompact", "").lower()
+               == "true"
+               or conf.get("delta.autoOptimize", "").lower() == "true")
+    if not enabled:
         return
     if txn.operation == "OPTIMIZE" or not txn._adds:
         return

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, IcebergCompatViolationError
 from delta_tpu.models.schema import ArrayType, MapType, PrimitiveType, StructType
 
 ICEBERG_COMPAT_V1_KEY = "delta.enableIcebergCompatV1"
@@ -35,7 +35,7 @@ def enabled_version(configuration) -> Optional[int]:
     v1 = _is_true(configuration, ICEBERG_COMPAT_V1_KEY)
     v2 = _is_true(configuration, ICEBERG_COMPAT_V2_KEY)
     if v1 and v2:
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             "icebergCompatV1 and icebergCompatV2 are mutually exclusive "
             "(CheckOnlySingleVersionEnabled)")
     return 1 if v1 else 2 if v2 else None
@@ -73,7 +73,7 @@ def validate_enablement(snapshot, new_configuration) -> None:
     dvs = [d for d in snapshot.state.add_files_table
            .column("deletion_vector").to_pylist() if d]
     if dvs:
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"cannot enable icebergCompatV{new_v}: {len(dvs)} live "
             "file(s) still carry deletion vectors; run REORG TABLE ... "
             "APPLY (UPGRADE UNIFORM (...)) or PURGE first")
@@ -89,12 +89,12 @@ def validate_iceberg_compat(metadata, protocol,
         return
     feature = f"icebergCompatV{version}"
     if feature not in (protocol.writerFeatures or []):
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"delta.enableIcebergCompatV{version} requires the "
             f"{feature} writer table feature")
     mode = conf.get("delta.columnMapping.mode", "none")
     if mode not in ("name", "id"):
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"icebergCompatV{version} requires column mapping "
             f"(delta.columnMapping.mode=name), found {mode!r} "
             "(RequireColumnMapping)")
@@ -104,27 +104,27 @@ def validate_iceberg_compat(metadata, protocol,
         # checked at ENABLEMENT time (validate_enablement) and staged
         # adds on every commit below — REORG ... APPLY (UPGRADE UNIFORM)
         # is the purge path for tables that already wrote DVs
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"icebergCompatV{version} is incompatible with deletion "
             "vectors (CheckDeletionVectorDisabled)")
     dv_adds = [a.path for a in adds
                if getattr(a, "deletionVector", None) is not None]
     if dv_adds:
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"icebergCompatV{version}: staged add(s) carry deletion "
             f"vectors ({dv_adds[:3]})")
     problems: list = []
     if metadata.schema is not None:
         _walk_types(metadata.schema, [], problems, version)
     if problems:
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"icebergCompatV{version} schema violations: "
             + "; ".join(problems))
     # every AddFile, including dataChange=false rewrites: the Iceberg
     # mirror needs numRecords for each data file (CheckAddFileHasStats)
     missing_stats = [a.path for a in adds if not a.stats]
     if missing_stats:
-        raise DeltaError(
+        raise IcebergCompatViolationError(
             f"icebergCompatV{version} requires stats on every added "
             f"file (CheckAddFileHasStats); missing on "
             f"{missing_stats[:3]}")

@@ -26,7 +26,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, SharingError
 
 Transport = Callable[[str, Optional[dict]], dict]
 """(endpoint_path, json_body_or_None_for_GET) -> parsed response.
@@ -89,7 +89,7 @@ class HttpTransport:
                         detail = e.read().decode(errors="replace")[:500]
                     except Exception:
                         pass
-                    raise DeltaError(
+                    raise SharingError(
                         f"sharing server returned HTTP {e.code} for "
                         f"{url}: {detail}") from e
                 retry_after = e.headers.get("Retry-After")
@@ -102,7 +102,7 @@ class HttpTransport:
                 delay = min(delay * 2, 8.0)
             except urllib.error.URLError as e:
                 if attempt == self.max_retries:
-                    raise DeltaError(
+                    raise SharingError(
                         f"sharing server unreachable at {url}: {e.reason}"
                     ) from e
                 time.sleep(delay)
@@ -209,7 +209,7 @@ def materialize_shared_table(lines: List[dict], dest_path: str) -> str:
     protocol_line = next((l["protocol"] for l in lines if "protocol" in l), None)
     meta_line = next((l["metaData"] for l in lines if "metaData" in l), None)
     if meta_line is None:
-        raise DeltaError("sharing response has no metaData line")
+        raise SharingError("sharing response has no metaData line")
     files = [l["file"] for l in lines if "file" in l]
 
     log = os.path.join(dest_path, "_delta_log")
@@ -319,7 +319,7 @@ class SharingStreamSource:
             # updated/deleted/compacted server-side, and re-emitting the
             # rewritten files would duplicate rows downstream — same
             # contract as DeltaSource's data-changing-remove error
-            raise DeltaError(
+            raise SharingError(
                 f"{len(vanished)} previously-streamed file(s) were "
                 "rewritten or removed on the sharing server; restart the "
                 "stream, or pass ignore_changes=True to re-emit "

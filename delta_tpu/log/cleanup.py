@@ -14,7 +14,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, InvalidArgumentError
 from delta_tpu.models.actions import (
     Action,
     AddFile,
@@ -102,7 +102,7 @@ def cleanup_expired_logs(
 def write_compacted_delta(table, from_version: int, to_version: int) -> str:
     """Reconcile commits [from, to] into one compacted file."""
     if to_version <= from_version:
-        raise DeltaError("compaction range must span at least two commits")
+        raise InvalidArgumentError("compaction range must span at least two commits")
     engine = table.engine
     # Sequential reconciliation of the range (small: it's a commit range,
     # not a full table state).

@@ -48,6 +48,7 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -1897,9 +1898,38 @@ const void* das_ptr(void* h, int32_t which) {
 // scanner). Listing 100k commit files costs ~40us/file of interpreter
 // overhead when read from Python; here it is two syscalls per file.
 
+// GB-scale anonymous buffer mapped with transparent-huge-page advice:
+// on hypervisor-backed VMs a minor fault costs tens of microseconds, so
+// first-touching a 3GB std::string at 4KiB granularity (~800k faults)
+// dominates a cold snapshot load. 2MiB THP cuts the fault count 512x,
+// and MADV_POPULATE_WRITE (Linux 5.14+) prefaults in-kernel in one
+// syscall instead of per-page user traps.
+struct HugeBuf {
+  char* p = nullptr;
+  size_t n = 0;
+  bool alloc(size_t want) {
+    if (want == 0) want = 1;
+    void* m = mmap(nullptr, want, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+    if (m == MAP_FAILED) return false;
+    p = (char*)m;
+    n = want;
+#ifdef MADV_HUGEPAGE
+    madvise(p, n, MADV_HUGEPAGE);
+#endif
+#ifdef MADV_POPULATE_WRITE
+    madvise(p, n, MADV_POPULATE_WRITE);
+#endif
+    return true;
+  }
+  ~HugeBuf() {
+    if (p) munmap(p, n);
+  }
+};
+
 struct ReadResult {
   int32_t error = 0;           // 0 ok, 1 open/stat/read failure
-  std::string buf;
+  HugeBuf buf;
   std::vector<int64_t> starts;  // n+1: byte start of each file region
 };
 
@@ -1916,9 +1946,9 @@ void* dar_read(const char* paths_blob, const int64_t* path_offs,
     sizes[i] = st.st_size;
     total += st.st_size + 1;
   }
-  r->buf.resize((size_t)total);
+  if (!r->buf.alloc((size_t)total)) { r->error = 1; return r; }
   r->starts.resize(n_files + 1);
-  char* out = &r->buf[0];
+  char* out = r->buf.p;
   int64_t off = 0;
   for (int32_t i = 0; i < n_files; i++) {
     r->starts[i] = off;
@@ -1943,8 +1973,8 @@ void* dar_read(const char* paths_blob, const int64_t* path_offs,
 
 void dar_free(void* h) { delete (ReadResult*)h; }
 int32_t dar_error(void* h) { return ((ReadResult*)h)->error; }
-int64_t dar_len(void* h) { return (int64_t)((ReadResult*)h)->buf.size(); }
-const void* dar_buf(void* h) { return ((ReadResult*)h)->buf.data(); }
+int64_t dar_len(void* h) { return (int64_t)((ReadResult*)h)->buf.n; }
+const void* dar_buf(void* h) { return ((ReadResult*)h)->buf.p; }
 const void* dar_starts(void* h) { return ((ReadResult*)h)->starts.data(); }
 
 }  // extern "C"

@@ -15,8 +15,8 @@ from typing import List, Optional
 
 import pyarrow as pa
 
-from delta_tpu.config import ENABLE_CDF, get_table_config
-from delta_tpu.errors import DeltaError
+from delta_tpu.config import ENABLE_CDF, cdf_enabled, get_table_config
+from delta_tpu.errors import CdcNotEnabledError, DeltaError
 from delta_tpu.models.actions import (
     AddCDCFile,
     AddFile,
@@ -47,8 +47,8 @@ def table_changes(
 ) -> pa.Table:
     snap = table.latest_snapshot()
     conf = snap.metadata.configuration
-    if not get_table_config(conf, ENABLE_CDF):
-        raise DeltaError(
+    if not cdf_enabled(conf):
+        raise CdcNotEnabledError(
             "change data feed is not enabled on this table "
             "(set delta.enableChangeDataFeed=true)"
         )

@@ -24,7 +24,7 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
-from delta_tpu.errors import UnsupportedTableFeatureError
+from delta_tpu.errors import LogCorruptedError, UnsupportedTableFeatureError
 from delta_tpu.models.actions import (
     AddFile,
     CommitInfo,
@@ -356,7 +356,7 @@ def reconstruct_small_state(engine, segment,
     if columnar.protocol is None or columnar.metadata is None:
         from delta_tpu.errors import DeltaError
 
-        raise DeltaError(
+        raise LogCorruptedError(
             f"log segment for version {segment.version} has no "
             f"{'protocol' if columnar.protocol is None else 'metadata'} action"
         )
@@ -384,7 +384,7 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
     if columnar.protocol is None or columnar.metadata is None:
         from delta_tpu.errors import DeltaError
 
-        raise DeltaError(
+        raise LogCorruptedError(
             f"log segment for version {segment.version} has no "
             f"{'protocol' if columnar.protocol is None else 'metadata'} action"
         )

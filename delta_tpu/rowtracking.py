@@ -18,7 +18,7 @@ import dataclasses
 import json
 from typing import List, Optional, Tuple
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, RowTrackingError
 from delta_tpu.models.actions import AddFile, DomainMetadata, Protocol
 
 ROW_TRACKING_DOMAIN = "delta.rowTracking"
@@ -60,7 +60,7 @@ def assign_fresh_row_ids(
         base = a.baseRowId
         if base is None:
             if num is None:
-                raise DeltaError(
+                raise RowTrackingError(
                     f"row tracking requires numRecords stats on {a.path}"
                 )
             base = next_id

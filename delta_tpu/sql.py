@@ -48,7 +48,7 @@ from __future__ import annotations
 import re
 from typing import Optional
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import CatalogTableError, DeltaError, DuplicateColumnError, SqlParseError, UnresolvedColumnError
 from delta_tpu.expressions.parser import parse_expression
 from delta_tpu.table import Table
 
@@ -99,7 +99,7 @@ def _table(m, engine, catalog=None) -> Table:
     ident = m.groupdict().get("ident")
     if ident is not None:
         if catalog is None:
-            raise DeltaError(
+            raise CatalogTableError(
                 f"table name {ident!r} requires a catalog (pass catalog=)"
             )
         return catalog.table(ident)
@@ -307,7 +307,7 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         try:
             new_type = PrimitiveType(_SQL_TYPES.get(typ, typ))
         except ValueError as e:
-            raise DeltaError(str(e)) from e
+            raise SqlParseError(str(e)) from e
         return change_column_type(
             _table(m, engine, catalog), m.group("col"), new_type)
 
@@ -379,7 +379,7 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         pred = parse_expression(m.group("where")) if m.group("where") else None
         return update(_table(m, engine, catalog), assignments, pred)
 
-    raise DeltaError(f"cannot parse Delta SQL statement: {statement!r}")
+    raise SqlParseError(f"cannot parse Delta SQL statement: {statement!r}")
 
 
 def _parse_properties(text: str) -> dict:
@@ -404,7 +404,7 @@ def _parse_column_defs(text: str):
             part, re.IGNORECASE | re.DOTALL,
         )
         if not m:
-            raise DeltaError(f"cannot parse column definition: {part!r}")
+            raise SqlParseError(f"cannot parse column definition: {part!r}")
         name = m.group("q") or m.group("name")
         typ = normalize_sql_type(m.group("type"))
         nullable = True
@@ -422,24 +422,24 @@ def _parse_column_defs(text: str):
                 try:
                     d_expr = parse_expression(default)  # fail at CREATE, not on write
                 except Exception as e:
-                    raise DeltaError(
+                    raise SqlParseError(
                         f"cannot parse DEFAULT expression {default!r}: {e}"
                     ) from None
                 if d_expr.references():
                     # protocol: column defaults must be constant expressions
-                    raise DeltaError(
+                    raise SqlParseError(
                         f"DEFAULT must be a constant expression, got {default!r}"
                     )
                 rest = rest[c.end():].strip()
                 continue
-            raise DeltaError(
+            raise SqlParseError(
                 f"cannot parse column constraint {rest!r} in {part!r}"
             )
         metadata = {CURRENT_DEFAULT_KEY: default} if default is not None else {}
         try:
             dtype = PrimitiveType(typ)
         except ValueError as e:
-            raise DeltaError(f"unsupported column type in {part!r}: {e}") from None
+            raise SqlParseError(f"unsupported column type in {part!r}: {e}") from None
         fields.append(
             StructField(name, dtype, nullable=nullable, metadata=metadata)
         )
@@ -459,7 +459,7 @@ def _catalog_statement(s: str, engine, catalog):
     )
     if m:
         if catalog is None:
-            raise DeltaError("CREATE TABLE <name> requires a catalog")
+            raise CatalogTableError("CREATE TABLE <name> requires a catalog")
         from delta_tpu.models.schema import StructType
 
         schema = StructType(_parse_column_defs(m.group("cols")))
@@ -483,12 +483,12 @@ def _catalog_statement(s: str, engine, catalog):
     )
     if m:
         if catalog is None:
-            raise DeltaError("DROP TABLE <name> requires a catalog")
+            raise CatalogTableError("DROP TABLE <name> requires a catalog")
         return catalog.drop(m.group("name"), if_exists=m.group("ife") is not None)
 
     if re.fullmatch(r"SHOW\s+TABLES", s, re.IGNORECASE):
         if catalog is None:
-            raise DeltaError("SHOW TABLES requires a catalog")
+            raise CatalogTableError("SHOW TABLES requires a catalog")
         return catalog.tables()
 
     return NotImplemented
@@ -509,7 +509,7 @@ def _rewrite_columns(expr, mapping):
         key = tuple(expr.name_path)
         if key in mapping:
             return Column((mapping[key],))
-        raise DeltaError(
+        raise UnresolvedColumnError(
             f"column {'.'.join(key)!r} is not in scope; available: "
             f"{sorted({'.'.join(k) if len(k) > 1 else k[0] for k in mapping})}")
     if not isinstance(expr, Expression) or not dataclasses.is_dataclass(expr):
@@ -532,7 +532,7 @@ def _parse_table_ref(text: str, engine, catalog):
     m = re.match(rf"{_PATH}(?:\s+(?:AS\s+)?(?P<alias>[A-Za-z_][A-Za-z0-9_]*))?\s*$",
                  text.strip(), re.IGNORECASE)
     if not m:
-        raise DeltaError(f"cannot parse table reference {text!r}")
+        raise SqlParseError(f"cannot parse table reference {text!r}")
     table = _table(m, engine, catalog)
     alias = m.group("alias")
     return table, alias
@@ -550,33 +550,71 @@ def _exec_select_extended(s: str, engine, catalog):
     return execute_select(s, engine=engine, catalog=catalog)
 
 
-def _needs_extended_select(s: str) -> bool:
-    up = re.sub(r"'[^']*'", "''", s).upper()
-    if re.search(r"\bJOIN\b|\bGROUP\s+BY\b|\bORDER\s+BY\b|\bHAVING\b"
-                 r"|\b(?:COUNT|SUM|MIN|MAX|AVG|STDDEV_SAMP|VAR_SAMP)\s*\("
-                 r"|\bCASE\b|\bEXISTS\b|\bBETWEEN\b|\bDISTINCT\b"
-                 r"|\bUNION\b|\(\s*SELECT\b|\bCAST\s*\("
-                 r"|\bNOT\s+(?:IN|LIKE|BETWEEN)\b|\bLIKE\b|\bIN\s*\("
-                 r"|\bINTERVAL\b|\bSUBSTR|\bCOALESCE\s*\(|\bCONCAT\s*\("
-                 r"|\|\||\bOVER\s*\(", up):
-        return True
-    # implicit comma join: a comma at FROM-list depth before any WHERE
-    m = re.search(r"\bFROM\b(?P<rest>.*)$", up, re.DOTALL)
-    if m:
-        rest = m.group("rest")
-        for stop in ("WHERE", "LIMIT"):
-            cut = re.search(rf"\b{stop}\b", rest)
-            if cut:
-                rest = rest[:cut.start()]
-        depth = 0
-        for ch in rest:
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-            elif ch == "," and depth == 0:
-                return True
-    return False
+def _simple_select(s: str, engine, catalog):
+    """Arrow-native fast path for `SELECT <plain cols|*> FROM <one
+    table> [time travel] [WHERE <pushdown-parseable pred>] [LIMIT n]`.
+    Returns NotImplemented for anything richer. Exists for type
+    fidelity, not just speed: the sqlengine's pandas round-trip turns
+    nullable int64 into float64 (lossy above 2^53) and date32 into
+    timestamps, while this path stays `Snapshot.scan().to_arrow()`
+    end-to-end."""
+    m = re.fullmatch(
+        rf"SELECT\s+(?P<cols>.+?)\s+FROM\s+{_PATH}"
+        r"(?:\s+VERSION\s+AS\s+OF\s+(?P<tt_version>\d+)"
+        r"|\s+TIMESTAMP\s+AS\s+OF\s+(?P<tt_ts>\d+|'[^']+'))?"
+        r"(?:\s+WHERE\s+(?P<where>.+?))?(?:\s+LIMIT\s+(?P<limit>\d+))?",
+        s, re.IGNORECASE | re.DOTALL,
+    )
+    if not m:
+        return NotImplemented
+    cols_text = m.group("cols").strip()
+    if cols_text == "*":
+        columns = None
+    else:
+        columns = [c.strip().strip("`")
+                   for c in _split_top_level_commas(cols_text)]
+        if not all(re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", c)
+                   for c in columns):
+            return NotImplemented  # expressions/aliases → sqlengine
+    if m.group("where"):
+        # NULL literals outside IS [NOT] NULL need three-valued logic
+        # the pushdown evaluator doesn't implement — sqlengine handles
+        stripped = re.sub(r"\bIS\s+(?:NOT\s+)?NULL\b", "",
+                          m.group("where"), flags=re.IGNORECASE)
+        if re.search(r"\bNULL\b", stripped, re.IGNORECASE):
+            return NotImplemented
+        try:
+            pred = parse_expression(m.group("where"))
+        except Exception:
+            return NotImplemented  # richer predicate → sqlengine
+    else:
+        pred = None
+    table = _table(m, engine, catalog)
+    if m.group("tt_version") is not None:
+        snap = table.snapshot_at(int(m.group("tt_version")))
+    elif m.group("tt_ts") is not None:
+        snap = table.snapshot_as_of_timestamp(
+            _timestamp_ms(m.group("tt_ts")))
+    else:
+        snap = table.latest_snapshot()
+    known = ({f.name for f in snap.schema.fields}
+             if snap.schema is not None else set())
+    if columns is not None and known:
+        unknown = [c for c in columns if c not in known]
+        if unknown:
+            raise UnresolvedColumnError(
+                f"column(s) {unknown} not found in table schema "
+                f"{sorted(known)}")
+    if pred is not None and known:
+        bad = sorted({r[0] for r in pred.references()} - known)
+        if bad:
+            raise UnresolvedColumnError(
+                f"WHERE references unknown column(s) {bad}; table "
+                f"schema is {sorted(known)}")
+    out = snap.scan(filter=pred, columns=columns).to_arrow()
+    if m.group("limit"):
+        out = out.slice(0, int(m.group("limit")))
+    return out
 
 
 def _query_statement(s: str, engine, catalog):
@@ -600,50 +638,14 @@ def _query_statement(s: str, engine, catalog):
             out = out.slice(0, int(m.group("limit")))
         return out
 
-    if re.match(r"SELECT\b", s, re.IGNORECASE) and _needs_extended_select(s):
+    if re.match(r"SELECT\b", s, re.IGNORECASE):
+        # plain single-table scans take the Arrow-native fast path
+        # (type fidelity); everything richer runs through the
+        # sqlengine parser/planner
+        result = _simple_select(s, engine, catalog)
+        if result is not NotImplemented:
+            return result
         return _exec_select_extended(s, engine, catalog)
-    m = re.fullmatch(
-        rf"SELECT\s+(?P<cols>.+?)\s+FROM\s+{_PATH}"
-        r"(?:\s+VERSION\s+AS\s+OF\s+(?P<tt_version>\d+)"
-        r"|\s+TIMESTAMP\s+AS\s+OF\s+(?P<tt_ts>\d+|'[^']+'))?"
-        r"(?:\s+WHERE\s+(?P<where>.+?))?(?:\s+LIMIT\s+(?P<limit>\d+))?",
-        s, re.IGNORECASE | re.DOTALL,
-    )
-    if m:
-        table = _table(m, engine, catalog)
-        if m.group("tt_version") is not None:
-            snap = table.snapshot_at(int(m.group("tt_version")))
-        elif m.group("tt_ts") is not None:
-            snap = table.snapshot_as_of_timestamp(
-                _timestamp_ms(m.group("tt_ts")))
-        else:
-            snap = table.latest_snapshot()
-        known = ({f.name for f in snap.schema.fields}
-                 if snap.schema is not None else set())
-        cols_text = m.group("cols").strip()
-        columns = (None if cols_text == "*"
-                   else [c.strip().strip("`")
-                         for c in _split_top_level_commas(cols_text)])
-        if columns is not None:
-            unknown = [c for c in columns if c not in known]
-            if unknown:
-                raise DeltaError(
-                    f"column(s) {unknown} not found in table schema "
-                    f"{sorted(known)}"
-                )
-        pred = parse_expression(m.group("where")) if m.group("where") else None
-        if pred is not None and known:
-            bad = sorted({r[0] for r in pred.references()} - known)
-            if bad:
-                raise DeltaError(
-                    f"WHERE references unknown column(s) {bad}; table "
-                    f"schema is {sorted(known)}"
-                )
-        scan = snap.scan(filter=pred, columns=columns)
-        out = scan.to_arrow()
-        if m.group("limit"):
-            out = out.slice(0, int(m.group("limit")))
-        return out
 
     m = re.fullmatch(
         rf"INSERT\s+(?:INTO|(?P<overwrite>OVERWRITE))\s+{_PATH}\s*"
@@ -661,15 +663,15 @@ def _query_statement(s: str, engine, catalog):
         rw = re.match(r"REPLACE\s+WHERE\s+", rest, re.IGNORECASE)
         if rw:
             if not m.group("overwrite"):
-                raise DeltaError("REPLACE WHERE requires INSERT OVERWRITE")
+                raise SqlParseError("REPLACE WHERE requires INSERT OVERWRITE")
             pred_str, rest = _split_before_keyword(rest[rw.end():], "VALUES")
             if rest is None:
-                raise DeltaError("REPLACE WHERE must be followed by VALUES")
+                raise SqlParseError("REPLACE WHERE must be followed by VALUES")
             replace_where = parse_expression(pred_str.strip())
         vm = re.match(r"VALUES\s+(?P<vals>.+)", rest,
                       re.IGNORECASE | re.DOTALL)
         if not vm:
-            raise DeltaError("INSERT requires a VALUES clause")
+            raise SqlParseError("INSERT requires a VALUES clause")
         vals_str = vm.group("vals")
 
         table = _table(m, engine, catalog)
@@ -680,9 +682,9 @@ def _query_statement(s: str, engine, catalog):
                        for c in m.group("collist").split(",")]
             unknown = [c for c in targets if c not in fields]
             if unknown:
-                raise DeltaError(f"INSERT column(s) {unknown} not in schema")
+                raise UnresolvedColumnError(f"INSERT column(s) {unknown} not in schema")
             if len(set(targets)) != len(targets):
-                raise DeltaError(f"duplicate INSERT column(s) in {targets}")
+                raise DuplicateColumnError(f"duplicate INSERT column(s) in {targets}")
         else:
             targets = list(fields)
         rows = []
@@ -691,14 +693,14 @@ def _query_statement(s: str, engine, catalog):
             for item in _split_top_level_commas(tup):
                 expr = parse_expression(item.strip())
                 if not isinstance(expr, Literal):
-                    raise DeltaError(
+                    raise SqlParseError(
                         f"INSERT VALUES must be literals, got {item!r}")
                 vals.append(expr.value)
             rows.append(vals)
         if not rows:
-            raise DeltaError("INSERT requires at least one VALUES tuple")
+            raise SqlParseError("INSERT requires at least one VALUES tuple")
         if any(len(r) != len(targets) for r in rows):
-            raise DeltaError(
+            raise SqlParseError(
                 f"each VALUES tuple must have exactly {len(targets)} "
                 f"value(s) for columns {targets}"
             )
@@ -728,7 +730,7 @@ def _handle_merge_into(s: str, engine, catalog):
     def take_table(text):
         m = re.match(_PATH, text)
         if not m:
-            raise DeltaError(f"cannot parse table reference near {text[:40]!r}")
+            raise SqlParseError(f"cannot parse table reference near {text[:40]!r}")
         return m, text[m.end():].lstrip()
 
     def take_alias(text):
@@ -743,15 +745,15 @@ def _handle_merge_into(s: str, engine, catalog):
     alias_t, rest = take_alias(rest)
     um = re.match(r"USING\s+", rest, re.IGNORECASE)
     if not um:
-        raise DeltaError("MERGE INTO requires a USING clause")
+        raise SqlParseError("MERGE INTO requires a USING clause")
     s_m, rest = take_table(rest[um.end():])
     alias_s, rest = take_alias(rest)
     onm = re.match(r"ON\s+", rest, re.IGNORECASE)
     if not onm:
-        raise DeltaError("MERGE INTO requires an ON condition")
+        raise SqlParseError("MERGE INTO requires an ON condition")
     on_text, rest = _split_before_keyword(rest[onm.end():], "WHEN")
     if rest is None:
-        raise DeltaError("MERGE INTO requires at least one WHEN clause")
+        raise SqlParseError("MERGE INTO requires at least one WHEN clause")
 
     # split the WHEN clauses at top level
     clause_texts = []
@@ -813,13 +815,13 @@ def _handle_merge_into(s: str, engine, catalog):
         # literal like 'a THEN b' inside the AND condition parses
         before_then, from_then = _split_before_keyword(text, "THEN")
         if from_then is None:
-            raise DeltaError(f"cannot parse MERGE clause: {text[:60]!r}")
+            raise SqlParseError(f"cannot parse MERGE clause: {text[:60]!r}")
         km = re.match(
             r"(?P<kind>MATCHED|NOT\s+MATCHED\s+BY\s+SOURCE|NOT\s+MATCHED)"
             r"(?:\s+AND\s+(?P<cond>.+))?\s*$",
             before_then.strip(), re.IGNORECASE | re.DOTALL)
         if not km:
-            raise DeltaError(f"cannot parse MERGE clause: {text[:60]!r}")
+            raise SqlParseError(f"cannot parse MERGE clause: {text[:60]!r}")
         kind = re.sub(r"\s+", " ", km.group("kind").upper())
         cond = (requalify(parse_expression(km.group("cond").strip()))
                 if km.group("cond") else None)
@@ -838,7 +840,7 @@ def _handle_merge_into(s: str, engine, catalog):
                                         action, flags=re.IGNORECASE)),
                     condition=cond)
             else:
-                raise DeltaError(f"unsupported MATCHED action {action!r}")
+                raise SqlParseError(f"unsupported MATCHED action {action!r}")
         elif kind == "NOT MATCHED":
             if a_up in ("INSERT *",):
                 builder = builder.when_not_matched_insert_all(condition=cond)
@@ -847,14 +849,14 @@ def _handle_merge_into(s: str, engine, catalog):
                               r"\((?P<vals>.+)\)\s*$", action,
                               re.IGNORECASE | re.DOTALL)
                 if not im:
-                    raise DeltaError(
+                    raise SqlParseError(
                         f"unsupported NOT MATCHED action {action!r}")
                 cols = [c.strip().strip("`")
                         for c in im.group("cols").split(",")]
                 vals = [requalify(parse_expression(v.strip()))
                         for v in _split_top_level_commas(im.group("vals"))]
                 if len(cols) != len(vals):
-                    raise DeltaError("INSERT column/value count mismatch")
+                    raise SqlParseError("INSERT column/value count mismatch")
                 builder = builder.when_not_matched_insert(
                     values=dict(zip(cols, vals)), condition=cond)
         else:  # NOT MATCHED BY SOURCE
@@ -867,7 +869,7 @@ def _handle_merge_into(s: str, engine, catalog):
                                         action, flags=re.IGNORECASE)),
                     condition=cond)
             else:
-                raise DeltaError(
+                raise SqlParseError(
                     f"unsupported NOT MATCHED BY SOURCE action {action!r}")
     return builder.execute()
 
@@ -884,7 +886,7 @@ def _timestamp_ms(raw: str) -> int:
         try:
             return int(dt.datetime.fromisoformat(text).timestamp() * 1000)
         except ValueError as e:
-            raise DeltaError(f"cannot parse timestamp {raw}: {e}") from None
+            raise SqlParseError(f"cannot parse timestamp {raw}: {e}") from None
     return int(raw)
 
 
@@ -943,11 +945,11 @@ def _split_values_tuples(s: str):
         elif depth >= 1:
             cur.append(ch)
         elif not ch.isspace() and ch != ",":
-            raise DeltaError(f"cannot parse VALUES tuples near {ch!r} in {s!r}")
+            raise SqlParseError(f"cannot parse VALUES tuples near {ch!r} in {s!r}")
     if depth != 0 or in_str:
-        raise DeltaError(f"unbalanced VALUES tuples: {s!r}")
+        raise SqlParseError(f"unbalanced VALUES tuples: {s!r}")
     if cur:
-        raise DeltaError(
+        raise SqlParseError(
             f"unexpected content outside VALUES tuples: {''.join(cur)!r}"
         )
     return out

@@ -32,7 +32,7 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import AmbiguousColumnError, CatalogTableError, DeltaError, SqlParseError, SubqueryShapeError, UnresolvedColumnError, UnsupportedSqlError
 from delta_tpu.sqlengine.parser import (
     And, Between, BinOp, CaseWhen, Cast, Cmp, Col, Exists, Func, InList,
     InSelect, Interval, IsNull, JoinClause, Like, Lit, Neg, Not, Or,
@@ -111,7 +111,7 @@ def _canon(e, resolve) -> str:
         return f"win({_canon(e.func, resolve)};part={parts};ord={orders})"
     if isinstance(e, (InSelect, Exists, ScalarSelect)):
         return f"subquery:{id(e)}"
-    raise DeltaError(f"cannot canonicalize {type(e).__name__}")
+    raise UnsupportedSqlError(f"cannot canonicalize {type(e).__name__}")
 
 
 def _split_and(e) -> list:
@@ -242,7 +242,7 @@ class _Exec:
             table = Table.for_path(ref.value, self.engine)
         else:
             if self.catalog is None:
-                raise DeltaError(
+                raise CatalogTableError(
                     f"table name {ref.value!r} requires a catalog "
                     "(pass catalog=)")
             table = self.catalog.table(ref.value)
@@ -276,7 +276,7 @@ class _Exec:
                 src = {"alias": alias, "snap": snap, "frame": None,
                        "cols": [f.name for f in snap.schema.fields]}
             if alias in seen_aliases:
-                raise DeltaError(f"duplicate table alias {alias!r}")
+                raise AmbiguousColumnError(f"duplicate table alias {alias!r}")
             seen_aliases.add(alias)
             sources.append(src)
         # sources[len(froms) + k] belongs to sel.joins[k]
@@ -293,10 +293,10 @@ class _Exec:
             if len(col.parts) >= 2:
                 alias, name = col.parts[-2], col.parts[-1]
                 if alias not in by_alias:
-                    raise DeltaError(f"table alias {alias!r} not found "
+                    raise UnresolvedColumnError(f"table alias {alias!r} not found "
                                      f"for column {col.text!r}")
                 if name not in by_alias[alias]["cols"]:
-                    raise DeltaError(
+                    raise UnresolvedColumnError(
                         f"column {col.text!r} not found in {alias!r}")
                 return f"{alias}.{name}"
             name = col.parts[0]
@@ -304,10 +304,10 @@ class _Exec:
             if len(owners) == 1:
                 return f"{owners[0]}.{name}"
             if not owners:
-                raise DeltaError(
+                raise UnresolvedColumnError(
                     f"column {name!r} not found; not in scope of any "
                     f"table ({sorted(by_alias)})")
-            raise DeltaError(
+            raise AmbiguousColumnError(
                 f"column {name!r} is ambiguous (in {owners}); qualify "
                 "with a table alias — not in scope unqualified")
 
@@ -366,7 +366,10 @@ class _Exec:
             filt = None
             for t in pushed[s["alias"]]:
                 filt = t if filt is None else (filt & t)
-            cols = sorted(needed[s["alias"]]) or s["cols"][:1]
+            # schema order, not sorted: SELECT * must present columns
+            # in table order
+            cols = [c for c in s["cols"] if c in needed[s["alias"]]] \
+                or s["cols"][:1]
             arrow = s["snap"].scan(filter=filt, columns=cols).to_arrow()
             df = arrow.to_pandas()
             df = _normalize_frame(df)
@@ -464,14 +467,14 @@ class _Exec:
                 if not (isinstance(conj, Cmp) and conj.op == "="
                         and isinstance(conj.left, Col)
                         and isinstance(conj.right, Col)):
-                    raise DeltaError(
+                    raise UnsupportedSqlError(
                         "JOIN ON supports conjunctions of column = "
                         f"column equalities; got {_render(conj)!r}")
                 pl, pr = resolve(conj.left), resolve(conj.right)
                 if pl.split(".", 1)[0] == a and pr.split(".", 1)[0] != a:
                     pl, pr = pr, pl
                 if pr.split(".", 1)[0] != a:
-                    raise DeltaError(
+                    raise UnsupportedSqlError(
                         f"JOIN keys {pl!r}/{pr!r} do not span the "
                         "two sides")
                 lk.append(pl)
@@ -509,7 +512,7 @@ class _Exec:
             _walk_exprs(o, check_agg)
 
         if sel.having is not None and not sel.group_by and not has_agg:
-            raise DeltaError(
+            raise SqlParseError(
                 "HAVING without GROUP BY requires an aggregate")
 
         alias_map = {it.alias: it.expr for it in sel.items if it.alias}
@@ -526,7 +529,7 @@ class _Exec:
         for it in sel.items:
             if isinstance(it.expr, Star):
                 if has_agg or sel.group_by:
-                    raise DeltaError("SELECT * cannot combine with "
+                    raise SqlParseError("SELECT * cannot combine with "
                                      "GROUP BY/aggregates")
                 for c in df.columns:
                     out_cols.append(df[c])
@@ -614,7 +617,7 @@ class _Exec:
         for k, f in agg_specs.items():
             if not f.star:
                 if len(f.args) != 1:
-                    raise DeltaError(
+                    raise SqlParseError(
                         f"{f.name} takes exactly one argument")
                 arg_cols[k] = self._eval(f.args[0], df)
                 work[f"__arg_{k}"] = arg_cols[k]
@@ -710,7 +713,7 @@ class _Exec:
             if canon in env:
                 return df[env[canon]]
             if isinstance(e, Col):
-                raise DeltaError(
+                raise SqlParseError(
                     f"column {e.text!r} in SELECT/HAVING/ORDER BY must "
                     "appear in GROUP BY or inside an aggregate")
             if isinstance(e, Lit):
@@ -762,8 +765,8 @@ class _Exec:
                         for a in e.args], df)
             if isinstance(e, Func) and e.name in _AGGS:
                 # canon miss should not happen (collected above)
-                raise DeltaError(f"aggregate {e.name} not computed")
-            raise DeltaError(
+                raise UnsupportedSqlError(f"aggregate {e.name} not computed")
+            raise UnsupportedSqlError(
                 f"unsupported expression over aggregated result: "
                 f"{_render(e)}")
         return self._eval(e, df)
@@ -848,16 +851,16 @@ class _Exec:
         if isinstance(e, ScalarSelect):
             out = execute_select(e.select, self.engine, self.catalog)
             if out.num_columns != 1:
-                raise DeltaError("scalar subquery must return one column")
+                raise SqlParseError("scalar subquery must return one column")
             if out.num_rows == 0:
                 return None
             if out.num_rows > 1:
-                raise DeltaError("scalar subquery returned >1 row")
+                raise SubqueryShapeError("scalar subquery returned >1 row")
             return out.column(0)[0].as_py()
         if isinstance(e, InSelect):
             out = execute_select(e.select, self.engine, self.catalog)
             if out.num_columns != 1:
-                raise DeltaError("IN subquery must return one column")
+                raise SqlParseError("IN subquery must return one column")
             raw = out.column(0).to_pylist()
             has_null = any(x is None for x in raw)
             vals = set(x for x in raw if x is not None)
@@ -872,12 +875,12 @@ class _Exec:
             return flag
         if isinstance(e, Func):
             if e.name in _AGGS:
-                raise DeltaError(
+                raise SqlParseError(
                     f"aggregate {e.name}(...) is not allowed here")
             return self._scalar_func(e, df)
         if isinstance(e, Star):
-            raise DeltaError("* is only allowed as a lone select item")
-        raise DeltaError(f"unsupported expression {type(e).__name__}")
+            raise SqlParseError("* is only allowed as a lone select item")
+        raise UnsupportedSqlError(f"unsupported expression {type(e).__name__}")
 
     def _scalar_func(self, e: Func, df):
         return self._apply_func(e, [self._eval(a, df) for a in e.args],
@@ -890,7 +893,7 @@ class _Exec:
         row_number additionally use the ORDER BY clause."""
         name = e.func.name
         if e.func.distinct:
-            raise DeltaError(
+            raise UnsupportedSqlError(
                 f"DISTINCT inside window function {name} is not "
                 "supported")
         parts = [ev(p) for p in e.partition_by]
@@ -923,7 +926,7 @@ class _Exec:
                              index=df.index)
         if name in ("rank", "row_number", "dense_rank"):
             if not e.order_by:
-                raise DeltaError(f"{name}() requires ORDER BY")
+                raise SqlParseError(f"{name}() requires ORDER BY")
             work = pd.DataFrame(index=pd.RangeIndex(len(df)))
             pcols, ocols, ascs = [], [], []
             for i, p in enumerate(parts):
@@ -965,7 +968,7 @@ class _Exec:
                     dropna=False).transform("max")
             out = ranks.sort_index()
             return pd.Series(out.values, index=df.index)
-        raise DeltaError(f"unsupported window function {name!r}")
+        raise UnsupportedSqlError(f"unsupported window function {name!r}")
 
     @staticmethod
     def _running_window(e: Window, df, ev, s, fn, parts):
@@ -1002,6 +1005,10 @@ class _Exec:
 
     def _apply_func(self, e: Func, args, df):
         name = e.name
+        if e.star:
+            raise SqlParseError(
+                f"* argument is only allowed in count(*), not "
+                f"{name}(*)")
         if name in ("substr", "substring"):
             s, start, length = args[0], int(args[1]), int(args[2]) \
                 if len(args) > 2 else None
@@ -1044,7 +1051,7 @@ class _Exec:
             return args[0].dt.year
         if name == "month":
             return args[0].dt.month
-        raise DeltaError(f"unsupported function {name!r}")
+        raise UnsupportedSqlError(f"unsupported function {name!r}")
 
     @staticmethod
     def _truth(m):
@@ -1226,7 +1233,7 @@ def _binop(op, l, r):
         ls = l.astype("string") if isinstance(l, pd.Series) else str(l)
         rs = r.astype("string") if isinstance(r, pd.Series) else str(r)
         return ls + rs
-    raise DeltaError(f"unsupported operator {op!r}")
+    raise UnsupportedSqlError(f"unsupported operator {op!r}")
 
 
 def _coerce_datetime(l, r):
@@ -1258,7 +1265,7 @@ def _cmp(op, l, r):
     elif op == ">=":
         res = l >= r
     else:
-        raise DeltaError(f"unsupported comparison {op!r}")
+        raise UnsupportedSqlError(f"unsupported comparison {op!r}")
     return _with_nulls(res, l, r)
 
 
@@ -1277,4 +1284,4 @@ def _cast(v, type_name):
         return v.astype("string") if isinstance(v, pd.Series) else str(v)
     if type_name.startswith("decimal"):
         return v.astype(float) if isinstance(v, pd.Series) else float(v)
-    raise DeltaError(f"unsupported CAST target {type_name!r}")
+    raise UnsupportedSqlError(f"unsupported CAST target {type_name!r}")

@@ -18,7 +18,7 @@ import re
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, SqlParseError
 
 
 # ------------------------------------------------------------- AST ----
@@ -238,7 +238,7 @@ def tokenize(s: str) -> List[Token]:
     while pos < n:
         m = _TOKEN_RE.match(s, pos)
         if m is None:
-            raise DeltaError(f"cannot tokenize SQL at {s[pos:pos+30]!r}")
+            raise SqlParseError(f"cannot tokenize SQL at {s[pos:pos+30]!r}")
         pos = m.end()
         kind = m.lastgroup
         if kind == "ws":
@@ -303,12 +303,12 @@ class _P:
 
     def expect_kw(self, name: str) -> None:
         if not self.accept_kw(name):
-            raise DeltaError(
+            raise SqlParseError(
                 f"expected {name} at {self._ctx()}")
 
     def expect_op(self, op: str) -> None:
         if not self.accept_op(op):
-            raise DeltaError(f"expected {op!r} at {self._ctx()}")
+            raise SqlParseError(f"expected {op!r} at {self._ctx()}")
 
     def _ctx(self) -> str:
         t = self.peek()
@@ -336,7 +336,7 @@ class _P:
                 on = None
                 if kind != "cross":
                     if not self.accept_kw("ON"):
-                        raise DeltaError("JOIN requires ON")
+                        raise SqlParseError("JOIN requires ON")
                     on = self._expr()
                 sel.joins.append(JoinClause(ref, kind, on))
         if self.accept_kw("WHERE"):
@@ -356,7 +356,7 @@ class _P:
         if self.accept_kw("LIMIT"):
             t = self.next()
             if t.kind != "num":
-                raise DeltaError(f"LIMIT expects a number, got {t.value!r}")
+                raise SqlParseError(f"LIMIT expects a number, got {t.value!r}")
             sel.limit = int(t.value)
         return sel
 
@@ -390,7 +390,7 @@ class _P:
     def _ident_token(self) -> Token:
         t = self.next()
         if t.kind not in ("ident", "bstr", "dstr"):
-            raise DeltaError(f"expected identifier, got {t.value!r}")
+            raise SqlParseError(f"expected identifier, got {t.value!r}")
         return t
 
     # -- table refs -----------------------------------------------------
@@ -423,21 +423,21 @@ class _P:
             self.next()
             kind, value = "path", t.value
         else:
-            raise DeltaError(f"expected table reference at {self._ctx()}")
+            raise SqlParseError(f"expected table reference at {self._ctx()}")
         tt_version = tt_ts = None
         if self.accept_kw("VERSION"):
             self.expect_kw("AS")
             self.expect_kw("OF")
             tok = self.next()
             if tok.kind != "num":
-                raise DeltaError("VERSION AS OF expects a number")
+                raise SqlParseError("VERSION AS OF expects a number")
             tt_version = int(tok.value)
         elif self.accept_kw("TIMESTAMP"):
             self.expect_kw("AS")
             self.expect_kw("OF")
             tok = self.next()
             if tok.kind not in ("num", "str"):
-                raise DeltaError("TIMESTAMP AS OF expects a value")
+                raise SqlParseError("TIMESTAMP AS OF expects a value")
             # preserve the literal kind: _timestamp_ms only treats a
             # leading quote as "parse as ISO", so a bare ISO string
             # would fall through to int() and crash
@@ -537,7 +537,7 @@ class _P:
             self.next()
             pat = self.next()
             if pat.kind != "str":
-                raise DeltaError("LIKE expects a string pattern")
+                raise SqlParseError("LIKE expects a string pattern")
             return Like(left, pat.value, negated)
         return left
 
@@ -610,7 +610,7 @@ class _P:
                 while depth:
                     tok = self.next()
                     if tok.kind == "end":
-                        raise DeltaError("unterminated CAST type")
+                        raise SqlParseError("unterminated CAST type")
                     if tok.kind == "op" and tok.value == "(":
                         depth += 1
                     elif tok.kind == "op" and tok.value == ")":
@@ -621,10 +621,10 @@ class _P:
             self.next()
             num = self.next()
             if num.kind != "num":
-                raise DeltaError("INTERVAL expects a number")
+                raise SqlParseError("INTERVAL expects a number")
             unit_tok = self._ident_token().value.lower().rstrip("s")
             if unit_tok not in ("day",):
-                raise DeltaError(f"unsupported INTERVAL unit {unit_tok!r}")
+                raise SqlParseError(f"unsupported INTERVAL unit {unit_tok!r}")
             return Interval(int(num.value), unit_tok)
         if t.is_kw("EXISTS"):
             self.next()
@@ -660,7 +660,7 @@ class _P:
                 self.next()
                 parts.append(self._ident_token().value)
             return Col(tuple(parts))
-        raise DeltaError(f"unexpected token at {self._ctx()}")
+        raise SqlParseError(f"unexpected token at {self._ctx()}")
 
 
 def parse_select(statement: str) -> Select:
@@ -669,7 +669,7 @@ def parse_select(statement: str) -> Select:
     p = _P(toks, statement)
     sel = p.parse_select()
     if p.peek().kind != "end":
-        raise DeltaError(f"unexpected trailing SQL at {p._ctx()}")
+        raise SqlParseError(f"unexpected trailing SQL at {p._ctx()}")
     return sel
 
 
@@ -719,7 +719,7 @@ def _parse_case(self: _P) -> object:
         else_ = self._expr()
     self.expect_kw("END")
     if not whens:
-        raise DeltaError("CASE requires at least one WHEN")
+        raise SqlParseError("CASE requires at least one WHEN")
     return CaseWhen(tuple(whens), else_)
 
 

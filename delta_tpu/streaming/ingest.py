@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, StreamingSourceError
 from delta_tpu.models.actions import AddFile
 from delta_tpu.txn.transaction import Operation
 from delta_tpu.write.writer import write_data_files
@@ -101,7 +101,7 @@ class GlobalCommitter:
         reference)."""
         for c in committables:
             if c.checkpoint_id != checkpoint_id:
-                raise DeltaError(
+                raise StreamingSourceError(
                     f"committable for checkpoint {c.checkpoint_id} handed "
                     f"to commit of checkpoint {checkpoint_id}")
         with self._lock:

@@ -13,7 +13,7 @@ from typing import Dict, Optional, Sequence
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, StreamingSourceError
 from delta_tpu.models.schema import from_arrow_schema
 from delta_tpu.table import Table
 from delta_tpu.txn.transaction import Operation
@@ -33,7 +33,7 @@ class DeltaSink:
         self.query_id = query_id
         self.partition_by = list(partition_by or [])
         if output_mode not in ("append", "complete"):
-            raise DeltaError(f"unsupported output mode {output_mode}")
+            raise StreamingSourceError(f"unsupported output mode {output_mode}")
         self.output_mode = output_mode
 
     def add_batch(self, batch_id: int, data: pa.Table) -> Optional[int]:

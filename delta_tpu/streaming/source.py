@@ -20,7 +20,7 @@ from typing import Iterator, List, Optional
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, StreamingSchemaChangeError, StreamingSourceError
 from delta_tpu.models.actions import (
     AddFile,
     CommitInfo,
@@ -152,7 +152,7 @@ class _ExpiryGuard:
                     return
             except ValueError:
                 continue
-        raise DeltaError(
+        raise StreamingSourceError(
             f"commit {v} required by this {self._what} no longer exists "
             "(expired by log cleanup); restart the stream from a fresh "
             "snapshot")
@@ -242,7 +242,7 @@ class DeltaSource:
                 adds.append(a)
             elif isinstance(a, RemoveFile) and a.dataChange:
                 if not (self.ignore_deletes or self.ignore_changes):
-                    raise DeltaError(
+                    raise StreamingSourceError(
                         f"streaming source found a data-changing remove in "
                         f"version {version}; set ignore_deletes/ignore_changes "
                         "or use the CDC reader"
@@ -259,9 +259,9 @@ class DeltaSource:
         if baseline is None or meta.schemaString == baseline:
             return
         if self.schema_log is None:
-            from delta_tpu.errors import DeltaError
+            from delta_tpu.errors import DeltaError, StreamingSchemaChangeError, StreamingSourceError
 
-            raise DeltaError(
+            raise StreamingSchemaChangeError(
                 f"table schema changed at version {version}; restart the "
                 "stream (attach a SchemaTrackingLog to evolve automatically)"
             )
@@ -435,12 +435,16 @@ class DeltaCDCSource:
     — the reference's initial-snapshot-as-inserts contract."""
 
     def __init__(self, table, starting_version: Optional[int] = None):
-        from delta_tpu.config import ENABLE_CDF, get_table_config
+        from delta_tpu.config import ENABLE_CDF, cdf_enabled, get_table_config
 
         self.table = table
         snap = table.latest_snapshot()
-        if not get_table_config(snap.metadata.configuration, ENABLE_CDF):
-            raise DeltaError(
+        if not cdf_enabled(snap.metadata.configuration):
+            from delta_tpu.errors import CdcNotEnabledError
+
+            # same class as the batch CDC reader: callers match on
+            # DELTA_MISSING_CHANGE_DATA for both surfaces
+            raise CdcNotEnabledError(
                 "change data feed is not enabled on this table "
                 "(set delta.enableChangeDataFeed=true)"
             )
@@ -519,7 +523,7 @@ class DeltaCDCSource:
                     # deliver commits admitted before the schema change;
                     # the next poll starts AT the change and raises
                     return last
-                raise DeltaError(
+                raise StreamingSchemaChangeError(
                     f"table schema changed at version {sc.version}; "
                     "restart the CDC stream to continue with the new "
                     "schema") from None

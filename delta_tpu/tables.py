@@ -24,7 +24,7 @@ from typing import Dict, Optional, Union
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, InvalidArgumentError
 from delta_tpu.expressions.parser import parse_expression
 from delta_tpu.expressions.tree import Expression
 from delta_tpu.table import Table
@@ -56,13 +56,13 @@ class DeltaTable:
     def forPath(cls, path: str, engine=None) -> "DeltaTable":
         t = Table.for_path(path, engine)
         if not t.exists():
-            raise DeltaError(f"{path} is not a Delta table")
+            raise InvalidArgumentError(f"{path} is not a Delta table")
         return cls(t)
 
     @classmethod
     def forName(cls, name: str, catalog=None) -> "DeltaTable":
         if catalog is None:
-            raise DeltaError("forName requires a catalog")
+            raise InvalidArgumentError("forName requires a catalog")
         return cls(catalog.table(name))
 
     @classmethod
@@ -99,7 +99,7 @@ class DeltaTable:
     def update(self, condition: ExprOrStr = None,
                set: Optional[Dict[str, object]] = None):
         if not set:
-            raise DeltaError("update requires a set mapping")
+            raise InvalidArgumentError("update requires a set mapping")
         from delta_tpu.commands.dml import update
 
         return update(self._table, _exprs(set), predicate=_expr(condition))
@@ -122,7 +122,7 @@ class DeltaTable:
 
     def generate(self, mode: str) -> None:
         if mode != "symlink_format_manifest":
-            raise DeltaError(f"unsupported generate mode {mode!r}")
+            raise InvalidArgumentError(f"unsupported generate mode {mode!r}")
         from delta_tpu.commands.generate import generate_symlink_manifest
 
         generate_symlink_manifest(self._table)
@@ -232,7 +232,7 @@ class DeltaTableBuilder:
         cols = list(cols)
         bad = [c for c in cols if not isinstance(c, StructField)]
         if bad:
-            raise DeltaError(
+            raise InvalidArgumentError(
                 f"addColumns takes StructFields or a StructType, got "
                 f"{type(bad[0]).__name__}")
         self._columns.extend(cols)
@@ -250,10 +250,10 @@ class DeltaTableBuilder:
         from delta_tpu.models.schema import StructType
 
         if not self._columns:
-            raise DeltaError("table builder requires at least one column")
+            raise InvalidArgumentError("table builder requires at least one column")
         if self._location is None:
             if self._name is None or self._catalog is None:
-                raise DeltaError(
+                raise InvalidArgumentError(
                     "table builder needs a location (or a tableName plus "
                     "a catalog)")
             self._location = self._catalog.default_location(self._name)
@@ -264,17 +264,17 @@ class DeltaTableBuilder:
                 self._catalog.exists(self._name):
             registered = self._catalog.table(self._name).path
             if registered != table.path:
-                raise DeltaError(
+                raise InvalidArgumentError(
                     f"catalog already maps {self._name!r} to "
                     f"{registered}, not {table.path}")
         exists = table.exists()
         if not exists and self._mode == "replace":
             # matches the reference: replace() demands an existing table
-            raise DeltaError(
+            raise InvalidArgumentError(
                 f"table {self._location} cannot be replaced as it does "
                 "not exist; use createOrReplace()")
         if exists and self._mode == "create":
-            raise DeltaError(f"table {self._location} already exists")
+            raise InvalidArgumentError(f"table {self._location} already exists")
 
         import dataclasses
 
@@ -335,7 +335,7 @@ class DeltaTableBuilder:
                 # (fine) or another writer raced us to the name
                 registered = self._catalog.table(self._name).path
                 if registered != table.path:
-                    raise DeltaError(
+                    raise InvalidArgumentError(
                         f"catalog already maps {self._name!r} to "
                         f"{registered}, not {table.path}") from None
         return DeltaTable(table)
@@ -374,7 +374,7 @@ class DeltaMergeBuilder:
                           set: Optional[Dict[str, object]] = None
                           ) -> "DeltaMergeBuilder":
         if not set:
-            raise DeltaError("whenMatchedUpdate requires a set mapping")
+            raise InvalidArgumentError("whenMatchedUpdate requires a set mapping")
         self._b = self._b.when_matched_update(set=_exprs(set),
                                               condition=_expr(condition))
         return self
@@ -393,7 +393,7 @@ class DeltaMergeBuilder:
                              values: Optional[Dict[str, object]] = None
                              ) -> "DeltaMergeBuilder":
         if not values:
-            raise DeltaError("whenNotMatchedInsert requires values")
+            raise InvalidArgumentError("whenNotMatchedInsert requires values")
         self._b = self._b.when_not_matched_insert(
             values=_exprs(values), condition=_expr(condition))
         return self
@@ -409,7 +409,7 @@ class DeltaMergeBuilder:
         set: Optional[Dict[str, object]] = None,
     ) -> "DeltaMergeBuilder":
         if not set:
-            raise DeltaError(
+            raise InvalidArgumentError(
                 "whenNotMatchedBySourceUpdate requires a set mapping")
         self._b = self._b.when_not_matched_by_source_update(
             set=_exprs(set), condition=_expr(condition))

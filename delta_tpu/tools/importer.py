@@ -26,7 +26,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import pyarrow as pa
 
-from delta_tpu.errors import DeltaError
+from delta_tpu.errors import DeltaError, ImportError_
 
 DEFAULT_CHUNK_ROWS = 1_000_000
 
@@ -53,7 +53,7 @@ def _detect_format(path: str) -> str:
         return "ndjson"
     if ext in ("db", "sqlite", "sqlite3"):
         return "sqlite"
-    raise DeltaError(
+    raise ImportError_(
         f"cannot infer import format from {path!r}; pass --format")
 
 
@@ -67,7 +67,7 @@ def _expand_sources(source: str) -> List[str]:
         files = sorted(glob.glob(source)) or [source]
     missing = [f for f in files if not os.path.exists(f)]
     if missing:
-        raise DeltaError(f"source file(s) not found: {missing}")
+        raise ImportError_(f"source file(s) not found: {missing}")
     return files
 
 
@@ -102,7 +102,7 @@ def _iter_batches(path: str, fmt: str, chunk_rows: int,
     elif fmt == "sqlite":
         yield from _iter_sqlite(path, query, chunk_rows)
     else:
-        raise DeltaError(f"unsupported import format {fmt!r}")
+        raise ImportError_(f"unsupported import format {fmt!r}")
 
 
 def _iter_sqlite(path: str, query: Optional[str],
@@ -115,7 +115,7 @@ def _iter_sqlite(path: str, query: Optional[str],
             tables = [r[0] for r in conn.execute(
                 "SELECT name FROM sqlite_master WHERE type='table'")]
             if len(tables) != 1:
-                raise DeltaError(
+                raise ImportError_(
                     f"sqlite source has tables {tables}; pass --query "
                     "'SELECT ... FROM <table>'")
             query = f"SELECT * FROM {tables[0]}"
@@ -201,7 +201,7 @@ def import_into_delta(
                 result.first_version = v
             result.last_version = v
     if result.num_chunks == 0:
-        raise DeltaError(f"source {source!r} produced no rows")
+        raise ImportError_(f"source {source!r} produced no rows")
     return result
 
 

@@ -32,6 +32,7 @@ from delta_tpu.config import (
 from delta_tpu.errors import (
     ConcurrentTransactionError,
     DeltaError,
+    InvalidArgumentError,
     MaxCommitRetriesExceededError,
     MetadataChangedError,
     ProtocolChangedError,
@@ -145,7 +146,7 @@ class TransactionBuilder:
             snapshot = None
 
         if snapshot is None and self._schema is None:
-            raise DeltaError(
+            raise InvalidArgumentError(
                 f"table {self._table.path} does not exist; provide a schema "
                 "to create it"
             )
@@ -172,11 +173,18 @@ class TransactionBuilder:
                 from delta_tpu.columnmapping import assign_column_mapping
 
                 schema_obj, props = assign_column_mapping(schema_obj, props)
+            # creation-only protocol properties are consumed here, not
+            # persisted in Metadata.configuration (reference strips
+            # them the same way)
+            persisted = {k: v for k, v in props.items()
+                         if k not in ("delta.minReaderVersion",
+                                      "delta.minWriterVersion",
+                                      "delta.ignoreProtocolDefaults")}
             metadata = Metadata(
                 id=str(uuid.uuid4()),
                 schemaString=schema_to_json(schema_obj),
                 partitionColumns=list(self._partition_columns or []),
-                configuration=props,
+                configuration=persisted,
                 createdTime=int(time.time() * 1000),
             )
             txn.update_metadata(metadata)
@@ -323,12 +331,12 @@ class Transaction:
             known = {f.name for f in schema.fields} if schema else set()
             missing = [c for c in pcols if c not in known]
             if missing:
-                raise DeltaError(
+                raise InvalidArgumentError(
                     f"partition column(s) {missing} not found in schema "
                     f"{sorted(known)}"
                 )
             if len(set(pcols)) != len(pcols):
-                raise DeltaError(f"duplicate partition columns: {pcols}")
+                raise InvalidArgumentError(f"duplicate partition columns: {pcols}")
         self._new_metadata = metadata
 
     def update_protocol(self, protocol: Protocol) -> None:
@@ -359,9 +367,9 @@ class Transaction:
         order actions; first line is commitInfo (required when ICT on)."""
         meta = self.metadata()
         if meta is None:
-            raise DeltaError("cannot commit a transaction with no metadata")
+            raise InvalidArgumentError("cannot commit a transaction with no metadata")
         if self.read_snapshot is None and self._new_protocol is None:
-            raise DeltaError("new table commit must include a protocol")
+            raise InvalidArgumentError("new table commit must include a protocol")
         from delta_tpu.features import validate_writable
 
         validate_writable(self.protocol(), meta)
@@ -379,7 +387,7 @@ class Transaction:
             # commands check earlier, but a raw transaction must not
             # bypass the table contract. dataChange=false removes
             # (OPTIMIZE rewrites) stay allowed.
-            raise DeltaError(
+            raise InvalidArgumentError(
                 "This table is configured to only allow appends "
                 "(delta.appendOnly=true); data-changing removes are not "
                 "permitted")
@@ -476,6 +484,15 @@ class Transaction:
     def _isolation_level(self) -> IsolationLevel:
         if self._isolation is not None:
             return self._isolation
+        # delta.isolationLevel table property overrides the
+        # data-changed default (DeltaConfig.scala isolationLevel)
+        meta = self.metadata()
+        if meta is not None:
+            from delta_tpu.config import ISOLATION_LEVEL, get_table_config
+
+            raw = meta.configuration.get(ISOLATION_LEVEL.key)
+            if raw is not None:
+                return IsolationLevel(ISOLATION_LEVEL.parse(raw))
         return default_isolation_level(self.data_changed)
 
     def _read_state(self) -> TransactionReadState:
@@ -551,7 +568,7 @@ class Transaction:
     def commit(self) -> CommitResult:
         """doCommitRetryIteratively (`OptimisticTransaction.scala:2198`)."""
         if self._committed:
-            raise DeltaError("transaction already committed")
+            raise InvalidArgumentError("transaction already committed")
         engine = self._table.engine
         log_path = self._table.log_path
         attempt_version = self.read_version + 1

@@ -1,0 +1,369 @@
+"""Per-construct sqlengine coverage (VERDICT r3 ask #2).
+
+One test per AST node type / surface feature of
+`delta_tpu/sqlengine/parser.py` + `executor.py`: subqueries
+(scalar/IN/EXISTS), CASE WHEN, BETWEEN, LIKE, substr and the scalar
+function set, CAST/INTERVAL date arithmetic, operators, null
+semantics, and parser/executor error paths. The reference's pattern is
+a suite per feature (SURVEY §4.4); TPC-DS end-to-end coverage lives in
+test_tpcds.py, window functions in test_sql_window.py.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.errors import DeltaError
+from delta_tpu.sql import sql
+
+
+@pytest.fixture
+def t(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "id": pa.array([1, 2, 3, 4, None], pa.int64()),
+        "v": pa.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+        "s": pa.array(["apple", "banana", "cherry", None, "apricot"]),
+        "d": pa.array([18262, 18293, 18322, 18353, 18383],
+                      pa.date32()),  # 2020-01-01 .. 2020-05-01
+    }))
+    return tmp_table_path
+
+
+@pytest.fixture
+def other(tmp_path):
+    p = str(tmp_path / "other")
+    dta.write_table(p, pa.table({
+        "k": pa.array([2, 3, 9], pa.int64()),
+        "w": pa.array([200.0, 300.0, 900.0]),
+    }))
+    return p
+
+
+# ---- literals, operators, projection --------------------------------
+
+def test_literals_and_arithmetic(t):
+    out = sql(f"SELECT id, v + 1, v - 1, v * 2, v / 2, -v "
+              f"FROM '{t}' WHERE id = 1")
+    row = [c[0].as_py() for c in out.columns]
+    assert row == [1, 11.0, 9.0, 20.0, 5.0, -10.0]
+
+
+def test_string_concat_operator(t):
+    out = sql(f"SELECT s || '_x' FROM '{t}' WHERE id = 1")
+    assert out.column(0).to_pylist() == ["apple_x"]
+
+
+def test_comparison_operators(t):
+    for op, expect in [("=", [3]), ("<>", [1, 2, 4]), ("<", [1, 2]),
+                      ("<=", [1, 2, 3]), (">", [4]), (">=", [3, 4])]:
+        out = sql(f"SELECT id FROM '{t}' WHERE id {op} 3 ORDER BY id")
+        assert out.column("id").to_pylist() == expect, op
+
+
+def test_select_star_and_alias(t):
+    out = sql(f"SELECT * FROM '{t}' WHERE id = 1")
+    assert out.column_names == ["id", "v", "s", "d"]
+    out = sql(f"SELECT v AS val FROM '{t}' WHERE id = 1")
+    assert out.column_names == ["val"]
+
+
+def test_distinct(t):
+    out = sql(f"SELECT DISTINCT CASE WHEN id < 3 THEN 'lo' ELSE 'hi' "
+              f"END AS bucket FROM '{t}' WHERE id IS NOT NULL")
+    assert sorted(out.column("bucket").to_pylist()) == ["hi", "lo"]
+
+
+def test_limit_and_order(t):
+    out = sql(f"SELECT id FROM '{t}' ORDER BY id DESC LIMIT 2")
+    assert out.column("id").to_pylist() == [4, 3]
+    # nulls first when ascending
+    out = sql(f"SELECT id FROM '{t}' ORDER BY id")
+    assert out.column("id").to_pylist() == [None, 1, 2, 3, 4]
+
+
+# ---- CASE WHEN ------------------------------------------------------
+
+def test_case_when_else(t):
+    out = sql(f"SELECT CASE WHEN v < 25 THEN 'small' WHEN v < 45 "
+              f"THEN 'mid' ELSE 'big' END c FROM '{t}' ORDER BY v")
+    assert out.column("c").to_pylist() == \
+        ["small", "small", "mid", "mid", "big"]
+
+
+def test_case_when_no_else_yields_null(t):
+    out = sql(f"SELECT CASE WHEN v < 25 THEN v END c FROM '{t}' "
+              f"ORDER BY v")
+    got = out.column("c").to_pylist()
+    assert got[:2] == [10.0, 20.0] and got[2:] == [None, None, None]
+
+
+def test_case_when_null_condition_is_false(t):
+    # id IS NULL on the null row: `id < 3` is NULL -> branch not taken
+    out = sql(f"SELECT CASE WHEN id < 3 THEN 'y' ELSE 'n' END c "
+              f"FROM '{t}' WHERE id IS NULL")
+    assert out.column("c").to_pylist() == ["n"]
+
+
+# ---- BETWEEN / IN / LIKE / IS NULL ----------------------------------
+
+def test_between_and_not_between(t):
+    out = sql(f"SELECT id FROM '{t}' WHERE v BETWEEN 15 AND 35 "
+              f"ORDER BY id")
+    assert out.column("id").to_pylist() == [2, 3]
+    out = sql(f"SELECT id FROM '{t}' WHERE v NOT BETWEEN 15 AND 35 "
+              f"ORDER BY id")
+    assert out.column("id").to_pylist() == [None, 1, 4]
+
+
+def test_in_list_with_null_literal(t):
+    # x IN (.., NULL): TRUE on match, NULL (excluded) otherwise
+    out = sql(f"SELECT id FROM '{t}' WHERE id IN (1, NULL)")
+    assert out.column("id").to_pylist() == [1]
+    out = sql(f"SELECT id FROM '{t}' WHERE id NOT IN (1, NULL)")
+    assert out.num_rows == 0
+
+
+def test_like_patterns(t):
+    out = sql(f"SELECT s FROM '{t}' WHERE s LIKE 'ap%' ORDER BY s")
+    assert out.column("s").to_pylist() == ["apple", "apricot"]
+    out = sql(f"SELECT s FROM '{t}' WHERE s LIKE '_anana'")
+    assert out.column("s").to_pylist() == ["banana"]
+    # regex metacharacters in the pattern are literal
+    out = sql(f"SELECT s FROM '{t}' WHERE s LIKE 'a.p%'")
+    assert out.num_rows == 0
+
+
+def test_is_null_and_not_null(t):
+    assert sql(f"SELECT v FROM '{t}' WHERE id IS NULL") \
+        .column("v").to_pylist() == [50.0]
+    assert sql(f"SELECT COUNT(*) n FROM '{t}' WHERE id IS NOT NULL") \
+        .column("n").to_pylist() == [4]
+
+
+# ---- CAST / INTERVAL / date arithmetic ------------------------------
+
+def test_cast_types(t):
+    out = sql(f"SELECT CAST(v AS int) i, CAST(id AS double) f, "
+              f"CAST(id AS string) st, CAST(v AS decimal(10,2)) dec "
+              f"FROM '{t}' WHERE id = 2")
+    assert out.column("i").to_pylist() == [20]
+    assert out.column("f").to_pylist() == [2.0]
+    assert out.column("st").to_pylist() == ["2"]
+    assert out.column("dec").to_pylist() == [20.0]
+
+
+def test_cast_date_and_interval_arithmetic(t):
+    out = sql(f"SELECT id FROM '{t}' WHERE d BETWEEN "
+              f"cast('2020-01-15' as date) AND "
+              f"(cast('2020-01-15' as date) + interval 60 days) "
+              f"ORDER BY id")
+    assert out.column("id").to_pylist() == [2, 3]
+
+
+def test_date_parts(t):
+    out = sql(f"SELECT year(d) y, month(d) m FROM '{t}' WHERE id = 3")
+    assert out.column("y").to_pylist() == [2020]
+    assert out.column("m").to_pylist() == [3]
+
+
+# ---- scalar functions -----------------------------------------------
+
+def test_substr_upper_lower_length(t):
+    out = sql(f"SELECT substr(s, 1, 3) a, upper(s) u, lower(upper(s)) "
+              f"lo, length(s) n FROM '{t}' WHERE id = 2")
+    assert out.column("a").to_pylist() == ["ban"]
+    assert out.column("u").to_pylist() == ["BANANA"]
+    assert out.column("lo").to_pylist() == ["banana"]
+    assert out.column("n").to_pylist() == [6]
+
+
+def test_abs_round_coalesce_concat(t):
+    out = sql(f"SELECT abs(10 - v) a, round(v / 3, 1) r, "
+              f"coalesce(id, -1) c, concat(s, '!') k "
+              f"FROM '{t}' WHERE v = 50")
+    assert out.column("a").to_pylist() == [40.0]
+    assert out.column("r").to_pylist() == [16.7]
+    assert out.column("c").to_pylist() == [-1]
+    assert out.column("k").to_pylist() == ["apricot!"]
+
+
+# ---- aggregates -----------------------------------------------------
+
+def test_aggregate_functions(t):
+    out = sql(f"SELECT COUNT(*) n, COUNT(id) ni, SUM(v) s, AVG(v) a, "
+              f"MIN(v) lo, MAX(v) hi, stddev_samp(v) sd, "
+              f"var_samp(v) vr FROM '{t}'")
+    r = {c: out.column(c)[0].as_py() for c in out.column_names}
+    assert r["n"] == 5 and r["ni"] == 4
+    assert r["s"] == 150.0 and r["a"] == 30.0
+    assert r["lo"] == 10.0 and r["hi"] == 50.0
+    assert r["sd"] == pytest.approx(np.std([10, 20, 30, 40, 50],
+                                           ddof=1))
+    assert r["vr"] == pytest.approx(np.var([10, 20, 30, 40, 50],
+                                           ddof=1))
+
+
+def test_sum_of_all_null_group_is_null(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "k": pa.array(["a", "b"]),
+        "v": pa.array([None, 1], pa.int64()),
+    }))
+    out = sql(f"SELECT k, SUM(v) s FROM '{tmp_table_path}' GROUP BY k "
+              f"ORDER BY k")
+    assert out.column("s").to_pylist() == [None, 1]
+
+
+def test_group_by_null_key_kept(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "k": pa.array([None, None, "a"]),
+        "v": pa.array([1, 2, 3], pa.int64()),
+    }))
+    out = sql(f"SELECT k, SUM(v) s FROM '{tmp_table_path}' GROUP BY k")
+    got = dict(zip(out.column("k").to_pylist(),
+                   out.column("s").to_pylist()))
+    assert got == {None: 3, "a": 3}
+
+
+def test_group_by_expression(t):
+    out = sql(f"SELECT month(d) m, COUNT(*) n FROM '{t}' "
+              f"GROUP BY month(d) ORDER BY m LIMIT 2")
+    assert out.column("m").to_pylist() == [1, 2]
+
+
+# ---- subqueries -----------------------------------------------------
+
+def test_scalar_subquery(t, other):
+    out = sql(f"SELECT id FROM '{t}' WHERE v > "
+              f"(SELECT AVG(w) FROM '{other}') ORDER BY id")
+    # avg(w) ≈ 466.7: no v qualifies
+    assert out.num_rows == 0
+    out = sql(f"SELECT id FROM '{t}' WHERE v > "
+              f"(SELECT MIN(w) / 10 FROM '{other}') ORDER BY id")
+    assert out.column("id").to_pylist() == [None, 3, 4]
+
+
+def test_scalar_subquery_in_select_list(t, other):
+    out = sql(f"SELECT id, (SELECT MAX(w) FROM '{other}') mx "
+              f"FROM '{t}' WHERE id = 1")
+    assert out.column("mx").to_pylist() == [900.0]
+
+
+def test_scalar_subquery_empty_is_null(t, other):
+    out = sql(f"SELECT (SELECT w FROM '{other}' WHERE k = 77) x "
+              f"FROM '{t}' WHERE id = 1")
+    assert out.column("x").to_pylist() == [None]
+
+
+def test_scalar_subquery_multirow_rejected(t, other):
+    with pytest.raises(DeltaError, match="1 row|one row|>1"):
+        sql(f"SELECT id FROM '{t}' WHERE v > "
+            f"(SELECT w FROM '{other}')")
+
+
+def test_in_subquery(t, other):
+    out = sql(f"SELECT id FROM '{t}' WHERE id IN "
+              f"(SELECT k FROM '{other}') ORDER BY id")
+    assert out.column("id").to_pylist() == [2, 3]
+    out = sql(f"SELECT id FROM '{t}' WHERE id NOT IN "
+              f"(SELECT k FROM '{other}') ORDER BY id")
+    assert out.column("id").to_pylist() == [1, 4]
+
+
+def test_in_subquery_must_be_one_column(t, other):
+    with pytest.raises(DeltaError, match="one column"):
+        sql(f"SELECT id FROM '{t}' WHERE id IN "
+            f"(SELECT k, w FROM '{other}')")
+
+
+def test_exists_and_not_exists(t, other):
+    out = sql(f"SELECT COUNT(*) n FROM '{t}' WHERE EXISTS "
+              f"(SELECT k FROM '{other}' WHERE k = 9)")
+    assert out.column("n").to_pylist() == [5]
+    out = sql(f"SELECT COUNT(*) n FROM '{t}' WHERE NOT EXISTS "
+              f"(SELECT k FROM '{other}' WHERE k = 77)")
+    assert out.column("n").to_pylist() == [5]
+    out = sql(f"SELECT id FROM '{t}' WHERE EXISTS "
+              f"(SELECT k FROM '{other}' WHERE k = 77)")
+    assert out.num_rows == 0
+
+
+def test_from_subquery(t):
+    out = sql(f"SELECT big.id FROM (SELECT id, v FROM '{t}' "
+              f"WHERE v >= 30) big WHERE big.id IS NOT NULL "
+              f"ORDER BY big.id")
+    assert out.column("id").to_pylist() == [3, 4]
+
+
+def test_nested_from_subqueries(t):
+    out = sql(f"SELECT mx FROM (SELECT MAX(v) mx FROM "
+              f"(SELECT v FROM '{t}' WHERE v < 45) inner_q) outer_q")
+    assert out.column("mx").to_pylist() == [40.0]
+
+
+# ---- joins ----------------------------------------------------------
+
+def test_join_kinds(t, other):
+    inner = sql(f"SELECT a.id, b.w FROM '{t}' a JOIN '{other}' b "
+                f"ON a.id = b.k ORDER BY a.id")
+    assert inner.column("id").to_pylist() == [2, 3]
+    left = sql(f"SELECT a.id, b.w FROM '{t}' a LEFT JOIN '{other}' b "
+               f"ON a.id = b.k ORDER BY a.id")
+    assert left.num_rows == 5
+    cross = sql(f"SELECT COUNT(*) n FROM '{t}' a CROSS JOIN "
+                f"'{other}' b")
+    assert cross.column("n").to_pylist() == [15]
+
+
+def test_join_on_non_equi_rejected(t, other):
+    with pytest.raises(DeltaError, match="JOIN ON"):
+        sql(f"SELECT a.id FROM '{t}' a JOIN '{other}' b "
+            f"ON a.id < b.k")
+
+
+# ---- error paths ----------------------------------------------------
+
+def test_unknown_column(t):
+    with pytest.raises(DeltaError, match="not found"):
+        sql(f"SELECT nope FROM '{t}'")
+
+
+def test_ambiguous_column(t, other):
+    p2 = t  # same table twice -> every column ambiguous
+    with pytest.raises(DeltaError, match="ambiguous"):
+        sql(f"SELECT id FROM '{t}' a, '{p2}' b WHERE a.id = b.id")
+
+
+def test_duplicate_alias(t):
+    with pytest.raises(DeltaError, match="duplicate"):
+        sql(f"SELECT a.id FROM '{t}' a, '{t}' a")
+
+
+def test_trailing_garbage_rejected(t):
+    with pytest.raises(DeltaError):
+        sql(f"SELECT id FROM '{t}' ORDER BY id nonsense extra")
+
+
+def test_unsupported_function(t):
+    with pytest.raises(DeltaError, match="unsupported function"):
+        sql(f"SELECT regexp_extract(s, 'x') FROM '{t}'")
+
+
+def test_star_not_alone_rejected(t):
+    with pytest.raises(DeltaError):
+        sql(f"SELECT abs(*) FROM '{t}'")
+
+
+def test_version_as_of_requires_number(t):
+    with pytest.raises(DeltaError, match="VERSION AS OF"):
+        sql(f"SELECT id FROM '{t}' VERSION AS OF 'zero'")
+
+
+def test_aggregate_in_where_rejected(t):
+    with pytest.raises(DeltaError, match="not allowed|aggregate"):
+        sql(f"SELECT id FROM '{t}' WHERE SUM(v) > 10")
+
+
+def test_bare_column_with_group_by_rejected(t):
+    with pytest.raises(DeltaError, match="GROUP BY"):
+        sql(f"SELECT v, COUNT(*) FROM '{t}' GROUP BY id")

@@ -1,0 +1,126 @@
+"""Table-property catalog conformance (VERDICT r3 ask #7, config half).
+
+The reference defines 46 table properties (`DeltaConfig.scala`
+buildConfig entries); this suite pins that ≥40 have typed catalog
+entries here, that every entry parses its default and a representative
+raw value, and that the newly wired ones (protocol floors, isolation
+validation) actually enforce.
+"""
+
+import pytest
+
+from delta_tpu import config as cfg
+from delta_tpu.config import TABLE_CONFIGS, get_table_config
+
+# the reference catalog (DeltaConfig.scala, keys get the delta. prefix)
+REFERENCE_KEYS = [
+    "minReaderVersion", "minWriterVersion", "ignoreProtocolDefaults",
+    "logRetentionDuration", "sampleRetentionDuration",
+    "checkpointRetentionDuration", "checkpointInterval",
+    "enableExpiredLogCleanup", "enableFullRetentionRollback",
+    "dropFeatureTruncateHistory.retentionDuration",
+    "deletedFileRetentionDuration", "randomizeFilePrefixes",
+    "randomPrefixLength", "dataSkippingNumIndexedCols",
+    "dataSkippingStatsColumns", "checkpoint.writeStatsAsJson",
+    "checkpoint.writeStatsAsStruct", "enableChangeDataCapture",
+    "enableChangeDataFeed", "columnMapping.mode",
+    "columnMapping.maxColumnId", "isolationLevel",
+    "enableInCommitTimestamps", "inCommitTimestampEnablementVersion",
+    "inCommitTimestampEnablementTimestamp",
+    "requireCheckpointProtectionBeforeVersion",
+    "setTransactionRetentionDuration",
+    "universalFormat.enabledFormats", "enableIcebergCompatV1",
+    "enableIcebergCompatV2", "castIcebergTimeType", "autoOptimize",
+    "autoOptimize.autoCompact", "autoOptimize.optimizeWrite",
+    "coordinatedCommits.commitCoordinator-preview",
+    "coordinatedCommits.commitCoordinatorConf-preview",
+    "coordinatedCommits.tableConf-preview",
+    "redirectReaderWriter-preview", "redirectWriterOnly-preview",
+    "appendOnly", "castIcebergTimeType", "checkpointPolicy",
+    "enableDeletionVectors", "enableRowTracking", "enableTypeWidening",
+    "compatibility.symlinkFormatManifest.enabled",
+]
+
+_SAMPLES = {
+    int: "7",
+    bool: "true",
+    str: "anything",
+}
+
+
+def test_reference_coverage():
+    have = {k[len("delta."):] for k in TABLE_CONFIGS}
+    missing = [k for k in set(REFERENCE_KEYS) if k not in have]
+    covered = len(set(REFERENCE_KEYS)) - len(missing)
+    assert covered >= 40, f"only {covered} covered; missing: {missing}"
+
+
+@pytest.mark.parametrize("key", sorted(TABLE_CONFIGS))
+def test_default_when_absent(key):
+    c = TABLE_CONFIGS[key]
+    assert get_table_config({}, c) == c.default
+
+
+@pytest.mark.parametrize("key", sorted(TABLE_CONFIGS))
+def test_parse_roundtrip(key):
+    c = TABLE_CONFIGS[key]
+    if c.parse is int:
+        raw, want = "7", 7
+    elif c.parse is cfg._parse_bool:
+        raw, want = "true", True
+    elif c.parse is cfg._parse_interval_ms:
+        raw, want = "interval 2 days", 2 * 86_400_000
+    elif c.parse is str:
+        raw = want = "x"
+    elif key == "delta.dataSkippingStatsColumns":
+        raw, want = "a, b", ["a", "b"]
+    elif key == "delta.universalFormat.enabledFormats":
+        raw, want = "iceberg,hudi", ["iceberg", "hudi"]
+    elif key == "delta.isolationLevel":
+        raw = want = "Serializable"
+    else:
+        pytest.skip(f"no sample for parser of {key}")
+    assert get_table_config({key: raw}, c) == want
+
+
+def test_interval_parser_units():
+    p = cfg._parse_interval_ms
+    assert p("interval 1 week") == 7 * 86_400_000
+    assert p("interval 12 hours") == 12 * 3_600_000
+    assert p("1234") == 1234
+    with pytest.raises(KeyError):
+        p("interval 1 fortnight")
+
+
+def test_isolation_level_validated():
+    c = TABLE_CONFIGS["delta.isolationLevel"]
+    assert get_table_config(
+        {c.key: "SnapshotIsolation"}, c) == "SnapshotIsolation"
+    with pytest.raises(ValueError):
+        get_table_config({c.key: "ReadCommitted"}, c)
+
+
+def test_uniform_formats_validated():
+    c = TABLE_CONFIGS["delta.universalFormat.enabledFormats"]
+    with pytest.raises(ValueError):
+        get_table_config({c.key: "iceberg,parquet"}, c)
+
+
+def test_protocol_floor_properties_enforced():
+    from delta_tpu.features import protocol_for_new_table
+
+    p = protocol_for_new_table({})
+    assert (p.minReaderVersion, p.minWriterVersion) == (1, 2)
+    p = protocol_for_new_table({"delta.minReaderVersion": "2",
+                                "delta.minWriterVersion": "5"})
+    assert (p.minReaderVersion, p.minWriterVersion) == (2, 5)
+    p = protocol_for_new_table({"delta.ignoreProtocolDefaults": "true"})
+    assert (p.minReaderVersion, p.minWriterVersion) == (1, 1)
+    from delta_tpu.errors import DeltaError
+
+    with pytest.raises(DeltaError):
+        protocol_for_new_table({"delta.minWriterVersion": "high"})
+
+
+def test_catalog_size_guard():
+    assert len(TABLE_CONFIGS) >= 40
