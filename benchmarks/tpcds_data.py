@@ -48,7 +48,8 @@ _BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
                   "0-500", "Unknown"]
 _STORE_NAMES = ["ese", "ought", "able", "bar", "anti", "cally"]
 _SM_TYPES = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
-_STATES = ["CA", "WA", "GA", "TX", "NY", "FL", "OH", "MI", "IL", "VA"]
+_STATES = ["CA", "WA", "GA", "TX", "NY", "FL", "OH", "MI", "IL", "VA",
+           "TN", "NE", "IA", "IN", "KY", "AL", "MN", "SD"]
 _COUNTIES = ["Williamson County", "Ziebach County", "Walker County",
              "Daviess County", "Fairfield County", "Barrow County",
              "Franklin Parish", "Luce County", "Mobile County"]
@@ -64,7 +65,7 @@ TABLE_NAMES = [
     "customer_demographics", "household_demographics", "promotion",
     "store", "warehouse", "ship_mode", "web_site", "web_page",
     "call_center", "store_sales", "store_returns", "catalog_sales",
-    "web_sales", "inventory",
+    "catalog_returns", "web_sales", "web_returns", "inventory",
 ]
 
 _BASE_DATE = datetime.date(1998, 1, 1)
@@ -115,10 +116,16 @@ def _date_dim() -> pa.Table:
 
 def _time_dim() -> pa.Table:
     mins = np.arange(24 * 60)
+    hours = mins // 60
+    meal = np.where(hours < 9, "breakfast",
+                    np.where((hours >= 11) & (hours < 14), "lunch",
+                             np.where((hours >= 17) & (hours < 21),
+                                      "dinner", None)))
     return pa.table({
         "t_time_sk": pa.array(mins * 60),  # sk = second of day
-        "t_hour": pa.array(mins // 60),
+        "t_hour": pa.array(hours),
         "t_minute": pa.array(mins % 60),
+        "t_meal_time": pa.array(meal.tolist()),
     })
 
 
@@ -182,6 +189,18 @@ def _customer(rng, n_cust, n_addr) -> pa.Table:
         "c_birth_country": pa.array(
             [["UNITED STATES", "CANADA", "MEXICO"][i]
              for i in rng.integers(0, 3, n_cust)]),
+        "c_birth_day": pa.array(
+            rng.integers(1, 29, n_cust).astype(np.int64)),
+        "c_birth_month": pa.array(
+            rng.integers(1, 13, n_cust).astype(np.int64)),
+        "c_birth_year": pa.array(
+            rng.integers(1930, 1995, n_cust).astype(np.int64)),
+        "c_login": pa.array([f"user{j}" for j in sk]),
+        "c_email_address": pa.array(
+            [f"user{j}@example.com" for j in sk]),
+        "c_last_review_date": pa.array(
+            (_DATE_SK0 + rng.integers(0, _N_DAYS, n_cust)).astype(
+                np.int64)),
     })
 
 
@@ -202,6 +221,21 @@ def _customer_address(rng, n_addr) -> pa.Table:
             [_COUNTIES[i]
              for i in rng.integers(0, len(_COUNTIES), n_addr)]),
         "ca_country": pa.array(["United States"] * n_addr),
+        "ca_street_number": pa.array(
+            [str(z) for z in rng.integers(1, 1000, n_addr)]),
+        "ca_street_name": pa.array(
+            [["Main", "Oak", "Park", "First"][i]
+             for i in rng.integers(0, 4, n_addr)]),
+        "ca_street_type": pa.array(
+            [["St", "Ave", "Blvd", "Ln"][i]
+             for i in rng.integers(0, 4, n_addr)]),
+        "ca_suite_number": pa.array(
+            [f"Suite {z}" for z in rng.integers(0, 500, n_addr)]),
+        "ca_gmt_offset": pa.array(
+            np.where(rng.random(n_addr) < 0.5, -6.0, -5.0)),
+        "ca_location_type": pa.array(
+            [["apartment", "condo", "single family"][i]
+             for i in rng.integers(0, 3, n_addr)]),
     })
 
 
@@ -265,7 +299,7 @@ def _store(rng) -> pa.Table:
         "s_county": pa.array(
             [_COUNTIES[i] for i in rng.integers(0, len(_COUNTIES), n)]),
         "s_state": pa.array(
-            [_STATES[i] for i in rng.integers(0, len(_STATES), n)]),
+            [_STATES[j % len(_STATES)] for j in range(n)]),
         "s_number_employees": pa.array(
             rng.integers(200, 301, n).astype(np.int64)),
         "s_company_id": pa.array(np.ones(n, np.int64)),
@@ -306,6 +340,7 @@ def _web_site() -> pa.Table:
     return pa.table({
         "web_site_sk": pa.array(np.arange(1, n + 1)),
         "web_name": pa.array([f"site_{j}" for j in range(n)]),
+        "web_company_name": pa.array(["pri"] * n),
     })
 
 
@@ -389,12 +424,12 @@ def generate(scale: int = 50_000, seed: int = 7):
             rng.integers(1, n_items + 1, n).astype(np.int64)),
         "ss_customer_sk": pa.array(
             t_cust[ticket_of_row].astype(np.int64)),
-        "ss_cdemo_sk": pa.array(
-            t_cdemo[ticket_of_row].astype(np.int64)),
+        "ss_cdemo_sk": _maybe_null_int(
+            rng, t_cdemo[ticket_of_row], 0.03),
         "ss_hdemo_sk": pa.array(
             t_hdemo[ticket_of_row].astype(np.int64)),
-        "ss_addr_sk": pa.array(
-            t_addr[ticket_of_row].astype(np.int64)),
+        "ss_addr_sk": _maybe_null_int(
+            rng, t_addr[ticket_of_row], 0.03),
         "ss_store_sk": pa.array(
             t_store[ticket_of_row].astype(np.int64)),
         "ss_promo_sk": _maybe_null_int(
@@ -431,11 +466,14 @@ def generate(scale: int = 50_000, seed: int = 7):
             t_cust[ticket_of_row[ret_idx]].astype(np.int64)),
         "sr_cdemo_sk": pa.array(
             t_cdemo[ticket_of_row[ret_idx]].astype(np.int64)),
+        "sr_store_sk": pa.array(
+            t_store[ticket_of_row[ret_idx]].astype(np.int64)),
         "sr_ticket_number": pa.array(
             (ticket_of_row[ret_idx] + 1).astype(np.int64)),
         "sr_return_quantity": pa.array(
             rng.integers(1, 50, nr).astype(np.int64)),
         "sr_return_amt": _money(rng, nr, 1, 500),
+        "sr_fee": _money(rng, nr, 1, 100),
         "sr_net_loss": _money(rng, nr, 1, 300),
     })
 
@@ -469,14 +507,14 @@ def generate(scale: int = 50_000, seed: int = 7):
             rng.integers(1, n_hd + 1, nc).astype(np.int64)),
         "cs_bill_addr_sk": pa.array(
             rng.integers(1, n_addr + 1, nc).astype(np.int64)),
-        "cs_ship_customer_sk": pa.array(
-            rng.integers(1, n_cust + 1, nc).astype(np.int64)),
+        "cs_ship_customer_sk": _maybe_null_int(
+            rng, rng.integers(1, n_cust + 1, nc), 0.03),
         "cs_ship_addr_sk": pa.array(
             rng.integers(1, n_addr + 1, nc).astype(np.int64)),
         "cs_ship_mode_sk": pa.array(
             rng.integers(1, n_sm + 1, nc).astype(np.int64)),
-        "cs_warehouse_sk": pa.array(
-            rng.integers(1, n_wh + 1, nc).astype(np.int64)),
+        "cs_warehouse_sk": _maybe_null_int(
+            rng, rng.integers(1, n_wh + 1, nc), 0.03),
         "cs_call_center_sk": pa.array(
             rng.integers(1, n_cc + 1, nc).astype(np.int64)),
         "cs_promo_sk": _maybe_null_int(
@@ -488,8 +526,41 @@ def generate(scale: int = 50_000, seed: int = 7):
         "cs_sales_price": _money(rng, nc, 1, 600, null_frac=0.0),
         "cs_ext_sales_price": _money(rng, nc, 1, 2000),
         "cs_coupon_amt": _money(rng, nc, 0, 50),
+        "cs_ext_discount_amt": _money(rng, nc, 0, 100),
+        "cs_ext_ship_cost": _money(rng, nc, 0, 100),
         "cs_net_profit": pa.array(
             np.round(rng.uniform(-4000.0, 4000.0, nc), 2)),
+    })
+
+    # ---- catalog_returns (sampled from catalog_sales) -----------------
+    ncr = max(100, nc // 8)
+    cr_idx = rng.integers(0, nc, ncr)
+    cs_item_np = tables["catalog_sales"].column("cs_item_sk").to_numpy()
+    cs_ono_np = tables["catalog_sales"].column(
+        "cs_order_number").to_numpy()
+    cr_day = np.minimum(c_sold[cr_idx] + rng.integers(1, 100, ncr),
+                        _N_DAYS - 1)
+    tables["catalog_returns"] = pa.table({
+        "cr_returned_date_sk": pa.array(
+            (_DATE_SK0 + cr_day).astype(np.int64)),
+        "cr_item_sk": pa.array(cs_item_np[cr_idx]),
+        "cr_order_number": pa.array(cs_ono_np[cr_idx]),
+        "cr_returning_customer_sk": pa.array(
+            cs_cust[cr_idx].astype(np.int64)),
+        "cr_returning_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, ncr).astype(np.int64)),
+        "cr_call_center_sk": pa.array(
+            rng.integers(1, n_cc + 1, ncr).astype(np.int64)),
+        "cr_catalog_page_sk": pa.array(
+            rng.integers(1, 21, ncr).astype(np.int64)),
+        "cr_return_quantity": pa.array(
+            rng.integers(1, 50, ncr).astype(np.int64)),
+        "cr_return_amount": _money(rng, ncr, 1, 500),
+        "cr_return_amt_inc_tax": _money(rng, ncr, 1, 550),
+        "cr_refunded_cash": _money(rng, ncr, 0, 400),
+        "cr_reversed_charge": _money(rng, ncr, 0, 100),
+        "cr_store_credit": _money(rng, ncr, 0, 100),
+        "cr_net_loss": _money(rng, ncr, 1, 300),
     })
 
     # ---- web_sales ----------------------------------------------------
@@ -511,8 +582,8 @@ def generate(scale: int = 50_000, seed: int = 7):
             rng.integers(1, n_addr + 1, nw).astype(np.int64)),
         "ws_ship_customer_sk": pa.array(
             rng.integers(1, n_cust + 1, nw).astype(np.int64)),
-        "ws_ship_hdemo_sk": pa.array(
-            rng.integers(1, n_hd + 1, nw).astype(np.int64)),
+        "ws_ship_hdemo_sk": _maybe_null_int(
+            rng, rng.integers(1, n_hd + 1, nw), 0.03),
         "ws_ship_addr_sk": pa.array(
             rng.integers(1, n_addr + 1, nw).astype(np.int64)),
         "ws_ship_mode_sk": pa.array(
@@ -521,8 +592,8 @@ def generate(scale: int = 50_000, seed: int = 7):
             rng.integers(1, n_wh + 1, nw).astype(np.int64)),
         "ws_web_site_sk": pa.array(
             rng.integers(1, n_ws_site + 1, nw).astype(np.int64)),
-        "ws_web_page_sk": pa.array(
-            rng.integers(1, n_wp + 1, nw).astype(np.int64)),
+        "ws_web_page_sk": _maybe_null_int(
+            rng, rng.integers(1, n_wp + 1, nw), 0.03),
         "ws_promo_sk": _maybe_null_int(
             rng, rng.integers(1, 31, nw), 0.05),
         "ws_order_number": pa.array((np.arange(nw) // 2 + 1)),
@@ -532,8 +603,45 @@ def generate(scale: int = 50_000, seed: int = 7):
         "ws_sales_price": _money(rng, nw, 1, 600, null_frac=0.0),
         "ws_ext_sales_price": _money(rng, nw, 1, 2000),
         "ws_ext_ship_cost": _money(rng, nw, 0, 100),
+        "ws_ext_discount_amt": _money(rng, nw, 0, 100),
+        "ws_net_paid": _money(rng, nw, 1, 2000),
         "ws_net_profit": pa.array(
             np.round(rng.uniform(-4000.0, 4000.0, nw), 2)),
+    })
+
+    # ---- web_returns (sampled from web_sales) -------------------------
+    nwr = max(100, nw // 8)
+    wr_idx = rng.integers(0, nw, nwr)
+    ws_item_np = tables["web_sales"].column("ws_item_sk").to_numpy()
+    ws_ono_np = tables["web_sales"].column("ws_order_number").to_numpy()
+    ws_cust_np = tables["web_sales"].column(
+        "ws_bill_customer_sk").to_numpy()
+    wr_day = np.minimum(w_sold[wr_idx] + rng.integers(1, 100, nwr),
+                        _N_DAYS - 1)
+    tables["web_returns"] = pa.table({
+        "wr_returned_date_sk": pa.array(
+            (_DATE_SK0 + wr_day).astype(np.int64)),
+        "wr_item_sk": pa.array(ws_item_np[wr_idx]),
+        "wr_order_number": pa.array(ws_ono_np[wr_idx]),
+        "wr_returning_customer_sk": pa.array(ws_cust_np[wr_idx]),
+        "wr_refunded_cdemo_sk": pa.array(
+            rng.integers(1, n_cd + 1, nwr).astype(np.int64)),
+        "wr_returning_cdemo_sk": pa.array(
+            rng.integers(1, n_cd + 1, nwr).astype(np.int64)),
+        "wr_refunded_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, nwr).astype(np.int64)),
+        "wr_returning_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, nwr).astype(np.int64)),
+        "wr_web_page_sk": pa.array(
+            rng.integers(1, n_wp + 1, nwr).astype(np.int64)),
+        "wr_reason_sk": pa.array(
+            rng.integers(1, 10, nwr).astype(np.int64)),
+        "wr_return_quantity": pa.array(
+            rng.integers(1, 50, nwr).astype(np.int64)),
+        "wr_return_amt": _money(rng, nwr, 1, 500),
+        "wr_refunded_cash": _money(rng, nwr, 0, 400),
+        "wr_fee": _money(rng, nwr, 1, 100),
+        "wr_net_loss": _money(rng, nwr, 1, 300),
     })
 
     # ---- inventory (weekly snapshots) ---------------------------------

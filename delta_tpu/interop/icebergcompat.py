@@ -15,8 +15,10 @@ from typing import Optional, Sequence
 from delta_tpu.errors import DeltaError, IcebergCompatViolationError
 from delta_tpu.models.schema import ArrayType, MapType, PrimitiveType, StructType
 
-ICEBERG_COMPAT_V1_KEY = "delta.enableIcebergCompatV1"
-ICEBERG_COMPAT_V2_KEY = "delta.enableIcebergCompatV2"
+from delta_tpu.config import ICEBERG_COMPAT_V1, ICEBERG_COMPAT_V2
+
+ICEBERG_COMPAT_V1_KEY = ICEBERG_COMPAT_V1.key
+ICEBERG_COMPAT_V2_KEY = ICEBERG_COMPAT_V2.key
 
 # Iceberg's primitive type space (CheckTypeInV2AllowList)
 _V2_ALLOWED_PRIMITIVES = {
@@ -32,8 +34,11 @@ def _is_true(configuration, key) -> bool:
 
 
 def enabled_version(configuration) -> Optional[int]:
-    v1 = _is_true(configuration, ICEBERG_COMPAT_V1_KEY)
-    v2 = _is_true(configuration, ICEBERG_COMPAT_V2_KEY)
+    from delta_tpu.config import get_table_config
+
+    conf = configuration or {}
+    v1 = get_table_config(conf, ICEBERG_COMPAT_V1)
+    v2 = get_table_config(conf, ICEBERG_COMPAT_V2)
     if v1 and v2:
         raise IcebergCompatViolationError(
             "icebergCompatV1 and icebergCompatV2 are mutually exclusive "

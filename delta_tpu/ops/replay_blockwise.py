@@ -41,8 +41,7 @@ from delta_tpu.ops.replay import (
 DEFAULT_BLOCK_ROWS = 1 << 22  # 4M rows/block: ~24MB device footprint
 
 
-@functools.partial(jax.jit, static_argnames=("m",), donate_argnums=(0,))
-def _block_kernel(seen_words, keys, n_real, m: int):
+def _block_kernel_impl(seen_words, keys, n_real, m: int):
     """One reverse-order block step.
 
     seen_words u32[W]: bitset over key space (donated, updated in place).
@@ -92,6 +91,10 @@ def _block_kernel(seen_words, keys, n_real, m: int):
     winner_words = (winner.reshape(-1, 32).astype(jnp.uint32)
                     * weights).sum(axis=1, dtype=jnp.uint32)
     return winner_words, seen_words
+
+
+_block_kernel = functools.partial(jax.jit, static_argnames=("m",),
+                                  donate_argnums=(0,))(_block_kernel_impl)
 
 
 def replay_select_blockwise(

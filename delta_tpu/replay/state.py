@@ -243,6 +243,18 @@ def compute_masks_device(
 
     mesh = getattr(engine, "mesh", None) if engine is not None else None
     if mesh is not None and mesh.devices.size > 1:
+        if n >= BLOCKWISE_MIN_ROWS * mesh.devices.size:
+            # sharded AND >HBM: each shard streams its substream in
+            # bounded blocks with a persistent bitset — the
+            # `Snapshot.scala:481-511` multi-host configuration
+            from delta_tpu.parallel.sharded_blockwise import (
+                replay_select_sharded_blockwise,
+            )
+
+            live, tomb, _ = replay_select_sharded_blockwise(
+                [path_codes, dv_codes], version.astype(np.int32),
+                order, is_add, mesh)
+            return live, tomb
         from delta_tpu.parallel.sharded_replay import sharded_replay_select
 
         live, tomb, _, _ = sharded_replay_select(

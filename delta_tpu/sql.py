@@ -638,6 +638,8 @@ def _query_statement(s: str, engine, catalog):
             out = out.slice(0, int(m.group("limit")))
         return out
 
+    if re.match(r"WITH\b", s, re.IGNORECASE):
+        return _exec_select_extended(s, engine, catalog)
     if re.match(r"SELECT\b", s, re.IGNORECASE):
         # plain single-table scans take the Arrow-native fast path
         # (type fidelity); everything richer runs through the

@@ -36,8 +36,8 @@ from delta_tpu.errors import AmbiguousColumnError, CatalogTableError, DeltaError
 from delta_tpu.sqlengine.parser import (
     And, Between, BinOp, CaseWhen, Cast, Cmp, Col, Exists, Func, InList,
     InSelect, Interval, IsNull, JoinClause, Like, Lit, Neg, Not, Or,
-    ScalarSelect, Select, SelectItem, Star, TableRef, Window,
-    parse_select,
+    Query, ScalarSelect, Select, SelectItem, Star, TableRef, Window,
+    parse_query,
 )
 
 _AGGS = {"count", "sum", "min", "max", "avg", "stddev_samp", "var_samp"}
@@ -47,12 +47,71 @@ _NULL_SUPPLYING = {"left outer": ("right",), "right outer": ("left",),
 
 # ---------------------------------------------------------------- API --
 
-def execute_select(statement_or_ast, engine=None, catalog=None) -> pa.Table:
-    sel = (statement_or_ast if isinstance(statement_or_ast, Select)
-           else parse_select(statement_or_ast))
-    df, names = _Exec(engine, catalog).run(sel)
+def execute_select(statement_or_ast, engine=None, catalog=None,
+                   ctes=None) -> pa.Table:
+    if isinstance(statement_or_ast, Select):
+        q = Query(selects=[statement_or_ast])
+    elif isinstance(statement_or_ast, Query):
+        q = statement_or_ast
+    else:
+        q = parse_query(statement_or_ast)
+    df, names = _run_query(q, engine, catalog, dict(ctes or {}))
     out = pa.Table.from_pandas(df, preserve_index=False)
     return out.rename_columns(names)
+
+
+def _run_query(q: Query, engine, catalog, ctes) -> Tuple[pd.DataFrame,
+                                                         List[str]]:
+    """Execute a full query: materialize WITH bindings in order (a CTE
+    sees the ones before it), run each UNION ALL branch against the
+    same bindings, concatenate positionally, then apply the trailing
+    ORDER BY/LIMIT on the union result."""
+    for name, sub in q.ctes:
+        # a CTE body sees the bindings before it, not its siblings' —
+        # pass a copy so its own nested WITHs never leak outward
+        df, names = _run_query(sub, engine, catalog, dict(ctes))
+        df = df.copy()
+        df.columns = names
+        ctes[name.lower()] = df
+    frames = []
+    out_names: List[str] = []
+    for i, sel in enumerate(q.selects):
+        df, names = _Exec(engine, catalog, ctes).run(sel)
+        if i == 0:
+            out_names = names
+        elif len(names) != len(out_names):
+            raise SqlParseError(
+                f"UNION ALL branches have different widths "
+                f"({len(out_names)} vs {len(names)})")
+        df = df.copy()
+        df.columns = [f"__c{j}" for j in range(len(names))]
+        frames.append(df)
+    result = frames[0]
+    for op, f in zip(q.union_ops, frames[1:]):
+        result = pd.concat([result, f], ignore_index=True)
+        if op == "distinct":
+            result = result.drop_duplicates(ignore_index=True)
+    if q.order_by:
+        for i in range(len(q.order_by) - 1, -1, -1):
+            e, asc = q.order_by[i]
+            lower_names = [n.lower() for n in out_names]
+            if isinstance(e, Lit) and isinstance(e.value, int) \
+                    and 1 <= e.value <= len(out_names):
+                pos = e.value - 1
+            elif (isinstance(e, Col) and len(e.parts) == 1
+                    and e.parts[0].lower() in lower_names):
+                pos = lower_names.index(e.parts[0].lower())
+            else:
+                raise UnsupportedSqlError(
+                    "ORDER BY after UNION ALL must reference output "
+                    f"column names or ordinals; got {type(e).__name__}")
+            col = f"__c{pos}"
+            result = result.sort_values(
+                col, ascending=asc, kind="mergesort",
+                na_position="first" if asc else "last")
+    if q.limit is not None:
+        result = result.head(q.limit)
+    return result.reset_index(drop=True), out_names
 
 
 # ------------------------------------------------------------ helpers --
@@ -108,10 +167,23 @@ def _canon(e, resolve) -> str:
         parts = ",".join(_canon(p, resolve) for p in e.partition_by)
         orders = ",".join(f"{_canon(o, resolve)}:{a}"
                           for o, a in e.order_by)
-        return f"win({_canon(e.func, resolve)};part={parts};ord={orders})"
+        return (f"win({_canon(e.func, resolve)};part={parts};"
+                f"ord={orders};{e.frame})")
     if isinstance(e, (InSelect, Exists, ScalarSelect)):
         return f"subquery:{id(e)}"
     raise UnsupportedSqlError(f"cannot canonicalize {type(e).__name__}")
+
+
+def _has_agg(e) -> bool:
+    found = False
+
+    def chk(x):
+        nonlocal found
+        if isinstance(x, Func) and x.name in _AGGS:
+            found = True
+
+    _walk_exprs(e, chk)
+    return found
 
 
 def _split_and(e) -> list:
@@ -225,9 +297,10 @@ def _normalize_frame(df: pd.DataFrame) -> pd.DataFrame:
 # -------------------------------------------------------- the executor --
 
 class _Exec:
-    def __init__(self, engine, catalog):
+    def __init__(self, engine, catalog, ctes=None):
         self.engine = engine
         self.catalog = catalog
+        self.ctes = ctes or {}
 
     # -- table materialization ------------------------------------------
     def _snapshot(self, ref: TableRef):
@@ -262,12 +335,25 @@ class _Exec:
         for i, ref in enumerate(list(sel.froms)
                                 + [j.ref for j in sel.joins]):
             if ref.kind == "subquery":
-                sub_df, sub_names = _Exec(self.engine, self.catalog).run(
-                    ref.value)
+                if isinstance(ref.value, Query):
+                    sub_df, sub_names = _run_query(
+                        ref.value, self.engine, self.catalog,
+                        dict(self.ctes))
+                else:
+                    sub_df, sub_names = _Exec(self.engine, self.catalog,
+                                              self.ctes).run(ref.value)
                 sub_df.columns = sub_names
                 alias = ref.alias or f"_s{i}"
                 src = {"alias": alias, "frame": sub_df,
                        "cols": list(sub_df.columns), "snap": None}
+            elif ref.kind == "name" and ref.value.lower() in self.ctes:
+                # WITH binding: shared frame, copied per reference
+                # (q47-style self-joins alias the same CTE 3x and the
+                # materializer renames columns in place)
+                cte_df = self.ctes[ref.value.lower()].copy()
+                alias = ref.alias or ref.value
+                src = {"alias": alias, "frame": cte_df,
+                       "cols": list(cte_df.columns), "snap": None}
             else:
                 snap = self._snapshot(ref)
                 alias = ref.alias or (
@@ -275,43 +361,53 @@ class _Exec:
                     else f"_t{i}")
                 src = {"alias": alias, "snap": snap, "frame": None,
                        "cols": [f.name for f in snap.schema.fields]}
-            if alias in seen_aliases:
+            if alias.lower() in seen_aliases:
                 raise AmbiguousColumnError(f"duplicate table alias {alias!r}")
-            seen_aliases.add(alias)
+            seen_aliases.add(alias.lower())
             sources.append(src)
         # sources[len(froms) + k] belongs to sel.joins[k]
         join_aliases = [sources[len(sel.froms) + k]["alias"]
                         for k in range(len(sel.joins))]
 
         by_alias = {s["alias"]: s for s in sources}
-        col_owners: Dict[str, List[str]] = {}
+        # case-insensitive like Spark: SR_FEE resolves to sr_fee
+        lower_alias = {s["alias"].lower(): s["alias"] for s in sources}
+        col_owners: Dict[str, List[tuple]] = {}
         for s in sources:
+            s["lower_cols"] = {c.lower(): c for c in s["cols"]}
             for c in s["cols"]:
-                col_owners.setdefault(c, []).append(s["alias"])
+                col_owners.setdefault(c.lower(), []).append(
+                    (s["alias"], c))
 
         def resolve(col: Col) -> str:
             if len(col.parts) >= 2:
                 alias, name = col.parts[-2], col.parts[-1]
-                if alias not in by_alias:
-                    raise UnresolvedColumnError(f"table alias {alias!r} not found "
-                                     f"for column {col.text!r}")
-                if name not in by_alias[alias]["cols"]:
+                alias = lower_alias.get(alias.lower())
+                if alias is None:
+                    raise UnresolvedColumnError(
+                        f"table alias {col.parts[-2]!r} not found "
+                        f"for column {col.text!r}")
+                actual = by_alias[alias]["lower_cols"].get(name.lower())
+                if actual is None:
                     raise UnresolvedColumnError(
                         f"column {col.text!r} not found in {alias!r}")
-                return f"{alias}.{name}"
+                return f"{alias}.{actual}"
             name = col.parts[0]
-            owners = col_owners.get(name, [])
+            owners = col_owners.get(name.lower(), [])
             if len(owners) == 1:
-                return f"{owners[0]}.{name}"
+                alias, actual = owners[0]
+                return f"{alias}.{actual}"
             if not owners:
                 raise UnresolvedColumnError(
                     f"column {name!r} not found; not in scope of any "
                     f"table ({sorted(by_alias)})")
             raise AmbiguousColumnError(
-                f"column {name!r} is ambiguous (in {owners}); qualify "
+                f"column {name!r} is ambiguous "
+                f"(in {[a for a, _ in owners]}); qualify "
                 "with a table alias — not in scope unqualified")
 
         self._resolve = resolve
+        self._outer_aliases = set(by_alias)
 
         # ---- referenced columns per alias (projection) ----------------
         needed: Dict[str, set] = {s["alias"]: set() for s in sources}
@@ -325,6 +421,15 @@ class _Exec:
                     return  # surfaces with a proper error during eval
                 alias, name = phys.split(".", 1)
                 needed[alias].add(name)
+            elif isinstance(e, (ScalarSelect, InSelect, Exists)):
+                # correlated subquery: outer columns referenced inside
+                # the subquery's WHERE must survive projection (inner
+                # names that don't resolve out here no-op in note)
+                for c in _split_and(e.select.where):
+                    def sub_note(x):
+                        if isinstance(x, Col):
+                            note(x)
+                    _walk_exprs(c, sub_note)
 
         for it in sel.items:
             _walk_exprs(it.expr, note)
@@ -566,9 +671,12 @@ class _Exec:
             sort_series = []
             for e, asc in sel.order_by:
                 e = self._sub_aliases(e, alias_map)
-                # select-list alias / output column reference
+                # select-list alias / ordinal / output column reference
                 s = None
-                if isinstance(e, Col) and len(e.parts) == 1:
+                if isinstance(e, Lit) and isinstance(e.value, int) \
+                        and 1 <= e.value <= len(out_names):
+                    s = result[f"__c{e.value - 1}"]  # ORDER BY 2,1,3
+                elif isinstance(e, Col) and len(e.parts) == 1:
                     if e.parts[0] in out_names:
                         s = result[f"__c{out_names.index(e.parts[0])}"]
                 if s is None:
@@ -849,7 +957,11 @@ class _Exec:
         if isinstance(e, Interval):
             return pd.Timedelta(days=e.n)
         if isinstance(e, ScalarSelect):
-            out = execute_select(e.select, self.engine, self.catalog)
+            corr = self._correlation(e.select)
+            if corr:
+                return self._correlated_scalar(e.select, corr, df)
+            out = execute_select(e.select, self.engine, self.catalog,
+                                 ctes=self.ctes)
             if out.num_columns != 1:
                 raise SqlParseError("scalar subquery must return one column")
             if out.num_rows == 0:
@@ -858,7 +970,13 @@ class _Exec:
                 raise SubqueryShapeError("scalar subquery returned >1 row")
             return out.column(0)[0].as_py()
         if isinstance(e, InSelect):
-            out = execute_select(e.select, self.engine, self.catalog)
+            corr = self._correlation(e.select)
+            if corr:
+                m = self._correlated_semi(e.select, corr, df,
+                                          item=e.item)
+                return ~m if e.negated else m
+            out = execute_select(e.select, self.engine, self.catalog,
+                                 ctes=self.ctes)
             if out.num_columns != 1:
                 raise SqlParseError("IN subquery must return one column")
             raw = out.column(0).to_pylist()
@@ -868,7 +986,12 @@ class _Exec:
             m = _in_membership(v, vals, has_null, df.index)
             return ~m if e.negated else m
         if isinstance(e, Exists):
-            out = execute_select(e.select, self.engine, self.catalog)
+            corr = self._correlation(e.select)
+            if corr:
+                m = self._correlated_semi(e.select, corr, df)
+                return ~m if e.negated else m
+            out = execute_select(e.select, self.engine, self.catalog,
+                                 ctes=self.ctes)
             flag = out.num_rows > 0
             if e.negated:
                 flag = not flag
@@ -881,6 +1004,214 @@ class _Exec:
         if isinstance(e, Star):
             raise SqlParseError("* is only allowed as a lone select item")
         raise UnsupportedSqlError(f"unsupported expression {type(e).__name__}")
+
+    # -- correlated subqueries (equality decorrelation) -----------------
+
+    @staticmethod
+    def _inner_aliases(sub: Select) -> set:
+        out = set()
+        for ref in list(sub.froms) + [j.ref for j in sub.joins]:
+            if ref.alias:
+                out.add(ref.alias.lower())
+            elif ref.kind == "name":
+                out.add(ref.value.split(".")[-1].lower())
+        return out
+
+    def _inner_columns(self, sub: Select) -> set:
+        """Best-effort lowercase column inventory of the subquery's own
+        sources (schema probe; snapshots are metadata-cached)."""
+        out = set()
+        for ref in list(sub.froms) + [j.ref for j in sub.joins]:
+            try:
+                if ref.kind == "subquery":
+                    sel = (ref.value.selects[0]
+                           if isinstance(ref.value, Query) else ref.value)
+                    for it in sel.items:
+                        if it.alias:
+                            out.add(it.alias.lower())
+                        elif isinstance(it.expr, Col):
+                            out.add(it.expr.parts[-1].lower())
+                elif ref.kind == "name" and ref.value.lower() in self.ctes:
+                    out |= {c.lower()
+                            for c in self.ctes[ref.value].columns}
+                else:
+                    snap = self._snapshot(ref)
+                    out |= {f.name.lower() for f in snap.schema.fields}
+            except Exception:
+                pass  # unknown source: treat its columns as unknown
+        return out
+
+    def _correlation(self, sub: Select):
+        """Detect equality correlation: WHERE conjuncts of the form
+        `outer.col = inner_col`, with the outer side either qualified
+        by an outer alias (q1/q30/q81) or an unqualified name that
+        belongs only to the outer scope (q32/q92's bare `i_item_sk`).
+        Returns a list of (outer Col, inner Col, conjunct) or [] when
+        uncorrelated. Raises for correlation shapes that can't be
+        decorrelated by equality (e.g. q16's `cs1.x <> cs2.x`)."""
+        inner = self._inner_aliases(sub)
+        outer = {a.lower() for a in getattr(self, "_outer_aliases", ())}
+        inner_cols = None  # lazily probed
+
+        def is_outer(c) -> bool:
+            nonlocal inner_cols
+            if not isinstance(c, Col):
+                return False
+            if len(c.parts) >= 2:
+                return (c.parts[-2].lower() not in inner
+                        and c.parts[-2].lower() in outer)
+            # unqualified: outer only if the name is NOT an inner
+            # column but IS resolvable in the outer scope
+            if inner_cols is None:
+                inner_cols = self._inner_columns(sub)
+            if c.parts[0].lower() in inner_cols:
+                return False
+            try:
+                self._resolve(c)
+                return True
+            except DeltaError:
+                return False
+
+        corr = []
+        leftover_outer = []
+        for conj in _split_and(sub.where):
+            if (isinstance(conj, Cmp) and conj.op == "="
+                    and isinstance(conj.left, Col)
+                    and isinstance(conj.right, Col)):
+                lo, ro = is_outer(conj.left), is_outer(conj.right)
+                if lo != ro:
+                    o, i = ((conj.left, conj.right) if lo
+                            else (conj.right, conj.left))
+                    corr.append((o, i, conj))
+                    continue
+
+            def chk(x):
+                if is_outer(x):
+                    leftover_outer.append(x)
+            _walk_exprs(conj, chk)
+        if leftover_outer:
+            raise UnsupportedSqlError(
+                "correlated subquery uses outer columns outside "
+                f"equality conjuncts ({leftover_outer[0].text}); only "
+                "equality correlation is supported")
+        return corr
+
+    def _decorrelated_frame(self, sub: Select, corr, extra_items,
+                            aggregate: bool):
+        """Run `sub` with the correlation conjuncts removed and the
+        inner correlation columns added as group keys (aggregate=True)
+        or distinct output columns. Returns (df, corr_key_names)."""
+        if sub.group_by or sub.having:
+            raise UnsupportedSqlError(
+                "correlated subquery with its own GROUP BY/HAVING is "
+                "not supported")
+        drop = {id(c) for _o, _i, c in corr}
+        keep = [c for c in _split_and(sub.where) if id(c) not in drop]
+        where = None
+        if keep:
+            where = keep[0] if len(keep) == 1 else And(tuple(keep))
+        key_items = [SelectItem(i, alias=f"__ck{k}")
+                     for k, (_o, i, _c) in enumerate(corr)]
+        inner_sel = Select(
+            items=key_items + extra_items,
+            froms=list(sub.froms), joins=list(sub.joins), where=where,
+            group_by=[i for _o, i, _c in corr] if aggregate else [],
+            distinct=not aggregate,
+        )
+        sub_df, names = _Exec(self.engine, self.catalog,
+                              self.ctes).run(inner_sel)
+        sub_df = sub_df.copy()
+        sub_df.columns = names
+        return sub_df, [f"__ck{k}" for k in range(len(corr))]
+
+    def _outer_key_frame(self, corr, df):
+        work = pd.DataFrame(index=pd.RangeIndex(len(df)))
+        for k, (o, _i, _c) in enumerate(corr):
+            s = self._eval(o, df)
+            work[f"__ck{k}"] = s.values if isinstance(s, pd.Series) \
+                else s
+        return work
+
+    def _correlated_scalar(self, sub: Select, corr, df):
+        if len(sub.items) != 1 or isinstance(sub.items[0].expr, Star):
+            raise SqlParseError("scalar subquery must return one column")
+        val_item = SelectItem(sub.items[0].expr, alias="__cv")
+        if not _has_agg(val_item.expr):
+            raise UnsupportedSqlError(
+                "correlated scalar subquery must aggregate (else it "
+                "may return >1 row per outer row)")
+        sub_df, keys = self._decorrelated_frame(sub, corr, [val_item],
+                                                aggregate=True)
+        # per-outer-row lookup by correlation tuple; missing → NULL.
+        # NULL keys never participate: `k = NULL` is UNKNOWN on both
+        # sides (Python dicts would happily match None == None)
+        lut = {}
+        for r in sub_df[keys + ["__cv"]].itertuples(index=False):
+            t = tuple(r)
+            if not any(pd.isna(v) for v in t[:-1]):
+                lut[t[:-1]] = t[-1]
+        outer = self._outer_key_frame(corr, df)
+        out_vals = [None if any(pd.isna(v) for v in r)
+                    else lut.get(tuple(r), None)
+                    for r in outer[keys].itertuples(index=False)]
+        return pd.Series(out_vals, index=df.index)
+
+    def _correlated_semi(self, sub: Select, corr, df, item=None):
+        """EXISTS (semi-join) / IN membership against a correlated
+        subquery; returns a kleene boolean mask over df."""
+        extra = []
+        if item is not None:
+            if len(sub.items) != 1 or isinstance(sub.items[0].expr,
+                                                 Star):
+                raise SqlParseError("IN subquery must return one column")
+            extra = [SelectItem(sub.items[0].expr, alias="__cv")]
+        sub_df, keys = self._decorrelated_frame(sub, corr, extra,
+                                                aggregate=False)
+        cols = keys + (["__cv"] if item is not None else [])
+        # three-valued membership: a NULL inner correlation key never
+        # matches equality; a NULL inner VALUE makes non-matches in
+        # that group UNKNOWN (the NOT IN footgun, per correlation group)
+        match_keys = set()
+        groups_seen = set()
+        group_has_null = set()
+        for r in sub_df[cols].itertuples(index=False):
+            t = tuple(r)
+            kt = t[:len(keys)]
+            if any(pd.isna(v) for v in kt):
+                continue
+            if item is None:
+                match_keys.add(kt)
+                continue
+            groups_seen.add(kt)
+            if pd.isna(t[-1]):
+                group_has_null.add(kt)
+            else:
+                match_keys.add(t)
+        outer = self._outer_key_frame(corr, df)
+        if item is not None:
+            s = self._eval(item, df)
+            outer["__cv"] = s.values if isinstance(s, pd.Series) else s
+        vals = []
+        for r in outer.itertuples(index=False):
+            t = tuple(r)
+            kt = t[:len(keys)]
+            if any(pd.isna(v) for v in kt):
+                # NULL outer key: equality is UNKNOWN for every inner
+                # row, so the subquery is empty — EXISTS/IN → FALSE
+                vals.append(False)
+            elif item is None:
+                vals.append(kt in match_keys)
+            elif kt not in groups_seen:
+                vals.append(False)  # IN against an empty set
+            elif pd.isna(t[-1]):
+                vals.append(pd.NA)  # NULL item vs non-empty set
+            elif t in match_keys:
+                vals.append(True)
+            elif kt in group_has_null:
+                vals.append(pd.NA)
+            else:
+                vals.append(False)
+        return pd.Series(vals, index=df.index, dtype="boolean")
 
     def _scalar_func(self, e: Func, df):
         return self._apply_func(e, [self._eval(a, df) for a in e.args],
@@ -996,9 +1327,13 @@ class _Exec:
                 "__v"].transform(expand)
         else:
             cum = expand(order["__v"])
-        # RANGE frame: peers (equal order keys) share the value at
-        # the last peer row
         order = order.assign(__cum=cum.values)
+        if e.frame == "rows":
+            # strict running frame: no peer sharing
+            return pd.Series(order["__cum"].sort_index().values,
+                             index=df.index)
+        # RANGE frame (SQL default): peers (equal order keys) share
+        # the value at the last peer row
         peers = order.groupby(pcols + ocols, dropna=False,
                               sort=False)["__cum"].transform("last")
         return pd.Series(peers.sort_index().values, index=df.index)

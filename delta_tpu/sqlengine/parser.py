@@ -87,6 +87,9 @@ class Window:
     func: "Func"
     partition_by: Tuple[object, ...] = ()
     order_by: Tuple[Tuple[object, bool], ...] = ()  # (expr, asc)
+    # frame with ORDER BY: "range" (SQL default; peers share values)
+    # or "rows" (strict running frame)
+    frame: str = "range"
 
 
 @dataclass(frozen=True)
@@ -196,6 +199,21 @@ class Select:
     distinct: bool = False
 
 
+@dataclass
+class Query:
+    """Full query: optional WITH clause + one or more UNION ALL'd
+    selects + trailing ORDER BY/LIMIT applying to the union result.
+    A bare SELECT parses as Query(ctes=[], selects=[sel]) and the
+    executor unwraps it."""
+
+    ctes: List[Tuple[str, "Query"]] = field(default_factory=list)
+    selects: List[Select] = field(default_factory=list)
+    # "all" | "distinct", one per additional select (left-assoc fold)
+    union_ops: List[str] = field(default_factory=list)
+    order_by: List[Tuple[object, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
 # ------------------------------------------------------------ lexer ---
 
 _TOKEN_RE = re.compile(
@@ -217,7 +235,8 @@ KEYWORDS = {
     "CROSS", "ON", "AS", "AND", "OR", "NOT", "IN", "EXISTS", "BETWEEN",
     "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END", "CAST",
     "INTERVAL", "ASC", "DESC", "VERSION", "TIMESTAMP", "OF", "UNION",
-    "TRUE", "FALSE", "OVER", "PARTITION",
+    "TRUE", "FALSE", "OVER", "PARTITION", "WITH", "ALL", "ROWS",
+    "RANGE", "UNBOUNDED", "PRECEDING", "CURRENT", "ROW", "FOLLOWING",
 }
 
 
@@ -398,9 +417,13 @@ class _P:
         t = self.peek()
         if t.kind == "op" and t.value == "(":
             self.next()
-            sub = self.parse_select()
+            # full query: `from (select ... union all select ...) x`
+            sub = self._query()
             self.expect_op(")")
             alias = self._opt_alias()
+            if not sub.ctes and len(sub.selects) == 1 \
+                    and not sub.order_by and sub.limit is None:
+                sub = sub.selects[0]
             return TableRef("subquery", sub, alias)
         if t.kind in ("str", "dstr"):
             self.next()
@@ -748,8 +771,58 @@ def _parse_window(self: _P, f: Func) -> Window:
             order.append((e, asc))
             if not self.accept_op(","):
                 break
+    frame = "range"
+    if self.peek().is_kw("ROWS") or self.peek().is_kw("RANGE"):
+        # only the SQL-default-shaped frame is supported:
+        # [ROWS|RANGE] BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
+        frame = "rows" if self.next().value.lower() == "rows" else "range"
+        self.expect_kw("BETWEEN")
+        self.expect_kw("UNBOUNDED")
+        self.expect_kw("PRECEDING")
+        self.expect_kw("AND")
+        self.expect_kw("CURRENT")
+        self.expect_kw("ROW")
     self.expect_op(")")
-    return Window(f, tuple(part), tuple(order))
+    return Window(f, tuple(part), tuple(order), frame)
 
 
 _P._window = _parse_window
+
+
+def _parse_query(self: _P) -> Query:
+    q = Query()
+    if self.accept_kw("WITH"):
+        while True:
+            name = self._ident_token().value
+            self.expect_kw("AS")
+            self.expect_op("(")
+            q.ctes.append((name, self._query()))
+            self.expect_op(")")
+            if not self.accept_op(","):
+                break
+    q.selects.append(self.parse_select())
+    while self.peek().is_kw("UNION"):
+        self.next()
+        q.union_ops.append("all" if self.accept_kw("ALL")
+                           else "distinct")
+        q.selects.append(self.parse_select())
+    if len(q.selects) > 1:
+        # a trailing ORDER BY/LIMIT binds to the union result, not the
+        # final branch (standard SQL); the branch parser grabbed it
+        last = q.selects[-1]
+        q.order_by, last.order_by = last.order_by, []
+        q.limit, last.limit = last.limit, None
+    return q
+
+
+_P._query = _parse_query
+
+
+def parse_query(statement: str) -> Query:
+    """Parse a full query: [WITH ...] select [UNION ALL select]..."""
+    toks = tokenize(statement.strip().rstrip(";"))
+    p = _P(toks, statement)
+    q = p._query()
+    if p.peek().kind != "end":
+        raise SqlParseError(f"unexpected trailing SQL at {p._ctx()}")
+    return q

@@ -181,16 +181,23 @@ class AzureRenameLogStore(LogStore):
         tmp = (f"{parent}/" if parent else "") + \
             f".{base}.{uuid.uuid4().hex}.tmp"
         self.client.put_file(tmp, data)
+        # a successful rename removes the source atomically; only the
+        # destination-exists and transport-error paths leave a temp to
+        # clean (an orphan temp is invisible to the log listing anyway)
         try:
-            if not self.client.rename_if_absent(tmp, name):
-                raise FileAlreadyExistsError(path)
-        finally:
-            # successful rename removes the source; this only cleans
-            # up the destination-exists and transport-error paths
-            try:
-                self.client.delete(tmp)
-            except IOError:
-                pass  # orphan temp is invisible to the log listing
+            renamed = self.client.rename_if_absent(tmp, name)
+        except Exception:
+            self._cleanup_tmp(tmp)
+            raise
+        if not renamed:
+            self._cleanup_tmp(tmp)
+            raise FileAlreadyExistsError(path)
+
+    def _cleanup_tmp(self, tmp: str) -> None:
+        try:
+            self.client.delete(tmp)
+        except IOError:
+            pass
 
     def _status(self, item: dict, directory: str) -> FileStatus:
         name = item["name"]

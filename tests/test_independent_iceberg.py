@@ -1,0 +1,129 @@
+"""UniForm Iceberg conformance via the independent from-spec reader
+(VERDICT r3 ask #6): every converted snapshot's live file set — read
+back through `tests/independent_iceberg_oracle.py`, which shares zero
+code with `delta_tpu.interop` — must equal the Delta snapshot's, across
+a seeded op-fuzz of append/delete/optimize/restore including the
+remove-then-re-add case fixed in round 3 (commit b579481).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.table import Table
+from tests.independent_iceberg_oracle import (
+    live_data_files,
+    snapshot_lineage,
+    total_record_count,
+)
+
+PROPS = {"delta.universalFormat.enabledFormats": "iceberg"}
+
+
+def _delta_live(table_path) -> set:
+    snap = Table.for_path(table_path).latest_snapshot()
+    paths = snap.state.add_files_table.column("path").to_pylist()
+    return {p if ("://" in p or p.startswith("/"))
+            else f"{table_path}/{p}" for p in paths}
+
+
+def _assert_conforms(table_path):
+    ice = live_data_files(table_path)
+    delta = _delta_live(table_path)
+    assert ice == delta, (
+        f"iceberg live set diverged: only-ice={sorted(ice - delta)[:3]} "
+        f"only-delta={sorted(delta - ice)[:3]}")
+
+
+def _batch(lo, hi):
+    return pa.table({
+        "id": pa.array(np.arange(lo, hi, dtype=np.int64)),
+        "v": pa.array(np.arange(lo, hi, dtype=np.float64)),
+    })
+
+
+def test_append_delete_roundtrip(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 100), properties=PROPS)
+    _assert_conforms(tmp_table_path)
+    dta.write_table(tmp_table_path, _batch(100, 200), mode="append")
+    _assert_conforms(tmp_table_path)
+
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.expressions import col, lit
+
+    delete(Table.for_path(tmp_table_path),
+           predicate=col("id") < lit(100))
+    _assert_conforms(tmp_table_path)
+
+
+def test_remove_then_readd_same_file(tmp_table_path):
+    """The round-3 re-add bug shape: a file removed and re-added in a
+    later commit must appear exactly once in the manifests."""
+    dta.write_table(tmp_table_path, _batch(0, 50), properties=PROPS)
+    snap = Table.for_path(tmp_table_path).latest_snapshot()
+    from delta_tpu.commands.restore import restore
+
+    dta.write_table(tmp_table_path, _batch(50, 100), mode="append")
+    restore(Table.for_path(tmp_table_path), version=0)
+    _assert_conforms(tmp_table_path)
+    # re-add: restore forward again to the version holding both files
+    restore(Table.for_path(tmp_table_path), version=1)
+    _assert_conforms(tmp_table_path)
+
+
+def test_optimize_rewrite(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 40), properties=PROPS)
+    for i in range(3):
+        dta.write_table(tmp_table_path, _batch(40 * (i + 1), 40 * (i + 2)),
+                        mode="append")
+    _assert_conforms(tmp_table_path)
+    Table.for_path(tmp_table_path).optimize().execute_compaction()
+    _assert_conforms(tmp_table_path)
+    assert total_record_count(tmp_table_path) == 160
+
+
+def test_seeded_op_fuzz(tmp_table_path):
+    """Randomized append/delete/optimize/restore sequence; the
+    independent reader must agree after EVERY commit."""
+    from delta_tpu.commands.dml import delete
+    from delta_tpu.commands.restore import restore
+    from delta_tpu.expressions import col, lit
+
+    rng = np.random.default_rng(42)
+    dta.write_table(tmp_table_path, _batch(0, 30), properties=PROPS)
+    _assert_conforms(tmp_table_path)
+    next_id = 30
+    for step in range(12):
+        op = rng.choice(["append", "delete", "optimize", "restore"])
+        table = Table.for_path(tmp_table_path)
+        try:
+            if op == "append":
+                dta.write_table(tmp_table_path,
+                                _batch(next_id, next_id + 20),
+                                mode="append")
+                next_id += 20
+            elif op == "delete":
+                cut = int(rng.integers(0, next_id))
+                delete(table, predicate=col("id") < lit(cut))
+            elif op == "optimize":
+                table.optimize().execute_compaction()
+            else:
+                v = table.latest_snapshot().version
+                target = int(rng.integers(0, v + 1))
+                restore(table, version=target)
+        except Exception as e:  # empty-table edge ops are fine to skip
+            if "no files" in str(e).lower():
+                continue
+            raise
+        _assert_conforms(tmp_table_path)
+    lineage = snapshot_lineage(tmp_table_path)
+    assert len(lineage) >= 2  # history accumulated through the fuzz
+
+
+def test_record_counts_match_delta_stats(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 64), properties=PROPS)
+    dta.write_table(tmp_table_path, _batch(64, 100), mode="append")
+    assert total_record_count(tmp_table_path) == 100

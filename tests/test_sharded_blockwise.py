@@ -1,0 +1,99 @@
+"""Sharded × blockwise composition (VERDICT r3 ask #5): mesh-sharded
+replay where every shard streams >HBM-sized substreams in bounded
+blocks with a persistent bitset — the `Snapshot.scala:481-511`
+multi-host configuration. Parity vs the single-device oracle at 10M
+rows on an 8-device CPU mesh, including a skewed (hot-shard) history.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from delta_tpu.ops.replay import replay_select
+from delta_tpu.parallel.sharded_blockwise import (
+    replay_select_sharded_blockwise,
+)
+
+
+def _mesh():
+    from delta_tpu.parallel.mesh import REPLAY_AXIS
+
+    devs = np.array(jax.devices())
+    if devs.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    return Mesh(devs, (REPLAY_AXIS,))
+
+
+def _history(n, n_paths, seed=0, hot_fraction=0.0, n_shards=8):
+    """Synthetic add/remove stream; `hot_fraction` routes that share of
+    rows to paths whose key ≡ 0 (mod n_shards) — one hot shard."""
+    rng = np.random.default_rng(seed)
+    pk = rng.integers(0, n_paths, n).astype(np.uint32)
+    if hot_fraction:
+        hot = rng.random(n) < hot_fraction
+        pk[hot] = (pk[hot] // n_shards) * n_shards  # key % S == 0
+    dk = np.zeros(n, dtype=np.uint32)
+    dv_rows = rng.random(n) < 0.02
+    dk[dv_rows] = rng.integers(1, 4, int(dv_rows.sum())).astype(np.uint32)
+    is_add = rng.random(n) < 0.7
+    n_commits = max(2, n // 50)
+    ver = np.sort(rng.integers(0, n_commits, n)).astype(np.int32)
+    change = np.nonzero(np.diff(ver))[0] + 1
+    starts = np.concatenate([[0], change])
+    lens = np.diff(np.concatenate([starts, [n]]))
+    order = (np.arange(n) - np.repeat(starts, lens)).astype(np.int32)
+    return pk, dk, ver, order, is_add
+
+
+def test_parity_10m_rows_multiple_blocks():
+    mesh = _mesh()
+    n = 10_000_000
+    pk, dk, ver, order, is_add = _history(n, n_paths=2_000_000)
+    live, tomb, blocks = replay_select_sharded_blockwise(
+        [pk, dk], ver, order, is_add, mesh, block_rows=1 << 18)
+    live_o, tomb_o = replay_select([pk, dk], ver, order, is_add)
+    assert np.array_equal(live, np.asarray(live_o))
+    assert np.array_equal(tomb, np.asarray(tomb_o))
+    # the scale claim: every shard streamed >1 block
+    assert (blocks > 1).all(), blocks
+
+
+def test_parity_skewed_hot_shard():
+    mesh = _mesh()
+    S = mesh.devices.size
+    n = 1_000_000
+    pk, dk, ver, order, is_add = _history(
+        n, n_paths=200_000, seed=3, hot_fraction=0.6, n_shards=S)
+    live, tomb, blocks = replay_select_sharded_blockwise(
+        [pk, dk], ver, order, is_add, mesh, block_rows=1 << 15)
+    live_o, tomb_o = replay_select([pk, dk], ver, order, is_add)
+    assert np.array_equal(live, np.asarray(live_o))
+    assert np.array_equal(tomb, np.asarray(tomb_o))
+    # skew materialized: the hot shard streamed strictly more blocks
+    assert blocks[0] > blocks[1:].max()
+
+
+def test_parity_unsorted_history_and_small():
+    mesh = _mesh()
+    rng = np.random.default_rng(7)
+    n = 50_000
+    pk, dk, ver, order, is_add = _history(n, n_paths=5_000, seed=11)
+    shuffle = rng.permutation(n)
+    live, tomb, _ = replay_select_sharded_blockwise(
+        [pk[shuffle], dk[shuffle]], ver[shuffle], order[shuffle],
+        is_add[shuffle], mesh, block_rows=1 << 13)
+    live_o, tomb_o = replay_select(
+        [pk[shuffle], dk[shuffle]], ver[shuffle], order[shuffle],
+        is_add[shuffle])
+    assert np.array_equal(live, np.asarray(live_o))
+    assert np.array_equal(tomb, np.asarray(tomb_o))
+
+
+def test_empty_stream():
+    mesh = _mesh()
+    z = np.zeros(0, np.uint32)
+    live, tomb, blocks = replay_select_sharded_blockwise(
+        [z, z], np.zeros(0, np.int32), np.zeros(0, np.int32),
+        np.zeros(0, bool), mesh)
+    assert live.size == 0 and tomb.size == 0
