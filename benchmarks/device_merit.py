@@ -32,8 +32,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -57,16 +61,23 @@ def measure_link(device):
     import jax
     import jax.numpy as jnp
 
-    sizes = [1 << 20, 8 << 20, 64 << 20]
+    sizes = [8 << 20, 64 << 20]
     h2d, d2h = {}, {}
     for size in sizes:
         buf = np.random.default_rng(0).integers(
             0, 255, size, dtype=np.uint8)
         t = _best(lambda: jax.device_put(buf, device).block_until_ready())
         h2d[size] = size / t
-        dbuf = jax.device_put(buf, device)
-        dbuf.block_until_ready()
-        t = _best(lambda: np.asarray(dbuf))
+
+        def pull():
+            # fresh device array per rep: jax caches np.asarray results
+            dbuf = jax.device_put(buf, device)
+            dbuf.block_until_ready()
+            t0 = time.perf_counter()
+            np.asarray(dbuf)
+            return time.perf_counter() - t0
+
+        t = min(pull() for _ in range(3))
         d2h[size] = size / t
     one = jax.device_put(np.zeros(8, np.float32), device)
     inc = jax.jit(lambda x: x + 1)
@@ -76,7 +87,9 @@ def measure_link(device):
         "h2d_bytes_per_s": {str(k): round(v) for k, v in h2d.items()},
         "d2h_bytes_per_s": {str(k): round(v) for k, v in d2h.items()},
         "rtt_s": rtt,
-        "bw_bytes_per_s": max(h2d.values()),
+        # sustained figure: the LARGEST transfer's bandwidth (small
+        # sizes are RTT/warmup-dominated and can read as outliers)
+        "bw_bytes_per_s": h2d[sizes[-1]],
     }
 
 
@@ -178,8 +191,34 @@ def wl_blockwise(n, device):
 
     assert host() == got
     t_host = _best(host, k=2)
+    # isolated compute: one resident block step x number of blocks
+    import jax
+    import jax.numpy as jnp
+
+    from delta_tpu.ops.replay import _PAD_KEY, pad_bucket
+    from delta_tpu.ops.replay_blockwise import (
+        DEFAULT_BLOCK_ROWS,
+        _block_kernel_impl,
+    )
+
+    m = pad_bucket(min(DEFAULT_BLOCK_ROWS, n))
+    n_blocks = -(-n // m)
+    key32 = ((pk.astype(np.uint32) << np.uint32(2)) | dk)[:m]
+    blk = np.full(m, _PAD_KEY, np.uint32)
+    blk[:len(key32)] = key32
+    words = m // 32
+    step = jax.jit(lambda seen, keys: _block_kernel_impl(
+        seen, keys, jnp.int32(m), m))
+    seen0 = jax.device_put(jnp.zeros((pad_bucket(words),), jnp.uint32),
+                           device)
+    dblk = jax.device_put(blk, device)
+    step(seen0, dblk)[0].block_until_ready()
+    t_block = _best(
+        lambda: step(seen0, dblk)[0].block_until_ready(), k=3)
+    t_comp = t_block * n_blocks
     bytes_moved = n * 4.0 + n // 8  # u32 key blocks + winner words
     return {"n": n, "t_device_s": t_dev, "t_host_s": t_host,
+            "t_device_compute_s": t_comp,
             "bytes_transferred_est": int(bytes_moved),
             "device_wins": t_dev < t_host}
 

@@ -64,7 +64,8 @@ TABLE_NAMES = [
     "date_dim", "time_dim", "item", "customer", "customer_address",
     "customer_demographics", "household_demographics", "promotion",
     "store", "warehouse", "ship_mode", "web_site", "web_page",
-    "call_center", "store_sales", "store_returns", "catalog_sales",
+    "catalog_page", "call_center", "store_sales", "store_returns",
+    "catalog_sales",
     "catalog_returns", "web_sales", "web_returns", "inventory",
 ]
 
@@ -279,6 +280,8 @@ def _promotion(rng) -> pa.Table:
             [["N", "Y"][i] for i in rng.integers(0, 2, n)]),
         "p_channel_dmail": pa.array(
             [["N", "Y"][i] for i in rng.integers(0, 2, n)]),
+        "p_channel_tv": pa.array(
+            [["N", "Y"][i] for i in rng.integers(0, 2, n)]),
     })
 
 
@@ -339,6 +342,7 @@ def _web_site() -> pa.Table:
     n = 6
     return pa.table({
         "web_site_sk": pa.array(np.arange(1, n + 1)),
+        "web_site_id": pa.array([f"SITE{j:012d}" for j in range(n)]),
         "web_name": pa.array([f"site_{j}" for j in range(n)]),
         "web_company_name": pa.array(["pri"] * n),
     })
@@ -350,6 +354,16 @@ def _web_page(rng) -> pa.Table:
         "wp_web_page_sk": pa.array(np.arange(1, n + 1)),
         "wp_char_count": pa.array(
             rng.integers(4000, 6000, n).astype(np.int64)),
+    })
+
+
+def _catalog_page() -> pa.Table:
+    n = 20
+    sk = np.arange(1, n + 1)
+    return pa.table({
+        "cp_catalog_page_sk": pa.array(sk),
+        "cp_catalog_page_id": pa.array(
+            [f"PAGE{j:012d}" for j in sk]),
     })
 
 
@@ -384,6 +398,7 @@ def generate(scale: int = 50_000, seed: int = 7):
         "ship_mode": _ship_mode(),
         "web_site": _web_site(),
         "web_page": _web_page(rng),
+        "catalog_page": _catalog_page(),
         "call_center": _call_center(),
     }
 
@@ -519,6 +534,8 @@ def generate(scale: int = 50_000, seed: int = 7):
             rng.integers(1, n_cc + 1, nc).astype(np.int64)),
         "cs_promo_sk": _maybe_null_int(
             rng, rng.integers(1, 31, nc), 0.05),
+        "cs_catalog_page_sk": _maybe_null_int(
+            rng, rng.integers(1, 21, nc), 0.03),
         "cs_order_number": pa.array((np.arange(nc) // 2 + 1)),
         "cs_quantity": pa.array(rng.integers(1, 101, nc).astype(
             np.int64)),

@@ -631,7 +631,7 @@ class _Exec:
         # output column evaluation
         out_cols: List[pd.Series] = []
         out_names: List[str] = []
-        for it in sel.items:
+        for item_idx, it in enumerate(sel.items):
             if isinstance(it.expr, Star):
                 if has_agg or sel.group_by:
                     raise SqlParseError("SELECT * cannot combine with "
@@ -640,7 +640,23 @@ class _Exec:
                     out_cols.append(df[c])
                     out_names.append(c.split(".", 1)[1] if "." in c else c)
                 continue
-            s = self._eval_out(it.expr, df, env, resolve)
+            # lateral alias resolution (Spark semantics): an item may
+            # reference EARLIER items' aliases (q36's lochierarchy in
+            # a later rank() window), but a real source column of the
+            # same name always wins over an alias
+            expr = it.expr
+            lateral = {}
+            for prev in sel.items[:item_idx]:
+                if not prev.alias:
+                    continue
+                try:
+                    resolve(Col((prev.alias,)))
+                    continue  # real column shadows the alias
+                except DeltaError:
+                    lateral[prev.alias] = prev.expr
+            if lateral:
+                expr = self._sub_aliases(expr, lateral)
+            s = self._eval_out(expr, df, env, resolve)
             if not isinstance(s, pd.Series):  # scalar -> broadcast
                 s = pd.Series([s] * len(df), index=df.index)
             out_cols.append(s)
@@ -702,6 +718,13 @@ class _Exec:
     def _aggregate(self, sel: Select, df: pd.DataFrame, resolve):
         canon = lambda e: _canon(e, resolve)  # noqa: E731
         key_exprs = list(sel.group_by)
+        rollup = None
+        if len(key_exprs) == 1 and isinstance(key_exprs[0], Func) \
+                and key_exprs[0].name == "rollup":
+            # GROUP BY ROLLUP (a, b, c): aggregate at every key prefix
+            # level and union, with grouping(k)=1 on rolled-up keys
+            rollup = list(key_exprs[0].args)
+            key_exprs = rollup
         key_cols = {}
         for e in key_exprs:
             key_cols[canon(e)] = self._eval(e, df)
@@ -721,42 +744,42 @@ class _Exec:
         work = pd.DataFrame(index=df.index)
         for k, s in key_cols.items():
             work[k] = s
-        arg_cols = {}
         for k, f in agg_specs.items():
             if not f.star:
                 if len(f.args) != 1:
                     raise SqlParseError(
                         f"{f.name} takes exactly one argument")
-                arg_cols[k] = self._eval(f.args[0], df)
-                work[f"__arg_{k}"] = arg_cols[k]
+                work[f"__arg_{k}"] = self._eval(f.args[0], df)
 
-        if key_exprs:
-            gb = work.groupby(list(key_cols), dropna=False, sort=False)
-            out = gb.size().rename("__size").reset_index()
-            for k, f in agg_specs.items():
-                if f.star:
-                    out[k] = gb.size().values
-                    continue
-                col = f"__arg_{k}"
-                if f.name == "count" and f.distinct:
-                    vals = gb[col].nunique()
-                elif f.name == "count":
-                    vals = gb[col].count()
-                elif f.name == "sum":
-                    vals = gb[col].sum(min_count=1)
-                elif f.name == "avg":
-                    vals = gb[col].mean()
-                elif f.name == "min":
-                    vals = gb[col].min()
-                elif f.name == "max":
-                    vals = gb[col].max()
-                elif f.name == "stddev_samp":
-                    vals = gb[col].std()
-                elif f.name == "var_samp":
-                    vals = gb[col].var()
-                out[k] = vals.values
-            out = out.drop(columns="__size")
-        else:
+        def agg_over(names):
+            """Aggregate `work` grouped by the given key columns
+            (global single row when empty)."""
+            if names:
+                gb = work.groupby(names, dropna=False, sort=False)
+                out = gb.size().rename("__size").reset_index()
+                for k, f in agg_specs.items():
+                    if f.star:
+                        out[k] = gb.size().values
+                        continue
+                    col = f"__arg_{k}"
+                    if f.name == "count" and f.distinct:
+                        vals = gb[col].nunique()
+                    elif f.name == "count":
+                        vals = gb[col].count()
+                    elif f.name == "sum":
+                        vals = gb[col].sum(min_count=1)
+                    elif f.name == "avg":
+                        vals = gb[col].mean()
+                    elif f.name == "min":
+                        vals = gb[col].min()
+                    elif f.name == "max":
+                        vals = gb[col].max()
+                    elif f.name == "stddev_samp":
+                        vals = gb[col].std()
+                    elif f.name == "var_samp":
+                        vals = gb[col].var()
+                    out[k] = vals.values
+                return out.drop(columns="__size")
             row = {}
             for k, f in agg_specs.items():
                 if f.star:
@@ -779,7 +802,23 @@ class _Exec:
                     row[k] = s.std()
                 elif f.name == "var_samp":
                     row[k] = s.var()
-            out = pd.DataFrame([row])
+            return pd.DataFrame([row])
+
+        names = list(key_cols)
+        if rollup is not None:
+            frames = []
+            for level in range(len(names), -1, -1):
+                sub = agg_over(names[:level])
+                for j, kn in enumerate(names):
+                    if j >= level:
+                        sub[kn] = None
+                    sub[f"grouping({kn})"] = 1 if j >= level else 0
+                frames.append(sub)
+            out = pd.concat(frames, ignore_index=True)
+        elif names:
+            out = agg_over(names)
+        else:
+            out = agg_over([])
         self._agg_env = {k: k for k in out.columns}
         return out
 
@@ -811,6 +850,26 @@ class _Exec:
                 e, item=self._sub_aliases(e.item, alias_map),
                 values=tuple(self._sub_aliases(v, alias_map)
                              for v in e.values))
+        if isinstance(e, Window):
+            return dataclasses.replace(
+                e,
+                func=self._sub_aliases(e.func, alias_map),
+                partition_by=tuple(self._sub_aliases(p, alias_map)
+                                   for p in e.partition_by),
+                order_by=tuple((self._sub_aliases(o, alias_map), asc)
+                               for o, asc in e.order_by))
+        if isinstance(e, Func):
+            return dataclasses.replace(
+                e, args=tuple(self._sub_aliases(a, alias_map)
+                              for a in e.args))
+        if isinstance(e, CaseWhen):
+            return dataclasses.replace(
+                e,
+                whens=tuple((self._sub_aliases(c, alias_map),
+                             self._sub_aliases(v, alias_map))
+                            for c, v in e.whens),
+                else_=self._sub_aliases(e.else_, alias_map)
+                if e.else_ is not None else None)
         return e
 
     def _eval_out(self, e, df, env, resolve):
@@ -1269,8 +1328,13 @@ class _Exec:
                     else s
                 ocols.append(f"__o{i}")
                 ascs.append(asc)
-            order = work.sort_values(ocols, ascending=ascs,
-                                     kind="mergesort")
+            # Spark sort-order semantics per key: NULLS FIRST when
+            # ascending, LAST when descending (reverse stable passes)
+            order = work
+            for i in range(len(ocols) - 1, -1, -1):
+                order = order.sort_values(
+                    ocols[i], ascending=ascs[i], kind="mergesort",
+                    na_position="first" if ascs[i] else "last")
             if pcols:
                 pos = order.groupby(pcols, dropna=False,
                                     sort=False).cumcount() + 1
@@ -1315,8 +1379,11 @@ class _Exec:
             ocols.append(f"__o{i}")
             ascs.append(asc)
         work["__v"] = s.values
-        order = work.sort_values(ocols, ascending=ascs,
-                                 kind="mergesort")
+        order = work
+        for i in range(len(ocols) - 1, -1, -1):
+            order = order.sort_values(
+                ocols[i], ascending=ascs[i], kind="mergesort",
+                na_position="first" if ascs[i] else "last")
         expand = {"sum": lambda x: x.expanding().sum(),
                   "mean": lambda x: x.expanding().mean(),
                   "min": lambda x: x.expanding().min(),

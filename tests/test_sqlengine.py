@@ -367,3 +367,74 @@ def test_aggregate_in_where_rejected(t):
 def test_bare_column_with_group_by_rejected(t):
     with pytest.raises(DeltaError, match="GROUP BY"):
         sql(f"SELECT v, COUNT(*) FROM '{t}' GROUP BY id")
+
+
+def test_group_by_rollup_with_grouping(t):
+    out = sql(f"SELECT CASE WHEN id < 3 THEN 'lo' ELSE 'hi' END b, "
+              f"SUM(v) s, grouping(CASE WHEN id < 3 THEN 'lo' ELSE "
+              f"'hi' END) g FROM '{t}' WHERE id IS NOT NULL "
+              f"GROUP BY ROLLUP (CASE WHEN id < 3 THEN 'lo' ELSE "
+              f"'hi' END) ORDER BY g, b")
+    # detail rows (hi=70, lo=30) + grand total (100, grouping=1)
+    assert out.column("b").to_pylist() == ["hi", "lo", None]
+    assert out.column("s").to_pylist() == [70.0, 30.0, 100.0]
+    assert out.column("g").to_pylist() == [0, 0, 1]
+
+
+def test_union_all_and_distinct(t):
+    out = sql(f"SELECT id FROM '{t}' WHERE id <= 2 "
+              f"UNION ALL SELECT id FROM '{t}' WHERE id = 2 "
+              f"ORDER BY id")
+    assert out.column("id").to_pylist() == [1, 2, 2]
+    out = sql(f"SELECT id FROM '{t}' WHERE id <= 2 "
+              f"UNION SELECT id FROM '{t}' WHERE id = 2 ORDER BY 1")
+    assert out.column("id").to_pylist() == [1, 2]
+
+
+def test_cte_visible_to_subqueries(t):
+    out = sql(f"WITH big AS (SELECT id, v FROM '{t}' WHERE v >= 30) "
+              f"SELECT id FROM big WHERE v > "
+              f"(SELECT AVG(v) FROM big) ORDER BY id")
+    assert out.column("id").to_pylist() == [None]  # v=50 > avg(40)
+
+
+def test_correlated_exists(t, other):
+    out = sql(f"SELECT id FROM '{t}' WHERE EXISTS "
+              f"(SELECT k FROM '{other}' WHERE k = id) ORDER BY id")
+    assert out.column("id").to_pylist() == [2, 3]
+    out = sql(f"SELECT id FROM '{t}' WHERE id IS NOT NULL AND "
+              f"NOT EXISTS (SELECT k FROM '{other}' WHERE k = id) "
+              f"ORDER BY id")
+    assert out.column("id").to_pylist() == [1, 4]
+
+
+def test_correlated_scalar_aggregate(t, other):
+    # per-key average from the other table; keys without a group → NULL
+    out = sql(f"SELECT id, (SELECT SUM(w) FROM '{other}' "
+              f"WHERE k = id) s FROM '{t}' "
+              f"WHERE id IS NOT NULL ORDER BY id")
+    assert out.column("s").to_pylist() == [None, 200.0, 300.0, None]
+
+
+def test_alias_never_shadows_real_column(t, other):
+    # `SELECT v*100 AS s, s+1 ...` has no real column s -> lateral
+    # alias applies; but a real column named like an alias always wins
+    p2 = other  # columns k, w
+    out = sql(f"SELECT k*100 AS w, w+1 AS x FROM '{p2}' ORDER BY k")
+    # x must use the REAL column w (200,300,900), not the alias k*100
+    assert out.column("x").to_pylist() == [201.0, 301.0, 901.0]
+    out = sql(f"SELECT k*100 AS big, big+1 AS x FROM '{p2}' "
+              f"ORDER BY k")
+    # no real column named big -> lateral alias applies
+    assert out.column("x").to_pylist() == [201, 301, 901]
+
+
+def test_window_rank_mixed_direction_nulls(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "a": pa.array([1, 1, 1], pa.int64()),
+        "b": pa.array([5, None, 7], pa.int64()),
+    }))
+    out = sql(f"SELECT b, rank() OVER (ORDER BY a ASC, b DESC) r "
+              f"FROM '{tmp_table_path}' ORDER BY r")
+    # DESC nulls LAST: 7 -> 1, 5 -> 2, NULL -> 3
+    assert out.column("b").to_pylist() == [7, 5, None]

@@ -52,7 +52,65 @@ def _rewrite(q: str) -> str:
     # int/int ratios truncate; REAL matches the engine's float64
     q = re.sub(r"cast\s*\(\s*([^()]+?)\s+as\s+decimal\s*\([^)]*\)\s*\)",
                r"CAST(\1 AS REAL)", q, flags=re.IGNORECASE)
+    q = _expand_rollup(q)
     return q
+
+
+def _expand_rollup(q: str) -> str:
+    """sqlite has no GROUP BY ROLLUP; expand mechanically into a UNION
+    ALL of per-level aggregations. For each prefix level, rolled-up key
+    references in the owning SELECT's select list become NULL and
+    `grouping(k)` becomes the 0/1 constant. The WHERE clause (which
+    runs BEFORE grouping) is never touched — only the select-list
+    segment and the group-by clause are rewritten."""
+    m = re.search(r"group\s+by\s+rollup\s*\(([^)]*)\)", q,
+                  re.IGNORECASE)
+    if not m:
+        return q
+    keys = [k.strip() for k in m.group(1).split(",")]
+
+    def depth0_positions(text, word):
+        out = []
+        depth = 0
+        for mo in re.finditer(r"[()]|\b" + word + r"\b", text,
+                              re.IGNORECASE):
+            tok = mo.group(0)
+            if tok == "(":
+                depth += 1
+            elif tok == ")":
+                depth -= 1
+            elif depth == 0:
+                out.append(mo.start())
+        return out
+
+    head = q[:m.start()]
+    sel_positions = depth0_positions(head, "select")
+    sel_start = sel_positions[-1]
+    from_positions = [p for p in depth0_positions(head, "from")
+                      if p > sel_start]
+    from_start = from_positions[0]
+    select_list = q[sel_start:from_start]
+
+    prefix = q[:sel_start]  # WITH clause, hoisted once
+    branches = []
+    for level in range(len(keys), -1, -1):
+        sl = select_list
+        for j, k in enumerate(keys):
+            sl = re.sub(r"grouping\s*\(\s*" + re.escape(k) + r"\s*\)",
+                        "1" if j >= level else "0", sl,
+                        flags=re.IGNORECASE)
+            if j >= level:
+                sl = re.sub(r"\b" + re.escape(k) + r"\b", "NULL", sl,
+                            flags=re.IGNORECASE)
+        gb = ("GROUP BY " + ", ".join(keys[:level])) if level else ""
+        branches.append(sl + q[from_start:m.start()] + gb + " ")
+    # drop the trailing ORDER BY outright: result comparison is
+    # order-insensitive, and sqlite restricts post-UNION ORDER BY terms
+    # to output columns (q36's `case when lochierarchy = 0 ...` isn't)
+    tail = q[m.end():]
+    tail = re.sub(r"\border\s+by\b.*$", "", tail,
+                  flags=re.IGNORECASE | re.DOTALL)
+    return prefix + " UNION ALL ".join(branches) + " " + tail
 
 
 class SqliteOracle:
