@@ -203,14 +203,20 @@ def wl_blockwise(n, device):
 
     m = pad_bucket(min(DEFAULT_BLOCK_ROWS, n))
     n_blocks = -(-n // m)
-    key32 = ((pk.astype(np.uint32) << np.uint32(2)) | dk)[:m]
+    # densify exactly like the real blockwise path: the kernel's seen
+    # bitset is sized to the unique-key space, so raw sparse keys would
+    # clamp out of range and measure a degenerate access pattern
+    wide = (pk.astype(np.uint64) << np.uint64(2)) | dk
+    _, dense = np.unique(wide, return_inverse=True)
+    key32 = dense.astype(np.uint32)[:m]
     blk = np.full(m, _PAD_KEY, np.uint32)
     blk[:len(key32)] = key32
-    words = m // 32
+    n_words = -(-(int(key32.max()) + 1) // 32)
     step = jax.jit(lambda seen, keys: _block_kernel_impl(
         seen, keys, jnp.int32(m), m))
-    seen0 = jax.device_put(jnp.zeros((pad_bucket(words),), jnp.uint32),
-                           device)
+    seen0 = jax.device_put(
+        jnp.zeros((pad_bucket(max(n_words, 1024)),), jnp.uint32),
+        device)
     dblk = jax.device_put(blk, device)
     step(seen0, dblk)[0].block_until_ready()
     t_block = _best(

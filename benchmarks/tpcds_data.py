@@ -64,8 +64,8 @@ TABLE_NAMES = [
     "date_dim", "time_dim", "item", "customer", "customer_address",
     "customer_demographics", "household_demographics", "promotion",
     "store", "warehouse", "ship_mode", "web_site", "web_page",
-    "catalog_page", "call_center", "store_sales", "store_returns",
-    "catalog_sales",
+    "catalog_page", "call_center", "reason", "income_band",
+    "store_sales", "store_returns", "catalog_sales",
     "catalog_returns", "web_sales", "web_returns", "inventory",
 ]
 
@@ -124,6 +124,7 @@ def _time_dim() -> pa.Table:
                                       "dinner", None)))
     return pa.table({
         "t_time_sk": pa.array(mins * 60),  # sk = second of day
+        "t_time": pa.array(mins * 60),
         "t_hour": pa.array(hours),
         "t_minute": pa.array(mins % 60),
         "t_meal_time": pa.array(meal.tolist()),
@@ -162,6 +163,18 @@ def _item(rng, n_items) -> pa.Table:
             np.round(rng.uniform(1.0, 120.0, n_items), 2)),
         "i_wholesale_cost": pa.array(
             np.round(rng.uniform(1.0, 80.0, n_items), 2)),
+        "i_product_name": pa.array(
+            [f"product {j % 211}ought" for j in sk]),
+        "i_color": pa.array(
+            [["slate", "blanched", "burnished", "peach", "metallic",
+              "dim", "red", "navy"][c]
+             for c in rng.integers(0, 8, n_items)]),
+        "i_size": pa.array(
+            [["small", "medium", "large", "petite", "extra large",
+              "economy", "N/A"][c] for c in rng.integers(0, 7, n_items)]),
+        "i_units": pa.array(
+            [["Each", "Dozen", "Case", "Pallet", "Oz", "Lb"][c]
+             for c in rng.integers(0, 6, n_items)]),
     })
 
 
@@ -200,6 +213,12 @@ def _customer(rng, n_cust, n_addr) -> pa.Table:
         "c_email_address": pa.array(
             [f"user{j}@example.com" for j in sk]),
         "c_last_review_date": pa.array(
+            (_DATE_SK0 + rng.integers(0, _N_DAYS, n_cust)).astype(
+                np.int64)),
+        "c_first_sales_date_sk": pa.array(
+            (_DATE_SK0 + rng.integers(0, _N_DAYS, n_cust)).astype(
+                np.int64)),
+        "c_first_shipto_date_sk": pa.array(
             (_DATE_SK0 + rng.integers(0, _N_DAYS, n_cust)).astype(
                 np.int64)),
     })
@@ -252,6 +271,15 @@ def _customer_demographics() -> pa.Table:
         "cd_education_status": pa.array([r[2] for r in rows]),
         "cd_dep_count": pa.array(
             np.arange(len(rows), dtype=np.int64) % 7),
+        "cd_dep_employed_count": pa.array(
+            np.arange(len(rows), dtype=np.int64) % 5),
+        "cd_dep_college_count": pa.array(
+            np.arange(len(rows), dtype=np.int64) % 4),
+        "cd_purchase_estimate": pa.array(
+            (np.arange(len(rows), dtype=np.int64) % 12) * 500 + 500),
+        "cd_credit_rating": pa.array(
+            [["Low Risk", "High Risk", "Good", "Unknown"][j % 4]
+             for j in range(len(rows))]),
     })
 
 
@@ -267,6 +295,8 @@ def _household_demographics() -> pa.Table:
         "hd_vehicle_count": pa.array(np.array([r[1] for r in rows],
                                               np.int64)),
         "hd_buy_potential": pa.array([r[2] for r in rows]),
+        "hd_income_band_sk": pa.array(
+            np.arange(len(rows), dtype=np.int64) % 20 + 1),
     })
 
 
@@ -305,6 +335,8 @@ def _store(rng) -> pa.Table:
             [_STATES[j % len(_STATES)] for j in range(n)]),
         "s_number_employees": pa.array(
             rng.integers(200, 301, n).astype(np.int64)),
+        "s_market_id": pa.array(
+            rng.integers(1, 11, n).astype(np.int64)),
         "s_company_id": pa.array(np.ones(n, np.int64)),
         "s_company_name": pa.array(["Unknown"] * n),
         "s_street_number": pa.array(
@@ -326,6 +358,15 @@ def _warehouse(rng) -> pa.Table:
         "w_warehouse_sk": pa.array(np.arange(1, n + 1)),
         "w_warehouse_name": pa.array(
             [f"Warehouse number {j} of the chain" for j in range(n)]),
+        "w_warehouse_sq_ft": pa.array(
+            rng.integers(50_000, 1_000_000, n).astype(np.int64)),
+        "w_city": pa.array(
+            [_CITIES[i] for i in rng.integers(0, len(_CITIES), n)]),
+        "w_county": pa.array(
+            [_COUNTIES[i] for i in rng.integers(0, len(_COUNTIES), n)]),
+        "w_state": pa.array(
+            [_STATES[i] for i in rng.integers(0, len(_STATES), n)]),
+        "w_country": pa.array(["United States"] * n),
     })
 
 
@@ -335,6 +376,9 @@ def _ship_mode() -> pa.Table:
         "sm_ship_mode_sk": pa.array(np.arange(1, n + 1)),
         "sm_type": pa.array([_SM_TYPES[j % len(_SM_TYPES)]
                              for j in range(n)]),
+        "sm_carrier": pa.array(
+            [["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL"][j % 5]
+             for j in range(n)]),
     })
 
 
@@ -357,6 +401,30 @@ def _web_page(rng) -> pa.Table:
     })
 
 
+def _reason() -> pa.Table:
+    n = 9
+    sk = np.arange(1, n + 1)
+    descs = ["Package was damaged", "Stopped working", "Did not get it",
+             "Not the product that was ordred", "Parts missing",
+             "Does not work with a product that I have",
+             "Gift exchange", "Did not like the color",
+             "Did not like the model"]
+    return pa.table({
+        "r_reason_sk": pa.array(sk),
+        "r_reason_desc": pa.array(descs),
+    })
+
+
+def _income_band() -> pa.Table:
+    n = 20
+    sk = np.arange(1, n + 1)
+    return pa.table({
+        "ib_income_band_sk": pa.array(sk),
+        "ib_lower_bound": pa.array((sk - 1) * 10000),
+        "ib_upper_bound": pa.array(sk * 10000),
+    })
+
+
 def _catalog_page() -> pa.Table:
     n = 20
     sk = np.arange(1, n + 1)
@@ -371,8 +439,12 @@ def _call_center() -> pa.Table:
     n = 4
     return pa.table({
         "cc_call_center_sk": pa.array(np.arange(1, n + 1)),
+        "cc_call_center_id": pa.array(
+            [f"CC{j:014d}" for j in range(n)]),
         "cc_name": pa.array([f"call center {j}" for j in range(n)]),
         "cc_manager": pa.array([f"Manager {j}" for j in range(n)]),
+        "cc_county": pa.array(
+            [_COUNTIES[j % len(_COUNTIES)] for j in range(n)]),
     })
 
 
@@ -400,6 +472,8 @@ def generate(scale: int = 50_000, seed: int = 7):
         "web_page": _web_page(rng),
         "catalog_page": _catalog_page(),
         "call_center": _call_center(),
+        "reason": _reason(),
+        "income_band": _income_band(),
     }
 
     n_cd = tables["customer_demographics"].num_rows
@@ -461,6 +535,7 @@ def generate(scale: int = 50_000, seed: int = 7):
         "ss_ext_tax": _money(rng, n, 0, 150),
         "ss_coupon_amt": _money(rng, n, 0, 50),
         "ss_net_paid": _money(rng, n, 1, 2000),
+        "ss_net_paid_inc_tax": _money(rng, n, 1, 2100),
         "ss_net_profit": pa.array(
             np.round(rng.uniform(-5000.0, 5000.0, n), 2)),
         "ss_wholesale_cost": _money(rng, n, 1, 100),
@@ -485,6 +560,8 @@ def generate(scale: int = 50_000, seed: int = 7):
             t_store[ticket_of_row[ret_idx]].astype(np.int64)),
         "sr_ticket_number": pa.array(
             (ticket_of_row[ret_idx] + 1).astype(np.int64)),
+        "sr_reason_sk": pa.array(
+            rng.integers(1, 10, nr).astype(np.int64)),
         "sr_return_quantity": pa.array(
             rng.integers(1, 50, nr).astype(np.int64)),
         "sr_return_amt": _money(rng, nr, 1, 500),
@@ -545,6 +622,12 @@ def generate(scale: int = 50_000, seed: int = 7):
         "cs_coupon_amt": _money(rng, nc, 0, 50),
         "cs_ext_discount_amt": _money(rng, nc, 0, 100),
         "cs_ext_ship_cost": _money(rng, nc, 0, 100),
+        "cs_ext_list_price": _money(rng, nc, 1, 2500),
+        "cs_ext_wholesale_cost": _money(rng, nc, 1, 1500),
+        "cs_net_paid": _money(rng, nc, 1, 2000),
+        "cs_net_paid_inc_ship": _money(rng, nc, 1, 2100),
+        "cs_net_paid_inc_ship_tax": _money(rng, nc, 1, 2200),
+        "cs_wholesale_cost": _money(rng, nc, 1, 100),
         "cs_net_profit": pa.array(
             np.round(rng.uniform(-4000.0, 4000.0, nc), 2)),
     })
@@ -621,7 +704,11 @@ def generate(scale: int = 50_000, seed: int = 7):
         "ws_ext_sales_price": _money(rng, nw, 1, 2000),
         "ws_ext_ship_cost": _money(rng, nw, 0, 100),
         "ws_ext_discount_amt": _money(rng, nw, 0, 100),
+        "ws_ext_list_price": _money(rng, nw, 1, 2500),
+        "ws_ext_wholesale_cost": _money(rng, nw, 1, 1500),
+        "ws_wholesale_cost": _money(rng, nw, 1, 100),
         "ws_net_paid": _money(rng, nw, 1, 2000),
+        "ws_net_paid_inc_tax": _money(rng, nw, 1, 2100),
         "ws_net_profit": pa.array(
             np.round(rng.uniform(-4000.0, 4000.0, nw), 2)),
     })
@@ -672,7 +759,8 @@ def generate(scale: int = 50_000, seed: int = 7):
         "inv_warehouse_sk": pa.array(
             rng.integers(1, n_wh + 1, ninv).astype(np.int64)),
         "inv_quantity_on_hand": pa.array(
-            rng.integers(0, 1000, ninv).astype(np.int64)),
+            np.clip(rng.lognormal(5.0, 1.4, ninv), 0, 8000).astype(
+                np.int64)),
     })
 
     return tables

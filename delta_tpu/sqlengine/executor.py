@@ -76,7 +76,10 @@ def _run_query(q: Query, engine, catalog, ctes) -> Tuple[pd.DataFrame,
     frames = []
     out_names: List[str] = []
     for i, sel in enumerate(q.selects):
-        df, names = _Exec(engine, catalog, ctes).run(sel)
+        if isinstance(sel, Query):  # parenthesized nested set-op
+            df, names = _run_query(sel, engine, catalog, dict(ctes))
+        else:
+            df, names = _Exec(engine, catalog, ctes).run(sel)
         if i == 0:
             out_names = names
         elif len(names) != len(out_names):
@@ -88,9 +91,23 @@ def _run_query(q: Query, engine, catalog, ctes) -> Tuple[pd.DataFrame,
         frames.append(df)
     result = frames[0]
     for op, f in zip(q.union_ops, frames[1:]):
-        result = pd.concat([result, f], ignore_index=True)
-        if op == "distinct":
-            result = result.drop_duplicates(ignore_index=True)
+        if op in ("all", "distinct"):
+            result = pd.concat([result, f], ignore_index=True)
+            if op == "distinct":
+                result = result.drop_duplicates(ignore_index=True)
+            continue
+        # set semantics (SQL INTERSECT/EXCEPT are distinct, and NULLs
+        # compare EQUAL for set operations — pandas merge's NaN
+        # matching is the right behavior here, unlike joins)
+        a = result.drop_duplicates(ignore_index=True)
+        b = f.drop_duplicates(ignore_index=True)
+        cols = list(a.columns)
+        if op == "intersect":
+            result = a.merge(b, how="inner", on=cols)
+        else:  # except
+            marked = a.merge(b, how="left", on=cols, indicator=True)
+            result = marked[marked["_merge"] == "left_only"] \
+                .drop(columns="_merge").reset_index(drop=True)
     if q.order_by:
         for i in range(len(q.order_by) - 1, -1, -1):
             e, asc = q.order_by[i]
@@ -454,9 +471,19 @@ class _Exec:
                 null_supplying.update(
                     s["alias"] for s in sources[:len(sel.froms) + k])
         pushed: Dict[str, list] = {s["alias"]: [] for s in sources}
+        frame_pushed: Dict[str, list] = {s["alias"]: [] for s in sources}
+        frame_aliases = {s["alias"] for s in sources
+                         if s["frame"] is not None}
         for conj in conjuncts:
             target = self._sole_alias(conj, resolve)
             if target and target not in null_supplying:
+                if target in frame_aliases:
+                    # derived-table selection pushdown: filter the
+                    # CTE/subquery frame BEFORE joining (q4's 6-way
+                    # year_total self-join otherwise multiplies 6x per
+                    # merge before the year/type filters ever apply)
+                    frame_pushed[target].append(conj)
+                    continue
                 tree = self._to_tree(conj, resolve, target)
                 if tree is not None:
                     pushed[target].append(tree)
@@ -466,6 +493,8 @@ class _Exec:
             if s["frame"] is not None:
                 df = s["frame"]
                 df.columns = [f"{s['alias']}.{c}" for c in df.columns]
+                for conj in frame_pushed[s["alias"]]:
+                    df = df[self._truth(self._eval(conj, df))]
                 s["frame"] = df
                 continue
             filt = None
@@ -475,7 +504,16 @@ class _Exec:
             # in table order
             cols = [c for c in s["cols"] if c in needed[s["alias"]]] \
                 or s["cols"][:1]
-            arrow = s["snap"].scan(filter=filt, columns=cols).to_arrow()
+            try:
+                arrow = s["snap"].scan(filter=filt,
+                                       columns=cols).to_arrow()
+            except pa.lib.ArrowNotImplementedError:
+                # type-mismatched pushdown (e.g. date32 column vs the
+                # query's string literal): drop the scan filter — the
+                # residual WHERE still applies the predicate with the
+                # executor's coercions
+                arrow = s["snap"].scan(filter=None,
+                                       columns=cols).to_arrow()
             df = arrow.to_pandas()
             df = _normalize_frame(df)
             df.columns = [f"{s['alias']}.{c}" for c in df.columns]
@@ -673,6 +711,8 @@ class _Exec:
         if sel.having is not None:
             mask = self._truth(self._eval_out(
                 self._sub_aliases(sel.having, alias_map), df, env, resolve))
+            if isinstance(mask, bool):  # constant predicate
+                mask = pd.Series(mask, index=df.index)
             df = df[mask]
             out_cols = [c[mask] for c in out_cols]
 
@@ -697,6 +737,8 @@ class _Exec:
                         s = result[f"__c{out_names.index(e.parts[0])}"]
                 if s is None:
                     ref = self._eval_out(e, df, env, resolve)
+                    if not isinstance(ref, pd.Series):  # constant
+                        ref = pd.Series([ref] * len(df), index=df.index)
                     s = ref.reset_index(drop=True)
                 sort_series.append((s, asc))
             tmp = result.copy()
@@ -884,7 +926,9 @@ class _Exec:
                     f"column {e.text!r} in SELECT/HAVING/ORDER BY must "
                     "appear in GROUP BY or inside an aggregate")
             if isinstance(e, Lit):
-                return pd.Series([e.value] * len(df), index=df.index)
+                # raw scalar: every consumer broadcasts, and scalar
+                # function args (substr's start/length) must stay ints
+                return e.value
             if isinstance(e, BinOp):
                 l = self._eval_out(e.left, df, env, resolve)
                 r = self._eval_out(e.right, df, env, resolve)
@@ -922,6 +966,45 @@ class _Exec:
                                          df.index)
             if isinstance(e, Neg):
                 return -self._eval_out(e.item, df, env, resolve)
+            if isinstance(e, Cast):
+                return _cast(self._eval_out(e.item, df, env, resolve),
+                             e.type_name)
+            if isinstance(e, IsNull):
+                s = self._eval_out(e.item, df, env, resolve)
+                if isinstance(s, pd.Series):
+                    isna = s.isna()
+                    return ~isna if e.negated else isna
+                isna = bool(pd.isna(s))
+                return (not isna) if e.negated else isna
+            if isinstance(e, Between):
+                v = self._eval_out(e.item, df, env, resolve)
+                lo = self._eval_out(e.lo, df, env, resolve)
+                hi = self._eval_out(e.hi, df, env, resolve)
+                m = _as_kleene(_cmp(">=", v, lo), df.index) \
+                    & _as_kleene(_cmp("<=", v, hi), df.index)
+                return ~m if e.negated else m
+            if isinstance(e, InList):
+                v = self._eval_out(e.item, df, env, resolve)
+                vals = [self._eval_out(x, df, env, resolve)
+                        for x in e.values]
+                has_null = any(not isinstance(x, pd.Series)
+                               and pd.isna(x) for x in vals)
+                vals = [x for x in vals
+                        if isinstance(x, pd.Series) or not pd.isna(x)]
+                m = _in_membership(v, vals, has_null, df.index)
+                return ~m if e.negated else m
+            if isinstance(e, ScalarSelect):
+                if self._correlation(e.select):
+                    raise UnsupportedSqlError(
+                        "correlated scalar subquery over an aggregated "
+                        "result is not supported")
+                out = execute_select(e.select, self.engine,
+                                     self.catalog, ctes=self.ctes)
+                if out.num_columns != 1 or out.num_rows > 1:
+                    raise SubqueryShapeError(
+                        "scalar subquery must return one value")
+                return (None if out.num_rows == 0
+                        else out.column(0)[0].as_py())
             if isinstance(e, Window):
                 return self._window_eval(
                     e, df, lambda x: self._eval_out(x, df, env, resolve))
@@ -1328,13 +1411,7 @@ class _Exec:
                     else s
                 ocols.append(f"__o{i}")
                 ascs.append(asc)
-            # Spark sort-order semantics per key: NULLS FIRST when
-            # ascending, LAST when descending (reverse stable passes)
-            order = work
-            for i in range(len(ocols) - 1, -1, -1):
-                order = order.sort_values(
-                    ocols[i], ascending=ascs[i], kind="mergesort",
-                    na_position="first" if ascs[i] else "last")
+            order = _sql_sort(work, ocols, ascs)
             if pcols:
                 pos = order.groupby(pcols, dropna=False,
                                     sort=False).cumcount() + 1
@@ -1379,11 +1456,7 @@ class _Exec:
             ocols.append(f"__o{i}")
             ascs.append(asc)
         work["__v"] = s.values
-        order = work
-        for i in range(len(ocols) - 1, -1, -1):
-            order = order.sort_values(
-                ocols[i], ascending=ascs[i], kind="mergesort",
-                na_position="first" if ascs[i] else "last")
+        order = _sql_sort(work, ocols, ascs)
         expand = {"sum": lambda x: x.expanding().sum(),
                   "mean": lambda x: x.expanding().mean(),
                   "min": lambda x: x.expanding().min(),
@@ -1430,9 +1503,18 @@ class _Exec:
             return args[0].abs() if isinstance(args[0], pd.Series) \
                 else abs(args[0])
         if name == "round":
+            # Spark/SQL ROUND is HALF_UP; pandas/python round is
+            # half-even (2.125 → 2.12 there, 2.13 in SQL)
             nd = int(args[1]) if len(args) > 1 else 0
-            return args[0].round(nd) if isinstance(args[0], pd.Series) \
-                else round(args[0], nd)
+            scale = 10 ** nd
+            v = args[0]
+            if isinstance(v, pd.Series):
+                return np.sign(v) * np.floor(np.abs(v) * scale + 0.5) \
+                    / scale
+            if pd.isna(v):
+                return None
+            return float(np.sign(v) * np.floor(abs(v) * scale + 0.5)
+                         / scale)
         if name == "coalesce":
             out = args[0]
             for nxt in args[1:]:
@@ -1550,6 +1632,17 @@ class _Exec:
         return conv(conj)
 
 
+def _sql_sort(frame: pd.DataFrame, cols, ascs) -> pd.DataFrame:
+    """Multi-key stable sort with Spark null ordering per key: NULLS
+    FIRST when ascending, LAST when descending (reverse stable passes,
+    since pandas only takes one na_position per call)."""
+    for i in range(len(cols) - 1, -1, -1):
+        frame = frame.sort_values(
+            cols[i], ascending=ascs[i], kind="mergesort",
+            na_position="first" if ascs[i] else "last")
+    return frame
+
+
 def _case_from_values(conds, vals, default, n, index):
     """np.select over pre-evaluated CASE WHEN branches."""
     vals = [v.values if isinstance(v, pd.Series)
@@ -1623,6 +1716,14 @@ def _with_nulls(res, *operands):
 
 
 def _binop(op, l, r):
+    # NULL arithmetic: a scalar NULL operand (e.g. an empty scalar
+    # subquery) nulls the whole expression
+    for o in (l, r):
+        if not isinstance(o, pd.Series) and o is not None \
+                and not isinstance(o, str) and pd.isna(o):
+            return None
+    if l is None or r is None:
+        return None
     if op == "+":
         return l + r
     if op == "-":

@@ -235,6 +235,7 @@ KEYWORDS = {
     "CROSS", "ON", "AS", "AND", "OR", "NOT", "IN", "EXISTS", "BETWEEN",
     "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END", "CAST",
     "INTERVAL", "ASC", "DESC", "VERSION", "TIMESTAMP", "OF", "UNION",
+    "INTERSECT", "EXCEPT",
     "TRUE", "FALSE", "OVER", "PARTITION", "WITH", "ALL", "ROWS",
     "RANGE", "UNBOUNDED", "PRECEDING", "CURRENT", "ROW", "FOLLOWING",
 }
@@ -279,7 +280,8 @@ def tokenize(s: str) -> List[Token]:
 # identifiers that terminate an alias-less table/column position
 _STOP_ALIAS = {
     "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "ON", "JOIN",
-    "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "UNION", "AND",
+    "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "UNION",
+    "INTERSECT", "EXCEPT", "AND",
     "OR", "NOT", "VERSION", "TIMESTAMP", "SELECT", "WHEN", "THEN",
     "ELSE", "END", "ASC", "DESC", "BY", "AS", "IN", "IS", "BETWEEN",
     "LIKE", "EXISTS", "CASE",
@@ -731,9 +733,17 @@ def _children(node):
 
 def _parse_case(self: _P) -> object:
     self.expect_kw("CASE")
+    # simple form `CASE expr WHEN v THEN r ...` desugars to the
+    # searched form `CASE WHEN expr = v THEN r ...` (q39's
+    # `case mean when 0 then null else ... end`)
+    operand = None
+    if not self.peek().is_kw("WHEN"):
+        operand = self._expr()
     whens = []
     while self.accept_kw("WHEN"):
         cond = self._expr()
+        if operand is not None:
+            cond = Cmp("=", operand, cond)
         self.expect_kw("THEN")
         val = self._expr()
         whens.append((cond, val))
@@ -800,21 +810,58 @@ def _parse_query(self: _P) -> Query:
             self.expect_op(")")
             if not self.accept_op(","):
                 break
-    q.selects.append(self.parse_select())
-    while self.peek().is_kw("UNION"):
-        self.next()
-        q.union_ops.append("all" if self.accept_kw("ALL")
-                           else "distinct")
-        q.selects.append(self.parse_select())
+    q.selects.append(self._set_operand())
+    while self.peek().is_kw("UNION", "INTERSECT", "EXCEPT"):
+        kw = self.next().value.upper()
+        if kw == "UNION":
+            q.union_ops.append("all" if self.accept_kw("ALL")
+                               else "distinct")
+        else:
+            q.union_ops.append(kw.lower())
+        q.selects.append(self._set_operand())
     if len(q.selects) > 1:
-        # a trailing ORDER BY/LIMIT binds to the union result, not the
-        # final branch (standard SQL); the branch parser grabbed it
+        # a trailing ORDER BY/LIMIT binds to the set-op result, not
+        # the final branch — but ONLY when the final operand is a bare
+        # SELECT; a parenthesized operand keeps its own clauses
         last = q.selects[-1]
-        q.order_by, last.order_by = last.order_by, []
-        q.limit, last.limit = last.limit, None
+        if isinstance(last, Select):
+            q.order_by, last.order_by = last.order_by, []
+            q.limit, last.limit = last.limit, None
+        # INTERSECT binds tighter than UNION/EXCEPT (standard SQL):
+        # fold intersect pairs into nested sub-queries left-to-right
+        sels, ops = [q.selects[0]], []
+        for op, sel in zip(q.union_ops, q.selects[1:]):
+            if op == "intersect":
+                prev = sels.pop()
+                sels.append(Query(selects=[prev, sel],
+                                  union_ops=["intersect"]))
+            else:
+                ops.append(op)
+                sels.append(sel)
+        q.selects, q.union_ops = sels, ops
     return q
 
 
+def _parse_set_operand(self: _P):
+    """One operand of a set-op chain: a SELECT, or a parenthesized
+    query (q87's `(select ...) except (select ...)`)."""
+    t = self.peek()
+    if t.kind == "op" and t.value == "(":
+        self.next()
+        sub = self._query()
+        self.expect_op(")")
+        if not sub.ctes and len(sub.selects) == 1 \
+                and not sub.order_by and sub.limit is None:
+            inner = sub.selects[0]
+            if not inner.order_by and inner.limit is None:
+                return inner
+            # keep the Query wrapper: a parenthesized branch's own
+            # ORDER BY/LIMIT must not be hoisted to the set-op result
+        return sub
+    return self.parse_select()
+
+
+_P._set_operand = _parse_set_operand
 _P._query = _parse_query
 
 
