@@ -4764,3 +4764,86 @@ from ssci full outer join csci on (ssci.customer_sk=csci.customer_sk
                                and ssci.item_sk = csci.item_sk)
 limit 100
 """
+
+# --- added in round 4 (fifth wave): OR-factored + residual correlation (verbatim) ---
+
+QUERIES["q41"] = r"""
+select  distinct(i_product_name)
+ from item i1
+ where i_manufact_id between 732 and 732+40
+   and (select count(*) as item_cnt
+        from item
+        where (i_manufact = i1.i_manufact and
+        ((i_category = 'Women' and
+        (i_color = 'beige' or i_color = 'spring') and
+        (i_units = 'Tsp' or i_units = 'Ton') and
+        (i_size = 'petite' or i_size = 'extra large')
+        ) or
+        (i_category = 'Women' and
+        (i_color = 'white' or i_color = 'pale') and
+        (i_units = 'Box' or i_units = 'Dram') and
+        (i_size = 'large' or i_size = 'economy')
+        ) or
+        (i_category = 'Men' and
+        (i_color = 'midnight' or i_color = 'frosted') and
+        (i_units = 'Bunch' or i_units = 'Carton') and
+        (i_size = 'small' or i_size = 'N/A')
+        ) or
+        (i_category = 'Men' and
+        (i_color = 'azure' or i_color = 'goldenrod') and
+        (i_units = 'Pallet' or i_units = 'Gross') and
+        (i_size = 'petite' or i_size = 'extra large')
+        ))) or
+       (i_manufact = i1.i_manufact and
+        ((i_category = 'Women' and
+        (i_color = 'brown' or i_color = 'hot') and
+        (i_units = 'Tbl' or i_units = 'Cup') and
+        (i_size = 'petite' or i_size = 'extra large')
+        ) or
+        (i_category = 'Women' and
+        (i_color = 'powder' or i_color = 'honeydew') and
+        (i_units = 'Bundle' or i_units = 'Unknown') and
+        (i_size = 'large' or i_size = 'economy')
+        ) or
+        (i_category = 'Men' and
+        (i_color = 'antique' or i_color = 'purple') and
+        (i_units = 'N/A' or i_units = 'Dozen') and
+        (i_size = 'small' or i_size = 'N/A')
+        ) or
+        (i_category = 'Men' and
+        (i_color = 'lavender' or i_color = 'tomato') and
+        (i_units = 'Lb' or i_units = 'Oz') and
+        (i_size = 'petite' or i_size = 'extra large')
+        )))) > 0
+ order by i_product_name
+ limit 100
+"""
+
+QUERIES["q94"] = r"""
+select
+   count(distinct ws_order_number) as `order count`
+  ,sum(ws_ext_ship_cost) as `total shipping cost`
+  ,sum(ws_net_profit) as `total net profit`
+from
+   web_sales ws1
+  ,date_dim
+  ,customer_address
+  ,web_site
+where
+    d_date between '2001-5-01' and
+           (cast('2001-5-01' as date) + INTERVAL 60 days)
+and ws1.ws_ship_date_sk = d_date_sk
+and ws1.ws_ship_addr_sk = ca_address_sk
+and ca_state = 'TX'
+and ws1.ws_web_site_sk = web_site_sk
+and web_company_name = 'pri'
+and exists (select *
+            from web_sales ws2
+            where ws1.ws_order_number = ws2.ws_order_number
+              and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+and not exists(select *
+               from web_returns wr1
+               where ws1.ws_order_number = wr1.wr_order_number)
+order by count(distinct ws_order_number)
+limit 100
+"""

@@ -122,10 +122,7 @@ def _run_query(q: Query, engine, catalog, ctes) -> Tuple[pd.DataFrame,
                 raise UnsupportedSqlError(
                     "ORDER BY after UNION ALL must reference output "
                     f"column names or ordinals; got {type(e).__name__}")
-            col = f"__c{pos}"
-            result = result.sort_values(
-                col, ascending=asc, kind="mergesort",
-                na_position="first" if asc else "last")
+            result = _sql_sort(result, [f"__c{pos}"], [asc])
     if q.limit is not None:
         result = result.head(q.limit)
     return result.reset_index(drop=True), out_names
@@ -744,11 +741,9 @@ class _Exec:
             tmp = result.copy()
             for i, (s, asc) in enumerate(sort_series):
                 tmp[f"__s{i}"] = s.values
-            for i in range(len(sort_series) - 1, -1, -1):
-                asc = sort_series[i][1]
-                tmp = tmp.sort_values(
-                    f"__s{i}", ascending=asc, kind="mergesort",
-                    na_position="first" if asc else "last")
+            tmp = _sql_sort(
+                tmp, [f"__s{i}" for i in range(len(sort_series))],
+                [asc for _s, asc in sort_series])
             result = tmp.drop(columns=[f"__s{i}"
                                        for i in range(len(sort_series))])
 
@@ -1214,67 +1209,108 @@ class _Exec:
             except DeltaError:
                 return False
 
-        corr = []
-        leftover_outer = []
-        for conj in _split_and(sub.where):
+        def outer_eq(conj):
             if (isinstance(conj, Cmp) and conj.op == "="
                     and isinstance(conj.left, Col)
                     and isinstance(conj.right, Col)):
                 lo, ro = is_outer(conj.left), is_outer(conj.right)
                 if lo != ro:
-                    o, i = ((conj.left, conj.right) if lo
+                    return ((conj.left, conj.right) if lo
                             else (conj.right, conj.left))
-                    corr.append((o, i, conj))
+            return None
+
+        corr = []       # [(outer Col, inner Col)]
+        residual = []   # outer-referencing, non-equality (q94's <>)
+        where_rest = []  # inner-only conjuncts (possibly rewritten)
+        for conj in _split_and(sub.where):
+            eq = outer_eq(conj)
+            if eq:
+                corr.append(eq)
+                continue
+            # q41's shape: OR whose EVERY branch repeats the same
+            # outer-equality conjunct — factor it out and rebuild the
+            # OR without it (frozen-dataclass equality makes the
+            # identical-conjunct check exact)
+            if isinstance(conj, Or):
+                branch_splits = [_split_and(b) for b in conj.items]
+                common = next(
+                    (cand for cand in branch_splits[0]
+                     if outer_eq(cand)
+                     and all(cand in bs for bs in branch_splits)),
+                    None)
+                if common is not None:
+                    corr.append(outer_eq(common))
+                    branches = []
+                    trivially_true = False
+                    for bs in branch_splits:
+                        rest = tuple(c for c in bs if c != common)
+                        if not rest:
+                            # a branch that was ONLY the equality: the
+                            # whole OR holds wherever the correlation
+                            # key matches — nothing left to filter
+                            trivially_true = True
+                            break
+                        branches.append(rest[0] if len(rest) == 1
+                                        else And(rest))
+                    if not trivially_true:
+                        where_rest.append(Or(tuple(branches)))
                     continue
+            has_outer = []
 
             def chk(x):
                 if is_outer(x):
-                    leftover_outer.append(x)
+                    has_outer.append(x)
             _walk_exprs(conj, chk)
-        if leftover_outer:
-            raise UnsupportedSqlError(
-                "correlated subquery uses outer columns outside "
-                f"equality conjuncts ({leftover_outer[0].text}); only "
-                "equality correlation is supported")
-        return corr
+            (residual if has_outer else where_rest).append(conj)
+        if not corr:
+            if residual:
+                raise UnsupportedSqlError(
+                    "correlated subquery has outer references but no "
+                    "equality correlation to decorrelate on")
+            return None
+        return _CorrInfo(corr, where_rest, residual, is_outer)
 
-    def _decorrelated_frame(self, sub: Select, corr, extra_items,
+    def _decorrelated_frame(self, sub: Select, info, extra_items,
                             aggregate: bool):
-        """Run `sub` with the correlation conjuncts removed and the
-        inner correlation columns added as group keys (aggregate=True)
-        or distinct output columns. Returns (df, corr_key_names)."""
+        """Run `sub` with the correlation conjuncts removed (using the
+        rewritten inner-only WHERE) and the inner correlation columns
+        added as group keys (aggregate=True) or distinct output
+        columns. Returns (df, corr_key_names)."""
         if sub.group_by or sub.having:
             raise UnsupportedSqlError(
                 "correlated subquery with its own GROUP BY/HAVING is "
                 "not supported")
-        drop = {id(c) for _o, _i, c in corr}
-        keep = [c for c in _split_and(sub.where) if id(c) not in drop]
+        keep = list(info.where_rest)
         where = None
         if keep:
             where = keep[0] if len(keep) == 1 else And(tuple(keep))
         key_items = [SelectItem(i, alias=f"__ck{k}")
-                     for k, (_o, i, _c) in enumerate(corr)]
+                     for k, (_o, i) in enumerate(info.corr)]
         inner_sel = Select(
             items=key_items + extra_items,
             froms=list(sub.froms), joins=list(sub.joins), where=where,
-            group_by=[i for _o, i, _c in corr] if aggregate else [],
+            group_by=[i for _o, i in info.corr] if aggregate else [],
             distinct=not aggregate,
         )
         sub_df, names = _Exec(self.engine, self.catalog,
                               self.ctes).run(inner_sel)
         sub_df = sub_df.copy()
         sub_df.columns = names
-        return sub_df, [f"__ck{k}" for k in range(len(corr))]
+        return sub_df, [f"__ck{k}" for k in range(len(info.corr))]
 
-    def _outer_key_frame(self, corr, df):
+    def _outer_key_frame(self, info, df):
         work = pd.DataFrame(index=pd.RangeIndex(len(df)))
-        for k, (o, _i, _c) in enumerate(corr):
+        for k, (o, _i) in enumerate(info.corr):
             s = self._eval(o, df)
             work[f"__ck{k}"] = s.values if isinstance(s, pd.Series) \
                 else s
         return work
 
-    def _correlated_scalar(self, sub: Select, corr, df):
+    def _correlated_scalar(self, sub: Select, info, df):
+        if info.residual:
+            raise UnsupportedSqlError(
+                "correlated scalar subquery with non-equality outer "
+                "references is not supported")
         if len(sub.items) != 1 or isinstance(sub.items[0].expr, Star):
             raise SqlParseError("scalar subquery must return one column")
         val_item = SelectItem(sub.items[0].expr, alias="__cv")
@@ -1282,7 +1318,7 @@ class _Exec:
             raise UnsupportedSqlError(
                 "correlated scalar subquery must aggregate (else it "
                 "may return >1 row per outer row)")
-        sub_df, keys = self._decorrelated_frame(sub, corr, [val_item],
+        sub_df, keys = self._decorrelated_frame(sub, info, [val_item],
                                                 aggregate=True)
         # per-outer-row lookup by correlation tuple; missing → NULL.
         # NULL keys never participate: `k = NULL` is UNKNOWN on both
@@ -1292,22 +1328,30 @@ class _Exec:
             t = tuple(r)
             if not any(pd.isna(v) for v in t[:-1]):
                 lut[t[:-1]] = t[-1]
-        outer = self._outer_key_frame(corr, df)
+        outer = self._outer_key_frame(info, df)
         out_vals = [None if any(pd.isna(v) for v in r)
                     else lut.get(tuple(r), None)
                     for r in outer[keys].itertuples(index=False)]
         return pd.Series(out_vals, index=df.index)
 
-    def _correlated_semi(self, sub: Select, corr, df, item=None):
+    def _correlated_semi(self, sub: Select, info, df, item=None):
         """EXISTS (semi-join) / IN membership against a correlated
-        subquery; returns a kleene boolean mask over df."""
+        subquery; returns a kleene boolean mask over df. Residual
+        non-equality outer references (q94) are applied as post-join
+        filters on the EXISTS path."""
+        if info.residual:
+            if item is not None:
+                raise UnsupportedSqlError(
+                    "correlated IN with non-equality outer references "
+                    "is not supported")
+            return self._correlated_exists_residual(sub, info, df)
         extra = []
         if item is not None:
             if len(sub.items) != 1 or isinstance(sub.items[0].expr,
                                                  Star):
                 raise SqlParseError("IN subquery must return one column")
             extra = [SelectItem(sub.items[0].expr, alias="__cv")]
-        sub_df, keys = self._decorrelated_frame(sub, corr, extra,
+        sub_df, keys = self._decorrelated_frame(sub, info, extra,
                                                 aggregate=False)
         cols = keys + (["__cv"] if item is not None else [])
         # three-valued membership: a NULL inner correlation key never
@@ -1329,7 +1373,7 @@ class _Exec:
                 group_has_null.add(kt)
             else:
                 match_keys.add(t)
-        outer = self._outer_key_frame(corr, df)
+        outer = self._outer_key_frame(info, df)
         if item is not None:
             s = self._eval(item, df)
             outer["__cv"] = s.values if isinstance(s, pd.Series) else s
@@ -1354,6 +1398,55 @@ class _Exec:
             else:
                 vals.append(False)
         return pd.Series(vals, index=df.index, dtype="boolean")
+
+    def _correlated_exists_residual(self, sub: Select, info, df):
+        """EXISTS with equality correlation PLUS outer-referencing
+        residual conjuncts: join outer keys+residual operands to the
+        decorrelated inner rows on the equality keys, apply the
+        residuals on the joined rows, reduce per outer row."""
+        inner_cols, outer_cols = [], []
+        for rc in info.residual:
+            def reg(c):
+                if not isinstance(c, Col):
+                    return
+                if info.is_outer(c):
+                    if c not in outer_cols:
+                        outer_cols.append(c)
+                elif c not in inner_cols:
+                    inner_cols.append(c)
+            _walk_exprs(rc, reg)
+        extra = [SelectItem(c, alias=f"__rin_{j}")
+                 for j, c in enumerate(inner_cols)]
+        sub_df, keys = self._decorrelated_frame(sub, info, extra,
+                                                aggregate=False)
+        outer = self._outer_key_frame(info, df)
+        for j, c in enumerate(outer_cols):
+            v = self._eval(c, df)
+            outer[f"__out_{j}"] = v.values if isinstance(v, pd.Series) \
+                else v
+        outer["__rowid"] = np.arange(len(outer))
+        merged = _merge_null_safe(outer, sub_df, "inner", keys, keys)
+        # rewrite residuals over the merged frame's flat column names
+        def sub_col(c):
+            if info.is_outer(c):
+                return Col((f"__out_{outer_cols.index(c)}",))
+            return Col((f"__rin_{inner_cols.index(c)}",))
+        mask = pd.Series(True, index=merged.index)
+        old_resolve = self._resolve
+        self._resolve = lambda col: col.parts[-1]
+        try:
+            for rc in info.residual:
+                m = self._truth(self._eval(_rewrite_cols(rc, sub_col),
+                                           merged))
+                if isinstance(m, bool):
+                    m = pd.Series(m, index=merged.index)
+                mask &= m
+        finally:
+            self._resolve = old_resolve
+        hit = set(merged.loc[mask, "__rowid"].tolist())
+        flags = np.fromiter((i in hit for i in range(len(df))),
+                            count=len(df), dtype=bool)
+        return _as_kleene(pd.Series(flags, index=df.index), df.index)
 
     def _scalar_func(self, e: Func, df):
         return self._apply_func(e, [self._eval(a, df) for a in e.args],
@@ -1630,6 +1723,61 @@ class _Exec:
             return None
 
         return conv(conj)
+
+
+class _CorrInfo:
+    """Decorrelation analysis of a correlated subquery: equality
+    correlation pairs, the inner-only WHERE remainder (with q41-style
+    OR-factored equalities removed), and residual outer-referencing
+    conjuncts (q94's `ws1.x <> ws2.x`) applied post-join."""
+
+    def __init__(self, corr, where_rest, residual, is_outer):
+        self.corr = corr
+        self.where_rest = where_rest
+        self.residual = residual
+        self.is_outer = is_outer
+
+    def __bool__(self):
+        return bool(self.corr)
+
+
+def _rewrite_cols(e, fn):
+    """Structurally rebuild `e` with every Col node replaced by
+    fn(col)."""
+    import dataclasses
+
+    if isinstance(e, Col):
+        return fn(e)
+    if isinstance(e, (BinOp, Cmp)):
+        return dataclasses.replace(
+            e, left=_rewrite_cols(e.left, fn),
+            right=_rewrite_cols(e.right, fn))
+    if isinstance(e, (And, Or)):
+        return dataclasses.replace(
+            e, items=tuple(_rewrite_cols(x, fn) for x in e.items))
+    if isinstance(e, (Not, Neg, IsNull, Like, Cast)):
+        return dataclasses.replace(e, item=_rewrite_cols(e.item, fn))
+    if isinstance(e, Between):
+        return dataclasses.replace(
+            e, item=_rewrite_cols(e.item, fn),
+            lo=_rewrite_cols(e.lo, fn), hi=_rewrite_cols(e.hi, fn))
+    if isinstance(e, InList):
+        return dataclasses.replace(
+            e, item=_rewrite_cols(e.item, fn),
+            values=tuple(_rewrite_cols(v, fn) for v in e.values))
+    if isinstance(e, Func):
+        return dataclasses.replace(
+            e, args=tuple(_rewrite_cols(a, fn) for a in e.args))
+    contains_col = []
+    _walk_exprs(e, lambda x: contains_col.append(x)
+                if isinstance(x, Col) else None)
+    if contains_col:
+        from delta_tpu.errors import UnsupportedSqlError
+
+        raise UnsupportedSqlError(
+            f"unsupported expression {type(e).__name__} in a "
+            "correlated residual predicate")
+    return e
 
 
 def _sql_sort(frame: pd.DataFrame, cols, ascs) -> pd.DataFrame:

@@ -438,3 +438,24 @@ def test_window_rank_mixed_direction_nulls(tmp_table_path):
               f"FROM '{tmp_table_path}' ORDER BY r")
     # DESC nulls LAST: 7 -> 1, 5 -> 2, NULL -> 3
     assert out.column("b").to_pylist() == [7, 5, None]
+
+
+def test_or_factored_correlation_with_trivial_branch(t, other):
+    # `(eq) or (eq and p)` is logically `eq`; the factored OR must not
+    # drop rows (round-4 review repro)
+    out = sql(f"SELECT id FROM '{t}' WHERE id IS NOT NULL AND "
+              f"(SELECT COUNT(*) FROM '{other}' WHERE (k = id) OR "
+              f"(k = id AND w > 250)) > 0 ORDER BY id")
+    assert out.column("id").to_pylist() == [2, 3]
+
+
+def test_residual_nonequality_exists(t, other):
+    # q94's shape: equality + non-equality outer reference
+    out = sql(f"SELECT id FROM '{t}' WHERE EXISTS "
+              f"(SELECT k FROM '{other}' o WHERE o.k = id AND "
+              f"o.w <> v) ORDER BY id")
+    assert out.column("id").to_pylist() == [2, 3]
+    out = sql(f"SELECT id FROM '{t}' WHERE EXISTS "
+              f"(SELECT k FROM '{other}' o WHERE o.k = id AND "
+              f"o.w < v) ORDER BY id")
+    assert out.num_rows == 0
