@@ -561,6 +561,45 @@ class _Exec:
                     if aa != ab:
                         edges.append((aa, pa_, ab, pb_, None))
 
+        # eager residual application: a WHERE conjunct whose aliases
+        # are all joined (and that contains no subquery) filters the
+        # intermediate frame IMMEDIATELY instead of after every join —
+        # q72's `inv_quantity_on_hand < cs_quantity` otherwise rides a
+        # 50M-row intermediate through four more merges
+        applied = set()
+
+        def conj_aliases(conj):
+            aliases = set()
+            blocked = []
+
+            def chk(x):
+                if isinstance(x, Col):
+                    try:
+                        aliases.add(resolve(x).split(".", 1)[0])
+                    except DeltaError:
+                        blocked.append(x)
+                elif isinstance(x, (InSelect, Exists, ScalarSelect)):
+                    blocked.append(x)
+            _walk_exprs(conj, chk)
+            return None if blocked else aliases
+
+        def apply_eager(frame):
+            for conj in conjuncts:
+                if id(conj) in consumed or id(conj) in applied:
+                    continue
+                al = conj_aliases(conj)
+                if al is None or not al or not al <= joined:
+                    continue
+                if al & null_supplying:
+                    continue  # outer-join semantics: filter at the end
+                m = self._truth(self._eval(conj, frame))
+                if not isinstance(m, bool):
+                    frame = frame[m]
+                elif not m:
+                    frame = frame.iloc[0:0]
+                applied.add(id(conj))
+            return frame
+
         first_alias = sources[0]["alias"]
         current = by_alias[first_alias]["frame"]
         joined = {first_alias}
@@ -591,6 +630,7 @@ class _Exec:
                     consumed.add(id(c))
             joined.add(a)
             remaining.remove(a)
+            current = apply_eager(current)
 
         for k, j in enumerate(sel.joins):
             a = join_aliases[k]
@@ -621,9 +661,11 @@ class _Exec:
                 rk.append(pr)
             current = _merge_null_safe(current, right, how, lk, rk)
             joined.add(a)
+            current = apply_eager(current)
 
         # ---- residual WHERE -------------------------------------------
-        residual = [c for c in conjuncts if id(c) not in consumed]
+        residual = [c for c in conjuncts
+                    if id(c) not in consumed and id(c) not in applied]
         if residual:
             mask = None
             for conj in residual:
@@ -1183,9 +1225,11 @@ class _Exec:
         `outer.col = inner_col`, with the outer side either qualified
         by an outer alias (q1/q30/q81) or an unqualified name that
         belongs only to the outer scope (q32/q92's bare `i_item_sk`).
-        Returns a list of (outer Col, inner Col, conjunct) or [] when
-        uncorrelated. Raises for correlation shapes that can't be
-        decorrelated by equality (e.g. q16's `cs1.x <> cs2.x`)."""
+        Also factors equalities repeated across every OR branch (q41)
+        and collects non-equality outer references (q94's `<>`) as
+        residual conjuncts for the post-join EXISTS path. Returns a
+        _CorrInfo, or None when uncorrelated; raises only when outer
+        references exist with no equality to decorrelate on."""
         inner = self._inner_aliases(sub)
         outer = {a.lower() for a in getattr(self, "_outer_aliases", ())}
         inner_cols = None  # lazily probed
@@ -1239,6 +1283,19 @@ class _Exec:
                      and all(cand in bs for bs in branch_splits)),
                     None)
                 if common is not None:
+                    # the rebuilt branches must be inner-only; any
+                    # OTHER outer reference inside them makes this a
+                    # residual conjunct, not a factorable one
+                    leftover = []
+                    for bs in branch_splits:
+                        for c in bs:
+                            if c == common:
+                                continue
+                            _walk_exprs(c, lambda x: leftover.append(x)
+                                        if is_outer(x) else None)
+                    if leftover:
+                        residual.append(conj)
+                        continue
                     corr.append(outer_eq(common))
                     branches = []
                     trivially_true = False
@@ -1320,7 +1377,10 @@ class _Exec:
                 "may return >1 row per outer row)")
         sub_df, keys = self._decorrelated_frame(sub, info, [val_item],
                                                 aggregate=True)
-        # per-outer-row lookup by correlation tuple; missing → NULL.
+        # missing group == subquery over ZERO rows: count()-family
+        # aggregates yield 0 there, everything else NULL (the q41
+        # `count(*) = 0` shape must see 0, not NULL)
+        default = self._empty_agg_value(val_item.expr)
         # NULL keys never participate: `k = NULL` is UNKNOWN on both
         # sides (Python dicts would happily match None == None)
         lut = {}
@@ -1330,9 +1390,33 @@ class _Exec:
                 lut[t[:-1]] = t[-1]
         outer = self._outer_key_frame(info, df)
         out_vals = [None if any(pd.isna(v) for v in r)
-                    else lut.get(tuple(r), None)
+                    else lut.get(tuple(r), default)
                     for r in outer[keys].itertuples(index=False)]
         return pd.Series(out_vals, index=df.index)
+
+    def _empty_agg_value(self, expr):
+        """Value of an aggregate expression over an empty input:
+        count → 0, other aggregates → NULL, constants fold through;
+        anything unresolvable defaults to NULL."""
+        def sub(e):
+            import dataclasses
+            if isinstance(e, Func) and e.name in _AGGS:
+                return Lit(0) if e.name == "count" else Lit(None)
+            if isinstance(e, (BinOp, Cmp)):
+                return dataclasses.replace(e, left=sub(e.left),
+                                           right=sub(e.right))
+            if isinstance(e, (Neg, Cast)):
+                return dataclasses.replace(e, item=sub(e.item))
+            return e
+        try:
+            empty = pd.DataFrame(index=pd.RangeIndex(0))
+            v = self._eval(sub(expr), empty)
+            if isinstance(v, pd.Series):
+                return None
+            return None if (v is not None and not isinstance(v, str)
+                            and pd.isna(v)) else v
+        except Exception:
+            return None
 
     def _correlated_semi(self, sub: Select, info, df, item=None):
         """EXISTS (semi-join) / IN membership against a correlated
@@ -1768,6 +1852,20 @@ def _rewrite_cols(e, fn):
     if isinstance(e, Func):
         return dataclasses.replace(
             e, args=tuple(_rewrite_cols(a, fn) for a in e.args))
+    if isinstance(e, CaseWhen):
+        return dataclasses.replace(
+            e,
+            whens=tuple((_rewrite_cols(c, fn), _rewrite_cols(v, fn))
+                        for c, v in e.whens),
+            else_=_rewrite_cols(e.else_, fn)
+            if e.else_ is not None else None)
+    if isinstance(e, Window):
+        return dataclasses.replace(
+            e, func=_rewrite_cols(e.func, fn),
+            partition_by=tuple(_rewrite_cols(p, fn)
+                               for p in e.partition_by),
+            order_by=tuple((_rewrite_cols(o, fn), asc)
+                           for o, asc in e.order_by))
     contains_col = []
     _walk_exprs(e, lambda x: contains_col.append(x)
                 if isinstance(x, Col) else None)

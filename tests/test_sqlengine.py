@@ -459,3 +459,25 @@ def test_residual_nonequality_exists(t, other):
               f"(SELECT k FROM '{other}' o WHERE o.k = id AND "
               f"o.w < v) ORDER BY id")
     assert out.num_rows == 0
+
+
+def test_correlated_count_empty_group_is_zero(t, other):
+    # COUNT over an empty correlated group is 0, not NULL
+    out = sql(f"SELECT id, (SELECT COUNT(*) FROM '{other}' "
+              f"WHERE k = id) c FROM '{t}' WHERE id IS NOT NULL "
+              f"ORDER BY id")
+    assert out.column("c").to_pylist() == [0, 1, 1, 0]
+    out = sql(f"SELECT id FROM '{t}' WHERE id IS NOT NULL AND "
+              f"(SELECT COUNT(*) FROM '{other}' WHERE k = id) = 0 "
+              f"ORDER BY id")
+    assert out.column("id").to_pylist() == [1, 4]
+
+
+def test_or_factoring_rejects_extra_outer_refs(t, other):
+    # an OR branch with an outer ref beyond the common equality is not
+    # factorable; it must fail cleanly, not with a resolution error
+    with pytest.raises(DeltaError, match="correlated|Unsupported"):
+        sql(f"SELECT t1.id FROM '{t}' t1 WHERE "
+            f"(SELECT COUNT(*) FROM '{other}' WHERE "
+            f"(k = t1.id AND w > 250) OR (k = t1.id AND t1.v > 100)"
+            f") > 0")
