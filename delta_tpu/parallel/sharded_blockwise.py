@@ -113,11 +113,15 @@ def replay_select_sharded_blockwise(
     local_key = combine_key_lanes(
         [(pk // np.uint32(S)).astype(np.uint32)] + lanes[1:])
     if local_key is None:
-        # radix overflow: densify (shard-local codes stay dense
-        # because every (path, dv) pair maps to a unique wide value)
-        wide = ((pk // np.uint32(S)).astype(np.uint64) << np.uint64(32)
-                | lanes[1].astype(np.uint64))
-        _, local_key = np.unique(wide, return_inverse=True)
+        # radix overflow: densify over ALL lanes (shard-local codes
+        # stay dense because every (path, dv, ...) tuple maps to a
+        # unique structured row)
+        cols_ = [(pk // np.uint32(S)).astype(np.uint32)]
+        cols_ += [l.astype(np.uint32) for l in lanes[1:]]
+        stacked = np.ascontiguousarray(np.stack(cols_, axis=1))
+        view = stacked.view(
+            [("", np.uint32)] * stacked.shape[1]).reshape(-1)
+        _, local_key = np.unique(view, return_inverse=True)
         local_key = local_key.astype(np.uint32)
     n_uniq_local = int(local_key.max()) + 1
 

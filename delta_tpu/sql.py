@@ -599,19 +599,36 @@ def _simple_select(s: str, engine, catalog):
         snap = table.latest_snapshot()
     known = ({f.name for f in snap.schema.fields}
              if snap.schema is not None else set())
+    # Spark-style case-insensitive resolution, matching the sqlengine
+    # path: map requested names onto actual schema field names
+    by_lower = {k.lower(): k for k in known}
+    requested = None
     if columns is not None and known:
-        unknown = [c for c in columns if c not in known]
+        resolved, unknown = [], []
+        for c in columns:
+            actual = c if c in known else by_lower.get(c.lower())
+            (resolved.append(actual) if actual is not None
+             else unknown.append(c))
         if unknown:
             raise UnresolvedColumnError(
                 f"column(s) {unknown} not found in table schema "
                 f"{sorted(known)}")
+        requested, columns = columns, resolved
     if pred is not None and known:
-        bad = sorted({r[0] for r in pred.references()} - known)
+        refs = {r[0] for r in pred.references()}
+        bad = sorted(r for r in refs
+                     if r not in known and r.lower() not in by_lower)
         if bad:
             raise UnresolvedColumnError(
                 f"WHERE references unknown column(s) {bad}; table "
                 f"schema is {sorted(known)}")
+        if any(r not in known for r in refs):
+            return NotImplemented  # case-folding predicate → sqlengine
     out = snap.scan(filter=pred, columns=columns).to_arrow()
+    if requested is not None and requested != columns:
+        # output columns carry the case the query wrote (sqlengine
+        # behavior), while the scan used the schema's actual names
+        out = out.rename_columns(requested)
     if m.group("limit"):
         out = out.slice(0, int(m.group("limit")))
     return out

@@ -1212,11 +1212,13 @@ class _Exec:
                             out.add(it.expr.parts[-1].lower())
                 elif ref.kind == "name" and ref.value.lower() in self.ctes:
                     out |= {c.lower()
-                            for c in self.ctes[ref.value].columns}
+                            for c in self.ctes[ref.value.lower()].columns}
                 else:
                     snap = self._snapshot(ref)
-                    out |= {f.name.lower() for f in snap.schema.fields}
-            except Exception:
+                    if snap.schema is not None:
+                        out |= {f.name.lower()
+                                for f in snap.schema.fields}
+            except (DeltaError, OSError):
                 pass  # unknown source: treat its columns as unknown
         return out
 

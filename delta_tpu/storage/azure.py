@@ -98,6 +98,19 @@ class AdlsGen2Client:
         raise IOError(f"adls rename {src}->{dst}: {status} "
                       f"{body[:200]!r}")
 
+    def rename_overwrite(self, src: str, dst: str) -> None:
+        """Atomic rename replacing `dst` if it exists (no
+        precondition — the service swaps the destination in one op)."""
+        headers = self._headers({
+            "x-ms-rename-source": urllib.parse.quote(
+                f"/{self.filesystem}/{src}"),
+        })
+        status, _, body = self.transport("PUT", self._url(dst),
+                                         headers, b"")
+        if status not in (200, 201):
+            raise IOError(f"adls rename {src}->{dst}: {status} "
+                          f"{body[:200]!r}")
+
     def get(self, name: str) -> bytes:
         status, _, body = self.transport("GET", self._url(name),
                                          self._headers(), None)
@@ -117,16 +130,31 @@ class AdlsGen2Client:
         return {k.lower(): v for k, v in headers.items()}
 
     def list_dir(self, directory: str) -> List[dict]:
-        q = ("resource=filesystem&recursive=false&directory="
-             + urllib.parse.quote(directory))
-        url = f"{self.base}/{self.filesystem}?{q}"
-        status, _, body = self.transport("GET", url, self._headers(),
-                                         None)
-        if status == 404:
-            return []
-        if status != 200:
-            raise IOError(f"adls list {directory}: {status}")
-        return json.loads(body.decode()).get("paths", [])
+        # ADLS Gen2 paginates listings (default 5000 entries/page);
+        # follow x-ms-continuation until absent or a page comes back
+        # empty-with-the-same-token (defensive stop).
+        base_q = ("resource=filesystem&recursive=false&directory="
+                  + urllib.parse.quote(directory))
+        out: List[dict] = []
+        continuation: Optional[str] = None
+        while True:
+            q = base_q
+            if continuation:
+                q += "&continuation=" + urllib.parse.quote(
+                    continuation, safe="")
+            url = f"{self.base}/{self.filesystem}?{q}"
+            status, headers, body = self.transport(
+                "GET", url, self._headers(), None)
+            if status == 404:
+                return out
+            if status != 200:
+                raise IOError(f"adls list {directory}: {status}")
+            out.extend(json.loads(body.decode()).get("paths", []))
+            nxt = {k.lower(): v for k, v in headers.items()}.get(
+                "x-ms-continuation")
+            if not nxt or nxt == continuation:
+                return out
+            continuation = nxt
 
     def delete(self, name: str) -> None:
         status, _, _ = self.transport("DELETE", self._url(name),
@@ -174,12 +202,21 @@ class AzureRenameLogStore(LogStore):
     def write(self, path: str, data: bytes,
               overwrite: bool = False) -> None:
         name = self._name(path)
-        if overwrite:
-            self.client.put_file(name, data)
-            return
         parent, _, base = name.rpartition("/")
         tmp = (f"{parent}/" if parent else "") + \
             f".{base}.{uuid.uuid4().hex}.tmp"
+        if overwrite:
+            # temp + unconditional rename keeps the destination
+            # all-or-nothing, so is_partial_write_visible stays False
+            # for every write path (create+append+flush directly onto
+            # the final name would expose an empty/partial file).
+            self.client.put_file(tmp, data)
+            try:
+                self.client.rename_overwrite(tmp, name)
+            except Exception:
+                self._cleanup_tmp(tmp)
+                raise
+            return
         self.client.put_file(tmp, data)
         # a successful rename removes the source atomically; only the
         # destination-exists and transport-error paths leave a temp to

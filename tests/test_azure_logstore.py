@@ -29,6 +29,8 @@ class _AdlsState:
         self.lock = threading.Lock()
         self.files = {}  # name (fs-relative) -> bytes
         self.fail_rename_once = set()  # dst names -> one 500
+        self.page_size = None  # listing entries per page (None = all)
+        self.list_calls = 0
 
 
 class _AdlsHandler(BaseHTTPRequestHandler):
@@ -117,6 +119,16 @@ class _AdlsHandler(BaseHTTPRequestHandler):
                 })
             for d in sorted(dirs):
                 paths.append({"name": d, "isDirectory": "true"})
+            st.list_calls += 1
+            if st.page_size:  # paginate like real ADLS Gen2
+                start = int(q.get("continuation") or 0)
+                page = paths[start:start + st.page_size]
+                hdrs = {}
+                if start + st.page_size < len(paths):
+                    hdrs["x-ms-continuation"] = str(
+                        start + st.page_size)
+                return self._send(
+                    200, json.dumps({"paths": page}).encode(), hdrs)
             return self._send(
                 200, json.dumps({"paths": paths}).encode())
         name = self._name()
@@ -260,3 +272,34 @@ def test_scheme_registration(adls_server, monkeypatch):
     assert isinstance(store, AzureRenameLogStore)
     store.write(f"{P}/00000000000000000000.json", b"via-scheme")
     assert store.read(f"{P}/00000000000000000000.json") == b"via-scheme"
+
+
+def test_list_pagination_follows_continuation(adls_server):
+    # real ADLS Gen2 pages listings (default 5000); the client must
+    # follow x-ms-continuation or long _delta_logs silently truncate
+    base, state = adls_server
+    store = _store(base)
+    for v in range(23):
+        store.write(f"{P}/{v:020d}.json", b"x")
+    state.page_size = 5
+    state.list_calls = 0
+    listed = list(store.list_from(f"{P}/{0:020d}.json"))
+    assert len(listed) == 23
+    assert state.list_calls >= 5  # actually paginated
+    names = [p.path.rpartition("/")[2] for p in listed]
+    assert names == [f"{v:020d}.json" for v in range(23)]
+
+
+def test_overwrite_goes_through_rename(adls_server):
+    # overwrite=True must stay all-or-nothing (temp + unconditional
+    # rename), so is_partial_write_visible() == False holds for every
+    # write path — not just put-if-absent commits
+    base, state = adls_server
+    store = _store(base)
+    p = f"{P}/_last_checkpoint"
+    store.write(p, b"v1", overwrite=True)
+    store.write(p, b"v2", overwrite=True)
+    assert store.read(p) == b"v2"
+    assert not store.is_partial_write_visible(p)
+    with state.lock:  # no leftover temp files
+        assert [n for n in state.files if ".tmp" in n] == []

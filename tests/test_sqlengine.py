@@ -481,3 +481,28 @@ def test_or_factoring_rejects_extra_outer_refs(t, other):
             f"(SELECT COUNT(*) FROM '{other}' WHERE "
             f"(k = t1.id AND w > 250) OR (k = t1.id AND t1.v > 100)"
             f") > 0")
+
+
+def test_mixed_case_cte_in_correlated_subquery(t):
+    # ADVICE r4: _inner_columns indexed self.ctes with the original
+    # (mixed-case) name while the dict is keyed lowercase; the KeyError
+    # was swallowed and the CTE's columns vanished from the inner-column
+    # inventory, misclassifying unqualified inner columns as outer
+    # correlations. `v` below is an inner column of the CTE.
+    out = sql(
+        f"WITH Big AS (SELECT id, v FROM '{t}' WHERE id IS NOT NULL) "
+        f"SELECT o.id FROM '{t}' o WHERE o.v = "
+        f"(SELECT max(v) FROM Big WHERE id = o.id) "
+        f"ORDER BY o.id")
+    assert out.column(0).to_pylist() == [1, 2, 3, 4]
+
+
+def test_fast_path_case_insensitive_projection(t):
+    # ADVICE r4: the _simple_select fast path validated projected
+    # columns case-sensitively while the sqlengine resolves
+    # Spark-style case-insensitively; both paths must agree.
+    out = sql(f"SELECT ID, V FROM '{t}' WHERE id = 2")
+    assert out.column(0).to_pylist() == [2]
+    assert out.column(1).to_pylist() == [20.0]
+    out2 = sql(f"SELECT Id FROM '{t}' WHERE ID = 3")
+    assert out2.column(0).to_pylist() == [3]
