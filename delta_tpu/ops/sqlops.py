@@ -1,0 +1,469 @@
+"""Device SQL operators: the execution spine for the SQL engine.
+
+The reference delegates query execution to Spark's distributed columnar
+engine (injected at
+`spark/src/main/scala/io/delta/sql/DeltaSparkSessionExtension.scala:84-173`;
+scans planned via
+`spark/src/main/scala/org/apache/spark/sql/delta/stats/PrepareDeltaScan.scala:308`).
+This module is the TPU-native replacement for the three relational
+operators that dominate that substrate's work on TPC-DS: equi-join,
+GROUP BY aggregation, and (window) sort. The division of labor follows
+the replay kernel's proven shape (`ops/replay.py`):
+
+- host: dictionary-encode string/float keys to dense uint32 codes
+  (pandas factorize — same as `ops/join.py::equi_join_device`) and do
+  O(output) gathers/expansions;
+- device: the O(n log n) sorts (`jax.lax.sort`, stable, multi-lane) and
+  O(n) segment reductions/scans (`jax.ops.segment_*`,
+  `jax.lax.associative_scan`) on bucket-padded static shapes so jit
+  caches a bounded number of programs across table sizes.
+
+Aggregation dtype policy: integer columns accumulate in int64 (exact),
+floats in float64 — x64 is enabled lazily on first use. The repo's other
+kernels are dtype-explicit throughout, so flipping the global flag is
+safe for them (verified by the full suite).
+
+Null semantics match pandas GROUP BY (`dropna=False` on keys; null
+values excluded from aggregates; all-null group sum/min/max = NULL) so
+HostEngine's pandas path stays the bit-exact parity oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from delta_tpu.ops.replay import pad_bucket
+
+_PAD_CODE = np.uint32(0xFFFFFFFF)
+_x64_enabled = False
+
+
+def _ensure_x64() -> None:
+    """int64/float64 device math for exact aggregation. Lazy so
+    processes that never touch the SQL spine keep the default."""
+    global _x64_enabled
+    if not _x64_enabled:
+        jax.config.update("jax_enable_x64", True)
+        _x64_enabled = True
+
+
+# ------------------------------------------------------------- sort ----
+
+@functools.partial(jax.jit, static_argnames=("num_keys",))
+def _sort_kernel(operands, num_keys: int):
+    out = jax.lax.sort(operands, num_keys=num_keys, is_stable=True)
+    return out[-1]
+
+
+def sort_permutation(lanes: Sequence[np.ndarray],
+                     device=None) -> np.ndarray:
+    """Stable multi-key ascending sort; returns the permutation (int64
+    row indices). Lanes are NaN-free numerics, primary first; callers
+    encode direction (negate for DESC) and null ordering (a 0/1 null
+    lane per key) before calling — the device only ever sorts
+    ascending."""
+    _ensure_x64()
+    n = int(len(lanes[0]))
+    if n == 0:
+        return np.empty(0, np.int64)
+    npad = pad_bucket(n)
+    padded = []
+    for lane in lanes:
+        lane = np.asarray(lane)
+        if lane.dtype == np.float32:
+            lane = lane.astype(np.float64)
+        elif lane.dtype == bool:  # 0/1 null-ordering lanes
+            lane = lane.astype(np.uint8)
+        if lane.dtype.kind == "f":
+            fill = np.inf
+        else:
+            fill = np.iinfo(lane.dtype).max
+        p = np.full(npad, fill, dtype=lane.dtype)
+        p[:n] = lane
+        padded.append(jax.device_put(p, device))
+    iota = jax.device_put(np.arange(npad, dtype=np.int64), device)
+    perm = np.asarray(_sort_kernel(tuple(padded) + (iota,),
+                                   num_keys=len(padded)))
+    return perm[perm < n]
+
+
+# --------------------------------------------------- group-by reduce ----
+
+@functools.partial(jax.jit, static_argnames=("op", "n_seg"))
+def _segagg_kernel(codes, v, valid, op: str, n_seg: int):
+    """One aggregate over dense group codes. Returns (agg[n_seg],
+    valid_count[n_seg])."""
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), codes,
+                              num_segments=n_seg)
+    if op == "count":
+        return cnt, cnt
+    if op == "sum":
+        zero = jnp.zeros((), v.dtype)
+        s = jax.ops.segment_sum(jnp.where(valid, v, zero), codes,
+                                num_segments=n_seg)
+        return s, cnt
+    if v.dtype.kind == "f":
+        big = jnp.array(np.inf, v.dtype)
+    else:
+        big = jnp.array(np.iinfo(np.int64).max, v.dtype)
+    if op == "min":
+        s = jax.ops.segment_min(jnp.where(valid, v, big), codes,
+                                num_segments=n_seg)
+    elif op == "max":
+        s = jax.ops.segment_max(jnp.where(valid, v, -big), codes,
+                                num_segments=n_seg)
+    else:
+        raise ValueError(op)
+    return s, cnt
+
+
+@functools.partial(jax.jit, static_argnames=("n_seg",))
+def _group_sizes_kernel(codes, real, n_seg: int):
+    return jax.ops.segment_sum(real.astype(jnp.int64), codes,
+                               num_segments=n_seg)
+
+
+@functools.partial(jax.jit, static_argnames=("n_seg",))
+def _centered_sumsq_kernel(codes, v, valid, means, n_seg: int):
+    """Second pass for variance: sum((v - mean[g])^2) over valid rows."""
+    d = v - means[codes]
+    zero = jnp.zeros((), d.dtype)
+    return jax.ops.segment_sum(jnp.where(valid, d * d, zero), codes,
+                               num_segments=n_seg)
+
+
+class GroupAggregator:
+    """Padded, device-resident group codes plus per-spec reductions.
+
+    Usage: construct with the row->group code array, then call
+    `reduce(values, valid, op)` per aggregate. Ints accumulate in i64,
+    floats in f64; `var(values, valid)` runs the exact two-pass
+    variance. Results are sliced to `n_groups`.
+    """
+
+    def __init__(self, codes: np.ndarray, n_groups: int, device=None):
+        _ensure_x64()
+        self.n = int(len(codes))
+        self.n_groups = int(n_groups)
+        self.n_seg = pad_bucket(self.n_groups + 1, min_bucket=256)
+        self.npad = pad_bucket(max(self.n, 1))
+        padded = np.full(self.npad, self.n_seg - 1, np.int32)
+        padded[:self.n] = codes
+        self.device = device
+        self.codes = jax.device_put(padded, device)
+        real = np.zeros(self.npad, bool)
+        real[:self.n] = True
+        self._real = jax.device_put(real, device)
+
+    def sizes(self) -> np.ndarray:
+        """COUNT(*) per group."""
+        out = _group_sizes_kernel(self.codes, self._real,
+                                  n_seg=self.n_seg)
+        return np.asarray(out)[:self.n_groups]
+
+    def _pad(self, values: np.ndarray, valid: np.ndarray):
+        v = np.asarray(values)
+        if v.dtype.kind in "ui" or v.dtype == bool:
+            v = v.astype(np.int64)
+        else:
+            v = v.astype(np.float64)
+        vp = np.zeros(self.npad, v.dtype)
+        vp[:self.n] = v
+        mp = np.zeros(self.npad, bool)
+        mp[:self.n] = valid
+        return (jax.device_put(vp, self.device),
+                jax.device_put(mp, self.device))
+
+    def reduce(self, values, valid, op: str):
+        """Returns (agg[n_groups], valid_count[n_groups]) numpy arrays.
+        Callers NULL-out groups where count==0 (min_count=1 sum
+        semantics) and restore original dtypes."""
+        vp, mp = self._pad(values, valid)
+        agg, cnt = _segagg_kernel(self.codes, vp, mp, op=op,
+                                  n_seg=self.n_seg)
+        return (np.asarray(agg)[:self.n_groups],
+                np.asarray(cnt)[:self.n_groups])
+
+    def var(self, values, valid):
+        """Two-pass sample variance (exact centering — a single-pass
+        sumsq in f64 loses catastrophically on money columns). Returns
+        (var[n_groups], count[n_groups]); var is NaN where count < 2."""
+        vp, mp = self._pad(values, valid)
+        if vp.dtype != np.float64:
+            vp = vp.astype(jnp.float64)
+        s, cnt = _segagg_kernel(self.codes, vp, mp, op="sum",
+                                n_seg=self.n_seg)
+        means = s / jnp.maximum(cnt, 1)
+        ss = _centered_sumsq_kernel(self.codes, vp, mp, means,
+                                    n_seg=self.n_seg)
+        cnt_np = np.asarray(cnt)[:self.n_groups]
+        ss_np = np.asarray(ss)[:self.n_groups]
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = np.where(cnt_np >= 2, ss_np / np.maximum(cnt_np - 1, 1),
+                           np.nan)
+        return var, cnt_np
+
+    def count_distinct(self, value_codes: np.ndarray,
+                       valid: np.ndarray) -> np.ndarray:
+        """COUNT(DISTINCT x) per group: device-sort (group, value)
+        pairs, count run boundaries per group."""
+        vc = np.asarray(value_codes, np.int64)
+        g = np.asarray(self.codes)[:self.n].astype(np.int64)
+        keep = np.asarray(valid, bool)
+        g, vc = g[keep], vc[keep]
+        m = len(g)
+        if m == 0:
+            return np.zeros(self.n_groups, np.int64)
+        mpad = pad_bucket(m)
+        gp = np.full(mpad, self.n_seg - 1, np.int64)
+        gp[:m] = g
+        vp = np.full(mpad, np.iinfo(np.int64).max, np.int64)
+        vp[:m] = vc
+        out = _count_distinct_kernel(
+            jax.device_put(gp, self.device),
+            jax.device_put(vp, self.device), n_seg=self.n_seg)
+        return np.asarray(out)[:self.n_groups]
+
+
+@functools.partial(jax.jit, static_argnames=("n_seg",))
+def _count_distinct_kernel(g, v, n_seg: int):
+    sg, sv = jax.lax.sort((g, v), num_keys=2)
+    first = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (sg[1:] != sg[:-1]) | (sv[1:] != sv[:-1])])
+    # pad group's runs land in segment n_seg-1, sliced off by caller
+    return jax.ops.segment_sum(first.astype(jnp.int64), sg,
+                               num_segments=n_seg)
+
+
+# ----------------------------------------------------------- join ----
+
+@jax.jit
+def _join_sort_kernel(codes, side, iota):
+    return jax.lax.sort((codes, side, iota), num_keys=2,
+                        is_stable=True)
+
+
+def join_pairs(
+    l_codes: np.ndarray,
+    r_codes: np.ndarray,
+    how: str = "inner",
+    device=None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """General many-to-many equi-join on pre-densified uint32 codes
+    (< 0xFFFFFFFF). Returns (l_idx, r_idx) int64 pair indices;
+    unmatched rows preserved by `how` appear with the other side's
+    index = -1. Device does the combined O(n log n) sort; the host does
+    the O(output) pair expansion with vectorized numpy.
+
+    Unlike `ops/join.py::equi_join_codes` (MERGE's cardinality-
+    restricted 1-match variant) the output here is variable-size — the
+    expansion must live host-side under XLA's static-shape model.
+    """
+    _ensure_x64()
+    nl, nr = int(len(l_codes)), int(len(r_codes))
+    n = nl + nr
+    empty = np.empty(0, np.int64)
+    if n == 0:
+        return empty, empty
+    npad = pad_bucket(n)
+    codes = np.full(npad, _PAD_CODE, np.uint32)
+    codes[:nl] = l_codes
+    codes[nl:n] = r_codes
+    side = np.zeros(npad, np.uint32)
+    side[nl:] = 1
+    iota = np.arange(npad, dtype=np.int64)
+    s_code, s_side, s_pos = (
+        np.asarray(a) for a in _join_sort_kernel(
+            jax.device_put(codes, device),
+            jax.device_put(side, device),
+            jax.device_put(iota, device)))
+    real = s_code != _PAD_CODE
+    s_code, s_side, s_pos = s_code[real], s_side[real], s_pos[real]
+    m = len(s_code)
+    if m == 0:
+        return empty, empty
+
+    starts = np.flatnonzero(
+        np.concatenate([[True], s_code[1:] != s_code[:-1]]))
+    run_len = np.diff(np.concatenate([starts, [m]]))
+    n_r = np.add.reduceat(s_side, starts).astype(np.int64)
+    n_l = run_len - n_r
+
+    pairs = n_l * n_r
+    total = int(pairs.sum())
+    run_of = np.repeat(np.arange(len(starts)), pairs)
+    off = np.concatenate([[0], np.cumsum(pairs)[:-1]])
+    within = np.arange(total, dtype=np.int64) - off[run_of]
+    nr_run = n_r[run_of]
+    li = within // nr_run
+    ri = within - li * nr_run
+    l_idx = s_pos[starts[run_of] + li]
+    r_idx = s_pos[starts[run_of] + n_l[run_of] + ri] - nl
+
+    extras_l = extras_r = None
+    if how != "inner":
+        run_of_sorted = np.repeat(np.arange(len(starts)), run_len)
+    if how in ("left", "outer"):
+        sel = (n_r[run_of_sorted] == 0) & (s_side == 0)
+        extras_l = s_pos[sel]
+    if how in ("right", "outer"):
+        sel = (n_l[run_of_sorted] == 0) & (s_side == 1)
+        extras_r = s_pos[sel] - nl
+    if extras_l is not None and len(extras_l):
+        l_idx = np.concatenate([l_idx, extras_l])
+        r_idx = np.concatenate([r_idx, np.full(len(extras_l), -1,
+                                               np.int64)])
+    if extras_r is not None and len(extras_r):
+        l_idx = np.concatenate([l_idx, np.full(len(extras_r), -1,
+                                               np.int64)])
+        r_idx = np.concatenate([r_idx, extras_r])
+    return l_idx.astype(np.int64), r_idx.astype(np.int64)
+
+
+# --------------------------------------------------------- windows ----
+
+_NEG = np.int64(-(1 << 62))
+
+
+@jax.jit
+def _ranks_kernel(pb, kb):
+    """Sorted-order rank family. pb[i]: row i starts a partition;
+    kb[i]: row i starts an order-key run (kb includes pb positions).
+    Returns (row_number, rank, dense_rank), all 1-based int64."""
+    n = pb.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int64)
+    neg = jnp.int64(_NEG)
+    start = jax.lax.cummax(jnp.where(pb, iota, neg))
+    row_number = iota - start + 1
+    kstart = jax.lax.cummax(jnp.where(kb, iota, neg))
+    rank = kstart - start + 1
+    kcum = jnp.cumsum(kb.astype(jnp.int64))
+    kcum_at_start = jax.lax.cummax(jnp.where(pb, kcum, neg))
+    dense = kcum - kcum_at_start + 1
+    return row_number, rank, dense
+
+
+def window_ranks(pb: np.ndarray, kb: np.ndarray, device=None):
+    """Host wrapper: bucket-pads the boundary lanes (pads start their
+    own partitions so they can't bleed backwards) and slices."""
+    _ensure_x64()
+    n = len(pb)
+    if n == 0:
+        z = np.empty(0, np.int64)
+        return z, z, z
+    npad = pad_bucket(n)
+    pbp = np.ones(npad, bool)
+    kbp = np.ones(npad, bool)
+    pbp[:n] = pb
+    kbp[:n] = kb | pb
+    rn, rk, dr = _ranks_kernel(jax.device_put(pbp, device),
+                               jax.device_put(kbp, device))
+    return (np.asarray(rn)[:n], np.asarray(rk)[:n],
+            np.asarray(dr)[:n])
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def _segscan_kernel(v, valid, pb, op: str):
+    """Segmented running aggregate in sorted order. Partitions are
+    contiguous; pb marks starts. Returns (running[n], run_count[n])."""
+    n = v.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int64)
+    neg = jnp.int64(_NEG)
+    start = jax.lax.cummax(jnp.where(pb, iota, neg))
+    cnt_cum = jnp.cumsum(valid.astype(jnp.int64))
+    cnt_base = jnp.where(start > 0,
+                         cnt_cum[jnp.maximum(start - 1, 0)], 0)
+    rcount = cnt_cum - cnt_base
+    if op in ("sum", "mean"):
+        zero = jnp.zeros((), v.dtype)
+        c = jnp.cumsum(jnp.where(valid, v, zero))
+        base = jnp.where(start > 0, c[jnp.maximum(start - 1, 0)],
+                         zero)
+        rsum = c - base
+        if op == "mean":
+            return rsum / jnp.maximum(rcount, 1), rcount
+        return rsum, rcount
+    if op == "count":
+        return rcount.astype(jnp.float64), rcount
+    # min/max: segmented scan via associative combine with reset flag
+    if op == "min":
+        fill = jnp.array(np.inf, v.dtype)
+        red = jnp.minimum
+    else:
+        fill = jnp.array(-np.inf, v.dtype)
+        red = jnp.maximum
+
+    def comb(a, b):
+        va, ba = a
+        vb, bb = b
+        return jnp.where(bb, vb, red(va, vb)), ba | bb
+
+    vf = jnp.where(valid, v, fill)
+    out, _ = jax.lax.associative_scan(comb, (vf, pb))
+    return out, rcount
+
+
+def window_running(v: np.ndarray, valid: np.ndarray, pb: np.ndarray,
+                   op: str, device=None):
+    """Running sum/mean/min/max/count within contiguous partitions (the
+    SQL default RANGE UNBOUNDED PRECEDING..CURRENT ROW before peer
+    sharing). Returns (values f64[n], counts i64[n]); rows where
+    count==0 are NULL (callers mask)."""
+    _ensure_x64()
+    n = len(v)
+    if n == 0:
+        return np.empty(0, np.float64), np.empty(0, np.int64)
+    npad = pad_bucket(n)
+    vp = np.zeros(npad, np.float64)
+    vp[:n] = np.asarray(v, np.float64)
+    mp = np.zeros(npad, bool)
+    mp[:n] = valid
+    pbp = np.ones(npad, bool)
+    pbp[:n] = pb
+    out, cnt = _segscan_kernel(jax.device_put(vp, device),
+                               jax.device_put(mp, device),
+                               jax.device_put(pbp, device), op=op)
+    return np.asarray(out)[:n], np.asarray(cnt)[:n]
+
+
+@jax.jit
+def _peer_last_kernel(vals, counts, kb):
+    """RANGE-frame peer sharing: every row takes the running value at
+    the LAST row of its order-key run."""
+    n = vals.shape[0]
+    krun = jnp.cumsum(kb.astype(jnp.int64)) - 1
+    iota = jnp.arange(n, dtype=jnp.int64)
+    last = jax.ops.segment_max(iota, krun, num_segments=n)
+    take = last[krun]
+    return vals[take], counts[take]
+
+
+def window_peer_last(vals: np.ndarray, counts: np.ndarray,
+                     kb: np.ndarray, pb: Optional[np.ndarray] = None,
+                     device=None):
+    """`kb` marks order-key run starts; peers never span partitions,
+    so pass `pb` (or pre-OR it in) — and row 0 always starts a run
+    (forced here so a raw diff-based lane can't wrap the first run
+    into the padding segment)."""
+    _ensure_x64()
+    n = len(vals)
+    if n == 0:
+        return vals, counts
+    npad = pad_bucket(n)
+    vp = np.zeros(npad, np.float64)
+    vp[:n] = vals
+    cp = np.zeros(npad, np.int64)
+    cp[:n] = counts
+    kbp = np.ones(npad, bool)
+    kbp[:n] = kb if pb is None else (np.asarray(kb) | np.asarray(pb))
+    kbp[0] = True
+    v_out, c_out = _peer_last_kernel(jax.device_put(vp, device),
+                                     jax.device_put(cp, device),
+                                     jax.device_put(kbp, device))
+    return np.asarray(v_out)[:n], np.asarray(c_out)[:n]

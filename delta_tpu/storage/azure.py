@@ -146,6 +146,12 @@ class AdlsGen2Client:
             status, headers, body = self.transport(
                 "GET", url, self._headers(), None)
             if status == 404:
+                if continuation is not None:
+                    # the directory vanished mid-pagination: a partial
+                    # listing must not masquerade as a complete one
+                    raise IOError(
+                        f"adls list {directory}: 404 on continuation "
+                        "page (listing changed underneath)")
                 return out
             if status != 200:
                 raise IOError(f"adls list {directory}: {status}")

@@ -303,3 +303,27 @@ def test_overwrite_goes_through_rename(adls_server):
     assert not store.is_partial_write_visible(p)
     with state.lock:  # no leftover temp files
         assert [n for n in state.files if ".tmp" in n] == []
+
+
+def test_list_pagination_404_midway_raises(adls_server):
+    # a 404 on a continuation page means the listing changed under
+    # us; a partial listing must not masquerade as complete
+    base, state = adls_server
+    store = _store(base)
+    for v in range(8):
+        store.write(f"{P}/{v:020d}.json", b"x")
+    state.page_size = 3
+
+    real = store.client.transport
+    calls = {"n": 0}
+
+    def flaky(method, url, headers, body):
+        if "resource=filesystem" in url:
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                return 404, {}, b""
+        return real(method, url, headers, body)
+
+    store.client.transport = flaky
+    with pytest.raises(IOError):
+        store.client.list_dir("t/_delta_log")
