@@ -279,6 +279,179 @@ def wl_merge_join(n, device):
             "device_wins": t_dev < t_host}
 
 
+def wl_sql_groupby(n, device):
+    """SQL GROUP BY spine: device segment reduce (sum+count over dense
+    group codes, `ops/sqlops.py::GroupAggregator`) vs the displaced
+    substrate — pandas groupby — AND the strongest numpy formulation
+    (np.bincount weighted sums), reported against the stronger of the
+    two."""
+    import jax
+    import pandas as pd
+
+    from delta_tpu.ops import sqlops
+
+    rng = np.random.default_rng(11)
+    G = max(n // 100, 16)
+    codes = rng.integers(0, G, n).astype(np.int32)
+    v = rng.standard_normal(n) * 100.0
+    valid = np.ones(n, bool)
+
+    def dev():
+        ga = sqlops.GroupAggregator(codes, G, device=device)
+        s, c = ga.reduce(v, valid, "sum")
+        return float(s.sum()), int(c.sum())
+
+    got = dev()
+    t_dev = _best(dev, k=2)
+
+    def host_pandas():
+        g = pd.Series(v).groupby(codes)
+        s = g.sum()
+        c = g.count()
+        return float(s.sum()), int(c.sum())
+
+    def host_numpy():
+        s = np.bincount(codes, weights=v, minlength=G)
+        c = np.bincount(codes, minlength=G)
+        return float(s.sum()), int(c.sum())
+
+    hp = host_pandas()
+    assert abs(hp[0] - got[0]) < 1e-6 * max(1, abs(got[0]))
+    assert hp[1] == got[1]
+    t_pandas = _best(host_pandas, k=2)
+    t_numpy = _best(host_numpy, k=2)
+    t_host = min(t_pandas, t_numpy)
+
+    # isolated compute: resident padded operands through the jit kernel
+    npad = sqlops.pad_bucket(n)
+    n_seg = sqlops.pad_bucket(G + 1, min_bucket=256)
+    cp = np.full(npad, n_seg - 1, np.int32)
+    cp[:n] = codes
+    vp = np.zeros(npad, np.float64)
+    vp[:n] = v
+    mp = np.zeros(npad, bool)
+    mp[:n] = valid
+    dc = jax.device_put(cp, device)
+    dv = jax.device_put(vp, device)
+    dm = jax.device_put(mp, device)
+
+    def comp():
+        s, c = sqlops._segagg_kernel(dc, dv, dm, op="sum", n_seg=n_seg)
+        s.block_until_ready()
+
+    comp()
+    t_comp = _best(comp, k=3)
+    bytes_moved = n * (4 + 8 + 1) + G * 16
+    return {"n": n, "t_device_s": t_dev, "t_host_s": t_host,
+            "t_host_pandas_s": t_pandas, "t_host_numpy_s": t_numpy,
+            "t_device_compute_s": t_comp,
+            "bytes_transferred_est": int(bytes_moved),
+            "device_wins": t_dev < t_host}
+
+
+def wl_sql_join(n, device):
+    """SQL many-to-many equi-join spine: device sort + host pair
+    expansion (`ops/sqlops.py::join_pairs`) vs pandas merge (the
+    displaced substrate)."""
+    import pandas as pd
+
+    import jax
+
+    from delta_tpu.ops import sqlops
+
+    rng = np.random.default_rng(12)
+    nl, nr = n, n // 2
+    lk = rng.integers(0, n, nl).astype(np.uint32)
+    rk = rng.integers(0, n, nr).astype(np.uint32)
+
+    def dev():
+        li, ri = sqlops.join_pairs(lk, rk, how="inner", device=device)
+        return len(li)
+
+    got = dev()
+    t_dev = _best(dev, k=2)
+
+    left = pd.DataFrame({"k": lk})
+    right = pd.DataFrame({"k": rk})
+
+    def host():
+        return len(left.merge(right, on="k", how="inner"))
+
+    assert host() == got
+    t_host = _best(host, k=2)
+
+    # isolated compute: the combined sort on resident operands
+    npad = sqlops.pad_bucket(nl + nr)
+    codes = np.full(npad, 0xFFFFFFFF, np.uint32)
+    codes[:nl] = lk
+    codes[nl:nl + nr] = rk
+    side = np.zeros(npad, np.uint32)
+    side[nl:] = 1
+    iota = np.arange(npad, dtype=np.int64)
+    dc = jax.device_put(codes, device)
+    ds = jax.device_put(side, device)
+    di = jax.device_put(iota, device)
+
+    def comp():
+        out = sqlops._join_sort_kernel(dc, ds, di)
+        out[0].block_until_ready()
+
+    comp()
+    t_comp = _best(comp, k=3)
+    bytes_moved = npad * (4 + 4 + 8) * 2  # up + sorted lanes down
+    return {"n": n, "t_device_s": t_dev, "t_host_s": t_host,
+            "t_device_compute_s": t_comp,
+            "bytes_transferred_est": int(bytes_moved),
+            "device_wins": t_dev < t_host}
+
+
+def wl_sql_sort(n, device):
+    """SQL ORDER BY / window sort spine: device stable multi-lane sort
+    permutation vs numpy lexsort (stronger than pandas sort_values)."""
+    import jax
+
+    from delta_tpu.ops import sqlops
+
+    rng = np.random.default_rng(13)
+    a = rng.integers(0, 1000, n).astype(np.int64)
+    b = rng.standard_normal(n)
+
+    def dev():
+        return len(sqlops.sort_permutation([a, b], device=device))
+
+    dev()
+    t_dev = _best(dev, k=2)
+
+    def host():
+        return len(np.lexsort((b, a)))
+
+    t_host = _best(host, k=2)
+    assert np.array_equal(sqlops.sort_permutation([a, b], device=device),
+                          np.lexsort((b, a)))
+
+    npad = sqlops.pad_bucket(n)
+    ap = np.full(npad, np.iinfo(np.int64).max, np.int64)
+    ap[:n] = a
+    bp = np.full(npad, np.inf, np.float64)
+    bp[:n] = b
+    iota = np.arange(npad, dtype=np.int64)
+    da = jax.device_put(ap, device)
+    db = jax.device_put(bp, device)
+    di = jax.device_put(iota, device)
+
+    def comp():
+        sqlops._sort_kernel((da, db, di), num_keys=2) \
+            .block_until_ready()
+
+    comp()
+    t_comp = _best(comp, k=3)
+    bytes_moved = n * (8 + 8) + n * 8
+    return {"n": n, "t_device_s": t_dev, "t_host_s": t_host,
+            "t_device_compute_s": t_comp,
+            "bytes_transferred_est": int(bytes_moved),
+            "device_wins": t_dev < t_host}
+
+
 # ------------------------------------------------------- cost model --
 
 
@@ -302,6 +475,7 @@ def main():
     ap.add_argument("--replay-rows", type=int, default=30_000_000)
     ap.add_argument("--blockwise-rows", type=int, default=100_000_000)
     ap.add_argument("--join-rows", type=int, default=10_000_000)
+    ap.add_argument("--sql-rows", type=int, default=10_000_000)
     args = ap.parse_args()
 
     import jax
@@ -320,7 +494,10 @@ def main():
     for name, fn, n in (
             ("replay_fa", wl_replay, args.replay_rows),
             ("blockwise_replay", wl_blockwise, args.blockwise_rows),
-            ("merge_join", wl_merge_join, args.join_rows)):
+            ("merge_join", wl_merge_join, args.join_rows),
+            ("sql_groupby", wl_sql_groupby, args.sql_rows),
+            ("sql_join", wl_sql_join, args.sql_rows),
+            ("sql_sort", wl_sql_sort, args.sql_rows)):
         print(f"== {name} @ {n} rows", file=sys.stderr)
         wl = fn(n, device)
         wl["model"] = model(link, wl)

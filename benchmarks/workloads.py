@@ -396,9 +396,12 @@ class TpcdsBenchmark(Benchmark):
     Delta tables (`benchmarks/tpcds_data.py`, the dsdgen role of the
     reference's `TPCDSDataLoad.scala:71`) and times every VERBATIM
     query in `benchmarks/tpcds_queries.py` through the sqlengine
-    (`TPCDSBenchmark.scala:74` role). Two timed iterations per query
-    (cold + warm); correctness of each query is asserted separately
-    against an independent sqlite oracle in `tests/test_tpcds.py`."""
+    (`TPCDSBenchmark.scala:74` role) on BOTH substrates — the
+    TpuEngine device spine (`ops/sqlops.py` kernels) and the
+    HostEngine pandas path — plus the independent sqlite oracle as the
+    external comparison column. Two timed iterations per engine query
+    (cold + warm); correctness is asserted separately in
+    tests/test_tpcds.py."""
 
     name = "tpcds"
 
@@ -409,8 +412,10 @@ class TpcdsBenchmark(Benchmark):
                  "full": 25_000_000}
 
     def run(self):
-        from benchmarks.tpcds_data import load_delta
+        from benchmarks.tpcds_data import generate, load_delta
         from benchmarks.tpcds_queries import QUERIES
+        from delta_tpu.catalog import Catalog
+        from delta_tpu.engine.host import HostEngine
         from delta_tpu.sqlengine import execute_select
 
         rows = self.FACT_ROWS[self.scale]
@@ -418,24 +423,56 @@ class TpcdsBenchmark(Benchmark):
         shutil.rmtree(root, ignore_errors=True)
         with self.timed("load", rows=rows):
             catalog = load_delta(root, scale=rows)
+        host_catalog = Catalog(root, engine=HostEngine())
         size = sum(
             os.path.getsize(os.path.join(dp, f))
             for dp, _, fs in os.walk(root) for f in fs)
         self.metric("dataset_bytes", size, "bytes", fact_rows=rows)
 
-        total_ms = 0.0
+        oracle = None
+        if os.environ.get("TPCDS_BENCH_ORACLE", "1") != "0":
+            from tests.tpcds_sqlite_oracle import SqliteOracle
+
+            t0 = time.perf_counter()
+            oracle = SqliteOracle(generate(rows))
+            self.metric("oracle_load_ms",
+                        (time.perf_counter() - t0) * 1000, "ms")
+
+        totals = {"device": 0.0, "host": 0.0, "oracle": 0.0}
         for name, q in QUERIES.items():
-            for it in range(2):
+            for substrate, cat in (("device", catalog),
+                                   ("host", host_catalog)):
+                for it in range(2):
+                    t0 = time.perf_counter()
+                    out = execute_select(q, catalog=cat)
+                    dt = (time.perf_counter() - t0) * 1000
+                    self.report.results.append(QueryResult(
+                        name, it, dt, {"rows": out.num_rows,
+                                       "substrate": substrate}))
+                    print(f"  {name}[{substrate}:{it}]: {dt:,.1f} ms "
+                          f"({out.num_rows} rows)", file=sys.stderr)
+                    if it == 1:
+                        totals[substrate] += dt
+            if oracle is not None:
                 t0 = time.perf_counter()
-                out = execute_select(q, catalog=catalog)
-                dt = (time.perf_counter() - t0) * 1000
-                self.report.results.append(QueryResult(
-                    name, it, dt, {"rows": out.num_rows}))
-                print(f"  {name}[{it}]: {dt:,.1f} ms "
-                      f"({out.num_rows} rows)", file=sys.stderr)
-                if it == 1:
-                    total_ms += dt
-        self.metric("tpcds_warm_total", total_ms, "ms",
+                try:
+                    orows = len(oracle.run(q))
+                    dt = (time.perf_counter() - t0) * 1000
+                    self.report.results.append(QueryResult(
+                        name, 0, dt, {"rows": orows,
+                                      "substrate": "oracle"}))
+                    totals["oracle"] += dt
+                    print(f"  {name}[oracle]: {dt:,.1f} ms",
+                          file=sys.stderr)
+                except Exception as exc:  # q67 rollup depth
+                    self.report.results.append(QueryResult(
+                        name, 0, float("nan"),
+                        {"substrate": "oracle",
+                         "error": str(exc)[:120]}))
+        for substrate, total in totals.items():
+            self.metric(f"tpcds_warm_total_{substrate}", total, "ms",
+                        queries=len(QUERIES))
+        self.metric("tpcds_warm_total", totals["device"], "ms",
                     queries=len(QUERIES))
         return self.report
 

@@ -185,6 +185,8 @@ def _configure_arrow_pool() -> None:
 
 
 class HostEngine(Engine):
+    use_device_sql = False  # pandas relational path (parity oracle)
+
     def __init__(self, store_resolver=logstore_for_path, metrics_reporters=None):
         _configure_arrow_pool()
         from delta_tpu.utils.alloc import tune_allocator

@@ -61,6 +61,9 @@ def _configure_compilation_cache() -> None:
 
 class TpuEngine(HostEngine):
     use_device_replay = True
+    # SQL engine relational spine (join/group-by/window sort) runs on
+    # the device kernels in ops/sqlops.py; see sqlengine/device.py
+    use_device_sql = True
 
     def __init__(
         self,

@@ -269,16 +269,24 @@ def _render(e) -> str:
 
 
 def _merge_null_safe(left: pd.DataFrame, right: pd.DataFrame, how: str,
-                     lk: List[str], rk: List[str]) -> pd.DataFrame:
+                     lk: List[str], rk: List[str],
+                     spine=None) -> pd.DataFrame:
     """SQL join: NULL keys never match (pandas merge matches NaN/None
     to each other). Rows with a null key are excluded from matching;
-    sides preserved by `how` get them re-appended null-extended."""
+    sides preserved by `how` get them re-appended null-extended.
+    With a DeviceSpine the match itself runs on the device join
+    kernel; the null-key bookkeeping stays identical."""
     lnull = left[lk].isna().any(axis=1)
     rnull = right[rk].isna().any(axis=1)
     if not lnull.any() and not rnull.any():  # hot path: no copies
+        if spine is not None:
+            return spine.merge(left, right, how, lk, rk)
         return left.merge(right, how=how, left_on=lk, right_on=rk)
-    merged = left[~lnull].merge(right[~rnull], how=how, left_on=lk,
-                                right_on=rk)
+    lm, rm = left[~lnull], right[~rnull]
+    if spine is not None:
+        merged = spine.merge(lm, rm, how, lk, rk)
+    else:
+        merged = lm.merge(rm, how=how, left_on=lk, right_on=rk)
     extra = []
     if how in ("left", "outer") and lnull.any():
         extra.append(left[lnull])
@@ -315,6 +323,9 @@ class _Exec:
         self.engine = engine
         self.catalog = catalog
         self.ctes = ctes or {}
+        from delta_tpu.sqlengine.device import spine_for
+
+        self.spine = spine_for(engine, catalog)
 
     # -- table materialization ------------------------------------------
     def _snapshot(self, ref: TableRef):
@@ -624,7 +635,8 @@ class _Exec:
             lk = [k for k, _ in keys]
             rk = [k for _, k in keys]
             current = _merge_null_safe(current, by_alias[a]["frame"],
-                                       "inner", lk, rk)
+                                       "inner", lk, rk,
+                                       spine=self.spine)
             for (al, pl, ar, pr, c) in edges:
                 if c is not None and {al, ar} <= joined | {a}:
                     consumed.add(id(c))
@@ -659,7 +671,8 @@ class _Exec:
                         "two sides")
                 lk.append(pl)
                 rk.append(pr)
-            current = _merge_null_safe(current, right, how, lk, rk)
+            current = _merge_null_safe(current, right, how, lk, rk,
+                                       spine=self.spine)
             joined.add(a)
             current = apply_eager(current)
 
@@ -783,9 +796,12 @@ class _Exec:
             tmp = result.copy()
             for i, (s, asc) in enumerate(sort_series):
                 tmp[f"__s{i}"] = s.values
-            tmp = _sql_sort(
-                tmp, [f"__s{i}" for i in range(len(sort_series))],
-                [asc for _s, asc in sort_series])
+            scols = [f"__s{i}" for i in range(len(sort_series))]
+            sascs = [asc for _s, asc in sort_series]
+            sorted_dev = (self.spine.sort_frame(tmp, scols, sascs)
+                          if self.spine is not None else None)
+            tmp = sorted_dev if sorted_dev is not None \
+                else _sql_sort(tmp, scols, sascs)
             result = tmp.drop(columns=[f"__s{i}"
                                        for i in range(len(sort_series))])
 
@@ -833,6 +849,10 @@ class _Exec:
         def agg_over(names):
             """Aggregate `work` grouped by the given key columns
             (global single row when empty)."""
+            if names and self.spine is not None:
+                dev = self.spine.groupby(work, names, agg_specs)
+                if dev is not None:
+                    return dev
             if names:
                 gb = work.groupby(names, dropna=False, sort=False)
                 out = gb.size().rename("__size").reset_index()
@@ -1565,6 +1585,13 @@ class _Exec:
                 # SQL default frame with ORDER BY: RANGE UNBOUNDED
                 # PRECEDING..CURRENT ROW — a running aggregate where
                 # order-key peers share the value at their last row
+                if self.spine is not None:
+                    r = self.spine.window_running(
+                        parts, self._order_items(e, df, ev), s, fn,
+                        "rows" if e.frame == "rows" else "range",
+                        df.index)
+                    if r is not None:
+                        return r
                 return self._running_window(e, df, ev, s, fn, parts)
             if not parts:
                 # whole-frame window
@@ -1573,12 +1600,26 @@ class _Exec:
                 else:
                     val = getattr(s, fn)()
                 return pd.Series([val] * len(df), index=df.index)
+            if self.spine is not None:
+                r = self.spine.partition_transform(parts, s, fn)
+                if r is not None:
+                    return r
             grouped = s.groupby([p.values for p in parts], dropna=False)
-            return pd.Series(grouped.transform(fn).values,
+            # min_count=1: SUM over an all-NULL partition is NULL (SQL
+            # semantics, and what the device path returns) — pandas'
+            # default transform("sum") would say 0.0
+            kw = {"min_count": 1} if fn == "sum" else {}
+            return pd.Series(grouped.transform(fn, **kw).values,
                              index=df.index)
         if name in ("rank", "row_number", "dense_rank"):
             if not e.order_by:
                 raise SqlParseError(f"{name}() requires ORDER BY")
+            if self.spine is not None:
+                r = self.spine.window_rank(
+                    parts, self._order_items(e, df, ev), name,
+                    len(df), df.index)
+                if r is not None:
+                    return r
             work = pd.DataFrame(index=pd.RangeIndex(len(df)))
             pcols, ocols, ascs = [], [], []
             for i, p in enumerate(parts):
@@ -1620,6 +1661,18 @@ class _Exec:
             out = ranks.sort_index()
             return pd.Series(out.values, index=df.index)
         raise UnsupportedSqlError(f"unsupported window function {name!r}")
+
+    @staticmethod
+    def _order_items(e: Window, df, ev):
+        """Evaluate a window's ORDER BY into [(Series, asc)] for the
+        device path; scalar exprs broadcast."""
+        items = []
+        for o, asc in e.order_by:
+            s = ev(o)
+            if not isinstance(s, pd.Series):
+                s = pd.Series([s] * len(df), index=df.index)
+            items.append((s, asc))
+        return items
 
     @staticmethod
     def _running_window(e: Window, df, ev, s, fn, parts):

@@ -1,0 +1,106 @@
+"""DeviceSpine bridge regressions (`sqlengine/device.py`): semantics
+where the device path could silently diverge from the pandas parity
+oracle. Corpus-level parity lives in test_tpcds.py (both substrates);
+kernel-level parity in test_sqlops.py."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import delta_tpu.api as dta
+import pyarrow as pa
+from delta_tpu.sqlengine.device import DeviceSpine, spine_for
+
+
+class _F:
+    """Minimal Func stand-in for direct groupby() calls."""
+
+    def __init__(self, name, star=False, distinct=False):
+        self.name = name
+        self.star = star
+        self.distinct = distinct
+        self.args = [None]
+
+
+@pytest.fixture(scope="module")
+def spine():
+    return DeviceSpine()
+
+
+@pytest.mark.parametrize("unit", ["s", "ms", "us", "ns"])
+def test_groupby_datetime_units(spine, unit):
+    # non-ns datetime columns must not leak raw ticks through the
+    # .view("datetime64[ns]") reconstruction
+    dates = np.array(["2020-06-01", "2019-01-02", "2021-03-04"],
+                     dtype=f"datetime64[{unit}]")
+    work = pd.DataFrame({"g": [0, 0, 0], "__arg_k": dates})
+    out = spine.groupby(work, ["g"], {"k": _F("max")})
+    assert pd.Timestamp(out["k"].iloc[0]) == pd.Timestamp("2021-03-04")
+    out = spine.groupby(work, ["g"], {"k": _F("min")})
+    assert pd.Timestamp(out["k"].iloc[0]) == pd.Timestamp("2019-01-02")
+
+
+def test_partition_sum_all_null_is_null(spine):
+    # SQL: SUM over an all-NULL partition is NULL — device returns NaN
+    s = pd.Series([np.nan, np.nan, 1.0])
+    parts = [pd.Series([0, 0, 1])]
+    r = spine.partition_transform(parts, s, "sum")
+    assert np.isnan(r.iloc[0]) and np.isnan(r.iloc[1])
+    assert r.iloc[2] == 1.0
+
+
+def test_window_sum_all_null_parity(tmp_path):
+    # both substrates must agree on the all-NULL-partition window SUM
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.engine.tpu import TpuEngine
+    from delta_tpu.sql import sql
+
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({
+        "g": pa.array([0, 0, 1], pa.int64()),
+        "v": pa.array([None, None, 1.0], pa.float64()),
+    }))
+    q = f"SELECT g, sum(v) OVER (PARTITION BY g) AS s FROM '{p}' ORDER BY g"
+    dev = sql(q, engine=TpuEngine())
+    host = sql(q, engine=HostEngine())
+    assert dev.column("s").to_pylist() == host.column("s").to_pylist() \
+        == [None, None, 1.0]
+
+
+def test_spine_resolution():
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.engine.tpu import TpuEngine
+
+    assert spine_for(TpuEngine()) is not None
+    assert spine_for(HostEngine()) is None
+    assert spine_for(None) is not None  # default engine is TpuEngine
+
+
+def test_spine_env_override(monkeypatch):
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.engine.tpu import TpuEngine
+
+    monkeypatch.setenv("DELTA_TPU_DEVICE_SQL", "0")
+    assert spine_for(TpuEngine()) is None
+    monkeypatch.setenv("DELTA_TPU_DEVICE_SQL", "1")
+    assert spine_for(HostEngine()) is not None
+
+
+def test_merge_null_extension_dtypes(spine):
+    # left-join null extension must upcast like pandas (int -> float)
+    left = pd.DataFrame({"a.k": [1, 2, 3], "a.x": [10, 20, 30]})
+    right = pd.DataFrame({"b.k": [1, 1], "b.y": [5, 6]})
+    out = spine.merge(left, right, "left", ["a.k"], ["b.k"])
+    assert len(out) == 4  # k=1 matches twice, k=2/k=3 null-extended
+    nulls = out[out["b.y"].isna()]
+    assert sorted(nulls["a.k"].tolist()) == [2, 3]
+    ref = left.merge(right, how="left", left_on=["a.k"],
+                     right_on=["b.k"])
+    assert sorted(map(tuple, out.fillna(-1).to_numpy().tolist())) == \
+        sorted(map(tuple, ref.fillna(-1).to_numpy().tolist()))
+
+
+def test_groupby_string_min_falls_back(spine):
+    # object-dtype aggregation is unsupported -> None (pandas handles)
+    work = pd.DataFrame({"g": [0, 1], "__arg_k": ["b", "a"]})
+    assert spine.groupby(work, ["g"], {"k": _F("min")}) is None

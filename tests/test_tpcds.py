@@ -40,6 +40,17 @@ def tpcds(tmp_path_factory):
     return catalog, oracle
 
 
+@pytest.fixture(scope="session")
+def tpcds_host(tpcds):
+    """Same tables through the HostEngine: the pandas relational path
+    (device spine off) — the parity oracle substrate."""
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.engine.host import HostEngine
+
+    catalog, oracle = tpcds
+    return Catalog(catalog.root, engine=HostEngine()), oracle
+
+
 # sqlite's parser overflows on q67's 9-level rollup expansion (the
 # mechanical UNION ALL rewrite exceeds its expression-depth limit);
 # the query still must EXECUTE — it just can't be cross-checked there
@@ -47,9 +58,17 @@ ORACLE_EXEMPT = {"q67": "sqlite parser stack overflow on the 9-key "
                         "rollup expansion"}
 
 
+@pytest.mark.parametrize("substrate", ["device", "host"])
 @pytest.mark.parametrize("name", sorted(QUERIES))
-def test_query_matches_oracle(tpcds, name):
-    catalog, oracle = tpcds
+def test_query_matches_oracle(tpcds, tpcds_host, name, substrate):
+    """Both substrates — the TpuEngine device spine (ops/sqlops
+    kernels for join/group-by/window/sort) and the HostEngine pandas
+    path — must match the independent sqlite oracle on every query."""
+    catalog, oracle = tpcds if substrate == "device" else tpcds_host
+    if substrate == "device":
+        from delta_tpu.sqlengine.device import spine_for
+
+        assert spine_for(None, catalog) is not None
     if name in ORACLE_EXEMPT:
         out = execute_select(_strip_limit(QUERIES[name]),
                              catalog=catalog)
