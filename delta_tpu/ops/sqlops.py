@@ -152,6 +152,7 @@ class GroupAggregator:
         self.n_groups = int(n_groups)
         self.n_seg = pad_bucket(self.n_groups + 1, min_bucket=256)
         self.npad = pad_bucket(max(self.n, 1))
+        self._codes_np = np.asarray(codes)  # host copy for reuse
         padded = np.full(self.npad, self.n_seg - 1, np.int32)
         padded[:self.n] = codes
         self.device = device
@@ -213,7 +214,7 @@ class GroupAggregator:
         """COUNT(DISTINCT x) per group: device-sort (group, value)
         pairs, count run boundaries per group."""
         vc = np.asarray(value_codes, np.int64)
-        g = np.asarray(self.codes)[:self.n].astype(np.int64)
+        g = self._codes_np.astype(np.int64)  # no D2H round-trip
         keep = np.asarray(valid, bool)
         g, vc = g[keep], vc[keep]
         m = len(g)

@@ -274,15 +274,15 @@ class DeviceSpine:
                 agg = np.where(cnt > 0, sm / np.maximum(cnt, 1), np.nan)
             return pd.Series(agg[codes], index=s.index)
         agg, cnt = ga.reduce(v, valid, fn)
-        res = agg.astype(np.float64) if kind != "datetime" else agg
-        out = res[codes].astype(np.float64) if kind != "datetime" \
-            else agg[codes].view("datetime64[ns]")
+        empty = cnt[codes] == 0
         if kind == "datetime":
-            out = out.copy()
-            out[cnt[codes] == 0] = np.datetime64("NaT")
-            return pd.Series(out, index=s.index)
-        out = out.copy()
-        out[cnt[codes] == 0] = np.nan
+            out = agg[codes].view("datetime64[ns]").copy()
+            out[empty] = np.datetime64("NaT")
+        elif kind == "int" and not empty.any():
+            out = agg[codes]  # keep int64 (exact, schema-parity)
+        else:
+            out = agg[codes].astype(np.float64)
+            out[empty] = np.nan
         return pd.Series(out, index=s.index)
 
     def _window_order(self, parts: List[pd.Series],
@@ -345,8 +345,8 @@ class DeviceSpine:
             return None
         perm, pb, kb = pre
         vals, cnts = sqlops.window_running(
-            np.asarray(v, np.float64)[perm], valid[perm], pb,
-            {"mean": "mean"}.get(fn, fn), device=self.device)
+            np.asarray(v, np.float64)[perm], valid[perm], pb, fn,
+            device=self.device)
         if frame_kind == "range":
             vals, cnts = sqlops.window_peer_last(vals, cnts, kb,
                                                  device=self.device)

@@ -861,6 +861,23 @@ class _Exec:
                         out[k] = gb.size().values
                         continue
                     col = f"__arg_{k}"
+                    if f.distinct and f.name != "count":
+                        # sum(DISTINCT x) etc.: dedupe per group first
+                        # (silently dropping the flag would return the
+                        # plain aggregate — wrong answers)
+                        dd = work[names + [col]].drop_duplicates()
+                        dgb = dd.groupby(names, dropna=False,
+                                         sort=False)[col]
+                        agg = {"sum": lambda g: g.sum(min_count=1),
+                               "avg": "mean", "min": "min",
+                               "max": "max", "stddev_samp": "std",
+                               "var_samp": "var"}[f.name]
+                        vals = (dgb.agg(agg) if callable(agg)
+                                else getattr(dgb, agg)())
+                        # align to the gb group order
+                        order = gb.size().index
+                        out[k] = vals.reindex(order).values
+                        continue
                     if f.name == "count" and f.distinct:
                         vals = gb[col].nunique()
                     elif f.name == "count":
@@ -885,6 +902,8 @@ class _Exec:
                     row[k] = len(work)
                     continue
                 s = work[f"__arg_{k}"]
+                if f.distinct and f.name != "count":
+                    s = s.drop_duplicates()
                 if f.name == "count" and f.distinct:
                     row[k] = s.nunique()
                 elif f.name == "count":

@@ -1,17 +1,35 @@
-"""Window-function semantics (round-4 sqlengine surface).
+"""Window-function semantics, one named test per function family
+(VERDICT r4 ask #9), each run on BOTH substrates — the TpuEngine
+device spine (`ops/sqlops.py` window kernels) and the HostEngine
+pandas path.
 
-Partition-only aggregates, the SQL default running RANGE frame when
-ORDER BY is present, rank/row_number/dense_rank, and windows over
-aggregated results (the TPC-DS q12/q53/q98 shapes — those queries are
-oracle-validated end-to-end in test_tpcds.py; these pin the primitive
-semantics)."""
+Families: partition-only aggregates, whole-frame windows, the SQL
+default running RANGE frame (peer sharing) for sum/avg/min/max/count,
+explicit ROWS frames, rank/row_number/dense_rank (ties, partitions,
+multi-key order), null ordering per key (Spark: NULLS FIRST asc,
+NULLS LAST desc), nulls in aggregated values, windows over aggregated
+results, and the error paths. The TPC-DS windowed queries
+(q47/q51/q53/q57/q63/q89...) are oracle-validated end-to-end in
+test_tpcds.py; these pin the primitive semantics."""
 
+import numpy as np
 import pyarrow as pa
 import pytest
 
 import delta_tpu.api as dta
 from delta_tpu.errors import DeltaError
-from delta_tpu.sql import sql
+from delta_tpu.sql import sql as _sql
+
+
+@pytest.fixture(params=["device", "host"])
+def eng(request):
+    if request.param == "device":
+        from delta_tpu.engine.tpu import TpuEngine
+
+        return TpuEngine()
+    from delta_tpu.engine.host import HostEngine
+
+    return HostEngine()
 
 
 @pytest.fixture
@@ -24,62 +42,245 @@ def path(tmp_table_path):
     return tmp_table_path
 
 
-def test_partition_aggregate(path):
-    out = sql(f"SELECT g, v, sum(v) OVER (PARTITION BY g) t "
-              f"FROM '{path}' ORDER BY g, o, v")
+@pytest.fixture
+def nullpath(tmp_table_path):
+    dta.write_table(tmp_table_path, pa.table({
+        "g": pa.array(["a", "a", "a", "b", "b", "b"]),
+        "o": pa.array([1, None, 3, None, 2, 1], pa.int64()),
+        "v": pa.array([10.0, 20.0, None, 5.0, None, 7.0]),
+    }))
+    return tmp_table_path
+
+
+# ---- partition / whole-frame aggregates -----------------------------
+
+def test_partition_aggregate(path, eng):
+    out = _sql(f"SELECT g, v, sum(v) OVER (PARTITION BY g) t "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
     assert out.column("t").to_pylist() == [60.0, 60.0, 60.0, 12.0, 12.0]
 
 
-def test_whole_frame_window(path):
-    out = sql(f"SELECT v, avg(v) OVER () a FROM '{path}' ORDER BY v")
+@pytest.mark.parametrize("fn,expect_a,expect_b", [
+    ("min", 10.0, 5.0), ("max", 30.0, 7.0), ("avg", 20.0, 6.0),
+])
+def test_partition_min_max_avg(path, eng, fn, expect_a, expect_b):
+    out = _sql(f"SELECT g, {fn}(v) OVER (PARTITION BY g) t "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    assert out.column("t").to_pylist() == [expect_a] * 3 + [expect_b] * 2
+
+
+def test_partition_count_skips_nulls(nullpath, eng):
+    out = _sql(f"SELECT g, count(v) OVER (PARTITION BY g) c "
+               f"FROM '{nullpath}' ORDER BY g", engine=eng)
+    assert out.column("c").to_pylist() == [2, 2, 2, 2, 2, 2]
+
+
+def test_partition_count_star(path, eng):
+    out = _sql(f"SELECT g, count(*) OVER (PARTITION BY g) c "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    assert out.column("c").to_pylist() == [3, 3, 3, 2, 2]
+
+
+def test_whole_frame_window(path, eng):
+    out = _sql(f"SELECT v, avg(v) OVER () a FROM '{path}' ORDER BY v",
+               engine=eng)
     assert out.column("a").to_pylist() == [14.4] * 5
 
 
-def test_running_sum_range_frame(path):
+def test_partition_sum_all_null_is_null(nullpath, eng):
+    # SQL: SUM over only NULLs is NULL (both substrates agree)
+    out = _sql(f"SELECT o, sum(v) OVER (PARTITION BY o) s "
+               f"FROM '{nullpath}' WHERE o = 3", engine=eng)
+    assert out.column("s").to_pylist() == [None]
+
+
+# ---- running frames (ORDER BY in the window) ------------------------
+
+def test_running_sum_range_frame(path, eng):
     # ORDER BY without explicit frame = RANGE UNBOUNDED..CURRENT ROW:
     # order-key peers share the value at their last peer row
-    out = sql(f"SELECT o, sum(v) OVER (PARTITION BY g ORDER BY o) c "
-              f"FROM '{path}' ORDER BY g, o, v")
+    out = _sql(f"SELECT o, sum(v) OVER (PARTITION BY g ORDER BY o) c "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
     assert out.column("c").to_pylist() == [10.0, 60.0, 60.0, 5.0, 12.0]
 
 
-def test_rank_and_row_number(path):
-    out = sql(f"SELECT g, v, "
-              f"rank() OVER (PARTITION BY g ORDER BY v DESC) r "
-              f"FROM '{path}' ORDER BY g, v")
+def test_running_rows_frame_no_peer_sharing(path, eng):
+    out = _sql(
+        f"SELECT o, sum(v) OVER (PARTITION BY g ORDER BY o, v "
+        f"ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) c "
+        f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    assert out.column("c").to_pylist() == [10.0, 30.0, 60.0, 5.0, 12.0]
+
+
+@pytest.mark.parametrize("fn,expect", [
+    ("min", [10.0, 10.0, 10.0, 5.0, 5.0]),
+    ("max", [10.0, 30.0, 30.0, 5.0, 7.0]),
+    ("count", [1, 3, 3, 1, 2]),
+])
+def test_running_min_max_count(path, eng, fn, expect):
+    out = _sql(f"SELECT o, {fn}(v) OVER (PARTITION BY g ORDER BY o) c "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    assert out.column("c").to_pylist() == expect
+
+
+def test_running_avg(path, eng):
+    out = _sql(f"SELECT o, avg(v) OVER (PARTITION BY g ORDER BY o) c "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    assert out.column("c").to_pylist() == [10.0, 20.0, 20.0, 5.0, 6.0]
+
+
+def test_running_without_partition(path, eng):
+    out = _sql(f"SELECT v, sum(v) OVER (ORDER BY v) c "
+               f"FROM '{path}' ORDER BY v", engine=eng)
+    assert out.column("c").to_pylist() == [5.0, 12.0, 22.0, 42.0, 72.0]
+
+
+def test_running_null_values_carry(nullpath, eng):
+    # NULL values don't contribute but the running value carries
+    out = _sql(f"SELECT o, sum(v) OVER (PARTITION BY g ORDER BY o) c "
+               f"FROM '{nullpath}' WHERE g = 'b' AND o IS NOT NULL "
+               f"ORDER BY o", engine=eng)
+    assert out.column("c").to_pylist() == [7.0, 7.0]
+
+
+# ---- rank family ----------------------------------------------------
+
+def test_rank_and_row_number(path, eng):
+    out = _sql(f"SELECT g, v, "
+               f"rank() OVER (PARTITION BY g ORDER BY v DESC) r "
+               f"FROM '{path}' ORDER BY g, v", engine=eng)
     assert out.column("r").to_pylist() == [3, 2, 1, 2, 1]
-    out = sql(f"SELECT o, row_number() OVER (ORDER BY o) rn "
-              f"FROM '{path}' WHERE g = 'a' ORDER BY o, rn")
+    out = _sql(f"SELECT o, row_number() OVER (ORDER BY o) rn "
+               f"FROM '{path}' WHERE g = 'a' ORDER BY o, rn",
+               engine=eng)
     assert out.column("rn").to_pylist() == [1, 2, 3]
 
 
-def test_rank_ties_share_min_position(tmp_table_path):
+def test_rank_ties_share_min_position(tmp_table_path, eng):
     dta.write_table(tmp_table_path, pa.table({
         "v": pa.array([1, 2, 2, 3], pa.int64()),
     }))
-    out = sql(f"SELECT v, rank() OVER (ORDER BY v) r, "
-              f"dense_rank() OVER (ORDER BY v) d "
-              f"FROM '{tmp_table_path}' ORDER BY v")
+    out = _sql(f"SELECT v, rank() OVER (ORDER BY v) r, "
+               f"dense_rank() OVER (ORDER BY v) d "
+               f"FROM '{tmp_table_path}' ORDER BY v", engine=eng)
     assert out.column("r").to_pylist() == [1, 2, 2, 4]
     assert out.column("d").to_pylist() == [1, 2, 2, 3]
 
 
-def test_window_over_aggregate(path):
+def test_dense_rank_with_partitions(path, eng):
+    out = _sql(f"SELECT g, o, dense_rank() OVER "
+               f"(PARTITION BY g ORDER BY o) d "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    assert out.column("d").to_pylist() == [1, 2, 2, 1, 2]
+
+
+def test_rank_multi_key_order(path, eng):
+    out = _sql(f"SELECT g, o, v, row_number() OVER "
+               f"(PARTITION BY g ORDER BY o ASC, v DESC) rn "
+               f"FROM '{path}' ORDER BY g, o, v", engine=eng)
+    # within g='a': (1,10)->1, (2,30)->2, (2,20)->3
+    assert out.column("rn").to_pylist() == [1, 3, 2, 1, 2]
+
+
+def test_rank_larger_scale_parity(tmp_table_path):
+    # device vs host on 10k rows with ties — catches boundary bugs
+    # the 5-row fixtures can't
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.engine.tpu import TpuEngine
+
+    rng = np.random.default_rng(3)
+    n = 10_000
+    dta.write_table(tmp_table_path, pa.table({
+        "p": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "k": pa.array(rng.integers(0, 30, n), pa.int64()),
+    }))
+    q = (f"SELECT p, k, rank() OVER (PARTITION BY p ORDER BY k) r, "
+         f"dense_rank() OVER (PARTITION BY p ORDER BY k) d, "
+         f"row_number() OVER (PARTITION BY p ORDER BY k) rn "
+         f"FROM '{tmp_table_path}' ORDER BY p, k, rn")
+    a = _sql(q, engine=TpuEngine())
+    b = _sql(q, engine=HostEngine())
+    assert a.column("r").to_pylist() == b.column("r").to_pylist()
+    assert a.column("d").to_pylist() == b.column("d").to_pylist()
+    assert a.column("rn").to_pylist() == b.column("rn").to_pylist()
+
+
+# ---- null ordering per key (Spark rule) -----------------------------
+
+def test_window_order_nulls_first_asc(nullpath, eng):
+    # Spark: ascending ORDER BY puts NULLs FIRST -> they rank 1
+    out = _sql(f"SELECT g, o, row_number() OVER "
+               f"(PARTITION BY g ORDER BY o) rn "
+               f"FROM '{nullpath}' WHERE g = 'b' ORDER BY rn",
+               engine=eng)
+    assert out.column("o").to_pylist() == [None, 1, 2]
+    assert out.column("rn").to_pylist() == [1, 2, 3]
+
+
+def test_window_order_nulls_last_desc(nullpath, eng):
+    out = _sql(f"SELECT g, o, row_number() OVER "
+               f"(PARTITION BY g ORDER BY o DESC) rn "
+               f"FROM '{nullpath}' WHERE g = 'b' ORDER BY rn",
+               engine=eng)
+    assert out.column("o").to_pylist() == [2, 1, None]
+    assert out.column("rn").to_pylist() == [1, 2, 3]
+
+
+def test_rank_null_keys_are_peers(nullpath, eng):
+    # two NULL order keys in one partition tie (rank peers)
+    out = _sql(f"SELECT rank() OVER (ORDER BY v) r FROM '{nullpath}' "
+               f"WHERE g = 'b' ORDER BY r", engine=eng)
+    assert out.column("r").to_pylist() == [1, 2, 3]
+
+
+# ---- windows over aggregates / string partitions --------------------
+
+def test_window_over_aggregate(path, eng):
     # q12/q98 shape: sum(sum(x)) over (partition by ...)
-    out = sql(f"SELECT g, o, sum(v) s, "
-              f"sum(v)*100/sum(sum(v)) OVER (PARTITION BY g) pct "
-              f"FROM '{path}' GROUP BY g, o ORDER BY g, o")
+    out = _sql(f"SELECT g, o, sum(v) s, "
+               f"sum(v)*100/sum(sum(v)) OVER (PARTITION BY g) pct "
+               f"FROM '{path}' GROUP BY g, o ORDER BY g, o",
+               engine=eng)
     pct = out.column("pct").to_pylist()
     assert pct[0] == pytest.approx(100 * 10 / 60)
     assert pct[1] == pytest.approx(100 * 50 / 60)
 
 
-def test_distinct_in_window_rejected(path):
+def test_window_order_by_string_key(path, eng):
+    out = _sql(f"SELECT g, row_number() OVER (ORDER BY g DESC, o, v) rn "
+               f"FROM '{path}' ORDER BY rn", engine=eng)
+    assert out.column("g").to_pylist() == ["b", "b", "a", "a", "a"]
+
+
+# ---- error paths ----------------------------------------------------
+
+def test_distinct_in_window_rejected(path, eng):
     with pytest.raises(DeltaError, match="DISTINCT"):
-        sql(f"SELECT count(DISTINCT v) OVER (PARTITION BY g) "
-            f"FROM '{path}'")
+        _sql(f"SELECT count(DISTINCT v) OVER (PARTITION BY g) "
+             f"FROM '{path}'", engine=eng)
 
 
-def test_window_rank_requires_order(path):
+def test_window_rank_requires_order(path, eng):
     with pytest.raises(DeltaError, match="ORDER BY"):
-        sql(f"SELECT rank() OVER (PARTITION BY g) FROM '{path}'")
+        _sql(f"SELECT rank() OVER (PARTITION BY g) FROM '{path}'",
+             engine=eng)
+
+
+def test_partition_int_sum_keeps_int_schema(tmp_table_path):
+    # int64 in -> int64 out on BOTH substrates (schema parity)
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.engine.tpu import TpuEngine
+
+    dta.write_table(tmp_table_path, pa.table({
+        "g": pa.array([0, 0, 1], pa.int64()),
+        "i": pa.array([1, 2, 3], pa.int64()),
+    }))
+    q = (f"SELECT g, sum(i) OVER (PARTITION BY g) s, "
+         f"min(i) OVER (PARTITION BY g) m FROM '{tmp_table_path}' "
+         f"ORDER BY g, i")
+    a = _sql(q, engine=TpuEngine())
+    b = _sql(q, engine=HostEngine())
+    assert a.schema.field("s").type == b.schema.field("s").type
+    assert a.schema.field("m").type == b.schema.field("m").type
+    assert a.column("s").to_pylist() == [3, 3, 3]
+    assert a.column("m").to_pylist() == [1, 1, 3]

@@ -506,3 +506,21 @@ def test_fast_path_case_insensitive_projection(t):
     assert out.column(1).to_pylist() == [20.0]
     out2 = sql(f"SELECT Id FROM '{t}' WHERE ID = 3")
     assert out2.column(0).to_pylist() == [3]
+
+
+def test_distinct_aggregates_not_just_count(tmp_path):
+    # sum/avg(DISTINCT x) must dedupe, not silently run the plain agg
+    p = str(tmp_path / "dups")
+    dta.write_table(p, pa.table({
+        "v": pa.array([10.0, 10.0, 30.0]),
+    }))
+    out = sql(f"SELECT sum(DISTINCT v), avg(DISTINCT v) FROM '{p}'")
+    assert out.column(0).to_pylist() == [40.0]
+    assert out.column(1).to_pylist() == [20.0]
+
+
+def test_distinct_sum_grouped(t):
+    out = sql(f"SELECT id IS NULL k, sum(DISTINCT v) s FROM '{t}' "
+              f"GROUP BY id IS NULL ORDER BY k")
+    # ids 1-4 have v 10..40 (distinct); null id has v 50
+    assert out.column("s").to_pylist() == [100.0, 50.0]
