@@ -61,11 +61,76 @@ def _walk_table_files(table_path: str):
             yield abs_path, rel.replace(os.sep, "/"), mtime
 
 
+INVENTORY_COLUMNS = ("path", "length", "isDir", "modificationTime")
+
+
+def _inventory_files(table_path: str, inventory):
+    """Yield (abs_path, rel_path, mtime_ms) from a pre-computed
+    inventory instead of listing (`VacuumCommand.scala:59` USING
+    INVENTORY; required schema `VacuumCommand.scala:69`: path, length,
+    isDir, modificationTime). Accepts a pyarrow Table or pandas
+    DataFrame; paths may be absolute or table-relative, and rows
+    outside the table root or under hidden dirs are ignored exactly
+    like the listing path would."""
+    import pyarrow as pa
+
+    if isinstance(inventory, pa.Table):
+        cols = set(inventory.column_names)
+    else:
+        cols = set(getattr(inventory, "columns", ()))
+    missing = [c for c in INVENTORY_COLUMNS if c not in cols]
+    if missing:
+        raise DeltaError(
+            f"invalid inventory schema: missing column(s) {missing}; "
+            f"required: {list(INVENTORY_COLUMNS)}")
+    if isinstance(inventory, pa.Table):
+        rows = zip(inventory.column("path").to_pylist(),
+                   inventory.column("isDir").to_pylist(),
+                   inventory.column("modificationTime").to_pylist())
+    else:
+        rows = zip(inventory["path"].tolist(),
+                   inventory["isDir"].tolist(),
+                   inventory["modificationTime"].tolist())
+    import math
+    import posixpath
+
+    base = table_path.rstrip("/")
+    for path, is_dir, mtime in rows:
+        if is_dir or path is None:
+            continue
+        if path.startswith(base + "/"):
+            rel = path[len(base) + 1:]
+        elif "://" in path or path.startswith("/"):
+            continue  # outside the table root
+        else:
+            rel = path
+        # canonicalize: '..' segments could escape the table root
+        # (unlinking arbitrary files) or alias a live file past the
+        # string-keyed protected-set check — the listing path can
+        # never produce them, so reject rather than resolve upward
+        rel = posixpath.normpath(rel.replace(os.sep, "/"))
+        if rel.startswith("..") or rel.startswith("/") or rel == ".":
+            continue
+        top = rel.split("/", 1)[0]
+        if _is_hidden(top) and top != filenames.CHANGE_DATA_DIR:
+            continue
+        if _is_hidden(rel.rsplit("/", 1)[-1]):
+            continue
+        if mtime is None or (isinstance(mtime, float)
+                             and math.isnan(mtime)):
+            # unknown age: skip, like the in-flight-txn stance —
+            # an epoch-0 default would make it an unconditional
+            # deletion candidate
+            continue
+        yield os.path.join(base, rel), rel, int(mtime)
+
+
 def vacuum(
     table,
     retention_hours: Optional[float] = None,
     dry_run: bool = False,
     enforce_retention_check: bool = True,
+    inventory=None,
 ) -> VacuumResult:
     snapshot = table.latest_snapshot()
     state = snapshot.state
@@ -105,7 +170,10 @@ def vacuum(
 
     result = VacuumResult(dry_run=dry_run)
     doomed: List[str] = []
-    for abs_path, rel, mtime in _walk_table_files(table.path):
+    candidates = (_inventory_files(table.path, inventory)
+                  if inventory is not None
+                  else _walk_table_files(table.path))
+    for abs_path, rel, mtime in candidates:
         if rel in protected:
             continue
         if mtime >= cutoff:

@@ -170,10 +170,12 @@ class Table:
 
         return get_history(self, limit)
 
-    def vacuum(self, retention_hours: Optional[float] = None, dry_run: bool = False):
+    def vacuum(self, retention_hours: Optional[float] = None,
+               dry_run: bool = False, inventory=None):
         from delta_tpu.commands.vacuum import vacuum
 
-        return vacuum(self, retention_hours=retention_hours, dry_run=dry_run)
+        return vacuum(self, retention_hours=retention_hours,
+                      dry_run=dry_run, inventory=inventory)
 
     def optimize(self):
         from delta_tpu.commands.optimize import OptimizeBuilder

@@ -113,9 +113,9 @@ class DeltaTable:
 
     # -- maintenance ---------------------------------------------------
     def vacuum(self, retentionHours: Optional[float] = None,
-               dryRun: bool = False):
+               dryRun: bool = False, inventory=None):
         return self._table.vacuum(retention_hours=retentionHours,
-                                  dry_run=dryRun)
+                                  dry_run=dryRun, inventory=inventory)
 
     def optimize(self) -> "DeltaOptimizeBuilder":
         return DeltaOptimizeBuilder(self._table.optimize())

@@ -81,6 +81,7 @@ class TransactionReadState:
     protocol_changed: bool = False
     partition_columns: List[str] = field(default_factory=list)
     isolation: IsolationLevel = IsolationLevel.WRITE_SERIALIZABLE
+    metadata: Optional[Metadata] = None  # for column-mapping-aware stats
 
 
 def read_winning_commits(fs, log_path: str, from_version: int, to_version: int) -> List[WinningCommit]:
@@ -91,36 +92,67 @@ def read_winning_commits(fs, log_path: str, from_version: int, to_version: int) 
     return out
 
 
-def _add_matches_predicates(add: AddFile, state: TransactionReadState) -> bool:
-    """Could this added file have matched any of our read predicates?
-    Partition-only conjuncts are evaluated exactly against the file's
-    partitionValues; anything else conservatively matches (the reference
-    evaluates against stats when available, else conservatively)."""
+def _matching_adds(adds: Sequence[AddFile],
+                   state: TransactionReadState):
+    """Boolean may-match mask over the winner's AddFiles, evaluated
+    VECTORIZED per conjunct (one `skipping_mask` / partition-batch
+    call over all files — `ConflictChecker.scala:584` consults the
+    same skipping index on the winner's files DataFrame).
+
+    Per predicate (a conjunction): any conjunct DISPROVED — exactly,
+    against partitionValues, for partition-only conjuncts; via
+    min/max/nullCount stats for data conjuncts — disproves the whole
+    predicate for that file. A file may-match only if no conjunct of
+    some read predicate is disproved for it. Unevaluable conjuncts
+    widen to true (`ConflictCheckerPredicateElimination.scala:30`
+    semantics: dropping a conjunct only over-approximates the match
+    set — the safe direction). Missing stats keep the file
+    (conservative)."""
+    import numpy as np
+
+    n = len(adds)
     if state.read_whole_table:
-        return True
+        return np.ones(n, bool)
     if not state.read_predicates:
-        return False
+        return np.zeros(n, bool)
     import pyarrow as pa
 
     from delta_tpu.expressions.eval import evaluate_predicate_host
     from delta_tpu.stats.partition import partition_values_to_batch
+    from delta_tpu.stats.skipping import skipping_mask
 
     pcols = set(state.partition_columns)
+    pbatch = None
+    stats_files = pa.table({
+        "stats": pa.array([a.stats for a in adds], pa.string())})
+
+    may = np.zeros(n, bool)
     for pred in state.read_predicates:
+        alive = np.ones(n, bool)
         for conj in split_conjuncts(pred):
             refs = conj.references()
             if refs and all(r[0] in pcols for r in refs):
-                batch = partition_values_to_batch(
-                    [add.partitionValues], state.partition_columns
-                )
+                if pbatch is None:
+                    pbatch = partition_values_to_batch(
+                        [a.partitionValues for a in adds],
+                        state.partition_columns)
                 try:
-                    if bool(evaluate_predicate_host(conj, batch)[0]):
-                        return True
+                    res = np.asarray(
+                        evaluate_predicate_host(conj, pbatch),
+                        dtype=bool)
+                    alive &= res
                 except Exception:
-                    return True  # can't evaluate exactly -> conservative
+                    pass  # can't evaluate exactly -> widen to true
             else:
-                return True  # non-partition predicate: can't disprove overlap
-    return False
+                try:
+                    alive &= skipping_mask(stats_files, [conj],
+                                           state.metadata)
+                except Exception:
+                    pass  # unevaluable -> widen to true
+        may |= alive
+        if may.all():
+            break
+    return may
 
 
 def check_conflicts(
@@ -134,6 +166,8 @@ def check_conflicts(
     rebase_row_watermark: List[int] = []
     for w in winners:
         blind = w.is_blind_append
+        # check order per the module docstring: protocol, metadata,
+        # then appends (batched), then the per-action checks
         for a in w.actions:
             if isinstance(a, Protocol):
                 raise ProtocolChangedError(
@@ -143,16 +177,23 @@ def check_conflicts(
                 raise MetadataChangedError(
                     f"metadata changed by concurrent commit {w.version}"
                 )
-            if isinstance(a, AddFile):
-                check_appends = (
-                    state.isolation == IsolationLevel.SERIALIZABLE
-                    or (state.isolation == IsolationLevel.WRITE_SERIALIZABLE and not blind)
+        check_appends = (
+            state.isolation == IsolationLevel.SERIALIZABLE
+            or (state.isolation == IsolationLevel.WRITE_SERIALIZABLE
+                and not blind)
+        )
+        adds = [a for a in w.actions if isinstance(a, AddFile)] \
+            if check_appends else []
+        if adds:
+            mask = _matching_adds(adds, state)
+            if mask.any():
+                first = adds[int(mask.argmax())]
+                raise ConcurrentAppendError(
+                    f"files added by concurrent commit {w.version} may "
+                    f"match this transaction's read predicate: "
+                    f"{first.path}"
                 )
-                if check_appends and _add_matches_predicates(a, state):
-                    raise ConcurrentAppendError(
-                        f"files added by concurrent commit {w.version} may "
-                        f"match this transaction's read predicate: {a.path}"
-                    )
+        for a in w.actions:
             if isinstance(a, RemoveFile):
                 key = (a.path, a.dv_unique_id)
                 if key in state.read_files:

@@ -508,6 +508,7 @@ class Transaction:
             protocol_changed=self._new_protocol is not None,
             partition_columns=list(meta.partitionColumns) if meta else [],
             isolation=self._isolation_level(),
+            metadata=meta,
         )
 
     def _coordinator(self):

@@ -168,3 +168,120 @@ def test_cdc_files_written(tmp_table_path):
     cdc_dir = os.path.join(tmp_table_path, "_change_data")
     assert os.path.isdir(cdc_dir)
     assert len(os.listdir(cdc_dir)) == 1
+
+
+def test_vacuum_with_inventory(tmp_table_path):
+    """VacuumCommand.scala:59 USING INVENTORY role: a pre-computed
+    file inventory replaces the recursive listing."""
+    import pyarrow as pa
+
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    delete(table, col("id") < lit(100))
+    listed = vacuum(table, retention_hours=0, dry_run=True)
+    assert listed.num_deleted == 1
+
+    # inventory covering the whole table dir (absolute paths)
+    rows = []
+    for root, _, files in os.walk(tmp_table_path):
+        for f in files:
+            p = os.path.join(root, f)
+            rows.append((p, os.path.getsize(p), False,
+                         int(os.stat(p).st_mtime * 1000)))
+    inv = pa.table({
+        "path": pa.array([r[0] for r in rows]),
+        "length": pa.array([r[1] for r in rows], pa.int64()),
+        "isDir": pa.array([r[2] for r in rows]),
+        "modificationTime": pa.array([r[3] for r in rows], pa.int64()),
+    })
+    res = vacuum(table, retention_hours=0, dry_run=True, inventory=inv)
+    assert sorted(res.files_deleted) == sorted(listed.files_deleted)
+
+    # a partial inventory deletes only what it covers
+    doomed_rel = listed.files_deleted[0]
+    partial = inv.filter(pa.compute.invert(pa.compute.match_substring(
+        inv.column("path"), doomed_rel)))
+    res2 = vacuum(table, retention_hours=0, dry_run=True,
+                  inventory=partial)
+    assert res2.num_deleted == 0
+
+    # _delta_log rows in the inventory are never candidates
+    res3 = vacuum(table, retention_hours=0, inventory=inv)
+    assert sorted(res3.files_deleted) == sorted(listed.files_deleted)
+    assert os.path.isdir(os.path.join(tmp_table_path, "_delta_log"))
+    out = dta.read_table(tmp_table_path)
+    assert out.num_rows == 100
+
+
+def test_vacuum_inventory_schema_validated(tmp_table_path):
+    import pyarrow as pa
+    import pytest
+
+    from delta_tpu.errors import DeltaError
+
+    table = _mk_table(tmp_table_path, n=10, n_commits=1)
+    bad = pa.table({"path": pa.array(["x"]),
+                    "length": pa.array([1], pa.int64())})
+    with pytest.raises(DeltaError, match="inventory schema"):
+        vacuum(table, retention_hours=0, inventory=bad)
+
+
+def test_vacuum_inventory_pandas_frame(tmp_table_path):
+    import pandas as pd
+
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    delete(table, col("id") < lit(100))
+    listed = vacuum(table, retention_hours=0, dry_run=True)
+    inv = pd.DataFrame({
+        "path": listed.files_deleted,  # table-relative paths
+        "length": [1] * len(listed.files_deleted),
+        "isDir": [False] * len(listed.files_deleted),
+        "modificationTime": [0] * len(listed.files_deleted),
+    })
+    res = vacuum(table, retention_hours=0, dry_run=True, inventory=inv)
+    assert sorted(res.files_deleted) == sorted(listed.files_deleted)
+
+
+def test_vacuum_inventory_rejects_path_traversal(tmp_table_path, tmp_path):
+    """'..' segments must neither escape the table root nor alias a
+    live file past the protected-set check."""
+    import pyarrow as pa
+
+    table = _mk_table(tmp_table_path, n=100, n_commits=1)
+    victim = tmp_path / "outside.txt"
+    victim.write_text("precious")
+    os.utime(victim, (0, 0))
+    live = dta.read_table(tmp_table_path)  # table intact before
+    live_file = [f for f in os.listdir(tmp_table_path)
+                 if f.endswith(".parquet")][0]
+    inv = pa.table({
+        "path": pa.array([
+            f"{tmp_table_path}/data/../../{victim.name}",
+            f"{tmp_table_path}/x/../{live_file}",  # alias of live file
+            "sub/../../../etc/hosts",
+        ]),
+        "length": pa.array([1, 1, 1], pa.int64()),
+        "isDir": pa.array([False, False, False]),
+        "modificationTime": pa.array([0, 0, 0], pa.int64()),
+    })
+    res = vacuum(table, retention_hours=0, inventory=inv)
+    assert res.num_deleted == 0
+    assert victim.exists()
+    assert os.path.exists(os.path.join(tmp_table_path, live_file))
+    assert dta.read_table(tmp_table_path).num_rows == live.num_rows
+
+
+def test_vacuum_inventory_null_mtime_is_skipped(tmp_table_path):
+    import pyarrow as pa
+
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    delete(table, col("id") < lit(100))
+    listed = vacuum(table, retention_hours=0, dry_run=True)
+    inv = pa.table({
+        "path": pa.array(listed.files_deleted),
+        "length": pa.array([1] * len(listed.files_deleted), pa.int64()),
+        "isDir": pa.array([False] * len(listed.files_deleted)),
+        "modificationTime": pa.array([None] * len(listed.files_deleted),
+                                     pa.int64()),
+    })
+    res = vacuum(table, retention_hours=0, dry_run=True, inventory=inv)
+    assert res.num_deleted == 0  # unknown age: conservative skip
