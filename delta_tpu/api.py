@@ -54,10 +54,12 @@ def write_table(
 
     if (overwrite_schema or replace_where is not None) and mode != "overwrite":
         raise InvalidArgumentError(
-            "overwrite_schema/replace_where require mode='overwrite'")
+            "overwrite_schema/replace_where require mode='overwrite'",
+            error_class="DELTA_ILLEGAL_USAGE")
     if overwrite_schema and replace_where is not None:
         raise InvalidArgumentError(
-            "overwrite_schema cannot be combined with replace_where")
+            "overwrite_schema cannot be combined with replace_where",
+            error_class="DELTA_ILLEGAL_USAGE")
 
     builder = table.create_transaction_builder(
         Operation.WRITE if exists else Operation.CREATE_TABLE
@@ -144,7 +146,7 @@ def write_table(
         if unknown:
             raise UnresolvedColumnError(
                 f"replace_where references column(s) {unknown} not in the "
-                "table schema")
+                "table schema", error_class="DELTA_CANNOT_RESOLVE_COLUMN")
         # predicate columns absent from the written batch read as NULL
         # (which never satisfies the predicate -> clean violation error,
         # not a KeyError)
@@ -158,7 +160,8 @@ def write_table(
         if not bool(matches.all()):
             raise InvariantViolationError(
                 "replace_where: written data contains rows that do "
-                "not match the predicate")
+                "not match the predicate",
+                error_class="DELTA_REPLACE_WHERE_MISMATCH")
 
     if exists and mode == "overwrite":
         if replace_where is not None:
@@ -216,6 +219,12 @@ def read_table(
     engine=None,
 ) -> pa.Table:
     table = Table.for_path(path, engine)
+    if version is not None and timestamp_ms is not None:
+        from delta_tpu.errors import TimeTravelArgumentError
+
+        raise TimeTravelArgumentError(
+            "provide either version or timestamp_ms, not both",
+            error_class="DELTA_ONEOF_IN_TIMETRAVEL")
     if version is not None:
         snap = table.snapshot_at(version)
     elif timestamp_ms is not None:

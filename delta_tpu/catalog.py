@@ -42,7 +42,8 @@ class Catalog:
 
     def _entry_path(self, name: str) -> str:
         if not _NAME_RE.match(name):
-            raise InvalidArgumentError(f"invalid table name: {name!r}")
+            raise InvalidArgumentError(f"invalid table name: {name!r}",
+                                       error_class="DELTA_PARSING_ILLEGAL_TABLE_NAME")
         return f"{self._dir}/{name}.json"
 
     def _default_location(self, name: str) -> str:
@@ -84,7 +85,8 @@ class Catalog:
         except FileExistsError:
             if if_not_exists:
                 return self.table(name)
-            raise TableAlreadyExistsError(f"table {name} already exists")
+            raise TableAlreadyExistsError(f"table {name} already exists",
+                                          error_class="DELTA_TABLE_ALREADY_EXISTS")
 
         table = Table.for_path(loc, self.engine)
         if schema is not None and not table.exists():
@@ -133,7 +135,8 @@ class Catalog:
         """Register an existing Delta table under a name."""
         t = Table.for_path(path, self.engine)
         if not t.exists():
-            raise MissingTransactionLogError(f"no Delta table at {path}")
+            raise MissingTransactionLogError(f"no Delta table at {path}",
+                                             error_class="DELTA_MISSING_DELTA_TABLE")
         return self.create_table(name, location=path)
 
     def drop(self, name: str, if_exists: bool = False,
@@ -149,7 +152,8 @@ class Catalog:
             # recursive delete is local-FS only (like VACUUM's walker);
             # failing loudly beats reporting success while retaining data
             raise CatalogTableError(
-                f"DROP TABLE ... delete_data is not supported for "
+                error_class="DELTA_OPERATION_NOT_ALLOWED_DETAIL",
+                message=f"DROP TABLE ... delete_data is not supported for "
                 f"non-local location {loc!r}; drop without delete_data "
                 f"and remove the data out of band"
             )
@@ -157,7 +161,8 @@ class Catalog:
             # externally registered table: refuse rather than silently
             # keep the data after an explicit delete_data request
             raise CatalogTableError(
-                f"table {name} is external (location {loc!r} outside the "
+                error_class="DELTA_OPERATION_NOT_ALLOWED_DETAIL",
+                message=f"table {name} is external (location {loc!r} outside the "
                 f"catalog root); drop without delete_data"
             )
         if delete_data:

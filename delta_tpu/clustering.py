@@ -62,11 +62,14 @@ def set_clustering_columns(table, columns: List[str]) -> int:
     schema = meta.schema
     for c in columns:
         if schema is not None and c not in schema:
-            raise ClusteringColumnError(f"clustering column {c} not in schema")
+            raise ClusteringColumnError(f"clustering column {c} not in schema",
+                                        error_class="DELTA_COLUMN_NOT_FOUND_IN_SCHEMA")
         if c in meta.partitionColumns:
-            raise ClusteringColumnError(f"cannot cluster by partition column {c}")
+            raise ClusteringColumnError(f"cannot cluster by partition column {c}",
+                                        error_class="DELTA_CLUSTERING_ON_PARTITION_COLUMN")
     if meta.partitionColumns and columns:
-        raise ClusteringColumnError("clustered tables cannot be partitioned")
+        raise ClusteringColumnError("clustered tables cannot be partitioned",
+                                    error_class="DELTA_CLUSTER_BY_WITH_PARTITIONED_BY")
 
     txn = table.create_transaction_builder(Operation.CLUSTER_BY).build()
     proto = snap.protocol

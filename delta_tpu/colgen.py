@@ -51,7 +51,8 @@ def identity_field(
     from delta_tpu.models.schema import LONG
 
     if step == 0:
-        raise IdentityColumnError("identity step must not be 0")
+        raise IdentityColumnError("identity step must not be 0",
+                                  error_class="DELTA_IDENTITY_COLUMNS_ILLEGAL_STEP")
     return StructField(
         name,
         LONG,
@@ -132,7 +133,8 @@ def apply_column_generation(
                 ).as_py()
                 if mismatch:
                     raise InvariantViolationError(
-                        f"{mismatch} row(s) violate generation expression of "
+                        error_class="DELTA_GENERATED_COLUMNS_EXPR_TYPE_MISMATCH",
+                        message=f"{mismatch} row(s) violate generation expression of "
                         f"column {f.name}: {gen_expr}"
                     )
             else:
@@ -146,7 +148,8 @@ def apply_column_generation(
             if f.name in data.column_names:
                 if not allow_explicit:
                     raise IdentityColumnError(
-                        f"explicit values for identity column {f.name} are "
+                        error_class="DELTA_IDENTITY_COLUMNS_EXPLICIT_INSERT_NOT_SUPPORTED",
+                        message=f"explicit values for identity column {f.name} are "
                         "not allowed (allowExplicitInsert=false)"
                     )
                 continue

@@ -152,5 +152,6 @@ def drop_column(schema: StructType, name: str) -> StructType:
     if name not in schema:
         raise NonExistentColumnError(f"column {name} not found")
     if len(schema.fields) == 1:
-        raise SchemaEvolutionError("cannot drop the last column")
+        raise SchemaEvolutionError("cannot drop the last column",
+                                   error_class="DELTA_DROP_COLUMN_ON_SINGLE_FIELD_SCHEMA")
     return StructType([f for f in schema.fields if f.name != name])

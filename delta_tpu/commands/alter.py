@@ -72,7 +72,8 @@ def add_columns(table, columns: Sequence[StructField]) -> int:
         if f.name in schema:
             raise SchemaMismatchError(f"column {f.name} already exists")
         if not f.nullable:
-            raise SchemaEvolutionError("added columns must be nullable")
+            raise SchemaEvolutionError("added columns must be nullable",
+                                       error_class="DELTA_ADD_COLUMN_NOT_NULLABLE")
         new_fields.append(f)
     new_schema = StructType(schema.fields + list(new_fields))
     if mapping_mode(conf) != "none":
@@ -89,7 +90,8 @@ def rename_column(table, old: str, new: str) -> int:
     if mapping_mode(meta.configuration) == "none":
         raise SchemaEvolutionError(
             "RENAME COLUMN requires column mapping "
-            "(set delta.columnMapping.mode = 'name')"
+            "(set delta.columnMapping.mode = 'name')",
+            error_class="DELTA_UNSUPPORTED_RENAME_COLUMN"
         )
     schema = schema_from_json(meta.schemaString)
     new_schema = _rename_in_schema(schema, old, new)
@@ -113,10 +115,12 @@ def drop_column(table, name: str) -> int:
     if mapping_mode(meta.configuration) == "none":
         raise SchemaEvolutionError(
             "DROP COLUMN requires column mapping "
-            "(set delta.columnMapping.mode = 'name')"
+            "(set delta.columnMapping.mode = 'name')",
+            error_class="DELTA_UNSUPPORTED_DROP_COLUMN"
         )
     if name in meta.partitionColumns:
-        raise SchemaEvolutionError(f"cannot drop partition column {name}")
+        raise SchemaEvolutionError(f"cannot drop partition column {name}",
+                                   error_class="DELTA_UNSUPPORTED_DROP_PARTITION_COLUMN")
     schema = schema_from_json(meta.schemaString)
     new_schema = _drop_from_schema(schema, name)
     return _commit_schema(txn, new_schema, {"column": name})
@@ -129,15 +133,18 @@ def change_column_type(table, name: str, new_type: DataType) -> int:
     meta = txn.metadata()
     schema = schema_from_json(meta.schemaString)
     if name not in schema:
-        raise SchemaMismatchError(f"column {name} not found")
+        raise SchemaMismatchError(f"column {name} not found",
+                                  error_class="DELTA_COLUMN_NOT_FOUND_IN_SCHEMA")
     f = schema[name]
     if not can_widen(f.dataType, new_type):
         raise SchemaEvolutionError(
             f"unsupported type change {f.dataType.to_json_value()} -> "
-            f"{new_type.to_json_value()} (only widening changes allowed)"
+            f"{new_type.to_json_value()} (only widening changes allowed)",
+            error_class="DELTA_CANNOT_CHANGE_DATA_TYPE"
         )
     if meta.configuration.get("delta.enableTypeWidening", "").lower() != "true":
-        raise SchemaEvolutionError("set delta.enableTypeWidening = true first")
+        raise SchemaEvolutionError("set delta.enableTypeWidening = true first",
+                                   error_class="DELTA_TYPE_WIDENING_DISABLED")
     new_fields = [
         StructField(x.name, new_type, x.nullable, dict(x.metadata))
         if x.name == name
@@ -183,9 +190,16 @@ def set_properties(table, properties: Dict[str, str]) -> int:
     return _commit_schema(txn, schema, {"properties": dict(properties)}, conf)
 
 
-def unset_properties(table, keys: Sequence[str]) -> int:
+def unset_properties(table, keys: Sequence[str],
+                     if_exists: bool = False) -> int:
     txn = _metadata_txn(table, Operation.SET_TBLPROPERTIES)
     meta = txn.metadata()
+    missing = [k for k in keys if k not in meta.configuration]
+    if missing and not if_exists:
+        raise InvalidArgumentError(
+            f"cannot unset non-existent propert{'ies' if len(missing) > 1 else 'y'} "
+            f"{missing}; use UNSET TBLPROPERTIES IF EXISTS",
+            error_class="DELTA_UNSET_NON_EXISTENT_PROPERTY")
     conf = {k: v for k, v in meta.configuration.items() if k not in set(keys)}
     replacement = dataclasses.replace(meta, configuration=conf)
     txn.update_metadata(replacement)
@@ -200,7 +214,8 @@ def upgrade_protocol(table, min_reader: Optional[int] = None,
     proto = txn.protocol()
     if feature is not None:
         if feature not in FEATURES:
-            raise InvalidArgumentError(f"unknown table feature {feature}")
+            raise InvalidArgumentError(f"unknown table feature {feature}",
+                                       error_class="DELTA_UNSUPPORTED_FEATURES_IN_CONFIG")
         new_proto = upgraded_protocol(proto, FEATURES[feature])
     else:
         new_proto = dataclasses.replace(
@@ -212,7 +227,8 @@ def upgrade_protocol(table, min_reader: Optional[int] = None,
         return txn.read_version
     if (new_proto.minReaderVersion < proto.minReaderVersion
             or new_proto.minWriterVersion < proto.minWriterVersion):
-        raise InvalidProtocolVersionError("protocol downgrade is not allowed")
+        raise InvalidProtocolVersionError("protocol downgrade is not allowed",
+                                          error_class="DELTA_INVALID_PROTOCOL_DOWNGRADE")
     txn.update_protocol(new_proto)
     txn.set_operation_parameters(
         {"newProtocol": new_proto.to_dict()}

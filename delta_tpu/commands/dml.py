@@ -308,7 +308,8 @@ def _apply_assignments(matched: pa.Table, assignments, evaluate_host) -> pa.Tabl
     out = matched
     for col_name, value in assignments.items():
         if col_name not in out.column_names:
-            raise InvalidArgumentError(f"unknown column in SET: {col_name}")
+            raise InvalidArgumentError(f"unknown column in SET: {col_name}",
+                                       error_class="DELTA_MISSING_SET_COLUMN")
         idx = out.column_names.index(col_name)
         if isinstance(value, Expression):
             arr = evaluate_host(value, out)

@@ -77,7 +77,8 @@ def drop_feature(table, feature_name: str, truncate_history: bool = False) -> in
             f"{sorted(FEATURES)}")
     if feature_name not in _REMOVABLE:
         raise FeatureDropError(
-            f"feature {feature_name!r} cannot be dropped (not removable)")
+            f"feature {feature_name!r} cannot be dropped (not removable)",
+            error_class="DELTA_FEATURE_DROP_NONREMOVABLE_FEATURE")
 
     snapshot = table.latest_snapshot()
     if snapshot is None:
@@ -88,11 +89,14 @@ def drop_feature(table, feature_name: str, truncate_history: bool = False) -> in
     ):
         if is_feature_supported(proto, feature):
             raise FeatureDropError(
-                f"feature {feature_name!r} is implicitly supported by "
+                error_class="DELTA_FEATURE_DROP_IMPLICITLY_SUPPORTED",
+                message=f"feature {feature_name!r} is implicitly supported by "
                 f"protocol ({proto.minReaderVersion}, {proto.minWriterVersion}) "
                 "legacy versions; dropping legacy features requires them to "
                 "be listed explicitly (writer version 7)")
-        raise FeatureDropError(f"feature {feature_name!r} is not present on this table")
+        raise FeatureDropError(
+            f"feature {feature_name!r} is not present on this table",
+            error_class="DELTA_FEATURE_DROP_FEATURE_NOT_PRESENT")
 
     _pre_downgrade(table, feature_name)
 
@@ -101,7 +105,8 @@ def drop_feature(table, feature_name: str, truncate_history: bool = False) -> in
     if feature.is_reader_writer and feature_name != "vacuumProtocolCheck":
         if not truncate_history:
             raise FeatureDropError(
-                f"dropping reader+writer feature {feature_name!r} requires "
+                error_class="DELTA_FEATURE_DROP_HISTORICAL_VERSIONS_EXIST",
+                message=f"dropping reader+writer feature {feature_name!r} requires "
                 "history truncation: historical versions may still carry the "
                 "feature. Re-run with TRUNCATE HISTORY "
                 "(drop_feature(..., truncate_history=True))")
@@ -127,7 +132,8 @@ def _pre_downgrade(table, name: str) -> None:
                  if f.deletionVector is not None]
         if still:
             raise FeatureDropError(
-                f"{len(still)} file(s) still carry deletion vectors after purge")
+                f"{len(still)} file(s) still carry deletion vectors after purge",
+                error_class="DELTA_FEATURE_DROP_STILL_ACTIVE")
         return
 
     if name == "checkConstraints":
@@ -136,7 +142,8 @@ def _pre_downgrade(table, name: str) -> None:
         existing = table_constraints(conf)
         if existing:
             raise FeatureDropError(
-                f"cannot drop checkConstraints: constraint(s) "
+                error_class="DELTA_CANNOT_DROP_CHECK_CONSTRAINT_FEATURE",
+                message=f"cannot drop checkConstraints: constraint(s) "
                 f"{sorted(existing)} still exist — DROP CONSTRAINT them first")
         return
 
@@ -257,7 +264,8 @@ def _commit_downgrade(table, feature: TableFeature) -> int:
     meta = txn.metadata()
     if feature.activated_by is not None and feature.activated_by(meta):
         raise FeatureDropError(
-            f"feature {feature.name!r} is still active after pre-downgrade")
+            f"feature {feature.name!r} is still active after pre-downgrade",
+            error_class="DELTA_FEATURE_DROP_STILL_ACTIVE")
     txn.update_protocol(_downgraded_protocol(proto, feature.name))
     txn.set_operation_parameters({"featureName": feature.name})
     return txn.commit().version

@@ -108,14 +108,16 @@ def _check_compatible(snapshot) -> None:
 
     if mapping_mode(snapshot.metadata.configuration) != "none":
         raise InvalidArgumentError(
-            "symlink manifests are not supported on column-mapped tables")
+            "symlink manifests are not supported on column-mapped tables",
+            error_class="DELTA_GENERATE_WITH_COLUMN_MAPPING")
 
 
 def _check_no_dvs(files: Iterable) -> None:
     n = sum(1 for f in files if f.deletionVector is not None)
     if n:
         raise InvalidArgumentError(
-            f"cannot generate symlink manifests: {n} live file(s) carry "
+            error_class="DELTA_GENERATE_WITH_DELETION_VECTORS",
+            message=f"cannot generate symlink manifests: {n} live file(s) carry "
             "deletion vectors (external engines would see deleted rows); "
             "run REORG TABLE ... APPLY (PURGE) first")
 

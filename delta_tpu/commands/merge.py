@@ -44,7 +44,7 @@ from delta_tpu.write.writer import write_data_files
 
 
 class MergeCardinalityError(DeltaError):
-    error_class = "DELTA_MULTIPLE_SOURCE_ROW_MATCHING_TARGET_ROW"
+    error_class = "DELTA_MULTIPLE_SOURCE_ROW_MATCHING_TARGET_ROW_IN_MERGE"
 
 
 @dataclass
@@ -117,11 +117,37 @@ class MergeBuilder:
         return self
 
     def execute(self) -> MergeMetrics:
+        self._validate_clauses()
         return _execute_merge(
             self._table, self._source, self._on,
             self._matched, self._not_matched, self._not_matched_by_source,
             schema_evolution=self._schema_evolution,
         )
+
+    def _validate_clauses(self) -> None:
+        """Reference analysis rules: a MERGE needs at least one WHEN
+        clause (`DELTA_MERGE_MISSING_WHEN`), and within each clause
+        family only the LAST clause may omit its condition — an
+        unconditional non-last clause would shadow everything after it
+        (`DELTA_NON_LAST_MATCHED_CLAUSE_OMIT_CONDITION` family)."""
+        if not (self._matched or self._not_matched
+                or self._not_matched_by_source):
+            raise InvalidArgumentError(
+                "MERGE requires at least one WHEN clause",
+                error_class="DELTA_MERGE_MISSING_WHEN")
+        for clauses, ec in (
+                (self._matched,
+                 "DELTA_NON_LAST_MATCHED_CLAUSE_OMIT_CONDITION"),
+                (self._not_matched,
+                 "DELTA_NON_LAST_NOT_MATCHED_CLAUSE_OMIT_CONDITION"),
+                (self._not_matched_by_source,
+                 "DELTA_NON_LAST_NOT_MATCHED_BY_SOURCE_CLAUSE_OMIT_CONDITION")):
+            for c in clauses[:-1]:
+                if c.condition is None:
+                    raise InvalidArgumentError(
+                        "only the last clause of its kind may omit a "
+                        "condition; an unconditional earlier clause "
+                        "would shadow the rest", error_class=ec)
 
 
 def merge(table, source: pa.Table, on: Expression) -> MergeBuilder:
@@ -283,7 +309,8 @@ def _execute_merge(
         for k in c.assignments:
             if k.lower() in seen:
                 raise InvalidArgumentError(
-                    f"duplicate assignment for column '{k}' in MERGE clause"
+                    f"duplicate assignment for column '{k}' in MERGE clause",
+                    error_class="DELTA_DUPLICATE_COLUMNS_ON_UPDATE_TABLE"
                 )
             seen.add(k.lower())
     extra_cols = [c for c in source.column_names
@@ -302,7 +329,8 @@ def _execute_merge(
         if missing:
             raise InvalidArgumentError(
                 f"assignment target column(s) {missing} exist in neither "
-                "the target schema nor the source")
+                "the target schema nor the source",
+                error_class="DELTA_COLUMN_NOT_FOUND_IN_MERGE")
         if not schema_evolution:
             raise InvalidArgumentError(
                 f"assignment target column(s) {unknown_assigned} not in "
@@ -310,7 +338,8 @@ def _execute_merge(
                 "evolve the table")
     if (extra_cols and has_star and not schema_evolution):
         raise InvalidArgumentError(
-            f"source column(s) {extra_cols} not in the target schema; "
+            error_class="DELTA_MERGE_UNRESOLVED_EXPRESSION",
+            message=f"source column(s) {extra_cols} not in the target schema; "
             "call with_schema_evolution() to evolve the table")
     if (extra_cols and has_star) or unknown_assigned:
         import dataclasses

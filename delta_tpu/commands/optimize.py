@@ -92,7 +92,8 @@ class OptimizeBuilder:
         max_file_size: int = DEFAULT_MAX_FILE_SIZE,
     ) -> OptimizeMetrics:
         if not columns:
-            raise OptimizeArgumentError("ZORDER BY requires at least one column")
+            raise OptimizeArgumentError("ZORDER BY requires at least one column",
+                                        error_class="DELTA_ZORDER_REQUIRES_COLUMN")
         return _run_optimize(
             self._table, self._filter, zorder_by=list(columns), curve=curve,
             min_file_size=None, max_file_size=max_file_size,
@@ -132,14 +133,17 @@ def _run_optimize(
     elif zorder_by and cluster_cols:
         raise OptimizeArgumentError(
             "clustered tables use OPTIMIZE (no ZORDER BY); clustering "
-            f"columns are {cluster_cols}")
+            f"columns are {cluster_cols}",
+            error_class="DELTA_CLUSTERING_WITH_ZORDER_BY")
 
     if zorder_by:
         for c in zorder_by:
             if c in meta.partitionColumns:
-                raise OptimizeArgumentError(f"cannot Z-order by partition column {c}")
+                raise OptimizeArgumentError(f"cannot Z-order by partition column {c}",
+                                        error_class="DELTA_ZORDERING_ON_PARTITION_COLUMN")
             if schema is not None and c not in schema:
-                raise OptimizeArgumentError(f"Z-order column {c} not in schema")
+                raise OptimizeArgumentError(f"Z-order column {c} not in schema",
+                                        error_class="DELTA_ZORDERING_COLUMN_DOES_NOT_EXIST")
 
     candidates = txn.scan_files(filter=filter)
     if zcube_tags is not None:

@@ -35,7 +35,9 @@ class RestoreMetrics:
 def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = None,
             force: bool = False) -> RestoreMetrics:
     if (version is None) == (timestamp_ms is None):
-        raise RestoreTargetError("restore requires exactly one of version / timestamp")
+        raise RestoreTargetError(
+            "restore requires exactly one of version / timestamp",
+            error_class="DELTA_ONEOF_IN_TIMETRAVEL")
     target = (
         table.snapshot_at(version)
         if version is not None
@@ -62,7 +64,8 @@ def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = 
             abs_path = p if ("://" in p or p.startswith("/")) else f"{table.path}/{p}"
             if not table.engine.fs.exists(abs_path):
                 raise RestoreTargetError(
-                    f"cannot restore: data file {a.path} was removed "
+                    error_class="DELTA_RESTORE_MISSING_DATA_FILE",
+                    message=f"cannot restore: data file {a.path} was removed "
                     "(probably by VACUUM); use force=True to restore anyway"
                 )
 
@@ -176,7 +179,8 @@ def convert_to_delta(
 
     table = Table.for_path(path, engine)
     if table.exists():
-        raise ConvertTargetError(f"{path} is already a Delta table")
+        raise ConvertTargetError(f"{path} is already a Delta table",
+                                 error_class="DELTA_CONVERT_TARGET_ALREADY_DELTA")
     part_schema = partition_schema or {}
     part_cols = list(part_schema)
 
@@ -203,7 +207,8 @@ def convert_to_delta(
             missing = [k for k in part_cols if k not in pv]
             if missing:
                 raise ConvertTargetError(
-                    f"file {rel} lacks partition values for {missing}"
+                    f"file {rel} lacks partition values for {missing}",
+                    error_class="DELTA_CONVERSION_NO_PARTITION_FOUND"
                 )
             manifest.append((full, rel, {k: pv.get(k) for k in part_cols}))
     if not manifest:

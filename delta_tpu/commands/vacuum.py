@@ -16,7 +16,11 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from delta_tpu.config import TOMBSTONE_RETENTION, get_table_config
-from delta_tpu.errors import DeltaError, VacuumRetentionError
+from delta_tpu.errors import (
+    DeltaError,
+    InvalidArgumentError,
+    VacuumRetentionError,
+)
 from delta_tpu.utils import filenames
 
 
@@ -80,9 +84,10 @@ def _inventory_files(table_path: str, inventory):
         cols = set(getattr(inventory, "columns", ()))
     missing = [c for c in INVENTORY_COLUMNS if c not in cols]
     if missing:
-        raise DeltaError(
+        raise InvalidArgumentError(
             f"invalid inventory schema: missing column(s) {missing}; "
-            f"required: {list(INVENTORY_COLUMNS)}")
+            f"required: {list(INVENTORY_COLUMNS)}",
+            error_class="DELTA_INVALID_INVENTORY_SCHEMA")
     if isinstance(inventory, pa.Table):
         rows = zip(inventory.column("path").to_pylist(),
                    inventory.column("isDir").to_pylist(),

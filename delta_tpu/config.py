@@ -19,9 +19,13 @@ def _parse_interval_ms(s: str) -> int:
     plain millisecond count."""
     s = s.strip().lower()
     if s.startswith("interval"):
+        from delta_tpu.errors import InvalidTablePropertyError
+
         parts = s.split()
-        n = float(parts[1])
-        unit = parts[2].rstrip("s") if len(parts) > 2 else "millisecond"
+        if len(parts) < 2:
+            raise InvalidTablePropertyError(
+                "interval value is empty; expected 'interval <n> <unit>'",
+                error_class="DELTA_INVALID_CALENDAR_INTERVAL_EMPTY")
         scale = {
             "millisecond": 1,
             "second": 1000,
@@ -29,8 +33,16 @@ def _parse_interval_ms(s: str) -> int:
             "hour": 3_600_000,
             "day": 86_400_000,
             "week": 7 * 86_400_000,
-        }[unit]
-        return int(n * scale)
+        }
+        unit = parts[2].rstrip("s") if len(parts) > 2 else "millisecond"
+        try:
+            n = float(parts[1])
+            return int(n * scale[unit])
+        except (ValueError, KeyError):
+            raise InvalidTablePropertyError(
+                f"invalid interval {s!r}; expected 'interval <n> "
+                f"<{'|'.join(scale)}>'",
+                error_class="DELTA_INVALID_INTERVAL") from None
     return int(s)
 
 
@@ -142,7 +154,11 @@ def _parse_isolation(s: str) -> str:
     lv = s.strip()
     if lv not in ("Serializable", "WriteSerializable",
                   "SnapshotIsolation"):
-        raise ValueError(f"invalid delta.isolationLevel {s!r}")
+        from delta_tpu.errors import InvalidTablePropertyError
+
+        raise InvalidTablePropertyError(
+            f"invalid delta.isolationLevel {s!r}",
+            error_class="DELTA_INVALID_ISOLATION_LEVEL")
     return lv
 
 

@@ -102,7 +102,9 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
             # not escape the confinement the docstring promises
             resolved = os.path.realpath(path)
             if not (resolved + "/").startswith(self.allowed_root + "/"):
-                raise ConnectProtocolError(f"path {path!r} is outside the served root")
+                raise ConnectProtocolError(
+                f"path {path!r} is outside the served root",
+                error_class="DELTA_CONNECT_PATH_OUTSIDE_ROOT")
 
     def _table(self, path: str):
         from delta_tpu.table import Table
@@ -132,7 +134,8 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
         if op == "write":
             data = ipc_to_table(payload)
             if data is None:
-                raise ConnectProtocolError("write requires an Arrow payload")
+                raise ConnectProtocolError("write requires an Arrow payload",
+                                       error_class="DELTA_CONNECT_MISSING_PAYLOAD")
             import delta_tpu.api as dta
 
             self._table(env["path"])  # root check
@@ -185,7 +188,8 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
                              dry_run=env.get("dry_run", False))
             return {"deleted": deleted.num_deleted}, b""
 
-        raise ConnectProtocolError(f"unknown connect op {op!r}")
+        raise ConnectProtocolError(f"unknown connect op {op!r}",
+                               error_class="DELTA_CONNECT_UNKNOWN_OP")
 
 
 def serve(path_root: str, host: str = "127.0.0.1", port: int = 9477):

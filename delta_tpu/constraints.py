@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from delta_tpu.errors import ConstraintAlreadyExistsError, ConstraintNotFoundError, DeltaError, InvariantViolationError, MissingTransactionLogError
+from delta_tpu.errors import ConstraintAlreadyExistsError, ConstraintNotFoundError, DeltaError, InvalidArgumentError, InvariantViolationError, MissingTransactionLogError
 from delta_tpu.expressions.parser import parse_expression, to_sql
 from delta_tpu.expressions.tree import Expression
 
@@ -29,6 +29,16 @@ def table_constraints(configuration: Dict[str, str]) -> Dict[str, Expression]:
         if k.startswith(CONSTRAINT_PREFIX):
             out[k[len(CONSTRAINT_PREFIX):]] = parse_expression(v)
     return out
+
+
+def _empty_batch(meta):
+    import pyarrow as pa
+
+    from delta_tpu.models.schema import to_arrow_schema
+
+    return pa.Table.from_arrays(
+        [pa.array([], f.type) for f in to_arrow_schema(meta.schema)],
+        schema=to_arrow_schema(meta.schema))
 
 
 def add_constraint(table, name: str, expr) -> int:
@@ -52,6 +62,20 @@ def add_constraint(table, name: str, expr) -> int:
     key = constraint_key(name)
     if key in meta.configuration:
         raise ConstraintAlreadyExistsError(f"constraint {name} already exists")
+    try:
+        # type-probe on an empty batch: a CHECK body must be boolean
+        from delta_tpu.expressions.eval import evaluate_host
+
+        probe = (evaluate_host(expr, _empty_batch(meta))
+                 if meta.schema is not None else None)
+        probe_type = getattr(probe, "type", None)
+    except Exception:
+        probe_type = None  # unevaluable-on-empty: row validation decides
+    if probe_type is not None and probe_type != pa.bool_():
+        raise InvalidArgumentError(
+            f"CHECK constraint {name} must be a boolean expression, got "
+            f"{probe_type}",
+            error_class="DELTA_NON_BOOLEAN_CHECK_CONSTRAINT")
 
     # validate current data
     data = snapshot.scan().to_arrow()
@@ -60,7 +84,8 @@ def add_constraint(table, name: str, expr) -> int:
         bad = int((~np.asarray(ok)).sum())
         if bad:
             raise InvariantViolationError(
-                f"{bad} existing row(s) violate new constraint {name}: "
+                error_class="DELTA_NEW_CHECK_CONSTRAINT_VIOLATION",
+                message=f"{bad} existing row(s) violate new constraint {name}: "
                 f"{to_sql(expr)}"
             )
     txn.mark_read_whole_table()

@@ -180,7 +180,10 @@ def coordinator_for_table(metadata_configuration: Dict[str, str]) -> Optional[Co
         return None
     client = _REGISTRY.get(name)
     if client is None:
-        raise KeyError(
-            f"commit coordinator {name!r} is not registered in this process"
+        from delta_tpu.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"commit coordinator {name!r} is not registered in this process",
+            error_class="DELTA_UNKNOWN_COMMIT_COORDINATOR",
         )
     return client

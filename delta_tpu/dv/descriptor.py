@@ -50,7 +50,11 @@ def absolute_dv_path(table_path: str, descriptor_row: Dict) -> str:
         if prefix:
             return f"{table_path}/{prefix}/{name}"
         return f"{table_path}/{name}"
-    raise ValueError(f"cannot resolve a path for storageType {storage!r}")
+    from delta_tpu.errors import DeletionVectorError
+
+    raise DeletionVectorError(
+        f"cannot resolve a path for storageType {storage!r}",
+        error_class="DELTA_CANNOT_RECONSTRUCT_PATH_FROM_URI")
 
 
 def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.ndarray:
@@ -66,7 +70,11 @@ def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.nd
     blob = data[offset + 4:offset + 4 + size]
     (crc,) = struct.unpack_from(">I", data, offset + 4 + size)
     if checksum(blob) != crc:
-        raise ValueError(f"deletion vector checksum mismatch in {path}")
+        from delta_tpu.errors import DeletionVectorError
+
+        raise DeletionVectorError(
+            f"deletion vector checksum mismatch in {path}",
+            error_class="DELTA_DELETION_VECTOR_CHECKSUM_MISMATCH")
     return RoaringBitmapArray.deserialize_delta(blob).values
 
 

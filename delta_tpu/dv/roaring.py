@@ -152,7 +152,10 @@ class RoaringBitmapArray:
         else:
             cookie32, n = struct.unpack_from("<ii", buf, 0)
             if cookie32 != SERIAL_COOKIE_NO_RUNCONTAINER:
-                raise ValueError(f"bad roaring cookie {cookie32}")
+                from delta_tpu.errors import DeletionVectorError
+
+                raise DeletionVectorError(
+                    f"bad roaring cookie {cookie32}")
             pos = 8
             run_flags = np.zeros(n, dtype=bool)
             has_offsets = True
@@ -235,7 +238,10 @@ class RoaringBitmapArray:
     def deserialize_delta(data: bytes) -> "RoaringBitmapArray":
         (magic,) = struct.unpack_from("<i", data, 0)
         if magic != DELTA_MAGIC:
-            raise ValueError(f"bad deletion-vector magic {magic}")
+            from delta_tpu.errors import DeletionVectorError
+
+            raise DeletionVectorError(
+                f"bad deletion-vector magic {magic}")
         return RoaringBitmapArray.deserialize_portable(data[4:])
 
 

@@ -15,12 +15,22 @@ from __future__ import annotations
 
 
 class DeltaError(Exception):
-    """Base class for all delta-tpu errors."""
+    """Base class for all delta-tpu errors.
+
+    `error_class` identifies the stable catalog entry
+    (resources/error_classes.json — the reference's
+    delta-error-classes.json role). A raise site may narrow its
+    exception type's default class by passing `error_class=` — the
+    reference does the same thing with one `DeltaErrors.scala` factory
+    per condition over a handful of exception types."""
 
     error_class: str = "DELTA_ERROR"
 
-    def __init__(self, message: str = "", **context):
+    def __init__(self, message: str = "", error_class: str = None,
+                 **context):
         super().__init__(message)
+        if error_class is not None:
+            self.error_class = error_class
         self.context = context
 
 
@@ -48,7 +58,7 @@ class TimestampEarlierThanCommitRetentionError(DeltaError):
 
 
 class TimestampLaterThanLatestCommitError(DeltaError):
-    error_class = "DELTA_TIMESTAMP_LATER_THAN_LATEST_COMMIT"
+    error_class = "DELTA_TIMESTAMP_GREATER_THAN_COMMIT"
 
 
 class CommitFailedError(DeltaError):
@@ -132,6 +142,8 @@ class UnsupportedTableFeatureError(DeltaError):
         super().__init__(
             f"Unsupported Delta table features for {kind}: {sorted(features)}",
             features=sorted(features),
+            error_class=("DELTA_UNSUPPORTED_FEATURES_FOR_READ" if read
+                         else "DELTA_UNSUPPORTED_FEATURES_FOR_WRITE"),
         )
         self.features = frozenset(features)
 
@@ -155,9 +167,6 @@ class CorruptStatsError(DeltaError):
 class SchemaMismatchError(DeltaError):
     error_class = "DELTA_SCHEMA_MISMATCH"
 
-
-class PartitionColumnMismatchError(DeltaError):
-    error_class = "DELTA_PARTITION_COLUMN_MISMATCH"
 
 
 class SqlParseError(DeltaError):
@@ -191,9 +200,6 @@ class InvalidTablePropertyError(DeltaError):
     error_class = "DELTA_INVALID_TABLE_PROPERTY"
 
 
-class UnknownConfigurationError(DeltaError):
-    error_class = "DELTA_UNKNOWN_CONFIGURATION"
-
 
 class InvalidArgumentError(DeltaError):
     """Bad argument to a command/API builder (reference
@@ -220,12 +226,6 @@ class AppendOnlyTableError(DeltaError):
     error_class = "DELTA_CANNOT_MODIFY_APPEND_ONLY"
 
 
-class MultipleSourceRowMatchesError(DeltaError):
-    """MERGE: >1 source row matched the same target row with
-    conflicting actions."""
-
-    error_class = "DELTA_MULTIPLE_SOURCE_ROW_MATCHING_TARGET_ROW_IN_MERGE"
-
 
 class ColumnMappingError(DeltaError):
     error_class = "DELTA_UNSUPPORTED_COLUMN_MAPPING_OPERATION"
@@ -235,11 +235,6 @@ class ColumnMappingModeChangeError(ColumnMappingError):
     error_class = "DELTA_UNSUPPORTED_COLUMN_MAPPING_MODE_CHANGE"
 
 
-class UnsupportedTypeChangeError(DeltaError):
-    """ALTER COLUMN TYPE outside the widening matrix."""
-
-    error_class = "DELTA_UNSUPPORTED_TYPE_CHANGE"
-
 
 class NonExistentColumnError(DeltaError):
     error_class = "DELTA_COLUMN_NOT_FOUND"
@@ -248,9 +243,6 @@ class NonExistentColumnError(DeltaError):
 class DuplicateColumnError(DeltaError):
     error_class = "DELTA_DUPLICATE_COLUMNS_FOUND"
 
-
-class GeneratedColumnError(DeltaError):
-    error_class = "DELTA_UNSUPPORTED_GENERATED_COLUMN"
 
 
 class IdentityColumnError(DeltaError):
@@ -275,9 +267,6 @@ class FeatureDropError(DeltaError):
 class FeatureDropHistoricalVersionsExistError(FeatureDropError):
     error_class = "DELTA_FEATURE_DROP_HISTORICAL_VERSIONS_EXIST"
 
-
-class FeatureDropWaitForRetentionError(FeatureDropError):
-    error_class = "DELTA_FEATURE_DROP_WAIT_FOR_RETENTION_PERIOD"
 
 
 class RestoreTargetError(DeltaError):
@@ -310,9 +299,6 @@ class StreamingSourceError(DeltaError):
     error_class = "DELTA_STREAMING_SOURCE_ERROR"
 
 
-class StreamingOffsetError(StreamingSourceError):
-    error_class = "DELTA_STREAMING_INVALID_OFFSET"
-
 
 class StreamingSchemaChangeError(StreamingSourceError):
     """Non-additive schema change mid-stream (reference
@@ -322,7 +308,7 @@ class StreamingSchemaChangeError(StreamingSourceError):
 
 
 class CdcNotEnabledError(DeltaError):
-    error_class = "DELTA_MISSING_CHANGE_DATA"
+    error_class = "DELTA_CHANGE_TABLE_FEED_DISABLED"
 
 
 class IcebergCompatViolationError(DeltaError):

@@ -75,7 +75,11 @@ def _eval(expr: Expression, batch: pa.Table):
         return pc.is_in(child, value_set=pa.array(list(expr.values)))
     if isinstance(expr, StartsWith):
         return pc.starts_with(_eval(expr.child, batch), pattern=expr.prefix)
-    raise ValueError(f"cannot evaluate {expr!r}")
+    from delta_tpu.errors import InvalidArgumentError
+
+    raise InvalidArgumentError(
+        f"cannot evaluate {expr!r}",
+        error_class="DELTA_CANNOT_EVALUATE_EXPRESSION")
 
 
 def evaluate_host(expr: Expression, batch: pa.Table):

@@ -53,7 +53,7 @@ _KEYWORDS = {"AND", "OR", "NOT", "IS", "NULL", "IN", "TRUE", "FALSE"}
 
 
 class ParseError(ValueError):
-    pass
+    error_class = "DELTA_FAILED_RECOGNIZE_PREDICATE"
 
 
 def _tokenize(s: str) -> List[tuple]:

@@ -148,7 +148,8 @@ def protocol_for_new_table(
         forced_w = int(raw_w) if raw_w is not None else None
     except ValueError as e:
         raise InvalidTablePropertyError(
-            f"invalid protocol version property: {e}") from None
+            f"invalid protocol version property: {e}",
+            error_class="DELTA_PROTOCOL_PROPERTY_NOT_INT") from None
     # range/consistency validation BEFORE committing: an out-of-range
     # protocol would brick the table for every reader (incl. us)
     if forced_r is not None and not 1 <= forced_r <= 3:
@@ -161,7 +162,8 @@ def protocol_for_new_table(
     if forced_r == 3 and (forced_w or 7) != 7:
         raise InvalidProtocolVersionError(
             "readerVersion 3 requires writerVersion 7 "
-            "(feature-vector protocols)")
+            "(feature-vector protocols)",
+            error_class="DELTA_READ_FEATURE_PROTOCOL_REQUIRES_WRITE")
     if forced_r is not None:
         min_reader = max(min_reader, forced_r)
         if forced_r == 3:

@@ -100,6 +100,8 @@ symlink_manifest_hook.critical = True
 class PostCommitHookError(Exception):
     """A critical post-commit hook failed. The commit itself succeeded."""
 
+    error_class = "DELTA_POST_COMMIT_HOOK_FAILED"
+
     def __init__(self, hook_name: str, version: int, cause: Exception):
         super().__init__(
             f"post-commit hook {hook_name!r} failed after version "

@@ -64,7 +64,11 @@ def _iceberg_type(dt: DataType, ids: _IdGen):
             return f"decimal({p}, {s})"
         t = _DELTA_TO_ICEBERG.get(dt.name)
         if t is None:
-            raise ValueError(f"no iceberg mapping for {dt.name}")
+            from delta_tpu.errors import UniFormConversionError
+
+            raise UniFormConversionError(
+                f"no iceberg mapping for {dt.name}",
+                error_class="DELTA_UNIVERSAL_FORMAT_CONVERSION_FAILED")
         return t
     if isinstance(dt, StructType):
         return {
@@ -95,7 +99,11 @@ def _iceberg_type(dt: DataType, ids: _IdGen):
             "value": _iceberg_type(dt.valueType, ids),
             "value-required": not dt.valueContainsNull,
         }
-    raise ValueError(f"cannot convert {dt!r}")
+    from delta_tpu.errors import UniFormConversionError
+
+    raise UniFormConversionError(
+        f"cannot convert {dt!r}",
+        error_class="DELTA_UNIVERSAL_FORMAT_CONVERSION_FAILED")
 
 
 def iceberg_schema(schema: StructType) -> Dict:

@@ -41,7 +41,8 @@ def enabled_version(configuration) -> Optional[int]:
     v2 = get_table_config(conf, ICEBERG_COMPAT_V2)
     if v1 and v2:
         raise IcebergCompatViolationError(
-            "icebergCompatV1 and icebergCompatV2 are mutually exclusive "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.VERSION_MUTUAL_EXCLUSIVE",
+            message="icebergCompatV1 and icebergCompatV2 are mutually exclusive "
             "(CheckOnlySingleVersionEnabled)")
     return 1 if v1 else 2 if v2 else None
 
@@ -79,7 +80,8 @@ def validate_enablement(snapshot, new_configuration) -> None:
            .column("deletion_vector").to_pylist() if d]
     if dvs:
         raise IcebergCompatViolationError(
-            f"cannot enable icebergCompatV{new_v}: {len(dvs)} live "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.DELETION_VECTORS_NOT_PURGED",
+            message=f"cannot enable icebergCompatV{new_v}: {len(dvs)} live "
             "file(s) still carry deletion vectors; run REORG TABLE ... "
             "APPLY (UPGRADE UNIFORM (...)) or PURGE first")
 
@@ -95,12 +97,14 @@ def validate_iceberg_compat(metadata, protocol,
     feature = f"icebergCompatV{version}"
     if feature not in (protocol.writerFeatures or []):
         raise IcebergCompatViolationError(
-            f"delta.enableIcebergCompatV{version} requires the "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.MISSING_REQUIRED_TABLE_FEATURE",
+            message=f"delta.enableIcebergCompatV{version} requires the "
             f"{feature} writer table feature")
     mode = conf.get("delta.columnMapping.mode", "none")
     if mode not in ("name", "id"):
         raise IcebergCompatViolationError(
-            f"icebergCompatV{version} requires column mapping "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.WRONG_REQUIRED_TABLE_PROPERTY",
+            message=f"icebergCompatV{version} requires column mapping "
             f"(delta.columnMapping.mode=name), found {mode!r} "
             "(RequireColumnMapping)")
     if _is_true(conf, "delta.enableDeletionVectors"):
@@ -110,26 +114,30 @@ def validate_iceberg_compat(metadata, protocol,
         # adds on every commit below — REORG ... APPLY (UPGRADE UNIFORM)
         # is the purge path for tables that already wrote DVs
         raise IcebergCompatViolationError(
-            f"icebergCompatV{version} is incompatible with deletion "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.DELETION_VECTORS_SHOULD_BE_DISABLED",
+            message=f"icebergCompatV{version} is incompatible with deletion "
             "vectors (CheckDeletionVectorDisabled)")
     dv_adds = [a.path for a in adds
                if getattr(a, "deletionVector", None) is not None]
     if dv_adds:
         raise IcebergCompatViolationError(
-            f"icebergCompatV{version}: staged add(s) carry deletion "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.ADDING_DELETION_VECTORS",
+            message=f"icebergCompatV{version}: staged add(s) carry deletion "
             f"vectors ({dv_adds[:3]})")
     problems: list = []
     if metadata.schema is not None:
         _walk_types(metadata.schema, [], problems, version)
     if problems:
         raise IcebergCompatViolationError(
-            f"icebergCompatV{version} schema violations: "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.INCOMPATIBLE_SCHEMA",
+            message=f"icebergCompatV{version} schema violations: "
             + "; ".join(problems))
     # every AddFile, including dataChange=false rewrites: the Iceberg
     # mirror needs numRecords for each data file (CheckAddFileHasStats)
     missing_stats = [a.path for a in adds if not a.stats]
     if missing_stats:
         raise IcebergCompatViolationError(
-            f"icebergCompatV{version} requires stats on every added "
+            error_class="DELTA_ICEBERG_COMPAT_VIOLATION.FILES_MISSING_STATS",
+            message=f"icebergCompatV{version} requires stats on every added "
             f"file (CheckAddFileHasStats); missing on "
             f"{missing_stats[:3]}")

@@ -90,7 +90,8 @@ class HttpTransport:
                     except Exception:
                         pass
                     raise SharingError(
-                        f"sharing server returned HTTP {e.code} for "
+                        error_class="DELTA_SHARING_SERVER_ERROR",
+                        message=f"sharing server returned HTTP {e.code} for "
                         f"{url}: {detail}") from e
                 retry_after = e.headers.get("Retry-After")
                 try:
@@ -103,7 +104,8 @@ class HttpTransport:
             except urllib.error.URLError as e:
                 if attempt == self.max_retries:
                     raise SharingError(
-                        f"sharing server unreachable at {url}: {e.reason}"
+                        error_class="DELTA_SHARING_SERVER_UNREACHABLE",
+                        message=f"sharing server unreachable at {url}: {e.reason}"
                     ) from e
                 time.sleep(delay)
                 delay = min(delay * 2, 8.0)
@@ -209,7 +211,8 @@ def materialize_shared_table(lines: List[dict], dest_path: str) -> str:
     protocol_line = next((l["protocol"] for l in lines if "protocol" in l), None)
     meta_line = next((l["metaData"] for l in lines if "metaData" in l), None)
     if meta_line is None:
-        raise SharingError("sharing response has no metaData line")
+        raise SharingError("sharing response has no metaData line",
+                           error_class="DELTA_SHARING_NO_METADATA")
     files = [l["file"] for l in lines if "file" in l]
 
     log = os.path.join(dest_path, "_delta_log")
@@ -320,7 +323,8 @@ class SharingStreamSource:
             # rewritten files would duplicate rows downstream — same
             # contract as DeltaSource's data-changing-remove error
             raise SharingError(
-                f"{len(vanished)} previously-streamed file(s) were "
+                error_class="DELTA_SHARING_FILES_REWRITTEN",
+                message=f"{len(vanished)} previously-streamed file(s) were "
                 "rewritten or removed on the sharing server; restart the "
                 "stream, or pass ignore_changes=True to re-emit "
                 "rewritten files (downstream must tolerate duplicates)")

@@ -447,7 +447,8 @@ def write_checkpoint(engine, snapshot, policy: Optional[str] = None) -> LastChec
 
     if settings.verify_checkpoint_row_count and len(add_struct) != state.num_files:
         raise ChecksumMismatchError(
-            f"checkpoint add rows {len(add_struct)} != snapshot numFiles "
+            error_class="DELTA_CHECKPOINT_SNAPSHOT_MISMATCH",
+            message=f"checkpoint add rows {len(add_struct)} != snapshot numFiles "
             f"{state.num_files}"
         )
 

@@ -159,4 +159,5 @@ def validate_state_against_checksum(state, crc: VersionChecksum) -> None:
     if state.metadata.id != crc.metadata.id:
         problems.append("metadata id mismatch")
     if problems:
-        raise ChecksumMismatchError("; ".join(problems))
+        raise ChecksumMismatchError("; ".join(problems),
+                                    error_class="DELTA_TXN_LOG_FAILED_INTEGRITY")

@@ -102,7 +102,9 @@ def cleanup_expired_logs(
 def write_compacted_delta(table, from_version: int, to_version: int) -> str:
     """Reconcile commits [from, to] into one compacted file."""
     if to_version <= from_version:
-        raise InvalidArgumentError("compaction range must span at least two commits")
+        raise InvalidArgumentError(
+            "compaction range must span at least two commits",
+            error_class="DELTA_COMPACTION_RANGE_TOO_SMALL")
     engine = table.engine
     # Sequential reconciliation of the range (small: it's a commit range,
     # not a full table state).

@@ -52,7 +52,8 @@ class CorruptLogError(DeltaError):
 def _verify_deltas_contiguous(versions: List[int], expected_start: int, target: int) -> None:
     if versions != list(range(expected_start, target + 1)):
         raise CorruptLogError(
-            f"Log is missing commit files: have versions {versions[:5]}..., "
+            error_class="DELTA_TRUNCATED_TRANSACTION_LOG",
+            message=f"Log is missing commit files: have versions {versions[:5]}..., "
             f"expected contiguous [{expected_start}, {target}]"
         )
 
@@ -114,7 +115,8 @@ def build_log_segment(
         else:
             listing = list(fs.list_from(prefix))
     except FileNotFoundError:
-        raise TableNotFoundError(f"no _delta_log at {log_path}")
+        raise TableNotFoundError(f"no _delta_log at {log_path}",
+                                 error_class="DELTA_EMPTY_DIRECTORY")
 
     # (version, fstat) pairs: each name is parsed exactly once — at 100k
     # commits the repeated delta_version() calls below were measurable
@@ -144,7 +146,8 @@ def build_log_segment(
                 fs, log_path, target_version, checkpoint_hint=None,
                 use_compacted_deltas=use_compacted_deltas,
             )
-        raise TableNotFoundError(f"no commits found in {log_path}")
+        raise TableNotFoundError(f"no commits found in {log_path}",
+                                 error_class="DELTA_NO_COMMITS_FOUND")
 
     complete = group_complete_checkpoints(checkpoint_files)
     chosen_checkpoint: List[CheckpointInstance] = complete[-1] if complete else []

@@ -62,7 +62,11 @@ class PrimitiveType(DataType):
 
     def __post_init__(self):
         if self.name not in PRIMITIVES and not _DECIMAL_RE.match(self.name):
-            raise ValueError(f"unknown primitive type: {self.name}")
+            from delta_tpu.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"unknown primitive type: {self.name}",
+                error_class="DELTA_PARSING_UNSUPPORTED_DATA_TYPE")
 
     @property
     def is_decimal(self) -> bool:
@@ -221,7 +225,11 @@ def _type_from_json_value(v: Any) -> DataType:
                 valueType=_type_from_json_value(v["valueType"]),
                 valueContainsNull=bool(v.get("valueContainsNull", True)),
             )
-    raise ValueError(f"cannot parse schema type: {v!r}")
+    from delta_tpu.errors import InvalidArgumentError
+
+    raise InvalidArgumentError(
+        f"cannot parse schema type: {v!r}",
+        error_class="DELTA_PARSING_UNSUPPORTED_DATA_TYPE")
 
 
 def schema_from_json(s: str) -> StructType:
@@ -263,7 +271,11 @@ def to_arrow_type(dt: DataType) -> pa.DataType:
         try:
             return _PRIM_TO_ARROW[dt.name]
         except KeyError:
-            raise ValueError(f"no arrow mapping for {dt.name}")
+            from delta_tpu.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"no arrow mapping for {dt.name}",
+                error_class="DELTA_UNSUPPORTED_DATA_TYPES")
     if isinstance(dt, ArrayType):
         return pa.list_(to_arrow_type(dt.elementType))
     if isinstance(dt, MapType):
@@ -322,7 +334,11 @@ def from_arrow_type(t: pa.DataType) -> DataType:
                 for i in range(t.num_fields)
             ]
         )
-    raise ValueError(f"cannot convert arrow type {t}")
+    from delta_tpu.errors import InvalidArgumentError
+
+    raise InvalidArgumentError(
+        f"cannot convert arrow type {t}",
+        error_class="DELTA_UNSUPPORTED_DATA_TYPES")
 
 
 def from_arrow_schema(schema: pa.Schema) -> StructType:

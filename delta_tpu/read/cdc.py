@@ -50,9 +50,16 @@ def table_changes(
     if not cdf_enabled(conf):
         raise CdcNotEnabledError(
             "change data feed is not enabled on this table "
-            "(set delta.enableChangeDataFeed=true)"
+            "(set delta.enableChangeDataFeed=true)",
+            error_class="DELTA_CHANGE_TABLE_FEED_DISABLED"
         )
     end = ending_version if ending_version is not None else snap.version
+    if end < starting_version:
+        from delta_tpu.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"invalid CDC range [{starting_version}, {end}]: start is "
+            "after end", error_class="DELTA_INVALID_CDC_RANGE")
     fs = table.engine.fs
     out: List[pa.Table] = []
     for v in range(starting_version, end + 1):

@@ -108,8 +108,15 @@ def read_add_file_logical(engine, table_path: str, snapshot, add,
     mapped = mapping_mode(meta.configuration) != "none" and schema is not None
     p2l = physical_to_logical_names(schema) if mapped else {}
 
-    tbl = next(iter(engine.parquet.read_parquet_files(
-        [_absolute_path(table_path, add.path)])))
+    try:
+        tbl = next(iter(engine.parquet.read_parquet_files(
+            [_absolute_path(table_path, add.path)])))
+    except FileNotFoundError as e:
+        from delta_tpu.errors import FileNotFoundInLogError
+
+        raise FileNotFoundInLogError(
+            f"data file referenced by the log is missing: {add.path} "
+            "(removed by VACUUM, or the log is ahead of storage)") from e
     tbl = _align_to_logical(tbl, schema, partition_columns, p2l)
     if apply_dv and add.deletionVector is not None:
         mask = _dv_row_mask(engine, table_path, add.deletionVector.to_dict(),

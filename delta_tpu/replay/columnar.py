@@ -466,7 +466,8 @@ def _read_commits_buffer(
             # cleanup — the same contract as a listing gap
             raise CorruptLogError(
                 f"commit file vanished after listing (concurrent log "
-                f"cleanup?): {e}") from e
+                f"cleanup?): {e}",
+                error_class="DELTA_COMMIT_FILE_VANISHED") from e
     sizes = np.array([max(0, int(s)) for _, _, s in commit_infos], dtype=np.int64)
     starts = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(sizes + 1, out=starts[1:])
@@ -584,8 +585,11 @@ def parse_commit_batch(
         read_options=pa_json.ReadOptions(block_size=1 << 24),
     )
     if table.num_rows != versions.shape[0]:
-        raise ValueError(
-            f"JSON parse row count {table.num_rows} != line count {versions.shape[0]}"
+        from delta_tpu.errors import LogCorruptedError
+
+        raise LogCorruptedError(
+            f"JSON parse row count {table.num_rows} != line count "
+            f"{versions.shape[0]}"
         )
     return table, versions, orders, total
 

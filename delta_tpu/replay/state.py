@@ -370,7 +370,8 @@ def reconstruct_small_state(engine, segment,
 
         raise LogCorruptedError(
             f"log segment for version {segment.version} has no "
-            f"{'protocol' if columnar.protocol is None else 'metadata'} action"
+            f"{'protocol' if columnar.protocol is None else 'metadata'} action",
+            error_class="DELTA_STATE_RECOVER_ERROR",
         )
     if check_protocol:
         check_read_supported(columnar.protocol)

@@ -61,7 +61,8 @@ def assign_fresh_row_ids(
         if base is None:
             if num is None:
                 raise RowTrackingError(
-                    f"row tracking requires numRecords stats on {a.path}"
+                    error_class="DELTA_ROW_ID_ASSIGNMENT_WITHOUT_STATS",
+                    message=f"row tracking requires numRecords stats on {a.path}"
                 )
             base = next_id
             next_id += num

@@ -69,7 +69,8 @@ def merge_types(
     if can_widen(incoming, current):
         return current  # incoming is narrower: fits without change
     raise SchemaMismatchError(
-        f"cannot merge types at {path or '<root>'}: "
+        error_class="DELTA_FAILED_TO_MERGE_FIELDS",
+        message=f"cannot merge types at {path or '<root>'}: "
         f"{current.to_json_value()} vs {incoming.to_json_value()}"
     )
 

@@ -100,7 +100,8 @@ def _table(m, engine, catalog=None) -> Table:
     if ident is not None:
         if catalog is None:
             raise CatalogTableError(
-                f"table name {ident!r} requires a catalog (pass catalog=)"
+                f"table name {ident!r} requires a catalog (pass catalog=)",
+                error_class="DELTA_MISSING_CATALOG",
             )
         return catalog.table(ident)
     return Table.for_path(_path_of(m), engine)
@@ -254,6 +255,7 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
 
     m = re.fullmatch(
         rf"ALTER\s+TABLE\s+{_PATH}\s+UNSET\s+TBLPROPERTIES\s*"
+        r"(?P<ife>IF\s+EXISTS\s*)?"
         r"\((?P<props>.+)\)",
         s, re.IGNORECASE,
     )
@@ -262,7 +264,8 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
 
         keys = [k.strip().strip("'\"`") for k in
                 _split_top_level_commas(m.group("props"))]
-        return unset_properties(_table(m, engine, catalog), keys)
+        return unset_properties(_table(m, engine, catalog), keys,
+                                if_exists=m.group("ife") is not None)
 
     m = re.fullmatch(
         rf"ALTER\s+TABLE\s+{_PATH}\s+ADD\s+COLUMNS?\s*\((?P<cols>.+)\)",
@@ -306,7 +309,7 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         typ = m.group("typ").lower()
         try:
             new_type = PrimitiveType(_SQL_TYPES.get(typ, typ))
-        except ValueError as e:
+        except (ValueError, DeltaError) as e:
             raise SqlParseError(str(e)) from e
         return change_column_type(
             _table(m, engine, catalog), m.group("col"), new_type)
@@ -438,8 +441,10 @@ def _parse_column_defs(text: str):
         metadata = {CURRENT_DEFAULT_KEY: default} if default is not None else {}
         try:
             dtype = PrimitiveType(typ)
-        except ValueError as e:
-            raise SqlParseError(f"unsupported column type in {part!r}: {e}") from None
+        except (ValueError, DeltaError) as e:
+            raise SqlParseError(
+                f"unsupported column type in {part!r}: {e}",
+                error_class="DELTA_PARSING_UNSUPPORTED_DATA_TYPE") from None
         fields.append(
             StructField(name, dtype, nullable=nullable, metadata=metadata)
         )
@@ -682,7 +687,9 @@ def _query_statement(s: str, engine, catalog):
         rw = re.match(r"REPLACE\s+WHERE\s+", rest, re.IGNORECASE)
         if rw:
             if not m.group("overwrite"):
-                raise SqlParseError("REPLACE WHERE requires INSERT OVERWRITE")
+                raise SqlParseError(
+                    "REPLACE WHERE requires INSERT OVERWRITE",
+                    error_class="DELTA_OPERATION_NOT_ALLOWED")
             pred_str, rest = _split_before_keyword(rest[rw.end():], "VALUES")
             if rest is None:
                 raise SqlParseError("REPLACE WHERE must be followed by VALUES")
@@ -703,7 +710,9 @@ def _query_statement(s: str, engine, catalog):
             if unknown:
                 raise UnresolvedColumnError(f"INSERT column(s) {unknown} not in schema")
             if len(set(targets)) != len(targets):
-                raise DuplicateColumnError(f"duplicate INSERT column(s) in {targets}")
+                raise DuplicateColumnError(
+                f"duplicate INSERT column(s) in {targets}",
+                error_class="DELTA_DUPLICATE_COLUMNS_ON_INSERT")
         else:
             targets = list(fields)
         rows = []
@@ -720,7 +729,8 @@ def _query_statement(s: str, engine, catalog):
             raise SqlParseError("INSERT requires at least one VALUES tuple")
         if any(len(r) != len(targets) for r in rows):
             raise SqlParseError(
-                f"each VALUES tuple must have exactly {len(targets)} "
+                error_class="DELTA_INSERT_COLUMN_ARITY_MISMATCH",
+                message=f"each VALUES tuple must have exactly {len(targets)} "
                 f"value(s) for columns {targets}"
             )
         from delta_tpu.models.schema import to_arrow_type
@@ -905,7 +915,9 @@ def _timestamp_ms(raw: str) -> int:
         try:
             return int(dt.datetime.fromisoformat(text).timestamp() * 1000)
         except ValueError as e:
-            raise SqlParseError(f"cannot parse timestamp {raw}: {e}") from None
+            raise SqlParseError(
+                f"cannot parse timestamp {raw}: {e}",
+                error_class="DELTA_INVALID_TIMESTAMP_FORMAT") from None
     return int(raw)
 
 

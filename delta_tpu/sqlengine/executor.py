@@ -85,7 +85,8 @@ def _run_query(q: Query, engine, catalog, ctes) -> Tuple[pd.DataFrame,
         elif len(names) != len(out_names):
             raise SqlParseError(
                 f"UNION ALL branches have different widths "
-                f"({len(out_names)} vs {len(names)})")
+                f"({len(out_names)} vs {len(names)})",
+                error_class="DELTA_UNION_WIDTH_MISMATCH")
         df = df.copy()
         df.columns = [f"__c{j}" for j in range(len(names))]
         frames.append(df)
@@ -121,7 +122,8 @@ def _run_query(q: Query, engine, catalog, ctes) -> Tuple[pd.DataFrame,
             else:
                 raise UnsupportedSqlError(
                     "ORDER BY after UNION ALL must reference output "
-                    f"column names or ordinals; got {type(e).__name__}")
+                    f"column names or ordinals; got {type(e).__name__}",
+                    error_class="DELTA_ORDER_BY_AFTER_UNION")
             result = _sql_sort(result, [f"__c{pos}"], [asc])
     if q.limit is not None:
         result = result.head(q.limit)
@@ -661,7 +663,8 @@ class _Exec:
                         and isinstance(conj.right, Col)):
                     raise UnsupportedSqlError(
                         "JOIN ON supports conjunctions of column = "
-                        f"column equalities; got {_render(conj)!r}")
+                        f"column equalities; got {_render(conj)!r}",
+                        error_class="DELTA_UNSUPPORTED_JOIN_CONDITION")
                 pl, pr = resolve(conj.left), resolve(conj.right)
                 if pl.split(".", 1)[0] == a and pr.split(".", 1)[0] != a:
                     pl, pr = pr, pl
@@ -708,7 +711,8 @@ class _Exec:
 
         if sel.having is not None and not sel.group_by and not has_agg:
             raise SqlParseError(
-                "HAVING without GROUP BY requires an aggregate")
+                "HAVING without GROUP BY requires an aggregate",
+                error_class="DELTA_HAVING_WITHOUT_GROUP_BY")
 
         alias_map = {it.alias: it.expr for it in sel.items if it.alias}
 
@@ -725,7 +729,8 @@ class _Exec:
             if isinstance(it.expr, Star):
                 if has_agg or sel.group_by:
                     raise SqlParseError("SELECT * cannot combine with "
-                                     "GROUP BY/aggregates")
+                                     "GROUP BY/aggregates",
+                                     error_class="DELTA_STAR_WITH_AGGREGATE")
                 for c in df.columns:
                     out_cols.append(df[c])
                     out_names.append(c.split(".", 1)[1] if "." in c else c)
@@ -1217,7 +1222,8 @@ class _Exec:
         if isinstance(e, Func):
             if e.name in _AGGS:
                 raise SqlParseError(
-                    f"aggregate {e.name}(...) is not allowed here")
+                    f"aggregate {e.name}(...) is not allowed here",
+                    error_class="DELTA_AGGREGATION_NOT_SUPPORTED")
             return self._scalar_func(e, df)
         if isinstance(e, Star):
             raise SqlParseError("* is only allowed as a lone select item")
@@ -1364,7 +1370,8 @@ class _Exec:
             if residual:
                 raise UnsupportedSqlError(
                     "correlated subquery has outer references but no "
-                    "equality correlation to decorrelate on")
+                    "equality correlation to decorrelate on",
+                    error_class="DELTA_UNSUPPORTED_CORRELATED_SUBQUERY")
             return None
         return _CorrInfo(corr, where_rest, residual, is_outer)
 
@@ -1377,7 +1384,8 @@ class _Exec:
         if sub.group_by or sub.having:
             raise UnsupportedSqlError(
                 "correlated subquery with its own GROUP BY/HAVING is "
-                "not supported")
+                "not supported",
+                error_class="DELTA_UNSUPPORTED_CORRELATED_SUBQUERY")
         keep = list(info.where_rest)
         where = None
         if keep:
@@ -1408,7 +1416,8 @@ class _Exec:
         if info.residual:
             raise UnsupportedSqlError(
                 "correlated scalar subquery with non-equality outer "
-                "references is not supported")
+                "references is not supported",
+                error_class="DELTA_UNSUPPORTED_CORRELATED_SUBQUERY")
         if len(sub.items) != 1 or isinstance(sub.items[0].expr, Star):
             raise SqlParseError("scalar subquery must return one column")
         val_item = SelectItem(sub.items[0].expr, alias="__cv")
@@ -1468,7 +1477,8 @@ class _Exec:
             if item is not None:
                 raise UnsupportedSqlError(
                     "correlated IN with non-equality outer references "
-                    "is not supported")
+                    "is not supported",
+                    error_class="DELTA_UNSUPPORTED_CORRELATED_SUBQUERY")
             return self._correlated_exists_residual(sub, info, df)
         extra = []
         if item is not None:
@@ -1586,7 +1596,7 @@ class _Exec:
         if e.func.distinct:
             raise UnsupportedSqlError(
                 f"DISTINCT inside window function {name} is not "
-                "supported")
+                "supported", error_class="DELTA_UNSUPPORTED_DISTINCT_IN_WINDOW")
         parts = [ev(p) for p in e.partition_by]
         parts = [p if isinstance(p, pd.Series)
                  else pd.Series([p] * len(df), index=df.index)
@@ -1632,7 +1642,8 @@ class _Exec:
                              index=df.index)
         if name in ("rank", "row_number", "dense_rank"):
             if not e.order_by:
-                raise SqlParseError(f"{name}() requires ORDER BY")
+                raise SqlParseError(f"{name}() requires ORDER BY",
+                                    error_class="DELTA_WINDOW_REQUIRES_ORDER")
             if self.spine is not None:
                 r = self.spine.window_rank(
                     parts, self._order_items(e, df, ev), name,
@@ -1679,7 +1690,8 @@ class _Exec:
                     dropna=False).transform("max")
             out = ranks.sort_index()
             return pd.Series(out.values, index=df.index)
-        raise UnsupportedSqlError(f"unsupported window function {name!r}")
+        raise UnsupportedSqlError(f"unsupported window function {name!r}",
+                                  error_class="DELTA_UNSUPPORTED_WINDOW_FUNCTION")
 
     @staticmethod
     def _order_items(e: Window, df, ev):
@@ -1786,7 +1798,8 @@ class _Exec:
             return args[0].dt.year
         if name == "month":
             return args[0].dt.month
-        raise UnsupportedSqlError(f"unsupported function {name!r}")
+        raise UnsupportedSqlError(f"unsupported function {name!r}",
+                                  error_class="DELTA_UNSUPPORTED_FUNCTION")
 
     @staticmethod
     def _truth(m):
@@ -2056,7 +2069,8 @@ def _binop(op, l, r):
         ls = l.astype("string") if isinstance(l, pd.Series) else str(l)
         rs = r.astype("string") if isinstance(r, pd.Series) else str(r)
         return ls + rs
-    raise UnsupportedSqlError(f"unsupported operator {op!r}")
+    raise UnsupportedSqlError(f"unsupported operator {op!r}",
+                              error_class="DELTA_UNSUPPORTED_SQL_OPERATOR")
 
 
 def _coerce_datetime(l, r):
@@ -2107,4 +2121,5 @@ def _cast(v, type_name):
         return v.astype("string") if isinstance(v, pd.Series) else str(v)
     if type_name.startswith("decimal"):
         return v.astype(float) if isinstance(v, pd.Series) else float(v)
-    raise UnsupportedSqlError(f"unsupported CAST target {type_name!r}")
+    raise UnsupportedSqlError(f"unsupported CAST target {type_name!r}",
+                              error_class="DELTA_UNSUPPORTED_CAST_TARGET")

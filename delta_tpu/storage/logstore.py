@@ -189,7 +189,7 @@ class LocalLogStore(LogStore):
 
 
 class FileAlreadyExistsError(FileExistsError):
-    pass
+    error_class = "DELTA_FILE_ALREADY_EXISTS"
 
 
 class InMemoryLogStore(LogStore):

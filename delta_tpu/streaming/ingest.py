@@ -102,7 +102,8 @@ class GlobalCommitter:
         for c in committables:
             if c.checkpoint_id != checkpoint_id:
                 raise StreamingSourceError(
-                    f"committable for checkpoint {c.checkpoint_id} handed "
+                    error_class="DELTA_INGEST_COMMITTABLE_MISMATCH",
+                    message=f"committable for checkpoint {c.checkpoint_id} handed "
                     f"to commit of checkpoint {checkpoint_id}")
         with self._lock:
             last = self.last_committed_checkpoint()

@@ -33,7 +33,8 @@ class DeltaSink:
         self.query_id = query_id
         self.partition_by = list(partition_by or [])
         if output_mode not in ("append", "complete"):
-            raise StreamingSourceError(f"unsupported output mode {output_mode}")
+            raise StreamingSourceError(f"unsupported output mode {output_mode}",
+                                       error_class="DELTA_MODE_NOT_SUPPORTED")
         self.output_mode = output_mode
 
     def add_batch(self, batch_id: int, data: pa.Table) -> Optional[int]:

@@ -153,7 +153,8 @@ class _ExpiryGuard:
             except ValueError:
                 continue
         raise StreamingSourceError(
-            f"commit {v} required by this {self._what} no longer exists "
+            error_class="DELTA_LOG_FILE_NOT_FOUND_FOR_STREAMING_SOURCE",
+            message=f"commit {v} required by this {self._what} no longer exists "
             "(expired by log cleanup); restart the stream from a fresh "
             "snapshot")
 
@@ -184,6 +185,12 @@ class DeltaSource:
         self.table = table
         self.ignore_deletes = ignore_deletes
         self.ignore_changes = ignore_changes
+        if starting_version is not None and starting_version < 0:
+            from delta_tpu.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"invalid starting version {starting_version}: must be >= 0",
+                error_class="DELTA_TIME_TRAVEL_INVALID_BEGIN_VALUE")
         self._starting_version = starting_version
         self._initial_files: Optional[List[AddFile]] = None
         self._initial_version: Optional[int] = None
@@ -243,7 +250,8 @@ class DeltaSource:
             elif isinstance(a, RemoveFile) and a.dataChange:
                 if not (self.ignore_deletes or self.ignore_changes):
                     raise StreamingSourceError(
-                        f"streaming source found a data-changing remove in "
+                        error_class="DELTA_SOURCE_IGNORE_DELETE",
+                        message=f"streaming source found a data-changing remove in "
                         f"version {version}; set ignore_deletes/ignore_changes "
                         "or use the CDC reader"
                     )
@@ -262,7 +270,8 @@ class DeltaSource:
             from delta_tpu.errors import DeltaError, StreamingSchemaChangeError, StreamingSourceError
 
             raise StreamingSchemaChangeError(
-                f"table schema changed at version {version}; restart the "
+                error_class="DELTA_SCHEMA_CHANGED_WITH_VERSION",
+                message=f"table schema changed at version {version}; restart the "
                 "stream (attach a SchemaTrackingLog to evolve automatically)"
             )
         from delta_tpu.streaming.schema_log import (
@@ -279,7 +288,8 @@ class DeltaSource:
             )
         )
         raise SchemaEvolutionRequiresRestart(
-            f"schema change at version {version} persisted to the schema "
+            error_class="DELTA_STREAMING_METADATA_EVOLUTION",
+            message=f"schema change at version {version} persisted to the schema "
             "log; restart the stream to continue with the new schema"
         )
 
@@ -443,10 +453,11 @@ class DeltaCDCSource:
             from delta_tpu.errors import CdcNotEnabledError
 
             # same class as the batch CDC reader: callers match on
-            # DELTA_MISSING_CHANGE_DATA for both surfaces
+            # DELTA_CHANGE_TABLE_FEED_DISABLED for both surfaces
             raise CdcNotEnabledError(
                 "change data feed is not enabled on this table "
-                "(set delta.enableChangeDataFeed=true)"
+                "(set delta.enableChangeDataFeed=true)",
+                error_class="DELTA_CHANGE_TABLE_FEED_DISABLED"
             )
         self._starting_version = starting_version
         self._initial_version: Optional[int] = None

@@ -12,6 +12,7 @@ import threading
 from typing import Optional
 
 from delta_tpu.engine.tpu import default_engine
+from delta_tpu.errors import TableNotFoundError
 from delta_tpu.log.last_checkpoint import read_last_checkpoint
 from delta_tpu.log.segment import build_log_segment
 from delta_tpu.snapshot import Snapshot
@@ -154,7 +155,14 @@ class Table:
         from delta_tpu.log.checkpointer import write_checkpoint
         from delta_tpu.log.checksum import write_checksum_from_state
 
-        snap = self.latest_snapshot() if version is None else self.snapshot_at(version)
+        try:
+            snap = (self.latest_snapshot() if version is None
+                    else self.snapshot_at(version))
+        except TableNotFoundError as e:
+            from delta_tpu.errors import CheckpointError
+
+            raise CheckpointError(
+                f"cannot checkpoint a non-existent table: {e}") from e
         write_checkpoint(self.engine, snap)
         # reseed the incremental .crc chain from the full state: a commit
         # whose checksum couldn't be derived (e.g. removes without sizes)

@@ -56,7 +56,8 @@ class DeltaTable:
     def forPath(cls, path: str, engine=None) -> "DeltaTable":
         t = Table.for_path(path, engine)
         if not t.exists():
-            raise InvalidArgumentError(f"{path} is not a Delta table")
+            raise InvalidArgumentError(f"{path} is not a Delta table",
+                                       error_class="DELTA_MISSING_DELTA_TABLE")
         return cls(t)
 
     @classmethod
@@ -250,12 +251,15 @@ class DeltaTableBuilder:
         from delta_tpu.models.schema import StructType
 
         if not self._columns:
-            raise InvalidArgumentError("table builder requires at least one column")
+            raise InvalidArgumentError(
+                "table builder requires at least one column",
+                error_class="DELTA_TARGET_TABLE_FINAL_SCHEMA_EMPTY")
         if self._location is None:
             if self._name is None or self._catalog is None:
                 raise InvalidArgumentError(
                     "table builder needs a location (or a tableName plus "
-                    "a catalog)")
+                    "a catalog)",
+                    error_class="DELTA_CREATE_TABLE_MISSING_TABLE_NAME_OR_LOCATION")
             self._location = self._catalog.default_location(self._name)
         table = Table.for_path(self._location)
         # a catalog-name conflict must surface BEFORE any commit, so a
@@ -266,15 +270,18 @@ class DeltaTableBuilder:
             if registered != table.path:
                 raise InvalidArgumentError(
                     f"catalog already maps {self._name!r} to "
-                    f"{registered}, not {table.path}")
+                    f"{registered}, not {table.path}",
+                    error_class="DELTA_TABLE_LOCATION_MISMATCH")
         exists = table.exists()
         if not exists and self._mode == "replace":
             # matches the reference: replace() demands an existing table
             raise InvalidArgumentError(
                 f"table {self._location} cannot be replaced as it does "
-                "not exist; use createOrReplace()")
+                "not exist; use createOrReplace()",
+                error_class="DELTA_CANNOT_REPLACE_MISSING_TABLE")
         if exists and self._mode == "create":
-            raise InvalidArgumentError(f"table {self._location} already exists")
+            raise InvalidArgumentError(f"table {self._location} already exists",
+                                       error_class="DELTA_TABLE_ALREADY_EXISTS")
 
         import dataclasses
 
@@ -337,7 +344,8 @@ class DeltaTableBuilder:
                 if registered != table.path:
                     raise InvalidArgumentError(
                         f"catalog already maps {self._name!r} to "
-                        f"{registered}, not {table.path}") from None
+                        f"{registered}, not {table.path}",
+                        error_class="DELTA_TABLE_LOCATION_MISMATCH") from None
         return DeltaTable(table)
 
 

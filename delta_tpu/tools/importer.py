@@ -54,7 +54,8 @@ def _detect_format(path: str) -> str:
     if ext in ("db", "sqlite", "sqlite3"):
         return "sqlite"
     raise ImportError_(
-        f"cannot infer import format from {path!r}; pass --format")
+        f"cannot infer import format from {path!r}; pass --format",
+        error_class="DELTA_IMPORT_FORMAT_UNKNOWN")
 
 
 def _expand_sources(source: str) -> List[str]:
@@ -67,7 +68,8 @@ def _expand_sources(source: str) -> List[str]:
         files = sorted(glob.glob(source)) or [source]
     missing = [f for f in files if not os.path.exists(f)]
     if missing:
-        raise ImportError_(f"source file(s) not found: {missing}")
+        raise ImportError_(f"source file(s) not found: {missing}",
+                           error_class="DELTA_IMPORT_SOURCE_NOT_FOUND")
     return files
 
 
@@ -116,7 +118,8 @@ def _iter_sqlite(path: str, query: Optional[str],
                 "SELECT name FROM sqlite_master WHERE type='table'")]
             if len(tables) != 1:
                 raise ImportError_(
-                    f"sqlite source has tables {tables}; pass --query "
+                    error_class="DELTA_IMPORT_AMBIGUOUS_QUERY",
+                    message=f"sqlite source has tables {tables}; pass --query "
                     "'SELECT ... FROM <table>'")
             query = f"SELECT * FROM {tables[0]}"
         cur = conn.execute(query)
@@ -201,7 +204,8 @@ def import_into_delta(
                 result.first_version = v
             result.last_version = v
     if result.num_chunks == 0:
-        raise ImportError_(f"source {source!r} produced no rows")
+        raise ImportError_(f"source {source!r} produced no rows",
+                           error_class="DELTA_IMPORT_EMPTY_SOURCE")
     return result
 
 

@@ -148,7 +148,7 @@ class TransactionBuilder:
         if snapshot is None and self._schema is None:
             raise InvalidArgumentError(
                 f"table {self._table.path} does not exist; provide a schema "
-                "to create it"
+                "to create it", error_class="DELTA_METADATA_ABSENT"
             )
 
         txn = Transaction(
@@ -173,6 +173,7 @@ class TransactionBuilder:
                 from delta_tpu.columnmapping import assign_column_mapping
 
                 schema_obj, props = assign_column_mapping(schema_obj, props)
+
             # creation-only protocol properties are consumed here, not
             # persisted in Metadata.configuration (reference strips
             # them the same way)
@@ -323,6 +324,7 @@ class Transaction:
         self._set_txns[app_id] = SetTransaction(app_id, version, last_updated)
 
     def update_metadata(self, metadata: Metadata) -> None:
+        _check_column_name_characters(metadata)
         # partition columns must name schema fields and be unique
         # (`DeltaErrors.partitionColumnNotFoundException` semantics)
         pcols = list(metadata.partitionColumns or [])
@@ -333,7 +335,8 @@ class Transaction:
             if missing:
                 raise InvalidArgumentError(
                     f"partition column(s) {missing} not found in schema "
-                    f"{sorted(known)}"
+                    f"{sorted(known)}",
+                    error_class="DELTA_INVALID_PARTITION_COLUMN"
                 )
             if len(set(pcols)) != len(pcols):
                 raise InvalidArgumentError(f"duplicate partition columns: {pcols}")
@@ -343,9 +346,31 @@ class Transaction:
         self._new_protocol = protocol
 
     def set_domain_metadata(self, domain: str, configuration: str) -> None:
+        self._check_domain_metadata_supported()
         self._domain_metadata[domain] = DomainMetadata(domain, configuration, removed=False)
 
+    def _check_domain_metadata_supported(self) -> None:
+        """DomainMetadata actions require the domainMetadata writer
+        feature (PROTOCOL.md domain metadata section; reference raises
+        DELTA_DOMAIN_METADATA_NOT_SUPPORTED)."""
+        # a staged upgrade (e.g. CLUSTER BY adds domainMetadata just
+        # before setting its domain) takes precedence over the snapshot
+        snap = self.read_snapshot
+        proto = self._new_protocol if self._new_protocol is not None \
+            else (snap.protocol if snap is not None else None)
+        if proto is None:
+            return
+        from delta_tpu.features import is_feature_supported, DOMAIN_METADATA
+        from delta_tpu.errors import DomainMetadataError
+
+        if not is_feature_supported(proto, DOMAIN_METADATA):
+            raise DomainMetadataError(
+                "setting domain metadata requires the domainMetadata "
+                "writer table feature (protocol "
+                f"({proto.minReaderVersion}, {proto.minWriterVersion}))")
+
     def remove_domain_metadata(self, domain: str) -> None:
+        self._check_domain_metadata_supported()
         self._domain_metadata[domain] = DomainMetadata(domain, "", removed=True)
 
     def set_operation_parameters(self, params: Dict[str, object]) -> None:
@@ -367,9 +392,13 @@ class Transaction:
         order actions; first line is commitInfo (required when ICT on)."""
         meta = self.metadata()
         if meta is None:
-            raise InvalidArgumentError("cannot commit a transaction with no metadata")
+            raise InvalidArgumentError(
+                "cannot commit a transaction with no metadata",
+                error_class="DELTA_METADATA_ABSENT")
         if self.read_snapshot is None and self._new_protocol is None:
-            raise InvalidArgumentError("new table commit must include a protocol")
+            raise InvalidArgumentError(
+                "new table commit must include a protocol",
+                error_class="DELTA_PROTOCOL_ABSENT")
         from delta_tpu.features import validate_writable
 
         validate_writable(self.protocol(), meta)
@@ -388,7 +417,8 @@ class Transaction:
             # bypass the table contract. dataChange=false removes
             # (OPTIMIZE rewrites) stay allowed.
             raise InvalidArgumentError(
-                "This table is configured to only allow appends "
+                error_class="DELTA_APPEND_ONLY_REMOVES",
+                message="This table is configured to only allow appends "
                 "(delta.appendOnly=true); data-changing removes are not "
                 "permitted")
 
@@ -569,7 +599,8 @@ class Transaction:
     def commit(self) -> CommitResult:
         """doCommitRetryIteratively (`OptimisticTransaction.scala:2198`)."""
         if self._committed:
-            raise InvalidArgumentError("transaction already committed")
+            raise InvalidArgumentError("transaction already committed",
+                                       error_class="DELTA_TRANSACTION_ALREADY_COMMITTED")
         engine = self._table.engine
         log_path = self._table.log_path
         attempt_version = self.read_version + 1
@@ -680,3 +711,45 @@ class Transaction:
             # Other post-commit hooks are best-effort (reference: hook
             # failures do not fail the commit).
             pass
+
+
+_INVALID_NAME_CHARS = " ,;{}()\n\t="
+
+
+def _check_column_name_characters(metadata: Metadata) -> None:
+    """Column names containing ' ,;{}()\\n\\t=' require column mapping
+    (PROTOCOL column-mapping section; the reference rejects them at
+    every schema change via `SchemaUtils`). Checked at the
+    update_metadata choke point so CREATE, ALTER ADD COLUMNS, and
+    schema evolution all pass through it; nested struct/array/map
+    fields included."""
+    if metadata.configuration.get("delta.columnMapping.mode",
+                                  "none") != "none":
+        return
+    schema = metadata.schema
+    if schema is None:
+        return
+    from delta_tpu.models.schema import ArrayType, MapType, StructType
+
+    bad: List[str] = []
+
+    def walk(dt, prefix: str) -> None:
+        if isinstance(dt, StructType):
+            for f in dt.fields:
+                name = f"{prefix}.{f.name}" if prefix else f.name
+                if any(c in f.name for c in _INVALID_NAME_CHARS):
+                    bad.append(name)
+                walk(f.dataType, name)
+        elif isinstance(dt, ArrayType):
+            walk(dt.elementType, prefix + "[]")
+        elif isinstance(dt, MapType):
+            walk(dt.keyType, prefix + "{key}")
+            walk(dt.valueType, prefix + "{value}")
+
+    walk(schema, "")
+    if bad:
+        raise InvalidArgumentError(
+            f"column name(s) {bad} contain invalid characters "
+            "(' ,;{}()\\n\\t='); enable column mapping "
+            "(delta.columnMapping.mode = 'name') to use them",
+            error_class="DELTA_INVALID_CHARACTERS_IN_COLUMN_NAME")

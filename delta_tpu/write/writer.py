@@ -35,7 +35,8 @@ def _check_invariants(table: pa.Table, schema: StructType, constraints=None) -> 
             nulls = table.column(f.name).null_count
             if nulls:
                 raise InvariantViolationError(
-                    f"NOT NULL constraint violated for column {f.name}: "
+                    error_class="DELTA_NOT_NULL_CONSTRAINT_VIOLATED",
+                    message=f"NOT NULL constraint violated for column {f.name}: "
                     f"{nulls} null row(s)"
                 )
     if constraints:
@@ -46,7 +47,8 @@ def _check_invariants(table: pa.Table, schema: StructType, constraints=None) -> 
             bad = int((~ok).sum())
             if bad:
                 raise InvariantViolationError(
-                    f"CHECK constraint {name} violated by {bad} row(s)"
+                    error_class="DELTA_VIOLATE_CONSTRAINT_WITH_VALUES",
+                    message=f"CHECK constraint {name} violated by {bad} row(s)"
                 )
 
 
@@ -56,8 +58,16 @@ def _validate_schema(table: pa.Table, schema: StructType) -> None:
     missing = schema_fields - table_fields
     extra = table_fields - schema_fields
     if extra:
+        reserved = {"_change_type", "_commit_version", "_commit_timestamp"}
+        if reserved & extra:
+            raise SchemaMismatchError(
+                f"columns {sorted(reserved & extra)} are reserved for the "
+                "change data feed and cannot be written",
+                error_class="RESERVED_CDC_COLUMNS_ON_WRITE",
+            )
         raise SchemaMismatchError(
-            f"columns {sorted(extra)} not in table schema {sorted(schema_fields)}"
+            f"columns {sorted(extra)} not in table schema {sorted(schema_fields)}",
+            error_class="DELTA_COLUMN_NOT_FOUND_IN_SCHEMA",
         )
     if missing:
         nonnull_missing = [
@@ -65,7 +75,8 @@ def _validate_schema(table: pa.Table, schema: StructType) -> None:
         ]
         if nonnull_missing:
             raise SchemaMismatchError(
-                f"missing non-nullable columns: {sorted(nonnull_missing)}"
+                error_class="DELTA_MISSING_NOT_NULL_COLUMN_VALUE",
+                message=f"missing non-nullable columns: {sorted(nonnull_missing)}"
             )
 
 
@@ -174,7 +185,9 @@ def _partition_groups(data: pa.Table, partition_columns: List[str]):
     key_cols = []
     for c in partition_columns:
         if c not in data.column_names:
-            raise SchemaMismatchError(f"partition column {c} missing from data")
+            raise SchemaMismatchError(
+                f"partition column {c} missing from data",
+                error_class="DELTA_MISSING_PARTITION_COLUMN")
         key_cols.append(data.column(c).to_pandas())
     if len(key_cols) == 1:
         codes, uniques = pd.factorize(key_cols[0], use_na_sentinel=False)

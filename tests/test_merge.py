@@ -347,3 +347,50 @@ def test_merge_duplicate_assignment_differing_case_raises(target_path):
     )
     with pytest.raises(DeltaError, match="duplicate assignment"):
         m.execute()
+
+
+def test_merge_clause_validation(tmp_table_path):
+    """Reference analysis rules: MERGE without WHEN clauses, and
+    non-last clauses omitting their condition (which would shadow
+    later clauses) are rejected with their catalog classes."""
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.commands.merge import merge
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.expressions.tree import col
+    from delta_tpu.table import Table
+
+    dta.write_table(tmp_table_path, pa.table({"id": [1, 2]}))
+    t = Table.for_path(tmp_table_path)
+    src = pa.table({"id": [2, 3]})
+    on = col("target.id") == col("source.id")
+
+    with pytest.raises(DeltaError) as ei:
+        merge(t, src, on).execute()
+    assert error_info(ei.value)["errorClass"] == "DELTA_MERGE_MISSING_WHEN"
+
+    b = (merge(t, src, on)
+         .when_matched_delete()            # unconditional, NOT last
+         .when_matched_update_all())
+    with pytest.raises(DeltaError) as ei:
+        b.execute()
+    assert error_info(ei.value)["errorClass"] == \
+        "DELTA_NON_LAST_MATCHED_CLAUSE_OMIT_CONDITION"
+
+    b = (merge(t, src, on)
+         .when_not_matched_insert_all()
+         .when_not_matched_insert(values={"id": col("source.id")}))
+    with pytest.raises(DeltaError) as ei:
+        b.execute()
+    assert error_info(ei.value)["errorClass"] == \
+        "DELTA_NON_LAST_NOT_MATCHED_CLAUSE_OMIT_CONDITION"
+
+    b = (merge(t, src, on)
+         .when_not_matched_by_source_delete()
+         .when_not_matched_by_source_update(set={"id": col("target.id")}))
+    with pytest.raises(DeltaError) as ei:
+        b.execute()
+    assert error_info(ei.value)["errorClass"] == \
+        "DELTA_NON_LAST_NOT_MATCHED_BY_SOURCE_CLAUSE_OMIT_CONDITION"

@@ -206,7 +206,15 @@ def test_sql_alter_add_rename_drop_columns(tmp_table_path):
     snap = Table.for_path(tmp_table_path).latest_snapshot()
     assert snap.schema["cnt"].dataType.name == "long"
 
-    sql(f"ALTER TABLE '{tmp_table_path}' UNSET TBLPROPERTIES ('nokey')")
+    # without IF EXISTS, unsetting an unknown key is an error
+    import pytest as _pytest
+
+    from delta_tpu.errors import DeltaError
+
+    with _pytest.raises(DeltaError, match="non-existent"):
+        sql(f"ALTER TABLE '{tmp_table_path}' UNSET TBLPROPERTIES ('nokey')")
+    sql(f"ALTER TABLE '{tmp_table_path}' UNSET TBLPROPERTIES IF EXISTS "
+        "('nokey')")
 
 
 def test_upgrade_to_feature_vectors_keeps_implied_legacy_features(tmp_table_path):

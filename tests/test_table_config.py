@@ -88,7 +88,9 @@ def test_interval_parser_units():
     assert p("interval 1 week") == 7 * 86_400_000
     assert p("interval 12 hours") == 12 * 3_600_000
     assert p("1234") == 1234
-    with pytest.raises(KeyError):
+    from delta_tpu.errors import InvalidTablePropertyError
+
+    with pytest.raises(InvalidTablePropertyError, match="invalid interval"):
         p("interval 1 fortnight")
 
 
@@ -96,7 +98,10 @@ def test_isolation_level_validated():
     c = TABLE_CONFIGS["delta.isolationLevel"]
     assert get_table_config(
         {c.key: "SnapshotIsolation"}, c) == "SnapshotIsolation"
-    with pytest.raises(ValueError):
+    from delta_tpu.errors import InvalidTablePropertyError
+
+    with pytest.raises(InvalidTablePropertyError,
+                       match="isolationLevel"):
         get_table_config({c.key: "ReadCommitted"}, c)
 
 
