@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional
 
-from delta_tpu.errors import DeltaError, FeatureDropError, MissingTransactionLogError
+from delta_tpu.errors import FeatureDropHistoricalVersionsExistError, DeltaError, FeatureDropError, MissingTransactionLogError
 from delta_tpu.features import FEATURES, TableFeature, is_feature_supported
 from delta_tpu.models.actions import Metadata, Protocol
 from delta_tpu.models.schema import (
@@ -104,9 +104,8 @@ def drop_feature(table, feature_name: str, truncate_history: bool = False) -> in
     # checkpoints; those stay readable until history is truncated
     if feature.is_reader_writer and feature_name != "vacuumProtocolCheck":
         if not truncate_history:
-            raise FeatureDropError(
-                error_class="DELTA_FEATURE_DROP_HISTORICAL_VERSIONS_EXIST",
-                message=f"dropping reader+writer feature {feature_name!r} requires "
+            raise FeatureDropHistoricalVersionsExistError(
+                f"dropping reader+writer feature {feature_name!r} requires "
                 "history truncation: historical versions may still carry the "
                 "feature. Re-run with TRUNCATE HISTORY "
                 "(drop_feature(..., truncate_history=True))")

@@ -16,11 +16,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from delta_tpu.config import TOMBSTONE_RETENTION, get_table_config
-from delta_tpu.errors import (
-    DeltaError,
-    InvalidArgumentError,
-    VacuumRetentionError,
-)
+from delta_tpu.errors import InvalidArgumentError, VacuumRetentionError
 from delta_tpu.utils import filenames
 
 

@@ -132,8 +132,7 @@ def version_at_timestamp(
         # strictly after the newest commit: reference raises unless
         # explicitly allowed (e.g. streaming startingTimestamp)
         raise TimestampLaterThanLatestCommitError(
-            error_class="DELTA_TIMESTAMP_GREATER_THAN_COMMIT",
-            message=f"timestamp {timestamp_ms} is after the latest commit "
+            f"timestamp {timestamp_ms} is after the latest commit "
             f"(ts {ict_ts[-1]}); retry with a timestamp <= {ict_ts[-1]}"
         )
     return best

@@ -50,8 +50,7 @@ def table_changes(
     if not cdf_enabled(conf):
         raise CdcNotEnabledError(
             "change data feed is not enabled on this table "
-            "(set delta.enableChangeDataFeed=true)",
-            error_class="DELTA_CHANGE_TABLE_FEED_DISABLED"
+            "(set delta.enableChangeDataFeed=true)"
         )
     end = ending_version if ending_version is not None else snap.version
     if end < starting_version:

@@ -456,8 +456,7 @@ class DeltaCDCSource:
             # DELTA_CHANGE_TABLE_FEED_DISABLED for both surfaces
             raise CdcNotEnabledError(
                 "change data feed is not enabled on this table "
-                "(set delta.enableChangeDataFeed=true)",
-                error_class="DELTA_CHANGE_TABLE_FEED_DISABLED"
+                "(set delta.enableChangeDataFeed=true)"
             )
         self._starting_version = starting_version
         self._initial_version: Optional[int] = None
