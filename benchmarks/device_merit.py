@@ -452,6 +452,61 @@ def wl_sql_sort(n, device):
             "device_wins": t_dev < t_host}
 
 
+def wl_page_decode(n, device):
+    """Checkpoint Parquet page decode: the thrift/page split + Pallas
+    bit-unpack + dictionary-gather path (log/page_decode.py) vs
+    pyarrow's C++ reader on the same single column."""
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from delta_tpu.log.page_decode import read_checkpoint_column
+
+    rng = np.random.default_rng(21)
+    vals = rng.integers(0, 60_000, n)  # dictionary-encodable domain
+    path = tempfile.mktemp(suffix=".parquet")
+    pq.write_table(pa.table({"x": pa.array(vals, pa.int64())}), path)
+
+    def dev():
+        v, ok = read_checkpoint_column(path, "x", device=device)
+        return int(v[ok].sum())
+
+    got = dev()
+    t_dev = _best(dev, k=2)
+
+    def host():
+        return int(pq.read_table(path, columns=["x"])
+                   .column("x").to_numpy().sum())
+
+    assert host() == got
+    t_host = _best(host, k=2)
+
+    # isolated compute: the unpack kernel on resident padded words
+    import jax
+
+    from delta_tpu.ops import sqlops  # noqa: F401  (x64 on)
+    from delta_tpu.ops.pallas_kernels import (
+        _TILE,
+        unpack_bitpacked_tiled,
+    )
+
+    w = 16
+    groups = -(-n // 32)
+    padded = -(-groups // _TILE) * _TILE
+    words = rng.integers(0, 1 << 32, (w, padded), dtype=np.uint64)         .astype(np.uint32)
+    dw = jax.device_put(words, device)
+    unpack_bitpacked_tiled(dw, w).block_until_ready()
+    t_comp = _best(
+        lambda: unpack_bitpacked_tiled(dw, w).block_until_ready(), k=3)
+    bytes_moved = padded * w * 4 + n * 4
+    os.unlink(path)
+    return {"n": n, "t_device_s": t_dev, "t_host_s": t_host,
+            "t_device_compute_s": t_comp,
+            "bytes_transferred_est": int(bytes_moved),
+            "device_wins": t_dev < t_host}
+
+
 # ------------------------------------------------------- cost model --
 
 
@@ -497,7 +552,8 @@ def main():
             ("merge_join", wl_merge_join, args.join_rows),
             ("sql_groupby", wl_sql_groupby, args.sql_rows),
             ("sql_join", wl_sql_join, args.sql_rows),
-            ("sql_sort", wl_sql_sort, args.sql_rows)):
+            ("sql_sort", wl_sql_sort, args.sql_rows),
+            ("page_decode", wl_page_decode, args.sql_rows)):
         print(f"== {name} @ {n} rows", file=sys.stderr)
         wl = fn(n, device)
         wl["model"] = model(link, wl)

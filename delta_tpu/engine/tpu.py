@@ -64,6 +64,11 @@ class TpuEngine(HostEngine):
     # SQL engine relational spine (join/group-by/window sort) runs on
     # the device kernels in ops/sqlops.py; see sqlengine/device.py
     use_device_sql = True
+    # checkpoint Parquet page decode through the Pallas bit-unpack
+    # kernel (log/page_decode.py) — opt-in while the Arrow reader
+    # remains the measured default on tunnel deployments; resolved at
+    # construction so in-process env changes take effect
+    use_device_page_decode = False
 
     def __init__(
         self,
@@ -79,6 +84,8 @@ class TpuEngine(HostEngine):
         self.expressions = DeviceExpressionHandler()
         self.mesh = mesh
         self.replay_shards = replay_shards
+        self.use_device_page_decode = (
+            os.environ.get("DELTA_TPU_DEVICE_PAGE_DECODE") == "1")
 
 
 def default_engine(**kwargs) -> TpuEngine:

@@ -149,3 +149,84 @@ def batched_file_stats(values: np.ndarray, valid: np.ndarray):
     num_records = np.full(f, r, dtype=np.int64)
     null_count = num_records - cnt
     return mn, mx, null_count, num_records
+
+
+# ---------------------------------------------------------------------------
+# parquet bit-packed group decode (checkpoint page decoder)
+# ---------------------------------------------------------------------------
+
+
+def _unpack_kernel(w: int, in_ref, out_ref):
+    """in_ref: [w, 8, 128] uint32 (word-index-major, like the
+    interleave kernel's layout); out_ref: [32, 8, 128] uint32 values.
+
+    One Parquet bit-packed GROUP is 32 values x w bits = w u32 words;
+    value j of a group lives at bit j*w, so its word index j*w//32 and
+    shift j*w%32 are STATIC per j — the 32-step loop unrolls into pure
+    vector shifts/ors over the [8, 128] group tile (the exact inverse
+    of `_interleave_kernel`)."""
+    mask = jnp.uint32((1 << w) - 1) if w < 32 else jnp.uint32(0xFFFFFFFF)
+    for j in range(32):
+        bitpos = j * w
+        lo, sh = divmod(bitpos, 32)
+        v = in_ref[lo] >> jnp.uint32(sh)
+        if sh + w > 32:
+            v = v | (in_ref[lo + 1] << jnp.uint32(32 - sh))
+        out_ref[j] = v & mask
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def unpack_bitpacked_tiled(packed: jnp.ndarray, w: int) -> jnp.ndarray:
+    """packed: [w, G] uint32 (word-major: packed[k, g] = word k of
+    group g; G a multiple of 1024) -> [G * 32] uint32 values, group-
+    major (value j of group g at g*32 + j)."""
+    g = packed.shape[1]
+    assert g % _TILE == 0, g
+    tiles = g // _TILE
+    shaped = packed.reshape(w, tiles * _SUBLANES, _LANES)
+    out = pl.pallas_call(
+        functools.partial(_unpack_kernel, w),
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec((w, _SUBLANES, _LANES), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((32, _SUBLANES, _LANES), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, tiles * _SUBLANES, _LANES),
+                                       jnp.uint32),
+        interpret=_use_interpret(),
+    )(shaped)
+    # [32, G] -> group-major [G, 32] -> flat
+    return out.reshape(32, -1).T.reshape(-1)
+
+
+def unpack_bitpacked(packed_words: np.ndarray, w: int,
+                     n_groups: int, device=None) -> jnp.ndarray:
+    """Decode `n_groups` Parquet bit-packed groups (32 values x w bits
+    each) from a flat little-endian u32 word stream. Pallas when
+    available, jnp fallback with identical semantics. Returns a device
+    array of n_groups*32 uint32 values."""
+    if w == 0:
+        return jnp.zeros(n_groups * 32, jnp.uint32)
+    need = n_groups * w
+    padded_groups = -(-max(n_groups, 1) // _TILE) * _TILE
+    buf = np.zeros(padded_groups * w, np.uint32)
+    buf[:need] = packed_words[:need]
+    # [G, w] group-major words -> [w, G] word-major for the kernel
+    shaped = np.ascontiguousarray(buf.reshape(padded_groups, w).T)
+    arr = jax.device_put(shaped, device)
+    if not HAVE_PALLAS:
+        return _unpack_jnp(arr, w)[:n_groups * 32]
+    return unpack_bitpacked_tiled(arr, w)[:n_groups * 32]
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _unpack_jnp(packed: jnp.ndarray, w: int) -> jnp.ndarray:
+    """packed: [w, G] word-major; same output layout as the kernel."""
+    g = packed.shape[1]
+    mask = jnp.uint32((1 << w) - 1) if w < 32 else jnp.uint32(0xFFFFFFFF)
+    outs = []
+    for j in range(32):
+        lo, sh = divmod(j * w, 32)
+        v = packed[lo] >> jnp.uint32(sh)
+        if sh + w > 32:
+            v = v | (packed[lo + 1] << jnp.uint32(32 - sh))
+        outs.append(v & mask)
+    return jnp.stack(outs, axis=-1).reshape(g * 32)

@@ -651,6 +651,15 @@ def columnarize_log_segment(
 
     def _read_checkpoint_part(path: str):
         if not small_only:
+            if getattr(engine, "use_device_page_decode", False):
+                from delta_tpu.log.page_decode import (
+                    read_checkpoint_part_hybrid,
+                )
+
+                tbl = read_checkpoint_part_hybrid(path)
+                if tbl is not None:
+                    yield tbl
+                    return
             yield from engine.parquet.read_parquet_files([path])
             return
         try:

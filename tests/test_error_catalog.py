@@ -71,6 +71,9 @@ _ALLOWED_NON_DELTA = {
     "FileAlreadyExistsError", "PreconditionFailedError",
     "TableAlreadyExistsError", "TableNotInCatalogError",
     "ParseError", "CommitFailedException",
+    # internal fall-back signal of the page decoder: always caught,
+    # the Arrow reader takes over (log/page_decode.py)
+    "DecodeUnsupported",
 }
 
 

@@ -1,0 +1,220 @@
+"""Device checkpoint-page decoder (`log/page_decode.py` + the Pallas
+bit-unpack kernel) vs the Arrow reader as oracle: kernel-level width
+fuzz, page-level parity on synthetic parquet (nulls, multiple row
+groups, dictionary + plain fallbacks), real checkpoint files incl. the
+golden fixtures, and the hybrid grafted read equaling a plain Arrow
+read. The reference hand-rolls this decode in
+`kernel-defaults/.../internal/parquet/ParquetFileReader.java`."""
+
+import glob
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu.log.page_decode import (
+    DecodeUnsupported,
+    read_checkpoint_column,
+    read_checkpoint_part_hybrid,
+)
+from delta_tpu.ops.pallas_kernels import unpack_bitpacked
+from delta_tpu.table import Table
+
+
+# ---- kernel: every width vs a bit-level reference packer -------------
+
+def _pack_reference(vals, w):
+    bits = np.zeros(len(vals) * w, np.uint8)
+    for i, v in enumerate(vals):
+        for b in range(w):
+            bits[i * w + b] = (int(v) >> b) & 1
+    words = np.zeros(-(-len(bits) // 32), np.uint32)
+    for i, bit in enumerate(bits):
+        if bit:
+            words[i // 32] |= np.uint32(1) << np.uint32(i % 32)
+    return words
+
+
+@pytest.mark.parametrize("w", [1, 2, 3, 4, 5, 7, 8, 11, 16, 21, 31, 32])
+def test_unpack_kernel_widths(w):
+    rng = np.random.default_rng(w)
+    n_groups = 9
+    vals = (rng.integers(0, 1 << 62, n_groups * 32, dtype=np.uint64)
+            & np.uint64((1 << w) - 1)).astype(np.uint64)
+    out = np.asarray(unpack_bitpacked(_pack_reference(vals, w), w,
+                                      n_groups))
+    assert np.array_equal(out, vals.astype(np.uint32))
+
+
+# ---- page-level parity on synthetic parquet --------------------------
+
+def _roundtrip(table, tmp_path, **write_kw):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(table, p, **write_kw)
+    return p
+
+
+def _column_parity(path, col):
+    ref = pq.read_table(path)
+    parts = col.split(".")
+    a = ref.column(parts[0])
+    for sub in parts[1:]:
+        a = pc.struct_field(a, sub)
+    vals, valid = read_checkpoint_column(path, col)
+    exp = a.to_pylist()
+    got = [None if not v else
+           (bool(x) if vals.dtype == bool else
+            float(x) if vals.dtype == np.float64 else int(x))
+           for x, v in zip(vals.tolist(), valid.tolist())]
+    assert got == [None if e is None else
+                   (bool(e) if isinstance(e, bool) else
+                    float(e) if isinstance(e, float) else int(e))
+                   for e in exp], col
+
+
+@pytest.mark.parametrize("codec", ["snappy", "none"])
+def test_flat_int64_with_nulls(tmp_path, codec):
+    rng = np.random.default_rng(1)
+    n = 5_000
+    vals = rng.integers(0, 50, n)  # small domain -> dictionary
+    mask = rng.random(n) < 0.1
+    t = pa.table({"x": pa.array(
+        [None if m else int(v) for v, m in zip(vals, mask)],
+        pa.int64())})
+    p = _roundtrip(t, tmp_path, compression=codec)
+    _column_parity(p, "x")
+
+
+def test_plain_fallback_high_cardinality(tmp_path):
+    # a huge domain overflows the dictionary -> PLAIN data pages
+    rng = np.random.default_rng(2)
+    n = 200_000
+    t = pa.table({"x": pa.array(rng.integers(0, 1 << 60, n),
+                                pa.int64())})
+    p = _roundtrip(t, tmp_path, dictionary_pagesize_limit=1024,
+                   data_page_size=64 << 10)
+    _column_parity(p, "x")
+
+
+def test_boolean_and_double_and_multiple_row_groups(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 30_000
+    t = pa.table({
+        "b": pa.array([None if x < 0.05 else bool(x < 0.5)
+                       for x in rng.random(n)], pa.bool_()),
+        "d": pa.array(np.round(rng.random(n) * 100, 2), pa.float64()),
+    })
+    p = _roundtrip(t, tmp_path, row_group_size=7_000)
+    _column_parity(p, "b")
+    _column_parity(p, "d")
+
+
+def test_nested_struct_levels(tmp_path):
+    rng = np.random.default_rng(4)
+    rows = []
+    for i in range(4_000):
+        r = rng.random()
+        if r < 0.1:
+            rows.append(None)  # struct null (def 0)
+        elif r < 0.2:
+            rows.append({"size": None, "flag": None})  # field null (1)
+        else:
+            rows.append({"size": int(rng.integers(0, 99)),
+                         "flag": bool(rng.random() < 0.5)})
+    t = pa.table({"add": pa.array(
+        rows, pa.struct([("size", pa.int64()), ("flag", pa.bool_())]))})
+    p = _roundtrip(t, tmp_path)
+    _column_parity(p, "add.size")
+    _column_parity(p, "add.flag")
+
+
+def test_unsupported_shapes_raise(tmp_path):
+    t = pa.table({"s": pa.array(["a", "b"]),
+                  "l": pa.array([[1, 2], [3]], pa.list_(pa.int64()))})
+    p = _roundtrip(t, tmp_path)
+    with pytest.raises(DecodeUnsupported):
+        read_checkpoint_column(p, "s")  # BYTE_ARRAY out of scope
+    with pytest.raises(DecodeUnsupported):
+        read_checkpoint_column(p, "l.list.element")  # repeated
+
+
+# ---- real checkpoints ------------------------------------------------
+
+@pytest.fixture
+def checkpoint_path(tmp_table_path):
+    rng = np.random.default_rng(5)
+    for i in range(15):
+        dta.write_table(
+            tmp_table_path,
+            pa.table({"id": pa.array(rng.integers(0, 1000, 200))}),
+            mode="append" if i else "error")
+    t = Table.for_path(tmp_table_path)
+    t.checkpoint()
+    return glob.glob(
+        tmp_table_path + "/_delta_log/*.checkpoint.parquet")[0]
+
+
+def test_real_checkpoint_columns(checkpoint_path):
+    for col in ("add.size", "add.modificationTime", "add.dataChange"):
+        _column_parity(checkpoint_path, col)
+
+
+def test_golden_checkpoints():
+    fixtures = glob.glob(os.path.join(
+        os.path.dirname(__file__), "golden_fixtures", "**",
+        "*.checkpoint.parquet"), recursive=True)
+    checked = 0
+    for path in fixtures:
+        leaves = {pq.ParquetFile(path).metadata.schema.column(i).path
+                  for i in range(
+                      len(pq.ParquetFile(path).metadata.schema))}
+        for col in ("add.size", "add.modificationTime",
+                    "add.dataChange"):
+            if col in leaves:
+                _column_parity(path, col)
+                checked += 1
+    assert checked > 0, "no golden checkpoints found"
+
+
+def test_hybrid_graft_equals_arrow_read(checkpoint_path):
+    ref = pq.read_table(checkpoint_path)
+    got = read_checkpoint_part_hybrid(checkpoint_path)
+    assert got is not None
+    assert set(got.column_names) == set(ref.column_names)
+    for name in ref.column_names:
+        assert got.column(name).combine_chunks().equals(
+            ref.column(name).combine_chunks()), name
+
+
+def test_snapshot_load_with_device_decode_flag(tmp_table_path,
+                                               monkeypatch):
+    rng = np.random.default_rng(6)
+    for i in range(13):
+        dta.write_table(
+            tmp_table_path,
+            pa.table({"id": pa.array(rng.integers(0, 100, 300))}),
+            mode="append" if i else "error")
+    t = Table.for_path(tmp_table_path)
+    t.checkpoint()
+    dta.write_table(tmp_table_path, pa.table(
+        {"id": pa.array([1, 2])}), mode="append")
+
+    from delta_tpu.engine.tpu import TpuEngine
+
+    base = Table.for_path(tmp_table_path,
+                          TpuEngine()).latest_snapshot()
+    monkeypatch.setenv("DELTA_TPU_DEVICE_PAGE_DECODE", "1")
+    eng = TpuEngine()
+    assert eng.use_device_page_decode  # env resolved at construction
+    snap = Table.for_path(tmp_table_path, eng).latest_snapshot()
+    assert snap.num_files == base.num_files
+    a = snap.state.add_files_table
+    b = base.state.add_files_table
+    assert sorted(a.column("path").to_pylist()) == \
+        sorted(b.column("path").to_pylist())
+    assert sorted(a.column("size").to_pylist()) == \
+        sorted(b.column("size").to_pylist())
