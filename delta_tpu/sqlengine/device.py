@@ -360,11 +360,34 @@ class DeviceSpine:
         return pd.Series(out, index=index)
 
 
+def _link_supports_sql_offload() -> bool:
+    """SQL operators ship full columns both ways, so the interconnect
+    decides (DEVICE_MERIT.json: on the tunnel deployment the link —
+    6-26MB/s, ~120ms RTT — makes every SQL op slower on device at any
+    size). Auto-engage only when the device is locally attached: the
+    CPU backend (tests' virtual mesh; transfers are memcpy) or a real
+    PCIe/ICI TPU. The axon tunnel platform is the measured exception."""
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        backend = xb.get_backend(jax.default_backend())
+        # the tunnel registers as the 'axon' PJRT plugin (device
+        # .platform still reads 'tpu'); PALLAS_AXON_POOL_IPS is its
+        # launch marker
+        name = next((k for k, v in xb.backends().items()
+                     if v is backend), jax.default_backend())
+        return name != "axon"
+    except Exception:
+        return False
+
+
 def spine_for(engine, catalog=None) -> Optional[DeviceSpine]:
     """Resolve whether this query runs the device spine.
     DELTA_TPU_DEVICE_SQL=0 forces host pandas; =1 forces the device
-    path regardless of engine; otherwise the engine's
-    `use_device_sql` attribute decides (TpuEngine: on)."""
+    path regardless of engine/link; otherwise the engine's
+    `use_device_sql` attribute (TpuEngine: on) AND the link gate
+    decide."""
     import os
 
     flag = os.environ.get("DELTA_TPU_DEVICE_SQL", "")
@@ -381,4 +404,6 @@ def spine_for(engine, catalog=None) -> Optional[DeviceSpine]:
         use = True
     else:
         use = getattr(eng, "use_device_sql", False)
+    if use and not _link_supports_sql_offload():
+        return None
     return DeviceSpine() if use else None

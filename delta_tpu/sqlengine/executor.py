@@ -618,15 +618,23 @@ class _Exec:
         joined = {first_alias}
         remaining = [a for a in implicit if a != first_alias]
         while remaining:
+            # greedy order: most connecting equi-edges first (a 2-key
+            # join is far more selective than either key alone — q72's
+            # inventory joins on (item_sk, date_sk) once d2 is in),
+            # tie-broken by smallest right frame so big fact tables
+            # join after the filtering dims
             pick = None
+            best_score = None
             for a in remaining:
                 keys = [(pl, pr) if al in joined else (pr, pl)
                         for (al, pl, ar, pr, c) in edges
                         if (al in joined and ar == a)
                         or (ar in joined and al == a)]
                 if keys:
-                    pick = (a, keys)
-                    break
+                    score = (len(keys), -len(by_alias[a]["frame"]))
+                    if best_score is None or score > best_score:
+                        best_score = score
+                        pick = (a, keys)
             if pick is None:  # no connecting predicate: cross join
                 a = remaining[0]
                 current = current.merge(by_alias[a]["frame"], how="cross")
@@ -646,16 +654,10 @@ class _Exec:
             remaining.remove(a)
             current = apply_eager(current)
 
-        for k, j in enumerate(sel.joins):
-            a = join_aliases[k]
-            right = by_alias[a]["frame"]
-            how = {"inner": "inner", "left outer": "left",
-                   "right outer": "right", "full outer": "outer",
-                   "cross": "cross"}[j.kind]
-            if j.kind == "cross":
-                current = current.merge(right, how="cross")
-                joined.add(a)
-                continue
+        def _on_keys(a, j):
+            """ON conjuncts of explicit join `a` as (left, right) key
+            pairs; None when a non-`a` side is not joined yet (the
+            join cannot run at this point)."""
             lk, rk = [], []
             for conj in _split_and(j.on):
                 if not (isinstance(conj, Cmp) and conj.op == "="
@@ -672,8 +674,83 @@ class _Exec:
                     raise UnsupportedSqlError(
                         f"JOIN keys {pl!r}/{pr!r} do not span the "
                         "two sides")
+                if pl.split(".", 1)[0] not in joined:
+                    return None
                 lk.append(pl)
                 rk.append(pr)
+            return lk, rk
+
+        # inner-join PREFIX commutes: reorder it greedily like the
+        # implicit pool (most keys first — WHERE equi-edges count, so
+        # q72's inventory waits for d2 and then joins on BOTH
+        # (item_sk, date_sk via week) — tie-break smallest frame).
+        # Outer/cross joins and everything after them keep clause order.
+        explicit = list(zip(join_aliases, sel.joins))
+        n_inner = 0
+        for a, j in explicit:
+            if j.kind != "inner":
+                break
+            n_inner += 1
+        pool = explicit[:n_inner]
+        tail = explicit[n_inner:]
+        while pool:
+            best = None
+            best_score = None
+            for a, j in pool:
+                on = _on_keys(a, j)
+                if on is None:
+                    continue
+                # WHERE edges fold into keys ONLY when no later
+                # outer join can null-extend their aliases — filtering
+                # before a RIGHT/FULL join would resurrect unmatched
+                # rows the residual WHERE must drop
+                wk = [(pl, pr) if al in joined else (pr, pl)
+                      for (al, pl, ar, pr, c) in edges
+                      if ((al in joined and ar == a)
+                          or (ar in joined and al == a))
+                      and not ({al, ar} & null_supplying)]
+                keys = [(l, r) for l, r in zip(on[0], on[1])] + wk
+                score = (len(keys), -len(by_alias[a]["frame"]))
+                if best_score is None or score > best_score:
+                    best_score = score
+                    best = (a, j, keys)
+            if best is None:  # unsatisfiable ON ordering: clause order
+                a, j = pool[0]
+                on = _on_keys(a, j)
+                if on is None:
+                    raise UnsupportedSqlError(
+                        f"JOIN ON for {a!r} references aliases joined "
+                        "after it")
+                best = (a, j, list(zip(on[0], on[1])))
+            a, j, keys = best
+            lk = [l for l, _ in keys]
+            rk = [r for _, r in keys]
+            current = _merge_null_safe(current, by_alias[a]["frame"],
+                                       "inner", lk, rk,
+                                       spine=self.spine)
+            for (al, pl, ar, pr, c) in edges:
+                if c is not None and {al, ar} <= joined | {a} \
+                        and not ({al, ar} & null_supplying):
+                    consumed.add(id(c))
+            joined.add(a)
+            pool = [(pa, pj) for pa, pj in pool if pa != a]
+            current = apply_eager(current)
+
+        for a, j in tail:
+            right = by_alias[a]["frame"]
+            how = {"inner": "inner", "left outer": "left",
+                   "right outer": "right", "full outer": "outer",
+                   "cross": "cross"}[j.kind]
+            if j.kind == "cross":
+                current = current.merge(right, how="cross")
+                joined.add(a)
+                continue
+            on = _on_keys(a, j)
+            if on is None:
+                raise UnsupportedSqlError(
+                    f"JOIN ON for {a!r} references aliases joined "
+                    "after it")
+            lk, rk = on
             current = _merge_null_safe(current, right, how, lk, rk,
                                        spine=self.spine)
             joined.add(a)

@@ -524,3 +524,21 @@ def test_distinct_sum_grouped(t):
               f"GROUP BY id IS NULL ORDER BY k")
     # ids 1-4 have v 10..40 (distinct); null id has v 50
     assert out.column("s").to_pylist() == [100.0, 50.0]
+
+
+def test_where_edge_not_folded_before_right_join(tmp_path):
+    """A WHERE equality between inner-joined aliases must stay a
+    residual filter when a later RIGHT JOIN can null-extend them:
+    folding it into the inner join's keys would resurrect unmatched
+    right rows as null-extended survivors."""
+    f = str(tmp_path / "f")
+    d = str(tmp_path / "d")
+    x = str(tmp_path / "x")
+    dta.write_table(f, pa.table({"k": [1], "j": [1], "a": [1]}))
+    dta.write_table(d, pa.table({"k": [1], "b": [2]}))
+    dta.write_table(x, pa.table({"j": [1]}))
+    out = sql(f"SELECT x.j FROM '{f}' f JOIN '{d}' d ON f.k = d.k "
+              f"RIGHT JOIN '{x}' x ON x.j = f.j WHERE f.a = d.b")
+    # f.a = d.b is false on the only row: the WHERE (applied after the
+    # right join) removes everything — 0 rows, not a null-extended one
+    assert out.num_rows == 0

@@ -168,6 +168,56 @@ class SqliteOracle:
         cur = self.conn.execute(_rewrite(query))
         return cur.fetchall()
 
+    def create_indexes(self) -> int:
+        """Index every surrogate/join-key column (*_sk) — the timing
+        configuration (any real warehouse has these); correctness runs
+        skip them so plans stay unassisted."""
+        n = 0
+        for (name,) in list(self.conn.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'")):
+            cols = [r[1] for r in self.conn.execute(
+                f"PRAGMA table_info({name})")]
+            for c in cols:
+                if c.endswith("_sk"):
+                    self.conn.execute(
+                        f"CREATE INDEX IF NOT EXISTS idx_{name}_{c} "
+                        f"ON {name} ({c})")
+                    n += 1
+        self.conn.execute("ANALYZE")
+        return n
+
+    def run_with_timeout(self, query: str, seconds: float = 60.0):
+        """run() with a watchdog: sqlite3.interrupt() from a timer
+        thread aborts runaway plans; returns None on timeout."""
+        import sqlite3 as _sq
+        import threading
+
+        fired = threading.Event()
+
+        def _interrupt():
+            fired.set()
+            self.conn.interrupt()
+
+        timer = threading.Timer(seconds, _interrupt)
+        timer.start()
+        try:
+            result = self.run(query)
+        except _sq.OperationalError as e:
+            if fired.is_set() and "interrupt" in str(e).lower():
+                return None
+            raise
+        finally:
+            timer.cancel()
+        if fired.is_set():
+            # the timer fired as the query finished: a pending
+            # interrupt may abort the NEXT statement on older
+            # sqlite — drain it with a throwaway statement
+            try:
+                self.conn.execute("SELECT 1").fetchall()
+            except _sq.OperationalError:
+                pass
+        return result
+
 
 def _norm(v):
     if isinstance(v, float):
