@@ -495,10 +495,14 @@ def wl_page_decode(n, device):
     groups = -(-n // 32)
     padded = -(-groups // _TILE) * _TILE
     words = rng.integers(0, 1 << 32, (w, padded), dtype=np.uint64)         .astype(np.uint32)
-    dw = jax.device_put(words, device)
-    unpack_bitpacked_tiled(dw, w).block_until_ready()
-    t_comp = _best(
-        lambda: unpack_bitpacked_tiled(dw, w).block_until_ready(), k=3)
+    # pin x32: Mosaic lowers the kernel with i32 grid indexing and a
+    # prior sql workload flipped global x64 in this process
+    with jax.enable_x64(False):
+        dw = jax.device_put(words, device)
+        unpack_bitpacked_tiled(dw, w).block_until_ready()
+        t_comp = _best(
+            lambda: unpack_bitpacked_tiled(dw, w).block_until_ready(),
+            k=3)
     bytes_moved = padded * w * 4 + n * 4
     os.unlink(path)
     return {"n": n, "t_device_s": t_dev, "t_host_s": t_host,
@@ -555,7 +559,16 @@ def main():
             ("sql_sort", wl_sql_sort, args.sql_rows),
             ("page_decode", wl_page_decode, args.sql_rows)):
         print(f"== {name} @ {n} rows", file=sys.stderr)
-        wl = fn(n, device)
+        try:
+            wl = fn(n, device)
+        except Exception as exc:
+            # record the failure honestly (e.g. a transient remote-
+            # compile 500 over the tunnel) instead of losing the run
+            import traceback
+
+            traceback.print_exc()
+            out["workloads"][name] = {"n": n, "error": str(exc)[:300]}
+            continue
         wl["model"] = model(link, wl)
         wl["projected_pcie_wins"] = (
             wl["model"]["projected_pcie_s"] < wl["t_host_s"])
@@ -567,11 +580,11 @@ def main():
               file=sys.stderr)
 
     out["any_device_win_measured"] = any(
-        w["device_wins"] for w in out["workloads"].values())
+        w.get("device_wins") for w in out["workloads"].values())
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"metric": "device_merit_wins",
-                      "value": sum(w["device_wins"]
+                      "value": sum(bool(w.get("device_wins"))
                                    for w in out["workloads"].values()),
                       "unit": "workloads",
                       "vs_baseline": 0.0}))

@@ -457,55 +457,59 @@ class TpcdsBenchmark(Benchmark):
         totals = {s: 0.0 for s, _c in pairs}
         oracle_total, oracle_done, oracle_skipped = 0.0, 0, 0
         saved_flag = os.environ.get("DELTA_TPU_DEVICE_SQL")
-        for name, q in QUERIES.items():
-            for substrate, cat in pairs:
-                # pin the substrate: the device column must measure the
-                # device spine even where the link auto-gate would
-                # decline it (that cost is exactly what it reports)
-                os.environ["DELTA_TPU_DEVICE_SQL"] = (
-                    "1" if substrate == "device" else "0")
-                for it in range(2):
+        try:
+            for name, q in QUERIES.items():
+                for substrate, cat in pairs:
+                    # pin the substrate: the device column must measure the
+                    # device spine even where the link auto-gate would
+                    # decline it (that cost is exactly what it reports)
+                    os.environ["DELTA_TPU_DEVICE_SQL"] = (
+                        "1" if substrate == "device" else "0")
+                    for it in range(2):
+                        t0 = time.perf_counter()
+                        out = execute_select(q, catalog=cat)
+                        dt = (time.perf_counter() - t0) * 1000
+                        self.report.results.append(QueryResult(
+                            name, it, dt, {"rows": out.num_rows,
+                                           "substrate": substrate}))
+                        print(f"  {name}[{substrate}:{it}]: {dt:,.1f} ms "
+                              f"({out.num_rows} rows)", file=sys.stderr)
+                        if it == 1:
+                            totals[substrate] += dt
+                if oracle is not None:
                     t0 = time.perf_counter()
-                    out = execute_select(q, catalog=cat)
-                    dt = (time.perf_counter() - t0) * 1000
-                    self.report.results.append(QueryResult(
-                        name, it, dt, {"rows": out.num_rows,
-                                       "substrate": substrate}))
-                    print(f"  {name}[{substrate}:{it}]: {dt:,.1f} ms "
-                          f"({out.num_rows} rows)", file=sys.stderr)
-                    if it == 1:
-                        totals[substrate] += dt
-            if oracle is not None:
-                t0 = time.perf_counter()
-                try:
-                    res = oracle.run_with_timeout(q, seconds=60.0)
-                    dt = (time.perf_counter() - t0) * 1000
-                    if res is None:
+                    try:
+                        res = oracle.run_with_timeout(q, seconds=60.0)
+                        dt = (time.perf_counter() - t0) * 1000
+                        if res is None:
+                            oracle_skipped += 1
+                            self.report.results.append(QueryResult(
+                                name, 0, dt, {"substrate": "oracle",
+                                              "error": "timeout"}))
+                            print(f"  {name}[oracle]: TIMEOUT",
+                                  file=sys.stderr)
+                            continue
+                        orows = len(res)
+                        self.report.results.append(QueryResult(
+                            name, 0, dt, {"rows": orows,
+                                          "substrate": "oracle"}))
+                        oracle_total += dt
+                        oracle_done += 1
+                        print(f"  {name}[oracle]: {dt:,.1f} ms",
+                              file=sys.stderr)
+                    except Exception as exc:  # q67 rollup depth
                         oracle_skipped += 1
                         self.report.results.append(QueryResult(
-                            name, 0, dt, {"substrate": "oracle",
-                                          "error": "timeout"}))
-                        print(f"  {name}[oracle]: TIMEOUT",
-                              file=sys.stderr)
-                        continue
-                    orows = len(res)
-                    self.report.results.append(QueryResult(
-                        name, 0, dt, {"rows": orows,
-                                      "substrate": "oracle"}))
-                    oracle_total += dt
-                    oracle_done += 1
-                    print(f"  {name}[oracle]: {dt:,.1f} ms",
-                          file=sys.stderr)
-                except Exception as exc:  # q67 rollup depth
-                    oracle_skipped += 1
-                    self.report.results.append(QueryResult(
-                        name, 0, float("nan"),
-                        {"substrate": "oracle",
-                         "error": str(exc)[:120]}))
-        if saved_flag is None:
-            os.environ.pop("DELTA_TPU_DEVICE_SQL", None)
-        else:
-            os.environ["DELTA_TPU_DEVICE_SQL"] = saved_flag
+                            name, 0, float("nan"),
+                            {"substrate": "oracle",
+                             "error": str(exc)[:120]}))
+        finally:
+            # never leak the substrate pin (a mid-loop
+            # failure would force it process-wide)
+            if saved_flag is None:
+                os.environ.pop("DELTA_TPU_DEVICE_SQL", None)
+            else:
+                os.environ["DELTA_TPU_DEVICE_SQL"] = saved_flag
         for substrate, total in totals.items():
             self.metric(f"tpcds_warm_total_{substrate}", total, "ms",
                         queries=len(QUERIES))

@@ -1938,75 +1938,58 @@ struct ReadResult {
 void* dar_read(const char* paths_blob, const int64_t* path_offs,
                int32_t n_files) {
   ReadResult* r = new ReadResult();
-  // pass 1: stat everything and hand the kernel the FULL read plan up
-  // front (POSIX_FADV_WILLNEED, which queues readahead on the INODE —
-  // it survives the close, so no fd-limit pressure). Cold reads on a
-  // virtio disk are latency-bound; with the plan queued the kernel
-  // readahead runs asynchronously while we copy earlier files.
+  // pass 1: stat for sizes (one syscall per file).
   std::vector<int64_t> sizes(n_files);
   int64_t total = 0;
   for (int32_t i = 0; i < n_files; i++) {
     std::string path(paths_blob + path_offs[i],
                      (size_t)(path_offs[i + 1] - path_offs[i]));
-    int fd = open(path.c_str(), O_RDONLY);
-    if (fd < 0) { r->error = 1; return r; }
     struct stat st;
-    if (fstat(fd, &st) != 0) { r->error = 1; close(fd); return r; }
+    if (stat(path.c_str(), &st) != 0) { r->error = 1; return r; }
     sizes[i] = st.st_size;
     total += st.st_size + 1;
-#ifdef POSIX_FADV_WILLNEED
-    posix_fadvise(fd, 0, 0, POSIX_FADV_WILLNEED);
-#endif
-    close(fd);
   }
   if (!r->buf.alloc((size_t)total)) { r->error = 1; return r; }
   r->starts.resize(n_files + 1);
   char* out = r->buf.p;
-  std::vector<int64_t> offs(n_files);
   int64_t off = 0;
-  for (int32_t i = 0; i < n_files; i++) {
-    r->starts[i] = off;
-    offs[i] = off;
-    off += sizes[i] + 1;
-  }
+  for (int32_t i = 0; i < n_files; i++) { r->starts[i] = off; off += sizes[i] + 1; }
   r->starts[n_files] = off;
-  // pass 2: parallel copies. Even on one vCPU several reader threads
-  // keep the device queue deep, overlapping I/O waits; each file's
-  // destination region is disjoint so no synchronization is needed.
-  unsigned hw = std::thread::hardware_concurrency();
-  int n_threads = (int)std::min<unsigned>(hw ? hw * 2 : 2, 8);
-  if (n_files < 64) n_threads = 1;
-  std::atomic<int32_t> next(0);
-  std::atomic<int32_t> failed(0);
-  auto work = [&]() {
-    for (;;) {
-      if (failed.load()) return;  // don't finish a doomed read
-      int32_t i = next.fetch_add(1);
-      if (i >= n_files) return;
-      std::string path(paths_blob + path_offs[i],
-                       (size_t)(path_offs[i + 1] - path_offs[i]));
-      int fd = open(path.c_str(), O_RDONLY);
-      if (fd < 0) { failed.store(1); continue; }
-      int64_t got = 0;
-      while (got < sizes[i]) {
-        ssize_t k = pread(fd, out + offs[i] + got,
-                          (size_t)(sizes[i] - got), got);
-        if (k <= 0) break;
-        got += k;
-      }
+  // pass 2a: hand the kernel the FULL read plan up front —
+  // POSIX_FADV_WILLNEED binds readahead to the inode and survives the
+  // close, so a cold virtio disk streams upcoming files while pass 2b
+  // copies earlier ones (measured 11.5s -> ~1.0s for a 687MB
+  // 30k-commit log; a 512-file sliding window only reached 3.8s).
+  // A copy thread pool was measured and REJECTED on this 1-vCPU box:
+  // two copiers on one core regress the warm path.
+#ifdef POSIX_FADV_WILLNEED
+  for (int32_t i = 0; i < n_files; i++) {
+    std::string path(paths_blob + path_offs[i],
+                     (size_t)(path_offs[i + 1] - path_offs[i]));
+    int fd = open(path.c_str(), O_RDONLY);
+    if (fd >= 0) {
+      posix_fadvise(fd, 0, 0, POSIX_FADV_WILLNEED);
       close(fd);
-      if (got != sizes[i]) failed.store(1);
-      out[offs[i] + sizes[i]] = '\n';
     }
-  };
-  if (n_threads <= 1) {
-    work();
-  } else {
-    std::vector<std::thread> threads;
-    for (int t = 0; t < n_threads; t++) threads.emplace_back(work);
-    for (auto& t : threads) t.join();
   }
-  if (failed.load()) { r->error = 1; return r; }
+#endif
+  // pass 2b: sequential single-threaded copy.
+  for (int32_t i = 0; i < n_files; i++) {
+    std::string path(paths_blob + path_offs[i],
+                     (size_t)(path_offs[i + 1] - path_offs[i]));
+    int fd = open(path.c_str(), O_RDONLY);
+    if (fd < 0) { r->error = 1; return r; }
+    int64_t base = r->starts[i];
+    int64_t got = 0;
+    while (got < sizes[i]) {
+      ssize_t k = pread(fd, out + base + got, (size_t)(sizes[i] - got), got);
+      if (k <= 0) break;
+      got += k;
+    }
+    close(fd);
+    if (got != sizes[i]) { r->error = 1; return r; }
+    out[base + sizes[i]] = '\n';
+  }
   return r;
 }
 

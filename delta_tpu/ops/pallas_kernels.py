@@ -81,14 +81,17 @@ def interleave_bits_tiled(cols: jnp.ndarray, n_bits: int = 32) -> jnp.ndarray:
 
 
 def interleave_bits_auto(cols, n_bits: int = 32):
-    """Pallas when available/beneficial, jnp fallback otherwise."""
+    """Pallas when available/beneficial, jnp fallback otherwise.
+    x32 pinned: Mosaic grid indexing is i32 and all dtypes here are
+    explicit, so a global x64 flip (the SQL spine's) must not leak in."""
     from delta_tpu.ops.zorder import interleave_bits
 
-    stacked = jnp.stack(list(cols))
-    k, n = stacked.shape
-    if not HAVE_PALLAS or n % _TILE != 0:
-        return interleave_bits(list(cols), n_bits=n_bits)
-    return interleave_bits_tiled(stacked, n_bits=n_bits)
+    with jax.enable_x64(False):
+        stacked = jnp.stack(list(cols))
+        k, n = stacked.shape
+        if not HAVE_PALLAS or n % _TILE != 0:
+            return interleave_bits(list(cols), n_bits=n_bits)
+        return interleave_bits_tiled(stacked, n_bits=n_bits)
 
 
 # ---------------------------------------------------------------------------
@@ -136,7 +139,13 @@ def segmented_minmax(values: jnp.ndarray, valid: jnp.ndarray):
 
 def batched_file_stats(values: np.ndarray, valid: np.ndarray):
     """Host wrapper: pad [F, R] to tile multiples, run the kernel, return
-    numpy (min, max, null_count, num_records) per file."""
+    numpy (min, max, null_count, num_records) per file. x32 pinned for
+    the same Mosaic reason as interleave_bits_auto."""
+    with jax.enable_x64(False):
+        return _batched_file_stats_impl(values, valid)
+
+
+def _batched_file_stats_impl(values: np.ndarray, valid: np.ndarray):
     f, r = values.shape
     fpad = (-f) % _SUBLANES
     rpad = (-r) % _LANES
@@ -211,10 +220,15 @@ def unpack_bitpacked(packed_words: np.ndarray, w: int,
     buf[:need] = packed_words[:need]
     # [G, w] group-major words -> [w, G] word-major for the kernel
     shaped = np.ascontiguousarray(buf.reshape(padded_groups, w).T)
-    arr = jax.device_put(shaped, device)
-    if not HAVE_PALLAS:
-        return _unpack_jnp(arr, w)[:n_groups * 32]
-    return unpack_bitpacked_tiled(arr, w)[:n_groups * 32]
+    # Mosaic lowers this kernel with i32 grid indexing; a process that
+    # enabled global x64 (the SQL spine does) would otherwise feed it
+    # i64 index maps and fail to legalize — dtypes here are explicit,
+    # so pin x32 semantics for the call
+    with jax.enable_x64(False):
+        arr = jax.device_put(shaped, device)
+        if not HAVE_PALLAS:
+            return _unpack_jnp(arr, w)[:n_groups * 32]
+        return unpack_bitpacked_tiled(arr, w)[:n_groups * 32]
 
 
 @functools.partial(jax.jit, static_argnames=("w",))

@@ -622,14 +622,17 @@ class _Exec:
             # join is far more selective than either key alone — q72's
             # inventory joins on (item_sk, date_sk) once d2 is in),
             # tie-broken by smallest right frame so big fact tables
-            # join after the filtering dims
+            # join after the filtering dims. Edges whose aliases a
+            # later outer join can null-extend never become join keys
+            # (they must stay residual WHERE filters).
             pick = None
             best_score = None
             for a in remaining:
                 keys = [(pl, pr) if al in joined else (pr, pl)
                         for (al, pl, ar, pr, c) in edges
-                        if (al in joined and ar == a)
-                        or (ar in joined and al == a)]
+                        if ((al in joined and ar == a)
+                            or (ar in joined and al == a))
+                        and not ({al, ar} & null_supplying)]
                 if keys:
                     score = (len(keys), -len(by_alias[a]["frame"]))
                     if best_score is None or score > best_score:
@@ -648,7 +651,8 @@ class _Exec:
                                        "inner", lk, rk,
                                        spine=self.spine)
             for (al, pl, ar, pr, c) in edges:
-                if c is not None and {al, ar} <= joined | {a}:
+                if c is not None and {al, ar} <= joined | {a} \
+                        and not ({al, ar} & null_supplying):
                     consumed.add(id(c))
             joined.add(a)
             remaining.remove(a)
@@ -714,14 +718,12 @@ class _Exec:
                 if best_score is None or score > best_score:
                     best_score = score
                     best = (a, j, keys)
-            if best is None:  # unsatisfiable ON ordering: clause order
-                a, j = pool[0]
-                on = _on_keys(a, j)
-                if on is None:
-                    raise UnsupportedSqlError(
-                        f"JOIN ON for {a!r} references aliases joined "
-                        "after it")
-                best = (a, j, list(zip(on[0], on[1])))
+            if best is None:
+                # every pool member's ON references an alias joined
+                # after it — impossible for clause-ordered SQL
+                raise UnsupportedSqlError(
+                    "JOIN ON ordering is unsatisfiable: every "
+                    "remaining join references aliases joined later")
             a, j, keys = best
             lk = [l for l, _ in keys]
             rk = [r for _, r in keys]

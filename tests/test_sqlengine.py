@@ -542,3 +542,19 @@ def test_where_edge_not_folded_before_right_join(tmp_path):
     # f.a = d.b is false on the only row: the WHERE (applied after the
     # right join) removes everything — 0 rows, not a null-extended one
     assert out.num_rows == 0
+
+
+def test_implicit_where_edge_not_folded_before_right_join(tmp_path):
+    """Same guard as the explicit pool, for comma-FROM sources: a
+    WHERE equality between implicit-joined aliases must stay residual
+    when a later RIGHT JOIN can null-extend them."""
+    f = str(tmp_path / "f")
+    d = str(tmp_path / "d")
+    x = str(tmp_path / "x")
+    dta.write_table(f, pa.table({"k": [1], "j": [1], "a": [1]}))
+    dta.write_table(d, pa.table({"k": [1], "b": [2]}))
+    dta.write_table(x, pa.table({"j": [1]}))
+    out = sql(f"SELECT x.j FROM '{f}' f, '{d}' d "
+              f"RIGHT JOIN '{x}' x ON x.j = f.j "
+              f"WHERE f.k = d.k AND f.a = d.b")
+    assert out.num_rows == 0

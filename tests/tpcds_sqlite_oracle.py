@@ -208,6 +208,7 @@ class SqliteOracle:
             raise
         finally:
             timer.cancel()
+            timer.join()  # a timer mid-fire must finish interrupt()
         if fired.is_set():
             # the timer fired as the query finished: a pending
             # interrupt may abort the NEXT statement on older
