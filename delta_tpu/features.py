@@ -46,7 +46,14 @@ def _conf_true(key):
 
 APPEND_ONLY = _feature("appendOnly", 1, 2, False, _conf_true("delta.appendOnly"), legacy=True)
 INVARIANTS = _feature("invariants", 1, 2, False, legacy=True)
-CHECK_CONSTRAINTS = _feature("checkConstraints", 1, 3, False, legacy=True)
+CHECK_CONSTRAINTS = _feature(
+    "checkConstraints", 1, 3, False,
+    # a table CREATEd with delta.constraints.* properties needs
+    # writer v3 from its first commit (ALTER ADD CONSTRAINT upgrades
+    # separately through the txn)
+    lambda meta: any(k.startswith("delta.constraints.")
+                     for k in meta.configuration),
+    legacy=True)
 CHANGE_DATA_FEED = _feature(
     "changeDataFeed", 1, 4, False, _conf_true("delta.enableChangeDataFeed"), legacy=True
 )
