@@ -375,13 +375,16 @@ def _link_supports_sql_offload() -> bool:
         if jax.default_backend() == "cpu":
             return True  # tests' virtual mesh: transfers are memcpy
         # the tunnel registers as the 'axon' PJRT plugin (device
-        # .platform still reads 'tpu'): the backend registry is the
-        # authoritative signal; the tunnel's launch-marker env is the
-        # conservative fallback if the private registry API moves
+        # .platform still reads 'tpu'): what matters is whether the
+        # ACTIVE backend is that plugin — mere registration of the
+        # package must not disable offload on a genuinely local TPU.
+        # The launch-marker env is the conservative fallback if the
+        # private registry API moves.
         try:
             import jax._src.xla_bridge as xb
 
-            return "axon" not in xb.backends()
+            active = xb.get_backend()
+            return xb.backends().get("axon") is not active
         except Exception:
             return not os.environ.get("PALLAS_AXON_POOL_IPS")
     except Exception:

@@ -74,17 +74,61 @@ def _hive_type(dt) -> str:
     raise ValueError(f"no Hive mapping for {dt!r}")
 
 
-def _columns(snapshot):
+_TRINO_TYPES = {
+    "string": "VARCHAR",
+    "long": "BIGINT",
+    "integer": "INTEGER",
+    "short": "SMALLINT",
+    "byte": "TINYINT",
+    "double": "DOUBLE",
+    "float": "REAL",
+    "boolean": "BOOLEAN",
+    "binary": "VARBINARY",
+    "date": "DATE",
+    "timestamp": "TIMESTAMP",
+}
+
+
+def _trino_type(dt) -> str:
+    """Delta type -> Presto/Trino type (ARRAY(...)/MAP(...)/ROW(...))."""
+    from delta_tpu.models.schema import (
+        ArrayType,
+        MapType,
+        PrimitiveType,
+        StructType,
+    )
+
+    if isinstance(dt, PrimitiveType):
+        name = dt.name
+        if name.startswith("decimal"):
+            return name.upper()
+        try:
+            return _TRINO_TYPES[name]
+        except KeyError:
+            raise ValueError(f"no Trino mapping for Delta type {name!r}")
+    if isinstance(dt, ArrayType):
+        return f"ARRAY({_trino_type(dt.elementType)})"
+    if isinstance(dt, MapType):
+        return (f"MAP({_trino_type(dt.keyType)}, "
+                f"{_trino_type(dt.valueType)})")
+    if isinstance(dt, StructType):
+        fields = ", ".join(
+            f"\"{f.name}\" {_trino_type(f.dataType)}" for f in dt.fields)
+        return f"ROW({fields})"
+    raise ValueError(f"no Trino mapping for {dt!r}")
+
+
+def _columns(snapshot, type_fn=_hive_type):
     schema = snapshot.schema
     part = list(snapshot.partition_columns)
-    data_cols = [(f.name, _hive_type(f.dataType))
+    data_cols = [(f.name, type_fn(f.dataType))
                  for f in schema.fields if f.name not in part]
     # PARTITIONED BY must follow the manifest's DIRECTORY order
     # (snapshot.partition_columns) — Hive/Trino bind partition columns
     # to path levels positionally, so schema order would swap values
     # on multi-column partitioning
     by_name = {f.name: f for f in schema.fields}
-    part_cols = [(n, _hive_type(by_name[n].dataType)) for n in part]
+    part_cols = [(n, type_fn(by_name[n].dataType)) for n in part]
     return data_cols, part_cols
 
 
@@ -119,7 +163,7 @@ def presto_ddl(table, catalog_schema_table: str,
     """Presto/Trino CREATE TABLE over the same manifest (hive
     connector with format = 'PARQUET' symlink table)."""
     snapshot = table.latest_snapshot()
-    data_cols, part_cols = _columns(snapshot)
+    data_cols, part_cols = _columns(snapshot, type_fn=_trino_type)
     location = manifest_dir or f"{table.path}/_symlink_format_manifest"
     cols = data_cols + part_cols
     body = ",\n".join(f"  \"{n}\" {t}" for n, t in cols)

@@ -181,6 +181,9 @@ def test_hive_ddl_partitioned(tmp_path):
     pddl = presto_ddl(t, "hive.db.events")
     assert "external_location" in pddl and "format = 'PARQUET'" in pddl
     assert "partitioned_by = ARRAY['part']" in pddl
+    # Trino dialect types, not Hive's
+    assert '"part" VARCHAR' in pddl and '"id" BIGINT' in pddl
+    assert "STRING" not in pddl
 
     # the manifests the DDL points at list exactly the live files
     import glob
@@ -227,7 +230,7 @@ def test_powerbi_reader_ships_and_is_balanced():
     p = os.path.join(os.path.dirname(__import__("delta_tpu").__file__),
                      "integrations", "powerbi_delta.pq")
     src = open(p).read()
-    for marker in ("_delta_log", "_last_checkpoint", ".checkpoint",
+    for marker in ("_delta_log", ".checkpoint",
                    "Parquet.Document", "Json.Document",
                    "minReaderVersion", "partitionValues",
                    "deletionVector", "DeltaTpu.Table"):
