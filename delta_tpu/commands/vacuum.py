@@ -6,17 +6,35 @@ files whose deletionTimestamp is inside the retention window. Everything
 else under the table directory (excluding `_delta_log`) whose
 modification time predates the cutoff is deleted. Hidden files/dirs
 (`_`/`.` prefixed, except `_change_data`) are skipped.
+
+Three candidate sources, mirroring the reference's dispatch
+(`VacuumCommand.scala:281-333`):
+- FULL (default): recursive listing of the table directory;
+- USING INVENTORY: a caller-supplied frame of (path, length, isDir,
+  modificationTime) rows;
+- LITE (`vacuum_type="LITE"`): candidates come from the delta log
+  itself — RemoveFile tombstones (and their DV files) plus CDC files
+  recorded in the commit range since the last vacuum's watermark
+  (`VacuumCommand.scala:506-636`). Never lists the data directory, so
+  untracked files survive; a `_last_vacuum_info` watermark file makes
+  successive LITE runs incremental.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 from delta_tpu.config import TOMBSTONE_RETENTION, get_table_config
-from delta_tpu.errors import InvalidArgumentError, VacuumRetentionError
+from delta_tpu.errors import (
+    InvalidArgumentError,
+    TimestampEarlierThanCommitRetentionError,
+    VacuumLiteError,
+    VacuumRetentionError,
+)
 from delta_tpu.utils import filenames
 
 
@@ -25,6 +43,9 @@ class VacuumResult:
     files_deleted: List[str] = field(default_factory=list)
     dirs_scanned: int = 0
     dry_run: bool = False
+    type_of_vacuum: str = "FULL"
+    eligible_start_commit_version: Optional[int] = None
+    eligible_end_commit_version: Optional[int] = None
 
     @property
     def num_deleted(self) -> int:
@@ -126,13 +147,162 @@ def _inventory_files(table_path: str, inventory):
         yield os.path.join(base, rel), rel, int(mtime)
 
 
+LAST_VACUUM_INFO = "_last_vacuum_info"
+
+
+def _last_vacuum_watermark(table) -> Optional[int]:
+    """The previous vacuum's latestCommitVersionOutsideOfRetentionWindow
+    from `_delta_log/_last_vacuum_info` (`VacuumCommand.scala:948`);
+    None when absent or unreadable (corrupt info only widens the next
+    LITE scan, never breaks it)."""
+    path = f"{table.log_path}/{LAST_VACUUM_INFO}"
+    try:
+        data = table.engine.fs.read_file(path)
+        return json.loads(data.decode())[
+            "latestCommitVersionOutsideOfRetentionWindow"]
+    except (FileNotFoundError, KeyError, ValueError):
+        return None
+
+
+def _persist_last_vacuum_info(table, watermark: Optional[int]) -> None:
+    """Best-effort watermark persistence (`VacuumCommand.scala:967`):
+    FULL vacuums reset it to null (the next LITE rescans from the
+    earliest commit — conservative), LITE vacuums advance it."""
+    path = f"{table.log_path}/{LAST_VACUUM_INFO}"
+    body = json.dumps(
+        {"latestCommitVersionOutsideOfRetentionWindow": watermark}
+    ).encode()
+    try:
+        table.engine.fs.write_file(path, body)
+    except OSError:
+        pass
+
+
+def _read_commit_actions(table, version: int):
+    from delta_tpu.models.actions import actions_from_commit_bytes
+
+    fs = table.engine.fs
+    try:
+        data = fs.read_file(filenames.delta_file(table.log_path, version))
+    except FileNotFoundError:
+        # unbackfilled coordinated commit: look in _delta_log/_commits
+        commit_dir = f"{table.log_path}/_commits"
+        for st in fs.list_from(f"{commit_dir}/"):
+            name = st.path.rsplit("/", 1)[-1]
+            if name.startswith(f"{version:020d}.") and \
+                    name.endswith(".json"):
+                data = fs.read_file(st.path)
+                break
+        else:
+            raise
+    return actions_from_commit_bytes(data)
+
+
+def _lite_candidates(table, snapshot, cutoff_ms: int):
+    """(candidates, start_version, end_version) for VACUUM LITE: the
+    deletion candidates are the RemoveFile tombstones (+ their on-disk
+    DV files) and AddCDCFile entries recorded in commits
+    [start, end], where end is the newest commit outside the retention
+    window and start resumes from the last vacuum's watermark
+    (`VacuumCommand.scala:506-556`). Candidate mtime is the remove's
+    deletionTimestamp, so the caller's shared cutoff filter applies
+    unchanged; CDC files get mtime 0 (always eligible once their
+    commit leaves the window, matching `VacuumCommand.scala:622`)."""
+    from delta_tpu.history import version_at_timestamp
+    from delta_tpu.models.actions import AddCDCFile, RemoveFile
+
+    try:
+        end = version_at_timestamp(table, cutoff_ms,
+                                   can_return_last_commit=True)
+    except TimestampEarlierThanCommitRetentionError:
+        return [], None, None  # nothing old enough to vacuum
+
+    fs = table.engine.fs
+    versions = sorted(
+        filenames.delta_version(st.path)
+        for st in fs.list_from(f"{table.log_path}/")
+        if filenames.is_delta_file(st.path))
+    if not versions:
+        return [], None, None
+    earliest = versions[0]
+    last_mark = _last_vacuum_watermark(table)
+    # Log cleanup removed commits we never scanned: tombstones may
+    # have expired out of the log unobserved — only a FULL listing can
+    # find those files now. No gap when last_mark + 1 == earliest
+    # (every expired commit was already scanned; the reference's
+    # `VacuumCommand.scala:533` check is conservative by one here).
+    if earliest != 0 and (last_mark is None
+                          or last_mark + 1 < earliest):
+        raise VacuumLiteError(
+            "VACUUM LITE cannot delete all eligible files as some "
+            "files are not referenced by the Delta log. Please run "
+            "VACUUM FULL.")
+    start = min(snapshot.version,
+                last_mark + 1 if last_mark is not None else earliest)
+    if start > end:
+        return [], None, end
+
+    import posixpath
+    from urllib.parse import unquote
+
+    base = table.path.rstrip("/")
+    by_path = {}
+
+    def _offer(raw: str, mtime: int) -> None:
+        # decode BEFORE the root checks: '%2Fetc%2Fx' must be treated
+        # as the absolute path it decodes to, not a relative name
+        rel = unquote(raw)
+        if rel.startswith(base + "/"):
+            rel = rel[len(base) + 1:]
+        elif "://" in rel or rel.startswith("/"):
+            return  # outside the table root (e.g. shallow clone source)
+        # same traversal guard as _inventory_files: a '..' segment in a
+        # logged path could escape the table root on unlink
+        rel = posixpath.normpath(rel.replace(os.sep, "/"))
+        if rel.startswith("..") or rel.startswith("/") or rel == ".":
+            return
+        prev = by_path.get(rel)
+        if prev is None or mtime > prev:
+            by_path[rel] = mtime
+
+    for v in range(start, end + 1):
+        for a in _read_commit_actions(table, v):
+            if isinstance(a, RemoveFile):
+                mtime = a.deletionTimestamp or 0
+                _offer(a.path, mtime)
+                dv = a.deletionVector
+                if dv is not None and dv.storageType == "u":
+                    from delta_tpu.dv.descriptor import absolute_dv_path
+
+                    abs_dv = absolute_dv_path(base, {
+                        "storageType": dv.storageType,
+                        "pathOrInlineDv": dv.pathOrInlineDv})
+                    _offer(abs_dv, mtime)
+            elif isinstance(a, AddCDCFile):
+                _offer(a.path, 0)
+
+    out = [(os.path.join(base, rel), rel, mtime)
+           for rel, mtime in by_path.items()]
+    return out, start, end
+
+
 def vacuum(
     table,
     retention_hours: Optional[float] = None,
     dry_run: bool = False,
     enforce_retention_check: bool = True,
     inventory=None,
+    vacuum_type: str = "FULL",
 ) -> VacuumResult:
+    vacuum_type = vacuum_type.upper()
+    if vacuum_type not in ("FULL", "LITE"):
+        raise InvalidArgumentError(
+            f"invalid vacuum type {vacuum_type!r}: expected FULL or "
+            "LITE", error_class="DELTA_ILLEGAL_ARGUMENT")
+    if inventory is not None and vacuum_type == "LITE":
+        raise InvalidArgumentError(
+            "VACUUM LITE does not accept an inventory",
+            error_class="DELTA_ILLEGAL_ARGUMENT")
     snapshot = table.latest_snapshot()
     state = snapshot.state
     conf = state.metadata.configuration
@@ -169,11 +339,18 @@ def vacuum(
             abs_dv = absolute_dv_path(table.path, dv)
             protected.add(os.path.relpath(abs_dv, table.path).replace(os.sep, "/"))
 
-    result = VacuumResult(dry_run=dry_run)
+    result = VacuumResult(dry_run=dry_run, type_of_vacuum=vacuum_type)
     doomed: List[str] = []
-    candidates = (_inventory_files(table.path, inventory)
-                  if inventory is not None
-                  else _walk_table_files(table.path))
+    lite_end = None
+    if inventory is not None:
+        candidates = _inventory_files(table.path, inventory)
+    elif vacuum_type == "LITE":
+        candidates, lite_start, lite_end = _lite_candidates(
+            table, snapshot, cutoff)
+        result.eligible_start_commit_version = lite_start
+        result.eligible_end_commit_version = lite_end
+    else:
+        candidates = _walk_table_files(table.path)
     for abs_path, rel, mtime in candidates:
         if rel in protected:
             continue
@@ -194,4 +371,20 @@ def vacuum(
                 pass
 
         parallel_map(_unlink, doomed)
+    if not dry_run:
+        if vacuum_type == "LITE":
+            # advance-only: an empty run (cutoff before the earliest
+            # commit, or no new commits since the last watermark) must
+            # not reset or regress the watermark — that would force
+            # the next run to rescan, or spuriously trip the
+            # log-cleanup gap check above
+            prev = _last_vacuum_watermark(table)
+            if lite_end is not None and (prev is None
+                                         or lite_end > prev):
+                _persist_last_vacuum_info(table, lite_end)
+        else:
+            # FULL resets the watermark (null): the next LITE rescans
+            # from the earliest commit (conservative, matches the
+            # reference's unconditional persist)
+            _persist_last_vacuum_info(table, None)
     return result

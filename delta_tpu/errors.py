@@ -287,6 +287,13 @@ class VacuumRetentionError(DeltaError):
     error_class = "DELTA_UNSAFE_VACUUM_RETENTION"
 
 
+class VacuumLiteError(DeltaError):
+    """VACUUM LITE cannot prove completeness: log cleanup removed
+    commits that were never scanned by a previous vacuum."""
+
+    error_class = "DELTA_CANNOT_VACUUM_LITE"
+
+
 class OptimizeArgumentError(DeltaError):
     error_class = "DELTA_OPTIMIZE_INVALID_ARGUMENT"
 

@@ -5,7 +5,7 @@ The reference extends Spark SQL with delta-specific statements
 (`catalog/DeltaCatalog.scala`). This module provides the same statement
 set over table *paths*, or over *names* when a `Catalog` is passed:
 
-    VACUUM <t> [RETAIN n HOURS] [DRY RUN]
+    VACUUM <t> [RETAIN n HOURS] [LITE|FULL] [DRY RUN]
     OPTIMIZE <t> [WHERE <pred>] [ZORDER BY (c1, c2)]
     DESCRIBE HISTORY <t> [LIMIT n]
     DESCRIBE DETAIL <t>
@@ -131,7 +131,7 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
 
     m = re.fullmatch(
         rf"VACUUM\s+{_PATH}(?:\s+RETAIN\s+(?P<hours>[\d.]+)\s+HOURS)?"
-        r"(?P<dry>\s+DRY\s+RUN)?",
+        r"(?:\s+(?P<vtype>LITE|FULL))?(?P<dry>\s+DRY\s+RUN)?",
         s, re.IGNORECASE,
     )
     if m:
@@ -141,6 +141,7 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
             _table(m, engine, catalog),
             retention_hours=float(m.group("hours")) if m.group("hours") else None,
             dry_run=m.group("dry") is not None,
+            vacuum_type=(m.group("vtype") or "FULL").upper(),
         )
 
     m = re.fullmatch(

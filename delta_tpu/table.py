@@ -179,11 +179,13 @@ class Table:
         return get_history(self, limit)
 
     def vacuum(self, retention_hours: Optional[float] = None,
-               dry_run: bool = False, inventory=None):
+               dry_run: bool = False, inventory=None,
+               vacuum_type: str = "FULL"):
         from delta_tpu.commands.vacuum import vacuum
 
         return vacuum(self, retention_hours=retention_hours,
-                      dry_run=dry_run, inventory=inventory)
+                      dry_run=dry_run, inventory=inventory,
+                      vacuum_type=vacuum_type)
 
     def optimize(self):
         from delta_tpu.commands.optimize import OptimizeBuilder

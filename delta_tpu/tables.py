@@ -114,9 +114,11 @@ class DeltaTable:
 
     # -- maintenance ---------------------------------------------------
     def vacuum(self, retentionHours: Optional[float] = None,
-               dryRun: bool = False, inventory=None):
+               dryRun: bool = False, inventory=None,
+               vacuumType: str = "FULL"):
         return self._table.vacuum(retention_hours=retentionHours,
-                                  dry_run=dryRun, inventory=inventory)
+                                  dry_run=dryRun, inventory=inventory,
+                                  vacuum_type=vacuumType)
 
     def optimize(self) -> "DeltaOptimizeBuilder":
         return DeltaOptimizeBuilder(self._table.optimize())

@@ -285,3 +285,170 @@ def test_vacuum_inventory_null_mtime_is_skipped(tmp_table_path):
     })
     res = vacuum(table, retention_hours=0, dry_run=True, inventory=inv)
     assert res.num_deleted == 0  # unknown age: conservative skip
+
+
+# ---- VACUUM LITE (`VacuumCommand.scala:281-636`) ---------------------
+
+
+def test_vacuum_lite_deletes_tombstones_not_untracked(tmp_table_path):
+    """LITE candidates come from the log's RemoveFile tombstones, so an
+    untracked file survives (FULL's listing would delete it) — the
+    defining behavioral difference (`VacuumCommand.scala:506`)."""
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    delete(table, col("id") < lit(100))  # tombstones the first file
+    junk = os.path.join(tmp_table_path, "untracked-junk.parquet")
+    with open(junk, "wb") as f:
+        f.write(b"not a real parquet")
+    os.utime(junk, (0, 0))  # old enough that FULL would delete it
+    res = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res.type_of_vacuum == "LITE"
+    assert res.num_deleted == 1
+    assert not os.path.exists(
+        os.path.join(tmp_table_path, res.files_deleted[0]))
+    assert os.path.exists(junk)  # untracked: invisible to LITE
+    assert res.eligible_start_commit_version == 0
+    assert res.eligible_end_commit_version == table.latest_snapshot().version
+    # watermark persisted for the next incremental run
+    info = os.path.join(tmp_table_path, "_delta_log", "_last_vacuum_info")
+    assert os.path.exists(info)
+    import json as _json
+
+    mark = _json.load(open(info))
+    assert mark["latestCommitVersionOutsideOfRetentionWindow"] == \
+        res.eligible_end_commit_version
+    # FULL still reaps the junk afterwards, and resets the watermark
+    res_full = vacuum(table, retention_hours=0)
+    assert "untracked-junk.parquet" in res_full.files_deleted
+    assert _json.load(open(info))[
+        "latestCommitVersionOutsideOfRetentionWindow"] is None
+
+
+def test_vacuum_lite_incremental_watermark(tmp_table_path):
+    """A second LITE run resumes after the first one's watermark
+    (`VacuumCommand.scala:540-544`) and still finds new tombstones."""
+    table = _mk_table(tmp_table_path, n=100, n_commits=2)
+    delete(table, col("id") < lit(100))
+    res1 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res1.num_deleted == 1
+    delete(table, col("id") >= lit(100))
+    res2 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res2.eligible_start_commit_version == \
+        res1.eligible_end_commit_version + 1
+    assert res2.num_deleted == 1
+    # every data file is gone; the log still replays
+    assert dta.read_table(tmp_table_path).num_rows == 0
+
+
+def test_vacuum_lite_protects_recent_tombstones(tmp_table_path):
+    table = _mk_table(tmp_table_path, n=50, n_commits=2)
+    delete(table, col("id") < lit(50))
+    res = vacuum(table, retention_hours=1000, vacuum_type="LITE")
+    assert res.num_deleted == 0
+
+
+def test_vacuum_lite_raises_after_unobserved_log_cleanup(tmp_table_path):
+    """Commits expired before any vacuum observed them: their
+    tombstones are unrecoverable from the log, so LITE must refuse
+    (`VacuumCommand.scala:532-537` -> DELTA_CANNOT_VACUUM_LITE)."""
+    from delta_tpu.errors import VacuumLiteError
+
+    table = _mk_table(tmp_table_path, n=50, n_commits=3)
+    table.checkpoint()
+    # simulate metadata cleanup having expired the earliest commits
+    for v in (0, 1):
+        os.unlink(os.path.join(
+            tmp_table_path, "_delta_log", f"{v:020d}.json"))
+    table = Table.for_path(tmp_table_path)
+    with pytest.raises(VacuumLiteError) as ei:
+        vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert ei.value.error_class == "DELTA_CANNOT_VACUUM_LITE"
+
+
+def test_vacuum_lite_sql_surface(tmp_table_path):
+    from delta_tpu.sql import sql
+
+    table = _mk_table(tmp_table_path, n=60, n_commits=2)
+    delete(table, col("id") < lit(60))
+    res = sql(f"VACUUM '{tmp_table_path}' RETAIN 0 HOURS LITE DRY RUN")
+    assert res.type_of_vacuum == "LITE" and res.dry_run
+    assert res.num_deleted == 1
+    assert os.path.exists(
+        os.path.join(tmp_table_path, res.files_deleted[0]))
+
+
+def test_vacuum_lite_rejects_inventory(tmp_table_path):
+    from delta_tpu.errors import InvalidArgumentError
+
+    table = _mk_table(tmp_table_path, n=10, n_commits=1)
+    inv = pa.table({"path": ["x"], "length": [1], "isDir": [False],
+                    "modificationTime": [0]})
+    with pytest.raises(InvalidArgumentError):
+        vacuum(table, retention_hours=0, inventory=inv,
+               vacuum_type="LITE")
+
+
+def test_vacuum_lite_empty_run_keeps_watermark(tmp_table_path):
+    """An empty LITE run (nothing outside retention) must not reset or
+    regress the watermark — that would rescan or spuriously trip the
+    gap check after log cleanup."""
+    import json as _json
+
+    table = _mk_table(tmp_table_path, n=50, n_commits=2)
+    delete(table, col("id") < lit(50))
+    res1 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    info = os.path.join(tmp_table_path, "_delta_log", "_last_vacuum_info")
+    mark1 = _json.load(open(info))
+    assert mark1["latestCommitVersionOutsideOfRetentionWindow"] == \
+        res1.eligible_end_commit_version
+    # big retention: cutoff predates every commit -> empty run
+    res2 = vacuum(table, retention_hours=100000, vacuum_type="LITE")
+    assert res2.num_deleted == 0
+    assert _json.load(open(info)) == mark1  # unchanged
+
+
+def test_vacuum_lite_contiguous_watermark_after_cleanup(tmp_table_path):
+    """last_mark+1 == earliest is NOT a gap: every expired commit was
+    scanned, so the next LITE run proceeds."""
+    import json as _json
+
+    table = _mk_table(tmp_table_path, n=50, n_commits=3)
+    delete(table, col("id") < lit(50))  # version 3
+    res1 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    end1 = res1.eligible_end_commit_version
+    table.checkpoint()
+    # cleanup expires exactly the scanned prefix [0, end1]
+    for v in range(0, end1 + 1):
+        os.unlink(os.path.join(
+            tmp_table_path, "_delta_log", f"{v:020d}.json"))
+    table = Table.for_path(tmp_table_path)
+    delete(table, col("id") >= lit(100))
+    res2 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res2.eligible_start_commit_version == end1 + 1
+    assert res2.num_deleted >= 1
+
+
+def test_vacuum_lite_rejects_traversal_paths(tmp_table_path, tmp_path):
+    """A logged remove path with '..' or an encoded absolute path must
+    not unlink outside the table root (same guard as the inventory
+    path)."""
+    import json as _json
+
+    victim = tmp_path / "victim.bin"
+    victim.write_bytes(b"precious")
+    table = _mk_table(tmp_table_path, n=10, n_commits=1)
+    # hand-craft a commit with hostile remove paths
+    rel_victim = os.path.relpath(str(victim), tmp_table_path)
+    log = os.path.join(tmp_table_path, "_delta_log")
+    evil = [
+        {"remove": {"path": rel_victim.replace(os.sep, "/"),
+                    "deletionTimestamp": 1, "dataChange": True}},
+        {"remove": {"path": "%2Fetc%2Fhostname",
+                    "deletionTimestamp": 1, "dataChange": True}},
+    ]
+    with open(os.path.join(log, f"{1:020d}.json"), "w") as f:
+        f.write("\n".join(_json.dumps(a) for a in evil))
+    table = Table.for_path(tmp_table_path)
+    res = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert victim.exists()
+    assert all("victim" not in p and "etc" not in p
+               for p in res.files_deleted)
