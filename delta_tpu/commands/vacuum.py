@@ -198,23 +198,34 @@ def _read_commit_actions(table, version: int):
     return actions_from_commit_bytes(data)
 
 
-def _lite_candidates(table, snapshot, cutoff_ms: int):
+def _commit_outside_retention(table, cutoff_ms: int) -> Optional[int]:
+    """Version of the newest commit at/before the cutoff, or None when
+    every commit is inside the retention window
+    (`VacuumCommand.scala:285-296`)."""
+    from delta_tpu.history import version_at_timestamp
+
+    try:
+        return version_at_timestamp(table, cutoff_ms,
+                                    can_return_last_commit=True)
+    except TimestampEarlierThanCommitRetentionError:
+        return None
+
+
+def _lite_candidates(table, snapshot, cutoff_ms: int,
+                     last_mark: Optional[int]):
     """(candidates, start_version, end_version) for VACUUM LITE: the
     deletion candidates are the RemoveFile tombstones (+ their on-disk
     DV files) and AddCDCFile entries recorded in commits
     [start, end], where end is the newest commit outside the retention
-    window and start resumes from the last vacuum's watermark
+    window and start resumes after the last vacuum's watermark
     (`VacuumCommand.scala:506-556`). Candidate mtime is the remove's
     deletionTimestamp, so the caller's shared cutoff filter applies
     unchanged; CDC files get mtime 0 (always eligible once their
     commit leaves the window, matching `VacuumCommand.scala:622`)."""
-    from delta_tpu.history import version_at_timestamp
     from delta_tpu.models.actions import AddCDCFile, RemoveFile
 
-    try:
-        end = version_at_timestamp(table, cutoff_ms,
-                                   can_return_last_commit=True)
-    except TimestampEarlierThanCommitRetentionError:
+    end = _commit_outside_retention(table, cutoff_ms)
+    if end is None:
         return [], None, None  # nothing old enough to vacuum
 
     fs = table.engine.fs
@@ -225,7 +236,6 @@ def _lite_candidates(table, snapshot, cutoff_ms: int):
     if not versions:
         return [], None, None
     earliest = versions[0]
-    last_mark = _last_vacuum_watermark(table)
     # Log cleanup removed commits we never scanned: tombstones may
     # have expired out of the log unobserved — only a FULL listing can
     # find those files now. No gap when last_mark + 1 == earliest
@@ -237,8 +247,11 @@ def _lite_candidates(table, snapshot, cutoff_ms: int):
             "VACUUM LITE cannot delete all eligible files as some "
             "files are not referenced by the Delta log. Please run "
             "VACUUM FULL.")
-    start = min(snapshot.version,
-                last_mark + 1 if last_mark is not None else earliest)
+    # strictly after the watermark: re-scanning the watermark commit
+    # itself would re-report (and re-"delete") files a previous run
+    # already removed. A corrupt watermark beyond `end` just yields an
+    # empty range.
+    start = last_mark + 1 if last_mark is not None else earliest
     if start > end:
         return [], None, end
 
@@ -341,12 +354,13 @@ def vacuum(
 
     result = VacuumResult(dry_run=dry_run, type_of_vacuum=vacuum_type)
     doomed: List[str] = []
+    last_mark = _last_vacuum_watermark(table)
     lite_end = None
     if inventory is not None:
         candidates = _inventory_files(table.path, inventory)
     elif vacuum_type == "LITE":
         candidates, lite_start, lite_end = _lite_candidates(
-            table, snapshot, cutoff)
+            table, snapshot, cutoff, last_mark)
         result.eligible_start_commit_version = lite_start
         result.eligible_end_commit_version = lite_end
     else:
@@ -372,19 +386,18 @@ def vacuum(
 
         parallel_map(_unlink, doomed)
     if not dry_run:
-        if vacuum_type == "LITE":
-            # advance-only: an empty run (cutoff before the earliest
-            # commit, or no new commits since the last watermark) must
-            # not reset or regress the watermark — that would force
-            # the next run to rescan, or spuriously trip the
-            # log-cleanup gap check above
-            prev = _last_vacuum_watermark(table)
-            if lite_end is not None and (prev is None
-                                         or lite_end > prev):
-                _persist_last_vacuum_info(table, lite_end)
-        else:
-            # FULL resets the watermark (null): the next LITE rescans
-            # from the earliest commit (conservative, matches the
-            # reference's unconditional persist)
-            _persist_last_vacuum_info(table, None)
+        # Advance-only watermark: an empty run (cutoff before the
+        # earliest commit, or no new commits since the last watermark)
+        # must not reset or regress it — that would force the next run
+        # to rescan, or spuriously trip the log-cleanup gap check. A
+        # FULL (or inventory) vacuum observes every file regardless of
+        # log state, so it advances the watermark too — unlike the
+        # reference, which resets it to null after FULL
+        # (`VacuumCommand.scala:484`) and thereby wedges LITE forever
+        # on any table whose log head has been cleaned up.
+        new_mark = lite_end if vacuum_type == "LITE" else \
+            _commit_outside_retention(table, cutoff)
+        if new_mark is not None and (last_mark is None
+                                     or new_mark > last_mark):
+            _persist_last_vacuum_info(table, new_mark)
     return result

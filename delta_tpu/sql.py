@@ -130,18 +130,26 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         return result
 
     m = re.fullmatch(
-        rf"VACUUM\s+{_PATH}(?:\s+RETAIN\s+(?P<hours>[\d.]+)\s+HOURS)?"
-        r"(?:\s+(?P<vtype>LITE|FULL))?(?P<dry>\s+DRY\s+RUN)?",
+        # modifiers compose in any order, like the reference grammar
+        # (`DeltaSqlBase.g4:198` — `(vacuumType|retain|dryRun)*`)
+        rf"VACUUM\s+{_PATH}"
+        r"(?P<mods>(?:\s+(?:RETAIN\s+[\d.]+\s+HOURS|LITE|FULL"
+        r"|DRY\s+RUN))*)",
         s, re.IGNORECASE,
     )
     if m:
         from delta_tpu.commands.vacuum import vacuum
 
+        mods = m.group("mods") or ""
+        hours = re.search(r"RETAIN\s+([\d.]+)\s+HOURS", mods,
+                          re.IGNORECASE)
+        vtype = re.search(r"\b(LITE|FULL)\b", mods, re.IGNORECASE)
         return vacuum(
             _table(m, engine, catalog),
-            retention_hours=float(m.group("hours")) if m.group("hours") else None,
-            dry_run=m.group("dry") is not None,
-            vacuum_type=(m.group("vtype") or "FULL").upper(),
+            retention_hours=float(hours.group(1)) if hours else None,
+            dry_run=re.search(r"DRY\s+RUN", mods, re.IGNORECASE)
+            is not None,
+            vacuum_type=vtype.group(1).upper() if vtype else "FULL",
         )
 
     m = re.fullmatch(

@@ -316,11 +316,13 @@ def test_vacuum_lite_deletes_tombstones_not_untracked(tmp_table_path):
     mark = _json.load(open(info))
     assert mark["latestCommitVersionOutsideOfRetentionWindow"] == \
         res.eligible_end_commit_version
-    # FULL still reaps the junk afterwards, and resets the watermark
+    # FULL still reaps the junk afterwards, and (having observed every
+    # file) keeps the watermark current rather than resetting it
     res_full = vacuum(table, retention_hours=0)
     assert "untracked-junk.parquet" in res_full.files_deleted
     assert _json.load(open(info))[
-        "latestCommitVersionOutsideOfRetentionWindow"] is None
+        "latestCommitVersionOutsideOfRetentionWindow"] == \
+        res.eligible_end_commit_version
 
 
 def test_vacuum_lite_incremental_watermark(tmp_table_path):
@@ -452,3 +454,50 @@ def test_vacuum_lite_rejects_traversal_paths(tmp_table_path, tmp_path):
     assert victim.exists()
     assert all("victim" not in p and "etc" not in p
                for p in res.files_deleted)
+
+
+def test_vacuum_lite_repeat_is_empty(tmp_table_path):
+    """Running LITE twice with no new commits must not re-report (or
+    re-'delete') the files the first run already removed."""
+    table = _mk_table(tmp_table_path, n=50, n_commits=2)
+    delete(table, col("id") < lit(50))
+    res1 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res1.num_deleted == 1
+    res2 = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res2.num_deleted == 0
+    res_dry = vacuum(table, retention_hours=0, vacuum_type="LITE",
+                     dry_run=True)
+    assert res_dry.num_deleted == 0
+
+
+def test_vacuum_full_enables_lite_on_cleaned_log(tmp_table_path):
+    """A FULL vacuum observes every file, so on a table whose log head
+    was cleaned up it advances the watermark and un-wedges LITE."""
+    table = _mk_table(tmp_table_path, n=50, n_commits=3)
+    table.checkpoint()
+    for v in (0, 1):
+        os.unlink(os.path.join(
+            tmp_table_path, "_delta_log", f"{v:020d}.json"))
+    table = Table.for_path(tmp_table_path)
+    from delta_tpu.errors import VacuumLiteError
+
+    with pytest.raises(VacuumLiteError):
+        vacuum(table, retention_hours=0, vacuum_type="LITE")
+    vacuum(table, retention_hours=0)  # FULL
+    delete(table, col("id") < lit(50))
+    res = vacuum(table, retention_hours=0, vacuum_type="LITE")
+    assert res.num_deleted == 1
+
+
+def test_vacuum_sql_modifier_order(tmp_table_path):
+    """Reference grammar (`DeltaSqlBase.g4:198`) accepts modifiers in
+    any order: LITE before RETAIN must parse too."""
+    from delta_tpu.sql import sql
+
+    table = _mk_table(tmp_table_path, n=60, n_commits=2)
+    delete(table, col("id") < lit(60))
+    res = sql(f"VACUUM '{tmp_table_path}' LITE RETAIN 0 HOURS DRY RUN")
+    assert res.type_of_vacuum == "LITE" and res.dry_run
+    assert res.num_deleted == 1
+    res2 = sql(f"VACUUM '{tmp_table_path}' DRY RUN")
+    assert res2.type_of_vacuum == "FULL" and res2.dry_run
