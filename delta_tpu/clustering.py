@@ -28,6 +28,15 @@ ZCUBE_ZORDER_CURVE_TAG = "ZCUBE_ZORDER_CURVE"
 DEFAULT_MIN_CUBE_SIZE = 100 * 1024 * 1024 * 1024  # 100GB, reference default
 
 
+def _clusterable_type(dtype) -> bool:
+    """Clustering keys must support data skipping: scalar types only
+    (`ClusteredTableUtils.validateDataTypeSupported` — nested/complex
+    types have no min/max ordering)."""
+    from delta_tpu.models.schema import ArrayType, MapType, StructType
+
+    return not isinstance(dtype, (ArrayType, MapType, StructType))
+
+
 def clustering_domain(columns: List[str]) -> DomainMetadata:
     return DomainMetadata(
         CLUSTERING_DOMAIN,
@@ -60,10 +69,23 @@ def set_clustering_columns(table, columns: List[str]) -> int:
     snap = table.latest_snapshot()
     meta = snap.metadata
     schema = meta.schema
+    if len(columns) > 4:
+        # `DeltaErrors.clusterByInvalidNumColumnsException` (the
+        # reference caps liquid clustering keys at 4)
+        raise ClusteringColumnError(
+            f"CLUSTER BY supports at most 4 columns, got {len(columns)}",
+            error_class="DELTA_CLUSTER_BY_INVALID_NUM_COLUMNS")
     for c in columns:
         if schema is not None and c not in schema:
             raise ClusteringColumnError(f"clustering column {c} not in schema",
                                         error_class="DELTA_COLUMN_NOT_FOUND_IN_SCHEMA")
+        if schema is not None and not _clusterable_type(
+                schema[c].dataType):
+            # `DeltaErrors.clusteringColumnsDatatypeNotSupportedException`
+            raise ClusteringColumnError(
+                f"clustering column {c} has a data type that does not "
+                "support data skipping",
+                error_class="DELTA_CLUSTERING_COLUMNS_DATATYPE_NOT_SUPPORTED")
         if c in meta.partitionColumns:
             raise ClusteringColumnError(f"cannot cluster by partition column {c}",
                                         error_class="DELTA_CLUSTERING_ON_PARTITION_COLUMN")

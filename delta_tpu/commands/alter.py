@@ -61,21 +61,60 @@ def _commit_schema(txn, new_schema: StructType, operation_params: Dict,
     return txn.commit().version
 
 
+def _add_nested_field(schema: StructType, parent: list,
+                      leaf: StructField) -> StructType:
+    """Rebuild `schema` with `leaf` appended inside the struct at
+    `parent` path. Missing parent -> DELTA_ADD_COLUMN_STRUCT_NOT_FOUND;
+    non-struct parent -> DELTA_ADD_COLUMN_PARENT_NOT_STRUCT (reference
+    `SchemaUtils.addColumn` error conditions)."""
+    head = parent[0]
+    if head not in schema:
+        raise SchemaEvolutionError(
+            f"Struct not found at position {head}",
+            error_class="DELTA_ADD_COLUMN_STRUCT_NOT_FOUND")
+    out = []
+    for f in schema.fields:
+        if f.name != head:
+            out.append(f)
+            continue
+        if not isinstance(f.dataType, StructType):
+            raise SchemaEvolutionError(
+                f"cannot add {leaf.name} because its parent {head} is "
+                f"not a StructType ({f.dataType.to_json_value()})",
+                error_class="DELTA_ADD_COLUMN_PARENT_NOT_STRUCT")
+        inner = (
+            _add_nested_field(f.dataType, parent[1:], leaf)
+            if len(parent) > 1
+            else StructType(list(f.dataType.fields) + [leaf]))
+        if len(parent) == 1 and leaf.name in f.dataType:
+            raise SchemaMismatchError(
+                f"column {head}.{leaf.name} already exists")
+        out.append(StructField(f.name, inner, f.nullable,
+                               dict(f.metadata)))
+    return StructType(out)
+
+
 def add_columns(table, columns: Sequence[StructField]) -> int:
-    """ADD COLUMNS (always nullable; appended at the end)."""
+    """ADD COLUMNS (always nullable; appended at the end). Dotted
+    names (`a.b.c`) add a nested field inside the struct at `a.b`."""
     txn = _metadata_txn(table, Operation.ADD_COLUMNS)
     meta = txn.metadata()
     schema = schema_from_json(meta.schemaString)
     conf = dict(meta.configuration)
-    new_fields = []
     for f in columns:
-        if f.name in schema:
-            raise SchemaMismatchError(f"column {f.name} already exists")
         if not f.nullable:
             raise SchemaEvolutionError("added columns must be nullable",
                                        error_class="DELTA_ADD_COLUMN_NOT_NULLABLE")
-        new_fields.append(f)
-    new_schema = StructType(schema.fields + list(new_fields))
+        if "." in f.name:
+            parts = f.name.split(".")
+            leaf = StructField(parts[-1], f.dataType, f.nullable,
+                               dict(f.metadata))
+            schema = _add_nested_field(schema, parts[:-1], leaf)
+            continue
+        if f.name in schema:
+            raise SchemaMismatchError(f"column {f.name} already exists")
+        schema = StructType(schema.fields + [f])
+    new_schema = schema
     if mapping_mode(conf) != "none":
         new_schema, conf = assign_column_mapping(new_schema, conf)
     return _commit_schema(
@@ -122,8 +161,40 @@ def drop_column(table, name: str) -> int:
         raise SchemaEvolutionError(f"cannot drop partition column {name}",
                                    error_class="DELTA_UNSUPPORTED_DROP_PARTITION_COLUMN")
     schema = schema_from_json(meta.schemaString)
-    new_schema = _drop_from_schema(schema, name)
+    if "." in name:
+        new_schema = _drop_nested_field(schema, name.split("."))
+    else:
+        new_schema = _drop_from_schema(schema, name)
     return _commit_schema(txn, new_schema, {"column": name})
+
+
+def _drop_nested_field(schema: StructType, parts: list) -> StructType:
+    """Drop a nested field; an intermediate that is not a struct is
+    the reference's
+    `DeltaErrors.dropNestedColumnsFromNonStructTypeException`."""
+    from delta_tpu.errors import NonExistentColumnError
+
+    head = parts[0]
+    if head not in schema:
+        raise NonExistentColumnError(f"column {head} not found")
+    out = []
+    for f in schema.fields:
+        if f.name != head:
+            out.append(f)
+            continue
+        if not isinstance(f.dataType, StructType):
+            raise SchemaEvolutionError(
+                f"cannot drop nested column from a non-struct type: "
+                f"{f.dataType.to_json_value()}",
+                error_class=(
+                    "DELTA_UNSUPPORTED_DROP_NESTED_COLUMN_FROM_NON_STRUCT_TYPE"))
+        if len(parts) == 2:
+            inner = _drop_from_schema(f.dataType, parts[1])
+        else:
+            inner = _drop_nested_field(f.dataType, parts[1:])
+        out.append(StructField(f.name, inner, f.nullable,
+                               dict(f.metadata)))
+    return StructType(out)
 
 
 def change_column_type(table, name: str, new_type: DataType) -> int:
@@ -164,6 +235,11 @@ def change_column_type(table, name: str, new_type: DataType) -> int:
 def set_properties(table, properties: Dict[str, str]) -> int:
     txn = _metadata_txn(table, Operation.SET_TBLPROPERTIES)
     meta = txn.metadata()
+    from delta_tpu.config import validate_table_properties
+    from delta_tpu.coordinatedcommits.client import validate_cc_alter_set
+
+    validate_cc_alter_set(meta.configuration, properties)
+    validate_table_properties(properties)
     conf = dict(meta.configuration)
     old_mode = mapping_mode(conf)
     conf.update(properties)
@@ -194,6 +270,9 @@ def unset_properties(table, keys: Sequence[str],
                      if_exists: bool = False) -> int:
     txn = _metadata_txn(table, Operation.SET_TBLPROPERTIES)
     meta = txn.metadata()
+    from delta_tpu.coordinatedcommits.client import validate_cc_alter_unset
+
+    validate_cc_alter_unset(meta.configuration, keys)
     missing = [k for k in keys if k not in meta.configuration]
     if missing and not if_exists:
         raise InvalidArgumentError(

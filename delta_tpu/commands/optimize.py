@@ -99,6 +99,26 @@ class OptimizeBuilder:
             min_file_size=None, max_file_size=max_file_size,
         )
 
+    def execute_full(
+        self, max_file_size: int = DEFAULT_MAX_FILE_SIZE,
+    ) -> OptimizeMetrics:
+        """OPTIMIZE ... FULL: re-cluster EVERY file of a clustered
+        table, including files already in stable ZCubes
+        (`OptimizeTableCommand.scala` isFull; only valid on clustered
+        tables — `DeltaErrors.optimizeFullNotSupportedException`)."""
+        from delta_tpu.clustering import clustering_columns
+
+        snap = self._table.latest_snapshot()
+        if not clustering_columns(snap):
+            raise OptimizeArgumentError(
+                "OPTIMIZE FULL is only supported for clustered tables "
+                "with non-empty clustering columns",
+                error_class="DELTA_OPTIMIZE_FULL_NOT_SUPPORTED")
+        return _run_optimize(
+            self._table, self._filter, zorder_by=None,
+            min_file_size=None, max_file_size=max_file_size, full=True,
+        )
+
 
 def _run_optimize(
     table,
@@ -107,6 +127,7 @@ def _run_optimize(
     max_file_size: int,
     min_file_size: Optional[int],
     curve: str = "zorder",
+    full: bool = False,
 ) -> OptimizeMetrics:
     from delta_tpu.clustering import (
         clustering_columns,
@@ -130,6 +151,13 @@ def _run_optimize(
         zorder_by = cluster_cols
         min_file_size = None
         zcube_tags = new_zcube_tags(cluster_cols, curve)
+        if filter is not None:
+            # `DeltaErrors.clusteringWithPartitionPredicatesException`:
+            # clustered tables cluster the whole table, never a slice
+            raise OptimizeArgumentError(
+                "predicates are not supported when optimizing a "
+                "clustered table",
+                error_class="DELTA_CLUSTERING_WITH_PARTITION_PREDICATE")
     elif zorder_by and cluster_cols:
         raise OptimizeArgumentError(
             "clustered tables use OPTIMIZE (no ZORDER BY); clustering "
@@ -137,6 +165,11 @@ def _run_optimize(
             error_class="DELTA_CLUSTERING_WITH_ZORDER_BY")
 
     if zorder_by:
+        from delta_tpu.stats.collection import stats_columns
+
+        indexed = {".".join(p) for p in stats_columns(
+            schema, meta.configuration, meta.partitionColumns)} \
+            if schema is not None else None
         for c in zorder_by:
             if c in meta.partitionColumns:
                 raise OptimizeArgumentError(f"cannot Z-order by partition column {c}",
@@ -144,9 +177,22 @@ def _run_optimize(
             if schema is not None and c not in schema:
                 raise OptimizeArgumentError(f"Z-order column {c} not in schema",
                                         error_class="DELTA_ZORDERING_COLUMN_DOES_NOT_EXIST")
+            if indexed is not None and c not in indexed:
+                # `DeltaErrors.zOrderingOnColumnWithNoStatsException`:
+                # clustering by an unindexed column cannot help skipping
+                raise OptimizeArgumentError(
+                    f"Z-ordering on {c} will be ineffective: no "
+                    "file statistics are collected for it (see "
+                    "delta.dataSkippingStatsColumns / "
+                    "delta.dataSkippingNumIndexedCols)",
+                    error_class="DELTA_ZORDERING_ON_COLUMN_WITHOUT_STATS")
 
     candidates = txn.scan_files(filter=filter)
-    if zcube_tags is not None:
+    if full:
+        zcube_tags = zcube_tags or (
+            new_zcube_tags(cluster_cols, curve) if cluster_cols else None)
+        # OPTIMIZE FULL ignores ZCube stability: everything re-clusters
+    elif zcube_tags is not None:
         # skip files already in a stable cube over the same columns
         cube_sizes: Dict[str, int] = {}
         from delta_tpu.clustering import ZCUBE_ID_TAG

@@ -38,11 +38,29 @@ def restore(table, version: Optional[int] = None, timestamp_ms: Optional[int] = 
         raise RestoreTargetError(
             "restore requires exactly one of version / timestamp",
             error_class="DELTA_ONEOF_IN_TIMETRAVEL")
-    target = (
-        table.snapshot_at(version)
-        if version is not None
-        else table.snapshot_as_of_timestamp(timestamp_ms)
-    )
+    if version is not None:
+        target = table.snapshot_at(version)
+    else:
+        from delta_tpu.errors import (
+            TimestampEarlierThanCommitRetentionError,
+            TimestampLaterThanLatestCommitError,
+        )
+
+        # `RestoreTableCommand` maps time-travel range misses to its
+        # own classes (`DeltaErrors.restoreTimestampBefore/GreaterThan
+        # LatestCommit`)
+        try:
+            target = table.snapshot_as_of_timestamp(timestamp_ms)
+        except TimestampEarlierThanCommitRetentionError as e:
+            raise RestoreTargetError(
+                f"cannot restore table to timestamp {timestamp_ms}: "
+                f"it is before the earliest available version ({e})",
+                error_class="DELTA_CANNOT_RESTORE_TIMESTAMP_EARLIER")
+        except TimestampLaterThanLatestCommitError as e:
+            raise RestoreTargetError(
+                f"cannot restore table to timestamp {timestamp_ms}: "
+                f"it is after the latest available version ({e})",
+                error_class="DELTA_CANNOT_RESTORE_TIMESTAMP_GREATER")
     current = table.latest_snapshot()
     now_ms = int(time.time() * 1000)
 
@@ -101,6 +119,14 @@ def clone(source_table, dest_path: str, shallow: bool = True,
     dest = Table.for_path(dest_path, source_table.engine)
     if dest.exists():
         raise CloneTargetExistsError(f"clone destination {dest_path} already exists")
+    if os.path.isdir(dest_path) and os.listdir(dest_path):
+        # a non-table directory with content: cloning over it would
+        # mix foreign files into the table data
+        # (`DeltaErrors.cloneOnNonEmptyTarget` semantics)
+        raise CloneTargetExistsError(
+            f"clone destination {dest_path} is a non-empty directory; "
+            "CLONE requires an empty or nonexistent target",
+            error_class="DELTA_UNSUPPORTED_NON_EMPTY_CLONE")
     meta = snap.metadata
 
     new_conf = dict(meta.configuration)

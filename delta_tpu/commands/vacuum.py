@@ -385,16 +385,18 @@ def vacuum(
                 pass
 
         parallel_map(_unlink, doomed)
-    if not dry_run:
+    if not dry_run and inventory is None:
         # Advance-only watermark: an empty run (cutoff before the
         # earliest commit, or no new commits since the last watermark)
         # must not reset or regress it — that would force the next run
         # to rescan, or spuriously trip the log-cleanup gap check. A
-        # FULL (or inventory) vacuum observes every file regardless of
-        # log state, so it advances the watermark too — unlike the
-        # reference, which resets it to null after FULL
-        # (`VacuumCommand.scala:484`) and thereby wedges LITE forever
-        # on any table whose log head has been cleaned up.
+        # true FULL vacuum walks every file, so it advances the
+        # watermark too — unlike the reference, which resets it to
+        # null after FULL (`VacuumCommand.scala:484`) and thereby
+        # wedges LITE forever on any table whose log head has been
+        # cleaned up. An INVENTORY vacuum observes only the rows the
+        # caller supplied, which proves nothing about unlisted
+        # tombstones — it never touches the watermark.
         new_mark = lite_end if vacuum_type == "LITE" else \
             _commit_outside_retention(table, cutoff)
         if new_mark is not None and (last_mark is None

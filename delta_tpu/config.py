@@ -306,3 +306,48 @@ class Settings:
 
 
 settings = Settings()
+
+
+# properties recognized beyond the typed registry: feature-support
+# flags and constraint definitions carry open-ended suffixes
+_OPEN_PREFIXES = ("delta.feature.", "delta.constraints.")
+
+
+def validate_table_properties(properties: Dict[str, str]) -> None:
+    """SET-time validation (`DeltaConfigs.validateConfigurations`):
+    unknown `delta.`-namespace keys are rejected (typo protection — a
+    misspelled property would otherwise silently do nothing), and known
+    keys must parse."""
+    from delta_tpu.errors import DeltaError, InvalidTablePropertyError
+
+    for k, v in properties.items():
+        if not k.startswith("delta.") or k.startswith(_OPEN_PREFIXES):
+            continue
+        cfg = TABLE_CONFIGS.get(k)
+        if cfg is None:
+            raise InvalidTablePropertyError(
+                f"Unknown configuration was specified: {k}",
+                error_class="DELTA_UNKNOWN_CONFIGURATION")
+        if cfg.parse is _parse_bool and \
+                str(v).strip().lower() not in ("true", "false"):
+            # the read path is lenient (anything != 'true' is False),
+            # so SET must be strict or a typo'd boolean silently
+            # flips the property off
+            if k == "delta.autoOptimize.autoCompact":
+                raise InvalidTablePropertyError(
+                    f"Invalid auto-compact type: {v}. Allowed values "
+                    "are: (true, false)",
+                    error_class="DELTA_INVALID_AUTO_COMPACT_TYPE")
+            raise InvalidTablePropertyError(
+                f"The validation of the properties of the table has "
+                f"been violated: {k}={v!r} is not a boolean",
+                error_class="DELTA_VIOLATE_TABLE_PROPERTY_VALIDATION_FAILED")
+        try:
+            cfg.parse(str(v))
+        except DeltaError:
+            raise
+        except Exception as e:
+            raise InvalidTablePropertyError(
+                f"The validation of the properties of the table has "
+                f"been violated: {k}={v!r} ({e})",
+                error_class="DELTA_VIOLATE_TABLE_PROPERTY_VALIDATION_FAILED")

@@ -187,3 +187,80 @@ def coordinator_for_table(metadata_configuration: Dict[str, str]) -> Optional[Co
             error_class="DELTA_UNKNOWN_COMMIT_COORDINATOR",
         )
     return client
+
+
+# -- ALTER-time validation (`CoordinatedCommitsUtils.scala:437-483`) ----
+
+CC_TABLE_PROPERTY_KEYS = (COORDINATOR_NAME_KEY, COORDINATOR_CONF_KEY,
+                          TABLE_CONF_KEY)
+ICT_TABLE_PROPERTY_KEYS = (
+    "delta.enableInCommitTimestamps",
+    "delta.inCommitTimestampEnablementVersion",
+    "delta.inCommitTimestampEnablementTimestamp",
+)
+
+
+def validate_cc_alter_set(existing: Dict[str, str],
+                          overrides: Dict[str, str]) -> None:
+    """ALTER ... SET TBLPROPERTIES guards for coordinated-commits
+    confs: no overriding an existing coordinator, no direct tableConf
+    writes, name+conf must come together, and the ICT properties a
+    coordinator depends on are immutable while (or when becoming)
+    coordinated."""
+    from delta_tpu.errors import InvalidArgumentError
+
+    cc_over = [k for k in overrides if k in CC_TABLE_PROPERTY_KEYS]
+    cc_exist = [k for k in existing if k in CC_TABLE_PROPERTY_KEYS]
+    ict_over = [k for k in overrides if k in ICT_TABLE_PROPERTY_KEYS]
+    if cc_over:
+        if cc_exist:
+            raise InvalidArgumentError(
+                "ALTER cannot override coordinated-commits "
+                "configurations of an already-coordinated table; drop "
+                "the coordinatedCommits feature first",
+                error_class=(
+                    "DELTA_CANNOT_OVERRIDE_COORDINATED_COMMITS_CONFS"))
+        if ict_over:
+            raise InvalidArgumentError(
+                "ALTER cannot set in-commit-timestamp properties "
+                "together with coordinated-commits configurations",
+                error_class=(
+                    "DELTA_CANNOT_SET_COORDINATED_COMMITS_DEPENDENCIES"))
+        if TABLE_CONF_KEY in overrides:
+            raise InvalidArgumentError(
+                f"configuration {TABLE_CONF_KEY} is coordinator-"
+                "managed and cannot be set by ALTER",
+                error_class="DELTA_CONF_OVERRIDE_NOT_SUPPORTED_IN_COMMAND")
+        for key in (COORDINATOR_NAME_KEY, COORDINATOR_CONF_KEY):
+            if key not in overrides:
+                raise InvalidArgumentError(
+                    f"ALTER must set both {COORDINATOR_NAME_KEY} and "
+                    f"{COORDINATOR_CONF_KEY}; missing {key}",
+                    error_class=(
+                        "DELTA_MUST_SET_ALL_COORDINATED_COMMITS_CONFS_IN_COMMAND"))
+    elif cc_exist and ict_over:
+        raise InvalidArgumentError(
+            "ALTER cannot modify in-commit-timestamp properties of a "
+            "coordinated-commits table",
+            error_class=(
+                "DELTA_CANNOT_MODIFY_COORDINATED_COMMITS_DEPENDENCIES"))
+
+
+def validate_cc_alter_unset(existing: Dict[str, str], keys) -> None:
+    """ALTER ... UNSET TBLPROPERTIES guard: coordinated-commits confs
+    and their ICT dependencies only leave via DROP FEATURE."""
+    from delta_tpu.errors import InvalidArgumentError
+
+    if not any(k in existing for k in CC_TABLE_PROPERTY_KEYS):
+        return
+    if any(k in CC_TABLE_PROPERTY_KEYS for k in keys):
+        raise InvalidArgumentError(
+            "ALTER cannot unset coordinated-commits configurations; "
+            "drop the coordinatedCommits feature instead",
+            error_class="DELTA_CANNOT_UNSET_COORDINATED_COMMITS_CONFS")
+    if any(k in ICT_TABLE_PROPERTY_KEYS for k in keys):
+        raise InvalidArgumentError(
+            "ALTER cannot unset in-commit-timestamp properties of a "
+            "coordinated-commits table",
+            error_class=(
+                "DELTA_CANNOT_MODIFY_COORDINATED_COMMITS_DEPENDENCIES"))

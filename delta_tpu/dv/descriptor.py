@@ -58,11 +58,15 @@ def absolute_dv_path(table_path: str, descriptor_row: Dict) -> str:
 
 
 def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.ndarray:
-    """Descriptor → sorted uint64 array of deleted row indexes."""
+    """Descriptor → sorted uint64 array of deleted row indexes.
+    Validates the descriptor's declared size and cardinality against
+    the decoded bitmap (`DeltaErrors.deletionVectorSizeMismatch` /
+    `.deletionVectorCardinalityMismatch` — a descriptor out of sync
+    with its bitmap silently un-deletes or over-deletes rows)."""
     storage = descriptor_row["storageType"]
     if storage == "i":
         blob = base64.b85decode(descriptor_row["pathOrInlineDv"].encode("ascii"))
-        return RoaringBitmapArray.deserialize_delta(blob).values
+        return _decoded(blob, descriptor_row, "<inline>")
     path = absolute_dv_path(table_path, descriptor_row)
     data = engine.fs.read_file(path)
     offset = descriptor_row.get("offset") or 0
@@ -75,7 +79,26 @@ def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.nd
         raise DeletionVectorError(
             f"deletion vector checksum mismatch in {path}",
             error_class="DELTA_DELETION_VECTOR_CHECKSUM_MISMATCH")
-    return RoaringBitmapArray.deserialize_delta(blob).values
+    return _decoded(blob, descriptor_row, path)
+
+
+def _decoded(blob: bytes, descriptor_row: Dict, where: str) -> np.ndarray:
+    from delta_tpu.errors import DeletionVectorError
+
+    declared_size = descriptor_row.get("sizeInBytes")
+    if declared_size is not None and declared_size != len(blob):
+        raise DeletionVectorError(
+            f"deletion vector at {where}: sizeInBytes "
+            f"{declared_size} != actual {len(blob)}",
+            error_class="DELTA_DELETION_VECTOR_SIZE_MISMATCH")
+    values = RoaringBitmapArray.deserialize_delta(blob).values
+    declared_card = descriptor_row.get("cardinality")
+    if declared_card is not None and declared_card != len(values):
+        raise DeletionVectorError(
+            f"deletion vector at {where}: cardinality "
+            f"{declared_card} != decoded {len(values)}",
+            error_class="DELTA_DELETION_VECTOR_CARDINALITY_MISMATCH")
+    return values
 
 
 def write_deletion_vector_file(

@@ -138,6 +138,31 @@ def version_at_timestamp(
     return best
 
 
+def version_at_or_after_timestamp(table, timestamp_ms: int) -> int:
+    """Earliest version whose (ICT-aware) commit timestamp is >= the
+    given timestamp — the start-boundary rule shared by streaming
+    `startingTimestamp` and CDC `startingTimestamp`
+    (`DeltaSource.getStartingVersion` / `CDCReader` semantics: changes
+    AT or AFTER the time, never before). A timestamp after the latest
+    commit raises."""
+    fs = table.engine.fs
+    commits = _list_commit_files(fs, table.log_path)
+    if not commits:
+        from delta_tpu.errors import TableNotFoundError
+
+        raise TableNotFoundError(table.path)
+    commits.sort(key=lambda f: filenames.delta_version(f.path))
+    ts = _commit_timestamps(fs, commits)
+    ict_ts = _maybe_ict_timestamps(fs, commits, ts)
+    for fstat, t in zip(commits, ict_ts):
+        if t >= timestamp_ms:
+            return filenames.delta_version(fstat.path)
+    raise TimestampLaterThanLatestCommitError(
+        f"timestamp {timestamp_ms} is after the latest commit "
+        f"(ts {ict_ts[-1]})",
+        error_class="DELTA_TIMESTAMP_GREATER_THAN_COMMIT")
+
+
 def _maybe_ict_timestamps(fs, commits, fallback_ts: List[int]) -> List[int]:
     """If any commit carries inCommitTimestamp, prefer it. Reads commit
     heads only when the table's newest commit uses ICT."""

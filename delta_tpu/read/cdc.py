@@ -21,6 +21,7 @@ from delta_tpu.models.actions import (
     AddCDCFile,
     AddFile,
     CommitInfo,
+    Metadata,
     RemoveFile,
     actions_from_commit_bytes,
 )
@@ -42,9 +43,41 @@ def _with_meta(tbl: pa.Table, change_type: Optional[str], version: int, ts: int)
 
 def table_changes(
     table,
-    starting_version: int,
+    starting_version: Optional[int] = None,
     ending_version: Optional[int] = None,
+    starting_timestamp: Optional[int] = None,
+    ending_timestamp: Optional[int] = None,
 ) -> pa.Table:
+    from delta_tpu.errors import InvalidArgumentError
+
+    if starting_version is not None and starting_timestamp is not None:
+        # `DeltaErrors.multipleCDCBoundaryException`
+        raise InvalidArgumentError(
+            "multiple starting arguments provided for CDC read; please "
+            "provide one of either startingVersion or startingTimestamp",
+            error_class="DELTA_MULTIPLE_CDC_BOUNDARY")
+    if ending_version is not None and ending_timestamp is not None:
+        raise InvalidArgumentError(
+            "multiple ending arguments provided for CDC read; please "
+            "provide one of either endingVersion or endingTimestamp",
+            error_class="DELTA_MULTIPLE_CDC_BOUNDARY")
+    if starting_version is None and starting_timestamp is None:
+        # `DeltaErrors.noStartVersionForCDC`
+        raise InvalidArgumentError(
+            "no startingVersion or startingTimestamp provided for CDC "
+            "read", error_class="DELTA_NO_START_FOR_CDC_READ")
+    if starting_timestamp is not None:
+        # start boundary is AT-OR-AFTER the timestamp (changes
+        # committed before the requested time must not be returned)
+        from delta_tpu.history import version_at_or_after_timestamp
+
+        starting_version = version_at_or_after_timestamp(
+            table, starting_timestamp)
+    if ending_timestamp is not None:
+        from delta_tpu.history import version_at_timestamp
+
+        ending_version = version_at_timestamp(
+            table, ending_timestamp, can_return_last_commit=True)
     snap = table.latest_snapshot()
     conf = snap.metadata.configuration
     if not cdf_enabled(conf):
@@ -60,6 +93,18 @@ def table_changes(
             f"invalid CDC range [{starting_version}, {end}]: start is "
             "after end", error_class="DELTA_INVALID_CDC_RANGE")
     fs = table.engine.fs
+    # CDF coverage check (`DeltaErrors.changeDataNotRecordedException`):
+    # if the range reaches back before CDF was enabled, those commits
+    # never recorded change data and the read must fail rather than
+    # silently fabricate it
+    enabled = True
+    if starting_version <= snap.version:
+        try:
+            enabled = cdf_enabled(
+                table.snapshot_at(starting_version)
+                .metadata.configuration)
+        except DeltaError:
+            pass  # start predates reconstructable history: best effort
     out: List[pa.Table] = []
     for v in range(starting_version, end + 1):
         try:
@@ -67,6 +112,20 @@ def table_changes(
         except FileNotFoundError:
             continue
         actions = actions_from_commit_bytes(data)
+        metas = [a for a in actions if isinstance(a, Metadata)]
+        if metas:
+            enabled = cdf_enabled(metas[-1].configuration)
+        if not enabled and any(
+                isinstance(a, AddCDCFile)
+                or (isinstance(a, (AddFile, RemoveFile)) and a.dataChange)
+                for a in actions):
+            from delta_tpu.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"error getting change data for range "
+                f"[{starting_version}, {end}]: change data was not "
+                f"recorded for version {v}",
+                error_class="DELTA_MISSING_CHANGE_DATA")
         ts = 0
         for a in actions:
             if isinstance(a, CommitInfo):

@@ -153,7 +153,8 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         )
 
     m = re.fullmatch(
-        rf"OPTIMIZE\s+{_PATH}(?:\s+WHERE\s+(?P<where>.+?))?"
+        rf"OPTIMIZE\s+{_PATH}(?P<full>\s+FULL)?"
+        r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+ZORDER\s+BY\s+\((?P<zcols>[^)]+)\))?",
         s, re.IGNORECASE,
     )
@@ -161,6 +162,17 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
         builder = _table(m, engine, catalog).optimize()
         if m.group("where"):
             builder = builder.where(parse_expression(m.group("where")))
+        if m.group("full"):
+            if m.group("zcols"):
+                from delta_tpu.errors import OptimizeArgumentError
+
+                raise OptimizeArgumentError(
+                    "OPTIMIZE FULL re-clusters by the table's "
+                    "clustering columns; ZORDER BY cannot be combined "
+                    "with it",
+                    error_class="DELTA_CLUSTERING_WITH_ZORDER_BY")
+            # OPTIMIZE ... FULL (clustered tables only)
+            return builder.execute_full()
         if m.group("zcols"):
             cols = [c.strip().strip("`") for c in m.group("zcols").split(",")]
             return builder.execute_zorder_by(*cols)
@@ -200,12 +212,27 @@ def sql(statement: str, engine=None, catalog=None, path_guard=None):
                        timestamp_ms=_timestamp_ms(raw))
 
     m = re.fullmatch(
-        rf"CONVERT\s+TO\s+DELTA\s+parquet\.{_QUOTED_PATH}"
+        rf"CONVERT\s+TO\s+DELTA\s+(?:(?P<prov>\w+)\.)?{_QUOTED_PATH}"
         r"(?:\s+PARTITIONED\s+BY\s+\((?P<parts>[^)]+)\))?",
         s, re.IGNORECASE,
     )
     if m:
         from delta_tpu.commands.restore import convert_to_delta
+        from delta_tpu.errors import ConvertTargetError
+
+        prov = m.group("prov")
+        if prov is None:
+            # `DeltaErrors.missingProviderForConvertException`
+            raise ConvertTargetError(
+                "CONVERT TO DELTA requires a provider prefix, e.g. "
+                "parquet.`/path`",
+                error_class="DELTA_MISSING_PROVIDER_FOR_CONVERT")
+        if prov.lower() != "parquet":
+            # `DeltaErrors.convertNonParquetTablesException`
+            raise ConvertTargetError(
+                f"CONVERT TO DELTA only supports parquet tables, got "
+                f"provider {prov!r}",
+                error_class="DELTA_CONVERT_NON_PARQUET_TABLE")
 
         part_schema = None
         if m.group("parts"):

@@ -467,6 +467,14 @@ class _P:
             # leading quote as "parse as ISO", so a bare ISO string
             # would fall through to int() and crash
             tt_ts = tok.value if tok.kind == "num" else f"'{tok.value}'"
+        if (tt_version is not None or tt_ts is not None) and \
+                self.peek().is_kw("VERSION", "TIMESTAMP") and \
+                self.peek(1).is_kw("AS"):
+            # `DeltaErrors.multipleTimeTravelSyntaxUsed`
+            raise SqlParseError(
+                "cannot specify time travel in multiple formats "
+                "(VERSION AS OF and TIMESTAMP AS OF)",
+                error_class="DELTA_UNSUPPORTED_TIME_TRAVEL_MULTIPLE_FORMATS")
         alias = self._opt_alias()
         return TableRef(kind, value, alias, tt_version, tt_ts)
 

@@ -15,8 +15,8 @@ same contract as the reference.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass
-from typing import Iterator, List, Optional
+from dataclasses import dataclass, field
+from typing import ClassVar, Iterator, List, Optional
 
 import pyarrow as pa
 
@@ -40,10 +40,19 @@ class DeltaSourceOffset:
     reservoir_version: int
     index: int
     is_initial_snapshot: bool = False
+    # provenance fields (`DeltaSourceOffset.scala:43-59`): the offset
+    # format version and the table id the offset was produced against.
+    # Excluded from equality so positional offsets still compare.
+    source_version: int = field(default=1, compare=False)
+    reservoir_id: Optional[str] = field(default=None, compare=False)
+
+    VERSION: ClassVar[int] = 1
 
     def to_json(self) -> str:
         return json.dumps(
             {
+                "sourceVersion": self.source_version,
+                "reservoirId": self.reservoir_id,
                 "reservoirVersion": self.reservoir_version,
                 "index": self.index,
                 "isStartingVersion": self.is_initial_snapshot,
@@ -52,10 +61,27 @@ class DeltaSourceOffset:
 
     @staticmethod
     def from_json(s: str) -> "DeltaSourceOffset":
-        d = json.loads(s)
+        from delta_tpu.errors import StreamingSourceError
+
+        try:
+            d = json.loads(s)
+            version = int(d["reservoirVersion"])
+            index = int(d["index"])
+            sv = int(d.get("sourceVersion", DeltaSourceOffset.VERSION))
+        except (ValueError, TypeError, KeyError) as e:
+            # `DeltaErrors.invalidSourceOffsetFormat`
+            raise StreamingSourceError(
+                f"invalid Delta source offset: {s!r} ({e})",
+                error_class="DELTA_INVALID_SOURCE_OFFSET_FORMAT")
+        if not 1 <= sv <= DeltaSourceOffset.VERSION:
+            # `DeltaSourceOffset.validateSourceVersion` ->
+            # `DeltaErrors.invalidSourceVersion`
+            raise StreamingSourceError(
+                f"sourceVersion({sv}) is invalid",
+                error_class="DELTA_INVALID_SOURCE_VERSION")
         return DeltaSourceOffset(
-            int(d["reservoirVersion"]), int(d["index"]),
-            bool(d.get("isStartingVersion", False)),
+            version, index, bool(d.get("isStartingVersion", False)),
+            source_version=sv, reservoir_id=d.get("reservoirId"),
         )
 
 
@@ -181,10 +207,22 @@ class DeltaSource:
         ignore_deletes: bool = False,
         ignore_changes: bool = False,
         schema_tracking_log=None,
+        starting_timestamp: Optional[int] = None,
     ):
         self.table = table
         self.ignore_deletes = ignore_deletes
         self.ignore_changes = ignore_changes
+        if starting_version is not None and starting_timestamp is not None:
+            from delta_tpu.errors import InvalidArgumentError
+
+            # `DeltaErrors.startingVersionAndTimestampBothSetException`
+            raise InvalidArgumentError(
+                "please either provide 'startingVersion' or "
+                "'startingTimestamp'",
+                error_class="DELTA_STARTING_VERSION_AND_TIMESTAMP_BOTH_SET")
+        if starting_timestamp is not None:
+            starting_version = self._version_from_timestamp(
+                table, starting_timestamp)
         if starting_version is not None and starting_version < 0:
             from delta_tpu.errors import InvalidArgumentError
 
@@ -204,6 +242,87 @@ class DeltaSource:
             latest = schema_tracking_log.latest()
             if latest is not None:
                 self._tracked_schema = latest.schema_string
+
+    @staticmethod
+    def _version_from_timestamp(table, ts_ms: int) -> int:
+        """startingTimestamp -> version: the earliest commit at/after
+        the timestamp (`DeltaSource.getStartingVersion`); a timestamp
+        after the latest commit is an error."""
+        from delta_tpu.history import version_at_or_after_timestamp
+
+        return version_at_or_after_timestamp(table, ts_ms)
+
+    @classmethod
+    def from_options(cls, table, options: dict):
+        """Build a source + ReadLimits from string options — the
+        reference's `DeltaOptions` parsing surface with its validation
+        classes. Returns (source, limits)."""
+        from delta_tpu.errors import InvalidArgumentError
+
+        opts = {k.lower(): v for k, v in options.items()}
+
+        def boolean(name, default=False):
+            v = opts.get(name.lower())
+            if v is None:
+                return default
+            if str(v).lower() in ("true", "false"):
+                return str(v).lower() == "true"
+            # `DeltaErrors.illegalDeltaOptionException`
+            raise InvalidArgumentError(
+                f"Invalid value '{v}' for option '{name}', must be "
+                "'true' or 'false'", error_class="DELTA_ILLEGAL_OPTION")
+
+        def limit(name):
+            v = opts.get(name.lower())
+            if v is None:
+                return None
+            try:
+                n = int(v)
+                if n <= 0:
+                    raise ValueError
+            except ValueError:
+                # `DeltaErrors.unknownReadLimit`
+                raise InvalidArgumentError(
+                    f"Invalid value '{v}' for option '{name}': "
+                    "expected a positive integer",
+                    error_class="DELTA_UNKNOWN_READ_LIMIT")
+            return n
+
+        sv = opts.get("startingversion")
+        if sv is not None:
+            if str(sv).lower() == "latest":
+                sv = table.latest_snapshot().version + 1
+            else:
+                try:
+                    sv = int(sv)
+                except ValueError:
+                    # `DeltaErrors.invalidSourceVersion` option form
+                    raise InvalidArgumentError(
+                        f"Invalid value '{sv}' for option "
+                        "'startingVersion': expected an integer or "
+                        "'latest'",
+                        error_class="DELTA_INVALID_SOURCE_VERSION")
+        ts = opts.get("startingtimestamp")
+        if ts is not None:
+            from delta_tpu.sql import _timestamp_ms
+
+            ts = _timestamp_ms(str(ts) if str(ts).isdigit()
+                               else f"'{ts}'")
+        src = cls(
+            table,
+            starting_version=sv,
+            starting_timestamp=ts,
+            ignore_deletes=boolean("ignoreDeletes"),
+            ignore_changes=boolean("ignoreChanges"),
+        )
+        limits = ReadLimits()
+        mf = limit("maxFilesPerTrigger")
+        if mf is not None:
+            limits.max_files = mf
+        mb = limit("maxBytesPerTrigger")
+        if mb is not None:
+            limits.max_bytes = mb
+        return src, limits
 
     # -- initial snapshot ---------------------------------------------------
 
@@ -363,15 +482,43 @@ class DeltaSource:
 
     # -- public micro-batch API --------------------------------------------
 
+    def _table_id(self) -> str:
+        """The table's immutable id, fetched once (offset stamping and
+        validation sit on the per-poll hot path — no extra snapshot
+        builds there)."""
+        if getattr(self, "_cached_table_id", None) is None:
+            self._cached_table_id = \
+                self.table.latest_snapshot().metadata.id
+        return self._cached_table_id
+
+    def _check_offset_table(self, *offsets) -> None:
+        """An offset produced against a different table id must not be
+        applied here (`DeltaSource.scala` checkReadIncompatibleSchema
+        path -> `DeltaErrors.differentDeltaTableReadByStreamingSource`):
+        a checkpoint dir reused for another table would silently replay
+        the wrong history."""
+        for o in offsets:
+            if o is not None and o.reservoir_id is not None \
+                    and o.reservoir_id != self._table_id():
+                raise StreamingSourceError(
+                    f"the streaming query was reading from an "
+                    f"unexpected Delta table (id = {o.reservoir_id!r}, "
+                    f"expected {self._table_id()!r})",
+                    error_class=(
+                        "DIFFERENT_DELTA_TABLE_READ_BY_STREAMING_SOURCE"))
+
     def latest_offset(
         self, start: Optional[DeltaSourceOffset] = None,
         limits: Optional[ReadLimits] = None,
     ) -> Optional[DeltaSourceOffset]:
+        self._check_offset_table(start)
         files = self._indexed_after(start, limits or ReadLimits())
         if not files:
             return start
         last = files[-1]
-        return DeltaSourceOffset(last.version, last.index, last.is_initial)
+        return DeltaSourceOffset(
+            last.version, last.index, last.is_initial,
+            reservoir_id=self._table_id())
 
     def get_batch(
         self,
@@ -379,6 +526,7 @@ class DeltaSource:
         end: DeltaSourceOffset,
     ) -> pa.Table:
         """All rows in files after `start` up to and including `end`."""
+        self._check_offset_table(start, end)
         files = self._indexed_after(start, ReadLimits(max_files=None, max_bytes=None))
         # Initial-snapshot files share the start snapshot's version and the
         # tail begins at version+1, so (version, index) totally orders the

@@ -340,6 +340,30 @@ class Transaction:
                 )
             if len(set(pcols)) != len(pcols):
                 raise InvalidArgumentError(f"duplicate partition columns: {pcols}")
+            if schema is not None and len(pcols) == len(schema.fields):
+                # `DeltaErrors.cannotUseAllColumnsForPartitionColumns`:
+                # every row group would be a partition directory with
+                # empty data files
+                raise InvalidArgumentError(
+                    "cannot use all columns for partition columns",
+                    error_class="DELTA_CANNOT_USE_ALL_COLUMNS_FOR_PARTITION")
+            if schema is not None:
+                from delta_tpu.models.schema import (
+                    ArrayType,
+                    MapType,
+                    StructType,
+                )
+
+                by_name = {f.name: f for f in schema.fields}
+                for c in pcols:
+                    if isinstance(by_name[c].dataType,
+                                  (ArrayType, MapType, StructType)):
+                        # `DeltaErrors.invalidPartitionColumnType`
+                        raise InvalidArgumentError(
+                            f"using column {c} of type "
+                            f"{by_name[c].dataType.to_json_value()} as "
+                            "a partition column is not supported",
+                            error_class="DELTA_INVALID_PARTITION_COLUMN_TYPE")
         self._new_metadata = metadata
 
     def update_protocol(self, protocol: Protocol) -> None:

@@ -335,3 +335,392 @@ def test_invalid_column_chars_nested_and_alter(tmp_path):
         add_columns(Table.for_path(p2), [StructField("a b", LONG)])
     assert error_info(ei.value)["errorClass"] == \
         "DELTA_INVALID_CHARACTERS_IN_COLUMN_NAME"
+
+
+def test_round5_command_validation_conditions(tmp_path):
+    """Batch of reference conditions added in round 5: OPTIMIZE FULL,
+    zorder-without-stats, clustering limits, restore timestamps,
+    clone/convert targets, multi-format time travel."""
+    import time
+
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.sql import sql
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({
+        "id": pa.array([1, 2], pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+        "tags": pa.array([[1], [2]], pa.list_(pa.int64()))}))
+    t = Table.for_path(p)
+
+    # OPTIMIZE FULL on a non-clustered table
+    assert klass(lambda: sql(f"OPTIMIZE '{p}' FULL")) \
+        == "DELTA_OPTIMIZE_FULL_NOT_SUPPORTED"
+
+    # zorder on a column with no collected stats
+    sql(f"ALTER TABLE '{p}' SET TBLPROPERTIES "
+        f"('delta.dataSkippingStatsColumns' = 'id')")
+    assert klass(lambda: t.optimize().execute_zorder_by("v")) \
+        == "DELTA_ZORDERING_ON_COLUMN_WITHOUT_STATS"
+
+    # clustering: >4 columns / non-skippable datatype
+    from delta_tpu.clustering import set_clustering_columns
+
+    assert klass(lambda: set_clustering_columns(
+        t, ["a", "b", "c", "d", "e"])) \
+        == "DELTA_CLUSTER_BY_INVALID_NUM_COLUMNS"
+    assert klass(lambda: set_clustering_columns(t, ["tags"])) \
+        == "DELTA_CLUSTERING_COLUMNS_DATATYPE_NOT_SUPPORTED"
+
+    # clustered OPTIMIZE rejects predicates; FULL works end-to-end
+    set_clustering_columns(t, ["id"])
+    from delta_tpu.expressions import col, lit
+
+    assert klass(lambda: t.optimize().where(
+        col("id") > lit(0)).execute_compaction()) \
+        == "DELTA_CLUSTERING_WITH_PARTITION_PREDICATE"
+    m = t.optimize().execute_full()
+    assert m.num_files_added >= 1
+
+    # restore to out-of-range timestamps
+    from delta_tpu.commands.restore import restore
+
+    assert klass(lambda: restore(t, timestamp_ms=1)) \
+        == "DELTA_CANNOT_RESTORE_TIMESTAMP_EARLIER"
+    assert klass(lambda: restore(
+        t, timestamp_ms=int(time.time() * 1000) + 10**9)) \
+        == "DELTA_CANNOT_RESTORE_TIMESTAMP_GREATER"
+
+    # clone into a non-empty, non-table directory
+    from delta_tpu.commands.restore import clone
+
+    junkdir = tmp_path / "junkdir"
+    junkdir.mkdir()
+    (junkdir / "x.bin").write_bytes(b"x")
+    assert klass(lambda: clone(t, str(junkdir))) \
+        == "DELTA_UNSUPPORTED_NON_EMPTY_CLONE"
+
+    # convert: missing / non-parquet provider
+    assert klass(lambda: sql(f"CONVERT TO DELTA '{p}'")) \
+        == "DELTA_MISSING_PROVIDER_FOR_CONVERT"
+    assert klass(lambda: sql(f"CONVERT TO DELTA iceberg.'{p}'")) \
+        == "DELTA_CONVERT_NON_PARQUET_TABLE"
+
+    # both time-travel formats on one table reference
+    from delta_tpu.sqlengine import execute_select
+
+    assert klass(lambda: execute_select(
+        f"SELECT * FROM '{p}' VERSION AS OF 0 TIMESTAMP AS OF 1")) \
+        == "DELTA_UNSUPPORTED_TIME_TRAVEL_MULTIPLE_FORMATS"
+
+
+def test_round5_streaming_cdc_validation_conditions(tmp_path):
+    """Streaming option/offset validation + CDC boundary classes."""
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.sql import sql
+    from delta_tpu.streaming import DeltaSource, DeltaSourceOffset
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({"id": pa.array([1, 2], pa.int64())}))
+    dta.write_table(p, pa.table({"id": pa.array([3], pa.int64())}),
+                    mode="append")
+    t = Table.for_path(p)
+
+    # option parsing
+    assert klass(lambda: DeltaSource.from_options(
+        t, {"startingVersion": "banana"})) == "DELTA_INVALID_SOURCE_VERSION"
+    assert klass(lambda: DeltaSource.from_options(
+        t, {"startingVersion": "1", "startingTimestamp": "1"})) \
+        == "DELTA_STARTING_VERSION_AND_TIMESTAMP_BOTH_SET"
+    assert klass(lambda: DeltaSource.from_options(
+        t, {"maxFilesPerTrigger": "0"})) == "DELTA_UNKNOWN_READ_LIMIT"
+    assert klass(lambda: DeltaSource.from_options(
+        t, {"ignoreDeletes": "maybe"})) == "DELTA_ILLEGAL_OPTION"
+    src, limits = DeltaSource.from_options(
+        t, {"startingVersion": "latest", "maxFilesPerTrigger": "7"})
+    assert limits.max_files == 7
+    assert src.latest_offset() is None  # nothing after "latest"
+
+    # startingTimestamp resolves to the first commit at/after it
+    ts1 = t.snapshot_at(1)  # noqa: F841 — materialize version 1
+    from delta_tpu.history import get_history
+
+    hist = {r.version: r.timestamp_ms for r in get_history(t)}
+    src2, _ = DeltaSource.from_options(
+        t, {"startingTimestamp": str(hist[1])})
+    off = src2.latest_offset()
+    batch = src2.get_batch(None, off)
+    assert sorted(batch.column("id").to_pylist()) == [3]  # v1 only
+
+    # offset wire-format validation
+    assert klass(lambda: DeltaSourceOffset.from_json("not json")) \
+        == "DELTA_INVALID_SOURCE_OFFSET_FORMAT"
+    assert klass(lambda: DeltaSourceOffset.from_json(
+        '{"sourceVersion": 99, "reservoirVersion": 1, "index": -1}')) \
+        == "DELTA_INVALID_SOURCE_VERSION"
+    rt = DeltaSourceOffset.from_json(
+        DeltaSourceOffset(1, -1, reservoir_id="abc").to_json())
+    assert rt.reservoir_id == "abc" and rt.reservoir_version == 1
+
+    # offset from a different table id is rejected
+    src3 = DeltaSource(t)
+    foreign = DeltaSourceOffset(0, -1, reservoir_id="some-other-table")
+    assert klass(lambda: src3.latest_offset(foreign)) \
+        == "DIFFERENT_DELTA_TABLE_READ_BY_STREAMING_SOURCE"
+
+    # CDC boundary validation
+    from delta_tpu.read.cdc import table_changes
+
+    sql(f"ALTER TABLE '{p}' SET TBLPROPERTIES "
+        f"('delta.enableChangeDataFeed' = 'true')")  # version 2
+    assert klass(lambda: table_changes(t)) == "DELTA_NO_START_FOR_CDC_READ"
+    assert klass(lambda: table_changes(
+        t, starting_version=0, starting_timestamp=1)) \
+        == "DELTA_MULTIPLE_CDC_BOUNDARY"
+    assert klass(lambda: table_changes(
+        t, starting_version=0, ending_version=1, ending_timestamp=2)) \
+        == "DELTA_MULTIPLE_CDC_BOUNDARY"
+    # the pre-enablement range never recorded change data
+    assert klass(lambda: table_changes(t, starting_version=0)) \
+        == "DELTA_MISSING_CHANGE_DATA"
+    # post-enablement range works, including timestamp boundaries
+    dta.write_table(p, pa.table({"id": pa.array([4], pa.int64())}),
+                    mode="append")  # version 3
+    changes = table_changes(t, starting_version=3)
+    assert changes.column("id").to_pylist() == [4]
+    hist = {r.version: r.timestamp_ms for r in get_history(t)}
+    by_ts = table_changes(t, starting_timestamp=hist[3])
+    assert by_ts.column("id").to_pylist() == [4]
+
+
+def test_round5_schema_conf_dv_validation_conditions(tmp_path):
+    """Batch C: property/coordinated-commits guards, nested ALTER
+    errors, partition validation, DV descriptor validation."""
+    import dataclasses
+
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({
+        "id": pa.array([1, 2], pa.int64()),
+        "s": pa.array([{"a": 1}, {"a": 2}],
+                      pa.struct([("a", pa.int64())]))}))
+    t = Table.for_path(p)
+
+    from delta_tpu.commands.alter import (
+        add_columns,
+        drop_column,
+        set_properties,
+        unset_properties,
+    )
+    from delta_tpu.models.schema import LONG, StructField
+
+    # unknown delta.* property / bad value / bad autoCompact value
+    assert klass(lambda: set_properties(
+        t, {"delta.checkpointIntervall": "10"})) \
+        == "DELTA_UNKNOWN_CONFIGURATION"
+    assert klass(lambda: set_properties(
+        t, {"delta.checkpointInterval": "many"})) \
+        == "DELTA_VIOLATE_TABLE_PROPERTY_VALIDATION_FAILED"
+    assert klass(lambda: set_properties(
+        t, {"delta.autoOptimize.autoCompact": "sometimes"})) \
+        == "DELTA_INVALID_AUTO_COMPACT_TYPE"
+
+    # coordinated-commits guards (non-CC table first)
+    from delta_tpu.coordinatedcommits.client import (
+        COORDINATOR_CONF_KEY,
+        COORDINATOR_NAME_KEY,
+        TABLE_CONF_KEY,
+    )
+
+    assert klass(lambda: set_properties(
+        t, {COORDINATOR_NAME_KEY: "x"})) \
+        == "DELTA_MUST_SET_ALL_COORDINATED_COMMITS_CONFS_IN_COMMAND"
+    assert klass(lambda: set_properties(
+        t, {COORDINATOR_NAME_KEY: "x", COORDINATOR_CONF_KEY: "{}",
+            TABLE_CONF_KEY: "{}"})) \
+        == "DELTA_CONF_OVERRIDE_NOT_SUPPORTED_IN_COMMAND"
+    assert klass(lambda: set_properties(
+        t, {COORDINATOR_NAME_KEY: "x", COORDINATOR_CONF_KEY: "{}",
+            "delta.enableInCommitTimestamps": "true"})) \
+        == "DELTA_CANNOT_SET_COORDINATED_COMMITS_DEPENDENCIES"
+    # now a CC table (simulated existing confs)
+    from delta_tpu.coordinatedcommits.client import (
+        validate_cc_alter_set,
+        validate_cc_alter_unset,
+    )
+
+    existing = {COORDINATOR_NAME_KEY: "c", COORDINATOR_CONF_KEY: "{}"}
+    assert klass(lambda: validate_cc_alter_set(
+        existing, {COORDINATOR_NAME_KEY: "other",
+                   COORDINATOR_CONF_KEY: "{}"})) \
+        == "DELTA_CANNOT_OVERRIDE_COORDINATED_COMMITS_CONFS"
+    assert klass(lambda: validate_cc_alter_set(
+        existing, {"delta.enableInCommitTimestamps": "false"})) \
+        == "DELTA_CANNOT_MODIFY_COORDINATED_COMMITS_DEPENDENCIES"
+    assert klass(lambda: validate_cc_alter_unset(
+        existing, [COORDINATOR_NAME_KEY])) \
+        == "DELTA_CANNOT_UNSET_COORDINATED_COMMITS_CONFS"
+    assert klass(lambda: validate_cc_alter_unset(
+        existing, ["delta.enableInCommitTimestamps"])) \
+        == "DELTA_CANNOT_MODIFY_COORDINATED_COMMITS_DEPENDENCIES"
+    # plain property set/unset still works
+    set_properties(t, {"delta.checkpointInterval": "20",
+                       "myapp.custom": "anything"})
+    unset_properties(t, ["myapp.custom"])
+
+    # nested ALTER errors + the working nested paths
+    assert klass(lambda: add_columns(
+        t, [StructField("nope.b", LONG)])) \
+        == "DELTA_ADD_COLUMN_STRUCT_NOT_FOUND"
+    assert klass(lambda: add_columns(
+        t, [StructField("id.b", LONG)])) \
+        == "DELTA_ADD_COLUMN_PARENT_NOT_STRUCT"
+    add_columns(t, [StructField("s.b", LONG)])
+    snap = t.latest_snapshot()
+    s_field = next(f for f in snap.schema.fields if f.name == "s")
+    assert [f.name for f in s_field.dataType.fields] == ["a", "b"]
+    assert klass(lambda: drop_column(t, "id.x")) \
+        == "DELTA_UNSUPPORTED_DROP_COLUMN"  # mapping off first
+    set_properties(t, {"delta.columnMapping.mode": "name"})
+    assert klass(lambda: drop_column(t, "id.x")) \
+        == "DELTA_UNSUPPORTED_DROP_NESTED_COLUMN_FROM_NON_STRUCT_TYPE"
+    drop_column(t, "s.b")
+    snap = t.latest_snapshot()
+    s_field = next(f for f in snap.schema.fields if f.name == "s")
+    assert [f.name for f in s_field.dataType.fields] == ["a"]
+
+    # partition validation at metadata update
+    assert klass(lambda: dta.write_table(
+        str(tmp_path / "allpart"),
+        pa.table({"a": [1], "b": [2]}), partition_by=["a", "b"])) \
+        == "DELTA_CANNOT_USE_ALL_COLUMNS_FOR_PARTITION"
+    assert klass(lambda: dta.write_table(
+        str(tmp_path / "badpart"),
+        pa.table({"a": [1], "s": pa.array(
+            [{"x": 1}], pa.struct([("x", pa.int64())]))}),
+        partition_by=["s"])) == "DELTA_INVALID_PARTITION_COLUMN_TYPE"
+
+    # DV descriptor out of sync with its bitmap
+    from delta_tpu.dv.descriptor import load_deletion_vector
+    from delta_tpu.dv.roaring import RoaringBitmapArray
+    import base64
+
+    import numpy as np
+
+    bm = RoaringBitmapArray(np.array([1, 5, 9], np.uint64))
+    blob = bm.serialize_delta()
+    inline = base64.b85encode(blob).decode()
+    good = {"storageType": "i", "pathOrInlineDv": inline,
+            "sizeInBytes": len(blob), "cardinality": 3}
+    assert list(load_deletion_vector(t.engine, p, good)) == [1, 5, 9]
+    assert klass(lambda: load_deletion_vector(
+        t.engine, p, {**good, "sizeInBytes": len(blob) + 1})) \
+        == "DELTA_DELETION_VECTOR_SIZE_MISMATCH"
+    assert klass(lambda: load_deletion_vector(
+        t.engine, p, {**good, "cardinality": 7})) \
+        == "DELTA_DELETION_VECTOR_CARDINALITY_MISMATCH"
+
+
+def test_round5_review_fix_regressions(tmp_path):
+    """Regressions for the round-5 review findings."""
+    import time as _time
+
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.sql import sql
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({"id": pa.array([1], pa.int64())}),
+                    properties={"delta.enableChangeDataFeed": "true"})
+    _time.sleep(0.05)
+    dta.write_table(p, pa.table({"id": pa.array([2], pa.int64())}),
+                    mode="append")
+    t = Table.for_path(p)
+
+    # CDC startingTimestamp is at-or-AFTER: a midpoint timestamp must
+    # exclude the earlier commit
+    from delta_tpu.history import get_history
+    from delta_tpu.read.cdc import table_changes
+
+    hist = {r.version: r.timestamp_ms for r in get_history(t)}
+    assert hist[1] > hist[0], "need distinct mtimes for the boundary"
+    mid = hist[0] + 1
+    ch = table_changes(t, starting_timestamp=mid)
+    assert ch.column("id").to_pylist() == [2]
+
+    # a trailing token named 'version' after a time-travel clause must
+    # produce a clean parse error, not an IndexError (the multi-format
+    # lookahead reads one token past the clause)
+    from delta_tpu.errors import SqlParseError
+
+    with pytest.raises(SqlParseError):
+        sql(f"SELECT id FROM '{p}' VERSION AS OF 0 version")
+
+    # inventory vacuum must NOT advance the LITE watermark
+    import json as _json
+    import os as _os
+
+    inv = pa.table({"path": ["x"], "length": [1], "isDir": [False],
+                    "modificationTime": [0]})
+    t.vacuum(retention_hours=0, inventory=inv)
+    info = _os.path.join(p, "_delta_log", "_last_vacuum_info")
+    assert not _os.path.exists(info)
+
+    # corrupted sourceVersion type -> offset-format error, not ValueError
+    from delta_tpu.streaming import DeltaSourceOffset
+
+    assert klass(lambda: DeltaSourceOffset.from_json(
+        '{"reservoirVersion": 1, "index": -1, "sourceVersion": "abc"}')) \
+        == "DELTA_INVALID_SOURCE_OFFSET_FORMAT"
+
+    # OPTIMIZE FULL + ZORDER BY is contradictory, not silently dropped
+    assert klass(lambda: sql(
+        f"OPTIMIZE '{p}' FULL ZORDER BY (id)")) \
+        == "DELTA_CLUSTERING_WITH_ZORDER_BY"
+
+    # every boolean property validates strictly at SET time
+    from delta_tpu.commands.alter import set_properties
+
+    assert klass(lambda: set_properties(
+        t, {"delta.appendOnly": "yess"})) \
+        == "DELTA_VIOLATE_TABLE_PROPERTY_VALIDATION_FAILED"
