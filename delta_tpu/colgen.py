@@ -165,3 +165,86 @@ def apply_column_generation(
             schema_changed = True
 
     return data, (StructType(new_schema_fields) if schema_changed else None)
+
+
+def validate_generated_schema(schema: StructType,
+                              partition_columns=()) -> None:
+    """Schema-level generation/identity invariants, checked when table
+    metadata is (re)committed (`IdentityColumn.scala` /
+    `GeneratedColumn.scala` declaration-time validations)."""
+    from delta_tpu.models.schema import INTEGER, LONG
+
+    names = {f.name for f in schema.fields}
+    pcols = set(partition_columns or ())
+    for f in schema.fields:
+        is_identity = IDENTITY_START_KEY in f.metadata \
+            or IDENTITY_STEP_KEY in f.metadata
+        gen_expr = f.metadata.get(GENERATION_EXPRESSION_KEY)
+        if is_identity and gen_expr is not None:
+            raise IdentityColumnError(
+                f"identity column {f.name} cannot also have a "
+                "generation expression",
+                error_class=(
+                    "DELTA_IDENTITY_COLUMNS_WITH_GENERATED_EXPRESSION"))
+        if is_identity and f.name in pcols:
+            raise IdentityColumnError(
+                f"identity column {f.name} cannot be a partition "
+                "column (PARTITIONED BY is not supported for identity "
+                "columns)",
+                error_class="DELTA_IDENTITY_COLUMNS_PARTITION_NOT_SUPPORTED")
+        if is_identity and f.dataType not in (LONG, INTEGER):
+            raise IdentityColumnError(
+                f"identity column {f.name} must be BIGINT or INT, got "
+                f"{f.dataType.to_json_value()}",
+                error_class="DELTA_IDENTITY_COLUMNS_UNSUPPORTED_DATA_TYPE")
+        if gen_expr is not None:
+            from delta_tpu.expressions.parser import parse_expression
+
+            try:
+                refs = {r[0] for r in
+                        parse_expression(gen_expr).references()}
+            except Exception:
+                refs = set()
+            generated = {
+                g.name for g in schema.fields
+                if GENERATION_EXPRESSION_KEY in g.metadata
+                or IDENTITY_START_KEY in g.metadata
+                or IDENTITY_STEP_KEY in g.metadata}
+            bad = sorted((refs - names) | (refs & generated))
+            if bad:
+                # missing columns AND other generated/identity columns
+                # are both invalid references (computation order over
+                # generated inputs is undefined)
+                raise InvariantViolationError(
+                    f"generation expression of {f.name} references "
+                    f"non-existent or generated column(s) {bad}",
+                    error_class="DELTA_INVALID_GENERATED_COLUMN_REFERENCES")
+
+
+def _ref_overlaps(ref: str, column: str) -> bool:
+    """A dotted reference depends on `column` when either is a prefix
+    path of the other: referencing `s.x` depends on both `s.x` and
+    `s`; referencing `s` depends on every field under `s`."""
+    return (ref == column or ref.startswith(column + ".")
+            or column.startswith(ref + "."))
+
+
+def generated_dependents(schema: StructType, column: str):
+    """Names of generated columns whose expression references
+    `column` — possibly a dotted nested path — (dependency guard for
+    DROP/RENAME COLUMN)."""
+    from delta_tpu.expressions.parser import parse_expression
+
+    out = []
+    for f in schema.fields:
+        expr = f.metadata.get(GENERATION_EXPRESSION_KEY)
+        if expr is None:
+            continue
+        try:
+            refs = {".".join(r) for r in
+                    parse_expression(expr).references()}
+        except Exception:
+            continue
+        if any(_ref_overlaps(r, column) for r in refs):
+            out.append(f.name)
+    return out

@@ -31,6 +31,37 @@ from delta_tpu.schema_evolution import can_widen
 from delta_tpu.txn.transaction import Operation
 
 
+def _check_dependent_columns(schema, configuration, column: str,
+                             what: str) -> None:
+    """A column referenced by a generated column's expression or a
+    CHECK constraint cannot be dropped/renamed
+    (`DeltaErrors.generatedColumnsDependentColumnChange` /
+    `.constraintDependentColumnChange`)."""
+    from delta_tpu.colgen import _ref_overlaps, generated_dependents
+    from delta_tpu.constraints import CONSTRAINT_PREFIX
+    from delta_tpu.expressions.parser import parse_expression
+
+    deps = generated_dependents(schema, column)
+    if deps:
+        raise SchemaEvolutionError(
+            f"cannot {what} column {column}: generated column(s) "
+            f"{deps} depend on it",
+            error_class="DELTA_GENERATED_COLUMNS_DEPENDENT_COLUMN_CHANGE")
+    for key, expr in (configuration or {}).items():
+        if not key.startswith(CONSTRAINT_PREFIX):
+            continue
+        try:
+            refs = {".".join(r)
+                    for r in parse_expression(expr).references()}
+        except Exception:
+            continue
+        if any(_ref_overlaps(r, column) for r in refs):
+            raise SchemaEvolutionError(
+                f"cannot {what} column {column}: CHECK constraint "
+                f"{key[len(CONSTRAINT_PREFIX):]!r} depends on it",
+                error_class="DELTA_CONSTRAINT_DEPENDENT_COLUMN_CHANGE")
+
+
 def _metadata_txn(table, operation: str):
     txn = table.create_transaction_builder(operation).build()
     if txn.read_snapshot is None:
@@ -133,6 +164,7 @@ def rename_column(table, old: str, new: str) -> int:
             error_class="DELTA_UNSUPPORTED_RENAME_COLUMN"
         )
     schema = schema_from_json(meta.schemaString)
+    _check_dependent_columns(schema, meta.configuration, old, "rename")
     new_schema = _rename_in_schema(schema, old, new)
     partition_cols = [
         new if c == old else c for c in meta.partitionColumns
@@ -161,6 +193,7 @@ def drop_column(table, name: str) -> int:
         raise SchemaEvolutionError(f"cannot drop partition column {name}",
                                    error_class="DELTA_UNSUPPORTED_DROP_PARTITION_COLUMN")
     schema = schema_from_json(meta.schemaString)
+    _check_dependent_columns(schema, meta.configuration, name, "drop")
     if "." in name:
         new_schema = _drop_nested_field(schema, name.split("."))
     else:

@@ -244,6 +244,21 @@ def update(
     meta = snapshot.metadata
     if meta.configuration.get("delta.appendOnly", "").lower() == "true":
         raise AppendOnlyTableError("cannot UPDATE an append-only table")
+    if meta.schema is not None:
+        from delta_tpu.colgen import IDENTITY_START_KEY, IDENTITY_STEP_KEY
+        from delta_tpu.errors import IdentityColumnError
+
+        identity_cols = {
+            f.name for f in meta.schema.fields
+            if IDENTITY_START_KEY in f.metadata
+            or IDENTITY_STEP_KEY in f.metadata}
+        hit = sorted(identity_cols & set(assignments))
+        if hit:
+            # `DeltaErrors.identityColumnUpdateNotSupported`: values
+            # are system-allocated; an UPDATE would break uniqueness
+            raise IdentityColumnError(
+                f"UPDATE on IDENTITY column(s) {hit} is not supported",
+                error_class="DELTA_IDENTITY_COLUMNS_UPDATE_NOT_SUPPORTED")
     use_cdc = cdf_enabled(meta.configuration)
     now_ms = int(time.time() * 1000)
     metrics = DMLMetrics()

@@ -673,13 +673,23 @@ def columnarize_log_segment(
     # --- checkpoint parts (columnar already) ---
     cp_version = segment.checkpoint_version
     for fstat in segment.checkpoints:
-        if fstat.path.endswith(".json"):
-            # V2 top-level checkpoint in JSON form
-            tbl = pa_json.read_json(pa.BufferReader(engine.fs.read_file(fstat.path)))
-            _consume_checkpoint_table(tbl)
-        else:
-            for tbl in _read_checkpoint_part(fstat.path):
+        try:
+            if fstat.path.endswith(".json"):
+                # V2 top-level checkpoint in JSON form
+                tbl = pa_json.read_json(pa.BufferReader(engine.fs.read_file(fstat.path)))
                 _consume_checkpoint_table(tbl)
+            else:
+                for tbl in _read_checkpoint_part(fstat.path):
+                    _consume_checkpoint_table(tbl)
+        except FileNotFoundError:
+            # selected as a complete checkpoint at LIST time, gone at
+            # read time (`DeltaErrors.missingPartFilesException`)
+            from delta_tpu.errors import LogCorruptedError
+
+            raise LogCorruptedError(
+                f"couldn't find all part files of the checkpoint at "
+                f"version {cp_version}: {fstat.path} is missing",
+                error_class="DELTA_MISSING_PART_FILES")
         bytes_parsed += fstat.size
 
     # --- compacted deltas + commits: parallel read, one JSON parse ---

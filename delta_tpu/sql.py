@@ -921,7 +921,9 @@ def _handle_merge_into(s: str, engine, catalog):
                 vals = [requalify(parse_expression(v.strip()))
                         for v in _split_top_level_commas(im.group("vals"))]
                 if len(cols) != len(vals):
-                    raise SqlParseError("INSERT column/value count mismatch")
+                    raise SqlParseError(
+                        "INSERT column/value count mismatch",
+                        error_class="DELTA_INSERT_COLUMN_ARITY_MISMATCH")
                 builder = builder.when_not_matched_insert(
                     values=dict(zip(cols, vals)), condition=cond)
         else:  # NOT MATCHED BY SOURCE

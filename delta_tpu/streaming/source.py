@@ -170,14 +170,41 @@ class _ExpiryGuard:
         # still existing.
         from delta_tpu.utils import filenames as fn
 
+        delta_versions = set()
         for fstat in segment.deltas:
-            if f"/{fn.COMMIT_SUBDIR}/" not in fstat.path:
-                continue
             try:
-                if fn.delta_version(fstat.path) == v:
-                    return
+                dv = fn.delta_version(fstat.path)
             except ValueError:
                 continue
+            if dv == v and f"/{fn.COMMIT_SUBDIR}/" in fstat.path:
+                return  # unbackfilled coordinated commit: wait
+            delta_versions.add(dv)
+        ckpt_v = getattr(segment, "checkpoint_version", None)
+        hole_certain = True
+        try:
+            # a cached snapshot may predate the covering checkpoint:
+            # the _last_checkpoint hint is the authoritative floor
+            hint = read_last_checkpoint(self.table.engine.fs,
+                                        self.table.log_path)
+            if hint is not None:
+                ckpt_v = max(ckpt_v if ckpt_v is not None else -1,
+                             hint.version)
+        except Exception:
+            # can't read the hint: a covering checkpoint may exist, so
+            # do not escalate to the non-retryable corruption verdict
+            hole_certain = False
+        if hole_certain and (ckpt_v is None or v > ckpt_v) \
+                and delta_versions \
+                and min(delta_versions) < v < max(delta_versions):
+            # a MID-RANGE hole past any checkpoint (commits exist on
+            # both sides of v and no checkpoint covers it) is not
+            # expiry — the log itself is broken
+            # (`DeltaErrors.deltaVersionsNotContiguousException`)
+            raise StreamingSourceError(
+                error_class="DELTA_VERSIONS_NOT_CONTIGUOUS",
+                message=f"versions ({sorted(delta_versions)[:5]}...) "
+                f"are not contiguous: commit {v} is missing between "
+                "existing commits")
         raise StreamingSourceError(
             error_class="DELTA_LOG_FILE_NOT_FOUND_FOR_STREAMING_SOURCE",
             message=f"commit {v} required by this {self._what} no longer exists "

@@ -327,6 +327,16 @@ class Transaction:
         _check_column_name_characters(metadata)
         # partition columns must name schema fields and be unique
         # (`DeltaErrors.partitionColumnNotFoundException` semantics)
+        if metadata.schema is not None and not metadata.schema.fields:
+            # `DeltaErrors.emptyDataException`
+            raise InvalidArgumentError(
+                "Data used in creating the Delta table doesn't have "
+                "any columns.", error_class="DELTA_EMPTY_DATA")
+        if metadata.schema is not None:
+            from delta_tpu.colgen import validate_generated_schema
+
+            validate_generated_schema(metadata.schema,
+                                      metadata.partitionColumns)
         pcols = list(metadata.partitionColumns or [])
         if pcols:
             schema = metadata.schema
@@ -683,12 +693,43 @@ class Transaction:
                         self._winners_row_watermark or -1,
                         rebase["row_id_high_watermark"],
                     )
+                ict_on = self.read_snapshot is not None and \
+                    get_table_config(
+                        self.read_snapshot.metadata.configuration,
+                        IN_COMMIT_TIMESTAMPS)
                 for w in winners:
+                    # a winner may toggle ICT itself: its Metadata
+                    # governs whether IT and later winners must carry
+                    # an inCommitTimestamp
+                    wmeta = next(
+                        (a for a in w.actions if isinstance(a, Metadata)),
+                        None)
+                    if wmeta is not None:
+                        ict_on = get_table_config(
+                            wmeta.configuration, IN_COMMIT_TIMESTAMPS)
                     ci = next(
                         (a for a in w.actions if isinstance(a, CommitInfo)), None
                     )
                     if ci is not None and ci.inCommitTimestamp is not None:
                         winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
+                    elif ict_on:
+                        # `CommitInfo.getRequiredInCommitTimestamp`:
+                        # on an ICT table every commit must carry its
+                        # timestamp — a winner without one corrupts
+                        # the monotonic clock this rebase maintains
+                        from delta_tpu.errors import LogCorruptedError
+
+                        _report(None, False)
+                        if ci is None:
+                            raise LogCorruptedError(
+                                f"commit {w.version} has no commitInfo "
+                                "but in-commit timestamps are enabled",
+                                error_class="DELTA_MISSING_COMMIT_INFO")
+                        raise LogCorruptedError(
+                            f"commitInfo of commit {w.version} has no "
+                            "inCommitTimestamp but in-commit "
+                            "timestamps are enabled",
+                            error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
                 attempt_version = latest + 1
                 continue
             self._committed = True

@@ -724,3 +724,190 @@ def test_round5_review_fix_regressions(tmp_path):
     assert klass(lambda: set_properties(
         t, {"delta.appendOnly": "yess"})) \
         == "DELTA_VIOLATE_TABLE_PROPERTY_VALIDATION_FAILED"
+
+
+def test_round5_colgen_write_log_validation_conditions(tmp_path):
+    """Batch D: identity/generated declaration + dependency guards,
+    empty data, INSERT mismatch, log-integrity classes."""
+    import os as _os
+
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.colgen import generated_field, identity_field
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.models.schema import (
+        LONG,
+        STRING,
+        StructField,
+        StructType,
+        schema_to_json,
+    )
+    from delta_tpu.sql import sql
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    def create(schema_fields, path, partition_by=None):
+        t = Table.for_path(str(tmp_path / path))
+        b = t.create_transaction_builder("CREATE TABLE") \
+            .with_schema(schema_to_json(StructType(schema_fields)))
+        if partition_by:
+            b = b.with_partition_columns(partition_by)
+        return b.build()
+
+    # identity declaration invariants
+    ident = identity_field("id")
+    both = StructField("id", LONG, metadata={
+        "delta.identity.start": 1, "delta.identity.step": 1,
+        "delta.generationExpression": "x"})
+    assert klass(lambda: create([both, StructField("x", LONG)], "t1")) \
+        == "DELTA_IDENTITY_COLUMNS_WITH_GENERATED_EXPRESSION"
+    assert klass(lambda: create([ident, StructField("x", LONG)], "t2",
+                                partition_by=["id"])) \
+        == "DELTA_IDENTITY_COLUMNS_PARTITION_NOT_SUPPORTED"
+    bad_type = StructField("id", STRING, metadata={
+        "delta.identity.start": 1, "delta.identity.step": 1})
+    assert klass(lambda: create([bad_type, StructField("x", LONG)],
+                                "t3")) \
+        == "DELTA_IDENTITY_COLUMNS_UNSUPPORTED_DATA_TYPE"
+    gen_bad = generated_field("g", LONG, "missing_col")
+    assert klass(lambda: create([StructField("x", LONG), gen_bad],
+                                "t4")) \
+        == "DELTA_INVALID_GENERATED_COLUMN_REFERENCES"
+    assert klass(lambda: create([], "t5")) == "DELTA_EMPTY_DATA"
+
+    # UPDATE of an identity column
+    p = str(tmp_path / "ident")
+    t = Table.for_path(p)
+    t.create_transaction_builder("CREATE TABLE").with_schema(
+        schema_to_json(StructType([ident, StructField("x", LONG)]))
+    ).build().commit()
+    dta.write_table(p, pa.table({"x": pa.array([1, 2], pa.int64())}),
+                    mode="append")
+    from delta_tpu.commands.dml import update
+    from delta_tpu.expressions import col, lit
+
+    assert klass(lambda: update(t, {"id": lit(99)}, col("x") > lit(0))) \
+        == "DELTA_IDENTITY_COLUMNS_UPDATE_NOT_SUPPORTED"
+
+    # dependent-column guards (generated + constraint)
+    p2 = str(tmp_path / "dep")
+    t2 = Table.for_path(p2)
+    t2.create_transaction_builder("CREATE TABLE").with_schema(
+        schema_to_json(StructType([
+            StructField("base", LONG),
+            StructField("other", LONG),
+            generated_field("twice", LONG, "base")]))
+    ).build().commit()
+    from delta_tpu.commands.alter import rename_column, set_properties
+
+    set_properties(t2, {"delta.columnMapping.mode": "name"})
+    from delta_tpu.commands.alter import drop_column
+    from delta_tpu.constraints import add_constraint
+
+    assert klass(lambda: drop_column(t2, "base")) \
+        == "DELTA_GENERATED_COLUMNS_DEPENDENT_COLUMN_CHANGE"
+    assert klass(lambda: rename_column(t2, "base", "b2")) \
+        == "DELTA_GENERATED_COLUMNS_DEPENDENT_COLUMN_CHANGE"
+    add_constraint(t2, "pos", "other > 0")
+    assert klass(lambda: drop_column(t2, "other")) \
+        == "DELTA_CONSTRAINT_DEPENDENT_COLUMN_CHANGE"
+
+    # MERGE INSERT column/value count mismatch shares the arity class
+    p3 = str(tmp_path / "ins")
+    dta.write_table(p3, pa.table({"a": pa.array([1], pa.int64())}))
+    assert klass(lambda: sql(
+        f"MERGE INTO '{p3}' AS t USING '{p3}' AS s ON t.a = s.a "
+        "WHEN NOT MATCHED THEN INSERT (a) VALUES (s.a, 1)")) \
+        == "DELTA_INSERT_COLUMN_ARITY_MISMATCH"
+
+    # mid-range log hole past the checkpoint -> not contiguous
+    p4 = str(tmp_path / "gap")
+    for i in range(4):
+        dta.write_table(p4, pa.table({"a": pa.array([i], pa.int64())}),
+                        mode="error" if i == 0 else "append")
+    t4 = Table.for_path(p4)
+    from delta_tpu.streaming import DeltaSource
+
+    _os.unlink(_os.path.join(p4, "_delta_log", f"{2:020d}.json"))
+    # a FRESH listing detects the hole at segment build
+    assert klass(lambda: Table.for_path(p4).latest_snapshot()) \
+        == "DELTA_TRUNCATED_TRANSACTION_LOG"
+    # the streaming guard sees the hole only through a CACHED listing
+    # (the segment still brackets the vanished commit); it must
+    # classify it as non-contiguous, not as expiry
+    from delta_tpu.streaming.source import _ExpiryGuard
+
+    class _StubSeg:
+        version = 3
+        checkpoint_version = None
+        deltas = [type("F", (), {"path": _os.path.join(
+            p4, "_delta_log", f"{v:020d}.json")})() for v in (1, 2, 3)]
+
+    class _StubSnap:
+        log_segment = _StubSeg()
+
+    class _StubTable:
+        engine = t4.engine
+        log_path = t4.log_path
+
+        def latest_snapshot(self):
+            return _StubSnap()
+
+    guard = _ExpiryGuard(_StubTable(), "stream")
+    assert klass(lambda: guard.check(2)) \
+        == "DELTA_VERSIONS_NOT_CONTIGUOUS"
+
+
+def test_round5_dependency_guard_review_fixes(tmp_path):
+    """Nested-path dependency guards + generated-referencing-generated
+    rejection (review findings)."""
+    import pyarrow as pa
+    import pytest
+
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.models.schema import (
+        LONG,
+        StructField,
+        StructType,
+        schema_to_json,
+    )
+    from delta_tpu.table import Table
+    from delta_tpu.colgen import generated_field
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    # generated column referencing another generated column
+    t0 = Table.for_path(str(tmp_path / "gg"))
+    b = t0.create_transaction_builder("CREATE TABLE").with_schema(
+        schema_to_json(StructType([
+            StructField("x", LONG),
+            generated_field("g1", LONG, "x"),
+            generated_field("g2", LONG, "g1")])))
+    assert klass(lambda: b.build().commit()) \
+        == "DELTA_INVALID_GENERATED_COLUMN_REFERENCES"
+
+    # generated column referencing a NESTED field blocks dropping it
+    p = str(tmp_path / "nested")
+    t = Table.for_path(p)
+    inner = StructType([StructField("x", LONG), StructField("y", LONG)])
+    t.create_transaction_builder("CREATE TABLE").with_schema(
+        schema_to_json(StructType([
+            StructField("s", inner),
+            generated_field("g", LONG, "s.x")]))).build().commit()
+    from delta_tpu.commands.alter import drop_column, set_properties
+
+    set_properties(t, {"delta.columnMapping.mode": "name"})
+    assert klass(lambda: drop_column(t, "s.x")) \
+        == "DELTA_GENERATED_COLUMNS_DEPENDENT_COLUMN_CHANGE"
+    assert klass(lambda: drop_column(t, "s")) \
+        == "DELTA_GENERATED_COLUMNS_DEPENDENT_COLUMN_CHANGE"
+    drop_column(t, "s.y")  # un-referenced sibling drops fine
