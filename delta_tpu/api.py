@@ -32,6 +32,8 @@ def write_table(
     merge_schema: bool = False,
     overwrite_schema: bool = False,
     replace_where=None,
+    partition_overwrite_mode: Optional[str] = None,
+    data_change: bool = True,
 ) -> int:
     """Write an Arrow table as a Delta commit. Returns the commit version.
 
@@ -43,6 +45,13 @@ def write_table(
     matching it are replaced (matching rows are deleted exactly as
     DELETE would, then the new data is appended; every incoming row must
     satisfy the predicate — reference `replaceWhere` semantics).
+    partition_overwrite_mode: with mode='overwrite', 'dynamic' replaces
+    only the partitions present in the incoming data
+    (`partitionOverwriteMode` option; 'static'/None replaces the whole
+    table).
+    data_change: False marks the written files as a rearrangement
+    (OPTIMIZE-like): streams skip them and the commit must not change
+    data or metadata (`dataChange` option).
     """
     table = Table.for_path(path, engine)
     exists = table.exists()
@@ -60,6 +69,41 @@ def write_table(
         raise InvalidArgumentError(
             "overwrite_schema cannot be combined with replace_where",
             error_class="DELTA_ILLEGAL_USAGE")
+    if partition_overwrite_mode is not None and \
+            partition_overwrite_mode.lower() not in ("static", "dynamic"):
+        raise InvalidArgumentError(
+            f"Invalid value '{partition_overwrite_mode}' for option "
+            "'partitionOverwriteMode': expected 'static' or 'dynamic'",
+            error_class="DELTA_ILLEGAL_OPTION")
+    dynamic_overwrite = (partition_overwrite_mode or "").lower() == "dynamic"
+    if dynamic_overwrite and replace_where is not None:
+        # `DeltaErrors.replaceWhereUsedWithDynamicPartitionOverwrite`
+        raise InvalidArgumentError(
+            "A 'replaceWhere' expression and "
+            "'partitionOverwriteMode'='dynamic' cannot both be set",
+            error_class="DELTA_REPLACE_WHERE_WITH_DYNAMIC_PARTITION_OVERWRITE")
+    if dynamic_overwrite and overwrite_schema:
+        # `DeltaErrors.overwriteSchemaUsedWithDynamicPartitionOverwrite`
+        raise InvalidArgumentError(
+            "'overwriteSchema' cannot be used in dynamic partition "
+            "overwrite mode",
+            error_class=(
+                "DELTA_OVERWRITE_SCHEMA_WITH_DYNAMIC_PARTITION_OVERWRITE"))
+    if not data_change:
+        if replace_where is not None:
+            # `DeltaErrors.replaceWhereWithFilterDataChangeUnset`
+            raise InvalidArgumentError(
+                "'replaceWhere' cannot be used with data filters when "
+                "'dataChange' is set to false",
+                error_class=(
+                    "DELTA_REPLACE_WHERE_WITH_FILTER_DATA_CHANGE_UNSET"))
+        if not exists or overwrite_schema or merge_schema:
+            # `DeltaErrors.unexpectedDataChangeException`: a
+            # rearrangement must not create tables or change metadata
+            raise InvalidArgumentError(
+                "Cannot change table metadata because the 'dataChange' "
+                "option is set to false. Attempted operation: "
+                f"'{mode}'", error_class="DELTA_DATA_CHANGE_FALSE")
 
     builder = table.create_transaction_builder(
         Operation.WRITE if exists else Operation.CREATE_TABLE
@@ -170,9 +214,33 @@ def write_table(
             rw_metrics = DMLMetrics()
             delete_matching_rows(txn, table, txn.read_snapshot,
                                  replace_where, rw_metrics)
+        elif dynamic_overwrite:
+            # replace only the partitions present in the incoming data
+            # (`DeltaDataSource` partitionOverwriteMode=dynamic; the
+            # reference computes the touched partitions from the
+            # written files and removes just those)
+            from delta_tpu.columnmapping import logical_to_physical_names
+            from delta_tpu.stats.partition import serialize_partition_value
+
+            phys = logical_to_physical_names(schema)
+            touched = set()
+            present = [c for c in partition_columns
+                       if c in data.column_names]
+            for row in data.select(present).to_pylist():
+                touched.add(tuple(
+                    serialize_partition_value(row.get(c))
+                    for c in present))
+            for f in txn.scan_files():
+                pv = f.partitionValues or {}
+                # stored partitionValues use physical names
+                key = tuple(pv.get(phys.get(c, c)) for c in present)
+                if key in touched:
+                    txn.remove_file(f.remove(deletion_timestamp=_now_ms(),
+                                             data_change=data_change))
         else:
             for f in txn.scan_files():
-                txn.remove_file(f.remove(deletion_timestamp=_now_ms()))
+                txn.remove_file(f.remove(deletion_timestamp=_now_ms(),
+                                         data_change=data_change))
 
     adds = write_data_files(
         engine=table.engine,
@@ -182,6 +250,7 @@ def write_table(
         partition_columns=partition_columns,
         configuration=meta.configuration,
         target_rows_per_file=target_rows_per_file,
+        data_change=data_change,
     )
     txn.add_files(adds)
     if replace_where is not None:

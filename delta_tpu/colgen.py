@@ -203,8 +203,16 @@ def validate_generated_schema(schema: StructType,
             try:
                 refs = {r[0] for r in
                         parse_expression(gen_expr).references()}
-            except Exception:
-                refs = set()
+            except Exception as e:
+                # an unparseable expression must fail at DECLARATION,
+                # not on the first write
+                # (`DeltaErrors.unsupportedExpression` for generated
+                # columns)
+                raise InvariantViolationError(
+                    f"generation expression of {f.name} cannot be "
+                    f"parsed: {gen_expr!r} ({e})",
+                    error_class=(
+                        "DELTA_UNSUPPORTED_EXPRESSION_GENERATED_COLUMN"))
             generated = {
                 g.name for g in schema.fields
                 if GENERATION_EXPRESSION_KEY in g.metadata

@@ -300,6 +300,12 @@ def _execute_merge(
     # explicit assignments targeting unknown columns. Without
     # with_schema_evolution() both are errors (never silent drops).
     target_by_lower = {f.name.lower() for f in schema.fields}
+    from delta_tpu.colgen import IDENTITY_START_KEY, IDENTITY_STEP_KEY
+
+    identity_lower = {
+        f.name.lower() for f in schema.fields
+        if IDENTITY_START_KEY in f.metadata
+        or IDENTITY_STEP_KEY in f.metadata}
     # duplicate assignments (incl. case-only collisions) are an analysis
     # error regardless of whether any row reaches the clause
     for c in (matched + not_matched + not_matched_by_source):
@@ -313,6 +319,17 @@ def _execute_merge(
                     error_class="DELTA_DUPLICATE_COLUMNS_ON_UPDATE_TABLE"
                 )
             seen.add(k.lower())
+            # UPDATE clauses must not touch identity columns (same
+            # rule as dml.update — values are system-allocated);
+            # INSERT clauses may, when allowExplicitInsert is set
+            # (enforced downstream by apply_column_generation)
+            if c.kind == "update" and k.lower() in identity_lower:
+                from delta_tpu.errors import IdentityColumnError
+
+                raise IdentityColumnError(
+                    f"UPDATE on IDENTITY column {k} is not supported "
+                    "in MERGE",
+                    error_class="DELTA_IDENTITY_COLUMNS_UPDATE_NOT_SUPPORTED")
     extra_cols = [c for c in source.column_names
                   if c.lower() not in target_by_lower]
     has_star = any(c.assignments is None and c.kind != "delete"

@@ -33,6 +33,7 @@ class PersistedMetadata:
     partition_columns: list
     configuration: dict
     seq_num: int = 0
+    table_id: str = None
 
     def to_json(self) -> str:
         return json.dumps(
@@ -41,20 +42,31 @@ class PersistedMetadata:
                 "schemaString": self.schema_string,
                 "partitionColumns": self.partition_columns,
                 "configuration": self.configuration,
+                "tableId": self.table_id,
             },
             sort_keys=True,
         )
 
     @staticmethod
     def from_json(s: str, seq_num: int) -> "PersistedMetadata":
-        d = json.loads(s)
-        return PersistedMetadata(
-            delta_commit_version=d["deltaCommitVersion"],
-            schema_string=d["schemaString"],
-            partition_columns=d.get("partitionColumns", []),
-            configuration=d.get("configuration", {}),
-            seq_num=seq_num,
-        )
+        try:
+            d = json.loads(s)
+            return PersistedMetadata(
+                delta_commit_version=d["deltaCommitVersion"],
+                schema_string=d["schemaString"],
+                partition_columns=d.get("partitionColumns", []),
+                configuration=d.get("configuration", {}),
+                seq_num=seq_num,
+                table_id=d.get("tableId"),
+            )
+        except (ValueError, TypeError, KeyError) as e:
+            from delta_tpu.errors import StreamingSourceError
+
+            # `DeltaErrors.failToDeserializeSchemaLog`
+            raise StreamingSourceError(
+                f"incomplete/corrupt schema log entry {seq_num} ({e}); "
+                "pick a new schemaTrackingLocation to restart",
+                error_class="DELTA_STREAMING_SCHEMA_LOG_DESERIALIZE_FAILED")
 
 
 class SchemaTrackingLog:
@@ -64,6 +76,7 @@ class SchemaTrackingLog:
 
     def __init__(self, engine, location: str, table_id: str):
         self._engine = engine
+        self._table_id = table_id
         self._dir = f"{location.rstrip('/')}/_schema_log_{table_id}"
 
     def _entry_path(self, seq: int) -> str:
@@ -86,9 +99,22 @@ class SchemaTrackingLog:
                 seq = int(name[:-5])
             except ValueError:
                 continue
-            out.append(
-                PersistedMetadata.from_json(
-                    fs.read_file(st.path).decode("utf-8"), seq))
+            entry = PersistedMetadata.from_json(
+                fs.read_file(st.path).decode("utf-8"), seq)
+            if entry.table_id is not None and \
+                    entry.table_id != self._table_id:
+                from delta_tpu.errors import StreamingSourceError
+
+                # `DeltaErrors.incompatibleSchemaLogDeltaTable`: a
+                # schema log reused across tables would replay the
+                # wrong schema history
+                raise StreamingSourceError(
+                    f"schema log entry {seq} was persisted for table "
+                    f"id {entry.table_id!r}, expected "
+                    f"{self._table_id!r}",
+                    error_class=(
+                        "DELTA_STREAMING_SCHEMA_LOG_INCOMPATIBLE_DELTA_TABLE_ID"))
+            out.append(entry)
         return out
 
     def latest(self) -> Optional[PersistedMetadata]:
@@ -103,6 +129,20 @@ class SchemaTrackingLog:
         cur = self.latest()
         seq = 0 if cur is None else cur.seq_num + 1
         entry.seq_num = seq
+        if entry.table_id is None:
+            entry.table_id = self._table_id
+        if cur is not None and \
+                list(cur.partition_columns) != list(entry.partition_columns):
+            from delta_tpu.errors import StreamingSourceError
+
+            # `DeltaErrors.incompatibleSchemaLogPartitionSchema`:
+            # a partitioning change invalidates every outstanding
+            # offset's file-index interpretation
+            raise StreamingSourceError(
+                f"incompatible partition schema change in stream: "
+                f"{cur.partition_columns} -> {entry.partition_columns}",
+                error_class=(
+                    "DELTA_STREAMING_SCHEMA_LOG_INCOMPATIBLE_PARTITION_SCHEMA"))
         path = self._entry_path(seq)
         store = logstore_for_path(path)
         store.mkdirs(self._dir)

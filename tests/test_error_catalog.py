@@ -911,3 +911,178 @@ def test_round5_dependency_guard_review_fixes(tmp_path):
     assert klass(lambda: drop_column(t, "s")) \
         == "DELTA_GENERATED_COLUMNS_DEPENDENT_COLUMN_CHANGE"
     drop_column(t, "s.y")  # un-referenced sibling drops fine
+
+
+def test_round5_dynamic_overwrite_and_schema_log(tmp_path):
+    """Batch E: dynamic partition overwrite (feature + guards),
+    dataChange=false discipline, schema-log integrity classes."""
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.expressions import col, lit
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    p = str(tmp_path / "t")
+    dta.write_table(p, pa.table({
+        "id": pa.array([1, 2, 3, 4], pa.int64()),
+        "part": pa.array(["a", "a", "b", "b"])}),
+        partition_by=["part"])
+
+    # dynamic overwrite replaces ONLY the partitions in the new data
+    dta.write_table(p, pa.table({
+        "id": pa.array([10], pa.int64()),
+        "part": pa.array(["a"])}),
+        mode="overwrite", partition_overwrite_mode="dynamic")
+    out = dta.read_table(p).sort_by("id")
+    assert out.column("id").to_pylist() == [3, 4, 10]
+    assert sorted(set(out.column("part").to_pylist())) == ["a", "b"]
+
+    # option conflicts
+    assert klass(lambda: dta.write_table(
+        p, pa.table({"id": pa.array([1], pa.int64()),
+                     "part": pa.array(["a"])}),
+        mode="overwrite", partition_overwrite_mode="dynamic",
+        replace_where=col("part") == lit("a"))) \
+        == "DELTA_REPLACE_WHERE_WITH_DYNAMIC_PARTITION_OVERWRITE"
+    assert klass(lambda: dta.write_table(
+        p, pa.table({"id": pa.array([1], pa.int64()),
+                     "part": pa.array(["a"])}),
+        mode="overwrite", partition_overwrite_mode="dynamic",
+        overwrite_schema=True)) \
+        == "DELTA_OVERWRITE_SCHEMA_WITH_DYNAMIC_PARTITION_OVERWRITE"
+    assert klass(lambda: dta.write_table(
+        p, pa.table({"id": pa.array([1], pa.int64()),
+                     "part": pa.array(["a"])}),
+        mode="overwrite", data_change=False,
+        replace_where=col("part") == lit("a"))) \
+        == "DELTA_REPLACE_WHERE_WITH_FILTER_DATA_CHANGE_UNSET"
+    assert klass(lambda: dta.write_table(
+        str(tmp_path / "new"), pa.table({"id": pa.array([1], pa.int64())}),
+        data_change=False)) == "DELTA_DATA_CHANGE_FALSE"
+    assert klass(lambda: dta.write_table(
+        p, pa.table({"id": pa.array([1], pa.int64()),
+                     "part": pa.array(["a"])}),
+        mode="overwrite", partition_overwrite_mode="sideways")) \
+        == "DELTA_ILLEGAL_OPTION"
+
+    # dataChange=false writes rearrangement adds streams must skip
+    v = dta.write_table(p, pa.table({
+        "id": pa.array([99], pa.int64()),
+        "part": pa.array(["c"])}), mode="append", data_change=False)
+    from delta_tpu.models.actions import (
+        AddFile,
+        actions_from_commit_bytes,
+    )
+    from delta_tpu.utils import filenames
+
+    t = Table.for_path(p)
+    acts = actions_from_commit_bytes(t.engine.fs.read_file(
+        filenames.delta_file(t.log_path, v)))
+    adds = [a for a in acts if isinstance(a, AddFile)]
+    assert adds and all(not a.dataChange for a in adds)
+
+    # schema-log integrity
+    from delta_tpu.streaming.schema_log import (
+        PersistedMetadata,
+        SchemaTrackingLog,
+    )
+
+    loc = str(tmp_path / "ckpt")
+    log = SchemaTrackingLog(t.engine, loc, "table-A")
+    log.append(PersistedMetadata(0, "{}", ["part"], {}))
+    # partition schema change is rejected
+    assert klass(lambda: log.append(
+        PersistedMetadata(1, "{}", ["other"], {}))) \
+        == "DELTA_STREAMING_SCHEMA_LOG_INCOMPATIBLE_PARTITION_SCHEMA"
+    # wrong table id in a persisted entry
+    log2 = SchemaTrackingLog(t.engine, loc, "table-A")
+    import os as _os
+
+    evil = _os.path.join(loc, "_schema_log_table-A",
+                         f"{1:020d}.json")
+    with open(evil, "w") as f:
+        f.write(PersistedMetadata(1, "{}", ["part"], {},
+                                  table_id="table-B").to_json())
+    assert klass(lambda: log2.entries()) \
+        == "DELTA_STREAMING_SCHEMA_LOG_INCOMPATIBLE_DELTA_TABLE_ID"
+    # corrupt entry
+    with open(evil, "w") as f:
+        f.write("{not json")
+    assert klass(lambda: log2.entries()) \
+        == "DELTA_STREAMING_SCHEMA_LOG_DESERIALIZE_FAILED"
+
+
+def test_round5_batch_e_review_fixes(tmp_path):
+    """Review regressions: consistent dataChange on overwrite removes,
+    MERGE identity guard, unparseable generation expressions."""
+    import pyarrow as pa
+    import pytest
+
+    import delta_tpu.api as dta
+    from delta_tpu.errors import DeltaError, error_info
+    from delta_tpu.models.schema import (
+        LONG,
+        StructField,
+        StructType,
+        schema_to_json,
+    )
+    from delta_tpu.table import Table
+
+    def klass(fn):
+        with pytest.raises(DeltaError) as ei:
+            fn()
+        return error_info(ei.value)["errorClass"]
+
+    # rearrangement overwrite: BOTH adds and removes carry
+    # dataChange=false
+    p = str(tmp_path / "re")
+    dta.write_table(p, pa.table({"id": pa.array([1, 2], pa.int64())}))
+    v = dta.write_table(p, pa.table({"id": pa.array([1, 2], pa.int64())}),
+                        mode="overwrite", data_change=False)
+    from delta_tpu.models.actions import (
+        AddFile,
+        RemoveFile,
+        actions_from_commit_bytes,
+    )
+    from delta_tpu.utils import filenames
+
+    t = Table.for_path(p)
+    acts = actions_from_commit_bytes(
+        t.engine.fs.read_file(filenames.delta_file(t.log_path, v)))
+    assert all(not a.dataChange for a in acts
+               if isinstance(a, (AddFile, RemoveFile)))
+
+    # MERGE update of an identity column is rejected at analysis
+    from delta_tpu.colgen import identity_field
+    from delta_tpu.expressions import col, lit
+
+    p2 = str(tmp_path / "ident")
+    t2 = Table.for_path(p2)
+    t2.create_transaction_builder("CREATE TABLE").with_schema(
+        schema_to_json(StructType([identity_field("id"),
+                                   StructField("x", LONG)]))
+    ).build().commit()
+    dta.write_table(p2, pa.table({"x": pa.array([1], pa.int64())}),
+                    mode="append")
+    from delta_tpu.commands.merge import merge
+
+    src = pa.table({"x": pa.array([1], pa.int64())})
+    assert klass(lambda: merge(t2, src, on=col("target.x") == col("source.x"))
+                 .when_matched_update(set={"id": lit(0)}).execute()) \
+        == "DELTA_IDENTITY_COLUMNS_UPDATE_NOT_SUPPORTED"
+
+    # unparseable generation expression fails at declaration
+    bad = StructField("g", LONG, metadata={
+        "delta.generationExpression": "1 +"})
+    t3 = Table.for_path(str(tmp_path / "badgen"))
+    b = t3.create_transaction_builder("CREATE TABLE").with_schema(
+        schema_to_json(StructType([StructField("x", LONG), bad])))
+    assert klass(lambda: b.build().commit()) \
+        == "DELTA_UNSUPPORTED_EXPRESSION_GENERATED_COLUMN"
