@@ -84,11 +84,19 @@ class Catalog:
             store.write(entry, payload, overwrite=False)
         except FileExistsError:
             if if_not_exists:
-                return self.table(name)
+                existing = self.table(name)
+                _check_create_spec_matches(existing, partition_by,
+                                           properties, cluster_by)
+                return existing
             raise TableAlreadyExistsError(f"table {name} already exists",
                                           error_class="DELTA_TABLE_ALREADY_EXISTS")
 
         table = Table.for_path(loc, self.engine)
+        if table.exists():
+            # registering a name over an existing table at LOCATION:
+            # a divergent spec must not be silently ignored
+            _check_create_spec_matches(table, partition_by, properties,
+                                       cluster_by)
         if schema is not None and not table.exists():
             import os
 
