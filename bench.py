@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -43,6 +44,7 @@ import time
 import numpy as np
 
 FILES_PER_COMMIT = 100
+INCREMENTAL_COMMITS = 100  # appended for the update() metric
 
 
 # --------------------------------------------------------------- synth log
@@ -108,7 +110,57 @@ def ensure_log(workdir: str, commits: int) -> str:
         synth_delta_log(path, commits, FILES_PER_COMMIT)
         print(f"  generated in {time.perf_counter() - t0:.0f}s",
               file=sys.stderr)
+    # the incremental phase appends commits >= `commits` and removes them
+    # when done; a crashed prior run may have left strays in the cached
+    # log, which would skew every later measurement
+    log = os.path.join(path, "_delta_log")
+    for name in os.listdir(log):
+        m = re.match(r"^(\d{20})\.json$", name)
+        if m and int(m.group(1)) >= commits:
+            print(f"  removing stale appended commit {name}",
+                  file=sys.stderr)
+            os.remove(os.path.join(log, name))
     return path
+
+
+def append_commits(path: str, start_version: int, k: int):
+    """Append `k` synthetic commits continuing the history at
+    `start_version` — the workload behind the incremental update()
+    metric. Same shape as synth_delta_log commits (adds + removes of
+    files added by EARLIER appended commits, so replay does real
+    last-wins work). Returns (written_paths, n_actions)."""
+    rng = np.random.default_rng(start_version)
+    log = os.path.join(path, "_delta_log")
+    alive: list = []
+    written = []
+    n_actions = 0
+    fid = 0
+    n_rm = int(FILES_PER_COMMIT * 0.2)
+    for i in range(k):
+        v = start_version + i
+        lines = []
+        if alive and n_rm:
+            for _ in range(min(n_rm, len(alive))):
+                p = alive.pop(int(rng.integers(0, len(alive))))
+                lines.append(
+                    f'{{"remove":{{"path":"{p}","deletionTimestamp":{v},'
+                    f'"dataChange":true}}}}'
+                )
+        for _ in range(FILES_PER_COMMIT - n_rm):
+            p = f"inc-{v:010d}-{fid:06d}.parquet"
+            fid += 1
+            alive.append(p)
+            lines.append(
+                f'{{"add":{{"path":"{p}","partitionValues":{{}},'
+                f'"size":1048576,"modificationTime":{v},"dataChange":true,'
+                f'"stats":"{{\\"numRecords\\":1000}}"}}}}'
+            )
+        fp = os.path.join(log, f"{v:020d}.json")
+        with open(fp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        written.append(fp)
+        n_actions += len(lines)
+    return written, n_actions
 
 
 # ---------------------------------------------------------------- baseline
@@ -192,24 +244,76 @@ def _baseline_once(eng, path: str) -> tuple[float, int, int]:
 
 
 _DEVICE_CODE = r"""
-import sys, time, json
+import os, sys, time, json, hashlib
 sys.path.insert(0, {repo!r})
 import jax
 jax.devices()  # device / tunnel init outside the timed region
+import pyarrow as pa
+import bench
 from delta_tpu.engine.tpu import TpuEngine
 from delta_tpu.table import Table
+from delta_tpu.replay.columnar import clear_parse_cache
 out = []
+tbl = snap = None
 for run in range(3):
+    if snap is not None:
+        del snap
     t0 = time.perf_counter()
-    snap = Table.for_path({path!r}, TpuEngine()).latest_snapshot()
+    tbl = Table.for_path({path!r}, TpuEngine())
+    snap = tbl.latest_snapshot()
     nf = snap.num_files
     sz = snap.state.size_in_bytes
     out.append(time.perf_counter() - t0)
     print(f"  device e2e run{{run}}: {{out[-1]:.1f}}s files={{nf}}",
           file=sys.stderr)
-    del snap
-print("DEVICE_RESULT=" + json.dumps({{"cold": out[0], "warm": min(out),
-                                      "files": nf}}))
+result = {{"cold": out[0], "warm": min(out), "files": nf}}
+
+# ---- incremental update(): append commits, advance, verify vs cold ----
+def live_digest(s):
+    st = s.state  # raw columns only: never trigger the stats decode
+    paths = (st.file_actions_raw.column("path")
+             .filter(pa.array(st.live_mask)).to_pylist())
+    h = hashlib.sha256()
+    for p in sorted(paths):
+        h.update(p.encode())
+    return (s.version, st.num_files, st.size_in_bytes,
+            int(st.tombstone_mask.sum()), h.hexdigest())
+
+base_v = snap.version
+written, n_appended = bench.append_commits(
+    {path!r}, base_v + 1, bench.INCREMENTAL_COMMITS)
+try:
+    t0 = time.perf_counter()
+    snap2 = tbl.update()
+    upd_s = time.perf_counter() - t0
+    nf2 = snap2.num_files
+    assert snap2.version == base_v + bench.INCREMENTAL_COMMITS, \
+        (snap2.version, base_v)
+    print(f"  device update(): {{upd_s * 1000:.0f}}ms for "
+          f"{{n_appended}} appended actions, files={{nf2}}",
+          file=sys.stderr)
+    del snap  # keep peak memory at two materialized states
+    clear_parse_cache()
+    t0 = time.perf_counter()
+    cold = Table.for_path({path!r}, TpuEngine()).latest_snapshot()
+    cold_nf = cold.num_files
+    cold_s = time.perf_counter() - t0
+    print(f"  device cold reload at v{{cold.version}}: {{cold_s:.1f}}s",
+          file=sys.stderr)
+    parity = live_digest(snap2) == live_digest(cold)
+    if not parity:
+        print(f"  INCREMENTAL PARITY MISMATCH: {{live_digest(snap2)}} vs "
+              f"{{live_digest(cold)}}", file=sys.stderr)
+    result.update(update_s=upd_s, update_actions=n_appended,
+                  update_files=nf2, cold_after_append_s=cold_s,
+                  parity=parity)
+finally:
+    for fp in written:
+        try:
+            os.remove(fp)
+        except OSError:
+            pass
+print("DEVICE_RESULT=" + json.dumps(result))
 """
 
 
@@ -385,6 +489,25 @@ def main():
 
     if os.environ.get("BENCH_KERNEL_DIAG", "1") != "0":
         kernel_diagnostics(min(n_actions, 10_000_000), timeout_s)
+
+    if "update_s" in dev:
+        upd_s = dev["update_s"]
+        cold_s = dev["cold_after_append_s"]
+        ok = dev["parity"]
+        print(f"incremental update(): {upd_s * 1000:.0f}ms for "
+              f"{dev['update_actions']} actions "
+              f"({dev['update_actions'] / upd_s / 1e3:.0f}K actions/s), "
+              f"{cold_s / upd_s:.0f}x faster than the {cold_s:.1f}s cold "
+              f"reload, parity={'OK' if ok else 'MISMATCH'}",
+              file=sys.stderr)
+        # secondary metric line (the driver reads the LAST line only)
+        print(json.dumps({
+            "metric": "incremental_update_actions_per_sec",
+            "value": round(dev["update_actions"] / upd_s, 1) if ok else 0.0,
+            "unit": "actions/s",
+            "vs_cold_full_load": round(cold_s / upd_s, 1) if ok else 0.0,
+            "parity": ok,
+        }))
 
     print(json.dumps({
         "metric": "e2e_snapshot_load_actions_per_sec",

@@ -30,6 +30,49 @@ class TableNotInCatalogError(DeltaError):
     pass
 
 
+def _check_create_spec_matches(table, partition_by, properties,
+                               cluster_by) -> None:
+    """CREATE TABLE over an existing table (IF NOT EXISTS, or a name
+    registered at an existing LOCATION) must not silently diverge from
+    the on-disk spec — the reference's `DeltaCatalog` verifies the
+    create spec against the existing metadata and errors on mismatch.
+    `None` means the caller left that field unspecified: only explicit
+    requests are compared, so plain registration always passes."""
+    if partition_by is None and cluster_by is None and not properties:
+        return
+    if not table.exists():
+        return  # nothing on disk yet to diverge from
+    try:
+        snapshot = table.latest_snapshot()
+    except (FileNotFoundError, MissingTransactionLogError):
+        return
+    meta = snapshot.metadata
+    if partition_by is not None and \
+            list(partition_by) != list(meta.partitionColumns):
+        raise CatalogTableError(
+            error_class="DELTA_CREATE_TABLE_WITH_DIFFERENT_PARTITIONING",
+            message=f"requested partitioning {list(partition_by)} does not "
+            f"match the existing table's {list(meta.partitionColumns)}")
+    if properties:
+        existing = meta.configuration
+        diverged = sorted(k for k, v in properties.items()
+                          if existing.get(k) != v)
+        if diverged:
+            raise CatalogTableError(
+                error_class="DELTA_CREATE_TABLE_WITH_DIFFERENT_PROPERTY",
+                message=f"requested table properties {diverged} differ from "
+                "the existing table's configuration")
+    if cluster_by is not None:
+        from delta_tpu.clustering import clustering_columns
+
+        existing_cb = clustering_columns(snapshot) or []
+        if list(cluster_by) != list(existing_cb):
+            raise CatalogTableError(
+                error_class="DELTA_CREATE_TABLE_WITH_DIFFERENT_CLUSTERING",
+                message=f"requested clustering {list(cluster_by)} does not "
+                f"match the existing table's {list(existing_cb)}")
+
+
 class Catalog:
     def __init__(self, root: str, engine=None):
         if engine is None:

@@ -330,6 +330,23 @@ def _execute_merge(
                     f"UPDATE on IDENTITY column {k} is not supported "
                     "in MERGE",
                     error_class="DELTA_IDENTITY_COLUMNS_UPDATE_NOT_SUPPORTED")
+    # UPDATE SET * expands to an assignment per same-named source
+    # column, so it would overwrite system-allocated identity values
+    # just like an explicit assignment — guard it too, not only the
+    # explicit-assignments loop above
+    if identity_lower and any(
+            c.kind == "update" and c.assignments is None
+            for c in matched):
+        star_hit = sorted(c for c in source.column_names
+                          if c.lower() in identity_lower)
+        if star_hit:
+            from delta_tpu.errors import IdentityColumnError
+
+            raise IdentityColumnError(
+                f"UPDATE on IDENTITY column {star_hit[0]} is not "
+                "supported in MERGE (UPDATE SET * assigns it from the "
+                "source)",
+                error_class="DELTA_IDENTITY_COLUMNS_UPDATE_NOT_SUPPORTED")
     extra_cols = [c for c in source.column_names
                   if c.lower() not in target_by_lower]
     has_star = any(c.assignments is None and c.kind != "delete"

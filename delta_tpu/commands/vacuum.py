@@ -165,9 +165,12 @@ def _last_vacuum_watermark(table) -> Optional[int]:
 
 
 def _persist_last_vacuum_info(table, watermark: Optional[int]) -> None:
-    """Best-effort watermark persistence (`VacuumCommand.scala:967`):
-    FULL vacuums reset it to null (the next LITE rescans from the
-    earliest commit — conservative), LITE vacuums advance it."""
+    """Best-effort watermark persistence (`VacuumCommand.scala:967`).
+    Both FULL and LITE vacuums advance the watermark (advance-only,
+    never reset to null — see the caller's rationale), except a FULL
+    run that left mtime-skewed survivors behind, which skips the
+    advance so the next LITE still rescans the commits that removed
+    them."""
     path = f"{table.log_path}/{LAST_VACUUM_INFO}"
     body = json.dumps(
         {"latestCommitVersionOutsideOfRetentionWindow": watermark}
@@ -337,11 +340,17 @@ def vacuum(
     del_ts = fa.column("deletion_timestamp").to_pylist()
     dvs = fa.column("deletion_vector").to_pylist()
     live = state.live_mask
+    # tombstones whose deletionTimestamp already expired: deletable per
+    # the log, so if one SURVIVES the mtime guard below the watermark
+    # must not advance past the commit that removed it
+    expired: set = set()
     for i, p in enumerate(live_paths):
         if not masks[i]:
             continue
         keep = live[i] or (del_ts[i] or 0) >= cutoff
         if not keep:
+            if "://" not in p and not p.startswith("/"):
+                expired.add(unquote(p))
             continue
         if "://" not in p and not p.startswith("/"):
             protected.add(unquote(p))
@@ -365,11 +374,18 @@ def vacuum(
         result.eligible_end_commit_version = lite_end
     else:
         candidates = _walk_table_files(table.path)
+    skewed_survivor = False
     for abs_path, rel, mtime in candidates:
         if rel in protected:
             continue
         if mtime >= cutoff:
-            continue  # too young — may belong to an in-flight txn
+            # too young — may belong to an in-flight txn. A file whose
+            # REMOVE already expired per the log but whose on-disk
+            # mtime is skewed forward survives this run; remember that
+            # so the FULL watermark below doesn't seal it in forever.
+            if rel in expired:
+                skewed_survivor = True
+            continue
         result.files_deleted.append(rel)
         doomed.append(abs_path)
     if not dry_run and doomed:
@@ -397,8 +413,13 @@ def vacuum(
         # cleaned up. An INVENTORY vacuum observes only the rows the
         # caller supplied, which proves nothing about unlisted
         # tombstones — it never touches the watermark.
+        # ... except when a FULL walk left mtime-skewed survivors: their
+        # remove actions live in commits the watermark would skip, so a
+        # later LITE could never reconsider them once their mtime ages
+        # out. Hold the watermark until a run observes no such survivor.
         new_mark = lite_end if vacuum_type == "LITE" else \
-            _commit_outside_retention(table, cutoff)
+            (None if skewed_survivor
+             else _commit_outside_retention(table, cutoff))
         if new_mark is not None and (last_mark is None
                                      or new_mark > last_mark):
             _persist_last_vacuum_info(table, new_mark)

@@ -82,15 +82,23 @@ class HostParquetHandler(ParquetHandler):
 
 
 class HostFileSystemClient(FileSystemClient):
+    # I/O call counters (cheap, process-local, never reset implicitly):
+    # tests and bench diagnostics assert e.g. that a no-change poll does
+    # one listing and zero reads, or that a cache-covered reload
+    # re-reads nothing
     def __init__(self, store_resolver=logstore_for_path):
         self._store_for = store_resolver
+        self.read_calls = 0
+        self.list_calls = 0
 
     def list_from(self, path: str) -> Iterator[FileStatus]:
+        self.list_calls += 1
         return self._store_for(path).list_from(path)
 
     def list_from_fast(self, path: str, skip_stat):
         """Stat-skipping listing when the store supports it (local
         stores); falls back to the full listing."""
+        self.list_calls += 1
         store = self._store_for(path)
         fast = getattr(store, "list_from_fast", None)
         if fast is not None:
@@ -98,6 +106,7 @@ class HostFileSystemClient(FileSystemClient):
         return store.list_from(path)
 
     def read_file(self, path: str) -> bytes:
+        self.read_calls += 1
         return self._store_for(path).read(path)
 
     def write_file(self, path: str, data: bytes) -> None:

@@ -17,6 +17,21 @@ Hook = Callable[..., None]  # (table, txn, version, metadata)
 _EXTRA_HOOKS: List[Hook] = []
 
 
+def _snapshot_for_hook(table, version: int):
+    """Snapshot at the just-committed `version` for a hook's use. The
+    commit's own bytes were just handed to the snapshot cache
+    (`Table.notify_commit`), so `update()` normally serves this from the
+    incrementally-advanced state with zero log reads; `snapshot_at` is
+    the fallback when another writer got past `version` already."""
+    try:
+        snap = table.update()
+        if snap.version == version:
+            return snap
+    except Exception:
+        pass
+    return table.snapshot_at(version)
+
+
 def register_post_commit_hook(hook: Hook) -> None:
     _EXTRA_HOOKS.append(hook)
 
@@ -26,7 +41,7 @@ def checkpoint_hook(table, txn, version: int, metadata) -> None:
     if interval > 0 and version > 0 and version % interval == 0:
         from delta_tpu.log.checkpointer import write_checkpoint
 
-        snap = table.snapshot_at(version)
+        snap = _snapshot_for_hook(table, version)
         write_checkpoint(table.engine, snap)
 
 
@@ -56,7 +71,7 @@ def auto_compact_hook(table, txn, version: int, metadata) -> None:
         return
     if txn.operation == "OPTIMIZE" or not txn._adds:
         return
-    snap = table.snapshot_at(version)
+    snap = _snapshot_for_hook(table, version)
     small = sum(
         1 for s in snap.state.add_files_table.column("size").to_pylist()
         if (s or 0) < AUTO_COMPACT_MAX_FILE_SIZE

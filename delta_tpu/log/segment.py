@@ -49,6 +49,97 @@ class CorruptLogError(DeltaError):
     error_class = "DELTA_CORRUPT_LOG"
 
 
+class _IncrementalUnavailable(Exception):
+    """The log can't be advanced incrementally from the given segment —
+    a checkpoint/compaction landed past it, or the listing has a gap
+    (concurrent log cleanup). The caller falls back to a full load;
+    this is a control-flow signal, never a user-facing error."""
+
+
+def extend_log_segment(fs, prev: LogSegment):
+    """LIST only log files with version > `prev.version` and extend the
+    segment with the new commits — the incremental half of snapshot
+    maintenance (`SnapshotManagement.getUpdatedLogSegment`).
+
+    Returns None when there is nothing new (the common poll outcome —
+    one directory listing, zero reads/parses), or
+    `(new_segment, new_deltas)` where `new_deltas` are just the appended
+    commit FileStatus entries.
+
+    Raises _IncrementalUnavailable when a checkpoint or compacted delta
+    newer than `prev.version` appeared (the canonical segment for the
+    new version starts from that checkpoint — rebuilding keeps segments
+    identical to what a cold load would produce), or when the new
+    commit versions aren't contiguous with `prev` (log cleanup raced
+    the listing).
+    """
+    start = prev.version + 1
+    prefix = filenames.listing_prefix(prev.log_path, start)
+    # same stat-skipping policy as build_log_segment: commit entries
+    # keep (size=-1, mtime=0), so the parsed-commit cache keys of an
+    # incremental load match a later full listing's keys exactly
+    fast = getattr(fs, "list_from_fast", None)
+    try:
+        if fast is not None:
+            listing = list(fast(
+                prefix, lambda n: filenames.DELTA_FILE_RE.match(n)
+                is not None))
+        else:
+            listing = list(fs.list_from(prefix))
+    except FileNotFoundError:
+        raise TableNotFoundError(f"no _delta_log at {prev.log_path}",
+                                 error_class="DELTA_EMPTY_DIRECTORY")
+
+    new_deltas: List[tuple] = []
+    delta_match = filenames.DELTA_FILE_RE.match
+    for fstat in listing:
+        name = filenames.file_name(fstat.path)
+        if delta_match(name):
+            v = int(name.split(".", 1)[0])
+            if v >= start:
+                new_deltas.append((v, fstat))
+        elif filenames.CHECKPOINT_FILE_RE.match(name) and fstat.size > 0:
+            ci = CheckpointInstance.parse(fstat.path)
+            if ci is not None and ci.version > prev.version:
+                raise _IncrementalUnavailable(
+                    f"checkpoint appeared at version {ci.version}")
+        elif filenames.COMPACTED_DELTA_FILE_RE.match(name):
+            _, hi = filenames.compacted_delta_versions(fstat.path)
+            if hi > prev.version:
+                raise _IncrementalUnavailable(
+                    f"compacted delta appeared covering up to {hi}")
+    if not new_deltas:
+        return None
+    new_deltas.sort(key=lambda t: t[0])
+    versions = [v for v, _ in new_deltas]
+    if versions != list(range(start, versions[-1] + 1)):
+        raise _IncrementalUnavailable(
+            f"non-contiguous new commits {versions[:5]}..., expected "
+            f"[{start}, {versions[-1]}]")
+
+    files = [f for _, f in new_deltas]
+    last_ts = max(prev.last_commit_timestamp,
+                  max(f.modification_time for f in files))
+    if files[-1].modification_time == 0:
+        # stat-deferred listing: the newest commit's mtime is the
+        # snapshot timestamp — fetch just that one
+        try:
+            last_ts = max(last_ts,
+                          fs.file_status(files[-1].path).modification_time)
+        except FileNotFoundError:
+            pass
+
+    import dataclasses
+
+    seg = dataclasses.replace(
+        prev,
+        version=versions[-1],
+        deltas=list(prev.deltas) + files,
+        last_commit_timestamp=last_ts,
+    )
+    return seg, files
+
+
 def _verify_deltas_contiguous(versions: List[int], expected_start: int, target: int) -> None:
     if versions != list(range(expected_start, target + 1)):
         raise CorruptLogError(

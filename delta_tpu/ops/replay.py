@@ -574,3 +574,33 @@ def python_replay_reference(
         else:
             tomb[i] = True
     return live, tomb
+
+
+def delta_winner_masks(
+    keys: Sequence[tuple],
+    version: np.ndarray,
+    order: np.ndarray,
+    is_add: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, dict]:
+    """Last-wins masks over a DELTA batch of actions (the commits an
+    incremental `update()` appends on top of a retained snapshot).
+
+    Same contract as python_replay_reference, plus the winner map
+    `{key: row}` — the caller uses its key set to clear superseded rows
+    in the prior state's masks. Delta batches are O(new commits), so the
+    sequential formulation is the right tool here; the device kernels
+    above exist for the O(full history) replay.
+    """
+    n = len(keys)
+    rows = sorted(range(n), key=lambda i: (int(version[i]), int(order[i])))
+    winner: dict = {}
+    for i in rows:
+        winner[keys[i]] = i
+    live = np.zeros(n, dtype=bool)
+    tomb = np.zeros(n, dtype=bool)
+    for i in winner.values():
+        if is_add[i]:
+            live[i] = True
+        else:
+            tomb[i] = True
+    return live, tomb, winner

@@ -597,11 +597,211 @@ def parse_commit_batch(
 SMALL_ACTION_COLUMNS = ("protocol", "metaData", "txn", "domainMetadata")
 
 
+def _extract_small_rows(
+    table: pa.Table, versions: np.ndarray, orders: np.ndarray
+) -> List[Tuple[int, int, dict]]:
+    """Small-action rows of a parsed chunk in the native scanner's
+    `others` format: (version, order, {action-key: body}). Lets a cached
+    generic parse feed `_SmallActionTracker.scan_pylist` on later loads
+    without re-touching the Arrow chunk."""
+    rows: List[Tuple[int, int, dict]] = []
+    for col in (*SMALL_ACTION_COLUMNS, "commitInfo"):
+        if col not in table.column_names:
+            continue
+        arr = table.column(col).combine_chunks()
+        if pa.types.is_null(arr.type):
+            continue
+        mask = np.asarray(pc.is_valid(arr), dtype=bool)
+        sel = np.nonzero(mask)[0]
+        if sel.size == 0:
+            continue
+        vals = arr.take(pa.array(sel, pa.int64())).to_pylist()
+        for i, row in zip(sel, vals):
+            rows.append((int(versions[i]), int(orders[i]), {col: row}))
+    return rows
+
+
+class _OnceThunk:
+    """Memoize a one-shot decode thunk (the native scan's stats thunk
+    consumes its scan object on first call) so a cached parse can serve
+    the decoded column to any number of later snapshots."""
+
+    __slots__ = ("_thunk", "_value", "_lock")
+
+    def __init__(self, thunk):
+        self._thunk = thunk
+        self._value = None
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            if self._thunk is not None:
+                self._value = self._thunk()
+                self._thunk = None
+            return self._value
+
+
+def _combined_stats_thunk(parts):
+    """Deferred stats decode spanning several blocks: `parts` is a list
+    of (block, thunk-or-None); blocks without a thunk contribute their
+    already-real stats column. Returns None when nothing is deferred."""
+    if all(th is None for _, th in parts):
+        return None
+
+    def thunk():
+        chunks: List[pa.Array] = []
+        for block, th in parts:
+            col = th() if th is not None else block.column("stats")
+            if isinstance(col, pa.ChunkedArray):
+                chunks.extend(col.chunks)
+            else:
+                chunks.append(col)
+        return pa.chunked_array(chunks, pa.string())
+
+    return thunk
+
+
+@dataclass
+class ParsedSpan:
+    """One cached parse result covering a contiguous run of commit
+    files. `keys` (native replay-key sidecar) is row-aligned with
+    `block` and only usable when the span is the snapshot's sole
+    file-action source."""
+
+    block: pa.Table
+    others: List[Tuple[int, int, dict]]
+    keys: Optional[object]
+    stats_thunk: Optional[_OnceThunk]
+    n_files: int
+    nbytes: int
+
+
+def _span_nbytes(block: pa.Table, others: list) -> int:
+    try:
+        b = block.get_total_buffer_size()
+    except Exception:
+        b = block.nbytes
+    return int(b) + 256 * len(others)
+
+
+class ParsedCommitCache:
+    """Process-wide LRU of parsed commit spans, keyed by the tuple of
+    `(path, size, mtime)` of the files each span covers (commit files
+    are written put-if-absent, so the triple identifies the content;
+    stat-deferred listings key on `(path, -1, 0)` consistently).
+
+    Shared between full and incremental loads: a full load caches one
+    span for the whole commit run; each `update()` caches one small span
+    for its tail — so a later full reload is assembled entirely from
+    cached spans and re-parses nothing. Coverage is greedy from the
+    front of the request; only the uncovered tail is parsed."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        from collections import OrderedDict
+
+        self._spans: "OrderedDict[tuple, ParsedSpan]" = OrderedDict()
+        self._by_first: Dict[tuple, List[tuple]] = {}
+        self._bytes = 0
+        self.hits = 0          # lookups fully served from cache
+        self.partial_hits = 0  # a prefix was served, tail parsed
+        self.misses = 0
+        self.hit_files = 0
+        self.miss_files = 0
+
+    def get_covering(self, file_keys: tuple) -> List[ParsedSpan]:
+        """Longest greedy prefix cover of `file_keys` by cached spans
+        (possibly empty). Covered spans are LRU-refreshed."""
+        out: List[ParsedSpan] = []
+        n = len(file_keys)
+        with self._lock:
+            i = 0
+            while i < n:
+                best = None
+                for k in self._by_first.get(file_keys[i], ()):
+                    if (len(k) <= n - i
+                            and (best is None or len(k) > len(best))
+                            and file_keys[i:i + len(k)] == k):
+                        best = k
+                if best is None:
+                    break
+                self._spans.move_to_end(best)
+                out.append(self._spans[best])
+                i += len(best)
+            self.hit_files += i
+            self.miss_files += n - i
+            if i == n:
+                self.hits += 1
+            elif out:
+                self.partial_hits += 1
+            else:
+                self.misses += 1
+        return out
+
+    def put(self, file_keys: tuple, span: ParsedSpan) -> None:
+        if not file_keys or span.nbytes > self.max_bytes:
+            return
+        with self._lock:
+            if file_keys in self._spans:
+                return
+            self._spans[file_keys] = span
+            self._by_first.setdefault(file_keys[0], []).append(file_keys)
+            self._bytes += span.nbytes
+            while self._bytes > self.max_bytes and len(self._spans) > 1:
+                old_key, old = self._spans.popitem(last=False)
+                self._bytes -= old.nbytes
+                sibs = self._by_first.get(old_key[0], [])
+                if old_key in sibs:
+                    sibs.remove(old_key)
+                    if not sibs:
+                        del self._by_first[old_key[0]]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._by_first.clear()
+            self._bytes = 0
+
+    @property
+    def cached_bytes(self) -> int:
+        return self._bytes
+
+
+_PARSE_CACHE: Optional[ParsedCommitCache] = None
+_PARSE_CACHE_LOCK = threading.Lock()
+_PARSE_CACHE_DEFAULT_BYTES = 6 << 30
+
+
+def parse_cache() -> Optional[ParsedCommitCache]:
+    """The process-wide parsed-commit cache, or None when disabled via
+    DELTA_TPU_PARSE_CACHE_BYTES=0."""
+    global _PARSE_CACHE
+    if _PARSE_CACHE is None:
+        with _PARSE_CACHE_LOCK:
+            if _PARSE_CACHE is None:
+                budget = int(os.environ.get(
+                    "DELTA_TPU_PARSE_CACHE_BYTES",
+                    _PARSE_CACHE_DEFAULT_BYTES))
+                _PARSE_CACHE = (ParsedCommitCache(budget) if budget > 0
+                                else False)
+    return _PARSE_CACHE or None
+
+
+def clear_parse_cache() -> None:
+    """Drop all cached parses AND re-read the budget env var (tests and
+    the bench cold-comparator use this)."""
+    global _PARSE_CACHE
+    with _PARSE_CACHE_LOCK:
+        _PARSE_CACHE = None
+
+
 def columnarize_log_segment(
     engine,
     segment,
     table_root: Optional[str] = None,
     small_only: bool = False,
+    early_replay: bool = True,
 ) -> ColumnarActions:
     """Read every file in the segment and produce a ColumnarActions.
 
@@ -696,120 +896,169 @@ def columnarize_log_segment(
     from delta_tpu.utils import filenames as fn
 
     commit_infos: List[Tuple[int, str, int]] = []
+    commit_stats: List[object] = []  # FileStatus aligned with commit_infos
     for fstat in segment.compacted_deltas:
         _, hi = fn.compacted_delta_versions(fstat.path)
         commit_infos.append((hi, fstat.path, fstat.size))
+        commit_stats.append(fstat)
     for fstat in segment.deltas:
         commit_infos.append((fn.delta_version(fstat.path), fstat.path, fstat.size))
+        commit_stats.append(fstat)
 
     native_keys = None
     native_pending = None
     native_stats_thunk = None
+    checkpoint_blocks = list(blocks)
     if commit_infos:
-        version_arr = np.array([v for v, _, _ in commit_infos],
-                               dtype=np.int64)
-        from delta_tpu import native as _native
+        cache = parse_cache()
+        file_keys = tuple(
+            (f.path, f.size, f.modification_time) for f in commit_stats)
+        span_parts: List[ParsedSpan] = (
+            cache.get_covering(file_keys) if cache is not None else [])
+        n_covered = sum(s.n_files for s in span_parts)
+        remaining = commit_infos[n_covered:]
+        fresh_pending = None
+        if remaining:
+            version_arr = np.array([v for v, _, _ in remaining],
+                                   dtype=np.int64)
+            from delta_tpu import native as _native
 
-        total_listed = sum(max(0, int(s)) for _, _, s in commit_infos)
-        if any(int(s) < 0 for _, _, s in commit_infos):
-            # stat-deferred listing: estimate with a typical commit size
-            total_listed = max(total_listed, 8192 * len(commit_infos))
-        allow_compile = total_listed >= _native.MIN_BYTES_FOR_COLD_BUILD
-        parsed_native = generic = read = None
-        native_rejected = False
+            total_listed = sum(max(0, int(s)) for _, _, s in remaining)
+            if any(int(s) < 0 for _, _, s in remaining):
+                # stat-deferred listing: estimate with a typical commit size
+                total_listed = max(total_listed, 8192 * len(remaining))
+            allow_compile = total_listed >= _native.MIN_BYTES_FOR_COLD_BUILD
+            parsed_native = generic = read = None
+            native_rejected = False
 
-        # Early device dispatch: when the native block will be the sole
-        # block (no checkpoint rows) on a single-device engine, kick the
-        # replay kernel off as soon as the scan's key lanes exist — the
-        # device sorts while the host assembles the Arrow table.
-        launch = None
-        mesh = getattr(engine, "mesh", None)
-        if (not blocks and not small_only
-                and (mesh is None or mesh.devices.size <= 1)):
-            def launch(scan, row_versions, row_orders):
-                from delta_tpu.ops.replay import replay_select_launch
-                from delta_tpu.replay.state import BLOCKWISE_MIN_ROWS
+            # Early device dispatch: when the native block will be the sole
+            # block (no checkpoint rows, no cached spans) on a
+            # single-device engine, kick the replay kernel off as soon as
+            # the scan's key lanes exist — the device sorts while the host
+            # assembles the Arrow table.
+            launch = None
+            mesh = getattr(engine, "mesh", None)
+            sole_fresh = not blocks and not span_parts
+            if (early_replay and sole_fresh and not small_only
+                    and (mesh is None or mesh.devices.size <= 1)):
+                def launch(scan, row_versions, row_orders):
+                    from delta_tpu.ops.replay import replay_select_launch
+                    from delta_tpu.replay.state import BLOCKWISE_MIN_ROWS
 
-                if scan.n_rows >= BLOCKWISE_MIN_ROWS:
-                    return None  # >HBM: compute_masks_device streams blocks
-                if row_versions.max(initial=0) >= 2**31:
-                    return None
-                return replay_select_launch(
-                    [scan.path_code,
-                     np.zeros(scan.n_rows, np.uint32)],
-                    row_versions.astype(np.int32), row_orders,
-                    scan.is_add.astype(bool),
-                    fa_hint=(scan.path_new, scan.refs, scan.n_uniq),
-                )
-        if _native.available(allow_compile):
-            # local files: one native read+scan round-trip (no per-file
-            # interpreter I/O, no buffer copy into Python)
-            local = [engine.fs.os_path(p) for _, p, _ in commit_infos]
-            if all(p is not None for p in local):
-                from delta_tpu.replay.native_parse import (
-                    parse_commit_paths_native,
-                )
-
-                out = parse_commit_paths_native(
-                    local, version_arr, small_only=small_only,
-                    launch=launch,
-                    # stats decode defers only when this scan's rows are
-                    # the whole table (sole block) — otherwise the concat
-                    # below would bake the placeholder in
-                    lazy_stats=(not blocks and not small_only
-                                and not os.environ.get(
-                                    "DELTA_TPU_EAGER_STATS")))
-                if out is not None:
-                    block, others, keys, pending, sthunk, total = out
-                    parsed_native = (block, others, keys, pending, sthunk)
-                    bytes_parsed += total
-                else:
-                    # the scanner saw (and rejected) this exact content —
-                    # don't scan the same bytes natively a second time
-                    native_rejected = True
-        if parsed_native is None:
-            # one parallel read into one buffer; the native C++ scanner
-            # and the generic Arrow parser are alternative consumers of
-            # the SAME bytes — a native-side rejection never re-fetches
-            read = _read_commits_buffer(engine, commit_infos)
-            if read is not None:
-                buf, starts, version_arr = read
-                if not native_rejected and _native.available(allow_compile):
+                    if scan.n_rows >= BLOCKWISE_MIN_ROWS:
+                        return None  # >HBM: compute_masks_device streams blocks
+                    if row_versions.max(initial=0) >= 2**31:
+                        return None
+                    return replay_select_launch(
+                        [scan.path_code,
+                         np.zeros(scan.n_rows, np.uint32)],
+                        row_versions.astype(np.int32), row_orders,
+                        scan.is_add.astype(bool),
+                        fa_hint=(scan.path_new, scan.refs, scan.n_uniq),
+                    )
+            if _native.available(allow_compile):
+                # local files: one native read+scan round-trip (no per-file
+                # interpreter I/O, no buffer copy into Python)
+                local = [engine.fs.os_path(p) for _, p, _ in remaining]
+                if all(p is not None for p in local):
                     from delta_tpu.replay.native_parse import (
-                        parse_commits_native,
+                        parse_commit_paths_native,
                     )
 
-                    parsed_native = parse_commits_native(
-                        buf, starts, version_arr, small_only=small_only,
-                        launch=launch)
-                    if parsed_native is not None:
-                        bytes_parsed += int(starts[-1])
-                if parsed_native is None:
-                    generic = _parse_buffer_generic(buf, starts, version_arr)
-        if parsed_native is not None:
-            block, others, keys, pending, sthunk = parsed_native
-            if block.num_rows and not small_only:
-                if not blocks:
-                    native_keys = keys  # row-aligned only when sole block
-                    native_pending = pending
-                    native_stats_thunk = sthunk
-                blocks.append(block)
-            tracker.scan_pylist(others)
-        else:
-            if generic is None:  # size mismatch or accounting failure
-                blobs = [(v, engine.fs.read_file(p))
-                         for v, p, _ in commit_infos]
-                generic = parse_commit_batch(blobs)
-            tbl, versions, orders, nbytes = generic
-            bytes_parsed += nbytes
-            if tbl is not None:
-                tracker.scan_chunk(tbl, versions, orders)
-                if not small_only:
-                    for col in ("add", "remove"):
-                        block = _extract_file_actions(tbl, col, versions,
+                    out = parse_commit_paths_native(
+                        local, version_arr, small_only=small_only,
+                        launch=launch,
+                        # stats decode defers only when a deferred column
+                        # can later be assembled: the combined stats thunk
+                        # spans blocks, so any non-small parse may defer
+                        lazy_stats=(not small_only
+                                    and not os.environ.get(
+                                        "DELTA_TPU_EAGER_STATS")))
+                    if out is not None:
+                        block, others, keys, pending, sthunk, total = out
+                        parsed_native = (block, others, keys, pending, sthunk)
+                        bytes_parsed += total
+                    else:
+                        # the scanner saw (and rejected) this exact content —
+                        # don't scan the same bytes natively a second time
+                        native_rejected = True
+            if parsed_native is None:
+                # one parallel read into one buffer; the native C++ scanner
+                # and the generic Arrow parser are alternative consumers of
+                # the SAME bytes — a native-side rejection never re-fetches
+                read = _read_commits_buffer(engine, remaining)
+                if read is not None:
+                    buf, starts, version_arr = read
+                    if not native_rejected and _native.available(allow_compile):
+                        from delta_tpu.replay.native_parse import (
+                            parse_commits_native,
+                        )
+
+                        parsed_native = parse_commits_native(
+                            buf, starts, version_arr, small_only=small_only,
+                            launch=launch)
+                        if parsed_native is not None:
+                            bytes_parsed += int(starts[-1])
+                    if parsed_native is None:
+                        generic = _parse_buffer_generic(buf, starts, version_arr)
+            if parsed_native is not None:
+                block, others, keys, pending, sthunk = parsed_native
+                fresh_pending = pending
+                fresh = ParsedSpan(
+                    block=block, others=others, keys=keys,
+                    stats_thunk=_OnceThunk(sthunk) if sthunk is not None
+                    else None,
+                    n_files=len(remaining),
+                    nbytes=_span_nbytes(block, others))
+            else:
+                if generic is None:  # size mismatch or accounting failure
+                    blobs = [(v, engine.fs.read_file(p))
+                             for v, p, _ in remaining]
+                    generic = parse_commit_batch(blobs)
+                tbl, versions, orders, nbytes = generic
+                bytes_parsed += nbytes
+                gen_blocks: List[pa.Table] = []
+                small_rows: List[Tuple[int, int, dict]] = []
+                if tbl is not None:
+                    if small_only:
+                        tracker.scan_chunk(tbl, versions, orders)
+                    else:
+                        small_rows = _extract_small_rows(tbl, versions,
+                                                         orders)
+                        for col in ("add", "remove"):
+                            b = _extract_file_actions(tbl, col, versions,
                                                       orders)
-                        if block is not None:
-                            blocks.append(block)
+                            if b is not None:
+                                gen_blocks.append(b)
+                fresh = None
+                if not small_only:
+                    gb = (pa.concat_tables(gen_blocks) if gen_blocks
+                          else CANONICAL_FILE_ACTION_SCHEMA.empty_table())
+                    fresh = ParsedSpan(
+                        block=gb, others=small_rows, keys=None,
+                        stats_thunk=None, n_files=len(remaining),
+                        nbytes=_span_nbytes(gb, small_rows))
+            if fresh is not None:
+                span_parts.append(fresh)
+                # never cache a small_only parse — its span has no file
+                # actions and would poison later full loads
+                if cache is not None and not small_only:
+                    cache.put(file_keys[n_covered:], fresh)
+        for part in span_parts:
+            tracker.scan_pylist(part.others)
+            if not small_only and part.block.num_rows:
+                blocks.append(part.block)
+        if not small_only:
+            if not checkpoint_blocks and len(span_parts) == 1:
+                # sole file-action source: the span's replay-key sidecar
+                # (and any in-flight device dispatch) are row-aligned
+                # with the final table
+                native_keys = span_parts[0].keys
+                native_pending = fresh_pending
+            native_stats_thunk = _combined_stats_thunk(
+                [(b, None) for b in checkpoint_blocks]
+                + [(p.block, p.stats_thunk) for p in span_parts
+                   if p.block.num_rows])
 
     if blocks:
         file_actions = pa.concat_tables(blocks)
@@ -833,4 +1082,38 @@ def columnarize_log_segment(
         stats_thunk=native_stats_thunk,
         bytes_parsed=bytes_parsed,
         replay_keys=native_keys,
+    )
+
+
+def columnarize_commit_blobs(
+    commit_blobs: Sequence[Tuple[int, bytes]],
+) -> ColumnarActions:
+    """In-memory commits → ColumnarActions, no filesystem access. The
+    post-commit fast path feeds the bytes a transaction just wrote
+    straight into snapshot advancement — the commit it authored is never
+    re-listed or re-read (`SnapshotManagement.updateAfterCommit`)."""
+    tracker = _SmallActionTracker()
+    tbl, versions, orders, nbytes = parse_commit_batch(commit_blobs)
+    blocks: List[pa.Table] = []
+    if tbl is not None:
+        tracker.scan_chunk(tbl, versions, orders)
+        for col in ("add", "remove"):
+            b = _extract_file_actions(tbl, col, versions, orders)
+            if b is not None:
+                blocks.append(b)
+    fa = (pa.concat_tables(blocks) if blocks
+          else CANONICAL_FILE_ACTION_SCHEMA.empty_table())
+    latest_ci = None
+    if tracker.commit_infos:
+        latest_ci = tracker.commit_infos[max(tracker.commit_infos)]
+    return ColumnarActions(
+        file_actions=fa,
+        protocol=tracker.protocol[2],
+        metadata=tracker.metadata[2],
+        set_transactions={k: t[2] for k, t in tracker.txns.items()},
+        domain_metadata={k: t[2] for k, t in tracker.domains.items()},
+        latest_commit_info=latest_ci,
+        commit_infos=tracker.commit_infos,
+        num_commit_files=len(commit_blobs),
+        bytes_parsed=nbytes,
     )

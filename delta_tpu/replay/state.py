@@ -387,6 +387,109 @@ def reconstruct_small_state(engine, segment,
     )
 
 
+def advance_state(
+    engine, prev: SnapshotState, delta: ColumnarActions, new_segment
+) -> SnapshotState:
+    """Replay a delta batch of commits ON TOP of a retained prior state
+    — the incremental half of `update()` (SnapshotManagement log-segment
+    deltas). Reuses the prior snapshot's columnar arrays: the new state's
+    table is `concat(prev rows, delta rows)` (zero-copy) and only the
+    delta keys' winners are recomputed; prior rows whose key is touched
+    by the delta have their mask bits cleared. Produces a state
+    bit-identical to a cold full replay at the same version.
+
+    Callers must handle protocol changes BEFORE this (fallback to full
+    replay) — a new protocol can change how existing actions are read.
+    """
+    from delta_tpu.ops.replay import delta_winner_masks
+
+    delta_fa = delta.file_actions_complete()  # delta stats: small, eager
+    m = delta_fa.num_rows
+    n_prev = prev.file_actions_raw.num_rows
+
+    if m == 0:
+        new_raw = prev.file_actions_raw
+        live = prev.live_mask
+        tomb = prev.tombstone_mask
+        stats_thunk = prev.stats_thunk and _chained_prev_stats(prev, None)
+    else:
+        d_paths = delta_fa.column("path").to_pylist()
+        d_dv = delta_fa.column("dv_id").to_pylist()
+        d_keys = list(zip(d_paths, d_dv))
+        d_live, d_tomb, winner = delta_winner_masks(
+            d_keys,
+            np.asarray(delta_fa.column("version"), np.int64),
+            np.asarray(delta_fa.column("order"), np.int32),
+            np.asarray(delta_fa.column("is_add"), bool),
+        )
+        prev_live = prev.live_mask.copy()
+        prev_tomb = prev.tombstone_mask.copy()
+        if n_prev:
+            # candidate prior rows: active AND path touched by the delta
+            # (one vectorized hash probe over the big column; the exact
+            # (path, dv_id) check runs only on the few candidates)
+            touched = pa.array(sorted({p for p, _ in winner}), pa.string())
+            import pyarrow.compute as pc
+
+            hit = np.asarray(
+                pc.is_in(prev.file_actions_raw.column("path"),
+                         value_set=touched).combine_chunks(),
+                dtype=bool)
+            cand = np.nonzero(hit & (prev_live | prev_tomb))[0]
+            if cand.size:
+                sub = prev.file_actions_raw.take(
+                    pa.array(cand, pa.int64()))
+                for j, p, dv in zip(cand,
+                                    sub.column("path").to_pylist(),
+                                    sub.column("dv_id").to_pylist()):
+                    if (p, dv) in winner:
+                        prev_live[j] = False
+                        prev_tomb[j] = False
+        new_raw = pa.concat_tables([prev.file_actions_raw, delta_fa])
+        live = np.concatenate([prev_live, d_live])
+        tomb = np.concatenate([prev_tomb, d_tomb])
+        stats_thunk = (prev.stats_thunk
+                       and _chained_prev_stats(prev, delta_fa))
+
+    set_txns = dict(prev.set_transactions)
+    set_txns.update(delta.set_transactions)
+    domains = dict(prev.domain_metadata)
+    domains.update(delta.domain_metadata)
+    commit_infos = dict(prev.commit_infos)
+    commit_infos.update(delta.commit_infos)
+
+    return SnapshotState(
+        version=new_segment.version,
+        protocol=delta.protocol or prev.protocol,
+        metadata=delta.metadata or prev.metadata,
+        set_transactions=set_txns,
+        domain_metadata=domains,
+        file_actions_raw=new_raw,
+        live_mask=live,
+        tombstone_mask=tomb,
+        latest_commit_info=delta.latest_commit_info or prev.latest_commit_info,
+        commit_infos=commit_infos,
+        timestamp_ms=new_segment.last_commit_timestamp,
+        stats_thunk=stats_thunk,
+    )
+
+
+def _chained_prev_stats(prev: SnapshotState, delta_fa: Optional[pa.Table]):
+    """Deferred-stats chain for an advanced state: the prior state's
+    pending decode runs (exactly once, under ITS splice lock) only when
+    the NEW state's stats are first touched; the delta rows' stats are
+    already real."""
+
+    def thunk():
+        col = prev.file_actions.column("stats")  # splices prev on demand
+        chunks = list(col.chunks)
+        if delta_fa is not None:
+            chunks.extend(delta_fa.column("stats").chunks)
+        return pa.chunked_array(chunks, pa.string())
+
+    return thunk
+
+
 def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotState:
     """Full state reconstruction for a log segment."""
     from delta_tpu.metrics import SnapshotMetrics

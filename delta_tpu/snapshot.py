@@ -160,6 +160,112 @@ class Snapshot:
             return None
         return dm
 
+    def update(self, engine=None) -> Optional["Snapshot"]:
+        """Incrementally advance to the latest version: LIST only commits
+        past this one, parse just those, and replay them ON TOP of this
+        snapshot's retained state (`SnapshotManagement.updateAfterCommit`
+        semantics — one prefix listing, O(new commits) work).
+
+        Returns `self` when nothing new landed (zero reads, zero
+        parses), a new Snapshot sharing this one's columnar arrays when
+        commits appended cleanly, or None when incremental maintenance
+        is unavailable — a checkpoint/compaction boundary intervened, a
+        listing gap appeared, or the protocol changed — and the caller
+        must fall back to a full `latest_snapshot()` load. The advanced
+        state is bit-identical to a cold replay at the same version.
+        """
+        from delta_tpu.log.segment import (
+            _IncrementalUnavailable,
+            extend_log_segment,
+        )
+
+        eng = engine if engine is not None else self._engine
+        try:
+            ext = extend_log_segment(eng.fs, self._segment)
+        except _IncrementalUnavailable:
+            return None
+        if ext is None:
+            return self
+        new_segment, new_deltas = ext
+        if self._state is None:
+            # no replayed state retained to advance — a lazy snapshot
+            # over the extended segment costs the same as advancing
+            # would, and the parsed-commit cache still spares any
+            # re-parse of commits this segment shares with prior loads
+            return Snapshot(self._table, new_segment, self._engine)
+
+        import dataclasses
+
+        from delta_tpu.replay.columnar import columnarize_log_segment
+        from delta_tpu.replay.state import advance_state
+
+        delta_seg = dataclasses.replace(
+            new_segment,
+            deltas=new_deltas,
+            checkpoints=[],
+            compacted_deltas=[],
+            checkpoint_version=None,
+        )
+        # early_replay=False: the delta is replayed host-side by
+        # advance_state; an early device dispatch would go unused
+        delta = columnarize_log_segment(eng, delta_seg, early_replay=False)
+        if delta.protocol is not None:
+            # a protocol change can alter how existing actions must be
+            # read — never replay across it incrementally
+            return None
+        new_state = advance_state(eng, self._state, delta, new_segment)
+        snap = Snapshot(self._table, new_segment, self._engine)
+        snap._state = new_state
+        return snap
+
+    def _advanced_with_blobs(self, blobs) -> Optional["Snapshot"]:
+        """Advance with commit bytes already in memory (the post-commit
+        fast path: a transaction hands over the actions it just wrote,
+        so its own commit is never re-listed or re-read). `blobs` is
+        [(version, bytes)] contiguous from `self.version + 1`. Returns
+        None when this snapshot can't host the advancement (no retained
+        state, version gap, or a protocol change in the blobs)."""
+        if self._state is None:
+            return None
+        versions = [v for v, _ in blobs]
+        if versions != list(range(self.version + 1,
+                                  self.version + 1 + len(blobs))):
+            return None
+
+        import dataclasses
+        import time
+
+        from delta_tpu.replay.columnar import columnarize_commit_blobs
+        from delta_tpu.replay.state import advance_state
+        from delta_tpu.storage.logstore import FileStatus
+        from delta_tpu.utils import filenames
+
+        delta = columnarize_commit_blobs(blobs)
+        if delta.protocol is not None:
+            return None
+        fs = self._engine.fs
+        files = []
+        last_ts = self._segment.last_commit_timestamp
+        for v, data in blobs:
+            path = filenames.delta_file(self._table.log_path, v)
+            try:
+                mtime = fs.file_status(path).modification_time
+            except Exception:
+                mtime = int(time.time() * 1000)
+            files.append(FileStatus(path, len(data), mtime))
+            last_ts = max(last_ts, mtime)
+        new_segment = dataclasses.replace(
+            self._segment,
+            version=versions[-1],
+            deltas=list(self._segment.deltas) + files,
+            last_commit_timestamp=last_ts,
+        )
+        new_state = advance_state(self._engine, self._state, delta,
+                                  new_segment)
+        snap = Snapshot(self._table, new_segment, self._engine)
+        snap._state = new_state
+        return snap
+
     def scan_builder(self):
         from delta_tpu.scan import ScanBuilder
 

@@ -152,8 +152,10 @@ class _ExpiryGuard:
             if hint is None or hint.version < v:
                 return
             self._verified_pending = None  # re-verify below
+        # duck-typed: incremental poll when the table supports it
+        poll = getattr(self.table, "update", None) or self.table.latest_snapshot
         try:
-            segment = self.table.latest_snapshot().log_segment
+            segment = poll().log_segment
         except Exception:
             return  # can't list — treat as caught up, retry next poll
         if segment.version < v:
@@ -318,7 +320,7 @@ class DeltaSource:
         sv = opts.get("startingversion")
         if sv is not None:
             if str(sv).lower() == "latest":
-                sv = table.latest_snapshot().version + 1
+                sv = table.update().version + 1
             else:
                 try:
                     sv = int(sv)
@@ -356,7 +358,7 @@ class DeltaSource:
     def _ensure_initial(self) -> None:
         if self._initial_version is not None:
             return
-        snap = self.table.latest_snapshot()
+        snap = self.table.update()
         if self._tracked_schema is None:
             # the schema this stream was started against — the baseline
             # for mid-stream metadata-change detection. With a
@@ -446,7 +448,7 @@ class DeltaSource:
 
         if self._tracked_schema is not None:
             return schema_from_json(self._tracked_schema)
-        return self.table.latest_snapshot().metadata.schema
+        return self.table.update().metadata.schema
 
     def _indexed_after(
         self, start: Optional[DeltaSourceOffset], limits: ReadLimits
@@ -515,7 +517,7 @@ class DeltaSource:
         builds there)."""
         if getattr(self, "_cached_table_id", None) is None:
             self._cached_table_id = \
-                self.table.latest_snapshot().metadata.id
+                self.table.update().metadata.id
         return self._cached_table_id
 
     def _check_offset_table(self, *offsets) -> None:
@@ -567,7 +569,7 @@ class DeltaSource:
         from delta_tpu.models.schema import PrimitiveType, to_arrow_type
         from delta_tpu.stats.partition import deserialize_partition_value
 
-        snap = self.table.latest_snapshot()
+        snap = self.table.update()
         schema = snap.schema
         part_cols = snap.partition_columns
         batches = []
@@ -623,7 +625,7 @@ class DeltaCDCSource:
         from delta_tpu.config import ENABLE_CDF, cdf_enabled, get_table_config
 
         self.table = table
-        snap = table.latest_snapshot()
+        snap = table.update()
         if not cdf_enabled(snap.metadata.configuration):
             from delta_tpu.errors import CdcNotEnabledError
 
@@ -653,7 +655,7 @@ class DeltaCDCSource:
         if self._starting_version is not None:
             self._initial_version = self._starting_version - 1
         else:
-            self._initial_version = self.table.latest_snapshot().version
+            self._initial_version = self.table.update().version
 
     def _version_file_stats(self, version: int) -> Optional[tuple]:
         """(file_count, byte_count) of the files a CDC read of this
@@ -764,7 +766,7 @@ class DeltaCDCSource:
             COMMIT_VERSION_COL,
         )
 
-        # the stream's baseline schema, NOT latest_snapshot() — batches
+        # the stream's baseline schema, NOT update() — batches
         # for offsets before a schema change must not adopt the new one
         sch = to_arrow_schema(schema_from_json(self._baseline_schema))
         return (sch.append(pa.field(CDC_TYPE_COL, pa.string()))

@@ -109,7 +109,53 @@ class Table:
             ),
         )
 
-    update = latest_snapshot
+    def update(self) -> Snapshot:
+        """Return the latest snapshot, advancing the cached one
+        incrementally when possible (the `DeltaLog.update()` fast path):
+        LIST only commits past the cached version and replay just those
+        on top of the retained state. Falls back to the full
+        `latest_snapshot()` load when there is no usable cached snapshot
+        or incremental maintenance is unavailable (checkpoint boundary,
+        listing gap, protocol change, coordinated tables)."""
+        with self._lock:
+            cached = self._cached_snapshot
+        if cached is None or self._coordinated:
+            return self.latest_snapshot()
+        advanced = cached.update()
+        if advanced is None:
+            return self.latest_snapshot()
+        if advanced is not cached:
+            with self._lock:
+                cur = self._cached_snapshot
+                if cur is None or cur.version <= advanced.version:
+                    self._cached_snapshot = advanced
+                else:
+                    advanced = cur  # a racing full load got further
+        return advanced
+
+    def notify_commit(self, version: int, data: bytes) -> None:
+        """Post-commit handoff: a transaction that just wrote commit
+        `version` gives its serialized actions to the snapshot cache, so
+        the next `update()` (and the post-commit hooks) advance without
+        re-listing or re-reading the commit this process just produced
+        (`SnapshotManagement.updateAfterCommit`). Best-effort: any
+        failure leaves the cache untouched and the next poll takes the
+        normal path. Never raises."""
+        try:
+            with self._lock:
+                cached = self._cached_snapshot
+            if (cached is None or self._coordinated
+                    or cached.version != version - 1
+                    or cached._state is None):
+                return
+            advanced = cached._advanced_with_blobs([(version, data)])
+            if advanced is None:
+                return
+            with self._lock:
+                if self._cached_snapshot is cached:
+                    self._cached_snapshot = advanced
+        except Exception:
+            pass
 
     def snapshot_at(self, version: int) -> Snapshot:
         hint = read_last_checkpoint(self.engine.fs, self.log_path)

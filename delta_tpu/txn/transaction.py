@@ -30,6 +30,7 @@ from delta_tpu.config import (
     settings,
 )
 from delta_tpu.errors import (
+    CommitFailedError,
     ConcurrentTransactionError,
     DeltaError,
     InvalidArgumentError,
@@ -733,6 +734,12 @@ class Transaction:
                 attempt_version = latest + 1
                 continue
             self._committed = True
+            # hand the bytes we just wrote to the snapshot cache BEFORE
+            # the hooks run, so they (and the next update() poll) advance
+            # incrementally without re-reading our own commit
+            notify = getattr(self._table, "notify_commit", None)
+            if notify is not None and self._coordinator() is None:
+                notify(attempt_version, data)
             if self.observer:
                 self.observer.after_commit(self, attempt_version)
             _report(attempt_version, True)
@@ -741,7 +748,7 @@ class Transaction:
             return CommitResult(
                 version=attempt_version,
                 committed=True,
-                snapshot_fn=lambda: table.latest_snapshot(),
+                snapshot_fn=lambda: table.update(),
                 attempts=attempts,
             )
         raise MaxCommitRetriesExceededError(

@@ -57,6 +57,16 @@ def tpcds_host(tpcds):
 ORACLE_EXEMPT = {"q67": "sqlite parser stack overflow on the 9-key "
                         "rollup expansion"}
 
+import sqlite3 as _sqlite3
+
+if tuple(int(x) for x in _sqlite3.sqlite_version.split(".")[:2]) < (3, 39):
+    # FULL OUTER JOIN landed in sqlite 3.39; older oracles can't run
+    # these (the engine still must execute them — the exempt branch
+    # asserts that)
+    for _q in ("q51", "q97"):
+        ORACLE_EXEMPT.setdefault(
+            _q, f"sqlite {_sqlite3.sqlite_version} lacks FULL OUTER JOIN")
+
 
 @pytest.mark.parametrize("substrate", ["device", "host"])
 @pytest.mark.parametrize("name", sorted(QUERIES))
