@@ -446,11 +446,38 @@ def kernel_diagnostics(n: int, timeout_s: int) -> None:
 # --------------------------------------------------------------------- main
 
 
+def analyzer_scan_metric():
+    """delta-lint full-repo scan time: a secondary metric so an
+    accidentally quadratic rule (the lint runs in tier-1 CI) shows up
+    as a >10s regression here instead of as slow test runs."""
+    import delta_tpu
+    from delta_tpu.tools.analyzer import analyze_paths
+
+    pkg = os.path.dirname(os.path.abspath(delta_tpu.__file__))
+    t0 = time.perf_counter()
+    report = analyze_paths([pkg], root=os.path.dirname(pkg))
+    scan_s = time.perf_counter() - t0
+    print(f"delta-lint repo scan: {scan_s:.2f}s over "
+          f"{report.files_scanned} files, {len(report.findings)} "
+          f"finding(s), {len(report.suppressed)} suppressed",
+          file=sys.stderr)
+    # secondary metric line (the driver reads the LAST line only)
+    print(json.dumps({
+        "metric": "analyzer_repo_scan_seconds",
+        "value": round(scan_s, 3),
+        "unit": "s",
+        "files": report.files_scanned,
+        "clean": report.ok,
+    }))
+
+
 def main():
     commits = int(os.environ.get("BENCH_COMMITS", 100_000))
     workdir = os.environ.get("BENCH_WORKDIR", "/tmp/delta_tpu_bench")
     timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 1800))
     n_actions = commits * FILES_PER_COMMIT
+
+    analyzer_scan_metric()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # build the native scanner up front so neither side times a g++ run

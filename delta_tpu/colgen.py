@@ -241,7 +241,7 @@ def generated_dependents(schema: StructType, column: str):
     """Names of generated columns whose expression references
     `column` — possibly a dotted nested path — (dependency guard for
     DROP/RENAME COLUMN)."""
-    from delta_tpu.expressions.parser import parse_expression
+    from delta_tpu.expressions.parser import ParseError, parse_expression
 
     out = []
     for f in schema.fields:
@@ -251,7 +251,7 @@ def generated_dependents(schema: StructType, column: str):
         try:
             refs = {".".join(r) for r in
                     parse_expression(expr).references()}
-        except Exception:
+        except ParseError:
             continue
         if any(_ref_overlaps(r, column) for r in refs):
             out.append(f.name)

@@ -39,7 +39,7 @@ def _check_dependent_columns(schema, configuration, column: str,
     `.constraintDependentColumnChange`)."""
     from delta_tpu.colgen import _ref_overlaps, generated_dependents
     from delta_tpu.constraints import CONSTRAINT_PREFIX
-    from delta_tpu.expressions.parser import parse_expression
+    from delta_tpu.expressions.parser import ParseError, parse_expression
 
     deps = generated_dependents(schema, column)
     if deps:
@@ -53,7 +53,7 @@ def _check_dependent_columns(schema, configuration, column: str,
         try:
             refs = {".".join(r)
                     for r in parse_expression(expr).references()}
-        except Exception:
+        except ParseError:
             continue
         if any(_ref_overlaps(r, column) for r in refs):
             raise SchemaEvolutionError(

@@ -69,6 +69,9 @@ def add_constraint(table, name: str, expr) -> int:
         probe = (evaluate_host(expr, _empty_batch(meta))
                  if meta.schema is not None else None)
         probe_type = getattr(probe, "type", None)
+    # delta-lint: disable=except-swallow (audited: the probe evaluates an
+    # arbitrary user expression on an empty batch — any failure means
+    # "cannot type statically" and per-row validation decides instead)
     except Exception:
         probe_type = None  # unevaluable-on-empty: row validation decides
     if probe_type is not None and probe_type != pa.bool_():

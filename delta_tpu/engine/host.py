@@ -189,6 +189,9 @@ def _configure_arrow_pool() -> None:
             _pa.set_cpu_count(n)
         if _pa.io_thread_count() < n:
             _pa.set_io_thread_count(n)
+    # delta-lint: disable=except-swallow (audited: pool sizing is an
+    # optimization probed at engine construction — any pyarrow API drift
+    # must leave the default pools, never fail engine startup)
     except Exception:
         pass
 

@@ -55,6 +55,9 @@ def _configure_compilation_cache() -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # delta-lint: disable=except-swallow (audited: the jax config surface
+    # varies across versions; the compile cache is an optimization and
+    # must never fail engine construction)
     except Exception:
         pass  # cache is an optimization; never fail engine construction
 

@@ -8,9 +8,12 @@ ChecksumHook (`.crc` per version). Custom hooks register process-wide via
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, List
 
 from delta_tpu.config import CHECKPOINT_INTERVAL, get_table_config, settings
+
+_log = logging.getLogger(__name__)
 
 Hook = Callable[..., None]  # (table, txn, version, metadata)
 
@@ -27,8 +30,9 @@ def _snapshot_for_hook(table, version: int):
         snap = table.update()
         if snap.version == version:
             return snap
-    except Exception:
-        pass
+    except Exception as e:
+        _log.debug("update() fast path failed for hook snapshot at "
+                   "version %d (%s); rebuilding via snapshot_at", version, e)
     return table.snapshot_at(version)
 
 

@@ -17,6 +17,7 @@ normal `Table` stack.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import time
@@ -87,8 +88,8 @@ class HttpTransport:
                     detail = ""
                     try:
                         detail = e.read().decode(errors="replace")[:500]
-                    except Exception:
-                        pass
+                    except (OSError, http.client.HTTPException):
+                        pass  # body unreadable: raise without detail
                     raise SharingError(
                         error_class="DELTA_SHARING_SERVER_ERROR",
                         message=f"sharing server returned HTTP {e.code} for "

@@ -34,7 +34,7 @@ from delta_tpu.config import (
     get_table_config,
     settings,
 )
-from delta_tpu.errors import ChecksumMismatchError
+from delta_tpu.errors import ChecksumMismatchError, InvalidArgumentError
 from delta_tpu.log.last_checkpoint import LastCheckpointInfo, write_last_checkpoint
 from delta_tpu.models.actions import Sidecar
 from delta_tpu.replay.columnar import DV_STRUCT_TYPE
@@ -152,8 +152,8 @@ def _stats_parsed_schema(schema, configuration,
             continue
         try:
             arrow_t = to_arrow_type(dt)
-        except Exception:
-            continue
+        except (ValueError, InvalidArgumentError):
+            continue  # unmappable type: no stats column for it
         insert(null_tree, path, pa.int64())
         if dt.name != "binary":
             insert(minmax_tree, path, arrow_t)
@@ -223,12 +223,12 @@ def _parse_stats_structs(
                 parse_options=pa_json.ParseOptions(
                     explicit_schema=explicit_schema,
                     unexpected_field_behavior="ignore"))
-        except Exception:
-            parsed = None
+        except (pa.ArrowException, ValueError, OSError):
+            parsed = None  # schema mismatch: retry with inference below
     if parsed is None:
         try:
             parsed = pa_json.read_json(pa.BufferReader(buf))
-        except Exception:
+        except (pa.ArrowException, ValueError, OSError):
             return None  # malformed stats: skip the struct form entirely
     if parsed.num_rows != len(stats_col):
         return None
@@ -497,7 +497,7 @@ def write_checkpoint(engine, snapshot, policy: Optional[str] = None) -> LastChec
 def _file_size(engine, path: str) -> Optional[int]:
     try:
         return engine.fs.file_status(path).size
-    except Exception:
+    except OSError:
         return None
 
 

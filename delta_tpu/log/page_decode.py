@@ -456,5 +456,8 @@ def read_checkpoint_part_hybrid(path: str, device=None):
         return tbl.set_column(add_idx, "add", new_add)
     except DecodeUnsupported:
         return None
+    # delta-lint: disable=except-swallow (audited: the native decoder is
+    # an accelerator with a byte-identical Arrow fallback — any surprise
+    # must select the fallback, never fail the read)
     except Exception:
         return None  # any surprise -> Arrow fallback, never a failure

@@ -66,7 +66,8 @@ def _build(allow_compile: bool = True) -> Optional[str]:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, lib_path)  # atomic: racing builders both succeed
         return lib_path
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
+        # g++ missing/failed/timed out: pure-python paths take over
         try:
             os.unlink(tmp)
         except OSError:
@@ -89,6 +90,10 @@ def load(allow_compile: bool = True) -> Optional[ctypes.CDLL]:
         if os.environ.get("DELTA_TPU_DISABLE_NATIVE"):
             _TRIED = True
             return None
+        # delta-lint: disable=lock-io (audited: the double-checked once-
+        # only compile MUST hold the lock across g++ so concurrent first
+        # callers don't race duplicate builds; all later calls hit the
+        # _LIB/_TRIED fast path above without the lock)
         path = _build(allow_compile)
         if path is None:
             # only a definitive failure (compile attempted) is final
@@ -178,6 +183,9 @@ class _NativeScanHandle:
     def __del__(self):
         try:
             self._lib.das_free(self._h)
+        # delta-lint: disable=except-swallow (audited: __del__ runs at
+        # arbitrary points incl. interpreter shutdown where the ctypes
+        # lib may be half-torn-down; raising or logging here is unsafe)
         except Exception:
             pass
 
@@ -194,6 +202,8 @@ class _NativeReadHandle:
     def __del__(self):
         try:
             self._lib.dar_free(self._h)
+        # delta-lint: disable=except-swallow (audited: same __del__
+        # shutdown-safety contract as _NativeScanHandle)
         except Exception:
             pass
 

@@ -679,8 +679,8 @@ class ParsedSpan:
 def _span_nbytes(block: pa.Table, others: list) -> int:
     try:
         b = block.get_total_buffer_size()
-    except Exception:
-        b = block.nbytes
+    except (AttributeError, NotImplementedError):
+        b = block.nbytes  # older pyarrow without the buffer-level API
     return int(b) + 256 * len(others)
 
 
@@ -865,7 +865,7 @@ def columnarize_log_segment(
         try:
             yield from engine.parquet.read_parquet_files(
                 [path], columns=list(SMALL_ACTION_COLUMNS))
-        except Exception:
+        except (pa.ArrowException, KeyError, ValueError):
             # part lacks some small column (e.g. a multipart tail part
             # written by another engine): fall back to a full read
             yield from engine.parquet.read_parquet_files([path])

@@ -8,6 +8,7 @@ when the version is unchanged.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Optional
 
 from delta_tpu.log.segment import LogSegment
@@ -18,6 +19,8 @@ from delta_tpu.replay.state import (
     reconstruct_small_state,
     reconstruct_state,
 )
+
+_log = logging.getLogger(__name__)
 
 
 class Snapshot:
@@ -84,7 +87,11 @@ class Snapshot:
             try:
                 crc = read_checksum(self._engine.fs, self._table.log_path,
                                     self.version)
-            except Exception:
+            except Exception as e:
+                # the .crc is an accelerator: unreadable/corrupt means
+                # fall back to log replay, never fail the read
+                _log.debug("checksum read failed at version %d (%s); "
+                           "using log replay", self.version, e)
                 crc = None
             if crc is not None:
                 from delta_tpu.config import IN_COMMIT_TIMESTAMPS, get_table_config
@@ -250,7 +257,7 @@ class Snapshot:
             path = filenames.delta_file(self._table.log_path, v)
             try:
                 mtime = fs.file_status(path).modification_time
-            except Exception:
+            except OSError:
                 mtime = int(time.time() * 1000)
             files.append(FileStatus(path, len(data), mtime))
             last_ts = max(last_ts, mtime)

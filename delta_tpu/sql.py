@@ -49,7 +49,7 @@ import re
 from typing import Optional
 
 from delta_tpu.errors import CatalogTableError, DeltaError, DuplicateColumnError, SqlParseError, UnresolvedColumnError
-from delta_tpu.expressions.parser import parse_expression
+from delta_tpu.expressions.parser import ParseError, parse_expression
 from delta_tpu.table import Table
 
 _PATH = (r"(?:'(?P<path>[^']+)'|delta\.`(?P<path2>[^`]+)`|\"(?P<path3>[^\"]+)\""
@@ -626,7 +626,7 @@ def _simple_select(s: str, engine, catalog):
             return NotImplemented
         try:
             pred = parse_expression(m.group("where"))
-        except Exception:
+        except ParseError:
             return NotImplemented  # richer predicate → sqlengine
     else:
         pred = None

@@ -43,11 +43,12 @@ def footer_stats(
     """Stats JSON for one existing Parquet file, from its footer only.
     Returns None when the footer is unreadable (caller converts the file
     without stats)."""
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
     try:
         md = pq.ParquetFile(parquet_path).metadata
-    except Exception:
+    except (OSError, pa.ArrowException, ValueError):
         return None
 
     stats: dict = {"numRecords": md.num_rows}

@@ -33,6 +33,7 @@ generation-precondition subset of the GCS JSON API.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 import urllib.parse
@@ -47,6 +48,8 @@ from delta_tpu.storage.logstore import (
     FileStatus,
     LogStore,
 )
+
+_log = logging.getLogger(__name__)
 
 Transport = Callable[[str, str, Dict[str, str], Optional[bytes]],
                      Tuple[int, Dict[str, str], bytes]]
@@ -534,10 +537,11 @@ class ExternalArbiterLogStore(DelegatingLogStore):
                 self._write_copy_temp_file(entry.absolute_temp_path(), path)
                 # Step 4: ACKNOWLEDGE
                 self._write_put_complete_entry(entry)
-            except Exception:
+            except Exception as e:
                 # recoverable: we own E(N); any reader/writer will finish
                 # the copy+ack via fix_delta_log
-                pass
+                _log.warning("commit %s prepared but copy/ack failed "
+                             "(%s); recovery via fix_delta_log", path, e)
         finally:
             lk.release()
 

@@ -23,6 +23,8 @@ from delta_tpu.errors import DeltaError
 class SchemaEvolutionRequiresRestart(DeltaError):
     """The source persisted a new schema; restart the stream to adopt it."""
 
+    error_class = "DELTA_STREAMING_METADATA_EVOLUTION"
+
 
 @dataclass
 class PersistedMetadata:

@@ -15,6 +15,7 @@ same contract as the reference.
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, field
 from typing import ClassVar, Iterator, List, Optional
 
@@ -30,6 +31,8 @@ from delta_tpu.models.actions import (
     actions_from_commit_bytes,
 )
 from delta_tpu.utils import filenames
+
+_log = logging.getLogger(__name__)
 
 BASE_INDEX = -1  # offset index meaning "before any file of this version"
 END_INDEX = -2   # (reference END_INDEX analog: version fully consumed)
@@ -137,8 +140,8 @@ class _ExpiryGuard:
             self.table.engine.fs.file_status(
                 fn.delta_file(self.table.log_path, v))
             return True
-        except Exception:
-            return False
+        except OSError:
+            return False  # missing/unreadable: treat as expired
 
     def check(self, v: int) -> None:
         from delta_tpu.log.last_checkpoint import read_last_checkpoint
@@ -147,8 +150,8 @@ class _ExpiryGuard:
             try:
                 hint = read_last_checkpoint(self.table.engine.fs,
                                             self.table.log_path)
-            except Exception:
-                hint = None
+            except OSError:
+                hint = None  # parse errors return None inside already
             if hint is None or hint.version < v:
                 return
             self._verified_pending = None  # re-verify below
@@ -156,8 +159,11 @@ class _ExpiryGuard:
         poll = getattr(self.table, "update", None) or self.table.latest_snapshot
         try:
             segment = poll().log_segment
-        except Exception:
-            return  # can't list — treat as caught up, retry next poll
+        except Exception as e:
+            # can't list — treat as caught up, retry next poll
+            _log.debug("expiry-guard poll failed (%s); retrying next "
+                       "trigger", e)
+            return
         if segment.version < v:
             self._verified_pending = v
             return
@@ -191,7 +197,7 @@ class _ExpiryGuard:
             if hint is not None:
                 ckpt_v = max(ckpt_v if ckpt_v is not None else -1,
                              hint.version)
-        except Exception:
+        except OSError:
             # can't read the hint: a covering checkpoint may exist, so
             # do not escalate to the non-retryable corruption verdict
             hole_certain = False
@@ -369,8 +375,12 @@ class DeltaSource:
             if self._starting_version is not None:
                 try:
                     baseline = self.table.snapshot_at(self._starting_version)
-                except Exception:
-                    baseline = snap  # version expired: best effort
+                except Exception as e:
+                    # version expired: best effort
+                    _log.debug("baseline snapshot_at(%d) failed (%s); "
+                               "using start snapshot schema",
+                               self._starting_version, e)
+                    baseline = snap
             self._tracked_schema = baseline.metadata.schemaString
         if self._starting_version is not None:
             # start tailing from a version: no initial snapshot
@@ -643,8 +653,11 @@ class DeltaCDCSource:
         if starting_version is not None:
             try:
                 base = table.snapshot_at(starting_version)
-            except Exception:
-                base = snap  # expired version: best effort
+            except Exception as e:
+                # expired version: best effort
+                _log.debug("CDC baseline snapshot_at(%d) failed (%s); "
+                           "using latest schema", starting_version, e)
+                base = snap
         else:
             base = snap
         self._baseline_schema = base.metadata.schemaString

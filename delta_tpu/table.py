@@ -8,6 +8,7 @@ caching + update()).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
@@ -17,6 +18,8 @@ from delta_tpu.log.last_checkpoint import read_last_checkpoint
 from delta_tpu.log.segment import build_log_segment
 from delta_tpu.snapshot import Snapshot
 from delta_tpu.utils import filenames
+
+_log = logging.getLogger(__name__)
 
 
 class Table:
@@ -36,6 +39,9 @@ class Table:
         try:
             build_log_segment(self.engine.fs, self.log_path)
             return True
+        # delta-lint: disable=except-swallow (audited: the contract is
+        # "is there a readable Delta table here" — a missing log dir and
+        # a malformed one both answer no, whatever the exception type)
         except Exception:
             return False
 
@@ -77,7 +83,9 @@ class Table:
         unbackfilled `_commits/` files, when the table uses one."""
         try:
             meta_conf = probe.metadata.configuration
-        except Exception:
+        except Exception as e:
+            _log.debug("metadata probe failed while merging unbackfilled "
+                       "commits (%s); using listed segment", e)
             return segment
         from delta_tpu.coordinatedcommits import coordinator_for_table
 
@@ -154,8 +162,11 @@ class Table:
             with self._lock:
                 if self._cached_snapshot is cached:
                     self._cached_snapshot = advanced
-        except Exception:
-            pass
+        except Exception as e:
+            # the handoff is purely an optimization: the next update()
+            # rebuilds from the log if the delta-replay advance failed
+            _log.debug("post-commit snapshot advance to version %d "
+                       "failed (%s); next update() will list", version, e)
 
     def snapshot_at(self, version: int) -> Snapshot:
         hint = read_last_checkpoint(self.engine.fs, self.log_path)
@@ -167,8 +178,10 @@ class Table:
                 target_version=version,
                 checkpoint_hint=cp_hint,
             )
-        except Exception:
+        except Exception as e:
             # hint past target or cleaned log — retry with full listing
+            _log.debug("hinted listing for version %d failed (%s); "
+                       "retrying without checkpoint hint", version, e)
             segment = build_log_segment(
                 self.engine.fs, self.log_path, target_version=version, checkpoint_hint=None
             )
@@ -216,8 +229,9 @@ class Table:
         # point (reference recomputes the checksum from the snapshot too)
         try:
             write_checksum_from_state(self.engine, self.log_path, snap.state)
-        except Exception:
-            pass  # the checksum is an accelerator, never a failure cause
+        except Exception as e:
+            # the checksum is an accelerator, never a failure cause
+            _log.debug("checksum reseed after checkpoint failed: %s", e)
 
     def history(self, limit: Optional[int] = None):
         from delta_tpu.history import get_history

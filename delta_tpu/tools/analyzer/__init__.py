@@ -1,0 +1,37 @@
+"""delta-lint: project-native static analysis for delta-tpu.
+
+An AST-based multi-pass analyzer that understands *this* codebase's
+invariants, in the role scalastyle + compile-time checks play for the
+JVM reference implementation:
+
+- ``lock-order`` / ``lock-io`` / ``global-mutation`` — lock-discipline
+  race detector over the optimistic-concurrency path
+  (:mod:`delta_tpu.tools.analyzer.passes.locks`);
+- ``jit-impure`` / ``jit-sync`` — purity lint for every function
+  reachable from a ``jax.jit`` / ``pallas_call`` decoration site
+  (:mod:`delta_tpu.tools.analyzer.passes.purity`);
+- ``error-uncataloged`` / ``error-dead-entry`` / ``error-untyped-raise``
+  — two-way conformance between raise sites and
+  ``resources/error_classes.json``
+  (:mod:`delta_tpu.tools.analyzer.passes.errors_catalog`);
+- ``except-swallow`` / ``mutable-default`` — exception hygiene
+  (:mod:`delta_tpu.tools.analyzer.passes.hygiene`);
+- ``undefined-name`` — module-level name resolution
+  (:mod:`delta_tpu.tools.analyzer.passes.imports`).
+
+Run it as ``python -m delta_tpu.tools.analyzer delta_tpu/`` (or the
+``delta-lint`` console script), suppress audited false positives with
+``# delta-lint: disable=RULE`` comments, and see
+``docs/static_analysis.md`` for the rule catalog and plugin API.
+"""
+
+from delta_tpu.tools.analyzer.core import (  # noqa: F401
+    Finding,
+    ModuleInfo,
+    Report,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_sources,
+    register,
+)

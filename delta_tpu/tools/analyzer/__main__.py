@@ -1,0 +1,8 @@
+"""``python -m delta_tpu.tools.analyzer`` entry point."""
+
+import sys
+
+from delta_tpu.tools.analyzer.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

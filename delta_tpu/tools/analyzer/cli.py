@@ -1,0 +1,77 @@
+"""Command-line front end: ``python -m delta_tpu.tools.analyzer`` /
+the ``delta-lint`` console script.
+
+Exit status: 0 when the unsuppressed-findings list is empty, 1 when
+any rule fired, 2 on usage errors — so CI can gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from delta_tpu.tools.analyzer.core import all_rules, analyze_paths
+from delta_tpu.tools.analyzer.report import render_json, render_text
+
+
+def _default_target() -> str:
+    """The installed delta_tpu package itself."""
+    import delta_tpu
+
+    return os.path.dirname(os.path.abspath(delta_tpu.__file__))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="delta-lint",
+        description="delta-tpu project-native static analysis "
+                    "(lock discipline, JAX purity, error-catalog "
+                    "conformance, exception hygiene, undefined names)")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to scan "
+                        "(default: the delta_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format (json is SARIF-lite)")
+    p.add_argument("--rules",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by "
+                        "`# delta-lint: disable=...` pragmas")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, cls in sorted(all_rules().items()):
+            print(f"{rule_id}: {cls.description or cls.__doc__ or ''}"
+                  .strip())
+        return 0
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"delta-lint: no such path: {p}", file=sys.stderr)
+            return 2
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        report = analyze_paths(paths, rules=rules)
+    except ValueError as e:  # unknown rule id
+        print(f"delta-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(render_json(report))
+    else:
+        print(render_text(report, verbose=args.show_suppressed))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    sys.exit(main())

@@ -1,0 +1,197 @@
+"""delta-lint core: module model, rule plugin registry, analysis engine.
+
+The engine runs in three passes, so project-wide rules (lock-order
+cycles, catalog conformance) see every module before they report:
+
+1. **load** — read + parse every target file once into a
+   :class:`ModuleInfo` (AST, source lines, suppression pragmas);
+2. **module pass** — each rule's :meth:`Rule.check_module` runs per
+   file (purely local rules live entirely here);
+3. **project pass** — each rule's :meth:`Rule.check_project` runs once
+   over all modules (rules typically accumulate facts during the module
+   pass and cross-reference them here).
+
+Adding a rule: subclass :class:`Rule`, set ``id``/``description``,
+implement either hook, decorate with :func:`register`, and import the
+module from ``passes/__init__.py``. Fixture-test it in
+``tests/test_analyzer.py`` (every rule must both fire on its positive
+fixture and stay silent on its negative one).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Type
+
+from delta_tpu.tools.analyzer.suppress import is_suppressed, parse_suppressions
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. `line`/`col` are 1-based / 0-based like CPython
+    AST nodes. `severity` is "error" or "warning" (both fail the run;
+    the split only drives reporting)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+
+
+class ModuleInfo:
+    """One parsed target file plus its suppression pragmas."""
+
+    def __init__(self, path: str, source: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = rel if rel is not None else path
+        self.source = source
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(source, path)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        self.suppress_lines, self.suppress_file = parse_suppressions(source)
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        return is_suppressed(rule_id, line, self.suppress_lines,
+                             self.suppress_file)
+
+
+class Rule:
+    """Plugin base. Stateless across runs: the engine instantiates a
+    fresh rule object per analysis, so instance attributes are safe
+    scratch space for module-pass fact accumulation."""
+
+    id: str = "?"
+    description: str = ""
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, mods: List[ModuleInfo]) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the global registry."""
+    if cls.id in _REGISTRY and _REGISTRY[cls.id] is not cls:
+        raise ValueError(f"duplicate delta-lint rule id: {cls.id!r}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    """id -> rule class for every registered rule (imports the bundled
+    passes on first use so the registry is populated)."""
+    import delta_tpu.tools.analyzer.passes  # noqa: F401  (registers)
+
+    return dict(_REGISTRY)
+
+
+@dataclass
+class Report:
+    findings: List[Finding]          # unsuppressed — these fail the run
+    suppressed: List[Finding]        # matched a disable pragma
+    files_scanned: int
+    rules_run: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+# --------------------------------------------------------------- collection
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def load_modules(paths: Iterable[str],
+                 root: Optional[str] = None) -> List[ModuleInfo]:
+    mods: List[ModuleInfo] = []
+    for p in paths:
+        for fp in _iter_py_files(p):
+            with open(fp, encoding="utf-8") as f:
+                source = f.read()
+            rel = os.path.relpath(fp, root) if root else fp
+            mods.append(ModuleInfo(fp, source, rel=rel))
+    return mods
+
+
+# ------------------------------------------------------------------ engine
+
+
+def _run(mods: List[ModuleInfo],
+         rule_ids: Optional[Iterable[str]] = None) -> Report:
+    registry = all_rules()
+    ids = list(rule_ids) if rule_ids is not None else sorted(registry)
+    unknown = [i for i in ids if i not in registry]
+    if unknown:
+        raise ValueError(f"unknown delta-lint rule(s): {unknown}; "
+                         f"known: {sorted(registry)}")
+    rules = [registry[i]() for i in ids]
+
+    raw: List[Finding] = []
+    for mod in mods:
+        if mod.syntax_error is not None:
+            e = mod.syntax_error
+            raw.append(Finding("parse-error", mod.rel, e.lineno or 1, 0,
+                               f"syntax error: {e.msg}"))
+            continue
+        for rule in rules:
+            raw.extend(rule.check_module(mod))
+    parsed = [m for m in mods if m.tree is not None]
+    for rule in rules:
+        raw.extend(rule.check_project(parsed))
+
+    by_rel = {m.rel: m for m in mods}
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings=findings, suppressed=suppressed,
+                  files_scanned=len(mods), rules_run=ids)
+
+
+def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
+                  rules: Optional[Iterable[str]] = None) -> Report:
+    """Analyze every ``.py`` file under `paths` (files or directories)."""
+    return _run(load_modules(paths, root=root), rules)
+
+
+def analyze_sources(sources: Dict[str, str],
+                    rules: Optional[Iterable[str]] = None) -> Report:
+    """Analyze in-memory sources (virtual path -> source text) — the
+    fixture-test entry point."""
+    mods = [ModuleInfo(path, src) for path, src in sources.items()]
+    return _run(mods, rules)

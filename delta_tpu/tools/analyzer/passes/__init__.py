@@ -1,0 +1,10 @@
+"""Bundled delta-lint passes. Importing this package registers every
+rule; add new rule modules to the import list below."""
+
+from delta_tpu.tools.analyzer.passes import (  # noqa: F401
+    errors_catalog,
+    hygiene,
+    imports,
+    locks,
+    purity,
+)

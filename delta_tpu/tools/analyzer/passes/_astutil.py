@@ -1,0 +1,61 @@
+"""Shared AST helpers for delta-lint passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c"; `name` -> "name"; anything else -> None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+def iter_functions(
+        tree: ast.Module) -> Iterator[Tuple[str, Optional[str], ast.AST]]:
+    """Yield (qualname, class_name, funcdef) for every function in the
+    module: module-level functions, methods one class deep, and nothing
+    nested inside other functions (those are handled by whoever walks
+    the enclosing function's body)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, None, node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{item.name}", node.name, item
+
+
+def build_function_table(
+        tree: ast.Module) -> Dict[str, ast.AST]:
+    """qualname -> def node, for intra-module call resolution."""
+    return {qn: fn for qn, _cls, fn in iter_functions(tree)}
+
+
+def resolve_local_call(name: str, cls: Optional[str],
+                       table: Dict[str, ast.AST]) -> Optional[str]:
+    """Resolve a call's dotted name to a qualname in `table`:
+    `helper()` -> "helper"; `self.m()` / `cls.m()` inside class C ->
+    "C.m"; `C.m()` -> "C.m". Returns None for anything unresolvable
+    (imported modules, attribute chains on objects)."""
+    if name in table:
+        return name
+    head, _, rest = name.partition(".")
+    if rest and "." not in rest:
+        if head in ("self", "cls") and cls is not None:
+            qn = f"{cls}.{rest}"
+            return qn if qn in table else None
+        qn = f"{head}.{rest}"
+        return qn if qn in table else None
+    return None

@@ -1,0 +1,118 @@
+"""Exception hygiene.
+
+- ``except-swallow`` — an ``except Exception:`` / bare ``except:`` /
+  ``except BaseException:`` handler that swallows silently: it neither
+  re-raises, nor uses the bound exception, nor logs/warns. Every such
+  handler either gets narrowed to the exceptions the fallback is
+  actually for, gains a ``logging`` breadcrumb, or carries an audited
+  ``# delta-lint: disable=except-swallow`` pragma explaining why
+  anything-goes is correct there (e.g. "never fail the commit for a
+  post-commit accelerator").
+- ``mutable-default`` — a mutable default argument (``def f(x=[])`` /
+  ``={}`` / ``=set()``): the single most classic shared-state bug in
+  long-running Python services; the default is evaluated once and
+  shared by every call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_HEADS = ("logging", "logger", "log", "_log", "warnings", "traceback")
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log", "print_exc", "format_exc"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=e, name=None, body=[]))
+                   for e in t.elts)
+    return False
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the body neither re-raises, uses the bound exception,
+    nor logs."""
+    bound = handler.name
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return False
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return False
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            head = name.split(".", 1)[0]
+            tail = name.rpartition(".")[2]
+            if name == "print" or head in _LOG_HEADS \
+                    or tail in _LOG_METHODS:
+                return False
+    return True
+
+
+@register
+class ExceptSwallowRule(Rule):
+    id = "except-swallow"
+    description = ("broad `except Exception`/bare `except` that "
+                   "silently swallows: no re-raise, no use of the "
+                   "exception, no logging")
+
+    def check_module(self, mod: ModuleInfo):
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                    and _handler_swallows(node):
+                what = ("bare except" if node.type is None else
+                        "except Exception" if getattr(
+                            node.type, "id", getattr(
+                                node.type, "attr", "")) == "Exception"
+                        else "except BaseException")
+                findings.append(Finding(
+                    self.id, mod.rel, node.lineno, node.col_offset,
+                    f"{what} swallows silently — narrow it to the "
+                    f"exceptions the fallback is for, log the error, or "
+                    f"audit + suppress"))
+        return findings
+
+
+_MUTABLE_CALLS = {"list", "dict", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter"}
+
+
+@register
+class MutableDefaultRule(Rule):
+    id = "mutable-default"
+    description = "mutable default argument (def f(x=[]) / ={} / =set())"
+
+    def check_module(self, mod: ModuleInfo):
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and (call_name(d) or "").rpartition(".")[2]
+                    in _MUTABLE_CALLS)
+                if mutable:
+                    findings.append(Finding(
+                        self.id, mod.rel, d.lineno, d.col_offset,
+                        f"mutable default argument in {node.name}() — "
+                        f"evaluated once and shared across calls; use "
+                        f"None + in-body default"))
+        return findings

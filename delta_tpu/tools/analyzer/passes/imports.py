@@ -1,0 +1,75 @@
+"""Undefined-name pass.
+
+Absorbs (and extends to every scan target) the symtable check that
+``tests/test_module_imports.py`` introduced after the r05
+``_check_create_spec_matches`` gap: a name a function scope resolves as
+GLOBAL must be bound at module level (imports, defs, assignments —
+``symtable`` records bindings from every branch, so conditional imports
+count) or be a builtin. This is exactly the class of bug where a helper
+is called but never defined and only explodes when that code path runs.
+
+Modules using ``from x import *`` are skipped (module-level bindings
+are not statically enumerable there).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+from typing import List
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+
+_BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__annotations__", "__class__",
+    "__debug__", "__path__", "WindowsError",
+}
+
+
+@register
+class UndefinedNameRule(Rule):
+    id = "undefined-name"
+    description = ("function references a module-level name that is "
+                   "bound nowhere (missing import / undefined helper)")
+
+    def check_module(self, mod: ModuleInfo):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and any(a.name == "*" for a in node.names):
+                return ()  # star-import: bindings not enumerable
+        try:
+            table = symtable.symtable(mod.source, mod.path, "exec")
+        except SyntaxError:  # reported by the engine as parse-error
+            return ()
+        module_names = set(table.get_identifiers())
+        findings: List[Finding] = []
+
+        def line_of(name: str, scope_name: str) -> int:
+            """Best-effort source line for the reference (symtable has
+            no position info): first line mentioning the name inside
+            the named function if findable, else the first mention."""
+            for lineno, line in enumerate(mod.source.splitlines(),
+                                          start=1):
+                if name in line and not line.lstrip().startswith("#"):
+                    return lineno
+            return 1
+
+        def walk(t):
+            if t.get_type() == "function":
+                for sym in t.get_symbols():
+                    if (sym.is_referenced() and sym.is_global()
+                            and not sym.is_assigned()
+                            and sym.get_name() not in module_names
+                            and sym.get_name() not in _BUILTINS):
+                        findings.append(Finding(
+                            self.id, mod.rel,
+                            line_of(sym.get_name(), t.get_name()), 0,
+                            f"{t.get_name()}() references undefined "
+                            f"module-level name {sym.get_name()!r}"))
+            for child in t.get_children():
+                walk(child)
+
+        walk(table)
+        return findings

@@ -1,0 +1,519 @@
+"""Lock-discipline / race detector.
+
+Three rules over one statically-built lock model:
+
+- ``lock-order`` — builds a project-wide lock-acquisition graph (an
+  edge A -> B means "some code path acquires B while holding A", either
+  by lexical nesting or through a same-module call made inside the
+  ``with A:`` block) and flags every edge that participates in a cycle
+  (inconsistent acquisition order = deadlock potential), plus
+  re-acquisition of a held non-reentrant ``threading.Lock``
+  (self-deadlock), directly or through a call chain;
+- ``lock-io`` — flags file/network I/O primitives invoked while any
+  lock is held (long I/O under a hot lock serializes the whole
+  optimistic-concurrency path; where mutual exclusion around the I/O
+  *is* the point — put-if-absent emulation, once-only native compile —
+  the site carries an audited ``# delta-lint: disable=lock-io``);
+- ``global-mutation`` — in modules that declare themselves concurrent
+  (they create at least one ``threading`` lock), flags mutation of
+  module-level mutable state from function bodies outside any
+  ``with <lock>:`` block.
+
+Lock identity is ``<module-stem>.<Class>.<attr>`` for instance locks
+(``self._lock = threading.Lock()`` in any method, dataclass
+``field(default_factory=threading.Lock)``, and the
+``self.__dict__.setdefault("x", threading.Lock())`` idiom),
+``<module-stem>.<NAME>`` for module globals, and a function-scoped name
+for locals bound to a fresh lock. Call resolution is same-module only
+(``helper()`` / ``self.method()`` / ``Class.method()``); cross-module
+cycles still surface because the acquisition graph itself is global.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.passes._astutil import (
+    build_function_table,
+    call_name,
+    dotted,
+    iter_functions,
+    resolve_local_call,
+)
+
+_LOCK_FACTORIES = {
+    "threading.Lock": False,       # value: reentrant?
+    "threading.RLock": True,
+    "threading.Condition": True,
+    "Lock": False,
+    "RLock": True,
+    "Condition": True,
+}
+
+_IO_PREFIXES = (
+    "os.", "shutil.", "subprocess.", "socket.", "urllib.", "requests.",
+    "http.client.",
+)
+_IO_EXEMPT = {
+    # pure path/string/env helpers that happen to live under os.*
+    "os.path.join", "os.path.dirname", "os.path.basename",
+    "os.path.splitext", "os.path.abspath", "os.path.normpath",
+    "os.path.relpath", "os.path.split", "os.path.exists", "os.fspath",
+    "os.environ.get", "os.getenv", "os.getpid", "os.cpu_count",
+}
+_IO_CALLS = {"open", "time.sleep"}
+
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "extendleft",
+    "move_to_end",
+}
+_MUTABLE_FACTORIES = {
+    "dict", "list", "set", "OrderedDict", "collections.OrderedDict",
+    "defaultdict", "collections.defaultdict", "deque",
+    "collections.deque", "Counter", "collections.Counter",
+}
+
+
+def _module_stem(rel: str) -> str:
+    stem = rel[:-3] if rel.endswith(".py") else rel
+    return stem.replace(os.sep, ".").replace("/", ".")
+
+
+def _lock_factory(node: ast.AST) -> Optional[bool]:
+    """If `node` constructs a lock, return its reentrancy, else None."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _LOCK_FACTORIES:
+            return _LOCK_FACTORIES[name]
+    return None
+
+
+@dataclass
+class _ModuleLocks:
+    mod: ModuleInfo
+    stem: str
+    locks: Dict[str, bool] = field(default_factory=dict)  # id -> reentrant
+    by_attr: Dict[Tuple[Optional[str], str], str] = field(
+        default_factory=dict)  # (Class|None, attr) -> lock id
+    mutable_globals: Set[str] = field(default_factory=set)
+
+    def define(self, cls: Optional[str], attr: str, reentrant: bool) -> str:
+        lock_id = (f"{self.stem}.{cls}.{attr}" if cls
+                   else f"{self.stem}.{attr}")
+        self.locks.setdefault(lock_id, reentrant)
+        self.by_attr.setdefault((cls, attr), lock_id)
+        return lock_id
+
+
+@dataclass
+class _Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    via: str  # "" for lexical nesting, else the callee qualname
+
+
+@dataclass
+class _FuncFacts:
+    mod_rel: str
+    direct_acquires: Set[str] = field(default_factory=set)
+    held_calls: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)  # (held locks, callee qualname, line)
+    callees: Set[str] = field(default_factory=set)
+    direct_io: Set[str] = field(default_factory=set)  # io call names
+
+
+def _collect_definitions(mod: ModuleInfo) -> _ModuleLocks:
+    ml = _ModuleLocks(mod, _module_stem(mod.rel))
+    tree = mod.tree
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            reentrant = _lock_factory(node.value)
+            if reentrant is not None:
+                ml.define(None, name, reentrant)
+            elif isinstance(node.value, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(node.value, ast.Call)
+                    and call_name(node.value) in _MUTABLE_FACTORIES):
+                ml.mutable_globals.add(name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for st in node.body:
+            if isinstance(st, ast.AnnAssign) \
+                    and isinstance(st.target, ast.Name) \
+                    and isinstance(st.value, ast.Call) \
+                    and call_name(st.value) in ("field",
+                                                "dataclasses.field"):
+                for kw in st.value.keywords:
+                    if kw.arg == "default_factory":
+                        factory = dotted(kw.value)
+                        if factory in _LOCK_FACTORIES:
+                            ml.define(node.name, st.target.id,
+                                      _LOCK_FACTORIES[factory])
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for st in ast.walk(item):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Attribute) \
+                        and isinstance(st.targets[0].value, ast.Name) \
+                        and st.targets[0].value.id == "self":
+                    reentrant = _lock_factory(st.value)
+                    if reentrant is not None:
+                        ml.define(node.name, st.targets[0].attr, reentrant)
+    return ml
+
+
+class _LockAnalysis:
+    """Shared lock model; built once per module set and cached so the
+    three thin rules don't re-walk the project."""
+
+    def __init__(self, mods: List[ModuleInfo]):
+        self.findings: List[Finding] = []
+        self.edges: List[_Edge] = []
+        self.facts: Dict[str, _FuncFacts] = {}
+        per_mod = {m.rel: _collect_definitions(m) for m in mods}
+        for mod in mods:
+            self._scan_module(per_mod[mod.rel])
+        self._propagate(per_mod)
+        self.findings.extend(self._cycle_findings())
+
+    # -- per-module scan ---------------------------------------------------
+
+    def _scan_module(self, ml: _ModuleLocks):
+        mod = ml.mod
+        table = build_function_table(mod.tree)
+        for qualname, cls, fn in iter_functions(mod.tree):
+            ff = _FuncFacts(mod.rel)
+            self.facts[f"{mod.rel}::{qualname}"] = ff
+            local_locks: Dict[str, Tuple[str, bool]] = {}
+            declared_global: Set[str] = set()
+            for st in ast.walk(fn):
+                if isinstance(st, ast.Global):
+                    declared_global.update(st.names)
+            self._seed_locals(fn, ml, cls, qualname, local_locks)
+            self._walk(fn.body, (), ml, cls, table, local_locks,
+                       declared_global, ff)
+
+    def _seed_locals(self, fn, ml, cls, qualname, local_locks):
+        for st in ast.walk(fn):
+            if not (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)):
+                continue
+            v = st.value
+            reentrant = _lock_factory(v)
+            if reentrant is not None:
+                lock_id = f"{ml.stem}.{qualname}.{st.targets[0].id}"
+                ml.locks.setdefault(lock_id, reentrant)
+                local_locks[st.targets[0].id] = (lock_id, reentrant)
+            elif isinstance(v, ast.Call) \
+                    and (call_name(v) or "").endswith(
+                        "__dict__.setdefault") \
+                    and len(v.args) == 2 \
+                    and isinstance(v.args[0], ast.Constant) \
+                    and _lock_factory(v.args[1]) is not None:
+                lock_id = ml.define(cls, str(v.args[0].value),
+                                    bool(_lock_factory(v.args[1])))
+                local_locks[st.targets[0].id] = (
+                    lock_id, bool(_lock_factory(v.args[1])))
+
+    def _resolve_lock(self, expr, ml: _ModuleLocks, cls, local_locks):
+        name = dotted(expr)
+        if name is None:
+            return None
+        if name in local_locks:
+            return local_locks[name]
+        head, _, rest = name.partition(".")
+        if not rest and (None, name) in ml.by_attr:
+            lock_id = ml.by_attr[(None, name)]
+            return lock_id, ml.locks[lock_id]
+        if head == "self" and rest and "." not in rest \
+                and (cls, rest) in ml.by_attr:
+            lock_id = ml.by_attr[(cls, rest)]
+            return lock_id, ml.locks[lock_id]
+        return None
+
+    def _walk(self, stmts, held, ml, cls, table, local_locks,
+              declared_global, ff: _FuncFacts):
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in st.items:
+                    self._scan_expr(item.context_expr, held, ml, cls,
+                                    table, ff)
+                    resolved = self._resolve_lock(item.context_expr, ml,
+                                                  cls, local_locks)
+                    if resolved is None:
+                        continue
+                    lock_id, reentrant = resolved
+                    ff.direct_acquires.add(lock_id)
+                    if lock_id in held and not reentrant:
+                        self.findings.append(Finding(
+                            "lock-order", ml.mod.rel, st.lineno,
+                            st.col_offset,
+                            f"non-reentrant lock {lock_id} acquired "
+                            f"while already held (self-deadlock)"))
+                        continue
+                    for h in held:
+                        if h != lock_id:
+                            self.edges.append(_Edge(h, lock_id,
+                                                    ml.mod.rel,
+                                                    st.lineno, ""))
+                    acquired.append(lock_id)
+                self._walk(st.body, held + tuple(acquired), ml, cls,
+                           table, local_locks, declared_global, ff)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs run later, under no held lock
+            else:
+                for expr in _stmt_exprs(st):
+                    self._scan_expr(expr, held, ml, cls, table, ff)
+                if not held and ml.locks:
+                    self._check_global_mutation(st, ml, declared_global)
+                for child_body in _sub_bodies(st):
+                    self._walk(child_body, held, ml, cls, table,
+                               local_locks, declared_global, ff)
+
+    def _scan_expr(self, expr, held, ml, cls, table, ff: _FuncFacts):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            callee = resolve_local_call(name, cls, table)
+            if callee is not None:
+                ff.callees.add(callee)
+                if held:
+                    ff.held_calls.append((held, callee, node.lineno))
+                continue
+            if _is_io(name):
+                ff.direct_io.add(name)
+                if held:
+                    self.findings.append(Finding(
+                        "lock-io", ml.mod.rel, node.lineno,
+                        node.col_offset,
+                        f"I/O call {name}() while holding lock "
+                        f"{held[-1]} (move the I/O outside the critical "
+                        f"section, or audit + suppress)"))
+
+    def _check_global_mutation(self, st, ml: _ModuleLocks,
+                               declared_global):
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        elif isinstance(st, ast.Delete):
+            targets = st.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id in ml.mutable_globals:
+                self.findings.append(Finding(
+                    "global-mutation", ml.mod.rel, st.lineno,
+                    st.col_offset,
+                    f"module-global {t.value.id!r} mutated outside any "
+                    f"lock in a module that uses threading locks"))
+            elif isinstance(t, ast.Name) and t.id in declared_global \
+                    and t.id in ml.mutable_globals:
+                self.findings.append(Finding(
+                    "global-mutation", ml.mod.rel, st.lineno,
+                    st.col_offset,
+                    f"module-global {t.id!r} rebound outside any lock "
+                    f"in a module that uses threading locks"))
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            name = call_name(st.value)
+            if name and "." in name:
+                head, _, method = name.rpartition(".")
+                if head in ml.mutable_globals and method in _MUTATORS:
+                    self.findings.append(Finding(
+                        "global-mutation", ml.mod.rel, st.lineno,
+                        st.col_offset,
+                        f"module-global {head!r}.{method}() outside any "
+                        f"lock in a module that uses threading locks"))
+
+    # -- propagation + cycles ----------------------------------------------
+
+    def _propagate(self, per_mod: Dict[str, _ModuleLocks]):
+        trans: Dict[str, Set[str]] = {
+            k: set(f.direct_acquires) for k, f in self.facts.items()}
+        trans_io: Dict[str, Set[str]] = {
+            k: set(f.direct_io) for k, f in self.facts.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, f in self.facts.items():
+                for callee in f.callees:
+                    ck = f"{f.mod_rel}::{callee}"
+                    if ck in trans and not trans[ck] <= trans[k]:
+                        trans[k] |= trans[ck]
+                        changed = True
+                    if ck in trans_io and not trans_io[ck] <= trans_io[k]:
+                        trans_io[k] |= trans_io[ck]
+                        changed = True
+        reentrant: Dict[str, bool] = {}
+        for ml in per_mod.values():
+            reentrant.update(ml.locks)
+        for k, f in self.facts.items():
+            for held, callee, line in f.held_calls:
+                ck = f"{f.mod_rel}::{callee}"
+                io_names = sorted(trans_io.get(ck, ()))
+                if io_names:
+                    self.findings.append(Finding(
+                        "lock-io", f.mod_rel, line, 0,
+                        f"call to {callee}() performs I/O "
+                        f"({', '.join(io_names[:3])}) while holding "
+                        f"lock {held[-1]}"))
+                for acquired in sorted(trans.get(ck, ())):
+                    if acquired in held:
+                        if not reentrant.get(acquired, True):
+                            self.findings.append(Finding(
+                                "lock-order", f.mod_rel, line, 0,
+                                f"call to {callee}() may re-acquire "
+                                f"non-reentrant lock {acquired} already "
+                                f"held here (self-deadlock)"))
+                        continue
+                    for h in held:
+                        self.edges.append(_Edge(h, acquired, f.mod_rel,
+                                                line, callee))
+
+    def _cycle_findings(self) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for e in self.edges:
+            graph.setdefault(e.src, set()).add(e.dst)
+            graph.setdefault(e.dst, set())
+        cyclic = [frozenset(s) for s in _tarjan(graph)
+                  if len(s) > 1
+                  or next(iter(s)) in graph.get(next(iter(s)), ())]
+        findings, seen = [], set()
+        for e in self.edges:
+            for scc in cyclic:
+                if e.src in scc and e.dst in scc \
+                        and (e.src, e.dst) not in seen:
+                    seen.add((e.src, e.dst))
+                    via = f" (via {e.via}())" if e.via else ""
+                    findings.append(Finding(
+                        "lock-order", e.path, e.line, 0,
+                        f"lock order cycle: acquires {e.dst} while "
+                        f"holding {e.src}{via}; another path acquires "
+                        f"them in the opposite order"))
+        return findings
+
+
+# single-entry cache: (mods list, analysis). The mods list is retained
+# so the id()-tuple key stays sound — holding the objects alive means a
+# later run's fresh ModuleInfos can never reuse their addresses and
+# falsely hit a stale analysis.
+_CACHE: List[Tuple[List[ModuleInfo], _LockAnalysis]] = []
+
+
+def _analysis(mods: List[ModuleInfo]) -> _LockAnalysis:
+    if _CACHE:
+        cached_mods, cached = _CACHE[0]
+        if len(cached_mods) == len(mods) \
+                and all(a is b for a, b in zip(cached_mods, mods)):
+            return cached
+    analysis = _LockAnalysis(mods)
+    _CACHE[:] = [(list(mods), analysis)]
+    return analysis
+
+
+class _LockRuleBase(Rule):
+    def check_project(self, mods):
+        return [f for f in _analysis(mods).findings if f.rule == self.id]
+
+
+@register
+class LockOrderRule(_LockRuleBase):
+    id = "lock-order"
+    description = ("inconsistent lock-acquisition order (cycle in the "
+                   "static lock graph) or re-acquisition of a held "
+                   "non-reentrant lock (self-deadlock)")
+
+
+@register
+class LockIoRule(_LockRuleBase):
+    id = "lock-io"
+    description = "file/network I/O performed while holding a lock"
+
+
+@register
+class GlobalMutationRule(_LockRuleBase):
+    id = "global-mutation"
+    description = ("module-level mutable state mutated outside any lock "
+                   "in a module that uses threading locks")
+
+
+def _is_io(name: str) -> bool:
+    if name in _IO_CALLS:
+        return True
+    if name in _IO_EXEMPT:
+        return False
+    return name.startswith(_IO_PREFIXES)
+
+
+def _sub_bodies(st) -> List[list]:
+    out = []
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(st, attr, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            out.append(b)
+    for h in getattr(st, "handlers", ()) or ():
+        out.append(h.body)
+    return out
+
+
+def _stmt_exprs(st) -> List[ast.AST]:
+    """Expressions evaluated by `st` itself (not nested statements)."""
+    out = []
+    for _name, value in ast.iter_fields(st):
+        vals = value if isinstance(value, list) else [value]
+        for v in vals:
+            if isinstance(v, ast.expr):
+                out.append(v)
+    return out
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out

@@ -1,0 +1,191 @@
+"""JAX purity lint.
+
+Jitted replay/SQL kernels are retraced from cache keys that only cover
+argument shapes/dtypes and static args — any host-side effect inside
+the traced region either silently freezes at trace time (``time.time``
+baked in as a constant, RNG drawn once) or breaks retracing. Two rules:
+
+- ``jit-impure`` — walks every function reachable from a ``jax.jit`` /
+  ``pl.pallas_call`` decoration or call site (including
+  ``functools.partial(jax.jit, ...)`` decorator forms and module-level
+  jit-wrapper aliases) and flags host impurities: wall-clock reads,
+  non-JAX RNG (``random.*`` / ``np.random.*`` — ``jax.random`` is fine),
+  file/process/network I/O, and mutation of closed-over or global state
+  (``global`` / ``nonlocal`` rebinds, ``self.x = ...`` stores);
+- ``jit-sync`` — host-synchronizing materialization in device code:
+  ``.item()`` / ``.tolist()`` inside jit-reachable functions, and
+  ``.block_until_ready()`` anywhere in library code (it belongs in
+  benchmarks, not the serving path).
+
+Call resolution is name-based within the module (an over-approximation:
+all same-named functions are considered reachable), which is the right
+trade-off for a lint — missing an alias would hide a real impurity.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.passes._astutil import call_name, dotted
+
+_JIT_NAMES = {"jax.jit", "jit", "pl.pallas_call", "pallas_call",
+              "pltpu.pallas_call", "jax.pmap", "pmap"}
+
+_IMPURE_EXACT = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "time.process_time", "time.sleep", "open", "input", "print",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "uuid.uuid4", "uuid.uuid1",
+}
+_IMPURE_PREFIXES = (
+    "random.", "np.random.", "numpy.random.", "secrets.", "shutil.",
+    "subprocess.", "socket.", "requests.", "urllib.", "os.",
+)
+_IMPURE_EXEMPT = {
+    # pure helpers under impure prefixes
+    "os.path.join", "os.path.dirname", "os.path.basename",
+    "os.path.splitext", "os.fspath", "os.environ.get", "os.getenv",
+}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+def _contains_jit_name(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        name = dotted(sub)
+        if name in _JIT_NAMES:
+            return True
+    return False
+
+
+class _PurityScan:
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.findings: List[Finding] = []
+        tree = mod.tree
+
+        # every function def in the module, by bare name (any nesting)
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        # module-level aliases wrapping jax.jit, e.g.
+        # _block_kernel = functools.partial(jax.jit, static_argnames=...)
+        aliases: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _contains_jit_name(node.value):
+                aliases.add(node.targets[0].id)
+
+        roots: List[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _contains_jit_name(dec) or dotted(dec) in aliases:
+                        roots.append(node)
+                        break
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if (name in _JIT_NAMES or name in aliases) and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in defs:
+                        roots.extend(defs[arg.id])
+
+        # reachability over name-based calls
+        reachable: List[ast.AST] = []
+        seen: Set[int] = set()
+        queue = list(roots)
+        while queue:
+            fn = queue.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reachable.append(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                tail = name.rpartition(".")[2]
+                if name in defs:
+                    queue.extend(defs[name])
+                elif name.startswith(("self.", "cls.")) and tail in defs:
+                    queue.extend(defs[tail])
+
+        emitted: Set[tuple] = set()
+
+        def emit(rule, node, msg):
+            key = (rule, node.lineno, node.col_offset, msg)
+            if key not in emitted:
+                emitted.add(key)
+                self.findings.append(Finding(
+                    rule, mod.rel, node.lineno, node.col_offset, msg))
+
+        for fn in reachable:
+            ctx = f"jit-reachable function {fn.name}()"
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if name and _is_impure(name):
+                        emit("jit-impure", node,
+                             f"host-impure call {name}() inside {ctx}")
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _SYNC_METHODS \
+                            and not node.args:
+                        emit("jit-sync", node,
+                             f".{node.func.attr}() host sync inside {ctx}")
+                elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                    emit("jit-impure", node,
+                         f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                         f" rebinding of {', '.join(node.names)} inside "
+                         f"{ctx} (traced code must not mutate host state)")
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            emit("jit-impure", node,
+                                 f"self.{t.attr} store inside {ctx} "
+                                 f"(traced code must not mutate host "
+                                 f"state)")
+
+        # block_until_ready: a benchmarking construct; flag anywhere
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                emit("jit-sync", node,
+                     ".block_until_ready() in library code (host sync "
+                     "belongs in benchmarks, not the serving path)")
+
+
+def _is_impure(name: str) -> bool:
+    if name in _IMPURE_EXACT:
+        return True
+    if name in _IMPURE_EXEMPT:
+        return False
+    return name.startswith(_IMPURE_PREFIXES)
+
+
+class _PurityRuleBase(Rule):
+    def check_module(self, mod: ModuleInfo):
+        return [f for f in _PurityScan(mod).findings if f.rule == self.id]
+
+
+@register
+class JitImpureRule(_PurityRuleBase):
+    id = "jit-impure"
+    description = ("host impurity (clock, RNG, I/O, state mutation) in "
+                   "a function reachable from jax.jit / pallas_call")
+
+
+@register
+class JitSyncRule(_PurityRuleBase):
+    id = "jit-sync"
+    description = (".item()/.tolist() in jit-reachable code or "
+                   ".block_until_ready() in library code")

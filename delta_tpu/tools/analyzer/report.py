@@ -1,0 +1,67 @@
+"""Reporters: human-readable text and SARIF-lite JSON.
+
+The JSON shape is a deliberately small subset of SARIF 2.1 (tool /
+results / ruleId / level / message / location) so CI systems that speak
+SARIF can ingest it with a trivial adapter, without this module taking
+on the full spec.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from delta_tpu.tools.analyzer.core import Finding, Report
+
+
+def render_text(report: Report, verbose: bool = False) -> str:
+    lines = []
+    for f in report.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+    if verbose:
+        for f in report.suppressed:
+            lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: "
+                         f"[suppressed] {f.message}")
+    counts = report.by_rule()
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+    lines.append(
+        f"delta-lint: {len(report.findings)} finding(s)"
+        + (f" ({summary})" if summary else "")
+        + f", {len(report.suppressed)} suppressed, "
+        f"{report.files_scanned} file(s), "
+        f"rules: {', '.join(report.rules_run)}")
+    return "\n".join(lines)
+
+
+def _result(f: Finding) -> Dict:
+    return {
+        "ruleId": f.rule,
+        "level": f.severity,
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line, "startColumn": f.col + 1},
+            },
+        }],
+    }
+
+
+def render_json(report: Report) -> str:
+    doc = {
+        "version": "2.1.0-lite",
+        "runs": [{
+            "tool": {"driver": {"name": "delta-lint",
+                                "rules": [{"id": r}
+                                          for r in report.rules_run]}},
+            "results": [_result(f) for f in report.findings],
+            "suppressedResults": [_result(f) for f in report.suppressed],
+            "summary": {
+                "findings": len(report.findings),
+                "suppressed": len(report.suppressed),
+                "filesScanned": report.files_scanned,
+                "byRule": report.by_rule(),
+            },
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True)

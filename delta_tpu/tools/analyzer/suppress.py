@@ -1,0 +1,61 @@
+"""Suppression comments for delta-lint.
+
+Two forms, both comments so they survive formatting and never affect
+runtime behavior:
+
+- line-scoped: ``# delta-lint: disable=RULE[,RULE2]`` on the line the
+  finding is reported at (for multi-line statements that is the first
+  line of the statement). Anything after the rule list is free-form
+  audit rationale and is encouraged:
+  ``with self._lock:  # delta-lint: disable=lock-io — put-if-absent``
+  A pragma on a standalone comment line applies to the next code line,
+  so multi-line audit rationale can sit between pragma and code.
+- file-scoped: ``# delta-lint: file-disable=RULE[,RULE2]`` anywhere in
+  the file (conventionally in the module docstring area) disables the
+  rules for the whole file.
+
+``disable=all`` matches every rule.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, FrozenSet, Tuple
+
+_LINE_RE = re.compile(
+    r"#\s*delta-lint:\s*(file-)?disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+def parse_suppressions(
+        source: str) -> Tuple[Dict[int, FrozenSet[str]], FrozenSet[str]]:
+    """Return (per-line rule sets keyed by 1-based lineno, file-level
+    rule set). Purely lexical: a pragma inside a string literal would
+    also count, which is fine for a lint suppression."""
+    per_line: Dict[int, FrozenSet[str]] = {}
+    file_level: set = set()
+    pending: FrozenSet[str] = frozenset()  # from standalone comment lines
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        stripped = line.strip()
+        comment_only = stripped.startswith("#")
+        m = _LINE_RE.search(line)
+        if m:
+            rules = frozenset(r.strip() for r in m.group(2).split(","))
+            if m.group(1):
+                file_level |= rules
+            elif comment_only:
+                pending |= rules  # applies to the next code line
+            else:
+                per_line[lineno] = per_line.get(lineno, frozenset()) | rules
+        if pending and stripped and not comment_only:
+            per_line[lineno] = per_line.get(lineno, frozenset()) | pending
+            pending = frozenset()
+    return per_line, frozenset(file_level)
+
+
+def is_suppressed(rule_id: str, line: int,
+                  per_line: Dict[int, FrozenSet[str]],
+                  file_level: FrozenSet[str]) -> bool:
+    if "all" in file_level or rule_id in file_level:
+        return True
+    rules = per_line.get(line)
+    return bool(rules) and ("all" in rules or rule_id in rules)

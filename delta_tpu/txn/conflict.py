@@ -24,6 +24,7 @@ read-state for the next round).
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Set
 
@@ -49,6 +50,8 @@ from delta_tpu.models.actions import (
 )
 from delta_tpu.txn.isolation import IsolationLevel
 from delta_tpu.utils import filenames
+
+_log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -141,14 +144,19 @@ def _matching_adds(adds: Sequence[AddFile],
                         evaluate_predicate_host(conj, pbatch),
                         dtype=bool)
                     alive &= res
-                except Exception:
-                    pass  # can't evaluate exactly -> widen to true
+                except Exception as e:
+                    # can't evaluate exactly -> widen to true (sound:
+                    # over-approximating visibility only adds conflicts)
+                    _log.debug("partition predicate unevaluable for "
+                               "conflict check, widening: %s", e)
             else:
                 try:
                     alive &= skipping_mask(stats_files, [conj],
                                            state.metadata)
-                except Exception:
-                    pass  # unevaluable -> widen to true
+                except Exception as e:
+                    # unevaluable -> widen to true (same soundness)
+                    _log.debug("stats predicate unevaluable for "
+                               "conflict check, widening: %s", e)
         may |= alive
         if may.all():
             break

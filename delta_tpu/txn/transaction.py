@@ -18,6 +18,7 @@ checker against the winning commits and retry at the next version, up to
 
 from __future__ import annotations
 
+import logging
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -59,6 +60,8 @@ from delta_tpu.txn.conflict import (
 )
 from delta_tpu.txn.isolation import IsolationLevel, default_isolation_level
 from delta_tpu.utils import filenames
+
+_log = logging.getLogger(__name__)
 
 
 class Operation:
@@ -781,8 +784,10 @@ class Transaction:
             raise
         except Exception:
             # Other post-commit hooks are best-effort (reference: hook
-            # failures do not fail the commit).
-            pass
+            # failures do not fail the commit) — but their failures must
+            # be observable, or checkpoint/checksum drift is silent.
+            _log.warning("post-commit hook failed after commit %d "
+                         "(commit is durable)", version, exc_info=True)
 
 
 _INVALID_NAME_CHARS = " ,;{}()\n\t="

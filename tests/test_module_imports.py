@@ -4,19 +4,20 @@ helper is called but never defined (it only explodes when that code path
 runs — e.g. the r05 catalog `_check_create_spec_matches` gap, which
 broke collection of an entire test file).
 
-The undefined-name check uses pyflakes when installed (the `lint` extra
-in pyproject.toml); otherwise a stdlib `symtable` fallback covers the
-same class: names a function scope resolves globally that exist neither
-at module level nor in builtins."""
+The dynamic import walk stays here (it exercises real import-time side
+effects the static pass can't); the undefined-name check is now a thin
+wrapper over delta-lint's ``undefined-name`` rule
+(``delta_tpu/tools/analyzer/passes/imports.py``), which absorbed the
+old symtable logic — one implementation, shared by CI and this test."""
 
-import builtins
 import importlib
+import os
 import pkgutil
-import symtable
 
 import pytest
 
 import delta_tpu
+from delta_tpu.tools.analyzer import analyze_paths
 
 MODULES = sorted(
     m.name for m in pkgutil.walk_packages(delta_tpu.__path__,
@@ -39,73 +40,13 @@ def test_every_module_imports():
     assert not failures, "\n".join(failures)
 
 
-def _module_files():
-    import os
-
-    root = os.path.dirname(delta_tpu.__file__)
-    for dirpath, _dirs, files in os.walk(root):
-        for f in sorted(files):
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
-
-
-_BUILTINS = set(dir(builtins)) | {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__annotations__", "__class__",
-    "__debug__", "__path__", "WindowsError",
-}
-
-
-def _undefined_globals(path: str):
-    """symtable-based: a symbol a nested scope resolves as GLOBAL must be
-    bound at module level (imports, defs, assignments — symtable records
-    bindings from every branch, so conditional imports count) or be a
-    builtin."""
-    with open(path) as f:
-        src = f.read()
-    try:
-        table = symtable.symtable(src, path, "exec")
-    except SyntaxError as e:  # pragma: no cover - would break import too
-        return [f"{path}: syntax error {e}"]
-    module_names = set(table.get_identifiers())
-    problems = []
-
-    def walk(t):
-        if t.get_type() == "function":
-            for sym in t.get_symbols():
-                if (sym.is_referenced() and sym.is_global()
-                        and not sym.is_assigned()
-                        and sym.get_name() not in module_names
-                        and sym.get_name() not in _BUILTINS):
-                    problems.append(
-                        f"{path}: {t.get_name()}() references undefined "
-                        f"name {sym.get_name()!r}")
-        for child in t.get_children():
-            walk(child)
-
-    walk(table)
-    return problems
-
-
 def test_no_undefined_names():
-    try:
-        from pyflakes.api import checkPath  # noqa: F401
-        from pyflakes.reporter import Reporter
-
-        import io
-
-        out, err = io.StringIO(), io.StringIO()
-        rep = Reporter(out, err)
-        n = sum(checkPath(p, rep) for p in _module_files())
-        undefined = [line for line in out.getvalue().splitlines()
-                     if "undefined name" in line]
-        assert not undefined, "\n".join(undefined)
-        assert n >= 0
-    except ImportError:
-        problems = []
-        for p in _module_files():
-            problems.extend(_undefined_globals(p))
-        assert not problems, "\n".join(problems)
+    pkg = os.path.dirname(os.path.abspath(delta_tpu.__file__))
+    report = analyze_paths([pkg], root=os.path.dirname(pkg),
+                           rules=["undefined-name"])
+    problems = [f"{f.path}:{f.line}: {f.message}" for f in report.findings]
+    assert not problems, "\n".join(problems)
+    assert report.files_scanned > 100  # the walk actually covered the tree
 
 
 @pytest.mark.parametrize("helper", ["_check_create_spec_matches"])
