@@ -471,6 +471,80 @@ def analyzer_scan_metric():
     }))
 
 
+def trace_overhead_metric(workdir: str) -> None:
+    """delta-trace overhead: snapshot-load with DELTA_TPU_TRACE=on vs
+    off on a small host-engine log, plus a direct measurement of the
+    disabled fast path (the cost every untraced production call pays).
+
+    The asserted number is the DISABLED path: per-call no-op span()
+    cost x the span count an identical traced load emits, as a fraction
+    of the untraced load time. The on-vs-off wall delta is printed as a
+    diagnostic only (sub-second loads make it noisy). One traced run is
+    exported as a Chrome trace artifact next to the cached log."""
+    from delta_tpu import obs
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.replay.columnar import clear_parse_cache
+    from delta_tpu.table import Table
+
+    commits = int(os.environ.get("BENCH_TRACE_COMMITS", 500))
+    path = ensure_log(workdir, commits)
+
+    def load(mode: str) -> float:
+        obs.set_trace_mode(mode)
+        clear_parse_cache()
+        eng = HostEngine()
+        t0 = time.perf_counter()
+        snap = Table.for_path(path, eng).latest_snapshot()
+        _ = snap.state
+        return time.perf_counter() - t0
+
+    try:
+        load("off")  # warm page cache / allocator before either side
+        off_s = min(load("off"), load("off"))
+        obs.reset_trace_buffer()
+        on_s = min(load("on"), load("on"))
+        spans = obs.get_finished_spans()
+        n_spans = len(spans) // 2  # two ON loads in the buffer
+
+        artifact = os.path.join(workdir, "snapshot_load_trace.json")
+        from delta_tpu.obs.export import write_chrome_trace
+
+        half = spans[len(spans) // 2:]  # the second (warmer) load
+        write_chrome_trace(artifact, half)
+
+        # disabled fast path, measured directly
+        obs.set_trace_mode("off")
+        n_calls = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            with obs.span("bench.noop", table="x"):
+                pass
+        noop_per_call_s = (time.perf_counter() - t0) / n_calls
+        overhead_pct = 100.0 * (noop_per_call_s * n_spans) / off_s
+        on_vs_off_pct = 100.0 * (on_s - off_s) / off_s
+
+        print(f"trace overhead @{commits} commits: off {off_s:.3f}s, "
+              f"on {on_s:.3f}s ({on_vs_off_pct:+.1f}%), {n_spans} spans, "
+              f"no-op span {noop_per_call_s * 1e9:.0f}ns/call -> disabled-"
+              f"path overhead {overhead_pct:.3f}%", file=sys.stderr)
+        print(f"chrome trace artifact: {artifact}", file=sys.stderr)
+        assert overhead_pct < 2.0, (
+            f"disabled-path trace overhead {overhead_pct:.2f}% >= 2%")
+        # secondary metric line (the driver reads the LAST line only)
+        print(json.dumps({
+            "metric": "trace_overhead_pct",
+            "value": round(overhead_pct, 4),
+            "unit": "%",
+            "on_vs_off_pct": round(on_vs_off_pct, 2),
+            "spans_per_load": n_spans,
+            "noop_span_ns": round(noop_per_call_s * 1e9, 1),
+            "chrome_trace": artifact,
+        }))
+    finally:
+        obs.set_trace_mode("off")
+        obs.reset_trace_buffer()
+
+
 def main():
     commits = int(os.environ.get("BENCH_COMMITS", 100_000))
     workdir = os.environ.get("BENCH_WORKDIR", "/tmp/delta_tpu_bench")
@@ -478,6 +552,7 @@ def main():
     n_actions = commits * FILES_PER_COMMIT
 
     analyzer_scan_metric()
+    trace_overhead_metric(workdir)
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # build the native scanner up front so neither side times a g++ run

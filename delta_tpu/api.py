@@ -12,6 +12,7 @@ from typing import Dict, List, Optional, Sequence
 
 import pyarrow as pa
 
+from delta_tpu import obs
 from delta_tpu.errors import DeltaError, InvalidArgumentError, InvariantViolationError, PathExistsError, UnresolvedColumnError
 from delta_tpu.models.actions import RemoveFile
 from delta_tpu.models.schema import from_arrow_schema
@@ -53,6 +54,21 @@ def write_table(
     (OPTIMIZE-like): streams skip them and the commit must not change
     data or metadata (`dataChange` option).
     """
+    with obs.span("table.write", table=path, mode=mode,
+                  rows=data.num_rows) as sp:
+        version = _write_table(
+            path, data, mode, partition_by, engine, properties,
+            target_rows_per_file, schema, merge_schema, overwrite_schema,
+            replace_where, partition_overwrite_mode, data_change)
+        sp.set_attr("version", version)
+        return version
+
+
+def _write_table(
+    path, data, mode, partition_by, engine, properties,
+    target_rows_per_file, schema, merge_schema, overwrite_schema,
+    replace_where, partition_overwrite_mode, data_change,
+) -> int:
     table = Table.for_path(path, engine)
     exists = table.exists()
     if exists and mode == "error":

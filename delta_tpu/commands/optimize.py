@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 import pyarrow as pa
 
+from delta_tpu import obs
 from delta_tpu.errors import DeltaError, MissingTransactionLogError, OptimizeArgumentError
 from delta_tpu.expressions.tree import Expression
 from delta_tpu.models.actions import AddFile
@@ -121,6 +122,25 @@ class OptimizeBuilder:
 
 
 def _run_optimize(
+    table,
+    filter: Optional[Expression],
+    zorder_by: Optional[List[str]],
+    max_file_size: int,
+    min_file_size: Optional[int],
+    curve: str = "zorder",
+    full: bool = False,
+) -> OptimizeMetrics:
+    with obs.span("command.optimize", table=table.path,
+                  zorder=bool(zorder_by)) as sp:
+        metrics = _run_optimize_inner(
+            table, filter, zorder_by, max_file_size, min_file_size, curve,
+            full)
+        sp.set_attrs(files_removed=metrics.num_files_removed,
+                     files_added=metrics.num_files_added)
+        return metrics
+
+
+def _run_optimize_inner(
     table,
     filter: Optional[Expression],
     zorder_by: Optional[List[str]],

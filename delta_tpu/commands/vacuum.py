@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from delta_tpu import obs
 from delta_tpu.config import TOMBSTONE_RETENTION, get_table_config
 from delta_tpu.errors import (
     InvalidArgumentError,
@@ -309,6 +310,23 @@ def vacuum(
     enforce_retention_check: bool = True,
     inventory=None,
     vacuum_type: str = "FULL",
+) -> VacuumResult:
+    with obs.span("command.vacuum", table=table.path, dry_run=dry_run,
+                  vacuum_type=vacuum_type.upper()) as sp:
+        result = _vacuum(table, retention_hours, dry_run,
+                         enforce_retention_check, inventory, vacuum_type)
+        sp.set_attrs(files_deleted=result.num_deleted,
+                     dirs_scanned=result.dirs_scanned)
+        return result
+
+
+def _vacuum(
+    table,
+    retention_hours: Optional[float],
+    dry_run: bool,
+    enforce_retention_check: bool,
+    inventory,
+    vacuum_type: str,
 ) -> VacuumResult:
     vacuum_type = vacuum_type.upper()
     if vacuum_type not in ("FULL", "LITE"):

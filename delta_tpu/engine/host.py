@@ -17,6 +17,7 @@ import pyarrow as pa
 import pyarrow.json as pa_json
 import pyarrow.parquet as pq
 
+from delta_tpu import obs
 from delta_tpu.engine.spi import (
     Engine,
     ExpressionHandler,
@@ -26,6 +27,14 @@ from delta_tpu.engine.spi import (
     ParquetHandler,
 )
 from delta_tpu.storage.logstore import FileStatus, LogStore, logstore_for_path
+
+# process-wide storage I/O counters; per-file spans are verbose-only
+# (a 100k-commit load would emit 100k spans), the counters always run
+_READ_CALLS = obs.counter("storage.read.calls")
+_READ_BYTES = obs.counter("storage.read.bytes")
+_LIST_CALLS = obs.counter("storage.list.calls")
+_WRITE_CALLS = obs.counter("storage.write.calls")
+_WRITE_BYTES = obs.counter("storage.write.bytes")
 
 
 class HostJsonHandler(JsonHandler):
@@ -41,7 +50,11 @@ class HostJsonHandler(JsonHandler):
             yield p, self._store_for(p).read(p)
 
     def write_json_file_atomically(self, path: str, data: bytes, overwrite: bool = False) -> None:
-        self._store_for(path).write(path, data, overwrite=overwrite)
+        with obs.span("storage.commit_write", path=path, bytes=len(data),
+                      overwrite=overwrite):
+            self._store_for(path).write(path, data, overwrite=overwrite)
+        _WRITE_CALLS.inc()
+        _WRITE_BYTES.inc(len(data))
 
 
 class HostParquetHandler(ParquetHandler):
@@ -72,13 +85,21 @@ class HostParquetHandler(ParquetHandler):
         pq.write_table(table, sink, compression="snappy")
         buf = sink.getvalue().to_pybytes()
         store = self._store_for(path)
-        store.write(path, buf, overwrite=True)
+        with obs.span("storage.parquet_write", _verbose=True, path=path,
+                      bytes=len(buf)):
+            store.write(path, buf, overwrite=True)
+        _WRITE_CALLS.inc()
+        _WRITE_BYTES.inc(len(buf))
         return store.file_status(path)
 
     def write_parquet_file_atomically(self, path: str, table: pa.Table) -> None:
         sink = pa.BufferOutputStream()
         pq.write_table(table, sink, compression="snappy")
-        self._store_for(path).write(path, sink.getvalue().to_pybytes(), overwrite=False)
+        buf = sink.getvalue().to_pybytes()
+        with obs.span("storage.parquet_write", path=path, bytes=len(buf)):
+            self._store_for(path).write(path, buf, overwrite=False)
+        _WRITE_CALLS.inc()
+        _WRITE_BYTES.inc(len(buf))
 
 
 class HostFileSystemClient(FileSystemClient):
@@ -93,12 +114,14 @@ class HostFileSystemClient(FileSystemClient):
 
     def list_from(self, path: str) -> Iterator[FileStatus]:
         self.list_calls += 1
+        _LIST_CALLS.inc()
         return self._store_for(path).list_from(path)
 
     def list_from_fast(self, path: str, skip_stat):
         """Stat-skipping listing when the store supports it (local
         stores); falls back to the full listing."""
         self.list_calls += 1
+        _LIST_CALLS.inc()
         store = self._store_for(path)
         fast = getattr(store, "list_from_fast", None)
         if fast is not None:
@@ -107,10 +130,19 @@ class HostFileSystemClient(FileSystemClient):
 
     def read_file(self, path: str) -> bytes:
         self.read_calls += 1
-        return self._store_for(path).read(path)
+        _READ_CALLS.inc()
+        with obs.span("storage.read", _verbose=True, path=path) as sp:
+            data = self._store_for(path).read(path)
+            sp.set_attr("bytes", len(data))
+        _READ_BYTES.inc(len(data))
+        return data
 
     def write_file(self, path: str, data: bytes) -> None:
-        self._store_for(path).write(path, data, overwrite=True)
+        _WRITE_CALLS.inc()
+        _WRITE_BYTES.inc(len(data))
+        with obs.span("storage.write", _verbose=True, path=path,
+                      bytes=len(data)):
+            self._store_for(path).write(path, data, overwrite=True)
 
     def resolve_path(self, path: str) -> str:
         return path

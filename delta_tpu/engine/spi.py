@@ -125,5 +125,14 @@ class Engine:
         self.metrics_reporters = list(metrics_reporters or [])
 
     def report_metrics(self, report: dict) -> None:
+        # correlation: with tracing on, every emitted report is also
+        # pinned to the active span as an event, so a SnapshotReport /
+        # TransactionReport can be matched to the exact trace that
+        # produced it (the reportUUID rides along)
+        from delta_tpu import obs
+
+        obs.add_event("metrics_report",
+                      report_type=report.get("type"),
+                      report_uuid=report.get("reportUUID"))
         for r in self.metrics_reporters:
             r.report(report)

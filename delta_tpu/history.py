@@ -20,6 +20,12 @@ from delta_tpu.models.actions import CommitInfo, actions_from_commit_bytes
 from delta_tpu.utils import filenames
 
 
+def _metric_value_str(v) -> str:
+    from delta_tpu.txn.transaction import _metric_str
+
+    return v if isinstance(v, str) else _metric_str(v)
+
+
 @dataclass
 class CommitRecord:
     version: int
@@ -30,11 +36,20 @@ class CommitRecord:
         d = {"version": self.version, "timestamp": self.timestamp_ms}
         if self.commit_info is not None:
             ci = self.commit_info
+            # operationMetrics is a string-valued map in the reference
+            # (`CommitInfo.operationMetrics: Map[String, String]`); new
+            # commits serialize strings, but logs written by older
+            # versions may carry raw ints/floats — normalize on read so
+            # consumers see one shape
+            metrics = ci.operationMetrics
+            if metrics:
+                metrics = {k: _metric_value_str(v)
+                           for k, v in metrics.items()}
             d.update(
                 {
                     "operation": ci.operation,
                     "operationParameters": ci.operationParameters,
-                    "operationMetrics": ci.operationMetrics,
+                    "operationMetrics": metrics,
                     "engineInfo": ci.engineInfo,
                     "isBlindAppend": ci.isBlindAppend,
                     "readVersion": ci.readVersion,

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, List
 
+from delta_tpu import obs
 from delta_tpu.config import CHECKPOINT_INTERVAL, get_table_config, settings
 
 _log = logging.getLogger(__name__)
@@ -131,13 +132,23 @@ class PostCommitHookError(Exception):
 
 
 def run_post_commit_hooks(table, txn, version: int, metadata) -> None:
-    for hook in (
-        checksum_hook, checkpoint_hook, auto_compact_hook, uniform_hooks,
-        symlink_manifest_hook,
-        *_EXTRA_HOOKS,
-    ):
-        try:
-            hook(table, txn, version, metadata)
-        except Exception as e:
-            if getattr(hook, "critical", False):
-                raise PostCommitHookError(hook.__name__, version, e) from e
+    with obs.span("txn.post_commit_hooks", version=version):
+        for hook in (
+            checksum_hook, checkpoint_hook, auto_compact_hook, uniform_hooks,
+            symlink_manifest_hook,
+            *_EXTRA_HOOKS,
+        ):
+            # per-hook child spans make "the commit is slow" diagnosable:
+            # checkpoint vs checksum vs auto-compact cost separates here,
+            # and a swallowed best-effort failure still leaves an
+            # error-status span behind
+            with obs.span(f"hook.{hook.__name__}") as sp:
+                try:
+                    hook(table, txn, version, metadata)
+                except Exception as e:
+                    sp.set_attrs(hook_error=type(e).__name__,
+                                 swallowed=not getattr(
+                                     hook, "critical", False))
+                    if getattr(hook, "critical", False):
+                        raise PostCommitHookError(
+                            hook.__name__, version, e) from e

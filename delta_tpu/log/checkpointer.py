@@ -34,6 +34,7 @@ from delta_tpu.config import (
     get_table_config,
     settings,
 )
+from delta_tpu import obs
 from delta_tpu.errors import ChecksumMismatchError, InvalidArgumentError
 from delta_tpu.log.last_checkpoint import LastCheckpointInfo, write_last_checkpoint
 from delta_tpu.models.actions import Sidecar
@@ -414,6 +415,15 @@ def _retained_tombstones(state, now_ms: int, retention_ms: int) -> pa.Table:
 
 def write_checkpoint(engine, snapshot, policy: Optional[str] = None) -> LastCheckpointInfo:
     """Write a checkpoint for `snapshot` and update `_last_checkpoint`."""
+    with obs.span("checkpoint.write", log_path=snapshot._table.log_path,
+                  version=snapshot.version) as sp:
+        info = _write_checkpoint(engine, snapshot, policy)
+        sp.set_attrs(actions=info.size, num_add_files=info.numOfAddFiles,
+                     size_bytes=info.sizeInBytes)
+        return info
+
+
+def _write_checkpoint(engine, snapshot, policy: Optional[str]) -> LastCheckpointInfo:
     state = snapshot.state
     meta_conf = state.metadata.configuration
     if policy is None:

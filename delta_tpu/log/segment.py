@@ -21,6 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from delta_tpu import obs
 from delta_tpu.errors import DeltaError, TableNotFoundError, VersionNotFoundError
 from delta_tpu.storage.logstore import FileStatus
 from delta_tpu.utils import filenames
@@ -73,6 +74,15 @@ def extend_log_segment(fs, prev: LogSegment):
     commit versions aren't contiguous with `prev` (log cleanup raced
     the listing).
     """
+    with obs.span("log.list_incremental", log_path=prev.log_path,
+                  from_version=prev.version) as sp:
+        ext = _extend_log_segment(fs, prev)
+        if ext is not None:
+            sp.set_attrs(to_version=ext[0].version, new_commits=len(ext[1]))
+        return ext
+
+
+def _extend_log_segment(fs, prev: LogSegment):
     start = prev.version + 1
     prefix = filenames.listing_prefix(prev.log_path, start)
     # same stat-skipping policy as build_log_segment: commit entries
@@ -192,6 +202,22 @@ def build_log_segment(
 ) -> LogSegment:
     """LIST the log and assemble the segment for `target_version` (or the
     latest version when None)."""
+    with obs.span("log.list_segment", log_path=log_path) as sp:
+        seg = _build_log_segment(fs, log_path, target_version,
+                                 checkpoint_hint, use_compacted_deltas)
+        sp.set_attrs(version=seg.version, num_deltas=len(seg.deltas),
+                     num_checkpoint_parts=len(seg.checkpoints),
+                     num_compacted=len(seg.compacted_deltas))
+        return seg
+
+
+def _build_log_segment(
+    fs,
+    log_path: str,
+    target_version: Optional[int],
+    checkpoint_hint: Optional[int],
+    use_compacted_deltas: bool,
+) -> LogSegment:
     start = checkpoint_hint if checkpoint_hint is not None else 0
     prefix = filenames.listing_prefix(log_path, start)
     # commit files skip the per-entry stat (their sizes come from the

@@ -1,4 +1,4 @@
-"""Typed metric reports + timers.
+"""Typed metric reports + timers, derived from obs spans.
 
 Reference kernel `internal/metrics/` (Timer/Counter,
 SnapshotMetrics/ScanMetrics/TransactionMetrics) pushed as
@@ -8,6 +8,13 @@ MetricsReporters (`engine/Engine.java:61`), and spark's
 
 Reports are plain dicts with a `type` tag so reporters stay trivial;
 `delta_tpu.engine.host.LoggingMetricsReporter` collects them in-memory.
+
+Since the obs subsystem landed, every `Timer` is a span bridge: give it
+a `span_name` and each `time()` scope both records into the report (the
+always-on path reporters depend on) and opens a `delta_tpu.obs` span
+(the `DELTA_TPU_TRACE`-gated path traces are built from). Report timings
+and trace timings therefore come from the same scopes — a report is the
+flat projection of the spans of one operation.
 """
 
 from __future__ import annotations
@@ -17,18 +24,35 @@ import uuid
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+from delta_tpu.obs import span as _span
+
 
 class Timer:
-    def __init__(self):
+    """Count/total-ns accumulator; `span_name` makes each timed scope
+    also an obs span (no-op when tracing is off)."""
+
+    def __init__(self, span_name: Optional[str] = None):
         self.count = 0
         self.total_ns = 0
+        self.span_name = span_name
 
     @contextmanager
     def time(self):
+        if self.span_name:
+            with _span(self.span_name):
+                yield from self._measure()
+        else:
+            yield from self._measure()
+
+    def _measure(self):
+        # the report path must stay alive with tracing off, so this is
+        # the one sanctioned raw-clock site the obs spans are bridged to
+        # delta-lint: disable=obs-span-leak
         t0 = time.perf_counter_ns()
         try:
             yield
         finally:
+            # delta-lint: disable=obs-span-leak
             self.record(time.perf_counter_ns() - t0)
 
     def record(self, duration_ns: int) -> None:
@@ -50,9 +74,10 @@ class Counter:
 
 class SnapshotMetrics:
     def __init__(self):
-        self.load_init_state_timer = Timer()      # listing + segment build
-        self.columnarize_timer = Timer()          # log parse → arrow
-        self.replay_timer = Timer()               # dedup kernel
+        # span names mirror the phase names in docs/observability.md
+        self.load_init_state_timer = Timer("snapshot.load_init_state")
+        self.columnarize_timer = Timer("snapshot.columnarize")
+        self.replay_timer = Timer("snapshot.replay")
         self.num_commit_files = Counter()
         self.num_checkpoint_parts = Counter()
         self.num_actions = Counter()

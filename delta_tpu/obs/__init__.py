@@ -1,0 +1,97 @@
+"""delta-tpu observability: hierarchical spans, metrics registry, exporters.
+
+Zero-dependency tracing + telemetry spine (ROADMAP: observability).
+Typical instrumentation site::
+
+    from delta_tpu import obs
+
+    with obs.span("snapshot.load", table=path) as s:
+        ...
+        s.set_attr("version", snap.version)
+
+Gate with ``DELTA_TPU_TRACE=off|on|verbose`` (default off; the disabled
+path returns a shared no-op context manager). ``DELTA_TPU_TRACE_FILE``
+appends finished spans as JSONL; `delta-trace` (``python -m
+delta_tpu.tools.trace``) summarizes either JSONL or Chrome trace files.
+
+Counters/histograms (`counter`, `histogram`) are always on and
+process-wide; resolve them once at module import and call ``.inc()`` on
+the hot path.
+"""
+
+from delta_tpu.obs.export import (
+    JsonlExporter,
+    chrome_trace,
+    load_spans,
+    span_to_dict,
+    write_chrome_trace,
+)
+from delta_tpu.obs.registry import (
+    Counter,
+    Histogram,
+    Registry,
+    counter,
+    histogram,
+    metrics_snapshot,
+    registry,
+)
+from delta_tpu.obs.trace import (
+    MODE_OFF,
+    MODE_ON,
+    MODE_VERBOSE,
+    Span,
+    add_event,
+    add_exporter,
+    current_span,
+    get_finished_spans,
+    remove_exporter,
+    reset_trace_buffer,
+    set_attr,
+    set_attrs,
+    set_trace_mode,
+    span,
+    trace_enabled,
+    trace_mode,
+    wrap,
+)
+
+# Both trace and export are fully initialized here, so honoring
+# DELTA_TPU_TRACE_FILE at startup is now cycle-safe (trace.py itself
+# must not do this at import time — export.py imports trace.py).
+if trace_enabled():
+    from delta_tpu.obs.trace import _install_env_exporter_once
+
+    _install_env_exporter_once()
+    del _install_env_exporter_once
+
+__all__ = [
+    "MODE_OFF",
+    "MODE_ON",
+    "MODE_VERBOSE",
+    "Counter",
+    "Histogram",
+    "JsonlExporter",
+    "Registry",
+    "Span",
+    "add_event",
+    "add_exporter",
+    "chrome_trace",
+    "counter",
+    "current_span",
+    "get_finished_spans",
+    "histogram",
+    "load_spans",
+    "metrics_snapshot",
+    "registry",
+    "remove_exporter",
+    "reset_trace_buffer",
+    "set_attr",
+    "set_attrs",
+    "set_trace_mode",
+    "span",
+    "span_to_dict",
+    "trace_enabled",
+    "trace_mode",
+    "wrap",
+    "write_chrome_trace",
+]

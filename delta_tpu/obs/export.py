@@ -1,0 +1,179 @@
+"""Trace exporters: JSONL span records and Chrome trace-event JSON.
+
+Two on-disk shapes:
+
+- JSONL — one JSON object per finished span (``{"type": "span", ...}``,
+  see `Span.to_dict`). Appendable, greppable, stream-friendly; the
+  `DELTA_TPU_TRACE_FILE` auto-exporter writes this.
+- Chrome trace-event format — a ``{"traceEvents": [...]}`` document of
+  ``ph: "X"`` complete events (ts/dur in microseconds) loadable in
+  `chrome://tracing` or https://ui.perfetto.dev. `write_chrome_trace`
+  converts; `delta-trace --chrome` does the same from the CLI.
+
+`load_spans` reads either shape back into plain span dicts, so the CLI
+and tests are format-agnostic.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from delta_tpu.obs.trace import Span
+
+
+def span_to_dict(span) -> Dict[str, object]:
+    """Normalize a Span (or an already-dict record) to the JSONL shape."""
+    if isinstance(span, Span):
+        return span.to_dict()
+    return dict(span)
+
+
+class JsonlExporter:
+    """Append finished spans to `path`, one JSON object per line.
+
+    Thread-safe; lines are written+flushed under a lock so concurrent
+    spans never interleave mid-line. Register with
+    `obs.add_exporter(JsonlExporter(path))`, or set
+    `DELTA_TPU_TRACE_FILE` to have one installed automatically.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh: Optional[io.TextIOWrapper] = open(  # noqa: SIM115
+            path, "a", encoding="utf-8"
+        )
+        self._lock = threading.Lock()
+
+    def __call__(self, span) -> None:
+        line = json.dumps(span_to_dict(span), sort_keys=True,
+                          default=str)
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __repr__(self):
+        return f"JsonlExporter({self.path!r})"
+
+
+def load_spans(path: str) -> List[Dict[str, object]]:
+    """Read span dicts back from a JSONL or Chrome trace-event file."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") and '"traceEvents"' in stripped[:200]:
+        return _spans_from_chrome(json.loads(stripped))
+    spans = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("type", "span") == "span":
+            spans.append(rec)
+    return spans
+
+
+def _spans_from_chrome(doc: Dict[str, object]) -> List[Dict[str, object]]:
+    spans = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        spans.append({
+            "type": "span",
+            "name": ev.get("name"),
+            "trace_id": args.pop("trace_id", None),
+            "span_id": args.pop("span_id", None),
+            "parent_id": args.pop("parent_id", None),
+            "start_unix_ns": int(ev.get("ts", 0) * 1000),
+            "duration_ns": int(ev.get("dur", 0) * 1000),
+            "status": args.pop("status", "ok"),
+            "thread_id": ev.get("tid", 0),
+            "thread_name": None,
+            "attrs": args,
+            "events": [],
+        })
+    return spans
+
+
+def chrome_trace(spans: Iterable, pid: Optional[int] = None) -> Dict[str, object]:
+    """Convert spans to a Chrome trace-event document.
+
+    Every span becomes a ``ph: "X"`` complete event; trace/span/parent
+    ids and attributes ride in ``args`` so the conversion is lossless
+    enough for `load_spans` to round-trip. Thread names are emitted as
+    ``ph: "M"`` metadata events.
+    """
+    if pid is None:
+        pid = os.getpid()
+    events: List[Dict[str, object]] = []
+    thread_names: Dict[int, str] = {}
+    for s in spans:
+        d = span_to_dict(s)
+        tid = d.get("thread_id") or 0
+        tname = d.get("thread_name")
+        if tname and tid not in thread_names:
+            thread_names[tid] = tname
+        args = dict(d.get("attrs") or {})
+        args["trace_id"] = d.get("trace_id")
+        args["span_id"] = d.get("span_id")
+        args["parent_id"] = d.get("parent_id")
+        if d.get("status") and d["status"] != "ok":
+            args["status"] = d["status"]
+        for ev in d.get("events") or []:
+            events.append({
+                "name": ev.get("name"),
+                "ph": "i",
+                "ts": ev.get("ts_unix_ns", 0) / 1000.0,
+                "pid": pid,
+                "tid": tid,
+                "s": "t",
+                "args": dict(ev.get("attrs") or {}),
+            })
+        name = d.get("name") or "?"
+        events.append({
+            "name": name,
+            "cat": name.split(".", 1)[0],
+            "ph": "X",
+            "ts": d.get("start_unix_ns", 0) / 1000.0,
+            "dur": (d.get("duration_ns") or 0) / 1000.0,
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    for tid, tname in sorted(thread_names.items()):
+        events.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, spans: Iterable,
+                       pid: Optional[int] = None) -> str:
+    """Write spans as a Chrome trace-event JSON file; returns `path`."""
+    doc = chrome_trace(spans, pid=pid)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True, default=str)
+    return path

@@ -1,0 +1,147 @@
+"""Process-wide metrics registry: named counters and histograms.
+
+The kernel side of the reference keeps `Counter`/`Timer` objects inside
+per-operation metric bags (`internal/metrics/`); cross-operation totals
+(parse-cache hit rates, storage bytes, retry counts) need a process-wide
+home instead. This registry is that home.
+
+Fast path is lock-free: instrument sites resolve their Counter once at
+module import (`_HITS = counter("parse_cache.hit_files")`) and the hot
+call is a plain attribute increment — GIL-atomic for ints, no lock, no
+dict lookup. The registry lock only guards instrument *creation*.
+
+Counters are always on (a dict-free int add is cheaper than checking a
+gate); the span machinery in `trace.py` carries the `DELTA_TPU_TRACE`
+gating.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Counter:
+    """Monotonic counter. `inc()` is GIL-atomic for the int add; exact
+    totals under free-threaded builds are not guaranteed (telemetry
+    tolerance, same trade the reference's SQLMetrics make)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def reset(self) -> None:
+        self.value = 0
+
+    def __repr__(self):
+        return f"Counter({self.name!r}, {self.value})"
+
+
+class Histogram:
+    """Streaming summary: count/sum/min/max. No bucket vector — the
+    per-operation latency distribution lives in spans; this is the cheap
+    aggregate for code paths too hot to span."""
+
+    __slots__ = ("name", "count", "sum", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0
+        self.min = None
+        self.max = None
+
+    def observe(self, value) -> None:
+        self.count += 1
+        self.sum += value
+        mn = self.min
+        if mn is None or value < mn:
+            self.min = value
+        mx = self.max
+        if mx is None or value > mx:
+            self.max = value
+
+    def reset(self) -> None:
+        self.count = 0
+        self.sum = 0
+        self.min = None
+        self.max = None
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else None
+
+    def __repr__(self):
+        return f"Histogram({self.name!r}, n={self.count}, sum={self.sum})"
+
+
+class Registry:
+    """Named instrument table. Same name → same instrument, process-wide."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name))
+        return h
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Point-in-time dump: {'counters': {name: value}, 'histograms':
+        {name: {count, sum, min, max}}}. Zero-valued instruments are
+        included — absence means never created, not never hit."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            histograms = {
+                n: {"count": h.count, "sum": h.sum,
+                    "min": h.min, "max": h.max}
+                for n, h in self._histograms.items()
+            }
+        return {"counters": counters, "histograms": histograms}
+
+    def reset(self) -> None:
+        """Zero every instrument (tests/bench); instruments stay
+        registered so module-cached references remain valid."""
+        with self._lock:
+            for c in self._counters.values():
+                c.reset()
+            for h in self._histograms.values():
+                h.reset()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    """The process-wide counter named `name` (created on first use)."""
+    return _REGISTRY.counter(name)
+
+
+def histogram(name: str) -> Histogram:
+    """The process-wide histogram named `name` (created on first use)."""
+    return _REGISTRY.histogram(name)
+
+
+def metrics_snapshot() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every registered counter/histogram."""
+    return _REGISTRY.snapshot()

@@ -1,0 +1,369 @@
+"""Hierarchical span tracing: contextvar span stack, ring buffer, exporters.
+
+Dapper-style traces (Sigelman et al. 2010) shaped after the reference's
+`recordDeltaOperation` timing scopes (`DeltaLogging.scala:118`): every
+instrumented operation opens a span; nested operations become child
+spans sharing the root's trace id, so one `Table.latest_snapshot()`
+stitches listing, parse, columnarize, and replay-kernel phases — across
+threads and storage layers — into a single connected tree.
+
+Gating: `DELTA_TPU_TRACE=off|on|verbose` (default off).  The disabled
+path is near-zero cost: `span()` returns a process-wide no-op context
+manager singleton — no allocation, no clock read, no contextvar touch.
+`verbose` additionally enables high-cardinality spans (per-file storage
+reads) that `on` folds into counters.
+
+Finished spans land in a bounded in-process ring buffer
+(`get_finished_spans`) and are fanned out to registered exporters;
+`DELTA_TPU_TRACE_FILE=<path>` auto-installs a JSONL exporter.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+MODE_OFF = 0
+MODE_ON = 1
+MODE_VERBOSE = 2
+
+_MODES = {"off": MODE_OFF, "on": MODE_ON, "verbose": MODE_VERBOSE,
+          "0": MODE_OFF, "1": MODE_ON, "2": MODE_VERBOSE}
+
+
+def _mode_from_env() -> int:
+    raw = os.environ.get("DELTA_TPU_TRACE", "off").strip().lower()
+    mode = _MODES.get(raw)
+    if mode is None:
+        _log.warning("unknown DELTA_TPU_TRACE=%r; tracing stays off", raw)
+        return MODE_OFF
+    return mode
+
+
+_mode: int = _mode_from_env()
+
+# the active span of the calling context; child contexts (threads) do
+# NOT inherit it automatically — use wrap() to propagate across pools
+_CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "delta_tpu_current_span", default=None
+)
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+class Span:
+    """One finished or in-flight operation: half-open interval + metadata.
+
+    `start_unix_ns` anchors the span on the wall clock (exporters need
+    absolute timestamps); `duration_ns` is measured on the monotonic
+    clock so it survives wall-clock steps.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "start_unix_ns", "monotonic_start_ns", "duration_ns",
+                 "attrs", "events", "status", "thread_id", "thread_name")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], attrs: Dict[str, object]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_unix_ns = time.time_ns()
+        self.monotonic_start_ns = time.perf_counter_ns()
+        self.duration_ns: Optional[int] = None
+        self.attrs = attrs
+        self.events: List[Dict[str, object]] = []
+        self.status = "ok"
+        cur = threading.current_thread()
+        self.thread_id = cur.ident or 0
+        self.thread_name = cur.name
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def set_attrs(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append({"name": name, "ts_unix_ns": time.time_ns(),
+                            "attrs": attrs})
+
+    @property
+    def recording(self) -> bool:
+        return True
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "type": "span",
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_unix_ns": self.start_unix_ns,
+            "duration_ns": self.duration_ns,
+            "status": self.status,
+            "thread_id": self.thread_id,
+            "thread_name": self.thread_name,
+            "attrs": self.attrs,
+            "events": self.events,
+        }
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, trace={self.trace_id[:8]}, "
+                f"span={self.span_id}, parent={self.parent_id}, "
+                f"status={self.status})")
+
+
+class _NoopSpan:
+    """The recorded-nothing span: every mutator is a no-op. A single
+    process-wide instance backs the disabled path."""
+
+    __slots__ = ()
+
+    trace_id = None
+    span_id = None
+    parent_id = None
+    name = None
+    status = "ok"
+    duration_ns = None
+
+    def set_attr(self, key: str, value) -> None:
+        pass
+
+    def set_attrs(self, **attrs) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs) -> None:
+        pass
+
+    @property
+    def recording(self) -> bool:
+        return False
+
+
+class _NoopCtx:
+    """Reusable, reentrant, thread-safe no-op context manager: carries no
+    per-use state, so one singleton serves every disabled `span()` call."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+_NOOP_CTX = _NoopCtx()
+
+
+class _SpanCtx:
+    """Live-path context manager: creates the span on __enter__ (so the
+    parent is read from the entering context, not the creating one)."""
+
+    __slots__ = ("_name", "_attrs", "_span", "_token")
+
+    def __init__(self, name: str, attrs: Dict[str, object]):
+        self._name = name
+        self._attrs = attrs
+        self._span: Optional[Span] = None
+        self._token = None
+
+    def __enter__(self) -> Span:
+        parent = _CURRENT.get()
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = _new_id(16), None
+        s = Span(self._name, trace_id, _new_id(8), parent_id, self._attrs)
+        self._span = s
+        self._token = _CURRENT.set(s)
+        return s
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        s = self._span
+        s.duration_ns = time.perf_counter_ns() - s.monotonic_start_ns
+        if exc_type is not None:
+            s.status = "error"
+            s.attrs.setdefault("error.type", exc_type.__name__)
+            if exc is not None:
+                s.attrs.setdefault("error.message", str(exc)[:200])
+        _CURRENT.reset(self._token)
+        _finish(s)
+        return False
+
+
+def span(name: str, _verbose: bool = False, **attrs):
+    """Open a span named `name` with initial attributes `attrs`.
+
+    Use as a context manager: ``with span("snapshot.load", table=p) as s:``.
+    `_verbose=True` marks a high-cardinality span recorded only under
+    `DELTA_TPU_TRACE=verbose` (e.g. per-file storage reads). When tracing
+    is disabled (or the span is verbose-only and the mode is `on`) a
+    shared no-op context manager is returned — near-zero cost.
+    """
+    if _mode == MODE_OFF or (_verbose and _mode < MODE_VERBOSE):
+        return _NOOP_CTX
+    return _SpanCtx(name, attrs)
+
+
+def current_span() -> Optional[Span]:
+    """The context's active span, or None outside any span (or when
+    tracing is off)."""
+    return _CURRENT.get()
+
+
+def set_attr(key: str, value) -> None:
+    """Attach `key=value` to the active span; no-op outside a span."""
+    cur = _CURRENT.get()
+    if cur is not None:
+        cur.attrs[key] = value
+
+
+def set_attrs(**attrs) -> None:
+    cur = _CURRENT.get()
+    if cur is not None:
+        cur.attrs.update(attrs)
+
+
+def add_event(name: str, **attrs) -> None:
+    """Append a point-in-time event to the active span; no-op outside."""
+    cur = _CURRENT.get()
+    if cur is not None:
+        cur.add_event(name, **attrs)
+
+
+def wrap(fn):
+    """Bind the caller's active span to `fn` so running it on another
+    thread parents its spans correctly.
+
+    contextvars do not propagate into ThreadPoolExecutor workers; submit
+    ``wrap(fn)`` instead of ``fn`` and the callee joins the caller's
+    trace. Returns `fn` unchanged when tracing is off.
+    """
+    if _mode == MODE_OFF:
+        return fn
+    parent = _CURRENT.get()
+    if parent is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        token = _CURRENT.set(parent)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _CURRENT.reset(token)
+
+    return bound
+
+
+# -- mode control ------------------------------------------------------------
+
+
+def trace_mode() -> int:
+    return _mode
+
+
+def trace_enabled() -> bool:
+    return _mode != MODE_OFF
+
+
+def set_trace_mode(mode: Optional[str]) -> None:
+    """Programmatically set the trace mode ('off'|'on'|'verbose'); None
+    re-reads `DELTA_TPU_TRACE` from the environment. Tests and bench use
+    this; production uses the env var."""
+    global _mode
+    if mode is None:
+        _mode = _mode_from_env()
+    else:
+        try:
+            _mode = _MODES[mode.strip().lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown trace mode {mode!r}; expected off|on|verbose"
+            ) from None
+    if _mode != MODE_OFF:
+        _install_env_exporter_once()
+
+
+# -- collection + export -----------------------------------------------------
+
+_BUFFER_DEFAULT = 200_000
+_buffer: collections.deque = collections.deque(
+    maxlen=int(os.environ.get("DELTA_TPU_TRACE_BUFFER", _BUFFER_DEFAULT))
+)
+_exporters: List[object] = []
+_exporters_lock = threading.Lock()
+_env_exporter_installed = False
+
+
+def _finish(s: Span) -> None:
+    _buffer.append(s)
+    # snapshot the exporter list so a concurrent add/remove cannot
+    # invalidate the iteration
+    for exp in tuple(_exporters):
+        try:
+            exp(s)
+        except Exception as e:
+            _log.warning("trace exporter %r failed: %s", exp, e)
+
+
+def get_finished_spans() -> List[Span]:
+    """Finished spans in finish order (bounded ring buffer)."""
+    return list(_buffer)
+
+
+def reset_trace_buffer() -> None:
+    _buffer.clear()
+
+
+def add_exporter(exporter) -> None:
+    """Register a callable(span) invoked for every finished span."""
+    with _exporters_lock:
+        if exporter not in _exporters:
+            _exporters.append(exporter)
+
+
+def remove_exporter(exporter) -> None:
+    with _exporters_lock:
+        if exporter in _exporters:
+            _exporters.remove(exporter)
+
+
+def _install_env_exporter_once() -> None:
+    """Honor DELTA_TPU_TRACE_FILE: append every finished span as a JSONL
+    record to the named file. Installed at most once per process."""
+    global _env_exporter_installed
+    if _env_exporter_installed:
+        return
+    path = os.environ.get("DELTA_TPU_TRACE_FILE")
+    if not path:
+        return
+    with _exporters_lock:
+        if _env_exporter_installed:
+            return
+        _env_exporter_installed = True
+    from delta_tpu.obs.export import JsonlExporter
+
+    try:
+        add_exporter(JsonlExporter(path))
+    except OSError as e:
+        _log.warning("cannot open DELTA_TPU_TRACE_FILE=%r: %s", path, e)
+
+
+# NOTE: the enabled-at-startup install happens in delta_tpu.obs.__init__
+# (and in set_trace_mode), never at this module's import: export.py
+# imports trace.py, so importing JsonlExporter from module level here
+# would hit export mid-initialization and crash the whole package
+# whenever DELTA_TPU_TRACE=on + DELTA_TPU_TRACE_FILE are both set.

@@ -30,6 +30,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 import pyarrow.json as pa_json
 
+from delta_tpu import obs
 from delta_tpu.models.actions import (
     CommitInfo,
     DomainMetadata,
@@ -37,6 +38,14 @@ from delta_tpu.models.actions import (
     Protocol,
     SetTransaction,
 )
+
+# process-wide parse-cache effectiveness counters (obs registry names
+# mirror the per-instance ParsedCommitCache fields)
+_OBS_CACHE_HITS = obs.counter("parse_cache.hits")
+_OBS_CACHE_PARTIAL = obs.counter("parse_cache.partial_hits")
+_OBS_CACHE_MISSES = obs.counter("parse_cache.misses")
+_OBS_CACHE_HIT_FILES = obs.counter("parse_cache.hit_files")
+_OBS_CACHE_MISS_FILES = obs.counter("parse_cache.miss_files")
 
 DV_STRUCT_TYPE = pa.struct(
     [
@@ -489,14 +498,18 @@ def _read_commits_buffer(
     from delta_tpu.utils.threads import default_io_threads
 
     workers = min(max_workers, default_io_threads())
-    if n > 4:
-        from concurrent.futures import ThreadPoolExecutor
+    with obs.span("storage.read_commits", files=n, bytes=total,
+                  workers=workers if n > 4 else 0):
+        if n > 4:
+            from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=workers) as ex:
-            list(ex.map(fill, range(n)))
-    else:
-        for i in range(n):
-            fill(i)
+            with ThreadPoolExecutor(max_workers=workers) as ex:
+                # obs.wrap: contextvars don't cross the pool boundary, so
+                # bind this span as the workers' parent explicitly
+                list(ex.map(obs.wrap(fill), range(n)))
+        else:
+            for i in range(n):
+                fill(i)
     if mismatch:
         return None
     version_arr = np.array([v for v, _, _ in commit_infos], dtype=np.int64)
@@ -731,12 +744,17 @@ class ParsedCommitCache:
                 i += len(best)
             self.hit_files += i
             self.miss_files += n - i
+            _OBS_CACHE_HIT_FILES.inc(i)
+            _OBS_CACHE_MISS_FILES.inc(n - i)
             if i == n:
                 self.hits += 1
+                _OBS_CACHE_HITS.inc()
             elif out:
                 self.partial_hits += 1
+                _OBS_CACHE_PARTIAL.inc()
             else:
                 self.misses += 1
+                _OBS_CACHE_MISSES.inc()
         return out
 
     def put(self, file_keys: tuple, span: ParsedSpan) -> None:
@@ -818,6 +836,23 @@ def columnarize_log_segment(
     reference's P&M fast path (`Snapshot.scala:440`,
     `LogReplay.loadTableProtocolAndMetadata`).
     """
+    with obs.span("log.columnarize", version=segment.version,
+                  small_only=small_only) as osp:
+        out = _columnarize_log_segment(engine, segment, table_root,
+                                       small_only, early_replay)
+        osp.set_attrs(bytes_parsed=out.bytes_parsed,
+                      num_commit_files=out.num_commit_files,
+                      num_actions=out.num_actions)
+        return out
+
+
+def _columnarize_log_segment(
+    engine,
+    segment,
+    table_root: Optional[str],
+    small_only: bool,
+    early_replay: bool,
+) -> ColumnarActions:
     tracker = _SmallActionTracker()
     blocks: List[pa.Table] = []
     bytes_parsed = 0
@@ -872,25 +907,33 @@ def columnarize_log_segment(
 
     # --- checkpoint parts (columnar already) ---
     cp_version = segment.checkpoint_version
-    for fstat in segment.checkpoints:
-        try:
-            if fstat.path.endswith(".json"):
-                # V2 top-level checkpoint in JSON form
-                tbl = pa_json.read_json(pa.BufferReader(engine.fs.read_file(fstat.path)))
-                _consume_checkpoint_table(tbl)
-            else:
-                for tbl in _read_checkpoint_part(fstat.path):
-                    _consume_checkpoint_table(tbl)
-        except FileNotFoundError:
-            # selected as a complete checkpoint at LIST time, gone at
-            # read time (`DeltaErrors.missingPartFilesException`)
-            from delta_tpu.errors import LogCorruptedError
 
-            raise LogCorruptedError(
-                f"couldn't find all part files of the checkpoint at "
-                f"version {cp_version}: {fstat.path} is missing",
-                error_class="DELTA_MISSING_PART_FILES")
-        bytes_parsed += fstat.size
+    def _consume_checkpoint_parts():
+        nonlocal bytes_parsed
+        for fstat in segment.checkpoints:
+            try:
+                if fstat.path.endswith(".json"):
+                    # V2 top-level checkpoint in JSON form
+                    tbl = pa_json.read_json(pa.BufferReader(engine.fs.read_file(fstat.path)))
+                    _consume_checkpoint_table(tbl)
+                else:
+                    for tbl in _read_checkpoint_part(fstat.path):
+                        _consume_checkpoint_table(tbl)
+            except FileNotFoundError:
+                # selected as a complete checkpoint at LIST time, gone at
+                # read time (`DeltaErrors.missingPartFilesException`)
+                from delta_tpu.errors import LogCorruptedError
+
+                raise LogCorruptedError(
+                    f"couldn't find all part files of the checkpoint at "
+                    f"version {cp_version}: {fstat.path} is missing",
+                    error_class="DELTA_MISSING_PART_FILES")
+            bytes_parsed += fstat.size
+
+    if segment.checkpoints:
+        with obs.span("log.read_checkpoint", version=cp_version,
+                      parts=len(segment.checkpoints)):
+            _consume_checkpoint_parts()
 
     # --- compacted deltas + commits: parallel read, one JSON parse ---
     from delta_tpu.utils import filenames as fn

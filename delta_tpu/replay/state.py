@@ -24,6 +24,7 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
+from delta_tpu import obs
 from delta_tpu.errors import LogCorruptedError, UnsupportedTableFeatureError
 from delta_tpu.models.actions import (
     AddFile,
@@ -518,6 +519,13 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
     metrics.num_checkpoint_parts.increment(len(segment.checkpoints))
     metrics.num_actions.increment(columnar.num_actions)
     metrics.bytes_parsed.increment(columnar.bytes_parsed)
+    obs.set_attrs(
+        num_actions=columnar.num_actions,
+        num_commit_files=columnar.num_commit_files,
+        num_checkpoint_parts=len(segment.checkpoints),
+        bytes_parsed=columnar.bytes_parsed,
+        replay_mode="device" if use_device else "host",
+    )
     if getattr(engine, "metrics_reporters", None):
         engine.report_metrics(
             metrics.report(

@@ -21,6 +21,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from delta_tpu import obs
 from delta_tpu.expressions.tree import Expression, split_conjuncts
 from delta_tpu.models.actions import AddFile
 
@@ -70,7 +71,17 @@ class Scan:
         """Surviving AddFiles (canonical columnar schema) after pruning."""
         if self._result_cache is not None:
             return self._result_cache
+        with obs.span("scan.plan", table=self._snapshot.table_path,
+                      version=self._snapshot.version) as sp:
+            result = self._plan(sp)
+            sp.set_attrs(surviving=result.num_rows,
+                         partition_pruned=self.partition_pruned,
+                         skipped_by_stats=self.skipped_by_stats)
+            return result
+
+    def _plan(self, sp) -> pa.Table:
         files = self._snapshot.state.add_files_table
+        sp.set_attr("total_files", files.num_rows)
         if self.filter is None or files.num_rows == 0:
             self._result_cache = files
             return files

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from delta_tpu import obs
 from delta_tpu.log.segment import LogSegment
 from delta_tpu.models.actions import DomainMetadata, Metadata, Protocol, SetTransaction
 from delta_tpu.replay.state import (
@@ -47,7 +48,9 @@ class Snapshot:
     @property
     def state(self) -> SnapshotState:
         if self._state is None:
-            self._state = reconstruct_state(self._engine, self._segment)
+            with obs.span("snapshot.load", table=self._table.path,
+                          version=self.version):
+                self._state = reconstruct_state(self._engine, self._segment)
         return self._state
 
     @property
@@ -64,9 +67,15 @@ class Snapshot:
                 # (there are no parquet columns to skip), but a later
                 # full-state access would re-read and re-parse the whole
                 # log — reconstruct once and serve both
-                self._state = reconstruct_state(self._engine, self._segment)
+                with obs.span("snapshot.load", table=self._table.path,
+                              version=self.version):
+                    self._state = reconstruct_state(self._engine,
+                                                    self._segment)
                 return self._state
-            self._small = reconstruct_small_state(self._engine, self._segment)
+            with obs.span("snapshot.load_small", table=self._table.path,
+                          version=self.version):
+                self._small = reconstruct_small_state(self._engine,
+                                                      self._segment)
         return self._small
 
     @property
@@ -187,13 +196,27 @@ class Snapshot:
         )
 
         eng = engine if engine is not None else self._engine
-        try:
-            ext = extend_log_segment(eng.fs, self._segment)
-        except _IncrementalUnavailable:
-            return None
-        if ext is None:
-            return self
-        new_segment, new_deltas = ext
+        with obs.span("snapshot.update", table=self._table.path,
+                      from_version=self.version) as sp:
+            try:
+                ext = extend_log_segment(eng.fs, self._segment)
+            except _IncrementalUnavailable:
+                sp.set_attr("outcome", "fallback_full_load")
+                return None
+            if ext is None:
+                sp.set_attr("outcome", "unchanged")
+                return self
+            new_segment, new_deltas = ext
+            advanced = self._update_advance(eng, new_segment, new_deltas)
+            if advanced is None:
+                sp.set_attr("outcome", "fallback_full_load")
+            else:
+                sp.set_attrs(outcome="advanced",
+                             to_version=new_segment.version,
+                             new_commits=len(new_deltas))
+            return advanced
+
+    def _update_advance(self, eng, new_segment, new_deltas):
         if self._state is None:
             # no replayed state retained to advance — a lazy snapshot
             # over the extended segment costs the same as advancing
@@ -238,6 +261,11 @@ class Snapshot:
         if versions != list(range(self.version + 1,
                                   self.version + 1 + len(blobs))):
             return None
+        with obs.span("snapshot.advance_blobs", table=self._table.path,
+                      from_version=self.version, commits=len(blobs)):
+            return self._advance_with_blobs_inner(blobs, versions)
+
+    def _advance_with_blobs_inner(self, blobs, versions):
 
         import dataclasses
         import time

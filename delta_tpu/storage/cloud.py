@@ -42,6 +42,7 @@ import uuid
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from delta_tpu import obs
 from delta_tpu.storage.logstore import (
     DelegatingLogStore,
     FileAlreadyExistsError,
@@ -50,6 +51,15 @@ from delta_tpu.storage.logstore import (
 )
 
 _log = logging.getLogger(__name__)
+
+# cloud I/O counters: request counts and byte volumes per direction, plus
+# how often arbiter recovery had to retry — the signals a flaky-network
+# incident shows up in first
+_GCS_REQUESTS = obs.counter("storage.gcs.requests")
+_GCS_GET_BYTES = obs.counter("storage.gcs.get_bytes")
+_GCS_PUT_BYTES = obs.counter("storage.gcs.put_bytes")
+_ARBITER_FIXES = obs.counter("storage.arbiter.fixes")
+_ARBITER_FIX_RETRIES = obs.counter("storage.arbiter.fix_retries")
 
 Transport = Callable[[str, str, Dict[str, str], Optional[bytes]],
                      Tuple[int, Dict[str, str], bytes]]
@@ -104,40 +114,57 @@ class GCSObjectClient:
                + urllib.parse.urlencode(q))
         headers = self._headers()
         headers["Content-Type"] = "application/octet-stream"
-        status, _, body = self.transport("POST", url, headers, data)
-        if status == 412:
-            raise PreconditionFailedError(name)
-        if status >= 300:
-            raise IOError(f"GCS put {name}: HTTP {status} {body[:200]!r}")
+        _GCS_REQUESTS.inc()
+        _GCS_PUT_BYTES.inc(len(data))
+        with obs.span("storage.gcs.put", object=name, bytes=len(data),
+                      conditional=if_generation_match is not None) as sp:
+            status, _, body = self.transport("POST", url, headers, data)
+            sp.set_attr("http_status", status)
+            if status == 412:
+                raise PreconditionFailedError(name)
+            if status >= 300:
+                raise IOError(
+                    f"GCS put {name}: HTTP {status} {body[:200]!r}")
 
     def get(self, name: str) -> bytes:
         url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
                f"{urllib.parse.quote(name, safe='')}?alt=media")
-        status, _, body = self.transport("GET", url, self._headers(), None)
-        if status == 404:
-            raise FileNotFoundError(name)
-        if status >= 300:
-            raise IOError(f"GCS get {name}: HTTP {status}")
+        _GCS_REQUESTS.inc()
+        with obs.span("storage.gcs.get", _verbose=True, object=name) as sp:
+            status, _, body = self.transport("GET", url, self._headers(),
+                                             None)
+            sp.set_attr("http_status", status)
+            if status == 404:
+                raise FileNotFoundError(name)
+            if status >= 300:
+                raise IOError(f"GCS get {name}: HTTP {status}")
+            sp.set_attr("bytes", len(body))
+        _GCS_GET_BYTES.inc(len(body))
         return body
 
     def list_prefix(self, prefix: str) -> List[dict]:
-        items: List[dict] = []
-        page: Optional[str] = None
-        while True:
-            q = {"prefix": prefix}
-            if page:
-                q["pageToken"] = page
-            url = (f"{self.base}/storage/v1/b/{self.bucket}/o?"
-                   + urllib.parse.urlencode(q))
-            status, _, body = self.transport("GET", url, self._headers(),
-                                             None)
-            if status >= 300:
-                raise IOError(f"GCS list {prefix}: HTTP {status}")
-            doc = json.loads(body)
-            items.extend(doc.get("items", []))
-            page = doc.get("nextPageToken")
-            if not page:
-                return items
+        with obs.span("storage.gcs.list", prefix=prefix) as sp:
+            items: List[dict] = []
+            page: Optional[str] = None
+            pages = 0
+            while True:
+                q = {"prefix": prefix}
+                if page:
+                    q["pageToken"] = page
+                url = (f"{self.base}/storage/v1/b/{self.bucket}/o?"
+                       + urllib.parse.urlencode(q))
+                _GCS_REQUESTS.inc()
+                status, _, body = self.transport("GET", url, self._headers(),
+                                                 None)
+                pages += 1
+                if status >= 300:
+                    raise IOError(f"GCS list {prefix}: HTTP {status}")
+                doc = json.loads(body)
+                items.extend(doc.get("items", []))
+                page = doc.get("nextPageToken")
+                if not page:
+                    sp.set_attrs(pages=pages, objects=len(items))
+                    return items
 
     def stat(self, name: str) -> dict:
         """Object metadata (size/updated/generation) without the body —
@@ -467,25 +494,29 @@ class ExternalArbiterLogStore(DelegatingLogStore):
         writer/reader already did the copy."""
         if entry.complete:
             return
+        _ARBITER_FIXES.inc()
         target = entry.absolute_file_path()
         lk = self._path_locks.acquire(target)
         try:
-            copied = False
-            retry = 0
-            while True:
-                try:
-                    if not copied and not self.inner.exists(target):
-                        self._fix_copy_temp_file(entry.absolute_temp_path(),
-                                                 target)
-                        copied = True
-                    self._fix_put_complete_entry(entry)
-                    return
-                except FileAlreadyExistsError:
-                    copied = True  # another fixer copied; still ack
-                except Exception:
-                    retry += 1
-                    if retry >= 3:
-                        raise
+            with obs.span("storage.arbiter.fix", path=target) as sp:
+                copied = False
+                retry = 0
+                while True:
+                    try:
+                        if not copied and not self.inner.exists(target):
+                            self._fix_copy_temp_file(
+                                entry.absolute_temp_path(), target)
+                            copied = True
+                        self._fix_put_complete_entry(entry)
+                        sp.set_attr("retries", retry)
+                        return
+                    except FileAlreadyExistsError:
+                        copied = True  # another fixer copied; still ack
+                    except Exception:
+                        _ARBITER_FIX_RETRIES.inc()
+                        retry += 1
+                        if retry >= 3:
+                            raise
         finally:
             lk.release()
 
@@ -509,39 +540,47 @@ class ExternalArbiterLogStore(DelegatingLogStore):
             return
         lk = self._path_locks.acquire(path)
         try:
-            # Step 0: fail fast if N.json is already visible
-            if self.inner.exists(path):
-                raise FileAlreadyExistsError(path)
-            table_path = self._table_path(path)
-            version = int(name.split(".")[0])
-            # Step 1: ensure N-1.json exists (recover if half-committed)
-            if version > 0:
-                prev_name = f"{version - 1:020d}.json"
-                prev_entry = self.arbiter.get_entry(table_path, prev_name)
-                prev_path = f"{table_path}/_delta_log/{prev_name}"
-                if prev_entry is not None and not prev_entry.complete:
-                    self.fix_delta_log(prev_entry)
-                elif not self.inner.exists(prev_path):
-                    raise FileNotFoundError(
-                        f"previous commit {prev_path} does not exist")
-            # Step 2: PREPARE — write T(N), then claim the version with a
-            # conditional put of E(N, T(N), complete=false)
-            temp_rel = f"_delta_log/.tmp/{name}.{uuid.uuid4().hex}"
-            entry = ExternalCommitEntry(table_path, name, temp_rel,
-                                        complete=False)
-            self.inner.write(entry.absolute_temp_path(), data,
-                             overwrite=True)
-            self.arbiter.put_entry(entry, overwrite=False)  # the real race
-            try:
-                # Step 3: COMMIT — copy T(N) into N.json
-                self._write_copy_temp_file(entry.absolute_temp_path(), path)
-                # Step 4: ACKNOWLEDGE
-                self._write_put_complete_entry(entry)
-            except Exception as e:
-                # recoverable: we own E(N); any reader/writer will finish
-                # the copy+ack via fix_delta_log
-                _log.warning("commit %s prepared but copy/ack failed "
-                             "(%s); recovery via fix_delta_log", path, e)
+            with obs.span("storage.arbiter.write", path=path,
+                          bytes=len(data)) as sp:
+                # Step 0: fail fast if N.json is already visible
+                if self.inner.exists(path):
+                    raise FileAlreadyExistsError(path)
+                table_path = self._table_path(path)
+                version = int(name.split(".")[0])
+                # Step 1: ensure N-1.json exists (recover if half-committed)
+                if version > 0:
+                    prev_name = f"{version - 1:020d}.json"
+                    prev_entry = self.arbiter.get_entry(table_path, prev_name)
+                    prev_path = f"{table_path}/_delta_log/{prev_name}"
+                    if prev_entry is not None and not prev_entry.complete:
+                        sp.add_event("recover_previous", path=prev_path)
+                        self.fix_delta_log(prev_entry)
+                    elif not self.inner.exists(prev_path):
+                        raise FileNotFoundError(
+                            f"previous commit {prev_path} does not exist")
+                # Step 2: PREPARE — write T(N), then claim the version with
+                # a conditional put of E(N, T(N), complete=false)
+                temp_rel = f"_delta_log/.tmp/{name}.{uuid.uuid4().hex}"
+                entry = ExternalCommitEntry(table_path, name, temp_rel,
+                                            complete=False)
+                self.inner.write(entry.absolute_temp_path(), data,
+                                 overwrite=True)
+                sp.add_event("prepare")
+                self.arbiter.put_entry(entry, overwrite=False)  # the race
+                try:
+                    # Step 3: COMMIT — copy T(N) into N.json
+                    self._write_copy_temp_file(entry.absolute_temp_path(),
+                                               path)
+                    sp.add_event("commit")
+                    # Step 4: ACKNOWLEDGE
+                    self._write_put_complete_entry(entry)
+                    sp.add_event("acknowledge")
+                except Exception as e:
+                    # recoverable: we own E(N); any reader/writer will
+                    # finish the copy+ack via fix_delta_log
+                    sp.set_attr("deferred_recovery", True)
+                    _log.warning("commit %s prepared but copy/ack failed "
+                                 "(%s); recovery via fix_delta_log", path, e)
         finally:
             lk.release()
 

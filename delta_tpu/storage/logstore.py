@@ -30,6 +30,14 @@ import uuid
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Optional
 
+from delta_tpu import obs
+
+# local-store counters: fsync count tracks commit durability cost, the
+# conflict counter counts put-if-absent races lost (each one is a txn
+# retry upstream)
+_LOCAL_FSYNCS = obs.counter("storage.local.fsyncs")
+_LOCAL_CONFLICTS = obs.counter("storage.local.conflicts")
+
 
 @dataclass(frozen=True)
 class FileStatus:
@@ -108,6 +116,7 @@ class LocalLogStore(LogStore):
                 f.write(data)
                 f.flush()
                 os.fsync(f.fileno())
+            _LOCAL_FSYNCS.inc()
             os.replace(tmp, path)
             return
         # Atomic put-if-absent. Write to a temp file first so a crash
@@ -118,9 +127,14 @@ class LocalLogStore(LogStore):
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
+        _LOCAL_FSYNCS.inc()
         try:
             os.link(tmp, path)
         except FileExistsError:
+            # the exact moment a commit race is lost — pin it to the
+            # enclosing txn-attempt span before the retry machinery runs
+            _LOCAL_CONFLICTS.inc()
+            obs.add_event("commit_conflict", path=path)
             raise FileAlreadyExistsError(path)
         finally:
             os.unlink(tmp)

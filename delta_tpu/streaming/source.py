@@ -21,6 +21,7 @@ from typing import ClassVar, Iterator, List, Optional
 
 import pyarrow as pa
 
+from delta_tpu import obs
 from delta_tpu.errors import DeltaError, StreamingSchemaChangeError, StreamingSourceError
 from delta_tpu.models.actions import (
     AddFile,
@@ -550,14 +551,17 @@ class DeltaSource:
         self, start: Optional[DeltaSourceOffset] = None,
         limits: Optional[ReadLimits] = None,
     ) -> Optional[DeltaSourceOffset]:
-        self._check_offset_table(start)
-        files = self._indexed_after(start, limits or ReadLimits())
-        if not files:
-            return start
-        last = files[-1]
-        return DeltaSourceOffset(
-            last.version, last.index, last.is_initial,
-            reservoir_id=self._table_id())
+        with obs.span("stream.latest_offset", table=self.table.path) as sp:
+            self._check_offset_table(start)
+            files = self._indexed_after(start, limits or ReadLimits())
+            sp.set_attr("new_files", len(files))
+            if not files:
+                return start
+            last = files[-1]
+            sp.set_attrs(to_version=last.version, to_index=last.index)
+            return DeltaSourceOffset(
+                last.version, last.index, last.is_initial,
+                reservoir_id=self._table_id())
 
     def get_batch(
         self,
@@ -565,14 +569,21 @@ class DeltaSource:
         end: DeltaSourceOffset,
     ) -> pa.Table:
         """All rows in files after `start` up to and including `end`."""
-        self._check_offset_table(start, end)
-        files = self._indexed_after(start, ReadLimits(max_files=None, max_bytes=None))
-        # Initial-snapshot files share the start snapshot's version and the
-        # tail begins at version+1, so (version, index) totally orders the
-        # stream.
-        end_key = (end.reservoir_version, end.index)
-        selected = [f.add for f in files if (f.version, f.index) <= end_key]
-        return self._read_adds(selected)
+        with obs.span("stream.get_batch", table=self.table.path,
+                      end_version=end.reservoir_version,
+                      end_index=end.index) as sp:
+            self._check_offset_table(start, end)
+            files = self._indexed_after(
+                start, ReadLimits(max_files=None, max_bytes=None))
+            # Initial-snapshot files share the start snapshot's version and
+            # the tail begins at version+1, so (version, index) totally
+            # orders the stream.
+            end_key = (end.reservoir_version, end.index)
+            selected = [
+                f.add for f in files if (f.version, f.index) <= end_key]
+            batch = self._read_adds(selected)
+            sp.set_attrs(files_read=len(selected), rows=batch.num_rows)
+            return batch
 
     def _read_adds(self, adds: List[AddFile]) -> pa.Table:
         from delta_tpu.read.reader import _absolute_path
@@ -702,6 +713,18 @@ class DeltaCDCSource:
         self, start: Optional[DeltaSourceOffset] = None,
         limits: Optional[ReadLimits] = None,
     ) -> Optional[DeltaSourceOffset]:
+        with obs.span("stream.cdc_latest_offset",
+                      table=self.table.path) as sp:
+            out = self._latest_offset(start, limits)
+            if out is not None:
+                sp.set_attrs(to_version=out.reservoir_version,
+                             initial=out.is_initial_snapshot)
+            return out
+
+    def _latest_offset(
+        self, start: Optional[DeltaSourceOffset],
+        limits: Optional[ReadLimits],
+    ) -> Optional[DeltaSourceOffset]:
         self._ensure_initial()
         limits = limits or ReadLimits()
         budget_files = (limits.max_files if limits.max_files is not None
@@ -746,19 +769,23 @@ class DeltaCDCSource:
     ) -> pa.Table:
         from delta_tpu.read.cdc import table_changes
 
-        self._ensure_initial()
-        parts = []
-        if start is None and self._starting_version is None:
-            parts.append(self._initial_snapshot_as_inserts())
-        begin = ((self._initial_version + 1) if start is None
-                 else start.reservoir_version + 1)
-        if not end.is_initial_snapshot and begin <= end.reservoir_version:
-            parts.append(table_changes(self.table, begin,
-                                       end.reservoir_version))
-        parts = [p for p in parts if p.num_rows]
-        if not parts:
-            return self._empty_batch()
-        return pa.concat_tables(parts, promote_options="permissive")
+        with obs.span("stream.cdc_get_batch", table=self.table.path,
+                      end_version=end.reservoir_version) as sp:
+            self._ensure_initial()
+            parts = []
+            if start is None and self._starting_version is None:
+                parts.append(self._initial_snapshot_as_inserts())
+            begin = ((self._initial_version + 1) if start is None
+                     else start.reservoir_version + 1)
+            if not end.is_initial_snapshot and begin <= end.reservoir_version:
+                parts.append(table_changes(self.table, begin,
+                                           end.reservoir_version))
+            parts = [p for p in parts if p.num_rows]
+            if not parts:
+                return self._empty_batch()
+            batch = pa.concat_tables(parts, promote_options="permissive")
+            sp.set_attr("rows", batch.num_rows)
+            return batch
 
     def _commit_timestamp(self, version: int) -> int:
         try:

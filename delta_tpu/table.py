@@ -12,6 +12,7 @@ import logging
 import threading
 from typing import Optional
 
+from delta_tpu import obs
 from delta_tpu.engine.tpu import default_engine
 from delta_tpu.errors import TableNotFoundError
 from delta_tpu.log.last_checkpoint import read_last_checkpoint
@@ -52,31 +53,34 @@ class Table:
         newest snapshot; reuses the cached state when the version is
         unchanged. Coordinated-commit tables additionally merge the
         coordinator's unbackfilled commits (`Snapshot.scala:166-220`)."""
-        hint = read_last_checkpoint(self.engine.fs, self.log_path)
-        segment = build_log_segment(
-            self.engine.fs,
-            self.log_path,
-            target_version=None,
-            checkpoint_hint=hint.version if hint else None,
-        )
-        with self._lock:
-            cached = self._cached_snapshot
-        if (
-            cached is not None
-            and cached.version == segment.version
-            and not self._coordinated
-        ):
-            return cached
-        snap = Snapshot(self, segment)
-        merged = self._merge_unbackfilled(snap, segment)
-        if merged is not segment:
-            snap = Snapshot(self, merged)
-        with self._lock:
-            cached = self._cached_snapshot
-            if cached is not None and cached.version == snap.version:
+        with obs.span("table.latest_snapshot", table=self.path) as sp:
+            hint = read_last_checkpoint(self.engine.fs, self.log_path)
+            segment = build_log_segment(
+                self.engine.fs,
+                self.log_path,
+                target_version=None,
+                checkpoint_hint=hint.version if hint else None,
+            )
+            sp.set_attr("version", segment.version)
+            with self._lock:
+                cached = self._cached_snapshot
+            if (
+                cached is not None
+                and cached.version == segment.version
+                and not self._coordinated
+            ):
+                sp.set_attr("cache_hit", True)
                 return cached
-            self._cached_snapshot = snap
-            return snap
+            snap = Snapshot(self, segment)
+            merged = self._merge_unbackfilled(snap, segment)
+            if merged is not segment:
+                snap = Snapshot(self, merged)
+            with self._lock:
+                cached = self._cached_snapshot
+                if cached is not None and cached.version == snap.version:
+                    return cached
+                self._cached_snapshot = snap
+                return snap
 
     def _merge_unbackfilled(self, probe: Snapshot, segment):
         """Extend the listed segment with the commit coordinator's
@@ -125,21 +129,22 @@ class Table:
         `latest_snapshot()` load when there is no usable cached snapshot
         or incremental maintenance is unavailable (checkpoint boundary,
         listing gap, protocol change, coordinated tables)."""
-        with self._lock:
-            cached = self._cached_snapshot
-        if cached is None or self._coordinated:
-            return self.latest_snapshot()
-        advanced = cached.update()
-        if advanced is None:
-            return self.latest_snapshot()
-        if advanced is not cached:
+        with obs.span("table.update", table=self.path):
             with self._lock:
-                cur = self._cached_snapshot
-                if cur is None or cur.version <= advanced.version:
-                    self._cached_snapshot = advanced
-                else:
-                    advanced = cur  # a racing full load got further
-        return advanced
+                cached = self._cached_snapshot
+            if cached is None or self._coordinated:
+                return self.latest_snapshot()
+            advanced = cached.update()
+            if advanced is None:
+                return self.latest_snapshot()
+            if advanced is not cached:
+                with self._lock:
+                    cur = self._cached_snapshot
+                    if cur is None or cur.version <= advanced.version:
+                        self._cached_snapshot = advanced
+                    else:
+                        advanced = cur  # a racing full load got further
+            return advanced
 
     def notify_commit(self, version: int, data: bytes) -> None:
         """Post-commit handoff: a transaction that just wrote commit
@@ -222,7 +227,9 @@ class Table:
 
             raise CheckpointError(
                 f"cannot checkpoint a non-existent table: {e}") from e
-        write_checkpoint(self.engine, snap)
+        with obs.span("table.checkpoint", table=self.path,
+                      version=snap.version):
+            write_checkpoint(self.engine, snap)
         # reseed the incremental .crc chain from the full state: a commit
         # whose checksum couldn't be derived (e.g. removes without sizes)
         # breaks the chain, and the checkpoint is the natural recovery

@@ -6,5 +6,6 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     hygiene,
     imports,
     locks,
+    obs,
     purity,
 )

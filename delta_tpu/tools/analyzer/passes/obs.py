@@ -1,0 +1,119 @@
+"""Tracing-hygiene rule: ``obs-span-leak``.
+
+Two ways an instrumented module silently corrupts traces:
+
+- **span() outside ``with``** — ``obs.span(...)`` returns a context
+  manager; the span only starts/finishes (and restores the contextvar
+  parent stack) through ``__enter__``/``__exit__``. A bare call —
+  assigned to a variable, passed as an argument, or discarded — never
+  records and, worse, reads as instrumentation that isn't there.
+- **raw ``time.perf_counter_ns()``** — hand-rolled timing in a module
+  that already imports ``delta_tpu.obs`` bypasses the span clock: the
+  measured interval exists nowhere in the trace tree, so self-time math
+  and Chrome export silently disagree with it. Use a span (or a
+  registry histogram); audited exceptions carry a
+  ``# delta-lint: disable=obs-span-leak`` pragma (e.g. ``metrics.py``,
+  whose reports must work with tracing off).
+
+The ``delta_tpu/obs`` package itself is the implementation of the span
+clock and is exempt by path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+_OBS_MODULES = ("delta_tpu.obs", "delta_tpu.obs.trace")
+
+
+def _span_call_names(tree: ast.Module) -> Set[str]:
+    """Dotted call names that resolve to ``delta_tpu.obs``'s ``span`` in
+    this module: ``from delta_tpu.obs import span [as x]`` binds ``x``;
+    ``from delta_tpu import obs [as o]`` / ``import delta_tpu.obs as o``
+    bind ``o.span``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module in _OBS_MODULES:
+                for a in node.names:
+                    if a.name == "span":
+                        names.add(a.asname or a.name)
+            elif node.module == "delta_tpu":
+                for a in node.names:
+                    if a.name == "obs":
+                        names.add(f"{a.asname or a.name}.span")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "delta_tpu.obs":
+                    names.add(f"{a.asname or a.name}.span"
+                              if a.asname else "delta_tpu.obs.span")
+    return names
+
+
+def _imports_obs(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.startswith("delta_tpu.obs"):
+                return True
+            if node.module == "delta_tpu" and any(
+                    a.name == "obs" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.startswith("delta_tpu.obs")
+                   for a in node.names):
+                return True
+    return False
+
+
+@register
+class ObsSpanLeakRule(Rule):
+    id = "obs-span-leak"
+    description = ("span(...) used outside a `with` statement (the span "
+                   "never records), or raw time.perf_counter_ns() timing "
+                   "in a module that is already span-instrumented")
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        # the obs package IS the span clock; its internal cross-imports
+        # (trace -> export) must not make it count as instrumented
+        rel = mod.rel.replace("\\", "/")
+        if "delta_tpu/obs/" in rel or rel.startswith("obs/"):
+            return []
+        span_names = _span_call_names(tree)
+        instrumented = _imports_obs(tree)
+        if not span_names and not instrumented:
+            return []
+
+        with_calls: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        with_calls.add(id(item.context_expr))
+
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            if name in span_names and id(node) not in with_calls:
+                out.append(Finding(
+                    self.id, mod.rel, node.lineno, node.col_offset,
+                    f"{name}(...) outside a `with` statement: the span "
+                    f"is never entered, so it records nothing and the "
+                    f"code looks instrumented when it isn't"))
+            elif instrumented and name == "time.perf_counter_ns":
+                out.append(Finding(
+                    self.id, mod.rel, node.lineno, node.col_offset,
+                    "raw time.perf_counter_ns() in a span-instrumented "
+                    "module: the interval bypasses the trace tree — use "
+                    "a span (or audit + suppress)"))
+        return out

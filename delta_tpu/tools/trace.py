@@ -1,0 +1,194 @@
+"""delta-trace: summarize a delta-tpu trace file.
+
+Usage::
+
+    delta-trace trace.jsonl                    # per-operation summary table
+    delta-trace trace.jsonl --sort self        # order by self-time
+    delta-trace trace.jsonl --tree             # slowest trace as a span tree
+    delta-trace trace.jsonl --chrome out.json  # convert to Chrome format
+    python -m delta_tpu.tools.trace ...        # same, without the script
+
+Accepts either shape `delta_tpu.obs` writes: JSONL span records
+(`DELTA_TPU_TRACE_FILE`) or a Chrome trace-event document. The summary
+is per span *name*: count, total wall time, self time (total minus time
+attributed to child spans), mean/p95/max, and error count — the
+latency/self-time table a slow snapshot load or txn retry storm is
+diagnosed from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from delta_tpu.obs.export import load_spans, write_chrome_trace
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (q in [0, 100])."""
+    if not sorted_values:
+        return 0.0
+    rank = max(0, min(len(sorted_values) - 1,
+                      int(round(q / 100.0 * (len(sorted_values) - 1)))))
+    return sorted_values[rank]
+
+
+def compute_self_times(spans: List[Dict[str, object]]) -> Dict[str, float]:
+    """Self time per span id: duration minus the sum of direct-children
+    durations (clamped at zero — clock skew across threads can make the
+    children nominally exceed the parent)."""
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    child_total: Dict[str, int] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent and parent in by_id:
+            child_total[parent] = (child_total.get(parent, 0)
+                                   + int(s.get("duration_ns") or 0))
+    out: Dict[str, float] = {}
+    for sid, s in by_id.items():
+        dur = int(s.get("duration_ns") or 0)
+        out[sid] = max(0, dur - child_total.get(sid, 0))
+    return out
+
+
+def summarize(spans: List[Dict[str, object]]) -> List[Dict[str, object]]:
+    """Aggregate spans into per-operation rows (sorted by total time)."""
+    self_ns = compute_self_times(spans)
+    groups: Dict[str, List[Dict[str, object]]] = {}
+    for s in spans:
+        groups.setdefault(str(s.get("name")), []).append(s)
+    rows = []
+    for name, group in groups.items():
+        durs_ms = sorted((int(s.get("duration_ns") or 0)) / 1e6
+                         for s in group)
+        total_ms = sum(durs_ms)
+        self_ms = sum(self_ns.get(s.get("span_id"), 0) for s in group) / 1e6
+        rows.append({
+            "operation": name,
+            "count": len(group),
+            "total_ms": total_ms,
+            "self_ms": self_ms,
+            "avg_ms": total_ms / len(group) if group else 0.0,
+            "p95_ms": _percentile(durs_ms, 95),
+            "max_ms": durs_ms[-1] if durs_ms else 0.0,
+            "errors": sum(1 for s in group if s.get("status") == "error"),
+        })
+    rows.sort(key=lambda r: r["total_ms"], reverse=True)
+    return rows
+
+
+def format_table(rows: List[Dict[str, object]]) -> str:
+    headers = ["OPERATION", "COUNT", "TOTAL_MS", "SELF_MS", "AVG_MS",
+               "P95_MS", "MAX_MS", "ERRORS"]
+    body = [
+        [r["operation"], str(r["count"]), f"{r['total_ms']:.3f}",
+         f"{r['self_ms']:.3f}", f"{r['avg_ms']:.3f}", f"{r['p95_ms']:.3f}",
+         f"{r['max_ms']:.3f}", str(r["errors"])]
+        for r in rows
+    ]
+    widths = [max(len(h), *(len(row[i]) for row in body)) if body else len(h)
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(headers))]
+    for row in body:
+        cells = [row[0].ljust(widths[0])]
+        cells += [row[i].rjust(widths[i]) for i in range(1, len(headers))]
+        lines.append("  ".join(cells))
+    return "\n".join(lines)
+
+
+def format_tree(spans: List[Dict[str, object]],
+                trace_id: Optional[str] = None) -> str:
+    """Render one trace (default: the one with the slowest root span) as
+    an indented span tree with durations."""
+    roots = [s for s in spans
+             if not s.get("parent_id")
+             or s["parent_id"] not in {x.get("span_id") for x in spans}]
+    if trace_id is None:
+        if not roots:
+            return "(no root spans)"
+        trace_id = max(roots,
+                       key=lambda s: int(s.get("duration_ns") or 0)
+                       )["trace_id"]
+    in_trace = [s for s in spans if s.get("trace_id") == trace_id]
+    children: Dict[Optional[str], List[Dict[str, object]]] = {}
+    ids = {s.get("span_id") for s in in_trace}
+    for s in in_trace:
+        parent = s.get("parent_id")
+        key = parent if parent in ids else None
+        children.setdefault(key, []).append(s)
+    for group in children.values():
+        group.sort(key=lambda s: int(s.get("start_unix_ns") or 0))
+    lines = [f"trace {trace_id}"]
+
+    def walk(parent_key, depth):
+        for s in children.get(parent_key, []):
+            dur_ms = (int(s.get("duration_ns") or 0)) / 1e6
+            mark = "" if s.get("status") != "error" else "  [ERROR]"
+            lines.append(f"{'  ' * depth}{s.get('name')}  "
+                         f"{dur_ms:.3f}ms{mark}")
+            walk(s.get("span_id"), depth + 1)
+
+    walk(None, 1)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="delta-trace",
+        description="Summarize a delta-tpu trace file (JSONL or Chrome "
+                    "trace-event JSON).",
+    )
+    parser.add_argument("trace_file", help="JSONL span file or Chrome "
+                        "trace JSON")
+    parser.add_argument("--sort", choices=["total", "self", "count", "name"],
+                        default="total", help="summary ordering")
+    parser.add_argument("--limit", type=int, default=0,
+                        help="show at most N rows (0 = all)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the summary as JSON instead of a table")
+    parser.add_argument("--tree", action="store_true",
+                        help="also print the slowest trace as a span tree")
+    parser.add_argument("--chrome", metavar="OUT",
+                        help="convert the input to Chrome trace-event "
+                             "format at OUT")
+    args = parser.parse_args(argv)
+
+    try:
+        spans = load_spans(args.trace_file)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"delta-trace: cannot read {args.trace_file}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.chrome:
+        write_chrome_trace(args.chrome, spans)
+        print(f"wrote {len(spans)} spans to {args.chrome}", file=sys.stderr)
+
+    rows = summarize(spans)
+    key = {"total": "total_ms", "self": "self_ms", "count": "count",
+           "name": "operation"}[args.sort]
+    rows.sort(key=lambda r: r[key], reverse=(args.sort != "name"))
+    if args.limit > 0:
+        rows = rows[: args.limit]
+
+    try:
+        if args.json:
+            print(json.dumps({"spans": len(spans), "operations": rows},
+                             indent=2))
+        else:
+            print(f"{len(spans)} spans, {len(rows)} operations "
+                  f"({args.trace_file})")
+            print(format_table(rows))
+            if args.tree:
+                print()
+                print(format_tree(spans))
+    except BrokenPipeError:
+        # downstream pager/head closed stdout; exit quietly like any CLI
+        sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

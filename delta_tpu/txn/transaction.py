@@ -24,6 +24,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from delta_tpu import obs
 from delta_tpu.config import (
     CHECKPOINT_INTERVAL,
     IN_COMMIT_TIMESTAMPS,
@@ -539,14 +540,15 @@ class Transaction:
         actions.extend(self._cdcs)
         return actions
 
-    def _compute_metrics(self) -> Dict[str, object]:
+    def _compute_metrics(self) -> Dict[str, str]:
         m = {
             "numOutputFiles": str(len(self._adds)),
             "numOutputBytes": str(sum(a.size for a in self._adds)),
         }
         if self._removes:
             m["numRemovedFiles"] = str(len(self._removes))
-        m.update({k: str(v) for k, v in self._op_metrics.items()})
+        m.update({k: _metric_str(v) for k, v in self._op_metrics.items()
+                  if v is not None})
         return m
 
     def _isolation_level(self) -> IsolationLevel:
@@ -639,6 +641,16 @@ class Transaction:
         if self._committed:
             raise InvalidArgumentError("transaction already committed",
                                        error_class="DELTA_TRANSACTION_ALREADY_COMMITTED")
+        with obs.span("txn.commit", table=self._table.path,
+                      operation=self.operation,
+                      read_version=self.read_version,
+                      txn_id=self.txn_id) as csp:
+            result = self._commit_loop()
+            csp.set_attrs(committed_version=result.version,
+                          attempts=result.attempts)
+            return result
+
+    def _commit_loop(self) -> CommitResult:
         engine = self._table.engine
         log_path = self._table.log_path
         attempt_version = self.read_version + 1
@@ -666,76 +678,87 @@ class Transaction:
 
         while attempts <= self._max_retries:
             attempts += 1
-            if self.observer:
-                self.observer.before_commit_attempt(self, attempt_version)
-            actions = self._prepare_actions(attempt_version, winners_ict)
-            data = actions_to_commit_bytes(actions)
-            if self.observer:
-                # prepare/commit phase boundary: actions are validated +
-                # serialized; the commit file is not yet visible
-                hook = getattr(self.observer, "after_prepare", None)
-                if hook is not None:
-                    hook(self, attempt_version)
-            try:
-                self._write_commit(engine, log_path, attempt_version, data)
-            except FileExistsError:
+            with obs.span("txn.attempt", attempt=attempts,
+                          version=attempt_version) as asp:
                 if self.observer:
-                    self.observer.on_commit_conflict(self, attempt_version)
-                # We lost the race: find the current latest, check logical
-                # conflicts against every winner, rebase, retry.
-                latest = self._latest_version(engine, log_path, attempt_version)
-                winners = self._read_commit_range(
-                    engine, log_path, attempt_version, latest
-                )
+                    self.observer.before_commit_attempt(self, attempt_version)
+                actions = self._prepare_actions(attempt_version, winners_ict)
+                data = actions_to_commit_bytes(actions)
+                if self.observer:
+                    # prepare/commit phase boundary: actions are validated +
+                    # serialized; the commit file is not yet visible
+                    hook = getattr(self.observer, "after_prepare", None)
+                    if hook is not None:
+                        hook(self, attempt_version)
                 try:
-                    rebase = check_conflicts(self._read_state(), winners)
-                except Exception:
-                    _report(None, False)
-                    raise
-                if rebase.get("row_id_high_watermark") is not None:
-                    self._winners_row_watermark = max(
-                        self._winners_row_watermark or -1,
-                        rebase["row_id_high_watermark"],
-                    )
-                ict_on = self.read_snapshot is not None and \
-                    get_table_config(
-                        self.read_snapshot.metadata.configuration,
-                        IN_COMMIT_TIMESTAMPS)
-                for w in winners:
-                    # a winner may toggle ICT itself: its Metadata
-                    # governs whether IT and later winners must carry
-                    # an inCommitTimestamp
-                    wmeta = next(
-                        (a for a in w.actions if isinstance(a, Metadata)),
-                        None)
-                    if wmeta is not None:
-                        ict_on = get_table_config(
-                            wmeta.configuration, IN_COMMIT_TIMESTAMPS)
-                    ci = next(
-                        (a for a in w.actions if isinstance(a, CommitInfo)), None
-                    )
-                    if ci is not None and ci.inCommitTimestamp is not None:
-                        winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
-                    elif ict_on:
-                        # `CommitInfo.getRequiredInCommitTimestamp`:
-                        # on an ICT table every commit must carry its
-                        # timestamp — a winner without one corrupts
-                        # the monotonic clock this rebase maintains
-                        from delta_tpu.errors import LogCorruptedError
+                    self._write_commit(engine, log_path, attempt_version, data)
+                except FileExistsError:
+                    asp.set_attr("conflict", True)
+                    if self.observer:
+                        self.observer.on_commit_conflict(self, attempt_version)
+                    # We lost the race: find the current latest, check logical
+                    # conflicts against every winner, rebase, retry.
+                    latest = self._latest_version(engine, log_path,
+                                                  attempt_version)
+                    with obs.span("txn.conflict_check",
+                                  lost_version=attempt_version,
+                                  winners=latest - attempt_version + 1):
+                        winners = self._read_commit_range(
+                            engine, log_path, attempt_version, latest
+                        )
+                        try:
+                            rebase = check_conflicts(self._read_state(),
+                                                     winners)
+                        except Exception:
+                            _report(None, False)
+                            raise
+                    if rebase.get("row_id_high_watermark") is not None:
+                        self._winners_row_watermark = max(
+                            self._winners_row_watermark or -1,
+                            rebase["row_id_high_watermark"],
+                        )
+                    ict_on = self.read_snapshot is not None and \
+                        get_table_config(
+                            self.read_snapshot.metadata.configuration,
+                            IN_COMMIT_TIMESTAMPS)
+                    for w in winners:
+                        # a winner may toggle ICT itself: its Metadata
+                        # governs whether IT and later winners must carry
+                        # an inCommitTimestamp
+                        wmeta = next(
+                            (a for a in w.actions if isinstance(a, Metadata)),
+                            None)
+                        if wmeta is not None:
+                            ict_on = get_table_config(
+                                wmeta.configuration, IN_COMMIT_TIMESTAMPS)
+                        ci = next(
+                            (a for a in w.actions if isinstance(a, CommitInfo)), None
+                        )
+                        if ci is not None and ci.inCommitTimestamp is not None:
+                            winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
+                        elif ict_on:
+                            # `CommitInfo.getRequiredInCommitTimestamp`:
+                            # on an ICT table every commit must carry its
+                            # timestamp — a winner without one corrupts
+                            # the monotonic clock this rebase maintains
+                            from delta_tpu.errors import LogCorruptedError
 
-                        _report(None, False)
-                        if ci is None:
+                            _report(None, False)
+                            if ci is None:
+                                raise LogCorruptedError(
+                                    f"commit {w.version} has no commitInfo "
+                                    "but in-commit timestamps are enabled",
+                                    error_class="DELTA_MISSING_COMMIT_INFO")
                             raise LogCorruptedError(
-                                f"commit {w.version} has no commitInfo "
-                                "but in-commit timestamps are enabled",
-                                error_class="DELTA_MISSING_COMMIT_INFO")
-                        raise LogCorruptedError(
-                            f"commitInfo of commit {w.version} has no "
-                            "inCommitTimestamp but in-commit "
-                            "timestamps are enabled",
-                            error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
-                attempt_version = latest + 1
-                continue
+                                f"commitInfo of commit {w.version} has no "
+                                "inCommitTimestamp but in-commit "
+                                "timestamps are enabled",
+                                error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
+                    # no backoff sleep today: rebase work itself spaces the
+                    # retries; the attr keeps trace shape stable if one lands
+                    asp.set_attrs(rebased_to=latest + 1, backoff_ms=0)
+                    attempt_version = latest + 1
+                    continue
             self._committed = True
             # hand the bytes we just wrote to the snapshot cache BEFORE
             # the hooks run, so they (and the next update() poll) advance
@@ -788,6 +811,19 @@ class Transaction:
             # be observable, or checkpoint/checksum drift is silent.
             _log.warning("post-commit hook failed after commit %d "
                          "(commit is durable)", version, exc_info=True)
+
+
+def _metric_str(v) -> str:
+    """operationMetrics values are string-valued in the reference's
+    commitInfo serialization (`SQLMetric.value.toString` — booleans as
+    'true'/'false', integral floats without the trailing '.0'). Callers
+    hand `set_operation_metrics` arbitrary objects; this is the one
+    normalization point."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
 
 
 _INVALID_NAME_CHARS = " ,;{}()\n\t="

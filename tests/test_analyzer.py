@@ -666,7 +666,7 @@ def test_render_json_roundtrip():
 
 
 def test_every_registered_rule_has_fixture_coverage():
-    """Each of the five analysis passes must be exercised above; this
+    """Each of the six analysis passes must be exercised above; this
     guards the registry against silently-unregistered rules."""
     expected = {
         "lock-order", "lock-io", "global-mutation",          # locks
@@ -675,8 +675,92 @@ def test_every_registered_rule_has_fixture_coverage():
         "error-untyped-raise",                               # catalog
         "except-swallow", "mutable-default",                 # hygiene
         "undefined-name",                                    # imports
+        "obs-span-leak",                                     # obs
     }
     assert set(all_rules()) == expected
+
+
+# ----------------------------------------------------- obs-span-leak
+
+
+def test_obs_span_leak_bare_call_flagged():
+    src = """
+from delta_tpu import obs
+
+def load():
+    s = obs.span("snapshot.load")  # never entered
+    do_work()
+    return s
+"""
+    report = analyze_sources({"m.py": src}, rules=["obs-span-leak"])
+    found = _rules_fired(report, "obs-span-leak")
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_obs_span_leak_from_import_alias_flagged():
+    src = """
+from delta_tpu.obs import span as _span
+
+def load():
+    ctx = _span("snapshot.load")
+    with ctx:
+        pass
+"""
+    report = analyze_sources({"m.py": src}, rules=["obs-span-leak"])
+    assert _rules_fired(report, "obs-span-leak"), \
+        "span bound to a variable first is still a leak (parent is read " \
+        "at __enter__, not at construction)"
+
+
+def test_obs_span_leak_raw_perf_counter_flagged():
+    src = """
+import time
+from delta_tpu import obs
+
+def load():
+    t0 = time.perf_counter_ns()
+    with obs.span("snapshot.load"):
+        pass
+    return time.perf_counter_ns() - t0
+"""
+    report = analyze_sources({"m.py": src}, rules=["obs-span-leak"])
+    assert len(_rules_fired(report, "obs-span-leak")) == 2
+
+
+def test_obs_span_leak_negative():
+    # with-statement spans and perf_counter_ns in UNinstrumented
+    # modules are both fine
+    clean = """
+from delta_tpu import obs
+
+def load():
+    with obs.span("snapshot.load", table="/t") as sp:
+        sp.set_attr("version", 3)
+"""
+    uninstrumented = """
+import time
+
+def bench():
+    t0 = time.perf_counter_ns()
+    return time.perf_counter_ns() - t0
+"""
+    report = analyze_sources(
+        {"a.py": clean, "b.py": uninstrumented}, rules=["obs-span-leak"])
+    assert not report.findings
+
+
+def test_obs_span_leak_suppression_pragma():
+    src = """
+import time
+from delta_tpu import obs
+
+def measure():
+    # delta-lint: disable=obs-span-leak
+    t0 = time.perf_counter_ns()
+    return t0
+"""
+    report = analyze_sources({"m.py": src}, rules=["obs-span-leak"])
+    assert not report.findings and report.suppressed
 
 
 # ------------------------------------------------------ whole-repo gate

@@ -59,6 +59,47 @@ def test_engine_call_efficiency(tmp_table_path):
     assert len([p for p in commit_reads if not p.endswith("_last_checkpoint")]) == 5
 
 
+def test_operation_metrics_string_round_trip(tmp_table_path):
+    """operationMetrics serializes as a string-valued map (reference
+    `CommitInfo.operationMetrics: Map[String, String]`) and history
+    surfaces the same strings back."""
+    import json
+
+    from delta_tpu.history import get_history
+    from delta_tpu.txn.transaction import Operation
+    from delta_tpu.utils import filenames
+
+    engine = HostEngine()
+    dta.write_table(tmp_table_path, _data(5), engine=engine)
+    table = Table.for_path(tmp_table_path, engine)
+    txn = table.create_transaction_builder(Operation.WRITE).build()
+    txn.set_operation_metrics({
+        "numOutputRows": 5,          # int
+        "executionTimeMs": 12.0,     # integral float -> "12"
+        "fractionScanned": 0.25,     # real float -> "0.25"
+        "materializeSourceReason": "none",  # string passes through
+        "skipped": None,             # dropped, not serialized as "None"
+    })
+    version = txn.commit().version
+
+    raw = engine.fs.read_file(
+        filenames.delta_file(table.log_path, version))
+    ci = next(json.loads(l)["commitInfo"] for l in raw.splitlines()
+              if b"commitInfo" in l)
+    om = ci["operationMetrics"]
+    assert om["numOutputRows"] == "5"
+    assert om["executionTimeMs"] == "12"
+    assert om["fractionScanned"] == "0.25"
+    assert om["materializeSourceReason"] == "none"
+    assert "skipped" not in om
+    assert all(isinstance(v, str) for v in om.values())
+
+    rec = next(r for r in get_history(table) if r.version == version)
+    surfaced = rec.to_dict()["operationMetrics"]
+    for k, v in om.items():
+        assert surfaced[k] == v
+
+
 def test_metadata_access_skips_file_replay(tmp_table_path, monkeypatch):
     """P&M / txn / domain accessors must never trigger the full
     file-level state reconstruction (`Snapshot.scala:440` fast path)."""

@@ -1,0 +1,382 @@
+"""delta-trace (delta_tpu.obs) tests: span nesting and cross-thread
+parenting, disabled-path no-op guarantees, exporter round-trips, the
+txn-retry trace shape, and the end-to-end connected-trace acceptance
+check (write -> latest_snapshot -> scan under one root span)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu import obs
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.table import Table
+
+
+@pytest.fixture
+def tracing():
+    """Tracing on for the test, restored to the env default after; the
+    buffer is cleared on both sides so tests never see each other."""
+    obs.reset_trace_buffer()
+    obs.set_trace_mode("on")
+    yield
+    obs.set_trace_mode("off")
+    obs.reset_trace_buffer()
+
+
+def _data(n=20):
+    return pa.table({"id": pa.array(np.arange(n, dtype=np.int64))})
+
+
+def _by_name(spans, name):
+    return [s for s in spans if s.name == name]
+
+
+# ------------------------------------------------------------- span model
+
+
+def test_span_nesting_and_ids(tracing):
+    with obs.span("outer", k="v") as outer:
+        with obs.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        with obs.span("inner2") as inner2:
+            assert inner2.parent_id == outer.span_id
+    spans = obs.get_finished_spans()
+    assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+    assert spans[2].parent_id is None
+    assert spans[2].attrs["k"] == "v"
+    assert all(s.duration_ns is not None and s.duration_ns >= 0
+               for s in spans)
+    assert len(spans[2].trace_id) == 32 and len(spans[2].span_id) == 16
+
+
+def test_parent_read_at_enter_not_at_construction(tracing):
+    """The parent is resolved when the span is ENTERED, so a pre-built
+    ctx entered inside another span still parents correctly."""
+    ctx = obs.span("child")  # delta-lint: disable=obs-span-leak — entered below
+    with obs.span("root") as root:
+        with ctx as child:
+            assert child.parent_id == root.span_id
+
+
+def test_error_status_and_exception_passthrough(tracing):
+    with pytest.raises(ValueError, match="boom"):
+        with obs.span("failing"):
+            raise ValueError("boom")
+    (s,) = obs.get_finished_spans()
+    assert s.status == "error"
+    assert s.attrs["error.type"] == "ValueError"
+    assert "boom" in s.attrs["error.message"]
+
+
+def test_module_helpers_attach_to_active_span(tracing):
+    with obs.span("op") as s:
+        obs.set_attr("a", 1)
+        obs.set_attrs(b=2, c=3)
+        obs.add_event("milestone", pos=7)
+        assert obs.current_span() is s
+    assert s.attrs == {"a": 1, "b": 2, "c": 3}
+    assert s.events[0]["name"] == "milestone"
+    assert s.events[0]["attrs"] == {"pos": 7}
+    # outside any span the helpers are no-ops, never errors
+    obs.set_attr("x", 1)
+    obs.add_event("y")
+    assert obs.current_span() is None
+
+
+def test_cross_thread_parenting_via_wrap(tracing):
+    """contextvars don't flow into pool workers; wrap() carries the
+    caller's span across so worker spans join the same trace."""
+    def work(i):
+        with obs.span("worker", i=i):
+            pass
+
+    with obs.span("root") as root:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            list(ex.map(obs.wrap(work), range(3)))
+        # un-wrapped submission must NOT inherit the root
+        t = threading.Thread(target=work, args=(99,))
+        t.start()
+        t.join()
+
+    spans = obs.get_finished_spans()
+    wrapped = [s for s in _by_name(spans, "worker") if s.attrs["i"] != 99]
+    assert len(wrapped) == 3
+    assert all(s.trace_id == root.trace_id for s in wrapped)
+    assert all(s.parent_id == root.span_id for s in wrapped)
+    (orphan,) = [s for s in _by_name(spans, "worker")
+                 if s.attrs["i"] == 99]
+    assert orphan.trace_id != root.trace_id and orphan.parent_id is None
+
+
+# ---------------------------------------------------------- disabled path
+
+
+def test_disabled_path_is_noop_singleton():
+    obs.set_trace_mode("off")
+    obs.reset_trace_buffer()
+    ctx1 = obs.span("a", big="attr")  # delta-lint: disable=obs-span-leak — singleton identity check
+    ctx2 = obs.span("b")  # delta-lint: disable=obs-span-leak — singleton identity check
+    assert ctx1 is ctx2  # process-wide singleton: no per-call allocation
+    with ctx1 as s:
+        assert not s.recording
+        s.set_attr("k", "v")
+        s.set_attrs(a=1)
+        s.add_event("e")
+        assert obs.current_span() is None
+    assert obs.get_finished_spans() == []
+    # wrap() returns the function unchanged when off
+    fn = lambda: None  # noqa: E731
+    assert obs.wrap(fn) is fn
+
+
+def test_verbose_spans_folded_at_mode_on(tracing):
+    with obs.span("op"):
+        with obs.span("storage.read", _verbose=True):
+            pass
+    names = [s.name for s in obs.get_finished_spans()]
+    assert names == ["op"]
+    obs.set_trace_mode("verbose")
+    with obs.span("op"):
+        with obs.span("storage.read", _verbose=True):
+            pass
+    names = [s.name for s in obs.get_finished_spans()]
+    assert "storage.read" in names
+
+
+# ------------------------------------------------------ registry counters
+
+
+def test_registry_counters_and_histograms():
+    c = obs.counter("test.counter")
+    c.reset()
+    c.inc()
+    c.inc(5)
+    assert c.value == 6
+    assert obs.counter("test.counter") is c  # same instance by name
+    h = obs.histogram("test.histo")
+    h.reset()
+    h.observe(2.0)
+    h.observe(4.0)
+    assert h.mean == 3.0
+    snap = obs.metrics_snapshot()
+    assert snap["counters"]["test.counter"] == 6
+    assert snap["histograms"]["test.histo"]["count"] == 2
+    assert snap["histograms"]["test.histo"]["min"] == 2.0
+    assert snap["histograms"]["test.histo"]["max"] == 4.0
+
+
+# -------------------------------------------------------------- exporters
+
+
+def test_jsonl_export_round_trip(tmp_path, tracing):
+    path = str(tmp_path / "trace.jsonl")
+    exp = obs.JsonlExporter(path)
+    obs.add_exporter(exp)
+    try:
+        with obs.span("op", table="/t"):
+            with obs.span("child"):
+                pass
+    finally:
+        obs.remove_exporter(exp)
+        exp.close()
+    recs = obs.load_spans(path)
+    assert [r["name"] for r in recs] == ["child", "op"]
+    child, op = recs
+    assert child["trace_id"] == op["trace_id"]
+    assert child["parent_id"] == op["span_id"]
+    assert op["attrs"]["table"] == "/t"
+
+
+def test_chrome_trace_round_trip(tmp_path, tracing):
+    with obs.span("op", table="/t") as op:
+        obs.add_event("tick")
+        with obs.span("child"):
+            pass
+    path = str(tmp_path / "trace.json")
+    obs.write_chrome_trace(path, obs.get_finished_spans())
+
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert "traceEvents" in doc
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"op", "child"}
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in xs)
+    assert any(e["ph"] == "M" for e in doc["traceEvents"])
+    assert any(e["ph"] == "i" and e["name"] == "tick"
+               for e in doc["traceEvents"])
+
+    # load_spans reads the Chrome shape back with ids intact
+    recs = obs.load_spans(path)
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["child"]["parent_id"] == by_name["op"]["span_id"]
+    assert by_name["op"]["trace_id"] == op.trace_id
+
+
+def test_trace_cli_summarizes_both_formats(tmp_path, tracing, capsys):
+    from delta_tpu.tools.trace import main as trace_main
+
+    with obs.span("snapshot.load"):
+        with obs.span("log.columnarize"):
+            pass
+    spans = obs.get_finished_spans()
+    jsonl = str(tmp_path / "t.jsonl")
+    exp = obs.JsonlExporter(jsonl)
+    for s in spans:
+        exp(s)
+    exp.close()
+    chrome = str(tmp_path / "t.json")
+    obs.write_chrome_trace(chrome, spans)
+
+    for path in (jsonl, chrome):
+        assert trace_main([path]) == 0
+        out = capsys.readouterr().out
+        assert "snapshot.load" in out and "log.columnarize" in out
+    assert trace_main([jsonl, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert {r["operation"] for r in doc["operations"]} == {
+        "snapshot.load", "log.columnarize"}
+    assert trace_main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ------------------------------------------------------- instrumentation
+
+
+def test_txn_retry_trace_one_attempt_span_per_try(tmp_table_path, tracing):
+    """A commit that loses the O_EXCL race shows one txn.attempt child
+    per try under a single txn.commit span, with conflict attributes."""
+    from delta_tpu.txn.transaction import Operation
+
+    engine = HostEngine()
+    dta.write_table(tmp_table_path, _data(), engine=engine)
+    table = Table.for_path(tmp_table_path, engine)
+
+    txn = table.create_transaction_builder(Operation.WRITE).build()
+    txn.add_files([])
+    # another writer lands version 1 first -> our attempt at 1 conflicts
+    dta.write_table(tmp_table_path, _data(5), engine=HostEngine())
+    obs.reset_trace_buffer()
+    result = txn.commit()
+    assert result.version == 2 and result.attempts == 2
+
+    spans = obs.get_finished_spans()
+    (commit,) = _by_name(spans, "txn.commit")
+    attempts = _by_name(spans, "txn.attempt")
+    assert len(attempts) == 2
+    assert all(a.parent_id == commit.span_id
+               and a.trace_id == commit.trace_id for a in attempts)
+    first, second = sorted(attempts, key=lambda a: a.attrs["attempt"])
+    assert first.attrs["conflict"] is True
+    assert first.attrs["rebased_to"] == 2
+    assert "conflict" not in second.attrs
+    checks = _by_name(spans, "txn.conflict_check")
+    assert len(checks) == 1 and checks[0].parent_id == first.span_id
+    assert commit.attrs["committed_version"] == 2
+    assert commit.attrs["attempts"] == 2
+
+
+def test_storage_spans_share_txn_trace_id(tmp_table_path, tracing):
+    """Correlation across layers: the storage commit_write span carries
+    the same trace id as the txn.commit that caused it."""
+    engine = HostEngine()
+    obs.reset_trace_buffer()
+    dta.write_table(tmp_table_path, _data(), engine=engine)
+    spans = obs.get_finished_spans()
+    (commit,) = _by_name(spans, "txn.commit")
+    writes = _by_name(spans, "storage.commit_write")
+    assert writes, "commit must produce a storage.commit_write span"
+    assert all(w.trace_id == commit.trace_id for w in writes)
+
+
+def test_end_to_end_connected_trace(tmp_table_path, tracing):
+    """Acceptance: write -> latest_snapshot -> scan under one root span
+    produces a single connected trace (every span reachable from the
+    root) and valid Chrome JSON the delta-trace CLI summarizes."""
+    from delta_tpu.tools.trace import compute_self_times, main as trace_main
+
+    engine = HostEngine()
+    obs.reset_trace_buffer()
+    with obs.span("e2e") as root:
+        dta.write_table(tmp_table_path, _data(), engine=engine)
+        snap = Table.for_path(tmp_table_path, engine).latest_snapshot()
+        snap.scan().add_files_table()
+
+    spans = obs.get_finished_spans()
+    names = {s.name for s in spans}
+    for expected in ("table.write", "txn.commit", "txn.attempt",
+                     "storage.commit_write", "table.latest_snapshot",
+                     "snapshot.load", "log.columnarize", "scan.plan"):
+        assert expected in names, f"missing span {expected}"
+    # single connected trace: same trace id and every span reachable
+    # from the root through parent links
+    assert all(s.trace_id == root.trace_id for s in spans)
+    by_id = {s.span_id: s for s in spans}
+    by_id[root.span_id] = root
+    for s in spans:
+        node, hops = s, 0
+        while node.parent_id is not None and hops < 100:
+            node = by_id[node.parent_id]  # KeyError = broken link
+            hops += 1
+        assert node.span_id == root.span_id, f"{s.name} not under root"
+
+    # chrome export is valid and the CLI summarizes it without error
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        path = obs.write_chrome_trace(f"{td}/e2e.json", spans + [root])
+        with open(path, encoding="utf-8") as fh:
+            json.load(fh)
+        assert trace_main([path]) == 0
+        assert trace_main([path, "--tree"]) == 0
+
+    # self-time never exceeds duration and is non-negative
+    selfs = compute_self_times([s.to_dict() for s in spans + [root]])
+    for d in [s.to_dict() for s in spans + [root]]:
+        st = selfs[d["span_id"]]
+        assert 0 <= st <= d["duration_ns"]
+
+
+def test_snapshot_report_correlated_to_trace(tmp_table_path, tracing):
+    """The metrics_report event pins a SnapshotReport's UUID onto the
+    span tree, so reports and traces can be joined after the fact."""
+    from delta_tpu.engine.host import LoggingMetricsReporter
+
+    reporter = LoggingMetricsReporter()
+    engine = HostEngine(metrics_reporters=[reporter])
+    dta.write_table(tmp_table_path, _data(), engine=engine)
+    obs.reset_trace_buffer()
+    # SnapshotReport is emitted by the state reconstruction itself
+    Table.for_path(tmp_table_path, engine).latest_snapshot().state
+
+    snap_reports = [r for r in reporter.reports
+                    if r["type"] == "SnapshotReport"]
+    assert snap_reports
+    uuids = {r["reportUUID"] for r in snap_reports}
+    events = [ev for s in obs.get_finished_spans() for ev in s.events
+              if ev["name"] == "metrics_report"]
+    assert any(ev["attrs"].get("report_uuid") in uuids for ev in events)
+
+
+def test_parse_cache_counters_increment(tmp_table_path, tracing):
+    from delta_tpu.replay.columnar import clear_parse_cache
+
+    engine = HostEngine()
+    for _ in range(3):
+        dta.write_table(tmp_table_path, _data(5), engine=engine)
+    clear_parse_cache()
+    hits = obs.counter("parse_cache.hits")
+    misses = obs.counter("parse_cache.misses")
+    h0, m0 = hits.value, misses.value
+    t = Table.for_path(tmp_table_path, engine)
+    t.latest_snapshot().state  # cold: miss
+    assert misses.value > m0
+    t2 = Table.for_path(tmp_table_path, engine)
+    t2.latest_snapshot().state  # warm: served from the parsed cache
+    assert hits.value > h0
