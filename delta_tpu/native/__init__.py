@@ -306,6 +306,15 @@ class ScanResult:
         self._rh_buf = buf_ptr
         self._rh_len = total
 
+    def attach_py_buffer(self, owner, addr: int, total: int) -> None:
+        """Python-owned-buffer counterpart of `attach_read_buffer` (the
+        `scan_actions(lazy_stats=True)` path): `owner` is whatever object
+        keeps the scanned bytes alive and pinned at `addr`. Released on
+        materialization, same as the native read handle."""
+        self._rh = owner
+        self._rh_buf = addr
+        self._rh_len = total
+
     def materialize_stats(self) -> None:
         """Decode the deferred stats spans into the standard column
         buffers (idempotent, thread-safe — ctypes drops the GIL during
@@ -367,12 +376,20 @@ class ScanResult:
         return [uniq[c] for c in self.path_code]
 
 
-def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
+def scan_actions(buf, n_threads: int = 0,
+                 lazy_stats: bool = False) -> Optional[ScanResult]:
     """Scan a buffer of newline-delimited Delta action JSON. Returns
     None when the native library is unavailable or the buffer doesn't
-    parse as well-formed action lines (caller falls back)."""
+    parse as well-formed action lines (caller falls back).
+
+    `lazy_stats` defers the stats-string decode (the bulk of commit
+    bytes): the result keeps `buf` alive and pinned until
+    `materialize_stats()` runs — same contract as the
+    `scan_commit_files` lazy path, with a Python-owned buffer."""
     lib = load()
-    if lib is None:
+    if lib is None or len(buf) == 0:
+        # a zero-byte buffer allocates none of the column buffers the
+        # result would wrap; let the caller's generic path handle it
         return None
     if n_threads <= 0:
         from delta_tpu.utils.threads import default_scan_threads
@@ -388,15 +405,29 @@ def scan_actions(buf, n_threads: int = 0) -> Optional[ScanResult]:
     else:
         data = bytes(buf)
         n_bytes = len(data)
-    h = lib.das_scan(data, n_bytes, n_threads)
+    if lazy_stats:
+        # the deferred decode re-reads the SAME address later, so take a
+        # stable pointer now and keep `data` (which pins the bytes) on
+        # the result
+        if isinstance(data, bytes):
+            addr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
+        else:
+            addr = ctypes.addressof(data)
+        h = lib.das_scan2(ctypes.cast(addr, ctypes.c_char_p), n_bytes,
+                          n_threads, 1)
+    else:
+        h = lib.das_scan(data, n_bytes, n_threads)
     if lib.das_error(h):
         lib.das_free(h)
         return None
     try:
-        return ScanResult(lib, h)  # handle ownership moves to the result
+        res = ScanResult(lib, h)  # handle ownership moves to the result
     except BaseException:
         lib.das_free(h)
         raise
+    if res.stats_lazy:
+        res.attach_py_buffer(data, addr, n_bytes)
+    return res
 
 
 def scan_commit_files(paths, lazy_stats: bool = False) -> Optional[tuple]:

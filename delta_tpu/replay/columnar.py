@@ -463,11 +463,16 @@ def _read_commits_buffer(
     if any(int(s) < 0 for _, _, s in commit_infos):
         # fast listing deferred the stats: resolve sizes now (this path
         # runs only when the native one-round-trip reader is unavailable)
+        from delta_tpu.utils.threads import parallel_map
+
+        def stat(info):
+            v, p, s = info
+            if int(s) >= 0:
+                return info
+            return (v, p, engine.fs.file_status(p).size)
+
         try:
-            commit_infos = [
-                (v, p, s if int(s) >= 0
-                 else engine.fs.file_status(p).size)
-                for v, p, s in commit_infos]
+            commit_infos = parallel_map(stat, list(commit_infos))
         except FileNotFoundError as e:
             from delta_tpu.log.segment import CorruptLogError
 
@@ -487,26 +492,39 @@ def _read_commits_buffer(
 
     def fill(i: int):
         _, path, _ = commit_infos[i]
-        data = engine.fs.read_file(path)
-        if len(data) != sizes[i]:
-            mismatch.append(i)
-            return
         off = starts[i]
-        mv[off:off + sizes[i]] = data
+        local = engine.fs.os_path(path)
+        if local is not None:
+            # local file: read straight into the shared buffer (no
+            # intermediate bytes object, no second copy)
+            try:
+                with open(local, "rb") as f:
+                    got = f.readinto(mv[off:off + sizes[i]])
+                    if got != sizes[i] or f.read(1):
+                        mismatch.append(i)
+                        return
+            except OSError:
+                mismatch.append(i)
+                return
+        else:
+            data = engine.fs.read_file(path)
+            if len(data) != sizes[i]:
+                mismatch.append(i)
+                return
+            mv[off:off + sizes[i]] = data
         mv[off + sizes[i]] = 0x0A
 
-    from delta_tpu.utils.threads import default_io_threads
+    from delta_tpu.utils.threads import default_io_threads, shared_pool
 
     workers = min(max_workers, default_io_threads())
     with obs.span("storage.read_commits", files=n, bytes=total,
                   workers=workers if n > 4 else 0):
         if n > 4:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=workers) as ex:
-                # obs.wrap: contextvars don't cross the pool boundary, so
-                # bind this span as the workers' parent explicitly
-                list(ex.map(obs.wrap(fill), range(n)))
+            # obs.wrap: contextvars don't cross the pool boundary, so
+            # bind this span as the workers' parent explicitly. The
+            # shared pool is safe here because fill() is a leaf read —
+            # it never submits pool work of its own.
+            shared_pool().map(obs.wrap(fill), range(n))
         else:
             for i in range(n):
                 fill(i)
@@ -559,7 +577,11 @@ def parse_commit_files(
     read = _read_commits_buffer(engine, commit_infos, max_workers)
     out = _parse_buffer_generic(*read) if read is not None else None
     if out is None:
-        blobs = [(v, engine.fs.read_file(p)) for v, p, _ in commit_infos]
+        from delta_tpu.utils.threads import parallel_map
+
+        blobs = parallel_map(
+            lambda vp: (vp[0], engine.fs.read_file(vp[1])),
+            [(v, p) for v, p, _ in commit_infos])
         return parse_commit_batch(blobs)
     return out
 
@@ -910,7 +932,32 @@ def _columnarize_log_segment(
 
     def _consume_checkpoint_parts():
         nonlocal bytes_parsed
-        for fstat in segment.checkpoints:
+        parts = list(segment.checkpoints)
+        # Multipart/V2 parquet checkpoints: ONE batched handler call so
+        # its byte-prefetch overlaps part i's decode with part i+1's
+        # read. Consumption order is unchanged; the small_only
+        # projection-fallback and device page-decode paths keep the
+        # per-part loop below.
+        if (len(parts) > 1 and not small_only
+                and not getattr(engine, "use_device_page_decode", False)
+                and all(not f.path.endswith(".json") for f in parts)):
+            tables = engine.parquet.read_parquet_files(
+                [f.path for f in parts])
+            for fstat in parts:
+                try:
+                    # sidecar reads nest inside the consume call; a
+                    # vanished sidecar maps like a vanished part
+                    _consume_checkpoint_table(next(tables))
+                except FileNotFoundError:
+                    from delta_tpu.errors import LogCorruptedError
+
+                    raise LogCorruptedError(
+                        f"couldn't find all part files of the checkpoint "
+                        f"at version {cp_version}: {fstat.path} is missing",
+                        error_class="DELTA_MISSING_PART_FILES")
+                bytes_parsed += fstat.size
+            return
+        for fstat in parts:
             try:
                 if fstat.path.endswith(".json"):
                     # V2 top-level checkpoint in JSON form
@@ -999,7 +1046,29 @@ def _columnarize_log_segment(
                         scan.is_add.astype(bool),
                         fa_hint=(scan.path_new, scan.refs, scan.n_uniq),
                     )
-            if _native.available(allow_compile):
+            # Pipelined load: when the tail is big enough to window,
+            # overlap storage reads with parsing (and with the device
+            # replay dispatch) instead of the phase-serial flow below.
+            fresh = None
+            if not small_only:
+                from delta_tpu.replay import pipeline as _pipeline
+
+                if _pipeline.enabled() and _pipeline.profitable(
+                        engine, remaining,
+                        _native.available(allow_compile)):
+                    windows = _pipeline.plan_windows(
+                        _pipeline.resolve_sizes(engine, remaining))
+                    if len(windows) >= 2:
+                        fresh, fresh_pending, pipe_nbytes = (
+                            _pipeline.parse_commits_pipelined(
+                                engine, windows,
+                                allow_native=_native.available(
+                                    allow_compile),
+                                lazy_stats=not os.environ.get(
+                                    "DELTA_TPU_EAGER_STATS"),
+                                launch=launch))
+                        bytes_parsed += pipe_nbytes
+            if fresh is None and _native.available(allow_compile):
                 # local files: one native read+scan round-trip (no per-file
                 # interpreter I/O, no buffer copy into Python)
                 local = [engine.fs.os_path(p) for _, p, _ in remaining]
@@ -1025,7 +1094,7 @@ def _columnarize_log_segment(
                         # the scanner saw (and rejected) this exact content —
                         # don't scan the same bytes natively a second time
                         native_rejected = True
-            if parsed_native is None:
+            if fresh is None and parsed_native is None:
                 # one parallel read into one buffer; the native C++ scanner
                 # and the generic Arrow parser are alternative consumers of
                 # the SAME bytes — a native-side rejection never re-fetches
@@ -1053,10 +1122,13 @@ def _columnarize_log_segment(
                     else None,
                     n_files=len(remaining),
                     nbytes=_span_nbytes(block, others))
-            else:
+            elif fresh is None:
                 if generic is None:  # size mismatch or accounting failure
-                    blobs = [(v, engine.fs.read_file(p))
-                             for v, p, _ in remaining]
+                    from delta_tpu.utils.threads import parallel_map
+
+                    blobs = parallel_map(
+                        lambda vp: (vp[0], engine.fs.read_file(vp[1])),
+                        [(v, p) for v, p, _ in remaining])
                     generic = parse_commit_batch(blobs)
                 tbl, versions, orders, nbytes = generic
                 bytes_parsed += nbytes

@@ -156,15 +156,77 @@ class NativeReplayKeys:
     """Replay-key sidecar from the native scan: first-appearance path
     dictionary codes plus the ready-made delta encoding the device
     kernel ships (ops/replay.py `_winner_kernel_fa`). Row-aligned with
-    the canonical table built from the same scan."""
+    the canonical table built from the same scan (or, via
+    `merge_replay_keys`, with the concatenation of several scans)."""
 
     __slots__ = ("path_code", "path_new", "refs", "n_uniq")
 
-    def __init__(self, scan):
-        self.path_code = scan.path_code
-        self.path_new = scan.path_new
-        self.refs = scan.refs
-        self.n_uniq = scan.n_uniq
+    def __init__(self, path_code: np.ndarray, path_new: np.ndarray,
+                 refs: np.ndarray, n_uniq: int):
+        self.path_code = path_code
+        self.path_new = path_new
+        self.refs = refs
+        self.n_uniq = n_uniq
+
+    @classmethod
+    def from_scan(cls, scan) -> "NativeReplayKeys":
+        return cls(scan.path_code, scan.path_new, scan.refs, scan.n_uniq)
+
+
+def merge_replay_keys(
+    parts: List[Tuple[Optional[NativeReplayKeys], Optional[pa.Array], int]],
+) -> Optional[NativeReplayKeys]:
+    """Merge per-window replay-key sidecars into one sidecar that is
+    dense-first-appearance coded over the concatenated row stream.
+
+    `parts` is [(keys, unique-path strings in window code order, n_rows)]
+    in window order. Returns None when any window lacks keys (generic
+    parse, or percent-decoding collapsed two spellings).
+
+    Why this is valid: the dictionary encode assigns global codes in
+    order of first appearance over the concatenated per-window unique
+    arrays; each
+    window's unique array is itself in window-local first-appearance
+    (row) order, and windows concatenate in row order — so global code
+    order == global row-stream first-appearance order, exactly the dense
+    coding `ops.replay.derive_fa_flags` requires. A row is globally new
+    iff it is locally new AND its path's first window is this one."""
+    if not parts or any(k is None or u is None for k, u, _ in parts):
+        return None
+    if len(parts) == 1:
+        return parts[0][0]
+    uniqs = [u.combine_chunks() if isinstance(u, pa.ChunkedArray) else u
+             for _, u, _ in parts]
+    lens = np.fromiter((len(u) for u in uniqs), np.int64, len(uniqs))
+    offs = np.zeros(len(uniqs) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    all_uniq = pa.concat_arrays(uniqs)
+    # Arrow's dictionary_encode assigns codes in order of first
+    # appearance (same contract as pd.factorize) without materializing
+    # the strings into the interpreter
+    enc = all_uniq.dictionary_encode()
+    codes = enc.indices.to_numpy(zero_copy_only=False).astype(
+        np.int64, copy=False)
+    n_uniq = len(enc.dictionary)
+    # earliest window containing each global code (reverse iteration:
+    # earlier windows overwrite later ones)
+    first_win = np.empty(n_uniq, np.int32)
+    for w in range(len(uniqs) - 1, -1, -1):
+        first_win[codes[offs[w]:offs[w + 1]]] = w
+    pc_parts: List[np.ndarray] = []
+    pn_parts: List[np.ndarray] = []
+    ref_parts: List[np.ndarray] = []
+    for w, (k, _, _) in enumerate(parts):
+        local_to_global = codes[offs[w]:offs[w + 1]]
+        g = (local_to_global[k.path_code] if len(k.path_code)
+             else np.empty(0, np.int64))
+        flags = k.path_new & (first_win[g] == w) if len(g) else k.path_new
+        pc_parts.append(g.astype(np.uint32))
+        pn_parts.append(flags)
+        ref_parts.append(g[~flags].astype(np.uint32))
+    return NativeReplayKeys(
+        np.concatenate(pc_parts), np.concatenate(pn_parts),
+        np.concatenate(ref_parts), n_uniq)
 
 
 def _finish_scan(
@@ -207,7 +269,7 @@ def _finish_scan(
         table, codes_ok = build_canonical_table(
             scan, row_versions, row_orders, path_and_ok=path_and_ok)
         if codes_ok:
-            keys = NativeReplayKeys(scan)
+            keys = NativeReplayKeys.from_scan(scan)
     # NOTE: a malformed control line below aborts AFTER a launch may have
     # been issued; the pending handle is simply dropped (harmless async
     # work) and the generic path re-parses.
@@ -272,3 +334,35 @@ def parse_commit_paths_native(
     if fin is None:
         return None
     return (*fin, total)
+
+
+def parse_window_native(
+    buf,
+    file_starts: np.ndarray,
+    file_versions: np.ndarray,
+    lazy_stats: bool = False,
+) -> Optional[Tuple[pa.Table, List[Tuple[int, int, dict]],
+                    Optional[NativeReplayKeys], Optional[pa.Array],
+                    bool, Optional[object]]]:
+    """Native parse of ONE pipeline window. Like `parse_commits_native`
+    but never launches (the pipeline launches once over the merged
+    stream) and additionally surfaces what cross-window key merging
+    needs: (table, others, keys, unique path strings in code order,
+    any-DV flag, stats thunk), or None for the generic fallback."""
+    from delta_tpu import native
+
+    scan = native.scan_actions(buf, lazy_stats=lazy_stats)
+    if scan is None:
+        return None
+    mv = memoryview(buf)
+    others_raw = [bytes(mv[s:e])
+                  for s, e in zip(scan.other_start.tolist(),
+                                  scan.other_end.tolist())]
+    fin = _finish_scan(scan, others_raw, file_starts, file_versions,
+                       small_only=False, launch=None)
+    if fin is None:
+        return None
+    table, others, keys, _pending, stats_thunk = fin
+    uniq = scan.uniq_strings() if keys is not None else None
+    dv_any = bool(scan.dv_valid.any()) if scan.n_rows else False
+    return table, others, keys, uniq, dv_any, stats_thunk

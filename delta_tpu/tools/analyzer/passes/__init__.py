@@ -8,4 +8,5 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     locks,
     obs,
     purity,
+    threads,
 )

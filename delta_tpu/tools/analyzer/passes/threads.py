@@ -1,0 +1,92 @@
+"""Thread-pool discipline rule: ``threadpool-discipline``.
+
+All host-side parallelism goes through ``delta_tpu/utils/threads.py``
+(the analogue of the reference's managed ``DeltaThreadPool`` family): a
+shared, bounded, named daemon pool plus ``parallel_map``. A
+``ThreadPoolExecutor(...)`` constructed anywhere else is a discipline
+leak three ways:
+
+- **unbounded fan-out** — every ad-hoc pool adds its own worker set on
+  top of the shared one, so aggregate concurrency is no longer the one
+  number ``default_io_threads()`` was sized to;
+- **churn** — a throwaway pool pays thread spawn/join on every call in
+  paths that are hot enough to have wanted a pool in the first place;
+- **deadlock surface** — the shared pool's no-nesting rule (pool tasks
+  are leaf work only) is only auditable while every submission site
+  goes through the one module.
+
+``delta_tpu/utils/threads.py`` itself is exempt by path — it is the one
+place allowed to own an executor. Audited exceptions elsewhere carry a
+``# delta-lint: disable=threadpool-discipline`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+
+def _executor_call_names(tree: ast.Module) -> Set[str]:
+    """Dotted call names that resolve to
+    ``concurrent.futures.ThreadPoolExecutor`` in this module:
+    ``from concurrent.futures import ThreadPoolExecutor [as x]`` binds
+    ``x``; ``from concurrent import futures [as f]`` binds
+    ``f.ThreadPoolExecutor``; ``import concurrent.futures [as cf]``
+    binds ``cf.ThreadPoolExecutor`` (or the full dotted path)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "concurrent.futures":
+                for a in node.names:
+                    if a.name == "ThreadPoolExecutor":
+                        names.add(a.asname or a.name)
+            elif node.module == "concurrent":
+                for a in node.names:
+                    if a.name == "futures":
+                        names.add(
+                            f"{a.asname or a.name}.ThreadPoolExecutor")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "concurrent.futures":
+                    names.add(
+                        f"{a.asname}.ThreadPoolExecutor" if a.asname
+                        else "concurrent.futures.ThreadPoolExecutor")
+                elif a.name == "concurrent" and not a.asname:
+                    names.add("concurrent.futures.ThreadPoolExecutor")
+    return names
+
+
+@register
+class ThreadPoolDisciplineRule(Rule):
+    id = "threadpool-discipline"
+    description = ("direct ThreadPoolExecutor(...) construction outside "
+                   "delta_tpu/utils/threads.py — use the shared pool "
+                   "(shared_pool() / parallel_map) so worker counts stay "
+                   "bounded and nesting stays auditable")
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        # the one module allowed to own executors
+        rel = mod.rel.replace("\\", "/")
+        if rel.endswith("utils/threads.py"):
+            return []
+        names = _executor_call_names(tree)
+        if not names:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in names:
+                out.append(Finding(
+                    self.id, mod.rel, node.lineno, node.col_offset,
+                    f"{name}(...) constructed outside utils/threads.py: "
+                    f"route the work through shared_pool()/parallel_map "
+                    f"(or audit + suppress)"))
+        return out

@@ -666,7 +666,7 @@ def test_render_json_roundtrip():
 
 
 def test_every_registered_rule_has_fixture_coverage():
-    """Each of the six analysis passes must be exercised above; this
+    """Each of the analysis passes must be exercised above; this
     guards the registry against silently-unregistered rules."""
     expected = {
         "lock-order", "lock-io", "global-mutation",          # locks
@@ -676,6 +676,7 @@ def test_every_registered_rule_has_fixture_coverage():
         "except-swallow", "mutable-default",                 # hygiene
         "undefined-name",                                    # imports
         "obs-span-leak",                                     # obs
+        "threadpool-discipline",                             # threads
     }
     assert set(all_rules()) == expected
 
@@ -760,6 +761,76 @@ def measure():
     return t0
 """
     report = analyze_sources({"m.py": src}, rules=["obs-span-leak"])
+    assert not report.findings and report.suppressed
+
+
+# ------------------------------------------ threadpool-discipline rule
+
+
+def test_threadpool_direct_construction_flagged():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+def load(paths):
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        return list(ex.map(len, paths))
+"""
+    report = analyze_sources({"m.py": src},
+                             rules=["threadpool-discipline"])
+    assert len(report.findings) == 1
+    assert "shared_pool" in report.findings[0].message
+
+
+def test_threadpool_aliased_imports_flagged():
+    src = """
+import concurrent.futures as cf
+from concurrent import futures
+
+def a():
+    return cf.ThreadPoolExecutor(2)
+
+def b():
+    return futures.ThreadPoolExecutor(2)
+"""
+    report = analyze_sources({"m.py": src},
+                             rules=["threadpool-discipline"])
+    assert len(report.findings) == 2
+
+
+def test_threadpool_threads_module_exempt():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+POOL = ThreadPoolExecutor(max_workers=4)
+"""
+    report = analyze_sources({"delta_tpu/utils/threads.py": src},
+                             rules=["threadpool-discipline"])
+    assert not report.findings
+
+
+def test_threadpool_shared_pool_usage_clean():
+    src = """
+from delta_tpu.utils.threads import parallel_map, shared_pool
+
+def load(paths):
+    return parallel_map(len, paths) + shared_pool().map(len, paths)
+"""
+    report = analyze_sources({"m.py": src},
+                             rules=["threadpool-discipline"])
+    assert not report.findings
+
+
+def test_threadpool_suppression_pragma():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+def oneshot():
+    # delta-lint: disable=threadpool-discipline (audited: example)
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        return ex.submit(int).result()
+"""
+    report = analyze_sources({"m.py": src},
+                             rules=["threadpool-discipline"])
     assert not report.findings and report.suppressed
 
 

@@ -1,0 +1,341 @@
+"""Pipelined snapshot load (`replay/pipeline.py`): pipelined-vs-serial
+equivalence across segment shapes, fault propagation/drain semantics,
+and the cross-window replay-key merge."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from delta_tpu import obs
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.replay import pipeline
+from delta_tpu.replay.columnar import clear_parse_cache
+from delta_tpu.table import Table
+
+PROTOCOL = {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}
+METADATA = {
+    "metaData": {
+        "id": "pipeline-test-table",
+        "format": {"provider": "parquet", "options": {}},
+        "schemaString": json.dumps(
+            {"type": "struct",
+             "fields": [{"name": "x", "type": "long", "nullable": True,
+                         "metadata": {}}]}),
+        "partitionColumns": [],
+        "configuration": {},
+    }
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    # tiny windows so even hand-sized logs exercise multi-window flow
+    monkeypatch.setenv("DELTA_TPU_PIPELINE_WINDOW_BYTES", "256")
+    clear_parse_cache()
+    yield
+    clear_parse_cache()
+
+
+def write_log(path, commits):
+    log = os.path.join(path, "_delta_log")
+    os.makedirs(log, exist_ok=True)
+    for v, actions in enumerate(commits):
+        with open(os.path.join(log, f"{v:020d}.json"), "w") as f:
+            for a in actions:
+                f.write(json.dumps(a) + "\n")
+    return path
+
+
+def add(path, size=100, stats=None, **kw):
+    d = {"path": path, "partitionValues": {}, "size": size,
+         "modificationTime": 1, "dataChange": True, **kw}
+    if stats is not None:
+        d["stats"] = stats
+    return {"add": d}
+
+
+def remove(path, **kw):
+    return {"remove": {"path": path, "deletionTimestamp": 5,
+                       "dataChange": True, **kw}}
+
+
+def _commits(n):
+    """n commits with path re-use across windows (first-appearance
+    coding must merge), removes, stats, txns, and domains."""
+    out = [[PROTOCOL, METADATA,
+            {"txn": {"appId": "app", "version": 0}},
+            {"domainMetadata": {"domain": "d1", "configuration": "v0",
+                                "removed": False}}]]
+    for i in range(n):
+        actions = [add(f"f{i}", size=100 + i,
+                       stats=json.dumps({"numRecords": i})),
+                   add(f"shared{i % 3}", size=7)]
+        if i > 2:
+            actions.append(remove(f"f{i - 2}"))
+        if i % 5 == 0:
+            actions.append({"txn": {"appId": "app", "version": i}})
+            actions.append(
+                {"domainMetadata": {"domain": "d1",
+                                    "configuration": f"v{i}",
+                                    "removed": False}})
+        out.append(actions)
+    return out
+
+
+def _digest(path):
+    """Everything replay decides: per-row masks aligned to (path, dv)
+    plus stats, P&M, txns, and domains."""
+    clear_parse_cache()
+    snap = Table.for_path(str(path), HostEngine()).latest_snapshot()
+    st = snap.state
+    fa = st.file_actions
+    rows = sorted(zip(
+        fa.column("path").to_pylist(), fa.column("dv_id").to_pylist(),
+        fa.column("version").to_pylist(), fa.column("stats").to_pylist(),
+        np.asarray(st.live_mask).tolist(),
+        np.asarray(st.tombstone_mask).tolist()))
+    return (snap.version, st.num_files, st.size_in_bytes,
+            (snap.protocol.minReaderVersion,
+             snap.protocol.minWriterVersion),
+            snap.metadata.id,
+            sorted((k, t.version) for k, t in st.set_transactions.items()),
+            sorted((k, d.configuration, d.removed)
+                   for k, d in st.domain_metadata.items()),
+            rows)
+
+
+def _on_off_digests(path, monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "off")
+    off = _digest(path)
+    w0 = obs.counter("pipeline.windows").value
+    # force: these logs are local files with the native scanner
+    # available, where the profitability gate prefers the serial path
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "force")
+    on = _digest(path)
+    engaged = obs.counter("pipeline.windows").value - w0
+    return off, on, engaged
+
+
+def _assert_no_pipeline_threads():
+    # stage threads join before parse_commits_pipelined returns; allow a
+    # short grace for the daemon join timeout path
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        left = [t.name for t in threading.enumerate()
+                if t.name.startswith("delta-pipeline")]
+        if not left:
+            return
+        time.sleep(0.05)
+    assert not left, f"leaked pipeline threads: {left}"
+
+
+# -------------------------------------------------------------- shapes
+
+
+def test_equivalence_plain_commits(tmp_path, monkeypatch):
+    path = write_log(str(tmp_path), _commits(25))
+    off, on, engaged = _on_off_digests(path, monkeypatch)
+    assert engaged >= 2, "pipeline did not engage"
+    assert on == off
+    _assert_no_pipeline_threads()
+
+
+def test_equivalence_classic_checkpoint_with_tail(tmp_path, monkeypatch):
+    path = write_log(str(tmp_path), _commits(25))
+    table = Table.for_path(path, HostEngine())
+    table.checkpoint(10)
+    off, on, engaged = _on_off_digests(path, monkeypatch)
+    assert engaged >= 2
+    assert on == off
+
+
+def test_equivalence_multipart_checkpoint(tmp_path, monkeypatch):
+    from delta_tpu.config import settings
+
+    path = write_log(str(tmp_path), _commits(25))
+    table = Table.for_path(path, HostEngine())
+    old = settings.checkpoint_part_size
+    settings.checkpoint_part_size = 4
+    try:
+        table.checkpoint(12)
+    finally:
+        settings.checkpoint_part_size = old
+    log = os.path.join(path, "_delta_log")
+    assert len([f for f in os.listdir(log) if ".checkpoint.00" in f]) > 1
+    pf0 = obs.counter("storage.parquet.prefetched_files").value
+    off, on, engaged = _on_off_digests(path, monkeypatch)
+    assert engaged >= 2
+    assert on == off
+    # the batched part consumption prefetched bytes ahead of the decoder
+    assert obs.counter("storage.parquet.prefetched_files").value > pf0
+
+
+def test_equivalence_v2_checkpoint_sidecars(tmp_path, monkeypatch):
+    from delta_tpu.log.checkpointer import write_checkpoint
+
+    path = write_log(str(tmp_path), _commits(25))
+    table = Table.for_path(path, HostEngine())
+    write_checkpoint(table.engine, table.latest_snapshot(), policy="v2")
+    # tail commits past the checkpoint so the pipeline still has windows
+    write_log(str(tmp_path), _commits(25) + [
+        [add("post0")], [add("post1")], [add("post2")], [add("post3")]])
+    off, on, engaged = _on_off_digests(path, monkeypatch)
+    assert engaged >= 2
+    assert on == off
+
+
+def test_equivalence_compacted_deltas(tmp_path, monkeypatch):
+    from delta_tpu.log.cleanup import write_compacted_delta
+
+    path = write_log(str(tmp_path), _commits(25))
+    table = Table.for_path(path, HostEngine())
+    write_compacted_delta(table, 3, 9)
+    snap = Table.for_path(path, HostEngine()).latest_snapshot()
+    assert len(snap.log_segment.compacted_deltas) == 1
+    off, on, engaged = _on_off_digests(path, monkeypatch)
+    assert engaged >= 2
+    assert on == off
+
+
+def test_off_switch_disables(tmp_path, monkeypatch):
+    path = write_log(str(tmp_path), _commits(12))
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "off")
+    w0 = obs.counter("pipeline.windows").value
+    _digest(path)
+    assert obs.counter("pipeline.windows").value == w0
+
+
+def test_profitability_gate(tmp_path, monkeypatch):
+    from delta_tpu import native
+
+    path = write_log(str(tmp_path), _commits(12))
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "on")
+    if native.load() is not None:
+        # local files + native scanner: the one-round-trip direct
+        # reader wins, pipeline stands down
+        w0 = obs.counter("pipeline.windows").value
+        _digest(path)
+        assert obs.counter("pipeline.windows").value == w0
+    # a store without local paths: byte acquisition is remote, engage
+    clear_parse_cache()
+    eng = HostEngine()
+    monkeypatch.setattr(eng.fs, "os_path", lambda p: None)
+    w0 = obs.counter("pipeline.windows").value
+    snap = Table.for_path(path, eng).latest_snapshot()
+    assert snap.state.num_files > 0
+    assert obs.counter("pipeline.windows").value > w0
+
+
+# --------------------------------------------------------------- faults
+
+
+def test_read_fault_mid_window_propagates_and_drains(tmp_path, monkeypatch):
+    path = write_log(str(tmp_path), _commits(25))
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "on")
+    eng = HostEngine()
+    real_read = eng.fs.read_file
+    boom = {"n": 0}
+
+    def flaky(p):
+        if p.endswith("00000000000000000014.json"):
+            boom["n"] += 1
+            raise OSError("injected mid-window read failure")
+        return real_read(p)
+
+    # present as a remote store so reads route through read_file (the
+    # local fast path reads straight into the window buffer)
+    monkeypatch.setattr(eng.fs, "os_path", lambda p: None)
+    monkeypatch.setattr(eng.fs, "read_file", flaky)
+    clear_parse_cache()
+    with pytest.raises(OSError, match="injected mid-window"):
+        Table.for_path(path, eng).latest_snapshot().state.file_actions
+    assert boom["n"] >= 1
+    _assert_no_pipeline_threads()
+
+    # the failure left no wedged state: a clean engine loads fine
+    clear_parse_cache()
+    snap = Table.for_path(path, HostEngine()).latest_snapshot()
+    assert snap.state.num_files > 0
+    _assert_no_pipeline_threads()
+
+
+def test_parse_fault_mid_window_propagates(tmp_path, monkeypatch):
+    path = write_log(str(tmp_path), _commits(25))
+    # corrupt one mid-log commit: not JSON at all
+    bad = os.path.join(path, "_delta_log", "00000000000000000013.json")
+    with open(bad, "w") as f:
+        f.write("this is not json\n")
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "force")
+    clear_parse_cache()
+    with pytest.raises(Exception):
+        Table.for_path(path, HostEngine()).latest_snapshot().state.file_actions
+    _assert_no_pipeline_threads()
+
+
+# ----------------------------------------------------- key-merge oracle
+
+
+def test_merge_replay_keys_dense_first_appearance():
+    import pandas as pd
+    import pyarrow as pa
+
+    from delta_tpu.replay.native_parse import (
+        NativeReplayKeys,
+        merge_replay_keys,
+    )
+
+    rng = np.random.RandomState(7)
+    pool = np.array([f"p{i}" for i in range(12)])
+    windows = [pool[rng.randint(0, 12, size=n)] for n in (9, 0, 14, 5)]
+
+    parts = []
+    for paths in windows:
+        codes, uniques = pd.factorize(paths, sort=False)
+        seen = set()
+        flags = np.array([c not in seen and not seen.add(c)
+                          for c in codes], dtype=bool)
+        keys = NativeReplayKeys(
+            codes.astype(np.uint32), flags,
+            codes[~flags].astype(np.uint32), len(uniques))
+        parts.append((keys, pa.array(list(uniques), pa.string()),
+                      len(paths)))
+
+    merged = merge_replay_keys(parts)
+    assert merged is not None
+
+    flat = np.concatenate(windows) if windows else np.empty(0)
+    codes, uniques = pd.factorize(flat, sort=False)
+    assert (merged.path_code == codes.astype(np.uint32)).all()
+    seen = set()
+    flags = np.array([c not in seen and not seen.add(c) for c in codes],
+                     dtype=bool)
+    assert (merged.path_new == flags).all()
+    assert (merged.refs == codes[~flags].astype(np.uint32)).all()
+    assert merged.n_uniq == len(uniques)
+
+
+def test_merge_replay_keys_none_part_disables():
+    from delta_tpu.replay.native_parse import merge_replay_keys
+
+    assert merge_replay_keys([]) is None
+    assert merge_replay_keys([(None, None, 3)]) is None
+
+
+# ------------------------------------------------------------ windowing
+
+
+def test_plan_windows_respects_byte_target(monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_PIPELINE_WINDOW_BYTES", "1000")
+    infos = [(v, f"c{v}.json", 400) for v in range(10)]
+    wins = pipeline.plan_windows(infos)
+    assert [i for w in wins for i in w] == infos  # order-preserving cover
+    assert all(len(w) >= 1 for w in wins)
+    assert len(wins) == 4  # 3 files (~1203B) per window, 10 files
+
+    # stat-deferred sizes (-1) still window by the nominal estimate
+    wins = pipeline.plan_windows([(v, f"c{v}.json", -1) for v in range(4)])
+    assert sum(len(w) for w in wins) == 4
