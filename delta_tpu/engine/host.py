@@ -26,6 +26,7 @@ from delta_tpu.engine.spi import (
     MetricsReporter,
     ParquetHandler,
 )
+from delta_tpu.resilience import endpoint_of, io_call
 from delta_tpu.storage.logstore import FileStatus, LogStore, logstore_for_path
 
 # process-wide storage I/O counters; per-file spans are verbose-only
@@ -51,12 +52,19 @@ class HostJsonHandler(JsonHandler):
 
     def read_json_files(self, paths: Sequence[str]) -> Iterator[tuple[str, bytes]]:
         for p in paths:
-            yield p, self._store_for(p).read(p)
+            store = self._store_for(p)
+            yield p, io_call(endpoint_of(p), lambda: store.read(p))
 
     def write_json_file_atomically(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        # Retrying a put-if-absent write is safe: transient failures
+        # surface before/without the object landing, and a retry that
+        # finds the object present raises FileAlreadyExistsError —
+        # permanent, so it flows straight to the conflict machinery.
+        store = self._store_for(path)
         with obs.span("storage.commit_write", path=path, bytes=len(data),
                       overwrite=overwrite):
-            self._store_for(path).write(path, data, overwrite=overwrite)
+            io_call(endpoint_of(path),
+                    lambda: store.write(path, data, overwrite=overwrite))
         _WRITE_CALLS.inc()
         _WRITE_BYTES.inc(len(data))
 
@@ -85,7 +93,9 @@ class HostParquetHandler(ParquetHandler):
         paths = list(paths)
         if len(paths) <= 1:
             for p in paths:
-                yield self._decode(self._store_for(p).read(p), columns)
+                store = self._store_for(p)
+                data = io_call(endpoint_of(p), lambda: store.read(p))
+                yield self._decode(data, columns)
             return
         # Byte-prefetch: keep the next reads in flight on the shared I/O
         # pool so decoding file i overlaps reading file i+1 (checkpoint
@@ -96,7 +106,8 @@ class HostParquetHandler(ParquetHandler):
         from delta_tpu.utils.threads import shared_pool
 
         pool = shared_pool()
-        read = obs.wrap(lambda p: self._store_for(p).read(p))
+        read = obs.wrap(
+            lambda p: io_call(endpoint_of(p), lambda: self._store_for(p).read(p)))
         pending: deque = deque()
         i = 0
         try:
@@ -118,7 +129,8 @@ class HostParquetHandler(ParquetHandler):
         store = self._store_for(path)
         with obs.span("storage.parquet_write", _verbose=True, path=path,
                       bytes=len(buf)):
-            store.write(path, buf, overwrite=True)
+            io_call(endpoint_of(path),
+                    lambda: store.write(path, buf, overwrite=True))
         _WRITE_CALLS.inc()
         _WRITE_BYTES.inc(len(buf))
         return store.file_status(path)
@@ -127,8 +139,10 @@ class HostParquetHandler(ParquetHandler):
         sink = pa.BufferOutputStream()
         pq.write_table(table, sink, compression="snappy")
         buf = sink.getvalue().to_pybytes()
+        store = self._store_for(path)
         with obs.span("storage.parquet_write", path=path, bytes=len(buf)):
-            self._store_for(path).write(path, buf, overwrite=False)
+            io_call(endpoint_of(path),
+                    lambda: store.write(path, buf, overwrite=False))
         _WRITE_CALLS.inc()
         _WRITE_BYTES.inc(len(buf))
 
@@ -146,7 +160,11 @@ class HostFileSystemClient(FileSystemClient):
     def list_from(self, path: str) -> Iterator[FileStatus]:
         self.list_calls += 1
         _LIST_CALLS.inc()
-        return self._store_for(path).list_from(path)
+        store = self._store_for(path)
+        # Materialize inside the retry so a listing that fails mid-walk
+        # is redone whole, never resumed half-consumed.
+        return iter(io_call(endpoint_of(path),
+                            lambda: list(store.list_from(path))))
 
     def list_from_fast(self, path: str, skip_stat):
         """Stat-skipping listing when the store supports it (local
@@ -156,14 +174,17 @@ class HostFileSystemClient(FileSystemClient):
         store = self._store_for(path)
         fast = getattr(store, "list_from_fast", None)
         if fast is not None:
-            return fast(path, skip_stat)
-        return store.list_from(path)
+            return iter(io_call(endpoint_of(path),
+                                lambda: list(fast(path, skip_stat))))
+        return iter(io_call(endpoint_of(path),
+                            lambda: list(store.list_from(path))))
 
     def read_file(self, path: str) -> bytes:
         self.read_calls += 1
         _READ_CALLS.inc()
+        store = self._store_for(path)
         with obs.span("storage.read", _verbose=True, path=path) as sp:
-            data = self._store_for(path).read(path)
+            data = io_call(endpoint_of(path), lambda: store.read(path))
             sp.set_attr("bytes", len(data))
         _READ_BYTES.inc(len(data))
         return data
@@ -171,9 +192,11 @@ class HostFileSystemClient(FileSystemClient):
     def write_file(self, path: str, data: bytes) -> None:
         _WRITE_CALLS.inc()
         _WRITE_BYTES.inc(len(data))
+        store = self._store_for(path)
         with obs.span("storage.write", _verbose=True, path=path,
                       bytes=len(data)):
-            self._store_for(path).write(path, data, overwrite=True)
+            io_call(endpoint_of(path),
+                    lambda: store.write(path, data, overwrite=True))
 
     def resolve_path(self, path: str) -> str:
         return path
@@ -192,13 +215,16 @@ class HostFileSystemClient(FileSystemClient):
         return self._store_for(path).walk(path)
 
     def delete(self, path: str) -> None:
-        self._store_for(path).delete(path)
+        store = self._store_for(path)
+        io_call(endpoint_of(path), lambda: store.delete(path))
 
     def exists(self, path: str) -> bool:
-        return self._store_for(path).exists(path)
+        store = self._store_for(path)
+        return io_call(endpoint_of(path), lambda: store.exists(path))
 
     def file_status(self, path: str):
-        return self._store_for(path).file_status(path)
+        store = self._store_for(path)
+        return io_call(endpoint_of(path), lambda: store.file_status(path))
 
 
 class HostExpressionHandler(ExpressionHandler):

@@ -338,6 +338,24 @@ class LogCorruptedError(DeltaError):
     error_class = "DELTA_LOG_FILE_MALFORMED"
 
 
+class TornCommitError(LogCorruptedError):
+    """The *trailing* commit file ends in a torn (partially written)
+    JSON line — the signature of an interrupted non-atomic write, as
+    opposed to mid-log corruption. Callers can drop the torn tip and
+    serve the previous version; `LogCorruptedError` proper means the
+    log is damaged somewhere history depends on."""
+
+    error_class = "DELTA_TORN_COMMIT"
+
+
+class CircuitOpenError(DeltaError):
+    """An endpoint's circuit breaker is open: recent calls failed
+    repeatedly, so this call fails fast instead of burning a retry
+    budget (see delta_tpu/resilience/breaker.py)."""
+
+    error_class = "DELTA_CIRCUIT_BREAKER_OPEN"
+
+
 class DomainMetadataError(DeltaError):
     error_class = "DELTA_DOMAIN_METADATA_NOT_SUPPORTED"
 

@@ -79,6 +79,10 @@ class HttpTransport:
         if data is not None:
             req.add_header("Content-Type", "application/json; charset=utf-8")
         delay = 0.5
+        # delta-lint: disable=retry-discipline (audited: the sharing
+        # protocol's backoff is server-directed — the Retry-After header
+        # overrides any client-side schedule, which RetryPolicy's
+        # decorrelated jitter cannot express)
         for attempt in range(self.max_retries + 1):
             try:
                 return urllib.request.urlopen(req, timeout=self.timeout)

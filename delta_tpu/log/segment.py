@@ -27,6 +27,8 @@ from delta_tpu.storage.logstore import FileStatus
 from delta_tpu.utils import filenames
 from delta_tpu.utils.filenames import CheckpointInstance, group_complete_checkpoints
 
+_HINT_DISCARDED = obs.counter("log.hint_discarded")
+
 
 @dataclass
 class LogSegment:
@@ -199,12 +201,32 @@ def build_log_segment(
     target_version: Optional[int] = None,
     checkpoint_hint: Optional[int] = None,
     use_compacted_deltas: bool = True,
+    max_checkpoint_version: Optional[int] = None,
 ) -> LogSegment:
     """LIST the log and assemble the segment for `target_version` (or the
-    latest version when None)."""
+    latest version when None).
+
+    `max_checkpoint_version` caps which checkpoints may anchor the
+    segment (corruption fallback: a reader that failed to consume the
+    checkpoint at version V rebuilds with `max_checkpoint_version=V - 1`
+    to replay from the previous complete checkpoint, or from the JSON
+    commits alone when none remains)."""
     with obs.span("log.list_segment", log_path=log_path) as sp:
-        seg = _build_log_segment(fs, log_path, target_version,
-                                 checkpoint_hint, use_compacted_deltas)
+        try:
+            seg = _build_log_segment(fs, log_path, target_version,
+                                     checkpoint_hint, use_compacted_deltas,
+                                     max_checkpoint_version)
+        except CorruptLogError:
+            if checkpoint_hint is None:
+                raise
+            # the hint is only an accelerator: a window that can't be
+            # assembled from it (e.g. the hinted checkpoint lost a part)
+            # may still assemble from a full listing anchored earlier
+            _HINT_DISCARDED.inc()
+            sp.set_attr("hint_discarded", True)
+            seg = _build_log_segment(fs, log_path, target_version,
+                                     None, use_compacted_deltas,
+                                     max_checkpoint_version)
         sp.set_attrs(version=seg.version, num_deltas=len(seg.deltas),
                      num_checkpoint_parts=len(seg.checkpoints),
                      num_compacted=len(seg.compacted_deltas))
@@ -217,6 +239,7 @@ def _build_log_segment(
     target_version: Optional[int],
     checkpoint_hint: Optional[int],
     use_compacted_deltas: bool,
+    max_checkpoint_version: Optional[int] = None,
 ) -> LogSegment:
     start = checkpoint_hint if checkpoint_hint is not None else 0
     prefix = filenames.listing_prefix(log_path, start)
@@ -249,7 +272,11 @@ def _build_log_segment(
                 deltas.append((v, fstat))
         elif filenames.CHECKPOINT_FILE_RE.match(name) and fstat.size > 0:
             ci = CheckpointInstance.parse(fstat.path)
-            if ci is not None and (target_version is None or ci.version <= target_version):
+            if (ci is not None
+                    and (target_version is None
+                         or ci.version <= target_version)
+                    and (max_checkpoint_version is None
+                         or ci.version <= max_checkpoint_version)):
                 checkpoint_files.append(ci)
         elif filenames.COMPACTED_DELTA_FILE_RE.match(name):
             lo, hi = filenames.compacted_delta_versions(fstat.path)
@@ -262,6 +289,7 @@ def _build_log_segment(
             return build_log_segment(
                 fs, log_path, target_version, checkpoint_hint=None,
                 use_compacted_deltas=use_compacted_deltas,
+                max_checkpoint_version=max_checkpoint_version,
             )
         raise TableNotFoundError(f"no commits found in {log_path}",
                                  error_class="DELTA_NO_COMMITS_FOUND")

@@ -46,6 +46,7 @@ _OBS_CACHE_PARTIAL = obs.counter("parse_cache.partial_hits")
 _OBS_CACHE_MISSES = obs.counter("parse_cache.misses")
 _OBS_CACHE_HIT_FILES = obs.counter("parse_cache.hit_files")
 _OBS_CACHE_MISS_FILES = obs.counter("parse_cache.miss_files")
+_TORN_COMMITS = obs.counter("log.torn_commits")
 
 DV_STRUCT_TYPE = pa.struct(
     [
@@ -556,10 +557,16 @@ def _parse_buffer_generic(
         - np.repeat(np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
     ).astype(np.int32)
 
-    table = pa_json.read_json(
-        pa.BufferReader(pa.py_buffer(buf)),
-        read_options=pa_json.ReadOptions(block_size=1 << 24),
-    )
+    try:
+        table = pa_json.read_json(
+            pa.BufferReader(pa.py_buffer(buf)),
+            read_options=pa_json.ReadOptions(block_size=1 << 24),
+        )
+    except pa.ArrowInvalid:
+        # malformed JSON somewhere in the concatenated buffer; the
+        # per-file fallback path diagnoses which commit (and whether it
+        # is a torn trailing line) precisely
+        return None
     if table.num_rows != versions.shape[0]:
         return None
     return table, versions, orders, total
@@ -615,18 +622,60 @@ def parse_commit_batch(
     data = b"".join(bufs)
     versions = np.concatenate(versions_parts) if versions_parts else np.empty(0, np.int64)
     orders = np.concatenate(orders_parts) if orders_parts else np.empty(0, np.int32)
-    table = pa_json.read_json(
-        pa.BufferReader(data),
-        read_options=pa_json.ReadOptions(block_size=1 << 24),
-    )
+    try:
+        table = pa_json.read_json(
+            pa.BufferReader(data),
+            read_options=pa_json.ReadOptions(block_size=1 << 24),
+        )
+    except pa.ArrowInvalid as e:
+        _raise_commit_parse_error(commit_blobs, str(e), cause=e)
     if table.num_rows != versions.shape[0]:
-        from delta_tpu.errors import LogCorruptedError
-
-        raise LogCorruptedError(
+        _raise_commit_parse_error(
+            commit_blobs,
             f"JSON parse row count {table.num_rows} != line count "
-            f"{versions.shape[0]}"
+            f"{versions.shape[0]}",
         )
     return table, versions, orders, total
+
+
+def _raise_commit_parse_error(
+    commit_blobs: Sequence[Tuple[int, bytes]], detail: str, cause=None
+):
+    """Diagnose a commit-batch parse failure before raising.
+
+    A crashed writer on a non-atomic store leaves the *newest* commit
+    with a truncated final line; everything before it is intact. That
+    shape is recoverable (drop the tip, read at version - 1), so it gets
+    a dedicated `TornCommitError` carrying the torn version. Corruption
+    anywhere else means the log itself is damaged and stays a plain
+    `LogCorruptedError`.
+    """
+    from delta_tpu.errors import LogCorruptedError, TornCommitError
+
+    tip_version, tip_blob = max(commit_blobs, key=lambda vb: vb[0])
+    lines = [ln for ln in tip_blob.split(b"\n") if ln.strip()]
+    torn = False
+    if lines:
+        try:
+            json.loads(lines[-1])
+        except ValueError:
+            torn = all(_json_line_ok(ln) for ln in lines[:-1])
+    if torn:
+        _TORN_COMMITS.inc()
+        raise TornCommitError(
+            f"commit {tip_version} ends with a torn JSON line "
+            f"(interrupted write); earlier lines are intact",
+            version=tip_version,
+        ) from cause
+    raise LogCorruptedError(detail, version=tip_version) from cause
+
+
+def _json_line_ok(line: bytes) -> bool:
+    try:
+        json.loads(line)
+        return True
+    except ValueError:
+        return False
 
 
 SMALL_ACTION_COLUMNS = ("protocol", "metaData", "txn", "domainMetadata")

@@ -335,9 +335,16 @@ def _read_window(engine, index: int,
 
 
 def _reader_main(engine, windows, out_q, stop) -> None:
+    from delta_tpu.resilience import default_policy
+
+    # A transient window-fetch failure (network blip mid-cold-load)
+    # retries just that window instead of killing the whole pipelined
+    # load; permanent errors (corruption, missing files) still flow to
+    # the consumer via _offer_error for a fail-fast drain + clean join.
+    policy = default_policy()
     try:
         for i, win in enumerate(windows):
-            item = _read_window(engine, i, win)
+            item = policy.call(lambda: _read_window(engine, i, win))
             _put(out_q, item, stop, _READ_STALL_NS)
         _put(out_q, _DONE, stop, _READ_STALL_NS)
     except _Cancelled:

@@ -1,0 +1,106 @@
+"""delta-resilience: unified retry/backoff, circuit breaking, and chaos.
+
+The reference implementation survives flaky object stores by
+construction — every storage round trip goes through Hadoop FS clients
+that retry transients with exponential backoff, and `_delta_log`
+recovery tolerates zombie writers (`Checkpoints.scala:752-767`). This
+package gives the port the same shape as one shared subsystem instead
+of ad-hoc loops:
+
+- :mod:`delta_tpu.resilience.classify` — maps an exception to
+  transient (worth retrying) or permanent (fail fast), consulting the
+  error catalog for `DeltaError` subclasses.
+- :mod:`delta_tpu.resilience.policy` — `RetryPolicy`: exponential
+  backoff with decorrelated jitter, attempt caps, and a wall-clock
+  deadline budget. Env-tunable via ``DELTA_TPU_RETRY_*``.
+- :mod:`delta_tpu.resilience.breaker` — per-endpoint circuit breaker
+  (closed → open → half-open with probe requests) so a dead endpoint
+  fails fast instead of serially burning retry budgets.
+- :mod:`delta_tpu.resilience.chaos` — deterministic seeded
+  `ChaosStore` fault-injection wrapper (superset of
+  `FaultInjectingLogStore`) for soak testing.
+
+Every storage-facing layer funnels IO through :func:`io_call` so the
+policy, breaker registry, and telemetry
+(``storage.retry.attempts``, ``storage.breaker.state``) stay uniform.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, TypeVar
+
+from delta_tpu.resilience.breaker import (
+    CircuitBreaker,
+    breaker_for,
+    reset_breakers,
+)
+from delta_tpu.resilience.chaos import ChaosSchedule, ChaosStore
+from delta_tpu.resilience.classify import (
+    PERMANENT,
+    TRANSIENT,
+    StorageRequestError,
+    classify,
+    is_transient,
+)
+from delta_tpu.resilience.policy import RetryPolicy
+
+T = TypeVar("T")
+
+_policy_lock = threading.Lock()
+_default_policy: Optional[RetryPolicy] = None
+
+
+def default_policy() -> RetryPolicy:
+    """The process-wide IO retry policy, built once from the
+    ``DELTA_TPU_RETRY_*`` environment knobs."""
+    global _default_policy
+    p = _default_policy
+    if p is None:
+        with _policy_lock:
+            p = _default_policy
+            if p is None:
+                p = RetryPolicy.from_env()
+                _default_policy = p
+    return p
+
+
+def reset() -> None:
+    """Forget the cached policy and all breaker state (tests)."""
+    global _default_policy
+    with _policy_lock:
+        _default_policy = None
+    reset_breakers()
+
+
+def endpoint_of(path: str) -> str:
+    """Endpoint key for breaker bucketing: the URL scheme, or
+    ``file`` for plain paths."""
+    i = path.find("://")
+    return path[:i] if i > 0 else "file"
+
+
+def io_call(endpoint: str, fn: Callable[[], T]) -> T:
+    """Run one storage operation under the default retry policy and the
+    endpoint's circuit breaker. This is the single funnel every
+    storage-facing layer uses; keep its fault-free path cheap."""
+    return default_policy().call(fn, breaker=breaker_for(endpoint))
+
+
+__all__ = [
+    "CircuitBreaker",
+    "ChaosSchedule",
+    "ChaosStore",
+    "PERMANENT",
+    "RetryPolicy",
+    "StorageRequestError",
+    "TRANSIENT",
+    "breaker_for",
+    "classify",
+    "default_policy",
+    "endpoint_of",
+    "io_call",
+    "is_transient",
+    "reset",
+    "reset_breakers",
+]

@@ -1,0 +1,152 @@
+"""Per-endpoint circuit breaker: closed → open → half-open.
+
+When an endpoint (one storage scheme/host) fails repeatedly, retrying
+every caller serially multiplies the damage — each request burns a
+full backoff budget before failing. The breaker converts that into a
+fast fail: after ``threshold`` consecutive transient failures the
+circuit *opens* and calls raise :class:`CircuitOpenError` immediately.
+After ``reset_s`` seconds one *probe* request is let through
+(*half-open*); success closes the circuit, failure re-opens it and
+restarts the clock.
+
+Only transient failures count — a `FileNotFoundError` is an answer,
+not an outage (see `delta_tpu/resilience/classify.py`), and the
+`RetryPolicy` only reports transient outcomes here.
+
+Telemetry: every state transition increments
+``storage.breaker.state`` and emits a span event carrying the
+endpoint and the new state; opens and probes have their own counters.
+
+Env knobs: ``DELTA_TPU_BREAKER_THRESHOLD`` (default 8 consecutive
+failures), ``DELTA_TPU_BREAKER_RESET_S`` (default 10.0).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict
+
+from delta_tpu import obs
+from delta_tpu.errors import CircuitOpenError
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_STATE_CHANGES = obs.counter("storage.breaker.state")
+_OPENS = obs.counter("storage.breaker.opens")
+_PROBES = obs.counter("storage.breaker.probes")
+_FAST_FAILS = obs.counter("storage.breaker.fast_fails")
+
+
+class CircuitBreaker:
+    """One breaker, normally one per endpoint via :func:`breaker_for`.
+
+    The fault-free path reads ``self._state`` without taking the lock
+    (attribute reads are atomic under the GIL); the lock guards only
+    failure accounting and transitions.
+    """
+
+    def __init__(self, name: str, threshold: int = 8,
+                 reset_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.threshold = max(1, int(threshold))
+        self.reset_s = float(reset_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def before_call(self) -> None:
+        """Gate an attempt. Raises :class:`CircuitOpenError` when open,
+        except for the single probe allowed once ``reset_s`` elapsed."""
+        if self._state == CLOSED:
+            return
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.reset_s:
+                    self._transition(HALF_OPEN)
+                else:
+                    _FAST_FAILS.inc()
+                    raise CircuitOpenError(
+                        f"circuit breaker open for endpoint "
+                        f"'{self.name}' after {self._failures} "
+                        f"consecutive failures",
+                        endpoint=self.name)
+            if self._state == HALF_OPEN:
+                if self._probing:
+                    _FAST_FAILS.inc()
+                    raise CircuitOpenError(
+                        f"circuit breaker half-open for endpoint "
+                        f"'{self.name}'; probe in flight",
+                        endpoint=self.name)
+                self._probing = True
+                _PROBES.inc()
+
+    def on_success(self) -> None:
+        if self._state == CLOSED and self._failures == 0:
+            return
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def on_failure(self) -> None:
+        """Record one transient failure."""
+        with self._lock:
+            self._failures += 1
+            if self._state == HALF_OPEN:
+                self._probing = False
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+            elif self._state == CLOSED and self._failures >= self.threshold:
+                self._opened_at = self._clock()
+                _OPENS.inc()
+                self._transition(OPEN)
+
+    # call with self._lock held
+    def _transition(self, state: str) -> None:
+        self._state = state
+        _STATE_CHANGES.inc()
+        obs.add_event("breaker.transition", endpoint=self.name, state=state)
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(endpoint: str) -> CircuitBreaker:
+    """The process-wide breaker for an endpoint key (URL scheme)."""
+    b = _breakers.get(endpoint)
+    if b is not None:
+        return b
+    with _breakers_lock:
+        b = _breakers.get(endpoint)
+        if b is None:
+            b = CircuitBreaker(
+                endpoint,
+                threshold=int(float(
+                    os.environ.get("DELTA_TPU_BREAKER_THRESHOLD") or 8)),
+                reset_s=float(
+                    os.environ.get("DELTA_TPU_BREAKER_RESET_S") or 10.0),
+            )
+            _breakers[endpoint] = b
+    return b
+
+
+def reset_breakers() -> None:
+    """Drop all breaker state (tests)."""
+    with _breakers_lock:
+        _breakers.clear()

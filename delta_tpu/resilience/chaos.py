@@ -1,0 +1,201 @@
+"""ChaosStore: deterministic seeded fault injection for soak tests.
+
+`FaultInjectingLogStore` arms *specific* faults for *specific* tests
+("fail the next write of 00000003.json"). The chaos harness asks the
+opposite question: under a sustained, seeded barrage of generic faults
+— transient request errors, latency spikes, torn writes, stale
+listings — does the full workload still converge to exactly the state
+a fault-free run produces? That is the property serving infrastructure
+actually needs, and seeding makes any failure replayable.
+
+Fault model (each drawn independently per operation from one seeded
+RNG, so a given seed yields one schedule):
+
+- **transient errors** (`error_rate`): the operation raises
+  :class:`ChaosError` *before* touching the inner store. Raising
+  pre-write keeps put-if-absent exactly-once: a retry can never turn
+  one logical commit into a false `FileAlreadyExistsError`.
+- **latency spikes** (`latency_rate`): the operation sleeps a seeded
+  duration first.
+- **torn writes** (`torn_write_rate`): for paths matching
+  ``torn_pred`` (default: checkpoint artifacts, ``.crc`` files, and
+  the ``_last_checkpoint`` hint) a prefix of the payload is written,
+  then :class:`ChaosError` raises — the reader-side corruption
+  fallback must absorb the damage. Commit ``.json`` files are
+  excluded by default: their writes are atomic-by-contract on every
+  store (O_EXCL / generation preconditions), so a torn commit can
+  only come from a store whose `is_partial_write_visible` is true —
+  that shape is covered by the dedicated torn-commit tests.
+- **stale listings** (`stale_list_rate`): `list_from` drops entries
+  from the *tail* of the result — the prefix-consistent shape real
+  eventually-consistent listings have. Readers see an older version;
+  writers lose the put-if-absent race and rebase.
+
+All decisions honour ``path_filter`` (default: only `_delta_log`
+paths) so table-data IO can be left quiet while the log is hammered.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+from delta_tpu import obs
+from delta_tpu.storage.logstore import (
+    DelegatingLogStore,
+    FileStatus,
+    LogStore,
+)
+
+_CHAOS_FAULTS = obs.counter("chaos.faults")
+_CHAOS_TORN = obs.counter("chaos.torn_writes")
+_CHAOS_STALE = obs.counter("chaos.stale_listings")
+
+
+class ChaosError(IOError):
+    """A seeded injected transient fault (classified retryable)."""
+
+
+def _default_torn_pred(path: str) -> bool:
+    name = path.rpartition("/")[2]
+    return (".checkpoint" in name or name.endswith(".crc")
+            or name == "_last_checkpoint")
+
+
+def _default_path_filter(path: str) -> bool:
+    return "_delta_log" in path
+
+
+class ChaosSchedule:
+    """Seeded per-operation fault decisions. Thread-safe: draws are
+    serialized so one seed produces one decision sequence."""
+
+    def __init__(self, seed: int, error_rate: float = 0.05,
+                 latency_rate: float = 0.0,
+                 latency_s: tuple = (0.0002, 0.002),
+                 torn_write_rate: float = 0.0,
+                 stale_list_rate: float = 0.0):
+        self.seed = seed
+        self.error_rate = error_rate
+        self.latency_rate = latency_rate
+        self.latency_s = latency_s
+        self.torn_write_rate = torn_write_rate
+        self.stale_list_rate = stale_list_rate
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def draw(self) -> float:
+        with self._lock:
+            return self._rng.random()
+
+    def draw_latency(self) -> float:
+        lo, hi = self.latency_s
+        with self._lock:
+            return self._rng.uniform(lo, hi)
+
+    def draw_stale_drop(self, n: int) -> int:
+        """How many tail entries to hide from an n-entry listing.
+
+        Always leaves at least one entry visible: a stale listing lags
+        behind the log tail, it never makes an existing table vanish
+        (an empty listing is indistinguishable from "no table", which
+        no amount of retrying can recover from). Returns 0 for n <= 1.
+        """
+        with self._lock:
+            return self._rng.randint(1, max(1, min(3, n - 1))) if n > 1 else 0
+
+
+class ChaosStore(DelegatingLogStore):
+    """Seeded chaos wrapper around any `LogStore`.
+
+    ``enabled`` can be flipped off (e.g. for final verification reads)
+    without rebuilding engines; ``fault_log`` records every injection
+    as ``(kind, op, path)`` for assertions and replay triage.
+    """
+
+    def __init__(self, inner: LogStore, schedule: ChaosSchedule,
+                 path_filter: Optional[Callable[[str], bool]] = None,
+                 torn_pred: Optional[Callable[[str], bool]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        super().__init__(inner)
+        self.schedule = schedule
+        self.path_filter = path_filter or _default_path_filter
+        self.torn_pred = torn_pred or _default_torn_pred
+        self.enabled = True
+        self.fault_log: List[tuple] = []
+        self.fault_counts: Dict[str, int] = {}
+        self._sleep = sleep
+
+    # ------------------------------------------------------------ core
+    def _record(self, kind: str, op: str, path: str) -> None:
+        self.fault_log.append((kind, op, path))
+        self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+
+    def _perturb(self, op: str, path: str) -> None:
+        """Latency then maybe a transient error, before the real op."""
+        if not self.enabled or not self.path_filter(path):
+            return
+        s = self.schedule
+        if s.latency_rate and s.draw() < s.latency_rate:
+            self._record("latency", op, path)
+            self._sleep(s.draw_latency())
+        if s.error_rate and s.draw() < s.error_rate:
+            self._record("error", op, path)
+            _CHAOS_FAULTS.inc()
+            raise ChaosError(f"chaos[{s.seed}]: injected {op} fault: {path}")
+
+    # ------------------------------------------------------------- ops
+    def read(self, path: str) -> bytes:
+        self._perturb("read", path)
+        return self.inner.read(path)
+
+    def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self._perturb("write", path)
+        s = self.schedule
+        if (self.enabled and s.torn_write_rate and self.path_filter(path)
+                and self.torn_pred(path) and s.draw() < s.torn_write_rate):
+            self._record("torn_write", "write", path)
+            _CHAOS_TORN.inc()
+            torn = data[: len(data) // 2]
+            self.inner.write(path, torn, overwrite)
+            raise ChaosError(
+                f"chaos[{s.seed}]: torn write ({len(torn)}/{len(data)} "
+                f"bytes): {path}")
+        self.inner.write(path, data, overwrite)
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        self._perturb("list_from", path)
+        entries = list(self.inner.list_from(path))
+        s = self.schedule
+        if (self.enabled and s.stale_list_rate and entries
+                and self.path_filter(path)
+                and s.draw() < s.stale_list_rate):
+            drop = s.draw_stale_drop(len(entries))
+            # A lagging listing hides recent tail entries; it must not
+            # hide the table itself. Shrink the drop until at least one
+            # commit .json stays visible (else readers conclude the
+            # table does not exist — unrecoverable, not merely stale).
+            def _has_commit(es):
+                return any(e.path.endswith(".json") for e in es)
+            while (drop and _has_commit(entries)
+                   and not _has_commit(entries[:len(entries) - drop])):
+                drop -= 1
+            if drop:
+                self._record("stale_list", "list_from", path)
+                _CHAOS_STALE.inc()
+                entries = entries[:-drop]
+        return iter(entries)
+
+    def list_dir(self, path: str) -> List[FileStatus]:
+        self._perturb("list_dir", path)
+        return self.inner.list_dir(path)
+
+    def exists(self, path: str) -> bool:
+        self._perturb("exists", path)
+        return self.inner.exists(path)
+
+    def file_status(self, path: str) -> FileStatus:
+        self._perturb("file_status", path)
+        return self.inner.file_status(path)

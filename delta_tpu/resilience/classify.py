@@ -1,0 +1,119 @@
+"""Transient-vs-permanent exception classification.
+
+One classifier for the whole engine so every retry site agrees on what
+is worth retrying. The split mirrors the reference's treatment of
+storage `CommitFailedException(retryable=...)` and the Hadoop FS
+retry policies:
+
+- **transient** — the operation may succeed if simply repeated:
+  network blips (`ConnectionError`, `TimeoutError`, generic
+  `OSError`), HTTP 408/429/5xx responses, DynamoDB throttling and
+  5xx, and `DeltaError`s whose raise site marked them ``retryable``.
+- **permanent** — repeating cannot help: protocol signals
+  (`FileNotFoundError`, `FileExistsError` — put-if-absent losses must
+  surface to the conflict machinery, never be swallowed by a retry
+  loop), permission errors, corruption (`pyarrow` decode failures,
+  `LogCorruptedError`), and every other `DeltaError`.
+
+Classification is structural (types + attributes), with the error
+catalog consulted for `DeltaError` subclasses so a class-level policy
+can be kept in one place.
+"""
+
+from __future__ import annotations
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+# HTTP statuses worth retrying: request timeout, throttling, and
+# server-side failures. 501 (Not Implemented) is deliberately excluded.
+_RETRYABLE_HTTP = frozenset({408, 429, 500, 502, 503, 504})
+
+# DynamoDB error types that are throttling/availability, not caller bugs.
+_RETRYABLE_DDB_TYPES = frozenset({
+    "ProvisionedThroughputExceededException",
+    "ThrottlingException",
+    "RequestLimitExceeded",
+    "InternalServerError",
+    "ServiceUnavailable",
+    "TransactionConflictException",
+    "LimitExceededException",
+})
+
+# DeltaError catalog classes that are safe to retry at the storage
+# layer. Deliberately empty today: DeltaErrors encode logical outcomes
+# (conflicts, corruption, unsupported features) that retrying at the IO
+# layer would only mask — retryable commit failures carry an explicit
+# ``retryable`` attribute instead. Kept as a named set so a future
+# catalog class can opt in without touching the classifier logic.
+_RETRYABLE_ERROR_CLASSES = frozenset()
+
+# OSError subclasses that are protocol signals or caller bugs, never
+# network weather.
+_PERMANENT_OSERRORS = (
+    FileNotFoundError,
+    FileExistsError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+
+class StorageRequestError(IOError):
+    """An HTTP storage request failed with a status code.
+
+    Cloud clients raise this instead of a bare ``IOError`` so the
+    classifier can discriminate 5xx/429 (transient) from 4xx
+    (permanent) without parsing message text.
+    """
+
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = int(status)
+
+
+def classify(exc: BaseException) -> str:
+    """Return :data:`TRANSIENT` or :data:`PERMANENT` for ``exc``."""
+    return TRANSIENT if is_transient(exc) else PERMANENT
+
+
+def is_transient(exc: BaseException) -> bool:
+    # Explicit override wins: anything carrying retryable=True was
+    # classified at the raise site (CommitFailedError /
+    # CommitFailedException both use this spelling).
+    retryable = getattr(exc, "retryable", None)
+    if retryable is not None:
+        return bool(retryable)
+
+    from delta_tpu.errors import DeltaError
+
+    if isinstance(exc, DeltaError):
+        return exc.error_class in _RETRYABLE_ERROR_CLASSES
+
+    status = getattr(exc, "status", None)
+    try:
+        status = int(status) if status is not None else None
+    except (TypeError, ValueError):
+        status = None
+
+    error_type = getattr(exc, "error_type", None)
+    if error_type is not None:
+        # DynamoDbError shape: .error_type + .status
+        return error_type in _RETRYABLE_DDB_TYPES or (status or 0) >= 500
+    if isinstance(exc, StorageRequestError):
+        # status 0 means the transport itself failed (connection reset,
+        # DNS) before any HTTP status arrived — retryable.
+        return exc.status in _RETRYABLE_HTTP or exc.status == 0
+    if status is not None and isinstance(exc, IOError):
+        return status in _RETRYABLE_HTTP or status >= 500
+
+    if isinstance(exc, _PERMANENT_OSERRORS):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError):
+        # Bare OSError/IOError from sockets, HTTP stacks, and flaky
+        # filesystems: retryable by default. Specific permanent shapes
+        # were excluded above.
+        return True
+    return False

@@ -1,0 +1,183 @@
+"""RetryPolicy: exponential backoff with decorrelated jitter.
+
+One policy object shared by every storage-facing layer, replacing the
+ad-hoc loops that used to live at each call site. Semantics:
+
+- attempt 1 runs immediately; only exceptions classified *transient*
+  (:func:`delta_tpu.resilience.classify.is_transient`) are retried —
+  permanent errors re-raise untouched on the first attempt, so
+  protocol signals like `FileAlreadyExistsError` keep their exact
+  meaning.
+- sleep between attempts follows decorrelated jitter
+  (`sleep = min(cap, uniform(base, 3 * prev_sleep))`), which avoids
+  the synchronized herds plain exponential backoff produces.
+- two budgets bound the loop: an attempt cap and a wall-clock
+  deadline. Whichever exhausts first re-raises the last error.
+
+Telemetry: each retry increments ``storage.retry.attempts`` and total
+sleep is both counted (``storage.retry.sleep_ns``) and attributed to
+the enclosing delta-trace span (``retry_sleep_ms`` attribute +
+per-retry events), so a slow cold load shows *where* the time went.
+
+Environment knobs (read by :meth:`RetryPolicy.from_env`):
+
+========================================  =======  =========================
+``DELTA_TPU_RETRY_MAX_ATTEMPTS``          5        total attempts, >= 1
+``DELTA_TPU_RETRY_BASE_MS``               50       first-sleep lower bound
+``DELTA_TPU_RETRY_CAP_MS``                5000     per-sleep upper bound
+``DELTA_TPU_RETRY_DEADLINE_S``            60       wall-clock budget
+========================================  =======  =========================
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+from delta_tpu import obs
+from delta_tpu.resilience.classify import is_transient
+
+T = TypeVar("T")
+
+_RETRY_ATTEMPTS = obs.counter("storage.retry.attempts")
+_RETRY_SLEEP_NS = obs.counter("storage.retry.sleep_ns")
+_RETRY_EXHAUSTED = obs.counter("storage.retry.exhausted")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+class RetryPolicy:
+    """Immutable retry configuration plus the retry loop itself.
+
+    ``sleep``/``clock``/``rng`` are injectable for deterministic tests;
+    production call sites never pass them.
+    """
+
+    __slots__ = ("max_attempts", "base_s", "cap_s", "deadline_s",
+                 "_sleep", "_clock", "_rng")
+
+    def __init__(self, max_attempts: int = 5, base_s: float = 0.05,
+                 cap_s: float = 5.0, deadline_s: float = 60.0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_s = max(0.0, float(base_s))
+        self.cap_s = max(self.base_s, float(cap_s))
+        self.deadline_s = float(deadline_s)
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random()
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RetryPolicy":
+        kw = {
+            "max_attempts": int(_env_float("DELTA_TPU_RETRY_MAX_ATTEMPTS", 5)),
+            "base_s": _env_float("DELTA_TPU_RETRY_BASE_MS", 50.0) / 1000.0,
+            "cap_s": _env_float("DELTA_TPU_RETRY_CAP_MS", 5000.0) / 1000.0,
+            "deadline_s": _env_float("DELTA_TPU_RETRY_DEADLINE_S", 60.0),
+        }
+        kw.update(overrides)
+        return cls(**kw)
+
+    def with_overrides(self, **overrides) -> "RetryPolicy":
+        kw = {
+            "max_attempts": self.max_attempts,
+            "base_s": self.base_s,
+            "cap_s": self.cap_s,
+            "deadline_s": self.deadline_s,
+            "sleep": self._sleep,
+            "clock": self._clock,
+            "rng": self._rng,
+        }
+        kw.update(overrides)
+        return RetryPolicy(**kw)
+
+    def call(self, fn: Callable[[], T], *,
+             breaker=None,
+             classify: Callable[[BaseException], bool] = is_transient,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             ) -> T:
+        """Run ``fn`` under this policy.
+
+        ``breaker`` (a :class:`CircuitBreaker`) is consulted before
+        every attempt and told about each outcome; an open breaker
+        raises `CircuitOpenError` without invoking ``fn``. Only
+        transient failures count against the breaker — a
+        `FileNotFoundError` says nothing about endpoint health.
+
+        ``on_retry(attempt, exc)`` fires before each backoff sleep —
+        call sites use it to keep bespoke counters (e.g. the GCS
+        arbiter's fix-retry count) without owning the loop.
+        """
+        if breaker is not None:
+            breaker.before_call()
+        try:
+            result = fn()
+        except BaseException as e:
+            if not classify(e):
+                raise
+            if breaker is not None:
+                breaker.on_failure()
+            return self._retry_slow_path(fn, e, breaker, classify, on_retry)
+        if breaker is not None:
+            breaker.on_success()
+        return result
+
+    # Kept off the fast path: the code above is all a fault-free call
+    # ever executes.
+    def _retry_slow_path(self, fn, first_exc, breaker, classify, on_retry):
+        start = self._clock()
+        deadline = start + self.deadline_s
+        exc = first_exc
+        prev_sleep = self.base_s
+        total_sleep_ns = 0
+        attempt = 1
+        while True:
+            if attempt >= self.max_attempts or self._clock() >= deadline:
+                _RETRY_EXHAUSTED.inc()
+                obs.add_event("retry.exhausted", attempts=attempt,
+                              error=type(exc).__name__)
+                raise exc
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            # Decorrelated jitter, clipped to both the per-sleep cap and
+            # the remaining deadline budget.
+            delay = min(self.cap_s,
+                        self._rng.uniform(self.base_s, prev_sleep * 3.0))
+            delay = min(delay, max(0.0, deadline - self._clock()))
+            prev_sleep = max(delay, self.base_s)
+            _RETRY_ATTEMPTS.inc()
+            obs.add_event("retry", attempt=attempt,
+                          error=type(exc).__name__, sleep_ms=delay * 1e3)
+            if delay > 0:
+                self._sleep(delay)
+                total_sleep_ns += int(delay * 1e9)
+                _RETRY_SLEEP_NS.inc(int(delay * 1e9))
+            attempt += 1
+            if breaker is not None:
+                breaker.before_call()
+            try:
+                result = fn()
+            except BaseException as e:
+                if not classify(e):
+                    raise
+                if breaker is not None:
+                    breaker.on_failure()
+                exc = e
+                continue
+            if breaker is not None:
+                breaker.on_success()
+            obs.set_attrs(retry_attempts=attempt - 1,
+                          retry_sleep_ms=total_sleep_ns / 1e6)
+            return result

@@ -9,6 +9,7 @@ when the version is unchanged.
 from __future__ import annotations
 
 import logging
+import re
 from typing import Dict, Optional
 
 from delta_tpu import obs
@@ -22,6 +23,13 @@ from delta_tpu.replay.state import (
 )
 
 _log = logging.getLogger(__name__)
+
+_CHECKPOINT_FALLBACKS = obs.counter("snapshot.checkpoint_fallbacks")
+_TORN_FALLBACKS = obs.counter("snapshot.torn_commit_fallbacks")
+_CRC_QUARANTINED = obs.counter("snapshot.crc_quarantined")
+
+# a commit file in a FileNotFoundError message (vs a checkpoint part)
+_COMMIT_JSON_RE = re.compile(r"\d{20}\.json")
 
 
 class Snapshot:
@@ -50,8 +58,100 @@ class Snapshot:
         if self._state is None:
             with obs.span("snapshot.load", table=self._table.path,
                           version=self.version):
-                self._state = reconstruct_state(self._engine, self._segment)
+                self._state = self._load_state()
         return self._state
+
+    def _load_state(self) -> SnapshotState:
+        """Reconstruct state with the degradation ladder: a corrupt or
+        incomplete checkpoint falls back to the previous complete
+        checkpoint (or pure JSON replay), and a torn trailing commit —
+        an interrupted writer's half-line, not a real commit — falls
+        back to the last intact version. Both paths warn and count;
+        corruption that no fallback can route around still raises."""
+        import pyarrow as pa
+
+        from delta_tpu.errors import LogCorruptedError, TornCommitError
+        from delta_tpu.log.segment import build_log_segment
+
+        seg = self._segment
+        while True:
+            try:
+                state = reconstruct_state(self._engine, seg)
+                break
+            except TornCommitError as e:
+                torn_v = e.context.get("version")
+                if torn_v is None or torn_v != seg.version or torn_v <= 0:
+                    # torn line below the tip: the log itself is
+                    # damaged, no earlier version is trustworthy
+                    raise
+                _TORN_FALLBACKS.inc()
+                _log.warning(
+                    "commit %d of %s has a torn trailing line "
+                    "(interrupted write); serving version %d",
+                    torn_v, self._table.path, torn_v - 1)
+                seg = build_log_segment(
+                    self._engine.fs, seg.log_path,
+                    target_version=torn_v - 1)
+            except (LogCorruptedError, pa.ArrowException,
+                    FileNotFoundError) as e:
+                if not seg.checkpoints:
+                    raise
+                if isinstance(e, FileNotFoundError) and \
+                        _COMMIT_JSON_RE.search(str(e)):
+                    # a vanished commit file is not a checkpoint
+                    # problem — excluding the checkpoint cannot bring
+                    # the commit back, so don't burn a rebuild on it
+                    raise
+                cp_v = seg.checkpoint_version
+                _CHECKPOINT_FALLBACKS.inc()
+                _log.warning(
+                    "checkpoint %d of %s unreadable (%s); rebuilding "
+                    "from an earlier checkpoint or the JSON log",
+                    cp_v, self._table.path, e)
+                seg = build_log_segment(
+                    self._engine.fs, seg.log_path,
+                    target_version=seg.version,
+                    max_checkpoint_version=cp_v - 1)
+        if seg is not self._segment:
+            self._segment = seg
+        self._validate_crc(state)
+        return state
+
+    def _validate_crc(self, state: SnapshotState) -> None:
+        """Check the replayed state against this version's `.crc` file
+        when one exists. A mismatch means the checksum chain is lying —
+        quarantine it by reseeding from the (authoritative) replayed
+        state, warn and count, and never fail the read: the .crc is an
+        accelerator, the log is the source of truth."""
+        from delta_tpu.errors import ChecksumMismatchError
+        from delta_tpu.log.checksum import (
+            read_checksum,
+            validate_state_against_checksum,
+            write_checksum_from_state,
+        )
+
+        try:
+            crc = read_checksum(self._engine.fs, self._table.log_path,
+                                state.version)
+        except Exception as e:
+            _log.debug("checksum read failed at version %d (%s)",
+                       state.version, e)
+            return
+        if crc is None:
+            return
+        try:
+            validate_state_against_checksum(state, crc)
+        except ChecksumMismatchError as e:
+            _CRC_QUARANTINED.inc()
+            _log.warning(
+                "checksum at version %d of %s disagrees with replayed "
+                "state (%s); quarantining by reseeding from state",
+                state.version, self._table.path, e)
+            try:
+                write_checksum_from_state(self._engine,
+                                          self._table.log_path, state)
+            except Exception as e2:
+                _log.debug("checksum reseed failed: %s", e2)
 
     @property
     def _small_state(self):
@@ -69,8 +169,7 @@ class Snapshot:
                 # log — reconstruct once and serve both
                 with obs.span("snapshot.load", table=self._table.path,
                               version=self.version):
-                    self._state = reconstruct_state(self._engine,
-                                                    self._segment)
+                    self._state = self._load_state()
                 return self._state
             with obs.span("snapshot.load_small", table=self._table.path,
                           version=self.version):

@@ -24,6 +24,7 @@ import urllib.parse
 import uuid
 from typing import Dict, Iterator, List, Optional
 
+from delta_tpu.resilience.classify import StorageRequestError
 from delta_tpu.storage.cloud import HttpTransport, Transport
 from delta_tpu.storage.logstore import (
     FileAlreadyExistsError,
@@ -66,20 +67,22 @@ class AdlsGen2Client:
             "PUT", self._url(name, "resource=file"), self._headers(),
             b"")
         if status not in (200, 201):
-            raise IOError(f"adls create {name}: {status} {body[:200]!r}")
+            raise StorageRequestError(
+                f"adls create {name}: {status} {body[:200]!r}", status)
         if data:
             status, _, body = self.transport(
                 "PATCH", self._url(name, "action=append&position=0"),
                 self._headers(), data)
             if status not in (200, 202):
-                raise IOError(
-                    f"adls append {name}: {status} {body[:200]!r}")
+                raise StorageRequestError(
+                    f"adls append {name}: {status} {body[:200]!r}", status)
         status, _, body = self.transport(
             "PATCH",
             self._url(name, f"action=flush&position={len(data)}"),
             self._headers(), b"")
         if status not in (200, 202):
-            raise IOError(f"adls flush {name}: {status} {body[:200]!r}")
+            raise StorageRequestError(
+                f"adls flush {name}: {status} {body[:200]!r}", status)
 
     def rename_if_absent(self, src: str, dst: str) -> bool:
         """Atomic rename failing if `dst` exists. True on success,
@@ -95,8 +98,8 @@ class AdlsGen2Client:
             return True
         if status in (409, 412):  # exists / precondition failed
             return False
-        raise IOError(f"adls rename {src}->{dst}: {status} "
-                      f"{body[:200]!r}")
+        raise StorageRequestError(
+            f"adls rename {src}->{dst}: {status} {body[:200]!r}", status)
 
     def rename_overwrite(self, src: str, dst: str) -> None:
         """Atomic rename replacing `dst` if it exists (no
@@ -108,8 +111,8 @@ class AdlsGen2Client:
         status, _, body = self.transport("PUT", self._url(dst),
                                          headers, b"")
         if status not in (200, 201):
-            raise IOError(f"adls rename {src}->{dst}: {status} "
-                          f"{body[:200]!r}")
+            raise StorageRequestError(
+                f"adls rename {src}->{dst}: {status} {body[:200]!r}", status)
 
     def get(self, name: str) -> bytes:
         status, _, body = self.transport("GET", self._url(name),
@@ -117,7 +120,7 @@ class AdlsGen2Client:
         if status == 404:
             raise FileNotFoundError(name)
         if status != 200:
-            raise IOError(f"adls get {name}: {status}")
+            raise StorageRequestError(f"adls get {name}: {status}", status)
         return body
 
     def stat(self, name: str) -> dict:
@@ -126,7 +129,7 @@ class AdlsGen2Client:
         if status == 404:
             raise FileNotFoundError(name)
         if status != 200:
-            raise IOError(f"adls head {name}: {status}")
+            raise StorageRequestError(f"adls head {name}: {status}", status)
         return {k.lower(): v for k, v in headers.items()}
 
     def list_dir(self, directory: str) -> List[dict]:
@@ -154,7 +157,8 @@ class AdlsGen2Client:
                         "page (listing changed underneath)")
                 return out
             if status != 200:
-                raise IOError(f"adls list {directory}: {status}")
+                raise StorageRequestError(f"adls list {directory}: {status}",
+                                          status)
             out.extend(json.loads(body.decode()).get("paths", []))
             nxt = {k.lower(): v for k, v in headers.items()}.get(
                 "x-ms-continuation")
@@ -166,7 +170,7 @@ class AdlsGen2Client:
         status, _, _ = self.transport("DELETE", self._url(name),
                                       self._headers(), None)
         if status not in (200, 202, 404):
-            raise IOError(f"adls delete {name}: {status}")
+            raise StorageRequestError(f"adls delete {name}: {status}", status)
 
 
 def _mtime_ms(item: dict) -> int:

@@ -43,6 +43,8 @@ from dataclasses import dataclass, replace
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from delta_tpu import obs
+from delta_tpu.resilience import default_policy
+from delta_tpu.resilience.classify import StorageRequestError
 from delta_tpu.storage.logstore import (
     DelegatingLogStore,
     FileAlreadyExistsError,
@@ -123,8 +125,8 @@ class GCSObjectClient:
             if status == 412:
                 raise PreconditionFailedError(name)
             if status >= 300:
-                raise IOError(
-                    f"GCS put {name}: HTTP {status} {body[:200]!r}")
+                raise StorageRequestError(
+                    f"GCS put {name}: HTTP {status} {body[:200]!r}", status)
 
     def get(self, name: str) -> bytes:
         url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
@@ -137,7 +139,8 @@ class GCSObjectClient:
             if status == 404:
                 raise FileNotFoundError(name)
             if status >= 300:
-                raise IOError(f"GCS get {name}: HTTP {status}")
+                raise StorageRequestError(
+                    f"GCS get {name}: HTTP {status}", status)
             sp.set_attr("bytes", len(body))
         _GCS_GET_BYTES.inc(len(body))
         return body
@@ -158,7 +161,8 @@ class GCSObjectClient:
                                                  None)
                 pages += 1
                 if status >= 300:
-                    raise IOError(f"GCS list {prefix}: HTTP {status}")
+                    raise StorageRequestError(
+                        f"GCS list {prefix}: HTTP {status}", status)
                 doc = json.loads(body)
                 items.extend(doc.get("items", []))
                 page = doc.get("nextPageToken")
@@ -175,7 +179,8 @@ class GCSObjectClient:
         if status == 404:
             raise FileNotFoundError(name)
         if status >= 300:
-            raise IOError(f"GCS stat {name}: HTTP {status}")
+            raise StorageRequestError(f"GCS stat {name}: HTTP {status}",
+                                      status)
         return json.loads(body)
 
     def delete(self, name: str) -> None:
@@ -185,7 +190,8 @@ class GCSObjectClient:
         if status == 404:
             raise FileNotFoundError(name)
         if status >= 300:
-            raise IOError(f"GCS delete {name}: HTTP {status}")
+            raise StorageRequestError(f"GCS delete {name}: HTTP {status}",
+                                      status)
 
 
 def _split_object_path(path: str) -> str:
@@ -499,24 +505,24 @@ class ExternalArbiterLogStore(DelegatingLogStore):
         lk = self._path_locks.acquire(target)
         try:
             with obs.span("storage.arbiter.fix", path=target) as sp:
-                copied = False
-                retry = 0
-                while True:
-                    try:
-                        if not copied and not self.inner.exists(target):
+                state = {"copied": False, "retries": 0}
+
+                def attempt() -> None:
+                    if not state["copied"] and not self.inner.exists(target):
+                        try:
                             self._fix_copy_temp_file(
                                 entry.absolute_temp_path(), target)
-                            copied = True
-                        self._fix_put_complete_entry(entry)
-                        sp.set_attr("retries", retry)
-                        return
-                    except FileAlreadyExistsError:
-                        copied = True  # another fixer copied; still ack
-                    except Exception:
-                        _ARBITER_FIX_RETRIES.inc()
-                        retry += 1
-                        if retry >= 3:
-                            raise
+                        except FileAlreadyExistsError:
+                            pass  # another fixer copied; still ack
+                        state["copied"] = True
+                    self._fix_put_complete_entry(entry)
+
+                def on_retry(_attempt: int, _exc: BaseException) -> None:
+                    _ARBITER_FIX_RETRIES.inc()
+                    state["retries"] += 1
+
+                default_policy().call(attempt, on_retry=on_retry)
+                sp.set_attr("retries", state["retries"])
         finally:
             lk.release()
 

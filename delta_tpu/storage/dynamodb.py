@@ -30,7 +30,6 @@ import datetime
 import hashlib
 import hmac
 import json
-import time
 import urllib.parse
 from typing import Dict, Optional
 
@@ -241,9 +240,14 @@ class DynamoDbCommitArbiter(CommitArbiter):
 
     def _ensure_table(self, timeout_s: float) -> None:
         """DescribeTable; CreateTable on ResourceNotFound; poll until
-        ACTIVE (`S3DynamoDBLogStore.java:262` tryEnsureTableExists)."""
-        deadline = time.monotonic() + timeout_s
-        while True:
+        ACTIVE (`S3DynamoDBLogStore.java:262` tryEnsureTableExists).
+
+        The poll runs under the shared `RetryPolicy` (deadline =
+        ``timeout_s``): each not-yet-ACTIVE probe raises a retryable
+        marker so the policy owns the sleeping and the give-up."""
+        from delta_tpu.resilience import default_policy
+
+        def probe() -> None:
             try:
                 desc = self.client.describe_table(self.table_name)
                 status = desc.get("Table", {}).get("TableStatus",
@@ -261,12 +265,17 @@ class DynamoDbCommitArbiter(CommitArbiter):
                     # race — fine, fall through to the status poll
                     if ce.error_type != "ResourceInUseException":
                         raise
-            if time.monotonic() >= deadline:
-                raise DynamoDbError(
-                    "TableNotActive",
-                    f"table {self.table_name} not ACTIVE after "
-                    f"{timeout_s}s", 0)
-            time.sleep(0.2)
+            err = DynamoDbError(
+                "TableNotActive",
+                f"table {self.table_name} not ACTIVE after "
+                f"{timeout_s}s", 0)
+            err.retryable = True  # poll again until the deadline
+            raise err
+
+        policy = default_policy().with_overrides(
+            max_attempts=10_000, base_s=0.2, cap_s=0.5,
+            deadline_s=timeout_s)
+        policy.call(probe)
 
     # -- entry mapping -------------------------------------------------
 

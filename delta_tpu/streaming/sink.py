@@ -39,7 +39,28 @@ class DeltaSink:
 
     def add_batch(self, batch_id: int, data: pa.Table) -> Optional[int]:
         """Commit one micro-batch; returns the commit version, or None if
-        this batch id was already committed (replay after restart)."""
+        this batch id was already committed (replay after restart).
+
+        A `ConcurrentTransactionError` means the idempotency watermark
+        for this query advanced underneath us — typically because the
+        snapshot the dedup check ran against was stale (an eventually-
+        consistent listing lagging our own previous commit). The safe
+        response is the same as a query restart: re-read fresh state
+        and re-run the watermark check, which either skips the batch
+        (already committed) or commits it against current state.
+        """
+        from delta_tpu.errors import ConcurrentTransactionError
+
+        stale_checks = 0
+        while True:
+            try:
+                return self._commit_batch(batch_id, data)
+            except ConcurrentTransactionError:
+                stale_checks += 1
+                if stale_checks > 3:
+                    raise
+
+    def _commit_batch(self, batch_id: int, data: pa.Table) -> Optional[int]:
         exists = self.table.exists()
         builder = self.table.create_transaction_builder(Operation.STREAMING_UPDATE)
         if not exists:

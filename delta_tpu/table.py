@@ -100,7 +100,12 @@ class Table:
         self._coordinated = coordinator is not None
         if coordinator is None:
             return segment
-        resp = coordinator.get_commits(self.log_path, segment.version + 1)
+        from delta_tpu.resilience import breaker_for, default_policy
+
+        resp = default_policy().call(
+            lambda: coordinator.get_commits(self.log_path,
+                                            segment.version + 1),
+            breaker=breaker_for("commit-coordinator"))
         extra = []
         next_v = segment.version + 1
         for c in sorted(resp.commits, key=lambda c: c.version):

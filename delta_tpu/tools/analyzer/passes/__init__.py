@@ -8,5 +8,6 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     locks,
     obs,
     purity,
+    retry_discipline,
     threads,
 )

@@ -49,6 +49,10 @@ _ALLOWED_NON_DELTA = {
     "TableAlreadyExistsError", "TableNotInCatalogError",
     "ParseError", "CommitFailedException",
     "DecodeUnsupported", "DynamoDbError",
+    # storage-protocol IOError subclasses: StorageRequestError carries
+    # the HTTP status the resilience classifier keys on; ChaosError is
+    # the chaos harness's injected (always-transient) fault
+    "StorageRequestError", "ChaosError",
 }
 
 # catalog entries with no statically-attributable raise site, each

@@ -600,9 +600,16 @@ class Transaction:
         import time as _time
 
         from delta_tpu.coordinatedcommits import CommitFailedException
+        from delta_tpu.resilience import breaker_for, default_policy
 
+        ts = int(_time.time() * 1000)
         try:
-            coordinator.commit(log_path, version, data, int(_time.time() * 1000))
+            # Retryable coordinator failures (network, coordinator
+            # restarts) are absorbed here; conflicts and non-retryable
+            # failures pass through to the txn machinery untouched.
+            default_policy().call(
+                lambda: coordinator.commit(log_path, version, data, ts),
+                breaker=breaker_for("commit-coordinator"))
         except CommitFailedException as e:
             if e.conflict:
                 raise FileExistsError(str(e)) from e
@@ -620,7 +627,11 @@ class Transaction:
         coordinator = self._coordinator()
         unbackfilled = {}
         if coordinator is not None:
-            resp = coordinator.get_commits(log_path, lo, hi)
+            from delta_tpu.resilience import breaker_for, default_policy
+
+            resp = default_policy().call(
+                lambda: coordinator.get_commits(log_path, lo, hi),
+                breaker=breaker_for("commit-coordinator"))
             for c in resp.commits:
                 unbackfilled[c.version] = c.file_status.path
         from delta_tpu.models.actions import actions_from_commit_bytes

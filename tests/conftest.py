@@ -31,6 +31,24 @@ def pytest_report_header(config):
     return f"jax devices: {jax.devices()}"
 
 
+@pytest.fixture(autouse=True)
+def _fast_resilience(monkeypatch):
+    """Keep retry backoff near-instant and isolate breaker state.
+
+    Production defaults sleep up to seconds between attempts; a suite
+    full of injected persistent faults would crawl. Per-endpoint
+    breakers are process-wide, so one test's fault barrage must not
+    fast-fail the next test's IO."""
+    from delta_tpu import resilience
+
+    monkeypatch.setenv("DELTA_TPU_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("DELTA_TPU_RETRY_CAP_MS", "5")
+    monkeypatch.setenv("DELTA_TPU_RETRY_DEADLINE_S", "10")
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
 @pytest.fixture
 def tmp_table_path(tmp_path):
     return str(tmp_path / "table")

@@ -677,6 +677,7 @@ def test_every_registered_rule_has_fixture_coverage():
         "undefined-name",                                    # imports
         "obs-span-leak",                                     # obs
         "threadpool-discipline",                             # threads
+        "retry-discipline",                                  # retry
     }
     assert set(all_rules()) == expected
 
@@ -831,6 +832,117 @@ def oneshot():
 """
     report = analyze_sources({"m.py": src},
                              rules=["threadpool-discipline"])
+    assert not report.findings and report.suppressed
+
+
+# ---------------------------------------------- retry-discipline rule
+
+
+def test_retry_sleep_in_exception_loop_flagged():
+    src = """
+import time
+
+def fetch(op):
+    delay = 0.1
+    while True:
+        try:
+            return op()
+        except IOError:
+            time.sleep(delay)
+            delay *= 2
+"""
+    report = analyze_sources({"m.py": src}, rules=["retry-discipline"])
+    found = _rules_fired(report, "retry-discipline")
+    assert found and "RetryPolicy" in found[0].message
+
+
+def test_retry_sleep_from_import_alias_flagged():
+    src = """
+from time import sleep as snooze
+
+def fetch(op):
+    for _ in range(1000):
+        try:
+            return op()
+        except OSError:
+            snooze(0.5)
+"""
+    report = analyze_sources({"m.py": src}, rules=["retry-discipline"])
+    assert _rules_fired(report, "retry-discipline")
+
+
+def test_retry_literal_attempt_cap_flagged():
+    src = """
+def fetch(op):
+    for attempt in range(3):
+        try:
+            return op()
+        except IOError:
+            if attempt == 2:
+                raise
+"""
+    report = analyze_sources({"m.py": src}, rules=["retry-discipline"])
+    found = _rules_fired(report, "retry-discipline")
+    assert found and "attempt cap" in found[0].message
+
+
+def test_retry_discipline_negatives_clean():
+    # sleep without exception handling (a poller), exception handling
+    # without sleep or a literal cap (a scan loop), and a data loop
+    # over range with no try — none are retry loops
+    src = """
+import time
+
+def poll(ready):
+    while not ready():
+        time.sleep(0.1)
+
+def scan(items, f):
+    out = []
+    for it in items:
+        try:
+            out.append(f(it))
+        except ValueError:
+            pass
+    return out
+
+def fill(n):
+    return [0 for _ in range(8)]
+"""
+    report = analyze_sources({"m.py": src}, rules=["retry-discipline"])
+    assert not _rules_fired(report, "retry-discipline")
+
+
+def test_retry_discipline_resilience_package_exempt():
+    src = """
+import time
+
+def call(fn):
+    while True:
+        try:
+            return fn()
+        except IOError:
+            time.sleep(0.05)
+"""
+    report = analyze_sources(
+        {"delta_tpu/resilience/policy.py": src},
+        rules=["retry-discipline"])
+    assert not _rules_fired(report, "retry-discipline")
+
+
+def test_retry_discipline_suppression_pragma():
+    src = """
+import time
+
+def fetch(op):
+    # delta-lint: disable=retry-discipline (audited: example)
+    while True:
+        try:
+            return op()
+        except IOError:
+            time.sleep(0.1)
+"""
+    report = analyze_sources({"m.py": src}, rules=["retry-discipline"])
     assert not report.findings and report.suppressed
 
 

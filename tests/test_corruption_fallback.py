@@ -1,0 +1,232 @@
+"""Graceful degradation under log corruption: unreadable checkpoints
+fall back to an earlier checkpoint or pure JSON replay, a torn trailing
+commit serves the last intact version, and a lying `.crc` checksum is
+quarantined by reseeding — all without failing the read."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from delta_tpu import obs
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.errors import LogCorruptedError, TornCommitError
+from delta_tpu.models.actions import AddFile
+from delta_tpu.models.schema import INTEGER, StructField, StructType
+from delta_tpu.replay.columnar import clear_parse_cache
+from delta_tpu.table import Table
+
+
+@pytest.fixture(autouse=True)
+def _fresh_parse_cache():
+    clear_parse_cache()
+    yield
+    clear_parse_cache()
+
+
+def _make_table(path) -> Table:
+    t = Table.for_path(str(path), HostEngine())
+    t.create_transaction_builder().with_schema(
+        StructType([StructField("x", INTEGER)])).build().commit()
+    return t
+
+
+def _commit(t: Table, i: int):
+    txn = t.start_transaction()
+    txn.add_file(AddFile(
+        path=f"p{i}.parquet", partitionValues={}, size=100 + i,
+        modificationTime=1000 + i, dataChange=True,
+        stats=json.dumps({"numRecords": i})))
+    txn.commit()
+
+
+def _cold(path) -> Table:
+    clear_parse_cache()
+    return Table.for_path(str(path), HostEngine())
+
+
+def _log_file(path, pattern):
+    files = sorted(glob.glob(os.path.join(str(path), "_delta_log",
+                                          pattern)))
+    assert files, f"no {pattern} under {path}"
+    return files
+
+
+def _expected_paths(n):
+    return sorted(f"p{i}.parquet" for i in range(n))
+
+
+def _live_paths(snap):
+    st = snap.state
+    import numpy as np
+
+    mask = np.asarray(st.live_mask)
+    return sorted(p for p, m in zip(
+        st.file_actions.column("path").to_pylist(), mask.tolist()) if m)
+
+
+# ----------------------------------------------------- checkpoint parts
+
+
+def test_truncated_checkpoint_falls_back_to_json(tmp_path):
+    t = _make_table(tmp_path)
+    for i in range(4):
+        _commit(t, i)
+    t.checkpoint()
+    _commit(t, 4)
+
+    cp = _log_file(tmp_path, "*.checkpoint.parquet")[0]
+    with open(cp, "rb") as f:
+        data = f.read()
+    with open(cp, "wb") as f:
+        f.write(data[: len(data) // 2])
+
+    c0 = obs.counter("snapshot.checkpoint_fallbacks").value
+    snap = _cold(tmp_path).latest_snapshot()
+    assert snap.version == 5
+    assert _live_paths(snap) == _expected_paths(5)
+    assert obs.counter("snapshot.checkpoint_fallbacks").value == c0 + 1
+
+
+def test_garbled_checkpoint_falls_back_to_previous_checkpoint(tmp_path):
+    t = _make_table(tmp_path)
+    for i in range(2):
+        _commit(t, i)
+    t.checkpoint()  # v2 — the good one
+    for i in range(2, 4):
+        _commit(t, i)
+    t.checkpoint()  # v4 — will be garbled
+    _commit(t, 4)
+
+    cps = _log_file(tmp_path, "*.checkpoint.parquet")
+    assert len(cps) == 2
+    with open(cps[-1], "wb") as f:
+        f.write(b"\x89not-a-parquet-file" * 64)
+
+    c0 = obs.counter("snapshot.checkpoint_fallbacks").value
+    snap = _cold(tmp_path).latest_snapshot()
+    assert snap.version == 5
+    assert _live_paths(snap) == _expected_paths(5)
+    assert obs.counter("snapshot.checkpoint_fallbacks").value == c0 + 1
+    # the fallback segment is anchored at the surviving v2 checkpoint
+    assert snap.log_segment.checkpoint_version == 2
+
+
+def test_missing_multipart_part_falls_back(tmp_path):
+    from delta_tpu.config import settings
+    from delta_tpu.log.checkpointer import write_checkpoint
+
+    t = _make_table(tmp_path)
+    for i in range(4):
+        _commit(t, i)
+    saved = settings.checkpoint_part_size
+    settings.checkpoint_part_size = 2
+    try:
+        write_checkpoint(t.engine, t.latest_snapshot(), policy="classic")
+    finally:
+        settings.checkpoint_part_size = saved
+    _commit(t, 4)
+
+    parts = _log_file(tmp_path, "*.checkpoint.0*.parquet")
+    assert len(parts) > 1, "multipart checkpoint did not split"
+    os.remove(parts[0])
+
+    # the incomplete checkpoint is rejected at listing time: the stale
+    # `_last_checkpoint` hint is discarded and the full listing replays
+    # from the JSON commits alone
+    c0 = obs.counter("log.hint_discarded").value
+    snap = _cold(tmp_path).latest_snapshot()
+    assert snap.version == 5
+    assert _live_paths(snap) == _expected_paths(5)
+    assert snap.log_segment.checkpoint_version is None
+    assert obs.counter("log.hint_discarded").value == c0 + 1
+
+
+# ------------------------------------------------------- torn commits
+
+
+def test_torn_trailing_commit_serves_previous_version(tmp_path):
+    t = _make_table(tmp_path)
+    for i in range(3):
+        _commit(t, i)
+
+    tip = _log_file(tmp_path, "*.json")[-1]
+    assert tip.endswith("00000000000000000003.json")
+    with open(tip, "rb") as f:
+        data = f.read()
+    torn = data.rstrip(b"\n")
+    with open(tip, "wb") as f:
+        f.write(torn[: len(torn) - len(torn) // 3])
+
+    t0 = obs.counter("log.torn_commits").value
+    f0 = obs.counter("snapshot.torn_commit_fallbacks").value
+    snap = _cold(tmp_path).latest_snapshot()
+    state = snap.state
+    assert state.version == 2
+    assert snap.version == 2
+    assert _live_paths(snap) == _expected_paths(2)
+    assert obs.counter("log.torn_commits").value > t0
+    assert obs.counter("snapshot.torn_commit_fallbacks").value == f0 + 1
+
+
+def test_torn_midlog_commit_is_plain_corruption(tmp_path):
+    t = _make_table(tmp_path)
+    for i in range(3):
+        _commit(t, i)
+
+    mid = _log_file(tmp_path, "*.json")[2]
+    assert mid.endswith("00000000000000000002.json")
+    with open(mid, "rb") as f:
+        data = f.read()
+    torn = data.rstrip(b"\n")
+    with open(mid, "wb") as f:
+        f.write(torn[: len(torn) - len(torn) // 3])
+
+    with pytest.raises(LogCorruptedError) as ei:
+        _cold(tmp_path).latest_snapshot().state
+    # mid-log damage is NOT the recoverable torn-tip shape
+    assert not isinstance(ei.value, TornCommitError)
+
+
+def test_torn_commit_error_carries_version(tmp_path):
+    from delta_tpu.replay.columnar import parse_commit_batch
+
+    good = b'{"commitInfo": {"operation": "WRITE"}}\n'
+    with pytest.raises(TornCommitError) as ei:
+        parse_commit_batch([(0, good), (1, good + b'{"add": {"pa')])
+    assert ei.value.context["version"] == 1
+    assert ei.value.error_class == "DELTA_TORN_COMMIT"
+
+
+# ------------------------------------------------------------- checksum
+
+
+def test_crc_mismatch_quarantined_and_reseeded(tmp_path):
+    t = _make_table(tmp_path)
+    for i in range(3):
+        _commit(t, i)
+    t.checkpoint()  # reseeds the .crc chain at v3
+
+    crcs = _log_file(tmp_path, "*.crc")
+    crc_path = crcs[-1]
+    doc = json.loads(open(crc_path).read())
+    doc["numFiles"] = doc["numFiles"] + 7
+    doc["tableSizeBytes"] = doc["tableSizeBytes"] + 999
+    with open(crc_path, "w") as f:
+        f.write(json.dumps(doc))
+
+    q0 = obs.counter("snapshot.crc_quarantined").value
+    snap = _cold(tmp_path).latest_snapshot()
+    assert _live_paths(snap) == _expected_paths(3)  # read never fails
+    assert obs.counter("snapshot.crc_quarantined").value == q0 + 1
+
+    # the lying checksum was reseeded from the replayed state
+    reseeded = json.loads(open(crc_path).read())
+    assert reseeded["numFiles"] == snap.state.num_files
+    assert reseeded["tableSizeBytes"] == snap.state.size_in_bytes
+
+    # a second cold read sees a healthy chain — no further quarantine
+    snap2 = _cold(tmp_path).latest_snapshot()
+    assert _live_paths(snap2) == _expected_paths(3)
+    assert obs.counter("snapshot.crc_quarantined").value == q0 + 1

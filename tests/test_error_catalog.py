@@ -78,6 +78,10 @@ _ALLOWED_NON_DELTA = {
     # maps the arbitration-relevant case (ConditionalCheckFailed) to
     # FileAlreadyExistsError like the other store clients
     "DynamoDbError",
+    # storage-protocol IOError subclasses: StorageRequestError carries
+    # the HTTP status the resilience classifier keys on; ChaosError is
+    # the chaos harness's injected (always-transient) fault
+    "StorageRequestError", "ChaosError",
 }
 
 

@@ -37,20 +37,36 @@ def _data(n=5, start=0):
 TBL = "memory://fault/tbl"
 
 
-def test_commit_write_failure_then_retry():
-    """A transient storage failure on the commit file write surfaces to
-    the caller; the table is unchanged and the retried write lands."""
+def test_commit_write_transient_failure_retried_transparently():
+    """A one-shot transient storage failure on the commit file write is
+    absorbed by the shared retry policy: the commit lands without the
+    caller ever seeing the fault."""
     eng, store = _engine_with_faults()
     dta.write_table(TBL + "0", _data(), engine=eng)
 
     store.fail_writes(lambda p: p.endswith("1.json"), once=True)
-    with pytest.raises(Exception):
-        dta.write_table(TBL + "0", _data(), mode="append", engine=eng)
-    snap = Table.for_path(TBL + "0", eng).latest_snapshot()
-    assert snap.version == 0 and snap.num_files == 1  # unchanged
-
     dta.write_table(TBL + "0", _data(), mode="append", engine=eng)
     snap = Table.for_path(TBL + "0", eng).latest_snapshot()
+    assert snap.version == 1 and snap.num_files == 2
+    # the store saw the failed attempt and the retried one
+    assert sum(1 for p in store.write_log if p.endswith("1.json")) == 2
+
+
+def test_commit_write_persistent_failure_surfaces():
+    """A persistent storage failure exhausts the retry budget and
+    surfaces; the table is unchanged and a later write lands."""
+    eng, store = _engine_with_faults()
+    dta.write_table(TBL + "0p", _data(), engine=eng)
+
+    store.fail_writes(lambda p: p.endswith("1.json"), once=False)
+    with pytest.raises(Exception):
+        dta.write_table(TBL + "0p", _data(), mode="append", engine=eng)
+    snap = Table.for_path(TBL + "0p", eng).latest_snapshot()
+    assert snap.version == 0 and snap.num_files == 1  # unchanged
+
+    store._write_faults.clear()
+    dta.write_table(TBL + "0p", _data(), mode="append", engine=eng)
+    snap = Table.for_path(TBL + "0p", eng).latest_snapshot()
     assert snap.version == 1 and snap.num_files == 2
 
 

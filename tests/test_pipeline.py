@@ -263,6 +263,58 @@ def test_read_fault_mid_window_propagates_and_drains(tmp_path, monkeypatch):
     _assert_no_pipeline_threads()
 
 
+def test_transient_read_fault_absorbed_by_retry(tmp_path, monkeypatch):
+    """A one-shot transient window-read failure is retried inside the
+    reader stage: the load succeeds and the consumer never sees it."""
+    path = write_log(str(tmp_path), _commits(25))
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "on")
+    eng = HostEngine()
+    real_read = eng.fs.read_file
+    boom = {"n": 0}
+
+    def flaky_once(p):
+        if p.endswith("00000000000000000014.json") and boom["n"] == 0:
+            boom["n"] += 1
+            raise ConnectionError("injected transient read failure")
+        return real_read(p)
+
+    monkeypatch.setattr(eng.fs, "os_path", lambda p: None)
+    monkeypatch.setattr(eng.fs, "read_file", flaky_once)
+    clear_parse_cache()
+    snap = Table.for_path(path, eng).latest_snapshot()
+    assert snap.state.num_files > 0
+    assert boom["n"] == 1  # the fault fired and was absorbed
+    _assert_no_pipeline_threads()
+
+
+def test_permanent_read_fault_fails_fast(tmp_path, monkeypatch):
+    """Permanent errors (here: a vanished commit file) must not burn
+    the retry budget — one attempt, straight to the consumer."""
+    path = write_log(str(tmp_path), _commits(25))
+    monkeypatch.setenv("DELTA_TPU_PIPELINE", "on")
+    eng = HostEngine()
+    attempts = {"n": 0}
+    real_read = eng.fs.read_file
+
+    def gone(p):
+        if p.endswith("00000000000000000014.json"):
+            attempts["n"] += 1
+            raise FileNotFoundError(p)
+        return real_read(p)
+
+    monkeypatch.setattr(eng.fs, "os_path", lambda p: None)
+    monkeypatch.setattr(eng.fs, "read_file", gone)
+    clear_parse_cache()
+    with pytest.raises(FileNotFoundError):
+        Table.for_path(path, eng).latest_snapshot().state.file_actions
+    # two independent load passes run here — latest_snapshot()'s
+    # (swallowed) metadata probe and the .state replay — and each must
+    # try the vanished file exactly ONCE: with the policy wrongly
+    # retrying permanents this climbs to 2 x max_attempts
+    assert attempts["n"] == 2
+    _assert_no_pipeline_threads()
+
+
 def test_parse_fault_mid_window_propagates(tmp_path, monkeypatch):
     path = write_log(str(tmp_path), _commits(25))
     # corrupt one mid-log commit: not JSON at all

@@ -1,0 +1,510 @@
+"""delta-resilience unit coverage: the transient/permanent classifier,
+RetryPolicy backoff/deadline semantics, the per-endpoint circuit
+breaker, the seeded ChaosStore, and the chaos soak (a full workload
+under sustained seeded faults must converge to the exact state of a
+fault-free run)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu import obs
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.errors import (
+    CircuitOpenError,
+    CommitFailedError,
+    LogCorruptedError,
+    TableNotFoundError,
+)
+from delta_tpu.resilience import (
+    ChaosSchedule,
+    ChaosStore,
+    CircuitBreaker,
+    RetryPolicy,
+    StorageRequestError,
+    breaker_for,
+    endpoint_of,
+    io_call,
+    is_transient,
+)
+from delta_tpu.resilience.chaos import ChaosError
+from delta_tpu.storage.logstore import InMemoryLogStore
+from delta_tpu.table import Table
+
+# ----------------------------------------------------------- classifier
+
+
+@pytest.mark.parametrize("exc,expected", [
+    (ConnectionError("reset"), True),
+    (TimeoutError("slow"), True),
+    (OSError("generic io"), True),
+    (ChaosError("injected"), True),
+    (StorageRequestError("503", status=503), True),
+    (StorageRequestError("429", status=429), True),
+    (StorageRequestError("connection dropped"), True),  # status=0
+    (StorageRequestError("403 forbidden", status=403), False),
+    (StorageRequestError("404", status=404), False),
+    (FileNotFoundError("gone"), False),
+    (FileExistsError("taken"), False),
+    (PermissionError("denied"), False),
+    (IsADirectoryError("dir"), False),
+    (ValueError("bad arg"), False),
+    (LogCorruptedError("torn"), False),
+    (TableNotFoundError("none"), False),
+])
+def test_classifier(exc, expected):
+    assert is_transient(exc) is expected
+
+
+def test_classifier_retryable_attribute_wins():
+    assert is_transient(CommitFailedError("busy", retryable=True))
+    assert not is_transient(CommitFailedError("conflict", retryable=False))
+    # an explicit attribute overrides even a normally-permanent type
+    e = ValueError("throttled")
+    e.retryable = True
+    assert is_transient(e)
+
+
+def test_classifier_dynamodb_error_types():
+    from delta_tpu.storage.dynamodb import DynamoDbError
+
+    assert is_transient(
+        DynamoDbError("ProvisionedThroughputExceededException", "slow", 400))
+    assert is_transient(DynamoDbError("InternalServerError", "oops", 500))
+    assert not is_transient(
+        DynamoDbError("ConditionalCheckFailedException", "lost race", 400))
+
+
+def test_endpoint_of():
+    assert endpoint_of("gs://bucket/t/_delta_log/0.json") == "gs"
+    assert endpoint_of("memory://x/y") == "memory"
+    assert endpoint_of("/local/path") == "file"
+
+
+# ---------------------------------------------------------- RetryPolicy
+
+
+def _fake_env(sleeps):
+    """Deterministic (sleep, clock) pair: the clock advances only when
+    the policy sleeps."""
+    now = [0.0]
+
+    def sleep(s):
+        sleeps.append(s)
+        now[0] += s
+
+    return sleep, lambda: now[0]
+
+
+def test_retry_transient_until_success():
+    sleeps = []
+    sleep, clock = _fake_env(sleeps)
+    p = RetryPolicy(max_attempts=5, base_s=0.01, cap_s=1.0,
+                    deadline_s=60, sleep=sleep, clock=clock)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2
+    assert all(0.0 <= s <= 1.0 for s in sleeps)
+
+
+def test_retry_permanent_raises_immediately():
+    p = RetryPolicy(max_attempts=5, base_s=0, deadline_s=60)
+    calls = {"n": 0}
+
+    def denied():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        p.call(denied)
+    assert calls["n"] == 1
+
+
+def test_retry_attempt_cap_exhausts():
+    sleeps = []
+    sleep, clock = _fake_env(sleeps)
+    p = RetryPolicy(max_attempts=3, base_s=0.01, cap_s=0.1,
+                    deadline_s=60, sleep=sleep, clock=clock)
+    calls = {"n": 0}
+    x0 = obs.counter("storage.retry.exhausted").value
+
+    def always():
+        calls["n"] += 1
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        p.call(always)
+    assert calls["n"] == 3
+    assert obs.counter("storage.retry.exhausted").value == x0 + 1
+
+
+def test_retry_wall_clock_deadline():
+    sleeps = []
+    sleep, clock = _fake_env(sleeps)
+    p = RetryPolicy(max_attempts=10_000, base_s=0.5, cap_s=0.5,
+                    deadline_s=2.0, sleep=sleep, clock=clock)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        p.call(always)
+    # 0.5s sleeps against a 2s budget: ~5 attempts, nowhere near 10_000
+    assert calls["n"] <= 6
+    assert sum(sleeps) <= 2.0 + 0.5
+
+
+def test_retry_on_retry_callback_and_counters():
+    sleeps = []
+    sleep, clock = _fake_env(sleeps)
+    p = RetryPolicy(max_attempts=4, base_s=0.01, cap_s=0.1,
+                    deadline_s=60, sleep=sleep, clock=clock)
+    seen = []
+    a0 = obs.counter("storage.retry.attempts").value
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("x")
+        return 42
+
+    assert p.call(flaky, on_retry=lambda a, e: seen.append(
+        (a, type(e).__name__))) == 42
+    assert seen == [(1, "ConnectionError"), (2, "ConnectionError")]
+    assert obs.counter("storage.retry.attempts").value == a0 + 2
+
+
+def test_retry_env_knobs(monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("DELTA_TPU_RETRY_BASE_MS", "7")
+    monkeypatch.setenv("DELTA_TPU_RETRY_CAP_MS", "70")
+    monkeypatch.setenv("DELTA_TPU_RETRY_DEADLINE_S", "3")
+    p = RetryPolicy.from_env()
+    assert p.max_attempts == 2
+    assert p.base_s == pytest.approx(0.007)
+    assert p.cap_s == pytest.approx(0.070)
+    assert p.deadline_s == 3.0
+
+
+# ------------------------------------------------------ circuit breaker
+
+
+def _breaker(threshold=3, reset_s=10.0):
+    now = [0.0]
+    b = CircuitBreaker("ep", threshold=threshold, reset_s=reset_s,
+                       clock=lambda: now[0])
+    return b, now
+
+
+def test_breaker_opens_after_threshold_and_fast_fails():
+    b, _now = _breaker(threshold=3)
+    for _ in range(3):
+        b.before_call()
+        b.on_failure()
+    assert b.state == "open"
+    with pytest.raises(CircuitOpenError) as ei:
+        b.before_call()
+    assert ei.value.error_class == "DELTA_CIRCUIT_BREAKER_OPEN"
+
+
+def test_breaker_half_open_probe_success_closes():
+    b, now = _breaker(threshold=2, reset_s=5.0)
+    for _ in range(2):
+        b.before_call()
+        b.on_failure()
+    assert b.state == "open"
+    now[0] = 6.0
+    b.before_call()  # the probe
+    assert b.state == "half_open"
+    b.on_success()
+    assert b.state == "closed"
+    b.before_call()  # closed again: no gate
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b, now = _breaker(threshold=2, reset_s=5.0)
+    for _ in range(2):
+        b.before_call()
+        b.on_failure()
+    now[0] = 6.0
+    b.before_call()
+    b.on_failure()
+    assert b.state == "open"
+    with pytest.raises(CircuitOpenError):
+        b.before_call()  # the clock restarted at the failed probe
+    now[0] = 12.0
+    b.before_call()
+    b.on_success()
+    assert b.state == "closed"
+
+
+def test_breaker_success_resets_failure_streak():
+    b, _now = _breaker(threshold=3)
+    b.on_failure()
+    b.on_failure()
+    b.on_success()
+    b.on_failure()
+    b.on_failure()
+    assert b.state == "closed"  # never 3 consecutive
+
+
+def test_breaker_policy_integration_only_transients_count():
+    """Permanent errors pass through the policy without touching the
+    breaker; sustained transients trip it and later callers fast-fail."""
+    b = CircuitBreaker("ep2", threshold=3, reset_s=60.0)
+    p = RetryPolicy(max_attempts=2, base_s=0, cap_s=0, deadline_s=60,
+                    sleep=lambda s: None)
+    for _ in range(5):
+        with pytest.raises(FileNotFoundError):
+            p.call(lambda: (_ for _ in ()).throw(
+                FileNotFoundError("x")), breaker=b)
+    assert b.state == "closed"
+
+    def down():
+        raise ConnectionError("down")
+
+    with pytest.raises((ConnectionError, CircuitOpenError)):
+        p.call(down, breaker=b)
+    with pytest.raises(CircuitOpenError):
+        p.call(down, breaker=b)
+    calls = {"n": 0}
+
+    def counted():
+        calls["n"] += 1
+
+    with pytest.raises(CircuitOpenError):
+        p.call(counted, breaker=b)
+    assert calls["n"] == 0  # fast fail: fn never invoked
+
+
+def test_breaker_for_registry_and_env(monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_BREAKER_THRESHOLD", "2")
+    from delta_tpu import resilience
+    resilience.reset()
+    b = breaker_for("gs")
+    assert b is breaker_for("gs")
+    assert b is not breaker_for("abfss")
+    assert b.threshold == 2
+
+
+def test_io_call_funnel():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("blip")
+        return "data"
+
+    assert io_call("memory", flaky) == "data"
+    assert calls["n"] == 2
+
+
+# ------------------------------------------------------------ ChaosStore
+
+
+def _chaos_store(seed=7, **rates):
+    inner = InMemoryLogStore()
+    return ChaosStore(inner, ChaosSchedule(seed, **rates),
+                      sleep=lambda s: None), inner
+
+
+def test_chaos_is_deterministic_per_seed():
+    logs = []
+    for _ in range(2):
+        store, _inner = _chaos_store(seed=11, error_rate=0.3)
+        for i in range(50):
+            try:
+                store.write(f"t/_delta_log/{i:020d}.json", b"{}\n")
+            except ChaosError:
+                pass
+        logs.append(list(store.fault_log))
+    assert logs[0] == logs[1] and logs[0]
+
+
+def test_chaos_error_precedes_the_operation():
+    """Injected transient errors fire BEFORE the inner op, so a retried
+    put-if-absent can never see its own first attempt."""
+    store, inner = _chaos_store(seed=3, error_rate=0.5)
+    path = "t/_delta_log/00000000000000000000.json"
+    for _ in range(20):
+        try:
+            store.write(path, b"{}\n")
+            break
+        except ChaosError:
+            assert not inner.exists(path)  # nothing leaked
+    assert inner.exists(path)
+
+
+def test_chaos_torn_write_leaves_prefix():
+    store, inner = _chaos_store(seed=5, torn_write_rate=1.0)
+    path = "t/_delta_log/00000000000000000004.checkpoint.parquet"
+    payload = b"P" * 100
+    with pytest.raises(ChaosError):
+        store.write(path, payload, overwrite=True)
+    assert inner.read(path) == payload[:50]
+    # commit json files are atomic-by-contract: never torn by default
+    store.write("t/_delta_log/00000000000000000000.json", b"{}\n")
+    assert inner.read(
+        "t/_delta_log/00000000000000000000.json") == b"{}\n"
+
+
+def test_chaos_stale_listing_drops_tail():
+    store, _inner = _chaos_store(seed=9, error_rate=0.0,
+                                 stale_list_rate=1.0)
+    for i in range(6):
+        store.write(f"t/_delta_log/{i:020d}.json", b"{}\n")
+    listed = [s.path for s in store.list_from("t/_delta_log/")]
+    full = [f"t/_delta_log/{i:020d}.json" for i in range(6)]
+    assert listed == full[: len(listed)]  # prefix-consistent
+    assert len(listed) < 6
+
+
+def test_chaos_disabled_is_transparent():
+    store, _inner = _chaos_store(seed=1, error_rate=1.0,
+                                 torn_write_rate=1.0)
+    store.enabled = False
+    store.write("t/_delta_log/00000000000000000000.json", b"{}\n")
+    assert store.read(
+        "t/_delta_log/00000000000000000000.json") == b"{}\n"
+    assert not store.fault_log
+
+
+def test_chaos_path_filter_spares_data_io():
+    store, _inner = _chaos_store(seed=2, error_rate=1.0)
+    store.write("t/part-0001.parquet", b"DATA")  # not _delta_log
+    assert store.read("t/part-0001.parquet") == b"DATA"
+
+
+# ------------------------------------------------------------ chaos soak
+
+
+def _batch(start, n):
+    return pa.table({"x": pa.array(
+        np.arange(start, start + n, dtype=np.int64))})
+
+
+def _chaos_engine(seed, **rates):
+    store = ChaosStore(InMemoryLogStore(), ChaosSchedule(seed, **rates),
+                       sleep=lambda s: None)
+
+    def resolver(path):
+        return store
+
+    return HostEngine(store_resolver=resolver), store
+
+
+def _workload(eng, path):
+    """Write/commit/checkpoint/stream/optimize, end to end."""
+    from delta_tpu.streaming import DeltaSink, DeltaSource
+
+    dta.write_table(path, _batch(0, 10), engine=eng)
+    sink = DeltaSink(path, query_id="chaos-q", engine=eng)
+    for b in range(1, 5):
+        sink.add_batch(b, _batch(b * 10, 10))
+    t = Table.for_path(path, eng)
+    t.checkpoint()
+    for b in range(5, 8):
+        sink.add_batch(b, _batch(b * 10, 10))
+    t.optimize().execute_compaction()
+    t.checkpoint()
+    streamed = 0
+    src = DeltaSource(Table.for_path(path, eng))
+    for _off, batch in src.micro_batches():
+        streamed += batch.num_rows
+    return streamed
+
+
+def _digest(eng, path):
+    """Logical table digest: version + sorted row contents. Stable
+    under ANY fault schedule — faults may change which physical files
+    hold the rows (a stale listing can make OPTIMIZE plan against an
+    older, still-correct snapshot), never the rows themselves."""
+    snap = Table.for_path(path, eng).latest_snapshot()
+    rows = sorted(dta.read_table(path, engine=eng).column("x").to_pylist())
+    return (snap.version, rows)
+
+
+def _physical_digest(eng, path):
+    """Strict digest including physical layout (file count / bytes).
+    Holds only for schedules without stale listings: errors, latency,
+    and torn writes perturb timing but never what a transaction plans,
+    so the replayed log is byte-identical to the fault-free one."""
+    snap = Table.for_path(path, eng).latest_snapshot()
+    rows = sorted(dta.read_table(path, engine=eng).column("x").to_pylist())
+    return (snap.version, snap.num_files, snap.size_in_bytes, rows)
+
+
+def _run_soak(seed, stale_list_rate=0.05):
+    """One seeded chaos run; returns (engine, path, store). Torn writes
+    hit checkpoint artifacts/.crc/_last_checkpoint — commit .json files
+    are atomic-by-contract on every store (O_EXCL / preconditions), so
+    commits see transient errors and stale listings instead."""
+    eng, store = _chaos_engine(
+        seed, error_rate=0.05, latency_rate=0.02,
+        torn_write_rate=0.25, stale_list_rate=stale_list_rate)
+    path = f"memory://chaos-{seed}/tbl"
+    streamed = _workload(eng, path)
+    assert streamed >= 80  # every batch reached the stream reader
+    # final verification reads over the SAME store, chaos silenced
+    store.enabled = False
+    return eng, path, store
+
+
+def _clean_run(tag):
+    clean_eng, _ = _chaos_engine(0, error_rate=0.0)
+    clean_path = f"memory://{tag}/tbl"
+    _workload(clean_eng, clean_path)
+    return clean_eng, clean_path
+
+
+def test_chaos_soak_converges_to_fault_free_digest():
+    """The acceptance property: a seeded chaos run over the full
+    workload converges to the same table as a fault-free run."""
+    clean_eng, clean_path = _clean_run("fault-free")
+    eng, path, store = _run_soak(seed=1234)
+    assert store.fault_counts.get("error", 0) > 0, \
+        "the schedule must actually have injected faults"
+    assert _digest(eng, path) == _digest(clean_eng, clean_path)
+
+
+def test_chaos_soak_layout_identical_without_stale_listings():
+    """With only transient errors, latency, and torn writes (no stale
+    listings) the run is byte-identical to fault-free, physical layout
+    included — those faults are absorbed before any planning decision."""
+    clean_eng, clean_path = _clean_run("fault-free-strict")
+    eng, path, store = _run_soak(seed=77, stale_list_rate=0.0)
+    assert store.fault_counts.get("error", 0) > 0
+    assert (_physical_digest(eng, path)
+            == _physical_digest(clean_eng, clean_path))
+
+
+@pytest.mark.slow
+def test_chaos_soak_many_seeds():
+    """Soak: many seeded schedules, each converging exactly."""
+    clean_eng, clean_path = _clean_run("fault-free-soak")
+    clean = _digest(clean_eng, clean_path)
+    clean_strict = _physical_digest(clean_eng, clean_path)
+
+    for seed in range(20):
+        eng, path, _store = _run_soak(seed=seed)
+        assert _digest(eng, path) == clean, \
+            f"divergence under chaos seed {seed}"
+
+    for seed in range(10):
+        eng, path, _store = _run_soak(seed=seed + 100,
+                                      stale_list_rate=0.0)
+        assert _physical_digest(eng, path) == clean_strict, \
+            f"layout divergence under stale-free chaos seed {seed + 100}"
