@@ -56,10 +56,11 @@ class HostJsonHandler(JsonHandler):
             yield p, io_call(endpoint_of(p), lambda: store.read(p))
 
     def write_json_file_atomically(self, path: str, data: bytes, overwrite: bool = False) -> None:
-        # Retrying a put-if-absent write is safe: transient failures
-        # surface before/without the object landing, and a retry that
-        # finds the object present raises FileAlreadyExistsError —
-        # permanent, so it flows straight to the conflict machinery.
+        # Retrying a put-if-absent write is safe even when the outcome
+        # is ambiguous (the PUT landed but its response was lost): the
+        # retry raises FileAlreadyExistsError — permanent, so it flows
+        # to the conflict machinery, where CommitInfo.txnId self-commit
+        # detection distinguishes our own landed write from a real loss.
         store = self._store_for(path)
         with obs.span("storage.commit_write", path=path, bytes=len(data),
                       overwrite=overwrite):

@@ -11,10 +11,16 @@ to a full listing, never to an error.
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
+from delta_tpu import obs
 from delta_tpu.utils import filenames
+
+_log = logging.getLogger(__name__)
+
+_HINT_WRITE_FAILURES = obs.counter("log.hint_write_failures")
 
 
 @dataclass
@@ -61,7 +67,21 @@ def read_last_checkpoint(fs, log_path: str) -> Optional[LastCheckpointInfo]:
 
 
 def write_last_checkpoint(json_handler, log_path: str, info: LastCheckpointInfo) -> None:
+    """Best-effort write, mirroring the reference (`Checkpoints.scala`
+    logs and swallows hint-write failures): the checkpoint itself is
+    durable at this point, and a missing/stale hint only costs readers a
+    longer listing — failing the checkpoint over it would be strictly
+    worse."""
     path = filenames.last_checkpoint_file(log_path)
-    json_handler.write_json_file_atomically(
-        path, info.to_json().encode("utf-8"), overwrite=True
-    )
+    # delta-lint: disable=except-swallow (audited: the hint is an
+    # accelerator — its write failure is counted and logged, never
+    # allowed to fail the durable checkpoint that precedes it)
+    try:
+        json_handler.write_json_file_atomically(
+            path, info.to_json().encode("utf-8"), overwrite=True
+        )
+    except Exception as e:
+        _HINT_WRITE_FAILURES.inc()
+        _log.warning("_last_checkpoint hint write failed for %s (%s); "
+                     "readers will list from an older hint or version 0",
+                     log_path, e)

@@ -337,11 +337,16 @@ def _read_window(engine, index: int,
 def _reader_main(engine, windows, out_q, stop) -> None:
     from delta_tpu.resilience import default_policy
 
-    # A transient window-fetch failure (network blip mid-cold-load)
-    # retries just that window instead of killing the whole pipelined
-    # load; permanent errors (corruption, missing files) still flow to
-    # the consumer via _offer_error for a fail-fast drain + clean join.
-    policy = default_policy()
+    # Storage ops inside _read_window already retry transients through
+    # io_call (shared policy + breaker); stacking the full policy here
+    # again would multiply attempts (~max_attempts² per window) and
+    # double-count breaker failures. The outer policy only restarts a
+    # whole window ONCE, with no sleeps of its own, if the inner budget
+    # exhausts mid-window; permanent errors (corruption, missing files)
+    # still flow to the consumer via _offer_error for a fail-fast drain
+    # + clean join.
+    policy = default_policy().with_overrides(max_attempts=2, base_s=0.0,
+                                             cap_s=0.0)
     try:
         for i, win in enumerate(windows):
             item = policy.call(lambda: _read_window(engine, i, win))

@@ -74,10 +74,16 @@ def reset() -> None:
 
 
 def endpoint_of(path: str) -> str:
-    """Endpoint key for breaker bucketing: the URL scheme, or
-    ``file`` for plain paths."""
+    """Endpoint key for breaker bucketing: scheme plus authority
+    (``gs://bucket``), or ``file`` for plain paths. The authority
+    matters: breaker state must be isolated per bucket/account — one
+    dead bucket opening a scheme-wide breaker would fast-fail traffic
+    to every healthy bucket on that scheme."""
     i = path.find("://")
-    return path[:i] if i > 0 else "file"
+    if i <= 0:
+        return "file"
+    j = path.find("/", i + 3)
+    return path if j < 0 else path[:j]
 
 
 def io_call(endpoint: str, fn: Callable[[], T]) -> T:

@@ -10,8 +10,12 @@ After ``reset_s`` seconds one *probe* request is let through
 restarts the clock.
 
 Only transient failures count — a `FileNotFoundError` is an answer,
-not an outage (see `delta_tpu/resilience/classify.py`), and the
-`RetryPolicy` only reports transient outcomes here.
+not an outage (see `delta_tpu/resilience/classify.py`). The
+`RetryPolicy` reports permanent errors as *success*: the endpoint is
+reachable and healthy, and a half-open probe that came back 404 must
+close the circuit (leaving it probing would brick the endpoint). As a
+backstop, a probe whose caller never reports an outcome is reclaimed
+after ``reset_s``.
 
 Telemetry: every state transition increments
 ``storage.breaker.state`` and emits a span event carrying the
@@ -61,6 +65,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
+        self._probe_started = 0.0
 
     @property
     def state(self) -> str:
@@ -85,13 +90,19 @@ class CircuitBreaker:
                         f"consecutive failures",
                         endpoint=self.name)
             if self._state == HALF_OPEN:
-                if self._probing:
+                if self._probing and \
+                        self._clock() - self._probe_started < self.reset_s:
                     _FAST_FAILS.inc()
                     raise CircuitOpenError(
                         f"circuit breaker half-open for endpoint "
                         f"'{self.name}'; probe in flight",
                         endpoint=self.name)
+                # no probe in flight — or the previous one went stale
+                # (its caller died without reporting an outcome after a
+                # full reset_s): reclaim it rather than wedging the
+                # endpoint until process restart.
                 self._probing = True
+                self._probe_started = self._clock()
                 _PROBES.inc()
 
     def on_success(self) -> None:
@@ -128,7 +139,9 @@ _breakers_lock = threading.Lock()
 
 
 def breaker_for(endpoint: str) -> CircuitBreaker:
-    """The process-wide breaker for an endpoint key (URL scheme)."""
+    """The process-wide breaker for an endpoint key
+    (``scheme://authority`` from :func:`endpoint_of`, or a logical name
+    like ``commit-coordinator``)."""
     b = _breakers.get(endpoint)
     if b is not None:
         return b

@@ -12,9 +12,14 @@ Fault model (each drawn independently per operation from one seeded
 RNG, so a given seed yields one schedule):
 
 - **transient errors** (`error_rate`): the operation raises
-  :class:`ChaosError` *before* touching the inner store. Raising
-  pre-write keeps put-if-absent exactly-once: a retry can never turn
-  one logical commit into a false `FileAlreadyExistsError`.
+  :class:`ChaosError` *before* touching the inner store, so the fault
+  is unambiguous — the op did not happen and a retry is always safe.
+- **lost write acks** (`ack_loss_rate`): the *ambiguous* counterpart
+  for commit ``N.json`` writes — the inner write lands, then
+  :class:`ChaosError` raises as if the response was lost. The
+  put-if-absent retry observes its own commit as `FileExistsError`,
+  which the transaction's `CommitInfo.txnId` self-commit detection
+  must recover without rebasing (no duplicate data).
 - **latency spikes** (`latency_rate`): the operation sleeps a seeded
   duration first.
 - **torn writes** (`torn_write_rate`): for paths matching
@@ -52,6 +57,7 @@ from delta_tpu.storage.logstore import (
 _CHAOS_FAULTS = obs.counter("chaos.faults")
 _CHAOS_TORN = obs.counter("chaos.torn_writes")
 _CHAOS_STALE = obs.counter("chaos.stale_listings")
+_CHAOS_ACK_LOSS = obs.counter("chaos.ack_losses")
 
 
 class ChaosError(IOError):
@@ -62,6 +68,13 @@ def _default_torn_pred(path: str) -> bool:
     name = path.rpartition("/")[2]
     return (".checkpoint" in name or name.endswith(".crc")
             or name == "_last_checkpoint")
+
+
+def _default_ack_pred(path: str) -> bool:
+    """Commit delta files (``<version>.json``): the put-if-absent path
+    where a lost ack turns into a self-conflict the txn must detect."""
+    name = path.rpartition("/")[2]
+    return name.endswith(".json") and name[:-5].isdigit()
 
 
 def _default_path_filter(path: str) -> bool:
@@ -76,13 +89,15 @@ class ChaosSchedule:
                  latency_rate: float = 0.0,
                  latency_s: tuple = (0.0002, 0.002),
                  torn_write_rate: float = 0.0,
-                 stale_list_rate: float = 0.0):
+                 stale_list_rate: float = 0.0,
+                 ack_loss_rate: float = 0.0):
         self.seed = seed
         self.error_rate = error_rate
         self.latency_rate = latency_rate
         self.latency_s = latency_s
         self.torn_write_rate = torn_write_rate
         self.stale_list_rate = stale_list_rate
+        self.ack_loss_rate = ack_loss_rate
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
@@ -118,11 +133,13 @@ class ChaosStore(DelegatingLogStore):
     def __init__(self, inner: LogStore, schedule: ChaosSchedule,
                  path_filter: Optional[Callable[[str], bool]] = None,
                  torn_pred: Optional[Callable[[str], bool]] = None,
+                 ack_pred: Optional[Callable[[str], bool]] = None,
                  sleep: Callable[[float], None] = time.sleep):
         super().__init__(inner)
         self.schedule = schedule
         self.path_filter = path_filter or _default_path_filter
         self.torn_pred = torn_pred or _default_torn_pred
+        self.ack_pred = ack_pred or _default_ack_pred
         self.enabled = True
         self.fault_log: List[tuple] = []
         self.fault_counts: Dict[str, int] = {}
@@ -164,6 +181,13 @@ class ChaosStore(DelegatingLogStore):
                 f"chaos[{s.seed}]: torn write ({len(torn)}/{len(data)} "
                 f"bytes): {path}")
         self.inner.write(path, data, overwrite)
+        if (self.enabled and s.ack_loss_rate and self.path_filter(path)
+                and self.ack_pred(path) and s.draw() < s.ack_loss_rate):
+            # ambiguous outcome: the write landed, the response did not
+            self._record("ack_loss", "write", path)
+            _CHAOS_ACK_LOSS.inc()
+            raise ChaosError(
+                f"chaos[{s.seed}]: write ack lost after landing: {path}")
 
     def list_from(self, path: str) -> Iterator[FileStatus]:
         self._perturb("list_from", path)

@@ -37,6 +37,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from delta_tpu import obs
+from delta_tpu.errors import CircuitOpenError
 from delta_tpu.resilience.classify import is_transient
 
 T = TypeVar("T")
@@ -113,8 +114,12 @@ class RetryPolicy:
         ``breaker`` (a :class:`CircuitBreaker`) is consulted before
         every attempt and told about each outcome; an open breaker
         raises `CircuitOpenError` without invoking ``fn``. Only
-        transient failures count against the breaker — a
-        `FileNotFoundError` says nothing about endpoint health.
+        transient failures count against the breaker — a permanent
+        error like `FileNotFoundError` is an *answer* from the
+        endpoint, so it reports success (crucially, that releases a
+        half-open probe: a 404 probe must close the circuit, not wedge
+        it). A `CircuitOpenError` surfacing from a nested call is
+        neither — nobody answered — and leaves the breaker untouched.
 
         ``on_retry(attempt, exc)`` fires before each backoff sleep —
         call sites use it to keep bespoke counters (e.g. the GCS
@@ -126,6 +131,8 @@ class RetryPolicy:
             result = fn()
         except BaseException as e:
             if not classify(e):
+                if breaker is not None and not isinstance(e, CircuitOpenError):
+                    breaker.on_success()
                 raise
             if breaker is not None:
                 breaker.on_failure()
@@ -171,6 +178,10 @@ class RetryPolicy:
                 result = fn()
             except BaseException as e:
                 if not classify(e):
+                    # the endpoint answered (see call()); release any probe
+                    if breaker is not None and \
+                            not isinstance(e, CircuitOpenError):
+                        breaker.on_success()
                     raise
                 if breaker is not None:
                     breaker.on_failure()

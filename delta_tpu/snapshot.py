@@ -62,12 +62,20 @@ class Snapshot:
         return self._state
 
     def _load_state(self) -> SnapshotState:
-        """Reconstruct state with the degradation ladder: a corrupt or
-        incomplete checkpoint falls back to the previous complete
-        checkpoint (or pure JSON replay), and a torn trailing commit —
-        an interrupted writer's half-line, not a real commit — falls
-        back to the last intact version. Both paths warn and count;
-        corruption that no fallback can route around still raises."""
+        state = self._replay_degrading(reconstruct_state)
+        self._validate_crc(state)
+        return state
+
+    def _replay_degrading(self, replay_fn):
+        """Run one replay function (full or small state) over the
+        segment with the degradation ladder: a corrupt or incomplete
+        checkpoint falls back to the previous complete checkpoint (or
+        pure JSON replay), and a torn trailing commit — an interrupted
+        writer's half-line, not a real commit — falls back to the last
+        intact version. Both paths warn and count; corruption that no
+        fallback can route around still raises. On fallback the
+        snapshot's segment is replaced so later accesses reuse the
+        repaired view."""
         import pyarrow as pa
 
         from delta_tpu.errors import LogCorruptedError, TornCommitError
@@ -76,7 +84,7 @@ class Snapshot:
         seg = self._segment
         while True:
             try:
-                state = reconstruct_state(self._engine, seg)
+                state = replay_fn(self._engine, seg)
                 break
             except TornCommitError as e:
                 torn_v = e.context.get("version")
@@ -114,7 +122,6 @@ class Snapshot:
                     max_checkpoint_version=cp_v - 1)
         if seg is not self._segment:
             self._segment = seg
-        self._validate_crc(state)
         return state
 
     def _validate_crc(self, state: SnapshotState) -> None:
@@ -173,8 +180,10 @@ class Snapshot:
                 return self._state
             with obs.span("snapshot.load_small", table=self._table.path,
                           version=self.version):
-                self._small = reconstruct_small_state(self._engine,
-                                                      self._segment)
+                # same degradation ladder as the full load: the small
+                # projection reads the same checkpoint parts and commit
+                # tail, so a torn artifact must fall back here too
+                self._small = self._replay_degrading(reconstruct_small_state)
         return self._small
 
     @property

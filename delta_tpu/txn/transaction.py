@@ -64,6 +64,10 @@ from delta_tpu.utils import filenames
 
 _log = logging.getLogger(__name__)
 
+# put-if-absent retries that conflicted with their OWN landed commit
+# (write applied, success response lost) and were recovered in place
+_SELF_COMMITS = obs.counter("txn.self_commit_recovered")
+
 
 class Operation:
     WRITE = "WRITE"
@@ -707,69 +711,35 @@ class Transaction:
                     asp.set_attr("conflict", True)
                     if self.observer:
                         self.observer.on_commit_conflict(self, attempt_version)
-                    # We lost the race: find the current latest, check logical
-                    # conflicts against every winner, rebase, retry.
+                    # Apparently lost the race: find the current latest and
+                    # read the winners — first checking whether the "winner"
+                    # at our version is actually us (ambiguous write
+                    # outcome), else conflict-check, rebase, retry.
                     latest = self._latest_version(engine, log_path,
                                                   attempt_version)
-                    with obs.span("txn.conflict_check",
-                                  lost_version=attempt_version,
-                                  winners=latest - attempt_version + 1):
-                        winners = self._read_commit_range(
-                            engine, log_path, attempt_version, latest
-                        )
-                        try:
-                            rebase = check_conflicts(self._read_state(),
-                                                     winners)
-                        except Exception:
-                            _report(None, False)
-                            raise
-                    if rebase.get("row_id_high_watermark") is not None:
-                        self._winners_row_watermark = max(
-                            self._winners_row_watermark or -1,
-                            rebase["row_id_high_watermark"],
-                        )
-                    ict_on = self.read_snapshot is not None and \
-                        get_table_config(
-                            self.read_snapshot.metadata.configuration,
-                            IN_COMMIT_TIMESTAMPS)
-                    for w in winners:
-                        # a winner may toggle ICT itself: its Metadata
-                        # governs whether IT and later winners must carry
-                        # an inCommitTimestamp
-                        wmeta = next(
-                            (a for a in w.actions if isinstance(a, Metadata)),
-                            None)
-                        if wmeta is not None:
-                            ict_on = get_table_config(
-                                wmeta.configuration, IN_COMMIT_TIMESTAMPS)
-                        ci = next(
-                            (a for a in w.actions if isinstance(a, CommitInfo)), None
-                        )
-                        if ci is not None and ci.inCommitTimestamp is not None:
-                            winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
-                        elif ict_on:
-                            # `CommitInfo.getRequiredInCommitTimestamp`:
-                            # on an ICT table every commit must carry its
-                            # timestamp — a winner without one corrupts
-                            # the monotonic clock this rebase maintains
-                            from delta_tpu.errors import LogCorruptedError
-
-                            _report(None, False)
-                            if ci is None:
-                                raise LogCorruptedError(
-                                    f"commit {w.version} has no commitInfo "
-                                    "but in-commit timestamps are enabled",
-                                    error_class="DELTA_MISSING_COMMIT_INFO")
-                            raise LogCorruptedError(
-                                f"commitInfo of commit {w.version} has no "
-                                "inCommitTimestamp but in-commit "
-                                "timestamps are enabled",
-                                error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
-                    # no backoff sleep today: rebase work itself spaces the
-                    # retries; the attr keeps trace shape stable if one lands
-                    asp.set_attrs(rebased_to=latest + 1, backoff_ms=0)
-                    attempt_version = latest + 1
-                    continue
+                    winners = self._read_commit_range(
+                        engine, log_path, attempt_version, latest
+                    )
+                    if self._is_own_commit(winners[0]):
+                        # Not a loss at all: an ambiguous write outcome
+                        # (the PUT landed but its response was lost) made
+                        # the retried put-if-absent observe our OWN commit
+                        # as FileExistsError. Rebasing would re-commit the
+                        # same actions at N+1 — duplicate data. The txnId
+                        # we serialize into commitInfo makes the case
+                        # detectable; fall through to the success path at
+                        # this attempt version.
+                        _SELF_COMMITS.inc()
+                        asp.set_attrs(conflict=False, self_commit=True)
+                        obs.add_event("txn.self_commit_recovered",
+                                      version=attempt_version)
+                    else:
+                        winners_ict = self._resolve_conflict(
+                            winners, attempt_version, latest, winners_ict,
+                            _report, asp)
+                        attempt_version = latest + 1
+                        continue
+                    # (self-commit) fall through to the success path
             self._committed = True
             # hand the bytes we just wrote to the snapshot cache BEFORE
             # the hooks run, so they (and the next update() poll) advance
@@ -792,6 +762,76 @@ class Transaction:
             f"commit failed after {attempts} attempts (last tried version "
             f"{attempt_version})"
         )
+
+    def _is_own_commit(self, winner) -> bool:
+        """True when the 'winning' commit at our attempt version is the
+        one THIS transaction wrote, identified by the ``txnId`` we
+        serialize into every commitInfo."""
+        ci = next(
+            (a for a in winner.actions if isinstance(a, CommitInfo)), None)
+        return ci is not None and ci.txnId == self.txn_id
+
+    def _resolve_conflict(self, winners, attempt_version: int, latest: int,
+                          winners_ict: Optional[int], report, asp
+                          ) -> Optional[int]:
+        """Genuine lost race: check logical conflicts against every
+        winner and fold their in-commit timestamps into the rebase.
+        Returns the updated ``winners_ict``; raises when the loss is
+        not retryable."""
+        with obs.span("txn.conflict_check",
+                      lost_version=attempt_version,
+                      winners=latest - attempt_version + 1):
+            try:
+                rebase = check_conflicts(self._read_state(), winners)
+            except Exception:
+                report(None, False)
+                raise
+        if rebase.get("row_id_high_watermark") is not None:
+            self._winners_row_watermark = max(
+                self._winners_row_watermark or -1,
+                rebase["row_id_high_watermark"],
+            )
+        ict_on = self.read_snapshot is not None and \
+            get_table_config(
+                self.read_snapshot.metadata.configuration,
+                IN_COMMIT_TIMESTAMPS)
+        for w in winners:
+            # a winner may toggle ICT itself: its Metadata
+            # governs whether IT and later winners must carry
+            # an inCommitTimestamp
+            wmeta = next(
+                (a for a in w.actions if isinstance(a, Metadata)),
+                None)
+            if wmeta is not None:
+                ict_on = get_table_config(
+                    wmeta.configuration, IN_COMMIT_TIMESTAMPS)
+            ci = next(
+                (a for a in w.actions if isinstance(a, CommitInfo)), None
+            )
+            if ci is not None and ci.inCommitTimestamp is not None:
+                winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
+            elif ict_on:
+                # `CommitInfo.getRequiredInCommitTimestamp`:
+                # on an ICT table every commit must carry its
+                # timestamp — a winner without one corrupts
+                # the monotonic clock this rebase maintains
+                from delta_tpu.errors import LogCorruptedError
+
+                report(None, False)
+                if ci is None:
+                    raise LogCorruptedError(
+                        f"commit {w.version} has no commitInfo "
+                        "but in-commit timestamps are enabled",
+                        error_class="DELTA_MISSING_COMMIT_INFO")
+                raise LogCorruptedError(
+                    f"commitInfo of commit {w.version} has no "
+                    "inCommitTimestamp but in-commit "
+                    "timestamps are enabled",
+                    error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
+        # no backoff sleep today: rebase work itself spaces the
+        # retries; the attr keeps trace shape stable if one lands
+        asp.set_attrs(rebased_to=latest + 1, backoff_ms=0)
+        return winners_ict
 
     def _latest_version(self, engine, log_path: str, at_least: int) -> int:
         latest = at_least

@@ -77,8 +77,12 @@ def test_classifier_dynamodb_error_types():
 
 
 def test_endpoint_of():
-    assert endpoint_of("gs://bucket/t/_delta_log/0.json") == "gs"
-    assert endpoint_of("memory://x/y") == "memory"
+    # scheme + authority: breaker state is per bucket/account, so one
+    # dead bucket cannot fast-fail every other bucket on the scheme
+    assert endpoint_of("gs://bucket/t/_delta_log/0.json") == "gs://bucket"
+    assert endpoint_of("gs://other/t/_delta_log/0.json") == "gs://other"
+    assert endpoint_of("memory://x/y") == "memory://x"
+    assert endpoint_of("memory://x") == "memory://x"
     assert endpoint_of("/local/path") == "file"
 
 
@@ -250,6 +254,49 @@ def test_breaker_half_open_probe_failure_reopens():
     assert b.state == "closed"
 
 
+def test_breaker_half_open_permanent_probe_outcome_closes():
+    """A probe answered with a permanent error (e.g. 404 on a log tail
+    probe) proves the endpoint is healthy: the policy reports success,
+    the circuit closes, and later calls flow. Regression: the probe
+    used to stay marked in-flight forever, bricking the endpoint."""
+    now = [0.0]
+    b = CircuitBreaker("ep-perm", threshold=2, reset_s=5.0,
+                       clock=lambda: now[0])
+    p = RetryPolicy(max_attempts=2, base_s=0, cap_s=0, deadline_s=60,
+                    sleep=lambda s: None)
+
+    def down():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        p.call(down, breaker=b)  # 2 attempts = threshold: opens
+    assert b.state == "open"
+    now[0] = 6.0
+    with pytest.raises(FileNotFoundError):
+        p.call(lambda: (_ for _ in ()).throw(FileNotFoundError("404")),
+               breaker=b)
+    assert b.state == "closed"
+    assert p.call(lambda: "ok", breaker=b) == "ok"
+
+
+def test_breaker_stale_probe_reclaimed_after_reset():
+    """Backstop: if a probe's caller dies without reporting an outcome,
+    the probe slot is reclaimed after reset_s instead of wedging the
+    endpoint until process restart."""
+    b, now = _breaker(threshold=2, reset_s=5.0)
+    for _ in range(2):
+        b.before_call()
+        b.on_failure()
+    now[0] = 6.0
+    b.before_call()  # probe taken, caller never reports back
+    with pytest.raises(CircuitOpenError):
+        b.before_call()  # in-flight probe still gates
+    now[0] = 12.0
+    b.before_call()  # stale probe reclaimed
+    b.on_success()
+    assert b.state == "closed"
+
+
 def test_breaker_success_resets_failure_streak():
     b, _now = _breaker(threshold=3)
     b.on_failure()
@@ -361,6 +408,23 @@ def test_chaos_torn_write_leaves_prefix():
         "t/_delta_log/00000000000000000000.json") == b"{}\n"
 
 
+def test_chaos_ack_loss_lands_then_errors():
+    """Ack-loss faults are the deliberate AMBIGUOUS mode: the inner
+    write lands first, then the error raises — only for commit .json
+    files, whose put-if-absent retry path can detect its own commit."""
+    store, inner = _chaos_store(seed=13, ack_loss_rate=1.0)
+    path = "t/_delta_log/00000000000000000000.json"
+    with pytest.raises(ChaosError):
+        store.write(path, b"{}\n")
+    assert inner.read(path) == b"{}\n"  # the write landed
+    assert store.fault_counts.get("ack_loss") == 1
+    # non-commit artifacts are spared: their retries are plain overwrites
+    store.write("t/_delta_log/00000000000000000001.checkpoint.parquet",
+                b"P", overwrite=True)
+    store.write("t/_delta_log/_last_checkpoint", b"{}", overwrite=True)
+    assert store.fault_counts.get("ack_loss") == 1
+
+
 def test_chaos_stale_listing_drops_tail():
     store, _inner = _chaos_store(seed=9, error_rate=0.0,
                                  stale_list_rate=1.0)
@@ -451,10 +515,13 @@ def _run_soak(seed, stale_list_rate=0.05):
     """One seeded chaos run; returns (engine, path, store). Torn writes
     hit checkpoint artifacts/.crc/_last_checkpoint — commit .json files
     are atomic-by-contract on every store (O_EXCL / preconditions), so
-    commits see transient errors and stale listings instead."""
+    commits see transient errors, lost acks (the write lands, the
+    response doesn't — recovered by txnId self-commit detection), and
+    stale listings instead."""
     eng, store = _chaos_engine(
         seed, error_rate=0.05, latency_rate=0.02,
-        torn_write_rate=0.25, stale_list_rate=stale_list_rate)
+        torn_write_rate=0.25, stale_list_rate=stale_list_rate,
+        ack_loss_rate=0.1)
     path = f"memory://chaos-{seed}/tbl"
     streamed = _workload(eng, path)
     assert streamed >= 80  # every batch reached the stream reader
@@ -487,6 +554,23 @@ def test_chaos_soak_layout_identical_without_stale_listings():
     clean_eng, clean_path = _clean_run("fault-free-strict")
     eng, path, store = _run_soak(seed=77, stale_list_rate=0.0)
     assert store.fault_counts.get("error", 0) > 0
+    assert (_physical_digest(eng, path)
+            == _physical_digest(clean_eng, clean_path))
+
+
+def test_ack_loss_recovered_as_self_commit():
+    """Every commit write's ack is lost after the write lands: the
+    put-if-absent retry observes FileExistsError, and CommitInfo.txnId
+    self-commit detection recovers each commit at its own version —
+    exactly once, no rebase, no duplicated rows, byte-identical log."""
+    c0 = obs.counter("txn.self_commit_recovered").value
+    clean_eng, clean_path = _clean_run("fault-free-ack")
+    eng, store = _chaos_engine(21, error_rate=0.0, ack_loss_rate=1.0)
+    path = "memory://ack-loss/tbl"
+    _workload(eng, path)
+    store.enabled = False
+    assert store.fault_counts.get("ack_loss", 0) > 0
+    assert obs.counter("txn.self_commit_recovered").value > c0
     assert (_physical_digest(eng, path)
             == _physical_digest(clean_eng, clean_path))
 
