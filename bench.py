@@ -729,6 +729,148 @@ def chaos_recovery_metric() -> None:
     }))
 
 
+def serve_metrics() -> None:
+    """Multi-tenant snapshot service under load: N clients x M tables
+    against `DeltaServeServer`, once clean and once under a seeded
+    ChaosStore (transient errors + stale listings, zero injected
+    latency so the number tracks the serve/retry machinery, not naps).
+    Gate: chaos p99 must stay within 10x the clean p99 — graceful
+    degradation (shedding, stale serving) is supposed to bound tail
+    latency under faults, and this is where a regression shows up."""
+    import threading as th
+
+    import pyarrow as pa
+
+    import delta_tpu.api as dta
+    from delta_tpu.connect import connect
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.errors import (DeadlineExceededError,
+                                  ServiceOverloadedError)
+    from delta_tpu.resilience import (ChaosSchedule, ChaosStore,
+                                      reset as resilience_reset)
+    from delta_tpu.serve import DeltaServeServer, ServeConfig
+    from delta_tpu.storage.logstore import InMemoryLogStore
+
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    n_tables = int(os.environ.get("BENCH_SERVE_TABLES", 4))
+    n_ops = int(os.environ.get("BENCH_SERVE_OPS", 40))
+    overrides = {"DELTA_TPU_RETRY_BASE_MS": "1",
+                 "DELTA_TPU_RETRY_CAP_MS": "5"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    resilience_reset()
+
+    def run(chaos: bool):
+        store = ChaosStore(
+            InMemoryLogStore(),
+            ChaosSchedule(seed=77, error_rate=0.15, stale_list_rate=0.05),
+            sleep=lambda s: None)
+        store.enabled = False
+        eng = HostEngine(store_resolver=lambda p: store)
+        tag = "chaos" if chaos else "clean"
+        paths = [f"memory://bench-serve-{tag}/t{i}"
+                 for i in range(n_tables)]
+        for p in paths:
+            dta.write_table(p, pa.table(
+                {"x": pa.array(list(range(64)), type=pa.int64())}),
+                engine=eng)
+        srv = DeltaServeServer(
+            "127.0.0.1", 0, engine=eng,
+            config=ServeConfig.from_env(workers=4, max_queue=64,
+                                        drain_grace_s=2.0))
+        srv.start_background()
+        # warmup before the clock: first requests pay lazy imports and
+        # cold snapshot loads, which would otherwise dominate p99
+        with connect(*srv.address, reconnect=False) as w:
+            for p in paths:
+                w.read_table(p)
+        store.enabled = chaos
+        lat_ms, counts = [], {"ok": 0, "stale": 0, "shed": 0,
+                              "deadline": 0}
+        lock = th.Lock()
+
+        def client(ci):
+            with connect(*srv.address, tenant=f"tenant-{ci % 4}",
+                         reconnect=False) as c:
+                for k in range(n_ops):
+                    p = paths[(ci + k) % n_tables]
+                    t1 = time.perf_counter()
+                    try:
+                        if k % 3 == 2:
+                            c.table_version(p)
+                        else:
+                            c.read_table(p)
+                        kind = ("stale" if c.last_envelope.get("stale")
+                                else "ok")
+                    except ServiceOverloadedError:
+                        kind = "shed"
+                    except DeadlineExceededError:
+                        kind = "deadline"
+                    dt = (time.perf_counter() - t1) * 1000.0
+                    with lock:
+                        lat_ms.append(dt)
+                        counts[kind] += 1
+
+        threads = [th.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        srv.shutdown(2.0)
+        lat_ms.sort()
+        p50 = lat_ms[len(lat_ms) // 2]
+        p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+        return (len(lat_ms) / wall, p50, p99, counts,
+                dict(store.fault_counts))
+
+    try:
+        clean_qps, clean_p50, clean_p99, clean_counts, _ = run(False)
+        resilience_reset()  # fresh breakers for the fault run
+        chaos_qps, chaos_p50, chaos_p99, chaos_counts, faults = run(True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience_reset()
+
+    print(f"serve clean: {clean_qps:.0f} qps p50={clean_p50:.2f}ms "
+          f"p99={clean_p99:.2f}ms {clean_counts}", file=sys.stderr)
+    print(f"serve chaos: {chaos_qps:.0f} qps p50={chaos_p50:.2f}ms "
+          f"p99={chaos_p99:.2f}ms {chaos_counts} faults={faults}",
+          file=sys.stderr)
+    # the degradation gate: tail latency under chaos stays bounded
+    # (floor the clean p99 at 1ms so an unloaded box can't fail on
+    # sub-millisecond jitter)
+    limit = 10.0 * max(clean_p99, 1.0)
+    assert chaos_p99 <= limit, \
+        (f"serve p99 under chaos {chaos_p99:.1f}ms exceeds 10x clean "
+         f"p99 ({limit:.1f}ms): degradation is no longer graceful")
+    print(json.dumps({
+        "metric": "serve_qps",
+        "value": round(clean_qps, 1),
+        "unit": "requests/s",
+        "clients": n_clients,
+        "tables": n_tables,
+        "p50_ms": round(clean_p50, 2),
+        "p99_ms": round(clean_p99, 2),
+    }))
+    print(json.dumps({
+        "metric": "serve_p99_ms_chaos",
+        "value": round(chaos_p99, 2),
+        "unit": "ms",
+        "qps": round(chaos_qps, 1),
+        "p50_ms": round(chaos_p50, 2),
+        "outcomes": chaos_counts,
+        "faults": faults,
+        "gate_10x_clean_p99_ms": round(limit, 2),
+    }))
+
+
 def main():
     commits = int(os.environ.get("BENCH_COMMITS", 100_000))
     workdir = os.environ.get("BENCH_WORKDIR", "/tmp/delta_tpu_bench")
@@ -739,6 +881,7 @@ def main():
     trace_overhead_metric(workdir)
     retry_overhead_metric(workdir)
     chaos_recovery_metric()
+    serve_metrics()
     checkpoint_read_metric(workdir)
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

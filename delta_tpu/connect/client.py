@@ -6,12 +6,41 @@ Scala/Python Delta Connect clients, `spark-connect/client/` and
         session.write_table("/data/t", arrow_table, mode="append")
         rows = session.read_table("/data/t", filter="id > 5")
         session.sql("OPTIMIZE '/data/t'")
+
+Robustness features (all opt-in via the constructor, all designed for
+the serve layer in :mod:`delta_tpu.serve` but protocol-compatible with
+the plain connect server):
+
+- **typed remote errors** — an error envelope whose ``error_class``
+  names a `delta_tpu.errors` exception is re-raised as that type (with
+  the server's ``retry_after_ms`` hint attached), so callers can catch
+  ``ServiceOverloadedError`` / ``DeadlineExceededError`` instead of
+  string-matching a generic wrapper.
+- **deadline stamping** — ``deadline_ms`` (per-client default, or
+  per-call) rides in the request envelope as the *remaining budget* in
+  milliseconds (relative, so no clock sync needed); the server abandons
+  the work when the budget expires.
+- **reconnect** — idempotent ops retry through the shared
+  `RetryPolicy` (decorrelated-jitter backoff), transparently replacing
+  a broken socket. A server-side shed (`ServiceOverloadedError`) is
+  classified transient — the request did no work — so idempotent ops
+  also back off and retry it automatically.
+- **hedged reads** — with ``hedge_ms > 0``, an idempotent op that has
+  not answered within the hedge budget fires a duplicate on a fresh
+  connection and takes whichever finishes first (tail-latency
+  insurance during chaos; costs at most one duplicate read).
+- ``last_envelope`` exposes the most recent reply envelope so callers
+  can observe the serve layer's ``stale: true`` degradation marker.
 """
 
 from __future__ import annotations
 
+import logging
 import socket
 import threading
+from concurrent.futures import FIRST_COMPLETED
+from concurrent.futures import TimeoutError as _FutureTimeout
+from concurrent.futures import wait as _futures_wait
 from typing import Optional, Sequence
 
 import pyarrow as pa
@@ -24,6 +53,45 @@ from delta_tpu.connect.protocol import (
 )
 from delta_tpu.errors import DeltaError
 
+_log = logging.getLogger("delta_tpu.connect")
+
+# Ops safe to resend after an ambiguous failure: they mutate nothing,
+# so a duplicate (reconnect retry or hedge) is at worst wasted work.
+_IDEMPOTENT = frozenset(
+    {"ping", "health", "read", "version", "history", "detail"})
+
+_error_types = None
+
+
+def _remote_exception(envelope: dict) -> Exception:
+    """Rebuild the server's exception from an error envelope. Falls
+    back to :class:`RemoteDeltaError` for unknown/unconstructible
+    classes; always attaches ``retry_after_ms`` when the server sent
+    the hint."""
+    global _error_types
+    if _error_types is None:
+        import delta_tpu.errors as _errs
+
+        _error_types = {
+            name: cls for name, cls in vars(_errs).items()
+            if isinstance(cls, type) and issubclass(cls, DeltaError)}
+    name = envelope.get("error_class", "DeltaError")
+    message = envelope.get("error", "unknown error")
+    cls = _error_types.get(name)
+    exc: Exception
+    if cls is None or cls is DeltaError:
+        exc = RemoteDeltaError(message, name)
+    else:
+        try:
+            exc = cls(message)
+        except TypeError:
+            # constructor demands structured args we don't have remotely
+            exc = RemoteDeltaError(message, name)
+    retry_after = envelope.get("retry_after_ms")
+    if retry_after is not None:
+        exc.retry_after_ms = retry_after
+    return exc
+
 
 class RemoteDeltaError(DeltaError):
     """Server-side failure surfaced to the client."""
@@ -35,25 +103,129 @@ class RemoteDeltaError(DeltaError):
 
 class DeltaConnectClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 9477,
-                 timeout: float = 120.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+                 timeout: float = 120.0, tenant: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 reconnect: bool = True, hedge_ms: float = 0.0):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._tenant = tenant
+        self._deadline_ms = deadline_ms
+        self._hedge_ms = float(hedge_ms)
         self._lock = threading.Lock()
+        # Connect eagerly so a bad address fails at construction.
+        self._sock: Optional[socket.socket] = self._open()
+        self.last_envelope: Optional[dict] = None
+        self._policy = None
+        if reconnect:
+            from delta_tpu.resilience import RetryPolicy
+
+            self._policy = RetryPolicy.from_env()
 
     # -- plumbing ------------------------------------------------------
-    def _call(self, op: str, payload: bytes = b"", **params):
-        with self._lock:
-            send_frame(self._sock, {"op": op, **params}, payload)
-            envelope, out_payload = recv_frame(self._sock)
+    def _open(self) -> socket.socket:
+        return socket.create_connection((self._host, self._port),
+                                        timeout=self._timeout)
+
+    def _roundtrip(self, op: str, payload: bytes, params: dict,
+                   sock: Optional[socket.socket] = None):
+        """One request/response exchange. With ``sock=None`` the shared
+        connection is used (serialized by the client lock; broken
+        sockets are dropped so the next attempt reconnects)."""
+        if sock is not None:
+            send_frame(sock, {"op": op, **params}, payload)
+            envelope, out_payload = recv_frame(sock)
+        else:
+            # Reconnect outside the lock: a TCP connect can block for
+            # seconds and must not stall other callers' roundtrips. If
+            # two threads race, the loser's socket is closed unused.
+            fresh = self._open() if self._sock is None else None
+            with self._lock:
+                if self._sock is None and fresh is not None:
+                    self._sock, fresh = fresh, None
+                if fresh is not None:
+                    try:
+                        fresh.close()
+                    except OSError as e:
+                        _log.debug("extra socket close: %s", e)
+                if self._sock is None:
+                    # lost a race with a concurrent failure; transient,
+                    # so the retry policy reconnects on the next attempt
+                    raise ConnectionError("connection lost before send")
+                try:
+                    send_frame(self._sock, {"op": op, **params}, payload)
+                    envelope, out_payload = recv_frame(self._sock)
+                except (ConnectionError, OSError):
+                    try:
+                        self._sock.close()
+                    except OSError as e:
+                        _log.debug("socket close after failure: %s", e)
+                    self._sock = None
+                    raise
+        self.last_envelope = envelope
         if not envelope.get("ok"):
-            raise RemoteDeltaError(envelope.get("error", "unknown error"),
-                                   envelope.get("error_class", "DeltaError"))
+            raise _remote_exception(envelope)
         return envelope, out_payload
 
-    def close(self) -> None:
+    def _hedged(self, op: str, payload: bytes, params: dict):
+        """Primary on the shared socket; if it has not answered within
+        the hedge budget, race a duplicate on a fresh connection."""
+        from delta_tpu.utils.threads import shared_pool
+
+        pool_submit = shared_pool().submit
+        primary = pool_submit(self._roundtrip, op, payload, params)
         try:
-            self._sock.close()
-        except OSError:
-            pass
+            return primary.result(timeout=self._hedge_ms / 1000.0)
+        except _FutureTimeout:
+            _log.debug("hedging %s after %.0fms", op, self._hedge_ms)
+
+        def _fresh():
+            s = self._open()
+            try:
+                return self._roundtrip(op, payload, params, sock=s)
+            finally:
+                try:
+                    s.close()
+                except OSError as e:
+                    _log.debug("hedge socket close: %s", e)
+
+        hedge = pool_submit(_fresh)
+        pending = {primary, hedge}
+        last_error: Optional[BaseException] = None
+        while pending:
+            done, pending = _futures_wait(pending,
+                                          return_when=FIRST_COMPLETED)
+            for f in done:
+                err = f.exception()
+                if err is None:
+                    return f.result()
+                last_error = err
+        raise last_error
+
+    def _call(self, op: str, payload: bytes = b"", **params):
+        if self._tenant is not None:
+            params.setdefault("tenant", self._tenant)
+        if self._deadline_ms is not None:
+            params.setdefault("deadline_ms", self._deadline_ms)
+        idempotent = op in _IDEMPOTENT
+        if idempotent and self._hedge_ms > 0:
+            return self._hedged(op, payload, params)
+        if idempotent and self._policy is not None:
+            # ConnectionError (socket died → reconnect) and
+            # ServiceOverloadedError (shed before any work) are both
+            # transient; the policy backs off with decorrelated jitter.
+            return self._policy.call(
+                lambda: self._roundtrip(op, payload, params))
+        return self._roundtrip(op, payload, params)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError as e:
+                    _log.debug("close: %s", e)
+                self._sock = None
 
     def __enter__(self):
         return self
@@ -66,12 +238,21 @@ class DeltaConnectClient:
         env, _ = self._call("ping")
         return bool(env.get("pong"))
 
+    def health(self) -> dict:
+        """Serve-layer health snapshot (queue depth, breaker states,
+        cache freshness). The lightweight connect server rejects this
+        op; use it against `DeltaServeServer`."""
+        env, _ = self._call("health")
+        return env.get("health", {})
+
     def read_table(self, path: str, columns: Optional[Sequence[str]] = None,
                    filter: Optional[str] = None,
-                   version: Optional[int] = None) -> pa.Table:
+                   version: Optional[int] = None,
+                   deadline_ms: Optional[float] = None) -> pa.Table:
         _, payload = self._call(
             "read", path=path, columns=list(columns) if columns else None,
-            filter=filter, version=version)
+            filter=filter, version=version,
+            **({"deadline_ms": deadline_ms} if deadline_ms else {}))
         return ipc_to_table(payload)
 
     def write_table(self, path: str, data: pa.Table, mode: str = "append",
@@ -115,5 +296,5 @@ class DeltaConnectClient:
 
 
 def connect(host: str = "127.0.0.1", port: int = 9477,
-            timeout: float = 120.0) -> DeltaConnectClient:
-    return DeltaConnectClient(host, port, timeout)
+            timeout: float = 120.0, **kwargs) -> DeltaConnectClient:
+    return DeltaConnectClient(host, port, timeout, **kwargs)

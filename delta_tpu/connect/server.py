@@ -7,6 +7,12 @@ vacuum. Each request envelope is `{"op": ..., **params}`; tabular
 results travel as an Arrow IPC payload, scalar results inside the JSON
 envelope. Errors return `{"ok": false, "error", "error_class"}`.
 
+The op table itself lives in :mod:`delta_tpu.serve.ops` and is shared
+with the hardened multi-tenant `DeltaServeServer`; this server remains
+the zero-setup thread-per-connection variant for tests and single-user
+tooling. Production serving (admission control, deadlines, stale
+fallback, drain) is `delta_tpu.serve` — see docs/serving.md.
+
 Security note: the server executes operations on local table paths on
 behalf of remote clients; `allowed_root` confines requests to one
 directory tree.
@@ -14,38 +20,19 @@ directory tree.
 
 from __future__ import annotations
 
+import logging
 import os
 import socketserver
 import threading
 from typing import Optional
 
-from delta_tpu.connect.protocol import (
-    ipc_to_table,
-    recv_frame,
-    send_frame,
-    table_to_ipc,
-)
-from delta_tpu.errors import ConnectProtocolError, DeltaError
+from delta_tpu import obs
+from delta_tpu.connect.protocol import recv_frame, send_frame
+from delta_tpu.errors import DeltaError
 
+_log = logging.getLogger("delta_tpu.connect")
 
-def _jsonable(out):
-    """Convert an arbitrary statement result (dataclass metrics objects,
-    lists of them, plain scalars) into something json.dumps accepts — a
-    VACUUM/OPTIMIZE result must not kill the response frame after the
-    operation already ran."""
-    import dataclasses
-
-    if hasattr(out, "to_dict"):
-        return out.to_dict()
-    if dataclasses.is_dataclass(out) and not isinstance(out, type):
-        return dataclasses.asdict(out)
-    if isinstance(out, (list, tuple)):
-        return [_jsonable(v) for v in out]
-    if isinstance(out, dict):
-        return {k: _jsonable(v) for k, v in out.items()}
-    if out is None or isinstance(out, (bool, int, float, str)):
-        return out
-    return str(out)
+_PROTOCOL_ERRORS = obs.counter("server.protocol_errors")
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -55,16 +42,51 @@ class _Handler(socketserver.BaseRequestHandler):
                 envelope, payload = recv_frame(self.request)
             except (ConnectionError, OSError):
                 return
+            except Exception as e:
+                # A garbage frame (unparseable envelope JSON, bogus
+                # lengths) means the byte stream can no longer be
+                # trusted: any further recv would read from the middle
+                # of the corrupt frame and desync every later reply.
+                # Answer with a typed protocol error, then close.
+                _PROTOCOL_ERRORS.inc()
+                try:
+                    send_frame(self.request, {
+                        "ok": False,
+                        "error": f"malformed frame: {e}",
+                        "error_class": "ConnectProtocolError",
+                        "error_code": "DELTA_CONNECT_PROTOCOL_ERROR",
+                    })
+                except OSError as send_err:
+                    _log.debug("protocol-error notify failed: %s", send_err)
+                return
             try:
                 result, out_payload = self.server._dispatch(envelope, payload)
                 send_frame(self.request, {"ok": True, **(result or {})},
                            out_payload)
+            except (ConnectionError, OSError):
+                return  # reply could not be delivered; peer is gone
             except Exception as e:  # error envelope, keep connection alive
-                send_frame(self.request, {
+                env = {
                     "ok": False,
                     "error": str(e),
                     "error_class": type(e).__name__,
-                })
+                }
+                if isinstance(e, DeltaError):
+                    env["error_code"] = e.error_class
+                retry_after = getattr(e, "retry_after_ms", None)
+                if retry_after is not None:
+                    env["retry_after_ms"] = retry_after
+                try:
+                    send_frame(self.request, env)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as send_err:
+                    # The error envelope itself failed to serialize or
+                    # send mid-frame — the stream may hold a partial
+                    # header, so the only safe move is to close.
+                    _log.debug("error reply failed (%s): %s",
+                               type(send_err).__name__, send_err)
+                    return
 
 
 class DeltaConnectServer(socketserver.ThreadingTCPServer):
@@ -74,9 +96,14 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  engine=None, allowed_root: Optional[str] = None):
         super().__init__((host, port), _Handler)
+        # Runtime import: serve.ops pulls connect.protocol, which would
+        # re-enter this package's __init__ if imported at module scope.
+        from delta_tpu.serve.ops import Dispatcher
+
         self.engine = engine
         self.allowed_root = (os.path.realpath(allowed_root)
                              if allowed_root else None)
+        self.dispatcher = Dispatcher(engine, allowed_root=allowed_root)
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------
@@ -97,99 +124,10 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
 
     # -- dispatch ------------------------------------------------------
     def _check_root(self, path: str) -> None:
-        if self.allowed_root is not None:
-            # realpath, not abspath: a symlink inside the served root must
-            # not escape the confinement the docstring promises
-            resolved = os.path.realpath(path)
-            if not (resolved + "/").startswith(self.allowed_root + "/"):
-                raise ConnectProtocolError(
-                f"path {path!r} is outside the served root",
-                error_class="DELTA_CONNECT_PATH_OUTSIDE_ROOT")
-
-    def _table(self, path: str):
-        from delta_tpu.table import Table
-
-        self._check_root(path)
-        return Table.for_path(path, engine=self.engine)
+        self.dispatcher.check_root(path)
 
     def _dispatch(self, env: dict, payload: bytes):
-        op = env.get("op")
-        if op == "ping":
-            return {"pong": True}, b""
-
-        if op == "read":
-            t = self._table(env["path"])
-            snap = (t.snapshot_at(env["version"])
-                    if env.get("version") is not None
-                    else t.latest_snapshot())
-            pred = None
-            if env.get("filter"):
-                from delta_tpu.expressions.parser import parse_expression
-
-                pred = parse_expression(env["filter"])
-            data = snap.scan(filter=pred, columns=env.get("columns")).to_arrow()
-            return {"num_rows": data.num_rows,
-                    "version": snap.version}, table_to_ipc(data)
-
-        if op == "write":
-            data = ipc_to_table(payload)
-            if data is None:
-                raise ConnectProtocolError("write requires an Arrow payload",
-                                       error_class="DELTA_CONNECT_MISSING_PAYLOAD")
-            import delta_tpu.api as dta
-
-            self._table(env["path"])  # root check
-            v = dta.write_table(
-                env["path"], data,
-                mode=env.get("mode", "append"),
-                partition_by=env.get("partition_by"),
-                properties=env.get("properties"),
-                engine=self.engine)
-            return {"version": v}, b""
-
-        if op == "sql":
-            import pyarrow as pa
-
-            from delta_tpu.sql import sql as run_sql
-
-            out = run_sql(env["statement"], engine=self.engine,
-                          path_guard=self._check_root)
-            if isinstance(out, pa.Table):
-                return {"kind": "table"}, table_to_ipc(out)
-            return {"kind": "json", "result": _jsonable(out)}, b""
-
-        if op == "history":
-            t = self._table(env["path"])
-            return {"history": [r.to_dict()
-                                for r in t.history(env.get("limit"))]}, b""
-
-        if op == "detail":
-            from delta_tpu.sql import describe_detail
-
-            return {"detail": describe_detail(self._table(env["path"]))}, b""
-
-        if op == "version":
-            return {"version": self._table(env["path"]).latest_snapshot().version}, b""
-
-        if op == "optimize":
-            t = self._table(env["path"])
-            builder = t.optimize()
-            if env.get("zorder_by"):
-                m = builder.execute_zorder_by(*env["zorder_by"])
-            else:
-                m = builder.execute_compaction()
-            return {"metrics": m.to_dict()}, b""
-
-        if op == "vacuum":
-            from delta_tpu.commands.vacuum import vacuum
-
-            deleted = vacuum(self._table(env["path"]),
-                             retention_hours=env.get("retention_hours"),
-                             dry_run=env.get("dry_run", False))
-            return {"deleted": deleted.num_deleted}, b""
-
-        raise ConnectProtocolError(f"unknown connect op {op!r}",
-                               error_class="DELTA_CONNECT_UNKNOWN_OP")
+        return self.dispatcher.dispatch(env, payload)
 
 
 def serve(path_root: str, host: str = "127.0.0.1", port: int = 9477):

@@ -356,6 +356,35 @@ class CircuitOpenError(DeltaError):
     error_class = "DELTA_CIRCUIT_BREAKER_OPEN"
 
 
+class DeadlineExceededError(DeltaError):
+    """The request's wall-clock deadline passed before the work
+    finished: the client has stopped caring, so the remaining work is
+    abandoned rather than completed into the void (see
+    delta_tpu/resilience/deadline.py). Deliberately permanent in the
+    transient/permanent classification — retrying an expired budget
+    cannot help."""
+
+    error_class = "DELTA_DEADLINE_EXCEEDED"
+
+
+class ServiceOverloadedError(DeltaError):
+    """The serve-layer admission controller rejected the request before
+    doing any work: the queue is at capacity, the tenant is over its
+    rate/concurrency budget, or the server is draining. Classified
+    *transient* (delta_tpu/resilience/classify.py): backing off and
+    retrying is exactly what the caller should do, and
+    ``retry_after_ms`` hints when."""
+
+    error_class = "DELTA_SERVICE_OVERLOADED"
+
+    def __init__(self, message: str, retry_after_ms: int = None,
+                 reason: str = None):
+        super().__init__(message, retry_after_ms=retry_after_ms,
+                         reason=reason)
+        self.retry_after_ms = retry_after_ms
+        self.reason = reason
+
+
 class DomainMetadataError(DeltaError):
     error_class = "DELTA_DOMAIN_METADATA_NOT_SUPPORTED"
 

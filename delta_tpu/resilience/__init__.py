@@ -16,6 +16,10 @@ of ad-hoc loops:
 - :mod:`delta_tpu.resilience.breaker` — per-endpoint circuit breaker
   (closed → open → half-open with probe requests) so a dead endpoint
   fails fast instead of serially burning retry budgets.
+- :mod:`delta_tpu.resilience.deadline` — ambient (contextvar-scoped)
+  request deadlines; `RetryPolicy` honours them at every attempt
+  boundary, so multi-hop work is abandoned the moment the requesting
+  client's budget expires.
 - :mod:`delta_tpu.resilience.chaos` — deterministic seeded
   `ChaosStore` fault-injection wrapper (superset of
   `FaultInjectingLogStore`) for soak testing.
@@ -33,6 +37,7 @@ from typing import Callable, Optional, TypeVar
 from delta_tpu.resilience.breaker import (
     CircuitBreaker,
     breaker_for,
+    breaker_states,
     reset_breakers,
 )
 from delta_tpu.resilience.chaos import ChaosSchedule, ChaosStore
@@ -42,6 +47,14 @@ from delta_tpu.resilience.classify import (
     StorageRequestError,
     classify,
     is_transient,
+)
+from delta_tpu.resilience.deadline import (
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+    deadline_scope_at,
+    expired,
+    remaining,
 )
 from delta_tpu.resilience.policy import RetryPolicy
 
@@ -102,11 +115,18 @@ __all__ = [
     "StorageRequestError",
     "TRANSIENT",
     "breaker_for",
+    "breaker_states",
+    "check_deadline",
     "classify",
+    "current_deadline",
+    "deadline_scope",
+    "deadline_scope_at",
     "default_policy",
     "endpoint_of",
+    "expired",
     "io_call",
     "is_transient",
+    "remaining",
     "reset",
     "reset_breakers",
 ]

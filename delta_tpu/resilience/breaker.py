@@ -127,6 +127,19 @@ class CircuitBreaker:
                 _OPENS.inc()
                 self._transition(OPEN)
 
+    def snapshot(self) -> dict:
+        """Point-in-time introspection view (health endpoints): state,
+        consecutive failure count, and — when open — how long until the
+        next probe is allowed."""
+        with self._lock:
+            out = {"state": self._state, "failures": self._failures}
+            if self._state == OPEN:
+                out["retry_in_s"] = round(max(
+                    0.0, self.reset_s - (self._clock() - self._opened_at)), 3)
+            if self._state == HALF_OPEN:
+                out["probing"] = self._probing
+            return out
+
     # call with self._lock held
     def _transition(self, state: str) -> None:
         self._state = state
@@ -157,6 +170,15 @@ def breaker_for(endpoint: str) -> CircuitBreaker:
             )
             _breakers[endpoint] = b
     return b
+
+
+def breaker_states() -> Dict[str, dict]:
+    """Introspection over every live breaker: endpoint ->
+    :meth:`CircuitBreaker.snapshot`. The serve `/health` op reports
+    this so operators can see which storage buckets are degraded."""
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {name: b.snapshot() for name, b in items}
 
 
 def reset_breakers() -> None:

@@ -40,13 +40,16 @@ _RETRYABLE_DDB_TYPES = frozenset({
     "LimitExceededException",
 })
 
-# DeltaError catalog classes that are safe to retry at the storage
-# layer. Deliberately empty today: DeltaErrors encode logical outcomes
-# (conflicts, corruption, unsupported features) that retrying at the IO
-# layer would only mask — retryable commit failures carry an explicit
-# ``retryable`` attribute instead. Kept as a named set so a future
-# catalog class can opt in without touching the classifier logic.
-_RETRYABLE_ERROR_CLASSES = frozenset()
+# DeltaError catalog classes that are safe to retry. Almost empty by
+# design: DeltaErrors encode logical outcomes (conflicts, corruption,
+# unsupported features) that retrying at the IO layer would only mask —
+# retryable commit failures carry an explicit ``retryable`` attribute
+# instead. The one opt-in is the serve layer's admission rejection: a
+# shed request did no work at all, and backing off + retrying (per its
+# ``retry_after_ms`` hint) is precisely the documented contract.
+# DELTA_DEADLINE_EXCEEDED stays permanent: an expired budget cannot be
+# retried into existence.
+_RETRYABLE_ERROR_CLASSES = frozenset({"DELTA_SERVICE_OVERLOADED"})
 
 # OSError subclasses that are protocol signals or caller bugs, never
 # network weather.

@@ -1,0 +1,105 @@
+"""Ambient request deadlines: contextvar-scoped wall-clock budgets.
+
+The serve layer stamps each admitted request with the client's
+``deadline_ms`` budget; everything the request touches — snapshot
+load, retry loops, storage hops — must stop the moment that budget is
+gone, because finishing work for a client that already timed out only
+steals capacity from clients still waiting. A deadline is carried as
+an *absolute* ``time.monotonic()`` instant in a :mod:`contextvars`
+variable, so it flows through nested calls without threading a
+parameter through every signature, and nested scopes can only tighten
+it (a callee never outlives its caller's budget).
+
+Integration points:
+
+- :meth:`delta_tpu.resilience.policy.RetryPolicy.call` checks the
+  ambient deadline before every attempt and clamps its own wall-clock
+  retry budget to it, so every ``io_call`` hop is an abandonment
+  point;
+- the serve worker pool wraps request execution in
+  :func:`deadline_scope` and converts an expired budget into a typed
+  :class:`~delta_tpu.errors.DeadlineExceededError` response.
+
+Cross-thread note: contextvars do not flow into threads implicitly.
+The serve worker pool re-establishes the scope inside the worker; code
+handing work to other threads must do the same (``obs.wrap`` is the
+tracing analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Iterator, Optional
+
+from delta_tpu.errors import DeadlineExceededError
+
+_DEADLINE: "contextvars.ContextVar[Optional[float]]" = \
+    contextvars.ContextVar("delta_tpu_deadline", default=None)
+
+
+@contextlib.contextmanager
+def deadline_scope(seconds: Optional[float]) -> Iterator[Optional[float]]:
+    """Run the body under a wall-clock budget of ``seconds`` from now.
+
+    ``None`` leaves any enclosing deadline in force (a no-op scope).
+    Nesting takes the minimum: an inner scope can shorten the budget
+    but never extend past the enclosing one. Yields the absolute
+    monotonic deadline in force (or ``None``)."""
+    if seconds is None:
+        yield _DEADLINE.get()
+        return
+    target = time.monotonic() + max(0.0, float(seconds))
+    outer = _DEADLINE.get()
+    if outer is not None:
+        target = min(target, outer)
+    token = _DEADLINE.set(target)
+    try:
+        yield target
+    finally:
+        _DEADLINE.reset(token)
+
+
+@contextlib.contextmanager
+def deadline_scope_at(at: Optional[float]) -> Iterator[Optional[float]]:
+    """Like :func:`deadline_scope` but with an absolute
+    ``time.monotonic()`` instant — the serve worker re-establishing a
+    request's deadline in a different thread uses this."""
+    if at is None:
+        yield _DEADLINE.get()
+        return
+    outer = _DEADLINE.get()
+    target = at if outer is None else min(at, outer)
+    token = _DEADLINE.set(target)
+    try:
+        yield target
+    finally:
+        _DEADLINE.reset(token)
+
+
+def current_deadline() -> Optional[float]:
+    """The absolute monotonic deadline in force, or ``None``."""
+    return _DEADLINE.get()
+
+
+def remaining() -> Optional[float]:
+    """Seconds left in the ambient budget (may be negative), or
+    ``None`` when no deadline is in force."""
+    d = _DEADLINE.get()
+    return None if d is None else d - time.monotonic()
+
+
+def expired() -> bool:
+    d = _DEADLINE.get()
+    return d is not None and time.monotonic() >= d
+
+
+def check_deadline(what: str = "operation") -> None:
+    """Raise :class:`DeadlineExceededError` if the ambient deadline has
+    passed. The fast path (no deadline set) is one contextvar read."""
+    d = _DEADLINE.get()
+    if d is not None and time.monotonic() >= d:
+        raise DeadlineExceededError(
+            f"deadline exceeded before {what} "
+            f"({(time.monotonic() - d) * 1000.0:.0f}ms past budget)")

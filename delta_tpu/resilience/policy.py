@@ -37,8 +37,9 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from delta_tpu import obs
-from delta_tpu.errors import CircuitOpenError
+from delta_tpu.errors import CircuitOpenError, DeadlineExceededError
 from delta_tpu.resilience.classify import is_transient
+from delta_tpu.resilience.deadline import check_deadline, remaining
 
 T = TypeVar("T")
 
@@ -124,14 +125,25 @@ class RetryPolicy:
         ``on_retry(attempt, exc)`` fires before each backoff sleep —
         call sites use it to keep bespoke counters (e.g. the GCS
         arbiter's fix-retry count) without owning the loop.
+
+        An ambient request deadline
+        (:mod:`delta_tpu.resilience.deadline`) is honoured at every
+        attempt boundary: an already-expired budget raises
+        `DeadlineExceededError` without invoking ``fn`` (and without
+        touching the breaker — nobody answered), and the retry loop's
+        wall-clock budget is clamped to it. This is what makes every
+        storage hop an abandonment point for the serve layer.
         """
+        check_deadline("storage call")
         if breaker is not None:
             breaker.before_call()
         try:
             result = fn()
         except BaseException as e:
             if not classify(e):
-                if breaker is not None and not isinstance(e, CircuitOpenError):
+                if breaker is not None and \
+                        not isinstance(e, (CircuitOpenError,
+                                           DeadlineExceededError)):
                     breaker.on_success()
                 raise
             if breaker is not None:
@@ -146,6 +158,12 @@ class RetryPolicy:
     def _retry_slow_path(self, fn, first_exc, breaker, classify, on_retry):
         start = self._clock()
         deadline = start + self.deadline_s
+        # clamp to the ambient request deadline: the retry loop must
+        # never sleep past the moment the client stops caring. Measured
+        # as a remaining budget so injected test clocks stay coherent.
+        ambient_rem = remaining()
+        if ambient_rem is not None:
+            deadline = min(deadline, start + max(0.0, ambient_rem))
         exc = first_exc
         prev_sleep = self.base_s
         total_sleep_ns = 0
@@ -155,6 +173,15 @@ class RetryPolicy:
                 _RETRY_EXHAUSTED.inc()
                 obs.add_event("retry.exhausted", attempts=attempt,
                               error=type(exc).__name__)
+                if ambient_rem is not None and self._clock() >= \
+                        start + max(0.0, ambient_rem):
+                    # the *request's* budget (not the policy's) ran out:
+                    # surface the typed abandonment signal, chaining the
+                    # fault that was being retried
+                    raise DeadlineExceededError(
+                        f"request deadline exceeded after {attempt} "
+                        f"attempt(s); last error: "
+                        f"{type(exc).__name__}: {exc}") from exc
                 raise exc
             if on_retry is not None:
                 on_retry(attempt, exc)

@@ -1,0 +1,28 @@
+"""delta-serve: multi-tenant snapshot service.
+
+The hardened sibling of :mod:`delta_tpu.connect`: same framed
+JSON/Arrow wire protocol, but every table operation passes through
+admission control (bounded workers, per-tenant budgets, load
+shedding), ambient deadline propagation, and a shared hot-snapshot
+cache that degrades to explicitly-stale answers when storage is down.
+See docs/serving.md for the operator contract.
+"""
+
+from __future__ import annotations
+
+from delta_tpu.serve.admission import AdmissionController, Request, TokenBucket
+from delta_tpu.serve.cache import SnapshotCache
+from delta_tpu.serve.config import ServeConfig
+from delta_tpu.serve.ops import Dispatcher
+from delta_tpu.serve.server import DeltaServeServer, serve
+
+__all__ = [
+    "AdmissionController",
+    "DeltaServeServer",
+    "Dispatcher",
+    "Request",
+    "ServeConfig",
+    "SnapshotCache",
+    "TokenBucket",
+    "serve",
+]

@@ -1,0 +1,300 @@
+"""Admission control: bounded worker pool, per-tenant budgets, load
+shedding, deadline-aware execution.
+
+The design inverts the old connect server's thread-per-request model.
+Requests land in ONE bounded queue; a fixed pool of workers drains it.
+Everything that can go wrong under load is decided *at admission*,
+before any work or memory is committed:
+
+- **queue-depth shedding** — a full queue rejects immediately with a
+  typed :class:`~delta_tpu.errors.ServiceOverloadedError` carrying a
+  ``retry_after_ms`` hint, instead of stacking threads until the
+  process dies. An early typed rejection costs the client one backoff;
+  an accepted-then-timed-out request costs a worker slot and the
+  client its whole deadline.
+- **per-tenant token buckets** — sustained request rate per tenant is
+  bounded (``tenant_rate``/``tenant_burst``), so one chatty tenant
+  cannot starve the rest of the queue.
+- **per-tenant concurrency caps** — queued + running requests per
+  tenant are bounded, which keeps one tenant's slow tables from
+  occupying every worker.
+- **deadline enforcement** — a request whose client budget expired
+  while it sat in the queue is answered with
+  :class:`~delta_tpu.errors.DeadlineExceededError` *without running*
+  (its slot is reclaimed for a client that still cares); one that
+  expires mid-execution is abandoned at the next storage hop by the
+  ambient-deadline check in ``RetryPolicy``.
+- **graceful drain** — :meth:`AdmissionController.drain` stops
+  admitting, lets workers finish what is queued and running within a
+  grace budget, and answers anything still queued after the grace with
+  a typed draining rejection. Nothing is ever dropped without a
+  response.
+
+Counters: ``server.requests``, ``server.shed``,
+``server.deadline_exceeded``, ``server.queue_wait_ns``,
+``server.drained``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from delta_tpu import obs
+from delta_tpu.errors import DeadlineExceededError, ServiceOverloadedError
+from delta_tpu.resilience.deadline import deadline_scope_at
+from delta_tpu.serve import pool
+from delta_tpu.serve.config import ServeConfig
+
+_REQUESTS = obs.counter("server.requests")
+_SHED = obs.counter("server.shed")
+_DEADLINE_EXCEEDED = obs.counter("server.deadline_exceeded")
+_QUEUE_WAIT_NS = obs.counter("server.queue_wait_ns")
+_DRAINED = obs.counter("server.drained")
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second up to ``burst``.
+    ``try_take`` is non-blocking; a failed take reports how long until
+    one token will be available (the retry-after hint)."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._stamp = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self) -> Tuple[bool, float]:
+        """Returns ``(acquired, retry_after_s)``."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._stamp) * self.rate)
+            self._stamp = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True, 0.0
+            need = 1.0 - self._tokens
+            return False, need / self.rate if self.rate > 0 else 1.0
+
+
+class _Tenant:
+    __slots__ = ("bucket", "active")
+
+    def __init__(self, config: ServeConfig,
+                 clock: Callable[[], float]):
+        if config.tenant_rate > 0:
+            burst = config.tenant_burst or 2.0 * config.tenant_rate
+            self.bucket: Optional[TokenBucket] = TokenBucket(
+                config.tenant_rate, burst, clock)
+        else:
+            self.bucket = None
+        self.active = 0  # queued + running, guarded by the controller lock
+
+
+class Request:
+    """One admitted unit of work. ``fn`` runs on a worker under the
+    request's deadline scope; the submitting (connection-reader) thread
+    blocks in :meth:`wait` for the outcome."""
+
+    __slots__ = ("fn", "tenant", "op", "deadline", "enqueued_at",
+                 "_done", "result", "error", "queue_wait_s")
+
+    def __init__(self, fn: Callable[[], object], tenant: str, op: str,
+                 deadline: Optional[float]):
+        self.fn = fn
+        self.tenant = tenant
+        self.op = op
+        self.deadline = deadline  # absolute time.monotonic, or None
+        self.enqueued_at = 0.0
+        self._done = threading.Event()
+        self.result: object = None
+        self.error: Optional[BaseException] = None
+        self.queue_wait_s = 0.0
+
+    def complete(self, result=None, error: BaseException = None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class AdmissionController:
+    """Bounded queue + fixed worker pool + tenant budgets."""
+
+    def __init__(self, config: ServeConfig,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: "deque[Request]" = deque()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._running = 0
+        self._draining = False
+        self._stopped = False
+        self._workers = []
+        self.shed_counts: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "AdmissionController":
+        for i in range(self.config.workers):
+            self._workers.append(
+                pool.spawn(f"worker-{i}", self._worker_loop))
+        return self
+
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Stop admitting, finish queued + in-flight work within the
+        grace budget, then answer any stragglers with a typed draining
+        rejection. Idempotent."""
+        grace = self.config.drain_grace_s if grace_s is None else grace_s
+        with self._lock:
+            if self._stopped:
+                return
+            self._draining = True
+            self._work.notify_all()
+        deadline = self._clock() + grace
+        while self._clock() < deadline:
+            with self._lock:
+                if not self._queue and self._running == 0:
+                    break
+            time.sleep(0.01)
+        leftovers = []
+        with self._lock:
+            self._stopped = True
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._work.notify_all()
+        for req in leftovers:
+            _DRAINED.inc()
+            req.complete(error=ServiceOverloadedError(
+                "server is draining; request was not started",
+                retry_after_ms=1000, reason="draining"))
+        for w in self._workers:
+            pool.join_quietly(w, timeout=max(1.0, grace))
+        self._workers = []
+
+    # -- admission -----------------------------------------------------
+    def _note_shed(self, reason: str) -> None:
+        _SHED.inc()
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+        obs.add_event("server.shed", reason=reason)
+
+    def submit(self, req: Request) -> Request:
+        """Admit ``req`` or raise :class:`ServiceOverloadedError`.
+        Never blocks: every rejection path is decided immediately."""
+        cfg = self.config
+        with self._lock:
+            if self._draining or self._stopped:
+                self._note_shed("draining")
+                raise ServiceOverloadedError(
+                    "server is draining; not accepting work",
+                    retry_after_ms=1000, reason="draining")
+            tenant = self._tenants.get(req.tenant)
+            if tenant is None:
+                tenant = self._tenants[req.tenant] = _Tenant(
+                    cfg, self._clock)
+            if cfg.tenant_concurrency and \
+                    tenant.active >= cfg.tenant_concurrency:
+                self._note_shed("tenant_concurrency")
+                raise ServiceOverloadedError(
+                    f"tenant {req.tenant!r} already has {tenant.active} "
+                    f"request(s) in flight (cap {cfg.tenant_concurrency})",
+                    retry_after_ms=50, reason="tenant_concurrency")
+            if tenant.bucket is not None:
+                ok, retry_s = tenant.bucket.try_take()
+                if not ok:
+                    self._note_shed("rate_limited")
+                    raise ServiceOverloadedError(
+                        f"tenant {req.tenant!r} exceeded "
+                        f"{cfg.tenant_rate:g} req/s",
+                        retry_after_ms=max(1, int(retry_s * 1000)),
+                        reason="rate_limited")
+            if len(self._queue) >= cfg.max_queue:
+                self._note_shed("queue_full")
+                # hint scales with how much work is already ahead
+                est_ms = max(50, int(
+                    1000.0 * len(self._queue) / max(1, cfg.workers) * 0.01))
+                raise ServiceOverloadedError(
+                    f"admission queue at capacity ({cfg.max_queue})",
+                    retry_after_ms=est_ms, reason="queue_full")
+            _REQUESTS.inc()
+            tenant.active += 1
+            req.enqueued_at = self._clock()
+            self._queue.append(req)
+            self._work.notify()
+        return req
+
+    # -- execution -----------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._stopped \
+                        and not (self._draining and not self._queue):
+                    self._work.wait(timeout=0.5)
+                if self._stopped and not self._queue:
+                    return
+                if not self._queue:
+                    if self._draining:
+                        return
+                    continue
+                req = self._queue.popleft()
+                self._running += 1
+            try:
+                self._execute(req)
+            finally:
+                with self._lock:
+                    self._running -= 1
+                    tenant = self._tenants.get(req.tenant)
+                    if tenant is not None:
+                        tenant.active -= 1
+                    self._work.notify()
+
+    def _execute(self, req: Request) -> None:
+        now = self._clock()
+        req.queue_wait_s = now - req.enqueued_at
+        _QUEUE_WAIT_NS.inc(int(req.queue_wait_s * 1e9))
+        if req.deadline is not None and now >= req.deadline:
+            # the client stopped caring while this sat in the queue:
+            # reclaim the slot without doing the work
+            _DEADLINE_EXCEEDED.inc()
+            req.complete(error=DeadlineExceededError(
+                f"deadline expired after {req.queue_wait_s * 1000:.0f}ms "
+                f"in the admission queue"))
+            return
+        try:
+            with obs.span("serve.request", op=req.op, tenant=req.tenant):
+                with deadline_scope_at(req.deadline):
+                    result = req.fn()
+        except BaseException as e:
+            if isinstance(e, DeadlineExceededError):
+                _DEADLINE_EXCEEDED.inc()
+            req.complete(error=e)
+            return
+        req.complete(result=result)
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "running": self._running,
+                "workers": self.config.workers,
+                "draining": self._draining,
+                "tenants": {
+                    name: {"active": t.active}
+                    for name, t in self._tenants.items() if t.active
+                },
+                "shed": dict(self.shed_counts),
+            }
+
+    @property
+    def draining(self) -> bool:
+        return self._draining

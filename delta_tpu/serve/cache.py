@@ -1,0 +1,137 @@
+"""Shared hot-snapshot cache with stale serving.
+
+The serve layer keeps one :class:`~delta_tpu.table.Table` per served
+path in a small LRU. Each request advances the cached snapshot
+incrementally (``Table.update()`` → ``Snapshot.update()``: only log
+segments past the cached version are read) instead of re-listing the
+whole ``_delta_log`` — the same trick the paper's driver uses to keep
+refresh cost proportional to what changed.
+
+Degradation contract: when storage is down (circuit breaker open, or a
+transient fault that outlived the retry budget) and a previously
+loaded snapshot exists, the cache serves it — the response envelope is
+marked ``stale: true`` with the ``snapshot_version`` actually served,
+so clients can decide whether an old-but-consistent view is acceptable.
+A *deadline* expiry is never converted to a stale answer: the client
+has already stopped caring, so the typed error propagates. A table
+never loaded at all has nothing stale to serve; the original error
+propagates then too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from delta_tpu import obs
+from delta_tpu.errors import DeadlineExceededError
+from delta_tpu.resilience import is_transient
+from delta_tpu.serve.config import ServeConfig
+from delta_tpu.table import Table
+
+_STALE_SERVED = obs.counter("server.stale_served")
+_CACHE_HITS = obs.counter("server.cache_fresh_hits")
+_CACHE_REFRESH = obs.counter("server.cache_refresh")
+
+
+class _Entry:
+    __slots__ = ("table", "snapshot", "fresh_at", "lock")
+
+    def __init__(self, table: Table):
+        self.table = table
+        self.snapshot = None
+        self.fresh_at = 0.0   # monotonic instant of last successful refresh
+        self.lock = threading.Lock()
+
+
+class SnapshotCache:
+    """LRU of served tables; one refresh in flight per table."""
+
+    def __init__(self, engine, config: ServeConfig,
+                 clock=time.monotonic):
+        self._engine = engine
+        self._config = config
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+
+    def _entry(self, path: str) -> _Entry:
+        with self._lock:
+            e = self._entries.get(path)
+            if e is not None:
+                self._entries.move_to_end(path)
+                return e
+            e = _Entry(Table.for_path(path, self._engine))
+            self._entries[path] = e
+            while len(self._entries) > self._config.cache_tables:
+                self._entries.popitem(last=False)
+            return e
+
+    def snapshot_for(self, path: str,
+                     version: Optional[int] = None) -> Tuple[object, dict]:
+        """Return ``(snapshot, meta)`` for ``path``.
+
+        ``meta`` is merged into the reply envelope: ``{}`` for a fresh
+        read, or ``{"stale": True, "snapshot_version": v, ...}`` when
+        storage failed and the last known snapshot was served instead.
+        """
+        if version is not None:
+            # Time travel pins an exact version; serving anything else
+            # would be wrong, so there is no stale fallback here.
+            e = self._entry(path)
+            return e.table.snapshot_at(int(version)), {}
+        e = self._entry(path)
+        with e.lock:
+            now = self._clock()
+            window = self._config.refresh_ms / 1000.0
+            if e.snapshot is not None and window > 0 and \
+                    now - e.fresh_at < window:
+                _CACHE_HITS.inc()
+                return e.snapshot, {}
+            try:
+                snap = e.table.update()
+            except DeadlineExceededError:
+                raise
+            except Exception as exc:
+                if e.snapshot is None or not self._config.stale_ok \
+                        or not self._degradable(exc):
+                    raise
+                _STALE_SERVED.inc()
+                obs.add_event("server.stale_served", path=path,
+                              version=e.snapshot.version,
+                              cause=type(exc).__name__)
+                return e.snapshot, {
+                    "stale": True,
+                    "snapshot_version": e.snapshot.version,
+                    "stale_age_ms": int((now - e.fresh_at) * 1000),
+                    "stale_cause": type(exc).__name__,
+                }
+            _CACHE_REFRESH.inc()
+            e.snapshot = snap
+            e.fresh_at = now
+            return snap, {}
+
+    @staticmethod
+    def _degradable(exc: BaseException) -> bool:
+        # CircuitOpenError carries retryable=True, so is_transient covers
+        # both the open-breaker fast-fail and raw transient storage
+        # faults. Permanent errors (corruption already past the
+        # fallback, missing table, bad request) must surface.
+        return is_transient(exc)
+
+    def health(self) -> dict:
+        """Per-table freshness for the ``/health`` op."""
+        now = self._clock()
+        out = {}
+        with self._lock:
+            entries = list(self._entries.items())
+        for path, e in entries:
+            snap = e.snapshot
+            out[path] = {
+                "version": None if snap is None else snap.version,
+                "age_ms": None if e.fresh_at == 0.0
+                else int((now - e.fresh_at) * 1000),
+            }
+        return out

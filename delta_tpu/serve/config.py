@@ -1,0 +1,76 @@
+"""Serve-layer configuration, read once from ``DELTA_TPU_SERVE_*``.
+
+Every knob an operator needs to bound a long-lived multi-tenant
+snapshot service lives here (docs/serving.md documents the contract):
+
+=========================================  =======  ====================
+``DELTA_TPU_SERVE_WORKERS``                4        bounded worker pool
+``DELTA_TPU_SERVE_MAX_QUEUE``              32       admission queue depth
+``DELTA_TPU_SERVE_MAX_CONNECTIONS``        128      concurrent sockets
+``DELTA_TPU_SERVE_TENANT_RATE``            0        req/s per tenant (0 = off)
+``DELTA_TPU_SERVE_TENANT_BURST``           0        bucket burst (0 = 2x rate)
+``DELTA_TPU_SERVE_TENANT_CONCURRENCY``     0        in-flight+queued cap (0 = off)
+``DELTA_TPU_SERVE_DEFAULT_DEADLINE_MS``    0        deadline for unstamped requests
+``DELTA_TPU_SERVE_CACHE_TABLES``           64       hot-snapshot LRU entries
+``DELTA_TPU_SERVE_REFRESH_MS``             0        freshness window (0 = always re-list)
+``DELTA_TPU_SERVE_STALE_OK``               1        serve last snapshot on outage
+``DELTA_TPU_SERVE_DRAIN_GRACE_S``          10       drain budget on shutdown
+=========================================  =======  ====================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env_num(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    workers: int = 4
+    max_queue: int = 32
+    max_connections: int = 128
+    tenant_rate: float = 0.0          # requests/second; 0 disables
+    tenant_burst: float = 0.0         # bucket capacity; 0 -> 2x rate
+    tenant_concurrency: int = 0       # queued+running cap; 0 disables
+    default_deadline_ms: float = 0.0  # applied when the client sent none
+    cache_tables: int = 64
+    refresh_ms: float = 0.0           # snapshot freshness window
+    stale_ok: bool = True
+    drain_grace_s: float = 10.0
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        kw = {
+            "workers": max(1, int(_env_num("DELTA_TPU_SERVE_WORKERS", 4))),
+            "max_queue": max(0, int(_env_num("DELTA_TPU_SERVE_MAX_QUEUE",
+                                             32))),
+            "max_connections": max(1, int(_env_num(
+                "DELTA_TPU_SERVE_MAX_CONNECTIONS", 128))),
+            "tenant_rate": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_TENANT_RATE", 0.0)),
+            "tenant_burst": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_TENANT_BURST", 0.0)),
+            "tenant_concurrency": max(0, int(_env_num(
+                "DELTA_TPU_SERVE_TENANT_CONCURRENCY", 0))),
+            "default_deadline_ms": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_DEFAULT_DEADLINE_MS", 0.0)),
+            "cache_tables": max(1, int(_env_num(
+                "DELTA_TPU_SERVE_CACHE_TABLES", 64))),
+            "refresh_ms": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_REFRESH_MS", 0.0)),
+            "stale_ok": _env_num("DELTA_TPU_SERVE_STALE_OK", 1.0) != 0.0,
+            "drain_grace_s": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_DRAIN_GRACE_S", 10.0)),
+        }
+        kw.update(overrides)
+        return cls(**kw)

@@ -1,0 +1,170 @@
+"""Operation dispatch shared by the connect server and the serve layer.
+
+This module absorbs the op table that used to live inline in
+``connect/server.py`` so both servers speak the identical protocol:
+the lightweight `DeltaConnectServer` (thread-per-connection, zero
+setup, fine for tests and single-user tools) delegates here directly,
+while `DeltaServeServer` routes the same dispatcher through admission
+control and the hot-snapshot cache.
+
+Ops: ping, health, read, write, sql, history, detail, version,
+optimize, vacuum. Request envelope: ``{"op": ..., **params}``; tabular
+results travel as an Arrow IPC payload; scalar results inside the JSON
+envelope. The optional ``snapshot_provider`` hook (the serve layer's
+:meth:`~delta_tpu.serve.cache.SnapshotCache.snapshot_for`) supplies
+``(snapshot, meta)`` for snapshot-reading ops; ``meta`` (e.g. the
+``stale: true`` degradation marker) is merged into the reply envelope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Optional, Tuple
+
+from delta_tpu.connect.protocol import ipc_to_table, table_to_ipc
+from delta_tpu.errors import ConnectProtocolError
+
+
+def jsonable(out):
+    """Convert an arbitrary statement result (dataclass metrics objects,
+    lists of them, plain scalars) into something json.dumps accepts — a
+    VACUUM/OPTIMIZE result must not kill the response frame after the
+    operation already ran."""
+    if hasattr(out, "to_dict"):
+        return out.to_dict()
+    if dataclasses.is_dataclass(out) and not isinstance(out, type):
+        return dataclasses.asdict(out)
+    if isinstance(out, (list, tuple)):
+        return [jsonable(v) for v in out]
+    if isinstance(out, dict):
+        return {k: jsonable(v) for k, v in out.items()}
+    if out is None or isinstance(out, (bool, int, float, str)):
+        return out
+    return str(out)
+
+
+class Dispatcher:
+    """Executes one request envelope against local tables.
+
+    ``snapshot_provider(path, version) -> (snapshot, meta)`` lets the
+    serve layer substitute its shared cache (incremental refresh, stale
+    fallback) for the default cold ``Table`` load.
+    """
+
+    def __init__(self, engine=None, allowed_root: Optional[str] = None,
+                 snapshot_provider: Optional[
+                     Callable[[str, Optional[int]],
+                              Tuple[object, dict]]] = None):
+        self.engine = engine
+        self.allowed_root = (os.path.realpath(allowed_root)
+                             if allowed_root else None)
+        self.snapshot_provider = snapshot_provider
+
+    # -- helpers -------------------------------------------------------
+    def check_root(self, path: str) -> None:
+        if self.allowed_root is not None:
+            # realpath, not abspath: a symlink inside the served root must
+            # not escape the confinement the docstring promises
+            resolved = os.path.realpath(path)
+            if not (resolved + "/").startswith(self.allowed_root + "/"):
+                raise ConnectProtocolError(
+                    f"path {path!r} is outside the served root",
+                    error_class="DELTA_CONNECT_PATH_OUTSIDE_ROOT")
+
+    def _table(self, path: str):
+        from delta_tpu.table import Table
+
+        self.check_root(path)
+        return Table.for_path(path, engine=self.engine)
+
+    def _snapshot(self, path: str, version=None):
+        """Resolve a snapshot plus its envelope meta (stale markers)."""
+        self.check_root(path)
+        if self.snapshot_provider is not None:
+            return self.snapshot_provider(
+                path, None if version is None else int(version))
+        t = self._table(path)
+        snap = (t.snapshot_at(int(version)) if version is not None
+                else t.latest_snapshot())
+        return snap, {}
+
+    # -- dispatch ------------------------------------------------------
+    def dispatch(self, env: dict, payload: bytes):
+        op = env.get("op")
+        if op == "ping":
+            return {"pong": True}, b""
+
+        if op == "read":
+            snap, meta = self._snapshot(env["path"], env.get("version"))
+            pred = None
+            if env.get("filter"):
+                from delta_tpu.expressions.parser import parse_expression
+
+                pred = parse_expression(env["filter"])
+            data = snap.scan(filter=pred,
+                             columns=env.get("columns")).to_arrow()
+            return {"num_rows": data.num_rows, "version": snap.version,
+                    **meta}, table_to_ipc(data)
+
+        if op == "write":
+            data = ipc_to_table(payload)
+            if data is None:
+                raise ConnectProtocolError(
+                    "write requires an Arrow payload",
+                    error_class="DELTA_CONNECT_MISSING_PAYLOAD")
+            import delta_tpu.api as dta
+
+            self.check_root(env["path"])
+            v = dta.write_table(
+                env["path"], data,
+                mode=env.get("mode", "append"),
+                partition_by=env.get("partition_by"),
+                properties=env.get("properties"),
+                engine=self.engine)
+            return {"version": v}, b""
+
+        if op == "sql":
+            import pyarrow as pa
+
+            from delta_tpu.sql import sql as run_sql
+
+            out = run_sql(env["statement"], engine=self.engine,
+                          path_guard=self.check_root)
+            if isinstance(out, pa.Table):
+                return {"kind": "table"}, table_to_ipc(out)
+            return {"kind": "json", "result": jsonable(out)}, b""
+
+        if op == "history":
+            t = self._table(env["path"])
+            return {"history": [r.to_dict()
+                                for r in t.history(env.get("limit"))]}, b""
+
+        if op == "detail":
+            from delta_tpu.sql import describe_detail
+
+            return {"detail": describe_detail(self._table(env["path"]))}, b""
+
+        if op == "version":
+            snap, meta = self._snapshot(env["path"])
+            return {"version": snap.version, **meta}, b""
+
+        if op == "optimize":
+            t = self._table(env["path"])
+            builder = t.optimize()
+            if env.get("zorder_by"):
+                m = builder.execute_zorder_by(*env["zorder_by"])
+            else:
+                m = builder.execute_compaction()
+            return {"metrics": m.to_dict()}, b""
+
+        if op == "vacuum":
+            from delta_tpu.commands.vacuum import vacuum
+
+            deleted = vacuum(self._table(env["path"]),
+                             retention_hours=env.get("retention_hours"),
+                             dry_run=env.get("dry_run", False))
+            return {"deleted": deleted.num_deleted}, b""
+
+        raise ConnectProtocolError(f"unknown connect op {op!r}",
+                                   error_class="DELTA_CONNECT_UNKNOWN_OP")

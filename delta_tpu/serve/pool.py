@@ -1,0 +1,41 @@
+"""Thread creation for the serve layer — the ONE module allowed to
+spawn threads under ``delta_tpu/serve/``.
+
+The old connect server's thread-per-connection pattern is exactly what
+admission control replaces: every accepted socket minted an unbounded
+`threading.Thread`, so a traffic burst turned directly into thread
+stack memory and scheduler pressure. The serve layer's rule (enforced
+by the ``handler-discipline`` delta-lint pass) is that all of its
+threads are created here, named, daemonized, and accounted for — the
+bounded worker pool in :mod:`delta_tpu.serve.admission`, the acceptor,
+and the per-connection readers (which are themselves bounded by the
+``max_connections`` admission gate, not by accident).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from delta_tpu import obs
+
+_SPAWNED = obs.counter("server.threads_spawned")
+
+
+def spawn(name: str, target: Callable[[], None],
+          daemon: bool = True) -> threading.Thread:
+    """Start a named daemon thread. Every serve-layer thread goes
+    through here so live-thread accounting stays in one place."""
+    t = threading.Thread(target=target, name=f"delta-serve-{name}",
+                         daemon=daemon)
+    _SPAWNED.inc()
+    t.start()
+    return t
+
+
+def join_quietly(thread: Optional[threading.Thread],
+                 timeout: float = 5.0) -> None:
+    """Join a thread if it exists and is not the caller."""
+    if thread is None or thread is threading.current_thread():
+        return
+    thread.join(timeout=timeout)

@@ -1,0 +1,283 @@
+"""delta-serve: hardened multi-tenant snapshot service.
+
+`DeltaServeServer` speaks the same framed JSON/Arrow protocol as the
+lightweight connect server, but routes every operation through the
+robustness stack this package exists for:
+
+- **admission control** (:mod:`delta_tpu.serve.admission`) — a bounded
+  worker pool with per-tenant rate limits and concurrency caps; excess
+  load is rejected early with a typed overload error + retry hint
+  instead of stacking threads.
+- **deadline propagation** — clients stamp ``deadline_ms`` (remaining
+  budget, milliseconds) into the request envelope; the server converts
+  it to an absolute monotonic instant at receipt and the worker runs
+  the request under an ambient deadline scope, so storage retries deep
+  inside snapshot load abandon work the moment the client stops
+  caring.
+- **graceful degradation** (:mod:`delta_tpu.serve.cache`) — snapshot
+  reads come from a shared hot cache that serves the last known
+  snapshot (marked ``stale: true``) when the storage breaker is open.
+- **graceful drain** — ``shutdown()`` (or SIGTERM in the CLI entry)
+  stops accepting, finishes or deadline-cancels in-flight requests
+  within a grace budget, and answers everything still queued with a
+  typed draining rejection. No request is ever dropped without a
+  response.
+
+``ping`` and ``health`` bypass admission: a health probe must answer
+precisely when the queue is full.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Optional, Set, Tuple
+
+from delta_tpu import obs
+from delta_tpu.connect.protocol import recv_frame, send_frame
+from delta_tpu.errors import DeltaError
+from delta_tpu.resilience import breaker_states
+from delta_tpu.serve import pool
+from delta_tpu.serve.admission import AdmissionController, Request
+from delta_tpu.serve.cache import SnapshotCache
+from delta_tpu.serve.config import ServeConfig
+from delta_tpu.serve.ops import Dispatcher
+
+_log = logging.getLogger("delta_tpu.serve")
+
+_CONN_ACCEPTED = obs.counter("server.conn_accepted")
+_CONN_REJECTED = obs.counter("server.conn_rejected")
+_PROTOCOL_ERRORS = obs.counter("server.protocol_errors")
+
+# Ops answered inline on the connection-reader thread. Admission
+# exists to protect table work; a liveness probe must not queue
+# behind the very backlog it is trying to report.
+_INLINE_OPS = frozenset({"ping", "health"})
+
+
+def _error_envelope(e: BaseException) -> dict:
+    env = {
+        "ok": False,
+        "error": str(e),
+        "error_class": type(e).__name__,
+    }
+    retry_after = getattr(e, "retry_after_ms", None)
+    if retry_after is not None:
+        env["retry_after_ms"] = retry_after
+    if isinstance(e, DeltaError):
+        env["error_code"] = e.error_class
+    return env
+
+
+class DeltaServeServer:
+    """Multi-tenant snapshot server. All threads come from
+    :mod:`delta_tpu.serve.pool`; connection count, queue depth, and
+    per-tenant load are all bounded by :class:`ServeConfig`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 engine=None, allowed_root: Optional[str] = None,
+                 config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig.from_env()
+        self.cache = SnapshotCache(engine, self.config)
+        self.dispatcher = Dispatcher(
+            engine, allowed_root=allowed_root,
+            snapshot_provider=self.cache.snapshot_for)
+        self.admission = AdmissionController(self.config)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        # A timeout, not blocking accept: closing a socket does NOT
+        # wake a thread already parked in accept() on Linux, so the
+        # accept loop must poll to notice shutdown promptly.
+        self._listener.settimeout(0.25)
+        self.address: Tuple[str, int] = self._listener.getsockname()
+        self._conns: Set[socket.socket] = set()
+        self._conn_lock = threading.Lock()
+        self._accept_thread = None
+        self._stopping = False
+        self._started_at = time.monotonic()
+
+    # -- lifecycle -----------------------------------------------------
+    def start_background(self) -> "DeltaServeServer":
+        self.admission.start()
+        self._accept_thread = pool.spawn("accept", self._accept_loop)
+        return self
+
+    def serve_forever(self) -> None:
+        """Blocking variant for the CLI entry; returns after drain."""
+        self.admission.start()
+        self._accept_loop()
+
+    def shutdown(self, grace_s: Optional[float] = None) -> None:
+        """Graceful drain: stop accepting, finish in-flight work, answer
+        queued stragglers with a typed draining error, then close."""
+        if self._stopping:
+            return
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError as e:
+            _log.debug("listener close: %s", e)
+        self.admission.drain(grace_s)
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            # Half-close: SHUT_RD unblocks the reader's next recv (EOF)
+            # without cutting the write side, so a reply the drain just
+            # completed still flushes to the client before the reader's
+            # finally-close. A full close here could drop the last
+            # response of an in-flight request.
+            try:
+                c.shutdown(socket.SHUT_RD)
+            except OSError as e:
+                _log.debug("conn shutdown: %s", e)
+        pool.join_quietly(self._accept_thread)
+
+    # -- accept / read loops -------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue  # periodic shutdown-flag check
+            except OSError:
+                return  # listener closed: shutdown in progress
+            conn.settimeout(None)
+            with self._conn_lock:
+                over = len(self._conns) >= self.config.max_connections
+                if not over:
+                    self._conns.add(conn)
+            if over:
+                _CONN_REJECTED.inc()
+                try:
+                    send_frame(conn, {
+                        "ok": False,
+                        "error": "connection limit reached "
+                                 f"({self.config.max_connections})",
+                        "error_class": "ServiceOverloadedError",
+                        "error_code": "DELTA_SERVICE_OVERLOADED",
+                        "retry_after_ms": 500,
+                    })
+                except OSError as e:
+                    _log.debug("reject notify failed: %s", e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass  # best-effort close of a rejected socket
+                continue
+            _CONN_ACCEPTED.inc()
+            pool.spawn(f"conn-{conn.fileno()}",
+                       lambda c=conn: self._reader_loop(c))
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    envelope, payload = recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return  # peer hung up / we closed during drain
+                except Exception as e:
+                    # Garbage on the wire (bad JSON, oversized frame):
+                    # past this point framing is unrecoverable, so reply
+                    # typed and close rather than desync.
+                    _PROTOCOL_ERRORS.inc()
+                    self._try_send(conn, {
+                        "ok": False,
+                        "error": f"malformed frame: {e}",
+                        "error_class": "ConnectProtocolError",
+                        "error_code": "DELTA_CONNECT_PROTOCOL_ERROR",
+                    })
+                    return
+                if not self._serve_one(conn, envelope, payload):
+                    return
+        finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError as e:
+                _log.debug("conn close: %s", e)
+
+    def _serve_one(self, conn, envelope: dict, payload: bytes) -> bool:
+        """Handle one request; returns False when the connection must
+        close (reply could not be sent)."""
+        op = envelope.get("op")
+        if op in _INLINE_OPS:
+            if op == "ping":
+                return self._try_send(conn, {"ok": True, "pong": True})
+            return self._try_send(conn, {"ok": True,
+                                         "health": self.health()})
+        deadline = None
+        budget_ms = envelope.get("deadline_ms") \
+            or self.config.default_deadline_ms or None
+        if budget_ms:
+            deadline = time.monotonic() + float(budget_ms) / 1000.0
+        req = Request(
+            fn=lambda: self.dispatcher.dispatch(envelope, payload),
+            tenant=str(envelope.get("tenant") or "default"),
+            op=str(op), deadline=deadline)
+        try:
+            self.admission.submit(req)
+        except Exception as e:
+            return self._try_send(conn, _error_envelope(e))
+        # One request in flight per connection (the protocol is strict
+        # request/response), so blocking the reader here is the natural
+        # backpressure: a client cannot pipeline past its own replies.
+        req.wait()
+        if req.error is not None:
+            return self._try_send(conn, _error_envelope(req.error))
+        result, out_payload = req.result
+        return self._try_send(conn, {"ok": True, **(result or {})},
+                              out_payload)
+
+    def _try_send(self, conn, env: dict, payload: bytes = b"") -> bool:
+        try:
+            send_frame(conn, env, payload)
+            return True
+        except Exception as e:
+            # The reply may be unserializable (never for our own
+            # envelopes) or the peer gone; either way this stream is
+            # done. Log the breadcrumb and let the reader close.
+            _log.debug("send failed (%s): %s", type(e).__name__, e)
+            return False
+
+    # -- health --------------------------------------------------------
+    def health(self) -> dict:
+        return {
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+            "draining": self.admission.draining,
+            "admission": self.admission.stats(),
+            "connections": len(self._conns),
+            "max_connections": self.config.max_connections,
+            "breakers": breaker_states(),
+            "tables": self.cache.health(),
+        }
+
+
+def serve(path_root: str, host: str = "127.0.0.1", port: int = 9478):
+    """Blocking CLI entry: ``python -m delta_tpu.serve.server /root``.
+    SIGTERM/SIGINT trigger a graceful drain."""
+    import signal
+
+    srv = DeltaServeServer(host, port, allowed_root=path_root)
+
+    def _drain(signum, frame):
+        print(f"delta-serve: signal {signum}, draining "
+              f"(grace {srv.config.drain_grace_s:g}s)")
+        srv.shutdown()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    print(f"delta-serve on {srv.address}, root={path_root}, "
+          f"workers={srv.config.workers}, queue={srv.config.max_queue}")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve(sys.argv[1] if len(sys.argv) > 1 else ".",
+          port=int(sys.argv[2]) if len(sys.argv) > 2 else 9478)

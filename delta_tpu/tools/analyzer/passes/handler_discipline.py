@@ -1,0 +1,157 @@
+"""Serve-layer handler discipline rule: ``handler-discipline``.
+
+The serve layer (``delta_tpu/serve/``) exists to make request handling
+*bounded*: a fixed worker pool, an admission queue, and an ambient
+deadline on everything a worker does. Two code shapes silently defeat
+those bounds, so both are flagged inside the serve tree:
+
+1. **direct ``threading.Thread(...)`` construction** anywhere except
+   ``serve/pool.py``. A thread minted outside the pool module is
+   unnamed, uncounted (misses the ``server.threads_spawned`` counter),
+   and — the real hazard — unbounded: the old connect server's
+   thread-per-connection growth is exactly the failure mode admission
+   control replaced. Every serve thread goes through
+   :func:`delta_tpu.serve.pool.spawn`.
+2. **``io_call(...)`` outside a deadline scope.** The serve layer's
+   contract is that storage work done on behalf of a request is
+   abandoned when the client's budget expires; ``RetryPolicy`` only
+   honours that when an ambient deadline is in scope. An ``io_call``
+   lexically outside any ``with deadline_scope(...)`` /
+   ``deadline_scope_at(...)`` block (and outside the worker execution
+   path that establishes one) retries to its own private deadline,
+   holding a bounded worker long after the client hung up. Handlers
+   normally inherit the scope from
+   ``AdmissionController._execute``; code that calls ``io_call``
+   *directly* in the serve tree must establish its own scope.
+
+Scope is ``delta_tpu/serve/`` only — everywhere else these are the
+concern of ``threadpool-discipline`` and the resilience layer's
+defaults. Audited exceptions carry a
+``# delta-lint: disable=handler-discipline`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+_SCOPE_FNS = {"deadline_scope", "deadline_scope_at"}
+
+
+def _thread_ctor_names(tree: ast.Module) -> Set[str]:
+    """Dotted call names that resolve to ``threading.Thread`` in this
+    module: ``import threading [as t]`` binds ``t.Thread``; ``from
+    threading import Thread [as x]`` binds ``x``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "threading":
+                for a in node.names:
+                    if a.name == "Thread":
+                        names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "threading":
+                    names.add(f"{a.asname or a.name}.Thread")
+    return names
+
+
+def _io_call_names(tree: ast.Module) -> Set[str]:
+    """Dotted call names that resolve to
+    ``delta_tpu.resilience.io_call``: direct import (optionally
+    aliased) or attribute access through an imported resilience
+    module."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module in ("delta_tpu.resilience", "delta_tpu"):
+                for a in node.names:
+                    if a.name == "io_call":
+                        names.add(a.asname or a.name)
+                    elif a.name == "resilience":
+                        names.add(f"{a.asname or a.name}.io_call")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "delta_tpu.resilience":
+                    names.add(f"{a.asname}.io_call" if a.asname
+                              else "delta_tpu.resilience.io_call")
+    return names
+
+
+def _scope_call(item: ast.withitem) -> bool:
+    if not isinstance(item.context_expr, ast.Call):
+        return False
+    name = call_name(item.context_expr)
+    return bool(name) and name.rsplit(".", 1)[-1] in _SCOPE_FNS
+
+
+class _IoCallVisitor(ast.NodeVisitor):
+    """Collects io_call sites, tracking whether each is lexically under
+    a ``with deadline_scope(...)`` item."""
+
+    def __init__(self, io_names: Set[str]):
+        self.io_names = io_names
+        self.depth = 0  # nested deadline-scope with-blocks
+        self.bad: List[ast.Call] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        scoped = any(_scope_call(i) for i in node.items)
+        if scoped:
+            self.depth += 1
+        self.generic_visit(node)
+        if scoped:
+            self.depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in self.io_names and self.depth == 0:
+            self.bad.append(node)
+        self.generic_visit(node)
+
+
+@register
+class HandlerDisciplineRule(Rule):
+    id = "handler-discipline"
+    description = ("serve-layer handler spawning raw threads or doing "
+                   "storage IO outside a deadline scope — route threads "
+                   "through serve/pool.spawn and io_call through "
+                   "`with deadline_scope(...)`")
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        rel = mod.rel.replace("\\", "/")
+        if "delta_tpu/serve/" not in rel:
+            return []
+        findings: List[Finding] = []
+
+        # 1. raw thread construction (pool.py is the one allowed owner)
+        if not rel.endswith("serve/pool.py"):
+            ctors = _thread_ctor_names(tree)
+            if ctors:
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Call) \
+                            and call_name(node) in ctors:
+                        findings.append(Finding(
+                            self.id, mod.path, node.lineno, node.col_offset,
+                            "raw threading.Thread(...) in the serve layer "
+                            "— spawn through delta_tpu.serve.pool.spawn so "
+                            "the thread is named, counted, and bounded"))
+
+        # 2. io_call outside any deadline scope
+        io_names = _io_call_names(tree)
+        if io_names:
+            v = _IoCallVisitor(io_names)
+            v.visit(tree)
+            for node in v.bad:
+                findings.append(Finding(
+                    self.id, mod.path, node.lineno, node.col_offset,
+                    "io_call(...) outside a deadline scope in the serve "
+                    "layer — wrap in `with deadline_scope(...)` (or "
+                    "deadline_scope_at) so the request's budget bounds "
+                    "the storage retries"))
+        return findings

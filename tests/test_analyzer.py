@@ -678,6 +678,7 @@ def test_every_registered_rule_has_fixture_coverage():
         "obs-span-leak",                                     # obs
         "threadpool-discipline",                             # threads
         "retry-discipline",                                  # retry
+        "handler-discipline",                                # serve
     }
     assert set(all_rules()) == expected
 
@@ -943,6 +944,117 @@ def fetch(op):
             time.sleep(0.1)
 """
     report = analyze_sources({"m.py": src}, rules=["retry-discipline"])
+    assert not report.findings and report.suppressed
+
+
+# ------------------------------------------------- handler-discipline
+
+
+def test_handler_discipline_raw_thread_flagged():
+    src = """
+import threading
+
+def handle(conn):
+    t = threading.Thread(target=lambda: None, daemon=True)
+    t.start()
+"""
+    report = analyze_sources({"delta_tpu/serve/handlers.py": src},
+                             rules=["handler-discipline"])
+    fired = _rules_fired(report, "handler-discipline")
+    assert len(fired) == 1 and "pool.spawn" in fired[0].message
+
+
+def test_handler_discipline_from_import_thread_flagged():
+    src = """
+from threading import Thread as T
+
+def accept_loop(listener):
+    while True:
+        T(target=listener.accept).start()
+"""
+    report = analyze_sources({"delta_tpu/serve/server2.py": src},
+                             rules=["handler-discipline"])
+    assert _rules_fired(report, "handler-discipline")
+
+
+def test_handler_discipline_pool_module_exempt():
+    src = """
+import threading
+
+def spawn(name, target):
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    return t
+"""
+    report = analyze_sources({"delta_tpu/serve/pool.py": src},
+                             rules=["handler-discipline"])
+    assert not _rules_fired(report, "handler-discipline")
+
+
+def test_handler_discipline_outside_serve_exempt():
+    """The rule is scoped: the same shapes elsewhere in the tree are the
+    business of threadpool-discipline / resilience defaults."""
+    src = """
+import threading
+from delta_tpu.resilience import io_call
+
+def elsewhere(store):
+    threading.Thread(target=lambda: None).start()
+    return io_call("file", lambda: store.read("p"))
+"""
+    report = analyze_sources({"delta_tpu/storage/other.py": src},
+                             rules=["handler-discipline"])
+    assert not _rules_fired(report, "handler-discipline")
+
+
+def test_handler_discipline_naked_io_call_flagged():
+    src = """
+from delta_tpu.resilience import io_call
+
+def refresh(store):
+    return io_call("file", lambda: store.list_from("p"))
+"""
+    report = analyze_sources({"delta_tpu/serve/cachey.py": src},
+                             rules=["handler-discipline"])
+    fired = _rules_fired(report, "handler-discipline")
+    assert len(fired) == 1 and "deadline" in fired[0].message
+
+
+def test_handler_discipline_scoped_io_call_ok():
+    src = """
+from delta_tpu.resilience import deadline_scope, io_call
+
+def refresh(store, budget_s):
+    with deadline_scope(budget_s):
+        return io_call("file", lambda: store.list_from("p"))
+"""
+    report = analyze_sources({"delta_tpu/serve/cachey.py": src},
+                             rules=["handler-discipline"])
+    assert not _rules_fired(report, "handler-discipline")
+
+
+def test_handler_discipline_module_alias_io_call_flagged():
+    src = """
+from delta_tpu import resilience
+
+def refresh(store):
+    return resilience.io_call("file", lambda: store.read("p"))
+"""
+    report = analyze_sources({"delta_tpu/serve/cachey.py": src},
+                             rules=["handler-discipline"])
+    assert _rules_fired(report, "handler-discipline")
+
+
+def test_handler_discipline_suppression_pragma():
+    src = """
+import threading
+
+def special(target):
+    # delta-lint: disable=handler-discipline (audited: example)
+    return threading.Thread(target=target)
+"""
+    report = analyze_sources({"delta_tpu/serve/x.py": src},
+                             rules=["handler-discipline"])
     assert not report.findings and report.suppressed
 
 

@@ -1,0 +1,538 @@
+"""delta-serve coverage: admission control (tenant caps, queue
+shedding), deadline propagation (queue expiry and abandoned storage
+loads), stale serving under storage outage, graceful drain, the
+garbage-frame protocol regression, typed error surfacing, the health
+op, and a multi-seed chaos QPS soak (slow-marked)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu import obs
+from delta_tpu.connect import DeltaConnectServer, connect
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.errors import (
+    ConnectProtocolError,
+    DeadlineExceededError,
+    ServiceOverloadedError,
+)
+from delta_tpu.resilience import ChaosSchedule, ChaosStore
+from delta_tpu.resilience import reset as resilience_reset
+from delta_tpu.serve import (
+    AdmissionController,
+    DeltaServeServer,
+    ServeConfig,
+    TokenBucket,
+)
+from delta_tpu.storage.logstore import InMemoryLogStore
+
+
+def _batch(start, n):
+    return pa.table({"x": pa.array(
+        np.arange(start, start + n, dtype=np.int64))})
+
+
+def _chaos_engine(seed, sleep=None, **rates):
+    store = ChaosStore(InMemoryLogStore(), ChaosSchedule(seed, **rates),
+                       sleep=sleep or (lambda s: None))
+    store.enabled = False  # tests enable chaos after priming tables
+    return HostEngine(store_resolver=lambda p: store), store
+
+
+def _serve(engine, **cfg):
+    cfg.setdefault("drain_grace_s", 5.0)
+    srv = DeltaServeServer("127.0.0.1", 0, engine=engine,
+                           config=ServeConfig.from_env(**cfg))
+    return srv.start_background()
+
+
+# -------------------------------------------------------- token bucket
+
+
+def test_token_bucket_rate_and_hint():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=1.0, clock=lambda: now[0])
+    ok, _ = b.try_take()
+    assert ok
+    ok, retry_s = b.try_take()
+    assert not ok and retry_s == pytest.approx(0.5)
+    now[0] += 0.5  # one token refilled
+    ok, _ = b.try_take()
+    assert ok
+
+
+# ---------------------------------------------------- admission control
+
+
+def _controller(**cfg):
+    cfg.setdefault("workers", 1)
+    cfg.setdefault("drain_grace_s", 5.0)
+    return AdmissionController(ServeConfig.from_env(**cfg)).start()
+
+
+def _blocker():
+    """A request fn that parks a worker until released."""
+    gate = threading.Event()
+
+    def fn():
+        gate.wait(timeout=10)
+        return "done"
+
+    return gate, fn
+
+
+def test_queue_full_sheds_typed():
+    from delta_tpu.serve.admission import Request
+
+    ctl = _controller(workers=1, max_queue=1)
+    try:
+        gate, fn = _blocker()
+        running = ctl.submit(Request(fn, "a", "op", None))
+        time.sleep(0.05)  # let the worker pick it up
+        queued = ctl.submit(Request(fn, "a", "op", None))
+        with pytest.raises(ServiceOverloadedError) as ei:
+            ctl.submit(Request(fn, "a", "op", None))
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_ms >= 1
+        assert ctl.stats()["shed"] == {"queue_full": 1}
+        gate.set()
+        assert running.wait(5) and queued.wait(5)
+        assert running.result == "done" and running.error is None
+    finally:
+        ctl.drain(0.5)
+
+
+def test_tenant_concurrency_cap_isolates_tenants():
+    from delta_tpu.serve.admission import Request
+
+    ctl = _controller(workers=2, max_queue=8, tenant_concurrency=1)
+    try:
+        gate, fn = _blocker()
+        first = ctl.submit(Request(fn, "a", "op", None))
+        with pytest.raises(ServiceOverloadedError) as ei:
+            ctl.submit(Request(fn, "a", "op", None))
+        assert ei.value.reason == "tenant_concurrency"
+        # a different tenant is unaffected by tenant a's cap
+        other = ctl.submit(Request(fn, "b", "op", None))
+        gate.set()
+        assert first.wait(5) and other.wait(5)
+        # and once tenant a's request finished, its slot is free again
+        done = ctl.submit(Request(lambda: 1, "a", "op", None))
+        assert done.wait(5) and done.result == 1
+    finally:
+        ctl.drain(0.5)
+
+
+def test_tenant_rate_limit_sheds_with_hint():
+    from delta_tpu.serve.admission import Request
+
+    ctl = _controller(workers=2, max_queue=8, tenant_rate=1.0,
+                      tenant_burst=1.0)
+    try:
+        ok = ctl.submit(Request(lambda: 1, "a", "op", None))
+        assert ok.wait(5)
+        with pytest.raises(ServiceOverloadedError) as ei:
+            ctl.submit(Request(lambda: 1, "a", "op", None))
+        assert ei.value.reason == "rate_limited"
+        assert ei.value.retry_after_ms >= 1
+    finally:
+        ctl.drain(0.5)
+
+
+def test_deadline_expired_in_queue_never_runs():
+    from delta_tpu.serve.admission import Request
+
+    before = obs.counter("server.deadline_exceeded").value
+    ctl = _controller(workers=1, max_queue=4)
+    try:
+        gate, fn = _blocker()
+        ctl.submit(Request(fn, "a", "op", None))
+        time.sleep(0.05)
+        ran = []
+        doomed = ctl.submit(Request(
+            lambda: ran.append(1), "a", "op",
+            deadline=time.monotonic() + 0.02))
+        time.sleep(0.1)  # budget expires while queued behind the blocker
+        gate.set()
+        assert doomed.wait(5)
+        assert isinstance(doomed.error, DeadlineExceededError)
+        assert not ran  # the work was never started
+        assert obs.counter("server.deadline_exceeded").value == before + 1
+    finally:
+        ctl.drain(0.5)
+
+
+def test_drain_answers_queued_requests():
+    from delta_tpu.serve.admission import Request
+
+    ctl = _controller(workers=1, max_queue=8)
+    gate, fn = _blocker()
+    running = ctl.submit(Request(fn, "a", "op", None))
+    time.sleep(0.05)
+    queued = [ctl.submit(Request(lambda: 1, "a", "op", None))
+              for _ in range(3)]
+    done = threading.Event()
+
+    def _release():
+        done.wait(5)
+        gate.set()
+
+    t = threading.Thread(target=_release, daemon=True)
+    t.start()
+    done.set()
+    ctl.drain(2.0)
+    # the running request finished; queued ones either ran inside the
+    # grace or were answered with a typed draining rejection — nothing
+    # is left hanging
+    assert running.wait(1) and running.result == "done"
+    for q in queued:
+        assert q.wait(1)
+        assert q.result == 1 or (
+            isinstance(q.error, ServiceOverloadedError)
+            and q.error.reason == "draining")
+    with pytest.raises(ServiceOverloadedError) as ei:
+        ctl.submit(Request(lambda: 1, "a", "op", None))
+    assert ei.value.reason == "draining"
+    t.join(timeout=5)
+
+
+# ------------------------------------------------------- serve e2e
+
+
+def test_serve_roundtrip_and_health():
+    eng, _store = _chaos_engine(seed=1)
+    srv = _serve(eng, workers=2, max_queue=8)
+    try:
+        host, port = srv.address
+        with connect(host, port) as c:
+            assert c.ping()
+            path = "memory://serve-t"
+            v = c.write_table(path, _batch(0, 20))
+            assert v == 0
+            out = c.read_table(path)
+            assert out.num_rows == 20
+            assert c.last_envelope.get("stale") is None
+            assert c.table_version(path) == 0
+            h = c.health()
+            assert h["admission"]["workers"] == 2
+            assert not h["draining"]
+            assert "breakers" in h
+            assert h["tables"][path]["version"] == 0
+            assert h["tables"][path]["age_ms"] is not None
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_serve_deadline_abandons_slow_chaos_load():
+    eng, store = _chaos_engine(
+        seed=3, sleep=time.sleep, error_rate=1.0,
+        latency_rate=1.0, latency_s=(0.05, 0.06))
+    srv = _serve(eng, workers=2, max_queue=8)
+    before = obs.counter("server.deadline_exceeded").value
+    try:
+        host, port = srv.address
+        path = "memory://serve-deadline"
+        dta.write_table(path, _batch(0, 10), engine=eng)
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10  # prime the cache
+            store.enabled = True  # storage now slow AND failing
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                c.read_table(path, deadline_ms=30)
+            # abandoned promptly: nowhere near the retry policy's own
+            # multi-second default budget
+            assert time.monotonic() - t0 < 5.0
+            assert obs.counter(
+                "server.deadline_exceeded").value == before + 1
+            # a deadline expiry is NOT converted to a stale answer
+            assert not c.last_envelope.get("stale")
+            store.enabled = False
+            resilience_reset()  # clear any breaker the chaos opened
+            assert c.read_table(path).num_rows == 10  # service recovered
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_serve_stale_when_storage_down():
+    eng, store = _chaos_engine(seed=5, error_rate=1.0)
+    srv = _serve(eng, workers=2, max_queue=8)
+    before = obs.counter("server.stale_served").value
+    try:
+        host, port = srv.address
+        path = "memory://serve-stale"
+        dta.write_table(path, _batch(0, 15), engine=eng)
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 15  # prime: version 0
+            store.enabled = True  # total storage outage
+            for _ in range(3):  # keeps working, explicitly stale
+                out = c.read_table(path)
+                assert out.num_rows == 15
+                env = c.last_envelope
+                assert env["stale"] is True
+                assert env["snapshot_version"] == 0
+                assert env["version"] == 0
+            assert obs.counter(
+                "server.stale_served").value >= before + 3
+            # version op degrades the same way
+            assert c.table_version(path) == 0
+            assert c.last_envelope["stale"] is True
+            # recovery: chaos off -> fresh, unmarked responses
+            store.enabled = False
+            resilience_reset()
+            assert c.read_table(path).num_rows == 15
+            assert c.last_envelope.get("stale") is None
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_serve_stale_never_lies_about_time_travel():
+    """An explicit version pin has no stale fallback: serving any other
+    version would be wrong, so the error surfaces."""
+    eng, store = _chaos_engine(seed=6, error_rate=1.0)
+    srv = _serve(eng, workers=1, max_queue=4)
+    try:
+        host, port = srv.address
+        path = "memory://serve-pin"
+        dta.write_table(path, _batch(0, 5), engine=eng)
+        dta.write_table(path, _batch(5, 5), engine=eng, mode="append")
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10
+            store.enabled = True
+            with pytest.raises(Exception) as ei:
+                c.read_table(path, version=0)
+            assert not isinstance(ei.value, DeadlineExceededError)
+            assert not c.last_envelope.get("ok")
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_serve_shed_surfaces_typed_overload():
+    # max_queue=0: every non-inline op sheds immediately
+    eng, _store = _chaos_engine(seed=7)
+    srv = _serve(eng, workers=1, max_queue=0)
+    try:
+        host, port = srv.address
+        with connect(host, port, reconnect=False) as c:
+            assert c.ping()  # inline ops bypass admission
+            assert c.health()["admission"]["queue_depth"] == 0
+            with pytest.raises(ServiceOverloadedError) as ei:
+                c.table_version("memory://nope")
+            assert ei.value.retry_after_ms >= 1
+            assert c.last_envelope["error_code"] == \
+                "DELTA_SERVICE_OVERLOADED"
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_serve_drain_no_request_dropped():
+    eng, store = _chaos_engine(
+        seed=9, sleep=time.sleep, latency_rate=1.0,
+        latency_s=(0.01, 0.02))
+    srv = _serve(eng, workers=2, max_queue=16)
+    host, port = srv.address
+    paths = [f"memory://serve-drain-{i}" for i in range(2)]
+    for p in paths:
+        dta.write_table(p, _batch(0, 10), engine=eng)
+    store.enabled = True  # every load now takes 10-20ms per storage op
+    outcomes = []
+    lock = threading.Lock()
+
+    def client(i):
+        try:
+            with connect(host, port, reconnect=False) as c:
+                for k in range(20):
+                    try:
+                        c.read_table(paths[(i + k) % 2])
+                        res = "ok"
+                    except (ServiceOverloadedError,
+                            DeadlineExceededError) as e:
+                        res = type(e).__name__
+                    with lock:
+                        outcomes.append(res)
+        except (ConnectionError, OSError):
+            pass  # connection closed after drain: no request in flight
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # let requests pile up mid-flight
+    srv.shutdown(5.0)
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "client hung across drain"
+    # every outcome recorded before/through the drain is a success or a
+    # typed rejection — never a half-written reply or a silent drop
+    assert outcomes
+    assert set(outcomes) <= {"ok", "ServiceOverloadedError",
+                             "DeadlineExceededError"}
+    assert "ok" in outcomes
+
+
+# ------------------------------------------- protocol regressions
+
+
+def _raw_frame(sock, body: bytes, payload: bytes = b""):
+    sock.sendall(struct.pack("<II", len(body), len(payload))
+                 + body + payload)
+
+
+def _recv_reply(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("closed")
+        hdr += chunk
+    jlen, plen = struct.unpack("<II", hdr)
+    body = b""
+    while len(body) < jlen + plen:
+        chunk = sock.recv(jlen + plen - len(body))
+        if not chunk:
+            raise ConnectionError("closed")
+        body += chunk
+    import json
+
+    return json.loads(body[:jlen])
+
+
+@pytest.mark.parametrize("server_kind", ["connect", "serve"])
+def test_garbage_frame_gets_typed_error_then_close(server_kind, tmp_path):
+    """Regression: a frame whose envelope is not valid JSON used to
+    kill the handler thread with no reply, leaving the client hanging
+    on a desynchronized stream. Both servers must answer with a typed
+    protocol error and close cleanly."""
+    if server_kind == "connect":
+        srv = DeltaConnectServer("127.0.0.1", 0,
+                                 allowed_root=str(tmp_path))
+        srv.start_background()
+        stop = srv.stop
+    else:
+        eng, _store = _chaos_engine(seed=11)
+        srv = _serve(eng, workers=1, max_queue=4)
+        stop = lambda: srv.shutdown(1.0)  # noqa: E731
+    try:
+        host, port = srv.address
+        s = socket.create_connection((host, port), timeout=5)
+        _raw_frame(s, b'{"op": "ping" oops not json')
+        env = _recv_reply(s)
+        assert env["ok"] is False
+        assert env["error_class"] == "ConnectProtocolError"
+        assert env["error_code"] == "DELTA_CONNECT_PROTOCOL_ERROR"
+        # the server closed its side: next read is EOF, not a hang
+        s.settimeout(5)
+        assert s.recv(1) == b""
+        s.close()
+        # and the server survived to serve well-formed clients
+        with connect(host, port) as c:
+            assert c.ping()
+    finally:
+        stop()
+
+
+def test_client_reconnects_after_socket_loss():
+    eng, _store = _chaos_engine(seed=13)
+    srv = _serve(eng, workers=1, max_queue=4)
+    try:
+        host, port = srv.address
+        c = connect(host, port)  # reconnect=True default
+        assert c.ping()
+        c._sock.close()  # simulate the connection dying under us
+        assert c.ping()  # transparently re-established
+        c.close()
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_client_hedged_read():
+    eng, store = _chaos_engine(
+        seed=15, sleep=time.sleep, latency_rate=1.0,
+        latency_s=(0.02, 0.03))
+    srv = _serve(eng, workers=4, max_queue=16)
+    try:
+        host, port = srv.address
+        path = "memory://serve-hedge"
+        dta.write_table(path, _batch(0, 12), engine=eng)
+        store.enabled = True
+        with connect(host, port, hedge_ms=10.0) as c:
+            for _ in range(3):
+                assert c.read_table(path).num_rows == 12
+    finally:
+        srv.shutdown(1.0)
+
+
+# ------------------------------------------------------- chaos soak
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(20))
+def test_serve_chaos_qps_soak(seed):
+    """8 clients x 4 tables against a seeded ChaosStore: the service
+    must neither crash nor hang, and every response must be a success,
+    an explicitly-stale success, or a typed shed/deadline error."""
+    eng, store = _chaos_engine(
+        seed=100 + seed, error_rate=0.15, stale_list_rate=0.05)
+    srv = _serve(eng, workers=3, max_queue=6, tenant_concurrency=2)
+    host, port = srv.address
+    paths = [f"memory://soak-{seed}-{i}" for i in range(4)]
+    for i, p in enumerate(paths):
+        dta.write_table(p, _batch(0, 10 + i), engine=eng)
+    store.enabled = True
+    counts = {"ok": 0, "stale": 0, "shed": 0, "deadline": 0}
+    unexpected = []
+    lock = threading.Lock()
+
+    def client(ci):
+        try:
+            with connect(host, port, tenant=f"t{ci % 4}",
+                         reconnect=False) as c:
+                for k in range(8):
+                    p = paths[(ci + k) % 4]
+                    try:
+                        if k % 3 == 2:
+                            c.table_version(p)
+                        else:
+                            c.read_table(p)
+                        kind = ("stale" if c.last_envelope.get("stale")
+                                else "ok")
+                    except ServiceOverloadedError:
+                        kind = "shed"
+                    except DeadlineExceededError:
+                        kind = "deadline"
+                    except Exception as e:  # anything else fails the soak
+                        kind = None
+                        with lock:
+                            unexpected.append(
+                                f"{type(e).__name__}: {e}")
+                    if kind:
+                        with lock:
+                            counts[kind] += 1
+        except Exception as e:
+            with lock:
+                unexpected.append(f"conn: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(8)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), f"seed {seed}: client hung"
+    elapsed = time.monotonic() - t0
+    try:
+        assert not unexpected, f"seed {seed}: {unexpected[:5]}"
+        total = sum(counts.values())
+        assert total == 8 * 8
+        assert counts["ok"] + counts["stale"] > 0
+        assert elapsed < 60
+    finally:
+        srv.shutdown(1.0)

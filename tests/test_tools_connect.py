@@ -11,7 +11,6 @@ import pytest
 
 import delta_tpu.api as dta
 from delta_tpu.connect import DeltaConnectServer, connect
-from delta_tpu.connect.client import RemoteDeltaError
 from delta_tpu.errors import DeltaError
 from delta_tpu.table import Table
 from delta_tpu.tools.importer import import_into_delta, main as import_main
@@ -128,11 +127,15 @@ def test_connect_sql_and_errors(server, tmp_path):
         c.write_table(path, pa.table({"id": pa.array([1, 2, 3], pa.int64())}))
         out = c.sql(f"SELECT id FROM '{path}' WHERE id > 1")
         assert sorted(out.column("id").to_pylist()) == [2, 3]
-        with pytest.raises(RemoteDeltaError, match="cannot parse"):
+        # error envelopes re-raise the server's exception type
+        from delta_tpu.errors import ConnectProtocolError, SqlParseError
+
+        with pytest.raises(SqlParseError, match="cannot parse"):
             c.sql("FLY TO THE MOON")
         # connection survives the error
         assert c.ping()
-        with pytest.raises(RemoteDeltaError, match="outside the served root"):
+        with pytest.raises(ConnectProtocolError,
+                           match="outside the served root"):
             c.read_table("/etc/passwd-table")
 
 
