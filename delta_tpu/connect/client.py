@@ -29,8 +29,11 @@ the plain connect server):
   not answered within the hedge budget fires a duplicate on a fresh
   connection and takes whichever finishes first (tail-latency
   insurance during chaos; costs at most one duplicate read).
-- ``last_envelope`` exposes the most recent reply envelope so callers
-  can observe the serve layer's ``stale: true`` degradation marker.
+- ``last_envelope`` exposes the envelope of the most recent reply whose
+  outcome was actually surfaced to the caller — the winning attempt of
+  a hedged read (never the abandoned one) or the error envelope of the
+  exception that propagated — so callers can observe the serve layer's
+  ``stale: true`` degradation marker and error metadata.
 """
 
 from __future__ import annotations
@@ -162,9 +165,14 @@ class DeltaConnectClient:
                         _log.debug("socket close after failure: %s", e)
                     self._sock = None
                     raise
-        self.last_envelope = envelope
+        # last_envelope is assigned in _call from the outcome actually
+        # surfaced to the caller — never here, so the losing side of a
+        # hedged read can't clobber the winner's stale/fresh marker.
+        # The envelope rides on the exception for the error path.
         if not envelope.get("ok"):
-            raise _remote_exception(envelope)
+            exc = _remote_exception(envelope)
+            exc.envelope = envelope
+            raise exc
         return envelope, out_payload
 
     def _hedged(self, op: str, payload: bytes, params: dict):
@@ -208,15 +216,28 @@ class DeltaConnectClient:
         if self._deadline_ms is not None:
             params.setdefault("deadline_ms", self._deadline_ms)
         idempotent = op in _IDEMPOTENT
-        if idempotent and self._hedge_ms > 0:
-            return self._hedged(op, payload, params)
-        if idempotent and self._policy is not None:
-            # ConnectionError (socket died → reconnect) and
-            # ServiceOverloadedError (shed before any work) are both
-            # transient; the policy backs off with decorrelated jitter.
-            return self._policy.call(
-                lambda: self._roundtrip(op, payload, params))
-        return self._roundtrip(op, payload, params)
+        try:
+            if idempotent and self._hedge_ms > 0:
+                envelope, out_payload = self._hedged(op, payload, params)
+            elif idempotent and self._policy is not None:
+                # ConnectionError (socket died → reconnect) and
+                # ServiceOverloadedError (shed before any work) are both
+                # transient; the policy backs off with decorrelated
+                # jitter.
+                envelope, out_payload = self._policy.call(
+                    lambda: self._roundtrip(op, payload, params))
+            else:
+                envelope, out_payload = self._roundtrip(op, payload, params)
+        except Exception as e:
+            # Record the error envelope only when this exception is the
+            # one the caller sees (an abandoned hedge attempt's error
+            # never reaches this frame). Transport errors carry none.
+            err_env = getattr(e, "envelope", None)
+            if err_env is not None:
+                self.last_envelope = err_env
+            raise
+        self.last_envelope = envelope
+        return envelope, out_payload
 
     def close(self) -> None:
         with self._lock:

@@ -59,12 +59,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 except OSError as send_err:
                     _log.debug("protocol-error notify failed: %s", send_err)
                 return
+            # Dispatch in its own try: ANY operation failure — including
+            # OSError subclasses like FileNotFoundError from a missing
+            # data file — must become an error envelope, or the client
+            # sees a bare connection drop and retry-loops a permanent
+            # server-side error. Silent close is reserved for failures
+            # of the send itself (peer gone / stream mid-frame).
             try:
                 result, out_payload = self.server._dispatch(envelope, payload)
-                send_frame(self.request, {"ok": True, **(result or {})},
-                           out_payload)
-            except (ConnectionError, OSError):
-                return  # reply could not be delivered; peer is gone
             except Exception as e:  # error envelope, keep connection alive
                 env = {
                     "ok": False,
@@ -87,6 +89,18 @@ class _Handler(socketserver.BaseRequestHandler):
                     _log.debug("error reply failed (%s): %s",
                                type(send_err).__name__, send_err)
                     return
+                continue
+            try:
+                send_frame(self.request, {"ok": True, **(result or {})},
+                           out_payload)
+            except (ConnectionError, OSError):
+                return  # reply could not be delivered; peer is gone
+            except Exception as send_err:
+                # Serialization died mid-frame: a partial header may be
+                # on the wire, so closing is the only safe recovery.
+                _log.debug("reply failed (%s): %s",
+                           type(send_err).__name__, send_err)
+                return
 
 
 class DeltaConnectServer(socketserver.ThreadingTCPServer):

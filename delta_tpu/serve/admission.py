@@ -54,6 +54,12 @@ _DEADLINE_EXCEEDED = obs.counter("server.deadline_exceeded")
 _QUEUE_WAIT_NS = obs.counter("server.queue_wait_ns")
 _DRAINED = obs.counter("server.drained")
 
+# How often `submit` sweeps `_tenants` for evictable idle entries. The
+# sweep is what bounds memory under churning/adversarial tenant names:
+# completion-time eviction alone never fires for tenants whose every
+# request was shed at admission.
+_TENANT_SWEEP_INTERVAL_S = 5.0
+
 
 class TokenBucket:
     """Classic token bucket: ``rate`` tokens/second up to ``burst``.
@@ -81,6 +87,17 @@ class TokenBucket:
                 return True, 0.0
             need = 1.0 - self._tokens
             return False, need / self.rate if self.rate > 0 else 1.0
+
+    def replenished(self) -> bool:
+        """True once the bucket has refilled to full burst: dropping and
+        later recreating it is then indistinguishable from keeping it,
+        which is the safety condition for evicting an idle tenant."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._stamp) * self.rate)
+            self._stamp = now
+            return self._tokens >= self.burst
 
 
 class _Tenant:
@@ -141,6 +158,7 @@ class AdmissionController:
         self._draining = False
         self._stopped = False
         self._workers = []
+        self._next_tenant_sweep = clock() + _TENANT_SWEEP_INTERVAL_S
         self.shed_counts: Dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------
@@ -187,6 +205,25 @@ class AdmissionController:
         self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
         obs.add_event("server.shed", reason=reason)
 
+    def _tenant_evictable(self, tenant: _Tenant) -> bool:
+        """Caller holds the controller lock. A tenant can be dropped
+        once it has nothing in flight and its rate bucket (if any) has
+        refilled — recreating it later yields identical behaviour, so
+        eviction cannot be used to bypass rate limiting."""
+        return tenant.active <= 0 and (
+            tenant.bucket is None or tenant.bucket.replenished())
+
+    def _sweep_tenants(self, now: float) -> None:
+        """Caller holds the controller lock. Periodically drop idle
+        tenant entries so churning (or adversarial) tenant names cannot
+        grow `_tenants` without bound on a long-lived server."""
+        if now < self._next_tenant_sweep:
+            return
+        self._next_tenant_sweep = now + _TENANT_SWEEP_INTERVAL_S
+        for name in [name for name, t in self._tenants.items()
+                     if self._tenant_evictable(t)]:
+            del self._tenants[name]
+
     def submit(self, req: Request) -> Request:
         """Admit ``req`` or raise :class:`ServiceOverloadedError`.
         Never blocks: every rejection path is decided immediately."""
@@ -197,6 +234,7 @@ class AdmissionController:
                 raise ServiceOverloadedError(
                     "server is draining; not accepting work",
                     retry_after_ms=1000, reason="draining")
+            self._sweep_tenants(self._clock())
             tenant = self._tenants.get(req.tenant)
             if tenant is None:
                 tenant = self._tenants[req.tenant] = _Tenant(
@@ -255,6 +293,8 @@ class AdmissionController:
                     tenant = self._tenants.get(req.tenant)
                     if tenant is not None:
                         tenant.active -= 1
+                        if self._tenant_evictable(tenant):
+                            del self._tenants[req.tenant]
                     self._work.notify()
 
     def _execute(self, req: Request) -> None:

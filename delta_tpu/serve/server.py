@@ -191,7 +191,16 @@ class DeltaServeServer:
                         "error_code": "DELTA_CONNECT_PROTOCOL_ERROR",
                     })
                     return
-                if not self._serve_one(conn, envelope, payload):
+                try:
+                    ok = self._serve_one(conn, envelope, payload)
+                except Exception as e:
+                    # Belt-and-suspenders for the "no request is ever
+                    # dropped without a response" contract: a bug (or a
+                    # hostile envelope) must answer typed, not kill the
+                    # reader and silently close the connection.
+                    _log.warning("unexpected error serving request: %s", e)
+                    ok = self._try_send(conn, _error_envelope(e))
+                if not ok:
                     return
         finally:
             with self._conn_lock:
@@ -214,7 +223,20 @@ class DeltaServeServer:
         budget_ms = envelope.get("deadline_ms") \
             or self.config.default_deadline_ms or None
         if budget_ms:
-            deadline = time.monotonic() + float(budget_ms) / 1000.0
+            # The envelope is untrusted: a non-numeric deadline_ms must
+            # get a typed protocol error, not crash the reader. Framing
+            # is still in sync (the JSON parsed), so keep the connection.
+            try:
+                deadline = time.monotonic() + float(budget_ms) / 1000.0
+            except (TypeError, ValueError):
+                _PROTOCOL_ERRORS.inc()
+                return self._try_send(conn, {
+                    "ok": False,
+                    "error": "deadline_ms must be a number, "
+                             f"got {budget_ms!r}",
+                    "error_class": "ConnectProtocolError",
+                    "error_code": "DELTA_CONNECT_PROTOCOL_ERROR",
+                })
         req = Request(
             fn=lambda: self.dispatcher.dispatch(envelope, payload),
             tenant=str(envelope.get("tenant") or "default"),

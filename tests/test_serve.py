@@ -147,6 +147,48 @@ def test_tenant_rate_limit_sheds_with_hint():
         ctl.drain(0.5)
 
 
+def test_idle_tenants_evicted():
+    """Regression: `_tenants` used to grow one entry per distinct
+    tenant string forever. Idle tenants are dropped once nothing is in
+    flight and the rate bucket has refilled (so eviction can't be used
+    to bypass rate limiting)."""
+    from delta_tpu.serve.admission import Request
+
+    now = [1000.0]
+    ctl = AdmissionController(
+        ServeConfig.from_env(workers=1, max_queue=8, drain_grace_s=5.0,
+                             tenant_rate=1.0, tenant_burst=1.0),
+        clock=lambda: now[0]).start()
+    try:
+        done = ctl.submit(Request(lambda: 1, "x", "op", None))
+        assert done.wait(5)
+        time.sleep(0.05)  # let the worker's finally block run
+        # bucket is empty (one token taken, fake clock frozen): the
+        # tenant must survive completion or its limit would reset
+        with ctl._lock:
+            assert "x" in ctl._tenants
+        # bucket refilled + sweep interval elapsed: the next submit's
+        # periodic sweep drops the idle entry
+        now[0] += 30.0
+        other = ctl.submit(Request(lambda: 1, "y", "op", None))
+        assert other.wait(5)
+        with ctl._lock:
+            assert "x" not in ctl._tenants
+    finally:
+        ctl.drain(0.5)
+    # without a rate bucket there is nothing to preserve: the entry is
+    # dropped the moment its last request completes
+    ctl2 = _controller(workers=1, max_queue=8)
+    try:
+        done = ctl2.submit(Request(lambda: 1, "z", "op", None))
+        assert done.wait(5)
+        time.sleep(0.05)  # let the worker's finally block run
+        with ctl2._lock:
+            assert "z" not in ctl2._tenants
+    finally:
+        ctl2.drain(0.5)
+
+
 def test_deadline_expired_in_queue_never_runs():
     from delta_tpu.serve.admission import Request
 
@@ -436,6 +478,52 @@ def test_garbage_frame_gets_typed_error_then_close(server_kind, tmp_path):
             assert c.ping()
     finally:
         stop()
+
+
+@pytest.mark.parametrize("bad", ['"soon"', '[1, 2]', '{"ms": 5}'])
+def test_bad_deadline_type_answers_typed_and_keeps_connection(bad):
+    """Regression: a non-numeric ``deadline_ms`` in an otherwise valid
+    envelope used to raise out of the reader thread, closing the
+    connection with no reply. Framing is still in sync, so the server
+    must answer a typed protocol error and keep serving."""
+    eng, _store = _chaos_engine(seed=19)
+    srv = _serve(eng, workers=1, max_queue=4)
+    try:
+        host, port = srv.address
+        s = socket.create_connection((host, port), timeout=5)
+        _raw_frame(s, ('{"op": "version", "path": "memory://nope", '
+                       f'"deadline_ms": {bad}}}').encode())
+        env = _recv_reply(s)
+        assert env["ok"] is False
+        assert env["error_class"] == "ConnectProtocolError"
+        assert env["error_code"] == "DELTA_CONNECT_PROTOCOL_ERROR"
+        # same connection still serves well-formed requests
+        _raw_frame(s, b'{"op": "ping"}')
+        assert _recv_reply(s)["pong"] is True
+        s.close()
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_last_envelope_only_set_by_surfaced_outcome():
+    """Regression: every `_roundtrip` used to write `last_envelope`,
+    so the LOSING side of a hedged read finishing late could clobber
+    the stale/fresh marker of the reply the caller actually received.
+    Only `_call` assigns it now, from the surfaced outcome."""
+    eng, _store = _chaos_engine(seed=17)
+    srv = _serve(eng, workers=1, max_queue=4)
+    try:
+        host, port = srv.address
+        with connect(host, port, reconnect=False) as c:
+            assert c.ping()
+            winner = c.last_envelope
+            assert winner["pong"] is True
+            # a straggling attempt completing out-of-band (what an
+            # abandoned hedge is) must not touch last_envelope
+            c._roundtrip("ping", b"", {})
+            assert c.last_envelope is winner
+    finally:
+        srv.shutdown(1.0)
 
 
 def test_client_reconnects_after_socket_loss():

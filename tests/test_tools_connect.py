@@ -139,6 +139,31 @@ def test_connect_sql_and_errors(server, tmp_path):
             c.read_table("/etc/passwd-table")
 
 
+def test_connect_oserror_in_dispatch_gets_error_envelope(server, tmp_path):
+    """Regression: an OSError raised by the OPERATION (here a
+    FileNotFoundError from a table whose data file vanished) used to be
+    swallowed by the send-failure handler, closing the connection with
+    no reply — so clients retry-looped a permanent server-side error.
+    It must surface as an error envelope and the connection survive."""
+    import glob
+
+    host, port = server.address
+    path = str(tmp_path / "t")
+    with connect(host, port, reconnect=False) as c:
+        c.write_table(path, pa.table({"id": pa.array([1, 2, 3], pa.int64())}))
+        assert c.read_table(path).num_rows == 3
+        for f in glob.glob(os.path.join(path, "**", "*.parquet"),
+                           recursive=True):
+            os.remove(f)
+        with pytest.raises(DeltaError) as ei:
+            c.read_table(path)
+        # a typed envelope, not a bare connection drop
+        assert "FileNotFoundError" in getattr(
+            ei.value, "error_class", type(ei.value).__name__)
+        # the connection is still alive and serving
+        assert c.ping()
+
+
 def test_connect_time_travel_and_optimize(server, tmp_path):
     host, port = server.address
     path = str(tmp_path / "t")
