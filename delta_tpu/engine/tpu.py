@@ -85,10 +85,43 @@ class TpuEngine(HostEngine):
         from delta_tpu.expressions.device_eval import DeviceExpressionHandler
 
         self.expressions = DeviceExpressionHandler()
+        # An explicitly supplied mesh (or shard count) carries intent:
+        # the profitability gate must not demote it to single-chip on
+        # small tables (tests shard 1k-row logs on purpose).
+        self._mesh_forced = mesh is not None or (replay_shards or 0) > 1
+        if mesh is None:
+            mesh = _default_mesh(replay_shards)
         self.mesh = mesh
         self.replay_shards = replay_shards
         self.use_device_page_decode = (
             os.environ.get("DELTA_TPU_DEVICE_PAGE_DECODE") == "1")
+
+
+def _default_mesh(replay_shards: Optional[int]):
+    """Sharded replay is the product default whenever >1 device is
+    visible. DELTA_TPU_REPLAY_SHARDS overrides the shard count; "0" or
+    "1" disables sharding entirely."""
+    env = os.environ.get("DELTA_TPU_REPLAY_SHARDS")
+    if env is not None:
+        replay_shards = int(env)
+    if replay_shards is not None and replay_shards <= 1:
+        return None
+    try:
+        import jax
+
+        n = len(jax.devices())
+    # delta-lint: disable=except-swallow (audited: device discovery can
+    # fail on misconfigured hosts; engine construction must survive and
+    # fall back to the single-chip path)
+    except Exception:
+        return None
+    if replay_shards is not None:
+        n = min(n, replay_shards)
+    if n <= 1:
+        return None
+    from delta_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_devices=n)
 
 
 def default_engine(**kwargs) -> TpuEngine:

@@ -30,11 +30,13 @@ vectorizes. The TPU-native formulation used here:
    aren't first-appearance-coded (verified host-side with two cheap
    vector passes) fall back to shipping the combined code lane as the
    minimum number of little-endian byte planes that hold its range.
-3. One `lax.sort` by (key, payload) where payload = `(chrono_rank << 1)
-   | is_add` — two operands total, both sort keys. After the sort every
+3. One `lax.sort` by (key, chrono_rank) — two operands total, both
+   sort keys, and the rank is a device-side iota. After the sort every
    logical file's history is a contiguous run in chronological order;
    the run-boundary mask `key[i] != key[i+1]` marks the newest action
-   per key. No loops, no hash table.
+   per key. No loops, no hash table. The add/remove bit never ships:
+   the iota is already unique, so the bit cannot change any winner, and
+   the host keeps its own packed copy for the live/tombstone split.
 4. One scatter puts the per-run winner mask back in input order; the
    winner bits ship home packed (32× smaller D2H) and the host — which
    already holds `is_add` — splits winners into live (`winner & add`)
@@ -61,8 +63,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from delta_tpu import obs
+
 _PAD_KEY = np.uint32(0xFFFFFFFF)
 _MIN_BUCKET = 1024
+
+# Bytes of replay operands shipped host->device. The residency tests
+# and the bench artifact read this to prove incremental updates ship
+# only delta rows (never the 10M-row base state).
+_H2D_BYTES = obs.counter("replay.h2d_bytes")
 
 
 _FINE_PAD_START = 1 << 20  # above this, pad linearly instead of to pow2
@@ -171,23 +180,24 @@ def _decode_planes(planes) -> jax.Array:
     return key
 
 
-def _sort_winner_pack(lanes, n_real, is_add_bits) -> jax.Array:
-    """Shared tail of both kernels: sort by (key..., payload) where
-    payload = (iota << 1) | is_add — the iota is the chronological rank
-    (callers permute first if their rows aren't already chronological)
-    and the add bit rides along for free. Marks per-run winners in
-    sorted order, scatters the single winner mask back to input order,
-    and bit-packs it. Padding rows (idx >= n_real) sort after the real
-    rows of any run they share a key with (their iota is larger), so the
-    winner of a run is its last *valid* row — a real row whose key
-    happens to equal the all-ones pad sentinel is never swallowed by
-    padding."""
+def _sort_winner_pack(lanes, n_real) -> jax.Array:
+    """Shared tail of both kernels: sort by (key..., iota) where the
+    iota is the chronological rank (callers permute first if their rows
+    aren't already chronological). Marks per-run winners in sorted
+    order, scatters the single winner mask back to input order, and
+    bit-packs it. The iota is unique, so no extra tiebreaker lane can
+    ever change a winner — in particular the add/remove bit stays home
+    (the r05 regression shipped it per-row and widened the payload for
+    nothing). Padding rows (idx >= n_real) sort after the real rows of
+    any run they share a key with (their iota is larger), so the winner
+    of a run is its last *valid* row — a real row whose key happens to
+    equal the all-ones pad sentinel is never swallowed by padding."""
     m = lanes[0].shape[0]
-    payload = (jnp.arange(m, dtype=jnp.uint32) << 1) | is_add_bits
+    payload = jnp.arange(m, dtype=jnp.uint32)
     sorted_ = lax.sort((*lanes, payload), num_keys=len(lanes) + 1,
                        is_stable=False)
     s_lanes, s_payload = sorted_[:-1], sorted_[-1]
-    s_idx = (s_payload >> 1).astype(jnp.int32)
+    s_idx = s_payload.astype(jnp.int32)
     s_valid = s_idx < n_real
 
     same_as_next = jnp.ones((m - 1,), dtype=bool)
@@ -207,10 +217,10 @@ def _sort_winner_pack(lanes, n_real, is_add_bits) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("width",))
 def _winner_kernel(operands, width: int) -> jax.Array:
     """Full-key path. operands = (*key_planes[u8, m] | *key_lanes[u32, m],
-    n_real[i32], add_words[u32, m/32]) -> winner_words[u32, m/32]."""
-    *key_ops, n_real, add_words = operands
+    n_real[i32]) -> winner_words[u32, m/32]."""
+    *key_ops, n_real = operands
     lanes = (_decode_planes(key_ops),) if width else tuple(key_ops)
-    return _sort_winner_pack(lanes, n_real, _unpack_bits_device(add_words))
+    return _sort_winner_pack(lanes, n_real)
 
 
 def _bitcast_u32(b: jax.Array) -> jax.Array:
@@ -221,10 +231,10 @@ def _bitcast_u32(b: jax.Array) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("layout",))
 def _winner_kernel_fa_packed(buf, layout) -> jax.Array:
     """Single-transfer variant of `_winner_kernel_fa`: every operand —
-    n_real, sub_radix, flag words, ref planes, the sparse DV lane, add
-    words — rides in ONE uint8 buffer and is sliced out on device. Over
-    a high-latency host<->device link (the tunnel pays ~120ms per
-    transfer), one H2D beats seven.
+    n_real, sub_radix, flag words, ref planes, the sparse DV lane —
+    rides in ONE uint8 buffer and is sliced out on device. Over a
+    high-latency host<->device link (the tunnel pays ~120ms per
+    transfer), one H2D beats six.
 
     layout = (m, ref_width, r_pad, d_pad) — all bucket-padded statics."""
     m, ref_width, r_pad, d_pad = layout
@@ -247,7 +257,6 @@ def _winner_kernel_fa_packed(buf, layout) -> jax.Array:
     if has_sub:
         sub_idx = _bitcast_u32(take(d_pad * 4))
         sub_val = _bitcast_u32(take(d_pad * 4))
-    add_words = _bitcast_u32(take(m // 32 * 4))
 
     is_new = _unpack_bits_device(flag_words)
     new_rank = jnp.cumsum(is_new.astype(jnp.int32))
@@ -262,7 +271,7 @@ def _winner_kernel_fa_packed(buf, layout) -> jax.Array:
         key = key * sub_radix + sub
     iota = jnp.arange(m, dtype=jnp.int32)
     key = jnp.where(iota < n_real, key, jnp.uint32(0xFFFFFFFF))
-    return _sort_winner_pack((key,), n_real, _unpack_bits_device(add_words))
+    return _sort_winner_pack((key,), n_real)
 
 
 def _pack_fa_operands(fa: "_FAEncoding", n: int) -> tuple[np.ndarray, tuple]:
@@ -280,6 +289,34 @@ def _pack_fa_operands(fa: "_FAEncoding", n: int) -> tuple[np.ndarray, tuple]:
     if d_pad:
         parts += [fa.sub_idx.view(np.uint8), fa.sub_val.view(np.uint8)]
     return parts, (m, len(fa.ref_planes), r_pad, d_pad)
+
+
+@functools.lru_cache(maxsize=16)
+def _concat_chunks_jit(k: int):
+    return jax.jit(lambda *chunks: jnp.concatenate(chunks))
+
+
+def _put_chunked(buf: np.ndarray, device):
+    """device_put that rides the fast H2D bandwidth bucket: the link
+    model (parallel/gate.py) says large transfers collapse to ~29 MB/s
+    while <=8 MB chunks sustain ~1 GB/s, so a buffer bigger than the
+    fast-bucket size ships as fixed-size chunks and is reassembled by a
+    jit'd concatenate. The trailing zero-pad past `buf.nbytes` is never
+    read — the packed kernel slices at static offsets that end at the
+    real layout length. Disabled (plain device_put) when the model has
+    no bandwidth cliff (CPU backends) or the buffer already fits one
+    chunk."""
+    from delta_tpu.parallel import gate
+
+    chunk = gate.link_model().chunk_bytes()
+    if not chunk or buf.nbytes <= chunk:
+        return jax.device_put(buf, device)
+    k = -(-buf.nbytes // chunk)
+    padded = np.zeros(k * chunk, np.uint8)
+    padded[:buf.nbytes] = buf
+    pieces = [jax.device_put(padded[i * chunk:(i + 1) * chunk], device)
+              for i in range(k)]
+    return _concat_chunks_jit(k)(*pieces)
 
 
 class _FAEncoding(NamedTuple):
@@ -530,9 +567,9 @@ def replay_select_launch(
     n_op = np.asarray(n, dtype=np.int32)
     if fa is not None:
         parts, layout = _pack_fa_operands(fa, n)
-        buf = np.concatenate(parts + [add_words_np.view(np.uint8)])
-        if device is not None:
-            buf = jax.device_put(buf, device)
+        buf = np.concatenate(parts)
+        _H2D_BYTES.inc(buf.nbytes)
+        buf = _put_chunked(buf, device)
         winner_words = _winner_kernel_fa_packed(buf, layout)
     else:
         combined = combine_key_lanes(lanes)
@@ -547,7 +584,8 @@ def replay_select_launch(
                      np.full(pad, _PAD_KEY, np.uint32)])
                     if pad else np.asarray(k, np.uint32))
                 for k in lanes)
-        operands = (*key_ops, n_op, add_words_np)
+        operands = (*key_ops, n_op)
+        _H2D_BYTES.inc(sum(int(o.nbytes) for o in key_ops))
         if device is not None:
             operands = tuple(jax.device_put(o, device) for o in operands)
         winner_words = _winner_kernel(operands, width=width)

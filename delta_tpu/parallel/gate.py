@@ -1,0 +1,193 @@
+"""DEVICE_MERIT-derived profitability gate for the replay product path.
+
+The replay driver has three routes — host-vectorized, single-chip
+kernel, and mesh-sharded (`parallel/sharded_replay.py`) — and the right
+one depends on the *link*, not the compute: DEVICE_MERIT.json measured
+the bench host's host<->device path at ~1.05 GB/s for <=8 MB transfers
+but only ~29 MB/s beyond, with a 78 ms round trip. This module turns
+those measurements into the routing decision instead of hardcoded row
+counts:
+
+- tiny segments are RTT-dominated -> host replay beats any device
+  dispatch;
+- mid-size segments -> single-chip kernel, with H2D transfers chunked
+  to the fast-bucket size (`LinkModel.chunk_bytes`);
+- large segments on a >1-device mesh -> sharded replay, where per-shard
+  state residency (parallel/resident.py) amortizes the link cost across
+  `Snapshot.update()` calls.
+
+The model is loaded from DEVICE_MERIT.json at the repo root when the
+default JAX backend is an accelerator; on CPU backends (tests, dev
+boxes) transfers are memcpys and the model collapses to "device always
+profitable" so behavior is deterministic. Env overrides:
+
+  DELTA_TPU_REPLAY_ROUTE       force "host" | "single" | "sharded"
+  DELTA_TPU_SHARDED_MIN_ROWS   row floor for the sharded route
+  DELTA_TPU_LINK_MODEL         path to an alternative DEVICE_MERIT json
+  DELTA_TPU_LINK_H2D_BPS       flat H2D bandwidth override (bytes/s)
+  DELTA_TPU_LINK_RTT_S         round-trip override (seconds)
+  DELTA_TPU_H2D_CHUNK          transfer chunk size override (bytes)
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from pathlib import Path
+from typing import NamedTuple, Optional
+
+# Fallbacks when no DEVICE_MERIT.json is available (same shape as the
+# bench host's measurements so the gate degrades to sane behavior).
+_FALLBACK_H2D = {8 << 20: 1_050_000_000.0, 64 << 20: 29_000_000.0}
+_FALLBACK_RTT_S = 0.078
+# replay_fa workload calibration fallbacks: host-vectorized replay rate
+# and device compute rate (rows/s) when the json carries no workloads.
+_FALLBACK_HOST_ROWS_S = 17e6
+_FALLBACK_DEVICE_ROWS_S = 170e6
+
+# Sharding below this many rows never pays on a single host: the host
+# routing pass (stable shard argsort) costs more than the per-shard sort
+# saving. Overridable; the sharded tests force it down to exercise the
+# mesh on tiny logs, bench artifacts record where the real crossover is.
+DEFAULT_SHARDED_MIN_ROWS = 4_000_000
+
+# FA delta coding ships ~2 bits/row of flags plus byte-packed refs for
+# the non-new minority — ~4 rows/byte is the planning estimate.
+_FA_BYTES_PER_ROW = 0.25
+
+
+class LinkModel(NamedTuple):
+    """Host<->device link + replay-rate model used for routing."""
+
+    h2d_bps: dict          # {transfer_size_bytes: bytes_per_s}
+    rtt_s: float
+    host_rows_per_s: float
+    device_rows_per_s: float
+
+    def chunk_bytes(self) -> int:
+        """Largest transfer size that still rides the fastest measured
+        bandwidth bucket — the H2D chunking quantum."""
+        override = os.environ.get("DELTA_TPU_H2D_CHUNK")
+        if override:
+            return int(override)
+        if not self.h2d_bps:
+            return 0
+        return int(max(self.h2d_bps, key=lambda sz: self.h2d_bps[sz]))
+
+    def h2d_seconds(self, nbytes: int) -> float:
+        """Predicted H2D time for `nbytes` shipped in fast-bucket
+        chunks (one RTT per dispatch, amortized bandwidth after)."""
+        if nbytes <= 0 or not self.h2d_bps:
+            return 0.0
+        chunk = self.chunk_bytes()
+        bps = self.h2d_bps.get(chunk, max(self.h2d_bps.values()))
+        return self.rtt_s + nbytes / max(bps, 1.0)
+
+
+_CPU_MODEL = LinkModel({}, 0.0, _FALLBACK_HOST_ROWS_S, float("inf"))
+
+
+def _device_platform() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    # delta-lint: disable=except-swallow (audited: backend discovery can
+    # fail on hosts with no configured platform; the gate must degrade
+    # to the CPU model, never fail routing)
+    except Exception:
+        return "cpu"
+
+
+def _model_path() -> Optional[Path]:
+    override = os.environ.get("DELTA_TPU_LINK_MODEL")
+    if override:
+        return Path(override)
+    p = Path(__file__).resolve().parents[2] / "DEVICE_MERIT.json"
+    return p if p.exists() else None
+
+
+@functools.lru_cache(maxsize=1)
+def link_model() -> LinkModel:
+    """The active link model: measured numbers on accelerator backends,
+    the trivial (free-transfer) model on CPU backends."""
+    if (_device_platform() == "cpu"
+            and not os.environ.get("DELTA_TPU_LINK_MODEL")):
+        return _CPU_MODEL
+
+    h2d = dict(_FALLBACK_H2D)
+    rtt = _FALLBACK_RTT_S
+    host_rate = _FALLBACK_HOST_ROWS_S
+    dev_rate = _FALLBACK_DEVICE_ROWS_S
+    path = _model_path()
+    if path is not None:
+        try:
+            merit = json.loads(path.read_text())
+            link = merit.get("link", {})
+            raw = link.get("h2d_bytes_per_s") or {}
+            if raw:
+                h2d = {int(k): float(v) for k, v in raw.items()}
+            rtt = float(link.get("rtt_s", rtt))
+            fa = merit.get("workloads", {}).get("replay_fa", {})
+            n = float(fa.get("n", 0))
+            if n and fa.get("t_host_s"):
+                host_rate = n / float(fa["t_host_s"])
+            if n and fa.get("t_device_compute_s"):
+                dev_rate = n / float(fa["t_device_compute_s"])
+        except (OSError, ValueError):
+            pass  # fall back to the baked-in shape
+    bps_env = os.environ.get("DELTA_TPU_LINK_H2D_BPS")
+    if bps_env:
+        h2d = {self_sz: float(bps_env) for self_sz in (h2d or {8 << 20: 0})}
+    rtt_env = os.environ.get("DELTA_TPU_LINK_RTT_S")
+    if rtt_env:
+        rtt = float(rtt_env)
+    return LinkModel(h2d, rtt, host_rate, dev_rate)
+
+
+def reset_model_cache() -> None:
+    """Drop the cached model (tests flip env knobs)."""
+    link_model.cache_clear()
+
+
+def sharded_min_rows() -> int:
+    env = os.environ.get("DELTA_TPU_SHARDED_MIN_ROWS")
+    if env:
+        return int(env)
+    return DEFAULT_SHARDED_MIN_ROWS
+
+
+def replay_route(
+    n_rows: int,
+    n_shards: int = 1,
+    nbytes_est: Optional[int] = None,
+    forced: Optional[str] = None,
+) -> str:
+    """Pick the replay route: "host", "single", or "sharded".
+
+    `forced` carries caller intent that bypasses the economics (an
+    explicitly constructed mesh keeps its sharded semantics); the
+    DELTA_TPU_REPLAY_ROUTE env var outranks everything (tests, bench
+    lanes)."""
+    env_route = os.environ.get("DELTA_TPU_REPLAY_ROUTE")
+    if env_route in ("host", "single", "sharded"):
+        if env_route == "sharded" and n_shards <= 1:
+            return "single"
+        return env_route
+    if forced == "sharded" and n_shards > 1:
+        return "sharded"
+    if n_rows <= 0:
+        return "single"
+
+    model = link_model()
+    if nbytes_est is None:
+        nbytes_est = int(n_rows * _FA_BYTES_PER_ROW)
+    t_host = n_rows / max(model.host_rows_per_s, 1.0)
+    t_device = (model.h2d_seconds(nbytes_est)
+                + n_rows / model.device_rows_per_s)
+    if t_host < t_device:
+        return "host"
+    if n_shards > 1 and n_rows >= sharded_min_rows():
+        return "sharded"
+    return "single"

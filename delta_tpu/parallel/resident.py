@@ -1,0 +1,277 @@
+"""Device-resident sharded replay state across `Snapshot.update()`.
+
+The sharded replay (`sharded_replay.py`) already rebuilds each shard's
+key lane on device; DEVICE_MERIT.json says the expensive thing is the
+host->device link, not the sort. So after a sharded full replay the
+rebuilt per-shard key lane is simply KEPT on device (zero extra
+transfer — `want_key` in the FA kernel), and every incremental
+`Snapshot.update()` ships only its delta rows to their owning shards:
+~8 bytes/delta row (slot index + key) instead of re-routing and
+re-shipping the multi-million-row base state. The device then re-runs
+the per-shard last-wins sort over base+delta and returns bit-packed
+winner words (~1 bit/row D2H); the host — which keeps the add bits,
+slot->row scatter, and path dictionary — rebuilds the full live and
+tombstone masks without probing the base table at all.
+
+Lifecycle: established by `compute_masks_device` (replay/state.py) when
+the sharded route runs on chronological, DV-free input; ownership moves
+`ColumnarActions` -> `SnapshotState` -> the advanced state (the append
+kernel donates the key buffer, so exactly one state may own it);
+released when a snapshot falls back to a full load (`table.py`) or is
+evicted from the serve cache (`serve/cache.py`). Any append the state
+cannot express (DV rows, batches older than the resident tail, capacity
+overflow) returns None and the caller falls back to the host delta
+path, dropping residency; in-batch disorder is sorted away, not
+rejected — real commits columnarize removes after adds. Disable with DELTA_TPU_RESIDENT=0.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import numpy as np
+
+from delta_tpu import obs
+
+_H2D_BYTES = obs.counter("replay.h2d_bytes")
+_APPENDS = obs.counter("replay.resident_appends")
+_FALLBACKS = obs.counter("replay.resident_fallbacks")
+
+
+def enabled() -> bool:
+    return os.environ.get("DELTA_TPU_RESIDENT") != "0"
+
+
+@functools.lru_cache(maxsize=32)
+def _append_fn_cached(mesh, d_pad: int):
+    """jit'd per-mesh append+replay: scatter the delta keys into each
+    shard's resident lane (slot indexes past the shard's capacity are
+    the drop sentinel) and re-run the last-wins sort. The resident lane
+    is donated — the update happens in place on device."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from delta_tpu.ops.replay import _sort_winner_pack
+    from delta_tpu.parallel.mesh import REPLAY_AXIS
+    from delta_tpu.parallel.sharded_replay import shard_map
+
+    def kernel(key, idx, val, n_real):
+        key, idx, val = key[0], idx[0], val[0]
+        key = key.at[idx].set(val, mode="drop")
+        winner = _sort_winner_pack((key,), n_real[0][0])
+        return key[None], winner[None]
+
+    spec = P(REPLAY_AXIS, None)
+    fn = shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4,
+                   out_specs=(spec, spec))
+    # donate the resident lane so the update is in place on device; CPU
+    # backends don't implement donation and would warn on every call
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(fn, donate_argnums=donate)
+
+
+class ResidentShardState:
+    """Host bookkeeping + device key lane for one resident snapshot."""
+
+    def __init__(self, payload, paths, path_codes: np.ndarray):
+        # payload: sharded_replay.ResidentPayload
+        self.mesh = payload.mesh
+        self.m = payload.m
+        self.n_shards = int(payload.mesh.devices.size)
+        self.key_sh = payload.key_sh
+        self.n_real = np.asarray(payload.n_real, np.int64).copy()
+        self.add = np.unpackbits(
+            payload.add_words.view(np.uint8).reshape(self.n_shards, -1),
+            axis=1, bitorder="little")[:, :self.m].astype(bool)
+        self.scatter = payload.scatter.astype(np.int64)
+        self.n = int(payload.n)
+        self.n_uniq = int(payload.n_uniq)
+        # path -> dense code, built lazily on first append (pd.Index
+        # hashtable build is O(base), each append lookup O(delta))
+        self._paths = paths            # arrow ChunkedArray, zero-copy ref
+        self._base_codes = np.asarray(path_codes, np.uint32)
+        self._index = None
+        self._overlay: dict = {}       # paths first seen after establish
+        self._max_version: Optional[int] = None  # newest appended version
+
+    # ------------------------------------------------------------ codes
+
+    def _ensure_index(self) -> None:
+        if self._index is not None:
+            return
+        import pandas as pd
+
+        codes = self._base_codes
+        n_base_uniq = int(codes.max()) + 1 if len(codes) else 0
+        _, first_idx = np.unique(codes, return_index=True)
+        paths_np = np.asarray(self._paths.to_pandas(), dtype=object)
+        uniq_paths = paths_np[first_idx]
+        assert len(uniq_paths) == n_base_uniq
+        self._index = pd.Index(uniq_paths)
+        self._paths = None             # dictionary built; drop the ref
+        self._base_codes = None
+
+    def _code_paths(self, delta_paths: list) -> np.ndarray:
+        """Dense codes for the delta rows, extending the dictionary in
+        first-appearance order (matching what a cold full replay's
+        factorize would assign over concat(base, delta))."""
+        self._ensure_index()
+        codes = self._index.get_indexer(delta_paths)
+        out = np.empty(len(delta_paths), np.uint32)
+        for i, (p, c) in enumerate(zip(delta_paths, codes)):
+            if c >= 0:
+                out[i] = c
+            else:
+                c2 = self._overlay.get(p)
+                if c2 is None:
+                    c2 = self.n_uniq
+                    self._overlay[p] = c2
+                    self.n_uniq += 1
+                out[i] = c2
+        return out
+
+    # ----------------------------------------------------------- append
+
+    def append(self, delta_fa, n_prev: int):
+        """Ship the delta rows to their shards, re-reconcile on device,
+        and return (live_mask, tombstone_mask) over the concatenated
+        n_prev + delta rows — or None when this state can't express the
+        batch (caller falls back to the host delta path and drops
+        residency)."""
+        from delta_tpu.ops.replay import chrono_ok
+
+        d = delta_fa.num_rows
+        if n_prev != self.n or self.key_sh is None:
+            _FALLBACKS.inc()
+            return None
+        dv = delta_fa.column("dv_id")
+        if dv.null_count != d:
+            _FALLBACKS.inc()  # DV rows need the (path, dv) key: not resident
+            return None
+        version = np.asarray(delta_fa.column("version"), np.int64)
+        order = np.asarray(delta_fa.column("order"), np.int32)
+        # In-batch disorder is routine (a commit's removes serialize
+        # before its adds but columnarize after), so sort here: the
+        # device kernel breaks key ties by slot index, and slots are
+        # assigned in processing order. Only a batch older than what's
+        # already resident is inexpressible — appended slots always sort
+        # after the base, so a stale version would win ties it lost.
+        if chrono_ok(version, order):
+            chrono = np.arange(d, dtype=np.int64)
+        else:
+            chrono = np.lexsort((order, version))
+        if d:
+            lo = int(version[chrono[0]])
+            if self._max_version is not None and lo < self._max_version:
+                _FALLBACKS.inc()
+                return None
+
+        with obs.span("replay.resident_append", rows=d, base=self.n):
+            codes = self._code_paths(delta_fa.column("path").to_pylist())
+            is_add = np.asarray(delta_fa.column("is_add"), bool)
+            codes_c = codes[chrono]
+            is_add_c = is_add[chrono]
+            s = self.n_shards
+            shard_of = (codes_c % np.uint32(s)).astype(np.int64)
+            counts = np.bincount(shard_of, minlength=s)
+            new_n_real = self.n_real + counts
+            if int(new_n_real.max(initial=0)) > self.m:
+                _FALLBACKS.inc()  # shard full: re-establish on next load
+                return None
+
+            # slot of row i = shard fill level + rank among its shard's
+            # delta rows (stable shard sort keeps chronological order)
+            sort_idx = np.argsort(shard_of, kind="stable")
+            starts = np.zeros(s + 1, np.int64)
+            np.cumsum(counts, out=starts[1:])
+            rows = shard_of[sort_idx]
+            slots = (np.arange(d) - starts[rows]) + self.n_real[rows]
+
+            d_pad = max(128, 1 << int(d - 1).bit_length()) if d else 128
+            idx2d = np.full((s, d_pad), self.m, np.int32)  # m = drop
+            val2d = np.zeros((s, d_pad), np.uint32)
+            cols = np.arange(d) - starts[rows]
+            idx2d[rows, cols] = slots.astype(np.int32)
+            val2d[rows, cols] = (codes_c[sort_idx] //
+                                 np.uint32(s)).astype(np.uint32)
+
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from delta_tpu.parallel.mesh import REPLAY_AXIS
+
+            spec = NamedSharding(self.mesh, P(REPLAY_AXIS, None))
+            nbytes = idx2d.nbytes + val2d.nbytes
+            _H2D_BYTES.inc(nbytes)
+            obs.set_attrs(h2d_bytes=nbytes)
+            n_real_op = new_n_real.astype(np.int32).reshape(s, 1)
+            fn = _append_fn_cached(self.mesh, d_pad)
+            new_key, winner_sh = fn(
+                self.key_sh,
+                jax.device_put(idx2d, spec),
+                jax.device_put(val2d, spec),
+                jax.device_put(n_real_op, spec))
+            self.key_sh = new_key
+
+            # host bookkeeping for the appended slots (scatter maps each
+            # slot back to its original arrow row, so the returned masks
+            # stay in the caller's row order even for sorted batches)
+            self.add[rows, slots] = is_add_c[sort_idx]
+            self.scatter[rows, slots] = (n_prev +
+                                         chrono[sort_idx].astype(np.int64))
+            self.n_real = new_n_real
+            self.n = n_prev + d
+            if d:
+                self._max_version = int(version[chrono[-1]])
+
+            winner_np = np.asarray(winner_sh)  # [S, M/32] packed D2H
+            winner = np.unpackbits(
+                winner_np.view(np.uint8).reshape(s, -1),
+                axis=1, bitorder="little")[:, :self.m].astype(bool)
+            live_slots = winner & self.add
+            tomb_slots = winner & ~self.add
+            valid = self.scatter >= 0
+            live = np.zeros(self.n, bool)
+            tomb = np.zeros(self.n, bool)
+            live[self.scatter[valid]] = live_slots[valid]
+            tomb[self.scatter[valid]] = tomb_slots[valid]
+            _APPENDS.inc()
+            return live, tomb
+
+    def release(self) -> None:
+        """Drop the device buffer (the host bookkeeping is garbage with
+        it, so the whole state is dead after this)."""
+        self.key_sh = None
+
+
+def establish_resident(payload, file_actions,
+                       path_codes: np.ndarray) -> Optional[ResidentShardState]:
+    """Wrap a `ResidentPayload` from `sharded_replay_select` with the
+    snapshot's path column so future appends can code new paths
+    consistently. `file_actions` is the canonical arrow table the
+    payload's rows came from (same row order)."""
+    try:
+        with obs.span("replay.resident_establish", rows=payload.n):
+            return ResidentShardState(
+                payload, file_actions.column("path").combine_chunks(),
+                path_codes)
+    # delta-lint: disable=except-swallow (audited: residency is an
+    # optimization; any establishment failure must degrade to the
+    # non-resident path, never fail the load)
+    except Exception:
+        _FALLBACKS.inc()
+        return None
+
+
+def release_snapshot_resident(snapshot) -> None:
+    """Free a snapshot's resident device state, if any. Accepts
+    `Snapshot`, `SnapshotState`, or anything in between (duck-typed so
+    the serve cache and table fallback paths don't need type checks)."""
+    state = getattr(snapshot, "_state", None) or snapshot
+    resident = getattr(state, "resident", None)
+    if resident is not None:
+        resident.release()
+        state.resident = None

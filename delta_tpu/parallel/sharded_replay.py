@@ -55,6 +55,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
+from delta_tpu import obs
 from delta_tpu.ops.replay import (
     _PAD_KEY,
     _decode_planes,
@@ -67,6 +68,11 @@ from delta_tpu.ops.replay import (
     pad_bucket,
 )
 from delta_tpu.parallel.mesh import REPLAY_AXIS, make_mesh
+
+# Same counter as the single-chip launch path (ops/replay.py): total
+# replay operand bytes shipped host->device, read by the residency
+# tests and the bench transfer accounting.
+_H2D_BYTES = obs.counter("replay.h2d_bytes")
 
 
 # --------------------------------------------------------------- raw path
@@ -279,8 +285,12 @@ def route_to_shards_fa(
                              m, nbytes)
 
 
-def _shard_kernel_fa(ref_width: int, has_sub: bool):
-    """Kernel body factory for the FA-coded sharded replay."""
+def _shard_kernel_fa(ref_width: int, has_sub: bool, want_key: bool = False):
+    """Kernel body factory for the FA-coded sharded replay. With
+    `want_key` the rebuilt per-shard key lane is returned as a third
+    output so the caller can keep it device-resident across
+    `Snapshot.update()` calls (parallel/resident.py) — the lane already
+    exists on device, so residency costs zero extra transfer."""
 
     def kernel(*ops):
         flag_words = ops[0][0]
@@ -307,41 +317,60 @@ def _shard_kernel_fa(ref_width: int, has_sub: bool):
         iota = jnp.arange(m, dtype=jnp.int32)
         key = jnp.where(iota < n_real, key, jnp.uint32(0xFFFFFFFF))
 
-        add_bits = _unpack_bits_device(add_words)
-        winner_words = _sort_winner_pack((key,), n_real, add_bits)
+        winner_words = _sort_winner_pack((key,), n_real)
         live_words = winner_words & add_words
         live_bits = _unpack_bits_device(live_words)
         local_live = jnp.sum(live_bits.astype(jnp.int32))
         # the only cross-device exchange in the whole replay: one scalar
         # psum over the ICI (int32 — exact)
         num_live = lax.psum(local_live, REPLAY_AXIS)
+        if want_key:
+            return winner_words[None], num_live, key[None]
         return winner_words[None], num_live
 
     return kernel
 
 
 @functools.lru_cache(maxsize=32)
-def _fa_fn_cached(mesh: Mesh, ref_width: int, has_sub: bool):
+def _fa_fn_cached(mesh: Mesh, ref_width: int, has_sub: bool,
+                  want_key: bool = False):
     spec = P(REPLAY_AXIS, None)
     in_specs = [spec]                       # flag_words
     in_specs += [spec] * ref_width          # ref planes
     if has_sub:
         in_specs += [P(), spec, spec]       # sub_radix (replicated), idx, val
     in_specs += [spec, spec]                # n_real, add_words
+    out_specs = (spec, P(), spec) if want_key else (spec, P())
     fn = shard_map(
-        _shard_kernel_fa(ref_width, has_sub),
+        _shard_kernel_fa(ref_width, has_sub, want_key),
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(spec, P()),
+        out_specs=out_specs,
     )
     return jax.jit(fn)
 
 
-def build_sharded_replay_fa_fn(mesh: Mesh, ref_width: int, has_sub: bool):
-    return _fa_fn_cached(mesh, ref_width, has_sub)
+def build_sharded_replay_fa_fn(mesh: Mesh, ref_width: int, has_sub: bool,
+                               want_key: bool = False):
+    return _fa_fn_cached(mesh, ref_width, has_sub, want_key)
 
 
 # ------------------------------------------------------------ public API
+
+
+class ResidentPayload(NamedTuple):
+    """Everything `parallel/resident.py` needs to keep a sharded replay
+    device-resident after `sharded_replay_select` returns: the rebuilt
+    per-shard key lane (already on device — zero extra transfer) plus
+    the host-side routing bookkeeping."""
+    key_sh: object                # jax [S, M] u32, NamedSharding over mesh
+    mesh: Mesh
+    m: int
+    n_real: np.ndarray            # [S] i32 rows per shard
+    add_words: np.ndarray         # [S, M/32] u32
+    scatter: np.ndarray           # [S, M] i32 original row (-1 = pad)
+    n: int                        # total real rows
+    n_uniq: int                   # dense path-code count (sub_radix == 1)
 
 
 def sharded_replay_select(
@@ -353,11 +382,17 @@ def sharded_replay_select(
     size: Optional[np.ndarray] = None,
     mesh: Optional[Mesh] = None,
     fa_hint: Optional[tuple] = None,
+    resident_sink: Optional[list] = None,
 ) -> tuple[np.ndarray, np.ndarray, int, int]:
     """Full pipeline; returns (live_mask, tomb_mask, num_live, live_bytes)
     in original row order. `fa_hint` = (is_new flags, refs, n_uniq) from
     the native scanner's in-scan dictionary (refs unused here — the
-    sharded route re-derives per-shard refs from the codes)."""
+    sharded route re-derives per-shard refs from the codes).
+
+    `resident_sink`: when the FA route runs with chronological input and
+    no DV lane, a `ResidentPayload` is appended so the caller can keep
+    the per-shard state device-resident (see parallel/resident.py);
+    otherwise the list is left untouched."""
     if mesh is None:
         mesh = make_mesh()
     n = len(path_key)
@@ -367,37 +402,62 @@ def sharded_replay_select(
     n_shards = mesh.devices.size
 
     size_orig = size  # original row order, for the exact host aggregate
-    perm = None
-    if not chrono_ok(np.asarray(version), np.asarray(order)):
-        perm = np.lexsort((order, version)).astype(np.int64)
-        path_key = np.asarray(path_key)[perm]
-        dv_key = np.asarray(dv_key)[perm]
-        is_add = np.asarray(is_add)[perm]
-        size = None if size is None else np.asarray(size)[perm]
-        fa_hint = None  # hint flags were in original row order
+    with obs.span("replay.shard_route", rows=n, shards=n_shards):
+        perm = None
+        if not chrono_ok(np.asarray(version), np.asarray(order)):
+            perm = np.lexsort((order, version)).astype(np.int64)
+            path_key = np.asarray(path_key)[perm]
+            dv_key = np.asarray(dv_key)[perm]
+            is_add = np.asarray(is_add)[perm]
+            size = None if size is None else np.asarray(size)[perm]
+            fa_hint = None  # hint flags were in original row order
 
-    is_new = fa_hint[0] if fa_hint is not None else None
-    if is_new is None or len(is_new) != n:
-        is_new = derive_fa_flags(np.asarray(path_key))
+        is_new = fa_hint[0] if fa_hint is not None else None
+        if is_new is None or len(is_new) != n:
+            is_new = derive_fa_flags(np.asarray(path_key))
 
-    fa = None
-    if is_new is not None:
-        fa = route_to_shards_fa(path_key, dv_key, is_new, is_add, n_shards)
+        fa = None
+        if is_new is not None:
+            fa = route_to_shards_fa(path_key, dv_key, is_new, is_add,
+                                    n_shards)
+        if fa is None:
+            operands, scatter = route_to_shards(
+                path_key, dv_key,
+                np.arange(n, dtype=np.int64), np.zeros(n, np.int64),
+                is_add, size, n_shards)
     spec = NamedSharding(mesh, P(REPLAY_AXIS, None))
     live_bytes = None
     if fa is not None:
         has_sub = fa.sub_radix > 1
+        want_key = (resident_sink is not None and perm is None
+                    and not has_sub)
         ops = [fa.flag_words, *fa.ref_planes]
         if has_sub:
             ops += [np.uint32(fa.sub_radix), fa.sub_idx, fa.sub_val]
         ops += [fa.n_real, fa.add_words]
-        device_ops = tuple(
-            o if np.isscalar(o) or o.ndim == 0 else jax.device_put(o, spec)
-            for o in ops)
+        with obs.span("replay.shard_transfer", nbytes=fa.nbytes,
+                      route="fa"):
+            _H2D_BYTES.inc(fa.nbytes)
+            device_ops = tuple(
+                o if np.isscalar(o) or o.ndim == 0
+                else jax.device_put(o, spec)
+                for o in ops)
         # scalar sub_radix is replicated, not sharded
-        fn = build_sharded_replay_fa_fn(mesh, len(fa.ref_planes), has_sub)
-        winner_sh, num_live = fn(*device_ops)
-        winner_words = np.asarray(winner_sh)
+        fn = build_sharded_replay_fa_fn(mesh, len(fa.ref_planes), has_sub,
+                                        want_key)
+        with obs.span("replay.shard_reconcile", shards=n_shards,
+                      route="fa"):
+            if want_key:
+                winner_sh, num_live, key_sh = fn(*device_ops)
+            else:
+                winner_sh, num_live = fn(*device_ops)
+            winner_words = np.asarray(winner_sh)
+        if want_key:
+            resident_sink.append(ResidentPayload(
+                key_sh=key_sh, mesh=mesh, m=fa.m,
+                n_real=fa.n_real.reshape(-1).astype(np.int64),
+                add_words=fa.add_words, scatter=fa.scatter, n=n,
+                n_uniq=(int(np.asarray(path_key).max()) + 1) if n else 0))
         add_words = fa.add_words
         live_words = winner_words & add_words
         tomb_words = winner_words & ~add_words
@@ -406,15 +466,16 @@ def sharded_replay_select(
         scatter = fa.scatter
         m = fa.m
     else:
-        operands, scatter = route_to_shards(
-            path_key, dv_key,
-            np.arange(n, dtype=np.int64), np.zeros(n, np.int64),
-            is_add, size, n_shards)
-        device_ops = tuple(jax.device_put(o, spec) for o in operands)
+        nbytes = sum(int(o.nbytes) for o in operands)
+        with obs.span("replay.shard_transfer", nbytes=nbytes, route="raw"):
+            _H2D_BYTES.inc(nbytes)
+            device_ops = tuple(jax.device_put(o, spec) for o in operands)
         fn = _cached_fn(mesh)
-        live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
-        flat_live = np.asarray(live_sh).ravel()
-        flat_tomb = np.asarray(tomb_sh).ravel()
+        with obs.span("replay.shard_reconcile", shards=n_shards,
+                      route="raw"):
+            live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
+            flat_live = np.asarray(live_sh).ravel()
+            flat_tomb = np.asarray(tomb_sh).ravel()
         m = operands[0].shape[1]
 
     live = np.zeros(n, dtype=bool)

@@ -116,6 +116,12 @@ class ColumnarActions:
     # splices the real column before any user-facing surface; any other
     # caller must use `file_actions_complete()`.
     stats_thunk: Optional[object] = None
+    # Device-resident sharded replay state (parallel/resident.py
+    # ResidentShardState), established by compute_masks_device when the
+    # sharded route runs; reconstruct_state moves ownership to the
+    # SnapshotState so `Snapshot.update()` can append delta rows without
+    # re-shipping the base state.
+    resident: Optional[object] = None
     _splice_lock: object = field(default_factory=threading.Lock,
                                  repr=False, compare=False)
 
@@ -1078,12 +1084,22 @@ def _columnarize_log_segment(
             launch = None
             mesh = getattr(engine, "mesh", None)
             sole_fresh = not blocks and not span_parts
-            if (early_replay and sole_fresh and not small_only
-                    and (mesh is None or mesh.devices.size <= 1)):
+            if early_replay and sole_fresh and not small_only:
                 def launch(scan, row_versions, row_orders):
                     from delta_tpu.ops.replay import replay_select_launch
+                    from delta_tpu.parallel import gate
                     from delta_tpu.replay.state import BLOCKWISE_MIN_ROWS
 
+                    # Same routing decision compute_masks_device will
+                    # make: an early launch may only claim the replay
+                    # when the single-chip kernel is the chosen route
+                    # (host/sharded routes dispatch there instead).
+                    n_shards = mesh.devices.size if mesh is not None else 1
+                    forced = ("sharded" if n_shards > 1 and getattr(
+                        engine, "_mesh_forced", False) else None)
+                    if gate.replay_route(scan.n_rows, n_shards=n_shards,
+                                         forced=forced) != "single":
+                        return None
                     if scan.n_rows >= BLOCKWISE_MIN_ROWS:
                         return None  # >HBM: compute_masks_device streams blocks
                     if row_versions.max(initial=0) >= 2**31:

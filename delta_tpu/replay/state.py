@@ -58,6 +58,12 @@ class SnapshotState:
     # deferred stats decode from the lazy-stats native scan (columnar
     # stats_thunk); spliced exactly once below
     stats_thunk: Optional[object] = None
+    # Device-resident sharded replay state (parallel/resident.py):
+    # exactly one SnapshotState owns it at a time — `advance_state`
+    # moves it to the advanced state (the append kernel donates the
+    # device buffer, so the prior owner's reference would be stale)
+    resident: Optional[object] = field(default=None, repr=False,
+                                       compare=False)
 
     _add_table_cache: Optional[pa.Table] = None
     _tombstone_table_cache: Optional[pa.Table] = None
@@ -242,9 +248,19 @@ def compute_masks_device(
     order = np.asarray(fa.column("order"), dtype=np.int32)
     is_add = np.asarray(fa.column("is_add"), dtype=bool)
 
+    from delta_tpu.parallel import gate
+
     mesh = getattr(engine, "mesh", None) if engine is not None else None
-    if mesh is not None and mesh.devices.size > 1:
-        if n >= BLOCKWISE_MIN_ROWS * mesh.devices.size:
+    n_shards = mesh.devices.size if mesh is not None else 1
+    forced = ("sharded" if n_shards > 1
+              and getattr(engine, "_mesh_forced", False) else None)
+    route = gate.replay_route(n, n_shards=n_shards, forced=forced)
+    if route == "host":
+        # RTT-dominated tiny segment: dispatching to the device costs
+        # more than the host-vectorized replay (DEVICE_MERIT link model)
+        return compute_masks_host(columnar)
+    if route == "sharded":
+        if n >= BLOCKWISE_MIN_ROWS * n_shards:
             # sharded AND >HBM: each shard streams its substream in
             # bounded blocks with a persistent bitset — the
             # `Snapshot.scala:481-511` multi-host configuration
@@ -256,12 +272,20 @@ def compute_masks_device(
                 [path_codes, dv_codes], version.astype(np.int32),
                 order, is_add, mesh)
             return live, tomb
+        from delta_tpu.parallel import resident as _resident
         from delta_tpu.parallel.sharded_replay import sharded_replay_select
 
+        sink = [] if _resident.enabled() else None
         live, tomb, _, _ = sharded_replay_select(
             path_codes, dv_codes, version.astype(np.int32), order, is_add,
-            mesh=mesh, fa_hint=fa_hint,
+            mesh=mesh, fa_hint=fa_hint, resident_sink=sink,
         )
+        if sink:
+            # keep the per-shard state on device so Snapshot.update()
+            # ships only delta rows (ownership moves to SnapshotState
+            # in reconstruct_state)
+            columnar.resident = _resident.establish_resident(
+                sink[0], fa, path_codes)
         return live, tomb
     if n >= BLOCKWISE_MIN_ROWS:
         # >HBM scale path (SURVEY §5.7): stream fixed-size blocks through
@@ -407,13 +431,30 @@ def advance_state(
     delta_fa = delta.file_actions_complete()  # delta stats: small, eager
     m = delta_fa.num_rows
     n_prev = prev.file_actions_raw.num_rows
+    resident = prev.resident
 
     if m == 0:
         new_raw = prev.file_actions_raw
         live = prev.live_mask
         tomb = prev.tombstone_mask
         stats_thunk = prev.stats_thunk and _chained_prev_stats(prev, None)
+    elif resident is not None and (
+            masks := resident.append(delta_fa, n_prev)) is not None:
+        # device-resident path: only the delta rows crossed the link;
+        # the device re-reconciled base+delta and the returned masks
+        # already cover the concatenated table
+        live, tomb = masks
+        new_raw = pa.concat_tables([prev.file_actions_raw, delta_fa])
+        stats_thunk = (prev.stats_thunk
+                       and _chained_prev_stats(prev, delta_fa))
     else:
+        if resident is not None:
+            # the batch couldn't be expressed on device (DV rows,
+            # capacity, ordering): residency ends here, host path takes
+            # over for this and every later advancement
+            resident.release()
+            resident = None
+            prev.resident = None
         d_paths = delta_fa.column("path").to_pylist()
         d_dv = delta_fa.column("dv_id").to_pylist()
         d_keys = list(zip(d_paths, d_dv))
@@ -459,7 +500,7 @@ def advance_state(
     commit_infos = dict(prev.commit_infos)
     commit_infos.update(delta.commit_infos)
 
-    return SnapshotState(
+    new_state = SnapshotState(
         version=new_segment.version,
         protocol=delta.protocol or prev.protocol,
         metadata=delta.metadata or prev.metadata,
@@ -473,6 +514,12 @@ def advance_state(
         timestamp_ms=new_segment.last_commit_timestamp,
         stats_thunk=stats_thunk,
     )
+    if resident is not None:
+        # ownership moves: the append donated (mutated) the device
+        # buffer, so the prior state's reference is stale by definition
+        new_state.resident = resident
+        prev.resident = None
+    return new_state
 
 
 def _chained_prev_stats(prev: SnapshotState, delta_fa: Optional[pa.Table]):
@@ -551,4 +598,8 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
     )
     # ownership of the deferred decode moves to the snapshot state
     columnar.stats_thunk = None
+    # same for the device-resident sharded replay state, when one was
+    # established during compute_masks_device
+    state.resident = columnar.resident
+    columnar.resident = None
     return state

@@ -66,7 +66,17 @@ class SnapshotCache:
             e = _Entry(Table.for_path(path, self._engine))
             self._entries[path] = e
             while len(self._entries) > self._config.cache_tables:
-                self._entries.popitem(last=False)
+                _, old = self._entries.popitem(last=False)
+                if old.snapshot is not None:
+                    # evicted snapshots must free their device-resident
+                    # replay state — HBM is the scarce resource here;
+                    # entries that merely advance keep residency (the
+                    # state moves to the advanced snapshot)
+                    from delta_tpu.parallel.resident import (
+                        release_snapshot_resident,
+                    )
+
+                    release_snapshot_resident(old.snapshot)
             return e
 
     def snapshot_for(self, path: str,

@@ -141,6 +141,14 @@ class Table:
                 return self.latest_snapshot()
             advanced = cached.update()
             if advanced is None:
+                # full-load fallback: the cached snapshot's device-
+                # resident replay state (if any) can't be advanced
+                # across the boundary and would leak HBM — release it
+                from delta_tpu.parallel.resident import (
+                    release_snapshot_resident,
+                )
+
+                release_snapshot_resident(cached)
                 return self.latest_snapshot()
             if advanced is not cached:
                 with self._lock:

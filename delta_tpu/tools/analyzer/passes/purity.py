@@ -31,7 +31,18 @@ from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
 from delta_tpu.tools.analyzer.passes._astutil import call_name, dotted
 
 _JIT_NAMES = {"jax.jit", "jit", "pl.pallas_call", "pallas_call",
-              "pltpu.pallas_call", "jax.pmap", "pmap"}
+              "pltpu.pallas_call", "jax.pmap", "pmap",
+              # sharded-kernel wrappers: a shard_map/pjit body is traced
+              # exactly like a jit body and gets the same purity rules
+              "shard_map", "jax.shard_map", "pjit", "jax.pjit"}
+
+# cross-device collectives only appear inside traced (device) code, so
+# any function calling one is a root even without a visible jit wrapper
+# (e.g. a kernel-body factory returned into shard_map by the caller)
+_COLLECTIVE_NAMES = {"lax.psum", "jax.lax.psum", "psum",
+                     "lax.pmean", "jax.lax.pmean", "pmean",
+                     "lax.all_gather", "jax.lax.all_gather",
+                     "lax.ppermute", "jax.lax.ppermute"}
 
 _IMPURE_EXACT = {
     "time.time", "time.time_ns", "time.perf_counter",
@@ -94,6 +105,21 @@ class _PurityScan:
                     arg = node.args[0]
                     if isinstance(arg, ast.Name) and arg.id in defs:
                         roots.extend(defs[arg.id])
+                    elif isinstance(arg, ast.Call):
+                        # factory form: shard_map(make_kernel(...), ...)
+                        # — the factory (and the nested body it returns)
+                        # is traced code
+                        inner = call_name(arg)
+                        if inner in defs:
+                            roots.extend(defs[inner])
+
+        # any function using a collective is device code, jit'd or not
+        for fns in defs.values():
+            for fn in fns:
+                if any(isinstance(sub, ast.Call)
+                       and call_name(sub) in _COLLECTIVE_NAMES
+                       for sub in ast.walk(fn)):
+                    roots.append(fn)
 
         # reachability over name-based calls
         reachable: List[ast.AST] = []

@@ -358,6 +358,43 @@ def host(x):
     assert not _rules_fired(report, "jit-sync")
 
 
+def test_jit_impure_shard_map_factory_body():
+    # shard_map(make_kernel(...), ...) — the factory and the body it
+    # returns are traced code, even without a jit decorator in sight
+    src = """
+import time
+from jax.experimental.shard_map import shard_map
+
+def make_kernel(width):
+    def kernel(ops):
+        return ops[0] * time.time()
+    return kernel
+
+def launch(mesh, ops):
+    fn = shard_map(make_kernel(4), mesh=mesh, in_specs=None, out_specs=None)
+    return fn(ops)
+"""
+    report = analyze_sources({"m.py": src}, rules=["jit-impure"])
+    assert any("time.time" in f.message
+               for f in _rules_fired(report, "jit-impure"))
+
+
+def test_jit_impure_collective_marks_root():
+    # a psum can only execute inside traced device code, so the
+    # containing function gets purity rules with no visible wrapper
+    src = """
+import time
+from jax import lax
+
+def shard_body(x):
+    total = lax.psum(x, "shard")
+    return total + time.time()
+"""
+    report = analyze_sources({"m.py": src}, rules=["jit-impure"])
+    assert any("time.time" in f.message
+               for f in _rules_fired(report, "jit-impure"))
+
+
 # ----------------------------------------------------------- error rules
 
 

@@ -22,7 +22,15 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tier-2: real jax.distributed two-process jobs (Gloo rendezvous + full
+# XLA re-init per process) take minutes on constrained hosts; the tier-1
+# sharded coverage lives in test_sharded_replay.py on the in-process
+# 8-emulated-device mesh (the `sharded8` lane)
+pytestmark = pytest.mark.slow
 
 WORKER = r"""
 import os, sys

@@ -8,6 +8,10 @@ from delta_tpu.ops.replay import python_replay_reference
 from delta_tpu.parallel import make_mesh, sharded_replay_select
 from delta_tpu.parallel.sharded_replay import build_sharded_replay_fn, route_to_shards
 
+# the fast CPU-only sharded lane: `pytest -m sharded8` runs exactly the
+# in-process 8-emulated-device coverage (conftest forces the device count)
+pytestmark = pytest.mark.sharded8
+
 
 def _history(rng, n, n_keys, n_versions):
     pk = rng.integers(0, n_keys, n).astype(np.uint32)
@@ -195,3 +199,202 @@ def test_sharded_transfer_bytes_close_to_single_chip():
     single_total = single.nbytes + pad_bucket(n) // 8
     assert sharded.nbytes <= 2 * single_total, (
         sharded.nbytes, single_total)
+
+
+# ---------------------------------------------------- digest parity matrix
+
+
+def _mask_digest(live, tomb):
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.packbits(np.asarray(live, bool)).tobytes())
+    h.update(np.packbits(np.asarray(tomb, bool)).tobytes())
+    return h.hexdigest()
+
+
+def _matrix_stream(kind, rng, n):
+    """One named workload for the parity matrix."""
+    if kind == "fa":                       # product path: scanner FA codes
+        return _fa_history(rng, n, 64)
+    if kind == "dv_heavy":                 # (path, dv) composite keys
+        return _fa_history(rng, n, 64, dv_frac=0.5)
+    if kind == "hashed":                   # host-hashed lanes: not FA-coded
+        pk = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+        dk = rng.integers(0, 3, n).astype(np.uint32)
+        ver = np.sort(rng.integers(0, 64, n)).astype(np.int32)
+        order = np.zeros(n, np.int32)
+        for v in np.unique(ver):
+            s = ver == v
+            order[s] = np.arange(s.sum())
+        add = rng.random(n) < 0.6
+        size = rng.integers(100, 10_000, n).astype(np.int64)
+        return pk, dk, ver, order, add, size
+    if kind == "permuted":                 # non-chronological rows
+        pk, dk, ver, order, add, size = _fa_history(rng, n, 64)
+        p = rng.permutation(n)
+        return pk[p], dk[p], ver[p], order[p], add[p], size[p]
+    raise AssertionError(kind)
+
+
+# --------------------------------------------------------- device residency
+
+
+def _tpu_table(tmp_path, n_commits, files_per_commit=20):
+    from delta_tpu.engine.tpu import TpuEngine
+    from delta_tpu.models.actions import AddFile, RemoveFile
+    from delta_tpu.models.schema import INTEGER, StructField, StructType
+    from delta_tpu.table import Table
+
+    eng = TpuEngine(replay_shards=8)
+    t = Table.for_path(str(tmp_path), eng)
+    t.create_transaction_builder().with_schema(
+        StructType([StructField("x", INTEGER)])).build().commit()
+    for i in range(n_commits):
+        txn = t.start_transaction()
+        for j in range(files_per_commit):
+            txn.add_file(AddFile(
+                path=f"p{i}_{j}.parquet", partitionValues={}, size=100 + j,
+                modificationTime=1000 + i, dataChange=True))
+        if i > 0:
+            txn.remove_file(RemoveFile(
+                path=f"p{i - 1}_0.parquet", deletionTimestamp=2000 + i,
+                dataChange=True))
+        txn.commit()
+    return t
+
+
+def test_update_ships_only_delta_rows(tmp_path):
+    """Device residency: after a sharded load, advancing the snapshot
+    ships exactly the padded delta slots (8 bytes each per shard) over
+    the link — never the resident base rows — and the advanced masks
+    match a cold reload bit-for-bit."""
+    from delta_tpu import obs
+    from delta_tpu.engine.tpu import TpuEngine
+    from delta_tpu.models.actions import AddFile
+    from delta_tpu.table import Table
+
+    # stay under delta.checkpointInterval (10): a checkpoint-based load
+    # reconstructs from parquet + tail and correctly skips residency
+    t = _tpu_table(tmp_path, 8)
+    snap = t.latest_snapshot()
+    _ = snap.state.live_mask  # force replay
+    res = snap._state.resident
+    assert res is not None, "sharded load did not establish residency"
+
+    h2d = obs.counter("replay.h2d_bytes")
+    appends = obs.counter("replay.resident_appends")
+    fallbacks = obs.counter("replay.resident_fallbacks")
+    h2d0, app0, fb0 = h2d.value, appends.value, fallbacks.value
+
+    d = 20
+    txn = t.start_transaction()
+    for j in range(d):
+        txn.add_file(AddFile(
+            path=f"inc_{j}.parquet", partitionValues={}, size=50,
+            modificationTime=5000, dataChange=True))
+    txn.commit()
+    snap2 = t.update()
+    assert snap2.version == snap.version + 1
+
+    assert appends.value == app0 + 1
+    assert fallbacks.value == fb0
+    # exact link accounting for the advance: the append ships the
+    # scatter indexes + local codes, (4 + 4) bytes per padded delta
+    # slot per shard — a constant in the resident base size
+    d_pad = max(128, 1 << (d - 1).bit_length())
+    assert h2d.value - h2d0 == 8 * 8 * d_pad
+    # ownership moved to the advanced snapshot
+    assert snap2._state.resident is res
+    assert snap._state.resident is None
+
+    # warm and cold states order each commit's rows differently (the
+    # incremental columnarizer batches adds before removes, the full
+    # parse keeps JSON order), so compare per-(path, version) decisions
+    # rather than raw mask positions
+    def signature(st):
+        fa = st.file_actions_raw
+        return sorted(zip(
+            fa.column("path").to_pylist(), fa.column("dv_id").to_pylist(),
+            fa.column("version").to_pylist(), fa.column("order").to_pylist(),
+            np.asarray(st.live_mask).tolist(),
+            np.asarray(st.tombstone_mask).tolist()))
+
+    cold = Table.for_path(
+        str(tmp_path), TpuEngine(replay_shards=8)).latest_snapshot()
+    st, cst = snap2._state, cold.state
+    assert signature(st) == signature(cst)
+    assert (st.num_files, st.size_in_bytes) == \
+        (cst.num_files, cst.size_in_bytes)
+
+
+def test_resident_append_fallbacks(tmp_path):
+    """Batches the resident state cannot express — stale base, DV rows,
+    versions older than the resident tail — return None (host fallback)
+    and count; in-batch disorder is sorted away, not rejected."""
+    import pyarrow as pa
+
+    from delta_tpu import obs
+
+    t = _tpu_table(tmp_path, 6)
+    snap = t.latest_snapshot()
+    _ = snap.state.live_mask
+    res = snap._state.resident
+    assert res is not None
+    fb = obs.counter("replay.resident_fallbacks")
+    f0 = fb.value
+
+    def delta(paths, dvs, vers, orders):
+        return pa.table({
+            "path": pa.array(paths, pa.string()),
+            "dv_id": pa.array(dvs, pa.string()),
+            "version": pa.array(vers, pa.int64()),
+            "order": pa.array(orders, pa.int32()),
+            "is_add": pa.array([True] * len(paths)),
+        })
+
+    good = delta(["z.parquet"], [None], [99], [0])
+    assert res.append(good, n_prev=res.n + 5) is None          # stale base
+    dv = delta(["z.parquet"], ["dv-1"], [99], [0])
+    assert res.append(dv, n_prev=res.n) is None                # DV row
+    # in-batch disorder is expressible — a real commit's removes
+    # columnarize after its adds with smaller order values
+    ooo = delta(["a", "b"], [None, None], [99, 98], [1, 0])
+    masks = res.append(ooo, n_prev=res.n)
+    assert masks is not None and len(masks[0]) == res.n
+    # ...but a whole batch older than the resident tail is not: its
+    # slots would sort after rows that should outrank it
+    stale = delta(["c"], [None], [5], [0])
+    assert res.append(stale, n_prev=res.n) is None
+    assert fb.value == f0 + 3
+    assert res.key_sh is not None  # fallbacks don't corrupt the state
+
+
+# ---------------------------------------------------- digest parity matrix
+
+
+@pytest.mark.parametrize("kind", ["fa", "dv_heavy", "hashed", "permuted"])
+def test_digest_parity_matrix(kind):
+    """The full route matrix — sharded at S=1/2/8, the single-chip
+    kernel, and the host reference — produces bit-identical live and
+    tombstone masks on the same log, for FA, DV-heavy, raw-hashed, and
+    non-chronological streams."""
+    from delta_tpu.ops.replay import replay_select
+
+    rng = np.random.default_rng(1234)
+    n = 24_000
+    pk, dk, ver, order, add, size = _matrix_stream(kind, rng, n)
+
+    live_h, tomb_h = python_replay_reference(
+        list(zip(pk.tolist(), dk.tolist())), ver, order, add)
+    want = _mask_digest(live_h, tomb_h)
+
+    live_1, tomb_1 = replay_select([pk, dk], ver, order, add)
+    assert _mask_digest(live_1, tomb_1) == want, f"single-chip: {kind}"
+
+    for s in (1, 2, 8):
+        mesh = make_mesh(n_devices=s)
+        live, tomb, num_live, _ = sharded_replay_select(
+            pk, dk, ver, order, add, size, mesh)
+        assert _mask_digest(live, tomb) == want, f"S={s}: {kind}"
+        assert num_live == int(live_h.sum())
