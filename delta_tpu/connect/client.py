@@ -48,6 +48,7 @@ from typing import Optional, Sequence
 
 import pyarrow as pa
 
+from delta_tpu import obs
 from delta_tpu.connect.protocol import (
     ipc_to_table,
     recv_frame,
@@ -61,7 +62,7 @@ _log = logging.getLogger("delta_tpu.connect")
 # Ops safe to resend after an ambiguous failure: they mutate nothing,
 # so a duplicate (reconnect retry or hedge) is at worst wasted work.
 _IDEMPOTENT = frozenset(
-    {"ping", "health", "read", "version", "history", "detail"})
+    {"ping", "health", "metrics", "read", "version", "history", "detail"})
 
 _error_types = None
 
@@ -134,7 +135,22 @@ class DeltaConnectClient:
                    sock: Optional[socket.socket] = None):
         """One request/response exchange. With ``sock=None`` the shared
         connection is used (serialized by the client lock; broken
-        sockets are dropped so the next attempt reconnects)."""
+        sockets are dropped so the next attempt reconnects).
+
+        Each attempt — initial, retry, or hedge — gets its own
+        `connect.attempt` span and stamps THAT span's ids into the
+        envelope, so the server-side subtree of every attempt hangs off
+        a distinct branch of one shared trace (a hedged read renders as
+        two sibling server subtrees)."""
+        with obs.span("connect.attempt", op=op,
+                      hedge=sock is not None) as att:
+            if att.recording:
+                params = {**params, "trace_id": att.trace_id,
+                          "parent_span_id": att.span_id}
+            return self._exchange(op, payload, params, sock)
+
+    def _exchange(self, op: str, payload: bytes, params: dict,
+                  sock: Optional[socket.socket]):
         if sock is not None:
             send_frame(sock, {"op": op, **params}, payload)
             envelope, out_payload = recv_frame(sock)
@@ -181,7 +197,9 @@ class DeltaConnectClient:
         from delta_tpu.utils.threads import shared_pool
 
         pool_submit = shared_pool().submit
-        primary = pool_submit(self._roundtrip, op, payload, params)
+        # obs.wrap: pool workers don't inherit the caller's contextvars,
+        # and both hedge legs must branch from the same connect.call span
+        primary = pool_submit(obs.wrap(self._roundtrip), op, payload, params)
         try:
             return primary.result(timeout=self._hedge_ms / 1000.0)
         except _FutureTimeout:
@@ -197,7 +215,7 @@ class DeltaConnectClient:
                 except OSError as e:
                     _log.debug("hedge socket close: %s", e)
 
-        hedge = pool_submit(_fresh)
+        hedge = pool_submit(obs.wrap(_fresh))
         pending = {primary, hedge}
         last_error: Optional[BaseException] = None
         while pending:
@@ -216,28 +234,31 @@ class DeltaConnectClient:
         if self._deadline_ms is not None:
             params.setdefault("deadline_ms", self._deadline_ms)
         idempotent = op in _IDEMPOTENT
-        try:
-            if idempotent and self._hedge_ms > 0:
-                envelope, out_payload = self._hedged(op, payload, params)
-            elif idempotent and self._policy is not None:
-                # ConnectionError (socket died → reconnect) and
-                # ServiceOverloadedError (shed before any work) are both
-                # transient; the policy backs off with decorrelated
-                # jitter.
-                envelope, out_payload = self._policy.call(
-                    lambda: self._roundtrip(op, payload, params))
-            else:
-                envelope, out_payload = self._roundtrip(op, payload, params)
-        except Exception as e:
-            # Record the error envelope only when this exception is the
-            # one the caller sees (an abandoned hedge attempt's error
-            # never reaches this frame). Transport errors carry none.
-            err_env = getattr(e, "envelope", None)
-            if err_env is not None:
-                self.last_envelope = err_env
-            raise
-        self.last_envelope = envelope
-        return envelope, out_payload
+        with obs.span("connect.call", op=op):
+            try:
+                if idempotent and self._hedge_ms > 0:
+                    envelope, out_payload = self._hedged(op, payload, params)
+                elif idempotent and self._policy is not None:
+                    # ConnectionError (socket died → reconnect) and
+                    # ServiceOverloadedError (shed before any work) are
+                    # both transient; the policy backs off with
+                    # decorrelated jitter.
+                    envelope, out_payload = self._policy.call(
+                        lambda: self._roundtrip(op, payload, params))
+                else:
+                    envelope, out_payload = self._roundtrip(
+                        op, payload, params)
+            except Exception as e:
+                # Record the error envelope only when this exception is
+                # the one the caller sees (an abandoned hedge attempt's
+                # error never reaches this frame). Transport errors
+                # carry none.
+                err_env = getattr(e, "envelope", None)
+                if err_env is not None:
+                    self.last_envelope = err_env
+                raise
+            self.last_envelope = envelope
+            return envelope, out_payload
 
     def close(self) -> None:
         with self._lock:
@@ -265,6 +286,14 @@ class DeltaConnectClient:
         op; use it against `DeltaServeServer`."""
         env, _ = self._call("health")
         return env.get("health", {})
+
+    def metrics_text(self) -> str:
+        """The server's Prometheus-text metrics exposition (served
+        inline on `DeltaServeServer` even under full queues, like
+        `health`; the plain connect server serves it via the op
+        table)."""
+        env, _ = self._call("metrics")
+        return env.get("metrics", "")
 
     def read_table(self, path: str, columns: Optional[Sequence[str]] = None,
                    filter: Optional[str] = None,

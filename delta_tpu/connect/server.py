@@ -66,7 +66,15 @@ class _Handler(socketserver.BaseRequestHandler):
             # server-side error. Silent close is reserved for failures
             # of the send itself (peer gone / stream mid-frame).
             try:
-                result, out_payload = self.server._dispatch(envelope, payload)
+                # Adopt the client's trace context (if stamped) so this
+                # request's server-side spans parent under the client's
+                # connect.attempt span — one trace across processes.
+                with obs.remote_parent(envelope.get("trace_id"),
+                                       envelope.get("parent_span_id")):
+                    with obs.span("connect.request",
+                                  op=envelope.get("op")):
+                        result, out_payload = self.server._dispatch(
+                            envelope, payload)
             except Exception as e:  # error envelope, keep connection alive
                 env = {
                     "ok": False,
@@ -146,6 +154,7 @@ class DeltaConnectServer(socketserver.ThreadingTCPServer):
 
 def serve(path_root: str, host: str = "127.0.0.1", port: int = 9477):
     """Blocking entry point: `python -m delta_tpu.connect.server /root`."""
+    obs.set_process_label("delta-connect")
     srv = DeltaConnectServer(host, port, allowed_root=path_root)
     print(f"delta-tpu connect server on {srv.address}, root={path_root}")
     srv.serve_forever()

@@ -26,14 +26,32 @@ from delta_tpu.obs.export import (
     span_to_dict,
     write_chrome_trace,
 )
+from delta_tpu.obs.expose import (
+    CONTENT_TYPE,
+    metric_catalog,
+    parse_prometheus,
+    prom_name,
+    render_prometheus,
+)
+from delta_tpu.obs.flight import FlightRecorder
 from delta_tpu.obs.registry import (
+    EXPORT_BUCKETS,
     Counter,
+    Gauge,
     Histogram,
     Registry,
     counter,
+    gauge,
     histogram,
     metrics_snapshot,
     registry,
+)
+from delta_tpu.obs.slo import (
+    Breach,
+    Objective,
+    SloEngine,
+    SloVerdict,
+    serve_objectives,
 )
 from delta_tpu.obs.trace import (
     MODE_OFF,
@@ -44,14 +62,20 @@ from delta_tpu.obs.trace import (
     add_exporter,
     current_span,
     get_finished_spans,
+    process_label,
+    remote_parent,
     remove_exporter,
     reset_trace_buffer,
     set_attr,
     set_attrs,
+    set_process_label,
     set_trace_mode,
+    set_trace_sample,
     span,
+    trace_context,
     trace_enabled,
     trace_mode,
+    trace_sample,
     wrap,
 )
 
@@ -65,33 +89,53 @@ if trace_enabled():
     del _install_env_exporter_once
 
 __all__ = [
+    "CONTENT_TYPE",
+    "EXPORT_BUCKETS",
     "MODE_OFF",
     "MODE_ON",
     "MODE_VERBOSE",
+    "Breach",
     "Counter",
+    "FlightRecorder",
+    "Gauge",
     "Histogram",
     "JsonlExporter",
+    "Objective",
     "Registry",
+    "SloEngine",
+    "SloVerdict",
     "Span",
     "add_event",
     "add_exporter",
     "chrome_trace",
     "counter",
     "current_span",
+    "gauge",
     "get_finished_spans",
     "histogram",
     "load_spans",
+    "metric_catalog",
     "metrics_snapshot",
+    "parse_prometheus",
+    "process_label",
+    "prom_name",
     "registry",
+    "remote_parent",
     "remove_exporter",
+    "render_prometheus",
     "reset_trace_buffer",
+    "serve_objectives",
     "set_attr",
     "set_attrs",
+    "set_process_label",
     "set_trace_mode",
+    "set_trace_sample",
     "span",
     "span_to_dict",
+    "trace_context",
     "trace_enabled",
     "trace_mode",
+    "trace_sample",
     "wrap",
     "write_chrome_trace",
 ]

@@ -105,6 +105,8 @@ def _spans_from_chrome(doc: Dict[str, object]) -> List[Dict[str, object]]:
             "status": args.pop("status", "ok"),
             "thread_id": ev.get("tid", 0),
             "thread_name": None,
+            "pid": ev.get("pid", 0),
+            "process": args.pop("process", None),
             "attrs": args,
             "events": [],
         })
@@ -116,23 +118,32 @@ def chrome_trace(spans: Iterable, pid: Optional[int] = None) -> Dict[str, object
 
     Every span becomes a ``ph: "X"`` complete event; trace/span/parent
     ids and attributes ride in ``args`` so the conversion is lossless
-    enough for `load_spans` to round-trip. Thread names are emitted as
-    ``ph: "M"`` metadata events.
+    enough for `load_spans` to round-trip. Each span keeps its recorded
+    pid (merged multi-process dumps render as separate process rows) and
+    `process_name`/`thread_name` ``ph: "M"`` metadata events group the
+    timeline by process label and worker-thread name; `pid` only
+    overrides spans that carry no pid of their own (legacy records).
     """
-    if pid is None:
-        pid = os.getpid()
+    default_pid = os.getpid() if pid is None else pid
     events: List[Dict[str, object]] = []
-    thread_names: Dict[int, str] = {}
+    thread_names: Dict[tuple, str] = {}
+    process_names: Dict[int, str] = {}
     for s in spans:
         d = span_to_dict(s)
+        span_pid = d.get("pid") or default_pid
         tid = d.get("thread_id") or 0
         tname = d.get("thread_name")
-        if tname and tid not in thread_names:
-            thread_names[tid] = tname
+        if tname and (span_pid, tid) not in thread_names:
+            thread_names[(span_pid, tid)] = tname
+        pname = d.get("process")
+        if pname and span_pid not in process_names:
+            process_names[span_pid] = pname
         args = dict(d.get("attrs") or {})
         args["trace_id"] = d.get("trace_id")
         args["span_id"] = d.get("span_id")
         args["parent_id"] = d.get("parent_id")
+        if pname:
+            args["process"] = pname
         if d.get("status") and d["status"] != "ok":
             args["status"] = d["status"]
         for ev in d.get("events") or []:
@@ -140,7 +151,7 @@ def chrome_trace(spans: Iterable, pid: Optional[int] = None) -> Dict[str, object
                 "name": ev.get("name"),
                 "ph": "i",
                 "ts": ev.get("ts_unix_ns", 0) / 1000.0,
-                "pid": pid,
+                "pid": span_pid,
                 "tid": tid,
                 "s": "t",
                 "args": dict(ev.get("attrs") or {}),
@@ -152,17 +163,26 @@ def chrome_trace(spans: Iterable, pid: Optional[int] = None) -> Dict[str, object
             "ph": "X",
             "ts": d.get("start_unix_ns", 0) / 1000.0,
             "dur": (d.get("duration_ns") or 0) / 1000.0,
-            "pid": pid,
+            "pid": span_pid,
             "tid": tid,
             "args": args,
         })
-    for tid, tname in sorted(thread_names.items()):
+        if span_pid not in process_names:
+            process_names[span_pid] = f"pid {span_pid}"
+    for (tpid, tid), tname in sorted(thread_names.items()):
         events.append({
             "name": "thread_name",
             "ph": "M",
-            "pid": pid,
+            "pid": tpid,
             "tid": tid,
             "args": {"name": tname},
+        })
+    for ppid, pname in sorted(process_names.items()):
+        events.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": ppid,
+            "args": {"name": pname},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

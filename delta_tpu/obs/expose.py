@@ -1,0 +1,196 @@
+"""Prometheus text exposition for the metrics registry.
+
+`render_prometheus` turns `Registry.snapshot()` into the Prometheus
+text format (version 0.0.4): counters as `_total` series, histograms as
+cumulative `_bucket`/`_sum`/`_count` families over the fixed
+`EXPORT_BUCKETS` ladder (identical boundaries fleet-wide, so scrapes
+from any process aggregate), gauges as plain series. Circuit-breaker
+states are rendered as one labeled gauge series per endpoint.
+
+Dotted registry names map to Prometheus names as
+``delta_tpu_<name with . → _>``; the mapping is deterministic and
+reversible for catalogued names.
+
+The render unions the live snapshot with `resources/metric_names.json`
+(the same catalog the `metric-name-conformance` lint pass enforces):
+catalogued instruments that no loaded module has touched yet are
+emitted as zero, so a scrape's shape does not depend on import order —
+and each catalogued series carries its catalog description as `# HELP`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+from delta_tpu.obs.registry import EXPORT_BUCKETS, metrics_snapshot
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PREFIX = "delta_tpu_"
+
+_CATALOG_ENV = "DELTA_LINT_METRIC_CATALOG"
+
+_catalog_cache: Optional[Dict[str, Dict[str, str]]] = None
+_catalog_lock = threading.Lock()
+
+
+def _catalog_path() -> str:
+    override = os.environ.get(_CATALOG_ENV)
+    if override:
+        return override
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "resources", "metric_names.json")
+
+
+def metric_catalog() -> Dict[str, Dict[str, str]]:
+    """The metric-name catalog: {"counters"|"histograms"|"gauges":
+    {dotted_name: help_text}}. Missing/unreadable file → empty catalog
+    (exposition still renders whatever the registry holds)."""
+    global _catalog_cache
+    if _catalog_cache is not None and not os.environ.get(_CATALOG_ENV):
+        return _catalog_cache
+    try:
+        with open(_catalog_path(), encoding="utf-8") as fh:
+            raw = json.load(fh)
+    except (OSError, ValueError):
+        raw = {}
+    catalog = {
+        kind: dict(raw.get(kind) or {})
+        for kind in ("counters", "histograms", "gauges")
+    }
+    if not os.environ.get(_CATALOG_ENV):
+        with _catalog_lock:
+            _catalog_cache = catalog
+    return catalog
+
+
+def prom_name(dotted: str, suffix: str = "") -> str:
+    """`storage.read.calls` → `delta_tpu_storage_read_calls<suffix>`."""
+    return _PREFIX + dotted.replace(".", "_").replace("-", "_") + suffix
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "0"
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, float):
+        if value != value:  # NaN
+            return "NaN"
+        return repr(value)
+    if isinstance(value, int):
+        return str(value)
+    return "0"
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+_BREAKER_STATE_CODES = {"closed": 0, "open": 1, "half_open": 2}
+
+
+def _breaker_lines(lines) -> None:
+    # imported lazily: resilience instruments itself through obs, so a
+    # module-level import here would be a cycle
+    try:
+        from delta_tpu.resilience.breaker import breaker_states
+    except ImportError:
+        return
+    states = breaker_states()
+    if not states:
+        return
+    name = prom_name("resilience.breaker_state")
+    lines.append(f"# HELP {name} Circuit-breaker state per endpoint "
+                 "(0=closed, 1=open, 2=half_open).")
+    lines.append(f"# TYPE {name} gauge")
+    for endpoint in sorted(states):
+        snap = states[endpoint]
+        code = _BREAKER_STATE_CODES.get(str(snap.get("state")), 0)
+        lines.append(
+            f'{name}{{endpoint="{_escape_label(endpoint)}"}} {code}'
+        )
+
+
+def render_prometheus(snapshot: Optional[dict] = None,
+                      catalog: Optional[dict] = None) -> str:
+    """Render the registry (default: live `metrics_snapshot()`) as
+    Prometheus exposition text. Catalogued-but-untouched instruments
+    render as zero so the scrape shape is import-order independent."""
+    if snapshot is None:
+        snapshot = metrics_snapshot()
+    if catalog is None:
+        catalog = metric_catalog()
+    counters = dict(snapshot.get("counters") or {})
+    histograms = dict(snapshot.get("histograms") or {})
+    gauges = dict(snapshot.get("gauges") or {})
+    cat_counters = catalog.get("counters") or {}
+    cat_histograms = catalog.get("histograms") or {}
+    cat_gauges = catalog.get("gauges") or {}
+    for name in cat_counters:
+        counters.setdefault(name, 0)
+    for name in cat_histograms:
+        histograms.setdefault(name, None)
+    for name in cat_gauges:
+        gauges.setdefault(name, 0)
+
+    lines = []
+    for dotted in sorted(counters):
+        name = prom_name(dotted, "_total")
+        help_text = cat_counters.get(dotted)
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(counters[dotted])}")
+    for dotted in sorted(gauges):
+        name = prom_name(dotted)
+        help_text = cat_gauges.get(dotted)
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(gauges[dotted])}")
+    for dotted in sorted(histograms):
+        name = prom_name(dotted)
+        help_text = cat_histograms.get(dotted)
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} histogram")
+        h = histograms[dotted]
+        if h is None:
+            h = {"count": 0, "sum": 0, "buckets": None}
+        buckets = h.get("buckets")
+        if buckets is None:
+            buckets = {repr(b): 0 for b in EXPORT_BUCKETS}
+            buckets["+Inf"] = h.get("count") or 0
+        for bound, cumulative in buckets.items():
+            lines.append(
+                f'{name}_bucket{{le="{bound}"}} {_fmt(cumulative)}'
+            )
+        lines.append(f"{name}_sum {_fmt(h.get('sum'))}")
+        lines.append(f"{name}_count {_fmt(h.get('count'))}")
+    _breaker_lines(lines)
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Parse exposition text back to {series_key: value} — series_key is
+    the metric name plus any label block verbatim (`delta_tpu_x_total`,
+    `delta_tpu_x_bucket{le="1.0"}`). Tests and the CLI's --grep use
+    this; it handles exactly the subset `render_prometheus` emits."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            out[key] = float(value)
+        except ValueError:
+            continue
+    return out

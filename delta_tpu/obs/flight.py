@@ -1,0 +1,122 @@
+"""Flight recorder: a bounded ring of recent *complete* request traces.
+
+Black-box-style capture for postmortems: an exporter (register with
+`obs.add_exporter(recorder)`) groups finished spans by trace id; when a
+trace's root span finishes — parent-less, or named in `root_names`
+(server request roots finish before their client-side parents, which
+live in another process) — the assembled trace moves into a bounded
+ring of completed traces. When an SLO gate fires (`obs/slo.py`), the
+offending trace is still in the ring and `dump_jsonl` writes it out, so
+a latency regression arrives with its own trace attached.
+
+Memory is bounded on both sides: at most `max_open` in-flight traces
+(oldest evicted first — a trace whose root never finishes cannot leak)
+and `max_traces` completed ones.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from delta_tpu.obs.export import span_to_dict
+
+
+class FlightRecorder:
+    """Span exporter assembling complete per-request traces.
+
+    `root_names` marks span names that complete a trace even when the
+    span has a remote parent (the in-process root of a server-side
+    request). A parent-less span always completes its trace.
+    """
+
+    def __init__(self, max_traces: int = 256, max_open: int = 4096,
+                 root_names: Optional[Iterable[str]] = None):
+        self._max_open = max_open
+        self._root_names = frozenset(root_names or ())
+        self._open: "collections.OrderedDict[str, List[dict]]" = (
+            collections.OrderedDict()
+        )
+        self._complete: collections.deque = collections.deque(
+            maxlen=max_traces
+        )
+        self._index: Dict[str, List[dict]] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, span) -> None:
+        d = span_to_dict(span)
+        trace_id = d.get("trace_id")
+        if not trace_id:
+            return
+        is_root = (d.get("parent_id") is None
+                   or d.get("name") in self._root_names)
+        with self._lock:
+            spans = self._open.get(trace_id)
+            if spans is None:
+                spans = []
+                self._open[trace_id] = spans
+                while len(self._open) > self._max_open:
+                    evicted_id, _ = self._open.popitem(last=False)
+                    self._index.pop(evicted_id, None)
+            spans.append(d)
+            if is_root:
+                self._open.pop(trace_id, None)
+                existing = self._index.get(trace_id)
+                if existing is not None:
+                    # same trace completed again (e.g. the client-side
+                    # root finishing after the server-side root in a
+                    # single-process test, or a hedged duplicate):
+                    # merge — in-place, so the ring entry updates too
+                    existing.extend(spans)
+                    return
+                if len(self._complete) == self._complete.maxlen:
+                    oldest = self._complete[0]
+                    self._index.pop(oldest[0].get("trace_id"), None)
+                self._complete.append(spans)
+                self._index[trace_id] = spans
+
+    def get(self, trace_id: str) -> Optional[List[dict]]:
+        """The completed trace for `trace_id` (span dicts in finish
+        order), or None if it never completed / already rolled off."""
+        with self._lock:
+            spans = self._index.get(trace_id)
+            return list(spans) if spans is not None else None
+
+    def trace_ids(self) -> List[str]:
+        """Completed trace ids, oldest first."""
+        with self._lock:
+            return [t[0].get("trace_id") for t in self._complete]
+
+    def __len__(self) -> int:
+        return len(self._complete)
+
+    def dump_jsonl(self, path: str,
+                   trace_id: Optional[str] = None) -> int:
+        """Write completed traces (or just `trace_id`'s) as JSONL span
+        records readable by `delta-trace`. Returns spans written."""
+        with self._lock:
+            if trace_id is not None:
+                spans = list(self._index.get(trace_id) or ())
+            else:
+                spans = [d for t in self._complete for d in t]
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            for d in spans:
+                fh.write(json.dumps(d, sort_keys=True, default=str))
+                fh.write("\n")
+        return len(spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._open.clear()
+            self._complete.clear()
+            self._index.clear()
+
+    def __repr__(self):
+        return (f"FlightRecorder(complete={len(self._complete)}, "
+                f"open={len(self._open)})")

@@ -17,8 +17,9 @@ gating.
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict
+from typing import Callable, Dict, Optional
 
 
 class Counter:
@@ -42,12 +43,24 @@ class Counter:
         return f"Counter({self.name!r}, {self.value})"
 
 
-class Histogram:
-    """Streaming summary: count/sum/min/max. No bucket vector — the
-    per-operation latency distribution lives in spans; this is the cheap
-    aggregate for code paths too hot to span."""
+# Fixed export buckets shared by every histogram so scrapes from
+# different processes aggregate cleanly (Prometheus-style cumulative
+# buckets require identical boundaries fleet-wide). Log-spaced 13-point
+# ladder covering sub-ms spins through multi-minute soaks; values are
+# unit-agnostic (callers observe ns, ms, or depths — the ladder is wide
+# enough for all three).
+EXPORT_BUCKETS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1_000.0,
+                  5_000.0, 10_000.0, 100_000.0, 1_000_000.0,
+                  100_000_000.0, 10_000_000_000.0)
 
-    __slots__ = ("name", "count", "sum", "min", "max")
+
+class Histogram:
+    """Streaming summary: count/sum/min/max plus a fixed-boundary bucket
+    vector (`EXPORT_BUCKETS`) for aggregatable Prometheus exposition.
+    The per-operation latency distribution still lives in spans; this is
+    the cheap aggregate for code paths too hot to span."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "buckets")
 
     def __init__(self, name: str):
         self.name = name
@@ -55,6 +68,9 @@ class Histogram:
         self.sum = 0
         self.min = None
         self.max = None
+        # per-boundary (non-cumulative) hit counts + overflow slot;
+        # exposition cumulates at render time
+        self.buckets = [0] * (len(EXPORT_BUCKETS) + 1)
 
     def observe(self, value) -> None:
         self.count += 1
@@ -65,19 +81,83 @@ class Histogram:
         mx = self.max
         if mx is None or value > mx:
             self.max = value
+        self.buckets[bisect.bisect_left(EXPORT_BUCKETS, value)] += 1
 
     def reset(self) -> None:
         self.count = 0
         self.sum = 0
         self.min = None
         self.max = None
+        self.buckets = [0] * (len(EXPORT_BUCKETS) + 1)
 
     @property
     def mean(self):
         return self.sum / self.count if self.count else None
 
+    def bucket_counts(self) -> Dict[str, int]:
+        """Cumulative bucket counts keyed by upper bound ('+Inf' last)."""
+        out: Dict[str, int] = {}
+        running = 0
+        for bound, n in zip(EXPORT_BUCKETS, self.buckets):
+            running += n
+            out[repr(bound)] = running
+        out["+Inf"] = running + self.buckets[-1]
+        return out
+
     def __repr__(self):
         return f"Histogram({self.name!r}, n={self.count}, sum={self.sum})"
+
+
+class Gauge:
+    """Point-in-time value: settable directly (`set`/`inc`/`dec`) or
+    bound to a callback (`set_fn`) evaluated at read time — the callback
+    form lets structures like the admission queue expose their depth
+    without maintaining a shadow count on the hot path.
+
+    Callbacks must be cheap, lock-free, and exception-safe candidates:
+    `read()` swallows callback errors to None so a half-torn structure
+    during shutdown can't break a scrape."""
+
+    __slots__ = ("name", "value", "_fn")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._fn: Optional[Callable[[], object]] = None
+
+    def set(self, value) -> None:
+        self._fn = None
+        self.value = value
+
+    def inc(self, n=1) -> None:
+        self.value += n
+
+    def dec(self, n=1) -> None:
+        self.value -= n
+
+    def set_fn(self, fn: Callable[[], object]) -> None:
+        """Bind a zero-arg callback; subsequent `read()`s return its
+        result. Callbacks run OUTSIDE the registry lock at snapshot."""
+        self._fn = fn
+
+    def read(self):
+        fn = self._fn
+        if fn is None:
+            return self.value
+        try:
+            return fn()
+        # delta-lint: disable=except-swallow (audited: a scrape must
+        # never fail because one gauge callback raced its structure's
+        # teardown; absent value renders as 0)
+        except Exception:
+            return None
+
+    def reset(self) -> None:
+        self.value = 0
+
+    def __repr__(self):
+        kind = "fn" if self._fn is not None else "value"
+        return f"Gauge({self.name!r}, {kind}={self.read()})"
 
 
 class Registry:
@@ -86,6 +166,7 @@ class Registry:
     def __init__(self):
         self._counters: Dict[str, Counter] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
@@ -102,18 +183,33 @@ class Registry:
                 h = self._histograms.setdefault(name, Histogram(name))
         return h
 
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         """Point-in-time dump: {'counters': {name: value}, 'histograms':
-        {name: {count, sum, min, max}}}. Zero-valued instruments are
-        included — absence means never created, not never hit."""
+        {name: {count, sum, min, max, buckets}}, 'gauges': {name:
+        value}}. Zero-valued instruments are included — absence means
+        never created, not never hit."""
         with self._lock:
             counters = {n: c.value for n, c in self._counters.items()}
             histograms = {
                 n: {"count": h.count, "sum": h.sum,
-                    "min": h.min, "max": h.max}
+                    "min": h.min, "max": h.max,
+                    "buckets": h.bucket_counts()}
                 for n, h in self._histograms.items()
             }
-        return {"counters": counters, "histograms": histograms}
+            gauge_objs = list(self._gauges.values())
+        # gauge callbacks may take the owning structure's locks (e.g.
+        # len() over a guarded deque); evaluate them outside the registry
+        # lock so no registry→structure lock order is ever established
+        gauges = {g.name: g.read() for g in gauge_objs}
+        return {"counters": counters, "histograms": histograms,
+                "gauges": gauges}
 
     def reset(self) -> None:
         """Zero every instrument (tests/bench); instruments stay
@@ -123,6 +219,8 @@ class Registry:
                 c.reset()
             for h in self._histograms.values():
                 h.reset()
+            for g in self._gauges.values():
+                g.reset()
 
 
 _REGISTRY = Registry()
@@ -140,6 +238,11 @@ def counter(name: str) -> Counter:
 def histogram(name: str) -> Histogram:
     """The process-wide histogram named `name` (created on first use)."""
     return _REGISTRY.histogram(name)
+
+
+def gauge(name: str) -> Gauge:
+    """The process-wide gauge named `name` (created on first use)."""
+    return _REGISTRY.gauge(name)
 
 
 def metrics_snapshot() -> Dict[str, Dict[str, object]]:

@@ -1,0 +1,201 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+Objectives are declared, not hand-rolled: a latency objective ("p99 of
+serve requests ≤ 50ms") or a ratio objective ("shed rate ≤ 2%") each
+reduce to an error budget — the tolerated bad-event fraction — and an
+alert fires on *burn rate*, the ratio of observed bad fraction to
+budget, following the SRE-workbook multi-window recipe: breach only
+when BOTH a short window (fast reaction, noisy alone) and a long
+window (evidence, slow alone) burn above threshold. A p99-latency
+objective is the ratio objective "fraction of events slower than the
+threshold ≤ 1%" — one mechanism covers both shapes.
+
+`SloEngine.record()` is called per finished request with its outcome
+and latency; `evaluate()` returns a `SloVerdict` whose breaches carry a
+`worst_trace_id` so the flight recorder (`obs/flight.py`) can dump the
+offending trace. The clock is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One service-level objective.
+
+    - latency form: `threshold_ms` set, `bad_outcomes` empty — an event
+      is bad when it ran longer than `threshold_ms` (budget 0.01 ≡ "p99
+      under threshold").
+    - ratio form: `bad_outcomes` set — an event is bad when its outcome
+      string is in the set (budget is the tolerated fraction).
+    """
+
+    name: str
+    budget: float
+    threshold_ms: Optional[float] = None
+    bad_outcomes: FrozenSet[str] = frozenset()
+
+    def is_bad(self, outcome: str, latency_ms: float) -> bool:
+        if self.threshold_ms is not None:
+            return latency_ms > self.threshold_ms
+        return outcome in self.bad_outcomes
+
+
+@dataclass
+class Breach:
+    objective: str
+    burn_short: float
+    burn_long: float
+    bad_short: int
+    total_short: int
+    bad_long: int
+    total_long: int
+    worst_trace_id: Optional[str] = None
+
+
+@dataclass
+class SloVerdict:
+    ok: bool
+    breaches: List[Breach] = field(default_factory=list)
+    burn_rates: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "breaches": [
+                {"objective": b.objective,
+                 "burn_short": round(b.burn_short, 3),
+                 "burn_long": round(b.burn_long, 3),
+                 "bad_long": b.bad_long, "total_long": b.total_long,
+                 "worst_trace_id": b.worst_trace_id}
+                for b in self.breaches
+            ],
+            "burn_rates": {
+                name: [round(s, 3), round(lg, 3)]
+                for name, (s, lg) in self.burn_rates.items()
+            },
+        }
+
+
+class SloEngine:
+    """Sliding-window burn-rate evaluator over recorded request events.
+
+    `burn_threshold` is the multiple of budget-consumption-rate that
+    constitutes a breach (SRE workbook's fast-burn pages use 14.4 over
+    1h/5m; soaks here run seconds, so both windows shrink accordingly).
+    `min_events` guards cold windows — a 1-of-2 blip is not a p99.
+    """
+
+    def __init__(self, objectives: List[Objective],
+                 short_window_s: float = 5.0,
+                 long_window_s: float = 60.0,
+                 burn_threshold: float = 1.0,
+                 min_events: int = 20,
+                 clock=time.monotonic):
+        if short_window_s > long_window_s:
+            raise ValueError("short window must not exceed long window")
+        self.objectives = list(objectives)
+        self.short_window_s = short_window_s
+        self.long_window_s = long_window_s
+        self.burn_threshold = burn_threshold
+        self.min_events = min_events
+        self._clock = clock
+        # (ts, outcome, latency_ms, trace_id); bounded by time-pruning
+        # on record — a stalled evaluate() can't let it grow unbounded
+        self._events: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+
+    def record(self, outcome: str, latency_ms: float,
+               trace_id: Optional[str] = None) -> None:
+        now = self._clock()
+        horizon = now - self.long_window_s
+        with self._lock:
+            self._events.append((now, outcome, latency_ms, trace_id))
+            while self._events and self._events[0][0] < horizon:
+                self._events.popleft()
+
+    def _window_stats(self, events, objective: Objective, now: float,
+                      window_s: float):
+        cutoff = now - window_s
+        total = bad = 0
+        worst_latency = -1.0
+        worst_trace = None
+        for ts, outcome, latency_ms, trace_id in events:
+            if ts < cutoff:
+                continue
+            total += 1
+            if objective.is_bad(outcome, latency_ms):
+                bad += 1
+                if trace_id is not None and latency_ms >= worst_latency:
+                    worst_latency = latency_ms
+                    worst_trace = trace_id
+        return total, bad, worst_trace
+
+    def evaluate(self) -> SloVerdict:
+        """Current verdict across every objective. A breach requires
+        both windows to burn above `burn_threshold` AND the long window
+        to hold at least `min_events` events."""
+        now = self._clock()
+        with self._lock:
+            events = list(self._events)
+        verdict = SloVerdict(ok=True)
+        for obj in self.objectives:
+            t_long, b_long, worst = self._window_stats(
+                events, obj, now, self.long_window_s)
+            t_short, b_short, _ = self._window_stats(
+                events, obj, now, self.short_window_s)
+            frac_long = b_long / t_long if t_long else 0.0
+            frac_short = b_short / t_short if t_short else 0.0
+            burn_long = frac_long / obj.budget if obj.budget else 0.0
+            burn_short = frac_short / obj.budget if obj.budget else 0.0
+            verdict.burn_rates[obj.name] = (burn_short, burn_long)
+            if (t_long >= self.min_events
+                    and burn_short > self.burn_threshold
+                    and burn_long > self.burn_threshold):
+                verdict.ok = False
+                verdict.breaches.append(Breach(
+                    objective=obj.name,
+                    burn_short=burn_short, burn_long=burn_long,
+                    bad_short=b_short, total_short=t_short,
+                    bad_long=b_long, total_long=t_long,
+                    worst_trace_id=worst,
+                ))
+        return verdict
+
+    def event_count(self) -> int:
+        return len(self._events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+def serve_objectives(p99_ms: float = 0.0, shed_rate: float = 0.0,
+                     stale_rate: float = 0.0,
+                     deadline_rate: float = 0.0) -> List[Objective]:
+    """The serve path's standard objective set; a zero/negative knob
+    disables that objective (ServeConfig wires DELTA_TPU_SERVE_SLO_*
+    straight through)."""
+    objectives: List[Objective] = []
+    if p99_ms > 0:
+        objectives.append(Objective(
+            name="p99_latency", budget=0.01, threshold_ms=p99_ms))
+    if shed_rate > 0:
+        objectives.append(Objective(
+            name="shed_rate", budget=shed_rate,
+            bad_outcomes=frozenset({"shed"})))
+    if stale_rate > 0:
+        objectives.append(Objective(
+            name="stale_serve_rate", budget=stale_rate,
+            bad_outcomes=frozenset({"stale"})))
+    if deadline_rate > 0:
+        objectives.append(Objective(
+            name="deadline_miss_rate", budget=deadline_rate,
+            bad_outcomes=frozenset({"deadline"})))
+    return objectives

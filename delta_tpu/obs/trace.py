@@ -13,6 +13,13 @@ manager singleton — no allocation, no clock read, no contextvar touch.
 `verbose` additionally enables high-cardinality spans (per-file storage
 reads) that `on` folds into counters.
 
+Sampling: `DELTA_TPU_TRACE_SAMPLE=<0..1>` (default 1.0) keeps each new
+trace ROOT with that probability — head-based, so a kept trace is
+always complete and a dropped one costs one RNG draw. The decision is
+made once at the root and inherited by every descendant (including
+cross-thread children via `wrap()` and cross-process children via the
+envelope ids, which an unsampled client simply never stamps).
+
 Finished spans land in a bounded in-process ring buffer
 (`get_finished_spans`) and are fanned out to registered exporters;
 `DELTA_TPU_TRACE_FILE=<path>` auto-installs a JSONL exporter.
@@ -24,6 +31,7 @@ import collections
 import contextvars
 import logging
 import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional
@@ -49,11 +57,58 @@ def _mode_from_env() -> int:
 
 _mode: int = _mode_from_env()
 
+
+def _sample_from_env() -> float:
+    raw = os.environ.get("DELTA_TPU_TRACE_SAMPLE")
+    if not raw:
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        _log.warning("bad DELTA_TPU_TRACE_SAMPLE=%r; sampling stays at 1",
+                     raw)
+        return 1.0
+    return min(1.0, max(0.0, rate))
+
+
+_sample_rate: float = _sample_from_env()
+_sample_rng = random.Random()  # trace keep/drop only — not security
+
+
+def set_trace_sample(rate: Optional[float]) -> None:
+    """Set the head-sampling rate (fraction of new trace roots kept,
+    clamped to [0, 1]); None re-reads `DELTA_TPU_TRACE_SAMPLE`."""
+    global _sample_rate
+    if rate is None:
+        _sample_rate = _sample_from_env()
+    else:
+        _sample_rate = min(1.0, max(0.0, float(rate)))
+
+
+def trace_sample() -> float:
+    return _sample_rate
+
 # the active span of the calling context; child contexts (threads) do
 # NOT inherit it automatically — use wrap() to propagate across pools
 _CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "delta_tpu_current_span", default=None
 )
+
+# human label for this process in merged multi-process traces (the
+# Chrome exporter's process_name metadata); CLI entry points set it
+# ("delta-serve", "delta-connect"), libraries leave it None
+_process_label: Optional[str] = os.environ.get("DELTA_TPU_TRACE_PROCESS")
+
+
+def set_process_label(label: Optional[str]) -> None:
+    """Name this process for multi-process trace rendering. Spans record
+    the label at creation, so set it before serving traffic."""
+    global _process_label
+    _process_label = label
+
+
+def process_label() -> Optional[str]:
+    return _process_label
 
 
 def _new_id(nbytes: int) -> str:
@@ -70,7 +125,8 @@ class Span:
 
     __slots__ = ("trace_id", "span_id", "parent_id", "name",
                  "start_unix_ns", "monotonic_start_ns", "duration_ns",
-                 "attrs", "events", "status", "thread_id", "thread_name")
+                 "attrs", "events", "status", "thread_id", "thread_name",
+                 "pid", "process")
 
     def __init__(self, name: str, trace_id: str, span_id: str,
                  parent_id: Optional[str], attrs: Dict[str, object]):
@@ -87,6 +143,8 @@ class Span:
         cur = threading.current_thread()
         self.thread_id = cur.ident or 0
         self.thread_name = cur.name
+        self.pid = os.getpid()
+        self.process = _process_label
 
     def set_attr(self, key: str, value) -> None:
         self.attrs[key] = value
@@ -114,6 +172,8 @@ class Span:
             "status": self.status,
             "thread_id": self.thread_id,
             "thread_name": self.thread_name,
+            "pid": self.pid,
+            "process": self.process,
             "attrs": self.attrs,
             "events": self.events,
         }
@@ -168,6 +228,18 @@ _NOOP_SPAN = _NoopSpan()
 _NOOP_CTX = _NoopCtx()
 
 
+class _SuppressedMarker:
+    """Sentinel installed in `_CURRENT` for the extent of an UNSAMPLED
+    trace root: descendants (same-thread, and cross-thread via wrap())
+    see it and record nothing, so a dropped trace is dropped whole —
+    never a parent-less fragment."""
+
+    __slots__ = ()
+
+
+_SUPPRESSED = _SuppressedMarker()
+
+
 class _SpanCtx:
     """Live-path context manager: creates the span on __enter__ (so the
     parent is read from the entering context, not the creating one)."""
@@ -180,11 +252,18 @@ class _SpanCtx:
         self._span: Optional[Span] = None
         self._token = None
 
-    def __enter__(self) -> Span:
+    def __enter__(self):
         parent = _CURRENT.get()
+        if parent is _SUPPRESSED:
+            return _NOOP_SPAN  # inside an unsampled trace
         if parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
         else:
+            # new trace root: the head-sampling decision happens here,
+            # once, and binds the whole (cross-thread) subtree below
+            if _sample_rate < 1.0 and _sample_rng.random() >= _sample_rate:
+                self._token = _CURRENT.set(_SUPPRESSED)
+                return _NOOP_SPAN
             trace_id, parent_id = _new_id(16), None
         s = Span(self._name, trace_id, _new_id(8), parent_id, self._attrs)
         self._span = s
@@ -193,6 +272,13 @@ class _SpanCtx:
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         s = self._span
+        if s is None:
+            # suppressed (unsampled root, or child of one): unwind the
+            # sentinel if this ctx installed it, record nothing
+            if self._token is not None:
+                _CURRENT.reset(self._token)
+                self._token = None
+            return False
         s.duration_ns = time.perf_counter_ns() - s.monotonic_start_ns
         if exc_type is not None:
             s.status = "error"
@@ -215,32 +301,95 @@ def span(name: str, _verbose: bool = False, **attrs):
     """
     if _mode == MODE_OFF or (_verbose and _mode < MODE_VERBOSE):
         return _NOOP_CTX
+    if _CURRENT.get() is _SUPPRESSED:
+        return _NOOP_CTX  # unsampled trace: skip the ctx allocation too
     return _SpanCtx(name, attrs)
 
 
 def current_span() -> Optional[Span]:
     """The context's active span, or None outside any span (or when
-    tracing is off)."""
-    return _CURRENT.get()
+    tracing is off / the trace was not sampled)."""
+    cur = _CURRENT.get()
+    return None if cur is _SUPPRESSED else cur
+
+
+def trace_context() -> Optional[tuple]:
+    """(trace_id, span_id) of the active span for wire propagation, or
+    None outside any span / tracing off / trace unsampled (so remote
+    children of a dropped trace are dropped too). Stamp these into an
+    outgoing request envelope; the server side adopts them via
+    remote_parent()."""
+    cur = _CURRENT.get()
+    if cur is None or cur is _SUPPRESSED:
+        return None
+    return (cur.trace_id, cur.span_id)
+
+
+# envelope trace ids arrive from untrusted peers; accept only plain hex
+# strings of sane length so a hostile client can't bloat span records
+_MAX_WIRE_ID_LEN = 64
+
+
+def _valid_wire_id(value) -> bool:
+    return (isinstance(value, str) and 0 < len(value) <= _MAX_WIRE_ID_LEN
+            and all(c in "0123456789abcdefABCDEF-" for c in value))
+
+
+class _AdoptCtx:
+    """Adopt a remote (trace_id, parent_span_id) as the ambient parent.
+
+    Installs a synthetic, never-finished Span carrying the remote ids so
+    spans opened inside the scope parent *directly* under the client's
+    span — the placeholder itself is never buffered or exported (the
+    real span lives in the client process)."""
+
+    __slots__ = ("_trace_id", "_parent_span_id", "_token")
+
+    def __init__(self, trace_id: str, parent_span_id: str):
+        self._trace_id = trace_id
+        self._parent_span_id = parent_span_id
+        self._token = None
+
+    def __enter__(self):
+        placeholder = Span("remote.parent", self._trace_id,
+                           self._parent_span_id, None, {})
+        self._token = _CURRENT.set(placeholder)
+        return placeholder
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _CURRENT.reset(self._token)
+        return False
+
+
+def remote_parent(trace_id, parent_span_id):
+    """Continue a trace started in another process: spans opened inside
+    the returned context parent under (`trace_id`, `parent_span_id`) as
+    read from a request envelope. No-op (shared singleton) when tracing
+    is off or either id is missing/malformed — untrusted wire values
+    never abort request handling."""
+    if (_mode == MODE_OFF or not _valid_wire_id(trace_id)
+            or not _valid_wire_id(parent_span_id)):
+        return _NOOP_CTX
+    return _AdoptCtx(trace_id, parent_span_id)
 
 
 def set_attr(key: str, value) -> None:
     """Attach `key=value` to the active span; no-op outside a span."""
     cur = _CURRENT.get()
-    if cur is not None:
+    if cur is not None and cur is not _SUPPRESSED:
         cur.attrs[key] = value
 
 
 def set_attrs(**attrs) -> None:
     cur = _CURRENT.get()
-    if cur is not None:
+    if cur is not None and cur is not _SUPPRESSED:
         cur.attrs.update(attrs)
 
 
 def add_event(name: str, **attrs) -> None:
     """Append a point-in-time event to the active span; no-op outside."""
     cur = _CURRENT.get()
-    if cur is not None:
+    if cur is not None and cur is not _SUPPRESSED:
         cur.add_event(name, **attrs)
 
 
@@ -250,7 +399,9 @@ def wrap(fn):
 
     contextvars do not propagate into ThreadPoolExecutor workers; submit
     ``wrap(fn)`` instead of ``fn`` and the callee joins the caller's
-    trace. Returns `fn` unchanged when tracing is off.
+    trace. Returns `fn` unchanged when tracing is off. Inside an
+    UNSAMPLED trace the suppression marker is what gets bound, so the
+    worker's spans are dropped with the rest of the trace.
     """
     if _mode == MODE_OFF:
         return fn

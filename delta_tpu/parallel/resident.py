@@ -38,6 +38,9 @@ from delta_tpu import obs
 _H2D_BYTES = obs.counter("replay.h2d_bytes")
 _APPENDS = obs.counter("replay.resident_appends")
 _FALLBACKS = obs.counter("replay.resident_fallbacks")
+# device bytes currently pinned by resident key lanes, across all live
+# ResidentShardState instances (HBM is the scarce serving resource)
+_HBM_BYTES = obs.gauge("replay.resident_hbm_bytes")
 
 
 def enabled() -> bool:
@@ -82,6 +85,8 @@ class ResidentShardState:
         self.m = payload.m
         self.n_shards = int(payload.mesh.devices.size)
         self.key_sh = payload.key_sh
+        self._hbm_bytes = int(getattr(payload.key_sh, "nbytes", 0) or 0)
+        _HBM_BYTES.inc(self._hbm_bytes)
         self.n_real = np.asarray(payload.n_real, np.int64).copy()
         self.add = np.unpackbits(
             payload.add_words.view(np.uint8).reshape(self.n_shards, -1),
@@ -244,7 +249,10 @@ class ResidentShardState:
     def release(self) -> None:
         """Drop the device buffer (the host bookkeeping is garbage with
         it, so the whole state is dead after this)."""
-        self.key_sh = None
+        if self.key_sh is not None:
+            self.key_sh = None
+            _HBM_BYTES.dec(self._hbm_bytes)
+            self._hbm_bytes = 0
 
 
 def establish_resident(payload, file_actions,

@@ -120,10 +120,13 @@ class Request:
     blocks in :meth:`wait` for the outcome."""
 
     __slots__ = ("fn", "tenant", "op", "deadline", "enqueued_at",
-                 "_done", "result", "error", "queue_wait_s")
+                 "_done", "result", "error", "queue_wait_s",
+                 "trace_id", "parent_span_id")
 
     def __init__(self, fn: Callable[[], object], tenant: str, op: str,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], *,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None):
         self.fn = fn
         self.tenant = tenant
         self.op = op
@@ -133,6 +136,11 @@ class Request:
         self.result: object = None
         self.error: Optional[BaseException] = None
         self.queue_wait_s = 0.0
+        # remote trace context from the request envelope: the worker
+        # executing this request parents its spans under the client's
+        # connect.attempt span (obs.remote_parent)
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
 
     def complete(self, result=None, error: BaseException = None) -> None:
         self.result = result
@@ -160,6 +168,13 @@ class AdmissionController:
         self._workers = []
         self._next_tenant_sweep = clock() + _TENANT_SWEEP_INTERVAL_S
         self.shed_counts: Dict[str, int] = {}
+        # scrape-time gauges: callbacks are lock-free (len()/int reads
+        # are atomic) and evaluated outside the registry lock, so a
+        # scrape can never contend with admission
+        obs.gauge("server.queue_depth").set_fn(lambda: len(self._queue))
+        obs.gauge("server.running").set_fn(lambda: self._running)
+        obs.gauge("server.tenants_active").set_fn(
+            lambda: len(self._tenants))
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "AdmissionController":
@@ -310,9 +325,13 @@ class AdmissionController:
                 f"in the admission queue"))
             return
         try:
-            with obs.span("serve.request", op=req.op, tenant=req.tenant):
-                with deadline_scope_at(req.deadline):
-                    result = req.fn()
+            with obs.remote_parent(req.trace_id, req.parent_span_id):
+                with obs.span("serve.request", op=req.op,
+                              tenant=req.tenant,
+                              queue_wait_ms=round(
+                                  req.queue_wait_s * 1000.0, 3)):
+                    with deadline_scope_at(req.deadline):
+                        result = req.fn()
         except BaseException as e:
             if isinstance(e, DeadlineExceededError):
                 _DEADLINE_EXCEEDED.inc()

@@ -56,6 +56,9 @@ class SnapshotCache:
         self._clock = clock
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        # len() is atomic, so the scrape callback needs no lock
+        obs.gauge("server.cache_entries").set_fn(
+            lambda: len(self._entries))
 
     def _entry(self, path: str) -> _Entry:
         with self._lock:
@@ -93,12 +96,13 @@ class SnapshotCache:
             e = self._entry(path)
             return e.table.snapshot_at(int(version)), {}
         e = self._entry(path)
-        with e.lock:
+        with e.lock, obs.span("serve.cache", path=path) as sp:
             now = self._clock()
             window = self._config.refresh_ms / 1000.0
             if e.snapshot is not None and window > 0 and \
                     now - e.fresh_at < window:
                 _CACHE_HITS.inc()
+                sp.set_attr("outcome", "fresh_hit")
                 return e.snapshot, {}
             try:
                 snap = e.table.update()
@@ -109,6 +113,7 @@ class SnapshotCache:
                         or not self._degradable(exc):
                     raise
                 _STALE_SERVED.inc()
+                sp.set_attr("outcome", "stale")
                 obs.add_event("server.stale_served", path=path,
                               version=e.snapshot.version,
                               cause=type(exc).__name__)
@@ -119,6 +124,7 @@ class SnapshotCache:
                     "stale_cause": type(exc).__name__,
                 }
             _CACHE_REFRESH.inc()
+            sp.set_attr("outcome", "refresh")
             e.snapshot = snap
             e.fresh_at = now
             return snap, {}

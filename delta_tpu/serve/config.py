@@ -15,7 +15,16 @@ snapshot service lives here (docs/serving.md documents the contract):
 ``DELTA_TPU_SERVE_REFRESH_MS``             0        freshness window (0 = always re-list)
 ``DELTA_TPU_SERVE_STALE_OK``               1        serve last snapshot on outage
 ``DELTA_TPU_SERVE_DRAIN_GRACE_S``          10       drain budget on shutdown
+``DELTA_TPU_SERVE_SLO_P99_MS``             0        p99 latency objective (0 = off)
+``DELTA_TPU_SERVE_SLO_SHED_RATE``          0        tolerated shed fraction (0 = off)
+``DELTA_TPU_SERVE_SLO_STALE_RATE``         0        tolerated stale-serve fraction
+``DELTA_TPU_SERVE_SLO_DEADLINE_RATE``      0        tolerated deadline-miss fraction
+``DELTA_TPU_SERVE_SLO_DUMP_DIR``           ""       flight-recorder dump dir on breach
 =========================================  =======  ====================
+
+The SLO knobs arm :class:`delta_tpu.obs.SloEngine` burn-rate gates over
+the request stream; all default off, so the telemetry plane costs
+nothing unless an operator opts in.
 """
 
 from __future__ import annotations
@@ -47,6 +56,11 @@ class ServeConfig:
     refresh_ms: float = 0.0           # snapshot freshness window
     stale_ok: bool = True
     drain_grace_s: float = 10.0
+    slo_p99_ms: float = 0.0           # p99 latency objective; 0 disables
+    slo_shed_rate: float = 0.0        # tolerated shed fraction
+    slo_stale_rate: float = 0.0       # tolerated stale-serve fraction
+    slo_deadline_rate: float = 0.0    # tolerated deadline-miss fraction
+    slo_dump_dir: str = ""            # breach -> flight dump here
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -71,6 +85,16 @@ class ServeConfig:
             "stale_ok": _env_num("DELTA_TPU_SERVE_STALE_OK", 1.0) != 0.0,
             "drain_grace_s": max(0.0, _env_num(
                 "DELTA_TPU_SERVE_DRAIN_GRACE_S", 10.0)),
+            "slo_p99_ms": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_SLO_P99_MS", 0.0)),
+            "slo_shed_rate": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_SLO_SHED_RATE", 0.0)),
+            "slo_stale_rate": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_SLO_STALE_RATE", 0.0)),
+            "slo_deadline_rate": max(0.0, _env_num(
+                "DELTA_TPU_SERVE_SLO_DEADLINE_RATE", 0.0)),
+            "slo_dump_dir": os.environ.get(
+                "DELTA_TPU_SERVE_SLO_DUMP_DIR", ""),
         }
         kw.update(overrides)
         return cls(**kw)

@@ -22,6 +22,7 @@ import dataclasses
 import os
 from typing import Callable, Optional, Tuple
 
+from delta_tpu import obs
 from delta_tpu.connect.protocol import ipc_to_table, table_to_ipc
 from delta_tpu.errors import ConnectProtocolError
 
@@ -94,6 +95,17 @@ class Dispatcher:
         op = env.get("op")
         if op == "ping":
             return {"pong": True}, b""
+
+        if op == "metrics":
+            # Prometheus-text registry exposition; both servers share
+            # this op so any client can scrape without extra transport.
+            return {"metrics": obs.render_prometheus(),
+                    "content_type": obs.CONTENT_TYPE}, b""
+
+        with obs.span("serve.dispatch", op=op, path=env.get("path")):
+            return self._dispatch_op(op, env, payload)
+
+    def _dispatch_op(self, op, env: dict, payload: bytes):
 
         if op == "read":
             snap, meta = self._snapshot(env["path"], env.get("version"))

@@ -30,6 +30,7 @@ precisely when the queue is full.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import threading
 import time
@@ -50,11 +51,19 @@ _log = logging.getLogger("delta_tpu.serve")
 _CONN_ACCEPTED = obs.counter("server.conn_accepted")
 _CONN_REJECTED = obs.counter("server.conn_rejected")
 _PROTOCOL_ERRORS = obs.counter("server.protocol_errors")
+_SLO_BREACHES = obs.counter("server.slo_breaches")
 
 # Ops answered inline on the connection-reader thread. Admission
-# exists to protect table work; a liveness probe must not queue
-# behind the very backlog it is trying to report.
-_INLINE_OPS = frozenset({"ping", "health"})
+# exists to protect table work; a liveness probe (or a metrics scrape)
+# must not queue behind the very backlog it is trying to report.
+_INLINE_OPS = frozenset({"ping", "health", "metrics"})
+
+# SLO evaluation cadence: burn rates move on window timescales, so
+# re-evaluating more often than this only burns reader-thread time
+_SLO_EVAL_INTERVAL_S = 0.25
+# at most one flight dump per objective per interval — a sustained
+# breach must not write a dump per request
+_SLO_DUMP_INTERVAL_S = 5.0
 
 
 def _error_envelope(e: BaseException) -> dict:
@@ -99,15 +108,38 @@ class DeltaServeServer:
         self._accept_thread = None
         self._stopping = False
         self._started_at = time.monotonic()
+        # telemetry plane: flight recorder (armed while tracing is on)
+        # + declarative SLO burn-rate gates (armed by config knobs)
+        self.flight = obs.FlightRecorder(
+            root_names={"serve.request", "connect.request"})
+        self._flight_installed = False
+        objectives = obs.serve_objectives(
+            p99_ms=self.config.slo_p99_ms,
+            shed_rate=self.config.slo_shed_rate,
+            stale_rate=self.config.slo_stale_rate,
+            deadline_rate=self.config.slo_deadline_rate)
+        self.slo: Optional[obs.SloEngine] = (
+            obs.SloEngine(objectives) if objectives else None)
+        self._slo_lock = threading.Lock()
+        self._slo_next_eval = 0.0
+        self._slo_last_dump: dict = {}
+        self.last_slo_verdict: Optional[obs.SloVerdict] = None
 
     # -- lifecycle -----------------------------------------------------
     def start_background(self) -> "DeltaServeServer":
+        self._arm_flight()
         self.admission.start()
         self._accept_thread = pool.spawn("accept", self._accept_loop)
         return self
 
+    def _arm_flight(self) -> None:
+        if obs.trace_enabled() and not self._flight_installed:
+            obs.add_exporter(self.flight)
+            self._flight_installed = True
+
     def serve_forever(self) -> None:
         """Blocking variant for the CLI entry; returns after drain."""
+        self._arm_flight()
         self.admission.start()
         self._accept_loop()
 
@@ -135,6 +167,9 @@ class DeltaServeServer:
             except OSError as e:
                 _log.debug("conn shutdown: %s", e)
         pool.join_quietly(self._accept_thread)
+        if self._flight_installed:
+            obs.remove_exporter(self.flight)
+            self._flight_installed = False
 
     # -- accept / read loops -------------------------------------------
     def _accept_loop(self) -> None:
@@ -217,6 +252,10 @@ class DeltaServeServer:
         if op in _INLINE_OPS:
             if op == "ping":
                 return self._try_send(conn, {"ok": True, "pong": True})
+            if op == "metrics":
+                return self._try_send(conn, {
+                    "ok": True, "metrics": obs.render_prometheus(),
+                    "content_type": obs.CONTENT_TYPE})
             return self._try_send(conn, {"ok": True,
                                          "health": self.health()})
         deadline = None
@@ -237,23 +276,101 @@ class DeltaServeServer:
                     "error_class": "ConnectProtocolError",
                     "error_code": "DELTA_CONNECT_PROTOCOL_ERROR",
                 })
+        started = time.monotonic()
+        trace_id = envelope.get("trace_id")
         req = Request(
             fn=lambda: self.dispatcher.dispatch(envelope, payload),
             tenant=str(envelope.get("tenant") or "default"),
-            op=str(op), deadline=deadline)
+            op=str(op), deadline=deadline,
+            trace_id=trace_id,
+            parent_span_id=envelope.get("parent_span_id"))
         try:
             self.admission.submit(req)
         except Exception as e:
+            self._record_slo("shed", started, trace_id)
             return self._try_send(conn, _error_envelope(e))
         # One request in flight per connection (the protocol is strict
         # request/response), so blocking the reader here is the natural
         # backpressure: a client cannot pipeline past its own replies.
         req.wait()
         if req.error is not None:
+            self._record_slo(
+                self._classify_error(req.error), started, trace_id)
             return self._try_send(conn, _error_envelope(req.error))
         result, out_payload = req.result
+        self._record_slo(
+            "stale" if (result or {}).get("stale") else "ok",
+            started, trace_id)
         return self._try_send(conn, {"ok": True, **(result or {})},
                               out_payload)
+
+    @staticmethod
+    def _classify_error(error: BaseException) -> str:
+        from delta_tpu.errors import (DeadlineExceededError,
+                                      ServiceOverloadedError)
+
+        if isinstance(error, DeadlineExceededError):
+            return "deadline"
+        if isinstance(error, ServiceOverloadedError):
+            return "shed"
+        return "error"
+
+    # -- SLO gates -----------------------------------------------------
+    def _record_slo(self, outcome: str, started: float,
+                    trace_id: Optional[str]) -> None:
+        """Feed one finished request into the SLO engine and, on the
+        evaluation cadence, check burn rates. A breach bumps the
+        ``server.slo_breaches`` counter and dumps the worst offending
+        trace from the flight recorder (when configured)."""
+        slo = self.slo
+        if slo is None:
+            return
+        now = time.monotonic()
+        slo.record(outcome, (now - started) * 1000.0,
+                   trace_id=trace_id if isinstance(trace_id, str) else None)
+        with self._slo_lock:
+            if now < self._slo_next_eval:
+                return
+            self._slo_next_eval = now + _SLO_EVAL_INTERVAL_S
+        verdict = slo.evaluate()
+        self.last_slo_verdict = verdict
+        if verdict.ok:
+            return
+        for breach in verdict.breaches:
+            _SLO_BREACHES.inc()
+            with self._slo_lock:
+                last = self._slo_last_dump.get(breach.objective, 0.0)
+                if now - last < _SLO_DUMP_INTERVAL_S:
+                    continue
+                self._slo_last_dump[breach.objective] = now
+            _log.warning(
+                "SLO breach: %s burn short=%.1fx long=%.1fx "
+                "(%d/%d bad in long window)", breach.objective,
+                breach.burn_short, breach.burn_long,
+                breach.bad_long, breach.total_long)
+            if self.config.slo_dump_dir:
+                path = os.path.join(
+                    self.config.slo_dump_dir,
+                    f"flight_{breach.objective}.jsonl")
+                try:
+                    n = self.flight.dump_jsonl(
+                        path, trace_id=breach.worst_trace_id)
+                    if n == 0:
+                        # worst trace already rolled off (or ids were
+                        # not stamped): dump the whole ring instead
+                        n = self.flight.dump_jsonl(path)
+                    _log.warning("flight dump: %d span(s) -> %s", n, path)
+                except OSError as e:
+                    _log.warning("flight dump failed: %s", e)
+
+    def slo_verdict(self) -> Optional[obs.SloVerdict]:
+        """Evaluate and return the current SLO verdict (None when no
+        objective is armed)."""
+        if self.slo is None:
+            return None
+        verdict = self.slo.evaluate()
+        self.last_slo_verdict = verdict
+        return verdict
 
     def _try_send(self, conn, env: dict, payload: bytes = b"") -> bool:
         try:
@@ -268,7 +385,7 @@ class DeltaServeServer:
 
     # -- health --------------------------------------------------------
     def health(self) -> dict:
-        return {
+        health = {
             "uptime_s": round(time.monotonic() - self._started_at, 3),
             "draining": self.admission.draining,
             "admission": self.admission.stats(),
@@ -277,6 +394,12 @@ class DeltaServeServer:
             "breakers": breaker_states(),
             "tables": self.cache.health(),
         }
+        if self.slo is not None:
+            verdict = self.last_slo_verdict
+            health["slo"] = (verdict.to_dict() if verdict is not None
+                             else {"ok": True, "breaches": [],
+                                   "burn_rates": {}})
+        return health
 
 
 def serve(path_root: str, host: str = "127.0.0.1", port: int = 9478):
@@ -284,6 +407,7 @@ def serve(path_root: str, host: str = "127.0.0.1", port: int = 9478):
     SIGTERM/SIGINT trigger a graceful drain."""
     import signal
 
+    obs.set_process_label("delta-serve")
     srv = DeltaServeServer(host, port, allowed_root=path_root)
 
     def _drain(signum, frame):

@@ -7,6 +7,7 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     hygiene,
     imports,
     locks,
+    metrics_catalog,
     obs,
     purity,
     retry_discipline,

@@ -1,0 +1,81 @@
+"""delta-metrics: scrape or render Prometheus-text metrics.
+
+Usage::
+
+    delta-metrics --connect HOST:PORT        # scrape a running server
+    delta-metrics --local                    # this process's registry
+    delta-metrics --connect HOST:PORT --json # parsed series as JSON
+    delta-metrics --local --grep server.     # filter series by substring
+    python -m delta_tpu.tools.metrics_cli    # same, without the script
+
+``--connect`` issues the ``metrics`` op over the framed connect
+protocol (served inline by `delta-serve` even when the admission queue
+is full, and by the plain connect server's op table), so any running
+server is scrapeable with no extra listener or HTTP stack. ``--local``
+renders this process's registry — mostly useful under
+``DELTA_LINT_METRIC_CATALOG`` experiments or in scripts that import
+delta_tpu and want a one-shot exposition dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from delta_tpu.obs.expose import parse_prometheus, render_prometheus
+
+
+def _scrape_remote(target: str, timeout: float) -> str:
+    host, _, port = target.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"--connect wants HOST:PORT, got {target!r}")
+    from delta_tpu.connect.client import DeltaConnectClient
+
+    with DeltaConnectClient(host, int(port), timeout=timeout,
+                            reconnect=False) as client:
+        return client.metrics_text()
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="delta-metrics",
+        description="Scrape or render delta-tpu metrics "
+                    "(Prometheus text exposition).")
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument("--connect", metavar="HOST:PORT",
+                        help="scrape a running delta-serve/connect server")
+    source.add_argument("--local", action="store_true",
+                        help="render this process's registry")
+    parser.add_argument("--json", action="store_true",
+                        help="print parsed series as JSON instead of text")
+    parser.add_argument("--grep", metavar="SUBSTR",
+                        help="only series whose name contains SUBSTR")
+    parser.add_argument("--timeout", type=float, default=10.0,
+                        help="scrape timeout in seconds (default 10)")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.connect:
+            text = _scrape_remote(args.connect, args.timeout)
+        else:
+            text = render_prometheus()
+    except Exception as e:
+        print(f"delta-metrics: {e}", file=sys.stderr)
+        return 2
+
+    if args.grep:
+        kept = [line for line in text.splitlines()
+                if args.grep in line]
+        text = "\n".join(kept) + ("\n" if kept else "")
+    if args.json:
+        print(json.dumps(parse_prometheus(text), indent=2,
+                         sort_keys=True))
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -472,6 +472,77 @@ def g():
     assert not _rules_fired(report, "error-untyped-raise")
 
 
+# ---------------------------------------------------------- metric rules
+
+
+_METRIC_FIXTURE_SRC = """
+from delta_tpu import obs
+
+_HITS = obs.counter("demo.hits")
+_TYPO = obs.counter("demo.htis")
+_DEPTH = obs.gauge("demo.depth")
+_WRONG_KIND = obs.counter("demo.depth")
+_DYNAMIC = obs.counter("demo." + suffix)
+"""
+
+
+@pytest.fixture()
+def metric_catalog_env(tmp_path, monkeypatch):
+    path = tmp_path / "metric_names.json"
+    path.write_text(json.dumps({
+        "counters": {"demo.hits": "Fixture hits.",
+                     "demo.dead": "Fixture dead entry."},
+        "histograms": {},
+        "gauges": {"demo.depth": "Fixture depth."},
+    }, indent=1))
+    monkeypatch.setenv("DELTA_LINT_METRIC_CATALOG", str(path))
+    return path
+
+
+def test_metric_uncataloged(metric_catalog_env):
+    report = analyze_sources({"m.py": _METRIC_FIXTURE_SRC},
+                             rules=["metric-uncataloged"])
+    found = _rules_fired(report, "metric-uncataloged")
+    assert any("demo.htis" in f.message for f in found)
+    # cataloged names under the right kind stay silent
+    assert not any("demo.hits" in f.message for f in found)
+
+
+def test_metric_uncataloged_kind_mismatch(metric_catalog_env):
+    report = analyze_sources({"m.py": _METRIC_FIXTURE_SRC},
+                             rules=["metric-uncataloged"])
+    found = _rules_fired(report, "metric-uncataloged")
+    mismatch = [f for f in found if "demo.depth" in f.message]
+    assert mismatch and "cataloged as a gauge" in mismatch[0].message
+
+
+def test_metric_dead_entry(metric_catalog_env):
+    report = analyze_sources({"m.py": _METRIC_FIXTURE_SRC},
+                             rules=["metric-dead-entry"])
+    found = _rules_fired(report, "metric-dead-entry")
+    assert any("demo.dead" in f.message for f in found)
+    assert not any("demo.hits" in f.message for f in found)
+
+
+def test_metric_rules_ignore_dynamic_names(metric_catalog_env):
+    src = """
+from delta_tpu import obs
+
+def make(name):
+    return obs.counter("demo." + name)
+"""
+    report = analyze_sources({"m.py": src}, rules=["metric-uncataloged"])
+    assert not _rules_fired(report, "metric-uncataloged")
+
+
+def test_metric_dead_entry_silent_without_sites(metric_catalog_env):
+    # a scan over files with no instrument sites at all must not mark
+    # the whole catalog dead (e.g. linting a single non-metric module)
+    report = analyze_sources({"m.py": "def f():\n    return 1\n"},
+                             rules=["metric-dead-entry"])
+    assert not _rules_fired(report, "metric-dead-entry")
+
+
 # ------------------------------------------------------- except hygiene
 
 
@@ -710,6 +781,7 @@ def test_every_registered_rule_has_fixture_coverage():
         "jit-impure", "jit-sync",                            # purity
         "error-uncataloged", "error-dead-entry",
         "error-untyped-raise",                               # catalog
+        "metric-uncataloged", "metric-dead-entry",           # metrics
         "except-swallow", "mutable-default",                 # hygiene
         "undefined-name",                                    # imports
         "obs-span-leak",                                     # obs

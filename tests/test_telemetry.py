@@ -1,0 +1,698 @@
+"""Telemetry-plane coverage: Prometheus exposition (render/parse,
+fixed buckets, catalog zero-fill, gauge callbacks), the flight
+recorder ring, SLO burn-rate gates under a fake clock, distributed
+trace propagation across BOTH connect servers (including the hedged
+losing-attempt branch shape), the inline metrics scrape, the
+delta-metrics CLI, and Chrome-export process grouping."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu import obs
+from delta_tpu.connect import DeltaConnectServer, connect
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.obs.slo import Objective
+from delta_tpu.resilience import ChaosSchedule, ChaosStore
+from delta_tpu.serve import DeltaServeServer, ServeConfig
+from delta_tpu.storage.logstore import InMemoryLogStore
+
+
+@pytest.fixture
+def tracing():
+    obs.reset_trace_buffer()
+    obs.set_trace_mode("on")
+    yield
+    obs.set_trace_mode("off")
+    obs.reset_trace_buffer()
+
+
+def _data(n=10):
+    return pa.table({"x": pa.array(np.arange(n, dtype=np.int64))})
+
+
+def _by_name(spans, name):
+    return [s for s in spans if s.name == name]
+
+
+# ------------------------------------------------------------ exposition
+
+
+def test_prometheus_render_parse_round_trip():
+    c = obs.counter("test.expose.hits")
+    c.reset()
+    c.inc(7)
+    g = obs.gauge("test.expose.depth")
+    g.set(3)
+    text = obs.render_prometheus()
+    series = obs.parse_prometheus(text)
+    assert series["delta_tpu_test_expose_hits_total"] == 7.0
+    assert series["delta_tpu_test_expose_depth"] == 3.0
+    assert text.startswith("#") or text.startswith("delta_tpu_")
+
+
+def test_prometheus_histogram_buckets_cumulative():
+    h = obs.histogram("test.expose.lat")
+    h.reset()
+    for v in (0.5, 3.0, 7.0, 40.0, 1e12):  # last one overflows +Inf
+        h.observe(v)
+    text = obs.render_prometheus()
+    series = obs.parse_prometheus(text)
+    name = "delta_tpu_test_expose_lat"
+    assert series[f'{name}_bucket{{le="1.0"}}'] == 1.0
+    assert series[f'{name}_bucket{{le="5.0"}}'] == 2.0
+    assert series[f'{name}_bucket{{le="10.0"}}'] == 3.0
+    assert series[f'{name}_bucket{{le="50.0"}}'] == 4.0
+    assert series[f'{name}_bucket{{le="+Inf"}}'] == 5.0
+    assert series[f"{name}_count"] == 5.0
+    assert series[f"{name}_sum"] == pytest.approx(0.5 + 3 + 7 + 40 + 1e12)
+    # cumulative: each bucket >= the previous
+    bounds = [f'{name}_bucket{{le="{repr(b)}"}}'
+              for b in obs.EXPORT_BUCKETS]
+    values = [series[k] for k in bounds]
+    assert values == sorted(values)
+
+
+def test_prometheus_catalog_zero_fill(tmp_path, monkeypatch):
+    """Catalogued-but-untouched instruments render as zero with HELP
+    text, so the scrape shape does not depend on import order."""
+    cat = {"counters": {"test.never.touched": "Fixture help."},
+           "histograms": {"test.never.lat": "Fixture histogram."},
+           "gauges": {"test.never.depth": "Fixture gauge."}}
+    path = tmp_path / "cat.json"
+    path.write_text(json.dumps(cat))
+    monkeypatch.setenv("DELTA_LINT_METRIC_CATALOG", str(path))
+    text = obs.render_prometheus()
+    series = obs.parse_prometheus(text)
+    assert series["delta_tpu_test_never_touched_total"] == 0.0
+    assert series["delta_tpu_test_never_depth"] == 0.0
+    assert series['delta_tpu_test_never_lat_bucket{le="+Inf"}'] == 0.0
+    assert "# HELP delta_tpu_test_never_touched_total Fixture help." in text
+
+
+def test_repo_catalog_covered_by_exposition():
+    """Every catalogued metric appears in a live scrape (the zero-fill
+    union), including the serve/replay/resilience/parallel families the
+    acceptance checklist names."""
+    series = obs.parse_prometheus(obs.render_prometheus())
+    catalog = obs.metric_catalog()
+    for dotted in catalog["counters"]:
+        assert obs.prom_name(dotted, "_total") in series, dotted
+    for dotted in catalog["gauges"]:
+        assert obs.prom_name(dotted) in series, dotted
+    for dotted in catalog["histograms"]:
+        assert obs.prom_name(dotted) + "_count" in series, dotted
+    for expected in ("server.requests", "server.shed", "replay.h2d_bytes",
+                     "storage.retry.attempts", "chaos.faults"):
+        assert expected in catalog["counters"], expected
+
+
+def test_gauge_callback_and_failure_renders_zero():
+    g = obs.gauge("test.expose.cb")
+    items = [1, 2, 3]
+    g.set_fn(lambda: len(items))
+    snap = obs.metrics_snapshot()
+    assert snap["gauges"]["test.expose.cb"] == 3
+
+    def boom():
+        raise RuntimeError("torn down")
+
+    g.set_fn(boom)
+    assert g.read() is None  # swallowed, never raises
+    series = obs.parse_prometheus(obs.render_prometheus())
+    assert series["delta_tpu_test_expose_cb"] == 0.0
+    g.set(0)  # unbind for later tests
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_assembles_and_dumps(tmp_path, tracing):
+    rec = obs.FlightRecorder(max_traces=2)
+    obs.add_exporter(rec)
+    try:
+        ids = []
+        for i in range(3):
+            with obs.span("req", i=i) as root:
+                with obs.span("inner"):
+                    pass
+                ids.append(root.trace_id)
+    finally:
+        obs.remove_exporter(rec)
+    # ring bound: the oldest trace rolled off
+    assert len(rec) == 2
+    assert rec.get(ids[0]) is None
+    trace = rec.get(ids[2])
+    assert [d["name"] for d in trace] == ["inner", "req"]
+    assert all(d["trace_id"] == ids[2] for d in trace)
+    # dump -> delta-trace-readable JSONL
+    path = str(tmp_path / "flight.jsonl")
+    n = rec.dump_jsonl(path, trace_id=ids[2])
+    assert n == 2
+    recs = obs.load_spans(path)
+    assert {r["name"] for r in recs} == {"inner", "req"}
+    # whole-ring dump covers both retained traces
+    assert rec.dump_jsonl(path) == 4
+
+
+def test_flight_recorder_root_names_complete_remote_traces(tracing):
+    """A span named in root_names completes its trace even with a
+    remote parent — the server-side root finishes before the client's
+    (out-of-process) parent ever could."""
+    rec = obs.FlightRecorder(root_names={"serve.request"})
+    obs.add_exporter(rec)
+    try:
+        with obs.remote_parent("ab" * 16, "cd" * 8):
+            with obs.span("serve.request") as root:
+                with obs.span("serve.dispatch"):
+                    pass
+    finally:
+        obs.remove_exporter(rec)
+    trace = rec.get(root.trace_id)
+    assert trace is not None
+    assert {d["name"] for d in trace} == {"serve.request", "serve.dispatch"}
+    (req,) = [d for d in trace if d["name"] == "serve.request"]
+    assert req["trace_id"] == "ab" * 16
+    assert req["parent_id"] == "cd" * 8
+
+
+# ------------------------------------------------------------- SLO engine
+
+
+def test_slo_burn_rate_needs_both_windows_and_min_events():
+    now = [1000.0]
+    eng = obs.SloEngine(
+        [Objective(name="shed_rate", budget=0.05,
+                   bad_outcomes=frozenset({"shed"}))],
+        short_window_s=5.0, long_window_s=60.0, burn_threshold=1.0,
+        min_events=20, clock=lambda: now[0])
+    # cold window: 100% bad but below min_events -> no breach
+    for _ in range(10):
+        eng.record("shed", 1.0)
+    assert eng.evaluate().ok
+    # sustained burn across both windows
+    for _ in range(30):
+        eng.record("ok", 1.0)
+        eng.record("shed", 1.0, trace_id="deadbeef")
+        now[0] += 0.1
+    verdict = eng.evaluate()
+    assert not verdict.ok
+    (breach,) = verdict.breaches
+    assert breach.objective == "shed_rate"
+    assert breach.burn_long > 1.0 and breach.burn_short > 1.0
+    assert breach.worst_trace_id == "deadbeef"
+    # burn stopped: the short window recovers first and the gate clears
+    for _ in range(200):
+        eng.record("ok", 1.0)
+        now[0] += 0.05
+    assert eng.evaluate().ok
+    d = verdict.to_dict()
+    assert d["ok"] is False and d["breaches"][0]["objective"] == "shed_rate"
+
+
+def test_slo_p99_latency_objective_via_ratio():
+    now = [0.0]
+    eng = obs.SloEngine(
+        obs.serve_objectives(p99_ms=50.0),
+        short_window_s=5.0, long_window_s=30.0, min_events=20,
+        clock=lambda: now[0])
+    # 10% of events above threshold = 10x the 1% p99 budget
+    for i in range(100):
+        eng.record("ok", 500.0 if i % 10 == 0 else 5.0,
+                   trace_id=f"{i:032x}")
+        now[0] += 0.05
+    verdict = eng.evaluate()
+    assert not verdict.ok
+    (breach,) = verdict.breaches
+    assert breach.objective == "p99_latency"
+    assert breach.burn_long == pytest.approx(10.0, rel=0.5)
+    assert breach.worst_trace_id is not None
+
+
+def test_serve_objectives_zero_disables():
+    objs = obs.serve_objectives()
+    assert objs == []
+    objs = obs.serve_objectives(p99_ms=10.0, shed_rate=0.02)
+    assert [o.name for o in objs] == ["p99_latency", "shed_rate"]
+    events_pruned = obs.SloEngine(objs, clock=lambda: 0.0)
+    events_pruned.record("ok", 1.0)
+    assert events_pruned.event_count() == 1
+    events_pruned.reset()
+    assert events_pruned.event_count() == 0
+
+
+# ----------------------------------------- cross-process trace adoption
+
+
+def test_remote_parent_rejects_garbage_and_off_mode():
+    obs.set_trace_mode("off")
+    ctx = obs.remote_parent("ab" * 16, "cd" * 8)
+    with ctx as s:
+        assert not s.recording
+    obs.set_trace_mode("on")
+    try:
+        for bad in (None, 42, "", "x" * 65, "zz<script>", b"abc"):
+            with obs.remote_parent(bad, "cd" * 8) as s:
+                assert not s.recording
+            with obs.remote_parent("ab" * 16, bad) as s:
+                assert not s.recording
+        with obs.remote_parent("ab" * 16, "cd" * 8):
+            with obs.span("child") as child:
+                assert child.trace_id == "ab" * 16
+                assert child.parent_id == "cd" * 8
+        assert obs.trace_context() is None  # adoption fully unwound
+    finally:
+        obs.set_trace_mode("off")
+        obs.reset_trace_buffer()
+
+
+# ---------------------------------------------------- head-based sampling
+
+
+@pytest.fixture
+def sampled_off(tracing):
+    obs.set_trace_sample(0.0)
+    yield
+    obs.set_trace_sample(1.0)
+
+
+def test_unsampled_trace_is_dropped_whole(sampled_off):
+    """Sampling decides at the trace ROOT: an unsampled root suppresses
+    every descendant (same thread, wrapped threads) so no parent-less
+    fragments ever reach the buffer."""
+    import threading
+
+    seen = []
+
+    def worker():
+        with obs.span("thread.child"):
+            seen.append(obs.current_span())
+
+    with obs.span("root") as s:
+        assert not s.recording
+        assert obs.current_span() is None
+        assert obs.trace_context() is None  # no envelope stamping
+        obs.set_attr("k", 1)  # safe no-ops under suppression
+        obs.add_event("e")
+        with obs.span("child") as c:
+            assert not c.recording
+        t = threading.Thread(target=obs.wrap(worker))
+        t.start()
+        t.join()
+    assert seen == [None]
+    assert obs.get_finished_spans() == []
+    # suppression fully unwinds: the next root records again
+    obs.set_trace_sample(1.0)
+    with obs.span("after") as s:
+        assert s.recording
+    assert [s.name for s in obs.get_finished_spans()] == ["after"]
+
+
+def test_set_trace_sample_clamps_and_rereads_env(monkeypatch, tracing):
+    obs.set_trace_sample(7.5)
+    assert obs.trace_sample() == 1.0
+    obs.set_trace_sample(-2)
+    assert obs.trace_sample() == 0.0
+    monkeypatch.setenv("DELTA_TPU_TRACE_SAMPLE", "0.25")
+    obs.set_trace_sample(None)
+    assert obs.trace_sample() == 0.25
+    monkeypatch.setenv("DELTA_TPU_TRACE_SAMPLE", "nonsense")
+    obs.set_trace_sample(None)
+    assert obs.trace_sample() == 1.0
+    obs.set_trace_sample(1.0)
+
+
+def test_unsampled_client_request_emits_no_spans(sampled_off):
+    """End to end at sample rate 0: the client root is suppressed, the
+    envelope carries no trace ids, and the in-process server inherits
+    the zero rate — the whole request leaves zero spans behind."""
+    eng = _mem_engine(seed=7)
+    srv = _serve_server(eng, workers=2, max_queue=8)
+    try:
+        host, port = srv.address
+        path = "memory://telemetry-unsampled"
+        dta.write_table(path, _data(), engine=eng)
+        obs.reset_trace_buffer()
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10
+        time.sleep(0.1)  # let any stray server-side span land
+        assert obs.get_finished_spans() == []
+    finally:
+        srv.shutdown(1.0)
+
+
+def _serve_server(engine, **cfg):
+    cfg.setdefault("drain_grace_s", 5.0)
+    srv = DeltaServeServer("127.0.0.1", 0, engine=engine,
+                           config=ServeConfig.from_env(**cfg))
+    return srv.start_background()
+
+
+def _mem_engine(seed=1):
+    store = ChaosStore(InMemoryLogStore(), ChaosSchedule(seed),
+                       sleep=lambda s: None)
+    store.enabled = False
+    return HostEngine(store_resolver=lambda p: store)
+
+
+def _assert_single_connected_trace(spans, client_root):
+    """Every span shares client_root's trace id and walks up to it."""
+    assert all(s.trace_id == client_root.trace_id for s in spans)
+    by_id = {s.span_id: s for s in spans}
+    by_id[client_root.span_id] = client_root
+    for s in spans:
+        node, hops = s, 0
+        while node.parent_id is not None and hops < 100:
+            assert node.parent_id in by_id, \
+                f"{s.name}: broken parent link at {node.name}"
+            node = by_id[node.parent_id]
+            hops += 1
+        assert node.span_id == client_root.span_id
+
+
+@pytest.mark.parametrize("server_kind", ["connect", "serve"])
+def test_one_trace_id_across_client_and_server(server_kind, tmp_path,
+                                               tracing):
+    """Acceptance: a client request produces ONE trace whose server-side
+    spans (request root, dispatch, snapshot work) parent under the
+    client's connect.attempt span — on both server variants."""
+    if server_kind == "connect":
+        eng = HostEngine()
+        srv = DeltaConnectServer("127.0.0.1", 0, engine=eng,
+                                 allowed_root=str(tmp_path)).start_background()
+        stop = srv.stop
+        request_root = "connect.request"
+        path = str(tmp_path / "t")
+    else:
+        eng = _mem_engine()
+        srv = _serve_server(eng, workers=2, max_queue=8)
+        stop = lambda: srv.shutdown(1.0)  # noqa: E731
+        request_root = "serve.request"
+        path = "memory://telemetry-e2e"
+    try:
+        host, port = srv.address
+        dta.write_table(path, _data(), engine=eng)
+        obs.reset_trace_buffer()
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            spans = obs.get_finished_spans()
+            if _by_name(spans, "connect.call"):
+                break
+            time.sleep(0.01)
+        (call,) = _by_name(spans, "connect.call")
+        (attempt,) = _by_name(spans, "connect.attempt")
+        (req,) = _by_name(spans, request_root)
+        assert attempt.parent_id == call.span_id
+        # the server-side request root adopted the attempt as parent
+        assert req.trace_id == call.trace_id
+        assert req.parent_id == attempt.span_id
+        # snapshot work joined the same trace
+        assert any(s.trace_id == call.trace_id
+                   for s in _by_name(spans, "snapshot.load"))
+        others = [s for s in spans if s is not call]
+        _assert_single_connected_trace(others, call)
+    finally:
+        stop()
+
+
+def test_serve_flight_recorder_retrievable_by_trace_id(tracing):
+    """The serve server's armed flight recorder retains the complete
+    request trace, retrievable by the client's trace id."""
+    eng = _mem_engine(seed=2)
+    srv = _serve_server(eng, workers=2, max_queue=8)  # armed: tracing on
+    try:
+        host, port = srv.address
+        path = "memory://telemetry-flight"
+        dta.write_table(path, _data(), engine=eng)
+        obs.reset_trace_buffer()
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            calls = _by_name(obs.get_finished_spans(), "connect.call")
+            if calls and srv.flight.get(calls[0].trace_id):
+                break
+            time.sleep(0.01)
+        (call,) = calls
+        trace = srv.flight.get(call.trace_id)
+        assert trace is not None
+        names = {d["name"] for d in trace}
+        assert "serve.request" in names and "serve.dispatch" in names
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_hedged_read_losing_attempt_is_distinct_branch(tracing):
+    """Both hedge attempts share the call's trace id but are SIBLING
+    branches: distinct span ids, each the root of its own server-side
+    subtree."""
+    store = ChaosStore(InMemoryLogStore(),
+                       ChaosSchedule(21, latency_rate=1.0,
+                                     latency_s=(0.03, 0.04)),
+                       sleep=time.sleep)
+    store.enabled = False
+    eng = HostEngine(store_resolver=lambda p: store)
+    srv = _serve_server(eng, workers=4, max_queue=16)
+    try:
+        host, port = srv.address
+        path = "memory://telemetry-hedge"
+        dta.write_table(path, _data(12), engine=eng)
+        store.enabled = True  # slow enough that the hedge always fires
+        obs.reset_trace_buffer()
+        with connect(host, port, hedge_ms=5.0) as c:
+            assert c.read_table(path).num_rows == 12
+        deadline = time.monotonic() + 10
+        attempts = []
+        while time.monotonic() < deadline:
+            spans = obs.get_finished_spans()
+            attempts = [s for s in _by_name(spans, "connect.attempt")
+                        if s.attrs.get("op") == "read"]
+            if len(attempts) >= 2:
+                break
+            time.sleep(0.02)
+        assert len(attempts) >= 2, "hedge attempt never fired"
+        (call,) = [s for s in _by_name(spans, "connect.call")
+                   if s.attrs.get("op") == "read"]
+        assert len({a.span_id for a in attempts}) == len(attempts)
+        for a in attempts:
+            assert a.trace_id == call.trace_id
+            assert a.parent_id == call.span_id
+        # each server-side request root hangs under a DIFFERENT attempt
+        reqs = [s for s in _by_name(spans, "serve.request")
+                if s.attrs.get("op") == "read"
+                and s.trace_id == call.trace_id]
+        assert len(reqs) >= 2
+        parents = {r.parent_id for r in reqs}
+        assert parents <= {a.span_id for a in attempts}
+        assert len(parents) >= 2
+    finally:
+        srv.shutdown(1.0)
+
+
+# ------------------------------------------------------ metrics scraping
+
+
+def test_serve_inline_metrics_scrape():
+    eng = _mem_engine(seed=3)
+    srv = _serve_server(eng, workers=1, max_queue=4)
+    try:
+        host, port = srv.address
+        path = "memory://telemetry-scrape"
+        dta.write_table(path, _data(), engine=eng)
+        before = obs.counter("server.requests").value
+        with connect(host, port, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10
+            text = c.metrics_text()
+        series = obs.parse_prometheus(text)
+        assert series["delta_tpu_server_requests_total"] >= before + 1
+        assert "delta_tpu_server_queue_depth" in series
+        assert "delta_tpu_replay_resident_hbm_bytes" in series
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_metrics_op_bypasses_full_admission_queue():
+    """A scrape answers even when the admission queue sheds everything
+    (max_queue=0): observability of an overloaded server is the point."""
+    eng = _mem_engine(seed=4)
+    srv = _serve_server(eng, workers=1, max_queue=0)
+    try:
+        host, port = srv.address
+        with connect(host, port, reconnect=False) as c:
+            text = c.metrics_text()
+        assert "delta_tpu_server_requests_total" in text
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_metrics_cli_remote_and_local(capsys):
+    from delta_tpu.tools.metrics_cli import main as metrics_main
+
+    eng = _mem_engine(seed=5)
+    srv = _serve_server(eng, workers=1, max_queue=4)
+    try:
+        host, port = srv.address
+        assert metrics_main(["--connect", f"{host}:{port}"]) == 0
+        out = capsys.readouterr().out
+        assert "delta_tpu_server_requests_total" in out
+        assert metrics_main(["--connect", f"{host}:{port}", "--json",
+                             "--grep", "server_conn"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert all("server_conn" in k for k in doc)
+        assert doc  # the accepted-connections series survived the grep
+    finally:
+        srv.shutdown(1.0)
+    assert metrics_main(["--local", "--grep", "parse_cache"]) == 0
+    assert "parse_cache" in capsys.readouterr().out
+    # unreachable target: diagnostic on stderr, exit 2
+    assert metrics_main(["--connect", "127.0.0.1:1", "--timeout",
+                         "0.2"]) == 2
+    assert "delta-metrics:" in capsys.readouterr().err
+
+
+# ----------------------------------------------- SLO gates on the server
+
+
+def _slo_serve(engine, **cfg):
+    cfg.setdefault("drain_grace_s", 5.0)
+    cfg.setdefault("slo_p99_ms", 30_000.0)
+    cfg.setdefault("slo_shed_rate", 0.05)
+    srv = DeltaServeServer("127.0.0.1", 0, engine=engine,
+                           config=ServeConfig.from_env(**cfg))
+    return srv.start_background()
+
+
+def test_serve_slo_verdict_clean_and_breach(tmp_path):
+    eng = _mem_engine(seed=6)
+    srv = _slo_serve(eng, workers=1, max_queue=0,  # everything sheds
+                     slo_dump_dir=str(tmp_path))
+    try:
+        # widen the gate for test speed: the engine defaults to 60s
+        # windows / 20 events, which a unit test should not wait out
+        srv.slo.min_events = 5
+        host, port = srv.address
+        with connect(host, port, reconnect=False) as c:
+            for _ in range(8):
+                try:
+                    c.table_version("memory://nope")
+                except Exception:
+                    pass
+        verdict = srv.slo_verdict()
+        assert verdict is not None and not verdict.ok
+        assert any(b.objective == "shed_rate" for b in verdict.breaches)
+        with connect(host, port, reconnect=False) as c:
+            h = c.health()
+        assert h["slo"]["ok"] is False
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_serve_slo_disabled_by_default():
+    eng = _mem_engine(seed=7)
+    srv = _serve_server(eng, workers=1, max_queue=4)
+    try:
+        assert srv.slo is None and srv.slo_verdict() is None
+        with connect(*srv.address, reconnect=False) as c:
+            assert "slo" not in c.health()
+    finally:
+        srv.shutdown(1.0)
+
+
+def test_slo_breach_dumps_flight_trace(tmp_path, tracing):
+    """An SLO breach writes the offending trace from the flight ring
+    as a delta-trace-readable JSONL dump."""
+    eng = _mem_engine(seed=8)
+    srv = _slo_serve(eng, workers=2, max_queue=8,
+                     slo_p99_ms=0.0001,  # everything breaches p99
+                     slo_dump_dir=str(tmp_path))
+    try:
+        srv.slo.min_events = 5
+        host, port = srv.address
+        path = "memory://telemetry-slo-dump"
+        dta.write_table(path, _data(), engine=eng)
+        with connect(host, port, reconnect=False) as c:
+            for _ in range(10):
+                c.read_table(path)
+                time.sleep(0.03)  # straddle the evaluation cadence
+        dump = tmp_path / "flight_p99_latency.jsonl"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not dump.exists():
+            time.sleep(0.05)
+        assert dump.exists(), "breach produced no flight dump"
+        recs = obs.load_spans(str(dump))
+        assert recs and any(r["name"] == "serve.request" for r in recs)
+        assert obs.counter("server.slo_breaches").value > 0
+    finally:
+        srv.shutdown(1.0)
+
+
+# ------------------------------------------------- Chrome process groups
+
+
+def test_chrome_export_groups_by_process(tmp_path, tracing):
+    """Spans carrying different process labels land in different Chrome
+    pid groups, each with a process_name metadata event."""
+    obs.set_process_label("delta-serve")
+    try:
+        with obs.span("serve.request"):
+            pass
+    finally:
+        obs.set_process_label(None)
+    with obs.span("connect.call"):
+        pass
+    spans = obs.get_finished_spans()
+    serve_d = [s.to_dict() for s in _by_name(spans, "serve.request")][0]
+    client_d = [s.to_dict() for s in _by_name(spans, "connect.call")][0]
+    assert serve_d["process"] == "delta-serve"
+    assert client_d["process"] is None
+    # simulate the cross-process case: the server ran elsewhere
+    serve_d["pid"] = serve_d["pid"] + 1
+    doc = json.loads(json.dumps(obs.chrome_trace([serve_d, client_d])))
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert xs["serve.request"]["pid"] != xs["connect.call"]["pid"]
+    procs = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs[xs["serve.request"]["pid"]] == "delta-serve"
+    assert xs["connect.call"]["pid"] in procs
+    # thread_name metadata exists per (pid, tid) group
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in doc["traceEvents"])
+    # round-trip: pid/process survive the Chrome shape
+    path = str(tmp_path / "multi.json")
+    obs.write_chrome_trace(path, [serve_d, client_d])
+    back = obs.load_spans(path)
+    by = {r["name"]: r for r in back}
+    assert by["serve.request"]["pid"] == serve_d["pid"]
+
+
+# --------------------------------------------------------- disabled path
+
+
+def test_disabled_path_overhead_is_noop():
+    """With tracing off the serve path must not allocate spans: the
+    span() fast path returns the shared no-op singleton."""
+    obs.set_trace_mode("off")
+    assert obs.trace_context() is None
+    ctx1 = obs.span("serve.request")  # delta-lint: disable=obs-span-leak — singleton identity check
+    ctx2 = obs.remote_parent("ab" * 16, "cd" * 8)
+    assert ctx1 is ctx2  # same process-wide singleton, zero allocation
+    eng = _mem_engine(seed=9)
+    srv = _serve_server(eng, workers=1, max_queue=4)
+    try:
+        assert not srv._flight_installed  # recorder not armed when off
+        path = "memory://telemetry-off"
+        dta.write_table(path, _data(), engine=eng)
+        with connect(*srv.address, reconnect=False) as c:
+            assert c.read_table(path).num_rows == 10
+        assert len(srv.flight) == 0
+        assert obs.get_finished_spans() == []
+    finally:
+        srv.shutdown(1.0)
