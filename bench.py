@@ -663,24 +663,54 @@ def sharded_metrics(timeout_s: int) -> None:
 def analyzer_scan_metric():
     """delta-lint full-repo scan time: a secondary metric so an
     accidentally quadratic rule (the lint runs in tier-1 CI) shows up
-    as a >10s regression here instead of as slow test runs."""
+    as a >10s regression here instead of as slow test runs. Also times
+    the ``--changed`` cache-hit path (must stay sub-second: that is the
+    CI re-run hot path) and reports the unsuppressed finding count —
+    the repo's contract is zero, so any nonzero value is a regression
+    even when the scan stays fast."""
+    import tempfile
+
     import delta_tpu
     from delta_tpu.tools.analyzer import analyze_paths
+    from delta_tpu.tools.analyzer.cache import analyze_paths_cached
 
     pkg = os.path.dirname(os.path.abspath(delta_tpu.__file__))
     t0 = time.perf_counter()
     report = analyze_paths([pkg], root=os.path.dirname(pkg))
     scan_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "cache.json")
+        analyze_paths_cached([pkg], root=os.path.dirname(pkg),
+                             cache_path=cache)  # populate
+        t1 = time.perf_counter()
+        cached_report, stats = analyze_paths_cached(
+            [pkg], root=os.path.dirname(pkg), cache_path=cache)
+        cached_s = time.perf_counter() - t1
+    cache_ok = (stats["cache"] == "hit"
+                and len(cached_report.findings) == len(report.findings))
+
     print(f"delta-lint repo scan: {scan_s:.2f}s over "
           f"{report.files_scanned} files, {len(report.findings)} "
-          f"finding(s), {len(report.suppressed)} suppressed",
+          f"finding(s), {len(report.suppressed)} suppressed; "
+          f"cached re-scan {cached_s:.3f}s ({stats['cache']})",
           file=sys.stderr)
+    print(json.dumps({
+        "metric": "analyzer_findings_total",
+        "value": len(report.findings),
+        "unit": "findings",
+        "suppressed": len(report.suppressed),
+        "by_rule": report.by_rule(),
+        "clean": report.ok,
+    }))
     # secondary metric line (the driver reads the LAST line only)
     print(json.dumps({
         "metric": "analyzer_repo_scan_seconds",
         "value": round(scan_s, 3),
         "unit": "s",
         "files": report.files_scanned,
+        "cached_rescan_seconds": round(cached_s, 3),
+        "cache_ok": cache_ok,
         "clean": report.ok,
     }))
 

@@ -19,6 +19,11 @@ API (mirrors `DeltaMergeBuilder`):
 Conditions and values are expressions over a namespaced batch: columns of
 the target are `target.<name>`, of the source `source.<name>`.
 """
+# delta-lint: file-disable=shared-state-race — audited:
+# MergeBuilder is a per-operation fluent builder: it is created,
+# mutated, and executed by the single thread running the MERGE —
+# sharing one across threads is outside its contract (matching the
+# reference's DeltaMergeBuilder).
 
 from __future__ import annotations
 

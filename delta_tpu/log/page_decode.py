@@ -18,6 +18,10 @@ data page v1, SNAPPY or uncompressed, non-repeated columns (struct
 nesting adds definition levels and is handled; lists/maps are not),
 PLAIN / RLE_DICTIONARY values, physical INT32/INT64/DOUBLE/BOOLEAN.
 """
+# delta-lint: file-disable=shared-state-race — audited:
+# _Thrift is a function-local decode cursor: constructed inside the
+# decode call, never stored or returned, so no two threads ever see
+# the same instance.
 
 from __future__ import annotations
 

@@ -16,6 +16,11 @@ always-on path reporters depend on) and opens a `delta_tpu.obs` span
 and trace timings therefore come from the same scopes — a report is the
 flat projection of the spans of one operation.
 """
+# delta-lint: file-disable=shared-state-race — audited:
+# Timer/Counter here are per-operation metric bags (one
+# SnapshotMetrics per snapshot load, owned by the operation's
+# thread); the cross-thread instruments live in obs.registry, which
+# locks where it must.
 
 from __future__ import annotations
 

@@ -5,10 +5,15 @@ per-operation metric bags (`internal/metrics/`); cross-operation totals
 (parse-cache hit rates, storage bytes, retry counts) need a process-wide
 home instead. This registry is that home.
 
-Fast path is lock-free: instrument sites resolve their Counter once at
-module import (`_HITS = counter("parse_cache.hit_files")`) and the hot
-call is a plain attribute increment — GIL-atomic for ints, no lock, no
-dict lookup. The registry lock only guards instrument *creation*.
+The Counter fast path is lock-free: instrument sites resolve their
+Counter once at module import (`_HITS = counter("parse_cache.hit_files")`)
+and the hot call is a plain attribute increment — a monotonic counter
+tolerates the rare lost `+=` under thread interleaving (telemetry
+tolerance). Gauges and histograms do NOT get that trade: an up/down
+gauge drifts permanently when an inc/dec pair interleaves, and a
+histogram update must keep `sum(buckets) == count`, so those take a
+per-instrument lock. The registry lock only guards instrument
+*creation*.
 
 Counters are always on (a dict-free int add is cheaper than checking a
 gate); the span machinery in `trace.py` carries the `DELTA_TPU_TRACE`
@@ -58,12 +63,20 @@ class Histogram:
     """Streaming summary: count/sum/min/max plus a fixed-boundary bucket
     vector (`EXPORT_BUCKETS`) for aggregatable Prometheus exposition.
     The per-operation latency distribution still lives in spans; this is
-    the cheap aggregate for code paths too hot to span."""
+    the cheap aggregate for code paths too hot to span.
 
-    __slots__ = ("name", "count", "sum", "min", "max", "buckets")
+    `observe()` takes a per-instrument lock: unlike a monotonic counter
+    (where interleaved `+=` merely loses increments), a histogram update
+    touches count/sum/min/max/buckets together — interleaving breaks the
+    `sum(buckets) == count` invariant scrapes and burn-rate math rely
+    on."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "buckets",
+                 "_lock")
 
     def __init__(self, name: str):
         self.name = name
+        self._lock = threading.Lock()
         self.count = 0
         self.sum = 0
         self.min = None
@@ -73,22 +86,24 @@ class Histogram:
         self.buckets = [0] * (len(EXPORT_BUCKETS) + 1)
 
     def observe(self, value) -> None:
-        self.count += 1
-        self.sum += value
-        mn = self.min
-        if mn is None or value < mn:
-            self.min = value
-        mx = self.max
-        if mx is None or value > mx:
-            self.max = value
-        self.buckets[bisect.bisect_left(EXPORT_BUCKETS, value)] += 1
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            mn = self.min
+            if mn is None or value < mn:
+                self.min = value
+            mx = self.max
+            if mx is None or value > mx:
+                self.max = value
+            self.buckets[bisect.bisect_left(EXPORT_BUCKETS, value)] += 1
 
     def reset(self) -> None:
-        self.count = 0
-        self.sum = 0
-        self.min = None
-        self.max = None
-        self.buckets = [0] * (len(EXPORT_BUCKETS) + 1)
+        with self._lock:
+            self.count = 0
+            self.sum = 0
+            self.min = None
+            self.max = None
+            self.buckets = [0] * (len(EXPORT_BUCKETS) + 1)
 
     @property
     def mean(self):
@@ -118,10 +133,11 @@ class Gauge:
     `read()` swallows callback errors to None so a half-torn structure
     during shutdown can't break a scrape."""
 
-    __slots__ = ("name", "value", "_fn")
+    __slots__ = ("name", "value", "_fn", "_lock")
 
     def __init__(self, name: str):
         self.name = name
+        self._lock = threading.Lock()
         self.value = 0
         self._fn: Optional[Callable[[], object]] = None
 
@@ -130,10 +146,15 @@ class Gauge:
         self.value = value
 
     def inc(self, n=1) -> None:
-        self.value += n
+        # unlike Counter's monotonic loss tolerance, an up/down gauge
+        # drifts PERMANENTLY when an inc/dec pair interleaves (the
+        # in-flight depth never returns to zero), so these take the lock
+        with self._lock:
+            self.value += n
 
     def dec(self, n=1) -> None:
-        self.value -= n
+        with self._lock:
+            self.value -= n
 
     def set_fn(self, fn: Callable[[], object]) -> None:
         """Bind a zero-arg callback; subsequent `read()`s return its

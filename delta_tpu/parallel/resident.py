@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -81,6 +82,13 @@ class ResidentShardState:
 
     def __init__(self, payload, paths, path_codes: np.ndarray):
         # payload: sharded_replay.ResidentPayload
+        # Guards every post-publication mutation: append() rewrites the
+        # slot bookkeeping and swaps the donated device lane, and
+        # release() tears the lane down — the serve cache can evict (and
+        # release) a snapshot while another thread's refresh is still
+        # inside append(), so the two must serialize here, not rely on
+        # callers holding the right entry lock.
+        self._lock = threading.Lock()
         self.mesh = payload.mesh
         self.m = payload.m
         self.n_shards = int(payload.mesh.devices.size)
@@ -146,6 +154,10 @@ class ResidentShardState:
         n_prev + delta rows — or None when this state can't express the
         batch (caller falls back to the host delta path and drops
         residency)."""
+        with self._lock:
+            return self._append_locked(delta_fa, n_prev)
+
+    def _append_locked(self, delta_fa, n_prev: int):
         from delta_tpu.ops.replay import chrono_ok
 
         d = delta_fa.num_rows
@@ -248,11 +260,14 @@ class ResidentShardState:
 
     def release(self) -> None:
         """Drop the device buffer (the host bookkeeping is garbage with
-        it, so the whole state is dead after this)."""
-        if self.key_sh is not None:
-            self.key_sh = None
-            _HBM_BYTES.dec(self._hbm_bytes)
-            self._hbm_bytes = 0
+        it, so the whole state is dead after this). Serializes against
+        append(): an in-flight append finishes against the lane it
+        started with before the release lands."""
+        with self._lock:
+            if self.key_sh is not None:
+                self.key_sh = None
+                _HBM_BYTES.dec(self._hbm_bytes)
+                self._hbm_bytes = 0
 
 
 def establish_resident(payload, file_actions,

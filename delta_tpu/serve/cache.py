@@ -66,21 +66,40 @@ class SnapshotCache:
             if e is not None:
                 self._entries.move_to_end(path)
                 return e
-            e = _Entry(Table.for_path(path, self._engine))
-            self._entries[path] = e
+        # Table.for_path touches the filesystem (expanduser/makedirs),
+        # so it must not run under the cache lock: a slow open would
+        # stall every other table. Build optimistically, then
+        # put-if-absent — a concurrent builder for the same path wins
+        # and the losing Table (no snapshot loaded yet) is dropped.
+        fresh = _Entry(Table.for_path(path, self._engine))
+        evicted = []
+        with self._lock:
+            e = self._entries.get(path)
+            if e is not None:
+                self._entries.move_to_end(path)
+                return e
+            self._entries[path] = fresh
             while len(self._entries) > self._config.cache_tables:
                 _, old = self._entries.popitem(last=False)
                 if old.snapshot is not None:
-                    # evicted snapshots must free their device-resident
-                    # replay state — HBM is the scarce resource here;
-                    # entries that merely advance keep residency (the
-                    # state moves to the advanced snapshot)
-                    from delta_tpu.parallel.resident import (
-                        release_snapshot_resident,
-                    )
+                    evicted.append(old)
+        # Evicted snapshots must free their device-resident replay
+        # state — HBM is the scarce resource here; entries that merely
+        # advance keep residency (the state moves to the advanced
+        # snapshot). The release happens OUTSIDE the cache lock (it
+        # drops device buffers) and UNDER the evicted entry's own lock,
+        # so a refresh still in flight on that entry (snapshot_for holds
+        # e.lock across Table.update) finishes its append before the
+        # resident key lane is torn down beneath it.
+        if evicted:
+            from delta_tpu.parallel.resident import (
+                release_snapshot_resident,
+            )
 
+            for old in evicted:
+                with old.lock:
                     release_snapshot_resident(old.snapshot)
-            return e
+        return fresh
 
     def snapshot_for(self, path: str,
                      version: Optional[int] = None) -> Tuple[object, dict]:

@@ -1,0 +1,111 @@
+"""Findings baseline: ratchet CI on NEW findings only.
+
+``delta-lint --baseline write`` snapshots the current unsuppressed
+findings into a committed JSON file; ``--baseline check`` re-runs the
+scan and fails only on findings not in that snapshot, reporting the
+rest as known debt. This is how a new rule lands on a big tree without
+a flag day: commit the baseline with the rule, burn the debt down in
+follow-ups, and the ratchet stops regressions in the meantime.
+
+Fingerprints must survive unrelated edits, so they deliberately exclude
+line numbers: a finding is identified by its rule id, file path, the
+*text* of the source line it points at (stripped), and the message.
+Inserting code above a finding moves its line number but not its
+fingerprint. Identical findings are disambiguated by multiplicity: the
+baseline stores a count per fingerprint, and a check consumes matches
+up to that count — adding a second identical defect on a new line is
+still NEW.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from delta_tpu.tools.analyzer.core import Finding, Report
+
+BASELINE_ENV = "DELTA_LINT_BASELINE"
+DEFAULT_BASELINE_NAME = "delta-lint-baseline.json"
+_SCHEMA = 1
+
+
+def default_baseline_path() -> str:
+    return os.environ.get(BASELINE_ENV) or DEFAULT_BASELINE_NAME
+
+
+def _line_text(f: Finding, root: Optional[str],
+               _cache: Dict[str, List[str]]) -> str:
+    path = os.path.join(root, f.path) if root else f.path
+    lines = _cache.get(path)
+    if lines is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            lines = []
+        _cache[path] = lines
+    if 1 <= f.line <= len(lines):
+        return lines[f.line - 1].strip()
+    return ""
+
+
+def fingerprint(f: Finding, line_text: str) -> str:
+    return hashlib.sha1(
+        f"{f.rule}|{f.path}|{line_text}|{f.message}".encode()
+    ).hexdigest()
+
+
+def _fingerprints(findings: List[Finding],
+                  root: Optional[str]) -> List[Tuple[Finding, str]]:
+    cache: Dict[str, List[str]] = {}
+    return [(f, fingerprint(f, _line_text(f, root, cache)))
+            for f in findings]
+
+
+def write_baseline(path: str, report: Report,
+                   root: Optional[str] = None) -> int:
+    """Snapshot `report`'s unsuppressed findings; returns the count."""
+    counts: Dict[str, int] = {}
+    for _, fp in _fingerprints(report.findings, root):
+        counts[fp] = counts.get(fp, 0) + 1
+    doc = {"schema": _SCHEMA, "findings": len(report.findings),
+           "fingerprints": dict(sorted(counts.items()))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(report.findings)
+
+
+def load_baseline(path: str) -> Optional[Dict[str, int]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != _SCHEMA:
+        return None
+    fps = doc.get("fingerprints")
+    return {str(k): int(v) for k, v in fps.items()} \
+        if isinstance(fps, dict) else None
+
+
+def apply_baseline(report: Report, baseline: Dict[str, int],
+                   root: Optional[str] = None) -> Report:
+    """Partition `report.findings` against `baseline`: matched
+    fingerprints (up to their stored multiplicity) move to
+    ``report.baselined``; the remainder stay failing."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    known: List[Finding] = []
+    for f, fp in _fingerprints(report.findings, root):
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            known.append(f)
+        else:
+            new.append(f)
+    return Report(findings=new, suppressed=report.suppressed,
+                  files_scanned=report.files_scanned,
+                  rules_run=report.rules_run, baselined=known,
+                  baseline_checked=True)

@@ -1,0 +1,186 @@
+"""Scan cache: per-file fingerprints + the last report, for
+``delta-lint --changed``.
+
+The engine is whole-program (lock discipline, the race detector, and
+the transfer budget all build one :class:`~.core.ProjectGraph` over
+every module), so a single changed file can change findings anywhere —
+per-file *finding* reuse would be unsound for any rule that accumulates
+module-pass facts into its project pass. What IS sound is per-file
+*change detection*: the cache keys every scanned file by
+``(mtime_ns, size)`` with a content-hash fallback (a ``touch`` or a
+checkout that rewrites identical bytes stays a hit), plus a stamp over
+the analyzer's own sources and the rule set. When nothing changed, the
+previous report is reconstructed without parsing a single file —
+that is the CI hot path (re-runs on unchanged trees) and the
+``analyzer_cached_rescan`` bench path. When anything changed, the scan
+re-runs in full and the cache is rewritten.
+
+The cache file is plain JSON, defaulting to ``.delta-lint-cache.json``
+in the current directory (override with ``--cache-file`` or
+``DELTA_LINT_CACHE``). It is a pure accelerator: corrupt, stale, or
+missing cache files degrade to a full scan, never to wrong output.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    Report,
+    _iter_py_files,
+    _run,
+    load_modules,
+)
+
+CACHE_ENV = "DELTA_LINT_CACHE"
+DEFAULT_CACHE_NAME = ".delta-lint-cache.json"
+_SCHEMA = 1
+
+
+def default_cache_path() -> str:
+    return os.environ.get(CACHE_ENV) or DEFAULT_CACHE_NAME
+
+
+def _toolprint() -> str:
+    """Fingerprint of the analyzer package itself (stat-based): a rule
+    edit must invalidate every cached report."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1()
+    for fp in sorted(_iter_py_files(pkg)):
+        st = os.stat(fp)
+        h.update(f"{os.path.relpath(fp, pkg)}|{st.st_mtime_ns}|"
+                 f"{st.st_size}\n".encode())
+    return h.hexdigest()
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> Dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message, "severity": f.severity}
+
+
+def _finding_from_dict(d: Dict) -> Finding:
+    return Finding(d["rule"], d["path"], int(d["line"]), int(d["col"]),
+                   d["message"], d.get("severity", "error"))
+
+
+def _collect_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        out.extend(_iter_py_files(p))
+    return out
+
+
+def load_cache(cache_path: str) -> Optional[Dict]:
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != _SCHEMA:
+        return None
+    return doc
+
+
+def _changed_files(cached: Optional[Dict], files: List[str],
+                   stamp: Dict) -> Tuple[List[str], Dict[str, Dict]]:
+    """Return (changed file list, fresh per-file fingerprint map).
+
+    A file counts unchanged when (mtime_ns, size) match the cache, or
+    — after a stat mismatch — its content hash still matches (touched
+    but identical). Added and removed files both count as changes
+    (removal shows up as a cache entry with no file on disk)."""
+    prints: Dict[str, Dict] = {}
+    if cached is None or cached.get("stamp") != stamp:
+        for fp in files:
+            st = os.stat(fp)
+            prints[fp] = {"mtime_ns": st.st_mtime_ns,
+                          "size": st.st_size, "sha256": _sha256(fp)}
+        return list(files), prints
+
+    old: Dict[str, Dict] = cached.get("files", {})
+    changed: List[str] = []
+    for fp in files:
+        st = os.stat(fp)
+        rec = old.get(fp)
+        if rec is not None and rec.get("mtime_ns") == st.st_mtime_ns \
+                and rec.get("size") == st.st_size:
+            prints[fp] = rec
+            continue
+        sha = _sha256(fp)
+        prints[fp] = {"mtime_ns": st.st_mtime_ns, "size": st.st_size,
+                      "sha256": sha}
+        if rec is None or rec.get("sha256") != sha:
+            changed.append(fp)
+    changed.extend(fp for fp in old if fp not in prints)  # deletions
+    return changed, prints
+
+
+def _report_from_cache(cached: Dict) -> Report:
+    rep = cached["report"]
+    return Report(
+        findings=[_finding_from_dict(d) for d in rep["findings"]],
+        suppressed=[_finding_from_dict(d) for d in rep["suppressed"]],
+        files_scanned=int(rep["files_scanned"]),
+        rules_run=list(rep["rules_run"]),
+    )
+
+
+def analyze_paths_cached(
+        paths: Iterable[str],
+        root: Optional[str] = None,
+        rules: Optional[Iterable[str]] = None,
+        cache_path: Optional[str] = None,
+) -> Tuple[Report, Dict]:
+    """``--changed``-mode entry point: full-fidelity report, but skip
+    the scan entirely when no scanned file changed since the cached
+    run. Returns ``(report, stats)`` where stats records the cache
+    outcome for the CLI/bench (``hit`` | ``stale`` | ``cold``, plus the
+    changed-file count)."""
+    cache_path = cache_path or default_cache_path()
+    rule_list = sorted(rules) if rules is not None else None
+    stamp = {"schema": _SCHEMA, "tool": _toolprint(),
+             "rules": rule_list, "root": root,
+             "paths": sorted(os.path.abspath(p) for p in paths)}
+    files = _collect_files(paths)
+    cached = load_cache(cache_path)
+    changed, prints = _changed_files(cached, files, stamp)
+
+    if cached is not None and not changed:
+        return _report_from_cache(cached), {
+            "cache": "hit", "changed_files": 0, "files": len(files)}
+
+    report = _run(load_modules(paths, root=root), rules)
+    doc = {
+        "schema": _SCHEMA,
+        "stamp": stamp,
+        "files": prints,
+        "report": {
+            "findings": [_finding_to_dict(f) for f in report.findings],
+            "suppressed": [_finding_to_dict(f)
+                           for f in report.suppressed],
+            "files_scanned": report.files_scanned,
+            "rules_run": report.rules_run,
+        },
+    }
+    try:
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # unwritable cache location: still return the fresh report
+    return report, {
+        "cache": "cold" if cached is None else "stale",
+        "changed_files": len(changed), "files": len(files)}

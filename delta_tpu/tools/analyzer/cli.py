@@ -3,6 +3,10 @@ the ``delta-lint`` console script.
 
 Exit status: 0 when the unsuppressed-findings list is empty, 1 when
 any rule fired, 2 on usage errors — so CI can gate on it directly.
+With ``--baseline check``, findings matched against the committed
+baseline are known debt and do not fail the run; only NEW findings do.
+``--changed`` consults the scan cache and skips the scan entirely when
+no scanned file changed since the cached run.
 """
 
 from __future__ import annotations
@@ -12,6 +16,16 @@ import os
 import sys
 from typing import List, Optional
 
+from delta_tpu.tools.analyzer.baseline import (
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from delta_tpu.tools.analyzer.cache import (
+    analyze_paths_cached,
+    default_cache_path,
+)
 from delta_tpu.tools.analyzer.core import all_rules, analyze_paths
 from delta_tpu.tools.analyzer.report import render_json, render_text
 
@@ -27,8 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="delta-lint",
         description="delta-tpu project-native static analysis "
-                    "(lock discipline, JAX purity, error-catalog "
-                    "conformance, exception hygiene, undefined names)")
+                    "(lock discipline, shared-state races, transfer "
+                    "budgets, JAX purity, error-catalog conformance, "
+                    "exception hygiene, undefined names)")
     p.add_argument("paths", nargs="*",
                    help="files or directories to scan "
                         "(default: the delta_tpu package)")
@@ -40,7 +55,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the rule catalog and exit")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print findings silenced by "
-                        "`# delta-lint: disable=...` pragmas")
+                        "`# delta-lint: disable=...` pragmas "
+                        "(and baselined findings under "
+                        "--baseline check)")
+    p.add_argument("--changed", action="store_true",
+                   help="use the scan cache: skip the scan when no "
+                        "target file changed since the last cached run")
+    p.add_argument("--cache-file", default=None,
+                   help="scan cache location (default: "
+                        "$DELTA_LINT_CACHE or .delta-lint-cache.json)")
+    p.add_argument("--baseline", choices=("write", "check"),
+                   help="'write': snapshot current findings as the "
+                        "accepted baseline; 'check': fail only on "
+                        "findings not in the baseline")
+    p.add_argument("--baseline-file", default=None,
+                   help="baseline location (default: "
+                        "$DELTA_LINT_BASELINE or "
+                        "delta-lint-baseline.json)")
     return p
 
 
@@ -49,8 +80,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for rule_id, cls in sorted(all_rules().items()):
-            print(f"{rule_id}: {cls.description or cls.__doc__ or ''}"
-                  .strip())
+            desc = (cls.description or cls.__doc__ or "").strip()
+            print(f"{rule_id}: {desc}  [{cls.help_uri()}]")
         return 0
 
     paths = args.paths or [_default_target()]
@@ -61,10 +92,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     try:
-        report = analyze_paths(paths, rules=rules)
+        if args.changed:
+            report, stats = analyze_paths_cached(
+                paths, rules=rules,
+                cache_path=args.cache_file or default_cache_path())
+            print(f"delta-lint: cache {stats['cache']} "
+                  f"({stats['changed_files']} changed of "
+                  f"{stats['files']} files)", file=sys.stderr)
+        else:
+            report = analyze_paths(paths, rules=rules)
     except ValueError as e:  # unknown rule id
         print(f"delta-lint: {e}", file=sys.stderr)
         return 2
+
+    baseline_path = args.baseline_file or default_baseline_path()
+    if args.baseline == "write":
+        n = write_baseline(baseline_path, report)
+        print(f"delta-lint: baseline written to {baseline_path} "
+              f"({n} finding(s))", file=sys.stderr)
+        return 0
+    if args.baseline == "check":
+        baseline = load_baseline(baseline_path)
+        if baseline is None:
+            print(f"delta-lint: no readable baseline at "
+                  f"{baseline_path} (run --baseline write first)",
+                  file=sys.stderr)
+            return 2
+        report = apply_baseline(report, baseline)
 
     if args.format == "json":
         print(render_json(report))

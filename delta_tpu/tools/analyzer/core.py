@@ -1,4 +1,5 @@
-"""delta-lint core: module model, rule plugin registry, analysis engine.
+"""delta-lint core: module model, rule plugin registry, analysis engine,
+and the shared whole-program layer.
 
 The engine runs in three passes, so project-wide rules (lock-order
 cycles, catalog conformance) see every module before they report:
@@ -11,6 +12,17 @@ cycles, catalog conformance) see every module before they report:
    over all modules (rules typically accumulate facts during the module
    pass and cross-reference them here).
 
+Interprocedural rules (lock discipline, the shared-state race detector,
+the device-transfer budget) additionally share ONE :class:`ProjectGraph`
+per module set — a project-wide call graph with def/attr/method
+resolution (imports and re-exports, ``functools.partial`` aliases,
+dict-dispatch tables, constructor/annotation-based receiver typing),
+thread-root discovery (``threading.Thread`` targets and spawn wrappers,
+executor ``submit``/``map``, ``obs.wrap``), and a small dataflow driver
+(:meth:`ProjectGraph.reachable_from`,
+:meth:`ProjectGraph.propagate_meet`). Get it via :func:`project_graph`;
+it is cached on module-set identity exactly like the lock model.
+
 Adding a rule: subclass :class:`Rule`, set ``id``/``description``,
 implement either hook, decorate with :func:`register`, and import the
 module from ``passes/__init__.py``. Fixture-test it in
@@ -22,8 +34,18 @@ from __future__ import annotations
 
 import ast
 import os
-from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Type
+from dataclasses import dataclass, field
+from typing import (
+    Callable,
+    Dict,
+    FrozenSet,
+    Iterable,
+    List,
+    Optional,
+    Set,
+    Tuple,
+    Type,
+)
 
 from delta_tpu.tools.analyzer.suppress import is_suppressed, parse_suppressions
 
@@ -69,6 +91,15 @@ class Rule:
 
     id: str = "?"
     description: str = ""
+    # anchor into docs/static_analysis.md; the SARIF reporter turns it
+    # into the rule's helpUri so CI annotations are clickable. Rules
+    # documented under a shared section override this.
+    help_anchor: str = ""
+
+    @classmethod
+    def help_uri(cls) -> str:
+        anchor = cls.help_anchor or cls.id
+        return f"docs/static_analysis.md#{anchor}"
 
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
         return ()
@@ -102,6 +133,10 @@ class Report:
     suppressed: List[Finding]        # matched a disable pragma
     files_scanned: int
     rules_run: List[str]
+    # findings matched against a committed baseline (``delta-lint
+    # --baseline check``): known debt, reported but not failing
+    baselined: List[Finding] = field(default_factory=list)
+    baseline_checked: bool = False
 
     @property
     def ok(self) -> bool:
@@ -145,29 +180,45 @@ def load_modules(paths: Iterable[str],
 # ------------------------------------------------------------------ engine
 
 
-def _run(mods: List[ModuleInfo],
-         rule_ids: Optional[Iterable[str]] = None) -> Report:
+def resolve_rules(
+        rule_ids: Optional[Iterable[str]] = None,
+) -> Tuple[List[str], List[Rule]]:
+    """Validate + instantiate: (sorted/ordered ids, fresh instances)."""
     registry = all_rules()
     ids = list(rule_ids) if rule_ids is not None else sorted(registry)
     unknown = [i for i in ids if i not in registry]
     if unknown:
         raise ValueError(f"unknown delta-lint rule(s): {unknown}; "
                          f"known: {sorted(registry)}")
-    rules = [registry[i]() for i in ids]
+    return ids, [registry[i]() for i in ids]
 
-    raw: List[Finding] = []
-    for mod in mods:
-        if mod.syntax_error is not None:
-            e = mod.syntax_error
-            raw.append(Finding("parse-error", mod.rel, e.lineno or 1, 0,
-                               f"syntax error: {e.msg}"))
-            continue
-        for rule in rules:
-            raw.extend(rule.check_module(mod))
-    parsed = [m for m in mods if m.tree is not None]
+
+def module_pass(mod: ModuleInfo, rules: List[Rule]) -> List[Finding]:
+    """Per-file findings only — the cacheable half of the engine."""
+    if mod.syntax_error is not None:
+        e = mod.syntax_error
+        return [Finding("parse-error", mod.rel, e.lineno or 1, 0,
+                        f"syntax error: {e.msg}")]
+    out: List[Finding] = []
     for rule in rules:
-        raw.extend(rule.check_project(parsed))
+        out.extend(rule.check_module(mod))
+    return out
 
+
+def project_pass(mods: List[ModuleInfo],
+                 rules: List[Rule]) -> List[Finding]:
+    """Whole-program findings; sees every parsed module at once."""
+    parsed = [m for m in mods if m.tree is not None]
+    out: List[Finding] = []
+    for rule in rules:
+        out.extend(rule.check_project(parsed))
+    return out
+
+
+def partition_findings(
+        mods: List[ModuleInfo],
+        raw: Iterable[Finding]) -> Tuple[List[Finding], List[Finding]]:
+    """Split raw findings into (unsuppressed, suppressed), both sorted."""
     by_rel = {m.rel: m for m in mods}
     findings: List[Finding] = []
     suppressed: List[Finding] = []
@@ -179,6 +230,17 @@ def _run(mods: List[ModuleInfo],
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, suppressed
+
+
+def _run(mods: List[ModuleInfo],
+         rule_ids: Optional[Iterable[str]] = None) -> Report:
+    ids, rules = resolve_rules(rule_ids)
+    raw: List[Finding] = []
+    for mod in mods:
+        raw.extend(module_pass(mod, rules))
+    raw.extend(project_pass(mods, rules))
+    findings, suppressed = partition_findings(mods, raw)
     return Report(findings=findings, suppressed=suppressed,
                   files_scanned=len(mods), rules_run=ids)
 
@@ -195,3 +257,922 @@ def analyze_sources(sources: Dict[str, str],
     fixture-test entry point."""
     mods = [ModuleInfo(path, src) for path, src in sources.items()]
     return _run(mods, rules)
+
+
+# ===================================================================
+# Whole-program layer: project call graph, thread roots, dataflow.
+# ===================================================================
+
+MODULE_BODY = "<module>"
+
+
+def module_stem(rel: str) -> str:
+    """``a/b/c.py`` -> ``a.b.c``; packages drop ``__init__``."""
+    stem = rel[:-3] if rel.endswith(".py") else rel
+    stem = stem.replace(os.sep, ".").replace("/", ".")
+    if stem.endswith(".__init__"):
+        stem = stem[:-len(".__init__")]
+    return stem
+
+
+@dataclass(frozen=True)
+class CallEdge:
+    """One resolved call: `caller` invokes `callee` at `line` of the
+    caller's file. Keys are ``<rel-path>::<qualname>``. `node_id` is
+    ``id()`` of the ``ast.Call`` for direct calls (0 for synthesized
+    edges: higher-order escapes, deferred attr calls) — passes that
+    walk the same shared ASTs use it to join their own per-site facts
+    (e.g. lexically-held locks) onto graph edges."""
+
+    caller: str
+    callee: str
+    line: int
+    node_id: int = 0
+
+
+@dataclass(frozen=True)
+class ThreadRoot:
+    """A function that runs on a thread other than its spawner's.
+
+    `multi` marks roots that can run on MORE than one concurrent thread
+    from this single syntactic site (worker pools: the spawn sits in a
+    loop, or goes through an executor ``submit``/``map``) — a
+    multi-root alone makes everything it reaches shared state."""
+
+    target: str       # function key the new thread enters
+    site_path: str
+    site_line: int
+    kind: str         # thread | spawn-wrapper | submit | pool-map |
+    #                   obs-wrap | thread-subclass
+    multi: bool
+
+    @property
+    def site(self) -> str:
+        return f"{self.site_path}:{self.site_line}"
+
+
+@dataclass
+class FunctionNode:
+    key: str                      # "<rel>::<qualname>"
+    mod_rel: str
+    qualname: str
+    cls: Optional[str]            # enclosing class name, if a method
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+
+
+# attribute-method names too generic for the unique-definition fallback:
+# resolving `xs.append(...)` to the one project class defining `append`
+# would wire list mutations into the call graph
+_COMMON_METHODS = frozenset({
+    "append", "add", "get", "set", "put", "pop", "update", "items",
+    "keys", "values", "join", "start", "close", "read", "write", "wait",
+    "clear", "sort", "remove", "insert", "extend", "copy", "format",
+    "split", "strip", "encode", "decode", "count", "index", "setdefault",
+    "popitem", "discard", "send", "recv", "acquire", "release", "open",
+    "flush", "seek", "tell", "next", "run", "name", "result", "submit",
+    "map", "group", "match", "search", "startswith", "endswith",
+})
+
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_WRAP_NAMES = {"obs.wrap", "wrap"}
+_THREAD_NAMES = {"threading.Thread", "Thread"}
+
+
+class _ClassInfo:
+    __slots__ = ("name", "mod_rel", "bases", "methods", "attr_types")
+
+    def __init__(self, name: str, mod_rel: str):
+        self.name = name
+        self.mod_rel = mod_rel
+        self.bases: List[str] = []        # dotted base names, unresolved
+        self.methods: Dict[str, str] = {}  # method name -> function key
+        # attr -> dotted type name, from `self.x = Cls()` stores, class
+        # body annotations, and `self.x = fn()` with `-> Cls` annotation
+        self.attr_types: Dict[str, str] = {}
+
+
+def _ann_class_name(ann: ast.AST) -> Optional[str]:
+    """`Cls`, `Optional[Cls]`, `"Cls"` -> "Cls" (dotted ok)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        if base and base.rpartition(".")[2] in ("Optional", "Union"):
+            inner = ann.slice
+            if isinstance(inner, ast.Tuple):
+                elts = [e for e in inner.elts
+                        if not (isinstance(e, ast.Constant)
+                                and e.value is None)]
+                inner = elts[0] if len(elts) == 1 else None
+            return _ann_class_name(inner) if inner is not None else None
+        return None
+    return _dotted(ann)
+
+
+def _dotted(node) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ModuleView:
+    """Per-module symbol tables feeding project-wide resolution."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.stem = module_stem(mod.rel)
+        self.functions: Dict[str, ast.AST] = {}   # qualname -> def node
+        self.fn_class: Dict[str, Optional[str]] = {}
+        self.imports: Dict[str, str] = {}         # alias -> dotted module
+        self.from_names: Dict[str, Tuple[str, str]] = {}  # alias -> (mod, orig)
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.aliases: Dict[str, str] = {}   # module-level fn alias -> dotted
+        self.dispatch: Dict[str, List[str]] = {}  # dict name -> dotted fns
+        self.instances: Dict[str, str] = {}  # module-level var -> class name
+        self.returns: Dict[str, str] = {}    # qualname -> annotated class
+
+        tree = mod.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.partition(".")[0]] = (
+                        a.name if a.asname else a.name.partition(".")[0])
+                    if not a.asname and "." in a.name:
+                        # `import a.b.c` also binds the full dotted path
+                        self.imports[a.name] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        self.from_names[a.asname or a.name] = (
+                            node.module, a.name)
+
+        self._collect_defs(tree.body, prefix="", cls=None)
+
+        for st in tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                name, v = st.targets[0].id, st.value
+                if isinstance(v, ast.Call):
+                    cn = _dotted(v.func)
+                    if cn in _PARTIAL_NAMES and v.args:
+                        t = _dotted(v.args[0])
+                        if t:
+                            self.aliases[name] = t
+                    elif cn:
+                        self.instances[name] = cn
+                elif isinstance(v, ast.Name) or isinstance(v, ast.Attribute):
+                    t = _dotted(v)
+                    if t:
+                        self.aliases[name] = t
+                elif isinstance(v, ast.Dict):
+                    fns = []
+                    for val in v.values:
+                        t = _dotted(val)
+                        if t:
+                            fns.append(t)
+                    if fns:
+                        self.dispatch[name] = fns
+
+    def _collect_defs(self, body, prefix: str, cls: Optional[str]):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{node.name}"
+                self.functions[qn] = node
+                self.fn_class[qn] = cls
+                rcls = node.returns and _ann_class_name(node.returns)
+                if rcls:
+                    self.returns[qn] = rcls
+                if cls is not None and prefix.count(".") == 1:
+                    self.classes[cls].methods[node.name] = qn
+                # nested defs: attributed their own node, one level of
+                # dotting per nesting level
+                self._collect_defs(node.body, prefix=f"{qn}.", cls=cls)
+            elif isinstance(node, ast.ClassDef) and not prefix:
+                ci = self.classes.setdefault(
+                    node.name, _ClassInfo(node.name, self.mod.rel))
+                for b in node.bases:
+                    bn = _dotted(b)
+                    if bn:
+                        ci.bases.append(bn)
+                for st in node.body:
+                    if isinstance(st, ast.AnnAssign) \
+                            and isinstance(st.target, ast.Name):
+                        tn = _ann_class_name(st.annotation)
+                        if tn:
+                            ci.attr_types[st.target.id] = tn
+                self._collect_defs(node.body, prefix=f"{node.name}.",
+                                   cls=node.name)
+
+
+class ProjectGraph:
+    """Project-wide call graph + thread roots + dataflow driver.
+
+    Resolution is deliberately an over-approximation where precision is
+    unavailable (dict dispatch resolves to every value; an attribute
+    method with no receiver type resolves through the project-unique
+    definition fallback) — for the race detector and budget lint a
+    missed edge hides a real bug, while a spurious edge costs one
+    triaged suppression.
+    """
+
+    def __init__(self, mods: List[ModuleInfo]):
+        self.mods = mods
+        self.views: Dict[str, _ModuleView] = {
+            m.rel: _ModuleView(m) for m in mods}
+        self.by_stem: Dict[str, _ModuleView] = {
+            v.stem: v for v in self.views.values()}
+        self.functions: Dict[str, FunctionNode] = {}
+        for v in self.views.values():
+            for qn, fn in v.functions.items():
+                key = f"{v.mod.rel}::{qn}"
+                self.functions[key] = FunctionNode(
+                    key, v.mod.rel, qn, v.fn_class[qn], fn)
+        # method-name index for the unique-definition fallback
+        self._method_defs: Dict[str, List[Tuple[str, str]]] = {}
+        for v in self.views.values():
+            for ci in v.classes.values():
+                for mname, qn in ci.methods.items():
+                    self._method_defs.setdefault(mname, []).append(
+                        (ci.name, f"{v.mod.rel}::{qn}"))
+        self.edges: List[CallEdge] = []
+        self.edges_out: Dict[str, List[CallEdge]] = {}
+        self.edges_in: Dict[str, List[CallEdge]] = {}
+        self.thread_roots: List[ThreadRoot] = []
+        self._spawn_wrappers: Dict[str, int] = {}  # fn key -> param index
+        self._attr_class_fallback: Dict[str, Set[str]] = {}
+        self._find_spawn_wrappers()
+        self._infer_attr_types()
+        # socketserver protocol: a *RequestHandler subclass's handle()
+        # runs on a per-connection thread the stdlib spawns
+        for v in self.views.values():
+            for ci in v.classes.values():
+                if "handle" in ci.methods and any(
+                        b.rpartition(".")[2].endswith("RequestHandler")
+                        for b in ci.bases):
+                    node = v.functions[ci.methods["handle"]]
+                    self.thread_roots.append(ThreadRoot(
+                        f"{ci.mod_rel}::{ci.methods['handle']}",
+                        ci.mod_rel, node.lineno, "request-handler", True))
+        # callables that escape into a class's constructor (stored on
+        # the instance, invoked later through an attribute: `req.fn()`)
+        self._escaped_into: Dict[str, Set[str]] = {}
+        self._pending_attr_calls: List[Tuple[str, str, str, int]] = []
+        # id(ast.Call) -> resolved callee keys, for passes that walk
+        # the same shared ASTs (locks, races)
+        self.call_sites: Dict[int, List[str]] = {}
+        for v in self.views.values():
+            self._scan_module(v)
+        # second pass: `x.attr()` on a typed receiver whose class has no
+        # such method resolves to everything that escaped into the class
+        for caller, cls_name, attr, line in self._pending_attr_calls:
+            for key in self._escaped_into.get(cls_name, ()):
+                self.edges.append(CallEdge(caller, key, line))
+        for e in self.edges:
+            self.edges_out.setdefault(e.caller, []).append(e)
+            self.edges_in.setdefault(e.callee, []).append(e)
+
+    def _infer_attr_types(self):
+        """Fill each class's attr -> type table from ``self.attr = X()``
+        stores in its methods, where X is a constructor or a function
+        with a ``-> Cls`` return annotation. Runs before edge building
+        so ``self.attr.method()`` calls resolve. Also builds the
+        project-wide attr-name fallback: ``anything.attr = X()`` records
+        attr -> class, consulted (only when unique) to type locals
+        seeded from attribute loads — covers fields deliberately
+        annotated ``object`` to break import cycles
+        (``SnapshotState.resident``)."""
+        for v in self.views.values():
+            for qn, fn in v.functions.items():
+                cls = v.fn_class[qn]
+                ci = v.classes.get(cls) if cls else None
+                param_types: Dict[str, str] = {}
+                for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+                    if a.annotation is not None:
+                        tn = _ann_class_name(a.annotation)
+                        if tn:
+                            param_types[a.arg] = tn.rpartition(".")[2]
+                for st in ast.walk(fn):
+                    if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (st.targets if isinstance(st, ast.Assign)
+                               else [st.target])
+                    if len(targets) != 1:
+                        continue
+                    t = targets[0]
+                    if not isinstance(t, ast.Attribute):
+                        continue
+                    rcls = None
+                    if isinstance(st, ast.AnnAssign):
+                        # `self._cached_snapshot: Optional[Snapshot] = None`
+                        tn = _ann_class_name(st.annotation)
+                        if tn:
+                            rcls = tn.rpartition(".")[2]
+                    elif isinstance(st.value, ast.Call):
+                        cn = _dotted(st.value.func)
+                        if cn is not None:
+                            rcls = self._class_of_callable(v, cls, cn)
+                    elif isinstance(st.value, ast.Name):
+                        # `self.table = table` with `table: Table` param
+                        rcls = param_types.get(st.value.id)
+                    if not rcls or rcls in ("object", "Any"):
+                        continue
+                    self._attr_class_fallback.setdefault(
+                        t.attr, set()).add(rcls)
+                    if ci is not None and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        ci.attr_types.setdefault(t.attr, rcls)
+
+    # ---------------------------------------------------- spawn wrappers
+
+    def _find_spawn_wrappers(self):
+        """A function that passes one of its own parameters as
+        ``threading.Thread(target=...)`` is a spawn wrapper: each of its
+        call sites is a thread-root site for the argument it forwards
+        (serve/pool.spawn is the canonical instance)."""
+        for key, fn in self.functions.items():
+            node = fn.node
+            params = [a.arg for a in node.args.args]
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and _dotted(sub.func) in _THREAD_NAMES:
+                    for kw in sub.keywords:
+                        if kw.arg == "target" \
+                                and isinstance(kw.value, ast.Name) \
+                                and kw.value.id in params:
+                            self._spawn_wrappers[key] = params.index(
+                                kw.value.id)
+
+    # ------------------------------------------------------- module scan
+
+    def _scan_module(self, v: _ModuleView):
+        for qn, fn in v.functions.items():
+            caller = f"{v.mod.rel}::{qn}"
+            self._scan_body(v, caller, v.fn_class[qn], fn,
+                            skip_nested=True)
+        # module body (import-time calls, thread spawns at module level)
+        self._scan_body(v, f"{v.mod.rel}::{MODULE_BODY}", None,
+                        v.mod.tree, skip_nested=True)
+
+    def _scan_body(self, v: _ModuleView, caller: str, cls: Optional[str],
+                   fn: ast.AST, skip_nested: bool):
+        env_types: Dict[str, str] = {}       # local var -> class name
+        env_fns: Dict[str, List[str]] = {}   # local var -> function keys
+        submit_aliases: Set[str] = set()
+        own_prefix = caller.split("::", 1)[1]
+        if own_prefix == MODULE_BODY:
+            own_prefix = ""
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+                if a.annotation is not None:
+                    tn = _ann_class_name(a.annotation)
+                    if tn:
+                        env_types[a.arg] = tn.rpartition(".")[2]
+
+        # own-subtree preorder walk (nested defs/classes are their own
+        # graph nodes), tagging each node with whether it executes
+        # repeatedly (loop body or comprehension)
+        nodes: List[Tuple[ast.AST, bool]] = []
+
+        def collect(node: ast.AST, in_loop: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                child_loop = in_loop or isinstance(
+                    child, (ast.For, ast.AsyncFor, ast.While,
+                            ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp))
+                nodes.append((child, child_loop))
+                collect(child, child_loop)
+
+        for st in _body_of(fn):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            st_loop = isinstance(st, (ast.For, ast.AsyncFor, ast.While))
+            nodes.append((st, st_loop))
+            collect(st, st_loop)
+
+        # seed locals first (flow-insensitive: a later assignment types
+        # earlier calls too — an over-approximation, by design)
+        for node, _ in nodes:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._seed_locals(v, cls, node, env_types, env_fns,
+                                  submit_aliases, own_prefix)
+        for node, in_loop in nodes:
+            if isinstance(node, ast.Call):
+                self._handle_call(v, caller, cls, node, env_types,
+                                  env_fns, submit_aliases, in_loop)
+
+    def _seed_locals(self, v, cls, st, env_types, env_fns,
+                     submit_aliases, own_prefix):
+        if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+            return
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            return
+        name = targets[0].id
+        val = st.value
+        if isinstance(st, ast.AnnAssign):
+            tn = st.annotation and _ann_class_name(st.annotation)
+            if tn:
+                env_types[name] = tn.rpartition(".")[2]
+        if val is None:
+            return
+        if isinstance(val, ast.Attribute) and val.attr == "submit":
+            submit_aliases.add(name)
+            return
+        if isinstance(val, ast.Call):
+            cn = _dotted(val.func)
+            if cn in (_PARTIAL_NAMES | _WRAP_NAMES) and val.args:
+                keys = self._resolve_target_expr(v, cls, val.args[0],
+                                                 env_fns, own_prefix)
+                if keys:
+                    env_fns[name] = keys
+                return
+            if cn:
+                # constructor: `x = ClassName(...)`
+                rcls = self._class_of_callable(v, cls, cn)
+                if rcls:
+                    env_types[name] = rcls
+        else:
+            t = _dotted(val)
+            if t:
+                keys = self._resolve_name(v, cls, t, env_fns, own_prefix)
+                if keys:
+                    env_fns[name] = keys
+                elif isinstance(val, ast.Attribute):
+                    # `x = self.attr` / `x = y.attr`: receiver's class
+                    # attr table, then the project-unique attr fallback
+                    recv_cls = None
+                    if isinstance(val.value, ast.Name):
+                        if val.value.id == "self" and cls is not None:
+                            recv_cls = cls
+                        else:
+                            recv_cls = env_types.get(val.value.id)
+                    acls = None
+                    if recv_cls is not None:
+                        ci = self._class_info(v, recv_cls)
+                        if ci is not None:
+                            acls = ci.attr_types.get(val.attr)
+                    if acls is None \
+                            or acls.rpartition(".")[2] in ("object", "Any"):
+                        cands = self._attr_class_fallback.get(val.attr, ())
+                        acls = (next(iter(cands)) if len(cands) == 1
+                                else None)
+                    if acls and acls.rpartition(".")[2] not in (
+                            "object", "Any"):
+                        env_types.setdefault(
+                            name, acls.rpartition(".")[2])
+
+    # -------------------------------------------------------- resolution
+
+    def _resolve_module(self, dotted_mod: str) -> Optional[_ModuleView]:
+        return self.by_stem.get(dotted_mod)
+
+    def _lookup_in_module(self, view: _ModuleView, name: str,
+                          depth: int = 0) -> List[str]:
+        """Resolve `name` inside `view`'s namespace, following
+        re-export chains (``from x import name``) up to 3 hops."""
+        if name in view.functions:
+            return [f"{view.mod.rel}::{name}"]
+        if name in view.classes:
+            ci = view.classes[name]
+            if "__init__" in ci.methods:
+                return [f"{view.mod.rel}::{ci.methods['__init__']}"]
+            return []
+        if name in view.aliases and depth < 3:
+            return self._resolve_name(view, None, view.aliases[name],
+                                      {}, "", depth + 1)
+        if name in view.from_names and depth < 3:
+            src_mod, orig = view.from_names[name]
+            src = self._resolve_module(src_mod)
+            if src is not None:
+                return self._lookup_in_module(src, orig, depth + 1)
+        return []
+
+    def _class_info(self, view: _ModuleView,
+                    cls_name: str) -> Optional[_ClassInfo]:
+        cls_name = cls_name.rpartition(".")[2]
+        if cls_name in view.classes:
+            return view.classes[cls_name]
+        if cls_name in view.from_names:
+            src_mod, orig = view.from_names[cls_name]
+            src = self._resolve_module(src_mod)
+            if src is not None and orig in src.classes:
+                return src.classes[orig]
+        # unique class name project-wide
+        hits = [ci for v2 in self.views.values()
+                for n, ci in v2.classes.items() if n == cls_name]
+        return hits[0] if len(hits) == 1 else None
+
+    def _class_of_callable(self, view, cls, dotted_name) -> Optional[str]:
+        """`ClassName(...)` or `fn(...)` with `-> ClassName`: the class
+        name the result is an instance of."""
+        tail = dotted_name.rpartition(".")[2]
+        if tail[:1].isupper():
+            ci = self._class_info(view, tail)
+            if ci is not None:
+                return ci.name
+            # external constructor (queue.Queue(), threading.Event()):
+            # still the instance's class name — method resolution on it
+            # fails harmlessly, but type-based exemptions (the race
+            # rule's thread-safe table) need it
+            return tail
+        for key in self._resolve_name(view, cls, dotted_name, {}, ""):
+            fnode = self.functions.get(key)
+            if fnode is None:
+                continue
+            v2 = self.views[fnode.mod_rel]
+            rcls = v2.returns.get(fnode.qualname)
+            if rcls:
+                return rcls.rpartition(".")[2]
+        return None
+
+    def _method_on(self, view: _ModuleView, cls_name: str,
+                   method: str) -> List[str]:
+        """Resolve `method` on class `cls_name`, walking same-project
+        base classes."""
+        seen: Set[str] = set()
+        queue = [cls_name]
+        while queue:
+            cn = queue.pop()
+            if cn in seen:
+                continue
+            seen.add(cn)
+            ci = self._class_info(view, cn)
+            if ci is None:
+                continue
+            if method in ci.methods:
+                return [f"{ci.mod_rel}::{ci.methods[method]}"]
+            owner = self.views.get(ci.mod_rel, view)
+            for b in ci.bases:
+                queue.append(b.rpartition(".")[2])
+            view = owner
+        return []
+
+    def _resolve_name(self, v: _ModuleView, cls: Optional[str],
+                      name: str, env_fns: Dict[str, List[str]],
+                      own_prefix: str = "", depth: int = 0) -> List[str]:
+        """Resolve a dotted callable name to function keys."""
+        if depth > 4:
+            return []
+        head, _, rest = name.partition(".")
+        if not rest:
+            if name in env_fns:
+                return env_fns[name]
+            # sibling nested def in the same enclosing function
+            if own_prefix:
+                parts = own_prefix.split(".")
+                for i in range(len(parts), 0, -1):
+                    qn = ".".join(parts[:i]) + f".{name}"
+                    if qn in v.functions:
+                        return [f"{v.mod.rel}::{qn}"]
+            if name in v.functions:
+                return [f"{v.mod.rel}::{name}"]
+            if cls is not None and f"{cls}.{name}" in v.functions:
+                # unqualified call to a sibling method only resolves as
+                # a bare module function; don't invent `self.`
+                pass
+            if name in v.aliases:
+                return self._resolve_name(v, cls, v.aliases[name],
+                                          env_fns, "", depth + 1)
+            if name in v.from_names:
+                src_mod, orig = v.from_names[name]
+                src = self._resolve_module(src_mod)
+                if src is not None:
+                    return self._lookup_in_module(src, orig, depth + 1)
+            if name in v.classes:
+                ci = v.classes[name]
+                if "__init__" in ci.methods:
+                    return [f"{v.mod.rel}::{ci.methods['__init__']}"]
+            return []
+
+        # dotted: try module-path resolution on the longest alias prefix
+        # (`import a.b as x` binds x; `from a import b` binds b as a
+        # module alias too when a.b is a scanned module)
+        parts = name.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            mod_dotted = v.imports.get(prefix)
+            if mod_dotted is None and i == 1 and prefix in v.from_names:
+                src_mod, orig = v.from_names[prefix]
+                if self._resolve_module(f"{src_mod}.{orig}") is not None:
+                    mod_dotted = f"{src_mod}.{orig}"
+            if mod_dotted is None:
+                continue
+            sub = ".".join(parts[i:-1])
+            src = self._resolve_module(
+                f"{mod_dotted}.{sub}" if sub else mod_dotted)
+            if src is not None:
+                return self._lookup_in_module(src, parts[-1])
+            break
+        method = parts[-1]
+        recv = ".".join(parts[:-1])
+        if recv in ("self", "cls") and cls is not None:
+            return self._method_on(v, cls, method)
+        if len(parts) == 2:
+            head = parts[0]
+            if head in v.classes or (head[:1].isupper()
+                                     and head in v.from_names):
+                return self._method_on(v, head, method)
+            if head in v.instances:
+                recv_cls = self._class_of_callable(
+                    v, cls, v.instances[head])
+                if recv_cls is not None:
+                    return self._method_on(v, recv_cls, method)
+        # `self.attr.method()`: receiver type from the class attr table
+        if len(parts) == 3 and parts[0] in ("self", "cls") \
+                and cls is not None:
+            ci = self._class_info(v, cls)
+            if ci is not None and parts[1] in ci.attr_types:
+                tcls = ci.attr_types[parts[1]].rpartition(".")[2]
+                got = self._method_on(v, tcls, method)
+                if got:
+                    return got
+        return []
+
+    def _resolve_call(self, v: _ModuleView, cls: Optional[str],
+                      node: ast.Call, env_types: Dict[str, str],
+                      env_fns: Dict[str, List[str]],
+                      own_prefix: str) -> List[str]:
+        # dict dispatch: DISPATCH[op](...) / DISPATCH.get(op, d)(...)
+        f = node.func
+        if isinstance(f, ast.Subscript) and isinstance(f.value, ast.Name) \
+                and f.value.id in v.dispatch:
+            out: List[str] = []
+            for t in v.dispatch[f.value.id]:
+                out.extend(self._resolve_name(v, cls, t, env_fns,
+                                              own_prefix))
+            return out
+        if isinstance(f, ast.Call):
+            inner = _dotted(f.func)
+            if inner and inner.rpartition(".")[2] == "get" \
+                    and isinstance(f.func, ast.Attribute) \
+                    and isinstance(f.func.value, ast.Name) \
+                    and f.func.value.id in v.dispatch:
+                out = []
+                for t in v.dispatch[f.func.value.id]:
+                    out.extend(self._resolve_name(v, cls, t, env_fns,
+                                                  own_prefix))
+                return out
+        name = _dotted(f)
+        if name is None:
+            return []
+        got = self._resolve_name(v, cls, name, env_fns, own_prefix)
+        if got:
+            return got
+        # typed local receiver: `x = ClassName(...); x.method()`
+        head, _, rest = name.partition(".")
+        if rest and "." not in rest and head in env_types:
+            got = self._method_on(v, env_types[head], rest)
+            if got:
+                return got
+        # typed local attr chain: `e.table.update()` via attr_types
+        parts = name.split(".")
+        if len(parts) == 3 and parts[0] in env_types:
+            ci = self._class_info(v, env_types[parts[0]])
+            if ci is not None and parts[1] in ci.attr_types:
+                owner = self.views.get(ci.mod_rel, v)
+                tcls = ci.attr_types[parts[1]].rpartition(".")[2]
+                got = self._method_on(owner, tcls, parts[2])
+                if got:
+                    return got
+        # unique-definition fallback for attribute calls
+        if rest:
+            method = name.rpartition(".")[2]
+            if method not in _COMMON_METHODS \
+                    and not (method.startswith("__")
+                             and method.endswith("__")):
+                defs = self._method_defs.get(method, ())
+                if len(defs) == 1:
+                    return [defs[0][1]]
+        return []
+
+    # ------------------------------------------------------ call handler
+
+    def _handle_call(self, v: _ModuleView, caller: str,
+                     cls: Optional[str], node: ast.Call,
+                     env_types, env_fns, submit_aliases,
+                     in_loop: bool):
+        own_prefix = caller.split("::", 1)[1]
+        if own_prefix == MODULE_BODY:
+            own_prefix = ""
+        callees = self._resolve_call(v, cls, node, env_types, env_fns,
+                                     own_prefix)
+        if callees:
+            self.call_sites[id(node)] = callees
+        for key in callees:
+            self.edges.append(CallEdge(caller, key, node.lineno,
+                                       id(node)))
+        # higher-order escape: a function value passed as an argument is
+        # assumed invoked by the receiver (CFA-0). `Request(lambda: ...)`
+        # gets an edge Request.__init__ -> lambda-callees, so a worker
+        # pool draining Request objects still reaches the closure's code
+        # through the constructor. With no resolved receiver the edge
+        # falls back to the caller (the callable doesn't vanish).
+        hosts = callees or [caller]
+        name = _dotted(node.func)
+        if name not in _PARTIAL_NAMES | _WRAP_NAMES | _THREAD_NAMES:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if not isinstance(arg, (ast.Lambda, ast.Name,
+                                        ast.Attribute, ast.Call)):
+                    continue
+                for key in self._resolve_target_expr(v, cls, arg,
+                                                     env_fns, own_prefix):
+                    for h in hosts:
+                        self.edges.append(
+                            CallEdge(h, key, node.lineno))
+                    for ck in callees:
+                        fnode = self.functions.get(ck)
+                        if fnode is not None and fnode.cls is not None \
+                                and fnode.qualname.endswith("__init__"):
+                            self._escaped_into.setdefault(
+                                fnode.cls, set()).add(key)
+        # `x.attr()` where x is typed but attr is not a method of the
+        # class: deferred — resolves against callables that escaped into
+        # the class's constructor (`self.fn = fn; ...; req.fn()`)
+        if not callees and name and "." in name:
+            head, _, rest = name.partition(".")
+            if rest and "." not in rest:
+                recv_cls = env_types.get(head)
+                if recv_cls is None and head == "self" and cls is not None:
+                    recv_cls = cls
+                if recv_cls is not None:
+                    self._pending_attr_calls.append(
+                        (caller, recv_cls, rest, node.lineno))
+        self._maybe_root(v, caller, cls, node, env_types, env_fns,
+                         submit_aliases, in_loop, callees, own_prefix)
+
+    def _maybe_root(self, v, caller, cls, node, env_types, env_fns,
+                    submit_aliases, in_loop, callees, own_prefix):
+        name = _dotted(node.func)
+        rel, line = v.mod.rel, node.lineno
+
+        def add_roots(expr, kind, multi):
+            for key in self._resolve_target_expr(v, cls, expr, env_fns,
+                                                 own_prefix):
+                self.thread_roots.append(
+                    ThreadRoot(key, rel, line, kind, multi))
+
+        # threading.Thread(target=X) and Thread subclasses
+        if name in _THREAD_NAMES:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    add_roots(kw.value, "thread", in_loop)
+        elif name is not None:
+            tail = name.rpartition(".")[2]
+            # instantiation of a threading.Thread subclass
+            ci = None
+            if tail[:1].isupper():
+                ci = self._class_info(v, tail)
+            if ci is not None and any(
+                    b in _THREAD_NAMES or b.rpartition(".")[2] == "Thread"
+                    for b in ci.bases) and "run" in ci.methods:
+                self.thread_roots.append(ThreadRoot(
+                    f"{ci.mod_rel}::{ci.methods['run']}", rel, line,
+                    "thread-subclass", in_loop))
+            # spawn wrappers (pool.spawn and friends)
+            for key in callees:
+                idx = self._spawn_wrappers.get(key)
+                if idx is not None and idx < len(node.args):
+                    add_roots(node.args[idx], "spawn-wrapper", in_loop)
+                else:
+                    fnode = self.functions.get(key)
+                    if idx is not None and fnode is not None:
+                        pname = fnode.node.args.args[idx].arg
+                        for kw in node.keywords:
+                            if kw.arg == pname:
+                                add_roots(kw.value, "spawn-wrapper",
+                                          in_loop)
+            # obs.wrap(X): X is about to cross a thread boundary
+            if name in _WRAP_NAMES and node.args:
+                resolved_wrap = any(
+                    self.functions.get(k) is not None
+                    and "obs" in self.functions[k].mod_rel
+                    for k in callees)
+                if name != "wrap" or resolved_wrap:
+                    add_roots(node.args[0], "obs-wrap", True)
+        # executor submit / pool map
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "submit" and node.args:
+                add_roots(node.args[0], "submit", True)
+            elif node.func.attr == "map" and len(node.args) >= 2:
+                add_roots(node.args[0], "pool-map", True)
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in submit_aliases and node.args:
+            add_roots(node.args[0], "submit", True)
+
+    def _resolve_target_expr(self, v, cls, expr, env_fns,
+                             own_prefix) -> List[str]:
+        """Resolve a thread-target expression to function keys,
+        unwrapping obs.wrap(f) / functools.partial(f, ...) and lambdas
+        (a lambda roots every function it calls)."""
+        if isinstance(expr, ast.Call):
+            cn = _dotted(expr.func)
+            if cn in _WRAP_NAMES | _PARTIAL_NAMES and expr.args:
+                return self._resolve_target_expr(v, cls, expr.args[0],
+                                                 env_fns, own_prefix)
+            return []
+        if isinstance(expr, ast.Lambda):
+            out: List[str] = []
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    n = _dotted(sub.func)
+                    if n:
+                        out.extend(self._resolve_name(
+                            v, cls, n, env_fns, own_prefix))
+            return out
+        name = _dotted(expr)
+        if name is None:
+            return []
+        return self._resolve_name(v, cls, name, env_fns, own_prefix)
+
+    # ------------------------------------------------------ dataflow API
+
+    def reachable_from(self, keys: Iterable[str]) -> Set[str]:
+        """Transitive closure over call edges."""
+        seen: Set[str] = set()
+        queue = [k for k in keys]
+        while queue:
+            k = queue.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            for e in self.edges_out.get(k, ()):
+                if e.callee not in seen:
+                    queue.append(e.callee)
+        return seen
+
+    def root_reach(self) -> Dict[str, Set[str]]:
+        """function key -> set of thread-root site ids that reach it.
+        Multi roots contribute two pseudo-sites (they alone imply
+        concurrent execution of everything they reach)."""
+        out: Dict[str, Set[str]] = {}
+        for r in self.thread_roots:
+            sites = [r.site] if not r.multi else [r.site, r.site + "*"]
+            for k in self.reachable_from([r.target]):
+                out.setdefault(k, set()).update(sites)
+        return out
+
+    def propagate_meet(
+        self, entries: Dict[str, FrozenSet[str]],
+        edge_gain: Callable[[CallEdge], FrozenSet[str]],
+        domain: Optional[Set[str]] = None,
+    ) -> Dict[str, FrozenSet[str]]:
+        """Meet-over-paths dataflow: fact(F) = ∩ over incoming edges of
+        (fact(caller) ∪ edge_gain(edge)), seeded by `entries` (thread
+        entry points start with their given fact — usually ∅).
+
+        Used for interprocedural held-locks: a lock protects a mutation
+        only if it is held on EVERY path from a thread entry, so the
+        merge is intersection and unanalyzed callers contribute top
+        (ignored). Monotone on a finite lattice -> terminates."""
+        fact: Dict[str, FrozenSet[str]] = dict(entries)
+        keys = domain if domain is not None else set(self.functions)
+        changed = True
+        while changed:
+            changed = False
+            for k in keys:
+                if k in entries:
+                    continue
+                met: Optional[FrozenSet[str]] = None
+                for e in self.edges_in.get(k, ()):
+                    src = fact.get(e.caller)
+                    if src is None:
+                        continue  # caller not on any analyzed path: top
+                    val = src | edge_gain(e)
+                    met = val if met is None else (met & val)
+                if met is not None and fact.get(k) != met:
+                    fact[k] = met
+                    changed = True
+        return fact
+
+
+# cached like the lock model: keyed on module-list identity, holding the
+# module objects so addresses can't be reused by a later scan
+_GRAPH_CACHE: List[Tuple[List[ModuleInfo], ProjectGraph]] = []
+
+
+def project_graph(mods: List[ModuleInfo]) -> ProjectGraph:
+    if _GRAPH_CACHE:
+        cached_mods, cached = _GRAPH_CACHE[0]
+        if len(cached_mods) == len(mods) \
+                and all(a is b for a, b in zip(cached_mods, mods)):
+            return cached
+    g = ProjectGraph([m for m in mods if m.tree is not None])
+    _GRAPH_CACHE[:] = [(list(mods), g)]
+    return g
+
+
+def _body_of(fn: ast.AST) -> list:
+    return getattr(fn, "body", [])

@@ -10,6 +10,8 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     metrics_catalog,
     obs,
     purity,
+    races,
     retry_discipline,
     threads,
+    transfer_budget,
 )

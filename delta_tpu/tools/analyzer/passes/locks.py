@@ -24,9 +24,14 @@ Lock identity is ``<module-stem>.<Class>.<attr>`` for instance locks
 ``field(default_factory=threading.Lock)``, and the
 ``self.__dict__.setdefault("x", threading.Lock())`` idiom),
 ``<module-stem>.<NAME>`` for module globals, and a function-scoped name
-for locals bound to a fresh lock. Call resolution is same-module only
-(``helper()`` / ``self.method()`` / ``Class.method()``); cross-module
-cycles still surface because the acquisition graph itself is global.
+for locals bound to a fresh lock. Call resolution rides the shared
+:class:`~delta_tpu.tools.analyzer.core.ProjectGraph` (cross-module
+def/attr/method resolution), with the same-module heuristic as
+fallback, so acquisitions and I/O propagate through project-wide call
+chains. The analysis additionally records every instance-attr /
+module-global mutation with its lexically-held locks
+(:class:`Mutation`) — the fact base for the shared-state race detector
+in ``passes/races.py``.
 """
 
 from __future__ import annotations
@@ -36,7 +41,13 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from delta_tpu.tools.analyzer.core import Finding, ModuleInfo, Rule, register
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    project_graph,
+    register,
+)
 from delta_tpu.tools.analyzer.passes._astutil import (
     build_function_table,
     call_name,
@@ -119,14 +130,32 @@ class _Edge:
     via: str  # "" for lexical nesting, else the callee qualname
 
 
+@dataclass(frozen=True)
+class Mutation:
+    """One state mutation observed in a function body, with the locks
+    lexically held around it. Consumed by the shared-state race
+    detector (passes/races.py)."""
+
+    kind: str                 # rmw | item-store | mutate-call | del | store
+    owner_cls: Optional[str]  # class of a `self.attr` target, None = global
+    attr: str                 # attribute / global name mutated
+    line: int
+    col: int
+    held: Tuple[str, ...]     # lock ids lexically held at the site
+    detail: str = ""
+
+
 @dataclass
 class _FuncFacts:
     mod_rel: str
+    qualname: str = ""
+    cls: Optional[str] = None
     direct_acquires: Set[str] = field(default_factory=set)
     held_calls: List[Tuple[Tuple[str, ...], str, int]] = field(
-        default_factory=list)  # (held locks, callee qualname, line)
-    callees: Set[str] = field(default_factory=set)
+        default_factory=list)  # (held locks, callee KEY, line)
+    callees: Set[str] = field(default_factory=set)  # full function keys
     direct_io: Set[str] = field(default_factory=set)  # io call names
+    mutations: List[Mutation] = field(default_factory=list)
 
 
 def _collect_definitions(mod: ModuleInfo) -> _ModuleLocks:
@@ -175,16 +204,32 @@ def _collect_definitions(mod: ModuleInfo) -> _ModuleLocks:
 
 class _LockAnalysis:
     """Shared lock model; built once per module set and cached so the
-    three thin rules don't re-walk the project."""
+    thin rules (lock-order / lock-io / global-mutation, plus the
+    shared-state race detector in races.py) don't re-walk the project.
+
+    Call resolution rides the shared :class:`ProjectGraph` — the graph
+    records resolved callees per ``ast.Call`` node (same AST objects,
+    joined by ``id()``), so held-lock propagation crosses modules. The
+    same-module ``resolve_local_call`` remains as the fallback for call
+    shapes the graph doesn't type."""
 
     def __init__(self, mods: List[ModuleInfo]):
         self.findings: List[Finding] = []
         self.edges: List[_Edge] = []
         self.facts: Dict[str, _FuncFacts] = {}
-        per_mod = {m.rel: _collect_definitions(m) for m in mods}
+        self.graph = project_graph(mods)
+        # id(ast.Call) -> lock ids lexically held around the call; the
+        # race detector's propagate_meet edge gain
+        self.held_at_call: Dict[int, Tuple[str, ...]] = {}
+        self.per_mod = {m.rel: _collect_definitions(m) for m in mods}
+        # lock id -> (module stem, owning class or None, attribute)
+        self.lock_owners: Dict[str, Tuple[str, Optional[str], str]] = {}
+        for ml in self.per_mod.values():
+            for (cls, attr), lid in ml.by_attr.items():
+                self.lock_owners[lid] = (ml.stem, cls, attr)
         for mod in mods:
-            self._scan_module(per_mod[mod.rel])
-        self._propagate(per_mod)
+            self._scan_module(self.per_mod[mod.rel])
+        self._propagate(self.per_mod)
         self.findings.extend(self._cycle_findings())
 
     # -- per-module scan ---------------------------------------------------
@@ -193,7 +238,7 @@ class _LockAnalysis:
         mod = ml.mod
         table = build_function_table(mod.tree)
         for qualname, cls, fn in iter_functions(mod.tree):
-            ff = _FuncFacts(mod.rel)
+            ff = _FuncFacts(mod.rel, qualname=qualname, cls=cls)
             self.facts[f"{mod.rel}::{qualname}"] = ff
             local_locks: Dict[str, Tuple[str, bool]] = {}
             declared_global: Set[str] = set()
@@ -279,22 +324,113 @@ class _LockAnalysis:
                     self._scan_expr(expr, held, ml, cls, table, ff)
                 if not held and ml.locks:
                     self._check_global_mutation(st, ml, declared_global)
+                self._collect_mutations(st, held, ml, cls,
+                                        declared_global, ff)
                 for child_body in _sub_bodies(st):
                     self._walk(child_body, held, ml, cls, table,
                                local_locks, declared_global, ff)
+
+    def _collect_mutations(self, st, held, ml: _ModuleLocks, cls,
+                           declared_global, ff: _FuncFacts):
+        """Record every instance-attribute / module-global mutation with
+        the lock context, for the race detector. Taxonomy:
+
+        - ``rmw``: aug-assign, or a plain assign whose value reads the
+          same target (lost-update window even under the GIL);
+        - ``item-store``: subscript store on a container attr/global;
+        - ``mutate-call``: a mutator method on an attr/global container;
+        - ``del``: deletion of an attr / global / item;
+        - ``store``: plain attribute rebinding (GIL-atomic publication —
+          collected but exempt in the race rule)."""
+        held_t = tuple(held)
+
+        def owner_of(t) -> Optional[Tuple[Optional[str], str]]:
+            # self.attr -> (cls, attr); bare global name -> (None, name)
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" and cls is not None:
+                return (cls, t.attr)
+            if isinstance(t, ast.Name) and (
+                    t.id in declared_global
+                    or t.id in ml.mutable_globals):
+                return (None, t.id)
+            return None
+
+        def add(kind, owner, line, col, detail=""):
+            ff.mutations.append(Mutation(kind, owner[0], owner[1],
+                                         line, col, held_t, detail))
+
+        if isinstance(st, ast.AugAssign):
+            o = owner_of(st.target)
+            if o is not None:
+                add("rmw", o, st.lineno, st.col_offset)
+            elif isinstance(st.target, ast.Subscript):
+                o = owner_of(st.target.value)
+                if o is not None:
+                    add("rmw", o, st.lineno, st.col_offset)
+        elif isinstance(st, (ast.Assign, ast.AnnAssign)):
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                o = owner_of(t)
+                if o is not None:
+                    kind = "store"
+                    if st.value is not None and o[0] is not None:
+                        for sub in ast.walk(st.value):
+                            if isinstance(sub, ast.Attribute) \
+                                    and sub.attr == o[1] \
+                                    and isinstance(sub.value, ast.Name) \
+                                    and sub.value.id == "self":
+                                kind = "rmw"  # x = f(x): read-modify-write
+                                break
+                    add(kind, o, st.lineno, st.col_offset)
+                elif isinstance(t, ast.Subscript):
+                    o = owner_of(t.value)
+                    if o is not None:
+                        add("item-store", o, st.lineno, st.col_offset)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                o = owner_of(t)
+                if o is None and isinstance(t, ast.Subscript):
+                    o = owner_of(t.value)
+                if o is not None:
+                    add("del", o, st.lineno, st.col_offset)
+        elif isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            name = call_name(st.value)
+            if name and "." in name:
+                recv, _, method = name.rpartition(".")
+                if method in _MUTATORS:
+                    o = None
+                    parts = recv.split(".")
+                    if len(parts) == 2 and parts[0] == "self" \
+                            and cls is not None:
+                        o = (cls, parts[1])
+                    elif len(parts) == 1 and (
+                            parts[0] in ml.mutable_globals):
+                        o = (None, parts[0])
+                    if o is not None:
+                        add("mutate-call", o, st.lineno,
+                            st.col_offset, detail=method)
 
     def _scan_expr(self, expr, held, ml, cls, table, ff: _FuncFacts):
         for node in ast.walk(expr):
             if not isinstance(node, ast.Call):
                 continue
+            if held:
+                self.held_at_call[id(node)] = tuple(held)
             name = call_name(node)
             if name is None:
                 continue
-            callee = resolve_local_call(name, cls, table)
-            if callee is not None:
-                ff.callees.add(callee)
+            callee_keys = self.graph.call_sites.get(id(node))
+            if not callee_keys:
+                local = resolve_local_call(name, cls, table)
+                callee_keys = ([f"{ml.mod.rel}::{local}"]
+                               if local is not None else [])
+            if callee_keys:
+                ff.callees.update(callee_keys)
                 if held:
-                    ff.held_calls.append((held, callee, node.lineno))
+                    for ck in callee_keys:
+                        ff.held_calls.append((held, ck, node.lineno))
                 continue
             if _is_io(name):
                 ff.direct_io.add(name)
@@ -353,8 +489,7 @@ class _LockAnalysis:
         while changed:
             changed = False
             for k, f in self.facts.items():
-                for callee in f.callees:
-                    ck = f"{f.mod_rel}::{callee}"
+                for ck in f.callees:
                     if ck in trans and not trans[ck] <= trans[k]:
                         trans[k] |= trans[ck]
                         changed = True
@@ -365,8 +500,8 @@ class _LockAnalysis:
         for ml in per_mod.values():
             reentrant.update(ml.locks)
         for k, f in self.facts.items():
-            for held, callee, line in f.held_calls:
-                ck = f"{f.mod_rel}::{callee}"
+            for held, ck, line in f.held_calls:
+                callee = ck.split("::", 1)[1]
                 io_names = sorted(trans_io.get(ck, ()))
                 if io_names:
                     self.findings.append(Finding(

@@ -1,0 +1,178 @@
+"""Shared-state race detector: ``shared-state-race``.
+
+Flags functions that (a) are reachable from **two or more thread-root
+sites** — or from one *multi* root: a worker pool, executor
+``submit``/``map``, ``obs.wrap`` hand-off, or socketserver handler,
+any of which alone implies concurrent execution — and (b) mutate
+instance attributes or module globals **without the owning lock held on
+every path** from a thread entry point.
+
+Lock context is interprocedural: the lexically-held locks at each call
+site (from the lock model in ``passes/locks.py``) become edge gains in
+a meet-over-paths dataflow over the shared
+:class:`~delta_tpu.tools.analyzer.core.ProjectGraph` — a lock counts
+only if it is held on EVERY path from a thread root to the mutation
+(intersection merge), so a single unlocked path surfaces.
+
+What counts as a mutation (the taxonomy is collected by the lock
+model): read-modify-write (``self.n += 1``, ``self.x = f(self.x)``),
+subscript stores (``self.cache[k] = v``), container mutator calls
+(``self.xs.append(...)``), and ``del``. Plain attribute rebinding
+(``self.snapshot = snap``) is exempt — a single store is atomic
+publication under the GIL and is the idiomatic lock-free hand-off.
+
+Exemptions (each one is a claim the mutation is safe by construction):
+
+- mutations inside ``__init__`` / ``__new__`` / ``__post_init__`` — the
+  object is not yet shared;
+- attributes whose inferred type is itself thread-safe
+  (``queue.Queue``, ``threading.Event``, ``ContextVar``, locks, the
+  obs metric instruments — their methods take their own lock);
+- attributes that ARE locks (``self._lock``-style);
+- the owning lock held: any held lock whose owner is the mutating
+  class (or a base class), or a module-level lock of the defining
+  module for globals.
+
+Everything else is a finding; audited false positives carry
+``# delta-lint: disable=shared-state-race`` with a rationale.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Set
+
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    module_stem,
+    project_graph,
+    register,
+)
+from delta_tpu.tools.analyzer.passes.locks import _analysis
+
+# attribute types whose mutators are internally synchronized (or
+# per-context by construction); bare class names as the graph infers
+# them from constructor calls and annotations
+_THREADSAFE_TYPES = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Lock", "RLock", "Condition", "ContextVar", "local",
+    # obs metric instruments: inc()/dec()/observe() lock internally
+    "Counter", "Gauge", "Histogram",
+})
+
+# methods in which mutations are pre-publication by construction
+_CONSTRUCTION_METHODS = frozenset({
+    "__init__", "__new__", "__post_init__", "__init_subclass__",
+})
+
+
+@register
+class SharedStateRaceRule(Rule):
+    id = "shared-state-race"
+    description = (
+        "instance attr or module global mutated by code reachable from "
+        "multiple thread roots without the owning lock held on every "
+        "path (interprocedural held-locks meet-over-paths)")
+
+    def check_project(self, mods: List[ModuleInfo]) -> List[Finding]:
+        graph = project_graph(mods)
+        la = _analysis(mods)
+        root_sites = graph.root_reach()
+        shared = {k for k, s in root_sites.items() if len(s) >= 2}
+        if not shared:
+            return []
+
+        # meet-over-paths held locks: a thread entry starts with
+        # nothing held; each edge adds the locks lexically held around
+        # that call site in the caller; merging is intersection
+        entries: Dict[str, FrozenSet[str]] = {
+            r.target: frozenset() for r in graph.thread_roots}
+        domain = graph.reachable_from(entries)
+        held_in = graph.propagate_meet(
+            entries,
+            edge_gain=lambda e: frozenset(
+                la.held_at_call.get(e.node_id, ())),
+            domain=domain,
+        )
+
+        out: List[Finding] = []
+        for key in sorted(shared):
+            ff = la.facts.get(key)
+            if ff is None or not ff.mutations:
+                continue
+            method = ff.qualname.rpartition(".")[2]
+            if method in _CONSTRUCTION_METHODS:
+                continue
+            stem = module_stem(ff.mod_rel)
+            entry_held = held_in.get(key, frozenset())
+            n_roots = len(root_sites[key])
+            for mut in ff.mutations:
+                if mut.kind == "store":
+                    continue  # GIL-atomic publication
+                if self._attr_exempt(graph, la, ff, mut):
+                    continue
+                held = entry_held | set(mut.held)
+                if self._owned_lock_held(graph, la, ff, mut, stem, held):
+                    continue
+                owner = (f"{mut.owner_cls}.{mut.attr}"
+                         if mut.owner_cls else f"global {mut.attr!r}")
+                via = f".{mut.detail}()" if mut.detail else ""
+                held_note = (f"held here: {', '.join(sorted(held))}"
+                             if held else "no lock held")
+                out.append(Finding(
+                    self.id, ff.mod_rel, mut.line, mut.col,
+                    f"{mut.kind} of {owner}{via} in {ff.qualname}(), "
+                    f"reachable from {n_roots} thread-root sites, "
+                    f"without the owning lock on every path "
+                    f"({held_note})"))
+        return out
+
+    @staticmethod
+    def _attr_exempt(graph, la, ff, mut) -> bool:
+        """Thread-safe attr types, and attrs that are locks."""
+        if mut.owner_cls is None:
+            return False
+        ml = la.per_mod.get(ff.mod_rel)
+        if ml is not None and (mut.owner_cls, mut.attr) in ml.by_attr:
+            return True  # the attr IS a lock
+        v = graph.views.get(ff.mod_rel)
+        if v is None:
+            return False
+        ci = graph._class_info(v, mut.owner_cls)
+        if ci is None:
+            return False
+        tname = ci.attr_types.get(mut.attr, "")
+        return tname.rpartition(".")[2] in _THREADSAFE_TYPES
+
+    @staticmethod
+    def _owned_lock_held(graph, la, ff, mut, stem: str,
+                         held: Set[str]) -> bool:
+        if not held:
+            return False
+        if mut.owner_cls is not None:
+            # the class and its same-project bases all count as owners
+            names = {mut.owner_cls}
+            v = graph.views.get(ff.mod_rel)
+            queue = [mut.owner_cls]
+            while queue and v is not None:
+                ci = graph._class_info(v, queue.pop())
+                if ci is None:
+                    continue
+                for b in ci.bases:
+                    b = b.rpartition(".")[2]
+                    if b not in names:
+                        names.add(b)
+                        queue.append(b)
+            for lid in held:
+                o = la.lock_owners.get(lid)
+                if o is not None and o[1] in names:
+                    return True
+        # a module-level lock of the defining module also counts
+        # (module-singleton classes guarded by a global lock)
+        for lid in held:
+            o = la.lock_owners.get(lid)
+            if o is not None and o[0] == stem and o[1] is None:
+                return True
+        return False

@@ -1,0 +1,330 @@
+"""Device-transfer budget lint: ``transfer-budget`` /
+``transfer-unbudgeted``.
+
+The replay hot paths live and die by what crosses the host->device
+link (PAPER.md's thesis; DEVICE_MERIT's link model): the r05 0.5x
+single-chip kernel came from shipping one extra per-row lane plus a
+widened payload dtype — a change that is *statically visible* in the
+lane constructors. ``resources/transfer_budget.json`` commits each
+budgeted path's lanes and per-unit byte cost; this pass re-derives the
+cost from the AST and fails lint on any drift, so the diff review — not
+a bench run — catches the regression.
+
+Lane cost inference (per lane-named local in the site function):
+
+- ``np.packbits(...)`` anywhere in the value -> a packed bitplane,
+  0.125 B/unit (a later ``.view(np.uint32)`` reinterprets, it doesn't
+  widen);
+- otherwise the innermost dtype-bearing constructor wins:
+  ``np.full(shape, fill, np.int32)``, ``np.zeros/ones/empty(shape,
+  dt)``, ``np.asarray(x, dt)``, ``np.arange(..., dtype=dt)``,
+  ``x.astype(dt)``, ``np.uint32(x)``.
+
+``transfer-budget`` findings: a budgeted site or lane that no longer
+exists (stale manifest), a lane whose kind/dtype drifted (with the
+byte diff), a per-unit sum over budget, and — for entries with
+``device_put_exhaustive`` — a ``device_put`` of a non-lane local
+inside the site (the "extra lane" regression).
+
+``transfer-unbudgeted``: inside the manifest's ``modules``, every
+``jax.device_put`` call must sit in a budgeted site or a function
+listed in ``audited_transfer_sites`` — new transfer code in the
+disciplined modules must either get a budget entry or an audited
+listing. The manifest path defaults to the packaged resource and can
+be overridden with ``DELTA_LINT_TRANSFER_BUDGET`` (fixture tests).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    project_graph,
+    register,
+)
+from delta_tpu.tools.analyzer.passes._astutil import call_name, dotted
+
+_DTYPE_BYTES = {
+    "bool": 1, "bool_": 1, "int8": 1, "uint8": 1,
+    "int16": 2, "uint16": 2, "float16": 2, "bfloat16": 2,
+    "int32": 4, "uint32": 4, "float32": 4,
+    "int64": 8, "uint64": 8, "float64": 8,
+}
+_BITPLANE_BYTES = 0.125  # 1 bit/unit, packed
+
+# constructors whose dtype argument sits at this positional index when
+# not passed as dtype=...
+_DTYPE_ARG_POS = {
+    "full": 2, "zeros": 1, "ones": 1, "empty": 1, "asarray": 1,
+    "array": 1, "astype": 0, "view": 0,
+}
+
+
+def _manifest_path() -> Optional[str]:
+    env = os.environ.get("DELTA_LINT_TRANSFER_BUDGET")
+    if env:
+        return env
+    try:
+        import delta_tpu
+    except ImportError:
+        return None
+    p = os.path.join(os.path.dirname(delta_tpu.__file__), "resources",
+                     "transfer_budget.json")
+    return p if os.path.exists(p) else None
+
+
+def _load_manifest() -> Optional[dict]:
+    p = _manifest_path()
+    if p is None:
+        return None
+    try:
+        with open(p, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _dtype_name(expr: ast.AST) -> Optional[str]:
+    """``np.int32`` / ``jnp.uint32`` / ``"int32"`` -> "int32"."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value if expr.value in _DTYPE_BYTES else None
+    name = dotted(expr)
+    if name is None:
+        return None
+    tail = name.rpartition(".")[2]
+    return tail if tail in _DTYPE_BYTES else None
+
+
+def _infer_lane(value: ast.AST) -> Optional[Tuple[str, float, str]]:
+    """Infer (kind, bytes_per_unit, dtype_name) for a lane value
+    expression, or None when no dtype-bearing constructor is found."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            n = call_name(node)
+            if n and n.rpartition(".")[2] == "packbits":
+                return ("bitplane", _BITPLANE_BYTES, "1-bit")
+    best: Optional[Tuple[str, float, str]] = None
+    for node in ast.walk(value):
+        if not isinstance(node, ast.Call):
+            continue
+        n = call_name(node)
+        if n is None:
+            continue
+        tail = n.rpartition(".")[2]
+        dt: Optional[str] = None
+        if tail in _DTYPE_BYTES:
+            dt = tail                      # np.uint32(x) cast form
+        elif tail in _DTYPE_ARG_POS:
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = _dtype_name(kw.value)
+            if dt is None:
+                pos = _DTYPE_ARG_POS[tail]
+                if len(node.args) > pos:
+                    dt = _dtype_name(node.args[pos])
+        elif tail == "arange":
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = _dtype_name(kw.value)
+        if dt is not None:
+            cand = ("dtype", float(_DTYPE_BYTES[dt]), dt)
+            # prefer the innermost constructor: later astype/view on the
+            # same value reinterprets the same buffer, the first hit in
+            # a preorder walk is the outermost -- keep the LAST hit
+            best = cand
+    return best
+
+
+def _walk_own(fn: ast.AST):
+    """Preorder walk of `fn`'s own body, skipping nested def/class
+    subtrees (they are their own graph nodes and are checked
+    separately)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lane_assignments(fn: ast.AST) -> Dict[str, ast.AST]:
+    """lane-name -> value expr of the last whole-name assignment."""
+    out: Dict[str, ast.AST] = {}
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            out[node.target.id] = node.value
+    return out
+
+
+def _device_put_calls(fn: ast.AST) -> List[ast.Call]:
+    out = []
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Call):
+            n = call_name(node)
+            if n and n.rpartition(".")[2] == "device_put":
+                out.append(node)
+    return out
+
+
+@register
+class TransferBudgetRule(Rule):
+    id = "transfer-budget"
+    help_anchor = "transfer-budget"
+    description = (
+        "statically-derived per-unit H2D bytes of a budgeted transfer "
+        "path drifted from resources/transfer_budget.json (widened "
+        "dtype, un-packed bitplane, extra device_put lane, or stale "
+        "manifest)")
+
+    def check_project(self, mods: List[ModuleInfo]) -> List[Finding]:
+        manifest = _load_manifest()
+        if not manifest:
+            return []
+        graph = project_graph(mods)
+        out: List[Finding] = []
+        for entry_name, entry in sorted(manifest.get("paths",
+                                                     {}).items()):
+            site = entry.get("site", "")
+            rel = site.split("::", 1)[0]
+            if rel not in graph.views:
+                continue  # site's module not in this scan's target set
+            fnode = graph.functions.get(site)
+            if fnode is None:
+                out.append(Finding(
+                    self.id, rel, 1, 0,
+                    f"transfer budget {entry_name!r}: site {site!r} not "
+                    f"found — function renamed/removed; update "
+                    f"resources/transfer_budget.json"))
+                continue
+            out.extend(self._check_site(entry_name, entry, fnode))
+        return out
+
+    def _check_site(self, entry_name: str, entry: dict,
+                    fnode) -> List[Finding]:
+        out: List[Finding] = []
+        fn = fnode.node
+        rel = fnode.mod_rel
+        lanes = entry.get("lanes", [])
+        assigns = _lane_assignments(fn)
+        lane_names = {ln.get("name") for ln in lanes}
+        per_unit_sum = 0.0
+        lane_drift = False
+        for ln in lanes:
+            name, kind = ln.get("name"), ln.get("kind", "dtype")
+            value = assigns.get(name)
+            if value is None:
+                lane_drift = True
+                out.append(Finding(
+                    self.id, rel, fn.lineno, fn.col_offset,
+                    f"transfer budget {entry_name!r}: lane {name!r} "
+                    f"not assigned in {fnode.qualname}() — renamed or "
+                    f"removed; update the manifest"))
+                continue
+            inferred = _infer_lane(value)
+            if inferred is None:
+                lane_drift = True
+                out.append(Finding(
+                    self.id, rel, value.lineno, value.col_offset,
+                    f"transfer budget {entry_name!r}: lane {name!r} has "
+                    f"no statically-visible dtype — construct it with "
+                    f"an explicit np dtype so the budget stays "
+                    f"checkable"))
+                continue
+            ikind, ibytes, idt = inferred
+            if kind == "bitplane":
+                if ikind != "bitplane":
+                    lane_drift = True
+                    out.append(Finding(
+                        self.id, rel, value.lineno, value.col_offset,
+                        f"transfer budget {entry_name!r}: lane {name!r} "
+                        f"is no longer a packed bitplane — now {idt} "
+                        f"({ibytes:g} B/unit vs manifest "
+                        f"{_BITPLANE_BYTES:g} B/unit)"))
+                    per_unit_sum += ibytes
+                else:
+                    per_unit_sum += _BITPLANE_BYTES
+                continue
+            want_dt = ln.get("dtype", "")
+            want_bytes = float(_DTYPE_BYTES.get(want_dt, 0))
+            if ikind == "bitplane":
+                ibytes = _BITPLANE_BYTES
+            if ibytes != want_bytes or (
+                    want_dt and idt != want_dt
+                    and ibytes != want_bytes):
+                lane_drift = True
+                out.append(Finding(
+                    self.id, rel, value.lineno, value.col_offset,
+                    f"transfer budget {entry_name!r}: lane {name!r} "
+                    f"widened — {idt} ({ibytes:g} B/unit) vs manifest "
+                    f"{want_dt} ({want_bytes:g} B/unit)"))
+            if kind != "scalar":
+                per_unit_sum += ibytes
+        budget = float(entry.get("budget_bytes_per_unit", 0))
+        if not lane_drift and budget and per_unit_sum != budget:
+            out.append(Finding(
+                self.id, rel, fn.lineno, fn.col_offset,
+                f"transfer budget {entry_name!r}: per-unit bytes "
+                f"derived from {fnode.qualname}() = {per_unit_sum:g} B "
+                f"!= manifest budget {budget:g} B per "
+                f"{entry.get('unit', 'unit')}"))
+        if entry.get("device_put_exhaustive"):
+            for call in _device_put_calls(fn):
+                arg = dotted(call.args[0]) if call.args else None
+                if arg is None or arg not in lane_names:
+                    out.append(Finding(
+                        self.id, rel, call.lineno, call.col_offset,
+                        f"transfer budget {entry_name!r}: device_put of "
+                        f"{arg or '<expr>'} is not a budgeted lane — an "
+                        f"extra per-unit lane changes the link cost; "
+                        f"add it to resources/transfer_budget.json or "
+                        f"drop the transfer"))
+        return out
+
+
+@register
+class TransferUnbudgetedRule(Rule):
+    id = "transfer-unbudgeted"
+    help_anchor = "transfer-budget"
+    description = (
+        "jax.device_put in a transfer-disciplined module (manifest "
+        "'modules') outside every budgeted site and audited transfer "
+        "site — new H2D paths need a budget entry or an audit listing")
+
+    def check_project(self, mods: List[ModuleInfo]) -> List[Finding]:
+        manifest = _load_manifest()
+        if not manifest:
+            return []
+        modules = set(manifest.get("modules", []))
+        if not modules:
+            return []
+        allowed = {e.get("site") for e in manifest.get("paths",
+                                                       {}).values()}
+        allowed |= set(manifest.get("audited_transfer_sites", []))
+        graph = project_graph(mods)
+        out: List[Finding] = []
+        for key, fnode in sorted(graph.functions.items()):
+            if fnode.mod_rel not in modules or key in allowed:
+                continue
+            for call in _device_put_calls(fnode.node):
+                out.append(Finding(
+                    self.id, fnode.mod_rel, call.lineno,
+                    call.col_offset,
+                    f"device_put in {fnode.qualname}() is outside every "
+                    f"budgeted transfer site — add a "
+                    f"transfer_budget.json entry (or an "
+                    f"audited_transfer_sites listing) so the H2D cost "
+                    f"of this path stays pinned"))
+        return out

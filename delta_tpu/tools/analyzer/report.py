@@ -3,15 +3,20 @@
 The JSON shape is a deliberately small subset of SARIF 2.1 (tool /
 results / ruleId / level / message / location) so CI systems that speak
 SARIF can ingest it with a trivial adapter, without this module taking
-on the full spec.
+on the full spec. Each driver rule carries a ``helpUri`` pointing into
+``docs/static_analysis.md`` (so CI annotations are clickable), findings
+silenced by an in-source pragma are emitted with a SARIF
+``suppressions`` record, and when a baseline check ran every result
+carries a ``baselineState`` (``new`` for failing findings,
+``unchanged`` for known debt matched against the committed baseline).
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict
+from typing import Dict, Optional
 
-from delta_tpu.tools.analyzer.core import Finding, Report
+from delta_tpu.tools.analyzer.core import Finding, Report, all_rules
 
 
 def render_text(report: Report, verbose: bool = False) -> str:
@@ -19,22 +24,29 @@ def render_text(report: Report, verbose: bool = False) -> str:
     for f in report.findings:
         lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
     if verbose:
+        for f in report.baselined:
+            lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: "
+                         f"[baselined] {f.message}")
         for f in report.suppressed:
             lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: "
                          f"[suppressed] {f.message}")
     counts = report.by_rule()
     summary = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+    baseline_note = (f", {len(report.baselined)} baselined"
+                     if report.baseline_checked else "")
     lines.append(
         f"delta-lint: {len(report.findings)} finding(s)"
         + (f" ({summary})" if summary else "")
+        + baseline_note
         + f", {len(report.suppressed)} suppressed, "
         f"{report.files_scanned} file(s), "
         f"rules: {', '.join(report.rules_run)}")
     return "\n".join(lines)
 
 
-def _result(f: Finding) -> Dict:
-    return {
+def _result(f: Finding, baseline_state: Optional[str] = None,
+            suppressed: bool = False) -> Dict:
+    out = {
         "ruleId": f.rule,
         "level": f.severity,
         "message": {"text": f.message},
@@ -45,19 +57,45 @@ def _result(f: Finding) -> Dict:
             },
         }],
     }
+    if baseline_state is not None:
+        out["baselineState"] = baseline_state
+    if suppressed:
+        # the pragma lives in the scanned source, next to the finding
+        out["suppressions"] = [{"kind": "inSource",
+                                "status": "accepted"}]
+    return out
+
+
+def _driver_rules(report: Report) -> list:
+    registry = all_rules()
+    out = []
+    for rid in report.rules_run:
+        cls = registry.get(rid)
+        entry: Dict = {"id": rid}
+        if cls is not None:
+            if cls.description:
+                entry["shortDescription"] = {"text": cls.description}
+            entry["helpUri"] = cls.help_uri()
+        out.append(entry)
+    return out
 
 
 def render_json(report: Report) -> str:
+    new_state = "new" if report.baseline_checked else None
     doc = {
         "version": "2.1.0-lite",
         "runs": [{
             "tool": {"driver": {"name": "delta-lint",
-                                "rules": [{"id": r}
-                                          for r in report.rules_run]}},
-            "results": [_result(f) for f in report.findings],
-            "suppressedResults": [_result(f) for f in report.suppressed],
+                                "rules": _driver_rules(report)}},
+            "results": [_result(f, baseline_state=new_state)
+                        for f in report.findings],
+            "baselinedResults": [_result(f, baseline_state="unchanged")
+                                 for f in report.baselined],
+            "suppressedResults": [_result(f, suppressed=True)
+                                  for f in report.suppressed],
             "summary": {
                 "findings": len(report.findings),
+                "baselined": len(report.baselined),
                 "suppressed": len(report.suppressed),
                 "filesScanned": report.files_scanned,
                 "byRule": report.by_rule(),

@@ -15,6 +15,11 @@ checker against the winning commits and retry at the next version, up to
 `settings.max_commit_retries`. Post-commit hooks (checkpointing every
 `delta.checkpointInterval` commits, checksum) run best-effort.
 """
+# delta-lint: file-disable=shared-state-race — audited:
+# A Transaction is thread-confined by contract — one thread builds
+# and commits it (same as the reference's OptimisticTransaction,
+# which is also unsynchronized); concurrency happens BETWEEN
+# transactions and is handled by the commit conflict checker.
 
 from __future__ import annotations
 

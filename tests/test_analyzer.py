@@ -788,6 +788,8 @@ def test_every_registered_rule_has_fixture_coverage():
         "threadpool-discipline",                             # threads
         "retry-discipline",                                  # retry
         "handler-discipline",                                # serve
+        "shared-state-race",                                 # races
+        "transfer-budget", "transfer-unbudgeted",            # budget
     }
     assert set(all_rules()) == expected
 
@@ -1165,6 +1167,656 @@ def special(target):
     report = analyze_sources({"delta_tpu/serve/x.py": src},
                              rules=["handler-discipline"])
     assert not report.findings and report.suppressed
+
+
+# ----------------------------------------------- shared-state-race
+
+
+RACE = ["shared-state-race"]
+
+
+def test_race_rmw_from_two_thread_roots_flagged():
+    src = """
+import threading
+
+class Stats:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+
+STATS = Stats()
+
+def worker_a():
+    STATS.bump()
+
+def worker_b():
+    STATS.bump()
+
+def main():
+    threading.Thread(target=worker_a).start()
+    threading.Thread(target=worker_b).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    fired = _rules_fired(report, "shared-state-race")
+    assert fired and "Stats.n" in fired[0].message
+    assert "thread-root sites" in fired[0].message
+
+
+def test_race_owning_lock_held_two_call_levels_silent():
+    """Held-locks context must propagate interprocedurally: the lock is
+    taken two call frames above the mutation."""
+    src = """
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._inc()
+
+    def _inc(self):
+        self.n += 1
+
+STATS = Stats()
+
+def worker_a():
+    STATS.bump()
+
+def worker_b():
+    STATS.bump()
+
+def main():
+    threading.Thread(target=worker_a).start()
+    threading.Thread(target=worker_b).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert not report.findings
+
+
+def test_race_one_unlocked_path_still_flagged():
+    """Meet-over-paths: a lock held on only ONE of two paths from a
+    thread root does not protect the mutation."""
+    src = """
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self._inc()
+
+    def bump_unsafe(self):
+        self._inc()
+
+    def _inc(self):
+        self.n += 1
+
+STATS = Stats()
+
+def worker_a():
+    STATS.bump()
+
+def worker_b():
+    STATS.bump_unsafe()
+
+def main():
+    threading.Thread(target=worker_a).start()
+    threading.Thread(target=worker_b).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert _rules_fired(report, "shared-state-race")
+
+
+def test_race_partial_thread_target_resolved():
+    src = """
+import functools
+import threading
+
+class Stats:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self, k):
+        self.n += k
+
+STATS = Stats()
+
+def hit(k=1):
+    STATS.bump(k)
+
+def main():
+    threading.Thread(target=functools.partial(hit, 2)).start()
+    threading.Thread(target=hit).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert _rules_fired(report, "shared-state-race")
+
+
+def test_race_dict_dispatch_reachability():
+    src = """
+import threading
+
+LOG = []
+
+def do_a():
+    LOG.append("a")
+
+def do_b():
+    LOG.append("b")
+
+HANDLERS = {"a": do_a, "b": do_b}
+
+def dispatch(key):
+    HANDLERS[key]()
+
+def serve():
+    while True:
+        threading.Thread(target=dispatch).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    fired = _rules_fired(report, "shared-state-race")
+    assert len(fired) == 2  # both dispatch values reached
+    assert all("LOG" in f.message for f in fired)
+
+
+def test_race_executor_submit_is_multi_root():
+    """A single submit-in-a-loop site implies concurrency on its own:
+    no second root needed."""
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+class Stats:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+
+STATS = Stats()
+
+def worker():
+    STATS.bump()
+
+def main(items):
+    ex = ThreadPoolExecutor(4)
+    for _ in items:
+        ex.submit(worker)
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert _rules_fired(report, "shared-state-race")
+
+
+def test_race_obs_wrap_is_thread_root():
+    src = """
+from delta_tpu import obs
+
+class Stats:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+
+STATS = Stats()
+
+def worker():
+    STATS.bump()
+
+def main():
+    return obs.wrap(worker)
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert _rules_fired(report, "shared-state-race")
+
+
+def test_race_plain_store_exempt():
+    """Attribute rebinding is atomic publication under the GIL — the
+    idiomatic lock-free hand-off stays silent."""
+    src = """
+import threading
+
+class Holder:
+    def __init__(self):
+        self.latest = None
+
+    def publish(self, x):
+        self.latest = x
+
+H = Holder()
+
+def worker_a():
+    H.publish(1)
+
+def worker_b():
+    H.publish(2)
+
+def main():
+    threading.Thread(target=worker_a).start()
+    threading.Thread(target=worker_b).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert not report.findings
+
+
+def test_race_threadsafe_attr_type_exempt():
+    src = """
+import queue
+import threading
+
+class Mailbox:
+    def __init__(self):
+        self.q = queue.Queue()
+
+    def deliver(self, x):
+        self.q.update(x)
+
+M = Mailbox()
+
+def worker_a():
+    M.deliver(1)
+
+def worker_b():
+    M.deliver(2)
+
+def main():
+    threading.Thread(target=worker_a).start()
+    threading.Thread(target=worker_b).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert not report.findings
+
+
+def test_race_init_mutations_exempt():
+    src = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self.store = {}
+        self.store["warm"] = True
+
+def worker_a():
+    Cache()
+
+def worker_b():
+    Cache()
+
+def main():
+    threading.Thread(target=worker_a).start()
+    threading.Thread(target=worker_b).start()
+"""
+    report = analyze_sources({"m.py": src}, rules=RACE)
+    assert not report.findings
+
+
+# ------------------------------------------------- transfer budget
+
+
+def _write_budget(tmp_path, monkeypatch, paths, modules=(), audited=()):
+    doc = {"modules": list(modules),
+           "audited_transfer_sites": list(audited), "paths": paths}
+    p = tmp_path / "budget.json"
+    p.write_text(json.dumps(doc))
+    monkeypatch.setenv("DELTA_LINT_TRANSFER_BUDGET", str(p))
+
+
+_SHIP_ENTRY = {
+    "site": "pkg/ship.py::ship",
+    "unit": "slot",
+    "budget_bytes_per_unit": 8,
+    "device_put_exhaustive": True,
+    "lanes": [
+        {"name": "idx", "kind": "dtype", "dtype": "int32"},
+        {"name": "val", "kind": "dtype", "dtype": "uint32"},
+    ],
+}
+
+_SHIP_SRC = """
+import numpy as np
+import jax
+
+def ship(n):
+    idx = np.full((4, n), 0, np.int32)
+    val = np.zeros((4, n), np.uint32)
+    jax.device_put(idx)
+    jax.device_put(val)
+    return idx, val
+"""
+
+
+def test_budget_in_budget_site_clean(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"ship": _SHIP_ENTRY})
+    report = analyze_sources({"pkg/ship.py": _SHIP_SRC},
+                             rules=["transfer-budget"])
+    assert not report.findings
+
+
+def test_budget_widened_dtype_flagged_with_diff(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"ship": _SHIP_ENTRY})
+    src = _SHIP_SRC.replace("np.int32", "np.int64")
+    report = analyze_sources({"pkg/ship.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "widened" in fired[0].message
+    assert "int64" in fired[0].message and "int32" in fired[0].message
+    assert "8 B/unit" in fired[0].message \
+        and "4 B/unit" in fired[0].message
+
+
+def test_budget_extra_device_put_lane_flagged(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"ship": _SHIP_ENTRY})
+    src = _SHIP_SRC.replace(
+        "    return idx, val",
+        "    extra = np.zeros(n, np.uint8)\n"
+        "    jax.device_put(extra)\n"
+        "    return idx, val")
+    report = analyze_sources({"pkg/ship.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "not a budgeted lane" in fired[0].message
+
+
+def test_budget_bitplane_lane_clean(tmp_path, monkeypatch):
+    entry = {
+        "site": "pkg/plane.py::route",
+        "budget_bytes_per_unit": 0.25,
+        "lanes": [{"name": "flag_words", "kind": "bitplane"},
+                  {"name": "add_words", "kind": "bitplane"}],
+    }
+    src = """
+import numpy as np
+
+def route(flags, adds):
+    flag_words = np.packbits(flags, axis=1,
+                             bitorder="little").view(np.uint32)
+    add_words = np.packbits(adds, axis=1,
+                            bitorder="little").view(np.uint32)
+    return flag_words, add_words
+"""
+    _write_budget(tmp_path, monkeypatch, {"plane": entry})
+    report = analyze_sources({"pkg/plane.py": src},
+                             rules=["transfer-budget"])
+    assert not report.findings
+
+
+def test_budget_unpacked_bitplane_flagged(tmp_path, monkeypatch):
+    entry = {
+        "site": "pkg/plane.py::route",
+        "budget_bytes_per_unit": 0.125,
+        "lanes": [{"name": "flag_words", "kind": "bitplane"}],
+    }
+    src = """
+import numpy as np
+
+def route(flags):
+    flag_words = np.asarray(flags, np.uint32)
+    return flag_words
+"""
+    _write_budget(tmp_path, monkeypatch, {"plane": entry})
+    report = analyze_sources({"pkg/plane.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "no longer a packed bitplane" in fired[0].message
+
+
+def test_budget_missing_lane_flagged(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"ship": _SHIP_ENTRY})
+    src = _SHIP_SRC.replace("idx", "indices")
+    report = analyze_sources({"pkg/ship.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "not assigned" in fired[0].message
+
+
+def test_budget_stale_site_flagged(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"ship": _SHIP_ENTRY})
+    src = _SHIP_SRC.replace("def ship", "def ship_v2")
+    report = analyze_sources({"pkg/ship.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "not found" in fired[0].message
+
+
+def test_budget_sum_mismatch_flagged(tmp_path, monkeypatch):
+    entry = dict(_SHIP_ENTRY, budget_bytes_per_unit=4)
+    _write_budget(tmp_path, monkeypatch, {"ship": entry})
+    report = analyze_sources({"pkg/ship.py": _SHIP_SRC},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "!= manifest budget" in fired[0].message
+
+
+def test_budget_scalar_lane_excluded_from_sum(tmp_path, monkeypatch):
+    entry = dict(_SHIP_ENTRY)
+    entry = json.loads(json.dumps(entry))  # deep copy
+    entry["lanes"].append(
+        {"name": "n_op", "kind": "scalar", "dtype": "int32"})
+    src = _SHIP_SRC.replace(
+        "    return idx, val",
+        "    n_op = np.asarray(n, np.int32)\n"
+        "    jax.device_put(n_op)\n"
+        "    return idx, val")
+    _write_budget(tmp_path, monkeypatch, {"ship": entry})
+    report = analyze_sources({"pkg/ship.py": src},
+                             rules=["transfer-budget"])
+    assert not report.findings
+
+
+def test_unbudgeted_device_put_flagged_and_audit_exempt(
+        tmp_path, monkeypatch):
+    src = """
+import jax
+import numpy as np
+
+def rogue(x):
+    return jax.device_put(np.asarray(x, np.int64))
+
+def audited(x):
+    return jax.device_put(x)
+"""
+    _write_budget(tmp_path, monkeypatch, {},
+                  modules=["pkg/xfer.py"],
+                  audited=["pkg/xfer.py::audited"])
+    report = analyze_sources({"pkg/xfer.py": src},
+                             rules=["transfer-unbudgeted"])
+    fired = _rules_fired(report, "transfer-unbudgeted")
+    assert len(fired) == 1 and "rogue" in fired[0].message
+
+
+def test_unbudgeted_ignores_modules_off_manifest(tmp_path, monkeypatch):
+    src = """
+import jax
+
+def free(x):
+    return jax.device_put(x)
+"""
+    _write_budget(tmp_path, monkeypatch, {}, modules=["pkg/xfer.py"])
+    report = analyze_sources({"pkg/elsewhere.py": src},
+                             rules=["transfer-unbudgeted"])
+    assert not report.findings
+
+
+# -------------------------------------------------- scan cache / changed
+
+
+def test_scan_cache_hit_reproduces_report(tmp_path):
+    from delta_tpu.tools.analyzer.cache import analyze_paths_cached
+
+    target = tmp_path / "pkg"
+    target.mkdir()
+    (target / "a.py").write_text("def f(x=[]):\n    return x\n")
+    cache = tmp_path / "cache.json"
+    r1, s1 = analyze_paths_cached([str(target)],
+                                  cache_path=str(cache))
+    assert s1["cache"] == "cold"
+    r2, s2 = analyze_paths_cached([str(target)],
+                                  cache_path=str(cache))
+    assert s2["cache"] == "hit" and s2["changed_files"] == 0
+    assert [f.message for f in r2.findings] \
+        == [f.message for f in r1.findings]
+    assert r2.rules_run == r1.rules_run
+    assert r2.files_scanned == r1.files_scanned
+
+
+def test_scan_cache_invalidated_by_content_change(tmp_path):
+    from delta_tpu.tools.analyzer.cache import analyze_paths_cached
+
+    target = tmp_path / "pkg"
+    target.mkdir()
+    mod = target / "a.py"
+    mod.write_text("def f():\n    return 1\n")
+    cache = tmp_path / "cache.json"
+    r1, _ = analyze_paths_cached([str(target)], cache_path=str(cache))
+    assert not r1.findings
+    mod.write_text("def f(x=[]):\n    return x\n")
+    r2, s2 = analyze_paths_cached([str(target)], cache_path=str(cache))
+    assert s2["cache"] == "stale" and s2["changed_files"] == 1
+    assert _rules_fired(r2, "mutable-default")
+
+
+def test_scan_cache_touch_without_change_still_hits(tmp_path):
+    from delta_tpu.tools.analyzer.cache import analyze_paths_cached
+
+    target = tmp_path / "pkg"
+    target.mkdir()
+    mod = target / "a.py"
+    mod.write_text("def f():\n    return 1\n")
+    cache = tmp_path / "cache.json"
+    analyze_paths_cached([str(target)], cache_path=str(cache))
+    os.utime(mod)  # mtime moves, bytes identical
+    _, stats = analyze_paths_cached([str(target)],
+                                    cache_path=str(cache))
+    assert stats["cache"] == "hit"
+
+
+def test_cli_changed_mode_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    cache = tmp_path / "cache.json"
+    argv = [str(bad), "--changed", "--cache-file", str(cache)]
+    assert lint_main(argv) == 1
+    capsys.readouterr()
+    assert lint_main(argv) == 1  # cache hit must not mask findings
+    bad.write_text("def f(x=None):\n    return x\n")
+    capsys.readouterr()
+    assert lint_main(argv) == 0
+
+
+# ------------------------------------------------------------ baseline
+
+
+def test_baseline_write_then_check_passes(tmp_path, capsys,
+                                          monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(bad), "--baseline", "write",
+                      "--baseline-file", str(bl)]) == 0
+    capsys.readouterr()
+    assert lint_main([str(bad), "--baseline", "check",
+                      "--baseline-file", str(bl)]) == 0
+    out = capsys.readouterr().out
+    assert "1 baselined" in out
+
+
+def test_baseline_new_finding_fails(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    bl = tmp_path / "bl.json"
+    lint_main([str(bad), "--baseline", "write",
+               "--baseline-file", str(bl)])
+    bad.write_text("def f(x=[]):\n    return x\n"
+                   "def g(y={}):\n    return y\n")
+    capsys.readouterr()
+    assert lint_main([str(bad), "--baseline", "check",
+                      "--baseline-file", str(bl)]) == 1
+    out = capsys.readouterr().out
+    assert "g()" in out and "1 finding(s)" in out
+
+
+def test_baseline_fingerprint_survives_line_shift(tmp_path, capsys,
+                                                  monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    bl = tmp_path / "bl.json"
+    lint_main([str(bad), "--baseline", "write",
+               "--baseline-file", str(bl)])
+    bad.write_text("# pushed down two lines\n# by these comments\n"
+                   "def f(x=[]):\n    return x\n")
+    capsys.readouterr()
+    assert lint_main([str(bad), "--baseline", "check",
+                      "--baseline-file", str(bl)]) == 0
+
+
+def test_baseline_check_without_file_is_usage_error(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    assert lint_main([str(bad), "--baseline", "check",
+                      "--baseline-file",
+                      str(tmp_path / "missing.json")]) == 2
+
+
+# -------------------------------------------------------- SARIF upgrade
+
+
+def test_sarif_rules_carry_help_uris():
+    report = analyze_sources({"m.py": "def f(x=[]):\n    return x\n"})
+    doc = json.loads(render_json(report))
+    rules = doc["runs"][0]["tool"]["driver"]["rules"]
+    by_id = {r["id"]: r for r in rules}
+    assert by_id["shared-state-race"]["helpUri"] \
+        == "docs/static_analysis.md#shared-state-race"
+    assert by_id["transfer-budget"]["helpUri"] \
+        == "docs/static_analysis.md#transfer-budget"
+    assert by_id["transfer-unbudgeted"]["helpUri"] \
+        == "docs/static_analysis.md#transfer-budget"
+    assert all("helpUri" in r for r in rules)
+
+
+def test_sarif_suppressed_results_carry_suppression_records():
+    src = ("def f(x=[]):  # delta-lint: disable=mutable-default ok\n"
+           "    return x\n")
+    report = analyze_sources({"m.py": src})
+    doc = json.loads(render_json(report))
+    sup = doc["runs"][0]["suppressedResults"]
+    assert sup and sup[0]["suppressions"][0]["kind"] == "inSource"
+
+
+def test_sarif_baseline_states(tmp_path):
+    from delta_tpu.tools.analyzer.baseline import (
+        apply_baseline,
+        load_baseline,
+        write_baseline,
+    )
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    report = analyze_paths([str(bad)])
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), report)
+    bad.write_text("def f(x=[]):\n    return x\n"
+                   "def g(y={}):\n    return y\n")
+    checked = apply_baseline(analyze_paths([str(bad)]),
+                             load_baseline(str(bl)))
+    doc = json.loads(render_json(checked))
+    run = doc["runs"][0]
+    assert [r["baselineState"] for r in run["results"]] == ["new"]
+    assert [r["baselineState"] for r in run["baselinedResults"]] \
+        == ["unchanged"]
 
 
 # ------------------------------------------------------ whole-repo gate

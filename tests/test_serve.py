@@ -640,3 +640,94 @@ def test_serve_chaos_qps_soak(seed):
             f"burn_rates={verdict.burn_rates}")
     finally:
         srv.shutdown(1.0)
+
+
+# --------------------------------------------- snapshot cache locking
+
+
+class _StubTable:
+    """Stands in for Table.for_path in cache-locking tests."""
+
+    def __init__(self, path, engine):
+        self.path = path
+        self.engine = engine
+
+
+def _cache(eng, monkeypatch, **cfg):
+    from delta_tpu.serve.cache import SnapshotCache
+
+    monkeypatch.setattr(
+        "delta_tpu.serve.cache.Table",
+        type("T", (), {"for_path": staticmethod(_StubTable)}))
+    return SnapshotCache(eng, ServeConfig.from_env(**cfg))
+
+
+def test_cache_builds_table_outside_lock(monkeypatch):
+    """Regression: Table.for_path touches the filesystem, so _entry must
+    build it without holding the cache lock (a slow open would stall
+    every other served table)."""
+    eng, _ = _chaos_engine(seed=11)
+    cache = _cache(eng, monkeypatch)
+    seen = []
+    real_for_path = _StubTable
+
+    def spying_for_path(path, engine):
+        seen.append(cache._lock.locked())
+        return real_for_path(path, engine)
+
+    monkeypatch.setattr(
+        "delta_tpu.serve.cache.Table",
+        type("T", (), {"for_path": staticmethod(spying_for_path)}))
+    e = cache._entry("memory://t-outside-lock")
+    assert seen == [False]
+    # second lookup is a pure cache hit: same entry, no rebuild
+    assert cache._entry("memory://t-outside-lock") is e
+    assert seen == [False]
+
+
+def test_cache_concurrent_build_single_winner(monkeypatch):
+    """Two threads racing _entry for the same never-seen path must agree
+    on one entry (put-if-absent: the losing Table is dropped)."""
+    eng, _ = _chaos_engine(seed=12)
+    cache = _cache(eng, monkeypatch)
+    barrier = threading.Barrier(2)
+    got = []
+
+    def build():
+        barrier.wait()
+        got.append(cache._entry("memory://t-race"))
+
+    threads = [threading.Thread(target=build) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(got) == 2 and got[0] is got[1]
+    assert len(cache._entries) == 1
+
+
+def test_cache_eviction_release_lock_discipline(monkeypatch):
+    """Regression for the evict-during-append race: the evicted entry's
+    resident state is released OUTSIDE the cache lock and UNDER the
+    entry's own lock, so an in-flight refresh (snapshot_for holds e.lock
+    across Table.update) finishes before residency is torn down."""
+    import delta_tpu.parallel.resident as resident_mod
+
+    eng, _ = _chaos_engine(seed=13)
+    cache = _cache(eng, monkeypatch, cache_tables=1)
+    first = cache._entry("memory://t-old")
+    first.snapshot = object()  # pretend a snapshot was served
+    released = []
+
+    def spying_release(snapshot):
+        released.append((snapshot, cache._lock.locked(),
+                         first.lock.locked()))
+
+    monkeypatch.setattr(resident_mod, "release_snapshot_resident",
+                        spying_release)
+    cache._entry("memory://t-new")  # capacity 1 -> evicts t-old
+    assert [r[0] for r in released] == [first.snapshot]
+    cache_locked, entry_locked = released[0][1], released[0][2]
+    assert not cache_locked   # device teardown never under cache lock
+    assert entry_locked       # ...but always under the entry's own lock
+    assert "memory://t-old" not in cache._entries

@@ -398,3 +398,54 @@ def test_digest_parity_matrix(kind):
             pk, dk, ver, order, add, size, mesh)
         assert _mask_digest(live, tomb) == want, f"S={s}: {kind}"
         assert num_live == int(live_h.sum())
+
+
+# ------------------------------------------- resident lock discipline
+
+
+def test_resident_append_and_release_serialize():
+    """Regression for the serve-cache evict-during-append race: both
+    append() and release() must run their bodies under the state's own
+    lock, so an eviction landing mid-append can't tear the device lane
+    down beneath the refresh that is still using it."""
+    import threading
+
+    from delta_tpu.parallel.resident import ResidentShardState
+
+    st = object.__new__(ResidentShardState)
+    st._lock = threading.Lock()
+    st.key_sh = None
+    st._hbm_bytes = 0
+    seen = []
+
+    def spying_locked(self, delta_fa, n_prev):
+        seen.append(("append", self._lock.locked()))
+        return None
+
+    orig = ResidentShardState._append_locked
+    ResidentShardState._append_locked = spying_locked
+    try:
+        assert st.append(None, 0) is None
+    finally:
+        ResidentShardState._append_locked = orig
+    assert seen == [("append", True)]
+    assert not st._lock.locked()  # released on the way out
+
+    # release() with no lane is a no-op but must still be serialized:
+    # it cannot run while an append holds the lock
+    st._lock.acquire()
+    blocked = threading.Event()
+    done = threading.Event()
+
+    def try_release():
+        blocked.set()
+        st.release()
+        done.set()
+
+    t = threading.Thread(target=try_release)
+    t.start()
+    blocked.wait(5)
+    assert not done.wait(0.1)  # release waits on the held lock
+    st._lock.release()
+    assert done.wait(5)
+    t.join()

@@ -173,6 +173,53 @@ def test_registry_counters_and_histograms():
     assert snap["histograms"]["test.histo"]["max"] == 4.0
 
 
+def test_histogram_concurrent_observe_keeps_invariant():
+    """Regression: Histogram.observe updates count/sum/min/max/buckets
+    under a per-instrument lock. Without it, interleaved observes break
+    the `sum(buckets) == count` invariant exposition relies on."""
+    h = obs.histogram("test.histo.hammer")
+    h.reset()
+    n_threads, per = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def hammer():
+        barrier.wait()
+        for i in range(per):
+            h.observe(0.001 * (i % 7))
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n_threads * per
+    assert sum(h.buckets) == h.count
+    assert h.min == 0.0 and h.max == 0.006
+
+
+def test_gauge_concurrent_inc_dec_balances():
+    """Regression: paired Gauge.inc/dec from many threads must return
+    the gauge to zero — an interleaved read-modify-write would leave
+    the reported in-flight depth permanently drifted."""
+    g = obs.gauge("test.gauge.hammer")
+    g.reset()
+    n_threads, per = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(per):
+            g.inc()
+            g.dec()
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert g.read() == 0
+
+
 # -------------------------------------------------------------- exporters
 
 
