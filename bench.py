@@ -844,6 +844,136 @@ def checkpoint_read_metric(workdir: str) -> None:
     }))
 
 
+def checkpoint_write_metric(workdir: str) -> None:
+    """Checkpoint WRITE throughput + incremental reuse: over a
+    dedicated synth log, time a fresh multipart checkpoint through the
+    serialize→upload funnel (the profitability gate stands down to the
+    serial pool path on this local workdir by design — recorded via
+    `pipelined`), assert the written checkpoint reloads to the same
+    state as the live log, then append two add-only commits and
+    measure how many file parts the second, incremental checkpoint
+    reuses from the first instead of re-serializing."""
+    import hashlib
+
+    from delta_tpu import obs
+    from delta_tpu.config import settings
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.log.checkpointer import write_checkpoint
+    from delta_tpu.log.last_checkpoint import read_last_checkpoint
+    from delta_tpu.replay.columnar import clear_parse_cache
+    from delta_tpu.table import Table
+    from delta_tpu.write import ckpt_pipeline
+
+    commits = int(os.environ.get("BENCH_CKPT_WRITE_COMMITS", 500))
+    path = os.path.join(
+        workdir, f"ckpt_write_log_{commits}x{FILES_PER_COMMIT}_s0")
+    log = os.path.join(path, "_delta_log")
+    if not os.path.exists(os.path.join(log, f"{commits - 1:020d}.json")):
+        print(f"generating {commits}-commit write-bench log...",
+              file=sys.stderr)
+        synth_delta_log(path, commits, FILES_PER_COMMIT)
+    # restore the cached log to a bare commit history: a previous run's
+    # checkpoint would turn the timed write into a put-if-absent no-op,
+    # and its appended commits would shift this run's reuse arithmetic
+    for f in os.listdir(log):
+        if ".checkpoint" in f or f == "_last_checkpoint":
+            os.remove(os.path.join(log, f))
+        elif (f.endswith(".json") and f[:-5].isdigit()
+              and int(f[:-5]) >= commits):
+            os.remove(os.path.join(log, f))
+
+    def digest() -> tuple:
+        clear_parse_cache()
+        snap = Table.for_path(path, HostEngine()).latest_snapshot()
+        at = snap.state.add_files_table
+        h = hashlib.sha1()
+        for row in sorted(zip(at.column("path").to_pylist(),
+                              at.column("size").to_pylist())):
+            h.update(repr(row).encode())
+        return snap.version, snap.state.num_files, h.hexdigest()
+
+    eng = HostEngine()
+    clear_parse_cache()
+    snap = Table.for_path(path, eng).latest_snapshot()
+    live = digest()
+    old = settings.checkpoint_part_size
+    # ~8 file parts so both the funnel and the reuse split have real
+    # part structure to work with
+    settings.checkpoint_part_size = max(1, snap.state.num_files // 8)
+    bytes_c = obs.counter("checkpoint.bytes_written")
+    reused_c = obs.counter("checkpoint.parts_reused")
+    try:
+        pipelined = ckpt_pipeline.profitable(eng, log, 9)
+        b0 = bytes_c.value
+        t0 = time.perf_counter()
+        info = write_checkpoint(eng, snap)
+        write_s = time.perf_counter() - t0
+        nbytes = bytes_c.value - b0
+        parity_ok = digest() == live  # reload now resolves via the hint
+        gbps = nbytes / write_s / 1e9
+        n_parts = len(info.partManifest["parts"]) if info.partManifest else 0
+        print(f"checkpoint write @{commits} commits: {nbytes / 1e6:.1f}MB "
+              f"in {write_s:.2f}s ({gbps:.3f}GB/s) across {n_parts} file "
+              f"part(s), pipelined={pipelined}, parity_ok={parity_ok}",
+              file=sys.stderr)
+        # secondary metric line (the driver reads the LAST line only)
+        print(json.dumps({
+            "metric": "checkpoint_write_gbps",
+            "value": round(gbps, 4),
+            "unit": "GB/s",
+            "bytes": nbytes,
+            "seconds": round(write_s, 3),
+            "file_parts": n_parts,
+            "pipelined": pipelined,
+            "gate_ok": parity_ok,
+        }))
+        if os.environ.get("BENCH_STRICT") == "1":
+            assert parity_ok, (
+                "BENCH_STRICT: checkpoint reload digest != live digest")
+
+        # append-only growth, then an incremental checkpoint seeded
+        # with the previous hint's part manifest
+        for v in (commits, commits + 1):
+            lines = [
+                f'{{"add":{{"path":"inc-{v:06d}-{i:04d}.parquet",'
+                f'"partitionValues":{{}},"size":1048576,'
+                f'"modificationTime":{v},"dataChange":true}}}}'
+                for i in range(FILES_PER_COMMIT)
+            ]
+            with open(os.path.join(log, f"{v:020d}.json"), "w") as fh:
+                fh.write("\n".join(lines) + "\n")
+        clear_parse_cache()
+        snap2 = Table.for_path(path, eng).latest_snapshot()
+        live2 = digest()
+        prev = read_last_checkpoint(eng.fs, log)
+        r0 = reused_c.value
+        info2 = write_checkpoint(eng, snap2, prev_info=prev)
+        reused = reused_c.value - r0
+        total = (len(info2.partManifest["parts"])
+                 if info2.partManifest else 0)
+        reuse_pct = 100.0 * reused / total if total else 0.0
+        parity2_ok = digest() == live2
+        print(f"incremental checkpoint: reused {reused}/{total} file "
+              f"part(s) ({reuse_pct:.1f}%), parity_ok={parity2_ok}",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "incremental_checkpoint_reuse_pct",
+            "value": round(reuse_pct, 1),
+            "unit": "%",
+            "parts_reused": reused,
+            "file_parts": total,
+            "gate_ok": bool(reuse_pct > 0.0 and parity2_ok),
+        }))
+        if os.environ.get("BENCH_STRICT") == "1":
+            assert parity2_ok, (
+                "BENCH_STRICT: incremental checkpoint reload digest "
+                "!= live digest")
+            assert reuse_pct > 0.0, (
+                "BENCH_STRICT: append-only workload reused no parts")
+    finally:
+        settings.checkpoint_part_size = old
+
+
 def retry_overhead_metric(workdir: str) -> None:
     """delta-resilience overhead on the fault-free path: every storage
     hop runs through `io_call(endpoint, fn)` (breaker check + retry
@@ -1275,6 +1405,7 @@ def main():
     chaos_recovery_metric()
     serve_metrics()
     checkpoint_read_metric(workdir)
+    checkpoint_write_metric(workdir)
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         sharded_metrics(timeout_s)
 

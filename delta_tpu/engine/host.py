@@ -147,6 +147,17 @@ class HostParquetHandler(ParquetHandler):
         _WRITE_CALLS.inc()
         _WRITE_BYTES.inc(len(buf))
 
+    def write_serialized(self, path: str, data: bytes,
+                         overwrite: bool = False) -> FileStatus:
+        store = self._store_for(path)
+        with obs.span("storage.parquet_write", _verbose=True, path=path,
+                      bytes=len(data), overwrite=overwrite):
+            io_call(endpoint_of(path),
+                    lambda: store.write(path, data, overwrite=overwrite))
+        _WRITE_CALLS.inc()
+        _WRITE_BYTES.inc(len(data))
+        return store.file_status(path)
+
 
 class HostFileSystemClient(FileSystemClient):
     # I/O call counters (cheap, process-local, never reset implicitly):

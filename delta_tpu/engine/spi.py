@@ -49,6 +49,14 @@ class ParquetHandler:
     def write_parquet_file_atomically(self, path: str, table: pa.Table) -> None:
         raise NotImplementedError
 
+    def write_serialized(self, path: str, data: bytes,
+                         overwrite: bool = False) -> FileStatus:
+        """Upload already-encoded Parquet bytes. Splitting encode from
+        upload lets the pipelined checkpoint writer overlap the two
+        stages (and byte-copy reused parts without re-encoding);
+        overwrite=False is the atomic put-if-absent contract."""
+        raise NotImplementedError
+
 
 class FileSystemClient:
     def list_from(self, path: str) -> Iterator[FileStatus]:

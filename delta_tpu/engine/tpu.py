@@ -72,6 +72,13 @@ class TpuEngine(HostEngine):
     # remains the measured default on tunnel deployments; resolved at
     # construction so in-process env changes take effect
     use_device_page_decode = False
+    # checkpoint-write stats aggregation on device (ops/stats.py):
+    # autodetected from the backend at construction — on a real
+    # accelerator the snapshot's columnar state is already resident and
+    # the aggregation is one batched dispatch; on CPU backends the host
+    # numpy twin is bit-identical and skips the dispatch overhead.
+    # DELTA_TPU_DEVICE_CKPT_STATS=1|0 overrides at the call site.
+    use_device_ckpt_stats = False
 
     def __init__(
         self,
@@ -95,6 +102,9 @@ class TpuEngine(HostEngine):
         self.replay_shards = replay_shards
         self.use_device_page_decode = (
             os.environ.get("DELTA_TPU_DEVICE_PAGE_DECODE") == "1")
+        from delta_tpu.ops.stats import accel_backend_default
+
+        self.use_device_ckpt_stats = accel_backend_default()
 
 
 def _default_mesh(replay_shards: Optional[int]):

@@ -45,9 +45,13 @@ def checkpoint_hook(table, txn, version: int, metadata) -> None:
     interval = get_table_config(metadata.configuration, CHECKPOINT_INTERVAL)
     if interval > 0 and version > 0 and version % interval == 0:
         from delta_tpu.log.checkpointer import write_checkpoint
+        from delta_tpu.log.last_checkpoint import read_last_checkpoint
 
         snap = _snapshot_for_hook(table, version)
-        write_checkpoint(table.engine, snap)
+        # the previous hint carries the part manifest that lets the
+        # writer reuse unchanged parts (best-effort: None → full write)
+        prev = read_last_checkpoint(table.engine.fs, table.log_path)
+        write_checkpoint(table.engine, snap, prev_info=prev)
 
 
 def checksum_hook(table, txn, version: int, metadata) -> None:

@@ -16,13 +16,31 @@ the SingleAction layout: struct columns `protocol`, `metaData`, `txn`,
 The add/remove struct columns are assembled directly from the snapshot's
 canonical columnar state — no per-row object hop. Finishes by pointing
 `_last_checkpoint` at the new checkpoint.
+
+Multi-artifact checkpoints (multipart parts, V2 sidecars) go through
+`delta_tpu.write.ckpt_pipeline`: per-artifact serialize and upload are
+split so encode(part i+1) overlaps upload(part i) on remote stores,
+and any failure settles the in-flight tail, deletes every artifact
+this attempt created, bumps `checkpoint.aborted_writes`, and re-raises
+WITHOUT advancing `_last_checkpoint` — a torn multipart write can
+never become the active checkpoint.
+
+Incremental checkpoints: each file-action part is content-fingerprinted
+(`_part_fp`) and the fingerprints ride the `_last_checkpoint` hint as
+`partManifest`. The next write reuses fingerprint-matched parts —
+byte-copied under the new filename for multipart (old parts are
+cleanup-eligible once shadowed), re-referenced in place for V2
+sidecars (log cleanup never deletes `_sidecars/`). Append-only
+workloads rewrite only the tail part.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import time
 import uuid
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 import pyarrow as pa
@@ -40,6 +58,12 @@ from delta_tpu.log.last_checkpoint import LastCheckpointInfo, write_last_checkpo
 from delta_tpu.models.actions import Sidecar
 from delta_tpu.replay.columnar import DV_STRUCT_TYPE
 from delta_tpu.utils import filenames
+from delta_tpu.write import ckpt_pipeline
+
+_BYTES_WRITTEN = obs.counter("checkpoint.bytes_written")
+_PARTS_WRITTEN = obs.counter("checkpoint.parts_written")
+_PARTS_REUSED = obs.counter("checkpoint.parts_reused")
+_ABORTED_WRITES = obs.counter("checkpoint.aborted_writes")
 
 PV_MAP = pa.map_(pa.string(), pa.string())
 
@@ -413,17 +437,105 @@ def _retained_tombstones(state, now_ms: int, retention_ms: int) -> pa.Table:
     return tombs.filter(keep)
 
 
-def write_checkpoint(engine, snapshot, policy: Optional[str] = None) -> LastCheckpointInfo:
-    """Write a checkpoint for `snapshot` and update `_last_checkpoint`."""
+def _partition_codes(state, adds: pa.Table) -> tuple:
+    """Dictionary-code each add row's partition-value tuple.
+    Unpartitioned tables (the common case) take the zero-work
+    single-code path; partitioned tables code the tuples on host — the
+    expensive per-part distinct-count then reduces with the other
+    lanes in the one batched dispatch."""
+    n = adds.num_rows
+    if not list(state.metadata.partitionColumns or []):
+        return np.zeros(n, np.int64), 1
+    codebook: dict = {}
+    codes = np.empty(n, np.int64)
+    for i, kv in enumerate(adds.column("partition_values").to_pylist()):
+        key = tuple(kv) if kv is not None else ()
+        codes[i] = codebook.setdefault(key, len(codebook))
+    return codes, max(len(codebook), 1)
+
+
+def _checkpoint_aggregates(engine, state, adds: pa.Table, plan) -> None:
+    """Stats summary for the checkpoint being written: per-part
+    min/max/sum/null-count over the add lanes (file size, modification
+    time, DV cardinality) plus distinct partition values. On an engine
+    with an accelerator backend (`device_stats_enabled`) the whole
+    stage is ONE batched device dispatch returning one dense D2H block
+    (`ops/stats.py`, budgeted in transfer_budget.json), colocated with
+    the resident replay state's device when one exists; otherwise the
+    bit-identical host twin runs. The block feeds the
+    `checkpoint.aggregate` span — it is deliberately NOT part of the
+    reuse fingerprint, so stat-mode flips can never change checkpoint
+    bytes."""
+    from delta_tpu.ops import stats as ckstats
+
+    n = adds.num_rows
+    n_parts = len(plan)
+    with obs.span("checkpoint.aggregate", rows=n, parts=n_parts) as sp:
+
+        def lane(col) -> tuple:
+            arr = (col.combine_chunks()
+                   if isinstance(col, pa.ChunkedArray) else col)
+            vals = pc.fill_null(arr, 0).to_numpy(
+                zero_copy_only=False).astype(np.int64, copy=False)
+            ok = pc.is_valid(arr).to_numpy(zero_copy_only=False)
+            return vals, ok
+
+        size_v, size_ok = lane(adds.column("size"))
+        mt_v, mt_ok = lane(adds.column("modification_time"))
+        dv_v, dv_ok = lane(pc.struct_field(
+            adds.column("deletion_vector").combine_chunks(), "cardinality"))
+        codes, n_codes = _partition_codes(state, adds)
+        lanes = [size_v, mt_v, dv_v, codes]
+        valids = [size_ok, mt_ok, dv_ok, np.ones(n, bool)]
+        part_of = np.zeros(n, np.int32)
+        for i, (a0, a1, _r0, _r1) in enumerate(plan):
+            part_of[a0:a1] = i
+        mode = "host"
+        if ckstats.device_stats_enabled(engine):
+            resident = getattr(state, "resident", None)
+            hint = resident.device_hint() if resident is not None else None
+            try:
+                block = ckstats.checkpoint_stats_block(
+                    lanes, valids, part_of, n_parts, n_codes, device=hint)
+                mode = "device"
+            # delta-lint: disable=except-swallow (audited: the aggregate
+            # block is telemetry riding the checkpoint write — a device
+            # dispatch failure must degrade to the bit-identical host
+            # twin, never abort the checkpoint)
+            except Exception:
+                block = ckstats.host_stats_block(
+                    lanes, valids, part_of, n_parts, n_codes)
+        else:
+            block = ckstats.host_stats_block(
+                lanes, valids, part_of, n_parts, n_codes)
+        n_l = len(lanes)
+        sp.set_attrs(
+            stats_mode=mode,
+            logical_bytes=int(block[2 * n_l].sum()),
+            dv_cardinality=int(block[2 * n_l + 2].sum()),
+            distinct_partition_values=int(block[4 * n_l].max(initial=0)),
+        )
+
+
+def write_checkpoint(engine, snapshot, policy: Optional[str] = None,
+                     prev_info: Optional[LastCheckpointInfo] = None,
+                     ) -> LastCheckpointInfo:
+    """Write a checkpoint for `snapshot` and update `_last_checkpoint`.
+
+    `prev_info` is the previous `_last_checkpoint` hint; when it carries
+    a `partManifest` from an identically-configured writer, unchanged
+    parts/sidecars are reused instead of re-serialized."""
     with obs.span("checkpoint.write", log_path=snapshot._table.log_path,
                   version=snapshot.version) as sp:
-        info = _write_checkpoint(engine, snapshot, policy)
+        info = _write_checkpoint(engine, snapshot, policy, prev_info)
         sp.set_attrs(actions=info.size, num_add_files=info.numOfAddFiles,
                      size_bytes=info.sizeInBytes)
         return info
 
 
-def _write_checkpoint(engine, snapshot, policy: Optional[str]) -> LastCheckpointInfo:
+def _write_checkpoint(engine, snapshot, policy: Optional[str],
+                      prev_info: Optional[LastCheckpointInfo] = None,
+                      ) -> LastCheckpointInfo:
     state = snapshot.state
     meta_conf = state.metadata.configuration
     if policy is None:
@@ -464,19 +576,38 @@ def _write_checkpoint(engine, snapshot, policy: Optional[str]) -> LastCheckpoint
 
     log_path = snapshot._table.log_path
     version = snapshot.version
+    part_size = settings.checkpoint_part_size
+    n_files = len(add_struct) + len(remove_struct)
 
     if policy == "v2":
-        info = _write_v2_checkpoint(
-            engine, log_path, version, add_struct, remove_struct,
-            protocol_rows, metadata_rows, txn_rows, domain_rows,
-        )
+        route = "v2"
+        plan = _chunk_plan(len(add_struct), len(remove_struct),
+                           part_size or max(n_files, 1))
+    elif part_size is not None and n_files > part_size:
+        route = "multipart"
+        plan = _chunk_plan(len(add_struct), len(remove_struct), part_size)
     else:
-        part_size = settings.checkpoint_part_size
-        n_files = len(add_struct) + len(remove_struct)
-        if part_size is not None and n_files > part_size:
-            info = _write_multipart_checkpoint(
-                engine, log_path, version, part_size, add_struct, remove_struct,
+        route = "classic"
+        plan = [(0, len(add_struct), 0, len(remove_struct))]
+
+    _checkpoint_aggregates(engine, state, adds, plan)
+    writer_fp = _writer_fp(policy, part_size, stats_as_json,
+                           stats_as_struct, state.metadata.schemaString)
+    prev_parts = (_prev_part_index(prev_info, writer_fp)
+                  if route != "classic" else {})
+
+    try:
+        if route == "v2":
+            info = _write_v2_checkpoint(
+                engine, log_path, version, add_struct, remove_struct,
                 protocol_rows, metadata_rows, txn_rows, domain_rows,
+                plan, writer_fp, prev_parts,
+            )
+        elif route == "multipart":
+            info = _write_multipart_checkpoint(
+                engine, log_path, version, add_struct, remove_struct,
+                protocol_rows, metadata_rows, txn_rows, domain_rows,
+                plan, writer_fp, prev_parts,
             )
         else:
             n = (
@@ -490,16 +621,29 @@ def _write_checkpoint(engine, snapshot, policy: Optional[str]) -> LastCheckpoint
                 add_struct, remove_struct,
             )
             path = filenames.checkpoint_file_singular(log_path, version)
-            try:
-                engine.parquet.write_parquet_file_atomically(path, table)
-            except FileExistsError:
-                pass  # another writer already checkpointed this version
+            # same funnel as multipart/V2: put-if-absent with the
+            # torn-collision wholeness check, CheckpointWriteError on
+            # failure, and bytes/parts accounting
+            results = ckpt_pipeline.run_write_tasks(
+                engine,
+                [ckpt_pipeline.WriteTask(
+                    path, lambda: _encode_parquet(table),
+                    overwrite=False, label="classic")],
+                pipelined=False)
+            _count_written(results)
             info = LastCheckpointInfo(
                 version=version,
                 size=n,
                 sizeInBytes=_file_size(engine, path),
                 numOfAddFiles=len(add_struct),
             )
+    except ckpt_pipeline.CheckpointWriteError as e:
+        # torn checkpoint: delete everything this attempt materialized
+        # and leave `_last_checkpoint` pointing at the previous (still
+        # complete) checkpoint — readers never see a partial part set
+        _ABORTED_WRITES.inc()
+        _cleanup_orphans(engine, e.touched_paths)
+        raise
     write_last_checkpoint(engine.json, log_path, info)
     return info
 
@@ -511,98 +655,294 @@ def _file_size(engine, path: str) -> Optional[int]:
         return None
 
 
+def _chunk_plan(n_add: int, n_rem: int, part_size: int) -> List[tuple]:
+    """FIXED `part_size`-row chunks over the concatenated [adds;
+    removes] file-action row space → [(a0, a1, r0, r1)] per part.
+
+    Fixed chunks (not an even split) are what makes incremental reuse
+    work: append-only commits add rows at the END of the canonical
+    state, so every full earlier chunk covers the same rows as last
+    time and its fingerprint — and therefore its bytes — are unchanged.
+    An even split would shift every boundary on each append and
+    invalidate all parts."""
+    total = n_add + n_rem
+    out = []
+    lo = 0
+    while lo < total:
+        hi = min(lo + part_size, total)
+        out.append((min(lo, n_add), min(hi, n_add),
+                    max(lo, n_add) - n_add, max(hi, n_add) - n_add))
+        lo = hi
+    return out or [(0, 0, 0, 0)]
+
+
+def _writer_fp(policy, part_size, stats_as_json, stats_as_struct,
+               schema_string) -> str:
+    """Fingerprint of everything that shapes part bytes besides the rows
+    themselves. A part is only reusable when the writer that produced it
+    had an identical config — chunk boundaries (part_size), stats
+    shaping, the table schema (drives stats_parsed typing), and the
+    layout revision of this module."""
+    blob = json.dumps(
+        {
+            "layout": 1,
+            "policy": policy,
+            "partSize": part_size,
+            "statsAsJson": bool(stats_as_json),
+            "statsAsStruct": bool(stats_as_struct),
+            "schema": hashlib.sha1(
+                (schema_string or "").encode()).hexdigest(),
+        },
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def _part_fp(writer_fp: str, adds_i: pa.Array, rems_i: pa.Array) -> str:
+    """Content fingerprint of one part's file-action rows: sha1 over the
+    Arrow IPC bytes of the slices, re-materialized at offset 0 first
+    (`pa.concat_arrays`) — a plain slice's IPC stream leaks its parent's
+    buffer truncation and absolute offset, so only the rebased form is
+    byte-stable across snapshots. Equal fingerprints ⇒ identical rows ⇒
+    the previous checkpoint's part bytes are valid for this part."""
+    h = hashlib.sha1(writer_fp.encode())
+    for name, arr in (("add", adds_i), ("remove", rems_i)):
+        batch = pa.record_batch({name: pa.concat_arrays([arr])})
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, batch.schema) as w:
+            w.write_batch(batch)
+        h.update(sink.getvalue())
+    return h.hexdigest()[:20]
+
+
+def _prev_part_index(prev_info: Optional[LastCheckpointInfo],
+                     writer_fp: str) -> Dict[str, dict]:
+    """fp → manifest entry for the previous checkpoint's file-action
+    parts; empty unless the manifest was written by an identically
+    configured writer (unknown/absent manifests degrade to a full
+    write, never to wrong reuse)."""
+    if prev_info is None:
+        return {}
+    pm = getattr(prev_info, "partManifest", None)
+    if not isinstance(pm, dict) or pm.get("writerFp") != writer_fp:
+        return {}
+    out: Dict[str, dict] = {}
+    for e in pm.get("parts") or []:
+        if isinstance(e, dict) and e.get("fp") and e.get("name"):
+            out[e["fp"]] = e
+    return out
+
+
+def _encode_parquet(table: pa.Table) -> bytes:
+    import pyarrow.parquet as pq
+
+    sink = pa.BufferOutputStream()
+    pq.write_table(table, sink, compression="snappy")
+    return sink.getvalue().to_pybytes()
+
+
+def _file_part_build(engine, log_path: str, prev_entry: Optional[dict],
+                     adds_i: pa.Array, rems_i: pa.Array,
+                     ) -> Callable[[], bytes]:
+    """Build closure for one file-action part. With a fingerprint-matched
+    previous part the bytes are COPIED from the old object: multipart
+    part names embed version and part count, and log cleanup may delete
+    old parts once shadowed, so reuse must re-materialize under the new
+    checkpoint's filename rather than re-reference. A vacuumed or
+    unreadable old part degrades to a fresh encode."""
+
+    def fresh() -> bytes:
+        return _encode_parquet(_single_action_table(
+            len(adds_i) + len(rems_i), None, None, None, None,
+            adds_i, rems_i))
+
+    if prev_entry is None:
+        return fresh
+    prev_path = f"{log_path}/{prev_entry['name']}"
+
+    def build() -> bytes:
+        try:
+            data = engine.fs.read_file(prev_path)
+        except OSError:
+            return fresh()
+        _PARTS_REUSED.inc()
+        return data
+
+    return build
+
+
+def _count_written(results) -> None:
+    for r in results:
+        if r.created:
+            _PARTS_WRITTEN.inc()
+            _BYTES_WRITTEN.inc(r.nbytes)
+
+
+def _cleanup_orphans(engine, paths) -> None:
+    """Best-effort delete of an aborted checkpoint attempt's artifacts.
+    The write failure is re-raised by the caller either way; a path
+    that refuses to delete merely leaves an orphan part behind, which
+    readers ignore (an incomplete part set is never selected)."""
+    for p in paths:
+        try:
+            engine.fs.delete(p)
+        # delta-lint: disable=except-swallow (audited: cleanup after an
+        # aborted checkpoint is best-effort — the original failure
+        # propagates regardless, and a surviving orphan is inert)
+        except Exception:
+            pass
+
+
 def _write_multipart_checkpoint(
-    engine, log_path, version, part_size, add_struct, remove_struct,
+    engine, log_path, version, add_struct, remove_struct,
     protocol_rows, metadata_rows, txn_rows, domain_rows,
+    plan, writer_fp, prev_parts,
 ):
-    """Legacy multi-part: file actions split across parts; small actions in
-    part 1. Part layout mirrors `Checkpoints.scala:669-699` (hash split by
-    row — here contiguous ranges, equally valid: parts are unordered)."""
-    file_rows: List[tuple] = [(True, add_struct), (False, remove_struct)]
-    total_files = len(add_struct) + len(remove_struct)
-    num_parts = max(1, -(-total_files // part_size))
+    """Legacy multi-part. Part 1 holds the small actions ONLY (they
+    churn every checkpoint — protocol/metaData/txn/domainMetadata must
+    never dirty a reusable file-action chunk); parts 2..N are fixed
+    `part_size`-row file-action chunks per `plan`. Layout mirrors
+    `Checkpoints.scala:669-699` (hash split by row — contiguous ranges
+    are equally valid: parts are unordered). Parts flow through the
+    serialize→upload pipeline (`write/ckpt_pipeline.py`) when its gate
+    engages."""
+    num_parts = 1 + len(plan)
     paths = filenames.checkpoint_file_with_parts(log_path, version, num_parts)
+    n_small = (
+        len(protocol_rows) + len(metadata_rows)
+        + (len(txn_rows) if txn_rows is not None else 0)
+        + (len(domain_rows) if domain_rows is not None else 0)
+    )
 
-    add_splits = _split_ranges(len(add_struct), num_parts)
-    rem_splits = _split_ranges(len(remove_struct), num_parts)
+    def small_build() -> bytes:
+        return _encode_parquet(_single_action_table(
+            n_small, protocol_rows, metadata_rows, txn_rows, domain_rows,
+            None, None))
 
-    def _write_part(i: int) -> int:
-        """One part; returns its action count. Parts are independent
-        files, so they write concurrently — the reference's task-per-part
-        distributed write (`Checkpoints.scala:717-782`) mapped onto the
-        shared I/O pool."""
-        a0, a1 = add_splits[i]
-        r0, r1 = rem_splits[i]
+    tasks = [ckpt_pipeline.WriteTask(paths[0], small_build,
+                                     overwrite=False, label="small-actions")]
+    part_rows = [n_small]
+    part_fps: List[Optional[str]] = [None]
+    prev_parts = dict(prev_parts)
+    for i, (a0, a1, r0, r1) in enumerate(plan):
         adds_i = add_struct.slice(a0, a1 - a0)
         rems_i = remove_struct.slice(r0, r1 - r0)
-        p_rows = protocol_rows if i == 0 else None
-        m_rows = metadata_rows if i == 0 else None
-        t_rows = txn_rows if i == 0 else None
-        d_rows = domain_rows if i == 0 else None
-        n = (
-            (len(p_rows) if p_rows is not None else 0)
-            + (len(m_rows) if m_rows is not None else 0)
-            + (len(t_rows) if t_rows is not None else 0)
-            + (len(d_rows) if d_rows is not None else 0)
-            + len(adds_i) + len(rems_i)
-        )
-        table = _single_action_table(n, p_rows, m_rows, t_rows, d_rows,
-                                     adds_i, rems_i)
-        try:
-            engine.parquet.write_parquet_file_atomically(paths[i], table)
-        except FileExistsError:
-            pass
-        return n
+        fp = _part_fp(writer_fp, adds_i, rems_i)
+        # pop, not get: one old part must not satisfy two new chunks
+        prev = prev_parts.pop(fp, None)
+        tasks.append(ckpt_pipeline.WriteTask(
+            paths[i + 1],
+            _file_part_build(engine, log_path, prev, adds_i, rems_i),
+            overwrite=False,
+            label=f"part-{i + 2}" + (":reuse" if prev else "")))
+        part_rows.append(len(adds_i) + len(rems_i))
+        part_fps.append(fp)
 
-    from delta_tpu.utils.threads import parallel_map
+    pipelined = ckpt_pipeline.profitable(engine, log_path, len(tasks))
+    results = ckpt_pipeline.run_write_tasks(engine, tasks, pipelined)
+    _count_written(results)
 
-    total_actions = sum(parallel_map(_write_part, range(num_parts)))
+    manifest: Optional[dict] = {"writerFp": writer_fp, "parts": []}
+    total_bytes = 0
+    for path, fp, n, r in zip(paths, part_fps, part_rows, results):
+        if r.status is None:
+            # another writer materialized this part: its bytes may not
+            # match our fingerprints or sizes — publish no manifest
+            manifest = None
+            break
+        total_bytes += r.status.size or 0
+        if fp is not None and manifest is not None:
+            manifest["parts"].append({
+                "name": filenames.file_name(path), "fp": fp, "rows": n,
+                "bytes": r.status.size,
+                "mtime": r.status.modification_time,
+            })
     return LastCheckpointInfo(
-        version=version, size=total_actions, parts=num_parts,
+        version=version, size=sum(part_rows), parts=num_parts,
+        sizeInBytes=total_bytes if manifest is not None else None,
         numOfAddFiles=len(add_struct),
+        partManifest=manifest,
     )
 
 
-def _split_ranges(n: int, parts: int) -> List[tuple]:
-    bounds = [round(i * n / parts) for i in range(parts + 1)]
-    return [(bounds[i], bounds[i + 1]) for i in range(parts)]
+def _sidecar_usable(engine, log_path: str, prev_entry: dict) -> bool:
+    """Plan-time existence check before re-referencing a previous
+    checkpoint's sidecar, so one lost to manual deletion degrades to a
+    rewrite instead of a dangling pointer in the new checkpoint."""
+    path = f"{filenames.sidecar_dir(log_path)}/{prev_entry['name']}"
+    try:
+        return bool(engine.fs.exists(path))
+    except OSError:
+        return False
 
 
 def _write_v2_checkpoint(
     engine, log_path, version, add_struct, remove_struct,
     protocol_rows, metadata_rows, txn_rows, domain_rows,
+    plan, writer_fp, prev_parts,
 ):
     """V2 (PROTOCOL.md:196-269): file actions go to `_sidecars/<uuid>.parquet`;
     the top-level UUID checkpoint holds checkpointMetadata + sidecar
-    pointers + the small actions. File actions split across
-    `checkpoint_part_size`-row sidecars written concurrently (the
-    reference writes one sidecar per state partition)."""
-    n_files = len(add_struct) + len(remove_struct)
-    part_size = settings.checkpoint_part_size
-    num_parts = (max(1, -(-n_files // part_size)) if part_size else 1)
-    add_splits = _split_ranges(len(add_struct), num_parts)
-    rem_splits = _split_ranges(len(remove_struct), num_parts)
+    pointers + the small actions. File actions split across fixed
+    `checkpoint_part_size`-row sidecars per `plan` (the reference
+    writes one sidecar per state partition), run through the
+    serialize→upload pipeline when its gate engages.
 
-    def _write_sidecar(i: int) -> Sidecar:
-        a0, a1 = add_splits[i]
-        r0, r1 = rem_splits[i]
+    Reuse here is a RE-REFERENCE, not a copy: sidecars are uuid-named,
+    so log cleanup never deletes them (their names parse to no
+    version) and a fingerprint-matched previous sidecar can simply be
+    pointed at again — zero serialize, zero upload."""
+    n_files = len(add_struct) + len(remove_struct)
+    num_parts = len(plan)
+    prev_parts = dict(prev_parts)
+    tasks: List[ckpt_pipeline.WriteTask] = []
+    # per part: ("reuse", Sidecar) | ("task", task index, sidecar name)
+    slots: List[tuple] = []
+    part_fps: List[str] = []
+    part_rows: List[int] = []
+    for i, (a0, a1, r0, r1) in enumerate(plan):
         adds_i = add_struct.slice(a0, a1 - a0)
         rems_i = remove_struct.slice(r0, r1 - r0)
-        sidecar_uuid = str(uuid.uuid4())
-        sidecar_path = filenames.sidecar_file(log_path, sidecar_uuid)
-        sidecar_table = _single_action_table(
-            len(adds_i) + len(rems_i), None, None, None, None, adds_i, rems_i
-        )
-        status = engine.parquet.write_parquet_file(sidecar_path, sidecar_table)
-        return Sidecar(
-            path=f"{sidecar_uuid}.parquet",
-            sizeInBytes=status.size,
-            modificationTime=status.modification_time,
-        )
+        fp = _part_fp(writer_fp, adds_i, rems_i)
+        part_fps.append(fp)
+        part_rows.append(len(adds_i) + len(rems_i))
+        prev = prev_parts.pop(fp, None)
+        if prev is not None and _sidecar_usable(engine, log_path, prev):
+            _PARTS_REUSED.inc()
+            slots.append(("reuse", Sidecar(
+                path=prev["name"], sizeInBytes=prev.get("bytes"),
+                modificationTime=prev.get("mtime"))))
+            continue
 
-    from delta_tpu.utils.threads import parallel_map
+        def fresh(adds_i=adds_i, rems_i=rems_i) -> bytes:
+            return _encode_parquet(_single_action_table(
+                len(adds_i) + len(rems_i), None, None, None, None,
+                adds_i, rems_i))
 
-    sidecars = parallel_map(_write_sidecar, range(num_parts))
+        name = f"{uuid.uuid4()}.parquet"
+        tasks.append(ckpt_pipeline.WriteTask(
+            f"{filenames.sidecar_dir(log_path)}/{name}", fresh,
+            overwrite=True,  # uuid-named: never contended
+            label=f"sidecar-{i + 1}"))
+        slots.append(("task", len(tasks) - 1, name))
+
+    pipelined = ckpt_pipeline.profitable(engine, log_path, len(tasks))
+    results = ckpt_pipeline.run_write_tasks(engine, tasks, pipelined)
+    _count_written(results)
+
+    sidecars: List[Sidecar] = []
+    manifest_parts: List[dict] = []
+    for slot, fp, n in zip(slots, part_fps, part_rows):
+        if slot[0] == "reuse":
+            sc = slot[1]
+        else:
+            status = results[slot[1]].status
+            sc = Sidecar(path=slot[2], sizeInBytes=status.size,
+                         modificationTime=status.modification_time)
+        sidecars.append(sc)
+        manifest_parts.append({
+            "name": sc.path, "fp": fp, "rows": n,
+            "bytes": sc.sizeInBytes, "mtime": sc.modificationTime,
+        })
 
     top_schema_cols = {}
     n_top = (
@@ -658,7 +998,13 @@ def _write_v2_checkpoint(
 
     top_table = pa.table(top_schema_cols)
     top_path = filenames.top_level_v2_checkpoint_file(log_path, version, "parquet")
-    engine.parquet.write_parquet_file_atomically(top_path, top_table)
+    try:
+        engine.parquet.write_parquet_file_atomically(top_path, top_table)
+    except BaseException as e:
+        # only OUR fresh sidecars are orphans — re-referenced ones
+        # belong to the previous (still active) checkpoint
+        touched = [r.task.path for r in results if r.created] + [top_path]
+        raise ckpt_pipeline.CheckpointWriteError(e, touched) from e
     total_bytes = sum(sc.sizeInBytes or 0 for sc in sidecars)
     total_bytes += _file_size(engine, top_path) or 0
     return LastCheckpointInfo(
@@ -667,4 +1013,5 @@ def _write_v2_checkpoint(
         sizeInBytes=total_bytes or None,
         numOfAddFiles=len(add_struct),
         tag=filenames.file_name(top_path),
+        partManifest={"writerFp": writer_fp, "parts": manifest_parts},
     )

@@ -33,10 +33,18 @@ class LastCheckpointInfo:
     checkpointSchema: Optional[Dict[str, Any]] = None
     checksum: Optional[str] = None
     tag: Optional[str] = None       # V2: the UUID-named top-level file name
+    # Incremental-writer part manifest: {"writerFp": config fingerprint,
+    # "parts": [{"name", "fp", "rows", "bytes", "mtime"}, ...]} — lets
+    # the NEXT checkpoint reuse byte-identical parts/sidecars instead of
+    # rewriting them (log/checkpointer.py). Purely an accelerator rider
+    # on the hint: readers ignore it, a missing/stale manifest degrades
+    # to a full rewrite, and from_json's unknown-key tolerance keeps old
+    # readers compatible.
+    partManifest: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> str:
         d = {"version": self.version, "size": self.size}
-        for k in ("parts", "sizeInBytes", "numOfAddFiles", "checkpointSchema", "checksum", "tag"):
+        for k in ("parts", "sizeInBytes", "numOfAddFiles", "checkpointSchema", "checksum", "tag", "partManifest"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -54,6 +62,7 @@ class LastCheckpointInfo:
             checkpointSchema=d.get("checkpointSchema"),
             checksum=d.get("checksum"),
             tag=d.get("tag"),
+            partManifest=d.get("partManifest"),
         )
 
 

@@ -258,6 +258,22 @@ class ResidentShardState:
             _APPENDS.inc()
             return live, tomb
 
+    def device_hint(self):
+        """First device of the owning mesh, or None once released — the
+        checkpoint writer colocates its aggregation upload with the
+        resident replay lanes so the stats dispatch lands on a device
+        that already holds this snapshot's columnar state."""
+        with self._lock:
+            if self.key_sh is None or self.mesh is None:
+                return None
+            try:
+                return self.mesh.devices.flat[0]
+            # delta-lint: disable=except-swallow (audited: the hint is
+            # a placement optimization — any mesh-shape drift must fall
+            # back to default placement, never fail a checkpoint)
+            except Exception:
+                return None
+
     def release(self) -> None:
         """Drop the device buffer (the host bookkeeping is garbage with
         it, so the whole state is dead after this). Serializes against

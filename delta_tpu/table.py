@@ -240,9 +240,14 @@ class Table:
 
             raise CheckpointError(
                 f"cannot checkpoint a non-existent table: {e}") from e
+        from delta_tpu.log.last_checkpoint import read_last_checkpoint
+
         with obs.span("table.checkpoint", table=self.path,
                       version=snap.version):
-            write_checkpoint(self.engine, snap)
+            # the previous hint's partManifest lets the writer reuse
+            # unchanged parts/sidecars (best-effort: None → full write)
+            prev = read_last_checkpoint(self.engine.fs, self.log_path)
+            write_checkpoint(self.engine, snap, prev_info=prev)
         # reseed the incremental .crc chain from the full state: a commit
         # whose checksum couldn't be derived (e.g. removes without sizes)
         # breaks the chain, and the checkpoint is the natural recovery

@@ -1,0 +1,312 @@
+"""Pipelined checkpoint writer: serialize → upload overlap across
+multipart parts and V2 sidecars.
+
+The serial checkpoint writer encodes each part to Parquet and uploads
+it inside one pool task, so a part's upload latency and the next
+part's Arrow/Parquet encode cost add up on remote stores. This module
+is the write-path mirror of `replay/pipeline.py`: one serializer
+thread encodes parts ahead through a bounded queue while the calling
+thread keeps a bounded window of uploads in flight on the shared I/O
+pool — encode(part i+1) overlaps upload(part i), with the same
+poll-loop backpressure, stall counters, and fail-fast drain semantics
+as the read pipeline.
+
+Profitability gate (same stand-down shape as `parallel/gate.py` /
+`replay.pipeline.profitable`): local stores write at page-cache speed,
+where the existing pool fan-out already saturates the disk and the
+extra queue hop only adds overhead — the pipeline engages on non-local
+stores (per-part upload latency is the thing it hides) or under
+`force`, and always stands down for single-artifact checkpoints.
+
+Error contract: any serialize or upload failure aborts the whole run —
+remaining uploads are awaited (never abandoned mid-write), then
+`CheckpointWriteError` carries every path this attempt actually
+created (plus the possibly-torn failing target) so the checkpointer
+can delete the orphans and leave `_last_checkpoint` untouched.
+
+Env knobs:
+  DELTA_TPU_CKPT_PIPELINE=on|off|force  (default on; off = serial
+                                         pool path; force engages the
+                                         pipeline even on local stores)
+  DELTA_TPU_CKPT_PIPELINE_DEPTH         (default 2 parts per queue and
+                                         uploads in flight)
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from delta_tpu import obs
+from delta_tpu.replay.pipeline import (
+    _DONE,
+    _JOIN_S,
+    _Cancelled,
+    _StageError,
+    _drain,
+    _get,
+    _offer_error,
+    _put,
+)
+
+_SERIALIZE_STALL_NS = obs.counter("checkpoint.serialize_stall_ns")
+_UPLOAD_STALL_NS = obs.counter("checkpoint.upload_stall_ns")
+
+_DEFAULT_DEPTH = 2
+
+
+def enabled() -> bool:
+    return os.environ.get("DELTA_TPU_CKPT_PIPELINE", "on").lower() not in (
+        "off", "0", "false", "no")
+
+
+def forced() -> bool:
+    """`DELTA_TPU_CKPT_PIPELINE=force` engages the pipeline even where
+    the profitability gate would stand down (A/B runs, tests)."""
+    return os.environ.get("DELTA_TPU_CKPT_PIPELINE", "").lower() == "force"
+
+
+def pipeline_depth() -> int:
+    try:
+        return max(1, int(os.environ.get("DELTA_TPU_CKPT_PIPELINE_DEPTH",
+                                         _DEFAULT_DEPTH)))
+    except ValueError:
+        return _DEFAULT_DEPTH
+
+
+def profitable(engine, log_path: str, n_tasks: int) -> bool:
+    """Engage only where serialize/upload overlap can beat the serial
+    pool path: multi-artifact checkpoints on non-local stores (per-part
+    upload latency is what the pipeline hides). Local writes land in
+    the page cache, where the pool fan-out already saturates the disk
+    and the queue hop is pure overhead — stand down there."""
+    if forced():
+        return True
+    if not enabled():
+        return False
+    if n_tasks < 2:
+        return False
+    os_path = getattr(engine.fs, "os_path", None)
+    if os_path is None:
+        return True
+    return os_path(log_path) is None
+
+
+@dataclass
+class WriteTask:
+    """One checkpoint artifact. `build` produces the encoded Parquet
+    bytes — a fresh Arrow→Parquet encode for changed parts, or a
+    byte-copy read of the previous checkpoint's part for reused ones —
+    and the runner uploads them to `path`."""
+
+    path: str
+    build: Callable[[], bytes]
+    overwrite: bool = False
+    label: str = ""
+
+
+@dataclass
+class TaskResult:
+    """Outcome of one task: `status` is the uploaded file's FileStatus,
+    or None when an overwrite=False target already existed (another
+    writer checkpointed this version first — their artifact is complete
+    by the atomic put-if-absent contract, and is NOT ours to clean up);
+    `created` records whether this attempt materialized the file."""
+
+    task: WriteTask
+    nbytes: int
+    status: Optional[object]
+    created: bool
+
+
+class CheckpointWriteError(Exception):
+    """A part/sidecar serialize or upload failed mid-checkpoint.
+    `touched_paths` lists every artifact this attempt created, plus the
+    possibly-torn failing target — the caller must delete them and must
+    not advance `_last_checkpoint`."""
+
+    error_class = "DELTA_CHECKPOINT_WRITE_ABORTED"
+
+    def __init__(self, cause: BaseException, touched_paths: List[str]):
+        super().__init__(f"checkpoint write aborted: {cause}")
+        self.cause = cause
+        self.touched_paths = list(touched_paths)
+
+
+def _build(task: WriteTask) -> bytes:
+    with obs.span("checkpoint.serialize", path=task.path,
+                  label=task.label) as sp:
+        data = task.build()
+        sp.set_attr("bytes", len(data))
+        return data
+
+
+_TORN_RETRIES = 2
+
+
+def _upload(engine, task: WriteTask, data: bytes) -> TaskResult:
+    with obs.span("checkpoint.upload", path=task.path, bytes=len(data),
+                  label=task.label):
+        for attempt in range(_TORN_RETRIES + 1):
+            try:
+                status = engine.parquet.write_serialized(
+                    task.path, data, overwrite=task.overwrite)
+                return TaskResult(task, len(data), status, created=True)
+            except FileExistsError:
+                # put-if-absent collision. Usually another writer
+                # already checkpointed this version and their artifact
+                # is complete (whole by the atomic-put contract) — but
+                # on filesystem-style stores the collision can also be
+                # OUR OWN torn earlier attempt surfacing through the
+                # retry policy (write tears mid-stream, the retry finds
+                # the half file). Adopt the existing artifact only if
+                # it is whole; otherwise delete the torn leftover and
+                # re-attempt, and after the bounded retries fail so the
+                # abort path cleans up instead of publishing a corrupt
+                # part.
+                if _existing_is_whole(engine, task.path, len(data)):
+                    return TaskResult(task, 0, None, created=False)
+                if attempt >= _TORN_RETRIES:
+                    raise
+                try:
+                    engine.fs.delete(task.path)
+                # delta-lint: disable=except-swallow (audited: if the
+                # torn leftover can't be deleted, the next write
+                # attempt collides again and the bounded loop raises)
+                except OSError:
+                    pass
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+def _existing_is_whole(engine, path: str, expected_bytes: int) -> bool:
+    try:
+        return engine.fs.file_status(path).size == expected_bytes
+    except OSError:
+        return False
+
+
+def _created_paths(results) -> List[str]:
+    return [r.task.path for r in results if r is not None and r.created]
+
+
+def run_write_tasks(engine, tasks: List[WriteTask],
+                    pipelined: bool) -> List[TaskResult]:
+    """Execute every task, returning results in task order. On any
+    failure, remaining in-flight uploads are awaited, then
+    `CheckpointWriteError` is raised carrying the created/touched
+    paths for cleanup."""
+    if not tasks:
+        return []
+    if pipelined and len(tasks) > 1:
+        return _run_pipelined(engine, tasks)
+    return _run_serial(engine, tasks)
+
+
+def _run_serial(engine, tasks: List[WriteTask]) -> List[TaskResult]:
+    """Stand-down path: one pool task per artifact, serialize + upload
+    together (the pre-pipeline product behavior — parts still write
+    concurrently across the shared I/O pool)."""
+    from delta_tpu.utils.threads import shared_pool
+
+    def one(task: WriteTask) -> TaskResult:
+        return _upload(engine, task, _build(task))
+
+    if len(tasks) == 1:
+        try:
+            return [one(tasks[0])]
+        except BaseException as e:
+            raise CheckpointWriteError(e, [tasks[0].path]) from e
+
+    pool = shared_pool()
+    futures = [pool.submit(obs.wrap(one), t) for t in tasks]
+    results: List[Optional[TaskResult]] = []
+    first_exc: Optional[BaseException] = None
+    failed_paths: List[str] = []
+    # settle EVERY future before returning: cleanup must never race an
+    # in-flight write that would recreate a just-deleted orphan
+    for t, f in zip(tasks, futures):
+        try:
+            results.append(f.result())
+        except BaseException as e:
+            results.append(None)
+            failed_paths.append(t.path)
+            if first_exc is None:
+                first_exc = e
+    if first_exc is not None:
+        raise CheckpointWriteError(
+            first_exc, _created_paths(results) + failed_paths) from first_exc
+    return results  # type: ignore[return-value]
+
+
+def _run_pipelined(engine, tasks: List[WriteTask]) -> List[TaskResult]:
+    from delta_tpu.utils.threads import shared_pool
+
+    depth = pipeline_depth()
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _serializer_main() -> None:
+        try:
+            for i, task in enumerate(tasks):
+                data = _build(task)
+                _put(q, (i, data), stop, _SERIALIZE_STALL_NS)
+            _put(q, _DONE, stop, _SERIALIZE_STALL_NS)
+        except _Cancelled:
+            pass
+        except BaseException as e:
+            _offer_error(q, e, stop, _SERIALIZE_STALL_NS)
+
+    serializer = threading.Thread(
+        target=obs.wrap(_serializer_main),
+        name="delta-ckpt-serialize", daemon=True)
+    serializer.start()
+    pool = shared_pool()
+    inflight: deque = deque()  # (task index, upload future)
+    results: List[Optional[TaskResult]] = [None] * len(tasks)
+    first_exc: Optional[BaseException] = None
+    failed_paths: List[str] = []
+
+    def settle(j: int, fut) -> None:
+        nonlocal first_exc
+        try:
+            results[j] = fut.result()
+        except BaseException as e:
+            failed_paths.append(tasks[j].path)
+            if first_exc is None:
+                first_exc = e
+
+    try:
+        while first_exc is None:
+            item = _get(q, stop, _UPLOAD_STALL_NS)
+            if item is _DONE:
+                break
+            if isinstance(item, _StageError):
+                first_exc = item.exc
+                break
+            i, data = item
+            while len(inflight) >= depth:
+                settle(*inflight.popleft())
+                if first_exc is not None:
+                    break
+            if first_exc is None:
+                inflight.append(
+                    (i, pool.submit(obs.wrap(_upload), engine, tasks[i],
+                                    data)))
+    except BaseException as e:
+        if first_exc is None:
+            first_exc = e
+    finally:
+        stop.set()
+        _drain(q)
+        # await the tail either way — cleanup must not race a write
+        while inflight:
+            settle(*inflight.popleft())
+        serializer.join(timeout=_JOIN_S)
+    if first_exc is not None:
+        raise CheckpointWriteError(
+            first_exc, _created_paths(results) + failed_paths) from first_exc
+    return results  # type: ignore[return-value]

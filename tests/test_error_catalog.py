@@ -119,7 +119,7 @@ def test_every_raise_site_is_typed_or_allowed():
     # typed DeltaError subclasses defined next to their subsystem
     known |= {"MergeCardinalityError", "CorruptLogError",
               "RemoteDeltaError", "PostCommitHookError",
-              "SchemaEvolutionRequiresRestart"}
+              "SchemaEvolutionRequiresRestart", "CheckpointWriteError"}
     extra_builtin = {"AttributeError", "EOFError", "SystemExit"}
     bad = []
     for p, ln, name in _raise_sites():

@@ -228,10 +228,12 @@ def test_multipart_checkpoint(tmp_path, engine_cls):
         settings.checkpoint_part_size = old
     log = os.path.join(path, "_delta_log")
     parts = [f for f in os.listdir(log) if ".checkpoint.00" in f]
-    assert len(parts) == 3  # 10 files / 4 per part
+    # part 1 holds only the small actions (protocol/metaData), then
+    # 10 file actions in fixed chunks of 4 -> 3 file parts
+    assert len(parts) == 4
     snap = Table.for_path(path, engine_cls()).latest_snapshot()
     assert snap.log_segment.checkpoint_version == 1
-    assert len(snap.log_segment.checkpoints) == 3
+    assert len(snap.log_segment.checkpoints) == 4
     assert live_paths(snap) == [f"f{i}" for i in range(1, 10)]
 
 
