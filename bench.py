@@ -1393,6 +1393,103 @@ def serve_metrics() -> None:
     }))
 
 
+# ------------------------------------------------- device JSON parse
+
+
+def device_parse_metric() -> None:
+    """Device JSON action-parse kernels vs the host scanner over the
+    SAME in-memory commit buffer (cache-insensitive: direct window
+    parses, no parse cache, no filesystem in the timed loop). Emits
+    `device_parse_actions_per_sec`; value is 0 when the device route
+    falls back or row parity fails."""
+    commits = int(os.environ.get("BENCH_PARSE_COMMITS", 2000))
+    fpc = 50
+    rng = np.random.default_rng(11)
+    sizes = rng.integers(1, 1 << 40, commits * fpc)
+    mods = rng.integers(1, 1 << 41, commits * fpc)
+    blobs = []
+    k = 0
+    for v in range(commits):
+        lines = []
+        for i in range(fpc):
+            lines.append(
+                '{"add":{"path":"part-%05d-%04d-c000.snappy.parquet",'
+                '"partitionValues":{},"size":%d,"modificationTime":%d,'
+                '"dataChange":true,"stats":"{\\"numRecords\\":%d}"}}'
+                % (v, i, sizes[k], mods[k], i))
+            k += 1
+        if v:
+            lines.append(
+                '{"remove":{"path":"part-%05d-0000-c000.snappy.parquet",'
+                '"deletionTimestamp":%d,"dataChange":true}}'
+                % (v - 1, 10_000 + v))
+        lines.append('{"commitInfo":{"operation":"WRITE","ver":%d}}' % v)
+        blobs.append(("\n".join(lines) + "\n").encode())
+    starts = np.zeros(len(blobs) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=starts[1:])
+    buf = b"".join(blobs)
+    versions = np.arange(commits, dtype=np.int64)
+    n_lines = commits * (fpc + 2) - 1
+
+    from delta_tpu.replay.device_parse import parse_commits_device
+
+    os.environ["DELTA_TPU_DEVICE_PARSE"] = "force"
+    try:
+        dev_out = parse_commits_device(buf, starts, versions)
+        if dev_out is None:
+            print("device parse fell back to host on the bench corpus",
+                  file=sys.stderr)
+            print(json.dumps({"metric": "device_parse_actions_per_sec",
+                              "value": 0.0, "unit": "actions/s",
+                              "vs_host": 0.0}))
+            return
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            parse_commits_device(buf, starts, versions)
+            times.append(time.perf_counter() - t0)
+        dev_s = min(times)
+    finally:
+        del os.environ["DELTA_TPU_DEVICE_PARSE"]
+
+    from delta_tpu import native
+    from delta_tpu.replay.columnar import _parse_buffer_generic
+    from delta_tpu.replay.native_parse import parse_commits_native
+
+    host_kind = "native-simd"
+    if native.available(allow_compile=True):
+        host = lambda: parse_commits_native(buf, starts, versions)  # noqa: E731
+    else:
+        host_kind = "arrow-generic"
+        host = lambda: _parse_buffer_generic(buf, starts, versions)  # noqa: E731
+    host_out = host()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        host()
+        times.append(time.perf_counter() - t0)
+    host_s = min(times)
+
+    dev_t, host_t = dev_out[0], host_out[0]
+    parity = (dev_t.num_rows == host_t.num_rows
+              and dev_t.column("path").to_pylist()
+              == host_t.column("path").to_pylist()
+              and dev_t.column("size").to_pylist()
+              == host_t.column("size").to_pylist())
+    print(f"device parse @{n_lines} lines ({len(buf) / 1e6:.0f}MB): "
+          f"device {n_lines / dev_s / 1e6:.2f}M actions/s, "
+          f"{host_kind} host {n_lines / host_s / 1e6:.2f}M actions/s, "
+          f"parity={'OK' if parity else 'MISMATCH'}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "device_parse_actions_per_sec",
+        "value": round(n_lines / dev_s, 1) if parity else 0.0,
+        "unit": "actions/s",
+        "vs_host": round(host_s / dev_s, 3) if parity else 0.0,
+        "host_kind": host_kind,
+        "window_mb": round(len(buf) / 1e6, 1),
+    }))
+
+
 def main():
     commits = int(os.environ.get("BENCH_COMMITS", 100_000))
     workdir = os.environ.get("BENCH_WORKDIR", "/tmp/delta_tpu_bench")
@@ -1406,6 +1503,7 @@ def main():
     serve_metrics()
     checkpoint_read_metric(workdir)
     checkpoint_write_metric(workdir)
+    device_parse_metric()
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         sharded_metrics(timeout_s)
 

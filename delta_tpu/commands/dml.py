@@ -59,14 +59,11 @@ def _read_file_with_partitions(table, snapshot, add: AddFile) -> pa.Table:
 def _existing_dv_mask(table, add: AddFile, num_rows: int) -> Optional[np.ndarray]:
     if add.deletionVector is None:
         return None
-    from delta_tpu.dv.descriptor import load_deletion_vector
+    from delta_tpu.dv.descriptor import load_deletion_vector_mask
 
-    deleted = load_deletion_vector(
-        table.engine, table.path, add.deletionVector.to_dict()
+    return load_deletion_vector_mask(
+        table.engine, table.path, add.deletionVector.to_dict(), num_rows
     )
-    mask = np.zeros(num_rows, dtype=bool)
-    mask[deleted[deleted < num_rows].astype(np.int64)] = True
-    return mask
 
 
 def _write_cdc(table, snapshot, txn, rows: pa.Table, change_type: str) -> None:

@@ -57,16 +57,16 @@ def absolute_dv_path(table_path: str, descriptor_row: Dict) -> str:
         error_class="DELTA_CANNOT_RECONSTRUCT_PATH_FROM_URI")
 
 
-def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.ndarray:
-    """Descriptor → sorted uint64 array of deleted row indexes.
-    Validates the descriptor's declared size and cardinality against
-    the decoded bitmap (`DeltaErrors.deletionVectorSizeMismatch` /
-    `.deletionVectorCardinalityMismatch` — a descriptor out of sync
-    with its bitmap silently un-deletes or over-deletes rows)."""
+def _load_blob(engine, table_path: str, descriptor_row: Dict
+               ) -> tuple[bytes, str]:
+    """Descriptor → (verified blob bytes, where-string). Shared by the
+    values route and the mask route so checksum/size validation is
+    identical regardless of where the expansion runs."""
     storage = descriptor_row["storageType"]
     if storage == "i":
-        blob = base64.b85decode(descriptor_row["pathOrInlineDv"].encode("ascii"))
-        return _decoded(blob, descriptor_row, "<inline>")
+        blob = base64.b85decode(
+            descriptor_row["pathOrInlineDv"].encode("ascii"))
+        return blob, "<inline>"
     path = absolute_dv_path(table_path, descriptor_row)
     data = engine.fs.read_file(path)
     offset = descriptor_row.get("offset") or 0
@@ -79,7 +79,57 @@ def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.nd
         raise DeletionVectorError(
             f"deletion vector checksum mismatch in {path}",
             error_class="DELTA_DELETION_VECTOR_CHECKSUM_MISMATCH")
-    return _decoded(blob, descriptor_row, path)
+    return blob, path
+
+
+def load_deletion_vector(engine, table_path: str, descriptor_row: Dict) -> np.ndarray:
+    """Descriptor → sorted uint64 array of deleted row indexes.
+    Validates the descriptor's declared size and cardinality against
+    the decoded bitmap (`DeltaErrors.deletionVectorSizeMismatch` /
+    `.deletionVectorCardinalityMismatch` — a descriptor out of sync
+    with its bitmap silently un-deletes or over-deletes rows)."""
+    blob, where = _load_blob(engine, table_path, descriptor_row)
+    return _decoded(blob, descriptor_row, where)
+
+
+def load_deletion_vector_mask(engine, table_path: str,
+                              descriptor_row: Dict, num_rows: int
+                              ) -> np.ndarray:
+    """Descriptor → boolean deleted-row mask of length `num_rows`, with
+    the same size/cardinality/checksum validation as
+    `load_deletion_vector`. With DELTA_TPU_DEVICE_DV_DECODE=1 the
+    container expansion runs as one batched device scatter
+    (`dv/roaring.py::decode_delta_mask`); otherwise (or on any device
+    fallback) the host deserialize+to_mask twin produces an identical
+    mask."""
+    blob, where = _load_blob(engine, table_path, descriptor_row)
+    from delta_tpu.dv.roaring import decode_delta_mask
+
+    declared_size = descriptor_row.get("sizeInBytes")
+    if declared_size is not None and declared_size != len(blob):
+        from delta_tpu.errors import DeletionVectorError
+
+        raise DeletionVectorError(
+            f"deletion vector at {where}: sizeInBytes "
+            f"{declared_size} != actual {len(blob)}",
+            error_class="DELTA_DELETION_VECTOR_SIZE_MISMATCH")
+    out = decode_delta_mask(blob, num_rows)
+    if out is not None:
+        mask, card = out
+        declared_card = descriptor_row.get("cardinality")
+        if declared_card is not None and declared_card != card:
+            from delta_tpu.errors import DeletionVectorError
+
+            raise DeletionVectorError(
+                f"deletion vector at {where}: cardinality "
+                f"{declared_card} != decoded {card}",
+                error_class="DELTA_DELETION_VECTOR_CARDINALITY_MISMATCH")
+        return mask
+    values = _decoded(blob, descriptor_row, where)
+    mask = np.zeros(num_rows, dtype=bool)
+    sel = values[values < num_rows]
+    mask[sel.astype(np.int64)] = True
+    return mask
 
 
 def _decoded(blob: bytes, descriptor_row: Dict, where: str) -> np.ndarray:

@@ -41,6 +41,21 @@ BITMAP_BYTES = 8192
 
 DELTA_MAGIC = 1681511377
 
+# Device-decode safety valve: refuse to materialize a word buffer for
+# bitmaps whose highest container would need more than this many uint32
+# words (64 Mi words = 256 MiB covering 2^31 rows) — absurdly sparse
+# high-key blobs route to the host expansion instead.
+_MAX_DECODE_WORDS = 1 << 26
+
+
+def _ragged_arange(lens: np.ndarray) -> np.ndarray:
+    """[0..l0), [0..l1), ... concatenated (vectorized ragged iota)."""
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    offs = np.cumsum(lens) - lens
+    return np.arange(total, dtype=np.int64) - np.repeat(offs, lens)
+
 
 class RoaringBitmapArray:
     """A set of uint64 row indexes (sorted, deduplicated numpy array)."""
@@ -132,51 +147,60 @@ class RoaringBitmapArray:
                 # produces identical bytes)
                 except Exception:
                     dev_rows = None
-        descr = bytearray()
-        containers = []
-        for i in range(n):
-            lo = low[bounds[i]:bounds[i + 1]]
-            card = len(lo)
-            descr += struct.pack("<HH", int(keys[i]), card - 1)
-            if card <= ARRAY_MAX_CARD:
-                containers.append(lo.astype("<u2").tobytes())
-            elif dev_rows is not None:
-                containers.append(dev_rows[rank[i]].tobytes())
+        # descriptor + offsets + container data all assemble as
+        # vectorized numpy record writes — no per-container Python loop
+        descr = np.empty((n, 2), dtype="<u2")
+        descr[:, 0] = keys
+        descr[:, 1] = (cards - 1).astype(np.uint16)
+        sizes = np.where(bitmap_mask, BITMAP_BYTES, 2 * cards)
+        c_offs = np.cumsum(sizes) - sizes  # container start within data
+        data = np.zeros(int(sizes.sum()), np.uint8)
+        ai = np.flatnonzero(~bitmap_mask)
+        if len(ai):
+            a_bytes = 2 * cards[ai]
+            src = low[np.repeat(~bitmap_mask, cards)].astype(
+                "<u2").view(np.uint8)
+            data[np.repeat(c_offs[ai], a_bytes)
+                 + _ragged_arange(a_bytes)] = src
+        bi = np.flatnonzero(bitmap_mask)
+        if len(bi):
+            if dev_rows is not None:
+                blocks = dev_rows
             else:
-                bits = np.zeros(BITMAP_BYTES, dtype=np.uint8)
+                lo_b = low[np.repeat(bitmap_mask, cards)]
+                blocks = np.zeros((len(bi), BITMAP_BYTES), np.uint8)
                 np.bitwise_or.at(
-                    bits, (lo >> np.uint16(3)).astype(np.int64),
-                    (np.uint8(1) << (lo & np.uint16(7)).astype(np.uint8)),
-                )
-                containers.append(bits.tobytes())
-        # offsets: absolute byte position of each container within the blob
-        offset_block_pos = len(header) + len(descr)
-        data_start = offset_block_pos + 4 * n
-        offsets = []
-        pos = data_start
-        for c in containers:
-            offsets.append(pos)
-            pos += len(c)
-        return (
-            bytes(header)
-            + bytes(descr)
-            + struct.pack(f"<{n}i", *offsets)
-            + b"".join(containers)
-        )
+                    blocks,
+                    (np.repeat(np.arange(len(bi)), cards[bi]),
+                     (lo_b >> np.uint16(3)).astype(np.int64)),
+                    np.uint8(1) << (lo_b & np.uint16(7)).astype(np.uint8))
+            data[c_offs[bi][:, None]
+                 + np.arange(BITMAP_BYTES, dtype=np.int64)] = blocks
+        data_start = len(header) + 4 * n + 4 * n
+        offsets = (data_start + c_offs).astype("<i4")
+        return (bytes(header) + descr.tobytes() + offsets.tobytes()
+                + data.tobytes())
 
     @staticmethod
-    def _deserialize32(buf: memoryview) -> tuple[np.ndarray, int]:
-        """Returns (sorted uint32 values, bytes consumed)."""
+    def _parse32_layout(buf: memoryview):
+        """Header/descriptor/offset parse of one 32-bit roaring blob,
+        fully vectorized for the run-free layouts the writer emits (one
+        '<u2' record view instead of a per-container struct.unpack
+        loop). Run containers force a short sequential size walk — their
+        payload length lives in the payload itself.
+
+        Returns (keys u16[n], cards i64[n], run_flags bool[n],
+        starts i64[n] — absolute payload offsets, sizes i64[n],
+        consumed)."""
         (cookie16,) = struct.unpack_from("<H", buf, 0)
-        pos = 0
         if cookie16 == SERIAL_COOKIE:
             (cookie,) = struct.unpack_from("<I", buf, 0)
             n = (cookie >> 16) + 1
             pos = 4
             run_bytes = (n + 7) // 8
             run_flags = np.unpackbits(
-                np.frombuffer(buf[pos:pos + run_bytes], dtype=np.uint8), bitorder="little"
-            )[:n].astype(bool)
+                np.frombuffer(buf[pos:pos + run_bytes], dtype=np.uint8),
+                bitorder="little")[:n].astype(bool)
             pos += run_bytes
             has_offsets = n >= NO_OFFSET_THRESHOLD
         else:
@@ -190,42 +214,84 @@ class RoaringBitmapArray:
             run_flags = np.zeros(n, dtype=bool)
             has_offsets = True
 
-        keys = np.empty(n, dtype=np.uint16)
-        cards = np.empty(n, dtype=np.int64)
-        for i in range(n):
-            k, c = struct.unpack_from("<HH", buf, pos + 4 * i)
-            keys[i] = k
-            cards[i] = c + 1
+        descr = np.frombuffer(buf[pos:pos + 4 * n], dtype="<u2")
+        descr = descr.reshape(n, 2)
+        keys = descr[:, 0].astype(np.uint16)
+        cards = descr[:, 1].astype(np.int64) + 1
         pos += 4 * n
         if has_offsets:
             pos += 4 * n  # offsets are redundant for sequential reads
 
-        parts = []
-        for i in range(n):
-            key = np.uint32(keys[i]) << np.uint32(16)
-            if run_flags[i]:
-                (n_runs,) = struct.unpack_from("<H", buf, pos)
-                pos += 2
-                runs = np.frombuffer(buf[pos:pos + 4 * n_runs], dtype="<u2").reshape(-1, 2)
-                pos += 4 * n_runs
-                lows = np.concatenate(
-                    [
-                        np.arange(int(s), int(s) + int(l) + 1, dtype=np.uint32)
-                        for s, l in runs
-                    ]
-                ) if n_runs else np.empty(0, np.uint32)
-            elif cards[i] > ARRAY_MAX_CARD:
-                bits = np.frombuffer(buf[pos:pos + BITMAP_BYTES], dtype=np.uint8)
-                pos += BITMAP_BYTES
-                unpacked = np.unpackbits(bits, bitorder="little")
-                lows = np.nonzero(unpacked)[0].astype(np.uint32)
-            else:
-                c = int(cards[i])
-                lows = np.frombuffer(buf[pos:pos + 2 * c], dtype="<u2").astype(np.uint32)
-                pos += 2 * c
-            parts.append(key | lows)
-        values = np.concatenate(parts) if parts else np.empty(0, np.uint32)
-        return values, pos
+        sizes = np.where(cards > ARRAY_MAX_CARD, BITMAP_BYTES, 2 * cards)
+        if run_flags.any():
+            starts = np.empty(n, np.int64)
+            p = pos
+            for i in range(n):
+                starts[i] = p
+                if run_flags[i]:
+                    (n_runs,) = struct.unpack_from("<H", buf, p)
+                    sizes[i] = 2 + 4 * n_runs
+                p += int(sizes[i])
+            consumed = p
+        else:
+            starts = pos + np.cumsum(sizes) - sizes
+            consumed = pos + int(sizes.sum())
+        return keys, cards, run_flags, starts, sizes, consumed
+
+    @staticmethod
+    def _deserialize32(buf: memoryview) -> tuple[np.ndarray, int]:
+        """Returns (sorted uint32 values, bytes consumed). Array and
+        bitmap containers expand in batched vectorized passes (ragged
+        gather / one 2-D unpackbits); only run containers — which the
+        writer never emits — walk sequentially."""
+        keys, cards, run_flags, starts, sizes, consumed = (
+            RoaringBitmapArray._parse32_layout(buf))
+        n = len(keys)
+        arr8 = np.frombuffer(buf[:consumed], np.uint8)
+        key32 = keys.astype(np.uint32) << np.uint32(16)
+        is_bm = (cards > ARRAY_MAX_CARD) & ~run_flags
+        is_arr = ~is_bm & ~run_flags
+
+        # actual per-container value counts (bitmaps: real popcount, NOT
+        # the descriptor cardinality — preserves behavior on malformed
+        # blobs whose bitmap payload disagrees with its header)
+        lens = cards.copy()
+        bi = np.flatnonzero(is_bm)
+        vals_b = rows_b = None
+        if len(bi):
+            blk = arr8[starts[bi][:, None]
+                       + np.arange(BITMAP_BYTES, dtype=np.int64)]
+            unp = np.unpackbits(blk, axis=1, bitorder="little")
+            rows_b, cols_b = np.nonzero(unp)
+            vals_b = key32[bi][rows_b] | cols_b.astype(np.uint32)
+            lens[bi] = unp.sum(axis=1)
+        run_parts = {}
+        for i in np.flatnonzero(run_flags).tolist():
+            (n_runs,) = struct.unpack_from("<H", buf, int(starts[i]))
+            runs = np.frombuffer(
+                buf[int(starts[i]) + 2:int(starts[i]) + 2 + 4 * n_runs],
+                dtype="<u2").reshape(-1, 2)
+            lows = np.concatenate(
+                [np.arange(int(s), int(s) + int(l) + 1, dtype=np.uint32)
+                 for s, l in runs]) if n_runs else np.empty(0, np.uint32)
+            run_parts[i] = key32[i] | lows
+            lens[i] = len(lows)
+
+        offs = np.cumsum(lens) - lens
+        values = np.empty(int(lens.sum()), np.uint32)
+        ai = np.flatnonzero(is_arr)
+        if len(ai):
+            a_lens = cards[ai]
+            lows_a = arr8[np.repeat(starts[ai], 2 * a_lens)
+                          + _ragged_arange(2 * a_lens)].view("<u2")
+            values[np.repeat(offs[ai], a_lens) + _ragged_arange(a_lens)] = (
+                np.repeat(key32[ai], a_lens) | lows_a.astype(np.uint32))
+        if len(bi):
+            values[np.repeat(offs[bi], lens[bi])
+                   + _ragged_arange(lens[bi])] = vals_b
+        for i, part in run_parts.items():
+            values[offs[i]:offs[i] + len(part)] = part
+        return values, consumed
 
     # -- 64-bit portable ----------------------------------------------------
 
@@ -277,3 +343,114 @@ class RoaringBitmapArray:
 
 def checksum(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
+
+
+# --------------------------------------------------- device mask decode
+
+
+def _mask_plan(data: bytes):
+    """Host-side container-header parse of a Delta DV blob into the
+    decode kernel's lanes: (bit_idx int64 — absolute rows from array/
+    run containers, bm_words uint32 + bm_pos int32 — raw bitmap words
+    and their flat word positions, n_words). Returns None when the blob
+    spans more than `_MAX_DECODE_WORDS` words. Raises
+    DeletionVectorError on a bad magic/cookie, exactly like
+    `deserialize_delta`."""
+    (magic,) = struct.unpack_from("<i", data, 0)
+    if magic != DELTA_MAGIC:
+        from delta_tpu.errors import DeletionVectorError
+
+        raise DeletionVectorError(f"bad deletion-vector magic {magic}")
+    buf = memoryview(data)[4:]
+    (n_buckets,) = struct.unpack_from("<q", buf, 0)
+    pos = 8
+    idx_parts = []
+    word_parts = []
+    wpos_parts = []
+    n_words = 0
+    for _ in range(n_buckets):
+        (bkey,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        sub = buf[pos:]
+        keys, cards, run_flags, starts, sizes, consumed = (
+            RoaringBitmapArray._parse32_layout(sub))
+        pos += consumed
+        if not len(keys):
+            continue
+        arr8 = np.frombuffer(sub[:consumed], np.uint8)
+        # absolute row base per container: (bucket<<32) | (key<<16)
+        base = (np.int64(bkey) << np.int64(32)) | (
+            keys.astype(np.int64) << np.int64(16))
+        hi = int(base.max()) + 65536
+        n_words = max(n_words, -(-hi // 32))
+        if n_words > _MAX_DECODE_WORDS:
+            return None
+        is_bm = (cards > ARRAY_MAX_CARD) & ~run_flags
+        is_arr = ~is_bm & ~run_flags
+        ai = np.flatnonzero(is_arr)
+        if len(ai):
+            a_lens = cards[ai]
+            lows = arr8[np.repeat(starts[ai], 2 * a_lens)
+                        + _ragged_arange(2 * a_lens)].view("<u2")
+            idx_parts.append(np.repeat(base[ai], a_lens)
+                             + lows.astype(np.int64))
+        bi = np.flatnonzero(is_bm)
+        if len(bi):
+            blk = arr8[starts[bi][:, None]
+                       + np.arange(BITMAP_BYTES, dtype=np.int64)]
+            word_parts.append(
+                np.ascontiguousarray(blk).view("<u4").reshape(-1))
+            wpos_parts.append(
+                ((base[bi] >> np.int64(5))[:, None]
+                 + np.arange(BITMAP_BYTES // 4, dtype=np.int64)
+                 ).reshape(-1))
+        for i in np.flatnonzero(run_flags).tolist():
+            (n_runs,) = struct.unpack_from("<H", sub, int(starts[i]))
+            runs = np.frombuffer(
+                sub[int(starts[i]) + 2:int(starts[i]) + 2 + 4 * n_runs],
+                dtype="<u2").reshape(-1, 2)
+            lows = np.concatenate(
+                [np.arange(int(s), int(s) + int(l) + 1, dtype=np.int64)
+                 for s, l in runs]) if n_runs else np.empty(0, np.int64)
+            idx_parts.append(base[i] + lows)
+    bit_idx = (np.concatenate(idx_parts) if idx_parts
+               else np.empty(0, np.int64))
+    bm_words = (np.concatenate(word_parts) if word_parts
+                else np.empty(0, np.uint32))
+    bm_pos = (np.concatenate(wpos_parts) if wpos_parts
+              else np.empty(0, np.int64)).astype(np.int64)
+    return bit_idx, bm_words, bm_pos, int(n_words)
+
+
+def decode_delta_mask(data: bytes, n: int):
+    """Device-route decode of a Delta DV blob straight to its deleted-
+    row mask: container headers parse on the host, array/bitmap/run
+    payloads expand to a flat word stream in ONE batched device scatter
+    (`ops/stats.py::decode_mask_words` — the inverse of the PR 11 pack
+    kernel). Returns (mask bool[n], total cardinality) or None for the
+    host fallback; cardinality counts ALL decoded bits, including rows
+    >= n, matching `deserialize_delta(...).values` semantics so the
+    descriptor-level cardinality check is route-independent."""
+    from delta_tpu import obs
+    from delta_tpu.ops.stats import decode_mask_words, device_dv_decode_enabled
+
+    if not device_dv_decode_enabled():
+        return None
+    plan = _mask_plan(data)
+    if plan is None:
+        return None
+    bit_idx, bm_words, bm_pos, n_words = plan
+    try:
+        words = decode_mask_words(bit_idx, bm_words, bm_pos, n_words)
+    # delta-lint: disable=except-swallow (audited: the decode kernel is
+    # a read fast path — any dispatch failure must fall back to the
+    # host deserialize+to_mask, which produces an identical mask)
+    except Exception:
+        return None
+    unp = np.unpackbits(words.view(np.uint8), bitorder="little")
+    card = int(unp.sum())
+    mask = np.zeros(n, dtype=bool)
+    m = min(n, unp.shape[0])
+    mask[:m] = unp[:m]
+    obs.counter("dv.device_decodes").inc()
+    return mask, card

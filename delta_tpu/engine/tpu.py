@@ -105,6 +105,12 @@ class TpuEngine(HostEngine):
         from delta_tpu.ops.stats import accel_backend_default
 
         self.use_device_ckpt_stats = accel_backend_default()
+        # device JSON action parse (ops/json_parse.py): same
+        # autodetect contract — profitable only when a real accelerator
+        # runs the structural scan; the host C++ scanner stays the CPU
+        # default. DELTA_TPU_DEVICE_PARSE=force|off overrides
+        # (parallel/gate.py::parse_route).
+        self.use_device_parse = accel_backend_default()
 
 
 def _default_mesh(replay_shards: Optional[int]):

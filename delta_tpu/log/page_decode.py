@@ -215,6 +215,8 @@ def parse_hybrid(data: bytes, pos: int, w: int, n: int,
                  end: Optional[int] = None) -> Tuple[HybridRuns, int]:
     """Parse the RLE/bit-packed hybrid stream for `n` values at bit
     width `w` starting at `pos`. Returns (runs, next_pos)."""
+    if not isinstance(w, int) or not 0 <= w <= 32:
+        raise DecodeUnsupported(f"hybrid bit width {w!r} outside [0, 32]")
     runs = HybridRuns(n, w)
     out = 0
     byte_w = (w + 7) // 8
@@ -255,6 +257,10 @@ def materialize_runs(runs: HybridRuns, device=None) -> np.ndarray:
         from delta_tpu.ops.pallas_kernels import unpack_bitpacked
 
         w = runs.w
+        if not isinstance(w, int) or not 0 <= w <= 32:
+            # guards callers that build HybridRuns directly; w outside the
+            # kernel's domain means a corrupt page, not a kernel bug
+            raise DecodeUnsupported(f"bit-packed width {w!r} outside [0, 32]")
         group_counts = [-(-max(nv, 1) // 32) for _s, nv, _w in
                         runs.packed]
         total_groups = sum(group_counts)

@@ -161,8 +161,69 @@ def _batched_file_stats_impl(values: np.ndarray, valid: np.ndarray):
 
 
 # ---------------------------------------------------------------------------
+# JSON structural byte classes (device action parse)
+# ---------------------------------------------------------------------------
+
+# uint8 min tile is (32, 128) per the TPU tiling table
+_BYTE_SUBLANES = 32
+_BYTE_TILE = _BYTE_SUBLANES * _LANES
+
+# class bit per structural byte; ops/json_parse.py tests these bits
+BYTE_CLASS_BITS = {
+    "newline": 1, "quote": 2, "backslash": 4,
+    "colon": 8, "lbrace": 16, "rbrace": 32,
+}
+_BYTE_CLASS_VALUES = ((10, 1), (34, 2), (92, 4), (58, 8), (123, 16),
+                      (125, 32))
+
+
+def _byte_class_kernel(in_ref, out_ref):
+    """in/out: [32, 128] uint8. One VMEM pass ORs the six structural
+    class bits per byte — the first stage of the device JSON parse
+    (quote/escape/colon masks feed the parity scans in
+    ops/json_parse.py)."""
+    b = in_ref[:]
+    cls = jnp.zeros_like(b)
+    for byte, bit in _BYTE_CLASS_VALUES:
+        cls = cls | jnp.where(b == jnp.uint8(byte), jnp.uint8(bit),
+                              jnp.uint8(0))
+    out_ref[:] = cls
+
+
+@jax.jit
+def byte_class_tiled(b: jnp.ndarray) -> jnp.ndarray:
+    """b: [n] uint8 (n a multiple of 4096) -> [n] uint8 class bitmask."""
+    (n,) = b.shape
+    assert n % _BYTE_TILE == 0, n
+    tiles = n // _BYTE_TILE
+    shaped = b.reshape(tiles * _BYTE_SUBLANES, _LANES)
+    out = pl.pallas_call(
+        _byte_class_kernel,
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec((_BYTE_SUBLANES, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_BYTE_SUBLANES, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((tiles * _BYTE_SUBLANES, _LANES),
+                                       jnp.uint8),
+        interpret=_use_interpret(),
+    )(shaped)
+    return out.reshape(n)
+
+
+# ---------------------------------------------------------------------------
 # parquet bit-packed group decode (checkpoint page decoder)
 # ---------------------------------------------------------------------------
+
+
+def _check_unpack_width(w: int, allow_zero: bool = False) -> None:
+    """Typed guard for the bit-unpack primitive. A corrupt page header
+    can carry any width byte; before this guard a w>32 silently wrapped
+    the value mask (`1 << w` mod 2^32) and decoded garbage."""
+    lo = 0 if allow_zero else 1
+    if not isinstance(w, (int, np.integer)) or not lo <= int(w) <= 32:
+        from delta_tpu.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"bit-packed width must be in [{lo}, 32], got {w!r}")
 
 
 def _unpack_kernel(w: int, in_ref, out_ref):
@@ -189,6 +250,7 @@ def unpack_bitpacked_tiled(packed: jnp.ndarray, w: int) -> jnp.ndarray:
     """packed: [w, G] uint32 (word-major: packed[k, g] = word k of
     group g; G a multiple of 1024) -> [G * 32] uint32 values, group-
     major (value j of group g at g*32 + j)."""
+    _check_unpack_width(w)
     g = packed.shape[1]
     assert g % _TILE == 0, g
     tiles = g // _TILE
@@ -211,7 +273,10 @@ def unpack_bitpacked(packed_words: np.ndarray, w: int,
     """Decode `n_groups` Parquet bit-packed groups (32 values x w bits
     each) from a flat little-endian u32 word stream. Pallas when
     available, jnp fallback with identical semantics. Returns a device
-    array of n_groups*32 uint32 values."""
+    array of n_groups*32 uint32 values. w must be in [0, 32]; w == 0 is
+    the valid all-zero run, anything outside raises
+    InvalidArgumentError instead of wrapping the value mask."""
+    _check_unpack_width(w, allow_zero=True)
     if w == 0:
         return jnp.zeros(n_groups * 32, jnp.uint32)
     need = n_groups * w
@@ -234,6 +299,7 @@ def unpack_bitpacked(packed_words: np.ndarray, w: int,
 @functools.partial(jax.jit, static_argnames=("w",))
 def _unpack_jnp(packed: jnp.ndarray, w: int) -> jnp.ndarray:
     """packed: [w, G] word-major; same output layout as the kernel."""
+    _check_unpack_width(w)
     g = packed.shape[1]
     mask = jnp.uint32((1 << w) - 1) if w < 32 else jnp.uint32(0xFFFFFFFF)
     outs = []

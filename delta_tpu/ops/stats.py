@@ -28,6 +28,9 @@ Env:
                                    backend, HostEngine stays host)
   DELTA_TPU_DEVICE_DV_PACK=1      route multi-container roaring bitmap
                                    packing through the device kernel
+  DELTA_TPU_DEVICE_DV_DECODE=1    route DV blob -> row-mask expansion
+                                   through the decode kernel (the
+                                   pack kernel's inverse)
 """
 
 from __future__ import annotations
@@ -67,6 +70,10 @@ def device_stats_enabled(engine=None) -> bool:
 
 def device_dv_pack_enabled() -> bool:
     return os.environ.get("DELTA_TPU_DEVICE_DV_PACK") == "1"
+
+
+def device_dv_decode_enabled() -> bool:
+    return os.environ.get("DELTA_TPU_DEVICE_DV_DECODE") == "1"
 
 
 def accel_backend_default() -> bool:
@@ -251,3 +258,62 @@ def pack_bitmap_words(flat_bits: np.ndarray, n_containers: int,
     if out.dtype.byteorder == ">":  # pragma: no cover - LE hosts only
         out = out.astype("<u4")
     return out.view(np.uint8).reshape(n_containers, 8192)
+
+
+# ------------------------------------------------------- DV bit decode
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_fn_cached(i_pad: int, w_pad: int, n_words: int):
+    """jit'd inverse of `_pack_fn_cached`: scatter array-container bit
+    indexes AND whole bitmap-container words into one flat uint32 word
+    stream. The two lane families are disjoint by construction — a
+    roaring container is either array-coded (contributes single bits)
+    or bitmap-coded (contributes whole words) — and set bits are
+    unique, so `add` == bitwise-or throughout. Sentinels (bit index ==
+    n_words*32, word position == n_words) drop."""
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(bit_idx, bm_words, bm_pos):
+        word = (bit_idx >> 5).astype(jnp.int32)
+        bit = jnp.left_shift(jnp.uint32(1),
+                             (bit_idx & 31).astype(jnp.uint32))
+        out = jnp.zeros(n_words, jnp.uint32).at[word].add(bit, mode="drop")
+        return out.at[bm_pos].add(bm_words, mode="drop")
+
+    return jax.jit(kernel)
+
+
+def decode_mask_words(bit_idx: np.ndarray, bm_words: np.ndarray,
+                      bm_pos: np.ndarray, n_words: int,
+                      device=None) -> np.ndarray:
+    """Expand a deletion vector's containers to a flat little-endian
+    uint32 word stream on device, one batched dispatch: `bit_idx` are
+    absolute row indexes from array/run containers (int64), `bm_words`
+    are raw bitmap-container words placed at word positions `bm_pos`.
+    Returns [n_words] uint32 (one dense D2H) — the exact inverse of
+    `pack_bitmap_words`."""
+    import jax
+
+    from delta_tpu.ops.replay import pad_bucket
+
+    ni = int(len(bit_idx))
+    nw = int(len(bm_words))
+    i_pad = pad_bucket(max(ni, 1))
+    w_pad = pad_bucket(max(nw, 1))
+    lane_bit_idx = np.full(i_pad, int(n_words) * 32, np.int64)
+    lane_bit_idx[:ni] = np.asarray(bit_idx, np.int64)
+    lane_bm_words = np.zeros(w_pad, np.uint32)
+    lane_bm_words[:nw] = np.asarray(bm_words, np.uint32)
+    lane_bm_pos = np.full(w_pad, int(n_words), np.int32)
+    lane_bm_pos[:nw] = np.asarray(bm_pos, np.int32)
+    with _x64():
+        words = _decode_fn_cached(i_pad, w_pad, int(n_words))(
+            jax.device_put(lane_bit_idx, device),
+            jax.device_put(lane_bm_words, device),
+            jax.device_put(lane_bm_pos, device))
+        out = np.ascontiguousarray(np.asarray(words))
+    if out.dtype.byteorder == ">":  # pragma: no cover - LE hosts only
+        out = out.astype("<u4")
+    return out

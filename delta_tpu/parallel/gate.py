@@ -27,6 +27,8 @@ profitable" so behavior is deterministic. Env overrides:
   DELTA_TPU_LINK_H2D_BPS       flat H2D bandwidth override (bytes/s)
   DELTA_TPU_LINK_RTT_S         round-trip override (seconds)
   DELTA_TPU_H2D_CHUNK          transfer chunk size override (bytes)
+  DELTA_TPU_DEVICE_PARSE       force|1|on -> device JSON parse,
+                               0|off -> host (parse_route)
 """
 
 from __future__ import annotations
@@ -55,6 +57,13 @@ DEFAULT_SHARDED_MIN_ROWS = 4_000_000
 # FA delta coding ships ~2 bits/row of flags plus byte-packed refs for
 # the non-new minority — ~4 rows/byte is the planning estimate.
 _FA_BYTES_PER_ROW = 0.25
+
+# JSON-parse routing estimates: the host C++ field-extraction scan
+# measured ~270 MB/s on one vCPU (BASELINE.md r05); the device
+# structural scan is planned at ~2 GB/s — both deliberately coarse,
+# the gate only needs the crossover's order of magnitude.
+_HOST_SCAN_BPS = 270e6
+_DEVICE_PARSE_BPS = 2e9
 
 
 class LinkModel(NamedTuple):
@@ -191,3 +200,33 @@ def replay_route(
     if n_shards > 1 and n_rows >= sharded_min_rows():
         return "sharded"
     return "single"
+
+
+def parse_route(
+    nbytes: int,
+    engine_enabled: bool = False,
+    forced: Optional[str] = None,
+) -> str:
+    """Pick the commit-JSON parse route: "host" (C++ scanner / generic
+    Arrow) or "device" (ops/json_parse.py batched field extraction).
+
+    Unlike `replay_route`, the CPU free-transfer model does NOT flip
+    this to device-always: the host C++ scanner IS the calibrated
+    fast path on CPU backends, so the device route needs the engine's
+    construction-time opt-in (`use_device_parse`, true on accelerator
+    backends) before the link economics are even consulted.
+    DELTA_TPU_DEVICE_PARSE outranks everything (tests, bench lanes)."""
+    env = os.environ.get("DELTA_TPU_DEVICE_PARSE")
+    if env is not None:
+        if env.lower() in ("force", "1", "on", "device"):
+            return "device"
+        if env.lower() in ("0", "off", "host"):
+            return "host"
+    if forced in ("host", "device"):
+        return forced
+    if not engine_enabled or nbytes <= 0:
+        return "host"
+    model = link_model()
+    t_host = nbytes / _HOST_SCAN_BPS
+    t_device = model.h2d_seconds(nbytes) + nbytes / _DEVICE_PARSE_BPS
+    return "device" if t_device < t_host else "host"

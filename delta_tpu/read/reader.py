@@ -29,13 +29,10 @@ def _dv_row_mask(engine, table_path: str, dv_row: dict, num_rows: int) -> Option
     all)."""
     if dv_row is None or dv_row.get("storageType") is None:
         return None
-    from delta_tpu.dv.descriptor import load_deletion_vector
+    from delta_tpu.dv.descriptor import load_deletion_vector_mask
 
-    deleted = load_deletion_vector(engine, table_path, dv_row)
-    mask = np.ones(num_rows, dtype=bool)
-    idx = deleted[deleted < num_rows]
-    mask[idx] = False
-    return mask
+    deleted = load_deletion_vector_mask(engine, table_path, dv_row, num_rows)
+    return ~deleted
 
 
 def _align_to_logical(tbl: pa.Table, schema, partition_columns, p2l,

@@ -1131,9 +1131,36 @@ def _columnarize_log_segment(
                                     allow_compile),
                                 lazy_stats=not os.environ.get(
                                     "DELTA_TPU_EAGER_STATS"),
-                                launch=launch))
+                                launch=launch,
+                                allow_device=getattr(
+                                    engine, "use_device_parse", False)))
                         bytes_parsed += pipe_nbytes
-            if fresh is None and _native.available(allow_compile):
+            if fresh is None:
+                # Device JSON parse: gated by the engine's accelerator
+                # opt-in + link economics (or DELTA_TPU_DEVICE_PARSE).
+                # On fallback the buffer it read is REUSED by the host
+                # branches below — never fetched twice.
+                from delta_tpu.parallel import gate as _gate
+
+                if _gate.parse_route(
+                        total_listed,
+                        getattr(engine, "use_device_parse",
+                                False)) == "device":
+                    from delta_tpu.replay import device_parse as _dp
+
+                    read = _read_commits_buffer(engine, remaining)
+                    if read is not None:
+                        buf, starts, version_arr = read
+                        parsed_native = _dp.parse_commits_device(
+                            buf, starts, version_arr,
+                            small_only=small_only,
+                            lazy_stats=(not small_only
+                                        and not os.environ.get(
+                                            "DELTA_TPU_EAGER_STATS")))
+                        if parsed_native is not None:
+                            bytes_parsed += int(starts[-1])
+            if (fresh is None and parsed_native is None and read is None
+                    and _native.available(allow_compile)):
                 # local files: one native read+scan round-trip (no per-file
                 # interpreter I/O, no buffer copy into Python)
                 local = [engine.fs.os_path(p) for _, p, _ in remaining]
@@ -1163,7 +1190,9 @@ def _columnarize_log_segment(
                 # one parallel read into one buffer; the native C++ scanner
                 # and the generic Arrow parser are alternative consumers of
                 # the SAME bytes — a native-side rejection never re-fetches
-                read = _read_commits_buffer(engine, remaining)
+                # (and a device-route fallback above already supplied them)
+                if read is None:
+                    read = _read_commits_buffer(engine, remaining)
                 if read is not None:
                     buf, starts, version_arr = read
                     if not native_rejected and _native.available(allow_compile):

@@ -359,11 +359,23 @@ def _reader_main(engine, windows, out_q, stop) -> None:
 
 
 def _parse_window(w: _Window, allow_native: bool,
-                  lazy_stats: bool) -> _Parsed:
+                  lazy_stats: bool, allow_device: bool = False) -> _Parsed:
     from delta_tpu.replay import columnar as C
 
     with obs.span("pipeline.parse_window", index=w.index,
                   files=len(w.infos), bytes=w.nbytes) as sp:
+        from delta_tpu.parallel import gate
+
+        if gate.parse_route(w.nbytes, allow_device) == "device":
+            from delta_tpu.replay.device_parse import parse_window_device
+
+            out = parse_window_device(w.buf, w.starts, w.versions,
+                                      lazy_stats=lazy_stats)
+            if out is not None:
+                table, others, keys, uniq, dv_any, sthunk = out
+                sp.set_attrs(rows=table.num_rows, device=True)
+                return _Parsed(w.index, table, others, keys, uniq,
+                               dv_any, sthunk, len(w.infos), w.nbytes)
         if allow_native:
             from delta_tpu.replay.native_parse import parse_window_native
 
@@ -401,14 +413,16 @@ def _parse_window(w: _Window, allow_native: bool,
                        len(w.infos), w.nbytes)
 
 
-def _parser_main(in_q, out_q, stop, allow_native, lazy_stats) -> None:
+def _parser_main(in_q, out_q, stop, allow_native, lazy_stats,
+                 allow_device=False) -> None:
     try:
         while True:
             item = _get(in_q, stop, _PARSE_STALL_NS)
             if item is _DONE or isinstance(item, _StageError):
                 _put(out_q, item, stop, _PARSE_STALL_NS)
                 return
-            parsed = _parse_window(item, allow_native, lazy_stats)
+            parsed = _parse_window(item, allow_native, lazy_stats,
+                                   allow_device)
             _put(out_q, parsed, stop, _PARSE_STALL_NS)
     except _Cancelled:
         pass
@@ -453,6 +467,7 @@ def parse_commits_pipelined(
     allow_native: bool,
     lazy_stats: bool,
     launch=None,
+    allow_device: bool = False,
 ):
     """Drive the read → parse → ingest pipeline over `windows` and
     return (ParsedSpan over ALL windows, pending replay handle or None,
@@ -481,7 +496,8 @@ def parse_commits_pipelined(
             name="delta-pipeline-read", daemon=True)
         parser = threading.Thread(
             target=obs.wrap(_parser_main),
-            args=(read_q, parsed_q, stop, allow_native, lazy_stats),
+            args=(read_q, parsed_q, stop, allow_native, lazy_stats,
+                  allow_device),
             name="delta-pipeline-parse", daemon=True)
         reader.start()
         parser.start()

@@ -1647,6 +1647,94 @@ def free(x):
     assert not report.findings
 
 
+# shaped like ops/json_parse.py::parse_window_fields: one padded uint8
+# window lane, budgeted at 1 B/unit
+_WINDOW_ENTRY = {
+    "site": "pkg/jparse.py::parse_window",
+    "unit": "padded window byte",
+    "budget_bytes_per_unit": 1,
+    "device_put_exhaustive": True,
+    "lanes": [{"name": "lane_bytes", "kind": "dtype", "dtype": "uint8"}],
+}
+
+_WINDOW_SRC = """
+import numpy as np
+import jax
+
+def parse_window(window, n):
+    lane_bytes = np.full(n + 32, 0x20, np.uint8)
+    jax.device_put(lane_bytes)
+    return lane_bytes
+"""
+
+
+def test_budget_byte_window_lane_clean(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"jparse": _WINDOW_ENTRY})
+    report = analyze_sources({"pkg/jparse.py": _WINDOW_SRC},
+                             rules=["transfer-budget"])
+    assert not report.findings
+
+
+def test_budget_byte_window_widened_flagged(tmp_path, monkeypatch):
+    # the r17 failure mode: a uint8 window lane silently widening to
+    # int32 quadruples the parse plane's H2D bytes
+    _write_budget(tmp_path, monkeypatch, {"jparse": _WINDOW_ENTRY})
+    src = _WINDOW_SRC.replace("np.uint8", "np.int32")
+    report = analyze_sources({"pkg/jparse.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "widened" in fired[0].message
+
+
+# shaped like ops/stats.py::decode_mask_words: mixed-dtype decode lanes
+# (int64 bit index + uint32 bitmap words + int32 word positions)
+_DECODE_ENTRY = {
+    "site": "pkg/dvdec.py::decode_words",
+    "unit": "padded decode element",
+    "budget_bytes_per_unit": 16,
+    "device_put_exhaustive": True,
+    "lanes": [
+        {"name": "lane_bit_idx", "kind": "dtype", "dtype": "int64"},
+        {"name": "lane_bm_words", "kind": "dtype", "dtype": "uint32"},
+        {"name": "lane_bm_pos", "kind": "dtype", "dtype": "int32"},
+    ],
+}
+
+_DECODE_SRC = """
+import numpy as np
+import jax
+
+def decode_words(bit_idx, bm_words, bm_pos, n_words):
+    lane_bit_idx = np.full(8, n_words * 32, np.int64)
+    lane_bm_words = np.zeros(8, np.uint32)
+    lane_bm_pos = np.full(8, n_words, np.int32)
+    jax.device_put(lane_bit_idx)
+    jax.device_put(lane_bm_words)
+    jax.device_put(lane_bm_pos)
+    return lane_bit_idx, lane_bm_words, lane_bm_pos
+"""
+
+
+def test_budget_decode_lanes_clean(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"dvdec": _DECODE_ENTRY})
+    report = analyze_sources({"pkg/dvdec.py": _DECODE_SRC},
+                             rules=["transfer-budget"])
+    assert not report.findings
+
+
+def test_budget_decode_extra_lane_flagged(tmp_path, monkeypatch):
+    _write_budget(tmp_path, monkeypatch, {"dvdec": _DECODE_ENTRY})
+    src = _DECODE_SRC.replace(
+        "    return lane_bit_idx, lane_bm_words, lane_bm_pos",
+        "    lane_runs = np.zeros(8, np.int64)\n"
+        "    jax.device_put(lane_runs)\n"
+        "    return lane_bit_idx, lane_bm_words, lane_bm_pos")
+    report = analyze_sources({"pkg/dvdec.py": src},
+                             rules=["transfer-budget"])
+    fired = _rules_fired(report, "transfer-budget")
+    assert fired and "not a budgeted lane" in fired[0].message
+
+
 # -------------------------------------------------- scan cache / changed
 
 

@@ -1,0 +1,351 @@
+"""Device JSON action parse + device DV decode: parity vs the host
+routes, fallback behavior, and the bit-width guards that ride along.
+
+Everything runs with JAX on CPU (the kernels' jnp twin); the Pallas
+byte-class path is exercised on TPU only. Parity is asserted against
+the exact same assembly the C++ scanner / generic parser produce, so a
+green run here means the device route is digest-identical by
+construction.
+"""
+
+import functools
+import json
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu.errors import InvalidArgumentError
+
+# real Delta logs are compact; the device kernel's patterns key on the
+# compact form, and anything else routes the window to the host parser
+_dumps = functools.partial(json.dumps, separators=(",", ":"))
+
+
+# --------------------------------------------------------------- helpers ----
+
+def _mk_log(tmp_path, commits):
+    """Write `commits` (list of list-of-json-lines) as a _delta_log dir."""
+    log = tmp_path / "_delta_log"
+    log.mkdir(exist_ok=True)
+    for v, lines in enumerate(commits):
+        (log / f"{v:020d}.json").write_text("\n".join(lines) + "\n")
+    return log
+
+
+def _columnarize(tmp_path, monkeypatch, route):
+    """Columnarize tmp_path's log with the parse route forced to
+    `route` ('force' = device, '0' = host)."""
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.log.segment import build_log_segment
+    from delta_tpu.replay.columnar import columnarize_log_segment
+
+    monkeypatch.setenv("DELTA_TPU_DEVICE_PARSE", route)
+    eng = HostEngine()
+    seg = build_log_segment(eng.fs, str(tmp_path / "_delta_log"))
+    return columnarize_log_segment(eng, seg)
+
+
+def _norm(t):
+    idx = pa.compute.sort_indices(
+        t, sort_keys=[("version", "ascending"), ("order", "ascending")])
+    return t.take(idx)
+
+
+_PROTO = '{"protocol":{"minReaderVersion":1,"minWriterVersion":2}}'
+_META = ('{"metaData":{"id":"m","format":{"provider":"parquet",'
+         '"options":{}},"schemaString":"{}","partitionColumns":[],'
+         '"configuration":{}}}')
+
+
+def _add(path, size=1, mod=1, dc=True, stats=None, extra=None):
+    a = {"path": path, "partitionValues": {}, "size": size,
+         "modificationTime": mod, "dataChange": dc}
+    if stats is not None:
+        a["stats"] = stats
+    if extra:
+        a.update(extra)
+    return _dumps({"add": a})
+
+
+def _buffer(commits):
+    """list-of-list-of-lines -> (buf, starts[n+1], versions) as
+    `_read_commits_buffer` would produce them."""
+    blobs = [("\n".join(lines) + "\n").encode() for lines in commits]
+    starts = np.zeros(len(blobs) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=starts[1:])
+    return b"".join(blobs), starts, np.arange(len(blobs), dtype=np.int64)
+
+
+# ------------------------------------------------- columnarize parity -------
+
+def test_device_parity_full_corpus(tmp_path, monkeypatch):
+    """Device route must be row-identical to the host route on a corpus
+    covering escapes, unicode, nested stats JSON, missing optionals,
+    booleans both ways, and control lines."""
+    commits = [
+        [_PROTO, _META,
+         _add("plain.parquet", size=10, mod=100,
+              stats='{"numRecords":5,"minValues":{"x":1}}'),
+         _dumps({"commitInfo": {"operation": "WRITE", "n": 0}})],
+        # escaped quotes + backslashes + solidus in the path
+        [_add('esc\\"q\\\\b\\/s.parquet', size=2, mod=2),
+         # unicode escapes incl. a surrogate pair
+         _add('caf\\u00e9\\ud83d\\ude00.parquet', size=3, mod=3)],
+        # stats is JSON-in-a-string with nested braces/quotes
+        [_add("nested.parquet", size=4, mod=4,
+              stats=_dumps({"numRecords": 2,
+                            "minValues": {"s": 'a"b{c}'},
+                            "nullCount": {"s": 0}}))],
+        # missing optionals: no stats, dataChange=false, a remove
+        [_add("nostats.parquet", size=5, mod=5, dc=False),
+         _dumps({"remove": {"path": "plain.parquet",
+                                "deletionTimestamp": 999,
+                                "dataChange": True,
+                                "extendedFileMetadata": False}})],
+        # remove without optional fields at all
+        [_dumps({"remove": {"path": "nostats.parquet",
+                                "dataChange": False}}),
+         _dumps({"commitInfo": {"operation": "DELETE"}})],
+    ]
+    _mk_log(tmp_path, commits)
+    from delta_tpu import obs
+
+    windows_before = obs.counter("parse.device_windows").value
+    col_dev = _columnarize(tmp_path, monkeypatch, "force")
+    # the corpus must actually take the device route — a silent host
+    # fallback would make this parity test vacuous
+    assert obs.counter("parse.device_windows").value > windows_before
+    col_host = _columnarize(tmp_path, monkeypatch, "0")
+
+    td, th = _norm(col_dev.file_actions_complete()), _norm(
+        col_host.file_actions_complete())
+    assert td.num_rows == th.num_rows
+    for name in td.column_names:
+        assert td.column(name).to_pylist() == th.column(name).to_pylist(), name
+    assert col_dev.protocol == col_host.protocol
+    assert col_dev.metadata == col_host.metadata
+    assert col_dev.commit_infos.keys() == col_host.commit_infos.keys()
+
+
+def test_device_parity_percent_encoded_and_long_ints(tmp_path, monkeypatch):
+    commits = [
+        [_PROTO, _META,
+         _add("a%20b%2Fc.parquet", size=2**53 + 111, mod=1700000000123),
+         _dumps({"remove": {"path": "a%20b%2Fc.parquet",
+                                "deletionTimestamp": 2**53 + 7,
+                                "dataChange": True}})],
+    ]
+    _mk_log(tmp_path, commits)
+    col_dev = _columnarize(tmp_path, monkeypatch, "force")
+    col_host = _columnarize(tmp_path, monkeypatch, "0")
+    td, th = _norm(col_dev.file_actions_complete()), _norm(
+        col_host.file_actions_complete())
+    for name in ("path", "size", "modification_time", "deletion_timestamp"):
+        assert td.column(name).to_pylist() == th.column(name).to_pylist(), name
+
+
+# --------------------------------------------- direct window-level API ------
+
+def test_parse_commits_device_basic():
+    from delta_tpu.replay.device_parse import parse_commits_device
+
+    buf, starts, versions = _buffer([
+        [_add("x.parquet", size=7, mod=70, stats='{"numRecords":1}')],
+        [_dumps({"remove": {"path": "x.parquet",
+                                "deletionTimestamp": 5,
+                                "dataChange": True}})],
+    ])
+    out = parse_commits_device(buf, starts, versions)
+    assert out is not None
+    table = out[0]
+    assert table.num_rows == 2
+    assert table.column("path").to_pylist() == ["x.parquet", "x.parquet"]
+    assert table.column("is_add").to_pylist() == [True, False]
+    assert table.column("size").to_pylist() == [7, None]
+    assert table.column("deletion_timestamp").to_pylist() == [None, 5]
+
+
+def test_dv_line_falls_back_whole_window():
+    """A deletionVector sub-object makes the line complex; digest parity
+    requires the WHOLE window to take the host route (None here)."""
+    from delta_tpu import obs
+    from delta_tpu.replay.device_parse import parse_commits_device
+
+    before = obs.counter("parse.device_fallbacks").value
+    buf, starts, versions = _buffer([
+        [_add("p.parquet"),
+         _add("q.parquet", extra={"deletionVector": {
+             "storageType": "u", "pathOrInlineDv": "ab", "offset": 1,
+             "sizeInBytes": 40, "cardinality": 2}})],
+    ])
+    assert parse_commits_device(buf, starts, versions) is None
+    assert obs.counter("parse.device_fallbacks").value == before + 1
+
+
+def test_corrupt_window_falls_back():
+    from delta_tpu.replay.device_parse import parse_commits_device
+
+    buf, starts, versions = _buffer([['{"add":{"path": broken']])
+    assert parse_commits_device(buf, starts, versions) is None
+
+
+def test_whitespace_file_action_falls_back():
+    """A legal-but-spaced add line doesn't match the compact-form
+    patterns; treating it as a control line would silently drop a file
+    action, so the window must route to the host parser instead."""
+    from delta_tpu.replay.device_parse import parse_commits_device
+
+    spaced = json.dumps(
+        {"add": {"path": "s.parquet", "partitionValues": {}, "size": 1,
+                 "modificationTime": 1, "dataChange": True}})
+    assert ": " in spaced  # default separators keep the space
+    buf, starts, versions = _buffer([[_add("ok.parquet"), spaced]])
+    assert parse_commits_device(buf, starts, versions) is None
+
+
+def test_window_eligible_2gb_guard():
+    from delta_tpu.ops.json_parse import MAX_WINDOW_BYTES, window_eligible
+
+    assert window_eligible(1)
+    assert window_eligible(MAX_WINDOW_BYTES - 1)
+    assert not window_eligible(MAX_WINDOW_BYTES)  # offsets must fit int32
+    assert not window_eligible(1 << 31)
+    assert not window_eligible(0)
+
+
+def test_parse_route_env_and_economics(monkeypatch):
+    from delta_tpu.parallel import gate
+
+    monkeypatch.delenv("DELTA_TPU_DEVICE_PARSE", raising=False)
+    # engine not opted in -> host regardless of size
+    assert gate.parse_route(1 << 30, engine_enabled=False) == "host"
+    # env force outranks everything
+    monkeypatch.setenv("DELTA_TPU_DEVICE_PARSE", "force")
+    assert gate.parse_route(0, engine_enabled=False) == "device"
+    monkeypatch.setenv("DELTA_TPU_DEVICE_PARSE", "off")
+    assert gate.parse_route(1 << 30, engine_enabled=True) == "host"
+
+
+# ------------------------------------------------- device DV decode ---------
+
+def _mask_parity(vals, n):
+    from delta_tpu.dv.roaring import RoaringBitmapArray, decode_delta_mask
+
+    bm = RoaringBitmapArray(np.asarray(vals, np.uint64))
+    out = decode_delta_mask(bm.serialize_delta(), n)
+    assert out is not None
+    mask, card = out
+    assert np.array_equal(mask, bm.to_mask(n))
+    assert card == bm.cardinality
+    return mask
+
+
+def test_dv_decode_array_bitmap_parity(monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_DEVICE_DV_DECODE", "1")
+    rng = np.random.default_rng(3)
+    # array containers (sparse)
+    _mask_parity(rng.choice(100000, 500, replace=False), 100000)
+    # bitmap container (dense)
+    _mask_parity(rng.choice(70000, 20000, replace=False), 70000)
+    # mixed containers across several 16-bit keys
+    vals = np.concatenate([
+        rng.choice(65536, 64, replace=False).astype(np.uint64),
+        rng.choice(65536, 8000, replace=False).astype(np.uint64) + (1 << 16),
+        rng.choice(65536, 10, replace=False).astype(np.uint64) + (5 << 16),
+    ])
+    _mask_parity(vals, 1 << 20)
+    # rows beyond n: mask truncates, cardinality still counts them
+    _mask_parity([1, 5, 99, 150, 200], 100)
+    # empty
+    _mask_parity([], 64)
+
+
+def test_dv_decode_run_container_parity(monkeypatch):
+    """Hand-built run-container blob (our serializer never emits runs,
+    Spark's does)."""
+    monkeypatch.setenv("DELTA_TPU_DEVICE_DV_DECODE", "1")
+    from delta_tpu.dv.roaring import (DELTA_MAGIC, RoaringBitmapArray,
+                                      decode_delta_mask)
+
+    runs = [(10, 5), (100, 3), (40000, 100)]
+    body = bytearray()
+    body += struct.pack("<HH", 12347, 0)  # run cookie, (n-1)=0 containers
+    body += bytes([1])  # run-flag bitset: container 0 is a run container
+    card = sum(l for _, l in runs)
+    body += struct.pack("<HH", 0, card - 1)
+    body += struct.pack("<H", len(runs))  # no offsets (< 4 containers)
+    for start, length in runs:
+        body += struct.pack("<HH", start, length - 1)
+    blob = (struct.pack("<i", DELTA_MAGIC) + struct.pack("<q", 1)
+            + struct.pack("<I", 0) + bytes(body))
+
+    bm = RoaringBitmapArray.deserialize_delta(blob)
+    out = decode_delta_mask(blob, 65536)
+    assert out is not None
+    mask, dcard = out
+    assert np.array_equal(mask, bm.to_mask(65536))
+    assert dcard == bm.cardinality == card
+
+
+def test_dv_decode_gate_off_and_high_bucket(monkeypatch):
+    from delta_tpu.dv.roaring import RoaringBitmapArray, decode_delta_mask
+
+    blob = RoaringBitmapArray(np.array([1, 2, 3], np.uint64)).serialize_delta()
+    monkeypatch.delenv("DELTA_TPU_DEVICE_DV_DECODE", raising=False)
+    assert decode_delta_mask(blob, 10) is None  # gate off
+    monkeypatch.setenv("DELTA_TPU_DEVICE_DV_DECODE", "1")
+    # >2^32 address space exceeds _MAX_DECODE_WORDS -> host fallback
+    hi = RoaringBitmapArray(np.array([3, 1 << 33], np.uint64))
+    assert decode_delta_mask(hi.serialize_delta(), 100) is None
+
+
+def test_load_deletion_vector_mask_routes(tmp_path, monkeypatch):
+    """Descriptor-level mask API: identical masks whichever route runs,
+    and the declared-cardinality check fires on both."""
+    from delta_tpu.dv.descriptor import (inline_descriptor,
+                                         load_deletion_vector_mask)
+    from delta_tpu.dv.roaring import RoaringBitmapArray
+    from delta_tpu.errors import DeletionVectorError
+
+    bm = RoaringBitmapArray(np.array([0, 3, 9, 40000], np.uint64))
+    row = inline_descriptor(bm).to_dict()
+
+    monkeypatch.delenv("DELTA_TPU_DEVICE_DV_DECODE", raising=False)
+    host = load_deletion_vector_mask(None, "/t", row, 50000)
+    monkeypatch.setenv("DELTA_TPU_DEVICE_DV_DECODE", "1")
+    dev = load_deletion_vector_mask(None, "/t", row, 50000)
+    assert np.array_equal(host, dev)
+    assert host.sum() == 4 and host[3] and host[40000]
+
+    bad = dict(row, cardinality=17)
+    for env in ("0", "1"):
+        monkeypatch.setenv("DELTA_TPU_DEVICE_DV_DECODE", env)
+        with pytest.raises(DeletionVectorError):
+            load_deletion_vector_mask(None, "/t", bad, 50000)
+
+
+# ------------------------------------------------- bit-width guards ---------
+
+def test_unpack_width_guards():
+    from delta_tpu.ops.pallas_kernels import unpack_bitpacked
+
+    words = np.zeros(4, np.uint32)
+    with pytest.raises(InvalidArgumentError):
+        unpack_bitpacked(words, 33, 1)
+    with pytest.raises(InvalidArgumentError):
+        unpack_bitpacked(words, -1, 1)
+    with pytest.raises(InvalidArgumentError):
+        unpack_bitpacked(words, "8", 1)
+    # w=0 stays legal at this layer (all-zero groups)
+    assert np.asarray(unpack_bitpacked(np.zeros(0, np.uint32), 0, 1)).sum() == 0
+
+
+def test_hybrid_width_guard_surfaces_decode_error():
+    from delta_tpu.log.page_decode import DecodeUnsupported, parse_hybrid
+
+    with pytest.raises(DecodeUnsupported):
+        parse_hybrid(b"\x00" * 8, 0, 33, 4)
+    with pytest.raises(DecodeUnsupported):
+        parse_hybrid(b"\x00" * 8, 0, -2, 4)
