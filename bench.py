@@ -1104,6 +1104,119 @@ def chaos_recovery_metric() -> None:
     }))
 
 
+def contended_commits_metric() -> None:
+    """Multi-writer commit throughput, solo vs group commit, under an
+    injected ~2ms storage round trip (every op sleeps, so the number
+    tracks round trips — the thing batching amortizes — not Python
+    speed). W writers each push a fixed number of commits at one table;
+    solo mode pays one conflict check + one arbiter round trip per
+    commit (plus rebase re-reads under contention), batched mode rides
+    `DELTA_TPU_GROUP_COMMIT` so a burst shares ONE snapshot read and
+    ONE claim. Gate (ISSUE 13): at 8+ writers batched must beat solo."""
+    import threading
+
+    import pyarrow as pa
+
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.models.actions import AddFile
+    from delta_tpu.resilience import (ChaosSchedule, ChaosStore,
+                                      reset as resilience_reset)
+    from delta_tpu.storage.logstore import InMemoryLogStore
+    from delta_tpu.table import Table
+
+    import delta_tpu.api as dta
+
+    per_writer = int(os.environ.get("BENCH_CONTENDED_COMMITS", 3))
+    rtt_s = float(os.environ.get("BENCH_CONTENDED_RTT_MS", 2.0)) / 1000.0
+
+    def run(n_writers: int, batched: bool) -> float:
+        store = ChaosStore(
+            InMemoryLogStore(),
+            ChaosSchedule(seed=7, latency_rate=1.0,
+                          latency_s=(rtt_s, rtt_s)),
+            sleep=time.sleep)
+        eng = HostEngine(store_resolver=lambda p: store)
+        mode = "batched" if batched else "solo"
+        path = f"memory://bench-contended-{mode}-{n_writers}/tbl"
+        store.enabled = False  # setup at full speed
+        dta.write_table(path, pa.table({"x": pa.array([0], pa.int64())}),
+                        engine=eng)
+        table = Table.for_path(path, eng)
+        store.enabled = True
+        errors: list = []
+
+        def writer(wid: int) -> None:
+            try:
+                for i in range(per_writer):
+                    txn = table.start_transaction()
+                    txn.add_file(AddFile(
+                        path=f"w{wid}-{i}.parquet", partitionValues={},
+                        size=100, modificationTime=1, dataChange=True))
+                    txn.commit()
+            except Exception as e:  # pragma: no cover - surfaces below
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(n_writers)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        elapsed = time.perf_counter() - t0
+        assert not errors, f"contended bench writer failed: {errors}"
+        store.enabled = False
+        total = n_writers * per_writer
+        assert table.latest_snapshot().version == total, \
+            "contended bench lost a commit"
+        return total / elapsed
+
+    overrides = {"DELTA_TPU_RETRY_BASE_MS": "1",
+                 "DELTA_TPU_RETRY_CAP_MS": "5"}
+    saved = {k: os.environ.get(k)
+             for k in (*overrides, "DELTA_TPU_GROUP_COMMIT",
+                       "DELTA_TPU_GROUP_COMMIT_WINDOW_MS")}
+    os.environ.update(overrides)
+    resilience_reset()
+    results = {}
+    try:
+        for n_writers in (2, 8, 32):
+            os.environ.pop("DELTA_TPU_GROUP_COMMIT", None)
+            solo = run(n_writers, batched=False)
+            os.environ["DELTA_TPU_GROUP_COMMIT"] = "1"
+            os.environ["DELTA_TPU_GROUP_COMMIT_WINDOW_MS"] = "4"
+            grouped = run(n_writers, batched=True)
+            results[n_writers] = (solo, grouped)
+            print(f"contended commits @{n_writers} writers x "
+                  f"{per_writer}: solo {solo:.0f}/s, "
+                  f"batched {grouped:.0f}/s "
+                  f"({grouped / solo:.2f}x)", file=sys.stderr)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience_reset()
+
+    solo8, grouped8 = results[8]
+    if grouped8 <= solo8:
+        print(f"CONTENDED REGRESSION: batched ({grouped8:.0f}/s) did "
+              f"not beat solo ({solo8:.0f}/s) at 8 writers",
+              file=sys.stderr)
+    # secondary metric line (the driver reads the LAST line only)
+    print(json.dumps({
+        "metric": "contended_commits_per_sec",
+        "value": round(grouped8, 1),
+        "unit": "commits/s",
+        "writers": 8,
+        "vs_solo": round(grouped8 / solo8, 3),
+        "by_writers": {str(w): {"solo": round(s, 1),
+                                "batched": round(g, 1)}
+                       for w, (s, g) in results.items()},
+    }))
+
+
 def serve_metrics() -> None:
     """Multi-tenant snapshot service under load: N clients x M tables
     against `DeltaServeServer` — once clean, once with the full
@@ -1500,6 +1613,7 @@ def main():
     trace_overhead_metric(workdir)
     retry_overhead_metric(workdir)
     chaos_recovery_metric()
+    contended_commits_metric()
     serve_metrics()
     checkpoint_read_metric(workdir)
     checkpoint_write_metric(workdir)

@@ -66,6 +66,26 @@ class CommitCoordinatorClient:
         CommitFailedException(conflict=True) if the version was taken."""
         raise NotImplementedError
 
+    def commit_batch(
+        self,
+        log_path: str,
+        commits: List[tuple],
+        commit_timestamp: int,
+    ) -> List[Commit]:
+        """Atomically register several consecutive ``(version, data)``
+        commits (the group-commit emit). Default: sequential
+        :meth:`commit` calls that stop at the first failure — the
+        accepted prefix stays registered, so on
+        ``CommitFailedException`` the caller must resolve each member's
+        fate by read-back. Coordinators with a native batch op override
+        this with all-or-nothing semantics (both shapes are legal under
+        the same caller contract)."""
+        out = []
+        for version, data in commits:
+            out.append(self.commit(log_path, version, data,
+                                   commit_timestamp))
+        return out
+
     def get_commits(
         self, log_path: str, start_version: Optional[int] = None,
         end_version: Optional[int] = None,
@@ -134,6 +154,44 @@ class InMemoryCommitCoordinator(CommitCoordinatorClient):
         if version % self.batch_size == 0:
             self.backfill_to_version(log_path, version)
         return commit
+
+    def commit_batch(self, log_path, commits, commit_timestamp) -> List[Commit]:
+        """All-or-nothing batched registration: one lock hold covers
+        validation and every member, so concurrent solo committers and
+        other batches serialize against the whole batch (no
+        interleaving inside it)."""
+        commits = list(commits)
+        if not commits:
+            return []
+        st = self._state(log_path)
+        accepted: List[Commit] = []
+        with st.lock:
+            expected = st.latest + 1
+            versions = [v for v, _ in commits]
+            if versions != list(range(versions[0], versions[0] + len(versions))):
+                raise CommitFailedException(
+                    f"batch versions not consecutive: {versions}",
+                    retryable=False, conflict=False)
+            if versions[0] != expected:
+                raise CommitFailedException(
+                    f"batch commit version {versions[0]} rejected; "
+                    f"expected {expected}",
+                    retryable=True,
+                    conflict=versions[0] > expected
+                    or versions[0] <= st.latest,
+                )
+            for version, data in commits:
+                path = filenames.unbackfilled_delta_file(log_path, version)
+                store = logstore_for_path(path)
+                store.write(path, data, overwrite=False)
+                fstat = store.file_status(path)
+                commit = Commit(version, fstat, commit_timestamp)
+                st.commits[version] = commit
+                st.latest = version
+                accepted.append(commit)
+        if any(c.version % self.batch_size == 0 for c in accepted):
+            self.backfill_to_version(log_path, accepted[-1].version)
+        return accepted
 
     def get_commits(self, log_path, start_version=None, end_version=None) -> GetCommitsResponse:
         st = self._state(log_path)

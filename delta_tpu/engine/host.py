@@ -69,6 +69,29 @@ class HostJsonHandler(JsonHandler):
         _WRITE_CALLS.inc()
         _WRITE_BYTES.inc(len(data))
 
+    def write_json_files_atomically(self, items,
+                                    overwrite: bool = False) -> None:
+        """Batched put-if-absent for the group-commit emit: one
+        breaker-scoped `io_call` covers the whole batch, and stores
+        with a batch protocol (`LogStore.write_batch` — the external
+        arbiter claims every version in one round trip) get the items
+        together. On failure the already-written prefix stays durable
+        (the store contract), so the caller must resolve member fates
+        by read-back rather than resubmitting."""
+        items = list(items)
+        if not items:
+            return
+        first = items[0][0]
+        store = self._store_for(first)
+        total = sum(len(d) for _, d in items)
+        with obs.span("storage.commit_write_batch", path=first,
+                      members=len(items), bytes=total,
+                      overwrite=overwrite):
+            io_call(endpoint_of(first),
+                    lambda: store.write_batch(items, overwrite=overwrite))
+        _WRITE_CALLS.inc(len(items))
+        _WRITE_BYTES.inc(total)
+
 
 class HostParquetHandler(ParquetHandler):
     def __init__(self, store_resolver=logstore_for_path):

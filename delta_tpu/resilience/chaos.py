@@ -189,6 +189,35 @@ class ChaosStore(DelegatingLogStore):
             raise ChaosError(
                 f"chaos[{s.seed}]: write ack lost after landing: {path}")
 
+    def write_batch(self, items, overwrite: bool = False) -> None:
+        """Batched commit emit under chaos. Two fault shapes:
+
+        - a pre-op transient error (nothing landed, retry safe);
+        - a **partial-batch ack loss**: a prefix of 1..n members lands
+          durably in the inner store, then the response is lost. The
+          group-commit emitter must resolve every member's fate by
+          read-back (txnId compare) — exactly the per-member analogue
+          of the solo self-commit recovery.
+        """
+        items = list(items)
+        if not items:
+            return
+        first = items[0][0]
+        self._perturb("write_batch", first)
+        s = self.schedule
+        if (self.enabled and s.ack_loss_rate and self.path_filter(first)
+                and self.ack_pred(first) and s.draw() < s.ack_loss_rate):
+            # land a non-empty prefix, then lose the ack. draw() < 1.0
+            # strictly, so k is always in [1, len(items)].
+            k = 1 + int(s.draw() * len(items))
+            self._record("batch_ack_loss", "write_batch", first)
+            _CHAOS_ACK_LOSS.inc()
+            self.inner.write_batch(items[:k], overwrite=overwrite)
+            raise ChaosError(
+                f"chaos[{s.seed}]: batch ack lost after {k}/{len(items)} "
+                f"members landed: {first}")
+        self.inner.write_batch(items, overwrite=overwrite)
+
     def list_from(self, path: str) -> Iterator[FileStatus]:
         self._perturb("list_from", path)
         entries = list(self.inner.list_from(path))

@@ -83,9 +83,17 @@ def classify(exc: BaseException) -> str:
 def is_transient(exc: BaseException) -> bool:
     # Explicit override wins: anything carrying retryable=True was
     # classified at the raise site (CommitFailedError /
-    # CommitFailedException both use this spelling).
+    # CommitFailedException both use this spelling). One carve-out:
+    # a coordinator commit CONFLICT is a protocol answer — the version
+    # was taken — exactly like FileExistsError on the logstore path.
+    # It must surface to the conflict machinery immediately, never be
+    # absorbed by an IO retry loop re-attempting the same version
+    # (coordinators mark conflicts retryable=True meaning "retry at a
+    # NEW version", which is the txn layer's job, not the policy's).
     retryable = getattr(exc, "retryable", None)
     if retryable is not None:
+        if getattr(exc, "conflict", False):
+            return False
         return bool(retryable)
 
     from delta_tpu.errors import DeltaError

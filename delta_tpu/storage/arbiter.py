@@ -102,6 +102,29 @@ class SqliteCommitArbiter(CommitArbiter):
             except sqlite3.IntegrityError:
                 raise FileAlreadyExistsError(entry.file_name)
 
+    def put_entries(self, entries, overwrite: bool = False) -> int:
+        """All-or-nothing conditional multi-put: one transaction, so a
+        batch either claims every version or none (TransactWriteItems
+        semantics). Returns len(entries) on success, 0 on any key
+        collision — never a partial count."""
+        entries = list(entries)
+        if not entries:
+            return 0
+        rows = [(e.table_path, e.file_name, e.temp_path,
+                 int(e.complete), e.expire_time) for e in entries]
+        sql = ("INSERT OR REPLACE INTO commit_entries VALUES (?, ?, ?, ?, ?)"
+               if overwrite else
+               "INSERT INTO commit_entries VALUES (?, ?, ?, ?, ?)")
+        # IntegrityError is caught OUTSIDE the `with conn` block: the
+        # context manager must see the exception so it rolls back the
+        # already-inserted prefix of the executemany.
+        try:
+            with closing(self._connect()) as conn, conn:
+                conn.executemany(sql, rows)
+        except sqlite3.IntegrityError:
+            return 0
+        return len(rows)
+
     def get_entry(self, table_path: str,
                   file_name: str) -> Optional[ExternalCommitEntry]:
         with closing(self._connect()) as conn, conn:
@@ -121,6 +144,15 @@ class SqliteCommitArbiter(CommitArbiter):
                 "ORDER BY file_name DESC LIMIT 1", (table_path,))
             row = cur.fetchone()
         return self._row_to_entry(row)
+
+    def get_incomplete_entries(self, table_path: str):
+        with closing(self._connect()) as conn, conn:
+            cur = conn.execute(
+                "SELECT table_path, file_name, temp_path, complete, "
+                "expire_time FROM commit_entries WHERE table_path = ? "
+                "AND complete = 0 ORDER BY file_name ASC", (table_path,))
+            rows = cur.fetchall()
+        return [self._row_to_entry(r) for r in rows]
 
     @staticmethod
     def _row_to_entry(row) -> Optional[ExternalCommitEntry]:

@@ -394,6 +394,30 @@ class CommitArbiter:
         for (table_path, file_name) exists and overwrite is False."""
         raise NotImplementedError
 
+    def put_entries(self, entries: List[ExternalCommitEntry],
+                    overwrite: bool = False) -> int:
+        """Conditional put of several version-consecutive entries, in
+        order; returns how many were claimed. Two legal shapes, both
+        satisfying the batched-write recovery contract
+        (`ExternalArbiterLogStore.write_batch`):
+
+        - **ordered prefix** (this default): claims stop at the first
+          existing entry, so a partial claim is always a version-
+          consecutive prefix — every claimed member's base versions are
+          claimed too, and recovery can complete exactly the prefix.
+        - **all-or-nothing** (sqlite transaction, DynamoDB
+          TransactWriteItems): returns 0 or len(entries) — one
+          conditional round trip, never a partial claim.
+        """
+        claimed = 0
+        for e in entries:
+            try:
+                self.put_entry(e, overwrite)
+            except FileAlreadyExistsError:
+                return claimed
+            claimed += 1
+        return claimed
+
     def get_entry(self, table_path: str,
                   file_name: str) -> Optional[ExternalCommitEntry]:
         raise NotImplementedError
@@ -401,6 +425,19 @@ class CommitArbiter:
     def get_latest_entry(self,
                          table_path: str) -> Optional[ExternalCommitEntry]:
         raise NotImplementedError
+
+    def get_incomplete_entries(
+            self, table_path: str) -> List[ExternalCommitEntry]:
+        """Every incomplete entry for the table, ascending by file
+        name. The solo protocol leaves at most ONE (the latest); a
+        batched writer SIGKILLed mid-batch leaves several consecutive
+        ones, and recovery must fix them all — completing only the
+        latest would surface version N+k while N..N+k-1 stay missing.
+        The default derives from `get_latest_entry` (correct for
+        arbiters that only ever see solo writes); batch-capable
+        arbiters override with a real scan."""
+        e = self.get_latest_entry(table_path)
+        return [e] if e is not None and not e.complete else []
 
 
 class InMemoryCommitArbiter(CommitArbiter):
@@ -430,6 +467,25 @@ class InMemoryCommitArbiter(CommitArbiter):
         if not rows:
             return None
         return max(rows, key=lambda e: e.file_name)
+
+    def put_entries(self, entries, overwrite=False) -> int:
+        # all-or-nothing under one lock hold (the TransactWriteItems
+        # shape): either every version is claimed or none is
+        entries = list(entries)
+        with self._lock:
+            if not overwrite:
+                for e in entries:
+                    if (e.table_path, e.file_name) in self._rows:
+                        return 0
+            for e in entries:
+                self._rows[(e.table_path, e.file_name)] = e
+        return len(entries)
+
+    def get_incomplete_entries(self, table_path):
+        with self._lock:
+            rows = [e for (tp, _), e in self._rows.items()
+                    if tp == table_path and not e.complete]
+        return sorted(rows, key=lambda e: e.file_name)
 
 
 def _is_delta_file(name: str) -> bool:
@@ -526,13 +582,34 @@ class ExternalArbiterLogStore(DelegatingLogStore):
         finally:
             lk.release()
 
+    def recover_all_incomplete(self, table_path: str,
+                               below: Optional[str] = None) -> int:
+        """Complete EVERY incomplete arbiter entry, ascending. The solo
+        protocol leaves at most one half commit; a batched writer
+        SIGKILLed mid-copy leaves a consecutive run of them, and they
+        must be fixed lowest-first or readers would see a gapped log.
+        Returns the number of entries fixed.
+
+        ``below`` (a file name) restricts recovery to entries strictly
+        below it. Writers pass their own attempt version: they already
+        hold that version's path lock, so fixing a foreign claim AT the
+        attempt version would self-deadlock — and it is pointless, the
+        foreign claim defeats the attempt at the conditional put anyway
+        and the post-failure refresh (``list_from``, which holds no
+        path lock) recovers it."""
+        fixed = 0
+        for entry in self.arbiter.get_incomplete_entries(table_path):
+            if below is not None and entry.file_name >= below:
+                continue
+            self.fix_delta_log(entry)
+            fixed += 1
+        return fixed
+
     # -- LogStore surface ------------------------------------------------
 
     def list_from(self, path: str) -> Iterator[FileStatus]:
         if self._is_delta_log_path(path):
-            entry = self.arbiter.get_latest_entry(self._table_path(path))
-            if entry is not None and not entry.complete:
-                self.fix_delta_log(entry)
+            self.recover_all_incomplete(self._table_path(path))
         return self.inner.list_from(path)
 
     def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
@@ -559,8 +636,10 @@ class ExternalArbiterLogStore(DelegatingLogStore):
                     prev_entry = self.arbiter.get_entry(table_path, prev_name)
                     prev_path = f"{table_path}/_delta_log/{prev_name}"
                     if prev_entry is not None and not prev_entry.complete:
+                        # a crashed BATCH may have left earlier half
+                        # commits too; fix the whole run, not just N-1
                         sp.add_event("recover_previous", path=prev_path)
-                        self.fix_delta_log(prev_entry)
+                        self.recover_all_incomplete(table_path, below=name)
                     elif not self.inner.exists(prev_path):
                         raise FileNotFoundError(
                             f"previous commit {prev_path} does not exist")
@@ -587,6 +666,111 @@ class ExternalArbiterLogStore(DelegatingLogStore):
                     sp.set_attr("deferred_recovery", True)
                     _log.warning("commit %s prepared but copy/ack failed "
                                  "(%s); recovery via fix_delta_log", path, e)
+        finally:
+            lk.release()
+
+    def write_batch(self, items, overwrite: bool = False) -> None:
+        """Commit several consecutive versions with ONE arbiter round
+        trip (the group-commit emit path). The batched generalization
+        of `write`:
+
+        - Step 0: fail fast if the first target is already visible.
+        - Step 1: recover/verify version N-1, fixing ALL incomplete
+          entries (a previously crashed batch leaves a run of them).
+        - Step 2: PREPARE — write every member's temp file (durable),
+          then claim every version with one conditional multi-put
+          (`CommitArbiter.put_entries`).
+        - Step 3: COMMIT — copy temps into place, ascending.
+        - Step 4: ACKNOWLEDGE each claimed entry.
+
+        Crash semantics: before the claim lands nothing is visible and
+        the batch is cleanly abandoned (garbage temps only). After the
+        claim, every claimed member has a durable temp, so ANY later
+        reader or writer completes the run via `fix_delta_log` —
+        recovery either completes the claimed prefix or the batch never
+        existed; a partially-durable batch is never stranded.
+
+        Raises FileAlreadyExistsError naming the first unclaimed member
+        when the claim lost a race. With an ordered-prefix arbiter the
+        claimed prefix still lands (callers resolve member fates by
+        read-back); with an all-or-nothing arbiter nothing landed.
+        """
+        items = list(items)
+        if overwrite or len(items) <= 1:
+            for path, data in items:
+                self.write(path, data, overwrite=overwrite)
+            return
+        names = [p.rpartition("/")[2] for p, _ in items]
+        if not all(self._is_delta_log_path(p) and _is_delta_file(n)
+                   for (p, _), n in zip(items, names)):
+            raise ValueError("write_batch requires _delta_log commit files")
+        versions = [int(n.split(".")[0]) for n in names]
+        if versions != list(range(versions[0], versions[0] + len(items))):
+            raise ValueError(f"batch versions not consecutive: {versions}")
+        table_path = self._table_path(items[0][0])
+        if any(self._table_path(p) != table_path for p, _ in items):
+            raise ValueError("batch spans multiple tables")
+        first_path = items[0][0]
+        lk = self._path_locks.acquire(first_path)
+        try:
+            with obs.span("storage.arbiter.write_batch", path=first_path,
+                          members=len(items),
+                          bytes=sum(len(d) for _, d in items)) as sp:
+                # Step 0: fail fast if N.json is already visible
+                if self.inner.exists(first_path):
+                    raise FileAlreadyExistsError(first_path)
+                version = versions[0]
+                # Step 1: ensure N-1.json exists (recover half commits)
+                if version > 0:
+                    prev_name = f"{version - 1:020d}.json"
+                    prev_entry = self.arbiter.get_entry(table_path,
+                                                        prev_name)
+                    prev_path = f"{table_path}/_delta_log/{prev_name}"
+                    if prev_entry is not None and not prev_entry.complete:
+                        sp.add_event("recover_previous", path=prev_path)
+                        self.recover_all_incomplete(table_path,
+                                                    below=names[0])
+                    elif not self.inner.exists(prev_path):
+                        raise FileNotFoundError(
+                            f"previous commit {prev_path} does not exist")
+                # Step 2: PREPARE — all temps first (durable before any
+                # claim exists), then ONE conditional multi-put
+                entries = []
+                for (path, data), name in zip(items, names):
+                    temp_rel = f"_delta_log/.tmp/{name}.{uuid.uuid4().hex}"
+                    entry = ExternalCommitEntry(table_path, name, temp_rel,
+                                                complete=False)
+                    self.inner.write(entry.absolute_temp_path(), data,
+                                     overwrite=True)
+                    entries.append(entry)
+                sp.add_event("prepare", members=len(entries))
+                claimed = self.arbiter.put_entries(entries, overwrite=False)
+                sp.set_attr("claimed", claimed)
+                if claimed == 0:
+                    # lost the race outright; nothing of ours landed
+                    raise FileAlreadyExistsError(first_path)
+                try:
+                    # Steps 3+4: copy ascending, then acknowledge. A
+                    # crash anywhere in here leaves claimed entries
+                    # with durable temps — recoverable by anyone.
+                    for entry, (path, _) in zip(entries[:claimed], items):
+                        self._write_copy_temp_file(
+                            entry.absolute_temp_path(), path)
+                    sp.add_event("commit")
+                    for entry in entries[:claimed]:
+                        self._write_put_complete_entry(entry)
+                    sp.add_event("acknowledge")
+                except Exception as e:
+                    sp.set_attr("deferred_recovery", True)
+                    _log.warning(
+                        "batch %s..%s claimed but copy/ack failed (%s); "
+                        "recovery via fix_delta_log", names[0],
+                        names[claimed - 1], e)
+                if claimed < len(entries):
+                    # ordered-prefix arbiter: the prefix is ours (and
+                    # durable); the rest lost. Callers resolve member
+                    # fates by read-back on this error.
+                    raise FileAlreadyExistsError(items[claimed][0])
         finally:
             lk.release()
 

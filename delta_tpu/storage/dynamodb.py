@@ -196,6 +196,49 @@ class DynamoDbClient:
         items = out.get("Items") or []
         return items[0] if items else None
 
+    def transact_write_puts(self, table: str, items,
+                            condition_expression: Optional[str] = None
+                            ) -> None:
+        """All-or-nothing multi-put via TransactWriteItems: every item
+        lands or none does, with the same condition applied per item
+        (the batched spelling of the conditional PutItem)."""
+        puts = []
+        for item in items:
+            put: Dict[str, object] = {"TableName": table, "Item": item}
+            if condition_expression:
+                put["ConditionExpression"] = condition_expression
+            puts.append({"Put": put})
+        self._call("TransactWriteItems", {"TransactItems": puts})
+
+    def query_partition(self, table: str, hash_name: str, hash_value: str,
+                        filter_expression: Optional[str] = None,
+                        expr_names: Optional[Dict[str, str]] = None,
+                        expr_values: Optional[Dict[str, dict]] = None):
+        """All items for a partition key, ascending by sort key,
+        consistent, paginated through LastEvaluatedKey."""
+        values = {":tp": {"S": hash_value}}
+        if expr_values:
+            values.update(expr_values)
+        payload: Dict[str, object] = {
+            "TableName": table,
+            "KeyConditionExpression": f"{hash_name} = :tp",
+            "ExpressionAttributeValues": values,
+            "ScanIndexForward": True,
+            "ConsistentRead": True,
+        }
+        if filter_expression:
+            payload["FilterExpression"] = filter_expression
+        if expr_names:
+            payload["ExpressionAttributeNames"] = expr_names
+        items = []
+        while True:
+            out = self._call("Query", payload)
+            items.extend(out.get("Items") or [])
+            last = out.get("LastEvaluatedKey")
+            if not last:
+                return items
+            payload["ExclusiveStartKey"] = last
+
     def describe_table(self, table: str) -> dict:
         return self._call("DescribeTable", {"TableName": table})
 
@@ -320,6 +363,33 @@ class DynamoDbCommitArbiter(CommitArbiter):
                 raise FileAlreadyExistsError(entry.file_name)
             raise
 
+    def put_entries(self, entries, overwrite: bool = False) -> int:
+        """All-or-nothing batch claim via TransactWriteItems. Returns
+        len(entries) when every member's conditional put succeeded, 0
+        when the transaction was cancelled by any condition failure —
+        DynamoDB transactions never partially apply."""
+        entries = list(entries)
+        if not entries:
+            return 0
+        if len(entries) == 1:
+            try:
+                self.put_entry(entries[0], overwrite=overwrite)
+            except FileAlreadyExistsError:
+                return 0
+            return 1
+        cond = None if overwrite else \
+            f"attribute_not_exists({ATTR_FILE_NAME})"
+        try:
+            self.client.transact_write_puts(
+                self.table_name, [self._to_item(e) for e in entries],
+                condition_expression=cond)
+        except DynamoDbError as e:
+            if e.error_type in ("TransactionCanceledException",
+                                "ConditionalCheckFailedException"):
+                return 0
+            raise
+        return len(entries)
+
     def get_entry(self, table_path: str,
                   file_name: str) -> Optional[ExternalCommitEntry]:
         return self._from_item(self.client.get_item(self.table_name, {
@@ -331,6 +401,16 @@ class DynamoDbCommitArbiter(CommitArbiter):
             self, table_path: str) -> Optional[ExternalCommitEntry]:
         return self._from_item(self.client.query_latest(
             self.table_name, ATTR_TABLE_PATH, table_path))
+
+    def get_incomplete_entries(self, table_path: str):
+        # `complete` is a reserved-ish attribute name; alias it to be
+        # safe with the expression grammar.
+        items = self.client.query_partition(
+            self.table_name, ATTR_TABLE_PATH, table_path,
+            filter_expression="#c = :f",
+            expr_names={"#c": ATTR_COMPLETE},
+            expr_values={":f": {"S": "false"}})
+        return [self._from_item(i) for i in items]
 
 
 def dynamodb_arbiter_store(

@@ -61,6 +61,17 @@ class LogStore:
         (no partial file visible)."""
         raise NotImplementedError
 
+    def write_batch(self, items, overwrite: bool = False) -> None:
+        """Write several `(path, data)` pairs in order. Default: one
+        `write` per item, stopping at the first failure — the already-
+        written prefix stays durable, so a caller that sees an error
+        must resolve each member's fate individually (read-back) rather
+        than resubmitting the batch. Batch-aware stores (the external
+        arbiter) override this with a one-round-trip protocol carrying
+        the same prefix-durability contract."""
+        for path, data in items:
+            self.write(path, data, overwrite=overwrite)
+
     def list_from(self, path: str) -> Iterator[FileStatus]:
         """List files in the parent of `path` whose name is
         lexicographically >= `path`'s name, in sorted order."""

@@ -20,9 +20,26 @@ JSON attributable to exactly one writer attempt, every commit a writer
 observed as successful is present verbatim, and recovery leaves the
 arbiter's latest entry complete.
 
+`--batched` runs the same fight over the GROUP-commit emit path:
+writers commit consecutive multi-member batches through
+`write_batch` (one conditional multi-claim per batch) and are killed
+at the batched phase seams, including the new one:
+
+- `mid_copy` — the batch is claimed and SOME member files are copied
+  but not all: the partially-durable batch the recovery contract says
+  must never be stranded. `recover_all_incomplete` has to complete
+  the claimed run, lowest-first.
+
+Batched rounds additionally prove **convergence**: the pre-recovery
+crash state is snapshotted, recovered twice by independent fresh
+readers, and the two resulting `_delta_log/` trees must be
+byte-identical — plus every member nonce appears in exactly one
+version (no duplicate actions from ambiguous acks).
+
 Run standalone for the long proof:
 
     python -m delta_tpu.tools.arbiter_fuzz --rounds 100
+    python -m delta_tpu.tools.arbiter_fuzz --rounds 20 --batched
 
 The pytest suite (`tests/test_multiprocess_arbiter.py`) runs a few
 seeded rounds of the same driver.
@@ -31,9 +48,11 @@ seeded rounds of the same driver.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import random
+import shutil
 import subprocess
 import sys
 import time
@@ -41,6 +60,9 @@ import uuid
 from typing import List, Optional
 
 CRASH_PHASES = ["before_claim", "after_claim", "after_copy"]
+# batched emits have a fourth seam: killed after copying only a prefix
+# of the claimed members
+BATCH_CRASH_PHASES = CRASH_PHASES + ["mid_copy"]
 KILL_EXIT = 137
 
 
@@ -51,7 +73,7 @@ def _build_store(db_path: str, crash_plan):
     from delta_tpu.storage.arbiter import RacyLocalStore, SqliteCommitArbiter
     from delta_tpu.storage.cloud import ExternalArbiterLogStore
 
-    state = {"phase": None}
+    state = {"phase": None, "copies": 0, "batch_n": 1}
 
     class _CrashArbiter(SqliteCommitArbiter):
         def put_entry(self, entry, overwrite):
@@ -63,15 +85,38 @@ def _build_store(db_path: str, crash_plan):
                     and state["phase"] == "after_claim"):
                 os._exit(KILL_EXIT)
 
+        def put_entries(self, entries, overwrite=False):
+            if not overwrite and state["phase"] == "before_claim":
+                os._exit(KILL_EXIT)
+            claimed = super().put_entries(entries, overwrite=overwrite)
+            if not overwrite and state["phase"] == "after_claim":
+                os._exit(KILL_EXIT)
+            return claimed
+
     class _CrashStore(ExternalArbiterLogStore):
         def _write_copy_temp_file(self, src, dst):
             super()._write_copy_temp_file(src, dst)
-            if state["phase"] == "after_copy":
+            state["copies"] += 1
+            phase = state["phase"]
+            if phase == "after_copy" and state["copies"] >= state["batch_n"]:
+                os._exit(KILL_EXIT)
+            if (phase == "mid_copy" and state["batch_n"] > 1
+                    and state["copies"] >= 1):
+                # claimed batch, partial prefix of member files copied
                 os._exit(KILL_EXIT)
 
         def write(self, path, data, overwrite=False):
             state["phase"] = crash_plan()
+            state["copies"] = 0
+            state["batch_n"] = 1
             super().write(path, data, overwrite)
+
+        def write_batch(self, items, overwrite=False):
+            items = list(items)
+            state["phase"] = crash_plan()
+            state["copies"] = 0
+            state["batch_n"] = len(items)
+            super().write_batch(items, overwrite=overwrite)
 
     return _CrashStore(RacyLocalStore(), _CrashArbiter(db_path))
 
@@ -124,22 +169,117 @@ def worker_main(table: str, db_path: str, writer_id: int, seed: int,
     fh.close()
 
 
-def _spawn_worker(table, db_path, writer_id, seed, target, crash_prob):
+def worker_batched_main(table: str, db_path: str, writer_id: int,
+                        seed: int, target_version: int, crash_prob: float,
+                        batch_members: int = 3) -> None:
+    """Batched commit loop: each attempt claims a run of consecutive
+    versions through ONE `write_batch` (the group-commit emit shape).
+    Members are acked (fsync'd) only after the batch write returns —
+    with the sqlite arbiter the claim is all-or-nothing, so a
+    FileExistsError means NONE of our members landed and nothing is
+    acked."""
+    rng = random.Random(seed)
+
+    def crash_plan() -> Optional[str]:
+        if rng.random() < crash_prob:
+            return rng.choice(BATCH_CRASH_PHASES)
+        return None
+
+    store = _build_store(db_path, crash_plan)
+    success_log = os.path.join(table, f"_writer_{writer_id}.log")
+    fh = open(success_log, "a")
+    while True:
+        latest = _latest_version(store, table)
+        if latest >= target_version:
+            break
+        n = min(batch_members, target_version - latest)
+        items = []
+        members = []
+        for i in range(n):
+            v = latest + 1 + i
+            nonce = uuid.uuid4().hex
+            payload = json.dumps({"commitInfo": {
+                "writer": writer_id, "version": v, "nonce": nonce,
+                "member": i, "batch": n}}) + "\n"
+            items.append((os.path.join(table, "_delta_log",
+                                       f"{v:020d}.json"), payload.encode()))
+            members.append((v, nonce))
+        try:
+            store.write_batch(items)
+        except (FileExistsError, FileNotFoundError):
+            continue  # lost the claim race / prev not visible: refresh
+        for v, nonce in members:
+            fh.write(f"{v} {nonce}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    fh.close()
+
+
+def _spawn_worker(table, db_path, writer_id, seed, target, crash_prob,
+                  batched=False):
+    argv = [sys.executable, "-m", "delta_tpu.tools.arbiter_fuzz",
+            "--worker", "--table", table, "--db", db_path,
+            "--writer-id", str(writer_id), "--seed", str(seed),
+            "--target", str(target), "--crash-prob", str(crash_prob)]
+    if batched:
+        argv.append("--batched")
     return subprocess.Popen(
-        [sys.executable, "-m", "delta_tpu.tools.arbiter_fuzz", "--worker",
-         "--table", table, "--db", db_path, "--writer-id", str(writer_id),
-         "--seed", str(seed), "--target", str(target),
-         "--crash-prob", str(crash_prob)],
+        argv,
         cwd=os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))),
     )
 
 
+def _snapshot_state(table: str, db_path: str) -> dict:
+    """Byte snapshot of the whole crash state: table dir (commit files,
+    temps, ack logs) + the sqlite arbiter (db, -wal, -shm)."""
+    snap = {}
+    for root, _, files in os.walk(table):
+        for f in files:
+            p = os.path.join(root, f)
+            with open(p, "rb") as fh:
+                snap[p] = fh.read()
+    for ext in ("", "-wal", "-shm"):
+        p = db_path + ext
+        if os.path.exists(p):
+            with open(p, "rb") as fh:
+                snap[p] = fh.read()
+    return snap
+
+
+def _restore_state(table: str, db_path: str, snap: dict) -> None:
+    shutil.rmtree(table, ignore_errors=True)
+    for ext in ("", "-wal", "-shm"):
+        p = db_path + ext
+        if os.path.exists(p):
+            os.remove(p)
+    for p, data in snap.items():
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as fh:
+            fh.write(data)
+
+
+def _log_digest(table: str) -> str:
+    """sha256 over (name, bytes) of every commit file, sorted."""
+    log = os.path.join(table, "_delta_log")
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(log)):
+        if not (name.endswith(".json") and name.split(".")[0].isdigit()):
+            continue
+        h.update(name.encode())
+        with open(os.path.join(log, name), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
 def run_round(workdir: str, seed: int, n_writers: int = 3,
               target_version: int = 11, crash_prob: float = 0.25,
-              timeout_s: float = 120.0) -> dict:
+              timeout_s: float = 120.0, batched: bool = False) -> dict:
     """One fuzz round. Returns stats; raises AssertionError on any
-    protocol violation."""
+    protocol violation. With ``batched`` the writers commit multi-member
+    batches and the round additionally proves convergence: the crash
+    state is recovered twice by independent fresh readers and the two
+    resulting logs must be byte-identical."""
     rng = random.Random(seed)
     table = os.path.join(workdir, f"table_{seed}")
     os.makedirs(os.path.join(table, "_delta_log"), exist_ok=True)
@@ -150,7 +290,8 @@ def run_round(workdir: str, seed: int, n_writers: int = 3,
     spawned = 0
     for w in range(n_writers):
         procs[w] = _spawn_worker(table, db_path, w, rng.randrange(2**31),
-                                 target_version, crash_prob)
+                                 target_version, crash_prob,
+                                 batched=batched)
         spawned += 1
     deadline = time.time() + timeout_s
     while procs and time.time() < deadline:
@@ -165,7 +306,7 @@ def run_round(workdir: str, seed: int, n_writers: int = 3,
                 # exactly the recovery the protocol must survive
                 procs[w] = _spawn_worker(
                     table, db_path, w, rng.randrange(2**31),
-                    target_version, crash_prob)
+                    target_version, crash_prob, batched=batched)
                 spawned += 1
             elif rc != 0:
                 raise AssertionError(f"writer {w} died rc={rc}")
@@ -175,6 +316,9 @@ def run_round(workdir: str, seed: int, n_writers: int = 3,
     if procs:
         raise AssertionError(
             f"round timed out with {len(procs)} writers still running")
+
+    # convergence proof needs the untouched crash state twice
+    snap = _snapshot_state(table, db_path) if batched else None
 
     # --- recovery + invariant checks from a FRESH process-independent
     # store (a reader that never wrote) -------------------------------
@@ -202,6 +346,13 @@ def run_round(workdir: str, seed: int, n_writers: int = 3,
         assert ci["version"] == v, f"v{v} holds payload for v{ci['version']}"
         by_version[v] = (ci["writer"], ci["nonce"])
 
+    # no duplicate actions: every member nonce in exactly one version
+    # (an ambiguous-ack rebase that re-committed a member would show
+    # the same nonce twice)
+    nonces = [nonce for _, nonce in by_version.values()]
+    assert len(set(nonces)) == len(nonces), \
+        "duplicate member payloads: same nonce in more than one version"
+
     # acknowledged-commit durability: every success a writer recorded
     # must be present with that writer's exact nonce
     acked = 0
@@ -220,8 +371,21 @@ def run_round(workdir: str, seed: int, n_writers: int = 3,
     assert latest_entry is not None and latest_entry.complete, \
         f"latest arbiter entry not complete after recovery: {latest_entry}"
 
-    return {"seed": seed, "commits": len(versions), "crashes": crashes,
-            "spawned": spawned, "acked": acked}
+    stats = {"seed": seed, "commits": len(versions), "crashes": crashes,
+             "spawned": spawned, "acked": acked}
+    if batched:
+        # convergence: restore the crash state and recover again with
+        # an INDEPENDENT fresh reader; both recoveries must produce a
+        # byte-identical _delta_log/
+        digest_a = _log_digest(table)
+        _restore_state(table, db_path, snap)
+        reader_b = external_arbiter_store(db_path)
+        list(reader_b.list_from(os.path.join(log, f"{0:020d}.json")))
+        digest_b = _log_digest(table)
+        assert digest_a == digest_b, (
+            f"recovery diverged: {digest_a} != {digest_b} (seed {seed})")
+        stats["digest"] = digest_a
+    return stats
 
 
 def main(argv=None) -> int:
@@ -236,11 +400,17 @@ def main(argv=None) -> int:
     ap.add_argument("--rounds", type=int, default=5)
     ap.add_argument("--writers", type=int, default=3)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--batched", action="store_true",
+                    help="fuzz the batched (group-commit) emit path")
     args = ap.parse_args(argv)
 
     if args.worker:
-        worker_main(args.table, args.db, args.writer_id, args.seed,
-                    args.target, args.crash_prob)
+        if args.batched:
+            worker_batched_main(args.table, args.db, args.writer_id,
+                                args.seed, args.target, args.crash_prob)
+        else:
+            worker_main(args.table, args.db, args.writer_id, args.seed,
+                        args.target, args.crash_prob)
         return 0
 
     import tempfile
@@ -252,12 +422,14 @@ def main(argv=None) -> int:
         stats = run_round(workdir, seed=args.seed + r,
                           n_writers=args.writers,
                           target_version=args.target,
-                          crash_prob=args.crash_prob)
+                          crash_prob=args.crash_prob,
+                          batched=args.batched)
         total_crashes += stats["crashes"]
         total_commits += stats["commits"]
         print(f"round {r}: {stats}", flush=True)
     print(json.dumps({
         "rounds": args.rounds, "writers": args.writers,
+        "batched": args.batched,
         "total_commits": total_commits, "total_crashes": total_crashes,
         "elapsed_s": round(time.time() - t0, 1), "ok": True}))
     return 0

@@ -1,0 +1,121 @@
+"""Reusable conflict-set engine: one winner set, many losers.
+
+Extracted from ``Transaction._resolve_conflict`` (ROADMAP item 2) so
+the same machinery serves two callers:
+
+- the **solo retry loop**: one transaction checks itself against the
+  commits that beat it, folds their in-commit timestamps and row-ID
+  watermark, and rebases;
+- the **group committer** (``txn/groupcommit.py``): a batch of
+  transactions is checked against ONE shared snapshot of winners, and
+  each accepted member's own prepared actions are appended to the set
+  (via :meth:`ConflictSetEngine.extend`) so later members in the same
+  batch are checked against earlier ones exactly as if those had
+  already landed.
+
+The engine is deliberately stateless about any particular transaction:
+callers pass the ``TransactionReadState`` and their read version, and
+get back a :class:`ConflictResolution` (or a typed
+``ConcurrentModificationError`` subclass from the checker). All
+policy — what to do with a loser — stays with the caller.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from delta_tpu.config import IN_COMMIT_TIMESTAMPS, get_table_config
+from delta_tpu.errors import LogCorruptedError
+from delta_tpu.models.actions import CommitInfo, Metadata
+from delta_tpu.txn.conflict import (
+    TransactionReadState,
+    WinningCommit,
+    check_conflicts,
+)
+
+
+@dataclass
+class ConflictResolution:
+    """The successful outcome of one member's conflict check."""
+
+    #: max inCommitTimestamp across the winners (None when no winner
+    #: carried one) — the floor for the member's own ICT
+    winners_ict: Optional[int]
+    #: row-ID high watermark claimed by winners, or None
+    row_id_high_watermark: Optional[int]
+    #: raw rebase dict from ``check_conflicts`` (forward-compatible)
+    rebase: dict
+
+
+class ConflictSetEngine:
+    """A growing, ordered set of winning commits plus the fold logic
+    every loser needs: logical conflict check, in-commit-timestamp
+    monotonicity, row-ID watermark."""
+
+    def __init__(self, winners: Optional[List[WinningCommit]] = None):
+        self._winners: List[WinningCommit] = list(winners or [])
+
+    @property
+    def winners(self) -> List[WinningCommit]:
+        return list(self._winners)
+
+    def winners_after(self, read_version: int) -> List[WinningCommit]:
+        """Winners a transaction that read ``read_version`` must check
+        against (strictly newer than what it read)."""
+        return [w for w in self._winners if w.version > read_version]
+
+    def extend(self, winner: WinningCommit) -> None:
+        """Append a newly accepted commit (batch member or fresh
+        winner) so subsequent resolves see it."""
+        if self._winners and winner.version <= self._winners[-1].version:
+            raise ValueError(
+                f"winner versions must be ascending: {winner.version} "
+                f"after {self._winners[-1].version}")
+        self._winners.append(winner)
+
+    def resolve(self, state: TransactionReadState, read_version: int,
+                ict_on: bool,
+                winners_ict: Optional[int] = None) -> ConflictResolution:
+        """Check ``state`` against every winner newer than
+        ``read_version``; raises the checker's typed
+        ``ConcurrentModificationError`` subclass when the member loses.
+        ``ict_on`` is whether in-commit timestamps were enabled at the
+        member's read snapshot; winners that change Metadata may toggle
+        it mid-fold."""
+        winners = self.winners_after(read_version)
+        rebase = check_conflicts(state, winners)
+        row_hw = rebase.get("row_id_high_watermark")
+        for w in winners:
+            # a winner may toggle ICT itself: its Metadata governs
+            # whether IT and later winners must carry an
+            # inCommitTimestamp
+            wmeta = next(
+                (a for a in w.actions if isinstance(a, Metadata)), None)
+            if wmeta is not None:
+                ict_on = get_table_config(
+                    wmeta.configuration, IN_COMMIT_TIMESTAMPS)
+            ci = next(
+                (a for a in w.actions if isinstance(a, CommitInfo)), None)
+            if ci is not None and ci.inCommitTimestamp is not None:
+                winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
+            elif ict_on:
+                # `CommitInfo.getRequiredInCommitTimestamp`: on an ICT
+                # table every commit must carry its timestamp — a
+                # winner without one corrupts the monotonic clock this
+                # rebase maintains
+                if ci is None:
+                    raise LogCorruptedError(
+                        f"commit {w.version} has no commitInfo "
+                        "but in-commit timestamps are enabled",
+                        error_class="DELTA_MISSING_COMMIT_INFO")
+                raise LogCorruptedError(
+                    f"commitInfo of commit {w.version} has no "
+                    "inCommitTimestamp but in-commit "
+                    "timestamps are enabled",
+                    error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
+        return ConflictResolution(
+            winners_ict=winners_ict,
+            row_id_high_watermark=row_hw,
+            rebase=rebase,
+        )

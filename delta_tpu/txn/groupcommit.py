@@ -1,0 +1,363 @@
+"""Group commit: batch compatible concurrent transactions into one
+arbiter round trip.
+
+ROADMAP item 2: at heavy multi-writer traffic the commit path
+serializes on the arbiter — every transaction pays one storage round
+trip and one conflict check, and losers pay them again per rebase. The
+group committer amortizes both. Writers that reach ``commit()`` within
+a bounded window (``DELTA_TPU_GROUP_COMMIT_WINDOW_MS``, default 2) are
+queued per table; a leader drains up to
+``DELTA_TPU_GROUP_COMMIT_MAX_BATCH`` members, conflict-checks the
+whole batch against ONE snapshot of landed winners (the shared
+``ConflictSetEngine``), assigns the accepted members consecutive
+versions — each accepted member's prepared actions are appended to
+the conflict set so later members are checked against earlier ones
+exactly as if those had landed — and emits them as one batched write.
+
+Per-member typed outcomes keep failure member-scoped:
+
+- ``committed`` / ``rebased``: this member's commit is durable at
+  ``outcome.version`` (rebased when that is above its read version).
+- ``rejected``: the member logically conflicts (typed
+  ``ConcurrentModificationError`` from the checker) with a landed
+  winner or an earlier batch member. It degrades to the solo retry
+  path — never fails the batch — because the batch-mate it lost to
+  might itself fail to land; the solo path re-resolves against what is
+  actually on disk and raises the genuine typed error if the conflict
+  is real.
+- ``solo``: the emit outcome for this member is unknown or negative
+  (lost race, transport error, ambiguous ack). The member re-enters
+  the solo loop where PR 5b self-commit recovery (CommitInfo.txnId
+  compare) resolves ambiguity without duplicating data.
+
+Ambiguity ladder on emit failure: per-member read-back of the assigned
+version compares ``txnId`` (the per-member analogue of solo
+self-commit recovery — this is what `ChaosStore.ack_loss_rate` on the
+batched path exercises); members proven landed are committed, everyone
+else degrades to solo. Read-back errors also degrade to solo — safe,
+because the solo path's own self-commit detection is the backstop.
+
+Breaker/deadline scopes apply at batch granularity: the leader's one
+emit runs under the ``commit-coordinator`` breaker (coordinated
+tables) or the storage `io_call` breaker (logstore tables), and under
+the LEADER's ambient deadline. A waiter's own deadline is honoured at
+member granularity: while still un-sealed in the queue it retracts and
+raises ``DeadlineExceededError``; once its batch is sealed it waits
+for the (bounded) emit to finish.
+
+Disabled by default (``DELTA_TPU_GROUP_COMMIT=1`` to enable): solo
+commits must not pay the window latency unless a deployment opts in.
+"""
+# delta-lint: file-disable=shared-state-race — audited:
+# GroupCommitter is the one intentionally shared object on the commit
+# path. Every access to the queue/leader flag is under self._lock;
+# member outcome/lead_now/sealed hand-offs are published under the
+# same lock or before the member's Event is set (the Event is the
+# happens-before edge). Member transactions themselves stay
+# thread-confined: the leader only touches a member txn between seal
+# and outcome-set, while its owning thread is parked in submit().
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from delta_tpu import obs
+from delta_tpu.errors import ConcurrentModificationError, DeltaError
+from delta_tpu.models.actions import actions_to_commit_bytes
+from delta_tpu.resilience.deadline import check_deadline, expired
+from delta_tpu.txn.conflict import WinningCommit
+from delta_tpu.txn.conflictset import ConflictSetEngine
+from delta_tpu.utils import filenames
+
+_log = logging.getLogger(__name__)
+
+_GROUP_BATCHES = obs.counter("txn.group_commit.batches")
+_GROUP_MEMBERS = obs.counter("txn.group_commit.members")
+_GROUP_REJECTED = obs.counter("txn.group_commit.rejected")
+_GROUP_SOLO = obs.counter("txn.group_commit.solo_degraded")
+_GROUP_READBACK = obs.counter("txn.group_commit.readback_recovered")
+_GROUP_SIZE = obs.histogram("txn.group_commit.batch_size")
+_GROUP_WAIT = obs.histogram("txn.group_commit.wait_ms")
+
+# outcome kinds
+COMMITTED = "committed"
+REBASED = "rebased"
+REJECTED = "rejected"
+SOLO = "solo"
+
+_TRUTHY = ("1", "on", "true", "yes")
+
+
+def group_commit_enabled() -> bool:
+    return os.environ.get("DELTA_TPU_GROUP_COMMIT",
+                          "").strip().lower() in _TRUTHY
+
+
+def group_commit_window_s() -> float:
+    return float(os.environ.get("DELTA_TPU_GROUP_COMMIT_WINDOW_MS",
+                                "2")) / 1000.0
+
+
+def group_commit_max_batch() -> int:
+    return max(1, int(os.environ.get("DELTA_TPU_GROUP_COMMIT_MAX_BATCH",
+                                     "16")))
+
+
+@dataclass
+class MemberOutcome:
+    """What the batch decided for one member transaction."""
+
+    kind: str  # COMMITTED | REBASED | REJECTED | SOLO
+    version: Optional[int] = None
+    data: Optional[bytes] = None
+    error: Optional[BaseException] = None
+
+
+class _Member:
+    __slots__ = ("txn", "event", "outcome", "sealed", "lead_now")
+
+    def __init__(self, txn):
+        self.txn = txn
+        self.event = threading.Event()
+        self.outcome: Optional[MemberOutcome] = None
+        self.sealed = False      # drained into a batch; must wait
+        self.lead_now = False    # baton: this member leads the next batch
+
+
+class GroupCommitter:
+    """Per-table batching point for concurrent committers."""
+
+    def __init__(self, table, window_s: Optional[float] = None,
+                 max_batch: Optional[int] = None):
+        self._table = table
+        self._window_s = (window_s if window_s is not None
+                          else group_commit_window_s())
+        self._max_batch = (max_batch if max_batch is not None
+                           else group_commit_max_batch())
+        self._lock = threading.Lock()
+        self._queue: List[_Member] = []
+        self._leader_active = False
+
+    # ------------------------------------------------------------ entry
+    def submit(self, txn) -> MemberOutcome:
+        """Queue ``txn`` for the next batch and block until its
+        outcome is decided. The first member to arrive while no leader
+        is active becomes the leader; the baton passes to a queued
+        member whenever the queue is non-empty after an emit."""
+        m = _Member(txn)
+        t0 = time.perf_counter()
+        with self._lock:
+            self._queue.append(m)
+            if not self._leader_active:
+                self._leader_active = True
+                m.lead_now = True
+        while m.outcome is None:
+            if m.lead_now:
+                m.lead_now = False
+                self._lead()
+                continue
+            m.event.wait(timeout=0.05)
+            m.event.clear()
+            if m.outcome is not None or m.lead_now:
+                continue
+            if expired():
+                # member-granularity deadline: retract while still
+                # un-sealed; once sealed the emit is already paying for
+                # us, so wait it out (it is bounded by the leader's own
+                # deadline/breaker)
+                with self._lock:
+                    retract = not m.sealed and m in self._queue
+                    if retract:
+                        self._queue.remove(m)
+                if retract:
+                    check_deadline("group-commit wait")
+        _GROUP_WAIT.observe((time.perf_counter() - t0) * 1000.0)
+        return m.outcome
+
+    # ----------------------------------------------------------- leader
+    def _lead(self) -> None:
+        time.sleep(self._window_s)  # accumulation window
+        with self._lock:
+            batch = self._queue[: self._max_batch]
+            del self._queue[: len(batch)]
+            for m in batch:
+                m.sealed = True
+        try:
+            if batch:
+                self._emit(batch)
+        finally:
+            with self._lock:
+                if self._queue:
+                    nxt = self._queue[0]
+                    nxt.lead_now = True
+                    nxt.event.set()
+                else:
+                    self._leader_active = False
+
+    def _emit(self, batch: List[_Member]) -> None:
+        try:
+            with obs.span("txn.group_commit", table=self._table.path,
+                          members=len(batch)) as sp:
+                self._emit_inner(batch, sp)
+        except Exception:
+            # Safety net, not a handler: per-member outcomes (including
+            # every ConcurrentModificationError) were assigned inside
+            # _emit_inner. Anything reaching here is an engine bug or
+            # environmental failure — log it and degrade the still
+            # undecided members to the solo path, which re-resolves
+            # from durable state.
+            _log.warning("group-commit emit failed; undecided members "
+                         "degrade to solo", exc_info=True)
+        finally:
+            for m in batch:
+                if m.outcome is None:
+                    m.outcome = MemberOutcome(SOLO)
+                    _GROUP_SOLO.inc()
+                m.event.set()
+
+    def _emit_inner(self, batch: List[_Member], sp) -> None:
+        engine = self._table.engine
+        log_path = self._table.log_path
+        lead = batch[0].txn
+        min_read = min(m.txn.read_version for m in batch)
+        latest = lead._latest_version(engine, log_path, min_read)
+        winners = []
+        if latest > min_read:
+            winners = lead._read_commit_range(engine, log_path,
+                                              min_read + 1, latest)
+        cs = ConflictSetEngine(winners)
+        accepted = []  # (member, assigned version, serialized bytes)
+        next_version = latest + 1
+        for m in batch:
+            txn = m.txn
+            try:
+                res = cs.resolve(txn._read_state(), txn.read_version,
+                                 txn._ict_enabled_at_read())
+            except ConcurrentModificationError as e:
+                # reject ONLY the loser; it degrades to the solo retry
+                # path (never the batch) — the batch-mate it lost to
+                # may itself fail to land, so the solo re-check against
+                # durable state is what makes the rejection final
+                m.outcome = MemberOutcome(REJECTED, error=e)
+                _GROUP_REJECTED.inc()
+                continue
+            if res.row_id_high_watermark is not None:
+                txn._winners_row_watermark = max(
+                    txn._winners_row_watermark or -1,
+                    res.row_id_high_watermark)
+            assigned = next_version
+            try:
+                acts = txn._prepare_actions(assigned, res.winners_ict)
+            except DeltaError as e:
+                # deterministic validation failure (not a race): let
+                # the solo path surface the identical error to the
+                # member's own thread
+                m.outcome = MemberOutcome(SOLO, error=e)
+                _GROUP_SOLO.inc()
+                continue
+            data = actions_to_commit_bytes(acts)
+            cs.extend(WinningCommit(assigned, acts))
+            accepted.append((m, assigned, data))
+            next_version += 1
+        sp.set_attrs(accepted=len(accepted),
+                     rejected=len(batch) - len(accepted),
+                     base_version=latest)
+        if not accepted:
+            return
+        try:
+            self._emit_writes(engine, log_path, accepted)
+        except Exception as e:
+            sp.set_attr("emit_error", type(e).__name__)
+            self._resolve_by_readback(engine, log_path, accepted, e)
+        else:
+            for m, v, data in accepted:
+                kind = COMMITTED if v == m.txn.read_version + 1 else REBASED
+                m.outcome = MemberOutcome(kind, version=v, data=data)
+        _GROUP_BATCHES.inc()
+        _GROUP_MEMBERS.inc(len(accepted))
+        _GROUP_SIZE.observe(len(accepted))
+
+    def _emit_writes(self, engine, log_path: str, accepted) -> None:
+        """One batched write for the accepted run. Coordinated tables
+        go through `commit_batch` under the commit-coordinator breaker;
+        logstore tables through the engine's batched atomic-put (which
+        `ExternalArbiterLogStore` turns into one claim round trip).
+        Raises on any non-success — per-member fates are then resolved
+        by read-back, never assumed."""
+        coordinator = accepted[0][0].txn._coordinator()
+        if coordinator is not None:
+            from delta_tpu.coordinatedcommits import CommitFailedException
+            from delta_tpu.resilience import breaker_for, default_policy
+
+            ts = int(time.time() * 1000)
+            commits = [(v, data) for _, v, data in accepted]
+            try:
+                default_policy().call(
+                    lambda: coordinator.commit_batch(log_path, commits, ts),
+                    breaker=breaker_for("commit-coordinator"))
+            except CommitFailedException as e:
+                raise FileExistsError(str(e)) from e
+            return
+        items = [(filenames.delta_file(log_path, v), data)
+                 for _, v, data in accepted]
+        writer = getattr(engine.json, "write_json_files_atomically", None)
+        if writer is not None:
+            writer(items, overwrite=False)
+        else:
+            for path, data in items:
+                engine.json.write_json_file_atomically(path, data,
+                                                       overwrite=False)
+
+    def _resolve_by_readback(self, engine, log_path: str, accepted,
+                             cause: BaseException) -> None:
+        """The emit failed or was ambiguous (lost race / transport
+        error / lost ack): decide each member's fate by reading back
+        its assigned version and comparing ``txnId`` — the per-member
+        self-commit recovery. Proven-landed members are committed;
+        everyone else (including read-back failures) degrades to solo,
+        where the solo loop's own self-commit detection is the final
+        backstop against duplicate data."""
+        for m, v, data in accepted:
+            landed = False
+            try:
+                w = m.txn._read_commit_range(engine, log_path, v, v)[0]
+                landed = m.txn._is_own_commit(w)
+            except FileNotFoundError:
+                landed = False
+            except Exception:
+                _log.warning(
+                    "group-commit read-back of version %d failed after "
+                    "emit error (%s); degrading member to solo",
+                    v, cause, exc_info=True)
+                m.outcome = MemberOutcome(SOLO, error=cause)
+                _GROUP_SOLO.inc()
+                continue
+            if landed:
+                kind = COMMITTED if v == m.txn.read_version + 1 else REBASED
+                m.outcome = MemberOutcome(kind, version=v, data=data)
+                _GROUP_READBACK.inc()
+                obs.add_event("txn.group_commit.readback_recovered",
+                              version=v)
+            else:
+                m.outcome = MemberOutcome(SOLO, error=cause)
+                _GROUP_SOLO.inc()
+
+
+def group_committer_for(table) -> Optional[GroupCommitter]:
+    """The table's lazily-attached group committer, or None when group
+    commit is disabled. One committer per Table object: batching scope
+    is the in-process contention domain (cross-process contention is
+    what the arbiter itself serializes)."""
+    if not group_commit_enabled():
+        return None
+    with table._lock:
+        gc = getattr(table, "_group_committer", None)
+        if gc is None:
+            gc = GroupCommitter(table)
+            table._group_committer = gc
+    return gc

@@ -59,11 +59,7 @@ from delta_tpu.models.actions import (
     SetTransaction,
     actions_to_commit_bytes,
 )
-from delta_tpu.txn.conflict import (
-    TransactionReadState,
-    check_conflicts,
-    read_winning_commits,
-)
+from delta_tpu.txn.conflict import TransactionReadState
 from delta_tpu.txn.isolation import IsolationLevel, default_isolation_level
 from delta_tpu.utils import filenames
 
@@ -679,22 +675,20 @@ class Transaction:
         t_start = time.perf_counter()
 
         def _report(committed_version, success):
-            if getattr(engine, "metrics_reporters", None):
-                from delta_tpu.metrics import transaction_report
+            self._report_metrics(committed_version, success, attempts,
+                                 t_start)
 
-                engine.report_metrics(
-                    transaction_report(
-                        self._table.path,
-                        self.operation,
-                        self.read_version,
-                        committed_version,
-                        attempts,
-                        (time.perf_counter() - t_start) * 1000,
-                        len(self._adds),
-                        len(self._removes),
-                        success,
-                    )
-                )
+        gc = self._group_committer()
+        if gc is not None:
+            outcome = gc.submit(self)
+            if outcome.version is not None:
+                # committed (possibly rebased) through the batch — one
+                # arbiter round trip shared with the other members
+                return self._finish_commit(outcome.version, outcome.data,
+                                           1, t_start)
+            # conflict-rejected or degraded: fall through to the solo
+            # retry path, which re-resolves against the commits that
+            # actually landed (a batch-mate we "lost" to may not have)
 
         while attempts <= self._max_retries:
             attempts += 1
@@ -745,28 +739,75 @@ class Transaction:
                         attempt_version = latest + 1
                         continue
                     # (self-commit) fall through to the success path
-            self._committed = True
-            # hand the bytes we just wrote to the snapshot cache BEFORE
-            # the hooks run, so they (and the next update() poll) advance
-            # incrementally without re-reading our own commit
-            notify = getattr(self._table, "notify_commit", None)
-            if notify is not None and self._coordinator() is None:
-                notify(attempt_version, data)
-            if self.observer:
-                self.observer.after_commit(self, attempt_version)
-            _report(attempt_version, True)
-            self._run_post_commit_hooks(attempt_version)
-            table = self._table
-            return CommitResult(
-                version=attempt_version,
-                committed=True,
-                snapshot_fn=lambda: table.update(),
-                attempts=attempts,
-            )
+            return self._finish_commit(attempt_version, data, attempts,
+                                       t_start)
         raise MaxCommitRetriesExceededError(
             f"commit failed after {attempts} attempts (last tried version "
             f"{attempt_version})"
         )
+
+    def _finish_commit(self, version: int, data: bytes, attempts: int,
+                       t_start: float) -> CommitResult:
+        """The shared success tail of both commit paths (solo loop and
+        group-commit batch): mark committed, feed the snapshot cache,
+        fire observers/metrics/hooks, build the result."""
+        self._committed = True
+        # hand the bytes we just wrote to the snapshot cache BEFORE
+        # the hooks run, so they (and the next update() poll) advance
+        # incrementally without re-reading our own commit
+        notify = getattr(self._table, "notify_commit", None)
+        if notify is not None and self._coordinator() is None:
+            notify(version, data)
+        if self.observer:
+            self.observer.after_commit(self, version)
+        self._report_metrics(version, True, attempts, t_start)
+        self._run_post_commit_hooks(version)
+        table = self._table
+        return CommitResult(
+            version=version,
+            committed=True,
+            snapshot_fn=lambda: table.update(),
+            attempts=attempts,
+        )
+
+    def _report_metrics(self, committed_version: Optional[int],
+                        success: bool, attempts: int,
+                        t_start: float) -> None:
+        engine = self._table.engine
+        if getattr(engine, "metrics_reporters", None):
+            from delta_tpu.metrics import transaction_report
+
+            engine.report_metrics(
+                transaction_report(
+                    self._table.path,
+                    self.operation,
+                    self.read_version,
+                    committed_version,
+                    attempts,
+                    (time.perf_counter() - t_start) * 1000,
+                    len(self._adds),
+                    len(self._removes),
+                    success,
+                )
+            )
+
+    def _group_committer(self):
+        """The table's group committer, or None when this transaction
+        must take the solo path: disabled by env, a brand-new table
+        (read_version < 0 — there is no snapshot to batch against), or
+        an observer-driven test that phase-locks the solo protocol."""
+        if self.observer is not None or self.read_version < 0:
+            return None
+        from delta_tpu.txn.groupcommit import group_committer_for
+
+        return group_committer_for(self._table)
+
+    def _ict_enabled_at_read(self) -> bool:
+        """Whether in-commit timestamps were enabled at this
+        transaction's read snapshot (the starting state for the
+        conflict-set ICT fold)."""
+        return self.read_snapshot is not None and get_table_config(
+            self.read_snapshot.metadata.configuration, IN_COMMIT_TIMESTAMPS)
 
     def _is_own_commit(self, winner) -> bool:
         """True when the 'winning' commit at our attempt version is the
@@ -780,63 +821,32 @@ class Transaction:
                           winners_ict: Optional[int], report, asp
                           ) -> Optional[int]:
         """Genuine lost race: check logical conflicts against every
-        winner and fold their in-commit timestamps into the rebase.
-        Returns the updated ``winners_ict``; raises when the loss is
-        not retryable."""
+        winner and fold their in-commit timestamps into the rebase
+        (delegated to the shared `ConflictSetEngine` — the group
+        committer runs the same machinery per batch member). Returns
+        the updated ``winners_ict``; raises when the loss is not
+        retryable."""
+        from delta_tpu.txn.conflictset import ConflictSetEngine
+
         with obs.span("txn.conflict_check",
                       lost_version=attempt_version,
                       winners=latest - attempt_version + 1):
             try:
-                rebase = check_conflicts(self._read_state(), winners)
+                res = ConflictSetEngine(winners).resolve(
+                    self._read_state(), attempt_version - 1,
+                    self._ict_enabled_at_read(), winners_ict)
             except Exception:
                 report(None, False)
                 raise
-        if rebase.get("row_id_high_watermark") is not None:
+        if res.row_id_high_watermark is not None:
             self._winners_row_watermark = max(
                 self._winners_row_watermark or -1,
-                rebase["row_id_high_watermark"],
+                res.row_id_high_watermark,
             )
-        ict_on = self.read_snapshot is not None and \
-            get_table_config(
-                self.read_snapshot.metadata.configuration,
-                IN_COMMIT_TIMESTAMPS)
-        for w in winners:
-            # a winner may toggle ICT itself: its Metadata
-            # governs whether IT and later winners must carry
-            # an inCommitTimestamp
-            wmeta = next(
-                (a for a in w.actions if isinstance(a, Metadata)),
-                None)
-            if wmeta is not None:
-                ict_on = get_table_config(
-                    wmeta.configuration, IN_COMMIT_TIMESTAMPS)
-            ci = next(
-                (a for a in w.actions if isinstance(a, CommitInfo)), None
-            )
-            if ci is not None and ci.inCommitTimestamp is not None:
-                winners_ict = max(winners_ict or 0, ci.inCommitTimestamp)
-            elif ict_on:
-                # `CommitInfo.getRequiredInCommitTimestamp`:
-                # on an ICT table every commit must carry its
-                # timestamp — a winner without one corrupts
-                # the monotonic clock this rebase maintains
-                from delta_tpu.errors import LogCorruptedError
-
-                report(None, False)
-                if ci is None:
-                    raise LogCorruptedError(
-                        f"commit {w.version} has no commitInfo "
-                        "but in-commit timestamps are enabled",
-                        error_class="DELTA_MISSING_COMMIT_INFO")
-                raise LogCorruptedError(
-                    f"commitInfo of commit {w.version} has no "
-                    "inCommitTimestamp but in-commit "
-                    "timestamps are enabled",
-                    error_class="DELTA_MISSING_COMMIT_TIMESTAMP")
         # no backoff sleep today: rebase work itself spaces the
         # retries; the attr keeps trace shape stable if one lands
         asp.set_attrs(rebased_to=latest + 1, backoff_ms=0)
-        return winners_ict
+        return res.winners_ict
 
     def _latest_version(self, engine, log_path: str, at_least: int) -> int:
         latest = at_least

@@ -591,6 +591,39 @@ def f():
     assert not _rules_fired(report, "except-swallow")
 
 
+def test_except_swallow_batch_member_outcome_shape():
+    """Pins the group-commit batch-partitioning contract: a member's
+    ConcurrentTransactionError must become a typed per-member outcome
+    (used handler) — a broad except that silently drops it would turn
+    a real conflict into a phantom commit. The GOOD shape mirrors
+    `groupcommit._emit_inner`; the BAD shape (outcome assigned without
+    using the exception) must be flagged."""
+    good = """
+def partition(batch, cs):
+    for m in batch:
+        try:
+            cs.resolve(m.txn)
+        except ConcurrentTransactionError as e:
+            m.outcome = reject(e)
+            continue
+        m.outcome = accept(m)
+"""
+    report = analyze_sources({"m.py": good}, rules=["except-swallow"])
+    assert not _rules_fired(report, "except-swallow")
+
+    bad = """
+def partition(batch, cs):
+    for m in batch:
+        try:
+            cs.resolve(m.txn)
+        except Exception:
+            continue
+        m.outcome = accept(m)
+"""
+    report = analyze_sources({"m.py": bad}, rules=["except-swallow"])
+    assert _rules_fired(report, "except-swallow")
+
+
 def test_except_swallow_narrow_type_is_clean():
     src = """
 def f():

@@ -1,0 +1,341 @@
+"""Group commit: batch partitioning, threaded end-to-end batching, and
+the ack-loss ambiguity ladder (per-member txnId read-back recovery)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu import obs
+from delta_tpu.engine.host import HostEngine
+from delta_tpu.errors import (
+    ConcurrentDeleteDeleteError,
+    ConcurrentWriteError,
+)
+from delta_tpu.models.actions import AddFile
+from delta_tpu.resilience.chaos import ChaosSchedule, ChaosStore
+from delta_tpu.storage.logstore import InMemoryLogStore
+from delta_tpu.table import Table
+from delta_tpu.txn.groupcommit import (
+    COMMITTED,
+    REBASED,
+    REJECTED,
+    GroupCommitter,
+    _Member,
+    group_commit_enabled,
+    group_committer_for,
+)
+
+
+def _batch(start, n):
+    return pa.table({"id": pa.array(np.arange(start, start + n,
+                                              dtype=np.int64))})
+
+
+def _add(path, size=10):
+    return AddFile(path=path, size=size, modificationTime=1,
+                   dataChange=True)
+
+
+def _counter(name):
+    return obs.counter(name).value
+
+
+# ---------------------------------------------------------------- _emit
+# Deterministic batch partitioning: hand-built members through one
+# _emit call, no threads, no window.
+
+
+def test_batch_disjoint_members_all_commit(tmp_table_path):
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    gc = GroupCommitter(table, window_s=0.0)
+
+    txns = []
+    for i in range(3):
+        t = table.start_transaction()
+        t.add_file(_add(f"m{i}.parquet"))
+        txns.append(t)
+    members = [_Member(t) for t in txns]
+    gc._emit(members)
+
+    # all three commit; later members are typed REBASED because their
+    # batch-mates took the slots between their read version and their
+    # assigned version
+    assert [m.outcome.kind for m in members] == [COMMITTED, REBASED,
+                                                 REBASED]
+    assert [m.outcome.version for m in members] == [1, 2, 3]
+    snap = table.latest_snapshot()
+    assert snap.version == 3
+    paths = set(snap.state.add_files_table.column("path").to_pylist())
+    assert {"m0.parquet", "m1.parquet", "m2.parquet"} <= paths
+
+
+def test_batch_overlapping_members_split(tmp_table_path):
+    """Delete-delete on the same file inside one batch: the first
+    member wins (its actions become a pseudo-winner in the conflict
+    set), ONLY the second is rejected, and an unrelated third member
+    still commits — the batch never fails as a unit."""
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    victim = table.latest_snapshot().state.add_files()[0]
+    gc = GroupCommitter(table, window_s=0.0)
+
+    txn_a = table.start_transaction("DELETE")
+    txn_a.remove_file(victim.remove(deletion_timestamp=1))
+    txn_b = table.start_transaction("DELETE")
+    txn_b.remove_file(victim.remove(deletion_timestamp=2))
+    txn_c = table.start_transaction()
+    txn_c.add_file(_add("c.parquet"))
+
+    members = [_Member(t) for t in (txn_a, txn_b, txn_c)]
+    gc._emit(members)
+
+    assert members[0].outcome.kind == COMMITTED
+    assert members[0].outcome.version == 1
+    assert members[1].outcome.kind == REJECTED
+    assert isinstance(members[1].outcome.error,
+                      ConcurrentDeleteDeleteError)
+    assert members[2].outcome.kind == REBASED  # past its batch-mate
+    assert members[2].outcome.version == 2     # loser's slot not burned
+    assert table.latest_snapshot().version == 2
+
+
+def test_batch_domain_metadata_rejects_only_loser(tmp_table_path):
+    from delta_tpu.commands.alter import upgrade_protocol
+
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    upgrade_protocol(table, feature="domainMetadata")  # -> v1
+    gc = GroupCommitter(table, window_s=0.0)
+
+    txn_a = table.start_transaction()
+    txn_a.set_domain_metadata("d1", "a")
+    txn_a.add_file(_add("a.parquet"))
+    txn_b = table.start_transaction()
+    txn_b.set_domain_metadata("d1", "b")  # same domain: loses to a
+    txn_b.add_file(_add("b.parquet"))
+    txn_c = table.start_transaction()
+    txn_c.set_domain_metadata("d2", "c")  # disjoint domain: fine
+    txn_c.add_file(_add("c.parquet"))
+
+    members = [_Member(t) for t in (txn_a, txn_b, txn_c)]
+    gc._emit(members)
+
+    assert members[0].outcome.kind == COMMITTED
+    assert members[1].outcome.kind == REJECTED
+    assert isinstance(members[1].outcome.error, ConcurrentWriteError)
+    assert members[2].outcome.kind == REBASED
+    assert table.latest_snapshot().version == 3
+
+
+def test_batch_stale_member_rebases(tmp_table_path):
+    """A member whose read version is behind a landed winner rebases
+    within the batch (typed REBASED, not a retry loop)."""
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+
+    stale = table.start_transaction()
+    stale.add_file(_add("stale.parquet"))
+    # a solo writer lands v1 AFTER `stale` snapshotted v0
+    winner = table.start_transaction()
+    winner.add_file(_add("winner.parquet"))
+    assert winner.commit().version == 1
+
+    gc = GroupCommitter(table, window_s=0.0)
+    members = [_Member(stale)]
+    gc._emit(members)
+    assert members[0].outcome.kind == REBASED
+    assert members[0].outcome.version == 2
+
+
+# ----------------------------------------------------- threaded batches
+
+
+def test_group_commit_threaded_single_round_trip(tmp_table_path,
+                                                 monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT", "1")
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT_WINDOW_MS", "60")
+    assert group_commit_enabled()
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+
+    b0 = _counter("txn.group_commit.batches")
+    m0 = _counter("txn.group_commit.members")
+    txns = []
+    for i in range(8):
+        t = table.start_transaction()
+        t.add_file(_add(f"w{i}.parquet"))
+        txns.append(t)
+
+    results, errors = [], []
+
+    def commit(t):
+        try:
+            results.append(t.commit().version)
+        except Exception as e:  # pragma: no cover - fail loudly below
+            errors.append(e)
+
+    threads = [threading.Thread(target=commit, args=(t,)) for t in txns]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    assert not errors
+    assert sorted(results) == list(range(1, 9))  # gap-free, no dupes
+    assert table.latest_snapshot().version == 8
+    assert _counter("txn.group_commit.members") - m0 == 8
+    # the whole burst rode ONE window (60ms >> thread startup skew)
+    assert _counter("txn.group_commit.batches") - b0 == 1
+
+
+def test_group_commit_disabled_by_default(tmp_table_path, monkeypatch):
+    monkeypatch.delenv("DELTA_TPU_GROUP_COMMIT", raising=False)
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    assert group_committer_for(table) is None
+    b0 = _counter("txn.group_commit.batches")
+    txn = table.start_transaction()
+    txn.add_file(_add("solo.parquet"))
+    assert txn.commit().version == 1
+    assert _counter("txn.group_commit.batches") == b0
+
+
+def test_group_commit_max_batch_splits(tmp_table_path, monkeypatch):
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT", "1")
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT_WINDOW_MS", "40")
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT_MAX_BATCH", "3")
+    dta.write_table(tmp_table_path, _batch(0, 5))
+    table = Table.for_path(tmp_table_path)
+    b0 = _counter("txn.group_commit.batches")
+
+    txns = []
+    for i in range(6):
+        t = table.start_transaction()
+        t.add_file(_add(f"s{i}.parquet"))
+        txns.append(t)
+    results = []
+    lock = threading.Lock()
+
+    def commit(t):
+        v = t.commit().version
+        with lock:
+            results.append(v)
+
+    threads = [threading.Thread(target=commit, args=(t,)) for t in txns]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert sorted(results) == list(range(1, 7))
+    assert _counter("txn.group_commit.batches") - b0 >= 2
+
+
+# ------------------------------------------------------ ack-loss ladder
+
+
+def test_group_commit_ack_loss_recovered_by_readback(monkeypatch):
+    """Every batched emit's ack is lost after a random prefix of the
+    batch lands (ChaosStore partial-batch ack loss): landed members are
+    proven committed by per-member txnId read-back; the rest degrade to
+    solo, whose own self-commit recovery is the backstop. Exactly-once:
+    a gap-free log with every writer's file present exactly once."""
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT", "1")
+    monkeypatch.setenv("DELTA_TPU_GROUP_COMMIT_WINDOW_MS", "60")
+    store = ChaosStore(InMemoryLogStore(),
+                       ChaosSchedule(29, ack_loss_rate=1.0),
+                       sleep=lambda s: None)
+    eng = HostEngine(store_resolver=lambda path: store)
+    path = "memory://group-ack-loss/tbl"
+    dta.write_table(path, _batch(0, 5), engine=eng)
+    table = Table.for_path(path, eng)
+
+    r0 = _counter("txn.group_commit.readback_recovered")
+    txns = []
+    for i in range(6):
+        t = table.start_transaction()
+        t.add_file(_add(f"g{i}.parquet"))
+        txns.append(t)
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def commit(t):
+        try:
+            v = t.commit().version
+            with lock:
+                results.append(v)
+        except Exception as e:
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=commit, args=(t,)) for t in txns]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    assert not errors
+    assert store.fault_counts.get("batch_ack_loss", 0) > 0
+    assert _counter("txn.group_commit.readback_recovered") > r0
+    assert sorted(results) == list(range(1, 7))  # each exactly once
+    store.enabled = False
+    snap = Table.for_path(path, eng).latest_snapshot()
+    assert snap.version == 6
+    paths = [p for p in
+             snap.state.add_files_table.column("path").to_pylist()
+             if p.endswith(".parquet") and p.startswith("g")]
+    assert sorted(paths) == [f"g{i}.parquet" for i in range(6)]
+
+
+@pytest.mark.slow
+def test_group_commit_ack_loss_soak_many_seeds():
+    """Soak: 20 seeded partial-batch ack-loss schedules, each
+    converging to a gap-free log with every member exactly once."""
+    import os
+
+    os.environ["DELTA_TPU_GROUP_COMMIT"] = "1"
+    os.environ["DELTA_TPU_GROUP_COMMIT_WINDOW_MS"] = "40"
+    try:
+        for seed in range(20):
+            store = ChaosStore(InMemoryLogStore(),
+                               ChaosSchedule(seed, ack_loss_rate=0.5,
+                                             error_rate=0.05),
+                               sleep=lambda s: None)
+            eng = HostEngine(store_resolver=lambda path: store)
+            path = f"memory://group-soak-{seed}/tbl"
+            dta.write_table(path, _batch(0, 5), engine=eng)
+            table = Table.for_path(path, eng)
+            txns = []
+            for i in range(5):
+                t = table.start_transaction()
+                t.add_file(_add(f"g{i}.parquet"))
+                txns.append(t)
+            errs = []
+
+            def commit(t):
+                try:
+                    t.commit()
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=commit, args=(t,))
+                       for t in txns]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert not errs, f"seed {seed}: {errs}"
+            store.enabled = False
+            snap = Table.for_path(path, eng).latest_snapshot()
+            assert snap.version == 5, f"seed {seed}"
+            paths = [p for p in
+                     snap.state.add_files_table.column("path").to_pylist()
+                     if p.startswith("g")]
+            assert sorted(paths) == [f"g{i}.parquet" for i in range(5)], \
+                f"duplicate or missing member under seed {seed}"
+    finally:
+        os.environ.pop("DELTA_TPU_GROUP_COMMIT", None)
+        os.environ.pop("DELTA_TPU_GROUP_COMMIT_WINDOW_MS", None)

@@ -66,6 +66,23 @@ def test_classifier_retryable_attribute_wins():
     assert is_transient(e)
 
 
+def test_classifier_commit_failed_exception():
+    """Coordinator CommitFailedException: `retryable=True` means the
+    TRANSPORT may retry only when it is not a version conflict —
+    a conflict must surface to the conflict machinery (rebase at a new
+    version), never be replayed verbatim by a retry policy."""
+    from delta_tpu.coordinatedcommits import CommitFailedException
+
+    assert is_transient(
+        CommitFailedException("busy", retryable=True, conflict=False))
+    assert not is_transient(
+        CommitFailedException("version taken", retryable=True,
+                              conflict=True))
+    assert not is_transient(
+        CommitFailedException("non-consecutive batch", retryable=False,
+                              conflict=False))
+
+
 def test_classifier_dynamodb_error_types():
     from delta_tpu.storage.dynamodb import DynamoDbError
 
