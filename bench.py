@@ -1603,6 +1603,241 @@ def device_parse_metric() -> None:
     }))
 
 
+# ------------------------------------------------- device scan planning
+
+
+def scan_plan_metric() -> None:
+    """Batched device data-skipping vs its numpy host twin over the
+    SAME resident stats index (planning only, no data read; the index
+    is built once and both routes reuse it). Emits
+    `scan_plan_files_skipped_per_sec`; value is 0 when the routes'
+    skipped-file sets differ or the index was rebuilt instead of
+    reused."""
+    import threading
+
+    import pyarrow as pa
+
+    from delta_tpu import obs
+    from delta_tpu.expressions.tree import Comparison, In, col, lit
+    from delta_tpu.stats.skipping import skipping_mask
+
+    n_files = int(os.environ.get("BENCH_SCAN_FILES", 200_000))
+    rng = np.random.default_rng(17)
+    lo = rng.integers(0, 1 << 32, n_files)
+    width = rng.integers(1, 1 << 16, n_files)
+    flo = rng.uniform(-1e6, 1e6, n_files)
+    plo = rng.uniform(0.0, 1000.0, n_files)
+    nc = rng.integers(0, 5, n_files)
+    stats = [
+        '{"numRecords":50,"minValues":{"k":%d,"f":%.3f,"price":%.2f},'
+        '"maxValues":{"k":%d,"f":%.3f,"price":%.2f},'
+        '"nullCount":{"k":%d,"f":0,"price":0}}'
+        % (lo[i], flo[i], plo[i],
+           lo[i] + width[i], flo[i] + 10.0, plo[i] + 50.0, nc[i])
+        for i in range(n_files)
+    ]
+    files = pa.table({
+        "path": [f"f{i}.parquet" for i in range(n_files)],
+        "stats": pa.array(stats, pa.string()),
+    })
+
+    class _State:
+        """Duck-typed SnapshotState: the fields snapshot_stats_index
+        needs (plain attribute keeps `add_files_table` identity)."""
+
+        def __init__(self, f):
+            self.add_files_table = f
+            self.stats_index = None
+            self._stats_index_lock = threading.Lock()
+
+    # 3 comparisons + a 40-value In-list: 43 atoms, all compiled (no
+    # Arrow fallback) — the timed loop is pure plan work
+    conjs = [
+        Comparison(">=", col("k"), lit(1 << 31)),
+        Comparison("<", col("k"), lit((1 << 31) + (1 << 29))),
+        Comparison(">", col("f"), lit(0.0)),
+        In(col("price"), tuple(float(v) for v in range(100, 140))),
+    ]
+
+    def run(route):
+        os.environ["DELTA_TPU_DEVICE_SKIP"] = route
+        try:
+            mask = skipping_mask(files, conjs, None, state=st)
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                skipping_mask(files, conjs, None, state=st)
+                times.append(time.perf_counter() - t0)
+            return mask, min(times)
+        finally:
+            del os.environ["DELTA_TPU_DEVICE_SKIP"]
+
+    st = _State(files)
+    builds = obs.counter("scan.stats_index_builds")
+    b0 = builds.value
+    dev_mask, dev_s = run("force")
+    host_mask, host_s = run("off")
+    built = builds.value - b0  # 8 plans, one shared index build
+
+    skipped = int((~dev_mask).sum())
+    parity = bool((dev_mask == host_mask).all()) and built == 1
+    print(f"scan planning @{n_files} files ({len(conjs)} conjuncts, "
+          f"{skipped} skipped): device {skipped / dev_s / 1e6:.1f}M "
+          f"skips/s, host twin {skipped / host_s / 1e6:.1f}M skips/s, "
+          f"index builds={built}, "
+          f"parity={'OK' if parity else 'MISMATCH'}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "scan_plan_files_skipped_per_sec",
+        "value": round(skipped / dev_s, 1) if parity else 0.0,
+        "unit": "files/s",
+        "vs_host": round(host_s / dev_s, 3) if parity else 0.0,
+        "files": n_files,
+        "skipped": skipped,
+        "kept": n_files - skipped,
+        "stats_index_builds": built,
+    }))
+
+
+def tpcds_scan_metric(workdir: str) -> None:
+    """TPC-DS-derived scan planning on a real table: partition pruning
+    + stats skipping on a date-sorted store_sales slice, resident-index
+    reuse across two scans of one snapshot version, and the Z-order
+    payoff (clustering raises the box-predicate skip rate). Numbers on
+    a CPU container are informational; the pruning/skip-rate asserts
+    are platform-independent."""
+    import shutil
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    import delta_tpu.api as dta
+    from delta_tpu import obs
+    from delta_tpu.expressions.tree import col, lit
+    from delta_tpu.table import Table
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.tpcds_data import generate
+
+    scale = int(os.environ.get("BENCH_TPCDS_SCALE", 40_000))
+    root = os.path.join(workdir, "tpcds_scan")
+    shutil.rmtree(root, ignore_errors=True)
+
+    ss = generate(scale=scale, seed=7)["store_sales"]
+    # date-sorted ingest: files inside each store partition get disjoint
+    # ss_sold_date_sk ranges, the shape a daily batch load produces
+    ss = ss.sort_by("ss_sold_date_sk")
+    path = os.path.join(root, "store_sales")
+    dta.write_table(path, ss, partition_by=["ss_store_sk"],
+                    target_rows_per_file=500)
+
+    snap = Table.for_path(path).latest_snapshot()
+    total = snap.state.add_files_table.num_rows
+    dates = ss.column("ss_sold_date_sk")
+    d_lo = int(pc.quantile(dates, 0.25).to_pylist()[0])
+    d_hi = int(pc.quantile(dates, 0.35).to_pylist()[0])
+    flt = ((col("ss_store_sk") == lit(3))
+           & (col("ss_sold_date_sk") >= lit(d_lo))
+           & (col("ss_sold_date_sk") <= lit(d_hi)))
+
+    builds = obs.counter("scan.stats_index_builds")
+    plans = obs.counter("scan.device_plans")
+    b0, p0 = builds.value, plans.value
+    os.environ["DELTA_TPU_DEVICE_SKIP"] = "force"
+    try:
+        sc = snap.scan(filter=flt)
+        surviving = sc.add_files_table()
+        # second scan of the SAME snapshot version: index is reused
+        sc2 = snap.scan(filter=flt)
+        sc2.add_files_table()
+    finally:
+        del os.environ["DELTA_TPU_DEVICE_SKIP"]
+    built, planned = builds.value - b0, plans.value - p0
+
+    # correctness gate: no wrongly-skipped file — reading the surviving
+    # files and filtering rows must reproduce the exact answer
+    exact = int(pc.sum(pc.and_kleene(
+        pc.equal(ss.column("ss_store_sk"), 3),
+        pc.and_kleene(
+            pc.greater_equal(dates, d_lo),
+            pc.less_equal(dates, d_hi))).cast(pa.int64()),
+        min_count=0).as_py())
+    got_t = sc.to_arrow()
+    got = int(pc.sum(pc.and_kleene(
+        pc.equal(got_t.column("ss_store_sk"), 3),
+        pc.and_kleene(
+            pc.greater_equal(got_t.column("ss_sold_date_sk"), d_lo),
+            pc.less_equal(got_t.column("ss_sold_date_sk"), d_hi))
+        ).cast(pa.int64()), min_count=0).as_py())
+
+    files_read = surviving.num_rows
+    ok = (got == exact and files_read < total and built == 1
+          and planned == 2 and sc.partition_pruned > 0
+          and sc.skipped_by_stats > 0)
+    print(f"tpcds store_sales scan @{scale} rows: {files_read}/{total} "
+          f"files read (partition pruned {sc.partition_pruned}, stats "
+          f"skipped {sc.skipped_by_stats}), rows {got}/{exact}, index "
+          f"builds={built} over {planned} device plans, "
+          f"{'OK' if ok else 'MISMATCH'}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "tpcds_scan_files_read",
+        "value": files_read if ok else -1,
+        "unit": "files",
+        "files_total": total,
+        "partition_pruned": sc.partition_pruned,
+        "stats_skipped": sc.skipped_by_stats,
+        "rows": got,
+        "stats_index_builds": built,
+    }))
+
+    # ---- Z-order payoff: clustering must raise the skip rate --------
+    zpath = os.path.join(root, "zorder")
+    rng = np.random.default_rng(23)
+    n = scale
+    zt = pa.table({
+        "x": pa.array(rng.integers(0, 1 << 20, n).astype(np.int64)),
+        "y": pa.array(rng.integers(0, 1 << 20, n).astype(np.int64)),
+        "payload": pa.array(rng.integers(0, 1 << 30, n).astype(np.int64)),
+    })
+    dta.write_table(zpath, zt, target_rows_per_file=2000)
+    tbl = Table.for_path(zpath)
+    box = ((col("x") < lit(1 << 18)) & (col("y") < lit(1 << 18)))
+
+    snap_pre = tbl.latest_snapshot()
+    pre_total = snap_pre.state.add_files_table.num_rows
+    pre_read = snap_pre.scan(filter=box).add_files_table().num_rows
+
+    # keep the output file count comparable to the input's so the
+    # before/after skip rates are apples to apples
+    total_bytes = int(pc.sum(
+        snap_pre.state.add_files_table.column("size")).as_py())
+    tbl.optimize().execute_zorder_by(
+        "x", "y", max_file_size=max(1, total_bytes // max(1, pre_total)))
+
+    snap_post = tbl.latest_snapshot()
+    post_files = snap_post.state.add_files_table
+    post_total = post_files.num_rows
+    post_read = snap_post.scan(filter=box).add_files_table().num_rows
+    tags = [json.loads(t) if t else {}
+            for t in post_files.column("tags").to_pylist()]
+    tagged = sum(1 for t in tags if t.get("ZCUBE_ID"))
+
+    zok = (post_read / max(1, post_total) < pre_read / max(1, pre_total)
+           and tagged == post_total)
+    print(f"zorder payoff: box predicate read {pre_read}/{pre_total} "
+          f"files before, {post_read}/{post_total} after OPTIMIZE "
+          f"ZORDER (x, y); {tagged} files ZCube-tagged, "
+          f"{'OK' if zok else 'NO-IMPROVEMENT'}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "zorder_box_files_read_frac",
+        "value": round(post_read / max(1, post_total), 4) if zok else -1.0,
+        "unit": "fraction",
+        "before_frac": round(pre_read / max(1, pre_total), 4),
+        "files_before": pre_total,
+        "files_after": post_total,
+        "zcube_tagged": tagged,
+    }))
+
+
 def main():
     commits = int(os.environ.get("BENCH_COMMITS", 100_000))
     workdir = os.environ.get("BENCH_WORKDIR", "/tmp/delta_tpu_bench")
@@ -1618,6 +1853,8 @@ def main():
     checkpoint_read_metric(workdir)
     checkpoint_write_metric(workdir)
     device_parse_metric()
+    scan_plan_metric()
+    tpcds_scan_metric(workdir)
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         sharded_metrics(timeout_s)
 

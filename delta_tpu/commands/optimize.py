@@ -227,6 +227,15 @@ def _run_optimize_inner(
         ]
     metrics = OptimizeMetrics()
 
+    # Explicit Z-order stamps ZCube tags on its output too, so scan
+    # planning (and the bench's skip-rate assert) can see which files
+    # were curve-clustered. Kept separate from `zcube_tags`: explicit
+    # zorder must not inherit the clustered path's stable-cube
+    # candidate filtering, clusteringProvider, or operationParameters
+    # clusterBy semantics.
+    explicit_tags = (new_zcube_tags(zorder_by, curve)
+                     if zorder_by and zcube_tags is None else None)
+
     # group per partition (bins never span partitions)
     by_partition: Dict[tuple, List[AddFile]] = {}
     for f in candidates:
@@ -257,6 +266,14 @@ def _run_optimize_inner(
                         a, tags={**(a.tags or {}), **zcube_tags},
                         clusteringProvider="liquid",
                     )
+                    for a in adds
+                ]
+            elif explicit_tags is not None:
+                import dataclasses
+
+                adds = [
+                    dataclasses.replace(
+                        a, tags={**(a.tags or {}), **explicit_tags})
                     for a in adds
                 ]
             new_adds.extend(adds)

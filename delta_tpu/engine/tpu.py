@@ -79,6 +79,10 @@ class TpuEngine(HostEngine):
     # numpy twin is bit-identical and skips the dispatch overhead.
     # DELTA_TPU_DEVICE_CKPT_STATS=1|0 overrides at the call site.
     use_device_ckpt_stats = False
+    # batched data-skipping over the resident stats index
+    # (ops/skipping.py): same autodetect contract — the numpy twin is
+    # bit-identical and dispatch-free on CPU backends.
+    use_device_skip = False
 
     def __init__(
         self,
@@ -111,6 +115,12 @@ class TpuEngine(HostEngine):
         # default. DELTA_TPU_DEVICE_PARSE=force|off overrides
         # (parallel/gate.py::parse_route).
         self.use_device_parse = accel_backend_default()
+        # scan-plan data skipping through the resident stats index:
+        # the lanes live in HBM across scans of one version, so on an
+        # accelerator the whole conjunct list is one dispatch.
+        # DELTA_TPU_DEVICE_SKIP=force|off overrides
+        # (parallel/gate.py::skip_route).
+        self.use_device_skip = accel_backend_default()
 
 
 def _default_mesh(replay_shards: Optional[int]):

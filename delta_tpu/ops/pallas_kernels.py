@@ -37,6 +37,15 @@ _SUBLANES = 8
 _TILE = _SUBLANES * _LANES
 
 
+def _x32():
+    """Scoped x32 context: `jax.enable_x64(False)` was removed from the
+    jax namespace; `jax.experimental.enable_x64` is the supported
+    scoped switch and takes the desired state as an argument."""
+    from jax.experimental import enable_x64
+
+    return enable_x64(False)
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -86,7 +95,7 @@ def interleave_bits_auto(cols, n_bits: int = 32):
     explicit, so a global x64 flip (the SQL spine's) must not leak in."""
     from delta_tpu.ops.zorder import interleave_bits
 
-    with jax.enable_x64(False):
+    with _x32():
         stacked = jnp.stack(list(cols))
         k, n = stacked.shape
         if not HAVE_PALLAS or n % _TILE != 0:
@@ -141,7 +150,7 @@ def batched_file_stats(values: np.ndarray, valid: np.ndarray):
     """Host wrapper: pad [F, R] to tile multiples, run the kernel, return
     numpy (min, max, null_count, num_records) per file. x32 pinned for
     the same Mosaic reason as interleave_bits_auto."""
-    with jax.enable_x64(False):
+    with _x32():
         return _batched_file_stats_impl(values, valid)
 
 
@@ -289,7 +298,7 @@ def unpack_bitpacked(packed_words: np.ndarray, w: int,
     # enabled global x64 (the SQL spine does) would otherwise feed it
     # i64 index maps and fail to legalize — dtypes here are explicit,
     # so pin x32 semantics for the call
-    with jax.enable_x64(False):
+    with _x32():
         arr = jax.device_put(shaped, device)
         if not HAVE_PALLAS:
             return _unpack_jnp(arr, w)[:n_groups * 32]

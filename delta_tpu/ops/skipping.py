@@ -1,0 +1,162 @@
+"""Batched data-skipping kernel: one dispatch over the whole conjunct
+list x file-stats table (reference `stats/DataSkippingReader.scala`
+constructDataFilters, here compiled instead of interpreted).
+
+`stats/device_index.py` columnarizes the snapshot's parsed file stats
+into an int64 lane matrix (3 rows per skipping-eligible column: min /
+max / nullCount, plus one trailing numRecords row) with a validity
+bitplane, resident on device across scans of one snapshot version. A
+scan's conjunct list is compiled into flat *atom* arrays — one atom per
+`col op lit` comparison, grouped so that OR-alternatives share a group
+id — and this module evaluates every atom against every file in ONE
+jitted call: gather the three stat rows per atom, apply the per-op
+"known false" predicate, segment-fold atoms into per-group skip
+verdicts, and AND the groups into a single keep mask (one bool D2H).
+
+Kleene semantics match the host Arrow path by construction: an atom is
+*known false* for a file only when the deciding stat is present and
+proves no row can match; anything unknown keeps the file. A group
+(OR of atoms) skips only when every atom is known false; the final
+mask is the AND over groups. All lane math is int64 (floats are
+pre-encoded into order-preserving int64 by the index builder), so the
+numpy twin below is bit-identical to the jit kernel and routing is a
+pure performance decision (`parallel/gate.py::skip_route`).
+
+Atom op codes:
+  0 '<'   1 '<='   2 '>'   3 '>='   4 '='   5 '!='
+  6 IS NULL        7 IS NOT NULL
+Ops 0-5 additionally treat an all-null column (nullCount == numRecords)
+as known false, mirroring the host path's not-all-null augmentation.
+
+This module performs no `jax.device_put`: the resident lanes are
+uploaded by the budgeted site in `stats/device_index.py`, and the
+per-scan atom arrays (~13 B per atom) ride along as jit arguments.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+
+from delta_tpu.ops.stats import _x64
+
+
+class AtomBlock(NamedTuple):
+    """Compiled conjunct list: flat atom arrays over the lane matrix.
+
+    `rows_mn/rows_mx/rows_nc` index lane-matrix rows (the index builder
+    lays column c out as rows 3c/3c+1/3c+2, numRecords last); `grp`
+    assigns each atom to an OR-group; groups are ANDed into the mask.
+    """
+
+    rows_mn: np.ndarray  # int32 [A] min-lane row per atom
+    rows_mx: np.ndarray  # int32 [A] max-lane row per atom
+    rows_nc: np.ndarray  # int32 [A] nullCount-lane row per atom
+    ops: np.ndarray      # int32 [A] op code (see module docstring)
+    lits: np.ndarray     # int64 [A] encoded literal (0 for ops 6/7)
+    grp: np.ndarray      # int32 [A] OR-group id, dense in [0, n_groups)
+    n_atoms: int
+    n_groups: int
+
+
+def _known_false(xp, mn, mx, nc, nr, vmn, vmx, vnc, vnr, ops, lits):
+    """Per-atom x per-file "stats prove no row matches" matrix.
+
+    Shared by the jit kernel and the numpy twin: `xp` is jax.numpy or
+    numpy, every input already broadcast to [A, F] (or [1, F] for
+    nr/vnr) and every value int64/bool, so both backends produce
+    bit-identical results.
+    """
+    op = ops[:, None]
+    lit = lits[:, None]
+    all_null = vnc & vnr & (nc == nr)
+    kf = xp.where(op == 0, vmn & (mn >= lit),
+         xp.where(op == 1, vmn & (mn > lit),
+         xp.where(op == 2, vmx & (mx <= lit),
+         xp.where(op == 3, vmx & (mx < lit),
+         xp.where(op == 4, (vmn & (mn > lit)) | (vmx & (mx < lit)),
+         xp.where(op == 5, vmn & vmx & (mn == lit) & (mx == lit),
+         xp.where(op == 6, vnc & (nc == 0),
+                  vnc & vnr & (nc == nr))))))))
+    return kf | ((op <= 5) & all_null)
+
+
+@functools.lru_cache(maxsize=32)
+def _skip_fn_cached(a_pad: int, g_segs: int):
+    """jit'd keep-mask kernel for `a_pad` atom slots folding into
+    `g_segs` segments (last segment is the pad-atom sink)."""
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(vals, valid, rows_mn, rows_mx, rows_nc, ops, lits, grp,
+               n_atoms):
+        mn, mx, nc = vals[rows_mn], vals[rows_mx], vals[rows_nc]
+        vmn, vmx, vnc = valid[rows_mn], valid[rows_mx], valid[rows_nc]
+        nr, vnr = vals[-1][None, :], valid[-1][None, :]
+        kf = _known_false(jnp, mn, mx, nc, nr, vmn, vmx, vnc, vnr,
+                          ops, lits)
+        pad = (jnp.arange(a_pad, dtype=jnp.int32) >= n_atoms)[:, None]
+        # pad atoms are routed to the sink segment with kf=True so they
+        # can never unskip a real group nor skip anything themselves
+        kf = jnp.where(pad, True, kf)
+        g_min = jax.ops.segment_min(kf.astype(jnp.int32), grp,
+                                    num_segments=g_segs)
+        counts = jax.ops.segment_sum(
+            jnp.where(pad[:, 0], 0, 1), grp, num_segments=g_segs)
+        skip_g = (g_min == 1) & (counts > 0)[:, None]
+        return ~jnp.any(skip_g[: g_segs - 1], axis=0)
+
+    return jax.jit(kernel)
+
+
+def skip_mask_block(dev_vals, dev_valid, block: AtomBlock,
+                    n_files: int) -> np.ndarray:
+    """Evaluate a compiled conjunct list against resident device lanes;
+    one dispatch, one bool-mask D2H. `dev_vals`/`dev_valid` are the
+    index's device arrays [R, F_pad]."""
+    import jax.numpy as jnp
+
+    from delta_tpu.ops.replay import pad_bucket
+
+    a_pad = pad_bucket(max(block.n_atoms, 1), min_bucket=16)
+    g_pad = pad_bucket(max(block.n_groups, 1), min_bucket=16)
+    g_segs = g_pad + 1
+
+    def _pad(a, fill, dtype):
+        out = np.full(a_pad, fill, dtype=dtype)
+        out[: block.n_atoms] = a
+        return out
+
+    rows_mn = _pad(block.rows_mn, 0, np.int32)
+    rows_mx = _pad(block.rows_mx, 0, np.int32)
+    rows_nc = _pad(block.rows_nc, 0, np.int32)
+    ops = _pad(block.ops, 0, np.int32)
+    lits = _pad(block.lits, 0, np.int64)
+    grp = _pad(block.grp, g_segs - 1, np.int32)
+    with _x64():
+        keep = _skip_fn_cached(a_pad, g_segs)(
+            dev_vals, dev_valid, rows_mn, rows_mx, rows_nc, ops,
+            jnp.asarray(lits), grp, np.int32(block.n_atoms))
+        return np.asarray(keep)[:n_files]
+
+
+def host_skip_mask(vals: np.ndarray, valid: np.ndarray, block: AtomBlock,
+                   n_files: int) -> np.ndarray:
+    """numpy twin of the device kernel: identical formulas over the
+    identical int64 lanes, so masks are bit-identical across routes."""
+    vals = vals[:, :n_files]
+    valid = valid[:, :n_files]
+    mn, mx, nc = vals[block.rows_mn], vals[block.rows_mx], vals[block.rows_nc]
+    vmn, vmx, vnc = (valid[block.rows_mn], valid[block.rows_mx],
+                     valid[block.rows_nc])
+    nr, vnr = vals[-1][None, :], valid[-1][None, :]
+    kf = _known_false(np, mn, mx, nc, nr, vmn, vmx, vnc, vnr,
+                      block.ops, block.lits)
+    keep = np.ones(n_files, dtype=bool)
+    for g in range(block.n_groups):
+        members = block.grp == g
+        if members.any():
+            keep &= ~kf[members].all(axis=0)
+    return keep

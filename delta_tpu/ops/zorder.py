@@ -129,12 +129,15 @@ def curve_order(key_words: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("curve",))
-def _curve_perm(cols: tuple, curve: str) -> jnp.ndarray:
+def _curve_perm(stacked: jnp.ndarray, curve: str) -> jnp.ndarray:
     """One fused device program: rank -> scale -> curve key -> argsort.
-    Row count is the (bucket-padded) static shape; padding rows carry
+    `stacked` is the [n_cols, m] uint32 key matrix — all clustering
+    columns ride ONE transfer and one dispatch; the column count and
+    the (bucket-padded) row count are static shapes. Padding rows carry
     the all-ones sentinel, rank at the top, and sort to the end of the
     curve (the host drops them from the permutation)."""
-    m = cols[0].shape[0]
+    m = stacked.shape[1]
+    cols = tuple(stacked[i] for i in range(stacked.shape[0]))
     ranks = [range_rank(c) for c in cols]
     if curve == "hilbert":
         n_bits = 16
@@ -160,22 +163,21 @@ def zorder_sort_indices(cols: Sequence[np.ndarray], curve: str = "zorder") -> np
     OPTIMIZE over many different bin sizes compiles a handful of
     programs instead of one per size, and the whole pipeline runs as a
     single jit (one dispatch, fully fused) rather than eager per-op
-    round-trips."""
+    round-trips. The per-column u32 keys are stacked into one host
+    matrix first, so ALL clustering columns cross the link in a single
+    transfer instead of one round trip per column."""
     n = len(cols[0])
     if n == 0:
         return np.empty(0, dtype=np.int32)
     from delta_tpu.ops.replay import pad_bucket
 
     m = pad_bucket(n, min_bucket=1024)
-    host_cols = []
-    for c in cols:
-        h = _to_sortable_u32(c)
-        if m > n:
-            # all-ones padding ranks above (or tied with) every real
-            # value, so padding rows sort to the end of the curve
-            h = np.concatenate([h, np.full(m - n, 0xFFFFFFFF, np.uint32)])
-        host_cols.append(jnp.asarray(h))
-    perm = np.asarray(_curve_perm(tuple(host_cols), curve))
+    # all-ones padding ranks above (or tied with) every real value, so
+    # padding rows sort to the end of the curve
+    stacked = np.full((len(cols), m), 0xFFFFFFFF, np.uint32)
+    for i, c in enumerate(cols):
+        stacked[i, :n] = _to_sortable_u32(c)
+    perm = np.asarray(_curve_perm(jnp.asarray(stacked), curve))
     if m > n:
         perm = perm[perm < n]
     return perm

@@ -29,6 +29,8 @@ profitable" so behavior is deterministic. Env overrides:
   DELTA_TPU_H2D_CHUNK          transfer chunk size override (bytes)
   DELTA_TPU_DEVICE_PARSE       force|1|on -> device JSON parse,
                                0|off -> host (parse_route)
+  DELTA_TPU_DEVICE_SKIP        force|1|on -> device data skipping,
+                               0|off -> host numpy twin (skip_route)
 """
 
 from __future__ import annotations
@@ -64,6 +66,14 @@ _FA_BYTES_PER_ROW = 0.25
 # the gate only needs the crossover's order of magnitude.
 _HOST_SCAN_BPS = 270e6
 _DEVICE_PARSE_BPS = 2e9
+
+# Data-skipping routing estimates in atom x file cells/s: the host
+# numpy twin streams a few int64 compares per cell, the device kernel
+# is one fused dispatch over lanes already resident in HBM (the index
+# ships once per snapshot version — see stats/device_index.py — so the
+# per-scan device cost is one RTT plus the compute).
+_HOST_SKIP_CELLS_PS = 50e6
+_DEVICE_SKIP_CELLS_PS = 5e9
 
 
 class LinkModel(NamedTuple):
@@ -229,4 +239,39 @@ def parse_route(
     model = link_model()
     t_host = nbytes / _HOST_SCAN_BPS
     t_device = model.h2d_seconds(nbytes) + nbytes / _DEVICE_PARSE_BPS
+    return "device" if t_device < t_host else "host"
+
+
+def skip_route(
+    n_files: int,
+    n_atoms: int,
+    engine_enabled: bool = False,
+    forced: Optional[str] = None,
+) -> str:
+    """Pick the data-skipping route for one scan plan: "host" (numpy
+    twin over the encoded lanes) or "device" (ops/skipping.py batched
+    kernel over the resident index).
+
+    Like `parse_route`, the CPU free-transfer model does not flip this
+    to device-always — the numpy twin is fast and allocation-free on
+    CPU backends, so the device route needs the engine's
+    construction-time opt-in (`use_device_skip`) before the economics
+    run. The economics differ from `parse_route` in one way: the lane
+    matrix is already HBM-resident (shipped once per snapshot version),
+    so the device side pays one dispatch RTT, never a bulk H2D.
+    DELTA_TPU_DEVICE_SKIP outranks everything (tests, bench lanes)."""
+    env = os.environ.get("DELTA_TPU_DEVICE_SKIP")
+    if env is not None:
+        if env.lower() in ("force", "1", "on", "device"):
+            return "device"
+        if env.lower() in ("0", "off", "host"):
+            return "host"
+    if forced in ("host", "device"):
+        return forced
+    if not engine_enabled or n_files <= 0 or n_atoms <= 0:
+        return "host"
+    model = link_model()
+    cells = float(n_files) * float(n_atoms)
+    t_host = cells / _HOST_SKIP_CELLS_PS
+    t_device = model.rtt_s + cells / _DEVICE_SKIP_CELLS_PS
     return "device" if t_device < t_host else "host"

@@ -314,3 +314,9 @@ def release_snapshot_resident(snapshot) -> None:
     if resident is not None:
         resident.release()
         state.resident = None
+    # the scan-planning stats index (stats/device_index.py) shares the
+    # residency lifecycle: evicting the snapshot frees its lanes too
+    stats_index = getattr(state, "stats_index", None)
+    if stats_index is not None:
+        stats_index.release()
+        state.stats_index = None

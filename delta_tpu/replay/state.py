@@ -64,11 +64,21 @@ class SnapshotState:
     # device buffer, so the prior owner's reference would be stale)
     resident: Optional[object] = field(default=None, repr=False,
                                        compare=False)
+    # Resident scan-planning stats index (stats/device_index.py):
+    # built at most once per state under `_stats_index_lock` — a
+    # dedicated lock because the build reads `add_files_table`, which
+    # takes `_splice_lock` itself. `advance_state` carries it forward
+    # on empty deltas and releases it otherwise; serve-cache eviction
+    # releases it through `release_snapshot_resident`.
+    stats_index: Optional[object] = field(default=None, repr=False,
+                                          compare=False)
 
     _add_table_cache: Optional[pa.Table] = None
     _tombstone_table_cache: Optional[pa.Table] = None
     _splice_lock: object = field(default_factory=threading.Lock,
                                  repr=False, compare=False)
+    _stats_index_lock: object = field(default_factory=threading.Lock,
+                                      repr=False, compare=False)
 
     @property
     def file_actions(self) -> pa.Table:
@@ -519,6 +529,19 @@ def advance_state(
         # buffer, so the prior state's reference is stale by definition
         new_state.resident = resident
         prev.resident = None
+    stats_index = prev.stats_index
+    if stats_index is not None:
+        if m == 0:
+            # empty delta: the live-file table is unchanged, so the
+            # index is still exact — ownership moves like `resident`
+            new_state.stats_index = stats_index
+            prev.stats_index = None
+        else:
+            # the prior version's lanes are stale; release the HBM now
+            # rather than waiting for eviction (the next scan of the
+            # new state rebuilds lazily)
+            stats_index.release()
+            prev.stats_index = None
     return new_state
 
 

@@ -96,7 +96,12 @@ class Scan:
             c for c in conjuncts
             if c.references() and all(r[0] in partition_cols for r in c.references())
         ]
-        data_conjuncts = [c for c in conjuncts if c not in part_conjuncts]
+        # identity, not `in`: Expression.__eq__ BUILDS a (truthy)
+        # Comparison node, so `c not in part_conjuncts` was False for
+        # every conjunct whenever any partition conjunct existed —
+        # silently disabling stats skipping on partition-filtered scans
+        part_ids = {id(c) for c in part_conjuncts}
+        data_conjuncts = [c for c in conjuncts if id(c) not in part_ids]
 
         keep = np.ones(files.num_rows, dtype=bool)
         if part_conjuncts:
@@ -115,6 +120,7 @@ class Scan:
                 data_conjuncts,
                 self._snapshot.metadata,
                 engine=self._snapshot._engine,
+                state=self._snapshot.state,
             )
             self.skipped_by_stats = int((keep & ~stats_keep).sum())
             keep &= stats_keep

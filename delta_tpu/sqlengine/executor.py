@@ -1950,6 +1950,17 @@ class _Exec:
                 return lo & hi if lo is not None and hi is not None \
                     else None
             if isinstance(e, InList) and not e.negated:
+                # emit one tree `In` (not an OR-chain of equalities):
+                # skipping compiles it to a single vectorizable
+                # conjunct with a range prefilter and a large-list
+                # fast path (stats/skipping.py, stats/device_index.py)
+                if isinstance(e.item, Col) and e.values and all(
+                    isinstance(v, Lit)
+                    and isinstance(v.value, (int, float, str, bool))
+                    for v in e.values
+                ):
+                    return t_col(e.item.parts[-1]).is_in(
+                        *[v.value for v in e.values])
                 out = None
                 for v in e.values:
                     c = conv(Cmp("=", e.item, v))

@@ -1,0 +1,457 @@
+"""Resident device stats index for scan planning.
+
+Once per snapshot version, the parsed file-stats table
+(`stats/skipping.py::StatsIndex`) is columnarized into a dense int64
+lane matrix covering every *skipping-eligible* column — numeric,
+timestamp, date, and bool leaves whose min/max stats parsed to a
+comparable type — and cached on `SnapshotState` next to the
+PR 7 resident replay state (`parallel/resident.py`):
+
+  row 3c   : minValues  of eligible column c
+  row 3c+1 : maxValues  of eligible column c
+  row 3c+2 : nullCount  of eligible column c
+  row -1   : numRecords
+
+plus a validity bitplane (missing/unparseable stat -> invalid ->
+"unknown" -> keep, preserving the host path's Kleene semantics). All
+lanes are int64 in an order-preserving encoding (see `_enc_f64` for
+the float total order; timestamps/dates become epoch microseconds), so
+`ops/skipping.py` can evaluate a whole conjunct list against every
+file in one type-agnostic dispatch on either backend, bit-identically.
+
+Lifecycle mirrors `parallel/resident.py` discipline: built at most
+once per `SnapshotState` under the state's dedicated
+`_stats_index_lock` (NOT `_splice_lock` — building reads
+`add_files_table`, which takes the splice lock itself), advanced by
+`replay/state.py::advance_state` (carried over verbatim on empty
+deltas, released and lazily rebuilt otherwise), and released on
+serve-cache eviction through `release_snapshot_resident`. The device
+upload is lazy (first device-routed scan) and budgeted in
+`resources/transfer_budget.json` (`stats-index-lanes`): the lanes ship
+ONCE per version and stay HBM-resident across scans, so the per-scan
+device cost is one RTT plus the compiled atom arrays.
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu import obs
+from delta_tpu.expressions.tree import (
+    Column,
+    Comparison,
+    Expression,
+    In,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Not,
+    Or,
+)
+from delta_tpu.ops.skipping import AtomBlock
+
+_BUILDS = obs.counter("scan.stats_index_builds")
+_REUSES = obs.counter("scan.stats_index_reuses")
+_HBM_BYTES = obs.gauge("scan.stats_index_hbm_bytes")
+
+_OP_CODES = {"<": 0, "<=": 1, ">": 2, ">=": 3, "=": 4, "!=": 5}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+_NEG = {"=": "!=", "!=": "=", "<": ">=", "<=": ">", ">": "<=", ">=": "<"}
+_OP_ISNULL = 6
+_OP_ISNOTNULL = 7
+
+# an int cast to float64 is exact only within +/-2^53; literals outside
+# that window fall back to the Arrow route rather than compare inexactly
+_F64_EXACT_INT = 1 << 53
+
+# In-lists longer than this compile to a pure range prefilter (two
+# atoms) instead of one '=' atom per value
+IN_LIST_ATOM_LIMIT = 64
+
+_ARROW_ERRS = (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+               pa.ArrowTypeError)
+
+
+def _enc_f64(a: np.ndarray) -> np.ndarray:
+    """Order-preserving float64 -> int64 total-order encoding (sign-
+    magnitude IEEE bits flipped into two's complement); -0.0 is
+    canonicalized to +0.0 first so both compare equal to 0."""
+    a = np.asarray(a, np.float64) + 0.0
+    u = a.view(np.int64)
+    return np.where(u >= 0, u, np.int64(np.iinfo(np.int64).min) ^ ~u)
+
+
+def _lane_kind(t: pa.DataType) -> Optional[str]:
+    """Encoding kind for a parsed stat leaf type; None = ineligible."""
+    if pa.types.is_boolean(t):
+        return "bool"
+    if pa.types.is_integer(t):
+        return "int"
+    if pa.types.is_floating(t):
+        return "float"
+    if pa.types.is_timestamp(t):
+        return None if t.tz is not None else "ts"
+    if pa.types.is_date(t):
+        return "ts"
+    return None
+
+
+def _resolve_kind(k_min: Optional[str], k_max: Optional[str]) -> Optional[str]:
+    """Unify the min/max leaf kinds (pa_json infers each JSON field
+    independently, so `min=1, max=1.5` parses as int64/double)."""
+    if k_min is None or k_max is None:
+        return None
+    if k_min == k_max:
+        return k_min
+    if {k_min, k_max} == {"int", "float"}:
+        return "float"
+    return None
+
+
+def _leaf_paths(t: pa.DataType, prefix: Tuple[str, ...] = ()) -> List[tuple]:
+    out = []
+    for f in t:
+        p = prefix + (f.name,)
+        if pa.types.is_struct(f.type):
+            out.extend(_leaf_paths(f.type, p))
+        else:
+            out.append(p)
+    return out
+
+
+def _encode_lane(arr: pa.Array, kind: str):
+    """(int64 values, validity) for one stat leaf under `kind`; invalid
+    slots hold 0. None when the whole leaf can't be encoded."""
+    try:
+        valid = np.asarray(pc.is_valid(arr), dtype=bool)
+        if kind == "bool":
+            enc = np.asarray(pc.fill_null(arr.cast(pa.int64()), 0), np.int64)
+        elif kind == "int":
+            enc = np.asarray(pc.fill_null(arr.cast(pa.int64()), 0), np.int64)
+        elif kind == "float":
+            f = np.asarray(pc.fill_null(arr.cast(pa.float64()), 0.0),
+                           np.float64)
+            if pa.types.is_integer(arr.type):
+                # int64 -> float64 is lossy past 2^53: such stats stay
+                # "unknown" rather than compare inexactly
+                raw = np.asarray(pc.fill_null(arr.cast(pa.int64()), 0),
+                                 np.int64)
+                valid &= np.abs(raw) <= _F64_EXACT_INT
+            valid &= ~np.isnan(f)
+            enc = _enc_f64(f)
+        elif kind == "ts":
+            ts = arr.cast(pa.timestamp("us"))
+            enc = np.asarray(pc.fill_null(ts.cast(pa.int64()), 0), np.int64)
+        else:
+            return None
+        return enc, valid
+    except _ARROW_ERRS:
+        return None
+
+
+def encode_literal(value, kind: str) -> Optional[int]:
+    """Encode a predicate literal into the lane's int64 order; None =
+    not exactly representable -> the conjunct falls back to Arrow."""
+    if value is None:
+        return None
+    if kind == "bool":
+        return int(value) if isinstance(value, bool) else None
+    if isinstance(value, bool):
+        return None
+    if kind == "int":
+        if isinstance(value, (int, np.integer)):
+            v = int(value)
+            return v if -(1 << 63) <= v < (1 << 63) else None
+        return None
+    if kind == "float":
+        if isinstance(value, (int, np.integer)):
+            if abs(int(value)) > _F64_EXACT_INT:
+                return None
+            value = float(value)
+        if isinstance(value, (float, np.floating)):
+            f = np.float64(value)
+            if np.isnan(f):
+                return None
+            return int(_enc_f64(np.asarray([f]))[0])
+        return None
+    if kind == "ts":
+        if isinstance(value, datetime.datetime) and value.tzinfo is not None:
+            return None
+        if isinstance(value, (str, datetime.date, datetime.datetime)):
+            try:
+                s = pa.scalar(value).cast(pa.timestamp("us"))
+            except _ARROW_ERRS:
+                return None
+            return s.value if s.is_valid else None
+        return None
+    return None
+
+
+class ResidentStatsIndex:
+    """Per-snapshot-version stats index: the parsed Arrow table (shared
+    with the host fallback ladder) plus the encoded int64 lanes, with a
+    lazily uploaded device copy."""
+
+    def __init__(self, arrow_index, vals: Optional[np.ndarray],
+                 valid: Optional[np.ndarray],
+                 cols: Dict[tuple, Tuple[int, str]], n: int):
+        self._lock = threading.Lock()
+        self.arrow_index = arrow_index
+        self.vals = vals          # int64 [R, n_pad] or None
+        self.valid = valid        # bool  [R, n_pad] or None
+        self.cols = cols          # {physical name_path: (min row, kind)}
+        self.n = n
+        self.released = False
+        self._dev = None
+        self._hbm_bytes = 0
+
+    @property
+    def has_lanes(self) -> bool:
+        return self.vals is not None and not self.released
+
+    def device_lanes(self):
+        """(values, validity) device arrays, uploading on first use."""
+        with self._lock:
+            return self._upload_locked()
+
+    def _upload_locked(self):
+        if self._dev is not None or self.vals is None or self.released:
+            return self._dev
+        import jax
+        import jax.numpy as jnp
+
+        from delta_tpu.ops.stats import _x64
+
+        n_pad = self.vals.shape[1]
+        lane_vals = np.asarray(self.vals, np.int64)
+        valid_words = np.packbits(np.asarray(self.valid, bool), axis=1,
+                                  bitorder="little")
+        with _x64():
+            dv = jax.device_put(lane_vals)
+            dw = jax.device_put(valid_words)
+            dvalid = jnp.unpackbits(dw, axis=1, count=n_pad,
+                                    bitorder="little").astype(bool)
+        self._dev = (dv, dvalid)
+        self._hbm_bytes = int(dv.nbytes + dvalid.nbytes)
+        _HBM_BYTES.inc(self._hbm_bytes)
+        return self._dev
+
+    def release(self) -> None:
+        """Drop host lanes and the device copy (serve-cache eviction or
+        version advancement). jax arrays are refcounted, so a scan
+        concurrently holding the lanes finishes safely; the next scan
+        of a still-live snapshot simply rebuilds."""
+        with self._lock:
+            if self._dev is not None:
+                _HBM_BYTES.dec(self._hbm_bytes)
+                self._hbm_bytes = 0
+                self._dev = None
+            self.vals = None
+            self.valid = None
+            self.released = True
+
+
+def build_index(files: pa.Table) -> ResidentStatsIndex:
+    """Columnarize one snapshot version's parsed stats into lanes."""
+    from delta_tpu.ops.replay import pad_bucket
+    from delta_tpu.stats.skipping import StatsIndex
+
+    arrow_index = StatsIndex.from_stats_column(files.column("stats"))
+    n = arrow_index.n
+    table = arrow_index._table
+    if table is None:
+        return ResidentStatsIndex(arrow_index, None, None, {}, n)
+
+    names = table.column_names
+    mins = table.column("minValues").combine_chunks() \
+        if "minValues" in names else None
+    maxs = table.column("maxValues").combine_chunks() \
+        if "maxValues" in names else None
+    if (mins is None or maxs is None
+            or not pa.types.is_struct(mins.type)
+            or not pa.types.is_struct(maxs.type)):
+        return ResidentStatsIndex(arrow_index, None, None, {}, n)
+
+    lanes: List[Tuple[np.ndarray, np.ndarray]] = []
+    cols: Dict[tuple, Tuple[int, str]] = {}
+    nr = arrow_index.num_records()
+    for path in _leaf_paths(mins.type):
+        mn = arrow_index.min_values(path)
+        mx = arrow_index.max_values(path)
+        if mn is None or mx is None:
+            continue
+        kind = _resolve_kind(_lane_kind(mn.type), _lane_kind(mx.type))
+        if kind is None:
+            continue
+        enc_mn = _encode_lane(mn, kind)
+        enc_mx = _encode_lane(mx, kind)
+        if enc_mn is None or enc_mx is None:
+            continue
+        nc = arrow_index.null_count(path)
+        enc_nc = _encode_lane(nc, "int") if nc is not None else None
+        if enc_nc is None:
+            enc_nc = (np.zeros(n, np.int64), np.zeros(n, bool))
+        cols[path] = (len(lanes), kind)
+        lanes.extend((enc_mn, enc_mx, enc_nc))
+    if not cols:
+        return ResidentStatsIndex(arrow_index, None, None, {}, n)
+
+    enc_nr = _encode_lane(nr, "int") if nr is not None else None
+    if enc_nr is None:
+        enc_nr = (np.zeros(n, np.int64), np.zeros(n, bool))
+    lanes.append(enc_nr)
+
+    n_pad = pad_bucket(max(n, 1), min_bucket=128)
+    vals = np.zeros((len(lanes), n_pad), np.int64)
+    valid = np.zeros((len(lanes), n_pad), bool)
+    for r, (ev, eva) in enumerate(lanes):
+        vals[r, :n] = ev
+        valid[r, :n] = eva
+    return ResidentStatsIndex(arrow_index, vals, valid, cols, n)
+
+
+def _compile_conj(conj: Expression,
+                  cols: Dict[tuple, Tuple[int, str]]):
+    """Compile one conjunct to a list of OR-groups of atom triples
+    (min_row, op_code, encoded literal); None = not compilable (the
+    conjunct joins the Arrow fallback ladder)."""
+    if isinstance(conj, Comparison):
+        sides = (conj.left, conj.right)
+        if isinstance(sides[0], Column) and isinstance(sides[1], Literal):
+            colref, lit, op = sides[0], sides[1], conj.op
+        elif isinstance(sides[1], Column) and isinstance(sides[0], Literal):
+            colref, lit, op = sides[1], sides[0], _FLIP[conj.op]
+        else:
+            return None
+        ent = cols.get(colref.name_path)
+        if ent is None or op not in _OP_CODES:
+            return None
+        enc = encode_literal(lit.value, ent[1])
+        if enc is None:
+            return None
+        return [[(ent[0], _OP_CODES[op], enc)]]
+    if isinstance(conj, Or):
+        left = _compile_conj(conj.left, cols)
+        right = _compile_conj(conj.right, cols)
+        if left is None or right is None or len(left) != 1 or len(right) != 1:
+            # an AND nested under OR doesn't flatten into atom groups;
+            # the host ladder keeps it (it returns None there too)
+            return None
+        return [left[0] + right[0]]
+    if isinstance(conj, (IsNull, IsNotNull)):
+        child = conj.child
+        ent = cols.get(child.name_path) if isinstance(child, Column) else None
+        if ent is None:
+            return None
+        code = _OP_ISNULL if isinstance(conj, IsNull) else _OP_ISNOTNULL
+        return [[(ent[0], code, 0)]]
+    if isinstance(conj, In):
+        if not isinstance(conj.child, Column) or not conj.values:
+            return None
+        ent = cols.get(conj.child.name_path)
+        if ent is None:
+            return None
+        encs = []
+        for v in conj.values:
+            e = encode_literal(v, ent[1])
+            if e is None:
+                return None
+            encs.append(e)
+        if len(encs) > IN_LIST_ATOM_LIMIT:
+            # range prefilter only: col >= min(values) AND col <= max
+            # (the encoding is order-preserving, so min/max over the
+            # encoded ints bound the raw values)
+            return [[(ent[0], _OP_CODES[">="], min(encs))],
+                    [(ent[0], _OP_CODES["<="], max(encs))]]
+        return [[(ent[0], _OP_CODES["="], e) for e in encs]]
+    if isinstance(conj, Not):
+        inner = conj.child
+        if isinstance(inner, Comparison):
+            return _compile_conj(
+                Comparison(_NEG[inner.op], inner.left, inner.right), cols)
+        if isinstance(inner, IsNull):
+            return _compile_conj(IsNotNull(inner.child), cols)
+        if isinstance(inner, IsNotNull):
+            return _compile_conj(IsNull(inner.child), cols)
+        return None
+    return None
+
+
+def compile_conjuncts(conjuncts: List[Expression],
+                      index: ResidentStatsIndex):
+    """Split a conjunct list into (AtomBlock, fallback conjuncts). The
+    block covers every compilable conjunct in ONE dispatch; the rest
+    go through the per-conjunct Arrow ladder on both routes, so the
+    final mask is route-independent by construction."""
+    if not index.has_lanes:
+        return None, list(conjuncts)
+    rows_mn: List[int] = []
+    ops: List[int] = []
+    lits: List[int] = []
+    grp: List[int] = []
+    fallback: List[Expression] = []
+    n_groups = 0
+    for conj in conjuncts:
+        groups = _compile_conj(conj, index.cols)
+        if groups is None:
+            fallback.append(conj)
+            continue
+        for g in groups:
+            for (row0, code, enc) in g:
+                rows_mn.append(row0)
+                ops.append(code)
+                lits.append(enc)
+                grp.append(n_groups)
+            n_groups += 1
+    if not rows_mn:
+        return None, fallback
+    rmn = np.asarray(rows_mn, np.int32)
+    block = AtomBlock(
+        rows_mn=rmn,
+        rows_mx=rmn + 1,
+        rows_nc=rmn + 2,
+        ops=np.asarray(ops, np.int32),
+        lits=np.asarray(lits, np.int64),
+        grp=np.asarray(grp, np.int32),
+        n_atoms=len(rows_mn),
+        n_groups=n_groups,
+    )
+    return block, fallback
+
+
+def snapshot_stats_index(state, files: pa.Table):
+    """The state's resident index, building it on first use. Returns
+    None when `state` can't host one or `files` isn't the state's own
+    live-file table (e.g. the conflict checker's stats subsets)."""
+    lock = getattr(state, "_stats_index_lock", None)
+    if lock is None:
+        return None
+    try:
+        if state.add_files_table is not files:
+            return None
+    except AttributeError:
+        return None
+    with lock:
+        idx = state.stats_index
+        if idx is not None and not idx.released:
+            _REUSES.inc()
+            return idx
+        idx = build_index(files)
+        state.stats_index = idx
+        _BUILDS.inc()
+        return idx
+
+
+def release_state_stats_index(state) -> None:
+    """Release a state's resident index, if any (duck-typed like
+    `parallel/resident.py::release_snapshot_resident`)."""
+    idx = getattr(state, "stats_index", None)
+    if idx is not None:
+        idx.release()
+        state.stats_index = None

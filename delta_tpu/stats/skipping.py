@@ -4,9 +4,14 @@ constructDataFilters).
 
 The stats index is columnar: the `stats` JSON strings of all surviving
 AddFiles are parsed in ONE `pyarrow.json.read_json` call into struct
-columns (`numRecords`, `minValues.*`, `maxValues.*`, `nullCount.*`), then
-per-conjunct keep-masks are evaluated vectorized — numpy on the host
-engine, jit'd on device for the TpuEngine (delta_tpu.ops.stats).
+columns (`numRecords`, `minValues.*`, `maxValues.*`, `nullCount.*`).
+When the caller supplies the snapshot's `SnapshotState`, the parsed
+stats are further columnarized once per version into the resident
+device lanes of `stats/device_index.py`, and every compilable conjunct
+is evaluated in one batched dispatch (`ops/skipping.py`, jit kernel or
+bit-identical numpy twin per `parallel/gate.py::skip_route`); anything
+the compiler can't express — string and complex columns, inexact
+literals — falls back to the per-conjunct Arrow ladder in this module.
 
 Semantics: a file is SKIPPED only when stats *prove* no row can match.
 Missing stats (null stats string, missing column, or unparseable value)
@@ -24,6 +29,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 import pyarrow.json as pa_json
 
+from delta_tpu import obs
 from delta_tpu.expressions.tree import (
     Column,
     Comparison,
@@ -35,6 +41,9 @@ from delta_tpu.expressions.tree import (
     Not,
     Or,
 )
+
+_DEVICE_PLANS = obs.counter("scan.device_plans")
+_DEVICE_FALLBACKS = obs.counter("scan.device_fallbacks")
 
 
 class StatsIndex:
@@ -52,11 +61,34 @@ class StatsIndex:
             return StatsIndex(None, n)
         # one-shot parse: substitute "{}" for null rows to keep row alignment
         filled = pc.fill_null(arr, "{}")
+        # pretty-printed stats embed raw newlines, which would desync the
+        # one-row-per-line framing below (parsed.num_rows != n -> ALL
+        # skipping silently disabled). Raw newlines are illegal inside a
+        # JSON string value (they must be escaped as \n), so every literal
+        # newline in a stats row is structural whitespace — flatten it.
+        filled = pc.replace_substring(filled, pattern="\r", replacement=" ")
+        filled = pc.replace_substring(filled, pattern="\n", replacement=" ")
         joined = ("\n".join(filled.to_pylist()) + "\n").encode()
         try:
             parsed = pa_json.read_json(pa.BufferReader(joined))
         except pa.ArrowInvalid:
-            return StatsIndex(None, n)
+            # A non-finite float stat serializes as the string "NaN" /
+            # "Infinity" / "-Infinity" (see collection.py); ONE such
+            # file makes Arrow's JSON inference see a string/number mix
+            # and refuse the column — which used to disable skipping
+            # for the whole table. Nulling those tokens loses only
+            # precision (a null stat means unknown -> keep), never
+            # correctness: a raw `:"NaN"` byte sequence cannot occur
+            # inside a JSON string value (its quote would be escaped),
+            # so only whole stat values can match.
+            for tok in ('"NaN"', '"Infinity"', '"-Infinity"'):
+                filled = pc.replace_substring_regex(
+                    filled, pattern=r":\s*" + tok, replacement=":null")
+            joined = ("\n".join(filled.to_pylist()) + "\n").encode()
+            try:
+                parsed = pa_json.read_json(pa.BufferReader(joined))
+            except pa.ArrowInvalid:
+                return StatsIndex(None, n)
         if parsed.num_rows != n:
             return StatsIndex(None, n)
         return StatsIndex(parsed, n)
@@ -90,29 +122,64 @@ class StatsIndex:
         return self._leaf("nullCount", name_path)
 
 
+def _max_truncated(maxv) -> Optional[pa.Array]:
+    """Per-file "this string max MAY be truncated" mask. The collector
+    caps string maxValues at MAX_STRING_PREFIX_LENGTH with an upward
+    tie-break (stats/collection.py), and foreign writers do the same,
+    so any stored max AT the cap may differ from the true column max —
+    comparisons that rely on the max being exact must keep such files."""
+    if maxv is None or not (pa.types.is_string(maxv.type)
+                            or pa.types.is_large_string(maxv.type)):
+        return None
+    from delta_tpu.stats.collection import MAX_STRING_PREFIX_LENGTH
+
+    return pc.greater_equal(pc.utf8_length(maxv),
+                            pa.scalar(MAX_STRING_PREFIX_LENGTH))
+
+
 def _cmp_keep(op: str, minv, maxv, lit_arr) -> Optional[pa.Array]:
     """Keep-condition (nullable bool Arrow array) for `col op lit` given
-    min/max arrays; None = cannot decide (keep)."""
+    min/max arrays; None = cannot decide (keep).
+
+    String maxValues get prefix-aware semantics: a truncated max is only
+    a lower bound on the true max (tie-broken upward), so `maxv >= lit`
+    may be false while rows above `lit` exist — every max-dependent
+    verdict is widened to keep possibly-truncated files. minValues need
+    no guard: a truncated min prefix sorts <= the true min, so min-side
+    comparisons are already conservative."""
     try:
+        trunc = _max_truncated(maxv)
         if op == "=":
             if minv is None or maxv is None:
                 return None
-            return pc.and_kleene(pc.less_equal(minv, lit_arr), pc.greater_equal(maxv, lit_arr))
+            hi = pc.greater_equal(maxv, lit_arr)
+            if trunc is not None:
+                hi = pc.or_kleene(hi, trunc)
+            return pc.and_kleene(pc.less_equal(minv, lit_arr), hi)
         if op == "<":
             return None if minv is None else pc.less(minv, lit_arr)
         if op == "<=":
             return None if minv is None else pc.less_equal(minv, lit_arr)
         if op == ">":
-            return None if maxv is None else pc.greater(maxv, lit_arr)
+            if maxv is None:
+                return None
+            keep = pc.greater(maxv, lit_arr)
+            return keep if trunc is None else pc.or_kleene(keep, trunc)
         if op == ">=":
-            return None if maxv is None else pc.greater_equal(maxv, lit_arr)
+            if maxv is None:
+                return None
+            keep = pc.greater_equal(maxv, lit_arr)
+            return keep if trunc is None else pc.or_kleene(keep, trunc)
         if op == "!=":
             if minv is None or maxv is None:
                 return None
-            # skip only when min == max == lit (every row equals lit)
-            return pc.invert(
-                pc.and_kleene(pc.equal(minv, lit_arr), pc.equal(maxv, lit_arr))
-            )
+            # skip only when min == max == lit (every row equals lit) —
+            # and the max is exact, not a truncation-bumped prefix
+            all_eq = pc.and_kleene(pc.equal(minv, lit_arr),
+                                   pc.equal(maxv, lit_arr))
+            if trunc is not None:
+                all_eq = pc.and_kleene(all_eq, pc.invert(trunc))
+            return pc.invert(all_eq)
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
         return None
     return None
@@ -179,12 +246,40 @@ def _conjunct_keep(conj: Expression, index: StatsIndex) -> Optional[pa.Array]:
         return None
     if isinstance(conj, In):
         if isinstance(conj.child, Column) and conj.values:
+            if any(v is None for v in conj.values):
+                return None
+            # range prefilter: one pass with min(values)/max(values)
+            # bounds instead of len(values) passes — any file outside
+            # [min, max] can't contain any listed value
+            pre = None
+            try:
+                lo, hi = min(conj.values), max(conj.values)
+            except TypeError:  # mixed uncomparable values
+                lo = hi = None
+            if lo is not None:
+                k_lo = _conjunct_keep(
+                    Comparison(">=", conj.child, Literal(lo)), index)
+                k_hi = _conjunct_keep(
+                    Comparison("<=", conj.child, Literal(hi)), index)
+                if k_lo is not None and k_hi is not None:
+                    pre = pc.and_kleene(k_lo, k_hi)
+                elif k_lo is not None or k_hi is not None:
+                    pre = k_lo if k_lo is not None else k_hi
+            if pre is not None:
+                # large lists: the range bound IS the verdict (still
+                # conservative — a superset of the exact per-value OR)
+                if len(conj.values) > 64:
+                    return pre
+                if not pc.any(pc.fill_null(pre, True)).as_py():
+                    return pre  # nothing survives the range — done
             keeps = None
             for v in conj.values:
                 k = _conjunct_keep(Comparison("=", conj.child, Literal(v)), index)
                 if k is None:
-                    return None
+                    return pre
                 keeps = k if keeps is None else pc.or_kleene(keeps, k)
+            if keeps is not None and pre is not None:
+                keeps = pc.and_kleene(keeps, pre)
             return keeps
         return None
     if isinstance(conj, Not):
@@ -234,13 +329,29 @@ def skipping_mask(
     conjuncts: List[Expression],
     metadata,
     engine=None,
+    state=None,
 ) -> np.ndarray:
-    """Boolean keep-mask over `files` rows. True = must read the file."""
+    """Boolean keep-mask over `files` rows. True = must read the file.
+
+    With `state` (the snapshot's `SnapshotState`), skipping plans
+    through the resident stats index (`stats/device_index.py`): every
+    compilable conjunct is evaluated in ONE batched dispatch over the
+    encoded int64 lanes — jit kernel or its bit-identical numpy twin,
+    chosen by `parallel/gate.py::skip_route` — and only the remainder
+    (string/complex/missing-stats columns, inexact literals) walks the
+    per-conjunct Arrow ladder below. Both routes AND into the same
+    mask, so the result is route-independent by construction."""
     n = files.num_rows
     keep = np.ones(n, dtype=bool)
     if n == 0 or not conjuncts:
         return keep
-    index = StatsIndex.from_stats_column(files.column("stats"))
+    rs = None
+    if state is not None:
+        from delta_tpu.stats.device_index import snapshot_stats_index
+
+        rs = snapshot_stats_index(state, files)
+    index = rs.arrow_index if rs is not None \
+        else StatsIndex.from_stats_column(files.column("stats"))
     if index._table is None:
         return keep
     if (
@@ -254,7 +365,34 @@ def skipping_mask(
             if t is not None:
                 translated.append(t)
         conjuncts = translated
-    for conj in conjuncts:
+    fallback = conjuncts
+    if rs is not None and rs.has_lanes:
+        from delta_tpu.ops import skipping as ops_skipping
+        from delta_tpu.parallel.gate import skip_route
+        from delta_tpu.stats.device_index import compile_conjuncts
+
+        block, fallback = compile_conjuncts(conjuncts, rs)
+        if block is not None:
+            route = skip_route(
+                n, block.n_atoms,
+                engine_enabled=bool(getattr(engine, "use_device_skip", False)),
+            )
+            if route == "device":
+                lanes = rs.device_lanes()
+                if lanes is None:
+                    route = "host"
+                else:
+                    keep &= ops_skipping.skip_mask_block(
+                        lanes[0], lanes[1], block, n)
+                    _DEVICE_PLANS.inc()
+                    if fallback:
+                        _DEVICE_FALLBACKS.inc(len(fallback))
+            if route == "host":
+                keep &= ops_skipping.host_skip_mask(
+                    rs.vals, rs.valid, block, n)
+            obs.set_attrs(skip_route=route, skip_atoms=block.n_atoms,
+                          skip_fallback_conjuncts=len(fallback))
+    for conj in fallback:
         mask = _conjunct_keep(conj, index)
         if mask is None:
             continue
