@@ -19,6 +19,26 @@ process-wide; resolve them once at module import and call ``.inc()`` on
 the hot path.
 """
 
+from delta_tpu.obs.device import (
+    CONDITIONS_SCHEMA,
+    CONDITIONS_UNKNOWN,
+    capture_conditions,
+    conditions_fingerprint,
+    device_dispatch,
+    device_obs_enabled,
+    device_obs_mode,
+    dump_gate_log,
+    export_device_merit,
+    flush_gate_decisions,
+    gate_fell_back,
+    gate_observation,
+    get_dispatch_records,
+    get_gate_records,
+    record_gate_decision,
+    reset_device_obs,
+    set_device_obs_mode,
+    summarize_gates,
+)
 from delta_tpu.obs.export import (
     JsonlExporter,
     chrome_trace,
@@ -89,6 +109,8 @@ if trace_enabled():
     del _install_env_exporter_once
 
 __all__ = [
+    "CONDITIONS_SCHEMA",
+    "CONDITIONS_UNKNOWN",
     "CONTENT_TYPE",
     "EXPORT_BUCKETS",
     "MODE_OFF",
@@ -107,15 +129,29 @@ __all__ = [
     "Span",
     "add_event",
     "add_exporter",
+    "capture_conditions",
     "chrome_trace",
+    "conditions_fingerprint",
     "counter",
     "current_span",
+    "device_dispatch",
+    "device_obs_enabled",
+    "device_obs_mode",
+    "dump_gate_log",
+    "export_device_merit",
+    "flush_gate_decisions",
+    "gate_fell_back",
+    "gate_observation",
     "gauge",
+    "get_dispatch_records",
     "get_finished_spans",
+    "get_gate_records",
     "histogram",
     "load_spans",
     "metric_catalog",
     "metrics_snapshot",
+    "record_gate_decision",
+    "reset_device_obs",
     "parse_prometheus",
     "process_label",
     "prom_name",
@@ -127,8 +163,10 @@ __all__ = [
     "serve_objectives",
     "set_attr",
     "set_attrs",
+    "set_device_obs_mode",
     "set_process_label",
     "set_trace_mode",
+    "summarize_gates",
     "set_trace_sample",
     "span",
     "span_to_dict",

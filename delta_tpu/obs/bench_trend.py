@@ -1,0 +1,293 @@
+"""delta-bench-trend: regression verdicts over historical bench captures.
+
+Every repo revision leaves a ``BENCH_r*.json`` artifact behind, but a
+raw series of numbers answers the wrong question — benchmark noise on a
+shared CPU container routinely swings 10-20%, so "is r06 slower than
+r05" is meaningless without a noise model. This tool loads the whole
+historical series, groups points by *capture conditions* (platform,
+device kind/count, cache state — see
+`obs.device.capture_conditions`), and judges the newest point of each
+metric against the robust spread (median absolute deviation) of its
+comparable history:
+
+- ``regressed`` / ``improved``: the newest point sits outside the noise
+  band ``max(--min-band-pct, 2*MAD/median)`` in the direction-adjusted
+  worse/better sense;
+- ``stable``: inside the band;
+- ``insufficient-history``: fewer than ``--min-history`` comparable
+  points (different conditions fingerprints never compare — a TPU
+  capture is not a baseline for a CPU-container capture);
+- ``unknown-direction``: the metric name matches no direction rule, so
+  the tool refuses to call a winner.
+
+Artifact heterogeneity is absorbed here, not in the artifacts: r01-r05
+predate the ``metrics`` list (single ``parsed`` record plus
+``{"metric": ...}`` JSON lines embedded in the captured ``tail``), r06+
+carry a ``metrics`` list, r20+ stamp ``conditions``. ``--backfill``
+annotates pre-conditions artifacts with the sentinel
+``"unknown-pre-r20"`` so they form their own comparison group instead
+of silently mixing with conditioned captures.
+
+Usage::
+
+    delta-bench-trend                        # verdicts over ./BENCH_r*.json
+    delta-bench-trend --metric e2e_snapshot_load_actions_per_sec
+    delta-bench-trend --json                 # verdicts as JSON
+    delta-bench-trend --backfill             # stamp legacy artifacts
+    python -m delta_tpu.obs.bench_trend      # same, without the script
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+from delta_tpu.obs.device import CONDITIONS_UNKNOWN, conditions_fingerprint
+
+# Direction: +1 = higher is better, -1 = lower is better. Explicit
+# entries first (names where suffix heuristics would guess wrong, e.g.
+# reuse_pct is a hit rate, not an overhead), then suffix rules.
+_DIRECTION: Dict[str, int] = {
+    "incremental_checkpoint_reuse_pct": +1,
+    "replay_kernel_vs_host_vectorized": +1,
+    "analyzer_findings_total": -1,
+    "serve_p99_ms_chaos": -1,
+}
+
+_LOWER_MARKERS = ("overhead", "latency", "findings")
+_LOWER_SUFFIXES = ("_seconds", "_ms", "_ns", "_bytes", "_pct")
+_HIGHER_SUFFIXES = ("_per_sec", "_per_s", "_qps", "_gbps")
+
+
+def metric_direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
+    if name in _DIRECTION:
+        return _DIRECTION[name]
+    if any(m in name for m in _LOWER_MARKERS) or name.startswith("cold_"):
+        return -1
+    if name.endswith(_LOWER_SUFFIXES):
+        return -1
+    if name.endswith(_HIGHER_SUFFIXES) or "speedup" in name:
+        return +1
+    return 0
+
+
+_RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _extract_metrics(artifact: Dict[str, Any]) -> Dict[str, float]:
+    """Name -> value for one artifact, newest representation winning:
+    tail-embedded JSON lines < ``parsed`` < ``metrics`` list."""
+    out: Dict[str, float] = {}
+    for line in str(artifact.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith('{"metric"'):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec.get("value"), (int, float)):
+            out[str(rec["metric"])] = float(rec["value"])
+    for rec in ([artifact.get("parsed")] +
+                list(artifact.get("metrics") or [])):
+        if (isinstance(rec, dict) and "metric" in rec
+                and isinstance(rec.get("value"), (int, float))):
+            out[str(rec["metric"])] = float(rec["value"])
+    return out
+
+
+def load_bench_runs(paths: List[str]) -> List[Dict[str, Any]]:
+    """Parse artifacts into uniform run records, ordered by run number:
+    ``{"n", "path", "conditions", "fingerprint", "metrics"}``."""
+    runs = []
+    for path in paths:
+        m = _RUN_RE.search(os.path.basename(path))
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(artifact, dict):
+            continue
+        n = int(m.group(1)) if m else int(artifact.get("n", 0))
+        cond = artifact.get("conditions", CONDITIONS_UNKNOWN)
+        runs.append({
+            "n": n,
+            "path": path,
+            "conditions": cond,
+            "fingerprint": conditions_fingerprint(cond),
+            "metrics": _extract_metrics(artifact),
+        })
+    runs.sort(key=lambda r: r["n"])
+    return runs
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    k = len(s)
+    mid = k // 2
+    return s[mid] if k % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def trend_verdicts(
+    runs: List[Dict[str, Any]],
+    min_history: int = 3,
+    min_band_pct: float = 10.0,
+    metrics: Optional[List[str]] = None,
+) -> List[Dict[str, Any]]:
+    """Judge each metric's newest point against its comparable history.
+
+    Comparable = same conditions fingerprint as the newest point. The
+    noise band widens with the history's own scatter (2x the MAD as a
+    fraction of the median) but never below ``min_band_pct`` — a
+    3-point history with zero variance should not flag a 0.1% wiggle.
+    """
+    by_metric: Dict[str, List[Dict[str, Any]]] = {}
+    for run in runs:
+        for name, value in run["metrics"].items():
+            if metrics and name not in metrics:
+                continue
+            by_metric.setdefault(name, []).append(
+                {"n": run["n"], "value": value,
+                 "fingerprint": run["fingerprint"]})
+
+    verdicts = []
+    for name in sorted(by_metric):
+        points = by_metric[name]
+        latest = points[-1]
+        history = [p["value"] for p in points[:-1]
+                   if p["fingerprint"] == latest["fingerprint"]]
+        v: Dict[str, Any] = {
+            "metric": name,
+            "latest_run": latest["n"],
+            "latest_value": latest["value"],
+            "comparable_points": len(history),
+            "fingerprint": latest["fingerprint"],
+        }
+        if len(history) < min_history:
+            v["verdict"] = "insufficient-history"
+            verdicts.append(v)
+            continue
+        med = _median(history)
+        mad = _median([abs(x - med) for x in history])
+        if med == 0:
+            band_pct = min_band_pct
+            delta_pct = 0.0 if latest["value"] == 0 else float("inf")
+        else:
+            band_pct = max(min_band_pct, 200.0 * mad / abs(med))
+            delta_pct = 100.0 * (latest["value"] - med) / abs(med)
+        v.update(history_median=med, history_mad=mad,
+                 band_pct=round(band_pct, 3),
+                 delta_pct=round(delta_pct, 3)
+                 if delta_pct != float("inf") else delta_pct)
+        direction = metric_direction(name)
+        if direction == 0:
+            v["verdict"] = "unknown-direction"
+        elif direction * delta_pct < -band_pct:
+            v["verdict"] = "regressed"
+        elif direction * delta_pct > band_pct:
+            v["verdict"] = "improved"
+        else:
+            v["verdict"] = "stable"
+        verdicts.append(v)
+    return verdicts
+
+
+def backfill_conditions(paths: List[str]) -> int:
+    """Stamp ``"conditions": "unknown-pre-r20"`` into artifacts missing
+    the key (idempotent). Returns how many files were rewritten."""
+    changed = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                raw = f.read()
+            artifact = json.loads(raw)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(artifact, dict) or "conditions" in artifact:
+            continue
+        artifact["conditions"] = CONDITIONS_UNKNOWN
+        # preserve whatever indent the artifact was written with
+        m = re.search(r"\{\n( +)", raw)
+        indent = len(m.group(1)) if m else 2
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=indent)
+            f.write("\n")
+        changed += 1
+    return changed
+
+
+def _find_artifacts(root: str, pattern: str) -> List[str]:
+    return sorted(_glob.glob(os.path.join(root, pattern)))
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="delta-bench-trend",
+        description="Noise-banded regression verdicts over historical "
+                    "BENCH_r*.json captures.")
+    parser.add_argument("--root", default=".",
+                        help="directory holding the artifacts (default .)")
+    parser.add_argument("--glob", default="BENCH_r*.json",
+                        help="artifact filename pattern")
+    parser.add_argument("--metric", action="append", metavar="NAME",
+                        help="only judge NAME (repeatable)")
+    parser.add_argument("--min-history", type=int, default=3,
+                        help="comparable points required for a verdict "
+                             "(default 3)")
+    parser.add_argument("--min-band-pct", type=float, default=10.0,
+                        help="noise-band floor in percent (default 10)")
+    parser.add_argument("--json", action="store_true",
+                        help="print verdicts as JSON")
+    parser.add_argument("--backfill", action="store_true",
+                        help="stamp legacy artifacts missing 'conditions' "
+                             "with the unknown-pre-r20 sentinel and exit")
+    parser.add_argument("--fail-on-regress", action="store_true",
+                        help="exit 1 if any metric regressed")
+    args = parser.parse_args(argv)
+
+    paths = _find_artifacts(args.root, args.glob)
+    if not paths:
+        print(f"delta-bench-trend: no artifacts match "
+              f"{os.path.join(args.root, args.glob)}", file=sys.stderr)
+        return 2
+
+    if args.backfill:
+        changed = backfill_conditions(paths)
+        print(f"backfilled {changed} of {len(paths)} artifacts")
+        return 0
+
+    runs = load_bench_runs(paths)
+    verdicts = trend_verdicts(runs, min_history=args.min_history,
+                              min_band_pct=args.min_band_pct,
+                              metrics=args.metric)
+    if args.json:
+        print(json.dumps(verdicts, indent=2))
+    else:
+        width = max((len(v["metric"]) for v in verdicts), default=6)
+        for v in verdicts:
+            detail = ""
+            if "delta_pct" in v:
+                detail = (f"  {v['delta_pct']:+.1f}% vs median "
+                          f"{v['history_median']:.4g} "
+                          f"(band ±{v['band_pct']:.1f}%, "
+                          f"{v['comparable_points']} pts)")
+            elif v["verdict"] == "insufficient-history":
+                detail = (f"  ({v['comparable_points']} comparable pts, "
+                          f"need {args.min_history})")
+            print(f"{v['metric']:<{width}}  r{v['latest_run']:02d}  "
+                  f"{v['verdict']:<20}{detail}")
+    if args.fail_on_regress and any(
+            v["verdict"] == "regressed" for v in verdicts):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

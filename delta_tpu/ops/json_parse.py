@@ -48,6 +48,8 @@ import functools
 
 import numpy as np
 
+from delta_tpu import obs
+
 # Window spans are int32: a window must keep every byte offset below
 # 2^31. Callers split larger buffers (replay/device_parse.py windows at
 # DELTA_TPU_DEVICE_PARSE_WINDOW, default 64 MiB) long before this trips.
@@ -344,14 +346,20 @@ def parse_window_fields(window: np.ndarray, n_lines: int, device=None):
 
     pallas_ok = _use_device_classes() and n_pad % _BYTE_TILE == 0
     fn = _parse_fn_cached(n_pad, l_pad, pallas_ok)
-    with _x64():
+    with obs.device_dispatch("json_parse.window",
+                             key=(n_pad, l_pad, pallas_ok),
+                             budget="json-parse-window",
+                             units=lane_bytes.shape[0],
+                             gate="parse") as dd, _x64():
+        dd.h2d("lane_bytes", lane_bytes)
         vals, spans, flags, window_ok = fn(
             jax.device_put(lane_bytes, device), np.int32(n_lines))
         if not bool(window_ok):
+            dd.set(window_ok=False)
             return None
-        vals = np.asarray(vals)[:, :n_lines]
-        spans = np.asarray(spans)[:, :n_lines]
-        flags = np.asarray(flags)[:, :n_lines]
+        vals = dd.d2h("vals", np.asarray(vals))[:, :n_lines]
+        spans = dd.d2h("spans", np.asarray(spans))[:, :n_lines]
+        flags = dd.d2h("flags", np.asarray(flags))[:, :n_lines]
     out = {}
     for i, name in enumerate(VAL_NAMES):
         out[name] = vals[i]

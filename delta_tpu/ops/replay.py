@@ -565,12 +565,19 @@ def replay_select_launch(
         fa = _try_fa_encode(lanes, n, m)
 
     n_op = np.asarray(n, dtype=np.int32)
+    # these data-dependent lanes are accounted at runtime through
+    # replay.h2d_bytes (no static per-unit budget entry — the FA buffer
+    # mixes bitplanes and byte-packed refs); the funnel still records
+    # per-lane bytes and the compile/steady-state split per shape bucket
     if fa is not None:
         parts, layout = _pack_fa_operands(fa, n)
         buf = np.concatenate(parts)
-        _H2D_BYTES.inc(buf.nbytes)
-        buf = _put_chunked(buf, device)
-        winner_words = _winner_kernel_fa_packed(buf, layout)
+        with obs.device_dispatch("replay.single_fa", key=(m, layout),
+                                 gate="replay", route="single") as dd:
+            dd.h2d("fa_buf", buf)
+            _H2D_BYTES.inc(buf.nbytes)
+            buf = _put_chunked(buf, device)
+            winner_words = _winner_kernel_fa_packed(buf, layout)
     else:
         combined = combine_key_lanes(lanes)
         if combined is not None:
@@ -585,10 +592,15 @@ def replay_select_launch(
                     if pad else np.asarray(k, np.uint32))
                 for k in lanes)
         operands = (*key_ops, n_op)
-        _H2D_BYTES.inc(sum(int(o.nbytes) for o in key_ops))
-        if device is not None:
-            operands = tuple(jax.device_put(o, device) for o in operands)
-        winner_words = _winner_kernel(operands, width=width)
+        with obs.device_dispatch("replay.single_raw",
+                                 key=(m, width, len(key_ops)),
+                                 gate="replay", route="single") as dd:
+            for i, o in enumerate(key_ops):
+                dd.h2d(f"key_plane_{i}", o)
+            _H2D_BYTES.inc(sum(int(o.nbytes) for o in key_ops))
+            if device is not None:
+                operands = tuple(jax.device_put(o, device) for o in operands)
+            winner_words = _winner_kernel(operands, width=width)
 
     return ReplayPending(winner_words, add_words_np, n, perm)
 

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from delta_tpu import obs
 from delta_tpu.ops.replay import (
     _PAD_KEY,
     _unpack_bits,
@@ -131,7 +132,11 @@ def replay_select_blockwise(
     n_words = pad_bucket(-(-max(n_uniq, 1) // 32), min_bucket=1024)
     seen = jnp.zeros((n_words,), jnp.uint32)
     if device is not None:
-        seen = jax.device_put(seen, device)
+        # one-time seed upload of the persistent bitset (donated and
+        # updated in place by every block step after)
+        with obs.device_dispatch("replay.blockwise_seed",
+                                 key=(n_words,)) as dd:
+            seen = dd.h2d("seen", jax.device_put(seen, device))
 
     winner = np.zeros(n, dtype=bool)
     starts = list(range(0, n, m))
@@ -140,10 +145,14 @@ def replay_select_blockwise(
         blk = np.full(m, _PAD_KEY, np.uint32)
         blk[:e - s] = key[s:e]
         ops = (blk, np.int32(e - s))
-        if device is not None:
-            ops = tuple(jax.device_put(o, device) for o in ops)
-        winner_words, seen = _block_kernel(seen, *ops, m=m)
-        winner[s:e] = _unpack_bits(np.asarray(winner_words), m)[:e - s]
+        with obs.device_dispatch("replay.blockwise", key=(m, n_words),
+                                 gate="replay", route="single") as dd:
+            dd.h2d("block", int(blk.nbytes))
+            if device is not None:
+                ops = tuple(jax.device_put(o, device) for o in ops)
+            winner_words, seen = _block_kernel(seen, *ops, m=m)
+            winner[s:e] = _unpack_bits(
+                dd.d2h("winner_words", np.asarray(winner_words)), m)[:e - s]
 
     live = winner & is_add
     tomb = winner & ~is_add

@@ -40,6 +40,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from delta_tpu import obs
 from delta_tpu.ops.stats import _x64
 
 
@@ -135,11 +136,15 @@ def skip_mask_block(dev_vals, dev_valid, block: AtomBlock,
     ops = _pad(block.ops, 0, np.int32)
     lits = _pad(block.lits, 0, np.int64)
     grp = _pad(block.grp, g_segs - 1, np.int32)
-    with _x64():
+    # the index lanes are HBM-resident (budgeted at upload in
+    # stats/device_index.py); the per-scan atom arrays ride as jit
+    # arguments, so this dispatch carries no budgeted device_put lane
+    with obs.device_dispatch("skipping.mask_block", key=(a_pad, g_segs),
+                             gate="skip") as dd, _x64():
         keep = _skip_fn_cached(a_pad, g_segs)(
             dev_vals, dev_valid, rows_mn, rows_mx, rows_nc, ops,
             jnp.asarray(lits), grp, np.int32(block.n_atoms))
-        return np.asarray(keep)[:n_files]
+        return dd.d2h("keep", np.asarray(keep))[:n_files]
 
 
 def host_skip_mask(vals: np.ndarray, valid: np.ndarray, block: AtomBlock,

@@ -41,6 +41,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from delta_tpu import obs
+
 # identity elements for empty segments — shared by both modes so the
 # host fallback is bit-identical to jax.ops.segment_min/max
 IDENT_MIN = np.iinfo(np.int64).max
@@ -176,12 +178,19 @@ def checkpoint_stats_block(
     # a code multiplier > any code keeps (part, code) pairs distinct
     code_mult = np.int64(max(int(n_codes), 1) + 1)
     fn = _agg_fn_cached(n_l, n_pad, p_pad)
-    with _x64():
+    # lane matrices are [n_l, n_pad]: each lane prices at its own unit
+    # count (the manifest unit is one padded file row per stat lane)
+    with obs.device_dispatch("stats.ckpt_block", key=(n_l, n_pad, p_pad),
+                             budget="ckpt-stats-block",
+                             units=n_pad) as dd, _x64():
+        dd.h2d("lane_vals", lane_vals, units=n_l * n_pad)
+        dd.h2d("valid_words", valid_words, units=n_l * n_pad)
+        dd.h2d("part_ids", part_ids)
         block = fn(jax.device_put(lane_vals, device),
                    jax.device_put(valid_words, device),
                    jax.device_put(part_ids, device),
                    code_mult)
-        return np.asarray(block)[:, :n_parts]
+        return dd.d2h("block", np.asarray(block))[:, :n_parts]
 
 
 def host_stats_block(
@@ -251,10 +260,13 @@ def pack_bitmap_words(flat_bits: np.ndarray, n_containers: int,
     n_words = int(n_containers) * _BITMAP_WORDS
     flat_idx = np.full(n_pad, n_words * 32, np.int64)
     flat_idx[:n] = np.asarray(flat_bits, np.int64)
-    with _x64():
+    with obs.device_dispatch("stats.dv_pack", key=(n_pad, n_words),
+                             budget="ckpt-dv-pack", units=n_pad) as dd, \
+            _x64():
+        dd.h2d("flat_idx", flat_idx)
         words = _pack_fn_cached(n_pad, n_words)(
             jax.device_put(flat_idx, device))
-        out = np.ascontiguousarray(np.asarray(words))
+        out = dd.d2h("words", np.ascontiguousarray(np.asarray(words)))
     if out.dtype.byteorder == ">":  # pragma: no cover - LE hosts only
         out = out.astype("<u4")
     return out.view(np.uint8).reshape(n_containers, 8192)
@@ -308,12 +320,17 @@ def decode_mask_words(bit_idx: np.ndarray, bm_words: np.ndarray,
     lane_bm_words[:nw] = np.asarray(bm_words, np.uint32)
     lane_bm_pos = np.full(w_pad, int(n_words), np.int32)
     lane_bm_pos[:nw] = np.asarray(bm_pos, np.int32)
-    with _x64():
+    with obs.device_dispatch("stats.dv_decode",
+                             key=(i_pad, w_pad, int(n_words)),
+                             budget="dv-decode-lanes") as dd, _x64():
+        dd.h2d("lane_bit_idx", lane_bit_idx, units=i_pad)
+        dd.h2d("lane_bm_words", lane_bm_words, units=w_pad)
+        dd.h2d("lane_bm_pos", lane_bm_pos, units=w_pad)
         words = _decode_fn_cached(i_pad, w_pad, int(n_words))(
             jax.device_put(lane_bit_idx, device),
             jax.device_put(lane_bm_words, device),
             jax.device_put(lane_bm_pos, device))
-        out = np.ascontiguousarray(np.asarray(words))
+        out = dd.d2h("words", np.ascontiguousarray(np.asarray(words)))
     if out.dtype.byteorder == ">":  # pragma: no cover - LE hosts only
         out = out.astype("<u4")
     return out

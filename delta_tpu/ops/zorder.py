@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from delta_tpu import obs
+
 
 def range_rank(values: jnp.ndarray) -> jnp.ndarray:
     """Dense rank in [0, n) as uint32 (ties broken arbitrarily but
@@ -177,7 +179,12 @@ def zorder_sort_indices(cols: Sequence[np.ndarray], curve: str = "zorder") -> np
     stacked = np.full((len(cols), m), 0xFFFFFFFF, np.uint32)
     for i, c in enumerate(cols):
         stacked[i, :n] = _to_sortable_u32(c)
-    perm = np.asarray(_curve_perm(jnp.asarray(stacked), curve))
+    # stacked rides as a jit argument (no device_put lane to budget)
+    with obs.device_dispatch("zorder.curve_perm",
+                             key=(len(cols), m, curve)) as dd:
+        dd.h2d("stacked", stacked)
+        perm = dd.d2h("perm",
+                      np.asarray(_curve_perm(jnp.asarray(stacked), curve)))
     if m > n:
         perm = perm[perm < n]
     return perm

@@ -39,7 +39,9 @@ import functools
 import json
 import os
 from pathlib import Path
-from typing import NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional
+
+from delta_tpu.obs.device import record_gate_decision
 
 # Fallbacks when no DEVICE_MERIT.json is available (same shape as the
 # bench host's measurements so the gate degrades to sane behavior).
@@ -177,6 +179,15 @@ def sharded_min_rows() -> int:
     return DEFAULT_SHARDED_MIN_ROWS
 
 
+def _decide(gate: str, chosen: str, inputs: Dict[str, object],
+            predicted: Optional[Dict[str, float]] = None,
+            reason: str = "economics") -> str:
+    """Record the decision (obs/device.py joins it with the observed
+    execution cost for calibration) and return the chosen route."""
+    record_gate_decision(gate, chosen, inputs, predicted or {}, reason)
+    return chosen
+
+
 def replay_route(
     n_rows: int,
     n_shards: int = 1,
@@ -188,28 +199,36 @@ def replay_route(
     `forced` carries caller intent that bypasses the economics (an
     explicitly constructed mesh keeps its sharded semantics); the
     DELTA_TPU_REPLAY_ROUTE env var outranks everything (tests, bench
-    lanes)."""
+    lanes). Every decision emits a gate record — inputs, per-route
+    predicted seconds, chosen route, reason — for calibration against
+    the observed dispatch cost (see obs/device.py)."""
+    inputs = {"n_rows": n_rows, "n_shards": n_shards,
+              "nbytes_est": nbytes_est}
     env_route = os.environ.get("DELTA_TPU_REPLAY_ROUTE")
     if env_route in ("host", "single", "sharded"):
         if env_route == "sharded" and n_shards <= 1:
-            return "single"
-        return env_route
+            return _decide("replay", "single", inputs, reason="env")
+        return _decide("replay", env_route, inputs, reason="env")
     if forced == "sharded" and n_shards > 1:
-        return "sharded"
+        return _decide("replay", "sharded", inputs, reason="forced")
     if n_rows <= 0:
-        return "single"
+        return _decide("replay", "single", inputs, reason="empty")
 
     model = link_model()
     if nbytes_est is None:
         nbytes_est = int(n_rows * _FA_BYTES_PER_ROW)
+        inputs["nbytes_est"] = nbytes_est
     t_host = n_rows / max(model.host_rows_per_s, 1.0)
     t_device = (model.h2d_seconds(nbytes_est)
                 + n_rows / model.device_rows_per_s)
+    # the sharded route shares the single-chip transfer economics; its
+    # per-chip compute advantage is recorded under the same prediction
+    predicted = {"host": t_host, "single": t_device, "sharded": t_device}
     if t_host < t_device:
-        return "host"
+        return _decide("replay", "host", inputs, predicted)
     if n_shards > 1 and n_rows >= sharded_min_rows():
-        return "sharded"
-    return "single"
+        return _decide("replay", "sharded", inputs, predicted)
+    return _decide("replay", "single", inputs, predicted)
 
 
 def parse_route(
@@ -226,20 +245,23 @@ def parse_route(
     construction-time opt-in (`use_device_parse`, true on accelerator
     backends) before the link economics are even consulted.
     DELTA_TPU_DEVICE_PARSE outranks everything (tests, bench lanes)."""
+    inputs = {"nbytes": nbytes, "engine_enabled": engine_enabled}
     env = os.environ.get("DELTA_TPU_DEVICE_PARSE")
     if env is not None:
         if env.lower() in ("force", "1", "on", "device"):
-            return "device"
+            return _decide("parse", "device", inputs, reason="env")
         if env.lower() in ("0", "off", "host"):
-            return "host"
+            return _decide("parse", "host", inputs, reason="env")
     if forced in ("host", "device"):
-        return forced
+        return _decide("parse", forced, inputs, reason="forced")
     if not engine_enabled or nbytes <= 0:
-        return "host"
+        return _decide("parse", "host", inputs, reason="engine-disabled")
     model = link_model()
     t_host = nbytes / _HOST_SCAN_BPS
     t_device = model.h2d_seconds(nbytes) + nbytes / _DEVICE_PARSE_BPS
-    return "device" if t_device < t_host else "host"
+    predicted = {"host": t_host, "device": t_device}
+    return _decide("parse", "device" if t_device < t_host else "host",
+                   inputs, predicted)
 
 
 def skip_route(
@@ -260,18 +282,22 @@ def skip_route(
     matrix is already HBM-resident (shipped once per snapshot version),
     so the device side pays one dispatch RTT, never a bulk H2D.
     DELTA_TPU_DEVICE_SKIP outranks everything (tests, bench lanes)."""
+    inputs = {"n_files": n_files, "n_atoms": n_atoms,
+              "engine_enabled": engine_enabled}
     env = os.environ.get("DELTA_TPU_DEVICE_SKIP")
     if env is not None:
         if env.lower() in ("force", "1", "on", "device"):
-            return "device"
+            return _decide("skip", "device", inputs, reason="env")
         if env.lower() in ("0", "off", "host"):
-            return "host"
+            return _decide("skip", "host", inputs, reason="env")
     if forced in ("host", "device"):
-        return forced
+        return _decide("skip", forced, inputs, reason="forced")
     if not engine_enabled or n_files <= 0 or n_atoms <= 0:
-        return "host"
+        return _decide("skip", "host", inputs, reason="engine-disabled")
     model = link_model()
     cells = float(n_files) * float(n_atoms)
     t_host = cells / _HOST_SKIP_CELLS_PS
     t_device = model.rtt_s + cells / _DEVICE_SKIP_CELLS_PS
-    return "device" if t_device < t_host else "host"
+    predicted = {"host": t_host, "device": t_device}
+    return _decide("skip", "device" if t_device < t_host else "host",
+                   inputs, predicted)

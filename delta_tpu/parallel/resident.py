@@ -226,11 +226,18 @@ class ResidentShardState:
             obs.set_attrs(h2d_bytes=nbytes)
             n_real_op = new_n_real.astype(np.int32).reshape(s, 1)
             fn = _append_fn_cached(self.mesh, d_pad)
-            new_key, winner_sh = fn(
-                self.key_sh,
-                jax.device_put(idx2d, spec),
-                jax.device_put(val2d, spec),
-                jax.device_put(n_real_op, spec))
+            with obs.device_dispatch("replay.resident_append",
+                                     key=(s, d_pad),
+                                     budget="resident-append",
+                                     units=s * d_pad) as dd:
+                dd.h2d("idx2d", idx2d)
+                dd.h2d("val2d", val2d)
+                dd.h2d("n_real_op", n_real_op)
+                new_key, winner_sh = fn(
+                    self.key_sh,
+                    jax.device_put(idx2d, spec),
+                    jax.device_put(val2d, spec),
+                    jax.device_put(n_real_op, spec))
             self.key_sh = new_key
 
             # host bookkeeping for the appended slots (scatter maps each

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from delta_tpu import obs
 from delta_tpu.ops.replay import (
     _PAD_KEY,
     _unpack_bits,
@@ -143,17 +144,29 @@ def replay_select_sharded_blockwise(
 
     n_words = pad_bucket(-(-max(n_uniq_local, 1) // 32),
                          min_bucket=256)
-    seen = jax.device_put(
-        jnp.zeros((S, n_words), jnp.uint32),
-        NamedSharding(mesh, P(REPLAY_AXIS, None)))
+    # one-time seed upload of the per-shard bitsets (donated and updated
+    # in place by every block step after)
+    with obs.device_dispatch("replay.sharded_seed",
+                             key=(S, n_words)) as dd:
+        seen = dd.h2d("seen", jax.device_put(
+            jnp.zeros((S, n_words), jnp.uint32),
+            NamedSharding(mesh, P(REPLAY_AXIS, None))))
     step = _step_fn(mesh, m)
 
     winner = np.zeros(n, dtype=bool)  # original row space
     for b in reversed(range(n_blocks)):
         blk = keys_slab[:, b * m:(b + 1) * m]
         n_real = np.clip(counts - b * m, 0, m).astype(np.int32)
-        seen, packed = step(seen, jnp.asarray(blk), jnp.asarray(n_real))
-        words = np.asarray(packed)
+        # block operands ride as jit arguments (no device_put lane); the
+        # per-block costs accumulate onto the same pending replay
+        # decision, so calibration prices the whole block loop
+        with obs.device_dispatch("replay.sharded_blockwise",
+                                 key=(S, m, n_words), gate="replay",
+                                 route="sharded") as dd:
+            dd.h2d("block", int(blk.nbytes) + int(n_real.nbytes))
+            seen, packed = step(seen, jnp.asarray(blk),
+                                jnp.asarray(n_real))
+            words = dd.d2h("packed", np.asarray(packed))
         tgt = scatter[:, b * m:(b + 1) * m]
         for s in range(S):
             w = _unpack_bits(words[s], m)

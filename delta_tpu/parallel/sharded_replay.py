@@ -435,23 +435,37 @@ def sharded_replay_select(
         if has_sub:
             ops += [np.uint32(fa.sub_radix), fa.sub_idx, fa.sub_val]
         ops += [fa.n_real, fa.add_words]
-        with obs.span("replay.shard_transfer", nbytes=fa.nbytes,
-                      route="fa"):
-            _H2D_BYTES.inc(fa.nbytes)
-            device_ops = tuple(
-                o if np.isscalar(o) or o.ndim == 0
-                else jax.device_put(o, spec)
-                for o in ops)
-        # scalar sub_radix is replicated, not sharded
-        fn = build_sharded_replay_fa_fn(mesh, len(fa.ref_planes), has_sub,
-                                        want_key)
-        with obs.span("replay.shard_reconcile", shards=n_shards,
-                      route="fa"):
-            if want_key:
-                winner_sh, num_live, key_sh = fn(*device_ops)
-            else:
-                winner_sh, num_live = fn(*device_ops)
-            winner_words = np.asarray(winner_sh)
+        # the budget entry is non-exhaustive: ref planes and the DV lane
+        # are data-dependent and accounted through replay.h2d_bytes; the
+        # two committed bitplanes are priced per padded shard row
+        fa_rows = n_shards * fa.m
+        with obs.device_dispatch("replay.sharded_fa",
+                                 key=(n_shards, fa.m, len(fa.ref_planes),
+                                      has_sub, want_key),
+                                 budget="sharded-replay-fa-plane",
+                                 units=fa_rows, gate="replay",
+                                 route="sharded") as dd:
+            dd.h2d("flag_words", fa.flag_words)
+            dd.h2d("add_words", fa.add_words)
+            for i, rp in enumerate(fa.ref_planes):
+                dd.h2d(f"ref_plane_{i}", rp)
+            with obs.span("replay.shard_transfer", nbytes=fa.nbytes,
+                          route="fa"):
+                _H2D_BYTES.inc(fa.nbytes)
+                device_ops = tuple(
+                    o if np.isscalar(o) or o.ndim == 0
+                    else jax.device_put(o, spec)
+                    for o in ops)
+            # scalar sub_radix is replicated, not sharded
+            fn = build_sharded_replay_fa_fn(mesh, len(fa.ref_planes),
+                                            has_sub, want_key)
+            with obs.span("replay.shard_reconcile", shards=n_shards,
+                          route="fa"):
+                if want_key:
+                    winner_sh, num_live, key_sh = fn(*device_ops)
+                else:
+                    winner_sh, num_live = fn(*device_ops)
+                winner_words = dd.d2h("winner_words", np.asarray(winner_sh))
         if want_key:
             resident_sink.append(ResidentPayload(
                 key_sh=key_sh, mesh=mesh, m=fa.m,
@@ -467,15 +481,21 @@ def sharded_replay_select(
         m = fa.m
     else:
         nbytes = sum(int(o.nbytes) for o in operands)
-        with obs.span("replay.shard_transfer", nbytes=nbytes, route="raw"):
-            _H2D_BYTES.inc(nbytes)
-            device_ops = tuple(jax.device_put(o, spec) for o in operands)
-        fn = _cached_fn(mesh)
-        with obs.span("replay.shard_reconcile", shards=n_shards,
-                      route="raw"):
-            live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
-            flat_live = np.asarray(live_sh).ravel()
-            flat_tomb = np.asarray(tomb_sh).ravel()
+        with obs.device_dispatch("replay.sharded_raw",
+                                 key=(n_shards, operands[0].shape[1]),
+                                 gate="replay", route="sharded") as dd:
+            dd.h2d("operands", nbytes)
+            with obs.span("replay.shard_transfer", nbytes=nbytes,
+                          route="raw"):
+                _H2D_BYTES.inc(nbytes)
+                device_ops = tuple(jax.device_put(o, spec)
+                                   for o in operands)
+            fn = _cached_fn(mesh)
+            with obs.span("replay.shard_reconcile", shards=n_shards,
+                          route="raw"):
+                live_sh, tomb_sh, num_live, live_bytes = fn(*device_ops)
+                flat_live = np.asarray(live_sh).ravel()
+                flat_tomb = np.asarray(tomb_sh).ravel()
         m = operands[0].shape[1]
 
     live = np.zeros(n, dtype=bool)

@@ -1159,6 +1159,14 @@ def _columnarize_log_segment(
                                             "DELTA_TPU_EAGER_STATS")))
                         if parsed_native is not None:
                             bytes_parsed += int(starts[-1])
+                    if parsed_native is None:
+                        # buffer (if read) is reused by the host
+                        # branches; price them against the "device"
+                        # prediction for gate calibration
+                        obs.gate_fell_back(
+                            "parse", "host",
+                            reason=("read-failed" if read is None
+                                    else "device-parse-unavailable"))
             if (fresh is None and parsed_native is None and read is None
                     and _native.available(allow_compile)):
                 # local files: one native read+scan round-trip (no per-file

@@ -376,27 +376,33 @@ def _parse_window(w: _Window, allow_native: bool,
                 sp.set_attrs(rows=table.num_rows, device=True)
                 return _Parsed(w.index, table, others, keys, uniq,
                                dv_any, sthunk, len(w.infos), w.nbytes)
+            # mid-flight fallback: calibration prices the device attempt
+            # PLUS the host parse below against the "device" prediction
+            obs.gate_fell_back("parse", "host",
+                               reason="device-parse-unavailable")
         if allow_native:
             from delta_tpu.replay.native_parse import parse_window_native
 
-            out = parse_window_native(w.buf, w.starts, w.versions,
-                                      lazy_stats=lazy_stats)
+            with obs.gate_observation("parse", "host"):
+                out = parse_window_native(w.buf, w.starts, w.versions,
+                                          lazy_stats=lazy_stats)
             if out is not None:
                 table, others, keys, uniq, dv_any, sthunk = out
                 sp.set_attrs(rows=table.num_rows, native=True)
                 return _Parsed(w.index, table, others, keys, uniq,
                                dv_any, sthunk, len(w.infos), w.nbytes)
-        generic = C._parse_buffer_generic(w.buf, w.starts, w.versions)
-        if generic is None:
-            # line accounting disagreed; per-file byte extents are
-            # exact (verified read or blob assembly), so slicing the
-            # buffer back into per-file blobs is equivalent to the
-            # serial path's re-read
-            mv = memoryview(w.buf)
-            blobs = [(int(v), bytes(mv[int(s):int(e) - 1]))
-                     for v, s, e in zip(w.versions, w.starts[:-1],
-                                        w.starts[1:])]
-            generic = C.parse_commit_batch(blobs)
+        with obs.gate_observation("parse", "host"):
+            generic = C._parse_buffer_generic(w.buf, w.starts, w.versions)
+            if generic is None:
+                # line accounting disagreed; per-file byte extents are
+                # exact (verified read or blob assembly), so slicing the
+                # buffer back into per-file blobs is equivalent to the
+                # serial path's re-read
+                mv = memoryview(w.buf)
+                blobs = [(int(v), bytes(mv[int(s):int(e) - 1]))
+                         for v, s, e in zip(w.versions, w.starts[:-1],
+                                            w.starts[1:])]
+                generic = C.parse_commit_batch(blobs)
         tbl, versions, orders, _ = generic
         small_rows: List[Tuple[int, int, dict]] = []
         gen_blocks: List[pa.Table] = []

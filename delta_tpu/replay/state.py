@@ -268,7 +268,8 @@ def compute_masks_device(
     if route == "host":
         # RTT-dominated tiny segment: dispatching to the device costs
         # more than the host-vectorized replay (DEVICE_MERIT link model)
-        return compute_masks_host(columnar)
+        with obs.gate_observation("replay", "host"):
+            return compute_masks_host(columnar)
     if route == "sharded":
         if n >= BLOCKWISE_MIN_ROWS * n_shards:
             # sharded AND >HBM: each shard streams its substream in

@@ -232,7 +232,13 @@ class ResidentStatsIndex:
         lane_vals = np.asarray(self.vals, np.int64)
         valid_words = np.packbits(np.asarray(self.valid, bool), axis=1,
                                   bitorder="little")
-        with _x64():
+        cells = lane_vals.shape[0] * n_pad
+        with obs.device_dispatch("stats.index_upload",
+                                 key=(lane_vals.shape[0], n_pad),
+                                 budget="stats-index-lanes",
+                                 units=cells) as dd, _x64():
+            dd.h2d("lane_vals", lane_vals)
+            dd.h2d("valid_words", valid_words)
             dv = jax.device_put(lane_vals)
             dw = jax.device_put(valid_words)
             dvalid = jnp.unpackbits(dw, axis=1, count=n_pad,

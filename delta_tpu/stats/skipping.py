@@ -380,6 +380,8 @@ def skipping_mask(
             if route == "device":
                 lanes = rs.device_lanes()
                 if lanes is None:
+                    obs.gate_fell_back("skip", "host",
+                                       reason="no-resident-lanes")
                     route = "host"
                 else:
                     keep &= ops_skipping.skip_mask_block(
@@ -388,8 +390,9 @@ def skipping_mask(
                     if fallback:
                         _DEVICE_FALLBACKS.inc(len(fallback))
             if route == "host":
-                keep &= ops_skipping.host_skip_mask(
-                    rs.vals, rs.valid, block, n)
+                with obs.gate_observation("skip", "host"):
+                    keep &= ops_skipping.host_skip_mask(
+                        rs.vals, rs.valid, block, n)
             obs.set_attrs(skip_route=route, skip_atoms=block.n_atoms,
                           skip_fallback_conjuncts=len(fallback))
     for conj in fallback:

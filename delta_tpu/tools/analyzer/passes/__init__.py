@@ -2,6 +2,7 @@
 rule; add new rule modules to the import list below."""
 
 from delta_tpu.tools.analyzer.passes import (  # noqa: F401
+    dispatch,
     errors_catalog,
     handler_discipline,
     hygiene,

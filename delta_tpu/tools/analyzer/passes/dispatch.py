@@ -1,0 +1,149 @@
+"""Dispatch-funnel coverage lint: ``unprofiled-dispatch``.
+
+PR 15's device-execution observability only works if every hot-path
+kernel launch actually rides the `obs.device_dispatch` funnel — an
+unfunneled ``jax.device_put`` is a transfer the runtime budget audit
+never sees and a wall-time hole in the gate-calibration join. The
+funnel sites were added by hand; this pass keeps them from rotting:
+inside the covered device modules, every ``device_put`` call must sit
+lexically inside a ``with ... device_dispatch(...)`` block (any number
+of statements deep, including nested ``with`` items), or in an
+explicitly allowlisted transfer helper whose caller holds the funnel
+open around it.
+
+Covered modules default to the instrumented hot-path set (the same
+modules the transfer-budget manifest disciplines, minus the
+checkpoint writers whose transfers happen inside their own pipelined
+uploader). Overrides, mostly for fixture tests:
+
+  DELTA_LINT_DISPATCH_MODULES  comma-separated rel paths replacing the
+                               covered-module set
+  DELTA_LINT_DISPATCH_ALLOW    comma-separated function names (bare or
+                               ``rel.py::qualname``) replacing the
+                               allowlist
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+# The instrumented device modules: every kernel launch in these files
+# goes through the dispatch funnel (PR 15).
+_DEFAULT_MODULES = (
+    "delta_tpu/ops/json_parse.py",
+    "delta_tpu/ops/skipping.py",
+    "delta_tpu/ops/stats.py",
+    "delta_tpu/ops/replay.py",
+    "delta_tpu/ops/replay_blockwise.py",
+    "delta_tpu/ops/zorder.py",
+    "delta_tpu/parallel/resident.py",
+    "delta_tpu/parallel/sharded_replay.py",
+    "delta_tpu/parallel/sharded_blockwise.py",
+    "delta_tpu/stats/device_index.py",
+)
+
+# Transfer helpers invoked from inside a caller's open funnel: the
+# chunked uploader (replay), whose callers record the lane totals.
+_DEFAULT_ALLOW = ("_put_chunked",)
+
+
+def _covered_modules() -> Set[str]:
+    env = os.environ.get("DELTA_LINT_DISPATCH_MODULES")
+    if env is not None:
+        return {p.strip() for p in env.split(",") if p.strip()}
+    return set(_DEFAULT_MODULES)
+
+
+def _allowed_functions() -> Set[str]:
+    env = os.environ.get("DELTA_LINT_DISPATCH_ALLOW")
+    if env is not None:
+        return {p.strip() for p in env.split(",") if p.strip()}
+    return set(_DEFAULT_ALLOW)
+
+
+def _is_funnel_with(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            name = call_name(expr)
+            if name and name.rpartition(".")[2] == "device_dispatch":
+                return True
+    return False
+
+
+def _collect_funneled(tree: ast.AST) -> Set[int]:
+    """ids of every AST node lexically under a device_dispatch with."""
+    covered: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With) and _is_funnel_with(node):
+            for sub in ast.walk(node):
+                covered.add(id(sub))
+    return covered
+
+
+def _enclosing_functions(tree: ast.AST) -> dict:
+    """node id -> name of the innermost enclosing function def."""
+    owner: dict = {}
+
+    def visit(node: ast.AST, current: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = current
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                name = child.name
+            owner[id(child)] = name
+            visit(child, name)
+
+    visit(tree, "<module>")
+    return owner
+
+
+@register
+class UnprofiledDispatchRule(Rule):
+    id = "unprofiled-dispatch"
+    help_anchor = "unprofiled-dispatch"
+    description = (
+        "jax.device_put in a dispatch-instrumented device module "
+        "outside every `with obs.device_dispatch(...)` block — the "
+        "transfer bypasses the runtime budget audit and the gate-"
+        "calibration wall-time join; open the funnel around the launch "
+        "or allowlist the helper")
+
+    def check_project(self, mods: List[ModuleInfo]) -> List[Finding]:
+        modules = _covered_modules()
+        allowed = _allowed_functions()
+        out: List[Finding] = []
+        for mod in mods:
+            if mod.rel not in modules or mod.tree is None:
+                continue
+            covered = _collect_funneled(mod.tree)
+            owner = _enclosing_functions(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if not name or name.rpartition(".")[2] != "device_put":
+                    continue
+                if id(node) in covered:
+                    continue
+                fn = owner.get(id(node), "<module>")
+                if fn in allowed or f"{mod.rel}::{fn}" in allowed:
+                    continue
+                out.append(Finding(
+                    self.id, mod.rel, node.lineno, node.col_offset,
+                    f"device_put in {fn}() is outside every "
+                    f"device_dispatch funnel — wrap the launch in "
+                    f"`with obs.device_dispatch(...)` (budget-audited, "
+                    f"gate-joined) or allowlist the transfer helper in "
+                    f"the dispatch pass"))
+        return out

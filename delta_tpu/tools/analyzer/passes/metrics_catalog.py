@@ -39,8 +39,11 @@ _KIND_BY_FN = {"counter": "counters", "histogram": "histograms",
 
 # instrument sites inside the obs package itself are the machinery
 # (registry definitions, exposition, tests' fixtures ride through env
-# override), not product metrics
+# override), not product metrics — EXCEPT the device-execution
+# profiler, whose instruments (device.*, gate.*) are product telemetry
+# and must stay cataloged like any other module's
 _EXEMPT_PREFIX = os.path.join("delta_tpu", "obs") + os.sep
+_NON_EXEMPT_BASENAMES = {"device.py", "bench_trend.py"}
 
 
 def _catalog_path() -> Optional[str]:
@@ -84,7 +87,9 @@ class _MetricScan:
         self.sites: Dict[str, Dict[str, List[Tuple[str, int]]]] = {
             kind: {} for kind in _KIND_BY_FN.values()}
         for mod in mods:
-            if mod.rel.startswith(_EXEMPT_PREFIX):
+            if (mod.rel.startswith(_EXEMPT_PREFIX)
+                    and os.path.basename(mod.rel)
+                    not in _NON_EXEMPT_BASENAMES):
                 continue
             self._scan(mod)
 

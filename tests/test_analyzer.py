@@ -823,6 +823,7 @@ def test_every_registered_rule_has_fixture_coverage():
         "handler-discipline",                                # serve
         "shared-state-race",                                 # races
         "transfer-budget", "transfer-unbudgeted",            # budget
+        "unprofiled-dispatch",                               # device obs
     }
     assert set(all_rules()) == expected
 
@@ -1938,6 +1939,80 @@ def test_sarif_baseline_states(tmp_path):
     assert [r["baselineState"] for r in run["results"]] == ["new"]
     assert [r["baselineState"] for r in run["baselinedResults"]] \
         == ["unchanged"]
+
+
+# ------------------------------------------------- unprofiled dispatch
+
+
+_DISPATCH_ENV = "DELTA_LINT_DISPATCH_MODULES"
+
+_FUNNELED_SRC = """
+import jax
+from delta_tpu import obs
+
+def launch(arr):
+    with obs.device_dispatch("k.launch", key=(arr.shape[0],)) as dd:
+        dd.h2d("arr", arr)
+        return jax.device_put(arr)
+"""
+
+_BARE_SRC = """
+import jax
+
+def launch(arr):
+    return jax.device_put(arr)
+"""
+
+
+def test_dispatch_funneled_clean(monkeypatch):
+    monkeypatch.setenv(_DISPATCH_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": _FUNNELED_SRC},
+                             rules=["unprofiled-dispatch"])
+    assert not report.findings
+
+
+def test_dispatch_bare_device_put_flagged(monkeypatch):
+    monkeypatch.setenv(_DISPATCH_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": _BARE_SRC},
+                             rules=["unprofiled-dispatch"])
+    fired = _rules_fired(report, "unprofiled-dispatch")
+    assert fired and "launch()" in fired[0].message
+
+
+def test_dispatch_uncovered_module_ignored(monkeypatch):
+    monkeypatch.setenv(_DISPATCH_ENV, "pkg/other.py")
+    report = analyze_sources({"pkg/k.py": _BARE_SRC},
+                             rules=["unprofiled-dispatch"])
+    assert not report.findings
+
+
+def test_dispatch_allowlisted_helper_clean(monkeypatch):
+    monkeypatch.setenv(_DISPATCH_ENV, "pkg/k.py")
+    monkeypatch.setenv("DELTA_LINT_DISPATCH_ALLOW", "launch")
+    report = analyze_sources({"pkg/k.py": _BARE_SRC},
+                             rules=["unprofiled-dispatch"])
+    assert not report.findings
+
+
+def test_dispatch_multi_item_with_covers(monkeypatch):
+    """`with device_dispatch(...) as dd, other():` still counts, and so
+    does a device_put nested deeper inside the block."""
+    src = """
+import jax
+import contextlib
+from delta_tpu import obs
+
+def launch(arr, flag):
+    with obs.device_dispatch("k.launch") as dd, contextlib.nullcontext():
+        if flag:
+            for _ in range(2):
+                jax.device_put(arr)
+    return arr
+"""
+    monkeypatch.setenv(_DISPATCH_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src},
+                             rules=["unprofiled-dispatch"])
+    assert not report.findings
 
 
 # ------------------------------------------------------ whole-repo gate
