@@ -67,11 +67,11 @@ class TpuEngine(HostEngine):
     # SQL engine relational spine (join/group-by/window sort) runs on
     # the device kernels in ops/sqlops.py; see sqlengine/device.py
     use_device_sql = True
-    # checkpoint Parquet page decode through the Pallas bit-unpack
-    # kernel (log/page_decode.py) — opt-in while the Arrow reader
-    # remains the measured default on tunnel deployments; resolved at
-    # construction so in-process env changes take effect
-    use_device_page_decode = False
+    # checkpoint Parquet page decode through the one-lane batched plan
+    # (log/page_decode.py + ops/page_decode.py): same autodetect
+    # contract as parse/skip — Arrow stays the CPU default, the routing
+    # itself lives in parallel/gate.py::decode_route.
+    use_device_decode = False
     # checkpoint-write stats aggregation on device (ops/stats.py):
     # autodetected from the backend at construction — on a real
     # accelerator the snapshot's columnar state is already resident and
@@ -104,8 +104,6 @@ class TpuEngine(HostEngine):
             mesh = _default_mesh(replay_shards)
         self.mesh = mesh
         self.replay_shards = replay_shards
-        self.use_device_page_decode = (
-            os.environ.get("DELTA_TPU_DEVICE_PAGE_DECODE") == "1")
         from delta_tpu.ops.stats import accel_backend_default
 
         self.use_device_ckpt_stats = accel_backend_default()
@@ -121,6 +119,11 @@ class TpuEngine(HostEngine):
         # DELTA_TPU_DEVICE_SKIP=force|off overrides
         # (parallel/gate.py::skip_route).
         self.use_device_skip = accel_backend_default()
+        # checkpoint page decode (one dispatch per part): profitable
+        # when the raw page bytes beat the Arrow decode rate over the
+        # measured link. DELTA_TPU_DEVICE_DECODE=force|off overrides
+        # (parallel/gate.py::decode_route).
+        self.use_device_decode = accel_backend_default()
 
 
 def _default_mesh(replay_shards: Optional[int]):

@@ -1,33 +1,41 @@
-"""Device checkpoint-page decoder (SURVEY §7 hard part (d)).
+"""Host side of the device checkpoint-page decoder (SURVEY §7 hard
+part (d)).
 
 The reference hand-rolls its own Parquet reader precisely because page
 decode sits on its replay hot path
 (`kernel/kernel-defaults/src/main/java/io/delta/kernel/defaults/internal/parquet/ParquetFileReader.java`).
-This module is the TPU-native counterpart for the checkpoint's numeric
-columns (add.size, add.modificationTime, add.dataChange, version...):
+This module is the TPU-native counterpart for the checkpoint's
+projected columns (add.size, add.modificationTime, add.dataChange,
+add.path / remove.path as replay keys, ...):
 
 - host: thrift compact-protocol PageHeader parse (hand-rolled from the
   parquet-format spec), page decompression, and the tiny varint run
-  headers of the RLE/bit-packed hybrid;
-- device: the O(bytes) work — bit-unpacking of the packed index runs
-  through the Pallas kernel (`ops/pallas_kernels.py::unpack_bitpacked`)
-  and the dictionary gather.
+  headers of the RLE/bit-packed hybrid — everything O(pages), nothing
+  O(values);
+- device: the O(bytes) work, batched into ONE dispatch per part — all
+  page payloads pack into a single padded uint8 byte lane with int32
+  run/page plans, and `ops/page_decode.py::decode_part` extracts every
+  hybrid position, expands def-levels, and gathers dictionary / PLAIN
+  values in one launch.
 
 Scope (DecodeUnsupported → caller falls back to the Arrow reader):
-data page v1, SNAPPY or uncompressed, non-repeated columns (struct
-nesting adds definition levels and is handled; lists/maps are not),
-PLAIN / RLE_DICTIONARY values, physical INT32/INT64/DOUBLE/BOOLEAN.
+data page v1, SNAPPY / ZSTD / uncompressed, non-repeated columns
+(struct nesting adds definition levels and is handled; lists/maps are
+not), PLAIN / RLE_DICTIONARY values, physical INT32/INT64/DOUBLE/
+BOOLEAN — plus dictionary-coded BYTE_ARRAY for the two replay-key path
+columns, whose part-local codes stay device-resident for the replay
+handoff (`ops/page_decode.py::launch_checkpoint_handoff`).
 """
 # delta-lint: file-disable=shared-state-race — audited:
-# _Thrift is a function-local decode cursor: constructed inside the
-# decode call, never stored or returned, so no two threads ever see
-# the same instance.
+# _Thrift and _PlanState are function-local decode cursors: constructed
+# inside the decode call, never stored or returned, so no two threads
+# ever see the same instance.
 
 from __future__ import annotations
 
 import struct
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -182,26 +190,37 @@ def split_pages(chunk: bytes) -> List[PageInfo]:
     return pages
 
 
+_CODECS = {"SNAPPY": "snappy", "ZSTD": "zstd"}
+
+
 def _decompress(chunk: bytes, page: PageInfo, codec: str) -> bytes:
+    """Page payload bytes. EVERY codec outside the supported set raises
+    DecodeUnsupported so the caller takes the whole-part Arrow fallback
+    — including a supported name whose codec wasn't built into this
+    pyarrow."""
     raw = chunk[page.payload_start:page.payload_start
                 + page.compressed_size]
     if codec in ("UNCOMPRESSED", "NONE"):
         return raw
-    if codec == "SNAPPY":
-        import pyarrow as pa
+    name = _CODECS.get(codec)
+    if name is None:
+        raise DecodeUnsupported(f"codec {codec}")
+    import pyarrow as pa
 
-        return pa.Codec("snappy").decompress(
-            raw, decompressed_size=page.uncompressed_size).to_pybytes()
-    raise DecodeUnsupported(f"codec {codec}")
+    if not pa.Codec.is_available(name):
+        raise DecodeUnsupported(f"codec {codec} not available")
+    return pa.Codec(name).decompress(
+        raw, decompressed_size=page.uncompressed_size).to_pybytes()
 
 
 # ------------------------------------------- RLE/bit-packed hybrid ----
 
 @dataclass
 class HybridRuns:
-    """Parsed hybrid stream: RLE runs resolved host-side (they're a
-    value + count — nothing to compute), bit-packed runs forwarded to
-    the device kernel as (out_start, n_values, word blocks)."""
+    """Parsed hybrid stream: RLE runs as (value, count), bit-packed runs
+    as (out_start, n_values, word blocks). Host-side reference form —
+    the hot path plans runs into the device byte lane instead
+    (`_plan_hybrid`)."""
 
     n: int
     w: int = 0  # bit width (set by parse_hybrid)
@@ -247,227 +266,508 @@ def parse_hybrid(data: bytes, pos: int, w: int, n: int,
 
 
 def materialize_runs(runs: HybridRuns, device=None) -> np.ndarray:
-    """Expand a hybrid stream to uint32[n]: RLE fills host-side, all
-    bit-packed runs decode in ONE device kernel launch (runs are
-    concatenated group-aligned into a single [w-major] word stream)."""
+    """Expand a hybrid stream to uint32[n] host-side: the numpy
+    reference twin of the device extract (validation and cold paths).
+    The hot path never expands on host — it ships run PLANS in the
+    one-lane batch instead (`build_part_plan` + `ops/page_decode.py`).
+    `device` is accepted for API compatibility and ignored."""
+    del device
     out = np.zeros(runs.n, np.uint32)
     for start, count, value in runs.rle:
         out[start:start + count] = value
-    if runs.packed:
-        from delta_tpu.ops.pallas_kernels import unpack_bitpacked
-
-        w = runs.w
-        if not isinstance(w, int) or not 0 <= w <= 32:
-            # guards callers that build HybridRuns directly; w outside the
-            # kernel's domain means a corrupt page, not a kernel bug
-            raise DecodeUnsupported(f"bit-packed width {w!r} outside [0, 32]")
-        group_counts = [-(-max(nv, 1) // 32) for _s, nv, _w in
-                        runs.packed]
-        total_groups = sum(group_counts)
-        words = np.zeros(total_groups * w, np.uint32)
-        woff = 0
-        for (_s, _nv, rw), g in zip(runs.packed, group_counts):
-            need = g * w
-            words[woff:woff + min(len(rw), need)] = rw[:need]
-            woff += need
-        decoded = np.asarray(unpack_bitpacked(words, w, total_groups,
-                                               device=device))
-        goff = 0
-        for (start, nv, _rw), g in zip(runs.packed, group_counts):
-            out[start:start + nv] = decoded[goff * 32:goff * 32 + nv]
-            goff += g
+    w = runs.w
+    if runs.packed and not (isinstance(w, int) and 0 <= w <= 32):
+        # guards callers that build HybridRuns directly; w outside the
+        # extract's domain means a corrupt page, not a decoder bug
+        raise DecodeUnsupported(f"bit-packed width {w!r} outside [0, 32]")
+    for start, nv, words in runs.packed:
+        nv = min(nv, runs.n - start)
+        if nv <= 0 or w == 0:
+            continue
+        bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+        need = nv * w
+        if bits.size < need:
+            bits = np.concatenate(
+                [bits, np.zeros(need - bits.size, np.uint8)])
+        weights = np.uint32(1) << np.arange(w, dtype=np.uint32)
+        out[start:start + nv] = (
+            bits[:need].reshape(nv, w).astype(np.uint64) * weights
+        ).sum(axis=1).astype(np.uint32)
     return out
 
 
-# ------------------------------------------------- column decoding ----
+# ------------------------------------------------- the one-lane plan --
 
 _PHYS_NP = {"INT32": np.int32, "INT64": np.int64, "DOUBLE": np.float64}
+_PHYS_ITEM = {"INT32": 4, "INT64": 8, "DOUBLE": 8, "BOOLEAN": 1}
 
 
-def decode_dictionary(payload: bytes, num_values: int,
-                      physical_type: str) -> np.ndarray:
-    if physical_type not in _PHYS_NP:
-        raise DecodeUnsupported(f"dict physical {physical_type}")
-    dt = np.dtype(_PHYS_NP[physical_type]).newbyteorder("<")
-    return np.frombuffer(payload, dt, count=num_values)
+@dataclass
+class _PlanState:
+    """Mutable accumulator while planning one part: byte-lane segments
+    plus the run/page plan rows (layout documented in
+    `ops/page_decode.py`), with running hybrid/row counters."""
+
+    segs: List[bytes] = field(default_factory=list)
+    lane_len: int = 0
+    runs: List[Tuple[int, ...]] = field(default_factory=list)
+    pages: List[Tuple[int, ...]] = field(default_factory=list)
+    h: int = 0
+    rows: int = 0
+
+    def append(self, b: bytes) -> int:
+        """Append a byte segment to the lane, returning its offset."""
+        from delta_tpu.ops.page_decode import MAX_LANE_BYTES
+
+        off = self.lane_len
+        self.segs.append(b)
+        self.lane_len += len(b)
+        if self.lane_len > MAX_LANE_BYTES:
+            # bit offsets must fit int32 on device
+            raise DecodeUnsupported("part byte lane over cap")
+        return off
+
+    def snapshot(self):
+        return (len(self.segs), self.lane_len, len(self.runs),
+                len(self.pages), self.h, self.rows)
+
+    def restore(self, snap) -> None:
+        n_segs, lane_len, n_runs, n_pages, h, rows = snap
+        del self.segs[n_segs:]
+        self.lane_len = lane_len
+        del self.runs[n_runs:]
+        del self.pages[n_pages:]
+        self.h = h
+        self.rows = rows
 
 
-def decode_data_page(payload: bytes, page: PageInfo, physical_type: str,
-                     max_def: int, dictionary: Optional[np.ndarray],
-                     device=None):
-    """One v1 data page → (values np.ndarray, valid bool ndarray)."""
+def _plan_hybrid(st: _PlanState, base_off: int, data: bytes, pos: int,
+                 w: int, n: int, end: Optional[int] = None,
+                 strict: bool = True) -> int:
+    """Walk one hybrid stream's run headers WITHOUT expanding: each run
+    becomes a plan row carrying its absolute lane bit offset. Reserves
+    exactly `n` hybrid positions (the device addresses values as
+    stream-start + logical index). `strict=False` tolerates a stream
+    that ends before `n` values — dictionary-index and boolean streams
+    are sized by the page's num_values upper bound, but only carry the
+    page's PRESENT values, a count the host never computes."""
+    if not isinstance(w, int) or not 0 <= w <= 32:
+        raise DecodeUnsupported(f"hybrid bit width {w!r} outside [0, 32]")
+    h0 = st.h
+    out = 0
+    byte_w = (w + 7) // 8
+    limit = len(data) if end is None else end
+    t = _Thrift(data, pos)
+    while out < n and t.pos < limit:
+        header = t.varint()
+        if header & 1:  # bit-packed: (header >> 1) groups of 8
+            groups8 = header >> 1
+            nvals = groups8 * 8
+            st.runs.append((h0 + out, nvals, 8 * (base_off + t.pos),
+                            w, 0, 0))
+            t.pos += groups8 * w
+            out += nvals
+        else:  # RLE: value repeated (header >> 1) times
+            count = header >> 1
+            value = int.from_bytes(data[t.pos:t.pos + byte_w], "little")
+            t.pos += byte_w
+            v32 = value & 0xFFFFFFFF
+            if v32 >= 1 << 31:
+                v32 -= 1 << 32  # int32 bit pattern for the plan lane
+            st.runs.append((h0 + out, count, 0, w, 1, v32))
+            out += count
+    if strict and out < n:
+        raise DecodeUnsupported(f"hybrid stream ended early ({out}/{n})")
+    st.h = h0 + n
+    return t.pos
+
+
+def _parse_byte_array_dict(payload: bytes, num_values: int
+                           ) -> List[bytes]:
+    """PLAIN dictionary page of a BYTE_ARRAY column:
+    [4-byte LE length][bytes] per entry."""
+    out = []
     pos = 0
-    n = page.num_values
-    defined = np.ones(n, bool)
-    if max_def > 0:
-        # def levels: 4-byte LE length + hybrid at
-        # bit_length(max_def); a value is present only at the FULL
-        # definition level (nested struct ancestors add levels)
-        dw = max(1, int(max_def).bit_length())
-        (dl_len,) = struct.unpack_from("<i", payload, pos)
+    for _ in range(num_values):
+        (ln,) = struct.unpack_from("<i", payload, pos)
         pos += 4
-        druns, _ = parse_hybrid(payload, pos, dw, n, end=pos + dl_len)
-        levels = materialize_runs(druns, device)
-        defined = levels == max_def
-        pos += dl_len
-    n_present = int(defined.sum())
-    if page.encoding in (_ENC_RLE_DICT, _ENC_PLAIN_DICT):
-        if dictionary is None:
-            raise DecodeUnsupported("dict-encoded page without dict")
-        w = payload[pos]
-        pos += 1
-        if w > 32:
-            raise DecodeUnsupported(f"index width {w}")
-        iruns, _ = parse_hybrid(payload, pos, w, n_present)
-        idx = materialize_runs(iruns, device)
-        present = dictionary[idx]
-    elif page.encoding == _ENC_PLAIN:
-        if physical_type == "BOOLEAN":
-            # PLAIN booleans ARE the bit-packed stream at width 1
-            if n_present == 0:  # e.g. the column is all-null in a page
-                present = np.zeros(0, bool)
-            else:
-                nbytes = -(-n_present // 8)
-                seg = payload[pos:pos + nbytes]
-                padded = seg + b"\x00" * (-len(seg) % 4)
-                words = np.frombuffer(padded, np.uint32)
-                from delta_tpu.ops.pallas_kernels import unpack_bitpacked
-
-                groups = -(-n_present // 32)
-                bits = np.asarray(unpack_bitpacked(words, 1, groups,
-                                                   device=device))
-                present = bits[:n_present].astype(bool)
-        elif physical_type in _PHYS_NP:
-            dt = np.dtype(_PHYS_NP[physical_type]).newbyteorder("<")
-            present = np.frombuffer(payload, dt, count=n_present,
-                                    offset=pos)
-        else:
-            raise DecodeUnsupported(f"plain physical {physical_type}")
-    else:
-        raise DecodeUnsupported(f"encoding {page.encoding}")
-    if max_def == 0 or defined.all():
-        return np.asarray(present), defined
-    out = np.zeros(n, np.asarray(present).dtype)
-    out[defined] = present
-    return out, defined
+        if ln < 0 or pos + ln > len(payload):
+            raise DecodeUnsupported("corrupt byte-array dictionary")
+        out.append(payload[pos:pos + ln])
+        pos += ln
+    return out
 
 
-def decode_column_chunk(chunk: bytes, physical_type: str, codec: str,
-                        max_def: int, device=None):
-    """Decode one column chunk (dictionary page + v1 data pages) into
-    (values, valid). Raises DecodeUnsupported outside scope."""
-    pages = split_pages(chunk)
-    dictionary = None
-    vals: List[np.ndarray] = []
-    valids: List[np.ndarray] = []
-    for page in pages:
+def _plan_column_chunk(st: _PlanState, chunk: bytes, phys: str,
+                       codec: str, max_def: int, key: int,
+                       part_dict: Dict[bytes, int],
+                       uniq: List[bytes]) -> None:
+    """Plan one column chunk's pages into the global lane. `key` is the
+    KEY_* flag: for key columns the dictionary page is parsed host-side
+    into the part-local path dictionary (shared across add/remove) and
+    only the tiny int32 remap table enters the lane."""
+    from delta_tpu.ops.page_decode import KIND_BOOL, KIND_DICT, KIND_PLAIN
+
+    dict_b = dict_n = 0
+    have_dict = False
+    item = 4 if key else _PHYS_ITEM[phys]
+    for page in split_pages(chunk):
         if page.type == _PAGE_DICT:
             payload = _decompress(chunk, page, codec)
-            dictionary = decode_dictionary(payload, page.num_values,
-                                           physical_type)
+            if key:
+                local = _parse_byte_array_dict(payload, page.num_values)
+                remap = np.empty(max(len(local), 1), np.int32)
+                for j, b in enumerate(local):
+                    code = part_dict.setdefault(b, len(part_dict))
+                    if code == len(uniq):
+                        uniq.append(b)
+                    remap[j] = code
+                dict_b = st.append(remap.tobytes())
+                dict_n = len(local)
+            else:
+                if phys not in _PHYS_NP:
+                    raise DecodeUnsupported(f"dict physical {phys}")
+                dict_b = st.append(payload)
+                dict_n = page.num_values
+            have_dict = True
         elif page.type == _PAGE_DATA:
             payload = _decompress(chunk, page, codec)
-            v, ok = decode_data_page(payload, page, physical_type,
-                                     max_def, dictionary, device)
-            vals.append(v)
-            valids.append(ok)
-    if not vals:
-        raise DecodeUnsupported("no data pages")
-    return np.concatenate(vals), np.concatenate(valids)
+            off = st.append(payload)
+            n = page.num_values
+            pos = 0
+            def_h = 0
+            if max_def > 0:
+                # def levels: 4-byte LE length + hybrid at
+                # bit_length(max_def); a value is present only at the
+                # FULL definition level
+                dw = max(1, int(max_def).bit_length())
+                (dl_len,) = struct.unpack_from("<i", payload, pos)
+                pos += 4
+                def_h = st.h
+                _plan_hybrid(st, off, payload, pos, dw, n,
+                             end=pos + dl_len, strict=True)
+                pos += dl_len
+            if page.encoding in (_ENC_RLE_DICT, _ENC_PLAIN_DICT):
+                if not have_dict:
+                    raise DecodeUnsupported(
+                        "dict-encoded page without dict")
+                w = payload[pos]
+                if w > 32:
+                    raise DecodeUnsupported(f"index width {w}")
+                aux_h = st.h
+                _plan_hybrid(st, off, payload, pos + 1, w, n,
+                             strict=False)
+                kind, val_b = KIND_DICT, 0
+            elif page.encoding == _ENC_PLAIN:
+                if key:
+                    # PLAIN BYTE_ARRAY is variable-width — no device
+                    # plan; the caller drops just this key column
+                    raise DecodeUnsupported("plain-encoded key column")
+                if phys == "BOOLEAN":
+                    # PLAIN booleans ARE a width-1 bit-packed stream
+                    aux_h = st.h
+                    st.runs.append((st.h, n, 8 * (off + pos), 1, 0, 0))
+                    st.h += n
+                    kind, val_b = KIND_BOOL, 0
+                elif phys in _PHYS_NP:
+                    kind, val_b, aux_h = KIND_PLAIN, off + pos, 0
+                else:
+                    raise DecodeUnsupported(f"plain physical {phys}")
+            else:
+                raise DecodeUnsupported(f"encoding {page.encoding}")
+            st.pages.append((st.rows, n, max_def, def_h, kind, val_b,
+                             item, aux_h, dict_b, dict_n, key))
+            st.rows += n
 
 
-def _decode_file_column(pf, f, column: str, device=None):
-    """Decode one column given an already-parsed ParquetFile and open
-    handle (the footer is parsed ONCE per file, not per column)."""
-    md = pf.metadata
-    schema = md.schema
-    col_idx = None
+# ------------------------------------------------- part plan + read ----
+
+DEVICE_COLUMNS = ("add.size", "add.modificationTime", "add.dataChange")
+
+# planned when present; the add columns above are the gate — a part
+# without them falls back wholesale
+_VALUE_COLUMNS = DEVICE_COLUMNS + ("remove.deletionTimestamp",
+                                   "remove.dataChange")
+_KEY_COLUMNS = ("add.path", "remove.path")
+
+
+@dataclass
+class _ColSpan:
+    """One planned column's slice of the global output row space."""
+
+    name: str
+    phys: str
+    row_start: int
+    n_rows: int
+    key: int
+
+
+def _leaf_index(schema, column: str) -> Optional[int]:
     for i in range(len(schema)):
         if schema.column(i).path == column:
-            col_idx = i
-            break
-    if col_idx is None:
-        raise DecodeUnsupported(f"column {column} not found")
-    sc = schema.column(col_idx)
-    max_def = sc.max_definition_level
+            return i
+    return None
+
+
+def _plan_column(st: _PlanState, pf, data: bytes, col_idx: int,
+                 key: int, part_dict: Dict[bytes, int],
+                 uniq: List[bytes]) -> _ColSpan:
+    """Plan every row group's chunk of one leaf column. Row groups are
+    the inner loop, so a column's rows are CONTIGUOUS in the global row
+    space regardless of row-group count."""
+    md = pf.metadata
+    sc = md.schema.column(col_idx)
     if sc.max_repetition_level != 0:
         raise DecodeUnsupported("repeated column")
-    out_vals: List[np.ndarray] = []
-    out_valid: List[np.ndarray] = []
-    for rg in range(md.num_row_groups):
-        col = md.row_group(rg).column(col_idx)
-        start = col.data_page_offset
-        if col.dictionary_page_offset is not None:
-            start = min(start, col.dictionary_page_offset)
-        f.seek(start)
-        chunk = f.read(col.total_compressed_size)
-        v, ok = decode_column_chunk(
-            chunk, col.physical_type, col.compression, max_def,
-            device)
-        out_vals.append(v)
-        out_valid.append(ok)
-    return np.concatenate(out_vals), np.concatenate(out_valid)
+    phys = sc.physical_type
+    if key:
+        if phys != "BYTE_ARRAY" or sc.max_definition_level != 2:
+            raise DecodeUnsupported("key column shape")
+    elif phys not in _PHYS_ITEM:
+        raise DecodeUnsupported(f"physical {phys}")
+    row_start = st.rows
+    try:
+        for rg in range(md.num_row_groups):
+            col = md.row_group(rg).column(col_idx)
+            start = col.data_page_offset
+            if col.dictionary_page_offset is not None:
+                start = min(start, col.dictionary_page_offset)
+            chunk = data[start:start + col.total_compressed_size]
+            _plan_column_chunk(st, chunk, phys, col.compression,
+                               sc.max_definition_level, key,
+                               part_dict, uniq)
+    except (IndexError, struct.error) as e:
+        raise DecodeUnsupported(f"corrupt page stream: {e}") from e
+    return _ColSpan(sc.path, phys, row_start, st.rows - row_start, key)
+
+
+def build_part_plan(pf, data: bytes, value_cols: List[str],
+                    key_cols: List[str]):
+    """Build the one-lane decode plan for a checkpoint part: all pages
+    of the projected columns packed into one padded uint8 lane plus
+    int32 run/page plans (`ops/page_decode.py.PartPlan`).
+
+    Value-column failures propagate (whole-part Arrow fallback, digest
+    parity by construction); a KEY column that can't be planned (PLAIN
+    pages from a dictionary overflow, odd nesting...) is rolled back via
+    snapshot/restore and simply dropped — the part still decodes its
+    numeric columns on device, only the replay handoff is off.
+
+    Returns (plan, spans, uniq, dropped_keys)."""
+    from delta_tpu.ops.page_decode import (
+        KEY_ADD, KEY_REMOVE, PAGE_F, RUN_F, PartPlan, _FAR)
+    from delta_tpu.ops.replay import pad_bucket
+
+    st = _PlanState()
+    part_dict: Dict[bytes, int] = {}
+    uniq: List[bytes] = []
+    spans: List[_ColSpan] = []
+    dropped_keys: List[str] = []
+    schema = pf.metadata.schema
+    for name in value_cols:
+        idx = _leaf_index(schema, name)
+        if idx is None:
+            raise DecodeUnsupported(f"column {name} not found")
+        spans.append(_plan_column(st, pf, data, idx, 0, part_dict, uniq))
+    for name in key_cols:
+        idx = _leaf_index(schema, name)
+        if idx is None:
+            continue
+        key = KEY_ADD if name.startswith("add.") else KEY_REMOVE
+        snap = st.snapshot()
+        n_uniq = len(uniq)
+        try:
+            spans.append(_plan_column(st, pf, data, idx, key,
+                                      part_dict, uniq))
+        except DecodeUnsupported:
+            st.restore(snap)
+            for b in uniq[n_uniq:]:
+                del part_dict[b]
+            del uniq[n_uniq:]
+            dropped_keys.append(name)
+    if not st.pages:
+        raise DecodeUnsupported("no data pages")
+    plan = _pack_plan(st, has_keys=any(s.key for s in spans))
+    return plan, spans, uniq, dropped_keys
+
+
+def _pack_plan(st: _PlanState, has_keys: bool):
+    """Pad the accumulated plan state into a PartPlan: lane to the byte
+    bucket, run/page plans to small buckets with searchsorted-safe
+    sentinel starts on the pad rows."""
+    from delta_tpu.ops.page_decode import PAGE_F, RUN_F, PartPlan, _FAR
+    from delta_tpu.ops.replay import pad_bucket
+
+    lane = np.zeros(pad_bucket(max(st.lane_len, 1)), np.uint8)
+    if st.lane_len:
+        lane[:st.lane_len] = np.frombuffer(b"".join(st.segs), np.uint8)
+    runs = np.zeros((pad_bucket(len(st.runs), min_bucket=128), RUN_F),
+                    np.int32)
+    runs[len(st.runs):, 0] = _FAR  # pad runs sort after every real h
+    if st.runs:
+        runs[:len(st.runs)] = np.asarray(st.runs, np.int32)
+    pages = np.zeros((pad_bucket(len(st.pages), min_bucket=128), PAGE_F),
+                     np.int32)
+    pages[len(st.pages):, 0] = _FAR
+    pages[:len(st.pages)] = np.asarray(st.pages, np.int32)
+    return PartPlan(lane=lane, runs=runs, pages=pages, h_total=st.h,
+                    n_rows=st.rows, has_keys=has_keys)
+
+
+def _combine_values(phys: str, lo: np.ndarray, hi: np.ndarray
+                    ) -> np.ndarray:
+    """Two u32 device lanes -> the column's numpy values (the decode jit
+    stays x32-clean for Mosaic; widening happens here)."""
+    if phys == "BOOLEAN":
+        return lo.astype(bool)
+    if phys == "INT32":
+        return lo.view(np.int32)
+    u = lo.astype(np.uint64) | (hi.astype(np.uint64) << np.uint64(32))
+    return u.view(np.int64) if phys == "INT64" else u.view(np.float64)
 
 
 def read_checkpoint_column(path: str, column: str, device=None):
     """Decode one flat column of a checkpoint Parquet file through the
-    device page decoder. Returns (values, valid). The file footer is
-    read via pyarrow METADATA only (offsets/types); all page bytes
-    decode through this module + the Pallas kernel."""
+    device page decoder (one plan, one dispatch). Returns
+    (values, valid). The file footer is read via pyarrow METADATA only
+    (offsets/types); all page bytes decode through the one-lane plan."""
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
-    pf = pq.ParquetFile(path)
+    from delta_tpu.ops.page_decode import decode_part
+
     with open(path, "rb") as f:
-        return _decode_file_column(pf, f, column, device)
+        data = f.read()
+    pf = pq.ParquetFile(pa.BufferReader(data))
+    idx = _leaf_index(pf.metadata.schema, column)
+    if idx is None:
+        raise DecodeUnsupported(f"column {column} not found")
+    st = _PlanState()
+    span = _plan_column(st, pf, data, idx, 0, {}, [])
+    if not st.pages:
+        raise DecodeUnsupported("no data pages")
+    plan = _pack_plan(st, has_keys=False)
+    lo, hi, defined, _keys = decode_part(plan, device)
+    sl = slice(span.row_start, span.row_start + span.n_rows)
+    return _combine_values(span.phys, lo[sl], hi[sl]), defined[sl]
 
 
-DEVICE_COLUMNS = ("add.size", "add.modificationTime", "add.dataChange")
-
-
-def read_checkpoint_part_hybrid(path: str, device=None):
-    """Read a checkpoint part with the device page decoder handling the
-    hot numeric add columns and Arrow handling the rest, grafted into
-    one table identical to a plain Arrow read. None -> caller falls
-    back to the Arrow reader (shape outside the decoder's scope)."""
+def _graft_struct(tbl, pf, root: str, decoded):
+    """Replace `root`'s decoded children inside the Arrow-read table,
+    restoring the file's field order from the Arrow schema (the
+    leaf-path list loses the order of nested children)."""
     import pyarrow as pa
     import pyarrow.compute as pc
+
+    idx = tbl.column_names.index(root)
+    col = tbl.column(root).combine_chunks()
+    names = [f.name for f in col.type]
+    children = {n: col.field(i) for i, n in enumerate(names)}
+    children.update(decoded)
+    arrow_root = pf.schema_arrow.field(root).type
+    order = [f.name for f in arrow_root]
+    order += [n for n in children if n not in order]
+    present = [n for n in order if n in children]
+    new_col = pa.StructArray.from_arrays(
+        [children[n] for n in present], present, mask=pc.is_null(col))
+    return tbl.set_column(idx, root, new_col)
+
+
+def read_checkpoint_part_device(source, device=None, want_keys=True):
+    """Read a checkpoint part with the device page decoder handling the
+    projected hot columns in ONE dispatch and Arrow handling the rest,
+    grafted into a table identical to a plain Arrow read. `source` is a
+    path or the part's raw bytes (the pipeline prefetches bytes).
+
+    Returns (table, PartKeys-or-None); PartKeys carries the part's
+    device-resident replay-key code lane when both path columns planned
+    cleanly. None -> caller falls back to the Arrow reader (shape
+    outside the decoder's scope)."""
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from delta_tpu.ops.page_decode import PartKeys, decode_part
+
+    if isinstance(source, (bytes, bytearray, memoryview)):
+        data = bytes(source)
+    else:
+        with open(source, "rb") as f:
+            data = f.read()
+    # the native-decoder probe: plan + dispatch. Anything outside scope
+    # raises DecodeUnsupported; a genuine surprise must also select the
+    # byte-identical Arrow fallback rather than fail the read — but the
+    # suppression stops HERE: graft/assembly errors below raise.
     try:
-        pf = pq.ParquetFile(path)
+        pf = pq.ParquetFile(pa.BufferReader(data))
         schema = pf.metadata.schema
         leaves = [schema.column(i).path for i in range(len(schema))]
-        targets = [c for c in DEVICE_COLUMNS if c in leaves]
-        if not targets:
+        if not any(c in leaves for c in DEVICE_COLUMNS):
             return None
-        decoded = {}
-        with open(path, "rb") as f:
-            for col in targets:
-                decoded[col] = _decode_file_column(pf, f, col, device)
-        rest = [c for c in leaves if c not in targets]
-        tbl = pf.read(columns=rest)
-        add_idx = tbl.column_names.index("add")
-        add = tbl.column("add").combine_chunks()
-        names = [f.name for f in add.type]
-        children = {n: add.field(i) for i, n in enumerate(names)}
-        for col in targets:
-            vals, valid = decoded[col]
-            leaf = col.split(".", 1)[1]
-            children[leaf] = pa.array(vals, mask=~valid)
-        # restore the file's field order from the Arrow schema (the
-        # leaf-path list loses the order of nested children)
-        arrow_add = pf.schema_arrow.field("add").type
-        order = [f.name for f in arrow_add]
-        order += [n for n in children if n not in order]
-        arrays = [children[n] for n in order if n in children]
-        new_add = pa.StructArray.from_arrays(
-            arrays, [n for n in order if n in children],
-            mask=pc.is_null(add))
-        return tbl.set_column(add_idx, "add", new_add)
+        if pf.metadata.num_rows == 0:
+            # nothing to decode and nothing to replay: zero dispatches
+            return pf.read(), PartKeys(None, 0, 0, 0, [], 0)
+        value_cols = [c for c in _VALUE_COLUMNS if c in leaves]
+        key_cols = [c for c in _KEY_COLUMNS
+                    if want_keys and c in leaves
+                    and _key_arrow_ok(pf, c)]
+        plan, spans, uniq, _dropped = build_part_plan(
+            pf, data, value_cols, key_cols)
+        rest = [c for c in leaves
+                if c not in {s.name for s in spans}]
+        for root in {s.name.split(".", 1)[0] for s in spans}:
+            if not any(c.startswith(root + ".") for c in rest):
+                # the graft needs the Arrow-read root for struct
+                # validity; a fully-planned root has no carrier
+                raise DecodeUnsupported(f"no arrow leaf under {root}")
+        lo, hi, defined, keys = decode_part(plan, device)
     except DecodeUnsupported:
         return None
     # delta-lint: disable=except-swallow (audited: the native decoder is
     # an accelerator with a byte-identical Arrow fallback — any surprise
-    # must select the fallback, never fail the read)
+    # in the probe must select the fallback, never fail the read)
     except Exception:
-        return None  # any surprise -> Arrow fallback, never a failure
+        return None
+
+    tbl = pf.read(columns=rest)
+    by_root: Dict[str, Dict[str, object]] = {}
+    for s in spans:
+        root, leaf = s.name.split(".", 1)
+        sl = slice(s.row_start, s.row_start + s.n_rows)
+        valid = defined[sl]
+        if s.key:
+            codes = pa.array(lo[sl].view(np.int32), mask=~valid)
+            pool = pa.array([b.decode("utf-8") for b in uniq],
+                            pa.string())
+            arr = pa.DictionaryArray.from_arrays(codes, pool).cast(
+                pa.string())
+        else:
+            arr = pa.array(_combine_values(s.phys, lo[sl], hi[sl]),
+                           mask=~valid)
+        by_root.setdefault(root, {})[leaf] = arr
+    for root, decoded in by_root.items():
+        tbl = _graft_struct(tbl, pf, root, decoded)
+    if keys is not None:
+        keys.uniq = uniq
+        keys.n_rows = pf.metadata.num_rows
+    return tbl, keys
+
+
+def _key_arrow_ok(pf, column: str) -> bool:
+    """The replay-key rebuild requires the path leaf be a plain utf8
+    string directly under its root struct in the Arrow schema."""
+    import pyarrow as pa
+
+    root, leaf = column.split(".", 1)
+    try:
+        rt = pf.schema_arrow.field(root).type
+        ft = rt.field(leaf).type
+    except KeyError:
+        return False
+    return pa.types.is_struct(rt) and pa.types.is_string(ft)
+
+
+def read_checkpoint_part_hybrid(path: str, device=None):
+    """Compatibility wrapper: the grafted table only (no replay keys).
+    None -> caller falls back to the Arrow reader."""
+    out = read_checkpoint_part_device(path, device, want_keys=False)
+    return None if out is None else out[0]

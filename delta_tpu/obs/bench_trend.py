@@ -53,6 +53,7 @@ from delta_tpu.obs.device import CONDITIONS_UNKNOWN, conditions_fingerprint
 # entries first (names where suffix heuristics would guess wrong, e.g.
 # reuse_pct is a hit rate, not an overhead), then suffix rules.
 _DIRECTION: Dict[str, int] = {
+    "checkpoint_read_actions_per_sec": +1,
     "incremental_checkpoint_reuse_pct": +1,
     "replay_kernel_vs_host_vectorized": +1,
     "analyzer_findings_total": -1,

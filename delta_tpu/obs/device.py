@@ -595,7 +595,8 @@ def capture_conditions(cache_state: str = "unknown",
         pass
     env = {k: v for k, v in os.environ.items()
            if k in ("DELTA_TPU_REPLAY_ROUTE", "DELTA_TPU_DEVICE_PARSE",
-                    "DELTA_TPU_DEVICE_SKIP", "DELTA_TPU_LINK_MODEL",
+                    "DELTA_TPU_DEVICE_SKIP", "DELTA_TPU_DEVICE_DECODE",
+                    "DELTA_TPU_LINK_MODEL",
                     "DELTA_TPU_LINK_H2D_BPS", "DELTA_TPU_TRACE",
                     "DELTA_TPU_DEVICE_OBS", "JAX_PLATFORMS")}
     if env:

@@ -319,3 +319,67 @@ def _unpack_jnp(packed: jnp.ndarray, w: int) -> jnp.ndarray:
             v = v | (packed[lo + 1] << jnp.uint32(32 - sh))
         outs.append(v & mask)
     return jnp.stack(outs, axis=-1).reshape(g * 32)
+
+
+# ---------------------------------------------------------------------------
+# variable-shift bit-field extract (batched checkpoint page decode)
+# ---------------------------------------------------------------------------
+#
+# The one-lane page decoder (ops/page_decode.py) turns every RLE/
+# bit-packed hybrid position of a checkpoint part into four u32 lanes:
+# the 32-bit little-endian window at the value's byte offset (`lo`),
+# the spill byte above it (`hi`), the in-byte shift (`sh`, 0..7) and
+# the run's bit width (`w`, 0..32). Unlike `unpack_bitpacked` the shift
+# is DATA-dependent (each element belongs to a different run), so the
+# extract is elementwise rather than a static unrolled group loop.
+
+
+def _shift_extract_body(lo, hi, sh, w):
+    """value = ((lo >> sh) | (hi << (32 - sh))) & mask(w), elementwise
+    u32. `(32 - sh) & 31` + the sh>0 select keeps the sh==0 lane off
+    the undefined 32-bit shift."""
+    spill = jnp.where(sh > jnp.uint32(0),
+                      hi << ((jnp.uint32(32) - sh) & jnp.uint32(31)),
+                      jnp.uint32(0))
+    mask = jnp.where(
+        w >= jnp.uint32(32), jnp.uint32(0xFFFFFFFF),
+        (jnp.uint32(1) << (w & jnp.uint32(31))) - jnp.uint32(1))
+    return ((lo >> sh) | spill) & mask
+
+
+def _shift_extract_kernel(lo_ref, hi_ref, sh_ref, w_ref, out_ref):
+    """All refs: [8, 128] uint32 tiles; one VMEM pass per tile."""
+    out_ref[:] = _shift_extract_body(lo_ref[:], hi_ref[:], sh_ref[:],
+                                     w_ref[:])
+
+
+@jax.jit
+def shift_extract_tiled(lo: jnp.ndarray, hi: jnp.ndarray,
+                        sh: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """lo/hi/sh/w: [n] uint32 (n a multiple of 1024) -> [n] uint32."""
+    (n,) = lo.shape
+    assert n % _TILE == 0, n
+    tiles = n // _TILE
+    spec = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))
+    shaped = [a.reshape(tiles * _SUBLANES, _LANES)
+              for a in (lo, hi, sh, w)]
+    out = pl.pallas_call(
+        _shift_extract_kernel,
+        grid=(tiles,),
+        in_specs=[spec, spec, spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((tiles * _SUBLANES, _LANES),
+                                       jnp.uint32),
+        interpret=_use_interpret(),
+    )(*shaped)
+    return out.reshape(n)
+
+
+def shift_extract(lo: jnp.ndarray, hi: jnp.ndarray, sh: jnp.ndarray,
+                  w: jnp.ndarray, use_pallas: bool) -> jnp.ndarray:
+    """Trace-time dispatcher used INSIDE the page-decode jit: the Pallas
+    tile on TPU, the identical fused-jnp body elsewhere (interpret-mode
+    Pallas inside a large jit would serialize the whole dispatch)."""
+    if use_pallas and HAVE_PALLAS and lo.shape[0] % _TILE == 0:
+        return shift_extract_tiled(lo, hi, sh, w)
+    return _shift_extract_body(lo, hi, sh, w)

@@ -31,6 +31,8 @@ profitable" so behavior is deterministic. Env overrides:
                                0|off -> host (parse_route)
   DELTA_TPU_DEVICE_SKIP        force|1|on -> device data skipping,
                                0|off -> host numpy twin (skip_route)
+  DELTA_TPU_DEVICE_DECODE      force|1|on -> device checkpoint page
+                               decode, 0|off -> Arrow (decode_route)
 """
 
 from __future__ import annotations
@@ -68,6 +70,14 @@ _FA_BYTES_PER_ROW = 0.25
 # the gate only needs the crossover's order of magnitude.
 _HOST_SCAN_BPS = 270e6
 _DEVICE_PARSE_BPS = 2e9
+
+# Checkpoint page-decode routing estimates: the Arrow C++ reader
+# decodes checkpoint parts at roughly 900 MB/s of raw page bytes on one
+# vCPU; the one-lane device decode is planned at ~3 GB/s (a single
+# dispatch whose extract/gather stages are memory-bound). As with the
+# parse gate, only the crossover's order of magnitude matters.
+_HOST_ARROW_BPS = 900e6
+_DEVICE_DECODE_BPS = 3e9
 
 # Data-skipping routing estimates in atom x file cells/s: the host
 # numpy twin streams a few int64 compares per cell, the device kernel
@@ -261,6 +271,44 @@ def parse_route(
     t_device = model.h2d_seconds(nbytes) + nbytes / _DEVICE_PARSE_BPS
     predicted = {"host": t_host, "device": t_device}
     return _decide("parse", "device" if t_device < t_host else "host",
+                   inputs, predicted)
+
+
+def decode_route(
+    nbytes: int,
+    engine_enabled: bool = False,
+    forced: Optional[str] = None,
+) -> str:
+    """Pick the checkpoint page-decode route: "host" (the Arrow reader)
+    or "device" (log/page_decode.py one-lane plan +
+    ops/page_decode.py batched decode, one dispatch per part).
+
+    Decided ONCE per checkpoint read over the parts' total byte size —
+    the dispatch funnel then accumulates every part's observed cost
+    onto the single decision. Like `parse_route`, the CPU free-transfer
+    model does NOT flip this to device-always: Arrow IS the calibrated
+    fast path on CPU backends, so the device route needs the engine's
+    construction-time opt-in (`use_device_decode`, true on accelerator
+    backends) before the link economics are consulted. Unsupported
+    shapes fall back whole-part mid-flight (`obs.gate_fell_back`).
+    DELTA_TPU_DEVICE_DECODE outranks everything (tests, bench lanes)."""
+    inputs = {"nbytes": nbytes, "engine_enabled": engine_enabled}
+    env = os.environ.get("DELTA_TPU_DEVICE_DECODE")
+    if env is not None:
+        if env.lower() in ("force", "1", "on", "device"):
+            return _decide("decode", "device", inputs, reason="env")
+        if env.lower() in ("0", "off", "host"):
+            return _decide("decode", "host", inputs, reason="env")
+    if forced in ("host", "device"):
+        return _decide("decode", forced, inputs, reason="forced")
+    if not engine_enabled or nbytes <= 0:
+        return _decide("decode", "host", inputs,
+                       reason="engine-disabled")
+    model = link_model()
+    t_host = nbytes / _HOST_ARROW_BPS
+    t_device = model.h2d_seconds(nbytes) + nbytes / _DEVICE_DECODE_BPS
+    predicted = {"host": t_host, "device": t_device}
+    return _decide("decode", "device" if t_device < t_host else "host",
                    inputs, predicted)
 
 

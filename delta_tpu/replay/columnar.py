@@ -47,6 +47,8 @@ _OBS_CACHE_MISSES = obs.counter("parse_cache.misses")
 _OBS_CACHE_HIT_FILES = obs.counter("parse_cache.hit_files")
 _OBS_CACHE_MISS_FILES = obs.counter("parse_cache.miss_files")
 _TORN_COMMITS = obs.counter("log.torn_commits")
+_OBS_DECODE_PARTS = obs.counter("decode.device_parts")
+_OBS_DECODE_FALLBACKS = obs.counter("decode.device_fallbacks")
 
 DV_STRUCT_TYPE = pa.struct(
     [
@@ -934,7 +936,44 @@ def _columnarize_log_segment(
     blocks: List[pa.Table] = []
     bytes_parsed = 0
 
-    def _consume_checkpoint_table(tbl: pa.Table):
+    # Device-resident replay handoff: when the checkpoint is the sole
+    # file-action source, every part's replay-key code lane (decoded on
+    # device, never materialized on host) can feed the replay kernel
+    # directly. Any contributor the decoder didn't key (sidecar, Arrow
+    # fallback, JSON part) or any count/dv mismatch disables it — the
+    # host replay path is then authoritative.
+    want_handoff = (early_replay and not small_only
+                    and bool(segment.checkpoints)
+                    and not segment.compacted_deltas
+                    and not segment.deltas)
+    handoff = {"ok": want_handoff, "parts": []}
+
+    def _dv_all_null(block) -> bool:
+        return (block is None
+                or block.column("dv_id").null_count == block.num_rows)
+
+    def _track_handoff(part_keys, add_block, rem_block) -> None:
+        if not handoff["ok"]:
+            return
+        n_add = add_block.num_rows if add_block is not None else 0
+        n_rem = rem_block.num_rows if rem_block is not None else 0
+        if part_keys is None:
+            # keyless contributors break row alignment unless they
+            # contribute no file-action rows at all
+            handoff["ok"] = not (n_add or n_rem)
+            return
+        # the device key lane must agree row-for-row with the Arrow
+        # blocks: same present counts, no null paths inside present
+        # structs, and no deletion vectors (the key lane is path-only)
+        if (part_keys.n_bad or part_keys.n_add != n_add
+                or part_keys.n_rem != n_rem
+                or not _dv_all_null(add_block)
+                or not _dv_all_null(rem_block)):
+            handoff["ok"] = False
+        else:
+            handoff["parts"].append(part_keys)
+
+    def _consume_checkpoint_table(tbl: pa.Table, part_keys=None):
         nonlocal blocks
         n = tbl.num_rows
         versions = np.full(n, cp_version, np.int64)
@@ -944,10 +983,14 @@ def _columnarize_log_segment(
         tracker.scan_chunk(tbl, versions, orders)
         if small_only:
             return  # sidecars carry only file actions — nothing to do
+        part_blocks = {}
         for col in ("add", "remove"):
             block = _extract_file_actions(tbl, col, versions, orders)
+            part_blocks[col] = block
             if block is not None:
                 blocks.append(block)
+        _track_handoff(part_keys, part_blocks["add"],
+                       part_blocks["remove"])
         # V2 checkpoints: resolve sidecar pointers to _sidecars/ parquet
         if "sidecar" in tbl.column_names:
             sc = tbl.column("sidecar").combine_chunks()
@@ -963,15 +1006,6 @@ def _columnarize_log_segment(
 
     def _read_checkpoint_part(path: str):
         if not small_only:
-            if getattr(engine, "use_device_page_decode", False):
-                from delta_tpu.log.page_decode import (
-                    read_checkpoint_part_hybrid,
-                )
-
-                tbl = read_checkpoint_part_hybrid(path)
-                if tbl is not None:
-                    yield tbl
-                    return
             yield from engine.parquet.read_parquet_files([path])
             return
         try:
@@ -985,16 +1019,70 @@ def _columnarize_log_segment(
     # --- checkpoint parts (columnar already) ---
     cp_version = segment.checkpoint_version
 
+    def _consume_parts_device(parts):
+        """Device page-decode route: prefetched part BYTES feed the
+        one-lane plan builder (one dispatch per part); an unsupported
+        shape decodes the SAME bytes through Arrow — never re-fetched."""
+        nonlocal bytes_parsed
+        import pyarrow.parquet as pq
+
+        from delta_tpu.log.page_decode import read_checkpoint_part_device
+        from delta_tpu.replay.pipeline import prefetch_file_bytes
+
+        byte_iter = prefetch_file_bytes(
+            engine, [f.path for f in parts
+                     if not f.path.endswith(".json")])
+        for fstat in parts:
+            try:
+                if fstat.path.endswith(".json"):
+                    tbl = pa_json.read_json(pa.BufferReader(
+                        engine.fs.read_file(fstat.path)))
+                    _consume_checkpoint_table(tbl)
+                else:
+                    data = next(byte_iter)
+                    out = read_checkpoint_part_device(
+                        data, want_keys=want_handoff)
+                    if out is not None:
+                        _OBS_DECODE_PARTS.inc()
+                        _consume_checkpoint_table(out[0], out[1])
+                    else:
+                        _OBS_DECODE_FALLBACKS.inc()
+                        obs.gate_fell_back("decode", "host",
+                                           reason="unsupported-shape")
+                        with obs.gate_observation("decode", "host"):
+                            tbl = pq.read_table(pa.BufferReader(data))
+                        _consume_checkpoint_table(tbl)
+            except FileNotFoundError:
+                from delta_tpu.errors import LogCorruptedError
+
+                raise LogCorruptedError(
+                    f"couldn't find all part files of the checkpoint at "
+                    f"version {cp_version}: {fstat.path} is missing",
+                    error_class="DELTA_MISSING_PART_FILES")
+            bytes_parsed += fstat.size
+
     def _consume_checkpoint_parts():
         nonlocal bytes_parsed
         parts = list(segment.checkpoints)
+        # One routing decision per checkpoint read (the dispatch funnel
+        # accumulates every part's cost onto it): raw part bytes over
+        # the link vs the host Arrow decode rate.
+        if not small_only and any(not f.path.endswith(".json")
+                                  for f in parts):
+            from delta_tpu.parallel import gate as _gate
+
+            nbytes = sum(max(0, int(f.size)) for f in parts
+                         if not f.path.endswith(".json"))
+            if _gate.decode_route(
+                    nbytes, getattr(engine, "use_device_decode",
+                                    False)) == "device":
+                _consume_parts_device(parts)
+                return
         # Multipart/V2 parquet checkpoints: ONE batched handler call so
         # its byte-prefetch overlaps part i's decode with part i+1's
         # read. Consumption order is unchanged; the small_only
-        # projection-fallback and device page-decode paths keep the
-        # per-part loop below.
+        # projection fallback keeps the per-part loop below.
         if (len(parts) > 1 and not small_only
-                and not getattr(engine, "use_device_page_decode", False)
                 and all(not f.path.endswith(".json") for f in parts)):
             tables = engine.parquet.read_parquet_files(
                 [f.path for f in parts])
@@ -1032,10 +1120,28 @@ def _columnarize_log_segment(
                     error_class="DELTA_MISSING_PART_FILES")
             bytes_parsed += fstat.size
 
+    native_keys = None
+    native_pending = None
+    native_stats_thunk = None
+
     if segment.checkpoints:
         with obs.span("log.read_checkpoint", version=cp_version,
                       parts=len(segment.checkpoints)):
             _consume_checkpoint_parts()
+        if handoff["ok"] and handoff["parts"]:
+            # checkpoint-only load with every part keyed on device:
+            # launch the replay straight from the device-resident code
+            # lanes — the device sorts while the host assembles Arrow
+            from delta_tpu.ops.page_decode import (
+                launch_checkpoint_handoff,
+            )
+
+            mesh = getattr(engine, "mesh", None)
+            n_shards = mesh.devices.size if mesh is not None else 1
+            forced = ("sharded" if n_shards > 1 and getattr(
+                engine, "_mesh_forced", False) else None)
+            native_pending = launch_checkpoint_handoff(
+                handoff["parts"], n_shards=n_shards, forced=forced)
 
     # --- compacted deltas + commits: parallel read, one JSON parse ---
     from delta_tpu.utils import filenames as fn
@@ -1050,9 +1156,6 @@ def _columnarize_log_segment(
         commit_infos.append((fn.delta_version(fstat.path), fstat.path, fstat.size))
         commit_stats.append(fstat)
 
-    native_keys = None
-    native_pending = None
-    native_stats_thunk = None
     checkpoint_blocks = list(blocks)
     if commit_infos:
         cache = parse_cache()

@@ -58,6 +58,7 @@ from delta_tpu import obs
 
 _WINDOWS = obs.counter("pipeline.windows")
 _WINDOW_FALLBACKS = obs.counter("pipeline.window_fallbacks")
+_PART_BYTES_PREFETCHED = obs.counter("pipeline.part_bytes_prefetched")
 _BYTES_READ = obs.counter("pipeline.bytes_read")
 _READ_STALL_NS = obs.counter("pipeline.read_stall_ns")
 _PARSE_STALL_NS = obs.counter("pipeline.parse_stall_ns")
@@ -552,3 +553,37 @@ def parse_commits_pipelined(
         sp.set_attrs(bytes=nbytes, rows=block.num_rows,
                      merged_keys=merged is not None)
         return span, pending, nbytes
+
+
+def prefetch_file_bytes(engine, paths: Sequence[str], depth: int = 2):
+    """Yield each file's raw bytes in input order with a bounded
+    read-ahead on the shared I/O pool, so consuming file i overlaps
+    reading file i+1. The device checkpoint page decode consumes part
+    BYTES (the one-lane plan builder parses them itself), so the
+    engine's parquet-table prefetcher can't serve it — this is the
+    byte-level twin of `HostParquetHandler.read_parquet_files`. Reads
+    are leaf pool tasks; a cancelled tail never leaks a future."""
+    from collections import deque
+
+    from delta_tpu.utils.threads import shared_pool
+
+    paths = list(paths)
+    if len(paths) <= 1:
+        for p in paths:
+            yield engine.fs.read_file(p)
+        return
+    pool = shared_pool()
+    read = obs.wrap(engine.fs.read_file)
+    pending: deque = deque()
+    i = 0
+    try:
+        while pending or i < len(paths):
+            while i < len(paths) and len(pending) <= depth:
+                if pending:
+                    _PART_BYTES_PREFETCHED.inc()
+                pending.append(pool.submit(read, paths[i]))
+                i += 1
+            yield pending.popleft().result()
+    finally:
+        for fut in pending:
+            fut.cancel()

@@ -41,6 +41,7 @@ from delta_tpu.tools.analyzer.passes._astutil import call_name
 # goes through the dispatch funnel (PR 15).
 _DEFAULT_MODULES = (
     "delta_tpu/ops/json_parse.py",
+    "delta_tpu/ops/page_decode.py",
     "delta_tpu/ops/skipping.py",
     "delta_tpu/ops/stats.py",
     "delta_tpu/ops/replay.py",
