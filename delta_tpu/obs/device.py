@@ -554,6 +554,32 @@ CONDITIONS_SCHEMA = "delta-tpu/capture-conditions/v1"
 # conditioned captures instead of silently comparing across platforms
 CONDITIONS_UNKNOWN = "unknown-pre-r20"
 
+# Every env knob that can change a routing decision or the shape of
+# what a capture measured. The delta-lint `route-contract` and
+# `env-knob-capture-stamp` passes parse this tuple statically: a route
+# knob (or any env_knobs.json entry marked `"capture": true`) missing
+# here fails lint, so a new route can't repeat the PR 16 "forgot to
+# stamp DELTA_TPU_DEVICE_DECODE" omission.
+CAPTURE_ENV_KEYS = (
+    "DELTA_TPU_REPLAY_ROUTE",
+    "DELTA_TPU_DEVICE_PARSE",
+    "DELTA_TPU_DEVICE_SKIP",
+    "DELTA_TPU_DEVICE_DECODE",
+    "DELTA_TPU_LINK_MODEL",
+    "DELTA_TPU_LINK_H2D_BPS",
+    "DELTA_TPU_LINK_RTT_S",
+    "DELTA_TPU_H2D_CHUNK",
+    "DELTA_TPU_SHARDED_MIN_ROWS",
+    "DELTA_TPU_RESIDENT",
+    "DELTA_TPU_DEVICE_CKPT_STATS",
+    "DELTA_TPU_DEVICE_DV_PACK",
+    "DELTA_TPU_DEVICE_DV_DECODE",
+    "DELTA_TPU_DEVICE_SQL",
+    "DELTA_TPU_TRACE",
+    "DELTA_TPU_DEVICE_OBS",
+    "JAX_PLATFORMS",
+)
+
 
 def capture_conditions(cache_state: str = "unknown",
                        extra: Optional[Dict[str, object]] = None
@@ -594,11 +620,7 @@ def capture_conditions(cache_state: str = "unknown",
     except ImportError:
         pass
     env = {k: v for k, v in os.environ.items()
-           if k in ("DELTA_TPU_REPLAY_ROUTE", "DELTA_TPU_DEVICE_PARSE",
-                    "DELTA_TPU_DEVICE_SKIP", "DELTA_TPU_DEVICE_DECODE",
-                    "DELTA_TPU_LINK_MODEL",
-                    "DELTA_TPU_LINK_H2D_BPS", "DELTA_TPU_TRACE",
-                    "DELTA_TPU_DEVICE_OBS", "JAX_PLATFORMS")}
+           if k in CAPTURE_ENV_KEYS}
     if env:
         cond["env"] = env
     if extra:

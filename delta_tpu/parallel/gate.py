@@ -189,6 +189,42 @@ def sharded_min_rows() -> int:
     return DEFAULT_SHARDED_MIN_ROWS
 
 
+class RouteSpec(NamedTuple):
+    """Declared contract surface of one gated device route."""
+
+    env: str               # override knob the route function reads
+    fallback_counter: str  # cataloged counter the fallback path bumps
+    doc_anchor: str        # docs/architecture.md heading slug (prefix)
+
+
+# The route registry: one entry per gate name passed to `_decide`.
+# This is the declarative half of the 7-point route contract (host
+# twin, fallback + counter, dispatch funnel, budget entry, calibration
+# join, env override, capture-conditions stamp); the delta-lint
+# `route-contract` pass parses it statically and cross-checks every
+# claim against the code, so a new `*_route` function must register
+# here — and actually honor the contract — before lint passes. Keep
+# values literal: the checker reads the AST, it never imports us.
+ROUTES: Dict[str, RouteSpec] = {
+    "replay": RouteSpec(
+        env="DELTA_TPU_REPLAY_ROUTE",
+        fallback_counter="replay.resident_fallbacks",
+        doc_anchor="the-profitability-gate"),
+    "parse": RouteSpec(
+        env="DELTA_TPU_DEVICE_PARSE",
+        fallback_counter="parse.device_fallbacks",
+        doc_anchor="device-json-action-parse"),
+    "decode": RouteSpec(
+        env="DELTA_TPU_DEVICE_DECODE",
+        fallback_counter="decode.device_fallbacks",
+        doc_anchor="device-checkpoint-page-decode"),
+    "skip": RouteSpec(
+        env="DELTA_TPU_DEVICE_SKIP",
+        fallback_counter="scan.device_fallbacks",
+        doc_anchor="device-scan-planning"),
+}
+
+
 def _decide(gate: str, chosen: str, inputs: Dict[str, object],
             predicted: Optional[Dict[str, float]] = None,
             reason: str = "economics") -> str:

@@ -9,7 +9,9 @@ module-pass facts into its project pass. What IS sound is per-file
 *change detection*: the cache keys every scanned file by
 ``(mtime_ns, size)`` with a content-hash fallback (a ``touch`` or a
 checkout that rewrites identical bytes stays a hit), plus a stamp over
-the analyzer's own sources and the rule set. When nothing changed, the
+the analyzer's own sources, the catalogs its passes cross-reference
+(error/metric/transfer/env-knob JSON, docs/architecture.md), and the
+rule set. When nothing changed, the
 previous report is reconstructed without parsing a single file —
 that is the CI hot path (re-runs on unchanged trees) and the
 ``analyzer_cached_rescan`` bench path. When anything changed, the scan
@@ -45,15 +47,58 @@ def default_cache_path() -> str:
     return os.environ.get(CACHE_ENV) or DEFAULT_CACHE_NAME
 
 
+# Env overrides that redirect a pass's catalog to another file; the
+# pointed-at file is a scan input and must be part of the stamp too.
+_CATALOG_ENVS = (
+    "DELTA_LINT_CATALOG",
+    "DELTA_LINT_METRIC_CATALOG",
+    "DELTA_LINT_TRANSFER_BUDGET",
+    "DELTA_LINT_ENV_CATALOG",
+    "DELTA_LINT_ARCH_DOC",
+)
+
+
+def _catalog_files() -> List[str]:
+    """Every non-Python input the passes consume: the packaged JSON
+    catalogs (error/metric/transfer/env-knob), docs/architecture.md
+    (route-contract anchors), and any env-override catalog paths."""
+    out: List[str] = []
+    try:
+        import delta_tpu
+    except ImportError:  # pragma: no cover - analyzer ships inside it
+        return out
+    pkg = os.path.dirname(os.path.abspath(delta_tpu.__file__))
+    res = os.path.join(pkg, "resources")
+    if os.path.isdir(res):
+        out.extend(os.path.join(res, name)
+                   for name in sorted(os.listdir(res))
+                   if name.endswith(".json"))
+    doc = os.path.join(os.path.dirname(pkg), "docs", "architecture.md")
+    if os.path.exists(doc):
+        out.append(doc)
+    for env in _CATALOG_ENVS:
+        p = os.environ.get(env)
+        if p and os.path.exists(p) and p not in out:
+            out.append(p)
+    return out
+
+
 def _toolprint() -> str:
-    """Fingerprint of the analyzer package itself (stat-based): a rule
-    edit must invalidate every cached report."""
+    """Fingerprint of the analyzer's full input surface (stat-based):
+    its own sources AND the catalogs the passes cross-reference. A rule
+    edit — or a catalog edit (a new transfer-budget lane, a retired env
+    knob, a renamed architecture heading) — must invalidate every
+    cached report; findings depend on those files as much as on the
+    scanned tree."""
     pkg = os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha1()
     for fp in sorted(_iter_py_files(pkg)):
         st = os.stat(fp)
         h.update(f"{os.path.relpath(fp, pkg)}|{st.st_mtime_ns}|"
                  f"{st.st_size}\n".encode())
+    for fp in _catalog_files():
+        st = os.stat(fp)
+        h.update(f"{fp}|{st.st_mtime_ns}|{st.st_size}\n".encode())
     return h.hexdigest()
 
 

@@ -84,6 +84,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule_id}: {desc}  [{cls.help_uri()}]")
         return 0
 
+    # Anchor the default target at the package parent so module rels
+    # come out as "delta_tpu/..." — the form the module-scoped rules
+    # (dispatch coverage, transfer budget, recompile risk) and the
+    # manifest site keys are written in. Explicit paths scan as given.
+    root = None
+    if not args.paths:
+        root = os.path.dirname(_default_target())
     paths = args.paths or [_default_target()]
     for p in paths:
         if not os.path.exists(p):
@@ -94,13 +101,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.changed:
             report, stats = analyze_paths_cached(
-                paths, rules=rules,
+                paths, root=root, rules=rules,
                 cache_path=args.cache_file or default_cache_path())
             print(f"delta-lint: cache {stats['cache']} "
                   f"({stats['changed_files']} changed of "
                   f"{stats['files']} files)", file=sys.stderr)
         else:
-            report = analyze_paths(paths, rules=rules)
+            report = analyze_paths(paths, root=root, rules=rules)
     except ValueError as e:  # unknown rule id
         print(f"delta-lint: {e}", file=sys.stderr)
         return 2

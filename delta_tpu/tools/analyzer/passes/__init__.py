@@ -3,6 +3,7 @@ rule; add new rule modules to the import list below."""
 
 from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     dispatch,
+    env_catalog,
     errors_catalog,
     handler_discipline,
     hygiene,
@@ -12,7 +13,9 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     obs,
     purity,
     races,
+    recompile,
     retry_discipline,
+    route_contract,
     threads,
     transfer_budget,
 )

@@ -1,0 +1,371 @@
+"""Recompile-risk lint: ``recompile-risk``.
+
+The static twin of the runtime ``DELTA_TPU_RECOMPILE_ALARM`` (PR 15):
+a jit/shard_map/pallas callsite whose operand shape tracks a
+data-dependent length compiles a fresh executable per distinct length
+— the recompile storms the dispatch profiler alarms on at runtime are
+*statically visible* in the operand constructors. Inside the covered
+kernel modules, this pass flags calls to jitted callables whose
+operands take their shape from ``len(...)``, ``.shape`` of an
+unpadded input, or an appended-to list build, without the length
+first flowing through a recognized pad-to-bucket helper
+(``ops/replay.py::pad_bucket`` — the repo-wide bucketing quantum).
+
+The taint model is deliberately local and conservative (near-zero
+noise beats exhaustive recall — the runtime alarm still backstops):
+
+- a local becomes a *tainted scalar* when assigned from an expression
+  containing ``len(...)`` or ``.shape`` with no pad-helper call;
+- a local assigned from a pad-helper call is *padded*, and scalar
+  arithmetic over a padded local stays padded (``pad = m - n`` is the
+  bucket complement — the canonical top-up idiom
+  ``np.concatenate([x, np.zeros(pad)])`` is bucket-sized by
+  construction, so it must not flag);
+- a local list that is ``.append``-ed to is a *tainted list* (its
+  length is data-dependent by construction);
+- a local becomes a *tainted array* when an array constructor's
+  **shape position** is data-dependent — ``zeros/ones/empty/full``
+  judge their shape argument, ``arange`` any argument,
+  ``asarray/array`` taint only from a tainted list/array input (a
+  0-d ``np.asarray(n)`` scalar operand carries value, not shape),
+  ``concatenate/stack`` from tainted list/array inputs or a nested
+  shape-tainted constructor — and taint propagates through
+  array-to-array assignment;
+- passing a tainted array (or an inline shape-tainted constructor)
+  to a jitted callable is the finding, one per callsite.
+
+Jitted callables are recognized module-locally: defs decorated with
+``jit``/``jax.jit``/``partial(jax.jit, ...)``/``pjit``/``pallas_call``
+and names assigned from those calls.
+
+Intentionally shape-polymorphic sites carry a *typed exemption*: the
+in-code registry below maps ``rel.py::qualname`` to (kind, reason) —
+``bounded-polymorphism`` (the varying axis is schema-bound to a
+handful of values), ``cached-wrapper`` (the callee memoizes per padded
+shape elsewhere), ``host-fallback`` (the call only runs off the hot
+path), or ``measured`` (churn is priced and alarmed at runtime).
+Overrides, mostly for fixture tests:
+
+  DELTA_LINT_RECOMPILE_MODULES      comma-separated rel paths
+                                    replacing the covered-module set
+  DELTA_LINT_RECOMPILE_PAD_HELPERS  comma-separated callable names
+                                    replacing the pad-helper set
+  DELTA_LINT_RECOMPILE_EXEMPT       comma-separated ``rel.py::qualname``
+                                    entries replacing the exemption
+                                    registry
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+# The covered kernel modules: every jit launch in these files is on a
+# hot path where a recompile storm is a production incident.
+_DEFAULT_MODULES = (
+    "delta_tpu/ops/json_parse.py",
+    "delta_tpu/ops/page_decode.py",
+    "delta_tpu/ops/skipping.py",
+    "delta_tpu/ops/stats.py",
+    "delta_tpu/ops/replay.py",
+    "delta_tpu/ops/replay_blockwise.py",
+    "delta_tpu/ops/zorder.py",
+    "delta_tpu/parallel/resident.py",
+    "delta_tpu/parallel/sharded_replay.py",
+    "delta_tpu/parallel/sharded_blockwise.py",
+    "delta_tpu/stats/device_index.py",
+    "delta_tpu/sqlengine/device.py",
+)
+
+_DEFAULT_PAD_HELPERS = ("pad_bucket",)
+
+# Typed exemptions: intentionally shape-polymorphic sites.
+# kind: bounded-polymorphism | cached-wrapper | host-fallback | measured
+_EXEMPTIONS: Dict[str, Tuple[str, str]] = {
+    "delta_tpu/ops/zorder.py::zorder_sort_indices": (
+        "bounded-polymorphism",
+        "the stacked key matrix's first axis is the clustering column "
+        "count — schema-bound to a handful of distinct values per "
+        "table, while the row axis pads to pad_bucket; OPTIMIZE "
+        "compiles one program per column count by design and the "
+        "runtime recompile alarm prices any storm"),
+}
+
+_JIT_DECOS = {"jit", "pjit", "pallas_call", "shard_map"}
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "arange", "asarray",
+                "array", "concatenate", "stack"}
+
+
+def _covered_modules() -> Set[str]:
+    env = os.environ.get("DELTA_LINT_RECOMPILE_MODULES")
+    if env is not None:
+        return {p.strip() for p in env.split(",") if p.strip()}
+    return set(_DEFAULT_MODULES)
+
+
+def _pad_helpers() -> Set[str]:
+    env = os.environ.get("DELTA_LINT_RECOMPILE_PAD_HELPERS")
+    if env is not None:
+        return {p.strip() for p in env.split(",") if p.strip()}
+    return set(_DEFAULT_PAD_HELPERS)
+
+
+def _exempt_sites() -> Set[str]:
+    env = os.environ.get("DELTA_LINT_RECOMPILE_EXEMPT")
+    if env is not None:
+        return {p.strip() for p in env.split(",") if p.strip()}
+    return set(_EXEMPTIONS)
+
+
+def _tail(name: Optional[str]) -> str:
+    return name.rpartition(".")[2] if name else ""
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``pl.pallas_call(...)`` / ``shard_map(...)``
+    — also matches ``partial(jax.jit, ...)`` decorator forms."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _tail(call_name(node)) in _JIT_DECOS:
+        return True
+    if _tail(call_name(node)) == "partial":
+        return any(_tail(_dotted_of(a)) in _JIT_DECOS
+                   for a in node.args)
+    return False
+
+
+def _dotted_of(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted_of(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _jit_names(tree: ast.Module) -> Set[str]:
+    """Module-local names bound to jitted callables."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_call(deco) or _tail(_dotted_of(deco)) \
+                        in _JIT_DECOS:
+                    out.add(node.name)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _is_jit_call(node.value):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _mentions(expr: ast.AST, names: Set[str]) -> Optional[str]:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return sub.id
+    return None
+
+
+def _has_call(expr: ast.AST, tails: Set[str]) -> bool:
+    return any(isinstance(sub, ast.Call)
+               and _tail(call_name(sub)) in tails
+               for sub in ast.walk(expr))
+
+
+def _is_length_source(expr: ast.AST) -> bool:
+    """len(...) or .shape anywhere in the expression."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and _tail(call_name(sub)) == "len":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+    return False
+
+
+def _list_accumulators(fn: ast.AST) -> Set[str]:
+    """Locals assigned a list literal/ctor and later .append-ed to —
+    their length is data-dependent by construction."""
+    assigned: Set[str] = set()
+    appended: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            if isinstance(v, ast.List) or (
+                    isinstance(v, ast.Call)
+                    and _tail(call_name(v)) == "list"):
+                assigned.add(node.targets[0].id)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "append" \
+                and isinstance(node.func.value, ast.Name):
+            appended.add(node.func.value.id)
+    return assigned & appended
+
+
+def _own_statements(fn: ast.AST) -> Iterable[ast.stmt]:
+    """Source-order statements of fn's own body (nested defs are their
+    own analysis units and are skipped)."""
+    stack: List[ast.stmt] = list(reversed(getattr(fn, "body", [])))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        inner: List[ast.stmt] = []
+        for field in ("body", "orelse", "finalbody"):
+            inner.extend(getattr(node, field, []))
+        for handler in getattr(node, "handlers", []):
+            inner.extend(handler.body)
+        stack.extend(reversed(inner))
+
+
+def _qualnames(tree: ast.Module):
+    """(fn node, qualname) for every function def in the module."""
+    def visit(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, q
+                yield from visit(child, q)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield from visit(child, q)
+            else:
+                yield from visit(child, prefix)
+    yield from visit(tree, "")
+
+
+@register
+class RecompileRiskRule(Rule):
+    id = "recompile-risk"
+    help_anchor = "recompile-risk"
+    description = (
+        "jitted callsite in a covered kernel module whose operand "
+        "shape derives from a data-dependent length (len()/.shape/"
+        "list build) without flowing through a pad-to-bucket helper — "
+        "every distinct length compiles a fresh executable (the "
+        "static twin of DELTA_TPU_RECOMPILE_ALARM)")
+
+    def check_project(self, mods: List[ModuleInfo]) -> List[Finding]:
+        modules = _covered_modules()
+        pads = _pad_helpers()
+        exempt = _exempt_sites()
+        out: List[Finding] = []
+        for mod in mods:
+            if mod.rel not in modules or mod.tree is None:
+                continue
+            jits = _jit_names(mod.tree)
+            if not jits:
+                continue
+            for fn, qual in _qualnames(mod.tree):
+                site = f"{mod.rel}::{qual}"
+                if site in exempt:
+                    continue
+                out.extend(self._check_fn(mod.rel, fn, qual, jits,
+                                          pads))
+        return out
+
+    def _check_fn(self, rel: str, fn: ast.AST, qual: str,
+                  jits: Set[str], pads: Set[str]) -> List[Finding]:
+        lists = _list_accumulators(fn)
+        scalars: Set[str] = set()   # data-dependent lengths
+        arrays: Set[str] = set()    # shape tracks a tainted length
+        padded: Set[str] = set()    # flowed through a pad helper
+        seen: Set[int] = set()      # callsites already judged
+        out: List[Finding] = []
+
+        def dd(expr: ast.AST) -> bool:
+            """Data-dependent length expression (padded names are
+            bucket-quantized, so a bare padded Name is NOT dd)."""
+            return (_mentions(expr, scalars | lists) is not None
+                    or _is_length_source(expr))
+
+        def ctor_tainted(call: ast.AST) -> bool:
+            if not isinstance(call, ast.Call):
+                return False
+            tail = _tail(call_name(call))
+            if tail not in _ARRAY_CTORS or _has_call(call, pads):
+                return False
+            if tail in ("zeros", "ones", "empty", "full"):
+                shape = call.args[0] if call.args else None
+                for kw in call.keywords:
+                    if kw.arg == "shape":
+                        shape = kw.value
+                return shape is not None and dd(shape)
+            if tail == "arange":
+                return any(dd(a) for a in call.args)
+            if tail in ("asarray", "array"):
+                arg = call.args[0] if call.args else None
+                return arg is not None and _mentions(
+                    arg, lists | arrays) is not None
+            # concatenate/stack: output length sums the inputs
+            for a in list(call.args) + [kw.value for kw in
+                                        call.keywords]:
+                if _mentions(a, lists | arrays):
+                    return True
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Call) and sub is not call \
+                            and ctor_tainted(sub):
+                        return True
+            return False
+
+        for stmt in _own_statements(fn):
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name, value = stmt.targets[0].id, stmt.value
+                scalars.discard(name)
+                arrays.discard(name)
+                padded.discard(name)
+                if _has_call(value, pads):
+                    padded.add(name)
+                elif isinstance(value, ast.Call) \
+                        and _tail(call_name(value)) in _ARRAY_CTORS:
+                    if ctor_tainted(value):
+                        arrays.add(name)
+                elif _mentions(value, arrays):
+                    arrays.add(name)
+                elif _mentions(value, padded) \
+                        and not _is_length_source(value):
+                    # bucket-complement arithmetic (pad = m - n)
+                    padded.add(name)
+                elif _is_length_source(value) \
+                        or _mentions(value, scalars):
+                    scalars.add(name)
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Call)
+                        and _tail(call_name(node)) in jits) \
+                        or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    if _has_call(arg, pads):
+                        continue
+                    src = _mentions(arg, arrays)
+                    if src is None and ctor_tainted(arg):
+                        src = "<inline constructor>"
+                    if src is None:
+                        continue
+                    out.append(Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        f"operand {src!r} of jitted "
+                        f"{_tail(call_name(node))}() in {qual}() takes "
+                        f"its shape from a data-dependent length "
+                        f"without a pad helper — every distinct length "
+                        f"compiles a fresh executable; pad_bucket() "
+                        f"the length or add a typed exemption for "
+                        f"{rel}::{qual}"))
+                    break  # one finding per callsite is enough
+        return out

@@ -824,6 +824,10 @@ def test_every_registered_rule_has_fixture_coverage():
         "shared-state-race",                                 # races
         "transfer-budget", "transfer-unbudgeted",            # budget
         "unprofiled-dispatch",                               # device obs
+        "route-contract",                                    # routes
+        "recompile-risk",                                    # recompile
+        "env-knob-uncataloged", "env-knob-dead-entry",
+        "env-knob-capture-stamp",                            # env census
     }
     assert set(all_rules()) == expected
 
@@ -2013,6 +2017,605 @@ def launch(arr, flag):
     report = analyze_sources({"pkg/k.py": src},
                              rules=["unprofiled-dispatch"])
     assert not report.findings
+
+
+# -------------------------------------------------------- route-contract
+
+
+_GATE_SRC = """
+import os
+from delta_tpu.obs.device import record_gate_decision
+
+ROUTES = {{
+    "demo": RouteSpec(env="DELTA_TPU_DEMO",
+                      fallback_counter="demo.fallbacks",
+                      doc_anchor="demo-route"),{extra_route}
+}}
+
+def _decide(gate, chosen):
+    record_gate_decision(gate, chosen, {{}}, None, "x")
+    return chosen
+
+def demo_route(n):{env_read}
+    if n > 100:
+        return _decide("demo", "device")
+    return _decide("demo", "host")
+"""
+
+_OBS_SRC = "CAPTURE_ENV_KEYS = ({keys})\n"
+
+_WORKER_SRC = """
+from delta_tpu import obs
+
+_FB = obs.counter("demo.fallbacks")
+
+def run(x):
+    with obs.device_dispatch("demo.launch", gate="demo",
+                             budget={budget!r}):
+        pass
+{extra_dispatch}
+def fell_back(err):
+    {inc}
+    {observe}
+"""
+
+
+def _route_fixture(tmp_path, monkeypatch, *, env_read=True,
+                   capture_key=True, budget="demo-lane",
+                   extra_route="", extra_dispatch="", inc=True,
+                   observe=True, counter_cataloged=True,
+                   doc_heading="## Demo route", gate_src=None):
+    """Assemble the conformant three-module route fixture, optionally
+    mutated, and run the route-contract pass over it."""
+    manifest = tmp_path / "budget.json"
+    manifest.write_text(json.dumps({
+        "modules": [], "audited_transfer_sites": [],
+        "paths": {"demo-lane": {"site": "pkg/worker.py::run"}},
+    }))
+    catalog = tmp_path / "metrics.json"
+    catalog.write_text(json.dumps({
+        "counters": ({"demo.fallbacks": "route fell back"}
+                     if counter_cataloged else {}),
+        "histograms": {}, "gauges": {},
+    }))
+    doc = tmp_path / "architecture.md"
+    doc.write_text(f"# Design\n\n{doc_heading}\n\nprose\n")
+    monkeypatch.setenv("DELTA_LINT_GATE_MODULE", "pkg/gate.py")
+    monkeypatch.setenv("DELTA_LINT_OBS_MODULE", "pkg/obsmod.py")
+    monkeypatch.setenv("DELTA_LINT_ARCH_DOC", str(doc))
+    monkeypatch.setenv("DELTA_LINT_TRANSFER_BUDGET", str(manifest))
+    monkeypatch.setenv("DELTA_LINT_METRIC_CATALOG", str(catalog))
+    sources = {
+        "pkg/gate.py": gate_src if gate_src is not None
+        else _GATE_SRC.format(
+            extra_route=extra_route,
+            env_read=('\n    env = os.environ.get("DELTA_TPU_DEMO")'
+                      if env_read else "")),
+        "pkg/obsmod.py": _OBS_SRC.format(
+            keys='"DELTA_TPU_DEMO",' if capture_key else ""),
+        "pkg/worker.py": _WORKER_SRC.format(
+            budget=budget, extra_dispatch=extra_dispatch,
+            inc="_FB.inc()" if inc else "pass",
+            observe=('obs.gate_observation("demo", 1.0)'
+                     if observe else "pass")),
+    }
+    return analyze_sources(sources, rules=["route-contract"])
+
+
+def test_route_contract_conformant_route_is_clean(tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch)
+    assert not report.findings, [f.message for f in report.findings]
+
+
+def test_route_contract_missing_env_read(tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch, env_read=False)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "is never read in demo_route()" in found[0].message
+
+
+def test_route_contract_missing_capture_stamp(tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch, capture_key=False)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "not in CAPTURE_ENV_KEYS" in found[0].message
+
+
+def test_route_contract_unknown_budget_name(tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch, budget="no-such-lane")
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "has no transfer_budget.json path entry" in found[0].message
+    assert found[0].path == "pkg/worker.py"
+
+
+def test_route_contract_unaudited_dispatch_site(tmp_path, monkeypatch):
+    extra = """
+def rogue(x):
+    with obs.device_dispatch("demo.rogue", gate="demo"):
+        pass
+"""
+    report = _route_fixture(tmp_path, monkeypatch, extra_dispatch=extra)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "not an audited transfer site" in found[0].message
+    assert "rogue" in found[0].message
+
+
+def test_route_contract_missing_gate_observation(tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch, observe=False)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "no gate_observation" in found[0].message
+
+
+def test_route_contract_fallback_counter_never_incremented(
+        tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch, inc=False)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "never created-and-incremented" in found[0].message
+
+
+def test_route_contract_fallback_counter_uncataloged(
+        tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch,
+                            counter_cataloged=False)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "not cataloged in metric_names.json" in found[0].message
+
+
+def test_route_contract_doc_anchor_missing(tmp_path, monkeypatch):
+    report = _route_fixture(tmp_path, monkeypatch,
+                            doc_heading="## Something else")
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "heading matches anchor" in found[0].message
+
+
+def test_route_contract_stale_registry_entry(tmp_path, monkeypatch):
+    extra = """
+    "ghost": RouteSpec(env="DELTA_TPU_GHOST",
+                       fallback_counter="",
+                       doc_anchor=""),"""
+    report = _route_fixture(tmp_path, monkeypatch, extra_route=extra)
+    found = _rules_fired(report, "route-contract")
+    stale = [f for f in found if "stale registry entry" in f.message]
+    assert len(stale) == 1 and "'ghost'" in stale[0].message
+    # the ghost route has no dispatch funnel / observation either
+    assert all("'demo'" not in f.message for f in found)
+
+
+def test_route_contract_unregistered_route(tmp_path, monkeypatch):
+    gate_src = """
+import os
+from delta_tpu.obs.device import record_gate_decision
+
+ROUTES = {}
+
+def _decide(gate, chosen):
+    record_gate_decision(gate, chosen, {}, None, "x")
+    return chosen
+
+def demo_route(n):
+    return _decide("demo", "host")
+"""
+    report = _route_fixture(tmp_path, monkeypatch, gate_src=gate_src)
+    found = _rules_fired(report, "route-contract")
+    assert len(found) == 1
+    assert "ROUTES has no 'demo' entry" in found[0].message
+
+
+def test_route_contract_route_without_gate_record(tmp_path, monkeypatch):
+    gate_src = """
+import os
+
+ROUTES = {
+    "demo": RouteSpec(env="DELTA_TPU_DEMO",
+                      fallback_counter="demo.fallbacks",
+                      doc_anchor="demo-route"),
+}
+
+def demo_route(n):
+    return "host"
+"""
+    report = _route_fixture(tmp_path, monkeypatch, gate_src=gate_src)
+    found = _rules_fired(report, "route-contract")
+    msgs = "\n".join(f.message for f in found)
+    assert "never reaches record_gate_decision" in msgs
+    assert "stale registry entry" in msgs
+
+
+def test_route_contract_silent_without_gate_module(monkeypatch):
+    monkeypatch.setenv("DELTA_LINT_GATE_MODULE", "pkg/gate.py")
+    report = analyze_sources({"pkg/other.py": "x = 1\n"},
+                             rules=["route-contract"])
+    assert not report.findings
+
+
+def test_route_registry_covers_all_four_routes():
+    """The live registry names the four shipped routes and every env
+    override is mirrored into the capture-conditions stamp."""
+    from delta_tpu.obs.device import CAPTURE_ENV_KEYS
+    from delta_tpu.parallel.gate import ROUTES
+
+    assert set(ROUTES) == {"replay", "parse", "decode", "skip"}
+    for spec in ROUTES.values():
+        assert spec.env in CAPTURE_ENV_KEYS
+
+
+# -------------------------------------------------------- recompile-risk
+
+
+_RECOMPILE_ENV = "DELTA_LINT_RECOMPILE_MODULES"
+
+
+def test_recompile_risk_unpadded_length_flagged(monkeypatch):
+    src = """
+import numpy as np
+import jax
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(vals):
+    n = len(vals)
+    arr = np.zeros(n, dtype=np.int32)
+    return kern(arr)
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    found = _rules_fired(report, "recompile-risk")
+    assert len(found) == 1
+    assert "'arr'" in found[0].message and "kern" in found[0].message
+
+
+def test_recompile_risk_padded_length_is_clean(monkeypatch):
+    src = """
+import numpy as np
+import jax
+from delta_tpu.ops.replay import pad_bucket
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(vals):
+    n = len(vals)
+    m = pad_bucket(n)
+    arr = np.zeros(m, dtype=np.int32)
+    return kern(arr)
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    assert not report.findings
+
+
+def test_recompile_risk_bucket_complement_is_clean(monkeypatch):
+    # pad = m - n is the canonical top-up idiom: the concatenated
+    # length is bucket-quantized by construction
+    src = """
+import numpy as np
+import jax
+from delta_tpu.ops.replay import pad_bucket
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(vals, x):
+    n = len(vals)
+    m = pad_bucket(n)
+    pad = m - n
+    arr = np.concatenate([x, np.zeros(pad, dtype=x.dtype)])
+    return kern(arr)
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    assert not report.findings
+
+
+def test_recompile_risk_inline_ctor_flagged_once(monkeypatch):
+    src = """
+import numpy as np
+import jax
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(vals):
+    n = len(vals)
+    return kern(np.arange(n))
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    found = _rules_fired(report, "recompile-risk")
+    assert len(found) == 1, "one finding per callsite, no duplicates"
+    assert "<inline constructor>" in found[0].message
+
+
+def test_recompile_risk_scalar_asarray_is_clean(monkeypatch):
+    # np.asarray(n) is a 0-d operand: data-dependent *value*, constant
+    # shape — no recompile risk
+    src = """
+import numpy as np
+import jax
+
+@jax.jit
+def kern(x, n):
+    return x
+
+def launch(vals, x):
+    n = len(vals)
+    return kern(x, np.asarray(n))
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    assert not report.findings
+
+
+def test_recompile_risk_list_accumulator_flagged(monkeypatch):
+    src = """
+import numpy as np
+import jax
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(rows):
+    out = []
+    for r in rows:
+        out.append(r.key)
+    arr = np.asarray(out)
+    return kern(arr)
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    found = _rules_fired(report, "recompile-risk")
+    assert len(found) == 1 and "'arr'" in found[0].message
+
+
+def test_recompile_risk_typed_exemption_honored(monkeypatch):
+    src = """
+import numpy as np
+import jax
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(vals):
+    n = len(vals)
+    return kern(np.arange(n))
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/k.py")
+    monkeypatch.setenv("DELTA_LINT_RECOMPILE_EXEMPT", "pkg/k.py::launch")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    assert not report.findings
+
+
+def test_recompile_risk_uncovered_module_is_silent(monkeypatch):
+    src = """
+import numpy as np
+import jax
+
+@jax.jit
+def kern(x):
+    return x
+
+def launch(vals):
+    n = len(vals)
+    return kern(np.arange(n))
+"""
+    monkeypatch.setenv(_RECOMPILE_ENV, "pkg/other.py")
+    report = analyze_sources({"pkg/k.py": src}, rules=["recompile-risk"])
+    assert not report.findings
+
+
+def test_recompile_risk_exemption_registry_names_live_sites():
+    """Every built-in exemption must point at a real function — a
+    refactor that moves the site must move the exemption with it."""
+    import delta_tpu
+    from delta_tpu.tools.analyzer.passes.recompile import _EXEMPTIONS
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(delta_tpu.__file__)))
+    for site, (kind, reason) in _EXEMPTIONS.items():
+        rel, _, qual = site.partition("::")
+        assert kind and reason
+        path = os.path.join(root, rel)
+        assert os.path.exists(path), f"exempt module {rel} is gone"
+        leaf = qual.rpartition(".")[2]
+        with open(path, encoding="utf-8") as f:
+            assert f"def {leaf}(" in f.read(), \
+                f"exempt function {site} is gone"
+
+
+# ------------------------------------------------------- env-knob census
+
+
+def _env_catalog(tmp_path, monkeypatch, knobs):
+    path = tmp_path / "knobs.json"
+    path.write_text(json.dumps({"knobs": knobs}, indent=1))
+    monkeypatch.setenv("DELTA_LINT_ENV_CATALOG", str(path))
+    return path
+
+
+_ENV_RULES = ["env-knob-uncataloged", "env-knob-dead-entry",
+              "env-knob-capture-stamp"]
+
+
+def test_env_knob_uncataloged_read_flagged(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {})
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    report = analyze_sources({"pkg/a.py": src}, rules=_ENV_RULES)
+    found = _rules_fired(report, "env-knob-uncataloged")
+    assert len(found) == 1
+    assert "'DELTA_TPU_FOO'" in found[0].message
+    assert found[0].line == 2
+
+
+def test_env_knob_cataloged_read_is_clean(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_FOO": {"default": "", "modules": ["pkg/a.py"],
+                          "doc": "x", "help": "h"}})
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    report = analyze_sources({"pkg/a.py": src}, rules=_ENV_RULES)
+    assert not report.findings
+
+
+def test_env_knob_module_drift_flagged(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_FOO": {"default": "", "modules": ["pkg/other.py"],
+                          "doc": "x", "help": "h"}})
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    other = 'import os\nW = os.environ.get("DELTA_TPU_FOO")\n'
+    report = analyze_sources({"pkg/a.py": src, "pkg/other.py": other},
+                             rules=["env-knob-uncataloged"])
+    found = _rules_fired(report, "env-knob-uncataloged")
+    assert len(found) == 1 and found[0].path == "pkg/a.py"
+    assert "drifted catalog" in found[0].message
+
+
+def test_env_knob_dead_entry_flagged(tmp_path, monkeypatch):
+    path = _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_FOO": {"default": "", "modules": ["pkg/a.py"],
+                          "doc": "x", "help": "h"},
+        "DELTA_TPU_GHOST": {"default": "", "modules": [],
+                            "doc": "x", "help": "h"}})
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    report = analyze_sources({"pkg/a.py": src}, rules=_ENV_RULES)
+    found = _rules_fired(report, "env-knob-dead-entry")
+    assert len(found) == 1
+    assert "'DELTA_TPU_GHOST'" in found[0].message
+    assert found[0].path == os.path.basename(str(path))
+
+
+def test_env_knob_dead_entry_modules_list_drift(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_FOO": {"default": "",
+                          "modules": ["pkg/a.py", "pkg/other.py"],
+                          "doc": "x", "help": "h"}})
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    report = analyze_sources({"pkg/a.py": src, "pkg/other.py": "x = 1\n"},
+                             rules=["env-knob-dead-entry"])
+    found = _rules_fired(report, "env-knob-dead-entry")
+    assert len(found) == 1
+    assert "'modules' list drifted" in found[0].message
+
+
+def test_env_knob_const_and_helper_reads_resolved(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_BAR": {"default": "", "modules": ["pkg/a.py"],
+                          "doc": "x", "help": "h"},
+        "DELTA_TPU_BAZ": {"default": "1", "modules": ["pkg/a.py"],
+                          "doc": "x", "help": "h"}})
+    src = """
+import os
+
+_ENV = "DELTA_TPU_BAR"
+
+def _env_num(name, default):
+    return float(os.environ.get(name, default))
+
+V = os.environ.get(_ENV)
+W = _env_num("DELTA_TPU_BAZ", 1)
+"""
+    report = analyze_sources({"pkg/a.py": src}, rules=_ENV_RULES)
+    assert not report.findings, [f.message for f in report.findings]
+
+
+def test_env_knob_capture_stamp_missing_flagged(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_FOO": {"default": "", "modules": ["pkg/a.py"],
+                          "doc": "x", "help": "h", "capture": True}})
+    monkeypatch.setenv("DELTA_LINT_OBS_MODULE", "pkg/obsmod.py")
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    obsmod = 'CAPTURE_ENV_KEYS = ("DELTA_TPU_OTHER",)\n'
+    report = analyze_sources({"pkg/a.py": src, "pkg/obsmod.py": obsmod},
+                             rules=["env-knob-capture-stamp"])
+    found = _rules_fired(report, "env-knob-capture-stamp")
+    assert len(found) == 1
+    assert "'DELTA_TPU_FOO'" in found[0].message
+    assert found[0].path == "pkg/obsmod.py"
+
+
+def test_env_knob_capture_stamp_present_is_clean(tmp_path, monkeypatch):
+    _env_catalog(tmp_path, monkeypatch, {
+        "DELTA_TPU_FOO": {"default": "", "modules": ["pkg/a.py"],
+                          "doc": "x", "help": "h", "capture": True}})
+    monkeypatch.setenv("DELTA_LINT_OBS_MODULE", "pkg/obsmod.py")
+    src = 'import os\nV = os.environ.get("DELTA_TPU_FOO")\n'
+    obsmod = 'CAPTURE_ENV_KEYS = ("DELTA_TPU_FOO",)\n'
+    report = analyze_sources({"pkg/a.py": src, "pkg/obsmod.py": obsmod},
+                             rules=["env-knob-capture-stamp"])
+    assert not report.findings
+
+
+def test_knob_docs_table_is_current():
+    """docs/observability.md's generated env-knob table must match
+    resources/env_knobs.json — regenerate with
+    `python -m delta_tpu.tools.knob_docs` after a catalog edit."""
+    from delta_tpu.tools.knob_docs import main as knob_main
+
+    assert knob_main(["--check"]) == 0
+
+
+def test_capture_conditions_records_route_knobs(monkeypatch):
+    """The runtime half of the capture-stamp contract: a knob in
+    CAPTURE_ENV_KEYS set in the environment appears in
+    capture_conditions()['env']."""
+    from delta_tpu.obs.device import capture_conditions
+
+    monkeypatch.setenv("DELTA_TPU_DEVICE_DECODE", "force")
+    monkeypatch.setenv("DELTA_TPU_DEVICE_SQL", "1")
+    env = capture_conditions()["env"]
+    assert env["DELTA_TPU_DEVICE_DECODE"] == "force"
+    assert env["DELTA_TPU_DEVICE_SQL"] == "1"
+
+
+# ------------------------------------- scan cache: catalog soundness
+
+
+def test_scan_cache_invalidated_by_catalog_edit(tmp_path, monkeypatch):
+    """Regression for the stale-cache soundness hole: the pass
+    catalogs are scan inputs — editing one must invalidate the cache
+    even though no scanned .py file changed."""
+    from delta_tpu.tools.analyzer.cache import analyze_paths_cached
+
+    knobs = tmp_path / "knobs.json"
+    knobs.write_text(json.dumps({"knobs": {
+        "DELTA_TPU_FOO": {"default": "", "modules": [],
+                          "doc": "x", "help": "h"}}}))
+    monkeypatch.setenv("DELTA_LINT_ENV_CATALOG", str(knobs))
+    target = tmp_path / "pkg"
+    target.mkdir()
+    (target / "a.py").write_text(
+        'import os\nV = os.environ.get("DELTA_TPU_FOO")\n')
+    cache = tmp_path / "cache.json"
+    rules = ["env-knob-uncataloged", "env-knob-dead-entry"]
+    r1, s1 = analyze_paths_cached([str(target)], rules=rules,
+                                  cache_path=str(cache))
+    assert s1["cache"] == "cold" and not r1.findings
+    _, s2 = analyze_paths_cached([str(target)], rules=rules,
+                                 cache_path=str(cache))
+    assert s2["cache"] == "hit"
+
+    # catalog edit, no .py change: must NOT serve the cached report
+    knobs.write_text(json.dumps({"knobs": {
+        "DELTA_TPU_FOO": {"default": "", "modules": [],
+                          "doc": "x", "help": "h"},
+        "DELTA_TPU_GHOST": {"default": "", "modules": [],
+                            "doc": "x", "help": "h"}}}))
+    r3, s3 = analyze_paths_cached([str(target)], rules=rules,
+                                  cache_path=str(cache))
+    assert s3["cache"] != "hit", \
+        "catalog edits must invalidate the scan cache"
+    assert _rules_fired(r3, "env-knob-dead-entry")
 
 
 # ------------------------------------------------------ whole-repo gate
