@@ -316,7 +316,16 @@ def read_table(
         snap = table.snapshot_as_of_timestamp(timestamp_ms)
     else:
         snap = table.latest_snapshot()
-    return snap.scan(filter=filter, columns=columns).to_arrow()
+    try:
+        return snap.scan(filter=filter, columns=columns).to_arrow()
+    finally:
+        # One-shot read: the Table dies with this call, so any
+        # device-resident replay/stats lanes the scan established can
+        # never be reused — free them deterministically instead of
+        # leaving the HBM ledger to flag the GC'd owner as a leak.
+        from delta_tpu.parallel.resident import release_snapshot_resident
+
+        release_snapshot_resident(snap)
 
 
 def _now_ms() -> int:

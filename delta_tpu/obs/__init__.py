@@ -39,6 +39,11 @@ from delta_tpu.obs.device import (
     set_device_obs_mode,
     summarize_gates,
 )
+# Importing the submodule here (not just names) activates the
+# ledger-derived gauges process-wide: hbm.py binds their set_fn
+# callbacks at import time. Instrumented sites use the submodule
+# directly (`from delta_tpu.obs import hbm`; `hbm.register(...)`).
+from delta_tpu.obs import hbm
 from delta_tpu.obs.export import (
     JsonlExporter,
     chrome_trace,
@@ -54,6 +59,12 @@ from delta_tpu.obs.expose import (
     render_prometheus,
 )
 from delta_tpu.obs.flight import FlightRecorder
+from delta_tpu.obs.hbm import (
+    hbm_obs_enabled,
+    hbm_obs_mode,
+    reset_hbm_obs,
+    set_hbm_obs_mode,
+)
 from delta_tpu.obs.registry import (
     EXPORT_BUCKETS,
     Counter,
@@ -146,12 +157,17 @@ __all__ = [
     "get_dispatch_records",
     "get_finished_spans",
     "get_gate_records",
+    "hbm",
+    "hbm_obs_enabled",
+    "hbm_obs_mode",
     "histogram",
     "load_spans",
     "metric_catalog",
     "metrics_snapshot",
     "record_gate_decision",
     "reset_device_obs",
+    "reset_hbm_obs",
+    "set_hbm_obs_mode",
     "parse_prometheus",
     "process_label",
     "prom_name",

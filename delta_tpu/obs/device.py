@@ -577,6 +577,7 @@ CAPTURE_ENV_KEYS = (
     "DELTA_TPU_DEVICE_SQL",
     "DELTA_TPU_TRACE",
     "DELTA_TPU_DEVICE_OBS",
+    "DELTA_TPU_HBM_OBS",
     "JAX_PLATFORMS",
 )
 

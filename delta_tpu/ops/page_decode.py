@@ -40,6 +40,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from delta_tpu import obs
+from delta_tpu.obs import hbm
 
 # run_plan columns
 R_H, R_N, R_BIT, R_W, R_RLE, R_VAL = range(6)
@@ -94,6 +95,9 @@ class PartKeys:
     n_bad: int             # struct-present rows with a null path
     uniq: List[bytes]      # part-local dictionary, code order, raw bytes
     n_rows: int
+    # resident-ledger handle for the device code lane; released by
+    # `release_part_keys` when the handoff consumes or abandons it
+    hbm: object = None
 
 
 def _decode_stage_hybrid(lane, runs, h_pad: int, use_pallas: bool):
@@ -239,10 +243,24 @@ def decode_part(plan: PartPlan, device=None
             keys = PartKeys(codes=outs[3], n_add=int(counts[0]),
                             n_rem=int(counts[1]), n_bad=int(counts[2]),
                             uniq=[], n_rows=plan.n_rows)
+            keys.hbm = hbm.register(
+                keys, kind=hbm.KIND_CKPT_HANDOFF, arrays=(outs[3],),
+                rebuild_cost_class="cheap",  # re-decode of one part
+            )
     return lo, hi, defined, keys
 
 
 # ---------------------------------------------------------------- handoff --
+
+
+def release_part_keys(parts: Sequence[PartKeys]) -> None:
+    """Deregister the device code lanes of `parts` — they were either
+    consumed by a launched handoff or abandoned (handoff disqualified,
+    route not chosen); either way the artifact's residency ends here."""
+    for p in parts:
+        if p.hbm is not None:
+            p.hbm.release()
+            p.hbm = None
 
 
 def _decoded_paths(raw: Sequence[bytes]) -> Optional[List[str]]:
@@ -303,60 +321,65 @@ def launch_checkpoint_handoff(parts: Sequence[PartKeys], n_shards: int = 1,
     from delta_tpu.parallel import gate
     from delta_tpu.replay.state import BLOCKWISE_MIN_ROWS
 
-    live = [p for p in parts if p.n_add + p.n_rem > 0]
-    n = sum(p.n_add + p.n_rem for p in live)
-    if not live or n == 0:
-        return None
-    if any(p.n_bad > 0 or p.codes is None for p in live):
-        return None
-    if n >= BLOCKWISE_MIN_ROWS:
-        return None
-    if gate.replay_route(n, n_shards=n_shards, forced=forced) != "single":
-        return None
-
-    # global path-code unification over RAW dictionary bytes, with the
-    # percent-decoded collision check (two raw spellings of one decoded
-    # path must share a replay code — rare, so just disqualify)
-    global_codes: dict = {}
-    remaps: List[np.ndarray] = []
-    offs: List[int] = []
-    off = 0
-    for p in live:
-        decoded = _decoded_paths(p.uniq)
-        if decoded is None:
+    # the launch consumes (or abandons) every part's code lane
+    # on every return path below — residency ends with this call
+    try:
+        live = [p for p in parts if p.n_add + p.n_rem > 0]
+        n = sum(p.n_add + p.n_rem for p in live)
+        if not live or n == 0:
             return None
-        remap = np.empty(max(len(decoded), 1), np.uint32)
-        for j, s in enumerate(decoded):
-            remap[j] = global_codes.setdefault(s, len(global_codes))
-        offs.append(off)
-        remaps.append(remap)
-        off += remap.shape[0]
-    if len(global_codes) >= 0xFFFFFFFF:
-        return None
+        if any(p.n_bad > 0 or p.codes is None for p in live):
+            return None
+        if n >= BLOCKWISE_MIN_ROWS:
+            return None
+        if gate.replay_route(n, n_shards=n_shards, forced=forced) != "single":
+            return None
 
-    m = pad_bucket(n)
-    r_pad = pad_bucket(off, min_bucket=128)
-    remap_lane = np.zeros(r_pad, np.uint32)
-    remap_lane[:off] = np.concatenate(remaps)
-    part_meta = np.zeros((len(live), 4), np.int32)
-    is_add = np.zeros(m, np.bool_)
-    row = 0
-    for i, p in enumerate(live):
-        part_meta[i] = (offs[i], remaps[i].shape[0], row,
-                        p.n_add + p.n_rem)
-        is_add[row:row + p.n_add] = True
-        row += p.n_add + p.n_rem
-    add_words = _pack_bits(is_add)
+        # global path-code unification over RAW dictionary bytes, with the
+        # percent-decoded collision check (two raw spellings of one decoded
+        # path must share a replay code — rare, so just disqualify)
+        global_codes: dict = {}
+        remaps: List[np.ndarray] = []
+        offs: List[int] = []
+        off = 0
+        for p in live:
+            decoded = _decoded_paths(p.uniq)
+            if decoded is None:
+                return None
+            remap = np.empty(max(len(decoded), 1), np.uint32)
+            for j, s in enumerate(decoded):
+                remap[j] = global_codes.setdefault(s, len(global_codes))
+            offs.append(off)
+            remaps.append(remap)
+            off += remap.shape[0]
+        if len(global_codes) >= 0xFFFFFFFF:
+            return None
 
-    k_pads = tuple(int(p.codes.shape[0]) for p in live)
-    fn = _handoff_fn(m, k_pads)
-    with obs.device_dispatch("page_decode.handoff", key=(m, k_pads),
-                             budget="ckpt-decode-handoff", units=r_pad,
-                             gate="replay", route="single") as dd, _x32():
-        dd.h2d("remap_lane", remap_lane)
-        dd.h2d("part_meta", part_meta, units=part_meta.size)
-        winner = fn(jax.device_put(remap_lane, device),
-                    jax.device_put(part_meta, device),
-                    np.int32(n), *[p.codes for p in live])
-    _OBS_HANDOFFS.inc()
-    return ReplayPending(winner, add_words, n, None)
+        m = pad_bucket(n)
+        r_pad = pad_bucket(off, min_bucket=128)
+        remap_lane = np.zeros(r_pad, np.uint32)
+        remap_lane[:off] = np.concatenate(remaps)
+        part_meta = np.zeros((len(live), 4), np.int32)
+        is_add = np.zeros(m, np.bool_)
+        row = 0
+        for i, p in enumerate(live):
+            part_meta[i] = (offs[i], remaps[i].shape[0], row,
+                            p.n_add + p.n_rem)
+            is_add[row:row + p.n_add] = True
+            row += p.n_add + p.n_rem
+        add_words = _pack_bits(is_add)
+
+        k_pads = tuple(int(p.codes.shape[0]) for p in live)
+        fn = _handoff_fn(m, k_pads)
+        with obs.device_dispatch("page_decode.handoff", key=(m, k_pads),
+                                 budget="ckpt-decode-handoff", units=r_pad,
+                                 gate="replay", route="single") as dd, _x32():
+            dd.h2d("remap_lane", remap_lane)
+            dd.h2d("part_meta", part_meta, units=part_meta.size)
+            winner = fn(jax.device_put(remap_lane, device),
+                        jax.device_put(part_meta, device),
+                        np.int32(n), *[p.codes for p in live])
+        _OBS_HANDOFFS.inc()
+        return ReplayPending(winner, add_words, n, None)
+    finally:
+        release_part_keys(parts)

@@ -35,13 +35,14 @@ from typing import Optional
 import numpy as np
 
 from delta_tpu import obs
+from delta_tpu.obs import hbm
 
 _H2D_BYTES = obs.counter("replay.h2d_bytes")
 _APPENDS = obs.counter("replay.resident_appends")
 _FALLBACKS = obs.counter("replay.resident_fallbacks")
-# device bytes currently pinned by resident key lanes, across all live
-# ResidentShardState instances (HBM is the scarce serving resource)
-_HBM_BYTES = obs.gauge("replay.resident_hbm_bytes")
+# device bytes pinned by resident key lanes are accounted in the
+# process-wide resident ledger (obs/hbm.py), which also derives the
+# `replay.resident_hbm_bytes` gauge this module used to maintain
 
 
 def enabled() -> bool:
@@ -93,8 +94,11 @@ class ResidentShardState:
         self.m = payload.m
         self.n_shards = int(payload.mesh.devices.size)
         self.key_sh = payload.key_sh
-        self._hbm_bytes = int(getattr(payload.key_sh, "nbytes", 0) or 0)
-        _HBM_BYTES.inc(self._hbm_bytes)
+        self._hbm = hbm.register(
+            self, kind=hbm.KIND_REPLAY_KEYS,
+            arrays=(payload.key_sh,),
+            rebuild_cost_class="expensive",  # full sharded replay
+        )
         self.n_real = np.asarray(payload.n_real, np.int64).copy()
         self.add = np.unpackbits(
             payload.add_words.view(np.uint8).reshape(self.n_shards, -1),
@@ -239,6 +243,9 @@ class ResidentShardState:
                     jax.device_put(val2d, spec),
                     jax.device_put(n_real_op, spec))
             self.key_sh = new_key
+            # the donated append produced a NEW device array for the
+            # same logical artifact: re-point the ledger's audit refs
+            self._hbm.grow(arrays=(new_key,))
 
             # host bookkeeping for the appended slots (scatter maps each
             # slot back to its original arrow row, so the returned masks
@@ -289,8 +296,7 @@ class ResidentShardState:
         with self._lock:
             if self.key_sh is not None:
                 self.key_sh = None
-                _HBM_BYTES.dec(self._hbm_bytes)
-                self._hbm_bytes = 0
+                self._hbm.release()
 
 
 def establish_resident(payload, file_actions,
@@ -310,6 +316,19 @@ def establish_resident(payload, file_actions,
     except Exception:
         _FALLBACKS.inc()
         return None
+
+
+def touch_snapshot_resident(snapshot) -> None:
+    """Record access recency on a snapshot's resident artifacts (serve
+    cache hits/refreshes route here). Duck-typed like
+    `release_snapshot_resident`; missing pieces are no-ops."""
+    state = getattr(snapshot, "_state", None) or snapshot
+    resident = getattr(state, "resident", None)
+    if resident is not None:
+        resident._hbm.touch()
+    stats_index = getattr(state, "stats_index", None)
+    if stats_index is not None:
+        stats_index._hbm.touch()
 
 
 def release_snapshot_resident(snapshot) -> None:

@@ -952,8 +952,22 @@ def _columnarize_log_segment(
         return (block is None
                 or block.column("dv_id").null_count == block.num_rows)
 
+    def _abandon_handoff(part_keys=None) -> None:
+        # a dead handoff abandons every accumulated device code lane;
+        # deregister them so the resident ledger never counts lanes no
+        # launch will ever consume
+        from delta_tpu.ops.page_decode import release_part_keys
+
+        dead = list(handoff["parts"])
+        if part_keys is not None:
+            dead.append(part_keys)
+        handoff["parts"] = []
+        release_part_keys(dead)
+
     def _track_handoff(part_keys, add_block, rem_block) -> None:
         if not handoff["ok"]:
+            if part_keys is not None:
+                _abandon_handoff(part_keys)
             return
         n_add = add_block.num_rows if add_block is not None else 0
         n_rem = rem_block.num_rows if rem_block is not None else 0
@@ -961,6 +975,8 @@ def _columnarize_log_segment(
             # keyless contributors break row alignment unless they
             # contribute no file-action rows at all
             handoff["ok"] = not (n_add or n_rem)
+            if not handoff["ok"]:
+                _abandon_handoff()
             return
         # the device key lane must agree row-for-row with the Arrow
         # blocks: same present counts, no null paths inside present
@@ -970,6 +986,7 @@ def _columnarize_log_segment(
                 or not _dv_all_null(add_block)
                 or not _dv_all_null(rem_block)):
             handoff["ok"] = False
+            _abandon_handoff(part_keys)
         else:
             handoff["parts"].append(part_keys)
 
@@ -1125,9 +1142,16 @@ def _columnarize_log_segment(
     native_stats_thunk = None
 
     if segment.checkpoints:
-        with obs.span("log.read_checkpoint", version=cp_version,
-                      parts=len(segment.checkpoints)):
-            _consume_checkpoint_parts()
+        try:
+            with obs.span("log.read_checkpoint", version=cp_version,
+                          parts=len(segment.checkpoints)):
+                _consume_checkpoint_parts()
+        except BaseException:
+            # a torn/corrupt checkpoint aborts the load mid-accumulation
+            # (the caller falls back to an older segment) — the decoded
+            # code lanes must leave the resident ledger with it
+            _abandon_handoff()
+            raise
         if handoff["ok"] and handoff["parts"]:
             # checkpoint-only load with every part keyed on device:
             # launch the replay straight from the device-resident code

@@ -72,6 +72,11 @@ class SnapshotState:
     # releases it through `release_snapshot_resident`.
     stats_index: Optional[object] = field(default=None, repr=False,
                                           compare=False)
+    # Table root this state was reconstructed from — threaded into the
+    # HBM resident ledger so lazily built device artifacts (stats-index
+    # lanes, replay key lanes grown on advance) attribute to the right
+    # table even when built outside a `hbm.table_scope` block.
+    table_path: Optional[str] = None
 
     _add_table_cache: Optional[pa.Table] = None
     _tombstone_table_cache: Optional[pa.Table] = None
@@ -524,6 +529,7 @@ def advance_state(
         commit_infos=commit_infos,
         timestamp_ms=new_segment.last_commit_timestamp,
         stats_thunk=stats_thunk,
+        table_path=prev.table_path,
     )
     if resident is not None:
         # ownership moves: the append donated (mutated) the device
@@ -560,6 +566,16 @@ def _chained_prev_stats(prev: SnapshotState, delta_fa: Optional[pa.Table]):
         return pa.chunked_array(chunks, pa.string())
 
     return thunk
+
+
+def _table_root(log_path: Optional[str]) -> Optional[str]:
+    """Table root for a ``.../_delta_log`` path (ledger attribution)."""
+    if not log_path:
+        return None
+    trimmed = log_path.rstrip("/")
+    if trimmed.endswith("_delta_log"):
+        trimmed = trimmed[: -len("_delta_log")].rstrip("/")
+    return trimmed or log_path
 
 
 def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotState:
@@ -619,6 +635,7 @@ def reconstruct_state(engine, segment, check_protocol: bool = True) -> SnapshotS
         commit_infos=columnar.commit_infos,
         timestamp_ms=segment.last_commit_timestamp,
         stats_thunk=columnar.stats_thunk,
+        table_path=_table_root(segment.log_path),
     )
     # ownership of the deferred decode moves to the snapshot state
     columnar.stats_thunk = None

@@ -27,6 +27,7 @@ from typing import Optional, Tuple
 
 from delta_tpu import obs
 from delta_tpu.errors import DeadlineExceededError
+from delta_tpu.parallel.resident import touch_snapshot_resident
 from delta_tpu.resilience import is_transient
 from delta_tpu.serve.config import ServeConfig
 from delta_tpu.table import Table
@@ -98,6 +99,9 @@ class SnapshotCache:
 
             for old in evicted:
                 with old.lock:
+                    # the release deregisters every ledger-accounted
+                    # artifact the snapshot owned (replay key lanes,
+                    # stats-index lanes) — see obs/hbm.py
                     release_snapshot_resident(old.snapshot)
         return fresh
 
@@ -122,6 +126,7 @@ class SnapshotCache:
                     now - e.fresh_at < window:
                 _CACHE_HITS.inc()
                 sp.set_attr("outcome", "fresh_hit")
+                touch_snapshot_resident(e.snapshot)
                 return e.snapshot, {}
             try:
                 snap = e.table.update()
@@ -146,6 +151,7 @@ class SnapshotCache:
             sp.set_attr("outcome", "refresh")
             e.snapshot = snap
             e.fresh_at = now
+            touch_snapshot_resident(snap)
             return snap, {}
 
     @staticmethod

@@ -393,6 +393,9 @@ class DeltaServeServer:
             "max_connections": self.config.max_connections,
             "breakers": breaker_states(),
             "tables": self.cache.health(),
+            # device-memory budget view: operators watch resident bytes
+            # (and any nonzero leak count) next to per-table freshness
+            "hbm": obs.hbm.health_summary(),
         }
         if self.slo is not None:
             verdict = self.last_slo_verdict

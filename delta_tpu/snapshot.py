@@ -14,6 +14,7 @@ from typing import Dict, Optional
 
 from delta_tpu import obs
 from delta_tpu.log.segment import LogSegment
+from delta_tpu.obs import hbm
 from delta_tpu.models.actions import DomainMetadata, Metadata, Protocol, SetTransaction
 from delta_tpu.replay.state import (
     SmallState,
@@ -62,7 +63,10 @@ class Snapshot:
         return self._state
 
     def _load_state(self) -> SnapshotState:
-        state = self._replay_degrading(reconstruct_state)
+        # ambient table attribution for any device artifact the replay
+        # establishes (resident key lanes, checkpoint handoff lanes)
+        with hbm.table_scope(self._table.path):
+            state = self._replay_degrading(reconstruct_state)
         self._validate_crc(state)
         return state
 
@@ -351,7 +355,8 @@ class Snapshot:
             # a protocol change can alter how existing actions must be
             # read — never replay across it incrementally
             return None
-        new_state = advance_state(eng, self._state, delta, new_segment)
+        with hbm.table_scope(self._table.path):
+            new_state = advance_state(eng, self._state, delta, new_segment)
         snap = Snapshot(self._table, new_segment, self._engine)
         snap._state = new_state
         return snap
@@ -403,8 +408,9 @@ class Snapshot:
             deltas=list(self._segment.deltas) + files,
             last_commit_timestamp=last_ts,
         )
-        new_state = advance_state(self._engine, self._state, delta,
-                                  new_segment)
+        with hbm.table_scope(self._table.path):
+            new_state = advance_state(self._engine, self._state, delta,
+                                      new_segment)
         snap = Snapshot(self._table, new_segment, self._engine)
         snap._state = new_state
         return snap

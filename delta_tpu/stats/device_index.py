@@ -43,6 +43,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from delta_tpu import obs
+from delta_tpu.obs import hbm
 from delta_tpu.expressions.tree import (
     Column,
     Comparison,
@@ -58,7 +59,9 @@ from delta_tpu.ops.skipping import AtomBlock
 
 _BUILDS = obs.counter("scan.stats_index_builds")
 _REUSES = obs.counter("scan.stats_index_reuses")
-_HBM_BYTES = obs.gauge("scan.stats_index_hbm_bytes")
+# device bytes are accounted in the resident ledger (obs/hbm.py),
+# which derives the `scan.stats_index_hbm_bytes` gauge this module
+# used to maintain by hand
 
 _OP_CODES = {"<": 0, "<=": 1, ">": 2, ">=": 3, "=": 4, "!=": 5}
 _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
@@ -200,16 +203,20 @@ class ResidentStatsIndex:
 
     def __init__(self, arrow_index, vals: Optional[np.ndarray],
                  valid: Optional[np.ndarray],
-                 cols: Dict[tuple, Tuple[int, str]], n: int):
+                 cols: Dict[tuple, Tuple[int, str]], n: int,
+                 table_path: Optional[str] = None,
+                 version: Optional[int] = None):
         self._lock = threading.Lock()
         self.arrow_index = arrow_index
         self.vals = vals          # int64 [R, n_pad] or None
         self.valid = valid        # bool  [R, n_pad] or None
         self.cols = cols          # {physical name_path: (min row, kind)}
         self.n = n
+        self.table_path = table_path
+        self.version = version
         self.released = False
         self._dev = None
-        self._hbm_bytes = 0
+        self._hbm = hbm.noop_handle()
 
     @property
     def has_lanes(self) -> bool:
@@ -218,7 +225,10 @@ class ResidentStatsIndex:
     def device_lanes(self):
         """(values, validity) device arrays, uploading on first use."""
         with self._lock:
-            return self._upload_locked()
+            dev = self._upload_locked()
+            if dev is not None:
+                self._hbm.touch()
+            return dev
 
     def _upload_locked(self):
         if self._dev is not None or self.vals is None or self.released:
@@ -244,8 +254,11 @@ class ResidentStatsIndex:
             dvalid = jnp.unpackbits(dw, axis=1, count=n_pad,
                                     bitorder="little").astype(bool)
         self._dev = (dv, dvalid)
-        self._hbm_bytes = int(dv.nbytes + dvalid.nbytes)
-        _HBM_BYTES.inc(self._hbm_bytes)
+        self._hbm = hbm.register(
+            self, kind=hbm.KIND_STATS_INDEX, table_path=self.table_path,
+            version=self.version, arrays=(dv, dvalid),
+            rebuild_cost_class="cheap",  # lazy re-upload from host lanes
+        )
         return self._dev
 
     def release(self) -> None:
@@ -255,15 +268,16 @@ class ResidentStatsIndex:
         of a still-live snapshot simply rebuilds."""
         with self._lock:
             if self._dev is not None:
-                _HBM_BYTES.dec(self._hbm_bytes)
-                self._hbm_bytes = 0
                 self._dev = None
+                self._hbm.release()
+                self._hbm = hbm.noop_handle()
             self.vals = None
             self.valid = None
             self.released = True
 
 
-def build_index(files: pa.Table) -> ResidentStatsIndex:
+def build_index(files: pa.Table, table_path: Optional[str] = None,
+                version: Optional[int] = None) -> ResidentStatsIndex:
     """Columnarize one snapshot version's parsed stats into lanes."""
     from delta_tpu.ops.replay import pad_bucket
     from delta_tpu.stats.skipping import StatsIndex
@@ -272,7 +286,8 @@ def build_index(files: pa.Table) -> ResidentStatsIndex:
     n = arrow_index.n
     table = arrow_index._table
     if table is None:
-        return ResidentStatsIndex(arrow_index, None, None, {}, n)
+        return ResidentStatsIndex(arrow_index, None, None, {}, n,
+                                  table_path=table_path, version=version)
 
     names = table.column_names
     mins = table.column("minValues").combine_chunks() \
@@ -282,7 +297,8 @@ def build_index(files: pa.Table) -> ResidentStatsIndex:
     if (mins is None or maxs is None
             or not pa.types.is_struct(mins.type)
             or not pa.types.is_struct(maxs.type)):
-        return ResidentStatsIndex(arrow_index, None, None, {}, n)
+        return ResidentStatsIndex(arrow_index, None, None, {}, n,
+                                  table_path=table_path, version=version)
 
     lanes: List[Tuple[np.ndarray, np.ndarray]] = []
     cols: Dict[tuple, Tuple[int, str]] = {}
@@ -306,7 +322,8 @@ def build_index(files: pa.Table) -> ResidentStatsIndex:
         cols[path] = (len(lanes), kind)
         lanes.extend((enc_mn, enc_mx, enc_nc))
     if not cols:
-        return ResidentStatsIndex(arrow_index, None, None, {}, n)
+        return ResidentStatsIndex(arrow_index, None, None, {}, n,
+                                  table_path=table_path, version=version)
 
     enc_nr = _encode_lane(nr, "int") if nr is not None else None
     if enc_nr is None:
@@ -319,7 +336,8 @@ def build_index(files: pa.Table) -> ResidentStatsIndex:
     for r, (ev, eva) in enumerate(lanes):
         vals[r, :n] = ev
         valid[r, :n] = eva
-    return ResidentStatsIndex(arrow_index, vals, valid, cols, n)
+    return ResidentStatsIndex(arrow_index, vals, valid, cols, n,
+                              table_path=table_path, version=version)
 
 
 def _compile_conj(conj: Expression,
@@ -448,7 +466,9 @@ def snapshot_stats_index(state, files: pa.Table):
         if idx is not None and not idx.released:
             _REUSES.inc()
             return idx
-        idx = build_index(files)
+        idx = build_index(files,
+                          table_path=getattr(state, "table_path", None),
+                          version=getattr(state, "version", None))
         state.stats_index = idx
         _BUILDS.inc()
         return idx

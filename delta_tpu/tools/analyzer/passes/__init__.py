@@ -14,6 +14,7 @@ from delta_tpu.tools.analyzer.passes import (  # noqa: F401
     purity,
     races,
     recompile,
+    resident_ledger,
     retry_discipline,
     route_contract,
     threads,

@@ -41,9 +41,10 @@ _KIND_BY_FN = {"counter": "counters", "histogram": "histograms",
 # (registry definitions, exposition, tests' fixtures ride through env
 # override), not product metrics — EXCEPT the device-execution
 # profiler, whose instruments (device.*, gate.*) are product telemetry
-# and must stay cataloged like any other module's
+# and must stay cataloged like any other module's — likewise the HBM
+# resident ledger (hbm.*, plus the subsumed replay/scan gauges)
 _EXEMPT_PREFIX = os.path.join("delta_tpu", "obs") + os.sep
-_NON_EXEMPT_BASENAMES = {"device.py", "bench_trend.py"}
+_NON_EXEMPT_BASENAMES = {"device.py", "bench_trend.py", "hbm.py"}
 
 
 def _catalog_path() -> Optional[str]:

@@ -1,0 +1,170 @@
+"""Resident-ledger coverage lint: ``resident-ledger-discipline``.
+
+PR 18's HBM accounting only balances if every persistent device lane
+actually reaches the ledger — an artifact created without
+`hbm.register()` is invisible to the budget rollups, and a handle whose
+`release()` is unreachable turns every eviction into a phantom leak.
+The instrumentation sites were added by hand; this pass keeps them
+from rotting. Inside the covered resident-owner modules it enforces
+three shapes:
+
+- a `hbm.register(...)` result assigned to an attribute (or name) must
+  have a matching ``.release()`` call on that attribute/name somewhere
+  in the module (the owner's teardown path);
+- a `hbm.register(...)` whose result is discarded is always wrong —
+  the handle IS the only way to release or grow the entry;
+- a class in a covered module that launches ``device_put`` transfers
+  but never calls `hbm.register` anywhere is an unregistered resident
+  lane.
+
+Covered modules default to the resident-artifact owners (replay key
+lanes, stats-index lanes, checkpoint handoff codes). Override, mostly
+for fixture tests:
+
+  DELTA_LINT_LEDGER_MODULES  comma-separated rel paths replacing the
+                             covered-module set
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set, Tuple
+
+from delta_tpu.tools.analyzer.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from delta_tpu.tools.analyzer.passes._astutil import call_name
+
+# The resident-artifact owner modules: every persistent device lane in
+# these files registers with the HBM ledger (PR 18).
+_DEFAULT_MODULES = (
+    "delta_tpu/parallel/resident.py",
+    "delta_tpu/stats/device_index.py",
+    "delta_tpu/ops/page_decode.py",
+)
+
+
+def _covered_modules() -> Set[str]:
+    env = os.environ.get("DELTA_LINT_LEDGER_MODULES")
+    if env is not None:
+        return {p.strip() for p in env.split(",") if p.strip()}
+    return set(_DEFAULT_MODULES)
+
+
+def _is_register_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    return bool(name) and name.rpartition(".")[2] == "register" \
+        and "hbm" in name.split(".")
+
+
+def _handle_slot(target: ast.expr) -> Optional[Tuple[str, str]]:
+    """("attr"|"name", slot) for an assignment target that can hold a
+    ledger handle; None for targets the pass doesn't track (tuple
+    unpacking, subscripts)."""
+    if isinstance(target, ast.Attribute):
+        return ("attr", target.attr)
+    if isinstance(target, ast.Name):
+        return ("name", target.id)
+    return None
+
+
+def _released_slots(tree: ast.AST) -> Set[Tuple[str, str]]:
+    """Every ``<slot>.release()`` call in the module, keyed like
+    `_handle_slot`: ``self._hbm.release()`` / ``p.hbm.release()`` yield
+    ("attr", "_hbm") / ("attr", "hbm"); ``h.release()`` yields
+    ("name", "h")."""
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"):
+            continue
+        recv = node.func.value
+        slot = _handle_slot(recv)
+        if slot is not None:
+            out.add(slot)
+    return out
+
+
+@register
+class ResidentLedgerRule(Rule):
+    id = "resident-ledger-discipline"
+    help_anchor = "resident-ledger-discipline"
+    description = (
+        "hbm ledger coverage in resident-owner modules: every "
+        "`hbm.register()` handle needs a reachable `.release()`, a "
+        "discarded register() handle can never be released, and a "
+        "class launching device_put transfers without any register() "
+        "call is an unregistered resident lane invisible to the HBM "
+        "budget rollups")
+
+    def check_project(self, mods: List[ModuleInfo]) -> List[Finding]:
+        modules = _covered_modules()
+        out: List[Finding] = []
+        for mod in mods:
+            if mod.rel not in modules or mod.tree is None:
+                continue
+            released = _released_slots(mod.tree)
+            for node in ast.walk(mod.tree):
+                # shape B: register() result discarded
+                if isinstance(node, ast.Expr) \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_register_call(node.value):
+                    out.append(Finding(
+                        self.id, mod.rel, node.lineno, node.col_offset,
+                        "hbm.register() result discarded — the handle "
+                        "is the only way to release (or grow) the "
+                        "ledger entry; assign it to the owner"))
+                    continue
+                # shape A: register() assigned, no matching release()
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_register_call(node.value):
+                    for target in node.targets:
+                        slot = _handle_slot(target)
+                        if slot is not None and slot not in released:
+                            kind, name = slot
+                            out.append(Finding(
+                                self.id, mod.rel, node.lineno,
+                                node.col_offset,
+                                f"hbm.register() handle stored in "
+                                f"{'attribute' if kind == 'attr' else 'name'} "
+                                f"{name!r} has no matching "
+                                f"`.{name}.release()` in this module — "
+                                f"every registered artifact needs a "
+                                f"reachable teardown path"
+                                if kind == "attr" else
+                                f"hbm.register() handle bound to "
+                                f"{name!r} has no matching "
+                                f"`{name}.release()` in this module — "
+                                f"every registered artifact needs a "
+                                f"reachable teardown path"))
+                # shape C: class with device lanes but no register()
+                if isinstance(node, ast.ClassDef):
+                    has_put = False
+                    has_register = False
+                    for sub in ast.walk(node):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        name = call_name(sub)
+                        if not name:
+                            continue
+                        if name.rpartition(".")[2] == "device_put":
+                            has_put = True
+                        if _is_register_call(sub):
+                            has_register = True
+                    if has_put and not has_register:
+                        out.append(Finding(
+                            self.id, mod.rel, node.lineno,
+                            node.col_offset,
+                            f"class {node.name} launches device_put "
+                            f"transfers but never calls hbm.register() "
+                            f"— a persistent device lane in a covered "
+                            f"module must reach the resident ledger "
+                            f"(or move the lane out of the covered "
+                            f"set)"))
+        return out

@@ -1,0 +1,161 @@
+"""delta-hbm: resident-artifact report over an HBM ledger dump.
+
+The resident ledger (`obs.hbm`) tracks every device-resident artifact —
+replay key lanes, scan-planning stats indexes, checkpoint handoff
+codes — with ``(table_path, kind, version, nbytes, rebuild_cost_class,
+created_at, last_access)``. `hbm.dump_ledger(path)` serializes the live
+residents plus the leak ring as JSONL; this tool turns that artifact
+into the fleet-budget answers ROADMAP item 6 needs: *which tables hold
+how much HBM, in what kinds, and did anything leak?*
+
+Usage::
+
+    delta-hbm ledger.jsonl                  # rollup by table (default)
+    delta-hbm ledger.jsonl --by kind        # rollup by kind
+    delta-hbm ledger.jsonl --top 10         # N largest residents
+    delta-hbm ledger.jsonl --leaks          # leak report
+    delta-hbm ledger.jsonl --json           # any of the above as JSON
+    python -m delta_tpu.tools.hbm_cli ...   # same, without the script
+
+Rollups computed here from a JSONL dump match `hbm.rollup()` over the
+live ledger record-for-record — the round-trip is covered by
+tests/test_hbm_ledger.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_ledger_dump(path: str) -> Tuple[List[dict], List[dict]]:
+    """Split a dump_ledger JSONL artifact into (residents, leaks);
+    unparseable lines are skipped (the dump may be tail-truncated)."""
+    residents: List[dict] = []
+    leaks: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("type") == "hbm_resident":
+                residents.append(rec)
+            elif rec.get("type") == "hbm_leak":
+                leaks.append(rec)
+    return residents, leaks
+
+
+def rollup_records(residents: List[dict], by: str = "table") -> Dict[str, dict]:
+    """Per-table (or per-kind) byte/artifact totals from dump records —
+    the same shape `hbm.rollup()` produces from the live ledger."""
+    if by not in ("table", "kind"):
+        raise ValueError(f"rollup by {by!r}; expected 'table' or 'kind'")
+    sub_key = "by_kind" if by == "table" else "by_table"
+    out: Dict[str, dict] = {}
+    for r in residents:
+        key = r.get("table_path") if by == "table" else r.get("kind")
+        sub = r.get("kind") if by == "table" else r.get("table_path")
+        nbytes = int(r.get("nbytes", 0))
+        ent = out.setdefault(key, {"nbytes": 0, "artifacts": 0, sub_key: {}})
+        ent["nbytes"] += nbytes
+        ent["artifacts"] += 1
+        ent[sub_key][sub] = ent[sub_key].get(sub, 0) + nbytes
+    return out
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def render_rollup(rollup: Dict[str, dict], by: str) -> str:
+    sub_key = "by_kind" if by == "table" else "by_table"
+    lines = []
+    for key in sorted(rollup, key=lambda k: -rollup[k]["nbytes"]):
+        ent = rollup[key]
+        lines.append(f"{by} {key}: {_fmt_bytes(ent['nbytes'])} "
+                     f"in {ent['artifacts']} artifacts")
+        for sub in sorted(ent[sub_key], key=lambda s: -ent[sub_key][s]):
+            lines.append(f"  {sub:<16} {_fmt_bytes(ent[sub_key][sub])}")
+    return "\n".join(lines) if lines else "no resident artifacts in dump"
+
+
+def render_top(residents: List[dict], top: int) -> str:
+    ranked = sorted(residents,
+                    key=lambda r: (-int(r.get("nbytes", 0)),
+                                   r.get("seq", 0)))[:top]
+    lines = []
+    for r in ranked:
+        ver = r.get("version")
+        lines.append(
+            f"{_fmt_bytes(int(r.get('nbytes', 0))):>10}  "
+            f"{r.get('kind', '?'):<14} {r.get('table_path', '?')}"
+            f"{'' if ver is None else f' @v{ver}'}  "
+            f"[{r.get('rebuild_cost_class', '?')}]")
+    return "\n".join(lines) if lines else "no resident artifacts in dump"
+
+
+def render_leaks(leaks: List[dict]) -> str:
+    lines = []
+    for r in leaks:
+        lines.append(
+            f"LEAK {r.get('kind', '?')} artifact of "
+            f"{r.get('table_path', '?')} "
+            f"({_fmt_bytes(int(r.get('nbytes', 0)))}) — owner GC'd "
+            f"without release()")
+    return "\n".join(lines) if lines else "no leaks recorded"
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="delta-hbm",
+        description="Resident-artifact rollups, top-N, and leak report "
+                    "from an HBM ledger dump (hbm.dump_ledger JSONL).")
+    parser.add_argument("dump", help="ledger dump path (JSONL)")
+    parser.add_argument("--by", choices=("table", "kind"), default="table",
+                        help="rollup dimension (default: table)")
+    parser.add_argument("--top", type=int, metavar="N",
+                        help="N largest residents instead of the rollup")
+    parser.add_argument("--leaks", action="store_true",
+                        help="leak report instead of the rollup")
+    parser.add_argument("--json", action="store_true",
+                        help="print the selected view as JSON")
+    args = parser.parse_args(argv)
+
+    try:
+        residents, leaks = load_ledger_dump(args.dump)
+    except OSError as e:
+        print(f"delta-hbm: {e}", file=sys.stderr)
+        return 2
+
+    payload: Any
+    if args.leaks:
+        payload = leaks
+        print(json.dumps(payload, indent=2) if args.json
+              else render_leaks(leaks))
+        # a nonzero leak count is the signal CI greps for
+        return 1 if leaks else 0
+    if args.top:
+        payload = sorted(residents,
+                         key=lambda r: (-int(r.get("nbytes", 0)),
+                                        r.get("seq", 0)))[:args.top]
+        print(json.dumps(payload, indent=2) if args.json
+              else render_top(residents, args.top))
+    else:
+        payload = rollup_records(residents, by=args.by)
+        print(json.dumps(payload, indent=2) if args.json
+              else render_rollup(payload, args.by))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

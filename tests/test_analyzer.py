@@ -824,6 +824,7 @@ def test_every_registered_rule_has_fixture_coverage():
         "shared-state-race",                                 # races
         "transfer-budget", "transfer-unbudgeted",            # budget
         "unprofiled-dispatch",                               # device obs
+        "resident-ledger-discipline",                        # hbm ledger
         "route-contract",                                    # routes
         "recompile-risk",                                    # recompile
         "env-knob-uncataloged", "env-knob-dead-entry",
@@ -2016,6 +2017,127 @@ def launch(arr, flag):
     monkeypatch.setenv(_DISPATCH_ENV, "pkg/k.py")
     report = analyze_sources({"pkg/k.py": src},
                              rules=["unprofiled-dispatch"])
+    assert not report.findings
+
+
+# -------------------------------------- resident-ledger-discipline
+
+
+_LEDGER_ENV = "DELTA_LINT_LEDGER_MODULES"
+
+_LEDGER_CLEAN_SRC = """
+import jax
+from delta_tpu.obs import hbm
+
+class Lane:
+    def __init__(self, arr):
+        dev = jax.device_put(arr)
+        self._hbm = hbm.register(self, kind="replay-keys", arrays=(dev,))
+
+    def release(self):
+        self._hbm.release()
+"""
+
+
+def test_ledger_registered_and_released_clean(monkeypatch):
+    monkeypatch.setenv(_LEDGER_ENV, "pkg/owner.py")
+    report = analyze_sources({"pkg/owner.py": _LEDGER_CLEAN_SRC},
+                             rules=["resident-ledger-discipline"])
+    assert not report.findings
+
+
+def test_ledger_register_without_release_flagged(monkeypatch):
+    src = """
+from delta_tpu.obs import hbm
+
+class Lane:
+    def __init__(self, arr):
+        self._hbm = hbm.register(self, kind="replay-keys", arrays=(arr,))
+"""
+    monkeypatch.setenv(_LEDGER_ENV, "pkg/owner.py")
+    report = analyze_sources({"pkg/owner.py": src},
+                             rules=["resident-ledger-discipline"])
+    fired = _rules_fired(report, "resident-ledger-discipline")
+    assert len(fired) == 1 and "'_hbm'" in fired[0].message \
+        and "release" in fired[0].message
+
+
+def test_ledger_discarded_register_flagged(monkeypatch):
+    src = """
+from delta_tpu.obs import hbm
+
+def make(arr):
+    hbm.register(None, kind="stats-index", arrays=(arr,))
+"""
+    monkeypatch.setenv(_LEDGER_ENV, "pkg/owner.py")
+    report = analyze_sources({"pkg/owner.py": src},
+                             rules=["resident-ledger-discipline"])
+    fired = _rules_fired(report, "resident-ledger-discipline")
+    assert len(fired) == 1 and "discarded" in fired[0].message
+
+
+def test_ledger_unregistered_lane_class_flagged(monkeypatch):
+    src = """
+import jax
+
+class Lane:
+    def upload(self, arr):
+        self.dev = jax.device_put(arr)
+"""
+    monkeypatch.setenv(_LEDGER_ENV, "pkg/owner.py")
+    report = analyze_sources({"pkg/owner.py": src},
+                             rules=["resident-ledger-discipline"])
+    fired = _rules_fired(report, "resident-ledger-discipline")
+    assert len(fired) == 1 and "Lane" in fired[0].message \
+        and "hbm.register" in fired[0].message
+
+
+def test_ledger_uncovered_module_ignored(monkeypatch):
+    src = """
+import jax
+
+class Lane:
+    def upload(self, arr):
+        self.dev = jax.device_put(arr)
+"""
+    monkeypatch.setenv(_LEDGER_ENV, "pkg/other.py")
+    report = analyze_sources({"pkg/owner.py": src},
+                             rules=["resident-ledger-discipline"])
+    assert not report.findings
+
+
+def test_ledger_name_bound_release_clean(monkeypatch):
+    """A handle bound to a local name counts when `.release()` is
+    called on that name (the transient handoff-lane shape)."""
+    src = """
+from delta_tpu.obs import hbm
+
+def decode(arr):
+    h = hbm.register(None, kind="ckpt-handoff", arrays=(arr,))
+    try:
+        return arr
+    finally:
+        h.release()
+"""
+    monkeypatch.setenv(_LEDGER_ENV, "pkg/owner.py")
+    report = analyze_sources({"pkg/owner.py": src},
+                             rules=["resident-ledger-discipline"])
+    assert not report.findings
+
+
+def test_ledger_real_owner_modules_clean():
+    """The shipped resident owners (replay key lanes, stats-index
+    lanes, checkpoint handoff) must satisfy the discipline rule —
+    whole-repo zero findings is an acceptance gate for this pass."""
+    import delta_tpu
+
+    pkg = os.path.dirname(delta_tpu.__file__)
+    sources = {}
+    for rel in ("parallel/resident.py", "stats/device_index.py",
+                "ops/page_decode.py"):
+        with open(os.path.join(pkg, rel), encoding="utf-8") as f:
+            sources[f"delta_tpu/{rel}"] = f.read()
+    report = analyze_sources(sources, rules=["resident-ledger-discipline"])
     assert not report.findings
 
 
