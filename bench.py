@@ -2244,6 +2244,114 @@ def tpcds_scan_metric(workdir: str) -> None:
     }))
 
 
+def tpcds_query_metric(workdir: str) -> None:
+    """TPC-DS query execution through the device SQL spine: wall
+    seconds to plan + execute a join/agg-heavy query slice with the
+    sql gate forced to device, row-exact parity against the HostEngine
+    executor, and the resident operand cache's warm payoff — the warm
+    pass must show cache hits AND measurably fewer H2D bytes than the
+    cold pass (the build sides stayed on device). Numbers on a CPU
+    container are informational; the parity/cache asserts are
+    platform-independent."""
+    import shutil
+
+    from delta_tpu import obs
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.sqlengine import execute_select
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.tpcds_data import load_delta
+    from benchmarks.tpcds_queries import QUERIES
+
+    scale = int(os.environ.get("BENCH_TPCDS_SCALE", 40_000))
+    root = os.path.join(workdir, "tpcds_query")
+    shutil.rmtree(root, ignore_errors=True)
+    catalog = load_delta(root, scale=scale)
+    host_catalog = Catalog(catalog.root, engine=HostEngine())
+
+    # ORDER BY ties at a LIMIT cutoff are engine-dependent; comparing
+    # the full result set is strictly stronger (same as test_tpcds)
+    def _strip_limit(q: str) -> str:
+        return re.sub(r"\blimit\s+\d+\s*$", "", q.strip(),
+                      flags=re.IGNORECASE)
+
+    names = [n for n in ("q3", "q7", "q19", "q42", "q52", "q55", "q68",
+                         "q96") if n in QUERIES]
+    texts = {n: _strip_limit(QUERIES[n]) for n in names}
+
+    def _rows(tbl):
+        out = list(zip(*(c.to_pylist() for c in tbl.columns))) \
+            if tbl.num_columns else []
+        if tbl.num_rows and not out:
+            out = [()] * tbl.num_rows
+        return sorted(out, key=repr)
+
+    hits = obs.counter("sql.operand_cache_hits")
+    misses = obs.counter("sql.operand_cache_misses")
+    dev_q = obs.counter("sql.device_queries")
+    h2d = obs.counter("device.h2d_bytes")
+
+    os.environ["DELTA_TPU_DEVICE_SQL"] = "force"
+    obs.set_device_obs_mode("on")
+    obs.reset_device_obs()
+    try:
+        q0, b0 = dev_q.value, h2d.value
+        t0 = time.perf_counter()
+        for n in names:
+            execute_select(texts[n], catalog=catalog)
+        cold_s = time.perf_counter() - t0
+        cold_h2d = h2d.value - b0
+
+        h0, m0, b1 = hits.value, misses.value, h2d.value
+        warm = {}
+        t0 = time.perf_counter()
+        for n in names:
+            warm[n] = execute_select(texts[n], catalog=catalog)
+        warm_s = time.perf_counter() - t0
+        warm_h2d = h2d.value - b1
+        warm_hits = hits.value - h0
+        warm_misses = misses.value - m0
+        routed = dev_q.value - q0
+    finally:
+        del os.environ["DELTA_TPU_DEVICE_SQL"]
+        obs.set_device_obs_mode(None)
+        obs.reset_device_obs()
+
+    mismatches = [n for n in names
+                  if _rows(execute_select(texts[n], catalog=host_catalog))
+                  != _rows(warm[n])]
+
+    hit_pct = 100.0 * warm_hits / max(1, warm_hits + warm_misses)
+    ok = (not mismatches and routed >= 2 * len(names)
+          and warm_hits > 0 and warm_h2d < cold_h2d)
+    print(f"tpcds queries @{scale} rows: {len(names)} queries, cold "
+          f"{cold_s:.2f}s / warm {warm_s:.2f}s, H2D cold "
+          f"{cold_h2d / 1e6:.2f}MB -> warm {warm_h2d / 1e6:.2f}MB, "
+          f"operand cache {warm_hits} hits / {warm_misses} misses "
+          f"({hit_pct:.0f}%), {routed} device-routed, parity "
+          f"{'OK' if not mismatches else 'MISMATCH ' + str(mismatches)}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "tpcds_query_seconds",
+        "value": round(warm_s, 4) if ok else -1.0,
+        "unit": "s",
+        "queries": len(names),
+        "cold_seconds": round(cold_s, 4),
+        "h2d_bytes_cold": cold_h2d,
+        "h2d_bytes_warm": warm_h2d,
+        "device_routed": routed,
+        "parity_mismatches": mismatches,
+    }))
+    print(json.dumps({
+        "metric": "sql_operand_cache_hit_pct",
+        "value": round(hit_pct, 2) if ok else -1.0,
+        "unit": "%",
+        "hits": warm_hits,
+        "misses": warm_misses,
+    }))
+
+
 def main():
     commits = int(os.environ.get("BENCH_COMMITS", 100_000))
     workdir = os.environ.get("BENCH_WORKDIR", "/tmp/delta_tpu_bench")
@@ -2273,6 +2381,7 @@ def main():
     device_obs_metric(workdir)
     hbm_overhead_metric(workdir, min(timeout_s, 600))
     tpcds_scan_metric(workdir)
+    tpcds_query_metric(workdir)
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         sharded_metrics(timeout_s)
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -82,6 +83,14 @@ class Catalog:
         self.engine = engine
         self.root = root.rstrip("/")
         self._dir = f"{self.root}/_catalog"
+        # name -> Table instance cache: a Table's snapshot-state cache
+        # (and the device-resident artifacts hanging off it — stats
+        # index, SQL operand lanes) only pays off if repeated queries
+        # resolve a name to the SAME Table object. Invalidation is the
+        # Table's own job: latest_snapshot() re-lists the log every
+        # call and reuses state only when the version is unchanged.
+        self._tables: Dict[str, Table] = {}
+        self._tables_lock = threading.Lock()
 
     def _entry_path(self, name: str) -> str:
         if not _NAME_RE.match(name):
@@ -226,6 +235,10 @@ class Catalog:
             except FileNotFoundError:
                 pass
         fs.delete(entry)
+        # a recreate at the same location can reach the same version
+        # number, which would let the cached Table serve stale state
+        with self._tables_lock:
+            self._tables.pop(name, None)
         return True
 
     # -- resolution --------------------------------------------------------
@@ -238,7 +251,19 @@ class Catalog:
             raise TableNotInCatalogError(f"table {name} not found") from None
 
     def table(self, name: str) -> Table:
-        return Table.for_path(self._location(name), self.engine)
+        loc = self._location(name)
+        with self._tables_lock:
+            t = self._tables.get(name)
+            if t is not None and t.path == loc and t.engine is self.engine:
+                return t
+        t = Table.for_path(loc, self.engine)   # I/O outside the lock
+        with self._tables_lock:
+            cur = self._tables.get(name)
+            if cur is not None and cur.path == loc \
+                    and cur.engine is self.engine:
+                return cur                     # lost the race: reuse
+            self._tables[name] = t
+            return t
 
     def exists(self, name: str) -> bool:
         return self.engine.fs.exists(self._entry_path(name))

@@ -58,6 +58,8 @@ _DIRECTION: Dict[str, int] = {
     "replay_kernel_vs_host_vectorized": +1,
     "analyzer_findings_total": -1,
     "serve_p99_ms_chaos": -1,
+    "tpcds_query_seconds": -1,
+    "sql_operand_cache_hit_pct": +1,  # hit rate, not an overhead
 }
 
 _LOWER_MARKERS = ("overhead", "latency", "findings")

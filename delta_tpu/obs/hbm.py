@@ -69,6 +69,7 @@ _MODES = {"off": MODE_OFF, "on": MODE_ON, "strict": MODE_STRICT,
 KIND_REPLAY_KEYS = "replay-keys"      # parallel/resident.py
 KIND_STATS_INDEX = "stats-index"      # stats/device_index.py
 KIND_CKPT_HANDOFF = "ckpt-handoff"    # ops/page_decode.py (transient)
+KIND_SQL_OPERANDS = "sql-operands"    # sqlengine/operands.py
 
 UNKNOWN_TABLE = "unknown"
 
@@ -625,3 +626,5 @@ gauge("replay.resident_hbm_bytes").set_fn(
     lambda: _LEDGER.kind_bytes(KIND_REPLAY_KEYS))
 gauge("scan.stats_index_hbm_bytes").set_fn(
     lambda: _LEDGER.kind_bytes(KIND_STATS_INDEX))
+gauge("sql.operand_cache_bytes").set_fn(
+    lambda: _LEDGER.kind_bytes(KIND_SQL_OPERANDS))

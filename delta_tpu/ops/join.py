@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from delta_tpu import obs
 from delta_tpu.ops.replay import _unpack_bits, pad_bucket
 
 _PAD_CODE = np.uint32(0xFFFFFFFF)
@@ -96,10 +97,15 @@ def equi_join_codes(
     codes = np.full(nt_pad + ns_pad, _PAD_CODE, np.uint32)
     codes[:nt] = t_codes
     codes[nt_pad:nt_pad + ns] = s_codes
-    if device is not None:
-        codes = jax.device_put(codes, device)
-    match_src, src_words, n_multi = _join_kernel(
-        codes, nt_pad=nt_pad, ns_pad=ns_pad)
+    with obs.device_dispatch("join.merge_match",
+                             key=(nt_pad, ns_pad),
+                             budget="merge-join-codes",
+                             units=nt_pad + ns_pad) as dd:
+        dd.h2d("codes", codes)
+        codes_dev = jax.device_put(codes, device) \
+            if device is not None else codes
+        match_src, src_words, n_multi = _join_kernel(
+            codes_dev, nt_pad=nt_pad, ns_pad=ns_pad)
     match_src = np.asarray(match_src)[:nt]
     src_matched = _unpack_bits(np.asarray(src_words), ns_pad)[:ns]
     return match_src, int(n_multi), src_matched

@@ -31,16 +31,32 @@ HostEngine's pandas path stays the bit-exact parity oracle.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+import os
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from delta_tpu import obs
 from delta_tpu.ops.replay import pad_bucket
 
 _PAD_CODE = np.uint32(0xFFFFFFFF)
 _x64_enabled = False
+
+# Build sides at or above this many rows fan the segment-reduce
+# aggregation out over the engine mesh (`shard_map` over REPLAY_AXIS),
+# host-parity-gated like `ops/replay.py::compute_masks_device`. Below
+# it the single-chip kernel wins: the shard routing pass costs more
+# than the per-shard reduction saves.
+DEFAULT_SHARDED_AGG_MIN_ROWS = 2_000_000
+
+
+def sharded_agg_min_rows() -> int:
+    env = os.environ.get("DELTA_TPU_SQL_SHARD_MIN_ROWS")
+    if env:
+        return int(env)
+    return DEFAULT_SHARDED_AGG_MIN_ROWS
 
 
 def _ensure_x64() -> None:
@@ -72,23 +88,32 @@ def sort_permutation(lanes: Sequence[np.ndarray],
     if n == 0:
         return np.empty(0, np.int64)
     npad = pad_bucket(n)
-    padded = []
-    for lane in lanes:
-        lane = np.asarray(lane)
-        if lane.dtype == np.float32:
-            lane = lane.astype(np.float64)
-        elif lane.dtype == bool:  # 0/1 null-ordering lanes
-            lane = lane.astype(np.uint8)
-        if lane.dtype.kind == "f":
-            fill = np.inf
-        else:
-            fill = np.iinfo(lane.dtype).max
-        p = np.full(npad, fill, dtype=lane.dtype)
-        p[:n] = lane
-        padded.append(jax.device_put(p, device))
-    iota = jax.device_put(np.arange(npad, dtype=np.int64), device)
-    perm = np.asarray(_sort_kernel(tuple(padded) + (iota,),
-                                   num_keys=len(padded)))
+    with obs.device_dispatch("sqlops.sort", key=(len(lanes), npad),
+                             budget="sql-sort-lanes", units=npad,
+                             gate="sql") as dd:
+        padded = []
+        for lane in lanes:
+            lane = np.asarray(lane)
+            if lane.dtype == np.float32:
+                lane = lane.astype(np.float64)
+            elif lane.dtype == bool:  # 0/1 null-ordering lanes
+                lane = lane.astype(np.uint8)
+            if lane.dtype.kind == "f":
+                fill = np.inf
+            else:
+                fill = np.iinfo(lane.dtype).max
+            # "key" lanes mix dtypes (i64/f64 values, u8 null lanes), so
+            # the manifest prices them at runtime via the recorded bytes
+            # (entry is non-exhaustive); only iota is statically pinned
+            key = np.full(npad, fill, dtype=lane.dtype)
+            key[:n] = lane
+            dd.h2d("key", key)
+            padded.append(jax.device_put(key, device))
+        iota = np.arange(npad, dtype=np.int64)
+        dd.h2d("iota", iota)
+        perm = np.asarray(_sort_kernel(
+            tuple(padded) + (jax.device_put(iota, device),),
+            num_keys=len(padded)))
     return perm[perm < n]
 
 
@@ -122,6 +147,70 @@ def _segagg_kernel(codes, v, valid, op: str, n_seg: int):
     return s, cnt
 
 
+def _agg_mesh(n: int, mesh=None):
+    """Resolve the mesh for the sharded segment-reduce fan-out; None
+    keeps the single-chip kernel (input below the row threshold, a
+    1-device mesh, or no usable mesh at all)."""
+    if n < sharded_agg_min_rows():
+        return None
+    if mesh is None:
+        try:
+            from delta_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        except (ImportError, RuntimeError, ValueError):
+            return None
+    if mesh is None or mesh.devices.size <= 1:
+        return None
+    return mesh
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_segagg_fn(mesh, op: str, n_seg: int):
+    """Mesh-sharded segment reduce: each shard reduces its row block
+    into a full [n_seg] partial, combined with one cross-shard
+    psum/pmin/pmax. Per-segment results are identical to the
+    single-chip kernel for int64 accumulation (the parity gate in
+    tests/test_sql_operand_cache.py pins this); float64 sums may
+    differ in the last ulp from the reassociated addition order."""
+    from jax.sharding import PartitionSpec as P
+
+    from delta_tpu.parallel.mesh import REPLAY_AXIS
+    from delta_tpu.parallel.sharded_replay import shard_map
+
+    def kernel(codes, v, valid):
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), codes,
+                                  num_segments=n_seg)
+        cnt = jax.lax.psum(cnt, REPLAY_AXIS)
+        if op == "count":
+            return cnt, cnt
+        if op == "sum":
+            zero = jnp.zeros((), v.dtype)
+            s = jax.ops.segment_sum(jnp.where(valid, v, zero), codes,
+                                    num_segments=n_seg)
+            return jax.lax.psum(s, REPLAY_AXIS), cnt
+        if v.dtype.kind == "f":
+            big = jnp.array(np.inf, v.dtype)
+        else:
+            big = jnp.array(np.iinfo(np.int64).max, v.dtype)
+        if op == "min":
+            s = jax.ops.segment_min(jnp.where(valid, v, big), codes,
+                                    num_segments=n_seg)
+            s = jax.lax.pmin(s, REPLAY_AXIS)
+        elif op == "max":
+            s = jax.ops.segment_max(jnp.where(valid, v, -big), codes,
+                                    num_segments=n_seg)
+            s = jax.lax.pmax(s, REPLAY_AXIS)
+        else:
+            raise ValueError(op)
+        return s, cnt
+
+    spec = P(REPLAY_AXIS)
+    fn = shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=(P(), P()))
+    return jax.jit(fn)
+
+
 @functools.partial(jax.jit, static_argnames=("n_seg",))
 def _group_sizes_kernel(codes, real, n_seg: int):
     return jax.ops.segment_sum(real.astype(jnp.int64), codes,
@@ -146,20 +235,30 @@ class GroupAggregator:
     variance. Results are sliced to `n_groups`.
     """
 
-    def __init__(self, codes: np.ndarray, n_groups: int, device=None):
+    def __init__(self, codes: np.ndarray, n_groups: int, device=None,
+                 mesh=None):
         _ensure_x64()
         self.n = int(len(codes))
         self.n_groups = int(n_groups)
         self.n_seg = pad_bucket(self.n_groups + 1, min_bucket=256)
         self.npad = pad_bucket(max(self.n, 1))
         self._codes_np = np.asarray(codes)  # host copy for reuse
-        padded = np.full(self.npad, self.n_seg - 1, np.int32)
-        padded[:self.n] = codes
+        codes_p = np.full(self.npad, self.n_seg - 1, np.int32)
+        codes_p[:self.n] = codes
         self.device = device
-        self.codes = jax.device_put(padded, device)
         real = np.zeros(self.npad, bool)
         real[:self.n] = True
-        self._real = jax.device_put(real, device)
+        with obs.device_dispatch("sqlops.group_codes", key=(self.npad,),
+                                 budget="sql-agg-lanes", units=self.npad,
+                                 gate="sql") as dd:
+            dd.h2d("codes_p", codes_p)
+            dd.h2d("real", real)
+            self.codes = jax.device_put(codes_p, device)
+            self._real = jax.device_put(real, device)
+        mesh = _agg_mesh(self.n, mesh)
+        if mesh is not None and self.npad % mesh.devices.size:
+            mesh = None  # row blocks must split evenly over the mesh
+        self._mesh = mesh
 
     def sizes(self) -> np.ndarray:
         """COUNT(*) per group."""
@@ -173,20 +272,31 @@ class GroupAggregator:
             v = v.astype(np.int64)
         else:
             v = v.astype(np.float64)
-        vp = np.zeros(self.npad, v.dtype)
+        # both arms are 8 B/unit, so the static budget holds either way
+        vp = np.zeros(self.npad, np.int64) if v.dtype.kind != "f" \
+            else np.zeros(self.npad, np.float64)
         vp[:self.n] = v
         mp = np.zeros(self.npad, bool)
         mp[:self.n] = valid
-        return (jax.device_put(vp, self.device),
-                jax.device_put(mp, self.device))
+        with obs.device_dispatch("sqlops.agg_values", key=(self.npad,),
+                                 budget="sql-agg-values", units=self.npad,
+                                 gate="sql") as dd:
+            dd.h2d("vp", vp)
+            dd.h2d("mp", mp)
+            return (jax.device_put(vp, self.device),
+                    jax.device_put(mp, self.device))
 
     def reduce(self, values, valid, op: str):
         """Returns (agg[n_groups], valid_count[n_groups]) numpy arrays.
         Callers NULL-out groups where count==0 (min_count=1 sum
         semantics) and restore original dtypes."""
         vp, mp = self._pad(values, valid)
-        agg, cnt = _segagg_kernel(self.codes, vp, mp, op=op,
-                                  n_seg=self.n_seg)
+        if self._mesh is not None:
+            fn = _sharded_segagg_fn(self._mesh, op, self.n_seg)
+            agg, cnt = fn(self.codes, vp, mp)
+        else:
+            agg, cnt = _segagg_kernel(self.codes, vp, mp, op=op,
+                                      n_seg=self.n_seg)
         return (np.asarray(agg)[:self.n_groups],
                 np.asarray(cnt)[:self.n_groups])
 
@@ -225,9 +335,14 @@ class GroupAggregator:
         gp[:m] = g
         vp = np.full(mpad, np.iinfo(np.int64).max, np.int64)
         vp[:m] = vc
-        out = _count_distinct_kernel(
-            jax.device_put(gp, self.device),
-            jax.device_put(vp, self.device), n_seg=self.n_seg)
+        with obs.device_dispatch("sqlops.count_distinct", key=(mpad,),
+                                 budget="sql-agg-distinct", units=mpad,
+                                 gate="sql") as dd:
+            dd.h2d("gp", gp)
+            dd.h2d("vp", vp)
+            out = _count_distinct_kernel(
+                jax.device_put(gp, self.device),
+                jax.device_put(vp, self.device), n_seg=self.n_seg)
         return np.asarray(out)[:self.n_groups]
 
 
@@ -248,6 +363,78 @@ def _count_distinct_kernel(g, v, n_seg: int):
 def _join_sort_kernel(codes, side, iota):
     return jax.lax.sort((codes, side, iota), num_keys=2,
                         is_stable=True)
+
+
+@jax.jit
+def _join_lanes_kernel(l_vals, r_vals, n_l, n_r):
+    """Sort (pad_flag, value, side) over the concatenated padded int64
+    key lanes; side and iota are generated ON DEVICE (they never cross
+    the link), and pads are identified positionally so any fill value
+    in the padding is safe."""
+    nl_pad = l_vals.shape[0]
+    vals = jnp.concatenate([l_vals, r_vals])
+    iota = jnp.arange(vals.shape[0], dtype=jnp.int64)
+    side = (iota >= nl_pad).astype(jnp.uint8)
+    local = jnp.where(side == 1, iota - nl_pad, iota)
+    limit = jnp.where(side == 1, n_r, n_l)
+    pad = (local >= limit).astype(jnp.uint8)
+    return jax.lax.sort((pad, vals, side, iota), num_keys=3,
+                        is_stable=True)
+
+
+def _expand_pairs(
+    s_key: np.ndarray,
+    s_side: np.ndarray,
+    s_pos: np.ndarray,
+    r_offset: int,
+    how: str,
+) -> tuple[np.ndarray, np.ndarray]:
+    """O(output) host pair expansion over key-sorted (key, side,
+    position) triples: one run per distinct key, all left x right
+    combinations per run; `how`-preserved unmatched rows get the other
+    side's index = -1. Right positions are `r_offset`-rebased into
+    right-frame indices. The output is variable-size, so this stays
+    host-side under XLA's static-shape model."""
+    empty = np.empty(0, np.int64)
+    m = len(s_key)
+    if m == 0:
+        return empty, empty
+
+    starts = np.flatnonzero(
+        np.concatenate([[True], s_key[1:] != s_key[:-1]]))
+    run_len = np.diff(np.concatenate([starts, [m]]))
+    n_r = np.add.reduceat(s_side, starts).astype(np.int64)
+    n_l = run_len - n_r
+
+    pairs = n_l * n_r
+    total = int(pairs.sum())
+    run_of = np.repeat(np.arange(len(starts)), pairs)
+    off = np.concatenate([[0], np.cumsum(pairs)[:-1]])
+    within = np.arange(total, dtype=np.int64) - off[run_of]
+    nr_run = n_r[run_of]
+    li = within // nr_run
+    ri = within - li * nr_run
+    l_idx = s_pos[starts[run_of] + li]
+    r_idx = s_pos[starts[run_of] + n_l[run_of] + ri] - r_offset
+
+    extras_l = extras_r = None
+    if how != "inner":
+        run_of_sorted = np.repeat(np.arange(len(starts)), run_len)
+    if how in ("left", "outer"):
+        sel = (n_r[run_of_sorted] == 0) & (s_side == 0)
+        extras_l = s_pos[sel]
+    if how in ("right", "outer"):
+        sel = (n_l[run_of_sorted] == 0) & (s_side == 1)
+        extras_r = s_pos[sel] - r_offset
+    if extras_l is not None and len(extras_l):
+        l_idx = np.concatenate([l_idx, extras_l])
+        r_idx = np.concatenate([r_idx, np.full(len(extras_l), -1,
+                                               np.int64)])
+    if extras_r is not None and len(extras_r):
+        l_idx = np.concatenate([l_idx, np.full(len(extras_r), -1,
+                                               np.int64)])
+        r_idx = np.concatenate([r_idx, extras_r])
+    return l_idx.astype(np.int64), r_idx.astype(np.int64)
 
 
 def join_pairs(
@@ -279,52 +466,72 @@ def join_pairs(
     side = np.zeros(npad, np.uint32)
     side[nl:] = 1
     iota = np.arange(npad, dtype=np.int64)
-    s_code, s_side, s_pos = (
-        np.asarray(a) for a in _join_sort_kernel(
-            jax.device_put(codes, device),
-            jax.device_put(side, device),
-            jax.device_put(iota, device)))
+    with obs.device_dispatch("sqlops.join_codes", key=(npad,),
+                             budget="sql-join-lanes", units=npad,
+                             gate="sql") as dd:
+        dd.h2d("codes", codes)
+        dd.h2d("side", side)
+        dd.h2d("iota", iota)
+        s_code, s_side, s_pos = (
+            np.asarray(a) for a in _join_sort_kernel(
+                jax.device_put(codes, device),
+                jax.device_put(side, device),
+                jax.device_put(iota, device)))
     real = s_code != _PAD_CODE
-    s_code, s_side, s_pos = s_code[real], s_side[real], s_pos[real]
-    m = len(s_code)
-    if m == 0:
+    return _expand_pairs(s_code[real], s_side[real], s_pos[real],
+                         nl, how)
+
+
+def join_pairs_lanes(
+    l_vals: np.ndarray,
+    r_vals: Optional[np.ndarray] = None,
+    r_resident: Optional[Tuple[object, int]] = None,
+    how: str = "inner",
+    device=None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Single-key many-to-many equi-join directly on int64 value lanes
+    — no host factorize, and the side/iota lanes are generated on
+    device, so only the key values ever cross the link (8 B/row vs the
+    16 B/row `join_pairs` ships for codes + side + iota).
+
+    `r_resident` is `(device_lane, n_rows)` from the operand cache
+    (`sqlengine/operands.py`): the build side then costs ZERO H2D
+    bytes. Exactly one of `r_vals` / `r_resident` must be given.
+    Output contract matches `join_pairs` (pair order is value-sorted
+    rather than first-appearance-sorted; both are valid many-to-many
+    expansions of the same multiset)."""
+    _ensure_x64()
+    nl = int(len(l_vals))
+    if r_resident is not None:
+        r_dev, nr = r_resident
+        nr = int(nr)
+        nr_pad = int(r_dev.shape[0])
+    else:
+        nr = int(len(r_vals))
+        nr_pad = pad_bucket(max(nr, 1))
+    empty = np.empty(0, np.int64)
+    if nl + nr == 0:
         return empty, empty
-
-    starts = np.flatnonzero(
-        np.concatenate([[True], s_code[1:] != s_code[:-1]]))
-    run_len = np.diff(np.concatenate([starts, [m]]))
-    n_r = np.add.reduceat(s_side, starts).astype(np.int64)
-    n_l = run_len - n_r
-
-    pairs = n_l * n_r
-    total = int(pairs.sum())
-    run_of = np.repeat(np.arange(len(starts)), pairs)
-    off = np.concatenate([[0], np.cumsum(pairs)[:-1]])
-    within = np.arange(total, dtype=np.int64) - off[run_of]
-    nr_run = n_r[run_of]
-    li = within // nr_run
-    ri = within - li * nr_run
-    l_idx = s_pos[starts[run_of] + li]
-    r_idx = s_pos[starts[run_of] + n_l[run_of] + ri] - nl
-
-    extras_l = extras_r = None
-    if how != "inner":
-        run_of_sorted = np.repeat(np.arange(len(starts)), run_len)
-    if how in ("left", "outer"):
-        sel = (n_r[run_of_sorted] == 0) & (s_side == 0)
-        extras_l = s_pos[sel]
-    if how in ("right", "outer"):
-        sel = (n_l[run_of_sorted] == 0) & (s_side == 1)
-        extras_r = s_pos[sel] - nl
-    if extras_l is not None and len(extras_l):
-        l_idx = np.concatenate([l_idx, extras_l])
-        r_idx = np.concatenate([r_idx, np.full(len(extras_l), -1,
-                                               np.int64)])
-    if extras_r is not None and len(extras_r):
-        l_idx = np.concatenate([l_idx, np.full(len(extras_r), -1,
-                                               np.int64)])
-        r_idx = np.concatenate([r_idx, extras_r])
-    return l_idx.astype(np.int64), r_idx.astype(np.int64)
+    nl_pad = pad_bucket(max(nl, 1))
+    lp = np.zeros(nl_pad, np.int64)
+    lp[:nl] = np.asarray(l_vals, np.int64)
+    with obs.device_dispatch("sqlops.join_lanes",
+                             key=(nl_pad, nr_pad),
+                             budget="sql-join-values", units=nl_pad,
+                             gate="sql") as dd:
+        dd.h2d("lp", lp)
+        l_dev = jax.device_put(lp, device)
+        if r_resident is None:
+            rp = np.zeros(nr_pad, np.int64)
+            rp[:nr] = np.asarray(r_vals, np.int64)
+            dd.h2d("rp", rp, units=nr_pad)
+            r_dev = jax.device_put(rp, device)
+        s_pad, s_val, s_side, s_pos = (
+            np.asarray(a) for a in _join_lanes_kernel(
+                l_dev, r_dev, jnp.int64(nl), jnp.int64(nr)))
+    real = s_pad == 0
+    return _expand_pairs(s_val[real], s_side[real], s_pos[real],
+                         nl_pad, how)
 
 
 # --------------------------------------------------------- windows ----
@@ -363,8 +570,13 @@ def window_ranks(pb: np.ndarray, kb: np.ndarray, device=None):
     kbp = np.ones(npad, bool)
     pbp[:n] = pb
     kbp[:n] = kb | pb
-    rn, rk, dr = _ranks_kernel(jax.device_put(pbp, device),
-                               jax.device_put(kbp, device))
+    with obs.device_dispatch("sqlops.window_ranks", key=(npad,),
+                             budget="sql-window-ranks", units=npad,
+                             gate="sql") as dd:
+        dd.h2d("pbp", pbp)
+        dd.h2d("kbp", kbp)
+        rn, rk, dr = _ranks_kernel(jax.device_put(pbp, device),
+                                   jax.device_put(kbp, device))
     return (np.asarray(rn)[:n], np.asarray(rk)[:n],
             np.asarray(dr)[:n])
 
@@ -427,9 +639,15 @@ def window_running(v: np.ndarray, valid: np.ndarray, pb: np.ndarray,
     mp[:n] = valid
     pbp = np.ones(npad, bool)
     pbp[:n] = pb
-    out, cnt = _segscan_kernel(jax.device_put(vp, device),
-                               jax.device_put(mp, device),
-                               jax.device_put(pbp, device), op=op)
+    with obs.device_dispatch("sqlops.window_running", key=(npad,),
+                             budget="sql-window-running", units=npad,
+                             gate="sql") as dd:
+        dd.h2d("vp", vp)
+        dd.h2d("mp", mp)
+        dd.h2d("pbp", pbp)
+        out, cnt = _segscan_kernel(jax.device_put(vp, device),
+                                   jax.device_put(mp, device),
+                                   jax.device_put(pbp, device), op=op)
     return np.asarray(out)[:n], np.asarray(cnt)[:n]
 
 
@@ -464,7 +682,13 @@ def window_peer_last(vals: np.ndarray, counts: np.ndarray,
     kbp = np.ones(npad, bool)
     kbp[:n] = kb if pb is None else (np.asarray(kb) | np.asarray(pb))
     kbp[0] = True
-    v_out, c_out = _peer_last_kernel(jax.device_put(vp, device),
-                                     jax.device_put(cp, device),
-                                     jax.device_put(kbp, device))
+    with obs.device_dispatch("sqlops.window_peer_last", key=(npad,),
+                             budget="sql-window-peers", units=npad,
+                             gate="sql") as dd:
+        dd.h2d("vp", vp)
+        dd.h2d("cp", cp)
+        dd.h2d("kbp", kbp)
+        v_out, c_out = _peer_last_kernel(jax.device_put(vp, device),
+                                         jax.device_put(cp, device),
+                                         jax.device_put(kbp, device))
     return np.asarray(v_out)[:n], np.asarray(c_out)[:n]

@@ -33,6 +33,8 @@ profitable" so behavior is deterministic. Env overrides:
                                0|off -> host numpy twin (skip_route)
   DELTA_TPU_DEVICE_DECODE      force|1|on -> device checkpoint page
                                decode, 0|off -> Arrow (decode_route)
+  DELTA_TPU_DEVICE_SQL         force|1|on -> device SQL operators,
+                               0|off -> host pandas (sql_route)
 """
 
 from __future__ import annotations
@@ -86,6 +88,17 @@ _DEVICE_DECODE_BPS = 3e9
 # per-scan device cost is one RTT plus the compute).
 _HOST_SKIP_CELLS_PS = 50e6
 _DEVICE_SKIP_CELLS_PS = 5e9
+
+# SQL operator routing estimates in rows/s, per operator class. The
+# host numbers are pandas on one vCPU (merge is hash-probe bound,
+# groupby is hash-agg bound, sort_values is comparison bound); the
+# device numbers are the `ops/sqlops.py` kernels, whose sorts and
+# segment reductions are memory-bound. As with the other gates only
+# the crossover's order of magnitude matters — the dominant real-world
+# term is the link (`h2d_seconds` over the operand bytes), which is
+# what keeps SQL on host across a slow tunnel and on device locally.
+_HOST_SQL_ROWS_PS = {"join": 8e6, "group-agg": 20e6, "sort": 15e6}
+_DEVICE_SQL_ROWS_PS = {"join": 120e6, "group-agg": 300e6, "sort": 150e6}
 
 
 class LinkModel(NamedTuple):
@@ -222,6 +235,10 @@ ROUTES: Dict[str, RouteSpec] = {
         env="DELTA_TPU_DEVICE_SKIP",
         fallback_counter="scan.device_fallbacks",
         doc_anchor="device-scan-planning"),
+    "sql": RouteSpec(
+        env="DELTA_TPU_DEVICE_SQL",
+        fallback_counter="sql.device_fallbacks",
+        doc_anchor="device-sql-execution"),
 }
 
 
@@ -345,6 +362,53 @@ def decode_route(
     t_device = model.h2d_seconds(nbytes) + nbytes / _DEVICE_DECODE_BPS
     predicted = {"host": t_host, "device": t_device}
     return _decide("decode", "device" if t_device < t_host else "host",
+                   inputs, predicted)
+
+
+def sql_route(
+    op: str,
+    n_rows: int,
+    nbytes: int = 0,
+    engine_enabled: bool = False,
+    forced: Optional[str] = None,
+    probe_failed: bool = False,
+) -> str:
+    """Pick the route for one SQL operator: "host" (the pandas
+    executor, the bit-exact parity oracle) or "device" (the
+    `ops/sqlops.py` kernels behind `sqlengine/device.py::DeviceSpine`).
+
+    `op` is the operator class ("join" | "group-agg" | "sort"; the
+    per-query spine resolution uses "query" with the join economics).
+    `nbytes` is the operand bytes that must cross the link for this
+    operator — rows already HBM-resident via the operand cache
+    (`sqlengine/operands.py`) are excluded by the caller, which is how
+    a warm cache shifts the crossover toward the device. Like
+    `parse_route`, the device route needs the engine's opt-in
+    (`use_device_sql`, true on TpuEngine) before the economics run;
+    `probe_failed` marks a broken link probe (the decision record says
+    so instead of a spine silently resolving to None).
+    DELTA_TPU_DEVICE_SQL outranks everything (tests, bench lanes)."""
+    inputs = {"op": op, "n_rows": n_rows, "nbytes": nbytes,
+              "engine_enabled": engine_enabled}
+    env = os.environ.get("DELTA_TPU_DEVICE_SQL")
+    if env is not None and env != "":
+        if env.lower() in ("force", "1", "on", "device"):
+            return _decide("sql", "device", inputs, reason="env")
+        if env.lower() in ("0", "off", "host"):
+            return _decide("sql", "host", inputs, reason="env")
+    if probe_failed:
+        return _decide("sql", "host", inputs, reason="probe-failed")
+    if forced in ("host", "device"):
+        return _decide("sql", forced, inputs, reason="forced")
+    if not engine_enabled or n_rows <= 0:
+        return _decide("sql", "host", inputs, reason="engine-disabled")
+    model = link_model()
+    rate_h = _HOST_SQL_ROWS_PS.get(op, _HOST_SQL_ROWS_PS["join"])
+    rate_d = _DEVICE_SQL_ROWS_PS.get(op, _DEVICE_SQL_ROWS_PS["join"])
+    t_host = n_rows / rate_h
+    t_device = model.h2d_seconds(nbytes) + n_rows / rate_d
+    predicted = {"host": t_host, "device": t_device}
+    return _decide("sql", "device" if t_device < t_host else "host",
                    inputs, predicted)
 
 

@@ -329,6 +329,9 @@ def touch_snapshot_resident(snapshot) -> None:
     stats_index = getattr(state, "stats_index", None)
     if stats_index is not None:
         stats_index._hbm.touch()
+    operand_cache = getattr(state, "operand_cache", None)
+    if operand_cache is not None:
+        operand_cache._hbm.touch()
 
 
 def release_snapshot_resident(snapshot) -> None:
@@ -346,3 +349,9 @@ def release_snapshot_resident(snapshot) -> None:
     if stats_index is not None:
         stats_index.release()
         state.stats_index = None
+    # the SQL operand cache (sqlengine/operands.py) shares the same
+    # lifecycle: evicting the snapshot frees its column lanes too
+    operand_cache = getattr(state, "operand_cache", None)
+    if operand_cache is not None:
+        operand_cache.release()
+        state.operand_cache = None

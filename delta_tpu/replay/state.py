@@ -72,6 +72,13 @@ class SnapshotState:
     # releases it through `release_snapshot_resident`.
     stats_index: Optional[object] = field(default=None, repr=False,
                                           compare=False)
+    # Resident SQL operand cache (sqlengine/operands.py): per-column
+    # device lanes for join/group keys, built lazily per state under
+    # `_operand_cache_lock`. `advance_state` carries it forward on
+    # empty deltas and releases it otherwise; serve-cache eviction
+    # releases it through `release_snapshot_resident`.
+    operand_cache: Optional[object] = field(default=None, repr=False,
+                                            compare=False)
     # Table root this state was reconstructed from — threaded into the
     # HBM resident ledger so lazily built device artifacts (stats-index
     # lanes, replay key lanes grown on advance) attribute to the right
@@ -84,6 +91,8 @@ class SnapshotState:
                                  repr=False, compare=False)
     _stats_index_lock: object = field(default_factory=threading.Lock,
                                       repr=False, compare=False)
+    _operand_cache_lock: object = field(default_factory=threading.Lock,
+                                        repr=False, compare=False)
 
     @property
     def file_actions(self) -> pa.Table:
@@ -549,6 +558,19 @@ def advance_state(
             # new state rebuilds lazily)
             stats_index.release()
             prev.stats_index = None
+    operand_cache = prev.operand_cache
+    if operand_cache is not None:
+        if m == 0:
+            # empty delta: table content unchanged, the cached operand
+            # lanes are still exact — ownership moves like `resident`
+            new_state.operand_cache = operand_cache
+            prev.operand_cache = None
+        else:
+            # version advance invalidates the per-(table, version,
+            # column) artifacts; free the HBM now, the next device SQL
+            # query over the new state re-uploads lazily
+            operand_cache.release()
+            prev.operand_cache = None
     return new_state
 
 

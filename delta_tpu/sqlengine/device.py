@@ -23,10 +23,24 @@ device."""
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
 import pandas as pd
+
+from delta_tpu import obs
+from delta_tpu.obs.device import gate_fell_back
+from delta_tpu.parallel.gate import sql_route
+
+_log = logging.getLogger(__name__)
+
+# route-contract instruments: the fallback counter bumps whenever the
+# gate chose "device" but an operator input forced the pandas path
+# mid-flight; device_queries counts queries that resolved to the spine
+_FALLBACKS = obs.counter("sql.device_fallbacks")
+_QUERIES = obs.counter("sql.device_queries")
 
 sqlops = None  # set on first DeviceSpine construction (defers jax)
 
@@ -91,13 +105,77 @@ def _series_values(s: pd.Series):
     return None, None, None
 
 
+def _int64_lane(s: pd.Series) -> Optional[np.ndarray]:
+    """Probe-side join key as a raw int64 lane (the dtypes
+    `sqlengine/operands.py::_encode_column` caches as kind 'int').
+    None -> the lane join can't apply; callers fall through to the
+    joint-factorize path."""
+    v = s.to_numpy()
+    if v.dtype.kind in "ui" or v.dtype == bool:
+        return v.astype(np.int64, copy=False)
+    if v.dtype.kind == "M":
+        return v.astype("datetime64[ns]").view(np.int64)
+    if v.dtype.kind == "f":
+        # nullable integer keys arrive from arrow as float64; the
+        # null-key exclusion already dropped the NaNs, so an integral
+        # remainder maps exactly onto the int64 domain (bounded to the
+        # float64-exact range)
+        if len(v) and (not np.isfinite(v).all()
+                       or (v != np.floor(v)).any()
+                       or np.abs(v).max() >= 2 ** 53):
+            return None
+        return v.astype(np.int64)
+    if str(s.dtype) in ("Int64", "Int32", "boolean"):
+        if s.isna().any():
+            return None
+        return s.to_numpy(np.int64)
+    return None
+
+
 class DeviceSpine:
-    """Per-query device routing. Stateless beyond the jit caches the
-    kernels own; cheap to construct."""
+    """Per-query device routing plus source-frame provenance: the
+    executor registers each full-table materialized frame here
+    (`register_source`), so joins whose build side is such a frame can
+    consume the snapshot's resident operand cache instead of
+    re-shipping key lanes. Each operator entry point resolves through
+    `parallel/gate.py::sql_route` with its real operand sizes; a "host"
+    verdict returns None and the executor keeps its pandas path."""
 
     def __init__(self, device=None):
         _load_sqlops()
         self.device = device
+        # id(frame) -> (frame strong-ref, ResidentOperandCache,
+        #               {qualified column -> raw column}); per-query, so
+        # ids can't be recycled out from under us
+        self._sources: dict = {}
+
+    def register_source(self, frame: pd.DataFrame, state) -> None:
+        """Record that `frame` is a full, unfiltered materialization of
+        the snapshot whose loaded state is `state` (columns already
+        alias-qualified). Only such frames may consume the per-version
+        operand cache — a filtered frame's rows no longer align with
+        the cached full-column lanes."""
+        from delta_tpu.sqlengine.operands import snapshot_operand_cache
+
+        cache = snapshot_operand_cache(state)
+        if cache is None:
+            return
+        colmap = {c: c.split(".", 1)[1] for c in frame.columns
+                  if isinstance(c, str) and "." in c}
+        self._sources[id(frame)] = (frame, cache, colmap)
+
+    def _route(self, op: str, n_rows: int, nbytes: int) -> bool:
+        return sql_route(op, n_rows, nbytes,
+                         engine_enabled=True) == "device"
+
+    @staticmethod
+    def _fell_back(reason: str) -> None:
+        """The gate chose device but this operator's inputs forced the
+        pandas path mid-flight. Returns None so callers can
+        `return self._fell_back(...)`."""
+        _FALLBACKS.inc()
+        gate_fell_back("sql", "host", reason)
+        return None
 
     # ------------------------------------------------------ group-by --
 
@@ -109,21 +187,25 @@ class DeviceSpine:
         an input needs the fallback."""
         if not names or not agg_specs:
             return None
+        n = len(work)
+        # operand estimate: int32 codes + ~(8B values + 1B valid) per agg
+        if not self._route("group-agg", n, (4 + 9 * len(agg_specs)) * n):
+            return None
         plans = []
         for k, f in agg_specs.items():
             if f.name not in _SUPPORTED_AGGS:
-                return None
+                return self._fell_back(f"unsupported-agg:{f.name}")
             if f.star:
                 plans.append((k, f, None, None, None))
                 continue
             v, valid, kind = _series_values(work[f"__arg_{k}"])
             if kind is None:
-                return None
+                return self._fell_back("unsupported-agg-dtype")
             if f.name in ("sum", "avg", "stddev_samp", "var_samp") \
                     and kind == "datetime":
-                return None
+                return self._fell_back("datetime-sum")
             if f.distinct and f.name != "count":
-                return None
+                return self._fell_back("distinct-non-count")
             plans.append((k, f, v, valid, kind))
 
         key_vals = [work[n].to_numpy() for n in names]
@@ -183,16 +265,80 @@ class DeviceSpine:
     # --------------------------------------------------------- joins --
 
     def merge(self, left: pd.DataFrame, right: pd.DataFrame, how: str,
-              lk: List[str], rk: List[str]) -> pd.DataFrame:
+              lk: List[str], rk: List[str],
+              right_origin: Optional[pd.DataFrame] = None
+              ) -> Optional[pd.DataFrame]:
         """Equi-join with pandas-merge output shape (all columns of
         both frames). Callers guarantee null-free keys (SQL null-key
-        exclusion happens in `_merge_null_safe`)."""
+        exclusion happens in `_merge_null_safe`). None -> the route
+        chose the host merge.
+
+        When the build side traces to a registered source frame
+        (`right` itself, or `right_origin` when the caller's null-key
+        exclusion derived `right` from it) and the join has one key,
+        the snapshot's resident operand cache supplies the build lane
+        — a warm cache ships only the probe side, and the route sees
+        those bytes as already paid. Lane/frame alignment holds across
+        queries because the single-key null-drop is deterministic:
+        `right` is always "origin rows minus the key column's nulls",
+        and the lane caches exactly that remainder."""
         n_l, n_r = len(left), len(right)
+        cache = raw = None
+        if len(rk) == 1:
+            src = self._sources.get(
+                id(right) if right_origin is None else id(right_origin))
+            if src is not None:
+                _frame, cache, colmap = src
+                raw = colmap.get(rk[0])
+                if raw is None:
+                    cache = None
+        hot = cache is not None and cache.peek(raw) is not None
+        nbytes = 8 * n_l + (0 if hot else 8 * n_r)
+        if not self._route("join", n_l + n_r, nbytes):
+            return None
+        if cache is not None:
+            lane = cache.join_lane(raw, right[rk[0]])
+            if lane is not None:
+                out = self._merge_lanes(left, right, how, lk[0], lane)
+                if out is not None:
+                    return out
         codes, _ = _joint_codes([
             np.concatenate([left[a].to_numpy(), right[b].to_numpy()])
             for a, b in zip(lk, rk)])
         l_idx, r_idx = sqlops.join_pairs(codes[:n_l], codes[n_l:],
                                          how=how, device=self.device)
+        return self._gather(left, right, how, l_idx, r_idx)
+
+    def _merge_lanes(self, left: pd.DataFrame, right: pd.DataFrame,
+                     how: str, lcol: str, lane) -> Optional[pd.DataFrame]:
+        """Join `left[lcol]` against a resident build lane. The probe
+        side encodes host-side to the lane's int64 domain; None when it
+        can't (dtype mismatch) and the caller re-joins via the joint
+        factorize path."""
+        if lane.kind == "codes":
+            lv = left[lcol].to_numpy()
+            if lv.dtype.kind not in "OUS":
+                return None
+            probe = lane.dictionary.get_indexer(lv)
+            # probe values absent from the build dictionary can never
+            # match: remap the -1 misses past every real code (the pad
+            # sentinel stays reserved for padding)
+            l_vals = np.where(probe < 0, len(lane.dictionary),
+                              probe).astype(np.int64)
+        else:
+            l_vals = _int64_lane(left[lcol])
+            if l_vals is None:
+                return None
+        l_idx, r_idx = sqlops.join_pairs_lanes(
+            l_vals, r_resident=(lane.dev, lane.n), how=how,
+            device=self.device)
+        return self._gather(left, right, how, l_idx, r_idx)
+
+    @staticmethod
+    def _gather(left: pd.DataFrame, right: pd.DataFrame, how: str,
+                l_idx: np.ndarray, r_idx: np.ndarray) -> pd.DataFrame:
+        """Reconstruct the pandas-merge-shaped output from matched row
+        index pairs (-1 = null-extended side)."""
         lpart = left.take(np.where(l_idx >= 0, l_idx, 0)) \
             .reset_index(drop=True)
         rpart = right.take(np.where(r_idx >= 0, r_idx, 0)) \
@@ -242,11 +388,15 @@ class DeviceSpine:
         sort_values). None -> fallback."""
         if not len(frame):
             return frame
+        n = len(frame)
+        # per key: 8B value lane + 1B null lane; + 8B result iota
+        if not self._route("sort", n, (9 * len(cols) + 8) * n):
+            return None
         lanes = []
         for c, asc in zip(cols, ascs):
             ln = self._order_lanes(frame[c], asc)
             if ln is None:
-                return None
+                return self._fell_back("unsupported-sort-dtype")
             lanes.extend(ln)
         perm = sqlops.sort_permutation(lanes, device=self.device)
         return frame.iloc[perm]
@@ -257,9 +407,12 @@ class DeviceSpine:
                             fn: str) -> Optional[pd.Series]:
         """groupby(parts).transform(fn) on device: aggregate per
         partition, broadcast back by group code."""
+        # int32 codes + 8B values + 1B valid per row
+        if not self._route("group-agg", len(s), 13 * len(s)):
+            return None
         v, valid, kind = _series_values(s)
         if kind is None or (kind == "datetime" and fn in ("sum", "mean")):
-            return None
+            return self._fell_back("unsupported-window-agg")
         codes, n_groups = _joint_codes([p.to_numpy() for p in parts])
         if n_groups == 0:
             return pd.Series([], dtype=float, index=s.index)
@@ -318,9 +471,12 @@ class DeviceSpine:
                     index) -> Optional[pd.Series]:
         if n == 0:
             return pd.Series(np.empty(0, np.int64), index=index)
+        nkeys = len(parts) + len(order_items)
+        if not self._route("sort", n, (9 * max(nkeys, 1) + 8) * n):
+            return None
         pre = self._window_order(parts, order_items, n)
         if pre is None:
-            return None
+            return self._fell_back("unsupported-sort-dtype")
         perm, pb, kb = pre
         rn, rk, dr = sqlops.window_ranks(pb, kb, device=self.device)
         picked = {"row_number": rn, "rank": rk, "dense_rank": dr}[which]
@@ -334,15 +490,18 @@ class DeviceSpine:
         """Running sum/mean/min/max/count with the SQL default frame;
         `frame_kind` 'range' shares values across order-key peers,
         'rows' does not."""
-        v, valid, kind = _series_values(s)
-        if kind is None or kind == "datetime":
-            return None
         n = len(s)
         if n == 0:
             return pd.Series(np.empty(0, np.float64), index=index)
+        nkeys = len(parts) + len(order_items)
+        if not self._route("sort", n, (9 * max(nkeys, 1) + 17) * n):
+            return None
+        v, valid, kind = _series_values(s)
+        if kind is None or kind == "datetime":
+            return self._fell_back("unsupported-window-agg")
         pre = self._window_order(parts, order_items, n)
         if pre is None:
-            return None
+            return self._fell_back("unsupported-sort-dtype")
         perm, pb, kb = pre
         vals, cnts = sqlops.window_running(
             np.asarray(v, np.float64)[perm], valid[perm], pb, fn,
@@ -367,8 +526,6 @@ def _link_supports_sql_offload() -> bool:
     size). Auto-engage only when the device is locally attached: the
     CPU backend (tests' virtual mesh; transfers are memcpy) or a real
     PCIe/ICI TPU. The axon tunnel platform is the measured exception."""
-    import os
-
     try:
         import jax
 
@@ -385,30 +542,27 @@ def _link_supports_sql_offload() -> bool:
 
             active = xb.get_backend()
             return xb.backends().get("axon") is not active
-        # delta-lint: disable=except-swallow (audited: probing a private
-        # jax registry API — any drift falls back to the launch-marker
-        # env, per the comment above)
-        except Exception:
+        except (ImportError, AttributeError, KeyError,
+                RuntimeError) as e:
+            # private jax registry API drifted: conservative fallback
+            # to the tunnel launch-marker env, per the comment above
+            _log.debug("axon backend probe failed (%s: %s); using "
+                       "launch-marker fallback", type(e).__name__, e)
             return not os.environ.get("PALLAS_AXON_POOL_IPS")
-    # delta-lint: disable=except-swallow (audited: no usable jax backend
-    # at all — offload is simply unavailable)
-    except Exception:
+    except (ImportError, RuntimeError) as e:
+        # no usable jax backend at all — offload is simply unavailable
+        _log.debug("device backend unavailable for SQL offload "
+                   "(%s: %s)", type(e).__name__, e)
         return False
 
 
 def spine_for(engine, catalog=None) -> Optional[DeviceSpine]:
-    """Resolve whether this query runs the device spine.
-    DELTA_TPU_DEVICE_SQL=0 forces host pandas; =1 forces the device
-    path regardless of engine/link; otherwise the engine's
-    `use_device_sql` attribute (TpuEngine: on) AND the link gate
-    decide."""
-    import os
-
-    flag = os.environ.get("DELTA_TPU_DEVICE_SQL", "")
-    if flag == "0":
-        return None
-    if flag == "1":
-        return DeviceSpine()
+    """Resolve whether this query runs the device spine, through the
+    route gate (`parallel/gate.py::sql_route`, op "query"): the
+    DELTA_TPU_DEVICE_SQL override outranks everything, then a failed
+    link probe forces host — recorded as a `probe-failed` gate
+    decision, never a silent None — then the engine's `use_device_sql`
+    opt-in (TpuEngine: on) and the link economics decide."""
     eng = engine
     if eng is None and catalog is not None:
         eng = getattr(catalog, "engine", None)
@@ -417,7 +571,11 @@ def spine_for(engine, catalog=None) -> Optional[DeviceSpine]:
         # (TpuEngine) — the spine decision must mirror that
         use = True
     else:
-        use = getattr(eng, "use_device_sql", False)
-    if use and not _link_supports_sql_offload():
+        use = bool(getattr(eng, "use_device_sql", False))
+    probe_failed = use and not _link_supports_sql_offload()
+    route = sql_route("query", 1, 0, engine_enabled=use,
+                      probe_failed=probe_failed)
+    if route != "device":
         return None
-    return DeviceSpine() if use else None
+    _QUERIES.inc()
+    return DeviceSpine()

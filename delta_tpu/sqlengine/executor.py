@@ -278,17 +278,38 @@ def _merge_null_safe(left: pd.DataFrame, right: pd.DataFrame, how: str,
     sides preserved by `how` get them re-appended null-extended.
     With a DeviceSpine the match itself runs on the device join
     kernel; the null-key bookkeeping stays identical."""
+    from delta_tpu.obs.device import gate_observation
+
     lnull = left[lk].isna().any(axis=1)
     rnull = right[rk].isna().any(axis=1)
     if not lnull.any() and not rnull.any():  # hot path: no copies
         if spine is not None:
-            return spine.merge(left, right, how, lk, rk)
+            merged = spine.merge(left, right, how, lk, rk)
+            if merged is not None:
+                return merged
+            # the gate routed this join to host: run the pandas merge
+            # under the observation scope so its cost joins the
+            # decision record for calibration
+            with gate_observation("sql", "host"):
+                return left.merge(right, how=how, left_on=lk,
+                                  right_on=rk)
         return left.merge(right, how=how, left_on=lk, right_on=rk)
-    lm, rm = left[~lnull], right[~rnull]
-    if spine is not None:
-        merged = spine.merge(lm, rm, how, lk, rk)
-    else:
-        merged = lm.merge(rm, how=how, left_on=lk, right_on=rk)
+    # keep the original object when a side is already null-free (the
+    # spine's operand-cache lookup keys on frame identity), and pass
+    # the pre-exclusion right as provenance: for a single-key join the
+    # null-drop is exactly "rows minus that column's nulls", so the
+    # cached lane built from one query's rm aligns with every other
+    # query's rm
+    lm = left if not lnull.any() else left[~lnull]
+    rm = right if not rnull.any() else right[~rnull]
+    merged = spine.merge(lm, rm, how, lk, rk, right_origin=right) \
+        if spine is not None else None
+    if merged is None:
+        if spine is not None:
+            with gate_observation("sql", "host"):
+                merged = lm.merge(rm, how=how, left_on=lk, right_on=rk)
+        else:
+            merged = lm.merge(rm, how=how, left_on=lk, right_on=rk)
     extra = []
     if how in ("left", "outer") and lnull.any():
         extra.append(left[lnull])
@@ -514,6 +535,7 @@ class _Exec:
             # in table order
             cols = [c for c in s["cols"] if c in needed[s["alias"]]] \
                 or s["cols"][:1]
+            full_rows = filt is None
             try:
                 arrow = s["snap"].scan(filter=filt,
                                        columns=cols).to_arrow()
@@ -524,10 +546,18 @@ class _Exec:
                 # executor's coercions
                 arrow = s["snap"].scan(filter=None,
                                        columns=cols).to_arrow()
+                full_rows = True
             df = arrow.to_pandas()
             df = _normalize_frame(df)
             df.columns = [f"{s['alias']}.{c}" for c in df.columns]
             s["frame"] = df
+            if full_rows and self.spine is not None:
+                # full-table materialization: eligible for the
+                # snapshot's resident operand cache (the scan above
+                # already loaded the state)
+                state = getattr(s["snap"], "_state", None)
+                if state is not None:
+                    self.spine.register_source(df, state)
 
         # ---- joins ----------------------------------------------------
         implicit = [s["alias"] for s in sources

@@ -51,6 +51,9 @@ _DEFAULT_MODULES = (
     "delta_tpu/parallel/sharded_replay.py",
     "delta_tpu/parallel/sharded_blockwise.py",
     "delta_tpu/stats/device_index.py",
+    "delta_tpu/ops/sqlops.py",
+    "delta_tpu/ops/join.py",
+    "delta_tpu/sqlengine/operands.py",
 )
 
 # Transfer helpers invoked from inside a caller's open funnel: the

@@ -2356,13 +2356,13 @@ def test_route_contract_silent_without_gate_module(monkeypatch):
     assert not report.findings
 
 
-def test_route_registry_covers_all_four_routes():
-    """The live registry names the four shipped routes and every env
+def test_route_registry_covers_all_routes():
+    """The live registry names the five shipped routes and every env
     override is mirrored into the capture-conditions stamp."""
     from delta_tpu.obs.device import CAPTURE_ENV_KEYS
     from delta_tpu.parallel.gate import ROUTES
 
-    assert set(ROUTES) == {"replay", "parse", "decode", "skip"}
+    assert set(ROUTES) == {"replay", "parse", "decode", "skip", "sql"}
     for spec in ROUTES.values():
         assert spec.env in CAPTURE_ENV_KEYS
 

@@ -1,0 +1,275 @@
+"""Resident SQL operand cache lifecycle (`sqlengine/operands.py`):
+build-once across repeated queries (upload-dispatch count pinned under
+strict device obs), invalidation on version advance, ledger release on
+serve-cache eviction (strict audit clean, like test_hbm_ledger.py),
+device-vs-host TPC-DS parity with the cache forced hot and forced
+cold, and host-parity of the sharded segment-reduce fan-out."""
+
+import gc
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import delta_tpu.api as dta
+from delta_tpu import obs
+from delta_tpu.obs import hbm
+
+
+@pytest.fixture(autouse=True)
+def _strict_obs():
+    """Strict on both planes: the transfer-budget audit raises on any
+    unbudgeted upload, the ledger audit on any drift or leak."""
+    obs.reset_hbm_obs()
+    obs.set_hbm_obs_mode("strict")
+    obs.set_device_obs_mode("strict")
+    obs.reset_device_obs()
+    yield
+    obs.set_device_obs_mode(None)
+    obs.reset_device_obs()
+    obs.set_hbm_obs_mode(None)
+    obs.reset_hbm_obs()
+
+
+def _counter(name):
+    return obs.counter(name).value
+
+
+def _upload_dispatches():
+    return sum(1 for r in obs.get_dispatch_records()
+               if r["kernel"] == "sql.operand_upload")
+
+
+def _star_catalog(root, n_fact=400, n_dim=50):
+    """A tiny star schema behind a catalog: the catalog's Table
+    instance cache is what lets a second query reach the same
+    SnapshotState (and therefore a warm operand cache)."""
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.engine.tpu import TpuEngine
+
+    rng = np.random.default_rng(11)
+    dim = pa.table({
+        "k": pa.array(np.arange(n_dim, dtype=np.int64)),
+        "name": pa.array([f"n{i % 7}" for i in range(n_dim)]),
+    })
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, n_dim, n_fact).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, n_fact).astype(np.int64)),
+    })
+    dta.write_table(f"{root}/dim", dim)
+    dta.write_table(f"{root}/fact", fact)
+    cat = Catalog(str(root), engine=TpuEngine())
+    cat.register("dim", f"{root}/dim")
+    cat.register("fact", f"{root}/fact")
+    return cat
+
+
+_STAR_Q = ("SELECT d.name, sum(f.v) AS s FROM fact f JOIN dim d "
+           "ON f.fk = d.k GROUP BY d.name ORDER BY d.name")
+
+
+def _rows(tbl):
+    out = list(zip(*(c.to_pylist() for c in tbl.columns))) \
+        if tbl.num_columns else []
+    if tbl.num_rows and not out:
+        out = [()] * tbl.num_rows
+    return sorted(out, key=repr)
+
+
+# ------------------------------------------------- build-once ----------
+
+
+def test_build_once_over_n_queries(tmp_path):
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.sqlengine import execute_select
+
+    cat = _star_catalog(tmp_path)
+    h0, m0 = _counter("sql.operand_cache_hits"), \
+        _counter("sql.operand_cache_misses")
+    first = execute_select(_STAR_Q, catalog=cat)
+    miss_cold = _counter("sql.operand_cache_misses") - m0
+    assert miss_cold >= 1                      # dim.k uploaded
+    assert _counter("sql.operand_cache_hits") == h0
+    uploads_after_first = _upload_dispatches()
+    assert uploads_after_first >= 1
+
+    for _ in range(2):
+        again = execute_select(_STAR_Q, catalog=cat)
+        assert _rows(again) == _rows(first)
+    # two warm queries: only hits, no new misses, and — the pinned
+    # invariant — not one additional upload dispatch
+    assert _counter("sql.operand_cache_hits") - h0 >= 2
+    assert _counter("sql.operand_cache_misses") - m0 == miss_cold
+    assert _upload_dispatches() == uploads_after_first
+
+    assert hbm.ledger().kind_bytes(hbm.KIND_SQL_OPERANDS) > 0
+    assert obs.gauge("sql.operand_cache_bytes").read() == \
+        hbm.ledger().kind_bytes(hbm.KIND_SQL_OPERANDS)
+    assert hbm.audit()["ok"]
+
+    host = Catalog(str(tmp_path), engine=HostEngine())
+    assert _rows(execute_select(_STAR_Q, catalog=host)) == _rows(first)
+
+
+# ------------------------------------------- version invalidation ------
+
+
+def test_invalidation_on_version_advance(tmp_path):
+    from delta_tpu.sqlengine import execute_select
+
+    cat = _star_catalog(tmp_path, n_dim=50)
+    first = execute_select(_STAR_Q, catalog=cat)
+    state1 = cat.table("dim").latest_snapshot()._state
+    oc1 = state1.operand_cache
+    assert oc1 is not None and oc1.resident_bytes() > 0
+    assert hbm.ledger().kind_bytes(hbm.KIND_SQL_OPERANDS) > 0
+
+    # version advance with a real delta: every cached lane is stale
+    dta.write_table(f"{tmp_path}/dim", pa.table({
+        "k": pa.array(np.arange(50, 60, dtype=np.int64)),
+        "name": pa.array(["zz"] * 10),
+    }))
+    snap2 = cat.table("dim").update()
+    assert oc1.released
+    assert getattr(snap2._state, "operand_cache", None) is not oc1
+    assert hbm.audit()["ok"]
+
+    m0 = _counter("sql.operand_cache_misses")
+    second = execute_select(_STAR_Q, catalog=cat)
+    assert _counter("sql.operand_cache_misses") - m0 >= 1  # re-upload
+    # the new rows join nothing (no fact rows point at k>=50), so the
+    # aggregate answer is unchanged — but it must come from the NEW
+    # version's lanes, which the re-upload proves
+    assert _rows(second) == _rows(first)
+    assert hbm.audit()["ok"]
+
+
+def test_empty_delta_carries_cache(tmp_path):
+    """`Table.update()` with no new commits must keep the warm cache
+    (the stats-index carry rule, applied to operand lanes)."""
+    from delta_tpu.sqlengine import execute_select
+
+    cat = _star_catalog(tmp_path)
+    execute_select(_STAR_Q, catalog=cat)
+    t = cat.table("dim")
+    oc = t.latest_snapshot()._state.operand_cache
+    assert oc is not None and not oc.released
+    snap2 = t.update()                          # no new version
+    assert snap2._state.operand_cache is oc
+    assert not oc.released
+
+
+# ---------------------------------------- serve-cache eviction ---------
+
+
+def test_serve_cache_eviction_releases_ledger(tmp_path):
+    from delta_tpu.engine.tpu import TpuEngine
+    from delta_tpu.serve.cache import SnapshotCache
+    from delta_tpu.serve.config import ServeConfig
+    from delta_tpu.sqlengine.operands import snapshot_operand_cache
+
+    for name in ("t1", "t2"):
+        dta.write_table(f"{tmp_path}/{name}", pa.table({
+            "k": pa.array(np.arange(64, dtype=np.int64))}))
+    obs.reset_hbm_obs()                          # writer-side residue
+    cache = SnapshotCache(TpuEngine(), ServeConfig(cache_tables=1,
+                                                   refresh_ms=60_000.0))
+    snap, _ = cache.snapshot_for(f"{tmp_path}/t1")
+    oc = snapshot_operand_cache(snap.state)  # force the lazy state load
+    assert oc is not None
+    lane = oc.join_lane("k", pd.Series(np.arange(64, dtype=np.int64)))
+    assert lane is not None and lane.kind == "int"
+    assert hbm.ledger().kind_bytes(hbm.KIND_SQL_OPERANDS) > 0
+    recs = [r for r in hbm.residents()
+            if r["kind"] == hbm.KIND_SQL_OPERANDS]
+    assert len(recs) == 1
+    assert recs[0]["rebuild_cost_class"] == "cheap"
+    assert hbm.audit()["ok"]
+
+    # capacity 1: the second table evicts the first, and the eviction
+    # must release the operand lanes through the ledger
+    cache.snapshot_for(f"{tmp_path}/t2")
+    assert oc.released
+    assert hbm.ledger().kind_bytes(hbm.KIND_SQL_OPERANDS) == 0
+    assert hbm.audit()["ok"]
+
+    del snap, oc, lane
+    gc.collect()
+    hbm.audit()                                  # strict: zero leaks
+
+
+# ------------------------------------------- TPC-DS parity matrix ------
+
+
+@pytest.fixture(scope="module")
+def tpcds_small(tmp_path_factory):
+    from benchmarks.tpcds_data import load_delta
+
+    root = str(tmp_path_factory.mktemp("tpcds_oc"))
+    return load_delta(root, scale=2000)
+
+
+@pytest.mark.parametrize("name", ["q3", "q42", "q55"])
+def test_tpcds_parity_hot_and_cold(tpcds_small, name):
+    """Device route, cache forced cold (fresh catalog => fresh states)
+    and forced hot (same catalog, second run), must both match the
+    HostEngine executor row-exactly."""
+    from benchmarks.tpcds_queries import QUERIES
+    from delta_tpu.catalog import Catalog
+    from delta_tpu.engine.host import HostEngine
+    from delta_tpu.engine.tpu import TpuEngine
+    from delta_tpu.sqlengine import execute_select
+
+    import re
+    q = re.sub(r"\blimit\s+\d+\s*$", "", QUERIES[name].strip(),
+               flags=re.IGNORECASE)
+
+    host = _rows(execute_select(
+        q, catalog=Catalog(tpcds_small.root, engine=HostEngine())))
+
+    dq0 = _counter("sql.device_queries")
+    cold_cat = Catalog(tpcds_small.root, engine=TpuEngine())
+    cold = _rows(execute_select(q, catalog=cold_cat))
+    assert _counter("sql.device_queries") > dq0   # really device-routed
+    assert cold == host
+
+    h0 = _counter("sql.operand_cache_hits")
+    m0 = _counter("sql.operand_cache_misses")
+    hot = _rows(execute_select(q, catalog=cold_cat))
+    assert hot == host
+    assert _counter("sql.operand_cache_hits") - h0 > 0
+    assert _counter("sql.operand_cache_misses") - m0 == 0
+    assert hbm.audit()["ok"]
+
+
+# ------------------------------------------- sharded agg parity --------
+
+
+def test_sharded_agg_matches_single_chip(monkeypatch):
+    """Above the row floor the segment reduce fans out over the
+    conftest-emulated 8-device mesh; int64 accumulation must be
+    bit-exact against the single-chip kernel for every op."""
+    from delta_tpu.ops.sqlops import GroupAggregator
+
+    n, n_groups = 8192, 37
+    rng = np.random.default_rng(5)
+    codes = rng.integers(0, n_groups, n).astype(np.int32)
+    values = rng.integers(-10_000, 10_000, n).astype(np.int64)
+    valid = rng.random(n) > 0.15
+
+    monkeypatch.setenv("DELTA_TPU_SQL_SHARD_MIN_ROWS", "1024")
+    sharded = GroupAggregator(codes, n_groups)
+    assert sharded._mesh is not None, "mesh fan-out did not engage"
+
+    monkeypatch.setenv("DELTA_TPU_SQL_SHARD_MIN_ROWS", str(1 << 30))
+    single = GroupAggregator(codes, n_groups)
+    assert single._mesh is None
+
+    for op in ("sum", "min", "max"):
+        a_s, c_s = sharded.reduce(values, valid, op)
+        a_1, c_1 = single.reduce(values, valid, op)
+        np.testing.assert_array_equal(c_s, c_1)
+        np.testing.assert_array_equal(a_s, a_1)
+    np.testing.assert_array_equal(sharded.sizes(), single.sizes())
