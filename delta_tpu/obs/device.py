@@ -365,6 +365,21 @@ class _NoopDispatch:
 
 _NOOP_DISPATCH = _NoopDispatch()
 
+# Device-fault chaos hook (resilience/device_chaos.py). When armed,
+# every device_dispatch() call — obs on or off — passes through the
+# engine's on_dispatch() before a context is built: it may sleep
+# (transfer stall), salt the compile key (recompile storm), or raise
+# (dispatch error / simulated RESOURCE_EXHAUSTED). Injected exceptions
+# surface at the call site's `with` statement, indistinguishable from
+# a real launch failure.
+_dispatch_chaos = None
+
+
+def set_dispatch_chaos(engine) -> None:
+    """Arm (or, with None, disarm) the device-fault chaos engine."""
+    global _dispatch_chaos
+    _dispatch_chaos = engine
+
 
 class _DispatchCtx:
     """Live-path recorder for one kernel launch."""
@@ -490,7 +505,10 @@ class _DispatchCtx:
         if self._attrs:
             rec["attrs"] = self._attrs
         _dispatch_ring.append(rec)
-        if self._gate is not None and exc_type is None:
+        if self._gate is not None:
+            # failed dispatches feed calibration too: a route that burns
+            # wall time and then falls back to host must look *more*
+            # expensive to the gate, not invisible
             _observe_gate(self._gate, self._route, wall_ns / 1e9)
         _trace.add_event("device.dispatch", kernel=self._name,
                          route=self._route, wall_ms=round(wall_ns / 1e6, 4),
@@ -524,6 +542,9 @@ def device_dispatch(name: str, *, key=None, budget: Optional[str] = None,
     ``route``  the route label recorded on the join.
 
     Returns the shared no-op singleton when device obs is off."""
+    if _dispatch_chaos is not None:
+        key = _dispatch_chaos.on_dispatch(name, key=key, gate=gate,
+                                          route=route)
     if _mode == MODE_OFF:
         return _NOOP_DISPATCH
     return _DispatchCtx(name, key, budget, units, gate, route)
@@ -578,6 +599,7 @@ CAPTURE_ENV_KEYS = (
     "DELTA_TPU_TRACE",
     "DELTA_TPU_DEVICE_OBS",
     "DELTA_TPU_HBM_OBS",
+    "DELTA_TPU_DEVICE_CHAOS",
     "JAX_PLATFORMS",
 )
 

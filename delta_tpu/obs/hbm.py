@@ -117,6 +117,8 @@ def set_hbm_obs_mode(mode: Optional[str]) -> None:
 _REGISTRATIONS = counter("hbm.registrations")
 _RELEASES = counter("hbm.releases")
 _LEAKS = counter("hbm.resident_leaks")
+_SHEDS = counter("hbm.sheds")
+_SHED_BYTES = counter("hbm.shed_bytes")
 
 
 # -- ambient table scope -----------------------------------------------------
@@ -178,6 +180,28 @@ def _sum_nbytes(arrays: Sequence[object]) -> int:
     return sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)
 
 
+def _wrap_evictor(evictor):
+    """Normalize an evictor into a zero-arg resolver -> callable|None.
+
+    Bound methods are held via `weakref.WeakMethod`: a strong reference
+    from the ledger to the owner would keep the owner alive forever and
+    blind the finalize-based leak detector. Free functions are held
+    strongly (they don't pin an owner)."""
+    if evictor is None:
+        return None
+    if getattr(evictor, "__self__", None) is not None:
+        return weakref.WeakMethod(evictor)
+    return lambda: evictor
+
+
+# Shed ordering: cheapest-to-rebuild first, then least-recently-used.
+# Unknown classes sort with "normal"; "transient" artifacts are
+# mid-flight handoffs — evicting one tears an in-progress decode, so
+# they rank just above "expensive" and in practice never register an
+# evictor at all.
+_SHED_COST_RANK = {"cheap": 0, "normal": 1, "transient": 2, "expensive": 3}
+
+
 class ResidentHandle:
     """Ledger entry for one device-resident artifact. Obtained from
     `register()`; the owner calls `touch()` on read paths, `grow()`
@@ -186,7 +210,8 @@ class ResidentHandle:
 
     __slots__ = ("table_path", "kind", "version", "nbytes",
                  "rebuild_cost_class", "created_at", "last_access",
-                 "_seq", "_ledger", "_refs", "_finalizer", "_released")
+                 "_seq", "_ledger", "_refs", "_finalizer", "_released",
+                 "_evictor")
 
     def __init__(self, ledger: "ResidentLedger", seq: int, table_path: str,
                  kind: str, version: Optional[int], nbytes: int,
@@ -203,6 +228,7 @@ class ResidentHandle:
         self._refs = refs          # list of weakref.ref | None (untracked)
         self._finalizer = None     # wired by ResidentLedger.register
         self._released = False
+        self._evictor = None       # zero-arg resolver -> callable | None
 
     def touch(self) -> None:
         """Record an access (recency feeds future eviction policy)."""
@@ -262,7 +288,8 @@ class ResidentLedger:
     def register(self, owner, *, kind: str, table_path: Optional[str],
                  version: Optional[int], nbytes: Optional[int],
                  rebuild_cost_class: str,
-                 arrays: Sequence[object]) -> ResidentHandle:
+                 arrays: Sequence[object],
+                 evictor=None) -> ResidentHandle:
         if nbytes is None:
             nbytes = _sum_nbytes(arrays)
         if table_path is None:
@@ -282,6 +309,7 @@ class ResidentLedger:
             self._next_seq += 1
             h = ResidentHandle(self, seq, table_path, kind, version,
                                int(nbytes), rebuild_cost_class, refs)
+            h._evictor = _wrap_evictor(evictor)
             self._handles[seq] = h
             self._total += h.nbytes
             if self._total > self._peak:
@@ -329,6 +357,45 @@ class ResidentLedger:
         _RELEASES.inc()
         _trace.add_event("hbm.release", kind=h.kind, table=h.table_path,
                          nbytes=h.nbytes)
+
+    def shed(self, max_artifacts: Optional[int] = None,
+             need_bytes: Optional[int] = None) -> Tuple[int, int]:
+        """Evict resident artifacts under HBM pressure; returns
+        ``(artifacts_evicted, bytes_freed)``.
+
+        Candidates are the handles registered with an ``evictor`` whose
+        owner is still alive, ordered cheapest-to-rebuild first
+        (`rebuild_cost_class`), then least recently used. Evictors run
+        outside the ledger lock and must end in the handle's
+        ``release()`` — an eviction only counts once the handle reports
+        released. Stops after ``max_artifacts`` evictions or once
+        ``need_bytes`` have been freed (whichever comes first)."""
+        with self._lock:
+            cands = []
+            for h in self._handles.values():
+                ev = h._evictor() if h._evictor is not None else None
+                if ev is not None:
+                    cands.append(
+                        (_SHED_COST_RANK.get(h.rebuild_cost_class, 1),
+                         h.last_access, h._seq, h, ev))
+        cands.sort(key=lambda t: t[:3])
+        n = freed = 0
+        for _, _, _, h, ev in cands:
+            if max_artifacts is not None and n >= max_artifacts:
+                break
+            if need_bytes is not None and freed >= need_bytes:
+                break
+            nbytes = h.nbytes
+            ev()
+            if h._released:
+                n += 1
+                freed += nbytes
+                _trace.add_event("hbm.shed", kind=h.kind,
+                                 table=h.table_path, nbytes=nbytes)
+        if n:
+            _SHEDS.inc(n)
+            _SHED_BYTES.inc(freed)
+        return n, freed
 
     def _leaked(self, seq: int) -> None:
         """Finalizer callback: the owner was GC'd with the handle still
@@ -532,7 +599,8 @@ def ledger() -> ResidentLedger:
 def register(owner, *, kind: str, table_path: Optional[str] = None,
              version: Optional[int] = None, nbytes: Optional[int] = None,
              rebuild_cost_class: str = "normal",
-             arrays: Sequence[object] = ()):
+             arrays: Sequence[object] = (),
+             evictor=None):
     """Register one device-resident artifact; returns its handle (the
     shared no-op handle when the ledger is off).
 
@@ -542,14 +610,33 @@ def register(owner, *, kind: str, table_path: Optional[str] = None,
     ``arrays``  the device arrays backing the artifact (weakly held,
                 audited against `jax.live_arrays()`);
     ``nbytes``  registered size; computed from `arrays` when omitted;
-    ``table_path`` rollup key; the ambient `table_scope()` when omitted.
+    ``table_path`` rollup key; the ambient `table_scope()` when omitted;
+    ``evictor`` optional zero-arg callable `shed()` may invoke under
+                HBM pressure — must drop the artifact's device memory
+                and end in the handle's ``release()``; bound methods
+                are weakly held so the ledger never pins the owner.
     """
     if _mode == MODE_OFF:
         return _NOOP_HANDLE
     return _LEDGER.register(owner, kind=kind, table_path=table_path,
                             version=version, nbytes=nbytes,
                             rebuild_cost_class=rebuild_cost_class,
-                            arrays=arrays)
+                            arrays=arrays, evictor=evictor)
+
+
+def shed(max_artifacts: Optional[int] = None,
+         need_bytes: Optional[int] = None) -> Tuple[int, int]:
+    """Evict cheapest-to-rebuild resident artifacts under HBM pressure
+    (the shed half of shed-and-retry; see
+    `resilience/device_faults.py`). No-op ``(0, 0)`` when the ledger is
+    off — without byte accounting there is nothing principled to shed.
+    ``DELTA_TPU_HBM_SHED_MAX`` (default 4) caps evictions per call when
+    ``max_artifacts`` is omitted."""
+    if _mode == MODE_OFF:
+        return (0, 0)
+    if max_artifacts is None:
+        max_artifacts = int(os.environ.get("DELTA_TPU_HBM_SHED_MAX") or 4)
+    return _LEDGER.shed(max_artifacts=max_artifacts, need_bytes=need_bytes)
 
 
 def audit() -> Dict[str, object]:

@@ -46,6 +46,7 @@ from pathlib import Path
 from typing import Dict, NamedTuple, Optional
 
 from delta_tpu.obs.device import record_gate_decision
+from delta_tpu.obs.registry import counter
 
 # Fallbacks when no DEVICE_MERIT.json is available (same shape as the
 # bench host's measurements so the gate degrades to sane behavior).
@@ -208,6 +209,7 @@ class RouteSpec(NamedTuple):
     env: str               # override knob the route function reads
     fallback_counter: str  # cataloged counter the fallback path bumps
     doc_anchor: str        # docs/architecture.md heading slug (prefix)
+    breaker: str           # registry key of the route's circuit breaker
 
 
 # The route registry: one entry per gate name passed to `_decide`.
@@ -222,24 +224,87 @@ ROUTES: Dict[str, RouteSpec] = {
     "replay": RouteSpec(
         env="DELTA_TPU_REPLAY_ROUTE",
         fallback_counter="replay.resident_fallbacks",
-        doc_anchor="the-profitability-gate"),
+        doc_anchor="the-profitability-gate",
+        breaker="route:replay"),
     "parse": RouteSpec(
         env="DELTA_TPU_DEVICE_PARSE",
         fallback_counter="parse.device_fallbacks",
-        doc_anchor="device-json-action-parse"),
+        doc_anchor="device-json-action-parse",
+        breaker="route:parse"),
     "decode": RouteSpec(
         env="DELTA_TPU_DEVICE_DECODE",
         fallback_counter="decode.device_fallbacks",
-        doc_anchor="device-checkpoint-page-decode"),
+        doc_anchor="device-checkpoint-page-decode",
+        breaker="route:decode"),
     "skip": RouteSpec(
         env="DELTA_TPU_DEVICE_SKIP",
         fallback_counter="scan.device_fallbacks",
-        doc_anchor="device-scan-planning"),
+        doc_anchor="device-scan-planning",
+        breaker="route:skip"),
     "sql": RouteSpec(
         env="DELTA_TPU_DEVICE_SQL",
         fallback_counter="sql.device_fallbacks",
-        doc_anchor="device-sql-execution"),
+        doc_anchor="device-sql-execution",
+        breaker="route:sql"),
 }
+
+
+_ROUTE_FAILURES = counter("gate.route_failures")
+_BREAKER_DEGRADES = counter("gate.route_breaker_degrades")
+
+
+def _route_breaker(gate: str):
+    """The circuit breaker guarding one gate's device route (lazy
+    import: gate.py must stay importable without the resilience
+    package loaded)."""
+    from delta_tpu.resilience.breaker import route_breaker_for
+    return route_breaker_for(gate)
+
+
+def _breaker_admit(gate: str, chosen: str, reason: str):
+    """Consult the route breaker before committing a device choice.
+
+    Open breaker -> degrade to the host twin ("breaker-open");
+    half-open -> admit the decision as the probe ("breaker-probe") —
+    the executing site reports the outcome via :func:`route_ok` /
+    :func:`route_failed`, and a probe whose caller never reports is
+    reclaimed by the breaker after its reset window."""
+    from delta_tpu.errors import CircuitOpenError
+    from delta_tpu.resilience.breaker import HALF_OPEN
+    b = _route_breaker(gate)
+    try:
+        b.before_call()
+    except CircuitOpenError:
+        _BREAKER_DEGRADES.inc()
+        return "host", "breaker-open"
+    if b.state == HALF_OPEN:
+        return chosen, "breaker-probe"
+    return chosen, reason
+
+
+def route_ok(gate: str) -> None:
+    """Report one successful device-route execution to the gate's
+    breaker (closes a half-open probe, clears failure streaks)."""
+    _route_breaker(gate).on_success()
+
+
+def route_failed(gate: str, exc: BaseException) -> str:
+    """Report one failed device-route execution; returns the
+    classification verdict.
+
+    The exception is routed through `resilience/classify.py`: transient
+    verdicts count toward the breaker's trip threshold, permanent ones
+    report as success (the device answered; the error is an answer —
+    same contract as storage breakers)."""
+    from delta_tpu.resilience.classify import TRANSIENT, classify
+    verdict = classify(exc)
+    _ROUTE_FAILURES.inc()
+    b = _route_breaker(gate)
+    if verdict == TRANSIENT:
+        b.on_failure()
+    else:
+        b.on_success()
+    return verdict
 
 
 def _decide(gate: str, chosen: str, inputs: Dict[str, object],
@@ -247,6 +312,17 @@ def _decide(gate: str, chosen: str, inputs: Dict[str, object],
             reason: str = "economics") -> str:
     """Record the decision (obs/device.py joins it with the observed
     execution cost for calibration) and return the chosen route."""
+    if chosen != "host" and reason not in ("env", "forced") \
+            and inputs.get("op") != "query":
+        # env/forced outrank the breaker (explicit operator intent);
+        # every economic device choice pays the breaker toll so a
+        # poisoned route degrades to its host twin within K failures.
+        # The sql "query" spine resolution is exempt: it binds no
+        # execution (no route_ok/route_failed ever answers it), so
+        # letting it take the half-open probe would wedge the probe
+        # slot for a full reset window — the per-operator decisions
+        # that follow are the ones that pay the toll.
+        chosen, reason = _breaker_admit(gate, chosen, reason)
     record_gate_decision(gate, chosen, inputs, predicted or {}, reason)
     return chosen
 

@@ -49,6 +49,10 @@ _OBS_CACHE_MISS_FILES = obs.counter("parse_cache.miss_files")
 _TORN_COMMITS = obs.counter("log.torn_commits")
 _OBS_DECODE_PARTS = obs.counter("decode.device_parts")
 _OBS_DECODE_FALLBACKS = obs.counter("decode.device_fallbacks")
+# same instrument as replay/device_parse.py: absorbed device-parse
+# exceptions bump the cataloged parse fallback counter here (the
+# in-module bumps cover only the None-return unsupported shapes)
+_OBS_PARSE_FALLBACKS = obs.counter("parse.device_fallbacks")
 
 DV_STRUCT_TYPE = pa.struct(
     [
@@ -1044,7 +1048,9 @@ def _columnarize_log_segment(
         import pyarrow.parquet as pq
 
         from delta_tpu.log.page_decode import read_checkpoint_part_device
+        from delta_tpu.parallel import gate as gate_mod
         from delta_tpu.replay.pipeline import prefetch_file_bytes
+        from delta_tpu.resilience import device_faults
 
         byte_iter = prefetch_file_bytes(
             engine, [f.path for f in parts
@@ -1057,15 +1063,29 @@ def _columnarize_log_segment(
                     _consume_checkpoint_table(tbl)
                 else:
                     data = next(byte_iter)
-                    out = read_checkpoint_part_device(
-                        data, want_keys=want_handoff)
+                    host_reason = "unsupported-shape"
+                    try:
+                        out = device_faults.shed_retry(
+                            "decode",
+                            lambda data=data: read_checkpoint_part_device(
+                                data, want_keys=want_handoff))
+                    except Exception as e:
+                        # classify (feeds the route breaker); permanent
+                        # errors — a missing part file is one — re-raise
+                        # into the handler below
+                        if not device_faults.absorb_route_failure(
+                                "decode", e):
+                            raise
+                        out = None
+                        host_reason = f"device-error:{type(e).__name__}"
                     if out is not None:
                         _OBS_DECODE_PARTS.inc()
+                        gate_mod.route_ok("decode")
                         _consume_checkpoint_table(out[0], out[1])
                     else:
                         _OBS_DECODE_FALLBACKS.inc()
                         obs.gate_fell_back("decode", "host",
-                                           reason="unsupported-shape")
+                                           reason=host_reason)
                         with obs.gate_observation("decode", "host"):
                             tbl = pq.read_table(pa.BufferReader(data))
                         _consume_checkpoint_table(tbl)
@@ -1216,6 +1236,7 @@ def _columnarize_log_segment(
                     from delta_tpu.ops.replay import replay_select_launch
                     from delta_tpu.parallel import gate
                     from delta_tpu.replay.state import BLOCKWISE_MIN_ROWS
+                    from delta_tpu.resilience import device_faults
 
                     # Same routing decision compute_masks_device will
                     # make: an early launch may only claim the replay
@@ -1231,13 +1252,26 @@ def _columnarize_log_segment(
                         return None  # >HBM: compute_masks_device streams blocks
                     if row_versions.max(initial=0) >= 2**31:
                         return None
-                    return replay_select_launch(
-                        [scan.path_code,
-                         np.zeros(scan.n_rows, np.uint32)],
-                        row_versions.astype(np.int32), row_orders,
-                        scan.is_add.astype(bool),
-                        fa_hint=(scan.path_new, scan.refs, scan.n_uniq),
-                    )
+                    try:
+                        return device_faults.shed_retry(
+                            "replay", lambda: replay_select_launch(
+                                [scan.path_code,
+                                 np.zeros(scan.n_rows, np.uint32)],
+                                row_versions.astype(np.int32), row_orders,
+                                scan.is_add.astype(bool),
+                                fa_hint=(scan.path_new, scan.refs,
+                                         scan.n_uniq),
+                            ))
+                    except Exception as e:
+                        # The early launch is an overlap optimization:
+                        # a transient device failure here just forfeits
+                        # the head start — compute_masks_device makes
+                        # its own (absorbed) attempt later, so no
+                        # fallback counter and no host twin yet.
+                        if not device_faults.absorb_route_failure(
+                                "replay", e):
+                            raise
+                        return None
             # Pipelined load: when the tail is big enough to window,
             # overlap storage reads with parsing (and with the device
             # replay dispatch) instead of the phase-serial flow below.
@@ -1274,17 +1308,33 @@ def _columnarize_log_segment(
                         getattr(engine, "use_device_parse",
                                 False)) == "device":
                     from delta_tpu.replay import device_parse as _dp
+                    from delta_tpu.resilience import device_faults
 
+                    fell_reason = None
                     read = _read_commits_buffer(engine, remaining)
                     if read is not None:
                         buf, starts, version_arr = read
-                        parsed_native = _dp.parse_commits_device(
-                            buf, starts, version_arr,
-                            small_only=small_only,
-                            lazy_stats=(not small_only
-                                        and not os.environ.get(
-                                            "DELTA_TPU_EAGER_STATS")))
+                        try:
+                            parsed_native = device_faults.shed_retry(
+                                "parse",
+                                lambda: _dp.parse_commits_device(
+                                    buf, starts, version_arr,
+                                    small_only=small_only,
+                                    lazy_stats=(not small_only
+                                                and not os.environ.get(
+                                                    "DELTA_TPU_EAGER_STATS"
+                                                ))))
+                        except Exception as e:
+                            # classify (feeds the route breaker);
+                            # transient -> host twin reuses the buffer
+                            if not device_faults.absorb_route_failure(
+                                    "parse", e):
+                                raise
+                            _OBS_PARSE_FALLBACKS.inc()
+                            fell_reason = (
+                                f"device-error:{type(e).__name__}")
                         if parsed_native is not None:
+                            _gate.route_ok("parse")
                             bytes_parsed += int(starts[-1])
                     if parsed_native is None:
                         # buffer (if read) is reused by the host
@@ -1292,7 +1342,8 @@ def _columnarize_log_segment(
                         # prediction for gate calibration
                         obs.gate_fell_back(
                             "parse", "host",
-                            reason=("read-failed" if read is None
+                            reason=(fell_reason if fell_reason is not None
+                                    else "read-failed" if read is None
                                     else "device-parse-unavailable"))
             if (fresh is None and parsed_native is None and read is None
                     and _native.available(allow_compile)):

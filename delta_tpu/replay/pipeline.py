@@ -65,6 +65,9 @@ _PARSE_STALL_NS = obs.counter("pipeline.parse_stall_ns")
 _INGEST_STALL_NS = obs.counter("pipeline.ingest_stall_ns")
 _READQ_DEPTH = obs.histogram("pipeline.read_queue_depth")
 _PARSEQ_DEPTH = obs.histogram("pipeline.parse_queue_depth")
+# same instrument as replay/device_parse.py: absorbed device-parse
+# exceptions bump the cataloged parse fallback counter at this site
+_PARSE_FALLBACKS = obs.counter("parse.device_fallbacks")
 
 _DEFAULT_WINDOW_BYTES = 64 << 20
 _DEFAULT_DEPTH = 2
@@ -369,18 +372,32 @@ def _parse_window(w: _Window, allow_native: bool,
 
         if gate.parse_route(w.nbytes, allow_device) == "device":
             from delta_tpu.replay.device_parse import parse_window_device
+            from delta_tpu.resilience import device_faults
 
-            out = parse_window_device(w.buf, w.starts, w.versions,
-                                      lazy_stats=lazy_stats)
+            fell_reason = "device-parse-unavailable"
+            try:
+                out = device_faults.shed_retry(
+                    "parse",
+                    lambda: parse_window_device(w.buf, w.starts,
+                                                w.versions,
+                                                lazy_stats=lazy_stats))
+            except Exception as e:
+                # classify (feeds the route breaker); transient -> the
+                # host branches below reuse the window buffer
+                if not device_faults.absorb_route_failure("parse", e):
+                    raise
+                _PARSE_FALLBACKS.inc()
+                out = None
+                fell_reason = f"device-error:{type(e).__name__}"
             if out is not None:
+                gate.route_ok("parse")
                 table, others, keys, uniq, dv_any, sthunk = out
                 sp.set_attrs(rows=table.num_rows, device=True)
                 return _Parsed(w.index, table, others, keys, uniq,
                                dv_any, sthunk, len(w.infos), w.nbytes)
             # mid-flight fallback: calibration prices the device attempt
             # PLUS the host parse below against the "device" prediction
-            obs.gate_fell_back("parse", "host",
-                               reason="device-parse-unavailable")
+            obs.gate_fell_back("parse", "host", reason=fell_reason)
         if allow_native:
             from delta_tpu.replay.native_parse import parse_window_native
 

@@ -38,6 +38,10 @@ from delta_tpu.models.actions import (
 )
 from delta_tpu.replay.columnar import ColumnarActions, columnarize_log_segment
 
+# same registry instrument as parallel/resident.py: the cataloged
+# fallback counter for the replay route (route-contract lint)
+_ROUTE_FALLBACKS = obs.counter("replay.resident_fallbacks")
+
 
 @dataclass
 class SnapshotState:
@@ -240,10 +244,24 @@ def _dv_codes_only(file_actions: pa.Table) -> np.ndarray:
 BLOCKWISE_MIN_ROWS = 32_000_000
 
 
+def _replay_host_twin(columnar: ColumnarActions,
+                      exc: Exception) -> tuple[np.ndarray, np.ndarray]:
+    """Fallback bookkeeping + host replay after an absorbed (already
+    classified transient) device failure: bump the cataloged fallback
+    counter and run the host twin under the calibration join."""
+    _ROUTE_FALLBACKS.inc()
+    obs.gate_fell_back("replay", "host",
+                       reason=f"device-error:{type(exc).__name__}")
+    with obs.gate_observation("replay", "host"):
+        return compute_masks_host(columnar)
+
+
 def compute_masks_device(
     columnar: ColumnarActions, engine=None
 ) -> tuple[np.ndarray, np.ndarray]:
     from delta_tpu.ops.replay import replay_select
+    from delta_tpu.parallel import gate
+    from delta_tpu.resilience import device_faults
 
     fa = columnar.file_actions
     n = fa.num_rows
@@ -253,8 +271,17 @@ def compute_masks_device(
     pending = columnar.pending_masks
     if pending is not None:
         # device replay was dispatched during columnarization (overlapped
-        # with the Arrow assembly) — just collect the masks
-        return pending.finish()
+        # with the Arrow assembly) — just collect the masks; a failed
+        # overlapped dispatch degrades to the host twin like any other
+        try:
+            out = device_faults.shed_retry("replay", pending.finish)
+        except Exception as e:
+            # classify (feeds the route breaker); permanent -> re-raise
+            if not device_faults.absorb_route_failure("replay", e):
+                raise
+            return _replay_host_twin(columnar, e)
+        gate.route_ok("replay")
+        return out
     keys = columnar.replay_keys
     fa_hint = None
     if keys is not None and len(keys.path_code) == n:
@@ -272,8 +299,6 @@ def compute_masks_device(
     order = np.asarray(fa.column("order"), dtype=np.int32)
     is_add = np.asarray(fa.column("is_add"), dtype=bool)
 
-    from delta_tpu.parallel import gate
-
     mesh = getattr(engine, "mesh", None) if engine is not None else None
     n_shards = mesh.devices.size if mesh is not None else 1
     forced = ("sharded" if n_shards > 1
@@ -284,45 +309,62 @@ def compute_masks_device(
         # more than the host-vectorized replay (DEVICE_MERIT link model)
         with obs.gate_observation("replay", "host"):
             return compute_masks_host(columnar)
-    if route == "sharded":
-        if n >= BLOCKWISE_MIN_ROWS * n_shards:
-            # sharded AND >HBM: each shard streams its substream in
-            # bounded blocks with a persistent bitset — the
-            # `Snapshot.scala:481-511` multi-host configuration
-            from delta_tpu.parallel.sharded_blockwise import (
-                replay_select_sharded_blockwise,
+    def _run_device() -> tuple[np.ndarray, np.ndarray]:
+        if route == "sharded":
+            if n >= BLOCKWISE_MIN_ROWS * n_shards:
+                # sharded AND >HBM: each shard streams its substream in
+                # bounded blocks with a persistent bitset — the
+                # `Snapshot.scala:481-511` multi-host configuration
+                from delta_tpu.parallel.sharded_blockwise import (
+                    replay_select_sharded_blockwise,
+                )
+
+                live, tomb, _ = replay_select_sharded_blockwise(
+                    [path_codes, dv_codes], version.astype(np.int32),
+                    order, is_add, mesh)
+                return live, tomb
+            from delta_tpu.parallel import resident as _resident
+            from delta_tpu.parallel.sharded_replay import (
+                sharded_replay_select,
             )
 
-            live, tomb, _ = replay_select_sharded_blockwise(
-                [path_codes, dv_codes], version.astype(np.int32),
-                order, is_add, mesh)
+            sink = [] if _resident.enabled() else None
+            live, tomb, _, _ = sharded_replay_select(
+                path_codes, dv_codes, version.astype(np.int32), order,
+                is_add, mesh=mesh, fa_hint=fa_hint, resident_sink=sink,
+            )
+            if sink:
+                # keep the per-shard state on device so Snapshot.update()
+                # ships only delta rows (ownership moves to SnapshotState
+                # in reconstruct_state)
+                columnar.resident = _resident.establish_resident(
+                    sink[0], fa, path_codes)
             return live, tomb
-        from delta_tpu.parallel import resident as _resident
-        from delta_tpu.parallel.sharded_replay import sharded_replay_select
+        if n >= BLOCKWISE_MIN_ROWS:
+            # >HBM scale path (SURVEY §5.7): stream fixed-size blocks
+            # through the device with a persistent key bitset instead of
+            # one giant sort
+            from delta_tpu.ops.replay_blockwise import (
+                replay_select_blockwise,
+            )
 
-        sink = [] if _resident.enabled() else None
-        live, tomb, _, _ = sharded_replay_select(
-            path_codes, dv_codes, version.astype(np.int32), order, is_add,
-            mesh=mesh, fa_hint=fa_hint, resident_sink=sink,
+            return replay_select_blockwise(
+                [path_codes, dv_codes], version.astype(np.int32), order,
+                is_add)
+        return replay_select(
+            [path_codes, dv_codes], version.astype(np.int32), order, is_add,
+            fa_hint=fa_hint,
         )
-        if sink:
-            # keep the per-shard state on device so Snapshot.update()
-            # ships only delta rows (ownership moves to SnapshotState
-            # in reconstruct_state)
-            columnar.resident = _resident.establish_resident(
-                sink[0], fa, path_codes)
-        return live, tomb
-    if n >= BLOCKWISE_MIN_ROWS:
-        # >HBM scale path (SURVEY §5.7): stream fixed-size blocks through
-        # the device with a persistent key bitset instead of one giant sort
-        from delta_tpu.ops.replay_blockwise import replay_select_blockwise
 
-        return replay_select_blockwise(
-            [path_codes, dv_codes], version.astype(np.int32), order, is_add)
-    return replay_select(
-        [path_codes, dv_codes], version.astype(np.int32), order, is_add,
-        fa_hint=fa_hint,
-    )
+    try:
+        out = device_faults.shed_retry("replay", _run_device)
+    except Exception as e:
+        # classify (feeds the route breaker); permanent -> re-raise
+        if not device_faults.absorb_route_failure("replay", e):
+            raise
+        return _replay_host_twin(columnar, e)
+    gate.route_ok("replay")
+    return out
 
 
 def compute_masks_host(columnar: ColumnarActions) -> tuple[np.ndarray, np.ndarray]:

@@ -23,6 +23,13 @@ of ad-hoc loops:
 - :mod:`delta_tpu.resilience.chaos` — deterministic seeded
   `ChaosStore` fault-injection wrapper (superset of
   `FaultInjectingLogStore`) for soak testing.
+- :mod:`delta_tpu.resilience.device_chaos` — the device-side twin:
+  a seeded `ChaosEngine` that injects dispatch errors, simulated
+  RESOURCE_EXHAUSTED, transfer stalls, and recompile storms at the
+  `obs/device.py::device_dispatch()` funnel.
+- :mod:`delta_tpu.resilience.device_faults` — the absorption half:
+  HBM shed-and-retry plus classify-and-fall-back for every gated
+  device route (the route breakers live in `parallel/gate.py`).
 
 Every storage-facing layer funnels IO through :func:`io_call` so the
 policy, breaker registry, and telemetry
@@ -39,8 +46,15 @@ from delta_tpu.resilience.breaker import (
     breaker_for,
     breaker_states,
     reset_breakers,
+    route_breaker_for,
 )
 from delta_tpu.resilience.chaos import ChaosSchedule, ChaosStore
+from delta_tpu.resilience.device_chaos import (
+    ChaosEngine,
+    DeviceChaosError,
+    DeviceChaosSchedule,
+    DeviceResourceExhaustedError,
+)
 from delta_tpu.resilience.classify import (
     PERMANENT,
     TRANSIENT,
@@ -79,11 +93,15 @@ def default_policy() -> RetryPolicy:
 
 
 def reset() -> None:
-    """Forget the cached policy and all breaker state (tests)."""
+    """Forget the cached policy, all breaker state (route breakers
+    included), and any armed device-chaos engine (tests)."""
     global _default_policy
     with _policy_lock:
         _default_policy = None
     reset_breakers()
+    from delta_tpu.obs import device as _obs_device
+
+    _obs_device.set_dispatch_chaos(None)
 
 
 def endpoint_of(path: str) -> str:
@@ -107,9 +125,13 @@ def io_call(endpoint: str, fn: Callable[[], T]) -> T:
 
 
 __all__ = [
-    "CircuitBreaker",
+    "ChaosEngine",
     "ChaosSchedule",
     "ChaosStore",
+    "CircuitBreaker",
+    "DeviceChaosError",
+    "DeviceChaosSchedule",
+    "DeviceResourceExhaustedError",
     "PERMANENT",
     "RetryPolicy",
     "StorageRequestError",
@@ -129,4 +151,5 @@ __all__ = [
     "remaining",
     "reset",
     "reset_breakers",
+    "route_breaker_for",
 ]

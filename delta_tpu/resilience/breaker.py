@@ -172,6 +172,37 @@ def breaker_for(endpoint: str) -> CircuitBreaker:
     return b
 
 
+def route_breaker_for(gate: str) -> CircuitBreaker:
+    """The process-wide breaker for one device route (``replay``,
+    ``parse``, ``decode``, ``skip``, ``sql``).
+
+    Route breakers share the registry under a ``route:`` key prefix, so
+    they surface in :func:`breaker_states` (and the serve `/health` op)
+    next to storage endpoints and clear with :func:`reset_breakers`.
+    They trip faster and re-arm sooner than storage breakers: a poisoned
+    device route has a host twin standing by, so degrading early is
+    cheap and probing early is safe. Knobs:
+    ``DELTA_TPU_ROUTE_BREAKER_THRESHOLD`` (default 4 consecutive
+    classified-transient failures), ``DELTA_TPU_ROUTE_BREAKER_RESET_S``
+    (default 5.0 seconds to the half-open probe)."""
+    key = "route:" + gate
+    b = _breakers.get(key)
+    if b is not None:
+        return b
+    with _breakers_lock:
+        b = _breakers.get(key)
+        if b is None:
+            b = CircuitBreaker(
+                key,
+                threshold=int(float(os.environ.get(
+                    "DELTA_TPU_ROUTE_BREAKER_THRESHOLD") or 4)),
+                reset_s=float(os.environ.get(
+                    "DELTA_TPU_ROUTE_BREAKER_RESET_S") or 5.0),
+            )
+            _breakers[key] = b
+    return b
+
+
 def breaker_states() -> Dict[str, dict]:
     """Introspection over every live breaker: endpoint ->
     :meth:`CircuitBreaker.snapshot`. The serve `/health` op reports

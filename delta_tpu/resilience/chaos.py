@@ -35,6 +35,15 @@ RNG, so a given seed yields one schedule):
   from the *tail* of the result — the prefix-consistent shape real
   eventually-consistent listings have. Readers see an older version;
   writers lose the put-if-absent race and rebase.
+- **read corruption** (`corrupt_read_rate`): for paths matching
+  ``corrupt_pred`` (default: checkpoint artifacts and ``.crc``
+  files) the returned payload comes back with seeded bit flips near
+  its tail — where the parquet footer / crc digest lives — so the
+  read *succeeds* but the content is damaged. The reader-side
+  corruption ladder (crc quarantine, checkpoint fallback to the
+  commit-replay path) must absorb it; commit ``.json`` files are
+  excluded because a corrupt commit is genuine data loss, which no
+  reader-side ladder can recover.
 
 All decisions honour ``path_filter`` (default: only `_delta_log`
 paths) so table-data IO can be left quiet while the log is hammered.
@@ -58,6 +67,7 @@ _CHAOS_FAULTS = obs.counter("chaos.faults")
 _CHAOS_TORN = obs.counter("chaos.torn_writes")
 _CHAOS_STALE = obs.counter("chaos.stale_listings")
 _CHAOS_ACK_LOSS = obs.counter("chaos.ack_losses")
+_CHAOS_CORRUPT = obs.counter("chaos.read_corruptions")
 
 
 class ChaosError(IOError):
@@ -68,6 +78,14 @@ def _default_torn_pred(path: str) -> bool:
     name = path.rpartition("/")[2]
     return (".checkpoint" in name or name.endswith(".crc")
             or name == "_last_checkpoint")
+
+
+def _default_corrupt_pred(path: str) -> bool:
+    """Checkpoint artifacts and crc sidecars: the payloads whose
+    corruption the reader fallback ladder is contractually able to
+    absorb (commit .json damage is unrecoverable data loss)."""
+    name = path.rpartition("/")[2]
+    return ".checkpoint" in name or name.endswith(".crc")
 
 
 def _default_ack_pred(path: str) -> bool:
@@ -90,7 +108,8 @@ class ChaosSchedule:
                  latency_s: tuple = (0.0002, 0.002),
                  torn_write_rate: float = 0.0,
                  stale_list_rate: float = 0.0,
-                 ack_loss_rate: float = 0.0):
+                 ack_loss_rate: float = 0.0,
+                 corrupt_read_rate: float = 0.0):
         self.seed = seed
         self.error_rate = error_rate
         self.latency_rate = latency_rate
@@ -98,6 +117,7 @@ class ChaosSchedule:
         self.torn_write_rate = torn_write_rate
         self.stale_list_rate = stale_list_rate
         self.ack_loss_rate = ack_loss_rate
+        self.corrupt_read_rate = corrupt_read_rate
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
@@ -121,6 +141,17 @@ class ChaosSchedule:
         with self._lock:
             return self._rng.randint(1, max(1, min(3, n - 1))) if n > 1 else 0
 
+    def draw_flip_offsets(self, size: int, window: int = 16,
+                          n_flips: int = 3) -> List[tuple]:
+        """Seeded ``(byte_offset, bit)`` pairs inside the payload's last
+        ``window`` bytes — where the parquet footer magic / length and
+        crc digest text live, so a flip is guaranteed to damage what
+        the reader actually validates rather than some padding byte."""
+        lo = max(0, size - window)
+        with self._lock:
+            return [(self._rng.randrange(lo, size), self._rng.randrange(8))
+                    for _ in range(min(n_flips, size))]
+
 
 class ChaosStore(DelegatingLogStore):
     """Seeded chaos wrapper around any `LogStore`.
@@ -134,12 +165,14 @@ class ChaosStore(DelegatingLogStore):
                  path_filter: Optional[Callable[[str], bool]] = None,
                  torn_pred: Optional[Callable[[str], bool]] = None,
                  ack_pred: Optional[Callable[[str], bool]] = None,
+                 corrupt_pred: Optional[Callable[[str], bool]] = None,
                  sleep: Callable[[float], None] = time.sleep):
         super().__init__(inner)
         self.schedule = schedule
         self.path_filter = path_filter or _default_path_filter
         self.torn_pred = torn_pred or _default_torn_pred
         self.ack_pred = ack_pred or _default_ack_pred
+        self.corrupt_pred = corrupt_pred or _default_corrupt_pred
         self.enabled = True
         self.fault_log: List[tuple] = []
         self.fault_counts: Dict[str, int] = {}
@@ -166,7 +199,21 @@ class ChaosStore(DelegatingLogStore):
     # ------------------------------------------------------------- ops
     def read(self, path: str) -> bytes:
         self._perturb("read", path)
-        return self.inner.read(path)
+        data = self.inner.read(path)
+        s = self.schedule
+        if (self.enabled and s.corrupt_read_rate and data
+                and self.path_filter(path) and self.corrupt_pred(path)
+                and s.draw() < s.corrupt_read_rate):
+            # the read succeeds but the payload is damaged: seeded bit
+            # flips near the tail (parquet footer / crc digest), so the
+            # reader's validation — not the transport — catches it
+            self._record("corrupt_read", "read", path)
+            _CHAOS_CORRUPT.inc()
+            buf = bytearray(data)
+            for off, bit in s.draw_flip_offsets(len(buf)):
+                buf[off] ^= 1 << bit
+            data = bytes(buf)
+        return data
 
     def write(self, path: str, data: bytes, overwrite: bool = False) -> None:
         self._perturb("write", path)

@@ -1,0 +1,226 @@
+"""Seeded device-fault injection at the dispatch funnel (chaos plane).
+
+`ChaosStore` hammers the LogStore; this module is its device-side twin.
+A :class:`ChaosEngine` arms at the ``obs/device.py::device_dispatch()``
+funnel seam, so every jit/shard_map launch in ``parallel/``, ``ops/``
+and ``sqlengine/device.py`` is injectable without touching a single
+kernel. The fault model covers the device failure modes the paper's
+architecture inherits:
+
+- **dispatch errors** — the launch raises (:class:`DeviceChaosError`,
+  classified transient via its ``retryable`` attribute);
+- **allocation failures** — simulated ``RESOURCE_EXHAUSTED``
+  (:class:`DeviceResourceExhaustedError`), the trigger for the resident
+  ledger's shed-and-retry path (`resilience/device_faults.py`);
+- **transfer stalls** — a bounded sleep before the launch, modeling a
+  degraded interconnect;
+- **recompile storms** — shape-key perturbation: the dispatch's compile
+  key is salted so device obs sees a novel key per injection, driving
+  the `device.recompile_storms` alarm without recompiling anything.
+
+All draws come from one seeded ``random.Random`` held under a lock, so
+any observed failure schedule is replayable bit-for-bit from the seed
+(``fault_log`` records every injection in order). Faults raised here
+propagate out of the ``with device_dispatch(...)`` statement at the
+call site and are indistinguishable from a real launch failure — the
+route's absorption path (classify → breaker → host twin) is what's
+under test, never the kernel.
+
+Arming::
+
+    from delta_tpu.resilience.device_chaos import (
+        ChaosEngine, DeviceChaosSchedule)
+
+    eng = ChaosEngine(DeviceChaosSchedule(seed=7, dispatch_error_rate=0.1))
+    with eng:                       # arm()/disarm() also work
+        run_workload()
+    assert eng.fault_log == replay_same_seed()
+
+Env arming (captured into bench conditions): ``DELTA_TPU_DEVICE_CHAOS``
+is ``off`` (default) or an integer seed; ``DELTA_TPU_DEVICE_CHAOS_RATE``
+sets the per-dispatch rate for every enabled kind (default 0.05);
+``DELTA_TPU_DEVICE_CHAOS_KINDS`` is a comma list drawn from
+``error,oom,stall,recompile`` (default all).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from delta_tpu import obs
+
+KIND_ERROR = "error"
+KIND_OOM = "oom"
+KIND_STALL = "stall"
+KIND_RECOMPILE = "recompile"
+ALL_KINDS = (KIND_ERROR, KIND_OOM, KIND_STALL, KIND_RECOMPILE)
+
+_DEVICE_FAULTS = obs.counter("chaos.device_faults")
+
+
+class DeviceChaosError(RuntimeError):
+    """Injected dispatch failure; transient by construction."""
+
+    # resilience/classify.py checks this attribute first: injected
+    # faults must classify transient so absorption paths fall back to
+    # the host twin instead of propagating.
+    retryable = True
+
+
+class DeviceResourceExhaustedError(DeviceChaosError):
+    """Injected allocation failure shaped like an XLA allocator error.
+
+    The message carries ``RESOURCE_EXHAUSTED`` because that marker —
+    not the type — is what `device_faults.is_resource_exhausted`
+    matches, the same way real XlaRuntimeError text is matched.
+    """
+
+    def __init__(self, kernel: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected allocation failure while "
+            f"dispatching {kernel} (simulated out-of-HBM)")
+
+
+class DeviceChaosSchedule:
+    """Seeded fault schedule: one RNG, drawn under a lock.
+
+    Rates are per-dispatch probabilities evaluated independently per
+    kind, in a fixed order (stall, recompile, oom, error), so the draw
+    sequence — and therefore the whole fault schedule — is a pure
+    function of the seed and the dispatch sequence.
+    """
+
+    def __init__(self, seed: int = 0, *,
+                 dispatch_error_rate: float = 0.0,
+                 oom_rate: float = 0.0,
+                 stall_rate: float = 0.0,
+                 stall_s: Tuple[float, float] = (0.0002, 0.002),
+                 recompile_rate: float = 0.0):
+        import random
+        self.seed = int(seed)
+        self.dispatch_error_rate = float(dispatch_error_rate)
+        self.oom_rate = float(oom_rate)
+        self.stall_rate = float(stall_rate)
+        self.stall_s = (float(stall_s[0]), float(stall_s[1]))
+        self.recompile_rate = float(recompile_rate)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    def draw(self) -> float:
+        with self._lock:
+            return self._rng.random()
+
+    def draw_stall(self) -> float:
+        lo, hi = self.stall_s
+        with self._lock:
+            return lo + (hi - lo) * self._rng.random()
+
+    def draw_key_salt(self) -> int:
+        with self._lock:
+            return self._rng.getrandbits(32)
+
+
+class ChaosEngine:
+    """Device-fault injector armed at the dispatch funnel.
+
+    ``kernel_filter`` (kernel name -> bool) scopes injection to a
+    subset of kernels; ``sleep`` is swappable so tests can run stall
+    schedules without wall-clock cost. ``fault_log`` records
+    ``(kind, kernel, gate)`` tuples in injection order — two runs with
+    the same seed and workload produce identical logs, which is the
+    replayability contract the soak asserts.
+    """
+
+    def __init__(self, schedule: DeviceChaosSchedule, *,
+                 kernel_filter: Optional[Callable[[str], bool]] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        import time
+        self.schedule = schedule
+        self.kernel_filter = kernel_filter
+        self.enabled = True
+        self.fault_log: List[Tuple[str, str, Optional[str]]] = []
+        self.fault_counts = {k: 0 for k in ALL_KINDS}
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._log_lock = threading.Lock()
+
+    def _record(self, kind: str, kernel: str, gate: Optional[str]) -> None:
+        with self._log_lock:
+            self.fault_log.append((kind, kernel, gate))
+            self.fault_counts[kind] += 1
+        _DEVICE_FAULTS.inc()
+
+    @property
+    def total_faults(self) -> int:
+        return len(self.fault_log)
+
+    def on_dispatch(self, name: str, *, key=None,
+                    gate: Optional[str] = None, route: str = "device"):
+        """Funnel hook: perturb (or fail) one dispatch; returns the
+        possibly-salted compile key. Raising here surfaces at the call
+        site's ``with device_dispatch(...)`` statement."""
+        if not self.enabled:
+            return key
+        if self.kernel_filter is not None and not self.kernel_filter(name):
+            return key
+        s = self.schedule
+        if s.stall_rate and s.draw() < s.stall_rate:
+            self._record(KIND_STALL, name, gate)
+            self._sleep(s.draw_stall())
+        if (s.recompile_rate and key is not None
+                and s.draw() < s.recompile_rate):
+            self._record(KIND_RECOMPILE, name, gate)
+            # a salted key is a first sighting for device obs: it counts
+            # a compile and, past the alarm threshold, a recompile storm
+            # — shape churn simulated without touching the jit cache
+            key = (key, "chaos-recompile", s.draw_key_salt())
+        if s.oom_rate and s.draw() < s.oom_rate:
+            self._record(KIND_OOM, name, gate)
+            raise DeviceResourceExhaustedError(name)
+        if s.dispatch_error_rate and s.draw() < s.dispatch_error_rate:
+            self._record(KIND_ERROR, name, gate)
+            raise DeviceChaosError(
+                f"injected dispatch failure: {name} (gate={gate}, "
+                f"route={route})")
+        return key
+
+    def arm(self) -> None:
+        from delta_tpu.obs import device as obs_device
+        obs_device.set_dispatch_chaos(self)
+
+    def disarm(self) -> None:
+        from delta_tpu.obs import device as obs_device
+        obs_device.set_dispatch_chaos(None)
+
+    def __enter__(self) -> "ChaosEngine":
+        self.arm()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.disarm()
+        return False
+
+
+def engine_from_env() -> Optional[ChaosEngine]:
+    """Build an engine from ``DELTA_TPU_DEVICE_CHAOS*`` knobs, or None
+    when unarmed. Call sites (bench, ad-hoc soaks) arm it explicitly —
+    importing this module never injects anything."""
+    raw = os.environ.get("DELTA_TPU_DEVICE_CHAOS", "off").strip().lower()
+    if raw in ("", "off", "0", "false", "no"):
+        return None
+    try:
+        seed = int(raw)
+    except ValueError:
+        seed = 0
+    rate = float(os.environ.get("DELTA_TPU_DEVICE_CHAOS_RATE", "0.05"))
+    kinds_raw = os.environ.get("DELTA_TPU_DEVICE_CHAOS_KINDS", "")
+    kinds = {k.strip() for k in kinds_raw.split(",") if k.strip()} or set(
+        ALL_KINDS)
+    sched = DeviceChaosSchedule(
+        seed,
+        dispatch_error_rate=rate if KIND_ERROR in kinds else 0.0,
+        oom_rate=rate if KIND_OOM in kinds else 0.0,
+        stall_rate=rate if KIND_STALL in kinds else 0.0,
+        recompile_rate=rate if KIND_RECOMPILE in kinds else 0.0)
+    return ChaosEngine(sched)

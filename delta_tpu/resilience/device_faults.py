@@ -1,0 +1,91 @@
+"""Device-route failure absorption: shed-and-retry + classify-and-fall-back.
+
+Every gated device route (`replay`/`parse`/`decode`/`skip`/`sql`) has a
+host twin, so a failed device dispatch is never fatal — but the
+fallback must be *disciplined*: the exception is classified through
+`resilience/classify.py`, the verdict feeds the route's circuit breaker
+(`parallel/gate.py::route_failed`), the route's cataloged fallback
+counter is bumped, and only then does the host twin run. The
+retry-discipline lint pass enforces this shape at every
+`device_dispatch` call site.
+
+The canonical consumer-site pattern::
+
+    from delta_tpu.resilience import device_faults
+    from delta_tpu.parallel import gate as gate_mod
+
+    try:
+        out = device_faults.shed_retry("replay", run_device)
+        gate_mod.route_ok("replay")
+    except Exception as e:
+        if not device_faults.absorb_route_failure("replay", e):
+            raise                      # permanent: the error is an answer
+        _FALLBACKS.inc()
+        obs.gate_fell_back("replay", "host",
+                           reason=f"device-error:{type(e).__name__}")
+        with obs.gate_observation("replay", "host"):
+            out = run_host()
+
+:func:`shed_retry` implements HBM-pressure shed-and-retry: on an
+allocation failure (``RESOURCE_EXHAUSTED``) it asks the resident ledger
+(`obs/hbm.py`) to evict the cheapest-to-rebuild artifacts and retries
+the dispatch exactly once; a second failure — or nothing sheddable —
+propagates to the absorption path and the host twin takes over.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+from delta_tpu import obs
+
+T = TypeVar("T")
+
+_SHED_RETRIES = obs.counter("hbm.shed_retries")
+
+# Allocation-failure shapes: real XLA allocator errors carry
+# RESOURCE_EXHAUSTED in their message (jaxlib raises XlaRuntimeError,
+# whose *type* varies across jaxlib versions — match text, not type);
+# the injected twin (device_chaos.DeviceResourceExhaustedError) uses
+# the same marker on purpose.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True when the exception looks like a device allocation failure."""
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def shed_retry(gate: str, fn: Callable[[], T]) -> T:
+    """Run one device-route thunk with HBM-pressure shed-and-retry.
+
+    On an allocation failure, ask the resident ledger to shed the
+    cheapest-to-rebuild artifacts and retry ``fn`` once; any other
+    exception — and a retry that fails again — propagates to the
+    caller's absorption handler. The retry is observable: it bumps
+    ``hbm.shed_retries`` and the ledger's shed counters."""
+    try:
+        return fn()
+    except Exception as exc:
+        if not is_resource_exhausted(exc):
+            raise
+        from delta_tpu.obs import hbm
+        n, _freed = hbm.shed()
+        if not n:
+            raise
+        _SHED_RETRIES.inc()
+        obs.add_event("device.shed_retry", gate=gate, evicted=n)
+        return fn()
+
+
+def absorb_route_failure(gate: str, exc: BaseException) -> bool:
+    """Classify one device-route failure and feed the route breaker.
+
+    Returns True for transient verdicts — the caller bumps its fallback
+    counter and runs the host twin; False for permanent ones — the
+    caller re-raises (real corruption or a genuine bug must surface,
+    not be silently recomputed on the host)."""
+    from delta_tpu.parallel.gate import route_failed
+    from delta_tpu.resilience.classify import TRANSIENT
+    return route_failed(gate, exc) == TRANSIENT

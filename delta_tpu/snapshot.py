@@ -105,7 +105,10 @@ class Snapshot:
                     self._engine.fs, seg.log_path,
                     target_version=torn_v - 1)
             except (LogCorruptedError, pa.ArrowException,
-                    FileNotFoundError) as e:
+                    OSError) as e:
+                # OSError covers pyarrow's footer/thrift damage too:
+                # decoders raise it bare (not via ArrowException) when
+                # the parquet magic or metadata length is garbled
                 if not seg.checkpoints:
                     raise
                 if isinstance(e, FileNotFoundError) and \

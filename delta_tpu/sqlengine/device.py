@@ -23,6 +23,7 @@ device."""
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 from typing import List, Optional, Sequence
@@ -32,7 +33,7 @@ import pandas as pd
 
 from delta_tpu import obs
 from delta_tpu.obs.device import gate_fell_back
-from delta_tpu.parallel.gate import sql_route
+from delta_tpu.parallel.gate import route_ok, sql_route
 
 _log = logging.getLogger(__name__)
 
@@ -43,6 +44,33 @@ _FALLBACKS = obs.counter("sql.device_fallbacks")
 _QUERIES = obs.counter("sql.device_queries")
 
 sqlops = None  # set on first DeviceSpine construction (defers jax)
+
+
+def _absorbing(method):
+    """Disciplined device-failure contract around one public operator
+    entry point: shed-and-retry on allocation failure, classify the
+    exception through `resilience/classify.py` (feeding the sql route
+    breaker), bump the cataloged fallback counter, and return None so
+    the executor keeps its pandas path. Permanent verdicts re-raise —
+    a real bug must surface, not be recomputed on the host. Non-None
+    returns report success to the breaker (closing half-open probes)."""
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        from delta_tpu.resilience import device_faults
+
+        try:
+            out = device_faults.shed_retry(
+                "sql", lambda: method(self, *args, **kwargs))
+        except Exception as e:
+            if not device_faults.absorb_route_failure("sql", e):
+                raise
+            return self._fell_back(f"device-error:{type(e).__name__}")
+        if out is not None:
+            route_ok("sql")
+        return out
+
+    return wrapper
 
 
 def _load_sqlops():
@@ -179,6 +207,7 @@ class DeviceSpine:
 
     # ------------------------------------------------------ group-by --
 
+    @_absorbing
     def groupby(self, work: pd.DataFrame, names: List[str],
                 agg_specs: dict) -> Optional[pd.DataFrame]:
         """Device GROUP BY over `work` (key cols `names`, one
@@ -264,6 +293,7 @@ class DeviceSpine:
 
     # --------------------------------------------------------- joins --
 
+    @_absorbing
     def merge(self, left: pd.DataFrame, right: pd.DataFrame, how: str,
               lk: List[str], rk: List[str],
               right_origin: Optional[pd.DataFrame] = None
@@ -381,6 +411,7 @@ class DeviceSpine:
             vals = -vals
         return [null_lane, vals]
 
+    @_absorbing
     def sort_frame(self, frame: pd.DataFrame, cols: List[str],
                    ascs: List[bool]) -> Optional[pd.DataFrame]:
         """`_sql_sort` on device: multi-key stable sort with Spark
@@ -403,6 +434,7 @@ class DeviceSpine:
 
     # ------------------------------------------------------- windows --
 
+    @_absorbing
     def partition_transform(self, parts: List[pd.Series], s: pd.Series,
                             fn: str) -> Optional[pd.Series]:
         """groupby(parts).transform(fn) on device: aggregate per
@@ -466,6 +498,7 @@ class DeviceSpine:
             kb[1:] |= kl[1:] != kl[:-1]
         return perm, pb, kb
 
+    @_absorbing
     def window_rank(self, parts: List[pd.Series], order_items: list,
                     which: str, n: int,
                     index) -> Optional[pd.Series]:
@@ -484,6 +517,7 @@ class DeviceSpine:
         out[perm] = picked
         return pd.Series(out, index=index)
 
+    @_absorbing
     def window_running(self, parts: List[pd.Series], order_items: list,
                        s: pd.Series, fn: str, frame_kind: str,
                        index) -> Optional[pd.Series]:

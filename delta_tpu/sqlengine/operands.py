@@ -190,6 +190,10 @@ class ResidentOperandCache:
                 table_path=self.table_path, version=self.version,
                 arrays=tuple(self._arrays),
                 rebuild_cost_class="cheap",  # lazy re-upload from host
+                # shed under HBM pressure: release() marks the cache
+                # dead and snapshot_operand_cache builds a fresh one on
+                # the next query
+                evictor=self.release,
             )
             self._registered = True
         else:

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import datetime
 import threading
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -258,8 +259,20 @@ class ResidentStatsIndex:
             self, kind=hbm.KIND_STATS_INDEX, table_path=self.table_path,
             version=self.version, arrays=(dv, dvalid),
             rebuild_cost_class="cheap",  # lazy re-upload from host lanes
+            evictor=self.evict_device,
         )
         return self._dev
+
+    def evict_device(self) -> None:
+        """Drop only the device copy (ledger shed under HBM pressure).
+        The host lanes stay, so the next `device_lanes()` call lazily
+        re-uploads — this is what makes the artifact cheap-to-rebuild
+        rather than lost."""
+        with self._lock:
+            if self._dev is not None:
+                self._dev = None
+                self._hbm.release()
+                self._hbm = hbm.noop_handle()
 
     def release(self) -> None:
         """Drop host lanes and the device copy (serve-cache eviction or
@@ -470,6 +483,13 @@ def snapshot_stats_index(state, files: pa.Table):
                           table_path=getattr(state, "table_path", None),
                           version=getattr(state, "version", None))
         state.stats_index = idx
+        # built implicitly by ordinary filtered scans, so a state
+        # dropped outside the explicit-release paths (one-shot reads,
+        # version advance, serve eviction) must not read as a ledger
+        # leak: the state's own GC releases the lanes (idempotent with
+        # the explicit paths — same contract as the operand cache in
+        # sqlengine/operands.py)
+        weakref.finalize(state, ResidentStatsIndex.release, idx)
         _BUILDS.inc()
         return idx
 

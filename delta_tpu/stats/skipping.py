@@ -378,17 +378,34 @@ def skipping_mask(
                 engine_enabled=bool(getattr(engine, "use_device_skip", False)),
             )
             if route == "device":
-                lanes = rs.device_lanes()
-                if lanes is None:
-                    obs.gate_fell_back("skip", "host",
-                                       reason="no-resident-lanes")
+                from delta_tpu.parallel import gate as gate_mod
+                from delta_tpu.resilience import device_faults
+                try:
+                    lanes = device_faults.shed_retry(
+                        "skip", rs.device_lanes)
+                    if lanes is None:
+                        obs.gate_fell_back("skip", "host",
+                                           reason="no-resident-lanes")
+                        route = "host"
+                    else:
+                        keep &= device_faults.shed_retry(
+                            "skip",
+                            lambda: ops_skipping.skip_mask_block(
+                                lanes[0], lanes[1], block, n))
+                        gate_mod.route_ok("skip")
+                        _DEVICE_PLANS.inc()
+                        if fallback:
+                            _DEVICE_FALLBACKS.inc(len(fallback))
+                except Exception as e:
+                    # disciplined fallback: classify (feeds the route
+                    # breaker), bump the cataloged counter, host twin
+                    if not device_faults.absorb_route_failure("skip", e):
+                        raise
+                    _DEVICE_FALLBACKS.inc()
+                    obs.gate_fell_back(
+                        "skip", "host",
+                        reason=f"device-error:{type(e).__name__}")
                     route = "host"
-                else:
-                    keep &= ops_skipping.skip_mask_block(
-                        lanes[0], lanes[1], block, n)
-                    _DEVICE_PLANS.inc()
-                    if fallback:
-                        _DEVICE_FALLBACKS.inc(len(fallback))
             if route == "host":
                 with obs.gate_observation("skip", "host"):
                     keep &= ops_skipping.host_skip_mask(

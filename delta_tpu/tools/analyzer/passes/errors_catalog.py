@@ -51,8 +51,11 @@ _ALLOWED_NON_DELTA = {
     "DecodeUnsupported", "DynamoDbError",
     # storage-protocol IOError subclasses: StorageRequestError carries
     # the HTTP status the resilience classifier keys on; ChaosError is
-    # the chaos harness's injected (always-transient) fault
+    # the chaos harness's injected (always-transient) fault, and the
+    # Device* pair is its dispatch-funnel twin (classified transient
+    # via the `retryable` attribute)
     "StorageRequestError", "ChaosError",
+    "DeviceChaosError", "DeviceResourceExhaustedError",
 }
 
 # catalog entries with no statically-attributable raise site, each

@@ -15,13 +15,20 @@ loop anywhere else is a discipline leak three ways:
   ``storage.retry.attempts``, so chaos runs and production incidents
   under-report.
 
-Two shapes are flagged:
+Three shapes are flagged:
 
 1. a ``for``/``while`` loop that both handles exceptions and calls
    ``time.sleep`` — the classic grown-by-hand retry/backoff loop;
 2. a ``for _ in range(<literal>)`` loop with a ``try`` directly in its
    body — a hard-coded attempt cap that belongs in ``RetryPolicy``
-   (env-tunable), not in the call site.
+   (env-tunable), not in the call site;
+3. a ``try`` whose body dispatches to the device (a ``device_dispatch``
+   call, or a route thunk run through ``shed_retry``) with an exception
+   handler that neither classifies the error (``classify`` /
+   ``is_transient`` / ``route_failed`` / ``absorb_route_failure``) nor
+   bumps a fallback counter (``.inc(...)``) nor re-raises — a silent
+   device fallback that starves the route breaker and under-reports
+   exactly the failures the chaos soak injects.
 
 ``delta_tpu/resilience/`` itself is exempt by path — the policy is the
 one place allowed to own the loop, and the chaos harness's injected
@@ -71,6 +78,51 @@ def _literal_range_loop(node: ast.For) -> bool:
             and isinstance(it.args[0].value, int))
 
 
+# exception-handler calls that count as "the error was classified":
+# the classifier itself, and the absorption helpers that route through
+# it (resilience/device_faults.py, parallel/gate.py)
+_CLASSIFIER_CALLS = {"classify", "is_transient", "route_failed",
+                     "absorb_route_failure"}
+
+# calls that mark the try body as a device-route dispatch site
+_DISPATCH_CALLS = {"device_dispatch", "shed_retry"}
+
+
+def _walk_same_scope(stmts):
+    """Walk statements without descending into nested function/class/
+    lambda scopes — a dispatch inside a nested def is its own call
+    site, not this try's."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue  # also prunes defs that ARE the try's statements
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _dispatches_device(stmts) -> bool:
+    """True when the statements contain a device-dispatch call."""
+    return any(
+        isinstance(n, ast.Call)
+        and (call_name(n) or "").rpartition(".")[2] in _DISPATCH_CALLS
+        for n in _walk_same_scope(stmts))
+
+
+def _handler_disciplined(handler: ast.ExceptHandler) -> bool:
+    """A disciplined device-dispatch handler classifies, counts, or
+    re-raises (incl. `except X: raise`-style translation)."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            tail = (call_name(n) or "").rpartition(".")[2]
+            if tail in _CLASSIFIER_CALLS or tail == "inc":
+                return True
+    return False
+
+
 @register
 class RetryDisciplineRule(Rule):
     id = "retry-discipline"
@@ -116,4 +168,21 @@ class RetryDisciplineRule(Rule):
                     "retry budget into resilience.RetryPolicy (env-"
                     "tunable) instead of hard-coding it (or audit + "
                     "suppress)"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not node.handlers or not _dispatches_device(node.body):
+                continue
+            for handler in node.handlers:
+                if not _handler_disciplined(handler):
+                    out.append(Finding(
+                        self.id, mod.rel, handler.lineno,
+                        handler.col_offset,
+                        "device_dispatch exception handler neither "
+                        "classifies the error (resilience.classify / "
+                        "absorb_route_failure), bumps a fallback "
+                        "counter, nor re-raises: silent device "
+                        "fallbacks starve the route breaker — follow "
+                        "the resilience/device_faults.py contract (or "
+                        "audit + suppress)"))
         return out

@@ -1097,6 +1097,94 @@ def fetch(op):
     assert not report.findings and report.suppressed
 
 
+def test_retry_silent_device_fallback_flagged():
+    # third shape: a device-dispatch try whose handler swallows the
+    # error without classifying, counting, or re-raising
+    src = """
+def read(route, thunk):
+    from delta_tpu.resilience import device_faults
+    try:
+        return device_faults.shed_retry("decode", thunk)
+    except Exception:
+        return None
+"""
+    report = analyze_sources({"delta_tpu/x.py": src},
+                             rules=["retry-discipline"])
+    found = _rules_fired(report, "retry-discipline")
+    assert found and "starve the route breaker" in found[0].message
+
+
+def test_retry_dispatch_handler_each_discipline_clean():
+    # classify, count, and re-raise each individually satisfy the
+    # contract (incl. dotted/method spellings)
+    src = """
+def a(thunk, gate):
+    from delta_tpu.parallel import gate as g
+    try:
+        return device_dispatch("k", thunk)
+    except Exception as e:
+        g.route_failed(gate, e)
+        return None
+
+def b(thunk, ctr):
+    try:
+        return device_dispatch("k", thunk)
+    except Exception:
+        ctr.inc()
+        return None
+
+def c(thunk):
+    from delta_tpu.errors import DeltaError
+    try:
+        return device_dispatch("k", thunk)
+    except Exception as e:
+        raise DeltaError(str(e)) from e
+
+def d(thunk):
+    from delta_tpu.resilience import device_faults
+    try:
+        return device_faults.shed_retry("skip", thunk)
+    except Exception as e:
+        if not device_faults.absorb_route_failure("skip", e):
+            raise
+        return None
+"""
+    report = analyze_sources({"delta_tpu/x.py": src},
+                             rules=["retry-discipline"])
+    assert not _rules_fired(report, "retry-discipline")
+
+
+def test_retry_dispatch_in_nested_scope_not_attributed():
+    # a dispatch inside a nested def is its own call site — the outer
+    # try that merely BUILDS the closure is not a dispatch site
+    src = """
+def plan(thunk):
+    try:
+        def later():
+            return device_dispatch("k", thunk)
+        return later
+    except Exception:
+        return None
+"""
+    report = analyze_sources({"delta_tpu/x.py": src},
+                             rules=["retry-discipline"])
+    assert not _rules_fired(report, "retry-discipline")
+
+
+def test_retry_silent_fallback_resilience_path_exempt():
+    src = """
+def absorb(thunk):
+    try:
+        return device_dispatch("k", thunk)
+    except Exception:
+        return None
+"""
+    report = analyze_sources(
+        {"delta_tpu/resilience/device_faults.py": src},
+        rules=["retry-discipline"])
+    assert not _rules_fired(report, "retry-discipline")
+
+
 # ------------------------------------------------- handler-discipline
 
 

@@ -230,3 +230,112 @@ def test_crc_mismatch_quarantined_and_reseeded(tmp_path):
     snap2 = _cold(tmp_path).latest_snapshot()
     assert _live_paths(snap2) == _expected_paths(3)
     assert obs.counter("snapshot.crc_quarantined").value == q0 + 1
+
+
+# --------------------------------------------- seeded read corruption
+
+
+def test_default_corrupt_pred_scope():
+    """Only payloads the fallback ladder can absorb are eligible: a
+    corrupt commit .json is unrecoverable data loss, so chaos never
+    touches it."""
+    from delta_tpu.resilience.chaos import _default_corrupt_pred as pred
+
+    log = "mem://t/_delta_log"
+    assert pred(f"{log}/00000000000000000003.checkpoint.parquet")
+    assert pred(f"{log}/00000000000000000009.checkpoint."
+                "0000000001.0000000004.parquet")
+    assert pred(f"{log}/00000000000000000003.crc")
+    assert not pred(f"{log}/00000000000000000003.json")
+    assert not pred(f"{log}/_last_checkpoint")
+
+
+def test_draw_flip_offsets_seeded_and_tail_windowed():
+    from delta_tpu.resilience.chaos import ChaosSchedule
+
+    a = ChaosSchedule(19).draw_flip_offsets(4096)
+    b = ChaosSchedule(19).draw_flip_offsets(4096)
+    assert a == b and a  # same seed, same damage
+    for off, bit in a:
+        assert 4096 - 16 <= off < 4096  # footer/digest window
+        assert 0 <= bit < 8
+    # payloads smaller than the window stay in bounds
+    for off, _bit in ChaosSchedule(19).draw_flip_offsets(5):
+        assert 0 <= off < 5
+
+
+def _corrupting_engine(seed, rate):
+    from delta_tpu.engine.host import HostEngine as _Host
+    from delta_tpu.resilience import ChaosSchedule, ChaosStore
+    from delta_tpu.storage.logstore import InMemoryLogStore
+
+    store = ChaosStore(InMemoryLogStore(),
+                       ChaosSchedule(seed, error_rate=0.0,
+                                     corrupt_read_rate=rate),
+                       sleep=lambda s: None)
+    return _Host(store_resolver=lambda path: store), store
+
+
+def test_read_corruption_absorbed_by_fallback_ladder():
+    """Every checkpoint/crc read returns a damaged payload, yet a cold
+    read still serves the exact table: the ladder (crc quarantine,
+    checkpoint fallback to JSON replay) absorbs validation failures the
+    transport never sees."""
+    import delta_tpu.api as dta
+    import pyarrow as pa
+
+    eng, store = _corrupting_engine(seed=23, rate=1.0)
+    path = "memory://corrupt-soak/tbl"
+
+    store.enabled = False  # build the table cleanly
+    dta.write_table(path, pa.table({"x": list(range(10))}), engine=eng)
+    for i in range(3):
+        dta.write_table(path, pa.table({"x": [100 + i]}), engine=eng,
+                        mode="append")
+    t = Table.for_path(path, eng)
+    t.checkpoint()
+    dta.write_table(path, pa.table({"x": [999]}), engine=eng,
+                    mode="append")
+    expected = sorted(list(range(10)) + [100, 101, 102, 999])
+
+    store.enabled = True
+    c0 = obs.counter("chaos.read_corruptions").value
+    f0 = obs.counter("snapshot.checkpoint_fallbacks").value
+    clear_parse_cache()
+    got = sorted(dta.read_table(path, engine=eng)
+                 .column("x").to_pylist())
+    assert got == expected  # read never fails, rows exact
+    assert store.fault_counts.get("corrupt_read", 0) > 0
+    assert obs.counter("chaos.read_corruptions").value > c0
+    # the damaged checkpoint was abandoned for JSON replay
+    assert obs.counter("snapshot.checkpoint_fallbacks").value > f0
+
+    store.enabled = False  # verification read, chaos off
+    clear_parse_cache()
+    clean = sorted(dta.read_table(path, engine=eng)
+                   .column("x").to_pylist())
+    assert clean == expected
+
+
+def test_read_corruption_never_touches_commit_json():
+    """Commit deltas are outside the damage scope even at rate 1.0:
+    every corrupted payload is a checkpoint artifact or a .crc
+    sidecar (both absorbable), never a .json commit (which would be
+    unrecoverable data loss)."""
+    import delta_tpu.api as dta
+    import pyarrow as pa
+
+    eng, store = _corrupting_engine(seed=29, rate=1.0)
+    path = "memory://corrupt-json/tbl"
+    store.enabled = False
+    dta.write_table(path, pa.table({"x": [1, 2, 3]}), engine=eng)
+    store.enabled = True
+    clear_parse_cache()
+    assert sorted(dta.read_table(path, engine=eng)
+                  .column("x").to_pylist()) == [1, 2, 3]
+    for kind, _op, hit in store.fault_log:
+        if kind != "corrupt_read":
+            continue
+        name = hit.rpartition("/")[2]
+        assert ".checkpoint" in name or name.endswith(".crc"), hit
+        assert not name.endswith(".json"), hit

@@ -82,6 +82,10 @@ _ALLOWED_NON_DELTA = {
     # the HTTP status the resilience classifier keys on; ChaosError is
     # the chaos harness's injected (always-transient) fault
     "StorageRequestError", "ChaosError",
+    # device-chaos twins: seeded injections at the dispatch funnel,
+    # classified by retryable/markers like real runtime errors
+    # (resilience/device_chaos.py)
+    "DeviceChaosError", "DeviceResourceExhaustedError",
 }
 
 

@@ -609,3 +609,179 @@ def test_chaos_soak_many_seeds():
                                       stale_list_rate=0.0)
         assert _physical_digest(eng, path) == clean_strict, \
             f"layout divergence under stale-free chaos seed {seed + 100}"
+
+
+# ------------------------------------------- breaker half-open races
+
+
+def test_breaker_half_open_admits_exactly_one_concurrent_probe():
+    """Race: N threads hit a cooled-down open breaker at once; exactly
+    one wins the probe slot, the rest fast-fail typed."""
+    import threading
+
+    b, now = _breaker(threshold=1, reset_s=5.0)
+    b.before_call()
+    b.on_failure()
+    assert b.state == "open"
+    now[0] = 6.0  # cooled down: next call becomes the probe
+
+    barrier = threading.Barrier(8)
+    outcomes = []
+    lock = threading.Lock()
+
+    def contender():
+        barrier.wait()
+        try:
+            b.before_call()
+            with lock:
+                outcomes.append("probe")
+        except CircuitOpenError:
+            with lock:
+                outcomes.append("fast-fail")
+
+    threads = [threading.Thread(target=contender) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outcomes.count("probe") == 1
+    assert outcomes.count("fast-fail") == 7
+    b.on_success()  # the winner reports back
+    assert b.state == "closed"
+
+
+def test_breaker_half_open_late_success_after_reopen_still_closes():
+    """Race: probe A is reclaimed as stale, probe B fails and re-opens
+    the circuit — then A's slow success finally lands. on_success is
+    authoritative (the endpoint answered), so the circuit closes; a
+    wedged-open circuit would need another full cooldown for no
+    reason."""
+    b, now = _breaker(threshold=1, reset_s=5.0)
+    b.before_call()
+    b.on_failure()
+    now[0] = 6.0
+    b.before_call()           # probe A admitted, caller stalls
+    now[0] = 12.0
+    b.before_call()           # A stale -> reclaimed by probe B
+    b.on_failure()            # B fails: re-open, clock restarts
+    assert b.state == "open"
+    b.on_success()            # A's success finally lands
+    assert b.state == "closed"
+    b.before_call()           # and calls flow again
+
+
+def test_breaker_concurrent_failures_trip_exactly_once():
+    """Race: threshold-many concurrent failures must produce one open
+    transition (one `storage.breaker.opens` bump), not one per racer."""
+    import threading
+
+    opens = obs.counter("storage.breaker.opens").value
+    b = CircuitBreaker("ep-race", threshold=4, reset_s=60.0)
+    barrier = threading.Barrier(4)
+
+    def failer():
+        barrier.wait()
+        b.on_failure()
+
+    threads = [threading.Thread(target=failer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert b.state == "open"
+    assert obs.counter("storage.breaker.opens").value == opens + 1
+
+
+# --------------------------------------------------- deadline edges
+
+
+def test_deadline_zero_budget_is_immediately_expired():
+    from delta_tpu import resilience
+    from delta_tpu.errors import DeadlineExceededError
+
+    with resilience.deadline_scope(0):
+        assert resilience.expired()
+        assert resilience.remaining() <= 0
+        with pytest.raises(DeadlineExceededError):
+            resilience.check_deadline("unit probe")
+
+
+def test_deadline_negative_budget_clamps_to_zero():
+    from delta_tpu import resilience
+
+    import time as _time
+
+    t0 = _time.monotonic()
+    with resilience.deadline_scope(-30.0) as at:
+        assert at is not None
+        assert at <= t0 + 1.0  # clamped to "now", not 30s in the past
+        assert resilience.expired()
+
+
+def test_deadline_none_is_transparent():
+    from delta_tpu import resilience
+
+    assert resilience.current_deadline() is None
+    assert resilience.remaining() is None
+    assert not resilience.expired()
+    resilience.check_deadline("no ambient budget")  # never raises
+    with resilience.deadline_scope(60):
+        outer = resilience.current_deadline()
+        with resilience.deadline_scope(None) as at:
+            # None scope: the enclosing budget stays in force
+            assert at == outer
+            assert resilience.current_deadline() == outer
+
+
+def test_deadline_nested_scope_only_tightens():
+    from delta_tpu import resilience
+
+    with resilience.deadline_scope(0.05) as outer:
+        with resilience.deadline_scope(60.0) as inner:
+            # the callee cannot outlive the caller's budget
+            assert inner == outer
+        with resilience.deadline_scope(0.001) as tighter:
+            assert tighter < outer
+        assert resilience.current_deadline() == outer
+    assert resilience.current_deadline() is None
+
+
+def test_deadline_scope_at_past_instant_expired():
+    import time as _time
+
+    from delta_tpu import resilience
+    from delta_tpu.errors import DeadlineExceededError
+
+    with resilience.deadline_scope_at(_time.monotonic() - 1.0):
+        assert resilience.expired()
+        assert resilience.remaining() < 0
+        with pytest.raises(DeadlineExceededError):
+            resilience.check_deadline()
+    # reset token restored the clean ambient state
+    assert resilience.current_deadline() is None
+
+
+def test_deadline_scope_at_respects_enclosing_budget():
+    import time as _time
+
+    from delta_tpu import resilience
+
+    with resilience.deadline_scope(0.05) as outer:
+        with resilience.deadline_scope_at(
+                _time.monotonic() + 60.0) as at:
+            assert at == outer
+
+
+def test_expired_deadline_aborts_retry_before_first_attempt():
+    """The policy must not burn a single attempt once the ambient
+    budget is gone — abandonment happens at the attempt boundary."""
+    from delta_tpu import resilience
+    from delta_tpu.errors import DeadlineExceededError
+
+    attempts = []
+    p = RetryPolicy(max_attempts=5, base_s=0, cap_s=0, deadline_s=60,
+                    sleep=lambda s: None)
+    with resilience.deadline_scope(0):
+        with pytest.raises(DeadlineExceededError):
+            p.call(lambda: attempts.append(1))
+    assert not attempts
